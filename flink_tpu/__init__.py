@@ -1,0 +1,13 @@
+"""flink-tpu: a TPU-native stream- and batch-processing framework.
+
+A from-scratch re-design of Apache Flink's capabilities (reference at
+/root/reference, v1.14-SNAPSHOT) around JAX/XLA/Pallas: records flow as
+columnar micro-batches, keyed state lives as key-group-sharded dense arrays in
+device HBM, windowed aggregation is an XLA-fused segment-combine, and
+multi-chip scaling rides ``jax.sharding.Mesh`` + ``shard_map`` collectives
+over ICI instead of a Netty shuffle.
+"""
+
+__version__ = "0.1.0"
+
+from flink_tpu.config.config_option import ConfigOption, Configuration  # noqa: F401

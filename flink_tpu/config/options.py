@@ -1,0 +1,114 @@
+"""Built-in option groups.
+
+Analog of the reference's ``XxxOptions`` classes in
+``flink-core/src/main/java/org/apache/flink/configuration/`` (e.g.
+``CoreOptions``, ``CheckpointingOptions``, ``StateBackendOptions``,
+``TaskManagerOptions``, ``NettyShuffleEnvironmentOptions``).
+"""
+
+from flink_tpu.config.config_option import key
+
+
+class CoreOptions:
+    DEFAULT_PARALLELISM = key("parallelism.default").int_type().default_value(
+        1, "Default operator parallelism (number of key-group shards driven concurrently).")
+    MAX_PARALLELISM = key("pipeline.max-parallelism").int_type().default_value(
+        128, "Number of key groups (state sharding unit; rescaling upper bound).")
+    AUTO_WATERMARK_INTERVAL = key("pipeline.auto-watermark-interval").duration_type().default_value(
+        200, "Periodic watermark emission interval in ms.")
+    OBJECT_REUSE = key("pipeline.object-reuse").bool_type().default_value(
+        True, "Batches are passed by reference between chained operators.")
+
+
+class ExecutionOptions:
+    MICRO_BATCH_SIZE = key("execution.micro-batch-size").int_type().default_value(
+        65536, "Records per device micro-batch (the batched mailbox default action).")
+    MICRO_BATCH_TIMEOUT_MS = key("execution.micro-batch-timeout").duration_type().default_value(
+        5, "Max ms to wait filling a micro-batch before flushing a partial one.")
+    RUNTIME_MODE = key("execution.runtime-mode").string_type().default_value(
+        "STREAMING", "STREAMING | BATCH.")
+    BUFFER_TIMEOUT_MS = key("execution.buffer-timeout").duration_type().default_value(
+        100, "Output flush interval in ms.")
+
+
+class StateOptions:
+    BACKEND = key("state.backend").string_type().default_value(
+        "hbm", "Keyed state backend: 'hbm' (device-resident dense arrays) or 'host' (numpy).")
+    KEY_CAPACITY = key("state.backend.hbm.key-capacity").int_type().default_value(
+        1 << 20, "Initial dense key-slot capacity per key-group shard (grows by doubling).")
+    PANE_RING_SLOTS = key("state.backend.hbm.pane-ring-slots").int_type().default_value(
+        0, "Pane ring slots (0 = derive from window size / lateness).")
+    CHECKPOINT_DIR = key("state.checkpoints.dir").string_type().default_value(
+        None, "Directory for checkpoint snapshots.")
+    SAVEPOINT_DIR = key("state.savepoints.dir").string_type().default_value(
+        None, "Directory for user-triggered savepoints.")
+    INCREMENTAL = key("state.backend.incremental").bool_type().default_value(
+        False, "Incremental checkpoints (chunk diffing against previous snapshot).")
+
+
+class CheckpointingOptions:
+    INTERVAL = key("execution.checkpointing.interval").duration_type().default_value(
+        0, "Checkpoint interval in ms (0 disables periodic checkpoints).")
+    TIMEOUT = key("execution.checkpointing.timeout").duration_type().default_value(
+        600_000, "Checkpoint timeout in ms.")
+    MODE = key("execution.checkpointing.mode").string_type().default_value(
+        "EXACTLY_ONCE", "EXACTLY_ONCE | AT_LEAST_ONCE.")
+    MAX_CONCURRENT = key("execution.checkpointing.max-concurrent-checkpoints").int_type().default_value(
+        1, "Max concurrent in-flight checkpoints.")
+    MIN_PAUSE = key("execution.checkpointing.min-pause").duration_type().default_value(
+        0, "Minimum pause between checkpoints in ms.")
+    RETAINED = key("state.checkpoints.num-retained").int_type().default_value(
+        1, "How many completed checkpoints to retain.")
+
+
+class DeviceOptions:
+    PLATFORM = key("device.platform").string_type().default_value(
+        None, "Force jax platform ('tpu'|'cpu'); None = jax default.")
+    MESH_SHAPE = key("device.mesh.shape").string_type().default_value(
+        None, "Mesh shape as 'kg=8' style spec; None = all devices on one 'kg' axis.")
+    DONATE_STATE = key("device.donate-state").bool_type().default_value(
+        True, "Donate state buffers into the jitted step (in-place HBM update).")
+    SCATTER_MODE = key("device.scatter-mode").string_type().default_value(
+        "sorted", "Segment aggregation strategy: 'direct' scatter-add | 'sorted' dedupe+unique-scatter.")
+
+
+class NetworkOptions:
+    """Analog of NettyShuffleEnvironmentOptions — host data-plane knobs."""
+    BUFFERS_PER_CHANNEL = key("taskmanager.network.memory.buffers-per-channel").int_type().default_value(
+        2, "Exclusive credit buffers per channel in the host exchange layer.")
+    FLOATING_BUFFERS_PER_GATE = key("taskmanager.network.memory.floating-buffers-per-gate").int_type().default_value(
+        8, "Floating credit buffers shared per input gate.")
+    BUFFER_SIZE = key("taskmanager.memory.segment-size").memory_type().default_value(
+        32 * 1024, "Host exchange buffer (segment) size in bytes.")
+    COMPRESSION = key("taskmanager.network.compression.enabled").bool_type().default_value(
+        False, "zstd-compress exchange buffers between hosts.")
+
+
+class RestOptions:
+    PORT = key("rest.port").int_type().default_value(8081, "REST/web endpoint port.")
+    ADDRESS = key("rest.address").string_type().default_value("127.0.0.1", "REST bind address.")
+
+
+class HeartbeatOptions:
+    INTERVAL = key("heartbeat.interval").duration_type().default_value(
+        1000, "Heartbeat interval in ms between coordinator and workers.")
+    TIMEOUT = key("heartbeat.timeout").duration_type().default_value(
+        5000, "Heartbeat timeout in ms before a worker is declared dead.")
+
+
+class RestartOptions:
+    STRATEGY = key("restart-strategy").string_type().default_value(
+        "exponential-delay", "none | fixed-delay | exponential-delay | failure-rate.")
+    FIXED_DELAY_ATTEMPTS = key("restart-strategy.fixed-delay.attempts").int_type().default_value(3)
+    FIXED_DELAY_DELAY = key("restart-strategy.fixed-delay.delay").duration_type().default_value(1000)
+    EXP_INITIAL_BACKOFF = key("restart-strategy.exponential-delay.initial-backoff").duration_type().default_value(100)
+    EXP_MAX_BACKOFF = key("restart-strategy.exponential-delay.max-backoff").duration_type().default_value(60_000)
+    EXP_MULTIPLIER = key("restart-strategy.exponential-delay.backoff-multiplier").float_type().default_value(2.0)
+
+
+class MetricOptions:
+    REPORTERS = key("metrics.reporters").list_type().default_value(
+        [], "Active metric reporter names.")
+    LATENCY_INTERVAL = key("metrics.latency.interval").duration_type().default_value(
+        0, "Latency-marker emission interval in ms (0 = disabled).")
+    SCOPE_DELIMITER = key("metrics.scope.delimiter").string_type().default_value(".")

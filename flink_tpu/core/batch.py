@@ -1,0 +1,197 @@
+"""Stream elements, batched.
+
+The reference moves one ``StreamElement`` at a time through the dataflow
+(records, watermarks, barriers, latency markers — see
+``flink-streaming-java/.../streamrecord/``).  The TPU-native unit of flow is a
+**columnar RecordBatch** (dense numpy/jax arrays, one device micro-step per
+batch); control elements (``Watermark``, ``CheckpointBarrier``,
+``LatencyMarker``, ``StreamStatus``) stay individual and flow *in order*
+between batches — boundary-exactness for checkpoints falls out of that
+ordering exactly as it does from the reference's in-band barriers
+(``SingleCheckpointBarrierHandler.java:194``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Iterable, List, Mapping, Optional
+
+import numpy as np
+
+LONG_MIN = -(2 ** 63)
+LONG_MAX = 2 ** 63 - 1
+
+#: Watermark value meaning "end of stream" (reference: Watermark.MAX_WATERMARK)
+MAX_WATERMARK = LONG_MAX
+
+
+class StreamElement:
+    __slots__ = ()
+
+    def is_batch(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
+class Watermark(StreamElement):
+    """Event-time watermark: no element with ts <= this will arrive later."""
+
+    timestamp: int
+
+    def is_batch(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
+class StreamStatus(StreamElement):
+    """Channel idleness marker (``StreamStatus`` analog): idle channels are
+    excluded from watermark alignment."""
+
+    idle: bool
+
+
+@dataclass(frozen=True)
+class LatencyMarker(StreamElement):
+    """Latency-tracking probe (``LatencyMarker.java:32``): flows through
+    operators without entering user functions; sinks record marked_time→now."""
+
+    marked_time: float
+    source_id: int = 0
+    subtask_index: int = 0
+
+
+@dataclass(frozen=True)
+class CheckpointBarrier(StreamElement):
+    """In-band checkpoint barrier (``CheckpointBarrier.java``)."""
+
+    checkpoint_id: int
+    timestamp: int
+    is_savepoint: bool = False
+
+
+@dataclass(frozen=True)
+class EndOfInput(StreamElement):
+    """End of a bounded stream."""
+
+
+class RecordBatch(StreamElement):
+    """Columnar record batch.
+
+    columns:    name -> array [B, ...] (numpy on host, jax on device paths)
+    timestamps: int64[B] event timestamps in ms, or None (no time semantics yet)
+    key_ids:    int32[B] dense key-slot ids (present after keying), or None
+    key_groups: int32[B] key-group per record (present after keying), or None
+    """
+
+    __slots__ = ("columns", "timestamps", "key_ids", "key_groups", "_size")
+
+    def __init__(self, columns: Mapping[str, Any], timestamps=None,
+                 key_ids=None, key_groups=None):
+        self.columns: Dict[str, Any] = dict(columns)
+        self.timestamps = timestamps
+        self.key_ids = key_ids
+        self.key_groups = key_groups
+        if self.columns:
+            first = next(iter(self.columns.values()))
+            self._size = int(np.shape(first)[0])
+        elif timestamps is not None:
+            self._size = int(np.shape(timestamps)[0])
+        else:
+            self._size = 0
+        # Row-alignment invariant: a size-changing map that keeps stale
+        # timestamps/key_ids would silently attribute rows to wrong keys.
+        for attr in ("timestamps", "key_ids", "key_groups"):
+            v = getattr(self, attr)
+            if v is not None and int(np.shape(v)[0]) != self._size:
+                raise ValueError(
+                    f"{attr} length {int(np.shape(v)[0])} != batch size {self._size}")
+        for n, v in self.columns.items():
+            if int(np.shape(v)[0]) != self._size:
+                raise ValueError(
+                    f"column {n!r} length {int(np.shape(v)[0])} != batch size {self._size}")
+
+    def is_batch(self) -> bool:
+        return True
+
+    def __len__(self) -> int:
+        return self._size
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def column(self, name: str):
+        return self.columns[name]
+
+    def with_columns(self, columns: Mapping[str, Any]) -> "RecordBatch":
+        return RecordBatch(columns, self.timestamps, self.key_ids, self.key_groups)
+
+    def with_keys(self, key_ids, key_groups=None) -> "RecordBatch":
+        return RecordBatch(self.columns, self.timestamps, key_ids, key_groups)
+
+    def with_timestamps(self, timestamps) -> "RecordBatch":
+        return RecordBatch(self.columns, timestamps, self.key_ids, self.key_groups)
+
+    def select(self, mask: np.ndarray) -> "RecordBatch":
+        """Host-side row filter by boolean mask."""
+        cols = {k: np.asarray(v)[mask] for k, v in self.columns.items()}
+        ts = None if self.timestamps is None else np.asarray(self.timestamps)[mask]
+        kid = None if self.key_ids is None else np.asarray(self.key_ids)[mask]
+        kg = None if self.key_groups is None else np.asarray(self.key_groups)[mask]
+        return RecordBatch(cols, ts, kid, kg)
+
+    def take(self, indices: np.ndarray) -> "RecordBatch":
+        cols = {k: np.asarray(v)[indices] for k, v in self.columns.items()}
+        ts = None if self.timestamps is None else np.asarray(self.timestamps)[indices]
+        kid = None if self.key_ids is None else np.asarray(self.key_ids)[indices]
+        kg = None if self.key_groups is None else np.asarray(self.key_groups)[indices]
+        return RecordBatch(cols, ts, kid, kg)
+
+    @staticmethod
+    def concat(batches: Iterable["RecordBatch"]) -> "RecordBatch":
+        all_batches = list(batches)
+        batches = [b for b in all_batches if len(b)]
+        if not batches:
+            # Preserve schema/keyed-ness of an all-empty flush so downstream
+            # presence checks (timestamps/key_ids is not None) stay stable.
+            return all_batches[0] if all_batches else RecordBatch({})
+        if len(batches) == 1:
+            return batches[0]
+        first = batches[0]
+        names = set(first.columns)
+        for b in batches[1:]:
+            if set(b.columns) != names:
+                raise ValueError(f"concat of heterogeneous batches: {sorted(names)} vs {sorted(b.columns)}")
+            for attr in ("timestamps", "key_ids", "key_groups"):
+                if (getattr(b, attr) is None) != (getattr(first, attr) is None):
+                    raise ValueError(f"concat of batches with inconsistent {attr} presence")
+        cols = {n: np.concatenate([np.asarray(b.columns[n]) for b in batches]) for n in first.columns}
+        ts = (np.concatenate([np.asarray(b.timestamps) for b in batches])
+              if first.timestamps is not None else None)
+        kid = (np.concatenate([np.asarray(b.key_ids) for b in batches])
+               if first.key_ids is not None else None)
+        kg = (np.concatenate([np.asarray(b.key_groups) for b in batches])
+              if first.key_groups is not None else None)
+        return RecordBatch(cols, ts, kid, kg)
+
+    @staticmethod
+    def from_rows(rows: List[Mapping[str, Any]], timestamps: Optional[List[int]] = None) -> "RecordBatch":
+        """Test/connector convenience: list of dict rows -> columnar batch."""
+        if not rows:
+            return RecordBatch({})
+        names = rows[0].keys()
+        cols = {n: np.asarray([r[n] for r in rows]) for n in names}
+        ts = np.asarray(timestamps, np.int64) if timestamps is not None else None
+        return RecordBatch(cols, ts)
+
+    def to_rows(self) -> List[Dict[str, Any]]:
+        out = []
+        for i in range(self._size):
+            row = {k: np.asarray(v)[i].item() if np.asarray(v)[i].ndim == 0 else np.asarray(v)[i]
+                   for k, v in self.columns.items()}
+            out.append(row)
+        return out
+
+    def __repr__(self) -> str:
+        cols = {k: f"{np.asarray(v).dtype}{list(np.shape(v))}" for k, v in self.columns.items()}
+        return f"RecordBatch(n={self._size}, cols={cols}, keyed={self.key_ids is not None})"

@@ -1,0 +1,309 @@
+"""User function contracts, batch-vectorized for TPU execution.
+
+Analog of ``flink-core/src/main/java/org/apache/flink/api/common/functions/``
+(``AggregateFunction.java:114`` — createAccumulator/add/getResult/merge,
+``ReduceFunction``, ``MapFunction``, …) re-designed for a batched device
+runtime: instead of a per-record ``add(acc, value)`` call, an aggregate is
+expressed as a **commutative monoid over accumulator pytrees**:
+
+    lift(values)            [B, ...] record columns -> [B, ...] accumulators
+    combine(a, b)           associative+commutative elementwise merge
+    identity()              the neutral accumulator
+    get_result(acc)         accumulator -> output value
+
+so the runtime can fold a whole micro-batch with one fused
+``segment-combine`` on device, merge panes at fire time with ``combine``, and
+merge session windows with the same ``combine`` (the reference requires
+``merge`` for session windows for exactly this reason).  Every built-in
+reference aggregation (sum/count/min/max/avg — see
+``flink-streaming-java/.../api/functions/aggregation/SumAggregator.java``,
+``ComparableAggregator.java``) factors this way.
+
+All lift/combine/get_result bodies must be jax-traceable (they run inside the
+jitted micro-batch step); MapFunction/FilterFunction et al. come in two
+flavors: jax-traceable (chained into the device step, the analog of operator
+chaining ``OperatorChain.java:88``) or host-side numpy (the analog of a
+non-chainable boundary).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Function:
+    """Marker base for all user functions (``Function.java``)."""
+
+
+class RuntimeContext:
+    """Runtime info handed to rich functions (``RuntimeContext.java`` analog)."""
+
+    def __init__(self, task_name: str = "task", subtask_index: int = 0,
+                 parallelism: int = 1, max_parallelism: int = 128,
+                 metrics=None, external_resources: Optional[Dict[str, Any]] = None):
+        self.task_name = task_name
+        self.subtask_index = subtask_index
+        self.parallelism = parallelism
+        self.max_parallelism = max_parallelism
+        self.metrics = metrics
+        self._external_resources = external_resources or {}
+
+    def get_external_resource_infos(self, name: str):
+        """``RuntimeContext.getExternalResourceInfos`` analog (TPU driver plugs in here)."""
+        return self._external_resources.get(name, [])
+
+
+class RichFunction(Function):
+    """open/close lifecycle (``RichFunction.java``)."""
+
+    def open(self, ctx: RuntimeContext) -> None:  # noqa: D401
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Aggregates
+# ---------------------------------------------------------------------------
+
+class AggregateFunction(RichFunction, abc.ABC):
+    """Batch-vectorized aggregate (reference contract: AggregateFunction.java:114).
+
+    Correspondence to the reference contract:
+      createAccumulator() -> identity()
+      add(value, acc)     -> combine(acc, lift(value))   (computed batched)
+      merge(a, b)         -> combine(a, b)
+      getResult(acc)      -> get_result(acc)
+    """
+
+    @abc.abstractmethod
+    def identity(self):
+        """Neutral accumulator: a pytree of scalars / small arrays (jax-typed)."""
+
+    @abc.abstractmethod
+    def lift(self, values):
+        """Vectorized: record value columns ``[B, ...]`` -> accumulator pytree with
+        a leading batch dim on every leaf."""
+
+    @abc.abstractmethod
+    def combine(self, a, b):
+        """Associative, commutative merge of two accumulator pytrees (elementwise,
+        any leading batch dims broadcast)."""
+
+    def get_result(self, acc):
+        """Accumulator pytree -> output value (default: the accumulator itself)."""
+        return acc
+
+    # -- introspection used by the state backend ----------------------------
+    def acc_spec(self) -> "AccSpec":
+        ident = self.identity()
+        leaves, treedef = jax.tree_util.tree_flatten(ident)
+        return AccSpec(treedef=treedef,
+                       leaf_shapes=tuple(np.shape(l) for l in leaves),
+                       leaf_dtypes=tuple(jnp.asarray(l).dtype for l in leaves),
+                       leaf_inits=tuple(np.asarray(l) for l in leaves))
+
+
+@dataclass(frozen=True)
+class AccSpec:
+    """Static description of an accumulator pytree (shapes/dtypes/identity)."""
+
+    treedef: Any
+    leaf_shapes: Tuple[Tuple[int, ...], ...]
+    leaf_dtypes: Tuple[Any, ...]
+    leaf_inits: Tuple[np.ndarray, ...]
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.leaf_shapes)
+
+    def unflatten(self, leaves):
+        return jax.tree_util.tree_unflatten(self.treedef, list(leaves))
+
+
+class ReduceFunction(AggregateFunction):
+    """Associative reduce over values (``ReduceFunction.java``): ACC == value type.
+
+    Subclasses implement ``reduce(a, b)`` (vectorized, elementwise) and
+    ``identity()``.
+    """
+
+    def lift(self, values):
+        return values
+
+    def combine(self, a, b):
+        return self.reduce(a, b)
+
+    @abc.abstractmethod
+    def reduce(self, a, b):
+        ...
+
+
+class LambdaReduce(ReduceFunction):
+    def __init__(self, fn: Callable, identity_value):
+        self._fn = fn
+        self._identity = identity_value
+
+    def identity(self):
+        return self._identity
+
+    def reduce(self, a, b):
+        return self._fn(a, b)
+
+
+class SumAggregator(ReduceFunction):
+    """``.sum()`` (SumAggregator.java analog): elementwise sum, identity 0."""
+
+    def __init__(self, dtype=jnp.float32):
+        self._dtype = jnp.dtype(dtype)
+
+    def identity(self):
+        return jnp.zeros((), self._dtype)
+
+    def reduce(self, a, b):
+        return a + b
+
+
+class MinAggregator(ReduceFunction):
+    def __init__(self, dtype=jnp.float32):
+        self._dtype = jnp.dtype(dtype)
+
+    def identity(self):
+        if jnp.issubdtype(self._dtype, jnp.integer):
+            return jnp.array(jnp.iinfo(self._dtype).max, self._dtype)
+        return jnp.array(jnp.inf, self._dtype)
+
+    def reduce(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class MaxAggregator(ReduceFunction):
+    def __init__(self, dtype=jnp.float32):
+        self._dtype = jnp.dtype(dtype)
+
+    def identity(self):
+        if jnp.issubdtype(self._dtype, jnp.integer):
+            return jnp.array(jnp.iinfo(self._dtype).min, self._dtype)
+        return jnp.array(-jnp.inf, self._dtype)
+
+    def reduce(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class CountAggregator(AggregateFunction):
+    def identity(self):
+        return jnp.zeros((), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+    def lift(self, values):
+        leaf = jax.tree_util.tree_leaves(values)[0]
+        return jnp.ones(jnp.shape(leaf)[:1], self.identity().dtype)
+
+    def combine(self, a, b):
+        return a + b
+
+
+class AvgAggregator(AggregateFunction):
+    """Average: ACC = (sum, count) — the canonical non-trivial ACC from the
+    reference javadoc example (AggregateFunction.java:60-100)."""
+
+    def __init__(self, dtype=jnp.float32):
+        self._dtype = jnp.dtype(dtype)
+
+    def identity(self):
+        return {"sum": jnp.zeros((), self._dtype), "count": jnp.zeros((), jnp.int32)}
+
+    def lift(self, values):
+        v = jnp.asarray(values, self._dtype)
+        return {"sum": v, "count": jnp.ones(v.shape[:1], jnp.int32)}
+
+    def combine(self, a, b):
+        return {"sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+
+    def get_result(self, acc):
+        cnt = jnp.maximum(acc["count"], 1)
+        return acc["sum"] / cnt.astype(self._dtype)
+
+
+class TupleAggregator(AggregateFunction):
+    """Combine several aggregates over named value columns into one ACC dict —
+    the 'multi-field AggregateFunction' of baseline config #3."""
+
+    def __init__(self, aggs: Dict[str, Tuple[str, AggregateFunction]]):
+        """aggs: out_name -> (value_column, AggregateFunction)."""
+        self._aggs = aggs
+
+    def identity(self):
+        return {name: agg.identity() for name, (_, agg) in self._aggs.items()}
+
+    def lift(self, values):
+        return {name: agg.lift(values[col]) for name, (col, agg) in self._aggs.items()}
+
+    def combine(self, a, b):
+        return {name: agg.combine(a[name], b[name]) for name, (_, agg) in self._aggs.items()}
+
+    def get_result(self, acc):
+        return {name: agg.get_result(acc[name]) for name, (_, agg) in self._aggs.items()}
+
+
+# ---------------------------------------------------------------------------
+# Elementwise / host functions
+# ---------------------------------------------------------------------------
+
+class MapFunction(Function):
+    """Vectorized map over batch columns (``MapFunction.java``). ``map`` receives
+    the batch's column dict and returns a new column dict."""
+
+    def map(self, columns: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    #: if True the body is jax-traceable and is chained into the device step
+    jax_traceable: bool = False
+
+
+class FilterFunction(Function):
+    """Vectorized predicate: returns a boolean mask ``[B]``."""
+
+    def filter(self, columns: Dict[str, Any]):
+        raise NotImplementedError
+
+    jax_traceable: bool = False
+
+
+class FlatMapFunction(Function):
+    """Host-side flatmap: columns -> (columns, repeats[B]) or arbitrary re-batch."""
+
+    def flat_map(self, columns: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class ProcessFunction(RichFunction):
+    """Low-level host-side per-batch processing with timer access (analog of
+    ``ProcessFunction``/``KeyedProcessFunction``). Batched: receives the column
+    dict, timestamps, and a ``TimerService``-like context."""
+
+    def process_batch(self, columns: Dict[str, Any], timestamps, ctx) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_timer(self, timestamp: int, ctx) -> Optional[Dict[str, Any]]:
+        return None
+
+
+def as_map(fn: Callable, jax_traceable: bool = False) -> MapFunction:
+    m = MapFunction()
+    m.map = fn  # type: ignore[method-assign]
+    m.jax_traceable = jax_traceable
+    return m
+
+
+def as_filter(fn: Callable, jax_traceable: bool = False) -> FilterFunction:
+    f = FilterFunction()
+    f.filter = fn  # type: ignore[method-assign]
+    f.jax_traceable = jax_traceable
+    return f

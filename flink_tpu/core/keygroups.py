@@ -1,0 +1,119 @@
+"""Key groups: the state-sharding / rescaling unit, and the TPU sharding axis.
+
+Mirrors the contract of the reference's key-group assignment
+(``flink-runtime/src/main/java/org/apache/flink/runtime/state/KeyGroupRangeAssignment.java:50-84``
+and ``flink-core/src/main/java/org/apache/flink/util/MathUtils.java:137`` murmur
+finalizer): ``key_group = murmur(key_hash) % max_parallelism`` and contiguous
+key-group *ranges* per parallel subtask, so state laid out by key group can be
+rescaled/resharded without rehashing keys.
+
+Everything here is vectorized numpy over ``int32`` key hashes — the host-side
+router uses it to split record batches across device shards (the analog of
+``KeyGroupStreamPartitioner``), and snapshots index state by key-group range.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List
+
+import numpy as np
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(5)
+_N = np.uint32(0xE6546B64)
+
+
+def _rotl32(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def murmur_hash(code: np.ndarray | int) -> np.ndarray:
+    """Vectorized equivalent of ``MathUtils.murmurHash(int)`` (MathUtils.java:137).
+
+    Accepts int32-like input, returns non-negative int32 values with identical
+    results to the reference for every input (including the
+    ``Integer.MIN_VALUE -> 0`` edge case).
+    """
+    code = np.asarray(code, dtype=np.int64).astype(np.uint32)
+    with np.errstate(over="ignore"):
+        code = code * _C1
+        code = _rotl32(code, 15)
+        code = code * _C2
+        code = _rotl32(code, 13)
+        code = code * _M5 + _N
+        code = code ^ np.uint32(4)
+        # bitMix (MathUtils.java:194)
+        code ^= code >> np.uint32(16)
+        code = code * np.uint32(0x85EBCA6B)
+        code ^= code >> np.uint32(13)
+        code = code * np.uint32(0xC2B2AE35)
+        code ^= code >> np.uint32(16)
+    signed = code.astype(np.int32)
+    out = np.where(signed >= 0, signed, np.where(signed == np.int32(-2147483648), 0, -signed))
+    return out.astype(np.int32)
+
+
+def java_int_hash(values: np.ndarray) -> np.ndarray:
+    """``Integer.hashCode`` / ``Long.hashCode`` analog for numpy int arrays."""
+    v = np.asarray(values)
+    if v.dtype in (np.int64, np.uint64):
+        u = v.astype(np.uint64)
+        return (u ^ (u >> np.uint64(32))).astype(np.uint32).astype(np.int32)
+    return v.astype(np.int32)
+
+
+def assign_to_key_group(key_hashes: np.ndarray, max_parallelism: int) -> np.ndarray:
+    """``KeyGroupRangeAssignment.computeKeyGroupForKeyHash:75``: murmur % maxParallelism."""
+    return murmur_hash(key_hashes) % np.int32(max_parallelism)
+
+
+@dataclass(frozen=True)
+class KeyGroupRange:
+    """Inclusive [start, end] range of key groups (``KeyGroupRange.java``)."""
+
+    start: int
+    end: int
+
+    def __post_init__(self):
+        if self.end < self.start:
+            object.__setattr__(self, "start", 0)
+            object.__setattr__(self, "end", -1)
+
+    @property
+    def num_key_groups(self) -> int:
+        return self.end - self.start + 1
+
+    def contains(self, key_group: int) -> bool:
+        return self.start <= key_group <= self.end
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(range(self.start, self.end + 1))
+
+    def intersection(self, other: "KeyGroupRange") -> "KeyGroupRange":
+        return KeyGroupRange(max(self.start, other.start), min(self.end, other.end))
+
+
+def compute_key_group_range(max_parallelism: int, parallelism: int, operator_index: int) -> KeyGroupRange:
+    """``KeyGroupRangeAssignment.computeKeyGroupRangeForOperatorIndex``."""
+    if parallelism > max_parallelism:
+        raise ValueError(f"parallelism {parallelism} > max_parallelism {max_parallelism}")
+    start = (operator_index * max_parallelism + parallelism - 1) // parallelism
+    end = ((operator_index + 1) * max_parallelism - 1) // parallelism
+    return KeyGroupRange(start, end)
+
+
+def compute_operator_index_for_key_group(max_parallelism: int, parallelism: int, key_group: int) -> int:
+    """``KeyGroupRangeAssignment.computeOperatorIndexForKeyGroup``."""
+    return key_group * parallelism // max_parallelism
+
+
+def assign_key_to_parallel_operator(key_hashes: np.ndarray, max_parallelism: int, parallelism: int) -> np.ndarray:
+    """Vectorized ``assignKeyToParallelOperator:50`` — subtask index per key."""
+    kg = assign_to_key_group(key_hashes, max_parallelism)
+    return (kg.astype(np.int64) * parallelism // max_parallelism).astype(np.int32)
+
+
+def key_group_ranges(max_parallelism: int, parallelism: int) -> List[KeyGroupRange]:
+    return [compute_key_group_range(max_parallelism, parallelism, i) for i in range(parallelism)]

@@ -1,0 +1,247 @@
+"""Key -> dense-slot index: sparse record keys to dense HBM state rows.
+
+The reference stores keyed state in hash maps probed per record
+(``CopyOnWriteStateMap.java``); device state here is a dense ``[K, ...]``
+array, so the host must map each record's key to a stable dense row id.  This
+is the batched analog of that hash probe: a **vectorized open-addressing
+table** (numpy, no per-record Python) for int64 keys, and a
+factorize+dictionary variant for object (string) keys.  Slot ids are stable
+for the life of the operator (until snapshot/rescale), are dense (0..n-1,
+growing), and double as row indices into the device accumulator arrays.
+
+A C++ drop-in (``native/keydict.cpp``) can replace the numpy implementation;
+the interface is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — avalanching hash for table probing."""
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+class KeyIndex:
+    """Vectorized int64-key -> dense int32 slot table (open addressing)."""
+
+    def __init__(self, initial_capacity: int = 1 << 16, max_load: float = 0.5):
+        cap = 1
+        while cap < initial_capacity:
+            cap <<= 1
+        self._cap = cap
+        self._mask = np.uint64(cap - 1)
+        self._keys = np.zeros(cap, np.int64)
+        self._used = np.zeros(cap, bool)
+        self._slots = np.zeros(cap, np.int32)
+        self._n = 0
+        self._max_load = max_load
+        self._reverse = np.zeros(initial_capacity, np.int64)  # slot -> raw key
+
+    # -- public -------------------------------------------------------------
+    @property
+    def num_keys(self) -> int:
+        return self._n
+
+    def reverse_keys(self) -> np.ndarray:
+        """slot id -> raw key, length num_keys."""
+        return self._reverse[: self._n]
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Batch lookup; returns int32 slot ids, -1 for absent keys."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        out = np.full(keys.shape, -1, np.int32)
+        if keys.size == 0 or self._n == 0:
+            return out
+        idx = (_mix64(keys.view(np.uint64)) & self._mask).astype(np.int64)
+        pending = np.arange(keys.size, dtype=np.int64)
+        pidx = idx
+        while pending.size:
+            occupied = self._used[pidx]
+            hit = occupied & (self._keys[pidx] == keys[pending])
+            out[pending[hit]] = self._slots[pidx[hit]]
+            cont = occupied & ~hit  # occupied by another key: keep probing
+            pending = pending[cont]
+            pidx = (pidx[cont] + 1) & np.int64(self._mask)
+        return out
+
+    def lookup_or_insert(self, keys: np.ndarray) -> np.ndarray:
+        """Batch lookup, inserting unseen keys with fresh sequential slot ids."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        if keys.size == 0:
+            return np.zeros(0, np.int32)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        uids = self._lookup_or_insert_unique(uniq)
+        return uids[inv]
+
+    # -- internals ----------------------------------------------------------
+    def _lookup_or_insert_unique(self, uniq: np.ndarray) -> np.ndarray:
+        if self._n + uniq.size > int(self._cap * self._max_load):
+            # Only truly-new keys consume slots; a steady-state batch of
+            # mostly-existing keys must not trigger doubling, so probe first.
+            n_new = int(np.count_nonzero(self.lookup(uniq) < 0))
+            if self._n + n_new > int(self._cap * self._max_load):
+                self._grow(max(self._cap * 2, int((self._n + n_new) / self._max_load) + 1))
+        out = np.full(uniq.shape, -1, np.int32)
+        idx = (_mix64(uniq.view(np.uint64)) & self._mask).astype(np.int64)
+        pending = np.arange(uniq.size, dtype=np.int64)
+        pidx = idx
+        while pending.size:
+            occupied = self._used[pidx]
+            hit = occupied & (self._keys[pidx] == uniq[pending])
+            out[pending[hit]] = self._slots[pidx[hit]]
+            # empties: race between distinct keys targeting the same bucket —
+            # np.unique picks one winner per bucket, losers re-probe.
+            empty = ~occupied
+            e_pend = pending[empty]
+            e_idx = pidx[empty]
+            if e_pend.size:
+                win_idx, first = np.unique(e_idx, return_index=True)
+                w_pend = e_pend[first]
+                new_slots = self._n + np.arange(w_pend.size, dtype=np.int32)
+                self._used[win_idx] = True
+                self._keys[win_idx] = uniq[w_pend]
+                self._slots[win_idx] = new_slots
+                self._ensure_reverse(self._n + w_pend.size)
+                self._reverse[self._n: self._n + w_pend.size] = uniq[w_pend]
+                self._n += int(w_pend.size)
+                out[w_pend] = new_slots
+            unresolved = out[pending] < 0
+            pending = pending[unresolved]
+            pidx = (pidx[unresolved] + 1) & np.int64(self._mask)
+        return out
+
+    def _ensure_reverse(self, n: int) -> None:
+        if n > self._reverse.size:
+            new = np.zeros(max(n, self._reverse.size * 2), np.int64)
+            new[: self._n] = self._reverse[: self._n]
+            self._reverse = new
+
+    def _grow(self, min_cap: int) -> None:
+        cap = self._cap
+        while cap < min_cap:
+            cap <<= 1
+        old_rev = self._reverse[: self._n].copy()
+        self._cap = cap
+        self._mask = np.uint64(cap - 1)
+        self._keys = np.zeros(cap, np.int64)
+        self._used = np.zeros(cap, bool)
+        self._slots = np.zeros(cap, np.int32)
+        self._place_with_ids(old_rev)
+
+    def _place_with_ids(self, keys_in_slot_order: np.ndarray) -> None:
+        """Insert unique keys whose slot id == their position (vectorized);
+        used by rehash-on-grow and snapshot restore."""
+        n = keys_in_slot_order.size
+        if not n:
+            return
+        idx = (_mix64(keys_in_slot_order.view(np.uint64)) & self._mask).astype(np.int64)
+        pending = np.arange(n, dtype=np.int64)
+        pidx = idx
+        while pending.size:
+            empty = ~self._used[pidx]
+            e_pend = pending[empty]
+            e_idx = pidx[empty]
+            placed = np.zeros(pending.size, bool)
+            if e_pend.size:
+                win_idx, first = np.unique(e_idx, return_index=True)
+                w_pend = e_pend[first]
+                self._used[win_idx] = True
+                self._keys[win_idx] = keys_in_slot_order[w_pend]
+                self._slots[win_idx] = w_pend.astype(np.int32)
+                placed_mask = np.zeros(n, bool)
+                placed_mask[w_pend] = True
+                placed = placed_mask[pending]
+            pending = pending[~placed]
+            pidx = (pidx[~placed] + 1) & np.int64(self._mask)
+
+    # -- snapshot -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        return {"reverse": self.reverse_keys().copy()}
+
+    @classmethod
+    def restore(cls, snap: Dict[str, np.ndarray], max_load: float = 0.5) -> "KeyIndex":
+        rev = np.asarray(snap["reverse"], np.int64)
+        ki = cls(initial_capacity=max(1 << 16, int(rev.size / max_load) + 1), max_load=max_load)
+        ki._place_with_ids(rev)
+        ki._ensure_reverse(rev.size)
+        ki._reverse[: rev.size] = rev
+        ki._n = int(rev.size)
+        return ki
+
+
+class ObjectKeyIndex:
+    """Object (e.g. string) key -> dense slot index.
+
+    Batched via pandas ``factorize`` (C speed) so the Python dict is only
+    touched once per *distinct new* key, amortized O(1) per record.
+    """
+
+    def __init__(self):
+        self._dict: Dict[object, int] = {}
+        self._reverse: List[object] = []
+
+    @property
+    def num_keys(self) -> int:
+        return len(self._reverse)
+
+    def reverse_keys(self) -> np.ndarray:
+        return np.asarray(self._reverse, dtype=object)
+
+    def lookup_or_insert(self, keys: np.ndarray) -> np.ndarray:
+        import pandas as pd
+
+        codes, uniques = pd.factorize(np.asarray(keys, dtype=object))
+        if (codes < 0).any():
+            # pd.factorize emits -1 for None/NaN; keys must be non-null
+            # (same contract as KeyGroupRangeAssignment.java:51 checkNotNull)
+            raise ValueError("null/NaN keys are not allowed in keyed streams")
+        uniq_ids = np.empty(len(uniques), np.int32)
+        d = self._dict
+        for i, k in enumerate(uniques):
+            sid = d.get(k)
+            if sid is None:
+                sid = len(self._reverse)
+                d[k] = sid
+                self._reverse.append(k)
+            uniq_ids[i] = sid
+        return uniq_ids[codes]
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        import pandas as pd
+
+        codes, uniques = pd.factorize(np.asarray(keys, dtype=object))
+        if len(uniques) == 0:
+            return np.full(len(codes), -1, np.int32)
+        uniq_ids = np.array([self._dict.get(k, -1) for k in uniques], np.int32)
+        out = np.where(codes >= 0, uniq_ids[np.clip(codes, 0, None)], np.int32(-1))
+        return out.astype(np.int32)
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        return {"reverse": self.reverse_keys()}
+
+    @classmethod
+    def restore(cls, snap) -> "ObjectKeyIndex":
+        ki = cls()
+        for k in snap["reverse"]:
+            ki._dict[k] = len(ki._reverse)
+            ki._reverse.append(k)
+        return ki
+
+
+def make_key_index(sample_key) -> "KeyIndex | ObjectKeyIndex":
+    """Pick an index implementation from a sample key's dtype."""
+    arr = np.asarray(sample_key)
+    if arr.dtype.kind in "iu":
+        return KeyIndex()
+    return ObjectKeyIndex()

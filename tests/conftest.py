@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective paths are
+validated on a virtual 8-device CPU platform (the reference's analog is
+MiniCluster: multi-node semantics in one process, ``MiniCluster.java``).
+Must run before jax initializes its backends, hence top of conftest.
+"""
+
+import os
+
+# Force, don't setdefault: the driver environment pre-sets JAX_PLATFORMS to the
+# real TPU platform, and unit tests must never contend for the one real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+# The TPU-tunnel site hook (sitecustomize → axon.register) runs at interpreter
+# startup and overrides platform selection via jax.config.update("jax_platforms",
+# "axon,cpu") — the env var alone is not enough.  Re-force the config to CPU
+# before any backend initializes, otherwise the first jax.devices() call in a
+# test dials the (single, possibly busy) real chip and blocks indefinitely.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,131 @@
+"""RecordBatch / stream-element tests.
+
+The columnar batch is the TPU-native unit of flow (reference moves one
+``StreamElement`` at a time, ``flink-streaming-java/.../streamrecord/``).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import (
+    MAX_WATERMARK,
+    CheckpointBarrier,
+    RecordBatch,
+    Watermark,
+)
+
+
+def _batch(n=4, keyed=False):
+    b = RecordBatch(
+        {"v": np.arange(n, dtype=np.float32)},
+        timestamps=np.arange(n, dtype=np.int64) * 10,
+    )
+    if keyed:
+        b = b.with_keys(np.arange(n, dtype=np.int32) % 2,
+                        np.arange(n, dtype=np.int32) % 8)
+    return b
+
+
+def test_basic_shape_and_len():
+    b = _batch(5)
+    assert len(b) == 5 and b.size == 5
+    assert b.column("v").dtype == np.float32
+
+
+def test_empty_batch():
+    b = RecordBatch({})
+    assert len(b) == 0
+
+
+def test_misaligned_timestamps_rejected():
+    with pytest.raises(ValueError):
+        RecordBatch({"v": np.zeros(3)}, timestamps=np.zeros(2, np.int64))
+
+
+def test_misaligned_columns_rejected():
+    with pytest.raises(ValueError):
+        RecordBatch({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_with_columns_size_change_rejected():
+    # A size-changing map must not silently pair new rows with stale keys.
+    b = _batch(4, keyed=True)
+    with pytest.raises(ValueError):
+        b.with_columns({"v": np.zeros(2, np.float32)})
+
+
+def test_select_preserves_keyedness():
+    b = _batch(4, keyed=True)
+    out = b.select(np.array([True, False, True, False]))
+    assert len(out) == 2
+    assert out.key_ids is not None and out.key_groups is not None
+    assert out.timestamps.tolist() == [0, 20]
+
+
+def test_select_all_false_keeps_schema():
+    b = _batch(4, keyed=True)
+    out = b.select(np.zeros(4, bool))
+    assert len(out) == 0
+    assert set(out.columns) == {"v"}
+    assert out.timestamps is not None and out.key_ids is not None
+
+
+def test_take_reorders():
+    b = _batch(4)
+    out = b.take(np.array([3, 0]))
+    assert out.column("v").tolist() == [3.0, 0.0]
+    assert out.timestamps.tolist() == [30, 0]
+
+
+def test_concat():
+    b = RecordBatch.concat([_batch(2), _batch(3)])
+    assert len(b) == 5
+    assert b.timestamps.tolist() == [0, 10, 0, 10, 20]
+
+
+def test_concat_skips_empty():
+    b = RecordBatch.concat([_batch(2), _batch(0), _batch(3)])
+    assert len(b) == 5
+
+
+def test_concat_all_empty_preserves_schema():
+    # An all-empty flush must keep schema/keyed-ness: downstream presence
+    # checks (timestamps is not None) branch on it.
+    e = _batch(0, keyed=True)
+    out = RecordBatch.concat([e, e])
+    assert len(out) == 0
+    assert set(out.columns) == {"v"}
+    assert out.timestamps is not None and out.key_ids is not None
+
+
+def test_concat_of_nothing():
+    assert len(RecordBatch.concat([])) == 0
+
+
+def test_concat_heterogeneous_rejected():
+    a = RecordBatch({"x": np.zeros(2)})
+    b = RecordBatch({"y": np.zeros(2)})
+    with pytest.raises(ValueError):
+        RecordBatch.concat([a, b])
+
+
+def test_concat_inconsistent_timestamps_rejected():
+    a = RecordBatch({"x": np.zeros(2)}, timestamps=np.zeros(2, np.int64))
+    b = RecordBatch({"x": np.zeros(2)})
+    with pytest.raises(ValueError):
+        RecordBatch.concat([a, b])
+
+
+def test_from_rows_round_trip():
+    rows = [{"w": 1, "c": 2}, {"w": 3, "c": 4}]
+    b = RecordBatch.from_rows(rows, timestamps=[5, 6])
+    assert b.to_rows() == rows
+    assert b.timestamps.tolist() == [5, 6]
+
+
+def test_control_elements():
+    assert Watermark(MAX_WATERMARK).timestamp == MAX_WATERMARK
+    cb = CheckpointBarrier(7, 123)
+    assert cb.checkpoint_id == 7 and cb.timestamp == 123
+    assert not cb.is_batch()
+    assert _batch(1).is_batch()

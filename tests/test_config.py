@@ -1,0 +1,71 @@
+import pytest
+
+from flink_tpu.config.config_option import (Configuration, key,
+                                            parse_duration_ms,
+                                            parse_memory_bytes)
+from flink_tpu.config.options import (CheckpointingOptions, CoreOptions,
+                                      ExecutionOptions, StateOptions)
+
+
+def test_typed_option_defaults():
+    conf = Configuration()
+    assert conf.get(CoreOptions.MAX_PARALLELISM) == 128
+    assert conf.get(ExecutionOptions.MICRO_BATCH_SIZE) == 65536
+    assert conf.get(CheckpointingOptions.MODE) == "EXACTLY_ONCE"
+
+
+def test_set_get_parsing():
+    conf = Configuration()
+    conf.set(CoreOptions.MAX_PARALLELISM, "256")
+    assert conf.get(CoreOptions.MAX_PARALLELISM) == 256
+    conf.set(StateOptions.INCREMENTAL, "true")
+    assert conf.get(StateOptions.INCREMENTAL) is True
+    conf.set(CheckpointingOptions.INTERVAL, "5 s")
+    assert conf.get(CheckpointingOptions.INTERVAL) == 5000
+
+
+def test_duration_and_memory_parsers():
+    assert parse_duration_ms("500 ms") == 500
+    assert parse_duration_ms("2 min") == 120_000
+    assert parse_duration_ms(250) == 250
+    assert parse_duration_ms("1.5 s") == 1500
+    assert parse_memory_bytes("32 kb") == 32 * 1024
+    assert parse_memory_bytes("1g") == 1 << 30
+    assert parse_memory_bytes(4096) == 4096
+
+
+def test_fallback_and_deprecated_keys():
+    opt = key("new.key").int_type().default_value(7).with_deprecated_keys("old.key")
+    conf = Configuration({"old.key": "42"})
+    assert conf.get(opt) == 42
+    conf.set(opt, 13)
+    assert conf.get(opt) == 13
+
+
+def test_yaml_loading(tmp_path):
+    p = tmp_path / "flink-conf.yaml"
+    p.write_text("# comment\npipeline.max-parallelism: 64\nstate.backend: hbm\n")
+    conf = Configuration.from_yaml_file(str(p))
+    assert conf.get(CoreOptions.MAX_PARALLELISM) == 64
+    assert conf.get(StateOptions.BACKEND) == "hbm"
+
+
+def test_clone_independent():
+    a = Configuration({"x": 1})
+    b = a.clone()
+    b.set("x", 2)
+    assert a.get("x") == 1
+
+
+def test_remove_clears_all_keys():
+    opt = key("new.key").int_type().default_value(7).with_deprecated_keys("old.key")
+    conf = Configuration({"old.key": "42", "new.key": "43"})
+    conf.remove(opt)
+    assert conf.get(opt) == 7
+    assert not conf.contains(opt)
+
+
+def test_from_env_dash_keys(monkeypatch):
+    monkeypatch.setenv("FLINK_TPU_PIPELINE_MAX__PARALLELISM", "256")
+    conf = Configuration.from_env()
+    assert conf.get(CoreOptions.MAX_PARALLELISM) == 256

@@ -1,0 +1,80 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.functions import (AvgAggregator, CountAggregator,
+                                      LambdaReduce, MaxAggregator,
+                                      MinAggregator, SumAggregator,
+                                      TupleAggregator)
+
+
+def _fold(agg, values):
+    """Sequentially fold values through lift/combine — the reference's
+    add-per-record contract expressed via the monoid."""
+    acc = agg.identity()
+    lifted = agg.lift(values)
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(lifted)
+    n = leaves[0].shape[0]
+    for i in range(n):
+        one = jax.tree_util.tree_unflatten(treedef, [l[i] for l in leaves])
+        acc = agg.combine(acc, one)
+    return agg.get_result(acc)
+
+
+def test_sum_aggregator():
+    v = jnp.array([1.0, 2.5, 3.5])
+    assert float(_fold(SumAggregator(), v)) == 7.0
+
+
+def test_min_max():
+    v = jnp.array([5, -2, 9], dtype=jnp.int32)
+    assert int(_fold(MinAggregator(jnp.int32), v)) == -2
+    assert int(_fold(MaxAggregator(jnp.int32), v)) == 9
+
+
+def test_count():
+    v = jnp.array([10.0, 20.0, 30.0])
+    assert int(_fold(CountAggregator(), v)) == 3
+
+
+def test_avg():
+    v = jnp.array([2.0, 4.0, 9.0])
+    assert float(_fold(AvgAggregator(), v)) == 5.0
+
+
+def test_avg_acc_spec():
+    spec = AvgAggregator().acc_spec()
+    assert spec.num_leaves == 2
+    rebuilt = spec.unflatten(spec.leaf_inits)
+    assert set(rebuilt.keys()) == {"sum", "count"}
+
+
+def test_tuple_aggregator_multifield():
+    agg = TupleAggregator({
+        "total": ("price", SumAggregator()),
+        "n": ("price", CountAggregator()),
+        "biggest": ("qty", MaxAggregator()),
+    })
+    cols = {"price": jnp.array([1.0, 2.0, 3.0]), "qty": jnp.array([7.0, 1.0, 5.0])}
+    out = _fold(agg, cols)
+    assert float(out["total"]) == 6.0
+    assert int(out["n"]) == 3
+    assert float(out["biggest"]) == 7.0
+
+
+def test_lambda_reduce():
+    r = LambdaReduce(lambda a, b: a * b, jnp.ones(()))
+    v = jnp.array([2.0, 3.0, 4.0])
+    assert float(_fold(r, v)) == 24.0
+
+
+def test_combine_associative_commutative():
+    agg = AvgAggregator()
+    a = {"sum": jnp.array(3.0), "count": jnp.array(2, jnp.int32)}
+    b = {"sum": jnp.array(5.0), "count": jnp.array(1, jnp.int32)}
+    ab = agg.combine(a, b)
+    ba = agg.combine(b, a)
+    assert float(ab["sum"]) == float(ba["sum"]) == 8.0
+    assert int(ab["count"]) == int(ba["count"]) == 3

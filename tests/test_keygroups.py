@@ -1,0 +1,100 @@
+import numpy as np
+import pytest
+
+from flink_tpu.core.keygroups import (KeyGroupRange, assign_to_key_group,
+                                      assign_key_to_parallel_operator,
+                                      compute_key_group_range,
+                                      compute_operator_index_for_key_group,
+                                      java_int_hash, key_group_ranges,
+                                      murmur_hash)
+
+
+def _java_murmur(code: int) -> int:
+    """Scalar reference implementation transcribed from MathUtils.java:137."""
+    def i32(x):
+        x &= 0xFFFFFFFF
+        return x - (1 << 32) if x >= (1 << 31) else x
+
+    def rotl(x, r):
+        x &= 0xFFFFFFFF
+        return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+    code = i32(code * 0xCC9E2D51)
+    code = i32(rotl(code, 15))
+    code = i32(code * 0x1B873593)
+    code = i32(rotl(code, 13))
+    code = i32(code * 5 + 0xE6546B64)
+    code = i32(code ^ 4)
+    u = code & 0xFFFFFFFF
+    u ^= u >> 16
+    u = (u * 0x85EBCA6B) & 0xFFFFFFFF
+    u ^= u >> 13
+    u = (u * 0xC2B2AE35) & 0xFFFFFFFF
+    u ^= u >> 16
+    code = i32(u)
+    if code >= 0:
+        return code
+    if code != -(1 << 31):
+        return -code
+    return 0
+
+
+@pytest.mark.parametrize("val", [0, 1, -1, 42, 123456789, -987654321,
+                                 2**31 - 1, -(2**31), 7, 1000000])
+def test_murmur_matches_reference_scalar(val):
+    assert int(murmur_hash(val)) == _java_murmur(val)
+
+
+def test_murmur_vectorized_batch():
+    vals = np.arange(-5000, 5000, dtype=np.int32)
+    got = murmur_hash(vals)
+    assert got.dtype == np.int32
+    for v in (-5000, -1, 0, 1, 4999):
+        assert got[v + 5000] == _java_murmur(v)
+    assert (got >= 0).all()
+
+
+def test_assign_to_key_group_range():
+    keys = np.arange(100000, dtype=np.int32)
+    kg = assign_to_key_group(keys, 128)
+    assert kg.min() >= 0 and kg.max() < 128
+    # roughly uniform
+    counts = np.bincount(kg, minlength=128)
+    assert counts.min() > 400
+
+
+def test_key_group_ranges_partition_exactly():
+    max_p, par = 128, 6
+    ranges = key_group_ranges(max_p, par)
+    covered = sorted(g for r in ranges for g in r)
+    assert covered == list(range(max_p))
+    for i, r in enumerate(ranges):
+        for g in r:
+            assert compute_operator_index_for_key_group(max_p, par, g) == i
+
+
+def test_assign_key_to_parallel_operator_consistent():
+    keys = np.arange(10000, dtype=np.int64)
+    hashes = java_int_hash(keys)
+    ops = assign_key_to_parallel_operator(hashes, 128, 4)
+    kg = assign_to_key_group(hashes, 128)
+    ranges = key_group_ranges(128, 4)
+    for i, r in enumerate(ranges):
+        mask = ops == i
+        assert set(np.unique(kg[mask])).issubset(set(range(r.start, r.end + 1)))
+
+
+def test_key_group_range_intersection():
+    a = KeyGroupRange(0, 63)
+    b = KeyGroupRange(32, 100)
+    assert a.intersection(b) == KeyGroupRange(32, 63)
+    assert KeyGroupRange(0, 10).intersection(KeyGroupRange(20, 30)).num_key_groups == 0
+
+
+def test_java_long_hash():
+    v = np.array([0, 1, -1, 2**40], np.int64)
+    h = java_int_hash(v)
+    # Long.hashCode(x) = (int)(x ^ (x >>> 32))
+    assert h[0] == 0 and h[1] == 1
+    assert h[2] == 0  # -1 ^ (0xFFFFFFFF) = 0 ... (-1 >>> 32 == 0xFFFFFFFF)
+    assert h[3] == int(np.int32((2**40 ^ (2**40 >> 32)) & 0xFFFFFFFF))

@@ -1,0 +1,107 @@
+import numpy as np
+import pytest
+
+from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex, make_key_index
+
+
+def test_basic_insert_lookup():
+    ki = KeyIndex(initial_capacity=16)
+    ids = ki.lookup_or_insert(np.array([10, 20, 10, 30], np.int64))
+    assert ids[0] == ids[2]
+    assert len(set(ids.tolist())) == 3
+    assert ki.num_keys == 3
+    again = ki.lookup(np.array([10, 20, 30, 99], np.int64))
+    assert (again[:3] == ids[[0, 1, 3]]).all()
+    assert again[3] == -1
+
+
+def test_slot_ids_dense_and_stable():
+    ki = KeyIndex(initial_capacity=16)
+    a = ki.lookup_or_insert(np.arange(100, dtype=np.int64))
+    assert sorted(a.tolist()) == list(range(100))
+    b = ki.lookup_or_insert(np.arange(100, dtype=np.int64))
+    assert (a == b).all()
+
+
+def test_growth_preserves_ids(rng):
+    ki = KeyIndex(initial_capacity=16)
+    keys1 = rng.choice(10**9, size=5000, replace=False).astype(np.int64)
+    ids1 = ki.lookup_or_insert(keys1)
+    keys2 = rng.choice(10**9, size=50000, replace=False).astype(np.int64)
+    ki.lookup_or_insert(keys2)
+    assert (ki.lookup(keys1) == ids1).all()
+    assert (ki.reverse_keys()[ids1] == keys1).all()
+
+
+def test_adversarial_collisions():
+    # many keys hashing near each other + duplicates in batch
+    ki = KeyIndex(initial_capacity=8)
+    keys = np.repeat(np.arange(1000, dtype=np.int64) * 2**32, 3)
+    ids = ki.lookup_or_insert(keys)
+    assert ki.num_keys == 1000
+    assert (ids.reshape(1000, 3) == ids.reshape(1000, 3)[:, :1]).all()
+    assert (ki.reverse_keys()[ids] == keys).all()
+
+
+def test_negative_and_extreme_keys():
+    ki = KeyIndex(initial_capacity=8)
+    keys = np.array([0, -1, 2**63 - 1, -(2**63), 5], np.int64)
+    ids = ki.lookup_or_insert(keys)
+    assert len(set(ids.tolist())) == 5
+    assert (ki.lookup(keys) == ids).all()
+
+
+def test_snapshot_restore(rng):
+    ki = KeyIndex(initial_capacity=16)
+    keys = rng.choice(10**12, size=2000, replace=False).astype(np.int64)
+    ids = ki.lookup_or_insert(keys)
+    snap = ki.snapshot()
+    ki2 = KeyIndex.restore(snap)
+    assert ki2.num_keys == 2000
+    assert (ki2.lookup(keys) == ids).all()
+
+
+def test_object_key_index():
+    ki = ObjectKeyIndex()
+    words = np.array(["the", "quick", "the", "fox"], dtype=object)
+    ids = ki.lookup_or_insert(words)
+    assert ids[0] == ids[2]
+    assert ki.num_keys == 3
+    assert ki.lookup(np.array(["fox", "missing"], dtype=object))[1] == -1
+    snap = ki.snapshot()
+    ki2 = ObjectKeyIndex.restore(snap)
+    assert (ki2.lookup(words) == ids).all()
+
+
+def test_make_key_index_dispatch():
+    assert isinstance(make_key_index(np.int64(3)), KeyIndex)
+    assert isinstance(make_key_index("word"), ObjectKeyIndex)
+
+
+def test_empty_batch():
+    ki = KeyIndex()
+    assert ki.lookup_or_insert(np.array([], np.int64)).size == 0
+    assert ki.lookup(np.array([], np.int64)).size == 0
+
+
+def test_large_random_fuzz(rng):
+    ki = KeyIndex(initial_capacity=8)
+    oracle = {}
+    for _ in range(20):
+        batch = rng.integers(-10**6, 10**6, size=3000).astype(np.int64)
+        ids = ki.lookup_or_insert(batch)
+        for k, i in zip(batch.tolist(), ids.tolist()):
+            if k in oracle:
+                assert oracle[k] == i, k
+            else:
+                oracle[k] = i
+    assert ki.num_keys == len(oracle)
+
+
+def test_object_index_rejects_null_keys():
+    ki = ObjectKeyIndex()
+    with pytest.raises(ValueError):
+        ki.lookup_or_insert(np.array(["a", None, "b"], dtype=object))
+    ki.lookup_or_insert(np.array(["a"], dtype=object))
+    assert (ki.lookup(np.array([None, "a"], dtype=object)) == [-1, 0]).all()
+    assert (ki.lookup(np.array([None], dtype=object)) == [-1]).all()
