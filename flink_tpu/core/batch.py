@@ -185,12 +185,15 @@ class RecordBatch(StreamElement):
         return RecordBatch(cols, ts)
 
     def to_rows(self) -> List[Dict[str, Any]]:
-        out = []
-        for i in range(self._size):
-            row = {k: np.asarray(v)[i].item() if np.asarray(v)[i].ndim == 0 else np.asarray(v)[i]
-                   for k, v in self.columns.items()}
-            out.append(row)
-        return out
+        arrs = {k: np.asarray(v) for k, v in self.columns.items()}
+
+        def cell(a, i):
+            x = a[i]
+            if isinstance(x, np.generic):
+                return x.item()
+            return x  # object cells (strings) or sub-arrays pass through
+
+        return [{k: cell(a, i) for k, a in arrs.items()} for i in range(self._size)]
 
     def __repr__(self) -> str:
         cols = {k: f"{np.asarray(v).dtype}{list(np.shape(v))}" for k, v in self.columns.items()}

@@ -102,13 +102,43 @@ class AggregateFunction(RichFunction, abc.ABC):
         return acc
 
     # -- introspection used by the state backend ----------------------------
+    def scatter_kinds(self):
+        """Optional fast-path declaration: a pytree matching ``identity()``'s
+        structure with one of ``"add"/"min"/"max"`` per leaf, meaning
+        ``combine`` is that elementwise op on that leaf — lets the backend use
+        a single XLA scatter instead of the generic segmented-scan fold.
+        Return None (default) for arbitrary combines."""
+        return None
+
+    def scatter_kind_leaves(self) -> "Optional[Tuple[str, ...]]":
+        kinds = self.scatter_kinds()
+        if kinds is None:
+            return None
+        is_leaf = lambda x: isinstance(x, str)  # noqa: E731
+        if (jax.tree_util.tree_structure(kinds, is_leaf=is_leaf)
+                != self.acc_spec().treedef):
+            raise ValueError("scatter_kinds structure does not match identity()")
+        return tuple(jax.tree_util.tree_leaves(kinds, is_leaf=is_leaf))
+
+    def combine_leaves(self, a_leaves, b_leaves):
+        """Leaf-tuple view of ``combine`` (used by the scatter kernels)."""
+        spec = self.acc_spec()
+        out = self.combine(spec.unflatten(a_leaves), spec.unflatten(b_leaves))
+        return tuple(jax.tree_util.tree_leaves(out))
+
     def acc_spec(self) -> "AccSpec":
-        ident = self.identity()
-        leaves, treedef = jax.tree_util.tree_flatten(ident)
-        return AccSpec(treedef=treedef,
-                       leaf_shapes=tuple(np.shape(l) for l in leaves),
-                       leaf_dtypes=tuple(jnp.asarray(l).dtype for l in leaves),
-                       leaf_inits=tuple(np.asarray(l) for l in leaves))
+        # cached: identity() creates arrays, which must happen eagerly (calling
+        # it inside a jit trace would stage the constants as tracers)
+        cached = getattr(self, "_acc_spec_cache", None)
+        if cached is None:
+            ident = self.identity()
+            leaves, treedef = jax.tree_util.tree_flatten(ident)
+            cached = AccSpec(treedef=treedef,
+                             leaf_shapes=tuple(np.shape(l) for l in leaves),
+                             leaf_dtypes=tuple(jnp.asarray(l).dtype for l in leaves),
+                             leaf_inits=tuple(np.asarray(l) for l in leaves))
+            self._acc_spec_cache = cached
+        return cached
 
 
 @dataclass(frozen=True)
@@ -170,6 +200,9 @@ class SumAggregator(ReduceFunction):
     def reduce(self, a, b):
         return a + b
 
+    def scatter_kinds(self):
+        return "add"
+
 
 class MinAggregator(ReduceFunction):
     def __init__(self, dtype=jnp.float32):
@@ -182,6 +215,9 @@ class MinAggregator(ReduceFunction):
 
     def reduce(self, a, b):
         return jnp.minimum(a, b)
+
+    def scatter_kinds(self):
+        return "min"
 
 
 class MaxAggregator(ReduceFunction):
@@ -196,6 +232,9 @@ class MaxAggregator(ReduceFunction):
     def reduce(self, a, b):
         return jnp.maximum(a, b)
 
+    def scatter_kinds(self):
+        return "max"
+
 
 class CountAggregator(AggregateFunction):
     def identity(self):
@@ -207,6 +246,9 @@ class CountAggregator(AggregateFunction):
 
     def combine(self, a, b):
         return a + b
+
+    def scatter_kinds(self):
+        return "add"
 
 
 class AvgAggregator(AggregateFunction):
@@ -230,6 +272,9 @@ class AvgAggregator(AggregateFunction):
         cnt = jnp.maximum(acc["count"], 1)
         return acc["sum"] / cnt.astype(self._dtype)
 
+    def scatter_kinds(self):
+        return {"sum": "add", "count": "add"}
+
 
 class TupleAggregator(AggregateFunction):
     """Combine several aggregates over named value columns into one ACC dict —
@@ -250,6 +295,15 @@ class TupleAggregator(AggregateFunction):
 
     def get_result(self, acc):
         return {name: agg.get_result(acc[name]) for name, (_, agg) in self._aggs.items()}
+
+    def scatter_kinds(self):
+        kinds = {}
+        for name, (_, agg) in self._aggs.items():
+            k = agg.scatter_kinds()
+            if k is None:
+                return None
+            kinds[name] = k
+        return kinds
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,69 @@
+"""Stream operator contract (batched).
+
+Analog of ``StreamOperator.java:47`` / ``AbstractStreamOperator.java:88``:
+lifecycle (open/snapshot/close), element processing, watermark/time hooks.
+Re-designed batched: an operator consumes a ``RecordBatch`` (not one record)
+and returns the list of elements it emits; the executor (mailbox analog,
+``MailboxProcessor.java:66``) owns ordering, watermark forwarding and barrier
+alignment so each operator stays single-writer — the same structural
+race-avoidance the reference gets from the mailbox model (SURVEY §5.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from flink_tpu.core.batch import RecordBatch, StreamElement, Watermark
+from flink_tpu.core.functions import RuntimeContext
+
+
+class StreamOperator:
+    """Base operator. Subclasses override what they need.
+
+    Emission contract: every ``process_*`` returns the elements to forward
+    downstream (RecordBatches and, rarely, control elements).  The executor
+    forwards watermarks/barriers itself *after* delivering them to the
+    operator, so fires triggered by a watermark reach downstream before the
+    watermark does — same ordering as the reference's in-band control flow.
+    """
+
+    name: str = "operator"
+    #: operators that only transform rows (no state/time) are chainable into
+    #: the surrounding jitted step (``OperatorChain.java:88`` analog)
+    is_stateless: bool = False
+
+    def open(self, ctx: RuntimeContext) -> None:
+        self.ctx = ctx
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        raise NotImplementedError
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        """Called on watermark advance; return fired elements (watermark itself
+        is forwarded by the executor afterwards)."""
+        return []
+
+    def on_processing_time(self, timestamp_ms: int) -> List[StreamElement]:
+        """Processing-time timer callback (``onProcessingTime`` analog)."""
+        return []
+
+    def end_input(self) -> List[StreamElement]:
+        """Bounded-input flush (``BoundedOneInput.endInput`` analog)."""
+        return []
+
+    # -- checkpointing -------------------------------------------------------
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Synchronous snapshot part: return a host-side state dict (numpy
+        trees); called at barrier alignment points."""
+        return {}
+
+    def restore_state(self, snapshot: Dict[str, Any]) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- metrics -------------------------------------------------------------
+    def metric_group(self):
+        m = getattr(self.ctx, "metrics", None) if hasattr(self, "ctx") else None
+        return m

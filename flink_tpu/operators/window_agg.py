@@ -1,0 +1,471 @@
+"""WindowAggOperator — keyed windowed aggregation on dense TPU state.
+
+The north-star operator (reference: ``WindowOperator.java:98``,
+``processElement:300`` / ``onEventTime:459`` / ``emitWindowContents:574``),
+re-designed for the MXU/HBM execution model instead of the per-record JVM
+loop:
+
+- Keyed state is a **pane ring buffer** in HBM: per accumulator leaf an array
+  ``[K_cap, P, *leaf]`` (K_cap = key capacity, P = ring of panes) plus an
+  ``int32[K_cap, P]`` element count.  A pane is the gcd-span shared by all
+  windows covering it (``assigners.py``); tumbling windows have one pane per
+  window, sliding windows share panes across overlapping windows (the blink
+  pane optimization, ``HeapWindowsGrouping.java``, made the *only* path).
+- ``process_batch`` = one host key-index probe (vectorized, ``keyindex.py``)
+  plus ONE jitted device step: lift values, scatter-combine into
+  ``(key_slot, pane_slot)`` cells (``ops/scatter.py``).  This replaces the
+  reference's per-record ``windowState.add(value)``
+  (``WindowOperator.java:422`` → ``HeapAggregatingState.java:42``).
+- Watermark advance fires every window whose end it passed: gather the
+  window's pane set, tree-combine, ``get_result``, emit rows for keys with
+  data — the batched analog of timer-queue polling + ``emitWindowContents``
+  (``InternalTimerServiceImpl.advanceWatermark`` → ``onEventTime:459``).
+- **Allowed lateness** (``WindowOperator.java:630`` cleanup timers): panes are
+  retained until ``last_window_end + lateness`` passes the watermark; late
+  records within lateness fold into the retained panes and immediately
+  re-fire their windows (EventTimeTrigger late-firing semantics); records
+  beyond lateness are dropped and counted (side-output hook).
+- Count triggers (``CountTrigger.java`` over ``GlobalWindows``) fire per-key
+  when the device count crosses the threshold, then purge those keys' state —
+  evaluated once per micro-batch (mini-batch semantics, like the reference's
+  SQL ``bundle/`` operators).
+
+Static shapes throughout: batches are padded to pow2 sizes (padding rows use
+out-of-range slot ids, dropped by XLA scatter), state grows by doubling
+(K_cap) / ring doubling (P) — so XLA recompiles only O(log) times per run.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.batch import LONG_MIN, RecordBatch, StreamElement, Watermark
+from flink_tpu.core.functions import AggregateFunction, RuntimeContext
+from flink_tpu.core import keygroups
+from flink_tpu.operators.base import StreamOperator
+from flink_tpu.ops.scatter import combine_along_axis, scatter_fast, scatter_generic
+from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex, make_key_index
+from flink_tpu.windowing.assigners import GlobalWindows, WindowAssigner
+from flink_tpu.windowing.triggers import EventTimeTrigger, Trigger
+
+
+def _next_pow2(n: int, floor: int = 1) -> int:
+    c = floor
+    while c < n:
+        c <<= 1
+    return c
+
+
+class WindowAggOperator(StreamOperator):
+    """Keyed window aggregation: ``key_by(key_col).window(assigner).aggregate(agg)``."""
+
+    def __init__(
+        self,
+        assigner: WindowAssigner,
+        agg: AggregateFunction,
+        key_column: str,
+        value_selector: Optional[Callable[[Dict[str, Any]], Any]] = None,
+        value_column: Optional[str] = None,
+        allowed_lateness_ms: int = 0,
+        trigger: Optional[Trigger] = None,
+        output_column: str = "result",
+        emit_window_bounds: bool = True,
+        initial_key_capacity: int = 1 << 10,
+        initial_panes: int = 16,
+        max_batch: int = 1 << 16,
+        name: str = "window-agg",
+    ):
+        self.assigner = assigner
+        self.agg = agg
+        self.key_column = key_column
+        self.value_column = value_column
+        if value_selector is not None:
+            self._select = value_selector
+        elif value_column is not None:
+            self._select = lambda cols: cols[value_column]
+        else:
+            self._select = lambda cols: cols
+        self.lateness = int(allowed_lateness_ms)
+        self.trigger = trigger or EventTimeTrigger()
+        self.output_column = output_column
+        self.emit_window_bounds = emit_window_bounds
+        self.name = name
+        self.max_batch = max_batch
+
+        self.spec = agg.acc_spec()
+        self.kinds = agg.scatter_kind_leaves()
+
+        # ring geometry — P must exceed the live pane span (window length in
+        # panes + out-of-orderness + lateness retention)
+        self._P = _next_pow2(max(initial_panes, 2 * assigner.panes_per_window))
+        self._K = _next_pow2(initial_key_capacity)
+
+        self.key_index: Optional[KeyIndex | ObjectKeyIndex] = None
+        self._leaves = None          # tuple of [K, P, *leaf] device arrays
+        self._counts = None          # int32 [K, P]
+        self.pane_base: Optional[int] = None   # smallest retained pane id
+        self.max_pane: Optional[int] = None    # largest pane seen
+        self.last_fired_window: Optional[int] = None
+        self.watermark: int = LONG_MIN
+        self.late_dropped: int = 0   # beyond-lateness drop counter (numRecordsDropped)
+        self._proc_time: int = LONG_MIN
+
+    # ------------------------------------------------------------------ state
+    def _alloc(self, K: int, P: int):
+        leaves = []
+        for init, shape, dtype in zip(self.spec.leaf_inits, self.spec.leaf_shapes,
+                                      self.spec.leaf_dtypes):
+            leaves.append(jnp.broadcast_to(jnp.asarray(init, dtype), (K, P) + tuple(shape)).copy())
+        return tuple(leaves), jnp.zeros((K, P), jnp.int32)
+
+    def _ensure_alloc(self):
+        if self._leaves is None:
+            self._leaves, self._counts = self._alloc(self._K, self._P)
+
+    def _grow_keys(self, needed: int):
+        newK = _next_pow2(needed, self._K)
+        if newK == self._K and self._leaves is not None:
+            return
+        old_leaves, old_counts = self._leaves, self._counts
+        self._K = newK
+        fresh, fresh_counts = self._alloc(self._K, self._P)
+        if old_leaves is not None:
+            n = old_counts.shape[0]
+            self._leaves = tuple(f.at[:n].set(o) for f, o in zip(fresh, old_leaves))
+            self._counts = fresh_counts.at[:n].set(old_counts)
+        else:
+            self._leaves, self._counts = fresh, fresh_counts
+
+    def _grow_panes(self, span: int):
+        """Double the pane ring until it holds ``span`` live panes, remapping
+        slot = pane % P_old -> pane % P_new for retained panes."""
+        newP = self._P
+        while newP < span:
+            newP <<= 1
+        if newP == self._P:
+            return
+        old_leaves, old_counts, oldP = self._leaves, self._counts, self._P
+        self._P = newP
+        fresh, fresh_counts = self._alloc(self._K, newP)
+        if old_leaves is not None and self.pane_base is not None:
+            panes = np.arange(self.pane_base, self.max_pane + 1, dtype=np.int64)
+            src = jnp.asarray(panes % oldP, jnp.int32)
+            dst = jnp.asarray(panes % newP, jnp.int32)
+            self._leaves = tuple(
+                f.at[:, dst].set(jnp.take(o, src, axis=1))
+                for f, o in zip(fresh, old_leaves))
+            self._counts = fresh_counts.at[:, dst].set(jnp.take(old_counts, src, axis=1))
+        else:
+            self._leaves, self._counts = fresh, fresh_counts
+
+    # ------------------------------------------------------------- device ops
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
+    def _update_step(self, leaves, counts, flat_ids, values, ones):
+        """One micro-batch fold: lift + scatter-combine. flat_ids ∈ [0, K*P]
+        with K*P meaning 'dropped padding row'."""
+        K, P = counts.shape
+        lifted = tuple(jax.tree_util.tree_leaves(self.agg.lift(values)))
+        flat_leaves = tuple(l.reshape((K * P,) + l.shape[2:]) for l in leaves)
+        if self.kinds is not None:
+            new_flat = scatter_fast(flat_leaves, flat_ids, lifted, self.kinds)
+        else:
+            new_flat = scatter_generic(flat_leaves, flat_ids, lifted,
+                                       self.agg.combine_leaves, K * P)
+        new_leaves = tuple(l.reshape((K, P) + l.shape[1:]) for l in new_flat)
+        new_counts = counts.reshape(K * P).at[flat_ids].add(ones, mode="drop").reshape(K, P)
+        return new_leaves, new_counts
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _fire_step(self, leaves, counts, pane_slots):
+        """Assemble one window from its panes: combine + get_result + mask."""
+        sel = tuple(jnp.take(l, pane_slots, axis=1) for l in leaves)
+        total = jnp.take(counts, pane_slots, axis=1).sum(axis=1)
+        combined = combine_along_axis(sel, self.agg.combine_leaves, axis=1)
+        result = self.agg.get_result(self.spec.unflatten(combined))
+        return total > 0, result
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
+    def _clear_panes_step(self, leaves, counts, pane_slots):
+        new_leaves = []
+        for l, init in zip(leaves, self.spec.leaf_inits):
+            fill = jnp.broadcast_to(jnp.asarray(init, l.dtype),
+                                    (l.shape[0], pane_slots.shape[0]) + l.shape[2:])
+            new_leaves.append(l.at[:, pane_slots].set(fill))
+        return tuple(new_leaves), counts.at[:, pane_slots].set(0)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
+    def _purge_keys_step(self, leaves, counts, key_mask):
+        """Count-trigger purge: reset fired keys' state (FIRE_AND_PURGE)."""
+        new_leaves = []
+        for l, init in zip(leaves, self.spec.leaf_inits):
+            fill = jnp.broadcast_to(jnp.asarray(init, l.dtype), l.shape)
+            m = key_mask.reshape((-1,) + (1,) * (l.ndim - 1))
+            new_leaves.append(jnp.where(m, fill, l))
+        return tuple(new_leaves), jnp.where(key_mask[:, None], 0, counts)
+
+    # --------------------------------------------------------------- batching
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        cols = batch.columns
+        keys = np.asarray(cols[self.key_column])
+        if self.key_index is None:
+            self.key_index = make_key_index(keys[0] if keys.ndim else keys)
+        if self.assigner.is_event_time:
+            if batch.timestamps is None:
+                raise ValueError(
+                    "event-time window requires timestamps "
+                    "(assign_timestamps_and_watermarks upstream)")
+            ts = np.asarray(batch.timestamps, np.int64)
+        else:
+            ts = np.full(len(batch), self._now_ms(), np.int64)
+        panes = self.assigner.pane_of(ts)
+
+        # ---- late-beyond-lateness drop (reference: WindowOperator.java:437 isElementLate)
+        if self.pane_base is not None:
+            live = panes >= self.pane_base
+            if not live.all():
+                self.late_dropped += int(np.count_nonzero(~live))
+                batch = batch.select(live)
+                if len(batch) == 0:
+                    return []
+                cols = batch.columns
+                keys = np.asarray(cols[self.key_column])
+                ts = ts[live]
+                panes = panes[live]
+
+        pmin, pmax = int(panes.min()), int(panes.max())
+        if self.pane_base is None:
+            self.pane_base = pmin
+            self.max_pane = pmax
+        else:
+            # grow BEFORE extending max_pane: the remap copies the old live
+            # range [pane_base, max_pane], which is alias-free only in the
+            # old ring geometry
+            span = max(self.max_pane, pmax) - self.pane_base + 1
+            if span > self._P:
+                self._ensure_alloc()
+                self._grow_panes(span)
+            self.max_pane = max(self.max_pane, pmax)
+        span = self.max_pane - self.pane_base + 1
+        if span > self._P:
+            self._ensure_alloc()
+            self._grow_panes(span)
+
+        slots = self.key_index.lookup_or_insert(keys)
+        if self.key_index.num_keys > self._K:
+            self._ensure_alloc()
+            self._grow_keys(self.key_index.num_keys)
+        self._ensure_alloc()
+
+        # ---- pad to pow2 batch size (static shapes; pads dropped via slot id K*P)
+        B = len(batch)
+        Bp = _next_pow2(B, 64)
+        flat = slots.astype(np.int64) * self._P + (panes % self._P)
+        flat_p = np.full(Bp, self._K * self._P, np.int64)
+        flat_p[:B] = flat
+        values = self._select(cols)
+        values_p = jax.tree_util.tree_map(lambda a: _pad_rows(np.asarray(a), Bp), values)
+        ones = np.ones(Bp, np.int32)
+
+        self._leaves, self._counts = self._update_step(
+            self._leaves, self._counts,
+            jnp.asarray(flat_p, jnp.int32), values_p, jnp.asarray(ones))
+
+        out: List[StreamElement] = []
+        # ---- count-trigger (GlobalWindows / countWindow path)
+        if self.trigger.fires_on_count:
+            out.extend(self._fire_by_count())
+        # ---- late re-fire: windows already passed by the watermark that this
+        # batch updated fire again immediately (EventTimeTrigger.onElement FIRE)
+        if (self.trigger.fires_on_time and self.assigner.is_event_time
+                and self.last_fired_window is not None):
+            touched = np.unique(panes)
+            refire: List[int] = []
+            for p in touched.tolist():
+                w0, w1 = self.assigner.windows_of_pane(int(p))
+                for w in range(w0, w1 + 1):
+                    if (w <= self.last_fired_window
+                            and self.assigner.window_bounds(w).max_timestamp <= self.watermark):
+                        refire.append(w)
+            for w in sorted(set(refire)):
+                out.extend(self._fire_window(w))
+        return out
+
+    # ------------------------------------------------------------------ time
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        self.watermark = max(self.watermark, watermark.timestamp)
+        if not (self.trigger.fires_on_time and self.assigner.is_event_time):
+            return []
+        return self._advance_time(self.watermark)
+
+    def on_processing_time(self, timestamp_ms: int) -> List[StreamElement]:
+        self._proc_time = max(self._proc_time, timestamp_ms)
+        if self.assigner.is_event_time or not self.trigger.fires_on_time:
+            return []
+        return self._advance_time(self._proc_time)
+
+    def end_input(self) -> List[StreamElement]:
+        """Bounded input: fire everything outstanding (MAX_WATERMARK analog)."""
+        if isinstance(self.assigner, GlobalWindows):
+            return self._fire_by_count(force=True)
+        out = self._advance_time(2 ** 62)
+        return out
+
+    def _now_ms(self) -> int:
+        import time
+
+        return int(time.time() * 1000)
+
+    def _advance_time(self, now: int) -> List[StreamElement]:
+        if self._leaves is None or self.pane_base is None:
+            return []
+        a = self.assigner
+        out: List[StreamElement] = []
+        # largest w whose maxTimestamp (= end-1) has been passed — the fire
+        # condition of EventTimeTrigger: watermark >= window.maxTimestamp
+        denom = a.pane_stride * a.pane_ms
+        w_max = (now + 1 - a._offset - a.panes_per_window * a.pane_ms) // denom
+        while a.window_bounds(w_max + 1).max_timestamp <= now:
+            w_max += 1
+        while a.window_bounds(w_max).max_timestamp > now:
+            w_max -= 1
+        # bound firing to windows that can contain data ([pane_base, max_pane])
+        lo_window = a.windows_of_pane(self.pane_base)[0]
+        hi_window = a.windows_of_pane(self.max_pane)[1]
+        start = (self.last_fired_window + 1 if self.last_fired_window is not None
+                 else lo_window)
+        start = max(start, lo_window)
+        for w in range(start, min(w_max, hi_window) + 1):
+            out.extend(self._fire_window(w))
+        if self.last_fired_window is None or w_max > self.last_fired_window:
+            self.last_fired_window = w_max
+        # ---- retention: clear panes whose last window end + lateness passed
+        self._expire_panes(now)
+        return out
+
+    def _expire_panes(self, now: int):
+        if self.pane_base is None:
+            return
+        # cleanup time = window.maxTimestamp + allowedLateness (reference:
+        # WindowOperator.cleanupTime); a pane expires once its LAST covering
+        # window's cleanup time has been passed by the watermark.
+        expired = []
+        p = self.pane_base
+        while (p <= self.max_pane
+               and self.assigner.last_window_end_of_pane(p) - 1 + self.lateness <= now):
+            expired.append(p)
+            p += 1
+        if not expired:
+            return
+        self.pane_base = p
+        slots = jnp.asarray(np.asarray(expired, np.int64) % self._P, jnp.int32)
+        self._leaves, self._counts = self._clear_panes_step(self._leaves, self._counts, slots)
+        if self.pane_base > self.max_pane:
+            self.max_pane = self.pane_base
+
+    # ------------------------------------------------------------------ fires
+    def _fire_window(self, window_id: int) -> List[StreamElement]:
+        if self._leaves is None:
+            return []
+        first, last = self.assigner.window_panes(window_id)
+        # skip windows entirely outside retained panes
+        if last < self.pane_base or first > self.max_pane:
+            return []
+        panes = np.arange(first, last + 1, dtype=np.int64)
+        pane_slots = jnp.asarray(panes % self._P, jnp.int32)
+        mask, result = self._fire_step(self._leaves, self._counts, pane_slots)
+        return self._emit(mask, result, self.assigner.window_bounds(window_id))
+
+    def _fire_by_count(self, force: bool = False) -> List[StreamElement]:
+        if self._leaves is None:
+            return []
+        thr = 1 if force else self.trigger.count_threshold
+        counts0 = self._counts[:, 0]
+        mask = counts0 >= thr
+        pane_slots = jnp.zeros((1,), jnp.int32)
+        m, result = self._fire_step(self._leaves, self._counts, pane_slots)
+        mask = mask & m
+        out = self._emit(mask, result, self.assigner.window_bounds(0))
+        if self.trigger.purges_on_fire and out:
+            self._leaves, self._counts = self._purge_keys_step(
+                self._leaves, self._counts, mask)
+        return out
+
+    def _emit(self, mask, result, window) -> List[StreamElement]:
+        mask_np = np.asarray(mask[: self.key_index.num_keys]) if self.key_index else np.asarray(mask)
+        idx = np.nonzero(mask_np)[0]
+        if idx.size == 0:
+            return []
+        keys = np.asarray(self.key_index.reverse_keys())[idx]
+        cols: Dict[str, Any] = {self.key_column: keys}
+        res_np = jax.tree_util.tree_map(lambda a: np.asarray(a)[idx], result)
+        if isinstance(res_np, dict):
+            cols.update(res_np)
+        else:
+            cols[self.output_column] = res_np
+        if self.emit_window_bounds:
+            cols["window_start"] = np.full(idx.size, window.start, np.int64)
+            cols["window_end"] = np.full(idx.size, window.end, np.int64)
+        ts = np.full(idx.size, window.max_timestamp, np.int64)
+        return [RecordBatch(cols, timestamps=ts)]
+
+    # ------------------------------------------------------------- snapshots
+    def snapshot_state(self) -> Dict[str, Any]:
+        snap: Dict[str, Any] = {
+            "pane_base": self.pane_base,
+            "max_pane": self.max_pane,
+            "last_fired_window": self.last_fired_window,
+            "watermark": self.watermark,
+            "late_dropped": self.late_dropped,
+            "P": self._P,
+        }
+        if self.key_index is not None:
+            snap["key_index"] = self.key_index.snapshot()
+            snap["key_index_kind"] = type(self.key_index).__name__
+        if self._leaves is not None and self.pane_base is not None:
+            n = self.key_index.num_keys
+            panes = np.arange(self.pane_base, self.max_pane + 1, dtype=np.int64)
+            slots = jnp.asarray(panes % self._P, jnp.int32)
+            # snapshot only live keys × live panes (device→host transfer)
+            snap["panes"] = panes
+            snap["leaves"] = [np.asarray(jnp.take(l, slots, axis=1))[:n] for l in self._leaves]
+            snap["counts"] = np.asarray(jnp.take(self._counts, slots, axis=1))[:n]
+        return snap
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.pane_base = snap["pane_base"]
+        self.max_pane = snap["max_pane"]
+        self.last_fired_window = snap["last_fired_window"]
+        self.watermark = snap["watermark"]
+        self.late_dropped = snap.get("late_dropped", 0)
+        self._P = snap["P"]
+        if "key_index" in snap:
+            if snap["key_index_kind"] == "ObjectKeyIndex":
+                self.key_index = ObjectKeyIndex.restore(snap["key_index"])
+            else:
+                self.key_index = KeyIndex.restore(snap["key_index"])
+            self._K = _next_pow2(max(self.key_index.num_keys, 1), self._K)
+        self._leaves = None
+        self._counts = None
+        if "leaves" in snap:
+            self._ensure_alloc()
+            n = snap["counts"].shape[0]
+            panes = np.asarray(snap["panes"], np.int64)
+            slots = jnp.asarray(panes % self._P, jnp.int32)
+            self._leaves = tuple(
+                l.at[:n, slots].set(jnp.asarray(s))
+                for l, s in zip(self._leaves, snap["leaves"]))
+            self._counts = self._counts.at[:n, slots].set(jnp.asarray(snap["counts"]))
+
+
+def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    if a.shape[0] == n:
+        return a
+    pad = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad, mode="edge")

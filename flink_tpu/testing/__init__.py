@@ -1,0 +1,6 @@
+from flink_tpu.testing.harness import (
+    KeyedOneInputOperatorHarness,
+    TestProcessingTimeService,
+)
+
+__all__ = ["KeyedOneInputOperatorHarness", "TestProcessingTimeService"]

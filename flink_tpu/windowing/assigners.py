@@ -1,0 +1,206 @@
+"""Window assigners, pane-decomposed for batched TPU execution.
+
+The reference assigns each element to its window set per record
+(``flink-streaming-java/.../api/windowing/assigners/``: Tumbling/Sliding/
+Session/Global × event/processing time) and, on the SQL fast path, decomposes
+overlapping windows into **panes** — maximal non-overlapping spans shared by
+all windows covering them (``flink-table-runtime-blink/.../window/assigners/
+PanedWindowAssigner.java``, ``grouping/HeapWindowsGrouping.java``).
+
+The TPU-native design makes the pane the *only* unit the per-record hot path
+sees: ``pane_of(timestamps)`` is one vectorized int op over the batch, device
+state is a ``[keys, panes]`` ring buffer, and full windows are assembled at
+fire time by combining each window's (static, precomputed) pane set — the
+blockwise-partial/combine structure that maps onto ``segment_sum`` +
+tree-combine on the MXU-friendly dense layout.
+
+Session windows are data-dependent (gap merging) and handled by a dedicated
+operator (see ``flink_tpu/operators/session.py``), mirroring how the reference
+splits the merging path (``MergingWindowSet.java``) from the paned path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import gcd
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.core.batch import LONG_MAX, LONG_MIN
+
+
+@dataclass(frozen=True, order=True)
+class TimeWindow:
+    """[start, end) time window (``TimeWindow.java``); max_timestamp = end - 1."""
+
+    start: int
+    end: int
+
+    @property
+    def max_timestamp(self) -> int:
+        return self.end - 1
+
+    def intersects(self, other: "TimeWindow") -> bool:
+        return self.start <= other.end and other.start <= self.end
+
+    def cover(self, other: "TimeWindow") -> "TimeWindow":
+        return TimeWindow(min(self.start, other.start), max(self.end, other.end))
+
+
+class WindowAssigner:
+    """Pane-decomposed window assigner.
+
+    Contract (all windows are unions of contiguous panes):
+      pane_ms                       pane width in ms
+      panes_per_window              number of consecutive panes per window
+      pane_stride                   panes between consecutive window starts
+      pane_of(ts[B]) -> int64[B]    pane id per record (one vector op)
+      window_of_last_pane(pane)     window id of the *latest* window containing
+                                    this pane (used for retention math)
+    Window id ``w`` covers panes ``[w * pane_stride, w * pane_stride +
+    panes_per_window)``; its time span is ``window_bounds(w)``.
+    """
+
+    is_event_time: bool = True
+    pane_ms: int = 0
+    panes_per_window: int = 1
+    pane_stride: int = 1
+
+    def pane_of(self, timestamps: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def window_panes(self, window_id: int) -> Tuple[int, int]:
+        """[first_pane, last_pane] inclusive for a window id."""
+        first = window_id * self.pane_stride
+        return first, first + self.panes_per_window - 1
+
+    def window_bounds(self, window_id: int) -> TimeWindow:
+        start = window_id * self.pane_stride * self.pane_ms + self._offset
+        return TimeWindow(start, start + self.panes_per_window * self.pane_ms)
+
+    def windows_of_pane(self, pane_id: int) -> Tuple[int, int]:
+        """[first_window, last_window] inclusive containing pane_id."""
+        last = pane_id // self.pane_stride
+        first = (pane_id - self.panes_per_window + self.pane_stride) // self.pane_stride
+        return first, last
+
+    def last_window_end_of_pane(self, pane_id: int) -> int:
+        """End timestamp of the latest window containing this pane — the pane
+        can be cleared once the watermark passes this + allowed lateness."""
+        _, last_w = self.windows_of_pane(pane_id)
+        return self.window_bounds(last_w).end
+
+    _offset: int = 0
+
+
+@dataclass(frozen=True)
+class _FixedPaneAssigner(WindowAssigner):
+    size_ms: int = 0
+    slide_ms: int = 0
+    offset_ms: int = 0
+    is_event_time: bool = True
+
+    def __post_init__(self):
+        if self.size_ms <= 0 or self.slide_ms <= 0:
+            raise ValueError(
+                f"window size/slide must be > 0, got size={self.size_ms} slide={self.slide_ms}")
+        if self.slide_ms > self.size_ms:
+            # Tumbling-with-gaps (slide > size) is rejected by the reference too
+            # (SlidingEventTimeWindows checks size >= slide indirectly via panes).
+            raise ValueError("slide must be <= size")
+        pane = gcd(self.size_ms, self.slide_ms)
+        object.__setattr__(self, "pane_ms", pane)
+        object.__setattr__(self, "panes_per_window", self.size_ms // pane)
+        object.__setattr__(self, "pane_stride", self.slide_ms // pane)
+        object.__setattr__(self, "_offset", self.offset_ms % self.slide_ms)
+
+    def pane_of(self, timestamps: np.ndarray) -> np.ndarray:
+        ts = np.asarray(timestamps, np.int64)
+        return (ts - self._offset) // np.int64(self.pane_ms)
+
+
+class TumblingEventTimeWindows(_FixedPaneAssigner):
+    """``TumblingEventTimeWindows.of(size[, offset])`` — pane == window."""
+
+    def __init__(self, size_ms: int, offset_ms: int = 0):
+        super().__init__(size_ms=size_ms, slide_ms=size_ms, offset_ms=offset_ms,
+                         is_event_time=True)
+
+    @staticmethod
+    def of(size_ms: int, offset_ms: int = 0) -> "TumblingEventTimeWindows":
+        return TumblingEventTimeWindows(size_ms, offset_ms)
+
+
+class TumblingProcessingTimeWindows(_FixedPaneAssigner):
+    def __init__(self, size_ms: int, offset_ms: int = 0):
+        super().__init__(size_ms=size_ms, slide_ms=size_ms, offset_ms=offset_ms,
+                         is_event_time=False)
+
+    @staticmethod
+    def of(size_ms: int, offset_ms: int = 0) -> "TumblingProcessingTimeWindows":
+        return TumblingProcessingTimeWindows(size_ms, offset_ms)
+
+
+class SlidingEventTimeWindows(_FixedPaneAssigner):
+    """``SlidingEventTimeWindows.of(size, slide)``: windows overlap; each record
+    lands in exactly one pane, each window combines size/gcd panes at fire."""
+
+    def __init__(self, size_ms: int, slide_ms: int, offset_ms: int = 0):
+        super().__init__(size_ms=size_ms, slide_ms=slide_ms, offset_ms=offset_ms,
+                         is_event_time=True)
+
+    @staticmethod
+    def of(size_ms: int, slide_ms: int, offset_ms: int = 0) -> "SlidingEventTimeWindows":
+        return SlidingEventTimeWindows(size_ms, slide_ms, offset_ms)
+
+
+class SlidingProcessingTimeWindows(_FixedPaneAssigner):
+    def __init__(self, size_ms: int, slide_ms: int, offset_ms: int = 0):
+        super().__init__(size_ms=size_ms, slide_ms=slide_ms, offset_ms=offset_ms,
+                         is_event_time=False)
+
+    @staticmethod
+    def of(size_ms: int, slide_ms: int, offset_ms: int = 0) -> "SlidingProcessingTimeWindows":
+        return SlidingProcessingTimeWindows(size_ms, slide_ms, offset_ms)
+
+
+class GlobalWindows(WindowAssigner):
+    """One window covering everything (``GlobalWindows.java``); fires only via
+    a count/custom trigger.  Modeled as a single pane with an effectively
+    infinite width."""
+
+    is_event_time = True
+    pane_ms = LONG_MAX // 4
+    panes_per_window = 1
+    pane_stride = 1
+
+    def pane_of(self, timestamps: np.ndarray) -> np.ndarray:
+        return np.zeros(np.shape(timestamps)[0], np.int64)
+
+    def window_bounds(self, window_id: int) -> TimeWindow:
+        return TimeWindow(LONG_MIN, LONG_MAX)
+
+    def last_window_end_of_pane(self, pane_id: int) -> int:
+        return LONG_MAX
+
+    @staticmethod
+    def create() -> "GlobalWindows":
+        return GlobalWindows()
+
+
+@dataclass(frozen=True)
+class SessionGap:
+    """Session spec: windows merge while gaps < gap_ms (``EventTimeSessionWindows``).
+    Consumed by the dedicated session operator, not the paned one."""
+
+    gap_ms: int
+    is_event_time: bool = True
+
+
+def EventTimeSessionWindows(gap_ms: int) -> SessionGap:
+    return SessionGap(gap_ms, True)
+
+
+def ProcessingTimeSessionWindows(gap_ms: int) -> SessionGap:
+    return SessionGap(gap_ms, False)

@@ -1,0 +1,311 @@
+"""WindowAggOperator golden tests.
+
+Modeled on the reference's ``WindowOperatorTest.java`` (SURVEY §4.2): push
+elements + watermarks through a harness, assert emitted (key, value,
+timestamp) tuples per window — tumbling, sliding (pane combine), lateness /
+late re-fire / beyond-lateness drop, count windows, snapshot/restore.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.functions import (
+    AvgAggregator,
+    CountAggregator,
+    LambdaReduce,
+    MaxAggregator,
+    MinAggregator,
+    SumAggregator,
+    TupleAggregator,
+)
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.testing import KeyedOneInputOperatorHarness
+from flink_tpu.testing.harness import sorted_rows
+from flink_tpu.windowing import (
+    CountTrigger,
+    GlobalWindows,
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+    TumblingProcessingTimeWindows,
+)
+
+
+def make_op(assigner=None, agg=None, **kw):
+    return WindowAggOperator(
+        assigner or TumblingEventTimeWindows.of(100),
+        agg or SumAggregator(np.float32),
+        key_column="key",
+        value_column="v",
+        **kw,
+    )
+
+
+def rows(*kv_ts):
+    rws, ts = [], []
+    for k, v, t in kv_ts:
+        rws.append({"key": k, "v": np.float32(v)})
+        ts.append(t)
+    return rws, ts
+
+
+class TestTumbling:
+    def test_basic_sum(self):
+        h = KeyedOneInputOperatorHarness(make_op())
+        r, t = rows((1, 1.0, 10), (1, 2.0, 20), (2, 5.0, 30), (1, 4.0, 150))
+        h.process_elements(r, t)
+        h.process_watermark(99)
+        out = sorted_rows(h.extract_output_rows(), ("key",))
+        assert [(o["key"], o["result"]) for o in out] == [(1, 3.0), (2, 5.0)]
+        assert all(o["__ts__"] == 99 for o in out)          # window.maxTimestamp
+        assert all(o["window_start"] == 0 and o["window_end"] == 100 for o in out)
+        h.clear_output()
+        h.process_watermark(199)
+        out = h.extract_output_rows()
+        assert [(o["key"], o["result"]) for o in out] == [(1, 4.0)]
+        assert out[0]["window_start"] == 100
+
+    def test_empty_windows_not_emitted(self):
+        h = KeyedOneInputOperatorHarness(make_op())
+        r, t = rows((1, 1.0, 10))
+        h.process_elements(r, t)
+        h.process_watermark(5000)  # many empty windows passed
+        out = h.extract_output_rows()
+        assert len(out) == 1
+
+    def test_watermark_is_exclusive_boundary(self):
+        # element AT window end belongs to the next window; watermark == end-1 fires
+        h = KeyedOneInputOperatorHarness(make_op())
+        r, t = rows((1, 1.0, 99), (1, 10.0, 100))
+        h.process_elements(r, t)
+        h.process_watermark(98)
+        assert h.extract_output_rows() == []
+        h.process_watermark(99)
+        out = h.extract_output_rows()
+        assert [(o["key"], o["result"]) for o in out] == [(1, 1.0)]
+
+    def test_multiple_batches_accumulate(self):
+        h = KeyedOneInputOperatorHarness(make_op())
+        for v in (1.0, 2.0, 3.0):
+            r, t = rows((7, v, 50))
+            h.process_elements(r, t)
+        h.process_watermark(99)
+        out = h.extract_output_rows()
+        assert [(o["key"], o["result"]) for o in out] == [(7, 6.0)]
+
+    def test_offset(self):
+        h = KeyedOneInputOperatorHarness(
+            make_op(TumblingEventTimeWindows.of(100, offset_ms=30)))
+        r, t = rows((1, 1.0, 20), (1, 2.0, 40))
+        h.process_elements(r, t)
+        h.process_watermark(29)  # window [-70,30) ends
+        out = h.extract_output_rows()
+        assert [(o["key"], o["result"], o["window_end"]) for o in out] == [(1, 1.0, 30)]
+
+
+class TestAggregators:
+    def _run(self, agg, vals, expect, value_column="v"):
+        h = KeyedOneInputOperatorHarness(make_op(agg=agg))
+        r, t = rows(*[(1, v, 10) for v in vals])
+        h.process_elements(r, t)
+        h.process_watermark(99)
+        out = h.extract_output_rows()
+        assert len(out) == 1
+        assert out[0]["result"] == pytest.approx(expect)
+
+    def test_min(self):
+        self._run(MinAggregator(np.float32), [3.0, 1.0, 2.0], 1.0)
+
+    def test_max(self):
+        self._run(MaxAggregator(np.float32), [3.0, 1.0, 2.0], 3.0)
+
+    def test_count(self):
+        self._run(CountAggregator(), [3.0, 1.0, 2.0], 3)
+
+    def test_avg(self):
+        self._run(AvgAggregator(np.float32), [3.0, 1.0, 2.0], 2.0)
+
+    def test_generic_reduce_no_scatter_kind(self):
+        # LambdaReduce declares no scatter kind → generic segmented-scan path
+        agg = LambdaReduce(lambda a, b: a + b, np.float32(0.0))
+        assert agg.scatter_kind_leaves() is None
+        self._run(agg, [1.0, 2.0, 4.0], 7.0)
+
+    def test_multi_field_tuple_aggregate(self):
+        agg = TupleAggregator({
+            "total": ("v", SumAggregator(np.float32)),
+            "lo": ("v", MinAggregator(np.float32)),
+            "n": ("v", CountAggregator()),
+        })
+        op = WindowAggOperator(TumblingEventTimeWindows.of(100), agg,
+                               key_column="key", value_selector=lambda c: c)
+        h = KeyedOneInputOperatorHarness(op)
+        r, t = rows((1, 5.0, 10), (1, 3.0, 20))
+        h.process_elements(r, t)
+        h.process_watermark(99)
+        out = h.extract_output_rows()
+        assert len(out) == 1
+        assert out[0]["total"] == 8.0 and out[0]["lo"] == 3.0 and out[0]["n"] == 2
+
+
+class TestSliding:
+    def test_pane_combine(self):
+        # size 100, slide 50 → pane 50; element in 2 windows
+        h = KeyedOneInputOperatorHarness(
+            make_op(SlidingEventTimeWindows.of(100, 50)))
+        r, t = rows((1, 1.0, 60), (1, 2.0, 120))
+        h.process_elements(r, t)
+        h.process_watermark(250)
+        out = h.extract_output_rows()
+        got = {(o["window_start"], o["window_end"]): o["result"] for o in out}
+        # ts=60 in windows [0,100) and [50,150); ts=120 in [50,150) and [100,200)
+        assert got[(0, 100)] == 1.0
+        assert got[(50, 150)] == 3.0
+        assert got[(100, 200)] == 2.0
+
+    def test_uneven_pane_count(self):
+        # size 60, slide 20 → 3 panes/window
+        h = KeyedOneInputOperatorHarness(
+            make_op(SlidingEventTimeWindows.of(60, 20)))
+        r, t = rows((1, 1.0, 5), (1, 2.0, 25), (1, 4.0, 45))
+        h.process_elements(r, t)
+        h.process_watermark(300)
+        out = h.extract_output_rows()
+        got = {(o["window_start"], o["window_end"]): o["result"] for o in out}
+        assert got[(0, 60)] == 7.0
+        assert got[(-40, 20)] == 1.0
+        assert got[(20, 80)] == 6.0
+        assert got[(40, 100)] == 4.0
+
+
+class TestLateness:
+    def test_beyond_lateness_dropped(self):
+        op = make_op(allowed_lateness_ms=0)
+        h = KeyedOneInputOperatorHarness(op)
+        r, t = rows((1, 1.0, 10))
+        h.process_elements(r, t)
+        h.process_watermark(99)
+        h.clear_output()
+        r, t = rows((1, 100.0, 50))  # late beyond lateness: window fired+cleaned
+        h.process_elements(r, t)
+        h.process_watermark(199)
+        assert h.extract_output_rows() == []
+        assert op.late_dropped == 1
+
+    def test_late_within_lateness_refires(self):
+        op = make_op(allowed_lateness_ms=200)
+        h = KeyedOneInputOperatorHarness(op)
+        r, t = rows((1, 1.0, 10))
+        h.process_elements(r, t)
+        h.process_watermark(99)
+        h.clear_output()
+        # late but within lateness → accumulates and re-fires immediately
+        r, t = rows((1, 2.0, 20))
+        h.process_elements(r, t)
+        out = h.extract_output_rows()
+        assert [(o["key"], o["result"]) for o in out] == [(1, 3.0)]
+        assert op.late_dropped == 0
+        # past end+lateness → cleanup, further late data dropped
+        h.process_watermark(400)
+        h.clear_output()
+        r, t = rows((1, 50.0, 30))
+        h.process_elements(r, t)
+        assert h.extract_output_rows() == []
+        assert op.late_dropped == 1
+
+
+class TestCountWindows:
+    def test_count_trigger_fire_and_purge(self):
+        op = WindowAggOperator(
+            GlobalWindows.create(), SumAggregator(np.float32),
+            key_column="key", value_column="v", trigger=CountTrigger.of(2),
+            emit_window_bounds=False)
+        h = KeyedOneInputOperatorHarness(op)
+        r, t = rows((1, 1.0, 0), (1, 2.0, 0), (2, 5.0, 0))
+        h.process_elements(r, t)
+        out = h.extract_output_rows()
+        assert [(o["key"], o["result"]) for o in out] == [(1, 3.0)]
+        h.clear_output()
+        r, t = rows((1, 10.0, 0), (2, 1.0, 0), (1, 20.0, 0))
+        h.process_elements(r, t)
+        out = sorted_rows(h.extract_output_rows(), ("key",))
+        # key 1 purged after first fire → 10+20; key 2 reaches 2 elements → 5+1
+        assert [(o["key"], o["result"]) for o in out] == [(1, 30.0), (2, 6.0)]
+
+
+class TestProcessingTime:
+    def test_proc_time_window(self):
+        op = make_op(TumblingProcessingTimeWindows.of(100))
+        h = KeyedOneInputOperatorHarness(op)
+        h.time_service.advance_to(10)
+        r, t = rows((1, 1.0, 0), (1, 2.0, 0))
+        h.process_elements(r, t)
+        h.set_processing_time(98)
+        assert h.extract_output_rows() == []
+        # ProcessingTimeTrigger registers a timer at window.maxTimestamp (99)
+        h.set_processing_time(99)
+        out = h.extract_output_rows()
+        assert [(o["key"], o["result"]) for o in out] == [(1, 3.0)]
+
+
+class TestSnapshotRestore:
+    def test_mid_window_snapshot_restore(self):
+        op = make_op()
+        h = KeyedOneInputOperatorHarness(op)
+        r, t = rows((1, 1.0, 10), (2, 7.0, 20), (1, 2.0, 110))
+        h.process_elements(r, t)
+        snap = h.snapshot()
+
+        op2 = make_op()
+        h2 = KeyedOneInputOperatorHarness.restored(op2, snap)
+        h2.process_elements(*rows((1, 4.0, 30)))
+        h2.process_watermark(199)
+        out = sorted_rows(
+            [o for o in h2.extract_output_rows() if o["window_end"] == 100], ("key",))
+        assert [(o["key"], o["result"]) for o in out] == [(1, 5.0), (2, 7.0)]
+        out2 = [o for o in h2.extract_output_rows() if o["window_end"] == 200]
+        assert [(o["key"], o["result"]) for o in out2] == [(1, 2.0)]
+
+    def test_restore_preserves_fired_horizon(self):
+        op = make_op()
+        h = KeyedOneInputOperatorHarness(op)
+        h.process_elements(*rows((1, 1.0, 10)))
+        h.process_watermark(99)
+        snap = h.snapshot()
+        op2 = make_op()
+        h2 = KeyedOneInputOperatorHarness.restored(op2, snap)
+        h2.process_watermark(99)  # same watermark again must not re-fire
+        assert h2.extract_output_rows() == []
+
+
+class TestStringKeys:
+    def test_object_key_index(self):
+        h = KeyedOneInputOperatorHarness(make_op())
+        h.process_elements([{"key": "alpha", "v": np.float32(1.0)},
+                            {"key": "beta", "v": np.float32(2.0)},
+                            {"key": "alpha", "v": np.float32(3.0)}], [10, 20, 30])
+        h.process_watermark(99)
+        out = sorted_rows(h.extract_output_rows(), ("key",))
+        assert [(o["key"], o["result"]) for o in out] == [("alpha", 4.0), ("beta", 2.0)]
+
+
+class TestGrowth:
+    def test_key_capacity_doubling(self):
+        op = make_op(initial_key_capacity=4)
+        h = KeyedOneInputOperatorHarness(op)
+        n = 100
+        r = [{"key": k, "v": np.float32(k)} for k in range(n)]
+        h.process_elements(r, [10] * n)
+        h.process_watermark(99)
+        out = sorted_rows(h.extract_output_rows(), ("key",))
+        assert len(out) == n
+        assert all(o["result"] == float(o["key"]) for o in out)
+
+    def test_pane_ring_growth_on_time_jump(self):
+        op = make_op(initial_panes=2)
+        h = KeyedOneInputOperatorHarness(op)
+        h.process_elements(*rows((1, 1.0, 10)))
+        h.process_elements(*rows((1, 2.0, 100 * 40)))  # 40 windows ahead
+        h.process_watermark(100 * 41)
+        out = h.extract_output_rows()
+        got = {o["window_start"]: o["result"] for o in out}
+        assert got[0] == 1.0 and got[4000] == 2.0
