@@ -1,0 +1,15 @@
+from flink_tpu.connectors.sinks import CollectSink, FunctionSink, PrintSink, Sink
+from flink_tpu.connectors.sources import (
+    CollectionSource,
+    GeneratorSource,
+    IteratorSource,
+    SocketTextSource,
+    Source,
+    SourceSplit,
+)
+
+__all__ = [
+    "CollectSink", "FunctionSink", "PrintSink", "Sink",
+    "CollectionSource", "GeneratorSource", "IteratorSource",
+    "SocketTextSource", "Source", "SourceSplit",
+]

@@ -1,0 +1,78 @@
+"""Sinks: batched record consumers (``SinkFunction`` analogs)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.core.batch import RecordBatch
+
+
+class Sink:
+    def write_batch(self, batch: RecordBatch) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class CollectSink(Sink):
+    """Gathers all batches in memory (``CollectSink.java`` /
+    ``DataStream.executeAndCollect`` analog) — the test workhorse."""
+
+    def __init__(self):
+        self.batches: List[RecordBatch] = []
+
+    def write_batch(self, batch: RecordBatch) -> None:
+        self.batches.append(batch)
+
+    def rows(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for b in self.batches:
+            cols = {k: np.asarray(v) for k, v in b.columns.items()}
+            for i in range(len(b)):
+                row = {k: (v[i].item() if isinstance(v[i], np.generic) else v[i])
+                       for k, v in cols.items()}
+                if b.timestamps is not None:
+                    row["__ts__"] = int(np.asarray(b.timestamps)[i])
+                out.append(row)
+        return out
+
+    def column(self, name: str) -> np.ndarray:
+        parts = [np.asarray(b.column(name)) for b in self.batches if len(b)]
+        return np.concatenate(parts) if parts else np.asarray([])
+
+
+class PrintSink(Sink):
+    """``print()`` analog: one line per row to stdout/stderr."""
+
+    def __init__(self, prefix: str = "", to_stderr: bool = False, limit: int = 0):
+        self.prefix = prefix
+        self.stream = sys.stderr if to_stderr else sys.stdout
+        self.limit = limit
+        self._printed = 0
+
+    def write_batch(self, batch: RecordBatch) -> None:
+        cols = {k: np.asarray(v) for k, v in batch.columns.items()}
+        for i in range(len(batch)):
+            if self.limit and self._printed >= self.limit:
+                return
+            row = {k: v[i] for k, v in cols.items()}
+            p = f"{self.prefix}> " if self.prefix else ""
+            print(f"{p}{row}", file=self.stream)
+            self._printed += 1
+
+
+class FunctionSink(Sink):
+    """Adapts a plain callable(batch) -> None."""
+
+    def __init__(self, fn: Callable[[RecordBatch], None]):
+        self.fn = fn
+
+    def write_batch(self, batch: RecordBatch) -> None:
+        self.fn(batch)

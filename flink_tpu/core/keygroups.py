@@ -69,6 +69,35 @@ def assign_to_key_group(key_hashes: np.ndarray, max_parallelism: int) -> np.ndar
     return murmur_hash(key_hashes) % np.int32(max_parallelism)
 
 
+_string_hash_cache: dict = {}
+
+
+def java_string_hash(values: np.ndarray) -> np.ndarray:
+    """``String.hashCode`` (s[0]*31^(n-1) + ...) per element of an object array.
+
+    Cache persists across batches (hot path: keyBy on string keys re-sees the
+    same key universe every batch)."""
+    cache = _string_hash_cache
+    out = np.empty(len(values), np.int64)
+    for i, s in enumerate(values):
+        h = cache.get(s)
+        if h is None:
+            acc = 0
+            for ch in str(s):
+                acc = (acc * 31 + ord(ch)) & 0xFFFFFFFF
+            cache[s] = h = acc
+        out[i] = h
+    return out.astype(np.uint32).astype(np.int32)
+
+
+def hash_keys(keys: np.ndarray) -> np.ndarray:
+    """Key column (int or object dtype) -> int32 hashes (``Object.hashCode``)."""
+    keys = np.asarray(keys)
+    if keys.dtype.kind in "iu":
+        return java_int_hash(keys)
+    return java_string_hash(keys)
+
+
 @dataclass(frozen=True)
 class KeyGroupRange:
     """Inclusive [start, end] range of key groups (``KeyGroupRange.java``)."""

@@ -1,0 +1,15 @@
+from flink_tpu.datastream.api import (
+    DataStream,
+    DataStreamSink,
+    KeyedStream,
+    StreamExecutionEnvironment,
+    WindowedStream,
+)
+
+__all__ = [
+    "DataStream",
+    "DataStreamSink",
+    "KeyedStream",
+    "StreamExecutionEnvironment",
+    "WindowedStream",
+]

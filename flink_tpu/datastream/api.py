@@ -1,0 +1,372 @@
+"""DataStream API — the fluent program-construction surface.
+
+Analog of ``flink-streaming-java/.../api/datastream/`` +
+``StreamExecutionEnvironment.java:1873``: each call appends a
+``Transformation`` node; ``env.execute()`` translates the DAG through
+``StreamGraph`` (chaining) into an ``ExecutionPlan`` and runs it on the
+configured executor.  Records are columnar batches, so user functions are
+vectorized (columns-dict in/out) — see ``flink_tpu/operators/basic.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from flink_tpu.config.config_option import Configuration
+from flink_tpu.connectors.sinks import CollectSink, PrintSink, Sink
+from flink_tpu.connectors.sources import (CollectionSource, GeneratorSource,
+                                          IteratorSource, SocketTextSource,
+                                          Source)
+from flink_tpu.core.functions import (AggregateFunction, AvgAggregator,
+                                      CountAggregator, LambdaReduce,
+                                      MaxAggregator, MinAggregator,
+                                      ReduceFunction, SumAggregator)
+from flink_tpu.core.watermarks import (BoundedOutOfOrdernessWatermarks,
+                                       MonotonousTimestampsWatermarks,
+                                       WatermarkGenerator)
+from flink_tpu.graph.stream_graph import ExecutionPlan, StreamGraph
+from flink_tpu.graph.transformations import Partitioning, Transformation
+from flink_tpu.operators.basic import (FilterOperator, FlatMapOperator,
+                                       KeyByOperator, KeyedReduceOperator,
+                                       MapOperator, SinkOperator,
+                                       TimestampsAndWatermarksOperator)
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.runtime.executor import JobExecutionResult, LocalExecutor
+from flink_tpu.windowing.assigners import WindowAssigner
+from flink_tpu.windowing.triggers import Trigger
+
+
+class StreamExecutionEnvironment:
+    """``StreamExecutionEnvironment`` analog: source factories + execute()."""
+
+    def __init__(self, config: Optional[Configuration] = None,
+                 parallelism: int = 1, max_parallelism: int = 128):
+        self.config = config or Configuration()
+        self.parallelism = parallelism
+        self.max_parallelism = max_parallelism
+        self._sinks: List[Transformation] = []
+        self.checkpoint_interval_ms = 0
+        self.checkpoint_storage = None
+
+    @staticmethod
+    def get_execution_environment(
+            config: Optional[Configuration] = None) -> "StreamExecutionEnvironment":
+        return StreamExecutionEnvironment(config)
+
+    def set_parallelism(self, p: int) -> "StreamExecutionEnvironment":
+        self.parallelism = p
+        return self
+
+    def set_max_parallelism(self, p: int) -> "StreamExecutionEnvironment":
+        self.max_parallelism = p
+        return self
+
+    def enable_checkpointing(self, interval_ms: int,
+                             storage=None) -> "StreamExecutionEnvironment":
+        self.checkpoint_interval_ms = interval_ms
+        self.checkpoint_storage = storage
+        return self
+
+    # ------------------------------------------------------------- sources
+    def from_source(self, source: Source, name: str = "source") -> "DataStream":
+        t = Transformation(name=name, operator_factory=None, is_source=True,
+                           source=source, chainable=True,
+                           parallelism=self.parallelism,
+                           max_parallelism=self.max_parallelism)
+        # source vertices need a pass-through operator for the chain head
+        t.operator_factory = _identity_operator_factory(name)
+        return DataStream(self, t)
+
+    def from_collection(self, rows: Optional[Sequence[Mapping[str, Any]]] = None,
+                        columns: Optional[Mapping[str, Any]] = None,
+                        timestamp_column: Optional[str] = None,
+                        batch_size: int = 4096,
+                        name: str = "collection-source") -> "DataStream":
+        return self.from_source(
+            CollectionSource(rows, columns, timestamp_column, batch_size), name)
+
+    def socket_text_stream(self, host: str, port: int,
+                           batch_size: int = 4096) -> "DataStream":
+        return self.from_source(SocketTextSource(host, port, batch_size),
+                                f"socket:{host}:{port}")
+
+    def generate_sequence(self, start: int, end: int,
+                          batch_size: int = 4096) -> "DataStream":
+        return self.from_collection(
+            columns={"value": np.arange(start, end + 1, dtype=np.int64)},
+            batch_size=batch_size, name="sequence-source")
+
+    # ------------------------------------------------------------- execute
+    def _register_sink(self, t: Transformation) -> None:
+        self._sinks.append(t)
+
+    def get_stream_graph(self, job_name: str = "job") -> StreamGraph:
+        if not self._sinks:
+            raise ValueError("no sinks registered — nothing to execute")
+        return StreamGraph.from_sinks(self._sinks, self.parallelism,
+                                      self.max_parallelism, job_name)
+
+    def execute(self, job_name: str = "job",
+                restore: Optional[Dict[str, Any]] = None) -> JobExecutionResult:
+        plan = self.get_stream_graph(job_name).to_plan()
+        executor = LocalExecutor(
+            checkpoint_interval_ms=self.checkpoint_interval_ms,
+            checkpoint_storage=self.checkpoint_storage)
+        result = executor.execute(plan, restore=restore)
+        self._last_executor = executor
+        return result
+
+
+def _identity_operator_factory(name: str):
+    from flink_tpu.operators.base import StreamOperator
+
+    class _Identity(StreamOperator):
+        is_stateless = True
+
+        def process_batch(self, batch):
+            return [batch]
+
+    def make():
+        op = _Identity()
+        op.name = name
+        return op
+
+    return make
+
+
+class DataStream:
+    """Fluent stream handle appending transformations (``DataStream.java``)."""
+
+    def __init__(self, env: StreamExecutionEnvironment, transformation: Transformation):
+        self.env = env
+        self.transformation = transformation
+
+    def _then(self, name: str, factory, partitioning: str = Partitioning.FORWARD,
+              key_column: Optional[str] = None, chainable: bool = True) -> Transformation:
+        return Transformation(name=name, operator_factory=factory,
+                              inputs=[self.transformation],
+                              partitioning=partitioning,
+                              key_column=key_column, chainable=chainable,
+                              parallelism=self.env.parallelism,
+                              max_parallelism=self.env.max_parallelism)
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+            name: str = "map") -> "DataStream":
+        return DataStream(self.env, self._then(name, lambda: MapOperator(fn, name)))
+
+    def filter(self, fn: Callable[[Dict[str, Any]], np.ndarray],
+               name: str = "filter") -> "DataStream":
+        return DataStream(self.env, self._then(name, lambda: FilterOperator(fn, name)))
+
+    def flat_map(self, fn, name: str = "flat-map") -> "DataStream":
+        return DataStream(self.env, self._then(name, lambda: FlatMapOperator(fn, name)))
+
+    def assign_timestamps_and_watermarks(
+            self, generator_or_ooo: Union[WatermarkGenerator, int],
+            timestamp_column: Optional[str] = None,
+            timestamp_fn=None, name: str = "timestamps") -> "DataStream":
+        if isinstance(generator_or_ooo, WatermarkGenerator):
+            gen_proto = generator_or_ooo
+        else:
+            gen_proto = BoundedOutOfOrdernessWatermarks(int(generator_or_ooo))
+        import copy
+
+        def factory():
+            return TimestampsAndWatermarksOperator(
+                copy.deepcopy(gen_proto), timestamp_column, timestamp_fn, name)
+
+        return DataStream(self.env, self._then(name, factory))
+
+    def key_by(self, key_column: str) -> "KeyedStream":
+        t = self._then(f"key-by:{key_column}",
+                       lambda: KeyByOperator(key_column,
+                                             self.env.max_parallelism),
+                       partitioning=Partitioning.HASH, key_column=key_column)
+        return KeyedStream(self.env, t, key_column)
+
+    def union(self, *others: "DataStream") -> "DataStream":
+        t = Transformation(
+            name="union", operator_factory=_identity_operator_factory("union"),
+            inputs=[self.transformation] + [o.transformation for o in others],
+            parallelism=self.env.parallelism,
+            max_parallelism=self.env.max_parallelism)
+        return DataStream(self.env, t)
+
+    def rebalance(self) -> "DataStream":
+        t = self._then("rebalance", _identity_operator_factory("rebalance"),
+                       partitioning=Partitioning.REBALANCE, chainable=False)
+        return DataStream(self.env, t)
+
+    def broadcast(self) -> "DataStream":
+        t = self._then("broadcast", _identity_operator_factory("broadcast"),
+                       partitioning=Partitioning.BROADCAST, chainable=False)
+        return DataStream(self.env, t)
+
+    # -------------------------------------------------------------- sinks
+    def add_sink(self, sink: Sink, name: str = "sink") -> "DataStreamSink":
+        t = self._then(name, lambda: SinkOperator(sink, name))
+        t.is_sink = True
+        self.env._register_sink(t)
+        return DataStreamSink(self.env, t, sink)
+
+    sink_to = add_sink
+
+    def print(self, prefix: str = "") -> "DataStreamSink":
+        return self.add_sink(PrintSink(prefix), name="print")
+
+    def collect(self) -> CollectSink:
+        """Attach a CollectSink and return it (executeAndCollect helper)."""
+        sink = CollectSink()
+        self.add_sink(sink, name="collect")
+        return sink
+
+    def execute_and_collect(self, job_name: str = "collect-job") -> List[Dict[str, Any]]:
+        sink = self.collect()
+        self.env.execute(job_name)
+        return sink.rows()
+
+
+class DataStreamSink:
+    def __init__(self, env: StreamExecutionEnvironment, transformation: Transformation,
+                 sink: Sink):
+        self.env = env
+        self.transformation = transformation
+        self.sink = sink
+
+    def name(self, name: str) -> "DataStreamSink":
+        self.transformation.name = name
+        return self
+
+    def uid(self, uid: str) -> "DataStreamSink":
+        self.transformation.uid = uid
+        return self
+
+
+class KeyedStream(DataStream):
+    """``KeyedStream.java`` analog: windowing + keyed aggregations."""
+
+    def __init__(self, env: StreamExecutionEnvironment, transformation: Transformation,
+                 key_column: str):
+        super().__init__(env, transformation)
+        self.key_column = key_column
+
+    def window(self, assigner: WindowAssigner) -> "WindowedStream":
+        return WindowedStream(self, assigner)
+
+    def reduce(self, fn: Union[ReduceFunction, Callable], identity_value=None,
+               value_column: Optional[str] = None,
+               output_column: str = "result") -> "DataStream":
+        agg = fn if isinstance(fn, ReduceFunction) else LambdaReduce(fn, identity_value)
+        key_col = self.key_column
+
+        def factory():
+            return KeyedReduceOperator(agg, key_col, value_column, output_column)
+
+        return DataStream(self.env, self._then("keyed-reduce", factory))
+
+    def sum(self, value_column: str, output_column: Optional[str] = None,
+            dtype=None) -> "DataStream":
+        import jax.numpy as jnp
+        agg = SumAggregator(dtype or jnp.float64)
+        return self.reduce(agg, value_column=value_column,
+                           output_column=output_column or value_column)
+
+    def min(self, value_column: str, output_column: Optional[str] = None,
+            dtype=None) -> "DataStream":
+        import jax.numpy as jnp
+        agg = MinAggregator(dtype or jnp.float64)
+        return self.reduce(agg, value_column=value_column,
+                           output_column=output_column or value_column)
+
+    def max(self, value_column: str, output_column: Optional[str] = None,
+            dtype=None) -> "DataStream":
+        import jax.numpy as jnp
+        agg = MaxAggregator(dtype or jnp.float64)
+        return self.reduce(agg, value_column=value_column,
+                           output_column=output_column or value_column)
+
+
+class WindowedStream:
+    """``WindowedStream.java`` analog (``reduce:162``, ``aggregate:283``)."""
+
+    def __init__(self, keyed: KeyedStream, assigner: WindowAssigner):
+        self.keyed = keyed
+        self.assigner = assigner
+        self._trigger: Optional[Trigger] = None
+        self._allowed_lateness = 0
+
+    def trigger(self, trigger: Trigger) -> "WindowedStream":
+        self._trigger = trigger
+        return self
+
+    def allowed_lateness(self, ms: int) -> "WindowedStream":
+        self._allowed_lateness = ms
+        return self
+
+    def aggregate(self, agg: AggregateFunction,
+                  value_column: Optional[str] = None,
+                  value_selector=None,
+                  output_column: str = "result",
+                  name: str = "window-agg") -> DataStream:
+        keyed, assigner = self.keyed, self.assigner
+        trigger, lateness = self._trigger, self._allowed_lateness
+
+        def factory():
+            return WindowAggOperator(
+                assigner=assigner, agg=agg, key_column=keyed.key_column,
+                value_column=value_column, value_selector=value_selector,
+                allowed_lateness_ms=lateness, trigger=trigger,
+                output_column=output_column, name=name)
+
+        t = keyed._then(name, factory)
+        return DataStream(keyed.env, t)
+
+    def reduce(self, fn: Union[ReduceFunction, Callable], identity_value=None,
+               value_column: Optional[str] = None,
+               output_column: str = "result") -> DataStream:
+        agg = fn if isinstance(fn, ReduceFunction) else LambdaReduce(fn, identity_value)
+        return self.aggregate(agg, value_column=value_column,
+                              output_column=output_column, name="window-reduce")
+
+    def sum(self, value_column: str, output_column: Optional[str] = None,
+            dtype=None) -> DataStream:
+        import jax.numpy as jnp
+        return self.aggregate(SumAggregator(dtype or jnp.float64),
+                              value_column=value_column,
+                              output_column=output_column or value_column,
+                              name="window-sum")
+
+    def min(self, value_column: str, output_column: Optional[str] = None,
+            dtype=None) -> DataStream:
+        import jax.numpy as jnp
+        return self.aggregate(MinAggregator(dtype or jnp.float64),
+                              value_column=value_column,
+                              output_column=output_column or value_column,
+                              name="window-min")
+
+    def max(self, value_column: str, output_column: Optional[str] = None,
+            dtype=None) -> DataStream:
+        import jax.numpy as jnp
+        return self.aggregate(MaxAggregator(dtype or jnp.float64),
+                              value_column=value_column,
+                              output_column=output_column or value_column,
+                              name="window-max")
+
+    def count(self, output_column: str = "count") -> DataStream:
+        def ones(cols):
+            n = len(np.asarray(next(iter(cols.values()))))
+            return np.ones(n, np.int32)
+
+        return self.aggregate(CountAggregator(), value_column=None,
+                              value_selector=ones,
+                              output_column=output_column, name="window-count")
+
+    def avg(self, value_column: str, output_column: Optional[str] = None,
+            dtype=None) -> DataStream:
+        import jax.numpy as jnp
+        return self.aggregate(AvgAggregator(dtype or jnp.float64),
+                              value_column=value_column,
+                              output_column=output_column or value_column,
+                              name="window-avg")

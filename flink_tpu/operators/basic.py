@@ -1,0 +1,273 @@
+"""Basic stream operators: map/filter/flatMap, keyBy, timestamps/watermarks,
+keyed running reduce, and sinks — all batched.
+
+Analogs: ``StreamMap``/``StreamFilter``/``StreamFlatMap``
+(``flink-streaming-java/.../api/operators/``), the keying side of
+``KeyedStream.java`` + ``KeyGroupStreamPartitioner``,
+``TimestampsAndWatermarksOperator.java``, ``StreamGroupedReduceOperator``.
+Each processes a whole ``RecordBatch`` per call; jax-traceable map/filter
+bodies fuse into the surrounding device step (operator chaining,
+``OperatorChain.java:88`` — on TPU, XLA does the fusing).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core import keygroups
+from flink_tpu.core.batch import RecordBatch, StreamElement, Watermark
+from flink_tpu.core.functions import AggregateFunction, RuntimeContext
+from flink_tpu.core.watermarks import WatermarkGenerator
+from flink_tpu.operators.base import StreamOperator
+from flink_tpu.ops.scatter import segment_running_fold
+from flink_tpu.state.keyindex import make_key_index
+
+
+class MapOperator(StreamOperator):
+    """Vectorized map: fn(columns dict) -> columns dict (row-aligned)."""
+
+    is_stateless = True
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+                 name: str = "map"):
+        self.fn = fn
+        self.name = name
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        return [batch.with_columns(self.fn(dict(batch.columns)))]
+
+
+class FilterOperator(StreamOperator):
+    """Vectorized filter: fn(columns) -> bool mask [B]."""
+
+    is_stateless = True
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], np.ndarray],
+                 name: str = "filter"):
+        self.fn = fn
+        self.name = name
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        mask = np.asarray(self.fn(dict(batch.columns)))
+        if mask.all():
+            return [batch]
+        return [batch.select(mask)]
+
+
+class FlatMapOperator(StreamOperator):
+    """Vectorized flatMap: fn(columns) -> (new_columns, src_row_indices).
+
+    ``src_row_indices`` (int array, len = output rows) says which input row
+    produced each output row, so timestamps/keys propagate correctly.
+    """
+
+    is_stateless = True
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any], name: str = "flat-map"):
+        self.fn = fn
+        self.name = name
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        cols, src = self.fn(dict(batch.columns))
+        src = np.asarray(src)
+        ts = None if batch.timestamps is None else np.asarray(batch.timestamps)[src]
+        kid = None if batch.key_ids is None else np.asarray(batch.key_ids)[src]
+        kg = None if batch.key_groups is None else np.asarray(batch.key_groups)[src]
+        return [RecordBatch(cols, ts, kid, kg)]
+
+
+class KeyByOperator(StreamOperator):
+    """Attach key-group routing metadata (``KeyGroupStreamPartitioner`` analog).
+
+    Computes ``key_group = murmur(hash(key)) % max_parallelism`` per record —
+    the unit both network routing and state sharding agree on, so rescaling
+    moves whole key-group ranges (``KeyGroupRangeAssignment.java:50-84``).
+    Dense per-key slot ids stay owned by the downstream stateful operator.
+    """
+
+    is_stateless = True
+
+    def __init__(self, key_column: str, max_parallelism: int = 128,
+                 name: str = "key-by"):
+        self.key_column = key_column
+        self.max_parallelism = max_parallelism
+        self.name = name
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        keys = np.asarray(batch.column(self.key_column))
+        kg = keygroups.assign_to_key_group(keygroups.hash_keys(keys),
+                                           self.max_parallelism)
+        return [batch.with_keys(batch.key_ids, kg)]
+
+
+class TimestampsAndWatermarksOperator(StreamOperator):
+    """Extract event timestamps + emit watermarks
+    (``TimestampsAndWatermarksOperator.java`` analog, batched: the generator
+    sees each batch's timestamp column once)."""
+
+    def __init__(self, generator: WatermarkGenerator,
+                 timestamp_column: Optional[str] = None,
+                 timestamp_fn: Optional[Callable[[Dict[str, Any]], np.ndarray]] = None,
+                 name: str = "timestamps-watermarks"):
+        self.generator = generator
+        self.timestamp_column = timestamp_column
+        self.timestamp_fn = timestamp_fn
+        self.name = name
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if self.timestamp_fn is not None:
+            ts = np.asarray(self.timestamp_fn(dict(batch.columns)), np.int64)
+        elif self.timestamp_column is not None:
+            ts = np.asarray(batch.column(self.timestamp_column), np.int64)
+        else:
+            ts = batch.timestamps
+        out: List[StreamElement] = [batch.with_timestamps(ts)]
+        wm = self.generator.on_batch(ts)
+        if wm is not None:
+            out.append(Watermark(wm))
+        return out
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        # Upstream watermarks are ignored: this operator owns event time now
+        # (same as the reference implementation, which only forwards MAX).
+        return []
+
+
+class KeyedReduceOperator(StreamOperator):
+    """``keyBy().reduce(fn)`` — emits the running per-key fold for EVERY input
+    record (``StreamGroupedReduceOperator`` semantics), computed batched:
+
+    sort batch by dense key slot -> segmented inclusive ``associative_scan``
+    -> combine each row's in-batch prefix with the key's persisted accumulator
+    -> un-sort.  One jitted device step per batch instead of a per-record
+    state-map probe (SURVEY §3.3 hot loop (c)).
+    """
+
+    def __init__(self, agg: AggregateFunction, key_column: str,
+                 value_column: Optional[str] = None,
+                 output_column: str = "result",
+                 initial_key_capacity: int = 1 << 10,
+                 name: str = "keyed-reduce"):
+        self.agg = agg
+        self.key_column = key_column
+        self.value_column = value_column
+        self.output_column = output_column
+        self.name = name
+        self.spec = agg.acc_spec()
+        self._K = max(1 << 10, initial_key_capacity)
+        self.key_index = None
+        self._leaves = None
+
+    def _ensure(self, keys: np.ndarray):
+        if self.key_index is None:
+            self.key_index = make_key_index(keys[0] if keys.ndim else keys)
+
+    def _alloc(self, K: int):
+        return tuple(
+            jnp.broadcast_to(jnp.asarray(init, dtype), (K,) + tuple(shape)).copy()
+            for init, shape, dtype in zip(self.spec.leaf_inits, self.spec.leaf_shapes,
+                                          self.spec.leaf_dtypes))
+
+    @partial(jax.jit, static_argnums=0)
+    def _step(self, leaves, slot_ids, values):
+        lifted = tuple(jax.tree_util.tree_leaves(self.agg.lift(values)))
+        order, sids, is_end, prefix = segment_running_fold(
+            slot_ids, lifted, self.agg.combine_leaves)
+        K = leaves[0].shape[0]
+        current = tuple(l[jnp.minimum(sids, K - 1)] for l in leaves)
+        running = self.agg.combine_leaves(current, prefix)
+        write_ids = jnp.where(is_end, sids, K)
+        new_leaves = tuple(
+            l.at[write_ids].set(r.astype(l.dtype), mode="drop")
+            for l, r in zip(leaves, running))
+        # un-sort the running values back to input row order
+        inv = jnp.argsort(order)
+        out = self.agg.get_result(self.spec.unflatten(
+            tuple(r[inv] for r in running)))
+        return new_leaves, out
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        keys = np.asarray(batch.column(self.key_column))
+        self._ensure(keys)
+        slot_ids = self.key_index.lookup_or_insert(keys)
+        if self._leaves is None:
+            self._leaves = self._alloc(self._K)
+        while self.key_index.num_keys > self._K:
+            newK = self._K * 2
+            grown = self._alloc(newK)
+            self._leaves = tuple(g.at[: self._K].set(l)
+                                 for g, l in zip(grown, self._leaves))
+            self._K = newK
+        values = (batch.column(self.value_column) if self.value_column
+                  else dict(batch.columns))
+        self._leaves, out = self._step(self._leaves,
+                                       jnp.asarray(slot_ids, jnp.int32), values)
+        cols = dict(batch.columns)
+        if isinstance(out, dict):
+            cols.update({k: np.asarray(v) for k, v in out.items()})
+        else:
+            cols[self.output_column] = np.asarray(out)
+        return [RecordBatch(cols, batch.timestamps, batch.key_ids, batch.key_groups)]
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        if self.key_index is None:
+            return {"empty": True}
+        return {
+            "empty": False,
+            "keys": self.key_index.snapshot(),
+            "key_index_kind": type(self.key_index).__name__,
+            "leaves": [np.asarray(l)[: self.key_index.num_keys]
+                       for l in self._leaves],
+        }
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex
+        if snap.get("empty", True):
+            return
+        cls = (ObjectKeyIndex if snap["key_index_kind"] == "ObjectKeyIndex"
+               else KeyIndex)
+        self.key_index = cls.restore(snap["keys"])
+        while self._K < self.key_index.num_keys:
+            self._K *= 2
+        self._leaves = self._alloc(self._K)
+        n = snap["leaves"][0].shape[0]
+        self._leaves = tuple(l.at[:n].set(jnp.asarray(s))
+                             for l, s in zip(self._leaves, snap["leaves"]))
+
+
+class SinkOperator(StreamOperator):
+    """Terminal operator wrapping a sink function (``StreamSink`` analog)."""
+
+    def __init__(self, sink, name: str = "sink"):
+        self.sink = sink
+        self.name = name
+
+    def open(self, ctx: RuntimeContext) -> None:
+        super().open(ctx)
+        if hasattr(self.sink, "open"):
+            self.sink.open(ctx)
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        self.sink.write_batch(batch)
+        return []
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        if hasattr(self.sink, "on_watermark"):
+            self.sink.on_watermark(watermark.timestamp)
+        return []
+
+    def end_input(self) -> List[StreamElement]:
+        if hasattr(self.sink, "flush"):
+            self.sink.flush()
+        return []
+
+    def close(self) -> None:
+        if hasattr(self.sink, "close"):
+            self.sink.close()

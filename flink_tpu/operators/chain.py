@@ -1,0 +1,80 @@
+"""Operator chain: fused execution of consecutive same-parallelism operators.
+
+Analog of ``OperatorChain.java:88`` — chained outputs are direct calls, no
+re-batching or serialization between chain members.  Control elements
+(watermarks, processing time, end-of-input) are threaded through every member
+in order, with each member's emissions delivered to the next *before* the
+control element itself — the same ordering the reference's
+``ChainingOutput`` + in-band control flow guarantees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from flink_tpu.core.batch import RecordBatch, StreamElement, Watermark
+from flink_tpu.core.functions import RuntimeContext
+from flink_tpu.operators.base import StreamOperator
+
+
+class ChainedOperator(StreamOperator):
+    def __init__(self, operators: List[StreamOperator], name: str = "chain"):
+        self.operators = operators
+        self.name = name
+        self.is_stateless = all(op.is_stateless for op in operators)
+
+    def open(self, ctx: RuntimeContext) -> None:
+        super().open(ctx)
+        for op in self.operators:
+            op.open(ctx)
+
+    def _feed(self, start: int, elements: List[StreamElement]) -> List[StreamElement]:
+        """Push elements through chain members [start:]; returns chain output."""
+        for op in self.operators[start:]:
+            nxt: List[StreamElement] = []
+            for el in elements:
+                if isinstance(el, RecordBatch):
+                    nxt.extend(op.process_batch(el))
+                elif isinstance(el, Watermark):
+                    nxt.extend(op.process_watermark(el))
+                    nxt.append(el)
+                else:
+                    nxt.append(el)
+            elements = nxt
+        return elements
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        return self._feed(0, [batch])
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        # Deliver to member i, push its fires through members i+1.., then move
+        # the watermark itself to member i+1.  The executor appends the
+        # watermark downstream after this returns.
+        out: List[StreamElement] = []
+        for i, op in enumerate(self.operators):
+            out.extend(self._feed(i + 1, op.process_watermark(watermark)))
+        return out
+
+    def on_processing_time(self, timestamp_ms: int) -> List[StreamElement]:
+        out: List[StreamElement] = []
+        for i, op in enumerate(self.operators):
+            out.extend(self._feed(i + 1, op.on_processing_time(timestamp_ms)))
+        return out
+
+    def end_input(self) -> List[StreamElement]:
+        out: List[StreamElement] = []
+        for i, op in enumerate(self.operators):
+            out.extend(self._feed(i + 1, op.end_input()))
+        return out
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {f"op{i}": op.snapshot_state() for i, op in enumerate(self.operators)}
+
+    def restore_state(self, snapshot: Dict[str, Any]) -> None:
+        for i, op in enumerate(self.operators):
+            if f"op{i}" in snapshot:
+                op.restore_state(snapshot[f"op{i}"])
+
+    def close(self) -> None:
+        for op in self.operators:
+            op.close()

@@ -91,7 +91,17 @@ class WindowAggOperator(StreamOperator):
         else:
             self._select = lambda cols: cols
         self.lateness = int(allowed_lateness_ms)
-        self.trigger = trigger or EventTimeTrigger()
+        if trigger is None:
+            # GlobalWindows defaults to NeverTrigger (GlobalWindows.java
+            # getDefaultTrigger); time windows default to EventTimeTrigger.
+            from flink_tpu.windowing.triggers import NeverTrigger
+            trigger = (NeverTrigger() if isinstance(assigner, GlobalWindows)
+                       else EventTimeTrigger())
+        if trigger.fires_on_count and not isinstance(assigner, GlobalWindows):
+            raise NotImplementedError(
+                "CountTrigger over time-window assigners is not supported yet; "
+                "use GlobalWindows (countWindow) or a time trigger")
+        self.trigger = trigger
         self.output_column = output_column
         self.emit_window_bounds = emit_window_bounds
         self.name = name
@@ -311,11 +321,17 @@ class WindowAggOperator(StreamOperator):
         return self._advance_time(self._proc_time)
 
     def end_input(self) -> List[StreamElement]:
-        """Bounded input: fire everything outstanding (MAX_WATERMARK analog)."""
+        """Bounded input: fire everything outstanding (MAX_WATERMARK analog).
+
+        GlobalWindows: EventTimeTrigger fires at MAX_WATERMARK (GlobalWindow
+        maxTimestamp == Long.MAX_VALUE); NeverTrigger and partial count
+        windows emit nothing — matching the reference, where a trailing
+        partial countWindow is dropped at end of input."""
         if isinstance(self.assigner, GlobalWindows):
-            return self._fire_by_count(force=True)
-        out = self._advance_time(2 ** 62)
-        return out
+            if self.trigger.fires_on_time:
+                return self._fire_by_count(force=True)
+            return []
+        return self._advance_time(2 ** 62)
 
     def _now_ms(self) -> int:
         import time
@@ -326,6 +342,8 @@ class WindowAggOperator(StreamOperator):
         if self._leaves is None or self.pane_base is None:
             return []
         a = self.assigner
+        if isinstance(a, GlobalWindows):  # no time-bounded panes to fire
+            return []
         out: List[StreamElement] = []
         # largest w whose maxTimestamp (= end-1) has been passed — the fire
         # condition of EventTimeTrigger: watermark >= window.maxTimestamp
@@ -388,6 +406,8 @@ class WindowAggOperator(StreamOperator):
         thr = 1 if force else self.trigger.count_threshold
         counts0 = self._counts[:, 0]
         mask = counts0 >= thr
+        if not bool(mask.any()):  # cheap pre-check: skip the K-wide assembly
+            return []
         pane_slots = jnp.zeros((1,), jnp.int32)
         m, result = self._fire_step(self._leaves, self._counts, pane_slots)
         mask = mask & m

@@ -58,13 +58,27 @@ def scatter_fast(state_leaves, slot_ids, lifted_leaves, kinds: Sequence[str]):
     return tuple(out)
 
 
-def segment_fold(slot_ids, lifted_leaves, combine_leaves: Callable, num_slots: int):
+def segment_fold(slot_ids, lifted_leaves, combine_leaves: Callable,
+                 num_slots: int = 0):
     """Generic per-batch segment reduction: returns (unique_slot_ids[B],
     is_segment_end[B], folded_leaves[B, ...]) where rows flagged as segment
     ends hold the full fold of their slot's records in this batch.
 
     combine_leaves(a_leaves, b_leaves) -> leaves; must be associative +
     commutative per the ``AggregateFunction.merge`` contract.
+    """
+    _, sids, is_end, folded = segment_running_fold(slot_ids, lifted_leaves,
+                                                   combine_leaves)
+    return sids, is_end, folded
+
+
+def segment_running_fold(slot_ids, lifted_leaves, combine_leaves: Callable):
+    """Per-record *running* segment fold (keyed ``reduce()`` semantics:
+    every input record emits its key's fold-so-far within the batch).
+
+    Returns (order[B], sids[B], is_end[B], prefix_leaves[B, ...]) where
+    ``prefix_leaves[i]`` is the inclusive fold of sorted rows of the same slot
+    up to i; ``order`` maps sorted position -> original row.
     """
     order = jnp.argsort(slot_ids)
     sids = slot_ids[order]
@@ -82,9 +96,8 @@ def segment_fold(slot_ids, lifted_leaves, combine_leaves: Callable, num_slots: i
         return (fa | fb,) + vals
 
     scanned = jax.lax.associative_scan(seg_op, (first,) + svals)
-    folded = scanned[1:]
     is_end = jnp.concatenate([sids[1:] != sids[:-1], jnp.ones((1,), bool)])
-    return sids, is_end, folded
+    return order, sids, is_end, scanned[1:]
 
 
 def scatter_generic(state_leaves, slot_ids, lifted_leaves,
