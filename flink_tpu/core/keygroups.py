@@ -70,13 +70,17 @@ def assign_to_key_group(key_hashes: np.ndarray, max_parallelism: int) -> np.ndar
 
 
 _string_hash_cache: dict = {}
+_STRING_HASH_CACHE_MAX = 1 << 22  # bound: reset rather than leak unboundedly
 
 
 def java_string_hash(values: np.ndarray) -> np.ndarray:
     """``String.hashCode`` (s[0]*31^(n-1) + ...) per element of an object array.
 
     Cache persists across batches (hot path: keyBy on string keys re-sees the
-    same key universe every batch)."""
+    same key universe every batch); size-bounded against high-cardinality
+    streams."""
+    if len(_string_hash_cache) > _STRING_HASH_CACHE_MAX:
+        _string_hash_cache.clear()
     cache = _string_hash_cache
     out = np.empty(len(values), np.int64)
     for i, s in enumerate(values):

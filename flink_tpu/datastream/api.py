@@ -109,11 +109,14 @@ class StreamExecutionEnvironment:
                                       self.max_parallelism, job_name)
 
     def execute(self, job_name: str = "job",
-                restore: Optional[Dict[str, Any]] = None) -> JobExecutionResult:
+                restore: Optional[Dict[str, Any]] = None,
+                max_records: Optional[int] = None,
+                max_wall_ms: Optional[int] = None) -> JobExecutionResult:
         plan = self.get_stream_graph(job_name).to_plan()
         executor = LocalExecutor(
             checkpoint_interval_ms=self.checkpoint_interval_ms,
-            checkpoint_storage=self.checkpoint_storage)
+            checkpoint_storage=self.checkpoint_storage,
+            max_records=max_records, max_wall_ms=max_wall_ms)
         result = executor.execute(plan, restore=restore)
         self._last_executor = executor
         return result

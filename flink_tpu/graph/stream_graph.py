@@ -51,6 +51,7 @@ class PlanVertex:
     is_source: bool
     out_edges: List[StreamEdge] = field(default_factory=list)  # target = vertex id
     in_degree: int = 0
+    topo_index: int = -1  # assigned by ExecutionPlan; stable across rebuilds
 
     def build_operator(self) -> StreamOperator:
         ops = [t.operator_factory() for t in self.chain if t.operator_factory]
@@ -60,7 +61,14 @@ class PlanVertex:
 
     @property
     def uid(self) -> str:
-        return self.chain[0].uid or f"vertex-{self.name}-{self.id}"
+        """Stable operator id for snapshot mapping (``uid()`` analog): an
+        explicit uid on any chain member wins; otherwise topo-position + chain
+        name, which is identical for identically-built pipelines (unlike the
+        process-global transformation counter)."""
+        for t in self.chain:
+            if t.uid:
+                return t.uid
+        return f"v{self.topo_index}:{self.name}"
 
 
 class StreamGraph:
@@ -170,6 +178,8 @@ class ExecutionPlan:
     def __post_init__(self):
         self.vertices = self._topo_sort(self.vertices)
         self.by_id = {v.id: v for v in self.vertices}
+        for i, v in enumerate(self.vertices):
+            v.topo_index = i
 
     @staticmethod
     def _topo_sort(vertices: List[PlanVertex]) -> List[PlanVertex]:

@@ -31,6 +31,9 @@ class StreamOperator:
     #: operators that only transform rows (no state/time) are chainable into
     #: the surrounding jitted step (``OperatorChain.java:88`` analog)
     is_stateless: bool = False
+    #: False for operators that OWN event time (TimestampsAndWatermarks): the
+    #: executor/chain must not forward upstream watermarks past them
+    forwards_watermarks: bool = True
 
     def open(self, ctx: RuntimeContext) -> None:
         self.ctx = ctx

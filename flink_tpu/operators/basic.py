@@ -110,6 +110,8 @@ class TimestampsAndWatermarksOperator(StreamOperator):
     (``TimestampsAndWatermarksOperator.java`` analog, batched: the generator
     sees each batch's timestamp column once)."""
 
+    forwards_watermarks = False  # this operator owns event time downstream
+
     def __init__(self, generator: WatermarkGenerator,
                  timestamp_column: Optional[str] = None,
                  timestamp_fn: Optional[Callable[[Dict[str, Any]], np.ndarray]] = None,
@@ -133,8 +135,13 @@ class TimestampsAndWatermarksOperator(StreamOperator):
         return out
 
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
-        # Upstream watermarks are ignored: this operator owns event time now
-        # (same as the reference implementation, which only forwards MAX).
+        # Upstream watermarks are ignored — this operator owns event time —
+        # EXCEPT MAX_WATERMARK (end of input), which is forwarded so bounded
+        # jobs flush (reference: TimestampsAndWatermarksOperator.java
+        # processWatermark, which passes only Long.MAX_VALUE through).
+        from flink_tpu.core.batch import MAX_WATERMARK
+        if watermark.timestamp >= MAX_WATERMARK:
+            return [Watermark(MAX_WATERMARK)]
         return []
 
 

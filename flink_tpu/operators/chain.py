@@ -22,6 +22,7 @@ class ChainedOperator(StreamOperator):
         self.operators = operators
         self.name = name
         self.is_stateless = all(op.is_stateless for op in operators)
+        self.forwards_watermarks = all(op.forwards_watermarks for op in operators)
 
     def open(self, ctx: RuntimeContext) -> None:
         super().open(ctx)
@@ -37,7 +38,8 @@ class ChainedOperator(StreamOperator):
                     nxt.extend(op.process_batch(el))
                 elif isinstance(el, Watermark):
                     nxt.extend(op.process_watermark(el))
-                    nxt.append(el)
+                    if op.forwards_watermarks:
+                        nxt.append(el)
                 else:
                     nxt.append(el)
             elements = nxt
@@ -48,11 +50,14 @@ class ChainedOperator(StreamOperator):
 
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
         # Deliver to member i, push its fires through members i+1.., then move
-        # the watermark itself to member i+1.  The executor appends the
-        # watermark downstream after this returns.
+        # the watermark itself to member i+1 (unless member i owns event time
+        # and blocks it).  The executor appends the watermark downstream after
+        # this returns, gated on self.forwards_watermarks.
         out: List[StreamElement] = []
         for i, op in enumerate(self.operators):
             out.extend(self._feed(i + 1, op.process_watermark(watermark)))
+            if not op.forwards_watermarks:
+                break
         return out
 
     def on_processing_time(self, timestamp_ms: int) -> List[StreamElement]:

@@ -79,6 +79,7 @@ class WindowAggOperator(StreamOperator):
         initial_panes: int = 16,
         max_batch: int = 1 << 16,
         name: str = "window-agg",
+        sharding=None,
     ):
         self.assigner = assigner
         self.agg = agg
@@ -115,6 +116,19 @@ class WindowAggOperator(StreamOperator):
         self._P = _next_pow2(max(initial_panes, 2 * assigner.panes_per_window))
         self._K = _next_pow2(initial_key_capacity)
 
+        #: jax.sharding.Sharding for state arrays ([K, P, ...] sharded over the
+        #: key-slot dim = key-group axis, SURVEY §7.1).  The jitted steps are
+        #: placement-agnostic: XLA's SPMD partitioner splits the scatters per
+        #: shard (indices replicated, out-of-range rows dropped locally), so
+        #: multi-chip is pure data placement — no kernel changes.
+        self.sharding = sharding
+        # shard count must divide K for even state splits: round K up to
+        # lcm(K, n_shards); doubling growth preserves divisibility after that
+        if sharding is not None:
+            import math
+            nsh = max(len(sharding.mesh.devices.reshape(-1))
+                      if hasattr(sharding, "mesh") else 1, 1)
+            self._K = self._K * nsh // math.gcd(self._K, nsh)
         self.key_index: Optional[KeyIndex | ObjectKeyIndex] = None
         self._leaves = None          # tuple of [K, P, *leaf] device arrays
         self._counts = None          # int32 [K, P]
@@ -125,13 +139,31 @@ class WindowAggOperator(StreamOperator):
         self.late_dropped: int = 0   # beyond-lateness drop counter (numRecordsDropped)
         self._proc_time: int = LONG_MIN
 
+    def reset_state(self) -> None:
+        """Drop all keyed state/time progress but KEEP compiled steps (the
+        jit caches key on this instance).  Used by benchmarks/tests to re-run
+        a warm operator, and by restore paths before loading a snapshot."""
+        self.key_index = None
+        self._leaves = None
+        self._counts = None
+        self.pane_base = None
+        self.max_pane = None
+        self.last_fired_window = None
+        self.watermark = LONG_MIN
+        self.late_dropped = 0
+        self._proc_time = LONG_MIN
+
     # ------------------------------------------------------------------ state
     def _alloc(self, K: int, P: int):
         leaves = []
         for init, shape, dtype in zip(self.spec.leaf_inits, self.spec.leaf_shapes,
                                       self.spec.leaf_dtypes):
             leaves.append(jnp.broadcast_to(jnp.asarray(init, dtype), (K, P) + tuple(shape)).copy())
-        return tuple(leaves), jnp.zeros((K, P), jnp.int32)
+        counts = jnp.zeros((K, P), jnp.int32)
+        if self.sharding is not None:
+            leaves = [jax.device_put(l, self.sharding) for l in leaves]
+            counts = jax.device_put(counts, self.sharding)
+        return tuple(leaves), counts
 
     def _ensure_alloc(self):
         if self._leaves is None:
@@ -175,7 +207,7 @@ class WindowAggOperator(StreamOperator):
 
     # ------------------------------------------------------------- device ops
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
-    def _update_step(self, leaves, counts, flat_ids, values, ones):
+    def _update_step(self, leaves, counts, flat_ids, values):
         """One micro-batch fold: lift + scatter-combine. flat_ids ∈ [0, K*P]
         with K*P meaning 'dropped padding row'."""
         K, P = counts.shape
@@ -187,17 +219,135 @@ class WindowAggOperator(StreamOperator):
             new_flat = scatter_generic(flat_leaves, flat_ids, lifted,
                                        self.agg.combine_leaves, K * P)
         new_leaves = tuple(l.reshape((K, P) + l.shape[1:]) for l in new_flat)
+        ones = jnp.ones(flat_ids.shape, jnp.int32)  # device-side: keeps the
+        # host→device upload to ids+values only (tunnel bandwidth-bound)
         new_counts = counts.reshape(K * P).at[flat_ids].add(ones, mode="drop").reshape(K, P)
         return new_leaves, new_counts
 
-    @partial(jax.jit, static_argnums=(0,))
-    def _fire_step(self, leaves, counts, pane_slots):
-        """Assemble one window from its panes: combine + get_result + mask."""
+    def _fire_core(self, leaves, counts, pane_slots, k_active: int):
+        """Shared fire body: slice live rows, gather window panes, combine,
+        get_result.  k_active (static): only the first k_active key rows are
+        live — slicing inside the jit lets XLA fuse slice+gather, so fire cost
+        scales with live keys, not allocated capacity."""
+        if k_active and k_active < counts.shape[0]:
+            leaves = tuple(jax.lax.slice_in_dim(l, 0, k_active, axis=0)
+                           for l in leaves)
+            counts = jax.lax.slice_in_dim(counts, 0, k_active, axis=0)
         sel = tuple(jnp.take(l, pane_slots, axis=1) for l in leaves)
         total = jnp.take(counts, pane_slots, axis=1).sum(axis=1)
         combined = combine_along_axis(sel, self.agg.combine_leaves, axis=1)
         result = self.agg.get_result(self.spec.unflatten(combined))
         return total > 0, result
+
+    @partial(jax.jit, static_argnums=(0, 4))
+    def _fire_step(self, leaves, counts, pane_slots, k_active: int):
+        return self._fire_core(leaves, counts, pane_slots, k_active)
+
+    def _k_active(self) -> int:
+        """Static pow2 bound on live key rows (0 = use full capacity).
+        Sharded state skips slicing: the slice would break even row
+        distribution across devices."""
+        if self.sharding is not None or self.key_index is None:
+            return 0
+        # ×4 growth steps: every distinct value is one XLA compile of the fire
+        # step — coarse quantization caps the compile count at ~5 per run
+        ka = 4096
+        while ka < self.key_index.num_keys:
+            ka <<= 2
+        return min(ka, self._K)
+
+    @partial(jax.jit, static_argnums=(0, 4, 5))
+    def _fire_pack_step(self, leaves, counts, pane_slots, k_active: int,
+                        cap: int):
+        """Fire + device-side emit compaction: ONE packed int32 download of
+        [1 + cap + cap*row_words]: [count, nonzero key slots (padded), result
+        rows bitcast to i32].  Host↔device traffic per fire scales with rows
+        *emitted*, not allocated key capacity — the transfer-bound analog of
+        the reference emitting only non-empty windows
+        (``WindowOperator.emitWindowContents:574``)."""
+        mask, result = self._fire_core(leaves, counts, pane_slots, k_active)
+        K = k_active if (k_active and k_active < counts.shape[0]) else counts.shape[0]
+        n = jnp.sum(mask).astype(jnp.int32)
+        (idx,) = jnp.nonzero(mask, size=cap, fill_value=K)
+        parts = [n.reshape(1), idx.astype(jnp.int32)]
+        for l in jax.tree_util.tree_leaves(result):
+            g = jnp.take(l, jnp.minimum(idx, K - 1), axis=0)
+            g = g.reshape(cap, -1)
+            if g.dtype != jnp.int32:
+                if g.dtype.itemsize != 4:
+                    g = g.astype(jnp.float32)
+                g = jax.lax.bitcast_convert_type(g, jnp.int32)
+            parts.append(g.reshape(-1))
+        return jnp.concatenate(parts)
+
+    def _result_layout(self):
+        """(treedef, [(shape, dtype)]) of one result row — cached eval_shape."""
+        cached = getattr(self, "_result_layout_cache", None)
+        if cached is None:
+            def one(leaves):
+                combined = combine_along_axis(
+                    tuple(l[:, None] for l in leaves), self.agg.combine_leaves,
+                    axis=1)
+                return self.agg.get_result(self.spec.unflatten(combined))
+            dummies = tuple(
+                jax.ShapeDtypeStruct((1,) + tuple(s), d)
+                for s, d in zip(self.spec.leaf_shapes, self.spec.leaf_dtypes))
+            out = jax.eval_shape(one, dummies)
+            leaves, treedef = jax.tree_util.tree_flatten(out)
+            cached = (treedef, [(l.shape[1:], np.dtype(l.dtype)) for l in leaves])
+            self._result_layout_cache = cached
+        return cached
+
+    def _fire_window_packed(self, window_id: int,
+                            pane_slots) -> List[StreamElement]:
+        """Transfer-efficient fire for unsharded state (packed download with
+        capacity doubling; falls back to full width when the emit overflows)."""
+        ka = self._k_active() or self._K
+        # cap derives from ka (one compile per ka step), boosted ×4 on
+        # overflow — grow-only, so compiles stay O(log) over the run
+        boost = getattr(self, "_emit_boost", 1)
+        cap = min(ka, max(1024, (ka >> 3) * boost))
+        treedef, row_layout = self._result_layout()
+        packed = np.asarray(self._fire_pack_step(
+            self._leaves, self._counts, pane_slots, self._k_active(), cap))
+        n = int(packed[0])
+        while n > cap and cap < ka:  # overflow: boost and retry
+            boost = self._emit_boost = boost * 4
+            cap = min(ka, max(1024, (ka >> 3) * boost))
+            packed = np.asarray(self._fire_pack_step(
+                self._leaves, self._counts, pane_slots, self._k_active(), cap))
+            n = int(packed[0])
+        if n == 0:
+            return []
+        idx = packed[1:1 + cap][:n]
+        res_leaves = []
+        off = 1 + cap
+        for shape, dtype in row_layout:
+            # device packs every element as exactly one i32 word (non-4-byte
+            # dtypes are downcast to f32 before the bitcast)
+            words = int(np.prod(shape, dtype=np.int64)) or 1
+            seg = packed[off:off + cap * words].reshape(cap, words)[:n]
+            if dtype == np.int32:
+                arr = seg.reshape((n,) + tuple(shape))
+            elif dtype.itemsize == 4:
+                arr = seg.view(dtype).reshape((n,) + tuple(shape))
+            else:
+                arr = seg.view(np.float32).astype(dtype).reshape((n,) + tuple(shape))
+            res_leaves.append(arr)
+            off += cap * words
+        result = jax.tree_util.tree_unflatten(treedef, res_leaves)
+        window = self.assigner.window_bounds(window_id)
+        keys = np.asarray(self.key_index.reverse_keys())[idx]
+        cols: Dict[str, Any] = {self.key_column: keys}
+        if isinstance(result, dict):
+            cols.update(result)
+        else:
+            cols[self.output_column] = result
+        if self.emit_window_bounds:
+            cols["window_start"] = np.full(n, window.start, np.int64)
+            cols["window_end"] = np.full(n, window.end, np.int64)
+        ts = np.full(n, window.max_timestamp, np.int64)
+        return [RecordBatch(cols, timestamps=ts)]
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
     def _clear_panes_step(self, leaves, counts, pane_slots):
@@ -281,11 +431,10 @@ class WindowAggOperator(StreamOperator):
         flat_p[:B] = flat
         values = self._select(cols)
         values_p = jax.tree_util.tree_map(lambda a: _pad_rows(np.asarray(a), Bp), values)
-        ones = np.ones(Bp, np.int32)
 
         self._leaves, self._counts = self._update_step(
             self._leaves, self._counts,
-            jnp.asarray(flat_p, jnp.int32), values_p, jnp.asarray(ones))
+            jnp.asarray(flat_p, jnp.int32), values_p)
 
         out: List[StreamElement] = []
         # ---- count-trigger (GlobalWindows / countWindow path)
@@ -397,24 +546,30 @@ class WindowAggOperator(StreamOperator):
             return []
         panes = np.arange(first, last + 1, dtype=np.int64)
         pane_slots = jnp.asarray(panes % self._P, jnp.int32)
-        mask, result = self._fire_step(self._leaves, self._counts, pane_slots)
+        if self.sharding is None and self.key_index is not None:
+            return self._fire_window_packed(window_id, pane_slots)
+        mask, result = self._fire_step(self._leaves, self._counts, pane_slots,
+                                       self._k_active())
         return self._emit(mask, result, self.assigner.window_bounds(window_id))
 
     def _fire_by_count(self, force: bool = False) -> List[StreamElement]:
         if self._leaves is None:
             return []
         thr = 1 if force else self.trigger.count_threshold
-        counts0 = self._counts[:, 0]
+        ka = self._k_active() or self._K
+        counts0 = self._counts[:ka, 0]
         mask = counts0 >= thr
         if not bool(mask.any()):  # cheap pre-check: skip the K-wide assembly
             return []
         pane_slots = jnp.zeros((1,), jnp.int32)
-        m, result = self._fire_step(self._leaves, self._counts, pane_slots)
+        m, result = self._fire_step(self._leaves, self._counts, pane_slots,
+                                    self._k_active())
         mask = mask & m
         out = self._emit(mask, result, self.assigner.window_bounds(0))
         if self.trigger.purges_on_fire and out:
+            full_mask = jnp.zeros((self._K,), bool).at[:ka].set(mask)
             self._leaves, self._counts = self._purge_keys_step(
-                self._leaves, self._counts, mask)
+                self._leaves, self._counts, full_mask)
         return out
 
     def _emit(self, mask, result, window) -> List[StreamElement]:

@@ -1,0 +1,87 @@
+"""Device-side record exchange: the data plane, on ICI instead of Netty.
+
+The reference moves records between parallel subtasks through a Netty shuffle
+with credit-based flow control (``NettyMessage.java``,
+``RemoteInputChannel.java:302``).  On a TPU mesh the equivalent *intra-pod*
+exchange is a bucketed ``all_to_all`` under ``shard_map``: each device sorts
+its local records into per-destination buckets of fixed capacity and one XLA
+collective rotates the buckets over ICI.  Capacity overflows are reported (not
+silently dropped) so the host-side credit layer can resize — the analog of
+floating-buffer redistribution under backlog feedback
+(``NettyShuffleEnvironmentOptions.java:167``).
+
+All shapes are static (capacity per destination is fixed per compile), so the
+exchange jits once; padding rows carry slot id == capacity sentinel and are
+dropped by downstream scatters.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from flink_tpu.parallel.mesh import KG_AXIS
+
+
+def _bucket_local(dest: jnp.ndarray, leaves: Tuple[jnp.ndarray, ...],
+                  num_shards: int, cap: int):
+    """Sort local rows into [num_shards, cap] buckets by destination shard.
+
+    Returns (bucketed_leaves, valid mask [num_shards, cap], overflow count).
+    Rows beyond ``cap`` for a destination overflow (counted, not sent).
+    """
+    B = dest.shape[0]
+    order = jnp.argsort(dest)
+    sdest = dest[order]
+    # position of each row within its destination's bucket
+    idx_in_dest = jnp.arange(B) - jnp.searchsorted(sdest, sdest, side="left")
+    valid_src = idx_in_dest < cap
+    flat = jnp.where(valid_src, sdest * cap + idx_in_dest, num_shards * cap)
+    out_leaves = []
+    for l in leaves:
+        sl = l[order]
+        buf = jnp.zeros((num_shards * cap,) + l.shape[1:], l.dtype)
+        buf = buf.at[flat].set(sl, mode="drop")
+        out_leaves.append(buf.reshape((num_shards, cap) + l.shape[1:]))
+    vmask = jnp.zeros((num_shards * cap,), bool).at[flat].set(
+        valid_src, mode="drop").reshape(num_shards, cap)
+    overflow = jnp.sum(~valid_src)
+    return tuple(out_leaves), vmask, overflow
+
+
+def make_all_to_all_exchange(mesh: Mesh, num_leaves: int, cap: int):
+    """Build the jitted exchange: local [B] records -> received [D*cap] rows.
+
+    Inputs (per device, via shard_map):
+      dest[B] int32   destination shard per local record
+      leaves          tuple of [B, ...] value arrays
+    Outputs (per device):
+      rx_leaves       tuple of [D*cap, ...] received rows
+      rx_valid[D*cap] bool
+      overflow        int32 — local rows not sent (capacity exhausted)
+    """
+    D = mesh.devices.size
+
+    def _exchange(dest, *leaves):
+        bucketed, vmask, overflow = _bucket_local(dest, leaves, D, cap)
+        # all_to_all over the kg axis: [D, cap, ...] -> [D, cap, ...] where
+        # row d of the output came from device d's bucket for *this* device.
+        rx = tuple(
+            jax.lax.all_to_all(b, KG_AXIS, split_axis=0, concat_axis=0,
+                               tiled=True)
+            for b in bucketed)
+        rx_valid = jax.lax.all_to_all(vmask, KG_AXIS, split_axis=0,
+                                      concat_axis=0, tiled=True)
+        rx_flat = tuple(r.reshape((D * cap,) + r.shape[2:]) for r in rx)
+        return rx_flat, rx_valid.reshape(D * cap), overflow.reshape(1)
+
+    in_specs = (P(KG_AXIS),) + (P(KG_AXIS),) * num_leaves
+    out_specs = ((P(KG_AXIS),) * num_leaves, P(KG_AXIS), P(KG_AXIS))
+    fn = shard_map(_exchange, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    return jax.jit(fn)

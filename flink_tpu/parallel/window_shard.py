@@ -1,0 +1,36 @@
+"""Multi-chip windowed aggregation: placement-level sharding.
+
+The single-chip ``WindowAggOperator`` kernels (scatter-combine, pane fire,
+clear/purge) are placement-agnostic XLA programs.  Multi-chip execution is
+therefore pure *data placement*: state arrays ``[K, P, ...]`` get a
+``NamedSharding`` over the key-slot dimension (the key-group axis, SURVEY
+§2.7/§7.1) and XLA's SPMD partitioner splits every step:
+
+- scatter updates: indices replicated, each device applies the in-range rows
+  of the batch to its local state slice — no collectives in the hot loop;
+- fire/clear/purge: row-parallel over K, trivially partitioned;
+- results come back sharded; the host emit path reads them once per fire.
+
+This mirrors how the reference scales ``keyBy``: identical operator logic per
+subtask, state split by key-group range (``KeyGroupRangeAssignment.java``).
+Cross-host record routing (the Netty shuffle analog) is the separate
+``parallel/exchange.py`` all_to_all path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.parallel.mesh import make_mesh, state_sharding
+
+
+def sharded_window_operator(mesh: Optional[Mesh] = None, *,
+                            n_devices: Optional[int] = None,
+                            **kwargs) -> WindowAggOperator:
+    """A ``WindowAggOperator`` whose keyed state is sharded over ``mesh``."""
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    return WindowAggOperator(sharding=state_sharding(mesh), **kwargs)

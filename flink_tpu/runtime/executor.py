@@ -71,11 +71,21 @@ class LocalExecutor:
 
     def __init__(self, checkpoint_interval_ms: int = 0,
                  checkpoint_storage=None,
-                 listeners: Optional[List[Callable[[str, Any], None]]] = None):
+                 listeners: Optional[List[Callable[[str, Any], None]]] = None,
+                 max_records: Optional[int] = None,
+                 max_wall_ms: Optional[int] = None):
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.checkpoint_storage = checkpoint_storage
         self.listeners = listeners or []
+        self.max_records = max_records      # unbounded-source record budget
+        self.max_wall_ms = max_wall_ms      # unbounded-source wall budget
+        self._cancelled = False
         self._records = 0
+
+    def cancel(self) -> None:
+        """Cooperative cancellation (``JobMaster.cancel`` analog): the source
+        loop stops at the next batch boundary and flushes bounded-end path."""
+        self._cancelled = True
 
     # ------------------------------------------------------------- wiring
     def _build(self, plan: ExecutionPlan,
@@ -122,7 +132,8 @@ class LocalExecutor:
             if advanced is not None:
                 wm = Watermark(advanced)
                 self._route(rv, op.process_watermark(wm))
-                self._route(rv, [wm])
+                if op.forwards_watermarks:
+                    self._route(rv, [wm])
         elif isinstance(el, CheckpointBarrier):
             # single-input-per-vertex local mode: barrier alignment is trivial;
             # snapshot on first arrival, forward once all inputs delivered it.
@@ -153,7 +164,12 @@ class LocalExecutor:
 
         last_checkpoint = time.monotonic()
         ckpt_id = 0
-        while readers:
+        while readers and not self._cancelled:
+            if self.max_records is not None and self._records >= self.max_records:
+                break
+            if (self.max_wall_ms is not None
+                    and (time.monotonic() - t0) * 1000 >= self.max_wall_ms):
+                break
             still: List[Tuple[RunningVertex, Any]] = []
             for rv, it in readers:
                 try:
