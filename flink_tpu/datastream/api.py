@@ -111,15 +111,16 @@ class StreamExecutionEnvironment:
     def execute(self, job_name: str = "job",
                 restore: Optional[Dict[str, Any]] = None,
                 max_records: Optional[int] = None,
-                max_wall_ms: Optional[int] = None) -> JobExecutionResult:
+                max_wall_ms: Optional[int] = None,
+                drain: bool = True) -> JobExecutionResult:
         plan = self.get_stream_graph(job_name).to_plan()
         executor = LocalExecutor(
             checkpoint_interval_ms=self.checkpoint_interval_ms,
             checkpoint_storage=self.checkpoint_storage,
             max_records=max_records, max_wall_ms=max_wall_ms)
-        result = executor.execute(plan, restore=restore)
+        # publish BEFORE the blocking run so another thread can cancel()
         self._last_executor = executor
-        return result
+        return executor.execute(plan, restore=restore, drain=drain)
 
 
 def _identity_operator_factory(name: str):

@@ -144,6 +144,13 @@ class TimestampsAndWatermarksOperator(StreamOperator):
             return [Watermark(MAX_WATERMARK)]
         return []
 
+    def snapshot_state(self) -> Dict[str, Any]:
+        # watermark generators carry max-seen-timestamp across restores
+        return {"gen": dict(self.generator.__dict__)}
+
+    def restore_state(self, snapshot: Dict[str, Any]) -> None:
+        self.generator.__dict__.update(snapshot.get("gen", {}))
+
 
 class KeyedReduceOperator(StreamOperator):
     """``keyBy().reduce(fn)`` — emits the running per-key fold for EVERY input

@@ -139,6 +139,34 @@ class WindowAggOperator(StreamOperator):
         self.late_dropped: int = 0   # beyond-lateness drop counter (numRecordsDropped)
         self._proc_time: int = LONG_MIN
 
+    #: snapshot entries row-indexed by key slot (rescale redistribution)
+    ROW_FIELDS = ("leaves", "counts")
+
+    @staticmethod
+    def split_snapshot(snap: Dict[str, Any], max_parallelism: int,
+                       new_parallelism: int) -> List[Dict[str, Any]]:
+        """Rescale a snapshot across key-group ranges
+        (``StateAssignmentOperation.reDistributeKeyedStates`` analog)."""
+        from flink_tpu.state.redistribute import split_keyed_snapshot
+        return split_keyed_snapshot(snap, WindowAggOperator.ROW_FIELDS,
+                                    max_parallelism, new_parallelism)
+
+    @staticmethod
+    def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Merge coordinated same-checkpoint snapshots (scale-down).  All
+        parts must share pane progress — true for snapshots taken at one
+        barrier, where every subtask saw the same watermark."""
+        from flink_tpu.state.redistribute import merge_keyed_snapshots
+        live = [s for s in snaps if "panes" in s]
+        for s in live[1:]:
+            if not np.array_equal(s["panes"], live[0]["panes"]):
+                raise ValueError("cannot merge snapshots with different pane "
+                                 "progress (not from one coordinated checkpoint)")
+        merged = merge_keyed_snapshots(snaps, WindowAggOperator.ROW_FIELDS)
+        if live:
+            merged["watermark"] = max(s["watermark"] for s in live)
+        return merged
+
     def reset_state(self) -> None:
         """Drop all keyed state/time progress but KEEP compiled steps (the
         jit caches key on this instance).  Used by benchmarks/tests to re-run
@@ -274,8 +302,9 @@ class WindowAggOperator(StreamOperator):
             g = jnp.take(l, jnp.minimum(idx, K - 1), axis=0)
             g = g.reshape(cap, -1)
             if g.dtype != jnp.int32:
-                if g.dtype.itemsize != 4:
+                if g.dtype.itemsize < 4:  # sub-word dtypes widen to f32
                     g = g.astype(jnp.float32)
+                # 8-byte dtypes bitcast to TWO i32 words each (exact)
                 g = jax.lax.bitcast_convert_type(g, jnp.int32)
             parts.append(g.reshape(-1))
         return jnp.concatenate(parts)
@@ -323,13 +352,16 @@ class WindowAggOperator(StreamOperator):
         res_leaves = []
         off = 1 + cap
         for shape, dtype in row_layout:
-            # device packs every element as exactly one i32 word (non-4-byte
-            # dtypes are downcast to f32 before the bitcast)
-            words = int(np.prod(shape, dtype=np.int64)) or 1
-            seg = packed[off:off + cap * words].reshape(cap, words)[:n]
+            # word layout mirrors _fire_pack_step: 4-byte dtypes = 1 i32 word,
+            # 8-byte = 2 words (exact bitcast), sub-word = 1 word via f32
+            elems = int(np.prod(shape, dtype=np.int64)) or 1
+            wpe = dtype.itemsize // 4 if dtype.itemsize >= 4 else 1
+            words = elems * wpe
+            seg = np.ascontiguousarray(
+                packed[off:off + cap * words].reshape(cap, words)[:n])
             if dtype == np.int32:
                 arr = seg.reshape((n,) + tuple(shape))
-            elif dtype.itemsize == 4:
+            elif dtype.itemsize >= 4:
                 arr = seg.view(dtype).reshape((n,) + tuple(shape))
             else:
                 arr = seg.view(np.float32).astype(dtype).reshape((n,) + tuple(shape))

@@ -57,12 +57,3 @@ class KeyGroupSharding:
 def state_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [K_total, ...] state: key-slot dim split over the mesh."""
     return NamedSharding(mesh, P(KG_AXIS))
-
-
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for [D, B_local, ...] pre-routed batches: one row per device."""
-    return NamedSharding(mesh, P(KG_AXIS))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())

@@ -1,0 +1,13 @@
+from flink_tpu.runtime.checkpoint.storage import (
+    FileCheckpointStorage,
+    InMemoryCheckpointStorage,
+    read_savepoint,
+    write_savepoint,
+)
+
+__all__ = [
+    "FileCheckpointStorage",
+    "InMemoryCheckpointStorage",
+    "read_savepoint",
+    "write_savepoint",
+]

@@ -1,0 +1,149 @@
+"""Checkpoint storage: durable snapshot persistence + metadata.
+
+Analog of the reference's checkpoint storage stack
+(``CheckpointStorageCoordinatorView`` / ``FsCheckpointStorageAccess`` +
+versioned metadata ``runtime/checkpoint/Checkpoints.java`` and
+``metadata/MetadataSerializer``): a checkpoint is a directory
+``chk-{id}/`` holding one ``.npz`` per operator uid (numpy trees, pickled
+object leaves for key dictionaries) plus ``_metadata.json`` (version, id,
+uids, timestamp).  Savepoints are the same format at a user-chosen path —
+rescalable and inspectable offline (state-processor analog reads them back).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+METADATA_FILE = "_metadata.json"
+FORMAT_VERSION = 1
+
+
+class InMemoryCheckpointStorage:
+    """Test/local storage (``MemoryStateBackend``-style): deep-copied trees."""
+
+    def __init__(self, retain: int = 3):
+        self.retain = retain
+        self._store: Dict[int, Dict[str, Any]] = {}
+
+    def store(self, checkpoint_id: int, snapshot: Dict[str, Any]) -> None:
+        self._store[checkpoint_id] = pickle.loads(pickle.dumps(snapshot))
+        while len(self._store) > self.retain:
+            del self._store[min(self._store)]
+
+    def checkpoint_ids(self) -> List[int]:
+        return sorted(self._store)
+
+    def load(self, checkpoint_id: int) -> Dict[str, Any]:
+        return pickle.loads(pickle.dumps(self._store[checkpoint_id]))
+
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        ids = self.checkpoint_ids()
+        return self.load(ids[-1]) if ids else None
+
+
+class FileCheckpointStorage:
+    """Filesystem checkpoint storage (``FsStateBackend`` analog)."""
+
+    def __init__(self, base_dir: str, retain: int = 3):
+        self.base_dir = base_dir
+        self.retain = retain
+        os.makedirs(base_dir, exist_ok=True)
+
+    def _dir(self, checkpoint_id: int) -> str:
+        return os.path.join(self.base_dir, f"chk-{checkpoint_id}")
+
+    def store(self, checkpoint_id: int, snapshot: Dict[str, Any]) -> None:
+        d = self._dir(checkpoint_id)
+        tmp = d + ".inprogress"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        uids = []
+        for uid, op_snap in snapshot.items():
+            fname = f"op-{len(uids)}.pkl"
+            uids.append({"uid": uid, "file": fname})
+            with open(os.path.join(tmp, fname), "wb") as f:
+                pickle.dump(_to_numpy(op_snap), f, protocol=4)
+        meta = {"version": FORMAT_VERSION, "checkpoint_id": checkpoint_id,
+                "timestamp_ms": int(time.time() * 1000), "operators": uids}
+        with open(os.path.join(tmp, METADATA_FILE), "w") as f:
+            json.dump(meta, f, indent=2)
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        os.replace(tmp, d)  # atomic publish (reference: finalize + rename)
+        self._cleanup()
+
+    def _cleanup(self):
+        ids = self.checkpoint_ids()
+        for cid in ids[: max(0, len(ids) - self.retain)]:
+            shutil.rmtree(self._dir(cid), ignore_errors=True)
+
+    def checkpoint_ids(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.base_dir):
+            # skip leftover chk-N.inprogress dirs from a crash mid-publish
+            if not (name.startswith("chk-") and name[4:].isdigit()):
+                continue
+            if os.path.isfile(os.path.join(self.base_dir, name, METADATA_FILE)):
+                out.append(int(name[4:]))
+        return sorted(out)
+
+    def load(self, checkpoint_id: int) -> Dict[str, Any]:
+        d = self._dir(checkpoint_id)
+        with open(os.path.join(d, METADATA_FILE)) as f:
+            meta = json.load(f)
+        if meta["version"] > FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {meta['version']} too new")
+        out: Dict[str, Any] = {}
+        for entry in meta["operators"]:
+            with open(os.path.join(d, entry["file"]), "rb") as f:
+                out[entry["uid"]] = pickle.load(f)
+        return out
+
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        ids = self.checkpoint_ids()
+        return self.load(ids[-1]) if ids else None
+
+    def metadata(self, checkpoint_id: int) -> Dict[str, Any]:
+        with open(os.path.join(self._dir(checkpoint_id), METADATA_FILE)) as f:
+            return json.load(f)
+
+
+def _to_numpy(tree: Any) -> Any:
+    """Device arrays -> host numpy throughout a snapshot tree."""
+    if isinstance(tree, dict):
+        return {k: _to_numpy(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        t = [_to_numpy(v) for v in tree]
+        return tuple(t) if isinstance(tree, tuple) else t
+    if hasattr(tree, "__array__") and not isinstance(tree, np.ndarray):
+        return np.asarray(tree)
+    return tree
+
+
+def write_savepoint(path: str, snapshot: Dict[str, Any]) -> str:
+    """User-triggered rescalable savepoint (``Savepoint`` analog)."""
+    storage = FileCheckpointStorage(path, retain=1_000_000)
+    sid = (max(storage.checkpoint_ids()) + 1) if storage.checkpoint_ids() else 1
+    storage.store(sid, snapshot)
+    return os.path.join(path, f"chk-{sid}")
+
+
+def read_savepoint(path: str) -> Dict[str, Any]:
+    """Load a savepoint directory written by ``write_savepoint`` (accepts the
+    ``chk-N`` dir itself or its parent)."""
+    if os.path.isfile(os.path.join(path, METADATA_FILE)):
+        parent, name = os.path.split(path.rstrip("/"))
+        return FileCheckpointStorage(parent).load(int(name[4:]))
+    storage = FileCheckpointStorage(path)
+    snap = storage.load_latest()
+    if snap is None:
+        raise FileNotFoundError(f"no checkpoints under {path}")
+    return snap

@@ -149,7 +149,8 @@ class LocalExecutor:
 
     # ---------------------------------------------------------------- run
     def execute(self, plan: ExecutionPlan,
-                restore: Optional[Dict[str, Any]] = None) -> JobExecutionResult:
+                restore: Optional[Dict[str, Any]] = None,
+                drain: bool = True) -> JobExecutionResult:
         t0 = time.monotonic()
         running = self._build(plan, restore)
         self.running = running
@@ -185,7 +186,8 @@ class LocalExecutor:
                     if adv is not None:
                         wm = Watermark(adv)
                         self._route(rv, rv.operator.process_watermark(wm))
-                        self._route(rv, [wm])
+                        if rv.operator.forwards_watermarks:
+                            self._route(rv, [wm])
                 else:
                     self._route(rv, [el])
                 still.append((rv, it))
@@ -197,7 +199,15 @@ class LocalExecutor:
                 self.trigger_checkpoint(ckpt_id)
                 last_checkpoint = time.monotonic()
 
-        # bounded end: MAX_WATERMARK from sources, then end_input in topo order
+        # bounded end: MAX_WATERMARK from sources, then end_input in topo
+        # order.  drain=False (stop-with-savepoint --no-drain analog) keeps
+        # in-progress windows unfired so a restore continues them.
+        if not drain:
+            for v in plan.vertices:
+                running[v.id].operator.close()
+            return JobExecutionResult(plan.job_name,
+                                      (time.monotonic() - t0) * 1000.0,
+                                      self._records)
         for rv in source_vertices:
             adv = rv.valve.input_watermark(0, MAX_WATERMARK)
             if adv is not None:

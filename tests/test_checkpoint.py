@@ -1,0 +1,206 @@
+"""Checkpoint/restore/rescale tests.
+
+Modeled on the reference's checkpointing ITCases: snapshot mid-stream,
+restore into a fresh job, and assert the continued run equals an
+uninterrupted one (exactly-once state semantics); key-group redistribution
+mirrors ``StateAssignmentOperation`` rescale tests.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import RuntimeContext, SumAggregator
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.runtime.checkpoint import (FileCheckpointStorage,
+                                          InMemoryCheckpointStorage,
+                                          read_savepoint, write_savepoint)
+from flink_tpu.state.redistribute import (merge_keyed_snapshots,
+                                          split_keyed_snapshot)
+from flink_tpu.windowing import TumblingEventTimeWindows
+
+
+def make_op(**kw):
+    op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                           SumAggregator(jnp.float32),
+                           key_column="k", value_column="v", **kw)
+    op.open(RuntimeContext())
+    return op
+
+
+def feed(op, keys, vals, ts, wm=None):
+    out = op.process_batch(RecordBatch(
+        {"k": np.asarray(keys), "v": np.asarray(vals, np.float32)},
+        timestamps=np.asarray(ts, np.int64)))
+    if wm is not None:
+        out += op.process_watermark(Watermark(wm))
+    return out
+
+
+def collect(elements):
+    rows = {}
+    for b in elements:
+        for i in range(len(b)):
+            rows[(int(np.asarray(b.column("k"))[i]),
+                  int(np.asarray(b.column("window_start"))[i]))] = float(
+                np.asarray(b.column("result"))[i])
+    return rows
+
+
+def test_file_storage_roundtrip(tmp_path):
+    st = FileCheckpointStorage(str(tmp_path), retain=2)
+    snap = {"op-a": {"x": np.arange(5), "nested": {"y": np.ones((2, 3))},
+                     "scalar": 7, "none": None},
+            "op-b": {"keys": {"raw": np.asarray(["a", "b"], object)}}}
+    st.store(1, snap)
+    st.store(2, snap)
+    st.store(3, snap)
+    assert st.checkpoint_ids() == [2, 3]  # retention
+    back = st.load(3)
+    assert np.array_equal(back["op-a"]["x"], np.arange(5))
+    assert back["op-a"]["scalar"] == 7
+    assert list(back["op-b"]["keys"]["raw"]) == ["a", "b"]
+    assert st.metadata(3)["checkpoint_id"] == 3
+
+
+def test_exactly_once_resume_equals_uninterrupted():
+    rng = np.random.default_rng(5)
+    n = 4000
+    keys = rng.integers(0, 97, n)
+    vals = rng.random(n).astype(np.float32)
+    ts = np.sort(rng.integers(0, 4000, n))
+    half = n // 2
+
+    # uninterrupted
+    op_ref = make_op()
+    out = feed(op_ref, keys[:half], vals[:half], ts[:half], wm=int(ts[half - 1]))
+    out += feed(op_ref, keys[half:], vals[half:], ts[half:], wm=5000)
+    expected = collect([e for e in out if isinstance(e, RecordBatch)])
+
+    # snapshot after first half, restore into a NEW operator, continue
+    op_a = make_op()
+    out_a = feed(op_a, keys[:half], vals[:half], ts[:half], wm=int(ts[half - 1]))
+    snap = op_a.snapshot_state()
+    op_b = make_op()
+    op_b.restore_state(snap)
+    out_b = feed(op_b, keys[half:], vals[half:], ts[half:], wm=5000)
+    got = collect([e for e in out_a + out_b if isinstance(e, RecordBatch)])
+    assert got.keys() == expected.keys()
+    for k in expected:
+        assert abs(got[k] - expected[k]) < 1e-2
+
+
+def test_env_level_checkpoint_restore():
+    from flink_tpu.datastream import StreamExecutionEnvironment
+
+    def build(env, cols):
+        return (env.from_collection(columns=cols)
+                .assign_timestamps_and_watermarks(0, timestamp_column="t")
+                .key_by("k")
+                .window(TumblingEventTimeWindows.of(1000))
+                .sum("v"))
+
+    rng = np.random.default_rng(11)
+    n = 3000
+    keys = rng.integers(0, 53, n)
+    vals = rng.random(n).astype(np.float32)
+    ts = np.sort(rng.integers(0, 3000, n))
+    half = n // 2
+    part1 = {"k": keys[:half], "v": vals[:half], "t": ts[:half]}
+    part2 = {"k": keys[half:], "v": vals[half:], "t": ts[half:]}
+    whole = {"k": keys, "v": vals, "t": ts}
+
+    env_ref = StreamExecutionEnvironment()
+    sink_ref = build(env_ref, whole).collect()
+    env_ref.execute()
+    expected = {(r["k"], r["window_start"]): r["v"] for r in sink_ref.rows()}
+
+    st = InMemoryCheckpointStorage()
+    env1 = StreamExecutionEnvironment()
+    sink1 = build(env1, part1).collect()
+    # stop WITHOUT drain: in-progress windows stay open for the restored job
+    env1.execute(drain=False)
+    st.store(1, env1._last_executor.trigger_checkpoint(1))
+
+    env2 = StreamExecutionEnvironment()
+    sink2 = build(env2, part2).collect()
+    env2.execute(restore=st.load_latest())
+    got = {}
+    for r in sink1.rows() + sink2.rows():
+        got[(r["k"], r["window_start"])] = r["v"]
+    assert got.keys() == expected.keys()
+    for k in expected:
+        assert abs(got[k] - expected[k]) < 1e-2
+
+
+def test_rescale_split_and_merge():
+    rng = np.random.default_rng(9)
+    n = 2000
+    keys = rng.integers(0, 211, n)
+    vals = rng.random(n).astype(np.float32)
+    ts = np.sort(rng.integers(0, 2000, n))
+    op = make_op()
+    feed(op, keys, vals, ts, wm=int(ts[-1]) - 500)
+    snap = op.snapshot_state()
+
+    parts = WindowAggOperator.split_snapshot(snap, max_parallelism=128,
+                                             new_parallelism=4)
+    assert len(parts) == 4
+    # each part holds disjoint keys; union == all keys
+    from flink_tpu.state.keyindex import KeyIndex
+    part_keys = [set(KeyIndex.restore(p["key_index"]).reverse_keys().tolist())
+                 for p in parts]
+    allk = set()
+    for pk in part_keys:
+        assert not (allk & pk)
+        allk |= pk
+    assert allk == set(KeyIndex.restore(snap["key_index"]).reverse_keys().tolist())
+
+    # restored split operators: continued processing yields same fires as whole
+    tail_keys = rng.integers(0, 211, 500)
+    tail_vals = rng.random(500).astype(np.float32)
+    tail_ts = np.sort(rng.integers(1500, 2000, 500))
+
+    op_whole = make_op()
+    op_whole.restore_state(snap)
+    ref = collect(feed(op_whole, tail_keys, tail_vals, tail_ts, wm=5000))
+
+    got = {}
+    from flink_tpu.core import keygroups
+    kg = keygroups.assign_to_key_group(keygroups.hash_keys(tail_keys), 128)
+    ranges = keygroups.key_group_ranges(128, 4)
+    for p, r in zip(parts, ranges):
+        sub = make_op()
+        sub.restore_state(p)
+        sel = (kg >= r.start) & (kg <= r.end)
+        if sel.any():
+            got.update(collect(feed(sub, tail_keys[sel], tail_vals[sel],
+                                    tail_ts[sel], wm=5000)))
+        else:
+            sub.process_watermark(Watermark(5000))
+    assert got.keys() == ref.keys()
+    for k in ref:
+        assert abs(got[k] - ref[k]) < 1e-2
+
+    # merge back (scale-down) must reproduce the whole
+    merged = WindowAggOperator.merge_snapshots(parts)
+    op_merged = make_op()
+    op_merged.restore_state(merged)
+    got_m = collect(feed(op_merged, tail_keys, tail_vals, tail_ts, wm=5000))
+    assert got_m.keys() == ref.keys()
+    for k in ref:
+        assert abs(got_m[k] - ref[k]) < 1e-2
+
+
+def test_savepoint_write_read(tmp_path):
+    op = make_op()
+    feed(op, [1, 2, 3], [1.0, 2.0, 3.0], [10, 20, 30])
+    snap = {"win": op.snapshot_state()}
+    p = write_savepoint(str(tmp_path / "sp"), snap)
+    back = read_savepoint(p)
+    assert "win" in back
+    op2 = make_op()
+    op2.restore_state(back["win"])
+    out = op2.process_watermark(Watermark(5000))
+    assert collect(out) == {(1, 0): 1.0, (2, 0): 2.0, (3, 0): 3.0}
