@@ -259,6 +259,14 @@ class KeyedStream(DataStream):
     def window(self, assigner: WindowAssigner) -> "WindowedStream":
         return WindowedStream(self, assigner)
 
+    def process(self, fn, name: str = "keyed-process") -> "DataStream":
+        """Run a ``KeyedProcessFunction`` (keyed state + timers) on this
+        stream (``KeyedStream.process`` analog)."""
+        from flink_tpu.operators.process import KeyedProcessOperator
+        key_col = self.key_column
+        return DataStream(self.env, self._then(
+            name, lambda: KeyedProcessOperator(fn, key_col, name)))
+
     def reduce(self, fn: Union[ReduceFunction, Callable], identity_value=None,
                value_column: Optional[str] = None,
                output_column: str = "result") -> "DataStream":

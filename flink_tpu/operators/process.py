@@ -1,0 +1,203 @@
+"""KeyedProcessFunction operator: user logic + keyed state + timers.
+
+Analog of ``KeyedProcessOperator`` running a ``KeyedProcessFunction``
+(``flink-streaming-java/.../api/operators/KeyedProcessOperator.java``),
+batched: the user function receives a whole ``RecordBatch`` plus a context
+exposing vectorized keyed state (``flink_tpu/state/heap.py``) and batched
+timer registration (``flink_tpu/runtime/timers.py``); ``on_timer_batch``
+receives ALL timers firing at one watermark advance as arrays.
+
+Timer snapshots store raw keys (not backend-local slot ids) so they survive
+key-group redistribution on rescale — the same property the reference gets
+from key-grouped timer queues (``InternalTimerServiceImpl.java:50``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.core.batch import LONG_MIN, RecordBatch, StreamElement, Watermark
+from flink_tpu.core.functions import RichFunction, RuntimeContext
+from flink_tpu.operators.base import StreamOperator
+from flink_tpu.runtime.timers import InternalTimerService
+from flink_tpu.state.heap import HeapKeyedStateBackend
+
+
+class KeyedProcessFunction(RichFunction):
+    """Batched ``KeyedProcessFunction`` contract.
+
+    process_batch(ctx, batch)        -> elements to emit (list or None)
+    on_timer_batch(ctx, slots, ts)   -> elements to emit for fired timers
+    """
+
+    def process_batch(self, ctx: "Context", batch: RecordBatch):
+        raise NotImplementedError
+
+    def on_timer_batch(self, ctx: "OnTimerContext", slots: np.ndarray,
+                       timestamps: np.ndarray):
+        return None
+
+
+class TimerServiceView:
+    """User-facing timer registration surface (``TimerService`` analog)."""
+
+    def __init__(self, timers: InternalTimerService):
+        self._timers = timers
+
+    def current_watermark(self) -> int:
+        return self._timers.current_watermark
+
+    def register_event_time_timers(self, slots, timestamps) -> None:
+        self._timers.register_event_time(slots, timestamps)
+
+    def register_processing_time_timers(self, slots, timestamps) -> None:
+        self._timers.register_processing_time(slots, timestamps)
+
+    def delete_event_time_timers(self, slots, timestamps) -> None:
+        self._timers.delete_event_time(slots, timestamps)
+
+    def delete_processing_time_timers(self, slots, timestamps) -> None:
+        self._timers.delete_processing_time(slots, timestamps)
+
+
+class Context:
+    """Per-batch context: state access + timers + key metadata."""
+
+    def __init__(self, op: "KeyedProcessOperator", slots: Optional[np.ndarray]):
+        self._op = op
+        self.slots = slots  # dense slot per row of the current batch
+        self.timer_service = TimerServiceView(op.timers)
+
+    def state(self, descriptor):
+        return self._op.backend.get_state(descriptor)
+
+    def keys_of(self, slots: np.ndarray) -> np.ndarray:
+        return self._op.backend.slot_keys(slots)
+
+    @property
+    def current_watermark(self) -> int:
+        return self._op.timers.current_watermark
+
+
+class OnTimerContext(Context):
+    pass
+
+
+class KeyedProcessOperator(StreamOperator):
+    def __init__(self, fn: KeyedProcessFunction, key_column: str,
+                 name: str = "keyed-process"):
+        self.fn = fn
+        self.key_column = key_column
+        self.name = name
+        self.backend = HeapKeyedStateBackend()
+        self.timers = InternalTimerService()
+
+    def open(self, ctx: RuntimeContext) -> None:
+        super().open(ctx)
+        self.backend.max_parallelism = ctx.max_parallelism
+        self.fn.open(ctx)
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        slots = self.backend.key_slots(np.asarray(batch.column(self.key_column)))
+        batch = batch.with_keys(slots, batch.key_groups)
+        out = self.fn.process_batch(Context(self, slots), batch)
+        return _normalize(out)
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        slots, _ns, ts = self.timers.advance_watermark(watermark.timestamp)
+        if slots.size == 0:
+            return []
+        out = self.fn.on_timer_batch(OnTimerContext(self, None), slots, ts)
+        return _normalize(out)
+
+    def on_processing_time(self, timestamp_ms: int) -> List[StreamElement]:
+        slots, _ns, ts = self.timers.advance_processing_time(timestamp_ms)
+        if slots.size == 0:
+            return []
+        out = self.fn.on_timer_batch(OnTimerContext(self, None), slots, ts)
+        return _normalize(out)
+
+    # -- checkpointing -------------------------------------------------------
+    def snapshot_state(self) -> Dict[str, Any]:
+        snap = self.backend.snapshot()
+        tsnap = self.timers.snapshot()
+        # slot ids -> raw keys for rescale-safety
+        for part in ("event", "proc"):
+            slots = tsnap[part]["slots"]
+            tsnap[part] = dict(tsnap[part])
+            tsnap[part]["keys"] = (self.backend.slot_keys(slots)
+                                   if slots.size else np.zeros(0, np.int64))
+            del tsnap[part]["slots"]
+        snap["timers"] = tsnap
+        return snap
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        tsnap = snap.get("timers")
+        self.backend.restore({k: v for k, v in snap.items() if k != "timers"})
+        if tsnap is not None:
+            from flink_tpu.core import keygroups
+
+            ctx = getattr(self, "ctx", None)
+            my_range = (keygroups.compute_key_group_range(
+                ctx.max_parallelism, ctx.parallelism, ctx.subtask_index)
+                if ctx is not None else None)
+            restored = {"watermark": tsnap.get("watermark", LONG_MIN)}
+            for part in ("event", "proc"):
+                p = dict(tsnap[part])
+                keys = np.asarray(p.pop("keys"))
+                if keys.size and my_range is not None and ctx.parallelism > 1:
+                    # rescale: a split snapshot carries every subtask's timers;
+                    # keep only keys in this subtask's key-group range
+                    kg = keygroups.assign_to_key_group(
+                        keygroups.hash_keys(keys), ctx.max_parallelism)
+                    mine = (kg >= my_range.start) & (kg <= my_range.end)
+                    keys = keys[mine]
+                    p["ns"] = np.asarray(p["ns"])[mine]
+                    p["ts"] = np.asarray(p["ts"])[mine]
+                p["slots"] = (self.backend.key_slots(keys).astype(np.int64)
+                              if keys.size else np.zeros(0, np.int64))
+                restored[part] = p
+            self.timers.restore(restored)
+
+    def close(self) -> None:
+        self.fn.close()
+
+    # -- rescale hooks (StateAssignmentOperation analog) ---------------------
+    @staticmethod
+    def split_snapshot(snap: Dict[str, Any], max_parallelism: int,
+                       new_parallelism: int) -> List[Dict[str, Any]]:
+        """Each part carries the full timer set; ``restore_state`` filters by
+        the restoring subtask's key-group range."""
+        from flink_tpu.state.redistribute import split_keyed_snapshot
+        return split_keyed_snapshot(snap, HeapKeyedStateBackend.row_fields(snap),
+                                    max_parallelism, new_parallelism)
+
+    @staticmethod
+    def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Scale-down merge: keyed rows via the shared redistribution path,
+        timers unioned across every part (they are not per-slot row fields)."""
+        from flink_tpu.state.redistribute import merge_keyed_snapshots
+        fields = HeapKeyedStateBackend.row_fields(snaps[0]) if snaps else []
+        merged = merge_keyed_snapshots(snaps, fields)
+        timer_parts = [s["timers"] for s in snaps if "timers" in s]
+        if timer_parts:
+            union: Dict[str, Any] = {
+                "watermark": max(t.get("watermark", LONG_MIN)
+                                 for t in timer_parts)}
+            for part in ("event", "proc"):
+                union[part] = {
+                    f: np.concatenate([np.asarray(t[part][f])
+                                       for t in timer_parts])
+                    for f in ("keys", "ns", "ts")}
+            merged["timers"] = union
+        return merged
+
+
+def _normalize(out) -> List[StreamElement]:
+    if out is None:
+        return []
+    if isinstance(out, RecordBatch):
+        return [out]
+    return [o for o in out if o is not None and (not o.is_batch() or len(o))]

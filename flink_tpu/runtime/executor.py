@@ -171,6 +171,7 @@ class LocalExecutor:
             if (self.max_wall_ms is not None
                     and (time.monotonic() - t0) * 1000 >= self.max_wall_ms):
                 break
+            self._advance_processing_time(running)
             still: List[Tuple[RunningVertex, Any]] = []
             for rv, it in readers:
                 try:
@@ -222,6 +223,16 @@ class LocalExecutor:
         return JobExecutionResult(plan.job_name,
                                   (time.monotonic() - t0) * 1000.0,
                                   self._records)
+
+    def _advance_processing_time(self, running: Dict[int, RunningVertex]) -> None:
+        """Fire due processing-time timers on every vertex (the
+        ``ProcessingTimeService`` tick; local mode polls wall clock between
+        source rounds — same granularity as the mailbox checking its mail)."""
+        now_ms = int(time.time() * 1000)
+        for rv in running.values():
+            out = rv.operator.on_processing_time(now_ms)
+            if out:
+                self._route(rv, out)
 
     # ------------------------------------------------------- checkpointing
     def trigger_checkpoint(self, checkpoint_id: int) -> Dict[str, Any]:

@@ -1,0 +1,212 @@
+"""KeyedProcessFunction + timer service tests (KeyedProcessOperatorTest /
+InternalTimerServiceImplTest analogs)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.operators.process import (KeyedProcessFunction,
+                                         KeyedProcessOperator)
+from flink_tpu.runtime.timers import InternalTimerService
+from flink_tpu.state.api import ValueStateDescriptor
+from flink_tpu.testing.harness import KeyedOneInputOperatorHarness
+
+
+# ---------------------------------------------------------------- timer table
+
+def test_timer_fire_order_and_dedup():
+    t = InternalTimerService()
+    t.register_event_time([3, 1, 2], [30, 10, 20])
+    t.register_event_time([1], [10])  # duplicate — idempotent
+    slots, _, ts = t.advance_watermark(25)
+    np.testing.assert_array_equal(ts, [10, 20])
+    np.testing.assert_array_equal(slots, [1, 2])
+    slots, _, ts = t.advance_watermark(25)
+    assert slots.size == 0  # already fired
+    slots, _, ts = t.advance_watermark(100)
+    np.testing.assert_array_equal(slots, [3])
+
+
+def test_timer_delete():
+    t = InternalTimerService()
+    t.register_event_time([1, 2], [10, 10])
+    t.delete_event_time([1], [10])
+    slots, _, _ = t.advance_watermark(100)
+    np.testing.assert_array_equal(slots, [2])
+
+
+def test_timer_snapshot_restore():
+    t = InternalTimerService()
+    t.register_event_time([1, 2], [10, 20])
+    t.register_processing_time([5], [50])
+    snap = t.snapshot()
+    t2 = InternalTimerService()
+    t2.restore(snap)
+    slots, _, _ = t2.advance_watermark(15)
+    np.testing.assert_array_equal(slots, [1])
+    slots, _, _ = t2.advance_processing_time(60)
+    np.testing.assert_array_equal(slots, [5])
+
+
+def test_namespaced_timers_distinct():
+    t = InternalTimerService()
+    t.register_event_time([1, 1], [10, 10], namespaces=[100, 200])
+    slots, ns, _ = t.advance_watermark(10)
+    assert slots.size == 2
+    np.testing.assert_array_equal(np.sort(ns), [100, 200])
+
+
+# ------------------------------------------------------------ process operator
+
+class DedupeWithTimeout(KeyedProcessFunction):
+    """Emit first occurrence per key; per-key timer clears the seen flag after
+    ``timeout`` ms of event time (the classic state+timer pattern)."""
+
+    def __init__(self, timeout_ms: int = 100):
+        self.timeout_ms = timeout_ms
+        self.seen_desc = ValueStateDescriptor("seen", dtype=np.int64, default=0)
+
+    def process_batch(self, ctx, batch):
+        seen = ctx.state(self.seen_desc)
+        vals, alive = seen.get_rows(ctx.slots)
+        # first occurrence of each slot within the batch
+        _, first_idx = np.unique(ctx.slots, return_index=True)
+        first_mask = np.zeros(len(batch), bool)
+        first_mask[first_idx] = True
+        fresh = first_mask & ~(alive & (vals > 0))
+        seen.put_rows(ctx.slots, np.ones(len(batch), np.int64))
+        ctx.timer_service.register_event_time_timers(
+            ctx.slots[fresh], np.asarray(batch.timestamps)[fresh] + self.timeout_ms)
+        return [batch.select(fresh)]
+
+    def on_timer_batch(self, ctx, slots, timestamps):
+        ctx.state(self.seen_desc).clear_rows(slots)
+        return None
+
+
+def _batch(keys, ts):
+    return RecordBatch({"k": np.asarray(keys, np.int64)},
+                       timestamps=np.asarray(ts, np.int64))
+
+
+def test_process_function_dedupe_with_timer_reset():
+    h = KeyedOneInputOperatorHarness(
+        KeyedProcessOperator(DedupeWithTimeout(100), "k"))
+    h.process_batch(_batch([1, 2, 1], [10, 11, 12]))
+    assert [r["k"] for r in h.extract_output_rows()] == [1, 2]
+    h.clear_output()
+    # before the timeout: still deduped
+    h.process_batch(_batch([1], [50]))
+    assert h.extract_output_rows() == []
+    # watermark past the timer resets key 1
+    h.process_watermark(200)
+    h.process_batch(_batch([1], [210]))
+    assert [r["k"] for r in h.extract_output_rows()] == [1]
+
+
+def test_process_operator_snapshot_restore_keeps_timers_and_state():
+    op = KeyedProcessOperator(DedupeWithTimeout(100), "k")
+    h = KeyedOneInputOperatorHarness(op)
+    h.process_batch(_batch([1, 2], [10, 20]))
+    snap = h.snapshot()
+
+    op2 = KeyedProcessOperator(DedupeWithTimeout(100), "k")
+    h2 = KeyedOneInputOperatorHarness.restored(op2, snap)
+    # state survived: keys 1,2 still deduped
+    h2.process_batch(_batch([1, 2], [30]*2))
+    assert h2.extract_output_rows() == []
+    h2.clear_output()
+    # timers survived: firing past 110/120 resets both keys
+    h2.process_watermark(300)
+    h2.process_batch(_batch([1, 2], [310, 311]))
+    assert sorted(r["k"] for r in h2.extract_output_rows()) == [1, 2]
+
+
+class CountAndEmitOnTimer(KeyedProcessFunction):
+    """Accumulate per-key count; emit (key, count) when the timer fires —
+    exercises keys_of + emitting from on_timer_batch."""
+
+    def __init__(self):
+        self.cnt_desc = ValueStateDescriptor("cnt", dtype=np.int64, default=0)
+
+    def process_batch(self, ctx, batch):
+        cnt = ctx.state(self.cnt_desc)
+        vals, _ = cnt.get_rows(ctx.slots)
+        np.add.at(vals, np.arange(len(vals)), 0)  # copy semantics guard
+        # accumulate counts per slot within the batch
+        uniq, inverse, counts = np.unique(ctx.slots, return_inverse=True,
+                                          return_counts=True)
+        base, _ = cnt.get_rows(uniq)
+        cnt.put_rows(uniq, base + counts)
+        ctx.timer_service.register_event_time_timers(
+            uniq, np.full(uniq.size, 100, np.int64))
+        return None
+
+    def on_timer_batch(self, ctx, slots, timestamps):
+        vals, _ = ctx.state(self.cnt_desc).get_rows(slots)
+        return [RecordBatch({"k": ctx.keys_of(slots),
+                             "count": vals},
+                            timestamps=np.asarray(timestamps))]
+
+
+def test_emit_from_timer():
+    h = KeyedOneInputOperatorHarness(KeyedProcessOperator(CountAndEmitOnTimer(), "k"))
+    h.process_batch(_batch([7, 7, 8], [1, 2, 3]))
+    h.process_batch(_batch([7], [4]))
+    assert h.extract_output_rows() == []
+    h.process_watermark(150)
+    rows = sorted(({"k": r["k"], "count": r["count"]}
+                   for r in h.extract_output_rows()), key=lambda r: r["k"])
+    assert rows == [{"k": 7, "count": 3}, {"k": 8, "count": 1}]
+
+
+def test_process_in_datastream_pipeline():
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    env = StreamExecutionEnvironment()
+    rows = [{"k": i % 3, "v": i} for i in range(9)]
+    out = (env.from_collection(rows, timestamp_column=None)
+           .assign_timestamps_and_watermarks(0, timestamp_fn=lambda c: np.asarray(c["v"]) * 10)
+           .key_by("k")
+           .process(DedupeWithTimeout(1_000_000))
+           .execute_and_collect())
+    assert sorted(r["k"] for r in out) == [0, 1, 2]
+
+
+def test_scale_down_merges_timers_from_all_subtasks():
+    """merge_snapshots must union timers, not keep only subtask 0's."""
+    snaps = []
+    for sub in range(2):
+        op = KeyedProcessOperator(DedupeWithTimeout(100), "k")
+        h = KeyedOneInputOperatorHarness(op)
+        h.process_batch(_batch([sub * 10 + 1], [10]))  # distinct keys
+        snaps.append(h.snapshot())
+    merged = KeyedProcessOperator.merge_snapshots(snaps)
+    op2 = KeyedProcessOperator(DedupeWithTimeout(100), "k")
+    h2 = KeyedOneInputOperatorHarness.restored(op2, merged)
+    # both keys' timers must fire and reset the dedupe state
+    h2.process_watermark(1000)
+    h2.process_batch(_batch([1, 11], [1100, 1101]))
+    assert sorted(r["k"] for r in h2.extract_output_rows()) == [1, 11]
+
+
+class EmitOnProcTimer(KeyedProcessFunction):
+    def process_batch(self, ctx, batch):
+        # timer at t=0: due as soon as the executor's wall clock ticks
+        ctx.timer_service.register_processing_time_timers(
+            np.unique(ctx.slots), np.zeros(len(np.unique(ctx.slots)), np.int64))
+        return None
+
+    def on_timer_batch(self, ctx, slots, timestamps):
+        return [RecordBatch({"fired_k": ctx.keys_of(slots)})]
+
+
+def test_executor_fires_processing_time_timers():
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    env = StreamExecutionEnvironment()
+    rows = [{"k": i % 2} for i in range(8)]
+    out = (env.from_collection(rows, batch_size=2)  # several source rounds
+           .key_by("k").process(EmitOnProcTimer())
+           .execute_and_collect())
+    assert sorted(set(r["fired_k"] for r in out)) == [0, 1]

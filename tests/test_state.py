@@ -1,0 +1,271 @@
+"""State API + heap backend tests (analog of HeapStateBackendTest /
+StateBackendTestBase and TTL tests in runtime/state/ttl/)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.functions import AvgAggregator, SumAggregator
+from flink_tpu.state.api import (AggregatingStateDescriptor,
+                                 ListStateDescriptor, MapStateDescriptor,
+                                 ReducingStateDescriptor, StateTtlConfig,
+                                 UpdateType, ValueStateDescriptor)
+from flink_tpu.state.heap import HeapKeyedStateBackend
+from flink_tpu.state.redistribute import (merge_keyed_snapshots,
+                                          split_keyed_snapshot)
+
+
+def make_backend(clock=None):
+    if clock is None:
+        return HeapKeyedStateBackend()
+    return HeapKeyedStateBackend(clock=clock)
+
+
+def test_value_state_scalar_roundtrip():
+    b = make_backend()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.float64, default=0.0))
+    b.set_current_key(7)
+    assert st.value() == 0.0
+    st.update(3.5)
+    assert st.value() == 3.5
+    b.set_current_key(8)
+    assert st.value() == 0.0
+    b.set_current_key(7)
+    st.clear()
+    assert st.value() == 0.0
+
+
+def test_value_state_batched_rows():
+    b = make_backend()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.int64, default=-1))
+    slots = b.key_slots(np.array([10, 20, 30, 10]))
+    st.put_rows(slots, np.array([1, 2, 3, 4]))
+    vals, alive = st.get_rows(slots)
+    assert alive.all()
+    # duplicate slot: last write wins
+    np.testing.assert_array_equal(vals, [4, 2, 3, 4])
+    other = b.key_slots(np.array([99]))
+    vals, alive = st.get_rows(other)
+    assert not alive[0] and vals[0] == -1
+
+
+def test_value_state_object_dtype():
+    b = make_backend()
+    st = b.get_state(ValueStateDescriptor("v"))  # dtype=None -> objects
+    b.set_current_key("alice")
+    st.update({"nested": [1, 2]})
+    assert st.value() == {"nested": [1, 2]}
+    b.set_current_key("bob")
+    assert st.value() is None
+
+
+def test_list_state_batched_append_groups_by_slot():
+    b = make_backend()
+    st = b.get_state(ListStateDescriptor("l"))
+    slots = b.key_slots(np.array([1, 2, 1, 1, 2]))
+    st.add_rows(slots, ["a", "b", "c", "d", "e"])
+    lists = st.get_rows(b.key_slots(np.array([1, 2])))
+    assert lists[0] == ["a", "c", "d"]
+    assert lists[1] == ["b", "e"]
+    b.set_current_key(1)
+    st.add("z")
+    assert st.get() == ["a", "c", "d", "z"]
+    st.update(["only"])
+    assert st.get() == ["only"]
+    st.clear()
+    assert st.get() == []
+
+
+def test_map_state():
+    b = make_backend()
+    st = b.get_state(MapStateDescriptor("m"))
+    b.set_current_key(5)
+    assert st.is_empty()
+    st.put("x", 1)
+    st.put("y", 2)
+    assert st.get("x") == 1 and st.contains("y")
+    assert sorted(st.keys()) == ["x", "y"]
+    st.remove("x")
+    assert not st.contains("x")
+    b.set_current_key(6)
+    assert st.is_empty()  # per-key isolation
+
+
+def test_reducing_state_batched_fold():
+    import jax.numpy as jnp
+
+    b = make_backend()
+    st = b.get_state(ReducingStateDescriptor("r", SumAggregator(jnp.float64)))
+    slots = b.key_slots(np.array([1, 2, 1, 1]))
+    st.add_rows(slots, np.array([1.0, 10.0, 2.0, 3.0]))
+    res, alive = st.get_rows(b.key_slots(np.array([1, 2])))
+    assert alive.all()
+    np.testing.assert_allclose(res, [6.0, 10.0])
+    b.set_current_key(2)
+    st.add(5.0)
+    assert st.get() == 15.0
+
+
+def test_aggregating_state_nontrivial_acc():
+    import jax.numpy as jnp
+
+    b = make_backend()
+    st = b.get_state(AggregatingStateDescriptor("a", AvgAggregator(jnp.float64)))
+    slots = b.key_slots(np.array([1, 1, 2]))
+    st.add_rows(slots, np.array([2.0, 4.0, 9.0]))
+    res, alive = st.get_rows(b.key_slots(np.array([1, 2])))
+    np.testing.assert_allclose(res, [3.0, 9.0])
+
+
+def test_snapshot_restore_roundtrip():
+    import jax.numpy as jnp
+
+    b = make_backend()
+    v = b.get_state(ValueStateDescriptor("v", dtype=np.float32, default=0.0))
+    l = b.get_state(ListStateDescriptor("l"))
+    r = b.get_state(ReducingStateDescriptor("r", SumAggregator(jnp.float32)))
+    slots = b.key_slots(np.array([100, 200, 300]))
+    v.put_rows(slots, np.array([1.0, 2.0, 3.0]))
+    l.add_rows(slots, ["a", "b", "c"])
+    r.add_rows(np.array([slots[0], slots[0]]), np.array([5.0, 6.0]))
+    snap = b.snapshot()
+
+    b2 = make_backend()
+    b2.get_state(ValueStateDescriptor("v", dtype=np.float32, default=0.0))
+    b2.get_state(ListStateDescriptor("l"))
+    b2.get_state(ReducingStateDescriptor("r", SumAggregator(jnp.float32)))
+    b2.restore(snap)
+    b2.set_current_key(200)
+    assert b2._states["v"].value() == pytest.approx(2.0)
+    assert b2._states["l"].get() == ["b"]
+    b2.set_current_key(100)
+    assert b2._states["r"].get() == pytest.approx(11.0)
+
+
+def test_snapshot_splits_by_key_group_for_rescale():
+    b = make_backend()
+    v = b.get_state(ValueStateDescriptor("v", dtype=np.int64, default=0))
+    keys = np.arange(1000, dtype=np.int64)
+    slots = b.key_slots(keys)
+    v.put_rows(slots, keys * 2)
+    snap = b.snapshot()
+    parts = split_keyed_snapshot(snap, HeapKeyedStateBackend.row_fields(snap),
+                                 max_parallelism=128, new_parallelism=4)
+    assert len(parts) == 4
+    total = 0
+    for p in parts:
+        b2 = make_backend()
+        b2.get_state(ValueStateDescriptor("v", dtype=np.int64, default=0))
+        b2.restore(p)
+        n = b2.num_keys
+        total += n
+        if n:
+            ks = np.asarray(b2._index.reverse_keys())
+            vals, alive = b2._states["v"].get_rows(
+                b2.key_slots(ks))
+            assert alive.all()
+            np.testing.assert_array_equal(vals, ks * 2)
+    assert total == 1000
+    # and merge back (scale-down)
+    merged = merge_keyed_snapshots(parts, HeapKeyedStateBackend.row_fields(snap))
+    b3 = make_backend()
+    b3.get_state(ValueStateDescriptor("v", dtype=np.int64, default=0))
+    b3.restore(merged)
+    assert b3.num_keys == 1000
+
+
+def test_ttl_expiry_and_snapshot_cleanup():
+    now = [1000]
+    b = make_backend(clock=lambda: now[0])
+    ttl = StateTtlConfig.new_builder(ttl_ms=100).build()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.int64, default=-1,
+                                          ttl=ttl))
+    b.set_current_key(1)
+    st.update(42)
+    assert st.value() == 42
+    now[0] = 1050
+    assert st.value() == 42  # not yet expired
+    now[0] = 1200
+    assert st.value() == -1  # expired -> default (NeverReturnExpired)
+    # full-snapshot cleanup: expired rows dropped on restore
+    b.set_current_key(2)
+    st.update(7)  # fresh at t=1200
+    snap = b.snapshot()
+    b2 = make_backend(clock=lambda: now[0])
+    st2 = b2.get_state(ValueStateDescriptor("v", dtype=np.int64, default=-1,
+                                            ttl=ttl))
+    b2.restore(snap)
+    b2.set_current_key(1)
+    assert st2.value() == -1
+    b2.set_current_key(2)
+    assert st2.value() == 7
+
+
+def test_ttl_read_refresh():
+    now = [0]
+    b = make_backend(clock=lambda: now[0])
+    ttl = (StateTtlConfig.new_builder(ttl_ms=100)
+           .set_update_type(UpdateType.OnReadAndWrite).build())
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.int64, default=-1,
+                                          ttl=ttl))
+    b.set_current_key(1)
+    st.update(1)
+    now[0] = 80
+    assert st.value() == 1  # read refreshes the timestamp
+    now[0] = 160
+    assert st.value() == 1  # still alive because of the read at t=80
+    now[0] = 300
+    assert st.value() == -1
+
+
+def test_string_keys_use_object_index():
+    b = make_backend()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.float64, default=0.0))
+    slots = b.key_slots(np.array(["a", "b", "a"], dtype=object))
+    st.put_rows(slots, np.array([1.0, 2.0, 3.0]))
+    b.set_current_key("a")
+    assert st.value() == 3.0
+    np.testing.assert_array_equal(
+        np.sort(b.slot_keys(b.key_slots(np.array(["a", "b"], dtype=object)))),
+        ["a", "b"])
+
+
+def test_restore_then_snapshot_preserves_unregistered_state():
+    """Restored-but-not-yet-registered states must survive a checkpoint
+    (lazy descriptor binding must not lose state)."""
+    b = make_backend()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.int64, default=0))
+    b.set_current_key(1)
+    st.update(42)
+    snap = b.snapshot()
+
+    b2 = make_backend()
+    b2.restore(snap)          # no descriptor registered yet
+    snap2 = b2.snapshot()     # checkpoint before first use
+    b3 = make_backend()
+    b3.restore(snap2)
+    st3 = b3.get_state(ValueStateDescriptor("v", dtype=np.int64, default=0))
+    b3.set_current_key(1)
+    assert st3.value() == 42
+
+
+def test_ttl_append_does_not_resurrect_expired_content():
+    import jax.numpy as jnp
+
+    now = [0]
+    b = make_backend(clock=lambda: now[0])
+    ttl = StateTtlConfig.new_builder(ttl_ms=100).build()
+    lst = b.get_state(ListStateDescriptor("l", ttl=ttl))
+    red = b.get_state(ReducingStateDescriptor("r", SumAggregator(jnp.float64),
+                                              ttl=ttl))
+    mp = b.get_state(MapStateDescriptor("m", ttl=ttl))
+    b.set_current_key(1)
+    lst.add("old")
+    red.add(10.0)
+    mp.put("old", 1)
+    now[0] = 500  # everything expired
+    lst.add("new")
+    assert lst.get() == ["new"]
+    red.add(5.0)
+    assert red.get() == 5.0
+    mp.put("new", 2)
+    assert dict(mp.items()) == {"new": 2}
