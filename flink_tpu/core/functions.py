@@ -37,6 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
+#: numpy ufunc per scatter kind — the host-tier mirror of ops/scatter.py's
+#: device kinds; shared by every host fold path (heap backend, sessions)
+SCATTER_UFUNCS = {"add": np.add, "min": np.minimum, "max": np.maximum}
+
+
 class Function:
     """Marker base for all user functions (``Function.java``)."""
 

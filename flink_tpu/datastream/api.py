@@ -325,12 +325,27 @@ class WindowedStream:
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
 
-        def factory():
-            return WindowAggOperator(
-                assigner=assigner, agg=agg, key_column=keyed.key_column,
-                value_column=value_column, value_selector=value_selector,
-                allowed_lateness_ms=lateness, trigger=trigger,
-                output_column=output_column, name=name)
+        from flink_tpu.windowing.assigners import SessionGap
+        if isinstance(assigner, SessionGap):
+            if trigger is not None:
+                raise ValueError(
+                    "custom triggers are not supported on session windows "
+                    "(sessions fire when the gap closes); remove .trigger()")
+            from flink_tpu.operators.session_window import SessionWindowOperator
+
+            def factory():
+                return SessionWindowOperator(
+                    assigner, agg, key_column=keyed.key_column,
+                    value_column=value_column, value_selector=value_selector,
+                    allowed_lateness_ms=lateness,
+                    output_column=output_column, name=name)
+        else:
+            def factory():
+                return WindowAggOperator(
+                    assigner=assigner, agg=agg, key_column=keyed.key_column,
+                    value_column=value_column, value_selector=value_selector,
+                    allowed_lateness_ms=lateness, trigger=trigger,
+                    output_column=output_column, name=name)
 
         t = keyed._then(name, factory)
         return DataStream(keyed.env, t)

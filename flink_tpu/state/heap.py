@@ -487,9 +487,9 @@ class HeapAggregatingState(AggregatingState, _HeapStateBase):
         lifted = jax.tree_util.tree_leaves(self.agg.lift(values))
         lifted = [np.asarray(l) for l in lifted]
         if self._kinds is not None:
+            from flink_tpu.core.functions import SCATTER_UFUNCS
             for leaf, l, kind in zip(self._leaves, lifted, self._kinds):
-                ufunc = {"add": np.add, "min": np.minimum, "max": np.maximum}[kind]
-                ufunc.at(leaf, slots, l.astype(leaf.dtype))
+                SCATTER_UFUNCS[kind].at(leaf, slots, l.astype(leaf.dtype))
         else:
             order, spans = _segment_order_spans(slots)
             sv = [l[order] for l in lifted]
@@ -560,6 +560,10 @@ class HeapReducingState(HeapAggregatingState, ReducingState):
                                               ttl=desc.ttl)
         super().__init__(backend, agg_desc)
 
+
+#: every field a state impl may put in its snapshot dict (restore parses
+#: flattened "state.<name>.<field>" keys against this closed set)
+_STATE_SNAPSHOT_FIELDS = ("rows", "present", "ttl_ts", "ttl_expired")
 
 _IMPLS = {
     "value": HeapValueState,
@@ -685,8 +689,13 @@ class HeapKeyedStateBackend:
         cls = ObjectKeyIndex if kind == "ObjectKeyIndex" else KeyIndex
         self._index = cls.restore(snap["key_index"])
         for name in snap.get("state_names", []):
-            sub = {f.split(".", 2)[2]: v for f, v in snap.items()
-                   if f.startswith(f"state.{name}.")}
+            # match against the KNOWN field suffixes so a state name
+            # containing '.' (or one that prefixes another) parses correctly
+            sub = {}
+            for f in _STATE_SNAPSHOT_FIELDS:
+                key = f"state.{name}.{f}"
+                if key in snap:
+                    sub[f] = snap[key]
             st = self._states.get(name)
             if st is None:
                 self._pending_restore[name] = sub  # lazy-bind on registration

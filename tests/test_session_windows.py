@@ -1,0 +1,261 @@
+"""Session (merging) window tests — modeled on the session cases of the
+reference's WindowOperatorTest (flink-streaming-java/.../windowing/
+WindowOperatorTest.java: testSessionWindows / testSessionWindowsWithLateness /
+merging snapshot cases)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.core.functions import SumAggregator
+from flink_tpu.operators.session_window import SessionWindowOperator
+from flink_tpu.testing.harness import KeyedOneInputOperatorHarness
+from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+
+def make_op(gap=10, lateness=0):
+    import jax.numpy as jnp
+    return SessionWindowOperator(
+        EventTimeSessionWindows(gap), SumAggregator(jnp.float64),
+        key_column="k", value_column="v", output_column="v",
+        allowed_lateness_ms=lateness)
+
+
+def _batch(keys, vals, ts):
+    return RecordBatch({"k": np.asarray(keys, np.int64),
+                        "v": np.asarray(vals, np.float64)},
+                       timestamps=np.asarray(ts, np.int64))
+
+
+def fired(h):
+    rows = h.extract_output_rows()
+    return sorted(((r["k"], r["window_start"], r["window_end"], r["v"])
+                   for r in rows))
+
+
+def test_single_session_fires_after_gap():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10))
+    h.process_batch(_batch([1, 1, 1], [1, 2, 3], [0, 5, 8]))
+    h.process_watermark(17)  # session end = 8+10 = 18 > 17: not yet
+    assert fired(h) == []
+    h.process_watermark(18)
+    assert fired(h) == [(1, 0, 18, 6.0)]
+
+
+def test_gap_splits_sessions():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10))
+    h.process_batch(_batch([1, 1], [1, 2], [0, 30]))  # gap 30 > 10: two sessions
+    h.process_watermark(100)
+    assert fired(h) == [(1, 0, 10, 1.0), (1, 30, 40, 2.0)]
+
+
+def test_cross_batch_merge_extends_session():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10))
+    h.process_batch(_batch([1], [1], [0]))
+    h.process_batch(_batch([1], [2], [8]))   # within gap of [0,10): merge
+    h.process_watermark(100)
+    assert fired(h) == [(1, 0, 18, 3.0)]
+
+
+def test_bridging_record_merges_two_stored_sessions():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10))
+    # two disjoint sessions: [0,10) and [18,28)
+    h.process_batch(_batch([1, 1], [1, 2], [0, 18]))
+    # bridging record at 9: [9,19) overlaps both -> one merged session
+    h.process_batch(_batch([1], [10], [9]))
+    h.process_watermark(100)
+    assert fired(h) == [(1, 0, 28, 13.0)]
+
+
+def test_keys_are_isolated():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10))
+    h.process_batch(_batch([1, 2], [1, 5], [0, 3]))
+    h.process_watermark(100)
+    assert fired(h) == [(1, 0, 10, 1.0), (2, 3, 13, 5.0)]
+
+
+def test_late_record_within_lateness_merges_and_refires():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10, lateness=100))
+    h.process_batch(_batch([1], [1], [0]))
+    h.process_watermark(50)  # fires [0,10) -> 1.0
+    assert fired(h) == [(1, 0, 10, 1.0)]
+    h.clear_output()
+    # late record at ts=5 (watermark 50, within lateness horizon 110)
+    h.process_batch(_batch([1], [2], [5]))
+    assert fired(h) == [(1, 0, 15, 3.0)]  # re-fired enlarged session
+
+
+def test_beyond_lateness_dropped():
+    op = make_op(gap=10, lateness=0)
+    h = KeyedOneInputOperatorHarness(op)
+    h.process_batch(_batch([1], [1], [0]))
+    h.process_watermark(50)
+    h.clear_output()
+    h.process_batch(_batch([1], [2], [5]))  # end 15 + lateness 0 <= 50: drop
+    h.process_watermark(100)
+    assert fired(h) == []
+    assert op.late_dropped == 1
+
+
+def test_snapshot_restore_continues_sessions():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10))
+    h.process_batch(_batch([1, 2], [1, 2], [0, 3]))
+    snap = h.snapshot()
+    h2 = KeyedOneInputOperatorHarness.restored(make_op(gap=10), snap)
+    h2.process_batch(_batch([1], [10], [8]))  # merges into restored session
+    h2.process_watermark(100)
+    assert fired(h2) == [(1, 0, 18, 11.0), (2, 3, 13, 2.0)]
+
+
+def test_rescale_split_and_merge_roundtrip():
+    h = KeyedOneInputOperatorHarness(make_op(gap=10))
+    keys = np.arange(50, dtype=np.int64)
+    h.process_batch(_batch(keys, np.ones(50), np.zeros(50)))
+    snap = h.snapshot()
+    parts = SessionWindowOperator.split_snapshot(snap, 128, 4)
+    assert sum(len(p["session_keys"]) for p in parts) == 50
+    # each part restores and fires only its keys
+    seen = []
+    for i, p in enumerate(parts):
+        hp = KeyedOneInputOperatorHarness.restored(make_op(gap=10), p)
+        hp.process_watermark(100)
+        seen.extend(k for k, *_ in fired(hp))
+    assert sorted(seen) == list(range(50))
+    # merge back
+    merged = SessionWindowOperator.merge_snapshots(parts)
+    hm = KeyedOneInputOperatorHarness.restored(make_op(gap=10), merged)
+    hm.process_watermark(100)
+    assert len(fired(hm)) == 50
+
+
+def test_session_multiple_batch_sessions_same_batch_merge_with_store():
+    h = KeyedOneInputOperatorHarness(make_op(gap=5))
+    h.process_batch(_batch([1], [1], [10]))          # stored [10,15)
+    # batch contains two local sessions for key 1: [0,5) and [13,18)
+    h.process_batch(_batch([1, 1], [2, 3], [0, 13]))
+    h.process_watermark(100)
+    # [13,18) merges with [10,15) -> [10,18); [0,5) stays separate
+    assert fired(h) == [(1, 0, 5, 2.0), (1, 10, 18, 4.0)]
+
+
+def test_session_end_to_end_datastream():
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    env = StreamExecutionEnvironment()
+    rows = [{"k": 1, "v": 1.0, "t": 0}, {"k": 1, "v": 2.0, "t": 4},
+            {"k": 1, "v": 4.0, "t": 50}, {"k": 2, "v": 8.0, "t": 2}]
+    out = (env.from_collection(rows)
+           .assign_timestamps_and_watermarks(0, timestamp_column="t")
+           .key_by("k")
+           .window(EventTimeSessionWindows(10))
+           .sum("v")
+           .execute_and_collect())
+    got = sorted((r["k"], r["window_start"], r["window_end"], r["v"])
+                 for r in out)
+    assert got == [(1, 0, 14, 3.0), (1, 50, 60, 4.0), (2, 2, 12, 8.0)]
+
+
+def test_session_avg_nontrivial_acc():
+    import jax.numpy as jnp
+    from flink_tpu.core.functions import AvgAggregator
+
+    op = SessionWindowOperator(
+        EventTimeSessionWindows(10), AvgAggregator(jnp.float64),
+        key_column="k", value_column="v")
+    h = KeyedOneInputOperatorHarness(op)
+    h.process_batch(_batch([1, 1], [2.0, 4.0], [0, 5]))
+    h.process_watermark(100)
+    rows = h.extract_output_rows()
+    assert len(rows) == 1 and rows[0]["result"] == pytest.approx(3.0)
+
+
+def test_no_duplicate_emission_after_late_refire():
+    """A re-fired session must be marked fired — the next watermark advance
+    must not emit it again."""
+    h = KeyedOneInputOperatorHarness(make_op(gap=10, lateness=100))
+    h.process_batch(_batch([1], [1], [0]))
+    h.process_watermark(50)
+    h.clear_output()
+    h.process_batch(_batch([1], [2], [5]))  # late merge -> immediate re-fire
+    assert fired(h) == [(1, 0, 15, 3.0)]
+    h.clear_output()
+    h.process_watermark(60)  # must NOT re-emit
+    assert fired(h) == []
+
+
+def test_batch_boundary_does_not_change_sessionization():
+    """Records exactly `gap` apart must split the same way whether they
+    arrive in one batch or two (merge-boundary consistency)."""
+    h1 = KeyedOneInputOperatorHarness(make_op(gap=100))
+    h1.process_batch(_batch([1, 1], [1, 2], [0, 100]))
+    h1.process_watermark(1000)
+    h2 = KeyedOneInputOperatorHarness(make_op(gap=100))
+    h2.process_batch(_batch([1], [1], [0]))
+    h2.process_batch(_batch([1], [2], [100]))
+    h2.process_watermark(1000)
+    assert fired(h1) == fired(h2) == [(1, 0, 100, 1.0), (1, 100, 200, 2.0)]
+
+
+def test_late_record_overlapping_retained_session_survives():
+    """Lateness is judged on the post-merge window: a record whose own
+    window would be late still merges into a retained session."""
+    op = make_op(gap=40, lateness=100)
+    h = KeyedOneInputOperatorHarness(op)
+    h.process_batch(_batch([1], [1], [60]))   # session [60,100)
+    h.process_watermark(151)                  # fired; retained until 200
+    h.clear_output()
+    h.process_batch(_batch([1], [2], [70]))   # own end 110+100=210>151? 70+40+100=210>151 not late anyway
+    h.clear_output()
+    # ts=10: own window [10,50)+lateness=150 <= 151 -> late alone, but
+    # [10,50) does NOT overlap [60,100): dropped
+    h.process_batch(_batch([1], [4], [10]))
+    assert op.late_dropped == 1
+    # ts=25: own cleanup 25+40+100=165 > 151 -> not late, merges nothing
+    h.clear_output()
+    op2 = make_op(gap=40, lateness=100)
+    h3 = KeyedOneInputOperatorHarness(op2)
+    h3.process_batch(_batch([1], [1], [60]))
+    h3.process_watermark(151)
+    h3.clear_output()
+    # ts=30: own cleanup 30+40+100=170 > 151: not late; [30,70) overlaps
+    # [60,100) -> merges and re-fires enlarged session
+    h3.process_batch(_batch([1], [8], [30]))
+    assert fired(h3) == [(1, 30, 100, 9.0)]
+    assert op2.late_dropped == 0
+
+
+def test_late_record_that_merges_is_not_dropped_even_if_own_window_late():
+    op = make_op(gap=40, lateness=100)
+    h = KeyedOneInputOperatorHarness(op)
+    h.process_batch(_batch([1], [1], [100]))  # session [100,140)
+    h.process_watermark(235)                  # fired; retained until 240
+    h.clear_output()
+    # ts=90: own cleanup 90+40+100=230 <= 235 -> late alone, BUT [90,130)
+    # overlaps retained [100,140): must merge + re-fire, not drop
+    h.process_batch(_batch([1], [2], [90]))
+    assert fired(h) == [(1, 90, 140, 3.0)]
+    assert op.late_dropped == 0
+
+
+def test_trigger_on_session_raises():
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    env = StreamExecutionEnvironment()
+    with pytest.raises(ValueError, match="session"):
+        (env.from_collection([{"k": 1, "v": 1.0}])
+         .key_by("k").window(EventTimeSessionWindows(10))
+         .trigger(CountTrigger(2)).sum("v"))
+
+
+def test_split_zeroes_counter_in_all_but_first_part():
+    op = make_op(gap=10, lateness=0)
+    h = KeyedOneInputOperatorHarness(op)
+    h.process_batch(_batch([1], [1], [0]))
+    h.process_watermark(50)
+    h.process_batch(_batch([1], [2], [5]))  # dropped
+    assert op.late_dropped == 1
+    snap = h.snapshot()
+    parts = SessionWindowOperator.split_snapshot(snap, 128, 4)
+    total = sum(p.get("late_dropped", 0) for p in parts)
+    assert total == 1
