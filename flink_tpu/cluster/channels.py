@@ -1,0 +1,122 @@
+"""Data-plane channels between subtasks, with credit-style backpressure.
+
+Analog of the reference's network stack (``ResultPartition``/``InputGate``
+over Netty with credit-based flow control, SURVEY §2.2 "Network stack"):
+in-process exchanges are bounded queues — a full queue blocks the producer,
+which is exactly the reference's credit-exhaustion backpressure, while
+barrier alignment *stops polling* a blocked channel so its data queues up
+behind the barrier (``SingleCheckpointBarrierHandler`` semantics: blocked
+channels buffer, they don't drop).
+
+Partitioners mirror ``runtime/partitioner/``: forward, hash (key groups →
+operator index, the exact ``KeyGroupStreamPartitioner`` formula), rebalance
+(round-robin), broadcast.  Control elements (watermarks, barriers, end of
+input) always go to every target channel, like the reference's
+``RecordWriter.broadcastEvent``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.core import keygroups
+from flink_tpu.core.batch import RecordBatch, StreamElement
+
+
+class LocalChannel:
+    """Bounded in-memory channel (one producer subtask → one consumer
+    subtask).  ``capacity`` plays the role of the channel's credit budget."""
+
+    def __init__(self, capacity: int = 32, name: str = ""):
+        self.capacity = capacity
+        self.name = name
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+
+    def put(self, el: StreamElement, timeout_s: Optional[float] = None) -> bool:
+        with self._not_full:
+            while len(self._q) >= self.capacity and not self._closed:
+                if not self._not_full.wait(timeout=timeout_s):
+                    return False
+            if self._closed:
+                return False
+            self._q.append(el)
+            self._not_empty.notify()
+            return True
+
+    def poll(self, timeout_s: float = 0.0) -> Optional[StreamElement]:
+        with self._not_empty:
+            if not self._q and timeout_s > 0:
+                self._not_empty.wait(timeout=timeout_s)
+            if not self._q:
+                return None
+            el = self._q.popleft()
+            self._not_full.notify()
+            return el
+
+    def close(self) -> None:
+        """Unblock producers/consumers (used on cancel/teardown)."""
+        with self._lock:
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+class OutputDispatcher:
+    """Routes one subtask's emissions to target channels per edge semantics
+    (``RecordWriter`` + ``StreamPartitioner`` analog)."""
+
+    def __init__(self, partitioning: str, channels: Sequence[LocalChannel],
+                 max_parallelism: int = 128, subtask_index: int = 0):
+        self.partitioning = partitioning
+        self.channels = list(channels)
+        self.max_parallelism = max_parallelism
+        self._rr = subtask_index  # stagger round-robin starts across producers
+
+    def emit(self, el: StreamElement) -> None:
+        n = len(self.channels)
+        if n == 0:
+            return
+        if not isinstance(el, RecordBatch):
+            for ch in self.channels:   # broadcast control elements
+                ch.put(el)
+            return
+        if len(el) == 0:
+            return
+        if n == 1:
+            self.channels[0].put(el)
+        elif self.partitioning == "hash":
+            self._emit_hash(el)
+        elif self.partitioning == "broadcast":
+            for ch in self.channels:
+                ch.put(el)
+        elif self.partitioning in ("rebalance", "rescale", "shuffle"):
+            self.channels[self._rr % n].put(el)
+            self._rr += 1
+        else:  # forward with n>1 targets is a wiring bug
+            raise ValueError(
+                f"forward edge cannot fan out to {n} channels")
+
+    def _emit_hash(self, batch: RecordBatch) -> None:
+        kg = batch.key_groups
+        if kg is None:
+            raise ValueError("hash edge requires key_groups on the batch "
+                             "(key_by upstream)")
+        n = len(self.channels)
+        # KeyGroupRangeAssignment.computeOperatorIndexForKeyGroup
+        target = (np.asarray(kg, np.int64) * n) // self.max_parallelism
+        for t in range(n):
+            sel = target == t
+            if sel.any():
+                self.channels[t].put(batch.select(sel))

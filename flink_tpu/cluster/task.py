@@ -1,0 +1,306 @@
+"""Subtask: one parallel instance of a job vertex, on its own thread.
+
+Analog of ``runtime/taskmanager/Task.java:564`` + the StreamTask mailbox
+(``MailboxProcessor.java:66``): a dedicated thread runs a loop whose default
+action is polling input channels and whose "mail" is the command queue
+(checkpoint triggers, cancel).  All operator mutation happens on this one
+thread — the reference's single-writer discipline.
+
+Covers both task flavors:
+- **SourceSubtask** (``SourceStreamTask`` analog): drives a split iterator,
+  injects checkpoint barriers *between* elements on command (trigger RPC →
+  mail, same as the reference's source-task checkpoint trigger, SURVEY §3.4),
+  and snapshots its replay offset (element count) — the FLIP-27
+  split-state analog for deterministic replayable sources.
+- **Subtask**: consumes input channels with per-channel watermark valves
+  (``StatusWatermarkValve``) and ALIGNED barrier handling: a channel that
+  delivered barrier N stops being polled until every channel delivered N
+  (``SingleCheckpointBarrierHandler.processBarrier:194``), then the operator
+  snapshot is taken and the barrier forwarded downstream.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
+                                  EndOfInput, RecordBatch, StreamElement,
+                                  Watermark)
+from flink_tpu.core.functions import RuntimeContext
+from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
+from flink_tpu.runtime.executor import WatermarkValve
+
+
+class TaskStates:
+    DEPLOYING = "DEPLOYING"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    CANCELED = "CANCELED"
+    FAILED = "FAILED"
+
+
+class _Cancel(Exception):
+    pass
+
+
+class SubtaskBase:
+    def __init__(self, vertex_uid: str, subtask_index: int, operator,
+                 outputs: Sequence[OutputDispatcher],
+                 ctx: RuntimeContext,
+                 listener: "TaskListener"):
+        self.vertex_uid = vertex_uid
+        self.subtask_index = subtask_index
+        self.operator = operator
+        self.outputs = list(outputs)
+        self.ctx = ctx
+        self.listener = listener
+        self.commands: "queue.Queue[tuple]" = queue.Queue()
+        self.state = TaskStates.DEPLOYING
+        self._thread: Optional[threading.Thread] = None
+        self._cancelled = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, restore: Optional[Dict[str, Any]] = None) -> None:
+        self._restore = restore
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"task-{self.vertex_uid}-{self.subtask_index}", daemon=True)
+        self._thread.start()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+        self.commands.put(("cancel",))
+
+    def join(self, timeout_s: float = 10.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    # -- shared plumbing -----------------------------------------------------
+    def _emit(self, elements: Sequence[StreamElement]) -> None:
+        for el in elements:
+            for out in self.outputs:
+                out.emit(el)
+
+    def _transition(self, state: str, error: Optional[str] = None) -> None:
+        self.state = state
+        self.listener.task_state_changed(self.vertex_uid, self.subtask_index,
+                                         state, error)
+
+    def _open_and_restore(self) -> None:
+        self.operator.open(self.ctx)
+        if self._restore is not None and self._restore.get("operator") is not None:
+            self.operator.restore_state(self._restore["operator"])
+
+    def _check_cancel(self) -> None:
+        if self._cancelled.is_set():
+            raise _Cancel()
+
+    def _run(self) -> None:
+        try:
+            self._open_and_restore()
+            self._transition(TaskStates.RUNNING)
+            self._invoke()
+            self.operator.close()
+            self._transition(TaskStates.FINISHED)
+        except _Cancel:
+            self._transition(TaskStates.CANCELED)
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            self._transition(TaskStates.FAILED, f"{type(e).__name__}: {e}")
+
+    def _invoke(self) -> None:
+        raise NotImplementedError
+
+
+class SourceSubtask(SubtaskBase):
+    """Runs one source split; checkpoints replay offsets."""
+
+    def __init__(self, vertex_uid: str, subtask_index: int, operator,
+                 outputs, ctx, listener, split):
+        super().__init__(vertex_uid, subtask_index, operator, outputs, ctx,
+                         listener)
+        self.split = split
+        self._emitted = 0          # elements pulled from the split so far
+
+    def _invoke(self) -> None:
+        it = iter(self.split.read())
+        skip = (self._restore or {}).get("source_offset", 0)
+        for _ in range(skip):      # deterministic replay: skip to the offset
+            try:
+                next(it)
+            except StopIteration:
+                break
+        self._emitted = skip
+        while True:
+            self._check_cancel()
+            self._drain_commands()
+            try:
+                el = next(it)
+            except StopIteration:
+                break
+            self._emitted += 1
+            if isinstance(el, RecordBatch):
+                self._emit(self.operator.process_batch(el))
+            elif isinstance(el, Watermark):
+                self._emit(self.operator.process_watermark(el))
+                if self.operator.forwards_watermarks:
+                    self._emit([el])
+            else:
+                self._emit([el])
+        # bounded end: final watermark flushes event-time state downstream
+        wm = Watermark(MAX_WATERMARK)
+        self._emit(self.operator.process_watermark(wm))
+        self._emit([wm])
+        self._emit(self.operator.end_input())
+        self._emit([EndOfInput()])
+
+    def _drain_commands(self) -> None:
+        while True:
+            try:
+                cmd = self.commands.get_nowait()
+            except queue.Empty:
+                return
+            if cmd[0] == "checkpoint":
+                cid = cmd[1]
+                snap = {"operator": self.operator.snapshot_state(),
+                        "source_offset": self._emitted}
+                barrier = CheckpointBarrier(cid, timestamp=0)
+                self._emit([barrier])
+                self.listener.acknowledge_checkpoint(
+                    cid, self.vertex_uid, self.subtask_index, snap)
+            elif cmd[0] == "cancel":
+                raise _Cancel()
+
+
+class Subtask(SubtaskBase):
+    """Channel-consuming subtask with aligned barriers."""
+
+    def __init__(self, vertex_uid: str, subtask_index: int, operator,
+                 outputs, ctx, listener,
+                 input_channels: Sequence[LocalChannel]):
+        super().__init__(vertex_uid, subtask_index, operator, outputs, ctx,
+                         listener)
+        self.inputs = list(input_channels)
+
+    def _invoke(self) -> None:
+        n = len(self.inputs)
+        valve = WatermarkValve(n)
+        ended = [False] * n
+        blocked: Dict[int, int] = {}   # channel idx -> barrier id blocking it
+        pending_barrier: Optional[CheckpointBarrier] = None
+        while not all(ended):
+            self._check_cancel()
+            self._drain_commands()
+            progressed = False
+            for i, ch in enumerate(self.inputs):
+                if ended[i] or i in blocked:
+                    continue
+                el = ch.poll(timeout_s=0.0)
+                if el is None:
+                    continue
+                progressed = True
+                if isinstance(el, CheckpointBarrier):
+                    blocked[i] = el.checkpoint_id
+                    pending_barrier = el
+                    # barrier complete across channels (ended ones count)?
+                    if all(ended[j] or j in blocked
+                           for j in range(n)):
+                        self._take_checkpoint(pending_barrier)
+                        blocked.clear()
+                        pending_barrier = None
+                elif isinstance(el, EndOfInput):
+                    ended[i] = True
+                    # a channel ending mid-alignment completes the barrier
+                    if pending_barrier is not None and all(
+                            ended[j] or j in blocked for j in range(n)):
+                        self._take_checkpoint(pending_barrier)
+                        blocked.clear()
+                        pending_barrier = None
+                elif isinstance(el, Watermark):
+                    adv = valve.input_watermark(i, el.timestamp)
+                    if adv is not None:
+                        wm = Watermark(adv)
+                        self._emit(self.operator.process_watermark(wm))
+                        if self.operator.forwards_watermarks:
+                            self._emit([wm])
+                elif isinstance(el, RecordBatch):
+                    if len(el):
+                        self._emit(self.operator.process_batch(el))
+                else:
+                    self._emit([el])
+            if not progressed:
+                # nothing readable: brief blocking poll on one open channel
+                for i, ch in enumerate(self.inputs):
+                    if not ended[i] and i not in blocked:
+                        el = ch.poll(timeout_s=0.01)
+                        if el is not None:
+                            # put it back is impossible; handle inline by
+                            # re-dispatching through the same logic next loop:
+                            # simplest correct move — process it now
+                            self._handle_out_of_loop(i, el, valve, ended,
+                                                     blocked)
+                            if (pending_barrier is None and blocked):
+                                pending_barrier = self._last_barrier
+                            if pending_barrier is not None and all(
+                                    ended[j] or j in blocked
+                                    for j in range(n)):
+                                self._take_checkpoint(pending_barrier)
+                                blocked.clear()
+                                pending_barrier = None
+                        break
+        self._emit(self.operator.end_input())
+        self._emit([EndOfInput()])
+
+    _last_barrier: Optional[CheckpointBarrier] = None
+
+    def _handle_out_of_loop(self, i, el, valve, ended, blocked) -> None:
+        if isinstance(el, CheckpointBarrier):
+            blocked[i] = el.checkpoint_id
+            self._last_barrier = el
+        elif isinstance(el, EndOfInput):
+            ended[i] = True
+        elif isinstance(el, Watermark):
+            adv = valve.input_watermark(i, el.timestamp)
+            if adv is not None:
+                wm = Watermark(adv)
+                self._emit(self.operator.process_watermark(wm))
+                if self.operator.forwards_watermarks:
+                    self._emit([wm])
+        elif isinstance(el, RecordBatch):
+            if len(el):
+                self._emit(self.operator.process_batch(el))
+        else:
+            self._emit([el])
+
+    def _take_checkpoint(self, barrier: CheckpointBarrier) -> None:
+        snap = {"operator": self.operator.snapshot_state()}
+        self._emit([barrier])
+        self.listener.acknowledge_checkpoint(
+            barrier.checkpoint_id, self.vertex_uid, self.subtask_index, snap)
+
+    def _drain_commands(self) -> None:
+        while True:
+            try:
+                cmd = self.commands.get_nowait()
+            except queue.Empty:
+                return
+            if cmd[0] == "cancel":
+                raise _Cancel()
+
+
+class TaskListener:
+    """Callbacks from subtask threads to the coordination layer."""
+
+    def task_state_changed(self, vertex_uid: str, subtask_index: int,
+                           state: str, error: Optional[str]) -> None:
+        pass
+
+    def acknowledge_checkpoint(self, checkpoint_id: int, vertex_uid: str,
+                               subtask_index: int,
+                               snapshot: Dict[str, Any]) -> None:
+        pass

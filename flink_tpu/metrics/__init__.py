@@ -1,0 +1,24 @@
+"""Metrics system: types, hierarchical groups, registry, reporters.
+
+Reference analogs: ``flink-metrics-core`` (types + reporter SPI),
+``runtime/metrics/`` (registry + scoped groups), ``flink-metrics-prometheus``
+(exposition reporter). See SURVEY §2.2 "Metrics core" / §5.5.
+"""
+
+from flink_tpu.metrics.core import (Counter, Gauge, Histogram, Meter, Metric,
+                                    SettableGauge)
+from flink_tpu.metrics.groups import (BUSY_TIME, CURRENT_WATERMARK,
+                                      NUM_LATE_RECORDS_DROPPED,
+                                      NUM_RECORDS_IN, NUM_RECORDS_OUT,
+                                      MetricGroup, MetricRegistry,
+                                      OperatorIOMetrics, task_metric_group)
+from flink_tpu.metrics.reporters import (LoggingReporter, MetricReporter,
+                                         PrometheusReporter)
+
+__all__ = [
+    "Counter", "Gauge", "SettableGauge", "Meter", "Histogram", "Metric",
+    "MetricGroup", "MetricRegistry", "OperatorIOMetrics", "task_metric_group",
+    "MetricReporter", "LoggingReporter", "PrometheusReporter",
+    "NUM_RECORDS_IN", "NUM_RECORDS_OUT", "NUM_LATE_RECORDS_DROPPED",
+    "CURRENT_WATERMARK", "BUSY_TIME",
+]

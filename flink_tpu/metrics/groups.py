@@ -1,0 +1,170 @@
+"""Hierarchical metric groups + registry.
+
+Analog of ``runtime/metrics/groups/`` + ``MetricRegistryImpl.java:67``: every
+metric lives in a scope tree (jobmanager|taskmanager → job → task → operator,
+plus free-form user groups); the registry fans registrations out to reporters
+and owns the scope-string formatting (``runtime/metrics/scope/``).
+
+System metric names follow the reference's ``MetricNames.java``
+(numRecordsIn/Out, numLateRecordsDropped, currentWatermark, busyTimeMsPerSecond)
+so dashboards translate one-to-one.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from flink_tpu.metrics.core import (Counter, Gauge, Histogram, Meter, Metric,
+                                    SettableGauge)
+
+# MetricNames.java analogs
+NUM_RECORDS_IN = "numRecordsIn"
+NUM_RECORDS_OUT = "numRecordsOut"
+NUM_LATE_RECORDS_DROPPED = "numLateRecordsDropped"
+CURRENT_WATERMARK = "currentInputWatermark"
+BUSY_TIME = "busyTimeMsPerSecond"
+NUM_RESTARTS = "numRestarts"
+CHECKPOINT_DURATION = "lastCheckpointDuration"
+CHECKPOINT_SIZE = "lastCheckpointSize"
+
+
+class MetricGroup:
+    """One node of the scope tree (``AbstractMetricGroup`` analog)."""
+
+    def __init__(self, registry: "MetricRegistry", scope: Tuple[str, ...],
+                 parent: Optional["MetricGroup"] = None):
+        self._registry = registry
+        self._scope = scope
+        self._parent = parent
+        self._metrics: Dict[str, Metric] = {}
+        self._groups: Dict[str, "MetricGroup"] = {}
+
+    # -- structure -----------------------------------------------------------
+    def add_group(self, name: str) -> "MetricGroup":
+        g = self._groups.get(name)
+        if g is None:
+            g = MetricGroup(self._registry, self._scope + (str(name),), self)
+            self._groups[name] = g
+        return g
+
+    @property
+    def scope(self) -> Tuple[str, ...]:
+        return self._scope
+
+    def metric_identifier(self, name: str, delimiter: str = ".") -> str:
+        return delimiter.join(self._scope + (name,))
+
+    # -- registration --------------------------------------------------------
+    def _register(self, name: str, metric: Metric) -> Metric:
+        existing = self._metrics.get(name)
+        if existing is not None:
+            return existing
+        self._metrics[name] = metric
+        self._registry.register(metric, name, self)
+        return metric
+
+    def counter(self, name: str) -> Counter:
+        return self._register(name, Counter())
+
+    def gauge(self, name: str, supplier: Optional[Callable[[], Any]] = None):
+        if supplier is None:
+            return self._register(name, SettableGauge())
+        return self._register(name, Gauge(supplier))
+
+    def meter(self, name: str, **kw) -> Meter:
+        return self._register(name, Meter(**kw))
+
+    def histogram(self, name: str, **kw) -> Histogram:
+        return self._register(name, Histogram(**kw))
+
+    # -- introspection -------------------------------------------------------
+    def metrics(self) -> Dict[str, Metric]:
+        return dict(self._metrics)
+
+    def all_metrics(self) -> Dict[str, Metric]:
+        """Fully-qualified identifier -> metric, for this subtree."""
+        out = {self.metric_identifier(n): m for n, m in self._metrics.items()}
+        for g in self._groups.values():
+            out.update(g.all_metrics())
+        return out
+
+
+class MetricRegistry:
+    """Fan-out hub: registrations notify every reporter
+    (``MetricRegistryImpl`` analog; reporting runs on a timer thread when an
+    interval is configured, like the reference's reporter scheduler)."""
+
+    def __init__(self, reporters: Optional[List] = None,
+                 report_interval_s: float = 0.0):
+        self.reporters = list(reporters or [])
+        self._roots: List[MetricGroup] = []
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._interval = report_interval_s
+        if report_interval_s > 0 and self.reporters:
+            self._schedule()
+
+    # -- scope roots ---------------------------------------------------------
+    def task_manager_group(self, tm_id: str = "tm-0") -> MetricGroup:
+        g = MetricGroup(self, ("taskmanager", tm_id))
+        self._roots.append(g)
+        return g
+
+    def job_manager_group(self) -> MetricGroup:
+        g = MetricGroup(self, ("jobmanager",))
+        self._roots.append(g)
+        return g
+
+    def register(self, metric: Metric, name: str, group: MetricGroup) -> None:
+        with self._lock:
+            for r in self.reporters:
+                r.notify_of_added_metric(metric, name, group)
+
+    def all_metrics(self) -> Dict[str, Metric]:
+        out: Dict[str, Metric] = {}
+        for g in self._roots:
+            out.update(g.all_metrics())
+        return out
+
+    # -- periodic reporting --------------------------------------------------
+    def _schedule(self) -> None:
+        self._timer = threading.Timer(self._interval, self._tick)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _tick(self) -> None:
+        self.report_now()
+        self._schedule()
+
+    def report_now(self) -> None:
+        for r in self.reporters:
+            r.report(self.all_metrics())
+
+    def close(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+        for r in self.reporters:
+            close = getattr(r, "close", None)
+            if close:
+                close()
+
+
+def task_metric_group(registry: MetricRegistry, job_name: str,
+                      task_name: str, subtask_index: int) -> MetricGroup:
+    """taskmanager.<tm>.<job>.<task>.<subtask> — the scope format of
+    ``TaskMetricGroup`` (``runtime/metrics/scope/ScopeFormats``)."""
+    return (registry.task_manager_group()
+            .add_group(job_name).add_group(task_name)
+            .add_group(str(subtask_index)))
+
+
+class OperatorIOMetrics:
+    """numRecordsIn/Out + rates for one operator (``OperatorIOMetricGroup``)."""
+
+    def __init__(self, group: MetricGroup):
+        self.group = group
+        self.records_in = group.counter(NUM_RECORDS_IN)
+        self.records_out = group.counter(NUM_RECORDS_OUT)
+        self.late_dropped = group.counter(NUM_LATE_RECORDS_DROPPED)
+        self.watermark = group.gauge(CURRENT_WATERMARK)

@@ -1,0 +1,131 @@
+"""Metric reporters — the reporter SPI + shipped implementations.
+
+Analog of the ``MetricReporter`` SPI (``flink-metrics-core``) and two of the
+reference's shipped reporters (``flink-metrics/``): a logging reporter
+(slf4j reporter analog) and a Prometheus reporter serving the text exposition
+format over HTTP (``flink-metrics-prometheus``).  ``PrometheusReporter.scrape()``
+returns the exposition text directly so tests and in-process consumers don't
+need the HTTP server.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from flink_tpu.metrics.core import Counter, Gauge, Histogram, Meter, Metric
+
+log = logging.getLogger("flink_tpu.metrics")
+
+
+class MetricReporter:
+    """SPI: ``notify_of_added_metric`` on registration, ``report`` on each
+    reporting tick (scheduled reporters), ``close`` on shutdown."""
+
+    def notify_of_added_metric(self, metric: Metric, name: str, group) -> None:
+        pass
+
+    def report(self, metrics: Dict[str, Metric]) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class LoggingReporter(MetricReporter):
+    def __init__(self, level: int = logging.INFO):
+        self.level = level
+
+    def report(self, metrics: Dict[str, Metric]) -> None:
+        for ident, m in sorted(metrics.items()):
+            log.log(self.level, "%s = %s", ident, _render(m))
+
+
+def _render(m: Metric):
+    if isinstance(m, Counter):
+        return m.get_count()
+    if isinstance(m, Meter):
+        return f"{m.get_rate():.1f}/s (n={m.get_count()})"
+    if isinstance(m, Histogram):
+        s = m.get_statistics()
+        return f"p50={s['p50']:.2f} p99={s['p99']:.2f} n={s['count']}"
+    if isinstance(m, Gauge):
+        return m.get_value()
+    return m
+
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(ident: str) -> str:
+    return "flink_tpu_" + _INVALID.sub("_", ident)
+
+
+class PrometheusReporter(MetricReporter):
+    """Prometheus text exposition; optionally serves GET /metrics."""
+
+    def __init__(self, registry=None, port: Optional[int] = None):
+        self._registry = registry
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread = None
+        if port is not None:
+            self.start_server(port)
+
+    def bind(self, registry) -> None:
+        self._registry = registry
+
+    def scrape(self) -> str:
+        metrics = self._registry.all_metrics() if self._registry else {}
+        lines = []
+        for ident, m in sorted(metrics.items()):
+            name = _prom_name(ident)
+            if isinstance(m, Counter):
+                lines += [f"# TYPE {name} counter", f"{name} {m.get_count()}"]
+            elif isinstance(m, Meter):
+                lines += [f"# TYPE {name} gauge", f"{name} {m.get_rate()}"]
+            elif isinstance(m, Histogram):
+                s = m.get_statistics()
+                lines.append(f"# TYPE {name} summary")
+                for q, k in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    lines.append(f'{name}{{quantile="{q}"}} {s[k]}')
+                lines.append(f"{name}_count {s['count']}")
+            elif isinstance(m, Gauge):
+                v = m.get_value()
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    lines += [f"# TYPE {name} gauge", f"{name} {v}"]
+        return "\n".join(lines) + "\n"
+
+    # -- HTTP ----------------------------------------------------------------
+    def start_server(self, port: int) -> int:
+        reporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") in ("", "/metrics"):
+                    body = reporter.scrape().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *a):  # silence per-request logging
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

@@ -56,6 +56,7 @@ class RunningVertex:
     targets: List[Tuple["RunningVertex", int]] = field(default_factory=list)
     ended_inputs: int = 0
     num_inputs: int = 0
+    io: Any = None  # OperatorIOMetrics
 
 
 @dataclass
@@ -73,12 +74,14 @@ class LocalExecutor:
                  checkpoint_storage=None,
                  listeners: Optional[List[Callable[[str, Any], None]]] = None,
                  max_records: Optional[int] = None,
-                 max_wall_ms: Optional[int] = None):
+                 max_wall_ms: Optional[int] = None,
+                 metric_registry=None):
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.checkpoint_storage = checkpoint_storage
         self.listeners = listeners or []
         self.max_records = max_records      # unbounded-source record budget
         self.max_wall_ms = max_wall_ms      # unbounded-source wall budget
+        self.metric_registry = metric_registry
         self._cancelled = False
         self._records = 0
 
@@ -90,15 +93,25 @@ class LocalExecutor:
     # ------------------------------------------------------------- wiring
     def _build(self, plan: ExecutionPlan,
                restore: Optional[Dict[str, Any]] = None) -> Dict[int, RunningVertex]:
+        from flink_tpu.metrics import (MetricRegistry, OperatorIOMetrics,
+                                       task_metric_group)
+
+        if self.metric_registry is None:
+            self.metric_registry = MetricRegistry()
         running: Dict[int, RunningVertex] = {}
         for v in plan.vertices:
             op = v.build_operator()
+            group = task_metric_group(self.metric_registry, plan.job_name,
+                                      v.name, 0)
             ctx = RuntimeContext(task_name=v.name, subtask_index=0, parallelism=1,
-                                 max_parallelism=v.max_parallelism)
+                                 max_parallelism=v.max_parallelism,
+                                 metrics=group)
             op.open(ctx)
             if restore and v.uid in restore:
                 op.restore_state(restore[v.uid])
-            running[v.id] = RunningVertex(v, op, WatermarkValve(0))
+            rv = RunningVertex(v, op, WatermarkValve(0))
+            rv.io = OperatorIOMetrics(group)
+            running[v.id] = rv
         # wire edges; input index = position among target's in-edges
         in_counts: Dict[int, int] = {v.id: 0 for v in plan.vertices}
         for v in plan.vertices:
@@ -118,6 +131,8 @@ class LocalExecutor:
         for el in elements:
             if isinstance(el, RecordBatch):
                 self._records += len(el)
+                if rv.io is not None:
+                    rv.io.records_out.inc(len(el))
             for tgt, idx in rv.targets:
                 self._deliver(tgt, idx, el)
 
@@ -126,10 +141,14 @@ class LocalExecutor:
         op = rv.operator
         if isinstance(el, RecordBatch):
             if len(el):
+                if rv.io is not None:
+                    rv.io.records_in.inc(len(el))
                 self._route(rv, op.process_batch(el))
         elif isinstance(el, Watermark):
             advanced = rv.valve.input_watermark(input_index, el.timestamp)
             if advanced is not None:
+                if rv.io is not None:
+                    rv.io.watermark.set(advanced)
                 wm = Watermark(advanced)
                 self._route(rv, op.process_watermark(wm))
                 if op.forwards_watermarks:

@@ -1,0 +1,112 @@
+"""Metrics system tests (MetricRegistryImplTest / reporter tests analogs)."""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.metrics import (Counter, Histogram, LoggingReporter, Meter,
+                               MetricRegistry, PrometheusReporter,
+                               task_metric_group)
+
+
+def test_counter_and_group_identifier():
+    reg = MetricRegistry()
+    g = task_metric_group(reg, "jobA", "window-agg", 0)
+    c = g.counter("numRecordsIn")
+    c.inc(5)
+    c.inc()
+    assert c.get_count() == 6
+    ident = g.metric_identifier("numRecordsIn")
+    assert ident.endswith("jobA.window-agg.0.numRecordsIn")
+    assert reg.all_metrics()[ident] is c
+
+
+def test_group_reuse_and_idempotent_registration():
+    reg = MetricRegistry()
+    g = task_metric_group(reg, "j", "t", 0)
+    assert g.counter("c") is g.counter("c")
+    assert g.add_group("user") is g.add_group("user")
+
+
+def test_meter_rate():
+    t = [0.0]
+    m = Meter(window_s=60, clock=lambda: t[0])
+    m.mark_event(10)
+    t[0] = 10.0
+    m.mark_event(10)
+    assert m.get_rate() == pytest.approx(1.0)
+    assert m.get_count() == 20
+
+
+def test_histogram_bulk_update_and_percentiles():
+    h = Histogram(size=1000)
+    h.update_all(np.arange(1, 101, dtype=np.float64))
+    s = h.get_statistics()
+    assert s["count"] == 100
+    assert s["min"] == 1.0 and s["max"] == 100.0
+    assert s["p50"] == pytest.approx(50.5, abs=1)
+    # ring wrap: push more than capacity
+    h.update_all(np.full(2000, 7.0))
+    s = h.get_statistics()
+    assert s["count"] == 2100 and s["max"] == 7.0
+
+
+def test_reporter_notified_on_registration():
+    seen = []
+
+    class Spy(LoggingReporter):
+        def notify_of_added_metric(self, metric, name, group):
+            seen.append(name)
+
+    reg = MetricRegistry(reporters=[Spy()])
+    g = task_metric_group(reg, "j", "t", 0)
+    g.counter("a")
+    g.meter("b")
+    assert seen == ["a", "b"]
+
+
+def test_prometheus_scrape_text_format():
+    reg = MetricRegistry()
+    prom = PrometheusReporter(registry=reg)
+    g = task_metric_group(reg, "j", "my task!", 0)
+    g.counter("numRecordsIn").inc(3)
+    g.gauge("watermark", lambda: 42)
+    g.histogram("lat").update_all(np.array([1.0, 2.0, 3.0]))
+    text = prom.scrape()
+    assert "flink_tpu_taskmanager_tm_0_j_my_task__0_numRecordsIn 3" in text
+    assert "watermark 42" in text
+    assert 'quantile="0.99"' in text
+
+
+def test_prometheus_http_endpoint():
+    reg = MetricRegistry()
+    prom = PrometheusReporter(registry=reg)
+    g = task_metric_group(reg, "j", "t", 0)
+    g.counter("c").inc(9)
+    port = prom.start_server(0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "_c 9" in body
+    finally:
+        prom.close()
+
+
+def test_executor_populates_io_metrics():
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.metrics import NUM_RECORDS_IN, NUM_RECORDS_OUT
+
+    env = StreamExecutionEnvironment()
+    rows = [{"k": i % 2, "v": float(i)} for i in range(10)]
+    (env.from_collection(rows).key_by("k").sum("v").collect())
+    env.execute("metrics-job")
+    reg = env._last_executor.metric_registry
+    all_m = reg.all_metrics()
+    ins = {k: v.get_count() for k, v in all_m.items()
+           if k.endswith(NUM_RECORDS_IN)}
+    outs = {k: v.get_count() for k, v in all_m.items()
+            if k.endswith(NUM_RECORDS_OUT)}
+    # keyed-reduce vertex saw all 10 records in and emitted 10 running sums
+    assert any(v == 10 for v in ins.values()), ins
+    assert any(v == 10 for v in outs.values()), outs
