@@ -75,6 +75,14 @@ class SubtaskBase:
     def cancel(self) -> None:
         self._cancelled.set()
         self.commands.put(("cancel",))
+        # Unblock a task thread stuck in a full output channel (backpressure
+        # from a dead downstream) or an empty input poll: closed channels
+        # refuse puts and wake waiters, so the loop reaches _check_cancel.
+        for out in self.outputs:
+            for ch in getattr(out, "channels", []):
+                ch.close()
+        for ch in getattr(self, "inputs", []):
+            ch.close()
 
     def join(self, timeout_s: float = 10.0) -> None:
         if self._thread is not None:
@@ -189,83 +197,46 @@ class Subtask(SubtaskBase):
 
     def _invoke(self) -> None:
         n = len(self.inputs)
-        valve = WatermarkValve(n)
-        ended = [False] * n
-        blocked: Dict[int, int] = {}   # channel idx -> barrier id blocking it
-        pending_barrier: Optional[CheckpointBarrier] = None
-        while not all(ended):
+        self._valve = WatermarkValve(n)
+        self._ended = [False] * n
+        self._blocked: Dict[int, int] = {}  # channel idx -> blocking barrier id
+        self._pending_barrier: Optional[CheckpointBarrier] = None
+        while not all(self._ended):
             self._check_cancel()
             self._drain_commands()
             progressed = False
             for i, ch in enumerate(self.inputs):
-                if ended[i] or i in blocked:
+                if self._ended[i] or i in self._blocked:
                     continue
                 el = ch.poll(timeout_s=0.0)
                 if el is None:
                     continue
                 progressed = True
-                if isinstance(el, CheckpointBarrier):
-                    blocked[i] = el.checkpoint_id
-                    pending_barrier = el
-                    # barrier complete across channels (ended ones count)?
-                    if all(ended[j] or j in blocked
-                           for j in range(n)):
-                        self._take_checkpoint(pending_barrier)
-                        blocked.clear()
-                        pending_barrier = None
-                elif isinstance(el, EndOfInput):
-                    ended[i] = True
-                    # a channel ending mid-alignment completes the barrier
-                    if pending_barrier is not None and all(
-                            ended[j] or j in blocked for j in range(n)):
-                        self._take_checkpoint(pending_barrier)
-                        blocked.clear()
-                        pending_barrier = None
-                elif isinstance(el, Watermark):
-                    adv = valve.input_watermark(i, el.timestamp)
-                    if adv is not None:
-                        wm = Watermark(adv)
-                        self._emit(self.operator.process_watermark(wm))
-                        if self.operator.forwards_watermarks:
-                            self._emit([wm])
-                elif isinstance(el, RecordBatch):
-                    if len(el):
-                        self._emit(self.operator.process_batch(el))
-                else:
-                    self._emit([el])
+                self._handle(i, el)
             if not progressed:
                 # nothing readable: brief blocking poll on one open channel
                 for i, ch in enumerate(self.inputs):
-                    if not ended[i] and i not in blocked:
+                    if not self._ended[i] and i not in self._blocked:
                         el = ch.poll(timeout_s=0.01)
                         if el is not None:
-                            # put it back is impossible; handle inline by
-                            # re-dispatching through the same logic next loop:
-                            # simplest correct move — process it now
-                            self._handle_out_of_loop(i, el, valve, ended,
-                                                     blocked)
-                            if (pending_barrier is None and blocked):
-                                pending_barrier = self._last_barrier
-                            if pending_barrier is not None and all(
-                                    ended[j] or j in blocked
-                                    for j in range(n)):
-                                self._take_checkpoint(pending_barrier)
-                                blocked.clear()
-                                pending_barrier = None
+                            self._handle(i, el)
                         break
         self._emit(self.operator.end_input())
         self._emit([EndOfInput()])
 
-    _last_barrier: Optional[CheckpointBarrier] = None
-
-    def _handle_out_of_loop(self, i, el, valve, ended, blocked) -> None:
+    def _handle(self, i: int, el: StreamElement) -> None:
+        """Single dispatch point for every input element (the mailbox default
+        action), including aligned-barrier bookkeeping."""
         if isinstance(el, CheckpointBarrier):
-            blocked[i] = el.checkpoint_id
-            self._last_barrier = el
+            self._blocked[i] = el.checkpoint_id
+            self._pending_barrier = el
+            self._maybe_complete_alignment()
         elif isinstance(el, EndOfInput):
-            ended[i] = True
+            self._ended[i] = True
+            # a channel ending mid-alignment completes the barrier
+            self._maybe_complete_alignment()
         elif isinstance(el, Watermark):
-            adv = valve.input_watermark(i, el.timestamp)
+            adv = self._valve.input_watermark(i, el.timestamp)
             if adv is not None:
                 wm = Watermark(adv)
                 self._emit(self.operator.process_watermark(wm))
@@ -276,6 +247,15 @@ class Subtask(SubtaskBase):
                 self._emit(self.operator.process_batch(el))
         else:
             self._emit([el])
+
+    def _maybe_complete_alignment(self) -> None:
+        if self._pending_barrier is None:
+            return
+        if all(self._ended[j] or j in self._blocked
+               for j in range(len(self.inputs))):
+            self._take_checkpoint(self._pending_barrier)
+            self._blocked.clear()
+            self._pending_barrier = None
 
     def _take_checkpoint(self, barrier: CheckpointBarrier) -> None:
         snap = {"operator": self.operator.snapshot_state()}

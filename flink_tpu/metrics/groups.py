@@ -101,6 +101,7 @@ class MetricRegistry:
         self._roots: List[MetricGroup] = []
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        self._stopped = False
         self._interval = report_interval_s
         if report_interval_s > 0 and self.reporters:
             self._schedule()
@@ -129,9 +130,12 @@ class MetricRegistry:
 
     # -- periodic reporting --------------------------------------------------
     def _schedule(self) -> None:
-        self._timer = threading.Timer(self._interval, self._tick)
-        self._timer.daemon = True
-        self._timer.start()
+        with self._lock:
+            if self._stopped:
+                return
+            self._timer = threading.Timer(self._interval, self._tick)
+            self._timer.daemon = True
+            self._timer.start()
 
     def _tick(self) -> None:
         self.report_now()
@@ -142,8 +146,12 @@ class MetricRegistry:
             r.report(self.all_metrics())
 
     def close(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
+        # _stopped gates _schedule so a _tick racing close() cannot re-arm
+        # the timer after it was cancelled
+        with self._lock:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
         for r in self.reporters:
             close = getattr(r, "close", None)
             if close:

@@ -1,0 +1,464 @@
+"""SQL lexer + AST + recursive-descent parser.
+
+The reference parses SQL with Calcite (``flink-table/flink-sql-parser/``,
+grammar templates) into ``SqlNode`` trees validated by the Blink planner
+(``PlannerBase.scala:155``).  This is a self-contained parser for the
+streaming-SQL dialect subset the framework executes: SELECT with expressions,
+WHERE, GROUP BY (including the group-window functions ``TUMBLE``/``HOP``/
+``SESSION`` of ``StreamExecGroupWindowAggregate.java:103``), HAVING,
+ORDER BY / LIMIT (bounded results), aggregates, CASE, CAST, BETWEEN, IN,
+LIKE, and INTERVAL/DATE/TIMESTAMP literals.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+class SqlParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+class Expr:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object  # int | float | str | bool | None
+
+
+@dataclass(frozen=True)
+class Column(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    pass
+
+
+@dataclass(frozen=True)
+class Unary(Expr):
+    op: str  # '-', 'NOT'
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class Binary(Expr):
+    op: str  # + - * / % || = <> < <= > >= AND OR
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class Call(Expr):
+    name: str  # uppercased
+    args: Tuple[Expr, ...]
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    expr: Expr
+    type_name: str
+
+
+@dataclass(frozen=True)
+class Case(Expr):
+    whens: Tuple[Tuple[Expr, Expr], ...]
+    default: Optional[Expr]
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    expr: Expr
+    lo: Expr
+    hi: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    expr: Expr
+    items: Tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    expr: Expr
+    pattern: str
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Interval(Expr):
+    """Time interval, normalized to milliseconds."""
+
+    ms: int
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class SelectStmt:
+    items: List[SelectItem]
+    table: Optional[str]
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[Tuple[Expr, bool]] = field(default_factory=list)  # (expr, asc)
+    limit: Optional[int] = None
+
+
+#: aggregate function names the planner splits out of expressions
+AGG_FUNCS = {"SUM", "COUNT", "AVG", "MIN", "MAX"}
+#: group-window functions (GROUP BY position)
+WINDOW_FUNCS = {"TUMBLE", "HOP", "SESSION"}
+#: auxiliary window accessors (SELECT position)
+WINDOW_AUX = {
+    "TUMBLE_START", "TUMBLE_END", "TUMBLE_ROWTIME", "TUMBLE_PROCTIME",
+    "HOP_START", "HOP_END", "HOP_ROWTIME",
+    "SESSION_START", "SESSION_END", "SESSION_ROWTIME",
+    "WINDOW_START", "WINDOW_END",
+}
+
+_UNIT_MS = {
+    "MILLISECOND": 1, "MILLISECONDS": 1,
+    "SECOND": 1000, "SECONDS": 1000,
+    "MINUTE": 60_000, "MINUTES": 60_000,
+    "HOUR": 3_600_000, "HOURS": 3_600_000,
+    "DAY": 86_400_000, "DAYS": 86_400_000,
+}
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "ASC",
+    "DESC", "LIMIT", "AS", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE",
+    "IS", "NULL", "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
+    "CAST", "INTERVAL", "DATE", "TIMESTAMP", "DISTINCT",
+}
+
+_TOKEN_RE = re.compile(r"""
+    \s+
+  | --[^\n]*
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<qident>"[^"]+"|`[^`]+`)
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=])
+""", re.VERBOSE)
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str   # NUMBER STRING IDENT KEYWORD OP EOF
+    value: str
+    pos: int
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SqlParseError(f"unexpected character {sql[pos]!r} at {pos}")
+        if m.lastgroup == "number":
+            out.append(Token("NUMBER", m.group("number"), pos))
+        elif m.lastgroup == "string":
+            raw = m.group("string")[1:-1].replace("''", "'")
+            out.append(Token("STRING", raw, pos))
+        elif m.lastgroup == "ident":
+            text = m.group("ident")
+            up = text.upper()
+            out.append(Token("KEYWORD" if up in _KEYWORDS else "IDENT",
+                             up if up in _KEYWORDS else text, pos))
+        elif m.lastgroup == "qident":
+            out.append(Token("IDENT", m.group("qident")[1:-1], pos))
+        elif m.lastgroup == "op":
+            out.append(Token("OP", m.group("op"), pos))
+        pos = m.end()
+    out.append(Token("EOF", "", pos))
+    return out
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value: Optional[str] = None) -> Token:
+        t = self.accept(kind, value)
+        if t is None:
+            got = self.peek()
+            raise SqlParseError(
+                f"expected {value or kind}, got {got.value or got.kind!r} "
+                f"at {got.pos}")
+        return t
+
+    def at_keyword(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "KEYWORD" and t.value in kws
+
+    # -- entry --------------------------------------------------------------
+    def parse_select(self) -> SelectStmt:
+        self.expect("KEYWORD", "SELECT")
+        items = [self.parse_select_item()]
+        while self.accept("OP", ","):
+            items.append(self.parse_select_item())
+        table = None
+        if self.accept("KEYWORD", "FROM"):
+            table = self.expect("IDENT").value
+            # optional alias (ignored — single-table queries)
+            if self.peek().kind == "IDENT":
+                self.next()
+        stmt = SelectStmt(items=items, table=table)
+        if self.accept("KEYWORD", "WHERE"):
+            stmt.where = self.parse_expr()
+        if self.accept("KEYWORD", "GROUP"):
+            self.expect("KEYWORD", "BY")
+            stmt.group_by.append(self.parse_expr())
+            while self.accept("OP", ","):
+                stmt.group_by.append(self.parse_expr())
+        if self.accept("KEYWORD", "HAVING"):
+            stmt.having = self.parse_expr()
+        if self.accept("KEYWORD", "ORDER"):
+            self.expect("KEYWORD", "BY")
+            stmt.order_by.append(self.parse_order_item())
+            while self.accept("OP", ","):
+                stmt.order_by.append(self.parse_order_item())
+        if self.accept("KEYWORD", "LIMIT"):
+            stmt.limit = int(self.expect("NUMBER").value)
+        self.expect("EOF")
+        return stmt
+
+    def parse_order_item(self) -> Tuple[Expr, bool]:
+        e = self.parse_expr()
+        asc = True
+        if self.accept("KEYWORD", "DESC"):
+            asc = False
+        else:
+            self.accept("KEYWORD", "ASC")
+        return (e, asc)
+
+    def parse_select_item(self) -> SelectItem:
+        if self.accept("OP", "*"):
+            return SelectItem(Star())
+        e = self.parse_expr()
+        alias = None
+        if self.accept("KEYWORD", "AS"):
+            alias = self.expect("IDENT").value
+        elif self.peek().kind == "IDENT":
+            alias = self.next().value
+        return SelectItem(e, alias)
+
+    # -- expressions (precedence climbing) ----------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        e = self.parse_and()
+        while self.accept("KEYWORD", "OR"):
+            e = Binary("OR", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Expr:
+        e = self.parse_not()
+        while self.accept("KEYWORD", "AND"):
+            e = Binary("AND", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Expr:
+        if self.accept("KEYWORD", "NOT"):
+            return Unary("NOT", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> Expr:
+        e = self.parse_additive()
+        negated = bool(self.accept("KEYWORD", "NOT"))
+        if self.accept("KEYWORD", "BETWEEN"):
+            lo = self.parse_additive()
+            self.expect("KEYWORD", "AND")
+            hi = self.parse_additive()
+            return Between(e, lo, hi, negated)
+        if self.accept("KEYWORD", "IN"):
+            self.expect("OP", "(")
+            items = [self.parse_expr()]
+            while self.accept("OP", ","):
+                items.append(self.parse_expr())
+            self.expect("OP", ")")
+            return InList(e, tuple(items), negated)
+        if self.accept("KEYWORD", "LIKE"):
+            pat = self.expect("STRING").value
+            return Like(e, pat, negated)
+        if negated:
+            raise SqlParseError("NOT must be followed by BETWEEN/IN/LIKE here")
+        if self.accept("KEYWORD", "IS"):
+            neg = bool(self.accept("KEYWORD", "NOT"))
+            self.expect("KEYWORD", "NULL")
+            return IsNull(e, neg)
+        t = self.peek()
+        if t.kind == "OP" and t.value in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            op = "<>" if t.value == "!=" else t.value
+            return Binary(op, e, self.parse_additive())
+        return e
+
+    def parse_additive(self) -> Expr:
+        e = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value in ("+", "-", "||"):
+                self.next()
+                e = Binary(t.value, e, self.parse_multiplicative())
+            else:
+                return e
+
+    def parse_multiplicative(self) -> Expr:
+        e = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value in ("*", "/", "%"):
+                self.next()
+                e = Binary(t.value, e, self.parse_unary())
+            else:
+                return e
+
+    def parse_unary(self) -> Expr:
+        if self.accept("OP", "-"):
+            return Unary("-", self.parse_unary())
+        if self.accept("OP", "+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            v = float(t.value) if ("." in t.value or "e" in t.value.lower()) \
+                else int(t.value)
+            return Literal(v)
+        if t.kind == "STRING":
+            self.next()
+            return Literal(t.value)
+        if self.accept("KEYWORD", "TRUE"):
+            return Literal(True)
+        if self.accept("KEYWORD", "FALSE"):
+            return Literal(False)
+        if self.accept("KEYWORD", "NULL"):
+            return Literal(None)
+        if self.accept("KEYWORD", "INTERVAL"):
+            val = self.expect("STRING").value
+            unit_tok = self.expect("IDENT")
+            unit = unit_tok.value.upper()
+            if unit not in _UNIT_MS:
+                raise SqlParseError(f"unknown interval unit {unit!r}")
+            return Interval(int(float(val) * _UNIT_MS[unit]))
+        if self.accept("KEYWORD", "DATE"):
+            return Literal(_date_to_ms(self.expect("STRING").value))
+        if self.accept("KEYWORD", "TIMESTAMP"):
+            return Literal(_timestamp_to_ms(self.expect("STRING").value))
+        if self.accept("KEYWORD", "CAST"):
+            self.expect("OP", "(")
+            e = self.parse_expr()
+            self.expect("KEYWORD", "AS")
+            ty = self.expect("IDENT").value.upper()
+            # swallow precision, e.g. DECIMAL(12, 2)
+            if self.accept("OP", "("):
+                while not self.accept("OP", ")"):
+                    self.next()
+            self.expect("OP", ")")
+            return Cast(e, ty)
+        if self.accept("KEYWORD", "CASE"):
+            whens = []
+            while self.accept("KEYWORD", "WHEN"):
+                cond = self.parse_expr()
+                self.expect("KEYWORD", "THEN")
+                whens.append((cond, self.parse_expr()))
+            default = None
+            if self.accept("KEYWORD", "ELSE"):
+                default = self.parse_expr()
+            self.expect("KEYWORD", "END")
+            return Case(tuple(whens), default)
+        if self.accept("OP", "("):
+            e = self.parse_expr()
+            self.expect("OP", ")")
+            return e
+        if t.kind == "IDENT":
+            self.next()
+            name = t.value
+            if self.accept("OP", "("):
+                return self.parse_call(name)
+            # qualified column: tbl.col -> col
+            while self.accept("OP", "."):
+                name = self.expect("IDENT").value
+            return Column(name)
+        raise SqlParseError(f"unexpected token {t.value or t.kind!r} at {t.pos}")
+
+    def parse_call(self, name: str) -> Expr:
+        up = name.upper()
+        if self.accept("OP", ")"):
+            return Call(up, ())
+        if up == "COUNT" and self.accept("OP", "*"):
+            self.expect("OP", ")")
+            return Call("COUNT", (Star(),))
+        distinct = bool(self.accept("KEYWORD", "DISTINCT"))
+        args = [self.parse_expr()]
+        while self.accept("OP", ","):
+            args.append(self.parse_expr())
+        self.expect("OP", ")")
+        return Call(up, tuple(args), distinct)
+
+
+def _date_to_ms(s: str) -> int:
+    y, m, d = (int(x) for x in s.strip().split("-"))
+    import datetime
+    epoch = datetime.date(1970, 1, 1)
+    return (datetime.date(y, m, d) - epoch).days * 86_400_000
+
+
+def _timestamp_to_ms(s: str) -> int:
+    import datetime
+    s = s.strip()
+    fmt = "%Y-%m-%d %H:%M:%S.%f" if "." in s else (
+        "%Y-%m-%d %H:%M:%S" if " " in s else "%Y-%m-%d")
+    dt = datetime.datetime.strptime(s, fmt).replace(
+        tzinfo=datetime.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+def parse(sql: str) -> SelectStmt:
+    return Parser(sql.strip().rstrip(";")).parse_select()

@@ -1,0 +1,462 @@
+"""SQL planner: SELECT AST → DataStream pipeline.
+
+The reference's Blink planner lowers Calcite plans through optimization into
+``ExecNode``s that build stream operators — the group-window path being
+``StreamExecGroupWindowAggregate.java:103`` → ``WindowOperatorBuilder``
+(``createWindowOperator:345``) with a code-generated aggregate handler.  Here
+the lowering is direct: WHERE → vectorized filter, expression evaluation →
+columnar closures (``expressions.py``, the codegen analog), GROUP BY
+TUMBLE/HOP/SESSION → the paned ``WindowAggOperator`` / merging
+``SessionWindowOperator`` with a ``TupleAggregator`` (one accumulator pytree
+holding every aggregate — the ``NamespaceAggsHandleFunction`` analog), and a
+final projection map.  Bounded non-windowed GROUP BY runs on ``GlobalWindows``
+firing at end-of-input.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_tpu.core.functions import (AvgAggregator, CountAggregator,
+                                      MaxAggregator, MinAggregator,
+                                      SumAggregator, TupleAggregator)
+from flink_tpu.sql.expressions import (ExprCompiler, PlanError, expr_name,
+                                       to_column)
+from flink_tpu.sql.parser import (AGG_FUNCS, WINDOW_AUX, WINDOW_FUNCS, Between,
+                                  Binary, Call, Case, Cast, Column, Expr,
+                                  InList, Interval, IsNull, Like, Literal,
+                                  SelectItem, SelectStmt, Star, Unary)
+from flink_tpu.windowing.assigners import (EventTimeSessionWindows,
+                                           GlobalWindows,
+                                           SlidingEventTimeWindows,
+                                           TumblingEventTimeWindows)
+
+
+@dataclass
+class AggSpec:
+    """One aggregate call split out of the select/having expressions."""
+
+    out_name: str       # "__agg0", ... — ACC entry + fired column name
+    func: str           # SUM/COUNT/AVG/MIN/MAX
+    arg: Optional[Expr]  # None for COUNT(*)
+
+
+@dataclass
+class WindowSpec:
+    kind: str          # TUMBLE/HOP/SESSION
+    time_col: str
+    size_ms: int
+    slide_ms: Optional[int] = None  # HOP only
+
+
+@dataclass
+class QueryPlan:
+    """Planned query: the output DataStream + result metadata."""
+
+    stream: Any                       # DataStream producing the result rows
+    output_columns: List[str]
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+def _transform(expr: Expr, fn: Callable[[Expr], Optional[Expr]]) -> Expr:
+    """Generic top-down rewrite over frozen AST nodes: ``fn`` returns a
+    replacement (whole-subtree matches win) or None to recurse."""
+    hit = fn(expr)
+    if hit is not None:
+        return hit
+    rec = lambda e: _transform(e, fn)  # noqa: E731
+    if isinstance(expr, Unary):
+        return Unary(expr.op, rec(expr.operand))
+    if isinstance(expr, Binary):
+        return Binary(expr.op, rec(expr.left), rec(expr.right))
+    if isinstance(expr, Call):
+        return Call(expr.name, tuple(rec(a) for a in expr.args), expr.distinct)
+    if isinstance(expr, Cast):
+        return Cast(rec(expr.expr), expr.type_name)
+    if isinstance(expr, Case):
+        return Case(tuple((rec(c), rec(r)) for c, r in expr.whens),
+                    rec(expr.default) if expr.default is not None else None)
+    if isinstance(expr, Between):
+        return Between(rec(expr.expr), rec(expr.lo), rec(expr.hi), expr.negated)
+    if isinstance(expr, InList):
+        return InList(rec(expr.expr), tuple(rec(i) for i in expr.items),
+                      expr.negated)
+    if isinstance(expr, Like):
+        return Like(rec(expr.expr), expr.pattern, expr.negated)
+    if isinstance(expr, IsNull):
+        return IsNull(rec(expr.expr), expr.negated)
+    return expr
+
+
+def _walk_replace(expr: Expr, mapping: Dict[Expr, Expr]) -> Expr:
+    """Structural find/replace (GROUP BY expressions → key columns), plus
+    window auxiliary calls (``TUMBLE_START(...)`` etc.,
+    ``StreamExecGroupWindowAggregate`` window-property resolution) → the
+    ``window_start``/``window_end`` columns the window operators emit."""
+    def fn(e: Expr) -> Optional[Expr]:
+        if e in mapping:
+            return mapping[e]
+        if isinstance(e, Call) and e.name in WINDOW_AUX:
+            if e.name.endswith("_START"):
+                return Column("window_start")
+            if e.name.endswith("_END"):
+                return Column("window_end")
+            # *_ROWTIME / *_PROCTIME = window.maxTimestamp = end - 1
+            return Binary("-", Column("window_end"), Literal(1))
+        return None
+    return _transform(expr, fn)
+
+
+def _extract_aggs(expr: Expr, specs: List[AggSpec],
+                  cache: Dict[Expr, Column]) -> Expr:
+    """Replace aggregate calls with placeholder columns, collecting specs."""
+    if isinstance(expr, Call) and expr.name in AGG_FUNCS:
+        if expr.distinct:
+            raise PlanError(f"{expr.name}(DISTINCT ...) is not supported yet")
+        if expr in cache:
+            return cache[expr]
+        arg = None
+        if not (len(expr.args) == 1 and isinstance(expr.args[0], Star)):
+            if len(expr.args) != 1:
+                raise PlanError(f"{expr.name} takes exactly one argument")
+            arg = expr.args[0]
+        name = f"__agg{len(specs)}"
+        specs.append(AggSpec(name, expr.name, arg))
+        col = Column(name)
+        cache[expr] = col
+        return col
+    if isinstance(expr, Unary):
+        return Unary(expr.op, _extract_aggs(expr.operand, specs, cache))
+    if isinstance(expr, Binary):
+        return Binary(expr.op, _extract_aggs(expr.left, specs, cache),
+                      _extract_aggs(expr.right, specs, cache))
+    if isinstance(expr, Call):
+        return Call(expr.name,
+                    tuple(_extract_aggs(a, specs, cache) for a in expr.args),
+                    expr.distinct)
+    if isinstance(expr, Cast):
+        return Cast(_extract_aggs(expr.expr, specs, cache), expr.type_name)
+    if isinstance(expr, Case):
+        return Case(tuple((_extract_aggs(c, specs, cache),
+                           _extract_aggs(r, specs, cache))
+                          for c, r in expr.whens),
+                    _extract_aggs(expr.default, specs, cache)
+                    if expr.default is not None else None)
+    if isinstance(expr, Between):
+        return Between(_extract_aggs(expr.expr, specs, cache),
+                       _extract_aggs(expr.lo, specs, cache),
+                       _extract_aggs(expr.hi, specs, cache), expr.negated)
+    return expr
+
+
+def _contains_agg(expr: Expr) -> bool:
+    specs: List[AggSpec] = []
+    _extract_aggs(expr, specs, {})
+    return bool(specs)
+
+
+def _make_aggregator(spec: AggSpec, value_col: str):
+    import jax.numpy as jnp
+    if spec.func == "SUM":
+        return SumAggregator(jnp.float64)
+    if spec.func == "AVG":
+        return AvgAggregator(jnp.float64)
+    if spec.func == "MIN":
+        return MinAggregator(jnp.float64)
+    if spec.func == "MAX":
+        return MaxAggregator(jnp.float64)
+    if spec.func == "COUNT":
+        return CountAggregator()
+    raise PlanError(f"unknown aggregate {spec.func}")
+
+
+def _parse_window_call(call: Call, compiler: ExprCompiler) -> WindowSpec:
+    args = call.args
+    if not args or not isinstance(args[0], Column):
+        raise PlanError(f"{call.name} first argument must be the rowtime column")
+    time_col = args[0].name
+
+    def interval_ms(e: Expr) -> int:
+        if isinstance(e, Interval):
+            return e.ms
+        if isinstance(e, Literal) and isinstance(e.value, (int, float)):
+            return int(e.value)
+        raise PlanError(f"{call.name} expects INTERVAL arguments")
+
+    if call.name == "TUMBLE":
+        if len(args) != 2:
+            raise PlanError("TUMBLE(rowtime, size_interval)")
+        return WindowSpec("TUMBLE", time_col, interval_ms(args[1]))
+    if call.name == "HOP":
+        if len(args) != 3:
+            raise PlanError("HOP(rowtime, slide_interval, size_interval)")
+        return WindowSpec("HOP", time_col, interval_ms(args[2]),
+                          slide_ms=interval_ms(args[1]))
+    if call.name == "SESSION":
+        if len(args) != 2:
+            raise PlanError("SESSION(rowtime, gap_interval)")
+        return WindowSpec("SESSION", time_col, interval_ms(args[1]))
+    raise PlanError(f"unknown window function {call.name}")
+
+
+class Planner:
+    """Translates a parsed SELECT over one registered table to a DataStream."""
+
+    def __init__(self, env, catalog: Mapping[str, "CatalogTable"]):
+        self.env = env
+        self.catalog = catalog
+
+    def plan(self, stmt: SelectStmt) -> QueryPlan:
+        if stmt.table is None:
+            raise PlanError("FROM clause is required")
+        try:
+            table = self.catalog[stmt.table]
+        except KeyError:
+            raise PlanError(f"unknown table {stmt.table!r}; registered: "
+                            f"{sorted(self.catalog)}")
+        stream = table.stream()
+        schema = dict.fromkeys(table.columns)
+
+        # ---- expand * and split aggregates out of SELECT / HAVING
+        items: List[SelectItem] = []
+        for it in stmt.items:
+            if isinstance(it.expr, Star):
+                items.extend(SelectItem(Column(c), c) for c in table.columns)
+            else:
+                items.append(it)
+        agg_specs: List[AggSpec] = []
+        agg_cache: Dict[Expr, Column] = {}
+        rewritten = [SelectItem(_extract_aggs(it.expr, agg_specs, agg_cache),
+                                it.alias) for it in items]
+        having = (_extract_aggs(stmt.having, agg_specs, agg_cache)
+                  if stmt.having is not None else None)
+        if stmt.order_by and agg_cache:
+            # ORDER BY SUM(x) must resolve to the same placeholder column the
+            # select rewrite produced (aggregates not in SELECT are rejected
+            # when the name lookup fails in _order_names)
+            amap = dict(agg_cache)
+            stmt.order_by = [(_transform(e, amap.get), asc)
+                             for e, asc in stmt.order_by]
+
+        # ---- classify GROUP BY entries: window call vs plain keys
+        window: Optional[WindowSpec] = None
+        group_keys: List[Expr] = []
+        compiler = ExprCompiler(schema)
+        for g in stmt.group_by:
+            if isinstance(g, Call) and g.name in WINDOW_FUNCS:
+                if window is not None:
+                    raise PlanError("multiple window functions in GROUP BY")
+                window = _parse_window_call(g, compiler)
+            else:
+                group_keys.append(g)
+
+        if not agg_specs and (window or group_keys):
+            raise PlanError("GROUP BY without aggregates is not supported")
+
+        # ---- WHERE
+        if stmt.where is not None:
+            if _contains_agg(stmt.where):
+                raise PlanError("aggregates are not allowed in WHERE")
+            pred = compiler.compile(stmt.where)
+            stream = stream.filter(lambda cols, _p=pred: np.asarray(
+                to_column(_p(cols), _n(cols)), bool), name="sql-where")
+
+        if not agg_specs:
+            return self._plan_projection(stream, rewritten, table, stmt)
+        return self._plan_aggregate(stream, rewritten, having, agg_specs,
+                                    group_keys, window, table, stmt, compiler,
+                                    orig_items=items)
+
+    # ------------------------------------------------------------ projection
+    def _plan_projection(self, stream, items: List[SelectItem], table,
+                         stmt: SelectStmt) -> QueryPlan:
+        compiler = ExprCompiler(dict.fromkeys(table.columns))
+        names = _output_names(items)
+        fns = [compiler.compile(it.expr) for it in items]
+
+        def project(cols, _fns=fns, _names=names):
+            n = _n(cols)
+            return {nm: to_column(f(cols), n) for nm, f in zip(_names, _fns)}
+
+        out = stream.map(project, name="sql-project")
+        return QueryPlan(out, names, _order_names(stmt, items, names),
+                         stmt.limit)
+
+    # ------------------------------------------------------------- aggregate
+    def _plan_aggregate(self, stream, items, having, agg_specs: List[AggSpec],
+                        group_keys: List[Expr], window: Optional[WindowSpec],
+                        table, stmt: SelectStmt, compiler: ExprCompiler,
+                        orig_items: Optional[List[SelectItem]] = None) -> QueryPlan:
+        # ---- event time for windowed queries
+        if window is not None:
+            rowtime = table.rowtime
+            if rowtime is not None and rowtime != window.time_col:
+                raise PlanError(
+                    f"window is over {window.time_col!r} but table rowtime is "
+                    f"{rowtime!r}")
+            if not table.timestamps_assigned:
+                stream = stream.assign_timestamps_and_watermarks(
+                    table.watermark_delay_ms, timestamp_column=window.time_col,
+                    name="sql-rowtime")
+
+        # ---- pre-projection: aggregate inputs + computed/composite group key
+        key_exprs = group_keys
+        single_col_key = (len(key_exprs) == 1 and isinstance(key_exprs[0], Column))
+        key_col = key_exprs[0].name if single_col_key else "__key"
+        key_fns = [compiler.compile(k) for k in key_exprs]
+        arg_fns = [(s.out_name + "_in", compiler.compile(s.arg))
+                   for s in agg_specs if s.arg is not None]
+        need_ones = any(s.arg is None for s in agg_specs)
+
+        def pre_project(cols, _kf=key_fns, _af=arg_fns,
+                        _composite=not single_col_key, _ones=need_ones):
+            n = _n(cols)
+            out = dict(cols)
+            for nm, f in _af:
+                out[nm] = to_column(f(cols), n)
+            if _ones:
+                out["__ones"] = np.ones(n, np.int32)
+            if _composite:
+                if len(_kf) == 0:
+                    out["__key"] = np.zeros(n, np.int64)  # global aggregate
+                elif len(_kf) == 1:
+                    out["__key"] = to_column(_kf[0](cols), n)
+                else:
+                    parts = [to_column(f(cols), n) for f in _kf]
+                    out["__key"] = np.fromiter(
+                        (tuple(row) for row in zip(*(p.tolist() for p in parts))),
+                        object, count=n)
+            return out
+
+        stream = stream.map(pre_project, name="sql-pre-project")
+        keyed = stream.key_by(key_col)
+
+        # ---- the aggregate handler: one ACC pytree for all aggregates.
+        # The value selector passes ONLY numeric input columns — the update
+        # step is jitted, and key/string columns must stay host-side.
+        agg_map: Dict[str, Tuple[str, Any]] = {}
+        for s in agg_specs:
+            in_col = s.out_name + "_in" if s.arg is not None else "__ones"
+            agg_map[s.out_name] = (in_col, _make_aggregator(s, in_col))
+        tuple_agg = TupleAggregator(agg_map)
+        needed = sorted({c for c, _ in agg_map.values()})
+        select_values = lambda c, _need=tuple(needed): {k: c[k] for k in _need}  # noqa: E731
+
+        emit_bounds = window is not None
+        if window is None:
+            assigner = GlobalWindows()
+            assigner.is_event_time = False  # fire only at end-of-input
+            from flink_tpu.operators.window_agg import WindowAggOperator
+            from flink_tpu.windowing.triggers import EventTimeTrigger
+
+            def factory(_a=assigner, _agg=tuple_agg, _k=key_col):
+                return WindowAggOperator(
+                    _a, _agg, key_column=_k, value_selector=select_values,
+                    trigger=EventTimeTrigger(), emit_window_bounds=False,
+                    name="sql-group-agg")
+            t = keyed._then("sql-group-agg", factory)
+            from flink_tpu.datastream.api import DataStream
+            agg_stream = DataStream(keyed.env, t)
+        elif window.kind == "SESSION":
+            agg_stream = keyed.window(
+                EventTimeSessionWindows(window.size_ms)).aggregate(
+                    tuple_agg, value_selector=select_values,
+                    name="sql-session-agg")
+        else:
+            if window.kind == "TUMBLE":
+                assigner = TumblingEventTimeWindows.of(window.size_ms)
+            else:
+                assigner = SlidingEventTimeWindows.of(window.size_ms,
+                                                      window.slide_ms)
+            agg_stream = keyed.window(assigner).aggregate(
+                tuple_agg, value_selector=select_values, name="sql-window-agg")
+
+        # ---- split composite key back into its columns
+        if not single_col_key and len(key_exprs) > 1:
+            key_out_names = [f"__k{i}" for i in range(len(key_exprs))]
+
+            def split_key(cols, _names=key_out_names):
+                out = dict(cols)
+                tuples = cols["__key"]
+                for i, nm in enumerate(_names):
+                    out[nm] = np.asarray([t[i] for t in tuples])
+                return out
+
+            agg_stream = agg_stream.map(split_key, name="sql-key-split")
+            key_mapping = {k: Column(nm)
+                           for k, nm in zip(key_exprs, key_out_names)}
+        elif not single_col_key and len(key_exprs) == 1:
+            key_mapping = {key_exprs[0]: Column("__key")}
+        else:
+            key_mapping = {}
+
+        # ---- resolve select/having over the fired-batch schema
+        aux_mapping: Dict[Expr, Expr] = dict(key_mapping)
+        post_items = [SelectItem(_walk_replace(it.expr, aux_mapping), it.alias)
+                      for it in items]
+        # output names come from the user-visible items (aliases / original
+        # column names like "sum_v"), not the internal __k/__agg rewrites
+        names = _output_names(orig_items if orig_items is not None else items)
+        post_compiler = ExprCompiler()
+
+        if having is not None:
+            hv = post_compiler.compile(_walk_replace(having, aux_mapping))
+            agg_stream = agg_stream.filter(
+                lambda cols, _p=hv: np.asarray(to_column(_p(cols), _n(cols)),
+                                               bool), name="sql-having")
+
+        fns = [post_compiler.compile(it.expr) for it in post_items]
+
+        def project(cols, _fns=fns, _names=names):
+            n = _n(cols)
+            return {nm: to_column(f(cols), n) for nm, f in zip(_names, _fns)}
+
+        out = agg_stream.map(project, name="sql-project")
+        return QueryPlan(out, names, _order_names(stmt, items, names),
+                         stmt.limit)
+
+
+def _n(cols) -> int:
+    for v in cols.values():
+        return int(np.shape(v)[0])
+    return 0
+
+
+def _output_names(items: List[SelectItem]) -> List[str]:
+    names: List[str] = []
+    for i, it in enumerate(items):
+        nm = it.alias or expr_name(it.expr, i)
+        base, k = nm, 0
+        while nm in names:
+            k += 1
+            nm = f"{base}_{k}"
+        names.append(nm)
+    return names
+
+
+def _order_names(stmt: SelectStmt, items: List[SelectItem],
+                 names: List[str]) -> List[Tuple[str, bool]]:
+    """Resolve ORDER BY entries to output column names (by alias, by matching
+    select expression, or by 1-based ordinal)."""
+    out: List[Tuple[str, bool]] = []
+    for e, asc in stmt.order_by:
+        if isinstance(e, Literal) and isinstance(e.value, int):
+            out.append((names[e.value - 1], asc))
+            continue
+        if isinstance(e, Column):
+            if e.name in names:
+                out.append((e.name, asc))
+                continue
+        matched = None
+        for it, nm in zip(items, names):
+            if it.expr == e:
+                matched = nm
+                break
+        if matched is None:
+            raise PlanError(f"ORDER BY expression must appear in SELECT: {e}")
+        out.append((matched, asc))
+    return out

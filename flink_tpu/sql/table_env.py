@@ -1,0 +1,250 @@
+"""TableEnvironment / Table / TableResult — the SQL entry points.
+
+Analog of ``TableEnvironmentImpl.java:179`` (``executeSql:748``) and the
+``Table`` API (``flink-table-api-java``): register tables over sources or
+DataStreams, plan SQL through ``Planner`` onto the streaming runtime, collect
+bounded results.  Each ``execute`` plans onto a FRESH
+``StreamExecutionEnvironment`` so queries are isolated jobs (one job per
+submission, like the reference's per-statement pipeline translation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.sql.parser import SelectStmt, parse
+from flink_tpu.sql.planner import Planner, PlanError, QueryPlan
+
+
+@dataclass
+class CatalogTable:
+    """A registered table: stream factory + schema + time attributes."""
+
+    name: str
+    columns: List[str]
+    stream_factory: Callable[[Any], Any]   # env -> DataStream
+    rowtime: Optional[str] = None
+    watermark_delay_ms: int = 0
+    timestamps_assigned: bool = False
+    _bound_env: Any = None
+
+    def stream(self):
+        return self.stream_factory(self._bound_env)
+
+
+class TableEnvironment:
+    """Catalog + SQL planner over the streaming runtime."""
+
+    def __init__(self, parallelism: int = 1, max_parallelism: int = 128):
+        self.parallelism = parallelism
+        self.max_parallelism = max_parallelism
+        self._catalog: Dict[str, CatalogTable] = {}
+
+    @staticmethod
+    def create(**kw) -> "TableEnvironment":
+        return TableEnvironment(**kw)
+
+    # ---------------------------------------------------------- registration
+    def register_collection(self, name: str,
+                            rows: Optional[Sequence[Mapping[str, Any]]] = None,
+                            columns: Optional[Mapping[str, Any]] = None,
+                            rowtime: Optional[str] = None,
+                            watermark_delay_ms: int = 0,
+                            batch_size: int = 4096) -> "Table":
+        """Register an in-memory bounded table (``fromValues`` analog)."""
+        if columns is not None:
+            col_names = list(columns)
+            data = {k: np.asarray(v) for k, v in columns.items()}
+        elif rows:
+            col_names = list(rows[0].keys())
+            data = {k: np.asarray([r[k] for r in rows]) for k in col_names}
+        else:
+            raise ValueError("rows or columns required")
+
+        def factory(env, _data=data, _bs=batch_size):
+            return env.from_collection(columns=_data, batch_size=_bs,
+                                       name=f"table:{name}")
+
+        ct = CatalogTable(name, col_names, factory, rowtime=rowtime,
+                          watermark_delay_ms=watermark_delay_ms)
+        self._catalog[name] = ct
+        return Table(self, SelectStmt(items=[], table=name), ct)
+
+    def register_source(self, name: str, source, columns: List[str],
+                        rowtime: Optional[str] = None,
+                        watermark_delay_ms: int = 0) -> "Table":
+        """Register any connector ``Source`` as a table."""
+        def factory(env, _src=source):
+            return env.from_source(_src, name=f"table:{name}")
+
+        ct = CatalogTable(name, list(columns), factory, rowtime=rowtime,
+                          watermark_delay_ms=watermark_delay_ms)
+        self._catalog[name] = ct
+        return Table(self, SelectStmt(items=[], table=name), ct)
+
+    def create_temporary_view(self, name: str, table: "Table") -> None:
+        """Register a planned query as a view (``createTemporaryView``)."""
+        stmt = table._stmt
+
+        def factory(env, _stmt=stmt):
+            plan = Planner(env, self._catalog).plan(_stmt)
+            return plan.stream
+
+        cols = self._output_columns(stmt)
+        self._catalog[name] = CatalogTable(
+            name, cols, factory, timestamps_assigned=True)
+
+    def _output_columns(self, stmt: SelectStmt) -> List[str]:
+        """Dry-plan on a throwaway env to learn a view's output schema."""
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+        env = StreamExecutionEnvironment(parallelism=self.parallelism,
+                                         max_parallelism=self.max_parallelism)
+        for t in self._catalog.values():
+            t._bound_env = env
+        try:
+            return Planner(env, self._catalog).plan(stmt).output_columns
+        finally:
+            for t in self._catalog.values():
+                t._bound_env = None
+
+    # ---------------------------------------------------------------- query
+    def sql_query(self, sql: str) -> "Table":
+        return Table(self, parse(sql))
+
+    def execute_sql(self, sql: str) -> "TableResult":
+        return self.sql_query(sql).execute()
+
+    def _plan(self, stmt: SelectStmt):
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+        env = StreamExecutionEnvironment(parallelism=self.parallelism,
+                                         max_parallelism=self.max_parallelism)
+        for t in self._catalog.values():
+            t._bound_env = env
+        try:
+            plan = Planner(env, self._catalog).plan(stmt)
+        finally:
+            for t in self._catalog.values():
+                t._bound_env = None
+        return env, plan
+
+
+class Table:
+    """A (lazily planned) relational query (``Table`` analog)."""
+
+    def __init__(self, tenv: TableEnvironment, stmt: SelectStmt,
+                 catalog_entry: Optional[CatalogTable] = None):
+        self.tenv = tenv
+        self._stmt = stmt
+        self._entry = catalog_entry
+
+    # -- fluent Table API (sugar over the SQL AST) --------------------------
+    def _table_name(self) -> str:
+        if self._stmt.table is None:
+            raise PlanError("table has no FROM target")
+        return self._stmt.table
+
+    def select(self, select_list: str) -> "Table":
+        """Replace the projection, keeping WHERE/GROUP BY/... intact."""
+        import copy
+        items = parse(f"SELECT {select_list} FROM {self._table_name()}").items
+        stmt = copy.copy(self._stmt)
+        stmt.items = items
+        return Table(self.tenv, stmt)
+
+    def where(self, condition: str) -> "Table":
+        """AND the condition into the existing WHERE clause."""
+        import copy
+        from flink_tpu.sql.parser import Binary
+        cond = parse(
+            f"SELECT * FROM {self._table_name()} WHERE {condition}").where
+        stmt = copy.copy(self._stmt)
+        stmt.where = (cond if stmt.where is None
+                      else Binary("AND", stmt.where, cond))
+        return Table(self.tenv, stmt)
+
+    filter = where
+
+    def group_by(self, keys: str) -> "GroupedTable":
+        return GroupedTable(self, keys)
+
+    # -- execution ----------------------------------------------------------
+    def execute(self) -> "TableResult":
+        stmt = self._stmt
+        if not stmt.items:  # bare registered table: SELECT *
+            stmt = parse(f"SELECT * FROM {stmt.table}")
+        env, plan = self.tenv._plan(stmt)
+        return TableResult(env, plan)
+
+    def to_data_stream(self, env=None):
+        """Plan onto ``env`` (or the table env's fresh one) and return the
+        result ``DataStream`` (``toDataStream`` / ``toChangelogStream``)."""
+        stmt = self._stmt
+        if not stmt.items:
+            stmt = parse(f"SELECT * FROM {stmt.table}")
+        if env is None:
+            env, plan = self.tenv._plan(stmt)
+            return plan.stream
+        for t in self.tenv._catalog.values():
+            t._bound_env = env
+        try:
+            return Planner(env, self.tenv._catalog).plan(stmt).stream
+        finally:
+            for t in self.tenv._catalog.values():
+                t._bound_env = None
+
+
+class GroupedTable:
+    def __init__(self, table: Table, keys: str):
+        self.table = table
+        self.keys = keys
+
+    def select(self, select_list: str) -> Table:
+        import copy
+        sql = (f"SELECT {select_list} FROM {self.table._table_name()} "
+               f"GROUP BY {self.keys}")
+        stmt = parse(sql)
+        stmt.where = copy.copy(self.table._stmt.where)  # keep prior where()
+        return Table(self.table.tenv, stmt)
+
+
+class TableResult:
+    """Bounded query result: executes the job on collect (``TableResult``)."""
+
+    def __init__(self, env, plan: QueryPlan):
+        self.env = env
+        self.plan = plan
+        self._rows: Optional[List[Dict[str, Any]]] = None
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.plan.output_columns
+
+    def collect(self) -> List[Dict[str, Any]]:
+        if self._rows is None:
+            sink = self.plan.stream.collect()
+            self.env.execute("sql-query")
+            rows = sink.rows()
+            rows = [{k: r.get(k) for k in self.plan.output_columns}
+                    for r in rows]
+            if self.plan.order_by:
+                keys = list(reversed(self.plan.order_by))
+
+                def sort_key_chain(rs):
+                    for name, asc in keys:
+                        rs.sort(key=lambda r: r[name], reverse=not asc)
+                    return rs
+                rows = sort_key_chain(rows)
+            if self.plan.limit is not None:
+                rows = rows[: self.plan.limit]
+            self._rows = rows
+        return self._rows
+
+    def print(self) -> None:
+        rows = self.collect()
+        cols = self.plan.output_columns
+        print(" | ".join(cols))
+        for r in rows:
+            print(" | ".join(str(r[c]) for c in cols))
