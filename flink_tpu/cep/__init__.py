@@ -1,0 +1,12 @@
+"""Complex Event Processing (CEP) library.
+
+Analog of ``flink-libraries/flink-cep``: a fluent ``Pattern`` API compiled
+to an NFA run over keyed streams, with vectorized condition evaluation per
+batch and host-side transitions (``CEP.java``, ``nfa/NFA.java:86``).
+"""
+
+from flink_tpu.cep.operator import CEP, CepOperator, NFA, PatternStream
+from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern, Stage
+
+__all__ = ["AfterMatchSkipStrategy", "CEP", "CepOperator", "NFA", "Pattern",
+           "PatternStream", "Stage"]

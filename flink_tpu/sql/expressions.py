@@ -45,6 +45,15 @@ def _sql_div(a, b):
     return np.asarray(a, np.float64) / np.asarray(b, np.float64)
 
 
+def _sql_mod(a, b):
+    """SQL/Java remainder: sign follows the dividend (np.mod follows the
+    divisor — MOD(-7, 2) must be -1, not 1)."""
+    r = np.fmod(a, b)
+    if _is_int(a) and _is_int(b):
+        return r.astype(np.result_type(a, b))
+    return r
+
+
 def _as_str(a) -> np.ndarray:
     arr = np.asarray(a)
     if arr.dtype.kind in "OU":
@@ -95,7 +104,8 @@ SCALAR_FUNCS: Dict[str, Callable[..., Any]] = {
     "LN": lambda x: np.log(np.asarray(x, np.float64)),
     "LOG10": lambda x: np.log10(np.asarray(x, np.float64)),
     "POWER": lambda x, y: np.power(np.asarray(x, np.float64), y),
-    "MOD": lambda x, y: np.mod(x, y),
+    # fmod = truncated modulo (sign of dividend), matching Java/Calcite %
+    "MOD": lambda x, y: _sql_mod(x, y),
     "SIGN": lambda x: np.sign(x),
     "UPPER": lambda s: np.char.upper(_as_str(s)).astype(object),
     "LOWER": lambda s: np.char.lower(_as_str(s)).astype(object),
@@ -276,7 +286,7 @@ class ExprCompiler:
         if op == "/":
             return lambda cols: _sql_div(lf(cols), rf(cols))
         if op == "%":
-            return lambda cols: np.mod(lf(cols), rf(cols))
+            return lambda cols: _sql_mod(lf(cols), rf(cols))
         cmp = {"=": np.equal, "<>": np.not_equal, "<": np.less,
                "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal}
         if op in cmp:

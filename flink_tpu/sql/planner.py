@@ -113,44 +113,26 @@ def _walk_replace(expr: Expr, mapping: Dict[Expr, Expr]) -> Expr:
 
 def _extract_aggs(expr: Expr, specs: List[AggSpec],
                   cache: Dict[Expr, Column]) -> Expr:
-    """Replace aggregate calls with placeholder columns, collecting specs."""
-    if isinstance(expr, Call) and expr.name in AGG_FUNCS:
-        if expr.distinct:
-            raise PlanError(f"{expr.name}(DISTINCT ...) is not supported yet")
-        if expr in cache:
-            return cache[expr]
-        arg = None
-        if not (len(expr.args) == 1 and isinstance(expr.args[0], Star)):
-            if len(expr.args) != 1:
-                raise PlanError(f"{expr.name} takes exactly one argument")
-            arg = expr.args[0]
-        name = f"__agg{len(specs)}"
-        specs.append(AggSpec(name, expr.name, arg))
-        col = Column(name)
-        cache[expr] = col
-        return col
-    if isinstance(expr, Unary):
-        return Unary(expr.op, _extract_aggs(expr.operand, specs, cache))
-    if isinstance(expr, Binary):
-        return Binary(expr.op, _extract_aggs(expr.left, specs, cache),
-                      _extract_aggs(expr.right, specs, cache))
-    if isinstance(expr, Call):
-        return Call(expr.name,
-                    tuple(_extract_aggs(a, specs, cache) for a in expr.args),
-                    expr.distinct)
-    if isinstance(expr, Cast):
-        return Cast(_extract_aggs(expr.expr, specs, cache), expr.type_name)
-    if isinstance(expr, Case):
-        return Case(tuple((_extract_aggs(c, specs, cache),
-                           _extract_aggs(r, specs, cache))
-                          for c, r in expr.whens),
-                    _extract_aggs(expr.default, specs, cache)
-                    if expr.default is not None else None)
-    if isinstance(expr, Between):
-        return Between(_extract_aggs(expr.expr, specs, cache),
-                       _extract_aggs(expr.lo, specs, cache),
-                       _extract_aggs(expr.hi, specs, cache), expr.negated)
-    return expr
+    """Replace aggregate calls with placeholder columns, collecting specs
+    (full node coverage via the generic ``_transform`` walker)."""
+    def fn(e: Expr) -> Optional[Expr]:
+        if isinstance(e, Call) and e.name in AGG_FUNCS:
+            if e.distinct:
+                raise PlanError(f"{e.name}(DISTINCT ...) is not supported yet")
+            if e in cache:
+                return cache[e]
+            arg = None
+            if not (len(e.args) == 1 and isinstance(e.args[0], Star)):
+                if len(e.args) != 1:
+                    raise PlanError(f"{e.name} takes exactly one argument")
+                arg = e.args[0]
+            name = f"__agg{len(specs)}"
+            specs.append(AggSpec(name, e.name, arg))
+            col = Column(name)
+            cache[e] = col
+            return col
+        return None
+    return _transform(expr, fn)
 
 
 def _contains_agg(expr: Expr) -> bool:
@@ -159,16 +141,29 @@ def _contains_agg(expr: Expr) -> bool:
     return bool(specs)
 
 
-def _make_aggregator(spec: AggSpec, value_col: str):
+def _agg_dtype():
+    """Accumulator dtype for SQL aggregates.
+
+    float64 only when jax x64 is enabled — otherwise request float32
+    explicitly instead of letting jax silently truncate a float64 request
+    (TPU accumulates in f32; sums are chunked per micro-batch + pane and
+    tree-combined at fire time, which bounds error growth vs naive
+    sequential accumulation)."""
+    import jax
     import jax.numpy as jnp
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def _make_aggregator(spec: AggSpec, value_col: str):
+    dt = _agg_dtype()
     if spec.func == "SUM":
-        return SumAggregator(jnp.float64)
+        return SumAggregator(dt)
     if spec.func == "AVG":
-        return AvgAggregator(jnp.float64)
+        return AvgAggregator(dt)
     if spec.func == "MIN":
-        return MinAggregator(jnp.float64)
+        return MinAggregator(dt)
     if spec.func == "MAX":
-        return MaxAggregator(jnp.float64)
+        return MaxAggregator(dt)
     if spec.func == "COUNT":
         return CountAggregator()
     raise PlanError(f"unknown aggregate {spec.func}")
@@ -401,7 +396,20 @@ class Planner:
         # output names come from the user-visible items (aliases / original
         # column names like "sum_v"), not the internal __k/__agg rewrites
         names = _output_names(orig_items if orig_items is not None else items)
-        post_compiler = ExprCompiler()
+        # fired-batch schema: group keys + aggregate results (+ window
+        # bounds) — referencing any other column is the classic "column must
+        # appear in GROUP BY" SQL error, caught at plan time
+        fired_schema = {s.out_name: None for s in agg_specs}
+        if emit_bounds:
+            fired_schema.update(window_start=None, window_end=None)
+        if single_col_key:
+            fired_schema[key_col] = None
+        elif len(key_exprs) > 1:
+            fired_schema.update({f"__k{i}": None
+                                 for i in range(len(key_exprs))})
+        else:
+            fired_schema["__key"] = None
+        post_compiler = ExprCompiler(fired_schema)
 
         if having is not None:
             hv = post_compiler.compile(_walk_replace(having, aux_mapping))

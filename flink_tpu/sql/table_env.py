@@ -94,8 +94,11 @@ class TableEnvironment:
             return plan.stream
 
         cols = self._output_columns(stmt)
-        self._catalog[name] = CatalogTable(
-            name, cols, factory, timestamps_assigned=True)
+        # timestamps_assigned stays False: a windowed query OVER the view
+        # names its own time column, and re-assigning watermarks from it is
+        # always safe on bounded inputs (the view's own event-time handling,
+        # if any, already happened inside its plan)
+        self._catalog[name] = CatalogTable(name, cols, factory)
 
     def _output_columns(self, stmt: SelectStmt) -> List[str]:
         """Dry-plan on a throwaway env to learn a view's output schema."""

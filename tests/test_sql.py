@@ -319,3 +319,35 @@ def test_cast_string_boolean():
     rows = t.execute_sql(
         "SELECT x FROM r WHERE CAST(f AS BOOLEAN)").collect()
     assert [r["x"] for r in rows] == [1, 3]
+
+
+def test_windowed_query_over_view():
+    t = _tenv()
+    t.register_collection("e", columns={
+        "k": np.array(["a", "a"], object), "v": np.array([1.0, 2.0]),
+        "ts": np.array([0, 1000], np.int64)})
+    t.create_temporary_view("ve", t.sql_query("SELECT k, v, ts FROM e"))
+    rows = t.execute_sql(
+        "SELECT k, SUM(v) s FROM ve "
+        "GROUP BY k, TUMBLE(ts, INTERVAL '5' SECOND)").collect()
+    assert rows == [{"k": "a", "s": 3.0}]
+
+
+def test_mod_sign_and_having_in():
+    t = _tenv()
+    t.register_collection("m", columns={"a": np.array([-7, 7], np.int64),
+                                        "k": np.array([0, 1], np.int64)})
+    rows = t.execute_sql("SELECT a % 2 AS r, MOD(a, 2) AS m2 FROM m").collect()
+    assert [r["r"] for r in rows] == [-1, 1]
+    assert [r["m2"] for r in rows] == [-1, 1]
+    rows = t.execute_sql(
+        "SELECT k, SUM(a) AS s FROM m GROUP BY k HAVING SUM(a) IN (7)").collect()
+    assert rows == [{"k": 1, "s": 7.0}]
+
+
+def test_non_grouped_column_rejected():
+    from flink_tpu.sql import PlanError
+    t = _tenv()
+    t.register_collection("e", rows=[{"k": "a", "v": 1.0}])
+    with pytest.raises(PlanError):
+        t.execute_sql("SELECT v, SUM(v) AS s FROM e GROUP BY k")
