@@ -55,6 +55,9 @@ class NFA:
         #: SKIP_PAST_LAST_EVENT barrier: events at/before this ts cannot
         #: extend or start matches
         self.skip_until_ts: int = LONG_MIN
+        #: event id -> row, for match assembly (``SharedBuffer`` analog);
+        #: pruned to events referenced by live partials after every drain
+        self._rows: Dict[int, dict] = {}
 
     def _expired(self, pm: _Partial, ts: int) -> bool:
         w = self.pattern.within_ms
@@ -197,13 +200,8 @@ class CepOperator(StreamOperator):
             nfa = self._nfas.get(k)
             if nfa is None:
                 nfa = self._nfas[k] = NFA(self.pattern)
-            events_by_id = {}
             for ts, eid, bits, row in ready:
-                events_by_id[eid] = row
-            # NFA needs historical rows for match assembly
-            if not hasattr(nfa, "_rows"):
-                nfa._rows = {}
-            nfa._rows.update(events_by_id)
+                nfa._rows[eid] = row
             for ts, eid, bits, row in ready:
                 for match in nfa.advance(eid, ts, bits):
                     named: Dict[str, List[dict]] = {}
@@ -214,6 +212,14 @@ class CepOperator(StreamOperator):
                     if res is not None:
                         out_rows.append(res)
                         out_ts.append(ts)
+            # SharedBuffer-style pruning: rows only live as long as a partial
+            # match references them — otherwise host memory (and every
+            # checkpoint) grows with total events processed
+            referenced = {ev_id for pm in nfa.partials
+                          for _stage, ev_id in pm.events}
+            if len(nfa._rows) > len(referenced):
+                nfa._rows = {e: r for e, r in nfa._rows.items()
+                             if e in referenced}
         if not out_rows:
             return []
         cols = {c: np.asarray([r[c] for r in out_rows])

@@ -1,0 +1,360 @@
+"""Native runtime layer loader (C++ via ctypes).
+
+Builds ``native/flink_native.cc`` into a shared library on first use (g++,
+cached by source hash) and exposes typed wrappers.  If no compiler is
+available the pure-Python fallbacks in :mod:`flink_tpu.native.fallback` are
+used transparently — same API, slower, and compression falls back to zlib
+(method byte 2 in the block format, see :mod:`flink_tpu.native.codec`).
+
+This is the TPU-native equivalent of the reference's native-performance
+components (SURVEY §2.6): Cython fast coders, JNI LZ4 buffer compression,
+RocksDB spill tier, off-heap network buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "flink_native.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _build_error
+    if not os.path.exists(_SRC):
+        _build_error = f"source not found: {_SRC}"
+        return None
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD_DIR, f"libflink_native_{digest}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = so_path + f".tmp.{os.getpid()}"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               "-fvisibility=hidden", "-o", tmp, _SRC]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                FileNotFoundError) as e:
+            err = getattr(e, "stderr", b"") or b""
+            _build_error = f"native build failed: {e}: {err.decode()[:500]}"
+            return None
+    lib = ctypes.CDLL(so_path)
+    _declare(lib)
+    return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64, u8p, u32 = ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32
+    vp, cp, cint = ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+    lib.fn_delta_varint_encode_i64.restype = i64
+    lib.fn_delta_varint_encode_i64.argtypes = [ctypes.c_void_p, i64, u8p, i64]
+    lib.fn_delta_varint_decode_i64.restype = i64
+    lib.fn_delta_varint_decode_i64.argtypes = [u8p, i64, i64, ctypes.c_void_p]
+    lib.fn_lz_bound.restype = i64
+    lib.fn_lz_bound.argtypes = [i64]
+    lib.fn_lz_compress.restype = i64
+    lib.fn_lz_compress.argtypes = [u8p, i64, u8p, i64]
+    lib.fn_lz_decompress.restype = i64
+    lib.fn_lz_decompress.argtypes = [u8p, i64, u8p, i64]
+    lib.fn_crc32.restype = u32
+    lib.fn_crc32.argtypes = [u8p, i64, u32]
+    lib.spill_open.restype = vp
+    lib.spill_open.argtypes = [cp, i64]
+    lib.spill_put.restype = cint
+    lib.spill_put.argtypes = [vp, u8p, i64, u8p, i64]
+    lib.spill_get.restype = i64
+    lib.spill_get.argtypes = [vp, u8p, i64, u8p, i64]
+    lib.spill_delete.restype = cint
+    lib.spill_delete.argtypes = [vp, u8p, i64]
+    lib.spill_count.restype = i64
+    lib.spill_count.argtypes = [vp]
+    lib.spill_mem_used.restype = i64
+    lib.spill_mem_used.argtypes = [vp]
+    lib.spill_log_bytes.restype = i64
+    lib.spill_log_bytes.argtypes = [vp]
+    lib.spill_log_garbage.restype = i64
+    lib.spill_log_garbage.argtypes = [vp]
+    lib.spill_flush.restype = cint
+    lib.spill_flush.argtypes = [vp]
+    lib.spill_compact.restype = i64
+    lib.spill_compact.argtypes = [vp]
+    lib.spill_close.restype = None
+    lib.spill_close.argtypes = [vp]
+    lib.spill_iter_begin.restype = vp
+    lib.spill_iter_begin.argtypes = [vp]
+    lib.spill_iter_next.restype = i64
+    lib.spill_iter_next.argtypes = [vp, u8p, i64]
+    lib.spill_iter_end.restype = None
+    lib.spill_iter_end.argtypes = [vp]
+    lib.ring_create.restype = vp
+    lib.ring_create.argtypes = [i64]
+    lib.ring_free_space.restype = i64
+    lib.ring_free_space.argtypes = [vp]
+    lib.ring_push.restype = cint
+    lib.ring_push.argtypes = [vp, u8p, i64]
+    lib.ring_pop.restype = i64
+    lib.ring_pop.argtypes = [vp, u8p, i64]
+    lib.ring_destroy.restype = None
+    lib.ring_destroy.argtypes = [vp]
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first call; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is None and _build_error is None:
+            _lib = _build_and_load()
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def build_error() -> Optional[str]:
+    get_lib()
+    return _build_error
+
+
+def _u8(buf) -> "ctypes.POINTER(ctypes.c_uint8)":
+    return (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf) if isinstance(buf, (bytes, bytearray)) else buf
+
+
+# ---------------------------------------------------------------------------
+# typed wrappers (native or fallback)
+# ---------------------------------------------------------------------------
+
+def lz_compress(data: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        from flink_tpu.native import fallback
+        return fallback.lz_compress(data)
+    n = len(data)
+    cap = int(lib.fn_lz_bound(n))
+    out = (ctypes.c_uint8 * cap)()
+    w = lib.fn_lz_compress(_u8(data), n, out, cap)
+    if w < 0:
+        raise RuntimeError("lz_compress overflow")
+    return bytes(out[:w])
+
+
+def lz_decompress(data: bytes, orig_n: int) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("FLZ decompression requires the native library: "
+                           + str(_build_error))
+    out = (ctypes.c_uint8 * max(orig_n, 1))()
+    r = lib.fn_lz_decompress(_u8(data), len(data), out, orig_n)
+    if r != orig_n:
+        raise ValueError("malformed FLZ block")
+    return bytes(out[:orig_n])
+
+
+def delta_varint_encode(vals) -> bytes:
+    import numpy as np
+    vals = np.ascontiguousarray(vals, np.int64)
+    lib = get_lib()
+    if lib is None:
+        from flink_tpu.native import fallback
+        return fallback.delta_varint_encode(vals)
+    cap = vals.size * 10 + 16
+    out = (ctypes.c_uint8 * cap)()
+    w = lib.fn_delta_varint_encode_i64(vals.ctypes.data_as(ctypes.c_void_p),
+                                       vals.size, out, cap)
+    if w < 0:
+        raise RuntimeError("varint encode overflow")
+    return bytes(out[:w])
+
+
+def delta_varint_decode(data: bytes, n: int):
+    import numpy as np
+    lib = get_lib()
+    if lib is None:
+        from flink_tpu.native import fallback
+        return fallback.delta_varint_decode(data, n)
+    out = np.empty(n, np.int64)
+    r = lib.fn_delta_varint_decode_i64(_u8(data), len(data), n,
+                                       out.ctypes.data_as(ctypes.c_void_p))
+    if r < 0:
+        raise ValueError("malformed varint stream")
+    return out
+
+
+def crc32(data: bytes, seed: int = 0) -> int:
+    lib = get_lib()
+    if lib is None:
+        import zlib
+        return zlib.crc32(data, seed)
+    return int(lib.fn_crc32(_u8(data), len(data), seed))
+
+
+class SpillStore:
+    """Memory-budgeted KV store with disk spill (RocksDB-tier analog).
+
+    Keys and values are ``bytes``. Values beyond ``mem_budget`` resident bytes
+    are evicted (oldest-written first) to an append-only log;
+    ``flush()`` persists a manifest so ``SpillStore(dir)`` reopens durable
+    state; ``compact()`` reclaims dead log bytes.
+    """
+
+    def __init__(self, directory: str, mem_budget: int = 64 << 20):
+        self._lib = get_lib()
+        self.directory = directory
+        if self._lib is None:
+            from flink_tpu.native import fallback
+            self._impl = fallback.PySpillStore(directory, mem_budget)
+            self._h = None
+        else:
+            os.makedirs(directory, exist_ok=True)
+            self._h = self._lib.spill_open(directory.encode(), mem_budget)
+            if not self._h:
+                raise RuntimeError(f"spill_open failed for {directory}")
+            self._impl = None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if self._impl is not None:
+            self._impl.put(key, value)
+            return
+        self._lib.spill_put(self._h, _u8(key), len(key), _u8(value), len(value))
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        if self._impl is not None:
+            return self._impl.get(key)
+        cap = 4096
+        while True:
+            out = (ctypes.c_uint8 * cap)()
+            n = self._lib.spill_get(self._h, _u8(key), len(key), out, cap)
+            if n == -1:
+                return None
+            if n == -2:
+                raise IOError("spill store read failed")
+            if n <= cap:
+                return bytes(out[:n])
+            cap = int(n)
+
+    def delete(self, key: bytes) -> bool:
+        if self._impl is not None:
+            return self._impl.delete(key)
+        return bool(self._lib.spill_delete(self._h, _u8(key), len(key)))
+
+    def __len__(self) -> int:
+        if self._impl is not None:
+            return len(self._impl)
+        return int(self._lib.spill_count(self._h))
+
+    def keys(self):
+        if self._impl is not None:
+            yield from self._impl.keys()
+            return
+        it = self._lib.spill_iter_begin(self._h)
+        try:
+            cap = 256
+            buf = (ctypes.c_uint8 * cap)()
+            while True:
+                n = self._lib.spill_iter_next(it, buf, cap)
+                if n == -1:
+                    return
+                if n > cap:
+                    cap = int(n)
+                    buf = (ctypes.c_uint8 * cap)()
+                    continue
+                yield bytes(buf[:n])
+        finally:
+            self._lib.spill_iter_end(it)
+
+    def mem_used(self) -> int:
+        if self._impl is not None:
+            return self._impl.mem_used()
+        return int(self._lib.spill_mem_used(self._h))
+
+    def log_bytes(self) -> int:
+        if self._impl is not None:
+            return self._impl.log_bytes()
+        return int(self._lib.spill_log_bytes(self._h))
+
+    def flush(self) -> None:
+        if self._impl is not None:
+            self._impl.flush()
+            return
+        if self._lib.spill_flush(self._h) != 0:
+            raise IOError("spill flush failed")
+
+    def compact(self) -> int:
+        if self._impl is not None:
+            return self._impl.compact()
+        r = int(self._lib.spill_compact(self._h))
+        if r < 0:
+            raise IOError("spill compact failed")
+        return r
+
+    def close(self) -> None:
+        if self._impl is not None:
+            self._impl.close()
+            return
+        if self._h:
+            self._lib.spill_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RingBuffer:
+    """SPSC length-prefixed byte ring (host infeed / network buffer analog)."""
+
+    def __init__(self, capacity: int = 1 << 20):
+        self._lib = get_lib()
+        if self._lib is None:
+            from flink_tpu.native import fallback
+            self._impl = fallback.PyRingBuffer(capacity)
+            self._h = None
+        else:
+            self._h = self._lib.ring_create(capacity)
+            self._impl = None
+
+    def push(self, data: bytes) -> bool:
+        if self._impl is not None:
+            return self._impl.push(data)
+        return bool(self._lib.ring_push(self._h, _u8(data), len(data)))
+
+    def pop(self) -> Optional[bytes]:
+        if self._impl is not None:
+            return self._impl.pop()
+        cap = 4096
+        while True:
+            out = (ctypes.c_uint8 * cap)()
+            n = self._lib.ring_pop(self._h, out, cap)
+            if n == -1:
+                return None
+            if n <= cap:
+                return bytes(out[:n])
+            cap = int(n)
+
+    def free_space(self) -> int:
+        if self._impl is not None:
+            return self._impl.free_space()
+        return int(self._lib.ring_free_space(self._h))
+
+    def close(self) -> None:
+        if self._impl is not None:
+            return
+        if self._h:
+            self._lib.ring_destroy(self._h)
+            self._h = None

@@ -1,0 +1,139 @@
+"""Pure-Python fallbacks for the native layer (no compiler available).
+
+Same API as the ctypes wrappers in :mod:`flink_tpu.native`; compression uses
+zlib (stdlib) instead of FLZ — the block codec records the method byte so
+readers dispatch correctly either way.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import zlib
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def lz_compress(data: bytes) -> bytes:
+    # Marker handled by codec.py: fallback blocks are written as method=zlib.
+    return zlib.compress(data, 1)
+
+
+_U64 = (1 << 64) - 1
+
+
+def delta_varint_encode(vals: np.ndarray) -> bytes:
+    vals = np.asarray(vals, np.int64)
+    out = bytearray()
+    prev = 0
+    for v in vals.tolist():
+        # wrap the delta to int64 first (it can exceed the int64 range when
+        # mixing large-magnitude values) — matches the native C++ wraparound
+        d = (v - prev) & _U64
+        if d >= 1 << 63:
+            d -= 1 << 64
+        prev = v
+        z = ((d << 1) ^ (d >> 63)) & _U64
+        while z >= 0x80:
+            out.append((z & 0x7F) | 0x80)
+            z >>= 7
+        out.append(z)
+    return bytes(out)
+
+
+def delta_varint_decode(data: bytes, n: int) -> np.ndarray:
+    out = np.empty(n, np.int64)
+    pos = 0
+    prev = 0
+    for i in range(n):
+        z = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            z |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        d = (z >> 1) ^ -(z & 1)
+        # interpret as signed 64-bit
+        if d >= 1 << 63:
+            d -= 1 << 64
+        prev = (prev + d) & ((1 << 64) - 1)
+        sv = prev if prev < 1 << 63 else prev - (1 << 64)
+        out[i] = sv
+        prev = sv
+    return out
+
+
+class PySpillStore:
+    """Dict + pickle-file persistence; honors the same flush/reopen contract."""
+
+    def __init__(self, directory: str, mem_budget: int):
+        self.directory = directory
+        self.mem_budget = mem_budget
+        os.makedirs(directory, exist_ok=True)
+        self._path = os.path.join(directory, "pystore.pkl")
+        self._map: Dict[bytes, bytes] = {}
+        if os.path.exists(self._path):
+            with open(self._path, "rb") as f:
+                self._map = pickle.load(f)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._map[key] = value
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._map.get(key)
+
+    def delete(self, key: bytes) -> bool:
+        return self._map.pop(key, None) is not None
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def keys(self) -> Iterator[bytes]:
+        yield from list(self._map)
+
+    def mem_used(self) -> int:
+        return sum(len(v) for v in self._map.values())
+
+    def log_bytes(self) -> int:
+        return 0
+
+    def flush(self) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._map, f)
+        os.replace(tmp, self._path)
+
+    def compact(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        pass
+
+
+class PyRingBuffer:
+    def __init__(self, capacity: int):
+        from collections import deque
+        self.capacity = capacity
+        self._q = deque()
+        self._used = 0
+
+    def push(self, data: bytes) -> bool:
+        if self._used + len(data) + 4 > self.capacity:
+            return False
+        self._q.append(data)
+        self._used += len(data) + 4
+        return True
+
+    def pop(self) -> Optional[bytes]:
+        if not self._q:
+            return None
+        d = self._q.popleft()
+        self._used -= len(d) + 4
+        return d
+
+    def free_space(self) -> int:
+        return self.capacity - self._used

@@ -1,0 +1,626 @@
+// flink_tpu native runtime layer (C ABI, loaded via ctypes).
+//
+// TPU-native equivalents of the reference's native-performance components
+// (SURVEY §2.6): the Cython fast coders (pyflink/fn_execution/*_fast.pyx)
+// become the varint/block codec here; the JNI LZ4 buffer compression
+// (runtime/io/compression/BufferCompressor.java) becomes the FLZ block
+// compressor; the RocksDB JNI keyed-state spill tier
+// (flink-state-backends/flink-statebackend-rocksdb) becomes SpillStore — an
+// in-memory hash index over an append-only value log with a memory budget,
+// eviction to disk, manifest-based persistence and compaction; the Netty
+// off-heap buffer ring becomes the SPSC byte ring buffer used by host infeed.
+//
+// Everything is original code written for this framework; formats are custom
+// ("FLZ1" block format, "FSP1" manifest) — no wire compatibility with the
+// reference is intended or needed.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#if defined(_WIN32)
+#error "POSIX only"
+#endif
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#define API extern "C" __attribute__((visibility("default")))
+
+typedef int64_t i64;
+typedef uint64_t u64;
+typedef int32_t i32;
+typedef uint32_t u32;
+typedef uint8_t u8;
+
+// ---------------------------------------------------------------------------
+// varint / zigzag (delta codec for sorted int64 columns: timestamps, keys)
+// ---------------------------------------------------------------------------
+
+static inline u64 zigzag_enc(i64 v) { return ((u64)v << 1) ^ (u64)(v >> 63); }
+static inline i64 zigzag_dec(u64 v) { return (i64)(v >> 1) ^ -(i64)(v & 1); }
+
+static inline size_t varint_put(u8* out, u64 v) {
+  size_t i = 0;
+  while (v >= 0x80) { out[i++] = (u8)(v | 0x80); v >>= 7; }
+  out[i++] = (u8)v;
+  return i;
+}
+
+static inline size_t varint_get(const u8* in, const u8* end, u64* v) {
+  u64 r = 0; int shift = 0; size_t i = 0;
+  while (in + i < end) {
+    u8 b = in[i++];
+    r |= (u64)(b & 0x7f) << shift;
+    if (!(b & 0x80)) { *v = r; return i; }
+    shift += 7;
+    if (shift > 63) break;
+  }
+  return 0;  // malformed
+}
+
+// Delta + zigzag + varint encode. Returns bytes written, or -1 if cap too
+// small. Worst case 10 bytes/value.
+API i64 fn_delta_varint_encode_i64(const i64* vals, i64 n, u8* out, i64 cap) {
+  i64 w = 0, prev = 0;
+  for (i64 i = 0; i < n; i++) {
+    if (w + 10 > cap) return -1;
+    w += (i64)varint_put(out + w, zigzag_enc(vals[i] - prev));
+    prev = vals[i];
+  }
+  return w;
+}
+
+// Returns bytes consumed, or -1 on malformed input.
+API i64 fn_delta_varint_decode_i64(const u8* in, i64 nbytes, i64 n, i64* out) {
+  const u8* end = in + nbytes;
+  i64 r = 0, prev = 0;
+  for (i64 i = 0; i < n; i++) {
+    u64 v;
+    size_t c = varint_get(in + r, end, &v);
+    if (c == 0) return -1;
+    r += (i64)c;
+    prev += zigzag_dec(v);
+    out[i] = prev;
+  }
+  return r;
+}
+
+// ---------------------------------------------------------------------------
+// FLZ block compression (LZ77, byte-oriented, format "FLZ1")
+//
+// Sequence = token byte (hi nibble literal-run len, lo nibble match len - 4,
+// 15 => varint extension follows), literals, u16le offset, [ext match len].
+// Final sequence carries literals only (match nibble unused, no offset).
+// ---------------------------------------------------------------------------
+
+static const int FLZ_HASH_LOG = 15;
+static const u32 FLZ_MIN_MATCH = 4;
+
+static inline u32 flz_hash(u32 seq) {
+  return (seq * 2654435761u) >> (32 - FLZ_HASH_LOG);
+}
+
+static inline u32 read32(const u8* p) { u32 v; memcpy(&v, p, 4); return v; }
+
+API i64 fn_lz_bound(i64 n) { return n + n / 255 + 80; }
+
+// Compress src[0..n) into dst (cap >= fn_lz_bound(n)). Returns compressed
+// size, or -1 on cap overflow.
+API i64 fn_lz_compress(const u8* src, i64 n, u8* dst, i64 cap) {
+  std::vector<i64> table((size_t)1 << FLZ_HASH_LOG, -1);
+  i64 ip = 0, anchor = 0, op = 0;
+  const i64 mflimit = n - (i64)FLZ_MIN_MATCH;
+
+  auto emit = [&](i64 lit_len, i64 match_len, i64 offset, bool final_seq) -> bool {
+    // worst-case bytes for this sequence (varint extensions are <= 10 bytes)
+    i64 need = 1 + lit_len + (lit_len >= 15 ? 10 : 0) + 12;
+    if (op + need > cap) return false;
+    u8* token = dst + op++;
+    i64 ml = final_seq ? 0 : match_len - FLZ_MIN_MATCH;
+    *token = (u8)(((lit_len < 15 ? lit_len : 15) << 4) |
+                  (ml < 15 ? ml : 15));
+    if (lit_len >= 15) op += (i64)varint_put(dst + op, (u64)(lit_len - 15));
+    memcpy(dst + op, src + anchor, (size_t)lit_len);
+    op += lit_len;
+    if (!final_seq) {
+      dst[op++] = (u8)(offset & 0xff);
+      dst[op++] = (u8)(offset >> 8);
+      if (ml >= 15) op += (i64)varint_put(dst + op, (u64)(ml - 15));
+    }
+    return true;
+  };
+
+  while (ip <= mflimit) {
+    u32 h = flz_hash(read32(src + ip));
+    i64 cand = table[h];
+    table[h] = ip;
+    if (cand >= 0 && ip - cand <= 0xffff && read32(src + cand) == read32(src + ip)) {
+      // extend match
+      i64 ml = FLZ_MIN_MATCH;
+      while (ip + ml < n && src[cand + ml] == src[ip + ml]) ml++;
+      if (!emit(ip - anchor, ml, ip - cand, false)) return -1;
+      // index interior positions sparsely for better ratio on long matches
+      for (i64 p = ip + 1; p + 4 <= ip + ml && p <= mflimit; p += 3)
+        table[flz_hash(read32(src + p))] = p;
+      ip += ml;
+      anchor = ip;
+    } else {
+      ip++;
+    }
+  }
+  if (!emit(n - anchor, 0, 0, true)) return -1;
+  return op;
+}
+
+// Decompress into dst of exactly orig_n bytes. Returns orig_n, or -1 on
+// malformed input.
+API i64 fn_lz_decompress(const u8* src, i64 n, u8* dst, i64 orig_n) {
+  const u8* end = src + n;
+  i64 ip = 0, op = 0;
+  while (ip < n) {
+    u8 token = src[ip++];
+    i64 lit = token >> 4;
+    if (lit == 15) {
+      u64 ext; size_t c = varint_get(src + ip, end, &ext);
+      if (!c) return -1;
+      ip += (i64)c; lit = 15 + (i64)ext;
+    }
+    if (ip + lit > n || op + lit > orig_n) return -1;
+    memcpy(dst + op, src + ip, (size_t)lit);
+    ip += lit; op += lit;
+    if (ip >= n) break;  // final literals-only sequence
+    if (ip + 2 > n) return -1;
+    i64 offset = src[ip] | ((i64)src[ip + 1] << 8);
+    ip += 2;
+    i64 ml = (token & 0x0f);
+    if (ml == 15) {
+      u64 ext; size_t c = varint_get(src + ip, end, &ext);
+      if (!c) return -1;
+      ip += (i64)c; ml = 15 + (i64)ext;
+    }
+    ml += FLZ_MIN_MATCH;
+    if (offset == 0 || offset > op || op + ml > orig_n) return -1;
+    // byte-wise copy: overlapping matches are the RLE case and must copy fwd
+    for (i64 k = 0; k < ml; k++) dst[op + k] = dst[op + k - offset];
+    op += ml;
+  }
+  return op == orig_n ? orig_n : -1;
+}
+
+// ---------------------------------------------------------------------------
+// CRC32 (IEEE, table-driven) — checkpoint/log record integrity
+// ---------------------------------------------------------------------------
+
+static u32 crc_table[256];
+static std::once_flag crc_once;
+
+static void crc_init() {
+  for (u32 i = 0; i < 256; i++) {
+    u32 c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xedb88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+}
+
+API u32 fn_crc32(const u8* data, i64 n, u32 seed) {
+  std::call_once(crc_once, crc_init);
+  u32 c = seed ^ 0xffffffffu;
+  for (i64 i = 0; i < n; i++) c = crc_table[(c ^ data[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+// ---------------------------------------------------------------------------
+// SpillStore — memory-budgeted KV tier with append-only disk log
+// (RocksDB-analog behind the keyed-state spill interface)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Entry {
+  std::string val;   // when resident
+  bool in_mem;
+  i64 off;           // log offset of the record's value payload (when spilled)
+  u32 len;           // value length
+};
+
+struct SpillStore {
+  std::string dir;
+  i64 mem_budget;
+  i64 mem_used = 0;       // resident value bytes
+  i64 log_end = 0;        // append position
+  i64 log_garbage = 0;    // dead value bytes in log
+  FILE* log = nullptr;
+  std::unordered_map<std::string, Entry> map;
+  std::mutex mu;
+  // insertion clock for eviction (approx-LRU: evict oldest-written first)
+  std::vector<std::string> write_order;
+  size_t evict_cursor = 0;
+
+  std::string log_path() const { return dir + "/spill.log"; }
+  std::string manifest_path() const { return dir + "/manifest.fsp"; }
+};
+
+// log record: [crc u32][klen u32][vlen u32][key][value]
+static bool log_append(SpillStore* s, const std::string& key,
+                       const std::string& val, i64* val_off) {
+  u32 klen = (u32)key.size(), vlen = (u32)val.size();
+  u32 crc = fn_crc32((const u8*)key.data(), klen, 0);
+  crc = fn_crc32((const u8*)val.data(), vlen, crc);
+  if (fseeko(s->log, s->log_end, SEEK_SET) != 0) return false;
+  if (fwrite(&crc, 4, 1, s->log) != 1) return false;
+  if (fwrite(&klen, 4, 1, s->log) != 1) return false;
+  if (fwrite(&vlen, 4, 1, s->log) != 1) return false;
+  if (klen && fwrite(key.data(), 1, klen, s->log) != klen) return false;
+  if (vlen && fwrite(val.data(), 1, vlen, s->log) != vlen) return false;
+  *val_off = s->log_end + 12 + klen;
+  s->log_end += 12 + klen + vlen;
+  return true;
+}
+
+// Read a spilled value and verify the record CRC (record layout puts the crc
+// at off - 12 - klen; the crc covers key bytes then value bytes).
+static bool log_read(SpillStore* s, i64 off, u32 len, const std::string& key,
+                     std::string* out) {
+  out->resize(len);
+  fflush(s->log);
+  FILE* f = fopen(s->log_path().c_str(), "rb");
+  if (!f) return false;
+  i64 rec_start = off - 12 - (i64)key.size();
+  u32 stored_crc = 0;
+  bool ok = rec_start >= 0 && fseeko(f, rec_start, SEEK_SET) == 0 &&
+            fread(&stored_crc, 4, 1, f) == 1 &&
+            fseeko(f, off, SEEK_SET) == 0 &&
+            (len == 0 || fread(&(*out)[0], 1, len, f) == len);
+  fclose(f);
+  if (!ok) return false;
+  u32 crc = fn_crc32((const u8*)key.data(), (i64)key.size(), 0);
+  crc = fn_crc32((const u8*)out->data(), len, crc);
+  return crc == stored_crc;
+}
+
+static void maybe_evict(SpillStore* s) {
+  while (s->mem_used > s->mem_budget) {
+    if (s->evict_cursor >= s->write_order.size()) {
+      // Updated keys re-enter residency without re-entering write_order, so
+      // one pass is not enough: rebuild the queue from currently-resident
+      // keys. Empty rebuild == nothing evictable -> stop.
+      s->write_order.clear();
+      for (auto& kv : s->map)
+        if (kv.second.in_mem) s->write_order.push_back(kv.first);
+      s->evict_cursor = 0;
+      if (s->write_order.empty()) return;
+    }
+    const std::string& k = s->write_order[s->evict_cursor++];
+    auto it = s->map.find(k);
+    if (it == s->map.end() || !it->second.in_mem) continue;
+    i64 off;
+    if (!log_append(s, k, it->second.val, &off)) return;
+    s->mem_used -= (i64)it->second.val.size();
+    it->second.in_mem = false;
+    it->second.off = off;
+    it->second.len = (u32)it->second.val.size();
+    it->second.val.clear();
+    it->second.val.shrink_to_fit();
+  }
+}
+
+}  // namespace
+
+API void* spill_open(const char* dir, i64 mem_budget) {
+  auto* s = new SpillStore();
+  s->dir = dir;
+  s->mem_budget = mem_budget;
+  mkdir(dir, 0755);
+  // load manifest if present (reopen after flush)
+  FILE* mf = fopen(s->manifest_path().c_str(), "rb");
+  if (mf) {
+    char magic[4];
+    u64 n = 0;
+    if (fread(magic, 1, 4, mf) == 4 && memcmp(magic, "FSP1", 4) == 0 &&
+        fread(&n, 8, 1, mf) == 1) {
+      for (u64 i = 0; i < n; i++) {
+        u32 klen; u8 flag;
+        if (fread(&klen, 4, 1, mf) != 1 || fread(&flag, 1, 1, mf) != 1) break;
+        std::string key(klen, '\0');
+        if (klen && fread(&key[0], 1, klen, mf) != klen) break;
+        Entry e;
+        if (flag) {  // resident in manifest
+          u32 vlen;
+          if (fread(&vlen, 4, 1, mf) != 1) break;
+          e.val.resize(vlen);
+          if (vlen && fread(&e.val[0], 1, vlen, mf) != vlen) break;
+          e.in_mem = true; e.off = 0; e.len = vlen;
+          s->mem_used += vlen;
+        } else {
+          i64 off; u32 vlen;
+          if (fread(&off, 8, 1, mf) != 1 || fread(&vlen, 4, 1, mf) != 1) break;
+          e.in_mem = false; e.off = off; e.len = vlen;
+        }
+        s->write_order.push_back(key);
+        s->map.emplace(std::move(key), std::move(e));
+      }
+    }
+    fclose(mf);
+  }
+  s->log = fopen(s->log_path().c_str(), "ab+");
+  if (!s->log) { delete s; return nullptr; }
+  fseeko(s->log, 0, SEEK_END);
+  s->log_end = ftello(s->log);
+  return s;
+}
+
+API int spill_put(void* h, const u8* key, i64 klen, const u8* val, i64 vlen) {
+  auto* s = (SpillStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k((const char*)key, (size_t)klen);
+  auto it = s->map.find(k);
+  if (it != s->map.end()) {
+    if (it->second.in_mem) s->mem_used -= (i64)it->second.val.size();
+    else s->log_garbage += it->second.len;
+    it->second.val.assign((const char*)val, (size_t)vlen);
+    it->second.in_mem = true;
+    it->second.len = (u32)vlen;
+  } else {
+    Entry e;
+    e.val.assign((const char*)val, (size_t)vlen);
+    e.in_mem = true; e.off = 0; e.len = (u32)vlen;
+    s->map.emplace(k, std::move(e));
+    s->write_order.push_back(k);
+  }
+  s->mem_used += vlen;
+  maybe_evict(s);
+  return 0;
+}
+
+// Returns value length (copy into out up to cap), or -1 if absent, -2 on IO
+// error. Call with cap=0 to size-probe.
+API i64 spill_get(void* h, const u8* key, i64 klen, u8* out, i64 cap) {
+  auto* s = (SpillStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k((const char*)key, (size_t)klen);
+  auto it = s->map.find(k);
+  if (it == s->map.end()) return -1;
+  if (it->second.in_mem) {
+    i64 n = (i64)it->second.val.size();
+    if (out && cap >= n) memcpy(out, it->second.val.data(), (size_t)n);
+    return n;
+  }
+  if (out == nullptr || cap < (i64)it->second.len) return it->second.len;
+  std::string v;
+  if (!log_read(s, it->second.off, it->second.len, k, &v)) return -2;
+  memcpy(out, v.data(), v.size());
+  return (i64)v.size();
+}
+
+API int spill_delete(void* h, const u8* key, i64 klen) {
+  auto* s = (SpillStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k((const char*)key, (size_t)klen);
+  auto it = s->map.find(k);
+  if (it == s->map.end()) return 0;
+  if (it->second.in_mem) s->mem_used -= (i64)it->second.val.size();
+  else s->log_garbage += it->second.len;
+  s->map.erase(it);
+  return 1;
+}
+
+API i64 spill_count(void* h) {
+  auto* s = (SpillStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  return (i64)s->map.size();
+}
+
+API i64 spill_mem_used(void* h) { return ((SpillStore*)h)->mem_used; }
+API i64 spill_log_bytes(void* h) { return ((SpillStore*)h)->log_end; }
+API i64 spill_log_garbage(void* h) { return ((SpillStore*)h)->log_garbage; }
+
+// Iteration: caller passes cursor index; returns key length and fills key
+// buffer. Cursor walks the hash map snapshot taken at iter_begin.
+struct SpillIter {
+  std::vector<std::string> keys;
+  size_t pos = 0;
+};
+
+API void* spill_iter_begin(void* h) {
+  auto* s = (SpillStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  auto* it = new SpillIter();
+  it->keys.reserve(s->map.size());
+  for (auto& kv : s->map) it->keys.push_back(kv.first);
+  return it;
+}
+
+API i64 spill_iter_next(void* hi, u8* key_out, i64 cap) {
+  auto* it = (SpillIter*)hi;
+  if (it->pos >= it->keys.size()) return -1;
+  const std::string& k = it->keys[it->pos];
+  if ((i64)k.size() > cap) return (i64)k.size();  // probe: not advanced
+  memcpy(key_out, k.data(), k.size());
+  it->pos++;
+  return (i64)k.size();
+}
+
+API void spill_iter_end(void* hi) { delete (SpillIter*)hi; }
+
+// Durably persist: fsync log + write manifest atomically. The manifest holds
+// resident values inline and spilled values as (off, len) into the log.
+// Caller must hold s->mu.
+static int flush_locked(SpillStore* s) {
+  fflush(s->log);
+  fsync(fileno(s->log));
+  std::string tmp = s->manifest_path() + ".tmp";
+  FILE* mf = fopen(tmp.c_str(), "wb");
+  if (!mf) return -1;
+  u64 n = s->map.size();
+  fwrite("FSP1", 1, 4, mf);
+  fwrite(&n, 8, 1, mf);
+  for (auto& kv : s->map) {
+    u32 klen = (u32)kv.first.size();
+    u8 flag = kv.second.in_mem ? 1 : 0;
+    fwrite(&klen, 4, 1, mf);
+    fwrite(&flag, 1, 1, mf);
+    fwrite(kv.first.data(), 1, klen, mf);
+    if (flag) {
+      u32 vlen = (u32)kv.second.val.size();
+      fwrite(&vlen, 4, 1, mf);
+      fwrite(kv.second.val.data(), 1, vlen, mf);
+    } else {
+      fwrite(&kv.second.off, 8, 1, mf);
+      fwrite(&kv.second.len, 4, 1, mf);
+    }
+  }
+  fflush(mf);
+  fsync(fileno(mf));
+  fclose(mf);
+  return rename(tmp.c_str(), s->manifest_path().c_str());
+}
+
+API int spill_flush(void* h) {
+  auto* s = (SpillStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  return flush_locked(s);
+}
+
+// Rewrite the log keeping only live spilled values (incremental-checkpoint
+// hygiene, the RocksDB-compaction analog). Returns reclaimed bytes.
+API i64 spill_compact(void* h) {
+  auto* s = (SpillStore*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string tmp = s->log_path() + ".tmp";
+  FILE* nf = fopen(tmp.c_str(), "wb");
+  if (!nf) return -1;
+  i64 old_end = s->log_end;
+  fflush(s->log);
+  i64 new_end = 0;
+  bool ok = true;
+  // collect new offsets first; commit them only after the rename succeeds
+  std::vector<std::pair<Entry*, i64>> new_offs;
+  for (auto& kv : s->map) {
+    if (kv.second.in_mem) continue;
+    std::string v;
+    if (!log_read(s, kv.second.off, kv.second.len, kv.first, &v)) {
+      ok = false;
+      break;
+    }
+    u32 klen = (u32)kv.first.size(), vlen = (u32)v.size();
+    u32 crc = fn_crc32((const u8*)kv.first.data(), klen, 0);
+    crc = fn_crc32((const u8*)v.data(), vlen, crc);
+    fwrite(&crc, 4, 1, nf);
+    fwrite(&klen, 4, 1, nf);
+    fwrite(&vlen, 4, 1, nf);
+    fwrite(kv.first.data(), 1, klen, nf);
+    fwrite(v.data(), 1, vlen, nf);
+    new_offs.emplace_back(&kv.second, new_end + 12 + klen);
+    new_end += 12 + klen + vlen;
+  }
+  fflush(nf);
+  fclose(nf);
+  if (!ok) { remove(tmp.c_str()); return -1; }
+  fclose(s->log);
+  s->log = nullptr;
+  if (rename(tmp.c_str(), s->log_path().c_str()) != 0) {
+    // old log file is still in place and offsets unchanged: reopen and bail
+    s->log = fopen(s->log_path().c_str(), "ab+");
+    if (s->log) fseeko(s->log, 0, SEEK_END);
+    remove(tmp.c_str());
+    return -1;
+  }
+  for (auto& [entry, off] : new_offs) entry->off = off;
+  s->log = fopen(s->log_path().c_str(), "ab+");
+  fseeko(s->log, 0, SEEK_END);
+  s->log_end = new_end;
+  s->log_garbage = 0;
+  // eviction bookkeeping restarts over current keys
+  s->write_order.clear();
+  for (auto& kv : s->map)
+    if (kv.second.in_mem) s->write_order.push_back(kv.first);
+  s->evict_cursor = 0;
+  // the on-disk manifest (if any) points at pre-compaction offsets — rewrite
+  // it, or a reopen after crash would read wrong values from the new log
+  if (access(s->manifest_path().c_str(), F_OK) == 0) flush_locked(s);
+  return old_end - new_end;
+}
+
+API void spill_close(void* h) {
+  auto* s = (SpillStore*)h;
+  if (s->log) fclose(s->log);
+  delete s;
+}
+
+// ---------------------------------------------------------------------------
+// SPSC byte ring buffer — host infeed path (Netty buffer-pool analog)
+// ---------------------------------------------------------------------------
+
+namespace {
+struct Ring {
+  std::vector<u8> buf;
+  std::atomic<u64> head{0};  // producer position (bytes written)
+  std::atomic<u64> tail{0};  // consumer position (bytes read)
+  u64 cap;
+};
+
+static void ring_copy_in(Ring* r, u64 pos, const u8* src, u64 n) {
+  u64 off = pos % r->cap;
+  u64 first = std::min(n, r->cap - off);
+  memcpy(r->buf.data() + off, src, first);
+  if (n > first) memcpy(r->buf.data(), src + first, n - first);
+}
+
+static void ring_copy_out(Ring* r, u64 pos, u8* dst, u64 n) {
+  u64 off = pos % r->cap;
+  u64 first = std::min(n, r->cap - off);
+  memcpy(dst, r->buf.data() + off, first);
+  if (n > first) memcpy(dst + first, r->buf.data(), n - first);
+}
+}  // namespace
+
+API void* ring_create(i64 capacity) {
+  auto* r = new Ring();
+  r->cap = (u64)capacity;
+  r->buf.resize(r->cap);
+  return r;
+}
+
+API i64 ring_free_space(void* h) {
+  auto* r = (Ring*)h;
+  return (i64)(r->cap - (r->head.load(std::memory_order_acquire) -
+                         r->tail.load(std::memory_order_acquire)));
+}
+
+// Push one length-prefixed message. Returns 1 on success, 0 if no room.
+API int ring_push(void* h, const u8* data, i64 n) {
+  auto* r = (Ring*)h;
+  u64 need = (u64)n + 4;
+  u64 head = r->head.load(std::memory_order_relaxed);
+  u64 tail = r->tail.load(std::memory_order_acquire);
+  if (r->cap - (head - tail) < need) return 0;
+  u32 len = (u32)n;
+  ring_copy_in(r, head, (const u8*)&len, 4);
+  ring_copy_in(r, head + 4, data, (u64)n);
+  r->head.store(head + need, std::memory_order_release);
+  return 1;
+}
+
+// Pop one message into out (cap bytes). Returns message length, -1 if empty,
+// or required length if cap too small (message left in place).
+API i64 ring_pop(void* h, u8* out, i64 cap) {
+  auto* r = (Ring*)h;
+  u64 tail = r->tail.load(std::memory_order_relaxed);
+  u64 head = r->head.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  u32 len;
+  ring_copy_out(r, tail, (u8*)&len, 4);
+  if ((i64)len > cap) return (i64)len;
+  ring_copy_out(r, tail + 4, out, len);
+  r->tail.store(tail + 4 + len, std::memory_order_release);
+  return (i64)len;
+}
+
+API void ring_destroy(void* h) { delete (Ring*)h; }

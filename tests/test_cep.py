@@ -123,3 +123,27 @@ def test_followed_by_any():
             {"k": 0, "t": "b", "ts": 3}]
     out = run_pattern(pat, rows, lambda m: {"bt": m["b"][0]["ts"]})
     assert sorted(r["bt"] for r in out) == [2, 3]
+
+
+def test_cep_rows_pruned_no_unbounded_growth():
+    """Regression: the operator must not retain every event row forever
+    (SharedBuffer pruning analog) — checkpoints would grow without bound."""
+    import numpy as np
+    from flink_tpu.cep.operator import CepOperator
+    from flink_tpu.cep.pattern import Pattern
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    pat = (Pattern.begin("a").where(lambda c: np.asarray(c["v"]) == 1)
+           .next("b").where(lambda c: np.asarray(c["v"]) == 2))
+    op = CepOperator(pat, key_column="k", select_fn=lambda m: {"ok": 1})
+    n = 500
+    for lo in range(0, n, 50):
+        v = np.zeros(50, np.int64) + 7   # never matches any stage
+        b = RecordBatch({"k": np.zeros(50, np.int64), "v": v},
+                        timestamps=np.arange(lo, lo + 50, dtype=np.int64))
+        op.process_batch(b)
+        op.process_watermark(Watermark(lo + 49))
+    total_rows = sum(len(nfa._rows) for nfa in op._nfas.values())
+    assert total_rows == 0, f"rows retained: {total_rows}"
+    snap = op.snapshot_state()
+    assert sum(len(r) for _, _, r in snap["nfas"].values()) == 0
