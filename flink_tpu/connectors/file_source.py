@@ -1,0 +1,207 @@
+"""File system connector: split-based source + two-phase-commit sink.
+
+Source side is the FLIP-27 file source analog
+(``flink-connectors/flink-connector-files``: ``FileSource`` +
+``SplitEnumerator`` over file splits): one split per matched file, readers
+track a **row position** so checkpoints capture exact resume points — the
+executor snapshots ``reader.position`` per split and hands it back to
+``open_split`` on restore (``SourceReader.snapshotState`` analog).
+
+Sink side is the ``StreamingFileSink``/``FileSink`` two-phase commit:
+records append to an in-progress part file; ``snapshot_state`` rolls it into
+the *pending* set (pre-commit); ``notify_checkpoint_complete`` atomically
+renames pending parts to their final names (commit).  A restore re-commits
+pending parts from the snapshot and discards orphaned in-progress files —
+exactly-once file output.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from flink_tpu.connectors.sources import Source, SourceSplit
+from flink_tpu.core.batch import RecordBatch, StreamElement
+from flink_tpu.formats import reader_for, writer_for
+
+
+class _PositionedFileReader:
+    """Iterator over one file's batches; ``position`` = rows already emitted
+    (checkpointable, consumed by ``open_split`` on restore)."""
+
+    def __init__(self, source: "FileSource", path: str, start_row: int):
+        self.position = int(start_row)
+        self._it = source._read_file(path, start_row)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> StreamElement:
+        el = next(self._it)
+        if isinstance(el, RecordBatch):
+            self.position += len(el)
+        return el
+
+
+class FileSource(Source):
+    """Reads a file, directory, or glob in ``csv``/``jsonl``/``ftb`` format.
+    One split per file (``FileSourceSplit`` analog)."""
+
+    def __init__(self, path: str, format: str = "csv",
+                 timestamp_column: Optional[str] = None,
+                 batch_size: int = 8192, **format_kwargs):
+        self.path = path
+        self.format = format
+        self.timestamp_column = timestamp_column
+        self.batch_size = batch_size
+        self.format_kwargs = format_kwargs
+        reader_for(format)  # validate eagerly
+
+    def _files(self) -> List[str]:
+        if os.path.isdir(self.path):
+            fs = [os.path.join(self.path, f) for f in sorted(os.listdir(self.path))
+                  if not f.startswith((".", "_"))]
+        else:
+            fs = sorted(_glob.glob(self.path)) or [self.path]
+        files = [f for f in fs if os.path.isfile(f)]
+        if not files and not os.path.isdir(self.path):
+            # a typo'd path must fail loudly, not run an empty job to success
+            raise FileNotFoundError(
+                f"FileSource: no files match {self.path!r}")
+        return files
+
+    def create_splits(self, parallelism: int) -> List[SourceSplit]:
+        files = self._files()
+        return [FileSplit(self, i, len(files), path=f) for i, f in enumerate(files)]
+
+    def _read_file(self, path: str, start_row: int) -> Iterator[StreamElement]:
+        read = reader_for(self.format)
+        kw = dict(self.format_kwargs)
+        if self.format in ("csv", "jsonl"):
+            kw.setdefault("batch_size", self.batch_size)
+            kw["timestamp_column"] = self.timestamp_column
+            kw["skip_rows"] = start_row
+            yield from read(path, **kw)
+        else:  # ftb: frame-level skip by rows
+            skipped = 0
+            for b in read(path, **kw):
+                if skipped + len(b) <= start_row:
+                    skipped += len(b)
+                    continue
+                if skipped < start_row:  # partial batch resume
+                    b = b.take(np.arange(start_row - skipped, len(b)))
+                    skipped = start_row
+                yield b
+
+    # stateful-reader protocol (used by the executor; falls back to
+    # ``split.read()`` for sources that don't implement it)
+    def open_split(self, split: "FileSplit",
+                   position: Optional[int]) -> _PositionedFileReader:
+        return _PositionedFileReader(self, split.path, position or 0)
+
+
+@dataclass
+class FileSplit(SourceSplit):
+    path: str = ""
+
+    @property
+    def split_id(self) -> str:
+        return self.path
+
+    def read(self) -> Iterator[StreamElement]:
+        return self.source.open_split(self, 0)
+
+
+class FileSink:
+    """Two-phase-commit file sink (``FileSink`` analog). Part file lifecycle:
+    ``.inprogress`` → (snapshot) ``.pending-{n}`` → (notify complete) final."""
+
+    def __init__(self, directory: str, format: str = "csv",
+                 rolling_records: int = 1 << 20, prefix: str = "part"):
+        import uuid
+
+        self.directory = directory
+        self.format = format
+        self.rolling_records = rolling_records
+        self.prefix = prefix
+        #: unique per sink attempt, so a restarted job never collides with an
+        #: orphaned part file of a previous attempt (reference part files
+        #: carry subtask + bucket uid for the same reason)
+        self._attempt = uuid.uuid4().hex[:8]
+        self._buf: List[RecordBatch] = []
+        self._buf_rows = 0
+        self._counter = 0
+        self._pending: List[str] = []   # rolled, awaiting checkpoint-complete
+        writer_for(format)
+        os.makedirs(directory, exist_ok=True)
+
+    # -- Sink interface ------------------------------------------------------
+    def write_batch(self, batch: RecordBatch) -> None:
+        if len(batch) == 0:
+            return
+        self._buf.append(batch)
+        self._buf_rows += len(batch)
+        if self._buf_rows >= self.rolling_records:
+            self._roll()
+
+    def _part_name(self, n: int) -> str:
+        return os.path.join(self.directory,
+                            f"{self.prefix}-{self._attempt}-{n:05d}.{self.format}")
+
+    def _roll(self) -> None:
+        """Write the buffer to a pending part file (pre-commit)."""
+        if not self._buf:
+            return
+        pending = self._part_name(self._counter) + f".pending"
+        writer_for(self.format)(self._buf, pending)
+        self._pending.append(pending)
+        self._counter += 1
+        self._buf = []
+        self._buf_rows = 0
+
+    def flush(self) -> None:
+        # bounded end-of-input: roll and commit immediately (no more barriers)
+        self._roll()
+        self.commit_pending()
+
+    def close(self) -> None:
+        pass
+
+    # -- two-phase commit ----------------------------------------------------
+    def snapshot_state(self) -> Dict[str, Any]:
+        self._roll()
+        return {"pending": list(self._pending), "counter": self._counter}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._counter = int(snap.get("counter", 0))
+        # parts pending in a COMPLETED checkpoint belong to the output:
+        # re-commit them (rename is idempotent — missing file = already done)
+        self._pending = [p for p in snap.get("pending", [])
+                         if os.path.exists(p)]
+        self.commit_pending()
+        # orphaned pending files from a FAILED epoch are not in the snapshot:
+        # they must not leak into results. Scope to THIS sink's prefix —
+        # other sinks sharing the directory own their own pending parts.
+        for f in os.listdir(self.directory):
+            if f.endswith(".pending") and f.startswith(f"{self.prefix}-"):
+                os.remove(os.path.join(self.directory, f))
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        self.commit_pending()
+
+    def commit_pending(self) -> None:
+        for p in self._pending:
+            final = p[: -len(".pending")]
+            if os.path.exists(p):
+                os.replace(p, final)
+        self._pending = []
+
+    # -- inspection ----------------------------------------------------------
+    def committed_files(self) -> List[str]:
+        return sorted(os.path.join(self.directory, f)
+                      for f in os.listdir(self.directory)
+                      if not f.endswith(".pending") and f.startswith(self.prefix))

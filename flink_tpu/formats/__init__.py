@@ -1,0 +1,206 @@
+"""Record formats: CSV, JSON-lines, FTB binary.
+
+Analog of ``flink-formats/*`` (Avro/Parquet/ORC/CSV/JSON): encoders/decoders
+between files and columnar ``RecordBatch``es.  Columnar-first: a format reads
+a whole batch of rows into typed numpy columns (the batched-boundary pattern
+the TPU runtime needs), never record-at-a-time objects.
+
+FTB is the framework's own binary format (``flink_tpu/native/codec.py``):
+length-prefixed compressed column blocks — the Parquet-role format here.
+Parquet/ORC themselves need pyarrow, which is not in this environment; the
+reader raises a clear error if requested (pluggable seam kept).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.core.batch import RecordBatch
+
+
+def _coerce_columns(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    """Rows -> typed columns: try int64, then float64, else object.
+    Column set = union over all rows (sparse fields fill with None)."""
+    if not rows:
+        return {}
+    names: Dict[str, None] = {}
+    for r in rows:
+        for k in r:
+            names.setdefault(k)
+    cols: Dict[str, np.ndarray] = {}
+    for name in names:
+        vals = [r.get(name) for r in rows]
+        arr = None
+        for dtype in (np.int64, np.float64):
+            try:
+                arr = np.asarray(vals, dtype)
+                break
+            except (ValueError, TypeError, OverflowError):
+                continue
+        cols[name] = arr if arr is not None else np.asarray(vals, object)
+    return cols
+
+
+# ---------------------------------------------------------------------------
+# CSV
+# ---------------------------------------------------------------------------
+
+def read_csv(path: str, batch_size: int = 8192, delimiter: str = ",",
+             timestamp_column: Optional[str] = None,
+             skip_rows: int = 0) -> Iterator[RecordBatch]:
+    """CSV file -> RecordBatch iterator with type inference per batch.
+    ``skip_rows`` skips *data* rows (resume position), not the header."""
+    import csv as _csv
+
+    with open(path, newline="") as f:
+        reader = _csv.DictReader(f, delimiter=delimiter)
+        buf: List[Dict[str, Any]] = []
+        for i, row in enumerate(reader):
+            if i < skip_rows:
+                continue
+            buf.append(row)
+            if len(buf) >= batch_size:
+                yield _batch_from_rows(buf, timestamp_column)
+                buf = []
+        if buf:
+            yield _batch_from_rows(buf, timestamp_column)
+
+
+def write_csv(batches, path: str, delimiter: str = ",") -> int:
+    import csv as _csv
+
+    n = 0
+    writer = None
+    with open(path, "w", newline="") as f:
+        for b in batches:
+            for row in b.to_rows():
+                if writer is None:
+                    writer = _csv.DictWriter(f, fieldnames=list(row.keys()),
+                                             delimiter=delimiter)
+                    writer.writeheader()
+                writer.writerow(row)
+                n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# JSON lines
+# ---------------------------------------------------------------------------
+
+def read_jsonl(path: str, batch_size: int = 8192,
+               timestamp_column: Optional[str] = None,
+               skip_rows: int = 0) -> Iterator[RecordBatch]:
+    with open(path) as f:
+        buf: List[Dict[str, Any]] = []
+        data_row = 0  # skip_rows counts DATA rows (matches reader positions)
+        for line in f:
+            if not line.strip():
+                continue
+            data_row += 1
+            if data_row <= skip_rows:
+                continue
+            buf.append(json.loads(line))
+            if len(buf) >= batch_size:
+                yield _batch_from_rows(buf, timestamp_column)
+                buf = []
+        if buf:
+            yield _batch_from_rows(buf, timestamp_column)
+
+
+def write_jsonl(batches, path: str) -> int:
+    n = 0
+    with open(path, "w") as f:
+        for b in batches:
+            for row in b.to_rows():
+                f.write(json.dumps(row, default=_json_default) + "\n")
+                n += 1
+    return n
+
+
+def _json_default(o):
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+def _batch_from_rows(rows: List[Dict[str, Any]],
+                     timestamp_column: Optional[str]) -> RecordBatch:
+    cols = _coerce_columns(rows)
+    ts = (np.asarray(cols[timestamp_column], np.int64)
+          if timestamp_column and timestamp_column in cols else None)
+    return RecordBatch(cols, timestamps=ts)
+
+
+# ---------------------------------------------------------------------------
+# FTB binary (length-prefixed encoded RecordBatches; CRC-checked frames)
+# ---------------------------------------------------------------------------
+
+_FRAME = struct.Struct("<II")  # payload_len, crc32
+
+
+def write_ftb(batches, path: str, compress: bool = True,
+              append: bool = False) -> int:
+    from flink_tpu.native import crc32
+    from flink_tpu.native.codec import encode_batch
+
+    n = 0
+    with open(path, "ab" if append else "wb") as f:
+        for b in batches:
+            payload = encode_batch(b, compress=compress)
+            f.write(_FRAME.pack(len(payload), crc32(payload)))
+            f.write(payload)
+            n += len(b)
+    return n
+
+
+def read_ftb(path: str, skip_batches: int = 0,
+             start_offset: int = 0) -> Iterator[RecordBatch]:
+    from flink_tpu.native import crc32
+    from flink_tpu.native.codec import decode_batch
+
+    with open(path, "rb") as f:
+        if start_offset:
+            f.seek(start_offset)
+        i = 0
+        while True:
+            hdr = f.read(_FRAME.size)
+            if len(hdr) < _FRAME.size:
+                return
+            ln, crc = _FRAME.unpack(hdr)
+            payload = f.read(ln)
+            if len(payload) < ln:
+                return  # torn tail write: stop at last complete frame
+            if crc32(payload) != crc:
+                raise IOError(f"FTB frame CRC mismatch in {path} at batch {i}")
+            if i >= skip_batches:
+                yield decode_batch(payload)
+            i += 1
+
+
+FORMATS = {
+    "csv": (read_csv, write_csv),
+    "jsonl": (read_jsonl, write_jsonl),
+    "ftb": (read_ftb, write_ftb),
+}
+
+
+def reader_for(fmt: str):
+    if fmt in ("parquet", "orc", "avro"):
+        raise NotImplementedError(
+            f"{fmt} needs pyarrow/fastavro (not in this environment); "
+            f"use 'ftb' (binary), 'csv' or 'jsonl'")
+    if fmt not in FORMATS:
+        raise ValueError(f"unknown format {fmt!r}; have {sorted(FORMATS)}")
+    return FORMATS[fmt][0]
+
+
+def writer_for(fmt: str):
+    if fmt not in FORMATS:
+        raise ValueError(f"unknown format {fmt!r}; have {sorted(FORMATS)}")
+    return FORMATS[fmt][1]

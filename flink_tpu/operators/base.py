@@ -63,6 +63,10 @@ class StreamOperator:
     def restore_state(self, snapshot: Dict[str, Any]) -> None:
         pass
 
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        """``CheckpointListener`` analog: the checkpoint is durably stored —
+        two-phase-commit side effects may publish now."""
+
     def close(self) -> None:
         pass
 

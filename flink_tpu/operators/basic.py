@@ -282,6 +282,20 @@ class SinkOperator(StreamOperator):
             self.sink.flush()
         return []
 
+    # two-phase-commit sinks (FileSink/LogSink) hook the checkpoint lifecycle
+    def snapshot_state(self) -> Dict[str, Any]:
+        if hasattr(self.sink, "snapshot_state"):
+            return self.sink.snapshot_state()
+        return {}
+
+    def restore_state(self, snapshot: Dict[str, Any]) -> None:
+        if snapshot and hasattr(self.sink, "restore_state"):
+            self.sink.restore_state(snapshot)
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        if hasattr(self.sink, "notify_checkpoint_complete"):
+            self.sink.notify_checkpoint_complete(checkpoint_id)
+
     def close(self) -> None:
         if hasattr(self.sink, "close"):
             self.sink.close()

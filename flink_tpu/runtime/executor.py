@@ -175,12 +175,24 @@ class LocalExecutor:
         self.running = running
         source_vertices = [running[v.id] for v in plan.sources]
 
-        # split iterators, round-robin (SourceReaderBase poll loop analog)
+        # split readers, round-robin (SourceReaderBase poll loop analog);
+        # stateful sources (open_split + reader.position) resume from the
+        # checkpointed position — FLIP-27 SourceReader.snapshotState analog
+        restored_positions = (restore or {}).get("__sources__", {})
         readers: List[Tuple[RunningVertex, Any]] = []
+        self._split_readers: List[Tuple[str, str, Any]] = []  # (uid, split_id, reader)
         for rv in source_vertices:
             src = rv.vertex.chain[0].source
+            positions = restored_positions.get(rv.vertex.uid, {})
             for split in src.create_splits(rv.vertex.parallelism):
-                readers.append((rv, split.read()))
+                split_id = getattr(split, "split_id", None) or \
+                    f"{split.index}/{split.of}"
+                if hasattr(src, "open_split"):
+                    reader = src.open_split(split, positions.get(split_id))
+                else:
+                    reader = split.read()
+                readers.append((rv, reader))
+                self._split_readers.append((rv.vertex.uid, split_id, reader))
 
         last_checkpoint = time.monotonic()
         ckpt_id = 0
@@ -260,6 +272,17 @@ class LocalExecutor:
         vertices at this point — alignment is implicit)."""
         snapshot = {rv.vertex.uid: rv.operator.snapshot_state()
                     for rv in self.running.values()}
+        sources: Dict[str, Dict[str, Any]] = {}
+        for uid, split_id, reader in getattr(self, "_split_readers", []):
+            pos = getattr(reader, "position", None)
+            if pos is not None:
+                sources.setdefault(uid, {})[split_id] = pos
+        if sources:
+            snapshot["__sources__"] = sources
         if self.checkpoint_storage is not None:
             self.checkpoint_storage.store(checkpoint_id, snapshot)
+            # checkpoint durable -> commit side effects (CheckpointListener.
+            # notifyCheckpointComplete: two-phase-commit sinks publish here)
+            for rv in self.running.values():
+                rv.operator.notify_checkpoint_complete(checkpoint_id)
         return snapshot
