@@ -78,10 +78,12 @@ class OutputDispatcher:
     (``RecordWriter`` + ``StreamPartitioner`` analog)."""
 
     def __init__(self, partitioning: str, channels: Sequence[LocalChannel],
-                 max_parallelism: int = 128, subtask_index: int = 0):
+                 max_parallelism: int = 128, subtask_index: int = 0,
+                 key_column: Optional[str] = None):
         self.partitioning = partitioning
         self.channels = list(channels)
         self.max_parallelism = max_parallelism
+        self.key_column = key_column  # hash edges key on this column
         self._rr = subtask_index  # stagger round-robin starts across producers
 
     def emit(self, el: StreamElement) -> None:
@@ -110,6 +112,13 @@ class OutputDispatcher:
 
     def _emit_hash(self, batch: RecordBatch) -> None:
         kg = batch.key_groups
+        if kg is None and self.key_column is not None:
+            # the keying operator lives at the consumer chain head; the
+            # producer-side partitioner derives key groups from the key
+            # column itself (KeyGroupStreamPartitioner's key selector)
+            keys = np.asarray(batch.column(self.key_column))
+            kg = keygroups.assign_to_key_group(keygroups.hash_keys(keys),
+                                               self.max_parallelism)
         if kg is None:
             raise ValueError("hash edge requires key_groups on the batch "
                              "(key_by upstream)")

@@ -1,0 +1,282 @@
+"""MiniCluster: multi-subtask parallel job execution in one process.
+
+Analog of the reference's ``MiniCluster.java`` (Dispatcher + JobMaster +
+TaskExecutors in one JVM with real RPC/network/checkpointing): deploys an
+``ExecutionPlan`` with REAL parallelism — one thread per subtask, bounded
+channels between them (credit-style backpressure), hash/rebalance/broadcast
+partitioners on the edges — plus a **CheckpointCoordinator**
+(``CheckpointCoordinator.java:96``): periodic triggers to source subtasks,
+in-band barriers (aligned or unaligned), ack collection, completed-checkpoint
+store and ``notifyCheckpointComplete`` fan-out, and failure recovery by
+restarting the job from the latest completed checkpoint
+(restart-strategy analog, full-restart region).
+
+Checkpoint layout: ``{uid: {"subtasks": [per-subtask snapshot, ...]}}`` plus
+``__job__`` metadata.  On restore with the same parallelism each subtask gets
+its own snapshot back; sources replay from their recorded offsets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
+from flink_tpu.cluster.task import (SourceSubtask, Subtask, SubtaskBase,
+                                    TaskListener, TaskStates)
+from flink_tpu.core.functions import RuntimeContext
+from flink_tpu.graph.stream_graph import ExecutionPlan, PlanVertex
+
+
+@dataclass
+class _PendingCheckpoint:
+    checkpoint_id: int
+    expected: int
+    started_at: float
+    acks: Dict[Tuple[str, int], Dict[str, Any]] = field(default_factory=dict)
+
+
+@dataclass
+class JobResult:
+    job_name: str
+    state: str                      # FINISHED / FAILED / CANCELED
+    net_runtime_ms: float
+    restarts: int = 0
+    completed_checkpoints: List[int] = field(default_factory=list)
+    error: Optional[str] = None
+
+
+class MiniCluster(TaskListener):
+    def __init__(self, checkpoint_storage=None, checkpoint_interval_ms: int = 0,
+                 unaligned: bool = False, checkpoint_timeout_s: float = 60.0,
+                 restart_attempts: int = 0, restart_delay_ms: int = 50,
+                 channel_capacity: int = 32):
+        self.checkpoint_storage = checkpoint_storage
+        self.checkpoint_interval_ms = checkpoint_interval_ms
+        self.unaligned = unaligned
+        self.checkpoint_timeout_s = checkpoint_timeout_s
+        self.restart_attempts = restart_attempts
+        self.restart_delay_ms = restart_delay_ms
+        self.channel_capacity = channel_capacity
+        self._lock = threading.Lock()
+        self._tasks: List[SubtaskBase] = []
+        self._pending: Optional[_PendingCheckpoint] = None
+        self._completed_ids: List[int] = []
+        self._next_checkpoint_id = 1
+        self._failed: Optional[str] = None
+        self._stop_requested = False
+
+    # ------------------------------------------------------------ listener
+    def task_state_changed(self, vertex_uid: str, subtask_index: int,
+                           state: str, error: Optional[str]) -> None:
+        if state == TaskStates.FAILED:
+            with self._lock:
+                if self._failed is None:
+                    self._failed = f"{vertex_uid}[{subtask_index}]: {error}"
+        elif state == TaskStates.FINISHED:
+            with self._lock:
+                self._finished.add((vertex_uid, subtask_index))
+                # a task finishing mid-alignment will never ack: shrink the
+                # expectation so the checkpoint can still complete
+                p = self._pending
+                if p is not None and (vertex_uid, subtask_index) not in p.acks:
+                    p.expected -= 1
+                    if len(p.acks) >= p.expected:
+                        self._complete_checkpoint(p)
+                        self._pending = None
+
+    def acknowledge_checkpoint(self, checkpoint_id: int, vertex_uid: str,
+                               subtask_index: int,
+                               snapshot: Dict[str, Any]) -> None:
+        with self._lock:
+            p = self._pending
+            if p is None or p.checkpoint_id != checkpoint_id:
+                return  # late ack for an aborted checkpoint: decline
+            p.acks[(vertex_uid, subtask_index)] = snapshot
+            if len(p.acks) >= p.expected:
+                self._complete_checkpoint(p)
+                self._pending = None
+
+    def _complete_checkpoint(self, p: _PendingCheckpoint) -> None:
+        assembled: Dict[str, Any] = {"__job__": {
+            "checkpoint_id": p.checkpoint_id,
+            "parallelism": {uid: n for uid, n in self._subtask_counts.items()},
+        }}
+        for (uid, idx), snap in p.acks.items():
+            entry = assembled.setdefault(
+                uid, {"subtasks": [None] * self._subtask_counts[uid]})
+            entry["subtasks"][idx] = snap
+        if self.checkpoint_storage is not None:
+            self.checkpoint_storage.store(p.checkpoint_id, assembled)
+        self._completed_ids.append(p.checkpoint_id)
+        self._latest_snapshot = assembled
+        for t in self._tasks:
+            t.commands.put(("notify_complete", p.checkpoint_id))
+
+    # ------------------------------------------------------------ deploy
+    def _deploy(self, plan: ExecutionPlan,
+                restore: Optional[Dict[str, Any]]) -> None:
+        self._tasks = []
+        self._failed = None
+        self._pending = None
+        self._finished = set()
+        source_tasks: List[SourceSubtask] = []
+        subtask_counts: Dict[str, int] = {}
+        # source parallelism = split count (one SourceSubtask per split)
+        splits_by_vertex: Dict[int, list] = {}
+        for v in plan.vertices:
+            if v.is_source:
+                src = v.chain[0].source
+                splits = src.create_splits(v.parallelism)
+                splits_by_vertex[v.id] = splits
+                subtask_counts[v.uid] = max(1, len(splits))
+            else:
+                subtask_counts[v.uid] = v.parallelism
+        self._subtask_counts = subtask_counts
+
+        def n_subs(v: PlanVertex) -> int:
+            return subtask_counts[v.uid]
+
+        # channels per edge: producer subtask x consumer subtask
+        inputs: Dict[int, List[List[LocalChannel]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+        outputs: Dict[int, List[List[OutputDispatcher]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+        for v in plan.vertices:
+            for e in v.out_edges:
+                tgt = plan.by_id[e.target_id]
+                np_, nc = n_subs(v), n_subs(tgt)
+                for pi in range(np_):
+                    chans = [LocalChannel(self.channel_capacity,
+                                          name=f"{v.name}[{pi}]->{tgt.name}[{ci}]")
+                             for ci in range(nc)]
+                    for ci, ch in enumerate(chans):
+                        inputs[tgt.id][ci].append(ch)
+                    part = e.partitioning
+                    # forward edges with fan-out degrade to round-robin
+                    if part == "forward" and nc > 1:
+                        part = "rebalance"
+                    outputs[v.id][pi].append(OutputDispatcher(
+                        part, chans, max_parallelism=v.max_parallelism,
+                        subtask_index=pi, key_column=e.key_column))
+
+        restore = restore or {}
+        for v in plan.vertices:
+            uid = v.uid
+            vr = restore.get(uid, {})
+            sub_snaps = vr.get("subtasks", [])
+            if v.is_source:
+                splits = splits_by_vertex[v.id]
+                for i, split in enumerate(splits):
+                    ctx = RuntimeContext(task_name=v.name, subtask_index=i,
+                                         parallelism=len(splits),
+                                         max_parallelism=v.max_parallelism)
+                    t = SourceSubtask(uid, i, v.build_operator(),
+                                      outputs[v.id][i], ctx, self, split)
+                    t.start(sub_snaps[i] if i < len(sub_snaps) else None)
+                    self._tasks.append(t)
+                    source_tasks.append(t)
+            else:
+                for i in range(n_subs(v)):
+                    ctx = RuntimeContext(task_name=v.name, subtask_index=i,
+                                         parallelism=n_subs(v),
+                                         max_parallelism=v.max_parallelism)
+                    t = Subtask(uid, i, v.build_operator(), outputs[v.id][i],
+                                ctx, self, inputs[v.id][i],
+                                unaligned=self.unaligned)
+                    t.start(sub_snaps[i] if i < len(sub_snaps) else None)
+                    self._tasks.append(t)
+        self._source_tasks = source_tasks
+
+    # ------------------------------------------------------------ triggers
+    def trigger_checkpoint(self) -> Optional[int]:
+        """Start one checkpoint: inject barriers at all sources (RPC analog,
+        ``CheckpointCoordinator.triggerCheckpoint:502``)."""
+        with self._lock:
+            if self._pending is not None:
+                if (time.monotonic() - self._pending.started_at
+                        < self.checkpoint_timeout_s):
+                    return None   # previous still in flight
+                self._pending = None  # timed out: abort
+            # finished sources cannot inject barriers and finished tasks
+            # never ack — decline once any source finished, exclude finished
+            # tasks from the expectation otherwise
+            if any((t.vertex_uid, t.subtask_index) in self._finished
+                   for t in self._source_tasks):
+                return None
+            expected = len(self._tasks) - len(self._finished)
+            if expected <= 0:
+                return None
+            cid = self._next_checkpoint_id
+            self._next_checkpoint_id += 1
+            self._pending = _PendingCheckpoint(
+                cid, expected=expected, started_at=time.monotonic())
+        for t in self._source_tasks:
+            t.commands.put(("checkpoint", cid))
+        return cid
+
+    # ------------------------------------------------------------ execute
+    def execute(self, plan: ExecutionPlan,
+                restore: Optional[Dict[str, Any]] = None,
+                timeout_s: float = 300.0) -> JobResult:
+        t0 = time.monotonic()
+        restarts = 0
+        self._deploy(plan, restore)
+        last_trigger = time.monotonic()
+        while True:
+            time.sleep(0.002)
+            if time.monotonic() - t0 > timeout_s:
+                self.cancel()
+                return JobResult(plan.job_name, TaskStates.CANCELED,
+                                 (time.monotonic() - t0) * 1000, restarts,
+                                 self._completed_ids, "timeout")
+            if self._failed is not None:
+                err = self._failed
+                self.cancel()
+                for t in self._tasks:
+                    t.join()
+                latest = None
+                if self.checkpoint_storage is not None:
+                    latest = self.checkpoint_storage.load_latest()
+                elif getattr(self, "_latest_snapshot", None) is not None:
+                    latest = self._latest_snapshot
+                if restarts < self.restart_attempts:
+                    restarts += 1
+                    time.sleep(self.restart_delay_ms / 1000.0)
+                    self._deploy(plan, latest)
+                    continue
+                return JobResult(plan.job_name, TaskStates.FAILED,
+                                 (time.monotonic() - t0) * 1000, restarts,
+                                 self._completed_ids, err)
+            states = [t.state for t in self._tasks]
+            if all(s == TaskStates.FINISHED for s in states):
+                return JobResult(plan.job_name, TaskStates.FINISHED,
+                                 (time.monotonic() - t0) * 1000, restarts,
+                                 self._completed_ids)
+            if (self.checkpoint_interval_ms and
+                    (time.monotonic() - last_trigger) * 1000
+                    >= self.checkpoint_interval_ms):
+                if self.trigger_checkpoint() is not None:
+                    last_trigger = time.monotonic()
+
+    def cancel(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+    def savepoint(self) -> Optional[int]:
+        """User-triggered checkpoint (savepoint analog): returns its id once
+        completed, or None if it could not complete."""
+        cid = self.trigger_checkpoint()
+        if cid is None:
+            return None
+        deadline = time.monotonic() + self.checkpoint_timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if cid in self._completed_ids:
+                    return cid
+                if self._failed is not None:
+                    return None
+            time.sleep(0.005)
+        return None

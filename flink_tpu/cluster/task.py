@@ -181,19 +181,35 @@ class SourceSubtask(SubtaskBase):
                 self._emit([barrier])
                 self.listener.acknowledge_checkpoint(
                     cid, self.vertex_uid, self.subtask_index, snap)
+            elif cmd[0] == "notify_complete":
+                self.operator.notify_checkpoint_complete(cmd[1])
             elif cmd[0] == "cancel":
                 raise _Cancel()
 
 
 class Subtask(SubtaskBase):
-    """Channel-consuming subtask with aligned barriers."""
+    """Channel-consuming subtask with aligned OR unaligned barriers.
+
+    Aligned (default): a channel that delivered barrier N stops being polled
+    until every channel delivered N; snapshot at full alignment
+    (``SingleCheckpointBarrierHandler`` semantics).
+
+    Unaligned (``unaligned=True``): the barrier overtakes — on FIRST arrival
+    the operator snapshots and the barrier is forwarded immediately; elements
+    still arriving on not-yet-barriered channels keep being processed but are
+    ALSO recorded as **channel state** in the snapshot; the ack happens once
+    every channel delivered the barrier (``ChannelStateWriterImpl`` analog).
+    On restore the recorded elements are re-processed first.
+    """
 
     def __init__(self, vertex_uid: str, subtask_index: int, operator,
                  outputs, ctx, listener,
-                 input_channels: Sequence[LocalChannel]):
+                 input_channels: Sequence[LocalChannel],
+                 unaligned: bool = False):
         super().__init__(vertex_uid, subtask_index, operator, outputs, ctx,
                          listener)
         self.inputs = list(input_channels)
+        self.unaligned = unaligned
 
     def _invoke(self) -> None:
         n = len(self.inputs)
@@ -201,6 +217,18 @@ class Subtask(SubtaskBase):
         self._ended = [False] * n
         self._blocked: Dict[int, int] = {}  # channel idx -> blocking barrier id
         self._pending_barrier: Optional[CheckpointBarrier] = None
+        self._pending_snapshot: Optional[Dict[str, Any]] = None
+        self._channel_state: List[tuple] = []   # [(input_idx, element), ...]
+        # restore the valve FIRST: channel-state replay may carry watermarks
+        # (upstream will not resend them), which must advance past the
+        # snapshot-time valve, not be clobbered by it
+        restored_valve = (self._restore or {}).get("valve")
+        if restored_valve is not None:
+            self._valve.per_input = list(restored_valve)
+            self._valve.current = min(self._valve.per_input)
+        # unaligned restore: re-process recorded in-flight elements
+        for i, el in (self._restore or {}).get("channel_state", []):
+            self._handle_data(i, el)
         while not all(self._ended):
             self._check_cancel()
             self._drain_commands()
@@ -226,16 +254,31 @@ class Subtask(SubtaskBase):
 
     def _handle(self, i: int, el: StreamElement) -> None:
         """Single dispatch point for every input element (the mailbox default
-        action), including aligned-barrier bookkeeping."""
+        action), including barrier bookkeeping."""
         if isinstance(el, CheckpointBarrier):
+            first = self._pending_barrier is None
             self._blocked[i] = el.checkpoint_id
             self._pending_barrier = el
+            if self.unaligned and first:
+                # barrier overtakes: snapshot NOW, forward NOW
+                self._pending_snapshot = {
+                    "operator": self.operator.snapshot_state(),
+                    "valve": list(self._valve.per_input)}
+                self._emit([el])
             self._maybe_complete_alignment()
         elif isinstance(el, EndOfInput):
             self._ended[i] = True
             # a channel ending mid-alignment completes the barrier
             self._maybe_complete_alignment()
-        elif isinstance(el, Watermark):
+        else:
+            if self.unaligned and self._pending_barrier is not None:
+                # pre-barrier in-flight data on a not-yet-barriered channel:
+                # record into channel state AND process normally
+                self._channel_state.append((i, el))
+            self._handle_data(i, el)
+
+    def _handle_data(self, i: int, el: StreamElement) -> None:
+        if isinstance(el, Watermark):
             adv = self._valve.input_watermark(i, el.timestamp)
             if adv is not None:
                 wm = Watermark(adv)
@@ -258,8 +301,16 @@ class Subtask(SubtaskBase):
             self._pending_barrier = None
 
     def _take_checkpoint(self, barrier: CheckpointBarrier) -> None:
-        snap = {"operator": self.operator.snapshot_state()}
-        self._emit([barrier])
+        if self.unaligned and self._pending_snapshot is not None:
+            snap = self._pending_snapshot
+            snap["channel_state"] = list(self._channel_state)
+            self._pending_snapshot = None
+            self._channel_state = []
+            # barrier was already forwarded at first arrival
+        else:
+            snap = {"operator": self.operator.snapshot_state(),
+                    "valve": list(self._valve.per_input)}
+            self._emit([barrier])
         self.listener.acknowledge_checkpoint(
             barrier.checkpoint_id, self.vertex_uid, self.subtask_index, snap)
 
@@ -269,7 +320,9 @@ class Subtask(SubtaskBase):
                 cmd = self.commands.get_nowait()
             except queue.Empty:
                 return
-            if cmd[0] == "cancel":
+            if cmd[0] == "notify_complete":
+                self.operator.notify_checkpoint_complete(cmd[1])
+            elif cmd[0] == "cancel":
                 raise _Cancel()
 
 

@@ -132,6 +132,9 @@ class FileSink:
         #: orphaned part file of a previous attempt (reference part files
         #: carry subtask + bucket uid for the same reason)
         self._attempt = uuid.uuid4().hex[:8]
+        #: set by open(ctx); scopes part names AND orphan cleanup so parallel
+        #: sink subtasks sharing a directory never delete each other's parts
+        self._subtask_index = 0
         self._buf: List[RecordBatch] = []
         self._buf_rows = 0
         self._counter = 0
@@ -148,9 +151,14 @@ class FileSink:
         if self._buf_rows >= self.rolling_records:
             self._roll()
 
+    def open(self, ctx) -> None:
+        self._subtask_index = getattr(ctx, "subtask_index", 0)
+
     def _part_name(self, n: int) -> str:
-        return os.path.join(self.directory,
-                            f"{self.prefix}-{self._attempt}-{n:05d}.{self.format}")
+        return os.path.join(
+            self.directory,
+            f"{self.prefix}-s{self._subtask_index}-{self._attempt}-"
+            f"{n:05d}.{self.format}")
 
     def _roll(self) -> None:
         """Write the buffer to a pending part file (pre-commit)."""
@@ -184,10 +192,12 @@ class FileSink:
                          if os.path.exists(p)]
         self.commit_pending()
         # orphaned pending files from a FAILED epoch are not in the snapshot:
-        # they must not leak into results. Scope to THIS sink's prefix —
-        # other sinks sharing the directory own their own pending parts.
+        # they must not leak into results. Scope to THIS subtask's slot of
+        # THIS prefix — sibling subtasks and other sinks sharing the
+        # directory own their own pending parts.
+        scope = f"{self.prefix}-s{self._subtask_index}-"
         for f in os.listdir(self.directory):
-            if f.endswith(".pending") and f.startswith(f"{self.prefix}-"):
+            if f.endswith(".pending") and f.startswith(scope):
                 os.remove(os.path.join(self.directory, f))
 
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:

@@ -55,15 +55,17 @@ class PartitionedLog:
     def _path(self, partition: int) -> str:
         return os.path.join(self.directory, f"partition-{partition:04d}.log")
 
+    @staticmethod
+    def exists(directory: str) -> bool:
+        return os.path.exists(os.path.join(directory, "_meta.json"))
+
     def append(self, partition: int, batch: RecordBatch) -> int:
         """Append one batch; returns the end offset after the write."""
-        from flink_tpu.native import crc32
+        from flink_tpu.formats import write_frame
         from flink_tpu.native.codec import encode_batch
 
-        payload = encode_batch(batch)
         with open(self._path(partition), "ab") as f:
-            f.write(_FRAME.pack(len(payload), crc32(payload)))
-            f.write(payload)
+            write_frame(f, encode_batch(batch))
             f.flush()
             os.fsync(f.fileno())
             return f.tell()
@@ -74,26 +76,14 @@ class PartitionedLog:
 
     def read_from(self, partition: int, offset: int):
         """Yield ``(batch, next_offset)`` from ``offset`` to current end."""
-        from flink_tpu.native import crc32
+        from flink_tpu.formats import read_frames
         from flink_tpu.native.codec import decode_batch
 
         p = self._path(partition)
         if not os.path.exists(p):
             return
-        with open(p, "rb") as f:
-            f.seek(offset)
-            while True:
-                hdr = f.read(_FRAME.size)
-                if len(hdr) < _FRAME.size:
-                    return
-                ln, crc = _FRAME.unpack(hdr)
-                payload = f.read(ln)
-                if len(payload) < ln:
-                    return  # torn tail
-                if crc32(payload) != crc:
-                    raise IOError(f"log CRC mismatch: {p} @ {offset}")
-                offset = f.tell()
-                yield decode_batch(payload), offset
+        for payload, next_off in read_frames(p, offset):
+            yield decode_batch(payload), next_off
 
 
 class _LogSplitReader:
@@ -148,6 +138,11 @@ class LogSource(Source):
         self.idle_timeout_ms = idle_timeout_ms
 
     def create_splits(self, parallelism: int) -> List[SourceSplit]:
+        if not PartitionedLog.exists(self.directory):
+            # a typo'd path must fail loudly, not create an empty log and
+            # run a successful empty job
+            raise FileNotFoundError(
+                f"LogSource: no partitioned log at {self.directory!r}")
         log = PartitionedLog(self.directory)
         return [LogSplit(self, p, log.num_partitions, partition=p)
                 for p in range(log.num_partitions)]
@@ -180,9 +175,16 @@ class LogSink:
 
     def __init__(self, directory: str, num_partitions: int = 1,
                  key_column: Optional[str] = None, txn_id: str = "logsink"):
+        import uuid
+
         self.log = PartitionedLog(directory, num_partitions)
         self.key_column = key_column
         self.txn_id = txn_id
+        #: unique per sink attempt; committed-txn dedup keys on
+        #: (attempt, cid), so a FRESH job writing to a directory with a
+        #: stale sidecar never mistakes its own new txns for committed ones.
+        #: A restore adopts the snapshot's attempt (see restore_state).
+        self._attempt = uuid.uuid4().hex[:12]
         self._epoch: List[RecordBatch] = []
         self._staged: Dict[int, List[RecordBatch]] = {}
         self._rr = 0
@@ -190,15 +192,18 @@ class LogSink:
         # a crashed predecessor may have left a half-appended transaction
         self._recover_partial_commits()
 
-    def _committed_ids(self) -> List[int]:
+    def _committed_ids(self) -> List[str]:
         if os.path.exists(self._commits_path):
             with open(self._commits_path) as f:
                 return json.load(f)
         return []
 
+    def _commit_key(self, checkpoint_id: int) -> str:
+        return f"{self._attempt}:{checkpoint_id}"
+
     def _record_commit(self, checkpoint_id: int) -> None:
         ids = self._committed_ids()
-        ids.append(checkpoint_id)
+        ids.append(self._commit_key(checkpoint_id))
         tmp = self._commits_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(ids[-100:], f)
@@ -243,16 +248,19 @@ class LogSink:
         self._epoch = []
         self._staged_counter = getattr(self, "_staged_counter", 0) + 1
         self._staged[self._staged_counter] = staged_now
-        return {"staged": dict(self._staged), "counter": self._staged_counter}
+        return {"staged": dict(self._staged), "counter": self._staged_counter,
+                "attempt": self._attempt}
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         self._recover_partial_commits()
+        # adopt the snapshot's attempt: its committed txn keys must match
+        self._attempt = snap.get("attempt", self._attempt)
         self._staged_counter = int(snap.get("counter", 0))
         committed = set(self._committed_ids())
         self._staged = {}
         for cid, batches in snap.get("staged", {}).items():
             cid = int(cid)
-            if cid in committed:
+            if self._commit_key(cid) in committed:
                 continue  # already in the log: never double-append
             self._staged[cid] = list(batches)
         # transactions staged in a completed checkpoint are owed to the log
@@ -273,7 +281,7 @@ class LogSink:
 
     def _intent_path(self, cid: int) -> str:
         return os.path.join(self.log.directory,
-                            f"_intent-{self.txn_id}-{cid}.json")
+                            f"_intent-{self.txn_id}-{self._attempt}-{cid}.json")
 
     def _recover_partial_commits(self) -> None:
         committed = set(self._committed_ids())
@@ -283,7 +291,7 @@ class LogSink:
             path = os.path.join(self.log.directory, f)
             with open(path) as fh:
                 intent = json.load(fh)
-            if int(intent["cid"]) not in committed:
+            if intent["key"] not in committed:
                 for p_str, off in intent["offsets"].items():
                     lp = self.log._path(int(p_str))
                     if os.path.exists(lp) and os.path.getsize(lp) > off:
@@ -293,7 +301,7 @@ class LogSink:
 
     def _commit(self, cid: int) -> None:
         batches = self._staged.pop(cid, None)
-        if batches is None or cid in self._committed_ids():
+        if batches is None or self._commit_key(cid) in self._committed_ids():
             return
         if not batches:
             self._record_commit(cid)
@@ -302,7 +310,7 @@ class LogSink:
                    for p in range(self.log.num_partitions)}
         tmp = self._intent_path(cid) + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"cid": cid, "offsets": offsets}, f)
+            json.dump({"key": self._commit_key(cid), "offsets": offsets}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._intent_path(cid))

@@ -122,6 +122,26 @@ class StreamExecutionEnvironment:
         self._last_executor = executor
         return executor.execute(plan, restore=restore, drain=drain)
 
+    def execute_cluster(self, job_name: str = "job",
+                        restore: Optional[Dict[str, Any]] = None,
+                        checkpoint_interval_ms: Optional[int] = None,
+                        storage=None, unaligned: bool = False,
+                        restart_attempts: int = 0, timeout_s: float = 300.0):
+        """Run on the in-process MiniCluster with REAL parallelism (one
+        thread per subtask, channels + partitioners between them) — the
+        multi-node semantics path (``MiniCluster.java`` analog)."""
+        from flink_tpu.cluster.minicluster import MiniCluster
+
+        plan = self.get_stream_graph(job_name).to_plan()
+        cluster = MiniCluster(
+            checkpoint_storage=storage or self.checkpoint_storage,
+            checkpoint_interval_ms=(
+                checkpoint_interval_ms if checkpoint_interval_ms is not None
+                else self.checkpoint_interval_ms),
+            unaligned=unaligned, restart_attempts=restart_attempts)
+        self._last_cluster = cluster
+        return cluster.execute(plan, restore=restore, timeout_s=timeout_s)
+
 
 def _identity_operator_factory(name: str):
     from flink_tpu.operators.base import StreamOperator

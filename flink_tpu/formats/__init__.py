@@ -144,30 +144,23 @@ def _batch_from_rows(rows: List[Dict[str, Any]],
 _FRAME = struct.Struct("<II")  # payload_len, crc32
 
 
-def write_ftb(batches, path: str, compress: bool = True,
-              append: bool = False) -> int:
+def write_frame(fileobj, payload: bytes) -> None:
+    """One CRC-checked length-prefixed frame (shared by FTB files and the
+    partitioned-log connector — single source of truth for the framing)."""
     from flink_tpu.native import crc32
-    from flink_tpu.native.codec import encode_batch
 
-    n = 0
-    with open(path, "ab" if append else "wb") as f:
-        for b in batches:
-            payload = encode_batch(b, compress=compress)
-            f.write(_FRAME.pack(len(payload), crc32(payload)))
-            f.write(payload)
-            n += len(b)
-    return n
+    fileobj.write(_FRAME.pack(len(payload), crc32(payload)))
+    fileobj.write(payload)
 
 
-def read_ftb(path: str, skip_batches: int = 0,
-             start_offset: int = 0) -> Iterator[RecordBatch]:
+def read_frames(path: str, start_offset: int = 0):
+    """Yield ``(payload, next_offset)`` per complete frame; stops cleanly at
+    a torn tail write; raises on CRC mismatch."""
     from flink_tpu.native import crc32
-    from flink_tpu.native.codec import decode_batch
 
     with open(path, "rb") as f:
         if start_offset:
             f.seek(start_offset)
-        i = 0
         while True:
             hdr = f.read(_FRAME.size)
             if len(hdr) < _FRAME.size:
@@ -177,10 +170,29 @@ def read_ftb(path: str, skip_batches: int = 0,
             if len(payload) < ln:
                 return  # torn tail write: stop at last complete frame
             if crc32(payload) != crc:
-                raise IOError(f"FTB frame CRC mismatch in {path} at batch {i}")
-            if i >= skip_batches:
-                yield decode_batch(payload)
-            i += 1
+                raise IOError(f"frame CRC mismatch in {path}")
+            yield payload, f.tell()
+
+
+def write_ftb(batches, path: str, compress: bool = True,
+              append: bool = False) -> int:
+    from flink_tpu.native.codec import encode_batch
+
+    n = 0
+    with open(path, "ab" if append else "wb") as f:
+        for b in batches:
+            write_frame(f, encode_batch(b, compress=compress))
+            n += len(b)
+    return n
+
+
+def read_ftb(path: str, skip_batches: int = 0,
+             start_offset: int = 0) -> Iterator[RecordBatch]:
+    from flink_tpu.native.codec import decode_batch
+
+    for i, (payload, _off) in enumerate(read_frames(path, start_offset)):
+        if i >= skip_batches:
+            yield decode_batch(payload)
 
 
 FORMATS = {

@@ -221,13 +221,28 @@ class KeyedReduceOperator(StreamOperator):
             self._K = newK
         values = (batch.column(self.value_column) if self.value_column
                   else dict(batch.columns))
+        # pad to pow2 batch size: variable hash-split batch sizes would
+        # otherwise recompile _step per distinct size (static-shape rule).
+        # Pad slots use the out-of-range sentinel K -> writes drop.
+        B = len(batch)
+        Bp = max(64, 1 << (B - 1).bit_length())
+        if Bp != B:
+            pad = Bp - B
+            slot_ids = np.concatenate(
+                [np.asarray(slot_ids), np.full(pad, self._K, np.int64)])
+            values = jax.tree_util.tree_map(
+                lambda a: np.concatenate(
+                    [np.asarray(a),
+                     np.zeros((pad,) + np.shape(a)[1:], np.asarray(a).dtype)]),
+                values)
         self._leaves, out = self._step(self._leaves,
                                        jnp.asarray(slot_ids, jnp.int32), values)
+        out = jax.tree_util.tree_map(lambda a: np.asarray(a)[:B], out)
         cols = dict(batch.columns)
         if isinstance(out, dict):
-            cols.update({k: np.asarray(v) for k, v in out.items()})
+            cols.update(out)
         else:
-            cols[self.output_column] = np.asarray(out)
+            cols[self.output_column] = out
         return [RecordBatch(cols, batch.timestamps, batch.key_ids, batch.key_groups)]
 
     def snapshot_state(self) -> Dict[str, Any]:

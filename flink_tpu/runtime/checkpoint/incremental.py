@@ -1,0 +1,174 @@
+"""Incremental checkpoints: content-addressed shared state + refcounting.
+
+Analog of the reference's incremental RocksDB checkpoints
+(``RocksIncrementalSnapshotStrategy.java:83``: previously-uploaded SST files
+are re-referenced, not re-uploaded) + ``SharedStateRegistry`` (refcounts
+shared artifacts across retained checkpoints, deletes on last release).
+
+Redesigned for array state: every large numpy leaf in a snapshot tree is
+content-hashed; the blob is uploaded once into ``shared/`` and later
+checkpoints that contain the identical array just reference the hash.  A
+registry file tracks ``hash -> [checkpoint ids]``; retention eviction
+releases references and deletes unreferenced blobs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+METADATA_FILE = "_metadata.json"
+
+
+@dataclass(frozen=True)
+class BlobRef:
+    """Placeholder for a deduplicated array leaf."""
+
+    digest: str
+    shape: Tuple[int, ...]
+    dtype: str
+
+
+class IncrementalCheckpointStorage:
+    """Durable checkpoint storage with cross-checkpoint blob dedup."""
+
+    def __init__(self, directory: str, retain: int = 3,
+                 min_blob_bytes: int = 4096):
+        self.directory = directory
+        self.retain = retain
+        self.min_blob_bytes = min_blob_bytes
+        self.shared_dir = os.path.join(directory, "shared")
+        os.makedirs(self.shared_dir, exist_ok=True)
+        self._registry_path = os.path.join(directory, "_registry.json")
+        self._registry: Dict[str, List[int]] = {}
+        if os.path.exists(self._registry_path):
+            with open(self._registry_path) as f:
+                self._registry = {k: list(v) for k, v in json.load(f).items()}
+
+    # -- tree walk -----------------------------------------------------------
+    def _dedup(self, obj: Any, cid: int, new_blobs: Dict[str, np.ndarray]) -> Any:
+        if isinstance(obj, np.ndarray) and obj.dtype != object and \
+                obj.nbytes >= self.min_blob_bytes:
+            arr = np.ascontiguousarray(obj)
+            digest = hashlib.sha256(arr.tobytes()).hexdigest()[:32]
+            if digest not in self._registry:
+                new_blobs[digest] = arr
+            self._registry.setdefault(digest, [])
+            if cid not in self._registry[digest]:
+                self._registry[digest].append(cid)
+            return BlobRef(digest, tuple(arr.shape), arr.dtype.str)
+        if isinstance(obj, dict):
+            return {k: self._dedup(v, cid, new_blobs) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            out = [self._dedup(v, cid, new_blobs) for v in obj]
+            return type(obj)(out) if isinstance(obj, tuple) else out
+        return obj
+
+    def _resolve(self, obj: Any) -> Any:
+        if isinstance(obj, BlobRef):
+            path = os.path.join(self.shared_dir, obj.digest + ".blob")
+            arr = np.fromfile(path, np.dtype(obj.dtype))
+            return arr.reshape(obj.shape)
+        if isinstance(obj, dict):
+            return {k: self._resolve(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            out = [self._resolve(v) for v in obj]
+            return type(obj)(out) if isinstance(obj, tuple) else out
+        return obj
+
+    # -- storage interface ---------------------------------------------------
+    def store(self, checkpoint_id: int, snapshot: Dict[str, Any]) -> None:
+        new_blobs: Dict[str, np.ndarray] = {}
+        deduped = self._dedup(snapshot, checkpoint_id, new_blobs)
+        for digest, arr in new_blobs.items():
+            tmp = os.path.join(self.shared_dir, f".{digest}.tmp")
+            arr.tofile(tmp)
+            os.replace(tmp, os.path.join(self.shared_dir, digest + ".blob"))
+        cdir = os.path.join(self.directory, f"chk-{checkpoint_id}")
+        os.makedirs(cdir, exist_ok=True)
+        with open(os.path.join(cdir, "snapshot.pkl"), "wb") as f:
+            pickle.dump(deduped, f, protocol=4)
+        with open(os.path.join(cdir, METADATA_FILE), "w") as f:
+            json.dump({"checkpoint_id": checkpoint_id,
+                       "incremental": True,
+                       "new_blobs": len(new_blobs),
+                       "referenced_blobs": self._count_refs(deduped)}, f)
+        self._save_registry()
+        self._evict()
+
+    def _count_refs(self, obj: Any) -> int:
+        if isinstance(obj, BlobRef):
+            return 1
+        if isinstance(obj, dict):
+            return sum(self._count_refs(v) for v in obj.values())
+        if isinstance(obj, (list, tuple)):
+            return sum(self._count_refs(v) for v in obj)
+        return 0
+
+    def checkpoint_ids(self) -> List[int]:
+        ids = []
+        for d in os.listdir(self.directory):
+            if d.startswith("chk-"):
+                try:
+                    ids.append(int(d[4:]))
+                except ValueError:
+                    continue
+        return sorted(ids)
+
+    def load(self, checkpoint_id: int) -> Dict[str, Any]:
+        cdir = os.path.join(self.directory, f"chk-{checkpoint_id}")
+        with open(os.path.join(cdir, "snapshot.pkl"), "rb") as f:
+            return self._resolve(pickle.load(f))
+
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        ids = self.checkpoint_ids()
+        return self.load(ids[-1]) if ids else None
+
+    def metadata(self, checkpoint_id: int) -> Dict[str, Any]:
+        cdir = os.path.join(self.directory, f"chk-{checkpoint_id}")
+        with open(os.path.join(cdir, METADATA_FILE)) as f:
+            return json.load(f)
+
+    # -- retention / registry ------------------------------------------------
+    def _evict(self) -> None:
+        ids = self.checkpoint_ids()
+        while len(ids) > self.retain:
+            victim = ids.pop(0)
+            self.release(victim)
+
+    def release(self, checkpoint_id: int) -> None:
+        """Drop a checkpoint and delete blobs nothing references anymore
+        (``SharedStateRegistry.unregisterUnusedState`` analog)."""
+        import shutil
+
+        cdir = os.path.join(self.directory, f"chk-{checkpoint_id}")
+        if os.path.isdir(cdir):
+            shutil.rmtree(cdir)
+        dead = []
+        for digest, refs in self._registry.items():
+            if checkpoint_id in refs:
+                refs.remove(checkpoint_id)
+            if not refs:
+                dead.append(digest)
+        for digest in dead:
+            del self._registry[digest]
+            path = os.path.join(self.shared_dir, digest + ".blob")
+            if os.path.exists(path):
+                os.remove(path)
+        self._save_registry()
+
+    def _save_registry(self) -> None:
+        tmp = self._registry_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._registry, f)
+        os.replace(tmp, self._registry_path)
+
+    def shared_blob_count(self) -> int:
+        return len([f for f in os.listdir(self.shared_dir)
+                    if f.endswith(".blob")])

@@ -297,7 +297,7 @@ def test_log_sink_crash_mid_commit_truncate_recovery(tmp_path):
     cid = snap["counter"]
     offsets = {0: sink.log.end_offset(0)}
     with open(sink._intent_path(cid), "w") as f:
-        _json.dump({"cid": cid, "offsets": offsets}, f)
+        _json.dump({"key": sink._commit_key(cid), "offsets": offsets}, f)
     for b in snap["staged"][cid]:
         sink._append(b)
     assert sum(len(b) for b, _ in PartitionedLog(d).read_from(0, 0)) == 10
@@ -358,3 +358,45 @@ def test_log_sink_stable_string_key_partitioning(tmp_path):
             for k in got.tolist():
                 idx = keys.tolist().index(k)
                 assert expect_parts[idx] == p
+
+
+def test_log_sink_fresh_job_ignores_stale_sidecar(tmp_path):
+    """Regression: a NEW job writing to a directory with a surviving commit
+    sidecar must not mistake its own txn ids for already-committed ones."""
+    d = str(tmp_path / "log")
+    s1 = LogSink(d, num_partitions=1)
+    s1.write_batch(_mkbatch(0, 10))
+    s1.snapshot_state()
+    s1.notify_checkpoint_complete(1)
+    # fresh job, same directory, no restore
+    s2 = LogSink(d, num_partitions=1)
+    s2.write_batch(_mkbatch(10, 20))
+    s2.snapshot_state()
+    s2.notify_checkpoint_complete(1)
+    assert sum(len(b) for b, _ in PartitionedLog(d).read_from(0, 0)) == 20
+
+
+def test_log_source_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        LogSource(str(tmp_path / "nope")).create_splits(1)
+    assert not os.path.exists(str(tmp_path / "nope" / "_meta.json"))
+
+
+def test_file_sink_sibling_subtasks_share_directory(tmp_path):
+    """Regression: subtask 0's restore cleanup must not delete subtask 1's
+    live pending part."""
+    class _Ctx:
+        subtask_index = 0
+
+    d = str(tmp_path / "out")
+    a = FileSink(d, format="csv")
+    a.open(_Ctx())
+    b = FileSink(d, format="csv")
+    ctx1 = _Ctx()
+    ctx1.subtask_index = 1
+    b.open(ctx1)
+    b.write_batch(_mkbatch(0, 5))
+    b_snap = b.snapshot_state()            # b's pending part on disk
+    a.restore_state({"pending": [], "counter": 0})   # a restores
+    b.notify_checkpoint_complete(1)        # b commits: part must still exist
+    assert len(b.committed_files()) == 1

@@ -1,0 +1,142 @@
+"""Incremental checkpoint storage (blob dedup + shared-state refcounts) and
+the changelog keyed-state backend (log mutations, materialize, replay)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.runtime.checkpoint.incremental import IncrementalCheckpointStorage
+from flink_tpu.state.changelog import ChangelogKeyedStateBackend
+from flink_tpu.state.heap import HeapKeyedStateBackend
+
+
+def _snap(arr_a, arr_b):
+    return {"op1": {"state.x.rows": arr_a, "small": 7},
+            "op2": {"leaves": [arr_b], "name": "w"}}
+
+
+def test_incremental_dedup_unchanged_blobs(tmp_path):
+    st = IncrementalCheckpointStorage(str(tmp_path), retain=5,
+                                      min_blob_bytes=1024)
+    a = np.arange(10_000, dtype=np.float64)      # 80KB, stays identical
+    b = np.zeros(5_000, np.float32)
+    st.store(1, _snap(a, b))
+    blobs_after_1 = st.shared_blob_count()
+    st.store(2, _snap(a, b + 1))                 # only b changed
+    assert st.shared_blob_count() == blobs_after_1 + 1  # ONE new blob
+    assert st.metadata(2)["new_blobs"] == 1
+    # loads resolve to full arrays
+    got = st.load(2)
+    np.testing.assert_array_equal(got["op1"]["state.x.rows"], a)
+    np.testing.assert_array_equal(got["op2"]["leaves"][0], b + 1)
+    assert got["op1"]["small"] == 7
+
+
+def test_incremental_retention_releases_blobs(tmp_path):
+    st = IncrementalCheckpointStorage(str(tmp_path), retain=2,
+                                      min_blob_bytes=64)
+    shared = np.arange(1000, dtype=np.float64)   # referenced by every chk
+    for cid in range(1, 6):
+        unique = np.full(1000, cid, np.float64)  # referenced by one chk
+        st.store(cid, {"shared": shared, "unique": unique})
+    assert st.checkpoint_ids() == [4, 5]
+    # shared blob survives; evicted checkpoints' unique blobs are gone
+    assert st.shared_blob_count() == 3   # shared + unique4 + unique5
+    got = st.load(4)
+    np.testing.assert_array_equal(got["shared"], shared)
+    np.testing.assert_array_equal(got["unique"], np.full(1000, 4, np.float64))
+
+
+def test_incremental_registry_survives_reopen(tmp_path):
+    st = IncrementalCheckpointStorage(str(tmp_path), retain=3,
+                                      min_blob_bytes=64)
+    a = np.arange(500, dtype=np.int64)
+    st.store(1, {"a": a})
+    st2 = IncrementalCheckpointStorage(str(tmp_path), retain=3,
+                                       min_blob_bytes=64)
+    st2.store(2, {"a": a})                       # same content: deduped
+    assert st2.metadata(2)["new_blobs"] == 0
+    np.testing.assert_array_equal(st2.load(2)["a"], a)
+
+
+# ---------------------------------------------------------------------------
+# changelog backend
+# ---------------------------------------------------------------------------
+
+def test_changelog_records_and_replays():
+    be = ChangelogKeyedStateBackend(HeapKeyedStateBackend(max_parallelism=16))
+    st = be.value_state("v", default=0)
+    be.set_current_key("a")
+    st.update(1)
+    be.set_current_key("b")
+    st.update(2)
+    be.materialize()                       # base: {a:1, b:2}
+    be.set_current_key("a")
+    st.update(10)                          # post-materialization delta
+    ls = be.list_state("l")
+    ls.add("x")
+    snap = be.snapshot()
+    assert snap["changelog_backend"]
+    # log is short: registers + 3 entries, not the whole history
+    assert len(snap["changelog"]) <= 6
+
+    be2 = ChangelogKeyedStateBackend(HeapKeyedStateBackend(max_parallelism=16))
+    be2.restore(snap)
+    st2 = be2.value_state("v", default=0)
+    be2.set_current_key("a")
+    assert st2.value() == 10
+    be2.set_current_key("b")
+    assert st2.value() == 2
+    be2.set_current_key("a")               # "x" was added under key "a"
+    assert be2.list_state("l").get() == ["x"]
+
+
+def test_changelog_snapshot_is_cheap_after_materialize():
+    be = ChangelogKeyedStateBackend(HeapKeyedStateBackend())
+    st = be.value_state("v", default=0.0)
+    keys = np.arange(1000)
+    slots = be.key_slots(keys)
+    st.put_rows(slots, np.arange(1000.0))
+    be.materialize()
+    assert be.changelog_size() <= 1        # register entries only
+    be.set_current_key(5)
+    st.update(99.0)
+    snap = be.snapshot()
+    assert len(snap["changelog"]) <= 3     # register + key + mutation
+
+
+def test_changelog_vectorized_rows_replay():
+    be = ChangelogKeyedStateBackend(HeapKeyedStateBackend())
+    import jax.numpy as jnp
+
+    from flink_tpu.core.functions import SumAggregator
+    rs = be.reducing_state("sum", reduce_fn=SumAggregator(jnp.float64))
+    slots = be.key_slots(np.array([3, 1, 4, 1, 5]))
+    rs.add_rows(slots, np.asarray([1.0, 2.0, 3.0, 4.0, 5.0]))
+    snap = be.snapshot()                   # no materialization: pure log
+
+    be2 = ChangelogKeyedStateBackend(HeapKeyedStateBackend())
+    be2.restore(snap)
+    rs2 = be2.reducing_state("sum", reduce_fn=SumAggregator(jnp.float64))
+    be2.set_current_key(1)
+    assert float(rs2.get()) == 6.0
+    be2.set_current_key(5)
+    assert float(rs2.get()) == 5.0
+
+
+def test_changelog_restore_then_snapshot_keeps_deltas():
+    """A restore -> immediate snapshot cycle must not lose the replayed
+    suffix (the restored log carries over)."""
+    be = ChangelogKeyedStateBackend(HeapKeyedStateBackend())
+    st = be.value_state("v", default=0)
+    be.set_current_key("k")
+    st.update(42)
+    snap1 = be.snapshot()
+
+    be2 = ChangelogKeyedStateBackend(HeapKeyedStateBackend())
+    be2.restore(snap1)
+    snap2 = be2.snapshot()                 # no new mutations in between
+
+    be3 = ChangelogKeyedStateBackend(HeapKeyedStateBackend())
+    be3.restore(snap2)
+    be3.set_current_key("k")
+    assert be3.value_state("v", default=0).value() == 42
