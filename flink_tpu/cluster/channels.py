@@ -91,6 +91,14 @@ class OutputDispatcher:
         if n == 0:
             return
         if not isinstance(el, RecordBatch):
+            from flink_tpu.core.batch import TaggedBatch
+            if isinstance(el, TaggedBatch):
+                # side-output DATA: route to one consumer (round-robin), not
+                # the control-broadcast path — broadcasting would duplicate
+                # side-output rows x parallelism
+                self.channels[self._rr % n].put(el)
+                self._rr += 1
+                return
             for ch in self.channels:   # broadcast control elements
                 ch.put(el)
             return

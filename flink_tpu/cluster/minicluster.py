@@ -142,6 +142,8 @@ class MiniCluster(TaskListener):
         # channels per edge: producer subtask x consumer subtask
         inputs: Dict[int, List[List[LocalChannel]]] = {
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+        input_logical: Dict[int, List[List[int]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
         outputs: Dict[int, List[List[OutputDispatcher]]] = {
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
         for v in plan.vertices:
@@ -154,6 +156,7 @@ class MiniCluster(TaskListener):
                              for ci in range(nc)]
                     for ci, ch in enumerate(chans):
                         inputs[tgt.id][ci].append(ch)
+                        input_logical[tgt.id][ci].append(e.input_index)
                     part = e.partitioning
                     # forward edges with fan-out degrade to round-robin
                     if part == "forward" and nc > 1:
@@ -185,7 +188,8 @@ class MiniCluster(TaskListener):
                                          max_parallelism=v.max_parallelism)
                     t = Subtask(uid, i, v.build_operator(), outputs[v.id][i],
                                 ctx, self, inputs[v.id][i],
-                                unaligned=self.unaligned)
+                                unaligned=self.unaligned,
+                                input_logical=input_logical[v.id][i])
                     t.start(sub_snaps[i] if i < len(sub_snaps) else None)
                     self._tasks.append(t)
         self._source_tasks = source_tasks

@@ -30,7 +30,7 @@ import numpy as np
 
 from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
                                   EndOfInput, RecordBatch, StreamElement,
-                                  Watermark)
+                                  TaggedBatch, Watermark)
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
 from flink_tpu.runtime.executor import WatermarkValve
@@ -205,11 +205,15 @@ class Subtask(SubtaskBase):
     def __init__(self, vertex_uid: str, subtask_index: int, operator,
                  outputs, ctx, listener,
                  input_channels: Sequence[LocalChannel],
-                 unaligned: bool = False):
+                 unaligned: bool = False,
+                 input_logical: Optional[Sequence[int]] = None):
         super().__init__(vertex_uid, subtask_index, operator, outputs, ctx,
                          listener)
         self.inputs = list(input_channels)
         self.unaligned = unaligned
+        #: physical channel index -> logical input port (two-input operators)
+        self.input_logical = (list(input_logical) if input_logical is not None
+                              else [0] * len(self.inputs))
 
     def _invoke(self) -> None:
         n = len(self.inputs)
@@ -285,9 +289,16 @@ class Subtask(SubtaskBase):
                 self._emit(self.operator.process_watermark(wm))
                 if self.operator.forwards_watermarks:
                     self._emit([wm])
+        elif isinstance(el, TaggedBatch):
+            if getattr(self.operator, "accepts_tag", None) == el.tag:
+                self._emit(self.operator.process_tagged(el.batch))
         elif isinstance(el, RecordBatch):
             if len(el):
-                self._emit(self.operator.process_batch(el))
+                if self.operator.is_two_input:
+                    self._emit(self.operator.process_batch2(
+                        el, self.input_logical[i]))
+                else:
+                    self._emit(self.operator.process_batch(el))
         else:
             self._emit([el])
 

@@ -74,6 +74,25 @@ class EndOfInput(StreamElement):
     """End of a bounded stream."""
 
 
+@dataclass(frozen=True)
+class OutputTag:
+    """Names a side output (``OutputTag`` analog)."""
+
+    name: str
+
+
+class TaggedBatch(StreamElement):
+    """A batch destined for a side output: routed only to the matching
+    ``SideOutputOperator`` (``ProcessOperator`` side-output emission analog);
+    every other consumer drops it."""
+
+    __slots__ = ("tag", "batch")
+
+    def __init__(self, tag: str, batch: "RecordBatch"):
+        self.tag = tag
+        self.batch = batch
+
+
 class RecordBatch(StreamElement):
     """Columnar record batch.
 

@@ -10,6 +10,7 @@ vectorized (columns-dict in/out) — see ``flink_tpu/operators/basic.py``.
 
 from __future__ import annotations
 
+import copy
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Union
 
 import numpy as np
@@ -228,6 +229,67 @@ class DataStream:
                        partitioning=Partitioning.BROADCAST, chainable=False)
         return DataStream(self.env, t)
 
+    def iterate(self, max_wait_ms: int = 200) -> "IterativeStream":
+        """Streaming iteration (``DataStream.iterate`` analog): returns a
+        stream that unions this one with a feedback edge; wire the loop body
+        back with ``close_with(feedback_stream)``."""
+        from flink_tpu.operators.iteration import FeedbackQueue, FeedbackSource
+
+        q = FeedbackQueue()
+        fb = self.env.from_source(FeedbackSource(q, max_wait_ms),
+                                  "iteration-head")
+        unioned = self.union(fb)
+        return IterativeStream(self.env, unioned.transformation, q)
+
+    # ------------------------------------------------- two-input operations
+    def connect(self, other: "DataStream") -> "ConnectedStreams":
+        """Two streams, one two-input operator (``ConnectedStreams`` analog)."""
+        return ConnectedStreams(self.env, self, other)
+
+    def connect_broadcast(self, rules: "DataStream", fn,
+                          name: str = "broadcast-connect") -> "DataStream":
+        """Broadcast state pattern: ``rules`` replicates to every subtask;
+        ``fn`` is a BroadcastProcessFunction."""
+        from flink_tpu.operators.co import BroadcastConnectOperator
+
+        t = Transformation(
+            name=name, operator_factory=lambda: BroadcastConnectOperator(fn, name),
+            inputs=[self.transformation, rules.transformation],
+            input_partitionings=[Partitioning.FORWARD, Partitioning.BROADCAST],
+            input_key_columns=[None, None],
+            parallelism=self.env.parallelism, chainable=False,
+            max_parallelism=self.env.max_parallelism)
+        return DataStream(self.env, t)
+
+    def join(self, other: "DataStream") -> "JoinBuilder":
+        """``a.join(b).where(k).equal_to(k2).window(w).apply(fn)``."""
+        return JoinBuilder(self.env, self, other, cogroup=False)
+
+    def co_group(self, other: "DataStream") -> "JoinBuilder":
+        return JoinBuilder(self.env, self, other, cogroup=True)
+
+    def get_side_output(self, tag) -> "DataStream":
+        """Side-output stream of an upstream process function
+        (``getSideOutput`` analog). ``tag``: OutputTag or name."""
+        from flink_tpu.core.batch import OutputTag
+        from flink_tpu.operators.basic import SideOutputOperator
+
+        name = tag.name if isinstance(tag, OutputTag) else str(tag)
+        t = self._then(f"side-output:{name}",
+                       lambda: SideOutputOperator(name), chainable=False)
+        return DataStream(self.env, t)
+
+    def async_wait(self, fn, capacity: int = 16, timeout_ms: int = 60_000,
+                   ordered: bool = True, name: str = "async-wait") -> "DataStream":
+        """Async I/O (``AsyncDataStream.orderedWait/unorderedWait`` analog):
+        ``fn(cols) -> cols`` runs on a worker pool per batch."""
+        from flink_tpu.operators.async_io import AsyncWaitOperator
+
+        t = self._then(name, lambda: AsyncWaitOperator(
+            fn, capacity=capacity, timeout_ms=timeout_ms, ordered=ordered,
+            name=name), chainable=False)
+        return DataStream(self.env, t)
+
     # -------------------------------------------------------------- sinks
     def add_sink(self, sink: Sink, name: str = "sink") -> "DataStreamSink":
         t = self._then(name, lambda: SinkOperator(sink, name))
@@ -250,6 +312,132 @@ class DataStream:
         sink = self.collect()
         self.env.execute(job_name)
         return sink.rows()
+
+
+class IterativeStream(DataStream):
+    """Result of ``iterate()``: a stream with an open feedback edge."""
+
+    def __init__(self, env, transformation, queue):
+        super().__init__(env, transformation)
+        self.queue = queue
+
+    def close_with(self, feedback: DataStream) -> None:
+        """Attach the feedback edge (``IterativeStream.closeWith``)."""
+        from flink_tpu.operators.iteration import FeedbackSinkOperator
+
+        q = self.queue
+        t = feedback._then("iteration-tail",
+                           lambda: FeedbackSinkOperator(q), chainable=False)
+        t.is_sink = True
+        self.env._register_sink(t)
+
+
+class ConnectedStreams:
+    """``DataStream.connect`` result: map/flat_map/process over two inputs."""
+
+    def __init__(self, env: StreamExecutionEnvironment, left: DataStream,
+                 right: DataStream):
+        self.env = env
+        self.left = left
+        self.right = right
+
+    def _two_input(self, name: str, factory,
+                   partitionings=None, key_columns=None) -> DataStream:
+        t = Transformation(
+            name=name, operator_factory=factory,
+            inputs=[self.left.transformation, self.right.transformation],
+            input_partitionings=partitionings,
+            input_key_columns=key_columns,
+            parallelism=self.env.parallelism, chainable=False,
+            max_parallelism=self.env.max_parallelism)
+        return DataStream(self.env, t)
+
+    def map(self, fn1, fn2, name: str = "co-map") -> DataStream:
+        from flink_tpu.operators.co import CoMapOperator
+        return self._two_input(name, lambda: CoMapOperator(fn1, fn2, name))
+
+    def flat_map(self, fn1, fn2, name: str = "co-flat-map") -> DataStream:
+        from flink_tpu.operators.co import CoFlatMapOperator
+        return self._two_input(name, lambda: CoFlatMapOperator(fn1, fn2, name))
+
+    def process(self, fn, name: str = "co-process") -> DataStream:
+        from flink_tpu.operators.co import CoProcessOperator
+        return self._two_input(name, lambda: CoProcessOperator(fn, name))
+
+
+class JoinBuilder:
+    """``a.join(b).where(k).equal_to(k).window(w).apply(fn)`` — the
+    JoinedStreams/CoGroupedStreams fluent chain."""
+
+    def __init__(self, env, left: DataStream, right: DataStream, cogroup: bool):
+        self.env = env
+        self.left = left
+        self.right = right
+        self.cogroup = cogroup
+        self._left_key: Optional[str] = None
+        self._right_key: Optional[str] = None
+
+    def where(self, key_column: str) -> "JoinBuilder":
+        self._left_key = key_column
+        return self
+
+    def equal_to(self, key_column: str) -> "JoinBuilder":
+        self._right_key = key_column
+        return self
+
+    def window(self, assigner: WindowAssigner) -> "JoinBuilder":
+        self._assigner = assigner
+        return self
+
+    def apply(self, fn=None, name: str = "window-join") -> DataStream:
+        from flink_tpu.operators.joins import WindowJoinOperator
+
+        if self._left_key is None or self._right_key is None:
+            raise ValueError("join needs .where(...) and .equal_to(...)")
+        assigner = getattr(self, "_assigner", None)
+        if assigner is None:
+            raise ValueError("join needs .window(...)")
+        lk, rk, cg = self._left_key, self._right_key, self.cogroup
+        t = Transformation(
+            name=name,
+            operator_factory=lambda: WindowJoinOperator(
+                assigner, lk, rk, apply_fn=fn, cogroup=cg, name=name),
+            inputs=[self.left.transformation, self.right.transformation],
+            input_partitionings=[Partitioning.HASH, Partitioning.HASH],
+            input_key_columns=[lk, rk],
+            parallelism=self.env.parallelism, chainable=False,
+            max_parallelism=self.env.max_parallelism)
+        return DataStream(self.env, t)
+
+
+class IntervalJoinBuilder:
+    def __init__(self, env, left: "KeyedStream", right: "KeyedStream"):
+        self.env = env
+        self.left = left
+        self.right = right
+        self._lower = 0
+        self._upper = 0
+
+    def between(self, lower_ms: int, upper_ms: int) -> "IntervalJoinBuilder":
+        self._lower, self._upper = lower_ms, upper_ms
+        return self
+
+    def process(self, fn=None, name: str = "interval-join") -> DataStream:
+        from flink_tpu.operators.joins import IntervalJoinOperator
+
+        lk = self.left.key_column
+        rk = self.right.key_column
+        lo, hi = self._lower, self._upper
+        t = Transformation(
+            name=name,
+            operator_factory=lambda: IntervalJoinOperator(
+                lk, rk, lo, hi, output_fn=fn, name=name),
+            inputs=[self.left.transformation, self.right.transformation],
+            input_partitionings=[Partitioning.HASH, Partitioning.HASH],
+            input_key_columns=[lk, rk],
+            parallelism=self.env.parallelism, chainable=False,
+            max_parallelism=self.env.max_parallelism)
+        return DataStream(self.env, t)
 
 
 class DataStreamSink:
@@ -275,6 +463,10 @@ class KeyedStream(DataStream):
                  key_column: str):
         super().__init__(env, transformation)
         self.key_column = key_column
+
+    def interval_join(self, other: "KeyedStream") -> "IntervalJoinBuilder":
+        """``a.interval_join(b).between(lo, hi).process()`` (IntervalJoin)."""
+        return IntervalJoinBuilder(self.env, self, other)
 
     def window(self, assigner: WindowAssigner) -> "WindowedStream":
         return WindowedStream(self, assigner)
@@ -336,6 +528,35 @@ class WindowedStream:
     def allowed_lateness(self, ms: int) -> "WindowedStream":
         self._allowed_lateness = ms
         return self
+
+    def evictor(self, evictor) -> "WindowedStream":
+        """Raw-element window path with eviction (``evictor(...)`` analog);
+        terminal op becomes ``apply``."""
+        self._evictor = evictor
+        return self
+
+    def apply(self, fn, name: str = "window-apply") -> DataStream:
+        """``fn(key, window, rows) -> row dict`` over the window's raw
+        (evicted) rows — the WindowFunction path (buffers elements; use
+        ``aggregate``/``reduce`` for the incremental-ACC fast path)."""
+        from flink_tpu.operators.evicting_window import EvictingWindowOperator
+
+        if self._trigger is not None:
+            raise ValueError("custom triggers are not supported on the "
+                             "raw-element apply() path yet; use aggregate()")
+        assigner = self.assigner
+        key_col = self.keyed.key_column
+        ev = getattr(self, "_evictor", None)
+        lateness = self._allowed_lateness
+
+        def factory():
+            # evictors can hold per-fire scratch (DeltaEvictor.bind_values):
+            # every subtask needs its OWN instance
+            return EvictingWindowOperator(assigner, copy.deepcopy(ev),
+                                          key_col, fn, name,
+                                          allowed_lateness_ms=lateness)
+
+        return DataStream(self.keyed.env, self.keyed._then(name, factory))
 
     def aggregate(self, agg: AggregateFunction,
                   value_column: Optional[str] = None,

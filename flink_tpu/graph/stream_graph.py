@@ -26,6 +26,9 @@ class StreamEdge:
     target_id: int
     partitioning: str
     key_column: Optional[str] = None
+    #: which logical input port of the target this edge feeds (two-input
+    #: operators: 0 = left/main, 1 = right/broadcast side)
+    input_index: int = 0
 
 
 @dataclass
@@ -96,8 +99,14 @@ class StreamGraph:
             for t in all_t.values()
         }
         for t in all_t.values():
-            for inp in t.inputs:
-                e = StreamEdge(inp.id, t.id, t.partitioning, t.key_column)
+            for idx, inp in enumerate(t.inputs):
+                part = t.partitioning
+                key_col = t.key_column
+                if t.input_partitionings is not None:
+                    part = t.input_partitionings[idx]
+                if t.input_key_columns is not None:
+                    key_col = t.input_key_columns[idx]
+                e = StreamEdge(inp.id, t.id, part, key_col, input_index=idx)
                 nodes[inp.id].out_edges.append(e)
                 nodes[t.id].in_edges.append(e)
         return StreamGraph(nodes, default_parallelism, default_max_parallelism,
@@ -148,8 +157,9 @@ class StreamGraph:
                 if chained_into.get(e.target_id) != head_id or e.target_id == head_id:
                     tgt_head = chained_into[e.target_id]
                     if tgt_head != head_id:
-                        v.out_edges.append(StreamEdge(head_id, tgt_head,
-                                                      e.partitioning, e.key_column))
+                        v.out_edges.append(StreamEdge(
+                            head_id, tgt_head, e.partitioning, e.key_column,
+                            input_index=e.input_index))
                         vertices[tgt_head].in_degree += 1
         return ExecutionPlan(list(vertices.values()), self.job_name)
 

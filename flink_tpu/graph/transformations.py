@@ -50,6 +50,10 @@ class Transformation:
     chainable: bool = True
     slot_sharing_group: str = "default"
     uid: Optional[str] = None    # stable operator id for savepoint mapping
+    #: two-input transformations: per-input partitioning / key column
+    #: overrides (None = use the single transformation-level values)
+    input_partitionings: Optional[List[str]] = None
+    input_key_columns: Optional[List[Optional[str]]] = None
     id: int = field(default_factory=lambda: next(_ids))
 
     def with_uid(self, uid: str) -> "Transformation":

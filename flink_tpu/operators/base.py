@@ -34,11 +34,20 @@ class StreamOperator:
     #: False for operators that OWN event time (TimestampsAndWatermarks): the
     #: executor/chain must not forward upstream watermarks past them
     forwards_watermarks: bool = True
+    #: two-input operators (``TwoInputStreamOperator`` analog) receive
+    #: batches via process_batch2(batch, input_index) instead
+    is_two_input: bool = False
 
     def open(self, ctx: RuntimeContext) -> None:
         self.ctx = ctx
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        raise NotImplementedError
+
+    def process_batch2(self, batch: RecordBatch,
+                       input_index: int) -> List[StreamElement]:
+        """Two-input path (``processElement1/2`` analog); only called when
+        ``is_two_input`` is True."""
         raise NotImplementedError
 
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:

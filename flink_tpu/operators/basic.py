@@ -271,6 +271,22 @@ class KeyedReduceOperator(StreamOperator):
                              for l, s in zip(self._leaves, snap["leaves"]))
 
 
+class SideOutputOperator(StreamOperator):
+    """Consumes one side output tag (``DataStream.getSideOutput`` analog):
+    unwraps matching TaggedBatch elements, drops the main stream."""
+
+    def __init__(self, tag: str, name: str = "side-output"):
+        self.accepts_tag = tag
+        self.name = name
+        self.chainable = False
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        return []  # main-stream data does not pass
+
+    def process_tagged(self, batch: RecordBatch) -> List[StreamElement]:
+        return [batch]
+
+
 class SinkOperator(StreamOperator):
     """Terminal operator wrapping a sink function (``StreamSink`` analog)."""
 

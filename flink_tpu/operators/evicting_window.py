@@ -1,0 +1,133 @@
+"""Evicting window operator: raw-element window buffers + evictor + apply.
+
+Analog of ``EvictingWindowOperator.java``: unlike the incremental
+``WindowAggOperator`` (constant-size ACC per key x pane), evicting windows
+must buffer the raw rows (reference: ``ListStateDescriptor`` in
+``WindowOperatorBuilder:271``) because the evictor inspects individual
+elements at fire time.  Buffered columnar per (key, window); at watermark
+fire the evictor computes a keep-mask (arrival order), then the window
+function folds the surviving rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
+                                  Watermark)
+from flink_tpu.operators.base import StreamOperator
+from flink_tpu.windowing.assigners import WindowAssigner
+from flink_tpu.windowing.evictors import DeltaEvictor, Evictor
+
+
+class EvictingWindowOperator(StreamOperator):
+    """``window(...).evictor(...).apply(fn)``: fn(key, window, rows) -> row."""
+
+    def __init__(self, assigner: WindowAssigner, evictor: Optional[Evictor],
+                 key_column: str,
+                 apply_fn: Callable[[Any, Any, List[dict]], Optional[dict]],
+                 name: str = "evicting-window",
+                 allowed_lateness_ms: int = 0):
+        if getattr(assigner, "panes_per_window", 1) != 1:
+            raise ValueError("evicting windows support tumbling assigners")
+        self.assigner = assigner
+        self.evictor = evictor
+        self.key_column = key_column
+        self.apply_fn = apply_fn
+        self.name = name
+        self.allowed_lateness_ms = allowed_lateness_ms
+        #: (key, window_id) -> list of (arrival_seq, ts, row)
+        self._buffers: Dict[Any, list] = {}
+        self._seq = 0
+        self._fired_upto = LONG_MIN
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if batch.timestamps is None:
+            raise ValueError("evicting windows need event-time timestamps")
+        keys = np.asarray(batch.column(self.key_column))
+        ts = np.asarray(batch.timestamps, np.int64)
+        wins = self.assigner.pane_of(ts)
+        rows = batch.to_rows()
+        late_refire = set()
+        for i in range(len(batch)):
+            w = int(wins[i])
+            max_ts = self.assigner.window_bounds(w).max_timestamp
+            if max_ts <= self._fired_upto:
+                # window already fired: within allowed lateness the element
+                # joins the retained buffer and the window RE-fires
+                # (WindowOperator late-firing semantics); beyond it: dropped
+                if max_ts + self.allowed_lateness_ms <= self._fired_upto:
+                    continue
+                late_refire.add((self._key_of(keys, i), w))
+            k = self._key_of(keys, i)
+            self._buffers.setdefault((k, w), []).append(
+                (self._seq, int(ts[i]), rows[i]))
+            self._seq += 1
+        if late_refire:
+            return self._fire_windows(late_refire, cleanup=False)
+        return []
+
+    @staticmethod
+    def _key_of(keys: np.ndarray, i: int):
+        return keys[i].item() if isinstance(keys[i], np.generic) else keys[i]
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        return self._fire(watermark.timestamp)
+
+    def end_input(self) -> List[StreamElement]:
+        return self._fire(2 ** 62)
+
+    def _fire(self, wm: int) -> List[StreamElement]:
+        to_fire = set()
+        cleanup = []
+        for (k, w) in self._buffers:
+            max_ts = self.assigner.window_bounds(w).max_timestamp
+            if max_ts + self.allowed_lateness_ms <= wm:
+                cleanup.append((k, w))
+            if self._fired_upto < max_ts <= wm:
+                to_fire.add((k, w))
+        out = self._fire_windows(to_fire, cleanup=False)
+        for kw in cleanup:
+            self._buffers.pop(kw, None)
+        self._fired_upto = max(self._fired_upto, wm)
+        return out
+
+    def _fire_windows(self, window_keys, cleanup: bool) -> List[StreamElement]:
+        out_rows = []
+        out_ts = []
+        for (k, w) in sorted(window_keys, key=lambda kw: kw[1]):
+            entries = self._buffers.get((k, w))
+            if not entries:
+                continue
+            bounds = self.assigner.window_bounds(w)
+            entries.sort(key=lambda e: e[0])         # arrival order
+            ts = np.asarray([e[1] for e in entries], np.int64)
+            if self.evictor is None:
+                rows = [e[2] for e in entries]
+            else:
+                if isinstance(self.evictor, DeltaEvictor):
+                    self.evictor.bind_values(np.asarray(
+                        [e[2][self.evictor.value_column] for e in entries]))
+                keep = self.evictor.keep_mask(ts, bounds.max_timestamp)
+                rows = [e[2] for e, m in zip(entries, keep) if m]
+            res = self.apply_fn(k, bounds, rows)
+            if res is not None:
+                out_rows.append(res)
+                out_ts.append(bounds.max_timestamp)
+            if cleanup:
+                del self._buffers[(k, w)]
+        if not out_rows:
+            return []
+        cols = {c: np.asarray([r[c] for r in out_rows]) for c in out_rows[0]}
+        return [RecordBatch(cols, timestamps=np.asarray(out_ts, np.int64))]
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {"buffers": {k: list(v) for k, v in self._buffers.items()},
+                "seq": self._seq, "fired_upto": self._fired_upto}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._buffers = {k: list(v) for k, v in snap["buffers"].items()}
+        self._seq = snap["seq"]
+        self._fired_upto = snap["fired_upto"]

@@ -69,6 +69,17 @@ class Context:
         self._op = op
         self.slots = slots  # dense slot per row of the current batch
         self.timer_service = TimerServiceView(op.timers)
+        self._side: list = []
+
+    def side_output(self, tag, columns, timestamps=None) -> None:
+        """Emit a batch to the named side output (``Context.output`` analog).
+        ``tag`` is an OutputTag or its name string."""
+        from flink_tpu.core.batch import OutputTag, TaggedBatch
+
+        name = tag.name if isinstance(tag, OutputTag) else str(tag)
+        self._side.append(TaggedBatch(
+            name, RecordBatch({k: np.asarray(v) for k, v in columns.items()},
+                              timestamps=timestamps)))
 
     def state(self, descriptor):
         return self._op.backend.get_state(descriptor)
@@ -102,22 +113,25 @@ class KeyedProcessOperator(StreamOperator):
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         slots = self.backend.key_slots(np.asarray(batch.column(self.key_column)))
         batch = batch.with_keys(slots, batch.key_groups)
-        out = self.fn.process_batch(Context(self, slots), batch)
-        return _normalize(out)
+        ctx = Context(self, slots)
+        out = self.fn.process_batch(ctx, batch)
+        return _normalize(out) + ctx._side
 
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
         slots, _ns, ts = self.timers.advance_watermark(watermark.timestamp)
         if slots.size == 0:
             return []
-        out = self.fn.on_timer_batch(OnTimerContext(self, None), slots, ts)
-        return _normalize(out)
+        ctx = OnTimerContext(self, None)
+        out = self.fn.on_timer_batch(ctx, slots, ts)
+        return _normalize(out) + ctx._side
 
     def on_processing_time(self, timestamp_ms: int) -> List[StreamElement]:
         slots, _ns, ts = self.timers.advance_processing_time(timestamp_ms)
         if slots.size == 0:
             return []
-        out = self.fn.on_timer_batch(OnTimerContext(self, None), slots, ts)
-        return _normalize(out)
+        ctx = OnTimerContext(self, None)
+        out = self.fn.on_timer_batch(ctx, slots, ts)
+        return _normalize(out) + ctx._side
 
     # -- checkpointing -------------------------------------------------------
     def snapshot_state(self) -> Dict[str, Any]:

@@ -24,7 +24,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
-                                  RecordBatch, StreamElement, Watermark)
+                                  RecordBatch, StreamElement, TaggedBatch,
+                                  Watermark)
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.graph.stream_graph import ExecutionPlan, PlanVertex
 from flink_tpu.operators.base import StreamOperator
@@ -112,14 +113,13 @@ class LocalExecutor:
             rv = RunningVertex(v, op, WatermarkValve(0))
             rv.io = OperatorIOMetrics(group)
             running[v.id] = rv
-        # wire edges; input index = position among target's in-edges
+        # wire edges by the target's declared logical input port
         in_counts: Dict[int, int] = {v.id: 0 for v in plan.vertices}
         for v in plan.vertices:
             for e in v.out_edges:
                 tgt = running[e.target_id]
-                idx = in_counts[e.target_id]
                 in_counts[e.target_id] += 1
-                running[v.id].targets.append((tgt, idx))
+                running[v.id].targets.append((tgt, e.input_index))
         for v in plan.vertices:
             rv = running[v.id]
             rv.num_inputs = max(1, in_counts[v.id])
@@ -143,7 +143,10 @@ class LocalExecutor:
             if len(el):
                 if rv.io is not None:
                     rv.io.records_in.inc(len(el))
-                self._route(rv, op.process_batch(el))
+                if op.is_two_input:
+                    self._route(rv, op.process_batch2(el, input_index))
+                else:
+                    self._route(rv, op.process_batch(el))
         elif isinstance(el, Watermark):
             advanced = rv.valve.input_watermark(input_index, el.timestamp)
             if advanced is not None:
@@ -157,6 +160,11 @@ class LocalExecutor:
             # single-input-per-vertex local mode: barrier alignment is trivial;
             # snapshot on first arrival, forward once all inputs delivered it.
             self._on_barrier(rv, input_index, el)
+        elif isinstance(el, TaggedBatch):
+            # side-output routing: only the matching SideOutputOperator
+            # consumes it; every other vertex drops it
+            if getattr(op, "accepts_tag", None) == el.tag:
+                self._route(rv, op.process_tagged(el.batch))
         else:
             self._route(rv, [el])
 

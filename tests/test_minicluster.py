@@ -165,6 +165,7 @@ class _SumOp:
     name = "sum"
     forwards_watermarks = True
     is_stateless = False
+    is_two_input = False
 
     def open(self, ctx):
         self.total = 0.0

@@ -1,0 +1,398 @@
+"""Advanced stream operations: connected streams, broadcast state, interval
+join, window join/cogroup, side outputs, async I/O."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.task import TaskStates
+from flink_tpu.core.batch import OutputTag, RecordBatch, Watermark
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+def _env():
+    return StreamExecutionEnvironment()
+
+
+def test_connect_co_map():
+    env = _env()
+    a = env.from_collection(columns={"x": np.arange(5, dtype=np.int64)})
+    b = env.from_collection(columns={"x": np.arange(5, dtype=np.int64)})
+    out = (a.connect(b)
+           .map(lambda c: {"y": np.asarray(c["x"]) * 10},
+                lambda c: {"y": np.asarray(c["x"]) * 100})
+           .execute_and_collect())
+    ys = sorted(r["y"] for r in out)
+    assert ys == sorted([x * 10 for x in range(5)] + [x * 100 for x in range(5)])
+
+
+def test_broadcast_state_pattern():
+    from flink_tpu.operators.co import BroadcastProcessFunction
+
+    class Rules(BroadcastProcessFunction):
+        def process_broadcast_batch(self, cols, state, ctx):
+            for k, v in zip(np.asarray(cols["key"]).tolist(),
+                            np.asarray(cols["mult"]).tolist()):
+                state[int(k)] = v
+
+        def process_batch(self, cols, state, ctx):
+            x = np.asarray(cols["k"])
+            mult = np.asarray([state.get(int(k), 0) for k in x])
+            return {"k": x, "scaled": np.asarray(cols["v"]) * mult}
+
+    env = _env()
+    rules = env.from_collection(columns={"key": np.array([0, 1]),
+                                         "mult": np.array([10.0, 100.0])})
+    main = env.from_collection(columns={"k": np.array([0, 1, 0]),
+                                        "v": np.array([1.0, 2.0, 3.0])})
+    out = main.connect_broadcast(rules, Rules()).execute_and_collect()
+    got = sorted(r["scaled"] for r in out)
+    assert got == [10.0, 30.0, 200.0]
+
+
+def test_interval_join():
+    env = _env()
+    left = (env.from_collection(columns={"k": np.array([1, 1, 2]),
+                                         "lv": np.array([10., 20., 30.]),
+                                         "t": np.array([100, 200, 100])})
+            .assign_timestamps_and_watermarks(0, timestamp_column="t")
+            .key_by("k"))
+    right = (env.from_collection(columns={"k": np.array([1, 1, 2]),
+                                          "rv": np.array([1., 2., 3.]),
+                                          "t": np.array([105, 350, 190])})
+             .assign_timestamps_and_watermarks(0, timestamp_column="t")
+             .key_by("k"))
+    out = (left.interval_join(right).between(-50, 50).process()
+           .execute_and_collect())
+    pairs = sorted((r["lv"], r["rv"]) for r in out)
+    # k=1: (10,t100)x(1,t105) in window; (20,t200) matches nothing within 50
+    # k=2: (30,t100)x(3,t190) outside +50
+    assert pairs == [(10.0, 1.0)]
+
+
+def test_window_join():
+    env = _env()
+    left = (env.from_collection(columns={"k": np.array([1, 1, 2]),
+                                         "lv": np.array([1., 2., 3.]),
+                                         "t": np.array([10, 150, 20])})
+            .assign_timestamps_and_watermarks(0, timestamp_column="t"))
+    right = (env.from_collection(columns={"k": np.array([1, 2, 2]),
+                                          "rv": np.array([5., 6., 7.]),
+                                          "t": np.array([40, 30, 160])})
+             .assign_timestamps_and_watermarks(0, timestamp_column="t"))
+    out = (left.join(right).where("k").equal_to("k")
+           .window(TumblingEventTimeWindows.of(100))
+           .apply().execute_and_collect())
+    pairs = sorted((r["lv"], r["rv"]) for r in out)
+    # window [0,100): k=1 -> (1,5); k=2 -> (3,6). window [100,200): no match
+    assert pairs == [(1.0, 5.0), (3.0, 6.0)]
+    assert all(r["window_end"] % 100 == 0 for r in out)
+
+
+def test_window_cogroup_fires_one_sided():
+    env = _env()
+    left = (env.from_collection(columns={"k": np.array([1]),
+                                         "lv": np.array([1.]),
+                                         "t": np.array([10])})
+            .assign_timestamps_and_watermarks(0, timestamp_column="t"))
+    right = (env.from_collection(columns={"k": np.array([2]),
+                                          "rv": np.array([5.]),
+                                          "t": np.array([20])})
+             .assign_timestamps_and_watermarks(0, timestamp_column="t"))
+
+    def fold(key, window, lrows, rrows):
+        return {"k": key, "nl": len(lrows), "nr": len(rrows)}
+
+    out = (left.co_group(right).where("k").equal_to("k")
+           .window(TumblingEventTimeWindows.of(100))
+           .apply(fold).execute_and_collect())
+    got = {r["k"]: (r["nl"], r["nr"]) for r in out}
+    assert got == {1: (1, 0), 2: (0, 1)}
+
+
+def test_window_join_parallel_cluster():
+    rng = np.random.default_rng(12)
+    n = 400
+    lk = rng.integers(0, 11, n)
+    rk = rng.integers(0, 11, n)
+    lts = np.sort(rng.integers(0, 1000, n))
+    rts = np.sort(rng.integers(0, 1000, n))
+
+    def build(env):
+        left = (env.from_collection(columns={"k": lk, "lv": np.ones(n), "t": lts})
+                .assign_timestamps_and_watermarks(0, timestamp_column="t"))
+        right = (env.from_collection(columns={"k": rk, "rv": np.ones(n), "t": rts})
+                 .assign_timestamps_and_watermarks(0, timestamp_column="t"))
+        return (left.join(right).where("k").equal_to("k")
+                .window(TumblingEventTimeWindows.of(250)).apply())
+
+    env1 = _env()
+    serial = build(env1).collect()
+    env1.execute()
+
+    env2 = _env()
+    env2.set_parallelism(2)
+    par = build(env2).collect()
+    res = env2.execute_cluster()
+    assert res.state == TaskStates.FINISHED
+    assert len(par.rows()) == len(serial.rows()) > 0
+
+
+def test_side_outputs():
+    from flink_tpu.operators.process import KeyedProcessFunction
+
+    late = OutputTag("big")
+
+    class Splitter(KeyedProcessFunction):
+        def process_batch(self, ctx, batch):
+            v = np.asarray(batch.column("v"))
+            big = v >= 10
+            if big.any():
+                ctx.side_output(late, {"v": v[big]})
+            return [batch.select(~big)]
+
+    env = _env()
+    main = (env.from_collection(columns={"k": np.zeros(6, np.int64),
+                                         "v": np.array([1., 20., 2., 30., 3., 4.])})
+            .key_by("k").process(Splitter()))
+    main_sink = main.collect()
+    side_sink = main.get_side_output(late).collect()
+    env.execute()
+    assert sorted(r["v"] for r in main_sink.rows()) == [1., 2., 3., 4.]
+    assert sorted(r["v"] for r in side_sink.rows()) == [20., 30.]
+
+
+def test_async_io_ordered():
+    env = _env()
+    calls = []
+
+    def lookup(cols):
+        calls.append(len(cols["x"]))
+        return {"x": cols["x"], "y": np.asarray(cols["x"]) * 2}
+
+    out = (env.from_collection(columns={"x": np.arange(100, dtype=np.int64)},
+                               batch_size=10)
+           .async_wait(lookup, capacity=4, ordered=True)
+           .execute_and_collect())
+    xs = [r["x"] for r in out]
+    assert xs == list(range(100))          # ordered mode preserves order
+    assert all(r["y"] == r["x"] * 2 for r in out)
+    assert len(calls) == 10
+
+
+def test_async_io_unordered_with_watermark_fence():
+    import time
+
+    from flink_tpu.operators.async_io import AsyncWaitOperator
+
+    def slow_first(cols):
+        if cols["x"][0] == 0:
+            time.sleep(0.05)
+        return {"x": cols["x"]}
+
+    op = AsyncWaitOperator(slow_first, capacity=8, ordered=False)
+    from flink_tpu.core.functions import RuntimeContext
+    op.open(RuntimeContext())
+    out = []
+    out += op.process_batch(RecordBatch({"x": np.array([0])}))
+    out += op.process_batch(RecordBatch({"x": np.array([1])}))
+    out += op.process_watermark(Watermark(100))
+    out += op.process_batch(RecordBatch({"x": np.array([2])}))
+    out += op.end_input()
+    op.close()
+    kinds = [(type(e).__name__, (np.asarray(e.column("x"))[0]
+                                 if isinstance(e, RecordBatch) else e.timestamp))
+             for e in out]
+    xs = [v for k, v in kinds if k == "RecordBatch"]
+    wm_pos = [i for i, (k, _) in enumerate(kinds) if k == "Watermark"][0]
+    # both pre-fence batches emit before the watermark, in ANY order
+    assert sorted(xs[:wm_pos]) == [0, 1]
+    assert xs[wm_pos:] == [2]
+
+
+def test_async_io_timeout_replacement():
+    import time
+
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.async_io import AsyncFunction, AsyncWaitOperator
+
+    class Slow(AsyncFunction):
+        def invoke(self, cols):
+            time.sleep(1.0)
+            return cols
+
+        def timeout(self, cols):
+            return {"x": cols["x"], "timed_out": np.ones(len(cols["x"]), bool)}
+
+    op = AsyncWaitOperator(Slow(), timeout_ms=30, ordered=True)
+    op.open(RuntimeContext())
+    out = op.process_batch(RecordBatch({"x": np.array([7])}))
+    out += op.end_input()
+    op.close()
+    assert any("timed_out" in e.columns for e in out)
+
+
+def test_evicting_window_count_evictor():
+    from flink_tpu.windowing.evictors import CountEvictor
+
+    env = _env()
+    out = (env.from_collection(columns={"k": np.zeros(6, np.int64),
+                                        "v": np.array([1., 2., 3., 4., 5., 6.]),
+                                        "t": np.array([10, 20, 30, 40, 50, 60])})
+           .assign_timestamps_and_watermarks(0, timestamp_column="t")
+           .key_by("k")
+           .window(TumblingEventTimeWindows.of(100))
+           .evictor(CountEvictor.of(2))
+           .apply(lambda k, w, rows: {"k": k, "s": sum(r["v"] for r in rows)})
+           .execute_and_collect())
+    assert [r["s"] for r in out] == [11.0]   # last 2 rows: 5+6
+
+
+def test_window_apply_without_evictor():
+    env = _env()
+    out = (env.from_collection(columns={"k": np.array([1, 1, 2]),
+                                        "v": np.array([1., 2., 5.]),
+                                        "t": np.array([10, 20, 30])})
+           .assign_timestamps_and_watermarks(0, timestamp_column="t")
+           .key_by("k")
+           .window(TumblingEventTimeWindows.of(100))
+           .apply(lambda k, w, rows: {"k": k, "n": len(rows),
+                                      "start": w.start})
+           .execute_and_collect())
+    got = {r["k"]: r["n"] for r in out}
+    assert got == {1: 2, 2: 1}
+    assert all(r["start"] == 0 for r in out)
+
+
+def test_time_evictor():
+    from flink_tpu.windowing.evictors import TimeEvictor
+
+    env = _env()
+    out = (env.from_collection(columns={"k": np.zeros(4, np.int64),
+                                        "v": np.array([1., 2., 4., 8.]),
+                                        "t": np.array([0, 50, 80, 90])})
+           .assign_timestamps_and_watermarks(0, timestamp_column="t")
+           .key_by("k")
+           .window(TumblingEventTimeWindows.of(100))
+           .evictor(TimeEvictor.of(15))
+           .apply(lambda k, w, rows: {"s": sum(r["v"] for r in rows)})
+           .execute_and_collect())
+    assert [r["s"] for r in out] == [12.0]   # ts in [75, 90]: 4+8
+
+
+def test_streaming_iteration():
+    """Collatz-ish loop: halve evens, feed odds*3+1 back until all reach 1."""
+    env = _env()
+    start = env.from_collection(columns={"x": np.array([5, 6, 7], np.int64)})
+    it = start.iterate(max_wait_ms=300)
+
+    def step(cols):
+        x = np.asarray(cols["x"])
+        nxt = np.where(x % 2 == 0, x // 2, 3 * x + 1)
+        return {"x": nxt}
+
+    body = it.map(step)
+    not_done = body.filter(lambda c: np.asarray(c["x"]) != 1)
+    done = body.filter(lambda c: np.asarray(c["x"]) == 1)
+    it.close_with(not_done)
+    sink = done.collect()
+    env.execute()
+    assert sorted(r["x"] for r in sink.rows()) == [1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+
+def test_interval_join_intermediate_watermark_keeps_right_rows():
+    """Regression: a watermark landing between a matching pair must not
+    evict the right row before the left row fires."""
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.joins import IntervalJoinOperator
+
+    op = IntervalJoinOperator("k", "k", 0, 10)
+    op.open(RuntimeContext())
+    op.process_batch2(RecordBatch({"k": np.array([1]), "lv": np.array([1.0])},
+                                  timestamps=np.array([95])), 0)
+    op.process_batch2(RecordBatch({"k": np.array([1]), "rv": np.array([2.0])},
+                                  timestamps=np.array([96])), 1)
+    out = op.process_watermark(Watermark(100))   # left not yet complete
+    out += op.process_watermark(Watermark(110))  # now it fires
+    pairs = [(r["lv"], r["rv"]) for b in out for r in b.to_rows()]
+    assert pairs == [(1.0, 2.0)]
+
+
+def test_async_does_not_forward_watermarks_early():
+    from flink_tpu.operators.async_io import AsyncWaitOperator
+    assert AsyncWaitOperator(lambda c: c).forwards_watermarks is False
+
+
+def test_async_unordered_timeout_replacement():
+    import time
+
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.async_io import AsyncFunction, AsyncWaitOperator
+
+    class Slow(AsyncFunction):
+        def invoke(self, cols):
+            time.sleep(1.0)
+            return cols
+
+        def timeout(self, cols):
+            return {"x": cols["x"], "timed_out": np.ones(len(cols["x"]), bool)}
+
+    op = AsyncWaitOperator(Slow(), timeout_ms=30, ordered=False)
+    op.open(RuntimeContext())
+    out = op.process_batch(RecordBatch({"x": np.array([7])}))
+    out += op.end_input()
+    op.close()
+    assert any(isinstance(e, RecordBatch) and "timed_out" in e.columns
+               for e in out)
+
+
+def test_side_output_parallel_cluster_no_duplicates():
+    from flink_tpu.operators.process import KeyedProcessFunction
+
+    tag = OutputTag("big")
+
+    class Splitter(KeyedProcessFunction):
+        def process_batch(self, ctx, batch):
+            v = np.asarray(batch.column("v"))
+            big = v >= 10
+            if big.any():
+                ctx.side_output(tag, {"v": v[big]})
+            return [batch.select(~big)]
+
+    env = _env()
+    env.set_parallelism(2)
+    main = (env.from_collection(columns={"k": np.arange(6, dtype=np.int64),
+                                         "v": np.array([1., 20., 2., 30., 3., 4.])})
+            .key_by("k").process(Splitter()))
+    side_sink = main.get_side_output(tag).collect()
+    main.collect()
+    res = env.execute_cluster()
+    assert res.state == TaskStates.FINISHED
+    assert sorted(r["v"] for r in side_sink.rows()) == [20., 30.]
+
+
+def test_evicting_window_allowed_lateness_refire():
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.evicting_window import EvictingWindowOperator
+
+    op = EvictingWindowOperator(TumblingEventTimeWindows.of(100), None, "k",
+                                lambda k, w, rows: {"n": len(rows)},
+                                allowed_lateness_ms=50)
+    op.open(RuntimeContext())
+    op.process_batch(RecordBatch({"k": np.array([1])},
+                                 timestamps=np.array([10])))
+    out = op.process_watermark(Watermark(100))
+    assert [r["n"] for b in out for r in b.to_rows()] == [1]
+    # late element within lateness: window refires with updated contents
+    out = op.process_batch(RecordBatch({"k": np.array([1])},
+                                       timestamps=np.array([20])))
+    assert [r["n"] for b in out for r in b.to_rows()] == [2]
+    # beyond lateness: dropped silently
+    op.process_watermark(Watermark(200))
+    out = op.process_batch(RecordBatch({"k": np.array([1])},
+                                       timestamps=np.array([30])))
+    assert out == []
