@@ -294,7 +294,7 @@ class Subtask(SubtaskBase):
                 self._emit(self.operator.process_tagged(el.batch))
         elif isinstance(el, RecordBatch):
             if len(el):
-                if self.operator.is_two_input:
+                if getattr(self.operator, "is_two_input", False):
                     self._emit(self.operator.process_batch2(
                         el, self.input_logical[i]))
                 else:

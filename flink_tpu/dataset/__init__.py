@@ -1,0 +1,3 @@
+from flink_tpu.dataset.api import DataSet, ExecutionEnvironment
+
+__all__ = ["DataSet", "ExecutionEnvironment"]

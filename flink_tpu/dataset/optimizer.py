@@ -1,0 +1,422 @@
+"""Batch plan optimizer + vectorized drivers.
+
+Analog of ``flink-optimizer`` (``Optimizer.java:67`` ``compile:402`` — cost
+model choosing ship/local strategies) + the ``runtime/operators/`` drivers
+(sort, hash join, cogroup, cross).  Redesigned for columnar arrays:
+
+- **Cost model**: row-count estimates propagate bottom-up; equi-joins pick
+  ``broadcast_hash_{left,right}`` when one side is far smaller (the hybrid
+  hash join build-side choice) and ``sort_merge`` otherwise — physical
+  execution is the same vectorized kernel family either way, but the chosen
+  strategy is recorded and shown by ``explain()`` exactly like the
+  reference's plan dump.
+- **Drivers**: argsort-based sort, ``np.unique``-segment grouping (the
+  normalized-key-sort analog), span-intersection equi-join
+  (``flink_tpu/operators/joins._join_pairs``), BSP bulk/delta iterations
+  with superstep convergence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.dataset.api import BatchOp, DataSet
+from flink_tpu.operators.joins import _join_pairs
+
+#: one side this many times smaller than the other -> broadcast it
+_BROADCAST_RATIO = 8
+
+
+# ---------------------------------------------------------------------------
+# composite keys: multiple key columns -> one joinable 1-D array
+# ---------------------------------------------------------------------------
+
+def _composite_key(batch: RecordBatch, columns: List[str]) -> np.ndarray:
+    if len(columns) == 1:
+        return np.asarray(batch.column(columns[0]))
+    parts = [np.asarray(batch.column(c)) for c in columns]
+    if all(np.issubdtype(p.dtype, np.integer) for p in parts):
+        # pack small ints; fall back to strings on overflow risk
+        out = parts[0].astype(np.int64)
+        ok = True
+        for p in parts[1:]:
+            if (np.abs(out) > 1 << 31).any() or (np.abs(p) > 1 << 31).any():
+                ok = False
+                break
+            out = out * ((1 << 31) - 1) + p.astype(np.int64)
+        if ok:
+            return out
+    return np.asarray(["\x00".join(str(x) for x in row)
+                       for row in zip(*[p.tolist() for p in parts])], object)
+
+
+# ---------------------------------------------------------------------------
+# optimizer: size estimates + join strategy selection
+# ---------------------------------------------------------------------------
+
+def _estimate(op: BatchOp) -> int:
+    if op.est_rows is not None:
+        return op.est_rows
+    ins = [_estimate(i) for i in op.inputs]
+    if op.kind == "source":
+        n = len(op.args["batch"])
+    elif op.kind == "read":
+        n = 10_000  # unknown until read; mid-range guess
+    elif op.kind in ("map", "sort", "project"):
+        n = ins[0]
+    elif op.kind in ("filter", "distinct"):
+        n = max(1, ins[0] // 2)
+    elif op.kind == "flat_map":
+        n = ins[0] * 2
+    elif op.kind in ("group_agg", "group_reduce", "group_first_n"):
+        n = max(1, ins[0] // 4)
+    elif op.kind in ("global_agg", "global_reduce"):
+        n = 1
+    elif op.kind == "join":
+        n = max(ins) if ins else 1
+    elif op.kind == "cross":
+        n = ins[0] * ins[1]
+    elif op.kind == "union":
+        n = sum(ins)
+    elif op.kind == "first_n":
+        n = min(ins[0], op.args["n"])
+    else:
+        n = ins[0] if ins else 1
+    op.est_rows = n
+    if op.kind == "join" and op.strategy is None:
+        hint = op.args.get("hint")
+        if hint:
+            op.strategy = hint
+        else:
+            l, r = ins
+            if r * _BROADCAST_RATIO <= l:
+                op.strategy = "broadcast_hash_right"  # build small right side
+            elif l * _BROADCAST_RATIO <= r:
+                op.strategy = "broadcast_hash_left"
+            else:
+                op.strategy = "sort_merge"
+    return n
+
+
+def explain_plan(op: BatchOp, indent: int = 0) -> str:
+    _estimate(op)
+    pad = "  " * indent
+    extra = f" [{op.strategy}]" if op.strategy else ""
+    line = f"{pad}{op.kind}{extra} (est_rows={op.est_rows})"
+    return "\n".join([line] + [explain_plan(i, indent + 1) for i in op.inputs])
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def execute_plan(op: BatchOp) -> RecordBatch:
+    _estimate(op)
+    return _exec(op, {})
+
+
+def _exec(op: BatchOp, memo: Dict[int, RecordBatch]) -> RecordBatch:
+    if id(op) in memo:
+        return memo[id(op)]
+    ins = [_exec(i, memo) for i in op.inputs]
+    out = _DRIVERS[op.kind](op, ins)
+    memo[id(op)] = out
+    return out
+
+
+def _drv_source(op, ins):
+    return op.args["batch"]
+
+
+def _drv_read(op, ins):
+    from flink_tpu.formats import reader_for
+    batches = list(reader_for(op.args["format"])(op.args["path"],
+                                                 **op.args["kw"]))
+    return RecordBatch.concat(batches) if batches else RecordBatch({})
+
+
+def _drv_map(op, ins):
+    b = ins[0]
+    cols = op.args["fn"](dict(b.columns))
+    return RecordBatch({k: np.asarray(v) for k, v in cols.items()},
+                       timestamps=b.timestamps)
+
+
+def _drv_filter(op, ins):
+    b = ins[0]
+    if len(b) == 0:
+        return b
+    mask = np.asarray(op.args["fn"](dict(b.columns)), bool)
+    return b.select(mask)
+
+
+def _drv_flat_map(op, ins):
+    b = ins[0]
+    cols = op.args["fn"](dict(b.columns))
+    if cols is None:
+        return RecordBatch({})
+    return RecordBatch({k: np.asarray(v) for k, v in cols.items()})
+
+
+def _drv_project(op, ins):
+    b = ins[0]
+    return RecordBatch({c: b.column(c) for c in op.args["columns"]},
+                       timestamps=b.timestamps)
+
+
+def _drv_distinct(op, ins):
+    b = ins[0]
+    if len(b) == 0:
+        return b
+    columns = op.args["columns"] or list(b.columns)
+    key = _composite_key(b, columns)
+    _, idx = np.unique(key, return_index=True)
+    return b.take(np.sort(idx))
+
+
+def _drv_sort(op, ins):
+    b = ins[0]
+    if len(b) == 0:
+        return b
+    order = np.argsort(np.asarray(b.column(op.args["column"])), kind="stable")
+    if not op.args["ascending"]:
+        order = order[::-1]
+    return b.take(order)
+
+
+def _drv_first_n(op, ins):
+    b = ins[0]
+    return b.take(np.arange(min(op.args["n"], len(b))))
+
+
+def _drv_union(op, ins):
+    return RecordBatch.concat([b for b in ins if len(b)])
+
+
+def _drv_global_agg(op, ins):
+    b = ins[0]
+    col = np.asarray(b.column(op.args["column"]))
+    how = op.args["how"]
+    val = {"sum": col.sum, "min": col.min, "max": col.max}[how]()
+    return RecordBatch({op.args["column"]: np.asarray([val])})
+
+
+def _drv_global_reduce(op, ins):
+    rows = ins[0].to_rows()
+    if not rows:
+        return RecordBatch({})
+    acc = rows[0]
+    for r in rows[1:]:
+        acc = op.args["fn"](acc, r)
+    return RecordBatch.from_rows([acc])
+
+
+def _group_spans(key: np.ndarray):
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    bounds = np.nonzero(np.concatenate([[True], ks[1:] != ks[:-1]]))[0]
+    spans = [(int(b), int(bounds[j + 1]) if j + 1 < len(bounds) else len(ks))
+             for j, b in enumerate(bounds)]
+    return order, ks, spans
+
+
+def _drv_group_agg(op, ins):
+    b = ins[0]
+    keys_cols = op.args["keys"]
+    how = op.args["how"]
+    if len(b) == 0:
+        return b
+    key = _composite_key(b, keys_cols)
+    uniq, inv = np.unique(key, return_inverse=True)
+    n_groups = len(uniq)
+    out_cols: Dict[str, np.ndarray] = {}
+    # representative key column values (first occurrence per group)
+    first_idx = np.zeros(n_groups, np.int64)
+    first_idx[inv[::-1]] = np.arange(len(b))[::-1]
+    for kc in keys_cols:
+        out_cols[kc] = np.asarray(b.column(kc))[first_idx]
+    if how == "count":
+        out_cols["count"] = np.bincount(inv, minlength=n_groups).astype(np.int64)
+    else:
+        col = np.asarray(b.column(op.args["column"]))
+        if how == "sum":
+            out_cols[op.args["column"]] = np.bincount(
+                inv, weights=col.astype(np.float64), minlength=n_groups
+            ).astype(col.dtype if np.issubdtype(col.dtype, np.floating)
+                     else np.float64)
+        else:
+            # min/max: sorted-segment reduce
+            order, _ks, spans = _group_spans(key)
+            vals = col[order]
+            red = np.minimum.reduceat if how == "min" else np.maximum.reduceat
+            starts = [s for s, _e in spans]
+            seg = red(vals, starts)
+            # spans follow sorted-unique order == uniq order
+            out_cols[op.args["column"]] = seg
+    return RecordBatch(out_cols)
+
+
+def _drv_group_reduce(op, ins):
+    b = ins[0]
+    if len(b) == 0:
+        return b
+    key = _composite_key(b, op.args["keys"])
+    order, _ks, spans = _group_spans(key)
+    rows = b.take(order).to_rows()
+    out_rows = []
+    for s, e in spans:
+        key_vals = tuple(rows[s][kc] for kc in op.args["keys"])
+        res = op.args["fn"](key_vals if len(key_vals) > 1 else key_vals[0],
+                            rows[s:e])
+        if res is not None:
+            out_rows.append(res)
+    return RecordBatch.from_rows(out_rows)
+
+
+def _drv_group_first_n(op, ins):
+    b = ins[0]
+    if len(b) == 0:
+        return b
+    key = _composite_key(b, op.args["keys"])
+    order, _ks, spans = _group_spans(key)
+    keep = np.concatenate([order[s:min(e, s + op.args["n"])]
+                           for s, e in spans]) if spans else np.zeros(0, np.int64)
+    return b.take(np.sort(keep))
+
+
+def _drv_join(op, ins):
+    from flink_tpu.operators.joins import _merge_columns
+
+    l, r = ins
+    how = op.args["how"]
+    lk = _composite_key(l, op.args["left_keys"]) if len(l) else np.zeros(0, np.int64)
+    rk = _composite_key(r, op.args["right_keys"]) if len(r) else np.zeros(0, np.int64)
+    if how == "cogroup":
+        return _cogroup(op, l, r, lk, rk)
+    li, ri = _join_pairs(lk, rk) if len(l) and len(r) else (
+        np.zeros(0, np.int64), np.zeros(0, np.int64))
+    parts = []
+    if li.size:
+        cols = _merge_columns(l, r, li, ri)
+        parts.append(RecordBatch(cols))
+    if how in ("left", "full") and len(l):
+        unmatched = np.setdiff1d(np.arange(len(l)), li)
+        if unmatched.size:
+            cols = {k: np.asarray(v)[unmatched] for k, v in l.columns.items()}
+            for k in r.columns:
+                name = f"r_{k}" if k in cols else k
+                cols[name] = np.full(unmatched.size, None, object)
+            parts.append(RecordBatch(cols))
+    if how in ("right", "full") and len(r):
+        unmatched = np.setdiff1d(np.arange(len(r)), ri)
+        if unmatched.size:
+            cols = {k: np.full(unmatched.size, None, object)
+                    for k in l.columns}
+            for k, v in r.columns.items():
+                name = f"r_{k}" if k in cols else k
+                cols[name] = np.asarray(v)[unmatched]
+            parts.append(RecordBatch(cols))
+    if not parts:
+        return RecordBatch({})
+    out = RecordBatch.concat(parts) if len(parts) > 1 else parts[0]
+    fn = op.args.get("fn")
+    if fn is not None:
+        cols = fn(dict(out.columns))
+        out = RecordBatch({k: np.asarray(v) for k, v in cols.items()})
+    return out
+
+
+def _cogroup(op, l, r, lk, rk):
+    fn = op.args.get("fn")
+    if fn is None:
+        raise ValueError("co_group needs an apply function")
+    out_rows = []
+    for key in np.union1d(np.unique(lk) if lk.size else np.zeros(0, lk.dtype),
+                          np.unique(rk) if rk.size else np.zeros(0, rk.dtype)).tolist():
+        lrows = l.select(lk == key).to_rows() if lk.size else []
+        rrows = r.select(rk == key).to_rows() if rk.size else []
+        res = fn(key, lrows, rrows)
+        if res is not None:
+            out_rows.append(res)
+    return RecordBatch.from_rows(out_rows)
+
+
+def _drv_cross(op, ins):
+    l, r = ins
+    nl, nr = len(l), len(r)
+    if nl == 0 or nr == 0:
+        return RecordBatch({})
+    li = np.repeat(np.arange(nl), nr)
+    ri = np.tile(np.arange(nr), nl)
+    cols = {k: np.asarray(v)[li] for k, v in l.columns.items()}
+    for k, v in r.columns.items():
+        name = f"r_{k}" if k in cols else k
+        cols[name] = np.asarray(v)[ri]
+    return RecordBatch(cols)
+
+
+def _drv_bulk_iterate(op, ins):
+    from flink_tpu.dataset.api import DataSet, ExecutionEnvironment
+
+    env = ExecutionEnvironment()
+    current = ins[0]
+    step = op.args["step"]
+    term = op.args["termination"]
+    for _i in range(op.args["max_iterations"]):
+        ds = DataSet(env, BatchOp("source", {"batch": current}))
+        nxt = step(ds).collect_batch()
+        if term is not None and term(current, nxt):
+            current = nxt
+            break
+        current = nxt
+    return current
+
+
+def _drv_delta_iterate(op, ins):
+    from flink_tpu.dataset.api import DataSet, ExecutionEnvironment
+
+    env = ExecutionEnvironment()
+    solution, workset = ins
+    key_col = op.args["key_column"]
+    step = op.args["step"]
+    for _i in range(op.args["max_iterations"]):
+        if len(workset) == 0:
+            break
+        s_ds = DataSet(env, BatchOp("source", {"batch": solution}))
+        w_ds = DataSet(env, BatchOp("source", {"batch": workset}))
+        delta_ds, next_w_ds = step(s_ds, w_ds)
+        delta = delta_ds.collect_batch()
+        workset = next_w_ds.collect_batch()
+        if len(delta):
+            # merge delta into solution set by key (UPSERT semantics)
+            skeys = np.asarray(solution.column(key_col))
+            dkeys = np.asarray(delta.column(key_col))
+            keep = ~np.isin(skeys, dkeys)
+            solution = RecordBatch.concat([solution.select(keep), delta])
+    return solution
+
+
+_DRIVERS = {
+    "source": _drv_source,
+    "read": _drv_read,
+    "map": _drv_map,
+    "filter": _drv_filter,
+    "flat_map": _drv_flat_map,
+    "project": _drv_project,
+    "distinct": _drv_distinct,
+    "sort": _drv_sort,
+    "first_n": _drv_first_n,
+    "union": _drv_union,
+    "global_agg": _drv_global_agg,
+    "global_reduce": _drv_global_reduce,
+    "group_agg": _drv_group_agg,
+    "group_reduce": _drv_group_reduce,
+    "group_first_n": _drv_group_first_n,
+    "join": _drv_join,
+    "cross": _drv_cross,
+    "bulk_iterate": _drv_bulk_iterate,
+    "delta_iterate": _drv_delta_iterate,
+}

@@ -397,6 +397,9 @@ class JoinBuilder:
         assigner = getattr(self, "_assigner", None)
         if assigner is None:
             raise ValueError("join needs .window(...)")
+        if self.cogroup and fn is None:
+            raise ValueError("co_group needs an apply function "
+                             "fn(key, window, left_rows, right_rows)")
         lk, rk, cg = self._left_key, self._right_key, self.cogroup
         t = Transformation(
             name=name,

@@ -1,0 +1,3 @@
+from flink_tpu.graph_lib.graph import Graph
+
+__all__ = ["Graph"]

@@ -1,0 +1,227 @@
+"""Graph library — the Gelly analog, TPU-native.
+
+The reference's Gelly (``flink-libraries/flink-gelly``, ~60k LoC of
+DataSet-based graph algorithms + iteration abstractions) re-designed as
+dense array programs: a graph is (num_vertices, edge src[int32], edge
+dst[int32], optional edge weights), algorithms are ``jax.ops.segment_sum``
+message passing inside jitted supersteps — the scatter-gather /
+vertex-centric model (``spargel``) IS one segment-sum per superstep on TPU.
+
+Algorithms: PageRank, connected components (label propagation), SSSP
+(Bellman-Ford style relaxation), triangle count, degrees, plus the generic
+``scatter_gather`` harness the rest are built on.  Interop with the DataSet
+API both ways (``from_dataset`` / ``as_dataset``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Graph:
+    def __init__(self, num_vertices: int, src: np.ndarray, dst: np.ndarray,
+                 weights: Optional[np.ndarray] = None):
+        self.n = int(num_vertices)
+        self.src = jnp.asarray(src, jnp.int32)
+        self.dst = jnp.asarray(dst, jnp.int32)
+        self.weights = (jnp.asarray(weights, jnp.float32)
+                        if weights is not None else None)
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_edges(edges, num_vertices: Optional[int] = None,
+                   weights=None) -> "Graph":
+        e = np.asarray(edges, np.int64)
+        n = num_vertices if num_vertices is not None else (int(e.max()) + 1
+                                                           if e.size else 0)
+        return Graph(n, e[:, 0], e[:, 1], weights)
+
+    @staticmethod
+    def from_dataset(ds, src_column: str = "src", dst_column: str = "dst",
+                     weight_column: Optional[str] = None,
+                     num_vertices: Optional[int] = None) -> "Graph":
+        b = ds.collect_batch()
+        src = np.asarray(b.column(src_column))
+        dst = np.asarray(b.column(dst_column))
+        n = num_vertices if num_vertices is not None else (
+            int(max(src.max(), dst.max())) + 1 if len(b) else 0)
+        w = np.asarray(b.column(weight_column)) if weight_column else None
+        return Graph(n, src, dst, w)
+
+    def as_dataset(self):
+        from flink_tpu.dataset import ExecutionEnvironment
+        env = ExecutionEnvironment()
+        cols = {"src": np.asarray(self.src), "dst": np.asarray(self.dst)}
+        if self.weights is not None:
+            cols["weight"] = np.asarray(self.weights)
+        return env.from_columns(cols)
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    def undirected(self) -> "Graph":
+        """Add reverse edges (``Graph.getUndirected``)."""
+        return Graph(self.n,
+                     jnp.concatenate([self.src, self.dst]),
+                     jnp.concatenate([self.dst, self.src]),
+                     None if self.weights is None
+                     else jnp.concatenate([self.weights, self.weights]))
+
+    # -- degrees -------------------------------------------------------------
+    def out_degrees(self) -> np.ndarray:
+        return np.asarray(jax.ops.segment_sum(
+            jnp.ones_like(self.src, jnp.int32), self.src, self.n))
+
+    def in_degrees(self) -> np.ndarray:
+        return np.asarray(jax.ops.segment_sum(
+            jnp.ones_like(self.dst, jnp.int32), self.dst, self.n))
+
+    # -- generic scatter-gather (vertex-centric supersteps) ------------------
+    def scatter_gather(self, initial_values: np.ndarray,
+                       message_fn: Callable,
+                       combine: str,
+                       update_fn: Callable,
+                       max_supersteps: int,
+                       converged: Optional[Callable] = None) -> np.ndarray:
+        """Vertex-centric iteration (``ScatterGatherIteration`` analog).
+
+        Per superstep (one jitted step): ``msgs = message_fn(values[src],
+        weights)`` scattered to dst with ``combine`` (sum/min/max), then
+        ``values' = update_fn(values, combined)``. Stops at
+        ``max_supersteps`` or when ``converged(old, new)`` is True.
+        """
+        seg = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+               "max": jax.ops.segment_max}[combine]
+
+        @jax.jit
+        def superstep(values):
+            msgs = message_fn(values[self.src], self.weights)
+            combined = seg(msgs, self.dst, self.n)
+            return update_fn(values, combined)
+
+        values = jnp.asarray(initial_values)
+        for _ in range(max_supersteps):
+            new = superstep(values)
+            if converged is not None and bool(converged(values, new)):
+                values = new
+                break
+            values = new
+        return np.asarray(values)
+
+    # -- algorithms ----------------------------------------------------------
+    def pagerank(self, damping: float = 0.85, num_iterations: int = 30,
+                 tol: float = 0.0) -> np.ndarray:
+        """Power iteration with dangling-mass redistribution (``PageRank``)."""
+        n = self.n
+        out_deg = jnp.asarray(self.out_degrees(), jnp.float32)
+        dangling = out_deg == 0
+        safe_deg = jnp.where(dangling, 1.0, out_deg)
+
+        @jax.jit
+        def step(ranks):
+            contrib = ranks / safe_deg
+            spread = jax.ops.segment_sum(contrib[self.src], self.dst, n)
+            dangling_mass = jnp.sum(jnp.where(dangling, ranks, 0.0))
+            return ((1.0 - damping) / n
+                    + damping * (spread + dangling_mass / n))
+
+        ranks = jnp.full(n, 1.0 / n, jnp.float32)
+        for _ in range(num_iterations):
+            new = step(ranks)
+            if tol and float(jnp.abs(new - ranks).sum()) < tol:
+                ranks = new
+                break
+            ranks = new
+        return np.asarray(ranks)
+
+    def connected_components(self, max_supersteps: int = 0) -> np.ndarray:
+        """Min-label propagation over the undirected graph
+        (``ConnectedComponents`` delta-iteration analog)."""
+        g = self.undirected()
+        steps = max_supersteps or self.n
+
+        def msg(vals, _w):
+            return vals
+
+        def update(vals, combined):
+            return jnp.minimum(vals, combined)
+
+        return g.scatter_gather(
+            jnp.arange(self.n, dtype=jnp.int32), msg, "min", update, steps,
+            converged=lambda a, b: bool(jnp.array_equal(a, b)))
+
+    def sssp(self, source: int, num_iterations: int = 0) -> np.ndarray:
+        """Single-source shortest paths (``SingleSourceShortestPaths``):
+        Bellman-Ford relaxation, one segment_min per superstep."""
+        inf = jnp.float32(jnp.inf)
+        w = (self.weights if self.weights is not None
+             else jnp.ones_like(self.src, jnp.float32))
+        dist0 = jnp.full(self.n, inf, jnp.float32).at[source].set(0.0)
+        steps = num_iterations or self.n
+
+        def msg(vals, weights):
+            return vals + weights
+
+        def update(vals, combined):
+            return jnp.minimum(vals, combined)
+
+        def message_fn(src_vals, weights):
+            return msg(src_vals, w)
+
+        return self.scatter_gather(
+            dist0, message_fn, "min", update, steps,
+            converged=lambda a, b: bool(jnp.array_equal(a, b)))
+
+    def triangle_count(self) -> int:
+        """Total triangles (``TriangleEnumerator`` analog): dense adjacency
+        trace(A^3)/6 for small graphs, neighbor-set intersection otherwise."""
+        n = self.n
+        if n <= 2048:
+            a = jnp.zeros((n, n), jnp.float32)
+            a = a.at[self.src, self.dst].set(1.0)
+            a = a.at[self.dst, self.src].set(1.0)
+            a = a * (1.0 - jnp.eye(n))  # drop self loops
+            # MXU path: two matmuls + trace
+            t = jnp.trace(a @ a @ a)
+            return int(round(float(t) / 6.0))
+        # host fallback: sorted adjacency intersection
+        src = np.asarray(self.src)
+        dst = np.asarray(self.dst)
+        adj = {}
+        for s, d in zip(src.tolist(), dst.tolist()):
+            if s == d:
+                continue
+            adj.setdefault(s, set()).add(d)
+            adj.setdefault(d, set()).add(s)
+        count = 0
+        for v, nbrs in adj.items():
+            for u in nbrs:
+                if u > v:
+                    count += len(nbrs & adj.get(u, set())
+                                 & {x for x in adj.get(u, set()) if x > u})
+        return count
+
+    def label_propagation(self, initial_labels: np.ndarray,
+                          num_iterations: int = 10) -> np.ndarray:
+        """Community detection by iterated max-label adoption
+        (``LabelPropagation`` analog, deterministic max tie-break)."""
+        g = self.undirected()
+
+        def msg(vals, _w):
+            return vals
+
+        def update(vals, combined):
+            # adopt the max neighbor label (0 in-degree keeps its own)
+            has_nb = combined > jnp.iinfo(jnp.int32).min
+            return jnp.where(has_nb, jnp.maximum(vals, combined), vals)
+
+        return g.scatter_gather(
+            jnp.asarray(initial_labels, jnp.int32), msg, "max", update,
+            num_iterations,
+            converged=lambda a, b: bool(jnp.array_equal(a, b)))

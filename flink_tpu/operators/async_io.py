@@ -13,6 +13,7 @@ across a watermark (the reference's watermark fencing).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -39,13 +40,18 @@ class AsyncFunction:
 
 
 class _Entry:
-    __slots__ = ("future", "batch", "is_watermark", "watermark")
+    __slots__ = ("future", "batch", "is_watermark", "watermark", "deadline")
 
-    def __init__(self, future=None, batch=None, watermark=None):
+    def __init__(self, future=None, batch=None, watermark=None,
+                 deadline: float = 0.0):
         self.future = future
         self.batch = batch
         self.is_watermark = watermark is not None
         self.watermark = watermark
+        #: absolute monotonic deadline; the timeout clock starts at
+        #: SUBMISSION (reference registers the timer on asyncInvoke), not at
+        #: drain time
+        self.deadline = deadline
 
 
 class AsyncWaitOperator(StreamOperator):
@@ -87,7 +93,8 @@ class AsyncWaitOperator(StreamOperator):
             out.extend(self._drain(wait_one=True))
         self._queue.append(_Entry(
             future=self._pool.submit(self.fn.invoke, dict(batch.columns)),
-            batch=batch))
+            batch=batch,
+            deadline=time.monotonic() + self.timeout_ms / 1000.0))
         out.extend(self._drain())
         return out
 
@@ -108,8 +115,9 @@ class AsyncWaitOperator(StreamOperator):
 
     # -- emission ------------------------------------------------------------
     def _result(self, entry: _Entry) -> Optional[RecordBatch]:
+        remaining = max(0.0, entry.deadline - time.monotonic())
         try:
-            cols = entry.future.result(timeout=self.timeout_ms / 1000.0)
+            cols = entry.future.result(timeout=remaining)
         except cf.TimeoutError:
             entry.future.cancel()
             cols = self.fn.timeout(dict(entry.batch.columns))
@@ -127,7 +135,8 @@ class AsyncWaitOperator(StreamOperator):
                 out.append(head.watermark)
                 continue
             if self.ordered:
-                if not head.future.done() and not wait_one:
+                expired = time.monotonic() >= head.deadline
+                if not head.future.done() and not wait_one and not expired:
                     break
                 self._queue.pop(0)
                 res = self._result(head)
@@ -161,18 +170,27 @@ class AsyncWaitOperator(StreamOperator):
                 wait_one = False
         return out
 
-    #: note on checkpoints: in-flight batches are part of the snapshot so a
-    #: restore re-submits them (the reference persists the queue the same way)
+    #: note on checkpoints: the WHOLE queue is part of the snapshot — batches
+    #: re-submit on restore, and fenced watermarks must survive too (this
+    #: operator is their only forwarder: forwards_watermarks is False)
     def snapshot_state(self) -> Dict[str, Any]:
-        pending = [e.batch for e in self._queue if not e.is_watermark]
-        return {"pending": [{"columns": {k: np.asarray(v)
-                                         for k, v in b.columns.items()},
-                             "timestamps": None if b.timestamps is None
-                             else np.asarray(b.timestamps)}
-                            for b in pending]}
+        entries = []
+        for e in self._queue:
+            if e.is_watermark:
+                entries.append({"watermark": e.watermark.timestamp})
+            else:
+                entries.append({"columns": {k: np.asarray(v)
+                                            for k, v in e.batch.columns.items()},
+                                "timestamps": None if e.batch.timestamps is None
+                                else np.asarray(e.batch.timestamps)})
+        return {"queue": entries}
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        for b in snap.get("pending", []):
-            self._queue.append(_Entry(
-                future=self._pool.submit(self.fn.invoke, dict(b["columns"])),
-                batch=RecordBatch(b["columns"], timestamps=b["timestamps"])))
+        for e in snap.get("queue", snap.get("pending", [])):
+            if "watermark" in e:
+                self._queue.append(_Entry(watermark=Watermark(e["watermark"])))
+            else:
+                self._queue.append(_Entry(
+                    future=self._pool.submit(self.fn.invoke, dict(e["columns"])),
+                    batch=RecordBatch(e["columns"], timestamps=e["timestamps"]),
+                    deadline=time.monotonic() + self.timeout_ms / 1000.0))

@@ -278,7 +278,6 @@ class SideOutputOperator(StreamOperator):
     def __init__(self, tag: str, name: str = "side-output"):
         self.accepts_tag = tag
         self.name = name
-        self.chainable = False
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         return []  # main-stream data does not pass

@@ -34,10 +34,19 @@ class CoMapOperator(StreamOperator):
     def process_batch2(self, batch: RecordBatch,
                        input_index: int) -> List[StreamElement]:
         cols = self.fns[input_index](dict(batch.columns))
-        return [RecordBatch(cols, batch.timestamps)]
+        return [_with_ts(cols, batch)]
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         return self.process_batch2(batch, 0)
+
+
+def _with_ts(cols: Dict[str, Any], src_batch: RecordBatch) -> RecordBatch:
+    """Rebuild a batch, keeping event-time timestamps when the fn preserved
+    the row count (a size-changing fn cannot inherit per-row times)."""
+    out = RecordBatch({k: np.asarray(v) for k, v in cols.items()})
+    if src_batch.timestamps is not None and len(out) == len(src_batch):
+        out = out.with_timestamps(np.asarray(src_batch.timestamps))
+    return out
 
 
 class CoFlatMapOperator(StreamOperator):
@@ -55,7 +64,7 @@ class CoFlatMapOperator(StreamOperator):
         cols = self.fns[input_index](dict(batch.columns))
         if cols is None:
             return []
-        return [RecordBatch({k: np.asarray(v) for k, v in cols.items()})]
+        return [_with_ts(cols, batch)]
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         return self.process_batch2(batch, 0)
@@ -97,7 +106,7 @@ class CoProcessOperator(StreamOperator):
         out = handler(dict(batch.columns), self)
         if out is None:
             return []
-        return [RecordBatch({k: np.asarray(v) for k, v in out.items()})]
+        return [_with_ts(out, batch)]
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         return self.process_batch2(batch, 0)
@@ -158,8 +167,7 @@ class BroadcastConnectOperator(StreamOperator):
                                     self.broadcast_state, self)
         if out is None:
             return []
-        return [RecordBatch({k: np.asarray(v) for k, v in out.items()},
-                            batch.timestamps)]
+        return [_with_ts(out, batch)]
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         return self.process_batch2(batch, 0)

@@ -19,7 +19,7 @@ from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
                                   Watermark)
 from flink_tpu.operators.base import StreamOperator
 from flink_tpu.windowing.assigners import WindowAssigner
-from flink_tpu.windowing.evictors import DeltaEvictor, Evictor
+from flink_tpu.windowing.evictors import Evictor
 
 
 class EvictingWindowOperator(StreamOperator):
@@ -107,11 +107,10 @@ class EvictingWindowOperator(StreamOperator):
             if self.evictor is None:
                 rows = [e[2] for e in entries]
             else:
-                if isinstance(self.evictor, DeltaEvictor):
-                    self.evictor.bind_values(np.asarray(
-                        [e[2][self.evictor.value_column] for e in entries]))
-                keep = self.evictor.keep_mask(ts, bounds.max_timestamp)
-                rows = [e[2] for e, m in zip(entries, keep) if m]
+                all_rows = [e[2] for e in entries]
+                keep = self.evictor.keep_mask(ts, bounds.max_timestamp,
+                                              rows=all_rows)
+                rows = [r for r, m in zip(all_rows, keep) if m]
             res = self.apply_fn(k, bounds, rows)
             if res is not None:
                 out_rows.append(res)

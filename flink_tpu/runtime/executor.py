@@ -143,7 +143,7 @@ class LocalExecutor:
             if len(el):
                 if rv.io is not None:
                     rv.io.records_in.inc(len(el))
-                if op.is_two_input:
+                if getattr(op, "is_two_input", False):
                     self._route(rv, op.process_batch2(el, input_index))
                 else:
                     self._route(rv, op.process_batch(el))

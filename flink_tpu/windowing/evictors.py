@@ -12,8 +12,11 @@ import numpy as np
 
 
 class Evictor:
-    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int) -> np.ndarray:
-        """bool[n] over rows sorted by arrival order: True = keep."""
+    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int,
+                  rows=None) -> np.ndarray:
+        """bool[n] over rows sorted by arrival order: True = keep.
+        ``rows`` is the window's buffered row dicts (same order) so
+        value-inspecting evictors need no side channel."""
         raise NotImplementedError
 
 
@@ -27,7 +30,8 @@ class CountEvictor(Evictor):
     def of(n: int) -> "CountEvictor":
         return CountEvictor(n)
 
-    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int) -> np.ndarray:
+    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int,
+                  rows=None) -> np.ndarray:
         m = np.zeros(len(timestamps), bool)
         m[max(0, len(timestamps) - self.n):] = True
         return m
@@ -43,7 +47,8 @@ class TimeEvictor(Evictor):
     def of(window_ms: int) -> "TimeEvictor":
         return TimeEvictor(window_ms)
 
-    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int) -> np.ndarray:
+    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int,
+                  rows=None) -> np.ndarray:
         ts = np.asarray(timestamps, np.int64)
         if ts.size == 0:
             return np.zeros(0, bool)
@@ -51,22 +56,19 @@ class TimeEvictor(Evictor):
 
 class DeltaEvictor(Evictor):
     """Keep rows whose value is within ``threshold`` of the newest row's
-    value (``DeltaEvictor`` analog); needs the operator to pass values via
-    ``bind_values``."""
+    value (``DeltaEvictor`` analog)."""
 
     def __init__(self, threshold: float, value_column: str):
         self.threshold = threshold
         self.value_column = value_column
-        self._values: np.ndarray | None = None
 
     @staticmethod
     def of(threshold: float, value_column: str) -> "DeltaEvictor":
         return DeltaEvictor(threshold, value_column)
 
-    def bind_values(self, values: np.ndarray) -> None:
-        self._values = np.asarray(values, np.float64)
-
-    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int) -> np.ndarray:
-        if self._values is None or self._values.size == 0:
+    def keep_mask(self, timestamps: np.ndarray, window_max_ts: int,
+                  rows=None) -> np.ndarray:
+        if not rows:
             return np.ones(len(timestamps), bool)
-        return np.abs(self._values - self._values[-1]) <= self.threshold
+        values = np.asarray([r[self.value_column] for r in rows], np.float64)
+        return np.abs(values - values[-1]) <= self.threshold

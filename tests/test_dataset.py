@@ -1,0 +1,167 @@
+"""DataSet batch API: transforms, grouping, joins, optimizer strategies,
+BSP iterations."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.dataset import DataSet, ExecutionEnvironment
+
+
+@pytest.fixture
+def env():
+    return ExecutionEnvironment.get_execution_environment()
+
+
+def test_map_filter_project(env):
+    out = (env.generate_sequence(1, 10)
+           .map(lambda c: {"value": c["value"], "sq": np.asarray(c["value"]) ** 2})
+           .filter(lambda c: np.asarray(c["sq"]) % 2 == 0)
+           .project("sq")
+           .collect())
+    assert [r["sq"] for r in out] == [4, 16, 36, 64, 100]
+
+
+def test_group_by_aggregations(env):
+    ds = env.from_columns({"k": [1, 2, 1, 2, 1], "v": [10., 20., 30., 40., 50.]})
+    sums = {r["k"]: r["v"] for r in ds.group_by("k").sum("v").collect()}
+    assert sums == {1: 90.0, 2: 60.0}
+    mins = {r["k"]: r["v"] for r in ds.group_by("k").min("v").collect()}
+    assert mins == {1: 10.0, 2: 20.0}
+    counts = {r["k"]: r["count"] for r in ds.group_by("k").count().collect()}
+    assert counts == {1: 3, 2: 2}
+
+
+def test_group_by_composite_key(env):
+    ds = env.from_columns({"a": [1, 1, 2], "b": [1, 1, 1], "v": [5., 6., 7.]})
+    out = {(r["a"], r["b"]): r["v"]
+           for r in ds.group_by("a", "b").sum("v").collect()}
+    assert out == {(1, 1): 11.0, (2, 1): 7.0}
+
+
+def test_group_reduce_and_first_n(env):
+    ds = env.from_columns({"k": [1, 1, 1, 2], "v": [3., 1., 2., 9.]})
+    out = (ds.group_by("k")
+           .reduce_group(lambda k, rows: {"k": k, "n": len(rows),
+                                          "tot": sum(r["v"] for r in rows)})
+           .collect())
+    got = {r["k"]: (r["n"], r["tot"]) for r in out}
+    assert got == {1: (3, 6.0), 2: (1, 9.0)}
+    topn = ds.sort_partition("v").group_by("k").first_n(2).collect()
+    assert len(topn) == 3
+
+
+def test_distinct_sort_first(env):
+    ds = env.from_columns({"x": [3, 1, 2, 3, 1]})
+    assert sorted(r["x"] for r in ds.distinct().collect()) == [1, 2, 3]
+    assert [r["x"] for r in ds.sort_partition("x").first_n(2).collect()] == [1, 1]
+
+
+def test_inner_join(env):
+    users = env.from_columns({"uid": [1, 2, 3], "name": np.asarray(["a", "b", "c"], object)})
+    orders = env.from_columns({"uid": [1, 1, 3], "amt": [10., 20., 30.]})
+    out = (orders.join(users).where("uid").equal_to("uid").apply().collect())
+    got = sorted((r["name"], r["amt"]) for r in out)
+    assert got == [("a", 10.0), ("a", 20.0), ("c", 30.0)]
+
+
+def test_outer_joins(env):
+    l = env.from_columns({"k": [1, 2], "lv": [10., 20.]})
+    r = env.from_columns({"k": [2, 3], "rv": [200., 300.]})
+    left = (l.left_outer_join(r).where("k").equal_to("k").apply().collect())
+    assert len(left) == 2
+    unmatched = [x for x in left if x["lv"] == 10.0][0]
+    assert unmatched["rv"] is None
+    full = (l.full_outer_join(r).where("k").equal_to("k").apply().collect())
+    assert len(full) == 3
+
+
+def test_cogroup(env):
+    l = env.from_columns({"k": [1, 1, 2], "v": [1., 2., 3.]})
+    r = env.from_columns({"k": [2, 3], "w": [9., 8.]})
+    out = (l.co_group(r).where("k").equal_to("k")
+           .apply(lambda k, lr, rr: {"k": k, "nl": len(lr), "nr": len(rr)})
+           .collect())
+    got = {r["k"]: (r["nl"], r["nr"]) for r in out}
+    assert got == {1: (2, 0), 2: (1, 1), 3: (0, 1)}
+
+
+def test_cross_and_union(env):
+    a = env.from_columns({"x": [1, 2]})
+    b = env.from_columns({"y": [10, 20, 30]})
+    assert len(a.cross(b).collect()) == 6
+    assert len(a.union(a).collect()) == 4
+
+
+def test_optimizer_broadcast_choice(env):
+    big = env.from_columns({"k": np.arange(1000) % 10, "v": np.ones(1000)})
+    small = env.from_columns({"k": np.arange(10), "name": np.arange(10)})
+    joined = big.join(small).where("k").equal_to("k").apply()
+    plan = joined.explain()
+    assert "broadcast_hash_right" in plan
+    # hint overrides
+    hinted = (big.join(small).where("k").equal_to("k")
+              .with_hint("sort_merge").apply())
+    assert "sort_merge" in hinted.explain()
+    assert len(joined.collect()) == 1000
+
+
+def test_bulk_iteration_converges(env):
+    # Newton iteration for sqrt(2) per row
+    start = env.from_columns({"x": [1.0, 3.0]})
+
+    def step(ds):
+        return ds.map(lambda c: {"x": (np.asarray(c["x"]) + 2 / np.asarray(c["x"])) / 2})
+
+    out = start.iterate(50, step,
+                        termination=lambda prev, nxt: bool(
+                            np.allclose(np.asarray(prev.column("x")),
+                                        np.asarray(nxt.column("x"))))).collect()
+    assert np.allclose([r["x"] for r in out], np.sqrt(2))
+
+
+def test_delta_iteration_connected_components_style(env):
+    # min-label propagation on a tiny chain graph 0-1-2, 3-4
+    edges = [(0, 1), (1, 2), (3, 4)]
+    neighbors = {n: set() for n in range(5)}
+    for a, b in edges:
+        neighbors[a].add(b)
+        neighbors[b].add(a)
+
+    solution = env.from_columns({"v": np.arange(5), "label": np.arange(5)})
+    workset = env.from_columns({"v": np.arange(5), "label": np.arange(5)})
+
+    def step(sol_ds, work_ds):
+        sol = sol_ds.collect_batch()
+        work = work_ds.collect_batch()
+        labels = {int(v): int(l) for v, l in
+                  zip(np.asarray(sol.column("v")), np.asarray(sol.column("label")))}
+        changed = {}
+        for v, l in zip(np.asarray(work.column("v")).tolist(),
+                        np.asarray(work.column("label")).tolist()):
+            for nb in neighbors[v]:
+                if l < labels.get(nb, 1 << 30) and l < changed.get(nb, 1 << 30):
+                    changed[nb] = l
+        env2 = ExecutionEnvironment()
+        delta = env2.from_columns(
+            {"v": np.asarray(list(changed.keys()), np.int64),
+             "label": np.asarray(list(changed.values()), np.int64)})
+        return delta, delta
+
+    out = solution.delta_iterate(workset, "v", 10, step).collect()
+    labels = {r["v"]: r["label"] for r in out}
+    assert labels == {0: 0, 1: 0, 2: 0, 3: 3, 4: 3}
+
+
+def test_global_agg_and_reduce(env):
+    ds = env.from_columns({"v": [1., 2., 3.]})
+    assert ds.sum("v").collect()[0]["v"] == 6.0
+    assert ds.max("v").collect()[0]["v"] == 3.0
+    red = ds.reduce(lambda a, b: {"v": a["v"] + b["v"]}).collect()
+    assert red[0]["v"] == 6.0
+
+
+def test_file_roundtrip(env, tmp_path):
+    p = str(tmp_path / "out.csv")
+    env.from_columns({"a": [1, 2, 3], "b": [1., 2., 3.]}).write_file(p)
+    back = env.read_file(p, format="csv").collect()
+    assert [r["a"] for r in back] == [1, 2, 3]

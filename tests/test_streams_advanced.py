@@ -396,3 +396,79 @@ def test_evicting_window_allowed_lateness_refire():
     out = op.process_batch(RecordBatch({"k": np.array([1])},
                                        timestamps=np.array([30])))
     assert out == []
+
+
+def test_async_snapshot_preserves_fenced_watermark():
+    """Regression: a watermark queued behind in-flight work must survive a
+    checkpoint (this operator is its only forwarder)."""
+    import time as _t
+
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.async_io import AsyncWaitOperator
+
+    def slow(cols):
+        _t.sleep(0.2)
+        return cols
+
+    op = AsyncWaitOperator(slow, ordered=True)
+    op.open(RuntimeContext())
+    op.process_batch(RecordBatch({"x": np.array([1])},
+                                 timestamps=np.array([5])))
+    op.process_watermark(Watermark(50))
+    snap = op.snapshot_state()
+    op.close()
+    op2 = AsyncWaitOperator(lambda c: c, ordered=True)
+    op2.open(RuntimeContext())
+    op2.restore_state(snap)
+    out = op2.end_input()
+    op2.close()
+    assert any(isinstance(e, Watermark) and e.timestamp == 50 for e in out)
+    assert any(isinstance(e, RecordBatch) for e in out)
+
+
+def test_broadcast_connect_row_filtering_keeps_working():
+    """Regression: a broadcast fn that changes the row count must not crash
+    on timestamp re-attachment."""
+    from flink_tpu.operators.co import BroadcastProcessFunction
+
+    class Allow(BroadcastProcessFunction):
+        def process_broadcast_batch(self, cols, state, ctx):
+            state["allowed"] = set(np.asarray(cols["k"]).tolist())
+
+        def process_batch(self, cols, state, ctx):
+            k = np.asarray(cols["k"])
+            keep = np.isin(k, list(state.get("allowed", ())))
+            return {"k": k[keep]}
+
+    env = _env()
+    rules = env.from_collection(columns={"k": np.array([2])})
+    main = (env.from_collection(columns={"k": np.array([1, 2, 3]),
+                                         "t": np.array([10, 20, 30])})
+            .assign_timestamps_and_watermarks(0, timestamp_column="t"))
+    out = main.connect_broadcast(rules, Allow()).execute_and_collect()
+    assert [r["k"] for r in out] == [2]
+
+
+def test_cogroup_without_fn_raises_eagerly():
+    env = _env()
+    a = env.from_collection(columns={"k": np.array([1]), "t": np.array([1])})
+    b = env.from_collection(columns={"k": np.array([1]), "t": np.array([1])})
+    with pytest.raises(ValueError, match="co_group"):
+        (a.co_group(b).where("k").equal_to("k")
+         .window(TumblingEventTimeWindows.of(10)).apply())
+
+
+def test_delta_evictor_via_rows_protocol():
+    from flink_tpu.windowing.evictors import DeltaEvictor
+
+    env = _env()
+    out = (env.from_collection(columns={"k": np.zeros(4, np.int64),
+                                        "v": np.array([1., 9., 10., 11.]),
+                                        "t": np.array([10, 20, 30, 40])})
+           .assign_timestamps_and_watermarks(0, timestamp_column="t")
+           .key_by("k")
+           .window(TumblingEventTimeWindows.of(100))
+           .evictor(DeltaEvictor.of(2.0, "v"))
+           .apply(lambda k, w, rows: {"s": sum(r["v"] for r in rows)})
+           .execute_and_collect())
+    assert [r["s"] for r in out] == [30.0]   # 9+10+11 within delta of last=11
