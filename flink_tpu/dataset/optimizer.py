@@ -38,16 +38,19 @@ def _composite_key(batch: RecordBatch, columns: List[str]) -> np.ndarray:
     if len(columns) == 1:
         return np.asarray(batch.column(columns[0]))
     parts = [np.asarray(batch.column(c)) for c in columns]
-    if all(np.issubdtype(p.dtype, np.integer) for p in parts):
-        # pack small ints; fall back to strings on overflow risk
-        out = parts[0].astype(np.int64)
-        ok = True
-        for p in parts[1:]:
-            if (np.abs(out) > 1 << 31).any() or (np.abs(p) > 1 << 31).any():
-                ok = False
-                break
-            out = out * ((1 << 31) - 1) + p.astype(np.int64)
-        if ok:
+    if all(np.issubdtype(p.dtype, np.integer) for p in parts) and \
+            all(p.size for p in parts):
+        # radix packing: shift each column into its own value range so the
+        # mapping is injective; fall back to strings if int64 would overflow
+        mins = [int(p.min()) for p in parts]
+        ranges = [int(p.max()) - m + 1 for p, m in zip(parts, mins)]
+        total = 1
+        for r in ranges:
+            total *= r
+        if total < (1 << 62):
+            out = np.zeros(len(parts[0]), np.int64)
+            for p, m, r in zip(parts, mins, ranges):
+                out = out * np.int64(r) + (p.astype(np.int64) - np.int64(m))
             return out
     return np.asarray(["\x00".join(str(x) for x in row)
                        for row in zip(*[p.tolist() for p in parts])], object)

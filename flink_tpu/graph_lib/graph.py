@@ -36,7 +36,7 @@ class Graph:
     @staticmethod
     def from_edges(edges, num_vertices: Optional[int] = None,
                    weights=None) -> "Graph":
-        e = np.asarray(edges, np.int64)
+        e = np.asarray(edges, np.int64).reshape(-1, 2)
         n = num_vertices if num_vertices is not None else (int(e.max()) + 1
                                                            if e.size else 0)
         return Graph(n, e[:, 0], e[:, 1], weights)
@@ -183,13 +183,15 @@ class Graph:
         trace(A^3)/6 for small graphs, neighbor-set intersection otherwise."""
         n = self.n
         if n <= 2048:
-            a = jnp.zeros((n, n), jnp.float32)
-            a = a.at[self.src, self.dst].set(1.0)
-            a = a.at[self.dst, self.src].set(1.0)
-            a = a * (1.0 - jnp.eye(n))  # drop self loops
-            # MXU path: two matmuls + trace
-            t = jnp.trace(a @ a @ a)
-            return int(round(float(t) / 6.0))
+            # float64 on host: a float32 MXU trace loses exactness past
+            # 2^24 triangles; counts must be exact
+            a = np.zeros((n, n), np.float64)
+            src_np, dst_np = np.asarray(self.src), np.asarray(self.dst)
+            a[src_np, dst_np] = 1.0
+            a[dst_np, src_np] = 1.0
+            np.fill_diagonal(a, 0.0)  # drop self loops
+            t = np.trace(a @ a @ a)
+            return int(round(t / 6.0))
         # host fallback: sorted adjacency intersection
         src = np.asarray(self.src)
         dst = np.asarray(self.dst)

@@ -147,8 +147,10 @@ class AsyncWaitOperator(StreamOperator):
                 # unordered: emit ANY completed entry up to the next fence
                 fence = next((i for i, e in enumerate(self._queue)
                               if e.is_watermark), len(self._queue))
+                now = time.monotonic()
                 done = [i for i in range(fence)
-                        if self._queue[i].future.done()]
+                        if self._queue[i].future.done()
+                        or now >= self._queue[i].deadline]
                 if not done and wait_one and fence > 0:
                     # waits up to the timeout and applies the fn.timeout
                     # replacement hook — same semantics as ordered mode

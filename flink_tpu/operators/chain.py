@@ -76,6 +76,20 @@ class ChainedOperator(StreamOperator):
         return {f"op{i}": op.snapshot_state() for i, op in enumerate(self.operators)}
 
     def restore_state(self, snapshot: Dict[str, Any]) -> None:
+        if not any(f"op{i}" in snapshot for i in range(len(self.operators))):
+            # flat KEYED snapshot (e.g. a bootstrapped savepoint from the
+            # state processor API): hand it to the chain's single
+            # keyed-stateful member (the one owning a keyed backend/index)
+            keyed = [op for op in self.operators
+                     if hasattr(op, "backend") or hasattr(op, "key_index")]
+            if len(keyed) == 1:
+                keyed[0].restore_state(snapshot)
+                return
+            if snapshot:
+                raise ValueError(
+                    f"chain {self.name!r}: flat snapshot cannot be attributed "
+                    f"({len(keyed)} keyed-stateful members); write the "
+                    f"savepoint with per-member op0/op1/... structure")
         for i, op in enumerate(self.operators):
             if f"op{i}" in snapshot:
                 op.restore_state(snapshot[f"op{i}"])

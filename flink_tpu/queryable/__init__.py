@@ -1,0 +1,4 @@
+from flink_tpu.queryable.server import (KvStateRegistry, QueryableStateClient,
+                                        QueryableStateServer)
+
+__all__ = ["KvStateRegistry", "QueryableStateClient", "QueryableStateServer"]

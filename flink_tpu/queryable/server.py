@@ -1,0 +1,158 @@
+"""Queryable state: point lookups against live keyed state.
+
+Analog of ``flink-queryable-state`` (``KvStateServerImpl`` +
+``KvStateServerHandler`` on each TM, ``KvStateRegistry`` in the runtime,
+client proxy with location lookup): states registered as queryable get point
+reads over a TCP server while the job runs.
+
+Protocol: length-prefixed pickled ``(state_name, key)`` request ->
+length-prefixed pickled ``("ok", value) | ("missing", None) | ("err", msg)``.
+Reads are dirty by design — same consistency contract as the reference
+(queries see live, uncommitted state) — and read-only: lookups use the
+non-inserting key index path so the query thread never mutates the task
+thread's backend (single-writer preserved).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_LEN = struct.Struct("<I")
+
+
+class KvStateRegistry:
+    """Registered queryable states (``KvStateRegistry.java`` analog).
+
+    ``register(name, backend, state)`` exposes a state instance; lookups
+    read through the backend's NON-mutating path.
+    """
+
+    def __init__(self):
+        self._entries: Dict[str, Tuple[Any, Any]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, state_name: str, backend, state) -> None:
+        with self._lock:
+            self._entries[state_name] = (backend, state)
+
+    def unregister(self, state_name: str) -> None:
+        with self._lock:
+            self._entries.pop(state_name, None)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def lookup(self, state_name: str, key) -> Tuple[str, Any]:
+        with self._lock:
+            entry = self._entries.get(state_name)
+        if entry is None:
+            return "err", f"unknown state {state_name!r}; have {self.names()}"
+        backend, state = entry
+        idx = getattr(backend, "_index", None)
+        if idx is None:
+            return "missing", None
+        slots = idx.lookup(np.asarray([key]))    # NON-inserting
+        slot = int(slots[0])
+        if slot < 0:
+            return "missing", None
+        got = state.get_rows(np.asarray([slot]))
+        if isinstance(got, tuple):               # (values, alive)
+            vals, alive = got
+            if not bool(np.asarray(alive)[0]):
+                return "missing", None
+            return "ok", _plain(np.asarray(vals)[0])
+        return "ok", _plain(list(got)[0])
+
+
+def _plain(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+class QueryableStateServer:
+    """TCP server answering point queries (``KvStateServerImpl`` analog)."""
+
+    def __init__(self, registry: KvStateRegistry, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.registry = registry
+        registry_ref = registry
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        hdr = _recv_exact(self.request, _LEN.size)
+                        if hdr is None:
+                            return
+                        (n,) = _LEN.unpack(hdr)
+                        payload = _recv_exact(self.request, n)
+                        if payload is None:
+                            return
+                        state_name, key = pickle.loads(payload)
+                        resp = registry_ref.lookup(state_name, key)
+                        data = pickle.dumps(resp)
+                        self.request.sendall(_LEN.pack(len(data)) + data)
+                except (ConnectionError, OSError):
+                    return
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler,
+                                                       bind_and_activate=True)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="kv-state-server", daemon=True)
+
+    def start(self) -> "QueryableStateServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class QueryableStateClient:
+    """``QueryableStateClient`` analog: connect + get."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 5.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+
+    def get(self, state_name: str, key) -> Any:
+        """Point lookup; raises KeyError if the key has no state."""
+        payload = pickle.dumps((state_name, key))
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        hdr = _recv_exact(self._sock, _LEN.size)
+        if hdr is None:
+            raise ConnectionError("server closed")
+        (n,) = _LEN.unpack(hdr)
+        data = _recv_exact(self._sock, n)
+        if data is None:
+            raise ConnectionError("server closed mid-response")
+        status, value = pickle.loads(data)
+        if status == "ok":
+            return value
+        if status == "missing":
+            raise KeyError(key)
+        raise RuntimeError(value)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def _recv_exact(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf

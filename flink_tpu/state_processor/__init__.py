@@ -1,0 +1,3 @@
+from flink_tpu.state_processor.savepoint import Savepoint, SavepointWriter
+
+__all__ = ["Savepoint", "SavepointWriter"]

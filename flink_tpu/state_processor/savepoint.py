@@ -1,0 +1,281 @@
+"""State Processor API: read / bootstrap / modify savepoints offline.
+
+Analog of ``flink-libraries/flink-state-processing-api``
+(``Savepoint.load(...)``, ``WindowReader.java``, ``SavepointWriter``):
+checkpoints/savepoints become DataSets — list the operators, read any
+operator's keyed state as rows, read WindowAggOperator pane state, rewrite
+or bootstrap state from a DataSet, and write a new restorable savepoint.
+
+Handles both snapshot layouts: the LocalExecutor's ``{uid: op_snapshot}``
+and the MiniCluster's ``{uid: {"subtasks": [op_snapshot, ...]}}`` (subtask
+snapshots are merged through the key-group redistribute path on read).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.state.heap import HeapKeyedStateBackend
+from flink_tpu.state.redistribute import merge_keyed_snapshots
+
+
+def _is_subtask_layout(entry: Any) -> bool:
+    return isinstance(entry, dict) and "subtasks" in entry
+
+
+def _is_keyed(o: Any) -> bool:
+    return isinstance(o, dict) and ("key_index" in o or "keys" in o)
+
+
+def _merge_keyed_group(ops: List[Dict[str, Any]]) -> Dict[str, Any]:
+    fields = sorted({f for o in ops for f in o
+                     if f.startswith("state.") or f == "leaves"})
+    return merge_keyed_snapshots(ops, fields)
+
+
+def _merged_operator_snapshot(entry: Any) -> Dict[str, Any]:
+    if not _is_subtask_layout(entry):
+        return entry
+    subs = [s for s in entry["subtasks"] if s is not None]
+    ops = [s.get("operator", s) for s in subs]
+    if not ops:
+        return {}
+    if all(_is_keyed(o) for o in ops):
+        return _merge_keyed_group(ops)
+    # chained vertex: merge the keyed chain members across subtasks,
+    # best-effort (non-keyed members keep subtask 0's copy)
+    member_keys = [k for k in ops[0]
+                   if k.startswith("op") and k[2:].isdigit()]
+    if member_keys and all(set(member_keys) <= set(o) for o in ops
+                           if isinstance(o, dict)):
+        out = dict(ops[0])
+        for mk in member_keys:
+            members = [o[mk] for o in ops]
+            if all(_is_keyed(m) for m in members):
+                try:
+                    out[mk] = _merge_keyed_group(members)
+                except (ValueError, KeyError, IndexError):
+                    pass  # heterogeneous member layout: keep subtask 0
+        return out
+    return ops[0]
+
+
+class Savepoint:
+    """``Savepoint.load`` analog."""
+
+    @staticmethod
+    def load(storage, checkpoint_id: Optional[int] = None) -> "SavepointReader":
+        snap = (storage.load(checkpoint_id) if checkpoint_id is not None
+                else storage.load_latest())
+        if snap is None:
+            raise ValueError("no checkpoint found in storage")
+        return SavepointReader(snap)
+
+    @staticmethod
+    def from_snapshot(snapshot: Dict[str, Any]) -> "SavepointReader":
+        return SavepointReader(snapshot)
+
+
+def _chain_members(op_snap: Dict[str, Any]):
+    """A chained vertex snapshot nests member snapshots under op0/op1/...;
+    yield the vertex snapshot itself plus every chain member."""
+    yield op_snap
+    for k in sorted(op_snap):
+        if k.startswith("op") and k[2:].isdigit() and isinstance(op_snap[k], dict):
+            yield op_snap[k]
+
+
+def _find_member(op_snap: Dict[str, Any], *fields: str) -> Optional[Dict[str, Any]]:
+    for m in _chain_members(op_snap):
+        if any(f in m for f in fields):
+            return m
+    return None
+
+
+class SavepointReader:
+    def __init__(self, snapshot: Dict[str, Any]):
+        self.snapshot = snapshot
+
+    def operator_uids(self) -> List[str]:
+        return sorted(u for u in self.snapshot
+                      if not u.startswith("__"))
+
+    def raw(self, uid: str) -> Dict[str, Any]:
+        return _merged_operator_snapshot(self.snapshot[uid])
+
+    # -- keyed state ---------------------------------------------------------
+    def _keyed_member(self, uid: str) -> Dict[str, Any]:
+        snap = self.raw(uid)
+        op_snap = snap.get("operator", snap) if isinstance(snap, dict) else snap
+        m = _find_member(op_snap, "key_index", "keys")
+        if m is None:
+            raise ValueError(f"{uid}: no keyed state in snapshot")
+        return m
+
+    def _backend_for(self, uid: str) -> HeapKeyedStateBackend:
+        member = dict(self._keyed_member(uid))
+        member.pop("timers", None)
+        if "key_index" not in member and "keys" in member:
+            # operators like KeyedReduce store the index under "keys"
+            member["key_index"] = member.pop("keys")
+        be = HeapKeyedStateBackend()
+        be.restore(member)
+        return be
+
+    def keyed_state_names(self, uid: str) -> List[str]:
+        return sorted(self._keyed_member(uid).get("state_names", []))
+
+    def read_keyed_state(self, uid: str, state_name: str,
+                         descriptor=None):
+        """All (key, value) rows of one named state as a DataSet
+        (``Savepoint.readKeyedState`` analog)."""
+        from flink_tpu.dataset import ExecutionEnvironment
+        from flink_tpu.state.api import ValueStateDescriptor
+
+        be = self._backend_for(uid)
+        n = be.num_keys
+        env = ExecutionEnvironment()
+        if n == 0:
+            return env.from_columns({"key": np.zeros(0, np.int64),
+                                     "value": np.zeros(0)})
+        desc = descriptor or ValueStateDescriptor(state_name)
+        st = be.get_state(desc)
+        slots = np.arange(n)
+        keys = be.slot_keys(slots)
+        got = st.get_rows(slots)
+        if isinstance(got, tuple):       # (values, alive) states
+            vals, alive = got
+            keys, vals = np.asarray(keys)[alive], np.asarray(vals)[alive]
+        else:
+            vals = got
+        return env.from_columns({"key": np.asarray(keys),
+                                 "value": np.asarray(vals, dtype=object)
+                                 if isinstance(vals, list) else np.asarray(vals)})
+
+    # -- window state (WindowReader analog) ----------------------------------
+    def read_window_state(self, uid: str):
+        """WindowAggOperator pane state as rows (key, pane, acc leaves) —
+        ``WindowReader`` reads WindowOperator state offline the same way."""
+        from flink_tpu.dataset import ExecutionEnvironment
+        from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex
+
+        snap = self.raw(uid)
+        root = snap.get("operator", snap)
+        op_snap = _find_member(root, "leaves")
+        if op_snap is None:
+            raise ValueError(f"{uid}: not a window-aggregate snapshot "
+                             f"(fields: {sorted(root)[:8]})")
+        cls = (ObjectKeyIndex if op_snap.get("key_index_kind") == "ObjectKeyIndex"
+               else KeyIndex)
+        idx = cls.restore(op_snap["key_index"])
+        keys = idx.reverse_keys()
+        counts = np.asarray(op_snap["counts"])          # [K, n_live_panes]
+        leaves = [np.asarray(l) for l in op_snap["leaves"]]
+        panes_arr = np.asarray(op_snap["panes"], np.int64)
+        k_ids, pcols = np.nonzero(counts > 0)
+        cols: Dict[str, Any] = {
+            "key": np.asarray(keys)[k_ids],
+            "pane": panes_arr[pcols],
+            "count": counts[k_ids, pcols],
+        }
+        for i, leaf in enumerate(leaves):
+            cols[f"acc{i}"] = leaf[k_ids, pcols]
+        env = ExecutionEnvironment()
+        return env.from_columns(cols)
+
+    # -- sources -------------------------------------------------------------
+    def read_source_positions(self) -> Dict[str, Dict[str, Any]]:
+        out = dict(self.snapshot.get("__sources__", {}))
+        for uid, entry in self.snapshot.items():
+            if _is_subtask_layout(entry):
+                offs = {f"{i}": s.get("source_offset")
+                        for i, s in enumerate(entry["subtasks"])
+                        if s and "source_offset" in s}
+                if offs:
+                    out[uid] = offs
+        return out
+
+
+class SavepointWriter:
+    """Bootstrap/modify savepoints (``SavepointWriter``/``Savepoint.create``)."""
+
+    def __init__(self, base: Optional[Dict[str, Any]] = None):
+        self.snapshot: Dict[str, Any] = dict(base or {})
+
+    @staticmethod
+    def new_savepoint() -> "SavepointWriter":
+        return SavepointWriter()
+
+    @staticmethod
+    def from_existing(reader: SavepointReader) -> "SavepointWriter":
+        return SavepointWriter(reader.snapshot)
+
+    def remove_operator(self, uid: str) -> "SavepointWriter":
+        self.snapshot.pop(uid, None)
+        return self
+
+    def with_keyed_state(self, uid: str, dataset, key_column: str,
+                         value_column: str, state_name: str,
+                         descriptor=None) -> "SavepointWriter":
+        """Bootstrap one ValueState from a DataSet of (key, value) rows
+        (``KeyedStateBootstrapFunction`` analog, vectorized)."""
+        from flink_tpu.state.api import ValueStateDescriptor
+
+        b = dataset.collect_batch()
+        be = HeapKeyedStateBackend()
+        desc = descriptor or ValueStateDescriptor(state_name)
+        st = be.get_state(desc)
+        keys = np.asarray(b.column(key_column))
+        slots = be.key_slots(keys)
+        st.put_rows(slots, np.asarray(b.column(value_column)))
+        self.snapshot[uid] = be.snapshot()
+        return self
+
+    def transform_keyed_state(self, uid: str, state_name: str,
+                              fn, descriptor=None) -> "SavepointWriter":
+        """Rewrite every (key, value) through ``fn(key, value) -> value``."""
+        from flink_tpu.state.api import ValueStateDescriptor
+
+        entry = self.snapshot[uid]
+        op_snap = _merged_operator_snapshot(entry)
+        inner = op_snap.get("operator", op_snap)
+        member = _find_member(inner, "key_index", "keys")
+        if member is None:
+            raise ValueError(f"{uid}: no keyed state to transform")
+        be = HeapKeyedStateBackend()
+        be.restore({k: v for k, v in member.items() if k != "timers"})
+        desc = descriptor or ValueStateDescriptor(state_name)
+        st = be.get_state(desc)
+        n = be.num_keys
+        slots = np.arange(n)
+        keys = be.slot_keys(slots)
+        got = st.get_rows(slots)
+        vals, alive = got if isinstance(got, tuple) else (got, np.ones(n, bool))
+        new_vals = [fn(k, v) if a else v
+                    for k, v, a in zip(np.asarray(keys).tolist(), list(vals),
+                                       np.asarray(alive).tolist())]
+        st.put_rows(slots, new_vals)
+        new_snap = be.snapshot()
+        # non-backend member fields (timers, watermarks) must survive the
+        # rewrite — dropping them would silently cancel pending timers
+        for k, v in member.items():
+            if k not in new_snap and not k.startswith("state."):
+                new_snap[k] = v
+        if member is inner:
+            if "operator" in op_snap:
+                op_snap = dict(op_snap)
+                op_snap["operator"] = new_snap
+                self.snapshot[uid] = op_snap
+            else:
+                self.snapshot[uid] = new_snap
+        else:
+            member.clear()
+            member.update(new_snap)
+            self.snapshot[uid] = op_snap
+        return self
+
+    def write(self, storage, checkpoint_id: int = 1) -> Dict[str, Any]:
+        storage.store(checkpoint_id, self.snapshot)
+        return self.snapshot

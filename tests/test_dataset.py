@@ -165,3 +165,13 @@ def test_file_roundtrip(env, tmp_path):
     env.from_columns({"a": [1, 2, 3], "b": [1., 2., 3.]}).write_file(p)
     back = env.read_file(p, format="csv").collect()
     assert [r["a"] for r in back] == [1, 2, 3]
+
+
+def test_composite_key_no_collision_large_values():
+    """Regression: radix packing must stay injective for values near 2^31."""
+    env = ExecutionEnvironment()
+    ds = env.from_columns({"a": np.array([0, 1], np.int64),
+                           "b": np.array([2147483647, 0], np.int64),
+                           "v": np.array([1.0, 1.0])})
+    out = ds.group_by("a", "b").sum("v").collect()
+    assert len(out) == 2     # the two rows are DIFFERENT groups

@@ -115,3 +115,8 @@ def test_dataset_interop():
     assert g.num_edges == 2 and g.n == 3
     back = g.as_dataset().collect()
     assert len(back) == 2 and back[0]["weight"] == 1.0
+
+
+def test_empty_graph():
+    g = Graph.from_edges([])
+    assert g.n == 0 and g.num_edges == 0
