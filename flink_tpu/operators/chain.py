@@ -76,6 +76,8 @@ class ChainedOperator(StreamOperator):
         return {f"op{i}": op.snapshot_state() for i, op in enumerate(self.operators)}
 
     def restore_state(self, snapshot: Dict[str, Any]) -> None:
+        if not snapshot:
+            return
         if not any(f"op{i}" in snapshot for i in range(len(self.operators))):
             # flat KEYED snapshot (e.g. a bootstrapped savepoint from the
             # state processor API): hand it to the chain's single

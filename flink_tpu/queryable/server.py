@@ -5,8 +5,11 @@ Analog of ``flink-queryable-state`` (``KvStateServerImpl`` +
 client proxy with location lookup): states registered as queryable get point
 reads over a TCP server while the job runs.
 
-Protocol: length-prefixed pickled ``(state_name, key)`` request ->
-length-prefixed pickled ``("ok", value) | ("missing", None) | ("err", msg)``.
+Protocol: length-prefixed JSON ``[state_name, key]`` request ->
+length-prefixed JSON ``[status, value]`` (``ok/missing/err``).  JSON, not
+pickle: requests arrive over the network from untrusted clients, and
+unpickling attacker bytes is remote code execution.  Keys are therefore
+limited to JSON scalars (str/int/float/bool).
 Reads are dirty by design — same consistency contract as the reference
 (queries see live, uncommitted state) — and read-only: lookups use the
 non-inserting key index path so the query thread never mutates the task
@@ -15,7 +18,7 @@ thread's backend (single-writer preserved).
 
 from __future__ import annotations
 
-import pickle
+import json
 import socket
 import socketserver
 import struct
@@ -78,6 +81,14 @@ def _plain(v):
     return v
 
 
+def _json_safe(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return str(v)
+
+
 class QueryableStateServer:
     """TCP server answering point queries (``KvStateServerImpl`` analog)."""
 
@@ -97,9 +108,13 @@ class QueryableStateServer:
                         payload = _recv_exact(self.request, n)
                         if payload is None:
                             return
-                        state_name, key = pickle.loads(payload)
-                        resp = registry_ref.lookup(state_name, key)
-                        data = pickle.dumps(resp)
+                        try:
+                            state_name, key = json.loads(payload)
+                        except (ValueError, TypeError):
+                            resp = ("err", "malformed request")
+                        else:
+                            resp = registry_ref.lookup(state_name, key)
+                        data = json.dumps(resp, default=_json_safe).encode()
                         self.request.sendall(_LEN.pack(len(data)) + data)
                 except (ConnectionError, OSError):
                     return
@@ -128,7 +143,7 @@ class QueryableStateClient:
 
     def get(self, state_name: str, key) -> Any:
         """Point lookup; raises KeyError if the key has no state."""
-        payload = pickle.dumps((state_name, key))
+        payload = json.dumps([state_name, key]).encode()
         self._sock.sendall(_LEN.pack(len(payload)) + payload)
         hdr = _recv_exact(self._sock, _LEN.size)
         if hdr is None:
@@ -137,7 +152,7 @@ class QueryableStateClient:
         data = _recv_exact(self._sock, n)
         if data is None:
             raise ConnectionError("server closed mid-response")
-        status, value = pickle.loads(data)
+        status, value = json.loads(data)
         if status == "ok":
             return value
         if status == "missing":

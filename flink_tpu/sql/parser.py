@@ -37,6 +37,8 @@ class Literal(Expr):
 @dataclass(frozen=True)
 class Column(Expr):
     name: str
+    #: optional table/alias qualifier ("a.k" -> Column("k", table="a"))
+    table: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -118,9 +120,19 @@ class SelectItem:
 
 
 @dataclass
+class JoinClause:
+    table: str
+    alias: Optional[str]
+    kind: str          # inner / left / right / full
+    on: Expr
+
+
+@dataclass
 class SelectStmt:
     items: List[SelectItem]
     table: Optional[str]
+    table_alias: Optional[str] = None
+    joins: List["JoinClause"] = field(default_factory=list)
     where: Optional[Expr] = None
     group_by: List[Expr] = field(default_factory=list)
     having: Optional[Expr] = None
@@ -153,6 +165,7 @@ _KEYWORDS = {
     "DESC", "LIMIT", "AS", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE",
     "IS", "NULL", "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
     "CAST", "INTERVAL", "DATE", "TIMESTAMP", "DISTINCT",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -240,12 +253,39 @@ class Parser:
         while self.accept("OP", ","):
             items.append(self.parse_select_item())
         table = None
+        table_alias = None
+        joins: List[JoinClause] = []
         if self.accept("KEYWORD", "FROM"):
             table = self.expect("IDENT").value
-            # optional alias (ignored — single-table queries)
-            if self.peek().kind == "IDENT":
-                self.next()
-        stmt = SelectStmt(items=items, table=table)
+            if self.accept("KEYWORD", "AS"):
+                table_alias = self.expect("IDENT").value
+            elif self.peek().kind == "IDENT":
+                table_alias = self.next().value
+            while self.at_keyword("JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
+                kind = "inner"
+                if self.accept("KEYWORD", "INNER"):
+                    pass
+                elif self.accept("KEYWORD", "LEFT"):
+                    kind = "left"
+                    self.accept("KEYWORD", "OUTER")
+                elif self.accept("KEYWORD", "RIGHT"):
+                    kind = "right"
+                    self.accept("KEYWORD", "OUTER")
+                elif self.accept("KEYWORD", "FULL"):
+                    kind = "full"
+                    self.accept("KEYWORD", "OUTER")
+                self.expect("KEYWORD", "JOIN")
+                jt = self.expect("IDENT").value
+                jalias = None
+                if self.accept("KEYWORD", "AS"):
+                    jalias = self.expect("IDENT").value
+                elif self.peek().kind == "IDENT":
+                    jalias = self.next().value
+                self.expect("KEYWORD", "ON")
+                on = self.parse_expr()
+                joins.append(JoinClause(jt, jalias, kind, on))
+        stmt = SelectStmt(items=items, table=table, table_alias=table_alias,
+                          joins=joins)
         if self.accept("KEYWORD", "WHERE"):
             stmt.where = self.parse_expr()
         if self.accept("KEYWORD", "GROUP"):
@@ -422,10 +462,12 @@ class Parser:
             name = t.value
             if self.accept("OP", "("):
                 return self.parse_call(name)
-            # qualified column: tbl.col -> col
+            # qualified column: tbl.col keeps its qualifier (join resolution)
+            qualifier = None
             while self.accept("OP", "."):
+                qualifier = name if qualifier is None else f"{qualifier}.{name}"
                 name = self.expect("IDENT").value
-            return Column(name)
+            return Column(name, table=qualifier)
         raise SqlParseError(f"unexpected token {t.value or t.kind!r} at {t.pos}")
 
     def parse_call(self, name: str) -> Expr:

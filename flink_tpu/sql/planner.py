@@ -111,6 +111,41 @@ def _walk_replace(expr: Expr, mapping: Dict[Expr, Expr]) -> Expr:
     return _transform(expr, fn)
 
 
+def _rewrite_qualified(stmt: SelectStmt, qual_map,
+                       ambiguous: Optional[set] = None) -> SelectStmt:
+    """Resolve ``alias.col`` references to flat post-join column names and
+    strip qualifiers (single-table queries validate the alias too).
+    ``ambiguous``: bare names that exist on both join sides — referencing
+    one unqualified is an error, not a silent left-side pick."""
+    import copy as _copy
+
+    amb = ambiguous or set()
+
+    def fn(e: Expr) -> Optional[Expr]:
+        if isinstance(e, Column) and e.table is not None:
+            key = (e.table, e.name)
+            if key not in qual_map:
+                known = sorted({t for t, _ in qual_map})
+                raise PlanError(f"{e.table}.{e.name}: unknown qualifier "
+                                f"(tables in scope: {known})")
+            return Column(qual_map[key])
+        if isinstance(e, Column) and e.name in amb:
+            raise PlanError(f"column {e.name!r} is ambiguous after JOIN — "
+                            f"qualify it with a table alias")
+        return None
+
+    stmt = _copy.copy(stmt)
+    stmt.items = [SelectItem(_transform(it.expr, fn), it.alias)
+                  for it in stmt.items]
+    if stmt.where is not None:
+        stmt.where = _transform(stmt.where, fn)
+    stmt.group_by = [_transform(g, fn) for g in stmt.group_by]
+    if stmt.having is not None:
+        stmt.having = _transform(stmt.having, fn)
+    stmt.order_by = [(_transform(e, fn), asc) for e, asc in stmt.order_by]
+    return stmt
+
+
 def _extract_aggs(expr: Expr, specs: List[AggSpec],
                   cache: Dict[Expr, Column]) -> Expr:
     """Replace aggregate calls with placeholder columns, collecting specs
@@ -201,9 +236,11 @@ def _parse_window_call(call: Call, compiler: ExprCompiler) -> WindowSpec:
 class Planner:
     """Translates a parsed SELECT over one registered table to a DataStream."""
 
-    def __init__(self, env, catalog: Mapping[str, "CatalogTable"]):
+    def __init__(self, env, catalog: Mapping[str, "CatalogTable"],
+                 mini_batch_rows: int = 0):
         self.env = env
         self.catalog = catalog
+        self.mini_batch_rows = mini_batch_rows
 
     def plan(self, stmt: SelectStmt) -> QueryPlan:
         if stmt.table is None:
@@ -213,7 +250,14 @@ class Planner:
         except KeyError:
             raise PlanError(f"unknown table {stmt.table!r}; registered: "
                             f"{sorted(self.catalog)}")
-        stream = table.stream()
+        if stmt.joins:
+            stream, table, qual_map, ambiguous = self._plan_joins(stmt, table)
+            stmt = _rewrite_qualified(stmt, qual_map, ambiguous)
+        else:
+            stream = table.stream()
+            alias = stmt.table_alias or stmt.table
+            qual_map = {(alias, c): c for c in table.columns}
+            stmt = _rewrite_qualified(stmt, qual_map)
         schema = dict.fromkeys(table.columns)
 
         # ---- expand * and split aggregates out of SELECT / HAVING
@@ -265,6 +309,95 @@ class Planner:
         return self._plan_aggregate(stream, rewritten, having, agg_specs,
                                     group_keys, window, table, stmt, compiler,
                                     orig_items=items)
+
+    # ------------------------------------------------------------ joins
+    def _plan_joins(self, stmt: SelectStmt, base):
+        """FROM a JOIN b ON ... — equi-joins chained left-deep
+        (``StreamExecJoin`` over bounded inputs: emit at end of input)."""
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.graph.transformations import (Partitioning,
+                                                     Transformation)
+        from flink_tpu.operators.sql_ops import SqlJoinOperator
+        from flink_tpu.sql.table_env import CatalogTable
+
+        cur_stream = base.stream()
+        a0 = stmt.table_alias or stmt.table
+        qual_map: Dict[Tuple[str, str], str] = {(a0, c): c
+                                                for c in base.columns}
+        out_names: List[str] = list(base.columns)
+        ambiguous: set = set()
+        for jc in stmt.joins:
+            try:
+                rt = self.catalog[jc.table]
+            except KeyError:
+                raise PlanError(f"unknown table {jc.table!r} in JOIN")
+            ralias = jc.alias or jc.table
+            left_names = list(out_names)   # columns of the LEFT side only
+            rename: Dict[str, str] = {}
+            for c in rt.columns:
+                nm = c if c not in out_names else f"{ralias}_{c}"
+                while nm in out_names:
+                    nm += "_"
+                if nm != c:
+                    ambiguous.add(c)
+                rename[c] = nm
+                qual_map[(ralias, c)] = nm
+                out_names.append(nm)
+            lk, rk = self._resolve_equi_on(jc.on, qual_map, rt, ralias,
+                                           left_names)
+            rstream = rt.stream()
+            t = Transformation(
+                name=f"sql-join:{jc.table}",
+                operator_factory=(lambda _lk=lk, _rk=rk, _how=jc.kind,
+                                  _rn=dict(rename):
+                                  SqlJoinOperator(_lk, _rk, _how, _rn)),
+                inputs=[cur_stream.transformation, rstream.transformation],
+                input_partitionings=[Partitioning.HASH, Partitioning.HASH],
+                input_key_columns=[lk, rk],
+                parallelism=self.env.parallelism, chainable=False,
+                max_parallelism=self.env.max_parallelism)
+            cur_stream = DataStream(self.env, t)
+        joined = CatalogTable(name="<join>", columns=out_names,
+                              stream_factory=lambda env: cur_stream,
+                              timestamps_assigned=False)
+        return cur_stream, joined, qual_map, ambiguous
+
+    def _resolve_equi_on(self, on: Expr, qual_map, right_table, ralias: str,
+                         left_names: List[str]) -> Tuple[str, str]:
+        if not (isinstance(on, Binary) and on.op == "="
+                and isinstance(on.left, Column)
+                and isinstance(on.right, Column)):
+            raise PlanError("JOIN ... ON must be an equi-join between two "
+                            "columns (a.k = b.k)")
+
+        def side(col: Column) -> Tuple[str, str]:
+            """-> ('right', original right col) or ('left', output name)."""
+            if col.table == ralias:
+                if col.name not in right_table.columns:
+                    raise PlanError(f"{ralias}.{col.name}: no such column")
+                return "right", col.name
+            if col.table is not None:
+                key = (col.table, col.name)
+                if key not in qual_map:
+                    raise PlanError(f"{col.table}.{col.name}: unknown")
+                return "left", qual_map[key]
+            # unqualified: resolve by uniqueness across the two sides
+            in_left = col.name in left_names
+            in_right = col.name in right_table.columns
+            if in_left and in_right:
+                raise PlanError(f"column {col.name!r} is ambiguous in JOIN "
+                                f"(qualify it: {ralias}.{col.name})")
+            if in_right:
+                return "right", col.name
+            if in_left:
+                return "left", col.name
+            raise PlanError(f"column {col.name!r} not found in JOIN")
+
+        s1, c1 = side(on.left)
+        s2, c2 = side(on.right)
+        if {s1, s2} != {"left", "right"}:
+            raise PlanError("JOIN condition must relate the two tables")
+        return (c1, c2) if s1 == "left" else (c2, c1)
 
     # ------------------------------------------------------------ projection
     def _plan_projection(self, stream, items: List[SelectItem], table,
@@ -328,6 +461,16 @@ class Planner:
             return out
 
         stream = stream.map(pre_project, name="sql-pre-project")
+        if self.mini_batch_rows:
+            # bundle small batches ahead of the stateful aggregate
+            # (``table.exec.mini-batch`` bundling, ``operators/bundle/``)
+            from flink_tpu.operators.sql_ops import MiniBatchOperator
+            mbr = self.mini_batch_rows
+            t = stream._then("sql-mini-batch",
+                             lambda: MiniBatchOperator(mbr),
+                             chainable=False)
+            from flink_tpu.datastream.api import DataStream
+            stream = DataStream(stream.env, t)
         keyed = stream.key_by(key_col)
 
         # ---- the aggregate handler: one ACC pytree for all aggregates.

@@ -38,9 +38,13 @@ class CatalogTable:
 class TableEnvironment:
     """Catalog + SQL planner over the streaming runtime."""
 
-    def __init__(self, parallelism: int = 1, max_parallelism: int = 128):
+    def __init__(self, parallelism: int = 1, max_parallelism: int = 128,
+                 mini_batch_rows: int = 0):
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
+        #: >0 enables mini-batch bundling before group aggregates
+        #: (``table.exec.mini-batch`` analog)
+        self.mini_batch_rows = mini_batch_rows
         self._catalog: Dict[str, CatalogTable] = {}
 
     @staticmethod
@@ -127,7 +131,8 @@ class TableEnvironment:
         for t in self._catalog.values():
             t._bound_env = env
         try:
-            plan = Planner(env, self._catalog).plan(stmt)
+            plan = Planner(env, self._catalog,
+                           mini_batch_rows=self.mini_batch_rows).plan(stmt)
         finally:
             for t in self._catalog.values():
                 t._bound_env = None
@@ -175,18 +180,22 @@ class Table:
 
     # -- execution ----------------------------------------------------------
     def execute(self) -> "TableResult":
+        import copy
         stmt = self._stmt
         if not stmt.items:  # bare registered table: SELECT *
-            stmt = parse(f"SELECT * FROM {stmt.table}")
+            stmt = copy.copy(stmt)
+            stmt.items = parse(f"SELECT * FROM {stmt.table}").items
         env, plan = self.tenv._plan(stmt)
         return TableResult(env, plan)
 
     def to_data_stream(self, env=None):
         """Plan onto ``env`` (or the table env's fresh one) and return the
         result ``DataStream`` (``toDataStream`` / ``toChangelogStream``)."""
+        import copy
         stmt = self._stmt
         if not stmt.items:
-            stmt = parse(f"SELECT * FROM {stmt.table}")
+            stmt = copy.copy(stmt)
+            stmt.items = parse(f"SELECT * FROM {stmt.table}").items
         if env is None:
             env, plan = self.tenv._plan(stmt)
             return plan.stream
@@ -197,6 +206,45 @@ class Table:
         finally:
             for t in self.tenv._catalog.values():
                 t._bound_env = None
+
+
+    # -- blink-runtime extensions ------------------------------------------
+    def _planned(self):
+        import copy
+        stmt = self._stmt
+        if not stmt.items:
+            # bare table: fill in SELECT * but KEEP where()/group-by state
+            stmt = copy.copy(stmt)
+            stmt.items = parse(f"SELECT * FROM {stmt.table}").items
+        return self.tenv._plan(stmt)
+
+    def top_n(self, n: int, partition_by: Optional[str],
+              order_by: str, ascending: bool = False) -> "TableResult":
+        """Top-N per partition (``StreamExecRank`` analog): final ranked
+        rows with a ``rank`` column."""
+        from flink_tpu.operators.sql_ops import TopNOperator
+
+        env, plan = self._planned()
+        t = plan.stream._then(
+            "sql-top-n",
+            lambda: TopNOperator(n, partition_by, order_by,
+                                 ascending=ascending, emit_changelog=False))
+        from flink_tpu.datastream.api import DataStream
+        out = DataStream(env, t)
+        return TableResult(env, QueryPlan(out, plan.output_columns + ["rank"]))
+
+    def deduplicate(self, key: str, keep: str = "first",
+                    order_by: Optional[str] = None) -> "TableResult":
+        """Deduplication per key (``Deduplicate`` exec node analog)."""
+        from flink_tpu.operators.sql_ops import DeduplicateOperator
+
+        env, plan = self._planned()
+        t = plan.stream._then(
+            "sql-deduplicate",
+            lambda: DeduplicateOperator(key, keep=keep, order_column=order_by))
+        from flink_tpu.datastream.api import DataStream
+        return TableResult(env, QueryPlan(DataStream(env, t),
+                                          plan.output_columns))
 
 
 class GroupedTable:
@@ -211,6 +259,47 @@ class GroupedTable:
         stmt = parse(sql)
         stmt.where = copy.copy(self.table._stmt.where)  # keep prior where()
         return Table(self.table.tenv, stmt)
+
+    def select_changelog(self, select_list: str) -> "TableResult":
+        """Non-windowed group aggregate as a CHANGELOG stream with
+        retraction rows (+I / -U / +U in the ``op`` column) — the
+        ``GroupAggFunction`` retraction semantics of the blink runtime."""
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.operators.sql_ops import ChangelogGroupAggOperator
+        from flink_tpu.sql.parser import Call, Column as PCol, Star
+        from flink_tpu.sql.planner import QueryPlan as QP
+
+        if "," in self.keys:
+            raise PlanError("select_changelog supports a single group key")
+        key = self.keys.strip()
+        items = parse(f"SELECT {select_list} "
+                      f"FROM {self.table._table_name()}").items
+        agg_columns = {}
+        out_cols = ["op", key]
+        for it in items:
+            e = it.expr
+            if isinstance(e, PCol) and e.name == key:
+                continue
+            if not (isinstance(e, Call) and e.name in
+                    ("SUM", "COUNT", "MIN", "MAX")):
+                raise PlanError("select_changelog items must be the key or "
+                                "SUM/COUNT/MIN/MAX aggregates")
+            if e.name == "COUNT":
+                col = None
+            else:
+                if len(e.args) != 1 or not isinstance(e.args[0], PCol):
+                    raise PlanError(f"{e.name} needs one plain column arg")
+                col = e.args[0].name
+            out = it.alias or f"{e.name.lower()}_{col or 'rows'}"
+            agg_columns[out] = (col, e.name.lower()
+                                if e.name != "COUNT" else "count")
+            out_cols.append(out)
+
+        env, plan = self.table._planned()
+        t = plan.stream._then(
+            "sql-changelog-agg",
+            lambda: ChangelogGroupAggOperator(key, agg_columns))
+        return TableResult(env, QP(DataStream(env, t), out_cols))
 
 
 class TableResult:

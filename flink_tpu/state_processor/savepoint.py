@@ -30,6 +30,12 @@ def _is_keyed(o: Any) -> bool:
 
 
 def _merge_keyed_group(ops: List[Dict[str, Any]]) -> Dict[str, Any]:
+    if any("pane_base" in o for o in ops):
+        # window-aggregate snapshots have their own slot-aligned row fields
+        # (leaves AND counts) + pane-progress invariants: use the operator's
+        # merge, not the generic keyed merge
+        from flink_tpu.operators.window_agg import WindowAggOperator
+        return WindowAggOperator.merge_snapshots(ops)
     fields = sorted({f for o in ops for f in o
                      if f.startswith("state.") or f == "leaves"})
     return merge_keyed_snapshots(ops, fields)
@@ -238,14 +244,20 @@ class SavepointWriter:
         """Rewrite every (key, value) through ``fn(key, value) -> value``."""
         from flink_tpu.state.api import ValueStateDescriptor
 
+        # never mutate the caller's snapshot tree (from_existing shares it)
+        import copy as _copy
+        self.snapshot[uid] = _copy.deepcopy(self.snapshot[uid])
         entry = self.snapshot[uid]
         op_snap = _merged_operator_snapshot(entry)
         inner = op_snap.get("operator", op_snap)
         member = _find_member(inner, "key_index", "keys")
         if member is None:
             raise ValueError(f"{uid}: no keyed state to transform")
+        restorable = {k: v for k, v in member.items() if k != "timers"}
+        if "key_index" not in restorable and "keys" in restorable:
+            restorable["key_index"] = restorable.pop("keys")
         be = HeapKeyedStateBackend()
-        be.restore({k: v for k, v in member.items() if k != "timers"})
+        be.restore(restorable)
         desc = descriptor or ValueStateDescriptor(state_name)
         st = be.get_state(desc)
         n = be.num_keys

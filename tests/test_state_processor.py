@@ -208,3 +208,21 @@ def test_transform_preserves_timers_field():
     writer.snapshot["op"]["timers"] = {"event": "sentinel"}
     writer.transform_keyed_state("op", "s", lambda k, v: v + 1)
     assert writer.snapshot["op"]["timers"] == {"event": "sentinel"}
+
+
+def test_transform_does_not_mutate_source_snapshot():
+    from flink_tpu.dataset import ExecutionEnvironment as BatchEnv
+
+    benv = BatchEnv()
+    seed = benv.from_columns({"k": np.array([1]), "x": np.array([5.0])})
+    base_writer = SavepointWriter.new_savepoint()
+    base_writer.with_keyed_state("op", seed, "k", "x", "s")
+    reader = Savepoint.from_snapshot(base_writer.snapshot)
+
+    w2 = SavepointWriter.from_existing(reader)
+    w2.transform_keyed_state("op", "s", lambda k, v: v * 10)
+    # the ORIGINAL reader still sees the untransformed value
+    orig = reader.read_keyed_state("op", "s").collect()
+    assert orig[0]["value"] == 5.0
+    new = Savepoint.from_snapshot(w2.snapshot).read_keyed_state("op", "s").collect()
+    assert new[0]["value"] == 50.0
