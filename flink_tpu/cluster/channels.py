@@ -111,6 +111,8 @@ class OutputDispatcher:
         elif self.partitioning == "broadcast":
             for ch in self.channels:
                 ch.put(el)
+        elif self.partitioning == "global":
+            self.channels[0].put(el)   # everything to subtask 0
         elif self.partitioning in ("rebalance", "rescale", "shuffle"):
             self.channels[self._rr % n].put(el)
             self._rr += 1

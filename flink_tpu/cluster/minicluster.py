@@ -14,6 +14,12 @@ restarting the job from the latest completed checkpoint
 Checkpoint layout: ``{uid: {"subtasks": [per-subtask snapshot, ...]}}`` plus
 ``__job__`` metadata.  On restore with the same parallelism each subtask gets
 its own snapshot back; sources replay from their recorded offsets.
+
+NOTE on devices: subtasks are threads, and concurrent jit dispatch from many
+threads onto ONE physical TPU chip can crash the device client — run the
+MiniCluster on the CPU platform (tests do: ``jax_platforms=cpu``) or give
+each subtask its own device; single-chip TPU work belongs on the
+single-threaded LocalExecutor / the sharded ``parallel`` path.
 """
 
 from __future__ import annotations
@@ -196,30 +202,36 @@ class MiniCluster(TaskListener):
 
     # ------------------------------------------------------------ triggers
     def trigger_checkpoint(self) -> Optional[int]:
+        cid, _reason = self._trigger_checkpoint()
+        return cid
+
+    def _trigger_checkpoint(self) -> Tuple[Optional[int], str]:
         """Start one checkpoint: inject barriers at all sources (RPC analog,
-        ``CheckpointCoordinator.triggerCheckpoint:502``)."""
+        ``CheckpointCoordinator.triggerCheckpoint:502``).  Returns
+        ``(id, "ok")``, ``(None, "busy")`` while one is in flight, or
+        ``(None, "declined")`` when checkpointing is no longer possible."""
         with self._lock:
             if self._pending is not None:
                 if (time.monotonic() - self._pending.started_at
                         < self.checkpoint_timeout_s):
-                    return None   # previous still in flight
+                    return None, "busy"   # previous still in flight
                 self._pending = None  # timed out: abort
             # finished sources cannot inject barriers and finished tasks
             # never ack — decline once any source finished, exclude finished
             # tasks from the expectation otherwise
             if any((t.vertex_uid, t.subtask_index) in self._finished
                    for t in self._source_tasks):
-                return None
+                return None, "declined"
             expected = len(self._tasks) - len(self._finished)
             if expected <= 0:
-                return None
+                return None, "declined"
             cid = self._next_checkpoint_id
             self._next_checkpoint_id += 1
             self._pending = _PendingCheckpoint(
                 cid, expected=expected, started_at=time.monotonic())
         for t in self._source_tasks:
             t.commands.put(("checkpoint", cid))
-        return cid
+        return cid, "ok"
 
     # ------------------------------------------------------------ execute
     def execute(self, plan: ExecutionPlan,
@@ -255,8 +267,12 @@ class MiniCluster(TaskListener):
                                  (time.monotonic() - t0) * 1000, restarts,
                                  self._completed_ids, err)
             states = [t.state for t in self._tasks]
-            if all(s == TaskStates.FINISHED for s in states):
-                return JobResult(plan.job_name, TaskStates.FINISHED,
+            terminal = (TaskStates.FINISHED, TaskStates.CANCELED)
+            if all(s in terminal for s in states):
+                final = (TaskStates.FINISHED
+                         if all(s == TaskStates.FINISHED for s in states)
+                         else TaskStates.CANCELED)
+                return JobResult(plan.job_name, final,
                                  (time.monotonic() - t0) * 1000, restarts,
                                  self._completed_ids)
             if (self.checkpoint_interval_ms and
@@ -269,10 +285,68 @@ class MiniCluster(TaskListener):
         for t in self._tasks:
             t.cancel()
 
+    # ------------------------------------------------------- introspection
+    def job_status(self) -> Dict[str, Any]:
+        """REST-facing job view (jobs/<id> handler backing)."""
+        tasks = getattr(self, "_tasks", [])
+        by_vertex: Dict[str, List] = {}
+        for t in tasks:
+            by_vertex.setdefault(t.vertex_uid, []).append(t)
+        vertices = []
+        for uid, ts in by_vertex.items():
+            total_ns = max(1, sum(t.busy_ns + t.idle_ns + t.backpressure_ns
+                                  for t in ts))
+            vertices.append({
+                "id": uid,
+                "parallelism": len(ts),
+                "status": sorted({t.state for t in ts}),
+                "records_in": sum(t.records_in for t in ts),
+                "records_out": sum(t.records_out for t in ts),
+                "busy_ratio": sum(t.busy_ns for t in ts) / total_ns,
+                "idle_ratio": sum(t.idle_ns for t in ts) / total_ns,
+                "backpressure_ratio":
+                    sum(t.backpressure_ns for t in ts) / total_ns,
+            })
+        states = [t.state for t in tasks]
+        terminal = (TaskStates.FINISHED, TaskStates.CANCELED)
+        if self._failed is not None:
+            job_state = "FAILED"
+        elif states and all(s == TaskStates.FINISHED for s in states):
+            job_state = "FINISHED"
+        elif states and all(s in terminal for s in states):
+            job_state = "CANCELED"
+        elif states:
+            job_state = "RUNNING"
+        else:
+            job_state = "CREATED"
+        return {
+            "state": job_state,
+            "vertices": vertices,
+            "completed_checkpoints": list(self._completed_ids),
+            "failure": self._failed,
+        }
+
+    def sink_latencies_ms(self) -> List[float]:
+        out: List[float] = []
+        for t in getattr(self, "_tasks", []):
+            op = t.operator
+            ops = getattr(op, "operators", [op])
+            for member in ops:
+                out.extend(getattr(member, "latencies_ms", []))
+        return out
+
     def savepoint(self) -> Optional[int]:
         """User-triggered checkpoint (savepoint analog): returns its id once
         completed, or None if it could not complete."""
-        cid = self.trigger_checkpoint()
+        cid = None
+        deadline0 = time.monotonic() + self.checkpoint_timeout_s
+        while cid is None and time.monotonic() < deadline0:
+            cid, reason = self._trigger_checkpoint()
+            if cid is None:
+                if reason == "declined":
+                    return None    # permanently impossible (sources done)
+                # a periodic checkpoint is in flight: wait for its slot
+                time.sleep(0.005)
         if cid is None:
             return None
         deadline = time.monotonic() + self.checkpoint_timeout_s

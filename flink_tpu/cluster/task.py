@@ -23,14 +23,15 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
-                                  EndOfInput, RecordBatch, StreamElement,
-                                  TaggedBatch, Watermark)
+                                  EndOfInput, LatencyMarker, RecordBatch,
+                                  StreamElement, TaggedBatch, Watermark)
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
 from flink_tpu.runtime.executor import WatermarkValve
@@ -63,6 +64,13 @@ class SubtaskBase:
         self.state = TaskStates.DEPLOYING
         self._thread: Optional[threading.Thread] = None
         self._cancelled = threading.Event()
+        #: busy/idle/backpressure time accounting (TimerGauge analog,
+        #: ``runtime/metrics/TimerGauge.java`` — surfaced by the REST API)
+        self.busy_ns = 0
+        self.idle_ns = 0
+        self.backpressure_ns = 0
+        self.records_in = 0
+        self.records_out = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, restore: Optional[Dict[str, Any]] = None) -> None:
@@ -90,9 +98,15 @@ class SubtaskBase:
 
     # -- shared plumbing -----------------------------------------------------
     def _emit(self, elements: Sequence[StreamElement]) -> None:
+        t0 = time.monotonic_ns()
         for el in elements:
+            if isinstance(el, RecordBatch):
+                self.records_out += len(el)
             for out in self.outputs:
                 out.emit(el)
+        # time spent pushing into (possibly full) output channels is
+        # backpressure: the reference gauges recordWriter availability
+        self.backpressure_ns += time.monotonic_ns() - t0
 
     def _transition(self, state: str, error: Optional[str] = None) -> None:
         self.state = state
@@ -134,6 +148,9 @@ class SourceSubtask(SubtaskBase):
                          listener)
         self.split = split
         self._emitted = 0          # elements pulled from the split so far
+        #: emit a LatencyMarker every N batches (0 = off); the markers ride
+        #: the dataflow around user functions (``LatencyMarker.java:32``)
+        self.latency_marker_interval = 0
 
     def _invoke(self) -> None:
         it = iter(self.split.read())
@@ -153,7 +170,18 @@ class SourceSubtask(SubtaskBase):
                 break
             self._emitted += 1
             if isinstance(el, RecordBatch):
-                self._emit(self.operator.process_batch(el))
+                self.records_in += len(el)
+                self._batches_since_marker = getattr(
+                    self, "_batches_since_marker", 0) + 1
+                if self.latency_marker_interval and \
+                        self._batches_since_marker >= self.latency_marker_interval:
+                    self._batches_since_marker = 0
+                    self._emit([LatencyMarker(time.time(),
+                                              subtask_index=self.subtask_index)])
+                t0 = time.monotonic_ns()
+                out = self.operator.process_batch(el)
+                self.busy_ns += time.monotonic_ns() - t0
+                self._emit(out)
             elif isinstance(el, Watermark):
                 self._emit(self.operator.process_watermark(el))
                 if self.operator.forwards_watermarks:
@@ -247,11 +275,15 @@ class Subtask(SubtaskBase):
                 self._handle(i, el)
             if not progressed:
                 # nothing readable: brief blocking poll on one open channel
+                t0 = time.monotonic_ns()
                 for i, ch in enumerate(self.inputs):
                     if not self._ended[i] and i not in self._blocked:
                         el = ch.poll(timeout_s=0.01)
                         if el is not None:
+                            self.idle_ns += time.monotonic_ns() - t0
                             self._handle(i, el)
+                        else:
+                            self.idle_ns += time.monotonic_ns() - t0
                         break
         self._emit(self.operator.end_input())
         self._emit([EndOfInput()])
@@ -294,11 +326,25 @@ class Subtask(SubtaskBase):
                 self._emit(self.operator.process_tagged(el.batch))
         elif isinstance(el, RecordBatch):
             if len(el):
+                self.records_in += len(el)
+                t0 = time.monotonic_ns()
                 if getattr(self.operator, "is_two_input", False):
-                    self._emit(self.operator.process_batch2(
-                        el, self.input_logical[i]))
+                    out = self.operator.process_batch2(
+                        el, self.input_logical[i])
                 else:
-                    self._emit(self.operator.process_batch(el))
+                    out = self.operator.process_batch(el)
+                self.busy_ns += time.monotonic_ns() - t0
+                self._emit(out)
+        elif isinstance(el, LatencyMarker):
+            # LatencyMarker flows around user functions; sinks record it.
+            # The hook may return elements to keep forwarding (chains).
+            hook = getattr(self.operator, "on_latency_marker", None)
+            if hook is not None:
+                out = hook(el)
+                if out:
+                    self._emit(list(out))
+            else:
+                self._emit([el])
         else:
             self._emit([el])
 

@@ -307,6 +307,15 @@ class SinkOperator(StreamOperator):
             self.sink.on_watermark(watermark.timestamp)
         return []
 
+    def on_latency_marker(self, marker) -> None:
+        """Source→sink latency sample (``LatencyStats`` at the sink)."""
+        import time as _time
+
+        self.latencies_ms = getattr(self, "latencies_ms", [])
+        self.latencies_ms.append((_time.time() - marker.marked_time) * 1000.0)
+        if len(self.latencies_ms) > 1024:
+            del self.latencies_ms[:512]
+
     def end_input(self) -> List[StreamElement]:
         if hasattr(self.sink, "flush"):
             self.sink.flush()

@@ -72,6 +72,17 @@ class ChainedOperator(StreamOperator):
             out.extend(self._feed(i + 1, op.end_input()))
         return out
 
+    def on_latency_marker(self, marker):
+        """Markers flow around user functions; a recording member (sink)
+        consumes them, otherwise the marker continues downstream."""
+        handled = False
+        for op in self.operators:
+            hook = getattr(op, "on_latency_marker", None)
+            if hook is not None:
+                hook(marker)
+                handled = True
+        return [] if handled else [marker]
+
     def snapshot_state(self) -> Dict[str, Any]:
         return {f"op{i}": op.snapshot_state() for i, op in enumerate(self.operators)}
 

@@ -30,11 +30,17 @@ class SqlJoinOperator(StreamOperator):
 
     def __init__(self, left_key: str, right_key: str, how: str = "inner",
                  right_rename: Optional[Dict[str, str]] = None,
+                 left_columns: Optional[List[str]] = None,
+                 right_columns: Optional[List[str]] = None,
                  name: str = "sql-join"):
         self.left_key = left_key
         self.right_key = right_key
         self.how = how
         self.right_rename = right_rename or {}
+        #: declared schemas: outer joins must emit null-filled columns for an
+        #: EMPTY side, which cannot be inferred from received batches
+        self.left_columns = left_columns
+        self.right_columns = right_columns
         self.name = name
         self._left: List[RecordBatch] = []
         self._right: List[RecordBatch] = []
@@ -68,8 +74,10 @@ class SqlJoinOperator(StreamOperator):
         if nl and nr:
             li, ri = _join_pairs(np.asarray(l.column(self.left_key)),
                                  np.asarray(r.column(self.right_key)))
-        lcols = list(l.columns) if l is not None else []
-        rcols = list(r.columns) if r is not None else []
+        lcols = (self.left_columns if self.left_columns is not None
+                 else (list(l.columns) if l is not None else []))
+        rcols = (self.right_columns if self.right_columns is not None
+                 else (list(r.columns) if r is not None else []))
         if li.size:
             cols = {k: np.asarray(v)[li] for k, v in l.columns.items()}
             cols.update(self._rename_right(

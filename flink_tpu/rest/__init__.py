@@ -1,0 +1,3 @@
+from flink_tpu.rest.server import RestServer
+
+__all__ = ["RestServer"]

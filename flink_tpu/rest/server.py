@@ -1,0 +1,242 @@
+"""REST API + web dashboard.
+
+Analog of the reference's REST endpoint (``RestServerEndpoint`` + ~100
+typed handlers in ``runtime/rest/handler/job/*`` + the Angular dashboard of
+``flink-runtime-web``): a threaded HTTP server over the MiniCluster's job
+registry serving reference-shaped JSON plus a single-page dashboard that
+polls it.
+
+Endpoints:
+  GET  /overview                      cluster overview
+  GET  /jobs                          job listing
+  GET  /jobs/<id>                     topology + per-vertex gauges
+  GET  /jobs/<id>/checkpoints         completed checkpoint stats
+  GET  /jobs/<id>/backpressure        busy/idle/backpressured per vertex
+  GET  /jobs/<id>/metrics             numeric metrics incl. latency pcts
+  GET  /jobs/<id>/exceptions          root failure cause
+  GET  /jobs/<id>/flamegraph          sampled task-thread flame graph
+  POST /jobs/<id>/savepoints          trigger a savepoint
+  PATCH /jobs/<id>                    cancel
+  GET  /                              dashboard (HTML)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class JobRegistry:
+    """Named running/finished jobs the REST layer exposes."""
+
+    def __init__(self):
+        self._jobs: Dict[str, Tuple[str, Any]] = {}  # id -> (name, cluster)
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def register(self, name: str, cluster) -> str:
+        with self._lock:
+            self._n += 1
+            job_id = f"job-{self._n:04d}"
+            self._jobs[job_id] = (name, cluster)
+            return job_id
+
+    def jobs(self) -> List[Tuple[str, str, Any]]:
+        with self._lock:
+            return [(jid, name, c) for jid, (name, c) in self._jobs.items()]
+
+    def get(self, job_id: str):
+        with self._lock:
+            return self._jobs.get(job_id)
+
+
+def _percentiles(xs: List[float]) -> Dict[str, float]:
+    if not xs:
+        return {}
+    a = np.asarray(xs)
+    return {"p50": float(np.percentile(a, 50)),
+            "p95": float(np.percentile(a, 95)),
+            "p99": float(np.percentile(a, 99)),
+            "max": float(a.max()), "count": len(xs)}
+
+
+class RestServer:
+    def __init__(self, registry: JobRegistry, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.registry = registry
+        registry_ref = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, obj, status: int = 200,
+                      content_type: str = "application/json"):
+                data = (obj if isinstance(obj, bytes)
+                        else json.dumps(obj, default=str).encode())
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _job(self, job_id: str):
+                entry = registry_ref.get(job_id)
+                if entry is None:
+                    self._send({"error": f"no job {job_id}"}, 404)
+                    return None
+                return entry
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0].rstrip("/")
+                if path == "" or path == "/index.html":
+                    return self._send(_DASHBOARD_HTML.encode(),
+                                      content_type="text/html")
+                if path == "/overview":
+                    jobs = registry_ref.jobs()
+                    states = [c.job_status()["state"] for _, _, c in jobs]
+                    return self._send({
+                        "jobs_total": len(jobs),
+                        "jobs_running": states.count("RUNNING"),
+                        "jobs_finished": states.count("FINISHED"),
+                        "jobs_failed": states.count("FAILED")})
+                if path == "/jobs":
+                    return self._send({"jobs": [
+                        {"id": jid, "name": name,
+                         "state": c.job_status()["state"]}
+                        for jid, name, c in registry_ref.jobs()]})
+                m = re.match(r"^/jobs/([^/]+)(?:/(.*))?$", path)
+                if not m:
+                    return self._send({"error": "not found"}, 404)
+                entry = self._job(m.group(1))
+                if entry is None:
+                    return
+                name, cluster = entry
+                sub = m.group(2) or ""
+                status = cluster.job_status()
+                if sub == "":
+                    return self._send({"id": m.group(1), "name": name,
+                                       **status})
+                if sub == "checkpoints":
+                    return self._send({
+                        "completed": status["completed_checkpoints"],
+                        "count": len(status["completed_checkpoints"])})
+                if sub == "backpressure":
+                    return self._send({"vertices": [
+                        {"id": v["id"],
+                         "busy": round(v["busy_ratio"], 4),
+                         "idle": round(v["idle_ratio"], 4),
+                         "backpressured": round(v["backpressure_ratio"], 4)}
+                        for v in status["vertices"]]})
+                if sub == "metrics":
+                    return self._send({
+                        "records_in": sum(v["records_in"]
+                                          for v in status["vertices"]),
+                        "records_out": sum(v["records_out"]
+                                           for v in status["vertices"]),
+                        "latency_ms": _percentiles(
+                            cluster.sink_latencies_ms())})
+                if sub == "exceptions":
+                    return self._send({"root_exception": status["failure"]})
+                if sub == "flamegraph":
+                    from flink_tpu.rest.flamegraph import flamegraph
+                    return self._send(flamegraph(duration_ms=150))
+                return self._send({"error": f"unknown path {sub}"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                m = re.match(r"^/jobs/([^/]+)/savepoints$",
+                             self.path.rstrip("/"))
+                if not m:
+                    return self._send({"error": "not found"}, 404)
+                entry = self._job(m.group(1))
+                if entry is None:
+                    return
+                _name, cluster = entry
+                sp = cluster.savepoint()
+                if sp is None:
+                    return self._send({"status": "failed"}, 409)
+                return self._send({"status": "completed", "checkpoint_id": sp})
+
+            def do_PATCH(self):  # noqa: N802
+                m = re.match(r"^/jobs/([^/]+)$", self.path.rstrip("/"))
+                if not m:
+                    return self._send({"error": "not found"}, 404)
+                entry = self._job(m.group(1))
+                if entry is None:
+                    return
+                entry[1].cancel()
+                return self._send({"status": "cancelling"}, 202)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="rest-server", daemon=True)
+
+    def start(self) -> "RestServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+_DASHBOARD_HTML = """<!DOCTYPE html>
+<html><head><title>flink-tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;color:#1a1a1a}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;min-width:40rem}
+ th,td{border:1px solid #ccc;padding:.35rem .6rem;text-align:left;font-size:.9rem}
+ th{background:#f3f3f3}
+ .bar{display:inline-block;height:.7rem;background:#4a7dbd;vertical-align:middle}
+ .bp{background:#c0504d}.idle{background:#9a9a9a}
+ code{background:#f5f5f5;padding:0 .25rem}
+</style></head><body>
+<h1>flink-tpu dashboard</h1>
+<div id="overview"></div>
+<h2>Jobs</h2><table id="jobs"><tr><th>id</th><th>name</th><th>state</th>
+<th>records in/out</th><th>checkpoints</th></tr></table>
+<h2>Vertices</h2><table id="verts"><tr><th>job</th><th>vertex</th>
+<th>parallelism</th><th>busy / backpressured / idle</th></tr></table>
+<script>
+async function refresh(){
+  const ov = await (await fetch('/overview')).json();
+  document.getElementById('overview').textContent =
+    `jobs: ${ov.jobs_total} (running ${ov.jobs_running}, finished `+
+    `${ov.jobs_finished}, failed ${ov.jobs_failed})`;
+  const jobs = (await (await fetch('/jobs')).json()).jobs;
+  const jt = document.getElementById('jobs');
+  const vt = document.getElementById('verts');
+  jt.querySelectorAll('tr:not(:first-child)').forEach(r=>r.remove());
+  vt.querySelectorAll('tr:not(:first-child)').forEach(r=>r.remove());
+  for (const j of jobs){
+    const d = await (await fetch(`/jobs/${j.id}`)).json();
+    const m = await (await fetch(`/jobs/${j.id}/metrics`)).json();
+    const row = jt.insertRow();
+    row.innerHTML = `<td><code>${j.id}</code></td><td>${j.name}</td>`+
+      `<td>${d.state}</td><td>${m.records_in} / ${m.records_out}</td>`+
+      `<td>${d.completed_checkpoints.length}</td>`;
+    for (const v of d.vertices){
+      const r = vt.insertRow();
+      const w = x => Math.round(x*120);
+      r.innerHTML = `<td><code>${j.id}</code></td><td>${v.id}</td>`+
+        `<td>${v.parallelism}</td>`+
+        `<td><span class="bar" style="width:${w(v.busy_ratio)}px"></span>`+
+        `<span class="bar bp" style="width:${w(v.backpressure_ratio)}px"></span>`+
+        `<span class="bar idle" style="width:${w(v.idle_ratio)}px"></span></td>`;
+    }
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""

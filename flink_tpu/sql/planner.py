@@ -349,8 +349,11 @@ class Planner:
             t = Transformation(
                 name=f"sql-join:{jc.table}",
                 operator_factory=(lambda _lk=lk, _rk=rk, _how=jc.kind,
-                                  _rn=dict(rename):
-                                  SqlJoinOperator(_lk, _rk, _how, _rn)),
+                                  _rn=dict(rename), _lc=list(left_names),
+                                  _rc=list(rt.columns):
+                                  SqlJoinOperator(_lk, _rk, _how, _rn,
+                                                  left_columns=_lc,
+                                                  right_columns=_rc)),
                 inputs=[cur_stream.transformation, rstream.transformation],
                 input_partitionings=[Partitioning.HASH, Partitioning.HASH],
                 input_key_columns=[lk, rk],

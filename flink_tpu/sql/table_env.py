@@ -218,6 +218,22 @@ class Table:
             stmt.items = parse(f"SELECT * FROM {stmt.table}").items
         return self.tenv._plan(stmt)
 
+    @staticmethod
+    def _keyed_then(stream, key_column: Optional[str], name: str, factory):
+        """Route to the stateful operator by key (or send EVERYTHING to one
+        subtask when unpartitioned) — per-key state is only correct when
+        every row of a key meets the same operator instance."""
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.graph.transformations import Partitioning
+
+        if key_column is not None:
+            keyed = stream.key_by(key_column)
+            return DataStream(keyed.env, keyed._then(name, factory,
+                                                     chainable=False))
+        t = stream._then(name, factory, partitioning=Partitioning.GLOBAL,
+                         chainable=False)
+        return DataStream(stream.env, t)
+
     def top_n(self, n: int, partition_by: Optional[str],
               order_by: str, ascending: bool = False) -> "TableResult":
         """Top-N per partition (``StreamExecRank`` analog): final ranked
@@ -225,12 +241,10 @@ class Table:
         from flink_tpu.operators.sql_ops import TopNOperator
 
         env, plan = self._planned()
-        t = plan.stream._then(
-            "sql-top-n",
+        out = Table._keyed_then(
+            plan.stream, partition_by, "sql-top-n",
             lambda: TopNOperator(n, partition_by, order_by,
                                  ascending=ascending, emit_changelog=False))
-        from flink_tpu.datastream.api import DataStream
-        out = DataStream(env, t)
         return TableResult(env, QueryPlan(out, plan.output_columns + ["rank"]))
 
     def deduplicate(self, key: str, keep: str = "first",
@@ -239,12 +253,10 @@ class Table:
         from flink_tpu.operators.sql_ops import DeduplicateOperator
 
         env, plan = self._planned()
-        t = plan.stream._then(
-            "sql-deduplicate",
+        out = Table._keyed_then(
+            plan.stream, key, "sql-deduplicate",
             lambda: DeduplicateOperator(key, keep=keep, order_column=order_by))
-        from flink_tpu.datastream.api import DataStream
-        return TableResult(env, QueryPlan(DataStream(env, t),
-                                          plan.output_columns))
+        return TableResult(env, QueryPlan(out, plan.output_columns))
 
 
 class GroupedTable:
@@ -296,10 +308,10 @@ class GroupedTable:
             out_cols.append(out)
 
         env, plan = self.table._planned()
-        t = plan.stream._then(
-            "sql-changelog-agg",
+        out = Table._keyed_then(
+            plan.stream, key, "sql-changelog-agg",
             lambda: ChangelogGroupAggOperator(key, agg_columns))
-        return TableResult(env, QP(DataStream(env, t), out_cols))
+        return TableResult(env, QP(out, out_cols))
 
 
 class TableResult:

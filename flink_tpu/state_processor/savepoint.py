@@ -253,6 +253,12 @@ class SavepointWriter:
         member = _find_member(inner, "key_index", "keys")
         if member is None:
             raise ValueError(f"{uid}: no keyed state to transform")
+        if not any(k.startswith(f"state.{state_name}.") for k in member):
+            raise ValueError(
+                f"{uid}: no heap state named {state_name!r} in the snapshot "
+                f"(fields: {sorted(member)[:8]}); operators that keep state "
+                f"in dense row fields (e.g. keyed reduce 'leaves') are not "
+                f"transformable via transform_keyed_state")
         restorable = {k: v for k, v in member.items() if k != "timers"}
         if "key_index" not in restorable and "keys" in restorable:
             restorable["key_index"] = restorable.pop("keys")

@@ -155,3 +155,29 @@ def test_table_where_survives_topn_dedup():
     rows = te.sql_query("SELECT * FROM t").where("v < 10").top_n(
         5, partition_by=None, order_by="v").collect()
     assert sorted(r["v"] for r in rows) == [5.0, 7.0]
+
+
+def test_left_join_empty_right_side():
+    """Regression: an EMPTY right side must still produce null-filled right
+    columns on a LEFT JOIN."""
+    te = TableEnvironment()
+    te.register_collection("l", columns={"k": np.array([1, 2], np.int64),
+                                         "lv": np.array([10., 20.])})
+    te.register_collection("r", columns={"k": np.zeros(0, np.int64),
+                                         "name": np.zeros(0, object)})
+    rows = te.execute_sql(
+        "SELECT l.lv, r.name FROM l LEFT JOIN r ON l.k = r.k").collect()
+    assert len(rows) == 2 and all(r["name"] is None for r in rows)
+
+
+def test_dedup_parallel_correct():
+    """Regression: deduplicate must hash-route by key so parallelism > 1
+    cannot emit a key twice."""
+    te = TableEnvironment(parallelism=2)
+    n = 2000
+    te.register_collection("t", columns={
+        "k": np.arange(n) % 50, "v": np.arange(n, dtype=np.float64)},
+        batch_size=64)
+    rows = te.sql_query("SELECT * FROM t").deduplicate("k").collect()
+    ks = [r["k"] for r in rows]
+    assert sorted(ks) == sorted(set(ks)) and len(set(ks)) == 50
