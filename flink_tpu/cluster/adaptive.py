@@ -1,0 +1,243 @@
+"""Adaptive (reactive) scheduler: rescale the job to available slots.
+
+Analog of ``runtime/scheduler/adaptive/AdaptiveScheduler.java:146``
+(FLIP-160): a state machine — Created → WaitingForResources → Executing →
+Restarting → Finished/Failed — that sizes the job to whatever slots exist.
+``declare_slots(n)`` (the reactive-mode resource declaration) triggers a
+rescale: take a savepoint, cancel, re-split every keyed vertex's state to
+the new parallelism through the key-group redistribution path, and redeploy.
+
+Rescale contract: sources must have STABLE splits (split count independent
+of job parallelism — files, log partitions); their offsets carry over
+unchanged.  Keyed vertex state is merged across old subtasks and re-split
+by key-group range (``StateAssignmentOperation.reDistributeKeyedStates``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
+                                        RestartStrategy)
+from flink_tpu.cluster.minicluster import JobResult, MiniCluster
+from flink_tpu.graph.stream_graph import ExecutionPlan
+from flink_tpu.state.redistribute import split_keyed_snapshot
+from flink_tpu.state_processor.savepoint import (_is_keyed,
+                                                 _merged_operator_snapshot)
+
+
+class SchedulerStates:
+    CREATED = "Created"
+    WAITING_FOR_RESOURCES = "WaitingForResources"
+    EXECUTING = "Executing"
+    RESTARTING = "Restarting"
+    FINISHED = "Finished"
+    FAILED = "Failed"
+    CANCELED = "Canceled"
+
+
+def _split_member(member: Dict[str, Any], max_parallelism: int,
+                  n: int) -> List[Dict[str, Any]]:
+    if "pane_base" in member:
+        from flink_tpu.operators.window_agg import WindowAggOperator
+        return WindowAggOperator.split_snapshot(member, max_parallelism, n)
+    if _is_keyed(member):
+        fields = sorted({k for k in member
+                         if k.startswith("state.") or k == "leaves"})
+        return split_keyed_snapshot(member, fields, max_parallelism, n)
+    # stateless / non-keyed member: subtask 0 keeps it, others start fresh
+    return [member] + [{} for _ in range(n - 1)]
+
+
+def rescale_snapshot(snapshot: Dict[str, Any], plan: ExecutionPlan,
+                     new_counts: Dict[str, int]) -> Dict[str, Any]:
+    """A MiniCluster checkpoint taken at one parallelism -> restorable at
+    another (the StateAssignmentOperation analog)."""
+    out: Dict[str, Any] = {}
+    by_uid = {v.uid: v for v in plan.vertices}
+    for uid, entry in snapshot.items():
+        if uid.startswith("__"):
+            out[uid] = entry
+            continue
+        v = by_uid.get(uid)
+        n_new = new_counts.get(uid)
+        if v is None or n_new is None:
+            out[uid] = entry
+            continue
+        old_subs = entry.get("subtasks", []) if isinstance(entry, dict) else []
+        if v.is_source:
+            if len(old_subs) != n_new:
+                raise ValueError(
+                    f"rescale: source {uid!r} split count changed "
+                    f"({len(old_subs)} -> {n_new}); adaptive rescale needs "
+                    f"stable-split sources (files / log partitions)")
+            out[uid] = entry
+            continue
+        if len(old_subs) == n_new:
+            out[uid] = entry
+            continue
+        merged = _merged_operator_snapshot(entry)
+        inner = merged.get("operator", merged)
+        maxp = v.max_parallelism
+        member_keys = [k for k in inner
+                       if k.startswith("op") and k[2:].isdigit()]
+        parts: List[Dict[str, Any]]
+        if member_keys:
+            split_members = {mk: _split_member(inner[mk], maxp, n_new)
+                             for mk in member_keys}
+            passthrough = {k: v2 for k, v2 in inner.items()
+                           if k not in member_keys}
+            parts = [dict(passthrough,
+                          **{mk: split_members[mk][i] for mk in member_keys})
+                     for i in range(n_new)]
+        else:
+            parts = _split_member(inner, maxp, n_new)
+        wrapped = []
+        for p in parts:
+            if isinstance(merged, dict) and "operator" in merged:
+                w = {k: v2 for k, v2 in merged.items() if k != "operator"}
+                w["operator"] = p
+                wrapped.append(w)
+            else:
+                wrapped.append({"operator": p, "valve": None}
+                               if "operator" not in p else p)
+        # subtask snapshots are {"operator": ..., "valve": ...} shaped
+        out[uid] = {"subtasks": [
+            w if "operator" in w else {"operator": w} for w in wrapped]}
+    return out
+
+
+class AdaptiveScheduler:
+    """Reactive scheduler over the MiniCluster."""
+
+    def __init__(self, plan_factory: Callable[[int], ExecutionPlan],
+                 checkpoint_storage=None, checkpoint_interval_ms: int = 20,
+                 restart_strategy: Optional[RestartStrategy] = None,
+                 min_slots: int = 1):
+        self.plan_factory = plan_factory
+        self.checkpoint_storage = checkpoint_storage
+        self.checkpoint_interval_ms = checkpoint_interval_ms
+        self.restart_strategy = restart_strategy or FixedDelayRestartStrategy(2)
+        self.min_slots = min_slots
+        self.state = SchedulerStates.CREATED
+        self._slots = 0
+        self._desired_slots = 0
+        self._cluster: Optional[MiniCluster] = None
+        self._result: Optional[JobResult] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.rescales = 0
+
+    # -- resources (reactive declaration) ------------------------------------
+    def declare_slots(self, n: int) -> None:
+        """Reactive mode: the cluster now has ``n`` slots; the scheduler
+        rescales the job to use all of them (FLIP-160)."""
+        with self._lock:
+            self._desired_slots = n
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "AdaptiveScheduler":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="adaptive-scheduler")
+        self._thread.start()
+        return self
+
+    def cancel(self) -> None:
+        self._stop.set()
+        if self._cluster is not None:
+            self._cluster.cancel()
+
+    def join(self, timeout_s: float = 120.0) -> Optional[JobResult]:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+        return self._result
+
+    # -- state machine --------------------------------------------------------
+    def _run(self) -> None:
+        self.state = SchedulerStates.WAITING_FOR_RESOURCES
+        while not self._stop.is_set():
+            with self._lock:
+                desired = self._desired_slots
+            if desired >= self.min_slots:
+                break
+            time.sleep(0.01)
+        raw_restore: Optional[Dict[str, Any]] = None
+        while not self._stop.is_set():
+            with self._lock:
+                self._slots = self._desired_slots
+            parallelism = max(self.min_slots, self._slots)
+            plan = self.plan_factory(parallelism)
+            # split the snapshot for the parallelism we ACTUALLY deploy at —
+            # desired slots may have moved again since the savepoint was
+            # taken, and restoring N-way-split state into M subtasks would
+            # silently drop/misroute key-group ranges
+            if raw_restore is not None:
+                counts = {
+                    v.uid: (len(v.chain[0].source.create_splits(parallelism))
+                            if v.is_source else parallelism)
+                    for v in plan.vertices}
+                restore = rescale_snapshot(raw_restore, plan, counts)
+            else:
+                restore = None
+            cluster = MiniCluster(
+                checkpoint_storage=self.checkpoint_storage,
+                checkpoint_interval_ms=self.checkpoint_interval_ms)
+            self._cluster = cluster
+            self.state = SchedulerStates.EXECUTING
+            done: Dict[str, Any] = {}
+
+            def run_job(pl=plan, cl=cluster, rs=restore):
+                done["result"] = cl.execute(pl, restore=rs, timeout_s=600)
+
+            th = threading.Thread(target=run_job, daemon=True)
+            th.start()
+            rescale_to: Optional[int] = None
+            while th.is_alive():
+                if self._stop.is_set():
+                    cluster.cancel()
+                    break
+                with self._lock:
+                    if self._desired_slots != parallelism and \
+                            self._desired_slots >= self.min_slots:
+                        rescale_to = self._desired_slots
+                if rescale_to is not None:
+                    break
+                time.sleep(0.01)
+            if rescale_to is not None:
+                # take a consistent cut and stop; the split happens at the
+                # top of the loop for whatever parallelism wins
+                self.state = SchedulerStates.RESTARTING
+                sp = cluster.savepoint()
+                cluster.cancel()
+                th.join(timeout=60)
+                raw_restore = (self.checkpoint_storage.load(sp)
+                               if sp is not None and self.checkpoint_storage
+                               else getattr(cluster, "_latest_snapshot", None))
+                self.rescales += 1
+                continue
+            th.join(timeout=60)
+            result = done.get("result")
+            self._result = result
+            if result is None or self._stop.is_set():
+                self.state = SchedulerStates.CANCELED
+                return
+            if result.state == "FINISHED":
+                self.state = SchedulerStates.FINISHED
+                return
+            if result.state == "CANCELED":
+                self.state = SchedulerStates.CANCELED
+                return
+            # failure: consult the restart strategy
+            self.restart_strategy.notify_failure()
+            if not self.restart_strategy.can_restart():
+                self.state = SchedulerStates.FAILED
+                return
+            self.state = SchedulerStates.RESTARTING
+            time.sleep(self.restart_strategy.delay_ms() / 1000.0)
+            raw_restore = (self.checkpoint_storage.load_latest()
+                           if self.checkpoint_storage else
+                           getattr(self._cluster, "_latest_snapshot", None))
+        self.state = SchedulerStates.CANCELED

@@ -58,7 +58,10 @@ class MiniCluster(TaskListener):
     def __init__(self, checkpoint_storage=None, checkpoint_interval_ms: int = 0,
                  unaligned: bool = False, checkpoint_timeout_s: float = 60.0,
                  restart_attempts: int = 0, restart_delay_ms: int = 50,
-                 channel_capacity: int = 32):
+                 channel_capacity: int = 32, restart_strategy=None):
+        from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
+                                                NoRestartStrategy)
+
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.unaligned = unaligned
@@ -66,6 +69,11 @@ class MiniCluster(TaskListener):
         self.restart_attempts = restart_attempts
         self.restart_delay_ms = restart_delay_ms
         self.channel_capacity = channel_capacity
+        #: pluggable restart policy (fixed/exponential/failure-rate);
+        #: restart_attempts kept as the back-compat shorthand
+        self.restart_strategy = restart_strategy or (
+            FixedDelayRestartStrategy(restart_attempts, restart_delay_ms)
+            if restart_attempts > 0 else NoRestartStrategy())
         self._lock = threading.Lock()
         self._tasks: List[SubtaskBase] = []
         self._pending: Optional[_PendingCheckpoint] = None
@@ -73,6 +81,10 @@ class MiniCluster(TaskListener):
         self._next_checkpoint_id = 1
         self._failed: Optional[str] = None
         self._stop_requested = False
+        # pre-deploy defaults: REST calls may land before execute()
+        self._finished: set = set()
+        self._source_tasks: List[SourceSubtask] = []
+        self._subtask_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------ listener
     def task_state_changed(self, vertex_uid: str, subtask_index: int,
@@ -123,12 +135,15 @@ class MiniCluster(TaskListener):
 
     # ------------------------------------------------------------ deploy
     def _deploy(self, plan: ExecutionPlan,
-                restore: Optional[Dict[str, Any]]) -> None:
-        self._tasks = []
-        self._failed = None
-        self._pending = None
-        self._finished = set()
-        source_tasks: List[SourceSubtask] = []
+                restore: Optional[Dict[str, Any]],
+                _keep_tasks: Optional[List[SubtaskBase]] = None) -> None:
+        self._tasks = list(_keep_tasks or [])
+        if _keep_tasks is None:
+            self._failed = None
+            self._pending = None
+            self._finished = set()
+        source_tasks: List[SourceSubtask] = [
+            t for t in self._tasks if isinstance(t, SourceSubtask)]
         subtask_counts: Dict[str, int] = {}
         # source parallelism = split count (one SourceSubtask per split)
         splits_by_vertex: Dict[int, list] = {}
@@ -140,7 +155,10 @@ class MiniCluster(TaskListener):
                 subtask_counts[v.uid] = max(1, len(splits))
             else:
                 subtask_counts[v.uid] = v.parallelism
-        self._subtask_counts = subtask_counts
+        if _keep_tasks is None:
+            self._subtask_counts = subtask_counts
+        else:
+            self._subtask_counts.update(subtask_counts)
 
         def n_subs(v: PlanVertex) -> int:
             return subtask_counts[v.uid]
@@ -216,6 +234,8 @@ class MiniCluster(TaskListener):
                         < self.checkpoint_timeout_s):
                     return None, "busy"   # previous still in flight
                 self._pending = None  # timed out: abort
+            if not self._tasks:
+                return None, "declined"   # nothing deployed yet
             # finished sources cannot inject barriers and finished tasks
             # never ack — decline once any source finished, exclude finished
             # tasks from the expectation otherwise
@@ -237,8 +257,13 @@ class MiniCluster(TaskListener):
     def execute(self, plan: ExecutionPlan,
                 restore: Optional[Dict[str, Any]] = None,
                 timeout_s: float = 300.0) -> JobResult:
+        import copy as _copy
+
         t0 = time.monotonic()
         restarts = 0
+        # restart budgets are per execution (per-ExecutionGraph in the
+        # reference): a fresh strategy instance each run
+        self._active_strategy = _copy.deepcopy(self.restart_strategy)
         self._deploy(plan, restore)
         last_trigger = time.monotonic()
         while True:
@@ -250,19 +275,16 @@ class MiniCluster(TaskListener):
                                  self._completed_ids, "timeout")
             if self._failed is not None:
                 err = self._failed
+                failed_uid = err.split("[", 1)[0]
+                self._active_strategy.notify_failure()
+                if self._active_strategy.can_restart():
+                    restarts += 1
+                    time.sleep(self._active_strategy.delay_ms() / 1000.0)
+                    self._restart_failed_region(plan, failed_uid)
+                    continue
                 self.cancel()
                 for t in self._tasks:
                     t.join()
-                latest = None
-                if self.checkpoint_storage is not None:
-                    latest = self.checkpoint_storage.load_latest()
-                elif getattr(self, "_latest_snapshot", None) is not None:
-                    latest = self._latest_snapshot
-                if restarts < self.restart_attempts:
-                    restarts += 1
-                    time.sleep(self.restart_delay_ms / 1000.0)
-                    self._deploy(plan, latest)
-                    continue
                 return JobResult(plan.job_name, TaskStates.FAILED,
                                  (time.monotonic() - t0) * 1000, restarts,
                                  self._completed_ids, err)
@@ -280,6 +302,52 @@ class MiniCluster(TaskListener):
                     >= self.checkpoint_interval_ms):
                 if self.trigger_checkpoint() is not None:
                     last_trigger = time.monotonic()
+
+    def _restart_failed_region(self, plan: ExecutionPlan,
+                               failed_uid: str) -> None:
+        """Pipelined-region failover: restart only the connected component
+        containing the failed vertex (``RestartPipelinedRegionFailover
+        Strategy``); disconnected regions keep running."""
+        from flink_tpu.cluster.failover import region_of
+
+        try:
+            region = region_of(plan, failed_uid)
+        except KeyError:
+            region = {v.uid for v in plan.vertices}
+        latest = None
+        if self.checkpoint_storage is not None:
+            latest = self.checkpoint_storage.load_latest()
+        elif getattr(self, "_latest_snapshot", None) is not None:
+            latest = self._latest_snapshot
+        all_uids = {v.uid for v in plan.vertices}
+        if region == all_uids:
+            self.cancel()
+            for t in self._tasks:
+                t.join()
+            self._deploy(plan, latest)
+            return
+        # pin uids: the region sub-plan re-runs topo indexing, and
+        # position-derived uids would shift — snapshots key on them
+        for v in plan.vertices:
+            if not any(t.uid for t in v.chain):
+                v.chain[0].uid = v.uid
+        # cancel + drop only the failed region's tasks, keep the rest
+        keep, dead = [], []
+        for t in self._tasks:
+            (dead if t.vertex_uid in region else keep).append(t)
+        for t in dead:
+            t.cancel()
+        for t in dead:
+            t.join()
+        survivors = keep
+        with self._lock:
+            self._failed = None
+            self._pending = None
+            self._finished = {f for f in self._finished
+                              if f[0] not in region}
+        region_plan = ExecutionPlan(
+            [v for v in plan.vertices if v.uid in region], plan.job_name)
+        self._deploy(region_plan, latest, _keep_tasks=survivors)
 
     def cancel(self) -> None:
         for t in self._tasks:

@@ -18,8 +18,11 @@ from typing import Any, Dict, List, Optional
 
 
 def sample_stacks(duration_ms: int = 200, interval_ms: int = 5,
-                  thread_prefix: Optional[str] = None) -> Counter:
-    """Collapsed stack counter: 'frameA;frameB;frameC' -> samples."""
+                  thread_prefix: Optional[str] = None,
+                  thread_names: Optional[set] = None) -> Counter:
+    """Collapsed stack counter: 'frameA;frameB;frameC' -> samples.
+    ``thread_names``: exact-name allowlist (per-job scoping); otherwise
+    ``thread_prefix`` filters by prefix."""
     folded: Counter = Counter()
     deadline = time.monotonic() + duration_ms / 1000.0
     names = {t.ident: t.name for t in threading.enumerate()}
@@ -28,7 +31,10 @@ def sample_stacks(duration_ms: int = 200, interval_ms: int = 5,
             name = names.get(tid, str(tid))
             if tid == threading.get_ident():
                 continue  # skip the sampler itself
-            if thread_prefix and not name.startswith(thread_prefix):
+            if thread_names is not None:
+                if name not in thread_names:
+                    continue
+            elif thread_prefix and not name.startswith(thread_prefix):
                 continue
             stack = traceback.extract_stack(frame)
             key = ";".join(f"{f.name} ({f.filename.rsplit('/', 1)[-1]}"
@@ -60,6 +66,7 @@ def folded_to_tree(folded: Counter) -> Dict[str, Any]:
 
 
 def flamegraph(duration_ms: int = 200, interval_ms: int = 5,
-               thread_prefix: Optional[str] = "task-") -> Dict[str, Any]:
+               thread_prefix: Optional[str] = "task-",
+               thread_names: Optional[set] = None) -> Dict[str, Any]:
     return folded_to_tree(sample_stacks(duration_ms, interval_ms,
-                                        thread_prefix))
+                                        thread_prefix, thread_names))

@@ -145,7 +145,12 @@ class RestServer:
                     return self._send({"root_exception": status["failure"]})
                 if sub == "flamegraph":
                     from flink_tpu.rest.flamegraph import flamegraph
-                    return self._send(flamegraph(duration_ms=150))
+                    # scope to THIS job's subtask threads — concurrent jobs
+                    # must not pollute each other's profiles
+                    names = {f"task-{t.vertex_uid}-{t.subtask_index}"
+                             for t in getattr(cluster, "_tasks", [])}
+                    return self._send(flamegraph(duration_ms=150,
+                                                 thread_names=names))
                 return self._send({"error": f"unknown path {sub}"}, 404)
 
             def do_POST(self):  # noqa: N802
