@@ -130,6 +130,7 @@ class AdaptiveScheduler:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self.rescales = 0
+        self.error: Optional[str] = None
 
     # -- resources (reactive declaration) ------------------------------------
     def declare_slots(self, n: int) -> None:
@@ -157,6 +158,13 @@ class AdaptiveScheduler:
 
     # -- state machine --------------------------------------------------------
     def _run(self) -> None:
+        try:
+            self._run_inner()
+        except Exception as e:  # noqa: BLE001 — scheduler thread must not die silently
+            self.error = f"{type(e).__name__}: {e}"
+            self.state = SchedulerStates.FAILED
+
+    def _run_inner(self) -> None:
         self.state = SchedulerStates.WAITING_FOR_RESOURCES
         while not self._stop.is_set():
             with self._lock:
@@ -215,7 +223,7 @@ class AdaptiveScheduler:
                 th.join(timeout=60)
                 raw_restore = (self.checkpoint_storage.load(sp)
                                if sp is not None and self.checkpoint_storage
-                               else getattr(cluster, "_latest_snapshot", None))
+                               else cluster.latest_restore())
                 self.rescales += 1
                 continue
             th.join(timeout=60)
@@ -239,5 +247,5 @@ class AdaptiveScheduler:
             time.sleep(self.restart_strategy.delay_ms() / 1000.0)
             raw_restore = (self.checkpoint_storage.load_latest()
                            if self.checkpoint_storage else
-                           getattr(self._cluster, "_latest_snapshot", None))
+                           self._cluster.latest_restore())
         self.state = SchedulerStates.CANCELED

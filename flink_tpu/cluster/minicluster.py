@@ -314,11 +314,7 @@ class MiniCluster(TaskListener):
             region = region_of(plan, failed_uid)
         except KeyError:
             region = {v.uid for v in plan.vertices}
-        latest = None
-        if self.checkpoint_storage is not None:
-            latest = self.checkpoint_storage.load_latest()
-        elif getattr(self, "_latest_snapshot", None) is not None:
-            latest = self._latest_snapshot
+        latest = self.latest_restore()
         all_uids = {v.uid for v in plan.vertices}
         if region == all_uids:
             self.cancel()
@@ -341,13 +337,24 @@ class MiniCluster(TaskListener):
             t.join()
         survivors = keep
         with self._lock:
-            self._failed = None
+            # only clear the failure we are handling: a DIFFERENT region may
+            # have failed in the meantime and must get its own restart
+            if self._failed is not None and \
+                    self._failed.split("[", 1)[0] in region:
+                self._failed = None
             self._pending = None
             self._finished = {f for f in self._finished
                               if f[0] not in region}
         region_plan = ExecutionPlan(
             [v for v in plan.vertices if v.uid in region], plan.job_name)
         self._deploy(region_plan, latest, _keep_tasks=survivors)
+
+    def latest_restore(self) -> Optional[Dict[str, Any]]:
+        """Most recent restorable snapshot: durable storage first, else the
+        in-memory copy of the last completed checkpoint."""
+        if self.checkpoint_storage is not None:
+            return self.checkpoint_storage.load_latest()
+        return getattr(self, "_latest_snapshot", None)
 
     def cancel(self) -> None:
         for t in self._tasks:

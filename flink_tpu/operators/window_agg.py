@@ -54,6 +54,76 @@ from flink_tpu.windowing.assigners import GlobalWindows, WindowAssigner
 from flink_tpu.windowing.triggers import EventTimeTrigger, Trigger
 
 
+def _compact_indices(mask, cap: int, fill: int):
+    """(count, idx[cap]) of True positions, in order; extra rows get ``fill``.
+
+    Equivalent to ``jnp.nonzero(mask, size=cap, fill_value=fill)`` but built
+    from a HIERARCHICAL cumsum (2-D reshape): XLA compiles the flat 1M-element
+    cumsum of ``nonzero`` in ~27s on TPU, the row/column decomposition in
+    ~1.8s with identical sub-ms execution."""
+    K = mask.shape[0]
+    R = 1 << (max(K.bit_length() - 1, 2) // 2)
+    while K % R:
+        R >>= 1
+    C = K // R
+    m2 = mask.reshape(R, C)
+    within = jnp.cumsum(m2, axis=1)
+    row_tot = within[:, -1]
+    offs = jnp.cumsum(row_tot) - row_tot
+    pos = (within - 1 + offs[:, None]).reshape(K)
+    write = jnp.where(mask, pos, cap).astype(jnp.int32)
+    idx = jnp.full((cap,), fill, jnp.int32).at[write].set(
+        jnp.arange(K, dtype=jnp.int32), mode="drop")
+    return row_tot.sum().astype(jnp.int32), idx
+
+
+def _fetch_enqueue(arrays, chunk_bytes: int = 2 << 20):
+    """Slice arrays into ~2MB row chunks and start async device->host copies;
+    returns a handle for :func:`_fetch_collect`.  Chunked + pipelined copies
+    move ~3x faster over the single-chip tunnel than one large transfer."""
+    sliced = []
+    for a in arrays:
+        if a.ndim == 0 or a.nbytes <= chunk_bytes:
+            sliced.append([a])
+            continue
+        rows = max(1, int(chunk_bytes // max(1, a.nbytes // a.shape[0])))
+        sliced.append([a[i:i + rows] for i in range(0, a.shape[0], rows)])
+    for chunks in sliced:
+        for c in chunks:
+            try:
+                c.copy_to_host_async()
+            except AttributeError:
+                pass
+    return sliced
+
+
+def _fetch_collect(sliced):
+    out = []
+    for chunks in sliced:
+        if len(chunks) == 1:
+            out.append(np.asarray(chunks[0]))
+        else:
+            out.append(np.concatenate([np.asarray(c) for c in chunks]))
+    return out
+
+
+def _fetch_chunked(arrays, chunk_bytes: int = 2 << 20):
+    """Blocking chunked fetch (enqueue + collect)."""
+    return _fetch_collect(_fetch_enqueue(arrays, chunk_bytes))
+
+
+def _handle_ready(sliced) -> bool:
+    """True when every chunk's device->host copy has completed."""
+    for chunks in sliced:
+        for c in chunks:
+            try:
+                if not c.is_ready():
+                    return False
+            except AttributeError:
+                return True  # no readiness API: treat as ready (will block)
+    return True
+
+
 def _next_pow2(n: int, floor: int = 1) -> int:
     c = floor
     while c < n:
@@ -80,7 +150,14 @@ class WindowAggOperator(StreamOperator):
         max_batch: int = 1 << 16,
         name: str = "window-agg",
         sharding=None,
+        async_fire: bool = False,
     ):
+        #: opt-in: window emissions materialize on the NEXT operator call
+        #: (downloads overlap subsequent device work).  Terminal-sink
+        #: pipelines only — downstream event-time operators would see fired
+        #: rows after the firing watermark.
+        self.async_fire = async_fire
+        self._pending_fires: List[tuple] = []
         self.assigner = assigner
         self.agg = agg
         self.key_column = key_column
@@ -174,6 +251,8 @@ class WindowAggOperator(StreamOperator):
         self.key_index = None
         self._leaves = None
         self._counts = None
+        self._pending_fires = []
+        self._emit_hist = []
         self.pane_base = None
         self.max_pane = None
         self.last_fired_window = None
@@ -284,6 +363,22 @@ class WindowAggOperator(StreamOperator):
             ka <<= 2
         return min(ka, self._K)
 
+    @partial(jax.jit, static_argnums=(0, 4))
+    def _fire_dense_step(self, leaves, counts, pane_slots, k_active: int):
+        """Fire + DENSE download layout: (mask bits u32[K/32], result leaves
+        [K, ...]).  For high-hit-rate fires (most keys emit) the dense form
+        moves ~2x fewer bytes than the packed idx+gather form."""
+        mask, result = self._fire_core(leaves, counts, pane_slots, k_active)
+        K = mask.shape[0]
+        pad = (-K) % 32
+        m = mask
+        if pad:
+            m = jnp.concatenate([m, jnp.zeros((pad,), bool)])
+        bits = (m.reshape(-1, 32).astype(jnp.uint32)
+                << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1,
+                                                         dtype=jnp.uint32)
+        return bits, result
+
     @partial(jax.jit, static_argnums=(0, 4, 5))
     def _fire_pack_step(self, leaves, counts, pane_slots, k_active: int,
                         cap: int):
@@ -295,8 +390,7 @@ class WindowAggOperator(StreamOperator):
         (``WindowOperator.emitWindowContents:574``)."""
         mask, result = self._fire_core(leaves, counts, pane_slots, k_active)
         K = k_active if (k_active and k_active < counts.shape[0]) else counts.shape[0]
-        n = jnp.sum(mask).astype(jnp.int32)
-        (idx,) = jnp.nonzero(mask, size=cap, fill_value=K)
+        n, idx = _compact_indices(mask, cap, K)
         parts = [n.reshape(1), idx.astype(jnp.int32)]
         for l in jax.tree_util.tree_leaves(result):
             g = jnp.take(l, jnp.minimum(idx, K - 1), axis=0)
@@ -337,15 +431,16 @@ class WindowAggOperator(StreamOperator):
         boost = getattr(self, "_emit_boost", 1)
         cap = min(ka, max(1024, (ka >> 3) * boost))
         treedef, row_layout = self._result_layout()
-        packed = np.asarray(self._fire_pack_step(
-            self._leaves, self._counts, pane_slots, self._k_active(), cap))
+        [packed] = _fetch_chunked([self._fire_pack_step(
+            self._leaves, self._counts, pane_slots, self._k_active(), cap)])
         n = int(packed[0])
         while n > cap and cap < ka:  # overflow: boost and retry
             boost = self._emit_boost = boost * 4
             cap = min(ka, max(1024, (ka >> 3) * boost))
-            packed = np.asarray(self._fire_pack_step(
-                self._leaves, self._counts, pane_slots, self._k_active(), cap))
+            [packed] = _fetch_chunked([self._fire_pack_step(
+                self._leaves, self._counts, pane_slots, self._k_active(), cap)])
             n = int(packed[0])
+        self._note_emit(n)
         if n == 0:
             return []
         idx = packed[1:1 + cap][:n]
@@ -368,7 +463,66 @@ class WindowAggOperator(StreamOperator):
             res_leaves.append(arr)
             off += cap * words
         result = jax.tree_util.tree_unflatten(treedef, res_leaves)
-        window = self.assigner.window_bounds(window_id)
+        return self._rows_for(np.asarray(idx), result,
+                              self.assigner.window_bounds(window_id))
+
+    def _fire_window_dense(self, window_id: int,
+                           pane_slots) -> List[StreamElement]:
+        bits, result = self._fire_dense_step(
+            self._leaves, self._counts, pane_slots, self._k_active())
+        res_leaves = jax.tree_util.tree_leaves(result)
+        handle = _fetch_enqueue([bits] + list(res_leaves))
+        treedef = jax.tree_util.tree_structure(result)
+        if self.async_fire:
+            self._pending_fires.append((window_id, handle, treedef))
+            return []
+        return self._finish_dense_fire(window_id, handle, treedef)
+
+    def _note_emit(self, n: int) -> None:
+        hist = getattr(self, "_emit_hist", None)
+        if hist is None:
+            hist = self._emit_hist = []
+        hist.append(n)
+        del hist[:-3]
+
+    def drain_pending_fires(self, force: bool = False) -> List[StreamElement]:
+        """Materialize async fire downloads IN ORDER, but only those whose
+        transfers completed (unless ``force``): blocking on an in-flight
+        download would re-serialize it with the next batch's device work —
+        the whole point of async_fire is that fires stream out in the
+        background.  Depth is bounded so memory stays bounded."""
+        if not self._pending_fires:
+            return []
+        if len(self._pending_fires) > 3:
+            force = True
+        out: List[StreamElement] = []
+        while self._pending_fires:
+            window_id, handle, treedef = self._pending_fires[0]
+            if not force and not _handle_ready(handle):
+                break
+            self._pending_fires.pop(0)
+            out.extend(self._finish_dense_fire(window_id, handle, treedef))
+        return out
+
+    def _finish_dense_fire(self, window_id: int, handle,
+                           treedef) -> List[StreamElement]:
+        fetched = _fetch_collect(handle)
+        bits_np, res_np = fetched[0], fetched[1:]
+        mask = np.unpackbits(bits_np.view(np.uint8), bitorder="little")
+        nk = self.key_index.num_keys
+        idx = np.nonzero(mask[:nk])[0]
+        self._note_emit(idx.size)
+        if idx.size == 0:
+            return []
+        picked = jax.tree_util.tree_unflatten(
+            treedef, [r[idx] for r in res_np])
+        return self._rows_for(idx, picked,
+                              self.assigner.window_bounds(window_id))
+
+    def _rows_for(self, idx: np.ndarray, result,
+                  window) -> List[StreamElement]:
+        """Shared emit-row assembly (dense/packed/fallback fire paths)."""
+        n = idx.size
         keys = np.asarray(self.key_index.reverse_keys())[idx]
         cols: Dict[str, Any] = {self.key_column: keys}
         if isinstance(result, dict):
@@ -402,8 +556,9 @@ class WindowAggOperator(StreamOperator):
 
     # --------------------------------------------------------------- batching
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        pending = self.drain_pending_fires() if self.async_fire else []
         if len(batch) == 0:
-            return []
+            return pending
         cols = batch.columns
         keys = np.asarray(cols[self.key_column])
         if self.key_index is None:
@@ -425,7 +580,7 @@ class WindowAggOperator(StreamOperator):
                 self.late_dropped += int(np.count_nonzero(~live))
                 batch = batch.select(live)
                 if len(batch) == 0:
-                    return []
+                    return pending
                 cols = batch.columns
                 keys = np.asarray(cols[self.key_column])
                 ts = ts[live]
@@ -468,7 +623,7 @@ class WindowAggOperator(StreamOperator):
             self._leaves, self._counts,
             jnp.asarray(flat_p, jnp.int32), values_p)
 
-        out: List[StreamElement] = []
+        out: List[StreamElement] = list(pending)
         # ---- count-trigger (GlobalWindows / countWindow path)
         if self.trigger.fires_on_count:
             out.extend(self._fire_by_count())
@@ -509,10 +664,15 @@ class WindowAggOperator(StreamOperator):
         windows emit nothing — matching the reference, where a trailing
         partial countWindow is dropped at end of input."""
         if isinstance(self.assigner, GlobalWindows):
+            pending = self.drain_pending_fires() if self.async_fire else []
             if self.trigger.fires_on_time:
-                return self._fire_by_count(force=True)
-            return []
-        return self._advance_time(2 ** 62)
+                return pending + self._fire_by_count(force=True)
+            return pending
+        out = self._advance_time(2 ** 62)
+        # a 2^62 watermark can START async fires in the same call: drain them
+        if self.async_fire:
+            out.extend(self.drain_pending_fires(force=True))
+        return out
 
     def _now_ms(self) -> int:
         import time
@@ -520,12 +680,14 @@ class WindowAggOperator(StreamOperator):
         return int(time.time() * 1000)
 
     def _advance_time(self, now: int) -> List[StreamElement]:
+        # async fires from earlier calls surface before any new ones
+        _pending = self.drain_pending_fires() if self.async_fire else []
         if self._leaves is None or self.pane_base is None:
-            return []
+            return _pending
         a = self.assigner
         if isinstance(a, GlobalWindows):  # no time-bounded panes to fire
-            return []
-        out: List[StreamElement] = []
+            return _pending
+        out: List[StreamElement] = list(_pending)
         # largest w whose maxTimestamp (= end-1) has been passed — the fire
         # condition of EventTimeTrigger: watermark >= window.maxTimestamp
         denom = a.pane_stride * a.pane_ms
@@ -579,6 +741,17 @@ class WindowAggOperator(StreamOperator):
         panes = np.arange(first, last + 1, dtype=np.int64)
         pane_slots = jnp.asarray(panes % self._P, jnp.int32)
         if self.sharding is None and self.key_index is not None:
+            # expected emit size picks the wire format: dense (bitmask +
+            # full-width rows) when most keys fire, packed (idx + gather)
+            # when sparse — both chunk-async downloaded.  The estimate is the
+            # MAX over recent fires: a single small flush (e.g. the
+            # end-of-input tail) must not flip a steady dense workload onto
+            # the packed path, whose overflow retries cost several downloads.
+            ka = self._k_active() or self._K
+            hist = getattr(self, "_emit_hist", None)
+            expected = max(hist) if hist else ka
+            if expected * 4 >= ka:
+                return self._fire_window_dense(window_id, pane_slots)
             return self._fire_window_packed(window_id, pane_slots)
         mask, result = self._fire_step(self._leaves, self._counts, pane_slots,
                                        self._k_active())
@@ -609,21 +782,20 @@ class WindowAggOperator(StreamOperator):
         idx = np.nonzero(mask_np)[0]
         if idx.size == 0:
             return []
-        keys = np.asarray(self.key_index.reverse_keys())[idx]
-        cols: Dict[str, Any] = {self.key_column: keys}
         res_np = jax.tree_util.tree_map(lambda a: np.asarray(a)[idx], result)
-        if isinstance(res_np, dict):
-            cols.update(res_np)
-        else:
-            cols[self.output_column] = res_np
-        if self.emit_window_bounds:
-            cols["window_start"] = np.full(idx.size, window.start, np.int64)
-            cols["window_end"] = np.full(idx.size, window.end, np.int64)
-        ts = np.full(idx.size, window.max_timestamp, np.int64)
-        return [RecordBatch(cols, timestamps=ts)]
+        return self._rows_for(idx, res_np, window)
 
     # ------------------------------------------------------------- snapshots
     def snapshot_state(self) -> Dict[str, Any]:
+        if self._pending_fires:
+            # async fires already cleared their panes; a snapshot here could
+            # neither replay nor contain those emissions — refuse loudly
+            # (async_fire is the terminal-sink/bench mode, not
+            # checkpoint-compatible)
+            raise ValueError(
+                "snapshot with in-flight async fires: async_fire=True is not "
+                "checkpoint-compatible; drain (process a watermark) first or "
+                "use the default synchronous fires")
         snap: Dict[str, Any] = {
             "pane_base": self.pane_base,
             "max_pane": self.max_pane,

@@ -266,3 +266,29 @@ def test_adaptive_double_declare_race(tmp_path):
     for k in (np.arange(n) % 41).tolist():
         expect[k] = expect.get(k, 0) + 1.0
     assert final == expect
+
+
+def test_scheduler_surfaces_rescale_errors():
+    """Regression: an exception in the scheduler loop (e.g. rescaling an
+    unstable-split source) must surface as FAILED, not a silently dead
+    thread."""
+    def plan_factory(parallelism):
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(parallelism)
+        n = 200_000
+        (env.from_collection(columns={"k": np.arange(n) % 7,
+                                      "v": np.ones(n)}, batch_size=128)
+         .key_by("k").sum("v").collect())
+        return env.get_stream_graph().to_plan()
+
+    storage = InMemoryCheckpointStorage()
+    sched = AdaptiveScheduler(plan_factory, checkpoint_storage=storage,
+                              checkpoint_interval_ms=10)
+    sched.start()
+    sched.declare_slots(1)
+    time.sleep(0.3)
+    sched.declare_slots(2)   # collection source: splits change -> rescale fails
+    sched.join(timeout_s=60)
+    assert sched.state in (SchedulerStates.FAILED, SchedulerStates.FINISHED)
+    if sched.state == SchedulerStates.FAILED:
+        assert "stable-split" in sched.error

@@ -309,3 +309,44 @@ class TestGrowth:
         out = h.extract_output_rows()
         got = {o["window_start"]: o["result"] for o in out}
         assert got[0] == 1.0 and got[4000] == 2.0
+
+
+def test_async_fire_same_results_one_call_later():
+    """async_fire defers emission to the next operator call but must emit
+    IDENTICAL rows overall (terminal-sink pipelining mode)."""
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    rng = np.random.default_rng(21)
+    n = 5000
+    keys = rng.integers(0, 37, n)
+    vals = rng.random(n).astype(np.float32)
+    ts = np.sort(rng.integers(0, 5000, n))
+
+    def run(async_fire):
+        op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                               SumAggregator(jnp.float32), key_column="k",
+                               value_column="v", async_fire=async_fire)
+        op.open(RuntimeContext())
+        out = []
+        for lo in range(0, n, 512):
+            hi = min(lo + 512, n)
+            out += op.process_batch(RecordBatch(
+                {"k": keys[lo:hi], "v": vals[lo:hi]}, timestamps=ts[lo:hi]))
+            out += op.process_watermark(Watermark(int(ts[hi - 1]) - 1))
+        out += op.end_input()
+        rows = {}
+        for b in out:
+            for r in b.to_rows():
+                rows[(r["k"], r["window_start"])] = r["result"]
+        return rows
+
+    sync_rows = run(False)
+    async_rows = run(True)
+    assert sync_rows.keys() == async_rows.keys()
+    for k in sync_rows:
+        assert abs(sync_rows[k] - async_rows[k]) < 1e-3
