@@ -1,0 +1,94 @@
+"""Command-line entrypoint — the ``flink`` CLI analog (``CliFrontend``).
+
+    python -m flink_tpu run my_job.py [--parallelism N] [--cluster]
+    python -m flink_tpu sql "SELECT ..." --table name=path.csv
+    python -m flink_tpu info
+
+``run`` executes a job script: the script either defines ``main(env)`` or
+just uses a module-level ``env = StreamExecutionEnvironment()`` pipeline
+(``env.execute()`` inside the script also works).
+"""
+
+from __future__ import annotations
+
+import argparse
+import runpy
+import sys
+
+
+def _cmd_run(args) -> int:
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    env = StreamExecutionEnvironment(parallelism=args.parallelism)
+    ns = runpy.run_path(args.script, init_globals={"env": env})
+    main = ns.get("main")
+    if callable(main):
+        main(env)
+    if not env._sinks:
+        print(f"error: {args.script} registered no sinks on the provided "
+              f"'env' (use the injected env or define main(env)); "
+              f"nothing to run", file=sys.stderr)
+        return 2
+    if env._sinks:
+        if args.cluster:
+            res = env.execute_cluster(job_name=args.script)
+            print(f"job finished: {res.state} in {res.net_runtime_ms:.0f} ms")
+            return 0 if res.state == "FINISHED" else 1
+        res = env.execute(job_name=args.script)
+        print(f"job finished in {res.net_runtime_ms:.0f} ms "
+              f"({res.records_emitted} records)")
+    return 0
+
+
+def _cmd_sql(args) -> int:
+    from flink_tpu.sql.table_env import TableEnvironment
+
+    tenv = TableEnvironment(parallelism=args.parallelism)
+    for spec in args.table or []:
+        name, path = spec.split("=", 1)
+        fmt = path.rsplit(".", 1)[-1]
+        from flink_tpu import formats
+        from flink_tpu.core.batch import RecordBatch
+        batches = list(formats.reader_for(fmt)(path))
+        batch = RecordBatch.concat(batches) if batches else RecordBatch({})
+        tenv.register_collection(name, columns=dict(batch.columns))
+    tenv.execute_sql(args.query).print()
+    return 0
+
+
+def _cmd_info(_args) -> int:
+    import jax
+
+    import flink_tpu
+    from flink_tpu.native import build_error, native_available
+
+    print(f"flink-tpu {getattr(flink_tpu, '__version__', 'dev')}")
+    print(f"jax {jax.__version__}; devices: "
+          f"{[f'{d.platform}:{d.id}' for d in jax.devices()]}")
+    print(f"native layer: {'ok' if native_available() else build_error()}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="flink_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("run", help="run a job script")
+    pr.add_argument("script")
+    pr.add_argument("--parallelism", "-p", type=int, default=1)
+    pr.add_argument("--cluster", action="store_true",
+                    help="run on the in-process MiniCluster (parallel subtasks)")
+    pr.set_defaults(fn=_cmd_run)
+    ps = sub.add_parser("sql", help="run a SQL query")
+    ps.add_argument("query")
+    ps.add_argument("--table", action="append",
+                    help="name=path.csv|jsonl|ftb (repeatable)")
+    ps.add_argument("--parallelism", "-p", type=int, default=1)
+    ps.set_defaults(fn=_cmd_sql)
+    pi = sub.add_parser("info", help="environment info")
+    pi.set_defaults(fn=_cmd_info)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

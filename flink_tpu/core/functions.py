@@ -63,6 +63,36 @@ class RuntimeContext:
         """``RuntimeContext.getExternalResourceInfos`` analog (TPU driver plugs in here)."""
         return self._external_resources.get(name, [])
 
+    # -- accumulators (user counters, ``Accumulator``/``IntCounter`` analog)
+    def add_accumulator(self, name: str, start: float = 0.0) -> "Accumulator":
+        accs = getattr(self, "_accumulators", None)
+        if accs is None:
+            accs = self._accumulators = {}
+        if name not in accs:
+            accs[name] = Accumulator(name, start)
+        return accs[name]
+
+    def get_accumulator(self, name: str) -> "Accumulator":
+        return self.add_accumulator(name)
+
+    def accumulator_results(self) -> Dict[str, float]:
+        return {n: a.value for n, a in
+                getattr(self, "_accumulators", {}).items()}
+
+
+class Accumulator:
+    """Distributed user counter (``IntCounter``/``DoubleCounter`` analog):
+    per-subtask adds merge at job completion (JobExecutionResult)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str, start: float = 0.0):
+        self.name = name
+        self.value = start
+
+    def add(self, v: float = 1.0) -> None:
+        self.value += v
+
 
 class RichFunction(Function):
     """open/close lifecycle (``RichFunction.java``)."""

@@ -750,7 +750,9 @@ class WindowAggOperator(StreamOperator):
             ka = self._k_active() or self._K
             hist = getattr(self, "_emit_hist", None)
             expected = max(hist) if hist else ka
-            if expected * 4 >= ka:
+            # async mode pins the dense path: a packed fire is synchronous
+            # and would overtake queued dense fires (out-of-order emission)
+            if self.async_fire or expected * 4 >= ka:
                 return self._fire_window_dense(window_id, pane_slots)
             return self._fire_window_packed(window_id, pane_slots)
         mask, result = self._fire_step(self._leaves, self._counts, pane_slots,

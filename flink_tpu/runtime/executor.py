@@ -65,6 +65,11 @@ class JobExecutionResult:
     job_name: str
     net_runtime_ms: float
     records_emitted: int = 0
+    accumulators: Dict[str, float] = field(default_factory=dict)
+
+    def get_accumulator_result(self, name: str) -> float:
+        """``JobExecutionResult.getAccumulatorResult`` analog."""
+        return self.accumulators[name]
 
 
 class LocalExecutor:
@@ -247,7 +252,8 @@ class LocalExecutor:
                 running[v.id].operator.close()
             return JobExecutionResult(plan.job_name,
                                       (time.monotonic() - t0) * 1000.0,
-                                      self._records)
+                                      self._records,
+                                      self._collect_accumulators(running))
         for rv in source_vertices:
             adv = rv.valve.input_watermark(0, MAX_WATERMARK)
             if adv is not None:
@@ -261,7 +267,18 @@ class LocalExecutor:
             running[v.id].operator.close()
         return JobExecutionResult(plan.job_name,
                                   (time.monotonic() - t0) * 1000.0,
-                                  self._records)
+                                  self._records,
+                                  self._collect_accumulators(running))
+
+    def _collect_accumulators(self, running) -> Dict[str, float]:
+        """Merge per-subtask user counters (reference: accumulators shipped
+        with the final task state and merged on the JobMaster)."""
+        out: Dict[str, float] = {}
+        for rv in running.values():
+            ctx = getattr(rv.operator, "ctx", None)
+            for name, v in (ctx.accumulator_results() if ctx else {}).items():
+                out[name] = out.get(name, 0.0) + v
+        return out
 
     def _advance_processing_time(self, running: Dict[int, RunningVertex]) -> None:
         """Fire due processing-time timers on every vertex (the

@@ -1,0 +1,207 @@
+"""Control plane: session cluster, slot lifecycle, dispatcher recovery,
+heartbeat-driven executor loss, CLI."""
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.coordination import StandaloneSessionCluster
+from flink_tpu.cluster.ha import HaServices
+from flink_tpu.cluster.rpc import await_future
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+
+
+def _plan(n=50_000, keys=13, name="job"):
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    sink = (env.from_collection(columns={"k": np.arange(n) % keys,
+                                         "v": np.ones(n)}, batch_size=256)
+            .key_by("k").sum("v").collect())
+    return env.get_stream_graph(name).to_plan(), sink
+
+
+def test_session_cluster_submit_and_complete():
+    cluster = StandaloneSessionCluster(num_task_executors=2,
+                                      slots_per_executor=2)
+    try:
+        client = cluster.client()
+        ov = client.overview()
+        assert ov == {"task_executors": 2, "slots_total": 4, "slots_free": 4}
+        plan, sink = _plan()
+        job_id = client.submit(plan, parallelism=2)
+        assert job_id in client.list_jobs()
+        result = client.wait_for_completion(job_id, timeout_s=120)
+        assert result.state == "FINISHED"
+        assert client.overview()["slots_free"] == 4   # slots released
+        final = {r["k"]: r["v"] for r in sink.rows()}
+        assert len(final) == 13
+    finally:
+        cluster.shutdown()
+
+
+def test_slots_exhausted_job_waits():
+    cluster = StandaloneSessionCluster(num_task_executors=1,
+                                      slots_per_executor=1)
+    try:
+        client = cluster.client()
+        plan, _ = _plan(n=500_000)
+        j1 = client.submit(plan, parallelism=1)
+        time.sleep(0.1)
+        plan2, _ = _plan(n=1000)
+        j2 = client.submit(plan2, parallelism=1)
+        st2 = client.status(j2)
+        assert st2["status"] == "WAITING_FOR_RESOURCES"
+        client.wait_for_completion(j1, timeout_s=120)
+        # freed slots: the waiting job must now be scheduled and finish
+        res2 = client.wait_for_completion(j2, timeout_s=120)
+        assert res2.state == "FINISHED"
+    finally:
+        cluster.shutdown()
+
+
+def test_cancel_via_dispatcher():
+    cluster = StandaloneSessionCluster(num_task_executors=1,
+                                      slots_per_executor=2)
+    try:
+        client = cluster.client()
+        plan, _ = _plan(n=1_500_000)
+        job_id = client.submit(plan, parallelism=2)
+        time.sleep(0.2)
+        client.cancel(job_id)
+        res = client.wait_for_completion(job_id, timeout_s=60)
+        assert res.state == "CANCELED"
+    finally:
+        cluster.shutdown()
+
+
+def test_savepoint_via_dispatcher():
+    storages = {}
+    cluster = StandaloneSessionCluster(
+        num_task_executors=1, slots_per_executor=2,
+        checkpoint_storage_factory=lambda jid: storages.setdefault(
+            jid, InMemoryCheckpointStorage()))
+    try:
+        client = cluster.client()
+        plan, _ = _plan(n=1_500_000)
+        job_id = client.submit(plan, parallelism=2)
+        time.sleep(0.3)
+        sp = client.savepoint(job_id)
+        assert sp is not None
+        assert storages[job_id].load(sp) is not None
+        client.cancel(job_id)
+        client.wait_for_completion(job_id, timeout_s=60)
+    finally:
+        cluster.shutdown()
+
+
+def _recovery_plan_builder(spec):
+    plan, _sink = _plan(n=spec["n"], keys=spec["keys"])
+    return plan
+
+
+def test_dispatcher_recovers_persisted_jobs(tmp_path):
+    """Leader failover: a NEW dispatcher re-submits unfinished persisted
+    jobs (rebuilt from the picklable spec) and restores them from their
+    latest checkpoint."""
+    ha = HaServices(str(tmp_path / "ha"))
+    storages = {}
+
+    def factory(jid):
+        return storages.setdefault(jid, InMemoryCheckpointStorage())
+
+    c1 = StandaloneSessionCluster(num_task_executors=1, slots_per_executor=2,
+                                  ha_services=ha,
+                                  checkpoint_storage_factory=factory,
+                                  plan_builder=_recovery_plan_builder)
+    client = c1.client()
+    spec = {"n": 2_000_000, "keys": 13}
+    plan, _ = _plan(n=spec["n"], keys=spec["keys"])
+    job_id = client.submit(plan, parallelism=2, checkpoint_interval_ms=10,
+                           job_spec=spec)
+    time.sleep(0.6)
+    # "leader dies" without finishing the job
+    c1.shutdown()
+    assert ha.job_ids() == [job_id]
+    # new leader recovers and finishes it
+    c2 = StandaloneSessionCluster(num_task_executors=1, slots_per_executor=2,
+                                  ha_services=ha,
+                                  checkpoint_storage_factory=factory,
+                                  plan_builder=_recovery_plan_builder)
+    try:
+        client2 = c2.client()
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            jobs = client2.list_jobs()
+            if jobs:
+                st = client2.status(jobs[0])
+                if st["status"] == "FINISHED":
+                    break
+            time.sleep(0.1)
+        assert ha.job_ids() == []   # finished job removed from HA store
+    finally:
+        c2.shutdown()
+
+
+def test_executor_loss_drops_slots():
+    cluster = StandaloneSessionCluster(num_task_executors=2,
+                                      slots_per_executor=1)
+    try:
+        client = cluster.client()
+        assert client.overview()["slots_total"] == 2
+        # kill one TE: heartbeats stop answering -> RM unregisters it
+        cluster.rpc.stop_endpoint("taskexecutor-1")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.overview()["slots_total"] == 1:
+                break
+            time.sleep(0.1)
+        assert client.overview() == {"task_executors": 1, "slots_total": 1,
+                                     "slots_free": 1}
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_run_script(tmp_path):
+    script = tmp_path / "wordjob.py"
+    script.write_text(
+        "import numpy as np\n"
+        "(env.from_collection(columns={'k': np.arange(100) % 5,\n"
+        "                              'v': np.ones(100)})\n"
+        "    .key_by('k').sum('v').print())\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "flink_tpu", "run", str(script)],
+        capture_output=True, text=True, timeout=300, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "job finished" in out.stdout
+
+
+def test_cli_sql(tmp_path):
+    import flink_tpu.formats as formats
+    from flink_tpu.core.batch import RecordBatch
+
+    p = tmp_path / "t.csv"
+    formats.write_csv([RecordBatch({"k": np.array([1, 1, 2]),
+                                    "v": np.array([1., 2., 3.])})], str(p))
+    out = subprocess.run(
+        [sys.executable, "-m", "flink_tpu", "sql",
+         "SELECT k, SUM(v) AS s FROM t GROUP BY k ORDER BY k",
+         "--table", f"t={p}"],
+        capture_output=True, text=True, timeout=300, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "3.0" in out.stdout
+
+
+def test_cli_info():
+    out = subprocess.run([sys.executable, "-m", "flink_tpu", "info"],
+                         capture_output=True, text=True, timeout=300,
+                         cwd="/root/repo")
+    assert out.returncode == 0
+    assert "native layer: ok" in out.stdout

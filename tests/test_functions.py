@@ -78,3 +78,29 @@ def test_combine_associative_commutative():
     ba = agg.combine(b, a)
     assert float(ab["sum"]) == float(ba["sum"]) == 8.0
     assert int(ab["count"]) == int(ba["count"]) == 3
+
+
+def test_accumulators_merge_into_job_result():
+    """User counters (IntCounter analog) merge across operators into the
+    JobExecutionResult."""
+    import numpy as np
+
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    env = StreamExecutionEnvironment()
+
+    from flink_tpu.operators.process import KeyedProcessFunction
+
+    class P(KeyedProcessFunction):
+        def open(self, ctx):
+            self.acc = ctx.add_accumulator("rows-seen")
+
+        def process_batch(self, ctx, batch):
+            self.acc.add(len(batch))
+            return [batch]
+
+    (env.from_collection(columns={"k": np.arange(100) % 3,
+                                  "v": np.ones(100)})
+     .key_by("k").process(P()).collect())
+    res = env.execute()
+    assert res.get_accumulator_result("rows-seen") == 100
