@@ -24,19 +24,23 @@ def _cmd_run(args) -> int:
     main = ns.get("main")
     if callable(main):
         main(env)
+    if getattr(env, "_last_executor", None) is not None or \
+            getattr(env, "_last_cluster", None) is not None:
+        # the script executed itself: don't run the job a second time
+        print("job executed by script")
+        return 0
     if not env._sinks:
         print(f"error: {args.script} registered no sinks on the provided "
               f"'env' (use the injected env or define main(env)); "
               f"nothing to run", file=sys.stderr)
         return 2
-    if env._sinks:
-        if args.cluster:
-            res = env.execute_cluster(job_name=args.script)
-            print(f"job finished: {res.state} in {res.net_runtime_ms:.0f} ms")
-            return 0 if res.state == "FINISHED" else 1
-        res = env.execute(job_name=args.script)
-        print(f"job finished in {res.net_runtime_ms:.0f} ms "
-              f"({res.records_emitted} records)")
+    if args.cluster:
+        res = env.execute_cluster(job_name=args.script)
+        print(f"job finished: {res.state} in {res.net_runtime_ms:.0f} ms")
+        return 0 if res.state == "FINISHED" else 1
+    res = env.execute(job_name=args.script)
+    print(f"job finished in {res.net_runtime_ms:.0f} ms "
+          f"({res.records_emitted} records)")
     return 0
 
 

@@ -248,6 +248,11 @@ class JobMasterEndpoint(RpcEndpoint):
         self._stopped = True
         if self.cluster is not None:
             self.cluster.cancel()
+        else:
+            # never deployed (e.g. still waiting for slots): terminal now
+            from flink_tpu.cluster.minicluster import JobResult
+            self.status = "CANCELED"
+            self._job_done(JobResult(self.job_id, "CANCELED", 0.0))
         return "CANCELLING"
 
     def trigger_savepoint(self) -> Optional[int]:

@@ -315,6 +315,50 @@ class DeduplicateOperator(StreamOperator):
         self._order = dict(snap.get("order", {}))
 
 
+class SortLimitOperator(StreamOperator):
+    """Bounded ORDER BY / LIMIT inside a query pipeline (subquery result
+    semantics): buffer, sort at end of input, truncate."""
+
+    def __init__(self, order_by: List[Tuple[str, bool]],
+                 limit: Optional[int], name: str = "sort-limit"):
+        self.order_by = list(order_by)
+        self.limit = limit
+        self.name = name
+        self._buf: List[RecordBatch] = []
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if len(batch):
+            self._buf.append(batch)
+        return []
+
+    def end_input(self) -> List[StreamElement]:
+        if not self._buf:
+            return []
+        b = RecordBatch.concat(self._buf)
+        self._buf = []
+        order = np.arange(len(b))
+        for name, asc in reversed(self.order_by):
+            col = np.asarray(b.column(name))[order]
+            o = np.argsort(col, kind="stable")
+            if not asc:
+                o = o[::-1]
+            order = order[o]
+        if self.limit is not None:
+            order = order[: self.limit]
+        return [b.take(order)]
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        if not self._buf:
+            return {}
+        b = RecordBatch.concat(self._buf)
+        return {"cols": {k: np.asarray(v) for k, v in b.columns.items()},
+                "ts": None if b.timestamps is None else np.asarray(b.timestamps)}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        if snap.get("cols"):
+            self._buf = [RecordBatch(snap["cols"], timestamps=snap.get("ts"))]
+
+
 class MiniBatchOperator(StreamOperator):
     """Bundle small batches into bigger ones before an expensive stateful
     operator (``MiniBatch`` bundle operators, ``operators/bundle/``):

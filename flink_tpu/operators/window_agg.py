@@ -175,10 +175,11 @@ class WindowAggOperator(StreamOperator):
             from flink_tpu.windowing.triggers import NeverTrigger
             trigger = (NeverTrigger() if isinstance(assigner, GlobalWindows)
                        else EventTimeTrigger())
-        if trigger.fires_on_count and not isinstance(assigner, GlobalWindows):
+        if trigger.fires_on_count and not isinstance(assigner, GlobalWindows) \
+                and assigner.panes_per_window != 1:
             raise NotImplementedError(
-                "CountTrigger over time-window assigners is not supported yet; "
-                "use GlobalWindows (countWindow) or a time trigger")
+                "CountTrigger over MULTI-PANE (sliding) assigners is not "
+                "supported; use tumbling windows or GlobalWindows")
         self.trigger = trigger
         self.output_column = output_column
         self.emit_window_bounds = emit_window_bounds
@@ -626,7 +627,12 @@ class WindowAggOperator(StreamOperator):
         out: List[StreamElement] = list(pending)
         # ---- count-trigger (GlobalWindows / countWindow path)
         if self.trigger.fires_on_count:
-            out.extend(self._fire_by_count())
+            if isinstance(self.assigner, GlobalWindows):
+                out.extend(self._fire_by_count())
+            else:
+                # CountTrigger over tumbling time windows: fire (key, window)
+                # cells whose element count crossed the threshold
+                out.extend(self._fire_count_in_panes(np.unique(panes)))
         # ---- late re-fire: windows already passed by the watermark that this
         # batch updated fire again immediately (EventTimeTrigger.onElement FIRE)
         if (self.trigger.fires_on_time and self.assigner.is_event_time
@@ -647,6 +653,15 @@ class WindowAggOperator(StreamOperator):
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
         self.watermark = max(self.watermark, watermark.timestamp)
         if not (self.trigger.fires_on_time and self.assigner.is_event_time):
+            # count triggers don't FIRE on time, but window state still
+            # retires at window end + lateness (the reference registers
+            # cleanup timers regardless of the trigger) — otherwise the
+            # pane ring grows without bound
+            if (self.trigger.fires_on_count
+                    and not isinstance(self.assigner, GlobalWindows)
+                    and self._leaves is not None
+                    and self.pane_base is not None):
+                self._expire_panes(self.watermark)
             return []
         return self._advance_time(self.watermark)
 
@@ -778,6 +793,46 @@ class WindowAggOperator(StreamOperator):
             self._leaves, self._counts = self._purge_keys_step(
                 self._leaves, self._counts, full_mask)
         return out
+
+    def _fire_count_in_panes(self, touched_panes) -> List[StreamElement]:
+        """CountTrigger.onElement FIRE for time windows (tumbling: one pane
+        per window): per touched pane, emit keys at/over the threshold, then
+        purge those cells when the trigger purges."""
+        out: List[StreamElement] = []
+        thr = self.trigger.count_threshold
+        ka = self._k_active() or self._K
+        for p in np.asarray(touched_panes).tolist():
+            slot = int(p) % self._P
+            counts_col = np.asarray(self._counts[:ka, slot])
+            over = counts_col >= thr
+            if not over.any():
+                continue
+            pane_slots = jnp.asarray([slot], jnp.int32)
+            m, result = self._fire_step(self._leaves, self._counts,
+                                        pane_slots, self._k_active())
+            mask = jnp.asarray(over) & m
+            window = self.assigner.window_bounds(
+                self.assigner.windows_of_pane(int(p))[0])
+            out.extend(self._emit(mask, result, window))
+            if self.trigger.purges_on_fire:
+                full = jnp.zeros((self._K,), bool).at[:ka].set(mask)
+                self._leaves, self._counts = self._purge_cells_step(
+                    self._leaves, self._counts, full, pane_slots)
+        return out
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
+    def _purge_cells_step(self, leaves, counts, key_mask, pane_slots):
+        """Reset (key, pane) cells for fired count-trigger windows."""
+        new_leaves = []
+        for l, init in zip(leaves, self.spec.leaf_inits):
+            sel = jnp.take(l, pane_slots, axis=1)
+            fill = jnp.broadcast_to(jnp.asarray(init, l.dtype), sel.shape)
+            m = key_mask.reshape((-1, 1) + (1,) * (l.ndim - 2))
+            new_leaves.append(l.at[:, pane_slots].set(jnp.where(m, fill, sel)))
+        csel = jnp.take(counts, pane_slots, axis=1)
+        new_counts = counts.at[:, pane_slots].set(
+            jnp.where(key_mask[:, None], 0, csel))
+        return tuple(new_leaves), new_counts
 
     def _emit(self, mask, result, window) -> List[StreamElement]:
         mask_np = np.asarray(mask[: self.key_index.num_keys]) if self.key_index else np.asarray(mask)

@@ -113,6 +113,17 @@ class Interval(Expr):
     ms: int
 
 
+@dataclass(frozen=True)
+class OverCall(Expr):
+    """Window function call: ``fn() OVER (PARTITION BY p ORDER BY o [DESC])``
+    (``StreamExecRank``-feeding shape; ROW_NUMBER is the supported fn)."""
+
+    func: str
+    partition_by: Optional[Expr]
+    order_by: Optional[Expr]
+    ascending: bool = True
+
+
 @dataclass
 class SelectItem:
     expr: Expr
@@ -166,6 +177,7 @@ _KEYWORDS = {
     "IS", "NULL", "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
     "CAST", "INTERVAL", "DATE", "TIMESTAMP", "DISTINCT",
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON",
+    "OVER", "PARTITION",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -247,7 +259,7 @@ class Parser:
         return t.kind == "KEYWORD" and t.value in kws
 
     # -- entry --------------------------------------------------------------
-    def parse_select(self) -> SelectStmt:
+    def parse_select(self, expect_eof: bool = True) -> SelectStmt:
         self.expect("KEYWORD", "SELECT")
         items = [self.parse_select_item()]
         while self.accept("OP", ","):
@@ -256,7 +268,11 @@ class Parser:
         table_alias = None
         joins: List[JoinClause] = []
         if self.accept("KEYWORD", "FROM"):
-            table = self.expect("IDENT").value
+            if self.accept("OP", "("):
+                table = self.parse_select(expect_eof=False)
+                self.expect("OP", ")")
+            else:
+                table = self.expect("IDENT").value
             if self.accept("KEYWORD", "AS"):
                 table_alias = self.expect("IDENT").value
             elif self.peek().kind == "IDENT":
@@ -302,7 +318,8 @@ class Parser:
                 stmt.order_by.append(self.parse_order_item())
         if self.accept("KEYWORD", "LIMIT"):
             stmt.limit = int(self.expect("NUMBER").value)
-        self.expect("EOF")
+        if expect_eof:
+            self.expect("EOF")
         return stmt
 
     def parse_order_item(self) -> Tuple[Expr, bool]:
@@ -461,7 +478,10 @@ class Parser:
             self.next()
             name = t.value
             if self.accept("OP", "("):
-                return self.parse_call(name)
+                call = self.parse_call(name)
+                if self.at_keyword("OVER"):
+                    return self.parse_over(call)
+                return call
             # qualified column: tbl.col keeps its qualifier (join resolution)
             qualifier = None
             while self.accept("OP", "."):
@@ -469,6 +489,26 @@ class Parser:
                 name = self.expect("IDENT").value
             return Column(name, table=qualifier)
         raise SqlParseError(f"unexpected token {t.value or t.kind!r} at {t.pos}")
+
+    def parse_over(self, call: Expr) -> "OverCall":
+        self.expect("KEYWORD", "OVER")
+        self.expect("OP", "(")
+        partition = order = None
+        asc = True
+        if self.accept("KEYWORD", "PARTITION"):
+            self.expect("KEYWORD", "BY")
+            partition = self.parse_expr()
+        if self.accept("KEYWORD", "ORDER"):
+            self.expect("KEYWORD", "BY")
+            order = self.parse_expr()
+            if self.accept("KEYWORD", "DESC"):
+                asc = False
+            else:
+                self.accept("KEYWORD", "ASC")
+        self.expect("OP", ")")
+        if not isinstance(call, Call):
+            raise SqlParseError("OVER must follow a function call")
+        return OverCall(call.name, partition, order, asc)
 
     def parse_call(self, name: str) -> Expr:
         up = name.upper()

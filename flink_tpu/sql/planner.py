@@ -28,7 +28,8 @@ from flink_tpu.sql.expressions import (ExprCompiler, PlanError, expr_name,
 from flink_tpu.sql.parser import (AGG_FUNCS, WINDOW_AUX, WINDOW_FUNCS, Between,
                                   Binary, Call, Case, Cast, Column, Expr,
                                   InList, Interval, IsNull, Like, Literal,
-                                  SelectItem, SelectStmt, Star, Unary)
+                                  OverCall, SelectItem, SelectStmt, Star,
+                                  Unary)
 from flink_tpu.windowing.assigners import (EventTimeSessionWindows,
                                            GlobalWindows,
                                            SlidingEventTimeWindows,
@@ -170,6 +171,49 @@ def _extract_aggs(expr: Expr, specs: List[AggSpec],
     return _transform(expr, fn)
 
 
+def _copy_stmt(stmt: SelectStmt) -> SelectStmt:
+    import copy as _c
+    out = _c.copy(stmt)
+    out.items = list(stmt.items)
+    out.group_by = list(stmt.group_by)
+    out.order_by = list(stmt.order_by)
+    out.joins = list(stmt.joins)
+    return out
+
+
+def _contains_over(stmt: SelectStmt) -> bool:
+    found = []
+
+    def fn(e: Expr):
+        if isinstance(e, OverCall):
+            found.append(e)
+        return None
+
+    for it in stmt.items:
+        _transform(it.expr, fn)
+    return bool(found)
+
+
+def _rank_filter_limit(where: Optional[Expr], rn: str) -> Optional[int]:
+    """Match ``rn <= N`` / ``rn < N`` / ``N >= rn`` -> N (else None)."""
+    if not isinstance(where, Binary):
+        return None
+    op, l, r = where.op, where.left, where.right
+    if isinstance(l, Column) and l.name == rn and isinstance(r, Literal) \
+            and isinstance(r.value, (int, float)):
+        if op == "<=":
+            return int(r.value)
+        if op == "<":
+            return int(r.value) - 1
+    if isinstance(r, Column) and r.name == rn and isinstance(l, Literal) \
+            and isinstance(l.value, (int, float)):
+        if op == ">=":
+            return int(l.value)
+        if op == ">":
+            return int(l.value) - 1
+    return None
+
+
 def _contains_agg(expr: Expr) -> bool:
     specs: List[AggSpec] = []
     _extract_aggs(expr, specs, {})
@@ -245,6 +289,14 @@ class Planner:
     def plan(self, stmt: SelectStmt) -> QueryPlan:
         if stmt.table is None:
             raise PlanError("FROM clause is required")
+        if isinstance(stmt.table, SelectStmt):
+            return self._plan_derived(stmt)
+        if _contains_over(stmt):
+            raise PlanError(
+                "window functions (ROW_NUMBER() OVER ...) are supported in "
+                "the blink Top-N shape: SELECT * FROM (SELECT ..., "
+                "ROW_NUMBER() OVER (PARTITION BY p ORDER BY o) AS rn "
+                "FROM t) WHERE rn <= N")
         try:
             table = self.catalog[stmt.table]
         except KeyError:
@@ -309,6 +361,121 @@ class Planner:
         return self._plan_aggregate(stream, rewritten, having, agg_specs,
                                     group_keys, window, table, stmt, compiler,
                                     orig_items=items)
+
+    # ------------------------------------------------------- derived tables
+    def _plan_derived(self, stmt: SelectStmt) -> QueryPlan:
+        """FROM (SELECT ...): plan the subquery, then the outer query over
+        its output; the blink Top-N pattern (ROW_NUMBER + rn <= N filter)
+        lowers to the TopN operator (``StreamExecRank``)."""
+        from flink_tpu.sql.table_env import CatalogTable
+
+        rank = self._try_plan_rank(stmt)
+        if rank is not None:
+            return rank
+        inner = self.plan(stmt.table)
+        inner_stream = inner.stream
+        if inner.order_by or inner.limit is not None:
+            # a subquery's ORDER BY/LIMIT are part of ITS result set — apply
+            # them in-stream before the outer query consumes the rows
+            from flink_tpu.operators.sql_ops import SortLimitOperator
+            from flink_tpu.datastream.api import DataStream
+            t = inner_stream._then(
+                "sql-sort-limit",
+                lambda _ob=tuple(inner.order_by), _lim=inner.limit:
+                SortLimitOperator(list(_ob), _lim), chainable=False)
+            inner_stream = DataStream(inner_stream.env, t)
+        sub = CatalogTable(name="<subquery>",
+                           columns=list(inner.output_columns),
+                           stream_factory=lambda env: inner_stream,
+                           timestamps_assigned=True)
+        outer = _copy_stmt(stmt)
+        outer.table = "<subquery>"
+        outer.table_alias = stmt.table_alias
+        saved = self.catalog
+        self.catalog = dict(saved)
+        self.catalog["<subquery>"] = sub
+        try:
+            return self.plan(outer)
+        finally:
+            self.catalog = saved
+
+    def _try_plan_rank(self, stmt: SelectStmt) -> Optional[QueryPlan]:
+        inner: SelectStmt = stmt.table
+        over_items = [(i, it) for i, it in enumerate(inner.items)
+                      if isinstance(it.expr, OverCall)]
+        if not over_items:
+            return None
+        if len(over_items) != 1:
+            raise PlanError("exactly one window function per subquery")
+        idx, over_it = over_items[0]
+        over: OverCall = over_it.expr
+        if over.func != "ROW_NUMBER":
+            raise PlanError(f"{over.func}() OVER is not supported; "
+                            f"ROW_NUMBER is")
+        if over.order_by is None or not isinstance(over.order_by, Column):
+            raise PlanError("ROW_NUMBER OVER needs ORDER BY <column>")
+        if over.partition_by is not None and \
+                not isinstance(over.partition_by, Column):
+            raise PlanError("PARTITION BY must be a plain column")
+        rn = over_it.alias or "rn"
+        n = _rank_filter_limit(stmt.where, rn)
+        if n is None:
+            raise PlanError(
+                f"Top-N needs an outer filter of the form {rn} <= N")
+        # plan the base subquery WITHOUT the over item
+        base = _copy_stmt(inner)
+        base.items = [it for i, it in enumerate(inner.items) if i != idx]
+        base_plan = self.plan(base)
+        part_col = over.partition_by.name if over.partition_by else None
+        order_col = over.order_by.name
+        for c in filter(None, (part_col, order_col)):
+            if c not in base_plan.output_columns:
+                raise PlanError(f"rank column {c!r} must be selected in the "
+                                f"subquery (have {base_plan.output_columns})")
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.graph.transformations import Partitioning
+        from flink_tpu.operators.sql_ops import TopNOperator
+
+        stream = base_plan.stream
+        factory = (lambda _n=n, _p=part_col, _o=order_col,
+                   _a=over.ascending: TopNOperator(
+                       _n, _p, _o, ascending=_a, emit_changelog=False))
+        if part_col is not None:
+            keyed = stream.key_by(part_col)
+            t = keyed._then("sql-rank", factory, chainable=False)
+        else:
+            t = stream._then("sql-rank", factory,
+                            partitioning=Partitioning.GLOBAL, chainable=False)
+        ranked = DataStream(stream.env, t)
+
+        # rank column rename + outer projection over base cols + rn
+        def add_rn(cols, _rn=rn):
+            out = dict(cols)
+            out[_rn] = out.pop("rank")
+            out.pop("op", None)
+            return out
+
+        ranked = ranked.map(add_rn, name="sql-rank-name")
+        out_cols = base_plan.output_columns + [rn]
+        outer_items = []
+        for it in stmt.items:
+            if isinstance(it.expr, Star):
+                outer_items.extend(SelectItem(Column(c), c) for c in out_cols)
+            else:
+                outer_items.append(it)
+        schema = dict.fromkeys(out_cols)
+        compiler = ExprCompiler(schema)
+        fns = [compiler.compile(it.expr) for it in outer_items]
+        names = _output_names(outer_items)
+
+        def project(cols, _fns=fns, _names=names):
+            nrows = _n(cols)
+            return {nm: to_column(f(cols), nrows)
+                    for nm, f in zip(_names, _fns)}
+
+        out = ranked.map(project, name="sql-project")
+        return QueryPlan(out, names, _order_names(stmt, outer_items, names),
+                         stmt.limit)
 
     # ------------------------------------------------------------ joins
     def _plan_joins(self, stmt: SelectStmt, base):

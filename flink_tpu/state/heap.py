@@ -632,9 +632,41 @@ class HeapKeyedStateBackend:
             pending = self._pending_restore.pop(desc.name, None)
             if pending is not None:
                 # restored snapshot binds when the descriptor registers —
-                # same contract as the reference's getPartitionedState
+                # same contract as the reference's getPartitionedState.
+                # Schema compatibility resolves HERE (the reference's
+                # resolveSchemaCompatibility on first state access).
+                pending = self._resolve_schema(desc, pending)
                 st.restore(pending)
         return st
+
+    def _resolve_schema(self, desc: StateDescriptor,
+                        pending: Dict[str, Any]) -> Dict[str, Any]:
+        from flink_tpu.state.evolution import (AFTER_MIGRATION, INCOMPATIBLE,
+                                               SchemaEvolutionError,
+                                               resolve_compatibility,
+                                               schema_of_descriptor)
+        old = getattr(self, "_restored_schema", {}).get(desc.name)
+        if old is None:
+            return pending
+        new = schema_of_descriptor(desc)
+        verdict = resolve_compatibility(old, new)
+        if verdict == INCOMPATIBLE:
+            raise SchemaEvolutionError(
+                f"state {desc.name!r}: stored schema {old} cannot restore "
+                f"into descriptor schema {new} (only widening migrations "
+                f"are supported)")
+        if verdict == AFTER_MIGRATION:
+            import numpy as _np
+            target = _np.dtype(new["dtype"])
+            # ONLY the value rows migrate — bookkeeping fields (ttl_ts
+            # timestamps, presence flags) keep their own dtypes
+            pending = {
+                f: (v.astype(target)
+                    if f == "rows" and isinstance(v, _np.ndarray)
+                    and v.dtype != object
+                    and _np.issubdtype(v.dtype, _np.number) else v)
+                for f, v in pending.items()}
+        return pending
 
     def value_state(self, name: str, **kw) -> HeapValueState:
         return self.get_state(state_api.ValueStateDescriptor(name, **kw))
@@ -675,6 +707,13 @@ class HeapKeyedStateBackend:
         for name, sub in self._pending_restore.items():
             for f, v in sub.items():
                 snap[f"state.{name}.{f}"] = v
+        # serializer-snapshot analog: per-state schema rides the checkpoint
+        from flink_tpu.state.evolution import schema_of_backend
+        schema = schema_of_backend(self)
+        # states restored-but-not-rebound keep their stored schema
+        for name, s in getattr(self, "_restored_schema", {}).items():
+            schema.setdefault(name, s)
+        snap["__schema__"] = schema
         return snap
 
     @staticmethod
@@ -685,6 +724,7 @@ class HeapKeyedStateBackend:
     def restore(self, snap: Dict[str, Any]) -> None:
         if snap.get("empty"):
             return
+        self._restored_schema = dict(snap.get("__schema__", {}))
         kind = snap.get("key_index_kind", "KeyIndex")
         cls = ObjectKeyIndex if kind == "ObjectKeyIndex" else KeyIndex
         self._index = cls.restore(snap["key_index"])

@@ -1,0 +1,85 @@
+"""State schema evolution: versioned snapshots, widening migration,
+incompatible-change rejection (serializer-snapshot analog)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.state.api import ValueStateDescriptor
+from flink_tpu.state.evolution import (AFTER_MIGRATION, AS_IS, INCOMPATIBLE,
+                                       SchemaEvolutionError,
+                                       resolve_compatibility)
+from flink_tpu.state.heap import HeapKeyedStateBackend
+
+
+def test_resolve_verdicts():
+    v = resolve_compatibility({"kind": "value", "dtype": "int32", "shape": ()},
+                              {"kind": "value", "dtype": "int32", "shape": ()})
+    assert v == AS_IS
+    v = resolve_compatibility({"kind": "value", "dtype": "int32", "shape": ()},
+                              {"kind": "value", "dtype": "int64", "shape": ()})
+    assert v == AFTER_MIGRATION
+    v = resolve_compatibility({"kind": "value", "dtype": "int64", "shape": ()},
+                              {"kind": "value", "dtype": "int32", "shape": ()})
+    assert v == INCOMPATIBLE   # narrowing
+    v = resolve_compatibility({"kind": "value", "dtype": "int32", "shape": ()},
+                              {"kind": "list", "dtype": "int32", "shape": ()})
+    assert v == INCOMPATIBLE   # kind change
+
+
+def test_snapshot_carries_schema_and_widens_on_restore():
+    b = HeapKeyedStateBackend()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.int32, default=0))
+    slots = b.key_slots(np.array([1, 2, 3]))
+    st.put_rows(slots, np.array([10, 20, 30], np.int32))
+    snap = b.snapshot()
+    assert snap["__schema__"]["v"]["dtype"] == "int32"
+
+    # the evolved job registers the SAME state as int64: widening migration
+    b2 = HeapKeyedStateBackend()
+    b2.restore(snap)
+    st2 = b2.get_state(ValueStateDescriptor("v", dtype=np.int64, default=0))
+    got, alive = st2.get_rows(b2.key_slots(np.array([1, 2, 3])))
+    assert got.dtype == np.int64
+    assert got.tolist() == [10, 20, 30]
+
+
+def test_incompatible_restore_fails_loudly():
+    b = HeapKeyedStateBackend()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.int64, default=0))
+    b.set_current_key(1)
+    st.update(7)
+    snap = b.snapshot()
+
+    b2 = HeapKeyedStateBackend()
+    b2.restore(snap)
+    with pytest.raises(SchemaEvolutionError, match="widening"):
+        b2.get_state(ValueStateDescriptor("v", dtype=np.int32, default=0))
+
+
+def test_added_state_starts_empty():
+    b = HeapKeyedStateBackend()
+    st = b.get_state(ValueStateDescriptor("old", dtype=np.int32, default=0))
+    b.set_current_key(1)
+    st.update(5)
+    snap = b.snapshot()
+
+    b2 = HeapKeyedStateBackend()
+    b2.restore(snap)
+    new = b2.get_state(ValueStateDescriptor("brand_new", dtype=np.float64,
+                                            default=-1.0))
+    b2.set_current_key(1)
+    assert new.value() == -1.0
+    assert b2.get_state(ValueStateDescriptor("old", dtype=np.int32,
+                                             default=0)).value() == 5
+
+
+def test_schema_survives_restore_snapshot_cycle():
+    b = HeapKeyedStateBackend()
+    st = b.get_state(ValueStateDescriptor("v", dtype=np.int32, default=0))
+    b.set_current_key(1)
+    st.update(3)
+    snap = b.snapshot()
+    b2 = HeapKeyedStateBackend()
+    b2.restore(snap)
+    snap2 = b2.snapshot()   # no re-registration before re-snapshot
+    assert snap2["__schema__"]["v"]["dtype"] == "int32"

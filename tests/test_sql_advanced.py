@@ -181,3 +181,54 @@ def test_dedup_parallel_correct():
     rows = te.sql_query("SELECT * FROM t").deduplicate("k").collect()
     ks = [r["k"] for r in rows]
     assert sorted(ks) == sorted(set(ks)) and len(set(ks)) == 50
+
+
+def test_row_number_over_topn_sql(tenv):
+    rows = tenv.execute_sql(
+        "SELECT * FROM (SELECT cust, amount, "
+        "ROW_NUMBER() OVER (PARTITION BY cust ORDER BY amount DESC) AS rn "
+        "FROM orders) WHERE rn <= 2").collect()
+    got = {(r["cust"], r["rn"]): r["amount"] for r in rows}
+    assert got[(1, 1)] == 30.0 and got[(1, 2)] == 10.0
+    assert got[(2, 1)] == 50.0 and got[(2, 2)] == 20.0
+    assert got[(3, 1)] == 40.0
+
+
+def test_row_number_global_topn_sql(tenv):
+    rows = tenv.execute_sql(
+        "SELECT oid, rn FROM (SELECT oid, amount, "
+        "ROW_NUMBER() OVER (ORDER BY amount DESC) AS rn FROM orders) "
+        "WHERE rn <= 3 ORDER BY rn").collect()
+    assert [r["oid"] for r in rows] == [5, 4, 3]
+
+
+def test_plain_derived_table(tenv):
+    rows = tenv.execute_sql(
+        "SELECT big_cust, SUM(amount) AS total FROM "
+        "(SELECT cust AS big_cust, amount FROM orders WHERE amount > 15) "
+        "GROUP BY big_cust ORDER BY big_cust").collect()
+    assert [(r["big_cust"], r["total"]) for r in rows] == \
+        [(1, 30.0), (2, 70.0), (3, 40.0), (9, 60.0)]
+
+
+def test_over_outside_subquery_rejected(tenv):
+    from flink_tpu.sql.planner import PlanError
+    with pytest.raises(PlanError, match="Top-N shape"):
+        tenv.execute_sql(
+            "SELECT ROW_NUMBER() OVER (ORDER BY amount) FROM orders").collect()
+
+
+def test_subquery_order_limit_respected(tenv):
+    """Regression: a subquery's ORDER BY/LIMIT bound ITS result set."""
+    rows = tenv.execute_sql(
+        "SELECT SUM(amount) AS s FROM "
+        "(SELECT amount FROM orders ORDER BY amount DESC LIMIT 2)").collect()
+    assert rows[0]["s"] == 110.0    # 60 + 50
+
+
+def test_derived_table_join_not_dropped(tenv):
+    rows = tenv.execute_sql(
+        "SELECT c.name, o.amount FROM "
+        "(SELECT cust, amount FROM orders WHERE amount > 45) o "
+        "JOIN customers c ON o.cust = c.cust").collect()
+    assert sorted((r["name"], r["amount"]) for r in rows) == [("bob", 50.0)]

@@ -350,3 +350,36 @@ def test_async_fire_same_results_one_call_later():
     assert sync_rows.keys() == async_rows.keys()
     for k in sync_rows:
         assert abs(sync_rows[k] - async_rows[k]) < 1e-3
+
+
+def test_count_trigger_over_tumbling_windows():
+    """CountTrigger.of(n) on tumbling event-time windows: a (key, window)
+    fires when its count crosses n and purges (FIRE_AND_PURGE)."""
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                           SumAggregator(jnp.float32), key_column="k",
+                           value_column="v", trigger=CountTrigger.of(3))
+    op.open(RuntimeContext())
+    # key 1 gets 3 records in window [0,1000) -> fires on the third
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1, 1, 2]), "v": np.array([1., 2., 9.])},
+        timestamps=np.array([10, 20, 30])))
+    assert out == []
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1])}, timestamps=np.array([40])).with_columns(
+            {"k": np.array([1]), "v": np.array([4.])}))
+    rows = [r for b in out for r in b.to_rows()]
+    assert len(rows) == 1 and rows[0]["k"] == 1 and rows[0]["result"] == 7.0
+    # purged: three MORE records fire again with a fresh count
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1, 1, 1]), "v": np.array([1., 1., 1.])},
+        timestamps=np.array([50, 60, 70])))
+    rows = [r for b in out for r in b.to_rows()]
+    assert len(rows) == 1 and rows[0]["result"] == 3.0
