@@ -1,0 +1,91 @@
+"""Ring combine over the device mesh (sequence/context-parallel window
+fires — the ring-attention communication pattern)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.parallel.mesh import make_mesh
+from flink_tpu.parallel.ring import (make_ring_all_reduce_sum,
+                                     make_ring_combine,
+                                     sharded_pane_window_total)
+
+
+def _mesh8():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return make_mesh(8)
+
+
+def test_ring_combine_sum_monoid():
+    import jax.numpy as jnp
+
+    mesh = _mesh8()
+    D = mesh.devices.size
+
+    def combine(a, b):
+        return tuple(x + y for x, y in zip(a, b))
+
+    ring = make_ring_combine(mesh, combine, num_leaves=1)
+    # one partial row per device: [D, K] sharded over devices
+    parts = np.arange(D * 4, dtype=np.float32).reshape(D, 4)
+    (out,) = ring(jnp.asarray(parts))
+    # every device row holds the SUM over all partials
+    expect = parts.sum(axis=0)
+    for d in range(D):
+        np.testing.assert_allclose(np.asarray(out)[d], expect, rtol=1e-6)
+
+
+def test_ring_combine_max_monoid():
+    """A second commutative monoid (max) beyond sum; NOTE the ring requires
+    commutativity (AggregateFunction.combine contract) — partials arrive in
+    per-device cyclic order, so order-sensitive combines are unsupported."""
+    import jax.numpy as jnp
+
+    mesh = _mesh8()
+    D = mesh.devices.size
+
+    def combine(a, b):
+        return tuple(np.maximum(x, y) if isinstance(x, np.ndarray)
+                     else jnp.maximum(x, y) for x, y in zip(a, b))
+
+    ring = make_ring_combine(mesh, combine, num_leaves=1)
+    rng = np.random.default_rng(3)
+    parts = rng.random((D, 5)).astype(np.float32)
+    (out,) = ring(jnp.asarray(parts))
+    np.testing.assert_allclose(np.asarray(out)[0], parts.max(axis=0),
+                               rtol=1e-6)
+
+
+def test_ring_all_reduce_sum():
+    import jax.numpy as jnp
+
+    mesh = _mesh8()
+    D = mesh.devices.size
+    f = make_ring_all_reduce_sum(mesh)
+    x = np.ones((D, 3), np.float32)
+    out = np.asarray(f(jnp.asarray(x)))
+    np.testing.assert_allclose(out, np.full((D, 3), D, np.float32))
+
+
+def test_sequence_parallel_window_total():
+    """Pane axis sharded across chips: the window total equals the
+    single-chip combine (blockwise partials + ring)."""
+    import jax.numpy as jnp
+
+    mesh = _mesh8()
+    D = mesh.devices.size
+    K, panes_per_dev = 16, 4
+
+    def combine(a, b):
+        return tuple(x + y for x, y in zip(a, b))
+
+    fire = sharded_pane_window_total(mesh, combine, num_leaves=1)
+    rng = np.random.default_rng(4)
+    # [D, K, panes_local]: each device owns a slice of the window's panes
+    state = rng.random((D, K, panes_per_dev)).astype(np.float32)
+    (out,) = fire(jnp.asarray(state))
+    # expected: sum over ALL D*panes_per_dev panes per key
+    expect = state.sum(axis=(0, 2))
+    for d in range(D):
+        np.testing.assert_allclose(np.asarray(out)[d], expect, rtol=1e-5)
