@@ -1,0 +1,44 @@
+"""Fraud detection walkthrough analog (flink-walkthroughs): a keyed process
+function with state + timers flags accounts whose small transaction is
+followed by a large one within a time window, emitting alerts to a side
+output.
+
+    python -m flink_tpu run examples/fraud_detection.py
+"""
+
+import numpy as np
+
+
+def main(env):
+    from flink_tpu.core.batch import OutputTag
+    from flink_tpu.operators.process import KeyedProcessFunction
+    from flink_tpu.state.api import ValueStateDescriptor
+
+    alerts = OutputTag("alerts")
+
+    class Detector(KeyedProcessFunction):
+        def process_batch(self, ctx, batch):
+            flagged = ctx.state(ValueStateDescriptor("small_seen", default=0))
+            seen, _ = flagged.get_rows(batch.key_ids)
+            amounts = np.asarray(batch.column("amount"))
+            small = amounts < 1.0
+            big = amounts > 500.0
+            fraud = big & (np.asarray(seen) == 1)
+            if fraud.any():
+                ctx.side_output(alerts, {
+                    "account": np.asarray(batch.column("account"))[fraud],
+                    "amount": amounts[fraud]})
+            flagged.put_rows(batch.key_ids, np.where(small, 1, 0))
+            return [batch]
+
+    rng = np.random.default_rng(7)
+    n = 10_000
+    amounts = rng.random(n) * 100
+    amounts[rng.integers(0, n, 20)] = 0.5       # bait
+    amounts[rng.integers(0, n, 20)] = 900.0     # strike
+    tx = env.from_collection(columns={
+        "account": rng.integers(0, 50, n),
+        "amount": amounts})
+    scored = tx.key_by("account").process(Detector())
+    scored.get_side_output(alerts).print(prefix="ALERT")
+    scored.collect()

@@ -1,0 +1,30 @@
+"""SocketWindowWordCount — the reference's flagship example
+(flink-examples/.../SocketWindowWordCount.java:69-84, baseline config #1):
+  socket text -> split words -> keyBy(word) -> 5s tumbling window -> count.
+
+Run a text server first (e.g. ``nc -lk 9999``), then:
+
+    python -m flink_tpu run examples/socket_window_word_count.py
+"""
+
+import numpy as np
+
+
+def main(env):
+    from flink_tpu.core.functions import CountAggregator
+    from flink_tpu.windowing.assigners import TumblingProcessingTimeWindows
+
+    def split_words(cols):
+        words, src = [], []
+        for i, line in enumerate(np.asarray(cols["line"]).tolist()):
+            for w in line.split():
+                words.append(w)
+                src.append(i)
+        return {"word": np.asarray(words, object)}, np.asarray(src, np.int64)
+
+    (env.socket_text_stream("localhost", 9999)
+        .flat_map(split_words)
+        .key_by("word")
+        .window(TumblingProcessingTimeWindows.of(5000))
+        .count()
+        .print())

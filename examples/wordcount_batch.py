@@ -1,0 +1,29 @@
+"""Batch WordCount over the DataSet API (flink-examples batch flagship).
+
+    python examples/wordcount_batch.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from flink_tpu.dataset import ExecutionEnvironment
+
+TEXT = """to be or not to be that is the question whether tis nobler in
+the mind to suffer the slings and arrows of outrageous fortune""".split()
+
+
+def main():
+    env = ExecutionEnvironment.get_execution_environment()
+    counts = (env.from_columns({"word": np.asarray(TEXT, object)})
+              .group_by("word").count()
+              .sort_partition("count", ascending=False))
+    for row in counts.first_n(5).collect():
+        print(f"{row['word']}: {row['count']}")
+
+
+if __name__ == "__main__":
+    main()
