@@ -1,7 +1,6 @@
 """Fraud detection walkthrough analog (flink-walkthroughs): a keyed process
-function with state + timers flags accounts whose small transaction is
-followed by a large one within a time window, emitting alerts to a side
-output.
+function with state flags accounts whose SMALL transaction is immediately
+followed by a LARGE one, emitting alerts to a side output.
 
     python -m flink_tpu run examples/fraud_detection.py
 """
@@ -15,30 +14,45 @@ def main(env):
     from flink_tpu.state.api import ValueStateDescriptor
 
     alerts = OutputTag("alerts")
+    SMALL, LARGE = 1.0, 500.0
 
     class Detector(KeyedProcessFunction):
         def process_batch(self, ctx, batch):
             flagged = ctx.state(ValueStateDescriptor("small_seen", default=0))
-            seen, _ = flagged.get_rows(batch.key_ids)
+            accounts = np.asarray(batch.column("account"))
             amounts = np.asarray(batch.column("amount"))
-            small = amounts < 1.0
-            big = amounts > 500.0
-            fraud = big & (np.asarray(seen) == 1)
+            carried, _ = flagged.get_rows(batch.key_ids)
+            carried = np.asarray(carried).astype(bool)
+            # sequential per-account scan WITHIN the batch (the per-record
+            # order matters for this pattern), seeded by the carried state
+            last_small = {}
+            fraud = np.zeros(len(batch), bool)
+            for i, (acct, amt) in enumerate(zip(accounts.tolist(),
+                                                amounts.tolist())):
+                prev = last_small.get(acct, carried[i])
+                fraud[i] = prev and amt > LARGE
+                last_small[acct] = amt < SMALL
             if fraud.any():
-                ctx.side_output(alerts, {
-                    "account": np.asarray(batch.column("account"))[fraud],
-                    "amount": amounts[fraud]})
-            flagged.put_rows(batch.key_ids, np.where(small, 1, 0))
+                ctx.side_output(alerts, {"account": accounts[fraud],
+                                         "amount": amounts[fraud]})
+            # persist each account's LAST small-flag for the next batch
+            final = np.asarray([last_small[a] for a in accounts.tolist()],
+                               np.int64)
+            flagged.put_rows(batch.key_ids, final)
             return [batch]
 
     rng = np.random.default_rng(7)
     n = 10_000
+    accounts = rng.integers(0, 50, n)
     amounts = rng.random(n) * 100
-    amounts[rng.integers(0, n, 20)] = 0.5       # bait
-    amounts[rng.integers(0, n, 20)] = 900.0     # strike
-    tx = env.from_collection(columns={
-        "account": rng.integers(0, 50, n),
-        "amount": amounts})
+    # plant bait -> strike sequences for three accounts
+    for acct, pos in ((7, 100), (21, 2000), (33, 7777)):
+        accounts[pos] = accounts[pos + 1] = acct
+        amounts[pos] = 0.5          # bait
+        amounts[pos + 1] = 900.0    # strike
+
+    tx = env.from_collection(columns={"account": accounts,
+                                      "amount": amounts}, batch_size=1024)
     scored = tx.key_by("account").process(Detector())
     scored.get_side_output(alerts).print(prefix="ALERT")
     scored.collect()

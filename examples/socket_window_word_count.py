@@ -11,7 +11,6 @@ import numpy as np
 
 
 def main(env):
-    from flink_tpu.core.functions import CountAggregator
     from flink_tpu.windowing.assigners import TumblingProcessingTimeWindows
 
     def split_words(cols):

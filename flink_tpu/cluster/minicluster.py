@@ -175,14 +175,29 @@ class MiniCluster(TaskListener):
                 tgt = plan.by_id[e.target_id]
                 np_, nc = n_subs(v), n_subs(tgt)
                 for pi in range(np_):
+                    part = e.partitioning
+                    if part == "forward" and np_ == nc:
+                        # FORWARD keeps subtask alignment (producer i ->
+                        # consumer i): an upstream hash edge's key
+                        # partitioning must survive unchained stateful
+                        # consumers — rebalancing here would scatter keys
+                        ch = LocalChannel(
+                            self.channel_capacity,
+                            name=f"{v.name}[{pi}]->{tgt.name}[{pi}]")
+                        inputs[tgt.id][pi].append(ch)
+                        input_logical[tgt.id][pi].append(e.input_index)
+                        outputs[v.id][pi].append(OutputDispatcher(
+                            part, [ch], max_parallelism=v.max_parallelism,
+                            subtask_index=pi, key_column=e.key_column))
+                        continue
                     chans = [LocalChannel(self.channel_capacity,
                                           name=f"{v.name}[{pi}]->{tgt.name}[{ci}]")
                              for ci in range(nc)]
                     for ci, ch in enumerate(chans):
                         inputs[tgt.id][ci].append(ch)
                         input_logical[tgt.id][ci].append(e.input_index)
-                    part = e.partitioning
-                    # forward edges with fan-out degrade to round-robin
+                    # forward edges with MISMATCHED parallelism degrade to
+                    # round-robin (the reference inserts rescale here)
                     if part == "forward" and nc > 1:
                         part = "rebalance"
                     outputs[v.id][pi].append(OutputDispatcher(

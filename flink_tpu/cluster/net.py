@@ -1,0 +1,281 @@
+"""Cross-host data plane: TCP channels with credit-based flow control.
+
+The inter-host analog of the reference's Netty shuffle
+(``NettyServer.java`` / ``NettyMessage.java``: ``PartitionRequest``,
+``BufferResponse:254``, ``AddCredit:678``; credit accounting in
+``RemoteInputChannel.java:101,302``): intra-pod record exchange rides device
+collectives (``parallel/exchange.py``), and THIS module is the host/DCN tier
+— one :class:`ChannelServer` per receiving process, writers connect per
+logical channel, record batches travel as FTB frames (the native codec, with
+block compression), control elements as JSON frames.
+
+Flow control mirrors the reference's credit protocol: the receiver grants an
+initial per-channel credit budget (its buffer queue capacity); every element
+costs one credit; the consumer draining its queue returns credits to the
+sender.  A writer with zero credits blocks — the sender-side backpressure
+that keeps a slow consumer from being buried (never TCP head-of-line
+blocking across channels: each channel has its own connection + budget).
+
+Wire format per frame:  ``type u8 | length u32le | payload``
+  type 0 = RecordBatch (FTB), 1 = control element (JSON),
+  type 2 = credit grant (receiver -> sender, count u32 payload),
+  type 3 = handshake (sender -> receiver: channel id utf-8).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from flink_tpu.core.batch import (CheckpointBarrier, EndOfInput,
+                                  LatencyMarker, RecordBatch, StreamElement,
+                                  StreamStatus, Watermark)
+
+_HDR = struct.Struct("<BI")
+_BATCH, _CONTROL, _CREDIT, _HELLO = 0, 1, 2, 3
+
+
+def _encode_control(el: StreamElement) -> bytes:
+    if isinstance(el, Watermark):
+        d = {"t": "wm", "ts": el.timestamp}
+    elif isinstance(el, CheckpointBarrier):
+        d = {"t": "barrier", "id": el.checkpoint_id, "ts": el.timestamp,
+             "sp": el.is_savepoint}
+    elif isinstance(el, EndOfInput):
+        d = {"t": "eoi"}
+    elif isinstance(el, StreamStatus):
+        d = {"t": "status", "idle": el.idle}
+    elif isinstance(el, LatencyMarker):
+        d = {"t": "latency", "mt": el.marked_time, "src": el.source_id,
+             "sub": el.subtask_index}
+    else:
+        raise TypeError(f"not wire-encodable: {type(el).__name__}")
+    return json.dumps(d).encode()
+
+
+def _decode_control(payload: bytes) -> StreamElement:
+    d = json.loads(payload)
+    t = d["t"]
+    if t == "wm":
+        return Watermark(d["ts"])
+    if t == "barrier":
+        return CheckpointBarrier(d["id"], d["ts"], d["sp"])
+    if t == "eoi":
+        return EndOfInput()
+    if t == "status":
+        return StreamStatus(d["idle"])
+    if t == "latency":
+        return LatencyMarker(d["mt"], d["src"], d["sub"])
+    raise ValueError(f"unknown control frame {t!r}")
+
+
+def _send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(ftype, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None, None
+    ftype, ln = _HDR.unpack(hdr)
+    payload = _recv_exact(sock, ln) if ln else b""
+    if ln and payload is None:
+        return None, None
+    return ftype, payload
+
+
+class _ReceiveQueue:
+    """Server-side channel queue; polling returns credits to the sender
+    (``RemoteInputChannel.notifyCreditAvailable`` direction)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._conn: Optional[socket.socket] = None
+        self._closed = False
+
+    def _attach(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conn = conn
+
+    def _push(self, el: StreamElement) -> None:
+        with self._not_empty:
+            self._q.append(el)
+            self._not_empty.notify()
+
+    def poll(self, timeout_s: float = 0.0) -> Optional[StreamElement]:
+        with self._not_empty:
+            if not self._q and timeout_s > 0:
+                self._not_empty.wait(timeout=timeout_s)
+            if not self._q:
+                return None
+            el = self._q.popleft()
+            conn = self._conn
+        if conn is not None:
+            try:
+                _send_frame(conn, _CREDIT, struct.pack("<I", 1))
+            except OSError:
+                pass
+        return el
+
+    def close(self) -> None:
+        with self._not_empty:
+            self._closed = True
+            self._not_empty.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+class ChannelServer:
+    """Receiving endpoint: one TCP server, one queue per logical channel."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 channel_capacity: int = 32):
+        self.channel_capacity = channel_capacity
+        self._queues: Dict[str, _ReceiveQueue] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="channel-server", daemon=True)
+        self._thread.start()
+
+    def channel(self, channel_id: str) -> _ReceiveQueue:
+        """The consumer-side queue (poll/close/len — LocalChannel shape)."""
+        with self._lock:
+            q = self._queues.get(channel_id)
+            if q is None:
+                q = self._queues[channel_id] = _ReceiveQueue(
+                    self.channel_capacity)
+            return q
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from flink_tpu.native.codec import decode_batch
+
+        try:
+            ftype, payload = _recv_frame(conn)
+            if ftype != _HELLO:
+                conn.close()
+                return
+            q = self.channel(payload.decode())
+            q._attach(conn)
+            # initial credit grant = queue capacity (exclusive buffers)
+            _send_frame(conn, _CREDIT, struct.pack("<I", q.capacity))
+            while not self._stop.is_set():
+                ftype, payload = _recv_frame(conn)
+                if ftype is None:
+                    return
+                if ftype == _BATCH:
+                    q._push(decode_batch(payload))
+                elif ftype == _CONTROL:
+                    q._push(_decode_control(payload))
+        except (OSError, ValueError):
+            return
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            for q in self._queues.values():
+                q.close()
+
+
+class RemoteChannel:
+    """Sender side: LocalChannel-shaped ``put`` over TCP with credits."""
+
+    def __init__(self, host: str, port: int, channel_id: str,
+                 connect_timeout_s: float = 10.0):
+        self.channel_id = channel_id
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout_s)
+        self._sock.settimeout(None)
+        _send_frame(self._sock, _HELLO, channel_id.encode())
+        self._credits = 0
+        self._lock = threading.Lock()
+        self._have_credit = threading.Condition(self._lock)
+        self._closed = False
+        self._reader = threading.Thread(target=self._credit_loop,
+                                        name=f"credits-{channel_id}",
+                                        daemon=True)
+        self._reader.start()
+
+    def _credit_loop(self) -> None:
+        while True:
+            ftype, payload = _recv_frame(self._sock)
+            if ftype is None:
+                with self._have_credit:
+                    self._closed = True
+                    self._have_credit.notify_all()
+                return
+            if ftype == _CREDIT:
+                (n,) = struct.unpack("<I", payload)
+                with self._have_credit:
+                    self._credits += n
+                    self._have_credit.notify_all()
+
+    def put(self, el: StreamElement,
+            timeout_s: Optional[float] = None) -> bool:
+        from flink_tpu.native.codec import encode_batch
+
+        with self._have_credit:
+            while self._credits <= 0 and not self._closed:
+                if not self._have_credit.wait(timeout=timeout_s):
+                    return False
+            if self._closed:
+                return False
+            self._credits -= 1
+        try:
+            if isinstance(el, RecordBatch):
+                _send_frame(self._sock, _BATCH, encode_batch(el))
+            else:
+                _send_frame(self._sock, _CONTROL, _encode_control(el))
+            return True
+        except OSError:
+            with self._have_credit:
+                self._closed = True
+            return False
+
+    def close(self) -> None:
+        with self._have_credit:
+            self._closed = True
+            self._have_credit.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

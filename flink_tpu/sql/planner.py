@@ -43,6 +43,7 @@ class AggSpec:
     out_name: str       # "__agg0", ... — ACC entry + fired column name
     func: str           # SUM/COUNT/AVG/MIN/MAX
     arg: Optional[Expr]  # None for COUNT(*)
+    distinct: bool = False
 
 
 @dataclass
@@ -153,8 +154,6 @@ def _extract_aggs(expr: Expr, specs: List[AggSpec],
     (full node coverage via the generic ``_transform`` walker)."""
     def fn(e: Expr) -> Optional[Expr]:
         if isinstance(e, Call) and e.name in AGG_FUNCS:
-            if e.distinct:
-                raise PlanError(f"{e.name}(DISTINCT ...) is not supported yet")
             if e in cache:
                 return cache[e]
             arg = None
@@ -162,8 +161,10 @@ def _extract_aggs(expr: Expr, specs: List[AggSpec],
                 if len(e.args) != 1:
                     raise PlanError(f"{e.name} takes exactly one argument")
                 arg = e.args[0]
+            if e.distinct and arg is None:
+                raise PlanError(f"{e.name}(DISTINCT *) is meaningless")
             name = f"__agg{len(specs)}"
-            specs.append(AggSpec(name, e.name, arg))
+            specs.append(AggSpec(name, e.name, arg, distinct=e.distinct))
             col = Column(name)
             cache[e] = col
             return col
@@ -600,6 +601,48 @@ class Planner:
                 stream = stream.assign_timestamps_and_watermarks(
                     table.watermark_delay_ms, timestamp_column=window.time_col,
                     name="sql-rowtime")
+
+        # ---- DISTINCT aggregates: rewrite as dedup-then-aggregate
+        # (the classic two-phase expansion of COUNT(DISTINCT x) GROUP BY k:
+        # drop duplicate (k, x) rows, then aggregate normally)
+        distinct_specs = [s for s in agg_specs if s.distinct]
+        if distinct_specs:
+            if window is not None:
+                raise PlanError("DISTINCT aggregates inside group windows "
+                                "are not supported yet")
+            if any(not s.distinct for s in agg_specs):
+                raise PlanError("mixing DISTINCT and plain aggregates in one "
+                                "query is not supported (the dedup stage "
+                                "would drop the plain aggregates' rows)")
+            args = {repr(s.arg) for s in distinct_specs}
+            if len(args) != 1:
+                raise PlanError("all DISTINCT aggregates in a query must "
+                                "share the same argument")
+            dk_fns = ([compiler.compile(k) for k in group_keys]
+                      + [compiler.compile(distinct_specs[0].arg)])
+
+            def add_dedup_key(cols, _fns=dk_fns):
+                nrows = _n(cols)
+                parts = [to_column(f(cols), nrows) for f in _fns]
+                out = dict(cols)
+                # TUPLE keys: unambiguous (no separator collisions) and
+                # hashable for both the dedup dict and key-group routing
+                out["__dedup"] = np.fromiter(
+                    (tuple(row) for row in zip(*(p.tolist() for p in parts))),
+                    object, count=nrows)
+                return out
+
+            from flink_tpu.operators.sql_ops import DeduplicateOperator
+            stream = stream.map(add_dedup_key, name="sql-distinct-key")
+            # keyed routing: at parallelism > 1 every copy of a (key, value)
+            # pair must meet the SAME dedup instance
+            keyed_dedup = stream.key_by("__dedup")
+            from flink_tpu.datastream.api import DataStream
+            t = keyed_dedup._then(
+                "sql-distinct-dedup",
+                lambda: DeduplicateOperator("__dedup", keep="first"),
+                chainable=False)
+            stream = DataStream(stream.env, t)
 
         # ---- pre-projection: aggregate inputs + computed/composite group key
         key_exprs = group_keys

@@ -232,3 +232,53 @@ def test_derived_table_join_not_dropped(tenv):
         "(SELECT cust, amount FROM orders WHERE amount > 45) o "
         "JOIN customers c ON o.cust = c.cust").collect()
     assert sorted((r["name"], r["amount"]) for r in rows) == [("bob", 50.0)]
+
+
+def test_count_distinct(tenv):
+    rows = tenv.execute_sql(
+        "SELECT cust, COUNT(DISTINCT amount) AS n FROM orders "
+        "GROUP BY cust ORDER BY cust").collect()
+    # every amount is unique in the fixture -> same as COUNT(*)
+    assert [(r["cust"], r["n"]) for r in rows] == \
+        [(1, 2), (2, 2), (3, 1), (9, 1)]
+
+
+def test_sum_distinct_dedups_values():
+    te = TableEnvironment()
+    te.register_collection("t", columns={
+        "k": np.array([1, 1, 1, 2], np.int64),
+        "v": np.array([5., 5., 7., 5.])})
+    rows = te.execute_sql(
+        "SELECT k, SUM(DISTINCT v) AS s FROM t GROUP BY k ORDER BY k").collect()
+    assert [(r["k"], r["s"]) for r in rows] == [(1, 12.0), (2, 5.0)]
+    # global (no GROUP BY): distinct per whole table
+    rows = te.execute_sql("SELECT COUNT(DISTINCT v) AS n FROM t").collect()
+    assert rows[0]["n"] == 2
+
+
+def test_mixed_distinct_plain_rejected(tenv):
+    from flink_tpu.sql.planner import PlanError
+    with pytest.raises(PlanError, match="mixing DISTINCT"):
+        tenv.execute_sql("SELECT COUNT(DISTINCT cust), SUM(amount) "
+                         "FROM orders").collect()
+
+
+def test_count_distinct_parallel_cluster():
+    """Regression: the DISTINCT dedup stage must hash-route by the
+    (key, value) pair so parallel subtasks cannot each count a duplicate."""
+    from flink_tpu.cluster.task import TaskStates
+
+    te = TableEnvironment(parallelism=2)
+    te.register_collection("t", columns={
+        "k": np.ones(8, np.int64), "v": np.full(8, 5.0)}, batch_size=1)
+    table = te.sql_query("SELECT k, COUNT(DISTINCT v) AS n FROM t GROUP BY k")
+    env, plan = te._plan(table._stmt if table._stmt.items else table._stmt)
+    # execute on the MiniCluster (real parallelism)
+    sink = plan.stream.collect()
+    res = env.execute_cluster()
+    assert res.state == TaskStates.FINISHED
+    rows = [r for r in sink.rows()]
+    final = {r["k"]: r["__agg0"] for r in rows if "__agg0" in r}
+    if not final:   # post-projection naming
+        final = {r["k"]: r["n"] for r in rows}
+    assert final == {1: 1.0} or final == {1: 1}
