@@ -126,6 +126,17 @@ class MiniCluster(TaskListener):
             entry = assembled.setdefault(
                 uid, {"subtasks": [None] * self._subtask_counts[uid]})
             entry["subtasks"][idx] = snap
+        # finished tasks no longer ack: carry their FINAL snapshots so the
+        # checkpoint stays a complete consistent cut (FLIP-147 analog)
+        for t in self._tasks:
+            key = (t.vertex_uid, t.subtask_index)
+            if key in self._finished and key not in p.acks:
+                final = getattr(t, "final_snapshot", None)
+                if final is not None:
+                    entry = assembled.setdefault(
+                        t.vertex_uid,
+                        {"subtasks": [None] * self._subtask_counts[t.vertex_uid]})
+                    entry["subtasks"][t.subtask_index] = final
         if self.checkpoint_storage is not None:
             self.checkpoint_storage.store(p.checkpoint_id, assembled)
         self._completed_ids.append(p.checkpoint_id)

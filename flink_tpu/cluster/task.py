@@ -124,9 +124,25 @@ class SubtaskBase:
 
     def _run(self) -> None:
         try:
+            if self._restore is not None and self._restore.get("finished"):
+                # restored from a FINAL snapshot (FLIP-147): this task's
+                # data and end-of-input effects are already reflected in
+                # every downstream snapshot of the same checkpoint — only
+                # the channel-termination signal must be replayed, or
+                # downstream restored tasks would wait forever
+                self.final_snapshot = dict(self._restore)
+                self._transition(TaskStates.RUNNING)
+                self._emit([EndOfInput()])
+                self._transition(TaskStates.FINISHED)
+                return
             self._open_and_restore()
             self._transition(TaskStates.RUNNING)
             self._invoke()
+            # FLIP-147 (checkpoints after tasks finish): capture the FINAL
+            # state so checkpoints completing after this task ends still
+            # contain its contribution — restoring such a checkpoint must
+            # not lose finished subtasks' state
+            self.final_snapshot = self._final_snapshot()
             self.operator.close()
             self._transition(TaskStates.FINISHED)
         except _Cancel:
@@ -138,9 +154,16 @@ class SubtaskBase:
     def _invoke(self) -> None:
         raise NotImplementedError
 
+    def _final_snapshot(self) -> Dict[str, Any]:
+        return {"operator": self.operator.snapshot_state(), "finished": True}
+
 
 class SourceSubtask(SubtaskBase):
     """Runs one source split; checkpoints replay offsets."""
+
+    def _final_snapshot(self) -> Dict[str, Any]:
+        return {"operator": self.operator.snapshot_state(),
+                "source_offset": self._emitted, "finished": True}
 
     def __init__(self, vertex_uid: str, subtask_index: int, operator,
                  outputs, ctx, listener, split):

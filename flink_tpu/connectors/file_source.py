@@ -118,7 +118,18 @@ class FileSplit(SourceSplit):
 
 class FileSink:
     """Two-phase-commit file sink (``FileSink`` analog). Part file lifecycle:
-    ``.inprogress`` → (snapshot) ``.pending-{n}`` → (notify complete) final."""
+    ``.inprogress`` → (snapshot) ``.pending-{n}`` → (notify complete) final.
+    Cloned per parallel subtask (own attempt id + part counter)."""
+
+    clone_per_subtask = True
+
+    def on_cloned(self) -> None:
+        import uuid
+
+        self._attempt = uuid.uuid4().hex[:8]
+        self._buf = []
+        self._buf_rows = 0
+        self._pending = []
 
     def __init__(self, directory: str, format: str = "csv",
                  rolling_records: int = 1 << 20, prefix: str = "part"):

@@ -171,7 +171,14 @@ class LogSink:
 
     Partitioning: ``hash(key_column) % num_partitions`` when a key column is
     given, else round-robin per batch.
+
+    Parallel use: the runtime CLONES this sink per subtask
+    (``clone_per_subtask``) — every instance gets its own attempt id, epoch
+    buffer, and commit sidecar, so per-subtask barriers stage disjoint
+    transactions.
     """
+
+    clone_per_subtask = True
 
     def __init__(self, directory: str, num_partitions: int = 1,
                  key_column: Optional[str] = None, txn_id: str = "logsink"):
@@ -188,15 +195,62 @@ class LogSink:
         self._epoch: List[RecordBatch] = []
         self._staged: Dict[int, List[RecordBatch]] = {}
         self._rr = 0
-        self._commits_path = os.path.join(directory, f"_commits-{txn_id}.json")
+        self.directory = directory
         # a crashed predecessor may have left a half-appended transaction
         self._recover_partial_commits()
 
+    @property
+    def _commits_path(self) -> str:
+        # per-ATTEMPT sidecar: parallel clones and restored instances never
+        # read-modify-write one shared file
+        return os.path.join(self.directory,
+                            f"_commits-{self.txn_id}-{self._attempt}.json")
+
+    def _txn_lock(self):
+        """Exclusive cross-process/thread lock for commit + recovery critical
+        sections: sibling subtask clones share the directory, and recovery
+        must never observe (or truncate under) a sibling's in-flight commit."""
+        import fcntl
+        from contextlib import contextmanager
+
+        @contextmanager
+        def lock():
+            fd = os.open(os.path.join(self.directory, "_txnlock"),
+                         os.O_CREAT | os.O_RDWR)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                yield
+            finally:
+                os.close(fd)  # releases the flock
+
+        return lock()
+
+    def on_cloned(self) -> None:
+        """Fresh identity for a per-subtask clone."""
+        import uuid
+
+        self._attempt = uuid.uuid4().hex[:12]
+        self._epoch = []
+        self._staged = {}
+
     def _committed_ids(self) -> List[str]:
-        if os.path.exists(self._commits_path):
-            with open(self._commits_path) as f:
-                return json.load(f)
-        return []
+        """UNION over every attempt's sidecar (+ the legacy shared file):
+        recovery decisions must see commits recorded by ANY prior attempt or
+        sibling — keys are attempt-qualified, so the union never collides."""
+        out: List[str] = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        prefix = f"_commits-{self.txn_id}"
+        for f in names:
+            if f.startswith(prefix) and f.endswith(".json"):
+                try:
+                    with open(os.path.join(self.directory, f)) as fh:
+                        out.extend(json.load(fh))
+                except (OSError, ValueError):
+                    continue
+        return out
 
     def _commit_key(self, checkpoint_id: int) -> str:
         return f"{self._attempt}:{checkpoint_id}"
@@ -215,12 +269,14 @@ class LogSink:
             self._epoch.append(batch)
 
     def flush(self) -> None:
-        # bounded end: no more barriers will come — commit directly
+        # bounded end: no more barriers will come. ORDER MATTERS: staged
+        # (older) transactions must land in the log BEFORE the final epoch's
+        # rows, or consumers reading "last value per key" see stale data
+        for cid in sorted(self._staged):
+            self._commit(cid)
         for b in self._epoch:
             self._append(b)
         self._epoch = []
-        for cid in sorted(self._staged):
-            self._commit(cid)
 
     def close(self) -> None:
         pass
@@ -284,20 +340,27 @@ class LogSink:
                             f"_intent-{self.txn_id}-{self._attempt}-{cid}.json")
 
     def _recover_partial_commits(self) -> None:
-        committed = set(self._committed_ids())
-        for f in os.listdir(self.log.directory):
-            if not f.startswith(f"_intent-{self.txn_id}-"):
-                continue
-            path = os.path.join(self.log.directory, f)
-            with open(path) as fh:
-                intent = json.load(fh)
-            if intent["key"] not in committed:
-                for p_str, off in intent["offsets"].items():
-                    lp = self.log._path(int(p_str))
-                    if os.path.exists(lp) and os.path.getsize(lp) > off:
-                        with open(lp, "r+b") as lf:
-                            lf.truncate(off)
-            os.remove(path)
+        with self._txn_lock():
+            committed = set(self._committed_ids())
+            for f in os.listdir(self.log.directory):
+                if not f.startswith(f"_intent-{self.txn_id}-"):
+                    continue
+                path = os.path.join(self.log.directory, f)
+                try:
+                    with open(path) as fh:
+                        intent = json.load(fh)
+                except (FileNotFoundError, ValueError):
+                    continue  # sibling recovered it concurrently
+                if intent["key"] not in committed:
+                    for p_str, off in intent["offsets"].items():
+                        lp = self.log._path(int(p_str))
+                        if os.path.exists(lp) and os.path.getsize(lp) > off:
+                            with open(lp, "r+b") as lf:
+                                lf.truncate(off)
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
 
     def _commit(self, cid: int) -> None:
         batches = self._staged.pop(cid, None)
@@ -306,15 +369,23 @@ class LogSink:
         if not batches:
             self._record_commit(cid)
             return
-        offsets = {p: self.log.end_offset(p)
-                   for p in range(self.log.num_partitions)}
-        tmp = self._intent_path(cid) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"key": self._commit_key(cid), "offsets": offsets}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._intent_path(cid))
-        for b in batches:
-            self._append(b)
-        self._record_commit(cid)
-        os.remove(self._intent_path(cid))
+        # the whole intent->append->record->cleanup sequence runs under the
+        # directory txn lock so a sibling's recovery can never truncate a
+        # half-appended transaction that is actually in progress
+        with self._txn_lock():
+            offsets = {p: self.log.end_offset(p)
+                       for p in range(self.log.num_partitions)}
+            tmp = self._intent_path(cid) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"key": self._commit_key(cid), "offsets": offsets},
+                          f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._intent_path(cid))
+            for b in batches:
+                self._append(b)
+            self._record_commit(cid)
+            try:
+                os.remove(self._intent_path(cid))
+            except FileNotFoundError:
+                pass

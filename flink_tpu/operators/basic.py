@@ -290,6 +290,17 @@ class SinkOperator(StreamOperator):
     """Terminal operator wrapping a sink function (``StreamSink`` analog)."""
 
     def __init__(self, sink, name: str = "sink"):
+        import copy as _copy
+
+        # transactional/stateful sinks declare clone_per_subtask: each
+        # parallel operator instance needs its OWN epoch buffers and txn
+        # identity (a shared instance races across subtask threads and
+        # breaks barrier alignment); collection-style sinks stay shared
+        if getattr(sink, "clone_per_subtask", False):
+            sink = _copy.deepcopy(sink)
+            on_cloned = getattr(sink, "on_cloned", None)
+            if on_cloned is not None:
+                on_cloned()
         self.sink = sink
         self.name = name
 
