@@ -60,6 +60,51 @@ def _cmd_sql(args) -> int:
     return 0
 
 
+def _cmd_rest(args) -> int:
+    """Cluster commands against a running REST endpoint
+    (``flink list/cancel/savepoint`` parity)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+
+    def req(path, method="GET"):
+        """-> (status_code, parsed body); non-2xx responses are DATA here
+        (the server answers 404/409 with JSON bodies), not tracebacks."""
+        rq = urllib.request.Request(base + path, method=method)
+        try:
+            with urllib.request.urlopen(rq, timeout=60) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.fp.read())
+            except (ValueError, OSError):
+                return e.code, {"error": str(e)}
+
+    if args.cmd == "list":
+        _st, body = req("/jobs")
+        for j in body.get("jobs", []):
+            print(f"{j['id']}  {j['state']:<10} {j['name']}")
+        return 0
+    if args.cmd == "status":
+        st, body = req(f"/jobs/{args.job_id}")
+        print(json.dumps(body, indent=2))
+        return 0 if st == 200 else 1
+    if args.cmd == "cancel":
+        st, body = req(f"/jobs/{args.job_id}", "PATCH")
+        print(body.get("status", body.get("error")))
+        return 0 if st < 400 else 1
+    if args.cmd == "savepoint":
+        st, body = req(f"/jobs/{args.job_id}/savepoints", "POST")
+        if body.get("status") == "completed":
+            print(f"completed: checkpoint {body.get('checkpoint_id')}")
+            return 0
+        print(body.get("status", body.get("error")))
+        return 1
+    return 2
+
+
 def _cmd_info(_args) -> int:
     import jax
 
@@ -90,6 +135,14 @@ def main(argv=None) -> int:
     ps.set_defaults(fn=_cmd_sql)
     pi = sub.add_parser("info", help="environment info")
     pi.set_defaults(fn=_cmd_info)
+    for name, needs_job in (("list", False), ("status", True),
+                            ("cancel", True), ("savepoint", True)):
+        pc = sub.add_parser(name, help=f"{name} jobs via the REST endpoint")
+        pc.add_argument("--url", required=True,
+                        help="REST endpoint, e.g. http://127.0.0.1:8081")
+        if needs_job:
+            pc.add_argument("job_id")
+        pc.set_defaults(fn=_cmd_rest)
     args = p.parse_args(argv)
     return args.fn(args)
 

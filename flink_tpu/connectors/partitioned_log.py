@@ -256,11 +256,20 @@ class LogSink:
         return f"{self._attempt}:{checkpoint_id}"
 
     def _record_commit(self, checkpoint_id: int) -> None:
-        ids = self._committed_ids()
-        ids.append(self._commit_key(checkpoint_id))
+        # write ONLY this attempt's keys into its own sidecar (reads union
+        # all attempts): mixing the union in would evict other attempts'
+        # keys in arbitrary order once the 100-entry bound is hit
+        own: List[str] = []
+        if os.path.exists(self._commits_path):
+            try:
+                with open(self._commits_path) as f:
+                    own = json.load(f)
+            except (OSError, ValueError):
+                own = []
+        own.append(self._commit_key(checkpoint_id))
         tmp = self._commits_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(ids[-100:], f)
+            json.dump(own[-100:], f)
         os.replace(tmp, self._commits_path)
 
     # -- Sink interface ------------------------------------------------------

@@ -155,3 +155,33 @@ def test_latency_markers_recorded():
     lats = mc.sink_latencies_ms()
     assert lats, "no latency samples recorded at the sink"
     assert all(l >= 0 for l in lats)
+
+
+def test_cli_cluster_commands(stack):
+    import subprocess
+    import sys
+
+    registry, server = stack
+    job_id, mc, th = _run_job(registry, n=2_000_000,
+                              storage=InMemoryCheckpointStorage())
+    try:
+        time.sleep(0.2)
+
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def cli(*args):
+            return subprocess.run(
+                [sys.executable, "-m", "flink_tpu", *args, "--url", server.url],
+                capture_output=True, text=True, timeout=120, cwd=repo)
+
+        out = cli("list")
+        assert job_id in out.stdout
+        out = cli("status", job_id)
+        assert '"state"' in out.stdout
+        out = cli("savepoint", job_id)
+        assert "completed" in out.stdout, out.stdout + out.stderr
+        out = cli("cancel", job_id)
+        assert "cancelling" in out.stdout
+    finally:
+        th.join(timeout=120)
