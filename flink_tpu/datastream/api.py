@@ -476,11 +476,18 @@ class KeyedStream(DataStream):
 
     def process(self, fn, name: str = "keyed-process") -> "DataStream":
         """Run a ``KeyedProcessFunction`` (keyed state + timers) on this
-        stream (``KeyedStream.process`` analog)."""
+        stream (``KeyedStream.process`` analog).  The keyed backend follows
+        ``state.backend`` in the environment config (heap / spill /
+        changelog)."""
         from flink_tpu.operators.process import KeyedProcessOperator
+        from flink_tpu.state import make_keyed_backend
         key_col = self.key_column
+        cfg = self.env.config
+        maxp = self.env.max_parallelism
         return DataStream(self.env, self._then(
-            name, lambda: KeyedProcessOperator(fn, key_col, name)))
+            name, lambda: KeyedProcessOperator(
+                fn, key_col, name,
+                backend=make_keyed_backend(cfg, max_parallelism=maxp))))
 
     def reduce(self, fn: Union[ReduceFunction, Callable], identity_value=None,
                value_column: Optional[str] = None,

@@ -98,11 +98,14 @@ class OnTimerContext(Context):
 
 class KeyedProcessOperator(StreamOperator):
     def __init__(self, fn: KeyedProcessFunction, key_column: str,
-                 name: str = "keyed-process"):
+                 name: str = "keyed-process", backend=None):
         self.fn = fn
         self.key_column = key_column
         self.name = name
-        self.backend = HeapKeyedStateBackend()
+        #: configurable keyed backend (state.backend): heap / native spill /
+        #: changelog wrapper — same vectorized State API either way
+        self.backend = backend if backend is not None \
+            else HeapKeyedStateBackend()
         self.timers = InternalTimerService()
 
     def open(self, ctx: RuntimeContext) -> None:

@@ -1,0 +1,30 @@
+
+
+def make_keyed_backend(config=None, max_parallelism: int = 128,
+                       directory=None):
+    """Construct the configured keyed state backend (StateBackendOptions
+    analog): 'hbm'/'heap' -> dense-row heap backend, 'spill' -> the native
+    C++ spill tier, 'changelog' / 'changelog-spill' -> the changelog wrapper
+    over the chosen inner backend."""
+    from flink_tpu.config.options import StateOptions
+    from flink_tpu.state.heap import HeapKeyedStateBackend
+
+    name = "hbm"
+    if config is not None:
+        name = (config.get(StateOptions.BACKEND) or "hbm").lower()
+    if name in ("hbm", "heap", "host"):
+        return HeapKeyedStateBackend(max_parallelism=max_parallelism)
+    if name == "spill":
+        from flink_tpu.state.spill import SpillKeyedStateBackend
+        return SpillKeyedStateBackend(directory, max_parallelism=max_parallelism)
+    if name in ("changelog", "changelog-heap"):
+        from flink_tpu.state.changelog import ChangelogKeyedStateBackend
+        return ChangelogKeyedStateBackend(
+            HeapKeyedStateBackend(max_parallelism=max_parallelism))
+    if name == "changelog-spill":
+        from flink_tpu.state.changelog import ChangelogKeyedStateBackend
+        from flink_tpu.state.spill import SpillKeyedStateBackend
+        return ChangelogKeyedStateBackend(
+            SpillKeyedStateBackend(directory, max_parallelism=max_parallelism))
+    raise ValueError(f"unknown state.backend {name!r}; "
+                     f"use hbm|spill|changelog|changelog-spill")

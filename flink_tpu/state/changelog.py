@@ -67,6 +67,10 @@ class ChangelogKeyedStateBackend:
     def max_parallelism(self) -> int:
         return self.inner.max_parallelism
 
+    @max_parallelism.setter
+    def max_parallelism(self, value: int) -> None:
+        self.inner.max_parallelism = value
+
     @property
     def num_keys(self) -> int:
         return self.inner.num_keys

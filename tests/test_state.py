@@ -269,3 +269,57 @@ def test_ttl_append_does_not_resurrect_expired_content():
     assert red.get() == 5.0
     mp.put("new", 2)
     assert dict(mp.items()) == {"new": 2}
+
+
+def test_state_backend_selectable_via_config():
+    """state.backend config picks the keyed backend for process functions
+    (heap / native spill / changelog) with identical results."""
+    import numpy as np
+
+    from flink_tpu.config.config_option import Configuration
+    from flink_tpu.config.options import StateOptions
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.operators.process import KeyedProcessFunction
+    from flink_tpu.state.changelog import ChangelogKeyedStateBackend
+    from flink_tpu.state.spill import SpillKeyedStateBackend
+
+    class Count(KeyedProcessFunction):
+        def process_batch(self, ctx, batch):
+            st = ctx.state(ValueStateDescriptor("n", default=0))
+            got = st.get_rows(batch.key_ids)
+            cur = got[0] if isinstance(got, tuple) else got
+            vals = np.asarray([0 if c is None else int(c) for c in cur]) + 1
+            st.put_rows(batch.key_ids, vals)
+            return [batch.with_columns({"k": batch.column("k"),
+                                        "n": vals})]
+
+    def run(backend_name):
+        cfg = Configuration()
+        cfg.set(StateOptions.BACKEND, backend_name)
+        env = StreamExecutionEnvironment(config=cfg)
+        # batch_size == #keys: one occurrence per key per batch (duplicate
+        # slots within one put_rows overwrite — last write wins)
+        sink = (env.from_collection(columns={"k": np.arange(50) % 5},
+                                    batch_size=5)
+                .key_by("k").process(Count()).collect())
+        env.execute()
+        final = {}
+        for r in sink.rows():
+            final[r["k"]] = r["n"]
+        return final
+
+    expect = run("hbm")
+    assert expect == {k: 10 for k in range(5)}
+    assert run("spill") == expect
+    assert run("changelog") == expect
+
+
+def test_unknown_backend_rejected():
+    from flink_tpu.state import make_keyed_backend
+    from flink_tpu.config.config_option import Configuration
+    from flink_tpu.config.options import StateOptions
+
+    cfg = Configuration()
+    cfg.set(StateOptions.BACKEND, "rocksdb")
+    with pytest.raises(ValueError, match="unknown state.backend"):
+        make_keyed_backend(cfg)
