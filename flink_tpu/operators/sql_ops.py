@@ -410,3 +410,377 @@ class MiniBatchOperator(StreamOperator):
         if snap.get("bundle"):
             self._buf = [RecordBatch(snap["bundle"], timestamps=snap.get("ts"))]
             self._rows = sum(len(b) for b in self._buf)
+
+
+class OverAggSpec:
+    """One aggregate column of an OVER window (``StreamExecOverAggregate``).
+
+    ``func``: SUM/COUNT/AVG/MIN/MAX/ROW_NUMBER; ``in_col``: pre-projected
+    numeric input column (None for COUNT(*)/ROW_NUMBER).  Frame: both bounds
+    None = unbounded preceding; ``rows`` = ROWS n PRECEDING AND CURRENT ROW;
+    ``range_ms`` = RANGE INTERVAL n PRECEDING AND CURRENT ROW.  ``is_rows``
+    picks per-row vs peer-inclusive semantics for unbounded frames
+    (``RowTimeRowsUnboundedPrecedingFunction`` vs ``RowTimeRange...``)."""
+
+    __slots__ = ("out_name", "func", "in_col", "rows", "range_ms", "is_rows")
+
+    def __init__(self, out_name: str, func: str, in_col: Optional[str],
+                 rows: Optional[int] = None, range_ms: Optional[int] = None,
+                 is_rows: bool = False):
+        self.out_name = out_name
+        self.func = func
+        self.in_col = in_col
+        self.rows = rows
+        self.range_ms = range_ms
+        self.is_rows = is_rows
+
+
+def _sliding_window(padded: np.ndarray, width: int) -> np.ndarray:
+    from numpy.lib.stride_tricks import sliding_window_view
+    return sliding_window_view(padded, width)
+
+
+class OverAggregateOperator(StreamOperator):
+    """Per-partition running aggregates over time-ordered rows — the
+    ``StreamExecOverAggregate`` analog (reference:
+    ``flink-table-planner-blink/.../stream/StreamExecOverAggregate.java``,
+    runtime ``RowTime{Range,Rows}{Unbounded,Bounded}PrecedingFunction``).
+
+    Event-time mode buffers rows per partition and, on each watermark,
+    emits every buffered row with ``ts <= watermark`` in timestamp order,
+    each extended with its frame aggregates (vectorized: cumulative sums /
+    sliding-window reductions over the sorted flush, not a per-row state
+    probe).  Late rows (ts at or below the last watermark) are dropped, as
+    in the reference.  Arrival mode (no time attribute) emits immediately
+    in arrival order.
+    """
+
+    def __init__(self, specs: List[OverAggSpec],
+                 partition_column: Optional[str],
+                 event_time: bool = True, name: str = "sql-over-agg"):
+        self.specs = specs
+        self.partition_column = partition_column
+        self.event_time = event_time
+        self.name = name
+        if not event_time and any(s.range_ms is not None for s in specs):
+            raise ValueError("RANGE frames need an event-time ORDER BY")
+        # per-partition-key state:
+        self._pending: Dict[Any, List[RecordBatch]] = {}
+        # spec index -> key -> running acc (unbounded) or None
+        self._accs: List[Dict[Any, Any]] = [dict() for _ in specs]
+        # spec index -> key -> (ts_buf, val_buf) tail kept for bounded frames
+        self._tails: List[Dict[Any, Any]] = [dict() for _ in specs]
+        self._last_wm = LONG_MIN
+        self._dropped_late = 0
+
+    # ------------------------------------------------------------- ingest
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        if not self.event_time:
+            return self._emit(batch, order=np.arange(len(batch)))
+        ts = np.asarray(batch.timestamps)
+        fresh = ts > self._last_wm
+        if not fresh.all():
+            self._dropped_late += int((~fresh).sum())
+            batch = batch.select(fresh)
+            if len(batch) == 0:
+                return []
+        if self.partition_column is None:
+            self._pending.setdefault(None, []).append(batch)
+            return []
+        keys = np.asarray(batch.columns[self.partition_column])
+        uniq, inv = np.unique(keys, return_inverse=True)
+        for i, k in enumerate(uniq.tolist()):
+            self._pending.setdefault(k, []).append(batch.select(inv == i))
+        return []
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        out = self._flush(watermark.timestamp)
+        self._last_wm = max(self._last_wm, watermark.timestamp)
+        return out
+
+    def end_input(self) -> List[StreamElement]:
+        return self._flush(None)
+
+    def _flush(self, up_to: Optional[int]) -> List[StreamElement]:
+        out: List[StreamElement] = []
+        for key in list(self._pending):
+            merged = RecordBatch.concat(self._pending[key])
+            ts = np.asarray(merged.timestamps)
+            if up_to is None:
+                ready, rest = merged, None
+            else:
+                mask = ts <= up_to
+                if not mask.any():
+                    continue
+                ready = merged.select(mask)
+                rest = merged.select(~mask) if not mask.all() else None
+            if rest is not None and len(rest):
+                self._pending[key] = [rest]
+            else:
+                del self._pending[key]
+            order = np.argsort(np.asarray(ready.timestamps), kind="stable")
+            out.extend(self._emit(ready, order, key=key))
+        return out
+
+    # ------------------------------------------------------------ compute
+    def _emit(self, batch: RecordBatch, order: np.ndarray,
+              key: Any = None) -> List[StreamElement]:
+        batch = batch.take(order)
+        m = len(batch)
+        ts = (np.asarray(batch.timestamps) if batch.timestamps is not None
+              else np.arange(m, dtype=np.int64))
+        cols = dict(batch.columns)
+        if not self.event_time and self.partition_column is not None:
+            # arrival mode still aggregates per partition value
+            keys = np.asarray(cols[self.partition_column])
+            uniq, inv = np.unique(keys, return_inverse=True)
+            if len(uniq) > 1:
+                parts = [self._emit(batch.select(inv == i), np.arange(int((inv == i).sum())), key=k)
+                         for i, k in enumerate(uniq.tolist())]
+                return [RecordBatch.concat([p for part in parts for p in part])]
+            key = uniq[0].item() if len(uniq) else None
+        for i, spec in enumerate(self.specs):
+            vals = (np.asarray(cols[spec.in_col], np.float64)
+                    if spec.in_col is not None else np.ones(m, np.float64))
+            if spec.func == "ROW_NUMBER":
+                start = self._accs[i].get(key, 0)
+                cols[spec.out_name] = start + np.arange(1, m + 1, dtype=np.int64)
+                self._accs[i][key] = start + m
+            elif spec.rows is not None:
+                cols[spec.out_name] = self._rows_frame(i, spec, key, vals)
+            elif spec.range_ms is not None:
+                cols[spec.out_name] = self._range_frame(i, spec, key, ts, vals)
+            else:
+                cols[spec.out_name] = self._unbounded(i, spec, key, ts, vals)
+        return [RecordBatch(cols, batch.timestamps, batch.key_ids,
+                            batch.key_groups)]
+
+    def _unbounded(self, i: int, spec: OverAggSpec, key: Any, ts, vals):
+        """UNBOUNDED PRECEDING: running accumulator carried across flushes;
+        RANGE flavor gives every peer group (equal ts) the group's total."""
+        func = spec.func
+        if func in ("SUM", "AVG", "COUNT"):
+            ps, pc = self._accs[i].get(key, (0.0, 0))
+            cum_s = ps + np.cumsum(vals)
+            cum_c = pc + np.arange(1, len(vals) + 1, dtype=np.int64)
+            self._accs[i][key] = (float(cum_s[-1]), int(cum_c[-1]))
+        elif func == "MIN":
+            prev = self._accs[i].get(key, np.inf)
+            cum_s = np.minimum.accumulate(np.minimum(vals, prev))
+            self._accs[i][key] = float(cum_s[-1])
+            cum_c = None
+        elif func == "MAX":
+            prev = self._accs[i].get(key, -np.inf)
+            cum_s = np.maximum.accumulate(np.maximum(vals, prev))
+            self._accs[i][key] = float(cum_s[-1])
+            cum_c = None
+        else:
+            raise ValueError(f"unsupported OVER aggregate {func}")
+        if not spec.is_rows and self.event_time:
+            # peer-inclusive: each row reads the value at its LAST peer
+            last_peer = np.searchsorted(ts, ts, side="right") - 1
+            cum_s = cum_s[last_peer]
+            if cum_c is not None:
+                cum_c = cum_c[last_peer]
+        if func == "COUNT":
+            return cum_c.astype(np.int64)
+        if func == "AVG":
+            return cum_s / cum_c
+        return cum_s
+
+    def _rows_frame(self, i: int, spec: OverAggSpec, key: Any, vals):
+        """ROWS n PRECEDING AND CURRENT ROW: NaN-padded sliding window over
+        (kept tail ++ new rows); the tail keeps the last n values."""
+        n = spec.rows
+        tail = self._tails[i].get(key)
+        prev = tail if tail is not None else np.empty(0, np.float64)
+        allv = np.concatenate([prev, vals])
+        # windows of width n+1 ending at each NEW row
+        width = n + 1
+        padded = np.concatenate([np.full(width - 1, np.nan), allv])
+        win = _sliding_window(padded, width)[len(prev):]
+        self._tails[i][key] = allv[-n:] if n > 0 else np.empty(0, np.float64)
+        func = spec.func
+        if func == "SUM":
+            return np.nansum(win, axis=1)
+        if func == "COUNT":
+            return (~np.isnan(win)).sum(axis=1).astype(np.int64)
+        if func == "AVG":
+            return np.nansum(win, axis=1) / (~np.isnan(win)).sum(axis=1)
+        if func == "MIN":
+            return np.nanmin(win, axis=1)
+        if func == "MAX":
+            return np.nanmax(win, axis=1)
+        raise ValueError(f"unsupported OVER aggregate {func}")
+
+    def _range_frame(self, i: int, spec: OverAggSpec, key: Any, ts, vals):
+        """RANGE r PRECEDING AND CURRENT ROW over event time, peer-inclusive;
+        the tail keeps rows within r of the newest emitted timestamp."""
+        r = spec.range_ms
+        tail = self._tails[i].get(key)
+        pts, pvs = tail if tail is not None else (np.empty(0, np.int64),
+                                                 np.empty(0, np.float64))
+        all_ts = np.concatenate([pts, np.asarray(ts, np.int64)])
+        all_vs = np.concatenate([pvs, vals])
+        lo = np.searchsorted(all_ts, np.asarray(ts, np.int64) - r, side="left")
+        hi = np.searchsorted(all_ts, np.asarray(ts, np.int64), side="right")
+        keep = all_ts > (all_ts[-1] - r if len(all_ts) else 0)
+        self._tails[i][key] = (all_ts[keep], all_vs[keep])
+        func = spec.func
+        if func in ("SUM", "AVG", "COUNT"):
+            cum = np.concatenate([[0.0], np.cumsum(all_vs)])
+            s = cum[hi] - cum[lo]
+            c = (hi - lo).astype(np.int64)
+            if func == "SUM":
+                return s
+            if func == "COUNT":
+                return c
+            return s / c
+        red = np.minimum if func == "MIN" else np.maximum
+        out = np.empty(len(ts), np.float64)
+        for j in range(len(ts)):
+            out[j] = red.reduce(all_vs[lo[j]:hi[j]])
+        return out
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot_state(self) -> Dict[str, Any]:
+        def pack(batches):
+            b = RecordBatch.concat(batches)
+            return ({k: np.asarray(v) for k, v in b.columns.items()},
+                    None if b.timestamps is None else np.asarray(b.timestamps))
+        return {"pending": {k: pack(v) for k, v in self._pending.items()},
+                "accs": [dict(d) for d in self._accs],
+                "tails": [dict(d) for d in self._tails],
+                "last_wm": self._last_wm,
+                "dropped_late": self._dropped_late}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._pending = {k: [RecordBatch(cols, timestamps=ts)]
+                         for k, (cols, ts) in snap.get("pending", {}).items()}
+        self._accs = [dict(d) for d in snap.get(
+            "accs", [dict() for _ in self.specs])]
+        self._tails = [dict(d) for d in snap.get(
+            "tails", [dict() for _ in self.specs])]
+        self._last_wm = snap.get("last_wm", LONG_MIN)
+        self._dropped_late = snap.get("dropped_late", 0)
+
+
+class BranchMergeOperator(StreamOperator):
+    """Streaming inner merge of two aggregate branches on a merge-key column
+    — the glue for mixed DISTINCT/plain aggregate queries, where the planner
+    splits one logical group-aggregate into a plain branch and a
+    dedup-then-aggregate branch (the reference folds both into one
+    ``AggsHandleFunction`` with distinct-state MapViews; here each branch
+    stays a dense vectorized aggregate and the fired rows re-join).
+
+    Both branches fire the same (key, window) set, so every buffered row
+    pairs up exactly once; ``extra_cols`` names the columns only the right
+    branch contributes.  Column data moves by vectorized fancy-indexing —
+    the only per-row Python is a key-hash probe into the pending index."""
+
+    is_two_input = True
+
+    def __init__(self, merge_column: str, extra_cols: List[str],
+                 name: str = "sql-branch-merge"):
+        self.merge_column = merge_column
+        self.extra_cols = extra_cols
+        self.name = name
+        #: per side: buffered batches with un-merged rows, and an index
+        #: key -> (batch position in the buffer, row) of those rows
+        self._bufs: Tuple[List[RecordBatch], List[RecordBatch]] = ([], [])
+        self._unmatched: Tuple[Dict[Any, Tuple[int, int]],
+                               Dict[Any, Tuple[int, int]]] = ({}, {})
+
+    def process_batch2(self, batch: RecordBatch,
+                       input_index: int) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        s = input_index
+        o = 1 - s
+        keys = np.asarray(batch.columns[self.merge_column])
+        other_idx = self._unmatched[o]
+        mine_rows: List[int] = []              # rows of THIS batch that matched
+        other_rows: List[Tuple[int, int]] = []  # (buf_i, row_i) on the other side
+        buf_pos = len(self._bufs[s])
+        mine_idx = self._unmatched[s]
+        for i in range(len(keys)):
+            hit = other_idx.pop(keys[i], None)
+            if hit is None:
+                mine_idx[keys[i]] = (buf_pos, i)
+            else:
+                mine_rows.append(i)
+                other_rows.append(hit)
+        if len(mine_rows) < len(keys):
+            self._bufs[s].append(batch)
+        if not mine_rows:
+            return []
+
+        # gather the other side's matched rows per buffered batch (vectorized)
+        order = np.argsort([b * (1 << 32) + r for b, r in other_rows],
+                           kind="stable")
+        mine_sel = np.asarray(mine_rows, np.int64)[order]
+        other_sorted = [other_rows[i] for i in order]
+        other_parts: List[RecordBatch] = []
+        mine_parts: List[np.ndarray] = []
+        j = 0
+        while j < len(other_sorted):
+            bi = other_sorted[j][0]
+            k = j
+            while k < len(other_sorted) and other_sorted[k][0] == bi:
+                k += 1
+            rows = np.asarray([r for _, r in other_sorted[j:k]], np.int64)
+            other_parts.append(self._bufs[o][bi].take(rows))
+            mine_parts.append(mine_sel[j:k])
+            j = k
+        mine_take = batch.take(np.concatenate(mine_parts))
+        other_take = RecordBatch.concat(other_parts)
+        left, right = ((mine_take, other_take) if s == 0
+                       else (other_take, mine_take))
+        cols = dict(left.columns)
+        for c in self.extra_cols:
+            cols[c] = np.asarray(right.columns[c])
+        if not other_idx and not mine_idx:
+            # everything paired up — drop the consumed buffers
+            self._bufs[0].clear()
+            self._bufs[1].clear()
+        return [RecordBatch(cols)]
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        return self.process_batch2(batch, 0)
+
+    def _pack_pending(self, side: int) -> List[Dict[str, Any]]:
+        rows = []
+        for k, (bi, ri) in self._unmatched[side].items():
+            b = self._bufs[side][bi]
+            rows.append({n: np.asarray(v)[ri] for n, v in b.columns.items()})
+        return rows
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        # persist only un-merged rows, materialized (small residual set)
+        return {"left_rows": self._pack_pending(0),
+                "right_rows": self._pack_pending(1)}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._bufs = ([], [])
+        self._unmatched = ({}, {})
+        for side, field in ((0, "left_rows"), (1, "right_rows")):
+            rows = snap.get(field) or []
+            if not rows:
+                continue
+            cols: Dict[str, np.ndarray] = {}
+            for n in rows[0]:
+                vals = [r[n] for r in rows]
+                if any(isinstance(v, tuple) for v in vals):
+                    # tuple cells (composite keys) must stay 1-D object
+                    arr = np.empty(len(vals), object)
+                    arr[:] = vals
+                else:
+                    arr = np.asarray(vals)
+                cols[n] = arr
+            b = RecordBatch(cols)
+            self._bufs[side].append(b)
+            keys = np.asarray(b.columns[self.merge_column])
+            for i in range(len(b)):
+                self._unmatched[side][keys[i]] = (0, i)

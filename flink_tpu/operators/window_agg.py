@@ -176,10 +176,13 @@ class WindowAggOperator(StreamOperator):
             trigger = (NeverTrigger() if isinstance(assigner, GlobalWindows)
                        else EventTimeTrigger())
         if trigger.fires_on_count and not isinstance(assigner, GlobalWindows) \
-                and assigner.panes_per_window != 1:
+                and assigner.panes_per_window != 1 \
+                and trigger.purges_on_fire:
             raise NotImplementedError(
-                "CountTrigger over MULTI-PANE (sliding) assigners is not "
-                "supported; use tumbling windows or GlobalWindows")
+                "PURGING count triggers over MULTI-PANE (sliding) assigners "
+                "are not supported: overlapping windows share panes, so "
+                "purging one window's contents would corrupt its neighbours. "
+                "Plain CountTrigger (fire without purge) works.")
         self.trigger = trigger
         self.output_column = output_column
         self.emit_window_bounds = emit_window_bounds
@@ -210,6 +213,10 @@ class WindowAggOperator(StreamOperator):
         self.key_index: Optional[KeyIndex | ObjectKeyIndex] = None
         self._leaves = None          # tuple of [K, P, *leaf] device arrays
         self._counts = None          # int32 [K, P]
+        #: sliding count triggers: window id -> int64[<=K] count already
+        #: fired per key slot (the CountTrigger count register, which clears
+        #: on FIRE — next fire needs n MORE elements)
+        self._count_baselines: Dict[int, np.ndarray] = {}
         self.pane_base: Optional[int] = None   # smallest retained pane id
         self.max_pane: Optional[int] = None    # largest pane seen
         self.last_fired_window: Optional[int] = None
@@ -221,13 +228,42 @@ class WindowAggOperator(StreamOperator):
     ROW_FIELDS = ("leaves", "counts")
 
     @staticmethod
+    def _pack_baselines(snap: Dict[str, Any],
+                        windows: Optional[List[int]] = None):
+        """dict(window -> slot-row array) → parallel list row-field (the
+        redistribute helpers split/concat list-valued row fields per array),
+        aligned on ``windows`` (zeros for windows this snapshot lacks)."""
+        snap = dict(snap)
+        cb = snap.pop("count_baselines", None) or {}
+        if windows is None:
+            if not cb:
+                return snap, ()
+            windows = sorted(cb)
+        n = next((len(np.asarray(v)) for v in cb.values()),
+                 snap["counts"].shape[0] if "counts" in snap else 0)
+        snap["count_baseline_windows"] = list(windows)
+        snap["count_baseline_rows"] = [
+            np.asarray(cb.get(w, np.zeros(n, np.int64))) for w in windows]
+        return snap, ("count_baseline_rows",)
+
+    @staticmethod
+    def _unpack_baselines(snap: Dict[str, Any]) -> Dict[str, Any]:
+        wins = snap.pop("count_baseline_windows", None)
+        rows = snap.pop("count_baseline_rows", None)
+        if wins:
+            snap["count_baselines"] = dict(zip(wins, rows))
+        return snap
+
+    @staticmethod
     def split_snapshot(snap: Dict[str, Any], max_parallelism: int,
                        new_parallelism: int) -> List[Dict[str, Any]]:
         """Rescale a snapshot across key-group ranges
         (``StateAssignmentOperation.reDistributeKeyedStates`` analog)."""
         from flink_tpu.state.redistribute import split_keyed_snapshot
-        return split_keyed_snapshot(snap, WindowAggOperator.ROW_FIELDS,
-                                    max_parallelism, new_parallelism)
+        snap, extra = WindowAggOperator._pack_baselines(snap)
+        parts = split_keyed_snapshot(snap, WindowAggOperator.ROW_FIELDS + extra,
+                                     max_parallelism, new_parallelism)
+        return [WindowAggOperator._unpack_baselines(p) for p in parts]
 
     @staticmethod
     def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -240,7 +276,19 @@ class WindowAggOperator(StreamOperator):
             if not np.array_equal(s["panes"], live[0]["panes"]):
                 raise ValueError("cannot merge snapshots with different pane "
                                  "progress (not from one coordinated checkpoint)")
-        merged = merge_keyed_snapshots(snaps, WindowAggOperator.ROW_FIELDS)
+        all_windows = sorted({w for s in snaps
+                              for w in (s.get("count_baselines") or {})})
+        extra = ()
+        if all_windows:
+            packed = []
+            for s in snaps:
+                p, e = WindowAggOperator._pack_baselines(s, all_windows)
+                packed.append(p)
+                extra = e or extra
+            snaps = packed
+        merged = merge_keyed_snapshots(snaps,
+                                       WindowAggOperator.ROW_FIELDS + extra)
+        merged = WindowAggOperator._unpack_baselines(merged)
         if live:
             merged["watermark"] = max(s["watermark"] for s in live)
         return merged
@@ -252,6 +300,7 @@ class WindowAggOperator(StreamOperator):
         self.key_index = None
         self._leaves = None
         self._counts = None
+        self._count_baselines = {}
         self._pending_fires = []
         self._emit_hist = []
         self.pane_base = None
@@ -744,6 +793,11 @@ class WindowAggOperator(StreamOperator):
         self._leaves, self._counts = self._clear_panes_step(self._leaves, self._counts, slots)
         if self.pane_base > self.max_pane:
             self.max_pane = self.pane_base
+        if self._count_baselines:
+            # drop count-trigger registers of windows fully behind retention
+            lo_w = self.assigner.windows_of_pane(self.pane_base)[0]
+            for w in [w for w in self._count_baselines if w < lo_w]:
+                del self._count_baselines[w]
 
     # ------------------------------------------------------------------ fires
     def _fire_window(self, window_id: int) -> List[StreamElement]:
@@ -780,7 +834,21 @@ class WindowAggOperator(StreamOperator):
         thr = 1 if force else self.trigger.count_threshold
         ka = self._k_active() or self._K
         counts0 = self._counts[:ka, 0]
-        mask = counts0 >= thr
+        base = None
+        if not force and not self.trigger.purges_on_fire:
+            # FIRE-only trigger: state persists, so "n more elements" is
+            # tracked by a baseline of already-fired counts per key
+            counts_np = np.asarray(counts0, np.int64)
+            base = self._count_baselines.get(0)
+            if base is None or len(base) < ka:
+                grown = np.zeros(ka, np.int64)
+                if base is not None:
+                    grown[:len(base)] = base
+                base = grown
+                self._count_baselines[0] = base
+            mask = jnp.asarray((counts_np - base[:ka]) >= thr)
+        else:
+            mask = counts0 >= thr
         if not bool(mask.any()):  # cheap pre-check: skip the K-wide assembly
             return []
         pane_slots = jnp.zeros((1,), jnp.int32)
@@ -788,6 +856,10 @@ class WindowAggOperator(StreamOperator):
                                     self._k_active())
         mask = mask & m
         out = self._emit(mask, result, self.assigner.window_bounds(0))
+        if base is not None:
+            fired = np.asarray(mask)
+            base[:ka] = np.where(fired, np.asarray(counts0, np.int64),
+                                 base[:ka])
         if self.trigger.purges_on_fire and out:
             full_mask = jnp.zeros((self._K,), bool).at[:ka].set(mask)
             self._leaves, self._counts = self._purge_keys_step(
@@ -798,6 +870,11 @@ class WindowAggOperator(StreamOperator):
         """CountTrigger.onElement FIRE for time windows (tumbling: one pane
         per window): per touched pane, emit keys at/over the threshold, then
         purge those cells when the trigger purges."""
+        if self.assigner.panes_per_window != 1 \
+                or not self.trigger.purges_on_fire:
+            # multi-pane windows and non-purging triggers both track fires
+            # via per-(key, window) baselines instead of purging cells
+            return self._fire_count_sliding(touched_panes)
         out: List[StreamElement] = []
         thr = self.trigger.count_threshold
         ka = self._k_active() or self._K
@@ -818,6 +895,47 @@ class WindowAggOperator(StreamOperator):
                 full = jnp.zeros((self._K,), bool).at[:ka].set(mask)
                 self._leaves, self._counts = self._purge_cells_step(
                     self._leaves, self._counts, full, pane_slots)
+        return out
+
+    def _fire_count_sliding(self, touched_panes) -> List[StreamElement]:
+        """CountTrigger.onElement FIRE for SLIDING (multi-pane) windows: a
+        (key, window) fires when the sum of the window's pane counts has
+        grown by >= n since its last fire.  The per-window baseline is the
+        CountTrigger count register (``ReducingState<Long>`` per (key,
+        window) namespace in the reference) — it clears on FIRE.  No purge:
+        overlapping windows share panes."""
+        out: List[StreamElement] = []
+        thr = self.trigger.count_threshold
+        ka = self._k_active() or self._K
+        wins: set = set()
+        for p in np.asarray(touched_panes).tolist():
+            w0, w1 = self.assigner.windows_of_pane(int(p))
+            wins.update(range(w0, w1 + 1))
+        for w in sorted(wins):
+            first, last = self.assigner.window_panes(w)
+            lo, hi = max(first, self.pane_base), min(last, self.max_pane)
+            if lo > hi:
+                continue
+            panes = np.arange(lo, hi + 1, dtype=np.int64)
+            slots = jnp.asarray(panes % self._P, jnp.int32)
+            counts_w = np.asarray(
+                jnp.take(self._counts[:ka], slots, axis=1).sum(axis=1),
+                dtype=np.int64)
+            base = self._count_baselines.get(w)
+            if base is None or len(base) < ka:
+                grown = np.zeros(ka, np.int64)
+                if base is not None:
+                    grown[:len(base)] = base
+                base = grown
+            over = (counts_w - base[:ka]) >= thr
+            if over.any():
+                m, result = self._fire_step(self._leaves, self._counts,
+                                            slots, self._k_active())
+                mask = jnp.asarray(over) & m
+                out.extend(self._emit(mask, result,
+                                      self.assigner.window_bounds(w)))
+                base[:ka] = np.where(over, counts_w, base[:ka])
+            self._count_baselines[w] = base
         return out
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
@@ -872,6 +990,14 @@ class WindowAggOperator(StreamOperator):
             snap["panes"] = panes
             snap["leaves"] = [np.asarray(jnp.take(l, slots, axis=1))[:n] for l in self._leaves]
             snap["counts"] = np.asarray(jnp.take(self._counts, slots, axis=1))[:n]
+        if self._count_baselines:
+            n = self.key_index.num_keys if self.key_index else 0
+            packed = {}
+            for w, b in self._count_baselines.items():
+                arr = np.zeros(n, np.int64)  # pad: slot-aligned with leaves
+                arr[:min(len(b), n)] = np.asarray(b)[:n]
+                packed[w] = arr
+            snap["count_baselines"] = packed
         return snap
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
@@ -898,6 +1024,9 @@ class WindowAggOperator(StreamOperator):
                 l.at[:n, slots].set(jnp.asarray(s))
                 for l, s in zip(self._leaves, snap["leaves"]))
             self._counts = self._counts.at[:n, slots].set(jnp.asarray(snap["counts"]))
+        self._count_baselines = {w: np.asarray(b, np.int64).copy()
+                                 for w, b in
+                                 snap.get("count_baselines", {}).items()}
 
 
 def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:

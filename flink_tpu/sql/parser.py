@@ -115,13 +115,25 @@ class Interval(Expr):
 
 @dataclass(frozen=True)
 class OverCall(Expr):
-    """Window function call: ``fn() OVER (PARTITION BY p ORDER BY o [DESC])``
-    (``StreamExecRank``-feeding shape; ROW_NUMBER is the supported fn)."""
+    """Window function call: ``fn(args) OVER (PARTITION BY p ORDER BY o
+    [DESC] [frame])`` — the ``StreamExecRank`` shape (ROW_NUMBER in a Top-N
+    subquery) and the ``StreamExecOverAggregate`` shape (SUM/COUNT/AVG/MIN/
+    MAX over a partition).  Frame: both bounds None = RANGE UNBOUNDED
+    PRECEDING (the SQL default when ORDER BY is present); ``frame_rows`` =
+    ROWS n PRECEDING AND CURRENT ROW; ``frame_range_ms`` = RANGE INTERVAL
+    n PRECEDING AND CURRENT ROW."""
 
     func: str
     partition_by: Optional[Expr]
     order_by: Optional[Expr]
     ascending: bool = True
+    args: Tuple[Expr, ...] = ()
+    frame_rows: Optional[int] = None
+    frame_range_ms: Optional[int] = None
+    #: ROWS frames are per-row; RANGE frames include peer rows (same order
+    #: value) — matters only for unbounded frames with duplicate timestamps
+    frame_is_rows: bool = False
+    distinct: bool = False
 
 
 @dataclass
@@ -179,6 +191,10 @@ _KEYWORDS = {
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON",
     "OVER", "PARTITION",
 }
+# NOTE: the OVER frame words (ROWS/RANGE/PRECEDING/UNBOUNDED/CURRENT/ROW)
+# are deliberately NOT keywords — they are non-reserved in standard SQL and
+# are matched contextually inside OVER(...) (Parser.at_word), so they remain
+# usable as column names.
 
 _TOKEN_RE = re.compile(r"""
     \s+
@@ -495,6 +511,7 @@ class Parser:
         self.expect("OP", "(")
         partition = order = None
         asc = True
+        frame_rows = frame_range_ms = None
         if self.accept("KEYWORD", "PARTITION"):
             self.expect("KEYWORD", "BY")
             partition = self.parse_expr()
@@ -505,10 +522,58 @@ class Parser:
                 asc = False
             else:
                 self.accept("KEYWORD", "ASC")
+        is_rows = False
+        if self.at_word("ROWS") or self.at_word("RANGE"):
+            frame_rows, frame_range_ms, is_rows = self.parse_frame()
         self.expect("OP", ")")
         if not isinstance(call, Call):
             raise SqlParseError("OVER must follow a function call")
-        return OverCall(call.name, partition, order, asc)
+        return OverCall(call.name, partition, order, asc,
+                        args=call.args, frame_rows=frame_rows,
+                        frame_range_ms=frame_range_ms, frame_is_rows=is_rows,
+                        distinct=call.distinct)
+
+    # frame words are contextual (IDENT tokens), not reserved keywords
+    def at_word(self, word: str) -> bool:
+        t = self.peek()
+        return t.kind == "IDENT" and t.value.upper() == word
+
+    def accept_word(self, word: str) -> bool:
+        if self.at_word(word):
+            self.next()
+            return True
+        return False
+
+    def expect_word(self, word: str) -> None:
+        if not self.accept_word(word):
+            t = self.peek()
+            raise SqlParseError(
+                f"expected {word} at {t.pos}, got {t.value or t.kind!r}")
+
+    def parse_frame(self):
+        """``ROWS|RANGE [BETWEEN] <bound> PRECEDING [AND CURRENT ROW]`` →
+        (frame_rows, frame_range_ms, is_rows); UNBOUNDED → (None, None, _)."""
+        is_rows = self.accept_word("ROWS")
+        if not is_rows:
+            self.expect_word("RANGE")
+        self.accept("KEYWORD", "BETWEEN")
+        frame_rows = frame_range_ms = None
+        if self.accept_word("UNBOUNDED"):
+            pass  # unbounded preceding = the default frame
+        elif is_rows:
+            t = self.expect("NUMBER")
+            frame_rows = int(float(t.value))
+        else:
+            e = self.parse_primary()
+            if not isinstance(e, Interval):
+                raise SqlParseError(
+                    "RANGE frame bound must be INTERVAL '...' PRECEDING")
+            frame_range_ms = e.ms
+        self.expect_word("PRECEDING")
+        if self.accept("KEYWORD", "AND"):
+            self.expect_word("CURRENT")
+            self.expect_word("ROW")
+        return frame_rows, frame_range_ms, is_rows
 
     def parse_call(self, name: str) -> Expr:
         up = name.upper()

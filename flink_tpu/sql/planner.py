@@ -182,17 +182,21 @@ def _copy_stmt(stmt: SelectStmt) -> SelectStmt:
     return out
 
 
-def _contains_over(stmt: SelectStmt) -> bool:
-    found = []
-
-    def fn(e: Expr):
+def _extract_overs(expr: Expr, specs: List[Tuple[str, OverCall]],
+                   cache: Dict[Expr, Column]) -> Expr:
+    """Replace OVER calls with placeholder columns (``__overN``), collecting
+    (placeholder, OverCall) pairs — the ``StreamExecOverAggregate`` split."""
+    def fn(e: Expr) -> Optional[Expr]:
         if isinstance(e, OverCall):
-            found.append(e)
+            if e in cache:
+                return cache[e]
+            name = f"__over{len(specs)}"
+            specs.append((name, e))
+            col = Column(name)
+            cache[e] = col
+            return col
         return None
-
-    for it in stmt.items:
-        _transform(it.expr, fn)
-    return bool(found)
+    return _transform(expr, fn)
 
 
 def _rank_filter_limit(where: Optional[Expr], rn: str) -> Optional[int]:
@@ -213,6 +217,12 @@ def _rank_filter_limit(where: Optional[Expr], rn: str) -> Optional[int]:
         if op == ">":
             return int(l.value) - 1
     return None
+
+
+def _contains_over_expr(expr: Expr) -> bool:
+    specs: List[Tuple[str, OverCall]] = []
+    _extract_overs(expr, specs, {})
+    return bool(specs)
 
 
 def _contains_agg(expr: Expr) -> bool:
@@ -292,12 +302,6 @@ class Planner:
             raise PlanError("FROM clause is required")
         if isinstance(stmt.table, SelectStmt):
             return self._plan_derived(stmt)
-        if _contains_over(stmt):
-            raise PlanError(
-                "window functions (ROW_NUMBER() OVER ...) are supported in "
-                "the blink Top-N shape: SELECT * FROM (SELECT ..., "
-                "ROW_NUMBER() OVER (PARTITION BY p ORDER BY o) AS rn "
-                "FROM t) WHERE rn <= N")
         try:
             table = self.catalog[stmt.table]
         except KeyError:
@@ -320,6 +324,18 @@ class Planner:
                 items.extend(SelectItem(Column(c), c) for c in table.columns)
             else:
                 items.append(it)
+
+        # ---- OVER aggregates (StreamExecOverAggregate): split out before
+        # plain aggregate extraction; they append columns, not reduce rows
+        over_specs: List[Tuple[str, OverCall]] = []
+        over_cache: Dict[Expr, Column] = {}
+        over_items = [SelectItem(_extract_overs(it.expr, over_specs,
+                                                over_cache), it.alias)
+                      for it in items]
+        if over_specs:
+            return self._plan_over(stream, items, over_items, over_specs,
+                                   table, stmt)
+
         agg_specs: List[AggSpec] = []
         agg_cache: Dict[Expr, Column] = {}
         rewritten = [SelectItem(_extract_aggs(it.expr, agg_specs, agg_cache),
@@ -362,6 +378,141 @@ class Planner:
         return self._plan_aggregate(stream, rewritten, having, agg_specs,
                                     group_keys, window, table, stmt, compiler,
                                     orig_items=items)
+
+    # --------------------------------------------------- over aggregates
+    def _plan_over(self, stream, orig_items: List[SelectItem],
+                   items: List[SelectItem],
+                   over_specs: List[Tuple[str, OverCall]], table,
+                   stmt: SelectStmt) -> QueryPlan:
+        """``SELECT cols..., agg(x) OVER (PARTITION BY p ORDER BY rowtime
+        [frame]) FROM t`` — rows pass through extended with frame aggregates
+        (``StreamExecOverAggregate.java`` lowering; the Top-N ROW_NUMBER
+        subquery shape stays on ``_try_plan_rank``)."""
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.graph.transformations import Partitioning
+        from flink_tpu.operators.sql_ops import (OverAggregateOperator,
+                                                 OverAggSpec)
+
+        if stmt.group_by:
+            raise PlanError("OVER aggregates cannot be combined with "
+                            "GROUP BY in one SELECT (use a subquery)")
+        if stmt.having is not None:
+            raise PlanError("HAVING requires GROUP BY")
+        for it in items:
+            if _contains_agg(it.expr):
+                raise PlanError("plain aggregates need GROUP BY; in an OVER "
+                                "query every aggregate must have an OVER "
+                                "clause")
+        schema = dict.fromkeys(table.columns)
+        compiler = ExprCompiler(schema)
+        if stmt.where is not None:
+            if _contains_agg(stmt.where) or _contains_over_expr(stmt.where):
+                raise PlanError("aggregates are not allowed in WHERE")
+            pred = compiler.compile(stmt.where)
+            stream = stream.filter(lambda cols, _p=pred: np.asarray(
+                to_column(_p(cols), _n(cols)), bool), name="sql-where")
+
+        # ---- all OVER windows must share one partitioning + ordering
+        over0 = over_specs[0][1]
+        for _, oc in over_specs[1:]:
+            if (oc.partition_by, oc.order_by, oc.ascending) != \
+                    (over0.partition_by, over0.order_by, over0.ascending):
+                raise PlanError("all OVER windows in one SELECT must share "
+                                "PARTITION BY and ORDER BY")
+        part_col = None
+        if over0.partition_by is not None:
+            if not isinstance(over0.partition_by, Column):
+                raise PlanError("OVER PARTITION BY must be a plain column")
+            part_col = over0.partition_by.name
+        if over0.order_by is None:
+            # without ORDER BY the SQL frame is the whole partition, which a
+            # stream cannot produce row-by-row (the reference rejects it too)
+            raise PlanError("OVER aggregates need ORDER BY <rowtime>")
+        if not isinstance(over0.order_by, Column):
+            raise PlanError("OVER ORDER BY must be a plain column")
+        order_col = over0.order_by.name
+
+        # ---- event-time (rowtime-ordered)
+        event_time = False
+        if order_col is not None:
+            rowtime = table.rowtime
+            if rowtime is not None and order_col != rowtime:
+                raise PlanError(
+                    f"OVER ORDER BY must be the table rowtime ({rowtime!r}) "
+                    f"— streaming over-aggregates are time-ordered")
+            if rowtime is None and not table.timestamps_assigned:
+                raise PlanError("OVER ORDER BY needs a time attribute; "
+                                "declare a rowtime column on the table")
+            if not over0.ascending:
+                raise PlanError("OVER ORDER BY on the rowtime must be ASC")
+            event_time = True
+            if not table.timestamps_assigned:
+                stream = stream.assign_timestamps_and_watermarks(
+                    table.watermark_delay_ms, timestamp_column=order_col,
+                    name="sql-rowtime")
+
+        # ---- pre-project aggregate inputs, build operator specs
+        specs: List[OverAggSpec] = []
+        arg_fns: List[Tuple[str, Any]] = []
+        for name, oc in over_specs:
+            in_col = None
+            if oc.distinct:
+                raise PlanError(f"{oc.func}(DISTINCT ...) OVER is not "
+                                f"supported")
+            if oc.func == "ROW_NUMBER":
+                if oc.args:
+                    raise PlanError("ROW_NUMBER() takes no arguments")
+            elif oc.func in AGG_FUNCS:
+                if len(oc.args) == 1 and isinstance(oc.args[0], Star):
+                    pass  # COUNT(*)
+                elif len(oc.args) != 1:
+                    raise PlanError(f"{oc.func} takes exactly one argument")
+                else:
+                    in_col = name + "_in"
+                    arg_fns.append((in_col, compiler.compile(oc.args[0])))
+            else:
+                raise PlanError(f"{oc.func}() OVER is not supported "
+                                f"(supported: {sorted(AGG_FUNCS)}, "
+                                f"ROW_NUMBER)")
+            specs.append(OverAggSpec(name, oc.func, in_col,
+                                     rows=oc.frame_rows,
+                                     range_ms=oc.frame_range_ms,
+                                     is_rows=oc.frame_is_rows))
+        if arg_fns:
+            def add_args(cols, _af=tuple(arg_fns)):
+                n = _n(cols)
+                out = dict(cols)
+                for nm, f in _af:
+                    out[nm] = to_column(f(cols), n)
+                return out
+            stream = stream.map(add_args, name="sql-over-args")
+
+        factory = (lambda _s=tuple(specs), _p=part_col, _e=event_time:
+                   OverAggregateOperator(list(_s), _p, event_time=_e))
+        if part_col is not None:
+            keyed = stream.key_by(part_col)
+            t = keyed._then("sql-over-agg", factory, chainable=False)
+        else:
+            t = stream._then("sql-over-agg", factory,
+                             partitioning=Partitioning.GLOBAL,
+                             chainable=False)
+        over_stream = DataStream(stream.env, t)
+
+        # ---- final projection over (table cols + over outputs)
+        post_schema = dict.fromkeys(
+            list(table.columns) + [nm for nm, _ in arg_fns]
+            + [name for name, _ in over_specs])
+        post_compiler = ExprCompiler(post_schema)
+        fns = [post_compiler.compile(it.expr) for it in items]
+        names = _output_names(orig_items)
+
+        def project(cols, _fns=fns, _names=names):
+            n = _n(cols)
+            return {nm: to_column(f(cols), n) for nm, f in zip(_names, _fns)}
+
+        out = over_stream.map(project, name="sql-project")
+        return QueryPlan(out, names, _order_names(stmt, items, names),
+                         stmt.limit)
 
     # ------------------------------------------------------- derived tables
     def _plan_derived(self, stmt: SelectStmt) -> QueryPlan:
@@ -602,28 +753,76 @@ class Planner:
                     table.watermark_delay_ms, timestamp_column=window.time_col,
                     name="sql-rowtime")
 
-        # ---- DISTINCT aggregates: rewrite as dedup-then-aggregate
-        # (the classic two-phase expansion of COUNT(DISTINCT x) GROUP BY k:
-        # drop duplicate (k, x) rows, then aggregate normally)
+        # ---- DISTINCT aggregates: dedup-then-aggregate (the classic
+        # two-phase expansion of COUNT(DISTINCT x) GROUP BY k: drop duplicate
+        # (k[, window], x) rows, then aggregate normally).  Mixed queries
+        # split into a plain branch and a distinct branch whose fired rows
+        # re-merge on (key[, window]) — the reference folds both into one
+        # AggsHandleFunction with distinct-state MapViews instead.
         distinct_specs = [s for s in agg_specs if s.distinct]
+        plain_specs = [s for s in agg_specs if not s.distinct]
         if distinct_specs:
-            if window is not None:
-                raise PlanError("DISTINCT aggregates inside group windows "
-                                "are not supported yet")
-            if any(not s.distinct for s in agg_specs):
-                raise PlanError("mixing DISTINCT and plain aggregates in one "
-                                "query is not supported (the dedup stage "
-                                "would drop the plain aggregates' rows)")
+            if window is not None and window.kind != "TUMBLE":
+                raise PlanError(
+                    "DISTINCT aggregates are supported in TUMBLE windows and "
+                    "non-windowed GROUP BY (not HOP/SESSION: rows belong to "
+                    "several overlapping/merging windows, so a row-level "
+                    "dedup key cannot name the window)")
             args = {repr(s.arg) for s in distinct_specs}
             if len(args) != 1:
                 raise PlanError("all DISTINCT aggregates in a query must "
                                 "share the same argument")
-            dk_fns = ([compiler.compile(k) for k in group_keys]
-                      + [compiler.compile(distinct_specs[0].arg)])
 
-            def add_dedup_key(cols, _fns=dk_fns):
+        key_exprs = group_keys
+        single_col_key = (len(key_exprs) == 1 and isinstance(key_exprs[0], Column))
+        key_col = key_exprs[0].name if single_col_key else "__key"
+        emit_bounds = window is not None
+
+        if distinct_specs and plain_specs:
+            a = self._agg_branch(stream, plain_specs, key_exprs, key_col,
+                                 single_col_key, window, compiler, None)
+            b = self._agg_branch(stream, distinct_specs, key_exprs, key_col,
+                                 single_col_key, window, compiler,
+                                 distinct_specs[0].arg)
+            agg_stream = self._merge_branches(
+                a, b, key_col, emit_bounds,
+                extra=[s.out_name for s in distinct_specs])
+        elif distinct_specs:
+            agg_stream = self._agg_branch(stream, distinct_specs, key_exprs,
+                                          key_col, single_col_key, window,
+                                          compiler, distinct_specs[0].arg)
+        else:
+            agg_stream = self._agg_branch(stream, agg_specs, key_exprs,
+                                          key_col, single_col_key, window,
+                                          compiler, None)
+
+        return self._post_aggregate(agg_stream, items, having, agg_specs,
+                                    key_exprs, single_col_key, key_col,
+                                    emit_bounds, stmt, orig_items)
+
+    def _agg_branch(self, stream, agg_specs: List[AggSpec],
+                    key_exprs: List[Expr], key_col: str,
+                    single_col_key: bool, window: Optional[WindowSpec],
+                    compiler: ExprCompiler, dedup_arg: Optional[Expr]):
+        """One aggregate pipeline: [dedup →] pre-project → key_by → window
+        aggregate, returning the fired-rows stream."""
+        from flink_tpu.datastream.api import DataStream
+
+        if dedup_arg is not None:
+            dk_fns = ([compiler.compile(k) for k in key_exprs]
+                      + [compiler.compile(dedup_arg)])
+            win = window
+
+            def add_dedup_key(cols, _fns=dk_fns, _w=win):
                 nrows = _n(cols)
                 parts = [to_column(f(cols), nrows) for f in _fns]
+                if _w is not None:
+                    # TUMBLE: the dedup scope is one window — fold the
+                    # window index into the key so a value recurring in a
+                    # LATER window still counts there
+                    widx = np.asarray(cols[_w.time_col],
+                                      np.int64) // _w.size_ms
+                    parts = parts[:-1] + [widx, parts[-1]]
                 out = dict(cols)
                 # TUPLE keys: unambiguous (no separator collisions) and
                 # hashable for both the dedup dict and key-group routing
@@ -637,7 +836,6 @@ class Planner:
             # keyed routing: at parallelism > 1 every copy of a (key, value)
             # pair must meet the SAME dedup instance
             keyed_dedup = stream.key_by("__dedup")
-            from flink_tpu.datastream.api import DataStream
             t = keyed_dedup._then(
                 "sql-distinct-dedup",
                 lambda: DeduplicateOperator("__dedup", keep="first"),
@@ -645,9 +843,6 @@ class Planner:
             stream = DataStream(stream.env, t)
 
         # ---- pre-projection: aggregate inputs + computed/composite group key
-        key_exprs = group_keys
-        single_col_key = (len(key_exprs) == 1 and isinstance(key_exprs[0], Column))
-        key_col = key_exprs[0].name if single_col_key else "__key"
         key_fns = [compiler.compile(k) for k in key_exprs]
         arg_fns = [(s.out_name + "_in", compiler.compile(s.arg))
                    for s in agg_specs if s.arg is not None]
@@ -682,7 +877,6 @@ class Planner:
             t = stream._then("sql-mini-batch",
                              lambda: MiniBatchOperator(mbr),
                              chainable=False)
-            from flink_tpu.datastream.api import DataStream
             stream = DataStream(stream.env, t)
         keyed = stream.key_by(key_col)
 
@@ -697,7 +891,6 @@ class Planner:
         needed = sorted({c for c, _ in agg_map.values()})
         select_values = lambda c, _need=tuple(needed): {k: c[k] for k in _need}  # noqa: E731
 
-        emit_bounds = window is not None
         if window is None:
             assigner = GlobalWindows()
             assigner.is_event_time = False  # fire only at end-of-input
@@ -710,22 +903,60 @@ class Planner:
                     trigger=EventTimeTrigger(), emit_window_bounds=False,
                     name="sql-group-agg")
             t = keyed._then("sql-group-agg", factory)
-            from flink_tpu.datastream.api import DataStream
-            agg_stream = DataStream(keyed.env, t)
-        elif window.kind == "SESSION":
-            agg_stream = keyed.window(
+            return DataStream(keyed.env, t)
+        if window.kind == "SESSION":
+            return keyed.window(
                 EventTimeSessionWindows(window.size_ms)).aggregate(
                     tuple_agg, value_selector=select_values,
                     name="sql-session-agg")
+        if window.kind == "TUMBLE":
+            assigner = TumblingEventTimeWindows.of(window.size_ms)
         else:
-            if window.kind == "TUMBLE":
-                assigner = TumblingEventTimeWindows.of(window.size_ms)
-            else:
-                assigner = SlidingEventTimeWindows.of(window.size_ms,
-                                                      window.slide_ms)
-            agg_stream = keyed.window(assigner).aggregate(
-                tuple_agg, value_selector=select_values, name="sql-window-agg")
+            assigner = SlidingEventTimeWindows.of(window.size_ms,
+                                                  window.slide_ms)
+        return keyed.window(assigner).aggregate(
+            tuple_agg, value_selector=select_values, name="sql-window-agg")
 
+    def _merge_branches(self, a, b, key_col: str, emit_bounds: bool,
+                        extra: List[str]):
+        """Re-join the fired rows of two aggregate branches on the merge key
+        (group key [+ window bounds]); ``extra`` = columns only branch b
+        contributes."""
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.graph.transformations import (Partitioning,
+                                                     Transformation)
+        from flink_tpu.operators.sql_ops import BranchMergeOperator
+
+        def add_merge_key(cols, _kc=key_col, _b=emit_bounds):
+            n = _n(cols)
+            out = dict(cols)
+            parts = [np.asarray(cols[_kc])]
+            if _b:
+                parts += [np.asarray(cols["window_start"]),
+                          np.asarray(cols["window_end"])]
+            out["__merge"] = np.fromiter(
+                (tuple(row) for row in zip(*(p.tolist() for p in parts))),
+                object, count=n)
+            return out
+
+        a = a.map(add_merge_key, name="sql-merge-key")
+        b = b.map(add_merge_key, name="sql-merge-key")
+        t = Transformation(
+            name="sql-branch-merge",
+            operator_factory=(lambda _x=tuple(extra):
+                              BranchMergeOperator("__merge", list(_x))),
+            inputs=[a.transformation, b.transformation],
+            input_partitionings=[Partitioning.HASH, Partitioning.HASH],
+            input_key_columns=["__merge", "__merge"],
+            parallelism=self.env.parallelism, chainable=False,
+            max_parallelism=self.env.max_parallelism)
+        return DataStream(a.env, t)
+
+    def _post_aggregate(self, agg_stream, items, having,
+                        agg_specs: List[AggSpec], key_exprs: List[Expr],
+                        single_col_key: bool, key_col: str,
+                        emit_bounds: bool, stmt: SelectStmt,
+                        orig_items: Optional[List[SelectItem]]) -> QueryPlan:
         # ---- split composite key back into its columns
         if not single_col_key and len(key_exprs) > 1:
             key_out_names = [f"__k{i}" for i in range(len(key_exprs))]

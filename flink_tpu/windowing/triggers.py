@@ -82,17 +82,26 @@ class ProcessingTimeTrigger(Trigger):
 
 class CountTrigger(Trigger):
     """FIRE when a key's window holds >= n elements (``CountTrigger.java``);
-    evaluated after each micro-batch against the device count state."""
+    evaluated after each micro-batch against the device count state.
+
+    ``purge=True`` (default) is the ``countWindow`` behavior
+    (``PurgingTrigger(CountTrigger)``): fired state clears, the next fire
+    needs n fresh elements.  ``purge=False`` is the reference's raw
+    ``CountTrigger``: FIRE only — the window keeps accumulating and fires
+    again every n elements with the full running contents.  Sliding
+    (multi-pane) assigners support only ``purge=False``, because
+    overlapping windows share pane state."""
 
     fires_on_time = False
     fires_on_count = True
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, purge: bool = True):
         self.count_threshold = int(n)
+        self.purges_on_fire = bool(purge)
 
     @staticmethod
-    def of(n: int) -> "CountTrigger":
-        return CountTrigger(n)
+    def of(n: int, purge: bool = True) -> "CountTrigger":
+        return CountTrigger(n, purge)
 
 
 class PurgingTrigger(Trigger):

@@ -211,9 +211,11 @@ def test_plain_derived_table(tenv):
         [(1, 30.0), (2, 70.0), (3, 40.0), (9, 60.0)]
 
 
-def test_over_outside_subquery_rejected(tenv):
+def test_over_needs_time_attribute(tenv):
+    # top-level OVER is supported, but only ordered by a rowtime — "orders"
+    # has no time attribute, so the planner must reject the order column
     from flink_tpu.sql.planner import PlanError
-    with pytest.raises(PlanError, match="Top-N shape"):
+    with pytest.raises(PlanError, match="time attribute"):
         tenv.execute_sql(
             "SELECT ROW_NUMBER() OVER (ORDER BY amount) FROM orders").collect()
 
@@ -256,11 +258,47 @@ def test_sum_distinct_dedups_values():
     assert rows[0]["n"] == 2
 
 
-def test_mixed_distinct_plain_rejected(tenv):
-    from flink_tpu.sql.planner import PlanError
-    with pytest.raises(PlanError, match="mixing DISTINCT"):
-        tenv.execute_sql("SELECT COUNT(DISTINCT cust), SUM(amount) "
-                         "FROM orders").collect()
+def test_mixed_distinct_plain_aggregates(tenv):
+    # one query, both kinds: planned as two branches re-merged on the key
+    rows = tenv.execute_sql(
+        "SELECT cust, COUNT(DISTINCT amount) AS d, SUM(amount) AS s, "
+        "COUNT(*) AS n FROM orders GROUP BY cust ORDER BY cust").collect()
+    assert [(r["cust"], r["d"], r["s"], r["n"]) for r in rows] == \
+        [(1, 2, 40.0, 2), (2, 2, 70.0, 2), (3, 1, 40.0, 1), (9, 1, 60.0, 1)]
+
+
+def test_mixed_distinct_plain_global(tenv):
+    rows = tenv.execute_sql(
+        "SELECT COUNT(DISTINCT cust) AS d, SUM(amount) AS s "
+        "FROM orders").collect()
+    assert (rows[0]["d"], rows[0]["s"]) == (4, 210.0)
+
+
+def test_distinct_in_tumble_window():
+    te = TableEnvironment()
+    te.register_collection("e", columns={
+        "k": np.array([1, 1, 1, 1, 2], np.int64),
+        "ts": np.array([1000, 2000, 6000, 7000, 1500], np.int64),
+        "v": np.array([5., 5., 5., 7., 5.])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT k, COUNT(DISTINCT v) AS d FROM e "
+        "GROUP BY k, TUMBLE(ts, INTERVAL '5' SECOND) ORDER BY k").collect()
+    # key 1: window [0,5s) has {5} -> 1; window [5s,10s) has {5,7} -> 2;
+    # the 5.0 recurring in the SECOND window must still count there
+    assert sorted((r["k"], r["d"]) for r in rows) == [(1, 1), (1, 2), (2, 1)]
+
+
+def test_mixed_distinct_plain_in_tumble_window():
+    te = TableEnvironment()
+    te.register_collection("e", columns={
+        "k": np.array([1, 1, 1, 1], np.int64),
+        "ts": np.array([1000, 2000, 6000, 7000], np.int64),
+        "v": np.array([5., 5., 5., 7.])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT k, COUNT(DISTINCT v) AS d, SUM(v) AS s, "
+        "TUMBLE_START(ts, INTERVAL '5' SECOND) AS ws FROM e "
+        "GROUP BY k, TUMBLE(ts, INTERVAL '5' SECOND) ORDER BY ws").collect()
+    assert [(r["d"], r["s"]) for r in rows] == [(1, 10.0), (2, 12.0)]
 
 
 def test_count_distinct_parallel_cluster():

@@ -1,0 +1,238 @@
+"""OVER aggregations (StreamExecOverAggregate analog): unbounded running
+aggregates, ROWS / RANGE bounded frames, peer semantics, ROW_NUMBER.
+
+Reference: flink-table-planner-blink
+``plan/nodes/exec/stream/StreamExecOverAggregate.java`` with runtime
+``RowTime{Range,Rows}{Unbounded,Bounded}PrecedingFunction``.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.sql.planner import PlanError
+from flink_tpu.sql.table_env import TableEnvironment
+
+
+def make_env():
+    te = TableEnvironment()
+    te.register_collection("t", columns={
+        "k": np.array([1, 1, 1, 2, 2, 1], np.int64),
+        "ts": np.array([1000, 2000, 3000, 1000, 4000, 5000], np.int64),
+        "v": np.array([10., 20., 30., 5., 7., 40.])},
+        rowtime="ts")
+    return te
+
+
+def by_key(rows, k):
+    return sorted((r for r in rows if r["k"] == k), key=lambda r: r["ts"])
+
+
+def test_over_unbounded_running_sum():
+    rows = make_env().execute_sql(
+        "SELECT k, ts, v, SUM(v) OVER (PARTITION BY k ORDER BY ts) AS rs "
+        "FROM t").collect()
+    assert [r["rs"] for r in by_key(rows, 1)] == [10., 30., 60., 100.]
+    assert [r["rs"] for r in by_key(rows, 2)] == [5., 12.]
+
+
+def test_over_multiple_aggs_share_window():
+    rows = make_env().execute_sql(
+        "SELECT k, ts, COUNT(*) OVER (PARTITION BY k ORDER BY ts) AS c, "
+        "AVG(v) OVER (PARTITION BY k ORDER BY ts) AS a, "
+        "MAX(v) OVER (PARTITION BY k ORDER BY ts) AS mx, "
+        "MIN(v) OVER (PARTITION BY k ORDER BY ts) AS mn FROM t").collect()
+    k1 = by_key(rows, 1)
+    assert [r["c"] for r in k1] == [1, 2, 3, 4]
+    assert [r["a"] for r in k1] == [10., 15., 20., 25.]
+    assert [r["mx"] for r in k1] == [10., 20., 30., 40.]
+    assert [r["mn"] for r in k1] == [10., 10., 10., 10.]
+
+
+def test_over_rows_frame():
+    rows = make_env().execute_sql(
+        "SELECT k, ts, SUM(v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM t").collect()
+    assert [r["s"] for r in by_key(rows, 1)] == [10., 30., 50., 70.]
+    assert [r["s"] for r in by_key(rows, 2)] == [5., 12.]
+
+
+def test_over_rows_frame_min_count():
+    rows = make_env().execute_sql(
+        "SELECT k, ts, MIN(v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS mn, "
+        "COUNT(*) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS c FROM t").collect()
+    k1 = by_key(rows, 1)
+    assert [r["mn"] for r in k1] == [10., 10., 10., 20.]
+    assert [r["c"] for r in k1] == [1, 2, 3, 3]
+
+
+def test_over_range_frame():
+    # 2-second range: at ts=3000 the frame is [1000,3000]; at ts=5000 it is
+    # [3000,5000] (only ts=3000 and ts=5000 rows for key 1)
+    rows = make_env().execute_sql(
+        "SELECT k, ts, SUM(v) OVER (PARTITION BY k ORDER BY ts RANGE BETWEEN "
+        "INTERVAL '2' SECOND PRECEDING AND CURRENT ROW) AS s FROM t").collect()
+    assert [r["s"] for r in by_key(rows, 1)] == [10., 30., 60., 70.]
+    assert [r["s"] for r in by_key(rows, 2)] == [5., 7.]
+
+
+def test_over_range_unbounded_peers_share():
+    te = TableEnvironment()
+    te.register_collection("p", columns={
+        "k": np.array([1, 1, 1], np.int64),
+        "ts": np.array([1000, 1000, 2000], np.int64),
+        "v": np.array([3., 4., 5.])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT k, ts, SUM(v) OVER (PARTITION BY k ORDER BY ts) AS s "
+        "FROM p").collect()
+    # default frame = RANGE UNBOUNDED: the two ts=1000 peers both see 7
+    assert sorted(r["s"] for r in rows if r["ts"] == 1000) == [7., 7.]
+    assert [r["s"] for r in rows if r["ts"] == 2000] == [12.]
+
+
+def test_over_rows_unbounded_no_peer_sharing():
+    te = TableEnvironment()
+    te.register_collection("p", columns={
+        "k": np.array([1, 1, 1], np.int64),
+        "ts": np.array([1000, 1000, 2000], np.int64),
+        "v": np.array([3., 4., 5.])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT k, ts, SUM(v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS s "
+        "FROM p").collect()
+    assert sorted(r["s"] for r in rows if r["ts"] == 1000) == [3., 7.]
+    assert [r["s"] for r in rows if r["ts"] == 2000] == [12.]
+
+
+def test_over_row_number_plain():
+    rows = make_env().execute_sql(
+        "SELECT k, ts, ROW_NUMBER() OVER (PARTITION BY k ORDER BY ts) AS rn "
+        "FROM t").collect()
+    assert [r["rn"] for r in by_key(rows, 1)] == [1, 2, 3, 4]
+    assert [r["rn"] for r in by_key(rows, 2)] == [1, 2]
+
+
+def test_over_global_partition():
+    rows = make_env().execute_sql(
+        "SELECT ts, COUNT(*) OVER (ORDER BY ts) AS c FROM t").collect()
+    assert max(r["c"] for r in rows) == 6
+
+
+def test_over_in_expression_and_where():
+    rows = make_env().execute_sql(
+        "SELECT k, ts, SUM(v) OVER (PARTITION BY k ORDER BY ts) * 2 AS d "
+        "FROM t WHERE v > 5").collect()
+    assert [r["d"] for r in by_key(rows, 1)] == [20., 60., 120., 200.]
+    assert [r["d"] for r in by_key(rows, 2)] == [14.]  # v=5 filtered out
+
+
+def test_over_errors():
+    te = make_env()
+    with pytest.raises(PlanError, match="ORDER BY"):
+        te.execute_sql("SELECT SUM(v) OVER (PARTITION BY k) FROM t").collect()
+    with pytest.raises(PlanError, match="share"):
+        te.execute_sql(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts) AS a, "
+            "SUM(v) OVER (ORDER BY ts) AS b FROM t").collect()
+    with pytest.raises(PlanError, match="GROUP BY"):
+        te.execute_sql(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts), SUM(v) "
+            "FROM t GROUP BY k").collect()
+    with pytest.raises(PlanError, match="rowtime"):
+        te.execute_sql(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY v) FROM t").collect()
+
+
+def test_over_snapshot_restore_roundtrip():
+    from flink_tpu.operators.sql_ops import (OverAggregateOperator,
+                                             OverAggSpec)
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    def mk(keys, ts, vals):
+        return RecordBatch({"k": np.asarray(keys, np.int64),
+                            "v": np.asarray(vals, np.float64)},
+                           timestamps=np.asarray(ts, np.int64))
+
+    specs = [OverAggSpec("s", "SUM", "v"),
+             OverAggSpec("r2", "SUM", "v", rows=1)]
+    op = OverAggregateOperator(specs, "k")
+    op.process_batch(mk([1, 1], [1000, 2000], [1., 2.]))
+    out1 = op.process_watermark(Watermark(2000))
+    snap = op.snapshot_state()
+
+    op2 = OverAggregateOperator(specs, "k")
+    op2.restore_state(snap)
+    op2.process_batch(mk([1], [3000], [4.]))
+    out2 = op2.process_watermark(Watermark(3000))
+    got = np.concatenate([np.asarray(b.columns["s"]) for b in out1 + out2])
+    assert got.tolist() == [1., 3., 7.]
+    got2 = np.concatenate([np.asarray(b.columns["r2"]) for b in out1 + out2])
+    assert got2.tolist() == [1., 3., 6.]
+
+
+def test_over_late_rows_dropped():
+    from flink_tpu.operators.sql_ops import (OverAggregateOperator,
+                                             OverAggSpec)
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    op = OverAggregateOperator([OverAggSpec("s", "SUM", "v")], None)
+    b = RecordBatch({"v": np.array([1.])},
+                    timestamps=np.array([1000], np.int64))
+    op.process_batch(b)
+    op.process_watermark(Watermark(2000))
+    late = RecordBatch({"v": np.array([9.])},
+                       timestamps=np.array([1500], np.int64))
+    assert op.process_batch(late) == []
+    assert op._dropped_late == 1
+
+
+def test_over_distinct_rejected():
+    te = make_env()
+    with pytest.raises(PlanError, match="DISTINCT"):
+        te.execute_sql(
+            "SELECT SUM(DISTINCT v) OVER (PARTITION BY k ORDER BY ts) "
+            "FROM t").collect()
+
+
+def test_frame_words_stay_usable_as_columns():
+    # ROWS/RANGE/PRECEDING/... are contextual, not reserved: a table with
+    # such column names keeps working
+    te = TableEnvironment()
+    te.register_collection("t", columns={
+        "row": np.array([1, 2], np.int64),
+        "range": np.array([10., 20.]),
+        "current": np.array([5., 6.])})
+    rows = te.execute_sql(
+        "SELECT row, range, current FROM t ORDER BY row").collect()
+    assert [(r["row"], r["range"], r["current"]) for r in rows] == \
+        [(1, 10.0, 5.0), (2, 20.0, 6.0)]
+
+
+def test_branch_merge_snapshot_restore():
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.operators.sql_ops import BranchMergeOperator
+
+    def mk_batch(keys, vals, extra=None):
+        karr = np.empty(len(keys), object)
+        karr[:] = [tuple([k]) for k in keys]
+        cols = {"__merge": karr, "k": np.asarray(keys, np.int64)}
+        if extra is not None:
+            cols["d"] = np.asarray(extra)
+        else:
+            cols["s"] = np.asarray(vals)
+        return RecordBatch(cols)
+
+    op = BranchMergeOperator("__merge", ["d"])
+    # left fires keys 1,2; right fires key 1 only -> key 2 stays pending
+    assert op.process_batch2(mk_batch([1, 2], [10., 20.]), 0) == []
+    out = op.process_batch2(mk_batch([1], None, extra=[7.]), 1)
+    merged = [r for b in out for r in b.to_rows()]
+    assert len(merged) == 1 and merged[0]["s"] == 10.0 and merged[0]["d"] == 7.0
+
+    snap = op.snapshot_state()
+    op2 = BranchMergeOperator("__merge", ["d"])
+    op2.restore_state(snap)
+    out = op2.process_batch2(mk_batch([2], None, extra=[9.]), 1)
+    merged = [r for b in out for r in b.to_rows()]
+    assert len(merged) == 1 and merged[0]["s"] == 20.0 and merged[0]["d"] == 9.0

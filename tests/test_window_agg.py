@@ -383,3 +383,187 @@ def test_count_trigger_over_tumbling_windows():
         timestamps=np.array([50, 60, 70])))
     rows = [r for b in out for r in b.to_rows()]
     assert len(rows) == 1 and rows[0]["result"] == 3.0
+
+
+def test_count_trigger_over_sliding_windows():
+    """Non-purging CountTrigger over a SLIDING assigner: each overlapping
+    (key, window) fires independently when n elements have arrived since its
+    last fire; pane state is shared and never purged."""
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    # size 2000 / slide 1000 -> 2 panes per window
+    op = WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
+                           SumAggregator(jnp.float32), key_column="k",
+                           value_column="v",
+                           trigger=CountTrigger.of(2, purge=False))
+    op.open(RuntimeContext())
+    # two elements at t=1100,1200: panes -> both covered by windows
+    # [0,2000) and [1000,3000) -> both windows hit count 2 and fire
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([7, 7]), "v": np.array([1., 2.])},
+        timestamps=np.array([1100, 1200])))
+    rows = [r for b in out for r in b.to_rows()]
+    assert sorted((r["window_start"], r["result"]) for r in rows) == \
+        [(0, 3.0), (1000, 3.0)]
+    # one more element in the same panes: count 3 < 2+2 -> no fire yet
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([7])}, timestamps=np.array([1300])).with_columns(
+            {"k": np.array([7]), "v": np.array([10.])}))
+    assert [r for b in out for r in b.to_rows()] == []
+    # a fourth element: both windows fire again with the FULL running sum
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([7])}, timestamps=np.array([1400])).with_columns(
+            {"k": np.array([7]), "v": np.array([20.])}))
+    rows = [r for b in out for r in b.to_rows()]
+    assert sorted((r["window_start"], r["result"]) for r in rows) == \
+        [(0, 33.0), (1000, 33.0)]
+
+
+def test_count_trigger_sliding_window_isolation():
+    """An element in a NON-shared pane advances only its own windows."""
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    op = WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
+                           SumAggregator(jnp.float32), key_column="k",
+                           value_column="v",
+                           trigger=CountTrigger.of(2, purge=False))
+    op.open(RuntimeContext())
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1, 1]), "v": np.array([1., 2.])},
+        timestamps=np.array([100, 2100])))
+    # pane 0 (win -1, 0) and pane 2 (win 1, 2); only window [1000,3000)?
+    # windows: [0,2000) has 1 elem, [1000,3000) has 1, [-1000,1000) has 1,
+    # [2000,4000) has 1 -> nothing reaches 2
+    assert [r for b in out for r in b.to_rows()] == []
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1])}, timestamps=np.array([1100])).with_columns(
+            {"k": np.array([1]), "v": np.array([10.])}))
+    rows = [r for b in out for r in b.to_rows()]
+    # t=1100 joins [0,2000) (now 1+10) and [1000,3000) (now 2+10)
+    assert sorted((r["window_start"], r["result"]) for r in rows) == \
+        [(0, 11.0), (1000, 12.0)]
+
+
+def test_count_trigger_purging_sliding_rejected():
+    import jax.numpy as jnp
+
+    from flink_tpu.core.functions import SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    with pytest.raises(NotImplementedError, match="PURGING"):
+        WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
+                          SumAggregator(jnp.float32), key_column="k",
+                          value_column="v", trigger=CountTrigger.of(2))
+
+
+def test_count_trigger_nonpurging_tumbling_running_total():
+    """purge=False over tumbling windows: fires every n elements with the
+    running window total (the reference's raw CountTrigger semantics)."""
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    op = WindowAggOperator(TumblingEventTimeWindows.of(10_000),
+                           SumAggregator(jnp.float32), key_column="k",
+                           value_column="v",
+                           trigger=CountTrigger.of(2, purge=False))
+    op.open(RuntimeContext())
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1, 1]), "v": np.array([1., 2.])},
+        timestamps=np.array([10, 20])))
+    rows = [r for b in out for r in b.to_rows()]
+    assert [(r["k"], r["result"]) for r in rows] == [(1, 3.0)]
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1, 1]), "v": np.array([3., 4.])},
+        timestamps=np.array([30, 40])))
+    rows = [r for b in out for r in b.to_rows()]
+    # running total, not purged: 1+2+3+4
+    assert [(r["k"], r["result"]) for r in rows] == [(1, 10.0)]
+
+
+def test_count_trigger_nonpurging_global_windows():
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import GlobalWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    op = WindowAggOperator(GlobalWindows.create(), SumAggregator(jnp.float32),
+                           key_column="k", value_column="v",
+                           trigger=CountTrigger.of(2, purge=False),
+                           emit_window_bounds=False)
+    op.open(RuntimeContext())
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1, 1]), "v": np.array([1., 2.])},
+        timestamps=np.array([0, 0])))
+    rows = [r for b in out for r in b.to_rows()]
+    assert [(r["k"], r["result"]) for r in rows] == [(1, 3.0)]
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1])}, timestamps=np.array([0])).with_columns(
+            {"k": np.array([1]), "v": np.array([5.])}))
+    assert [r for b in out for r in b.to_rows()] == []  # only 1 new element
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1])}, timestamps=np.array([0])).with_columns(
+            {"k": np.array([1]), "v": np.array([7.])}))
+    rows = [r for b in out for r in b.to_rows()]
+    assert [(r["k"], r["result"]) for r in rows] == [(1, 15.0)]
+
+
+def test_count_trigger_sliding_snapshot_restore():
+    """Baselines ride snapshots: a restored operator does not re-fire
+    windows that already fired."""
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    def mk():
+        op = WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
+                               SumAggregator(jnp.float32), key_column="k",
+                               value_column="v",
+                               trigger=CountTrigger.of(2, purge=False))
+        op.open(RuntimeContext())
+        return op
+
+    op = mk()
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([7, 7]), "v": np.array([1., 2.])},
+        timestamps=np.array([1100, 1200])))
+    assert len([r for b in out for r in b.to_rows()]) == 2
+    snap = op.snapshot_state()
+
+    op2 = mk()
+    op2.restore_state(snap)
+    out = op2.process_batch(RecordBatch(
+        {"k": np.array([7])}, timestamps=np.array([1300])).with_columns(
+            {"k": np.array([7]), "v": np.array([10.])}))
+    assert [r for b in out for r in b.to_rows()] == []  # baseline restored
+    out = op2.process_batch(RecordBatch(
+        {"k": np.array([7])}, timestamps=np.array([1400])).with_columns(
+            {"k": np.array([7]), "v": np.array([20.])}))
+    rows = [r for b in out for r in b.to_rows()]
+    assert sorted((r["window_start"], r["result"]) for r in rows) == \
+        [(0, 33.0), (1000, 33.0)]
