@@ -118,6 +118,14 @@ def _cmd_info(_args) -> int:
     return 0
 
 
+def _cmd_worker(args) -> int:
+    from flink_tpu.cluster.distributed import _WorkerRuntime
+
+    host, port = args.coordinator.rsplit(":", 1)
+    return _WorkerRuntime(args.index, args.workers, args.job,
+                          host, int(port)).run()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="flink_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -135,6 +143,14 @@ def main(argv=None) -> int:
     ps.set_defaults(fn=_cmd_sql)
     pi = sub.add_parser("info", help="environment info")
     pi.set_defaults(fn=_cmd_info)
+    pw = sub.add_parser(
+        "worker", help="TaskExecutor worker process (spawned by "
+        "cluster.distributed.ProcessCluster)")
+    pw.add_argument("--index", type=int, required=True)
+    pw.add_argument("--workers", type=int, required=True)
+    pw.add_argument("--job", required=True)
+    pw.add_argument("--coordinator", required=True)
+    pw.set_defaults(fn=_cmd_worker)
     for name, needs_job in (("list", False), ("status", True),
                             ("cancel", True), ("savepoint", True)):
         pc = sub.add_parser(name, help=f"{name} jobs via the REST endpoint")

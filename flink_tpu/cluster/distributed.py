@@ -1,0 +1,532 @@
+"""Cross-process cluster: coordinator + TaskExecutor worker processes.
+
+The multi-process analog of the reference's Dispatcher/JobMaster ↔
+TaskExecutor deployment (``Execution.deploy`` →
+``TaskExecutor.submitTask:554`` over RPC): a :class:`ProcessCluster`
+coordinator spawns N worker processes, each hosting a deterministic slice of
+the job's subtasks.  Data-plane edges whose endpoints live in different
+processes ride the TCP credit-controlled channels of ``cluster/net.py`` (the
+Netty-shuffle analog); same-process edges stay in-memory ``LocalChannel``s —
+exactly the reference's local-vs-remote input channel split
+(``LocalInputChannel`` / ``RemoteInputChannel``).
+
+**Job shipping** follows the jar model (BLOB service analog): the job is a
+``module:function`` reference returning a ``StreamExecutionEnvironment`` (or
+``ExecutionPlan``); every process imports it and rebuilds the SAME plan, then
+instantiates only its assigned subtasks.  This requires the builder to be
+deterministic (source split creation included) — the same property a
+reference job jar must have for task deployment to be consistent.
+
+**Control plane** is a length-prefixed pickle protocol over one TCP
+connection per worker (the Akka RPC analog, single coordinator thread per
+worker connection):
+
+  worker → coordinator: ``hello`` (data-plane address), ``state`` (task
+  transitions), ``ack`` (checkpoint snapshots), ``final`` (FLIP-147 final
+  snapshots of finished tasks), ``rows`` (collect-sink results),
+  ``worker_done``
+  coordinator → worker: ``deploy`` (address map + restore), ``checkpoint``
+  (source barrier injection, ``CheckpointCoordinator.triggerCheckpoint``
+  analog), ``notify`` (checkpoint complete), ``stop``
+
+Checkpoints run the same protocol as the in-process MiniCluster: the
+coordinator triggers sources, barriers flow in-band through local AND remote
+channels, every subtask acks with its snapshot, and the coordinator
+assembles + stores the completed checkpoint (restorable at a different
+worker count — the assignment is re-computed, state is per-subtask).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_LEN = struct.Struct("<I")
+
+
+def _send_msg(sock: socket.socket, obj: Any, lock: threading.Lock) -> None:
+    data = pickle.dumps(obj)
+    with lock:
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[Any]:
+    buf = b""
+    while len(buf) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    (n,) = _LEN.unpack(buf)
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(min(1 << 20, n - len(data)))
+        if not chunk:
+            return None
+        data += chunk
+    return pickle.loads(data)
+
+
+def build_plan(job: str):
+    """``module:function`` → ExecutionPlan (the jar-main analog)."""
+    mod_name, fn_name = job.rsplit(":", 1)
+    obj = getattr(importlib.import_module(mod_name), fn_name)()
+    if hasattr(obj, "to_plan"):
+        return obj.to_plan()
+    if hasattr(obj, "get_stream_graph"):
+        return obj.get_stream_graph(job).to_plan()
+    return obj  # already an ExecutionPlan
+
+
+def subtask_counts_of(plan) -> Tuple[Dict[str, int], Dict[int, list]]:
+    """Subtask count per vertex (sources: one per split, like the
+    MiniCluster) and the split lists themselves."""
+    counts: Dict[str, int] = {}
+    splits_by_vertex: Dict[int, list] = {}
+    for v in plan.vertices:
+        if v.is_source:
+            splits = v.chain[0].source.create_splits(v.parallelism)
+            splits_by_vertex[v.id] = splits
+            counts[v.uid] = max(1, len(splits))
+        else:
+            counts[v.uid] = v.parallelism
+    return counts, splits_by_vertex
+
+
+def assign_subtasks(plan, counts: Dict[str, int],
+                    n_workers: int) -> Dict[Tuple[str, int], int]:
+    """Deterministic subtask → worker placement (round-robin over the
+    plan's vertex order — the declarative SlotManager's match, made a pure
+    function of (plan, n) so every process computes it identically)."""
+    out: Dict[Tuple[str, int], int] = {}
+    i = 0
+    for v in plan.vertices:
+        for s in range(counts[v.uid]):
+            out[(v.uid, s)] = i % n_workers
+            i += 1
+    return out
+
+
+def _edge_pairs(part: str, np_: int, nc: int):
+    """(producer, consumer, effective_partitioning) tuples for one edge —
+    the same channel topology the MiniCluster builds."""
+    if part == "forward" and np_ == nc:
+        return [(pi, pi) for pi in range(np_)], "forward"
+    eff = "rebalance" if (part == "forward" and nc > 1) else part
+    return [(pi, ci) for pi in range(np_) for ci in range(nc)], eff
+
+
+# --------------------------------------------------------------------------
+# worker process
+# --------------------------------------------------------------------------
+
+class _WorkerRuntime:
+    """TaskListener inside a worker: deploys the local subtask slice and
+    relays task events to the coordinator."""
+
+    def __init__(self, index: int, n_workers: int, job: str,
+                 coord_host: str, coord_port: int):
+        from flink_tpu.cluster.net import ChannelServer
+
+        self.index = index
+        self.n_workers = n_workers
+        self.job = job
+        self.server = ChannelServer()
+        self.sock = socket.create_connection((coord_host, coord_port),
+                                             timeout=30)
+        # the connect timeout must not linger: the worker blocks on this
+        # socket indefinitely waiting for deploy/stop (sibling workers can
+        # take arbitrarily long to cold-start before the coordinator
+        # broadcasts deploy)
+        self.sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self.tasks: List[Any] = []
+        self._terminal = set()
+        self._done_sent = False
+        self._remote_writers: List[Any] = []
+
+    def _send(self, obj: Any) -> None:
+        try:
+            _send_msg(self.sock, obj, self._send_lock)
+        except OSError:
+            pass
+
+    # -- TaskListener ------------------------------------------------------
+    def task_state_changed(self, vertex_uid: str, subtask_index: int,
+                           state: str, error: Optional[str]) -> None:
+        self._send(("state", vertex_uid, subtask_index, state, error))
+        if state == "FINISHED":
+            t = next((t for t in self.tasks
+                      if t.vertex_uid == vertex_uid
+                      and t.subtask_index == subtask_index), None)
+            final = getattr(t, "final_snapshot", None) if t else None
+            if final is not None:
+                self._send(("final", vertex_uid, subtask_index, final))
+        if state in ("FINISHED", "CANCELED", "FAILED"):
+            with self._lock:
+                self._terminal.add((vertex_uid, subtask_index))
+                done = (len(self._terminal) >= len(self.tasks)
+                        and not self._done_sent)
+                if done:
+                    self._done_sent = True
+            if done:
+                self._collect_and_finish()
+
+    def acknowledge_checkpoint(self, checkpoint_id: int, vertex_uid: str,
+                               subtask_index: int,
+                               snapshot: Dict[str, Any]) -> None:
+        self._send(("ack", checkpoint_id, vertex_uid, subtask_index,
+                    snapshot))
+
+    # -- results -----------------------------------------------------------
+    def _collect_and_finish(self) -> None:
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.operators.basic import SinkOperator
+
+        for t in self.tasks:
+            ops = getattr(t.operator, "operators", [t.operator])
+            for op in ops:
+                sink = getattr(op, "sink", None)
+                if isinstance(op, SinkOperator) and isinstance(sink,
+                                                               CollectSink):
+                    self._send(("rows", t.vertex_uid, t.subtask_index,
+                                sink.rows()))
+        self._send(("worker_done", self.index))
+
+    # -- deploy ------------------------------------------------------------
+    def deploy(self, addresses: Dict[int, Tuple[str, int]],
+               restore: Optional[Dict[str, Any]]) -> None:
+        from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
+        from flink_tpu.cluster.net import RemoteChannel
+        from flink_tpu.cluster.task import SourceSubtask, Subtask
+        from flink_tpu.core.functions import RuntimeContext
+
+        plan = build_plan(self.job)
+        counts, splits_by_vertex = subtask_counts_of(plan)
+        assign = assign_subtasks(plan, counts, self.n_workers)
+        me = self.index
+
+        def n_subs(v) -> int:
+            return counts[v.uid]
+
+        inputs: Dict[int, List[List[Any]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+        input_logical: Dict[int, List[List[int]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+        outputs: Dict[int, List[List[OutputDispatcher]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+
+        for v in plan.vertices:
+            for ei, e in enumerate(v.out_edges):
+                tgt = plan.by_id[e.target_id]
+                np_, nc = n_subs(v), n_subs(tgt)
+                pairs, eff = _edge_pairs(e.partitioning, np_, nc)
+                # group channels per producer (dispatcher wants ci order)
+                per_producer: Dict[int, List[Any]] = {}
+                for pi, ci in pairs:
+                    p_local = assign[(v.uid, pi)] == me
+                    c_local = assign[(tgt.uid, ci)] == me
+                    chan_id = f"{v.uid}[{pi}]->{tgt.uid}[{ci}]#{ei}"
+                    ch = None
+                    if p_local and c_local:
+                        ch = LocalChannel(name=chan_id)
+                        inputs[tgt.id][ci].append(ch)
+                        input_logical[tgt.id][ci].append(e.input_index)
+                    elif p_local:
+                        host, port = addresses[assign[(tgt.uid, ci)]]
+                        ch = RemoteChannel(host, port, chan_id)
+                        self._remote_writers.append(ch)
+                    elif c_local:
+                        q = self.server.channel(chan_id)
+                        inputs[tgt.id][ci].append(q)
+                        input_logical[tgt.id][ci].append(e.input_index)
+                    if p_local:
+                        per_producer.setdefault(pi, []).append(ch)
+                for pi, chans in per_producer.items():
+                    outputs[v.id][pi].append(OutputDispatcher(
+                        eff, chans, max_parallelism=v.max_parallelism,
+                        subtask_index=pi, key_column=e.key_column))
+
+        # build EVERY local task first, then start: a fast task finishing
+        # while deploy is mid-flight must not trip the all-terminal check
+        # against a partial task list
+        restore = restore or {}
+        to_start: List[Tuple[Any, Optional[Dict[str, Any]]]] = []
+        for v in plan.vertices:
+            vr = restore.get(v.uid, {})
+            sub_snaps = vr.get("subtasks", [])
+            if v.is_source:
+                splits = splits_by_vertex[v.id]
+                for i, split in enumerate(splits):
+                    if assign[(v.uid, i)] != me:
+                        continue
+                    ctx = RuntimeContext(task_name=v.name, subtask_index=i,
+                                         parallelism=len(splits),
+                                         max_parallelism=v.max_parallelism)
+                    t = SourceSubtask(v.uid, i, v.build_operator(),
+                                      outputs[v.id][i], ctx, self, split)
+                    to_start.append(
+                        (t, sub_snaps[i] if i < len(sub_snaps) else None))
+            else:
+                for i in range(n_subs(v)):
+                    if assign[(v.uid, i)] != me:
+                        continue
+                    ctx = RuntimeContext(task_name=v.name, subtask_index=i,
+                                         parallelism=n_subs(v),
+                                         max_parallelism=v.max_parallelism)
+                    t = Subtask(v.uid, i, v.build_operator(),
+                                outputs[v.id][i], ctx, self,
+                                inputs[v.id][i],
+                                input_logical=input_logical[v.id][i])
+                    to_start.append(
+                        (t, sub_snaps[i] if i < len(sub_snaps) else None))
+        self.tasks = [t for t, _ in to_start]
+        for t, snap in to_start:
+            t.start(snap)
+        if not self.tasks:
+            self._done_sent = True
+            self._send(("worker_done", self.index))
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> int:
+        self._send(("hello", self.index, self.server.host, self.server.port))
+        while True:
+            msg = _recv_msg(self.sock)
+            if msg is None:
+                break
+            kind = msg[0]
+            if kind == "deploy":
+                self.deploy(msg[1], msg[2])
+            elif kind == "checkpoint":
+                cid = msg[1]
+                for t in self.tasks:
+                    if hasattr(t, "split"):  # source: inject barrier
+                        t.commands.put(("checkpoint", cid))
+            elif kind == "notify":
+                for t in self.tasks:
+                    t.commands.put(("notify_complete", msg[1]))
+            elif kind == "cancel":
+                for t in self.tasks:
+                    t.cancel()
+            elif kind == "stop":
+                break
+        for t in self.tasks:
+            t.join(timeout_s=10)
+        for w in self._remote_writers:
+            w.close()
+        self.server.stop()
+        return 0
+
+
+# --------------------------------------------------------------------------
+# coordinator (worker processes enter via `python -m flink_tpu worker`,
+# which constructs a _WorkerRuntime directly — see __main__._cmd_worker)
+# --------------------------------------------------------------------------
+
+class _Pending:
+    def __init__(self, cid: int, expected: set):
+        self.cid = cid
+        self.expected = set(expected)
+        self.acks: Dict[Tuple[str, int], Dict[str, Any]] = {}
+
+
+class ProcessCluster:
+    """Coordinator: spawns workers, drives deploy/checkpoint/shutdown, and
+    assembles results (the Dispatcher + JobMaster + CheckpointCoordinator
+    roles collapsed into one process for a single job)."""
+
+    def __init__(self, job: str, n_workers: int = 2,
+                 checkpoint_storage=None, checkpoint_interval_ms: int = 0,
+                 extra_sys_path: Tuple[str, ...] = ()):
+        self.job = job
+        self.n_workers = n_workers
+        self.checkpoint_storage = checkpoint_storage
+        self.checkpoint_interval_ms = checkpoint_interval_ms
+        self.extra_sys_path = tuple(extra_sys_path)
+        self._lock = threading.Lock()
+        self._states: Dict[Tuple[str, int], str] = {}
+        self._finals: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._rows: Dict[Tuple[str, int], List[Dict[str, Any]]] = {}
+        self._pending: Optional[_Pending] = None
+        self._completed_ids: List[int] = []
+        self._next_cid = 1
+        self._failed: Optional[str] = None
+        self._done_workers: set = set()
+        self._all_done = threading.Event()
+        self._conns: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._counts: Dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self, timeout_s: float = 180.0,
+            restore: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        plan = build_plan(self.job)
+        self._counts, _ = subtask_counts_of(plan)
+        all_subtasks = {(uid, i) for uid, n in self._counts.items()
+                        for i in range(n)}
+        if restore is None and self.checkpoint_storage is not None:
+            restore = self.checkpoint_storage.load_latest()
+        srv = socket.create_server(("127.0.0.1", 0))
+        _, cport = srv.getsockname()[:2]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            (*self.extra_sys_path, *sys.path, env.get("PYTHONPATH", "")))
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "flink_tpu", "worker",
+             "--index", str(i), "--workers", str(self.n_workers),
+             "--job", self.job, "--coordinator", f"127.0.0.1:{cport}"],
+            env=env) for i in range(self.n_workers)]
+        try:
+            srv.settimeout(90)
+            addresses: Dict[int, Tuple[str, int]] = {}
+            hello_conns: List[Tuple[int, socket.socket]] = []
+            for _ in range(self.n_workers):
+                conn, _addr = srv.accept()
+                msg = _recv_msg(conn)
+                assert msg and msg[0] == "hello", msg
+                _, idx, host, port = msg
+                addresses[idx] = (host, port)
+                hello_conns.append((idx, conn))
+            for idx, conn in hello_conns:
+                self._conns[idx] = conn
+                self._send_locks[idx] = threading.Lock()
+            threads = []
+            for idx, conn in hello_conns:
+                th = threading.Thread(target=self._serve_worker,
+                                      args=(idx, conn), daemon=True)
+                th.start()
+                threads.append(th)
+            for idx in self._conns:
+                self._to_worker(idx, ("deploy", addresses, restore))
+            ticker = None
+            if self.checkpoint_interval_ms > 0:
+                ticker = threading.Thread(target=self._checkpoint_loop,
+                                          args=(all_subtasks,), daemon=True)
+                ticker.start()
+            if not self._all_done.wait(timeout=timeout_s):
+                self._failed = self._failed or "timeout"
+            for idx in self._conns:
+                self._to_worker(idx, ("stop",))
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            state = "FAILED" if self._failed else "FINISHED"
+            rows: List[Dict[str, Any]] = []
+            for key in sorted(self._rows):
+                rows.extend(self._rows[key])
+            return {"state": state, "error": self._failed, "rows": rows,
+                    "completed_checkpoints": list(self._completed_ids)}
+        finally:
+            srv.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+    def _to_worker(self, idx: int, msg) -> None:
+        try:
+            _send_msg(self._conns[idx], msg, self._send_locks[idx])
+        except OSError:
+            pass
+
+    # -- per-worker event loop --------------------------------------------
+    def _serve_worker(self, idx: int, conn: socket.socket) -> None:
+        while True:
+            msg = _recv_msg(conn)
+            if msg is None:
+                with self._lock:
+                    if idx not in self._done_workers and \
+                            self._failed is None:
+                        self._failed = f"worker {idx} died"
+                        self._all_done.set()
+                return
+            kind = msg[0]
+            if kind == "state":
+                _, uid, i, state, error = msg
+                with self._lock:
+                    self._states[(uid, i)] = state
+                    if state == "FAILED" and self._failed is None:
+                        self._failed = f"{uid}[{i}]: {error}"
+                        self._all_done.set()
+                    p = self._pending
+                    if state == "FINISHED" and p is not None \
+                            and (uid, i) not in p.acks:
+                        p.expected.discard((uid, i))
+                        if len(p.acks) >= len(p.expected):
+                            self._complete(p)
+            elif kind == "final":
+                _, uid, i, snap = msg
+                with self._lock:
+                    self._finals[(uid, i)] = snap
+            elif kind == "ack":
+                _, cid, uid, i, snap = msg
+                with self._lock:
+                    p = self._pending
+                    if p is not None and p.cid == cid:
+                        p.acks[(uid, i)] = snap
+                        if len(p.acks) >= len(p.expected):
+                            self._complete(p)
+            elif kind == "rows":
+                _, uid, i, rows = msg
+                with self._lock:
+                    self._rows[(uid, i)] = rows
+            elif kind == "worker_done":
+                with self._lock:
+                    self._done_workers.add(msg[1])
+                    if len(self._done_workers) >= self.n_workers:
+                        self._all_done.set()
+
+    # -- checkpointing -----------------------------------------------------
+    def trigger_checkpoint(self, all_subtasks: set) -> Optional[int]:
+        with self._lock:
+            if self._pending is not None or self._failed is not None:
+                return None
+            live = {k for k in all_subtasks
+                    if self._states.get(k) != "FINISHED"}
+            if not live:
+                return None
+            cid = self._next_cid
+            self._next_cid += 1
+            self._pending = _Pending(cid, live)
+        for idx in self._conns:
+            self._to_worker(idx, ("checkpoint", cid))
+        return cid
+
+    def _complete(self, p: _Pending) -> None:
+        """Assemble + store (caller holds the lock) — mirrors
+        ``MiniCluster._complete_checkpoint`` incl. FLIP-147 finals."""
+        assembled: Dict[str, Any] = {"__job__": {
+            "checkpoint_id": p.cid,
+            "parallelism": dict(self._counts)}}
+        for (uid, i), snap in p.acks.items():
+            entry = assembled.setdefault(
+                uid, {"subtasks": [None] * self._counts[uid]})
+            entry["subtasks"][i] = snap
+        for (uid, i), snap in self._finals.items():
+            if (uid, i) not in p.acks:
+                entry = assembled.setdefault(
+                    uid, {"subtasks": [None] * self._counts[uid]})
+                entry["subtasks"][i] = snap
+        if self.checkpoint_storage is not None:
+            self.checkpoint_storage.store(p.cid, assembled)
+        self._completed_ids.append(p.cid)
+        self._pending = None
+        for idx in self._conns:
+            self._to_worker(idx, ("notify", p.cid))
+
+    def _checkpoint_loop(self, all_subtasks: set) -> None:
+        while not self._all_done.is_set():
+            time.sleep(self.checkpoint_interval_ms / 1000.0)
+            if self._all_done.is_set():
+                return
+            self.trigger_checkpoint(all_subtasks)

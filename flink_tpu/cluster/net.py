@@ -19,7 +19,8 @@ blocking across channels: each channel has its own connection + budget).
 Wire format per frame:  ``type u8 | length u32le | payload``
   type 0 = RecordBatch (FTB), 1 = control element (JSON),
   type 2 = credit grant (receiver -> sender, count u32 payload),
-  type 3 = handshake (sender -> receiver: channel id utf-8).
+  type 3 = handshake (sender -> receiver: channel id utf-8),
+  type 4 = tagged batch (side output): tag length u16le | tag utf-8 | FTB.
 """
 
 from __future__ import annotations
@@ -33,10 +34,10 @@ from typing import Dict, Optional
 
 from flink_tpu.core.batch import (CheckpointBarrier, EndOfInput,
                                   LatencyMarker, RecordBatch, StreamElement,
-                                  StreamStatus, Watermark)
+                                  StreamStatus, TaggedBatch, Watermark)
 
 _HDR = struct.Struct("<BI")
-_BATCH, _CONTROL, _CREDIT, _HELLO = 0, 1, 2, 3
+_BATCH, _CONTROL, _CREDIT, _HELLO, _TAGGED = 0, 1, 2, 3, 4
 
 
 def _encode_control(el: StreamElement) -> bytes:
@@ -200,6 +201,11 @@ class ChannelServer:
                     q._push(decode_batch(payload))
                 elif ftype == _CONTROL:
                     q._push(_decode_control(payload))
+                elif ftype == _TAGGED:
+                    (tlen,) = struct.unpack("<H", payload[:2])
+                    tag = payload[2:2 + tlen].decode()
+                    q._push(TaggedBatch(tag,
+                                        decode_batch(payload[2 + tlen:])))
         except (OSError, ValueError):
             return
         finally:
@@ -263,6 +269,11 @@ class RemoteChannel:
         try:
             if isinstance(el, RecordBatch):
                 _send_frame(self._sock, _BATCH, encode_batch(el))
+            elif isinstance(el, TaggedBatch):
+                tag = el.tag.encode()
+                _send_frame(self._sock, _TAGGED,
+                            struct.pack("<H", len(tag)) + tag
+                            + encode_batch(el.batch))
             else:
                 _send_frame(self._sock, _CONTROL, _encode_control(el))
             return True

@@ -62,6 +62,12 @@ class QueryPlan:
     output_columns: List[str]
     order_by: List[Tuple[str, bool]] = field(default_factory=list)
     limit: Optional[int] = None
+    #: time-attribute propagation (the reference's rowtime column survives
+    #: projections): output column carrying the rowtime, if any, and whether
+    #: batch timestamps are already assigned in-stream — consumed when the
+    #: plan feeds a derived table
+    rowtime: Optional[str] = None
+    timestamps_assigned: bool = False
 
 
 def _transform(expr: Expr, fn: Callable[[Expr], Optional[Expr]]) -> Expr:
@@ -216,6 +222,18 @@ def _rank_filter_limit(where: Optional[Expr], rn: str) -> Optional[int]:
             return int(l.value)
         if op == ">":
             return int(l.value) - 1
+    return None
+
+
+def _propagated_rowtime(table, items: List[SelectItem],
+                        names: List[str]) -> Optional[str]:
+    """Output column name carrying the table's rowtime through a projection
+    (None when the projection drops or derives over it)."""
+    if table.rowtime is None:
+        return None
+    for it, nm in zip(items, names):
+        if isinstance(it.expr, Column) and it.expr.name == table.rowtime:
+            return nm
     return None
 
 
@@ -511,8 +529,15 @@ class Planner:
             return {nm: to_column(f(cols), n) for nm, f in zip(_names, _fns)}
 
         out = over_stream.map(project, name="sql-project")
+        rowtime_out = None
+        if event_time:
+            for it, nm in zip(items, names):
+                if isinstance(it.expr, Column) and it.expr.name == order_col:
+                    rowtime_out = nm
+                    break
         return QueryPlan(out, names, _order_names(stmt, items, names),
-                         stmt.limit)
+                         stmt.limit, rowtime=rowtime_out,
+                         timestamps_assigned=rowtime_out is not None)
 
     # ------------------------------------------------------- derived tables
     def _plan_derived(self, stmt: SelectStmt) -> QueryPlan:
@@ -536,10 +561,14 @@ class Planner:
                 lambda _ob=tuple(inner.order_by), _lim=inner.limit:
                 SortLimitOperator(list(_ob), _lim), chainable=False)
             inner_stream = DataStream(inner_stream.env, t)
+        # propagate the time attribute: the outer query may only use event
+        # time if the subquery's projection carried the rowtime through
+        # (the reference's rowtime-propagation rule)
         sub = CatalogTable(name="<subquery>",
                            columns=list(inner.output_columns),
                            stream_factory=lambda env: inner_stream,
-                           timestamps_assigned=True)
+                           rowtime=inner.rowtime,
+                           timestamps_assigned=inner.timestamps_assigned)
         outer = _copy_stmt(stmt)
         outer.table = "<subquery>"
         outer.table_alias = stmt.table_alias
@@ -555,15 +584,15 @@ class Planner:
         inner: SelectStmt = stmt.table
         over_items = [(i, it) for i, it in enumerate(inner.items)
                       if isinstance(it.expr, OverCall)]
-        if not over_items:
+        if not any(it.expr.func == "ROW_NUMBER" for _, it in over_items):
+            # not the Top-N shape — fall through to generic derived-table
+            # planning, where _plan_over handles OVER aggregates
             return None
         if len(over_items) != 1:
-            raise PlanError("exactly one window function per subquery")
+            raise PlanError("ROW_NUMBER Top-N allows exactly one window "
+                            "function in the subquery")
         idx, over_it = over_items[0]
         over: OverCall = over_it.expr
-        if over.func != "ROW_NUMBER":
-            raise PlanError(f"{over.func}() OVER is not supported; "
-                            f"ROW_NUMBER is")
         if over.order_by is None or not isinstance(over.order_by, Column):
             raise PlanError("ROW_NUMBER OVER needs ORDER BY <column>")
         if over.partition_by is not None and \
@@ -733,8 +762,11 @@ class Planner:
             return {nm: to_column(f(cols), n) for nm, f in zip(_names, _fns)}
 
         out = stream.map(project, name="sql-project")
+        rowtime_out = _propagated_rowtime(table, items, names)
         return QueryPlan(out, names, _order_names(stmt, items, names),
-                         stmt.limit)
+                         stmt.limit, rowtime=rowtime_out,
+                         timestamps_assigned=(rowtime_out is not None
+                                              and table.timestamps_assigned))
 
     # ------------------------------------------------------------- aggregate
     def _plan_aggregate(self, stream, items, having, agg_specs: List[AggSpec],

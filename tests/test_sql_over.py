@@ -236,3 +236,30 @@ def test_branch_merge_snapshot_restore():
     out = op2.process_batch2(mk_batch([2], None, extra=[9.]), 1)
     merged = [r for b in out for r in b.to_rows()]
     assert len(merged) == 1 and merged[0]["s"] == 20.0 and merged[0]["d"] == 9.0
+
+
+def test_over_in_subquery_bare():
+    # a bare OVER aggregate inside a derived table (not the Top-N shape)
+    rows = make_env().execute_sql(
+        "SELECT * FROM (SELECT k, ts, SUM(v) OVER (PARTITION BY k "
+        "ORDER BY ts) AS s FROM t) WHERE s > 20").collect()
+    assert sorted((r["k"], r["s"]) for r in rows) == \
+        [(1, 30.0), (1, 60.0), (1, 100.0)]
+
+
+def test_over_over_projection_subquery():
+    # OVER planned on TOP of a subquery: the rowtime must propagate through
+    # the inner projection for the outer ORDER BY to be a time attribute
+    rows = make_env().execute_sql(
+        "SELECT k, ts, SUM(v) OVER (PARTITION BY k ORDER BY ts) + 0 AS s "
+        "FROM (SELECT k, ts, v FROM t)").collect()
+    assert [r["s"] for r in by_key(rows, 1)] == [10., 30., 60., 100.]
+
+
+def test_over_subquery_dropped_rowtime_rejected():
+    # the subquery drops ts -> outer OVER has no time attribute
+    te = make_env()
+    with pytest.raises(PlanError, match="time attribute"):
+        te.execute_sql(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts) "
+            "FROM (SELECT k, v FROM t)").collect()
