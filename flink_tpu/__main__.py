@@ -123,7 +123,31 @@ def _cmd_worker(args) -> int:
 
     host, port = args.coordinator.rsplit(":", 1)
     return _WorkerRuntime(args.index, args.workers, args.job,
-                          host, int(port)).run()
+                          host, int(port), bind_host=args.bind,
+                          advertise_host=args.advertise).run()
+
+
+def _cmd_coordinate(args) -> int:
+    import json as _json
+
+    from flink_tpu.cluster.distributed import (ProcessCluster,
+                                               _security_from_env)
+    from flink_tpu.runtime.checkpoint.storage import FileCheckpointStorage
+
+    storage = (FileCheckpointStorage(args.checkpoint_dir)
+               if args.checkpoint_dir else None)
+    host, port = args.listen.rsplit(":", 1)
+    # same FLINK_TPU_SSL_*/FLINK_TPU_AUTH_TOKEN env contract as workers —
+    # on k8s both containers receive the secrets the same way
+    pc = ProcessCluster(args.job, n_workers=args.workers,
+                        checkpoint_storage=storage,
+                        checkpoint_interval_ms=args.checkpoint_interval,
+                        spawn=False, bind_host=host, listen_port=int(port),
+                        security=_security_from_env())
+    res = pc.run(timeout_s=args.timeout)
+    print(_json.dumps({k: v for k, v in res.items() if k != "rows"},
+                      default=str))
+    return 0 if res["state"] == "FINISHED" else 1
 
 
 def main(argv=None) -> int:
@@ -150,7 +174,21 @@ def main(argv=None) -> int:
     pw.add_argument("--workers", type=int, required=True)
     pw.add_argument("--job", required=True)
     pw.add_argument("--coordinator", required=True)
+    pw.add_argument("--bind", default="127.0.0.1",
+                    help="data-plane bind address (0.0.0.0 on k8s)")
+    pw.add_argument("--advertise", default=None,
+                    help="address peers dial (pod IP on k8s)")
     pw.set_defaults(fn=_cmd_worker)
+    pco = sub.add_parser(
+        "coordinate", help="cluster coordinator that WAITS for externally "
+        "started workers (k8s / multi-host deployments)")
+    pco.add_argument("--job", required=True)
+    pco.add_argument("--workers", type=int, required=True)
+    pco.add_argument("--listen", default="0.0.0.0:6123")
+    pco.add_argument("--checkpoint-dir", default=None)
+    pco.add_argument("--checkpoint-interval", type=int, default=0)
+    pco.add_argument("--timeout", type=float, default=86400.0)
+    pco.set_defaults(fn=_cmd_coordinate)
     for name, needs_job in (("list", False), ("status", True),
                             ("cancel", True), ("savepoint", True)):
         pc = sub.add_parser(name, help=f"{name} jobs via the REST endpoint")

@@ -276,12 +276,15 @@ class DispatcherEndpoint(RpcEndpoint):
     def __init__(self, rpc: RpcService, rm_address: str,
                  ha_services=None, name: str = "dispatcher",
                  checkpoint_storage_factory: Optional[Callable[[str], Any]] = None,
-                 plan_builder: Optional[Callable[[Any], Any]] = None):
+                 plan_builder: Optional[Callable[[Any], Any]] = None,
+                 history_dir: Optional[str] = None):
         super().__init__(name)
         self.rpc = rpc
         self.rm_address = rm_address
         self.ha = ha_services
         self.checkpoint_storage_factory = checkpoint_storage_factory
+        #: archive terminal jobs here for the HistoryServer (FsJobArchivist)
+        self.history_dir = history_dir
         #: rebuilds an ExecutionPlan from the picklable job spec persisted in
         #: HA (plans themselves hold operator closures — the durable artifact
         #: is the spec, like the reference persists the serialized JobGraph)
@@ -339,6 +342,14 @@ class DispatcherEndpoint(RpcEndpoint):
             self._results[job_id] = result
             if self.ha is not None and result.state == "FINISHED":
                 self.ha.remove_job(job_id)
+            if self.history_dir is not None:
+                from flink_tpu.rest.history import archive_job
+                try:
+                    status = await_future(self._jobs[job_id].job_status())
+                except Exception:  # noqa: BLE001 — archive the bare result
+                    status = {"state": result.state,
+                              "error": getattr(result, "error", None)}
+                archive_job(self.history_dir, job_id, status)
         self.run_async(record)
 
     def list_jobs(self) -> List[str]:
@@ -376,7 +387,8 @@ class StandaloneSessionCluster:
     def __init__(self, num_task_executors: int = 1, slots_per_executor: int = 1,
                  ha_services=None,
                  checkpoint_storage_factory: Optional[Callable[[str], Any]] = None,
-                 plan_builder: Optional[Callable[[Any], Any]] = None):
+                 plan_builder: Optional[Callable[[Any], Any]] = None,
+                 history_dir: Optional[str] = None):
         self.rpc = RpcService()
         self.rm = ResourceManagerEndpoint(self.rpc)
         self.rm_gw = self.rpc.start_endpoint(self.rm)
@@ -389,7 +401,7 @@ class StandaloneSessionCluster:
         self.dispatcher = DispatcherEndpoint(
             self.rpc, self.rm.name, ha_services=ha_services,
             checkpoint_storage_factory=checkpoint_storage_factory,
-            plan_builder=plan_builder)
+            plan_builder=plan_builder, history_dir=history_dir)
         self.dispatcher_gw = self.rpc.start_endpoint(self.dispatcher)
 
     def client(self) -> "ClusterClient":

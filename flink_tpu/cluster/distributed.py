@@ -128,20 +128,51 @@ def _edge_pairs(part: str, np_: int, nc: int):
 # worker process
 # --------------------------------------------------------------------------
 
+def _security_from_env() -> Optional["SecurityConfig"]:
+    """Worker-side security settings, shipped via environment variables by
+    the coordinator (the reference ships keystores via the container env /
+    mounted secrets the same way)."""
+    from flink_tpu.security import SecurityConfig
+
+    cert = os.environ.get("FLINK_TPU_SSL_CERT")
+    token = os.environ.get("FLINK_TPU_AUTH_TOKEN")
+    if not cert and not token:
+        return None
+    return SecurityConfig(
+        internal_ssl=bool(cert),
+        cert_path=cert,
+        key_path=os.environ.get("FLINK_TPU_SSL_KEY"),
+        ca_path=os.environ.get("FLINK_TPU_SSL_CA"),
+        auth_token=token or None)
+
+
 class _WorkerRuntime:
     """TaskListener inside a worker: deploys the local subtask slice and
     relays task events to the coordinator."""
 
     def __init__(self, index: int, n_workers: int, job: str,
-                 coord_host: str, coord_port: int):
+                 coord_host: str, coord_port: int,
+                 bind_host: str = "127.0.0.1",
+                 advertise_host: Optional[str] = None):
         from flink_tpu.cluster.net import ChannelServer
 
         self.index = index
         self.n_workers = n_workers
         self.job = job
-        self.server = ChannelServer()
+        self.security = _security_from_env()
+        server_ctx = client_ctx = None
+        if self.security is not None and self.security.internal_ssl:
+            server_ctx = self.security.server_context()
+            client_ctx = self.security.client_context()
+        self._client_ssl = client_ctx
+        self.server = ChannelServer(host=bind_host, ssl_context=server_ctx)
+        #: address other workers dial (pod IP / service DNS on k8s)
+        self.advertise_host = advertise_host or self.server.host
         self.sock = socket.create_connection((coord_host, coord_port),
                                              timeout=30)
+        if client_ctx is not None:
+            self.sock = client_ctx.wrap_socket(self.sock,
+                                               server_hostname=coord_host)
         # the connect timeout must not linger: the worker blocks on this
         # socket indefinitely waiting for deploy/stop (sibling workers can
         # take arbitrarily long to cold-start before the coordinator
@@ -243,7 +274,8 @@ class _WorkerRuntime:
                         input_logical[tgt.id][ci].append(e.input_index)
                     elif p_local:
                         host, port = addresses[assign[(tgt.uid, ci)]]
-                        ch = RemoteChannel(host, port, chan_id)
+                        ch = RemoteChannel(host, port, chan_id,
+                                           ssl_context=self._client_ssl)
                         self._remote_writers.append(ch)
                     elif c_local:
                         q = self.server.channel(chan_id)
@@ -298,7 +330,19 @@ class _WorkerRuntime:
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> int:
-        self._send(("hello", self.index, self.server.host, self.server.port))
+        # auth handshake: the coordinator challenges, the worker answers
+        # with an HMAC over the nonce (cluster shared secret)
+        msg = _recv_msg(self.sock)
+        if not msg or msg[0] != "challenge":
+            return 1
+        nonce = msg[1]
+        mac = None
+        if nonce is not None:
+            if self.security is None or self.security.auth_token is None:
+                return 1  # cluster requires a token this worker lacks
+            mac = self.security.sign(nonce)
+        self._send(("hello", self.index, self.advertise_host,
+                    self.server.port, mac))
         while True:
             msg = _recv_msg(self.sock)
             if msg is None:
@@ -346,12 +390,23 @@ class ProcessCluster:
 
     def __init__(self, job: str, n_workers: int = 2,
                  checkpoint_storage=None, checkpoint_interval_ms: int = 0,
-                 extra_sys_path: Tuple[str, ...] = ()):
+                 extra_sys_path: Tuple[str, ...] = (), security=None,
+                 spawn: bool = True, bind_host: str = "127.0.0.1",
+                 listen_port: int = 0):
         self.job = job
         self.n_workers = n_workers
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.extra_sys_path = tuple(extra_sys_path)
+        #: optional SecurityConfig: mutual TLS on control + data plane and/or
+        #: an HMAC token handshake on worker registration
+        self.security = security
+        #: spawn=True runs workers as local subprocesses; spawn=False only
+        #: LISTENS — workers are started externally (k8s pods, other hosts)
+        #: and dial in with `flink_tpu worker --coordinator host:port`
+        self.spawn = spawn
+        self.bind_host = bind_host
+        self.listen_port = listen_port
         self._lock = threading.Lock()
         self._states: Dict[Tuple[str, int], str] = {}
         self._finals: Dict[Tuple[str, int], Dict[str, Any]] = {}
@@ -375,25 +430,69 @@ class ProcessCluster:
                         for i in range(n)}
         if restore is None and self.checkpoint_storage is not None:
             restore = self.checkpoint_storage.load_latest()
-        srv = socket.create_server(("127.0.0.1", 0))
+        srv = socket.create_server((self.bind_host, self.listen_port))
         _, cport = srv.getsockname()[:2]
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            (*self.extra_sys_path, *sys.path, env.get("PYTHONPATH", "")))
-        procs = [subprocess.Popen(
-            [sys.executable, "-m", "flink_tpu", "worker",
-             "--index", str(i), "--workers", str(self.n_workers),
-             "--job", self.job, "--coordinator", f"127.0.0.1:{cport}"],
-            env=env) for i in range(self.n_workers)]
+        self.control_port = cport
+        procs: List[subprocess.Popen] = []
+        if self.spawn:
+            env = dict(os.environ)
+            env["PYTHONPATH"] = os.pathsep.join(
+                (*self.extra_sys_path, *sys.path, env.get("PYTHONPATH", "")))
+            if self.security is not None:
+                if self.security.internal_ssl:
+                    env["FLINK_TPU_SSL_CERT"] = self.security.cert_path
+                    env["FLINK_TPU_SSL_KEY"] = self.security.key_path
+                    env["FLINK_TPU_SSL_CA"] = self.security.ca_path
+                if self.security.auth_token:
+                    env["FLINK_TPU_AUTH_TOKEN"] = self.security.auth_token
+            procs = [subprocess.Popen(
+                [sys.executable, "-m", "flink_tpu", "worker",
+                 "--index", str(i), "--workers", str(self.n_workers),
+                 "--job", self.job, "--coordinator", f"127.0.0.1:{cport}"],
+                env=env) for i in range(self.n_workers)]
         try:
-            srv.settimeout(90)
+            # spawned workers register within seconds; external (pod) workers
+            # may take as long as the cluster scheduler needs
+            srv.settimeout(90 if self.spawn else timeout_s)
+            server_ctx = (self.security.server_context()
+                          if self.security is not None
+                          and self.security.internal_ssl else None)
+            need_token = (self.security is not None
+                          and bool(self.security.auth_token))
             addresses: Dict[int, Tuple[str, int]] = {}
             hello_conns: List[Tuple[int, socket.socket]] = []
-            for _ in range(self.n_workers):
+            tmp_lock = threading.Lock()
+            while len(hello_conns) < self.n_workers:
                 conn, _addr = srv.accept()
-                msg = _recv_msg(conn)
-                assert msg and msg[0] == "hello", msg
-                _, idx, host, port = msg
+                # a stray connection (readiness probe, port scan, wrong
+                # token) must neither consume a registration slot nor fail
+                # the job — drop it and keep accepting
+                try:
+                    if server_ctx is not None:
+                        conn = server_ctx.wrap_socket(conn, server_side=True)
+                    conn.settimeout(30)
+                    nonce = os.urandom(32) if need_token else None
+                    _send_msg(conn, ("challenge", nonce), tmp_lock)
+                    msg = _recv_msg(conn)
+                    if not (isinstance(msg, tuple) and len(msg) == 5
+                            and msg[0] == "hello"):
+                        conn.close()
+                        continue
+                    _, idx, host, port, mac = msg
+                    if not isinstance(idx, int) or idx in addresses:
+                        conn.close()
+                        continue
+                    if need_token and not self.security.verify(
+                            nonce, mac or b""):
+                        conn.close()
+                        continue
+                    conn.settimeout(None)
+                except (OSError, ValueError, pickle.UnpicklingError):
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
                 addresses[idx] = (host, port)
                 hello_conns.append((idx, conn))
             for idx, conn in hello_conns:

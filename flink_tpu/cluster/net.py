@@ -146,11 +146,17 @@ class _ReceiveQueue:
 
 
 class ChannelServer:
-    """Receiving endpoint: one TCP server, one queue per logical channel."""
+    """Receiving endpoint: one TCP server, one queue per logical channel.
+
+    ``ssl_context``: a server-side context (mutual TLS — see
+    ``security/ssl_context.py``) wraps every accepted connection, the
+    ``security.ssl.internal.enabled`` data-plane encryption of the
+    reference."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 channel_capacity: int = 32):
+                 channel_capacity: int = 32, ssl_context=None):
         self.channel_capacity = channel_capacity
+        self._ssl = ssl_context
         self._queues: Dict[str, _ReceiveQueue] = {}
         self._lock = threading.Lock()
         self._srv = socket.create_server((host, port))
@@ -185,6 +191,9 @@ class ChannelServer:
         from flink_tpu.native.codec import decode_batch
 
         try:
+            if self._ssl is not None:
+                # handshake on the connection thread (it can block)
+                conn = self._ssl.wrap_socket(conn, server_side=True)
             ftype, payload = _recv_frame(conn)
             if ftype != _HELLO:
                 conn.close()
@@ -226,10 +235,13 @@ class RemoteChannel:
     """Sender side: LocalChannel-shaped ``put`` over TCP with credits."""
 
     def __init__(self, host: str, port: int, channel_id: str,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0, ssl_context=None):
         self.channel_id = channel_id
         self._sock = socket.create_connection((host, port),
                                               timeout=connect_timeout_s)
+        if ssl_context is not None:
+            self._sock = ssl_context.wrap_socket(self._sock,
+                                                 server_hostname=host)
         self._sock.settimeout(None)
         _send_frame(self._sock, _HELLO, channel_id.encode())
         self._credits = 0
@@ -243,7 +255,10 @@ class RemoteChannel:
 
     def _credit_loop(self) -> None:
         while True:
-            ftype, payload = _recv_frame(self._sock)
+            try:
+                ftype, payload = _recv_frame(self._sock)
+            except OSError:
+                ftype = None  # reset by peer == closed
             if ftype is None:
                 with self._have_credit:
                     self._closed = True

@@ -112,3 +112,24 @@ class MetricOptions:
     LATENCY_INTERVAL = key("metrics.latency.interval").duration_type().default_value(
         0, "Latency-marker emission interval in ms (0 = disabled).")
     SCOPE_DELIMITER = key("metrics.scope.delimiter").string_type().default_value(".")
+
+
+class SecurityOptions:
+    """Transport security (``SecurityOptions.java`` analog: the
+    ``security.ssl.internal.*`` / ``security.ssl.rest.*`` key families)."""
+
+    SSL_INTERNAL_ENABLED = key("security.ssl.internal.enabled").bool_type().default_value(
+        False, "Mutual TLS on internal connections (data plane channels, "
+               "coordinator control plane).")
+    SSL_REST_ENABLED = key("security.ssl.rest.enabled").bool_type().default_value(
+        False, "TLS on the REST endpoint (server-auth only).")
+    SSL_CERT = key("security.ssl.certificate").string_type().default_value(
+        "", "PEM certificate presented by this process.")
+    SSL_KEY = key("security.ssl.key").string_type().default_value(
+        "", "PEM private key for the certificate.")
+    SSL_CA = key("security.ssl.ca").string_type().default_value(
+        "", "PEM CA bundle that signs every cluster certificate "
+            "(the truststore).")
+    AUTH_TOKEN = key("security.auth.token").string_type().default_value(
+        "", "Shared cluster secret: HMAC-authenticates control-plane "
+            "connections (usable with or without TLS).")

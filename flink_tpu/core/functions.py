@@ -168,10 +168,16 @@ class AggregateFunction(RichFunction, abc.ABC):
         if cached is None:
             ident = self.identity()
             leaves, treedef = jax.tree_util.tree_flatten(ident)
+            # stable leaf identities from pytree key paths ("['sum']", "[0]"):
+            # snapshots record them so composite accumulators can evolve by
+            # field name (add/remove/widen), the POJO-evolution analog
+            paths = jax.tree_util.tree_flatten_with_path(ident)[0]
+            names = tuple(jax.tree_util.keystr(p) for p, _ in paths)
             cached = AccSpec(treedef=treedef,
                              leaf_shapes=tuple(np.shape(l) for l in leaves),
                              leaf_dtypes=tuple(jnp.asarray(l).dtype for l in leaves),
-                             leaf_inits=tuple(np.asarray(l) for l in leaves))
+                             leaf_inits=tuple(np.asarray(l) for l in leaves),
+                             leaf_names=names)
             self._acc_spec_cache = cached
         return cached
 
@@ -184,6 +190,8 @@ class AccSpec:
     leaf_shapes: Tuple[Tuple[int, ...], ...]
     leaf_dtypes: Tuple[Any, ...]
     leaf_inits: Tuple[np.ndarray, ...]
+    #: pytree key path per leaf — the schema-evolution identity
+    leaf_names: Tuple[str, ...] = ()
 
     @property
     def num_leaves(self) -> int:

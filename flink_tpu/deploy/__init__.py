@@ -1,0 +1,3 @@
+from flink_tpu.deploy.kubernetes import render_job_cluster
+
+__all__ = ["render_job_cluster"]

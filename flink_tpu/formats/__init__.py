@@ -195,18 +195,29 @@ def read_ftb(path: str, skip_batches: int = 0,
             yield decode_batch(payload)
 
 
+def _read_avro(path: str, batch_size: int = 8192, **kw):
+    from flink_tpu.formats.avro import read_avro
+    return read_avro(path, batch_size=batch_size)
+
+
+def _write_avro(batches, path: str, **kw) -> int:
+    from flink_tpu.formats.avro import write_avro
+    return write_avro(batches, path, **kw)
+
+
 FORMATS = {
     "csv": (read_csv, write_csv),
     "jsonl": (read_jsonl, write_jsonl),
     "ftb": (read_ftb, write_ftb),
+    "avro": (_read_avro, _write_avro),
 }
 
 
 def reader_for(fmt: str):
-    if fmt in ("parquet", "orc", "avro"):
+    if fmt in ("parquet", "orc"):
         raise NotImplementedError(
-            f"{fmt} needs pyarrow/fastavro (not in this environment); "
-            f"use 'ftb' (binary), 'csv' or 'jsonl'")
+            f"{fmt} needs pyarrow (not in this environment); "
+            f"use 'avro', 'ftb' (binary), 'csv' or 'jsonl'")
     if fmt not in FORMATS:
         raise ValueError(f"unknown format {fmt!r}; have {sorted(FORMATS)}")
     return FORMATS[fmt][0]

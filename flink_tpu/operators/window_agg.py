@@ -990,6 +990,8 @@ class WindowAggOperator(StreamOperator):
             snap["panes"] = panes
             snap["leaves"] = [np.asarray(jnp.take(l, slots, axis=1))[:n] for l in self._leaves]
             snap["counts"] = np.asarray(jnp.take(self._counts, slots, axis=1))[:n]
+            from flink_tpu.state.evolution import acc_leaf_schema
+            snap["leaf_schema"] = acc_leaf_schema(self.spec)
         if self._count_baselines:
             n = self.key_index.num_keys if self.key_index else 0
             packed = {}
@@ -1016,13 +1018,25 @@ class WindowAggOperator(StreamOperator):
         self._leaves = None
         self._counts = None
         if "leaves" in snap:
+            from flink_tpu.state.evolution import migrate_acc_leaves
             self._ensure_alloc()
             n = snap["counts"].shape[0]
             panes = np.asarray(snap["panes"], np.int64)
             slots = jnp.asarray(panes % self._P, jnp.int32)
+
+            def fill(j, _n=n, _np=len(panes)):
+                # ADDED accumulator field: identity rows in [n, panes] shape
+                init = np.asarray(self.spec.leaf_inits[j],
+                                  self.spec.leaf_dtypes[j])
+                return np.broadcast_to(
+                    init, (_n, _np) + tuple(self.spec.leaf_shapes[j])).copy()
+
+            leaves = migrate_acc_leaves(snap["leaves"],
+                                        snap.get("leaf_schema"),
+                                        self.spec, fill)
             self._leaves = tuple(
                 l.at[:n, slots].set(jnp.asarray(s))
-                for l, s in zip(self._leaves, snap["leaves"]))
+                for l, s in zip(self._leaves, leaves))
             self._counts = self._counts.at[:n, slots].set(jnp.asarray(snap["counts"]))
         self._count_baselines = {w: np.asarray(b, np.int64).copy()
                                  for w, b in

@@ -67,13 +67,30 @@ def _percentiles(xs: List[float]) -> Dict[str, float]:
 
 class RestServer:
     def __init__(self, registry: JobRegistry, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, ssl_context=None,
+                 auth_token: Optional[str] = None):
+        """``ssl_context``: server-side TLS (``security.ssl.rest.enabled``
+        analog); ``auth_token``: require ``Authorization: Bearer <token>``
+        on every request."""
         self.registry = registry
+        self._ssl = ssl_context
         registry_ref = registry
+        token_ref = auth_token
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
+
+            def parse_request(self):
+                ok = super().parse_request()
+                if ok and token_ref is not None:
+                    import hmac as _hmac
+                    got = self.headers.get("Authorization", "")
+                    if not _hmac.compare_digest(got.encode(),
+                                                f"Bearer {token_ref}".encode()):
+                        self.send_error(401, "missing or wrong bearer token")
+                        return False
+                return ok
 
             def _send(self, obj, status: int = 200,
                       content_type: str = "application/json"):
@@ -178,6 +195,9 @@ class RestServer:
                 return self._send({"status": "cancelling"}, 202)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            self._server.socket = ssl_context.wrap_socket(
+                self._server.socket, server_side=True)
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="rest-server", daemon=True)
@@ -192,7 +212,8 @@ class RestServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self._ssl is not None else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
 
 _DASHBOARD_HTML = """<!DOCTYPE html>

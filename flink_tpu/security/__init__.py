@@ -1,0 +1,5 @@
+from flink_tpu.security.ssl_context import (SecurityConfig,
+                                            generate_self_signed,
+                                            load_security_config)
+
+__all__ = ["SecurityConfig", "generate_self_signed", "load_security_config"]

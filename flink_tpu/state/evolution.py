@@ -113,3 +113,58 @@ def migrate_snapshot(snapshot: Dict[str, Any],
     # (lazy-bound, dropped when never re-registered) — reference keeps
     # unknown state until explicitly removed via the State Processor API
     return out
+
+
+# ---------------------------------------------------------------------------
+# composite accumulator (ACC pytree) evolution
+# ---------------------------------------------------------------------------
+
+def acc_leaf_schema(spec) -> List[Dict[str, Any]]:
+    """Per-leaf schema of an accumulator pytree (written into snapshots):
+    the pytree key path is the leaf's evolution identity — dict-keyed ACC
+    fields migrate by NAME, the POJO field-name matching of the reference's
+    ``PojoSerializerSnapshot``."""
+    names = spec.leaf_names or tuple(f"[{i}]" for i in range(spec.num_leaves))
+    return [{"name": n, "dtype": np.dtype(d).name}
+            for n, d in zip(names, spec.leaf_dtypes)]
+
+
+def migrate_acc_leaves(old_leaves, old_schema: Optional[List[Dict[str, Any]]],
+                       spec, default_fill) -> List[Any]:
+    """Align snapshot leaf arrays with the CURRENT accumulator spec.
+
+    - same name, same dtype   → restored verbatim;
+    - same name, widened dtype → cast (``_WIDENINGS``);
+    - new leaf (field ADDED)  → ``default_fill(leaf_index)`` supplies rows
+      of the identity value in the caller's row geometry;
+    - old leaf gone (REMOVED) → dropped;
+    - narrowing/kind change   → :class:`SchemaEvolutionError`.
+
+    Snapshots without a recorded schema (pre-evolution) must match leaf
+    count exactly.
+    """
+    if old_schema is None:
+        if len(old_leaves) != spec.num_leaves:
+            raise SchemaEvolutionError(
+                f"accumulator layout changed ({len(old_leaves)} stored "
+                f"leaves vs {spec.num_leaves} registered) and the snapshot "
+                f"carries no leaf schema to migrate by")
+        return list(old_leaves)
+    new_schema = acc_leaf_schema(spec)
+    by_name = {s["name"]: i for i, s in enumerate(old_schema)}
+    out: List[Any] = []
+    for j, ns in enumerate(new_schema):
+        i = by_name.get(ns["name"])
+        if i is None:
+            out.append(default_fill(j))
+            continue
+        arr = np.asarray(old_leaves[i])
+        od, nd = old_schema[i]["dtype"], ns["dtype"]
+        if od != nd:
+            if nd not in _WIDENINGS.get(od, ()):
+                raise SchemaEvolutionError(
+                    f"accumulator leaf {ns['name']!r}: stored dtype {od} -> "
+                    f"registered {nd} is not a widening migration")
+            arr = arr.astype(nd)
+        out.append(arr)
+    return out

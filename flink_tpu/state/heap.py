@@ -532,18 +532,31 @@ class HeapAggregatingState(AggregatingState, _HeapStateBase):
         self._present[slots] = False
 
     def snapshot(self, n: int) -> Dict[str, Any]:
+        from flink_tpu.state.evolution import acc_leaf_schema
+
         self._ensure(n)
         return self._snapshot_common(n, {
             "rows": tuple(leaf[:n].copy() for leaf in self._leaves),
+            "leaf_schema": acc_leaf_schema(self._spec),
             "present": self._present[:n].copy()})
 
     def restore(self, snap: Dict[str, Any]) -> None:
-        rows = snap["rows"]
+        from flink_tpu.state.evolution import migrate_acc_leaves
+
         present = np.asarray(snap["present"], bool)
         if "ttl_expired" in snap:
             present = present & ~np.asarray(snap["ttl_expired"], bool)
         n = len(present)
         self._ensure(n)
+
+        def fill(j, _n=n):
+            init = np.asarray(self._spec.leaf_inits[j],
+                              self._spec.leaf_dtypes[j])
+            return np.broadcast_to(
+                init, (_n,) + tuple(self._spec.leaf_shapes[j])).copy()
+
+        rows = migrate_acc_leaves(snap["rows"], snap.get("leaf_schema"),
+                                  self._spec, fill)
         for leaf, r in zip(self._leaves, rows):
             leaf[:n] = r
         self._present[:n] = present
@@ -563,7 +576,8 @@ class HeapReducingState(HeapAggregatingState, ReducingState):
 
 #: every field a state impl may put in its snapshot dict (restore parses
 #: flattened "state.<name>.<field>" keys against this closed set)
-_STATE_SNAPSHOT_FIELDS = ("rows", "present", "ttl_ts", "ttl_expired")
+_STATE_SNAPSHOT_FIELDS = ("rows", "present", "ttl_ts", "ttl_expired",
+                          "leaf_schema")
 
 _IMPLS = {
     "value": HeapValueState,
@@ -718,8 +732,11 @@ class HeapKeyedStateBackend:
 
     @staticmethod
     def row_fields(snap: Dict[str, Any]) -> List[str]:
-        """The per-key row fields of a backend snapshot (for redistribute)."""
-        return [k for k in snap if k.startswith("state.")]
+        """The per-key row fields of a backend snapshot (for redistribute).
+        ``leaf_schema`` entries are per-STATE metadata, not per-key rows —
+        splitting them by key group would corrupt them."""
+        return [k for k in snap if k.startswith("state.")
+                and not k.endswith(".leaf_schema")]
 
     def restore(self, snap: Dict[str, Any]) -> None:
         if snap.get("empty"):

@@ -1,0 +1,148 @@
+"""Deployment: externally-started workers (the k8s pod flow) and manifest
+rendering (``flink-kubernetes`` analog)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.distributed import ProcessCluster
+from flink_tpu.deploy import render_job_cluster
+from flink_tpu.deploy.kubernetes import to_yaml
+
+
+def test_manifest_rendering_shapes():
+    ms = render_job_cluster("wordcount", "gcr.io/x/flink-tpu:1", "jobs:build",
+                            n_workers=3, checkpoint_dir="/ckpt",
+                            checkpoint_interval_ms=5000,
+                            tpu_resource={"google.com/tpu": 8},
+                            env={"EXTRA": "1"})
+    kinds = [m["kind"] for m in ms]
+    assert kinds == ["Service", "Job", "StatefulSet"]
+    svc, job, sts = ms
+    assert svc["spec"]["selector"]["component"] == "coordinator"
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--workers" in cmd and "3" in cmd and "--checkpoint-dir" in cmd
+    worker = sts["spec"]["template"]["spec"]["containers"][0]
+    assert sts["spec"]["replicas"] == 3
+    assert worker["resources"]["limits"] == {"google.com/tpu": 8}
+    assert "--advertise ${POD_IP}" in worker["command"][2]
+
+    text = to_yaml(ms)
+    import yaml
+    docs = list(yaml.safe_load_all(text))
+    assert len(docs) == 3 and docs[0]["kind"] == "Service"
+
+
+def test_external_workers_register_and_run(tmp_path):
+    """spawn=False: the coordinator only listens; workers are launched
+    separately with the exact CLI a k8s pod would run."""
+    mod = tmp_path / "ext_job_mod.py"
+    mod.write_text(textwrap.dedent('''
+        import numpy as np
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+        def build():
+            env = StreamExecutionEnvironment()
+            env.set_parallelism(2)
+            n = 4000
+            keys = (np.arange(n) % 3).astype(np.int64)
+            (env.from_collection(columns={"k": keys, "v": np.ones(n)},
+                                 batch_size=256)
+                .key_by("k").sum("v").collect())
+            return env.get_stream_graph("ext-job")
+    '''))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        pc = ProcessCluster("ext_job_mod:build", n_workers=2, spawn=False,
+                            extra_sys_path=(str(tmp_path),))
+        result = {}
+
+        def run():
+            result.update(pc.run(timeout_s=120))
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        # wait for the coordinator to listen, then start the "pods"
+        import time
+        deadline = time.time() + 10
+        while not hasattr(pc, "control_port") and time.time() < deadline:
+            time.sleep(0.02)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join((str(tmp_path), *sys.path))
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "flink_tpu", "worker",
+             "--index", str(i), "--workers", "2",
+             "--job", "ext_job_mod:build",
+             "--coordinator", f"127.0.0.1:{pc.control_port}",
+             "--bind", "127.0.0.1", "--advertise", "127.0.0.1"],
+            env=env) for i in range(2)]
+        th.join(timeout=120)
+        for p in procs:
+            p.wait(timeout=30)
+        assert result.get("state") == "FINISHED", result.get("error")
+        last = {}
+        for r in result["rows"]:
+            last[r["k"]] = r["v"]
+        assert last == {0: 1334.0, 1: 1333.0, 2: 1333.0}
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("ext_job_mod", None)
+
+
+def test_stray_connection_does_not_kill_registration(tmp_path):
+    """A readiness-probe-style connect/close or garbage bytes on the
+    coordinator port must not consume a worker slot or fail the job."""
+    import socket
+    import textwrap
+    import time
+
+    mod = tmp_path / "probe_job_mod.py"
+    mod.write_text(textwrap.dedent('''
+        import numpy as np
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+        def build():
+            env = StreamExecutionEnvironment()
+            env.set_parallelism(1)
+            (env.from_collection(columns={"k": np.zeros(100, np.int64),
+                                          "v": np.ones(100)}, batch_size=64)
+                .key_by("k").sum("v").collect())
+            return env.get_stream_graph("probe-job")
+    '''))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        pc = ProcessCluster("probe_job_mod:build", n_workers=1, spawn=False,
+                            extra_sys_path=(str(tmp_path),))
+        result = {}
+        th = threading.Thread(
+            target=lambda: result.update(pc.run(timeout_s=120)), daemon=True)
+        th.start()
+        deadline = time.time() + 10
+        while not hasattr(pc, "control_port") and time.time() < deadline:
+            time.sleep(0.02)
+        # probe 1: connect and close immediately
+        s = socket.create_connection(("127.0.0.1", pc.control_port))
+        s.close()
+        # probe 2: garbage bytes
+        s = socket.create_connection(("127.0.0.1", pc.control_port))
+        s.sendall(b"GET / HTTP/1.1\r\n\r\n")
+        s.close()
+        # the real worker registers fine afterwards
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join((str(tmp_path), *sys.path))
+        p = subprocess.Popen(
+            [sys.executable, "-m", "flink_tpu", "worker",
+             "--index", "0", "--workers", "1",
+             "--job", "probe_job_mod:build",
+             "--coordinator", f"127.0.0.1:{pc.control_port}"], env=env)
+        th.join(timeout=120)
+        p.wait(timeout=30)
+        assert result.get("state") == "FINISHED", result.get("error")
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("probe_job_mod", None)

@@ -1,0 +1,64 @@
+"""History server: terminal jobs archived by the Dispatcher and served
+after the cluster is gone (``HistoryServer`` + ``FsJobArchivist`` analog)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from flink_tpu.cluster.coordination import StandaloneSessionCluster
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.rest.history import HistoryServer, archive_job, list_archived
+
+
+def _plan(n=5_000, keys=7, name="hist-job"):
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    (env.from_collection(columns={"k": np.arange(n) % keys,
+                                  "v": np.ones(n)}, batch_size=256)
+        .key_by("k").sum("v").collect())
+    return env.get_stream_graph(name).to_plan()
+
+
+def test_archive_and_list(tmp_path):
+    d = str(tmp_path / "archive")
+    archive_job(d, "job-0001", {"state": "FINISHED", "name": "a"})
+    archive_job(d, "job-0002", {"state": "FAILED", "name": "b"})
+    jobs = list_archived(d)
+    assert {j["id"] for j in jobs} == {"job-0001", "job-0002"}
+    assert all("archived_at" in j for j in jobs)
+
+
+def test_dispatcher_archives_finished_jobs(tmp_path):
+    d = str(tmp_path / "archive")
+    cluster = StandaloneSessionCluster(num_task_executors=1,
+                                       slots_per_executor=1, history_dir=d)
+    try:
+        client = cluster.client()
+        job_id = client.submit(_plan(), parallelism=1)
+        client.wait_for_completion(job_id, timeout_s=120)
+        # archiving runs async on the dispatcher main thread
+        deadline = time.time() + 10
+        while time.time() < deadline and not list_archived(d):
+            time.sleep(0.05)
+        jobs = list_archived(d)
+        assert len(jobs) == 1 and jobs[0]["id"] == job_id
+    finally:
+        cluster.shutdown()
+
+    # the cluster is GONE; the history server still answers
+    hs = HistoryServer(d).start()
+    try:
+        with urllib.request.urlopen(f"{hs.url}/jobs", timeout=10) as r:
+            listing = json.loads(r.read())
+        assert listing["jobs"][0]["id"] == job_id
+        with urllib.request.urlopen(f"{hs.url}/jobs/{job_id}",
+                                    timeout=10) as r:
+            detail = json.loads(r.read())
+        assert detail["id"] == job_id
+        with urllib.request.urlopen(f"{hs.url}/overview", timeout=10) as r:
+            ov = json.loads(r.read())
+        assert ov["jobs_total"] == 1
+    finally:
+        hs.stop()
