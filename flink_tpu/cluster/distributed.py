@@ -468,9 +468,11 @@ class ProcessCluster:
                 # token) must neither consume a registration slot nor fail
                 # the job — drop it and keep accepting
                 try:
+                    # timeout BEFORE the TLS handshake: a silent connection
+                    # must not park the accept loop inside wrap_socket
+                    conn.settimeout(30)
                     if server_ctx is not None:
                         conn = server_ctx.wrap_socket(conn, server_side=True)
-                    conn.settimeout(30)
                     nonce = os.urandom(32) if need_token else None
                     _send_msg(conn, ("challenge", nonce), tmp_lock)
                     msg = _recv_msg(conn)
@@ -479,7 +481,9 @@ class ProcessCluster:
                         conn.close()
                         continue
                     _, idx, host, port, mac = msg
-                    if not isinstance(idx, int) or idx in addresses:
+                    if not isinstance(idx, int) \
+                            or not 0 <= idx < self.n_workers \
+                            or idx in addresses:
                         conn.close()
                         continue
                     if need_token and not self.security.verify(

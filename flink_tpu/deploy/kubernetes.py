@@ -105,6 +105,20 @@ def render_job_cluster(name: str, image: str, job: str, n_workers: int = 2,
     if tpu_resource:
         worker_container["resources"] = {"limits": dict(tpu_resource)}
 
+    worker_svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{name}-worker", "namespace": namespace,
+                     "labels": labels},
+        "spec": {
+            # governing headless Service of the StatefulSet: gives workers
+            # stable per-pod DNS ({name}-worker-0.{name}-worker...)
+            "clusterIP": "None",
+            "selector": {**labels, "component": "worker"},
+            "ports": [{"name": "data", "port": 6124}],
+        },
+    }
+
     workers = {
         "apiVersion": "apps/v1",
         "kind": "StatefulSet",
@@ -120,7 +134,7 @@ def render_job_cluster(name: str, image: str, job: str, n_workers: int = 2,
             },
         },
     }
-    return [svc, coordinator, workers]
+    return [svc, worker_svc, coordinator, workers]
 
 
 def to_yaml(manifests: List[Dict[str, Any]]) -> str:

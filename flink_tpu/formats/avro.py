@@ -266,53 +266,53 @@ def write_avro(batches: Iterable[RecordBatch], path: str,
 
 
 def read_avro(path: str, batch_size: int = 8192):
-    """Yield ``RecordBatch``es from an Avro object container file (one per
-    file block, re-chunked to ``batch_size``)."""
+    """Yield ``RecordBatch``es from an Avro object container file,
+    streaming block by block (sync markers self-delimit blocks, so memory
+    stays bounded by one block + the pending batch)."""
     with open(path, "rb") as f:
-        data = f.read()
-    buf = io.BytesIO(data)
-    if buf.read(4) != _MAGIC:
-        raise ValueError(f"{path}: not an Avro object container file")
-    meta: Dict[str, bytes] = {}
-    n = read_long(buf)
-    while n != 0:
-        if n < 0:  # negative count: size precedes (spec allows)
-            read_long(buf)
-            n = -n
-        for _ in range(n):
-            k = read_string(buf)
-            meta[k] = read_bytes(buf)
-        n = read_long(buf)
-    schema = json.loads(meta["avro.schema"].decode())
-    codec = meta.get("avro.codec", b"null").decode()
-    if codec not in ("null", "deflate"):
-        raise ValueError(f"unsupported codec {codec!r}")
-    sync = buf.read(16)
-    fields = [(fd["name"], *_field_type(fd["type"]))
-              for fd in schema.get("fields", [])]
+        if f.read(4) != _MAGIC:
+            raise ValueError(f"{path}: not an Avro object container file")
+        meta: Dict[str, bytes] = {}
+        n = read_long(f)
+        while n != 0:
+            if n < 0:  # negative count: size precedes (spec allows)
+                read_long(f)
+                n = -n
+            for _ in range(n):
+                k = read_string(f)
+                meta[k] = read_bytes(f)
+            n = read_long(f)
+        schema = json.loads(meta["avro.schema"].decode())
+        codec = meta.get("avro.codec", b"null").decode()
+        if codec not in ("null", "deflate"):
+            raise ValueError(f"unsupported codec {codec!r}")
+        sync = f.read(16)
+        fields = [(fd["name"], *_field_type(fd["type"]))
+                  for fd in schema.get("fields", [])]
 
-    pending: List[Dict[str, Any]] = []
-    while True:
-        head = buf.read(1)
-        if not head:
-            break
-        buf.seek(-1, io.SEEK_CUR)
-        n_rows = read_long(buf)
-        payload = read_bytes(buf)
-        if buf.read(16) != sync:
-            raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
-        if codec == "deflate":
-            payload = zlib.decompress(payload, wbits=-15)
-        blk = io.BytesIO(payload)
-        for _ in range(n_rows):
-            row = {name: _decode_value(blk, base, nullable)
-                   for name, base, nullable in fields}
-            pending.append(row)
-            if len(pending) >= batch_size:
-                yield _rows_to_batch(pending, fields)
-                pending = []
-    if pending:
-        yield _rows_to_batch(pending, fields)
+        pending: List[Dict[str, Any]] = []
+        while True:
+            head = f.read(1)
+            if not head:
+                break
+            f.seek(-1, io.SEEK_CUR)
+            n_rows = read_long(f)
+            payload = read_bytes(f)
+            if f.read(16) != sync:
+                raise ValueError(
+                    f"{path}: sync marker mismatch (corrupt block)")
+            if codec == "deflate":
+                payload = zlib.decompress(payload, wbits=-15)
+            blk = io.BytesIO(payload)
+            for _ in range(n_rows):
+                row = {name: _decode_value(blk, base, nullable)
+                       for name, base, nullable in fields}
+                pending.append(row)
+                if len(pending) >= batch_size:
+                    yield _rows_to_batch(pending, fields)
+                    pending = []
+        if pending:
+            yield _rows_to_batch(pending, fields)
 
 
 def _rows_to_batch(rows: List[Dict[str, Any]],

@@ -108,6 +108,18 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ring_pop.argtypes = [vp, u8p, i64]
     lib.ring_destroy.restype = None
     lib.ring_destroy.argtypes = [vp]
+    lib.keydict_create.restype = vp
+    lib.keydict_create.argtypes = [i64]
+    lib.keydict_destroy.restype = None
+    lib.keydict_destroy.argtypes = [vp]
+    lib.keydict_size.restype = i64
+    lib.keydict_size.argtypes = [vp]
+    lib.keydict_lookup_or_insert.restype = None
+    lib.keydict_lookup_or_insert.argtypes = [vp, vp, i64, vp]
+    lib.keydict_lookup.restype = None
+    lib.keydict_lookup.argtypes = [vp, vp, i64, vp]
+    lib.keydict_reverse.restype = None
+    lib.keydict_reverse.argtypes = [vp, vp]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -624,3 +624,102 @@ API i64 ring_pop(void* h, u8* out, i64 cap) {
 }
 
 API void ring_destroy(void* h) { delete (Ring*)h; }
+
+// ---------------------------------------------------------------------------
+// keydict: vectorized int64 key -> dense int32 slot open-addressing table.
+// The native drop-in for flink_tpu/state/keyindex.py (KeyIndex): the batched
+// analog of the reference's per-record CopyOnWriteStateMap hash probe —
+// one C call maps a whole micro-batch of keys to dense HBM row ids.
+// ---------------------------------------------------------------------------
+
+static inline u64 kd_mix64(u64 x) {
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+struct KeyDict {
+  u64 cap = 0, mask = 0;
+  std::vector<i64> keys;    // bucket -> key
+  std::vector<i32> slots;   // bucket -> slot id, -1 empty
+  std::vector<i64> reverse; // slot -> key
+  i64 n = 0;
+
+  void init(u64 c) {
+    cap = 1;
+    while (cap < c) cap <<= 1;
+    mask = cap - 1;
+    keys.assign(cap, 0);
+    slots.assign(cap, -1);
+  }
+
+  inline i32 find_or_insert(i64 key) {
+    u64 b = kd_mix64((u64)key) & mask;
+    for (;;) {
+      i32 s = slots[b];
+      if (s < 0) {
+        slots[b] = (i32)n;
+        keys[b] = key;
+        reverse.push_back(key);
+        return (i32)n++;
+      }
+      if (keys[b] == key) return s;
+      b = (b + 1) & mask;
+    }
+  }
+
+  inline i32 find(i64 key) const {
+    u64 b = kd_mix64((u64)key) & mask;
+    for (;;) {
+      i32 s = slots[b];
+      if (s < 0) return -1;
+      if (keys[b] == key) return s;
+      b = (b + 1) & mask;
+    }
+  }
+
+  void grow_to(u64 c) {
+    init(c);
+    for (i64 i = 0; i < n; i++) {
+      u64 b = kd_mix64((u64)reverse[i]) & mask;
+      while (slots[b] >= 0) b = (b + 1) & mask;
+      slots[b] = (i32)i;
+      keys[b] = reverse[i];
+    }
+  }
+
+  inline void reserve(i64 incoming) {
+    // worst case every incoming key is new; keep load factor <= 0.5
+    if ((u64)(n + incoming) * 2 > cap) {
+      u64 c = cap;
+      while ((u64)(n + incoming) * 2 > c) c <<= 1;
+      grow_to(c);
+    }
+  }
+};
+
+API void* keydict_create(i64 initial_cap) {
+  KeyDict* d = new KeyDict();
+  d->init((u64)(initial_cap > 16 ? initial_cap : 16));
+  return d;
+}
+
+API void keydict_destroy(void* h) { delete (KeyDict*)h; }
+
+API i64 keydict_size(void* h) { return ((KeyDict*)h)->n; }
+
+API void keydict_lookup_or_insert(void* h, const i64* ks, i64 m, i32* out) {
+  KeyDict* d = (KeyDict*)h;
+  d->reserve(m);
+  for (i64 i = 0; i < m; i++) out[i] = d->find_or_insert(ks[i]);
+}
+
+API void keydict_lookup(void* h, const i64* ks, i64 m, i32* out) {
+  KeyDict* d = (KeyDict*)h;
+  for (i64 i = 0; i < m; i++) out[i] = d->find(ks[i]);
+}
+
+API void keydict_reverse(void* h, i64* out) {
+  KeyDict* d = (KeyDict*)h;
+  std::memcpy(out, d->reverse.data(), (size_t)d->n * sizeof(i64));
+}

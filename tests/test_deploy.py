@@ -22,9 +22,12 @@ def test_manifest_rendering_shapes():
                             tpu_resource={"google.com/tpu": 8},
                             env={"EXTRA": "1"})
     kinds = [m["kind"] for m in ms]
-    assert kinds == ["Service", "Job", "StatefulSet"]
-    svc, job, sts = ms
+    assert kinds == ["Service", "Service", "Job", "StatefulSet"]
+    svc, wsvc, job, sts = ms
     assert svc["spec"]["selector"]["component"] == "coordinator"
+    # governing headless Service of the StatefulSet (stable per-pod DNS)
+    assert wsvc["metadata"]["name"] == sts["spec"]["serviceName"]
+    assert wsvc["spec"]["clusterIP"] == "None"
     cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--workers" in cmd and "3" in cmd and "--checkpoint-dir" in cmd
     worker = sts["spec"]["template"]["spec"]["containers"][0]
@@ -35,7 +38,7 @@ def test_manifest_rendering_shapes():
     text = to_yaml(ms)
     import yaml
     docs = list(yaml.safe_load_all(text))
-    assert len(docs) == 3 and docs[0]["kind"] == "Service"
+    assert len(docs) == 4 and docs[0]["kind"] == "Service"
 
 
 def test_external_workers_register_and_run(tmp_path):
