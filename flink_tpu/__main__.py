@@ -60,6 +60,29 @@ def _cmd_sql(args) -> int:
     return 0
 
 
+def _cmd_repl(args) -> int:
+    """Interactive shell with a preloaded environment — the Scala REPL
+    (``FlinkShell.scala``) analog, Python-native."""
+    import code
+
+    import numpy as np
+
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.sql.table_env import TableEnvironment
+
+    env = StreamExecutionEnvironment()
+    tenv = TableEnvironment()
+    banner = ("flink-tpu shell\n"
+              "  env  = StreamExecutionEnvironment()  "
+              "(env.from_collection(...).key_by(...)...)\n"
+              "  tenv = TableEnvironment()            "
+              "(tenv.register_collection / execute_sql)\n"
+              "  np   = numpy")
+    code.interact(banner=banner, local={"env": env, "tenv": tenv, "np": np},
+                  exitmsg="")
+    return 0
+
+
 def _cmd_rest(args) -> int:
     """Cluster commands against a running REST endpoint
     (``flink list/cancel/savepoint`` parity)."""
@@ -167,6 +190,9 @@ def main(argv=None) -> int:
     ps.set_defaults(fn=_cmd_sql)
     pi = sub.add_parser("info", help="environment info")
     pi.set_defaults(fn=_cmd_info)
+    prl = sub.add_parser("repl", help="interactive shell with a preloaded "
+                         "environment (Scala-shell analog)")
+    prl.set_defaults(fn=_cmd_repl)
     pw = sub.add_parser(
         "worker", help="TaskExecutor worker process (spawned by "
         "cluster.distributed.ProcessCluster)")
