@@ -229,6 +229,25 @@ class DataStream:
                        partitioning=Partitioning.BROADCAST, chainable=False)
         return DataStream(self.env, t)
 
+    def shuffle(self) -> "DataStream":
+        """Uniform-random redistribution (``ShufflePartitioner`` analog)."""
+        t = self._then("shuffle", _identity_operator_factory("shuffle"),
+                       partitioning=Partitioning.SHUFFLE, chainable=False)
+        return DataStream(self.env, t)
+
+    def rescale(self) -> "DataStream":
+        """Round-robin within the producer's local consumer group
+        (``RescalePartitioner`` analog)."""
+        t = self._then("rescale", _identity_operator_factory("rescale"),
+                       partitioning=Partitioning.RESCALE, chainable=False)
+        return DataStream(self.env, t)
+
+    def global_(self) -> "DataStream":
+        """Route everything to subtask 0 (``GlobalPartitioner`` analog)."""
+        t = self._then("global", _identity_operator_factory("global"),
+                       partitioning=Partitioning.GLOBAL, chainable=False)
+        return DataStream(self.env, t)
+
     def iterate(self, max_wait_ms: int = 200) -> "IterativeStream":
         """Streaming iteration (``DataStream.iterate`` analog): returns a
         stream that unions this one with a feedback edge; wire the loop body
@@ -471,6 +490,22 @@ class KeyedStream(DataStream):
         """``a.interval_join(b).between(lo, hi).process()`` (IntervalJoin)."""
         return IntervalJoinBuilder(self.env, self, other)
 
+    def count_window(self, size: int, slide: Optional[int] = None) -> "WindowedStream":
+        """``countWindow(size)`` analog: GlobalWindows + purging
+        CountTrigger — fires every ``size`` elements per key with that
+        batch's aggregate, then clears."""
+        if slide is not None:
+            raise NotImplementedError(
+                "count_window(size, slide) (CountEvictor over GlobalWindows)"
+                " is not supported; use count_window(size) or a sliding "
+                "time window with CountTrigger(purge=False)")
+        from flink_tpu.windowing.assigners import GlobalWindows
+        from flink_tpu.windowing.triggers import CountTrigger
+
+        assigner = GlobalWindows.create()
+        assigner.is_event_time = False  # counts, not timestamps, drive fires
+        return self.window(assigner).trigger(CountTrigger.of(size))
+
     def window(self, assigner: WindowAssigner) -> "WindowedStream":
         return WindowedStream(self, assigner)
 
@@ -539,6 +574,15 @@ class WindowedStream:
         self._allowed_lateness = ms
         return self
 
+    def side_output_late_data(self, tag) -> "WindowedStream":
+        """Route beyond-lateness records to a side output instead of
+        dropping them (``sideOutputLateData`` analog); read them downstream
+        with ``get_side_output(tag)``."""
+        from flink_tpu.core.batch import OutputTag
+
+        self._late_tag = tag.name if isinstance(tag, OutputTag) else str(tag)
+        return self
+
     def evictor(self, evictor) -> "WindowedStream":
         """Raw-element window path with eviction (``evictor(...)`` analog);
         terminal op becomes ``apply``."""
@@ -553,6 +597,9 @@ class WindowedStream:
 
         if self._trigger is not None:
             raise ValueError("custom triggers are not supported on the "
+                             "raw-element apply() path yet; use aggregate()")
+        if getattr(self, "_late_tag", None) is not None:
+            raise ValueError("side_output_late_data is not supported on the "
                              "raw-element apply() path yet; use aggregate()")
         assigner = self.assigner
         key_col = self.keyed.key_column
@@ -575,6 +622,7 @@ class WindowedStream:
                   name: str = "window-agg") -> DataStream:
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
+        late_tag = getattr(self, "_late_tag", None)
 
         from flink_tpu.windowing.assigners import SessionGap
         if isinstance(assigner, SessionGap):
@@ -582,6 +630,9 @@ class WindowedStream:
                 raise ValueError(
                     "custom triggers are not supported on session windows "
                     "(sessions fire when the gap closes); remove .trigger()")
+            if late_tag is not None:
+                raise ValueError("side_output_late_data is not supported on "
+                                 "session windows yet")
             from flink_tpu.operators.session_window import SessionWindowOperator
 
             def factory():
@@ -596,7 +647,8 @@ class WindowedStream:
                     assigner=assigner, agg=agg, key_column=keyed.key_column,
                     value_column=value_column, value_selector=value_selector,
                     allowed_lateness_ms=lateness, trigger=trigger,
-                    output_column=output_column, name=name)
+                    output_column=output_column, name=name,
+                    late_output_tag=late_tag)
 
         t = keyed._then(name, factory)
         return DataStream(keyed.env, t)

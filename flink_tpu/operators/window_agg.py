@@ -44,7 +44,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flink_tpu.core.batch import LONG_MIN, RecordBatch, StreamElement, Watermark
+from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
+                                  TaggedBatch, Watermark)
 from flink_tpu.core.functions import AggregateFunction, RuntimeContext
 from flink_tpu.core import keygroups
 from flink_tpu.operators.base import StreamOperator
@@ -150,7 +151,11 @@ class WindowAggOperator(StreamOperator):
         name: str = "window-agg",
         sharding=None,
         async_fire: bool = False,
+        late_output_tag: Optional[str] = None,
     ):
+        #: sideOutputLateData: beyond-lateness records emit as TaggedBatch
+        #: on this tag instead of being dropped (they are still counted)
+        self.late_output_tag = late_output_tag
         #: opt-in: window emissions materialize on the NEXT operator call
         #: (downloads overlap subsequent device work).  Terminal-sink
         #: pipelines only — downstream event-time operators would see fired
@@ -626,7 +631,15 @@ class WindowAggOperator(StreamOperator):
         if self.pane_base is not None:
             live = panes >= self.pane_base
             if not live.all():
-                self.late_dropped += int(np.count_nonzero(~live))
+                if self.late_output_tag is not None:
+                    # sideOutputLateData: rows are shipped, NOT dropped —
+                    # the drop counter must stay at the reference semantics
+                    # (WindowOperator.java:437 increments only when no side
+                    # output consumes the element)
+                    pending = list(pending) + [TaggedBatch(
+                        self.late_output_tag, batch.select(~live))]
+                else:
+                    self.late_dropped += int(np.count_nonzero(~live))
                 batch = batch.select(live)
                 if len(batch) == 0:
                     return pending

@@ -179,3 +179,63 @@ def test_watermarks_flow_to_sink():
     env.execute()
     assert 899 in wms  # batch watermark: max_ts - ooo - 1
     assert wms[-1] > 10 ** 15  # MAX_WATERMARK at end of input
+
+
+def test_count_window():
+    """countWindow(n): fires every n elements per key with that batch's
+    aggregate, then purges (GlobalWindows + purging CountTrigger)."""
+    env = StreamExecutionEnvironment()
+    n = 10
+    rows = (env.from_collection(
+        columns={"k": np.zeros(n, np.int64),
+                 "v": np.arange(1, n + 1, dtype=np.float64)}, batch_size=5)
+        .key_by("k").count_window(5).sum("v").execute_and_collect())
+    assert [r["v"] for r in rows] == [15.0, 40.0]   # 1..5, 6..10
+    import pytest as _pytest
+    env2 = StreamExecutionEnvironment()
+    with _pytest.raises(NotImplementedError):
+        (env2.from_collection(columns={"k": np.zeros(1, np.int64),
+                                       "v": np.zeros(1)})
+         .key_by("k").count_window(5, 2))
+
+
+def test_explicit_partitioning_methods():
+    env = StreamExecutionEnvironment()
+    n = 100
+    for maker in ("shuffle", "rescale", "global_"):
+        s = env.from_collection(columns={"v": np.arange(n, dtype=np.float64)},
+                                batch_size=16)
+        s = getattr(s, maker)()
+        total = sum(r["v"] for r in s.execute_and_collect(f"{maker}-job"))
+        assert total == float(n * (n - 1) / 2), maker
+        env = StreamExecutionEnvironment()
+
+
+def test_side_output_late_data():
+    """Beyond-lateness records route to a side output (sideOutputLateData)
+    instead of being silently dropped."""
+    from flink_tpu.core.batch import OutputTag
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    env = StreamExecutionEnvironment()
+    tag = OutputTag("late")
+    # main: ts 0..9 then watermark advances past window 0's cleanup;
+    # a straggler at ts=1 afterwards is beyond lateness
+    ks = np.zeros(12, np.int64)
+    vs = np.ones(12)
+    ts = np.array([100, 200, 300, 400, 5100, 5200, 5300, 5400,
+                   11_000, 12_000, 13_000, 1], np.int64)   # last row LATE
+    win = (env.from_collection(columns={"k": ks, "v": vs, "t": ts},
+                               batch_size=4)
+           .assign_timestamps_and_watermarks(0, timestamp_column="t")
+           .key_by("k")
+           .window(TumblingEventTimeWindows.of(5000)))
+    agg = win.side_output_late_data(tag).sum("v")
+    late_rows = agg.get_side_output(tag)
+    late_sink = late_rows.collect()
+    main_sink = agg.collect()
+    env.execute("late-side-output")
+    lr = late_sink.rows()
+    assert len(lr) == 1 and lr[0]["t"] == 1
+    # the main output still fired the on-time windows
+    assert sum(r["v"] for r in main_sink.rows()) >= 8.0
