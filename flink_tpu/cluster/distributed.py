@@ -392,7 +392,8 @@ class ProcessCluster:
                  checkpoint_storage=None, checkpoint_interval_ms: int = 0,
                  extra_sys_path: Tuple[str, ...] = (), security=None,
                  spawn: bool = True, bind_host: str = "127.0.0.1",
-                 listen_port: int = 0):
+                 listen_port: int = 0, restart_attempts: int = 0,
+                 restart_delay_ms: int = 500):
         self.job = job
         self.n_workers = n_workers
         self.checkpoint_storage = checkpoint_storage
@@ -407,23 +408,65 @@ class ProcessCluster:
         self.spawn = spawn
         self.bind_host = bind_host
         self.listen_port = listen_port
+        #: worker-loss recovery (spawn=True only): a failed execution is
+        #: retried up to this many times, restoring from the LATEST
+        #: completed checkpoint — the full-restart failover strategy (the
+        #: all-to-all edges make the whole job one pipelined region)
+        self.restart_attempts = restart_attempts
+        self.restart_delay_ms = restart_delay_ms
         self._lock = threading.Lock()
+        self._next_cid = 1
+        self._completed_ids: List[int] = []
+        self._counts: Dict[str, int] = {}
+        self._reset_attempt()
+
+    def _reset_attempt(self) -> None:
+        """Fresh per-execution state (checkpoint ids keep increasing)."""
+        #: generation guard: event threads of a PREVIOUS attempt (late EOFs
+        #: from killed workers) must not touch this attempt's state
+        self._gen = getattr(self, "_gen", 0) + 1
         self._states: Dict[Tuple[str, int], str] = {}
         self._finals: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._rows: Dict[Tuple[str, int], List[Dict[str, Any]]] = {}
         self._pending: Optional[_Pending] = None
-        self._completed_ids: List[int] = []
-        self._next_cid = 1
         self._failed: Optional[str] = None
         self._done_workers: set = set()
         self._all_done = threading.Event()
         self._conns: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
-        self._counts: Dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def run(self, timeout_s: float = 180.0,
             restore: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Execute, restarting from the latest completed checkpoint on
+        failure (up to ``restart_attempts`` times, spawned workers only).
+
+        Collect-sink rows come from the FINAL execution only: a failed
+        attempt never shipped its buffered collect rows, and the restored
+        sources resume at the checkpoint — collect() is a debugging sink
+        under failover; exactly-once delivery needs the transactional
+        sinks (``connectors/sinks.py``)."""
+        original_restore = restore
+        attempt = 0
+        while True:
+            if attempt > 0:
+                self._reset_attempt()
+                latest = (self.checkpoint_storage.load_latest()
+                          if self.checkpoint_storage is not None else None)
+                # no checkpoint completed yet: fall back to the restore the
+                # CALLER supplied (a savepoint must not silently drop)
+                restore = latest or original_restore
+            res = self._run_once(timeout_s, restore, attempt)
+            res["attempts"] = attempt + 1
+            if res["state"] == "FINISHED" or attempt >= self.restart_attempts \
+                    or not self.spawn:
+                return res
+            attempt += 1
+            time.sleep(self.restart_delay_ms / 1000.0)
+
+    def _run_once(self, timeout_s: float,
+                  restore: Optional[Dict[str, Any]],
+                  attempt: int = 0) -> Dict[str, Any]:
         plan = build_plan(self.job)
         self._counts, _ = subtask_counts_of(plan)
         all_subtasks = {(uid, i) for uid, n in self._counts.items()
@@ -445,6 +488,8 @@ class ProcessCluster:
                     env["FLINK_TPU_SSL_CA"] = self.security.ca_path
                 if self.security.auth_token:
                     env["FLINK_TPU_AUTH_TOKEN"] = self.security.auth_token
+            # failure-injection hooks / logs can key on the execution attempt
+            env["FLINK_TPU_ATTEMPT"] = str(attempt)
             procs = [subprocess.Popen(
                 [sys.executable, "-m", "flink_tpu", "worker",
                  "--index", str(i), "--workers", str(self.n_workers),
@@ -512,8 +557,11 @@ class ProcessCluster:
                 self._to_worker(idx, ("deploy", addresses, restore))
             ticker = None
             if self.checkpoint_interval_ms > 0:
-                ticker = threading.Thread(target=self._checkpoint_loop,
-                                          args=(all_subtasks,), daemon=True)
+                # the ticker loops on ITS attempt's event (self._all_done
+                # is replaced between restart attempts)
+                ticker = threading.Thread(
+                    target=self._checkpoint_loop,
+                    args=(all_subtasks, self._all_done), daemon=True)
                 ticker.start()
             if not self._all_done.wait(timeout=timeout_s):
                 self._failed = self._failed or "timeout"
@@ -531,10 +579,22 @@ class ProcessCluster:
             return {"state": state, "error": self._failed, "rows": rows,
                     "completed_checkpoints": list(self._completed_ids)}
         finally:
+            self._all_done.set()   # stop this attempt's checkpoint ticker
             srv.close()
+            # close control connections so stale _serve_worker threads
+            # unblock, and reap every child before a potential retry
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
 
     def _to_worker(self, idx: int, msg) -> None:
         try:
@@ -544,12 +604,18 @@ class ProcessCluster:
 
     # -- per-worker event loop --------------------------------------------
     def _serve_worker(self, idx: int, conn: socket.socket) -> None:
+        gen = self._gen
         while True:
-            msg = _recv_msg(conn)
+            try:
+                msg = _recv_msg(conn)
+            except OSError:
+                msg = None
+            if gen != self._gen:
+                return  # a restart superseded this attempt: stale thread
             if msg is None:
                 with self._lock:
-                    if idx not in self._done_workers and \
-                            self._failed is None:
+                    if gen == self._gen and idx not in self._done_workers \
+                            and self._failed is None:
                         self._failed = f"worker {idx} died"
                         self._all_done.set()
                 return
@@ -627,9 +693,9 @@ class ProcessCluster:
         for idx in self._conns:
             self._to_worker(idx, ("notify", p.cid))
 
-    def _checkpoint_loop(self, all_subtasks: set) -> None:
-        while not self._all_done.is_set():
+    def _checkpoint_loop(self, all_subtasks: set, done: threading.Event) -> None:
+        while not done.is_set():
             time.sleep(self.checkpoint_interval_ms / 1000.0)
-            if self._all_done.is_set():
+            if done.is_set():
                 return
             self.trigger_checkpoint(all_subtasks)

@@ -154,7 +154,8 @@ class WindowAggOperator(StreamOperator):
         late_output_tag: Optional[str] = None,
     ):
         #: sideOutputLateData: beyond-lateness records emit as TaggedBatch
-        #: on this tag instead of being dropped (they are still counted)
+        #: on this tag instead of being dropped; the drop counter does NOT
+        #: move for side-output rows (reference semantics)
         self.late_output_tag = late_output_tag
         #: opt-in: window emissions materialize on the NEXT operator call
         #: (downloads overlap subsequent device work).  Terminal-sink

@@ -128,3 +128,59 @@ def test_two_process_checkpoint_and_restore(slow_job_path, tmp_path):
     expect = {i: float(len(range(i, n, k))) for i in range(k)}
     # exactly-once across restore: final per-key totals identical
     assert totals == expect
+
+
+def test_worker_crash_restart_from_checkpoint(tmp_path):
+    """Worker-loss recovery: attempt 0 kills one worker mid-run (poison
+    pill); the coordinator restarts every worker from the LATEST completed
+    checkpoint and the job completes with exactly-once keyed totals."""
+    import textwrap
+
+    mod = tmp_path / "crash_job_mod.py"
+    mod.write_text(textwrap.dedent('''
+        import os
+        import numpy as np
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+        N = 60_000
+        K = 11
+
+        def poison(cols):
+            # attempt 0 dies once records past the midpoint flow; later
+            # attempts (restored from a checkpoint) run clean
+            if os.environ.get("FLINK_TPU_ATTEMPT") == "0" and \\
+                    float(np.max(cols["v_total"])) > N // (2 * K):
+                os.kill(os.getpid(), 9)   # hard worker loss, no cleanup
+            return cols
+
+        def build():
+            env = StreamExecutionEnvironment()
+            env.set_parallelism(2)
+            keys = (np.arange(N) % K).astype(np.int64)
+            (env.from_collection(columns={"k": keys, "v": np.ones(N)},
+                                 batch_size=128)
+                .key_by("k").sum("v", output_column="v_total")
+                .map(poison)
+                .collect())
+            return env.get_stream_graph("crash-job")
+    '''))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        store = FileCheckpointStorage(str(tmp_path / "ckpt"))
+        pc = ProcessCluster("crash_job_mod:build", n_workers=2,
+                            checkpoint_storage=store,
+                            checkpoint_interval_ms=100,
+                            restart_attempts=2,
+                            extra_sys_path=(str(tmp_path),))
+        res = pc.run(timeout_s=300)
+        assert res["state"] == "FINISHED", res["error"]
+        assert res["attempts"] >= 2, "the poison pill must have fired"
+        totals = {}
+        for r in res["rows"]:
+            totals[r["k"]] = max(r["v_total"], totals.get(r["k"], 0.0))
+        n, k = 60_000, 11
+        expect = {i: float(len(range(i, n, k))) for i in range(k)}
+        assert totals == expect
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("crash_job_mod", None)
