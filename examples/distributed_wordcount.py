@@ -1,0 +1,51 @@
+"""Multi-process cluster example: keyed sum across worker processes with
+periodic checkpoints and automatic restart on worker loss.
+
+Run:  python examples/distributed_wordcount.py
+The job ships as this module's ``build`` function (the jar analog): every
+worker imports it and deploys its assigned subtask slice; cross-process
+edges ride credit-controlled TCP channels.
+"""
+
+import numpy as np
+
+
+def build():
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    n = 100_000
+    words = (np.arange(n) % 1000).astype(np.int64)   # 1000 distinct "words"
+    (env.from_collection(columns={"word": words, "one": np.ones(n)},
+                         batch_size=1024)
+        .key_by("word")
+        .sum("one", output_column="count")
+        .collect())
+    return env.get_stream_graph("distributed-wordcount")
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))  # repo root (flink_tpu)
+    sys.path.insert(0, here)                   # this module (job shipping)
+    from flink_tpu.cluster.distributed import ProcessCluster
+    from flink_tpu.runtime.checkpoint.storage import FileCheckpointStorage
+
+    store = FileCheckpointStorage(tempfile.mkdtemp(prefix="flink-tpu-ckpt-"))
+    pc = ProcessCluster(
+        "distributed_wordcount:build", n_workers=2,
+        checkpoint_storage=store, checkpoint_interval_ms=500,
+        restart_attempts=2,
+        extra_sys_path=(here, os.path.dirname(here)))
+    res = pc.run(timeout_s=300)
+    final = {}
+    for r in res["rows"]:
+        final[r["word"]] = r["count"]
+    print(f"state={res['state']} attempts={res['attempts']} "
+          f"checkpoints={len(res['completed_checkpoints'])} "
+          f"words={len(final)} total={sum(final.values()):.0f}")

@@ -125,6 +125,14 @@ def _cmd_rest(args) -> int:
             return 0
         print(body.get("status", body.get("error")))
         return 1
+    if args.cmd == "stop":
+        # stop-with-savepoint (`flink stop` analog)
+        st, body = req(f"/jobs/{args.job_id}/stop", "POST")
+        if body.get("status") == "stopped":
+            print(f"stopped: checkpoint {body.get('checkpoint_id')}")
+            return 0
+        print(body.get("status", body.get("error")))
+        return 1
     return 2
 
 
@@ -216,7 +224,8 @@ def main(argv=None) -> int:
     pco.add_argument("--timeout", type=float, default=86400.0)
     pco.set_defaults(fn=_cmd_coordinate)
     for name, needs_job in (("list", False), ("status", True),
-                            ("cancel", True), ("savepoint", True)):
+                            ("cancel", True), ("savepoint", True),
+                            ("stop", True)):
         pc = sub.add_parser(name, help=f"{name} jobs via the REST endpoint")
         pc.add_argument("--url", required=True,
                         help="REST endpoint, e.g. http://127.0.0.1:8081")

@@ -507,43 +507,24 @@ class ProcessCluster:
             addresses: Dict[int, Tuple[str, int]] = {}
             hello_conns: List[Tuple[int, socket.socket]] = []
             tmp_lock = threading.Lock()
-            while len(hello_conns) < self.n_workers:
-                conn, _addr = srv.accept()
-                # a stray connection (readiness probe, port scan, wrong
-                # token) must neither consume a registration slot nor fail
-                # the job — drop it and keep accepting
-                try:
-                    # timeout BEFORE the TLS handshake: a silent connection
-                    # must not park the accept loop inside wrap_socket
-                    conn.settimeout(30)
-                    if server_ctx is not None:
-                        conn = server_ctx.wrap_socket(conn, server_side=True)
-                    nonce = os.urandom(32) if need_token else None
-                    _send_msg(conn, ("challenge", nonce), tmp_lock)
-                    msg = _recv_msg(conn)
-                    if not (isinstance(msg, tuple) and len(msg) == 5
-                            and msg[0] == "hello"):
-                        conn.close()
-                        continue
-                    _, idx, host, port, mac = msg
-                    if not isinstance(idx, int) \
-                            or not 0 <= idx < self.n_workers \
-                            or idx in addresses:
-                        conn.close()
-                        continue
-                    if need_token and not self.security.verify(
-                            nonce, mac or b""):
-                        conn.close()
-                        continue
-                    conn.settimeout(None)
-                except (OSError, ValueError, pickle.UnpicklingError):
+            try:
+                self._register_workers(srv, server_ctx, need_token,
+                                       addresses, hello_conns, tmp_lock)
+            except socket.timeout:
+                # a worker that died before saying hello (startup crash)
+                # must yield a FAILED result the restart loop can retry,
+                # not an escaped exception
+                for _i, c in hello_conns:
                     try:
-                        conn.close()
+                        c.close()
                     except OSError:
                         pass
-                    continue
-                addresses[idx] = (host, port)
-                hello_conns.append((idx, conn))
+                self._failed = (f"worker registration timed out "
+                                f"({len(hello_conns)}/{self.n_workers} "
+                                f"registered)")
+                return {"state": "FAILED", "error": self._failed,
+                        "rows": [],
+                        "completed_checkpoints": list(self._completed_ids)}
             for idx, conn in hello_conns:
                 self._conns[idx] = conn
                 self._send_locks[idx] = threading.Lock()
@@ -595,6 +576,57 @@ class ProcessCluster:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     pass
+
+    def _register_workers(self, srv, server_ctx, need_token: bool,
+                          addresses: Dict[int, Tuple[str, int]],
+                          hello_conns: List[Tuple[int, socket.socket]],
+                          tmp_lock: threading.Lock) -> None:
+        """Accept until every worker said a valid hello; raises
+        ``socket.timeout`` if they don't arrive in time."""
+        while len(hello_conns) < self.n_workers:
+            conn, _addr = srv.accept()
+            # a stray connection (readiness probe, port scan, wrong token)
+            # must neither consume a registration slot nor fail the job —
+            # drop it and keep accepting
+            try:
+                # timeout BEFORE the TLS handshake: a silent connection
+                # must not park the accept loop inside wrap_socket
+                conn.settimeout(30)
+                if server_ctx is not None:
+                    conn = server_ctx.wrap_socket(conn, server_side=True)
+                nonce = os.urandom(32) if need_token else None
+                _send_msg(conn, ("challenge", nonce), tmp_lock)
+                msg = _recv_msg(conn)
+                if not (isinstance(msg, tuple) and len(msg) == 5
+                        and msg[0] == "hello"):
+                    conn.close()
+                    continue
+                _, idx, host, port, mac = msg
+                if not isinstance(idx, int) \
+                        or not 0 <= idx < self.n_workers \
+                        or idx in addresses:
+                    conn.close()
+                    continue
+                if need_token and not self.security.verify(
+                        nonce, mac or b""):
+                    conn.close()
+                    continue
+                conn.settimeout(None)
+            except socket.timeout:
+                # per-connection stall, NOT the accept timeout: drop it
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            except (OSError, ValueError, pickle.UnpicklingError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            addresses[idx] = (host, port)
+            hello_conns.append((idx, conn))
 
     def _to_worker(self, idx: int, msg) -> None:
         try:

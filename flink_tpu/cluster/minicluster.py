@@ -459,3 +459,20 @@ class MiniCluster(TaskListener):
                     return None
             time.sleep(0.005)
         return None
+
+    def stop_with_savepoint(self) -> Optional[int]:
+        """``flink stop`` analog: PAUSE the sources, take a savepoint, then
+        cancel — pausing first means no record is processed after the
+        savepoint's barrier, so the returned id restores a successor run
+        exactly where this one stopped (the reference suspends sources at
+        the stop barrier for the same reason).  None if no savepoint could
+        complete; sources resume in that case and the job keeps running."""
+        for t in self._source_tasks:
+            t._paused.set()
+        sp = self.savepoint()
+        if sp is None:
+            for t in self._source_tasks:
+                t._paused.clear()
+            return None
+        self.cancel()
+        return sp

@@ -171,6 +171,9 @@ class SourceSubtask(SubtaskBase):
                          listener)
         self.split = split
         self._emitted = 0          # elements pulled from the split so far
+        #: stop-with-savepoint: a paused source emits nothing but keeps
+        #: serving its command queue (so the savepoint barrier still flows)
+        self._paused = threading.Event()
         #: emit a LatencyMarker every N batches (0 = off); the markers ride
         #: the dataflow around user functions (``LatencyMarker.java:32``)
         self.latency_marker_interval = 0
@@ -187,6 +190,9 @@ class SourceSubtask(SubtaskBase):
         while True:
             self._check_cancel()
             self._drain_commands()
+            if self._paused.is_set():
+                time.sleep(0.002)  # paused: commands/cancel only
+                continue
             try:
                 el = next(it)
             except StopIteration:

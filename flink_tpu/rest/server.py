@@ -171,14 +171,21 @@ class RestServer:
                 return self._send({"error": f"unknown path {sub}"}, 404)
 
             def do_POST(self):  # noqa: N802
-                m = re.match(r"^/jobs/([^/]+)/savepoints$",
-                             self.path.rstrip("/"))
+                path = self.path.rstrip("/")
+                m = re.match(r"^/jobs/([^/]+)/(savepoints|stop)$", path)
                 if not m:
                     return self._send({"error": "not found"}, 404)
                 entry = self._job(m.group(1))
                 if entry is None:
                     return
                 _name, cluster = entry
+                if m.group(2) == "stop":
+                    # stop-with-savepoint (`flink stop` analog)
+                    sp = cluster.stop_with_savepoint()
+                    if sp is None:
+                        return self._send({"status": "failed"}, 409)
+                    return self._send({"status": "stopped",
+                                       "checkpoint_id": sp})
                 sp = cluster.savepoint()
                 if sp is None:
                     return self._send({"status": "failed"}, 409)
