@@ -19,6 +19,35 @@ import sys
 def _cmd_run(args) -> int:
     from flink_tpu.datastream.api import StreamExecutionEnvironment
 
+    if args.workers:
+        # multi-process execution: the job must be a module:function
+        # reference (the jar-shipping model of cluster.distributed)
+        if ":" not in args.script or args.script.endswith(".py"):
+            print("error: --workers needs a module:function job reference "
+                  "(e.g. my_job:build), importable in every worker",
+                  file=sys.stderr)
+            return 2
+        import os as _os
+
+        from flink_tpu.cluster.distributed import ProcessCluster
+        from flink_tpu.runtime.checkpoint.storage import FileCheckpointStorage
+
+        storage = (FileCheckpointStorage(args.checkpoint_dir)
+                   if args.checkpoint_dir else None)
+        pc = ProcessCluster(
+            args.script, n_workers=args.workers,
+            checkpoint_storage=storage,
+            checkpoint_interval_ms=args.checkpoint_interval,
+            restart_attempts=args.restart_attempts,
+            extra_sys_path=(_os.getcwd(),))
+        res = pc.run(timeout_s=86400.0)
+        print(f"job finished: {res['state']} (attempts={res['attempts']}, "
+              f"checkpoints={len(res['completed_checkpoints'])})")
+        if res["state"] != "FINISHED":
+            print(f"error: {res['error']}", file=sys.stderr)
+            return 1
+        return 0
+
     env = StreamExecutionEnvironment(parallelism=args.parallelism)
     ns = runpy.run_path(args.script, init_globals={"env": env})
     main = ns.get("main")
@@ -185,10 +214,18 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="flink_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
     pr = sub.add_parser("run", help="run a job script")
-    pr.add_argument("script")
+    pr.add_argument("script",
+                    help="a .py script (local/MiniCluster) or, with "
+                         "--workers, a module:function job reference")
     pr.add_argument("--parallelism", "-p", type=int, default=1)
     pr.add_argument("--cluster", action="store_true",
                     help="run on the in-process MiniCluster (parallel subtasks)")
+    pr.add_argument("--workers", type=int, default=0,
+                    help="run on a MULTI-PROCESS cluster with this many "
+                         "worker processes")
+    pr.add_argument("--checkpoint-dir", default=None)
+    pr.add_argument("--checkpoint-interval", type=int, default=0)
+    pr.add_argument("--restart-attempts", type=int, default=0)
     pr.set_defaults(fn=_cmd_run)
     ps = sub.add_parser("sql", help="run a SQL query")
     ps.add_argument("query")

@@ -497,8 +497,11 @@ class ProcessCluster:
                 env=env) for i in range(self.n_workers)]
         try:
             # spawned workers register within seconds; external (pod) workers
-            # may take as long as the cluster scheduler needs
-            srv.settimeout(90 if self.spawn else timeout_s)
+            # may take as long as the cluster scheduler needs.  The limit is
+            # an OVERALL deadline — stray connections (probes/scans) must
+            # not keep resetting it
+            reg_deadline = time.monotonic() + (90 if self.spawn
+                                               else timeout_s)
             server_ctx = (self.security.server_context()
                           if self.security is not None
                           and self.security.internal_ssl else None)
@@ -509,7 +512,8 @@ class ProcessCluster:
             tmp_lock = threading.Lock()
             try:
                 self._register_workers(srv, server_ctx, need_token,
-                                       addresses, hello_conns, tmp_lock)
+                                       addresses, hello_conns, tmp_lock,
+                                       reg_deadline)
             except socket.timeout:
                 # a worker that died before saying hello (startup crash)
                 # must yield a FAILED result the restart loop can retry,
@@ -580,10 +584,15 @@ class ProcessCluster:
     def _register_workers(self, srv, server_ctx, need_token: bool,
                           addresses: Dict[int, Tuple[str, int]],
                           hello_conns: List[Tuple[int, socket.socket]],
-                          tmp_lock: threading.Lock) -> None:
+                          tmp_lock: threading.Lock,
+                          deadline: float) -> None:
         """Accept until every worker said a valid hello; raises
-        ``socket.timeout`` if they don't arrive in time."""
+        ``socket.timeout`` once the OVERALL deadline passes."""
         while len(hello_conns) < self.n_workers:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("worker registration deadline")
+            srv.settimeout(remaining)
             conn, _addr = srv.accept()
             # a stray connection (readiness probe, port scan, wrong token)
             # must neither consume a registration slot nor fail the job —

@@ -31,7 +31,8 @@ import numpy as np
 
 from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
                                   EndOfInput, LatencyMarker, RecordBatch,
-                                  StreamElement, TaggedBatch, Watermark)
+                                  StreamElement, StreamStatus, TaggedBatch,
+                                  Watermark)
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
 from flink_tpu.runtime.executor import WatermarkValve
@@ -285,8 +286,7 @@ class Subtask(SubtaskBase):
         # snapshot-time valve, not be clobbered by it
         restored_valve = (self._restore or {}).get("valve")
         if restored_valve is not None:
-            self._valve.per_input = list(restored_valve)
-            self._valve.current = min(self._valve.per_input)
+            self._valve.restore(restored_valve)
         # unaligned restore: re-process recorded in-flight elements
         for i, el in (self._restore or {}).get("channel_state", []):
             self._handle_data(i, el)
@@ -328,7 +328,7 @@ class Subtask(SubtaskBase):
                 # barrier overtakes: snapshot NOW, forward NOW
                 self._pending_snapshot = {
                     "operator": self.operator.snapshot_state(),
-                    "valve": list(self._valve.per_input)}
+                    "valve": self._valve.snapshot()}
                 self._emit([el])
             self._maybe_complete_alignment()
         elif isinstance(el, EndOfInput):
@@ -350,6 +350,17 @@ class Subtask(SubtaskBase):
                 self._emit(self.operator.process_watermark(wm))
                 if self.operator.forwards_watermarks:
                     self._emit([wm])
+        elif isinstance(el, StreamStatus):
+            # idleness: drop the channel from the min; that alone can
+            # advance event time (StatusWatermarkValve.markIdle)
+            adv, combined, changed = self._valve.status_update(i, el.idle)
+            if adv is not None:
+                wm = Watermark(adv)
+                self._emit(self.operator.process_watermark(wm))
+                if self.operator.forwards_watermarks:
+                    self._emit([wm])
+            if changed:   # forward the SUBTASK's combined status, on change
+                self._emit([StreamStatus(combined)])
         elif isinstance(el, TaggedBatch):
             if getattr(self.operator, "accepts_tag", None) == el.tag:
                 self._emit(self.operator.process_tagged(el.batch))
@@ -395,7 +406,7 @@ class Subtask(SubtaskBase):
             # barrier was already forwarded at first arrival
         else:
             snap = {"operator": self.operator.snapshot_state(),
-                    "valve": list(self._valve.per_input)}
+                    "valve": self._valve.snapshot()}
             self._emit([barrier])
         self.listener.acknowledge_checkpoint(
             barrier.checkpoint_id, self.vertex_uid, self.subtask_index, snap)

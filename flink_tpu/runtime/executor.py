@@ -24,28 +24,82 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
-                                  RecordBatch, StreamElement, TaggedBatch,
-                                  Watermark)
+                                  RecordBatch, StreamElement, StreamStatus,
+                                  TaggedBatch, Watermark)
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.graph.stream_graph import ExecutionPlan, PlanVertex
 from flink_tpu.operators.base import StreamOperator
 
 
 class WatermarkValve:
-    """Min-across-inputs watermark alignment (``StatusWatermarkValve``)."""
+    """Min-across-inputs watermark alignment (``StatusWatermarkValve``).
+
+    Idleness (``StreamStatus``, ``StatusWatermarkValve.java`` markIdle
+    semantics): an IDLE input is excluded from the min, so one stalled
+    source cannot freeze event time for the whole pipeline; when every
+    input is idle no watermark advances (nothing can be proven)."""
 
     def __init__(self, num_inputs: int):
         self.per_input = [LONG_MIN] * max(1, num_inputs)
+        self.idle = [False] * max(1, num_inputs)
         self.current = LONG_MIN
+        self._last_combined = False  # last combined status forwarded
 
-    def input_watermark(self, input_index: int, ts: int) -> Optional[int]:
-        if ts > self.per_input[input_index]:
-            self.per_input[input_index] = ts
-        new_min = min(self.per_input)
+    def _advance(self) -> Optional[int]:
+        active = [wm for wm, idl in zip(self.per_input, self.idle)
+                  if not idl]
+        if not active:
+            return None
+        new_min = min(active)
         if new_min > self.current:
             self.current = new_min
             return new_min
         return None
+
+    def input_watermark(self, input_index: int, ts: int) -> Optional[int]:
+        # a watermark is proof of activity (the reference re-activates the
+        # channel on any element)
+        self.idle[input_index] = False
+        if ts > self.per_input[input_index]:
+            self.per_input[input_index] = ts
+        return self._advance()
+
+    def input_status(self, input_index: int, idle: bool) -> Optional[int]:
+        """Mark a channel idle/active; going idle can UNBLOCK the min."""
+        self.idle[input_index] = idle
+        return self._advance()
+
+    def status_update(self, input_index: int,
+                      idle: bool) -> Tuple[Optional[int], bool, bool]:
+        """One StreamStatus arrival: returns (advanced watermark or None,
+        combined idle status, whether the combined status CHANGED — the
+        reference forwards status only on change)."""
+        adv = self.input_status(input_index, idle)
+        combined = all(self.idle)
+        changed = combined != self._last_combined
+        self._last_combined = combined
+        return adv, combined, changed
+
+    # -- snapshot (idle flags must survive recovery: a restored subtask
+    # will never be re-sent an idle channel's status) --------------------
+    def snapshot(self) -> Dict[str, Any]:
+        return {"per_input": list(self.per_input), "idle": list(self.idle),
+                "current": self.current, "combined": self._last_combined}
+
+    def restore(self, snap) -> None:
+        if isinstance(snap, dict):
+            self.per_input = list(snap["per_input"])
+            self.idle = list(snap.get("idle", [False] * len(self.per_input)))
+            self.current = snap.get("current", LONG_MIN)
+            self._last_combined = snap.get("combined", False)
+        else:  # legacy list-only snapshots
+            self.per_input = list(snap)
+            self.idle = [False] * len(self.per_input)
+            self.current = min(self.per_input)
+        active = [wm for wm, idl in zip(self.per_input, self.idle)
+                  if not idl]
+        if active:
+            self.current = max(self.current, min(active))
 
 
 @dataclass
@@ -170,6 +224,18 @@ class LocalExecutor:
             # consumes it; every other vertex drops it
             if getattr(op, "accepts_tag", None) == el.tag:
                 self._route(rv, op.process_tagged(el.batch))
+        elif isinstance(el, StreamStatus):
+            # idleness: excluding the idle channel can itself advance the
+            # min watermark (StatusWatermarkValve.markIdle)
+            advanced, combined, changed = rv.valve.status_update(
+                input_index, el.idle)
+            if advanced is not None:
+                wm = Watermark(advanced)
+                self._route(rv, op.process_watermark(wm))
+                if op.forwards_watermarks:
+                    self._route(rv, [wm])
+            if changed:  # vertex's COMBINED status, forwarded on change
+                self._route(rv, [StreamStatus(combined)])
         else:
             self._route(rv, [el])
 

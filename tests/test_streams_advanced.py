@@ -472,3 +472,93 @@ def test_delta_evictor_via_rows_protocol():
            .apply(lambda k, w, rows: {"s": sum(r["v"] for r in rows)})
            .execute_and_collect())
     assert [r["s"] for r in out] == [30.0]   # 9+10+11 within delta of last=11
+
+
+# ---------------------------------------------------------------------------
+# watermark idleness (StreamStatus / StatusWatermarkValve.markIdle analog)
+# ---------------------------------------------------------------------------
+
+def test_valve_idle_channel_excluded():
+    from flink_tpu.core.batch import LONG_MIN
+    from flink_tpu.runtime.executor import WatermarkValve
+
+    v = WatermarkValve(2)
+    assert v.input_watermark(0, 100) is None     # ch1 still at LONG_MIN
+    # ch1 goes idle -> excluded -> min jumps to ch0's 100
+    assert v.input_status(1, True) == 100
+    assert v.input_watermark(0, 200) == 200      # advances on ch0 alone
+    # ch1 reactivates behind the current watermark: no regression
+    assert v.input_status(1, False) is None
+    assert v.input_watermark(1, 150) is None     # still behind
+    assert v.input_watermark(1, 300) is None     # min is ch0's 200
+    assert v.input_watermark(0, 400) == 300
+    # all idle: nothing can be proven
+    v2 = WatermarkValve(2)
+    v2.input_status(0, True)
+    assert v2.input_status(1, True) is None
+
+
+def test_idle_input_does_not_stall_windows():
+    """A silent second input marked idle must not freeze event time: the
+    window fires from the active input's watermarks alone."""
+    import jax.numpy as jnp
+
+    import time
+
+    from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
+    from flink_tpu.cluster.task import Subtask, TaskListener
+    from flink_tpu.core.batch import (EndOfInput, RecordBatch, StreamStatus,
+                                      Watermark)
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    ch_active = LocalChannel(64)
+    ch_idle = LocalChannel(64)
+    out = LocalChannel(256)
+    op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                           SumAggregator(jnp.float32), key_column="k",
+                           value_column="v")
+    t = Subtask("win", 0, op,
+                [OutputDispatcher("forward", [out])],
+                RuntimeContext(), TaskListener(), [ch_active, ch_idle])
+    t.start()
+    ch_active.put(RecordBatch({"k": np.array([1, 1]),
+                               "v": np.array([2., 3.])},
+                              timestamps=np.array([10, 20], np.int64)))
+    ch_idle.put(StreamStatus(idle=True))
+    ch_active.put(Watermark(2000))
+    # drain the output until the window fire arrives
+    fired = []
+    deadline = time.time() + 20
+    while time.time() < deadline and not fired:
+        el = out.poll(timeout_s=0.2)
+        if isinstance(el, RecordBatch) and len(el):
+            fired.extend(el.to_rows())
+    ch_active.put(EndOfInput())
+    ch_idle.put(EndOfInput())
+    t.join(timeout_s=20)
+    assert fired and fired[0]["result"] == 5.0
+
+
+def test_valve_idle_survives_snapshot_restore():
+    """Regression: a checkpoint taken while a channel is idle must restore
+    WITH the idle flag — nothing re-sends StreamStatus after recovery, so
+    losing it would freeze event time forever."""
+    from flink_tpu.runtime.executor import WatermarkValve
+
+    v = WatermarkValve(2)
+    v.input_watermark(0, 1000)
+    v.input_status(1, True)      # min jumps to 1000
+    assert v.current == 1000
+    snap = v.snapshot()
+
+    v2 = WatermarkValve(2)
+    v2.restore(snap)
+    assert v2.current == 1000 and v2.idle == [False, True]
+    assert v2.input_watermark(0, 2000) == 2000   # still advances alone
+
+    # legacy list-only snapshot stays restorable
+    v3 = WatermarkValve(2)
+    v3.restore([500, 700])
+    assert v3.current == 500
