@@ -58,8 +58,10 @@ class WatermarkValve:
 
     def input_watermark(self, input_index: int, ts: int) -> Optional[int]:
         # a watermark is proof of activity (the reference re-activates the
-        # channel on any element)
+        # channel on any element); the COMBINED status must track this, or
+        # a later all-idle transition would compare equal and never forward
         self.idle[input_index] = False
+        self._last_combined = False
         if ts > self.per_input[input_index]:
             self.per_input[input_index] = ts
         return self._advance()

@@ -137,6 +137,17 @@ class OverCall(Expr):
 
 
 @dataclass
+class UnionStmt:
+    """``SELECT ... UNION [ALL] SELECT ...`` chain; trailing ORDER BY/LIMIT
+    bind to the whole union (standard SQL)."""
+
+    parts: List["SelectStmt"]
+    alls: List[bool]                     # one per UNION keyword
+    order_by: List[Tuple["Expr", bool]]
+    limit: Optional[int] = None
+
+
+@dataclass
 class SelectItem:
     expr: Expr
     alias: Optional[str] = None
@@ -189,7 +200,7 @@ _KEYWORDS = {
     "IS", "NULL", "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
     "CAST", "INTERVAL", "DATE", "TIMESTAMP", "DISTINCT",
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON",
-    "OVER", "PARTITION",
+    "OVER", "PARTITION", "UNION", "ALL",
 }
 # NOTE: the OVER frame words (ROWS/RANGE/PRECEDING/UNBOUNDED/CURRENT/ROW)
 # are deliberately NOT keywords — they are non-reserved in standard SQL and
@@ -275,6 +286,33 @@ class Parser:
         return t.kind == "KEYWORD" and t.value in kws
 
     # -- entry --------------------------------------------------------------
+    def parse_statement(self):
+        """SELECT or a UNION [ALL] chain of SELECTs."""
+        stmt = self.parse_union_chain()
+        self.expect("EOF")
+        return stmt
+
+    def parse_union_chain(self):
+        left = self.parse_select(expect_eof=False)
+        parts = [left]
+        alls: List[bool] = []
+        while self.accept("KEYWORD", "UNION"):
+            alls.append(bool(self.accept("KEYWORD", "ALL")))
+            parts.append(self.parse_select(expect_eof=False))
+        if len(parts) == 1:
+            return left
+        # standard SQL: a trailing ORDER BY/LIMIT binds to the WHOLE union
+        last = parts[-1]
+        order_by, limit = list(last.order_by), last.limit
+        last.order_by, last.limit = [], None
+        for p in parts[:-1]:
+            if p.order_by or p.limit is not None:
+                raise SqlParseError(
+                    "ORDER BY/LIMIT inside a UNION branch is not supported "
+                    "(put them after the last SELECT)")
+        return UnionStmt(parts=parts, alls=alls, order_by=order_by,
+                         limit=limit)
+
     def parse_select(self, expect_eof: bool = True) -> SelectStmt:
         self.expect("KEYWORD", "SELECT")
         items = [self.parse_select_item()]
@@ -285,7 +323,7 @@ class Parser:
         joins: List[JoinClause] = []
         if self.accept("KEYWORD", "FROM"):
             if self.accept("OP", "("):
-                table = self.parse_select(expect_eof=False)
+                table = self.parse_union_chain()
                 self.expect("OP", ")")
             else:
                 table = self.expect("IDENT").value
@@ -607,5 +645,6 @@ def _timestamp_to_ms(s: str) -> int:
     return int(dt.timestamp() * 1000)
 
 
-def parse(sql: str) -> SelectStmt:
-    return Parser(sql.strip().rstrip(";")).parse_select()
+def parse(sql: str):
+    """-> SelectStmt | UnionStmt."""
+    return Parser(sql.strip().rstrip(";")).parse_statement()

@@ -315,10 +315,14 @@ class Planner:
         self.catalog = catalog
         self.mini_batch_rows = mini_batch_rows
 
-    def plan(self, stmt: SelectStmt) -> QueryPlan:
+    def plan(self, stmt) -> QueryPlan:
+        from flink_tpu.sql.parser import UnionStmt
+
+        if isinstance(stmt, UnionStmt):
+            return self._plan_union(stmt)
         if stmt.table is None:
             raise PlanError("FROM clause is required")
-        if isinstance(stmt.table, SelectStmt):
+        if isinstance(stmt.table, (SelectStmt, UnionStmt)):
             return self._plan_derived(stmt)
         try:
             table = self.catalog[stmt.table]
@@ -396,6 +400,73 @@ class Planner:
         return self._plan_aggregate(stream, rewritten, having, agg_specs,
                                     group_keys, window, table, stmt, compiler,
                                     orig_items=items)
+
+    # ------------------------------------------------------------- union
+    def _plan_union(self, stmt) -> QueryPlan:
+        """``SELECT ... UNION [ALL] SELECT ...``: branches plan
+        independently, columns align BY POSITION to the first branch's
+        names, distinct unions dedup full rows (the two-input
+        ``StreamExecUnion`` + dedup lowering)."""
+        if any(stmt.alls) and not all(stmt.alls):
+            raise PlanError("mixing UNION and UNION ALL in one chain is "
+                            "not supported (semantics differ per position); "
+                            "use all-ALL or all-distinct")
+        plans = [self.plan(p) for p in stmt.parts]
+        base_cols = plans[0].output_columns
+        streams = [plans[0].stream]
+        for p in plans[1:]:
+            if len(p.output_columns) != len(base_cols):
+                raise PlanError(
+                    f"UNION branches must have the same column count "
+                    f"({len(base_cols)} vs {len(p.output_columns)})")
+            s = p.stream
+            if p.output_columns != base_cols:
+                ren = dict(zip(p.output_columns, base_cols))
+
+                def rename(cols, _r=ren):
+                    return {_r.get(k, k): v for k, v in cols.items()}
+
+                s = s.map(rename, name="sql-union-align")
+            streams.append(s)
+        out = streams[0].union(*streams[1:])
+
+        if not all(stmt.alls):
+            # UNION (distinct): drop duplicate FULL rows
+            from flink_tpu.datastream.api import DataStream
+            from flink_tpu.operators.sql_ops import DeduplicateOperator
+
+            def add_key(cols, _names=tuple(base_cols)):
+                nrows = _n(cols)
+                parts = [np.asarray(cols[nm]) for nm in _names]
+                outc = dict(cols)
+                outc["__dedup"] = np.fromiter(
+                    (tuple(row) for row in zip(*(p.tolist() for p in parts))),
+                    object, count=nrows)
+                return outc
+
+            keyed = out.map(add_key, name="sql-union-key").key_by("__dedup")
+            t = keyed._then("sql-union-dedup",
+                            lambda: DeduplicateOperator("__dedup",
+                                                        keep="first"),
+                            chainable=False)
+            strip = DataStream(out.env, t)
+            out = strip.map(
+                lambda cols, _names=tuple(base_cols):
+                {nm: cols[nm] for nm in _names}, name="sql-union-strip")
+
+        order_by: List[Tuple[str, bool]] = []
+        for e, asc in stmt.order_by:
+            if isinstance(e, Literal) and isinstance(e.value, int):
+                if not 1 <= e.value <= len(base_cols):
+                    raise PlanError(f"UNION ORDER BY ordinal {e.value} out "
+                                    f"of range (1..{len(base_cols)})")
+                order_by.append((base_cols[e.value - 1], asc))
+            elif isinstance(e, Column) and e.name in base_cols:
+                order_by.append((e.name, asc))
+            else:
+                raise PlanError("UNION ORDER BY must reference an output "
+                                "column of the first branch (or an ordinal)")
+        return QueryPlan(out, list(base_cols), order_by, stmt.limit)
 
     # --------------------------------------------------- over aggregates
     def _plan_over(self, stream, orig_items: List[SelectItem],
@@ -581,7 +652,9 @@ class Planner:
             self.catalog = saved
 
     def _try_plan_rank(self, stmt: SelectStmt) -> Optional[QueryPlan]:
-        inner: SelectStmt = stmt.table
+        inner = stmt.table
+        if not isinstance(inner, SelectStmt):
+            return None  # a UNION subquery cannot be the Top-N shape
         over_items = [(i, it) for i, it in enumerate(inner.items)
                       if isinstance(it.expr, OverCall)]
         if not any(it.expr.func == "ROW_NUMBER" for _, it in over_items):

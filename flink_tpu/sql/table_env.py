@@ -150,6 +150,10 @@ class Table:
 
     # -- fluent Table API (sugar over the SQL AST) --------------------------
     def _table_name(self) -> str:
+        from flink_tpu.sql.parser import UnionStmt
+        if isinstance(self._stmt, UnionStmt):
+            raise PlanError("fluent Table transformations are not supported "
+                            "on UNION queries; use execute_sql")
         if self._stmt.table is None:
             raise PlanError("table has no FROM target")
         return self._stmt.table
@@ -182,7 +186,8 @@ class Table:
     def execute(self) -> "TableResult":
         import copy
         stmt = self._stmt
-        if not stmt.items:  # bare registered table: SELECT *
+        if getattr(stmt, "items", None) is not None and not stmt.items:
+            # bare registered table: SELECT *
             stmt = copy.copy(stmt)
             stmt.items = parse(f"SELECT * FROM {stmt.table}").items
         env, plan = self.tenv._plan(stmt)
@@ -193,7 +198,7 @@ class Table:
         result ``DataStream`` (``toDataStream`` / ``toChangelogStream``)."""
         import copy
         stmt = self._stmt
-        if not stmt.items:
+        if getattr(stmt, "items", None) is not None and not stmt.items:
             stmt = copy.copy(stmt)
             stmt.items = parse(f"SELECT * FROM {stmt.table}").items
         if env is None:
@@ -212,7 +217,7 @@ class Table:
     def _planned(self):
         import copy
         stmt = self._stmt
-        if not stmt.items:
+        if getattr(stmt, "items", None) is not None and not stmt.items:
             # bare table: fill in SELECT * but KEEP where()/group-by state
             stmt = copy.copy(stmt)
             stmt.items = parse(f"SELECT * FROM {stmt.table}").items

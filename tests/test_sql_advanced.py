@@ -320,3 +320,89 @@ def test_count_distinct_parallel_cluster():
     if not final:   # post-projection naming
         final = {r["k"]: r["n"] for r in rows}
     assert final == {1: 1.0} or final == {1: 1}
+
+
+# ---------------------------------------------------------------------------
+# UNION / UNION ALL
+# ---------------------------------------------------------------------------
+
+def test_union_all(tenv):
+    rows = tenv.execute_sql(
+        "SELECT oid, amount FROM orders WHERE amount < 25 "
+        "UNION ALL SELECT oid, amount FROM orders WHERE amount >= 25 "
+        "ORDER BY oid").collect()
+    assert [r["oid"] for r in rows] == [0, 1, 2, 3, 4, 5]
+
+
+def test_union_distinct_dedups():
+    te = TableEnvironment()
+    te.register_collection("a", columns={"x": np.array([1, 2, 3], np.int64)})
+    te.register_collection("b", columns={"x": np.array([2, 3, 4], np.int64)})
+    rows = te.execute_sql(
+        "SELECT x FROM a UNION SELECT x FROM b ORDER BY x").collect()
+    assert [r["x"] for r in rows] == [1, 2, 3, 4]
+
+
+def test_union_positional_column_alignment():
+    te = TableEnvironment()
+    te.register_collection("a", columns={"x": np.array([1], np.int64),
+                                         "y": np.array([10.0])})
+    te.register_collection("b", columns={"p": np.array([2], np.int64),
+                                         "q": np.array([20.0])})
+    rows = te.execute_sql(
+        "SELECT x, y FROM a UNION ALL SELECT p, q FROM b "
+        "ORDER BY x").collect()
+    assert [(r["x"], r["y"]) for r in rows] == [(1, 10.0), (2, 20.0)]
+
+
+def test_union_aggregated_branches(tenv):
+    rows = tenv.execute_sql(
+        "SELECT cust, SUM(amount) AS s FROM orders GROUP BY cust "
+        "UNION ALL SELECT cust, COUNT(*) AS c FROM orders GROUP BY cust "
+        "ORDER BY cust").collect()
+    assert len(rows) == 8   # 4 custs x 2 branches
+
+
+def test_union_errors(tenv):
+    from flink_tpu.sql.parser import SqlParseError
+    from flink_tpu.sql.planner import PlanError
+    with pytest.raises(PlanError, match="column count"):
+        tenv.execute_sql("SELECT oid FROM orders UNION ALL "
+                         "SELECT oid, amount FROM orders").collect()
+    with pytest.raises(SqlParseError, match="UNION branch"):
+        tenv.execute_sql("SELECT oid FROM orders ORDER BY oid "
+                         "UNION ALL SELECT oid FROM orders").collect()
+    with pytest.raises(PlanError, match="mixing"):
+        tenv.execute_sql("SELECT oid FROM orders UNION "
+                         "SELECT oid FROM orders UNION ALL "
+                         "SELECT oid FROM orders").collect()
+
+
+def test_union_in_derived_table():
+    te = TableEnvironment()
+    te.register_collection("a", columns={"x": np.array([1, 5], np.int64)})
+    te.register_collection("b", columns={"x": np.array([2, 6], np.int64)})
+    rows = te.execute_sql(
+        "SELECT SUM(x) AS s FROM "
+        "(SELECT x FROM a UNION ALL SELECT x FROM b)").collect()
+    assert rows[0]["s"] == 14
+
+
+def test_union_order_by_ordinal_checked(tenv):
+    from flink_tpu.sql.planner import PlanError
+    rows = tenv.execute_sql(
+        "SELECT oid FROM orders UNION ALL SELECT oid FROM orders "
+        "ORDER BY 1 LIMIT 3").collect()
+    assert [r["oid"] for r in rows] == [0, 0, 1]
+    with pytest.raises(PlanError, match="out of range"):
+        tenv.execute_sql("SELECT oid FROM orders UNION ALL "
+                         "SELECT oid FROM orders ORDER BY 0").collect()
+
+
+def test_union_fluent_table_rejected():
+    from flink_tpu.sql.planner import PlanError
+    te = TableEnvironment()
+    te.register_collection("a", columns={"x": np.array([1], np.int64)})
+    t = te.sql_query("SELECT x FROM a UNION ALL SELECT x FROM a")
+    with pytest.raises(PlanError, match="UNION"):
+        t.where("x > 0")

@@ -562,3 +562,18 @@ def test_valve_idle_survives_snapshot_restore():
     v3 = WatermarkValve(2)
     v3.restore([500, 700])
     assert v3.current == 500
+
+
+def test_valve_idle_refoward_after_reactivation():
+    """Regression: a watermark reactivating an all-idle valve must reset
+    the combined-status memory, or the NEXT all-idle transition would
+    compare equal and never forward downstream."""
+    from flink_tpu.runtime.executor import WatermarkValve
+
+    v = WatermarkValve(2)
+    v.status_update(0, True)
+    _, combined, changed = v.status_update(1, True)
+    assert combined and changed
+    v.input_watermark(0, 100)            # reactivates channel 0
+    _, combined, changed = v.status_update(0, True)
+    assert combined and changed          # must re-forward idle
