@@ -237,6 +237,31 @@ def _propagated_rowtime(table, items: List[SelectItem],
     return None
 
 
+def _dedup_by_tuple_key(stream, key_parts_fn, name: str):
+    """Shared distinct lowering: add a TUPLE ``__dedup`` column (unambiguous,
+    hashable for both the dedup dict and key-group routing), hash-route by it
+    (at parallelism > 1 every copy of a value must meet the SAME dedup
+    instance), and drop duplicates."""
+    from flink_tpu.datastream.api import DataStream
+    from flink_tpu.operators.sql_ops import DeduplicateOperator
+
+    def add_key(cols, _fn=key_parts_fn):
+        nrows = _n(cols)
+        parts = _fn(cols, nrows)
+        out = dict(cols)
+        out["__dedup"] = np.fromiter(
+            (tuple(row) for row in zip(*(p.tolist() for p in parts))),
+            object, count=nrows)
+        return out
+
+    stream = stream.map(add_key, name=f"{name}-key")
+    keyed = stream.key_by("__dedup")
+    t = keyed._then(name, lambda: DeduplicateOperator("__dedup",
+                                                      keep="first"),
+                    chainable=False)
+    return DataStream(stream.env, t)
+
+
 def _contains_over_expr(expr: Expr) -> bool:
     specs: List[Tuple[str, OverCall]] = []
     _extract_overs(expr, specs, {})
@@ -432,25 +457,12 @@ class Planner:
 
         if not all(stmt.alls):
             # UNION (distinct): drop duplicate FULL rows
-            from flink_tpu.datastream.api import DataStream
-            from flink_tpu.operators.sql_ops import DeduplicateOperator
-
-            def add_key(cols, _names=tuple(base_cols)):
-                nrows = _n(cols)
-                parts = [np.asarray(cols[nm]) for nm in _names]
-                outc = dict(cols)
-                outc["__dedup"] = np.fromiter(
-                    (tuple(row) for row in zip(*(p.tolist() for p in parts))),
-                    object, count=nrows)
-                return outc
-
-            keyed = out.map(add_key, name="sql-union-key").key_by("__dedup")
-            t = keyed._then("sql-union-dedup",
-                            lambda: DeduplicateOperator("__dedup",
-                                                        keep="first"),
-                            chainable=False)
-            strip = DataStream(out.env, t)
-            out = strip.map(
+            deduped = _dedup_by_tuple_key(
+                out,
+                lambda cols, nrows, _names=tuple(base_cols):
+                [np.asarray(cols[nm]) for nm in _names],
+                "sql-union-dedup")
+            out = deduped.map(
                 lambda cols, _names=tuple(base_cols):
                 {nm: cols[nm] for nm in _names}, name="sql-union-strip")
 
@@ -918,8 +930,7 @@ class Planner:
                       + [compiler.compile(dedup_arg)])
             win = window
 
-            def add_dedup_key(cols, _fns=dk_fns, _w=win):
-                nrows = _n(cols)
+            def key_parts(cols, nrows, _fns=dk_fns, _w=win):
                 parts = [to_column(f(cols), nrows) for f in _fns]
                 if _w is not None:
                     # TUMBLE: the dedup scope is one window — fold the
@@ -928,24 +939,10 @@ class Planner:
                     widx = np.asarray(cols[_w.time_col],
                                       np.int64) // _w.size_ms
                     parts = parts[:-1] + [widx, parts[-1]]
-                out = dict(cols)
-                # TUPLE keys: unambiguous (no separator collisions) and
-                # hashable for both the dedup dict and key-group routing
-                out["__dedup"] = np.fromiter(
-                    (tuple(row) for row in zip(*(p.tolist() for p in parts))),
-                    object, count=nrows)
-                return out
+                return parts
 
-            from flink_tpu.operators.sql_ops import DeduplicateOperator
-            stream = stream.map(add_dedup_key, name="sql-distinct-key")
-            # keyed routing: at parallelism > 1 every copy of a (key, value)
-            # pair must meet the SAME dedup instance
-            keyed_dedup = stream.key_by("__dedup")
-            t = keyed_dedup._then(
-                "sql-distinct-dedup",
-                lambda: DeduplicateOperator("__dedup", keep="first"),
-                chainable=False)
-            stream = DataStream(stream.env, t)
+            stream = _dedup_by_tuple_key(stream, key_parts,
+                                         "sql-distinct-dedup")
 
         # ---- pre-projection: aggregate inputs + computed/composite group key
         key_fns = [compiler.compile(k) for k in key_exprs]

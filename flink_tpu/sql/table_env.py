@@ -46,6 +46,8 @@ class TableEnvironment:
         #: (``table.exec.mini-batch`` analog)
         self.mini_batch_rows = mini_batch_rows
         self._catalog: Dict[str, CatalogTable] = {}
+        #: sink tables for INSERT INTO: name -> (path, format)
+        self._sinks: Dict[str, Tuple[str, str]] = {}
 
     @staticmethod
     def create(**kw) -> "TableEnvironment":
@@ -118,11 +120,70 @@ class TableEnvironment:
                 t._bound_env = None
 
     # ---------------------------------------------------------------- query
+    def register_sink_table(self, name: str, path: str,
+                            fmt: Optional[str] = None) -> None:
+        """Register a file-backed sink table — the `INSERT INTO` target
+        (``CREATE TABLE ... WITH ('connector'='filesystem')`` analog).
+        ``fmt`` defaults to the path's extension (csv/jsonl/ftb/avro)."""
+        from flink_tpu.formats import writer_for
+        resolved = fmt or path.rsplit(".", 1)[-1]
+        writer_for(resolved)   # validate NOW — fail at registration, not
+        #                        after the INSERT's query already ran
+        self._sinks[name] = (path, resolved)
+
     def sql_query(self, sql: str) -> "Table":
         return Table(self, parse(sql))
 
     def execute_sql(self, sql: str) -> "TableResult":
+        """SELECT / UNION chains, ``INSERT INTO sink SELECT ...``, and
+        ``EXPLAIN <query>`` (``TableEnvironment.executeSql:748`` analog)."""
+        stripped = sql.strip()
+        up = stripped.upper()
+        if up.startswith("EXPLAIN"):
+            return _ExplainResult(self.explain_sql(stripped[len("EXPLAIN"):]))
+        if up.startswith("INSERT"):
+            return self._execute_insert(stripped)
         return self.sql_query(sql).execute()
+
+    def explain_sql(self, sql: str) -> str:
+        """Textual physical plan: the vertex/edge list of the stream graph
+        the query lowers to (``explainSql`` analog)."""
+        env, plan = self._plan(parse(sql))
+        plan.stream.collect()   # graph building needs a sink-reachable DAG
+        g = env.get_stream_graph("explain")
+        ep = g.to_plan()
+        lines = ["== Physical Execution Plan =="]
+        for v in ep.vertices:
+            chain = " -> ".join(getattr(n, "name", "?") for n in v.chain) \
+                or v.name
+            lines.append(f"Vertex {v.id}: {v.name} (parallelism "
+                         f"{v.parallelism}) [{chain}]")
+            for e in v.out_edges:
+                tgt = ep.by_id[e.target_id]
+                lines.append(f"  -> {tgt.name} [{e.partitioning}]")
+        lines.append(f"Output columns: {plan.output_columns}")
+        return "\n".join(lines)
+
+    def _execute_insert(self, sql: str) -> "_InsertResult":
+        import re as _re
+
+        m = _re.match(r"(?is)^INSERT\s+INTO\s+([A-Za-z_][A-Za-z_0-9]*)\s+"
+                      r"(SELECT.*)$", sql)
+        if not m:
+            raise PlanError("INSERT syntax: INSERT INTO <sink_table> "
+                            "SELECT ...")
+        sink_name, query = m.group(1), m.group(2)
+        if sink_name not in self._sinks:
+            raise PlanError(f"unknown sink table {sink_name!r}; register it "
+                            f"with register_sink_table(name, path)")
+        path, fmt = self._sinks[sink_name]
+        result = self.sql_query(query).execute()
+        rows = result.collect()
+        from flink_tpu.core.batch import RecordBatch
+        from flink_tpu.formats import writer_for
+        batch = RecordBatch.from_rows(rows) if rows else RecordBatch({})
+        n = writer_for(fmt)([batch], path)
+        return _InsertResult(n, path)
 
     def _plan(self, stmt: SelectStmt):
         from flink_tpu.datastream.api import StreamExecutionEnvironment
@@ -317,6 +378,33 @@ class GroupedTable:
             plan.stream, key, "sql-changelog-agg",
             lambda: ChangelogGroupAggOperator(key, agg_columns))
         return TableResult(env, QP(out, out_cols))
+
+
+class _ExplainResult:
+    """Result of ``EXPLAIN <query>``: the plan text."""
+
+    def __init__(self, text: str):
+        self.text = text
+
+    def collect(self):
+        return [{"plan": self.text}]
+
+    def print(self) -> None:
+        print(self.text)
+
+
+class _InsertResult:
+    """Result of ``INSERT INTO``: rows written + target path."""
+
+    def __init__(self, rows_written: int, path: str):
+        self.rows_written = rows_written
+        self.path = path
+
+    def collect(self):
+        return [{"rows_written": self.rows_written, "path": self.path}]
+
+    def print(self) -> None:
+        print(f"{self.rows_written} rows -> {self.path}")
 
 
 class TableResult:

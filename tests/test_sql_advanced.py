@@ -406,3 +406,28 @@ def test_union_fluent_table_rejected():
     t = te.sql_query("SELECT x FROM a UNION ALL SELECT x FROM a")
     with pytest.raises(PlanError, match="UNION"):
         t.where("x > 0")
+
+
+def test_explain_sql(tenv):
+    res = tenv.execute_sql(
+        "EXPLAIN SELECT cust, SUM(amount) AS s FROM orders GROUP BY cust")
+    text = res.collect()[0]["plan"]
+    assert "Physical Execution Plan" in text
+    assert "sql-group-agg" in text and "hash" in text
+    assert "Output columns: ['cust', 's']" in text
+
+
+def test_insert_into_sink_table(tenv, tmp_path):
+    out = str(tmp_path / "totals.csv")
+    tenv.register_sink_table("totals", out)
+    res = tenv.execute_sql(
+        "INSERT INTO totals SELECT cust, SUM(amount) AS total FROM orders "
+        "GROUP BY cust ORDER BY cust")
+    assert res.collect()[0]["rows_written"] == 4
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.formats import reader_for
+    got = RecordBatch.concat(list(reader_for("csv")(out)))
+    assert len(got) == 4
+    from flink_tpu.sql.planner import PlanError
+    with pytest.raises(PlanError, match="unknown sink"):
+        tenv.execute_sql("INSERT INTO nope SELECT * FROM orders")
