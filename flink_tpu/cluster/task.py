@@ -342,16 +342,14 @@ class Subtask(SubtaskBase):
                 self._channel_state.append((i, el))
             self._handle_data(i, el)
 
-    def _flush_status(self) -> None:
-        ps = self._valve.pending_status
-        if ps is not None:
-            self._valve.pending_status = None
-            self._emit([StreamStatus(ps)])
+    def _emit_status_change(self, st) -> None:
+        if st is not None:
+            self._emit([StreamStatus(st)])
 
     def _handle_data(self, i: int, el: StreamElement) -> None:
         if isinstance(el, Watermark):
+            self._emit_status_change(self._valve.record_activity(i))
             adv = self._valve.input_watermark(i, el.timestamp)
-            self._flush_status()
             if adv is not None:
                 wm = Watermark(adv)
                 self._emit(self.operator.process_watermark(wm))
@@ -373,8 +371,7 @@ class Subtask(SubtaskBase):
                 self._emit(self.operator.process_tagged(el.batch))
         elif isinstance(el, RecordBatch):
             if len(el):
-                self._valve.record_activity(i)
-                self._flush_status()
+                self._emit_status_change(self._valve.record_activity(i))
                 self.records_in += len(el)
                 t0 = time.monotonic_ns()
                 if getattr(self.operator, "is_two_input", False):

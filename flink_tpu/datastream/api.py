@@ -556,6 +556,23 @@ class KeyedStream(DataStream):
         return self.reduce(agg, value_column=value_column,
                            output_column=output_column or value_column)
 
+    def min_by(self, value_column: str, name: str = "min-by") -> "DataStream":
+        """Running FULL ROW of the minimum element per key
+        (``minBy(field)`` analog; ties keep the first arrival)."""
+        from flink_tpu.operators.basic import ExtremumByOperator
+        kc = self.key_column
+        t = self._then(name, lambda: ExtremumByOperator(
+            kc, value_column, is_min=True, name=name), chainable=False)
+        return DataStream(self.env, t)
+
+    def max_by(self, value_column: str, name: str = "max-by") -> "DataStream":
+        """Running FULL ROW of the maximum element per key (``maxBy``)."""
+        from flink_tpu.operators.basic import ExtremumByOperator
+        kc = self.key_column
+        t = self._then(name, lambda: ExtremumByOperator(
+            kc, value_column, is_min=False, name=name), chainable=False)
+        return DataStream(self.env, t)
+
 
 class WindowedStream:
     """``WindowedStream.java`` analog (``reduce:162``, ``aggregate:283``)."""

@@ -13,7 +13,7 @@ bodies fuse into the surrounding device step (operator chaining,
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -349,3 +349,67 @@ class SinkOperator(StreamOperator):
     def close(self) -> None:
         if hasattr(self.sink, "close"):
             self.sink.close()
+
+
+class ExtremumByOperator(StreamOperator):
+    """``KeyedStream.minBy/maxBy`` analog: per key, keep the FULL ROW of the
+    extreme element seen so far (ties keep the first arrival, the
+    reference's ``minBy(field, first=true)``), emitting the current extreme
+    per touched key per micro-batch — the batched form of the reference's
+    per-record running emission."""
+
+    def __init__(self, key_column: str, value_column: str, is_min: bool,
+                 name: str = "extremum-by"):
+        self.key_column = key_column
+        self.value_column = value_column
+        self.is_min = is_min
+        self.name = name
+        #: key -> (value, row dict)
+        self._state: Dict[Any, Tuple[float, Dict[str, Any]]] = {}
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        # NaN values can never win (a stored NaN would poison strict
+        # comparisons forever); rows carrying NaN are ignored entirely
+        vals_all = np.asarray(batch.column(self.value_column), np.float64)
+        finite = ~np.isnan(vals_all)
+        if not finite.all():
+            batch = batch.select(finite)
+            if len(batch) == 0:
+                return []
+        n = len(batch)
+        keys = np.asarray(batch.column(self.key_column))
+        vals = np.asarray(batch.column(self.value_column), np.float64)
+        ts = (np.asarray(batch.timestamps)
+              if batch.timestamps is not None else None)
+        _uniq, inv = np.unique(keys, return_inverse=True)
+        # batch-local extreme per key: lexsort by (key group, value,
+        # arrival) — the first row of each group is the winner
+        sort_vals = vals if self.is_min else -vals
+        order = np.lexsort((np.arange(n), sort_vals, inv))
+        first = np.ones(n, bool)
+        first[1:] = inv[order][1:] != inv[order][:-1]
+        winners = order[first]
+        rows = batch.take(winners).to_rows()
+        out_rows: List[Dict[str, Any]] = []
+        out_ts: List[int] = []
+        better = (lambda a, b: a < b) if self.is_min else (lambda a, b: a > b)
+        for row, w in zip(rows, winners.tolist()):
+            k = keys[w]
+            v = float(vals[w])
+            cur = self._state.get(k)
+            if cur is None or better(v, cur[0]):
+                self._state[k] = (v, row, int(ts[w]) if ts is not None else 0)
+            _v, out_row, row_ts = self._state[k]
+            out_rows.append(out_row)
+            out_ts.append(row_ts)
+        out = RecordBatch.from_rows(
+            out_rows, timestamps=out_ts if ts is not None else None)
+        return [out]
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {"state": dict(self._state)}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._state = dict(snap.get("state", {}))

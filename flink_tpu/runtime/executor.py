@@ -44,11 +44,6 @@ class WatermarkValve:
         self.idle = [False] * max(1, num_inputs)
         self.current = LONG_MIN
         self._last_combined = False  # last combined status forwarded
-        #: set when activity (a record/watermark on an idle channel) flips
-        #: the COMBINED status — the caller must forward StreamStatus(this)
-        #: downstream and clear it (the reference forwards ACTIVE on any
-        #: element that reactivates an idle stream)
-        self.pending_status: Optional[bool] = None
 
     def _advance(self) -> Optional[int]:
         active = [wm for wm, idl in zip(self.per_input, self.idle)
@@ -61,21 +56,26 @@ class WatermarkValve:
             return new_min
         return None
 
-    def record_activity(self, input_index: int) -> None:
-        """Any element on an idle channel reactivates it; a combined-status
-        flip is queued in ``pending_status`` for the caller to forward."""
+    def record_activity(self, input_index: int) -> Optional[bool]:
+        """Any element on an idle channel reactivates it; returns the new
+        COMBINED status iff it changed (the caller forwards it downstream —
+        the reference forwards ACTIVE on any reactivating element)."""
         if not self.idle[input_index]:
-            return
+            return None
         self.idle[input_index] = False
         combined = all(self.idle)
         if combined != self._last_combined:
             self._last_combined = combined
-            self.pending_status = combined
+            return combined
+        return None
 
     def input_watermark(self, input_index: int, ts: int) -> Optional[int]:
-        # a watermark is proof of activity (the reference re-activates the
-        # channel on any element)
-        self.record_activity(input_index)
+        # a watermark is proof of activity; idleness-aware callers invoke
+        # record_activity FIRST to forward the transition — this fallback
+        # keeps the combined memory consistent for everyone else
+        if self.idle[input_index]:
+            self.idle[input_index] = False
+            self._last_combined = all(self.idle)
         if ts > self.per_input[input_index]:
             self.per_input[input_index] = ts
         return self._advance()
@@ -211,19 +211,14 @@ class LocalExecutor:
             for tgt, idx in rv.targets:
                 self._deliver(tgt, idx, el)
 
-    def _flush_status(self, rv: RunningVertex) -> None:
-        ps = rv.valve.pending_status
-        if ps is not None:
-            rv.valve.pending_status = None
-            self._route(rv, [StreamStatus(ps)])
-
     def _deliver(self, rv: RunningVertex, input_index: int,
                  el: StreamElement) -> None:
         op = rv.operator
         if isinstance(el, RecordBatch):
             if len(el):
-                rv.valve.record_activity(input_index)
-                self._flush_status(rv)
+                st = rv.valve.record_activity(input_index)
+                if st is not None:
+                    self._route(rv, [StreamStatus(st)])
                 if rv.io is not None:
                     rv.io.records_in.inc(len(el))
                 if getattr(op, "is_two_input", False):
@@ -231,8 +226,10 @@ class LocalExecutor:
                 else:
                     self._route(rv, op.process_batch(el))
         elif isinstance(el, Watermark):
+            st = rv.valve.record_activity(input_index)
+            if st is not None:
+                self._route(rv, [StreamStatus(st)])
             advanced = rv.valve.input_watermark(input_index, el.timestamp)
-            self._flush_status(rv)
             if advanced is not None:
                 if rv.io is not None:
                     rv.io.watermark.set(advanced)

@@ -239,3 +239,27 @@ def test_side_output_late_data():
     assert len(lr) == 1 and lr[0]["t"] == 1
     # the main output still fired the on-time windows
     assert sum(r["v"] for r in main_sink.rows()) >= 8.0
+
+
+def test_min_by_max_by():
+    """minBy/maxBy keep the FULL ROW of the extreme element (ties keep the
+    first arrival)."""
+    env = StreamExecutionEnvironment()
+    rows = (env.from_collection(columns={
+        "k": np.array([1, 1, 1, 2, 2], np.int64),
+        "v": np.array([5., 2., 2., 9., 1.]),
+        "tag": np.asarray(["a", "b", "c", "d", "e"], object)}, batch_size=2)
+        .key_by("k").min_by("v").execute_and_collect())
+    final = {}
+    for r in rows:
+        final[r["k"]] = (r["v"], r["tag"])
+    # key 1's min is 2.0 first seen with tag "b" (tie with "c" keeps first)
+    assert final[1] == (2.0, "b") and final[2] == (1.0, "e")
+
+    env2 = StreamExecutionEnvironment()
+    rows = (env2.from_collection(columns={
+        "k": np.zeros(4, np.int64),
+        "v": np.array([3., 7., 7., 1.]),
+        "tag": np.asarray(["p", "q", "r", "s"], object)}, batch_size=1)
+        .key_by("k").max_by("v").execute_and_collect())
+    assert rows[-1]["tag"] == "q"   # max 7.0, first arrival wins the tie
