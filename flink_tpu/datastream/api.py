@@ -647,9 +647,6 @@ class WindowedStream:
                 raise ValueError(
                     "custom triggers are not supported on session windows "
                     "(sessions fire when the gap closes); remove .trigger()")
-            if late_tag is not None:
-                raise ValueError("side_output_late_data is not supported on "
-                                 "session windows yet")
             from flink_tpu.operators.session_window import SessionWindowOperator
 
             def factory():
@@ -657,7 +654,8 @@ class WindowedStream:
                     assigner, agg, key_column=keyed.key_column,
                     value_column=value_column, value_selector=value_selector,
                     allowed_lateness_ms=lateness,
-                    output_column=output_column, name=name)
+                    output_column=output_column, name=name,
+                    late_output_tag=late_tag)
         else:
             def factory():
                 return WindowAggOperator(

@@ -355,8 +355,10 @@ class ExtremumByOperator(StreamOperator):
     """``KeyedStream.minBy/maxBy`` analog: per key, keep the FULL ROW of the
     extreme element seen so far (ties keep the first arrival, the
     reference's ``minBy(field, first=true)``), emitting the current extreme
-    per touched key per micro-batch — the batched form of the reference's
-    per-record running emission."""
+    per touched key per micro-batch with the TRIGGERING record's timestamp
+    (``StreamGroupedReduceOperator`` emission semantics).  State follows the
+    repo keyed-snapshot convention (key index + slot-aligned row fields) so
+    rescale split/merge redistributes it by key group."""
 
     def __init__(self, key_column: str, value_column: str, is_min: bool,
                  name: str = "extremum-by"):
@@ -364,10 +366,23 @@ class ExtremumByOperator(StreamOperator):
         self.value_column = value_column
         self.is_min = is_min
         self.name = name
-        #: key -> (value, row dict)
-        self._state: Dict[Any, Tuple[float, Dict[str, Any]]] = {}
+        self.key_index = None
+        self._vals = np.zeros(0, np.float64)   # slot -> extreme value
+        self._rows = np.zeros(0, object)       # slot -> extreme row dict
+
+    def _ensure(self, n: int) -> None:
+        if n > self._vals.size:
+            cap = max(n, max(16, self._vals.size * 2))
+            sentinel = np.inf if self.is_min else -np.inf
+            nv = np.full(cap, sentinel, np.float64)
+            nv[: self._vals.size] = self._vals
+            nr = np.empty(cap, object)
+            nr[: self._rows.size] = self._rows
+            self._vals, self._rows = nv, nr
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        from flink_tpu.state.keyindex import make_key_index
+
         if len(batch) == 0:
             return []
         # NaN values can never win (a stored NaN would poison strict
@@ -383,7 +398,11 @@ class ExtremumByOperator(StreamOperator):
         vals = np.asarray(batch.column(self.value_column), np.float64)
         ts = (np.asarray(batch.timestamps)
               if batch.timestamps is not None else None)
-        _uniq, inv = np.unique(keys, return_inverse=True)
+        if self.key_index is None:
+            self.key_index = make_key_index(keys[0] if keys.ndim else keys)
+        slots = self.key_index.lookup_or_insert(keys).astype(np.int64)
+        self._ensure(self.key_index.num_keys)
+        _uniq, inv = np.unique(slots, return_inverse=True)
         # batch-local extreme per key: lexsort by (key group, value,
         # arrival) — the first row of each group is the winner
         sort_vals = vals if self.is_min else -vals
@@ -396,20 +415,38 @@ class ExtremumByOperator(StreamOperator):
         out_ts: List[int] = []
         better = (lambda a, b: a < b) if self.is_min else (lambda a, b: a > b)
         for row, w in zip(rows, winners.tolist()):
-            k = keys[w]
+            slot = int(slots[w])
             v = float(vals[w])
-            cur = self._state.get(k)
-            if cur is None or better(v, cur[0]):
-                self._state[k] = (v, row, int(ts[w]) if ts is not None else 0)
-            _v, out_row, row_ts = self._state[k]
-            out_rows.append(out_row)
-            out_ts.append(row_ts)
+            if self._rows[slot] is None or better(v, self._vals[slot]):
+                self._vals[slot] = v
+                self._rows[slot] = row
+            out_rows.append(self._rows[slot])
+            # emission carries the TRIGGERING record's timestamp: the
+            # stored extreme may be arbitrarily behind the watermark
+            out_ts.append(int(ts[w]) if ts is not None else 0)
         out = RecordBatch.from_rows(
             out_rows, timestamps=out_ts if ts is not None else None)
         return [out]
 
     def snapshot_state(self) -> Dict[str, Any]:
-        return {"state": dict(self._state)}
+        if self.key_index is None:
+            return {"empty": True}
+        n = self.key_index.num_keys
+        return {"empty": False,
+                "keys": self.key_index.snapshot(),
+                "key_index_kind": type(self.key_index).__name__,
+                "state.vals": self._vals[:n].copy(),
+                "state.rows": self._rows[:n].copy()}
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        self._state = dict(snap.get("state", {}))
+        from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex
+
+        if snap.get("empty", True):
+            return
+        cls = (ObjectKeyIndex if snap["key_index_kind"] == "ObjectKeyIndex"
+               else KeyIndex)
+        self.key_index = cls.restore(snap["keys"])
+        n = self.key_index.num_keys
+        self._ensure(n)
+        self._vals[:n] = np.asarray(snap["state.vals"])
+        self._rows[:n] = np.asarray(snap["state.rows"], object)

@@ -34,7 +34,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from flink_tpu.core.batch import LONG_MIN, RecordBatch, StreamElement, Watermark
+from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
+                                  TaggedBatch, Watermark)
 from flink_tpu.core.functions import AggregateFunction, RuntimeContext
 from flink_tpu.operators.base import StreamOperator
 from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex, make_key_index
@@ -105,7 +106,10 @@ class SessionWindowOperator(StreamOperator):
                  allowed_lateness_ms: int = 0,
                  output_column: str = "result",
                  emit_window_bounds: bool = True,
-                 name: str = "session-window-agg"):
+                 name: str = "session-window-agg",
+                 late_output_tag: Optional[str] = None):
+        #: sideOutputLateData: beyond-lateness records ship as TaggedBatch
+        #: instead of dropping (the drop counter stays untouched for them)
         self.gap = int(session.gap_ms)
         self.is_event_time = session.is_event_time
         self.agg = agg
@@ -124,6 +128,7 @@ class SessionWindowOperator(StreamOperator):
         self.kinds = agg.scatter_kind_leaves()
         self.key_index: Optional[KeyIndex | ObjectKeyIndex] = None
         self.store = _SessionStore(self.spec)
+        self.late_output_tag = late_output_tag
         self.watermark: int = LONG_MIN
         self._proc_time: int = LONG_MIN
         self.late_dropped: int = 0
@@ -133,6 +138,7 @@ class SessionWindowOperator(StreamOperator):
 
     # ------------------------------------------------------------ ingest
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        late_out: List[StreamElement] = []
         keys = np.asarray(batch.column(self.key_column))
         if self.is_event_time:
             if batch.timestamps is None:
@@ -165,13 +171,17 @@ class SessionWindowOperator(StreamOperator):
                         if self.store.start[r] < t1 and t0 < self.store.end[r]:
                             late[i] = False
                             break
-                self.late_dropped += int(late.sum())
+                if late.any() and self.late_output_tag is not None:
+                    late_out.append(TaggedBatch(self.late_output_tag,
+                                                batch.select(late)))
+                elif late.any():
+                    self.late_dropped += int(late.sum())
                 keep = ~late
                 slots, ts = slots[keep], ts[keep]
                 values = jax.tree_util.tree_map(
                     lambda c: np.asarray(c)[keep], values)
                 if not slots.size:
-                    return []
+                    return late_out
 
         # ---- vectorized batch-local sessionization
         order = np.lexsort((ts, slots))
@@ -250,7 +260,7 @@ class SessionWindowOperator(StreamOperator):
                     and end <= self.watermark:
                 refire.add(row)
 
-        out: List[StreamElement] = []
+        out: List[StreamElement] = list(late_out)
         if refire:
             rows = np.asarray(sorted(refire), np.int64)
             out.extend(self._emit_rows(rows))

@@ -504,16 +504,25 @@ class HeapAggregatingState(AggregatingState, _HeapStateBase):
         self._touch_write(np.unique(slots))
 
     def get_rows(self, slots: np.ndarray):
-        """Returns (results, alive): vectorized get_result over slots."""
+        """Returns (results, alive): vectorized get_result over slots.
+        Results are an array for scalar-valued aggregates, or a dict of
+        arrays for composite results (e.g. TupleAggregator)."""
         slots = np.asarray(slots, np.int64)
         self._ensure(int(slots.max()) + 1 if slots.size else 0)
         alive = self._alive(slots, self._present[slots])
         acc = self._spec.unflatten([leaf[slots] for leaf in self._leaves])
         self._touch_read(slots)
-        return np.asarray(self.agg.get_result(acc)), alive
+        res = self.agg.get_result(acc)
+        if isinstance(res, dict):
+            return {k: np.asarray(v) for k, v in res.items()}, alive
+        return np.asarray(res), alive
 
     def get(self):
         res, alive = self.get_rows(np.array([self._slot()]))
+        if isinstance(res, dict):
+            # composite result (dict-ACC aggregates): one row -> one dict
+            return ({k: v[0].item() if hasattr(v[0], "item") else v[0]
+                     for k, v in res.items()} if alive[0] else None)
         return res[0] if alive[0] else None
 
     def add(self, value) -> None:

@@ -263,3 +263,51 @@ def test_min_by_max_by():
         "tag": np.asarray(["p", "q", "r", "s"], object)}, batch_size=1)
         .key_by("k").max_by("v").execute_and_collect())
     assert rows[-1]["tag"] == "q"   # max 7.0, first arrival wins the tie
+
+
+def test_min_by_keyed_snapshot_rescale():
+    """min_by state follows the keyed-snapshot convention: rescale split
+    routes each key's extreme to its key-group owner."""
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.operators.basic import ExtremumByOperator
+    from flink_tpu.state.redistribute import split_keyed_snapshot
+
+    op = ExtremumByOperator("k", "v", is_min=True)
+    op.process_batch(RecordBatch({
+        "k": np.array([1, 2, 3, 1], np.int64),
+        "v": np.array([5., 7., 2., 1.]),
+        "tag": np.asarray(["a", "b", "c", "d"], object)}))
+    snap = op.snapshot_state()
+    parts = split_keyed_snapshot(
+        snap, [f for f in snap if f.startswith("state.")], 128, 2)
+    # every key's extreme lands in exactly one part, values intact
+    found = {}
+    for p in parts:
+        op2 = ExtremumByOperator("k", "v", is_min=True)
+        op2.restore_state(p)
+        out = op2.process_batch(RecordBatch({
+            "k": np.array([1, 2, 3], np.int64),
+            "v": np.array([99., 99., 99.]),
+            "tag": np.asarray(["x", "x", "x"], object)}))
+        for r in out[0].to_rows():
+            if r["tag"] != "x":
+                found[r["k"]] = (r["v"], r["tag"])
+    assert found == {1: (1.0, "d"), 2: (7.0, "b"), 3: (2.0, "c")}
+
+
+def test_min_by_emits_triggering_timestamp():
+    """Emission carries the TRIGGERING record's timestamp (the stored
+    extreme may be far behind the watermark)."""
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.operators.basic import ExtremumByOperator
+
+    op = ExtremumByOperator("k", "v", is_min=True)
+    op.process_batch(RecordBatch({"k": np.zeros(1, np.int64),
+                                  "v": np.array([1.])},
+                                 timestamps=np.array([100], np.int64)))
+    out = op.process_batch(RecordBatch({"k": np.zeros(1, np.int64),
+                                        "v": np.array([9.])},
+                                       timestamps=np.array([50_000],
+                                                           np.int64)))
+    assert np.asarray(out[0].timestamps)[0] == 50_000
+    assert out[0].to_rows()[0]["v"] == 1.0

@@ -198,10 +198,7 @@ def test_acc_evolution_heap_backend():
                                 "a": ("v", AvgAggregator(np.float32))})))
     b2.set_current_key(5)
     st2.add_rows(np.array([st2._slot()]), {"v": np.array([5.0])})
-    # read the ACC directly (scalar .get() doesn't support dict results)
-    slot = st2._slot()
-    acc = st2._spec.unflatten([leaf[slot] for leaf in st2._leaves])
-    got = st2.agg.get_result(acc)
+    got = st2.get()
     assert float(got["s"]) == 10.0 and float(got["a"]) == 5.0
 
 

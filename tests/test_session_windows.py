@@ -259,3 +259,32 @@ def test_split_zeroes_counter_in_all_but_first_part():
     parts = SessionWindowOperator.split_snapshot(snap, 128, 4)
     total = sum(p.get("late_dropped", 0) for p in parts)
     assert total == 1
+
+
+def test_session_side_output_late_data():
+    """Beyond-lateness session records route to a side output instead of
+    dropping (sideOutputLateData on merging windows)."""
+    import numpy as np
+
+    from flink_tpu.core.batch import OutputTag
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+    env = StreamExecutionEnvironment()
+    tag = OutputTag("late-sessions")
+    ks = np.zeros(6, np.int64)
+    vs = np.ones(6)
+    # session gap 1000; watermark sails past 50_000; then a straggler at 10
+    ts = np.array([100, 300, 20_000, 20_300, 50_000, 10], np.int64)
+    win = (env.from_collection(columns={"k": ks, "v": vs, "t": ts},
+                               batch_size=2)
+           .assign_timestamps_and_watermarks(0, timestamp_column="t")
+           .key_by("k")
+           .window(EventTimeSessionWindows(1000)))
+    agg = win.side_output_late_data(tag).sum("v")
+    late_sink = agg.get_side_output(tag).collect()
+    main_sink = agg.collect()
+    env.execute("late-session")
+    lr = late_sink.rows()
+    assert len(lr) == 1 and lr[0]["t"] == 10
+    assert sum(r["v"] for r in main_sink.rows()) >= 4.0
