@@ -567,3 +567,93 @@ def test_count_trigger_sliding_snapshot_restore():
     rows = [r for b in out for r in b.to_rows()]
     assert sorted((r["window_start"], r["result"]) for r in rows) == \
         [(0, 33.0), (1000, 33.0)]
+
+
+class TestSlidingLateness:
+    """WindowOperatorTest-style scenarios: sliding assigners crossed with
+    allowed lateness, late re-fires, and mid-stream snapshot/restore."""
+
+    def _op(self, lateness=0):
+        import jax.numpy as jnp
+
+        from flink_tpu.core.functions import RuntimeContext
+
+        op = WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
+                               SumAggregator(jnp.float32), key_column="k",
+                               value_column="v",
+                               allowed_lateness_ms=lateness)
+        op.open(RuntimeContext())
+        return op
+
+    @staticmethod
+    def _feed(op, keys, vals, ts):
+        from flink_tpu.core.batch import RecordBatch
+
+        return op.process_batch(RecordBatch(
+            {"k": np.asarray(keys, np.int64),
+             "v": np.asarray(vals, np.float64)},
+            timestamps=np.asarray(ts, np.int64)))
+
+    def test_late_record_refires_all_covering_windows(self):
+        from flink_tpu.core.batch import Watermark
+
+        op = self._op(lateness=5000)
+        self._feed(op, [1, 1], [1., 2.], [500, 1500])
+        fired = op.process_watermark(Watermark(3000))
+        pre = sorted((r["window_start"], r["result"])
+                     for b in fired for r in b.to_rows())
+        # windows [-1000,1000)=1, [0,2000)=3, [1000,3000)=2 all fired
+        assert pre == [(-1000, 1.0), (0, 3.0), (1000, 2.0)]
+        # a late record at 700 (within lateness) re-fires BOTH its windows
+        out = self._feed(op, [1], [10.], [700])
+        refired = sorted((r["window_start"], r["result"])
+                         for b in out for r in b.to_rows())
+        assert refired == [(-1000, 11.0), (0, 13.0)]
+
+    def test_beyond_lateness_sliding_drops_all_windows(self):
+        from flink_tpu.core.batch import Watermark
+
+        op = self._op(lateness=1000)
+        self._feed(op, [1], [1.], [500])
+        op.process_watermark(Watermark(10_000))   # far past retention
+        out = self._feed(op, [1], [9.], [600])
+        assert [r for b in out for r in b.to_rows()] == []
+        assert op.late_dropped == 1
+
+    def test_snapshot_restore_mid_sliding_with_lateness(self):
+        from flink_tpu.core.batch import Watermark
+
+        op = self._op(lateness=5000)
+        self._feed(op, [1, 2], [1., 2.], [500, 1500])
+        op.process_watermark(Watermark(1200))     # fires window [-1000,1000)
+        snap = op.snapshot_state()
+
+        op2 = self._op(lateness=5000)
+        op2.restore_state(snap)
+        # restored operator continues: remaining windows fire once, with
+        # the pre-snapshot contributions intact
+        self._feed(op2, [1], [4.], [1600])
+        fired = op2.process_watermark(Watermark(4000))
+        got = sorted((r["k"], r["window_start"], r["result"])
+                     for b in fired for r in b.to_rows())
+        # [0,2000): restored 1.0 + post-restore 4.0@1600; [1000,3000):
+        # the 4.0 alone; key 2's restored 2.0@1500 covers both windows —
+        # and the already-fired [-1000,1000) must NOT re-fire (exact set)
+        assert got == [(1, 0, 5.0), (1, 1000, 4.0),
+                       (2, 0, 2.0), (2, 1000, 2.0)]
+
+    def test_watermark_jump_fires_windows_in_order(self):
+        from flink_tpu.core.batch import Watermark
+
+        op = self._op()
+        self._feed(op, [1, 1, 1], [1., 2., 4.], [500, 2500, 4500])
+        fired = op.process_watermark(Watermark(100_000))  # one giant jump
+        starts = [r["window_start"]
+                  for b in fired for r in b.to_rows()]
+        assert starts == sorted(starts)    # ascending window order
+        got = {(r["window_start"], r["result"])
+               for b in fired for r in b.to_rows()}
+        # 2500 and 4500 never share a window (size 2000): the COMPLETE
+        # fire set — missing or spurious windows both fail
+        assert got == {(-1000, 1.0), (0, 1.0), (1000, 2.0),
+                       (2000, 2.0), (3000, 4.0), (4000, 4.0)}
