@@ -40,7 +40,7 @@ def _cmd_run(args) -> int:
             checkpoint_interval_ms=args.checkpoint_interval,
             restart_attempts=args.restart_attempts,
             extra_sys_path=(_os.getcwd(),))
-        res = pc.run(timeout_s=86400.0)
+        res = pc.run(timeout_s=86400.0, restore=_load_restore(args))
         print(f"job finished: {res['state']} (attempts={res['attempts']}, "
               f"checkpoints={len(res['completed_checkpoints'])})")
         if res["state"] != "FINISHED":
@@ -187,6 +187,14 @@ def _cmd_worker(args) -> int:
                           advertise_host=args.advertise).run()
 
 
+def _load_restore(args):
+    """--restore/-s: explicit savepoint/checkpoint path (or None)."""
+    if not getattr(args, "restore", None):
+        return None
+    from flink_tpu.runtime.checkpoint.storage import read_savepoint
+    return read_savepoint(args.restore)
+
+
 def _cmd_coordinate(args) -> int:
     import json as _json
 
@@ -199,12 +207,17 @@ def _cmd_coordinate(args) -> int:
     host, port = args.listen.rsplit(":", 1)
     # same FLINK_TPU_SSL_*/FLINK_TPU_AUTH_TOKEN env contract as workers —
     # on k8s both containers receive the secrets the same way
-    pc = ProcessCluster(args.job, n_workers=args.workers,
-                        checkpoint_storage=storage,
-                        checkpoint_interval_ms=args.checkpoint_interval,
-                        spawn=False, bind_host=host, listen_port=int(port),
-                        security=_security_from_env())
-    res = pc.run(timeout_s=args.timeout)
+    try:
+        pc = ProcessCluster(args.job, n_workers=args.workers,
+                            checkpoint_storage=storage,
+                            checkpoint_interval_ms=args.checkpoint_interval,
+                            spawn=False, bind_host=host,
+                            listen_port=int(port),
+                            security=_security_from_env())
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    res = pc.run(timeout_s=args.timeout, restore=_load_restore(args))
     print(_json.dumps({k: v for k, v in res.items() if k != "rows"},
                       default=str))
     return 0 if res["state"] == "FINISHED" else 1
@@ -226,6 +239,9 @@ def main(argv=None) -> int:
     pr.add_argument("--checkpoint-dir", default=None)
     pr.add_argument("--checkpoint-interval", type=int, default=0)
     pr.add_argument("--restart-attempts", type=int, default=0)
+    pr.add_argument("--restore", "-s", default=None,
+                    help="savepoint/checkpoint path to restore from "
+                         "(a fresh run never resumes implicitly)")
     pr.set_defaults(fn=_cmd_run)
     ps = sub.add_parser("sql", help="run a SQL query")
     ps.add_argument("query")
@@ -252,12 +268,16 @@ def main(argv=None) -> int:
     pw.set_defaults(fn=_cmd_worker)
     pco = sub.add_parser(
         "coordinate", help="cluster coordinator that WAITS for externally "
-        "started workers (k8s / multi-host deployments)")
+        "started workers (k8s / multi-host deployments); non-loopback "
+        "--listen requires TLS env vars (FLINK_TPU_SSL_*) or "
+        "FLINK_TPU_ALLOW_INSECURE=1")
     pco.add_argument("--job", required=True)
     pco.add_argument("--workers", type=int, required=True)
     pco.add_argument("--listen", default="0.0.0.0:6123")
     pco.add_argument("--checkpoint-dir", default=None)
     pco.add_argument("--checkpoint-interval", type=int, default=0)
+    pco.add_argument("--restore", "-s", default=None,
+                    help="savepoint/checkpoint path to restore from")
     pco.add_argument("--timeout", type=float, default=86400.0)
     pco.set_defaults(fn=_cmd_coordinate)
     for name, needs_job in (("list", False), ("status", True),

@@ -39,6 +39,7 @@ worker count — the assignment is re-computed, state is per-subtask).
 from __future__ import annotations
 
 import importlib
+import json
 import os
 import pickle
 import socket
@@ -51,6 +52,30 @@ from typing import Any, Dict, List, Optional, Tuple
 
 _LEN = struct.Struct("<I")
 
+#: handshake frames must never exceed this — a pre-auth peer cannot make
+#: the coordinator buffer arbitrary amounts
+_MAX_HANDSHAKE = 4096
+
+
+def _recv_raw(sock: socket.socket, limit: Optional[int] = None
+              ) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    (n,) = _LEN.unpack(buf)
+    if limit is not None and n > limit:
+        return None
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(min(1 << 20, n - len(data)))
+        if not chunk:
+            return None
+        data += chunk
+    return data
+
 
 def _send_msg(sock: socket.socket, obj: Any, lock: threading.Lock) -> None:
     data = pickle.dumps(obj)
@@ -59,20 +84,39 @@ def _send_msg(sock: socket.socket, obj: Any, lock: threading.Lock) -> None:
 
 
 def _recv_msg(sock: socket.socket) -> Optional[Any]:
-    buf = b""
-    while len(buf) < _LEN.size:
-        chunk = sock.recv(_LEN.size - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    (n,) = _LEN.unpack(buf)
-    data = b""
-    while len(data) < n:
-        chunk = sock.recv(min(1 << 20, n - len(data)))
-        if not chunk:
-            return None
-        data += chunk
-    return pickle.loads(data)
+    """Post-handshake control message (pickle).  Only ever called on a
+    connection whose peer already passed the JSON hello/challenge exchange
+    (and its HMAC, when the cluster has a token) — an unauthenticated peer
+    never reaches a ``pickle.loads``."""
+    data = _recv_raw(sock)
+    return None if data is None else pickle.loads(data)
+
+
+def _send_json(sock: socket.socket, obj: Any, lock: threading.Lock) -> None:
+    """Handshake frame: length-prefixed JSON — non-executable by design, so
+    both ends can parse the peer's FIRST message before trusting it."""
+    data = json.dumps(obj).encode()
+    with lock:
+        sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_json(sock: socket.socket) -> Optional[Any]:
+    data = _recv_raw(sock, limit=_MAX_HANDSHAKE)
+    if data is None:
+        return None
+    try:
+        return json.loads(data)
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def _require_secure_bind(bind_host: str, security, role: str) -> None:
+    """Shared bind policy (``cluster.net.require_secure_bind``) applied to a
+    :class:`SecurityConfig`."""
+    from flink_tpu.cluster.net import require_secure_bind
+
+    has_tls = security is not None and security.internal_ssl
+    require_secure_bind(bind_host, has_tls, role)
 
 
 def build_plan(job: str):
@@ -165,7 +209,12 @@ class _WorkerRuntime:
             server_ctx = self.security.server_context()
             client_ctx = self.security.client_context()
         self._client_ssl = client_ctx
-        self.server = ChannelServer(host=bind_host, ssl_context=server_ctx)
+        #: data-plane HMAC: channel HELLOs are signed with the cluster
+        #: token, so worker ports never decode unauthenticated batches
+        self._data_token = (self.security.auth_token
+                            if self.security is not None else None)
+        self.server = ChannelServer(host=bind_host, ssl_context=server_ctx,
+                                    auth_token=self._data_token)
         #: address other workers dial (pod IP / service DNS on k8s)
         self.advertise_host = advertise_host or self.server.host
         self.sock = socket.create_connection((coord_host, coord_port),
@@ -275,7 +324,8 @@ class _WorkerRuntime:
                     elif p_local:
                         host, port = addresses[assign[(tgt.uid, ci)]]
                         ch = RemoteChannel(host, port, chan_id,
-                                           ssl_context=self._client_ssl)
+                                           ssl_context=self._client_ssl,
+                                           auth_token=self._data_token)
                         self._remote_writers.append(ch)
                     elif c_local:
                         q = self.server.channel(chan_id)
@@ -330,19 +380,26 @@ class _WorkerRuntime:
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> int:
-        # auth handshake: the coordinator challenges, the worker answers
-        # with an HMAC over the nonce (cluster shared secret)
-        msg = _recv_msg(self.sock)
-        if not msg or msg[0] != "challenge":
+        # auth handshake, JSON both ways (never pickle pre-auth): the
+        # coordinator challenges, the worker answers with an HMAC over the
+        # nonce (cluster shared secret)
+        msg = _recv_json(self.sock)
+        if not isinstance(msg, dict) or msg.get("kind") != "challenge":
             return 1
-        nonce = msg[1]
-        mac = None
-        if nonce is not None:
+        nonce_hex = msg.get("nonce")
+        mac_hex = None
+        if nonce_hex is not None:
             if self.security is None or self.security.auth_token is None:
                 return 1  # cluster requires a token this worker lacks
-            mac = self.security.sign(nonce)
-        self._send(("hello", self.index, self.advertise_host,
-                    self.server.port, mac))
+            try:
+                nonce = bytes.fromhex(nonce_hex)
+            except (TypeError, ValueError):
+                return 1  # malformed challenge
+            mac_hex = self.security.sign(nonce).hex()
+        _send_json(self.sock, {"kind": "hello", "index": self.index,
+                               "host": self.advertise_host,
+                               "port": self.server.port, "mac": mac_hex},
+                   self._send_lock)
         while True:
             msg = _recv_msg(self.sock)
             if msg is None:
@@ -406,6 +463,8 @@ class ProcessCluster:
         #: LISTENS — workers are started externally (k8s pods, other hosts)
         #: and dial in with `flink_tpu worker --coordinator host:port`
         self.spawn = spawn
+        _require_secure_bind(bind_host, security,
+                             "ProcessCluster control plane")
         self.bind_host = bind_host
         self.listen_port = listen_port
         #: worker-loss recovery (spawn=True only): a failed execution is
@@ -451,8 +510,14 @@ class ProcessCluster:
         while True:
             if attempt > 0:
                 self._reset_attempt()
-                latest = (self.checkpoint_storage.load_latest()
-                          if self.checkpoint_storage is not None else None)
+                # restore ONLY from a checkpoint THIS run completed — a
+                # reused checkpoint dir may hold higher-numbered checkpoints
+                # from a previous execution, and load_latest() would silently
+                # resume a different job's state
+                latest = None
+                if self.checkpoint_storage is not None and self._completed_ids:
+                    latest = self.checkpoint_storage.load(
+                        max(self._completed_ids))
                 # no checkpoint completed yet: fall back to the restore the
                 # CALLER supplied (a savepoint must not silently drop)
                 restore = latest or original_restore
@@ -471,8 +536,10 @@ class ProcessCluster:
         self._counts, _ = subtask_counts_of(plan)
         all_subtasks = {(uid, i) for uid, n in self._counts.items()
                         for i in range(n)}
-        if restore is None and self.checkpoint_storage is not None:
-            restore = self.checkpoint_storage.load_latest()
+        # NOTE: no implicit load_latest() here — a fresh run with a reused
+        # --checkpoint-dir starts fresh unless the caller passed an explicit
+        # restore (the reference's -s savepoint semantics); the restart loop
+        # in run() consults the latest checkpoint only for attempt > 0
         srv = socket.create_server((self.bind_host, self.listen_port))
         _, cport = srv.getsockname()[:2]
         self.control_port = cport
@@ -604,22 +671,33 @@ class ProcessCluster:
                 if server_ctx is not None:
                     conn = server_ctx.wrap_socket(conn, server_side=True)
                 nonce = os.urandom(32) if need_token else None
-                _send_msg(conn, ("challenge", nonce), tmp_lock)
-                msg = _recv_msg(conn)
-                if not (isinstance(msg, tuple) and len(msg) == 5
-                        and msg[0] == "hello"):
+                _send_json(conn, {"kind": "challenge",
+                                  "nonce": nonce.hex() if nonce else None},
+                           tmp_lock)
+                # the hello is JSON (parsed, never unpickled) and the HMAC
+                # is verified BEFORE this connection graduates to the
+                # pickle control protocol
+                msg = _recv_json(conn)
+                if not isinstance(msg, dict) or msg.get("kind") != "hello":
                     conn.close()
                     continue
-                _, idx, host, port, mac = msg
+                idx, host = msg.get("index"), msg.get("host")
+                port, mac_hex = msg.get("port"), msg.get("mac")
                 if not isinstance(idx, int) \
                         or not 0 <= idx < self.n_workers \
-                        or idx in addresses:
+                        or idx in addresses \
+                        or not isinstance(host, str) \
+                        or not isinstance(port, int):
                     conn.close()
                     continue
-                if need_token and not self.security.verify(
-                        nonce, mac or b""):
-                    conn.close()
-                    continue
+                if need_token:
+                    try:
+                        mac = bytes.fromhex(mac_hex or "")
+                    except ValueError:
+                        mac = b""
+                    if not self.security.verify(nonce, mac):
+                        conn.close()
+                        continue
                 conn.settimeout(None)
             except socket.timeout:
                 # per-connection stall, NOT the accept timeout: drop it

@@ -19,13 +19,25 @@ blocking across channels: each channel has its own connection + budget).
 Wire format per frame:  ``type u8 | length u32le | payload``
   type 0 = RecordBatch (FTB), 1 = control element (JSON),
   type 2 = credit grant (receiver -> sender, count u32 payload),
-  type 3 = handshake (sender -> receiver: channel id utf-8),
-  type 4 = tagged batch (side output): tag length u16le | tag utf-8 | FTB.
+  type 3 = handshake (sender -> receiver:
+           ``mac_len u8 | mac | channel id utf-8``),
+  type 4 = tagged batch (side output): tag length u16le | tag utf-8 | FTB,
+  type 5 = challenge (receiver -> sender on accept: nonce bytes).
+
+**Authentication:** batches carry pickled object columns, so the receiver
+must never decode a frame from an unauthenticated peer.  On accept the
+server sends a ``_CHALLENGE`` nonce; the sender's HELLO carries
+``HMAC-SHA256(token, nonce + channel_id)``.  A server configured with an
+``auth_token`` drops any connection whose MAC fails BEFORE decoding
+anything else; TLS (mutual) is layered underneath via ``ssl_context``.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 import json
+import os
 import socket
 import struct
 import threading
@@ -37,7 +49,32 @@ from flink_tpu.core.batch import (CheckpointBarrier, EndOfInput,
                                   StreamStatus, TaggedBatch, Watermark)
 
 _HDR = struct.Struct("<BI")
-_BATCH, _CONTROL, _CREDIT, _HELLO, _TAGGED = 0, 1, 2, 3, 4
+_BATCH, _CONTROL, _CREDIT, _HELLO, _TAGGED, _CHALLENGE = 0, 1, 2, 3, 4, 5
+
+
+def _mac(token: str, nonce: bytes, channel_id: bytes) -> bytes:
+    return hmac_mod.new(token.encode(), nonce + channel_id,
+                        hashlib.sha256).digest()
+
+
+_LOOPBACK = ("127.0.0.1", "localhost", "::1")
+
+
+def require_secure_bind(host: str, has_tls: bool, role: str,
+                        detail: str = "") -> None:
+    """Single policy for every listening endpoint: a non-loopback bind
+    requires TLS (the reference's ``security.ssl.internal.enabled``
+    posture); ``FLINK_TPU_ALLOW_INSECURE=1`` overrides for trusted
+    networks.  Token-only auth gates handshakes but cannot stop an on-path
+    attacker injecting frames into an established stream — hence TLS."""
+    if host in _LOOPBACK or has_tls:
+        return
+    if os.environ.get("FLINK_TPU_ALLOW_INSECURE") == "1":
+        return
+    raise ValueError(
+        f"{role} would bind {host!r} (non-loopback) without TLS{detail}; "
+        f"configure mutual TLS or set FLINK_TPU_ALLOW_INSECURE=1 for a "
+        f"trusted network")
 
 
 def _encode_control(el: StreamElement) -> bytes:
@@ -154,9 +191,13 @@ class ChannelServer:
     reference."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 channel_capacity: int = 32, ssl_context=None):
+                 channel_capacity: int = 32, ssl_context=None,
+                 auth_token: Optional[str] = None):
+        require_secure_bind(host, ssl_context is not None, "ChannelServer",
+                            detail=" (batches carry pickled columns)")
         self.channel_capacity = channel_capacity
         self._ssl = ssl_context
+        self._auth_token = auth_token
         self._queues: Dict[str, _ReceiveQueue] = {}
         self._lock = threading.Lock()
         self._srv = socket.create_server((host, port))
@@ -194,11 +235,23 @@ class ChannelServer:
             if self._ssl is not None:
                 # handshake on the connection thread (it can block)
                 conn = self._ssl.wrap_socket(conn, server_side=True)
+            # a pre-auth peer must not stall the thread or feed us frames:
+            # bounded handshake window, MAC verified before ANY decode
+            conn.settimeout(30)
+            nonce = os.urandom(32)
+            _send_frame(conn, _CHALLENGE, nonce)
             ftype, payload = _recv_frame(conn)
-            if ftype != _HELLO:
+            if ftype != _HELLO or not payload:
                 conn.close()
                 return
-            q = self.channel(payload.decode())
+            mac_len = payload[0]
+            mac, chan = payload[1:1 + mac_len], payload[1 + mac_len:]
+            if self._auth_token is not None and not hmac_mod.compare_digest(
+                    _mac(self._auth_token, nonce, chan), mac):
+                conn.close()
+                return
+            conn.settimeout(None)
+            q = self.channel(chan.decode())
             q._attach(conn)
             # initial credit grant = queue capacity (exclusive buffers)
             _send_frame(conn, _CREDIT, struct.pack("<I", q.capacity))
@@ -235,7 +288,8 @@ class RemoteChannel:
     """Sender side: LocalChannel-shaped ``put`` over TCP with credits."""
 
     def __init__(self, host: str, port: int, channel_id: str,
-                 connect_timeout_s: float = 10.0, ssl_context=None):
+                 connect_timeout_s: float = 10.0, ssl_context=None,
+                 auth_token: Optional[str] = None):
         self.channel_id = channel_id
         self._sock = socket.create_connection((host, port),
                                               timeout=connect_timeout_s)
@@ -243,17 +297,39 @@ class RemoteChannel:
             self._sock = ssl_context.wrap_socket(self._sock,
                                                  server_hostname=host)
         self._sock.settimeout(None)
-        _send_frame(self._sock, _HELLO, channel_id.encode())
+        self._auth_token = auth_token
         self._credits = 0
         self._lock = threading.Lock()
         self._have_credit = threading.Condition(self._lock)
         self._closed = False
+        #: set when the connection died before the server ever granted
+        #: credit — a rejected handshake (auth failure), which must surface
+        #: as an error, not as silent backpressure-drop
+        self._error: Optional[str] = None
+        self._got_credit = False
         self._reader = threading.Thread(target=self._credit_loop,
                                         name=f"credits-{channel_id}",
                                         daemon=True)
         self._reader.start()
 
     def _credit_loop(self) -> None:
+        # answer the server's challenge first (HELLO carries the HMAC over
+        # nonce + channel id); credits only start flowing once the server
+        # accepted it, so put() blocks until the channel is authenticated
+        try:
+            ftype, nonce = _recv_frame(self._sock)
+            if ftype != _CHALLENGE:
+                raise OSError("bad data-plane challenge")
+            cid = self.channel_id.encode()
+            mac = (_mac(self._auth_token, nonce, cid)
+                   if self._auth_token else b"")
+            _send_frame(self._sock, _HELLO, bytes([len(mac)]) + mac + cid)
+        except OSError as e:
+            with self._have_credit:
+                self._closed = True
+                self._error = f"channel {self.channel_id}: handshake failed ({e})"
+                self._have_credit.notify_all()
+            return
         while True:
             try:
                 ftype, payload = _recv_frame(self._sock)
@@ -261,12 +337,23 @@ class RemoteChannel:
                 ftype = None  # reset by peer == closed
             if ftype is None:
                 with self._have_credit:
+                    if not self._got_credit and not self._closed \
+                            and self._auth_token is not None:
+                        # server hung up before the initial credit grant on
+                        # an authenticated channel: the HELLO was rejected
+                        # (bad/missing MAC).  A local close() or a token-less
+                        # channel stays a benign close (put returns False).
+                        self._error = (
+                            f"channel {self.channel_id}: connection rejected "
+                            f"before any credit grant — data-plane "
+                            f"authentication failed (token mismatch?)")
                     self._closed = True
                     self._have_credit.notify_all()
                 return
             if ftype == _CREDIT:
                 (n,) = struct.unpack("<I", payload)
                 with self._have_credit:
+                    self._got_credit = True
                     self._credits += n
                     self._have_credit.notify_all()
 
@@ -279,6 +366,10 @@ class RemoteChannel:
                 if not self._have_credit.wait(timeout=timeout_s):
                     return False
             if self._closed:
+                if self._error is not None:
+                    # auth rejection: dropping silently would let the job
+                    # "succeed" with missing data — fail the producer task
+                    raise ConnectionError(self._error)
                 return False
             self._credits -= 1
         try:

@@ -504,7 +504,8 @@ class KeyedStream(DataStream):
 
         assigner = GlobalWindows.create()
         assigner.is_event_time = False  # counts, not timestamps, drive fires
-        return self.window(assigner).trigger(CountTrigger.of(size))
+        return self.window(assigner).trigger(CountTrigger.of(size,
+                                                             purge=True))
 
     def window(self, assigner: WindowAssigner) -> "WindowedStream":
         return WindowedStream(self, assigner)

@@ -541,9 +541,14 @@ class Planner:
                 raise PlanError(
                     f"OVER ORDER BY must be the table rowtime ({rowtime!r}) "
                     f"— streaming over-aggregates are time-ordered")
-            if rowtime is None and not table.timestamps_assigned:
-                raise PlanError("OVER ORDER BY needs a time attribute; "
-                                "declare a rowtime column on the table")
+            if rowtime is None:
+                # timestamps may already be assigned on the stream (derived
+                # table), but without a known rowtime COLUMN we cannot prove
+                # the ORDER BY attribute matches them — buffering by the
+                # wrong attribute would silently mis-order the aggregate
+                raise PlanError("OVER ORDER BY needs a time attribute with a "
+                                "known rowtime column; declare a rowtime "
+                                "column on the table")
             if not over0.ascending:
                 raise PlanError("OVER ORDER BY on the rowtime must be ASC")
             event_time = True

@@ -84,23 +84,23 @@ class CountTrigger(Trigger):
     """FIRE when a key's window holds >= n elements (``CountTrigger.java``);
     evaluated after each micro-batch against the device count state.
 
-    ``purge=True`` (default) is the ``countWindow`` behavior
-    (``PurgingTrigger(CountTrigger)``): fired state clears, the next fire
-    needs n fresh elements.  ``purge=False`` is the reference's raw
-    ``CountTrigger``: FIRE only — the window keeps accumulating and fires
-    again every n elements with the full running contents.  Sliding
-    (multi-pane) assigners support only ``purge=False``, because
-    overlapping windows share pane state."""
+    ``purge=False`` (default — matching the reference's raw ``CountTrigger``,
+    FIRE only): the window keeps accumulating and fires again every n
+    elements with the full running contents.  ``purge=True`` is the
+    ``countWindow`` behavior (``PurgingTrigger(CountTrigger)``): fired state
+    clears, the next fire needs n fresh elements — ``count_window()`` passes
+    it explicitly.  Sliding (multi-pane) assigners support only
+    ``purge=False``, because overlapping windows share pane state."""
 
     fires_on_time = False
     fires_on_count = True
 
-    def __init__(self, n: int, purge: bool = True):
+    def __init__(self, n: int, purge: bool = False):
         self.count_threshold = int(n)
         self.purges_on_fire = bool(purge)
 
     @staticmethod
-    def of(n: int, purge: bool = True) -> "CountTrigger":
+    def of(n: int, purge: bool = False) -> "CountTrigger":
         return CountTrigger(n, purge)
 
 

@@ -217,7 +217,8 @@ class TestCountWindows:
     def test_count_trigger_fire_and_purge(self):
         op = WindowAggOperator(
             GlobalWindows.create(), SumAggregator(np.float32),
-            key_column="key", value_column="v", trigger=CountTrigger.of(2),
+            key_column="key", value_column="v",
+            trigger=CountTrigger.of(2, purge=True),
             emit_window_bounds=False)
         h = KeyedOneInputOperatorHarness(op)
         r, t = rows((1, 1.0, 0), (1, 2.0, 0), (2, 5.0, 0))
@@ -365,7 +366,7 @@ def test_count_trigger_over_tumbling_windows():
 
     op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
                            SumAggregator(jnp.float32), key_column="k",
-                           value_column="v", trigger=CountTrigger.of(3))
+                           value_column="v", trigger=CountTrigger.of(3, purge=True))
     op.open(RuntimeContext())
     # key 1 gets 3 records in window [0,1000) -> fires on the third
     out = op.process_batch(RecordBatch(
@@ -467,7 +468,8 @@ def test_count_trigger_purging_sliding_rejected():
     with pytest.raises(NotImplementedError, match="PURGING"):
         WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
                           SumAggregator(jnp.float32), key_column="k",
-                          value_column="v", trigger=CountTrigger.of(2))
+                          value_column="v",
+                          trigger=CountTrigger.of(2, purge=True))
 
 
 def test_count_trigger_nonpurging_tumbling_running_total():
