@@ -693,8 +693,8 @@ class ProcessCluster:
                 if need_token:
                     try:
                         mac = bytes.fromhex(mac_hex or "")
-                    except ValueError:
-                        mac = b""
+                    except (TypeError, ValueError):
+                        mac = b""  # non-string / malformed hex: fails verify
                     if not self.security.verify(nonce, mac):
                         conn.close()
                         continue

@@ -43,13 +43,28 @@ class StreamExecutionEnvironment:
     """``StreamExecutionEnvironment`` analog: source factories + execute()."""
 
     def __init__(self, config: Optional[Configuration] = None,
-                 parallelism: int = 1, max_parallelism: int = 128):
+                 parallelism: int = 1, max_parallelism: int = 128,
+                 mesh=None):
         self.config = config or Configuration()
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
         self._sinks: List[Transformation] = []
         self.checkpoint_interval_ms = 0
         self.checkpoint_storage = None
+        #: jax.sharding.Mesh: keyed window state shards over it and keyed
+        #: records ride the all_to_all device exchange (parallel/mesh_runtime)
+        self.mesh = mesh
+
+    def set_mesh(self, mesh=None, n_devices: Optional[int] = None
+                 ) -> "StreamExecutionEnvironment":
+        """Execute keyed window aggregations sharded over a device mesh —
+        the TPU scale-out axis (key groups -> devices, SURVEY §2.7).  With
+        no arguments, a mesh over all visible devices."""
+        if mesh is None:
+            from flink_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh(n_devices)
+        self.mesh = mesh
+        return self
 
     @staticmethod
     def get_execution_environment(
@@ -658,13 +673,20 @@ class WindowedStream:
                     output_column=output_column, name=name,
                     late_output_tag=late_tag)
         else:
+            mesh = keyed.env.mesh
+
             def factory():
-                return WindowAggOperator(
+                kwargs = dict(
                     assigner=assigner, agg=agg, key_column=keyed.key_column,
                     value_column=value_column, value_selector=value_selector,
                     allowed_lateness_ms=lateness, trigger=trigger,
                     output_column=output_column, name=name,
                     late_output_tag=late_tag)
+                if mesh is not None:
+                    from flink_tpu.parallel.mesh_runtime import (
+                        MeshWindowAggOperator)
+                    return MeshWindowAggOperator(mesh=mesh, **kwargs)
+                return WindowAggOperator(**kwargs)
 
         t = keyed._then(name, factory)
         return DataStream(keyed.env, t)

@@ -16,10 +16,16 @@ loop:
   ``(key_slot, pane_slot)`` cells (``ops/scatter.py``).  This replaces the
   reference's per-record ``windowState.add(value)``
   (``WindowOperator.java:422`` → ``HeapAggregatingState.java:42``).
-- Watermark advance fires every window whose end it passed: gather the
-  window's pane set, tree-combine, ``get_result``, emit rows for keys with
-  data — the batched analog of timer-queue polling + ``emitWindowContents``
+- Watermark advance fires every window whose end it passed: a **host emit
+  mirror** (pane id -> bool[K], maintained from the scatter ids the host
+  already computes) yields the exact emit set without any device->host
+  metadata traffic; the device gathers just those key rows, combines their
+  panes, and downloads ONLY the result values — the batched analog of
+  timer-queue polling + ``emitWindowContents``
   (``InternalTimerServiceImpl.advanceWatermark`` → ``onEventTime:459``).
+  Device->host bytes are the scarce resource (tunnel transport: ~3MB/s down
+  vs ~1.5GB/s up), so fires ship ``emitted_rows × value_bytes`` and nothing
+  else.
 - **Allowed lateness** (``WindowOperator.java:630`` cleanup timers): panes are
   retained until ``last_window_end + lateness`` passes the watermark; late
   records within lateness fold into the retained panes and immediately
@@ -55,27 +61,14 @@ from flink_tpu.windowing.assigners import GlobalWindows, WindowAssigner
 from flink_tpu.windowing.triggers import EventTimeTrigger, Trigger
 
 
-def _compact_indices(mask, cap: int, fill: int):
-    """(count, idx[cap]) of True positions, in order; extra rows get ``fill``.
-
-    Equivalent to ``jnp.nonzero(mask, size=cap, fill_value=fill)`` but built
-    from a HIERARCHICAL cumsum (2-D reshape): XLA compiles the flat 1M-element
-    cumsum of ``nonzero`` in ~27s on TPU, the row/column decomposition in
-    ~1.8s with identical sub-ms execution."""
-    K = mask.shape[0]
-    R = 1 << (max(K.bit_length() - 1, 2) // 2)
-    while K % R:
-        R >>= 1
-    C = K // R
-    m2 = mask.reshape(R, C)
-    within = jnp.cumsum(m2, axis=1)
-    row_tot = within[:, -1]
-    offs = jnp.cumsum(row_tot) - row_tot
-    pos = (within - 1 + offs[:, None]).reshape(K)
-    write = jnp.where(mask, pos, cap).astype(jnp.int32)
-    idx = jnp.full((cap,), fill, jnp.int32).at[write].set(
-        jnp.arange(K, dtype=jnp.int32), mode="drop")
-    return row_tot.sum().astype(jnp.int32), idx
+def _quantize_cap(n: int) -> int:
+    """Static gather width for ``n`` emitted rows: rounded up to 1/8-pow2
+    steps, so the jit cache holds at most 8 entries per size decade while
+    padding waste stays <=12.5% (the download is the scarce resource —
+    see the tunnel-asymmetry note in ``_fire_window``)."""
+    p = _next_pow2(max(n, 64))
+    q = max(p // 8, 64)
+    return ((n + q - 1) // q) * q
 
 
 def _fetch_enqueue(arrays, chunk_bytes: int = 0):
@@ -105,11 +98,6 @@ def _fetch_collect(sliced):
         else:
             out.append(np.concatenate([np.asarray(c) for c in chunks]))
     return out
-
-
-def _fetch_chunked(arrays, chunk_bytes: int = 0):
-    """Blocking fetch (enqueue + collect)."""
-    return _fetch_collect(_fetch_enqueue(arrays))
 
 
 def _handle_ready(sliced) -> bool:
@@ -222,6 +210,14 @@ class WindowAggOperator(StreamOperator):
         #: fired per key slot (the CountTrigger count register, which clears
         #: on FIRE — next fire needs n MORE elements)
         self._count_baselines: Dict[int, np.ndarray] = {}
+        #: host emit mirror: pane id -> bool[K] "this (key, pane) cell holds
+        #: data".  The host computes every scatter id, so it KNOWS which keys
+        #: a window will emit — fires upload the exact emit index and
+        #: download only the emitted rows' values.  On the tunnel transport
+        #: device->host bytes are ~500x more expensive than host->device
+        #: (measured ~3MB/s vs ~1.5GB/s), so eliminating mask/count/index
+        #: downloads is the difference between a 4MB and a <1MB fire.
+        self._mirror: Dict[int, np.ndarray] = {}
         self.pane_base: Optional[int] = None   # smallest retained pane id
         self.max_pane: Optional[int] = None    # largest pane seen
         self.last_fired_window: Optional[int] = None
@@ -307,7 +303,7 @@ class WindowAggOperator(StreamOperator):
         self._counts = None
         self._count_baselines = {}
         self._pending_fires = []
-        self._emit_hist = []
+        self._mirror = {}
         self.pane_base = None
         self.max_pane = None
         self.last_fired_window = None
@@ -331,8 +327,36 @@ class WindowAggOperator(StreamOperator):
         if self._leaves is None:
             self._leaves, self._counts = self._alloc(self._K, self._P)
 
+    # -------------------------------------------------------- emit mirror
+    def _mirror_mark(self, pane: int, slots: np.ndarray) -> None:
+        arr = self._mirror.get(pane)
+        if arr is None or arr.size < self._K:
+            grown = np.zeros(self._K, bool)
+            if arr is not None:
+                grown[: arr.size] = arr
+            arr = self._mirror[pane] = grown
+        arr[slots] = True
+
+    def _mirror_emit_idx(self, panes: np.ndarray) -> np.ndarray:
+        """Exact ascending key-slot ids that hold data in any of ``panes``."""
+        n = self.key_index.num_keys if self.key_index is not None else 0
+        acc = None
+        for p in panes.tolist():
+            arr = self._mirror.get(int(p))
+            if arr is None:
+                continue
+            a = arr[:n] if arr.size >= n else np.pad(arr, (0, n - arr.size))
+            acc = a.copy() if acc is None else (acc | a)
+        if acc is None:
+            return np.empty(0, np.int64)
+        return np.flatnonzero(acc)
+
+    def _round_key_capacity(self, needed: int) -> int:
+        """pow2 growth; subclasses may strengthen (e.g. mesh divisibility)."""
+        return _next_pow2(needed, self._K)
+
     def _grow_keys(self, needed: int):
-        newK = _next_pow2(needed, self._K)
+        newK = self._round_key_capacity(needed)
         if newK == self._K and self._leaves is not None:
             return
         old_leaves, old_counts = self._leaves, self._counts
@@ -418,127 +442,40 @@ class WindowAggOperator(StreamOperator):
             ka <<= 2
         return min(ka, self._K)
 
-    @partial(jax.jit, static_argnums=(0, 4))
-    def _fire_dense_step(self, leaves, counts, pane_slots, k_active: int):
-        """Fire + DENSE download layout: (mask bits u32[K/32], result leaves
-        [K, ...]).  For high-hit-rate fires (most keys emit) the dense form
-        moves ~2x fewer bytes than the packed idx+gather form."""
-        mask, result = self._fire_core(leaves, counts, pane_slots, k_active)
-        K = mask.shape[0]
-        pad = (-K) % 32
-        m = mask
-        if pad:
-            m = jnp.concatenate([m, jnp.zeros((pad,), bool)])
-        bits = (m.reshape(-1, 32).astype(jnp.uint32)
-                << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1,
-                                                         dtype=jnp.uint32)
-        return bits, result
-
-    @partial(jax.jit, static_argnums=(0, 4, 5))
-    def _fire_pack_step(self, leaves, counts, pane_slots, k_active: int,
-                        cap: int):
-        """Fire + device-side emit compaction: ONE packed int32 download of
-        [1 + cap + cap*row_words]: [count, nonzero key slots (padded), result
-        rows bitcast to i32].  Host↔device traffic per fire scales with rows
-        *emitted*, not allocated key capacity — the transfer-bound analog of
-        the reference emitting only non-empty windows
+    @partial(jax.jit, static_argnums=(0,))
+    def _fire_gather_step(self, leaves, pane_slots, idx):
+        """Fire for a host-known emit set: gather the ``idx`` key rows FIRST
+        (compute and download scale with rows *emitted*, not key capacity),
+        combine their window panes, ``get_result``.  The emit index is
+        host-derived from the mirror — nothing but result values ever rides
+        the (slow) device->host direction.  The batched analog of the
+        reference emitting only non-empty windows
         (``WindowOperator.emitWindowContents:574``)."""
-        mask, result = self._fire_core(leaves, counts, pane_slots, k_active)
-        K = k_active if (k_active and k_active < counts.shape[0]) else counts.shape[0]
-        n, idx = _compact_indices(mask, cap, K)
-        parts = [n.reshape(1), idx.astype(jnp.int32)]
-        for l in jax.tree_util.tree_leaves(result):
-            g = jnp.take(l, jnp.minimum(idx, K - 1), axis=0)
-            g = g.reshape(cap, -1)
-            if g.dtype != jnp.int32:
-                if g.dtype.itemsize < 4:  # sub-word dtypes widen to f32
-                    g = g.astype(jnp.float32)
-                # 8-byte dtypes bitcast to TWO i32 words each (exact)
-                g = jax.lax.bitcast_convert_type(g, jnp.int32)
-            parts.append(g.reshape(-1))
-        return jnp.concatenate(parts)
+        sel = tuple(jnp.take(jnp.take(l, idx, axis=0), pane_slots, axis=1)
+                    for l in leaves)
+        combined = combine_along_axis(sel, self.agg.combine_leaves, axis=1)
+        return self.agg.get_result(self.spec.unflatten(combined))
 
-    def _result_layout(self):
-        """(treedef, [(shape, dtype)]) of one result row — cached eval_shape."""
-        cached = getattr(self, "_result_layout_cache", None)
-        if cached is None:
-            def one(leaves):
-                combined = combine_along_axis(
-                    tuple(l[:, None] for l in leaves), self.agg.combine_leaves,
-                    axis=1)
-                return self.agg.get_result(self.spec.unflatten(combined))
-            dummies = tuple(
-                jax.ShapeDtypeStruct((1,) + tuple(s), d)
-                for s, d in zip(self.spec.leaf_shapes, self.spec.leaf_dtypes))
-            out = jax.eval_shape(one, dummies)
-            leaves, treedef = jax.tree_util.tree_flatten(out)
-            cached = (treedef, [(l.shape[1:], np.dtype(l.dtype)) for l in leaves])
-            self._result_layout_cache = cached
-        return cached
-
-    def _fire_window_packed(self, window_id: int,
-                            pane_slots) -> List[StreamElement]:
-        """Transfer-efficient fire for unsharded state (packed download with
-        capacity doubling; falls back to full width when the emit overflows)."""
-        ka = self._k_active() or self._K
-        # cap derives from ka (one compile per ka step), boosted ×4 on
-        # overflow — grow-only, so compiles stay O(log) over the run
-        boost = getattr(self, "_emit_boost", 1)
-        cap = min(ka, max(1024, (ka >> 3) * boost))
-        treedef, row_layout = self._result_layout()
-        [packed] = _fetch_chunked([self._fire_pack_step(
-            self._leaves, self._counts, pane_slots, self._k_active(), cap)])
-        n = int(packed[0])
-        while n > cap and cap < ka:  # overflow: boost and retry
-            boost = self._emit_boost = boost * 4
-            cap = min(ka, max(1024, (ka >> 3) * boost))
-            [packed] = _fetch_chunked([self._fire_pack_step(
-                self._leaves, self._counts, pane_slots, self._k_active(), cap)])
-            n = int(packed[0])
-        self._note_emit(n)
+    def _fire_window_gather(self, window_id: int,
+                            panes: np.ndarray) -> List[StreamElement]:
+        """Mirror-indexed fire (unsharded state): exact emit set from the
+        host mirror, one values-only download."""
+        idx = self._mirror_emit_idx(panes)
+        n = idx.size
         if n == 0:
             return []
-        idx = packed[1:1 + cap][:n]
-        res_leaves = []
-        off = 1 + cap
-        for shape, dtype in row_layout:
-            # word layout mirrors _fire_pack_step: 4-byte dtypes = 1 i32 word,
-            # 8-byte = 2 words (exact bitcast), sub-word = 1 word via f32
-            elems = int(np.prod(shape, dtype=np.int64)) or 1
-            wpe = dtype.itemsize // 4 if dtype.itemsize >= 4 else 1
-            words = elems * wpe
-            seg = np.ascontiguousarray(
-                packed[off:off + cap * words].reshape(cap, words)[:n])
-            if dtype == np.int32:
-                arr = seg.reshape((n,) + tuple(shape))
-            elif dtype.itemsize >= 4:
-                arr = seg.view(dtype).reshape((n,) + tuple(shape))
-            else:
-                arr = seg.view(np.float32).astype(dtype).reshape((n,) + tuple(shape))
-            res_leaves.append(arr)
-            off += cap * words
-        result = jax.tree_util.tree_unflatten(treedef, res_leaves)
-        return self._rows_for(np.asarray(idx), result,
-                              self.assigner.window_bounds(window_id))
-
-    def _fire_window_dense(self, window_id: int,
-                           pane_slots) -> List[StreamElement]:
-        bits, result = self._fire_dense_step(
-            self._leaves, self._counts, pane_slots, self._k_active())
-        res_leaves = jax.tree_util.tree_leaves(result)
-        handle = _fetch_enqueue([bits] + list(res_leaves))
+        cap = _quantize_cap(n)
+        idx_p = np.zeros(cap, np.int32)
+        idx_p[:n] = idx
+        pane_slots = jnp.asarray(panes % self._P, jnp.int32)
+        result = self._fire_gather_step(self._leaves, pane_slots,
+                                        jnp.asarray(idx_p))
+        handle = _fetch_enqueue(jax.tree_util.tree_leaves(result))
         treedef = jax.tree_util.tree_structure(result)
         if self.async_fire:
-            self._pending_fires.append((window_id, handle, treedef))
+            self._pending_fires.append((window_id, idx, handle, treedef))
             return []
-        return self._finish_dense_fire(window_id, handle, treedef)
-
-    def _note_emit(self, n: int) -> None:
-        hist = getattr(self, "_emit_hist", None)
-        if hist is None:
-            hist = self._emit_hist = []
-        hist.append(n)
-        del hist[:-3]
+        return self._finish_gather_fire(window_id, idx, handle, treedef)
 
     def drain_pending_fires(self, force: bool = False) -> List[StreamElement]:
         """Materialize async fire downloads IN ORDER, but only those whose
@@ -552,25 +489,20 @@ class WindowAggOperator(StreamOperator):
             force = True
         out: List[StreamElement] = []
         while self._pending_fires:
-            window_id, handle, treedef = self._pending_fires[0]
+            window_id, idx, handle, treedef = self._pending_fires[0]
             if not force and not _handle_ready(handle):
                 break
             self._pending_fires.pop(0)
-            out.extend(self._finish_dense_fire(window_id, handle, treedef))
+            out.extend(self._finish_gather_fire(window_id, idx, handle,
+                                                treedef))
         return out
 
-    def _finish_dense_fire(self, window_id: int, handle,
-                           treedef) -> List[StreamElement]:
+    def _finish_gather_fire(self, window_id: int, idx: np.ndarray, handle,
+                            treedef) -> List[StreamElement]:
         fetched = _fetch_collect(handle)
-        bits_np, res_np = fetched[0], fetched[1:]
-        mask = np.unpackbits(bits_np.view(np.uint8), bitorder="little")
-        nk = self.key_index.num_keys
-        idx = np.nonzero(mask[:nk])[0]
-        self._note_emit(idx.size)
-        if idx.size == 0:
-            return []
+        n = idx.size
         picked = jax.tree_util.tree_unflatten(
-            treedef, [r[idx] for r in res_np])
+            treedef, [r[:n] for r in fetched])
         return self._rows_for(idx, picked,
                               self.assigner.window_bounds(window_id))
 
@@ -682,9 +614,20 @@ class WindowAggOperator(StreamOperator):
         values = self._select(cols)
         values_p = jax.tree_util.tree_map(lambda a: _pad_rows(np.asarray(a), Bp), values)
 
+        # np (not device) ids: the jit converts at dispatch, and the mesh
+        # subclass re-routes them through the all_to_all exchange host-side
         self._leaves, self._counts = self._update_step(
-            self._leaves, self._counts,
-            jnp.asarray(flat_p, jnp.int32), values_p)
+            self._leaves, self._counts, flat_p.astype(np.int32), values_p)
+
+        # host emit mirror: record which (key, pane) cells this batch filled
+        # (unsharded path; sharded fires read the device mask instead)
+        if self.sharding is None:
+            uniq_panes = np.unique(panes)
+            if uniq_panes.size == 1:
+                self._mirror_mark(int(uniq_panes[0]), slots)
+            else:
+                for p in uniq_panes.tolist():
+                    self._mirror_mark(int(p), slots[panes == p])
 
         out: List[StreamElement] = list(pending)
         # ---- count-trigger (GlobalWindows / countWindow path)
@@ -804,6 +747,8 @@ class WindowAggOperator(StreamOperator):
         self.pane_base = p
         slots = jnp.asarray(np.asarray(expired, np.int64) % self._P, jnp.int32)
         self._leaves, self._counts = self._clear_panes_step(self._leaves, self._counts, slots)
+        for ep in expired:
+            self._mirror.pop(ep, None)
         if self.pane_base > self.max_pane:
             self.max_pane = self.pane_base
         if self._count_baselines:
@@ -820,23 +765,14 @@ class WindowAggOperator(StreamOperator):
         # skip windows entirely outside retained panes
         if last < self.pane_base or first > self.max_pane:
             return []
+        if self.sharding is None and self.key_index is not None:
+            # clip to retained panes: expired slots are identity on device,
+            # and the mirror only tracks live panes anyway
+            panes = np.arange(max(first, self.pane_base),
+                              min(last, self.max_pane) + 1, dtype=np.int64)
+            return self._fire_window_gather(window_id, panes)
         panes = np.arange(first, last + 1, dtype=np.int64)
         pane_slots = jnp.asarray(panes % self._P, jnp.int32)
-        if self.sharding is None and self.key_index is not None:
-            # expected emit size picks the wire format: dense (bitmask +
-            # full-width rows) when most keys fire, packed (idx + gather)
-            # when sparse — both chunk-async downloaded.  The estimate is the
-            # MAX over recent fires: a single small flush (e.g. the
-            # end-of-input tail) must not flip a steady dense workload onto
-            # the packed path, whose overflow retries cost several downloads.
-            ka = self._k_active() or self._K
-            hist = getattr(self, "_emit_hist", None)
-            expected = max(hist) if hist else ka
-            # async mode pins the dense path: a packed fire is synchronous
-            # and would overtake queued dense fires (out-of-order emission)
-            if self.async_fire or expected * 4 >= ka:
-                return self._fire_window_dense(window_id, pane_slots)
-            return self._fire_window_packed(window_id, pane_slots)
         mask, result = self._fire_step(self._leaves, self._counts, pane_slots,
                                        self._k_active())
         return self._emit(mask, result, self.assigner.window_bounds(window_id))
@@ -877,6 +813,9 @@ class WindowAggOperator(StreamOperator):
             full_mask = jnp.zeros((self._K,), bool).at[:ka].set(mask)
             self._leaves, self._counts = self._purge_keys_step(
                 self._leaves, self._counts, full_mask)
+            fired_np = np.asarray(mask)
+            for arr in self._mirror.values():  # whole key rows were purged
+                arr[: fired_np.size][fired_np] = False
         return out
 
     def _fire_count_in_panes(self, touched_panes) -> List[StreamElement]:
@@ -908,6 +847,10 @@ class WindowAggOperator(StreamOperator):
                 full = jnp.zeros((self._K,), bool).at[:ka].set(mask)
                 self._leaves, self._counts = self._purge_cells_step(
                     self._leaves, self._counts, full, pane_slots)
+                marr = self._mirror.get(int(p))
+                if marr is not None:
+                    fired_np = np.asarray(mask)
+                    marr[: fired_np.size][fired_np] = False
         return out
 
     def _fire_count_sliding(self, touched_panes) -> List[StreamElement]:
@@ -1027,9 +970,10 @@ class WindowAggOperator(StreamOperator):
                 self.key_index = ObjectKeyIndex.restore(snap["key_index"])
             else:
                 self.key_index = KeyIndex.restore(snap["key_index"])
-            self._K = _next_pow2(max(self.key_index.num_keys, 1), self._K)
+            self._K = self._round_key_capacity(max(self.key_index.num_keys, 1))
         self._leaves = None
         self._counts = None
+        self._mirror = {}
         if "leaves" in snap:
             from flink_tpu.state.evolution import migrate_acc_leaves
             self._ensure_alloc()
@@ -1051,6 +995,13 @@ class WindowAggOperator(StreamOperator):
                 l.at[:n, slots].set(jnp.asarray(s))
                 for l, s in zip(self._leaves, leaves))
             self._counts = self._counts.at[:n, slots].set(jnp.asarray(snap["counts"]))
+            # rebuild the host emit mirror from the snapshot's counts
+            self._mirror = {}
+            counts_np = np.asarray(snap["counts"])
+            for j, p in enumerate(panes.tolist()):
+                nz = np.flatnonzero(counts_np[:, j] > 0)
+                if nz.size:
+                    self._mirror_mark(int(p), nz)
         self._count_baselines = {w: np.asarray(b, np.int64).copy()
                                  for w, b in
                                  snap.get("count_baselines", {}).items()}

@@ -5,10 +5,12 @@ with credit-based flow control (``NettyMessage.java``,
 ``RemoteInputChannel.java:302``).  On a TPU mesh the equivalent *intra-pod*
 exchange is a bucketed ``all_to_all`` under ``shard_map``: each device sorts
 its local records into per-destination buckets of fixed capacity and one XLA
-collective rotates the buckets over ICI.  Capacity overflows are reported (not
-silently dropped) so the host-side credit layer can resize — the analog of
-floating-buffer redistribution under backlog feedback
-(``NettyShuffleEnvironmentOptions.java:167``).
+collective rotates the buckets over ICI.  Capacity overflows are reported by
+the raw exchange and handled by :class:`ResizingExchange`, which BLOCKS and
+re-runs at doubled capacity instead of dropping — the analog of
+credit-exhaustion blocking + floating-buffer redistribution under backlog
+feedback (``RemoteInputChannel.java:302``,
+``NettyShuffleEnvironmentOptions.java:167``).
 
 All shapes are static (capacity per destination is fixed per compile), so the
 exchange jits once; padding rows carry slot id == capacity sentinel and are
@@ -85,3 +87,39 @@ def make_all_to_all_exchange(mesh: Mesh, num_leaves: int, cap: int):
     fn = shard_map(_exchange, mesh=mesh, in_specs=in_specs,
                    out_specs=out_specs, check_vma=False)
     return jax.jit(fn)
+
+
+class ResizingExchange:
+    """Zero-loss all_to_all: overflow BLOCKS and renegotiates capacity, it
+    never drops (the reference's credit semantics — a sender without credit
+    waits, ``RemoteInputChannel.java:302``; floating buffers grow under
+    backlog, ``NettyShuffleEnvironmentOptions.java:167``).
+
+    The fixed-cap exchange is pure, so an overflowed round can simply be
+    re-run at double capacity with the SAME inputs — one recompile per
+    doubling, amortized O(log) over a run.  The overflow check is the one
+    host sync per round (the credit check of the hot path); capacity only
+    grows, so steady state pays a single scalar readback."""
+
+    def __init__(self, mesh: Mesh, num_leaves: int, cap: int,
+                 max_cap: int = 1 << 20):
+        self.mesh = mesh
+        self.num_leaves = num_leaves
+        self.cap = cap
+        self.max_cap = max_cap
+        self._fn = make_all_to_all_exchange(mesh, num_leaves, cap)
+
+    def __call__(self, dest, *leaves):
+        """-> (rx_leaves, rx_valid, cap_used).  Every input row is delivered
+        exactly once; raises only if ``max_cap`` cannot hold the skew."""
+        while True:
+            rx, valid, overflow = self._fn(dest, *leaves)
+            if int(jnp.max(overflow)) == 0:
+                return rx, valid, self.cap
+            if self.cap >= self.max_cap:
+                raise RuntimeError(
+                    f"exchange overflow at max capacity {self.max_cap}: "
+                    f"destination skew exceeds the configured buffer budget")
+            self.cap = min(self.cap * 2, self.max_cap)
+            self._fn = make_all_to_all_exchange(self.mesh, self.num_leaves,
+                                                self.cap)

@@ -1,0 +1,75 @@
+"""Regenerate the checked-in savepoint compatibility fixture.
+
+Run from the repo root:  JAX_PLATFORMS=cpu python tests/gen_savepoint_fixture.py
+
+The fixture freezes the CURRENT checkpoint format; the accompanying test
+(``test_savepoint_compat.py``) asserts every later round still restores it —
+the analog of the reference's cross-version snapshot files
+(``OperatorSnapshotUtil.java``, ``flink-stream-stateful-job-upgrade-test``).
+Never regenerate in the same change that alters the snapshot format, unless
+a deliberate (documented) format break with a version bump is intended.
+"""
+
+import os
+import shutil
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+FIXTURE = os.path.join(HERE, "fixtures", "savepoint_v1")
+
+
+def main():
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import AvgAggregator, RuntimeContext, SumAggregator
+    from flink_tpu.operators.session_window import SessionWindowOperator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.runtime.checkpoint.storage import write_savepoint
+    from flink_tpu.windowing.assigners import SessionGap, TumblingEventTimeWindows
+
+    rng = np.random.default_rng(42)
+    keys = rng.integers(0, 50, 400).astype(np.int64)
+    vals = (np.arange(400) % 7).astype(np.float32)
+    ts = np.sort(rng.integers(0, 5000, 400)).astype(np.int64)
+
+    win = WindowAggOperator(
+        TumblingEventTimeWindows.of(10_000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v")
+    win.open(RuntimeContext())
+    win.process_batch(RecordBatch({"k": keys, "v": vals}, timestamps=ts))
+
+    avg = WindowAggOperator(
+        TumblingEventTimeWindows.of(10_000), AvgAggregator(jnp.float32),
+        key_column="k", value_column="v", output_column="avg")
+    avg.open(RuntimeContext())
+    avg.process_batch(RecordBatch({"k": keys, "v": vals}, timestamps=ts))
+
+    sess = SessionWindowOperator(
+        SessionGap(500), SumAggregator(jnp.float32),
+        key_column="k", value_column="v")
+    sess.open(RuntimeContext())
+    sess.process_batch(RecordBatch({"k": keys[:100], "v": vals[:100]},
+                                   timestamps=ts[:100]))
+
+    snapshot = {
+        "tumbling-sum": win.snapshot_state(),
+        "tumbling-avg": avg.snapshot_state(),
+        "session-sum": sess.snapshot_state(),
+        "__fixture__": {
+            "keys": keys, "vals": vals, "ts": ts,
+            "expected_sum_total": float(vals.sum()),
+        },
+    }
+    if os.path.isdir(FIXTURE):
+        shutil.rmtree(FIXTURE)
+    path = write_savepoint(FIXTURE, snapshot)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,8 @@ from flink_tpu.core.batch import RecordBatch
 from flink_tpu.datastream.api import StreamExecutionEnvironment
 from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
 
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------------------
 # restart strategies

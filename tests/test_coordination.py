@@ -14,6 +14,8 @@ from flink_tpu.cluster.rpc import await_future
 from flink_tpu.datastream.api import StreamExecutionEnvironment
 from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
 
+pytestmark = pytest.mark.slow
+
 
 def _plan(n=50_000, keys=13, name="job"):
     env = StreamExecutionEnvironment()

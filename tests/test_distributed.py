@@ -17,6 +17,8 @@ from flink_tpu.cluster.distributed import (ProcessCluster, assign_subtasks,
                                            build_plan, subtask_counts_of)
 from flink_tpu.runtime.checkpoint.storage import FileCheckpointStorage
 
+pytestmark = pytest.mark.slow
+
 JOB_MODULE = textwrap.dedent('''
     """Deterministic job: keyed sum over 2 source splits, parallelism 2."""
     import numpy as np

@@ -15,6 +15,8 @@ from flink_tpu.core.batch import RecordBatch
 from flink_tpu.datastream.api import StreamExecutionEnvironment
 from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
 
+pytestmark = pytest.mark.slow
+
 
 def _fill_input_log(directory: str, n: int, keys: int,
                     partitions: int = 2) -> None:

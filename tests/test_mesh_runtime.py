@@ -1,0 +1,189 @@
+"""Mesh-sharded job runtime (VERDICT r1 #1): env.execute() runs keyed
+pipelines whose window state shards over a device mesh and whose records
+ride the all_to_all device exchange — no __graft_entry__ special-casing.
+
+Reference anchors: the keyed exchange as the runtime
+(``KeyGroupStreamPartitioner.java``, ``NettyMessage.java:254``), key-group
+rescaling (``StateAssignmentOperation.reDistributeKeyedStates``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import RuntimeContext, SumAggregator
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.parallel.mesh import make_mesh
+from flink_tpu.parallel.mesh_runtime import MeshWindowAggOperator
+from flink_tpu.testing.harness import KeyedOneInputOperatorHarness
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+def _wordcount_env(n=5000, n_keys=37, mesh_devices=8):
+    env = StreamExecutionEnvironment().set_mesh(n_devices=mesh_devices)
+    words = (np.arange(n) % n_keys).astype(np.int64)
+    sink = (env.from_collection(
+                columns={"word": words, "one": np.ones(n, np.float32)},
+                batch_size=512)
+            .assign_timestamps_and_watermarks(0, timestamp_column="word")
+            .key_by("word")
+            .window(TumblingEventTimeWindows.of(10_000))
+            .sum("one").collect())
+    want = {k: float(np.sum(words == k)) for k in range(n_keys)}
+    return env, sink, want
+
+
+def test_mesh_job_through_env_execute():
+    """A socket_window_word_count-class job runs end-to-end on the 8-device
+    mesh through the NORMAL DataStream path."""
+    env, sink, want = _wordcount_env()
+    env.execute()
+    got = {int(r["word"]): float(r["one"]) for r in sink.rows()}
+    assert got == want
+
+
+def test_mesh_operator_state_is_sharded_and_exchange_runs():
+    mesh = make_mesh(8)
+    op = MeshWindowAggOperator(
+        TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", mesh=mesh)
+    h = KeyedOneInputOperatorHarness(op)
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 500, 2000).astype(np.int64)
+    vals = rng.random(2000).astype(np.float32)
+    h.process_batch(RecordBatch({"k": keys, "v": vals},
+                                timestamps=np.zeros(2000, np.int64)))
+    # state physically lives on all 8 devices
+    assert len(op._leaves[0].sharding.device_set) == 8
+    h.process_watermark(1000 - 1)
+    rows = h.extract_output_rows()
+    got = {r["k"]: r["result"] for r in rows}
+    want = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want[k] = want.get(k, 0.0) + v
+    assert set(got) == set(want)
+    np.testing.assert_allclose(
+        [got[k] for k in sorted(got)], [want[k] for k in sorted(want)],
+        rtol=1e-4)
+
+
+def test_mesh_sharded_checkpoint_restore():
+    """Snapshot of mesh-sharded state restores and resumes correctly."""
+    mesh = make_mesh(8)
+    op = MeshWindowAggOperator(
+        TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", mesh=mesh)
+    op.open(RuntimeContext())
+    keys = np.arange(100, dtype=np.int64)
+    op.process_batch(RecordBatch(
+        {"k": keys, "v": np.full(100, 2.0, np.float32)},
+        timestamps=np.zeros(100, np.int64)))
+    snap = op.snapshot_state()
+
+    op2 = MeshWindowAggOperator(
+        TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", mesh=mesh)
+    op2.open(RuntimeContext())
+    op2.restore_state(snap)
+    assert len(op2._leaves[0].sharding.device_set) == 8
+    op2.process_batch(RecordBatch(
+        {"k": keys, "v": np.full(100, 3.0, np.float32)},
+        timestamps=np.full(100, 10, np.int64)))
+    out = op2.process_watermark(Watermark(999))
+    rows = [r for b in out for r in b.to_rows()]
+    assert len(rows) == 100
+    assert all(abs(r["result"] - 5.0) < 1e-5 for r in rows)
+
+
+@pytest.mark.parametrize("new_devices", [4, 1])
+def test_mesh_rescale_restore(new_devices):
+    """A snapshot taken on 8 devices restores onto a smaller mesh (and onto
+    a single chip): key-group ranges re-slice, results unchanged — the
+    reference's rescaling story (``StateAssignmentOperation``)."""
+    mesh8 = make_mesh(8)
+    op = MeshWindowAggOperator(
+        TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", mesh=mesh8)
+    op.open(RuntimeContext())
+    keys = np.arange(256, dtype=np.int64)
+    op.process_batch(RecordBatch(
+        {"k": keys, "v": np.full(256, 1.5, np.float32)},
+        timestamps=np.zeros(256, np.int64)))
+    snap = op.snapshot_state()
+
+    if new_devices == 1:
+        from flink_tpu.operators.window_agg import WindowAggOperator
+        op2 = WindowAggOperator(
+            TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+            key_column="k", value_column="v")
+    else:
+        op2 = MeshWindowAggOperator(
+            TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+            key_column="k", value_column="v", mesh=make_mesh(new_devices))
+    op2.open(RuntimeContext())
+    op2.restore_state(snap)
+    out = op2.process_watermark(Watermark(999))
+    rows = [r for b in out for r in b.to_rows()]
+    assert len(rows) == 256
+    assert all(abs(r["result"] - 1.5) < 1e-5 for r in rows)
+
+
+def test_mesh_job_with_checkpoint_through_env():
+    """env-level checkpointing of a mesh job: snapshot mid-stream, restore
+    into a fresh env, results complete."""
+    from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+
+    storage = InMemoryCheckpointStorage()
+    env, sink, want = _wordcount_env()
+    env.enable_checkpointing(1, storage=storage)
+    env.execute()
+    got = {int(r["word"]): float(r["one"]) for r in sink.rows()}
+    assert got == want
+    # at least one checkpoint completed and holds the mesh operator's state
+    assert storage.checkpoint_ids()
+
+    def _has_leaves(tree):
+        if isinstance(tree, dict):
+            return "leaves" in tree or any(_has_leaves(v)
+                                           for v in tree.values())
+        if isinstance(tree, (list, tuple)):
+            return any(_has_leaves(v) for v in tree)
+        return False
+
+    assert _has_leaves(storage.load_latest())
+
+
+def test_mesh_zipf_skew_correctness():
+    """Skewed (Zipf) keys: bucket capacities renegotiate host-side, no loss."""
+    mesh = make_mesh(8)
+    op = MeshWindowAggOperator(
+        TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", mesh=mesh)
+    h = KeyedOneInputOperatorHarness(op)
+    rng = np.random.default_rng(11)
+    keys = rng.zipf(1.5, 4000).astype(np.int64) % 1000
+    vals = np.ones(4000, np.float32)
+    h.process_batch(RecordBatch({"k": keys, "v": vals},
+                                timestamps=np.zeros(4000, np.int64)))
+    h.process_watermark(999)
+    rows = h.extract_output_rows()
+    assert sum(r["result"] for r in rows) == 4000.0
+
+
+def test_mesh_non_pow2_device_count():
+    """D=6: key capacity rounds to lcm(pow2, 6); rows still split evenly."""
+    op = MeshWindowAggOperator(
+        TumblingEventTimeWindows.of(1000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", mesh=make_mesh(6),
+        initial_key_capacity=64)
+    op.open(RuntimeContext())
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 100, 777).astype(np.int64)
+    op.process_batch(RecordBatch({"k": keys, "v": np.ones(777, np.float32)},
+                                 timestamps=np.zeros(777, np.int64)))
+    assert op._K % 6 == 0
+    out = op.process_watermark(Watermark(999))
+    total = sum(float(np.asarray(b.column("result")).sum()) for b in out)
+    assert total == 777.0

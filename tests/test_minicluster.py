@@ -17,6 +17,8 @@ from flink_tpu.datastream.api import StreamExecutionEnvironment
 from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
 from flink_tpu.windowing.assigners import TumblingEventTimeWindows
 
+pytestmark = pytest.mark.slow
+
 
 def _expected_sums(keys, vals):
     out = {}

@@ -11,6 +11,8 @@ from flink_tpu.runtime.timers import InternalTimerService
 from flink_tpu.state.api import ValueStateDescriptor
 from flink_tpu.testing.harness import KeyedOneInputOperatorHarness
 
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------- timer table
 

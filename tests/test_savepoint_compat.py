@@ -1,0 +1,123 @@
+"""Savepoint cross-round compatibility (VERDICT r1 #9).
+
+``tests/fixtures/savepoint_v1`` is a CHECKED-IN snapshot written by an
+earlier build (``gen_savepoint_fixture.py``).  These tests assert the
+current code still restores it — the analog of the reference's
+cross-version snapshot files (``OperatorSnapshotUtil.java``,
+``flink-end-to-end-tests/flink-stream-stateful-job-upgrade-test``).
+
+If a test here fails, the checkpoint FORMAT broke: either restore the
+compatibility path or document a deliberate format-version bump (and only
+then regenerate the fixture).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import AvgAggregator, RuntimeContext, SumAggregator
+from flink_tpu.operators.session_window import SessionWindowOperator
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.runtime.checkpoint.storage import read_savepoint
+from flink_tpu.windowing.assigners import SessionGap, TumblingEventTimeWindows
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "savepoint_v1")
+
+
+def _load():
+    return read_savepoint(FIXTURE)
+
+
+def test_fixture_restores_tumbling_sum_and_fires_correct_totals():
+    snap = _load()
+    fx = snap["__fixture__"]
+    op = WindowAggOperator(
+        TumblingEventTimeWindows.of(10_000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v")
+    op.open(RuntimeContext())
+    op.restore_state(snap["tumbling-sum"])
+    out = op.process_watermark(Watermark(10_000 - 1))
+    rows = [r for b in out for r in b.to_rows()]
+    total = sum(r["result"] for r in rows)
+    assert abs(total - fx["expected_sum_total"]) < 1e-3
+    # per-key totals must match a host recomputation of the fixture inputs
+    want = {}
+    for k, v in zip(fx["keys"].tolist(), fx["vals"].tolist()):
+        want[k] = want.get(k, 0.0) + v
+    got = {r["k"]: r["result"] for r in rows}
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-3
+
+
+def test_fixture_restores_avg_accumulator_pair():
+    snap = _load()
+    fx = snap["__fixture__"]
+    op = WindowAggOperator(
+        TumblingEventTimeWindows.of(10_000), AvgAggregator(jnp.float32),
+        key_column="k", value_column="v", output_column="avg")
+    op.open(RuntimeContext())
+    op.restore_state(snap["tumbling-avg"])
+    out = op.process_watermark(Watermark(10_000 - 1))
+    rows = [r for b in out for r in b.to_rows()]
+    want_sum, want_n = {}, {}
+    for k, v in zip(fx["keys"].tolist(), fx["vals"].tolist()):
+        want_sum[k] = want_sum.get(k, 0.0) + v
+        want_n[k] = want_n.get(k, 0) + 1
+    for r in rows:
+        assert abs(r["avg"] - want_sum[r["k"]] / want_n[r["k"]]) < 1e-3
+
+
+def test_fixture_restores_session_state():
+    snap = _load()
+    op = SessionWindowOperator(
+        SessionGap(500), SumAggregator(jnp.float32),
+        key_column="k", value_column="v")
+    op.open(RuntimeContext())
+    op.restore_state(snap["session-sum"])
+    out = op.process_watermark(Watermark(1 << 40))
+    rows = [r for b in out for r in b.to_rows()]
+    fx = snap["__fixture__"]
+    total = sum(r["result"] for r in rows)
+    assert abs(total - float(fx["vals"][:100].sum())) < 1e-3
+
+
+def test_fixture_restores_after_resume_with_more_data():
+    """Restore + keep processing: late-arriving records fold into restored
+    panes (the stateful-job-upgrade flow: stop, upgrade, resume)."""
+    snap = _load()
+    fx = snap["__fixture__"]
+    op = WindowAggOperator(
+        TumblingEventTimeWindows.of(10_000), SumAggregator(jnp.float32),
+        key_column="k", value_column="v")
+    op.open(RuntimeContext())
+    op.restore_state(snap["tumbling-sum"])
+    op.process_batch(RecordBatch(
+        {"k": np.array([1, 2], np.int64),
+         "v": np.array([10.0, 20.0], np.float32)},
+        timestamps=np.array([6000, 6001], np.int64)))
+    out = op.process_watermark(Watermark(10_000 - 1))
+    rows = [r for b in out for r in b.to_rows()]
+    total = sum(r["result"] for r in rows)
+    assert abs(total - (fx["expected_sum_total"] + 30.0)) < 1e-3
+
+
+def test_fixture_rescales_to_four_subtasks():
+    """The checked-in snapshot splits across key-group ranges (restore at a
+    different parallelism — the savepoint rescaling contract)."""
+    snap = _load()
+    fx = snap["__fixture__"]
+    parts = WindowAggOperator.split_snapshot(snap["tumbling-sum"], 128, 4)
+    total = 0.0
+    for part in parts:
+        op = WindowAggOperator(
+            TumblingEventTimeWindows.of(10_000), SumAggregator(jnp.float32),
+            key_column="k", value_column="v")
+        op.open(RuntimeContext())
+        op.restore_state(part)
+        out = op.process_watermark(Watermark(10_000 - 1))
+        total += sum(r["result"] for b in out for r in b.to_rows())
+    assert abs(total - fx["expected_sum_total"]) < 1e-3

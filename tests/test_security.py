@@ -10,6 +10,8 @@ import pytest
 
 from flink_tpu.security import SecurityConfig, generate_self_signed
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def certs(tmp_path_factory):
