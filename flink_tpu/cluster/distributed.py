@@ -132,12 +132,18 @@ def build_plan(job: str):
 
 def subtask_counts_of(plan) -> Tuple[Dict[str, int], Dict[int, list]]:
     """Subtask count per vertex (sources: one per split, like the
-    MiniCluster) and the split lists themselves."""
+    MiniCluster; runtime-enumerated sources: fixed reader count, splits
+    assigned over the control plane) and the static split lists."""
     counts: Dict[str, int] = {}
     splits_by_vertex: Dict[int, list] = {}
     for v in plan.vertices:
         if v.is_source:
-            splits = v.chain[0].source.create_splits(v.parallelism)
+            src = v.chain[0].source
+            if getattr(src, "create_enumerator", None) is not None:
+                splits_by_vertex[v.id] = None  # dynamic: request at runtime
+                counts[v.uid] = v.parallelism
+                continue
+            splits = src.create_splits(v.parallelism)
             splits_by_vertex[v.id] = splits
             counts[v.uid] = max(1, len(splits))
         else:
@@ -233,6 +239,7 @@ class _WorkerRuntime:
         self._terminal = set()
         self._done_sent = False
         self._remote_writers: List[Any] = []
+        self._split_queues: Dict[Tuple[str, int], Any] = {}
 
     def _send(self, obj: Any) -> None:
         try:
@@ -266,6 +273,26 @@ class _WorkerRuntime:
                                snapshot: Dict[str, Any]) -> None:
         self._send(("ack", checkpoint_id, vertex_uid, subtask_index,
                     snapshot))
+
+    # -- runtime split requests (FLIP-27 RequestSplitEvent over the
+    # control plane; replies land on a per-reader queue) ------------------
+    def _make_split_requester(self, uid: str, idx: int):
+        import queue as _q
+
+        q: "_q.Queue" = _q.Queue()
+        self._split_queues[(uid, idx)] = q
+
+        def request():
+            self._send(("split_request", uid, idx))
+            try:
+                split, done = q.get(timeout=60)
+            except _q.Empty:
+                # a silent finish here would report FINISHED with unread
+                # files; failing the task triggers restart + restore instead
+                raise RuntimeError(
+                    "split request timed out — coordinator unreachable")
+            return split, done
+        return request
 
     # -- results -----------------------------------------------------------
     def _collect_and_finish(self) -> None:
@@ -348,6 +375,26 @@ class _WorkerRuntime:
             sub_snaps = vr.get("subtasks", [])
             if v.is_source:
                 splits = splits_by_vertex[v.id]
+                if splits is None:
+                    # runtime enumeration: every reader pulls splits from
+                    # the coordinator over the control plane (the
+                    # RequestSplitEvent RPC, SourceCoordinator.java:155)
+                    for i in range(counts[v.uid]):
+                        if assign[(v.uid, i)] != me:
+                            continue
+                        ctx = RuntimeContext(
+                            task_name=v.name, subtask_index=i,
+                            parallelism=counts[v.uid],
+                            max_parallelism=v.max_parallelism)
+                        t = SourceSubtask(
+                            v.uid, i, v.build_operator(),
+                            outputs[v.id][i], ctx, self, None,
+                            split_requester=self._make_split_requester(
+                                v.uid, i))
+                        to_start.append(
+                            (t, sub_snaps[i] if i < len(sub_snaps)
+                             else None))
+                    continue
                 for i, split in enumerate(splits):
                     if assign[(v.uid, i)] != me:
                         continue
@@ -415,6 +462,11 @@ class _WorkerRuntime:
             elif kind == "notify":
                 for t in self.tasks:
                     t.commands.put(("notify_complete", msg[1]))
+            elif kind == "split_assign":
+                _, uid, idx, split, done = msg
+                q = self._split_queues.get((uid, idx))
+                if q is not None:
+                    q.put((split, done))
             elif kind == "cancel":
                 for t in self.tasks:
                     t.cancel()
@@ -434,10 +486,13 @@ class _WorkerRuntime:
 # --------------------------------------------------------------------------
 
 class _Pending:
-    def __init__(self, cid: int, expected: set):
+    def __init__(self, cid: int, expected: set, enumerators=None):
         self.cid = cid
         self.expected = set(expected)
         self.acks: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        #: enumerator snapshots taken at trigger time (§3.4 coordinator
+        #: snapshots precede task triggers)
+        self.enumerators = enumerators
 
 
 class ProcessCluster:
@@ -536,6 +591,27 @@ class ProcessCluster:
         self._counts, _ = subtask_counts_of(plan)
         all_subtasks = {(uid, i) for uid, n in self._counts.items()
                         for i in range(n)}
+        # runtime source coordination: enumerators live HERE, on the
+        # coordinator (SourceCoordinator.java:75); readers request splits
+        # via split_request control messages
+        from flink_tpu.connectors.enumerator import SourceCoordinator
+        self._source_coordinator = SourceCoordinator()
+        for v in plan.vertices:
+            if v.is_source:
+                src = v.chain[0].source
+                factory = getattr(src, "create_enumerator", None)
+                if factory is not None:
+                    self._source_coordinator.register(v.uid, factory())
+        if restore:
+            self._source_coordinator.restore(restore.get("__enumerators__"))
+            for uid, enum in self._source_coordinator._enums.items():
+                for s in (restore.get(uid) or {}).get("subtasks", []):
+                    if not s:
+                        continue
+                    if s.get("current_split") is not None:
+                        enum.reclaim(s["current_split"])
+                    for fs in s.get("finished_splits", []):
+                        enum.reclaim(fs)
         # NOTE: no implicit load_latest() here — a fresh run with a reused
         # --checkpoint-dir starts fresh unless the caller passed an explicit
         # restore (the reference's -s savepoint semantics); the restart loop
@@ -764,6 +840,12 @@ class ProcessCluster:
                         p.acks[(uid, i)] = snap
                         if len(p.acks) >= len(p.expected):
                             self._complete(p)
+            elif kind == "split_request":
+                _, uid, i = msg
+                split, done_flag = self._source_coordinator.request_split(
+                    uid, i)
+                self._to_worker(idx, ("split_assign", uid, i, split,
+                                      done_flag))
             elif kind == "rows":
                 _, uid, i, rows = msg
                 with self._lock:
@@ -785,7 +867,10 @@ class ProcessCluster:
                 return None
             cid = self._next_cid
             self._next_cid += 1
-            self._pending = _Pending(cid, live)
+            coord = getattr(self, "_source_coordinator", None)
+            enums = (coord.snapshot() if coord is not None and coord._enums
+                     else None)
+            self._pending = _Pending(cid, live, enumerators=enums)
         for idx in self._conns:
             self._to_worker(idx, ("checkpoint", cid))
         return cid
@@ -796,6 +881,8 @@ class ProcessCluster:
         assembled: Dict[str, Any] = {"__job__": {
             "checkpoint_id": p.cid,
             "parallelism": dict(self._counts)}}
+        if p.enumerators:
+            assembled["__enumerators__"] = p.enumerators
         for (uid, i), snap in p.acks.items():
             entry = assembled.setdefault(
                 uid, {"subtasks": [None] * self._counts[uid]})

@@ -42,6 +42,9 @@ class _PendingCheckpoint:
     expected: int
     started_at: float
     acks: Dict[Tuple[str, int], Dict[str, Any]] = field(default_factory=dict)
+    #: OperatorCoordinator snapshots taken at TRIGGER time (the reference
+    #: snapshots SourceCoordinator state before triggering tasks, §3.4)
+    enumerators: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -122,6 +125,8 @@ class MiniCluster(TaskListener):
             "checkpoint_id": p.checkpoint_id,
             "parallelism": {uid: n for uid, n in self._subtask_counts.items()},
         }}
+        if p.enumerators:
+            assembled["__enumerators__"] = p.enumerators
         for (uid, idx), snap in p.acks.items():
             entry = assembled.setdefault(
                 uid, {"subtasks": [None] * self._subtask_counts[uid]})
@@ -156,11 +161,30 @@ class MiniCluster(TaskListener):
         source_tasks: List[SourceSubtask] = [
             t for t in self._tasks if isinstance(t, SourceSubtask)]
         subtask_counts: Dict[str, int] = {}
-        # source parallelism = split count (one SourceSubtask per split)
+        # source parallelism = split count (one SourceSubtask per split),
+        # EXCEPT runtime-enumerated sources (FLIP-27 coordination): fixed
+        # reader count, splits assigned on request by the coordinator
+        from flink_tpu.connectors.enumerator import SourceCoordinator
+        if _keep_tasks is None or not hasattr(self, "_source_coordinator"):
+            self._source_coordinator = SourceCoordinator()
         splits_by_vertex: Dict[int, list] = {}
+        dynamic_sources: set = set()
         for v in plan.vertices:
             if v.is_source:
                 src = v.chain[0].source
+                enum_factory = getattr(src, "create_enumerator", None)
+                if enum_factory is not None:
+                    dynamic_sources.add(v.id)
+                    # region restart (_keep_tasks) keeps the LIVE enumerator
+                    # — its assigned-set must survive; only a fresh deploy
+                    # (full restart restores it from the checkpoint) builds
+                    # a new one
+                    if _keep_tasks is None or \
+                            v.uid not in self._source_coordinator._enums:
+                        self._source_coordinator.register(v.uid,
+                                                          enum_factory())
+                    subtask_counts[v.uid] = v.parallelism
+                    continue
                 splits = src.create_splits(v.parallelism)
                 splits_by_vertex[v.id] = splits
                 subtask_counts[v.uid] = max(1, len(splits))
@@ -221,6 +245,35 @@ class MiniCluster(TaskListener):
             vr = restore.get(uid, {})
             sub_snaps = vr.get("subtasks", [])
             if v.is_source:
+                if v.id in dynamic_sources:
+                    # runtime coordination: restore the enumerator, then
+                    # reclaim every reader-owned in-flight split
+                    enum_restore = (restore.get("__enumerators__") or {}) \
+                        .get(uid)
+                    coord = self._source_coordinator
+                    if enum_restore is not None:
+                        coord._enums[uid].restore_state(enum_restore)
+                    for s in sub_snaps:
+                        if not s:
+                            continue
+                        if s.get("current_split") is not None:
+                            coord._enums[uid].reclaim(s["current_split"])
+                        for fs in s.get("finished_splits", []):
+                            coord._enums[uid].reclaim(fs)
+                    for i in range(n_subs(v)):
+                        ctx = RuntimeContext(
+                            task_name=v.name, subtask_index=i,
+                            parallelism=n_subs(v),
+                            max_parallelism=v.max_parallelism)
+                        requester = (lambda u=uid, ri=i:
+                                     coord.request_split(u, ri))
+                        t = SourceSubtask(uid, i, v.build_operator(),
+                                          outputs[v.id][i], ctx, self, None,
+                                          split_requester=requester)
+                        t.start(sub_snaps[i] if i < len(sub_snaps) else None)
+                        self._tasks.append(t)
+                        source_tasks.append(t)
+                    continue
                 splits = splits_by_vertex[v.id]
                 for i, split in enumerate(splits):
                     ctx = RuntimeContext(task_name=v.name, subtask_index=i,
@@ -275,6 +328,9 @@ class MiniCluster(TaskListener):
             self._next_checkpoint_id += 1
             self._pending = _PendingCheckpoint(
                 cid, expected=expected, started_at=time.monotonic())
+            coord = getattr(self, "_source_coordinator", None)
+            if coord is not None and coord._enums:
+                self._pending.enumerators = coord.snapshot()
         for t in self._source_tasks:
             t.commands.put(("checkpoint", cid))
         return cid, "ok"

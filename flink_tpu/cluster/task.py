@@ -160,18 +160,32 @@ class SubtaskBase:
 
 
 class SourceSubtask(SubtaskBase):
-    """Runs one source split; checkpoints replay offsets."""
+    """Runs one source split (static deploy) OR a runtime-assigned split
+    sequence (FLIP-27 coordination: ``split_requester`` pulls splits from
+    the job's ``SourceCoordinator``, the ``RequestSplitEvent`` loop of
+    ``SourceCoordinator.java:155-170``); checkpoints replay offsets and the
+    in-flight split."""
 
     def _final_snapshot(self) -> Dict[str, Any]:
         return {"operator": self.operator.snapshot_state(),
                 "source_offset": self._emitted, "finished": True}
 
     def __init__(self, vertex_uid: str, subtask_index: int, operator,
-                 outputs, ctx, listener, split):
+                 outputs, ctx, listener, split,
+                 split_requester=None):
         super().__init__(vertex_uid, subtask_index, operator, outputs, ctx,
                          listener)
         self.split = split
-        self._emitted = 0          # elements pulled from the split so far
+        #: dynamic mode: () -> (split | None, done) — None+not-done means
+        #: poll again (the directory may grow)
+        self.split_requester = split_requester
+        self._emitted = 0          # elements pulled from the current split
+        self._current_split = split
+        #: dynamic mode: splits fully consumed by THIS reader — snapshotted
+        #: so a split finished between the enumerator's trigger-time
+        #: snapshot and this reader's barrier is still reclaimed on restore
+        #: (its records were emitted pre-barrier; re-reading would duplicate)
+        self._finished_splits: list = []
         #: stop-with-savepoint: a paused source emits nothing but keeps
         #: serving its command queue (so the savepoint barrier still flows)
         self._paused = threading.Event()
@@ -180,8 +194,39 @@ class SourceSubtask(SubtaskBase):
         self.latency_marker_interval = 0
 
     def _invoke(self) -> None:
-        it = iter(self.split.read())
-        skip = (self._restore or {}).get("source_offset", 0)
+        if self.split_requester is None:
+            skip = (self._restore or {}).get("source_offset", 0)
+            self._read_split(self.split, skip)
+        else:
+            restore = self._restore or {}
+            cur = restore.get("current_split")
+            skip = restore.get("source_offset", 0)
+            self._finished_splits = list(restore.get("finished_splits", []))
+            while True:
+                if cur is None:
+                    self._check_cancel()
+                    self._drain_commands()
+                    cur, done = self.split_requester()
+                    if cur is None:
+                        if done:
+                            break
+                        time.sleep(0.01)   # nothing yet: poll again
+                        continue
+                    skip = 0
+                self._current_split = cur
+                self._read_split(cur, skip)
+                self._finished_splits.append(cur)
+                self._current_split = cur = None
+                self._emitted = 0
+        # bounded end: final watermark flushes event-time state downstream
+        wm = Watermark(MAX_WATERMARK)
+        self._emit(self.operator.process_watermark(wm))
+        self._emit([wm])
+        self._emit(self.operator.end_input())
+        self._emit([EndOfInput()])
+
+    def _read_split(self, split, skip: int) -> None:
+        it = iter(split.read())
         for _ in range(skip):      # deterministic replay: skip to the offset
             try:
                 next(it)
@@ -218,12 +263,6 @@ class SourceSubtask(SubtaskBase):
                     self._emit([el])
             else:
                 self._emit([el])
-        # bounded end: final watermark flushes event-time state downstream
-        wm = Watermark(MAX_WATERMARK)
-        self._emit(self.operator.process_watermark(wm))
-        self._emit([wm])
-        self._emit(self.operator.end_input())
-        self._emit([EndOfInput()])
 
     def _drain_commands(self) -> None:
         while True:
@@ -235,6 +274,12 @@ class SourceSubtask(SubtaskBase):
                 cid = cmd[1]
                 snap = {"operator": self.operator.snapshot_state(),
                         "source_offset": self._emitted}
+                if self.split_requester is not None:
+                    # dynamic mode: the in-flight split AND consumed splits
+                    # are reader state (the enumerator's own snapshot can
+                    # race assignments made after the trigger)
+                    snap["current_split"] = self._current_split
+                    snap["finished_splits"] = list(self._finished_splits)
                 barrier = CheckpointBarrier(cid, timestamp=0)
                 self._emit([barrier])
                 self.listener.acknowledge_checkpoint(

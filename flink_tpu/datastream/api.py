@@ -634,6 +634,11 @@ class WindowedStream:
         if getattr(self, "_late_tag", None) is not None:
             raise ValueError("side_output_late_data is not supported on the "
                              "raw-element apply() path yet; use aggregate()")
+        if self.keyed.env.mesh is not None:
+            import warnings
+            warnings.warn("env mesh is not yet honored by the raw-element "
+                          "apply() path: this operator runs single-device",
+                          stacklevel=2)
         assigner = self.assigner
         key_col = self.keyed.key_column
         ev = getattr(self, "_evictor", None)
@@ -663,6 +668,12 @@ class WindowedStream:
                 raise ValueError(
                     "custom triggers are not supported on session windows "
                     "(sessions fire when the gap closes); remove .trigger()")
+            if keyed.env.mesh is not None:
+                import warnings
+                warnings.warn(
+                    "env mesh is not yet honored by session windows: this "
+                    "job runs the SessionWindowOperator single-device",
+                    stacklevel=2)
             from flink_tpu.operators.session_window import SessionWindowOperator
 
             def factory():

@@ -66,7 +66,18 @@ class _SideBuffer:
 
 def _join_pairs(lk: np.ndarray, rk: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Vectorized equi-join index pairs: returns (left_idx, right_idx) of
-    every cross pair with equal keys (sort + span intersection)."""
+    every cross pair with equal keys (sort + span intersection).
+
+    Dispatches to the device sorted-merge kernel
+    (``ops/join_kernels.device_join_pairs``) when ``FLINK_TPU_DEVICE_JOIN=1``
+    — the right choice for device-resident pipelines; host-numpy span
+    intersection otherwise (transfer-bound transports, see the kernel
+    module's docstring)."""
+    import os
+
+    if os.environ.get("FLINK_TPU_DEVICE_JOIN") == "1":
+        from flink_tpu.ops.join_kernels import device_join_pairs
+        return device_join_pairs(lk, rk)
     lo = np.argsort(lk, kind="stable")
     ro = np.argsort(rk, kind="stable")
     lks, rks = lk[lo], rk[ro]

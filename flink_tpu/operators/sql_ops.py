@@ -21,6 +21,13 @@ from flink_tpu.operators.base import StreamOperator
 from flink_tpu.operators.joins import _join_pairs, _merge_columns
 
 
+from flink_tpu.ops.shapes import next_pow2
+
+
+def _next_pow2_sql(n: int) -> int:
+    return next_pow2(n, 64)
+
+
 class SqlJoinOperator(StreamOperator):
     """Bounded-table equi-join (``StreamExecJoin`` over bounded inputs):
     both sides buffer; the join emits once at end-of-input — batch SQL
@@ -120,71 +127,254 @@ class SqlJoinOperator(StreamOperator):
 
 class ChangelogGroupAggOperator(StreamOperator):
     """Non-windowed group aggregate emitting a CHANGELOG (retraction) stream
-    (``GroupAggFunction`` analog): every batch updates the affected groups
-    and emits ``+I`` for new groups, ``-U`` (old value) + ``+U`` (new value)
-    for changed ones.  The ``op`` column carries the change kind."""
+    — the device-resident ``StreamExecGroupAggregate`` / ``GroupAggFunction``
+    analog (``flink-table-runtime-blink/.../operators/aggregate/``).
+
+    TPU design (same pattern as ``window_agg.py``): group state is a dense
+    ``[K]`` device array per aggregate; one jitted step per micro-batch
+    segment-reduces the batch into per-group partials, gathers the OLD
+    values, combines, scatters the NEW values back — and returns only the
+    ``[U]`` touched-group old/new pairs (U = distinct groups in the batch),
+    which is exactly the set changelog semantics must emit.  The host emits
+    ``+I`` for groups whose dense slot id is new (slot ids are
+    insertion-ordered, so "new since the previous batch" is a host-known
+    comparison — no seen-flag download), ``-U``/``+U`` pairs for changed
+    ones.  The ``op`` column carries the change kind."""
+
+    #: combine modes per aggregate kind (identity, jnp combine)
+    _MODES = {"sum": "add", "count": "add", "min": "min", "max": "max"}
 
     def __init__(self, key_column: str, agg_columns: Dict[str, Tuple[str, str]],
-                 name: str = "changelog-group-agg"):
+                 name: str = "changelog-group-agg",
+                 initial_capacity: int = 1 << 10):
         """agg_columns: out_name -> (input column, how in sum/count/min/max)."""
+        import jax.numpy as jnp  # noqa: F401 — device runtime
+
+        for out, (_c, how) in agg_columns.items():
+            if how not in self._MODES:
+                raise ValueError(f"unsupported changelog aggregate {how!r}")
         self.key_column = key_column
         self.agg_columns = agg_columns
         self.name = name
-        #: key -> {out_name: value}
-        self._groups: Dict[Any, Dict[str, float]] = {}
+        self._K = initial_capacity
+        self.key_index = None
+        self._state = None  # tuple of jnp [K] per agg column
+
+    def _identity(self, how: str) -> float:
+        return 0.0 if how in ("sum", "count") else (
+            np.inf if how == "min" else -np.inf)
+
+    def _alloc(self, K: int):
+        """One f32 array per min/max column; TWO (hi, lo) per sum/count —
+        double-single (compensated) accumulation keeps ~48 bits of
+        precision without float64 (jnp defaults to 32-bit): a count can
+        reach 2^48 exactly, where a plain f32 would freeze at 2^24."""
+        import jax.numpy as jnp
+
+        arrs = []
+        for out, (_c, how) in self.agg_columns.items():
+            arrs.append(jnp.full((K,), self._identity(how), jnp.float32))
+            if self._MODES[how] == "add":
+                arrs.append(jnp.zeros((K,), jnp.float32))  # low word
+        return tuple(arrs)
+
+    def _ensure(self, needed: int):
+        import jax.numpy as jnp  # noqa: F401
+
+        if self._state is None:
+            while self._K < needed:
+                self._K <<= 1
+            self._state = self._alloc(self._K)
+            return
+        if needed <= self._K:
+            return
+        oldK = self._state[0].shape[0]
+        while self._K < needed:
+            self._K <<= 1
+        fresh = self._alloc(self._K)
+        self._state = tuple(f.at[:oldK].set(o)
+                            for f, o in zip(fresh, self._state))
+
+    @staticmethod
+    def _seg_reduce(jnp, vals, inv, U, mode, identity):
+        if mode == "add":
+            return jnp.zeros((U,), jnp.float32).at[inv].add(vals)
+        return jnp.full((U,), identity, jnp.float32).at[inv].min(vals) \
+            if mode == "min" else \
+            jnp.full((U,), identity, jnp.float32).at[inv].max(vals)
+
+    def _update_step_impl(self, state, uniq_slots, inv, values, U):
+        """state': scatter combined; returns (state', old[U], new[U]) per
+        state array (sum/count columns contribute an (hi, lo) pair)."""
+        import jax.numpy as jnp
+
+        olds, news, out_state = [], [], []
+        si = 0
+        for out, (_c, how) in self.agg_columns.items():
+            mode = self._MODES[how]
+            ident = self._identity(how)
+            partial = self._seg_reduce(jnp, values[out], inv, U, mode, ident)
+            if mode == "add":
+                hi_arr, lo_arr = state[si], state[si + 1]
+                si += 2
+                hi = jnp.take(hi_arr, uniq_slots, mode="clip")
+                lo = jnp.take(lo_arr, uniq_slots, mode="clip")
+                # double-single += f32 (2Sum): exact error of hi+partial
+                # folds into the low word
+                s = hi + partial
+                v = s - hi
+                e = (hi - (s - v)) + (partial - v)
+                lo2 = lo + e
+                nh = s + lo2
+                nl = lo2 - (nh - s)
+                out_state.append(hi_arr.at[uniq_slots].set(nh, mode="drop"))
+                out_state.append(lo_arr.at[uniq_slots].set(nl, mode="drop"))
+                olds.extend([hi, lo])
+                news.extend([nh, nl])
+                continue
+            arr = state[si]
+            si += 1
+            old = jnp.take(arr, uniq_slots, mode="clip")
+            new = (jnp.minimum(old, partial) if mode == "min"
+                   else jnp.maximum(old, partial))
+            out_state.append(arr.at[uniq_slots].set(new, mode="drop"))
+            olds.append(old)
+            news.append(new)
+        return tuple(out_state), tuple(olds), tuple(news)
+
+    def _jitted(self):
+        import jax
+
+        fn = getattr(self, "_jit_cache", None)
+        if fn is None:
+            fn = self._jit_cache = jax.jit(
+                self._update_step_impl, static_argnums=(4,),
+                donate_argnums=(0,))
+        return fn
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        import jax.numpy as jnp
+
         if len(batch) == 0:
             return []
+        from flink_tpu.state.keyindex import make_key_index
+
         keys = np.asarray(batch.column(self.key_column))
-        uniq, inv = np.unique(keys, return_inverse=True)
-        # per-batch partial per group
-        partials: Dict[str, np.ndarray] = {}
+        if self.key_index is None:
+            self.key_index = make_key_index(keys[0] if keys.ndim else keys)
+        prev_n = self.key_index.num_keys
+        slots = self.key_index.lookup_or_insert(keys)
+        self._ensure(self.key_index.num_keys)
+        uniq_slots, inv = np.unique(slots, return_inverse=True)
+        U = int(uniq_slots.size)
+        Up = _next_pow2_sql(U)
+        uniq_p = np.full(Up, self._K, np.int32)  # pad: dropped by scatter
+        uniq_p[:U] = uniq_slots
+        # pad the batch dim too (quantized): varying micro-batch sizes must
+        # not each compile a fresh XLA program.  Padding rows carry each
+        # column's identity and inv=0, a no-op contribution to group 0.
+        from flink_tpu.ops.shapes import quantize_pow2
+        B = len(batch)
+        Bp = quantize_pow2(B, floor=64, steps=4)
+        inv_p = np.zeros(Bp, np.int64)
+        inv_p[:B] = inv
+        values = {}
         for out, (col, how) in self.agg_columns.items():
-            vals = (np.ones(len(batch)) if col is None
-                    else np.asarray(batch.column(col), np.float64))
-            if how in ("sum", "count"):
-                partials[out] = np.bincount(inv, weights=vals,
-                                            minlength=len(uniq))
-            elif how == "min":
-                agg = np.full(len(uniq), np.inf)
-                np.minimum.at(agg, inv, vals)
-                partials[out] = agg
-            elif how == "max":
-                agg = np.full(len(uniq), -np.inf)
-                np.maximum.at(agg, inv, vals)
-                partials[out] = agg
+            v = np.full(Bp, 0.0 if self._MODES[how] == "add"
+                        else self._identity(how), np.float32)
+            v[:B] = (1.0 if col is None
+                     else np.asarray(batch.column(col), np.float32))
+            values[out] = jnp.asarray(v)
+        self._state, olds, news = self._jitted()(
+            self._state, jnp.asarray(uniq_p), jnp.asarray(inv_p, jnp.int32),
+            values, Up)
+        # ---- host emit: only the [U] touched groups come back; (hi, lo)
+        # pairs collapse to f64 (recovering the compensated precision)
+        olds_f, news_f = [], []
+        i = 0
+        for out, (_c, how) in self.agg_columns.items():
+            if self._MODES[how] == "add":
+                olds_f.append(np.asarray(olds[i], np.float64)[:U]
+                              + np.asarray(olds[i + 1], np.float64)[:U])
+                news_f.append(np.asarray(news[i], np.float64)[:U]
+                              + np.asarray(news[i + 1], np.float64)[:U])
+                i += 2
             else:
-                raise ValueError(f"unsupported changelog aggregate {how!r}")
-        out_rows: List[Dict[str, Any]] = []
-        for gi, key in enumerate(uniq.tolist()):
-            old = self._groups.get(key)
-            if old is None:
-                new = {out: float(partials[out][gi])
-                       for out in self.agg_columns}
-                self._groups[key] = new
-                out_rows.append({"op": "+I", self.key_column: key, **new})
-            else:
-                new = {}
-                for out, (col, how) in self.agg_columns.items():
-                    p = float(partials[out][gi])
-                    new[out] = (old[out] + p if how in ("sum", "count")
-                                else (min(old[out], p) if how == "min"
-                                      else max(old[out], p)))
-                if new != old:
-                    out_rows.append({"op": "-U", self.key_column: key, **old})
-                    out_rows.append({"op": "+U", self.key_column: key, **new})
-                    self._groups[key] = new
-        if not out_rows:
+                olds_f.append(np.asarray(olds[i])[:U])
+                news_f.append(np.asarray(news[i])[:U])
+                i += 1
+        is_new = uniq_slots >= prev_n
+        changed = ~is_new & np.logical_or.reduce(
+            [o != n for o, n in zip(olds_f, news_f)])
+        if not (is_new.any() or changed.any()):
             return []
+        rev = getattr(self, "_rev_cache", None)
+        if rev is None or len(rev) < self.key_index.num_keys:
+            # O(N) reverse-table copy only when new keys appeared
+            rev = self._rev_cache = np.asarray(self.key_index.reverse_keys())
+        out_rows: List[Dict[str, Any]] = []
+        names = list(self.agg_columns)
+        for gi in range(U):
+            key = rev[uniq_slots[gi]]
+            if is_new[gi]:
+                out_rows.append({"op": "+I", self.key_column: key,
+                                 **{names[j]: news_f[j][gi]
+                                    for j in range(len(names))}})
+            elif changed[gi]:
+                out_rows.append({"op": "-U", self.key_column: key,
+                                 **{names[j]: olds_f[j][gi]
+                                    for j in range(len(names))}})
+                out_rows.append({"op": "+U", self.key_column: key,
+                                 **{names[j]: news_f[j][gi]
+                                    for j in range(len(names))}})
         cols = {c: np.asarray([r[c] for r in out_rows]) for c in out_rows[0]}
         return [RecordBatch(cols)]
 
     def snapshot_state(self) -> Dict[str, Any]:
-        return {"groups": dict(self._groups)}
+        snap: Dict[str, Any] = {}
+        if self.key_index is not None:
+            n = self.key_index.num_keys
+            snap["key_index"] = self.key_index.snapshot()
+            snap["key_index_kind"] = type(self.key_index).__name__
+            if self._state is not None:
+                snap["state"] = [np.asarray(a)[:n] for a in self._state]
+        return snap
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        self._groups = dict(snap.get("groups", {}))
+        import jax.numpy as jnp
+
+        from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex
+
+        if "groups" in snap:  # legacy host-dict snapshot format
+            groups = snap["groups"]
+            if groups:
+                keys = np.asarray(list(groups))
+                from flink_tpu.state.keyindex import make_key_index
+                self.key_index = make_key_index(keys[0])
+                slots = jnp.asarray(self.key_index.lookup_or_insert(keys))
+                self._ensure(self.key_index.num_keys)
+                state = list(self._state)
+                si = 0
+                for out, (_c, how) in self.agg_columns.items():
+                    vals = np.asarray([groups[k][out] for k in groups],
+                                      np.float32)
+                    state[si] = state[si].at[slots].set(jnp.asarray(vals))
+                    si += 2 if self._MODES[how] == "add" else 1
+                self._state = tuple(state)
+            return
+        if "key_index" not in snap:
+            return
+        if snap["key_index_kind"] == "ObjectKeyIndex":
+            self.key_index = ObjectKeyIndex.restore(snap["key_index"])
+        else:
+            self.key_index = KeyIndex.restore(snap["key_index"])
+        n = self.key_index.num_keys
+        self._state = None
+        self._ensure(max(n, 1))
+        if "state" in snap:
+            self._state = tuple(
+                a.at[:n].set(jnp.asarray(s))
+                for a, s in zip(self._state, snap["state"]))
 
 
 class TopNOperator(StreamOperator):
@@ -212,6 +402,37 @@ class TopNOperator(StreamOperator):
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         if len(batch) == 0:
             return []
+        # vectorized pre-filter: rows strictly worse than a FULL partition's
+        # current cutoff can never enter — drop them before the per-row
+        # merge (the merge itself is inherently sequential: each admission
+        # can change the cutoff)
+        vals = np.asarray(batch.column(self.order_column))
+        if self.partition_column is None:
+            top = self._tops.get(None)
+            if top is not None and len(top) >= self.n:
+                thr = top[-1][0]
+                keep = vals < thr if self.ascending else vals > thr
+                if not keep.all():
+                    batch = batch.select(keep)
+                    if len(batch) == 0:
+                        return []
+        elif getattr(self, "_any_full", False):
+            # only worth the per-row threshold lookup once SOME partition
+            # filled up (before that the filter can never drop anything)
+            parts_col = np.asarray(batch.column(self.partition_column))
+            thr = np.asarray([
+                (self._tops[p][-1][0]
+                 if p in self._tops and len(self._tops[p]) >= self.n
+                 else None)
+                for p in parts_col.tolist()], object)
+            has = np.asarray([t is not None for t in thr.tolist()])
+            if has.any():
+                tv = np.where(has, thr, vals[0]).astype(vals.dtype)
+                keep = ~has | (vals < tv if self.ascending else vals > tv)
+                if not keep.all():
+                    batch = batch.select(keep)
+                    if len(batch) == 0:
+                        return []
         rows = batch.to_rows()
         out_rows: List[Dict[str, Any]] = []
         for row in rows:
@@ -226,6 +447,8 @@ class TopNOperator(StreamOperator):
                          reverse=not self.ascending)
                 if self.emit_changelog:
                     out_rows.append({"op": "+I", **row})
+                if len(top) >= self.n:
+                    self._any_full = True
                 if len(top) > self.n:
                     _, _, evicted = top.pop()
                     if self.emit_changelog:
@@ -271,48 +494,149 @@ class DeduplicateOperator(StreamOperator):
         self.keep = keep
         self.order_column = order_column
         self.name = name
-        self._seen: Dict[Any, dict] = {}
-        self._order: Dict[Any, Any] = {}
+        #: vectorized membership: key -> dense slot (insertion-ordered), the
+        #: same probe the window state uses (state/keyindex) — no per-row
+        #: Python dict lookups
+        self._ki = None
+        #: keep='last': columnar current-row store, one array per column,
+        #: indexed by key slot; plus the per-slot order value
+        self._cols: Dict[str, np.ndarray] = {}
+        self._ordv: Optional[np.ndarray] = None
+
+    def _slots(self, keys: np.ndarray) -> np.ndarray:
+        from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex
+
+        if self._ki is None:
+            # dtype (not a sample element) decides: an object array of
+            # tuples (composite DISTINCT keys) must use the object index
+            self._ki = (KeyIndex() if keys.dtype.kind in "iu"
+                        else ObjectKeyIndex())
+        return self._ki.lookup_or_insert(keys)
+
+    @staticmethod
+    def _grow(arr: np.ndarray, n: int, fill) -> np.ndarray:
+        if arr.shape[0] >= n:
+            return arr
+        out = np.full((max(n, arr.shape[0] * 2),) + arr.shape[1:], fill,
+                      dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         if len(batch) == 0:
             return []
         keys = np.asarray(batch.column(self.key_column))
+        prev_n = self._ki.num_keys if self._ki is not None else 0
+        slots = self._slots(keys)
         if self.keep == "first":
-            # vectorized: first occurrence in-batch AND not seen before
-            _, first_idx = np.unique(keys, return_index=True)
+            # first occurrence in-batch of a key unseen before this batch
+            _, first_idx = np.unique(slots, return_index=True)
             mask = np.zeros(len(batch), bool)
             mask[first_idx] = True
-            unseen = np.asarray([k not in self._seen for k in keys.tolist()])
-            mask &= unseen
-            for k in keys[mask].tolist():
-                self._seen[k] = {}
+            mask &= slots >= prev_n
             return [batch.select(mask)] if mask.any() else []
-        # keep == 'last': retain latest (by order column or arrival)
-        rows = batch.to_rows()
-        for i, row in enumerate(rows):
-            k = keys[i].item() if isinstance(keys[i], np.generic) else keys[i]
-            if self.order_column is not None:
-                o = row[self.order_column]
-                if k in self._order and not o >= self._order[k]:
-                    continue
-                self._order[k] = o
-            self._seen[k] = row
+        # keep == 'last': per batch, the winning row per key is the max by
+        # (order value, position); then compare against the retained order
+        n = len(batch)
+        if self.order_column is not None:
+            ordv = np.asarray(batch.column(self.order_column))
+        else:
+            # arrival order must be GLOBAL across batches, not in-batch row
+            # position — a later batch's row always beats an earlier one
+            base = getattr(self, "_arrival", 0)
+            ordv = base + np.arange(n)
+            self._arrival = base + n
+        # lexsort: last key per (slot, order, position) group wins
+        order = np.lexsort((np.arange(n), ordv, slots))
+        ss = slots[order]
+        last_mask = np.r_[ss[1:] != ss[:-1], True]
+        win = order[last_mask]                    # winning row index per slot
+        wslots, word = slots[win], ordv[win]
+        nk = self._ki.num_keys
+        if self._ordv is None:
+            self._ordv = np.full(max(nk, 64), None, object)
+        self._ordv = self._grow(self._ordv, nk, None)
+        cur = self._ordv[wslots]
+        upd = np.asarray([c is None or o >= c
+                          for o, c in zip(word.tolist(), cur.tolist())])
+        if not upd.any():
+            return []
+        uw, uord = wslots[upd], word[upd]
+        self._ordv[uw] = uord
+        for c, v in batch.columns.items():
+            arr = self._cols.get(c)
+            if arr is None:
+                arr = np.full(max(nk, 64), None, object)
+            arr = self._grow(arr, nk, None)
+            arr[uw] = np.asarray(v, object)[win[upd]]
+            self._cols[c] = arr
         return []
 
     def end_input(self) -> List[StreamElement]:
-        if self.keep == "first" or not self._seen:
+        if self.keep == "first" or self._ki is None:
             return []
-        rows = list(self._seen.values())
-        cols = {c: np.asarray([r.get(c) for r in rows]) for c in rows[0]}
+        n = self._ki.num_keys
+        if n == 0 or not self._cols:
+            return []
+
+        def densify(a: np.ndarray) -> np.ndarray:
+            # the store is object-dtype (mixed batches may differ); emit
+            # with the natural inferred dtype so downstream device
+            # consumers can jnp.asarray the column
+            try:
+                out = np.asarray(a.tolist())
+            except (ValueError, TypeError):
+                return a
+            return a if out.dtype.kind == "O" and a.dtype.kind == "O" else out
+
+        cols = {c: densify(arr[:n]) for c, arr in self._cols.items()}
         return [RecordBatch(cols)]
 
     def snapshot_state(self) -> Dict[str, Any]:
-        return {"seen": dict(self._seen), "order": dict(self._order)}
+        snap: Dict[str, Any] = {}
+        if self._ki is not None:
+            snap["key_index"] = self._ki.snapshot()
+            snap["key_index_kind"] = type(self._ki).__name__
+            n = self._ki.num_keys
+            # COPIES, not views: later batches mutate the store in place,
+            # which must never reach into an already-taken checkpoint
+            snap["cols"] = {c: np.asarray(a[:n]).copy()
+                            for c, a in self._cols.items()}
+            snap["ordv"] = (None if self._ordv is None
+                            else np.asarray(self._ordv[:n]).copy())
+            snap["arrival"] = getattr(self, "_arrival", 0)
+        return snap
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        self._seen = dict(snap.get("seen", {}))
-        self._order = dict(snap.get("order", {}))
+        from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex
+
+        if "seen" in snap:  # legacy dict snapshot
+            seen = snap["seen"]
+            if seen:
+                keys = np.asarray(list(seen))
+                self._slots(keys)
+                rows = list(seen.values())
+                if rows and rows[0]:
+                    n = self._ki.num_keys
+                    for c in rows[0]:
+                        arr = np.full(max(n, 64), None, object)
+                        arr[:n] = [r.get(c) for r in rows]
+                        self._cols[c] = arr
+                order = snap.get("order", {})
+                self._ordv = np.full(max(len(seen), 64), None, object)
+                for i, k in enumerate(seen):
+                    self._ordv[i] = order.get(k)
+            return
+        if "key_index" not in snap:
+            return
+        cls = (ObjectKeyIndex if snap["key_index_kind"] == "ObjectKeyIndex"
+               else KeyIndex)
+        self._ki = cls.restore(snap["key_index"])
+        self._cols = {c: np.asarray(a, object).copy()
+                      for c, a in snap.get("cols", {}).items()}
+        ov = snap.get("ordv")
+        self._ordv = None if ov is None else np.asarray(ov, object).copy()
+        self._arrival = snap.get("arrival", 0)
 
 
 class SortLimitOperator(StreamOperator):

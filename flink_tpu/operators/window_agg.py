@@ -62,13 +62,11 @@ from flink_tpu.windowing.triggers import EventTimeTrigger, Trigger
 
 
 def _quantize_cap(n: int) -> int:
-    """Static gather width for ``n`` emitted rows: rounded up to 1/8-pow2
-    steps, so the jit cache holds at most 8 entries per size decade while
-    padding waste stays <=12.5% (the download is the scarce resource —
-    see the tunnel-asymmetry note in ``_fire_window``)."""
-    p = _next_pow2(max(n, 64))
-    q = max(p // 8, 64)
-    return ((n + q - 1) // q) * q
+    """Static gather width for ``n`` emitted rows: 1/8-pow2 steps — padding
+    waste <=12.5%, because the download is the scarce resource (see the
+    tunnel-asymmetry note in ``_fire_window``)."""
+    from flink_tpu.ops.shapes import quantize_pow2
+    return quantize_pow2(n, floor=64, steps=8)
 
 
 def _fetch_enqueue(arrays, chunk_bytes: int = 0):
@@ -112,11 +110,7 @@ def _handle_ready(sliced) -> bool:
     return True
 
 
-def _next_pow2(n: int, floor: int = 1) -> int:
-    c = floor
-    while c < n:
-        c <<= 1
-    return c
+from flink_tpu.ops.shapes import next_pow2 as _next_pow2  # noqa: E402
 
 
 class WindowAggOperator(StreamOperator):

@@ -1,0 +1,24 @@
+"""Static-shape sizing helpers shared by the device operators.
+
+XLA compiles one program per shape, so batch/capacity paddings are rounded
+to a small set of sizes: pow2 for growth-style capacities, pow2/4 or pow2/8
+sub-steps where padding waste is the scarcer resource (e.g. device->host
+transfers) — each distinct size is one compile, so the step count bounds the
+jit cache."""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    c = floor
+    while c < n:
+        c <<= 1
+    return c
+
+
+def quantize_pow2(n: int, floor: int = 64, steps: int = 4) -> int:
+    """Round ``n`` up to a multiple of ``next_pow2(n)/steps`` (>= floor):
+    at most ``steps`` distinct sizes per pow2 decade, <= 1/steps padding."""
+    p = next_pow2(max(n, floor), floor)
+    q = max(p // steps, floor)
+    return ((n + q - 1) // q) * q

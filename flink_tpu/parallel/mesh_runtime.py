@@ -46,11 +46,12 @@ from flink_tpu.ops.scatter import scatter_fast, scatter_generic
 from flink_tpu.parallel.mesh import KG_AXIS, make_mesh, state_sharding
 
 
+from flink_tpu.ops.shapes import quantize_pow2
+
+
 def _quantize(n: int, floor: int = 16) -> int:
     """pow2/4-step rounding: bounded compile count, <=25% padding."""
-    p = _next_pow2(max(n, floor))
-    q = max(p // 4, floor)
-    return ((n + q - 1) // q) * q
+    return quantize_pow2(n, floor=floor, steps=4)
 
 
 class MeshWindowAggOperator(WindowAggOperator):

@@ -1,0 +1,171 @@
+"""FLIP-27 runtime source coordination (VERDICT r1 #6): the enumerator
+lives on the coordinator, readers request splits at runtime, enumerator
+state rides checkpoints.  Reference: ``SourceCoordinator.java:75,155-170,229``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.task import TaskStates
+from flink_tpu.connectors.enumerator import (DirectoryEnumerator,
+                                             DynamicFileSource)
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+
+
+def _write_csv(path: str, lo: int, hi: int) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("k,v\n")
+        for i in range(lo, hi):
+            f.write(f"{i % 7},{i}\n")
+    os.replace(tmp, path)  # atomic: the enumerator never sees partials
+
+
+def test_split_list_grows_while_job_runs(tmp_path):
+    """Files added AFTER the job started are discovered and read — the
+    dynamic case deploy-time split creation cannot express."""
+    d = str(tmp_path)
+    _write_csv(os.path.join(d, "a.csv"), 0, 50)
+
+    def feeder():
+        time.sleep(0.3)
+        _write_csv(os.path.join(d, "b.csv"), 50, 120)
+        time.sleep(0.2)
+        _write_csv(os.path.join(d, "c.csv"), 120, 200)
+        open(os.path.join(d, "_DONE"), "w").close()
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    env = StreamExecutionEnvironment()
+    src = DynamicFileSource(d, format="csv")
+    sink = env.from_source(src).collect()
+    res = env.execute_cluster(timeout_s=60)
+    t.join()
+    assert res.state == TaskStates.FINISHED
+    got = sorted(int(r["v"]) for r in sink.rows())
+    assert got == list(range(200))
+
+
+def test_restore_mid_enumeration_exactly_once(tmp_path):
+    """Injected failure mid-read; restart restores the enumerator's
+    assigned-set + the reader's in-flight split/offset from the checkpoint,
+    final keyed sums stay exact (no loss, no double-read)."""
+    d = str(tmp_path)
+    for i, name in enumerate(["a.csv", "b.csv", "c.csv", "d.csv"]):
+        _write_csv(os.path.join(d, name), i * 500, (i + 1) * 500)
+    open(os.path.join(d, "_DONE"), "w").close()
+
+    fail_once = {"armed": True, "count": 0}
+
+    def poison(row_cols):
+        if fail_once["armed"] and fail_once["count"] >= 2:
+            fail_once["armed"] = False
+            raise RuntimeError("injected failure")
+        fail_once["count"] += 1
+        return row_cols
+
+    storage = InMemoryCheckpointStorage(retain=10)
+    env = StreamExecutionEnvironment()
+    src = DynamicFileSource(d, format="csv")
+    sink = (env.from_source(src).map(poison)
+            .key_by("k").sum("v").collect())
+    res = env.execute_cluster(storage=storage, checkpoint_interval_ms=2,
+                              restart_attempts=2, timeout_s=60)
+    assert res.state == TaskStates.FINISHED
+    assert res.restarts >= 1
+    vals = np.arange(2000)
+    expect = {k: int(vals[vals % 7 == k].sum()) for k in range(7)}
+    final = {int(r["k"]): int(r["v"]) for r in sink.rows()}
+    assert final == expect
+
+
+def test_enumerator_snapshot_reclaim_protocol(tmp_path):
+    """Protocol unit test: a split assigned AFTER the enumerator snapshot
+    but owned by a reader at the barrier is reclaimed on restore and never
+    handed out twice (``SourceCoordinator`` ownership model)."""
+    d = str(tmp_path)
+    for name in ("a.csv", "b.csv", "c.csv"):
+        _write_csv(os.path.join(d, name), 0, 5)
+    src = DynamicFileSource(d)
+    enum = DirectoryEnumerator(src)
+    s1 = enum.next_split(0)
+    snap = enum.snapshot_state()          # trigger-time snapshot: only a.csv
+    s2 = enum.next_split(0)               # assigned post-snapshot
+    assert s1.path.endswith("a.csv") and s2.path.endswith("b.csv")
+
+    restored = DirectoryEnumerator(src)
+    restored.restore_state(snap)
+    restored.reclaim(s2)                  # reader's restored current_split
+    s3 = restored.next_split(1)
+    assert s3.path.endswith("c.csv")
+    assert restored.next_split(1) is None
+    assert not restored.done()            # no _DONE marker yet
+    open(os.path.join(d, "_DONE"), "w").close()
+    assert restored.done()
+
+
+def test_dynamic_source_static_fallback(tmp_path):
+    """Executors without runtime coordination still read the directory as a
+    static split list (deploy-time enumeration)."""
+    d = str(tmp_path)
+    _write_csv(os.path.join(d, "a.csv"), 0, 30)
+    _write_csv(os.path.join(d, "b.csv"), 30, 80)
+    src = DynamicFileSource(d)
+    splits = src.create_splits(4)
+    assert len(splits) == 2
+    rows = []
+    for s in splits:
+        for el in s.read():
+            if hasattr(el, "columns"):
+                rows.extend(el.to_rows())
+    assert sorted(int(r["v"]) for r in rows) == list(range(80))
+
+
+@pytest.mark.slow
+def test_cross_process_split_requests(tmp_path):
+    """ProcessCluster: readers in WORKER PROCESSES request splits from the
+    coordinator over the control plane (the actual RPC case of
+    ``SourceCoordinator.java:155-170``)."""
+    import sys
+    import textwrap
+
+    from flink_tpu.cluster.distributed import ProcessCluster
+
+    d = tmp_path / "data"
+    d.mkdir()
+    for i, name in enumerate(["a.csv", "b.csv", "c.csv"]):
+        _write_csv(str(d / name), i * 100, (i + 1) * 100)
+    (d / "_DONE").touch()
+
+    mod = tmp_path / "dyn_src_job.py"
+    mod.write_text(textwrap.dedent(f'''
+        from flink_tpu.connectors.enumerator import DynamicFileSource
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+        def build():
+            env = StreamExecutionEnvironment()
+            env.set_parallelism(2)
+            (env.from_source(DynamicFileSource({str(d)!r}, format="csv"))
+                .key_by("k").sum("v").collect())
+            return env.get_stream_graph("dyn-src-job")
+    '''))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        pc = ProcessCluster("dyn_src_job:build", n_workers=2,
+                            extra_sys_path=(str(tmp_path),))
+        res = pc.run(timeout_s=120)
+        assert res["state"] == "FINISHED", res
+        vals = np.arange(300)
+        expect = {k: int(vals[vals % 7 == k].sum()) for k in range(7)}
+        final = {}
+        for r in res["rows"]:
+            final[int(r["k"])] = int(r["v"])
+        assert final == expect
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("dyn_src_job", None)
