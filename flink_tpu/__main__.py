@@ -187,6 +187,30 @@ def _cmd_worker(args) -> int:
                           advertise_host=args.advertise).run()
 
 
+def _cmd_logservice(args) -> int:
+    from flink_tpu.connectors.log_service import LogServiceBroker
+
+    broker = LogServiceBroker(args.dir, host=args.host, port=args.port)
+    print(f"log service broker on {broker.url} (dir={args.dir})")
+    try:
+        broker.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_objectstore(args) -> int:
+    from flink_tpu.runtime.checkpoint.objectstore import ObjectStoreServer
+
+    store = ObjectStoreServer(args.dir, host=args.host, port=args.port)
+    print(f"object store on {store.url} (dir={args.dir})")
+    try:
+        store.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def _load_restore(args):
     """--restore/-s: explicit savepoint/checkpoint path (or None)."""
     if not getattr(args, "restore", None):
@@ -280,6 +304,18 @@ def main(argv=None) -> int:
                     help="savepoint/checkpoint path to restore from")
     pco.add_argument("--timeout", type=float, default=86400.0)
     pco.set_defaults(fn=_cmd_coordinate)
+    pls = sub.add_parser("logservice", help="standalone durable log broker "
+                         "(Kafka-analog service any process can dial)")
+    pls.add_argument("--dir", required=True)
+    pls.add_argument("--host", default="127.0.0.1")
+    pls.add_argument("--port", type=int, default=9092)
+    pls.set_defaults(fn=_cmd_logservice)
+    pos = sub.add_parser("objectstore", help="standalone HTTP object store "
+                         "(S3-analog checkpoint/savepoint backend)")
+    pos.add_argument("--dir", required=True)
+    pos.add_argument("--host", default="127.0.0.1")
+    pos.add_argument("--port", type=int, default=9000)
+    pos.set_defaults(fn=_cmd_objectstore)
     for name, needs_job in (("list", False), ("status", True),
                             ("cancel", True), ("savepoint", True),
                             ("stop", True)):

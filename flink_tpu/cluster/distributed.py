@@ -467,6 +467,33 @@ class _WorkerRuntime:
                 q = self._split_queues.get((uid, idx))
                 if q is not None:
                     q.put((split, done))
+            elif kind == "reset":
+                # surviving-worker recovery: tear down THIS worker's tasks
+                # and channels, keep the process (and its warm caches/data
+                # plane address) alive for the next deploy
+                with self._lock:
+                    self._done_sent = True  # suppress worker_done/rows
+                for w in self._remote_writers:
+                    try:
+                        w.close()          # unblocks producers first
+                    except OSError:
+                        pass
+                self._remote_writers = []
+                # poison split-request waits: a reader parked in q.get()
+                # cannot see cancel(); (None, True) ends its loop cleanly
+                for q in self._split_queues.values():
+                    q.put((None, True))
+                for t in self.tasks:
+                    t.cancel()
+                for t in self.tasks:
+                    t.join(timeout_s=10)
+                self.server.reset()
+                self.tasks = []
+                self._split_queues = {}
+                with self._lock:
+                    self._terminal = set()
+                    self._done_sent = False
+                self._send(("reset_done", self.index))
             elif kind == "cancel":
                 for t in self.tasks:
                     t.cancel()
@@ -505,7 +532,7 @@ class ProcessCluster:
                  extra_sys_path: Tuple[str, ...] = (), security=None,
                  spawn: bool = True, bind_host: str = "127.0.0.1",
                  listen_port: int = 0, restart_attempts: int = 0,
-                 restart_delay_ms: int = 500):
+                 restart_delay_ms: int = 500, worker_recovery: bool = True):
         self.job = job
         self.n_workers = n_workers
         self.checkpoint_storage = checkpoint_storage
@@ -528,6 +555,13 @@ class ProcessCluster:
         #: all-to-all edges make the whole job one pipelined region)
         self.restart_attempts = restart_attempts
         self.restart_delay_ms = restart_delay_ms
+        #: prefer IN-PLACE recovery on worker loss (respawn the dead
+        #: process, redeploy tasks from the latest checkpoint, keep
+        #: surviving processes up) over a full-cluster restart
+        self.worker_recovery = worker_recovery
+        self._recovering = False
+        self._reset_cv = threading.Condition()
+        self._reset_acks: set = set()
         self._lock = threading.Lock()
         self._next_cid = 1
         self._completed_ids: List[int] = []
@@ -584,17 +618,13 @@ class ProcessCluster:
             attempt += 1
             time.sleep(self.restart_delay_ms / 1000.0)
 
-    def _run_once(self, timeout_s: float,
-                  restore: Optional[Dict[str, Any]],
-                  attempt: int = 0) -> Dict[str, Any]:
-        plan = build_plan(self.job)
-        self._counts, _ = subtask_counts_of(plan)
-        all_subtasks = {(uid, i) for uid, n in self._counts.items()
-                        for i in range(n)}
-        # runtime source coordination: enumerators live HERE, on the
-        # coordinator (SourceCoordinator.java:75); readers request splits
-        # via split_request control messages
+    def _setup_source_coordinator(self, plan, restore) -> None:
+        """Enumerators live HERE, on the coordinator
+        (``SourceCoordinator.java:75``); readers request splits via
+        split_request control messages.  Restore reconciles reader-owned
+        splits (in-flight + consumed) into the assigned sets."""
         from flink_tpu.connectors.enumerator import SourceCoordinator
+
         self._source_coordinator = SourceCoordinator()
         for v in plan.vertices:
             if v.is_source:
@@ -612,6 +642,15 @@ class ProcessCluster:
                         enum.reclaim(s["current_split"])
                     for fs in s.get("finished_splits", []):
                         enum.reclaim(fs)
+
+    def _run_once(self, timeout_s: float,
+                  restore: Optional[Dict[str, Any]],
+                  attempt: int = 0) -> Dict[str, Any]:
+        plan = build_plan(self.job)
+        self._counts, _ = subtask_counts_of(plan)
+        all_subtasks = {(uid, i) for uid, n in self._counts.items()
+                        for i in range(n)}
+        self._setup_source_coordinator(plan, restore)
         # NOTE: no implicit load_latest() here — a fresh run with a reused
         # --checkpoint-dir starts fresh unless the caller passed an explicit
         # restore (the reference's -s savepoint semantics); the restart loop
@@ -621,7 +660,7 @@ class ProcessCluster:
         self.control_port = cport
         procs: List[subprocess.Popen] = []
         if self.spawn:
-            env = dict(os.environ)
+            self._spawn_env = env = dict(os.environ)
             env["PYTHONPATH"] = os.pathsep.join(
                 (*self.extra_sys_path, *sys.path, env.get("PYTHONPATH", "")))
             if self.security is not None:
@@ -633,11 +672,9 @@ class ProcessCluster:
                     env["FLINK_TPU_AUTH_TOKEN"] = self.security.auth_token
             # failure-injection hooks / logs can key on the execution attempt
             env["FLINK_TPU_ATTEMPT"] = str(attempt)
-            procs = [subprocess.Popen(
-                [sys.executable, "-m", "flink_tpu", "worker",
-                 "--index", str(i), "--workers", str(self.n_workers),
-                 "--job", self.job, "--coordinator", f"127.0.0.1:{cport}"],
-                env=env) for i in range(self.n_workers)]
+            procs = [self._spawn_worker(i, cport)
+                     for i in range(self.n_workers)]
+        self._procs = procs  # chaos tests / operators can observe pids
         try:
             # spawned workers register within seconds; external (pod) workers
             # may take as long as the cluster scheduler needs.  The limit is
@@ -670,7 +707,7 @@ class ProcessCluster:
                                 f"({len(hello_conns)}/{self.n_workers} "
                                 f"registered)")
                 return {"state": "FAILED", "error": self._failed,
-                        "rows": [],
+                        "rows": [], "recoveries": 0,
                         "completed_checkpoints": list(self._completed_ids)}
             for idx, conn in hello_conns:
                 self._conns[idx] = conn
@@ -683,16 +720,45 @@ class ProcessCluster:
                 threads.append(th)
             for idx in self._conns:
                 self._to_worker(idx, ("deploy", addresses, restore))
-            ticker = None
             if self.checkpoint_interval_ms > 0:
                 # the ticker loops on ITS attempt's event (self._all_done
-                # is replaced between restart attempts)
-                ticker = threading.Thread(
+                # is replaced between restart attempts/recoveries)
+                threading.Thread(
                     target=self._checkpoint_loop,
-                    args=(all_subtasks, self._all_done), daemon=True)
-                ticker.start()
-            if not self._all_done.wait(timeout=timeout_s):
-                self._failed = self._failed or "timeout"
+                    args=(all_subtasks, self._all_done), daemon=True).start()
+            # ---- main wait, with SURVIVING-WORKER recovery: a dead worker
+            # process is respawned and only the TASKS redeploy (from the
+            # latest checkpoint, everywhere — consistency); surviving
+            # worker processes stay up with their data-plane addresses
+            # (the local-recovery posture; with all-to-all keyed edges the
+            # whole job is one pipelined region, so all tasks roll back,
+            # but no surviving process restarts)
+            deadline = time.monotonic() + timeout_s
+            recoveries = 0
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._all_done.wait(
+                        timeout=remaining):
+                    self._failed = self._failed or "timeout"
+                    break
+                if self._failed is None:
+                    break                   # finished cleanly
+                dead = [i for i, p in enumerate(procs)
+                        if p.poll() is not None]
+                if not (self.spawn and self.worker_recovery and dead
+                        and recoveries < self.restart_attempts
+                        and time.monotonic() < deadline):
+                    break                   # full-restart path handles it
+                recoveries += 1
+                time.sleep(self.restart_delay_ms / 1000.0)
+                self._recover_workers(plan, procs, dead, addresses, srv,
+                                      server_ctx, need_token, cport,
+                                      restore)
+                if self.checkpoint_interval_ms > 0:
+                    threading.Thread(
+                        target=self._checkpoint_loop,
+                        args=(all_subtasks, self._all_done),
+                        daemon=True).start()
             for idx in self._conns:
                 self._to_worker(idx, ("stop",))
             for p in procs:
@@ -705,6 +771,7 @@ class ProcessCluster:
             for key in sorted(self._rows):
                 rows.extend(self._rows[key])
             return {"state": state, "error": self._failed, "rows": rows,
+                    "recoveries": recoveries,
                     "completed_checkpoints": list(self._completed_ids)}
         finally:
             self._all_done.set()   # stop this attempt's checkpoint ticker
@@ -724,14 +791,89 @@ class ProcessCluster:
                 except subprocess.TimeoutExpired:
                     pass
 
+    def _spawn_worker(self, index: int, cport: int) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "flink_tpu", "worker",
+             "--index", str(index), "--workers", str(self.n_workers),
+             "--job", self.job, "--coordinator", f"127.0.0.1:{cport}"],
+            env=self._spawn_env)
+
+    def _recover_workers(self, plan, procs, dead, addresses, srv,
+                         server_ctx, need_token: bool, cport: int,
+                         original_restore) -> None:
+        """In-place recovery: quiesce survivors, respawn the dead worker
+        processes, redeploy every task from this run's latest checkpoint.
+        Surviving processes (and their data-plane servers) never restart —
+        the reference's local-recovery posture
+        (``RestartPipelinedRegionFailoverStrategy`` + local recovery)."""
+        self._recovering = True
+        old_done = self._all_done
+        survivors = [i for i in range(self.n_workers) if i not in dead]
+        # 1. quiesce survivors (tasks cancel, channels drop, process stays)
+        with self._reset_cv:
+            self._reset_acks = set()
+        for i in survivors:
+            self._to_worker(i, ("reset",))
+        end = time.monotonic() + 30
+        with self._reset_cv:
+            while not set(survivors) <= self._reset_acks \
+                    and time.monotonic() < end:
+                self._reset_cv.wait(timeout=1.0)
+        # 2. respawn dead workers and register ONLY them
+        for i in dead:
+            procs[i] = self._spawn_worker(i, cport)
+        new_addr: Dict[int, Tuple[str, int]] = {}
+        new_conns: List[Tuple[int, socket.socket]] = []
+        try:
+            self._register_workers(srv, server_ctx, need_token, new_addr,
+                                   new_conns, threading.Lock(),
+                                   time.monotonic() + 90,
+                                   expected=len(dead), allowed=set(dead))
+        except socket.timeout:
+            with self._lock:
+                self._failed = "respawned worker failed to register"
+                self._all_done.set()
+            self._recovering = False
+            return
+        addresses.update(new_addr)
+        for idx, conn in new_conns:
+            self._conns[idx] = conn
+            self._send_locks[idx] = threading.Lock()
+            threading.Thread(target=self._serve_worker, args=(idx, conn),
+                             daemon=True).start()
+        # 3. fresh attempt state (conns, gen and serve threads survive)
+        with self._lock:
+            self._states = {}
+            self._finals = {}
+            self._rows = {}
+            self._pending = None
+            self._failed = None
+            self._done_workers = set()
+            self._all_done = threading.Event()
+        old_done.set()  # stop the previous checkpoint ticker
+        # 4. redeploy from this run's latest completed checkpoint
+        latest = None
+        if self.checkpoint_storage is not None and self._completed_ids:
+            latest = self.checkpoint_storage.load(max(self._completed_ids))
+        restore = latest or original_restore
+        self._setup_source_coordinator(plan, restore)
+        self._recovering = False
+        for idx in self._conns:
+            self._to_worker(idx, ("deploy", addresses, restore))
+
     def _register_workers(self, srv, server_ctx, need_token: bool,
                           addresses: Dict[int, Tuple[str, int]],
                           hello_conns: List[Tuple[int, socket.socket]],
                           tmp_lock: threading.Lock,
-                          deadline: float) -> None:
-        """Accept until every worker said a valid hello; raises
-        ``socket.timeout`` once the OVERALL deadline passes."""
-        while len(hello_conns) < self.n_workers:
+                          deadline: float,
+                          expected: Optional[int] = None,
+                          allowed: Optional[set] = None) -> None:
+        """Accept until ``expected`` (default: all) workers said a valid
+        hello; raises ``socket.timeout`` once the OVERALL deadline passes.
+        ``allowed`` restricts acceptable worker indices (recovery accepts
+        only the respawned ones)."""
+        target = self.n_workers if expected is None else expected
+        while len(hello_conns) < target:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise socket.timeout("worker registration deadline")
@@ -762,6 +904,7 @@ class ProcessCluster:
                 if not isinstance(idx, int) \
                         or not 0 <= idx < self.n_workers \
                         or idx in addresses \
+                        or (allowed is not None and idx not in allowed) \
                         or not isinstance(host, str) \
                         or not isinstance(port, int):
                     conn.close()
@@ -850,6 +993,10 @@ class ProcessCluster:
                 _, uid, i, rows = msg
                 with self._lock:
                     self._rows[(uid, i)] = rows
+            elif kind == "reset_done":
+                with self._reset_cv:
+                    self._reset_acks.add(msg[1])
+                    self._reset_cv.notify_all()
             elif kind == "worker_done":
                 with self._lock:
                     self._done_workers.add(msg[1])
@@ -859,7 +1006,8 @@ class ProcessCluster:
     # -- checkpointing -----------------------------------------------------
     def trigger_checkpoint(self, all_subtasks: set) -> Optional[int]:
         with self._lock:
-            if self._pending is not None or self._failed is not None:
+            if self._pending is not None or self._failed is not None \
+                    or self._recovering:
                 return None
             live = {k for k in all_subtasks
                     if self._states.get(k) != "FINISHED"}

@@ -273,6 +273,17 @@ class ChannelServer:
         finally:
             conn.close()
 
+    def reset(self) -> None:
+        """Drop all channel queues (worker recovery: fresh deploys create
+        fresh channels; stale connections keep pushing into the detached
+        old queues, which nothing polls).  The server socket stays up — the
+        worker's advertised address survives the recovery."""
+        with self._lock:
+            old = list(self._queues.values())
+            self._queues = {}
+        for q in old:
+            q.close()
+
     def stop(self) -> None:
         self._stop.set()
         try:

@@ -167,8 +167,14 @@ class SourceSubtask(SubtaskBase):
     in-flight split."""
 
     def _final_snapshot(self) -> Dict[str, Any]:
-        return {"operator": self.operator.snapshot_state(),
+        snap = {"operator": self.operator.snapshot_state(),
                 "source_offset": self._emitted, "finished": True}
+        if self.split_requester is not None:
+            # split ownership must survive into checkpoints completed AFTER
+            # this reader finished, or restore re-reads its splits
+            snap["current_split"] = self._current_split
+            snap["finished_splits"] = list(self._finished_splits)
+        return snap
 
     def __init__(self, vertex_uid: str, subtask_index: int, operator,
                  outputs, ctx, listener, split,

@@ -174,6 +174,35 @@ def read_frames(path: str, start_offset: int = 0):
             yield payload, f.tell()
 
 
+def iter_frames(data: bytes):
+    """Yield frame payloads from an in-memory buffer (wire fetch bodies)."""
+    from flink_tpu.native import crc32
+
+    off = 0
+    while off + _FRAME.size <= len(data):
+        ln, crc = _FRAME.unpack_from(data, off)
+        start = off + _FRAME.size
+        if start + ln > len(data):
+            return
+        payload = data[start:start + ln]
+        if crc32(payload) != crc:
+            raise IOError("frame CRC mismatch in buffer")
+        yield payload
+        off = start + ln
+
+
+def frame_span(data: bytes) -> int:
+    """Byte length of the WHOLE frames at the head of ``data`` (a fetch
+    response must never split a frame)."""
+    off = 0
+    while off + _FRAME.size <= len(data):
+        ln, _ = _FRAME.unpack_from(data, off)
+        if off + _FRAME.size + ln > len(data):
+            break
+        off += _FRAME.size + ln
+    return off
+
+
 def write_ftb(batches, path: str, compress: bool = True,
               append: bool = False) -> int:
     from flink_tpu.native.codec import encode_batch

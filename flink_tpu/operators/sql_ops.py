@@ -252,11 +252,25 @@ class ChangelogGroupAggOperator(StreamOperator):
                 donate_argnums=(0,))
         return fn
 
+    #: per-batch partials reduce in plain f32 (exact for counts up to 2^24
+    #: per batch); batches beyond this bound chunk so the within-chunk
+    #: reduction stays exact and the double-single merge carries precision
+    #: across chunks
+    _MAX_CHUNK = 1 << 22
+
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         import jax.numpy as jnp
 
         if len(batch) == 0:
             return []
+        if len(batch) > self._MAX_CHUNK:
+            out: List[StreamElement] = []
+            n = len(batch)
+            idx = np.arange(n)
+            for lo in range(0, n, self._MAX_CHUNK):
+                m = (idx >= lo) & (idx < lo + self._MAX_CHUNK)
+                out.extend(self.process_batch(batch.select(m)))
+            return out
         from flink_tpu.state.keyindex import make_key_index
 
         keys = np.asarray(batch.column(self.key_column))

@@ -1,0 +1,218 @@
+"""Object-store checkpoint storage: snapshots behind an S3-shaped service.
+
+The reference persists checkpoints to pluggable remote filesystems
+(``flink-filesystems/flink-s3-fs-base``, ``FsCheckpointStorageAccess``);
+this module provides the same seam against an HTTP object store — a
+standalone :class:`ObjectStoreServer` process (``python -m flink_tpu
+objectstore``) speaking a minimal S3-like protocol, and
+:class:`ObjectStoreCheckpointStorage` implementing the exact storage
+interface of ``FileCheckpointStorage`` (store/load/load_latest/
+checkpoint_ids/metadata) over it.
+
+Wire protocol:
+  - ``PUT    /o/{key}``          store object (atomic: temp + rename)
+  - ``GET    /o/{key}``          fetch object
+  - ``GET    /list?prefix=P``    JSON list of keys
+  - ``DELETE /o/{key}``          remove object
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from flink_tpu.runtime.checkpoint.storage import (FORMAT_VERSION, _to_numpy)
+
+
+class ObjectStoreServer:
+    """Minimal durable object store over HTTP (keys -> files on disk)."""
+
+    def __init__(self, directory: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _path(self, key: str) -> str:
+                safe = urllib.parse.quote(key, safe="")
+                return os.path.join(store.directory, safe)
+
+            def do_PUT(self):
+                if not self.path.startswith("/o/"):
+                    self.send_error(404)
+                    return
+                key = urllib.parse.unquote(self.path[3:])
+                ln = int(self.headers["Content-Length"])
+                data = self.rfile.read(ln)
+                path = self._path(key)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                if self.path.startswith("/o/"):
+                    key = urllib.parse.unquote(self.path[3:])
+                    path = self._path(key)
+                    if not os.path.exists(path):
+                        self.send_error(404)
+                        return
+                    with open(path, "rb") as f:
+                        data = f.read()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if self.path.startswith("/list"):
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    prefix = q.get("prefix", [""])[0]
+                    keys = sorted(
+                        urllib.parse.unquote(n)
+                        for n in os.listdir(store.directory)
+                        if not n.endswith(".tmp")
+                        and urllib.parse.unquote(n).startswith(prefix))
+                    body = json.dumps(keys).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_error(404)
+
+            def do_DELETE(self):
+                if not self.path.startswith("/o/"):
+                    self.send_error(404)
+                    return
+                key = urllib.parse.unquote(self.path[3:])
+                try:
+                    os.remove(self._path(key))
+                except FileNotFoundError:
+                    pass
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self.url = f"http://{self.host}:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="object-store", daemon=True)
+
+    def start(self) -> "ObjectStoreServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+
+class ObjectStoreClient:
+    def __init__(self, url: str, timeout_s: float = 60.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _req(self, method: str, path: str, body: Optional[bytes] = None):
+        req = urllib.request.Request(self.url + path, data=body,
+                                     method=method)
+        return urllib.request.urlopen(req, timeout=self.timeout_s)
+
+    def put(self, key: str, data: bytes) -> None:
+        self._req("PUT", "/o/" + urllib.parse.quote(key, safe=""),
+                  data).read()
+
+    def get(self, key: str) -> bytes:
+        with self._req("GET", "/o/" + urllib.parse.quote(key, safe="")) as r:
+            return r.read()
+
+    def list(self, prefix: str = "") -> List[str]:
+        with self._req("GET", "/list?prefix="
+                       + urllib.parse.quote(prefix)) as r:
+            return json.loads(r.read())
+
+    def delete(self, key: str) -> None:
+        self._req("DELETE", "/o/"
+                  + urllib.parse.quote(key, safe="")).read()
+
+
+class ObjectStoreCheckpointStorage:
+    """Checkpoint storage against the object store — same interface (and
+    key layout) as ``FileCheckpointStorage``: ``{prefix}chk-{id}/op-{j}.pkl``
+    objects plus a ``_metadata.json`` published LAST (readers only trust
+    checkpoints whose metadata object exists — the atomic-rename analog)."""
+
+    def __init__(self, url: str, prefix: str = "", retain: int = 3):
+        self.client = ObjectStoreClient(url)
+        self.prefix = prefix
+        self.retain = retain
+
+    def _meta_key(self, cid: int) -> str:
+        return f"{self.prefix}chk-{cid}/_metadata.json"
+
+    def store(self, checkpoint_id: int, snapshot: Dict[str, Any]) -> None:
+        uids = []
+        for uid, op_snap in snapshot.items():
+            fname = f"op-{len(uids)}.pkl"
+            uids.append({"uid": uid, "file": fname})
+            self.client.put(f"{self.prefix}chk-{checkpoint_id}/{fname}",
+                            pickle.dumps(_to_numpy(op_snap), protocol=4))
+        meta = {"version": FORMAT_VERSION, "checkpoint_id": checkpoint_id,
+                "timestamp_ms": int(time.time() * 1000), "operators": uids}
+        # metadata LAST: its presence publishes the checkpoint
+        self.client.put(self._meta_key(checkpoint_id),
+                        json.dumps(meta).encode())
+        self._cleanup()
+
+    def _cleanup(self) -> None:
+        ids = self.checkpoint_ids()
+        for cid in ids[: max(0, len(ids) - self.retain)]:
+            for key in self.client.list(f"{self.prefix}chk-{cid}/"):
+                self.client.delete(key)
+
+    def checkpoint_ids(self) -> List[int]:
+        out = []
+        for key in self.client.list(self.prefix):
+            tail = key[len(self.prefix):]
+            if tail.endswith("/_metadata.json") and tail.startswith("chk-"):
+                cid = tail[4:].split("/", 1)[0]
+                if cid.isdigit():
+                    out.append(int(cid))
+        return sorted(out)
+
+    def load(self, checkpoint_id: int) -> Dict[str, Any]:
+        meta = json.loads(self.client.get(self._meta_key(checkpoint_id)))
+        if meta["version"] > FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {meta['version']} too new")
+        out: Dict[str, Any] = {}
+        for entry in meta["operators"]:
+            out[entry["uid"]] = pickle.loads(self.client.get(
+                f"{self.prefix}chk-{checkpoint_id}/{entry['file']}"))
+        return out
+
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        ids = self.checkpoint_ids()
+        return self.load(ids[-1]) if ids else None
+
+    def metadata(self, checkpoint_id: int) -> Dict[str, Any]:
+        return json.loads(self.client.get(self._meta_key(checkpoint_id)))

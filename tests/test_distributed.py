@@ -176,7 +176,9 @@ def test_worker_crash_restart_from_checkpoint(tmp_path):
                             extra_sys_path=(str(tmp_path),))
         res = pc.run(timeout_s=300)
         assert res["state"] == "FINISHED", res["error"]
-        assert res["attempts"] >= 2, "the poison pill must have fired"
+        # the poison pill must have fired: recovered either in place
+        # (surviving-worker recovery) or via a full restart
+        assert res["attempts"] + res.get("recoveries", 0) >= 2
         totals = {}
         for r in res["rows"]:
             totals[r["k"]] = max(r["v_total"], totals.get(r["k"], 0.0))
@@ -186,3 +188,77 @@ def test_worker_crash_restart_from_checkpoint(tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
         sys.modules.pop("crash_job_mod", None)
+
+
+def test_surviving_worker_recovery_keeps_other_processes(tmp_path):
+    """VERDICT r1 #7: killing 1 of 3 workers recovers WITHOUT restarting
+    the other two processes — the dead worker respawns, tasks redeploy
+    from the latest checkpoint, surviving PIDs are unchanged."""
+    import signal
+    import textwrap
+    import threading
+    import time
+
+    mod = tmp_path / "survive_job_mod.py"
+    mod.write_text(textwrap.dedent('''
+        import numpy as np
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+        N = 60_000
+        K = 9
+
+        def build():
+            env = StreamExecutionEnvironment()
+            env.set_parallelism(3)
+            keys = (np.arange(N) % K).astype(np.int64)
+            (env.from_collection(columns={"k": keys, "v": np.ones(N)},
+                                 batch_size=64)
+                .key_by("k").sum("v", output_column="v_total")
+                .collect())
+            return env.get_stream_graph("survive-job")
+    '''))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        store = FileCheckpointStorage(str(tmp_path / "ckpt"))
+        pc = ProcessCluster("survive_job_mod:build", n_workers=3,
+                            checkpoint_storage=store,
+                            checkpoint_interval_ms=50,
+                            restart_attempts=2,
+                            extra_sys_path=(str(tmp_path),))
+        killed = {"pids": None, "victim": None}
+
+        def chaos():
+            # wait for the first completed checkpoint, then kill worker 2
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if pc._completed_ids and getattr(pc, "_procs", None):
+                    procs = pc._procs
+                    if all(p.poll() is None for p in procs):
+                        killed["pids"] = [p.pid for p in procs]
+                        killed["victim"] = 2
+                        os.kill(procs[2].pid, signal.SIGKILL)
+                        return
+                time.sleep(0.02)
+
+        th = threading.Thread(target=chaos)
+        th.start()
+        res = pc.run(timeout_s=300)
+        th.join()
+        assert killed["pids"] is not None, "chaos thread never fired"
+        assert res["state"] == "FINISHED", res["error"]
+        assert res.get("recoveries", 0) >= 1, res
+        assert res["attempts"] == 1, "survivors must not full-restart"
+        # the two surviving worker PROCESSES are the original ones
+        final_pids = [p.pid for p in pc._procs]
+        assert final_pids[0] == killed["pids"][0]
+        assert final_pids[1] == killed["pids"][1]
+        assert final_pids[2] != killed["pids"][2]
+        n, k = 60_000, 9
+        totals = {}
+        for r in res["rows"]:
+            totals[r["k"]] = max(r["v_total"], totals.get(r["k"], 0.0))
+        expect = {i: float(len(range(i, n, k))) for i in range(k)}
+        assert totals == expect
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("survive_job_mod", None)

@@ -1,0 +1,165 @@
+"""External log service + object store (VERDICT r1 #8): a broker process
+any client can dial over HTTP (the Kafka-connector analog,
+``flink-connectors/flink-connector-kafka``), and an S3-shaped checkpoint
+backend behind the storage seam (``flink-filesystems/flink-s3-fs-base``).
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.log_service import (LogServiceBroker,
+                                              LogServiceClient,
+                                              LogServiceSink,
+                                              LogServiceSource)
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.runtime.checkpoint.objectstore import (
+    ObjectStoreCheckpointStorage, ObjectStoreServer)
+
+
+@pytest.fixture
+def broker(tmp_path):
+    b = LogServiceBroker(str(tmp_path / "broker")).start()
+    yield b
+    b.stop()
+
+
+def test_broker_append_fetch_roundtrip(broker):
+    c = LogServiceClient(broker.url)
+    c.create_topic("t", partitions=2)
+    c.append("t", 0, RecordBatch({"x": np.arange(5)}))
+    c.append("t", 1, RecordBatch({"x": np.arange(5, 9)}))
+    batches, nxt = c.fetch("t", 0, 0)
+    assert [int(v) for b in batches for v in np.asarray(b.column("x"))] == \
+        [0, 1, 2, 3, 4]
+    batches2, _ = c.fetch("t", 1, 0)
+    assert len(batches2) == 1
+    # offset resume: fetching from nxt returns nothing new
+    more, nxt2 = c.fetch("t", 0, nxt)
+    assert more == [] and nxt2 == nxt
+
+
+def test_idempotent_producer_dedup(broker):
+    c = LogServiceClient(broker.url)
+    c.create_topic("t")
+    b = RecordBatch({"x": np.arange(3)})
+    c.append("t", 0, b, producer="p1", seq=7)
+    c.append("t", 0, b, producer="p1", seq=7)   # retry: dropped
+    c.append("t", 0, b, producer="p1", seq=6)   # stale: dropped
+    c.append("t", 0, b, producer="p2", seq=1)   # other producer: kept
+    batches, _ = c.fetch("t", 0, 0)
+    assert len(batches) == 2
+
+
+def test_source_sink_job_roundtrip(broker, tmp_path):
+    """Pipeline consumes from the broker and produces exactly-once back."""
+    c = LogServiceClient(broker.url)
+    c.create_topic("in", partitions=2)
+    for p in range(2):
+        for lo in range(0, 300, 100):
+            c.append("in", p, RecordBatch({
+                "k": (np.arange(lo, lo + 100) % 5).astype(np.int64),
+                "v": np.ones(100)}))
+
+    env = StreamExecutionEnvironment()
+    src = LogServiceSource(broker.url, "in")
+    sink = LogServiceSink(broker.url, "out", num_partitions=2,
+                          key_column="k")
+    (env.from_source(src).key_by("k")
+        .sum("v", output_column="total").add_sink(sink))
+    env.execute()
+    out_rows = []
+    for p in range(2):
+        batches, _ = c.fetch("out", p, 0, max_bytes=1 << 24)
+        for b in batches:
+            out_rows.extend(b.to_rows())
+    finals = {}
+    for r in out_rows:
+        finals[int(r["k"])] = max(finals.get(int(r["k"]), 0), r["total"])
+    assert finals == {k: 120.0 for k in range(5)}
+
+
+def test_external_process_feeds_broker(broker, tmp_path):
+    """A SEPARATE OS process produces into the broker over the wire — the
+    external-world integration the in-repo partitioned log cannot do."""
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, "/root/repo")
+        import numpy as np
+        from flink_tpu.connectors.log_service import LogServiceClient
+        from flink_tpu.core.batch import RecordBatch
+        c = LogServiceClient("{broker.url}")
+        c.create_topic("ext", partitions=1)
+        for i in range(4):
+            c.append("ext", 0, RecordBatch({{"n": np.arange(i*10, i*10+10)}}))
+        print("fed")
+    """)
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120)
+    assert "fed" in out.stdout, out.stderr
+    src = LogServiceSource(broker.url, "ext")
+    env = StreamExecutionEnvironment()
+    got = env.from_source(src).collect()
+    env.execute()
+    assert sorted(int(r["n"]) for r in got.rows()) == list(range(40))
+
+
+def test_sink_commit_replay_dedups(broker):
+    """2PC replay: restoring a snapshot re-commits staged txns with the
+    same producer sequences; the broker drops the duplicates."""
+    sink = LogServiceSink(broker.url, "txn", num_partitions=1)
+    sink.open(None)
+    sink.write_batch(RecordBatch({"x": np.arange(4)}))
+    snap = sink.snapshot_state()          # pre-commit (staged txn 1)
+    sink.notify_checkpoint_complete(1)    # commit
+
+    sink2 = LogServiceSink(broker.url, "txn", num_partitions=1)
+    sink2.restore_state(snap)             # replays the same txn
+    c = LogServiceClient(broker.url)
+    batches, _ = c.fetch("txn", 0, 0)
+    total = sum(len(b) for b in batches)
+    assert total == 4                     # committed exactly once
+
+
+def test_object_store_checkpoint_storage(tmp_path):
+    server = ObjectStoreServer(str(tmp_path / "os")).start()
+    try:
+        st = ObjectStoreCheckpointStorage(server.url, prefix="jobA/",
+                                          retain=2)
+        for cid in (1, 2, 3):
+            st.store(cid, {"op": {"value": np.arange(cid)}})
+        assert st.checkpoint_ids() == [2, 3]   # retention pruned chk-1
+        snap = st.load_latest()
+        np.testing.assert_array_equal(snap["op"]["value"], np.arange(3))
+        meta = st.metadata(3)
+        assert meta["checkpoint_id"] == 3
+    finally:
+        server.stop()
+
+
+def test_object_store_backs_a_cluster_job(tmp_path):
+    """The object store plugs into the SAME seam as FileCheckpointStorage:
+    a MiniCluster job checkpoints to it and restores from it."""
+    from flink_tpu.cluster.task import TaskStates
+
+    server = ObjectStoreServer(str(tmp_path / "os")).start()
+    try:
+        st = ObjectStoreCheckpointStorage(server.url)
+        env = StreamExecutionEnvironment()
+        n = 50_000
+        keys = (np.arange(n) % 7).astype(np.int64)
+        sink = (env.from_collection(columns={"k": keys, "v": np.ones(n)},
+                                    batch_size=256)
+                .key_by("k").sum("v").collect())
+        res = env.execute_cluster(storage=st, checkpoint_interval_ms=20,
+                                  timeout_s=120)
+        assert res.state == TaskStates.FINISHED
+        assert st.checkpoint_ids(), "no checkpoints reached the store"
+        snap = st.load_latest()
+        assert any(isinstance(v, dict) for v in snap.values())
+    finally:
+        server.stop()
