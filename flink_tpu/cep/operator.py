@@ -14,9 +14,13 @@ Analog of ``flink-libraries/flink-cep``'s ``CepOperator`` + ``nfa/NFA.java:86``
   NFA with branching partial matches (take/proceed — the reference's
   ``SharedBuffer`` version tree, here explicit partial-match branches).
 
-Supported semantics: strict (``next``) / relaxed (``followedBy``)
-contiguity, ``times``/``oneOrMore``/``optional`` quantifiers, ``within``,
-NO_SKIP and SKIP_PAST_LAST_EVENT after-match strategies.
+Supported semantics: strict (``next``) / relaxed (``followedBy``) /
+non-deterministic relaxed (``followedByAny``) contiguity, NOT-patterns
+(``notNext``/``notFollowedBy``, incl. trailing ``notFollowedBy`` completing
+on ``within``-window close), ``times``/``oneOrMore``/``optional``
+quantifiers with ``greedy()`` and ``until()``, ``within``, NO_SKIP and
+SKIP_PAST_LAST_EVENT after-match strategies (``NFA.java:86``,
+``Quantifier.java``).
 """
 
 from __future__ import annotations
@@ -37,12 +41,16 @@ class _Partial:
     """One partial match: position in the pattern + taken events.
 
     events: tuple of (stage_index, event_id); count = matches of the
-    CURRENT stage taken so far (for quantifiers)."""
+    CURRENT stage taken so far (for quantifiers); greedy_from: index of the
+    greedy looping stage this partial advanced out of (-1 = none) — while
+    events still match that loop, the loop sibling consumes them and this
+    partial must ignore them (``Quantifier.greedy`` semantics)."""
 
     stage_i: int
     count: int
     events: Tuple[Tuple[int, int], ...]
     first_ts: int
+    greedy_from: int = -1
 
 
 class NFA:
@@ -51,6 +59,10 @@ class NFA:
     def __init__(self, pattern: Pattern):
         self.pattern = pattern
         self.stages = pattern.stages
+        last = pattern.stages[-1]
+        #: fast-path flag: only trailing notFollowedBy patterns need the
+        #: per-event window-close harvest
+        self._trailing_negation = last.negated and last.contiguity != "strict"
         self.partials: List[_Partial] = [_Partial(0, 0, (), LONG_MIN)]
         #: SKIP_PAST_LAST_EVENT barrier: events at/before this ts cannot
         #: extend or start matches
@@ -64,8 +76,9 @@ class NFA:
         return (w is not None and pm.first_ts != LONG_MIN
                 and ts - pm.first_ts > w)
 
-    def advance(self, event_id: int, ts: int,
-                stage_bits: np.ndarray) -> List[Tuple[Tuple[int, int], ...]]:
+    def advance(self, event_id: int, ts: int, stage_bits: np.ndarray,
+                until_bits: Optional[np.ndarray] = None,
+                ) -> List[Tuple[Tuple[int, int], ...]]:
         """Feed one event; returns completed matches (event lists).
 
         Per partial the NFA edges are: **take** (event matches current
@@ -75,7 +88,13 @@ class NFA:
         = ``followedByAny`` may skip matching ones too), and **die** (strict
         stage miss — the pointer-move sibling was already branched at take
         time, so nothing is lost).  Optional stages forward the event to the
-        following stage when they have taken nothing yet."""
+        following stage when they have taken nothing yet.  NEGATED stages
+        (``notNext``/``notFollowedBy``) invert: a condition match KILLS the
+        partial; strict negation is satisfied by one clean event (which then
+        feeds the following stage), relaxed negation watches until the
+        following stage matches.  Greedy loops consume events the advanced
+        sibling would otherwise take; ``until`` closes a loop without taking
+        the closing event."""
         if ts <= self.skip_until_ts:
             return []
         n_stages = len(self.stages)
@@ -87,7 +106,7 @@ class NFA:
             if pm.stage_i >= n_stages:
                 matches.append(pm.events)
                 return
-            key = (pm.stage_i, pm.count, pm.events)
+            key = (pm.stage_i, pm.count, pm.events, pm.greedy_from)
             if key not in seen:
                 seen.add(key)
                 new_partials.append(pm)
@@ -100,14 +119,22 @@ class NFA:
             if st.times_max is None or c < st.times_max:
                 add(_Partial(i, c, taken, first))       # stay in looping stage
             if c >= st.times_min:
-                add(_Partial(i + 1, 0, taken, first))   # stage satisfied
+                add(_Partial(i + 1, 0, taken, first,    # stage satisfied
+                             i if st.greedy else -1))
+
         def feed(pm: _Partial, i: int) -> bool:
             """Match the event against stage i (skipping through optionals)."""
-            if stage_bits[i]:
-                cnt = pm.count if i == pm.stage_i else 0
-                take(_Partial(i, cnt, pm.events, pm.first_ts), i)
-                return True
             st = self.stages[i]
+            if st.negated:
+                return False  # negated stages are driven by the main loop
+            if stage_bits[i]:
+                if i == pm.stage_i and until_bits is not None \
+                        and until_bits[i]:
+                    return False  # until: the loop is closed to this event
+                cnt = pm.count if i == pm.stage_i else 0
+                take(_Partial(i, cnt, pm.events, pm.first_ts,
+                              pm.greedy_from), i)
+                return True
             took_nothing = pm.count == 0 or i != pm.stage_i
             if st.optional and took_nothing and i + 1 < n_stages:
                 return feed(pm, i + 1)
@@ -116,8 +143,57 @@ class NFA:
         for pm in self.partials:
             if self._expired(pm, ts):
                 continue  # within window exceeded: prune
+            if pm.greedy_from >= 0 and stage_bits[pm.greedy_from] \
+                    and not (until_bits is not None
+                             and until_bits[pm.greedy_from]):
+                # the event extends the greedy loop this partial advanced
+                # out of: the loop sibling consumes it and THIS branch is
+                # non-maximal — it dies (greedy suppresses the ignore edge).
+                # EXCEPT when until() closes the loop on this very event:
+                # the loop cannot consume it, so this branch lives on.
+                continue
             i = pm.stage_i
             st = self.stages[i]
+            if st.negated:
+                if stage_bits[i]:
+                    continue        # forbidden event arrived: partial dies
+                if st.contiguity == "strict":
+                    # notNext satisfied by this one clean event; the SAME
+                    # event then feeds the following stage
+                    adv = _Partial(i + 1, 0, pm.events,
+                                   pm.first_ts if pm.first_ts != LONG_MIN
+                                   else ts)
+                    if i + 1 >= n_stages:
+                        add(adv)    # notNext at the end: match completes
+                        continue
+                    matched = feed(adv, i + 1)
+                    nxt = self.stages[i + 1]
+                    if matched:
+                        if nxt.contiguity == "relaxed_any":
+                            add(adv)
+                    elif nxt.contiguity in ("relaxed", "relaxed_any"):
+                        add(adv)
+                    # strict next-stage miss: partial dies
+                else:
+                    # notFollowedBy: watch for the forbidden event while
+                    # offering each event to the FOLLOWING stage; once that
+                    # stage matches, the watcher retires (first-match
+                    # semantics — staying would turn a plain followedBy
+                    # into followedByAny)
+                    matched = (feed(pm, i + 1) if i + 1 < n_stages
+                               else False)
+                    nxt = (self.stages[i + 1] if i + 1 < n_stages else None)
+                    if matched:
+                        if nxt is not None and nxt.contiguity == "relaxed_any":
+                            add(pm)
+                    elif nxt is None or nxt.contiguity != "strict":
+                        add(pm)     # keep watching (relaxed)
+                continue
+            # until on a looping stage: the closing event ends the loop
+            # permanently — the advanced sibling (created at the last take)
+            # carries on; this stay-partial dies without taking
+            if until_bits is not None and until_bits[i] and pm.count > 0:
+                continue
             matched = feed(pm, i)
             if i == 0 and pm.count == 0:
                 add(pm)                 # the start state always persists
@@ -138,6 +214,29 @@ class NFA:
             self.partials = [_Partial(0, 0, (), LONG_MIN)]
         return matches
 
+    def harvest_expired_negations(self, now: int
+                                  ) -> List[Tuple[Tuple[Tuple[int, int], ...],
+                                                  int]]:
+        """A pattern ENDING in ``notFollowedBy`` completes when its
+        ``within`` window closes without the forbidden event (the reference
+        only allows a trailing notFollowedBy under ``within``).  Returns
+        ``(events, completion_ts)`` pairs — the match's event time is the
+        WINDOW CLOSE (first_ts + within), not the draining watermark."""
+        w = self.pattern.within_ms
+        if w is None or not self._trailing_negation:
+            return []
+        n = len(self.stages)
+        out: List[Tuple[Tuple[Tuple[int, int], ...], int]] = []
+        keep: List[_Partial] = []
+        for pm in self.partials:
+            if (pm.stage_i == n - 1 and pm.first_ts != LONG_MIN
+                    and now - pm.first_ts > w):
+                out.append((pm.events, pm.first_ts + w))
+                continue
+            keep.append(pm)
+        self.partials = keep
+        return out
+
 
 class CepOperator(StreamOperator):
     """Keyed CEP: buffer events to watermark, run per-key NFAs, emit matches.
@@ -149,12 +248,19 @@ class CepOperator(StreamOperator):
     def __init__(self, pattern: Pattern, key_column: str,
                  select_fn: Callable[[Dict[str, List[dict]]], dict],
                  name: str = "cep"):
+        last = pattern.stages[-1]
+        if last.negated and last.contiguity != "strict" \
+                and pattern.within_ms is None:
+            # the reference's rule: NotFollowedBy can't end a pattern
+            # without a within window (the match could never complete)
+            raise ValueError("notFollowedBy cannot be the last pattern "
+                             "stage without within()")
         self.pattern = pattern
         self.key_column = key_column
         self.select_fn = select_fn
         self.name = name
         self._nfas: Dict[Any, NFA] = {}
-        #: per key: list of (ts, event_id, stage_bits, row)
+        #: per key: list of (ts, event_id, stage_bits, until_bits|None, row)
         self._buffers: Dict[Any, List] = {}
         self._next_event_id = 0
         self.watermark = LONG_MIN
@@ -163,8 +269,12 @@ class CepOperator(StreamOperator):
         if len(batch) == 0:
             return []
         cols = batch.columns
-        # vectorized: all stage conditions over the whole batch at once
+        # vectorized: all stage (and until) conditions over the whole batch
         bits = np.stack([s.matches(cols) for s in self.pattern.stages], axis=1)
+        ubits = (np.stack([s.until_matches(cols)
+                           for s in self.pattern.stages], axis=1)
+                 if any(s.until is not None for s in self.pattern.stages)
+                 else None)
         keys = np.asarray(cols[self.key_column])
         ts = (np.asarray(batch.timestamps, np.int64)
               if batch.timestamps is not None
@@ -175,7 +285,8 @@ class CepOperator(StreamOperator):
             eid = self._next_event_id
             self._next_event_id += 1
             self._buffers.setdefault(k, []).append(
-                (int(ts[i]), eid, bits[i], rows[i]))
+                (int(ts[i]), eid, bits[i],
+                 None if ubits is None else ubits[i], rows[i]))
         if batch.timestamps is None:
             # processing-time style: no watermarks will come, match eagerly
             return self._drain(2 ** 62)
@@ -191,6 +302,17 @@ class CepOperator(StreamOperator):
     def _drain(self, up_to_ts: int) -> List[StreamElement]:
         out_rows: List[dict] = []
         out_ts: List[int] = []
+
+        def emit(nfa, match, ts):
+            named: Dict[str, List[dict]] = {}
+            for stage_i, ev_id in match:
+                named.setdefault(self.pattern.stages[stage_i].name,
+                                 []).append(nfa._rows[ev_id])
+            res = self.select_fn(named)
+            if res is not None:
+                out_rows.append(res)
+                out_ts.append(ts)
+
         for k, buf in self._buffers.items():
             ready = [e for e in buf if e[0] <= up_to_ts]
             if not ready:
@@ -200,18 +322,20 @@ class CepOperator(StreamOperator):
             nfa = self._nfas.get(k)
             if nfa is None:
                 nfa = self._nfas[k] = NFA(self.pattern)
-            for ts, eid, bits, row in ready:
+            for ts, eid, bits, ubits, row in ready:
                 nfa._rows[eid] = row
-            for ts, eid, bits, row in ready:
-                for match in nfa.advance(eid, ts, bits):
-                    named: Dict[str, List[dict]] = {}
-                    for stage_i, ev_id in match:
-                        named.setdefault(self.pattern.stages[stage_i].name,
-                                         []).append(nfa._rows[ev_id])
-                    res = self.select_fn(named)
-                    if res is not None:
-                        out_rows.append(res)
-                        out_ts.append(ts)
+            for ts, eid, bits, ubits, row in ready:
+                # a trailing notFollowedBy completes by TIME, which may
+                # happen between events (the within window closing)
+                for match, cts in nfa.harvest_expired_negations(ts):
+                    emit(nfa, match, cts)
+                for match in nfa.advance(eid, ts, bits, ubits):
+                    emit(nfa, match, ts)
+        # time-driven completions for EVERY key — including quiet ones whose
+        # within window the watermark just closed
+        for k, nfa in self._nfas.items():
+            for match, cts in nfa.harvest_expired_negations(up_to_ts):
+                emit(nfa, match, cts)
             # SharedBuffer-style pruning: rows only live as long as a partial
             # match references them — otherwise host memory (and every
             # checkpoint) grows with total events processed

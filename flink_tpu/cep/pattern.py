@@ -39,12 +39,28 @@ class Stage:
     times_min: int = 1
     times_max: Optional[int] = 1   # None = unbounded (oneOrMore)
     optional: bool = False
+    #: not-pattern (``notNext``/``notFollowedBy``): the condition must NOT
+    #: match — strict checks exactly the next event, relaxed forbids any
+    #: matching event before the following stage matches
+    negated: bool = False
+    #: greedy looping stage: when an event matches both the loop and the
+    #: following stage, the loop consumes it (``Quantifier.greedy``)
+    greedy: bool = False
+    #: loop stop condition (``oneOrMore().until(cond)``): a matching event
+    #: closes the loop without being taken into it
+    until: Optional[Condition] = None
 
     def matches(self, cols: Mapping[str, Any]) -> np.ndarray:
         n = int(np.shape(next(iter(cols.values())))[0]) if cols else 0
         if self.condition is None:
             return np.ones(n, bool)
         return np.asarray(self.condition(cols), bool)
+
+    def until_matches(self, cols: Mapping[str, Any]) -> np.ndarray:
+        n = int(np.shape(next(iter(cols.values())))[0]) if cols else 0
+        if self.until is None:
+            return np.zeros(n, bool)
+        return np.asarray(self.until(cols), bool)
 
 
 class Pattern:
@@ -99,13 +115,60 @@ class Pattern:
         return Pattern(self.stages + [Stage(name, contiguity="relaxed_any")],
                        self.within_ms, self.skip_strategy)
 
+    def not_next(self, name: str) -> "Pattern":
+        """``notNext``: the event IMMEDIATELY after the previous stage's
+        match must not satisfy the condition (``NFA.java`` StateType.Stop
+        via strict negation)."""
+        if len(self.stages) == 0:
+            raise ValueError("a pattern cannot begin with a not-stage")
+        return Pattern(self.stages + [Stage(name, contiguity="strict",
+                                            negated=True)],
+                       self.within_ms, self.skip_strategy)
+
+    def not_followed_by(self, name: str) -> "Pattern":
+        """``notFollowedBy``: NO event matching the condition may occur
+        between the previous stage's match and the following stage's match.
+        As in the reference, it cannot END a pattern unless ``within`` is
+        set (checked at operator build)."""
+        if len(self.stages) == 0:
+            raise ValueError("a pattern cannot begin with a not-stage")
+        return Pattern(self.stages + [Stage(name, contiguity="relaxed",
+                                            negated=True)],
+                       self.within_ms, self.skip_strategy)
+
     def times(self, n: int, n_max: Optional[int] = None) -> "Pattern":
-        return self._mod_last(times_min=n, times_max=n_max if n_max is not None else n)
+        if self.stages[-1].negated:
+            raise ValueError("a not-stage cannot be quantified")
+        return self._mod_last(times_min=n,
+                              times_max=n_max if n_max is not None else n)
 
     def one_or_more(self) -> "Pattern":
+        if self.stages[-1].negated:
+            raise ValueError("a not-stage cannot be quantified")
         return self._mod_last(times_min=1, times_max=None)
 
+    def greedy(self) -> "Pattern":
+        """Looping quantifier consumes preferentially: an event matching
+        both the loop and the next stage extends the loop
+        (``Quantifier.greedy``)."""
+        last = self.stages[-1]
+        if last.times_max == 1 and last.times_min == 1:
+            raise ValueError("greedy() applies to a looping stage "
+                             "(times/one_or_more)")
+        return self._mod_last(greedy=True)
+
+    def until(self, condition: Condition) -> "Pattern":
+        """Stop condition for ``one_or_more`` loops (``Pattern.until``):
+        a matching event closes the loop and is not taken into it."""
+        last = self.stages[-1]
+        if last.times_max is not None:
+            raise ValueError("until() applies to an unbounded loop "
+                             "(one_or_more)")
+        return self._mod_last(until=condition)
+
     def optional(self) -> "Pattern":
+        if self.stages[-1].negated:
+            raise ValueError("a not-stage cannot be optional")
         return self._mod_last(optional=True)
 
     def within(self, ms: int) -> "Pattern":

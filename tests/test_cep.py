@@ -3,6 +3,7 @@
 event streams through patterns, assert the matched event sets."""
 
 import numpy as np
+import pytest
 
 from flink_tpu.cep import CEP, AfterMatchSkipStrategy, Pattern
 from flink_tpu.datastream.api import StreamExecutionEnvironment
@@ -147,3 +148,184 @@ def test_cep_rows_pruned_no_unbounded_growth():
     assert total_rows == 0, f"rows retained: {total_rows}"
     snap = op.snapshot_state()
     assert sum(len(r) for _, _, r in snap["nfas"].values()) == 0
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r1 #10: not-patterns, greedy, until — NFA.java scenario parity
+# ---------------------------------------------------------------------------
+
+def _run_events(pattern, events):
+    """events: list of (key, kind, ts); returns list of matched kind-lists."""
+    from flink_tpu.cep.operator import CepOperator
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    got = []
+
+    def sel(m):
+        flat = [r["kind"] for rows in m.values() for r in rows]
+        got.append((sorted(m.keys()), flat))
+        return {"n": len(flat)}
+
+    op = CepOperator(pattern, "k", sel)
+    ks = np.asarray([e[0] for e in events], np.int64)
+    kinds = np.asarray([e[1] for e in events], object)
+    ts = np.asarray([e[2] for e in events], np.int64)
+    op.process_batch(RecordBatch({"k": ks, "kind": kinds}, timestamps=ts))
+    op.process_watermark(Watermark(1 << 40))
+    op.end_input()
+    return got
+
+
+def _is(kind):
+    return lambda cols: np.asarray(cols["kind"]) == kind
+
+
+def test_not_next_blocks_immediate_match():
+    """a notNext(b) followedBy(c): 'a b c' fails (b immediately follows),
+    'a x c' matches."""
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_next("nb").where(_is("b"))
+         .followed_by("c").where(_is("c")))
+    assert _run_events(p, [(1, "a", 1), (1, "b", 2), (1, "c", 3)]) == []
+    got = _run_events(p, [(1, "a", 1), (1, "x", 2), (1, "c", 3)])
+    assert len(got) == 1 and got[0][1] == ["a", "c"]
+
+
+def test_not_next_same_event_can_match_following_stage():
+    """The clean event after notNext may itself match the next stage:
+    'a c' matches a notNext(b) followedBy(c)."""
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_next("nb").where(_is("b"))
+         .followed_by("c").where(_is("c")))
+    got = _run_events(p, [(1, "a", 1), (1, "c", 2)])
+    assert len(got) == 1 and got[0][1] == ["a", "c"]
+
+
+def test_not_followed_by_kills_on_forbidden_event():
+    """a notFollowedBy(b) followedBy(c): 'a x b c' fails, 'a x x c' matches
+    (any b between a and c poisons the match, NFA.java NotFollow)."""
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_followed_by("nb").where(_is("b"))
+         .followed_by("c").where(_is("c")))
+    assert _run_events(p, [(1, "a", 1), (1, "x", 2), (1, "b", 3),
+                           (1, "c", 4)]) == []
+    got = _run_events(p, [(1, "a", 1), (1, "x", 2), (1, "x", 3),
+                          (1, "c", 4)])
+    assert len(got) == 1 and got[0][1] == ["a", "c"]
+
+
+def test_not_followed_by_last_requires_within():
+    from flink_tpu.cep.operator import CepOperator
+
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_followed_by("nb").where(_is("b")))
+    with pytest.raises(ValueError, match="within"):
+        CepOperator(p, "k", lambda m: m)
+
+
+def test_trailing_not_followed_by_completes_on_window_close():
+    """a notFollowedBy(b) within 10: match completes when the window closes
+    clean; a 'b' inside the window kills it."""
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_followed_by("nb").where(_is("b"))
+         .within(10))
+    got = _run_events(p, [(1, "a", 1), (1, "x", 5), (1, "x", 100)])
+    assert len(got) == 1 and got[0][1] == ["a"]
+    assert _run_events(p, [(1, "a", 1), (1, "b", 5), (1, "x", 100)]) == []
+
+
+def test_greedy_loop_consumes_ambiguous_events():
+    """a+ greedy followedBy(end) where the loop condition overlaps the end
+    condition: greedy keeps extending, yielding only the LONGEST match per
+    start (Quantifier.greedy semantics)."""
+    is_num = lambda cols: np.char.isdigit(  # noqa: E731
+        np.asarray(cols["kind"], str))
+
+    base = Pattern.begin("nums").where(is_num).one_or_more()
+    greedy = base.greedy().followed_by("end").where(_is("x"))
+    lazy = base.followed_by("end").where(_is("x"))
+    ev = [(1, "1", 1), (1, "2", 2), (1, "3", 3), (1, "x", 4)]
+    got_greedy = _run_events(greedy, ev)
+    got_lazy = _run_events(lazy, ev)
+    # non-greedy branches on every prefix: 1|12|123|2|23|3 (+x each)
+    assert len(got_lazy) == 6
+    # greedy: only the maximal runs survive (one per distinct start)
+    lens = sorted(len(m[1]) for m in got_greedy)
+    assert len(got_greedy) == 3 and lens == [2, 3, 4]
+    assert ["1", "2", "3", "x"] in [m[1] for m in got_greedy]
+
+
+def test_until_closes_the_loop():
+    """one_or_more().until(stop): events after the stop event never extend
+    the loop (Pattern.until)."""
+    p = (Pattern.begin("a").where(_is("a")).one_or_more()
+         .until(_is("s"))
+         .followed_by("end").where(_is("e")))
+    # a a s a e -> loops of only the first two a's; the post-stop 'a'
+    # must not appear in any match
+    got = _run_events(p, [(1, "a", 1), (1, "a", 2), (1, "s", 3),
+                          (1, "a", 4), (1, "e", 5)])
+    assert got, "until must still allow completion via the advanced state"
+    for _names, flat in got:
+        a_count = sum(1 for x in flat if x == "a")
+        assert a_count <= 2
+
+
+def test_quantified_not_stage_rejected():
+    p = Pattern.begin("a").where(_is("a")).not_next("nb")
+    with pytest.raises(ValueError, match="quantified"):
+        p.times(2)
+    with pytest.raises(ValueError, match="optional"):
+        p.optional()
+
+
+def test_not_patterns_across_keys_are_independent():
+    """A forbidden event on key 2 must not poison key 1's match."""
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_followed_by("nb").where(_is("b"))
+         .followed_by("c").where(_is("c")))
+    got = _run_events(p, [(1, "a", 1), (2, "b", 2), (1, "c", 3)])
+    assert len(got) == 1 and got[0][1] == ["a", "c"]
+
+
+def test_not_followed_by_first_match_retires_watcher():
+    """Regression: a notFollowedBy(b) followedBy(c) on 'a c c' matches ONCE
+    (plain followedBy semantics, not followedByAny)."""
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_followed_by("nb").where(_is("b"))
+         .followed_by("c").where(_is("c")))
+    got = _run_events(p, [(1, "a", 1), (1, "c", 2), (1, "c", 3)])
+    assert len(got) == 1 and got[0][1] == ["a", "c"]
+
+
+def test_greedy_until_closing_event_completes():
+    """Regression: greedy + until — the closing event may match the loop
+    condition; the advanced branch must survive to complete the match."""
+    is_num = lambda cols: np.char.isdigit(  # noqa: E731
+        np.asarray(cols["kind"], str))
+    p = (Pattern.begin("nums").where(is_num).one_or_more().greedy()
+         .until(_is("9"))
+         .followed_by("end").where(_is("x")))
+    got = _run_events(p, [(1, "1", 1), (1, "2", 2), (1, "9", 3),
+                          (1, "x", 4)])
+    assert got, "greedy+until must still complete"
+    assert ["1", "2", "x"] in [m[1] for m in got]
+    for _n, flat in got:
+        assert "9" not in flat
+
+
+def test_trailing_negation_match_timestamped_at_window_close():
+    """Regression: the trailing-notFollowedBy match carries the window-close
+    event time (first_ts + within), not the draining watermark."""
+    from flink_tpu.cep.operator import CepOperator
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    p = (Pattern.begin("a").where(_is("a"))
+         .not_followed_by("nb").where(_is("b"))
+         .within(10))
+    op = CepOperator(p, "k", lambda m: {"ok": 1})
+    op.process_batch(RecordBatch(
+        {"k": np.array([1], np.int64), "kind": np.asarray(["a"], object)},
+        timestamps=np.array([1], np.int64)))
+    out = op.process_watermark(Watermark(1 << 40))
+    assert out and int(np.asarray(out[0].timestamps)[0]) == 11
