@@ -14,6 +14,18 @@ Wire protocol:
   - ``GET    /o/{key}``          fetch object
   - ``GET    /list?prefix=P``    JSON list of keys
   - ``DELETE /o/{key}``          remove object
+
+The server also exposes **TTL leases with fencing tokens** (the etcd-lease /
+ZooKeeper-ephemeral-node analog, ``ZooKeeperLeaderElectionDriver``):
+  - ``POST /lease/{name}/acquire``  body {holder, ttl_ms} ->
+        {acquired, holder, token, expires_in_ms}; a lease is granted when
+        free or expired; every new grant bumps the monotone fencing token
+  - ``POST /lease/{name}/renew``    body {holder, token, ttl_ms}
+  - ``POST /lease/{name}/release``  body {holder, token}
+  - ``GET  /lease/{name}``          current state
+Cross-HOST leader election (``cluster/ha.py`` LeaseLeaderElection) rides
+these endpoints — any number of pods on any machines contend through one
+object-store service, with fencing tokens guarding split-brain writers.
 """
 
 from __future__ import annotations
@@ -38,6 +50,15 @@ class ObjectStoreServer:
                  port: int = 0):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+        #: lease table: name -> {holder, token, expires (monotonic)}
+        self._leases: Dict[str, Dict[str, Any]] = {}
+        self._lease_lock = threading.Lock()
+        self._token_path = os.path.join(directory, "_lease_tokens.json")
+        try:
+            with open(self._token_path) as f:
+                self._next_token = int(json.load(f)["next"])
+        except (OSError, ValueError, KeyError):
+            self._next_token = 1
         store = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -47,6 +68,37 @@ class ObjectStoreServer:
             def _path(self, key: str) -> str:
                 safe = urllib.parse.quote(key, safe="")
                 return os.path.join(store.directory, safe)
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 3 and parts[0] == "lease":
+                    ln = int(self.headers.get("Content-Length", 0))
+                    try:
+                        req = json.loads(self.rfile.read(ln) or b"{}")
+                    except ValueError:
+                        return self._json(400, {"error": "bad json"})
+                    name, verb = parts[1], parts[2]
+                    if verb == "acquire":
+                        return self._json(200, store.lease_acquire(
+                            name, str(req.get("holder", "")),
+                            int(req.get("ttl_ms", 10_000))))
+                    if verb == "renew":
+                        return self._json(200, store.lease_renew(
+                            name, str(req.get("holder", "")),
+                            int(req.get("token", -1)),
+                            int(req.get("ttl_ms", 10_000))))
+                    if verb == "release":
+                        return self._json(200, store.lease_release(
+                            name, str(req.get("holder", "")),
+                            int(req.get("token", -1))))
+                self._json(404, {"error": "not found"})
 
             def do_PUT(self):
                 if not self.path.startswith("/o/"):
@@ -67,6 +119,9 @@ class ObjectStoreServer:
                 self.end_headers()
 
             def do_GET(self):
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if len(parts) == 2 and parts[0] == "lease":
+                    return self._json(200, store.lease_state(parts[1]))
                 if self.path.startswith("/o/"):
                     key = urllib.parse.unquote(self.path[3:])
                     path = self._path(key)
@@ -115,6 +170,63 @@ class ObjectStoreServer:
         self.url = f"http://{self.host}:{self.port}"
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="object-store", daemon=True)
+
+    # -- lease primitives (single authority, like an etcd leader) ---------
+    def lease_acquire(self, name: str, holder: str,
+                      ttl_ms: int) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lease_lock:
+            cur = self._leases.get(name)
+            if cur is not None and cur["expires"] > now \
+                    and cur["holder"] != holder:
+                return {"acquired": False, "holder": cur["holder"],
+                        "expires_in_ms": int((cur["expires"] - now) * 1000)}
+            if cur is not None and cur["holder"] == holder \
+                    and cur["expires"] > now:
+                cur["expires"] = now + ttl_ms / 1000.0
+                return {"acquired": True, "holder": holder,
+                        "token": cur["token"], "expires_in_ms": ttl_ms}
+            token = self._next_token
+            self._next_token += 1
+            tmp = self._token_path + ".tmp"
+            with open(tmp, "w") as f:  # tokens survive server restarts
+                json.dump({"next": self._next_token}, f)
+            os.replace(tmp, self._token_path)
+            self._leases[name] = {"holder": holder, "token": token,
+                                  "expires": now + ttl_ms / 1000.0}
+            return {"acquired": True, "holder": holder, "token": token,
+                    "expires_in_ms": ttl_ms}
+
+    def lease_renew(self, name: str, holder: str, token: int,
+                    ttl_ms: int) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lease_lock:
+            cur = self._leases.get(name)
+            if cur is None or cur["holder"] != holder \
+                    or cur["token"] != token or cur["expires"] <= now:
+                return {"renewed": False}
+            cur["expires"] = now + ttl_ms / 1000.0
+            return {"renewed": True, "token": token}
+
+    def lease_release(self, name: str, holder: str,
+                      token: int) -> Dict[str, Any]:
+        with self._lease_lock:
+            cur = self._leases.get(name)
+            if cur is not None and cur["holder"] == holder \
+                    and cur["token"] == token:
+                del self._leases[name]
+                return {"released": True}
+            return {"released": False}
+
+    def lease_state(self, name: str) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lease_lock:
+            cur = self._leases.get(name)
+            if cur is None or cur["expires"] <= now:
+                return {"held": False}
+            return {"held": True, "holder": cur["holder"],
+                    "token": cur["token"],
+                    "expires_in_ms": int((cur["expires"] - now) * 1000)}
 
     def start(self) -> "ObjectStoreServer":
         self._thread.start()
