@@ -184,6 +184,20 @@ def _drv_sort(op, ins):
     b = ins[0]
     if len(b) == 0:
         return b
+    from flink_tpu.dataset.external import ExternalSorter, memory_budget_rows
+
+    budget = memory_budget_rows()
+    if len(b) > budget:
+        # out-of-core: spill sorted runs + k-way gallop merge
+        # (ExternalSorter analog).  Bounds the SORT's scratch (per-run
+        # argsort/take) to the budget; the plan's own materialization of
+        # input/output batches is the separate in-memory-plan limitation.
+        s = ExternalSorter([op.args["column"]],
+                           ascending=op.args["ascending"],
+                           budget_rows=budget)
+        for lo in range(0, len(b), budget):
+            s.add(b.take(np.arange(lo, min(lo + budget, len(b)))))
+        return s.sorted_batch()
     order = np.argsort(np.asarray(b.column(op.args["column"])), kind="stable")
     if not op.args["ascending"]:
         order = order[::-1]
@@ -299,6 +313,36 @@ def _drv_join(op, ins):
     rk = _composite_key(r, op.args["right_keys"]) if len(r) else np.zeros(0, np.int64)
     if how == "cogroup":
         return _cogroup(op, l, r, lk, rk)
+    if how == "inner":
+        from flink_tpu.dataset.external import (GraceHashJoin,
+                                                memory_budget_rows)
+
+        if len(l) + len(r) > memory_budget_rows():
+            # out-of-core inner join: hash-partition both sides to bucket
+            # files, join bucket pairs in memory (grace scheme —
+            # MutableHashTable spilling hybrid analog)
+            gj = GraceHashJoin("__jk__", "__jk__")
+            gj.add(0, RecordBatch({**{k: np.asarray(v)
+                                      for k, v in l.columns.items()},
+                                   "__jk__": lk}))
+            gj.add(1, RecordBatch({**{k: np.asarray(v)
+                                      for k, v in r.columns.items()},
+                                   "__jk__": rk}))
+            parts = []
+            for lb, li, rb, ri in gj.join_pairs():
+                cols = _merge_columns(lb, rb, li, ri)
+                cols = {k: v for k, v in cols.items()
+                        if k not in ("__jk__", "r___jk__")}
+                parts.append(RecordBatch(cols))
+            if not parts:
+                return RecordBatch({})
+            out = RecordBatch.concat(parts) if len(parts) > 1 else parts[0]
+            fn = op.args.get("fn")
+            if fn is not None:
+                cols = fn(dict(out.columns))
+                out = RecordBatch({k: np.asarray(v)
+                                   for k, v in cols.items()})
+            return out
     li, ri = _join_pairs(lk, rk) if len(l) and len(r) else (
         np.zeros(0, np.int64), np.zeros(0, np.int64))
     parts = []

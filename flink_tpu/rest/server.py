@@ -224,52 +224,168 @@ class RestServer:
 
 
 _DASHBOARD_HTML = """<!DOCTYPE html>
-<html><head><title>flink-tpu dashboard</title>
+<html><head><meta charset="utf-8"><title>flink-tpu dashboard</title>
 <style>
- body{font-family:system-ui,sans-serif;margin:2rem;color:#1a1a1a}
- h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
- table{border-collapse:collapse;min-width:40rem}
- th,td{border:1px solid #ccc;padding:.35rem .6rem;text-align:left;font-size:.9rem}
- th{background:#f3f3f3}
- .bar{display:inline-block;height:.7rem;background:#4a7dbd;vertical-align:middle}
- .bp{background:#c0504d}.idle{background:#9a9a9a}
- code{background:#f5f5f5;padding:0 .25rem}
+ :root{color-scheme:light;
+   --surface:#fcfcfb;--panel:#f3f3f1;--border:#d9d8d4;
+   --text:#0b0b0b;--text-2:#52514e;
+   --busy:#2a78d6;--bp:#ec835a;--idle:#9a9a99;
+   --flame:#2a78d6;--good:#0ca30c;--crit:#d03b3b}
+ @media (prefers-color-scheme: dark){:root{color-scheme:dark;
+   --surface:#1a1a19;--panel:#232322;--border:#3a3a38;
+   --text:#fff;--text-2:#c3c2b7;
+   --busy:#3987e5;--bp:#ec835a;--idle:#7a7a78;
+   --flame:#3987e5;--good:#0ca30c;--crit:#d03b3b}}
+ body{font-family:system-ui,sans-serif;margin:1.5rem;max-width:72rem;
+   background:var(--surface);color:var(--text)}
+ h1{font-size:1.25rem;margin:.2rem 0 1rem}
+ h2{font-size:1rem;margin:1.4rem 0 .5rem;color:var(--text)}
+ .tiles{display:flex;gap:.8rem;flex-wrap:wrap}
+ .tile{background:var(--panel);border:1px solid var(--border);
+   border-radius:8px;padding:.6rem .9rem;min-width:7.5rem}
+ .tile .v{font-size:1.4rem;font-weight:600}
+ .tile .l{font-size:.75rem;color:var(--text-2)}
+ table{border-collapse:collapse;width:100%;font-size:.88rem}
+ th,td{border-bottom:1px solid var(--border);padding:.35rem .6rem;
+   text-align:left}
+ th{color:var(--text-2);font-weight:500}
+ tr.sel{background:var(--panel)} tr.job{cursor:pointer}
+ code{background:var(--panel);padding:0 .3rem;border-radius:4px}
+ .ratio{display:flex;height:12px;width:160px;border-radius:4px;
+   overflow:hidden;gap:2px;background:var(--surface)}
+ .ratio div{height:100%}
+ .legend{display:flex;gap:1rem;font-size:.78rem;color:var(--text-2);
+   margin:.3rem 0}
+ .legend span::before{content:"";display:inline-block;width:10px;
+   height:10px;border-radius:3px;margin-right:.35rem;
+   background:var(--c);vertical-align:-1px}
+ button{background:var(--panel);color:var(--text);
+   border:1px solid var(--border);border-radius:6px;
+   padding:.25rem .6rem;font-size:.8rem;cursor:pointer;margin-right:.3rem}
+ button:hover{border-color:var(--text-2)}
+ #flame svg{width:100%;background:var(--panel);border-radius:8px}
+ #flame text{font:10px system-ui;fill:#fff;pointer-events:none}
+ .state-RUNNING{color:var(--busy)} .state-FINISHED{color:var(--good)}
+ .state-FAILED,.state-CANCELED{color:var(--crit)}
+ .err{color:var(--crit);font-size:.85rem;white-space:pre-wrap}
 </style></head><body>
 <h1>flink-tpu dashboard</h1>
-<div id="overview"></div>
-<h2>Jobs</h2><table id="jobs"><tr><th>id</th><th>name</th><th>state</th>
-<th>records in/out</th><th>checkpoints</th></tr></table>
-<h2>Vertices</h2><table id="verts"><tr><th>job</th><th>vertex</th>
-<th>parallelism</th><th>busy / backpressured / idle</th></tr></table>
+<div class="tiles" id="tiles"></div>
+<h2>Jobs</h2>
+<table id="jobs"><thead><tr><th>id</th><th>name</th><th>state</th>
+<th>records in / out</th><th>checkpoints</th><th>actions</th></tr></thead>
+<tbody></tbody></table>
+<div id="detail" style="display:none">
+ <h2>Vertices — <code id="selid"></code></h2>
+ <div class="legend">
+  <span style="--c:var(--busy)">busy</span>
+  <span style="--c:var(--bp)">backpressured</span>
+  <span style="--c:var(--idle)">idle</span></div>
+ <table id="verts"><thead><tr><th>vertex</th><th>par</th><th>state</th>
+ <th>records in / out</th><th>time share</th></tr></thead><tbody></tbody>
+ </table>
+ <h2>Latency (source&rarr;sink)</h2><div class="tiles" id="lat"></div>
+ <h2>Checkpoints</h2><div id="ckpts" style="font-size:.88rem"></div>
+ <div id="exc"></div>
+ <h2>Flame graph <button onclick="flame()">sample</button></h2>
+ <div id="flame"></div>
+</div>
 <script>
+let sel=null;
+const J=async p=>(await fetch(p)).json();
+const esc=s=>String(s).replace(/[&<>"]/g,c=>({'&':'&amp;','<':'&lt;',
+  '>':'&gt;','"':'&quot;'}[c]));
+function tile(l,v){return `<div class="tile"><div class="v">${v}</div>`+
+  `<div class="l">${l}</div></div>`}
 async function refresh(){
-  const ov = await (await fetch('/overview')).json();
-  document.getElementById('overview').textContent =
-    `jobs: ${ov.jobs_total} (running ${ov.jobs_running}, finished `+
-    `${ov.jobs_finished}, failed ${ov.jobs_failed})`;
-  const jobs = (await (await fetch('/jobs')).json()).jobs;
-  const jt = document.getElementById('jobs');
-  const vt = document.getElementById('verts');
-  jt.querySelectorAll('tr:not(:first-child)').forEach(r=>r.remove());
-  vt.querySelectorAll('tr:not(:first-child)').forEach(r=>r.remove());
-  for (const j of jobs){
-    const d = await (await fetch(`/jobs/${j.id}`)).json();
-    const m = await (await fetch(`/jobs/${j.id}/metrics`)).json();
-    const row = jt.insertRow();
-    row.innerHTML = `<td><code>${j.id}</code></td><td>${j.name}</td>`+
-      `<td>${d.state}</td><td>${m.records_in} / ${m.records_out}</td>`+
-      `<td>${d.completed_checkpoints.length}</td>`;
-    for (const v of d.vertices){
-      const r = vt.insertRow();
-      const w = x => Math.round(x*120);
-      r.innerHTML = `<td><code>${j.id}</code></td><td>${v.id}</td>`+
-        `<td>${v.parallelism}</td>`+
-        `<td><span class="bar" style="width:${w(v.busy_ratio)}px"></span>`+
-        `<span class="bar bp" style="width:${w(v.backpressure_ratio)}px"></span>`+
-        `<span class="bar idle" style="width:${w(v.idle_ratio)}px"></span></td>`;
-    }
+  const ov=await J('/overview');
+  const jobs=(await J('/jobs')).jobs;
+  let tin=0,tout=0;const rows=[];
+  for(const j of jobs){
+    const d=await J('/jobs/'+j.id);const m=await J('/jobs/'+j.id+'/metrics');
+    tin+=m.records_in;tout+=m.records_out;
+    rows.push({j,d,m});
   }
+  document.getElementById('tiles').innerHTML=
+    tile('running',ov.jobs_running)+tile('finished',ov.jobs_finished)+
+    tile('failed',ov.jobs_failed)+
+    tile('records in',tin.toLocaleString())+
+    tile('records out',tout.toLocaleString());
+  const tb=document.querySelector('#jobs tbody');tb.innerHTML='';
+  for(const {j,d,m} of rows){
+    const tr=document.createElement('tr');
+    tr.className='job'+(sel===j.id?' sel':'');
+    tr.onclick=()=>{sel=j.id;refresh()};
+    tr.innerHTML=`<td><code>${esc(j.id)}</code></td><td>${esc(j.name)}</td>`+
+     `<td class="state-${esc(d.state)}">${esc(d.state)}</td>`+
+     `<td>${m.records_in.toLocaleString()} / ${m.records_out.toLocaleString()}</td>`+
+     `<td>${d.completed_checkpoints.length}</td>`+
+     `<td><button onclick="act(event,'${esc(j.id)}','savepoints')">savepoint</button>`+
+     `<button onclick="act(event,'${esc(j.id)}','stop')">stop</button>`+
+     `<button onclick="cancelJob(event,'${esc(j.id)}')">cancel</button></td>`;
+    tb.appendChild(tr);
+  }
+  if(sel===null&&rows.length)sel=rows[0].j.id;
+  const cur=rows.find(r=>r.j.id===sel);
+  document.getElementById('detail').style.display=cur?'':'none';
+  if(!cur)return;
+  document.getElementById('selid').textContent=sel;
+  const vb=document.querySelector('#verts tbody');vb.innerHTML='';
+  for(const v of cur.d.vertices){
+    const pct=x=>(100*x).toFixed(1)+'%';
+    const tr=document.createElement('tr');
+    tr.innerHTML=`<td>${esc(v.id)}</td><td>${v.parallelism}</td>`+
+     `<td>${esc((v.status||[]).join(','))}</td>`+
+     `<td>${v.records_in.toLocaleString()} / ${v.records_out.toLocaleString()}</td>`+
+     `<td><div class="ratio" title="busy ${pct(v.busy_ratio)} · `+
+     `backpressured ${pct(v.backpressure_ratio)} · idle ${pct(v.idle_ratio)}">`+
+     `<div style="width:${v.busy_ratio*100}%;background:var(--busy)"></div>`+
+     `<div style="width:${v.backpressure_ratio*100}%;background:var(--bp)"></div>`+
+     `<div style="width:${v.idle_ratio*100}%;background:var(--idle)"></div>`+
+     `</div></td>`;
+    vb.appendChild(tr);
+  }
+  const lat=cur.m.latency_ms||{};
+  document.getElementById('lat').innerHTML=['p50','p95','p99','max']
+    .filter(k=>lat[k]!==undefined)
+    .map(k=>tile(k,lat[k].toFixed(1)+' ms')).join('')||
+    '<span style="color:var(--text-2);font-size:.85rem">no samples yet</span>';
+  const ck=await J('/jobs/'+sel+'/checkpoints');
+  document.getElementById('ckpts').textContent=
+    ck.count?('completed: '+ck.completed.join(', ')):'none yet';
+  const ex=await J('/jobs/'+sel+'/exceptions');
+  document.getElementById('exc').innerHTML=ex.root_exception?
+    ('<h2>Root exception</h2><div class="err">'+esc(ex.root_exception)+
+     '</div>'):'';
 }
-refresh(); setInterval(refresh, 2000);
+async function act(ev,id,verb){ev.stopPropagation();
+  await fetch('/jobs/'+id+'/'+verb,{method:'POST'});refresh()}
+async function cancelJob(ev,id){ev.stopPropagation();
+  await fetch('/jobs/'+id,{method:'PATCH'});refresh()}
+async function flame(){
+  const t=await J('/jobs/'+sel+'/flamegraph');
+  const H=16,rows=[];
+  (function walk(n,x0,x1,d){if(d>=0)rows.push({n,x0,x1,d});
+    let x=x0;for(const c of (n.children||[])){
+      const w=(x1-x0)*(c.value/Math.max(1,n.value));
+      walk(c,x,x+w,d+1);x+=w;}})(t,0,100,-1);
+  const depth=Math.max(0,...rows.map(r=>r.d))+1;
+  // sequential single-hue: depth shades the one flame hue
+  const svg=['<svg viewBox="0 0 1000 '+(depth*(H+2))+'" '+
+    'xmlns="http://www.w3.org/2000/svg">'];
+  for(const r of rows){
+    const w=(r.x1-r.x0)*10;if(w<1)continue;
+    const o=0.45+0.55*(1-r.d/Math.max(1,depth));
+    svg.push(`<g><rect x="${(r.x0*10).toFixed(1)}" y="${r.d*(H+2)}" `+
+     `width="${w.toFixed(1)}" height="${H}" rx="3" `+
+     `fill="var(--flame)" fill-opacity="${o.toFixed(2)}">`+
+     `<title>${esc(r.n.name)} — ${r.n.value} samples</title></rect>`+
+     (w>60?`<text x="${(r.x0*10+4).toFixed(1)}" y="${r.d*(H+2)+12}">`+
+       esc(r.n.name.slice(0,Math.floor(w/7)))+'</text>':'')+'</g>');
+  }
+  svg.push('</svg>');
+  document.getElementById('flame').innerHTML=svg.join('');
+}
+refresh();setInterval(refresh,2000);
 </script></body></html>
 """

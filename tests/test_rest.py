@@ -102,7 +102,11 @@ def test_dashboard_served(stack):
     _registry, server = stack
     with urllib.request.urlopen(server.url + "/", timeout=10) as r:
         html = r.read().decode()
-    assert "flink-tpu dashboard" in html and "fetch('/jobs')" in html
+    assert "flink-tpu dashboard" in html and "/jobs" in html
+    # the dashboard is a real SPA: job actions, vertex time-share bars with
+    # a legend, latency tiles, and a flame-graph renderer
+    for marker in ("savepoint", "backpressured", "flame", "legend"):
+        assert marker in html, marker
 
 
 def test_flamegraph_sampler():
