@@ -156,30 +156,41 @@ class ExternalSorter:
         cursors = [_RunCursor(p, self.columns) for p in self._runs]
         live = [c for c in cursors if not c.exhausted]
         numeric = (live and live[0]._keys[0].dtype.kind in "iuf")
-        if len(self.columns) == 1 and numeric:
-            yield from self._merge_gallop(cursors)
-        else:
-            yield from self._merge_rowheap(cursors)
-        self._cleanup()
+        try:
+            if len(self.columns) == 1 and numeric:
+                yield from self._merge_gallop(cursors)
+            else:
+                yield from self._merge_rowheap(cursors)
+        finally:
+            # abandoned/failed iteration must not leak input-sized run files
+            self._cleanup()
 
     def _merge_gallop(self, cursors: List[_RunCursor]
                       ) -> Iterator[RecordBatch]:
-        sign = 1 if self.ascending else -1
+        asc = self.ascending
         out: List[RecordBatch] = []
         out_rows = 0
         live = [c for c in cursors if not c.exhausted]
         while live:
-            heads = [sign * c.head_scalar() for c in live]
-            j = int(np.argmin(heads))
+            heads = [c.head_scalar() for c in live]
+            # lead cursor + runner-up WITHOUT negating keys (negation would
+            # overflow uint64 and wrap INT64_MIN)
+            j = int(np.argmin(heads)) if asc else int(np.argmax(heads))
             c = live[j]
             if len(live) == 1:
                 hi = len(c._batch)
             else:
-                runner_up = min(h for i, h in enumerate(heads) if i != j)
-                keys = sign * c._keys[0]
-                # everything in the lead batch <= the runner-up's head can
-                # emit in ONE slice (keys within a run batch are sorted)
-                hi = int(np.searchsorted(keys, runner_up, side="right"))
+                others = [h for i, h in enumerate(heads) if i != j]
+                runner_up = min(others) if asc else max(others)
+                keys = c._keys[0]
+                if asc:
+                    # prefix of the (ascending) lead batch <= runner-up
+                    hi = int(np.searchsorted(keys, runner_up, side="right"))
+                else:
+                    # prefix of the DESCENDING lead batch >= runner-up:
+                    # count via the reversed (ascending) view
+                    hi = len(keys) - int(np.searchsorted(
+                        keys[::-1], runner_up, side="left"))
                 hi = max(hi, c._pos + 1)
             chunk = c._batch.take(np.arange(c._pos, hi))
             c._pos = hi
@@ -306,22 +317,27 @@ class GraceHashJoin:
         from flink_tpu.operators.joins import _join_pairs
 
         total = self._rows[0] + self._rows[1]
-        if total <= self.budget_rows:
-            # in-memory fast path: one bucket
-            l = RecordBatch.concat(self._left) if self._left else None
-            r = RecordBatch.concat(self._right) if self._right else None
-            if l is not None and r is not None and len(l) and len(r):
-                li, ri = _join_pairs(np.asarray(l.column(self.left_key)),
-                                     np.asarray(r.column(self.right_key)))
-                if li.size:
-                    yield l, li, r, ri
-            return
-        yield from self._partitioned(self._left, self._right, depth=0)
-        self._left, self._right = [], []
         try:
-            os.rmdir(self._dir)
-        except OSError:
-            pass
+            if total <= self.budget_rows:
+                # in-memory fast path: one bucket
+                l = RecordBatch.concat(self._left) if self._left else None
+                r = RecordBatch.concat(self._right) if self._right else None
+                if l is not None and r is not None and len(l) and len(r):
+                    li, ri = _join_pairs(
+                        np.asarray(l.column(self.left_key)),
+                        np.asarray(r.column(self.right_key)))
+                    if li.size:
+                        yield l, li, r, ri
+            else:
+                yield from self._partitioned(self._left, self._right,
+                                             depth=0)
+        finally:
+            self._left, self._right = [], []
+            self._rows = [0, 0]
+            try:
+                os.rmdir(self._dir)
+            except OSError:
+                pass
 
     _MAX_DEPTH = 3
 
@@ -334,6 +350,7 @@ class GraceHashJoin:
         from flink_tpu.formats import read_ftb, write_ftb
         from flink_tpu.operators.joins import _join_pairs
 
+        os.makedirs(self._dir, exist_ok=True)  # may be re-entered post-cleanup
         total = (sum(len(b) for b in left) + sum(len(b) for b in right))
         B = self.num_buckets or max(2, int(np.ceil(
             total / max(self.budget_rows // 2, 1))))

@@ -209,6 +209,75 @@ class Graph:
                                  & {x for x in adj.get(u, set()) if x > u})
         return count
 
+    def k_core(self, k: int, max_iterations: int = 0) -> np.ndarray:
+        """bool[n] membership in the k-core (``KCore`` analog): iteratively
+        peel vertices with degree < k — vectorized per round.  Degree is
+        over DISTINCT neighbors (duplicate and already-bidirectional edge
+        lists dedup first, matching triangle_count/clustering semantics)."""
+        src0, dst0 = np.asarray(self.src), np.asarray(self.dst)
+        keep = src0 != dst0
+        lo = np.minimum(src0[keep], dst0[keep]).astype(np.int64)
+        hi = np.maximum(src0[keep], dst0[keep]).astype(np.int64)
+        uniq = np.unique(lo * np.int64(self.n) + hi)
+        src = np.concatenate([uniq // self.n, uniq % self.n]).astype(np.int64)
+        dst = np.concatenate([uniq % self.n, uniq // self.n]).astype(np.int64)
+        alive = np.ones(self.n, bool)
+        limit = max_iterations or self.n
+        for _ in range(limit):
+            live_edge = alive[src] & alive[dst]
+            deg = np.bincount(dst[live_edge], minlength=self.n)
+            nxt = alive & (deg >= k)
+            if (nxt == alive).all():
+                break
+            alive = nxt
+        return alive
+
+    def clustering_coefficient(self) -> np.ndarray:
+        """float[n] local clustering coefficient (``LocalClusteringCoefficient``
+        analog): triangles through v / (deg(v) choose 2)."""
+        g = self.undirected()
+        src, dst = np.asarray(g.src), np.asarray(g.dst)
+        keep = src != dst
+        src, dst = src[keep], dst[keep]
+        adj: dict = {}
+        for s, d in zip(src.tolist(), dst.tolist()):
+            adj.setdefault(s, set()).add(d)
+        tri = np.zeros(g.n, np.int64)
+        for v, nbrs in adj.items():
+            t = 0
+            for u in nbrs:
+                t += len(nbrs & adj.get(u, set()))
+            tri[v] = t // 2
+        deg = np.asarray([len(adj.get(v, ())) for v in range(g.n)])
+        denom = deg * (deg - 1) / 2
+        with np.errstate(divide="ignore", invalid="ignore"):
+            cc = np.where(denom > 0, tri / np.maximum(denom, 1), 0.0)
+        return cc
+
+    def bfs_levels(self, sources: "np.ndarray | int",
+                   max_supersteps: int = 0,
+                   directed: bool = False) -> np.ndarray:
+        """int32[n] hop distance from the nearest source (multi-source BFS);
+        unreachable = -1.  Default treats edges as undirected;
+        ``directed=True`` follows edge direction only (matching ``sssp``,
+        which always runs on the directed edges)."""
+        srcs = np.atleast_1d(np.asarray(sources, np.int64))
+        inf = np.iinfo(np.int32).max
+        init = np.full(self.n, inf, np.int32)
+        init[srcs] = 0
+
+        def msg(vals, _w):
+            return jnp.where(vals < inf, vals + 1, inf)
+
+        def update(vals, combined):
+            return jnp.minimum(vals, combined).astype(jnp.int32)
+
+        g = self if directed else self.undirected()
+        out = g.scatter_gather(
+            init, msg, "min", update, max_supersteps or self.n,
+            converged=lambda a, b: bool(jnp.array_equal(a, b)))
+        return np.where(out >= inf, -1, out).astype(np.int32)
+
     def label_propagation(self, initial_labels: np.ndarray,
                           num_iterations: int = 10) -> np.ndarray:
         """Community detection by iterated max-label adoption

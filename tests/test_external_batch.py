@@ -127,3 +127,34 @@ def test_external_sort_string_keys_fall_back_to_rowheap():
     out = s.sorted_batch()
     got = [str(x) for x in np.asarray(out.column("k"))]
     assert got == sorted(str(w) for w in words)
+
+
+def test_external_sort_descending_uint64_and_int64_min():
+    """Regression: the descending gallop merge must not negate keys
+    (uint64 overflow; INT64_MIN wraparound)."""
+    vals = np.array([5, 2, 9, 2**63 + 7, 0, 13], np.uint64)
+    s = ExternalSorter(["k"], ascending=False, budget_rows=2)
+    for v in vals:
+        s.add(RecordBatch({"k": np.array([v], np.uint64)}))
+    out = np.asarray(s.sorted_batch().column("k"))
+    np.testing.assert_array_equal(out, np.sort(vals)[::-1])
+
+    imin = np.iinfo(np.int64).min
+    vals2 = np.array([3, imin, 7, -5], np.int64)
+    s2 = ExternalSorter(["k"], ascending=False, budget_rows=2)
+    for v in vals2:
+        s2.add(RecordBatch({"k": np.array([v], np.int64)}))
+    out2 = np.asarray(s2.sorted_batch().column("k"))
+    np.testing.assert_array_equal(out2, np.sort(vals2)[::-1])
+
+
+def test_grace_join_fast_path_resets_and_cleans(tmp_path):
+    import glob
+    import tempfile
+
+    gj = GraceHashJoin("k", "k", budget_rows=1_000_000)
+    gj.add(0, RecordBatch({"k": np.array([1], np.int64)}))
+    gj.add(1, RecordBatch({"k": np.array([1], np.int64)}))
+    assert sum(len(li) for _l, li, _r, _ri in gj.join_pairs()) == 1
+    # fast path resets sides (reuse must not re-join stale inputs)
+    assert gj._left == [] and gj._right == [] and gj._rows == [0, 0]

@@ -120,3 +120,45 @@ def test_dataset_interop():
 def test_empty_graph():
     g = Graph.from_edges([])
     assert g.n == 0 and g.num_edges == 0
+
+
+def test_k_core():
+    # a 4-clique plus a pendant chain: the 3-core is exactly the clique
+    edges = [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3),
+             (3, 4), (4, 5)]
+    g = Graph.from_edges(edges, num_vertices=6)
+    core3 = g.k_core(3)
+    assert core3.tolist() == [True, True, True, True, False, False]
+    assert g.k_core(1).tolist() == [True] * 6
+
+
+def test_clustering_coefficient():
+    # triangle 0-1-2 plus vertex 3 attached to 0 only
+    g = Graph.from_edges([(0, 1), (1, 2), (0, 2), (0, 3)], num_vertices=4)
+    cc = g.clustering_coefficient()
+    assert cc[1] == 1.0 and cc[2] == 1.0    # their 2 neighbors connect
+    assert abs(cc[0] - 1 / 3) < 1e-9        # 1 of 3 neighbor pairs
+    assert cc[3] == 0.0
+
+
+def test_bfs_levels_multi_source():
+    # path 0-1-2-3-4 and isolated 5
+    g = Graph.from_edges([(0, 1), (1, 2), (2, 3), (3, 4)], num_vertices=6)
+    lv = g.bfs_levels(0)
+    assert lv.tolist() == [0, 1, 2, 3, 4, -1]
+    lv2 = g.bfs_levels(np.array([0, 4]))
+    assert lv2.tolist() == [0, 1, 2, 1, 0, -1]
+
+
+def test_k_core_bidirectional_edge_list():
+    """Regression: an already-bidirectional edge list must not double
+    degrees — the 2-core of path 0-1-2 is empty."""
+    g = Graph.from_edges([(0, 1), (1, 0), (1, 2), (2, 1)], num_vertices=3)
+    assert g.k_core(2).tolist() == [False, False, False]
+    assert g.k_core(1).tolist() == [True, True, True]
+
+
+def test_bfs_levels_directed_flag():
+    g = Graph.from_edges([(1, 0)], num_vertices=2)
+    assert g.bfs_levels(0).tolist() == [0, 1]               # undirected
+    assert g.bfs_levels(0, directed=True).tolist() == [0, -1]
