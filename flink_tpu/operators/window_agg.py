@@ -554,9 +554,24 @@ class WindowAggOperator(StreamOperator):
             ts = np.full(len(batch), self._now_ms(), np.int64)
         panes = self.assigner.pane_of(ts)
 
-        # ---- late-beyond-lateness drop (reference: WindowOperator.java:437 isElementLate)
-        if self.pane_base is not None:
-            live = panes >= self.pane_base
+        # ---- late-beyond-lateness drop, judged EXACTLY like the reference
+        # (``WindowOperator.isElementLate``): a record is late iff its pane's
+        # last covering window's cleanup time (end - 1 + lateness) has been
+        # passed by time — NEVER by arrival order, so a parallel source
+        # racing ahead cannot make slower sources' records unstorable
+        # the gate's clock follows the assigner's time DOMAIN: wall-clock
+        # _proc_time ticks even on event-time operators (periodic timer
+        # service) and must never be compared against event-time panes
+        gate_now = (self.watermark if self.assigner.is_event_time
+                    else self._proc_time)
+        if gate_now != LONG_MIN and not isinstance(self.assigner,
+                                                   GlobalWindows):
+            uniq_p = np.unique(panes)
+            is_late = np.asarray(
+                [self.assigner.last_window_end_of_pane(int(p)) - 1
+                 + self.lateness <= gate_now for p in uniq_p.tolist()])
+            live = (~np.isin(panes, uniq_p[is_late]) if is_late.any()
+                    else np.ones(len(panes), bool))
             if not live.all():
                 if self.late_output_tag is not None:
                     # sideOutputLateData: rows are shipped, NOT dropped —
@@ -580,13 +595,17 @@ class WindowAggOperator(StreamOperator):
             self.pane_base = pmin
             self.max_pane = pmax
         else:
-            # grow BEFORE extending max_pane: the remap copies the old live
-            # range [pane_base, max_pane], which is alias-free only in the
-            # old ring geometry
-            span = max(self.max_pane, pmax) - self.pane_base + 1
+            # grow BEFORE extending the live range: the remap copies the
+            # old [pane_base, max_pane], which is alias-free only in the
+            # old ring geometry.  The range extends DOWNWARD too — a
+            # parallel source racing ahead must not make earlier panes
+            # unstorable (only truly expired panes drop, above).
+            new_base = min(self.pane_base, pmin)
+            span = max(self.max_pane, pmax) - new_base + 1
             if span > self._P:
                 self._ensure_alloc()
                 self._grow_panes(span)
+            self.pane_base = new_base
             self.max_pane = max(self.max_pane, pmax)
         span = self.max_pane - self.pane_base + 1
         if span > self._P:

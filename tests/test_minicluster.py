@@ -49,11 +49,11 @@ def test_parallel_keyed_sum_matches_serial():
 
 
 def test_parallel_window_aggregate():
-    # NOTE: observed ONE spurious failure (delta ~153 of 2000) in a full-suite
-    # run under load that never reproduced in isolation (0/9 retries) —
-    # if this fires again, suspect a scheduling race in the two-source
-    # watermark path (valve min vs in-flight channel data) and capture
-    # late_dropped + refire counts before anything else.
+    # The round-1 "spurious failure (delta ~153)" here was root-caused in
+    # round 2: pane_base initialized from the FIRST batch to arrive, so a
+    # parallel source racing ahead made lower panes drop as late.  Fixed by
+    # gating drops on expired panes only (window_agg._expired_through) with
+    # a deterministic regression test in test_window_agg.py.
     rng = np.random.default_rng(6)
     n = 4000
     keys = rng.integers(0, 21, n)

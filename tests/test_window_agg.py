@@ -659,3 +659,60 @@ class TestSlidingLateness:
         # fire set — missing or spurious windows both fail
         assert got == {(-1000, 1.0), (0, 1.0), (1000, 2.0),
                        (2000, 2.0), (3000, 4.0), (4000, 4.0)}
+
+
+def test_out_of_order_first_batches_extend_ring_downward():
+    """Regression (parallel-source race): when the FIRST batch to arrive is
+    high-timestamped (another source racing ahead), later low-timestamped
+    batches must extend retention downward — lateness is judged by the
+    watermark (isElementLate), never by arrival order."""
+    from flink_tpu.core.batch import RecordBatch
+    op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                           SumAggregator(np.float32), key_column="key",
+                           value_column="v")
+    h = KeyedOneInputOperatorHarness(op)
+    # batch from the "fast" source: panes ~ window 3
+    h.process_batch(RecordBatch({"key": np.array([1, 2]),
+                                 "v": np.array([10.0, 20.0], np.float32)},
+                                timestamps=np.array([3500, 3600])))
+    # batch from the "slow" source: window 0 — must NOT be dropped
+    h.process_batch(RecordBatch({"key": np.array([1]),
+                                 "v": np.array([5.0], np.float32)},
+                                timestamps=np.array([100])))
+    assert op.late_dropped == 0
+    h.process_watermark(999)
+    out0 = h.extract_output_rows()
+    assert [(o["key"], o["result"]) for o in out0] == [(1, 5.0)]
+    h.clear_output()
+    h.process_watermark(3999)
+    out1 = {(o["key"]): o["result"] for o in h.extract_output_rows()}
+    assert out1 == {1: 10.0, 2: 20.0}
+    # AFTER expiry the gate is real: a record behind the cleared panes drops
+    h.process_batch(RecordBatch({"key": np.array([1]),
+                                 "v": np.array([1.0], np.float32)},
+                                timestamps=np.array([50])))
+    assert op.late_dropped == 1
+
+
+def test_watermark_gate_drops_below_initial_pane_base():
+    """The late gate is the WATERMARK formula even for panes below the
+    initial pane_base: a record whose window's cleanup time passed the
+    watermark drops (no spurious refire of a long-closed window)."""
+    from flink_tpu.core.batch import RecordBatch
+
+    op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                           SumAggregator(np.float32), key_column="key",
+                           value_column="v")
+    h = KeyedOneInputOperatorHarness(op)
+    h.process_batch(RecordBatch({"key": np.array([1]),
+                                 "v": np.array([1.0], np.float32)},
+                                timestamps=np.array([5500])))
+    h.process_watermark(5000)
+    h.clear_output()
+    # window 0 (cleanup 999) is far behind the watermark: must drop even
+    # though pane 0 was never stored/expired here
+    h.process_batch(RecordBatch({"key": np.array([1]),
+                                 "v": np.array([9.0], np.float32)},
+                                timestamps=np.array([100])))
+    assert op.late_dropped == 1
+    assert h.extract_output_rows() == []
