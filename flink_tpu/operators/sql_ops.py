@@ -1005,6 +1005,50 @@ class OverAggregateOperator(StreamOperator):
         self._dropped_late = snap.get("dropped_late", 0)
 
 
+class HopWindowExpandOperator(StreamOperator):
+    """Row → per-covering-HOP-window copies, for window-scoped dedup
+    (DISTINCT aggregates in HOP windows).
+
+    Each copy carries a synthetic timestamp ``t' = w*slide + size - 1``
+    (its window's max timestamp) in a ``__hopts`` column AND as the batch
+    timestamp, so a TUMBLE(slide) aggregation downstream buckets each copy
+    into a bucket unique to its window: the bucket's end is ``>= t'``, so a
+    REAL-time watermark never fires a window before its true close (at most
+    ``slide-1`` ms after), and a copy whose real window already closed is
+    late by exactly the reference's rule.  The real HOP bounds are
+    recovered from the bucket start downstream
+    (``w = bucket_start/slide - (size-1)//slide``)."""
+
+    def __init__(self, size_ms: int, slide_ms: int,
+                 time_col: str = "__hopts", name: str = "hop-expand"):
+        self.size_ms = int(size_ms)
+        self.slide_ms = int(slide_ms)
+        self.time_col = time_col
+        self.name = name
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        if batch.timestamps is None:
+            raise ValueError("HOP expansion needs event-time timestamps")
+        ts = np.asarray(batch.timestamps, np.int64)
+        size, slide = self.size_ms, self.slide_ms
+        max_covers = -(-size // slide)
+        out: List[StreamElement] = []
+        base_w = np.floor_divide(ts, slide)
+        for k in range(max_covers):
+            w = base_w - k
+            valid = w * slide + size > ts
+            if not valid.any():
+                continue
+            tprime = (w * slide + size - 1)[valid]
+            cols = {c: np.asarray(v)[valid]
+                    for c, v in batch.columns.items()}
+            cols[self.time_col] = tprime
+            out.append(RecordBatch(cols, timestamps=tprime))
+        return out
+
+
 class BranchMergeOperator(StreamOperator):
     """Streaming inner merge of two aggregate branches on a merge-key column
     — the glue for mixed DISTINCT/plain aggregate queries, where the planner

@@ -52,6 +52,7 @@ class WindowSpec:
     time_col: str
     size_ms: int
     slide_ms: Optional[int] = None  # HOP only
+    offset_ms: int = 0              # synthetic TUMBLE alignment (HOP dedup)
 
 
 @dataclass
@@ -884,12 +885,12 @@ class Planner:
         distinct_specs = [s for s in agg_specs if s.distinct]
         plain_specs = [s for s in agg_specs if not s.distinct]
         if distinct_specs:
-            if window is not None and window.kind != "TUMBLE":
+            if window is not None and window.kind == "SESSION":
                 raise PlanError(
-                    "DISTINCT aggregates are supported in TUMBLE windows and "
-                    "non-windowed GROUP BY (not HOP/SESSION: rows belong to "
-                    "several overlapping/merging windows, so a row-level "
-                    "dedup key cannot name the window)")
+                    "DISTINCT aggregates are supported in TUMBLE/HOP "
+                    "windows and non-windowed GROUP BY (not SESSION: "
+                    "merging windows have no stable window identity a "
+                    "row-level dedup key could name)")
             args = {repr(s.arg) for s in distinct_specs}
             if len(args) != 1:
                 raise PlanError("all DISTINCT aggregates in a query must "
@@ -903,16 +904,17 @@ class Planner:
         if distinct_specs and plain_specs:
             a = self._agg_branch(stream, plain_specs, key_exprs, key_col,
                                  single_col_key, window, compiler, None)
-            b = self._agg_branch(stream, distinct_specs, key_exprs, key_col,
-                                 single_col_key, window, compiler,
-                                 distinct_specs[0].arg)
+            b = self._distinct_branch(stream, distinct_specs, key_exprs,
+                                      key_col, single_col_key, window,
+                                      compiler)
             agg_stream = self._merge_branches(
                 a, b, key_col, emit_bounds,
                 extra=[s.out_name for s in distinct_specs])
         elif distinct_specs:
-            agg_stream = self._agg_branch(stream, distinct_specs, key_exprs,
-                                          key_col, single_col_key, window,
-                                          compiler, distinct_specs[0].arg)
+            agg_stream = self._distinct_branch(stream, distinct_specs,
+                                               key_exprs, key_col,
+                                               single_col_key, window,
+                                               compiler)
         else:
             agg_stream = self._agg_branch(stream, agg_specs, key_exprs,
                                           key_col, single_col_key, window,
@@ -921,6 +923,51 @@ class Planner:
         return self._post_aggregate(agg_stream, items, having, agg_specs,
                                     key_exprs, single_col_key, key_col,
                                     emit_bounds, stmt, orig_items)
+
+    def _distinct_branch(self, stream, distinct_specs: List[AggSpec],
+                         key_exprs: List[Expr], key_col: str,
+                         single_col_key: bool,
+                         window: Optional[WindowSpec],
+                         compiler: ExprCompiler):
+        """The DISTINCT pipeline.  HOP windows first EXPAND each row into
+        per-covering-window copies on a synthetic per-window timestamp
+        (``HopWindowExpandOperator``) so the window identity becomes part
+        of the row — then the TUMBLE machinery applies unchanged; the real
+        HOP bounds are recovered from the synthetic bucket afterwards."""
+        from flink_tpu.datastream.api import DataStream
+
+        if window is not None and window.kind == "HOP":
+            from flink_tpu.operators.sql_ops import HopWindowExpandOperator
+
+            size, slide = window.size_ms, window.slide_ms
+            t = stream._then(
+                "sql-hop-expand",
+                lambda _s=size, _sl=slide: HopWindowExpandOperator(_s, _sl),
+                chainable=False)
+            expanded = DataStream(stream.env, t)
+            # offset aligns bucket boundaries on the REAL window closes
+            # (w*slide + size): every synthetic bucket ends exactly when
+            # its HOP window does, so the late-drop rule matches the plain
+            # branch for ANY size/slide (incl. size not a multiple of
+            # slide)
+            synth = WindowSpec(kind="TUMBLE", time_col="__hopts",
+                               size_ms=slide, offset_ms=size % slide)
+            out = self._agg_branch(expanded, distinct_specs, key_exprs,
+                                   key_col, single_col_key, synth, compiler,
+                                   distinct_specs[0].arg)
+            shift = size - slide  # bucket [w*slide+size-slide, w*slide+size)
+
+            def fix_bounds(cols, _shift=shift, _size=size):
+                o = dict(cols)
+                start = np.asarray(o["window_start"], np.int64) - _shift
+                o["window_start"] = start
+                o["window_end"] = start + _size
+                return o
+
+            return out.map(fix_bounds, name="sql-hop-bounds")
+        return self._agg_branch(stream, distinct_specs, key_exprs, key_col,
+                                single_col_key, window, compiler,
+                                distinct_specs[0].arg)
 
     def _agg_branch(self, stream, agg_specs: List[AggSpec],
                     key_exprs: List[Expr], key_col: str,
@@ -941,8 +988,8 @@ class Planner:
                     # TUMBLE: the dedup scope is one window — fold the
                     # window index into the key so a value recurring in a
                     # LATER window still counts there
-                    widx = np.asarray(cols[_w.time_col],
-                                      np.int64) // _w.size_ms
+                    widx = ((np.asarray(cols[_w.time_col], np.int64)
+                             - _w.offset_ms) // _w.size_ms)
                     parts = parts[:-1] + [widx, parts[-1]]
                 return parts
 
@@ -1017,7 +1064,8 @@ class Planner:
                     tuple_agg, value_selector=select_values,
                     name="sql-session-agg")
         if window.kind == "TUMBLE":
-            assigner = TumblingEventTimeWindows.of(window.size_ms)
+            assigner = TumblingEventTimeWindows.of(window.size_ms,
+                                                   window.offset_ms)
         else:
             assigner = SlidingEventTimeWindows.of(window.size_ms,
                                                   window.slide_ms)

@@ -549,3 +549,73 @@ def test_dedup_keep_last_arrival_order_across_batches():
     assert rows == {5: 9.0, 7: 3.0}
     # emitted column is numeric, not object (device-consumable downstream)
     assert out[0].column("v").dtype.kind == "f"
+
+
+# ---------------------------------------------------------------------------
+# DISTINCT aggregates in HOP windows (was an explicit known gap): rows expand
+# to per-covering-window copies so the dedup key can name the window
+# ---------------------------------------------------------------------------
+
+def _hop_distinct_env():
+    te = TableEnvironment()
+    te.register_collection("t", columns={
+        "k": np.array([1, 1, 1, 1], np.int64),
+        "v": np.array([5, 7, 5, 7], np.int64),
+        "ts": np.array([0, 1000, 1500, 2000], np.int64)}, rowtime="ts")
+    return te
+
+
+def test_hop_count_distinct():
+    rows = _hop_distinct_env().execute_sql(
+        "SELECT k, COUNT(DISTINCT v) AS dc, "
+        "HOP_START(ts, INTERVAL '1' SECOND, INTERVAL '2' SECOND) AS ws "
+        "FROM t GROUP BY k, HOP(ts, INTERVAL '1' SECOND, "
+        "INTERVAL '2' SECOND)").collect()
+    got = sorted((int(r["ws"]), int(r["dc"])) for r in rows)
+    # windows: [-1000,1000):{5}  [0,2000):{5,7}  [1000,3000):{7,5}
+    #          [2000,4000):{7}
+    assert got == [(-1000, 1), (0, 2), (1000, 2), (2000, 1)]
+
+
+def test_hop_sum_distinct_mixed_with_plain():
+    """Mixed plain + DISTINCT aggregates over HOP: the plain branch runs the
+    native sliding assigner, the distinct branch the expanded path; fired
+    rows re-merge on (key, REAL window bounds)."""
+    rows = _hop_distinct_env().execute_sql(
+        "SELECT k, COUNT(*) AS n, SUM(DISTINCT v) AS sd, "
+        "HOP_START(ts, INTERVAL '1' SECOND, INTERVAL '2' SECOND) AS ws "
+        "FROM t GROUP BY k, HOP(ts, INTERVAL '1' SECOND, "
+        "INTERVAL '2' SECOND)").collect()
+    got = {int(r["ws"]): (int(r["n"]), int(r["sd"])) for r in rows}
+    assert got == {-1000: (1, 5), 0: (3, 12), 1000: (3, 12), 2000: (1, 7)}
+
+
+def test_session_distinct_still_rejected():
+    from flink_tpu.sql.planner import PlanError
+
+    te = _hop_distinct_env()
+    with pytest.raises(PlanError, match="SESSION"):
+        te.execute_sql(
+            "SELECT k, COUNT(DISTINCT v) FROM t GROUP BY k, "
+            "SESSION(ts, INTERVAL '1' SECOND)").collect()
+
+
+def test_hop_distinct_non_divisible_size_late_rule_matches_plain():
+    """size % slide != 0: the synthetic bucket must close EXACTLY at the
+    real window close, so late rows drop identically in both branches —
+    never COUNT(DISTINCT) > COUNT(*)."""
+    te = TableEnvironment()
+    te.register_collection("t", columns={
+        "k": np.array([1, 1, 1], np.int64),
+        "v": np.array([5, 9, 7], np.int64),
+        # watermark reaches 2600 (closing real window [0,2500)), THEN a
+        # late row at 2400 arrives
+        "ts": np.array([0, 2600, 2400], np.int64)},
+        batch_size=2, rowtime="ts", watermark_delay_ms=0)
+    rows = te.execute_sql(
+        "SELECT k, COUNT(*) AS n, COUNT(DISTINCT v) AS dc, "
+        "HOP_START(ts, INTERVAL '1' SECOND, INTERVAL '2.5' SECOND) AS ws "
+        "FROM t GROUP BY k, HOP(ts, INTERVAL '1' SECOND, "
+        "INTERVAL '2.5' SECOND)").collect()
+    for r in rows:
+        assert int(r["dc"]) <= int(r["n"]), dict(r)
