@@ -187,11 +187,18 @@ class SourceSubtask(SubtaskBase):
         self.split_requester = split_requester
         self._emitted = 0          # elements pulled from the current split
         self._current_split = split
-        #: dynamic mode: splits fully consumed by THIS reader — snapshotted
-        #: so a split finished between the enumerator's trigger-time
-        #: snapshot and this reader's barrier is still reclaimed on restore
-        #: (its records were emitted pre-barrier; re-reading would duplicate)
+        #: dynamic mode: split IDS fully consumed by THIS reader —
+        #: snapshotted so a split finished between the enumerator's
+        #: trigger-time snapshot and this reader's barrier is still
+        #: reclaimed on restore (its records were emitted pre-barrier;
+        #: re-reading would duplicate).  Ids, not split objects, and pruned
+        #: once a checkpoint containing them COMPLETES (the enumerator's own
+        #: snapshot in that checkpoint already covers older assignments), so
+        #: snapshot size stays bounded on long-running dynamic sources.
         self._finished_splits: list = []
+        self._finished_in_ckpt: Dict[int, int] = {}  # cid -> total at snapshot
+        self._finished_total = 0
+        self._finished_pruned = 0
         #: stop-with-savepoint: a paused source emits nothing but keeps
         #: serving its command queue (so the savepoint barrier still flows)
         self._paused = threading.Event()
@@ -208,6 +215,7 @@ class SourceSubtask(SubtaskBase):
             cur = restore.get("current_split")
             skip = restore.get("source_offset", 0)
             self._finished_splits = list(restore.get("finished_splits", []))
+            self._finished_total = len(self._finished_splits)
             while True:
                 if cur is None:
                     self._check_cancel()
@@ -221,7 +229,8 @@ class SourceSubtask(SubtaskBase):
                     skip = 0
                 self._current_split = cur
                 self._read_split(cur, skip)
-                self._finished_splits.append(cur)
+                self._finished_splits.append(self._split_id_of(cur))
+                self._finished_total += 1
                 self._current_split = cur = None
                 self._emitted = 0
         # bounded end: final watermark flushes event-time state downstream
@@ -278,22 +287,45 @@ class SourceSubtask(SubtaskBase):
                 return
             if cmd[0] == "checkpoint":
                 cid = cmd[1]
-                snap = {"operator": self.operator.snapshot_state(),
-                        "source_offset": self._emitted}
+                from flink_tpu.operators.base import snapshot_scope
+                # drain async emissions downstream BEFORE the barrier
+                self._emit(self.operator.prepare_snapshot_pre_barrier())
+                with snapshot_scope(cid):
+                    snap = {"operator": self.operator.snapshot_state(),
+                            "source_offset": self._emitted}
                 if self.split_requester is not None:
                     # dynamic mode: the in-flight split AND consumed splits
                     # are reader state (the enumerator's own snapshot can
                     # race assignments made after the trigger)
                     snap["current_split"] = self._current_split
                     snap["finished_splits"] = list(self._finished_splits)
+                    self._finished_in_ckpt[cid] = self._finished_total
                 barrier = CheckpointBarrier(cid, timestamp=0)
                 self._emit([barrier])
                 self.listener.acknowledge_checkpoint(
                     cid, self.vertex_uid, self.subtask_index, snap)
             elif cmd[0] == "notify_complete":
                 self.operator.notify_checkpoint_complete(cmd[1])
+                self._prune_finished(cmd[1])
             elif cmd[0] == "cancel":
                 raise _Cancel()
+
+    def _split_id_of(self, split) -> str:
+        from flink_tpu.connectors.sources import split_id_of
+        return split_id_of(split)
+
+    def _prune_finished(self, completed_cid: int) -> None:
+        """Drop finished-split ids already covered by a COMPLETED checkpoint:
+        a restore from that checkpoint (or any later one) re-marks them via
+        the enumerator's own snapshotted assigned-set."""
+        covered = [c for c in self._finished_in_ckpt if c <= completed_cid]
+        if not covered:
+            return
+        high = max(self._finished_in_ckpt.pop(c) for c in covered)
+        drop = high - self._finished_pruned
+        if drop > 0:
+            del self._finished_splits[:drop]
+            self._finished_pruned = high
 
 
 class Subtask(SubtaskBase):
@@ -377,9 +409,12 @@ class Subtask(SubtaskBase):
             self._pending_barrier = el
             if self.unaligned and first:
                 # barrier overtakes: snapshot NOW, forward NOW
-                self._pending_snapshot = {
-                    "operator": self.operator.snapshot_state(),
-                    "valve": self._valve.snapshot()}
+                from flink_tpu.operators.base import snapshot_scope
+                self._emit(self.operator.prepare_snapshot_pre_barrier())
+                with snapshot_scope(el.checkpoint_id):
+                    self._pending_snapshot = {
+                        "operator": self.operator.snapshot_state(),
+                        "valve": self._valve.snapshot()}
                 self._emit([el])
             self._maybe_complete_alignment()
         elif isinstance(el, EndOfInput):
@@ -462,8 +497,11 @@ class Subtask(SubtaskBase):
             self._channel_state = []
             # barrier was already forwarded at first arrival
         else:
-            snap = {"operator": self.operator.snapshot_state(),
-                    "valve": self._valve.snapshot()}
+            from flink_tpu.operators.base import snapshot_scope
+            self._emit(self.operator.prepare_snapshot_pre_barrier())
+            with snapshot_scope(barrier.checkpoint_id):
+                snap = {"operator": self.operator.snapshot_state(),
+                        "valve": self._valve.snapshot()}
             self._emit([barrier])
         self.listener.acknowledge_checkpoint(
             barrier.checkpoint_id, self.vertex_uid, self.subtask_index, snap)

@@ -47,37 +47,58 @@ class SplitEnumerator:
     def reclaim(self, split) -> None:
         """Restore reconciliation: a split found in a READER's restored
         snapshot is owned by that reader even if it was assigned after this
-        enumerator's snapshot — never hand it out again."""
+        enumerator's snapshot — never hand it out again.  Accepts a split
+        object OR its plain ``split_id()`` string (readers snapshot ids)."""
         pass
 
 
 class _StaticEnumerator(SplitEnumerator):
-    """Wraps a fixed split list (the deploy-time behavior, made requestable)."""
+    """Wraps a fixed split list (the deploy-time behavior, made requestable).
+
+    Tracks the assigned-id SET (not a cursor) and honors ``reclaim()``: a
+    split handed out after this enumerator's trigger-time snapshot but owned
+    by a reader at the barrier is re-marked assigned on restore instead of
+    being assigned twice (duplicate reads)."""
 
     def __init__(self, splits: List[SourceSplit]):
         self._splits = list(splits)
-        self._next = 0
+        self._assigned: set = set()
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _sid(s: SourceSplit) -> str:
+        from flink_tpu.connectors.sources import split_id_of
+        return split_id_of(s)
 
     def next_split(self, reader_id: int) -> Optional[SourceSplit]:
         with self._lock:
-            if self._next >= len(self._splits):
-                return None
-            s = self._splits[self._next]
-            self._next += 1
-            return s
+            for s in self._splits:
+                if self._sid(s) not in self._assigned:
+                    self._assigned.add(self._sid(s))
+                    return s
+            return None
 
     def done(self) -> bool:
         with self._lock:
-            return self._next >= len(self._splits)
+            return all(self._sid(s) in self._assigned for s in self._splits)
 
     def snapshot_state(self) -> Dict[str, Any]:
         with self._lock:
-            return {"next": self._next}
+            return {"assigned": sorted(self._assigned)}
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         with self._lock:
-            self._next = snap.get("next", 0)
+            if "next" in snap:   # pre-r3 cursor snapshots
+                self._assigned = {self._sid(s)
+                                  for s in self._splits[:snap["next"]]}
+            else:
+                self._assigned = set(snap.get("assigned", []))
+
+    def reclaim(self, split) -> None:
+        if split is not None:
+            with self._lock:
+                self._assigned.add(
+                    split if isinstance(split, str) else self._sid(split))
 
 
 class DynamicFileSource(Source):
@@ -181,9 +202,11 @@ class DirectoryEnumerator(SplitEnumerator):
             self._assigned = set(snap.get("assigned", []))
 
     def reclaim(self, split) -> None:
+        # FilePathSplit.split_id() IS the path, so ids land in the same set
         if split is not None:
             with self._lock:
-                self._assigned.add(split.path)
+                self._assigned.add(
+                    split if isinstance(split, str) else split.path)
 
 
 class SourceCoordinator:

@@ -168,14 +168,20 @@ class LogServiceBroker:
                 key = (topic, partition, producer)
                 if self._seqs.get(key, -1) >= int(seq):
                     return log.end_offset(partition)  # duplicate: dropped
-                self._seqs[key] = int(seq)
-                self._persist_seqs()
             path = log._path(partition)
             with open(path, "ab") as f:
                 f.write(framed)
                 f.flush()
                 os.fsync(f.fileno())
-                return f.tell()
+                end = f.tell()
+            # sequence is recorded only AFTER the data is durable: a crash
+            # between the two at worst re-admits the producer's retry of the
+            # same batch (duplicate, the at-least-once floor) — never drops
+            # an acknowledged-but-unwritten batch as a "duplicate"
+            if producer is not None and seq is not None:
+                self._seqs[(topic, partition, producer)] = int(seq)
+                self._persist_seqs()
+            return end
 
     def _persist_seqs(self) -> None:
         tmp = self._seq_path + ".tmp"
@@ -344,6 +350,7 @@ class LogServiceSink:
         self.producer_id = uuid.uuid4().hex[:12]
         self._epoch = []
         self._staged = {}
+        self._txn_ckpt = {}
 
     def _cli(self) -> LogServiceClient:
         if self._client is None:
@@ -363,21 +370,37 @@ class LogServiceSink:
     # commits every staged txn; replayed commits after restore carry the
     # SAME producer sequences and deduplicate broker-side) -----------------
     def snapshot_state(self) -> Dict[str, Any]:
+        from flink_tpu.operators.base import current_checkpoint_id
+
         self._counter = getattr(self, "_counter", 0) + 1
         self._staged[self._counter] = self._epoch
+        # txn -> checkpoint id: notify commits ONLY txns staged for
+        # checkpoints <= the notified one (TwoPhaseCommitSinkFunction
+        # contract) — if checkpoints ever pipeline, an epoch staged for a
+        # later, uncompleted checkpoint must not commit early
+        self._txn_ckpt = getattr(self, "_txn_ckpt", {})
+        self._txn_ckpt[self._counter] = current_checkpoint_id()
         self._epoch = []
         staged = {cid: [{k: np.asarray(v) for k, v in b.columns.items()}
                         for b in bs] for cid, bs in self._staged.items()}
         # _rr rides the snapshot: a replayed commit must route each batch
         # to the SAME partition, or the per-partition seq dedup misses
         return {"staged": staged, "counter": self._counter,
-                "producer_id": self.producer_id, "rr": self._rr}
+                "producer_id": self.producer_id, "rr": self._rr,
+                "txn_ckpt": dict(self._txn_ckpt)}
 
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        txn_ckpt = getattr(self, "_txn_ckpt", {})
         for cid in sorted(self._staged):
+            staged_for = txn_ckpt.get(cid)
+            # None = the runtime gave no id at snapshot time: the legacy
+            # notify-before-next-barrier ordering applies — commit
+            if staged_for is not None and staged_for > checkpoint_id:
+                continue
             self._commit(cid)
 
     def _commit(self, cid: int) -> None:
+        getattr(self, "_txn_ckpt", {}).pop(cid, None)
         for j, batch in enumerate(self._staged.pop(cid, [])):
             # seq = (txn << 20 | j): strictly increasing per producer and
             # identical on replay -> broker-side idempotent dedup
@@ -406,6 +429,8 @@ class LogServiceSink:
         self._counter = int(snap.get("counter", 0))
         self._rr = int(snap.get("rr", 0))
         self._epoch = []
+        self._txn_ckpt = {int(cid): v
+                          for cid, v in snap.get("txn_ckpt", {}).items()}
         self._staged = {int(cid): [RecordBatch(c) for c in bs]
                         for cid, bs in snap.get("staged", {}).items()}
         # txns staged in a completed checkpoint are owed to the broker

@@ -44,6 +44,17 @@ class SourceSplit:
         return self.source.read_split(self.index, self.of)
 
 
+def split_id_of(split) -> str:
+    """Canonical split identity, shared by every runtime (reader-side
+    finished/assigned bookkeeping, enumerator reclaim, executor position
+    tracking must all key identically): a ``split_id`` method or plain
+    string attribute wins, else ``index/of``."""
+    sid = getattr(split, "split_id", None)
+    if callable(sid):
+        return sid()
+    return sid if sid else f"{split.index}/{split.of}"
+
+
 def _columns_from_rows(rows: Sequence[Mapping[str, Any]]) -> Dict[str, np.ndarray]:
     if not rows:
         return {}

@@ -11,10 +11,38 @@ race-avoidance the reference gets from the mailbox model (SURVEY §5.2).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Any, Dict, List, Optional
 
 from flink_tpu.core.batch import RecordBatch, StreamElement, Watermark
 from flink_tpu.core.functions import RuntimeContext
+
+#: checkpoint id of the snapshot currently being taken, visible to any
+#: operator/sink inside the snapshot call tree (chains included).  The
+#: runtimes set it around ``snapshot_state()``; 2PC sinks record it with
+#: their staged transactions so ``notify_checkpoint_complete(id)`` commits
+#: exactly the txns with ``staged_id <= id`` (the TwoPhaseCommitSinkFunction
+#: contract).  ContextVar: per-thread defaults keep concurrent subtask
+#: threads isolated.
+_CURRENT_CHECKPOINT_ID: contextvars.ContextVar[Optional[int]] = \
+    contextvars.ContextVar("flink_tpu_current_checkpoint_id", default=None)
+
+
+def current_checkpoint_id() -> Optional[int]:
+    """Checkpoint id of the in-progress snapshot, or None outside one."""
+    return _CURRENT_CHECKPOINT_ID.get()
+
+
+@contextlib.contextmanager
+def snapshot_scope(checkpoint_id: Optional[int]):
+    """Runtimes wrap operator ``snapshot_state()`` calls in this scope so
+    sinks can associate staged 2PC transactions with the checkpoint id."""
+    tok = _CURRENT_CHECKPOINT_ID.set(checkpoint_id)
+    try:
+        yield
+    finally:
+        _CURRENT_CHECKPOINT_ID.reset(tok)
 
 
 class StreamOperator:
@@ -64,6 +92,15 @@ class StreamOperator:
         return []
 
     # -- checkpointing -------------------------------------------------------
+    def prepare_snapshot_pre_barrier(self) -> List[StreamElement]:
+        """Called BEFORE the barrier is forwarded / the snapshot is taken:
+        drain any asynchronously-pending emissions so they reach downstream
+        ahead of the barrier (the reference drains its external Python
+        runtime the same way —
+        ``AbstractPythonFunctionOperator.prepareSnapshotPreBarrier:173``).
+        Returned elements are forwarded downstream pre-barrier."""
+        return []
+
     def snapshot_state(self) -> Dict[str, Any]:
         """Synchronous snapshot part: return a host-side state dict (numpy
         trees); called at barrier alignment points."""

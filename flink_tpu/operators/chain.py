@@ -83,6 +83,12 @@ class ChainedOperator(StreamOperator):
                 handled = True
         return [] if handled else [marker]
 
+    def prepare_snapshot_pre_barrier(self) -> List[StreamElement]:
+        out: List[StreamElement] = []
+        for i, op in enumerate(self.operators):
+            out.extend(self._feed(i + 1, op.prepare_snapshot_pre_barrier()))
+        return out
+
     def snapshot_state(self) -> Dict[str, Any]:
         return {f"op{i}": op.snapshot_state() for i, op in enumerate(self.operators)}
 

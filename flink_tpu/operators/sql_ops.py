@@ -165,17 +165,18 @@ class ChangelogGroupAggOperator(StreamOperator):
             np.inf if how == "min" else -np.inf)
 
     def _alloc(self, K: int):
-        """One f32 array per min/max column; TWO (hi, lo) per sum/count —
-        double-single (compensated) accumulation keeps ~48 bits of
-        precision without float64 (jnp defaults to 32-bit): a count can
-        reach 2^48 exactly, where a plain f32 would freeze at 2^24."""
+        """TWO f32 words (hi, lo) per column.  sum/count: double-single
+        (compensated) accumulation; min/max: Dekker-split pairs combined
+        lexicographically.  Both keep ~48 bits of precision without float64
+        (jnp defaults to 32-bit): a count or an integer-valued min/max is
+        exact up to 2^48, where a plain f32 would lose integers above
+        2^24."""
         import jax.numpy as jnp
 
         arrs = []
         for out, (_c, how) in self.agg_columns.items():
             arrs.append(jnp.full((K,), self._identity(how), jnp.float32))
-            if self._MODES[how] == "add":
-                arrs.append(jnp.zeros((K,), jnp.float32))  # low word
+            arrs.append(jnp.zeros((K,), jnp.float32))  # low word
         return tuple(arrs)
 
     def _ensure(self, needed: int):
@@ -196,16 +197,39 @@ class ChangelogGroupAggOperator(StreamOperator):
                             for f, o in zip(fresh, self._state))
 
     @staticmethod
-    def _seg_reduce(jnp, vals, inv, U, mode, identity):
+    def _lex_pick(jnp, ah, al, bh, bl, mode):
+        """Element-wise lexicographic min/max over Dekker pairs (hi, lo):
+        normalized pairs (|lo| <= ulp(hi)/2) order exactly like the f64
+        values they represent, so comparing (hi, then lo on hi-ties) picks
+        the true extremum without 64-bit arithmetic."""
+        if mode == "min":
+            take_a = (ah < bh) | ((ah == bh) & (al <= bl))
+        else:
+            take_a = (ah > bh) | ((ah == bh) & (al >= bl))
+        return jnp.where(take_a, ah, bh), jnp.where(take_a, al, bl)
+
+    def _seg_reduce_pair(self, jnp, hi, lo, inv, U, mode, identity):
+        """Per-batch segment reduce of Dekker pairs: two scatter-extrema —
+        first the hi words, then the lo words of rows WHOSE hi attained the
+        segment extremum (rows off the extremum are masked to identity)."""
         if mode == "add":
-            return jnp.zeros((U,), jnp.float32).at[inv].add(vals)
-        return jnp.full((U,), identity, jnp.float32).at[inv].min(vals) \
-            if mode == "min" else \
-            jnp.full((U,), identity, jnp.float32).at[inv].max(vals)
+            return (jnp.zeros((U,), jnp.float32).at[inv].add(hi),
+                    jnp.zeros((U,), jnp.float32).at[inv].add(lo))
+        red = (lambda a, i, v: a.at[i].min(v)) if mode == "min" \
+            else (lambda a, i, v: a.at[i].max(v))
+        hi_x = red(jnp.full((U,), identity, jnp.float32), inv, hi)
+        on_x = hi == jnp.take(hi_x, inv)
+        lo_masked = jnp.where(on_x, lo,
+                              jnp.float32(np.inf if mode == "min"
+                                          else -np.inf))
+        lo_x = red(jnp.full((U,), np.inf if mode == "min" else -np.inf,
+                            jnp.float32), inv, lo_masked)
+        # identity segments (no rows): lo back to 0 so hi+lo stays finite
+        return hi_x, jnp.where(jnp.isfinite(lo_x), lo_x, 0.0)
 
     def _update_step_impl(self, state, uniq_slots, inv, values, U):
         """state': scatter combined; returns (state', old[U], new[U]) per
-        state array (sum/count columns contribute an (hi, lo) pair)."""
+        state array (every column contributes an (hi, lo) pair)."""
         import jax.numpy as jnp
 
         olds, news, out_state = [], [], []
@@ -213,33 +237,28 @@ class ChangelogGroupAggOperator(StreamOperator):
         for out, (_c, how) in self.agg_columns.items():
             mode = self._MODES[how]
             ident = self._identity(how)
-            partial = self._seg_reduce(jnp, values[out], inv, U, mode, ident)
+            vhi, vlo = values[out]
+            phi, plo = self._seg_reduce_pair(jnp, vhi, vlo, inv, U, mode,
+                                             ident)
+            hi_arr, lo_arr = state[si], state[si + 1]
+            si += 2
+            hi = jnp.take(hi_arr, uniq_slots, mode="clip")
+            lo = jnp.take(lo_arr, uniq_slots, mode="clip")
             if mode == "add":
-                hi_arr, lo_arr = state[si], state[si + 1]
-                si += 2
-                hi = jnp.take(hi_arr, uniq_slots, mode="clip")
-                lo = jnp.take(lo_arr, uniq_slots, mode="clip")
                 # double-single += f32 (2Sum): exact error of hi+partial
                 # folds into the low word
-                s = hi + partial
+                s = hi + phi
                 v = s - hi
-                e = (hi - (s - v)) + (partial - v)
-                lo2 = lo + e
+                e = (hi - (s - v)) + (phi - v)
+                lo2 = (lo + plo) + e
                 nh = s + lo2
                 nl = lo2 - (nh - s)
-                out_state.append(hi_arr.at[uniq_slots].set(nh, mode="drop"))
-                out_state.append(lo_arr.at[uniq_slots].set(nl, mode="drop"))
-                olds.extend([hi, lo])
-                news.extend([nh, nl])
-                continue
-            arr = state[si]
-            si += 1
-            old = jnp.take(arr, uniq_slots, mode="clip")
-            new = (jnp.minimum(old, partial) if mode == "min"
-                   else jnp.maximum(old, partial))
-            out_state.append(arr.at[uniq_slots].set(new, mode="drop"))
-            olds.append(old)
-            news.append(new)
+            else:
+                nh, nl = self._lex_pick(jnp, hi, lo, phi, plo, mode)
+            out_state.append(hi_arr.at[uniq_slots].set(nh, mode="drop"))
+            out_state.append(lo_arr.at[uniq_slots].set(nl, mode="drop"))
+            olds.extend([hi, lo])
+            news.extend([nh, nl])
         return tuple(out_state), tuple(olds), tuple(news)
 
     def _jitted(self):
@@ -294,29 +313,29 @@ class ChangelogGroupAggOperator(StreamOperator):
         inv_p[:B] = inv
         values = {}
         for out, (col, how) in self.agg_columns.items():
-            v = np.full(Bp, 0.0 if self._MODES[how] == "add"
-                        else self._identity(how), np.float32)
-            v[:B] = (1.0 if col is None
-                     else np.asarray(batch.column(col), np.float32))
-            values[out] = jnp.asarray(v)
+            # Dekker split on the host: hi = f32(v), lo = f32(v - hi) — the
+            # pair carries ~48 bits, so integer inputs above 2^24 stay exact
+            # through min/max and into compensated sums
+            v64 = np.full(Bp, 0.0 if self._MODES[how] == "add"
+                          else self._identity(how), np.float64)
+            v64[:B] = (1.0 if col is None
+                       else np.asarray(batch.column(col), np.float64))
+            vhi = v64.astype(np.float32)
+            with np.errstate(invalid="ignore"):  # inf - inf pads -> 0 below
+                vlo = (v64 - vhi.astype(np.float64)).astype(np.float32)
+            vlo[~np.isfinite(vlo)] = 0.0
+            values[out] = (jnp.asarray(vhi), jnp.asarray(vlo))
         self._state, olds, news = self._jitted()(
             self._state, jnp.asarray(uniq_p), jnp.asarray(inv_p, jnp.int32),
             values, Up)
         # ---- host emit: only the [U] touched groups come back; (hi, lo)
         # pairs collapse to f64 (recovering the compensated precision)
         olds_f, news_f = [], []
-        i = 0
-        for out, (_c, how) in self.agg_columns.items():
-            if self._MODES[how] == "add":
-                olds_f.append(np.asarray(olds[i], np.float64)[:U]
-                              + np.asarray(olds[i + 1], np.float64)[:U])
-                news_f.append(np.asarray(news[i], np.float64)[:U]
-                              + np.asarray(news[i + 1], np.float64)[:U])
-                i += 2
-            else:
-                olds_f.append(np.asarray(olds[i])[:U])
-                news_f.append(np.asarray(news[i])[:U])
-                i += 1
+        for i in range(0, len(olds), 2):
+            olds_f.append(np.asarray(olds[i], np.float64)[:U]
+                          + np.asarray(olds[i + 1], np.float64)[:U])
+            news_f.append(np.asarray(news[i], np.float64)[:U]
+                          + np.asarray(news[i + 1], np.float64)[:U])
         is_new = uniq_slots >= prev_n
         changed = ~is_new & np.logical_or.reduce(
             [o != n for o, n in zip(olds_f, news_f)])
@@ -373,7 +392,7 @@ class ChangelogGroupAggOperator(StreamOperator):
                     vals = np.asarray([groups[k][out] for k in groups],
                                       np.float32)
                     state[si] = state[si].at[slots].set(jnp.asarray(vals))
-                    si += 2 if self._MODES[how] == "add" else 1
+                    si += 2  # lo word stays 0 (normalized pair)
                 self._state = tuple(state)
             return
         if "key_index" not in snap:
@@ -386,9 +405,23 @@ class ChangelogGroupAggOperator(StreamOperator):
         self._state = None
         self._ensure(max(n, 1))
         if "state" in snap:
+            arrs = list(snap["state"])
+            if len(arrs) != 2 * len(self.agg_columns):
+                # pre-r3 layout: min/max columns had a single word — insert
+                # zero low words so every column is an (hi, lo) pair
+                upgraded, i = [], 0
+                for out, (_c, how) in self.agg_columns.items():
+                    upgraded.append(arrs[i])
+                    if self._MODES[how] == "add":
+                        upgraded.append(arrs[i + 1])
+                        i += 2
+                    else:
+                        upgraded.append(np.zeros_like(arrs[i]))
+                        i += 1
+                arrs = upgraded
             self._state = tuple(
                 a.at[:n].set(jnp.asarray(s))
-                for a, s in zip(self._state, snap["state"]))
+                for a, s in zip(self._state, arrs))
 
 
 class TopNOperator(StreamOperator):

@@ -274,6 +274,7 @@ class LocalExecutor:
         t0 = time.monotonic()
         running = self._build(plan, restore)
         self.running = running
+        self.plan = plan
         source_vertices = [running[v.id] for v in plan.sources]
 
         # split readers, round-robin (SourceReaderBase poll loop analog);
@@ -286,8 +287,8 @@ class LocalExecutor:
             src = rv.vertex.chain[0].source
             positions = restored_positions.get(rv.vertex.uid, {})
             for split in src.create_splits(rv.vertex.parallelism):
-                split_id = getattr(split, "split_id", None) or \
-                    f"{split.index}/{split.of}"
+                from flink_tpu.connectors.sources import split_id_of
+                split_id = split_id_of(split)
                 if hasattr(src, "open_split"):
                     reader = src.open_split(split, positions.get(split_id))
                 else:
@@ -383,8 +384,22 @@ class LocalExecutor:
         """Synchronous aligned checkpoint of all vertices (local mode: the
         depth-first delivery order means no in-flight data exists between
         vertices at this point — alignment is implicit)."""
-        snapshot = {rv.vertex.uid: rv.operator.snapshot_state()
-                    for rv in self.running.values()}
+        from flink_tpu.operators.base import snapshot_scope
+
+        # pre-barrier drain: async emissions reach downstream BEFORE the
+        # snapshot (AbstractPythonFunctionOperator.prepareSnapshotPreBarrier
+        # analog) — topo order so drained elements flow through the plan
+        plan = getattr(self, "plan", None)
+        for v in plan.vertices if plan is not None else []:
+            rv = self.running.get(v.id)
+            if rv is None:
+                continue
+            drained = rv.operator.prepare_snapshot_pre_barrier()
+            if drained:
+                self._route(rv, drained)
+        with snapshot_scope(checkpoint_id):
+            snapshot = {rv.vertex.uid: rv.operator.snapshot_state()
+                        for rv in self.running.values()}
         sources: Dict[str, Dict[str, Any]] = {}
         for uid, split_id, reader in getattr(self, "_split_readers", []):
             pos = getattr(reader, "position", None)

@@ -125,6 +125,56 @@ def test_sink_commit_replay_dedups(broker):
     assert total == 4                     # committed exactly once
 
 
+def test_sink_pipelined_checkpoints_commit_by_id(broker):
+    """A txn staged for checkpoint 2 must NOT commit when only checkpoint 1
+    completes (TwoPhaseCommitSinkFunction: commit txns with id <= notified)."""
+    from flink_tpu.operators.base import snapshot_scope
+
+    sink = LogServiceSink(broker.url, "pipelined", num_partitions=1)
+    sink.open(None)
+    sink.write_batch(RecordBatch({"x": np.arange(3)}))
+    with snapshot_scope(1):
+        sink.snapshot_state()             # txn for checkpoint 1
+    sink.write_batch(RecordBatch({"x": np.arange(3, 8)}))
+    with snapshot_scope(2):
+        sink.snapshot_state()             # txn for checkpoint 2 (pipelined)
+
+    c = LogServiceClient(broker.url)
+    sink.notify_checkpoint_complete(1)
+    batches, _ = c.fetch("pipelined", 0, 0)
+    assert sum(len(b) for b in batches) == 3      # only checkpoint 1's rows
+    sink.notify_checkpoint_complete(2)
+    batches, _ = c.fetch("pipelined", 0, 0)
+    assert sum(len(b) for b in batches) == 8
+
+
+def test_broker_persists_seq_after_data(broker, tmp_path):
+    """Durability ordering: the idempotent-producer sequence is recorded
+    only after the partition data is written+fsynced, so a crash between
+    the two re-admits the retry (duplicate = at-least-once floor) instead
+    of dropping acknowledged-but-unwritten data."""
+    import flink_tpu.connectors.log_service as ls
+
+    c = LogServiceClient(broker.url)
+    c.create_topic("dur")
+    orig_persist = ls.LogServiceBroker._persist_seqs
+    seen = {}
+
+    def spy(self):
+        # at seq-persist time the data must already be on disk
+        log = self._logs["dur"]
+        seen["end_at_persist"] = log.end_offset(0)
+        return orig_persist(self)
+
+    ls.LogServiceBroker._persist_seqs = spy
+    try:
+        c.append("dur", 0, RecordBatch({"x": np.arange(3)}),
+                 producer="p", seq=1)
+    finally:
+        ls.LogServiceBroker._persist_seqs = orig_persist
+    assert seen["end_at_persist"] > 0
+
+
 def test_object_store_checkpoint_storage(tmp_path):
     server = ObjectStoreServer(str(tmp_path / "os")).start()
     try:

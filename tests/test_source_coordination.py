@@ -109,6 +109,30 @@ def test_enumerator_snapshot_reclaim_protocol(tmp_path):
     assert restored.done()
 
 
+def test_static_enumerator_reclaim_protocol():
+    """_StaticEnumerator honors the same base contract: a split handed out
+    after its trigger-time snapshot, reclaimed from a reader's restored
+    snapshot (by id), is never assigned a second time."""
+    from flink_tpu.connectors.enumerator import _StaticEnumerator
+    from flink_tpu.connectors.sources import CollectionSource
+
+    src = CollectionSource([{"v": i} for i in range(9)])
+    splits = src.create_splits(3)
+    enum = _StaticEnumerator(splits)
+    s1 = enum.next_split(0)
+    snap = enum.snapshot_state()          # only s1 assigned at trigger time
+    s2 = enum.next_split(0)               # assigned post-snapshot
+
+    restored = _StaticEnumerator(splits)
+    restored.restore_state(snap)
+    # readers snapshot split IDS — reclaim must accept the plain id
+    restored.reclaim(f"{s2.index}/{s2.of}")
+    s3 = restored.next_split(1)
+    assert {(_s.index, _s.of) for _s in (s1, s2, s3)} == \
+        {(s.index, s.of) for s in splits}
+    assert restored.next_split(1) is None and restored.done()
+
+
 def test_dynamic_source_static_fallback(tmp_path):
     """Executors without runtime coordination still read the directory as a
     static split list (deploy-time enumeration)."""

@@ -532,6 +532,37 @@ def test_changelog_count_exact_past_f32_precision():
     assert rows[-1]["n"] == total + 3
 
 
+def test_changelog_minmax_exact_past_f32_precision():
+    """min/max carry Dekker (hi, lo) pairs: integer-valued inputs above
+    2^24 — where plain f32 collapses adjacent integers — stay exact, so
+    change detection never misses or fabricates -U/+U pairs."""
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.operators.sql_ops import ChangelogGroupAggOperator
+
+    big = (1 << 24)  # 16777216: f32(big) == f32(big + 1)
+    op = ChangelogGroupAggOperator("k", {"mn": ("v", "min"),
+                                         "mx": ("v", "max")})
+    out = op.process_batch(RecordBatch({
+        "k": np.zeros(2, np.int64),
+        "v": np.array([big + 1, big + 3], np.int64)}))
+    rows = [r for b in out for r in b.to_rows()]
+    assert rows[-1]["mn"] == big + 1 and rows[-1]["mx"] == big + 3
+
+    # a new min one integer below: f32 cannot represent the difference,
+    # the pair can — the -U/+U change must be emitted with exact values
+    out = op.process_batch(RecordBatch({
+        "k": np.zeros(1, np.int64), "v": np.array([big], np.int64)}))
+    rows = [r for b in out for r in b.to_rows()]
+    assert [r["op"] for r in rows] == ["-U", "+U"]
+    assert rows[1]["mn"] == big and rows[1]["mx"] == big + 3
+
+    # equal-to-current-min arrival: NO change rows (f32 ties broken by the
+    # low word must not fabricate updates)
+    out = op.process_batch(RecordBatch({
+        "k": np.zeros(1, np.int64), "v": np.array([big], np.int64)}))
+    assert out == []
+
+
 def test_dedup_keep_last_arrival_order_across_batches():
     """keep='last' without an order column: a later BATCH's row must beat an
     earlier batch's row regardless of in-batch position."""
