@@ -61,26 +61,29 @@ class _StaticEnumerator(SplitEnumerator):
     being assigned twice (duplicate reads)."""
 
     def __init__(self, splits: List[SourceSplit]):
-        self._splits = list(splits)
-        self._assigned: set = set()
-        self._lock = threading.Lock()
-
-    @staticmethod
-    def _sid(s: SourceSplit) -> str:
         from flink_tpu.connectors.sources import split_id_of
-        return split_id_of(s)
+
+        self._splits = list(splits)
+        self._ids = [split_id_of(s) for s in self._splits]  # precomputed
+        self._assigned: set = set()
+        self._cursor = 0     # first possibly-unassigned position
+        self._lock = threading.Lock()
 
     def next_split(self, reader_id: int) -> Optional[SourceSplit]:
         with self._lock:
-            for s in self._splits:
-                if self._sid(s) not in self._assigned:
-                    self._assigned.add(self._sid(s))
-                    return s
+            while self._cursor < len(self._splits):
+                i = self._cursor
+                self._cursor += 1
+                if self._ids[i] not in self._assigned:
+                    self._assigned.add(self._ids[i])
+                    return self._splits[i]
             return None
 
     def done(self) -> bool:
         with self._lock:
-            return all(self._sid(s) in self._assigned for s in self._splits)
+            return (self._cursor >= len(self._splits)
+                    or all(i in self._assigned
+                           for i in self._ids[self._cursor:]))
 
     def snapshot_state(self) -> Dict[str, Any]:
         with self._lock:
@@ -89,16 +92,18 @@ class _StaticEnumerator(SplitEnumerator):
     def restore_state(self, snap: Dict[str, Any]) -> None:
         with self._lock:
             if "next" in snap:   # pre-r3 cursor snapshots
-                self._assigned = {self._sid(s)
-                                  for s in self._splits[:snap["next"]]}
+                self._assigned = set(self._ids[:snap["next"]])
             else:
                 self._assigned = set(snap.get("assigned", []))
+            self._cursor = 0
 
     def reclaim(self, split) -> None:
+        from flink_tpu.connectors.sources import split_id_of
+
         if split is not None:
             with self._lock:
                 self._assigned.add(
-                    split if isinstance(split, str) else self._sid(split))
+                    split if isinstance(split, str) else split_id_of(split))
 
 
 class DynamicFileSource(Source):

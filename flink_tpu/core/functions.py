@@ -136,6 +136,34 @@ class AggregateFunction(RichFunction, abc.ABC):
         """Accumulator pytree -> output value (default: the accumulator itself)."""
         return acc
 
+    # -- host emit tier (numpy evaluation) -----------------------------------
+    # The window backend can keep a write-through HOST mirror of the ACC
+    # column and serve window fires from it with zero device->host traffic
+    # (operators/window_agg.py ``emit_tier``) — decisive on egress-constrained
+    # links where downloads cost ~100ms+ each.  That requires evaluating the
+    # same monoid in numpy.  ``host_lift``/``host_get_result`` are the numpy
+    # twins of ``lift``/``get_result``; combine is covered by
+    # ``scatter_kinds`` (add/min/max ufuncs).  Return NotImplemented to keep
+    # an aggregate device-only.
+
+    def host_lift(self, values):
+        """numpy ``lift``: np column(s) -> ACC pytree of np arrays [B, ...].
+        Default: unsupported (jnp ``lift`` bodies would bounce every batch
+        off the device)."""
+        return NotImplemented
+
+    def host_get_result(self, acc):
+        """numpy ``get_result``: ACC pytree of np arrays -> output values."""
+        return NotImplemented
+
+    def supports_host_emit(self) -> bool:
+        """True when the backend may evaluate fires on the host: kinds are
+        declared (add/min/max combine) and both numpy twins are overridden."""
+        return (self.scatter_kind_leaves() is not None
+                and type(self).host_lift is not AggregateFunction.host_lift
+                and type(self).host_get_result
+                is not AggregateFunction.host_get_result)
+
     # -- introspection used by the state backend ----------------------------
     def scatter_kinds(self):
         """Optional fast-path declaration: a pytree matching ``identity()``'s
@@ -214,6 +242,13 @@ class ReduceFunction(AggregateFunction):
     def combine(self, a, b):
         return self.reduce(a, b)
 
+    # reduces are shape-preserving, so the numpy twins are identities
+    def host_lift(self, values):
+        return values
+
+    def host_get_result(self, acc):
+        return acc
+
     @abc.abstractmethod
     def reduce(self, a, b):
         ...
@@ -290,6 +325,13 @@ class CountAggregator(AggregateFunction):
     def combine(self, a, b):
         return a + b
 
+    def host_lift(self, values):
+        leaf = jax.tree_util.tree_leaves(values)[0]
+        return np.ones(np.shape(leaf)[:1], np.int64)
+
+    def host_get_result(self, acc):
+        return acc
+
     def scatter_kinds(self):
         return "add"
 
@@ -315,6 +357,14 @@ class AvgAggregator(AggregateFunction):
         cnt = jnp.maximum(acc["count"], 1)
         return acc["sum"] / cnt.astype(self._dtype)
 
+    def host_lift(self, values):
+        v = np.asarray(values, np.float64)
+        return {"sum": v, "count": np.ones(v.shape[:1], np.int64)}
+
+    def host_get_result(self, acc):
+        cnt = np.maximum(np.asarray(acc["count"]), 1)
+        return np.asarray(acc["sum"]) / cnt
+
     def scatter_kinds(self):
         return {"sum": "add", "count": "add"}
 
@@ -338,6 +388,23 @@ class TupleAggregator(AggregateFunction):
 
     def get_result(self, acc):
         return {name: agg.get_result(acc[name]) for name, (_, agg) in self._aggs.items()}
+
+    def host_lift(self, values):
+        if not all(agg.supports_host_emit() for _, agg in self._aggs.values()):
+            return NotImplemented
+        return {name: agg.host_lift(values[col])
+                for name, (col, agg) in self._aggs.items()}
+
+    def host_get_result(self, acc):
+        if not all(agg.supports_host_emit() for _, agg in self._aggs.values()):
+            return NotImplemented
+        return {name: agg.host_get_result(acc[name])
+                for name, (_, agg) in self._aggs.items()}
+
+    def supports_host_emit(self) -> bool:
+        return (self.scatter_kind_leaves() is not None
+                and all(agg.supports_host_emit()
+                        for _, agg in self._aggs.values()))
 
     def scatter_kinds(self):
         kinds = {}
