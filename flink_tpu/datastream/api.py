@@ -634,11 +634,20 @@ class WindowedStream:
         if getattr(self, "_late_tag", None) is not None:
             raise ValueError("side_output_late_data is not supported on the "
                              "raw-element apply() path yet; use aggregate()")
+        # raw-element windows keep their buffers host-side by design — the
+        # fire-time compute is the user's row function (the reference's
+        # evictor also inspects individual elements).  In PROCESS-parallel
+        # deployments the keyed exchange partitions rows per subtask and
+        # snapshots split/merge by key group
+        # (EvictingWindowOperator.split_snapshot); an in-process device
+        # mesh adds no parallelism to a host UDF, so say so.
         if self.keyed.env.mesh is not None:
             import warnings
-            warnings.warn("env mesh is not yet honored by the raw-element "
-                          "apply() path: this operator runs single-device",
-                          stacklevel=2)
+            warnings.warn(
+                "raw-element apply() buffers and fires on the host (user "
+                "row function): the env mesh adds no device parallelism to "
+                "this operator; scale it with process parallelism (key-group"
+                " partitioned, rescale-safe)", stacklevel=2)
         assigner = self.assigner
         key_col = self.keyed.key_column
         ev = getattr(self, "_evictor", None)
@@ -668,21 +677,22 @@ class WindowedStream:
                 raise ValueError(
                     "custom triggers are not supported on session windows "
                     "(sessions fire when the gap closes); remove .trigger()")
-            if keyed.env.mesh is not None:
-                import warnings
-                warnings.warn(
-                    "env mesh is not yet honored by session windows: this "
-                    "job runs the SessionWindowOperator single-device",
-                    stacklevel=2)
             from flink_tpu.operators.session_window import SessionWindowOperator
+            session_mesh = keyed.env.mesh
 
             def factory():
-                return SessionWindowOperator(
-                    assigner, agg, key_column=keyed.key_column,
+                kwargs = dict(
+                    key_column=keyed.key_column,
                     value_column=value_column, value_selector=value_selector,
                     allowed_lateness_ms=lateness,
                     output_column=output_column, name=name,
                     late_output_tag=late_tag)
+                if session_mesh is not None:
+                    from flink_tpu.parallel.mesh_runtime import (
+                        MeshSessionWindowOperator)
+                    return MeshSessionWindowOperator(
+                        assigner, agg, mesh=session_mesh, **kwargs)
+                return SessionWindowOperator(assigner, agg, **kwargs)
         else:
             mesh = keyed.env.mesh
 

@@ -183,45 +183,10 @@ class SessionWindowOperator(StreamOperator):
                 if not slots.size:
                     return late_out
 
-        # ---- vectorized batch-local sessionization
-        order = np.lexsort((ts, slots))
-        s_slots, s_ts = slots[order], ts[order]
-        lifted = jax.tree_util.tree_leaves(self.agg.lift(values))
-        lifted = [np.asarray(l)[order] for l in lifted]
-        new_key = np.concatenate([[True], s_slots[1:] != s_slots[:-1]])
-        # break when the next record's window [t, t+gap) does NOT overlap the
-        # previous one's — records exactly ``gap`` apart stay separate, same
-        # boundary as the interval-overlap merge below and the reference's
-        # TimeWindow.intersects (maxTimestamp = end - 1)
-        gap_break = np.concatenate([[True],
-                                    (s_ts[1:] - s_ts[:-1]) >= self.gap])
-        sess_first = new_key | gap_break
-        sess_id = np.cumsum(sess_first) - 1          # batch-local session id
-        n_sess = int(sess_id[-1]) + 1
-        firsts = np.nonzero(sess_first)[0]
-        lasts = np.concatenate([firsts[1:] - 1, [len(s_ts) - 1]])
-        b_key = s_slots[firsts]
-        b_start = s_ts[firsts]
-        b_end = s_ts[lasts] + self.gap               # exclusive end
-
-        # fold values per batch-local session (vectorized fast path)
-        accs = [np.empty((n_sess,) + sh, dt) for sh, dt in
-                zip(self.spec.leaf_shapes, self.spec.leaf_dtypes)]
-        for a, init in zip(accs, self.spec.leaf_inits):
-            a[:] = init
-        if self.kinds is not None:
-            from flink_tpu.core.functions import SCATTER_UFUNCS
-            for a, l, kind in zip(accs, lifted, self.kinds):
-                SCATTER_UFUNCS[kind].at(a, sess_id, l.astype(a.dtype))
-        else:
-            for i, b in enumerate(firsts):
-                e = int(lasts[i]) + 1
-                acc = tuple(a[i] for a in accs)
-                for j in range(b, e):
-                    acc = tuple(np.asarray(x) for x in self.agg.combine_leaves(
-                        acc, tuple(l[j] for l in lifted)))
-                for a, v in zip(accs, acc):
-                    a[i] = v
+        # ---- vectorized batch-local sessionization + fold (the mesh
+        # subclass reroutes the FOLD through the device exchange)
+        b_key, b_start, b_end, accs = self._sessionize(slots, ts, values)
+        n_sess = b_key.size
 
         # ---- host merge of batch sessions into the per-key interval sets
         st = self.store
@@ -266,6 +231,61 @@ class SessionWindowOperator(StreamOperator):
             out.extend(self._emit_rows(rows))
             st.fired[rows] = True  # re-fired: don't emit again at next advance
         return out
+
+    # ------------------------------------------------- batch sessionization
+    def _session_bounds(self, slots: np.ndarray, ts: np.ndarray):
+        """Sort by (key slot, ts) and find batch-local session boundaries:
+        a new session starts on key change or when the next record's window
+        [t, t+gap) does NOT overlap the previous one's — records exactly
+        ``gap`` apart stay separate, same boundary as the interval-overlap
+        merge and the reference's ``TimeWindow.intersects`` (maxTimestamp =
+        end - 1).  Returns (order, s_slots, s_ts, sess_id, firsts, lasts)
+        with the sorted arrays included (callers need them too)."""
+        order = np.lexsort((ts, slots))
+        s_slots, s_ts = slots[order], ts[order]
+        new_key = np.concatenate([[True], s_slots[1:] != s_slots[:-1]])
+        gap_break = np.concatenate([[True],
+                                    (s_ts[1:] - s_ts[:-1]) >= self.gap])
+        sess_first = new_key | gap_break
+        sess_id = np.cumsum(sess_first) - 1          # batch-local session id
+        firsts = np.nonzero(sess_first)[0]
+        lasts = np.concatenate([firsts[1:] - 1, [len(s_ts) - 1]])
+        return order, s_slots, s_ts, sess_id, firsts, lasts
+
+    def _sessionize(self, slots: np.ndarray, ts: np.ndarray, values):
+        """(b_key, b_start, b_end, acc leaf list) for this batch's local
+        sessions — host fold (``ufunc.reduceat`` over the sorted runs for
+        declared kinds, per-segment combine otherwise)."""
+        order, s_slots, s_ts, sess_id, firsts, lasts = \
+            self._session_bounds(slots, ts)
+        lifted = jax.tree_util.tree_leaves(self.agg.lift(values))
+        lifted = [np.asarray(l)[order] for l in lifted]
+        n_sess = int(firsts.size)
+        b_key = s_slots[firsts]
+        b_start = s_ts[firsts]
+        b_end = s_ts[lasts] + self.gap               # exclusive end
+
+        accs = [np.empty((n_sess,) + sh, dt) for sh, dt in
+                zip(self.spec.leaf_shapes, self.spec.leaf_dtypes)]
+        for a, init in zip(accs, self.spec.leaf_inits):
+            a[:] = init
+        if self.kinds is not None:
+            from flink_tpu.core.functions import SCATTER_UFUNCS
+            # rows are session-contiguous after the sort: one reduceat per
+            # leaf folds every session (ufunc.at is ~50x slower)
+            for a, l, kind in zip(accs, lifted, self.kinds):
+                a[:] = SCATTER_UFUNCS[kind].reduceat(
+                    l.astype(a.dtype, copy=False), firsts, axis=0)
+        else:
+            for i, b in enumerate(firsts):
+                e = int(lasts[i]) + 1
+                acc = tuple(a[i] for a in accs)
+                for j in range(b, e):
+                    acc = tuple(np.asarray(x) for x in self.agg.combine_leaves(
+                        acc, tuple(l[j] for l in lifted)))
+                for a, v in zip(accs, acc):
+                    a[i] = v
+        return b_key, b_start, b_end, accs
 
     # ------------------------------------------------------------- firing
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:

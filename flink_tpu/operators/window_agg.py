@@ -703,6 +703,8 @@ class WindowAggOperator(StreamOperator):
     def _finish_gather_fire(self, window_id: int, idx: np.ndarray, handle,
                             treedef) -> List[StreamElement]:
         fetched = _fetch_collect(handle)
+        self.phase_bytes["d2h"] = self.phase_bytes.get("d2h", 0) + \
+            sum(f.nbytes for f in fetched)
         n = idx.size
         picked = jax.tree_util.tree_unflatten(
             treedef, [r[:n] for r in fetched])
@@ -879,8 +881,13 @@ class WindowAggOperator(StreamOperator):
             for p in touched.tolist():
                 w0, w1 = self.assigner.windows_of_pane(int(p))
                 for w in range(w0, w1 + 1):
+                    max_ts = self.assigner.window_bounds(w).max_timestamp
+                    # only windows whose OWN cleanup horizon is still open:
+                    # a sliding pane can outlive an early covering window
+                    # the reference would already have purged
                     if (w <= self.last_fired_window
-                            and self.assigner.window_bounds(w).max_timestamp <= self.watermark):
+                            and max_ts <= self.watermark
+                            and max_ts + self.lateness > self.watermark):
                         refire.append(w)
             for w in sorted(set(refire)):
                 out.extend(self._fire_window(w))
@@ -1151,6 +1158,9 @@ class WindowAggOperator(StreamOperator):
         if idx.size == 0:
             return []
         res_np = jax.tree_util.tree_map(lambda a: np.asarray(a)[idx], result)
+        self.phase_bytes["d2h"] = self.phase_bytes.get("d2h", 0) + \
+            mask_np.nbytes + sum(a.nbytes for a in
+                                 jax.tree_util.tree_leaves(result))
         return self._rows_for(idx, res_np, window)
 
     # ------------------------------------------------------------- snapshots

@@ -41,6 +41,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from flink_tpu.operators.session_window import SessionWindowOperator
 from flink_tpu.operators.window_agg import WindowAggOperator, _next_pow2
 from flink_tpu.ops.scatter import scatter_fast, scatter_generic
 from flink_tpu.parallel.mesh import KG_AXIS, make_mesh, state_sharding
@@ -206,3 +207,149 @@ class MeshWindowAggOperator(WindowAggOperator):
 
         newK = _next_pow2(max(needed, self.n_shards), self._K)
         return newK * self.n_shards // math.gcd(newK, self.n_shards)
+
+
+class MeshSessionWindowOperator(SessionWindowOperator):
+    """Session windows over a device mesh (VERDICT r2 #2).
+
+    Split of responsibilities — the reference's merging-window path
+    (``MergingWindowSet.java:62``, ``WindowOperator.java:311-411``) with the
+    TPU-first layering of SURVEY §7.3 "Sessions":
+
+    - **Merge decisions stay on the host** (data-dependent control flow —
+      interval-set bookkeeping per key, exactly the ``MergingWindowSet``
+      role), inherited unchanged from ``SessionWindowOperator``.
+    - **The per-batch value FOLD rides the mesh**: the host sessionizes the
+      batch (sort + gap breaks — it needs the boundaries for its merge
+      anyway), assigns each batch-local session to the shard owning its key
+      (``slot % D``), and ships (dest, local session id, values) through one
+      ``shard_map`` step: bucket → ``all_to_all`` over ICI → per-shard
+      ``segment_sum``/``min``/``max`` — the "device segment merge kernels".
+      Only the folded per-session accumulators come back (orders of
+      magnitude smaller than the rows).
+    - Snapshots stay the base class's raw-key row format — mesh-size
+      independent, rescale/split/merge logic reused verbatim.
+
+    Requires declared scatter kinds (add/min/max); generic combines fall
+    back to the host fold, which is still shard-partitioned state-wise.
+    """
+
+    def __init__(self, *args, mesh: Optional[Mesh] = None,
+                 n_devices: Optional[int] = None, **kwargs):
+        if mesh is None:
+            mesh = make_mesh(n_devices)
+        self.mesh = mesh
+        self.n_shards = int(mesh.devices.size)
+        super().__init__(*args, **kwargs)
+        self._row_sharding = NamedSharding(mesh, P(KG_AXIS))
+        self._values_treedef = None
+
+    # ------------------------------------------------------------ device op
+    @partial(jax.jit, static_argnums=(0, 2, 3))
+    def _mesh_fold_step(self, batch, cap: int, cap_sess: int):
+        """One sharded fold: per-device bucket rows by destination shard →
+        ``all_to_all`` over ICI → per-shard segment combine keyed by the
+        (host-assigned) shard-local session id.  ``batch`` = (dest, sid,
+        *value_leaves), each row-split over the mesh; returns
+        ``[D * cap_sess, *leaf]`` folded accumulators (shard-major)."""
+        D = self.n_shards
+
+        def step(dest, sid, *values):
+            B = dest.shape[0]
+            order = jnp.argsort(dest)
+            sdest = dest[order]
+            idx_in = jnp.arange(B) - jnp.searchsorted(sdest, sdest,
+                                                      side="left")
+            flat = jnp.where(idx_in < cap, sdest * cap + idx_in, D * cap)
+
+            def bucket(a, fill):
+                buf = jnp.full((D * cap,) + a.shape[1:], fill, a.dtype)
+                return buf.at[flat].set(a[order], mode="drop").reshape(
+                    (D, cap) + a.shape[1:])
+
+            a2a = partial(jax.lax.all_to_all, axis_name=KG_AXIS,
+                          split_axis=0, concat_axis=0, tiled=True)
+            rx_sid = a2a(bucket(sid, cap_sess)).reshape(D * cap)
+            rx_vals = tuple(a2a(bucket(v, 0)).reshape((D * cap,) + v.shape[2:])
+                            for v in values)
+            lifted = tuple(jax.tree_util.tree_leaves(
+                self.agg.lift(self._values_tree(rx_vals))))
+            outs = []
+            for l, kind, init in zip(lifted, self.kinds,
+                                     self.spec.leaf_inits):
+                acc = jnp.broadcast_to(
+                    jnp.asarray(init, l.dtype),
+                    (cap_sess,) + l.shape[1:]).copy()
+                if kind == "add":
+                    outs.append(acc.at[rx_sid].add(l, mode="drop"))
+                elif kind == "min":
+                    outs.append(acc.at[rx_sid].min(l, mode="drop"))
+                else:
+                    outs.append(acc.at[rx_sid].max(l, mode="drop"))
+            return tuple(outs)
+
+        nv = len(batch) - 2
+        in_specs = (P(KG_AXIS), P(KG_AXIS)) + (P(KG_AXIS),) * nv
+        out_specs = (P(KG_AXIS),) * self.spec.num_leaves
+        fn = shard_map(step, mesh=self.mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+        return fn(*batch)
+
+    def _values_tree(self, flat_values):
+        return jax.tree_util.tree_unflatten(self._values_treedef,
+                                            list(flat_values))
+
+    # ------------------------------------------------------------ host side
+    def _sessionize(self, slots, ts, values):
+        if self.kinds is None:
+            return super()._sessionize(slots, ts, values)  # host fold
+        order, s_slots, s_ts, sess_id, firsts, lasts = \
+            self._session_bounds(slots, ts)
+        n_sess = int(firsts.size)
+        b_key = s_slots[firsts]
+        b_start = s_ts[firsts]
+        b_end = s_ts[lasts] + self.gap
+
+        D = self.n_shards
+        b_dest = (b_key % D).astype(np.int32)
+        # shard-local session numbering (0..n_d-1 per shard)
+        counts = np.bincount(b_dest, minlength=D)
+        base = np.zeros(D, np.int64)
+        base[1:] = np.cumsum(counts)[:-1]
+        sess_order = np.argsort(b_dest, kind="stable")
+        b_local = np.empty(n_sess, np.int64)
+        b_local[sess_order] = np.arange(n_sess) - base[b_dest[sess_order]]
+        cap_sess = _quantize(int(counts.max()))
+
+        # per-row routing labels (rows in sorted order)
+        row_dest = b_dest[sess_id]
+        row_sid = b_local[sess_id].astype(np.int32)
+        vleaves, self._values_treedef = jax.tree_util.tree_flatten(values)
+        vleaves = [np.asarray(v)[order] for v in vleaves]
+
+        # pad rows to a multiple of D; pad rows carry sid = cap_sess (the
+        # segment scatter drops them)
+        B = row_dest.size
+        Bp = -(-_quantize(-(-B // D) * D, D) // D) * D
+
+        def pad(a, fill, dtype):
+            out = np.full((Bp,) + a.shape[1:], fill, dtype)
+            out[:B] = a[:B]
+            return out
+
+        dest_p = pad(row_dest, 0, np.int32)
+        dest_p[B:] = np.arange(Bp - B) % D
+        sid_p = pad(row_sid, cap_sess, np.int32)
+        src = np.repeat(np.arange(D), Bp // D)
+        per_pair = np.bincount(src * D + dest_p, minlength=D * D)
+        cap = _quantize(int(per_pair.max()))
+
+        put = lambda a: jax.device_put(a, self._row_sharding)  # noqa: E731
+        batch = (put(dest_p), put(sid_p),
+                 *(put(pad(v, 0, v.dtype)) for v in vleaves))
+        folded = self._mesh_fold_step(batch, cap, cap_sess)
+        # gather each session's folded acc from its shard block
+        flat_idx = b_dest.astype(np.int64) * cap_sess + b_local
+        accs = [np.asarray(l)[flat_idx].astype(dt, copy=False)
+                for l, dt in zip(folded, self.spec.leaf_dtypes)]
+        return b_key, b_start, b_end, accs

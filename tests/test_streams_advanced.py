@@ -577,3 +577,105 @@ def test_valve_idle_refoward_after_reactivation():
     v.input_watermark(0, 100)            # reactivates channel 0
     _, combined, changed = v.status_update(0, True)
     assert combined and changed          # must re-forward idle
+
+
+def test_evicting_sliding_windows_share_pane_buffers():
+    """Sliding assigners on the raw-element path: each row is buffered once
+    per pane yet appears in every covering window's apply()."""
+    import numpy as np
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.evicting_window import EvictingWindowOperator
+    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+
+    op = EvictingWindowOperator(
+        SlidingEventTimeWindows.of(100, 50), None, "k",
+        lambda k, w, rows: {"k": k, "n": len(rows),
+                            "ws": w.start, "s": sum(r["v"] for r in rows)})
+    op.open(RuntimeContext())
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([1, 1, 1]), "v": np.array([1.0, 2.0, 4.0])},
+        timestamps=np.array([10, 60, 110])))
+    out += op.process_watermark(Watermark(250))
+    rows = sorted((int(r["ws"]), int(r["n"]), float(r["s"]))
+                  for b in out if hasattr(b, "columns") for r in b.to_rows())
+    # windows [-50,50): v=1; [0,100): 1+2; [50,150): 2+4; [100,200): 4
+    assert rows == [(-50, 1, 1.0), (0, 2, 3.0), (50, 2, 6.0), (100, 1, 4.0)]
+    # one buffered copy per pane: 3 rows total across pane chunks
+    assert sum(c[0].size for chunks in op._panes.values()
+               for c in chunks) <= 3
+
+
+def test_evicting_window_late_refire_and_beyond_lateness_drop():
+    import numpy as np
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.evicting_window import EvictingWindowOperator
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    op = EvictingWindowOperator(
+        TumblingEventTimeWindows.of(100), None, "k",
+        lambda k, w, rows: {"k": k, "s": sum(r["v"] for r in rows)},
+        allowed_lateness_ms=100)
+    op.open(RuntimeContext())
+    op.process_batch(RecordBatch({"k": np.array([1]),
+                                  "v": np.array([5.0])},
+                                 timestamps=np.array([10])))
+    out = op.process_watermark(Watermark(120))      # window 0 fires
+    assert [float(r["s"]) for b in out if hasattr(b, "columns")
+            for r in b.to_rows()] == [5.0]
+    # late within lateness: window 0 RE-fires with the merged content
+    out = op.process_batch(RecordBatch({"k": np.array([1]),
+                                        "v": np.array([2.0])},
+                                       timestamps=np.array([50])))
+    assert [float(r["s"]) for b in out if hasattr(b, "columns")
+            for r in b.to_rows()] == [7.0]
+    # beyond lateness (cleanup = 99 + 100 <= wm): dropped + counted
+    op.process_watermark(Watermark(250))
+    op.process_batch(RecordBatch({"k": np.array([1]),
+                                  "v": np.array([9.0])},
+                                 timestamps=np.array([20])))
+    assert op.late_dropped == 1
+
+
+def test_evicting_window_snapshot_restore_and_keygroup_rescale():
+    import numpy as np
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.core.functions import RuntimeContext
+    from flink_tpu.operators.evicting_window import EvictingWindowOperator
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    def mk():
+        op = EvictingWindowOperator(
+            TumblingEventTimeWindows.of(100), None, "k",
+            lambda k, w, rows: {"k": k, "s": sum(r["v"] for r in rows)})
+        op.open(RuntimeContext())
+        return op
+
+    op = mk()
+    keys = np.arange(20)
+    op.process_batch(RecordBatch({"k": keys,
+                                  "v": np.ones(20)},
+                                 timestamps=np.full(20, 10)))
+    snap = op.snapshot_state()
+
+    # plain restore finishes the window
+    op2 = mk()
+    op2.restore_state(snap)
+    out = op2.process_watermark(Watermark(150))
+    got = sorted(int(r["k"]) for b in out if hasattr(b, "columns")
+                 for r in b.to_rows())
+    assert got == sorted(int(k) for k in keys)
+
+    # rescale: split into 4, every row lands in exactly one part
+    parts = EvictingWindowOperator.split_snapshot(snap, 128, 4)
+    total = sum(p0["seq"].size for part in parts
+                for p0 in part["panes"].values())
+    assert total == 20
+    merged = EvictingWindowOperator.merge_snapshots(parts)
+    op3 = mk()
+    op3.restore_state(merged)
+    out = op3.process_watermark(Watermark(150))
+    got = sorted(int(r["k"]) for b in out if hasattr(b, "columns")
+                 for r in b.to_rows())
+    assert got == sorted(int(k) for k in keys)
