@@ -58,6 +58,9 @@ class _SessionStore:
         self.fired = np.zeros(0, bool)        # fired but retained (lateness)
         self.leaves = [np.zeros((0,) + s, d)
                        for s, d in zip(spec.leaf_shapes, spec.leaf_dtypes)]
+        #: row -> distinct-value set (DISTINCT aggregates only; the
+        #: reference's distinct-state MapView per window namespace)
+        self.sets: List[Optional[set]] = []
         self.by_key: Dict[int, List[int]] = {}
         self._free: List[int] = []
 
@@ -74,6 +77,7 @@ class _SessionStore:
         self.leaves = [gr(l) for l in self.leaves]
         for i, init in enumerate(self.spec.leaf_inits):
             self.leaves[i][old:] = init
+        self.sets.extend([None] * (cap - old))
         self._free.extend(range(cap - 1, old - 1, -1))
 
     def alloc(self) -> int:
@@ -86,6 +90,7 @@ class _SessionStore:
         self.fired[row] = False
         for leaf, init in zip(self.leaves, self.spec.leaf_inits):
             leaf[row] = init
+        self.sets[row] = None
         self._free.append(row)
 
     def acc_of(self, row: int) -> Tuple[np.ndarray, ...]:
@@ -107,7 +112,9 @@ class SessionWindowOperator(StreamOperator):
                  output_column: str = "result",
                  emit_window_bounds: bool = True,
                  name: str = "session-window-agg",
-                 late_output_tag: Optional[str] = None):
+                 late_output_tag: Optional[str] = None,
+                 distinct_specs: Optional[Dict[str, str]] = None,
+                 distinct_column: Optional[str] = None):
         #: sideOutputLateData: beyond-lateness records ship as TaggedBatch
         #: instead of dropping (the drop counter stays untouched for them)
         self.gap = int(session.gap_ms)
@@ -129,6 +136,13 @@ class SessionWindowOperator(StreamOperator):
         self.key_index: Optional[KeyIndex | ObjectKeyIndex] = None
         self.store = _SessionStore(self.spec)
         self.late_output_tag = late_output_tag
+        #: DISTINCT aggregates over merging windows (the PARITY r2 SESSION
+        #: DISTINCT gap): per-session value SETS ride the interval merge —
+        #: out_name -> func (COUNT/SUM/AVG/MIN/MAX) over ``distinct_column``
+        self.distinct_specs = distinct_specs or {}
+        self.distinct_column = distinct_column
+        if self.distinct_specs and distinct_column is None:
+            raise ValueError("distinct_specs needs distinct_column")
         self.watermark: int = LONG_MIN
         self._proc_time: int = LONG_MIN
         self.late_dropped: int = 0
@@ -185,8 +199,13 @@ class SessionWindowOperator(StreamOperator):
 
         # ---- vectorized batch-local sessionization + fold (the mesh
         # subclass reroutes the FOLD through the device exchange)
-        b_key, b_start, b_end, accs = self._sessionize(slots, ts, values)
+        bounds = (self._session_bounds(slots, ts)
+                  if self.distinct_specs else None)
+        b_key, b_start, b_end, accs = self._sessionize(slots, ts, values,
+                                                       bounds)
         n_sess = b_key.size
+        bsets = (self._batch_distinct_sets(values, bounds)
+                 if self.distinct_specs else None)
 
         # ---- host merge of batch sessions into the per-key interval sets
         st = self.store
@@ -195,6 +214,7 @@ class SessionWindowOperator(StreamOperator):
             k = int(b_key[i])
             start, end = int(b_start[i]), int(b_end[i])
             acc = tuple(a[i] for a in accs)
+            dset = set(bsets[i]) if bsets is not None else None
             rows = st.by_key.get(k)
             if rows is None:
                 rows = []
@@ -206,6 +226,8 @@ class SessionWindowOperator(StreamOperator):
                 if st.start[r] < end and start < st.end[r]:
                     acc = tuple(np.asarray(x) for x in self.agg.combine_leaves(
                         st.acc_of(r), acc))
+                    if dset is not None and st.sets[r]:
+                        dset |= st.sets[r]
                     start = min(start, int(st.start[r]))
                     end = max(end, int(st.end[r]))
                     # merging a fired (or refire-pending) session → re-fire
@@ -219,6 +241,7 @@ class SessionWindowOperator(StreamOperator):
             st.active[row] = True
             st.fired[row] = False
             st.set_acc(row, acc)
+            st.sets[row] = dset
             survivors.append(row)
             st.by_key[k] = survivors
             if absorbed_fired and self.is_event_time \
@@ -252,12 +275,15 @@ class SessionWindowOperator(StreamOperator):
         lasts = np.concatenate([firsts[1:] - 1, [len(s_ts) - 1]])
         return order, s_slots, s_ts, sess_id, firsts, lasts
 
-    def _sessionize(self, slots: np.ndarray, ts: np.ndarray, values):
+    def _sessionize(self, slots: np.ndarray, ts: np.ndarray, values,
+                    bounds=None):
         """(b_key, b_start, b_end, acc leaf list) for this batch's local
         sessions — host fold (``ufunc.reduceat`` over the sorted runs for
-        declared kinds, per-segment combine otherwise)."""
+        declared kinds, per-segment combine otherwise).  ``bounds``: the
+        precomputed ``_session_bounds`` result (avoids a second sort when
+        the caller needed it too)."""
         order, s_slots, s_ts, sess_id, firsts, lasts = \
-            self._session_bounds(slots, ts)
+            bounds if bounds is not None else self._session_bounds(slots, ts)
         lifted = jax.tree_util.tree_leaves(self.agg.lift(values))
         lifted = [np.asarray(l)[order] for l in lifted]
         n_sess = int(firsts.size)
@@ -286,6 +312,13 @@ class SessionWindowOperator(StreamOperator):
                 for a, v in zip(accs, acc):
                     a[i] = v
         return b_key, b_start, b_end, accs
+
+    def _batch_distinct_sets(self, values, bounds) -> List[set]:
+        """Per batch-local session: the SET of distinct-column values
+        (``bounds`` = the shared ``_session_bounds`` result)."""
+        order, _ss, _st, _sid, firsts, lasts = bounds
+        dv = np.asarray(values[self.distinct_column])[order]
+        return [set(dv[f:l + 1].tolist()) for f, l in zip(firsts, lasts)]
 
     # ------------------------------------------------------------- firing
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
@@ -339,6 +372,21 @@ class SessionWindowOperator(StreamOperator):
             cols.update({k: np.asarray(v) for k, v in result.items()})
         else:
             cols[self.output_column] = np.asarray(result)
+        for out, func in self.distinct_specs.items():
+            vals = []
+            for r in rows.tolist():
+                s = st.sets[r] or ()
+                if func == "COUNT":
+                    vals.append(len(s))
+                elif func == "SUM":
+                    vals.append(float(sum(s)))
+                elif func == "AVG":
+                    vals.append(float(sum(s)) / len(s) if s else 0.0)
+                elif func == "MIN":
+                    vals.append(min(s) if s else np.nan)
+                else:
+                    vals.append(max(s) if s else np.nan)
+            cols[out] = np.asarray(vals)
         if self.emit_window_bounds:
             cols["window_start"] = st.start[rows].copy()
             cols["window_end"] = st.end[rows].copy()
@@ -351,7 +399,7 @@ class SessionWindowOperator(StreamOperator):
         live = np.nonzero(st.active)[0]
         raw = (np.asarray(self.key_index.reverse_keys())[st.key_slot[live]]
                if self.key_index is not None else np.zeros(0, np.int64))
-        return {
+        snap = {
             "session_keys": raw,                  # raw keys → rescale-safe
             "start": st.start[live].copy(),
             "end": st.end[live].copy(),
@@ -360,6 +408,10 @@ class SessionWindowOperator(StreamOperator):
             "watermark": self.watermark,
             "late_dropped": self.late_dropped,
         }
+        if self.distinct_specs:
+            snap["sets"] = [sorted(st.sets[r]) if st.sets[r] else []
+                            for r in live.tolist()]
+        return snap
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         keys = np.asarray(snap["session_keys"])
@@ -386,6 +438,8 @@ class SessionWindowOperator(StreamOperator):
         ends = np.asarray(snap["end"])[sel]
         fireds = np.asarray(snap["fired"])[sel]
         accs = tuple(np.asarray(a)[sel] for a in snap["acc"])
+        sets = ([snap["sets"][i] for i in sel.tolist()]
+                if "sets" in snap else None)
         self.key_index = make_key_index(keys[0])
         slots = self.key_index.lookup_or_insert(keys).astype(np.int64)
         st = self.store
@@ -396,6 +450,8 @@ class SessionWindowOperator(StreamOperator):
             st.fired[row] = fireds[i]
             st.active[row] = True
             st.set_acc(row, tuple(a[i] for a in accs))
+            if sets is not None:
+                st.sets[row] = set(sets[i]) if sets[i] else None
             st.by_key.setdefault(int(slots[i]), []).append(row)
 
     @staticmethod
@@ -412,6 +468,11 @@ class SessionWindowOperator(StreamOperator):
         merged["acc"] = tuple(
             np.concatenate([np.asarray(s["acc"][i]) for s in live])
             for i in range(len(live[0]["acc"])))
+        if any("sets" in s for s in live):
+            merged["sets"] = [x for s in live
+                              for x in s.get(
+                                  "sets",
+                                  [[]] * len(np.asarray(s["session_keys"])))]
         merged["watermark"] = max(int(s.get("watermark", LONG_MIN))
                                   for s in live)
         merged["late_dropped"] = sum(int(s.get("late_dropped", 0))
@@ -436,6 +497,9 @@ class SessionWindowOperator(StreamOperator):
             for f in ("start", "end", "fired"):
                 sub[f] = np.asarray(snap[f])[sel]
             sub["acc"] = tuple(np.asarray(a)[sel] for a in snap["acc"])
+            if "sets" in snap:
+                sub["sets"] = [snap["sets"][j]
+                               for j in np.nonzero(sel)[0].tolist()]
             if i > 0:
                 # job-level counter: carried by part 0 only, or a later
                 # merge_snapshots would sum it new_parallelism times

@@ -793,17 +793,22 @@ class OverAggSpec:
     picks per-row vs peer-inclusive semantics for unbounded frames
     (``RowTimeRowsUnboundedPrecedingFunction`` vs ``RowTimeRange...``)."""
 
-    __slots__ = ("out_name", "func", "in_col", "rows", "range_ms", "is_rows")
+    __slots__ = ("out_name", "func", "in_col", "rows", "range_ms", "is_rows",
+                 "distinct")
 
     def __init__(self, out_name: str, func: str, in_col: Optional[str],
                  rows: Optional[int] = None, range_ms: Optional[int] = None,
-                 is_rows: bool = False):
+                 is_rows: bool = False, distinct: bool = False):
         self.out_name = out_name
         self.func = func
         self.in_col = in_col
         self.rows = rows
         self.range_ms = range_ms
         self.is_rows = is_rows
+        #: agg(DISTINCT x) over an UNBOUNDED frame: only each value's FIRST
+        #: occurrence per partition contributes (SUM/COUNT/AVG); MIN/MAX are
+        #: distinct-invariant
+        self.distinct = distinct
 
 
 def _sliding_window(padded: np.ndarray, width: int) -> np.ndarray:
@@ -841,6 +846,9 @@ class OverAggregateOperator(StreamOperator):
         self._accs: List[Dict[Any, Any]] = [dict() for _ in specs]
         # spec index -> key -> (ts_buf, val_buf) tail kept for bounded frames
         self._tails: List[Dict[Any, Any]] = [dict() for _ in specs]
+        # DISTINCT specs: spec index -> key -> set of values already seen
+        # (the reference's distinct-state MapView)
+        self._seen: List[Dict[Any, set]] = [dict() for _ in specs]
         self._last_wm = LONG_MIN
         self._dropped_late = 0
 
@@ -924,18 +932,40 @@ class OverAggregateOperator(StreamOperator):
             elif spec.range_ms is not None:
                 cols[spec.out_name] = self._range_frame(i, spec, key, ts, vals)
             else:
-                cols[spec.out_name] = self._unbounded(i, spec, key, ts, vals)
+                first = (self._first_occurrence(i, key, vals)
+                         if spec.distinct and spec.func not in ("MIN", "MAX")
+                         else None)
+                cols[spec.out_name] = self._unbounded(i, spec, key, ts, vals,
+                                                      first)
         return [RecordBatch(cols, batch.timestamps, batch.key_ids,
                             batch.key_groups)]
 
-    def _unbounded(self, i: int, spec: OverAggSpec, key: Any, ts, vals):
+    def _first_occurrence(self, i: int, key: Any,
+                          vals: np.ndarray) -> np.ndarray:
+        """bool mask: row carries the FIRST occurrence of its value in this
+        partition (across flushes, via the per-spec seen set)."""
+        seen = self._seen[i].setdefault(key, set())
+        uniq, first_idx = np.unique(vals, return_index=True)
+        novel = np.asarray([v not in seen for v in uniq.tolist()])
+        seen.update(uniq[novel].tolist())
+        mask = np.zeros(len(vals), bool)
+        mask[first_idx[novel]] = True
+        return mask
+
+    def _unbounded(self, i: int, spec: OverAggSpec, key: Any, ts, vals,
+                   first: Optional[np.ndarray] = None):
         """UNBOUNDED PRECEDING: running accumulator carried across flushes;
-        RANGE flavor gives every peer group (equal ts) the group's total."""
+        RANGE flavor gives every peer group (equal ts) the group's total.
+        ``first`` (DISTINCT): only first-occurrence rows contribute."""
         func = spec.func
         if func in ("SUM", "AVG", "COUNT"):
+            if first is not None:
+                vals = np.where(first, vals, 0.0)
             ps, pc = self._accs[i].get(key, (0.0, 0))
             cum_s = ps + np.cumsum(vals)
-            cum_c = pc + np.arange(1, len(vals) + 1, dtype=np.int64)
+            cum_c = pc + (np.cumsum(first).astype(np.int64)
+                          if first is not None
+                          else np.arange(1, len(vals) + 1, dtype=np.int64))
             self._accs[i][key] = (float(cum_s[-1]), int(cum_c[-1]))
         elif func == "MIN":
             prev = self._accs[i].get(key, np.inf)
@@ -1024,6 +1054,8 @@ class OverAggregateOperator(StreamOperator):
         return {"pending": {k: pack(v) for k, v in self._pending.items()},
                 "accs": [dict(d) for d in self._accs],
                 "tails": [dict(d) for d in self._tails],
+                "seen": [{k: sorted(s) for k, s in d.items()}
+                         for d in self._seen],
                 "last_wm": self._last_wm,
                 "dropped_late": self._dropped_late}
 
@@ -1034,6 +1066,9 @@ class OverAggregateOperator(StreamOperator):
             "accs", [dict() for _ in self.specs])]
         self._tails = [dict(d) for d in snap.get(
             "tails", [dict() for _ in self.specs])]
+        self._seen = [{k: set(s) for k, s in d.items()}
+                      for d in snap.get("seen",
+                                        [dict() for _ in self.specs])]
         self._last_wm = snap.get("last_wm", LONG_MIN)
         self._dropped_late = snap.get("dropped_late", 0)
 

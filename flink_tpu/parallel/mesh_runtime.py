@@ -300,11 +300,17 @@ class MeshSessionWindowOperator(SessionWindowOperator):
                                             list(flat_values))
 
     # ------------------------------------------------------------ host side
-    def _sessionize(self, slots, ts, values):
+    def _sessionize(self, slots, ts, values, bounds=None):
         if self.kinds is None:
-            return super()._sessionize(slots, ts, values)  # host fold
+            return super()._sessionize(slots, ts, values, bounds)  # host fold
+        if self.distinct_column is not None and isinstance(values, dict):
+            # the distinct column only feeds the HOST-side value sets
+            # (_batch_distinct_sets); never ship it through the exchange
+            # (string/object dtypes cannot ride the device anyway)
+            values = {k: v for k, v in values.items()
+                      if k != self.distinct_column}
         order, s_slots, s_ts, sess_id, firsts, lasts = \
-            self._session_bounds(slots, ts)
+            bounds if bounds is not None else self._session_bounds(slots, ts)
         n_sess = int(firsts.size)
         b_key = s_slots[firsts]
         b_start = s_ts[firsts]

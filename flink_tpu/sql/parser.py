@@ -159,6 +159,9 @@ class JoinClause:
     alias: Optional[str]
     kind: str          # inner / left / right / full
     on: Expr
+    #: set by the rewrite stage (rules.filter_pushdown): predicate applied
+    #: to THIS input before the join (bare column names)
+    pre_filter: Optional[Expr] = None
 
 
 @dataclass
@@ -172,6 +175,10 @@ class SelectStmt:
     having: Optional[Expr] = None
     order_by: List[Tuple[Expr, bool]] = field(default_factory=list)  # (expr, asc)
     limit: Optional[int] = None
+    #: rewrite-stage annotations (rules.py): predicate pushed onto the base
+    #: scan, and the pruned column set the scan should project to
+    scan_filter: Optional[Expr] = None
+    scan_columns: Optional[Tuple[str, ...]] = None
 
 
 #: aggregate function names the planner splits out of expressions

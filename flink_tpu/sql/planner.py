@@ -84,6 +84,14 @@ def _transform(expr: Expr, fn: Callable[[Expr], Optional[Expr]]) -> Expr:
         return Binary(expr.op, rec(expr.left), rec(expr.right))
     if isinstance(expr, Call):
         return Call(expr.name, tuple(rec(a) for a in expr.args), expr.distinct)
+    if isinstance(expr, OverCall):
+        return OverCall(
+            expr.func,
+            rec(expr.partition_by) if expr.partition_by is not None else None,
+            rec(expr.order_by) if expr.order_by is not None else None,
+            expr.ascending, tuple(rec(a) for a in expr.args),
+            expr.frame_rows, expr.frame_range_ms, expr.frame_is_rows,
+            expr.distinct)
     if isinstance(expr, Cast):
         return Cast(rec(expr.expr), expr.type_name)
     if isinstance(expr, Case):
@@ -340,9 +348,15 @@ class Planner:
         self.env = env
         self.catalog = catalog
         self.mini_batch_rows = mini_batch_rows
+        #: rewrite-rule applications (rules.py), surfaced by EXPLAIN
+        self.applied_rules: List[str] = []
 
     def plan(self, stmt) -> QueryPlan:
         from flink_tpu.sql.parser import UnionStmt
+        from flink_tpu.sql.rules import apply_rules
+
+        # ---- logical rewrite stage (PlannerBase.translate's optimize step)
+        stmt = apply_rules(stmt, self.catalog, self.applied_rules)
 
         if isinstance(stmt, UnionStmt):
             return self._plan_union(stmt)
@@ -363,6 +377,15 @@ class Planner:
             alias = stmt.table_alias or stmt.table
             qual_map = {(alias, c): c for c in table.columns}
             stmt = _rewrite_qualified(stmt, qual_map)
+            if stmt.scan_columns is not None:
+                # projection_prune rule: drop unreferenced columns at the
+                # scan, before any operator carries them
+                keep = tuple(stmt.scan_columns)
+                stream = stream.map(
+                    lambda cols, _k=keep: {c: cols[c] for c in _k},
+                    name=f"sql-scan-prune[{','.join(keep)}]")
+                table = replace(table, columns=list(keep)) \
+                    if hasattr(table, "__dataclass_fields__") else table
         schema = dict.fromkeys(table.columns)
 
         # ---- expand * and split aggregates out of SELECT / HAVING
@@ -433,10 +456,9 @@ class Planner:
         independently, columns align BY POSITION to the first branch's
         names, distinct unions dedup full rows (the two-input
         ``StreamExecUnion`` + dedup lowering)."""
-        if any(stmt.alls) and not all(stmt.alls):
-            raise PlanError("mixing UNION and UNION ALL in one chain is "
-                            "not supported (semantics differ per position); "
-                            "use all-ALL or all-distinct")
+        # mixed UNION/UNION ALL chains were restructured into nested
+        # homogeneous unions by rules.union_associativity before lowering
+        assert len(set(stmt.alls)) <= 1, "rewrite stage must run first"
         plans = [self.plan(p) for p in stmt.parts]
         base_cols = plans[0].output_columns
         streams = [plans[0].stream]
@@ -563,9 +585,16 @@ class Planner:
         arg_fns: List[Tuple[str, Any]] = []
         for name, oc in over_specs:
             in_col = None
-            if oc.distinct:
-                raise PlanError(f"{oc.func}(DISTINCT ...) OVER is not "
-                                f"supported")
+            if oc.distinct and (oc.frame_rows is not None
+                                or oc.frame_range_ms is not None):
+                # a value leaving a bounded frame may or may not still be
+                # "distinct-present" (another copy inside) — that needs
+                # per-frame multiset state; unbounded frames only need the
+                # first-occurrence contribution
+                raise PlanError(f"{oc.func}(DISTINCT ...) OVER supports only "
+                                f"unbounded frames (no ROWS/RANGE bound)")
+            if oc.distinct and oc.func == "ROW_NUMBER":
+                raise PlanError("ROW_NUMBER has no DISTINCT form")
             if oc.func == "ROW_NUMBER":
                 if oc.args:
                     raise PlanError("ROW_NUMBER() takes no arguments")
@@ -584,7 +613,8 @@ class Planner:
             specs.append(OverAggSpec(name, oc.func, in_col,
                                      rows=oc.frame_rows,
                                      range_ms=oc.frame_range_ms,
-                                     is_rows=oc.frame_is_rows))
+                                     is_rows=oc.frame_is_rows,
+                                     distinct=oc.distinct))
         if arg_fns:
             def add_args(cols, _af=tuple(arg_fns)):
                 n = _n(cols)
@@ -760,6 +790,11 @@ class Planner:
         from flink_tpu.sql.table_env import CatalogTable
 
         cur_stream = base.stream()
+        if stmt.scan_filter is not None:
+            # filter_pushdown rule: base-side WHERE conjuncts run pre-join
+            cur_stream = self._pre_filter(cur_stream, base.columns,
+                                          stmt.scan_filter,
+                                          f"sql-prejoin-filter:{stmt.table}")
         a0 = stmt.table_alias or stmt.table
         qual_map: Dict[Tuple[str, str], str] = {(a0, c): c
                                                 for c in base.columns}
@@ -785,6 +820,9 @@ class Planner:
             lk, rk = self._resolve_equi_on(jc.on, qual_map, rt, ralias,
                                            left_names)
             rstream = rt.stream()
+            if jc.pre_filter is not None:
+                rstream = self._pre_filter(rstream, rt.columns, jc.pre_filter,
+                                           f"sql-prejoin-filter:{jc.table}")
             t = Transformation(
                 name=f"sql-join:{jc.table}",
                 operator_factory=(lambda _lk=lk, _rk=rk, _how=jc.kind,
@@ -803,6 +841,13 @@ class Planner:
                               stream_factory=lambda env: cur_stream,
                               timestamps_assigned=False)
         return cur_stream, joined, qual_map, ambiguous
+
+    def _pre_filter(self, stream, columns, pred_expr: Expr, name: str):
+        """Apply a pushed-down predicate (bare column names) to an input."""
+        pred = ExprCompiler(dict.fromkeys(columns)).compile(pred_expr)
+        return stream.filter(
+            lambda cols, _p=pred: np.asarray(to_column(_p(cols), _n(cols)),
+                                             bool), name=name)
 
     def _resolve_equi_on(self, on: Expr, qual_map, right_table, ralias: str,
                          left_names: List[str]) -> Tuple[str, str]:
@@ -885,12 +930,6 @@ class Planner:
         distinct_specs = [s for s in agg_specs if s.distinct]
         plain_specs = [s for s in agg_specs if not s.distinct]
         if distinct_specs:
-            if window is not None and window.kind == "SESSION":
-                raise PlanError(
-                    "DISTINCT aggregates are supported in TUMBLE/HOP "
-                    "windows and non-windowed GROUP BY (not SESSION: "
-                    "merging windows have no stable window identity a "
-                    "row-level dedup key could name)")
             args = {repr(s.arg) for s in distinct_specs}
             if len(args) != 1:
                 raise PlanError("all DISTINCT aggregates in a query must "
@@ -900,6 +939,19 @@ class Planner:
         single_col_key = (len(key_exprs) == 1 and isinstance(key_exprs[0], Column))
         key_col = key_exprs[0].name if single_col_key else "__key"
         emit_bounds = window is not None
+
+        if distinct_specs and window is not None and window.kind == "SESSION":
+            # merging windows have no stable identity a row-level dedup key
+            # could name — instead ONE session operator carries per-session
+            # distinct-value SETS that merge with the intervals
+            # (SessionWindowOperator.distinct_specs, the MapView analog)
+            agg_stream = self._agg_branch(stream, agg_specs, key_exprs,
+                                          key_col, single_col_key, window,
+                                          compiler, None,
+                                          session_distinct=distinct_specs)
+            return self._post_aggregate(agg_stream, items, having, agg_specs,
+                                        key_exprs, single_col_key, key_col,
+                                        emit_bounds, stmt, orig_items)
 
         if distinct_specs and plain_specs:
             a = self._agg_branch(stream, plain_specs, key_exprs, key_col,
@@ -972,9 +1024,12 @@ class Planner:
     def _agg_branch(self, stream, agg_specs: List[AggSpec],
                     key_exprs: List[Expr], key_col: str,
                     single_col_key: bool, window: Optional[WindowSpec],
-                    compiler: ExprCompiler, dedup_arg: Optional[Expr]):
+                    compiler: ExprCompiler, dedup_arg: Optional[Expr],
+                    session_distinct: Optional[List[AggSpec]] = None):
         """One aggregate pipeline: [dedup →] pre-project → key_by → window
-        aggregate, returning the fired-rows stream."""
+        aggregate, returning the fired-rows stream.  ``session_distinct``:
+        DISTINCT specs handled by the session operator's per-session sets
+        (excluded from the ACC pytree)."""
         from flink_tpu.datastream.api import DataStream
 
         if dedup_arg is not None:
@@ -1037,12 +1092,18 @@ class Planner:
         # ---- the aggregate handler: one ACC pytree for all aggregates.
         # The value selector passes ONLY numeric input columns — the update
         # step is jitted, and key/string columns must stay host-side.
+        distinct_names = {s.out_name for s in (session_distinct or [])}
         agg_map: Dict[str, Tuple[str, Any]] = {}
         for s in agg_specs:
+            if s.out_name in distinct_names:
+                continue   # handled by the session operator's value sets
             in_col = s.out_name + "_in" if s.arg is not None else "__ones"
             agg_map[s.out_name] = (in_col, _make_aggregator(s, in_col))
         tuple_agg = TupleAggregator(agg_map)
-        needed = sorted({c for c, _ in agg_map.values()})
+        needed = {c for c, _ in agg_map.values()}
+        if session_distinct:
+            needed.add(session_distinct[0].out_name + "_in")
+        needed = sorted(needed)
         select_values = lambda c, _need=tuple(needed): {k: c[k] for k in _need}  # noqa: E731
 
         if window is None:
@@ -1059,6 +1120,30 @@ class Planner:
             t = keyed._then("sql-group-agg", factory)
             return DataStream(keyed.env, t)
         if window.kind == "SESSION":
+            if session_distinct:
+                from flink_tpu.operators.session_window import (
+                    SessionWindowOperator)
+                assigner = EventTimeSessionWindows(window.size_ms)
+                dspecs = {s.out_name: s.func for s in session_distinct}
+                dcol = session_distinct[0].out_name + "_in"
+                mesh = keyed.env.mesh
+
+                def factory(_a=assigner, _agg=tuple_agg, _k=key_col,
+                            _ds=dspecs, _dc=dcol, _m=mesh):
+                    kwargs = dict(key_column=_k,
+                                  value_selector=select_values,
+                                  name="sql-session-agg",
+                                  distinct_specs=dict(_ds),
+                                  distinct_column=_dc)
+                    if _m is not None:
+                        from flink_tpu.parallel.mesh_runtime import (
+                            MeshSessionWindowOperator)
+                        return MeshSessionWindowOperator(_a, _agg, mesh=_m,
+                                                         **kwargs)
+                    return SessionWindowOperator(_a, _agg, **kwargs)
+
+                t = keyed._then("sql-session-agg", factory, chainable=False)
+                return DataStream(keyed.env, t)
             return keyed.window(
                 EventTimeSessionWindows(window.size_ms)).aggregate(
                     tuple_agg, value_selector=select_values,

@@ -148,11 +148,16 @@ class TableEnvironment:
     def explain_sql(self, sql: str) -> str:
         """Textual physical plan: the vertex/edge list of the stream graph
         the query lowers to (``explainSql`` analog)."""
-        env, plan = self._plan(parse(sql))
+        env, plan, planner = self._plan(parse(sql), return_planner=True)
         plan.stream.collect()   # graph building needs a sink-reachable DAG
         g = env.get_stream_graph("explain")
         ep = g.to_plan()
-        lines = ["== Physical Execution Plan =="]
+        lines = []
+        if planner.applied_rules:
+            seen = dict.fromkeys(planner.applied_rules)  # ordered dedup
+            lines.append("== Logical Rewrites Applied ==")
+            lines.extend(f"  {r}" for r in seen)
+        lines.append("== Physical Execution Plan ==")
         for v in ep.vertices:
             chain = " -> ".join(getattr(n, "name", "?") for n in v.chain) \
                 or v.name
@@ -185,18 +190,21 @@ class TableEnvironment:
         n = writer_for(fmt)([batch], path)
         return _InsertResult(n, path)
 
-    def _plan(self, stmt: SelectStmt):
+    def _plan(self, stmt: SelectStmt, return_planner: bool = False):
         from flink_tpu.datastream.api import StreamExecutionEnvironment
         env = StreamExecutionEnvironment(parallelism=self.parallelism,
                                          max_parallelism=self.max_parallelism)
         for t in self._catalog.values():
             t._bound_env = env
+        planner = Planner(env, self._catalog,
+                          mini_batch_rows=self.mini_batch_rows)
         try:
-            plan = Planner(env, self._catalog,
-                           mini_batch_rows=self.mini_batch_rows).plan(stmt)
+            plan = planner.plan(stmt)
         finally:
             for t in self._catalog.values():
                 t._bound_env = None
+        if return_planner:
+            return env, plan, planner
         return env, plan
 
 

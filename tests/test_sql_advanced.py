@@ -372,10 +372,20 @@ def test_union_errors(tenv):
     with pytest.raises(SqlParseError, match="UNION branch"):
         tenv.execute_sql("SELECT oid FROM orders ORDER BY oid "
                          "UNION ALL SELECT oid FROM orders").collect()
-    with pytest.raises(PlanError, match="mixing"):
-        tenv.execute_sql("SELECT oid FROM orders UNION "
-                         "SELECT oid FROM orders UNION ALL "
-                         "SELECT oid FROM orders").collect()
+
+def test_union_mixed_all_chain(tenv):
+    """Mixed UNION/UNION ALL chains bind left-associatively (SQL standard):
+    A UNION B UNION ALL C = (A dedup B) followed by all of C — the
+    union_associativity rewrite rule nests the chain before lowering."""
+    rows = tenv.execute_sql(
+        "SELECT oid FROM orders UNION "
+        "SELECT oid FROM orders UNION ALL "
+        "SELECT oid FROM orders").collect()
+    oids = sorted(int(r["oid"]) for r in rows)
+    # (orders UNION orders) = each oid once; UNION ALL appends all rows
+    single = sorted(int(r["oid"]) for r in
+                    tenv.execute_sql("SELECT oid FROM orders").collect())
+    assert oids == sorted(list(set(single)) + single)
 
 
 def test_union_in_derived_table():
@@ -621,14 +631,69 @@ def test_hop_sum_distinct_mixed_with_plain():
     assert got == {-1000: (1, 5), 0: (3, 12), 1000: (3, 12), 2000: (1, 7)}
 
 
-def test_session_distinct_still_rejected():
-    from flink_tpu.sql.planner import PlanError
+def test_session_distinct_aggregates():
+    """DISTINCT aggregates over SESSION windows: per-session value SETS
+    merge with the session intervals (closes the PARITY r2 gap)."""
+    te = TableEnvironment()
+    te.register_collection("t", columns={
+        "k":  np.array([1, 1, 1, 1, 2], np.int64),
+        "ts": np.array([0, 400, 800, 5000, 100], np.int64),
+        "v":  np.array([5., 5., 7., 9., 5.])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT k, COUNT(DISTINCT v) AS dc, SUM(DISTINCT v) AS ds, "
+        "COUNT(*) AS n, "
+        "SESSION_START(ts, INTERVAL '1' SECOND) AS ws "
+        "FROM t GROUP BY k, SESSION(ts, INTERVAL '1' SECOND)").collect()
+    got = sorted((int(r["k"]), int(r["ws"]), int(r["dc"]), float(r["ds"]),
+                  int(r["n"])) for r in rows)
+    # key 1 session [0,1800): values {5,5,7} -> 2 distinct, sum 12, 3 rows
+    # key 1 session [5000,6000): {9};  key 2 session [100,1100): {5}
+    assert got == [(1, 0, 2, 12.0, 3), (1, 5000, 1, 9.0, 1),
+                   (2, 100, 1, 5.0, 1)]
 
-    te = _hop_distinct_env()
-    with pytest.raises(PlanError, match="SESSION"):
-        te.execute_sql(
-            "SELECT k, COUNT(DISTINCT v) FROM t GROUP BY k, "
-            "SESSION(ts, INTERVAL '1' SECOND)").collect()
+
+def test_session_distinct_merging_sessions_union_sets():
+    """A late-ish batch that MERGES two sessions must union their distinct
+    sets (the MergingWindowSet + distinct-MapView interaction)."""
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.core.functions import CountAggregator, RuntimeContext, TupleAggregator
+    from flink_tpu.operators.session_window import SessionWindowOperator
+    from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+    op = SessionWindowOperator(
+        EventTimeSessionWindows(100),
+        TupleAggregator({"n": ("v", CountAggregator())}),
+        key_column="k", value_selector=lambda c: c,
+        distinct_specs={"dc": "COUNT", "ds": "SUM"}, distinct_column="v")
+    op.open(RuntimeContext())
+    # two separate sessions for key 1: [0,100) {5}, [180,280) {5,7}
+    op.process_batch(RecordBatch(
+        {"k": np.array([1, 1, 1]), "v": np.array([5., 5., 7.])},
+        timestamps=np.array([0, 180, 190])))
+    # bridging row at t=90 merges them; distinct set must be {5,7,9}
+    op.process_batch(RecordBatch(
+        {"k": np.array([1]), "v": np.array([9.])},
+        timestamps=np.array([90])))
+    out = op.process_watermark(Watermark(10_000))
+    rows = [r for b in out if hasattr(b, "columns") for r in b.to_rows()]
+    assert len(rows) == 1
+    assert rows[0]["dc"] == 3 and rows[0]["ds"] == 21.0 and rows[0]["n"] == 4
+
+    # snapshot/restore keeps the sets
+    op.process_batch(RecordBatch(
+        {"k": np.array([3, 3]), "v": np.array([2., 2.])},
+        timestamps=np.array([20_000, 20_010])))
+    snap = op.snapshot_state()
+    op2 = SessionWindowOperator(
+        EventTimeSessionWindows(100),
+        TupleAggregator({"n": ("v", CountAggregator())}),
+        key_column="k", value_selector=lambda c: c,
+        distinct_specs={"dc": "COUNT", "ds": "SUM"}, distinct_column="v")
+    op2.open(RuntimeContext())
+    op2.restore_state(snap)
+    out = op2.process_watermark(Watermark(50_000))
+    rows = [r for b in out if hasattr(b, "columns") for r in b.to_rows()]
+    assert [(r["k"], r["dc"], r["ds"]) for r in rows] == [(3, 1, 2.0)]
 
 
 def test_hop_distinct_non_divisible_size_late_rule_matches_plain():
@@ -650,3 +715,42 @@ def test_hop_distinct_non_divisible_size_late_rule_matches_plain():
         "INTERVAL '2.5' SECOND)").collect()
     for r in rows:
         assert int(r["dc"]) <= int(r["n"]), dict(r)
+
+
+def test_explain_diff_shows_pushdown(tenv):
+    """EXPLAIN diff (VERDICT r2 #3 'done' criterion): the rewrite stage's
+    filter pushdown and projection pruning are visible in the physical
+    plan — a pre-join filter vertex appears, the post-join WHERE vanishes,
+    and the scan is pruned to referenced columns."""
+    join_q = ("SELECT o.oid, c.name FROM orders o JOIN customers c "
+              "ON o.cust = c.cust WHERE c.name = 'alice' AND o.amount > 15")
+    txt = tenv.explain_sql(join_q)
+    assert "Logical Rewrites Applied" in txt and "filter_pushdown" in txt
+    # both single-side conjuncts ran BEFORE the join
+    assert "sql-prejoin-filter:customers" in txt
+    assert "sql-prejoin-filter:orders" in txt
+    assert "sql-where" not in txt           # nothing left post-join
+
+    # scan pruning on a plain select: only referenced columns survive
+    txt2 = tenv.explain_sql("SELECT oid FROM orders WHERE amount > 15")
+    assert "projection_prune" in txt2
+    assert "sql-scan-prune[oid,amount]" in txt2
+
+    # and the rewritten plans still compute the right answers
+    rows = tenv.execute_sql(join_q).collect()
+    assert sorted((int(r["oid"]), r["name"]) for r in rows) == \
+        [(2, "alice")]
+    rows2 = tenv.execute_sql(
+        "SELECT oid FROM orders WHERE amount > 15").collect()
+    assert sorted(int(r["oid"]) for r in rows2) == [1, 2, 3, 4, 5]
+
+
+def test_filter_pushdown_outer_join_semantics(tenv):
+    """Pushdown must not change LEFT JOIN results: a right-side predicate
+    pre-filters the right input, turning unmatched rows into NULL-extended
+    output exactly as the post-join filter... does NOT — so the rule must
+    keep right-side conjuncts of outer joins un-pushed."""
+    rows = tenv.execute_sql(
+        "SELECT o.oid, c.name FROM orders o LEFT JOIN customers c "
+        "ON o.cust = c.cust WHERE c.name = 'alice'").collect()
+    assert sorted(int(r["oid"]) for r in rows) == [0, 2]

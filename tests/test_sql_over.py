@@ -131,10 +131,6 @@ def test_over_errors():
     te = make_env()
     with pytest.raises(PlanError, match="ORDER BY"):
         te.execute_sql("SELECT SUM(v) OVER (PARTITION BY k) FROM t").collect()
-    with pytest.raises(PlanError, match="share"):
-        te.execute_sql(
-            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts) AS a, "
-            "SUM(v) OVER (ORDER BY ts) AS b FROM t").collect()
     with pytest.raises(PlanError, match="GROUP BY"):
         te.execute_sql(
             "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts), SUM(v) "
@@ -187,12 +183,33 @@ def test_over_late_rows_dropped():
     assert op._dropped_late == 1
 
 
-def test_over_distinct_rejected():
+def test_over_distinct_unbounded():
+    """agg(DISTINCT x) OVER an unbounded frame: only each value's first
+    occurrence per partition contributes (closes the PARITY r2 gap)."""
+    te = TableEnvironment()
+    te.register_collection("d", columns={
+        "k": np.array([1, 1, 1, 1], np.int64),
+        "ts": np.array([1000, 2000, 3000, 4000], np.int64),
+        "v": np.array([10., 10., 20., 10.])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT ts, SUM(DISTINCT v) OVER (PARTITION BY k ORDER BY ts) AS s, "
+        "COUNT(DISTINCT v) OVER (PARTITION BY k ORDER BY ts) AS c, "
+        "SUM(v) OVER (PARTITION BY k ORDER BY ts) AS plain "
+        "FROM d").collect()
+    by_ts = {r["ts"]: r for r in rows}
+    assert [by_ts[t]["s"] for t in (1000, 2000, 3000, 4000)] == \
+        [10., 10., 30., 30.]
+    assert [by_ts[t]["c"] for t in (1000, 2000, 3000, 4000)] == [1, 1, 2, 2]
+    assert [by_ts[t]["plain"] for t in (1000, 2000, 3000, 4000)] == \
+        [10., 20., 40., 50.]
+
+
+def test_over_distinct_bounded_frame_rejected():
     te = make_env()
-    with pytest.raises(PlanError, match="DISTINCT"):
+    with pytest.raises(PlanError, match="unbounded"):
         te.execute_sql(
-            "SELECT SUM(DISTINCT v) OVER (PARTITION BY k ORDER BY ts) "
-            "FROM t").collect()
+            "SELECT SUM(DISTINCT v) OVER (PARTITION BY k ORDER BY ts "
+            "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM t").collect()
 
 
 def test_frame_words_stay_usable_as_columns():
@@ -263,3 +280,63 @@ def test_over_subquery_dropped_rowtime_rejected():
         te.execute_sql(
             "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts) "
             "FROM (SELECT k, v FROM t)").collect()
+
+
+def test_over_multiple_partitionings_one_select():
+    """Distinct (PARTITION BY, ORDER BY) groups in one SELECT: the
+    over_partition_split rule nests the SELECT so each level carries one
+    group (closes the PARITY r2 'multiple OVER partitionings' gap)."""
+    rows = make_env().execute_sql(
+        "SELECT k, ts, v, "
+        "SUM(v) OVER (PARTITION BY k ORDER BY ts) AS per_k, "
+        "SUM(v) OVER (ORDER BY ts) AS global_rs "
+        "FROM t").collect()
+    assert [r["per_k"] for r in by_key(rows, 1)] == [10., 30., 60., 100.]
+    assert [r["per_k"] for r in by_key(rows, 2)] == [5., 12.]
+    # global running sum over ALL rows in ts order (ties share the peer
+    # frame: RANGE semantics at equal timestamps)
+    by_ts = sorted(rows, key=lambda r: (r["ts"], r["k"]))
+    got = {(r["k"], r["ts"]): r["global_rs"] for r in by_ts}
+    assert got[(1, 1000)] == got[(2, 1000)] == 15.0    # peers at ts=1000
+    assert got[(1, 2000)] == 35.0 and got[(1, 3000)] == 65.0
+    assert got[(2, 4000)] == 72.0 and got[(1, 5000)] == 112.0
+
+
+def test_explain_shows_rewrites():
+    te = make_env()
+    txt = te.explain_sql(
+        "SELECT k, SUM(v) OVER (PARTITION BY k ORDER BY ts) AS a, "
+        "SUM(v) OVER (ORDER BY ts) AS b FROM t")
+    assert "Logical Rewrites Applied" in txt
+    assert "over_partition_split" in txt
+
+
+def test_filter_not_pushed_below_over_subquery():
+    """Regression: an outer WHERE must NOT push below a subquery computing
+    OVER aggregates — it would change the window input rows."""
+    te = TableEnvironment()
+    te.register_collection("t", columns={
+        "k": np.array([1, 2, 1, 2], np.int64),
+        "ts": np.array([1000, 2000, 3000, 4000], np.int64),
+        "v": np.array([10., 20., 30., 40.])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT k, ts, rs FROM (SELECT k, ts, SUM(v) OVER (ORDER BY ts) "
+        "AS rs FROM t) WHERE k = 1").collect()
+    got = {r["ts"]: r["rs"] for r in rows}
+    assert got == {1000: 10.0, 3000: 60.0}   # running sum saw k=2 rows
+
+
+def test_over_multiple_partitionings_with_alias():
+    rows = make_env().execute_sql(
+        "SELECT a.k, a.ts, SUM(a.v) OVER (PARTITION BY a.k ORDER BY a.ts) "
+        "AS x, SUM(a.v) OVER (ORDER BY a.ts) AS y FROM t a").collect()
+    assert [r["x"] for r in by_key(rows, 1)] == [10., 30., 60., 100.]
+    assert max(r["y"] for r in rows) == 112.0
+
+
+def test_over_split_preserves_having_rejection():
+    te = make_env()
+    with pytest.raises(PlanError, match="HAVING"):
+        te.execute_sql(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts) AS a, "
+            "SUM(v) OVER (ORDER BY ts) AS b FROM t HAVING 1 = 0").collect()
