@@ -240,6 +240,10 @@ class _WorkerRuntime:
         self._done_sent = False
         self._remote_writers: List[Any] = []
         self._split_queues: Dict[Tuple[str, int], Any] = {}
+        #: region-scoped recovery bookkeeping: which remote writers a local
+        #: producer owns, and which server channel ids feed a local consumer
+        self._writers_by_task: Dict[Tuple[str, int], List[Any]] = {}
+        self._inchans_by_task: Dict[Tuple[str, int], List[str]] = {}
 
     def _send(self, obj: Any) -> None:
         try:
@@ -311,7 +315,14 @@ class _WorkerRuntime:
 
     # -- deploy ------------------------------------------------------------
     def deploy(self, addresses: Dict[int, Tuple[str, int]],
-               restore: Optional[Dict[str, Any]]) -> None:
+               restore: Optional[Dict[str, Any]],
+               only: Optional[set] = None) -> None:
+        """Build and start this worker's subtask slice.  ``only``: restrict
+        to these (vertex_uid, subtask_index) — region-scoped recovery
+        redeploys just the affected regions' tasks, leaving the rest
+        running (``RestartPipelinedRegionFailoverStrategy``).  Regions are
+        edge-closed, so every channel of an ``only`` task has both
+        endpoints inside ``only``."""
         from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
         from flink_tpu.cluster.net import RemoteChannel
         from flink_tpu.cluster.task import SourceSubtask, Subtask
@@ -324,6 +335,9 @@ class _WorkerRuntime:
 
         def n_subs(v) -> int:
             return counts[v.uid]
+
+        def wanted(uid: str, i: int) -> bool:
+            return only is None or (uid, i) in only
 
         inputs: Dict[int, List[List[Any]]] = {
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
@@ -340,6 +354,8 @@ class _WorkerRuntime:
                 # group channels per producer (dispatcher wants ci order)
                 per_producer: Dict[int, List[Any]] = {}
                 for pi, ci in pairs:
+                    if not (wanted(v.uid, pi) or wanted(tgt.uid, ci)):
+                        continue
                     p_local = assign[(v.uid, pi)] == me
                     c_local = assign[(tgt.uid, ci)] == me
                     chan_id = f"{v.uid}[{pi}]->{tgt.uid}[{ci}]#{ei}"
@@ -354,10 +370,14 @@ class _WorkerRuntime:
                                            ssl_context=self._client_ssl,
                                            auth_token=self._data_token)
                         self._remote_writers.append(ch)
+                        self._writers_by_task.setdefault(
+                            (v.uid, pi), []).append(ch)
                     elif c_local:
                         q = self.server.channel(chan_id)
                         inputs[tgt.id][ci].append(q)
                         input_logical[tgt.id][ci].append(e.input_index)
+                        self._inchans_by_task.setdefault(
+                            (tgt.uid, ci), []).append(chan_id)
                     if p_local:
                         per_producer.setdefault(pi, []).append(ch)
                 for pi, chans in per_producer.items():
@@ -380,7 +400,7 @@ class _WorkerRuntime:
                     # the coordinator over the control plane (the
                     # RequestSplitEvent RPC, SourceCoordinator.java:155)
                     for i in range(counts[v.uid]):
-                        if assign[(v.uid, i)] != me:
+                        if assign[(v.uid, i)] != me or not wanted(v.uid, i):
                             continue
                         ctx = RuntimeContext(
                             task_name=v.name, subtask_index=i,
@@ -396,7 +416,7 @@ class _WorkerRuntime:
                              else None))
                     continue
                 for i, split in enumerate(splits):
-                    if assign[(v.uid, i)] != me:
+                    if assign[(v.uid, i)] != me or not wanted(v.uid, i):
                         continue
                     ctx = RuntimeContext(task_name=v.name, subtask_index=i,
                                          parallelism=len(splits),
@@ -407,7 +427,7 @@ class _WorkerRuntime:
                         (t, sub_snaps[i] if i < len(sub_snaps) else None))
             else:
                 for i in range(n_subs(v)):
-                    if assign[(v.uid, i)] != me:
+                    if assign[(v.uid, i)] != me or not wanted(v.uid, i):
                         continue
                     ctx = RuntimeContext(task_name=v.name, subtask_index=i,
                                          parallelism=n_subs(v),
@@ -418,7 +438,15 @@ class _WorkerRuntime:
                                 input_logical=input_logical[v.id][i])
                     to_start.append(
                         (t, sub_snaps[i] if i < len(sub_snaps) else None))
-        self.tasks = [t for t, _ in to_start]
+        if only is None:
+            self.tasks = [t for t, _ in to_start]
+        else:
+            self.tasks.extend(t for t, _ in to_start)
+            with self._lock:
+                # re-arm completion reporting (reset_tasks suppressed it);
+                # the just-started tasks guarantee a future terminal
+                # transition that runs the done check
+                self._done_sent = False
         for t, snap in to_start:
             t.start(snap)
         if not self.tasks:
@@ -453,7 +481,9 @@ class _WorkerRuntime:
                 break
             kind = msg[0]
             if kind == "deploy":
-                self.deploy(msg[1], msg[2])
+                self.deploy(msg[1], msg[2],
+                            only=set(msg[3]) if len(msg) > 3
+                            and msg[3] is not None else None)
             elif kind == "checkpoint":
                 cid = msg[1]
                 for t in self.tasks:
@@ -490,9 +520,50 @@ class _WorkerRuntime:
                 self.server.reset()
                 self.tasks = []
                 self._split_queues = {}
+                self._writers_by_task = {}
+                self._inchans_by_task = {}
                 with self._lock:
                     self._terminal = set()
                     self._done_sent = False
+                self._send(("reset_done", self.index))
+            elif kind == "reset_tasks":
+                # region-scoped recovery: tear down ONLY the affected
+                # regions' local tasks and their channels; everything else
+                # keeps running (surviving regions never restart)
+                with self._lock:
+                    # suppress worker_done until the follow-up deploy: the
+                    # cancels below (and any unaffected task finishing in
+                    # the window) must not make this worker look done
+                    # while its affected tasks are pending redeploy
+                    self._done_sent = True
+                aff = set(msg[1])
+                mine = [t for t in self.tasks
+                        if (t.vertex_uid, t.subtask_index) in aff]
+                for t in mine:
+                    key = (t.vertex_uid, t.subtask_index)
+                    for w in self._writers_by_task.pop(key, []):
+                        try:
+                            w.close()
+                        except OSError:
+                            pass
+                        if w in self._remote_writers:
+                            self._remote_writers.remove(w)
+                    q = self._split_queues.pop(key, None)
+                    if q is not None:
+                        q.put((None, True))
+                for t in mine:
+                    t.cancel()
+                for t in mine:
+                    t.join(timeout_s=10)
+                drop_chans = [cid for t in mine for cid in
+                              self._inchans_by_task.pop(
+                                  (t.vertex_uid, t.subtask_index), [])]
+                self.server.reset_channels(drop_chans)
+                self.tasks = [t for t in self.tasks if t not in mine]
+                with self._lock:
+                    self._terminal -= {(t.vertex_uid, t.subtask_index)
+                                       for t in mine}
+                    # _done_sent stays True: deploy(only=...) re-arms it
                 self._send(("reset_done", self.index))
             elif kind == "cancel":
                 for t in self.tasks:
@@ -574,6 +645,8 @@ class ProcessCluster:
         #: from killed workers) must not touch this attempt's state
         self._gen = getattr(self, "_gen", 0) + 1
         self._states: Dict[Tuple[str, int], str] = {}
+        self._state_log: List[Tuple[str, int, str]] = []
+        self._last_recovery: Optional[str] = None
         self._finals: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._rows: Dict[Tuple[str, int], List[Dict[str, Any]]] = {}
         self._pending: Optional[_Pending] = None
@@ -589,11 +662,13 @@ class ProcessCluster:
         """Execute, restarting from the latest completed checkpoint on
         failure (up to ``restart_attempts`` times, spawned workers only).
 
-        Collect-sink rows come from the FINAL execution only: a failed
-        attempt never shipped its buffered collect rows, and the restored
-        sources resume at the checkpoint — collect() is a debugging sink
-        under failover; exactly-once delivery needs the transactional
-        sinks (``connectors/sinks.py``)."""
+        Collect-sink rows come from the FINAL execution; since r3 the
+        CollectSink checkpoints its collected rows, so recovery from a
+        completed checkpoint preserves pre-checkpoint rows (exactly-once
+        for collect too).  Production delivery still belongs to the
+        transactional sinks (``connectors/sinks.py``,
+        ``connectors/log_service.py``) — the collect path keeps its whole
+        result in memory/checkpoints by design."""
         original_restore = restore
         attempt = 0
         while True:
@@ -745,6 +820,15 @@ class ProcessCluster:
                     break                   # finished cleanly
                 dead = [i for i, p in enumerate(procs)
                         if p.poll() is not None]
+                if not dead and self._failed and "died" in str(self._failed):
+                    # SIGKILL delivery/reaping can lag the control-plane
+                    # EOF by a moment: give the child a beat to be
+                    # observable before falling back to a full restart
+                    end = time.monotonic() + 5
+                    while not dead and time.monotonic() < end:
+                        time.sleep(0.05)
+                        dead = [i for i, p in enumerate(procs)
+                                if p.poll() is not None]
                 if not (self.spawn and self.worker_recovery and dead
                         and recoveries < self.restart_attempts
                         and time.monotonic() < deadline):
@@ -798,28 +882,11 @@ class ProcessCluster:
              "--job", self.job, "--coordinator", f"127.0.0.1:{cport}"],
             env=self._spawn_env)
 
-    def _recover_workers(self, plan, procs, dead, addresses, srv,
-                         server_ctx, need_token: bool, cport: int,
-                         original_restore) -> None:
-        """In-place recovery: quiesce survivors, respawn the dead worker
-        processes, redeploy every task from this run's latest checkpoint.
-        Surviving processes (and their data-plane servers) never restart —
-        the reference's local-recovery posture
-        (``RestartPipelinedRegionFailoverStrategy`` + local recovery)."""
-        self._recovering = True
-        old_done = self._all_done
-        survivors = [i for i in range(self.n_workers) if i not in dead]
-        # 1. quiesce survivors (tasks cancel, channels drop, process stays)
-        with self._reset_cv:
-            self._reset_acks = set()
-        for i in survivors:
-            self._to_worker(i, ("reset",))
-        end = time.monotonic() + 30
-        with self._reset_cv:
-            while not set(survivors) <= self._reset_acks \
-                    and time.monotonic() < end:
-                self._reset_cv.wait(timeout=1.0)
-        # 2. respawn dead workers and register ONLY them
+    def _respawn_and_register(self, procs, dead, addresses, srv, server_ctx,
+                              need_token: bool, cport: int) -> bool:
+        """Respawn the dead worker processes and register ONLY them; wires
+        their control connections + serve threads.  False = registration
+        failed (the attempt was marked FAILED)."""
         for i in dead:
             procs[i] = self._spawn_worker(i, cport)
         new_addr: Dict[int, Tuple[str, int]] = {}
@@ -834,13 +901,75 @@ class ProcessCluster:
                 self._failed = "respawned worker failed to register"
                 self._all_done.set()
             self._recovering = False
-            return
+            return False
         addresses.update(new_addr)
         for idx, conn in new_conns:
             self._conns[idx] = conn
             self._send_locks[idx] = threading.Lock()
             threading.Thread(target=self._serve_worker, args=(idx, conn),
                              daemon=True).start()
+        return True
+
+    def _latest_restore(self, original_restore):
+        """This run's newest completed checkpoint, else the original
+        restore the run started from."""
+        if self.checkpoint_storage is not None and self._completed_ids:
+            return self.checkpoint_storage.load(max(self._completed_ids))
+        return original_restore
+
+    def _affected_region_subtasks(self, plan, dead) -> Optional[set]:
+        """(vertex_uid, i) set of the pipelined regions touched by the dead
+        workers, or None when region-scoped recovery does not apply (the
+        whole job is affected, or a runtime-enumerated source shares
+        enumerator state across regions)."""
+        from flink_tpu.cluster.failover import subtask_regions
+
+        counts, splits_by_vertex = subtask_counts_of(plan)
+        if any(s is None for s in splits_by_vertex.values()):
+            return None     # dynamic enumerator: shared coordinator state
+        assign = assign_subtasks(plan, counts, self.n_workers)
+        dead_subs = {st for st, w in assign.items() if w in set(dead)}
+        affected: set = set()
+        for region in subtask_regions(plan, counts):
+            if region & dead_subs:
+                affected |= region
+        if not affected or affected == set(assign):
+            return None     # everything (or nothing) affected: full path
+        return affected
+
+    def _recover_workers(self, plan, procs, dead, addresses, srv,
+                         server_ctx, need_token: bool, cport: int,
+                         original_restore) -> None:
+        """In-place recovery: quiesce (only the affected regions of)
+        survivors, respawn the dead worker processes, redeploy the affected
+        tasks from this run's latest checkpoint.  Surviving processes (and
+        their data-plane servers) never restart, and with region-scoped
+        recovery the surviving regions' TASKS keep running too — the
+        reference's ``RestartPipelinedRegionFailoverStrategy`` + local
+        recovery."""
+        affected = self._affected_region_subtasks(plan, dead)
+        if affected is not None:
+            return self._recover_regions(plan, procs, dead, affected,
+                                         addresses, srv, server_ctx,
+                                         need_token, cport, original_restore)
+        self._last_recovery = "full"
+        self._recovering = True
+        old_done = self._all_done
+        survivors = [i for i in range(self.n_workers) if i not in dead]
+        # 1. quiesce survivors (tasks cancel, channels drop, process stays)
+        with self._reset_cv:
+            self._reset_acks = set()
+        for i in survivors:
+            self._to_worker(i, ("reset",))
+        end = time.monotonic() + 30
+        with self._reset_cv:
+            while not set(survivors) <= self._reset_acks \
+                    and time.monotonic() < end:
+                self._reset_cv.wait(timeout=1.0)
+        # 2. respawn dead workers and register ONLY them
+        if not self._respawn_and_register(procs, dead, addresses, srv,
+                                          server_ctx, need_token, cport):
+            return
         # 3. fresh attempt state (conns, gen and serve threads survive)
         with self._lock:
             self._states = {}
@@ -852,14 +981,58 @@ class ProcessCluster:
             self._all_done = threading.Event()
         old_done.set()  # stop the previous checkpoint ticker
         # 4. redeploy from this run's latest completed checkpoint
-        latest = None
-        if self.checkpoint_storage is not None and self._completed_ids:
-            latest = self.checkpoint_storage.load(max(self._completed_ids))
-        restore = latest or original_restore
+        restore = self._latest_restore(original_restore)
         self._setup_source_coordinator(plan, restore)
         self._recovering = False
         for idx in self._conns:
             self._to_worker(idx, ("deploy", addresses, restore))
+
+    def _recover_regions(self, plan, procs, dead, affected: set, addresses,
+                         srv, server_ctx, need_token: bool, cport: int,
+                         original_restore) -> None:
+        """Region-scoped recovery (VERDICT r2 #6): only the pipelined
+        regions touched by the dead workers roll back; every other region's
+        tasks keep RUNNING throughout — matching
+        ``RestartPipelinedRegionFailoverStrategy.java``."""
+        self._last_recovery = "region"
+        self._recovering = True
+        old_done = self._all_done
+        counts, _ = subtask_counts_of(plan)
+        assign = assign_subtasks(plan, counts, self.n_workers)
+        touched_workers = {assign[st] for st in affected}
+        survivors_touched = sorted(touched_workers - set(dead))
+        # 1. cancel ONLY affected tasks on touched survivors
+        with self._reset_cv:
+            self._reset_acks = set()
+        for i in survivors_touched:
+            self._to_worker(i, ("reset_tasks", sorted(affected)))
+        end = time.monotonic() + 30
+        with self._reset_cv:
+            while not set(survivors_touched) <= self._reset_acks \
+                    and time.monotonic() < end:
+                self._reset_cv.wait(timeout=1.0)
+        # 2. respawn dead workers and register ONLY them
+        if not self._respawn_and_register(procs, dead, addresses, srv,
+                                          server_ctx, need_token, cport):
+            return
+        # 3. reset ONLY the affected tasks' bookkeeping; unaffected
+        # regions' states, finals and collected rows stay
+        with self._lock:
+            for key in affected:
+                self._states.pop(key, None)
+                self._finals.pop(key, None)
+                self._rows.pop(key, None)
+            self._pending = None            # in-flight checkpoint aborts
+            self._failed = None
+            self._done_workers -= touched_workers
+            self._all_done = threading.Event()
+        old_done.set()  # stop the previous checkpoint ticker
+        # 4. redeploy the affected regions from the latest checkpoint
+        restore = self._latest_restore(original_restore)
+        self._recovering = False
+        only = sorted(affected)
+        for idx in sorted(touched_workers):
+            self._to_worker(idx, ("deploy", addresses, restore, only))
 
     def _register_workers(self, srv, server_ctx, need_token: bool,
                           addresses: Dict[int, Tuple[str, int]],
@@ -962,6 +1135,9 @@ class ProcessCluster:
                 _, uid, i, state, error = msg
                 with self._lock:
                     self._states[(uid, i)] = state
+                    # full transition history (tests/observability: proves
+                    # which subtasks restarted during a recovery)
+                    self._state_log.append((uid, i, state))
                     if state == "FAILED" and self._failed is None:
                         self._failed = f"{uid}[{i}]: {error}"
                         self._all_done.set()

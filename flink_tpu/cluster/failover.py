@@ -147,3 +147,41 @@ def region_of(plan: ExecutionPlan, vertex_uid: str) -> Set[str]:
         if vertex_uid in region:
             return region
     raise KeyError(vertex_uid)
+
+
+def subtask_regions(plan: ExecutionPlan,
+                    counts: Dict[str, int]) -> "List[Set[tuple]]":
+    """Pipelined regions at SUBTASK granularity — the actual
+    ``RestartPipelinedRegionFailoverStrategy`` unit: a forward edge at
+    equal parallelism connects producer i to consumer i only (so parallel
+    forward chains are independent regions); every other partitioning is
+    all-to-all and fuses both vertices' subtasks into one region.
+    ``counts``: effective subtask count per vertex uid (sources may run one
+    subtask per split)."""
+    subs = [(v.uid, i) for v in plan.vertices for i in range(counts[v.uid])]
+    parent = {s: s for s in subs}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a, b):
+        parent[find(a)] = find(b)
+
+    for v in plan.vertices:
+        for e in v.out_edges:
+            tgt = plan.by_id[e.target_id]
+            np_, nc = counts[v.uid], counts[tgt.uid]
+            if e.partitioning == "forward" and np_ == nc:
+                for i in range(np_):
+                    union((v.uid, i), (tgt.uid, i))
+            else:
+                for pi in range(np_):
+                    for ci in range(nc):
+                        union((v.uid, pi), (tgt.uid, ci))
+    regions: Dict[tuple, Set[tuple]] = {}
+    for s in subs:
+        regions.setdefault(find(s), set()).add(s)
+    return list(regions.values())

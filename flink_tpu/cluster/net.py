@@ -284,6 +284,16 @@ class ChannelServer:
         for q in old:
             q.close()
 
+    def reset_channels(self, channel_ids) -> None:
+        """Region-scoped recovery: drop ONLY these channels' queues (the
+        affected region's), leaving unaffected regions' channels streaming
+        undisturbed."""
+        with self._lock:
+            old = [self._queues.pop(cid) for cid in channel_ids
+                   if cid in self._queues]
+        for q in old:
+            q.close()
+
     def stop(self) -> None:
         self._stop.set()
         try:

@@ -47,6 +47,42 @@ class CollectSink(Sink):
         parts = [np.asarray(b.column(name)) for b in self.batches if len(b)]
         return np.concatenate(parts) if parts else np.asarray([])
 
+    # collected rows are operator STATE: a recovery that replays the source
+    # from the last checkpoint must not lose rows collected before it
+    # (exactly-once for the collect path, not just for aggregates).  Each
+    # snapshot carries the FULL history — O(collected rows) per checkpoint,
+    # inherent to a stateful collect (and why collect() is a test/debug
+    # sink, not a production one); batches are consolidated first so the
+    # payload is a few large arrays, and the incremental checkpoint layer's
+    # content-hash dedup skips re-uploading unchanged chunks.
+    def snapshot_state(self) -> Dict[str, Any]:
+        self._consolidate()
+        return {"batches": [
+            ({k: np.asarray(v) for k, v in b.columns.items()},
+             None if b.timestamps is None else np.asarray(b.timestamps))
+            for b in self.batches]}
+
+    def _consolidate(self) -> None:
+        """Merge buffered batches into one (columns + timestamps only —
+        key-group metadata varies between restored and live batches and is
+        irrelevant to a terminal sink).  Skipped when schemas differ."""
+        if len(self.batches) <= 1:
+            return
+        keys = set(self.batches[0].columns)
+        has_ts = self.batches[0].timestamps is not None
+        for b in self.batches[1:]:
+            if set(b.columns) != keys or (b.timestamps is not None) != has_ts:
+                return
+        cols = {k: np.concatenate([np.asarray(b.columns[k])
+                                   for b in self.batches]) for k in keys}
+        ts = (np.concatenate([np.asarray(b.timestamps)
+                              for b in self.batches]) if has_ts else None)
+        self.batches = [RecordBatch(cols, timestamps=ts)]
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.batches = [RecordBatch(cols, timestamps=ts)
+                        for cols, ts in snap.get("batches", [])]
+
 
 class PrintSink(Sink):
     """``print()`` analog: one line per row to stdout/stderr."""

@@ -56,9 +56,16 @@ class ObjectStoreServer:
         self._token_path = os.path.join(directory, "_lease_tokens.json")
         try:
             with open(self._token_path) as f:
-                self._next_token = int(json.load(f)["next"])
+                payload = json.load(f)
+            self._next_token = int(payload["next"])
+            #: per-election LAST granted token (persisted): fencing after a
+            #: restart must compare against the election's own newest
+            #: grant, not the shared counter
+            self._last_grant: Dict[str, int] = {
+                k: int(v) for k, v in payload.get("last", {}).items()}
         except (OSError, ValueError, KeyError):
             self._next_token = 1
+            self._last_grant = {}
         store = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -107,6 +114,19 @@ class ObjectStoreServer:
                 key = urllib.parse.unquote(self.path[3:])
                 ln = int(self.headers["Content-Length"])
                 data = self.rfile.read(ln)
+                # fenced writes: a writer presenting a fencing token older
+                # than the election's latest grant is a DEPOSED leader —
+                # reject (the split-brain guard the lease tokens exist for)
+                election = self.headers.get("X-Fencing-Election")
+                if election is not None:
+                    try:
+                        tok = int(self.headers.get("X-Fencing-Token", -1))
+                    except ValueError:
+                        tok = -1
+                    if not store.fencing_valid(election, tok):
+                        return self._json(
+                            412, {"error": "fencing token superseded",
+                                  "election": election})
                 path = self._path(key)
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
@@ -188,9 +208,11 @@ class ObjectStoreServer:
                         "token": cur["token"], "expires_in_ms": ttl_ms}
             token = self._next_token
             self._next_token += 1
+            self._last_grant[name] = token
             tmp = self._token_path + ".tmp"
             with open(tmp, "w") as f:  # tokens survive server restarts
-                json.dump({"next": self._next_token}, f)
+                json.dump({"next": self._next_token,
+                           "last": self._last_grant}, f)
             os.replace(tmp, self._token_path)
             self._leases[name] = {"holder": holder, "token": token,
                                   "expires": now + ttl_ms / 1000.0}
@@ -217,6 +239,21 @@ class ObjectStoreServer:
                 del self._leases[name]
                 return {"released": True}
             return {"released": False}
+
+    def fencing_valid(self, election: str, token: int) -> bool:
+        """A presented token is valid unless a NEWER grant exists for the
+        election (the write may proceed even if the lease lapsed, as long
+        as nobody else was granted since — standard fencing semantics)."""
+        with self._lease_lock:
+            cur = self._leases.get(election)
+            if cur is not None:
+                return token >= cur["token"]
+            # no live record (e.g. after a server restart): only THIS
+            # election's latest historical grant can still be valid —
+            # older ones are deposed by construction; elections that were
+            # never granted reject everything (fail closed)
+            last = self._last_grant.get(election)
+            return last is not None and token == last
 
     def lease_state(self, name: str) -> Dict[str, Any]:
         now = time.monotonic()
@@ -245,14 +282,23 @@ class ObjectStoreClient:
         self.url = url.rstrip("/")
         self.timeout_s = timeout_s
 
-    def _req(self, method: str, path: str, body: Optional[bytes] = None):
+    def _req(self, method: str, path: str, body: Optional[bytes] = None,
+             headers: Optional[Dict[str, str]] = None):
         req = urllib.request.Request(self.url + path, data=body,
-                                     method=method)
+                                     method=method, headers=headers or {})
         return urllib.request.urlopen(req, timeout=self.timeout_s)
 
-    def put(self, key: str, data: bytes) -> None:
+    def put(self, key: str, data: bytes,
+            fencing: Optional[tuple] = None) -> None:
+        """``fencing=(election, token)``: the server rejects the write with
+        412 when a newer fencing token was granted for that election — a
+        deposed leader cannot corrupt shared state."""
+        headers = {}
+        if fencing is not None:
+            headers = {"X-Fencing-Election": str(fencing[0]),
+                       "X-Fencing-Token": str(fencing[1])}
         self._req("PUT", "/o/" + urllib.parse.quote(key, safe=""),
-                  data).read()
+                  data, headers).read()
 
     def get(self, key: str) -> bytes:
         with self._req("GET", "/o/" + urllib.parse.quote(key, safe="")) as r:

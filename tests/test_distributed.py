@@ -262,3 +262,120 @@ def test_surviving_worker_recovery_keeps_other_processes(tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
         sys.modules.pop("survive_job_mod", None)
+
+
+def test_subtask_regions_forward_vs_keyed():
+    """Region computation: forward chains at equal parallelism are
+    per-subtask-index regions; any keyed/all-to-all edge fuses everything
+    (RestartPipelinedRegionFailoverStrategy region semantics)."""
+    import numpy as np
+
+    from flink_tpu.cluster.failover import subtask_regions
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(3)
+    (env.from_collection(columns={"v": np.arange(30.)}, batch_size=4)
+        .map(lambda c: {"v": np.asarray(c["v"]) * 2}).collect())
+    plan = env.get_stream_graph("regions").to_plan()
+    counts = {v.uid: v.parallelism for v in plan.vertices}
+    regions = subtask_regions(plan, counts)
+    # forward pipelines: one region per subtask column
+    assert len(regions) == 3
+    assert all(len({i for _, i in r}) == 1 for r in regions)
+
+    env2 = StreamExecutionEnvironment()
+    env2.set_parallelism(3)
+    (env2.from_collection(columns={"k": np.arange(30) % 3,
+                                   "v": np.ones(30)}, batch_size=4)
+         .key_by("k").sum("v").collect())
+    plan2 = env2.get_stream_graph("keyed").to_plan()
+    counts2 = {v.uid: v.parallelism for v in plan2.vertices}
+    assert len(subtask_regions(plan2, counts2)) == 1  # all-to-all fuses
+
+
+def test_region_scoped_recovery_survivor_regions_never_restart(tmp_path):
+    """VERDICT r2 #6: a 3-worker job of DISJOINT forward pipelines loses
+    one worker; only the dead worker's region redeploys — the other two
+    regions' tasks never leave RUNNING (no second RUNNING transition),
+    and the recovery path is region-scoped, not full."""
+    import signal
+    import textwrap
+    import threading
+    import time
+
+    mod = tmp_path / "region_job_mod.py"
+    mod.write_text(textwrap.dedent('''
+        import numpy as np
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+        N = 90_000
+
+        def build():
+            env = StreamExecutionEnvironment()
+            env.set_parallelism(3)
+            (env.from_collection(columns={"v": np.arange(float(N))},
+                                 batch_size=32)
+                .map(lambda c: {"v2": np.asarray(c["v"]) * 2.0})
+                .collect())
+            return env.get_stream_graph("region-job")
+    '''))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        store = FileCheckpointStorage(str(tmp_path / "ckpt"))
+        pc = ProcessCluster("region_job_mod:build", n_workers=3,
+                            checkpoint_storage=store,
+                            checkpoint_interval_ms=50,
+                            restart_attempts=2,
+                            extra_sys_path=(str(tmp_path),))
+        killed = {"pids": None}
+
+        def chaos():
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if pc._completed_ids and getattr(pc, "_procs", None):
+                    procs = pc._procs
+                    if all(p.poll() is None for p in procs):
+                        killed["pids"] = [p.pid for p in procs]
+                        os.kill(procs[2].pid, signal.SIGKILL)
+                        return
+                time.sleep(0.02)
+
+        th = threading.Thread(target=chaos)
+        th.start()
+        res = pc.run(timeout_s=300)
+        th.join()
+        assert killed["pids"] is not None, "chaos thread never fired"
+        assert res["state"] == "FINISHED", res["error"]
+        assert res.get("recoveries", 0) >= 1, res
+        assert res["attempts"] == 1
+        assert pc._last_recovery == "region", pc._last_recovery
+        # survivors kept their PIDs
+        final_pids = [p.pid for p in pc._procs]
+        assert final_pids[0] == killed["pids"][0]
+        assert final_pids[1] == killed["pids"][1]
+        # the dead worker's region redeployed; UNAFFECTED subtasks have
+        # exactly ONE RUNNING transition in the whole run
+        running_counts = {}
+        for uid, i, st in pc._state_log:
+            if st == "RUNNING":
+                running_counts[(uid, i)] = running_counts.get((uid, i),
+                                                              0) + 1
+        from flink_tpu.cluster.distributed import (assign_subtasks,
+                                                   build_plan,
+                                                   subtask_counts_of)
+        plan = build_plan("region_job_mod:build")
+        counts, _ = subtask_counts_of(plan)
+        assign = assign_subtasks(plan, counts, 3)
+        for key, w in assign.items():
+            if w != 2:
+                assert running_counts.get(key, 0) == 1, (key, running_counts)
+            else:
+                assert running_counts.get(key, 0) >= 2, (key, running_counts)
+        # every record accounted for exactly once (exactly-once collect)
+        vals = sorted(r["v2"] for r in res["rows"])
+        assert len(vals) == 90_000
+        assert vals == [2.0 * i for i in range(90_000)]
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("region_job_mod", None)
