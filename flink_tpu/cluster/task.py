@@ -289,7 +289,10 @@ class SourceSubtask(SubtaskBase):
                 cid = cmd[1]
                 from flink_tpu.operators.base import snapshot_scope
                 # drain async emissions downstream BEFORE the barrier
-                self._emit(self.operator.prepare_snapshot_pre_barrier())
+                prep = getattr(self.operator,
+                               "prepare_snapshot_pre_barrier", None)
+                if prep is not None:
+                    self._emit(prep())
                 with snapshot_scope(cid):
                     snap = {"operator": self.operator.snapshot_state(),
                             "source_offset": self._emitted}
@@ -410,7 +413,10 @@ class Subtask(SubtaskBase):
             if self.unaligned and first:
                 # barrier overtakes: snapshot NOW, forward NOW
                 from flink_tpu.operators.base import snapshot_scope
-                self._emit(self.operator.prepare_snapshot_pre_barrier())
+                prep = getattr(self.operator,
+                               "prepare_snapshot_pre_barrier", None)
+                if prep is not None:
+                    self._emit(prep())
                 with snapshot_scope(el.checkpoint_id):
                     self._pending_snapshot = {
                         "operator": self.operator.snapshot_state(),
@@ -498,7 +504,10 @@ class Subtask(SubtaskBase):
             # barrier was already forwarded at first arrival
         else:
             from flink_tpu.operators.base import snapshot_scope
-            self._emit(self.operator.prepare_snapshot_pre_barrier())
+            prep = getattr(self.operator,
+                           "prepare_snapshot_pre_barrier", None)
+            if prep is not None:
+                self._emit(prep())
             with snapshot_scope(barrier.checkpoint_id):
                 snap = {"operator": self.operator.snapshot_state(),
                         "valve": self._valve.snapshot()}

@@ -26,14 +26,24 @@ class CollectSink(Sink):
     ``DataStream.executeAndCollect`` analog) — the test workhorse."""
 
     def __init__(self):
+        import threading
+
         self.batches: List[RecordBatch] = []
+        #: ONE CollectSink instance is shared by every parallel subtask
+        #: (that is how collect() aggregates results), so appends from task
+        #: threads race with another subtask's multi-step snapshot
+        #: consolidation — serialize them
+        self._lock = threading.Lock()
 
     def write_batch(self, batch: RecordBatch) -> None:
-        self.batches.append(batch)
+        with self._lock:
+            self.batches.append(batch)
 
     def rows(self) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
-        for b in self.batches:
+        with self._lock:
+            batches = list(self.batches)
+        for b in batches:
             cols = {k: np.asarray(v) for k, v in b.columns.items()}
             for i in range(len(b)):
                 row = {k: (v[i].item() if isinstance(v[i], np.generic) else v[i])
@@ -44,7 +54,9 @@ class CollectSink(Sink):
         return out
 
     def column(self, name: str) -> np.ndarray:
-        parts = [np.asarray(b.column(name)) for b in self.batches if len(b)]
+        with self._lock:
+            batches = list(self.batches)
+        parts = [np.asarray(b.column(name)) for b in batches if len(b)]
         return np.concatenate(parts) if parts else np.asarray([])
 
     # collected rows are operator STATE: a recovery that replays the source
@@ -56,16 +68,18 @@ class CollectSink(Sink):
     # payload is a few large arrays, and the incremental checkpoint layer's
     # content-hash dedup skips re-uploading unchanged chunks.
     def snapshot_state(self) -> Dict[str, Any]:
-        self._consolidate()
-        return {"batches": [
-            ({k: np.asarray(v) for k, v in b.columns.items()},
-             None if b.timestamps is None else np.asarray(b.timestamps))
-            for b in self.batches]}
+        with self._lock:
+            self._consolidate_locked()
+            return {"batches": [
+                ({k: np.asarray(v) for k, v in b.columns.items()},
+                 None if b.timestamps is None else np.asarray(b.timestamps))
+                for b in self.batches]}
 
-    def _consolidate(self) -> None:
+    def _consolidate_locked(self) -> None:
         """Merge buffered batches into one (columns + timestamps only —
         key-group metadata varies between restored and live batches and is
-        irrelevant to a terminal sink).  Skipped when schemas differ."""
+        irrelevant to a terminal sink).  Skipped when schemas differ.
+        Caller holds the lock."""
         if len(self.batches) <= 1:
             return
         keys = set(self.batches[0].columns)
@@ -80,8 +94,9 @@ class CollectSink(Sink):
         self.batches = [RecordBatch(cols, timestamps=ts)]
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        self.batches = [RecordBatch(cols, timestamps=ts)
-                        for cols, ts in snap.get("batches", [])]
+        with self._lock:
+            self.batches = [RecordBatch(cols, timestamps=ts)
+                            for cols, ts in snap.get("batches", [])]
 
 
 class PrintSink(Sink):

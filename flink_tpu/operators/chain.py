@@ -84,9 +84,14 @@ class ChainedOperator(StreamOperator):
         return [] if handled else [marker]
 
     def prepare_snapshot_pre_barrier(self) -> List[StreamElement]:
+        # getattr: operators are duck-typed to the StreamOperator protocol;
+        # this hook is newer than some user/test operators, so absence
+        # means "nothing to drain" (same guard as the task runtimes)
         out: List[StreamElement] = []
         for i, op in enumerate(self.operators):
-            out.extend(self._feed(i + 1, op.prepare_snapshot_pre_barrier()))
+            prep = getattr(op, "prepare_snapshot_pre_barrier", None)
+            if prep is not None:
+                out.extend(self._feed(i + 1, prep()))
         return out
 
     def snapshot_state(self) -> Dict[str, Any]:

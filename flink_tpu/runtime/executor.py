@@ -394,7 +394,9 @@ class LocalExecutor:
             rv = self.running.get(v.id)
             if rv is None:
                 continue
-            drained = rv.operator.prepare_snapshot_pre_barrier()
+            prep = getattr(rv.operator, "prepare_snapshot_pre_barrier",
+                           None)
+            drained = prep() if prep is not None else []
             if drained:
                 self._route(rv, drained)
         with snapshot_scope(checkpoint_id):
