@@ -56,8 +56,10 @@ class ExecutionEnvironment:
                                               "kw": kw}))
 
     def generate_sequence(self, start: int, end: int) -> "DataSet":
-        return self.from_columns(
-            {"value": np.arange(start, end + 1, dtype=np.int64)})
+        # lazy: the streamed executor materializes only budget-sized chunks
+        # (``env.generateSequence`` analog)
+        return DataSet(self, BatchOp("sequence", {"start": int(start),
+                                                  "end": int(end)}))
 
 
 class DataSet:
@@ -98,7 +100,8 @@ class DataSet:
         return self._then("global_agg", column=column, how="max")
 
     def count(self) -> int:
-        return len(self.collect_batch())
+        # streaming terminal: never holds the result set
+        return sum(len(b) for b in self.stream_batches())
 
     def reduce(self, fn: Callable[[Dict, Dict], Dict]) -> "DataSet":
         return self._then("global_reduce", fn=fn)
@@ -165,13 +168,22 @@ class DataSet:
     def collect(self) -> List[Dict[str, Any]]:
         return self.collect_batch().to_rows()
 
+    def stream_batches(self) -> "Any":
+        """Pull-stream execution: an iterator of RecordBatch chunks under
+        the row budget (``BatchTask`` driver pipelining analog) — the
+        composing form behind ``count``/``write_file``."""
+        from flink_tpu.dataset.optimizer import stream_plan
+        return stream_plan(self.op)
+
     def explain(self) -> str:
         from flink_tpu.dataset.optimizer import explain_plan
         return explain_plan(self.op)
 
     def write_file(self, path: str, format: str = "csv") -> int:
+        # streaming sink: chunks flow straight to the writer — a plan
+        # larger than memory writes out under the row budget
         from flink_tpu.formats import writer_for
-        return writer_for(format)([self.collect_batch()], path)
+        return writer_for(format)(self.stream_batches(), path)
 
     def output(self) -> None:
         for row in self.collect():

@@ -175,3 +175,124 @@ def test_composite_key_no_collision_large_values():
                            "v": np.array([1.0, 1.0])})
     out = ds.group_by("a", "b").sum("v").collect()
     assert len(out) == 2     # the two rows are DIFFERENT groups
+
+
+# ---------------------------------------------------------------------------
+# Streamed (pipelined) plan execution — VERDICT r2 #5
+# ---------------------------------------------------------------------------
+
+def test_stream_plan_matches_materialized():
+    """Every streamed driver must agree with the materialized executor on
+    a plan mixing chunkwise ops, dams with streaming kernels (sort,
+    distinct, grouped agg) and genuine dams (join)."""
+    from flink_tpu.dataset import external
+
+    env = ExecutionEnvironment()
+    old = external.memory_budget_rows
+    external.memory_budget_rows = lambda: 64   # force many chunks + spills
+    try:
+        n = 1000
+        ds = (env.from_columns({"k": np.arange(n) % 17,
+                                "v": np.arange(n, dtype=np.float64)})
+              .filter(lambda c: np.asarray(c["v"]) % 3 != 0)
+              .map(lambda c: {"k": c["k"], "v": np.asarray(c["v"]) * 2}))
+        grouped = ds.group_by("k").sum("v")
+        ref = sorted((r["k"], r["v"]) for r in grouped.collect())
+        got = sorted((r["k"], r["v"]) for b in grouped.stream_batches()
+                     for r in b.to_rows())
+        assert got == ref
+
+        cnt = ds.group_by("k").count()
+        refc = sorted((r["k"], r["count"]) for r in cnt.collect())
+        gotc = sorted((r["k"], r["count"]) for b in cnt.stream_batches()
+                      for r in b.to_rows())
+        assert gotc == refc
+
+        srt = ds.sort_partition("v", ascending=False).first_n(10)
+        assert [r["v"] for b in srt.stream_batches()
+                for r in b.to_rows()] == [r["v"] for r in srt.collect()]
+
+        dst = ds.map(lambda c: {"k": c["k"]}).distinct("k")
+        assert sorted(r["k"] for b in dst.stream_batches()
+                      for r in b.to_rows()) == \
+            sorted(r["k"] for r in dst.collect())
+
+        # count() is streaming end-to-end
+        assert ds.count() == sum(1 for i in range(n) if i % 3 != 0)
+    finally:
+        external.memory_budget_rows = old
+
+
+def test_stream_plan_shared_subplan_materializes_once():
+    env = ExecutionEnvironment()
+    calls = {"n": 0}
+
+    def spy(cols):
+        calls["n"] += 1
+        return {"k": cols["k"], "v": cols["v"]}
+
+    base = env.from_columns({"k": np.arange(100) % 5,
+                             "v": np.ones(100)}).map(spy)
+    joined = base.join(base).where("k").equal_to("k").apply()
+    _ = [r for b in joined.stream_batches() for r in b.to_rows()]
+    # the shared mapped subplan ran ONCE (diamond memoization), not per side
+    assert calls["n"] == 1
+
+
+@pytest.mark.slow
+def test_stream_plan_peak_memory_bounded_by_budget(tmp_path):
+    """A 3-operator pipeline over FAR more rows than the budget completes
+    with peak RSS bounded: the plan never materializes its input or
+    output (sequence -> map -> filter -> count, 40M rows ~ 320MB/column
+    if materialized; chunks are budget-sized)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = textwrap.dedent(f"""
+        import resource, sys
+        sys.path.insert(0, {root!r})
+        from flink_tpu.dataset.api import ExecutionEnvironment
+        import numpy as np
+
+        n = 40_000_000
+        env = ExecutionEnvironment()
+        ds = (env.generate_sequence(1, n)
+              .map(lambda c: {{"value": np.asarray(c["value"]) * 2}})
+              .filter(lambda c: np.asarray(c["value"]) % 4 == 0))
+        assert ds.count() == n // 2
+        peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+        print("PEAK_MB", peak_mb)
+    """)
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=300)
+    assert "PEAK_MB" in out.stdout, out.stderr
+    peak_mb = float(out.stdout.split("PEAK_MB")[1].strip())
+    # materialized execution holds >= 3 full int64 columns (~960MB);
+    # streamed execution stays within interpreter+numpy baseline + chunks
+    assert peak_mb < 500, peak_mb
+
+
+def test_stream_plan_empty_result_keeps_schema():
+    """Streamed and materialized execution agree on empty results: the
+    stream yields one schema-carrying empty batch, count() matches
+    len(collect()), and dams over empty inputs see their columns."""
+    env = ExecutionEnvironment()
+    ds = (env.from_columns({"v": np.arange(10.0)})
+          .filter(lambda c: np.asarray(c["v"]) < 0))
+    assert ds.count() == 0
+    batches = list(ds.stream_batches())
+    assert len(batches) == 1 and len(batches[0]) == 0
+    assert list(batches[0].columns) == ["v"]
+    # a global agg over the empty stream matches collect()
+    s = ds.sum("v")
+    assert [r for b in s.stream_batches() for r in b.to_rows()] == s.collect()
+    # an outer join with an empty side keeps BOTH sides' columns
+    right = env.from_columns({"v": np.arange(3.0), "b": np.ones(3)})
+    j = ds.full_outer_join(right).where("v").equal_to("v").apply()
+    got = sorted(tuple(sorted(r)) for b in j.stream_batches()
+                 for r in b.to_rows())
+    ref = sorted(tuple(sorted(r)) for r in j.collect())
+    assert got == ref and len(ref) == 3
