@@ -199,6 +199,22 @@ def _cmd_logservice(args) -> int:
     return 0
 
 
+def _cmd_s3(args) -> int:
+    from flink_tpu.filesystems import S3CompatibleServer
+
+    srv = S3CompatibleServer(args.dir, access_key=args.access_key,
+                             secret_key=args.secret_key,
+                             region=args.region,
+                             host=args.host, port=args.port)
+    print(f"S3-compatible endpoint on {srv.url} (dir={args.dir}, "
+          f"SigV4 region={args.region})")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def _cmd_objectstore(args) -> int:
     from flink_tpu.runtime.checkpoint.objectstore import ObjectStoreServer
 
@@ -316,6 +332,15 @@ def main(argv=None) -> int:
     pos.add_argument("--host", default="127.0.0.1")
     pos.add_argument("--port", type=int, default=9000)
     pos.set_defaults(fn=_cmd_objectstore)
+    ps3 = sub.add_parser("s3", help="S3-compatible endpoint (real SigV4 "
+                         "REST dialect) over a local directory")
+    ps3.add_argument("--dir", required=True)
+    ps3.add_argument("--access-key", required=True)
+    ps3.add_argument("--secret-key", required=True)
+    ps3.add_argument("--region", default="us-east-1")
+    ps3.add_argument("--host", default="127.0.0.1")
+    ps3.add_argument("--port", type=int, default=9001)
+    ps3.set_defaults(fn=_cmd_s3)
     for name, needs_job in (("list", False), ("status", True),
                             ("cancel", True), ("savepoint", True),
                             ("stop", True)):

@@ -320,8 +320,12 @@ class ObjectStoreCheckpointStorage:
     objects plus a ``_metadata.json`` published LAST (readers only trust
     checkpoints whose metadata object exists — the atomic-rename analog)."""
 
-    def __init__(self, url: str, prefix: str = "", retain: int = 3):
-        self.client = ObjectStoreClient(url)
+    def __init__(self, url: str, prefix: str = "", retain: int = 3,
+                 client=None):
+        """``client``: any object with put/get/list/delete — the same
+        layout+metadata protocol then runs over other stores (e.g. the S3
+        dialect, ``filesystems/s3.py``)."""
+        self.client = client if client is not None else ObjectStoreClient(url)
         self.prefix = prefix
         self.retain = retain
 

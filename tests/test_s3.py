@@ -1,0 +1,228 @@
+"""Real S3 protocol (VERDICT r2 #4): AWS Signature V4 signing verified
+against the AWS-published example vector, a path-style S3 REST client and
+an S3-compatible server that any ecosystem client can point at, and the
+checkpoint-storage seam over the dialect.
+
+Environment note: this image has no third-party S3 server (no MinIO, no
+boto3) and no network egress, so ground truth for protocol correctness is
+(a) the AWS documentation's published signing vector (independent of this
+repo's code) and (b) raw hand-constructed HTTP requests that bypass the
+client class entirely.
+"""
+
+import hashlib
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.filesystems import (S3Client, S3CompatibleServer, sign_v4)
+
+
+# ---------------------------------------------------------------------------
+# known-answer test: the AWS documentation's SigV4 example
+# ---------------------------------------------------------------------------
+
+def test_sigv4_aws_documented_example_vector():
+    """The exact worked example from the AWS 'Signature Version 4 signing
+    process' documentation (IAM ListUsers, 20150830) — an independent
+    ground truth for the signer."""
+    headers = sign_v4(
+        "GET",
+        "https://iam.amazonaws.com/?Action=ListUsers&Version=2010-05-08",
+        {"host": "iam.amazonaws.com",
+         "content-type": "application/x-www-form-urlencoded; charset=utf-8"},
+        hashlib.sha256(b"").hexdigest(),
+        access_key="AKIDEXAMPLE",
+        secret_key="wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+        region="us-east-1", service="iam",
+        amz_date="20150830T123600Z")
+    auth = headers["Authorization"]
+    assert auth == (
+        "AWS4-HMAC-SHA256 "
+        "Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request, "
+        "SignedHeaders=content-type;host;x-amz-date, "
+        "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82"
+        "c400e06b5924a6f2b5d7")
+
+
+# ---------------------------------------------------------------------------
+# client <-> server over the real dialect
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def s3(tmp_path):
+    srv = S3CompatibleServer(str(tmp_path / "s3"), access_key="AKIA_TEST",
+                             secret_key="secret123").start()
+    yield srv
+    srv.stop()
+
+
+def test_put_get_list_delete_roundtrip(s3):
+    c = s3.client("data")
+    c.put_object("a/1.bin", b"hello")
+    c.put_object("a/2.bin", b"world!")
+    c.put_object("b/3.bin", b"x")
+    assert c.get_object("a/2.bin") == b"world!"
+    objs = c.list_objects("a/")
+    assert [o["key"] for o in objs] == ["a/1.bin", "a/2.bin"]
+    assert [o["size"] for o in objs] == [5, 6]
+    c.delete_object("a/1.bin")
+    assert c.list_keys("a/") == ["a/2.bin"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        c.get_object("a/1.bin")
+    assert ei.value.code == 404
+
+
+def test_list_objects_v2_pagination(s3):
+    s3.MAX_KEYS = 7      # force continuation tokens
+    c = s3.client("pager")
+    for i in range(23):
+        c.put_object(f"k{i:03d}", b"v")
+    keys = c.list_keys("k")
+    assert keys == [f"k{i:03d}" for i in range(23)]
+
+
+def test_signature_rejections(s3):
+    good = s3.client("sec")
+    good.put_object("k", b"v")
+    # wrong secret -> SignatureDoesNotMatch
+    bad = S3Client(s3.url, "sec", "AKIA_TEST", "WRONG")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        bad.get_object("k")
+    assert ei.value.code == 403
+    # unknown access key
+    bad2 = S3Client(s3.url, "sec", "AKIA_NOPE", "secret123")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        bad2.get_object("k")
+    assert ei.value.code == 403
+    # unsigned request
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{s3.url}/sec/k", timeout=5)
+    assert ei.value.code == 403
+    # signed payload hash must MATCH the body (tamper detection)
+    body = b"tampered"
+    url = f"{s3.url}/sec/k2"
+    host = url.split("//")[1].split("/")[0]
+    wrong_hash = hashlib.sha256(b"original").hexdigest()
+    headers = sign_v4("PUT", url,
+                      {"host": host, "x-amz-content-sha256": wrong_hash},
+                      wrong_hash, "AKIA_TEST", "secret123", "us-east-1")
+    req = urllib.request.Request(url, data=body, method="PUT",
+                                 headers=headers)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 400          # XAmzContentSHA256Mismatch
+    # stale x-amz-date -> RequestTimeTooSkewed
+    old_hash = hashlib.sha256(b"").hexdigest()
+    headers = sign_v4("GET", f"{s3.url}/sec/k",
+                      {"host": host, "x-amz-content-sha256": old_hash},
+                      old_hash, "AKIA_TEST", "secret123", "us-east-1",
+                      amz_date="20200101T000000Z")
+    req = urllib.request.Request(f"{s3.url}/sec/k", headers=headers)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 403
+
+
+def test_raw_http_client_independence(s3):
+    """A hand-constructed request (urllib + sign_v4 only — no S3Client)
+    interoperates with the server, and the server's responses parse as the
+    documented XML dialect."""
+    import xml.etree.ElementTree as ET
+
+    url = f"{s3.url}/raw/path%20with%20space.txt"
+    host = url.split("//")[1].split("/")[0]
+    body = b"raw bytes"
+    h = hashlib.sha256(body).hexdigest()
+    headers = sign_v4("PUT", url, {"host": host,
+                                   "x-amz-content-sha256": h},
+                      h, "AKIA_TEST", "secret123", "us-east-1")
+    urllib.request.urlopen(urllib.request.Request(
+        url, data=body, method="PUT", headers=headers), timeout=5).read()
+
+    lh = hashlib.sha256(b"").hexdigest()
+    lurl = f"{s3.url}/raw?list-type=2&prefix="
+    headers = sign_v4("GET", lurl, {"host": host,
+                                    "x-amz-content-sha256": lh},
+                      lh, "AKIA_TEST", "secret123", "us-east-1")
+    with urllib.request.urlopen(urllib.request.Request(
+            lurl, headers=headers), timeout=5) as r:
+        root = ET.fromstring(r.read())
+    assert root.tag.endswith("ListBucketResult")
+    ns = root.tag.split("}")[0] + "}"
+    keys = [c.findtext(f"{ns}Key") for c in root.findall(f"{ns}Contents")]
+    assert keys == ["path with space.txt"]
+
+
+# ---------------------------------------------------------------------------
+# the checkpoint seam over S3
+# ---------------------------------------------------------------------------
+
+def test_s3_checkpoint_storage_roundtrip(s3):
+    from flink_tpu.filesystems.s3 import S3CheckpointStorage
+
+    st = S3CheckpointStorage(s3.url, "ckpts", "AKIA_TEST", "secret123",
+                             retain=2)
+    for cid in (1, 2, 3):
+        st.store(cid, {"op-a": {"x": np.arange(cid)},
+                       "op-b": {"y": cid}})
+    assert st.checkpoint_ids() == [2, 3]         # retention pruned cid 1
+    snap = st.load_latest()
+    assert snap["op-b"]["y"] == 3
+    assert np.array_equal(snap["op-a"]["x"], np.arange(3))
+
+
+def test_s3_backs_a_streaming_job_checkpoint(s3):
+    """A real pipeline checkpoints THROUGH the S3 protocol and restores
+    from it — the object-store seam speaking the ecosystem dialect."""
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.filesystems.s3 import S3CheckpointStorage
+
+    st = S3CheckpointStorage(s3.url, "jobs", "AKIA_TEST", "secret123")
+    env = StreamExecutionEnvironment()
+    env.enable_checkpointing(20, storage=st)
+    n = 4000
+    res = (env.from_collection(
+                columns={"k": (np.arange(n) % 5).astype(np.int64),
+                         "v": np.ones(n)}, batch_size=64)
+           .key_by("k").sum("v", output_column="total").collect())
+    env.execute()
+    finals = {}
+    for r in res.rows():
+        finals[int(r["k"])] = max(finals.get(int(r["k"]), 0.0),
+                                  float(r["total"]))
+    assert finals == {k: float(n // 5) for k in range(5)}
+    assert st.checkpoint_ids(), "at least one checkpoint reached the bucket"
+    snap = st.load_latest()
+    assert snap
+
+
+def test_path_traversal_and_head_auth_rejected(s3):
+    """Security regressions: dot-segment buckets/keys are rejected (no
+    escape from the served directory) and HEAD requires SigV4 like every
+    other verb."""
+    c = s3.client("..")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        c.put_object("pwn", b"outside!")
+    assert ei.value.code == 400
+    c2 = s3.client("ok")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        c2.put_object("..", b"x")
+    assert ei.value.code == 400
+    # unauthenticated HEAD must not disclose existence/size
+    c2.put_object("secret", b"12345")
+    req = urllib.request.Request(f"{s3.url}/ok/secret", method="HEAD")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 403
+    # malformed Credential scope -> 403, never a 500
+    req = urllib.request.Request(
+        f"{s3.url}/ok/secret",
+        headers={"Authorization": "AWS4-HMAC-SHA256 Credential=AKIA_TEST, "
+                                  "SignedHeaders=host, Signature=x",
+                 "x-amz-date": "20990101T000000Z"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 403
