@@ -47,6 +47,42 @@ class _PendingCheckpoint:
     enumerators: Optional[Dict[str, Any]] = None
 
 
+def _vertex_watermark(tasks) -> Optional[int]:
+    """Min current watermark across a vertex's subtasks (the per-vertex
+    ``currentInputWatermark`` metric the reference UI shows), or None
+    before any watermark arrived."""
+    from flink_tpu.core.batch import LONG_MIN
+
+    wms = []
+    for t in tasks:
+        valve = getattr(t, "_valve", None)
+        if valve is not None:
+            wms.append(valve.current)
+        else:
+            op_wm = getattr(t.operator, "watermark", None)
+            if isinstance(op_wm, int):
+                wms.append(op_wm)
+    if not wms or any(w == LONG_MIN for w in wms):
+        return None                     # not established vertex-wide yet
+    return min(wms)
+
+
+def _state_size(tree) -> int:
+    """Approximate serialized checkpoint size: array nbytes + byte-string
+    lengths through the nested snapshot (cheap — no re-pickling)."""
+    import numpy as np
+
+    if isinstance(tree, dict):
+        return sum(_state_size(v) for v in tree.values())
+    if isinstance(tree, (list, tuple)):
+        return sum(_state_size(v) for v in tree)
+    if isinstance(tree, np.ndarray):
+        return int(tree.nbytes)
+    if isinstance(tree, (bytes, bytearray)):
+        return len(tree)
+    return 8
+
+
 @dataclass
 class JobResult:
     job_name: str
@@ -88,6 +124,12 @@ class MiniCluster(TaskListener):
         self._finished: set = set()
         self._source_tasks: List[SourceSubtask] = []
         self._subtask_counts: Dict[str, int] = {}
+        #: per-checkpoint stats (CheckpointStatsTracker analog) — id,
+        #: duration, state size; surfaced by REST + the dashboard
+        self._checkpoint_stats: List[Dict[str, Any]] = []
+        #: every task failure ever seen (JobExceptionsHandler's history,
+        #: not just the current root cause); bounded
+        self._exception_history: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------ listener
     def task_state_changed(self, vertex_uid: str, subtask_index: int,
@@ -96,6 +138,11 @@ class MiniCluster(TaskListener):
             with self._lock:
                 if self._failed is None:
                     self._failed = f"{vertex_uid}[{subtask_index}]: {error}"
+                self._exception_history.append({
+                    "timestamp_ms": int(time.time() * 1000),
+                    "task": f"{vertex_uid}[{subtask_index}]",
+                    "exception": str(error)})
+                del self._exception_history[:-50]   # bounded history
         elif state == TaskStates.FINISHED:
             with self._lock:
                 self._finished.add((vertex_uid, subtask_index))
@@ -146,6 +193,13 @@ class MiniCluster(TaskListener):
             self.checkpoint_storage.store(p.checkpoint_id, assembled)
         self._completed_ids.append(p.checkpoint_id)
         self._latest_snapshot = assembled
+        self._checkpoint_stats.append({
+            "id": p.checkpoint_id,
+            "completed_at_ms": int(time.time() * 1000),
+            "duration_ms": round((time.monotonic() - p.started_at) * 1000, 1),
+            "state_size_bytes": _state_size(assembled),
+            "acked_subtasks": len(p.acks)})
+        del self._checkpoint_stats[:-100]           # bounded history
         for t in self._tasks:
             t.commands.put(("notify_complete", p.checkpoint_id))
 
@@ -463,6 +517,7 @@ class MiniCluster(TaskListener):
                 "idle_ratio": sum(t.idle_ns for t in ts) / total_ns,
                 "backpressure_ratio":
                     sum(t.backpressure_ns for t in ts) / total_ns,
+                "watermark": _vertex_watermark(ts),
             })
         states = [t.state for t in tasks]
         terminal = (TaskStates.FINISHED, TaskStates.CANCELED)
@@ -480,6 +535,8 @@ class MiniCluster(TaskListener):
             "state": job_state,
             "vertices": vertices,
             "completed_checkpoints": list(self._completed_ids),
+            "checkpoint_stats": list(self._checkpoint_stats),
+            "exception_history": list(self._exception_history),
             "failure": self._failed,
         }
 

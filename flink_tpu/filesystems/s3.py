@@ -346,7 +346,9 @@ class S3CompatibleServer:
                     return self._ok()
                 path = self._obj_path(bucket, key)
                 os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = path + ".tmp"
+                # '#' never occurs in quote(safe="") output, so this temp
+                # name can never collide with (or shadow) a stored object
+                tmp = path + "#tmp"
                 with open(tmp, "wb") as f:
                     f.write(body)
                     f.flush()
@@ -383,10 +385,21 @@ class S3CompatibleServer:
                 if bk is None:
                     return
                 bucket, key = bk
-                try:
-                    os.remove(self._obj_path(bucket, key))
-                except FileNotFoundError:
-                    pass
+                if not key:
+                    # DeleteBucket: only when empty (the S3 contract)
+                    bdir = os.path.join(server.directory,
+                                        urllib.parse.quote(bucket, safe=""))
+                    try:
+                        os.rmdir(bdir)
+                    except FileNotFoundError:
+                        pass
+                    except OSError:
+                        return self._error(409, "BucketNotEmpty", bucket)
+                else:
+                    try:
+                        os.remove(self._obj_path(bucket, key))
+                    except (FileNotFoundError, IsADirectoryError, OSError):
+                        pass
                 self.send_response(204)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
@@ -419,7 +432,7 @@ class S3CompatibleServer:
                 if os.path.isdir(bdir):
                     keys = sorted(
                         urllib.parse.unquote(n) for n in os.listdir(bdir)
-                        if not n.endswith(".tmp"))
+                        if not n.endswith("#tmp"))
                 keys = [k for k in keys if k.startswith(prefix)
                         and (not start or k > start)]
                 page = keys[:server.MAX_KEYS]
@@ -427,9 +440,12 @@ class S3CompatibleServer:
                 items = []
                 for k in page:
                     p = os.path.join(bdir, urllib.parse.quote(k, safe=""))
+                    with open(p, "rb") as f:
+                        etag = hashlib.md5(f.read()).hexdigest()
                     items.append(
                         f"<Contents><Key>{_xml_escape(k)}</Key>"
                         f"<Size>{os.path.getsize(p)}</Size>"
+                        f"<ETag>&quot;{etag}&quot;</ETag>"
                         f"<StorageClass>STANDARD</StorageClass></Contents>")
                 nxt = (f"<NextContinuationToken>{_xml_escape(page[-1])}"
                        f"</NextContinuationToken>") if truncated else ""

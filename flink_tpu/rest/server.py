@@ -142,7 +142,14 @@ class RestServer:
                 if sub == "checkpoints":
                     return self._send({
                         "completed": status["completed_checkpoints"],
-                        "count": len(status["completed_checkpoints"])})
+                        "count": len(status["completed_checkpoints"]),
+                        # per-checkpoint duration/size history
+                        # (CheckpointStatsTracker analog)
+                        "history": status.get("checkpoint_stats", [])})
+                if sub == "watermarks":
+                    return self._send({"vertices": [
+                        {"id": v["id"], "watermark": v.get("watermark")}
+                        for v in status["vertices"]]})
                 if sub == "backpressure":
                     return self._send({"vertices": [
                         {"id": v["id"],
@@ -159,7 +166,9 @@ class RestServer:
                         "latency_ms": _percentiles(
                             cluster.sink_latencies_ms())})
                 if sub == "exceptions":
-                    return self._send({"root_exception": status["failure"]})
+                    return self._send({
+                        "root_exception": status["failure"],
+                        "history": status.get("exception_history", [])})
                 if sub == "flamegraph":
                     from flink_tpu.rest.flamegraph import flamegraph
                     # scope to THIS job's subtask threads — concurrent jobs
@@ -282,10 +291,15 @@ _DASHBOARD_HTML = """<!DOCTYPE html>
   <span style="--c:var(--bp)">backpressured</span>
   <span style="--c:var(--idle)">idle</span></div>
  <table id="verts"><thead><tr><th>vertex</th><th>par</th><th>state</th>
- <th>records in / out</th><th>time share</th></tr></thead><tbody></tbody>
+ <th>records in / out</th><th>watermark</th><th>time share</th></tr></thead>
+ <tbody></tbody>
  </table>
  <h2>Latency (source&rarr;sink)</h2><div class="tiles" id="lat"></div>
- <h2>Checkpoints</h2><div id="ckpts" style="font-size:.88rem"></div>
+ <h2>Checkpoints</h2>
+ <table id="cktab"><thead><tr><th>id</th><th>completed</th>
+ <th>duration</th><th>state size</th><th>acked subtasks</th></tr></thead>
+ <tbody></tbody></table>
+ <div id="ckpts" style="font-size:.88rem;color:var(--text-2)"></div>
  <div id="exc"></div>
  <h2>Flame graph <button onclick="flame()">sample</button></h2>
  <div id="flame"></div>
@@ -337,6 +351,7 @@ async function refresh(){
     tr.innerHTML=`<td>${esc(v.id)}</td><td>${v.parallelism}</td>`+
      `<td>${esc((v.status||[]).join(','))}</td>`+
      `<td>${v.records_in.toLocaleString()} / ${v.records_out.toLocaleString()}</td>`+
+     `<td>${v.watermark==null?'&mdash;':v.watermark.toLocaleString()}</td>`+
      `<td><div class="ratio" title="busy ${pct(v.busy_ratio)} · `+
      `backpressured ${pct(v.backpressure_ratio)} · idle ${pct(v.idle_ratio)}">`+
      `<div style="width:${v.busy_ratio*100}%;background:var(--busy)"></div>`+
@@ -351,12 +366,30 @@ async function refresh(){
     .map(k=>tile(k,lat[k].toFixed(1)+' ms')).join('')||
     '<span style="color:var(--text-2);font-size:.85rem">no samples yet</span>';
   const ck=await J('/jobs/'+sel+'/checkpoints');
+  const fmtB=b=>b>=1048576?(b/1048576).toFixed(1)+' MB':
+    b>=1024?(b/1024).toFixed(1)+' KB':b+' B';
+  const cb=document.querySelector('#cktab tbody');cb.innerHTML='';
+  for(const c of (ck.history||[]).slice(-12).reverse()){
+    const tr=document.createElement('tr');
+    tr.innerHTML=`<td>${c.id}</td>`+
+     `<td>${new Date(c.completed_at_ms).toLocaleTimeString()}</td>`+
+     `<td>${c.duration_ms} ms</td><td>${fmtB(c.state_size_bytes)}</td>`+
+     `<td>${c.acked_subtasks}</td>`;
+    cb.appendChild(tr);
+  }
   document.getElementById('ckpts').textContent=
-    ck.count?('completed: '+ck.completed.join(', ')):'none yet';
+    ck.count?('completed: '+ck.count):'none yet';
   const ex=await J('/jobs/'+sel+'/exceptions');
-  document.getElementById('exc').innerHTML=ex.root_exception?
+  let exh='';
+  if((ex.history||[]).length){
+    exh='<h2>Exception history</h2>'+ex.history.slice(-8).reverse()
+      .map(e=>'<div class="err">'+
+        new Date(e.timestamp_ms).toLocaleTimeString()+' '+
+        esc(e.task)+': '+esc(e.exception)+'</div>').join('');
+  }
+  document.getElementById('exc').innerHTML=(ex.root_exception?
     ('<h2>Root exception</h2><div class="err">'+esc(ex.root_exception)+
-     '</div>'):'';
+     '</div>'):'')+exh;
 }
 async function act(ev,id,verb){ev.stopPropagation();
   await fetch('/jobs/'+id+'/'+verb,{method:'POST'});refresh()}

@@ -226,3 +226,25 @@ def test_path_traversal_and_head_auth_rejected(s3):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req, timeout=5)
     assert ei.value.code == 403
+
+
+def test_tmp_suffix_keys_and_bucket_delete(s3):
+    """Review regressions: keys ending in '.tmp' are first-class objects
+    (no temp-file collision, listed normally); DeleteBucket follows the
+    S3 contract (204 when empty, 409 BucketNotEmpty otherwise); list
+    entries carry real ETags."""
+    c = s3.client("edge")
+    c.put_object("k.tmp", b"first")
+    c.put_object("k", b"second")
+    assert c.get_object("k.tmp") == b"first"
+    objs = c.list_objects()
+    assert [o["key"] for o in objs] == ["k", "k.tmp"]
+    import hashlib as _h
+    assert objs[0]["etag"] == _h.md5(b"second").hexdigest()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        c._request("DELETE").read()          # bucket not empty
+    assert ei.value.code == 409
+    c.delete_object("k")
+    c.delete_object("k.tmp")
+    c._request("DELETE").read()              # now empty: 204
+    assert c.list_objects() == []
