@@ -215,6 +215,23 @@ def _cmd_s3(args) -> int:
     return 0
 
 
+def _cmd_kafka(args) -> int:
+    from flink_tpu.connectors.kafka import KafkaWireBroker
+
+    b = KafkaWireBroker(host=args.host, port=args.port,
+                        directory=args.dir)
+    for t in args.topic or []:
+        name, _, parts = t.partition(":")
+        b.create_topic(name, int(parts or 1))
+    b.start()
+    print(f"kafka-wire broker on {b.host}:{b.port} (dir={args.dir})")
+    try:
+        b._thread.join()
+    except KeyboardInterrupt:
+        b.stop()
+    return 0
+
+
 def _cmd_objectstore(args) -> int:
     from flink_tpu.runtime.checkpoint.objectstore import ObjectStoreServer
 
@@ -341,6 +358,14 @@ def main(argv=None) -> int:
     ps3.add_argument("--host", default="127.0.0.1")
     ps3.add_argument("--port", type=int, default=9001)
     ps3.set_defaults(fn=_cmd_s3)
+    pk = sub.add_parser("kafka", help="broker speaking the Kafka v0 binary "
+                        "wire protocol over per-partition logs")
+    pk.add_argument("--dir", default=None)
+    pk.add_argument("--host", default="127.0.0.1")
+    pk.add_argument("--port", type=int, default=9092)
+    pk.add_argument("--topic", action="append",
+                    help="name[:partitions], repeatable")
+    pk.set_defaults(fn=_cmd_kafka)
     for name, needs_job in (("list", False), ("status", True),
                             ("cancel", True), ("savepoint", True),
                             ("stop", True)):

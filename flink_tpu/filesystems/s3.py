@@ -346,15 +346,21 @@ class S3CompatibleServer:
                     return self._ok()
                 path = self._obj_path(bucket, key)
                 os.makedirs(os.path.dirname(path), exist_ok=True)
-                # '#' never occurs in quote(safe="") output, so this temp
-                # name can never collide with (or shadow) a stored object
-                tmp = path + "#tmp"
+                # '#' never occurs in quote(safe="") output, so these
+                # sidecar names can never collide with a stored object; the
+                # temp suffix is per-request unique (concurrent PUTs of one
+                # key must not interleave through a shared temp file)
+                import uuid as _uuid
+                tmp = f"{path}#tmp{_uuid.uuid4().hex[:8]}"
                 with open(tmp, "wb") as f:
                     f.write(body)
                     f.flush()
                     os.fsync(f.fileno())
-                os.replace(tmp, path)
                 etag = hashlib.md5(body).hexdigest()
+                with open(tmp + "e", "w") as f:
+                    f.write(etag)
+                os.replace(tmp + "e", path + "#etag")
+                os.replace(tmp, path)
                 self.send_response(200)
                 self.send_header("ETag", f'"{etag}"')
                 self.send_header("Content-Length", "0")
@@ -396,9 +402,16 @@ class S3CompatibleServer:
                     except OSError:
                         return self._error(409, "BucketNotEmpty", bucket)
                 else:
+                    path = self._obj_path(bucket, key)
                     try:
-                        os.remove(self._obj_path(bucket, key))
-                    except (FileNotFoundError, IsADirectoryError, OSError):
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass            # idempotent delete (S3 semantics)
+                    except OSError as e:
+                        return self._error(500, "InternalError", str(e))
+                    try:
+                        os.remove(path + "#etag")
+                    except OSError:
                         pass
                 self.send_response(204)
                 self.send_header("Content-Length", "0")
@@ -432,7 +445,7 @@ class S3CompatibleServer:
                 if os.path.isdir(bdir):
                     keys = sorted(
                         urllib.parse.unquote(n) for n in os.listdir(bdir)
-                        if not n.endswith("#tmp"))
+                        if "#" not in n)     # sidecars/temps never list
                 keys = [k for k in keys if k.startswith(prefix)
                         and (not start or k > start)]
                 page = keys[:server.MAX_KEYS]
@@ -440,11 +453,19 @@ class S3CompatibleServer:
                 items = []
                 for k in page:
                     p = os.path.join(bdir, urllib.parse.quote(k, safe=""))
-                    with open(p, "rb") as f:
-                        etag = hashlib.md5(f.read()).hexdigest()
+                    try:
+                        size = os.path.getsize(p)
+                        try:  # ETag stored at PUT time (no O(data) reads)
+                            with open(p + "#etag") as f:
+                                etag = f.read().strip()
+                        except OSError:
+                            with open(p, "rb") as f:
+                                etag = hashlib.md5(f.read()).hexdigest()
+                    except OSError:
+                        continue        # deleted concurrently: skip entry
                     items.append(
                         f"<Contents><Key>{_xml_escape(k)}</Key>"
-                        f"<Size>{os.path.getsize(p)}</Size>"
+                        f"<Size>{size}</Size>"
                         f"<ETag>&quot;{etag}&quot;</ETag>"
                         f"<StorageClass>STANDARD</StorageClass></Contents>")
                 nxt = (f"<NextContinuationToken>{_xml_escape(page[-1])}"

@@ -1,0 +1,178 @@
+"""Kafka binary wire protocol (closing the 'Kafka's wire protocol is NOT
+spoken' gap): frame/message-set encoding with CRC verification, client ↔
+broker over real TCP frames, raw hand-built requests (client
+independence), persistence across broker restarts, and the source/sink
+seams feeding a pipeline.
+
+Environment note: no real Kafka broker exists in this image (no JVM
+Kafka, no kafka-python), so ground truth is the published v0 wire format
+(fixed framing + CRC32 message sets) exercised by BOTH an independent
+raw-socket test and the structured client.
+"""
+
+import json
+import socket
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.kafka import (KafkaWireBroker, KafkaWireClient,
+                                        KafkaWireSink, KafkaWireSource,
+                                        decode_message_set,
+                                        encode_message_set,
+                                        encode_message_v0)
+
+
+@pytest.fixture
+def broker(tmp_path):
+    b = KafkaWireBroker(directory=str(tmp_path / "kafka")).start()
+    yield b
+    b.stop()
+
+
+def test_message_v0_layout_and_crc():
+    """The v0 message layout is fixed by the protocol: crc:uint32 magic:0
+    attributes:0 key:bytes value:bytes, crc over magic..value."""
+    m = encode_message_v0(b"k", b"hello")
+    crc = struct.unpack(">I", m[:4])[0]
+    assert crc == zlib.crc32(m[4:]) & 0xFFFFFFFF
+    assert m[4] == 0 and m[5] == 0                 # magic, attributes
+    assert struct.unpack(">i", m[6:10])[0] == 1    # key length
+    assert m[10:11] == b"k"
+    assert struct.unpack(">i", m[11:15])[0] == 5   # value length
+    assert m[15:] == b"hello"
+    # null key encodes as length -1
+    m2 = encode_message_v0(None, b"x")
+    assert struct.unpack(">i", m2[6:10])[0] == -1
+
+    # roundtrip + corruption detection
+    ms = encode_message_set([(7, b"k", b"v"), (8, None, b"w")])
+    assert decode_message_set(ms) == [(7, b"k", b"v"), (8, None, b"w")]
+    corrupted = ms[:14] + bytes([ms[14] ^ 0xFF]) + ms[15:]
+    with pytest.raises(ValueError, match="CRC"):
+        decode_message_set(corrupted)
+
+
+def test_client_broker_roundtrip(broker):
+    broker.create_topic("t", partitions=2)
+    c = KafkaWireClient(broker.host, broker.port)
+    try:
+        versions = dict((k, (lo, hi)) for k, lo, hi in c.api_versions())
+        assert versions[0] == (0, 0) and versions[1] == (0, 0)
+        meta = c.metadata(["t"])
+        assert meta["brokers"][0]["port"] == broker.port
+        assert len(meta["topics"][0]["partitions"]) == 2
+
+        base = c.produce("t", 0, [(b"a", b"1"), (b"b", b"2")])
+        assert base == 0
+        assert c.produce("t", 0, [(None, b"3")]) == 2
+        msgs, hw = c.fetch("t", 0, 0)
+        assert hw == 3
+        assert [(o, k, v) for o, k, v in msgs] == \
+            [(0, b"a", b"1"), (1, b"b", b"2"), (2, None, b"3")]
+        # offset resume + latest
+        msgs2, _ = c.fetch("t", 0, 2)
+        assert msgs2 == [(2, None, b"3")]
+        assert c.latest_offset("t", 0) == 3
+        assert c.latest_offset("t", 1) == 0
+        with pytest.raises(IndexError):
+            c.fetch("t", 0, 99)
+    finally:
+        c.close()
+
+
+def test_raw_socket_client_independence(broker):
+    """Hand-built frames over a bare socket — no client class involved —
+    must interoperate: the broker speaks the published wire format, not a
+    private dialect."""
+    broker.create_topic("raw", partitions=1)
+    s = socket.create_connection((broker.host, broker.port), timeout=10)
+    try:
+        # Produce v0, hand-assembled: header + acks/timeout + topic array
+        msg = encode_message_v0(None, b"payload")
+        mset = struct.pack(">qi", 0, len(msg)) + msg
+        body = (struct.pack(">hi", -1, 5000)
+                + struct.pack(">i", 1)                       # 1 topic
+                + struct.pack(">h", 3) + b"raw"
+                + struct.pack(">i", 1)                       # 1 partition
+                + struct.pack(">i", 0)
+                + struct.pack(">i", len(mset)) + mset)
+        header = (struct.pack(">hhi", 0, 0, 42)              # Produce v0
+                  + struct.pack(">h", 4) + b"test")
+        frame = header + body
+        s.sendall(struct.pack(">i", len(frame)) + frame)
+        (size,) = struct.unpack(">i", s.recv(4))
+        resp = b""
+        while len(resp) < size:
+            resp += s.recv(size - len(resp))
+        corr, n_topics = struct.unpack(">ii", resp[:8])
+        assert corr == 42 and n_topics == 1
+        tlen = struct.unpack(">h", resp[8:10])[0]
+        assert resp[10:10 + tlen] == b"raw"
+        _nparts, part, err, base = struct.unpack(
+            ">iihq", resp[10 + tlen:10 + tlen + 18])
+        assert (part, err, base) == (0, 0, 0)
+    finally:
+        s.close()
+    # the structured client reads what the raw producer wrote
+    c = KafkaWireClient(broker.host, broker.port)
+    try:
+        msgs, hw = c.fetch("raw", 0, 0)
+        assert hw == 1 and msgs == [(0, None, b"payload")]
+    finally:
+        c.close()
+
+
+def test_broker_persistence_across_restart(tmp_path):
+    d = str(tmp_path / "klog")
+    b1 = KafkaWireBroker(directory=d).start()
+    b1.create_topic("dur", partitions=1)
+    c1 = KafkaWireClient(b1.host, b1.port)
+    c1.produce("dur", 0, [(b"k", b"v1"), (b"k", b"v2")])
+    c1.close()
+    b1.stop()
+
+    b2 = KafkaWireBroker(directory=d).start()
+    c2 = KafkaWireClient(b2.host, b2.port)
+    try:
+        msgs, hw = c2.fetch("dur", 0, 0)
+        assert hw == 2 and [v for _, _, v in msgs] == [b"v1", b"v2"]
+    finally:
+        c2.close()
+        b2.stop()
+
+
+def test_kafka_source_sink_pipeline(broker):
+    """A pipeline consumes a Kafka topic over the wire protocol and
+    produces results back to another topic."""
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    broker.create_topic("in", partitions=2)
+    broker.create_topic("out", partitions=1)
+    c = KafkaWireClient(broker.host, broker.port)
+    try:
+        for p in range(2):
+            for lo in range(0, 300, 100):
+                c.produce("in", p, [
+                    (None, json.dumps({"k": int(i % 5), "v": 1.0}).encode())
+                    for i in range(lo, lo + 100)])
+
+        env = StreamExecutionEnvironment()
+        src = KafkaWireSource(broker.host, broker.port, "in")
+        sink = KafkaWireSink(broker.host, broker.port, "out")
+        (env.from_source(src).key_by("k")
+            .sum("v", output_column="total").add_sink(sink))
+        env.execute()
+
+        rows = []
+        msgs, _ = c.fetch("out", 0, 0, max_bytes=1 << 22)
+        rows = [json.loads(v.decode()) for _, _, v in msgs]
+        finals = {}
+        for r in rows:
+            finals[int(r["k"])] = max(finals.get(int(r["k"]), 0.0),
+                                      r["total"])
+        assert finals == {k: 120.0 for k in range(5)}
+    finally:
+        c.close()
