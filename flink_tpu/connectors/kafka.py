@@ -222,7 +222,15 @@ class KafkaWireBroker:
                             f"{urllib.parse.quote(topic, safe='')}-{part}.log")
 
     def _load(self) -> None:
+        import json
         import urllib.parse
+        manifest = os.path.join(self.directory, "_topics.json")
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                for topic, n in json.load(f).items():
+                    self._logs.setdefault(topic, [])
+                    while len(self._logs[topic]) < n:
+                        self._logs[topic].append([])
         for name in sorted(os.listdir(self.directory)):
             if not name.endswith(".log"):
                 continue
@@ -243,6 +251,19 @@ class KafkaWireBroker:
             parts = self._logs.setdefault(topic, [])
             while len(parts) < partitions:
                 parts.append([])
+            self._persist_manifest_locked()
+
+    def _persist_manifest_locked(self) -> None:
+        """Topic/partition METADATA must survive restarts too — an empty
+        partition that vanished would fail keyed producers with
+        UNKNOWN_TOPIC after a restart."""
+        if not self.directory:
+            return
+        import json
+        tmp = os.path.join(self.directory, "_topics.json#tmp")
+        with open(tmp, "w") as f:
+            json.dump({t: len(p) for t, p in self._logs.items()}, f)
+        os.replace(tmp, os.path.join(self.directory, "_topics.json"))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "KafkaWireBroker":
@@ -367,7 +388,7 @@ class KafkaWireBroker:
                     continue
                 with self._lock:
                     parts = self._logs.get(topic)
-                    if parts is None or part >= len(parts):
+                    if parts is None or not 0 <= part < len(parts):
                         per_part.append((part, _ERR_UNKNOWN_TOPIC, -1))
                         continue
                     base = len(parts[part])
@@ -398,24 +419,23 @@ class KafkaWireBroker:
                 max_bytes = r.int32()
                 with self._lock:
                     parts = self._logs.get(topic)
-                    if parts is None or part >= len(parts):
+                    if parts is None or not 0 <= part < len(parts):
                         per_part.append((part, _ERR_UNKNOWN_TOPIC, -1, b""))
                         continue
                     log = parts[part]
                     hw = len(log)
-                    if offset > hw:
+                    if offset > hw or offset < 0:
                         per_part.append((part, _ERR_OFFSET_OUT_OF_RANGE,
                                          hw, b""))
                         continue
                     take, size = [], 0
                     for e in log[offset:]:
-                        m = encode_message_set([e])
+                        m = encode_message_set([e])   # encode ONCE
                         if take and size + len(m) > max_bytes:
                             break
-                        take.append(e)
+                        take.append(m)
                         size += len(m)
-                per_part.append((part, _ERR_NONE, hw,
-                                 encode_message_set(take)))
+                per_part.append((part, _ERR_NONE, hw, b"".join(take)))
             results.append((topic, per_part))
         w.array(results, lambda w, t: w.string(t[0]).array(
             t[1], lambda w, p: w.int32(p[0]).int16(p[1]).int64(p[2])
@@ -433,7 +453,7 @@ class KafkaWireBroker:
                 r.int32()                       # max_num_offsets
                 with self._lock:
                     parts = self._logs.get(topic)
-                    if parts is None or part >= len(parts):
+                    if parts is None or not 0 <= part < len(parts):
                         per_part.append((part, _ERR_UNKNOWN_TOPIC, []))
                         continue
                     hw = len(parts[part])
@@ -651,7 +671,10 @@ class KafkaWireSource:
             offset = 0
             max_bytes = 1 << 20
             rows: List[dict] = []
-            self._max_ts = None
+            # per-GENERATOR watermark state: split readers of one source
+            # instance interleave, and a shared running max would let a
+            # fast partition push a lagging one's records past lateness
+            wm_state = {"max_ts": None}
             while offset < end:
                 msgs, _hw = c.fetch(self.topic, part, offset,
                                     max_bytes=max_bytes)
@@ -674,21 +697,24 @@ class KafkaWireSource:
                     rows.append(json.loads(v.decode()))
                 while len(rows) >= self.batch_rows:
                     chunk, rows = rows[:self.batch_rows], rows[self.batch_rows:]
-                    yield from self._emit(chunk, RecordBatch, Watermark)
+                    yield from self._emit(chunk, RecordBatch, Watermark,
+                                          wm_state)
             if rows:
-                yield from self._emit(rows, RecordBatch, Watermark)
+                yield from self._emit(rows, RecordBatch, Watermark, wm_state)
         finally:
             c.close()
 
-    def _emit(self, rows, RecordBatch, Watermark):
+    def _emit(self, rows, RecordBatch, Watermark, wm_state=None):
         cols = {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
         if self.timestamp_column is not None:
             ts = np.asarray(cols[self.timestamp_column], np.int64)
             yield RecordBatch(cols, timestamps=ts)
-            if self.out_of_orderness_ms is not None:
-                self._max_ts = max(int(ts.max()),
-                                   self._max_ts or (1 << 63) * -1)
-                yield Watermark(self._max_ts - self.out_of_orderness_ms)
+            if self.out_of_orderness_ms is not None and wm_state is not None:
+                cur = wm_state["max_ts"]
+                nxt = int(ts.max())
+                wm_state["max_ts"] = nxt if cur is None else max(cur, nxt)
+                yield Watermark(wm_state["max_ts"]
+                                - self.out_of_orderness_ms)
         else:
             yield RecordBatch(cols)
 

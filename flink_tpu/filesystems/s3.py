@@ -219,6 +219,7 @@ class S3CompatibleServer:
         self.region = region
         self.require_auth = require_auth
         os.makedirs(directory, exist_ok=True)
+        self._put_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -359,8 +360,12 @@ class S3CompatibleServer:
                 etag = hashlib.md5(body).hexdigest()
                 with open(tmp + "e", "w") as f:
                     f.write(etag)
-                os.replace(tmp + "e", path + "#etag")
-                os.replace(tmp, path)
+                # finalize object+sidecar as one step under the server
+                # lock: racing same-key PUTs must not install one writer's
+                # object with the other's ETag
+                with server._put_lock:
+                    os.replace(tmp, path)
+                    os.replace(tmp + "e", path + "#etag")
                 self.send_response(200)
                 self.send_header("ETag", f'"{etag}"')
                 self.send_header("Content-Length", "0")
@@ -456,6 +461,9 @@ class S3CompatibleServer:
                     try:
                         size = os.path.getsize(p)
                         try:  # ETag stored at PUT time (no O(data) reads)
+                            if os.path.getmtime(p + "#etag") \
+                                    < os.path.getmtime(p):
+                                raise OSError("stale sidecar")  # crash gap
                             with open(p + "#etag") as f:
                                 etag = f.read().strip()
                         except OSError:

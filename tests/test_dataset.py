@@ -270,9 +270,12 @@ def test_stream_plan_peak_memory_bounded_by_budget(tmp_path):
                          capture_output=True, text=True, timeout=300)
     assert "PEAK_MB" in out.stdout, out.stderr
     peak_mb = float(out.stdout.split("PEAK_MB")[1].strip())
-    # materialized execution holds >= 3 full int64 columns (~960MB);
-    # streamed execution stays within interpreter+numpy baseline + chunks
-    assert peak_mb < 500, peak_mb
+    # materialized execution holds >= 3 full int64 columns (~960MB on top
+    # of the ~400MB interpreter+jax baseline => >=1.3GB); streamed
+    # execution stays near the baseline + budget-sized chunks.  700MB
+    # keeps allocator-arena headroom under load while still proving the
+    # plan never materialized
+    assert peak_mb < 700, peak_mb
 
 
 def test_stream_plan_empty_result_keeps_schema():

@@ -176,3 +176,31 @@ def test_kafka_source_sink_pipeline(broker):
         assert finals == {k: 120.0 for k in range(5)}
     finally:
         c.close()
+
+
+def test_topic_metadata_survives_restart_and_bad_ids_rejected(tmp_path):
+    """Review regressions: empty topics/partitions survive a broker
+    restart (durable manifest); negative partition ids and offsets error
+    instead of Python-indexing from the end."""
+    d = str(tmp_path / "kmeta")
+    b1 = KafkaWireBroker(directory=d).start()
+    b1.create_topic("t", partitions=2)
+    c1 = KafkaWireClient(b1.host, b1.port)
+    c1.produce("t", 0, [(None, b"x")])     # partition 1 stays EMPTY
+    with pytest.raises(ValueError):
+        c1.produce("t", -1, [(None, b"y")])
+    with pytest.raises(IndexError):
+        c1.fetch("t", 0, -2)
+    c1.close()
+    b1.stop()
+
+    b2 = KafkaWireBroker(directory=d).start()
+    c2 = KafkaWireClient(b2.host, b2.port)
+    try:
+        meta = c2.metadata(["t"])
+        assert len(meta["topics"][0]["partitions"]) == 2
+        assert c2.latest_offset("t", 1) == 0     # empty partition intact
+        assert c2.latest_offset("t", 0) == 1
+    finally:
+        c2.close()
+        b2.stop()
