@@ -263,6 +263,8 @@ class KafkaWireBroker:
         tmp = os.path.join(self.directory, "_topics.json#tmp")
         with open(tmp, "w") as f:
             json.dump({t: len(p) for t, p in self._logs.items()}, f)
+            f.flush()
+            os.fsync(f.fileno())   # as durable as the logs it describes
         os.replace(tmp, os.path.join(self.directory, "_topics.json"))
 
     # -- lifecycle ---------------------------------------------------------
@@ -671,9 +673,14 @@ class KafkaWireSource:
             offset = 0
             max_bytes = 1 << 20
             rows: List[dict] = []
-            # per-GENERATOR watermark state: split readers of one source
-            # instance interleave, and a shared running max would let a
-            # fast partition push a lagging one's records past lateness
+            # per-GENERATOR watermark state (each split reader tracks its
+            # own running max; a shared one would also get RESET by sibling
+            # generators starting up).  In the cluster runtimes each split
+            # is its own subtask, so downstream valves min-combine the
+            # per-partition watermarks correctly; the LOCAL depth-first
+            # executor funnels all splits into one valve channel (max),
+            # so there out_of_orderness_ms must also cover cross-partition
+            # event-time skew
             wm_state = {"max_ts": None}
             while offset < end:
                 msgs, _hw = c.fetch(self.topic, part, offset,

@@ -251,8 +251,11 @@ def test_stream_plan_peak_memory_bounded_by_budget(tmp_path):
     import textwrap
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # NOTE: VmHWM (per-mm, reset at execve), NOT getrusage ru_maxrss — the
+    # latter survives exec, so a child forked from a bloated pytest parent
+    # inherits the parent's high-water mark and fails spuriously
     script = textwrap.dedent(f"""
-        import resource, sys
+        import sys
         sys.path.insert(0, {root!r})
         from flink_tpu.dataset.api import ExecutionEnvironment
         import numpy as np
@@ -263,11 +266,19 @@ def test_stream_plan_peak_memory_bounded_by_budget(tmp_path):
               .map(lambda c: {{"value": np.asarray(c["value"]) * 2}})
               .filter(lambda c: np.asarray(c["value"]) % 4 == 0))
         assert ds.count() == n // 2
-        peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
-        print("PEAK_MB", peak_mb)
+        with open("/proc/self/status") as f:
+            hwm_kb = next(int(line.split()[1]) for line in f
+                          if line.startswith("VmHWM:"))
+        print("PEAK_MB", hwm_kb / 1024)
     """)
+    # hermetic child: CPU backend (a TPU client init would pollute the RSS
+    # measurement) and an EXPLICIT row budget (another test's leaked
+    # FLINK_TPU_BATCH_MEMORY_ROWS must not change what this test bounds)
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                     FLINK_TPU_BATCH_MEMORY_ROWS=str(1 << 22))
     out = subprocess.run([sys.executable, "-c", script],
-                         capture_output=True, text=True, timeout=300)
+                         capture_output=True, text=True, timeout=300,
+                         env=child_env)
     assert "PEAK_MB" in out.stdout, out.stderr
     peak_mb = float(out.stdout.split("PEAK_MB")[1].strip())
     # materialized execution holds >= 3 full int64 columns (~960MB on top
