@@ -120,6 +120,25 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.keydict_lookup.argtypes = [vp, vp, i64, vp]
     lib.keydict_reverse.restype = None
     lib.keydict_reverse.argtypes = [vp, vp]
+    i32 = ctypes.c_int32
+    lib.wm_create.restype = vp
+    lib.wm_create.argtypes = [vp, i32, u8p, u8p, vp]
+    lib.wm_destroy.restype = None
+    lib.wm_destroy.argtypes = [vp]
+    lib.wm_drop_pane.restype = None
+    lib.wm_drop_pane.argtypes = [vp, i64]
+    lib.wm_pane_count.restype = i64
+    lib.wm_pane_count.argtypes = [vp]
+    lib.wm_live_panes.restype = None
+    lib.wm_live_panes.argtypes = [vp, vp]
+    lib.wm_probe_update.restype = None
+    lib.wm_probe_update.argtypes = [vp, vp, vp, i64, vp, u8p, vp, i64, vp]
+    lib.wm_fire.restype = i64
+    lib.wm_fire.argtypes = [vp, vp, i32, vp, vp, vp]
+    lib.wm_export_pane.restype = i32
+    lib.wm_export_pane.argtypes = [vp, i64, i64, vp, vp]
+    lib.wm_import_pane.restype = None
+    lib.wm_import_pane.argtypes = [vp, i64, i64, vp, vp]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

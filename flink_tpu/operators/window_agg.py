@@ -164,7 +164,14 @@ class WindowAggOperator(StreamOperator):
         late_output_tag: Optional[str] = None,
         emit_tier: str = "auto",
         snapshot_source: str = "auto",
+        native_emit: bool = True,
     ):
+        #: host tier: use the C++ WinMirror kernels (fused probe+mirror,
+        #: compacting fire) when eligible; False pins the numpy mirror —
+        #: used by equivalence tests, and the portable fallback either way
+        self.native_emit = native_emit
+        self._nm = None          # NativeWindowMirror when active
+        self._nm_tried = False
         #: sideOutputLateData: beyond-lateness records emit as TaggedBatch
         #: on this tag instead of being dropped; the drop counter does NOT
         #: move for side-output rows (reference semantics)
@@ -384,6 +391,8 @@ class WindowAggOperator(StreamOperator):
         self._pending_fires = []
         self._mirror = {}
         self._vmirror = {}
+        self._nm = None          # keydict died with key_index
+        self._nm_tried = False
         self.pane_base = None
         self.max_pane = None
         self.last_fired_window = None
@@ -437,6 +446,17 @@ class WindowAggOperator(StreamOperator):
     def _phase(self, name: str):
         """Accumulating timer: ``with self._phase("mirror"): ...``."""
         return _PhaseTimer(self.phase_ns, name)
+
+    def _try_native_mirror(self) -> None:
+        """Bind the C++ WinMirror to the (fresh) key index, if eligible.
+        Called once per key-index lifetime; ineligible configs (object keys,
+        non-scalar leaves, no compiler) keep the numpy mirror."""
+        if self._nm_tried or self.emit_tier != "host" or not self.native_emit:
+            return
+        self._nm_tried = True
+        from flink_tpu.state.native_mirror import NativeWindowMirror
+        self._nm = NativeWindowMirror.try_create(
+            self.key_index, self.spec, self.kinds, self._mirror_dtypes)
 
     def _vmirror_pane(self, pane: int) -> list:
         """[counts, *leaves] arrays for a pane, allocated/grown to >= _K."""
@@ -498,6 +518,14 @@ class WindowAggOperator(StreamOperator):
         n = self.key_index.num_keys if self.key_index is not None else 0
         if n == 0:
             return []
+        if self._nm is not None:
+            # one C sweep: combine panes, compact non-empty rows, resolve keys
+            keys, _counts, leaves = self._nm.fire(panes)
+            if keys.size == 0:
+                return []
+            result = self.agg.host_get_result(self.spec.unflatten(leaves))
+            return self._rows_for_keys(
+                keys, result, self.assigner.window_bounds(window_id))
         entries = [self._vmirror[int(p)] for p in panes.tolist()
                    if int(p) in self._vmirror]
         if not entries:
@@ -531,7 +559,11 @@ class WindowAggOperator(StreamOperator):
         for p in range(self.pane_base, (self.max_pane or 0) + 1):
             slot = int(p) % self._P
             dev_counts = np.asarray(self._counts[:n, slot])
-            host = self._vmirror.get(p)
+            if self._nm is not None:
+                _ex, cnts, lvs = self._nm.export_pane(p, n)
+                host = [cnts] + lvs
+            else:
+                host = self._vmirror.get(p)
             host_counts = (host[0][:n] if host is not None
                            else np.zeros(n, np.int64))
             if not np.array_equal(dev_counts, host_counts):
@@ -714,17 +746,24 @@ class WindowAggOperator(StreamOperator):
     def _rows_for(self, idx: np.ndarray, result,
                   window) -> List[StreamElement]:
         """Shared emit-row assembly (dense/packed/fallback fire paths)."""
-        n = idx.size
         keys = np.asarray(self.key_index.reverse_keys())[idx]
+        return self._rows_for_keys(keys, result, window)
+
+    def _rows_for_keys(self, keys: np.ndarray, result,
+                       window) -> List[StreamElement]:
+        n = len(keys)
         cols: Dict[str, Any] = {self.key_column: keys}
         if isinstance(result, dict):
             cols.update(result)
         else:
             cols[self.output_column] = result
         if self.emit_window_bounds:
-            cols["window_start"] = np.full(n, window.start, np.int64)
-            cols["window_end"] = np.full(n, window.end, np.int64)
-        ts = np.full(n, window.max_timestamp, np.int64)
+            # constant columns as 0-strided broadcast views: a 1M-row fire
+            # would otherwise first-touch ~24MB of np.full pages per window
+            cols["window_start"] = np.broadcast_to(
+                np.int64(window.start), (n,))
+            cols["window_end"] = np.broadcast_to(np.int64(window.end), (n,))
+        ts = np.broadcast_to(np.int64(window.max_timestamp), (n,))
         return [RecordBatch(cols, timestamps=ts)]
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
@@ -754,7 +793,8 @@ class WindowAggOperator(StreamOperator):
         cols = batch.columns
         keys = np.asarray(cols[self.key_column])
         if self.key_index is None:
-            self.key_index = make_key_index(keys[0] if keys.ndim else keys)
+            self.key_index = make_key_index(keys[0] if keys.ndim else keys,
+                                            capacity_hint=self._K)
         if self.assigner.is_event_time:
             if batch.timestamps is None:
                 raise ValueError(
@@ -777,13 +817,26 @@ class WindowAggOperator(StreamOperator):
                     else self._proc_time)
         if gate_now != LONG_MIN and not isinstance(self.assigner,
                                                    GlobalWindows):
-            uniq_p = np.unique(panes)
+            # candidate panes via [min, max] arange (batch panes are a few
+            # contiguous values; np.unique over the batch costs ~ms each).
+            # A wide span (straggler records) would turn the per-candidate
+            # Python lateness calls below into the cost, so fall back to
+            # the distinct panes then.
+            p0, p1 = int(panes.min()), int(panes.max())
+            cand = (np.arange(p0, p1 + 1, dtype=np.int64)
+                    if p1 - p0 < 64 else np.unique(panes))
             is_late = np.asarray(
                 [self.assigner.last_window_end_of_pane(int(p)) - 1
-                 + self.lateness <= gate_now for p in uniq_p.tolist()])
-            live = (~np.isin(panes, uniq_p[is_late]) if is_late.any()
-                    else np.ones(len(panes), bool))
-            if not live.all():
+                 + self.lateness <= gate_now for p in cand.tolist()])
+            if not is_late.any():
+                live = np.ones(0, bool)  # nothing late: skip the gate body
+            elif np.all(is_late[:-1] >= is_late[1:]):
+                # lateness is a prefix of ascending panes (monotone cleanup
+                # times): one vector compare instead of isin
+                live = panes > int(cand[int(is_late.sum()) - 1])
+            else:
+                live = ~np.isin(panes, cand[is_late])
+            if live.size and not live.all():
                 if self.late_output_tag is not None:
                     # sideOutputLateData: rows are shipped, NOT dropped —
                     # the drop counter must stay at the reference semantics
@@ -823,8 +876,23 @@ class WindowAggOperator(StreamOperator):
             self._ensure_alloc()
             self._grow_panes(span)
 
-        with self._phase("probe"):
-            slots = self.key_index.lookup_or_insert(keys)
+        self._try_native_mirror()
+        values = self._select(cols)
+        flat_b = None
+        if self._nm is not None:
+            # fused C pass: key probe + mirror write-through + device scatter
+            # ids (the triples are computed once and consumed twice —
+            # VERDICT r3 next #1b)
+            with self._phase("probe_mirror"):
+                lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+                    self.agg.host_lift(values))]
+                flat_b = np.empty(len(batch), np.int32)
+                slots = self._nm.probe_update(keys, panes, lifted,
+                                              pane_mod=self._P,
+                                              flat_out=flat_b)
+        else:
+            with self._phase("probe"):
+                slots = self.key_index.lookup_or_insert(keys)
         if self.key_index.num_keys > self._K:
             self._ensure_alloc()
             self._grow_keys(self.key_index.num_keys)
@@ -833,17 +901,21 @@ class WindowAggOperator(StreamOperator):
         # ---- pad to pow2 batch size (static shapes; pads dropped via slot id K*P)
         B = len(batch)
         Bp = _next_pow2(B, 64)
-        flat = slots.astype(np.int64) * self._P + (panes % self._P)
-        flat_p = np.full(Bp, self._K * self._P, np.int64)
-        flat_p[:B] = flat
-        values = self._select(cols)
+        if flat_b is not None:
+            flat_p = np.full(Bp, self._K * self._P, np.int32)
+            flat_p[:B] = flat_b
+        else:
+            flat = slots.astype(np.int64) * self._P + (panes % self._P)
+            flat_p64 = np.full(Bp, self._K * self._P, np.int64)
+            flat_p64[:B] = flat
+            flat_p = flat_p64.astype(np.int32)
         values_p = jax.tree_util.tree_map(lambda a: _pad_rows(np.asarray(a), Bp), values)
 
         # np (not device) ids: the jit converts at dispatch, and the mesh
         # subclass re-routes them through the all_to_all exchange host-side
         with self._phase("device_dispatch"):
             self._leaves, self._counts = self._update_step(
-                self._leaves, self._counts, flat_p.astype(np.int32), values_p)
+                self._leaves, self._counts, flat_p, values_p)
         self.phase_bytes["h2d"] = self.phase_bytes.get("h2d", 0) + \
             flat_p.nbytes + sum(a.nbytes for a in
                                 jax.tree_util.tree_leaves(values_p))
@@ -853,8 +925,9 @@ class WindowAggOperator(StreamOperator):
         # counts, subsuming the boolean mirror; sharded fires read the
         # device mask instead)
         if self.emit_tier == "host":
-            with self._phase("mirror"):
-                self._vmirror_update(slots, panes, values)
+            if self._nm is None:  # native path already folded in probe_mirror
+                with self._phase("mirror"):
+                    self._vmirror_update(slots, panes, values)
         elif self.sharding is None:
             uniq_panes = np.unique(panes)
             if uniq_panes.size == 1:
@@ -875,7 +948,13 @@ class WindowAggOperator(StreamOperator):
         # ---- late re-fire: windows already passed by the watermark that this
         # batch updated fire again immediately (EventTimeTrigger.onElement FIRE)
         if (self.trigger.fires_on_time and self.assigner.is_event_time
-                and self.last_fired_window is not None):
+                and self.last_fired_window is not None
+                # refire needs a touched pane of an already-fired window:
+                # impossible when even the OLDEST touched pane's first window
+                # is beyond the fired horizon (the common in-order case) —
+                # skips the np.unique below, ~ms per hot-path batch
+                and self.assigner.windows_of_pane(pmin)[0]
+                <= self.last_fired_window):
             touched = np.unique(panes)
             refire: List[int] = []
             for p in touched.tolist():
@@ -989,6 +1068,8 @@ class WindowAggOperator(StreamOperator):
         for ep in expired:
             self._mirror.pop(ep, None)
             self._vmirror.pop(ep, None)
+            if self._nm is not None:
+                self._nm.drop_pane(ep)
         if self.pane_base > self.max_pane:
             self.max_pane = self.pane_base
         if self._count_baselines:
@@ -1209,6 +1290,12 @@ class WindowAggOperator(StreamOperator):
                               for s, d in zip(self.spec.leaf_shapes,
                                               self.spec.leaf_dtypes)]
                     for j, p in enumerate(panes.tolist()):
+                        if self._nm is not None:
+                            _ex, cnts, lvs = self._nm.export_pane(int(p), n)
+                            counts[:, j] = cnts  # int64 -> int32 cast
+                            for l, src in zip(leaves, lvs):
+                                l[:, j] = src  # mirror -> device dtype cast
+                            continue
                         e = self._vmirror.get(int(p))
                         if e is None:
                             for l, init, d in zip(leaves,
@@ -1253,12 +1340,15 @@ class WindowAggOperator(StreamOperator):
         self.watermark = snap["watermark"]
         self.late_dropped = snap.get("late_dropped", 0)
         self._P = snap["P"]
+        self._nm = None          # rebinds to the restored key index below
+        self._nm_tried = False
         if "key_index" in snap:
             if snap["key_index_kind"] == "ObjectKeyIndex":
                 self.key_index = ObjectKeyIndex.restore(snap["key_index"])
             else:
                 self.key_index = KeyIndex.restore(snap["key_index"])
             self._K = self._round_key_capacity(max(self.key_index.num_keys, 1))
+            self._try_native_mirror()
         self._leaves = None
         self._counts = None
         self._mirror = {}
@@ -1297,6 +1387,11 @@ class WindowAggOperator(StreamOperator):
                 restored = [np.asarray(l) for l in leaves]
                 for j, p in enumerate(panes.tolist()):
                     if not counts_np[:, j].any():
+                        continue
+                    if self._nm is not None:
+                        self._nm.import_pane(
+                            int(p), counts_np[:, j],
+                            [src[:, j] for src in restored])
                         continue
                     entry = self._vmirror_pane(int(p))
                     entry[0][:n] = counts_np[:, j]

@@ -294,9 +294,14 @@ class ObjectKeyIndex:
         return ki
 
 
-def make_key_index(sample_key) -> "KeyIndex | ObjectKeyIndex":
-    """Pick an index implementation from a sample key's dtype."""
+def make_key_index(sample_key,
+                   capacity_hint: int = 0) -> "KeyIndex | ObjectKeyIndex":
+    """Pick an index implementation from a sample key's dtype.
+
+    ``capacity_hint``: expected distinct-key count — pre-sizes the table to
+    2x (the load-factor bound) so a hinted run pays zero rehash-growths
+    (the reference pre-sizes keyed state by maxParallelism the same way)."""
     arr = np.asarray(sample_key)
     if arr.dtype.kind in "iu":
-        return KeyIndex()
+        return KeyIndex(initial_capacity=max(1 << 16, 2 * capacity_hint))
     return ObjectKeyIndex()

@@ -1,0 +1,192 @@
+"""Native (C++) write-through window mirror — the fire/mirror/probe hot path.
+
+Python face of the ``WinMirror`` kernels in ``native/flink_native.cc``: the
+host emit tier of :class:`~flink_tpu.operators.window_agg.WindowAggOperator`
+keeps a write-through host value mirror of the device ACC cells so window
+fires ship zero device->host bytes (decisive on egress-constrained links).
+Round 3 ran that mirror in numpy (per-batch ``bincount``/``reduceat`` plus a
+per-fire gather cascade); these kernels move the whole inner loop native:
+
+- ``probe_update`` fuses the key-index probe and the mirror write-through
+  into ONE C pass per micro-batch (the (slot, pane, value) triples are
+  computed once and consumed twice), sharing the key dict with the Python
+  :class:`~flink_tpu.state.keyindex.KeyIndex` so slot ids agree with the
+  device state rows by construction.
+- ``fire`` is one sequential C sweep that combines the window's panes,
+  compacts non-empty rows, and resolves raw keys — fire cost becomes memory
+  bandwidth instead of Python/numpy time.
+
+This is the same make-the-inner-loop-native move as the reference's Cython
+fast coders (``pyflink/fn_execution/table/window_aggregate_fast.pyx:51``)
+applied to ``WindowOperator.processElement``/``emitWindowContents``
+(``WindowOperator.java:300,574``).
+
+Eligibility: scalar accumulator leaves, add/min/max combine kinds, an int64
+native key index.  Anything else falls back to the numpy mirror in
+``window_agg.py`` (same semantics, slower).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: numpy dtype -> native value-load tag (VDt in flink_native.cc)
+_VDT = {np.dtype(np.float64): 0, np.dtype(np.float32): 1,
+        np.dtype(np.int64): 2, np.dtype(np.int32): 3}
+_KINDS = {"add": 0, "min": 1, "max": 2}
+
+
+class NativeWindowMirror:
+    """ctypes handle to a C++ WinMirror sharing a KeyIndex's key dict."""
+
+    def __init__(self, lib, key_index, handle, mirror_dtypes):
+        self._lib = lib
+        #: pins the KeyIndex (and thus the shared keydict) for our lifetime
+        self._key_index = key_index
+        self._h = handle
+        self._mirror_dtypes = tuple(np.dtype(d) for d in mirror_dtypes)
+        #: reusable fire output buffers (keys, counts, leaves) — a 1M-key
+        #: fire would otherwise first-touch ~24MB of fresh pages per window
+        self._fire_scratch = None
+
+    @classmethod
+    def try_create(cls, key_index, spec, kinds: Optional[Sequence[str]],
+                   mirror_dtypes) -> Optional["NativeWindowMirror"]:
+        """A mirror for this (key index, ACC spec), or None if ineligible."""
+        from flink_tpu.native import get_lib
+
+        lib = get_lib()
+        dict_handle = getattr(key_index, "_handle", None)
+        if lib is None or not hasattr(lib, "wm_create") or not dict_handle:
+            return None
+        if kinds is None or not all(k in _KINDS for k in kinds):
+            return None
+        if any(tuple(s) != () for s in spec.leaf_shapes):
+            return None  # non-scalar leaves: numpy mirror handles them
+        mdts = [np.dtype(d) for d in mirror_dtypes]
+        if any(d not in (np.dtype(np.float64), np.dtype(np.int64))
+               for d in mdts):
+            return None
+        nl = spec.num_leaves
+        kind_b = (ctypes.c_uint8 * nl)(*[_KINDS[k] for k in kinds])
+        lt_b = (ctypes.c_uint8 * nl)(
+            *[1 if d == np.dtype(np.int64) else 0 for d in mdts])
+        init = np.empty(nl, np.uint64)
+        for j, (iv, d) in enumerate(zip(spec.leaf_inits, mdts)):
+            init[j] = np.asarray(iv).astype(d).reshape(1).view(np.uint64)[0]
+        h = lib.wm_create(dict_handle, nl, kind_b, lt_b,
+                          init.ctypes.data_as(ctypes.c_void_p))
+        if not h:
+            return None
+        return cls(lib, key_index, h, mdts)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self._lib.wm_destroy(h)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+            self._h = None
+
+    # -- hot path ------------------------------------------------------------
+    def probe_update(self, keys: np.ndarray, panes: np.ndarray,
+                     lifted: List[np.ndarray], pane_mod: int = 0,
+                     flat_out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Fused probe + mirror fold; returns int32 slot ids for the device
+        scatter.  ``lifted`` is the agg's host_lift leaves, one [B] array per
+        ACC leaf.  When ``flat_out`` (int32[n], contiguous) is given, the C
+        pass also writes the device scatter ids slot * pane_mod +
+        pane %% pane_mod into it — one pass instead of three numpy ops."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        panes = np.ascontiguousarray(panes, np.int64)
+        n = keys.size
+        slots = np.empty(n, np.int32)
+        if n == 0:
+            return slots
+        nl = len(self._mirror_dtypes)
+        arrs = []
+        vdt = (ctypes.c_uint8 * nl)()
+        for j, l in enumerate(lifted):
+            a = np.ascontiguousarray(l)
+            if a.dtype not in _VDT:
+                a = a.astype(np.float64)
+            arrs.append(a)
+            vdt[j] = _VDT[a.dtype]
+        vals = (ctypes.c_void_p * nl)(*[a.ctypes.data for a in arrs])
+        flat_ptr = 0
+        if flat_out is not None:
+            # hard checks (not asserts): a wrong buffer here is C-side
+            # memory corruption, and pane_mod 0 is a divide-by-zero in C
+            if (flat_out.dtype != np.int32 or not flat_out.flags.c_contiguous
+                    or flat_out.size < n or pane_mod <= 0):
+                raise ValueError(
+                    "flat_out must be contiguous int32 with size >= n and "
+                    "pane_mod > 0")
+            flat_ptr = flat_out.ctypes.data
+        self._lib.wm_probe_update(
+            self._h, keys.ctypes.data, panes.ctypes.data, n, vals, vdt,
+            slots.ctypes.data, pane_mod, flat_ptr)
+        return slots
+
+    def fire(self, panes: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray]]:
+        """Combine+compact the window's panes: (keys[m], counts[m],
+        leaf arrays [m]) in ascending slot order."""
+        n = self._key_index.num_keys
+        panes = np.ascontiguousarray(panes, np.int64)
+        if n == 0 or panes.size == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    [np.empty(0, d) for d in self._mirror_dtypes])
+        sc = self._fire_scratch
+        if sc is None or sc[0].size < n:
+            cap = 1 << max(10, (n - 1).bit_length())
+            sc = self._fire_scratch = (
+                np.empty(cap, np.int64), np.empty(cap, np.int64),
+                [np.empty(cap, d) for d in self._mirror_dtypes])
+        out_keys, out_counts, out_leaves = sc
+        ptrs = (ctypes.c_void_p * len(out_leaves))(
+            *[a.ctypes.data for a in out_leaves])
+        m = int(self._lib.wm_fire(self._h, panes.ctypes.data, panes.size,
+                                  out_keys.ctypes.data,
+                                  out_counts.ctypes.data, ptrs))
+        # keys/leaves COPY out (they outlive this call in emitted batches);
+        # counts are consumed-or-dropped by the caller, so a view suffices
+        return (out_keys[:m].copy(), out_counts[:m],
+                [a[:m].copy() for a in out_leaves])
+
+    # -- pane lifecycle ------------------------------------------------------
+    def drop_pane(self, pane: int) -> None:
+        self._lib.wm_drop_pane(self._h, int(pane))
+
+    def live_panes(self) -> np.ndarray:
+        k = int(self._lib.wm_pane_count(self._h))
+        out = np.empty(k, np.int64)
+        if k:
+            self._lib.wm_live_panes(self._h, out.ctypes.data)
+        out.sort()
+        return out
+
+    # -- snapshots -----------------------------------------------------------
+    def export_pane(self, pane: int, nrows: int
+                    ) -> Tuple[bool, np.ndarray, List[np.ndarray]]:
+        """(exists, counts[nrows] int64, leaf columns in mirror dtypes)."""
+        counts = np.empty(nrows, np.int64)
+        leaves = [np.empty(nrows, d) for d in self._mirror_dtypes]
+        ptrs = (ctypes.c_void_p * len(leaves))(
+            *[a.ctypes.data for a in leaves])
+        ex = int(self._lib.wm_export_pane(self._h, int(pane), nrows,
+                                          counts.ctypes.data, ptrs))
+        return bool(ex), counts, leaves
+
+    def import_pane(self, pane: int, counts: np.ndarray,
+                    leaves: List[np.ndarray]) -> None:
+        counts = np.ascontiguousarray(counts, np.int64)
+        arrs = [np.ascontiguousarray(l, d)
+                for l, d in zip(leaves, self._mirror_dtypes)]
+        ptrs = (ctypes.c_void_p * len(arrs))(*[a.ctypes.data for a in arrs])
+        self._lib.wm_import_pane(self._h, int(pane), counts.size,
+                                 counts.ctypes.data, ptrs)

@@ -27,6 +27,7 @@
 #if defined(_WIN32)
 #error "POSIX only"
 #endif
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
@@ -638,10 +639,61 @@ static inline u64 kd_mix64(u64 x) {
   return x ^ (x >> 31);
 }
 
+// mmap-backed buffer advised onto 2MB transparent huge pages.  Random access
+// into multi-MB tables (the key dict, the mirror panes) is TLB-bound with 4K
+// pages — every probe is a TLB miss on top of the cache miss; 2MB pages cut
+// the working set to a handful of TLB entries.  Memory is NOT pre-touched:
+// anonymous mmap reads as zero, so untouched regions stay unbacked.
+struct HugeBuf {
+  u8* p = nullptr;
+  size_t mapped = 0;  // 0 => malloc fallback (zero-filled manually)
+
+  HugeBuf() = default;
+  HugeBuf(const HugeBuf&) = delete;
+  HugeBuf& operator=(const HugeBuf&) = delete;
+  HugeBuf(HugeBuf&& o) noexcept { *this = static_cast<HugeBuf&&>(o); }
+  HugeBuf& operator=(HugeBuf&& o) noexcept {
+    release();
+    p = o.p; mapped = o.mapped;
+    o.p = nullptr; o.mapped = 0;
+    return *this;
+  }
+  ~HugeBuf() { release(); }
+
+  void release() {
+    if (!p) return;
+    if (mapped) munmap(p, mapped);
+    else free(p);
+    p = nullptr;
+    mapped = 0;
+  }
+
+  // fresh zero-filled allocation (drops previous contents)
+  void alloc(size_t bytes) {
+    release();
+    size_t rounded = (bytes + ((size_t)1 << 21) - 1) & ~((((size_t)1 << 21)) - 1);
+    void* m = mmap(nullptr, rounded, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (m != MAP_FAILED) {
+      madvise(m, rounded, MADV_HUGEPAGE);
+      p = (u8*)m;
+      mapped = rounded;
+    } else {
+      p = (u8*)calloc(1, bytes);
+      mapped = 0;
+    }
+  }
+};
+
 struct KeyDict {
+  // Interleaved bucket layout: key + slot share a cache line, so a probe
+  // costs ONE memory access instead of two parallel-array misses, and the
+  // +1 linear-probe neighbour is usually already resident.  slot1 stores
+  // slot + 1 so the zero-page state of a fresh HugeBuf IS the empty table.
+  struct Bucket { i64 key; i32 slot1; };  // slot1 0 = empty (16B padded)
   u64 cap = 0, mask = 0;
-  std::vector<i64> keys;    // bucket -> key
-  std::vector<i32> slots;   // bucket -> slot id, -1 empty
+  HugeBuf tabbuf;
+  Bucket* tab = nullptr;
   std::vector<i64> reverse; // slot -> key
   i64 n = 0;
 
@@ -649,21 +701,21 @@ struct KeyDict {
     cap = 1;
     while (cap < c) cap <<= 1;
     mask = cap - 1;
-    keys.assign(cap, 0);
-    slots.assign(cap, -1);
+    tabbuf.alloc(cap * sizeof(Bucket));
+    tab = (Bucket*)tabbuf.p;
   }
 
   inline i32 find_or_insert(i64 key) {
     u64 b = kd_mix64((u64)key) & mask;
     for (;;) {
-      i32 s = slots[b];
-      if (s < 0) {
-        slots[b] = (i32)n;
-        keys[b] = key;
+      Bucket& bk = tab[b];
+      if (bk.slot1 == 0) {
+        bk.slot1 = (i32)n + 1;
+        bk.key = key;
         reverse.push_back(key);
         return (i32)n++;
       }
-      if (keys[b] == key) return s;
+      if (bk.key == key) return bk.slot1 - 1;
       b = (b + 1) & mask;
     }
   }
@@ -671,9 +723,9 @@ struct KeyDict {
   inline i32 find(i64 key) const {
     u64 b = kd_mix64((u64)key) & mask;
     for (;;) {
-      i32 s = slots[b];
-      if (s < 0) return -1;
-      if (keys[b] == key) return s;
+      const Bucket& bk = tab[b];
+      if (bk.slot1 == 0) return -1;
+      if (bk.key == key) return bk.slot1 - 1;
       b = (b + 1) & mask;
     }
   }
@@ -682,9 +734,9 @@ struct KeyDict {
     init(c);
     for (i64 i = 0; i < n; i++) {
       u64 b = kd_mix64((u64)reverse[i]) & mask;
-      while (slots[b] >= 0) b = (b + 1) & mask;
-      slots[b] = (i32)i;
-      keys[b] = reverse[i];
+      while (tab[b].slot1 != 0) b = (b + 1) & mask;
+      tab[b].slot1 = (i32)i + 1;
+      tab[b].key = reverse[i];
     }
   }
 
@@ -695,6 +747,10 @@ struct KeyDict {
       while ((u64)(n + incoming) * 2 > c) c <<= 1;
       grow_to(c);
     }
+  }
+
+  inline void prefetch(i64 key) const {
+    __builtin_prefetch(&tab[kd_mix64((u64)key) & mask]);
   }
 };
 
@@ -708,18 +764,352 @@ API void keydict_destroy(void* h) { delete (KeyDict*)h; }
 
 API i64 keydict_size(void* h) { return ((KeyDict*)h)->n; }
 
+// Probe distance for software pipelining: random hash probes are
+// memory-latency bound on one core; issuing the (i + PF)-th bucket's
+// prefetch while resolving the i-th keeps ~PF misses in flight.
+static const i64 KD_PF = 12;
+
 API void keydict_lookup_or_insert(void* h, const i64* ks, i64 m, i32* out) {
   KeyDict* d = (KeyDict*)h;
   d->reserve(m);
-  for (i64 i = 0; i < m; i++) out[i] = d->find_or_insert(ks[i]);
+  for (i64 i = 0; i < m; i++) {
+    if (i + KD_PF < m) d->prefetch(ks[i + KD_PF]);
+    out[i] = d->find_or_insert(ks[i]);
+  }
 }
 
 API void keydict_lookup(void* h, const i64* ks, i64 m, i32* out) {
   KeyDict* d = (KeyDict*)h;
-  for (i64 i = 0; i < m; i++) out[i] = d->find(ks[i]);
+  for (i64 i = 0; i < m; i++) {
+    if (i + KD_PF < m) d->prefetch(ks[i + KD_PF]);
+    out[i] = d->find(ks[i]);
+  }
 }
 
 API void keydict_reverse(void* h, i64* out) {
   KeyDict* d = (KeyDict*)h;
   std::memcpy(out, d->reverse.data(), (size_t)d->n * sizeof(i64));
+}
+
+// ---------------------------------------------------------------------------
+// WinMirror: write-through host value mirror of windowed ACC cells.
+//
+// The native fire/mirror/probe hot path of the window operator's HOST emit
+// tier (operators/window_agg.py): the batched analog of the reference's
+// per-record WindowOperator.processElement -> HeapAggregatingState.add loop
+// and its emitWindowContents fire path
+// (flink-streaming-java/.../windowing/WindowOperator.java:300,574), with the
+// same make-the-inner-loop-native role as the reference's Cython fast coders
+// (pyflink/fn_execution/table/window_aggregate_fast.pyx:51).
+//
+// Layout: one entry per live pane, rows interleaved as
+// [count i64][leaf_0 8B][leaf_1 8B]... so a record update touches ONE cache
+// line; leaves are f64 (float accumulators) or i64 (integer accumulators) —
+// the higher-precision twins of the device's f32/i32 cells.  The key dict is
+// SHARED with the Python KeyIndex (same handle), so slot ids agree with the
+// device state rows by construction.
+//
+// wm_probe_update fuses the key probe and the mirror write-through into one
+// pass (the (slot, pane, value) triples are computed once and consumed
+// twice); wm_fire is one sequential pass over slots that combines panes,
+// compacts non-empty rows, and resolves keys — fire cost is memory
+// bandwidth, not Python.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct MirrorPane {
+  HugeBuf rows;  // interleaved rows, `cap` of them
+  i64 cap = 0;
+};
+
+struct WinMirror {
+  KeyDict* dict = nullptr;  // shared with the Python KeyIndex; NOT owned
+  int nl = 0;               // number of accumulator leaves (scalar each)
+  u8 kind[16];              // per leaf: 0 add, 1 min, 2 max
+  u8 lt[16];                // per leaf storage: 0 f64, 1 i64
+  u64 init_bits[16];        // identity value bits (storage dtype)
+  i64 stride = 0;           // 8 * (1 + nl) bytes per row
+  bool zero_init = true;    // all identities are 0 bits: zero pages suffice
+  std::unordered_map<i64, MirrorPane> panes;
+
+  void grow(MirrorPane& mp, i64 min_rows) {
+    i64 nc = mp.cap ? mp.cap : 1024;
+    while (nc < min_rows) nc <<= 1;
+    HugeBuf fresh;
+    fresh.alloc((size_t)(nc * stride));
+    if (!zero_init) {
+      // min/max identities are non-zero bit patterns: stamp the template
+      // into the grown region (add identities are 0, the mmap default,
+      // so sum/count panes skip this and stay zero-page-backed)
+      u8 tmpl[8 * 17];
+      i64 zero = 0;
+      memcpy(tmpl, &zero, 8);
+      for (int j = 0; j < nl; j++) memcpy(tmpl + 8 + 8 * j, &init_bits[j], 8);
+      for (i64 r = mp.cap; r < nc; r++)
+        memcpy(fresh.p + r * stride, tmpl, (size_t)stride);
+    }
+    if (mp.cap) memcpy(fresh.p, mp.rows.p, (size_t)(mp.cap * stride));
+    mp.rows = static_cast<HugeBuf&&>(fresh);
+    mp.cap = nc;
+  }
+
+  inline MirrorPane* ensure_pane(i64 p, i64 min_rows) {
+    MirrorPane& mp = panes[p];
+    if (mp.cap < min_rows) grow(mp, min_rows);
+    return &mp;
+  }
+};
+
+// value load: input leaf arrays keep their numpy dtype (no Python-side cast)
+enum VDt { VF64 = 0, VF32 = 1, VI64 = 2, VI32 = 3 };
+
+}  // namespace
+
+API void* wm_create(void* dict_handle, i32 n_leaves, const u8* kinds,
+                    const u8* ltypes, const u64* init_bits) {
+  if (n_leaves < 1 || n_leaves > 16) return nullptr;
+  auto* w = new WinMirror();
+  w->dict = (KeyDict*)dict_handle;
+  w->nl = n_leaves;
+  memcpy(w->kind, kinds, (size_t)n_leaves);
+  memcpy(w->lt, ltypes, (size_t)n_leaves);
+  memcpy(w->init_bits, init_bits, (size_t)n_leaves * 8);
+  w->stride = 8 * (1 + n_leaves);
+  w->zero_init = true;
+  for (i32 j = 0; j < n_leaves; j++)
+    if (init_bits[j] != 0) w->zero_init = false;
+  return w;
+}
+
+API void wm_destroy(void* h) { delete (WinMirror*)h; }
+
+API void wm_drop_pane(void* h, i64 pane) { ((WinMirror*)h)->panes.erase(pane); }
+
+API i64 wm_pane_count(void* h) { return (i64)((WinMirror*)h)->panes.size(); }
+
+API void wm_live_panes(void* h, i64* out) {
+  auto* w = (WinMirror*)h;
+  i64 i = 0;
+  for (auto& kv : w->panes) out[i++] = kv.first;
+}
+
+// Fused probe + mirror write-through: one pass maps keys -> slots (shared
+// dict; new keys insert) and folds each record into its pane's row.  Pane
+// pointers are cached across the usual within-batch runs (timestamps arrive
+// roughly sorted), and both the hash probe and the mirror row are
+// software-prefetched — the loop keeps ~8-12 cache misses in flight, which
+// is the only parallelism a single core offers.
+// ``pane_mod``/``flat_out``: when flat_out is non-null, also emit the device
+// scatter ids flat = slot * pane_mod + pane %% pane_mod (int32) — the ids
+// the jitted update step consumes — saving three numpy passes per batch.
+API void wm_probe_update(void* h, const i64* keys, const i64* pane_ids, i64 n,
+                         const void* const* vals, const u8* vdt,
+                         i32* slots_out, i64 pane_mod, i32* flat_out) {
+  auto* w = (WinMirror*)h;
+  KeyDict* d = w->dict;
+  d->reserve(n);
+  for (i64 i = 0; i < n; i++) {
+    if (i + KD_PF < n) d->prefetch(keys[i + KD_PF]);
+    slots_out[i] = d->find_or_insert(keys[i]);
+  }
+  const i64 need = d->n;  // fixed for the scatter: all inserts done above
+  const i64 stride = w->stride;
+  const i64 PF = 16;
+  // timestamps arrive roughly sorted, so panes form long runs: segment the
+  // batch by pane once and keep the inner loops free of per-record checks
+  i64 i = 0;
+  while (i < n) {
+    const i64 p = pane_ids[i];
+    i64 j = i + 1;
+    while (j < n && pane_ids[j] == p) j++;
+    MirrorPane* mp = w->ensure_pane(p, need);
+    u8* base = mp->rows.p;
+    if (flat_out) {
+      const i32 ps = (i32)(((p % pane_mod) + pane_mod) % pane_mod);
+      const i32 mul = (i32)pane_mod;
+      for (i64 k = i; k < j; k++) flat_out[k] = slots_out[k] * mul + ps;
+    }
+    // fast path: single f64 add leaf fed by f32 values (sum over floats —
+    // the dominant shape).  Direct prefetched scatter: an LSD-radix
+    // sort-then-sweep variant measured SLOWER here (the bucket-placement
+    // passes cost more than the locality buys on this single-core box).
+    if (w->nl == 1 && w->kind[0] == 0 && w->lt[0] == 0 && vdt[0] == VF32) {
+      const float* v = (const float*)vals[0];
+      for (i64 k = i; k < j; k++) {
+        if (k + PF < j)
+          __builtin_prefetch(base + (i64)slots_out[k + PF] * stride, 1);
+        u8* row = base + (i64)slots_out[k] * stride;
+        (*(i64*)row)++;
+        *(double*)(row + 8) += (double)v[k];
+      }
+      i = j;
+      continue;
+    }
+    for (i64 k = i; k < j; k++) {
+      if (k + PF < j)
+        __builtin_prefetch(base + (i64)slots_out[k + PF] * stride, 1);
+      u8* row = base + (i64)slots_out[k] * stride;
+      (*(i64*)row)++;
+      for (int l = 0; l < w->nl; l++) {
+        u8* cell = row + 8 + 8 * l;
+        if (w->lt[l] == 0) {
+          double x;
+          switch (vdt[l]) {
+            case VF64: x = ((const double*)vals[l])[k]; break;
+            case VF32: x = (double)((const float*)vals[l])[k]; break;
+            case VI64: x = (double)((const i64*)vals[l])[k]; break;
+            default:   x = (double)((const i32*)vals[l])[k]; break;
+          }
+          double* c = (double*)cell;
+          if (w->kind[l] == 0) *c += x;
+          else if (w->kind[l] == 1) { if (x < *c) *c = x; }
+          else { if (x > *c) *c = x; }
+        } else {
+          i64 x;
+          switch (vdt[l]) {
+            case VF64: x = (i64)((const double*)vals[l])[k]; break;
+            case VF32: x = (i64)((const float*)vals[l])[k]; break;
+            case VI64: x = ((const i64*)vals[l])[k]; break;
+            default:   x = (i64)((const i32*)vals[l])[k]; break;
+          }
+          i64* c = (i64*)cell;
+          if (w->kind[l] == 0) *c += x;
+          else if (w->kind[l] == 1) { if (x < *c) *c = x; }
+          else { if (x > *c) *c = x; }
+        }
+      }
+    }
+    i = j;
+  }
+}
+
+// Window fire: combine the window's panes per slot, compact non-empty rows
+// (ascending slot order), resolve raw keys from the shared dict's reverse
+// table.  Outputs are caller-allocated with capacity >= dict->n rows.
+// Returns the number of emitted rows.  Slots beyond a pane's capacity hold
+// the identity by construction, so clamping is sufficient.
+API i64 wm_fire(void* h, const i64* pane_ids, i32 npanes, i64* out_keys,
+                i64* out_counts, void* const* out_leaves) {
+  auto* w = (WinMirror*)h;
+  const i64 n = w->dict->n;
+  std::vector<const u8*> bases_v;
+  std::vector<i64> caps_v;
+  bases_v.reserve((size_t)npanes);
+  caps_v.reserve((size_t)npanes);
+  for (i32 i = 0; i < npanes; i++) {
+    auto it = w->panes.find(pane_ids[i]);
+    if (it == w->panes.end() || it->second.cap == 0) continue;
+    bases_v.push_back(it->second.rows.p);
+    caps_v.push_back(it->second.cap);
+  }
+  const int np = (int)bases_v.size();
+  if (np == 0 || n == 0) return 0;
+  const u8* const* bases = bases_v.data();
+  const i64* caps = caps_v.data();
+  const i64 stride = w->stride;
+  const i64* rev = w->dict->reverse.data();
+  i64 m = 0;
+  // fast path: tumbling (single pane), one f64 leaf — one sequential sweep
+  if (np == 1 && w->nl == 1 && w->lt[0] == 0) {
+    const u8* base = bases[0];
+    const i64 lim = n < caps[0] ? n : caps[0];
+    double* ol = (double*)out_leaves[0];
+    for (i64 s = 0; s < lim; s++) {
+      const u8* row = base + s * stride;
+      const i64 c = *(const i64*)row;
+      if (c > 0) {
+        out_keys[m] = rev[s];
+        out_counts[m] = c;
+        ol[m] = *(const double*)(row + 8);
+        m++;
+      }
+    }
+    return m;
+  }
+  for (i64 s = 0; s < n; s++) {
+    i64 total = 0;
+    for (int q = 0; q < np; q++)
+      if (s < caps[q]) total += *(const i64*)(bases[q] + s * stride);
+    if (total <= 0) continue;
+    out_keys[m] = rev[s];
+    out_counts[m] = total;
+    // seed the combine from the FIRST present pane's cell (total > 0
+    // guarantees one exists) — seeding from the identity instead would
+    // double-count a nonzero 'add' identity relative to the numpy mirror
+    for (int j = 0; j < w->nl; j++) {
+      if (w->lt[j] == 0) {
+        double acc = 0;
+        bool first = true;
+        for (int q = 0; q < np; q++) {
+          if (s >= caps[q]) continue;
+          double v = *(const double*)(bases[q] + s * stride + 8 + 8 * j);
+          if (first) { acc = v; first = false; }
+          else if (w->kind[j] == 0) acc += v;
+          else if (w->kind[j] == 1) acc = v < acc ? v : acc;
+          else acc = v > acc ? v : acc;
+        }
+        ((double*)out_leaves[j])[m] = acc;
+      } else {
+        i64 acc = 0;
+        bool first = true;
+        for (int q = 0; q < np; q++) {
+          if (s >= caps[q]) continue;
+          i64 v = *(const i64*)(bases[q] + s * stride + 8 + 8 * j);
+          if (first) { acc = v; first = false; }
+          else if (w->kind[j] == 0) acc += v;
+          else if (w->kind[j] == 1) acc = v < acc ? v : acc;
+          else acc = v > acc ? v : acc;
+        }
+        ((i64*)out_leaves[j])[m] = acc;
+      }
+    }
+    m++;
+  }
+  return m;
+}
+
+// De-interleave one pane's first `nrows` rows into columnar buffers
+// (snapshots, verification).  Rows beyond the pane's capacity export as
+// count 0 / identity.  Returns 1 if the pane exists, else 0 (buffers are
+// still filled with identity rows).
+API i32 wm_export_pane(void* h, i64 pane, i64 nrows, i64* counts_out,
+                       void* const* leaves_out) {
+  auto* w = (WinMirror*)h;
+  auto it = w->panes.find(pane);
+  const u8* base = nullptr;
+  i64 cap = 0;
+  if (it != w->panes.end()) {
+    base = it->second.rows.p;
+    cap = it->second.cap;
+  }
+  const i64 stride = w->stride;
+  for (i64 s = 0; s < nrows; s++) {
+    if (s < cap) {
+      const u8* row = base + s * stride;
+      counts_out[s] = *(const i64*)row;
+      for (int j = 0; j < w->nl; j++)
+        memcpy((u8*)leaves_out[j] + 8 * s, row + 8 + 8 * j, 8);
+    } else {
+      counts_out[s] = 0;
+      for (int j = 0; j < w->nl; j++)
+        memcpy((u8*)leaves_out[j] + 8 * s, &w->init_bits[j], 8);
+    }
+  }
+  return it != w->panes.end() ? 1 : 0;
+}
+
+// Interleave columnar buffers into one pane's rows (snapshot restore).
+API void wm_import_pane(void* h, i64 pane, i64 nrows, const i64* counts,
+                        const void* const* leaves) {
+  auto* w = (WinMirror*)h;
+  MirrorPane* mp = w->ensure_pane(pane, nrows);
+  u8* base = mp->rows.p;
+  const i64 stride = w->stride;
+  for (i64 s = 0; s < nrows; s++) {
+    u8* row = base + s * stride;
+    *(i64*)row = counts[s];
+    for (int j = 0; j < w->nl; j++)
+      memcpy(row + 8 + 8 * j, (const u8*)leaves[j] + 8 * s, 8);
+  }
 }

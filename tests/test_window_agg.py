@@ -911,8 +911,12 @@ class TestHostEmitTier:
         op = make_op(emit_tier="host")
         op.open(RuntimeContext())
         _run_workload(op, n_batches=3)
-        assert op.phase_ns.get("probe", 0) > 0
-        assert op.phase_ns.get("mirror", 0) > 0
+        # fused native path reports "probe_mirror"; numpy fallback reports
+        # separate "probe" + "mirror" phases
+        host_ns = (op.phase_ns.get("probe_mirror", 0)
+                   or min(op.phase_ns.get("probe", 0),
+                          op.phase_ns.get("mirror", 0)))
+        assert host_ns > 0
         assert op.phase_ns.get("device_dispatch", 0) > 0
         assert op.phase_ns.get("fire", 0) > 0
         assert op.phase_bytes.get("h2d", 0) > 0
