@@ -350,6 +350,26 @@ def run_numpy_baseline(batches, window_ms: int):
     return n / elapsed, fired
 
 
+def check_budget(result: dict, budget: dict) -> list:
+    """Compare one bench result against a BENCH_BUDGET.json section; returns
+    human-readable violations (empty = pass).  The in-repo regression gate
+    (VERDICT r3 weak #3): throughput floor, p99 ceiling, per-phase ceilings."""
+    viol = []
+    if result["value"] < budget["min_rps"]:
+        viol.append(f"rec/s {result['value']:.0f} < floor "
+                    f"{budget['min_rps']:.0f}")
+    p99 = result["p99_fire_latency_ms"]
+    if p99 > budget["max_p99_ms"]:
+        viol.append(f"p99 fire latency {p99}ms > ceiling "
+                    f"{budget['max_p99_ms']}ms")
+    phases = result["details"]["phases_ms"]
+    for name, cap in budget.get("max_phase_ms", {}).items():
+        got = phases.get(name)
+        if got is not None and got > cap:
+            viol.append(f"phase {name} {got}ms > budget {cap}ms")
+    return viol
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small fast run")
@@ -363,6 +383,9 @@ def main():
                     choices=["host", "device"])
     ap.add_argument("--skip-verify", action="store_true",
                     help="skip the post-run device-vs-mirror download check")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero if the result violates "
+                         "BENCH_BUDGET.json (regression gate)")
     args = ap.parse_args()
 
     n_records = args.records or (1 << 18 if args.smoke else 1 << 24)
@@ -417,7 +440,7 @@ def main():
         "numpy_baseline_rps": round(numpy_rps, 1),
         "heap_baseline_rps": round(base_rps, 1),
     }
-    print(json.dumps({
+    result = {
         "metric": f"records/sec/chip (1M-key tumbling sum, {platform}, "
                   f"checkpointing every {args.checkpoint_every} batches)",
         "value": round(tpu_rps, 1),
@@ -427,8 +450,23 @@ def main():
         "vs_baseline": round(tpu_rps / base_rps, 3),
         "vs_numpy_baseline": round(tpu_rps / numpy_rps, 3),
         "details": detail,
-    }))
+    }
+    print(json.dumps(result))
     print(f"# details: {json.dumps(detail)}", file=sys.stderr)
+    if args.check:
+        import os
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_BUDGET.json")
+        with open(path) as f:
+            budget = json.load(f)["smoke" if args.smoke else "full"]
+        viol = check_budget(result, budget)
+        for v in viol:
+            print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
+        if not (replay_ok and mirror_ok):
+            viol.append("correctness check failed")
+            print("# BUDGET VIOLATION: restore/replay or mirror consistency "
+                  "failed", file=sys.stderr)
+        sys.exit(1 if viol else 0)
 
 
 if __name__ == "__main__":

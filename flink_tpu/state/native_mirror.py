@@ -51,6 +51,9 @@ class NativeWindowMirror:
         #: reusable fire output buffers (keys, counts, leaves) — a 1M-key
         #: fire would otherwise first-touch ~24MB of fresh pages per window
         self._fire_scratch = None
+        #: reusable export buffers (counts, leaves) for the same reason;
+        #: snapshots run inside the checkpointed hot path
+        self._export_scratch = None
 
     @classmethod
     def try_create(cls, key_index, spec, kinds: Optional[Sequence[str]],
@@ -173,14 +176,23 @@ class NativeWindowMirror:
     # -- snapshots -----------------------------------------------------------
     def export_pane(self, pane: int, nrows: int
                     ) -> Tuple[bool, np.ndarray, List[np.ndarray]]:
-        """(exists, counts[nrows] int64, leaf columns in mirror dtypes)."""
-        counts = np.empty(nrows, np.int64)
-        leaves = [np.empty(nrows, d) for d in self._mirror_dtypes]
+        """(exists, counts[nrows] int64, leaf columns in mirror dtypes).
+
+        Returns VIEWS into reusable scratch (overwritten by the next
+        export): callers (snapshot column fill, verify) consume them
+        before exporting the next pane."""
+        sc = self._export_scratch
+        if sc is None or sc[0].size < nrows:
+            cap = 1 << max(10, (nrows - 1).bit_length())
+            sc = self._export_scratch = (
+                np.empty(cap, np.int64),
+                [np.empty(cap, d) for d in self._mirror_dtypes])
+        counts, leaves = sc[0], sc[1]
         ptrs = (ctypes.c_void_p * len(leaves))(
             *[a.ctypes.data for a in leaves])
         ex = int(self._lib.wm_export_pane(self._h, int(pane), nrows,
                                           counts.ctypes.data, ptrs))
-        return bool(ex), counts, leaves
+        return bool(ex), counts[:nrows], [a[:nrows] for a in leaves]
 
     def import_pane(self, pane: int, counts: np.ndarray,
                     leaves: List[np.ndarray]) -> None:

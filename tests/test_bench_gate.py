@@ -1,0 +1,77 @@
+"""bench.py --check regression gate (VERDICT r3 next #2).
+
+Unit-tests the budget comparison itself, and (slow tier) runs the real
+smoke bench under --check so a structural perf regression fails the suite
+before the driver sees it — the in-repo answer to the r1->r2 0.84M rec/s
+surprise."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import check_budget  # noqa: E402
+
+
+def _result(rps=10e6, p99=10.0, phases=None):
+    return {"value": rps, "p99_fire_latency_ms": p99,
+            "details": {"phases_ms": phases or {"probe_mirror": 100.0}}}
+
+
+def _budget(**kw):
+    b = {"min_rps": 5e6, "max_p99_ms": 30.0,
+         "max_phase_ms": {"probe_mirror": 500.0}}
+    b.update(kw)
+    return b
+
+
+def test_check_budget_pass():
+    assert check_budget(_result(), _budget()) == []
+
+
+def test_check_budget_rps_floor():
+    viol = check_budget(_result(rps=1e6), _budget())
+    assert len(viol) == 1 and "rec/s" in viol[0]
+
+
+def test_check_budget_p99_ceiling():
+    viol = check_budget(_result(p99=45.0), _budget())
+    assert len(viol) == 1 and "p99" in viol[0]
+
+
+def test_check_budget_phase_ceiling():
+    viol = check_budget(_result(phases={"probe_mirror": 900.0}), _budget())
+    assert len(viol) == 1 and "probe_mirror" in viol[0]
+
+
+def test_check_budget_unknown_phase_ignored():
+    """A budgeted phase absent from the run (e.g. numpy fallback reports
+    'probe'+'mirror' instead of 'probe_mirror') is not a violation."""
+    b = _budget(max_phase_ms={"probe_mirror": 500.0, "mirror": 400.0})
+    assert check_budget(_result(), b) == []
+
+
+def test_budget_file_shape():
+    with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
+        budget = json.load(f)
+    for tier in ("full", "smoke"):
+        sec = budget[tier]
+        assert sec["min_rps"] > 0
+        assert sec["max_p99_ms"] > 0
+        assert "probe_mirror" in sec["max_phase_ms"]
+
+
+@pytest.mark.slow
+def test_smoke_bench_passes_gate():
+    """The committed budget must hold on this host: run the real smoke
+    bench end-to-end under --check."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--check"],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
