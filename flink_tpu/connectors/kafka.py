@@ -28,6 +28,7 @@ import os
 import socket
 import struct
 import threading
+import time
 import zlib
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -180,10 +181,45 @@ def decode_message_set(data: bytes) -> List[Tuple[int, Optional[bytes],
 _ERR_NONE = 0
 _ERR_OFFSET_OUT_OF_RANGE = 1
 _ERR_UNKNOWN_TOPIC = 3
+_ERR_ILLEGAL_GENERATION = 22
+_ERR_UNKNOWN_MEMBER_ID = 25
+_ERR_REBALANCE_IN_PROGRESS = 27
 _ERR_UNKNOWN = -1
 
 _API_PRODUCE, _API_FETCH, _API_LIST_OFFSETS = 0, 1, 2
 _API_METADATA, _API_VERSIONS = 3, 18
+_API_OFFSET_COMMIT, _API_OFFSET_FETCH = 8, 9
+_API_FIND_COORDINATOR, _API_JOIN_GROUP = 10, 11
+_API_HEARTBEAT, _API_LEAVE_GROUP, _API_SYNC_GROUP = 12, 13, 14
+
+#: how long a rebalance waits for every member to rejoin before expelling
+#: stragglers (the broker-side group.initial.rebalance.delay analog)
+_REBALANCE_TIMEOUT_S = 3.0
+
+
+class _Group:
+    """Coordinator-side consumer-group state (GroupMetadata analog).
+
+    States: Empty -> Joining (a rebalance is collecting JoinGroups) ->
+    AwaitingSync (generation bumped, leader computing assignment) ->
+    Stable.  Any join, leave, or session expiry re-enters Joining;
+    members in older generations discover it via errors 22/25/27 and
+    rejoin — the real protocol's client contract."""
+
+    __slots__ = ("generation", "members", "leader", "state", "assignments",
+                 "offsets", "joined", "deadline")
+
+    def __init__(self):
+        self.generation = 0
+        #: member_id -> {"sub": bytes, "timeout_ms": int, "last_seen": float}
+        self.members: Dict[str, Dict[str, Any]] = {}
+        self.leader: Optional[str] = None
+        self.state = "Empty"
+        self.assignments: Dict[str, bytes] = {}
+        #: (topic, partition) -> committed offset
+        self.offsets: Dict[Tuple[str, int], int] = {}
+        self.joined: set = set()          # members that (re)joined this round
+        self.deadline = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -205,8 +241,13 @@ class KafkaWireBroker:
         if directory:
             os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
-        #: topic -> partition -> list[(offset, key, value)]
-        self._logs: Dict[str, List[List[Tuple[int, bytes, bytes]]]] = {}
+        #: topic -> partition -> list[(offset, key, value, timestamp_ms)]
+        self._logs: Dict[str, List[List[Tuple[int, bytes, bytes, int]]]] = {}
+        #: consumer groups under a dedicated lock: JoinGroup BLOCKS (the
+        #: rebalance barrier) and must not hold the log lock while waiting
+        self._groups: Dict[str, _Group] = {}
+        self._gcond = threading.Condition()
+        self._member_seq = 0
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()[:2]
         self._stop = threading.Event()
@@ -238,13 +279,38 @@ class KafkaWireBroker:
             tq, _, p = stem.rpartition("-")
             if not tq or not p.isdigit():
                 continue                 # not a partition log of ours
-            topic = urllib.parse.unquote(tq)
             with open(os.path.join(self.directory, name), "rb") as f:
-                entries = decode_message_set(f.read())
+                data = f.read()
+            entries = _decode_mixed_log(data)
+            topic = urllib.parse.unquote(tq)
             parts = self._logs.setdefault(topic, [])
             while len(parts) <= int(p):
                 parts.append([])
             parts[int(p)] = list(entries)
+        goff = os.path.join(self.directory, "_groups.json")
+        if os.path.exists(goff):
+            with open(goff) as f:
+                for gid, offs in json.load(f).items():
+                    g = self._groups.setdefault(gid, _Group())
+                    for key, off in offs.items():
+                        topic, _, part = key.rpartition("@")
+                        g.offsets[(topic, int(part))] = off
+
+    def _persist_group_offsets_locked(self) -> None:
+        """Committed offsets survive broker restarts (the __consumer_offsets
+        topic analog).  Caller holds ``_gcond``."""
+        if not self.directory:
+            return
+        import json
+        payload = {gid: {f"{t}@{p}": off
+                         for (t, p), off in g.offsets.items()}
+                   for gid, g in self._groups.items() if g.offsets}
+        tmp = os.path.join(self.directory, "_groups.json#tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.directory, "_groups.json"))
 
     def create_topic(self, topic: str, partitions: int = 1) -> None:
         with self._lock:
@@ -330,27 +396,248 @@ class KafkaWireBroker:
         api_key = r.int16()
         api_version = r.int16()
         correlation = r.int32()
-        r.string()                              # client_id
+        client_id = r.string()
         w = _Writer().int32(correlation)
         if api_key == _API_VERSIONS:
             w.int16(_ERR_NONE).array(
-                [(_API_PRODUCE, 0, 0), (_API_FETCH, 0, 0),
+                [(_API_PRODUCE, 0, 3), (_API_FETCH, 0, 4),
                  (_API_LIST_OFFSETS, 0, 0), (_API_METADATA, 0, 0),
-                 (_API_VERSIONS, 0, 0)],
+                 (_API_OFFSET_COMMIT, 2, 2), (_API_OFFSET_FETCH, 1, 1),
+                 (_API_FIND_COORDINATOR, 0, 0), (_API_JOIN_GROUP, 0, 0),
+                 (_API_HEARTBEAT, 0, 0), (_API_LEAVE_GROUP, 0, 0),
+                 (_API_SYNC_GROUP, 0, 0), (_API_VERSIONS, 0, 0)],
                 lambda w, t: w.int16(t[0]).int16(t[1]).int16(t[2]))
         elif api_key == _API_METADATA:
             self._metadata(r, w)
         elif api_key == _API_PRODUCE and api_version == 0:
             self._produce(r, w)
+        elif api_key == _API_PRODUCE and api_version == 3:
+            self._produce_v3(r, w)
         elif api_key == _API_FETCH and api_version == 0:
             self._fetch(r, w)
+        elif api_key == _API_FETCH and api_version == 4:
+            self._fetch_v4(r, w)
         elif api_key == _API_LIST_OFFSETS and api_version == 0:
             self._list_offsets(r, w)
+        elif api_key == _API_FIND_COORDINATOR:
+            self._find_coordinator(r, w)
+        elif api_key == _API_JOIN_GROUP:
+            self._join_group(r, w, client_id)
+        elif api_key == _API_SYNC_GROUP:
+            self._sync_group(r, w)
+        elif api_key == _API_HEARTBEAT:
+            self._heartbeat(r, w)
+        elif api_key == _API_LEAVE_GROUP:
+            self._leave_group(r, w)
+        elif api_key == _API_OFFSET_COMMIT and api_version == 2:
+            self._offset_commit(r, w)
+        elif api_key == _API_OFFSET_FETCH and api_version == 1:
+            self._offset_fetch(r, w)
         else:
             # unsupported api/version: close the connection, the v0-era
             # broker behavior — a clean client-side error, never a hang
             return None
         return w.done()
+
+    # -- consumer groups (GroupCoordinator / GroupMetadataManager analog) --
+    def _expire_members_locked(self, g: _Group) -> None:
+        now = time.time()
+        dead = [m for m, info in g.members.items()
+                if now - info["last_seen"] > info["timeout_ms"] / 1000.0]
+        for m in dead:
+            del g.members[m]
+            g.joined.discard(m)
+        if dead and g.members and g.state == "Stable":
+            g.state = "Joining"
+            g.joined = set()
+            g.deadline = now + _REBALANCE_TIMEOUT_S
+            self._gcond.notify_all()
+        if not g.members:
+            g.state = "Empty"
+
+    def _find_coordinator(self, r: _Reader, w: _Writer) -> None:
+        r.string()                              # group id: we coordinate all
+        w.int16(_ERR_NONE).int32(self.node_id).string(self.host) \
+            .int32(self.port)
+
+    def _join_group(self, r: _Reader, w: _Writer,
+                    client_id: Optional[str]) -> None:
+        group_id = r.string()
+        session_timeout = r.int32()
+        member_id = r.string() or ""
+        r.string()                              # protocol_type
+        protos = r.array(lambda r: (r.string(), r.bytes_()))
+        sub = protos[0][1] if protos else b""
+        with self._gcond:
+            g = self._groups.setdefault(group_id, _Group())
+            self._expire_members_locked(g)
+            if member_id and member_id not in g.members:
+                # deposed member retrying with a stale id: reset it
+                w.int16(_ERR_UNKNOWN_MEMBER_ID).int32(-1).string("") \
+                    .string("").string(member_id) \
+                    .array([], lambda w, x: None)
+                return
+            if not member_id:
+                self._member_seq += 1
+                member_id = f"{client_id or 'member'}-{self._member_seq}"
+            if g.state != "Joining":
+                # any join (re)starts a rebalance round; members of the old
+                # generation discover via Heartbeat/SyncGroup error 27
+                g.state = "Joining"
+                g.joined = set()
+                g.deadline = time.time() + _REBALANCE_TIMEOUT_S
+            g.members[member_id] = {"sub": sub, "timeout_ms": session_timeout,
+                                    "last_seen": time.time()}
+            g.joined.add(member_id)
+            self._gcond.notify_all()
+            # the rebalance BARRIER: wait until every known member rejoined
+            # this round, expelling stragglers at the deadline
+            while g.state == "Joining":
+                # re-assert OUR membership every iteration: a concurrent
+                # leave/expiry restarts the round with a cleared joined set,
+                # and a member blocked right here must never be expelled as
+                # a straggler of the round it is actively waiting in
+                g.members.setdefault(
+                    member_id, {"sub": sub, "timeout_ms": session_timeout,
+                                "last_seen": time.time()})
+                g.joined.add(member_id)
+                missing = set(g.members) - g.joined
+                now = time.time()
+                if not missing or now >= g.deadline:
+                    for m in missing:
+                        del g.members[m]
+                    g.generation += 1
+                    g.leader = min(g.members) if g.members else None
+                    g.assignments = {}
+                    g.state = "AwaitingSync"
+                    self._gcond.notify_all()
+                    break
+                self._gcond.wait(
+                    timeout=max(0.01, min(0.25, g.deadline - now)))
+            members = ([(m, info["sub"])
+                        for m, info in sorted(g.members.items())]
+                       if g.leader == member_id else [])
+            w.int16(_ERR_NONE).int32(g.generation).string("range") \
+                .string(g.leader or "").string(member_id)
+            w.array(members, lambda w, p: w.string(p[0]).bytes_(p[1]))
+
+    def _sync_group(self, r: _Reader, w: _Writer) -> None:
+        r_group = r.string()
+        generation = r.int32()
+        member_id = r.string()
+        assignment_list = r.array(lambda r: (r.string(), r.bytes_()))
+        with self._gcond:
+            g = self._groups.get(r_group)
+            if g is None or member_id not in g.members:
+                w.int16(_ERR_UNKNOWN_MEMBER_ID).bytes_(None)
+                return
+            if g.state == "Joining":
+                w.int16(_ERR_REBALANCE_IN_PROGRESS).bytes_(None)
+                return
+            if generation != g.generation:
+                w.int16(_ERR_ILLEGAL_GENERATION).bytes_(None)
+                return
+            if member_id == g.leader and assignment_list:
+                g.assignments = dict(assignment_list)
+                g.state = "Stable"
+                self._gcond.notify_all()
+            deadline = time.time() + _REBALANCE_TIMEOUT_S
+            while g.state == "AwaitingSync" and generation == g.generation:
+                now = time.time()
+                if now >= deadline:
+                    break
+                self._gcond.wait(
+                    timeout=max(0.01, min(0.25, deadline - now)))
+            if generation != g.generation or g.state != "Stable":
+                w.int16(_ERR_REBALANCE_IN_PROGRESS).bytes_(None)
+                return
+            g.members[member_id]["last_seen"] = time.time()
+            w.int16(_ERR_NONE).bytes_(g.assignments.get(member_id, b""))
+
+    def _heartbeat(self, r: _Reader, w: _Writer) -> None:
+        group_id = r.string()
+        generation = r.int32()
+        member_id = r.string()
+        with self._gcond:
+            g = self._groups.get(group_id)
+            if g is None or member_id not in g.members:
+                w.int16(_ERR_UNKNOWN_MEMBER_ID)
+                return
+            if g.state == "Joining":
+                w.int16(_ERR_REBALANCE_IN_PROGRESS)
+                return
+            if generation != g.generation:
+                w.int16(_ERR_ILLEGAL_GENERATION)
+                return
+            g.members[member_id]["last_seen"] = time.time()
+            w.int16(_ERR_NONE)
+
+    def _leave_group(self, r: _Reader, w: _Writer) -> None:
+        group_id = r.string()
+        member_id = r.string()
+        with self._gcond:
+            g = self._groups.get(group_id)
+            if g is None or member_id not in g.members:
+                w.int16(_ERR_UNKNOWN_MEMBER_ID)
+                return
+            del g.members[member_id]
+            g.joined.discard(member_id)
+            if g.members:
+                g.state = "Joining"
+                g.joined = set()
+                g.deadline = time.time() + _REBALANCE_TIMEOUT_S
+                self._gcond.notify_all()
+            else:
+                g.state = "Empty"
+            w.int16(_ERR_NONE)
+
+    def _offset_commit(self, r: _Reader, w: _Writer) -> None:
+        group_id = r.string()
+        generation = r.int32()
+        member_id = r.string()
+        r.int64()                               # retention_time
+        results = []
+        with self._gcond:
+            g = self._groups.setdefault(group_id, _Group())
+            # generation fencing: a deposed member's commit is rejected
+            # (generation -1 + empty member = the simple-client escape)
+            fenced = (generation >= 0
+                      and (generation != g.generation
+                           or member_id not in g.members))
+            for _ in range(r.int32()):
+                topic = r.string()
+                per = []
+                for _ in range(r.int32()):
+                    part = r.int32()
+                    off = r.int64()
+                    r.string()                  # metadata
+                    if fenced:
+                        per.append((part, _ERR_ILLEGAL_GENERATION))
+                    else:
+                        g.offsets[(topic, part)] = off
+                        per.append((part, _ERR_NONE))
+                results.append((topic, per))
+            if not fenced:
+                self._persist_group_offsets_locked()
+        w.array(results, lambda w, t: w.string(t[0]).array(
+            t[1], lambda w, p: w.int32(p[0]).int16(p[1])))
+
+    def _offset_fetch(self, r: _Reader, w: _Writer) -> None:
+        group_id = r.string()
+        with self._gcond:
+            g = self._groups.get(group_id)
+            results = []
+            for _ in range(r.int32()):
+                topic = r.string()
+                per = []
+                for _ in range(r.int32()):
+                    part = r.int32()
+                    off = g.offsets.get((topic, part), -1) if g else -1
+                    per.append((part, off))
+                results.append((topic, per))
+        w.array(results, lambda w, t: w.string(t[0]).array(
+            t[1], lambda w, p: w.int32(p[0]).int64(p[1]).string("")
+            .int16(_ERR_NONE)))
 
     def _metadata(self, r: _Reader, w: _Writer) -> None:
         want = r.array(lambda r: r.string())
@@ -388,24 +675,36 @@ class KafkaWireBroker:
                 except ValueError:
                     per_part.append((part, _ERR_UNKNOWN, -1))
                     continue
-                with self._lock:
-                    parts = self._logs.get(topic)
-                    if parts is None or not 0 <= part < len(parts):
-                        per_part.append((part, _ERR_UNKNOWN_TOPIC, -1))
-                        continue
-                    base = len(parts[part])
-                    stored = [(base + i, k, v)
-                              for i, (_o, k, v) in enumerate(entries)]
-                    parts[part].extend(stored)
-                    if self.directory:
-                        with open(self._part_path(topic, part), "ab") as f:
-                            f.write(encode_message_set(stored))
-                            f.flush()
-                            os.fsync(f.fileno())
-                per_part.append((part, _ERR_NONE, base))
+                base = self._append(topic, part,
+                                    [(k, v, -1) for _o, k, v in entries])
+                per_part.append((part, _ERR_NONE, base) if base >= 0
+                                else (part, _ERR_UNKNOWN_TOPIC, -1))
             results.append((topic, per_part))
         w.array(results, lambda w, t: w.string(t[0]).array(
             t[1], lambda w, p: w.int32(p[0]).int16(p[1]).int64(p[2])))
+
+    def _append(self, topic: str, part: int,
+                records: List[Tuple[Optional[bytes], Optional[bytes], int]]
+                ) -> int:
+        """Append (key, value, ts) records; returns base offset or -1 for an
+        unknown topic/partition.  Disk persistence uses the v2 record-batch
+        format (richer: keeps timestamps); v0 produces store ts=-1."""
+        with self._lock:
+            parts = self._logs.get(topic)
+            if parts is None or not 0 <= part < len(parts):
+                return -1
+            base = len(parts[part])
+            stored = [(base + i, k, v, ts)
+                      for i, (k, v, ts) in enumerate(records)]
+            parts[part].extend(stored)
+            if self.directory:
+                batch = _encode_batch_v2(
+                    base, [(max(ts, 0), k, v, []) for _o, k, v, ts in stored])
+                with open(self._part_path(topic, part), "ab") as f:
+                    f.write(batch)
+                    f.flush()
+                    os.fsync(f.fileno())
+        return base
 
     def _fetch(self, r: _Reader, w: _Writer) -> None:
         r.int32()                               # replica_id
@@ -431,8 +730,8 @@ class KafkaWireBroker:
                                          hw, b""))
                         continue
                     take, size = [], 0
-                    for e in log[offset:]:
-                        m = encode_message_set([e])   # encode ONCE
+                    for o, k, v, _ts in log[offset:]:
+                        m = encode_message_set([(o, k, v)])   # encode ONCE
                         if take and size + len(m) > max_bytes:
                             break
                         take.append(m)
@@ -441,6 +740,77 @@ class KafkaWireBroker:
             results.append((topic, per_part))
         w.array(results, lambda w, t: w.string(t[0]).array(
             t[1], lambda w, p: w.int32(p[0]).int16(p[1]).int64(p[2])
+            .bytes_(p[3])))
+
+    def _produce_v3(self, r: _Reader, w: _Writer) -> None:
+        r.string()                              # transactional_id
+        r.int16()                               # required_acks
+        r.int32()                               # timeout
+        results = []
+        for _ in range(r.int32()):
+            topic = r.string()
+            per_part = []
+            for _ in range(r.int32()):
+                part = r.int32()
+                data = r.bytes_() or b""
+                try:
+                    recs = _decode_batches_v2(data)
+                except ValueError:
+                    per_part.append((part, _ERR_UNKNOWN, -1))
+                    continue
+                base = self._append(topic, part,
+                                    [(k, v, ts) for _o, ts, k, v, _h in recs])
+                per_part.append((part, _ERR_NONE, base) if base >= 0
+                                else (part, _ERR_UNKNOWN_TOPIC, -1))
+            results.append((topic, per_part))
+        w.array(results, lambda w, t: w.string(t[0]).array(
+            t[1], lambda w, p: w.int32(p[0]).int16(p[1]).int64(p[2])
+            .int64(-1)))                        # log_append_time
+        w.int32(0)                              # throttle_time_ms
+
+    def _fetch_v4(self, r: _Reader, w: _Writer) -> None:
+        r.int32()                               # replica_id
+        r.int32()                               # max_wait
+        r.int32()                               # min_bytes
+        r.int32()                               # max_bytes (response-wide)
+        r.int8()                                # isolation_level
+        results = []
+        for _ in range(r.int32()):
+            topic = r.string()
+            per_part = []
+            for _ in range(r.int32()):
+                part = r.int32()
+                offset = r.int64()
+                max_bytes = r.int32()
+                with self._lock:
+                    parts = self._logs.get(topic)
+                    if parts is None or not 0 <= part < len(parts):
+                        per_part.append((part, _ERR_UNKNOWN_TOPIC, -1, b""))
+                        continue
+                    log = parts[part]
+                    hw = len(log)
+                    if offset > hw or offset < 0:
+                        per_part.append((part, _ERR_OFFSET_OUT_OF_RANGE,
+                                         hw, b""))
+                        continue
+                    # one batch per fetch window, capped by max_bytes via a
+                    # record-count estimate then re-encoded exactly
+                    take = []
+                    size = 0
+                    for o, k, v, ts in log[offset:]:
+                        rec = (len(k or b"") + len(v or b"") + 32)
+                        if take and size + rec > max_bytes:
+                            break
+                        take.append((max(ts, 0), k, v, []))
+                        size += rec
+                    data = (_encode_batch_v2(offset, take) if take else b"")
+                per_part.append((part, _ERR_NONE, hw, data))
+            results.append((topic, per_part))
+        w.int32(0)                              # throttle_time_ms
+        w.array(results, lambda w, t: w.string(t[0]).array(
+            t[1], lambda w, p: w.int32(p[0]).int16(p[1]).int64(p[2])
+            .int64(p[2])                        # last_stable_offset = hw
+            .array([], lambda w, x: None)       # aborted transactions
             .bytes_(p[3])))
 
     def _list_offsets(self, r: _Reader, w: _Writer) -> None:
@@ -792,3 +1162,41 @@ def _json_default(o):
     if isinstance(o, np.generic):
         return o.item()
     raise TypeError(type(o).__name__)
+
+
+def _encode_batch_v2(base_offset, records):
+    """v2 record-batch codec bridge (lazy: kafka_v2 imports this module)."""
+    from flink_tpu.connectors.kafka_v2 import encode_record_batch
+    return encode_record_batch(base_offset, records)
+
+
+def _decode_batches_v2(data):
+    from flink_tpu.connectors.kafka_v2 import decode_record_batches
+    return decode_record_batches(data)
+
+
+def _decode_mixed_log(data: bytes) -> List[Tuple[int, Optional[bytes],
+                                                 Optional[bytes], int]]:
+    """Decode an on-disk partition log that may interleave v0 message sets
+    (pre-upgrade appends) and v2 record batches — byte 16 of each entry is
+    the magic in BOTH layouts (v0: offset8+size4+crc4+magic; v2:
+    baseOffset8+batchLength4+leaderEpoch4+magic), so each entry is sniffed
+    individually."""
+    out: List[Tuple[int, Optional[bytes], Optional[bytes], int]] = []
+    pos = 0
+    while len(data) - pos >= 17:
+        (size,) = struct.unpack_from(">i", data, pos + 8)
+        if data[pos + 16] == 2:
+            # one v2 batch: 12-byte prelude + batchLength
+            end = pos + 12 + size
+            out.extend((off, k, v, ts) for off, ts, k, v, _h
+                       in _decode_batches_v2(data[pos:end]))
+        else:
+            # one v0 message: offset8 + size4 + size bytes
+            end = pos + 12 + size
+            out.extend((off, k, v, -1) for off, k, v
+                       in decode_message_set(data[pos:end]))
+        if end <= pos:
+            raise ValueError("malformed partition log")
+        pos = end
+    return out

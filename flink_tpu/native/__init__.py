@@ -70,6 +70,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.fn_lz_decompress.argtypes = [u8p, i64, u8p, i64]
     lib.fn_crc32.restype = u32
     lib.fn_crc32.argtypes = [u8p, i64, u32]
+    lib.fn_crc32c.restype = u32
+    lib.fn_crc32c.argtypes = [u8p, i64, u32]
     lib.spill_open.restype = vp
     lib.spill_open.argtypes = [cp, i64]
     lib.spill_put.restype = cint
@@ -231,6 +233,29 @@ def crc32(data: bytes, seed: int = 0) -> int:
         import zlib
         return zlib.crc32(data, seed)
     return int(lib.fn_crc32(_u8(data), len(data), seed))
+
+
+_CRC32C_TABLE = None
+
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    """CRC32C (Castagnoli) — Kafka v2 record-batch checksum."""
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "fn_crc32c"):
+        return int(lib.fn_crc32c(_u8(data), len(data), seed))
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else c >> 1
+            tbl.append(c)
+        _CRC32C_TABLE = tbl
+    c = seed ^ 0xFFFFFFFF
+    for b in data:
+        c = _CRC32C_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
 
 
 class SpillStore:

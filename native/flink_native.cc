@@ -217,6 +217,39 @@ API u32 fn_crc32(const u8* data, i64 n, u32 seed) {
   return c ^ 0xffffffffu;
 }
 
+// CRC32C (Castagnoli, reflected poly 0x82F63B78) — the checksum of Kafka's
+// v2 record batches; slice-by-4 tables.
+static u32 crc32c_table[4][256];
+static std::once_flag crc32c_once;
+
+static void crc32c_init() {
+  for (u32 i = 0; i < 256; i++) {
+    u32 c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+    crc32c_table[0][i] = c;
+  }
+  for (u32 i = 0; i < 256; i++)
+    for (int t = 1; t < 4; t++)
+      crc32c_table[t][i] =
+          crc32c_table[t - 1][i] >> 8 ^
+          crc32c_table[0][crc32c_table[t - 1][i] & 0xff];
+}
+
+API u32 fn_crc32c(const u8* data, i64 n, u32 seed) {
+  std::call_once(crc32c_once, crc32c_init);
+  u32 c = seed ^ 0xffffffffu;
+  i64 i = 0;
+  for (; i + 4 <= n; i += 4) {
+    c ^= (u32)data[i] | ((u32)data[i + 1] << 8) | ((u32)data[i + 2] << 16) |
+         ((u32)data[i + 3] << 24);
+    c = crc32c_table[3][c & 0xff] ^ crc32c_table[2][(c >> 8) & 0xff] ^
+        crc32c_table[1][(c >> 16) & 0xff] ^ crc32c_table[0][c >> 24];
+  }
+  for (; i < n; i++)
+    c = crc32c_table[0][(c ^ data[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
 // ---------------------------------------------------------------------------
 // SpillStore — memory-budgeted KV tier with append-only disk log
 // (RocksDB-analog behind the keyed-state spill interface)

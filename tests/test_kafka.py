@@ -60,7 +60,9 @@ def test_client_broker_roundtrip(broker):
     c = KafkaWireClient(broker.host, broker.port)
     try:
         versions = dict((k, (lo, hi)) for k, lo, hi in c.api_versions())
-        assert versions[0] == (0, 0) and versions[1] == (0, 0)
+        # v0 stays supported; v2-era ranges advertised since round 4
+        assert versions[0] == (0, 3) and versions[1] == (0, 4)
+        assert versions[11] == (0, 0) and versions[14] == (0, 0)
         meta = c.metadata(["t"])
         assert meta["brokers"][0]["port"] == broker.port
         assert len(meta["topics"][0]["partitions"]) == 2

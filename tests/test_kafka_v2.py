@@ -1,0 +1,395 @@
+"""Kafka v2 record batches + consumer groups (VERDICT r3 next #3).
+
+Byte-level checks mirror the v0 suite's approach: frames are hand-built in
+the tests with independent struct packing, so the codec is validated
+against the spec, not against itself.  Group tests drive the real broker
+over TCP: join/sync/range assignment, a two-consumer rebalance, generation
+fencing, and committed offsets surviving both consumer restarts and broker
+restarts.  Reference: flink-connector-kafka KafkaSource (reader/enumerator
+built on exactly these APIs)."""
+
+import json
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.kafka import KafkaWireBroker, KafkaWireClient
+from flink_tpu.connectors.kafka_v2 import (
+    KafkaGroupConsumer, KafkaGroupSource, decode_assignment,
+    decode_record_batches, decode_subscription, encode_assignment,
+    encode_record_batch, encode_subscription, fetch_v2, produce_v2,
+    range_assign, read_varint, write_varint)
+from flink_tpu.native import crc32c
+
+
+# ---------------------------------------------------------------------------
+# codec, byte-level
+# ---------------------------------------------------------------------------
+
+def test_crc32c_known_answer():
+    # the Castagnoli check value from the CRC catalogue
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+def test_varint_zigzag():
+    for v in (0, 1, -1, 63, -64, 64, 300, -300, 2 ** 31, -2 ** 31, 10 ** 15):
+        buf = bytearray()
+        write_varint(buf, v)
+        got, pos = read_varint(bytes(buf), 0)
+        assert got == v and pos == len(buf)
+    # zigzag makes small magnitudes short
+    one = bytearray(); write_varint(one, -1)
+    assert len(one) == 1
+
+
+def test_record_batch_golden_bytes():
+    """Hand-assemble a one-record magic-2 batch per the spec and require
+    byte equality with the codec."""
+    key, value, ts = b"k", b"hello", 1234
+    # record: attrs(0) tsDelta(0) offDelta(0) klen(1) key vlen(5) value nh(0)
+    rec = bytes([0]) + bytes([0]) + bytes([0]) \
+        + bytes([1 << 1]) + key + bytes([5 << 1]) + value + bytes([0])
+    rec = bytes([len(rec) << 1]) + rec          # length varint (zigzag)
+    after_crc = struct.pack(">hiqqqhii", 0, 0, ts, ts, -1, -1, -1, 1) + rec
+    crc = crc32c(after_crc)
+    expected = (struct.pack(">qi", 7, 9 + len(after_crc))
+                + struct.pack(">ibI", 0, 2, crc) + after_crc)
+    got = encode_record_batch(7, [(ts, key, value, [])])
+    assert got == expected
+    [(off, rts, rk, rv, hdrs)] = decode_record_batches(expected)
+    assert (off, rts, rk, rv, hdrs) == (7, ts, key, value, [])
+
+
+def test_record_batch_roundtrip_edge_cases():
+    records = [
+        (100, None, b"v0", []),
+        (105, b"key", None, [("h1", b"x"), ("h2", None)]),
+        (99, b"" , b"", []),                     # empty (not null) key/value
+        (100 + 10 ** 7, b"late", b"\x00" * 300, []),
+    ]
+    data = encode_record_batch(42, records)
+    out = decode_record_batches(data)
+    assert [(o, t, k, v, h) for o, t, k, v, h in out] == [
+        (42 + i, t, k, v, h) for i, (t, k, v, h) in enumerate(records)]
+
+
+def test_record_batch_crc_rejects_corruption():
+    data = bytearray(encode_record_batch(0, [(1, b"a", b"b", [])]))
+    data[-1] ^= 0x40
+    with pytest.raises(ValueError, match="CRC32C"):
+        decode_record_batches(bytes(data))
+
+
+def test_partial_trailing_batch_skipped():
+    full = encode_record_batch(0, [(1, b"a", b"b", [])])
+    two = full + encode_record_batch(1, [(2, b"c", b"d", [])])
+    assert len(decode_record_batches(two[:len(full) + 10])) == 1
+
+
+def test_subscription_assignment_codec():
+    sub = encode_subscription(["t1", "t2"])
+    assert decode_subscription(sub) == ["t1", "t2"]
+    a = encode_assignment({"t1": [0, 2], "t2": [1]})
+    assert decode_assignment(a) == {"t1": [0, 2], "t2": [1]}
+
+
+def test_range_assignor():
+    plan = range_assign([("m1", ["t"]), ("m2", ["t"])], {"t": 5})
+    assert plan["m1"]["t"] == [0, 1, 2] and plan["m2"]["t"] == [3, 4]
+    # member not subscribed to a topic gets nothing from it
+    plan = range_assign([("m1", ["t"]), ("m2", ["u"])], {"t": 2, "u": 2})
+    assert plan["m1"] == {"t": [0, 1]} and plan["m2"] == {"u": [0, 1]}
+
+
+# ---------------------------------------------------------------------------
+# broker data plane (v2 over TCP) + cross-version interop
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def broker():
+    b = KafkaWireBroker().start()
+    yield b
+    b.stop()
+
+
+def test_produce_fetch_v2(broker):
+    broker.create_topic("t2", 1)
+    c = KafkaWireClient(broker.host, broker.port)
+    try:
+        base = produce_v2(c, "t2", 0, [(111, b"k1", b"v1", []),
+                                       (222, b"k2", b"v2", [("h", b"1")])])
+        assert base == 0
+        recs, hw = fetch_v2(c, "t2", 0, 0)
+        assert hw == 2
+        assert [(o, t, k, v) for o, t, k, v, _h in recs] == [
+            (0, 111, b"k1", b"v1"), (1, 222, b"k2", b"v2")]
+    finally:
+        c.close()
+
+
+def test_cross_version_interop(broker):
+    """v0-produced records fetch via v4 (and vice versa): one log, two
+    dialects — the broker re-encodes per request version."""
+    broker.create_topic("x", 1)
+    c = KafkaWireClient(broker.host, broker.port)
+    try:
+        c.produce("x", 0, [(b"a", b"old")])            # v0 produce
+        produce_v2(c, "x", 0, [(5, b"b", b"new", [])])  # v3 produce
+        msgs, hw = c.fetch("x", 0, 0)                   # v0 fetch
+        assert hw == 2 and [v for _o, _k, v in msgs] == [b"old", b"new"]
+        recs, hw = fetch_v2(c, "x", 0, 0)               # v4 fetch
+        assert hw == 2 and [v for _o, _t, _k, v, _h in recs] == [b"old",
+                                                                 b"new"]
+    finally:
+        c.close()
+
+
+def test_v2_persistence_across_broker_restart(tmp_path, broker):
+    d = str(tmp_path / "logs")
+    b1 = KafkaWireBroker(directory=d).start()
+    try:
+        b1.create_topic("p", 1)
+        c = KafkaWireClient(b1.host, b1.port)
+        produce_v2(c, "p", 0, [(77, b"k", b"v", [])])
+        c.close()
+    finally:
+        b1.stop()
+    b2 = KafkaWireBroker(directory=d).start()
+    try:
+        c = KafkaWireClient(b2.host, b2.port)
+        recs, hw = fetch_v2(c, "p", 0, 0)
+        assert hw == 1 and recs[0][1] == 77 and recs[0][3] == b"v"
+        c.close()
+    finally:
+        b2.stop()
+
+
+# ---------------------------------------------------------------------------
+# consumer groups
+# ---------------------------------------------------------------------------
+
+def test_find_coordinator(broker):
+    c = KafkaGroupConsumer(broker.host, broker.port, "g0", ["t"])
+    try:
+        node, host, port = c.find_coordinator()
+        assert (host, port) == (broker.host, broker.port)
+    finally:
+        c.close()
+
+
+def test_single_consumer_gets_all_partitions(broker):
+    broker.create_topic("t", 4)
+    c = KafkaGroupConsumer(broker.host, broker.port, "g1", ["t"])
+    try:
+        assignment = c.join()
+        assert assignment == {"t": [0, 1, 2, 3]}
+        assert c.heartbeat()
+    finally:
+        c.leave()
+        c.close()
+
+
+def test_two_consumer_rebalance(broker):
+    """c1 owns everything; c2 joins -> c1's heartbeat reports the rebalance
+    -> both rejoin -> the partitions split; c2 leaves -> c1 reclaims all."""
+    broker.create_topic("t", 4)
+    c1 = KafkaGroupConsumer(broker.host, broker.port, "g2", ["t"],
+                            client_id="c1")
+    c2 = KafkaGroupConsumer(broker.host, broker.port, "g2", ["t"],
+                            client_id="c2")
+    try:
+        assert c1.join() == {"t": [0, 1, 2, 3]}
+        # c2's join blocks on the rebalance barrier until c1 rejoins: run
+        # it in a thread while c1 heartbeats its way into the new round
+        a2: dict = {}
+        t = threading.Thread(target=lambda: a2.update(c2.join()))
+        t.start()
+        deadline = time.time() + 5
+        while c1.heartbeat() and time.time() < deadline:
+            time.sleep(0.02)
+        assert time.time() < deadline, "c1 never saw the rebalance"
+        a1 = c1.join()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        got = sorted(a1.get("t", []) + a2.get("t", []))
+        assert got == [0, 1, 2, 3]
+        assert a1["t"] and a2["t"]          # both hold a nonempty range
+        assert c1.generation == c2.generation
+        # c2 leaves: c1 discovers via heartbeat and reclaims everything
+        c2.leave()
+        deadline = time.time() + 5
+        while c1.heartbeat() and time.time() < deadline:
+            time.sleep(0.02)
+        assert c1.join() == {"t": [0, 1, 2, 3]}
+    finally:
+        c1.close()
+        c2.close()
+
+
+def test_commit_fetch_offsets_with_generation_fencing(broker):
+    broker.create_topic("t", 2)
+    c = KafkaGroupConsumer(broker.host, broker.port, "g3", ["t"])
+    try:
+        c.join()
+        c.commit({("t", 0): 41, ("t", 1): 7})
+        got = c.committed([("t", 0), ("t", 1)])
+        assert got == {("t", 0): 41, ("t", 1): 7}
+        # a deposed generation's commit is fenced
+        c.generation += 5
+        with pytest.raises(ValueError, match="OffsetCommit"):
+            c.commit({("t", 0): 99})
+        c.generation -= 5
+        assert c.committed([("t", 0)]) == {("t", 0): 41}
+    finally:
+        c.close()
+
+
+def test_committed_offsets_survive_broker_restart(tmp_path):
+    d = str(tmp_path / "logs")
+    b1 = KafkaWireBroker(directory=d).start()
+    try:
+        b1.create_topic("t", 1)
+        c = KafkaGroupConsumer(b1.host, b1.port, "gd", ["t"])
+        c.join()
+        c.commit({("t", 0): 123})
+        c.close()
+    finally:
+        b1.stop()
+    b2 = KafkaWireBroker(directory=d).start()
+    try:
+        c = KafkaGroupConsumer(b2.host, b2.port, "gd", ["t"])
+        assert c.committed([("t", 0)]) == {("t", 0): 123}
+        c.close()
+    finally:
+        b2.stop()
+
+
+# ---------------------------------------------------------------------------
+# group source: committed-offset restart
+# ---------------------------------------------------------------------------
+
+def _produce_rows(broker, topic, parts, rows_per_part):
+    c = KafkaWireClient(broker.host, broker.port)
+    try:
+        for p in range(parts):
+            recs = [(i, None,
+                     json.dumps({"part": p, "i": i}).encode(), [])
+                    for i in range(rows_per_part)]
+            produce_v2(c, topic, p, recs)
+    finally:
+        c.close()
+
+
+def _drain(source, parallelism: int = 1):
+    rows = []
+    for split in source.create_splits(parallelism):
+        for el in split.read():
+            if hasattr(el, "columns"):
+                for i in range(len(el)):
+                    rows.append({k: int(np.asarray(el.column(k))[i])
+                                 for k in el.columns})
+    return rows
+
+
+def test_group_source_reads_and_resumes(broker):
+    """First run drains everything and commits; a second run (same group)
+    resumes at the committed offsets and sees ONLY newly produced rows —
+    the committed-offset restart contract of the reference's
+    OffsetsInitializer.committedOffsets."""
+    broker.create_topic("s", 3)
+    _produce_rows(broker, "s", 3, 50)
+    src = KafkaGroupSource(broker.host, broker.port, "s", group_id="job1")
+    rows = _drain(src)
+    assert len(rows) == 150
+    assert {(r["part"], r["i"]) for r in rows} == {
+        (p, i) for p in range(3) for i in range(50)}
+    # run 2, nothing new: resumes at committed offsets, reads nothing
+    assert _drain(KafkaGroupSource(broker.host, broker.port, "s",
+                                   group_id="job1")) == []
+    # produce more, run 3: only the new rows
+    c = KafkaWireClient(broker.host, broker.port)
+    produce_v2(c, "s", 1, [(0, None, json.dumps({"part": 1, "i": 99}).encode(),
+                            [])])
+    c.close()
+    rows3 = _drain(KafkaGroupSource(broker.host, broker.port, "s",
+                                    group_id="job1"))
+    assert rows3 == [{"part": 1, "i": 99}]
+    # a FRESH group starts from earliest and sees everything
+    assert len(_drain(KafkaGroupSource(broker.host, broker.port, "s",
+                                       group_id="job2"))) == 151
+
+
+def test_group_source_parallel_exactly_once(broker):
+    """Two parallel splits partition the topic manually (p %% 2 == split
+    index, the enumerator's round-robin): every record read exactly once."""
+    broker.create_topic("par", 4)
+    _produce_rows(broker, "par", 4, 25)
+    rows = _drain(KafkaGroupSource(broker.host, broker.port, "par",
+                                   group_id="jp"), parallelism=2)
+    assert len(rows) == 100
+    assert {(r["part"], r["i"]) for r in rows} == {
+        (p, i) for p in range(4) for i in range(25)}
+    # resume across BOTH splits: nothing left
+    assert _drain(KafkaGroupSource(broker.host, broker.port, "par",
+                                   group_id="jp"), parallelism=2) == []
+
+
+def test_leave_during_join_barrier(broker):
+    """A member leaving while another waits in the rebalance barrier must
+    not expel the waiter (regression: the waiter's joined mark was erased
+    by the leave, then min() crashed on an empty group)."""
+    broker.create_topic("t", 2)
+    c1 = KafkaGroupConsumer(broker.host, broker.port, "gl", ["t"],
+                            client_id="c1")
+    c2 = KafkaGroupConsumer(broker.host, broker.port, "gl", ["t"],
+                            client_id="c2")
+    try:
+        c1.join()
+        result: dict = {}
+        t = threading.Thread(target=lambda: result.update(c2.join()))
+        t.start()
+        time.sleep(0.15)          # c2 is blocked in the barrier
+        c1.leave()
+        t.join(timeout=8)
+        assert not t.is_alive()
+        assert result == {"t": [0, 1]}   # c2 inherits everything
+        assert c2.heartbeat()
+    finally:
+        c1.close()
+        c2.close()
+
+
+def test_mixed_v0_v2_log_survives_restart(tmp_path):
+    """A pre-upgrade on-disk partition log (v0 message sets) continued with
+    v2 batches must load after a restart — per-entry format sniffing."""
+    from flink_tpu.connectors.kafka import encode_message_set
+
+    d = str(tmp_path / "logs")
+    b1 = KafkaWireBroker(directory=d).start()
+    try:
+        b1.create_topic("m", 1)
+        path = b1._part_path("m", 0)
+    finally:
+        b1.stop()
+    # simulate a pre-upgrade file: raw v0 message set on disk
+    with open(path, "ab") as f:
+        f.write(encode_message_set([(0, b"k0", b"old")]))
+    b2 = KafkaWireBroker(directory=d).start()
+    try:
+        c = KafkaWireClient(b2.host, b2.port)
+        produce_v2(c, "m", 0, [(9, b"k1", b"new", [])])  # appends v2
+        c.close()
+    finally:
+        b2.stop()
+    b3 = KafkaWireBroker(directory=d).start()   # loads the MIXED file
+    try:
+        c = KafkaWireClient(b3.host, b3.port)
+        recs, hw = fetch_v2(c, "m", 0, 0)
+        assert hw == 2
+        assert [v for _o, _t, _k, v, _h in recs] == [b"old", b"new"]
+        c.close()
+    finally:
+        b3.stop()
