@@ -1,0 +1,194 @@
+"""Exactly-once FileSink (VERDICT r3 next #4): checkpoint-id-bound part
+lifecycle (in-progress file -> pending-{ckpt} -> committed), rolling
+policies, buckets, and the S3 committer path — kill-and-restore proofs
+that committed output has no duplicates and no loss.  Reference:
+``flink-connector-files/.../sink/FileSink.java:1``."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu import formats
+from flink_tpu.connectors.file_source import (DateTimeBucketAssigner,
+                                              FileSink, RollingPolicy)
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.operators.base import snapshot_scope
+
+
+def _mkbatch(lo, hi, ts=None):
+    v = np.arange(lo, hi, dtype=np.float64)
+    return RecordBatch({"v": v},
+                       timestamps=None if ts is None
+                       else np.full(len(v), ts, np.int64))
+
+
+def _rows(paths):
+    out = []
+    for p in paths:
+        for b in formats.read_csv(p):
+            out.extend(np.asarray(b.column("v")).tolist())
+    return sorted(out)
+
+
+def test_inprogress_is_a_real_file(tmp_path):
+    """Row formats stream to an actual .inprogress file (bounded memory),
+    not a Python buffer."""
+    d = str(tmp_path / "out")
+    sink = FileSink(d, format="csv")
+    sink.write_batch(_mkbatch(0, 10))
+    inprog = [f for f in os.listdir(d) if f.endswith(".inprogress")]
+    assert len(inprog) == 1
+    # data streams through the OS file (buffered); after the roll the
+    # finalized pending part holds every byte
+    with snapshot_scope(1):
+        sink.snapshot_state()
+    assert not any(f.endswith(".inprogress") for f in os.listdir(d))
+    pend = [f for f in os.listdir(d) if f.endswith(".pending")]
+    assert len(pend) == 1
+    assert os.path.getsize(os.path.join(d, pend[0])) > 0
+
+
+def test_pending_bound_to_checkpoint_id(tmp_path):
+    """A part pended for checkpoint 2 must NOT be committed by checkpoint
+    1's notification — a restore to 1 after 2 fails would double it."""
+    d = str(tmp_path / "out")
+    sink = FileSink(d, format="csv")
+    sink.write_batch(_mkbatch(0, 5))
+    with snapshot_scope(1):
+        sink.snapshot_state()
+    sink.write_batch(_mkbatch(5, 9))
+    with snapshot_scope(2):
+        sink.snapshot_state()
+    sink.notify_checkpoint_complete(1)
+    assert _rows(sink.committed_files()) == list(map(float, range(5)))
+    sink.notify_checkpoint_complete(2)
+    assert _rows(sink.committed_files()) == list(map(float, range(9)))
+
+
+def test_kill_and_restore_no_dupes_no_loss(tmp_path):
+    """The VERDICT's done-criterion: write across checkpoints, crash after
+    an uncommitted epoch, restore from the completed checkpoint, replay —
+    committed output equals the logical stream exactly once."""
+    d = str(tmp_path / "out")
+    sink = FileSink(d, format="csv")
+    sink.write_batch(_mkbatch(0, 50))
+    with snapshot_scope(1):
+        snap1 = sink.snapshot_state()
+    sink.notify_checkpoint_complete(1)
+    sink.write_batch(_mkbatch(50, 80))
+    with snapshot_scope(2):
+        snap2 = sink.snapshot_state()
+    # checkpoint 2 completed, but the notification never arrived (crash
+    # window between complete and notify) — plus an uncheckpointed epoch
+    sink.write_batch(_mkbatch(80, 95))
+    sink._roll()
+    del sink
+    # restore from checkpoint 2: its pending parts commit, the orphaned
+    # epoch-3 parts are discarded; the source replays from 80
+    sink2 = FileSink(d, format="csv")
+    sink2.restore_state(snap2)
+    sink2.write_batch(_mkbatch(80, 95))
+    with snapshot_scope(3):
+        sink2.snapshot_state()
+    sink2.notify_checkpoint_complete(3)
+    assert _rows(sink2.committed_files()) == list(map(float, range(95)))
+    assert not any(f.endswith((".pending", ".inprogress"))
+                   for f in os.listdir(d))
+    # restore-to-1 variant: snap1's parts commit exactly once even though
+    # they were already committed (idempotent re-commit)
+    sink3 = FileSink(d, format="csv")
+    sink3.restore_state(snap1)
+    assert _rows(sink3.committed_files()) == list(map(float, range(95)))
+
+
+def test_rolling_policy_bytes_and_rows(tmp_path):
+    d = str(tmp_path / "out")
+    sink = FileSink(d, format="csv",
+                    rolling_policy=RollingPolicy(max_rows=10,
+                                                 max_bytes=1 << 30))
+    for lo in range(0, 25, 5):             # policy checked per batch
+        sink.write_batch(_mkbatch(lo, lo + 5))
+    with snapshot_scope(1):
+        sink.snapshot_state()
+    sink.notify_checkpoint_complete(1)
+    files = sink.committed_files()
+    assert len(files) >= 2                 # rolled before the checkpoint
+    assert _rows(files) == list(map(float, range(25)))
+    # bytes policy
+    sink2 = FileSink(d, format="csv", prefix="b",
+                     rolling_policy=RollingPolicy(max_rows=1 << 20,
+                                                  max_bytes=64))
+    for lo in range(0, 30, 5):
+        sink2.write_batch(_mkbatch(lo, lo + 5))
+    with snapshot_scope(1):
+        sink2.snapshot_state()
+    sink2.notify_checkpoint_complete(1)
+    assert len(sink2.committed_files()) >= 2
+
+
+def test_datetime_buckets(tmp_path):
+    d = str(tmp_path / "out")
+    sink = FileSink(d, format="csv",
+                    bucket_assigner=DateTimeBucketAssigner("%Y-%m-%d"))
+    day0 = 0                   # 1970-01-01
+    day1 = 86_400_000          # 1970-01-02
+    sink.write_batch(RecordBatch(
+        {"v": np.asarray([1.0, 2.0, 3.0])},
+        timestamps=np.asarray([day0, day1, day0], np.int64)))
+    with snapshot_scope(1):
+        sink.snapshot_state()
+    sink.notify_checkpoint_complete(1)
+    files = sink.committed_files()
+    dirs = {os.path.basename(os.path.dirname(f)) for f in files}
+    assert dirs == {"1970-01-01", "1970-01-02"}
+    assert _rows(files) == [1.0, 2.0, 3.0]
+
+
+def test_bulk_format_roundtrip(tmp_path):
+    """Bulk formats (ftb) buffer and materialize at roll; committed files
+    read back exactly."""
+    d = str(tmp_path / "out")
+    sink = FileSink(d, format="ftb")
+    sink.write_batch(_mkbatch(0, 100))
+    with snapshot_scope(1):
+        sink.snapshot_state()
+    sink.notify_checkpoint_complete(1)
+    [f] = sink.committed_files()
+    got = np.concatenate([np.asarray(b.column("v"))
+                          for b in formats.reader_for("ftb")(f)])
+    np.testing.assert_array_equal(got, np.arange(100, dtype=np.float64))
+
+
+@pytest.fixture()
+def s3(tmp_path):
+    from flink_tpu.filesystems.s3 import S3CompatibleServer
+
+    srv = S3CompatibleServer(str(tmp_path / "s3data"), access_key="AK",
+                             secret_key="SK").start()
+    try:
+        yield srv.client("sink-bucket")
+    finally:
+        srv.stop()
+
+
+def test_s3_commit_and_kill_restore(tmp_path, s3):
+    """S3 committer pattern: parts stage locally, commit uploads to the
+    object store (no rename on S3); kill-and-restore keeps exactly-once."""
+    d = str(tmp_path / "stage")
+    sink = FileSink(d, format="csv", filesystem=s3)
+    sink.write_batch(_mkbatch(0, 20))
+    with snapshot_scope(1):
+        snap = sink.snapshot_state()
+    assert sink.committed_files() == []    # staged, not uploaded
+    del sink                               # crash before notify
+    sink2 = FileSink(d, format="csv", filesystem=s3)
+    sink2.restore_state(snap)              # re-commit uploads to S3
+    [key] = sink2.committed_files()
+    data = s3.get_object(key).decode()
+    vals = sorted(float(line.split(",")[0])
+                  for line in data.splitlines()[1:])
+    assert vals == list(map(float, range(20)))
+    # staging dir fully drained
+    assert not any(f.endswith((".pending", ".inprogress"))
+                   for f in os.listdir(d))
