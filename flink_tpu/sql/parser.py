@@ -179,6 +179,9 @@ class SelectStmt:
     #: scan, and the pruned column set the scan should project to
     scan_filter: Optional[Expr] = None
     scan_columns: Optional[Tuple[str, ...]] = None
+    #: cost-stage annotation (cost.py join_reorder): chosen join order +
+    #: estimated cost; also the done-marker so the rule runs once
+    join_order_cost: Optional[str] = None
 
 
 #: aggregate function names the planner splits out of expressions

@@ -357,6 +357,9 @@ class Planner:
 
         # ---- logical rewrite stage (PlannerBase.translate's optimize step)
         stmt = apply_rules(stmt, self.catalog, self.applied_rules)
+        note = getattr(stmt, "join_order_cost", None)
+        if note is not None:
+            self.cost_note = note          # EXPLAIN's cost section
 
         if isinstance(stmt, UnionStmt):
             return self._plan_union(stmt)

@@ -378,11 +378,19 @@ def projection_prune(stmt, catalog) -> Optional[SelectStmt]:
     return None
 
 
+def _join_reorder(stmt, catalog):
+    # cost stage lives in sql/cost.py; runs AFTER filter_pushdown so the
+    # selectivity model sees the pushed per-input predicates
+    from flink_tpu.sql.cost import join_reorder
+    return join_reorder(stmt, catalog)
+
+
 RULES: List[Tuple[str, Callable]] = [
     ("union_associativity", union_associativity),
     ("over_partition_split", over_partition_split),
     ("filter_pushdown", filter_pushdown),
     ("projection_prune", projection_prune),
+    ("join_reorder(cost-based)", _join_reorder),
 ]
 
 

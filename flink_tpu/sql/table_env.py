@@ -30,6 +30,17 @@ class CatalogTable:
     watermark_delay_ms: int = 0
     timestamps_assigned: bool = False
     _bound_env: Any = None
+    #: lazy catalog statistics (row count + NDV) feeding the cost-based
+    #: join reorder (sql/cost.py); computed on FIRST use — registration
+    #: must not pay per-column np.unique for tables never joined.
+    #: None factory = unknown stats, keeps syntactic plans
+    stats_factory: Any = None
+    stats: Any = None
+
+    def get_stats(self):
+        if self.stats is None and self.stats_factory is not None:
+            self.stats = self.stats_factory()
+        return self.stats
 
     def stream(self):
         return self.stream_factory(self._bound_env)
@@ -74,8 +85,13 @@ class TableEnvironment:
             return env.from_collection(columns=_data, batch_size=_bs,
                                        name=f"table:{name}")
 
+        def make_stats(_data=data):
+            from flink_tpu.sql.cost import TableStats
+            return TableStats.from_columns(_data)
+
         ct = CatalogTable(name, col_names, factory, rowtime=rowtime,
-                          watermark_delay_ms=watermark_delay_ms)
+                          watermark_delay_ms=watermark_delay_ms,
+                          stats_factory=make_stats)
         self._catalog[name] = ct
         return Table(self, SelectStmt(items=[], table=name), ct)
 
@@ -157,6 +173,10 @@ class TableEnvironment:
             seen = dict.fromkeys(planner.applied_rules)  # ordered dedup
             lines.append("== Logical Rewrites Applied ==")
             lines.extend(f"  {r}" for r in seen)
+        note = getattr(planner, "cost_note", None)
+        if note is not None:
+            lines.append("== Join Order (cost-based) ==")
+            lines.append(f"  {note}")
         lines.append("== Physical Execution Plan ==")
         for v in ep.vertices:
             chain = " -> ".join(getattr(n, "name", "?") for n in v.chain) \

@@ -1,0 +1,161 @@
+"""Cost-based join reordering (VERDICT r3 next #5).
+
+The done-criterion test: a 3-way join whose cheapest order differs from
+the written order and measurably beats it, plus the model/DP units and
+the EXPLAIN cost section.  Reference: ``Optimizer.java:402``."""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.sql.cost import (TableStats, _best_order, _Edge, _Rel,
+                                filter_selectivity, join_reorder)
+from flink_tpu.sql.parser import parse
+from flink_tpu.sql.table_env import TableEnvironment
+
+
+def _env(big=20_000, tiny=50):
+    """big_a x big_b share a LOW-NDV key (explosive join); tiny_c shrinks
+    big_b first when joined early."""
+    rng = np.random.default_rng(5)
+    t = TableEnvironment()
+    t.register_collection("big_a", columns={
+        "x": rng.integers(0, 40, big), "va": np.arange(big)})
+    t.register_collection("big_b", columns={
+        "x": rng.integers(0, 40, big), "y": rng.integers(0, big, big),
+        "vb": np.arange(big)})
+    t.register_collection("tiny_c", columns={
+        "y": np.arange(tiny), "vc": np.arange(tiny) * 10})
+    return t
+
+
+def test_stats_lazy_and_cached():
+    t = _env()
+    ct = t._catalog["tiny_c"]
+    assert ct.stats is None               # registration pays nothing
+    st = ct.get_stats()
+    assert st.row_count == 50 and st.ndv["y"] == 50
+    assert ct.get_stats() is st           # cached
+
+
+def test_derived_table_base_keeps_order():
+    """Regression: a derived-table FROM base with two joins must plan (the
+    rule bails instead of using an unhashable SelectStmt as a catalog key)."""
+    t = _env(big=200, tiny=10)
+    rows = t.sql_query(
+        "SELECT d.va, tiny_c.vc FROM (SELECT x, va FROM big_a) d "
+        "JOIN big_b ON d.x = big_b.x "
+        "JOIN tiny_c ON big_b.y = tiny_c.y").execute().collect()
+    assert rows  # planned and executed
+
+
+def test_select_star_schema_stable():
+    """SELECT * must keep the written column order — the rule must not
+    rewrite queries whose OUTPUT depends on join order."""
+    t = _env(big=500, tiny=10)
+    res = t.sql_query(
+        "SELECT * FROM big_a "
+        "JOIN big_b ON big_a.x = big_b.x "
+        "JOIN tiny_c ON big_b.y = tiny_c.y").execute()
+    assert res.output_columns[:2] == ["x", "va"]   # big_a leads
+
+
+def test_filter_selectivity_heuristics():
+    from flink_tpu.sql.parser import Binary, Column, Literal
+    st = TableStats(row_count=1000, ndv={"k": 100})
+    eq = Binary("=", Column("k"), Literal(5))
+    gt = Binary(">", Column("k"), Literal(5))
+    assert filter_selectivity(eq, st) == pytest.approx(1 / 100)
+    assert filter_selectivity(gt, st) == pytest.approx(0.3)
+    assert filter_selectivity(Binary("AND", eq, gt), st) \
+        == pytest.approx(0.3 / 100)
+
+
+def test_dp_prefers_selective_edge_first():
+    # A(1e5) -x- B(1e5) -y- C(10): best left-deep order starts from the
+    # B-C edge, never materializing the A-B blowup first
+    rels = [
+        _Rel(0, "A", "A", None, 1e5, {"x": 10}),
+        _Rel(1, "B", "B", None, 1e5, {"x": 10, "y": 1e5}),
+        _Rel(2, "C", "C", None, 10, {"y": 10}),
+    ]
+    edges = [_Edge(0, 1, "x", "x", None), _Edge(1, 2, "y", "y", None)]
+    order, cost = _best_order(rels, edges)
+    assert order[0] in (1, 2) and set(order[:2]) == {1, 2}
+    assert cost < 1e9
+
+
+def test_three_way_join_reordered_and_faster():
+    """The written order A JOIN B (x, 40 NDV -> ~10M rows) JOIN C must be
+    replaced by one that joins tiny_c early; results identical; wall time
+    measurably better."""
+    sql = ("SELECT big_a.va, big_b.vb, tiny_c.vc FROM big_a "
+           "JOIN big_b ON big_a.x = big_b.x "
+           "JOIN tiny_c ON big_b.y = tiny_c.y")
+    t = _env()
+    plan = t.explain_sql(sql)
+    assert "Join Order (cost-based)" in plan
+    assert "order=['tiny_c'" in plan or "order=['big_b', 'tiny_c'" in plan, \
+        plan
+    # correctness: same rows as the syntactic plan (rule disabled)
+    import flink_tpu.sql.rules as rules_mod
+    rows_opt = t.sql_query(sql).execute().collect()
+    saved = list(rules_mod.RULES)
+    rules_mod.RULES = [r for r in saved if "join_reorder" not in r[0]]
+    try:
+        t2 = _env()
+        t0 = time.perf_counter()
+        rows_syn = t2.sql_query(sql).execute().collect()
+        syn_s = time.perf_counter() - t0
+    finally:
+        rules_mod.RULES = saved
+    t3 = _env()
+    t0 = time.perf_counter()
+    rows_opt2 = t3.sql_query(sql).execute().collect()
+    opt_s = time.perf_counter() - t0
+
+    def key(rows):
+        return sorted((int(r["va"]), int(r["vb"]), int(r["vc"]))
+                      for r in rows)
+
+    assert key(rows_opt) == key(rows_syn) == key(rows_opt2)
+    # the syntactic order materializes the ~10M-row A-B blowup; the chosen
+    # order never does — demand a decisive wall-clock win despite host noise
+    assert opt_s * 1.5 < syn_s, (opt_s, syn_s)
+
+
+def test_outer_join_keeps_syntactic_order():
+    t = _env()
+    sql = ("SELECT big_a.va FROM big_a "
+           "LEFT JOIN big_b ON big_a.x = big_b.x "
+           "JOIN tiny_c ON big_b.y = tiny_c.y")
+    stmt = parse(sql)
+    from flink_tpu.sql.rules import apply_rules
+    out = apply_rules(stmt, t._catalog)
+    assert out.table == "big_a"            # untouched
+    assert getattr(out, "join_order_cost", None) is None
+
+
+def test_no_stats_keeps_syntactic_order():
+    t = _env()
+    # a source-backed table has no stats
+    from flink_tpu.connectors.sources import IteratorSource
+    t.register_source("ext", IteratorSource([]), ["y", "w"])
+    stmt = parse("SELECT big_a.va FROM big_a "
+                 "JOIN big_b ON big_a.x = big_b.x "
+                 "JOIN ext ON big_b.y = ext.y")
+    assert join_reorder(stmt, t._catalog) is None
+
+
+def test_annotation_when_order_kept():
+    """Even a kept order records its estimated cost for EXPLAIN."""
+    t = TableEnvironment()
+    t.register_collection("s1", columns={"k": np.arange(10)})
+    t.register_collection("s2", columns={"k": np.arange(10),
+                                         "j": np.arange(10)})
+    t.register_collection("s3", columns={"j": np.arange(10)})
+    plan = t.explain_sql(
+        "SELECT s1.k FROM s1 JOIN s2 ON s1.k = s2.k "
+        "JOIN s3 ON s2.j = s3.j")
+    assert "est_cost=" in plan
