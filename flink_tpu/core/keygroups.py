@@ -99,6 +99,15 @@ def hash_keys(keys: np.ndarray) -> np.ndarray:
     keys = np.asarray(keys)
     if keys.dtype.kind in "iu":
         return java_int_hash(keys)
+    if keys.dtype.kind == "V" and keys.dtype.itemsize % 8 == 0:
+        # packed composite keys (void bytes, see dataset _composite_key):
+        # vectorized polynomial mix over the 8-byte words
+        words = keys.view(np.int64).reshape(len(keys), -1)
+        h = np.zeros(len(keys), np.int64)
+        with np.errstate(over="ignore"):
+            for j in range(words.shape[1]):
+                h = h * np.int64(31) + words[:, j]
+        return java_int_hash(h)
     return java_string_hash(keys)
 
 

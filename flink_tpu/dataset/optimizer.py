@@ -35,23 +35,25 @@ _BROADCAST_RATIO = 8
 # ---------------------------------------------------------------------------
 
 def _composite_key(batch: RecordBatch, columns: List[str]) -> np.ndarray:
+    """Injective per-row key for grouping/joining on multiple columns.
+
+    The encoding must be CANONICAL — a pure function of the row's values,
+    never of the batch's value range — because callers compare keys ACROSS
+    batches (streamed chunks, the two join sides, distinct's seen set).  A
+    min/max radix packing would map the same logical key differently per
+    batch.  Integer columns pack as big-endian bytes viewed as fixed-width
+    void scalars (memcmp-comparable, exact, sortable — order is equality-
+    only, which is all callers group/intersect on); anything else falls
+    back to joined strings."""
     if len(columns) == 1:
         return np.asarray(batch.column(columns[0]))
     parts = [np.asarray(batch.column(c)) for c in columns]
-    if all(np.issubdtype(p.dtype, np.integer) for p in parts) and \
-            all(p.size for p in parts):
-        # radix packing: shift each column into its own value range so the
-        # mapping is injective; fall back to strings if int64 would overflow
-        mins = [int(p.min()) for p in parts]
-        ranges = [int(p.max()) - m + 1 for p, m in zip(parts, mins)]
-        total = 1
-        for r in ranges:
-            total *= r
-        if total < (1 << 62):
-            out = np.zeros(len(parts[0]), np.int64)
-            for p, m, r in zip(parts, mins, ranges):
-                out = out * np.int64(r) + (p.astype(np.int64) - np.int64(m))
-            return out
+    if all(np.issubdtype(p.dtype, np.integer) for p in parts):
+        fields = np.dtype([(f"f{i}", ">i8") for i in range(len(parts))])
+        arr = np.empty(len(parts[0]), fields)
+        for i, p in enumerate(parts):
+            arr[f"f{i}"] = p.astype(np.int64)
+        return arr.view(f"V{fields.itemsize}").reshape(len(parts[0]))
     return np.asarray(["\x00".join(str(x) for x in row)
                        for row in zip(*[p.tolist() for p in parts])], object)
 
@@ -336,21 +338,10 @@ def _drv_join(op, ins):
             gj.add(1, RecordBatch({**{k: np.asarray(v)
                                       for k, v in r.columns.items()},
                                    "__jk__": rk}))
-            parts = []
-            for lb, li, rb, ri in gj.join_pairs():
-                cols = _merge_columns(lb, rb, li, ri)
-                cols = {k: v for k, v in cols.items()
-                        if k not in ("__jk__", "r___jk__")}
-                parts.append(RecordBatch(cols))
+            parts = [b for b in _grace_join_outputs(op, gj) if len(b)]
             if not parts:
                 return RecordBatch({})
-            out = RecordBatch.concat(parts) if len(parts) > 1 else parts[0]
-            fn = op.args.get("fn")
-            if fn is not None:
-                cols = fn(dict(out.columns))
-                out = RecordBatch({k: np.asarray(v)
-                                   for k, v in cols.items()})
-            return out
+            return RecordBatch.concat(parts) if len(parts) > 1 else parts[0]
     li, ri = _join_pairs(lk, rk) if len(l) and len(r) else (
         np.zeros(0, np.int64), np.zeros(0, np.int64))
     parts = []
@@ -646,10 +637,115 @@ def _exec_stream_raw(op: BatchOp, memo: Dict[int, RecordBatch],
             yield _combine_group_partials(op, partials)
         elif empty is not None:
             yield _DRIVERS[kind](op, [empty])
+    elif kind == "join" and op.args["how"] == "inner":
+        # spilling hybrid hash join: chunks stream into bucket files, each
+        # bucket pair joins in memory (MutableHashTable.java:1 analog) —
+        # the join dam no longer materializes its inputs
+        yield from _stream_inner_join(op, memo, refs, budget)
+    elif kind == "group_reduce":
+        # external sorted-group UDF reduce: sort by key out-of-core, walk
+        # group spans in merge order — one GROUP resident at a time
+        # (GroupReduceCombineDriver over UnilateralSortMerger analog)
+        yield from _stream_group_reduce(op, memo, refs, budget)
     else:
-        # genuine dam without a streaming kernel (joins, UDF reduces,
-        # iterations): materialize the inputs, run the vectorized driver
+        # genuine dam without a streaming kernel (outer joins, iterations):
+        # materialize the inputs, run the vectorized driver
         yield from _chunks(_materialize(op, memo, refs, budget), budget)
+
+
+def _with_join_key(batch: RecordBatch, keys: List[str]) -> RecordBatch:
+    """Attach the canonical composite join key as the ``__jk__`` column."""
+    return RecordBatch(
+        {**{k: np.asarray(v) for k, v in batch.columns.items()},
+         "__jk__": _composite_key(batch, keys)})
+
+
+def _grace_join_outputs(op: BatchOp, gj):
+    """Joined output batches from a fed GraceHashJoin — the single
+    assembly shared by the materialized driver's out-of-core branch and
+    the streamed executor (key-column stripping + optional join fn)."""
+    from flink_tpu.operators.joins import _merge_columns
+
+    fn = op.args.get("fn")
+    for lb, li, rb, ri in gj.join_pairs():
+        cols = _merge_columns(lb, rb, li, ri)
+        cols = {k: v for k, v in cols.items()
+                if k not in ("__jk__", "r___jk__")}
+        out = RecordBatch(cols)
+        if fn is not None:
+            out = RecordBatch({k: np.asarray(v)
+                               for k, v in fn(dict(out.columns)).items()})
+        yield out
+
+
+def _stream_inner_join(op: BatchOp, memo, refs, budget: int):
+    from flink_tpu.dataset.external import GraceHashJoin
+
+    gj = GraceHashJoin("__jk__", "__jk__", budget_rows=budget)
+    schema: List[Optional[RecordBatch]] = [None, None]
+    for side, inp, keys in ((0, op.inputs[0], op.args["left_keys"]),
+                            (1, op.inputs[1], op.args["right_keys"])):
+        for chunk in _exec_stream(inp, memo, refs, budget):
+            # keep only a zero-row slice for the empty-result schema —
+            # retaining the full chunk would pin a budget-sized batch
+            schema[side] = chunk.select(np.zeros(len(chunk), bool))
+            if len(chunk):
+                gj.add(side, _with_join_key(chunk, keys))
+    produced = False
+    for out in _grace_join_outputs(op, gj):
+        if len(out):
+            produced = True
+            yield from _chunks(out, budget)
+    if not produced:
+        # schema-carrying empty result: run the vectorized driver on the
+        # zero-row schema batches (matches the materialized executor)
+        l0 = schema[0] if schema[0] is not None else RecordBatch({})
+        r0 = schema[1] if schema[1] is not None else RecordBatch({})
+        yield _DRIVERS["join"](op, [l0, r0])
+
+
+def _stream_group_reduce(op: BatchOp, memo, refs, budget: int):
+    from flink_tpu.dataset.external import ExternalSorter
+
+    keys = op.args["keys"]
+    fn = op.args["fn"]
+    sorter = ExternalSorter(keys, budget_rows=budget,
+                            emit_batch_rows=min(budget, 1 << 16))
+    empty = None
+    for chunk in _exec_stream(op.inputs[0], memo, refs, budget):
+        if len(chunk):
+            sorter.add(chunk)
+        else:
+            empty = chunk
+    out_rows: List[dict] = []
+    cur_key = _NO_GROUP = object()
+    cur_rows: List[dict] = []
+
+    def flush_group():
+        if cur_key is _NO_GROUP:
+            return
+        res = fn(cur_key if len(keys) > 1 else cur_key[0], cur_rows)
+        if res is not None:
+            out_rows.append(res)
+
+    any_rows = False
+    for batch in sorter.merged():
+        any_rows = any_rows or len(batch) > 0
+        for row in batch.to_rows():
+            kv = tuple(row[k] for k in keys)
+            if kv != cur_key:
+                flush_group()
+                cur_key = kv
+                cur_rows = []
+            cur_rows.append(row)
+        while len(out_rows) >= (1 << 14):
+            emit, out_rows = out_rows[: 1 << 14], out_rows[1 << 14:]
+            yield RecordBatch.from_rows(emit)
+    flush_group()
+    if out_rows:
+        yield RecordBatch.from_rows(out_rows)
+    elif not any_rows and empty is not None:
+        yield empty                       # schema-carrying empty input
 
 
 def _materialize(op: BatchOp, memo, refs, budget) -> RecordBatch:

@@ -158,3 +158,198 @@ def test_grace_join_fast_path_resets_and_cleans(tmp_path):
     assert sum(len(li) for _l, li, _r, _ri in gj.join_pairs()) == 1
     # fast path resets sides (reuse must not re-join stale inputs)
     assert gj._left == [] and gj._right == [] and gj._rows == [0, 0]
+
+
+# ---------------------------------------------------------------------------
+# streamed-plan dam breakers (VERDICT r3 next #6)
+# ---------------------------------------------------------------------------
+
+def test_incremental_spill_during_add(tmp_path):
+    """add() past the budget flushes to bucket files immediately — building
+    the join never holds more than ~budget rows in memory."""
+    gj = GraceHashJoin("k", "k", budget_rows=1_000,
+                       spill_dir=str(tmp_path / "gj"))
+    rng = np.random.default_rng(2)
+    for lo in range(0, 10_000, 500):
+        gj.add(0, RecordBatch({"k": rng.integers(0, 200, 500).astype(np.int64),
+                               "v": np.arange(500)}))
+    assert gj._spilled
+    assert not gj._left and not gj._right       # buffer flushed
+    import os
+    assert any(f.endswith(".ftb") for f in os.listdir(tmp_path / "gj"))
+    gj.add(1, RecordBatch({"k": np.arange(200, dtype=np.int64),
+                           "w": np.arange(200)}))
+    n = sum(li.size for _l, li, _r, _ri in gj.join_pairs())
+    assert n == 10_000                          # every left row matches once
+
+
+def _streamed_rows(ds):
+    rows = []
+    for b in ds.stream_batches():
+        rows.extend(b.to_rows())
+    return rows
+
+
+def test_streamed_join_matches_materialized():
+    from flink_tpu.dataset.api import ExecutionEnvironment
+
+    rng = np.random.default_rng(11)
+    env = ExecutionEnvironment()
+    l = env.from_columns({"k": rng.integers(0, 50, 3_000).astype(np.int64),
+                          "v": np.arange(3_000)})
+    r = env.from_columns({"k": rng.integers(0, 50, 800).astype(np.int64),
+                          "w": np.arange(800)})
+    ds = l.join(r).where("k").equal_to("k").apply()
+    mat = ds.collect()
+    got = _streamed_rows(ds)
+
+    def key(rows):
+        return sorted((int(x["k"]), int(x["v"]), int(x["w"])) for x in rows)
+
+    assert key(got) == key(mat)
+    assert len(got) > 3_000                     # duplicates fanned out
+
+
+def test_streamed_group_reduce_matches_materialized():
+    from flink_tpu.dataset.api import ExecutionEnvironment
+
+    rng = np.random.default_rng(12)
+    env = ExecutionEnvironment()
+    ds0 = env.from_columns({"k": rng.integers(0, 40, 5_000).astype(np.int64),
+                            "v": rng.integers(0, 100, 5_000)})
+
+    def fn(key, rows):
+        return {"k": int(key), "n": len(rows),
+                "s": sum(int(r["v"]) for r in rows)}
+
+    ds = ds0.group_by("k").reduce_group(fn)
+    mat = sorted((r["k"], r["n"], r["s"]) for r in ds.collect())
+    got = sorted((r["k"], r["n"], r["s"]) for r in _streamed_rows(ds))
+    assert got == mat
+
+
+def test_streamed_join_empty_keeps_schema():
+    from flink_tpu.dataset.api import ExecutionEnvironment
+
+    env = ExecutionEnvironment()
+    l = env.from_columns({"k": np.arange(5, dtype=np.int64),
+                          "v": np.arange(5)})
+    r = env.from_columns({"k": np.arange(10, 15, dtype=np.int64),
+                          "w": np.arange(5)})
+    ds = l.join(r).where("k").equal_to("k").apply()
+    batches = list(ds.stream_batches())
+    assert sum(len(b) for b in batches) == 0
+    # streamed and materialized agree on the empty-result structure
+    assert set(batches[-1].columns) == set(ds.collect_batch().columns)
+
+
+@pytest.mark.slow
+def test_stream_plan_join_rss_bounded_beyond_budget(tmp_path):
+    """The VERDICT done-criterion: a join LARGER than the row budget runs
+    under the streamed plan with bounded peak RSS (VmHWM, hermetic child:
+    the inputs would be ~10M rows x 2 columns each if materialized)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {root!r})
+        import numpy as np
+        from flink_tpu.dataset.api import ExecutionEnvironment
+
+        n = 10_000_000
+        env = ExecutionEnvironment()
+        l = (env.generate_sequence(1, n)
+             .map(lambda c: {{"k": np.asarray(c["value"]) % 1_000_000,
+                              "v": np.asarray(c["value"])}}))
+        r = (env.generate_sequence(1, n)
+             .map(lambda c: {{"k": np.asarray(c["value"]) % 1_000_000,
+                              "w": np.asarray(c["value"])}}))
+        j = l.join(r).where("k").equal_to("k").apply()
+        total = 0
+        for b in j.stream_batches():
+            total += len(b)
+        assert total == 100_000_000, total   # 10 x 10 per key
+        g = (env.generate_sequence(1, n)
+             .map(lambda c: {{"k": np.asarray(c["value"]) % 100_000,
+                              "v": np.asarray(c["value"])}})
+             .group_by("k")
+             .reduce_group(lambda k, rows: {{"k": int(k), "n": len(rows)}}))
+        cnt = 0
+        for b in g.stream_batches():
+            cnt += len(b)
+        assert cnt == 100_000, cnt
+        with open("/proc/self/status") as f:
+            hwm_kb = next(int(line.split()[1]) for line in f
+                          if line.startswith("VmHWM:"))
+        print("PEAK_MB", hwm_kb / 1024)
+    """)
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                     FLINK_TPU_BATCH_MEMORY_ROWS=str(1 << 20))
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=900,
+                         env=child_env)
+    assert "PEAK_MB" in out.stdout, out.stderr[-3000:]
+    peak_mb = float(out.stdout.split("PEAK_MB")[1].strip())
+    # materialized join inputs alone would be ~320MB + the 100M-row output
+    # (~1.6GB); bounded execution stays near baseline + budget chunks
+    assert peak_mb < 800, peak_mb
+
+
+def test_multicolumn_key_join_canonical_across_chunks():
+    """Regression: composite keys must encode canonically — per-chunk
+    min/max radix packing matched (0,0) with (10,0) across chunks and
+    across sides with different value ranges."""
+    from flink_tpu.dataset.api import ExecutionEnvironment
+
+    env = ExecutionEnvironment()
+    # left a in {0,1,10,11}; right a in {0,10} (different side ranges)
+    l = env.from_columns({"a": np.array([0, 1, 10, 11] * 3, np.int64),
+                          "b": np.array([0, 0, 0, 0, 1, 1, 1, 1,
+                                         2, 2, 2, 2], np.int64),
+                          "v": np.arange(12)})
+    r = env.from_columns({"a": np.array([0, 10, 0], np.int64),
+                          "b": np.array([0, 0, 1], np.int64),
+                          "w": np.arange(3)})
+    ds = l.join(r).where("a", "b").equal_to("a", "b").apply()
+    expected = sorted([(0, 0, 0), (10, 0, 2), (0, 1, 4)])
+
+    def got(rows):
+        return sorted((int(x["a"]), int(x["b"]), int(x["v"])) for x in rows)
+
+    assert got(ds.collect()) == expected
+    # streamed with a 4-row chunk budget: chunks see disjoint ranges
+    import os
+    old = os.environ.get("FLINK_TPU_BATCH_MEMORY_ROWS")
+    os.environ["FLINK_TPU_BATCH_MEMORY_ROWS"] = "4"
+    try:
+        assert got(_streamed_rows(ds)) == expected
+    finally:
+        if old is None:
+            del os.environ["FLINK_TPU_BATCH_MEMORY_ROWS"]
+        else:
+            os.environ["FLINK_TPU_BATCH_MEMORY_ROWS"] = old
+
+
+def test_multicolumn_distinct_across_chunks():
+    from flink_tpu.dataset.api import ExecutionEnvironment
+    import os
+
+    env = ExecutionEnvironment()
+    ds = env.from_columns({
+        "a": np.array([0, 1, 10, 11, 0, 10], np.int64),
+        "b": np.array([0, 0, 0, 0, 0, 0], np.int64)}).distinct("a", "b")
+    old = os.environ.get("FLINK_TPU_BATCH_MEMORY_ROWS")
+    os.environ["FLINK_TPU_BATCH_MEMORY_ROWS"] = "2"
+    try:
+        rows = _streamed_rows(ds)
+    finally:
+        if old is None:
+            del os.environ["FLINK_TPU_BATCH_MEMORY_ROWS"]
+        else:
+            os.environ["FLINK_TPU_BATCH_MEMORY_ROWS"] = old
+    assert sorted((int(r["a"]), int(r["b"])) for r in rows) == [
+        (0, 0), (1, 0), (10, 0), (11, 0)]
