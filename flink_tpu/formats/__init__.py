@@ -1,4 +1,4 @@
-"""Record formats: CSV, JSON-lines, FTB binary.
+"""Record formats: CSV, JSON-lines, FTB binary, Avro, Parquet.
 
 Analog of ``flink-formats/*`` (Avro/Parquet/ORC/CSV/JSON): encoders/decoders
 between files and columnar ``RecordBatch``es.  Columnar-first: a format reads
@@ -6,9 +6,10 @@ a whole batch of rows into typed numpy columns (the batched-boundary pattern
 the TPU runtime needs), never record-at-a-time objects.
 
 FTB is the framework's own binary format (``flink_tpu/native/codec.py``):
-length-prefixed compressed column blocks — the Parquet-role format here.
-Parquet/ORC themselves need pyarrow, which is not in this environment; the
-reader raises a clear error if requested (pluggable seam kept).
+length-prefixed compressed column blocks.  Avro (``formats/avro.py``) and
+Parquet (``formats/parquet.py``) are implemented from their specs — no
+fastavro/pyarrow in this environment.  ORC still needs pyarrow; the reader
+raises a clear error if requested (pluggable seam kept).
 """
 
 from __future__ import annotations
@@ -234,19 +235,30 @@ def _write_avro(batches, path: str, **kw) -> int:
     return write_avro(batches, path, **kw)
 
 
+def _read_parquet(path: str, batch_size: int = 0, **kw):
+    from flink_tpu.formats.parquet import read_parquet
+    return read_parquet(path, batch_size=batch_size, **kw)
+
+
+def _write_parquet(batches, path: str, **kw) -> int:
+    from flink_tpu.formats.parquet import write_parquet
+    return write_parquet(batches, path, **kw)
+
+
 FORMATS = {
     "csv": (read_csv, write_csv),
     "jsonl": (read_jsonl, write_jsonl),
     "ftb": (read_ftb, write_ftb),
     "avro": (_read_avro, _write_avro),
+    "parquet": (_read_parquet, _write_parquet),
 }
 
 
 def reader_for(fmt: str):
-    if fmt in ("parquet", "orc"):
+    if fmt == "orc":
         raise NotImplementedError(
-            f"{fmt} needs pyarrow (not in this environment); "
-            f"use 'avro', 'ftb' (binary), 'csv' or 'jsonl'")
+            "orc needs pyarrow (not in this environment); "
+            "use 'parquet', 'avro', 'ftb' (binary), 'csv' or 'jsonl'")
     if fmt not in FORMATS:
         raise ValueError(f"unknown format {fmt!r}; have {sorted(FORMATS)}")
     return FORMATS[fmt][0]

@@ -1,0 +1,284 @@
+"""Parquet from first principles (VERDICT r3 next #7).
+
+The golden-fixture test hand-assembles a tiny Parquet file with an
+INDEPENDENT thrift-compact encoder written here (the codec is validated
+against the spec, not against itself — the Kafka-frame test pattern);
+round-trips cover every type, dictionary encoding, gzip, multiple row
+groups, and the FileSink/FileSource integration."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from flink_tpu import formats
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.formats.parquet import read_parquet, write_parquet
+
+
+# --------------------------------------------------------------------------
+# independent minimal thrift-compact encoder (test-local, for the fixture)
+# --------------------------------------------------------------------------
+
+def uv(n):
+    out = b""
+    while n >= 0x80:
+        out += bytes([(n & 0x7F) | 0x80])
+        n >>= 7
+    return out + bytes([n])
+
+
+def zz(n):
+    return uv((n << 1) ^ (n >> 63) if n < 0 else n << 1)
+
+
+def fld(delta, ftype):
+    return bytes([(delta << 4) | ftype])
+
+
+def golden_file_bytes():
+    """One INT64 REQUIRED column 'v' with values [7, 9]: PLAIN,
+    uncompressed, one row group — every byte derived from the spec."""
+    values = struct.pack("<qq", 7, 9)
+    # PageHeader{type=DATA(0), uncomp=16, comp=16,
+    #            data_page_header{num=2, enc=PLAIN, def=RLE, rep=RLE}}
+    page_hdr = (
+        fld(1, 5) + zz(0) +          # 1: i32 type = DATA_PAGE
+        fld(1, 5) + zz(16) +         # 2: i32 uncompressed_size
+        fld(1, 5) + zz(16) +         # 3: i32 compressed_size
+        fld(2, 12) +                 # 5: struct data_page_header (delta 2)
+        fld(1, 5) + zz(2) +          #   1: num_values
+        fld(1, 5) + zz(0) +          #   2: encoding PLAIN
+        fld(1, 5) + zz(3) +          #   3: def-level enc RLE
+        fld(1, 5) + zz(3) +          #   4: rep-level enc RLE
+        b"\x00" +                    # end data_page_header
+        b"\x00")                     # end PageHeader
+    body = b"PAR1" + page_hdr + values
+    data_off = 4                     # page starts right after the magic
+    chunk_total = len(page_hdr) + len(values)
+    # ColumnMetaData
+    cmd = (
+        fld(1, 5) + zz(2) +                    # 1: type INT64
+        fld(1, 9) + bytes([(1 << 4) | 5]) + zz(0) +   # 2: encodings [PLAIN]
+        fld(1, 9) + bytes([(1 << 4) | 8]) + uv(1) + b"v" +  # 3: path ["v"]
+        fld(1, 5) + zz(0) +                    # 4: codec UNCOMPRESSED
+        fld(1, 6) + zz(2) +                    # 5: num_values
+        fld(1, 6) + zz(chunk_total) +          # 6: total_uncompressed
+        fld(1, 6) + zz(chunk_total) +          # 7: total_compressed
+        fld(2, 6) + zz(data_off) +             # 9: data_page_offset
+        b"\x00")
+    chunk = (fld(2, 6) + zz(data_off) +        # 2: file_offset
+             fld(1, 12) + cmd +                # 3: meta_data
+             b"\x00")
+    row_group = (
+        fld(1, 9) + bytes([(1 << 4) | 12]) + chunk +  # 1: columns
+        fld(1, 6) + zz(chunk_total) +                 # 2: total_byte_size
+        fld(1, 6) + zz(2) +                           # 3: num_rows
+        b"\x00")
+    schema_root = fld(4, 8) + uv(6) + b"schema" + fld(1, 5) + zz(1) + b"\x00"
+    schema_v = (fld(1, 5) + zz(2) +            # 1: type INT64
+                fld(2, 5) + zz(0) +            # 3: repetition REQUIRED
+                fld(1, 8) + uv(1) + b"v" +     # 4: name
+                b"\x00")
+    created = "flink-tpu parquet 1.0".encode()
+    footer = (
+        fld(1, 5) + zz(1) +                            # 1: version
+        fld(1, 9) + bytes([(2 << 4) | 12]) + schema_root + schema_v,  # 2
+    )[0] + (
+        fld(1, 6) + zz(2) +                            # 3: num_rows
+        fld(1, 9) + bytes([(1 << 4) | 12]) + row_group +  # 4: row_groups
+        fld(2, 8) + uv(len(created)) + created +       # 6: created_by
+        b"\x00")
+    return body + footer + struct.pack("<I", len(footer)) + b"PAR1"
+
+
+def test_reader_decodes_spec_golden_fixture(tmp_path):
+    p = str(tmp_path / "golden.parquet")
+    with open(p, "wb") as f:
+        f.write(golden_file_bytes())
+    [batch] = list(read_parquet(p))
+    assert list(batch.columns) == ["v"]
+    assert np.asarray(batch.column("v")).tolist() == [7, 9]
+
+
+def test_writer_emits_exact_golden_bytes(tmp_path):
+    """Byte-level: the writer's output for the golden case is IDENTICAL to
+    the hand-derived fixture."""
+    p = str(tmp_path / "w.parquet")
+    write_parquet([RecordBatch({"v": np.array([7, 9], np.int64)})], p)
+    got = open(p, "rb").read()
+    assert got == golden_file_bytes()
+
+
+@pytest.mark.parametrize("compression", [None, "gzip"])
+def test_roundtrip_all_types(tmp_path, compression):
+    rng = np.random.default_rng(4)
+    n = 2_000
+    cols = {
+        "i64": rng.integers(-2**40, 2**40, n),
+        "i32": rng.integers(-2**30, 2**30, n).astype(np.int32),
+        "f32": rng.random(n).astype(np.float32),
+        "f64": rng.random(n),
+        "flag": rng.integers(0, 2, n).astype(bool),
+        "name": np.asarray([f"user-{i % 97}" for i in range(n)], object),
+    }
+    p = str(tmp_path / "t.parquet")
+    write_parquet([RecordBatch(cols)], p, compression=compression)
+    out = RecordBatch.concat(list(read_parquet(p)))
+    assert list(out.columns) == list(cols)
+    for c, v in cols.items():
+        got = np.asarray(out.column(c))
+        if v.dtype.kind == "O":
+            assert got.tolist() == [str(x) for x in v.tolist()]
+        else:
+            np.testing.assert_array_equal(got, v)
+
+
+def test_dictionary_encoding_small_cardinality(tmp_path):
+    n = 5_000
+    vals = np.asarray([f"city-{i % 7}" for i in range(n)], object)
+    p = str(tmp_path / "d.parquet")
+    write_parquet([RecordBatch({"city": vals})], p, dictionary="always")
+    raw = open(p, "rb").read()
+    # the 7 distinct strings appear ONCE (dictionary page), not 5000 times
+    assert raw.count(b"city-3") == 1
+    [out] = list(read_parquet(p))
+    assert np.asarray(out.column("city")).tolist() == vals.tolist()
+    # auto mode picks dictionary here too (7 << 5000)
+    p2 = str(tmp_path / "d2.parquet")
+    write_parquet([RecordBatch({"city": vals})], p2)
+    assert open(p2, "rb").read().count(b"city-3") == 1
+
+
+def test_multiple_row_groups(tmp_path):
+    p = str(tmp_path / "rg.parquet")
+    write_parquet([RecordBatch({"v": np.arange(10_000, dtype=np.int64)})],
+                  p, row_group_rows=3_000)
+    parts = list(read_parquet(p))
+    assert [len(b) for b in parts] == [3_000, 3_000, 3_000, 1_000]
+    got = np.concatenate([np.asarray(b.column("v")) for b in parts])
+    np.testing.assert_array_equal(got, np.arange(10_000))
+
+
+def test_rle_run_decoding(tmp_path):
+    """The hybrid reader must accept RLE runs too (a foreign writer may
+    emit them): splice an RLE-run index page into a dictionary file."""
+    from flink_tpu.formats.parquet import _rle_bitpack_read
+
+    # header (run=5)<<1, bit width 3, value 5 -> one byte 0b00000101
+    data = bytes([5 << 1, 0b101])
+    out = _rle_bitpack_read(data, 3, 5)
+    assert out.tolist() == [5] * 5
+    # mixed: bit-packed group then RLE run
+    from flink_tpu.formats.parquet import _rle_bitpack_write
+    bp = _rle_bitpack_write(np.asarray([1, 2, 3, 4, 5, 6, 7, 0]), 3)
+    mixed = bp + bytes([4 << 1, 0b010])
+    out2 = _rle_bitpack_read(mixed, 3, 12)
+    assert out2.tolist() == [1, 2, 3, 4, 5, 6, 7, 0, 2, 2, 2, 2]
+
+
+def test_file_sink_and_source_speak_parquet(tmp_path):
+    from flink_tpu.connectors.file_source import FileSink, FileSource
+    from flink_tpu.operators.base import snapshot_scope
+
+    d = str(tmp_path / "out")
+    sink = FileSink(d, format="parquet")
+    sink.write_batch(RecordBatch({"v": np.arange(100, dtype=np.int64)}))
+    with snapshot_scope(1):
+        sink.snapshot_state()
+    sink.notify_checkpoint_complete(1)
+    [f] = sink.committed_files()
+    src = FileSource(f, format="parquet")
+    [split] = src.create_splits(1)
+    got = np.concatenate([np.asarray(b.column("v")) for b in split.read()
+                          if hasattr(b, "columns")])
+    np.testing.assert_array_equal(got, np.arange(100))
+
+
+def test_corrupt_magic_rejected(tmp_path):
+    p = str(tmp_path / "bad.parquet")
+    with open(p, "wb") as f:
+        f.write(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="magic"):
+        list(read_parquet(p))
+
+
+def test_unsigned_roundtrip_bit_exact(tmp_path):
+    """Regression: uint32/uint64 store as signed physical bits with UINT
+    converted types — values above the signed range must round-trip."""
+    cols = {
+        "u32": np.array([0, 3_000_000_000, 2**32 - 1], np.uint32),
+        "u64": np.array([1, 2**63 + 5, 2**64 - 1], np.uint64),
+    }
+    p = str(tmp_path / "u.parquet")
+    write_parquet([RecordBatch(cols)], p)
+    [out] = list(read_parquet(p))
+    for c, v in cols.items():
+        got = np.asarray(out.column(c))
+        assert got.dtype == v.dtype
+        np.testing.assert_array_equal(got, v)
+
+
+def test_multi_page_chunk_fully_decoded(tmp_path):
+    """A chunk holding several data pages (foreign writers page at ~1MB)
+    must decode completely — the reader loops to the declared value count."""
+    from flink_tpu.formats.parquet import (_encode_plain, _page_header,
+                                           _file_metadata, T_INT64, MAGIC,
+                                           CODEC_UNCOMPRESSED)
+    import io
+
+    vals = np.arange(10, dtype=np.int64)
+    buf = io.BytesIO()
+    buf.write(MAGIC)
+    first_off = buf.tell()
+    data_off = buf.tell()
+    uncomp = 0
+    for lo in (0, 4, 8):               # three pages: 4 + 4 + 2 values
+        chunk = vals[lo:lo + 4]
+        raw = _encode_plain(chunk, T_INT64)
+        hdr = _page_header(0, len(raw), len(raw), num_values=len(chunk))
+        buf.write(hdr)
+        buf.write(raw)
+        uncomp += len(hdr) + len(raw)
+    end = buf.tell()
+    meta = [{"columns": [{
+        "name": "v", "type": T_INT64, "encodings": [0],
+        "codec": CODEC_UNCOMPRESSED, "num_values": 10,
+        "data_off": data_off, "dict_off": None,
+        "total_comp": end - first_off, "total_uncomp": uncomp,
+        "file_off": first_off}], "bytes": end - first_off, "rows": 10}]
+    footer = _file_metadata(["v"], {"v": (T_INT64, None)}, 10, meta)
+    buf.write(footer)
+    buf.write(struct.pack("<I", len(footer)))
+    buf.write(MAGIC)
+    p = str(tmp_path / "mp.parquet")
+    open(p, "wb").write(buf.getvalue())
+    [out] = list(read_parquet(p))
+    np.testing.assert_array_equal(np.asarray(out.column("v")), vals)
+
+
+def test_bytes_values_dictionary_safe(tmp_path):
+    """Regression: bytes cells must not be str()-mangled by the dictionary
+    path (b'x' previously became the string \"b'x'\")."""
+    vals = np.asarray([b"x", b"y", b"x", b"x"] * 30, object)
+    for mode in ("always", "never"):
+        p = str(tmp_path / f"b-{mode}.parquet")
+        write_parquet([RecordBatch({"k": vals})], p, dictionary=mode)
+        [out] = list(read_parquet(p))
+        assert np.asarray(out.column("k")).tolist() == ["x", "y", "x", "x"] * 30, mode
+
+
+def test_streaming_writer_bounded_groups(tmp_path):
+    """Many input batches with small row groups: the writer slices groups
+    exactly and never needs the whole input at once."""
+    p = str(tmp_path / "s.parquet")
+    batches = [RecordBatch({"v": np.arange(i * 100, (i + 1) * 100,
+                                           dtype=np.int64)})
+               for i in range(50)]
+    write_parquet(batches, p, row_group_rows=1_234)
+    parts = list(read_parquet(p))
+    assert sum(len(b) for b in parts) == 5_000
+    got = np.concatenate([np.asarray(b.column("v")) for b in parts])
+    np.testing.assert_array_equal(got, np.arange(5_000))
+    assert all(len(b) == 1_234 for b in parts[:-1])
