@@ -68,9 +68,11 @@ def test_ftb_torn_tail_ignored(tmp_path):
     assert sum(len(b) for b in got) == 10
 
 
-def test_parquet_clearly_gated():
+def test_orc_clearly_gated_parquet_native():
+    # parquet is implemented natively since round 4; orc stays gated
+    assert formats.reader_for("parquet") is not None
     with pytest.raises(NotImplementedError):
-        formats.reader_for("parquet")
+        formats.reader_for("orc")
 
 
 # ---------------------------------------------------------------------------
