@@ -395,6 +395,7 @@ class MiniCluster(TaskListener):
                 timeout_s: float = 300.0) -> JobResult:
         import copy as _copy
 
+        self._plan = plan              # dashboard DAG view
         t0 = time.monotonic()
         restarts = 0
         # restart budgets are per execution (per-ExecutionGraph in the
@@ -497,18 +498,56 @@ class MiniCluster(TaskListener):
             t.cancel()
 
     # ------------------------------------------------------- introspection
+    def execution_plan_view(self) -> Dict[str, Any]:
+        """DAG topology for the dashboard (JobGraph REST view analog):
+        vertices (id, name, parallelism) + edges (source, target,
+        partitioning)."""
+        plan = getattr(self, "_plan", None)
+        if plan is None:
+            return {"vertices": [], "edges": []}
+        edges = []
+        for v in plan.vertices:
+            for e in v.out_edges:
+                edges.append({"source": v.id, "target": e.target_id,
+                              "partitioning": str(getattr(
+                                  e, "partitioning", ""))})
+        return {"vertices": [{"id": v.id, "name": v.name,
+                              "parallelism": v.parallelism}
+                             for v in plan.vertices],
+                "edges": edges}
+
     def job_status(self) -> Dict[str, Any]:
         """REST-facing job view (jobs/<id> handler backing)."""
         tasks = getattr(self, "_tasks", [])
         by_vertex: Dict[str, List] = {}
         for t in tasks:
             by_vertex.setdefault(t.vertex_uid, []).append(t)
+        plan = getattr(self, "_plan", None)
+        # tasks key on v.uid (the stable operator id), not the int plan id
+        names = ({v.uid: v.name for v in plan.vertices} if plan is not None
+                 else {})
         vertices = []
         for uid, ts in by_vertex.items():
             total_ns = max(1, sum(t.busy_ns + t.idle_ns + t.backpressure_ns
                                   for t in ts))
+
+            def ratios(t):
+                tot = max(1, t.busy_ns + t.idle_ns + t.backpressure_ns)
+                return (t.busy_ns / tot, t.idle_ns / tot,
+                        t.backpressure_ns / tot)
+
+            subtasks = []
+            for t in sorted(ts, key=lambda t: t.subtask_index):
+                b, i, bp = ratios(t)
+                subtasks.append({
+                    "index": t.subtask_index, "state": t.state,
+                    "records_in": t.records_in,
+                    "records_out": t.records_out,
+                    "busy_ratio": b, "idle_ratio": i,
+                    "backpressure_ratio": bp})
             vertices.append({
                 "id": uid,
+                "name": names.get(uid, str(uid)),
                 "parallelism": len(ts),
                 "status": sorted({t.state for t in ts}),
                 "records_in": sum(t.records_in for t in ts),
@@ -518,6 +557,7 @@ class MiniCluster(TaskListener):
                 "backpressure_ratio":
                     sum(t.backpressure_ns for t in ts) / total_ns,
                 "watermark": _vertex_watermark(ts),
+                "subtasks": subtasks,
             })
         states = [t.state for t in tasks]
         terminal = (TaskStates.FINISHED, TaskStates.CANCELED)

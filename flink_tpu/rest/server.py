@@ -177,6 +177,39 @@ class RestServer:
                              for t in getattr(cluster, "_tasks", [])}
                     return self._send(flamegraph(duration_ms=150,
                                                  thread_names=names))
+                if sub == "plan":
+                    view = getattr(cluster, "execution_plan_view",
+                                   lambda: {"vertices": [], "edges": []})()
+                    return self._send(view)
+                # ---- server-rendered dashboard views (views.py): DAG svg,
+                # flame svg, checkpoint table, per-subtask backpressure —
+                # DOM-testable without a browser
+                if sub == "plan.svg":
+                    from flink_tpu.rest.views import plan_svg
+                    view = getattr(cluster, "execution_plan_view",
+                                   lambda: {"vertices": [], "edges": []})()
+                    return self._send(plan_svg(view).encode(),
+                                      content_type="image/svg+xml")
+                if sub == "flamegraph.svg":
+                    from flink_tpu.rest.flamegraph import flamegraph
+                    from flink_tpu.rest.views import flamegraph_svg
+                    names = {f"task-{t.vertex_uid}-{t.subtask_index}"
+                             for t in getattr(cluster, "_tasks", [])}
+                    tree = flamegraph(duration_ms=150, thread_names=names)
+                    return self._send(flamegraph_svg(tree).encode(),
+                                      content_type="image/svg+xml")
+                if sub == "checkpoints.html":
+                    from flink_tpu.rest.views import checkpoints_html
+                    frag = checkpoints_html(
+                        status.get("checkpoint_stats", []),
+                        status["completed_checkpoints"])
+                    return self._send(frag.encode(),
+                                      content_type="text/html")
+                if sub == "backpressure.html":
+                    from flink_tpu.rest.views import backpressure_html
+                    return self._send(
+                        backpressure_html(status["vertices"]).encode(),
+                        content_type="text/html")
                 return self._send({"error": f"unknown path {sub}"}, 404)
 
             def do_POST(self):  # noqa: N802
@@ -274,6 +307,17 @@ _DASHBOARD_HTML = """<!DOCTYPE html>
  button:hover{border-color:var(--text-2)}
  #flame svg{width:100%;background:var(--panel);border-radius:8px}
  #flame text{font:10px system-ui;fill:#fff;pointer-events:none}
+ .panelbox{background:var(--panel);border:1px solid var(--border);
+   border-radius:8px;padding:.4rem;overflow-x:auto}
+ .bp-subtask{display:flex;align-items:center;gap:.6rem;margin:.2rem 0}
+ .bp-label{font-size:.8rem;color:var(--text-2);min-width:7rem}
+ .bp-pct{font-size:.75rem;color:var(--text-2)}
+ .bp-bar{display:flex;height:10px;width:220px;border-radius:4px;
+   overflow:hidden;background:var(--surface)}
+ .bp-busy{background:var(--busy)} .bp-backpressured{background:var(--bp)}
+ .bp-idle{background:var(--idle)}
+ .bp-vertex h3{font-size:.85rem;margin:.5rem 0 .15rem}
+ .ckpt-table{margin:.3rem 0}
  .state-RUNNING{color:var(--busy)} .state-FINISHED{color:var(--good)}
  .state-FAILED,.state-CANCELED{color:var(--crit)}
  .err{color:var(--crit);font-size:.85rem;white-space:pre-wrap}
@@ -294,14 +338,15 @@ _DASHBOARD_HTML = """<!DOCTYPE html>
  <th>records in / out</th><th>watermark</th><th>time share</th></tr></thead>
  <tbody></tbody>
  </table>
+ <h2>Job graph</h2><div id="dag" class="panelbox"></div>
+ <h2>Subtask backpressure</h2><div id="bp"></div>
  <h2>Latency (source&rarr;sink)</h2><div class="tiles" id="lat"></div>
  <h2>Checkpoints</h2>
- <table id="cktab"><thead><tr><th>id</th><th>completed</th>
- <th>duration</th><th>state size</th><th>acked subtasks</th></tr></thead>
- <tbody></tbody></table>
+ <div id="ckview"></div>
  <div id="ckpts" style="font-size:.88rem;color:var(--text-2)"></div>
  <div id="exc"></div>
- <h2>Flame graph <button onclick="flame()">sample</button></h2>
+ <h2>Flame graph <button onclick="flame()">sample</button>
+  <button onclick="flameSvg()">server svg</button></h2>
  <div id="flame"></div>
 </div>
 <script>
@@ -366,19 +411,16 @@ async function refresh(){
     .map(k=>tile(k,lat[k].toFixed(1)+' ms')).join('')||
     '<span style="color:var(--text-2);font-size:.85rem">no samples yet</span>';
   const ck=await J('/jobs/'+sel+'/checkpoints');
-  const fmtB=b=>b>=1048576?(b/1048576).toFixed(1)+' MB':
-    b>=1024?(b/1024).toFixed(1)+' KB':b+' B';
-  const cb=document.querySelector('#cktab tbody');cb.innerHTML='';
-  for(const c of (ck.history||[]).slice(-12).reverse()){
-    const tr=document.createElement('tr');
-    tr.innerHTML=`<td>${c.id}</td>`+
-     `<td>${new Date(c.completed_at_ms).toLocaleTimeString()}</td>`+
-     `<td>${c.duration_ms} ms</td><td>${fmtB(c.state_size_bytes)}</td>`+
-     `<td>${c.acked_subtasks}</td>`;
-    cb.appendChild(tr);
-  }
   document.getElementById('ckpts').textContent=
     ck.count?('completed: '+ck.count):'none yet';
+  // server-rendered views: DAG svg, per-subtask backpressure, and the
+  // checkpoint drill-down table (replaces the old client-side renderer)
+  fetch('/jobs/'+sel+'/plan.svg').then(r=>r.text())
+    .then(t=>{document.getElementById('dag').innerHTML=t});
+  fetch('/jobs/'+sel+'/backpressure.html').then(r=>r.text())
+    .then(t=>{document.getElementById('bp').innerHTML=t});
+  fetch('/jobs/'+sel+'/checkpoints.html').then(r=>r.text())
+    .then(t=>{document.getElementById('ckview').innerHTML=t});
   const ex=await J('/jobs/'+sel+'/exceptions');
   let exh='';
   if((ex.history||[]).length){
@@ -418,6 +460,10 @@ async function flame(){
   }
   svg.push('</svg>');
   document.getElementById('flame').innerHTML=svg.join('');
+}
+async function flameSvg(){
+  const t=await (await fetch('/jobs/'+sel+'/flamegraph.svg')).text();
+  document.getElementById('flame').innerHTML=t;
 }
 refresh();setInterval(refresh,2000);
 </script></body></html>

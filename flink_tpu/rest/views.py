@@ -1,0 +1,196 @@
+"""Server-rendered dashboard views: job DAG SVG, flame graph SVG,
+checkpoint-history and per-subtask backpressure HTML fragments.
+
+The reference ships a 17k-LoC Angular SPA (``flink-runtime-web/
+web-dashboard``: dagre DAG view, d3-flame-graph, checkpoint drill-down,
+per-subtask backpressure); this framework renders the same four views
+server-side as SVG/HTML fragments the embedded dashboard injects — which
+also makes them assertable by automated DOM tests (parse the markup, no
+browser needed)."""
+
+from __future__ import annotations
+
+import html
+from typing import Any, Dict, List
+
+
+def _esc(s: Any) -> str:
+    return html.escape(str(s), quote=True)
+
+
+# ---------------------------------------------------------------------------
+# job DAG (dagre-analog layered layout)
+# ---------------------------------------------------------------------------
+
+def plan_svg(plan: Dict[str, Any]) -> str:
+    """ExecutionPlan view -> layered SVG.  ``plan``: {"vertices": [{id,
+    name, parallelism}], "edges": [{source, target, partitioning}]}.
+    Layers = longest-path depth from sources; vertices are rounded rects,
+    edges cubic paths labeled with their partitioning."""
+    vertices = plan.get("vertices", [])
+    edges = plan.get("edges", [])
+    depth: Dict[Any, int] = {v["id"]: 0 for v in vertices}
+    for _ in range(len(vertices)):
+        for e in edges:
+            if e["source"] in depth and e["target"] in depth:
+                depth[e["target"]] = max(depth[e["target"]],
+                                         depth[e["source"]] + 1)
+    layers: Dict[int, List[dict]] = {}
+    for v in vertices:
+        layers.setdefault(depth[v["id"]], []).append(v)
+    BW, BH, HGAP, VGAP, PAD = 190, 54, 90, 28, 24
+    pos: Dict[Any, tuple] = {}
+    max_rows = max((len(vs) for vs in layers.values()), default=1)
+    for d in sorted(layers):
+        for i, v in enumerate(layers[d]):
+            x = PAD + d * (BW + HGAP)
+            y = PAD + i * (BH + VGAP)
+            pos[v["id"]] = (x, y)
+    width = PAD * 2 + (max(layers, default=0) + 1) * (BW + HGAP) - HGAP
+    height = PAD * 2 + max_rows * (BH + VGAP) - VGAP
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" class="job-dag" '
+             f'viewBox="0 0 {width} {height}" width="{width}" '
+             f'height="{height}">']
+    for e in edges:
+        if e["source"] not in pos or e["target"] not in pos:
+            continue
+        x1, y1 = pos[e["source"]]
+        x2, y2 = pos[e["target"]]
+        sx, sy = x1 + BW, y1 + BH / 2
+        tx, ty = x2, y2 + BH / 2
+        mx = (sx + tx) / 2
+        parts.append(
+            f'<path class="dag-edge" d="M {sx} {sy} C {mx} {sy}, '
+            f'{mx} {ty}, {tx} {ty}" fill="none" stroke="#8b949e" '
+            f'stroke-width="1.5" marker-end="url(#arr)"/>')
+        label = _esc(e.get("partitioning", ""))
+        if label:
+            parts.append(f'<text class="dag-edge-label" x="{mx}" '
+                         f'y="{(sy + ty) / 2 - 5}" font-size="10" '
+                         f'fill="#8b949e" text-anchor="middle">{label}'
+                         f'</text>')
+    parts.append('<defs><marker id="arr" viewBox="0 0 10 10" refX="9" '
+                 'refY="5" markerWidth="7" markerHeight="7" '
+                 'orient="auto-start-reverse">'
+                 '<path d="M 0 0 L 10 5 L 0 10 z" fill="#8b949e"/>'
+                 '</marker></defs>')
+    for v in vertices:
+        x, y = pos[v["id"]]
+        name = _esc(v.get("name", v["id"]))
+        parts.append(
+            f'<g class="dag-vertex" data-vertex-id="{_esc(v["id"])}">'
+            f'<rect x="{x}" y="{y}" width="{BW}" height="{BH}" rx="8" '
+            f'fill="#1c2430" stroke="#2f81f7" stroke-width="1.5"/>'
+            f'<text x="{x + BW / 2}" y="{y + 22}" font-size="12" '
+            f'fill="#e6edf3" text-anchor="middle">{name}</text>'
+            f'<text x="{x + BW / 2}" y="{y + 40}" font-size="10" '
+            f'fill="#8b949e" text-anchor="middle">parallelism '
+            f'{_esc(v.get("parallelism", 1))}</text></g>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# flame graph (d3-flame-graph analog, static SVG)
+# ---------------------------------------------------------------------------
+
+def flamegraph_svg(tree: Dict[str, Any], width: int = 1000,
+                   row_h: int = 18, max_depth: int = 40) -> str:
+    """{name, value, children} tree -> icicle-layout SVG (root at top)."""
+    total = max(tree.get("value", 0), 1)
+
+    rects: List[str] = []
+    depth_max = 0
+
+    def walk(node, x0: float, x1: float, depth: int):
+        nonlocal depth_max
+        if depth > max_depth or x1 - x0 < 0.5:
+            return
+        depth_max = max(depth_max, depth)
+        w = x1 - x0
+        name = _esc(node.get("name", ""))
+        pct = 100.0 * node.get("value", 0) / total
+        hue = 20 + (hash(name) % 20)
+        rects.append(
+            f'<g class="flame-frame" data-depth="{depth}">'
+            f'<rect x="{x0:.2f}" y="{depth * row_h}" width="{w:.2f}" '
+            f'height="{row_h - 1}" fill="hsl({hue},85%,{60 - depth % 3 * 4}%)"'
+            f'><title>{name} — {node.get("value", 0)} samples '
+            f'({pct:.1f}%)</title></rect>')
+        if w > 40:
+            shown = name if len(name) * 6 < w else name[: int(w / 6)] + "…"
+            # style (not attribute): survives the dashboard's
+            # `#flame text{fill:#fff}` ID-selector rule
+            rects.append(
+                f'<text x="{x0 + 3:.2f}" y="{depth * row_h + 13}" '
+                f'font-size="10" style="fill:#1a1a1a">{shown}</text>')
+        rects.append("</g>")
+        x = x0
+        for c in node.get("children", []):
+            cw = w * c.get("value", 0) / max(node.get("value", 1), 1)
+            walk(c, x, x + cw, depth + 1)
+            x += cw
+
+    walk(tree, 0.0, float(width), 0)
+    height = (depth_max + 1) * row_h
+    return (f'<svg xmlns="http://www.w3.org/2000/svg" class="flamegraph" '
+            f'viewBox="0 0 {width} {height}" width="100%" '
+            f'height="{height}">' + "".join(rects) + "</svg>")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint drill-down + per-subtask backpressure (HTML fragments)
+# ---------------------------------------------------------------------------
+
+def checkpoints_html(history: List[Dict[str, Any]],
+                     completed_ids: List[int]) -> str:
+    """Checkpoint-history drill-down table (CheckpointStatsTracker view)."""
+    rows = []
+    done = set(completed_ids)
+    for cp in history:
+        cid = cp.get("id")
+        state = cp.get("state") or ("COMPLETED" if cid in done
+                                    else "IN_PROGRESS")
+        rows.append(
+            f'<tr class="ckpt-row" data-checkpoint-id="{_esc(cid)}">'
+            f'<td>{_esc(cid)}</td><td>{_esc(state)}</td>'
+            f'<td>{_esc(cp.get("duration_ms", "—"))}</td>'
+            f'<td>{_esc(cp.get("state_size_bytes", "—"))}</td>'
+            f'<td>{_esc(cp.get("kind", "checkpoint"))}</td></tr>')
+    if not rows:
+        rows.append('<tr class="ckpt-row"><td colspan="5">no checkpoints '
+                    'yet</td></tr>')
+    return ('<table class="ckpt-table"><thead><tr><th>id</th><th>state</th>'
+            '<th>duration (ms)</th><th>size (bytes)</th><th>kind</th>'
+            '</tr></thead><tbody>' + "".join(rows) + "</tbody></table>")
+
+
+def backpressure_html(vertices: List[Dict[str, Any]]) -> str:
+    """Per-SUBTASK busy/backpressure/idle bars (the reference's subtask
+    backpressure tab), one row per subtask under its vertex."""
+    out = ['<div class="bp-view">']
+    for v in vertices:
+        out.append(f'<div class="bp-vertex" data-vertex-id='
+                   f'"{_esc(v["id"])}"><h3>{_esc(v.get("name", v["id"]))}'
+                   f"</h3>")
+        for s in v.get("subtasks", []):
+            busy = float(s.get("busy_ratio", 0))
+            bp = float(s.get("backpressure_ratio", 0))
+            idle = float(s.get("idle_ratio", 0))
+            out.append(
+                f'<div class="bp-subtask" data-subtask='
+                f'"{_esc(s.get("index"))}">'
+                f'<span class="bp-label">#{_esc(s.get("index"))} '
+                f'{_esc(s.get("state", ""))}</span>'
+                f'<div class="bp-bar">'
+                f'<div class="bp-busy" style="width:{busy * 100:.1f}%">'
+                f"</div>"
+                f'<div class="bp-backpressured" '
+                f'style="width:{bp * 100:.1f}%"></div>'
+                f'<div class="bp-idle" style="width:{idle * 100:.1f}%">'
+                f"</div></div>"
+                f'<span class="bp-pct">busy {busy * 100:.0f}% · bp '
+                f'{bp * 100:.0f}% · idle {idle * 100:.0f}%</span></div>')
+        out.append("</div>")
+    out.append("</div>")
+    return "".join(out)
