@@ -617,8 +617,11 @@ class WindowedStream:
         return self
 
     def evictor(self, evictor) -> "WindowedStream":
-        """Raw-element window path with eviction (``evictor(...)`` analog);
-        terminal op becomes ``apply``."""
+        """Raw-element window path with eviction (``evictor(...)`` analog).
+        Terminal ops: ``aggregate``/``sum``/``count``/... with a
+        Count/Time evictor run the DEVICE fast lane (columnar elements,
+        mask eviction, on-device combine); any evictor works with the
+        host ``apply`` path."""
         self._evictor = evictor
         return self
 
@@ -670,6 +673,54 @@ class WindowedStream:
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
         late_tag = getattr(self, "_late_tag", None)
+        ev = getattr(self, "_evictor", None)
+        if ev is not None:
+            # evictor + aggregate: the DEVICE fast lane for the common
+            # cases (Count/Time evictors + built-in aggregates) — raw
+            # elements columnar on device, evict by mask, combine on
+            # device, download only fired results.  No host-UDF warning
+            # applies: the fire-time compute is device-side.
+            from flink_tpu.core.functions import CountAggregator
+            from flink_tpu.operators.evicting_device import (
+                DeviceEvictingWindowOperator, device_evictor_supported)
+            if not device_evictor_supported(ev, agg):
+                raise ValueError(
+                    "evictor()+aggregate() runs on the device lane for "
+                    "CountEvictor/TimeEvictor with built-in aggregates; "
+                    "for other evictors use .apply(fn) (raw-element host "
+                    "path)")
+            if not hasattr(assigner, "pane_of"):
+                raise ValueError(
+                    "evictors require a pane-based window assigner "
+                    "(tumbling/sliding); session windows do not support "
+                    "evictors")
+            if trigger is not None or late_tag is not None:
+                raise ValueError("custom triggers / side outputs are not "
+                                 "supported with evictors")
+            if value_column is None:
+                if isinstance(agg, CountAggregator):
+                    # count() needs no value column; the buffer still needs
+                    # SOME column — the key column is always present
+                    value_column = keyed.key_column
+                else:
+                    raise ValueError(
+                        "evictor()+aggregate() needs value_column")
+            if keyed.env.mesh is not None:
+                import warnings
+                warnings.warn(
+                    "evictor()+aggregate() runs on a single device (the "
+                    "element buffer is not mesh-sharded yet); the env mesh "
+                    "is ignored for this operator", stacklevel=2)
+            evictor_proto, evictor_vc = ev, value_column
+
+            def factory():
+                return DeviceEvictingWindowOperator(
+                    assigner, copy.deepcopy(evictor_proto), agg,
+                    key_column=keyed.key_column, value_column=evictor_vc,
+                    output_column=output_column,
+                    allowed_lateness_ms=lateness, name=name)
+
+            return DataStream(keyed.env, keyed._then(name, factory))
 
         from flink_tpu.windowing.assigners import SessionGap
         if isinstance(assigner, SessionGap):

@@ -1,0 +1,430 @@
+"""Device fast lane for evicting windows: columnar raw elements on device.
+
+``EvictingWindowOperator`` (the general lane) buffers rows host-side
+because the evictor + apply function are arbitrary per-row Python.  But
+the COMMON evictor cases need no row-level Python at all (VERDICT r3 next
+#10): CountEvictor keeps the last n per (key, window) and TimeEvictor
+keeps a trailing time span — both are vectorizable masks — and the
+built-in aggregates (sum/min/max/count/avg) are segment combines.  This
+operator keeps the raw elements as COLUMNAR DEVICE BUFFERS, evicts by
+mask inside one jitted fire step, combines on device, and downloads only
+the fired per-key results — the batched analog of
+``EvictingWindowOperator.java:1`` with ``CountEvictor``/``TimeEvictor``.
+
+Layout: ONE append-only element buffer (values [C], key slots [C], pane
+ids [C], timestamps [C], write cursor) — append is a single
+``dynamic_update_slice`` of the pow2-padded batch, so XLA compiles O(log)
+shapes; arrival order IS buffer order (what CountEvictor ranks by).
+Expired panes are dropped by an on-device stable compaction when the
+buffer passes 3/4 occupancy.  Fires slice the window's panes by mask:
+per-key reverse arrival ranks (count eviction) or per-key max-timestamp
+spans (time eviction), then a masked segment combine.
+
+Scope (falls back to the host lane otherwise): pane-based assigners,
+event time, Count/Time evictors, aggregates with declared scatter kinds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
+                                  Watermark)
+from flink_tpu.core.functions import AggregateFunction, RuntimeContext
+from flink_tpu.operators.base import StreamOperator
+from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex, make_key_index
+from flink_tpu.windowing.assigners import WindowAssigner
+from flink_tpu.windowing.evictors import CountEvictor, Evictor, TimeEvictor
+
+from flink_tpu.ops.shapes import next_pow2 as _next_pow2
+
+_SEG = {"add": jax.ops.segment_sum, "min": jax.ops.segment_min,
+        "max": jax.ops.segment_max}
+
+
+def device_evictor_supported(evictor: Optional[Evictor],
+                             agg: AggregateFunction) -> bool:
+    """True when the (evictor, aggregate) pair runs on the device lane."""
+    return (isinstance(evictor, (CountEvictor, TimeEvictor))
+            and agg.scatter_kind_leaves() is not None)
+
+
+class DeviceEvictingWindowOperator(StreamOperator):
+    """``window(...).evictor(Count/Time).aggregate(built-in)``, on device."""
+
+    def __init__(self, assigner: WindowAssigner, evictor: Evictor,
+                 agg: AggregateFunction, key_column: str,
+                 value_column: str, output_column: str = "result",
+                 allowed_lateness_ms: int = 0,
+                 emit_window_bounds: bool = True,
+                 initial_capacity: int = 1 << 12,
+                 initial_key_capacity: int = 1 << 10,
+                 name: str = "evicting-window-device"):
+        if not hasattr(assigner, "pane_of"):
+            raise ValueError("device evictor lane requires a pane-based "
+                             "assigner (tumbling/sliding)")
+        if not isinstance(evictor, (CountEvictor, TimeEvictor)):
+            raise ValueError("device evictor lane supports CountEvictor and "
+                             "TimeEvictor")
+        kinds = agg.scatter_kind_leaves()
+        if kinds is None:
+            raise ValueError("device evictor lane requires an aggregate "
+                             "with declared scatter kinds (built-ins)")
+        self.assigner = assigner
+        self.evictor = evictor
+        self.agg = agg
+        self.kinds = kinds
+        self.spec = agg.acc_spec()
+        self.key_column = key_column
+        self.value_column = value_column
+        self.output_column = output_column
+        self.emit_window_bounds = emit_window_bounds
+        self.lateness = int(allowed_lateness_ms)
+        self.name = name
+        self._C = _next_pow2(initial_capacity)
+        self._K = _next_pow2(initial_key_capacity)
+        self.key_index: Optional[KeyIndex | ObjectKeyIndex] = None
+        self._vals = None          # f32 [C]
+        self._keys = None          # i32 [C]  (K = invalid row)
+        self._panes = None         # i32 [C], RELATIVE to _pane_epoch
+        self._ts = None            # i32 [C], RELATIVE to _ts_epoch (ms)
+        self._count = 0            # host write cursor (rows appended)
+        # device columns are int32 (x64 off): absolute pane ids and
+        # epoch-ms timestamps rebase against per-operator epochs fixed at
+        # the first batch; snapshots store absolute values
+        self._pane_epoch: Optional[int] = None
+        self._ts_epoch: Optional[int] = None
+        self.pane_base: Optional[int] = None
+        self.max_pane: Optional[int] = None
+        self.last_fired_window: Optional[int] = None
+        self.watermark: int = LONG_MIN
+        self.late_dropped = 0
+
+    INVALID_PANE = -(1 << 31)     # int32 min: invalid row
+
+    def open(self, ctx: RuntimeContext) -> None:
+        pass
+
+    # -------------------------------------------------------------- buffers
+    def _alloc(self, C: int):
+        return (jnp.zeros(C, jnp.float32),
+                jnp.full(C, self._K, jnp.int32),
+                jnp.full(C, self.INVALID_PANE, jnp.int32),
+                jnp.zeros(C, jnp.int32))
+
+    def _ensure(self, extra: int):
+        if self._vals is None:
+            while self._C < extra:
+                self._C <<= 1
+            self._vals, self._keys, self._panes, self._ts = \
+                self._alloc(self._C)
+            return
+        if self._count + extra <= self._C:
+            return
+        # try on-device compaction of expired panes first
+        if self.pane_base is not None:
+            self._compact()
+        while self._count + extra > self._C:
+            self._C <<= 1
+            nv, nk, npn, nts = self._alloc(self._C)
+            half = self._C >> 1
+            self._vals = nv.at[:half].set(self._vals)
+            self._keys = nk.at[:half].set(self._keys)
+            self._panes = npn.at[:half].set(self._panes)
+            self._ts = nts.at[:half].set(self._ts)
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2, 3, 4))
+    def _compact_step(self, vals, keys, panes, ts, lo):
+        """Stable-partition live rows (pane >= lo) to the front, reset the
+        rest to invalid — one device op, no download but the live count."""
+        live = panes >= lo
+        order = jnp.argsort(~live, stable=True)
+        n_live = live.sum()
+        idx = jnp.arange(vals.shape[0])
+        keep = idx < n_live
+        vals2 = jnp.where(keep, vals[order], 0.0)
+        keys2 = jnp.where(keep, keys[order], self._K)
+        panes2 = jnp.where(keep, panes[order], self.INVALID_PANE)
+        ts2 = jnp.where(keep, ts[order], 0)
+        return vals2, keys2, panes2, ts2, n_live
+
+    def _compact(self):
+        lo = self.pane_base - (self._pane_epoch or 0)
+        self._vals, self._keys, self._panes, self._ts, n_live = \
+            self._compact_step(self._vals, self._keys, self._panes,
+                               self._ts, jnp.int32(lo))
+        self._count = int(n_live)  # one scalar download
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2, 3, 4))
+    def _append_step(self, vals, keys, panes, ts, new_v, new_k, new_p,
+                     new_t, at):
+        return (jax.lax.dynamic_update_slice(vals, new_v, (at,)),
+                jax.lax.dynamic_update_slice(keys, new_k, (at,)),
+                jax.lax.dynamic_update_slice(panes, new_p, (at,)),
+                jax.lax.dynamic_update_slice(ts, new_t, (at,)))
+
+    # ------------------------------------------------------------ batching
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        keys = np.asarray(batch.column(self.key_column))
+        if self.key_index is None:
+            self.key_index = make_key_index(keys[0] if keys.ndim else keys,
+                                            capacity_hint=self._K)
+        if batch.timestamps is None:
+            raise ValueError("evicting windows require timestamps")
+        ts = np.asarray(batch.timestamps, np.int64)
+        panes = self.assigner.pane_of(ts)
+        # lateness gate (same formula as WindowAggOperator)
+        if self.watermark != LONG_MIN:
+            p0, p1 = int(panes.min()), int(panes.max())
+            cand = (np.arange(p0, p1 + 1, dtype=np.int64)
+                    if p1 - p0 < 64 else np.unique(panes))
+            is_late = np.asarray(
+                [self.assigner.last_window_end_of_pane(int(p)) - 1
+                 + self.lateness <= self.watermark for p in cand.tolist()])
+            if is_late.any():
+                live = ~np.isin(panes, cand[is_late])
+                self.late_dropped += int(np.count_nonzero(~live))
+                if not live.any():
+                    return []
+                batch = batch.select(live)
+                keys = np.asarray(batch.column(self.key_column))
+                ts = ts[live]
+                panes = panes[live]
+        slots = self.key_index.lookup_or_insert(keys)
+        if self.key_index.num_keys > self._K:
+            self._grow_keys()
+        pmin, pmax = int(panes.min()), int(panes.max())
+        self.pane_base = pmin if self.pane_base is None \
+            else min(self.pane_base, pmin)
+        self.max_pane = pmax if self.max_pane is None \
+            else max(self.max_pane, pmax)
+        B = len(batch)
+        Bp = _next_pow2(B, 64)
+        self._ensure(Bp)
+        if self._pane_epoch is None:
+            self._pane_epoch = pmin
+            self._ts_epoch = int(ts.min())
+        vals = np.zeros(Bp, np.float32)
+        vals[:B] = np.asarray(batch.column(self.value_column), np.float32)
+        kp = np.full(Bp, self._K, np.int32)
+        kp[:B] = slots
+        pp = np.full(Bp, self.INVALID_PANE, np.int32)
+        pp[:B] = panes - self._pane_epoch
+        tp = np.zeros(Bp, np.int32)
+        tp[:B] = ts - self._ts_epoch
+        self._vals, self._keys, self._panes, self._ts = self._append_step(
+            self._vals, self._keys, self._panes, self._ts,
+            jnp.asarray(vals), jnp.asarray(kp), jnp.asarray(pp),
+            jnp.asarray(tp), jnp.int32(self._count))
+        self._count += Bp
+        return []
+
+    def _grow_keys(self):
+        # key ids only live in the buffer's key column; capacity is virtual
+        while self._K < self.key_index.num_keys:
+            self._K <<= 1
+
+    # ---------------------------------------------------------------- time
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        self.watermark = max(self.watermark, watermark.timestamp)
+        return self._advance(self.watermark)
+
+    def end_input(self) -> List[StreamElement]:
+        return self._advance(2 ** 62)
+
+    def _advance(self, now: int) -> List[StreamElement]:
+        if self._vals is None or self.pane_base is None:
+            return []
+        a = self.assigner
+        lo_w = a.windows_of_pane(self.pane_base)[0]
+        hi_w = a.windows_of_pane(self.max_pane)[1]
+        start = (self.last_fired_window + 1
+                 if self.last_fired_window is not None else lo_w)
+        out: List[StreamElement] = []
+        fired_any = None
+        for w in range(max(start, lo_w), hi_w + 1):
+            if a.window_bounds(w).max_timestamp > now:
+                break
+            out.extend(self._fire_window(w))
+            fired_any = w
+        if fired_any is not None and (self.last_fired_window is None
+                                      or fired_any > self.last_fired_window):
+            self.last_fired_window = fired_any
+        # retention: panes behind every un-expired window drop at compaction
+        p = self.pane_base
+        while (p <= self.max_pane
+               and a.last_window_end_of_pane(p) - 1 + self.lateness <= now):
+            p += 1
+        self.pane_base = p
+        return out
+
+    # --------------------------------------------------------------- fires
+    @partial(jax.jit, static_argnums=(0, 5, 6))
+    def _fire_step(self, vals, keys, panes, ts, k_active: int, n_rows: int,
+                   lo, hi):
+        """Evict + combine for one window, entirely on device.  Static:
+        key capacity bound and the buffer slice bound (pow2-quantized);
+        the window's pane range rides as TRACED scalars (one compile
+        serves every window)."""
+        vals = jax.lax.slice_in_dim(vals, 0, n_rows)
+        keys = jax.lax.slice_in_dim(keys, 0, n_rows)
+        panes = jax.lax.slice_in_dim(panes, 0, n_rows)
+        ts = jax.lax.slice_in_dim(ts, 0, n_rows)
+        return self._fire_core(vals, keys, panes, ts, k_active, lo, hi)
+
+    def _fire_core(self, vals, keys, panes, ts, k_active: int, lo, hi):
+        K = k_active
+        in_win = (panes >= lo) & (panes <= hi) & (keys < K)
+        kmask = jnp.where(in_win, keys, K)
+        # group by key, arrival order preserved within groups
+        order = jnp.argsort(kmask, stable=True)
+        sk = kmask[order]
+        sv = vals[order]
+        st = ts[order]
+        idx = jnp.arange(sk.shape[0])
+        is_start = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+        group_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_start, idx, 0))
+        pos = idx - group_start
+        counts = jax.ops.segment_sum(in_win.astype(jnp.int32), kmask, K + 1)
+        gsize = counts[jnp.clip(sk, 0, K)]
+        valid = sk < K
+        if isinstance(self.evictor, CountEvictor):
+            keep = valid & ((gsize - pos) <= self.evictor.n)
+        else:  # TimeEvictor: trailing span from each key's newest element
+            tmax = jax.ops.segment_max(
+                jnp.where(in_win, ts, jnp.int32(-(1 << 31) + 1)), kmask,
+                K + 1)
+            keep = valid & (st >= tmax[jnp.clip(sk, 0, K)]
+                            - jnp.int32(self.evictor.window_ms))
+        lifted = jax.tree_util.tree_leaves(self.agg.lift(sv))
+        seg_ids = jnp.where(keep, sk, K)
+        acc = []
+        for leaf, kind in zip(lifted, self.kinds):
+            acc.append(_SEG[kind](
+                jnp.where(self._lift_mask(keep, leaf), leaf,
+                          self._identity_like(leaf, kind)),
+                seg_ids, K + 1)[:K])
+        kept_counts = jax.ops.segment_sum(keep.astype(jnp.int32), seg_ids,
+                                          K + 1)[:K]
+        result = self.agg.get_result(self.spec.unflatten(acc))
+        return kept_counts > 0, result
+
+    @staticmethod
+    def _lift_mask(keep, leaf):
+        return keep.reshape(keep.shape + (1,) * (leaf.ndim - 1))
+
+    @staticmethod
+    def _identity_like(leaf, kind):
+        if kind == "add":
+            return jnp.zeros((), leaf.dtype)
+        if jnp.issubdtype(leaf.dtype, jnp.integer):
+            info = jnp.iinfo(leaf.dtype)
+            return jnp.asarray(info.max if kind == "min" else info.min,
+                               leaf.dtype)
+        return jnp.asarray(jnp.inf if kind == "min" else -jnp.inf,
+                           leaf.dtype)
+
+    def _fire_window(self, w: int) -> List[StreamElement]:
+        if self.key_index is None or self._vals is None:
+            return []
+        first, last = self.assigner.window_panes(w)
+        if last < self.pane_base or first > self.max_pane:
+            return []
+        ka = _next_pow2(max(self.key_index.num_keys, 1), 64)
+        nrows = _next_pow2(max(self._count, 1), 64)
+        ep = self._pane_epoch or 0
+        mask, result = self._fire_step(self._vals, self._keys, self._panes,
+                                       self._ts, ka, min(nrows, self._C),
+                                       jnp.int32(first - ep),
+                                       jnp.int32(last - ep))
+        mask_np = np.asarray(mask)[: self.key_index.num_keys]
+        idx = np.flatnonzero(mask_np)
+        if idx.size == 0:
+            return []
+        res_np = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[idx], result)
+        win = self.assigner.window_bounds(w)
+        keys = np.asarray(self.key_index.reverse_keys())[idx]
+        cols: Dict[str, Any] = {self.key_column: keys}
+        if isinstance(res_np, dict):
+            cols.update(res_np)
+        else:
+            cols[self.output_column] = res_np
+        if self.emit_window_bounds:
+            cols["window_start"] = np.broadcast_to(np.int64(win.start),
+                                                   (idx.size,))
+            cols["window_end"] = np.broadcast_to(np.int64(win.end),
+                                                 (idx.size,))
+        ts = np.broadcast_to(np.int64(win.max_timestamp), (idx.size,))
+        return [RecordBatch(cols, timestamps=ts)]
+
+    # ----------------------------------------------------------- snapshots
+    def snapshot_state(self) -> Dict[str, Any]:
+        snap: Dict[str, Any] = {
+            "pane_base": self.pane_base, "max_pane": self.max_pane,
+            "last_fired_window": self.last_fired_window,
+            "watermark": self.watermark, "late_dropped": self.late_dropped,
+        }
+        if self.key_index is not None:
+            snap["key_index"] = self.key_index.snapshot()
+            snap["key_index_kind"] = type(self.key_index).__name__
+        if self._vals is not None and self._count:
+            n = self._count
+            ep = self._pane_epoch or 0
+            te = self._ts_epoch or 0
+            panes = np.asarray(self._panes[:n]).astype(np.int64) + ep
+            lo = (self.pane_base if self.pane_base is not None
+                  else self.INVALID_PANE + 1 + ep)
+            live = (np.asarray(self._panes[:n]) != self.INVALID_PANE) \
+                & (panes >= lo)
+            snap["vals"] = np.asarray(self._vals[:n])[live]
+            snap["keys"] = np.asarray(self._keys[:n])[live]
+            snap["panes"] = panes[live]
+            snap["ts"] = (np.asarray(self._ts[:n]).astype(np.int64)
+                          + te)[live]
+        return snap
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.pane_base = snap["pane_base"]
+        self.max_pane = snap["max_pane"]
+        self.last_fired_window = snap["last_fired_window"]
+        self.watermark = snap["watermark"]
+        self.late_dropped = snap.get("late_dropped", 0)
+        self._vals = None
+        self._count = 0
+        if "key_index" in snap:
+            if snap["key_index_kind"] == "ObjectKeyIndex":
+                self.key_index = ObjectKeyIndex.restore(snap["key_index"])
+            else:
+                self.key_index = KeyIndex.restore(snap["key_index"])
+            self._grow_keys()
+        self._pane_epoch = None
+        self._ts_epoch = None
+        if "vals" in snap and len(snap["vals"]):
+            n = len(snap["vals"])
+            self._pane_epoch = int(np.min(snap["panes"]))
+            self._ts_epoch = int(np.min(snap["ts"]))
+            Bp = _next_pow2(n, 64)
+            self._ensure(Bp)
+            vals = np.zeros(Bp, np.float32)
+            vals[:n] = snap["vals"]
+            kp = np.full(Bp, self._K, np.int32)
+            kp[:n] = snap["keys"]
+            pp = np.full(Bp, self.INVALID_PANE, np.int32)
+            pp[:n] = np.asarray(snap["panes"]) - self._pane_epoch
+            tp = np.zeros(Bp, np.int32)
+            tp[:n] = np.asarray(snap["ts"]) - self._ts_epoch
+            self._vals, self._keys, self._panes, self._ts = \
+                self._append_step(self._vals, self._keys, self._panes,
+                                  self._ts, jnp.asarray(vals),
+                                  jnp.asarray(kp), jnp.asarray(pp),
+                                  jnp.asarray(tp), jnp.int32(0))
+            self._count = Bp
