@@ -505,15 +505,16 @@ class KeyedStream(DataStream):
         """``a.interval_join(b).between(lo, hi).process()`` (IntervalJoin)."""
         return IntervalJoinBuilder(self.env, self, other)
 
-    def count_window(self, size: int, slide: Optional[int] = None) -> "WindowedStream":
-        """``countWindow(size)`` analog: GlobalWindows + purging
-        CountTrigger — fires every ``size`` elements per key with that
-        batch's aggregate, then clears."""
+    def count_window(self, size: int, slide: Optional[int] = None):
+        """``countWindow(size[, slide])`` analog.  Without ``slide``:
+        GlobalWindows + purging CountTrigger — fires every ``size``
+        elements per key with that batch's aggregate, then clears.  With
+        ``slide``: every ``slide`` elements per key, emit the aggregate
+        of the key's last ``size`` elements (the reference's CountTrigger
+        + CountEvictor composition, implemented as a per-key value ring —
+        ``operators/count_window.py``; mini-batch fire semantics)."""
         if slide is not None:
-            raise NotImplementedError(
-                "count_window(size, slide) (CountEvictor over GlobalWindows)"
-                " is not supported; use count_window(size) or a sliding "
-                "time window with CountTrigger(purge=False)")
+            return SlidingCountWindowedStream(self, int(size), int(slide))
         from flink_tpu.windowing.assigners import GlobalWindows
         from flink_tpu.windowing.triggers import CountTrigger
 
@@ -524,6 +525,7 @@ class KeyedStream(DataStream):
 
     def window(self, assigner: WindowAssigner) -> "WindowedStream":
         return WindowedStream(self, assigner)
+
 
     def process(self, fn, name: str = "keyed-process") -> "DataStream":
         """Run a ``KeyedProcessFunction`` (keyed state + timers) on this
@@ -588,6 +590,73 @@ class KeyedStream(DataStream):
         t = self._then(name, lambda: ExtremumByOperator(
             kc, value_column, is_min=False, name=name), chainable=False)
         return DataStream(self.env, t)
+
+
+class SlidingCountWindowedStream:
+    """``count_window(size, slide)``: terminal aggregate ops over the
+    per-key last-``size`` ring (``WindowedStream.countWindow(size, slide)``
+    analog; no time semantics, so only aggregate-family terminals)."""
+
+    def __init__(self, keyed: "KeyedStream", size: int, slide: int):
+        self.keyed = keyed
+        self.size = size
+        self.slide = slide
+
+    def aggregate(self, agg: AggregateFunction,
+                  value_column: Optional[str] = None,
+                  output_column: str = "result",
+                  name: str = "count-slide-window") -> "DataStream":
+        from flink_tpu.operators.count_window import CountSlideWindowOperator
+
+        if value_column is None:
+            raise ValueError("count_window(size, slide).aggregate needs "
+                             "value_column")
+        # validate EAGERLY (the factory is deferred to execute time):
+        # the ring combine needs the aggregate's numpy twins
+        if self.size <= 0 or self.slide <= 0:
+            raise ValueError("count_window size and slide must be positive")
+        if not agg.supports_host_emit():
+            raise ValueError(
+                "count_window(size, slide) needs an aggregate with numpy "
+                "twins and declared combine kinds (all built-ins qualify; "
+                "a bare lambda reduce does not — use sum/min/max or an "
+                "AggregateFunction with host_lift/host_get_result/"
+                "scatter_kinds)")
+        keyed, size, slide = self.keyed, self.size, self.slide
+
+        def factory():
+            return CountSlideWindowOperator(
+                agg, key_column=keyed.key_column, value_column=value_column,
+                size=size, slide=slide, output_column=output_column,
+                name=name)
+
+        return DataStream(keyed.env, keyed._then(name, factory))
+
+    def reduce(self, fn: Union[ReduceFunction, Callable],
+               identity_value=None, value_column: Optional[str] = None,
+               output_column: str = "result") -> "DataStream":
+        agg = fn if isinstance(fn, ReduceFunction) \
+            else LambdaReduce(fn, identity_value)
+        return self.aggregate(agg, value_column=value_column,
+                              output_column=output_column)
+
+    def sum(self, value_column: str,
+            output_column: Optional[str] = None) -> "DataStream":
+        return self.aggregate(SumAggregator(np.float64),
+                              value_column=value_column,
+                              output_column=output_column or value_column)
+
+    def min(self, value_column: str,
+            output_column: Optional[str] = None) -> "DataStream":
+        return self.aggregate(MinAggregator(np.float64),
+                              value_column=value_column,
+                              output_column=output_column or value_column)
+
+    def max(self, value_column: str,
+            output_column: Optional[str] = None) -> "DataStream":
+        return self.aggregate(MaxAggregator(np.float64),
+                              value_column=value_column,
+                              output_column=output_column or value_column)
 
 
 class WindowedStream:

@@ -790,6 +790,9 @@ struct KeyDict {
 API void* keydict_create(i64 initial_cap) {
   KeyDict* d = new KeyDict();
   d->init((u64)(initial_cap > 16 ? initial_cap : 16));
+  // pre-size reverse to the load-factor bound so a hinted run avoids
+  // push_back's amortized doubling copies
+  d->reverse.reserve(d->cap / 2);
   return d;
 }
 
