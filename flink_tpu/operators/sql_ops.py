@@ -1004,6 +1004,14 @@ class OverAggregateOperator(StreamOperator):
         win = _sliding_window(padded, width)[len(prev):]
         self._tails[i][key] = allv[-n:] if n > 0 else np.empty(0, np.float64)
         func = spec.func
+        if spec.distinct and func in ("SUM", "COUNT", "AVG"):
+            # per-frame dedup: sort each window row (NaN pads sort last),
+            # NaN out equal neighbours — each distinct value counts once
+            # INSIDE its frame, whatever its multiplicity
+            sw = np.sort(win, axis=1)
+            dup = np.zeros(sw.shape, bool)
+            dup[:, 1:] = sw[:, 1:] == sw[:, :-1]
+            win = np.where(dup, np.nan, sw)
         if func == "SUM":
             return np.nansum(win, axis=1)
         if func == "COUNT":
@@ -1030,6 +1038,20 @@ class OverAggregateOperator(StreamOperator):
         keep = all_ts > (all_ts[-1] - r if len(all_ts) else 0)
         self._tails[i][key] = (all_ts[keep], all_vs[keep])
         func = spec.func
+        if spec.distinct and func in ("SUM", "AVG", "COUNT"):
+            # variable-width frames: per-row distinct set (the per-frame
+            # multiset, same per-row granularity as the MIN/MAX path below)
+            s = np.empty(len(ts), np.float64)
+            c = np.empty(len(ts), np.int64)
+            for j in range(len(ts)):
+                u = np.unique(all_vs[lo[j]:hi[j]])
+                s[j] = u.sum()
+                c[j] = u.size
+            if func == "SUM":
+                return s
+            if func == "COUNT":
+                return c
+            return s / c
         if func in ("SUM", "AVG", "COUNT"):
             cum = np.concatenate([[0.0], np.cumsum(all_vs)])
             s = cum[hi] - cum[lo]

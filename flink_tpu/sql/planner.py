@@ -588,14 +588,10 @@ class Planner:
         arg_fns: List[Tuple[str, Any]] = []
         for name, oc in over_specs:
             in_col = None
-            if oc.distinct and (oc.frame_rows is not None
-                                or oc.frame_range_ms is not None):
-                # a value leaving a bounded frame may or may not still be
-                # "distinct-present" (another copy inside) — that needs
-                # per-frame multiset state; unbounded frames only need the
-                # first-occurrence contribution
-                raise PlanError(f"{oc.func}(DISTINCT ...) OVER supports only "
-                                f"unbounded frames (no ROWS/RANGE bound)")
+            # DISTINCT over BOUNDED frames dedupes inside each frame at
+            # aggregate time (the kept tail holds raw rows, so a value
+            # leaving the frame re-counts correctly when another copy
+            # remains); unbounded frames use first-occurrence contribution
             if oc.distinct and oc.func == "ROW_NUMBER":
                 raise PlanError("ROW_NUMBER has no DISTINCT form")
             if oc.func == "ROW_NUMBER":

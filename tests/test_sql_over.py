@@ -204,12 +204,39 @@ def test_over_distinct_unbounded():
         [10., 20., 40., 50.]
 
 
-def test_over_distinct_bounded_frame_rejected():
-    te = make_env()
-    with pytest.raises(PlanError, match="unbounded"):
-        te.execute_sql(
-            "SELECT SUM(DISTINCT v) OVER (PARTITION BY k ORDER BY ts "
-            "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM t").collect()
+def test_over_distinct_bounded_rows_frame():
+    """SUM/COUNT(DISTINCT) OVER ROWS n PRECEDING (r3 rejection, now
+    implemented): each frame dedupes ITS OWN rows — a value leaving the
+    frame re-counts while another copy remains inside."""
+    te = TableEnvironment()
+    te.register_collection("dbr", columns={
+        "k": np.zeros(6, np.int64),
+        "ts": np.array([1, 2, 3, 4, 5, 6], np.int64) * 1000,
+        "v": np.array([5.0, 5.0, 3.0, 5.0, 3.0, 7.0])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT ts, SUM(DISTINCT v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS s, "
+        "COUNT(DISTINCT v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS c FROM dbr").collect()
+    rows.sort(key=lambda r: r["ts"])
+    # frames: [5],[5,5],[5,5,3],[5,3,5],[3,5,3],[5,3,7]
+    assert [r["s"] for r in rows] == [5.0, 5.0, 8.0, 8.0, 8.0, 15.0]
+    assert [r["c"] for r in rows] == [1, 1, 2, 2, 2, 3]
+
+
+def test_over_distinct_bounded_range_frame():
+    te = TableEnvironment()
+    te.register_collection("dgr", columns={
+        "k": np.zeros(5, np.int64),
+        "ts": np.array([0, 1000, 2000, 3000, 10_000], np.int64),
+        "v": np.array([2.0, 2.0, 4.0, 2.0, 6.0])}, rowtime="ts")
+    rows = te.execute_sql(
+        "SELECT ts, SUM(DISTINCT v) OVER (PARTITION BY k ORDER BY ts "
+        "RANGE BETWEEN INTERVAL '2' SECOND PRECEDING AND CURRENT ROW) AS s "
+        "FROM dgr").collect()
+    rows.sort(key=lambda r: r["ts"])
+    # frames by ts-2000: [2],[2,2],[2,2,4],[2,4,2],[6]
+    assert [r["s"] for r in rows] == [2.0, 2.0, 6.0, 6.0, 6.0]
 
 
 def test_frame_words_stay_usable_as_columns():
