@@ -49,6 +49,7 @@ out-of-range slot ids, dropped by XLA scatter), state grows by doubling
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -165,6 +166,7 @@ class WindowAggOperator(StreamOperator):
         emit_tier: str = "auto",
         snapshot_source: str = "auto",
         native_emit: bool = True,
+        device_sync: str = "auto",
     ):
         #: host tier: use the C++ WinMirror kernels (fused probe+mirror,
         #: compacting fire) when eligible; False pins the numpy mirror —
@@ -257,6 +259,44 @@ class WindowAggOperator(StreamOperator):
             raise ValueError("snapshot_source='mirror' requires the host "
                              "emit tier")
         self.snapshot_source = snapshot_source
+        # ---- device sync cadence (host tier only): how the device replica
+        # tracks the authoritative host mirror.  "scatter": every micro-batch
+        # dispatches the jitted scatter-combine — the device is continuously
+        # current (right on direct PCIe/ICI links, where dispatch is ~free,
+        # and on the CPU backend, where there is no transport at all).
+        # "deferred": per-record dispatch is skipped and the replica
+        # refreshes from the mirror at sync points (``device_refresh``:
+        # restore, verification, idle) — right on TAXED transports (tunnel/
+        # proxy links) where executing a dispatched step costs the host tens
+        # of CPU-ms per uploaded MB and that CPU is stolen from the native
+        # hot path (utils/transport.py; the ingress twin of the emit-tier
+        # download finding).  "auto" self-calibrates: the first operator on
+        # an accelerator backend measures its own first few update steps and
+        # the verdict is shared process-wide; the CPU backend always
+        # scatters.  Outside the host tier (device fires, sharded/mesh
+        # state) the device IS the authority and always scatters.
+        if device_sync not in ("auto", "scatter", "deferred"):
+            raise ValueError(f"device_sync must be auto|scatter|deferred, "
+                             f"got {device_sync!r}")
+        if device_sync == "deferred":
+            if emit_tier != "host" or sharding is not None:
+                raise ValueError(
+                    "device_sync='deferred' requires the unsharded host emit "
+                    "tier (the host mirror must be the authoritative copy)")
+            if snapshot_source != "mirror":
+                raise ValueError(
+                    "device_sync='deferred' requires snapshot_source="
+                    "'mirror' (device-sourced snapshots would read a stale "
+                    "replica)")
+        self.device_sync = device_sync
+        #: resolved cadence ("scatter"/"deferred"); None until first batch
+        self.device_sync_mode: Optional[str] = None
+        #: deferred mode: device replica lags the mirror until device_refresh
+        self._device_stale = False
+        #: auto-calibration attempts so far; bounded so workloads whose
+        #: batches are too small to yield a calibration sample settle on
+        #: scatter instead of measuring (and blocking) forever
+        self._calib_batches = 0
         #: mirror leaf dtypes: integer leaves widen to int64, floats to
         #: float64 — the host tier is the HIGHER-precision replica
         self._mirror_dtypes = tuple(
@@ -401,6 +441,7 @@ class WindowAggOperator(StreamOperator):
         self._proc_time = LONG_MIN
         self.phase_ns = {}
         self.phase_bytes = {}
+        self._device_stale = False  # resolved sync mode survives the reset
 
     # ------------------------------------------------------------------ state
     def _alloc(self, K: int, P: int):
@@ -457,6 +498,122 @@ class WindowAggOperator(StreamOperator):
         from flink_tpu.state.native_mirror import NativeWindowMirror
         self._nm = NativeWindowMirror.try_create(
             self.key_index, self.spec, self.kinds, self._mirror_dtypes)
+
+    def _resolve_device_sync(self) -> str:
+        """Resolved sync cadence for this batch: "scatter", "deferred", or
+        "calibrating" (= scatter + measure this batch's dispatch cost)."""
+        if self.device_sync_mode is not None:
+            return self.device_sync_mode
+        if (self.device_sync == "scatter" or self.emit_tier != "host"
+                or self.sharding is not None
+                or self.snapshot_source != "mirror"):
+            self.device_sync_mode = "scatter"
+        elif self.device_sync == "deferred":
+            self.device_sync_mode = "deferred"
+        else:  # auto
+            if jax.default_backend() == "cpu":
+                # the "device" is this host: nothing to tax, and staying
+                # scatter keeps CPU-backend behavior deterministic
+                self.device_sync_mode = "scatter"
+            else:
+                from flink_tpu.utils import transport
+                taxed = transport.dispatch_taxed()
+                if taxed is None:
+                    if self._calib_batches < 8:
+                        self._calib_batches += 1
+                        return "calibrating"
+                    # batches too small to ever yield a calibration sample
+                    # (transport.MIN_SAMPLE_MB): stop probing — scatter,
+                    # without the per-batch measurement block
+                    self.device_sync_mode = "scatter"
+                else:
+                    self.device_sync_mode = ("deferred" if taxed
+                                             else "scatter")
+        return self.device_sync_mode
+
+    def _mirror_columns(self, panes, rows: int,
+                        ncols: Optional[int] = None):
+        """Dense device-dtype columns of the host mirror: counts int32
+        [rows, ncols] plus one [rows, ncols, *shape] array per leaf, column
+        j holding pane ``panes[j]`` (missing panes and pad columns =
+        identity).  The single source of the mirror export semantics —
+        identity fill, int64->int32 counts, mirror->device dtype casts —
+        shared by mirror-sourced snapshots and the deferred-sync refresh."""
+        ncols = len(panes) if ncols is None else ncols
+        counts = np.zeros((rows, ncols), np.int32)
+        leaves = []
+        for init, shape, d in zip(self.spec.leaf_inits,
+                                  self.spec.leaf_shapes,
+                                  self.spec.leaf_dtypes):
+            arr = np.empty((rows, ncols) + tuple(shape), d)
+            arr[...] = np.asarray(init).astype(d)
+            leaves.append(arr)
+        for j, p in enumerate(panes):
+            if self._nm is not None:
+                ex, cnts, lvs = self._nm.export_pane(int(p), rows)
+                if not ex:
+                    continue
+                counts[:, j] = cnts  # int64 -> int32 cast
+                for dst, src in zip(leaves, lvs):
+                    dst[:, j] = src  # mirror -> device dtype cast
+            else:
+                e = self._vmirror.get(int(p))
+                if e is None:
+                    continue
+                counts[:, j] = e[0][:rows]
+                for k, dst in enumerate(leaves):
+                    dst[:, j] = e[k + 1][:rows].astype(
+                        self.spec.leaf_dtypes[k], copy=False)
+        return counts, leaves
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
+    def _refresh_step(self, leaves, counts, slots, counts_cols, leaf_cols):
+        """Replace the whole ring from live-pane COLUMNS: slots i32[m] are
+        the live ring slots (pads = P, dropped), counts_cols [rows, m] with
+        rows <= K covering the live keys, each leaf col [rows, m, *shape].
+        Every other cell resets to identity — the upload scales with live
+        panes x live keys, not ring/key capacity."""
+        rows = counts_cols.shape[0]
+        new_counts = jnp.zeros_like(counts).at[:rows, slots].set(
+            counts_cols, mode="drop")
+        new_leaves = tuple(
+            jnp.broadcast_to(jnp.asarray(init, l.dtype), l.shape)
+            .at[:rows, slots].set(col, mode="drop")
+            for l, init, col in zip(leaves, self.spec.leaf_inits, leaf_cols))
+        return new_leaves, new_counts
+
+    def device_refresh(self) -> None:
+        """Rebuild the device replica from the authoritative host mirror
+        (deferred sync's sync point — restore, verification, idle, or an
+        explicit pre-mesh handoff).  Set semantics over the whole ring:
+        slots without a live pane reset to identity, which also folds in
+        any expirations skipped while deferred; uploaded bytes scale with
+        live panes.  No-op when the replica is already current."""
+        if not self._device_stale:
+            return
+        self._device_stale = False
+        if self.key_index is None or self.pane_base is None:
+            return
+        self._ensure_alloc()
+        n = self.key_index.num_keys
+        present = (set(self._nm.live_panes().tolist()) if self._nm is not None
+                   else set(self._vmirror))
+        hi = self.pane_base if self.max_pane is None else self.max_pane
+        live = [int(p) for p in range(self.pane_base, hi + 1)
+                if int(p) in present]
+        m = _next_pow2(max(len(live), 1), 1)  # pad: bounded compile count
+        # rows cover live keys only (pow2-quantized for a bounded compile
+        # count), not key capacity: a 1M-capacity operator holding 10k keys
+        # refreshes ~80KB columns, not ~8MB
+        rows = min(_next_pow2(max(n, 1), 1024), self._K)
+        slots = np.full(m, self._P, np.int32)  # P = out of range, dropped
+        slots[:len(live)] = [p % self._P for p in live]
+        counts_cols, leaf_cols = self._mirror_columns(live, rows, ncols=m)
+        self._leaves, self._counts = self._refresh_step(
+            self._leaves, self._counts, slots, counts_cols, tuple(leaf_cols))
+        self.phase_bytes["h2d_refresh"] = (
+            self.phase_bytes.get("h2d_refresh", 0) + counts_cols.nbytes
+            + sum(l.nbytes for l in leaf_cols))
 
     def _vmirror_pane(self, pane: int) -> list:
         """[counts, *leaves] arrays for a pane, allocated/grown to >= _K."""
@@ -551,7 +708,15 @@ class WindowAggOperator(StreamOperator):
         """Consistency check: download the device state for live panes and
         compare against the host mirror (the device is the authoritative
         replica; the mirror must be its higher-precision twin).  Costly on
-        slow links — meant for tests and sampled bench validation."""
+        slow links — meant for tests and sampled bench validation.
+
+        Under deferred sync the replica is refreshed first, so the check
+        validates the refresh round trip (mirror -> upload -> download ->
+        compare: ring mapping, dtype casts, expiry folds) rather than
+        continuous per-batch equality — which deferred mode by design does
+        not maintain between sync points."""
+        if self.device_sync_mode == "deferred":
+            self.device_refresh()
         if self.emit_tier != "host" or self._leaves is None \
                 or self.pane_base is None:
             return True
@@ -877,48 +1042,70 @@ class WindowAggOperator(StreamOperator):
             self._grow_panes(span)
 
         self._try_native_mirror()
+        sync = self._resolve_device_sync()
         values = self._select(cols)
         flat_b = None
         if self._nm is not None:
             # fused C pass: key probe + mirror write-through + device scatter
             # ids (the triples are computed once and consumed twice —
-            # VERDICT r3 next #1b)
+            # VERDICT r3 next #1b).  Deferred sync needs no scatter ids.
             with self._phase("probe_mirror"):
                 lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
                     self.agg.host_lift(values))]
-                flat_b = np.empty(len(batch), np.int32)
-                slots = self._nm.probe_update(keys, panes, lifted,
-                                              pane_mod=self._P,
-                                              flat_out=flat_b)
+                if sync == "deferred":
+                    slots = self._nm.probe_update(keys, panes, lifted)
+                else:
+                    flat_b = np.empty(len(batch), np.int32)
+                    slots = self._nm.probe_update(keys, panes, lifted,
+                                                  pane_mod=self._P,
+                                                  flat_out=flat_b)
         else:
             with self._phase("probe"):
                 slots = self.key_index.lookup_or_insert(keys)
         if self.key_index.num_keys > self._K:
             self._ensure_alloc()
             self._grow_keys(self.key_index.num_keys)
+
         self._ensure_alloc()
-
-        # ---- pad to pow2 batch size (static shapes; pads dropped via slot id K*P)
-        B = len(batch)
-        Bp = _next_pow2(B, 64)
-        if flat_b is not None:
-            flat_p = np.full(Bp, self._K * self._P, np.int32)
-            flat_p[:B] = flat_b
+        if sync == "deferred":
+            # taxed transport: skip the per-batch dispatch; the mirror (the
+            # authoritative copy in this mode) absorbs the batch above and
+            # the device replica catches up at the next device_refresh()
+            self._device_stale = True
         else:
-            flat = slots.astype(np.int64) * self._P + (panes % self._P)
-            flat_p64 = np.full(Bp, self._K * self._P, np.int64)
-            flat_p64[:B] = flat
-            flat_p = flat_p64.astype(np.int32)
-        values_p = jax.tree_util.tree_map(lambda a: _pad_rows(np.asarray(a), Bp), values)
+            # ---- pad to pow2 batch size (static shapes; pads dropped via
+            # slot id K*P)
+            B = len(batch)
+            Bp = _next_pow2(B, 64)
+            if flat_b is not None:
+                flat_p = np.full(Bp, self._K * self._P, np.int32)
+                flat_p[:B] = flat_b
+            else:
+                flat = slots.astype(np.int64) * self._P + (panes % self._P)
+                flat_p64 = np.full(Bp, self._K * self._P, np.int64)
+                flat_p64[:B] = flat
+                flat_p = flat_p64.astype(np.int32)
+            values_p = jax.tree_util.tree_map(
+                lambda a: _pad_rows(np.asarray(a), Bp), values)
 
-        # np (not device) ids: the jit converts at dispatch, and the mesh
-        # subclass re-routes them through the all_to_all exchange host-side
-        with self._phase("device_dispatch"):
-            self._leaves, self._counts = self._update_step(
-                self._leaves, self._counts, flat_p, values_p)
-        self.phase_bytes["h2d"] = self.phase_bytes.get("h2d", 0) + \
-            flat_p.nbytes + sum(a.nbytes for a in
-                                jax.tree_util.tree_leaves(values_p))
+            # np (not device) ids: the jit converts at dispatch, and the mesh
+            # subclass re-routes them through the all_to_all exchange
+            # host-side
+            with self._phase("device_dispatch"):
+                self._leaves, self._counts = self._update_step(
+                    self._leaves, self._counts, flat_p, values_p)
+            mb = (flat_p.nbytes + sum(a.nbytes for a in
+                                      jax.tree_util.tree_leaves(values_p)))
+            self.phase_bytes["h2d"] = self.phase_bytes.get("h2d", 0) + mb
+            if sync == "calibrating":
+                # self-calibration: until-ready wall of this REAL step is
+                # the honest dispatch cost (compile/queue noise is filtered
+                # by transport.py taking the min across samples)
+                from flink_tpu.utils import transport
+                t0 = time.perf_counter()
+                jax.block_until_ready(self._counts)
+                transport.record_dispatch_cost(mb / 1e6,
+                                               time.perf_counter() - t0)
 
         # host emit mirror: record which (key, pane) cells this batch filled
         # (unsharded device tier; the host tier's value mirror carries exact
@@ -1063,8 +1250,16 @@ class WindowAggOperator(StreamOperator):
         if not expired:
             return
         self.pane_base = p
-        slots = jnp.asarray(np.asarray(expired, np.int64) % self._P, jnp.int32)
-        self._leaves, self._counts = self._clear_panes_step(self._leaves, self._counts, slots)
+        if self.device_sync_mode == "deferred":
+            # no in-line device writes while deferred: the next
+            # device_refresh rebuilds the whole ring (identity for slots
+            # without a live pane), which subsumes this clear
+            self._device_stale = True
+        else:
+            slots = jnp.asarray(np.asarray(expired, np.int64) % self._P,
+                                jnp.int32)
+            self._leaves, self._counts = self._clear_panes_step(
+                self._leaves, self._counts, slots)
         for ep in expired:
             self._mirror.pop(ep, None)
             self._vmirror.pop(ep, None)
@@ -1285,28 +1480,7 @@ class WindowAggOperator(StreamOperator):
                 # cast down to the device leaf dtypes so the snapshot format
                 # is identical either way
                 with self._phase("snapshot"):
-                    counts = np.zeros((n, panes.size), np.int32)
-                    leaves = [np.empty((n, panes.size) + tuple(s), d)
-                              for s, d in zip(self.spec.leaf_shapes,
-                                              self.spec.leaf_dtypes)]
-                    for j, p in enumerate(panes.tolist()):
-                        if self._nm is not None:
-                            _ex, cnts, lvs = self._nm.export_pane(int(p), n)
-                            counts[:, j] = cnts  # int64 -> int32 cast
-                            for l, src in zip(leaves, lvs):
-                                l[:, j] = src  # mirror -> device dtype cast
-                            continue
-                        e = self._vmirror.get(int(p))
-                        if e is None:
-                            for l, init, d in zip(leaves,
-                                                  self.spec.leaf_inits,
-                                                  self.spec.leaf_dtypes):
-                                l[:, j] = np.asarray(init).astype(d)
-                            continue
-                        counts[:, j] = e[0][:n]
-                        for l, src, d in zip(leaves, e[1:],
-                                             self.spec.leaf_dtypes):
-                            l[:, j] = src[:n].astype(d)
+                    counts, leaves = self._mirror_columns(panes.tolist(), n)
                     snap["leaves"] = leaves
                     snap["counts"] = counts
             else:
@@ -1354,10 +1528,8 @@ class WindowAggOperator(StreamOperator):
         self._mirror = {}
         if "leaves" in snap:
             from flink_tpu.state.evolution import migrate_acc_leaves
-            self._ensure_alloc()
             n = snap["counts"].shape[0]
             panes = np.asarray(snap["panes"], np.int64)
-            slots = jnp.asarray(panes % self._P, jnp.int32)
 
             def fill(j, _n=n, _np=len(panes)):
                 # ADDED accumulator field: identity rows in [n, panes] shape
@@ -1369,10 +1541,24 @@ class WindowAggOperator(StreamOperator):
             leaves = migrate_acc_leaves(snap["leaves"],
                                         snap.get("leaf_schema"),
                                         self.spec, fill)
-            self._leaves = tuple(
-                l.at[:n, slots].set(jnp.asarray(s))
-                for l, s in zip(self._leaves, leaves))
-            self._counts = self._counts.at[:n, slots].set(jnp.asarray(snap["counts"]))
+            # resolve the cadence NOW (a process-wide calibration verdict may
+            # already exist): a deferred restore skips the dispatched device
+            # import — the costliest possible upload on exactly the links
+            # deferred mode exists for ("calibrating" restores like scatter)
+            if self._resolve_device_sync() == "deferred":
+                # the mirror (rebuilt below) is the authority; the device
+                # replica catches up at the next device_refresh.  Alloc so
+                # time/fire guards see live state (content = identity).
+                self._ensure_alloc()
+                self._device_stale = True
+            else:
+                self._ensure_alloc()
+                slots = jnp.asarray(panes % self._P, jnp.int32)
+                self._leaves = tuple(
+                    l.at[:n, slots].set(jnp.asarray(s))
+                    for l, s in zip(self._leaves, leaves))
+                self._counts = self._counts.at[:n, slots].set(
+                    jnp.asarray(snap["counts"]))
             # rebuild the host emit mirror from the snapshot's counts
             self._mirror = {}
             counts_np = np.asarray(snap["counts"])
