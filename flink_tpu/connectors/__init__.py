@@ -7,9 +7,17 @@ from flink_tpu.connectors.sources import (
     Source,
     SourceSplit,
 )
+from flink_tpu.connectors.postgres import (
+    PostgresSink,
+    PostgresSource,
+    PostgresWireClient,
+    PostgresWireServer,
+)
 
 __all__ = [
     "CollectSink", "FunctionSink", "PrintSink", "Sink",
     "CollectionSource", "GeneratorSource", "IteratorSource",
     "SocketTextSource", "Source", "SourceSplit",
+    "PostgresSink", "PostgresSource", "PostgresWireClient",
+    "PostgresWireServer",
 ]
