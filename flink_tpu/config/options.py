@@ -84,6 +84,22 @@ class NetworkOptions:
         False, "zstd-compress exchange buffers between hosts.")
 
 
+class ShuffleOptions:
+    """Analog of the shuffle SPI knobs (ShuffleServiceOptions +
+    NettyShuffleEnvironmentOptions' sort-shuffle settings)."""
+    SERVICE = key("shuffle.service").string_type().default_value(
+        "sort-merge", "Result-partition service for batch exchanges: "
+        "'sort-merge' (spilled blocking partitions) | 'pipelined' "
+        "(in-memory concurrent) | any name registered via "
+        "register_shuffle_service.")
+    DIRECTORY = key("shuffle.directory").string_type().default_value(
+        None, "Directory for spilled sort-merge partitions (default: a "
+        "per-process tmp dir).")
+    MEMORY_BUDGET_BYTES = key("shuffle.sort-merge.memory").memory_type().default_value(
+        32 << 20, "Clustering buffer bytes before a sort-merge writer "
+        "spills one region.")
+
+
 class RestOptions:
     PORT = key("rest.port").int_type().default_value(8081, "REST/web endpoint port.")
     ADDRESS = key("rest.address").string_type().default_value("127.0.0.1", "REST bind address.")

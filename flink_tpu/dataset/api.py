@@ -39,9 +39,15 @@ class BatchOp:
 class ExecutionEnvironment:
     """``ExecutionEnvironment.getExecutionEnvironment`` analog."""
 
+    def __init__(self, config=None):
+        from flink_tpu.config.config_option import Configuration
+
+        #: governs batch exchanges (ShuffleOptions) and future knobs
+        self.config = config if config is not None else Configuration()
+
     @staticmethod
-    def get_execution_environment() -> "ExecutionEnvironment":
-        return ExecutionEnvironment()
+    def get_execution_environment(config=None) -> "ExecutionEnvironment":
+        return ExecutionEnvironment(config)
 
     def from_columns(self, columns: Dict[str, Any]) -> "DataSet":
         cols = {k: np.asarray(v) for k, v in columns.items()}
@@ -128,6 +134,28 @@ class DataSet:
 
     def union(self, other: "DataSet") -> "DataSet":
         return DataSet(self.env, BatchOp("union", {}, [self.op, other.op]))
+
+    # -- physical partitioning ----------------------------------------------
+    def partition_by_hash(self, *columns: str, num_partitions: int = 0,
+                          service: Optional[str] = None) -> "DataSet":
+        """``DataSet.partitionByHash`` analog: route rows into hash
+        partitions through the configured shuffle service
+        (``shuffle.service`` — sort-merge spilled blocking partitions by
+        default; ``service=`` overrides per-exchange).  ``num_partitions``
+        0 derives the count from the size estimate and the row budget.
+        Downstream :meth:`map_partition` sees one partition at a time."""
+        return self._then("partition_hash", columns=list(columns),
+                          n=int(num_partitions), service=service,
+                          config=self.env.config)
+
+    def map_partition(self, fn: Callable[[RecordBatch], RecordBatch]
+                      ) -> "DataSet":
+        """``DataSet.mapPartition`` analog, vectorized: ``fn`` receives one
+        whole partition as a RecordBatch and returns a RecordBatch.  Over
+        a :meth:`partition_by_hash` input each hash partition is one call
+        (peak memory = one partition); otherwise the full dataset is a
+        single partition."""
+        return self._then("map_partition", fn=fn)
 
     # -- ordering -----------------------------------------------------------
     def sort_partition(self, column: str, ascending: bool = True) -> "DataSet":

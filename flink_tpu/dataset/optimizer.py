@@ -390,6 +390,39 @@ def _cogroup(op, l, r, lk, rk):
     return RecordBatch.from_rows(out_rows)
 
 
+def _drv_partition_hash(op, ins):
+    # physical routing only — row content is unchanged; the partitioning
+    # takes effect in map_partition (streamed via the shuffle service)
+    return ins[0]
+
+
+def _partition_count(child: BatchOp) -> int:
+    """ONE derivation for both executors (a plan must partition the same
+    way whether an op runs streamed or materialized — a diamond reference
+    flips the mode mid-plan)."""
+    if child.args["n"]:
+        return int(child.args["n"])
+    from flink_tpu.dataset.external import memory_budget_rows
+    budget = memory_budget_rows()
+    return max(2, min(64, (child.est_rows or budget) // max(budget, 1) + 1))
+
+
+def _drv_map_partition(op, ins):
+    child = op.inputs[0]
+    batch = ins[0]
+    fn = op.args["fn"]
+    if child.kind != "partition_hash" or len(batch) == 0:
+        return fn(batch)
+    from flink_tpu.runtime.shuffle import hash_subpartition
+    n = _partition_count(child)
+    sub = hash_subpartition(
+        _composite_key(batch, child.args["columns"]), n)
+    # batch is non-empty here, so at least one subpartition matches
+    return RecordBatch.concat([fn(batch.select(sub == s))
+                               for s in range(n)
+                               if bool((sub == s).any())])
+
+
 def _drv_cross(op, ins):
     l, r = ins
     nl, nr = len(l), len(r)
@@ -464,6 +497,8 @@ _DRIVERS = {
     "group_first_n": _drv_group_first_n,
     "join": _drv_join,
     "cross": _drv_cross,
+    "partition_hash": _drv_partition_hash,
+    "map_partition": _drv_map_partition,
     "bulk_iterate": _drv_bulk_iterate,
     "delta_iterate": _drv_delta_iterate,
 }
@@ -647,10 +682,71 @@ def _exec_stream_raw(op: BatchOp, memo: Dict[int, RecordBatch],
         # group spans in merge order — one GROUP resident at a time
         # (GroupReduceCombineDriver over UnilateralSortMerger analog)
         yield from _stream_group_reduce(op, memo, refs, budget)
+    elif kind == "partition_hash":
+        # standalone (no map_partition consumer): physical no-op
+        yield from _exec_stream(op.inputs[0], memo, refs, budget)
+    elif kind == "map_partition":
+        yield from _stream_map_partition(op, memo, refs, budget)
     else:
         # genuine dam without a streaming kernel (outer joins, iterations):
         # materialize the inputs, run the vectorized driver
         yield from _chunks(_materialize(op, memo, refs, budget), budget)
+
+
+def _stream_map_partition(op: BatchOp, memo, refs, budget: int):
+    """``mapPartition`` over a hash exchange THROUGH the shuffle SPI
+    (``runtime/shuffle.py``): input chunks route to subpartitions via the
+    writer (the sort-merge service spills clustered regions under its own
+    byte budget — the all-to-all never materializes in memory), the
+    partition seals, and each subpartition streams back as ONE
+    RecordBatch through the user function.  Peak memory = one partition
+    + the service's clustering buffer, matching the reference's
+    sort-merge blocking shuffle role (SortMergeResultPartition.java:65).
+    Without a partition_hash input the whole stream is a single
+    partition."""
+    import os
+
+    child = op.inputs[0]
+    fn = op.args["fn"]
+    if child.kind != "partition_hash":
+        chunks = list(_exec_stream(child, memo, refs, budget))
+        yield from _chunks(fn(RecordBatch.concat(chunks) if len(chunks) > 1
+                              else chunks[0]), budget)
+        return
+    from flink_tpu.runtime.shuffle import (hash_subpartition,
+                                           shuffle_service_for)
+    n = _partition_count(child)
+    svc = shuffle_service_for(child.args.get("config"),
+                              name=child.args.get("service"))
+    pid = f"map-partition-{id(op)}-{os.getpid()}-{os.urandom(4).hex()}"
+    writer = svc.create_partition(pid, n)
+    empty = None
+    try:
+        for chunk in _exec_stream(child.inputs[0], memo, refs, budget):
+            if len(chunk) == 0:
+                empty = chunk
+                continue
+            sub = hash_subpartition(
+                _composite_key(chunk, child.args["columns"]), n)
+            for s in np.unique(sub).tolist():
+                writer.emit(int(s), chunk.select(sub == s))
+        writer.finish()
+        produced = False
+        for s in range(n):
+            parts = list(svc.open_reader(pid, s))
+            if not parts:
+                continue
+            produced = True
+            part = (RecordBatch.concat(parts) if len(parts) > 1
+                    else parts[0])
+            yield from _chunks(fn(part), budget)
+        if not produced and empty is not None:
+            yield fn(empty)        # schema contract: fn sees one empty
+    except BaseException:
+        writer.abort()
+        raise
+    finally:
+        svc.release_partition(pid)
 
 
 def _with_join_key(batch: RecordBatch, keys: List[str]) -> RecordBatch:
