@@ -97,10 +97,12 @@ class MiniCluster(TaskListener):
     def __init__(self, checkpoint_storage=None, checkpoint_interval_ms: int = 0,
                  unaligned: bool = False, checkpoint_timeout_s: float = 60.0,
                  restart_attempts: int = 0, restart_delay_ms: int = 50,
-                 channel_capacity: int = 32, restart_strategy=None):
+                 channel_capacity: int = 32, restart_strategy=None,
+                 config=None):
         from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
                                                 NoRestartStrategy)
 
+        self.config = config
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.unaligned = unaligned
@@ -115,6 +117,7 @@ class MiniCluster(TaskListener):
             if restart_attempts > 0 else NoRestartStrategy())
         self._lock = threading.Lock()
         self._tasks: List[SubtaskBase] = []
+        self._slot_memory_pool = None  # lazy: SlotMemoryPool
         self._pending: Optional[_PendingCheckpoint] = None
         self._completed_ids: List[int] = []
         self._next_checkpoint_id = 1
@@ -132,6 +135,16 @@ class MiniCluster(TaskListener):
         self._exception_history: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------ listener
+    def _slot_memory(self):
+        """The next slot's managed-memory accountant (round-robin over the
+        executor's fixed slot pool — TaskManagerOptions sizing; restarts
+        REUSE slots, so aggregate managed memory stays bounded)."""
+        from flink_tpu.runtime.memory import SlotMemoryPool
+
+        if self._slot_memory_pool is None:
+            self._slot_memory_pool = SlotMemoryPool(self.config)
+        return self._slot_memory_pool.assign()
+
     def task_state_changed(self, vertex_uid: str, subtask_index: int,
                            state: str, error: Optional[str]) -> None:
         if state == TaskStates.FAILED:
@@ -318,7 +331,8 @@ class MiniCluster(TaskListener):
                         ctx = RuntimeContext(
                             task_name=v.name, subtask_index=i,
                             parallelism=n_subs(v),
-                            max_parallelism=v.max_parallelism)
+                            max_parallelism=v.max_parallelism,
+                            memory_manager=self._slot_memory())
                         requester = (lambda u=uid, ri=i:
                                      coord.request_split(u, ri))
                         t = SourceSubtask(uid, i, v.build_operator(),
@@ -332,7 +346,8 @@ class MiniCluster(TaskListener):
                 for i, split in enumerate(splits):
                     ctx = RuntimeContext(task_name=v.name, subtask_index=i,
                                          parallelism=len(splits),
-                                         max_parallelism=v.max_parallelism)
+                                         max_parallelism=v.max_parallelism,
+                                         memory_manager=self._slot_memory())
                     t = SourceSubtask(uid, i, v.build_operator(),
                                       outputs[v.id][i], ctx, self, split)
                     t.start(sub_snaps[i] if i < len(sub_snaps) else None)
@@ -342,7 +357,8 @@ class MiniCluster(TaskListener):
                 for i in range(n_subs(v)):
                     ctx = RuntimeContext(task_name=v.name, subtask_index=i,
                                          parallelism=n_subs(v),
-                                         max_parallelism=v.max_parallelism)
+                                         max_parallelism=v.max_parallelism,
+                                         memory_manager=self._slot_memory())
                     t = Subtask(uid, i, v.build_operator(), outputs[v.id][i],
                                 ctx, self, inputs[v.id][i],
                                 unaligned=self.unaligned,

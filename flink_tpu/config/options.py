@@ -84,6 +84,16 @@ class NetworkOptions:
         False, "zstd-compress exchange buffers between hosts.")
 
 
+class TaskManagerOptions:
+    """Analog of TaskManagerOptions' managed-memory knobs (FLIP-49)."""
+    MANAGED_MEMORY_SIZE = key("taskmanager.memory.managed.size").memory_type().default_value(
+        256 << 20, "Managed memory per task executor, split evenly over "
+        "its slots; budgeted operators (spill tier, sort/hash buffers) "
+        "reserve from the slot's share and fail fast when over-committed.")
+    NUM_TASK_SLOTS = key("taskmanager.numberOfTaskSlots").int_type().default_value(
+        1, "Task slots offered by one task executor.")
+
+
 class ShuffleOptions:
     """Analog of the shuffle SPI knobs (ShuffleServiceOptions +
     NettyShuffleEnvironmentOptions' sort-shuffle settings)."""

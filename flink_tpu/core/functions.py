@@ -51,13 +51,17 @@ class RuntimeContext:
 
     def __init__(self, task_name: str = "task", subtask_index: int = 0,
                  parallelism: int = 1, max_parallelism: int = 128,
-                 metrics=None, external_resources: Optional[Dict[str, Any]] = None):
+                 metrics=None, external_resources: Optional[Dict[str, Any]] = None,
+                 memory_manager=None):
         self.task_name = task_name
         self.subtask_index = subtask_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
         self.metrics = metrics
         self._external_resources = external_resources or {}
+        #: this slot's managed-memory accountant (runtime/memory.py), or
+        #: None outside a managed slot — budgeted operators reserve here
+        self.memory_manager = memory_manager
 
     def get_external_resource_infos(self, name: str):
         """``RuntimeContext.getExternalResourceInfos`` analog (TPU driver plugs in here)."""

@@ -133,7 +133,8 @@ class StreamExecutionEnvironment:
         executor = LocalExecutor(
             checkpoint_interval_ms=self.checkpoint_interval_ms,
             checkpoint_storage=self.checkpoint_storage,
-            max_records=max_records, max_wall_ms=max_wall_ms)
+            max_records=max_records, max_wall_ms=max_wall_ms,
+            config=self.config)
         # publish BEFORE the blocking run so another thread can cancel()
         self._last_executor = executor
         return executor.execute(plan, restore=restore, drain=drain)

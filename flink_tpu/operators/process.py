@@ -111,6 +111,11 @@ class KeyedProcessOperator(StreamOperator):
     def open(self, ctx: RuntimeContext) -> None:
         super().open(ctx)
         self.backend.max_parallelism = ctx.max_parallelism
+        # budgeted backends claim their share of the slot's managed memory
+        mm = getattr(ctx, "memory_manager", None)
+        if mm is not None and hasattr(self.backend, "reserve_managed"):
+            self.backend.reserve_managed(
+                mm, owner=f"{ctx.task_name}[{ctx.subtask_index}]")
         self.fn.open(ctx)
 
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
@@ -180,6 +185,9 @@ class KeyedProcessOperator(StreamOperator):
 
     def close(self) -> None:
         self.fn.close()
+        # releases the backend's managed-memory claim + spill resources
+        if hasattr(self.backend, "close"):
+            self.backend.close()
 
     # -- rescale hooks (StateAssignmentOperation analog) ---------------------
     @staticmethod

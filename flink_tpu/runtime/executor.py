@@ -151,13 +151,14 @@ class LocalExecutor:
                  listeners: Optional[List[Callable[[str, Any], None]]] = None,
                  max_records: Optional[int] = None,
                  max_wall_ms: Optional[int] = None,
-                 metric_registry=None):
+                 metric_registry=None, config=None):
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.checkpoint_storage = checkpoint_storage
         self.listeners = listeners or []
         self.max_records = max_records      # unbounded-source record budget
         self.max_wall_ms = max_wall_ms      # unbounded-source wall budget
         self.metric_registry = metric_registry
+        self.config = config
         self._cancelled = False
         self._records = 0
 
@@ -174,6 +175,10 @@ class LocalExecutor:
 
         if self.metric_registry is None:
             self.metric_registry = MetricRegistry()
+        # local execution = one slot: every operator shares this slot's
+        # managed-memory accountant (budgeted components reserve from it)
+        from flink_tpu.runtime.memory import memory_manager_for
+        slot_memory = memory_manager_for(self.config)
         running: Dict[int, RunningVertex] = {}
         for v in plan.vertices:
             op = v.build_operator()
@@ -181,7 +186,7 @@ class LocalExecutor:
                                       v.name, 0)
             ctx = RuntimeContext(task_name=v.name, subtask_index=0, parallelism=1,
                                  max_parallelism=v.max_parallelism,
-                                 metrics=group)
+                                 metrics=group, memory_manager=slot_memory)
             op.open(ctx)
             if restore and v.uid in restore:
                 op.restore_state(restore[v.uid])

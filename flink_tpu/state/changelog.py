@@ -62,6 +62,17 @@ class ChangelogKeyedStateBackend:
         self._states: Dict[str, _ChangelogStateProxy] = {}
         self._descs: Dict[str, StateDescriptor] = {}
 
+    def reserve_managed(self, manager, owner: str) -> None:
+        """Forward the managed-memory claim to the wrapped backend (the
+        changelog itself is unbudgeted bookkeeping; the spill tier inside
+        is what holds resident bytes)."""
+        if hasattr(self.inner, "reserve_managed"):
+            self.inner.reserve_managed(manager, owner)
+
+    def close(self) -> None:
+        if hasattr(self.inner, "close"):
+            self.inner.close()
+
     # -- key plumbing (recorded: slot assignment must replay identically) ----
     @property
     def max_parallelism(self) -> int:

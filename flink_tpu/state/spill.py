@@ -270,6 +270,9 @@ class SpillKeyedStateBackend:
                  clock: Callable[[], int] = _now_ms):
         self.max_parallelism = max_parallelism
         self.directory = directory or tempfile.mkdtemp(prefix="flink_tpu_spill_")
+        self.mem_budget = mem_budget
+        #: slot managed-memory claim (runtime/memory.py), once bound
+        self._reservation = None
         self.store = SpillStore(self.directory, mem_budget)
         self._clock = clock
         self._index = None
@@ -390,5 +393,17 @@ class SpillKeyedStateBackend:
     def compact(self) -> int:
         return self.store.compact()
 
+    def reserve_managed(self, manager, owner: str) -> None:
+        """Claim this backend's resident-byte budget from the slot's
+        managed memory (the RocksDB-tier reservation analog: the budget is
+        accounted against the slot BEFORE the job runs, so an
+        over-committed slot fails at open time, not as a mid-job OOM).
+        Released by :meth:`close`."""
+        if self._reservation is None and manager is not None:
+            self._reservation = manager.reserve(owner, self.mem_budget)
+
     def close(self) -> None:
+        if self._reservation is not None:
+            self._reservation.release()
+            self._reservation = None
         self.store.close()

@@ -1,0 +1,176 @@
+"""Per-slot managed memory accounting (``runtime/memory.py`` —
+``MemoryManager.java`` analog): reservations, fail-fast over-commit,
+fraction splitting, slot sizing, and the spill-backend integration."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from flink_tpu.config.config_option import Configuration
+from flink_tpu.config.options import TaskManagerOptions
+from flink_tpu.runtime.memory import (
+    MemoryManager, MemoryReservationError, memory_manager_for,
+    slot_memory_managers)
+
+
+class TestAccounting:
+    def test_reserve_release_cycle(self):
+        mm = MemoryManager(100)
+        r1 = mm.reserve("sort", 60)
+        assert mm.available() == 40 and mm.used() == 60
+        r2 = mm.reserve("hash", 40)
+        assert mm.available() == 0
+        r1.release()
+        assert mm.available() == 60
+        r1.release()                      # idempotent
+        assert mm.available() == 60
+        r2.release()
+        assert mm.usage_by_owner() == {}
+
+    def test_over_commit_fails_fast(self):
+        mm = MemoryManager(100)
+        mm.reserve("a", 80)
+        with pytest.raises(MemoryReservationError, match="requested 30"):
+            mm.reserve("b", 30)
+        # the failed attempt must not leak accounting
+        assert mm.available() == 20
+        mm.reserve("b", 20)
+
+    def test_release_all_for_owner(self):
+        mm = MemoryManager(100)
+        mm.reserve("op", 30)
+        mm.reserve("op", 20)
+        mm.reserve("other", 10)
+        assert mm.release_all("op") == 50
+        assert mm.available() == 90
+        assert mm.usage_by_owner() == {"other": 10}
+
+    def test_context_manager_releases(self):
+        mm = MemoryManager(64)
+        with mm.reserve("tmp", 64):
+            assert mm.available() == 0
+        assert mm.available() == 64
+
+    def test_operator_share_weights(self):
+        mm = MemoryManager(1000)
+        w = {"sort": 3.0, "hash": 1.0}
+        assert mm.compute_operator_share(w, "sort") == 750
+        assert mm.compute_operator_share(w, "hash") == 250
+        assert mm.compute_operator_share(w, "absent") == 0
+
+    def test_slot_split(self):
+        slots = slot_memory_managers(100, 4)
+        assert [s.total for s in slots] == [25] * 4
+        cfg = Configuration()
+        cfg.set(TaskManagerOptions.MANAGED_MEMORY_SIZE, 128)
+        assert memory_manager_for(cfg, num_slots=2).total == 64
+        # num_slots defaults from taskmanager.numberOfTaskSlots
+        cfg.set(TaskManagerOptions.NUM_TASK_SLOTS, 4)
+        assert memory_manager_for(cfg).total == 32
+        assert memory_manager_for(None).total == 256 << 20  # default
+
+    def test_release_after_release_all_does_not_double_free(self):
+        """A reservation's own release after release_all(owner) must be a
+        no-op — a negative balance would void the over-commit invariant."""
+        mm = MemoryManager(100)
+        r = mm.reserve("op", 60)
+        assert mm.release_all("op") == 60
+        r.release()
+        assert mm.used() == 0 and mm.available() == 100
+        mm.reserve("later", 100)             # exactly full, no phantom room
+        with pytest.raises(MemoryReservationError):
+            mm.reserve("later", 1)
+
+    def test_slot_pool_bounds_aggregate_memory(self):
+        """Subtask launches (and relaunches) round-robin over a FIXED slot
+        pool: total managed memory stays bounded by the executor's size."""
+        from flink_tpu.runtime.memory import SlotMemoryPool
+
+        cfg = Configuration()
+        cfg.set(TaskManagerOptions.MANAGED_MEMORY_SIZE, 100)
+        cfg.set(TaskManagerOptions.NUM_TASK_SLOTS, 2)
+        pool = SlotMemoryPool(cfg)
+        assigned = [pool.assign() for _ in range(10)]
+        assert len({id(m) for m in assigned}) == 2     # reused, not grown
+        assert sum(m.total for m in pool.slots) == 100
+
+
+class TestSpillBackendIntegration:
+    def test_spill_backend_reserves_and_releases(self, tmp_path):
+        from flink_tpu.state.spill import SpillKeyedStateBackend
+
+        mm = MemoryManager(64 << 20)
+        b = SpillKeyedStateBackend(str(tmp_path), mem_budget=16 << 20)
+        b.reserve_managed(mm, owner="proc[0]")
+        assert mm.used() == 16 << 20
+        b.reserve_managed(mm, owner="proc[0]")   # idempotent rebind
+        assert mm.used() == 16 << 20
+        b.close()
+        assert mm.used() == 0
+
+    def test_over_committed_slot_fails_at_open(self, tmp_path):
+        """Two backends whose budgets exceed the slot's share: the second
+        open fails LOUDLY at reserve time — the mid-job-OOM prevention the
+        reference's managed memory exists for."""
+        from flink_tpu.state.spill import SpillKeyedStateBackend
+
+        mm = MemoryManager(20 << 20)
+        b1 = SpillKeyedStateBackend(str(tmp_path / "a"), mem_budget=16 << 20)
+        b1.reserve_managed(mm, owner="a")
+        b2 = SpillKeyedStateBackend(str(tmp_path / "b"), mem_budget=16 << 20)
+        with pytest.raises(MemoryReservationError):
+            b2.reserve_managed(mm, owner="b")
+        b1.close()
+        b2.reserve_managed(mm, owner="b")        # freed share is reusable
+        b2.close()
+
+    def test_pipeline_process_function_reserves_slot_memory(self):
+        """End to end: a keyed process function over the spill backend
+        claims managed memory from the executor slot's manager."""
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+        from flink_tpu.config.options import StateOptions
+
+        cfg = Configuration()
+        cfg.set(StateOptions.BACKEND, "spill")
+        env = StreamExecutionEnvironment(config=cfg)
+
+        class CountFn:
+            def open(self, ctx):
+                self._seen_manager = ctx.memory_manager
+                self.used_at_open = (ctx.memory_manager.used()
+                                     if ctx.memory_manager else -1)
+
+            def process_batch(self, ctx, batch):
+                return []
+
+            def close(self):
+                pass
+
+        fn = CountFn()
+        from flink_tpu.operators.process import KeyedProcessOperator
+        from flink_tpu.state import make_keyed_backend
+        from flink_tpu.core.functions import RuntimeContext
+
+        backend = make_keyed_backend(cfg)
+        op = KeyedProcessOperator(fn, "k", "proc", backend=backend)
+        mm = MemoryManager(256 << 20)
+        op.open(RuntimeContext(task_name="proc", memory_manager=mm))
+        if hasattr(backend, "mem_budget"):
+            assert mm.used() == backend.mem_budget
+        op.close()
+        assert mm.used() == 0               # teardown returned the claim
+
+    def test_changelog_wrapper_forwards_reservation(self, tmp_path):
+        """changelog-spill must enforce the same contract as plain spill:
+        the wrapper forwards reserve_managed/close to the inner backend."""
+        from flink_tpu.state.changelog import ChangelogKeyedStateBackend
+        from flink_tpu.state.spill import SpillKeyedStateBackend
+
+        inner = SpillKeyedStateBackend(str(tmp_path), mem_budget=8 << 20)
+        wrapped = ChangelogKeyedStateBackend(inner)
+        mm = MemoryManager(16 << 20)
+        wrapped.reserve_managed(mm, owner="w")
+        assert mm.used() == 8 << 20
+        wrapped.close()
+        assert mm.used() == 0
