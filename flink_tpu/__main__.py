@@ -388,7 +388,9 @@ def _cmd_coordinate(args) -> int:
     return 0 if res["state"] == "FINISHED" else 1
 
 
-def main(argv=None) -> int:
+def build_parser() -> "argparse.ArgumentParser":
+    """The full CLI surface (exposed so deployment renderers can validate
+    the commands they emit against the real parser)."""
     p = argparse.ArgumentParser(prog="flink_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
     pr = sub.add_parser("run", help="run a job script")
@@ -489,7 +491,11 @@ def main(argv=None) -> int:
         if needs_job:
             pc.add_argument("job_id")
         pc.set_defaults(fn=_cmd_rest)
-    args = p.parse_args(argv)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
     return args.fn(args)
 
 

@@ -1,0 +1,91 @@
+"""Container glue rendering (``deploy/docker.py`` — flink-container
+analog).  The docker daemon is absent here, so the contract is the
+rendered artifacts: structurally valid, role dispatch correct (the
+entrypoint runs under sh), compose parses as YAML-shaped config."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from flink_tpu.deploy.docker import (render_compose, render_dockerfile,
+                                     render_entrypoint, write_context)
+
+
+class TestRendering:
+    def test_dockerfile_structure(self):
+        df = render_dockerfile(python="3.12", extras=["pyarrow"])
+        assert df.startswith("FROM python:3.12-slim")
+        assert "COPY flink_tpu ./flink_tpu" in df
+        assert "COPY native ./native" in df          # C++ sources ship
+        assert "pip install --no-cache-dir pyarrow" in df
+        assert "USER flink" in df                    # non-root
+        assert 'ENTRYPOINT ["/docker-entrypoint.sh"]' in df
+
+    def test_entrypoint_dispatches_roles(self, tmp_path):
+        """Run the REAL script under sh with a stubbed python on PATH:
+        each role must exec the right module invocation."""
+        script = tmp_path / "docker-entrypoint.sh"
+        script.write_text(render_entrypoint())
+        script.chmod(0o755)
+        stub = tmp_path / "python"
+        stub.write_text("#!/bin/sh\necho ARGS:$@\n")
+        stub.chmod(0o755)
+        env = dict(os.environ, PATH=f"{tmp_path}:{os.environ['PATH']}")
+
+        def run(*args):
+            return subprocess.run(["sh", str(script), *args], env=env,
+                                  capture_output=True, text=True).stdout
+
+        assert "ARGS:-m flink_tpu coordinate --port 9"\
+            in run("coordinate", "--port", "9")
+        assert "ARGS:-m flink_tpu worker --coordinator c:1" \
+            in run("worker", "--coordinator", "c:1")
+        assert "ARGS:-m flink_tpu sql" in run("sql")
+        # arbitrary command passthrough (debug shells)
+        assert "hello" in run("echo", "hello")
+
+    def test_compose_structure(self):
+        text = render_compose("examples.job:build", n_workers=3,
+                              environment={"TPU_CHIPS": "0"})
+        # one service per worker index (compose replicas can't vary args)
+        for i in range(3):
+            assert f"worker-{i}:" in text
+            assert f'"--index", "{i}"' in text
+        assert 'command: ["coordinate", "--job", "examples.job:build"' in text
+        assert 'TPU_CHIPS: "0"' in text
+        assert 'FLINK_TPU_ALLOW_INSECURE: "1"' in text  # non-loopback guard
+        assert text.count("checkpoints:/checkpoints") == 4  # shared volume
+        try:
+            import yaml  # noqa: F401
+        except ImportError:
+            return
+        parsed = yaml.safe_load(text)
+        assert set(parsed["services"]) == {"coordinator", "worker-0",
+                                           "worker-1", "worker-2"}
+
+    def test_rendered_commands_parse_with_the_real_cli(self):
+        """The role commands must be valid for flink_tpu.__main__'s actual
+        argparse surface — spelling-level assertions let invalid flags
+        ship green."""
+        from flink_tpu.deploy.docker import (coordinator_command,
+                                             worker_command)
+        from flink_tpu.__main__ import build_parser
+
+        parser = build_parser()
+        c = coordinator_command("my.job:build", 3, 6123, "/checkpoints")
+        args = parser.parse_args(c)
+        assert args.job == "my.job:build" and args.workers == 3
+        assert args.listen == "0.0.0.0:6123"
+        w = worker_command(1, "my.job:build", 3, "coordinator:6123")
+        args = parser.parse_args(w)
+        assert args.index == 1 and args.coordinator == "coordinator:6123"
+        assert args.advertise == "worker-1"
+
+    def test_write_context(self, tmp_path):
+        paths = write_context(str(tmp_path / "ctx"), job="my.job:build")
+        names = sorted(os.path.basename(p) for p in paths)
+        assert names == ["Dockerfile", "docker-compose.yml",
+                         "docker-entrypoint.sh"]
+        ep = os.path.join(str(tmp_path / "ctx"), "docker-entrypoint.sh")
+        assert os.access(ep, os.X_OK)
