@@ -41,9 +41,11 @@ def render_dockerfile(python: str = "3.12",
         lines.append(f"RUN pip install --no-cache-dir {e}")
     lines += [
         "",
-        "# pre-build the native library into the image (first-use cache)",
-        "RUN python -c \"from flink_tpu.native import native_available; "
-        "native_available()\"",
+        "# pre-build the native library into the image (first-use cache);",
+        "# a failed C++ build must FAIL the image build, not ship a silent",
+        "# fallback (native_available returns False rather than raising)",
+        "RUN python -c \"from flink_tpu.native import native_available, "
+        "build_error; assert native_available(), build_error()\"",
         "",
         "COPY docker-entrypoint.sh /docker-entrypoint.sh",
         "RUN chmod +x /docker-entrypoint.sh",
@@ -68,17 +70,13 @@ ROLE="$1"
 [ $# -gt 0 ] && shift
 
 case "$ROLE" in
-    coordinate)
-        exec python -m flink_tpu coordinate "$@"
-        ;;
-    worker)
-        exec python -m flink_tpu worker "$@"
-        ;;
-    sql|repl|kafka|s3|run)
+    run|sql|info|repl|worker|coordinate|logservice|objectstore|s3|kafka|\
+quickstart|list|status|cancel|savepoint|stop)
+        # every CLI subcommand (flink_tpu.__main__ build_parser surface)
         exec python -m flink_tpu "$ROLE" "$@"
         ;;
     help|"")
-        echo "usage: <coordinate|worker|sql|repl|kafka|s3|run> [args...]"
+        echo "usage: <any flink_tpu subcommand|shell cmd> [args...]"
         exec python -m flink_tpu --help
         ;;
     *)
@@ -111,8 +109,16 @@ def worker_command(index: int, job: str, n_workers: int,
             "--bind", "0.0.0.0", "--advertise", f"worker-{index}"]
 
 
+def _yq(v: str) -> str:
+    """A YAML double-quoted scalar (json.dumps escapes quotes/backslashes
+    exactly as YAML flow scalars require)."""
+    import json
+
+    return json.dumps(str(v))
+
+
 def _yaml_cmd(args: List[str]) -> str:
-    return "[" + ", ".join(f'"{a}"' for a in args) + "]"
+    return "[" + ", ".join(_yq(a) for a in args) + "]"
 
 
 def render_compose(job: str, image: str = "flink-tpu:latest",
@@ -125,7 +131,7 @@ def render_compose(job: str, image: str = "flink-tpu:latest",
     ``FLINK_TPU_ALLOW_INSECURE`` — set ``FLINK_TPU_SSL_*`` instead for
     untrusted networks.  Healthcheck: a TCP dial of the control port (the
     coordinate role serves the binary control plane, not HTTP)."""
-    env_lines = "".join(f"      {k}: \"{v}\"\n"
+    env_lines = "".join(f"      {k}: {_yq(v)}\n"
                         for k, v in (environment or {}).items())
     base_env = ("      FLINK_TPU_ALLOW_INSECURE: \"1\"\n"
                 "      JAX_PLATFORMS: \"cpu\"\n" + env_lines)
@@ -154,7 +160,9 @@ def render_compose(job: str, image: str = "flink-tpu:latest",
     image: {image}
     command: {_yaml_cmd(wcmd)}
     depends_on:
-      - coordinator
+      coordinator:
+        condition: service_healthy
+    restart: on-failure
     environment:
 {base_env}    volumes:
       - checkpoints:/checkpoints
@@ -167,18 +175,40 @@ volumes:
 
 
 def write_context(directory: str, job: str, image: str = "flink-tpu:latest",
-                  n_workers: int = 2, python: str = "3.12") -> List[str]:
-    """Lay the build context on disk: Dockerfile, entrypoint, compose.
-    Returns the written paths (the package itself is copied by the
-    Dockerfile's COPY directives at build time)."""
+                  n_workers: int = 2, python: str = "3.12",
+                  repo_root: Optional[str] = None) -> List[str]:
+    """Lay a SELF-CONTAINED build context on disk: Dockerfile, entrypoint,
+    compose, plus the package sources the Dockerfile COPYs
+    (``pyproject.toml``, ``README.md``, ``flink_tpu/``, ``native/``) —
+    ``docker build <directory>`` works as-is.  ``repo_root`` defaults to
+    this installation's root."""
+    import shutil
+
     os.makedirs(directory, exist_ok=True)
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    out = []
+    for fname in ("pyproject.toml", "README.md"):
+        src = os.path.join(repo_root, fname)
+        if os.path.exists(src):
+            shutil.copy2(src, os.path.join(directory, fname))
+            out.append(os.path.join(directory, fname))
+    for pkg in ("flink_tpu", "native"):
+        src = os.path.join(repo_root, pkg)
+        dst = os.path.join(directory, pkg)
+        if os.path.isdir(src):
+            shutil.copytree(
+                src, dst, dirs_exist_ok=True,
+                ignore=shutil.ignore_patterns("__pycache__", "_build",
+                                              "*.so", "*.pyc"))
+            out.append(dst)
     files = {
         "Dockerfile": render_dockerfile(python=python),
         "docker-entrypoint.sh": render_entrypoint(),
         "docker-compose.yml": render_compose(job, image=image,
                                              n_workers=n_workers),
     }
-    out = []
     for name, content in files.items():
         path = os.path.join(directory, name)
         with open(path, "w") as f:

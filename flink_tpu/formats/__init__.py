@@ -7,9 +7,8 @@ the TPU runtime needs), never record-at-a-time objects.
 
 FTB is the framework's own binary format (``flink_tpu/native/codec.py``):
 length-prefixed compressed column blocks.  Avro (``formats/avro.py``) and
-Parquet (``formats/parquet.py``) are implemented from their specs — no
-fastavro/pyarrow in this environment.  ORC still needs pyarrow; the reader
-raises a clear error if requested (pluggable seam kept).
+Parquet (``formats/parquet.py``) and ORC (``formats/orc.py``) are
+implemented from their specs — no fastavro/pyarrow in this environment.
 """
 
 from __future__ import annotations
@@ -245,20 +244,27 @@ def _write_parquet(batches, path: str, **kw) -> int:
     return write_parquet(batches, path, **kw)
 
 
+def _read_orc(path: str, batch_size: int = 0, **kw):
+    from flink_tpu.formats.orc import read_orc
+    return read_orc(path, batch_size=batch_size, **kw)
+
+
+def _write_orc(batches, path: str, **kw) -> int:
+    from flink_tpu.formats.orc import write_orc
+    return write_orc(batches, path, **kw)
+
+
 FORMATS = {
     "csv": (read_csv, write_csv),
     "jsonl": (read_jsonl, write_jsonl),
     "ftb": (read_ftb, write_ftb),
     "avro": (_read_avro, _write_avro),
     "parquet": (_read_parquet, _write_parquet),
+    "orc": (_read_orc, _write_orc),
 }
 
 
 def reader_for(fmt: str):
-    if fmt == "orc":
-        raise NotImplementedError(
-            "orc needs pyarrow (not in this environment); "
-            "use 'parquet', 'avro', 'ftb' (binary), 'csv' or 'jsonl'")
     if fmt not in FORMATS:
         raise ValueError(f"unknown format {fmt!r}; have {sorted(FORMATS)}")
     return FORMATS[fmt][0]

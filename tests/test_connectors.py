@@ -68,11 +68,13 @@ def test_ftb_torn_tail_ignored(tmp_path):
     assert sum(len(b) for b in got) == 10
 
 
-def test_orc_clearly_gated_parquet_native():
-    # parquet is implemented natively since round 4; orc stays gated
-    assert formats.reader_for("parquet") is not None
-    with pytest.raises(NotImplementedError):
-        formats.reader_for("orc")
+def test_all_columnar_formats_registered():
+    # parquet AND orc are implemented natively since round 4
+    for fmt in ("parquet", "orc", "avro", "ftb", "csv", "jsonl"):
+        assert formats.reader_for(fmt) is not None
+        assert formats.writer_for(fmt) is not None
+    with pytest.raises(ValueError, match="unknown format"):
+        formats.reader_for("xml")
 
 
 # ---------------------------------------------------------------------------

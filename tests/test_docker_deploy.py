@@ -82,10 +82,48 @@ class TestRendering:
         assert args.index == 1 and args.coordinator == "coordinator:6123"
         assert args.advertise == "worker-1"
 
-    def test_write_context(self, tmp_path):
-        paths = write_context(str(tmp_path / "ctx"), job="my.job:build")
-        names = sorted(os.path.basename(p) for p in paths)
-        assert names == ["Dockerfile", "docker-compose.yml",
-                         "docker-entrypoint.sh"]
-        ep = os.path.join(str(tmp_path / "ctx"), "docker-entrypoint.sh")
-        assert os.access(ep, os.X_OK)
+    def test_write_context_is_self_contained(self, tmp_path):
+        """Every path the Dockerfile COPYs must exist in the context —
+        otherwise ``docker build <dir>`` fails at the first COPY."""
+        ctx = str(tmp_path / "ctx")
+        write_context(ctx, job="my.job:build")
+        df = open(os.path.join(ctx, "Dockerfile")).read()
+        import re
+        for line in re.findall(r"^COPY (.+?) (?:\./|/)", df, re.M):
+            for src in line.split():
+                assert os.path.exists(os.path.join(ctx, src)), \
+                    f"Dockerfile COPYs {src} but the context lacks it"
+        assert os.path.isfile(os.path.join(ctx, "flink_tpu",
+                                           "__init__.py"))
+        assert os.path.isfile(os.path.join(ctx, "native",
+                                           "flink_native.cc"))
+        assert os.access(os.path.join(ctx, "docker-entrypoint.sh"),
+                         os.X_OK)
+
+    def test_compose_worker_waits_for_healthy_coordinator(self):
+        text = render_compose("j:build", n_workers=1)
+        assert "condition: service_healthy" in text
+        assert "restart: on-failure" in text
+
+    def test_yaml_escaping(self):
+        text = render_compose('we"ird:build', n_workers=1,
+                              environment={"OPTS": 'x"y\\z'})
+        assert '"we\\"ird:build"' in text
+        assert 'OPTS: "x\\"y\\\\z"' in text
+
+    def test_entrypoint_covers_every_cli_subcommand(self, tmp_path):
+        """Each real subcommand must dispatch through python -m flink_tpu,
+        not fall into the arbitrary-exec arm."""
+        from flink_tpu.__main__ import build_parser
+
+        subs = build_parser()._subparsers._group_actions[0].choices
+        script = tmp_path / "ep.sh"
+        script.write_text(render_entrypoint())
+        stub = tmp_path / "python"
+        stub.write_text("#!/bin/sh\necho VIA_MODULE:$@\n")
+        stub.chmod(0o755)
+        env = dict(os.environ, PATH=f"{tmp_path}:{os.environ['PATH']}")
+        for name in subs:
+            out = subprocess.run(["sh", str(script), name], env=env,
+                                 capture_output=True, text=True).stdout
+            assert f"VIA_MODULE:-m flink_tpu {name}" in out, name

@@ -1,0 +1,253 @@
+"""ORC from the spec (``formats/orc.py``).
+
+RLEv2 decoding is validated against the worked byte examples in the
+public ORC specification (short-repeat / direct / delta), RLEv1 and the
+file layer by round trip and by hand-parsed structure — the same
+methodology as the Parquet and Avro suites (no foreign implementation
+exists in this image; the caveat rides PARITY.md)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.formats.orc import (
+    COMP_ZLIB, MAGIC, _bool_decode, _bool_encode, _byte_rle_decode,
+    _byte_rle_encode, _compress_stream, _decompress_stream, _pb_decode,
+    _rle1_decode, _rle1_encode, _rle2_decode, read_orc, write_orc)
+
+
+class TestRleV2SpecVectors:
+    """The ORC spec's own worked examples, byte for byte."""
+
+    def test_short_repeat(self):
+        # [10000] * 5 -> 0x0a 0x27 0x10 (width 2 bytes, count 5)
+        got = _rle2_decode(bytes([0x0A, 0x27, 0x10]), 5, signed=False)
+        assert got.tolist() == [10000] * 5
+
+    def test_direct(self):
+        # [23713, 43806, 57005, 48879] -> 5e 03 5c a1 ab 1e de ad be ef
+        data = bytes([0x5E, 0x03, 0x5C, 0xA1, 0xAB, 0x1E,
+                      0xDE, 0xAD, 0xBE, 0xEF])
+        got = _rle2_decode(data, 4, signed=False)
+        assert got.tolist() == [23713, 43806, 57005, 48879]
+
+    def test_delta(self):
+        # [2,3,5,7,11,13,17,19,23,29] -> c6 09 02 02 22 42 42 46
+        data = bytes([0xC6, 0x09, 0x02, 0x02, 0x22, 0x42, 0x42, 0x46])
+        got = _rle2_decode(data, 10, signed=False)
+        assert got.tolist() == [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+
+    def test_delta_fixed_width_zero(self):
+        # width code 0 = fixed delta: base 10, delta -2, length 4
+        # header: 11 00000 0 -> 0xC0, len-1 = 3
+        import flink_tpu.formats.orc as orc
+
+        data = bytes([0xC0, 0x03]) + orc._uvarint(10) + orc._svarint(-2)
+        got = _rle2_decode(data, 4, signed=False)
+        assert got.tolist() == [10, 8, 6, 4]
+
+    def test_patched_base_hand_built(self):
+        """Hand-built per the spec's field layout: base 100, width 4 bits,
+        one outlier patched with 8 extra bits at position 2."""
+        vals = [1, 5, 3, 7]          # packed 4-bit values
+        # outlier: position 2 gets patch 0x1 -> value 3 | (1 << 4) = 19
+        header = bytes([0x80 | (3 << 1), 0x03])  # width code 3 (=4 bits), len 4
+        bw_pw = bytes([(0 << 5) | 6])  # base width 1 byte, patch width code 6 (=7 bits)
+        # -> patch width 7 bits, gap width 3 bits, patch list length 1
+        pgw_pll = bytes([(2 << 5) | 1])
+        base = bytes([100])
+        packed = bytes([vals[0] << 4 | vals[1], vals[2] << 4 | vals[3]])
+        # one patch entry: gap 2 (3 bits), patch 1 (7 bits) -> 10 bits,
+        # padded to 2 bytes big-endian: 010 0000001 000000
+        entry = (2 << 7) | 1
+        patch_bytes = bytes([(entry >> 2) & 0xFF, (entry & 0x3) << 6])
+        data = header + bw_pw + pgw_pll + base + packed + patch_bytes
+        got = _rle2_decode(data, 4, signed=False)
+        assert got.tolist() == [101, 105, 100 + 19, 107]
+
+    def test_negative_base_sign_magnitude(self):
+        # patched base with MSB-set base byte = negative base
+        header = bytes([0x80 | (3 << 1), 0x01])  # 4-bit width, len 2
+        meta = bytes([(0 << 5) | 0, (0 << 5) | 0])  # bw 1, pw 1bit, no patches
+        base = bytes([0x80 | 10])    # sign-magnitude: -10
+        packed = bytes([2 << 4 | 4])
+        got = _rle2_decode(header + meta + base + packed, 2, signed=False)
+        assert got.tolist() == [-8, -6]
+
+
+class TestRleV1:
+    def test_runs_and_literals_round_trip(self, rng):
+        cases = [
+            np.arange(1000, dtype=np.int64),              # one long run
+            np.full(500, -7, np.int64),                   # constant
+            rng.integers(-10**12, 10**12, 333),           # literals
+            np.asarray([5], np.int64),
+            np.asarray([], np.int64),
+            np.repeat(np.arange(10), 40),                 # many runs
+        ]
+        for vals in cases:
+            vals = vals.astype(np.int64)
+            enc = _rle1_encode(vals, signed=True)
+            assert np.array_equal(_rle1_decode(enc, len(vals), True), vals)
+
+    def test_unsigned_lengths(self, rng):
+        vals = rng.integers(0, 100, 777).astype(np.int64)
+        enc = _rle1_encode(vals, signed=False)
+        assert np.array_equal(_rle1_decode(enc, 777, False), vals)
+
+    def test_run_compression_is_real(self):
+        enc = _rle1_encode(np.arange(130, dtype=np.int64), signed=True)
+        assert len(enc) <= 4          # one run record: ctrl, delta, base
+
+
+class TestByteAndBoolRle:
+    def test_byte_rle_round_trip(self, rng):
+        for raw in (b"\x00" * 100, bytes(rng.integers(0, 256, 257)),
+                    b"ab" * 3 + b"\x07" * 50, b""):
+            assert _byte_rle_decode(_byte_rle_encode(raw), len(raw)) == raw
+
+    def test_bool_round_trip(self, rng):
+        for mask in (np.zeros(100, bool), np.ones(31, bool),
+                     rng.integers(0, 2, 97).astype(bool)):
+            assert np.array_equal(_bool_decode(_bool_encode(mask),
+                                               len(mask)), mask)
+
+
+class TestFileRoundTrip:
+    def batch(self, rng, n=1000):
+        return RecordBatch({
+            "i64": rng.integers(-10**14, 10**14, n),
+            "i32": rng.integers(-2**30, 2**30, n).astype(np.int32),
+            "f64": rng.random(n),
+            "f32": rng.random(n).astype(np.float32),
+            "flag": rng.integers(0, 2, n).astype(bool),
+            "name": np.asarray([f"row-{i}'s ünïcode" for i in range(n)],
+                               object),
+        })
+
+    @pytest.mark.parametrize("compression", ["none", "zlib"])
+    def test_round_trip(self, tmp_path, rng, compression):
+        p = str(tmp_path / "t.orc")
+        src = self.batch(rng)
+        n = write_orc([src], p, compression=compression)
+        assert n == 1000
+        (got,) = read_orc(p)
+        for c in src.columns:
+            a, b = np.asarray(src.column(c)), np.asarray(got.column(c))
+            if a.dtype.kind == "f":
+                assert np.allclose(a, b) and a.dtype == b.dtype
+            elif a.dtype == object:
+                assert a.tolist() == b.tolist()
+            else:
+                assert np.array_equal(a, b) and a.dtype == b.dtype
+
+    def test_multiple_stripes(self, tmp_path, rng):
+        p = str(tmp_path / "s.orc")
+        write_orc([self.batch(rng, 500) for _ in range(4)], p,
+                  stripe_rows=800)
+        stripes = list(read_orc(p))
+        assert [len(s) for s in stripes] == [1000, 1000]
+        assert sum(len(s) for s in stripes) == 2000
+
+    def test_layout_bytes(self, tmp_path, rng):
+        """Hand-parse the physical layout: magic, trailing postscript
+        length byte, postscript fields, footer row count."""
+        p = str(tmp_path / "l.orc")
+        write_orc([self.batch(rng, 64)], p, compression="zlib")
+        raw = open(p, "rb").read()
+        assert raw.startswith(MAGIC)
+        ps_len = raw[-1]
+        ps = _pb_decode(raw[-1 - ps_len:-1])
+        assert ps[8000][0] == b"ORC"          # postscript magic field
+        assert ps[2][0] == COMP_ZLIB
+        flen = ps[1][0]
+        footer = _pb_decode(_decompress_stream(
+            raw[-1 - ps_len - flen:-1 - ps_len], COMP_ZLIB))
+        assert footer[6][0] == 64             # numberOfRows
+        assert len(footer[3]) == 1            # one stripe
+
+    def test_empty_input_writes_valid_file(self, tmp_path):
+        p = str(tmp_path / "e.orc")
+        empty = RecordBatch({"x": np.empty(0, np.int64)})
+        assert write_orc([empty], p) == 0
+        assert list(read_orc(p)) == []
+
+    def test_compression_chunks_round_trip(self, rng):
+        data = bytes(rng.integers(0, 8, 700_000))  # compressible, multi-chunk
+        z = _compress_stream(data, COMP_ZLIB)
+        assert len(z) < len(data)
+        assert _decompress_stream(z, COMP_ZLIB) == data
+
+
+class TestReaderForeignEncodings:
+    """Streams a modern writer would emit (DIRECT_V2 / DICTIONARY_V2):
+    hand-assembled stripes prove the reader handles them."""
+
+    def test_direct_v2_and_dictionary_v2(self, tmp_path):
+        """Assemble a whole single-stripe file by hand with RLEv2-coded
+        integers (DIRECT_V2) and a DICTIONARY_V2 string column — the
+        encodings a modern writer emits and our writer does not."""
+        import flink_tpu.formats.orc as orc
+
+        primes = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+        # signed RLEv2 delta: zigzag base 2, delta base +1, 4-bit deltas
+        int_data = bytes([0xC6, 0x09]) + orc._uvarint(4) \
+            + orc._svarint(1) + bytes([0x22, 0x42, 0x42, 0x46])
+        words = ["ab", "ab", "zz", "cd", "ab", "zz", "cd", "cd", "ab", "zz"]
+        dict_sorted = ["ab", "cd", "zz"]
+        idx = [dict_sorted.index(w) for w in words]
+        # indexes: RLEv2 DIRECT, width 2 bits, 10 values (unsigned)
+        packed = bytearray()
+        acc = bits = 0
+        for v in idx:
+            acc = (acc << 2) | v
+            bits += 2
+            while bits >= 8:
+                packed.append((acc >> (bits - 8)) & 0xFF)
+                bits -= 8
+        if bits:
+            packed.append((acc << (8 - bits)) & 0xFF)
+        idx_data = bytes([0x40 | (1 << 1), 0x09]) + bytes(packed)
+        dict_blob = "".join(dict_sorted).encode()
+        # dict entry lengths [2,2,2]: RLEv2 short repeat, width 1, count 3
+        len_data = bytes([0x00, 0x02])
+
+        streams = [(orc.STREAM_DATA, 1, int_data),
+                   (orc.STREAM_DATA, 2, idx_data),
+                   (orc.STREAM_DICT_DATA, 2, dict_blob),
+                   (orc.STREAM_LENGTH, 2, len_data)]
+        sfoot = orc._Msg()
+        body = b"".join(s[2] for s in streams)
+        for skind, col, blob in streams:
+            sfoot.msg(1, orc._Msg().varint(1, skind).varint(2, col)
+                      .varint(3, len(blob)))
+        sfoot.msg(2, orc._Msg().varint(1, orc.ENC_DIRECT))      # root
+        sfoot.msg(2, orc._Msg().varint(1, orc.ENC_DIRECT_V2))   # ints
+        sfoot.msg(2, orc._Msg().varint(1, orc.ENC_DICTIONARY_V2)
+                  .varint(2, len(dict_sorted)))                 # strings
+        sf = sfoot.encode()
+
+        footer = orc._Msg()
+        footer.varint(1, 3).varint(2, 3 + len(body) + len(sf))
+        footer.msg(3, orc._Msg().varint(1, 3).varint(2, 0)
+                   .varint(3, len(body)).varint(4, len(sf)).varint(5, 10))
+        root = orc._Msg().varint(1, orc.K_STRUCT)
+        root.varint(2, 1).varint(2, 2)
+        root.string(3, "x").string(3, "w")
+        footer.msg(4, root)
+        footer.msg(4, orc._Msg().varint(1, orc.K_LONG))
+        footer.msg(4, orc._Msg().varint(1, orc.K_STRING))
+        footer.varint(6, 10).varint(8, 0)
+        fb = footer.encode()
+        ps = orc._Msg().varint(1, len(fb)).varint(2, orc.COMP_NONE) \
+            .varint(3, orc._CHUNK).varint(4, 0).varint(4, 12) \
+            .string(8000, "ORC").encode()
+        p = str(tmp_path / "v2.orc")
+        with open(p, "wb") as f:
+            f.write(MAGIC + body + sf + fb + ps + bytes([len(ps)]))
+
+        (got,) = read_orc(p)
+        assert np.asarray(got.column("x")).tolist() == primes
+        assert np.asarray(got.column("w")).tolist() == words
