@@ -10,8 +10,9 @@ vertex-centric model (``spargel``) IS one segment-sum per superstep on TPU.
 Algorithms (the ``flink-gelly`` ``library/`` roster): PageRank, connected
 components, SSSP (Bellman-Ford relaxation), triangle count, k-core, local
 clustering coefficient, BFS levels, label propagation, HITS, per-edge
-Jaccard similarity — plus the generic ``scatter_gather`` harness the rest
-are built on.  ``scatter_gather``/``pagerank`` take a ``mesh`` to run
+Jaccard similarity and Adamic-Adar, structural summarization (contract by
+label), bipartite projections, aggregate vertex metrics — plus the
+generic ``scatter_gather`` harness the rest are built on.  ``scatter_gather``/``pagerank`` take a ``mesh`` to run
 EDGE-SHARDED over a device mesh (shard_map segment-combine per device, one
 ``psum``/``pmin``/``pmax`` over ICI per superstep).  Interop with the
 DataSet API both ways (``from_dataset`` / ``as_dataset``).
@@ -422,6 +423,128 @@ class Graph:
             hub, auth = step(hub)
         return np.asarray(hub), np.asarray(auth)
 
+    # one source of truth for the similarity kernels' neighborhood views:
+    # the dense/sparse split, symmetrization, and self-loop policy must
+    # stay identical across jaccard_similarity / adamic_adar
+    _DENSE_LIMIT = 4096
+
+    def _dense_undirected_adjacency(self) -> np.ndarray:
+        """Symmetric 0/1 adjacency with a zero diagonal (n <= _DENSE_LIMIT
+        — the MXU-native matmul representation)."""
+        a = np.zeros((self.n, self.n), np.float32)
+        a[np.asarray(self.src), np.asarray(self.dst)] = 1.0
+        a[np.asarray(self.dst), np.asarray(self.src)] = 1.0
+        np.fill_diagonal(a, 0.0)
+        return a
+
+    def _undirected_neighbor_sets(self) -> dict:
+        """vertex -> set of neighbors (self-loops dropped) — the sparse
+        twin of :meth:`_dense_undirected_adjacency`."""
+        adj: dict = {}
+        for s, d in zip(np.asarray(self.src).tolist(),
+                        np.asarray(self.dst).tolist()):
+            if s != d:
+                adj.setdefault(s, set()).add(d)
+                adj.setdefault(d, set()).add(s)
+        return adj
+
+    def adamic_adar(self) -> np.ndarray:
+        """Per-EDGE Adamic-Adar index: sum over common neighbors w of
+        ``1 / log(deg(w))`` (``AdamicAdar.java`` in Gelly's similarity
+        library).  Dense path: ``A @ diag(1/log deg) @ A.T`` — two
+        MXU-native matmuls; sorted-set fallback beyond 4096 vertices."""
+        src_np = np.asarray(self.src)
+        dst_np = np.asarray(self.dst)
+        if self.n <= self._DENSE_LIMIT:
+            a = self._dense_undirected_adjacency()
+            deg = a.sum(axis=1)
+            inv_log = np.where(deg > 1, 1.0 / np.log(np.maximum(deg, 2.0)),
+                               0.0).astype(np.float32)
+            aj = jnp.asarray(a)
+            scores = np.asarray((aj * jnp.asarray(inv_log)[None, :]) @ aj.T)
+            return scores[src_np, dst_np]
+        adj = self._undirected_neighbor_sets()
+        out = np.zeros(len(src_np), np.float32)
+        for i, (s, d) in enumerate(zip(src_np.tolist(), dst_np.tolist())):
+            commons = adj.get(s, set()) & adj.get(d, set())
+            out[i] = sum(1.0 / np.log(len(adj[w]))
+                         for w in commons if len(adj[w]) > 1)
+        return out
+
+    def summarize(self, vertex_labels: np.ndarray
+                  ) -> Tuple["Graph", np.ndarray, np.ndarray]:
+        """Structural summarization (``Summarization.java``): contract
+        vertices sharing a label into one summary vertex; summary edges
+        are the DISTINCT (src-label, dst-label) pairs weighted by how many
+        original edges they group.  Returns ``(summary graph with edge
+        counts as weights, label of each summary vertex, original-vertex
+        count per summary vertex)``."""
+        labels = np.asarray(vertex_labels)
+        uniq, inv = np.unique(labels, return_inverse=True)
+        group_sizes = np.bincount(inv, minlength=len(uniq))
+        s = inv[np.asarray(self.src)]
+        d = inv[np.asarray(self.dst)]
+        pair = s.astype(np.int64) * len(uniq) + d
+        upair, counts = np.unique(pair, return_counts=True)
+        g = Graph(len(uniq), upair // len(uniq), upair % len(uniq),
+                  counts.astype(np.float32))
+        return g, uniq, group_sizes.astype(np.int64)
+
+    def bipartite_projection(self, left_size: int,
+                             onto_left: bool = True) -> "Graph":
+        """Bipartite projection (Gelly's ``BipartiteGraph``
+        ``projectionTopSimple`` analog): edges run left->right with left
+        ids in ``[0, left_size)`` and right ids in ``[left_size, n)``;
+        the projection connects two LEFT vertices whenever they share a
+        right neighbor (or two right vertices, ``onto_left=False``),
+        weighted by the number of shared neighbors.  Self-loops drop."""
+        src_np = np.asarray(self.src)
+        dst_np = np.asarray(self.dst)
+        if onto_left:
+            keys, others, size = dst_np - left_size, src_np, left_size
+        else:
+            keys, others, size = src_np, dst_np - left_size, self.n - left_size
+        nkeys = (self.n - left_size) if onto_left else left_size
+        if size <= self._DENSE_LIMIT and nkeys <= self._DENSE_LIMIT:
+            # shared-neighbor counts = B.T @ B on the biadjacency matrix —
+            # the same MXU-native kernel as the similarity methods; strict
+            # upper triangle keeps (u < v) pairs once, no self-loops
+            b = np.zeros((nkeys, size), np.float32)
+            b[keys, others] = 1.0
+            counts = np.asarray(jnp.asarray(b).T @ jnp.asarray(b))
+            es, ed = np.nonzero(np.triu(counts, k=1))
+            return Graph(size, es.astype(np.int64), ed.astype(np.int64),
+                         counts[es, ed].astype(np.float32))
+        pairs: dict = {}
+        by_key: dict = {}
+        for k, v in zip(keys.tolist(), others.tolist()):
+            by_key.setdefault(k, []).append(v)
+        for members in by_key.values():
+            ms = sorted(set(members))
+            for i, u in enumerate(ms):
+                for v in ms[i + 1:]:
+                    pairs[(u, v)] = pairs.get((u, v), 0) + 1
+        if not pairs:
+            return Graph(size, np.empty(0, np.int64), np.empty(0, np.int64),
+                         np.empty(0, np.float32))
+        es = np.asarray([p[0] for p in pairs], np.int64)
+        ed = np.asarray([p[1] for p in pairs], np.int64)
+        w = np.asarray(list(pairs.values()), np.float32)
+        return Graph(size, es, ed, w)
+
+    def vertex_metrics(self) -> dict:
+        """Aggregate graph metrics (``VertexMetrics.java``): vertex/edge
+        counts, average degree, max degree, and the number of vertices
+        with at least one edge."""
+        deg = self.out_degrees() + self.in_degrees()
+        return {
+            "vertices": self.n,
+            "edges": self.num_edges,
+            "average_degree": float(deg.mean()) if self.n else 0.0,
+            "max_degree": int(deg.max()) if self.n else 0,
+            "vertices_with_edges": int((deg > 0).sum()),
+        }
+
     def jaccard_similarity(self) -> np.ndarray:
         """Per-EDGE Jaccard index |N(u) ∩ N(v)| / |N(u) ∪ N(v)| over the
         undirected neighborhood (``JaccardIndex`` analog).  Dense
@@ -429,23 +552,14 @@ class Graph:
         set intersection beyond."""
         src_np = np.asarray(self.src)
         dst_np = np.asarray(self.dst)
-        n = self.n
-        if n <= 4096:
-            a = np.zeros((n, n), np.float32)
-            a[src_np, dst_np] = 1.0
-            a[dst_np, src_np] = 1.0
-            np.fill_diagonal(a, 0.0)
+        if self.n <= self._DENSE_LIMIT:
+            a = self._dense_undirected_adjacency()
             common = np.asarray(
                 jnp.asarray(a) @ jnp.asarray(a).T)[src_np, dst_np]
             deg = a.sum(axis=1)
             union = deg[src_np] + deg[dst_np] - common
             return np.where(union > 0, common / np.maximum(union, 1.0), 0.0)
-        adj: dict = {}
-        for s, d in zip(src_np.tolist(), dst_np.tolist()):
-            if s == d:
-                continue
-            adj.setdefault(s, set()).add(d)
-            adj.setdefault(d, set()).add(s)
+        adj = self._undirected_neighbor_sets()
         out = np.zeros(len(src_np), np.float32)
         for i, (s, d) in enumerate(zip(src_np.tolist(), dst_np.tolist())):
             ns, nd = adj.get(s, set()), adj.get(d, set())

@@ -285,3 +285,110 @@ def test_mesh_vector_values_match_single_device():
     mesh = g.scatter_gather(init, msg, "sum", update, 3,
                             mesh=make_mesh(8))
     np.testing.assert_allclose(mesh, single, rtol=1e-5, atol=1e-6)
+
+
+def test_adamic_adar_hand_computed():
+    # triangle 0-1-2 plus pendant 3 on 2: deg 0=2, 1=2, 2=3, 3=1
+    g = Graph.from_edges([(0, 1), (1, 2), (0, 2), (2, 3)])
+    aa = g.adamic_adar()
+    # edge (0,1): common neighbor {2}, deg(2)=3 -> 1/log(3)
+    assert aa[0] == pytest.approx(1 / np.log(3), rel=1e-5)
+    # edge (2,3): no common neighbors
+    assert aa[3] == pytest.approx(0.0)
+
+
+def test_adamic_adar_dense_and_sparse_agree():
+    rng = np.random.default_rng(5)
+    e = np.stack([rng.integers(0, 50, 200), rng.integers(0, 50, 200)], 1)
+    g = Graph.from_edges(e, num_vertices=50)
+    dense = g.adamic_adar()
+    adj = {}
+    for s_, d in zip(np.asarray(g.src).tolist(), np.asarray(g.dst).tolist()):
+        if s_ != d:
+            adj.setdefault(s_, set()).add(d)
+            adj.setdefault(d, set()).add(s_)
+    sparse = []
+    for s_, d in zip(np.asarray(g.src).tolist(), np.asarray(g.dst).tolist()):
+        commons = adj.get(s_, set()) & adj.get(d, set())
+        sparse.append(sum(1.0 / np.log(len(adj[w]))
+                          for w in commons if len(adj[w]) > 1))
+    np.testing.assert_allclose(dense, sparse, rtol=1e-4, atol=1e-5)
+
+
+def test_summarize_contracts_by_label():
+    # two groups: {0,1} label 10, {2,3} label 20; edges within and across
+    g = Graph.from_edges([(0, 1), (0, 2), (1, 3), (2, 3), (3, 2)])
+    summary, labels, sizes = g.summarize(np.asarray([10, 10, 20, 20]))
+    assert labels.tolist() == [10, 20]
+    assert sizes.tolist() == [2, 2]
+    edges = {(int(s), int(d)): float(w) for s, d, w in
+             zip(np.asarray(summary.src), np.asarray(summary.dst),
+                 np.asarray(summary.weights))}
+    # (10->10): edge (0,1); (10->20): (0,2),(1,3); (20->20): (2,3),(3,2)
+    assert edges == {(0, 0): 1.0, (0, 1): 2.0, (1, 1): 2.0}
+
+
+def test_bipartite_projections():
+    # left {0,1,2}, right {3,4}: 0-3, 1-3, 1-4, 2-4
+    g = Graph.from_edges([(0, 3), (1, 3), (1, 4), (2, 4)], num_vertices=5)
+    left = g.bipartite_projection(left_size=3, onto_left=True)
+    le = {(int(s), int(d)): float(w) for s, d, w in
+          zip(np.asarray(left.src), np.asarray(left.dst),
+              np.asarray(left.weights))}
+    assert le == {(0, 1): 1.0, (1, 2): 1.0}   # share 3; share 4
+    right = g.bipartite_projection(left_size=3, onto_left=False)
+    re_ = {(int(s), int(d)): float(w) for s, d, w in
+           zip(np.asarray(right.src), np.asarray(right.dst),
+               np.asarray(right.weights))}
+    assert re_ == {(0, 1): 1.0}               # 3 and 4 share vertex 1
+    assert right.n == 2
+
+
+def test_vertex_metrics():
+    g = Graph.from_edges([(0, 1), (1, 2)], num_vertices=4)
+    m = g.vertex_metrics()
+    assert m["vertices"] == 4 and m["edges"] == 2
+    assert m["vertices_with_edges"] == 3       # vertex 3 is isolated
+    assert m["max_degree"] == 2                # vertex 1: in 1 + out 1
+    assert m["average_degree"] == pytest.approx(1.0)
+
+
+def test_similarity_sparse_branch_matches_dense():
+    """The n > 4096 sparse fallbacks must agree with the dense kernels on
+    the SAME edges (padding the vertex count flips the branch)."""
+    rng = np.random.default_rng(9)
+    e = np.stack([rng.integers(0, 50, 200), rng.integers(0, 50, 200)], 1)
+    small = Graph.from_edges(e, num_vertices=50)           # dense branch
+    big = Graph.from_edges(e, num_vertices=5000)           # sparse branch
+    np.testing.assert_allclose(big.adamic_adar(), small.adamic_adar(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(big.jaccard_similarity(),
+                               small.jaccard_similarity(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bipartite_dense_and_sparse_paths_agree():
+    rng = np.random.default_rng(11)
+    left, right, m = 30, 12, 150
+    e = np.stack([rng.integers(0, left, m),
+                  left + rng.integers(0, right, m)], 1)
+    dense = Graph.from_edges(e, num_vertices=left + right)
+    sparse = Graph.from_edges(e, num_vertices=left + 5000)  # big right side
+    for onto in (True, False):
+        a = dense.bipartite_projection(left, onto_left=onto)
+        b = sparse.bipartite_projection(left, onto_left=onto)
+        ea = {(int(s), int(d)): float(w) for s, d, w in
+              zip(np.asarray(a.src), np.asarray(a.dst),
+                  np.asarray(a.weights))}
+        eb = {(int(s), int(d)): float(w) for s, d, w in
+              zip(np.asarray(b.src), np.asarray(b.dst),
+                  np.asarray(b.weights))}
+        assert ea == eb, onto
+
+
+def test_empty_projection_has_typed_weights():
+    # no two left vertices share a right neighbor
+    g = Graph.from_edges([(0, 2), (1, 3)], num_vertices=4 + 5000)
+    p = g.bipartite_projection(left_size=2)
+    assert p.num_edges == 0
+    assert p.weights is not None and np.asarray(p.weights).shape == (0,)
