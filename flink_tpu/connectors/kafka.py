@@ -184,7 +184,17 @@ _ERR_UNKNOWN_TOPIC = 3
 _ERR_ILLEGAL_GENERATION = 22
 _ERR_UNKNOWN_MEMBER_ID = 25
 _ERR_REBALANCE_IN_PROGRESS = 27
+_ERR_UNSUPPORTED_SASL_MECHANISM = 33
+_ERR_ILLEGAL_SASL_STATE = 34
+_ERR_SASL_AUTHENTICATION_FAILED = 58
 _ERR_UNKNOWN = -1
+
+_API_SASL_HANDSHAKE = 17
+_API_SASL_AUTHENTICATE = 36
+
+
+class KafkaError(Exception):
+    """Broker-reported protocol error (auth failures, fatal responses)."""
 
 _API_PRODUCE, _API_FETCH, _API_LIST_OFFSETS = 0, 1, 2
 _API_METADATA, _API_VERSIONS = 3, 18
@@ -235,7 +245,13 @@ class KafkaWireBroker:
     message-set files and survive restarts."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 directory: Optional[str] = None, node_id: int = 0):
+                 directory: Optional[str] = None, node_id: int = 0,
+                 users: Optional[Dict[str, str]] = None):
+        #: SASL/PLAIN credentials (user -> password).  None = open broker;
+        #: set = every connection must complete SaslHandshake("PLAIN") +
+        #: SaslAuthenticate before any data/metadata API (unauthenticated
+        #: requests close the connection, as real brokers do)
+        self.users = users
         self.directory = directory
         self.node_id = node_id
         if directory:
@@ -359,6 +375,9 @@ class KafkaWireBroker:
 
     def _serve(self, conn: socket.socket) -> None:
         conn.settimeout(60)
+        # per-connection SASL session state (a real broker authenticates
+        # the CONNECTION, not individual requests)
+        state = {"authenticated": self.users is None, "mechanism": None}
         try:
             while not self._stop.is_set():
                 hdr = self._recv_exact(conn, 4)
@@ -368,7 +387,7 @@ class KafkaWireBroker:
                 frame = self._recv_exact(conn, size)
                 if frame is None:
                     return
-                resp = self._handle(frame)
+                resp = self._handle(frame, state)
                 if resp is None:
                     return                      # unsupported request: close
                 conn.sendall(struct.pack(">i", len(resp)) + resp)
@@ -391,13 +410,22 @@ class KafkaWireBroker:
         return buf
 
     # -- request dispatch --------------------------------------------------
-    def _handle(self, frame: bytes) -> Optional[bytes]:
+    def _handle(self, frame: bytes,
+                state: Optional[Dict[str, Any]] = None) -> Optional[bytes]:
+        if state is None:
+            # direct callers get the same auth posture as a fresh
+            # connection — defaulting to authenticated would silently
+            # bypass SASL on a credentialed broker
+            state = {"authenticated": self.users is None, "mechanism": None}
         r = _Reader(frame)
         api_key = r.int16()
         api_version = r.int16()
         correlation = r.int32()
         client_id = r.string()
         w = _Writer().int32(correlation)
+        if not state["authenticated"] and api_key not in (
+                _API_VERSIONS, _API_SASL_HANDSHAKE, _API_SASL_AUTHENTICATE):
+            return None  # real brokers drop unauthenticated connections
         if api_key == _API_VERSIONS:
             w.int16(_ERR_NONE).array(
                 [(_API_PRODUCE, 0, 3), (_API_FETCH, 0, 4),
@@ -405,8 +433,39 @@ class KafkaWireBroker:
                  (_API_OFFSET_COMMIT, 2, 2), (_API_OFFSET_FETCH, 1, 1),
                  (_API_FIND_COORDINATOR, 0, 0), (_API_JOIN_GROUP, 0, 0),
                  (_API_HEARTBEAT, 0, 0), (_API_LEAVE_GROUP, 0, 0),
-                 (_API_SYNC_GROUP, 0, 0), (_API_VERSIONS, 0, 0)],
+                 (_API_SYNC_GROUP, 0, 0), (_API_VERSIONS, 0, 0),
+                 # v1+ only: the v0 handshake's RAW post-handshake token
+                 # frames (no request header) are not spoken here
+                 (_API_SASL_HANDSHAKE, 1, 1),
+                 (_API_SASL_AUTHENTICATE, 0, 0)],
                 lambda w, t: w.int16(t[0]).int16(t[1]).int16(t[2]))
+        elif api_key == _API_SASL_HANDSHAKE:
+            mech = r.string() or ""
+            if mech.upper() != "PLAIN":
+                w.int16(_ERR_UNSUPPORTED_SASL_MECHANISM)
+            else:
+                state["mechanism"] = "PLAIN"
+                w.int16(_ERR_NONE)
+            w.array(["PLAIN"], lambda w, m: w.string(m))
+        elif api_key == _API_SASL_AUTHENTICATE:
+            # PLAIN token: [authzid] NUL user NUL password (RFC 4616)
+            token = r.bytes_() or b""
+            if state["mechanism"] != "PLAIN":
+                w.int16(_ERR_ILLEGAL_SASL_STATE) \
+                    .string("SaslHandshake must precede authentication") \
+                    .bytes_(b"")
+            else:
+                parts = token.split(b"\0")
+                user = parts[1].decode() if len(parts) == 3 else ""
+                pw = parts[2].decode() if len(parts) == 3 else ""
+                want = (self.users or {}).get(user)
+                if want is not None and pw == want:
+                    state["authenticated"] = True
+                    w.int16(_ERR_NONE).string(None).bytes_(b"")
+                else:
+                    w.int16(_ERR_SASL_AUTHENTICATION_FAILED) \
+                        .string(f"authentication failed for user "
+                                f"{user!r}").bytes_(b"")
         elif api_key == _API_METADATA:
             self._metadata(r, w)
         elif api_key == _API_PRODUCE and api_version == 0:
@@ -846,18 +905,70 @@ class KafkaWireClient:
     """Produce/fetch against any broker speaking the v0 dialect."""
 
     def __init__(self, host: str, port: int, client_id: str = "flink-tpu",
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, username: Optional[str] = None,
+                 password: str = ""):
         self.host, self.port = host, port
         self.client_id = client_id
         self.timeout_s = timeout_s
+        #: SASL/PLAIN credentials; when set, every (re)connection runs
+        #: SaslHandshake + SaslAuthenticate before the first data API
+        self.username = username
+        self.password = password
         self._sock: Optional[socket.socket] = None
         self._corr = 0
         self._lock = threading.Lock()
 
+    def _raw_call(self, s: socket.socket, api_key: int, api_version: int,
+                  body: bytes) -> _Reader:
+        """One request/response on an explicit socket — the single copy of
+        the frame-build/send/recv protocol IO (``_call`` layers locking and
+        connection lifecycle on top; the SASL exchange uses it directly
+        before ``self._sock`` is published).  Verifies the correlation id."""
+        self._corr += 1
+        corr = self._corr
+        frame = (_Writer().int16(api_key).int16(api_version)
+                 .int32(corr).string(self.client_id).raw(body).done())
+        s.sendall(struct.pack(">i", len(frame)) + frame)
+        hdr = KafkaWireBroker._recv_exact(s, 4)
+        if hdr is None:
+            raise OSError("broker closed connection")
+        (size,) = struct.unpack(">i", hdr)
+        resp = KafkaWireBroker._recv_exact(s, size)
+        if resp is None:
+            raise OSError("broker closed connection")
+        r = _Reader(resp)
+        got = r.int32()
+        if got != corr:
+            raise ValueError(f"correlation mismatch {got} != {corr}")
+        return r
+
+    def _sasl_authenticate(self, s: socket.socket) -> None:
+        r = self._raw_call(s, _API_SASL_HANDSHAKE, 1,
+                           _Writer().string("PLAIN").done())
+        err = r.int16()
+        if err != _ERR_NONE:
+            raise KafkaError(f"SASL handshake rejected (error {err})")
+        token = b"\0" + self.username.encode() + b"\0" \
+            + self.password.encode()
+        r = self._raw_call(s, _API_SASL_AUTHENTICATE, 0,
+                           _Writer().bytes_(token).done())
+        err = r.int16()
+        msg = r.string()
+        if err != _ERR_NONE:
+            raise KafkaError(msg or f"SASL authentication failed "
+                                    f"(error {err})")
+
     def _conn(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection((self.host, self.port),
-                                                  timeout=self.timeout_s)
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout_s)
+            if self.username is not None:
+                try:
+                    self._sasl_authenticate(s)
+                except BaseException:
+                    s.close()
+                    raise
+            self._sock = s
         return self._sock
 
     def close(self) -> None:
@@ -870,28 +981,12 @@ class KafkaWireClient:
 
     def _call(self, api_key: int, api_version: int, body: bytes) -> _Reader:
         with self._lock:
-            self._corr += 1
-            corr = self._corr
-            frame = (_Writer().int16(api_key).int16(api_version)
-                     .int32(corr).string(self.client_id).raw(body).done())
             s = self._conn()
             try:
-                s.sendall(struct.pack(">i", len(frame)) + frame)
-                hdr = KafkaWireBroker._recv_exact(s, 4)
-                if hdr is None:
-                    raise OSError("broker closed connection")
-                (size,) = struct.unpack(">i", hdr)
-                resp = KafkaWireBroker._recv_exact(s, size)
+                return self._raw_call(s, api_key, api_version, body)
             except OSError:
                 self.close()
                 raise
-        if resp is None:
-            raise OSError("short kafka response")
-        r = _Reader(resp)
-        got = r.int32()
-        if got != corr:
-            raise ValueError(f"correlation mismatch {got} != {corr}")
-        return r
 
     def api_versions(self) -> List[Tuple[int, int, int]]:
         r = self._call(_API_VERSIONS, 0, b"")
