@@ -116,6 +116,7 @@ class SubtaskBase:
 
     def _open_and_restore(self) -> None:
         self.operator.open(self.ctx)
+        self._opened = True
         if self._restore is not None and self._restore.get("operator") is not None:
             self.operator.restore_state(self._restore["operator"])
 
@@ -144,6 +145,8 @@ class SubtaskBase:
             # contain its contribution — restoring such a checkpoint must
             # not lose finished subtasks' state
             self.final_snapshot = self._final_snapshot()
+            self._closed = True   # before close(): a close() that raises
+            #                       mid-teardown must not be re-entered below
             self.operator.close()
             self._transition(TaskStates.FINISHED)
         except _Cancel:
@@ -151,6 +154,18 @@ class SubtaskBase:
         except Exception as e:  # noqa: BLE001
             traceback.print_exc()
             self._transition(TaskStates.FAILED, f"{type(e).__name__}: {e}")
+        finally:
+            # FAILED/CANCELED tasks must still release operator resources
+            # (managed-memory reservations, spill files, sockets): the slot's
+            # MemoryManager pool is reused across pipelined-region restarts,
+            # so a leaked reservation compounds until reserve_managed fails
+            # permanently inside open() (Task.releaseResources in the
+            # reference runs on every terminal state, not just FINISHED)
+            if getattr(self, "_opened", False) and not getattr(self, "_closed", False):
+                try:
+                    self.operator.close()
+                except Exception:  # noqa: BLE001
+                    pass  # teardown best-effort; original failure already reported
 
     def _invoke(self) -> None:
         raise NotImplementedError

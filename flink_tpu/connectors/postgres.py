@@ -339,7 +339,14 @@ class _MiniSql:
             txn.explicit = True
             return ("BEGIN", None, None)
         if up == "COMMIT":
-            self.srv._apply_staged(txn.staged)
+            # validate-then-apply, mirroring COMMIT PREPARED: a constraint
+            # violation must roll the whole txn back, not leave the rows
+            # staged before the offending one committed.  The (reentrant)
+            # server lock spans BOTH steps — a concurrent commit applying
+            # between validate and apply would re-introduce partial commits
+            with self.srv._lock:
+                self.srv._validate_staged(txn.staged)
+                self.srv._apply_staged(txn.staged)
             txn.reset()
             return ("COMMIT", None, None)
         if up == "ROLLBACK":
@@ -477,7 +484,15 @@ class _MiniSql:
             raise ValueError("malformed SELECT")
         proj, table, where, order, direction, limit = m.groups()
         with self.srv._lock:
-            t = self.srv.tables.get(table.lower())
+            if table.lower() == "pg_prepared_xacts":
+                # the catalog view real PostgreSQL exposes for dangling 2PC
+                # txns — materialized as a transient relation so the generic
+                # path below evaluates projections/aggregates/WHERE/ORDER/
+                # LIMIT on it like any other table
+                t = _Table("pg_prepared_xacts", ["gid"], ["text"],
+                           rows={"gid": sorted(self.srv.prepared)})
+            else:
+                t = self.srv.tables.get(table.lower())
             if t is None:
                 raise ValueError(f"relation {table} does not exist")
             mask = self._where_mask(t, where)
@@ -677,7 +692,12 @@ class PostgresWireServer:
 
     def _rollback_prepared(self, gid: str) -> None:
         with self._lock:
-            self.prepared.pop(gid, None)  # absent/committed -> no-op
+            if gid not in self.prepared:
+                # real PostgreSQL rejects rollback of an unknown gid — the
+                # restore path must enumerate pg_prepared_xacts, not probe
+                raise ValueError(f"prepared transaction with identifier "
+                                 f"{gid!r} does not exist")
+            self.prepared.pop(gid)
             if self.persist_dir:
                 try:
                     os.remove(self._gid_path(gid))
@@ -779,29 +799,37 @@ class PostgresWireClient:
                  password: str = "", database: str = "flink",
                  timeout: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
-        payload = struct.pack(">i", PROTOCOL_V3) + _cstr("user") \
-            + _cstr(user) + _cstr("database") + _cstr(database) + b"\0"
-        self.sock.sendall(struct.pack(">i", len(payload) + 4) + payload)
-        self.parameters: Dict[str, str] = {}
-        while True:
-            t, body = read_message(self.sock)
-            if t == b"R":
-                (code,) = struct.unpack(">i", body[:4])
-                if code == 0:
-                    continue
-                if code == 5:
-                    pw = md5_password(user, password, body[4:8])
-                    self.sock.sendall(_msg(b"p", _cstr(pw)))
-                    continue
-                raise PostgresError({"M": f"unsupported auth code {code}"})
-            if t == b"S":
-                k, v = body.split(b"\0")[:2]
-                self.parameters[k.decode()] = v.decode()
-            elif t == b"E":
-                raise PostgresError(self._error_fields(body))
-            elif t == b"Z":
-                return
-            # 'K' BackendKeyData and anything else: informational
+        try:
+            payload = struct.pack(">i", PROTOCOL_V3) + _cstr("user") \
+                + _cstr(user) + _cstr("database") + _cstr(database) + b"\0"
+            self.sock.sendall(struct.pack(">i", len(payload) + 4) + payload)
+            self.parameters: Dict[str, str] = {}
+            while True:
+                t, body = read_message(self.sock)
+                if t == b"R":
+                    (code,) = struct.unpack(">i", body[:4])
+                    if code == 0:
+                        continue
+                    if code == 5:
+                        pw = md5_password(user, password, body[4:8])
+                        self.sock.sendall(_msg(b"p", _cstr(pw)))
+                        continue
+                    raise PostgresError(
+                        {"M": f"unsupported auth code {code}"})
+                if t == b"S":
+                    k, v = body.split(b"\0")[:2]
+                    self.parameters[k.decode()] = v.decode()
+                elif t == b"E":
+                    raise PostgresError(self._error_fields(body))
+                elif t == b"Z":
+                    return
+                # 'K' BackendKeyData and anything else: informational
+        except BaseException:
+            # a failed handshake (auth rejection, protocol error) must not
+            # leak the connected socket — repeated failed connects would
+            # accumulate open FDs
+            self.sock.close()
+            raise
 
     @staticmethod
     def _error_fields(body: bytes) -> Dict[str, str]:
@@ -967,16 +995,26 @@ class PostgresSource(Source):
                 f"MAX({self.partition_column}), COUNT(*) FROM {self.table}")
         if int(cols["count"][0]) == 0 or cols["min"][0] is None:
             return []
-        lo, hi = float(cols["min"][0]), float(cols["max"][0])
+        lo_v, hi_v = cols["min"][0], cols["max"][0]
         n = max(1, n)
-        # JdbcNumericBetweenParametersProvider analog, but with HALF-OPEN
-        # real-valued boundaries [b_i, b_{i+1}) and a closed last split —
-        # integer-rounded inclusive ranges would silently drop fractional
-        # values of a float partition column falling between splits
-        if hi <= lo:
-            return [PostgresSplit(self, 0, 1, lo=cols["min"][0],
-                                  hi=cols["max"][0], hi_inclusive=True)]
-        bounds = [lo + (hi - lo) * i / n for i in range(n)] + [hi]
+        if hi_v <= lo_v:
+            return [PostgresSplit(self, 0, 1, lo=lo_v, hi=hi_v,
+                                  hi_inclusive=True)]
+        if isinstance(lo_v, (int, np.integer)) \
+                and isinstance(hi_v, (int, np.integer)):
+            # exact integer arithmetic (JdbcNumericBetweenParametersProvider):
+            # float() rounding of int8 values beyond 2^53 can push the lower
+            # bound above the true MIN and drop boundary rows from every split
+            # (Python ints: np.int64 would overflow on span * i)
+            lo_i, hi_i = int(lo_v), int(hi_v)
+            span = hi_i - lo_i + 1
+            bounds = [lo_i + span * i // n for i in range(n)] + [hi_i]
+        else:
+            lo, hi = float(lo_v), float(hi_v)
+            # HALF-OPEN real-valued boundaries [b_i, b_{i+1}) and a closed
+            # last split — integer-rounded inclusive ranges would silently
+            # drop fractional values falling between splits
+            bounds = [lo + (hi - lo) * i / n for i in range(n)] + [hi]
         splits = []
         for i in range(n):
             splits.append(PostgresSplit(
@@ -1157,13 +1195,26 @@ class PostgresSink(Sink):
         # commit the snapshot's staged epochs (their rows are part of the
         # restored checkpoint; COMMIT PREPARED replays idempotently), then
         # abort every OTHER dangling prepared txn of this sink — epochs
-        # staged after the restored checkpoint must not surface later
+        # staged after the restored checkpoint must not surface later.
+        # The dangling set is enumerated from pg_prepared_xacts (a real-PG
+        # catalog view), not probed by gid range: a restore arbitrarily far
+        # behind the crash still finds every orphan, and ROLLBACK PREPARED
+        # is only ever issued for gids that actually exist
+        committed = set()
         for entry in snap.get("staged", []):
             gid = entry[0] if isinstance(entry, (tuple, list)) else entry
             c.execute(f"COMMIT PREPARED '{gid}'")
+            committed.add(gid)
         self._staged = []
-        for e in range(self._epoch, self._epoch + 64):
-            c.execute(f"ROLLBACK PREPARED '{self._gid(e)}'")
+        mine = f"{self.sink_id}-s{self._subtask_index}-"
+        dangling = c.query_columns("SELECT gid FROM pg_prepared_xacts")
+        for gid in dangling.get("gid", []):
+            if gid is None or not gid.startswith(mine) or gid in committed:
+                continue
+            try:
+                c.execute(f"ROLLBACK PREPARED '{gid}'")
+            except PostgresError:
+                pass  # raced with another recovering instance: already gone
 
     def close(self) -> None:
         if self.exactly_once and self._in_txn and self._conn is not None:

@@ -219,6 +219,68 @@ def _batch(v):
     return RecordBatch({"v": np.asarray([v], np.float64)})
 
 
+def test_failed_task_still_closes_operator():
+    """A FAILED subtask must release operator resources (managed-memory
+    reservations, spill files): the slot's memory pool is reused across
+    pipelined-region restarts, so a leaked reservation compounds until
+    reserve_managed fails permanently inside open()."""
+
+    class _Boom(_SumOp):
+        def open(self, ctx):
+            super().open(ctx)
+            self.closed = 0
+
+        def process_batch(self, batch):
+            raise RuntimeError("induced failure")
+
+        def close(self):
+            self.closed += 1
+
+    class _Out:
+        channels = []
+
+        def emit(self, el):
+            pass
+
+    op = _Boom()
+    ch = LocalChannel(16)
+    rec = _Recorder()
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec, [ch])
+    t.start()
+    ch.put(_batch(1.0))
+    t.join()
+    assert ("FAILED", "RuntimeError: induced failure") in [
+        (s, e) for s, e in rec.states]
+    assert op.closed == 1
+
+
+def test_canceled_task_still_closes_operator():
+    class _Slow(_SumOp):
+        def open(self, ctx):
+            super().open(ctx)
+            self.closed = 0
+
+        def close(self):
+            self.closed += 1
+
+    class _Out:
+        channels = []
+
+        def emit(self, el):
+            pass
+
+    op = _Slow()
+    ch = LocalChannel(16)
+    rec = _Recorder()
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec, [ch])
+    t.start()
+    time.sleep(0.05)
+    t.cancel()
+    t.join()
+    assert any(s == "CANCELED" for s, _ in rec.states)
+    assert op.closed == 1
+
+
 def test_unaligned_barrier_overtakes_and_records_channel_state():
     ch0, ch1 = LocalChannel(16), LocalChannel(16)
     out = LocalChannel(64)

@@ -288,8 +288,39 @@ class TestSqlSurface:
             c.execute("COMMIT PREPARED 'gid-1'")
             with pytest.raises(PostgresError, match="does not exist"):
                 c.execute("COMMIT PREPARED 'never-prepared'")
-            # rollback of an absent gid is a no-op (restore-path hygiene)
-            c.execute("ROLLBACK PREPARED 'never-prepared'")
+            # rollback of an absent gid errors, matching real PostgreSQL —
+            # recovery code must enumerate pg_prepared_xacts instead
+            with pytest.raises(PostgresError, match="does not exist"):
+                c.execute("ROLLBACK PREPARED 'never-prepared'")
+
+    def test_pg_prepared_xacts_view(self, server):
+        with connect(server) as c:
+            c.execute("CREATE TABLE px (x int4)")
+            for gid in ("view-b", "view-a"):
+                c.execute("BEGIN")
+                c.execute("INSERT INTO px (x) VALUES (1)")
+                c.execute(f"PREPARE TRANSACTION '{gid}'")
+            cols = c.query_columns("SELECT gid FROM pg_prepared_xacts")
+            assert list(cols["gid"]) == ["view-a", "view-b"]
+            c.execute("ROLLBACK PREPARED 'view-a'")
+            c.execute("ROLLBACK PREPARED 'view-b'")
+            assert list(c.query_columns(
+                "SELECT gid FROM pg_prepared_xacts")["gid"]) == []
+
+    def test_plain_commit_is_atomic(self, server):
+        """A constraint violation inside COMMIT must roll back the WHOLE
+        txn — not leave the rows staged before the offending one applied."""
+        with connect(server) as c:
+            c.execute("CREATE TABLE ac (k int4 PRIMARY KEY)")
+            c.execute("INSERT INTO ac (k) VALUES (7)")
+            c.execute("BEGIN")
+            c.execute("INSERT INTO ac (k) VALUES (1)")
+            c.execute("INSERT INTO ac (k) VALUES (7)")   # will collide
+            c.execute("INSERT INTO ac (k) VALUES (2)")
+            with pytest.raises(PostgresError, match="duplicate key"):
+                c.execute("COMMIT")
+            assert c.query_columns(
+                "SELECT COUNT(*) FROM ac")["count"][0] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -324,6 +355,23 @@ class TestSourceSeam:
             for el in sp.read():
                 seen.extend(np.asarray(el.column("tag")).tolist())
         assert sorted(seen) == list(range(30))
+
+    def test_int8_splits_beyond_float53_cover_exactly(self, server):
+        """int8 partition bounds beyond 2^53: float() rounding would push
+        split boundaries past true MIN/MAX and silently drop boundary rows;
+        integer arithmetic must keep every row in exactly one split."""
+        with connect(server) as c:
+            c.execute("CREATE TABLE big (id int8, v int4)")
+            base = 2 ** 60 + 1
+            vals = ", ".join(f"({base + i * 997}, {i})" for i in range(20))
+            c.execute(f"INSERT INTO big (id, v) VALUES {vals}")
+        src = PostgresSource(server.host, server.port, "big",
+                             partition_column="id", batch_size=8)
+        seen = []
+        for sp in src.create_splits(4):
+            for el in sp.read():
+                seen.extend(np.asarray(el.column("v")).tolist())
+        assert sorted(seen) == list(range(20))
 
     def test_positioned_reader_resumes_mid_split(self, server):
         seed(server, 40)
@@ -476,6 +524,32 @@ class TestSinkSeam:
         with connect(server) as c:
             cols = c.query_columns("SELECT k FROM out3 ORDER BY k")
         assert cols["k"].tolist() == [1, 2]
+        restored.close()
+
+    def test_restore_far_behind_crash_cleans_all_danglers(self, server):
+        """Restoring to a checkpoint arbitrarily far behind the crash must
+        still find and roll back every dangling epoch: the restore path
+        enumerates pg_prepared_xacts instead of probing a bounded gid
+        window (70 dangling epochs > the old 64-epoch probe)."""
+        with connect(server) as c:
+            c.execute("CREATE TABLE deep (k int8)")
+        sink = PostgresSink(server.host, server.port, "deep",
+                            columns=["k"], exactly_once=True, sink_id="dp")
+        sink.write_batch(RecordBatch({"k": np.asarray([0], np.int64)}))
+        snap = sink.snapshot_state()          # epoch 0 @ checkpoint 1
+        for i in range(1, 71):                # 70 epochs past the checkpoint
+            sink.write_batch(RecordBatch({"k": np.asarray([i], np.int64)}))
+            sink.snapshot_state()
+        del sink                              # crash; none ever notified
+
+        restored = PostgresSink(server.host, server.port, "deep",
+                                columns=["k"], exactly_once=True,
+                                sink_id="dp")
+        restored.restore_state(snap)
+        assert server.list_prepared() == []   # every dangler rolled back
+        with connect(server) as c:
+            cols = c.query_columns("SELECT k FROM deep ORDER BY k")
+        assert cols["k"].tolist() == [0]      # only the restored epoch
         restored.close()
 
     def test_prepared_txns_survive_server_restart(self, tmp_path):
