@@ -125,6 +125,305 @@ class SqlJoinOperator(StreamOperator):
         self._right = ([RecordBatch(snap["right"])] if snap.get("right") else [])
 
 
+class _JoinSideState:
+    """One side of an unbounded streaming join: a row-instance table with a
+    per-key live index, association counts for outer padding, and optional
+    processing-time TTL (``JoinRecordStateView`` /
+    ``OuterJoinRecordStateView`` analog — state rows + numOfAssociations)."""
+
+    def __init__(self, columns: List[str], key_col: str):
+        self.columns = list(columns)
+        self.key_at = self.columns.index(key_col)
+        self.rows: List[Optional[tuple]] = []   # row tuples; None = freed
+        self.assoc: List[int] = []              # matches on the other side
+        self.ts: List[int] = []                 # last-touch ms (TTL)
+        self.by_key: Dict[Any, List[int]] = {}  # key -> live row indices
+        self.free: List[int] = []
+
+    def add(self, row: tuple, assoc: int, now_ms: int) -> int:
+        if self.free:
+            i = self.free.pop()
+            self.rows[i] = row
+            self.assoc[i] = assoc
+            self.ts[i] = now_ms
+        else:
+            i = len(self.rows)
+            self.rows.append(row)
+            self.assoc.append(assoc)
+            self.ts.append(now_ms)
+        self.by_key.setdefault(row[self.key_at], []).append(i)
+        return i
+
+    def remove_one(self, row: tuple) -> Optional[int]:
+        """Retract ONE instance equal to ``row``; returns its index (its
+        assoc count is still readable) or None if no instance is live."""
+        key = row[self.key_at]
+        idxs = self.by_key.get(key)
+        if not idxs:
+            return None
+        for pos, i in enumerate(idxs):
+            if self.rows[i] == row:
+                idxs.pop(pos)
+                if not idxs:
+                    del self.by_key[key]
+                self.rows[i] = None
+                self.free.append(i)
+                return i
+        return None
+
+    def matches(self, key: Any,
+                cutoff_ms: Optional[int] = None) -> List[int]:
+        """Live rows under ``key``; with a TTL cutoff, expired rows are
+        filtered at access time (exact semantics) while ``expire`` sweeps
+        reclaim their memory on an amortized cadence."""
+        idxs = self.by_key.get(key, [])
+        if cutoff_ms is None:
+            return idxs
+        return [i for i in idxs if self.ts[i] >= cutoff_ms]
+
+    def expire(self, cutoff_ms: int) -> int:
+        """Drop rows last touched before ``cutoff_ms`` (state TTL: silent
+        eviction, like the reference's StateTtlConfig on join state — no
+        retractions are emitted for expired rows)."""
+        dropped = 0
+        for key in list(self.by_key):
+            idxs = self.by_key[key]
+            keep = []
+            for i in idxs:
+                if self.ts[i] < cutoff_ms:
+                    self.rows[i] = None
+                    self.free.append(i)
+                    dropped += 1
+                else:
+                    keep.append(i)
+            if keep:
+                self.by_key[key] = keep
+            else:
+                del self.by_key[key]
+        return dropped
+
+    def snapshot(self) -> Dict[str, Any]:
+        live = [i for i, r in enumerate(self.rows) if r is not None]
+        return {
+            "cols": {c: np.asarray([self.rows[i][j] for i in live], object)
+                     for j, c in enumerate(self.columns)},
+            "assoc": np.asarray([self.assoc[i] for i in live], np.int64),
+            "ts": np.asarray([self.ts[i] for i in live], np.int64),
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        cols = [snap["cols"][c] for c in self.columns]
+        n = len(cols[0]) if cols else 0
+        self.rows = [tuple(col[i] for col in cols) for i in range(n)]
+        self.assoc = [int(a) for a in snap["assoc"]]
+        self.ts = [int(t) for t in snap["ts"]]
+        self.by_key = {}
+        self.free = []
+        for i, row in enumerate(self.rows):
+            self.by_key.setdefault(row[self.key_at], []).append(i)
+
+
+class StreamingJoinOperator(StreamOperator):
+    """Unbounded two-stream equi-join emitting an incremental CHANGELOG —
+    the ``StreamingJoinOperator`` analog
+    (``flink-table-runtime-blink/.../join/stream/StreamingJoinOperator.java:36``
+    with the ``JoinRecordStateView`` association counting of
+    ``OuterJoinRecordStateView.java``).
+
+    Both sides live in keyed state forever (or until ``state_ttl_ms``); each
+    arriving row emits joined rows immediately.  The ``op`` output column
+    carries the change kind: ``+I`` insert, ``-D`` delete, and the outer-join
+    padding transitions ride ``-U``/``+U`` pairs — when a null-padded outer
+    row gains its FIRST match the padded row downgrades out (``-U``) and the
+    joined row upgrades in (``+U``); losing the LAST match reverses it.
+    Inputs may themselves be changelogs: a batch with an ``op`` column
+    retracts on ``-D``/``-U`` and accumulates on ``+I``/``+U`` (RowKind
+    folding, ``AbstractStreamingJoinOperator.java``).
+
+    Append-only inner joins take a vectorized fast path (no association
+    bookkeeping is needed without padding): incoming batch keys hash-join
+    against the stored other side via ``_join_pairs`` in one shot.
+    """
+
+    is_two_input = True
+
+    def __init__(self, left_key: str, right_key: str, how: str = "inner",
+                 right_rename: Optional[Dict[str, str]] = None,
+                 left_columns: Optional[List[str]] = None,
+                 right_columns: Optional[List[str]] = None,
+                 state_ttl_ms: int = 0,
+                 name: str = "streaming-join"):
+        if left_columns is None or right_columns is None:
+            raise ValueError("streaming join requires declared schemas "
+                             "(outer padding cannot be inferred)")
+        self.left_key = left_key
+        self.right_key = right_key
+        self.how = how
+        self.right_rename = right_rename or {}
+        self.left_columns = list(left_columns)
+        self.right_columns = list(right_columns)
+        self.state_ttl_ms = state_ttl_ms
+        self.name = name
+        self._left = _JoinSideState(self.left_columns, left_key)
+        self._right = _JoinSideState(self.right_columns, right_key)
+        #: retractions for rows never accumulated (e.g. expired by TTL) are
+        #: dropped, counted here (the reference logs & skips the same way)
+        self.stale_retractions = 0
+        #: last full-expire sweep time: expiry is amortized (a sweep per
+        #: ttl/4, like the reference's timer-driven StateTtlConfig), never
+        #: an O(total state) scan on every batch
+        self._last_expire_ms = 0
+        self._out_columns = (["op"] + self.left_columns
+                             + [self.right_rename.get(c, c)
+                                for c in self.right_columns])
+
+    # -- helpers -------------------------------------------------------------
+    def _now_ms(self) -> int:
+        import time
+        return int(time.time() * 1000)
+
+    def _outer(self, side: int) -> bool:
+        """Is ``side`` (0=left, 1=right) an outer side (emits padding)?"""
+        return self.how in (("left", "full") if side == 0
+                            else ("right", "full"))
+
+    def _cutoff(self, now_ms: int) -> Optional[int]:
+        return (now_ms - self.state_ttl_ms) if self.state_ttl_ms > 0 else None
+
+    def _joined(self, op: str, lrow: Optional[tuple],
+                rrow: Optional[tuple]) -> tuple:
+        l = lrow if lrow is not None else (None,) * len(self.left_columns)
+        r = rrow if rrow is not None else (None,) * len(self.right_columns)
+        return (op,) + l + r
+
+    def _to_batch(self, out: List[tuple]) -> List[StreamElement]:
+        if not out:
+            return []
+        cols = {c: np.asarray([row[j] for row in out], object)
+                for j, c in enumerate(self._out_columns)}
+        return [RecordBatch(cols)]
+
+    # -- per-row semantics ---------------------------------------------------
+    def _accumulate(self, side: int, row: tuple, out: List[tuple],
+                    now_ms: int) -> None:
+        own = self._left if side == 0 else self._right
+        other = self._right if side == 0 else self._left
+        pair = ((lambda o, a, b: self._joined(o, a, b)) if side == 0
+                else (lambda o, a, b: self._joined(o, b, a)))
+        matches = list(other.matches(row[own.key_at], self._cutoff(now_ms)))
+        if matches:
+            for m in matches:
+                mrow = other.rows[m]
+                if self._outer(1 - side) and other.assoc[m] == 0:
+                    # the other side's null-padded row gains its first match:
+                    # downgrade the padding out, upgrade the joined row in
+                    out.append(pair("-U", None, mrow))
+                    out.append(pair("+U", row, mrow))
+                else:
+                    out.append(pair("+I", row, mrow))
+                other.assoc[m] += 1
+                other.ts[m] = now_ms
+        elif self._outer(side):
+            out.append(pair("+I", row, None))
+        own.add(row, len(matches), now_ms)
+
+    def _retract(self, side: int, row: tuple, out: List[tuple],
+                 now_ms: int) -> None:
+        own = self._left if side == 0 else self._right
+        other = self._right if side == 0 else self._left
+        pair = ((lambda o, a, b: self._joined(o, a, b)) if side == 0
+                else (lambda o, a, b: self._joined(o, b, a)))
+        if own.remove_one(row) is None:
+            self.stale_retractions += 1
+            return
+        matches = list(other.matches(row[own.key_at], self._cutoff(now_ms)))
+        if matches:
+            for m in matches:
+                mrow = other.rows[m]
+                other.assoc[m] -= 1
+                other.ts[m] = now_ms
+                if self._outer(1 - side) and other.assoc[m] == 0:
+                    # last match gone: the joined row downgrades out, the
+                    # null-padded row upgrades back in
+                    out.append(pair("-U", row, mrow))
+                    out.append(pair("+U", None, mrow))
+                else:
+                    out.append(pair("-D", row, mrow))
+        elif self._outer(side):
+            out.append(pair("-D", row, None))
+
+    # -- batch entry ---------------------------------------------------------
+    def process_batch2(self, batch: RecordBatch,
+                       input_index: int) -> List[StreamElement]:
+        if len(batch) == 0:
+            return []
+        now = self._now_ms()
+        if self.state_ttl_ms > 0 \
+                and now - self._last_expire_ms >= self.state_ttl_ms // 4:
+            self._last_expire_ms = now
+            cutoff = now - self.state_ttl_ms
+            self._left.expire(cutoff)
+            self._right.expire(cutoff)
+        own = self._left if input_index == 0 else self._right
+        col_names = own.columns
+        data = [np.asarray(batch.column(c)) for c in col_names]
+        ops = (np.asarray(batch.column("op"))
+               if "op" in batch.columns else None)
+        out: List[tuple] = []
+        if ops is None and self.how == "inner":
+            self._accumulate_append_inner(input_index, data, now, out)
+            return self._to_batch(out)
+        n = len(batch)
+        for i in range(n):
+            row = tuple(col[i] for col in data)
+            op = "+I" if ops is None else str(ops[i])
+            if op in ("+I", "+U"):
+                self._accumulate(input_index, row, out, now)
+            elif op in ("-D", "-U"):
+                self._retract(input_index, row, out, now)
+            else:
+                raise ValueError(f"unknown changelog op {op!r}")
+        return self._to_batch(out)
+
+    def _accumulate_append_inner(self, side: int, data: List[np.ndarray],
+                                 now_ms: int, out: List[tuple]) -> None:
+        """Vectorized append-only inner path: one hash join of the incoming
+        batch against the stored other side (no padding → no association
+        counts to maintain)."""
+        own = self._left if side == 0 else self._right
+        other = self._right if side == 0 else self._left
+        keys = data[own.key_at]
+        cut = self._cutoff(now_ms)
+        cand = [i for k in dict.fromkeys(keys.tolist())
+                for i in other.matches(k, cut)]
+        if cand:
+            other_keys = np.asarray([other.rows[i][other.key_at]
+                                     for i in cand], object)
+            bi, ci = _join_pairs(keys, other_keys)
+            for b, c in zip(bi.tolist(), ci.tolist()):
+                row = tuple(col[b] for col in data)
+                mrow = other.rows[cand[c]]
+                other.ts[cand[c]] = now_ms   # TTL touch, same as slow path
+                out.append(self._joined("+I", row, mrow) if side == 0
+                           else self._joined("+I", mrow, row))
+        for i in range(len(keys)):
+            own.add(tuple(col[i] for col in data), 0, now_ms)
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        return self.process_batch2(batch, 0)
+
+    # -- lifecycle -----------------------------------------------------------
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {"left": self._left.snapshot(),
+                "right": self._right.snapshot(),
+                "stale_retractions": self.stale_retractions}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._left.restore(snap["left"])
+        self._right.restore(snap["right"])
+        self.stale_retractions = int(snap.get("stale_retractions", 0))
+
+
 class ChangelogGroupAggOperator(StreamOperator):
     """Non-windowed group aggregate emitting a CHANGELOG (retraction) stream
     — the device-resident ``StreamExecGroupAggregate`` / ``GroupAggFunction``

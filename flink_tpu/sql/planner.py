@@ -350,10 +350,22 @@ class Planner:
         self.mini_batch_rows = mini_batch_rows
         #: rewrite-rule applications (rules.py), surfaced by EXPLAIN
         self.applied_rules: List[str] = []
+        #: set when a join planned as an UNBOUNDED streaming join: the query
+        #: output is a changelog (``op`` column) and must stay projection-only.
+        #: Both flags describe the MOST RECENT plan() call (reset at entry)
+        self._changelog_join = False
+        #: set when the plan reads any unbounded table (join or not) —
+        #: consumed at view/subquery boundaries so unboundedness propagates
+        self._unbounded_plan = False
 
     def plan(self, stmt) -> QueryPlan:
         from flink_tpu.sql.parser import UnionStmt
         from flink_tpu.sql.rules import apply_rules
+
+        # per-plan flags: a nested/earlier plan's changelog mode must not
+        # leak into this one (UNION branches, views share the Planner)
+        self._changelog_join = False
+        self._unbounded_plan = False
 
         # ---- logical rewrite stage (PlannerBase.translate's optimize step)
         stmt = apply_rules(stmt, self.catalog, self.applied_rules)
@@ -372,6 +384,12 @@ class Planner:
         except KeyError:
             raise PlanError(f"unknown table {stmt.table!r}; registered: "
                             f"{sorted(self.catalog)}")
+        if not table.bounded:
+            self._unbounded_plan = True
+        if getattr(table, "changelog", False):
+            # a changelog view/subquery feeds this query: same restrictions
+            # and op passthrough as a direct streaming join apply
+            self._changelog_join = True
         if stmt.joins:
             stream, table, qual_map, ambiguous = self._plan_joins(stmt, table)
             stmt = _rewrite_qualified(stmt, qual_map, ambiguous)
@@ -382,8 +400,12 @@ class Planner:
             stmt = _rewrite_qualified(stmt, qual_map)
             if stmt.scan_columns is not None:
                 # projection_prune rule: drop unreferenced columns at the
-                # scan, before any operator carries them
+                # scan, before any operator carries them ("op" always
+                # survives on changelogs: it is the row's change kind)
                 keep = tuple(stmt.scan_columns)
+                if self._changelog_join and "op" not in keep \
+                        and "op" in table.columns:
+                    keep = ("op",) + keep
                 stream = stream.map(
                     lambda cols, _k=keep: {c: cols[c] for c in _k},
                     name=f"sql-scan-prune[{','.join(keep)}]")
@@ -399,6 +421,22 @@ class Planner:
             else:
                 items.append(it)
 
+        if self._changelog_join:
+            # unbounded streaming join: the result is a CHANGELOG — the op
+            # column must survive projection, and row-reducing clauses have
+            # no meaning over an infinite retraction stream
+            if stmt.group_by or stmt.having is not None:
+                raise PlanError(
+                    "GROUP BY over an unbounded streaming JOIN changelog is "
+                    "not supported yet; aggregate before the join or use a "
+                    "windowed join")
+            if stmt.order_by or stmt.limit is not None:
+                raise PlanError("ORDER BY / LIMIT are not defined over an "
+                                "unbounded streaming JOIN result")
+            out_names_now = _output_names(items)
+            if "op" not in out_names_now:
+                items.insert(0, SelectItem(Column("op"), "op"))
+
         # ---- OVER aggregates (StreamExecOverAggregate): split out before
         # plain aggregate extraction; they append columns, not reduce rows
         over_specs: List[Tuple[str, OverCall]] = []
@@ -407,6 +445,9 @@ class Planner:
                                                 over_cache), it.alias)
                       for it in items]
         if over_specs:
+            if self._changelog_join:
+                raise PlanError("OVER aggregates over an unbounded streaming "
+                                "JOIN changelog are not supported yet")
             return self._plan_over(stream, items, over_items, over_specs,
                                    table, stmt)
 
@@ -447,6 +488,9 @@ class Planner:
             stream = stream.filter(lambda cols, _p=pred: np.asarray(
                 to_column(_p(cols), _n(cols)), bool), name="sql-where")
 
+        if self._changelog_join and agg_specs:
+            raise PlanError("aggregates over an unbounded streaming JOIN "
+                            "changelog are not supported yet")
         if not agg_specs:
             return self._plan_projection(stream, rewritten, table, stmt)
         return self._plan_aggregate(stream, rewritten, having, agg_specs,
@@ -462,7 +506,18 @@ class Planner:
         # mixed UNION/UNION ALL chains were restructured into nested
         # homogeneous unions by rules.union_associativity before lowering
         assert len(set(stmt.alls)) <= 1, "rewrite stage must run first"
-        plans = [self.plan(p) for p in stmt.parts]
+        plans, changelog, unbounded = [], False, False
+        for p in stmt.parts:
+            plans.append(self.plan(p))      # plan() resets the flags...
+            changelog |= self._changelog_join
+            unbounded |= self._unbounded_plan
+        # ...so re-assert the union of every branch's traits
+        self._changelog_join = changelog
+        self._unbounded_plan = unbounded
+        if changelog and not all(stmt.alls):
+            raise PlanError("UNION DISTINCT over a changelog stream is not "
+                            "defined (deduplication would break retraction "
+                            "pairing); use UNION ALL")
         base_cols = plans[0].output_columns
         streams = [plans[0].stream]
         for p in plans[1:]:
@@ -668,6 +723,11 @@ class Planner:
         if rank is not None:
             return rank
         inner = self.plan(stmt.table)
+        # the nested plan() just set the flags for the SUBQUERY — capture
+        # its traits before the outer plan() resets them, so unboundedness
+        # and changelog-ness survive the subquery boundary
+        inner_changelog = self._changelog_join
+        inner_unbounded = self._unbounded_plan
         inner_stream = inner.stream
         if inner.order_by or inner.limit is not None:
             # a subquery's ORDER BY/LIMIT are part of ITS result set — apply
@@ -686,7 +746,9 @@ class Planner:
                            columns=list(inner.output_columns),
                            stream_factory=lambda env: inner_stream,
                            rowtime=inner.rowtime,
-                           timestamps_assigned=inner.timestamps_assigned)
+                           timestamps_assigned=inner.timestamps_assigned,
+                           bounded=not inner_unbounded,
+                           changelog=inner_changelog)
         outer = _copy_stmt(stmt)
         outer.table = "<subquery>"
         outer.table_alias = stmt.table_alias
@@ -780,14 +842,37 @@ class Planner:
 
     # ------------------------------------------------------------ joins
     def _plan_joins(self, stmt: SelectStmt, base):
-        """FROM a JOIN b ON ... — equi-joins chained left-deep
-        (``StreamExecJoin`` over bounded inputs: emit at end of input)."""
+        """FROM a JOIN b ON ... — equi-joins chained left-deep.
+
+        Bounded inputs lower to ``SqlJoinOperator`` (``StreamExecJoin`` over
+        bounded inputs: emit at end of input).  If ANY input is unbounded,
+        every join in the chain lowers to the incremental
+        ``StreamingJoinOperator`` instead (``StreamExecJoin.java:61`` →
+        ``StreamingJoinOperator.java:36``): both sides live in keyed state
+        and the result is a changelog with an ``op`` column."""
         from flink_tpu.datastream.api import DataStream
         from flink_tpu.graph.transformations import (Partitioning,
                                                      Transformation)
-        from flink_tpu.operators.sql_ops import SqlJoinOperator
+        from flink_tpu.operators.sql_ops import (SqlJoinOperator,
+                                                 StreamingJoinOperator)
         from flink_tpu.sql.table_env import CatalogTable
 
+        def _traits(t):
+            return (not t.bounded) or getattr(t, "changelog", False)
+
+        streaming = _traits(base) or any(
+            _traits(self.catalog[jc.table])
+            for jc in stmt.joins if jc.table in self.catalog)
+        self._changelog_join = streaming
+        if streaming:
+            self._unbounded_plan = True
+
+        # a changelog input's "op" column is the row's change kind, not
+        # data: the join operator consumes it (retract on -D/-U) and must
+        # not store or re-emit it as a payload column
+        base_data_cols = [c for c in base.columns
+                          if not (c == "op"
+                                  and getattr(base, "changelog", False))]
         cur_stream = base.stream()
         if stmt.scan_filter is not None:
             # filter_pushdown rule: base-side WHERE conjuncts run pre-join
@@ -796,8 +881,8 @@ class Planner:
                                           f"sql-prejoin-filter:{stmt.table}")
         a0 = stmt.table_alias or stmt.table
         qual_map: Dict[Tuple[str, str], str] = {(a0, c): c
-                                                for c in base.columns}
-        out_names: List[str] = list(base.columns)
+                                                for c in base_data_cols}
+        out_names: List[str] = list(base_data_cols)
         ambiguous: set = set()
         for jc in stmt.joins:
             try:
@@ -806,8 +891,11 @@ class Planner:
                 raise PlanError(f"unknown table {jc.table!r} in JOIN")
             ralias = jc.alias or jc.table
             left_names = list(out_names)   # columns of the LEFT side only
+            rt_data_cols = [c for c in rt.columns
+                            if not (c == "op"
+                                    and getattr(rt, "changelog", False))]
             rename: Dict[str, str] = {}
-            for c in rt.columns:
+            for c in rt_data_cols:
                 nm = c if c not in out_names else f"{ralias}_{c}"
                 while nm in out_names:
                     nm += "_"
@@ -822,23 +910,32 @@ class Planner:
             if jc.pre_filter is not None:
                 rstream = self._pre_filter(rstream, rt.columns, jc.pre_filter,
                                            f"sql-prejoin-filter:{jc.table}")
+            cls = StreamingJoinOperator if streaming else SqlJoinOperator
+            op_cls = (lambda _cls=cls, _lk=lk, _rk=rk, _how=jc.kind,
+                      _rn=dict(rename), _lc=list(left_names),
+                      _rc=list(rt_data_cols):
+                      _cls(_lk, _rk, _how, _rn, left_columns=_lc,
+                           right_columns=_rc))
             t = Transformation(
-                name=f"sql-join:{jc.table}",
-                operator_factory=(lambda _lk=lk, _rk=rk, _how=jc.kind,
-                                  _rn=dict(rename), _lc=list(left_names),
-                                  _rc=list(rt.columns):
-                                  SqlJoinOperator(_lk, _rk, _how, _rn,
-                                                  left_columns=_lc,
-                                                  right_columns=_rc)),
+                name=(f"sql-streaming-join:{jc.table}" if streaming
+                      else f"sql-join:{jc.table}"),
+                operator_factory=op_cls,
                 inputs=[cur_stream.transformation, rstream.transformation],
                 input_partitionings=[Partitioning.HASH, Partitioning.HASH],
                 input_key_columns=[lk, rk],
                 parallelism=self.env.parallelism, chainable=False,
                 max_parallelism=self.env.max_parallelism)
             cur_stream = DataStream(self.env, t)
+        if streaming:
+            if "op" in out_names:
+                raise PlanError("streaming JOIN inputs must not have a "
+                                "column named 'op' (reserved for the "
+                                "changelog kind)")
+            out_names = ["op"] + out_names
         joined = CatalogTable(name="<join>", columns=out_names,
                               stream_factory=lambda env: cur_stream,
-                              timestamps_assigned=False)
+                              timestamps_assigned=False,
+                              bounded=not streaming, changelog=streaming)
         return cur_stream, joined, qual_map, ambiguous
 
     def _pre_filter(self, stream, columns, pred_expr: Expr, name: str):

@@ -29,6 +29,13 @@ class CatalogTable:
     rowtime: Optional[str] = None
     watermark_delay_ms: int = 0
     timestamps_assigned: bool = False
+    #: False = unbounded stream (a Kafka topic, a socket): joins over it
+    #: must use incremental streaming operators, never wait-for-end-of-input
+    bounded: bool = True
+    #: True = rows are a CHANGELOG (``op`` column carries +I/-U/+U/-D):
+    #: consumers must fold retractions, and aggregates/ORDER BY over the raw
+    #: rows are rejected (a -U row is not data)
+    changelog: bool = False
     _bound_env: Any = None
     #: lazy catalog statistics (row count + NDV) feeding the cost-based
     #: join reorder (sql/cost.py); computed on FIRST use — registration
@@ -70,8 +77,12 @@ class TableEnvironment:
                             columns: Optional[Mapping[str, Any]] = None,
                             rowtime: Optional[str] = None,
                             watermark_delay_ms: int = 0,
-                            batch_size: int = 4096) -> "Table":
-        """Register an in-memory bounded table (``fromValues`` analog)."""
+                            batch_size: int = 4096,
+                            bounded: bool = True) -> "Table":
+        """Register an in-memory table (``fromValues`` analog).
+        ``bounded=False`` declares it a stand-in for an unbounded stream:
+        queries over it plan with incremental streaming operators (e.g. the
+        changelog-emitting streaming join) instead of end-of-input ones."""
         if columns is not None:
             col_names = list(columns)
             data = {k: np.asarray(v) for k, v in columns.items()}
@@ -91,19 +102,21 @@ class TableEnvironment:
 
         ct = CatalogTable(name, col_names, factory, rowtime=rowtime,
                           watermark_delay_ms=watermark_delay_ms,
-                          stats_factory=make_stats)
+                          stats_factory=make_stats, bounded=bounded)
         self._catalog[name] = ct
         return Table(self, SelectStmt(items=[], table=name), ct)
 
     def register_source(self, name: str, source, columns: List[str],
                         rowtime: Optional[str] = None,
-                        watermark_delay_ms: int = 0) -> "Table":
+                        watermark_delay_ms: int = 0,
+                        bounded: bool = True) -> "Table":
         """Register any connector ``Source`` as a table."""
         def factory(env, _src=source):
             return env.from_source(_src, name=f"table:{name}")
 
         ct = CatalogTable(name, list(columns), factory, rowtime=rowtime,
-                          watermark_delay_ms=watermark_delay_ms)
+                          watermark_delay_ms=watermark_delay_ms,
+                          bounded=bounded)
         self._catalog[name] = ct
         return Table(self, SelectStmt(items=[], table=name), ct)
 
@@ -115,25 +128,34 @@ class TableEnvironment:
             plan = Planner(env, self._catalog).plan(_stmt)
             return plan.stream
 
-        cols = self._output_columns(stmt)
+        cols, changelog, unbounded = self._view_traits(stmt)
         # timestamps_assigned stays False: a windowed query OVER the view
         # names its own time column, and re-assigning watermarks from it is
         # always safe on bounded inputs (the view's own event-time handling,
         # if any, already happened inside its plan)
-        self._catalog[name] = CatalogTable(name, cols, factory)
+        self._catalog[name] = CatalogTable(name, cols, factory,
+                                           bounded=not unbounded,
+                                           changelog=changelog)
 
-    def _output_columns(self, stmt: SelectStmt) -> List[str]:
-        """Dry-plan on a throwaway env to learn a view's output schema."""
+    def _view_traits(self, stmt: SelectStmt):
+        """Dry-plan on a throwaway env to learn a view's output schema and
+        whether its rows are an (unbounded) changelog — unboundedness must
+        survive the view boundary or joins over it plan end-of-input."""
         from flink_tpu.datastream.api import StreamExecutionEnvironment
         env = StreamExecutionEnvironment(parallelism=self.parallelism,
                                          max_parallelism=self.max_parallelism)
         for t in self._catalog.values():
             t._bound_env = env
+        planner = Planner(env, self._catalog)
         try:
-            return Planner(env, self._catalog).plan(stmt).output_columns
+            cols = planner.plan(stmt).output_columns
+            return cols, planner._changelog_join, planner._unbounded_plan
         finally:
             for t in self._catalog.values():
                 t._bound_env = None
+
+    def _output_columns(self, stmt: SelectStmt) -> List[str]:
+        return self._view_traits(stmt)[0]
 
     # ---------------------------------------------------------------- query
     def register_sink_table(self, name: str, path: str,

@@ -1,0 +1,367 @@
+"""Unbounded streaming SQL join (StreamingJoinOperator).
+
+Golden property: at EVERY input prefix, materializing the emitted changelog
+(+I/+U add a row, -D/-U remove one) must equal a bounded recompute of the
+join over the rows seen so far — the defining contract of the reference's
+``StreamingJoinOperator`` (``flink-table-runtime-blink/.../join/stream/
+StreamingJoinOperator.java:36``).
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.operators.sql_ops import SqlJoinOperator, StreamingJoinOperator
+
+LCOLS = ["k", "x"]
+RCOLS = ["k2", "y"]
+RENAME = {"k2": "k2", "y": "y"}
+
+
+def lbatch(rows):
+    return RecordBatch({"k": np.asarray([r[0] for r in rows], object),
+                        "x": np.asarray([r[1] for r in rows], object)})
+
+
+def rbatch(rows):
+    return RecordBatch({"k2": np.asarray([r[0] for r in rows], object),
+                        "y": np.asarray([r[1] for r in rows], object)})
+
+
+def changelog_rows(elements):
+    out = []
+    for el in elements:
+        if isinstance(el, RecordBatch):
+            cols = list(el.columns)
+            arrs = [np.asarray(el.column(c)) for c in cols]
+            for i in range(len(el)):
+                out.append({c: a[i] for c, a in zip(cols, arrs)})
+    return out
+
+
+def materialize(view: Counter, rows):
+    """Apply changelog rows to the materialized multiset view."""
+    for r in rows:
+        op = r["op"]
+        key = tuple((c, r[c]) for c in sorted(r) if c != "op")
+        if op in ("+I", "+U"):
+            view[key] += 1
+        elif op in ("-D", "-U"):
+            view[key] -= 1
+            if view[key] == 0:
+                del view[key]
+        else:  # pragma: no cover
+            raise AssertionError(f"bad op {op}")
+    return view
+
+
+def bounded_recompute(how, lrows, rrows):
+    """Oracle: the bounded SqlJoinOperator over the same accumulated rows."""
+    op = SqlJoinOperator("k", "k2", how, dict(RENAME),
+                         left_columns=LCOLS, right_columns=RCOLS)
+    if lrows:
+        op.process_batch2(lbatch(lrows), 0)
+    if rrows:
+        op.process_batch2(rbatch(rrows), 1)
+    out = Counter()
+    for r in changelog_rows(op.end_input()):
+        key = tuple((c, r[c]) for c in sorted(r))
+        out[key] += 1
+    return out
+
+
+def strip_op_counter(view: Counter):
+    return Counter(dict(view))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+def test_prefix_equivalence_append_only(how):
+    """Interleaved append-only batches: after every batch the materialized
+    changelog equals the bounded recompute of the prefix."""
+    op = StreamingJoinOperator("k", "k2", how, dict(RENAME),
+                               left_columns=LCOLS, right_columns=RCOLS)
+    feed = [
+        (0, [("a", 1), ("b", 2)]),
+        (1, [("a", 10)]),
+        (1, [("a", 11), ("c", 30)]),
+        (0, [("a", 3), ("c", 4), ("c", 5)]),
+        (1, [("b", 20), ("b", 21)]),
+        (0, [("d", 6)]),
+        (1, [("a", 12)]),
+    ]
+    view = Counter()
+    lrows, rrows = [], []
+    for side, rows in feed:
+        (lrows if side == 0 else rrows).extend(rows)
+        emitted = op.process_batch2(lbatch(rows) if side == 0
+                                    else rbatch(rows), side)
+        materialize(view, changelog_rows(emitted))
+        assert view == bounded_recompute(how, lrows, rrows), \
+            f"{how}: prefix mismatch after {side}:{rows}"
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+def test_prefix_equivalence_with_retractions(how):
+    """Changelog INPUT (op column with -D rows): the view tracks the net
+    rows — retracting a row removes its joined rows and restores padding."""
+    op = StreamingJoinOperator("k", "k2", how, dict(RENAME),
+                               left_columns=LCOLS, right_columns=RCOLS)
+
+    def lb(rows, ops):
+        b = lbatch(rows)
+        cols = dict(b.columns)
+        cols["op"] = np.asarray(ops, object)
+        return RecordBatch(cols)
+
+    def rb(rows, ops):
+        b = rbatch(rows)
+        cols = dict(b.columns)
+        cols["op"] = np.asarray(ops, object)
+        return RecordBatch(cols)
+
+    feed = [
+        (0, [("a", 1), ("a", 2)], ["+I", "+I"]),
+        (1, [("a", 10), ("b", 20)], ["+I", "+I"]),
+        (0, [("a", 1)], ["-D"]),              # retract one left row
+        (1, [("a", 10)], ["-D"]),             # retract its match
+        (0, [("b", 3), ("a", 2)], ["+I", "-D"]),  # mixed batch
+        (1, [("b", 20)], ["-U"]),             # -U folds to retract
+        (1, [("c", 40)], ["+U"]),             # +U folds to accumulate
+    ]
+    view = Counter()
+    net_l, net_r = Counter(), Counter()
+    for side, rows, ops in feed:
+        tgt = net_l if side == 0 else net_r
+        for row, o in zip(rows, ops):
+            if o in ("+I", "+U"):
+                tgt[row] += 1
+            else:
+                tgt[row] -= 1
+        emitted = op.process_batch2(lb(rows, ops) if side == 0
+                                    else rb(rows, ops), side)
+        materialize(view, changelog_rows(emitted))
+        lrows = [r for r, c in net_l.items() for _ in range(c)]
+        rrows = [r for r, c in net_r.items() for _ in range(c)]
+        assert view == bounded_recompute(how, lrows, rrows), \
+            f"{how}: mismatch after {side}:{list(zip(rows, ops))}"
+
+
+def test_outer_padding_upgrade_downgrade_ops():
+    """The null-padding transitions ride -U/+U: first match upgrades the
+    padded row to a joined row; losing the last match downgrades back."""
+    op = StreamingJoinOperator("k", "k2", "left", dict(RENAME),
+                               left_columns=LCOLS, right_columns=RCOLS)
+    first = changelog_rows(op.process_batch2(lbatch([("a", 1)]), 0))
+    assert [r["op"] for r in first] == ["+I"]
+    assert first[0]["y"] is None              # padded
+    up = changelog_rows(op.process_batch2(rbatch([("a", 10)]), 1))
+    assert [r["op"] for r in up] == ["-U", "+U"]
+    assert up[0]["y"] is None and up[1]["y"] == 10
+    down = changelog_rows(op.process_batch2(
+        RecordBatch({"k2": np.asarray(["a"], object),
+                     "y": np.asarray([10], object),
+                     "op": np.asarray(["-D"], object)}), 1))
+    assert [r["op"] for r in down] == ["-U", "+U"]
+    assert down[0]["y"] == 10 and down[1]["y"] is None
+
+
+def test_snapshot_restore_mid_join():
+    """Kill-and-restore mid-stream: the restored operator continues the
+    changelog exactly where the snapshot left off."""
+    how = "full"
+    op = StreamingJoinOperator("k", "k2", how, dict(RENAME),
+                               left_columns=LCOLS, right_columns=RCOLS)
+    view = Counter()
+    materialize(view, changelog_rows(
+        op.process_batch2(lbatch([("a", 1), ("b", 2)]), 0)))
+    materialize(view, changelog_rows(
+        op.process_batch2(rbatch([("a", 10)]), 1)))
+    snap = op.snapshot_state()
+
+    restored = StreamingJoinOperator("k", "k2", how, dict(RENAME),
+                                     left_columns=LCOLS, right_columns=RCOLS)
+    restored.restore_state(snap)
+    materialize(view, changelog_rows(
+        restored.process_batch2(rbatch([("b", 20), ("a", 11)]), 1)))
+    materialize(view, changelog_rows(
+        restored.process_batch2(lbatch([("a", 3)]), 0)))
+    expected = bounded_recompute(
+        how, [("a", 1), ("b", 2), ("a", 3)],
+        [("a", 10), ("b", 20), ("a", 11)])
+    assert view == expected
+
+
+def test_state_ttl_expires_silently():
+    op = StreamingJoinOperator("k", "k2", "inner", dict(RENAME),
+                               left_columns=LCOLS, right_columns=RCOLS,
+                               state_ttl_ms=10_000)
+    op.process_batch2(lbatch([("a", 1)]), 0)
+    # age the stored left row past the TTL
+    op._left.ts = [t - 60_000 for t in op._left.ts]
+    out = changelog_rows(op.process_batch2(rbatch([("a", 10)]), 1))
+    assert out == []                      # expired row no longer joins
+    out2 = changelog_rows(op.process_batch2(lbatch([("a", 2)]), 0))
+    assert [r["op"] for r in out2] == ["+I"]  # fresh rows still join
+
+
+# ---------------------------------------------------------------------------
+# SQL-level wiring
+# ---------------------------------------------------------------------------
+
+
+def _collect_changelog(sql, bounded_left, bounded_right):
+    from flink_tpu.sql.table_env import TableEnvironment
+    tenv = TableEnvironment()
+    tenv.register_collection(
+        "orders", columns={"k": np.asarray(["a", "b", "a"], object),
+                           "x": np.asarray([1, 2, 3], object)},
+        batch_size=2, bounded=bounded_left)
+    tenv.register_collection(
+        "rates", columns={"k2": np.asarray(["a", "c"], object),
+                          "y": np.asarray([10, 30], object)},
+        batch_size=1, bounded=bounded_right)
+    return tenv, tenv.execute_sql(sql)
+
+
+def test_sql_unbounded_join_emits_changelog():
+    tenv, res = _collect_changelog(
+        "SELECT o.k, o.x, r.y FROM orders o JOIN rates r ON o.k = r.k2",
+        bounded_left=False, bounded_right=False)
+    rows = res.collect()
+    assert all(r["op"] in ("+I", "-U", "+U", "-D") for r in rows)
+    view = Counter()
+    materialize(view, rows)
+    final = {tuple(sorted(dict(k).items())) for k in view}
+    assert final == {(("k", "a"), ("x", 1), ("y", 10)),
+                     (("k", "a"), ("x", 3), ("y", 10))}
+    assert res.output_columns[0] == "op"
+
+
+def test_sql_unbounded_left_join_materializes_like_bounded():
+    sql = ("SELECT o.k, o.x, r.y FROM orders o "
+           "LEFT JOIN rates r ON o.k = r.k2")
+    _, stream_res = _collect_changelog(sql, False, False)
+    view = Counter()
+    materialize(view, stream_res.collect())
+    _, bounded_res = _collect_changelog(sql, True, True)
+    bview = Counter()
+    for r in bounded_res.collect():
+        key = tuple((c, r[c]) for c in sorted(r))
+        bview[key] += 1
+    final = Counter()
+    for k, c in view.items():
+        final[k] += c
+    assert final == bview
+
+
+def test_sql_bounded_join_keeps_batch_path():
+    _, res = _collect_changelog(
+        "SELECT o.k, o.x, r.y FROM orders o JOIN rates r ON o.k = r.k2",
+        bounded_left=True, bounded_right=True)
+    rows = res.collect()
+    assert "op" not in res.output_columns
+    assert sorted((r["k"], r["x"], r["y"]) for r in rows) == \
+        [("a", 1, 10), ("a", 3, 10)]
+
+
+def test_sql_unbounded_join_rejects_aggregates_and_order():
+    from flink_tpu.sql.planner import PlanError
+    with pytest.raises(PlanError, match="aggregates over an unbounded"):
+        _collect_changelog(
+            "SELECT SUM(o.x) FROM orders o JOIN rates r ON o.k = r.k2",
+            False, False)[1].collect()
+    with pytest.raises(PlanError, match="ORDER BY / LIMIT"):
+        _collect_changelog(
+            "SELECT o.k FROM orders o JOIN rates r ON o.k = r.k2 "
+            "ORDER BY o.k", False, False)[1].collect()
+
+
+def _tenv_three_tables(bounded):
+    from flink_tpu.sql.table_env import TableEnvironment
+    tenv = TableEnvironment()
+    tenv.register_collection(
+        "orders", columns={"k": np.asarray(["a", "b", "a"], object),
+                           "x": np.asarray([1, 2, 3], object)},
+        batch_size=2, bounded=bounded)
+    tenv.register_collection(
+        "rates", columns={"k2": np.asarray(["a", "c"], object),
+                          "y": np.asarray([10, 30], object)},
+        batch_size=1, bounded=bounded)
+    tenv.register_collection(
+        "m", columns={"k3": np.asarray(["a", "b"], object),
+                      "z": np.asarray([100, 200], object)})
+    return tenv
+
+
+def test_union_branch_does_not_leak_changelog_flag():
+    """A changelog branch planned before a plain branch must not poison the
+    plain branch's planning (the _changelog_join flag is per-plan state)."""
+    from flink_tpu.sql.planner import PlanError
+    tenv = _tenv_three_tables(bounded=False)
+    # changelog branch emits op + 3 cols, plain branch 3 cols: the honest
+    # error is the column-count mismatch, NOT an 'unknown column op' crash
+    with pytest.raises(PlanError, match="column count"):
+        tenv.execute_sql(
+            "SELECT o.k, o.x, r.y FROM orders o JOIN rates r ON o.k = r.k2 "
+            "UNION ALL SELECT k3, z, z FROM m").collect()
+    # and a plain query planned AFTER a changelog one stays plain
+    rows = tenv.execute_sql("SELECT k3, z FROM m").collect()
+    assert sorted(r["k3"] for r in rows) == ["a", "b"]
+
+
+def test_subquery_preserves_unboundedness():
+    """An unbounded changelog subquery joined again must plan a second
+    STREAMING join that folds the inner retractions — not the end-of-input
+    batch join (which would treat -U rows as data and never emit)."""
+    sql = ("SELECT s.k, s.x, s.y, m.z FROM "
+           "(SELECT o.k, o.x, r.y FROM orders o "
+           "LEFT JOIN rates r ON o.k = r.k2) s "
+           "JOIN m ON s.k = m.k3")
+    stream_rows = _tenv_three_tables(False).execute_sql(sql).collect()
+    assert stream_rows and all("op" in r for r in stream_rows)
+    view = Counter()
+    materialize(view, stream_rows)
+    bounded_rows = _tenv_three_tables(True).execute_sql(sql).collect()
+    bview = Counter()
+    for r in bounded_rows:
+        bview[tuple((c, r[c]) for c in sorted(r))] += 1
+    assert view == bview
+
+
+def test_aggregate_over_changelog_subquery_rejected():
+    from flink_tpu.sql.planner import PlanError
+    tenv = _tenv_three_tables(bounded=False)
+    with pytest.raises(PlanError, match="unbounded streaming JOIN"):
+        tenv.execute_sql(
+            "SELECT SUM(x) FROM (SELECT o.k, o.x, r.y FROM orders o "
+            "JOIN rates r ON o.k = r.k2) s").collect()
+
+
+def test_view_preserves_changelog_trait():
+    from flink_tpu.sql.planner import PlanError
+    tenv = _tenv_three_tables(bounded=False)
+    tenv.create_temporary_view(
+        "joined", tenv.sql_query(
+            "SELECT o.k, o.x, r.y FROM orders o JOIN rates r ON o.k = r.k2"))
+    assert tenv._catalog["joined"].changelog
+    assert not tenv._catalog["joined"].bounded
+    with pytest.raises(PlanError, match="unbounded streaming JOIN"):
+        tenv.execute_sql("SELECT SUM(x) FROM joined").collect()
+    rows = tenv.execute_sql("SELECT k, x, y FROM joined").collect()
+    view = Counter()
+    materialize(view, rows)
+    final = {tuple(sorted(dict(k).items())) for k in view}
+    assert final == {(("k", "a"), ("x", 1), ("y", 10)),
+                     (("k", "a"), ("x", 3), ("y", 10))}
+
+
+def test_sql_explain_shows_streaming_join():
+    from flink_tpu.sql.table_env import TableEnvironment
+    tenv = TableEnvironment()
+    tenv.register_collection("l", columns={"k": np.asarray([1, 2])},
+                             bounded=False)
+    tenv.register_collection("r", columns={"k2": np.asarray([1, 3])})
+    plan = tenv.explain_sql("SELECT l.k FROM l JOIN r ON l.k = r.k2")
+    assert "sql-streaming-join" in plan
