@@ -247,7 +247,10 @@ class CepOperator(StreamOperator):
 
     def __init__(self, pattern: Pattern, key_column: str,
                  select_fn: Callable[[Dict[str, List[dict]]], dict],
-                 name: str = "cep"):
+                 name: str = "cep",
+                 defer_conditions: bool = False,
+                 prev_columns: Optional[List[str]] = None,
+                 leftmost_order_column: Optional[str] = None):
         last = pattern.stages[-1]
         if last.negated and last.contiguity != "strict" \
                 and pattern.within_ms is None:
@@ -259,9 +262,24 @@ class CepOperator(StreamOperator):
         self.key_column = key_column
         self.select_fn = select_fn
         self.name = name
+        #: evaluate conditions at DRAIN time, per key over event-time-sorted
+        #: rows, instead of at arrival — required when conditions reference
+        #: order-dependent derived columns (MATCH_RECOGNIZE ``PREV(col)``:
+        #: ``__prev_<col>`` = the previous row of the same key in rowtime
+        #: order, which arrival order cannot provide)
+        self.defer_conditions = defer_conditions or bool(prev_columns)
+        self.prev_columns = list(prev_columns or [])
+        #: MATCH_RECOGNIZE determinism: when several branches complete on
+        #: the same event under SKIP PAST LAST ROW, SQL row-pattern
+        #: matching emits only the match attempt with the EARLIEST start
+        #: row (``SqlMatchRecognize`` leftmost semantics); CEP emits all.
+        #: Names the rowtime column used to order starts.
+        self.leftmost_order_column = leftmost_order_column
         self._nfas: Dict[Any, NFA] = {}
         #: per key: list of (ts, event_id, stage_bits, until_bits|None, row)
         self._buffers: Dict[Any, List] = {}
+        #: per key: last drained row (PREV continuity across drains)
+        self._last_row: Dict[Any, dict] = {}
         self._next_event_id = 0
         self.watermark = LONG_MIN
 
@@ -269,12 +287,16 @@ class CepOperator(StreamOperator):
         if len(batch) == 0:
             return []
         cols = batch.columns
-        # vectorized: all stage (and until) conditions over the whole batch
-        bits = np.stack([s.matches(cols) for s in self.pattern.stages], axis=1)
-        ubits = (np.stack([s.until_matches(cols)
-                           for s in self.pattern.stages], axis=1)
-                 if any(s.until is not None for s in self.pattern.stages)
-                 else None)
+        if self.defer_conditions:
+            bits = ubits = None
+        else:
+            # vectorized: all stage (and until) conditions over the batch
+            bits = np.stack([s.matches(cols) for s in self.pattern.stages],
+                            axis=1)
+            ubits = (np.stack([s.until_matches(cols)
+                               for s in self.pattern.stages], axis=1)
+                     if any(s.until is not None for s in self.pattern.stages)
+                     else None)
         keys = np.asarray(cols[self.key_column])
         ts = (np.asarray(batch.timestamps, np.int64)
               if batch.timestamps is not None
@@ -285,7 +307,7 @@ class CepOperator(StreamOperator):
             eid = self._next_event_id
             self._next_event_id += 1
             self._buffers.setdefault(k, []).append(
-                (int(ts[i]), eid, bits[i],
+                (int(ts[i]), eid, None if bits is None else bits[i],
                  None if ubits is None else ubits[i], rows[i]))
         if batch.timestamps is None:
             # processing-time style: no watermarks will come, match eagerly
@@ -319,6 +341,8 @@ class CepOperator(StreamOperator):
                 continue
             self._buffers[k] = [e for e in buf if e[0] > up_to_ts]
             ready.sort(key=lambda e: (e[0], e[1]))
+            if self.defer_conditions:
+                ready = self._evaluate_deferred(k, ready)
             nfa = self._nfas.get(k)
             if nfa is None:
                 nfa = self._nfas[k] = NFA(self.pattern)
@@ -329,7 +353,14 @@ class CepOperator(StreamOperator):
                 # happen between events (the within window closing)
                 for match, cts in nfa.harvest_expired_negations(ts):
                     emit(nfa, match, cts)
-                for match in nfa.advance(eid, ts, bits, ubits):
+                ms = nfa.advance(eid, ts, bits, ubits)
+                if len(ms) > 1 and self.leftmost_order_column is not None \
+                        and self.pattern.skip_strategy == \
+                        AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT:
+                    oc = self.leftmost_order_column
+                    ms = [min(ms, key=lambda m: (
+                        nfa._rows[m[0][1]].get(oc), m[0][1]))]
+                for match in ms:
                     emit(nfa, match, ts)
         # time-driven completions for EVERY key — including quiet ones whose
         # within window the watermark just closed
@@ -350,6 +381,39 @@ class CepOperator(StreamOperator):
                 for c in out_rows[0]}
         return [RecordBatch(cols, timestamps=np.asarray(out_ts, np.int64))]
 
+    def _evaluate_deferred(self, k, ready):
+        """Drain-time condition evaluation over the key's event-time-sorted
+        rows: inject ``__prev_<col>`` columns (the previous row's values in
+        ROWTIME order, seeded from the last drained row of this key), then
+        run every stage condition vectorized over the chunk."""
+        rows_ = [e[4] for e in ready]
+        cols = {c: np.asarray([r.get(c) for r in rows_])
+                for c in rows_[0]}
+        prev = self._last_row.get(k)
+        for c in self.prev_columns:
+            vals = []
+            p = prev
+            for r in rows_:
+                vals.append(p.get(c) if p is not None else None)
+                p = r
+            arr = np.asarray(vals, object)
+            try:
+                # numeric prevs: None -> NaN so ordering comparisons are
+                # well-defined (and False) on the partition's first row
+                arr = arr.astype(np.float64)
+            except (TypeError, ValueError):
+                pass
+            cols["__prev_" + c] = arr
+        self._last_row[k] = rows_[-1]
+        bits = np.stack([s.matches(cols) for s in self.pattern.stages],
+                        axis=1)
+        ubits = (np.stack([s.until_matches(cols)
+                           for s in self.pattern.stages], axis=1)
+                 if any(s.until is not None for s in self.pattern.stages)
+                 else None)
+        return [(ts, eid, bits[i], None if ubits is None else ubits[i], row)
+                for i, (ts, eid, _b, _u, row) in enumerate(ready)]
+
     # -- checkpointing -------------------------------------------------------
     def snapshot_state(self) -> Dict[str, Any]:
         return {
@@ -357,6 +421,7 @@ class CepOperator(StreamOperator):
             "nfas": {k: (n.partials, n.skip_until_ts,
                          getattr(n, "_rows", {}))
                      for k, n in self._nfas.items()},
+            "last_rows": dict(self._last_row),
             "next_event_id": self._next_event_id,
             "watermark": self.watermark,
         }
@@ -370,6 +435,7 @@ class CepOperator(StreamOperator):
             nfa.skip_until_ts = skip_ts
             nfa._rows = dict(rows)
             self._nfas[k] = nfa
+        self._last_row = dict(snap.get("last_rows", {}))
         self._next_event_id = snap["next_event_id"]
         self.watermark = snap["watermark"]
 

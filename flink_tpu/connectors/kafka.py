@@ -1097,11 +1097,18 @@ class KafkaWireSource:
     def __init__(self, host: str, port: int, topic: str,
                  timestamp_column: Optional[str] = None,
                  batch_rows: int = 1024,
-                 out_of_orderness_ms: Optional[int] = None):
+                 out_of_orderness_ms: Optional[int] = None,
+                 value_decoder=None):
         self.host, self.port = host, port
         self.topic = topic
         self.timestamp_column = timestamp_column
         self.batch_rows = batch_rows
+        #: optional ``bytes -> list[dict]`` record decoder replacing the
+        #: default one-JSON-object-per-value decode — the
+        #: DeserializationSchema seam; CDC envelope formats
+        #: (``flink_tpu.formats.cdc.cdc_decoder``) plug in here and may
+        #: emit several changelog rows per Kafka record
+        self.value_decoder = value_decoder
         #: emit Watermark(max_ts - bound) while reading; None = no in-read
         #: watermarks (offset order is NOT timestamp order on real topics —
         #: an unbounded per-chunk max would drop valid records as late; the
@@ -1166,7 +1173,10 @@ class KafkaWireSource:
                     offset = off + 1
                     if v is None:
                         continue         # tombstone: no row payload
-                    rows.append(json.loads(v.decode()))
+                    if self.value_decoder is not None:
+                        rows.extend(self.value_decoder(v))
+                    else:
+                        rows.append(json.loads(v.decode()))
                 while len(rows) >= self.batch_rows:
                     chunk, rows = rows[:self.batch_rows], rows[self.batch_rows:]
                     yield from self._emit(chunk, RecordBatch, Watermark,

@@ -1048,6 +1048,54 @@ class PostgresSource(Source):
             yield RecordBatch(chunk, timestamps=ts)
 
 
+class PostgresLookupFunction:
+    """Dimension point-lookup against a PostgreSQL server over the wire —
+    the ``JdbcRowDataLookupFunction`` analog feeding the SQL layer's
+    ``LookupJoinOperator`` (register via
+    ``TableEnvironment.register_lookup_table(name, fn, columns,
+    key_column)``).  One connection, lazily opened, re-opened on error;
+    caching lives in the operator, not here."""
+
+    def __init__(self, host: str, port: int, table: str, key_column: str,
+                 columns: Optional[List[str]] = None,
+                 user: str = "flink", password: str = ""):
+        self.host, self.port = host, port
+        self.table = table
+        self.key_column = key_column
+        self.columns = columns
+        self.user, self.password = user, password
+        self._conn: Optional[PostgresWireClient] = None
+
+    def _client(self) -> PostgresWireClient:
+        if self._conn is None:
+            self._conn = PostgresWireClient(self.host, self.port,
+                                            user=self.user,
+                                            password=self.password)
+        return self._conn
+
+    def __call__(self, key) -> List[dict]:
+        proj = ", ".join(self.columns) if self.columns else "*"
+        sql = (f"SELECT {proj} FROM {self.table} "
+               f"WHERE {self.key_column} = {_sql_literal(key)}")
+        try:
+            cols = self._client().query_columns(sql)
+        except (OSError, PostgresError):
+            # dropped connection: one reconnect-and-retry
+            self.close()
+            cols = self._client().query_columns(sql)
+        names = list(cols)
+        n = len(cols[names[0]]) if names else 0
+        return [{c: cols[c][i] for c in names} for i in range(n)]
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+
 class PostgresSink(Sink):
     """Buffered relational sink (``JdbcSink.sink`` /
     ``JdbcBatchingOutputFormat`` analog).

@@ -28,6 +28,21 @@ def _next_pow2_sql(n: int) -> int:
     return next_pow2(n, 64)
 
 
+def _infer_column(vals: List[Any]) -> np.ndarray:
+    """Row-dict values -> a column with a NATURAL dtype: numeric columns
+    must come out float/int (downstream jitted aggregates cannot consume
+    object arrays); None-padded or string columns stay object."""
+    if any(v is None for v in vals):
+        return np.asarray(vals, object)
+    try:
+        a = np.asarray(vals)
+    except (TypeError, ValueError):
+        return np.asarray(vals, object)
+    if a.dtype.kind in ("U", "S", "O"):
+        return np.asarray(vals, object)
+    return a
+
+
 class SqlJoinOperator(StreamOperator):
     """Bounded-table equi-join (``StreamExecJoin`` over bounded inputs):
     both sides buffer; the join emits once at end-of-input — batch SQL
@@ -424,6 +439,239 @@ class StreamingJoinOperator(StreamOperator):
         self.stale_retractions = int(snap.get("stale_retractions", 0))
 
 
+class LookupJoinOperator(StreamOperator):
+    """Dimension (lookup) join — the ``StreamExecLookupJoin`` /
+    ``LookupJoinRunner`` analog: each probe row looks its key up in an
+    EXTERNAL system (e.g. the wire-real Postgres connector) through a
+    TTL'd cache; the dimension is observed at processing time
+    (``FOR SYSTEM_TIME AS OF o.proctime`` semantics).
+
+    ``lookup_fn(key) -> list[dict]`` returns the dimension rows for a key
+    (empty list = no match).  The cache bounds external round-trips:
+    entries expire after ``cache_ttl_ms`` and the cache holds at most
+    ``max_cache_rows`` keys (LRU eviction), mirroring
+    ``LookupCacheManager`` / ``table.exec.lookup.cache`` options."""
+
+    def __init__(self, key_column: str,
+                 lookup_fn: Callable[[Any], List[dict]],
+                 right_columns: List[str],
+                 right_rename: Optional[Dict[str, str]] = None,
+                 how: str = "inner",
+                 cache_ttl_ms: int = 60_000,
+                 max_cache_rows: int = 10_000,
+                 name: str = "lookup-join"):
+        if how not in ("inner", "left"):
+            raise ValueError("lookup join supports INNER and LEFT only")
+        self.key_column = key_column
+        self.lookup_fn = lookup_fn
+        self.right_columns = list(right_columns)
+        self.right_rename = right_rename or {}
+        self.how = how
+        self.cache_ttl_ms = cache_ttl_ms
+        self.max_cache_rows = max_cache_rows
+        self.name = name
+        #: key -> (fetched_at_ms, rows); insertion order doubles as LRU
+        self._cache: Dict[Any, Tuple[int, List[dict]]] = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def _probe(self, key: Any, now_ms: int) -> List[dict]:
+        hit = self._cache.get(key)
+        if hit is not None and (self.cache_ttl_ms <= 0
+                                or now_ms - hit[0] < self.cache_ttl_ms):
+            self.cache_hits += 1
+            self._cache[key] = self._cache.pop(key)   # LRU touch
+            return hit[1]
+        self.cache_misses += 1
+        rows = list(self.lookup_fn(key))
+        self._cache.pop(key, None)
+        self._cache[key] = (now_ms, rows)
+        while len(self._cache) > self.max_cache_rows:
+            self._cache.pop(next(iter(self._cache)))
+        return rows
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        import time
+        if len(batch) == 0:
+            return []
+        now = int(time.time() * 1000)
+        keys = np.asarray(batch.column(self.key_column))
+        lcols = list(batch.columns)
+        larrs = [np.asarray(batch.column(c)) for c in lcols]
+        by_key = {k: self._probe(k, now)
+                  for k in dict.fromkeys(keys.tolist())}
+        out: List[dict] = []
+        for i in range(len(batch)):
+            matches = by_key[keys[i] if not isinstance(keys[i], np.generic)
+                             else keys[i].item()]
+            lrow = {c: a[i] for c, a in zip(lcols, larrs)}
+            if matches:
+                for m in matches:
+                    row = dict(lrow)
+                    for c in self.right_columns:
+                        row[self.right_rename.get(c, c)] = m.get(c)
+                    out.append(row)
+            elif self.how == "left":
+                row = dict(lrow)
+                for c in self.right_columns:
+                    row[self.right_rename.get(c, c)] = None
+                out.append(row)
+        if not out:
+            return []
+        cols = {c: _infer_column([r[c] for r in out]) for c in out[0]}
+        return [RecordBatch(cols)]
+
+    # the cache is NOT state: a restore re-probes the external system (the
+    # dimension may have changed; the reference's cache is also transient)
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._cache = {}
+
+
+class TemporalJoinOperator(StreamOperator):
+    """Event-time temporal (versioned-table) join — the
+    ``StreamExecTemporalJoin.java:67`` / ``TemporalRowTimeJoinOperator``
+    analog: the right side is a VERSIONED table (append stream of versions
+    keyed by ``right_key``, version time = ``right_time_column``); each
+    left row at time t joins the latest right version with
+    ``version_ts <= t``.  Left rows buffer until the watermark passes
+    their time (both inputs' watermarks merge through the two-input
+    valve), so late-arriving versions still win; versions older than the
+    one valid at the watermark are pruned (state cleanup,
+    ``TemporalRowTimeJoinOperator.cleanupState``)."""
+
+    is_two_input = True
+
+    def __init__(self, left_key: str, right_key: str,
+                 left_time_column: str, right_time_column: str,
+                 right_columns: List[str],
+                 right_rename: Optional[Dict[str, str]] = None,
+                 how: str = "inner",
+                 name: str = "temporal-join"):
+        if how not in ("inner", "left"):
+            raise ValueError("temporal join supports INNER and LEFT only")
+        self.left_key = left_key
+        self.right_key = right_key
+        self.left_time_column = left_time_column
+        self.right_time_column = right_time_column
+        self.right_columns = list(right_columns)
+        self.right_rename = right_rename or {}
+        self.how = how
+        self.name = name
+        #: right: key -> (sorted version ts list, parallel row list)
+        self._versions: Dict[Any, Tuple[List[int], List[dict]]] = {}
+        #: left rows waiting for the watermark: [(t, row), ...]
+        self._pending: List[Tuple[int, dict]] = []
+        self.watermark = LONG_MIN
+        self._wm_calls = 0
+
+    def process_batch2(self, batch: RecordBatch,
+                       input_index: int) -> List[StreamElement]:
+        import bisect
+        if len(batch) == 0:
+            return []
+        cols = list(batch.columns)
+        arrs = [np.asarray(batch.column(c)) for c in cols]
+        rows = [{c: a[i] for c, a in zip(cols, arrs)}
+                for i in range(len(batch))]
+        if input_index == 1:
+            for r in rows:
+                vts = int(r[self.right_time_column])
+                ts_list, row_list = self._versions.setdefault(
+                    r[self.right_key], ([], []))
+                i = bisect.bisect_right(ts_list, vts)
+                ts_list.insert(i, vts)
+                row_list.insert(i, r)
+            return []
+        for r in rows:
+            self._pending.append((int(r[self.left_time_column]), r))
+        return []
+
+    def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
+        return self.process_batch2(batch, 0)
+
+    def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
+        self.watermark = max(self.watermark, watermark.timestamp)
+        self._wm_calls += 1
+        if self._wm_calls % 64 == 0:
+            # amortized sweep for keys never probed (probe-time pruning
+            # below covers the active ones) — never a full scan per
+            # watermark on the hot path
+            self._prune_all(self.watermark)
+        return self._emit_ready(self.watermark)
+
+    def end_input(self) -> List[StreamElement]:
+        return self._emit_ready(2 ** 62)
+
+    def _emit_ready(self, up_to: int) -> List[StreamElement]:
+        import bisect
+        ready = [(t, r) for t, r in self._pending if t <= up_to]
+        if not ready:
+            return []
+        self._pending = [(t, r) for t, r in self._pending if t > up_to]
+        ready.sort(key=lambda e: e[0])
+        out: List[dict] = []
+        out_ts: List[int] = []
+        probed = set()
+        for t, lrow in ready:
+            key = lrow[self.left_key]
+            probed.add(key)
+            entry = self._versions.get(key)
+            i = bisect.bisect_right(entry[0], t) if entry else 0
+            if i > 0:
+                vrow = entry[1][i - 1]
+                row = dict(lrow)
+                for c in self.right_columns:
+                    row[self.right_rename.get(c, c)] = vrow.get(c)
+            elif self.how == "left":
+                row = dict(lrow)
+                for c in self.right_columns:
+                    row[self.right_rename.get(c, c)] = None
+            else:
+                continue
+            out.append(row)
+            out_ts.append(t)
+        if up_to < 2 ** 62:
+            for key in probed:        # lazy per-key state cleanup
+                self._prune_key(key, up_to)
+        if not out:
+            return []
+        cols = {c: _infer_column([r[c] for r in out]) for c in out[0]}
+        return [RecordBatch(cols, timestamps=np.asarray(out_ts, np.int64))]
+
+    def _prune_key(self, key, wm: int) -> None:
+        """Drop versions older than the one valid AT the watermark — they
+        can never be joined again (``TemporalRowTimeJoinOperator``'s state
+        cleanup)."""
+        import bisect
+        entry = self._versions.get(key)
+        if not entry:
+            return
+        ts_list, row_list = entry
+        cut = bisect.bisect_right(ts_list, wm) - 1
+        if cut > 0:
+            del ts_list[:cut]
+            del row_list[:cut]
+
+    def _prune_all(self, wm: int) -> None:
+        for key in list(self._versions):
+            self._prune_key(key, wm)
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {"versions": {k: (list(ts), list(rows))
+                             for k, (ts, rows) in self._versions.items()},
+                "pending": list(self._pending),
+                "watermark": self.watermark}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._versions = {k: (list(v[0]), list(v[1]))
+                          for k, v in snap["versions"].items()}
+        self._pending = list(snap["pending"])
+        self.watermark = snap["watermark"]
+
+
 class ChangelogGroupAggOperator(StreamOperator):
     """Non-windowed group aggregate emitting a CHANGELOG (retraction) stream
     — the device-resident ``StreamExecGroupAggregate`` / ``GroupAggFunction``
@@ -445,13 +693,33 @@ class ChangelogGroupAggOperator(StreamOperator):
 
     def __init__(self, key_column: str, agg_columns: Dict[str, Tuple[str, str]],
                  name: str = "changelog-group-agg",
-                 initial_capacity: int = 1 << 10):
-        """agg_columns: out_name -> (input column, how in sum/count/min/max)."""
+                 initial_capacity: int = 1 << 10,
+                 consume_retractions: bool = False):
+        """agg_columns: out_name -> (input column, how in sum/count/min/max).
+
+        ``consume_retractions=True``: the INPUT is itself a changelog (an
+        ``op`` column with +I/-U/+U/-D — a CDC ingress or an upstream
+        retracting operator); retraction rows contribute NEGATED values, a
+        hidden per-group row count detects group deletion (``-D`` emitted
+        when it reaches zero) and re-insertion (``+I``).  Only invertible
+        aggregates (sum/count) can consume retractions — min/max would
+        need the full value multiset (the reference's retract-agg rule)."""
         import jax.numpy as jnp  # noqa: F401 — device runtime
 
         for out, (_c, how) in agg_columns.items():
             if how not in self._MODES:
                 raise ValueError(f"unsupported changelog aggregate {how!r}")
+        self.consume_retractions = consume_retractions
+        self.output_names = list(agg_columns)
+        if consume_retractions:
+            bad = [o for o, (_c, how) in agg_columns.items()
+                   if self._MODES[how] != "add"]
+            if bad:
+                raise ValueError(
+                    f"aggregates {bad} cannot consume retractions "
+                    f"(min/max are not invertible); use sum/count")
+            agg_columns = dict(agg_columns)
+            agg_columns["__rows"] = (None, "count")   # hidden liveness count
         self.key_column = key_column
         self.agg_columns = agg_columns
         self.name = name
@@ -610,6 +878,12 @@ class ChangelogGroupAggOperator(StreamOperator):
         Bp = quantize_pow2(B, floor=64, steps=4)
         inv_p = np.zeros(Bp, np.int64)
         inv_p[:B] = inv
+        sign = None
+        if self.consume_retractions and "op" in batch.columns:
+            # retraction rows contribute negated values (invertible aggs
+            # only — enforced at construction)
+            ops = np.asarray(batch.column("op"))
+            sign = np.where(np.isin(ops, ["-D", "-U"]), -1.0, 1.0)
         values = {}
         for out, (col, how) in self.agg_columns.items():
             # Dekker split on the host: hi = f32(v), lo = f32(v - hi) — the
@@ -617,8 +891,9 @@ class ChangelogGroupAggOperator(StreamOperator):
             # through min/max and into compensated sums
             v64 = np.full(Bp, 0.0 if self._MODES[how] == "add"
                           else self._identity(how), np.float64)
-            v64[:B] = (1.0 if col is None
-                       else np.asarray(batch.column(col), np.float64))
+            vals = (1.0 if col is None
+                    else np.asarray(batch.column(col), np.float64))
+            v64[:B] = vals * sign if sign is not None else vals
             vhi = v64.astype(np.float32)
             with np.errstate(invalid="ignore"):  # inf - inf pads -> 0 below
                 vlo = (v64 - vhi.astype(np.float64)).astype(np.float32)
@@ -635,17 +910,17 @@ class ChangelogGroupAggOperator(StreamOperator):
                           + np.asarray(olds[i + 1], np.float64)[:U])
             news_f.append(np.asarray(news[i], np.float64)[:U]
                           + np.asarray(news[i + 1], np.float64)[:U])
+        names = list(self.agg_columns)
+        if self.consume_retractions:
+            return self._emit_retract_mode(names, uniq_slots, olds_f,
+                                           news_f, U)
         is_new = uniq_slots >= prev_n
         changed = ~is_new & np.logical_or.reduce(
             [o != n for o, n in zip(olds_f, news_f)])
         if not (is_new.any() or changed.any()):
             return []
-        rev = getattr(self, "_rev_cache", None)
-        if rev is None or len(rev) < self.key_index.num_keys:
-            # O(N) reverse-table copy only when new keys appeared
-            rev = self._rev_cache = np.asarray(self.key_index.reverse_keys())
+        rev = self._reverse_keys()
         out_rows: List[Dict[str, Any]] = []
-        names = list(self.agg_columns)
         for gi in range(U):
             key = rev[uniq_slots[gi]]
             if is_new[gi]:
@@ -660,6 +935,57 @@ class ChangelogGroupAggOperator(StreamOperator):
                                  **{names[j]: news_f[j][gi]
                                     for j in range(len(names))}})
         cols = {c: np.asarray([r[c] for r in out_rows]) for c in out_rows[0]}
+        return [RecordBatch(cols)]
+
+    def _reverse_keys(self):
+        rev = getattr(self, "_rev_cache", None)
+        if rev is None or len(rev) < self.key_index.num_keys:
+            # O(N) reverse-table copy only when new keys appeared
+            rev = self._rev_cache = np.asarray(self.key_index.reverse_keys())
+        return rev
+
+    def _emit_retract_mode(self, names, uniq_slots, olds_f, news_f,
+                           U: int) -> List[StreamElement]:
+        """Changelog-consuming emission: the hidden ``__rows`` count drives
+        group liveness — 0→n emits ``+I``, n→0 emits ``-D`` (with the OLD
+        values, the row downstream must revoke), live-and-changed emits the
+        ``-U``/``+U`` pair (``GroupAggFunction`` with
+        ``countIsZero``/``firstRow`` logic)."""
+        ri = names.index("__rows")
+        out_idx = [j for j, nm in enumerate(names) if nm != "__rows"]
+        old_r, new_r = olds_f[ri], news_f[ri]
+        val_changed = (np.logical_or.reduce(
+            [olds_f[j] != news_f[j] for j in out_idx])
+            if out_idx else np.zeros(U, bool))
+        appear = (old_r <= 0) & (new_r > 0)
+        disappear = (old_r > 0) & (new_r <= 0)
+        update = (old_r > 0) & (new_r > 0) & val_changed
+        if not (appear.any() or disappear.any() or update.any()):
+            return []
+        rev = self._reverse_keys()
+        onames = self.output_names
+        out_rows: List[Dict[str, Any]] = []
+        for gi in range(U):
+            key = rev[uniq_slots[gi]]
+            if appear[gi]:
+                out_rows.append({"op": "+I", self.key_column: key,
+                                 **{onames[j2]: news_f[out_idx[j2]][gi]
+                                    for j2 in range(len(onames))}})
+            elif disappear[gi]:
+                out_rows.append({"op": "-D", self.key_column: key,
+                                 **{onames[j2]: olds_f[out_idx[j2]][gi]
+                                    for j2 in range(len(onames))}})
+            elif update[gi]:
+                out_rows.append({"op": "-U", self.key_column: key,
+                                 **{onames[j2]: olds_f[out_idx[j2]][gi]
+                                    for j2 in range(len(onames))}})
+                out_rows.append({"op": "+U", self.key_column: key,
+                                 **{onames[j2]: news_f[out_idx[j2]][gi]
+                                    for j2 in range(len(onames))}})
+        if not out_rows:
+            return []
+        cols = {c: np.asarray([r[c] for r in out_rows])
+                for c in out_rows[0]}
         return [RecordBatch(cols)]
 
     def snapshot_state(self) -> Dict[str, Any]:

@@ -162,6 +162,39 @@ class JoinClause:
     #: set by the rewrite stage (rules.filter_pushdown): predicate applied
     #: to THIS input before the join (bare column names)
     pre_filter: Optional[Expr] = None
+    #: ``JOIN t FOR SYSTEM_TIME AS OF <expr>``: temporal (versioned-table)
+    #: or lookup (dimension) join — the time attribute of the LEFT row at
+    #: which the right side is observed (``SqlSnapshot`` /
+    #: ``StreamExecTemporalJoin`` / ``StreamExecLookupJoin``)
+    system_time_of: Optional[Expr] = None
+
+
+@dataclass
+class MatchStage:
+    """One PATTERN variable with its regex quantifier."""
+
+    var: str
+    quant_min: int = 1
+    quant_max: Optional[int] = 1   # None = unbounded (+ / *)
+    optional: bool = False         # ? or *
+
+
+@dataclass
+class MatchRecognizeClause:
+    """``MATCH_RECOGNIZE (PARTITION BY .. ORDER BY .. MEASURES ..
+    [ONE ROW PER MATCH] [AFTER MATCH SKIP ..] PATTERN (..)
+    [WITHIN INTERVAL ..] DEFINE ..)`` — the row-pattern clause of
+    ``SqlMatchRecognize`` (``flink-sql-parser``), lowered onto the CEP NFA
+    (``StreamExecMatch.java:90``)."""
+
+    partition_by: List[str]
+    order_by: str
+    measures: List[SelectItem]
+    pattern: List[MatchStage]
+    defines: dict                       # var -> Expr
+    after_match: str = "skip_to_next"   # skip_to_next | skip_past_last
+    within_ms: Optional[int] = None
+    alias: Optional[str] = None
 
 
 @dataclass
@@ -169,6 +202,8 @@ class SelectStmt:
     items: List[SelectItem]
     table: Optional[str]
     table_alias: Optional[str] = None
+    #: FROM <table> MATCH_RECOGNIZE ( ... ): row-pattern recognition
+    match: Optional["MatchRecognizeClause"] = None
     joins: List["JoinClause"] = field(default_factory=list)
     where: Optional[Expr] = None
     group_by: List[Expr] = field(default_factory=list)
@@ -182,6 +217,52 @@ class SelectStmt:
     #: cost-stage annotation (cost.py join_reorder): chosen join order +
     #: estimated cost; also the done-marker so the rule runs once
     join_order_cost: Optional[str] = None
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str                      # normalized SQL type text
+
+
+@dataclass
+class CreateTableStmt:
+    """``CREATE TABLE t (col TYPE, ..., [WATERMARK FOR c AS c - INTERVAL
+    ...,] [PRIMARY KEY (c) NOT ENFORCED]) WITH ('connector'='...', ...)`` —
+    the ``SqlCreateTable`` shape (``flink-sql-parser/.../ddl/
+    SqlCreateTable.java``)."""
+
+    name: str
+    columns: List[ColumnDef]
+    properties: dict                    # the WITH map, lower-cased keys
+    watermark_column: Optional[str] = None
+    watermark_delay_ms: int = 0
+    primary_key: Optional[str] = None
+    if_not_exists: bool = False
+
+
+@dataclass
+class CreateViewStmt:
+    name: str
+    query: object                       # SelectStmt | UnionStmt
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropStmt:
+    kind: str                           # 'TABLE' | 'VIEW'
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowTablesStmt:
+    pass
+
+
+@dataclass
+class DescribeStmt:
+    name: str
 
 
 #: aggregate function names the planner splits out of expressions
@@ -224,7 +305,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
   | (?P<qident>"[^"]+"|`[^`]+`)
-  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=])
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=?{}])
 """, re.VERBOSE)
 
 
@@ -302,6 +383,121 @@ class Parser:
         self.expect("EOF")
         return stmt
 
+    def parse_any(self):
+        """Query OR DDL statement (``executeSql`` dispatch surface)."""
+        if self.at_word("CREATE"):
+            return self.parse_create()
+        if self.at_word("DROP"):
+            return self.parse_drop()
+        if self.at_word("SHOW"):
+            self.next()
+            self.expect_word("TABLES")
+            self.expect("EOF")
+            return ShowTablesStmt()
+        if self.at_word("DESCRIBE") or self.at_word("DESC"):
+            self.next()
+            name = self.expect("IDENT").value
+            self.expect("EOF")
+            return DescribeStmt(name)
+        return self.parse_statement()
+
+    # -- DDL ----------------------------------------------------------------
+    def parse_create(self):
+        self.expect_word("CREATE")
+        self.accept_word("TEMPORARY")
+        if self.accept_word("VIEW"):
+            ine = self._if_not_exists()
+            name = self.expect("IDENT").value
+            self.expect("KEYWORD", "AS")
+            query = self.parse_union_chain()
+            self.expect("EOF")
+            return CreateViewStmt(name, query, ine)
+        self.expect_word("TABLE")
+        ine = self._if_not_exists()
+        name = self.expect("IDENT").value
+        self.expect("OP", "(")
+        cols: List[ColumnDef] = []
+        wm_col, wm_delay = None, 0
+        pkey = None
+        while True:
+            if self.accept_word("WATERMARK"):
+                self.expect_word("FOR")
+                wm_col = self.expect("IDENT").value
+                self.expect("KEYWORD", "AS")
+                e = self.parse_additive()
+                # `c` (delay 0) or `c - INTERVAL 'n' UNIT`
+                if isinstance(e, Binary) and e.op == "-" \
+                        and isinstance(e.right, Interval):
+                    wm_delay = e.right.ms
+                elif not isinstance(e, Column):
+                    raise SqlParseError(
+                        "WATERMARK expression must be <col> or "
+                        "<col> - INTERVAL '...' <unit>")
+            elif self.at_word("PRIMARY"):
+                self.next()
+                self.expect_word("KEY")
+                self.expect("OP", "(")
+                pkey = self.expect("IDENT").value
+                self.expect("OP", ")")
+                if self.accept("KEYWORD", "NOT"):
+                    self.expect_word("ENFORCED")
+            else:
+                cname = self.expect("IDENT").value
+                cols.append(ColumnDef(cname, self._parse_type()))
+            if not self.accept("OP", ","):
+                break
+        self.expect("OP", ")")
+        self.expect_word("WITH")
+        self.expect("OP", "(")
+        props = {}
+        while True:
+            k = self.expect("STRING").value
+            self.expect("OP", "=")
+            props[k.lower()] = self.expect("STRING").value
+            if not self.accept("OP", ","):
+                break
+        self.expect("OP", ")")
+        self.expect("EOF")
+        return CreateTableStmt(name, cols, props, wm_col, wm_delay, pkey, ine)
+
+    def _parse_type(self) -> str:
+        t = self.peek()
+        if t.kind == "KEYWORD" and t.value == "TIMESTAMP":
+            self.next()
+            base = "TIMESTAMP"
+        else:
+            base = self.expect("IDENT").value.upper()
+        if self.accept("OP", "("):
+            args = [self.expect("NUMBER").value]
+            while self.accept("OP", ","):
+                args.append(self.expect("NUMBER").value)
+            self.expect("OP", ")")
+            base += f"({', '.join(args)})"
+        return base
+
+    def _if_not_exists(self) -> bool:
+        if self.at_word("IF"):
+            self.next()
+            self.expect("KEYWORD", "NOT")
+            self.expect_word("EXISTS")
+            return True
+        return False
+
+    def parse_drop(self):
+        self.expect_word("DROP")
+        kind = "VIEW" if self.accept_word("VIEW") else None
+        if kind is None:
+            self.expect_word("TABLE")
+            kind = "TABLE"
+        ife = False
+        if self.at_word("IF"):
+            self.next()
+            self.expect_word("EXISTS")
+            ife = True
+        name = self.expect("IDENT").value
+        self.expect("EOF")
+        return DropStmt(kind, name, ife)
+
     def parse_union_chain(self):
         left = self.parse_select(expect_eof=False)
         parts = [left]
@@ -330,6 +526,7 @@ class Parser:
             items.append(self.parse_select_item())
         table = None
         table_alias = None
+        match_clause = None
         joins: List[JoinClause] = []
         if self.accept("KEYWORD", "FROM"):
             if self.accept("OP", "("):
@@ -337,7 +534,9 @@ class Parser:
                 self.expect("OP", ")")
             else:
                 table = self.expect("IDENT").value
-            if self.accept("KEYWORD", "AS"):
+            if self.at_word("MATCH_RECOGNIZE"):
+                match_clause = self.parse_match_recognize()
+            elif self.accept("KEYWORD", "AS"):
                 table_alias = self.expect("IDENT").value
             elif self.peek().kind == "IDENT":
                 table_alias = self.next().value
@@ -356,6 +555,12 @@ class Parser:
                     self.accept("KEYWORD", "OUTER")
                 self.expect("KEYWORD", "JOIN")
                 jt = self.expect("IDENT").value
+                sys_time = None
+                if self.accept_word("FOR"):
+                    self.expect_word("SYSTEM_TIME")
+                    self.expect("KEYWORD", "AS")
+                    self.expect_word("OF")
+                    sys_time = self.parse_additive()
                 jalias = None
                 if self.accept("KEYWORD", "AS"):
                     jalias = self.expect("IDENT").value
@@ -363,9 +568,10 @@ class Parser:
                     jalias = self.next().value
                 self.expect("KEYWORD", "ON")
                 on = self.parse_expr()
-                joins.append(JoinClause(jt, jalias, kind, on))
+                joins.append(JoinClause(jt, jalias, kind, on,
+                                        system_time_of=sys_time))
         stmt = SelectStmt(items=items, table=table, table_alias=table_alias,
-                          joins=joins)
+                          joins=joins, match=match_clause)
         if self.accept("KEYWORD", "WHERE"):
             stmt.where = self.parse_expr()
         if self.accept("KEYWORD", "GROUP"):
@@ -554,6 +760,102 @@ class Parser:
             return Column(name, table=qualifier)
         raise SqlParseError(f"unexpected token {t.value or t.kind!r} at {t.pos}")
 
+    def parse_match_recognize(self) -> MatchRecognizeClause:
+        """``MATCH_RECOGNIZE ( ... ) [AS alias]`` — clause words are
+        contextual (IDENT tokens), matching Calcite's non-reserved
+        treatment, so MEASURES/PATTERN/DEFINE stay usable as column
+        names elsewhere."""
+        self.expect_word("MATCH_RECOGNIZE")
+        self.expect("OP", "(")
+        partition_by: List[str] = []
+        order_by = None
+        measures: List[SelectItem] = []
+        after_match = "skip_to_next"
+        pattern: List[MatchStage] = []
+        defines: dict = {}
+        within_ms = None
+        if self.accept("KEYWORD", "PARTITION"):
+            self.expect("KEYWORD", "BY")
+            partition_by.append(self.expect("IDENT").value)
+            while self.accept("OP", ","):
+                partition_by.append(self.expect("IDENT").value)
+        if self.accept("KEYWORD", "ORDER"):
+            self.expect("KEYWORD", "BY")
+            order_by = self.expect("IDENT").value
+            self.accept("KEYWORD", "ASC")
+        if order_by is None:
+            raise SqlParseError("MATCH_RECOGNIZE requires ORDER BY")
+        if self.accept_word("MEASURES"):
+            measures.append(self.parse_select_item())
+            while self.accept("OP", ","):
+                measures.append(self.parse_select_item())
+        if self.accept_word("ONE"):
+            self.expect_word("ROW")
+            self.expect_word("PER")
+            self.expect_word("MATCH")
+        elif self.accept("KEYWORD", "ALL"):
+            raise SqlParseError("ALL ROWS PER MATCH is not supported "
+                                "(use ONE ROW PER MATCH)")
+        if self.accept_word("AFTER"):
+            self.expect_word("MATCH")
+            self.expect_word("SKIP")
+            if self.accept_word("PAST"):
+                self.expect_word("LAST")
+                self.expect_word("ROW")
+                after_match = "skip_past_last"
+            elif self.accept_word("TO"):
+                self.expect_word("NEXT")
+                self.expect_word("ROW")
+                after_match = "skip_to_next"
+            else:
+                raise SqlParseError("AFTER MATCH SKIP must be PAST LAST ROW "
+                                    "or TO NEXT ROW")
+        self.expect_word("PATTERN")
+        self.expect("OP", "(")
+        while not self.accept("OP", ")"):
+            var = self.expect("IDENT").value
+            st = MatchStage(var)
+            if self.accept("OP", "+"):
+                st = MatchStage(var, 1, None)
+            elif self.accept("OP", "*"):
+                st = MatchStage(var, 1, None, optional=True)
+            elif self.accept("OP", "?"):
+                st = MatchStage(var, 1, 1, optional=True)
+            elif self.accept("OP", "{"):
+                lo = int(self.expect("NUMBER").value)
+                hi = lo
+                if self.accept("OP", ","):
+                    # {n,} = at least n; {n,m} = between n and m
+                    hi = (int(self.next().value)
+                          if self.peek().kind == "NUMBER" else None)
+                self.expect("OP", "}")
+                st = MatchStage(var, lo, hi)
+            pattern.append(st)
+        if not pattern:
+            raise SqlParseError("PATTERN must name at least one variable")
+        if self.accept_word("WITHIN"):
+            e = self.parse_primary()
+            if not isinstance(e, Interval):
+                raise SqlParseError("WITHIN takes INTERVAL '...' <unit>")
+            within_ms = e.ms
+        self.expect_word("DEFINE")
+        while True:
+            var = self.expect("IDENT").value
+            self.expect("KEYWORD", "AS")
+            defines[var.upper()] = self.parse_expr()
+            if not self.accept("OP", ","):
+                break
+        self.expect("OP", ")")
+        alias = None
+        if self.accept("KEYWORD", "AS"):
+            alias = self.expect("IDENT").value
+        elif self.peek().kind == "IDENT":
+            alias = self.next().value
+        return MatchRecognizeClause(
+            partition_by=partition_by, order_by=order_by, measures=measures,
+            pattern=pattern, defines=defines, after_match=after_match,
+            within_ms=within_ms, alias=alias)
+
     def parse_over(self, call: Expr) -> "OverCall":
         self.expect("KEYWORD", "OVER")
         self.expect("OP", "(")
@@ -658,3 +960,8 @@ def _timestamp_to_ms(s: str) -> int:
 def parse(sql: str):
     """-> SelectStmt | UnionStmt."""
     return Parser(sql.strip().rstrip(";")).parse_statement()
+
+
+def parse_any(sql: str):
+    """-> query statement OR a DDL statement (CREATE/DROP/SHOW/DESCRIBE)."""
+    return Parser(sql.strip().rstrip(";")).parse_any()

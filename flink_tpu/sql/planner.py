@@ -390,7 +390,18 @@ class Planner:
             # a changelog view/subquery feeds this query: same restrictions
             # and op passthrough as a direct streaming join apply
             self._changelog_join = True
-        if stmt.joins:
+        if stmt.match is not None:
+            if stmt.joins:
+                raise PlanError("MATCH_RECOGNIZE cannot be combined with "
+                                "JOIN in one FROM clause (use a view)")
+            if self._changelog_join:
+                raise PlanError("MATCH_RECOGNIZE over a changelog stream "
+                                "is not supported (the NFA cannot fold "
+                                "-U/-D retractions); materialize the "
+                                "changelog first")
+            stream, table, qual_map = self._plan_match(stmt, table)
+            stmt = _rewrite_qualified(stmt, qual_map)
+        elif stmt.joins:
             stream, table, qual_map, ambiguous = self._plan_joins(stmt, table)
             stmt = _rewrite_qualified(stmt, qual_map, ambiguous)
         else:
@@ -840,6 +851,164 @@ class Planner:
         return QueryPlan(out, names, _order_names(stmt, outer_items, names),
                          stmt.limit)
 
+    # --------------------------------------------------- MATCH_RECOGNIZE
+    def _plan_match(self, stmt: SelectStmt, table):
+        """Lower ``MATCH_RECOGNIZE`` onto the CEP NFA operator — the
+        ``StreamExecMatch.java:90`` → ``CepOperator`` path.  PATTERN
+        variables become strict-contiguity NFA stages (a row not attributed
+        to any variable kills the attempt, unlike CEP's relaxed
+        ``followedBy``); DEFINE conditions compile to vectorized columnar
+        closures with ``PREV(col)`` resolved to a drain-time
+        ``__prev_<col>`` column; MEASURES evaluate per match."""
+        from flink_tpu.cep.operator import CepOperator
+        from flink_tpu.cep.pattern import (AfterMatchSkipStrategy, Pattern,
+                                           Stage)
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.sql.table_env import CatalogTable
+
+        mr = stmt.match
+        if len(mr.partition_by) > 1:
+            raise PlanError("MATCH_RECOGNIZE supports a single PARTITION BY "
+                            "column")
+        for c in mr.partition_by + [mr.order_by]:
+            if c not in table.columns:
+                raise PlanError(f"MATCH_RECOGNIZE: unknown column {c!r}")
+        if table.rowtime is not None and mr.order_by != table.rowtime:
+            raise PlanError(f"MATCH_RECOGNIZE ORDER BY must be the rowtime "
+                            f"column {table.rowtime!r}")
+        var_names = [st.var.upper() for st in mr.pattern]
+        if len(set(var_names)) != len(var_names):
+            raise PlanError("duplicate PATTERN variable")
+        for v in mr.defines:
+            if v not in var_names:
+                raise PlanError(f"DEFINE names unknown variable {v!r}")
+
+        prev_cols: List[str] = []
+        stages: List[Stage] = []
+        cond_schema = dict.fromkeys(
+            list(table.columns) + [f"__prev_{c}" for c in table.columns])
+        for st in mr.pattern:
+            cond = None
+            cexpr = mr.defines.get(st.var.upper())
+            if cexpr is not None:
+                rewritten = self._rewrite_match_define(
+                    cexpr, set(var_names), table.columns, prev_cols)
+                fn = ExprCompiler(cond_schema).compile(rewritten)
+                cond = (lambda cols, _f=fn: np.asarray(
+                    to_column(_f(cols), _n(cols)), bool))
+            stages.append(Stage(
+                st.var.upper(), condition=cond, contiguity="strict",
+                times_min=max(st.quant_min, 1),
+                # {0,n} / {0,}: a zero lower bound means the variable may
+                # match no rows at all — optional, not mandatory-once
+                times_max=st.quant_max,
+                optional=st.optional or st.quant_min == 0,
+                # SQL quantifiers are greedy by default: a looping variable
+                # takes every row it can before the next variable starts
+                greedy=(st.quant_max is None
+                        or st.quant_max != st.quant_min)))
+        pattern = Pattern(
+            stages, within_ms=mr.within_ms,
+            skip_strategy=(AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT
+                           if mr.after_match == "skip_past_last"
+                           else AfterMatchSkipStrategy.NO_SKIP))
+
+        part = mr.partition_by[0] if mr.partition_by else None
+        measure_names, measure_exprs = [], []
+        vset = set(var_names)
+        for it in mr.measures:
+            self._validate_measure(it.expr, vset, table.columns)
+            measure_names.append(it.alias or expr_name(it.expr))
+            measure_exprs.append(it.expr)
+        out_cols = ([part] if part else []) + measure_names
+        select_fn = _make_measure_fn(measure_names, measure_exprs,
+                                     var_names, part)
+
+        stream = table.stream()
+        if not table.timestamps_assigned:
+            stream = stream.assign_timestamps_and_watermarks(
+                table.watermark_delay_ms, timestamp_column=mr.order_by,
+                name="sql-match-rowtime")
+        if part is None:
+            # no PARTITION BY: one global NFA (constant key, dropped after)
+            stream = stream.map(
+                lambda cols: {**cols, "__match_pk": np.zeros(
+                    _n(cols), np.int64)}, name="sql-match-global-key")
+            key_col = "__match_pk"
+        else:
+            key_col = part
+        keyed = stream.key_by(key_col)
+        t = keyed._then(
+            "sql-match-recognize",
+            lambda _p=pattern, _k=key_col, _s=select_fn, _pc=list(prev_cols),
+            _oc=mr.order_by:
+            CepOperator(_p, _k, _s, name="sql-match-recognize",
+                        defer_conditions=True, prev_columns=_pc,
+                        leftmost_order_column=_oc),
+            chainable=False)
+        out_stream = DataStream(keyed.env, t)
+        alias = mr.alias or stmt.table_alias or stmt.table
+        qual_map = {(alias, c): c for c in out_cols}
+        out_table = CatalogTable(name="<match>", columns=out_cols,
+                                 stream_factory=lambda env: out_stream,
+                                 timestamps_assigned=True,
+                                 bounded=table.bounded)
+        if not table.bounded:
+            self._unbounded_plan = True
+        return out_stream, out_table, qual_map
+
+    def _validate_measure(self, expr: Expr, var_names: set,
+                          columns: List[str]) -> None:
+        """Plan-time checks for MEASURES: every variable qualifier must be a
+        PATTERN variable and every column must exist (runtime evaluation is
+        per-match and would surface these lazily otherwise)."""
+        def fn(e: Expr):
+            if isinstance(e, Column) and e.table is not None:
+                if e.table.upper() not in var_names:
+                    raise PlanError(f"{e.table}.{e.name}: unknown pattern "
+                                    f"variable in MEASURES")
+                if e.name not in columns:
+                    raise PlanError(f"MEASURES: unknown column {e.name!r}")
+            return None
+        _transform(expr, fn)
+
+    def _rewrite_match_define(self, expr: Expr, var_names: set,
+                              columns: List[str],
+                              prev_cols: List[str]) -> Expr:
+        """DEFINE condition rewrite: strip pattern-variable qualifiers
+        (``DOWN.price`` = the CURRENT row's price) and resolve
+        ``PREV(col)`` to the drain-time ``__prev_<col>`` column."""
+        def fn(e: Expr):
+            if isinstance(e, Call) and e.name == "PREV":
+                if len(e.args) == 2:
+                    off = e.args[1]
+                    if not (isinstance(off, Literal) and off.value == 1):
+                        raise PlanError("PREV with offset > 1 is not "
+                                        "supported")
+                elif len(e.args) != 1:
+                    raise PlanError("PREV takes a column (and optional "
+                                    "offset 1)")
+                arg = e.args[0]
+                if not isinstance(arg, Column):
+                    raise PlanError("PREV argument must be a column")
+                if arg.name not in columns:
+                    raise PlanError(f"PREV: unknown column {arg.name!r}")
+                if arg.name not in prev_cols:
+                    prev_cols.append(arg.name)
+                return Column(f"__prev_{arg.name}")
+            if isinstance(e, Call) and e.name in ("FIRST", "LAST"):
+                raise PlanError(f"{e.name} is only supported in MEASURES, "
+                                f"not DEFINE")
+            if isinstance(e, Column) and e.table is not None:
+                if e.table.upper() not in var_names:
+                    raise PlanError(f"{e.table}.{e.name}: unknown pattern "
+                                    f"variable in DEFINE")
+                if e.name not in columns:
+                    raise PlanError(f"DEFINE: unknown column {e.name!r}")
+                return Column(e.name)
+            return None
+        return _transform(expr, fn)
+
     # ------------------------------------------------------------ joins
     def _plan_joins(self, stmt: SelectStmt, base):
         """FROM a JOIN b ON ... — equi-joins chained left-deep.
@@ -863,9 +1032,12 @@ class Planner:
         streaming = _traits(base) or any(
             _traits(self.catalog[jc.table])
             for jc in stmt.joins if jc.table in self.catalog)
-        self._changelog_join = streaming
         if streaming:
             self._unbounded_plan = True
+        #: does the stream AT THIS POINT of the chain carry changelog rows?
+        #: (regular streaming joins produce changelogs; temporal/lookup
+        #: joins keep append-only rows and cannot consume changelogs)
+        changelog_now = getattr(base, "changelog", False)
 
         # a changelog input's "op" column is the row's change kind, not
         # data: the join operator consumes it (retract on -D/-U) and must
@@ -906,6 +1078,18 @@ class Planner:
                 out_names.append(nm)
             lk, rk = self._resolve_equi_on(jc.on, qual_map, rt, ralias,
                                            left_names)
+            if jc.system_time_of is not None:
+                if changelog_now:
+                    raise PlanError("temporal/lookup join over a changelog "
+                                    "input is not supported (put the "
+                                    "FOR SYSTEM_TIME join before the "
+                                    "regular join)")
+                first_join = left_names == list(base_data_cols)
+                cur_stream = self._plan_system_time_join(
+                    jc, rt, cur_stream, lk, rk, dict(rename),
+                    list(left_names), list(rt_data_cols), qual_map,
+                    base if first_join else None)
+                continue
             rstream = rt.stream()
             if jc.pre_filter is not None:
                 rstream = self._pre_filter(rstream, rt.columns, jc.pre_filter,
@@ -926,7 +1110,10 @@ class Planner:
                 parallelism=self.env.parallelism, chainable=False,
                 max_parallelism=self.env.max_parallelism)
             cur_stream = DataStream(self.env, t)
-        if streaming:
+            if streaming:
+                changelog_now = True
+        self._changelog_join = changelog_now
+        if changelog_now:
             if "op" in out_names:
                 raise PlanError("streaming JOIN inputs must not have a "
                                 "column named 'op' (reserved for the "
@@ -935,8 +1122,94 @@ class Planner:
         joined = CatalogTable(name="<join>", columns=out_names,
                               stream_factory=lambda env: cur_stream,
                               timestamps_assigned=False,
-                              bounded=not streaming, changelog=streaming)
+                              bounded=not streaming,
+                              changelog=changelog_now)
         return cur_stream, joined, qual_map, ambiguous
+
+    def _plan_system_time_join(self, jc, rt, cur_stream, lk: str, rk: str,
+                               rename: Dict[str, str],
+                               left_names: List[str], rt_cols: List[str],
+                               qual_map, base_if_first):
+        """``JOIN t FOR SYSTEM_TIME AS OF <time>`` — two shapes:
+
+        - ``t`` registered as a LOOKUP table → ``LookupJoinOperator``
+          (``StreamExecLookupJoin``): per-key external probe with TTL cache,
+          observed at processing time.
+        - ``t`` a regular table with a rowtime → ``TemporalJoinOperator``
+          (``StreamExecTemporalJoin.java:67``): event-time versioned join,
+          each left row sees the version valid at its time attribute."""
+        from flink_tpu.datastream.api import DataStream
+        from flink_tpu.graph.transformations import (Partitioning,
+                                                     Transformation)
+        from flink_tpu.operators.sql_ops import (LookupJoinOperator,
+                                                 TemporalJoinOperator)
+
+        if jc.kind not in ("inner", "left"):
+            raise PlanError("FOR SYSTEM_TIME joins support INNER and LEFT "
+                            "only")
+        if getattr(rt, "lookup", None) is not None:
+            lk_col = getattr(rt, "lookup_key", None)
+            if lk_col is not None and rk != lk_col:
+                raise PlanError(f"lookup table {rt.name!r} is keyed by "
+                                f"{lk_col!r}; the join must be ON "
+                                f"left.col = {rt.name}.{lk_col}")
+            t = Transformation(
+                name=f"sql-lookup-join:{jc.table}",
+                operator_factory=(
+                    lambda _lk=lk, _fn=rt.lookup, _rc=list(rt_cols),
+                    _rn=dict(rename), _how=jc.kind,
+                    _ttl=rt.lookup_cache_ttl_ms:
+                    LookupJoinOperator(_lk, _fn, _rc, _rn, _how,
+                                       cache_ttl_ms=_ttl)),
+                inputs=[cur_stream.transformation],
+                input_partitionings=[Partitioning.HASH],
+                input_key_columns=[lk],
+                parallelism=self.env.parallelism, chainable=False,
+                max_parallelism=self.env.max_parallelism)
+            return DataStream(self.env, t)
+
+        if rt.rowtime is None:
+            raise PlanError(f"temporal join: table {jc.table!r} must "
+                            f"declare a rowtime column (its version time), "
+                            f"or be registered as a lookup table")
+        st = jc.system_time_of
+        if not isinstance(st, Column):
+            raise PlanError("FOR SYSTEM_TIME AS OF must name a left-side "
+                            "time column")
+        if st.table is not None:
+            key = (st.table, st.name)
+            if key not in qual_map:
+                raise PlanError(f"{st.table}.{st.name}: unknown in "
+                                f"FOR SYSTEM_TIME AS OF")
+            ltime = qual_map[key]
+        else:
+            ltime = st.name
+        if ltime not in left_names:
+            raise PlanError(f"FOR SYSTEM_TIME AS OF column {ltime!r} is not "
+                            f"on the left side")
+        if base_if_first is not None \
+                and not base_if_first.timestamps_assigned:
+            # drive the valve: left watermarks gate the buffered emission
+            cur_stream = cur_stream.assign_timestamps_and_watermarks(
+                base_if_first.watermark_delay_ms, timestamp_column=ltime,
+                name="sql-temporal-left-rowtime")
+        rstream = rt.stream()
+        if not rt.timestamps_assigned:
+            rstream = rstream.assign_timestamps_and_watermarks(
+                rt.watermark_delay_ms, timestamp_column=rt.rowtime,
+                name=f"sql-temporal-version-rowtime:{jc.table}")
+        t = Transformation(
+            name=f"sql-temporal-join:{jc.table}",
+            operator_factory=(
+                lambda _lk=lk, _rk=rk, _lt=ltime, _rt=rt.rowtime,
+                _rc=list(rt_cols), _rn=dict(rename), _how=jc.kind:
+                TemporalJoinOperator(_lk, _rk, _lt, _rt, _rc, _rn, _how)),
+            inputs=[cur_stream.transformation, rstream.transformation],
+            input_partitionings=[Partitioning.HASH, Partitioning.HASH],
+            input_key_columns=[lk, rk],
+            parallelism=self.env.parallelism, chainable=False,
+            max_parallelism=self.env.max_parallelism)
+        return DataStream(self.env, t)
 
     def _pre_filter(self, stream, columns, pred_expr: Expr, name: str):
         """Apply a pushed-down predicate (bare column names) to an input."""
@@ -1355,6 +1628,114 @@ def _n(cols) -> int:
     for v in cols.values():
         return int(np.shape(v)[0])
     return 0
+
+
+def _make_measure_fn(names: List[str], exprs: List[Expr],
+                     var_names: List[str], part: Optional[str]):
+    """MEASURES evaluator: one output row per match.  Scalar semantics of
+    ``StreamExecMatch``'s generated condition/measure functions: a bare
+    ``A.col`` is the LAST row mapped to A (ONE ROW PER MATCH),
+    ``FIRST/LAST(A.col)`` navigate within A, aggregates fold over A's rows
+    (or over the whole match when unqualified)."""
+    uvars = [v.upper() for v in var_names]
+
+    def rows_of(match, var):
+        return match.get(var.upper(), [])
+
+    def all_rows(match):
+        out = []
+        for v in uvars:
+            out.extend(match.get(v, []))
+        return out
+
+    def last_row_value(match, name):
+        for v in reversed(uvars):
+            rows = match.get(v)
+            if rows:
+                return rows[-1].get(name)
+        return None
+
+    def agg(fn_name, vals):
+        vals = [v for v in vals if v is not None]
+        if fn_name == "COUNT":
+            return len(vals)
+        if not vals:
+            return None
+        if fn_name == "SUM":
+            return sum(vals)
+        if fn_name == "MIN":
+            return min(vals)
+        if fn_name == "MAX":
+            return max(vals)
+        if fn_name == "AVG":
+            return sum(vals) / len(vals)
+        raise PlanError(f"unsupported MEASURES aggregate {fn_name}")
+
+    def ev(e: Expr, match):
+        if isinstance(e, Literal):
+            return e.value
+        if isinstance(e, Interval):
+            return e.ms
+        if isinstance(e, Column):
+            if e.table is not None:
+                if e.table.upper() not in uvars:
+                    raise PlanError(f"{e.table}.{e.name}: unknown pattern "
+                                    f"variable in MEASURES")
+                rows = rows_of(match, e.table)
+                return rows[-1].get(e.name) if rows else None
+            if part is not None and e.name == part:
+                return all_rows(match)[0].get(part)
+            return last_row_value(match, e.name)
+        if isinstance(e, Call):
+            nm = e.name
+            if nm in ("FIRST", "LAST"):
+                if len(e.args) != 1 or not isinstance(e.args[0], Column) \
+                        or e.args[0].table is None:
+                    raise PlanError(f"{nm} takes a variable-qualified "
+                                    f"column (A.col)")
+                rows = rows_of(match, e.args[0].table)
+                if not rows:
+                    return None
+                row = rows[0] if nm == "FIRST" else rows[-1]
+                return row.get(e.args[0].name)
+            if nm in ("SUM", "COUNT", "MIN", "MAX", "AVG"):
+                if len(e.args) == 1 and isinstance(e.args[0], Star):
+                    return len(all_rows(match))
+                if len(e.args) != 1 or not isinstance(e.args[0], Column):
+                    raise PlanError(f"MEASURES {nm} takes one column")
+                col = e.args[0]
+                rows = (rows_of(match, col.table)
+                        if col.table is not None else all_rows(match))
+                return agg(nm, [r.get(col.name) for r in rows])
+            raise PlanError(f"unsupported function {nm} in MEASURES")
+        if isinstance(e, Unary):
+            v = ev(e.operand, match)
+            if e.op == "-":
+                return None if v is None else -v
+            return None if v is None else (not v)
+        if isinstance(e, Binary):
+            l, r = ev(e.left, match), ev(e.right, match)
+            if e.op in ("AND", "OR"):
+                return (l and r) if e.op == "AND" else (l or r)
+            if l is None or r is None:
+                return None
+            return {"+": lambda: l + r, "-": lambda: l - r,
+                    "*": lambda: l * r, "/": lambda: l / r,
+                    "%": lambda: l % r, "||": lambda: str(l) + str(r),
+                    "=": lambda: l == r, "<>": lambda: l != r,
+                    "<": lambda: l < r, "<=": lambda: l <= r,
+                    ">": lambda: l > r, ">=": lambda: l >= r}[e.op]()
+        raise PlanError(f"unsupported MEASURES expression {e!r}")
+
+    def select(match):
+        row = {}
+        if part is not None:
+            row[part] = all_rows(match)[0].get(part)
+        for nm, e in zip(names, exprs):
+            row[nm] = ev(e, match)
+        return row
+
+    return select
 
 
 def _output_names(items: List[SelectItem]) -> List[str]:

@@ -36,6 +36,12 @@ class CatalogTable:
     #: consumers must fold retractions, and aggregates/ORDER BY over the raw
     #: rows are rejected (a -U row is not data)
     changelog: bool = False
+    #: lookup (dimension) table: ``lookup(key) -> list[dict]`` probes an
+    #: external system; usable only via ``JOIN t FOR SYSTEM_TIME AS OF``
+    lookup: Any = None
+    lookup_cache_ttl_ms: int = 60_000
+    #: the dimension's key column — the join condition must equal-match it
+    lookup_key: Optional[str] = None
     _bound_env: Any = None
     #: lazy catalog statistics (row count + NDV) feeding the cost-based
     #: join reorder (sql/cost.py); computed on FIRST use — registration
@@ -57,19 +63,63 @@ class TableEnvironment:
     """Catalog + SQL planner over the streaming runtime."""
 
     def __init__(self, parallelism: int = 1, max_parallelism: int = 128,
-                 mini_batch_rows: int = 0):
+                 mini_batch_rows: int = 0,
+                 catalog_dir: Optional[str] = None):
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
         #: >0 enables mini-batch bundling before group aggregates
         #: (``table.exec.mini-batch`` analog)
         self.mini_batch_rows = mini_batch_rows
         self._catalog: Dict[str, CatalogTable] = {}
-        #: sink tables for INSERT INTO: name -> (path, format)
-        self._sinks: Dict[str, Tuple[str, str]] = {}
+        #: sink tables for INSERT INTO: name -> _SinkSpec
+        self._sinks: Dict[str, "_SinkSpec"] = {}
+        #: DDL-declared schemas, for DESCRIBE: name -> [(col, type), ...]
+        self._ddl_types: Dict[str, List[Tuple[str, str]]] = {}
+        #: names registered as VIEWs (DROP must match the object kind)
+        self._views: set = set()
+        #: durable catalog (``GenericInMemoryCatalog`` → persisted analog):
+        #: every successful DDL appends to <dir>/catalog.json and replays on
+        #: construction, so a catalog survives process restarts.  Point it
+        #: at an object-store-backed mount for cluster-shared durability.
+        self.catalog_dir = catalog_dir
+        if catalog_dir:
+            self._replay_catalog()
 
     @staticmethod
     def create(**kw) -> "TableEnvironment":
         return TableEnvironment(**kw)
+
+    # ------------------------------------------------------- durable catalog
+    def _catalog_file(self) -> str:
+        import os
+        return os.path.join(self.catalog_dir, "catalog.json")
+
+    def _replay_catalog(self) -> None:
+        import json
+        import os
+        os.makedirs(self.catalog_dir, exist_ok=True)
+        path = self._catalog_file()
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            for ddl in json.load(f):
+                self._execute_ddl(ddl, persist=False)
+
+    def _persist_ddl(self, sql: str) -> None:
+        if not self.catalog_dir:
+            return
+        import json
+        import os
+        path = self._catalog_file()
+        entries = []
+        if os.path.exists(path):
+            with open(path) as f:
+                entries = json.load(f)
+        entries.append(sql)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(entries, f, indent=1)
+        os.replace(tmp, path)
 
     # ---------------------------------------------------------- registration
     def register_collection(self, name: str,
@@ -120,6 +170,23 @@ class TableEnvironment:
         self._catalog[name] = ct
         return Table(self, SelectStmt(items=[], table=name), ct)
 
+    def register_lookup_table(self, name: str, lookup_fn,
+                              columns: List[str],
+                              key_column: Optional[str] = None,
+                              cache_ttl_ms: int = 60_000) -> None:
+        """Register a DIMENSION table backed by an external point-lookup
+        (``lookup_fn(key) -> list[dict]``, e.g. a Postgres primary-key
+        query).  Only joinable via ``JOIN name FOR SYSTEM_TIME AS OF
+        o.proctime`` — the ``StreamExecLookupJoin`` shape; results are
+        cached per key for ``cache_ttl_ms``."""
+        def no_scan(env):
+            raise PlanError(f"lookup table {name!r} cannot be scanned; use "
+                            f"JOIN {name} FOR SYSTEM_TIME AS OF ...")
+
+        self._catalog[name] = CatalogTable(
+            name, list(columns), no_scan, lookup=lookup_fn,
+            lookup_cache_ttl_ms=cache_ttl_ms, lookup_key=key_column)
+
     def create_temporary_view(self, name: str, table: "Table") -> None:
         """Register a planned query as a view (``createTemporaryView``)."""
         stmt = table._stmt
@@ -136,6 +203,7 @@ class TableEnvironment:
         self._catalog[name] = CatalogTable(name, cols, factory,
                                            bounded=not unbounded,
                                            changelog=changelog)
+        self._views.add(name)
 
     def _view_traits(self, stmt: SelectStmt):
         """Dry-plan on a throwaway env to learn a view's output schema and
@@ -167,21 +235,194 @@ class TableEnvironment:
         resolved = fmt or path.rsplit(".", 1)[-1]
         writer_for(resolved)   # validate NOW — fail at registration, not
         #                        after the INSERT's query already ran
-        self._sinks[name] = (path, resolved)
+        self._sinks[name] = _FileSinkSpec(path, resolved)
 
     def sql_query(self, sql: str) -> "Table":
         return Table(self, parse(sql))
 
     def execute_sql(self, sql: str) -> "TableResult":
-        """SELECT / UNION chains, ``INSERT INTO sink SELECT ...``, and
-        ``EXPLAIN <query>`` (``TableEnvironment.executeSql:748`` analog)."""
+        """SELECT / UNION chains, ``INSERT INTO sink SELECT ...``,
+        ``EXPLAIN <query>``, and DDL — CREATE TABLE ... WITH (connector
+        properties), CREATE VIEW, DROP, SHOW TABLES, DESCRIBE
+        (``TableEnvironmentImpl.executeSql:748`` dispatching DDL like
+        ``TableEnvironmentImpl.java:197-205``)."""
         stripped = sql.strip()
         up = stripped.upper()
-        if up.startswith("EXPLAIN"):
+        first = up.split(None, 1)[0] if up else ""
+        if first in ("CREATE", "DROP", "SHOW", "DESCRIBE", "DESC"):
+            return self._execute_ddl(stripped)
+        if first == "EXPLAIN":
             return _ExplainResult(self.explain_sql(stripped[len("EXPLAIN"):]))
-        if up.startswith("INSERT"):
+        if first == "INSERT":
             return self._execute_insert(stripped)
         return self.sql_query(sql).execute()
+
+    # ------------------------------------------------------------------ DDL
+    def _execute_ddl(self, sql: str, persist: bool = True):
+        from flink_tpu.sql.parser import (CreateTableStmt, CreateViewStmt,
+                                          DescribeStmt, DropStmt,
+                                          ShowTablesStmt, parse_any)
+        stmt = parse_any(sql)
+        if isinstance(stmt, CreateTableStmt):
+            if stmt.name in self._catalog or stmt.name in self._sinks:
+                if stmt.if_not_exists:
+                    return _DdlResult("OK")
+                raise PlanError(f"table {stmt.name!r} already exists")
+            self._register_connector_table(stmt)
+            if persist:
+                self._persist_ddl(sql)
+            return _DdlResult("OK")
+        if isinstance(stmt, CreateViewStmt):
+            if stmt.name in self._catalog:
+                if stmt.if_not_exists:
+                    return _DdlResult("OK")
+                raise PlanError(f"view {stmt.name!r} already exists")
+            query = stmt.query
+
+            def factory(env, _q=query):
+                return Planner(env, self._catalog).plan(_q).stream
+
+            cols, changelog, unbounded = self._view_traits(query)
+            self._catalog[stmt.name] = CatalogTable(
+                stmt.name, cols, factory, bounded=not unbounded,
+                changelog=changelog)
+            self._views.add(stmt.name)
+            if persist:
+                self._persist_ddl(sql)
+            return _DdlResult("OK")
+        if isinstance(stmt, DropStmt):
+            known = stmt.name in self._catalog or stmt.name in self._sinks
+            if not known and not stmt.if_exists:
+                raise PlanError(f"{stmt.kind.lower()} {stmt.name!r} does "
+                                f"not exist")
+            if known:
+                is_view = stmt.name in self._views
+                if stmt.kind == "VIEW" and not is_view:
+                    raise PlanError(f"{stmt.name!r} is a table, not a "
+                                    f"view (use DROP TABLE)")
+                if stmt.kind == "TABLE" and is_view:
+                    raise PlanError(f"{stmt.name!r} is a view, not a "
+                                    f"table (use DROP VIEW)")
+            self._catalog.pop(stmt.name, None)
+            self._sinks.pop(stmt.name, None)
+            self._ddl_types.pop(stmt.name, None)
+            self._views.discard(stmt.name)
+            if persist and known:
+                self._persist_ddl(sql)
+            return _DdlResult("OK")
+        if isinstance(stmt, ShowTablesStmt):
+            names = sorted(set(self._catalog) | set(self._sinks))
+            return _RowsResult([{"table name": n} for n in names],
+                               ["table name"])
+        if isinstance(stmt, DescribeStmt):
+            if stmt.name in self._ddl_types:
+                rows = [{"name": c, "type": t}
+                        for c, t in self._ddl_types[stmt.name]]
+            elif stmt.name in self._catalog:
+                rows = [{"name": c, "type": "ANY"}
+                        for c in self._catalog[stmt.name].columns]
+            else:
+                raise PlanError(f"table {stmt.name!r} does not exist")
+            return _RowsResult(rows, ["name", "type"])
+        raise PlanError(f"unsupported DDL {type(stmt).__name__}")
+
+    def _register_connector_table(self, stmt) -> None:
+        """CREATE TABLE → connector registration (source and, where the
+        connector writes, the INSERT INTO sink)."""
+        props = stmt.properties
+        conn = props.get("connector")
+        if conn is None:
+            raise PlanError("CREATE TABLE requires a 'connector' property")
+        cols = [c.name for c in stmt.columns]
+        name = stmt.name
+        rowtime = stmt.watermark_column
+        delay = stmt.watermark_delay_ms
+        self._ddl_types[name] = [(c.name, c.type_name) for c in stmt.columns]
+
+        if conn == "filesystem":
+            path = props.get("path")
+            if not path:
+                raise PlanError("filesystem connector requires 'path'")
+            fmt = props.get("format") or path.rsplit(".", 1)[-1]
+            from flink_tpu.formats import writer_for
+            writer_for(fmt)                      # validate format name
+
+            def factory(env, _p=path, _f=fmt, _rt=rowtime):
+                from flink_tpu.connectors.file_source import FileSource
+                return env.from_source(
+                    FileSource(_p, _f, timestamp_column=_rt),
+                    name=f"table:{name}")
+
+            self._catalog[name] = CatalogTable(
+                name, cols, factory, rowtime=rowtime,
+                watermark_delay_ms=delay)
+            self._sinks[name] = _FileSinkSpec(path, fmt)
+            return
+        if conn == "kafka":
+            topic = props.get("topic")
+            if not topic:
+                raise PlanError("kafka connector requires 'topic'")
+            bootstrap = props.get("properties.bootstrap.servers",
+                                  "127.0.0.1:9092")
+            host, _, port_s = bootstrap.partition(":")
+            port = int(port_s or 9092)
+            unbounded = props.get("scan.unbounded", "false") == "true"
+            fmt = props.get("format", "json")
+            decoder = None
+            is_cdc = fmt in ("debezium-json", "canal-json", "maxwell-json")
+            if is_cdc:
+                from flink_tpu.formats.cdc import cdc_decoder
+                decoder = cdc_decoder(fmt)
+            elif fmt != "json":
+                raise PlanError(f"kafka format {fmt!r} not supported "
+                                f"(json, debezium-json, canal-json, "
+                                f"maxwell-json)")
+
+            def factory(env, _h=host, _p=port, _t=topic, _rt=rowtime,
+                        _dec=decoder):
+                from flink_tpu.connectors.kafka import KafkaWireSource
+                return env.from_source(
+                    KafkaWireSource(_h, _p, _t, timestamp_column=_rt,
+                                    value_decoder=_dec),
+                    name=f"table:{name}")
+
+            # a CDC table IS a changelog: its rows carry the op column and
+            # downstream operators must fold retractions
+            self._catalog[name] = CatalogTable(
+                name, (["op"] + cols) if is_cdc else cols, factory,
+                rowtime=rowtime, watermark_delay_ms=delay,
+                bounded=not unbounded, changelog=is_cdc)
+            if not is_cdc:
+                self._sinks[name] = _KafkaSinkSpec(
+                    host, port, topic,
+                    key_column=props.get("sink.key-column"),
+                    num_partitions=int(props.get("sink.partitions", "1")))
+            return
+        if conn in ("postgres", "jdbc"):
+            table = props.get("table-name", name)
+            host = props.get("hostname", "127.0.0.1")
+            port = int(props.get("port", "5432"))
+            user = props.get("username", "flink")
+            password = props.get("password", "")
+            part_col = props.get("scan.partition.column",
+                                 stmt.primary_key or cols[0])
+
+            def factory(env, _h=host, _p=port, _t=table, _pc=part_col,
+                        _u=user, _pw=password, _c=cols):
+                from flink_tpu.connectors.postgres import PostgresSource
+                return env.from_source(
+                    PostgresSource(_h, _p, _t, partition_column=_pc,
+                                   columns=_c, user=_u, password=_pw),
+                    name=f"table:{name}")
+
+            self._catalog[name] = CatalogTable(
+                name, cols, factory, rowtime=rowtime,
+                watermark_delay_ms=delay)
+            self._sinks[name] = _PostgresSinkSpec(host, port, table, cols,
+                                                  user, password)
+            return
+        raise PlanError(f"unknown connector {conn!r} (have: filesystem, "
+                        f"kafka, postgres)")
 
     def explain_sql(self, sql: str) -> str:
         """Textual physical plan: the vertex/edge list of the stream graph
@@ -222,15 +463,15 @@ class TableEnvironment:
         sink_name, query = m.group(1), m.group(2)
         if sink_name not in self._sinks:
             raise PlanError(f"unknown sink table {sink_name!r}; register it "
-                            f"with register_sink_table(name, path)")
-        path, fmt = self._sinks[sink_name]
+                            f"with register_sink_table(name, path) or "
+                            f"CREATE TABLE ... WITH (...)")
+        spec = self._sinks[sink_name]
         result = self.sql_query(query).execute()
         rows = result.collect()
         from flink_tpu.core.batch import RecordBatch
-        from flink_tpu.formats import writer_for
         batch = RecordBatch.from_rows(rows) if rows else RecordBatch({})
-        n = writer_for(fmt)([batch], path)
-        return _InsertResult(n, path)
+        n, target = spec.write([batch])
+        return _InsertResult(n, target)
 
     def _plan(self, stmt: SelectStmt, return_planner: bool = False):
         from flink_tpu.datastream.api import StreamExecutionEnvironment
@@ -428,6 +669,95 @@ class GroupedTable:
             plan.stream, key, "sql-changelog-agg",
             lambda: ChangelogGroupAggOperator(key, agg_columns))
         return TableResult(env, QP(out, out_cols))
+
+
+class _SinkSpec:
+    """INSERT INTO target: writes batches, returns (rows, target desc)."""
+
+    def write(self, batches) -> Tuple[int, str]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class _FileSinkSpec(_SinkSpec):
+    def __init__(self, path: str, fmt: str):
+        self.path, self.fmt = path, fmt
+
+    def write(self, batches):
+        from flink_tpu.formats import writer_for
+        return writer_for(self.fmt)(batches, self.path), self.path
+
+
+class _KafkaSinkSpec(_SinkSpec):
+    def __init__(self, host, port, topic, key_column=None,
+                 num_partitions=1):
+        self.host, self.port, self.topic = host, port, topic
+        self.key_column = key_column
+        self.num_partitions = num_partitions
+
+    def write(self, batches):
+        from flink_tpu.connectors.kafka import KafkaWireSink
+        sink = KafkaWireSink(self.host, self.port, self.topic,
+                             key_column=self.key_column,
+                             num_partitions=self.num_partitions)
+        sink.open(None)
+        n = 0
+        try:
+            for b in batches:
+                sink.write_batch(b)
+                n += len(b)
+        finally:
+            sink.close()
+        return n, f"kafka://{self.host}:{self.port}/{self.topic}"
+
+
+class _PostgresSinkSpec(_SinkSpec):
+    def __init__(self, host, port, table, columns, user, password):
+        self.host, self.port, self.table = host, port, table
+        self.columns = columns
+        self.user, self.password = user, password
+
+    def write(self, batches):
+        from flink_tpu.connectors.postgres import PostgresSink
+        sink = PostgresSink(self.host, self.port, self.table,
+                            columns=self.columns, user=self.user,
+                            password=self.password)
+        n = 0
+        try:
+            for b in batches:
+                sink.write_batch(b)
+                n += len(b)
+        finally:
+            sink.close()
+        return n, f"postgres://{self.host}:{self.port}/{self.table}"
+
+
+class _DdlResult:
+    """Result of a DDL statement (``TableResultImpl.TABLE_RESULT_OK``)."""
+
+    def __init__(self, status: str = "OK"):
+        self.status = status
+
+    def collect(self):
+        return [{"result": self.status}]
+
+    def print(self) -> None:
+        print(self.status)
+
+
+class _RowsResult:
+    """Static rows (SHOW TABLES / DESCRIBE)."""
+
+    def __init__(self, rows, columns):
+        self._rows = rows
+        self.output_columns = columns
+
+    def collect(self):
+        return self._rows
+
+    def print(self) -> None:
+        print(" | ".join(self.output_columns))
+        for r in self._rows:
+            print(" | ".join(str(r[c]) for c in self.output_columns))
 
 
 class _ExplainResult:

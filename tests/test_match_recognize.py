@@ -1,0 +1,330 @@
+"""MATCH_RECOGNIZE → CEP NFA lowering (StreamExecMatch.java:90 analog).
+
+The canonical V-shape (falling-then-rising price) query and its variants:
+PREV navigation, greedy quantifiers, AFTER MATCH SKIP strategies, MEASURES
+(FIRST/LAST/aggregates), partitioning, and equivalence with the direct
+DataStream CEP path.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.sql.parser import SqlParseError, parse
+from flink_tpu.sql.planner import PlanError
+from flink_tpu.sql.table_env import TableEnvironment
+
+
+def ticker_env(**kw):
+    tenv = TableEnvironment()
+    # symbol A: 12 10 9 11 13 8 7 10  (two V shapes)
+    # symbol B: 5 6 4 8               (one V shape: 6->4 down, 8 up)
+    rows = [("A", 0, 12.0), ("B", 0, 5.0), ("A", 1, 10.0), ("B", 1, 6.0),
+            ("A", 2, 9.0), ("B", 2, 4.0), ("A", 3, 11.0), ("B", 3, 8.0),
+            ("A", 4, 13.0), ("A", 5, 8.0), ("A", 6, 7.0), ("A", 7, 10.0)]
+    tenv.register_collection(
+        "ticker",
+        columns={"symbol": np.asarray([r[0] for r in rows], object),
+                 "ts": np.asarray([r[1] for r in rows], np.int64),
+                 "price": np.asarray([r[2] for r in rows])},
+        batch_size=3, **kw)
+    return tenv
+
+
+V_QUERY = """
+SELECT * FROM ticker MATCH_RECOGNIZE (
+  PARTITION BY symbol
+  ORDER BY ts
+  MEASURES
+    FIRST(DOWN.price) AS start_price,
+    MIN(DOWN.price) AS bottom_price,
+    LAST(UP.price) AS end_price,
+    COUNT(DOWN.price) AS down_ticks
+  ONE ROW PER MATCH
+  AFTER MATCH SKIP PAST LAST ROW
+  PATTERN (DOWN+ UP)
+  DEFINE
+    DOWN AS price < PREV(price),
+    UP AS price > PREV(price)
+) AS T
+"""
+
+
+def test_parse_shape():
+    stmt = parse(V_QUERY)
+    mr = stmt.match
+    assert mr is not None
+    assert mr.partition_by == ["symbol"]
+    assert mr.order_by == "ts"
+    assert [s.var for s in mr.pattern] == ["DOWN", "UP"]
+    assert mr.pattern[0].quant_max is None       # DOWN+
+    assert mr.after_match == "skip_past_last"
+    assert set(mr.defines) == {"DOWN", "UP"}
+    assert mr.alias == "T"
+
+
+def test_v_shape_canonical():
+    rows = ticker_env().execute_sql(V_QUERY).collect()
+    got = sorted((r["symbol"], r["start_price"], r["bottom_price"],
+                  r["end_price"], r["down_ticks"]) for r in rows)
+    assert got == [
+        ("A", 10.0, 9.0, 11.0, 2),   # 12 >10 >9 then 11
+        ("A", 8.0, 7.0, 10.0, 2),    # 13 >8 >7 then 10
+        ("B", 4.0, 4.0, 8.0, 1),     # 6 >4 then 8
+    ] or got == sorted([
+        ("A", 10.0, 9.0, 11.0, 2),
+        ("A", 8.0, 7.0, 10.0, 2),
+        ("B", 4.0, 4.0, 8.0, 1)])
+
+
+def test_skip_to_next_row_overlapping():
+    """SKIP TO NEXT ROW: a match may start at EVERY row, so the nested V
+    (starting one tick later) also emits."""
+    q = V_QUERY.replace("SKIP PAST LAST ROW", "SKIP TO NEXT ROW")
+    rows = ticker_env().execute_sql(q).collect()
+    a_starts = sorted(r["start_price"] for r in rows if r["symbol"] == "A")
+    # matches starting at 10 (full V) AND at 9 (inner V), etc.
+    assert 9.0 in a_starts and 10.0 in a_starts
+    assert len(rows) > 3
+
+
+def test_quantifier_bounds():
+    q = """
+    SELECT * FROM ticker MATCH_RECOGNIZE (
+      PARTITION BY symbol
+      ORDER BY ts
+      MEASURES COUNT(DOWN.price) AS n
+      AFTER MATCH SKIP PAST LAST ROW
+      PATTERN (DOWN{2} UP)
+      DEFINE DOWN AS price < PREV(price), UP AS price > PREV(price)
+    )
+    """
+    rows = ticker_env().execute_sql(q).collect()
+    # B has only a single down tick: no match; A's two Vs have exactly 2
+    assert sorted(r["symbol"] for r in rows) == ["A", "A"]
+    assert all(r["n"] == 2 for r in rows)
+
+
+def test_optional_and_star():
+    q = """
+    SELECT * FROM ticker MATCH_RECOGNIZE (
+      PARTITION BY symbol
+      ORDER BY ts
+      MEASURES LAST(UP.price) AS end_price, COUNT(DOWN.price) AS downs
+      AFTER MATCH SKIP PAST LAST ROW
+      PATTERN (DOWN* UP)
+      DEFINE DOWN AS price < PREV(price), UP AS price > PREV(price)
+    )
+    """
+    rows = ticker_env().execute_sql(q).collect()
+    # DOWN* allows zero downs: a bare up-tick matches too
+    assert any(r["downs"] == 0 for r in rows)
+    assert any(r["downs"] >= 1 for r in rows)
+
+
+def test_unpartitioned_and_no_prev():
+    tenv = TableEnvironment()
+    tenv.register_collection(
+        "events",
+        columns={"ts": np.asarray([0, 1, 2, 3, 4], np.int64),
+                 "kind": np.asarray(["a", "b", "c", "a", "b"], object)})
+    q = """
+    SELECT * FROM events MATCH_RECOGNIZE (
+      ORDER BY ts
+      MEASURES FIRST(A.ts) AS a_ts, LAST(B.ts) AS b_ts
+      AFTER MATCH SKIP PAST LAST ROW
+      PATTERN (A B)
+      DEFINE A AS kind = 'a', B AS kind = 'b'
+    )
+    """
+    rows = tenv.execute_sql(q).collect()
+    assert sorted((r["a_ts"], r["b_ts"]) for r in rows) == [(0, 1), (3, 4)]
+
+
+def test_strict_contiguity_kills_gaps():
+    """Unlike CEP followedBy, MATCH_RECOGNIZE rows must be contiguous:
+    a non-matching row between A and B kills the attempt."""
+    tenv = TableEnvironment()
+    tenv.register_collection(
+        "events",
+        columns={"ts": np.asarray([0, 1, 2], np.int64),
+                 "kind": np.asarray(["a", "x", "b"], object)})
+    q = """
+    SELECT * FROM events MATCH_RECOGNIZE (
+      ORDER BY ts
+      MEASURES FIRST(A.ts) AS a_ts
+      PATTERN (A B)
+      DEFINE A AS kind = 'a', B AS kind = 'b'
+    )
+    """
+    assert tenv.execute_sql(q).collect() == []
+
+
+def test_measure_arithmetic_and_sum():
+    q = """
+    SELECT * FROM ticker MATCH_RECOGNIZE (
+      PARTITION BY symbol
+      ORDER BY ts
+      MEASURES
+        LAST(UP.price) - MIN(DOWN.price) AS rebound,
+        SUM(DOWN.price) AS down_total
+      AFTER MATCH SKIP PAST LAST ROW
+      PATTERN (DOWN+ UP)
+      DEFINE DOWN AS price < PREV(price), UP AS price > PREV(price)
+    )
+    """
+    rows = ticker_env().execute_sql(q).collect()
+    b = [r for r in rows if r["symbol"] == "B"][0]
+    assert b["rebound"] == 4.0 and b["down_total"] == 4.0
+
+
+def test_matches_direct_cep_path():
+    """The SQL lowering and a hand-built CEP pattern find the same episodes
+    (same count and same partition keys) for an A-then-B pattern."""
+    from flink_tpu.cep import CEP, Pattern
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    cols = {"k": np.asarray(["x", "x", "y", "x", "y"], object),
+            "ts": np.asarray([0, 1, 2, 3, 4], np.int64),
+            "kind": np.asarray(["a", "b", "a", "a", "b"], object)}
+    # SQL path
+    tenv = TableEnvironment()
+    tenv.register_collection("ev", columns=cols)
+    q = """
+    SELECT * FROM ev MATCH_RECOGNIZE (
+      PARTITION BY k
+      ORDER BY ts
+      MEASURES FIRST(A.ts) AS a_ts
+      AFTER MATCH SKIP PAST LAST ROW
+      PATTERN (A B)
+      DEFINE A AS kind = 'a', B AS kind = 'b'
+    )
+    """
+    sql_rows = tenv.execute_sql(q).collect()
+    # direct CEP path (relaxed contiguity is equivalent here: no gaps)
+    env = StreamExecutionEnvironment(parallelism=1)
+    pat = (Pattern.begin("A")
+           .where(lambda c: np.asarray(c["kind"]) == "a")
+           .next("B")
+           .where(lambda c: np.asarray(c["kind"]) == "b"))
+    stream = (env.from_collection(columns=cols, timestamp_column="ts")
+              .assign_timestamps_and_watermarks(0, timestamp_column="ts")
+              .key_by("k"))
+    res = CEP.pattern(stream, pat).select(
+        lambda m: {"k": m["A"][0]["k"], "a_ts": m["A"][0]["ts"]})
+    cep_rows = res.execute_and_collect()
+    assert sorted((r["k"], r["a_ts"]) for r in sql_rows) == \
+        sorted((r["k"], r["a_ts"]) for r in cep_rows)
+
+
+def test_snapshot_restore_mid_pattern():
+    """Operator-level: snapshot between the DOWN run and the UP tick; the
+    restored operator completes the match (PREV continuity included)."""
+    from flink_tpu.cep.operator import CepOperator
+    from flink_tpu.cep.pattern import Pattern, Stage
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    def mk():
+        stages = [
+            Stage("DOWN", condition=lambda c: np.asarray(
+                c["price"]) < np.asarray(c["__prev_price"]),
+                contiguity="strict", times_min=1, times_max=None,
+                greedy=True),
+            Stage("UP", condition=lambda c: np.asarray(
+                c["price"]) > np.asarray(c["__prev_price"]),
+                contiguity="strict"),
+        ]
+        pat = Pattern(stages)
+        return CepOperator(
+            pat, "symbol",
+            lambda m: {"symbol": m["DOWN"][0]["symbol"],
+                       "bottom": min(r["price"] for r in m["DOWN"])},
+            prev_columns=["price"], leftmost_order_column="ts")
+
+    def batch(ts, price):
+        return RecordBatch(
+            {"symbol": np.asarray(["A"], object),
+             "ts": np.asarray([ts], np.int64),
+             "price": np.asarray([price])},
+            timestamps=np.asarray([ts], np.int64))
+
+    op = mk()
+    out = []
+    out += op.process_batch(batch(0, 12.0))
+    out += op.process_batch(batch(1, 10.0))
+    out += op.process_watermark(Watermark(1))     # drain the down ticks
+    snap = op.snapshot_state()
+
+    op2 = mk()
+    op2.restore_state(snap)
+    out += op2.process_batch(batch(2, 9.0))
+    out += op2.process_batch(batch(3, 11.0))
+    out += op2.process_watermark(Watermark(3))
+    rows = [dict(zip(b.columns, vals))
+            for b in out
+            for vals in zip(*[np.asarray(b.column(c)) for c in b.columns])]
+    assert any(r["bottom"] == 9.0 for r in rows)
+
+
+def test_zero_min_quantifier_is_optional():
+    """PATTERN (A B{0,2} C): B may match ZERO rows — {0,n} must not be
+    silently clamped to at-least-once."""
+    q = """
+    SELECT * FROM ev MATCH_RECOGNIZE (
+      ORDER BY ts
+      MEASURES FIRST(A.ts) AS a_ts, LAST(C.ts) AS c_ts, COUNT(B.ts) AS nb
+      AFTER MATCH SKIP PAST LAST ROW
+      PATTERN (A B{0,2} C)
+      DEFINE A AS v = 1, B AS v > 3, C AS v = 1
+    )
+    """
+
+    def run(vals):
+        tenv = TableEnvironment()
+        tenv.register_collection(
+            "ev", columns={"ts": np.arange(len(vals), dtype=np.int64),
+                           "v": np.asarray(vals, np.int64)})
+        return sorted((r["a_ts"], r["c_ts"], r["nb"])
+                      for r in tenv.execute_sql(q).collect())
+
+    assert run([1, 1]) == [(0, 1, 0)]        # zero-B match
+    assert run([1, 5, 1]) == [(0, 2, 1)]     # one-B match
+    assert run([1, 5, 5, 1]) == [(0, 3, 2)]  # two-B match (greedy)
+
+
+def test_match_recognize_over_changelog_rejected():
+    tenv = TableEnvironment()
+    tenv.register_collection("l", columns={"k": np.asarray([1, 2]),
+                                           "ts": np.asarray([0, 1])},
+                             bounded=False)
+    tenv.register_collection("r", columns={"k2": np.asarray([1, 3])},
+                             bounded=False)
+    tenv.create_temporary_view(
+        "joined", tenv.sql_query("SELECT l.k, l.ts FROM l "
+                                 "JOIN r ON l.k = r.k2"))
+    with pytest.raises(PlanError, match="changelog"):
+        tenv.execute_sql("""
+        SELECT * FROM joined MATCH_RECOGNIZE (
+          ORDER BY ts MEASURES FIRST(A.k) AS k
+          PATTERN (A) DEFINE A AS k > 0 )
+        """).collect()
+
+
+def test_errors():
+    tenv = ticker_env()
+    with pytest.raises(SqlParseError):
+        tenv.execute_sql("SELECT * FROM ticker MATCH_RECOGNIZE ( "
+                         "MEASURES 1 AS x PATTERN (A) DEFINE A AS TRUE )")
+    with pytest.raises(PlanError, match="PREV with offset"):
+        tenv.execute_sql("""
+        SELECT * FROM ticker MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY ts
+          MEASURES LAST(A.price) AS p
+          PATTERN (A) DEFINE A AS price < PREV(price, 2) )
+        """)
+    with pytest.raises(PlanError, match="unknown pattern variable"):
+        tenv.execute_sql("""
+        SELECT * FROM ticker MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY ts
+          MEASURES LAST(Z.price) AS p
+          PATTERN (A) DEFINE A AS price > 0 )
+        """)

@@ -1,0 +1,260 @@
+"""SQL DDL: CREATE TABLE ... WITH (connector), CREATE VIEW, DROP,
+SHOW TABLES, DESCRIBE, durable catalog — ``SqlCreateTable`` +
+``TableEnvironmentImpl.executeSql`` DDL dispatch analogs.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.sql.parser import parse_any, CreateTableStmt, SqlParseError
+from flink_tpu.sql.planner import PlanError
+from flink_tpu.sql.table_env import TableEnvironment
+
+
+def test_parse_create_table():
+    stmt = parse_any("""
+        CREATE TABLE IF NOT EXISTS orders (
+          id BIGINT,
+          amount DOUBLE,
+          ts BIGINT,
+          note VARCHAR(255),
+          WATERMARK FOR ts AS ts - INTERVAL '5' SECOND,
+          PRIMARY KEY (id) NOT ENFORCED
+        ) WITH ('connector' = 'filesystem', 'path' = '/tmp/x.csv')
+    """)
+    assert isinstance(stmt, CreateTableStmt)
+    assert stmt.if_not_exists
+    assert [c.name for c in stmt.columns] == ["id", "amount", "ts", "note"]
+    assert stmt.columns[3].type_name == "VARCHAR(255)"
+    assert stmt.watermark_column == "ts" and stmt.watermark_delay_ms == 5000
+    assert stmt.primary_key == "id"
+    assert stmt.properties == {"connector": "filesystem",
+                               "path": "/tmp/x.csv"}
+
+
+def test_filesystem_ddl_end_to_end(tmp_path):
+    """The verdict's done-criterion: a job defined purely in SQL — DDL
+    source → windowed aggregate → INSERT INTO DDL sink."""
+    src = str(tmp_path / "events.csv")
+    dst = str(tmp_path / "out.csv")
+    with open(src, "w") as f:
+        f.write("k,v,ts\n")
+        for t in range(0, 1000, 10):
+            f.write(f"a,1,{t}\nb,2,{t}\n")
+    tenv = TableEnvironment()
+    tenv.execute_sql(f"""
+        CREATE TABLE events (k STRING, v DOUBLE, ts BIGINT,
+          WATERMARK FOR ts AS ts - INTERVAL '0' SECOND)
+        WITH ('connector' = 'filesystem', 'path' = '{src}',
+              'format' = 'csv')
+    """)
+    tenv.execute_sql(f"""
+        CREATE TABLE win_out (k STRING, total DOUBLE, wstart BIGINT)
+        WITH ('connector' = 'filesystem', 'path' = '{dst}',
+              'format' = 'csv')
+    """)
+    res = tenv.execute_sql(
+        "INSERT INTO win_out "
+        "SELECT k, SUM(v) AS total, TUMBLE_START(ts, INTERVAL '100' "
+        "MILLISECOND) AS wstart FROM events "
+        "GROUP BY k, TUMBLE(ts, INTERVAL '100' MILLISECOND)")
+    assert res.collect()[0]["rows_written"] == 20      # 2 keys x 10 windows
+    from flink_tpu.formats import read_csv
+    rows = [r for b in read_csv(dst) for r in b.to_rows()]
+    assert len(rows) == 20
+    a_rows = [r for r in rows if r["k"] == "a"]
+    assert all(float(r["total"]) == 10.0 for r in a_rows)
+
+
+def test_create_view_and_select(tmp_path):
+    src = str(tmp_path / "d.jsonl")
+    with open(src, "w") as f:
+        for i in range(6):
+            f.write('{"x": %d}\n' % i)
+    tenv = TableEnvironment()
+    tenv.execute_sql(f"CREATE TABLE d (x BIGINT) WITH "
+                     f"('connector'='filesystem', 'path'='{src}', "
+                     f"'format'='jsonl')")
+    tenv.execute_sql("CREATE VIEW big AS SELECT x FROM d WHERE x > 2")
+    rows = tenv.execute_sql("SELECT SUM(x) AS s FROM big").collect()
+    assert rows[0]["s"] == 3 + 4 + 5
+
+
+def test_show_describe_drop(tmp_path):
+    tenv = TableEnvironment()
+    tenv.execute_sql(f"CREATE TABLE t1 (a INT, b STRING) WITH "
+                     f"('connector'='filesystem', "
+                     f"'path'='{tmp_path}/t1.csv')")
+    names = [r["table name"] for r in
+             tenv.execute_sql("SHOW TABLES").collect()]
+    assert names == ["t1"]
+    desc = tenv.execute_sql("DESCRIBE t1").collect()
+    assert desc == [{"name": "a", "type": "INT"},
+                    {"name": "b", "type": "STRING"}]
+    tenv.execute_sql("DROP TABLE t1")
+    assert tenv.execute_sql("SHOW TABLES").collect() == []
+    with pytest.raises(PlanError, match="does not exist"):
+        tenv.execute_sql("DROP TABLE t1")
+    tenv.execute_sql("DROP TABLE IF EXISTS t1")     # no error
+
+
+def test_create_errors(tmp_path):
+    tenv = TableEnvironment()
+    with pytest.raises(PlanError, match="requires a 'connector'"):
+        tenv.execute_sql("CREATE TABLE x (a INT) WITH ('path'='/tmp/x')")
+    tenv.execute_sql(f"CREATE TABLE x (a INT) WITH ("
+                     f"'connector'='filesystem', 'path'='{tmp_path}/x.csv')")
+    with pytest.raises(PlanError, match="already exists"):
+        tenv.execute_sql(f"CREATE TABLE x (a INT) WITH ("
+                         f"'connector'='filesystem', "
+                         f"'path'='{tmp_path}/x.csv')")
+    tenv.execute_sql(f"CREATE TABLE IF NOT EXISTS x (a INT) WITH ("
+                     f"'connector'='filesystem', 'path'='{tmp_path}/x.csv')")
+    with pytest.raises(SqlParseError):
+        tenv.execute_sql("CREATE TABLE bad (a INT)")   # no WITH
+
+
+def test_drop_kind_must_match(tmp_path):
+    tenv = TableEnvironment()
+    tenv.execute_sql(f"CREATE TABLE t (a INT) WITH "
+                     f"('connector'='filesystem', "
+                     f"'path'='{tmp_path}/t.csv')")
+    tenv.execute_sql("CREATE VIEW v AS SELECT a FROM t")
+    with pytest.raises(PlanError, match="is a table, not a view"):
+        tenv.execute_sql("DROP VIEW t")
+    with pytest.raises(PlanError, match="is a view, not a table"):
+        tenv.execute_sql("DROP TABLE v")
+    tenv.execute_sql("DROP VIEW v")
+    tenv.execute_sql("DROP TABLE t")
+    assert tenv.execute_sql("SHOW TABLES").collect() == []
+
+
+def test_kafka_cdc_ddl_is_changelog(tmp_path):
+    """'format'='debezium-json' on a Kafka DDL table decodes envelopes to
+    changelog rows and marks the table a changelog."""
+    import json
+    from flink_tpu.connectors.kafka import KafkaWireBroker, KafkaWireClient
+
+    broker = KafkaWireBroker(directory=str(tmp_path / "kafka")).start()
+    try:
+        broker.create_topic("cdc", partitions=1)
+        envs = [
+            {"before": None, "after": {"k": "a", "v": 10}, "op": "c"},
+            {"before": {"k": "a", "v": 10}, "after": {"k": "a", "v": 20},
+             "op": "u"},
+        ]
+        c = KafkaWireClient(broker.host, broker.port)
+        c.produce("cdc", 0, [(None, json.dumps(e).encode()) for e in envs])
+        c.close()
+        tenv = TableEnvironment()
+        tenv.execute_sql(f"""
+            CREATE TABLE cdc (k STRING, v BIGINT) WITH (
+              'connector' = 'kafka', 'topic' = 'cdc',
+              'properties.bootstrap.servers' =
+                '{broker.host}:{broker.port}',
+              'format' = 'debezium-json')
+        """)
+        assert tenv._catalog["cdc"].changelog
+        rows = tenv.execute_sql("SELECT op, k, v FROM cdc").collect()
+        assert [r["op"] for r in rows] == ["+I", "-U", "+U"]
+        assert rows[-1]["v"] == 20
+        # aggregates over the raw changelog are rejected, not garbage
+        with pytest.raises(PlanError):
+            tenv.execute_sql("SELECT SUM(v) FROM cdc").collect()
+    finally:
+        broker.stop()
+
+
+def test_durable_catalog_survives_restart(tmp_path):
+    src = str(tmp_path / "in.csv")
+    with open(src, "w") as f:
+        f.write("a\n1\n2\n3\n")
+    cat = str(tmp_path / "catalog")
+    t1 = TableEnvironment(catalog_dir=cat)
+    t1.execute_sql(f"CREATE TABLE src (a BIGINT) WITH "
+                   f"('connector'='filesystem', 'path'='{src}', "
+                   f"'format'='csv')")
+    t1.execute_sql("CREATE VIEW doubled AS SELECT a * 2 AS d FROM src")
+    t1.execute_sql(f"CREATE TABLE dropme (z INT) WITH "
+                   f"('connector'='filesystem', "
+                   f"'path'='{tmp_path}/z.csv')")
+    t1.execute_sql("DROP TABLE dropme")
+
+    # a NEW environment replays the persisted DDL
+    t2 = TableEnvironment(catalog_dir=cat)
+    names = [r["table name"] for r in
+             t2.execute_sql("SHOW TABLES").collect()]
+    assert names == ["doubled", "src"]
+    rows = t2.execute_sql("SELECT SUM(d) AS s FROM doubled").collect()
+    assert rows[0]["s"] == 12
+
+
+def test_kafka_ddl_source_and_sink(tmp_path):
+    from flink_tpu.connectors.kafka import KafkaWireBroker, KafkaWireClient
+
+    broker = KafkaWireBroker(directory=str(tmp_path / "kafka")).start()
+    try:
+        broker.create_topic("numbers", partitions=1)
+        tenv = TableEnvironment()
+        tenv.execute_sql(f"""
+            CREATE TABLE numbers (n BIGINT) WITH (
+              'connector' = 'kafka', 'topic' = 'numbers',
+              'properties.bootstrap.servers' =
+                '{broker.host}:{broker.port}')
+        """)
+        import json
+        c = KafkaWireClient(broker.host, broker.port)
+        c.produce("numbers", 0,
+                  [(None, json.dumps({"n": i}).encode()) for i in range(5)])
+        c.close()
+        rows = tenv.execute_sql(
+            "SELECT SUM(n) AS s FROM numbers").collect()
+        assert rows[0]["s"] == 10
+        # sink direction
+        broker.create_topic("out", partitions=1)
+        tenv.execute_sql(f"""
+            CREATE TABLE out (n BIGINT) WITH (
+              'connector' = 'kafka', 'topic' = 'out',
+              'properties.bootstrap.servers' =
+                '{broker.host}:{broker.port}')
+        """)
+        res = tenv.execute_sql(
+            "INSERT INTO out SELECT n FROM numbers WHERE n > 2")
+        assert res.collect()[0]["rows_written"] == 2
+    finally:
+        broker.stop()
+
+
+def test_postgres_ddl_source_and_sink():
+    from flink_tpu.connectors.postgres import (PostgresWireClient,
+                                               PostgresWireServer)
+
+    srv = PostgresWireServer()
+    try:
+        with PostgresWireClient(srv.host, srv.port) as c:
+            c.execute("CREATE TABLE people (id int8, age int8)")
+            c.execute("INSERT INTO people (id, age) VALUES "
+                      "(1, 30), (2, 40), (3, 50)")
+            c.execute("CREATE TABLE adults (id int8, age int8)")
+        tenv = TableEnvironment()
+        tenv.execute_sql(f"""
+            CREATE TABLE people (id BIGINT, age BIGINT) WITH (
+              'connector' = 'postgres', 'hostname' = '{srv.host}',
+              'port' = '{srv.port}', 'table-name' = 'people',
+              'scan.partition.column' = 'id')
+        """)
+        tenv.execute_sql(f"""
+            CREATE TABLE adults (id BIGINT, age BIGINT) WITH (
+              'connector' = 'postgres', 'hostname' = '{srv.host}',
+              'port' = '{srv.port}', 'table-name' = 'adults')
+        """)
+        res = tenv.execute_sql(
+            "INSERT INTO adults SELECT id, age FROM people WHERE age > 35")
+        assert res.collect()[0]["rows_written"] == 2
+        with PostgresWireClient(srv.host, srv.port) as c:
+            cols = c.query_columns("SELECT id FROM adults ORDER BY id")
+        assert cols["id"].tolist() == [2, 3]
+    finally:
+        srv.close()
