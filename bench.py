@@ -34,10 +34,18 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# JAX_PLATFORMS=cpu smoke-runs the bench without touching the one chip
+# (the site hook would otherwise override the env var)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from flink_tpu.utils.platform import honor_jax_platforms  # noqa: E402
+
+honor_jax_platforms()
 
 
 def make_batches(n_records: int, n_keys: int, batch_size: int, window_ms: int,
@@ -354,6 +362,433 @@ def run_numpy_baseline(batches, window_ms: int):
     return n / elapsed, fired
 
 
+# ---------------------------------------------------------------------------
+# BASELINE.md configs 1/3/4/5 (config 2 — the 1M-key tumbling sum — is the
+# headline path below; these run via --config N)
+# ---------------------------------------------------------------------------
+
+
+def _best_of(fn, passes: int):
+    """Best-of-N timed passes with GC paused (same methodology as the
+    headline run; this host shows episodic multi-second slowdowns)."""
+    import gc
+    best = None
+    for _ in range(passes):
+        gc.disable()
+        try:
+            res = fn()
+        finally:
+            gc.enable()
+        if best is None or res[0] > best[0]:
+            best = res
+    return best
+
+
+def _drain(op, batches, key_col="k"):
+    """Feed (cols, ts) batches through an operator with per-batch
+    watermarks; returns (records, fired rows, elapsed_s)."""
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    t0 = time.perf_counter()
+    n = 0
+    fired = 0
+    for cols, ts in batches:
+        out = op.process_batch(RecordBatch(cols, timestamps=ts))
+        out += op.process_watermark(Watermark(int(ts.max()) - 1))
+        fired += sum(len(b) for b in out if hasattr(b, "columns"))
+        n += len(ts)
+    tail = op.end_input()
+    fired += sum(len(b) for b in tail if hasattr(b, "columns"))
+    if tail and hasattr(tail[-1], "columns"):
+        cols = tail[-1].columns
+        np.asarray(next(iter(cols.values())))   # block until on host
+    return n, fired, time.perf_counter() - t0
+
+
+def _result(cfg: int, metric: str, rps: float, heap_rps: float,
+            extra: dict) -> dict:
+    return {
+        "metric": metric,
+        "value": round(rps, 1),
+        "unit": "records/sec",
+        "config": cfg,
+        "vs_baseline": round(rps / heap_rps, 3),
+        "details": {"heap_baseline_rps": round(heap_rps, 1), **extra},
+    }
+
+
+# ---- config 1: socket-style WordCount (Tumbling 5s count per word) --------
+
+def _make_lines(n_words: int, vocab: int, seed: int = 11):
+    """Text lines (10 words each), Zipf word frequencies — the
+    SocketWindowWordCount input shape.  Returns [(lines, ts_ms)]."""
+    rng = np.random.default_rng(seed)
+    words = np.asarray([f"w{i:05d}" for i in range(vocab)], object)
+    ranks = rng.zipf(1.3, n_words).astype(np.int64) % vocab
+    flat = words[ranks]
+    per_line = 10
+    lines = [" ".join(flat[i:i + per_line])
+             for i in range(0, n_words, per_line)]
+    batches = []
+    bsz = 4096                       # lines per batch (~41k words)
+    t = 0
+    for lo in range(0, len(lines), bsz):
+        chunk = lines[lo:lo + bsz]
+        ts = t + np.sort(rng.integers(0, 1000, len(chunk))).astype(np.int64)
+        t += 1000
+        batches.append((chunk, ts))
+    return batches
+
+
+def run_config1(smoke: bool) -> dict:
+    """WordCount: tokenize lines (the flatMap), keyBy(word),
+    Tumbling(5s) count — ``SocketWindowWordCount.java:69-84``.  The socket
+    is not benchmarked (that would measure the kernel's TCP stack);
+    tokenization IS in the timed region on both sides."""
+    import jax.numpy as jnp
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    n_words = 1 << 17 if smoke else 1 << 22
+    batches = _make_lines(n_words, vocab=30_000)
+
+    def tokenize(chunk, ts):
+        words, wts = [], []
+        for line, t in zip(chunk, ts.tolist()):
+            ws = line.split()
+            words.extend(ws)
+            wts.extend([t] * len(ws))
+        return (np.asarray(words, object),
+                np.ones(len(words), np.float32),
+                np.asarray(wts, np.int64))
+
+    def mk_op():
+        op = WindowAggOperator(
+            TumblingEventTimeWindows.of(5000), SumAggregator(jnp.float32),
+            key_column="k", value_column="v", emit_tier="host",
+            snapshot_source="mirror", device_sync="auto")
+        op.open(RuntimeContext())
+        return op
+
+    op = mk_op()
+    for chunk, ts in batches[:2]:            # warm compiles
+        k, v, wts = tokenize(chunk, ts)
+        op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=wts))
+    op.reset_state()
+
+    def tpu_pass():
+        op.reset_state()
+        t0 = time.perf_counter()
+        n = fired = 0
+        for chunk, ts in batches:
+            k, v, wts = tokenize(chunk, ts)
+            out = op.process_batch(RecordBatch({"k": k, "v": v},
+                                               timestamps=wts))
+            out += op.process_watermark(Watermark(int(wts[-1]) - 1))
+            fired += sum(len(b) for b in out if hasattr(b, "columns"))
+            n += len(k)
+        tail = op.end_input()
+        fired += sum(len(b) for b in tail if hasattr(b, "columns"))
+        return n / (time.perf_counter() - t0), fired
+
+    rps, fired = _best_of(tpu_pass, 2 if smoke else 3)
+
+    def heap_pass():
+        state = {}
+        t0 = time.perf_counter()
+        n = fired = 0
+        for chunk, ts in batches:
+            tl = ts.tolist()
+            for line, t in zip(chunk, tl):
+                for w in line.split():
+                    sk = (w, t // 5000)
+                    state[sk] = state.get(sk, 0) + 1
+                    n += 1
+            wm = tl[-1] - 1
+            done = [sk for sk in state if (sk[1] + 1) * 5000 - 1 <= wm]
+            for sk in done:
+                state.pop(sk)
+                fired += 1
+            if time.perf_counter() - t0 > (3.0 if smoke else 20.0):
+                break
+        return n / (time.perf_counter() - t0), fired
+
+    heap_rps, _hf = _best_of(heap_pass, 2)
+    return _result(
+        1, "records/sec/chip (WordCount words, Tumbling 5s count)",
+        rps, heap_rps, {"windows_fired": fired, "n_words": n_words,
+                        "tokenize_in_timed_region": True})
+
+
+# ---- config 3: Sliding(60s, 5s) multi-field aggregate ---------------------
+
+def run_config3(smoke: bool) -> dict:
+    """Sliding(60s,5s) multi-field AggregateFunction (sum/count/min/max →
+    avg): the pane-combine shape of ``HeapWindowsGrouping.java``; the heap
+    baseline is the reference ``WindowOperator`` per-record behavior — each
+    element updates all 12 covering windows."""
+    import jax.numpy as jnp
+    from flink_tpu.core.functions import (CountAggregator, MaxAggregator,
+                                          MinAggregator, RuntimeContext,
+                                          SumAggregator, TupleAggregator)
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+
+    n = 1 << 17 if smoke else 1 << 23
+    n_keys = 100_000
+    rng = np.random.default_rng(13)
+    batches = []
+    t = 0
+    bsz = 1 << 17
+    for lo in range(0, n, bsz):
+        b = min(bsz, n - lo)
+        keys = rng.integers(0, n_keys, b).astype(np.int64)
+        vals = rng.random(b).astype(np.float32)
+        ts = t + np.sort(rng.integers(0, 5000, b)).astype(np.int64)
+        t += 5000
+        batches.append(({"k": keys, "v": vals}, ts))
+
+    def mk_agg():
+        return TupleAggregator({
+            "total": ("v", SumAggregator(jnp.float32)),
+            "n": ("v", CountAggregator()),
+            "lo": ("v", MinAggregator(jnp.float32)),
+            "hi": ("v", MaxAggregator(jnp.float32)),
+        })
+
+    op = WindowAggOperator(
+        SlidingEventTimeWindows.of(60_000, 5_000), mk_agg(),
+        key_column="k", value_selector=lambda c: c,
+        emit_tier="host", snapshot_source="mirror", device_sync="auto")
+    op.open(RuntimeContext())
+    _drain(op, batches[:2])                  # warm compiles
+
+    def tpu_pass():
+        op.reset_state()
+        nn, fired, el = _drain(op, batches)
+        return nn / el, fired
+
+    rps, fired = _best_of(tpu_pass, 2 if smoke else 3)
+
+    def heap_pass():
+        state = {}
+        t0 = time.perf_counter()
+        nn = fired = 0
+        for cols, ts in batches:
+            kl = cols["k"].tolist()
+            vl = cols["v"].tolist()
+            tl = ts.tolist()
+            for k, v, tt in zip(kl, vl, tl):
+                # every element joins the 12 sliding windows covering it
+                last = tt // 5000
+                for w in range(max(0, last - 11), last + 1):
+                    sk = (k, w)
+                    acc = state.get(sk)
+                    if acc is None:
+                        state[sk] = [v, 1, v, v]
+                    else:
+                        acc[0] += v
+                        acc[1] += 1
+                        if v < acc[2]:
+                            acc[2] = v
+                        if v > acc[3]:
+                            acc[3] = v
+                nn += 1
+            wm = tl[-1] - 1
+            done = [sk for sk in state
+                    if sk[1] * 5000 + 60_000 - 1 <= wm]
+            for sk in done:
+                state.pop(sk)
+                fired += 1
+            if time.perf_counter() - t0 > (3.0 if smoke else 20.0):
+                break
+        return nn / (time.perf_counter() - t0), fired
+
+    heap_rps, _hf = _best_of(heap_pass, 2)
+    return _result(
+        3, "records/sec/chip (Sliding 60s/5s multi-field sum/count/min/max)",
+        rps, heap_rps, {"windows_fired": fired, "n_records": n,
+                        "n_keys": n_keys})
+
+
+# ---- config 4: session windows + Zipf keys --------------------------------
+
+def run_config4(smoke: bool) -> dict:
+    """Session windows (gap merge) under Zipf key skew —
+    ``MergingWindowSet.java`` / ``WindowOperator.java:311-411``."""
+    import jax.numpy as jnp
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.session_window import SessionWindowOperator
+    from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+    n = 1 << 16 if smoke else 1 << 21
+    n_keys = 100_000
+    gap = 1000
+    rng = np.random.default_rng(17)
+    batches = []
+    t = 0
+    bsz = 1 << 15
+    for lo in range(0, n, bsz):
+        b = min(bsz, n - lo)
+        keys = (rng.zipf(1.3, b).astype(np.int64) - 1) % n_keys
+        vals = rng.random(b).astype(np.float32)
+        # bursts with inter-burst silence > gap, so sessions CLOSE
+        ts = t + np.sort(rng.integers(0, 800, b)).astype(np.int64)
+        t += 3000
+        batches.append(({"k": keys, "v": vals}, ts))
+
+    def mk_op():
+        op = SessionWindowOperator(
+            EventTimeSessionWindows(gap), SumAggregator(jnp.float32),
+            key_column="k", value_column="v")
+        op.open(RuntimeContext())
+        return op
+
+    op = mk_op()
+    _drain(op, batches[:2])                  # warm compiles
+
+    def tpu_pass():
+        o = mk_op()                          # session op: fresh state
+        nn, fired, el = _drain(o, batches)
+        return nn / el, fired
+
+    rps, fired = _best_of(tpu_pass, 2 if smoke else 3)
+
+    def heap_pass():
+        # MergingWindowSet analog: per key a list of (start, end, acc)
+        sessions: dict = {}
+        t0 = time.perf_counter()
+        nn = fired = 0
+        for cols, ts in batches:
+            kl = cols["k"].tolist()
+            vl = cols["v"].tolist()
+            tl = ts.tolist()
+            for k, v, tt in zip(kl, vl, tl):
+                lst = sessions.setdefault(k, [])
+                new = [tt, tt + gap, v]
+                merged = []
+                for s in lst:
+                    if s[0] <= new[1] and new[0] <= s[1]:  # overlap: merge
+                        new = [min(s[0], new[0]), max(s[1], new[1]),
+                               s[2] + new[2]]
+                    else:
+                        merged.append(s)
+                merged.append(new)
+                sessions[k] = merged
+                nn += 1
+            wm = tl[-1] - 1
+            for k in list(sessions):
+                keep = []
+                for s in sessions[k]:
+                    if s[1] - 1 <= wm:
+                        fired += 1
+                    else:
+                        keep.append(s)
+                if keep:
+                    sessions[k] = keep
+                else:
+                    del sessions[k]
+            if time.perf_counter() - t0 > (3.0 if smoke else 20.0):
+                break
+        return nn / (time.perf_counter() - t0), fired
+
+    heap_rps, _hf = _best_of(heap_pass, 2)
+    return _result(
+        4, "records/sec/chip (session windows gap=1s, Zipf keys)",
+        rps, heap_rps, {"sessions_fired": fired, "n_records": n,
+                        "gap_ms": gap})
+
+
+# ---- config 5: SQL TUMBLE/HOP over a lineitem stream ----------------------
+
+def _lineitem(n: int, seed: int = 19):
+    rng = np.random.default_rng(seed)
+    flags = np.asarray(["A", "N", "R"], object)
+    return {
+        "l_returnflag": flags[rng.integers(0, 3, n)],
+        "l_quantity": rng.integers(1, 51, n).astype(np.float64),
+        "l_extendedprice": (rng.random(n) * 1000).astype(np.float64),
+        "l_discount": (rng.random(n) * 0.1).astype(np.float64),
+        "ts": np.sort(rng.integers(0, 120_000, n)).astype(np.int64),
+    }
+
+
+def run_config5(smoke: bool) -> dict:
+    """SQL TUMBLE and HOP GroupWindowAggregate over a TPC-H-like lineitem
+    stream — ``StreamExecGroupWindowAggregate.java:103``.  Timed region =
+    plan + execute + collect (the whole executeSql path)."""
+    from flink_tpu.sql.table_env import TableEnvironment
+
+    n = 1 << 16 if smoke else 1 << 22
+    cols = _lineitem(n)
+    tumble_sql = (
+        "SELECT l_returnflag, "
+        "TUMBLE_START(ts, INTERVAL '5' SECOND) AS ws, "
+        "SUM(l_extendedprice * (1 - l_discount)) AS revenue, "
+        "SUM(l_quantity) AS qty, COUNT(*) AS n FROM lineitem "
+        "GROUP BY l_returnflag, TUMBLE(ts, INTERVAL '5' SECOND)")
+    hop_sql = (
+        "SELECT l_returnflag, "
+        "HOP_START(ts, INTERVAL '5' SECOND, INTERVAL '60' SECOND) AS ws, "
+        "SUM(l_extendedprice * (1 - l_discount)) AS revenue, "
+        "COUNT(*) AS n FROM lineitem "
+        "GROUP BY l_returnflag, "
+        "HOP(ts, INTERVAL '5' SECOND, INTERVAL '60' SECOND)")
+
+    def sql_pass(sql):
+        def run():
+            tenv = TableEnvironment()
+            tenv.register_collection("lineitem", columns=cols,
+                                     rowtime="ts", batch_size=1 << 17)
+            t0 = time.perf_counter()
+            rows = tenv.execute_sql(sql).collect()
+            return n / (time.perf_counter() - t0), len(rows)
+        return run
+
+    warm = sql_pass(tumble_sql)()            # warm compiles
+    t_rps, t_rows = _best_of(sql_pass(tumble_sql), 2 if smoke else 3)
+    h_rps, h_rows = _best_of(sql_pass(hop_sql), 1 if smoke else 2)
+
+    def heap_pass():
+        state: dict = {}
+        t0 = time.perf_counter()
+        fl = cols["l_returnflag"].tolist()
+        qty = cols["l_quantity"].tolist()
+        price = cols["l_extendedprice"].tolist()
+        disc = cols["l_discount"].tolist()
+        tl = cols["ts"].tolist()
+        nn = 0
+        for f, q, p, d, tt in zip(fl, qty, price, disc, tl):
+            sk = (f, tt // 5000)
+            acc = state.get(sk)
+            rev = p * (1 - d)
+            if acc is None:
+                state[sk] = [rev, q, 1]
+            else:
+                acc[0] += rev
+                acc[1] += q
+                acc[2] += 1
+            nn += 1
+            if nn % 65536 == 0 and \
+                    time.perf_counter() - t0 > (3.0 if smoke else 20.0):
+                break
+        return nn / (time.perf_counter() - t0), len(state)
+
+    heap_rps, _groups = _best_of(heap_pass, 2)
+    return _result(
+        5, "records/sec/chip (SQL TUMBLE 5s lineitem revenue aggregate)",
+        t_rps, heap_rps,
+        {"tumble_result_rows": t_rows, "hop_rps": round(h_rps, 1),
+         "hop_result_rows": h_rows, "n_records": n,
+         "warmup_rps": round(warm[0], 1)})
+
+
+CONFIG_RUNNERS = {1: run_config1, 3: run_config3, 4: run_config4,
+                  5: run_config5}
+
+
 def check_budget(result: dict, budget: dict) -> list:
     """Compare one bench result against a BENCH_BUDGET.json section; returns
     human-readable violations (empty = pass).  The in-repo regression gate
@@ -395,7 +830,28 @@ def main():
     ap.add_argument("--check", action="store_true",
                     help="exit nonzero if the result violates "
                          "BENCH_BUDGET.json (regression gate)")
+    ap.add_argument("--config", type=int, default=2, choices=[1, 2, 3, 4, 5],
+                    help="BASELINE.md config: 1=WordCount, 2=1M-key "
+                         "tumbling (headline, default), 3=sliding "
+                         "multi-field, 4=session+Zipf, 5=SQL TUMBLE/HOP")
     args = ap.parse_args()
+
+    if args.config != 2:
+        result = CONFIG_RUNNERS[args.config](args.smoke)
+        print(json.dumps(result))
+        if args.check:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BUDGET.json")
+            with open(path) as f:
+                budget = json.load(f).get(f"config{args.config}")
+            if budget is not None:
+                ok = result["value"] >= budget["min_rps"]
+                if not ok:
+                    print(f"# BUDGET VIOLATION: rec/s {result['value']:.0f}"
+                          f" < floor {budget['min_rps']:.0f}",
+                          file=sys.stderr)
+                sys.exit(0 if ok else 1)
+        return
 
     n_records = args.records or (1 << 18 if args.smoke else 1 << 24)
     n_keys = min(args.keys, n_records)
@@ -494,7 +950,6 @@ def main():
     print(json.dumps(result))
     print(f"# details: {json.dumps(detail)}", file=sys.stderr)
     if args.check:
-        import os
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_BUDGET.json")
         with open(path) as f:

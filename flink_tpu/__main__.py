@@ -12,8 +12,15 @@ just uses a module-level ``env = StreamExecutionEnvironment()`` pipeline
 from __future__ import annotations
 
 import argparse
+import os
 import runpy
 import sys
+
+# Cluster workers spawned from a CPU-forced test context must stay on CPU
+# instead of dialing the one shared (possibly busy) real chip.
+from flink_tpu.utils.platform import honor_jax_platforms
+
+honor_jax_platforms()
 
 
 def _cmd_run(args) -> int:

@@ -203,9 +203,30 @@ class _WorkerRuntime:
     def __init__(self, index: int, n_workers: int, job: str,
                  coord_host: str, coord_port: int,
                  bind_host: str = "127.0.0.1",
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 local_recovery_dir: Optional[str] = None):
         from flink_tpu.cluster.net import ChannelServer
 
+        #: local recovery (TaskLocalStateStoreImpl.java:54): secondary
+        #: worker-local snapshot copies; restore prefers them over the
+        #: coordinator-shipped (remote-storage) state
+        self.local_store = None
+        #: run scoping: only checkpoints of THIS cluster run restore from
+        #: the local store (ids restart per run; a reused dir must not
+        #: serve a previous run's chk-N files)
+        self.run_token = os.environ.get("FLINK_TPU_RUN_TOKEN")
+        if local_recovery_dir is None:
+            local_recovery_dir = os.environ.get("FLINK_TPU_LOCAL_RECOVERY")
+        if local_recovery_dir:
+            from flink_tpu.runtime.checkpoint.local import TaskLocalStateStore
+            scoped = (os.path.join(local_recovery_dir,
+                                   f"run-{self.run_token}")
+                      if self.run_token else local_recovery_dir)
+            self.local_store = TaskLocalStateStore(scoped, index)
+        #: per-deploy counters, reported to the coordinator after each
+        #: restore so tests (and operators) can assert local-recovery hits
+        self.recovery_local = 0
+        self.recovery_remote = 0
         self.index = index
         self.n_workers = n_workers
         self.job = job
@@ -275,6 +296,11 @@ class _WorkerRuntime:
     def acknowledge_checkpoint(self, checkpoint_id: int, vertex_uid: str,
                                subtask_index: int,
                                snapshot: Dict[str, Any]) -> None:
+        if self.local_store is not None:
+            # secondary local copy BEFORE the ack ships: a same-worker
+            # restart restores from here without touching remote storage
+            self.local_store.store(checkpoint_id, vertex_uid,
+                                   subtask_index, snapshot)
         self._send(("ack", checkpoint_id, vertex_uid, subtask_index,
                     snapshot))
 
@@ -389,6 +415,31 @@ class _WorkerRuntime:
         # while deploy is mid-flight must not trip the all-terminal check
         # against a partial task list
         restore = restore or {}
+        job_meta = restore.get("__job__") or {}
+        restore_cid = job_meta.get("checkpoint_id")
+        # the local store only serves checkpoints taken by THIS run: a
+        # cross-run restore (snap passed into a fresh cluster) carries a
+        # different run token and must read the shipped state
+        same_run = (self.run_token is not None
+                    and job_meta.get("run_token") == self.run_token)
+        self.recovery_local = 0
+        self.recovery_remote = 0
+
+        def pick_restore(uid: str, i: int, sub_snaps) -> Optional[Dict]:
+            """Local-recovery preference: this worker's own local copy of
+            (checkpoint, uid, subtask) wins over the coordinator-shipped
+            remote state; the shipped copy is the fallback."""
+            shipped = sub_snaps[i] if i < len(sub_snaps) else None
+            if self.local_store is not None and restore_cid is not None \
+                    and same_run:
+                local = self.local_store.load(restore_cid, uid, i)
+                if local is not None:
+                    self.recovery_local += 1
+                    return local
+                if shipped is not None:
+                    self.recovery_remote += 1
+            return shipped
+
         to_start: List[Tuple[Any, Optional[Dict[str, Any]]]] = []
         for v in plan.vertices:
             vr = restore.get(v.uid, {})
@@ -411,9 +462,8 @@ class _WorkerRuntime:
                             outputs[v.id][i], ctx, self, None,
                             split_requester=self._make_split_requester(
                                 v.uid, i))
-                        to_start.append(
-                            (t, sub_snaps[i] if i < len(sub_snaps)
-                             else None))
+                        to_start.append((t, pick_restore(v.uid, i,
+                                                         sub_snaps)))
                     continue
                 for i, split in enumerate(splits):
                     if assign[(v.uid, i)] != me or not wanted(v.uid, i):
@@ -423,8 +473,7 @@ class _WorkerRuntime:
                                          max_parallelism=v.max_parallelism)
                     t = SourceSubtask(v.uid, i, v.build_operator(),
                                       outputs[v.id][i], ctx, self, split)
-                    to_start.append(
-                        (t, sub_snaps[i] if i < len(sub_snaps) else None))
+                    to_start.append((t, pick_restore(v.uid, i, sub_snaps)))
             else:
                 for i in range(n_subs(v)):
                     if assign[(v.uid, i)] != me or not wanted(v.uid, i):
@@ -436,8 +485,7 @@ class _WorkerRuntime:
                                 outputs[v.id][i], ctx, self,
                                 inputs[v.id][i],
                                 input_logical=input_logical[v.id][i])
-                    to_start.append(
-                        (t, sub_snaps[i] if i < len(sub_snaps) else None))
+                    to_start.append((t, pick_restore(v.uid, i, sub_snaps)))
         if only is None:
             self.tasks = [t for t, _ in to_start]
         else:
@@ -484,12 +532,19 @@ class _WorkerRuntime:
                 self.deploy(msg[1], msg[2],
                             only=set(msg[3]) if len(msg) > 3
                             and msg[3] is not None else None)
+                if msg[2] and (self.recovery_local
+                               or self.recovery_remote):
+                    self._send(("recovery_stats", self.index,
+                                self.recovery_local,
+                                self.recovery_remote))
             elif kind == "checkpoint":
                 cid = msg[1]
                 for t in self.tasks:
                     if hasattr(t, "split"):  # source: inject barrier
                         t.commands.put(("checkpoint", cid))
             elif kind == "notify":
+                if self.local_store is not None:
+                    self.local_store.confirm(msg[1])
                 for t in self.tasks:
                     t.commands.put(("notify_complete", msg[1]))
             elif kind == "split_assign":
@@ -603,11 +658,23 @@ class ProcessCluster:
                  extra_sys_path: Tuple[str, ...] = (), security=None,
                  spawn: bool = True, bind_host: str = "127.0.0.1",
                  listen_port: int = 0, restart_attempts: int = 0,
-                 restart_delay_ms: int = 500, worker_recovery: bool = True):
+                 restart_delay_ms: int = 500, worker_recovery: bool = True,
+                 local_recovery_dir: Optional[str] = None):
         self.job = job
         self.n_workers = n_workers
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
+        #: local recovery: workers keep secondary snapshot copies under
+        #: this directory and restore from them on same-worker restarts
+        #: (``state.backend.local-recovery`` analog); stats from workers
+        #: land in ``recovery_stats`` as (worker, local_hits, remote_reads)
+        self.local_recovery_dir = local_recovery_dir
+        self.recovery_stats: List[Tuple[int, int, int]] = []
+        #: run fingerprint: local-store entries are scoped to ONE cluster
+        #: run — a reused local_recovery_dir must never serve a previous
+        #: run's chk-N files (checkpoint ids restart at 1 per run)
+        import uuid
+        self.run_token = uuid.uuid4().hex[:16]
         self.extra_sys_path = tuple(extra_sys_path)
         #: optional SecurityConfig: mutual TLS on control + data plane and/or
         #: an HMAC token handshake on worker registration
@@ -747,6 +814,9 @@ class ProcessCluster:
                     env["FLINK_TPU_AUTH_TOKEN"] = self.security.auth_token
             # failure-injection hooks / logs can key on the execution attempt
             env["FLINK_TPU_ATTEMPT"] = str(attempt)
+            if self.local_recovery_dir:
+                env["FLINK_TPU_LOCAL_RECOVERY"] = self.local_recovery_dir
+                env["FLINK_TPU_RUN_TOKEN"] = self.run_token
             procs = [self._spawn_worker(i, cport)
                      for i in range(self.n_workers)]
         self._procs = procs  # chaos tests / operators can observe pids
@@ -1147,6 +1217,9 @@ class ProcessCluster:
                         p.expected.discard((uid, i))
                         if len(p.acks) >= len(p.expected):
                             self._complete(p)
+            elif kind == "recovery_stats":
+                with self._lock:
+                    self.recovery_stats.append((msg[1], msg[2], msg[3]))
             elif kind == "final":
                 _, uid, i, snap = msg
                 with self._lock:
@@ -1204,6 +1277,7 @@ class ProcessCluster:
         ``MiniCluster._complete_checkpoint`` incl. FLIP-147 finals."""
         assembled: Dict[str, Any] = {"__job__": {
             "checkpoint_id": p.cid,
+            "run_token": self.run_token,
             "parallelism": dict(self._counts)}}
         if p.enumerators:
             assembled["__enumerators__"] = p.enumerators

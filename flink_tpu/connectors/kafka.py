@@ -187,6 +187,8 @@ _ERR_REBALANCE_IN_PROGRESS = 27
 _ERR_UNSUPPORTED_SASL_MECHANISM = 33
 _ERR_ILLEGAL_SASL_STATE = 34
 _ERR_SASL_AUTHENTICATION_FAILED = 58
+_ERR_INVALID_PRODUCER_EPOCH = 47
+_ERR_INVALID_TXN_STATE = 48
 _ERR_UNKNOWN = -1
 
 _API_SASL_HANDSHAKE = 17
@@ -201,6 +203,10 @@ _API_METADATA, _API_VERSIONS = 3, 18
 _API_OFFSET_COMMIT, _API_OFFSET_FETCH = 8, 9
 _API_FIND_COORDINATOR, _API_JOIN_GROUP = 10, 11
 _API_HEARTBEAT, _API_LEAVE_GROUP, _API_SYNC_GROUP = 12, 13, 14
+_API_INIT_PRODUCER_ID = 22
+_API_ADD_PARTITIONS_TO_TXN = 24
+_API_END_TXN = 26
+_API_LIST_TRANSACTIONS = 66
 
 #: how long a rebalance waits for every member to rejoin before expelling
 #: stragglers (the broker-side group.initial.rebalance.delay analog)
@@ -259,6 +265,17 @@ class KafkaWireBroker:
         self._lock = threading.Lock()
         #: topic -> partition -> list[(offset, key, value, timestamp_ms)]
         self._logs: Dict[str, List[List[Tuple[int, bytes, bytes, int]]]] = {}
+        #: KIP-98 transactions: transactional_id -> {pid, epoch, state,
+        #: staged {(topic, part): [(key, value, ts), ...]}}.  Transactional
+        #: produces buffer broker-side and append ATOMICALLY at EndTxn
+        #: commit — the log only ever holds committed data, so every
+        #: consumer observes read-committed isolation (the reference broker
+        #: appends eagerly and filters via abort markers + LSO instead)
+        self._txns: Dict[str, Dict[str, Any]] = {}
+        self._next_pid = 1000
+        #: committed transactional ids — EndTxn(commit) replays
+        #: idempotently (the 2PC sink's recover-and-commit path)
+        self._committed_tids: set = set()
         #: consumer groups under a dedicated lock: JoinGroup BLOCKS (the
         #: rebalance barrier) and must not hold the log lock while waiting
         self._groups: Dict[str, _Group] = {}
@@ -311,6 +328,25 @@ class KafkaWireBroker:
                     for key, off in offs.items():
                         topic, _, part = key.rpartition("@")
                         g.offsets[(topic, int(part))] = off
+        tcf = os.path.join(self.directory, "_txn_commits.json")
+        if os.path.exists(tcf):
+            with open(tcf) as f:
+                self._committed_tids = set(json.load(f))
+        self._load_txns()
+
+    def _persist_txn_commits_locked(self) -> None:
+        """Committed transactional ids survive restarts: a 2PC sink's
+        recover-and-commit replay must stay idempotent across broker
+        crashes (the __transaction_state topic analog)."""
+        if not self.directory:
+            return
+        import json
+        tmp = os.path.join(self.directory, "_txn_commits.json#tmp")
+        with open(tmp, "w") as f:
+            json.dump(sorted(self._committed_tids), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.directory, "_txn_commits.json"))
 
     def _persist_group_offsets_locked(self) -> None:
         """Committed offsets survive broker restarts (the __consumer_offsets
@@ -437,7 +473,11 @@ class KafkaWireBroker:
                  # v1+ only: the v0 handshake's RAW post-handshake token
                  # frames (no request header) are not spoken here
                  (_API_SASL_HANDSHAKE, 1, 1),
-                 (_API_SASL_AUTHENTICATE, 0, 0)],
+                 (_API_SASL_AUTHENTICATE, 0, 0),
+                 (_API_INIT_PRODUCER_ID, 0, 0),
+                 (_API_ADD_PARTITIONS_TO_TXN, 0, 0),
+                 (_API_END_TXN, 0, 0),
+                 (_API_LIST_TRANSACTIONS, 0, 0)],
                 lambda w, t: w.int16(t[0]).int16(t[1]).int16(t[2]))
         elif api_key == _API_SASL_HANDSHAKE:
             mech = r.string() or ""
@@ -488,6 +528,14 @@ class KafkaWireBroker:
             self._heartbeat(r, w)
         elif api_key == _API_LEAVE_GROUP:
             self._leave_group(r, w)
+        elif api_key == _API_INIT_PRODUCER_ID:
+            self._init_producer_id(r, w)
+        elif api_key == _API_ADD_PARTITIONS_TO_TXN:
+            self._add_partitions_to_txn(r, w)
+        elif api_key == _API_END_TXN:
+            self._end_txn(r, w)
+        elif api_key == _API_LIST_TRANSACTIONS:
+            self._list_transactions(r, w)
         elif api_key == _API_OFFSET_COMMIT and api_version == 2:
             self._offset_commit(r, w)
         elif api_key == _API_OFFSET_FETCH and api_version == 1:
@@ -745,25 +793,179 @@ class KafkaWireBroker:
     def _append(self, topic: str, part: int,
                 records: List[Tuple[Optional[bytes], Optional[bytes], int]]
                 ) -> int:
+        with self._lock:
+            return self._append_locked(topic, part, records)
+
+    def _append_locked(self, topic: str, part: int,
+                       records: List[Tuple[Optional[bytes],
+                                           Optional[bytes], int]]) -> int:
         """Append (key, value, ts) records; returns base offset or -1 for an
         unknown topic/partition.  Disk persistence uses the v2 record-batch
-        format (richer: keeps timestamps); v0 produces store ts=-1."""
-        with self._lock:
-            parts = self._logs.get(topic)
-            if parts is None or not 0 <= part < len(parts):
-                return -1
-            base = len(parts[part])
-            stored = [(base + i, k, v, ts)
-                      for i, (k, v, ts) in enumerate(records)]
-            parts[part].extend(stored)
-            if self.directory:
-                batch = _encode_batch_v2(
-                    base, [(max(ts, 0), k, v, []) for _o, k, v, ts in stored])
-                with open(self._part_path(topic, part), "ab") as f:
-                    f.write(batch)
-                    f.flush()
-                    os.fsync(f.fileno())
+        format (richer: keeps timestamps); v0 produces store ts=-1.
+        Caller holds ``_lock`` (EndTxn commits several partitions under ONE
+        acquisition — the atomicity of the commit)."""
+        parts = self._logs.get(topic)
+        if parts is None or not 0 <= part < len(parts):
+            return -1
+        base = len(parts[part])
+        stored = [(base + i, k, v, ts)
+                  for i, (k, v, ts) in enumerate(records)]
+        parts[part].extend(stored)
+        if self.directory:
+            batch = _encode_batch_v2(
+                base, [(max(ts, 0), k, v, []) for _o, k, v, ts in stored])
+            with open(self._part_path(topic, part), "ab") as f:
+                f.write(batch)
+                f.flush()
+                os.fsync(f.fileno())
         return base
+
+    # -- transactions (KIP-98: InitProducerId / AddPartitionsToTxn /
+    # EndTxn; ListTransactions for recovery enumeration) -------------------
+    def _txn_path(self, tid: str) -> str:
+        import urllib.parse
+        return os.path.join(self.directory,
+                            f"_txn-{urllib.parse.quote(tid, safe='')}.pkl")
+
+    def _persist_txn_locked(self, tid: str) -> None:
+        """OPEN (pre-committed) transactions survive broker restarts: the
+        2PC sink's crash window between pre-commit and commit must not
+        lose the staged records to a broker crash (the real broker gets
+        this from eager log appends + markers; the buffered design
+        persists the txn buffer instead).  Caller holds ``_lock``."""
+        if not self.directory:
+            return
+        import pickle
+        txn = self._txns.get(tid)
+        if txn is None:
+            return
+        tmp = self._txn_path(tid) + "#tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"pid": txn["pid"], "epoch": txn["epoch"],
+                         "state": txn["state"],
+                         "staged": {f"{t}\0{p}": v
+                                    for (t, p), v in txn["staged"].items()}},
+                        f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._txn_path(tid))
+
+    def _remove_txn_file_locked(self, tid: str) -> None:
+        if not self.directory:
+            return
+        try:
+            os.remove(self._txn_path(tid))
+        except FileNotFoundError:
+            pass
+
+    def _load_txns(self) -> None:
+        import pickle
+        import urllib.parse
+        for name in os.listdir(self.directory):
+            if not (name.startswith("_txn-") and name.endswith(".pkl")):
+                continue
+            tid = urllib.parse.unquote(name[len("_txn-"):-len(".pkl")])
+            try:
+                with open(os.path.join(self.directory, name), "rb") as f:
+                    rec = pickle.load(f)
+            except (OSError, pickle.PickleError, EOFError):
+                continue        # torn write: the txn aborts (never acked)
+            staged = {}
+            for key, v in rec["staged"].items():
+                t, _, p = key.rpartition("\0")
+                staged[(t, int(p))] = v
+            self._txns[tid] = {"pid": rec["pid"], "epoch": rec["epoch"],
+                               "state": rec["state"], "staged": staged}
+            self._next_pid = max(self._next_pid, rec["pid"] + 1)
+
+    def _init_producer_id(self, r: _Reader, w: _Writer) -> None:
+        tid = r.string()
+        r.int32()                               # transaction_timeout_ms
+        with self._lock:
+            if tid is None:
+                pid, epoch = self._next_pid, 0
+                self._next_pid += 1
+            else:
+                cur = self._txns.get(tid)
+                if cur is None:
+                    pid, epoch = self._next_pid, 0
+                    self._next_pid += 1
+                    self._txns[tid] = {"pid": pid, "epoch": 0,
+                                       "state": "ready", "staged": {}}
+                else:
+                    # zombie fencing: same tid re-initializes with a BUMPED
+                    # epoch and the old incarnation's ongoing txn aborts
+                    pid = cur["pid"]
+                    epoch = cur["epoch"] + 1
+                    cur.update(epoch=epoch, state="ready", staged={})
+                self._persist_txn_locked(tid)
+        w.int32(0).int16(_ERR_NONE).int64(pid).int16(epoch)
+
+    def _txn_check_locked(self, tid, pid, epoch):
+        txn = self._txns.get(tid)
+        if txn is None:
+            return None, _ERR_INVALID_TXN_STATE
+        if txn["pid"] != pid or txn["epoch"] != epoch:
+            return None, _ERR_INVALID_PRODUCER_EPOCH
+        return txn, _ERR_NONE
+
+    def _add_partitions_to_txn(self, r: _Reader, w: _Writer) -> None:
+        tid = r.string()
+        pid = r.int64()
+        epoch = r.int16()
+        topics = r.array(lambda r: (r.string(),
+                                    r.array(lambda r: r.int32())))
+        with self._lock:
+            txn, err = self._txn_check_locked(tid, pid, epoch)
+            if err == _ERR_NONE:
+                txn["state"] = "ongoing"
+                for t, ps in topics:
+                    for p in ps:
+                        txn["staged"].setdefault((t, p), [])
+                self._persist_txn_locked(tid)
+        w.int32(0).array(topics, lambda w, t: w.string(t[0]).array(
+            t[1], lambda w, p: w.int32(p).int16(err)))
+
+    def _end_txn(self, r: _Reader, w: _Writer) -> None:
+        tid = r.string()
+        pid = r.int64()
+        epoch = r.int16()
+        commit = r.int8() != 0
+        with self._lock:
+            if tid not in self._txns:
+                # no OPEN txn under this id: a commit replay of an already
+                # committed one is idempotent (the recover-and-commit
+                # path); anything else is an error.  The check must not
+                # swallow a NEW txn reusing a previously committed id —
+                # only absent ids answer from the committed set.
+                if commit and tid in self._committed_tids:
+                    w.int32(0).int16(_ERR_NONE)
+                else:
+                    w.int32(0).int16(_ERR_INVALID_TXN_STATE)
+                return
+            txn, err = self._txn_check_locked(tid, pid, epoch)
+            if err != _ERR_NONE:
+                w.int32(0).int16(err)
+                return
+            if commit:
+                # ONE lock acquisition spans every partition append: the
+                # whole transaction becomes visible atomically
+                for (t, p), recs in sorted(txn["staged"].items()):
+                    if recs:
+                        self._append_locked(t, p, recs)
+                self._committed_tids.add(tid)
+                self._persist_txn_commits_locked()
+            del self._txns[tid]
+            self._remove_txn_file_locked(tid)
+        w.int32(0).int16(_ERR_NONE)
+
+    def _list_transactions(self, r: _Reader, w: _Writer) -> None:
+        with self._lock:
+            entries = [(t, x["pid"], x["epoch"], x["state"])
+                       for t, x in self._txns.items()]
+        w.int32(0).int16(_ERR_NONE).array(
+            entries, lambda w, e: w.string(e[0]).int64(e[1]).int16(e[2])
+            .string(e[3]))
 
     def _fetch(self, r: _Reader, w: _Writer) -> None:
         r.int32()                               # replica_id
@@ -802,7 +1004,7 @@ class KafkaWireBroker:
             .bytes_(p[3])))
 
     def _produce_v3(self, r: _Reader, w: _Writer) -> None:
-        r.string()                              # transactional_id
+        tid = r.string()                        # transactional_id
         r.int16()                               # required_acks
         r.int32()                               # timeout
         results = []
@@ -816,6 +1018,24 @@ class KafkaWireBroker:
                     recs = _decode_batches_v2(data)
                 except ValueError:
                     per_part.append((part, _ERR_UNKNOWN, -1))
+                    continue
+                if tid is not None:
+                    # transactional: records stage in the txn buffer (the
+                    # batch's producer id/epoch fence zombie writers) and
+                    # reach the log only at EndTxn commit
+                    from flink_tpu.connectors.kafka_v2 import \
+                        batch_producer_info
+                    pid, pepoch, _txl = batch_producer_info(data)
+                    with self._lock:
+                        txn, err = self._txn_check_locked(tid, pid, pepoch)
+                        if err == _ERR_NONE and txn["state"] != "ongoing":
+                            err = _ERR_INVALID_TXN_STATE
+                        elif err == _ERR_NONE:
+                            txn["staged"].setdefault((topic, part),
+                                                     []).extend(
+                                (k, v, ts) for _o, ts, k, v, _h in recs)
+                            self._persist_txn_locked(tid)
+                    per_part.append((part, err, -1))
                     continue
                 base = self._append(topic, part,
                                     [(k, v, ts) for _o, ts, k, v, _h in recs])
@@ -1037,6 +1257,88 @@ class KafkaWireClient:
                 return base
         raise ValueError("empty produce response")
 
+    # -- transactions (KIP-98 client side) ----------------------------------
+    def init_producer_id(self, transactional_id: Optional[str],
+                         timeout_ms: int = 60_000) -> Tuple[int, int]:
+        """-> (producer_id, producer_epoch); re-initializing an existing
+        transactional id bumps the epoch and fences the old producer."""
+        body = (_Writer().string(transactional_id).int32(timeout_ms).done())
+        r = self._call(_API_INIT_PRODUCER_ID, 0, body)
+        r.int32()                               # throttle
+        err = r.int16()
+        pid, epoch = r.int64(), r.int16()
+        if err:
+            raise KafkaError(f"InitProducerId error {err}")
+        return pid, epoch
+
+    def add_partitions_to_txn(self, transactional_id: str, producer_id: int,
+                              producer_epoch: int,
+                              partitions: Dict[str, List[int]]) -> None:
+        body = (_Writer().string(transactional_id).int64(producer_id)
+                .int16(producer_epoch)
+                .array(sorted(partitions.items()),
+                       lambda w, t: w.string(t[0]).array(
+                           t[1], lambda w, p: w.int32(p)))
+                .done())
+        r = self._call(_API_ADD_PARTITIONS_TO_TXN, 0, body)
+        r.int32()                               # throttle
+        for _ in range(r.int32()):
+            r.string()
+            for _ in range(r.int32()):
+                r.int32()
+                err = r.int16()
+                if err:
+                    raise KafkaError(f"AddPartitionsToTxn error {err}")
+
+    def produce_txn(self, transactional_id: str, producer_id: int,
+                    producer_epoch: int, topic: str, partition: int,
+                    entries: List[Tuple[Optional[bytes], Optional[bytes]]],
+                    timestamp_ms: int = 0) -> None:
+        """Transactional produce (v3, magic-2 batch carrying the producer
+        id/epoch + transactional attribute): records stay invisible until
+        ``end_txn(commit=True)``."""
+        from flink_tpu.connectors.kafka_v2 import encode_record_batch
+        batch = encode_record_batch(
+            0, [(timestamp_ms, k, v, []) for k, v in entries],
+            producer_id=producer_id, producer_epoch=producer_epoch,
+            transactional=True)
+        body = (_Writer().string(transactional_id).int16(-1).int32(10_000)
+                .array([(topic, [(partition, batch)])],
+                       lambda w, t: w.string(t[0]).array(
+                           t[1], lambda w, p: w.int32(p[0]).bytes_(p[1])))
+                .done())
+        r = self._call(_API_PRODUCE, 3, body)
+        for _ in range(r.int32()):
+            r.string()
+            for _ in range(r.int32()):
+                r.int32()
+                err = r.int16()
+                r.int64()                       # base offset (-1: staged)
+                r.int64()                       # log_append_time
+                if err:
+                    raise KafkaError(f"transactional produce error {err}")
+
+    def end_txn(self, transactional_id: str, producer_id: int,
+                producer_epoch: int, commit: bool) -> None:
+        body = (_Writer().string(transactional_id).int64(producer_id)
+                .int16(producer_epoch).int8(1 if commit else 0).done())
+        r = self._call(_API_END_TXN, 0, body)
+        r.int32()                               # throttle
+        err = r.int16()
+        if err:
+            raise KafkaError(f"EndTxn error {err}")
+
+    def list_transactions(self) -> List[Tuple[str, int, int, str]]:
+        """-> [(transactional_id, producer_id, epoch, state)] of every
+        OPEN transaction (recovery enumeration, ListTransactions analog)."""
+        r = self._call(_API_LIST_TRANSACTIONS, 0, b"")
+        r.int32()                               # throttle
+        err = r.int16()
+        if err:
+            raise KafkaError(f"ListTransactions error {err}")
+        return r.array(lambda r: (r.string(), r.int64(), r.int16(),
+                                  r.string()))
+
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 1 << 20
               ) -> Tuple[List[Tuple[int, Optional[bytes], Optional[bytes]]],
@@ -1086,6 +1388,158 @@ class KafkaWireClient:
 # ---------------------------------------------------------------------------
 # source/sink seams
 # ---------------------------------------------------------------------------
+
+class KafkaExactlyOnceSink:
+    """Exactly-once Kafka sink: transactional produce bound to checkpoints
+    — the ``FlinkKafkaProducer.java:100`` two-phase commit.
+
+    One transactional id PER EPOCH (``{sink_id}-s{subtask}-{epoch}``, the
+    same gid scheme as the Postgres 2PC sink): rows buffer locally and
+    flush into the epoch's broker transaction; ``snapshot_state``
+    PRE-COMMITS (flushes; the txn stays open at the broker, recorded with
+    its checkpoint id); ``notify_checkpoint_complete(N)`` commits exactly
+    the epochs staged for checkpoints <= N; ``restore_state`` commits the
+    snapshot's staged epochs (idempotent broker-side replay via the
+    committed-tid set) and aborts every OTHER dangling transaction of this
+    sink enumerated via ListTransactions — a crash between pre-commit and
+    commit neither loses (restore commits) nor duplicates (replayed
+    commits are idempotent; post-checkpoint epochs abort)."""
+
+    clone_per_subtask = True
+
+    def __init__(self, host: str, port: int, topic: str,
+                 key_column: Optional[str] = None, num_partitions: int = 1,
+                 sink_id: str = "kafka-eos", buffer_rows: int = 4096):
+        self.host, self.port = host, port
+        self.topic = topic
+        self.key_column = key_column
+        self.num_partitions = num_partitions
+        self.sink_id = sink_id
+        self.buffer_rows = buffer_rows
+        self._client: Optional[KafkaWireClient] = None
+        self._subtask_index = 0
+        self._epoch = 0
+        self._txn: Optional[Tuple[str, int, int]] = None  # (tid, pid, ep)
+        self._staged: List[Tuple[str, int, int, Optional[int]]] = []
+        self._buf: List[Tuple[Optional[bytes], bytes]] = []
+
+    def _cli(self) -> KafkaWireClient:
+        if self._client is None:
+            self._client = KafkaWireClient(self.host, self.port)
+        return self._client
+
+    def open(self, ctx) -> None:
+        self._subtask_index = getattr(ctx, "subtask_index", 0)
+        self._cli()
+
+    def _tid(self, epoch: int) -> str:
+        return f"{self.sink_id}-s{self._subtask_index}-{epoch}"
+
+    def _begin_txn(self) -> Tuple[str, int, int]:
+        if self._txn is None:
+            tid = self._tid(self._epoch)
+            pid, pepoch = self._cli().init_producer_id(tid)
+            self._cli().add_partitions_to_txn(
+                tid, pid, pepoch,
+                {self.topic: list(range(self.num_partitions))})
+            self._txn = (tid, pid, pepoch)
+        return self._txn
+
+    def write_batch(self, batch) -> None:
+        import json
+        if not len(batch):
+            return
+        for r in batch.to_rows():
+            key = (None if self.key_column is None
+                   else str(r[self.key_column]).encode())
+            self._buf.append(
+                (key, json.dumps(r, default=_json_default).encode()))
+        if len(self._buf) >= self.buffer_rows:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buf:
+            return
+        tid, pid, pepoch = self._begin_txn()
+        if self.num_partitions == 1 or self.key_column is None:
+            parts: Dict[int, List] = {}
+            for i, kv in enumerate(self._buf):
+                parts.setdefault(
+                    0 if self.key_column is not None
+                    else i % self.num_partitions, []).append(kv)
+        else:
+            from flink_tpu.core.keygroups import hash_keys
+            keys = np.asarray([k for k, _v in self._buf], object)
+            pn = np.abs(hash_keys(keys).astype(np.int64)) \
+                % self.num_partitions
+            parts = {}
+            for i, kv in enumerate(self._buf):
+                parts.setdefault(int(pn[i]), []).append(kv)
+        for p, entries in sorted(parts.items()):
+            self._cli().produce_txn(tid, pid, pepoch, self.topic, p,
+                                    entries)
+        self._buf = []
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        from flink_tpu.operators.base import current_checkpoint_id
+        self._flush()
+        if self._txn is not None:
+            tid, pid, pepoch = self._txn
+            # pre-commit: the txn stays OPEN at the broker; only the
+            # matching checkpoint's completion may commit it
+            self._staged.append((tid, pid, pepoch, current_checkpoint_id()))
+            self._txn = None
+            self._epoch += 1
+        return {"epoch": self._epoch, "staged": list(self._staged)}
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        keep = []
+        for tid, pid, pepoch, staged_for in self._staged:
+            if staged_for is not None and staged_for > checkpoint_id:
+                keep.append((tid, pid, pepoch, staged_for))
+                continue
+            self._cli().end_txn(tid, pid, pepoch, commit=True)
+        self._staged = keep
+
+    def end_input(self) -> None:
+        self._flush()
+        if self._txn is not None:
+            tid, pid, pepoch = self._txn
+            self._cli().end_txn(tid, pid, pepoch, commit=True)
+            self._txn = None
+            self._epoch += 1
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self._epoch = int(snap.get("epoch", 0))
+        self._buf = []
+        self._txn = None
+        c = self._cli()
+        committed = set()
+        for tid, pid, pepoch, _cid in snap.get("staged", []):
+            c.end_txn(tid, pid, pepoch, commit=True)   # idempotent replay
+            committed.add(tid)
+        self._staged = []
+        mine = f"{self.sink_id}-s{self._subtask_index}-"
+        for tid, pid, pepoch, _state in c.list_transactions():
+            if not tid or not tid.startswith(mine) or tid in committed:
+                continue
+            try:
+                c.end_txn(tid, pid, pepoch, commit=False)
+            except KafkaError:
+                pass  # raced with another recovering instance
+
+    def close(self) -> None:
+        if self._txn is not None and self._client is not None:
+            tid, pid, pepoch = self._txn
+            try:
+                self._client.end_txn(tid, pid, pepoch, commit=False)
+            except (KafkaError, OSError):
+                pass
+            self._txn = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
 
 class KafkaWireSource:
     """Bounded source over the wire protocol: one split per partition,

@@ -98,9 +98,14 @@ Record = Tuple[int, Optional[bytes], Optional[bytes],
                List[Tuple[str, Optional[bytes]]]]
 
 
-def encode_record_batch(base_offset: int, records: List[Record]) -> bytes:
+def encode_record_batch(base_offset: int, records: List[Record],
+                        producer_id: int = -1, producer_epoch: int = -1,
+                        transactional: bool = False) -> bytes:
     """One magic-2 batch.  CRC32C covers attributes..end (the bytes after
-    the crc field), exactly as brokers verify it."""
+    the crc field), exactly as brokers verify it.  ``producer_id`` /
+    ``producer_epoch`` + the transactional attribute bit (0x10) mark the
+    batch as part of a transaction — the broker fences stale epochs with
+    them (KIP-98 exactly-once produce)."""
     if not records:
         return b""
     base_ts = min(r[0] for r in records)
@@ -135,8 +140,10 @@ def encode_record_batch(base_offset: int, records: List[Record]) -> bytes:
         recs += body
     # attributes(2) lastOffsetDelta(4) baseTs(8) maxTs(8) producerId(8)
     # producerEpoch(2) baseSequence(4) recordCount(4)
-    after_crc = struct.pack(">hiqqqhii", 0, len(records) - 1, base_ts,
-                            max_ts, -1, -1, -1, len(records)) + bytes(recs)
+    attrs = 0x10 if transactional else 0
+    after_crc = struct.pack(">hiqqqhii", attrs, len(records) - 1, base_ts,
+                            max_ts, producer_id, producer_epoch, -1,
+                            len(records)) + bytes(recs)
     crc = crc32c(after_crc)
     # partitionLeaderEpoch(4) magic(1) crc(4) + after_crc
     batch_tail = struct.pack(">ibI", 0, 2, crc) + after_crc
@@ -146,6 +153,20 @@ def encode_record_batch(base_offset: int, records: List[Record]) -> bytes:
 #: decoded record: (offset, timestamp_ms, key, value, headers)
 DecodedRecord = Tuple[int, int, Optional[bytes], Optional[bytes],
                       List[Tuple[str, Optional[bytes]]]]
+
+
+def batch_producer_info(data: bytes) -> Tuple[int, int, bool]:
+    """(producer_id, producer_epoch, transactional) of the FIRST batch in
+    ``data`` — the fencing fields a broker reads before accepting a
+    transactional produce.  (-1, -1, False) when absent/short."""
+    # header: baseOffset(8) batchLen(4) leaderEpoch(4) magic(1) crc(4)
+    # attributes(2) lastOffsetDelta(4) baseTs(8) maxTs(8) producerId(8)
+    # producerEpoch(2)
+    if len(data) < 53:
+        return -1, -1, False
+    (attrs,) = struct.unpack_from(">h", data, 21)
+    pid, epoch = struct.unpack_from(">qh", data, 43)
+    return pid, epoch, bool(attrs & 0x10)
 
 
 def decode_record_batches(data: bytes) -> List[DecodedRecord]:

@@ -1,0 +1,86 @@
+"""Task-local state store: a worker-side SECONDARY copy of that worker's
+own subtask snapshots.
+
+Analog of ``TaskLocalStateStoreImpl``
+(``flink-runtime/src/main/java/org/apache/flink/runtime/state/
+TaskLocalStateStoreImpl.java:54``) and the
+``flink-local-recovery-and-allocation-test`` e2e: every checkpoint ack ALSO
+writes the snapshot to a worker-local directory; on a same-worker restart
+the restore reads the local copy and touches the remote (primary)
+checkpoint storage only for states the local store lacks — recovery cost
+stops scaling with remote-storage bandwidth.
+
+The primary store (``FileCheckpointStorage`` / object store) stays the
+source of truth: local copies are best-effort (``confirm`` prunes
+everything older than the last completed checkpoint; a missing or corrupt
+local entry silently falls back to the shipped remote state).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import shutil
+import urllib.parse
+from typing import Any, Dict, List, Optional
+
+
+class TaskLocalStateStore:
+    """Per-worker local snapshot directory:
+    ``<base>/worker-<idx>/chk-<cid>/<uid>-<subtask>.pkl``."""
+
+    def __init__(self, base_dir: str, worker_index: int):
+        self.dir = os.path.join(base_dir, f"worker-{worker_index}")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _chk_dir(self, checkpoint_id: int) -> str:
+        return os.path.join(self.dir, f"chk-{checkpoint_id}")
+
+    def _path(self, checkpoint_id: int, uid: str, subtask: int) -> str:
+        safe = urllib.parse.quote(uid, safe="")
+        return os.path.join(self._chk_dir(checkpoint_id),
+                            f"{safe}-{subtask}.pkl")
+
+    def store(self, checkpoint_id: int, uid: str, subtask: int,
+              snapshot: Dict[str, Any]) -> None:
+        """Best-effort local write (never fails the checkpoint ack: the
+        primary copy rides the ack to the coordinator regardless)."""
+        try:
+            os.makedirs(self._chk_dir(checkpoint_id), exist_ok=True)
+            path = self._path(checkpoint_id, uid, subtask)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(snapshot, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def load(self, checkpoint_id: int, uid: str,
+             subtask: int) -> Optional[Dict[str, Any]]:
+        path = self._path(checkpoint_id, uid, subtask)
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except (OSError, pickle.PickleError, EOFError):
+            return None        # fall back to the remote copy
+
+    def confirm(self, checkpoint_id: int) -> None:
+        """Checkpoint ``checkpoint_id`` completed: local copies of OLDER
+        checkpoints can never be restored from again — prune them
+        (``TaskLocalStateStoreImpl.pruneCheckpoints``)."""
+        for cid in self.checkpoint_ids():
+            if cid < checkpoint_id:
+                shutil.rmtree(self._chk_dir(cid), ignore_errors=True)
+
+    def checkpoint_ids(self) -> List[int]:
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        for n in names:
+            m = re.fullmatch(r"chk-(\d+)", n)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)

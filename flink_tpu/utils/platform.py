@@ -1,0 +1,29 @@
+"""JAX platform selection helper.
+
+The TPU-tunnel site hook (sitecustomize → register) overrides jax's
+platform choice via ``jax.config.update("jax_platforms", ...)`` at
+interpreter start, so the ``JAX_PLATFORMS`` environment variable alone is
+not enough to keep a process off the one shared real chip.  Every
+entrypoint that must honor the env var (CLI workers spawned from a
+CPU-forced test context, the bench's CPU smoke mode, tests/conftest.py)
+calls this once before touching any jax API.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Re-assert ``JAX_PLATFORMS`` over any site-hook override; a missing
+    or broken jax leaves the process untouched (CLI subcommands that never
+    use jax must still work)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,236 @@
+"""Kafka exactly-once produce: KIP-98 transactions on the broker
+(InitProducerId / AddPartitionsToTxn / EndTxn / ListTransactions) and the
+checkpoint-bound 2PC sink — ``FlinkKafkaProducer.java:100`` analog.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.kafka import (KafkaError, KafkaExactlyOnceSink,
+                                        KafkaWireBroker, KafkaWireClient)
+from flink_tpu.core.batch import RecordBatch
+
+
+@pytest.fixture
+def broker(tmp_path):
+    b = KafkaWireBroker(directory=str(tmp_path / "kafka")).start()
+    b.create_topic("t", partitions=1)
+    yield b
+    b.stop()
+
+
+def consume_all(b, topic="t", part=0):
+    c = KafkaWireClient(b.host, b.port)
+    try:
+        out = []
+        hw = c.latest_offset(topic, part)
+        off = 0
+        while off < hw:
+            msgs, _ = c.fetch(topic, part, off)
+            for o, _k, v in msgs:
+                if o >= hw:
+                    break
+                out.append(json.loads(v.decode()) if v else None)
+                off = o + 1
+        return out
+    finally:
+        c.close()
+
+
+def batch(vals):
+    return RecordBatch({"v": np.asarray(vals, np.int64)})
+
+
+class TestBrokerTransactions:
+    def test_staged_invisible_until_commit(self, broker):
+        c = KafkaWireClient(broker.host, broker.port)
+        pid, ep = c.init_producer_id("tx1")
+        c.add_partitions_to_txn("tx1", pid, ep, {"t": [0]})
+        c.produce_txn("tx1", pid, ep, "t", 0, [(None, b'{"v": 1}')])
+        assert consume_all(broker) == []            # invisible pre-commit
+        assert [t[0] for t in c.list_transactions()] == ["tx1"]
+        c.end_txn("tx1", pid, ep, commit=True)
+        assert consume_all(broker) == [{"v": 1}]
+        assert c.list_transactions() == []
+        # commit replay is idempotent (recover-and-commit path)
+        c.end_txn("tx1", pid, ep, commit=True)
+        assert consume_all(broker) == [{"v": 1}]
+        c.close()
+
+    def test_abort_discards(self, broker):
+        c = KafkaWireClient(broker.host, broker.port)
+        pid, ep = c.init_producer_id("tx2")
+        c.add_partitions_to_txn("tx2", pid, ep, {"t": [0]})
+        c.produce_txn("tx2", pid, ep, "t", 0, [(None, b'{"v": 9}')])
+        c.end_txn("tx2", pid, ep, commit=False)
+        assert consume_all(broker) == []
+        c.close()
+
+    def test_zombie_fencing(self, broker):
+        c = KafkaWireClient(broker.host, broker.port)
+        pid, ep = c.init_producer_id("tx3")
+        c.add_partitions_to_txn("tx3", pid, ep, {"t": [0]})
+        c.produce_txn("tx3", pid, ep, "t", 0, [(None, b'{"v": 1}')])
+        # a new incarnation re-initializes: epoch bumps, old txn aborts
+        pid2, ep2 = c.init_producer_id("tx3")
+        assert pid2 == pid and ep2 == ep + 1
+        with pytest.raises(KafkaError):             # zombie produce fenced
+            c.produce_txn("tx3", pid, ep, "t", 0, [(None, b'{"v": 2}')])
+        with pytest.raises(KafkaError):             # zombie commit fenced
+            c.end_txn("tx3", pid, ep, commit=True)
+        assert consume_all(broker) == []            # old staged rows gone
+        c.close()
+
+    def test_multi_partition_commit_is_atomic(self, broker):
+        broker.create_topic("mp", partitions=3)
+        c = KafkaWireClient(broker.host, broker.port)
+        pid, ep = c.init_producer_id("tx4")
+        c.add_partitions_to_txn("tx4", pid, ep, {"mp": [0, 1, 2]})
+        for p in range(3):
+            c.produce_txn("tx4", pid, ep, "mp", p,
+                          [(None, json.dumps({"p": p}).encode())])
+        for p in range(3):
+            assert consume_all(broker, "mp", p) == []
+        c.end_txn("tx4", pid, ep, commit=True)
+        for p in range(3):
+            assert consume_all(broker, "mp", p) == [{"p": p}]
+        c.close()
+
+    def test_tid_reuse_after_commit(self, broker):
+        """Standard Kafka usage: ONE transactional id across many
+        transactions.  A new txn under a previously committed id must
+        commit its own records — not be swallowed by the idempotent
+        commit-replay check."""
+        c = KafkaWireClient(broker.host, broker.port)
+        for i in range(3):
+            pid, ep = c.init_producer_id("reuse")
+            c.add_partitions_to_txn("reuse", pid, ep, {"t": [0]})
+            c.produce_txn("reuse", pid, ep, "t", 0,
+                          [(None, json.dumps({"v": i}).encode())])
+            c.end_txn("reuse", pid, ep, commit=True)
+        assert [r["v"] for r in consume_all(broker)] == [0, 1, 2]
+        assert c.list_transactions() == []      # nothing dangling
+        c.close()
+
+    def test_open_txn_survives_broker_restart(self, tmp_path):
+        """The 2PC crash window: a PRE-COMMITTED (open) transaction must
+        survive a broker restart so the sink's recover-and-commit replay
+        finds it — staged records are durable, not memory-only."""
+        d = str(tmp_path / "kafka")
+        b1 = KafkaWireBroker(directory=d).start()
+        b1.create_topic("t", partitions=1)
+        c = KafkaWireClient(b1.host, b1.port)
+        pid, ep = c.init_producer_id("open1")
+        c.add_partitions_to_txn("open1", pid, ep, {"t": [0]})
+        c.produce_txn("open1", pid, ep, "t", 0, [(None, b'{"v": 42}')])
+        c.close()
+        b1.stop()                               # crash with the txn OPEN
+
+        b2 = KafkaWireBroker(directory=d).start()
+        try:
+            c2 = KafkaWireClient(b2.host, b2.port)
+            assert [t[0] for t in c2.list_transactions()] == ["open1"]
+            assert consume_all(b2) == []        # still invisible
+            c2.end_txn("open1", pid, ep, commit=True)
+            assert consume_all(b2) == [{"v": 42}]
+            c2.close()
+        finally:
+            b2.stop()
+
+    def test_committed_tids_survive_broker_restart(self, tmp_path):
+        d = str(tmp_path / "kafka")
+        b1 = KafkaWireBroker(directory=d).start()
+        b1.create_topic("t", partitions=1)
+        c = KafkaWireClient(b1.host, b1.port)
+        pid, ep = c.init_producer_id("txr")
+        c.add_partitions_to_txn("txr", pid, ep, {"t": [0]})
+        c.produce_txn("txr", pid, ep, "t", 0, [(None, b'{"v": 5}')])
+        c.end_txn("txr", pid, ep, commit=True)
+        c.close()
+        b1.stop()
+
+        b2 = KafkaWireBroker(directory=d).start()
+        try:
+            assert consume_all(b2) == [{"v": 5}]
+            c2 = KafkaWireClient(b2.host, b2.port)
+            # commit replay after restart is STILL idempotent
+            c2.end_txn("txr", pid, ep, commit=True)
+            assert consume_all(b2) == [{"v": 5}]
+            c2.close()
+        finally:
+            b2.stop()
+
+
+class TestExactlyOnceSink:
+    def test_crash_between_precommit_and_commit(self, broker):
+        """The verdict's done-criterion: a crash between pre-commit and
+        commit neither loses nor duplicates."""
+        from flink_tpu.operators.base import snapshot_scope
+
+        sink = KafkaExactlyOnceSink(broker.host, broker.port, "t",
+                                    sink_id="eos")
+        sink.open(type("Ctx", (), {"subtask_index": 0})())
+        sink.write_batch(batch([1, 2]))
+        with snapshot_scope(1):
+            snap = sink.snapshot_state()        # epoch 0 staged @ ckpt 1
+        # ... checkpoint 1 completes but the notification is LOST ...
+        sink.write_batch(batch([3]))
+        with snapshot_scope(2):
+            sink.snapshot_state()               # epoch 1 staged @ ckpt 2
+        del sink                                # crash before notify
+
+        assert consume_all(broker) == []        # nothing visible yet
+
+        restored = KafkaExactlyOnceSink(broker.host, broker.port, "t",
+                                        sink_id="eos")
+        restored.open(type("Ctx", (), {"subtask_index": 0})())
+        restored.restore_state(snap)
+        # epoch 0 (in the checkpoint) committed; epoch 1 aborted
+        vals = sorted(r["v"] for r in consume_all(broker))
+        assert vals == [1, 2]
+        # upstream replays the post-checkpoint rows
+        restored.write_batch(batch([3]))
+        with snapshot_scope(2):
+            restored.snapshot_state()
+        restored.notify_checkpoint_complete(2)
+        vals = sorted(r["v"] for r in consume_all(broker))
+        assert vals == [1, 2, 3]                # no loss, no duplicates
+        restored.close()
+
+    def test_double_restore_is_idempotent(self, broker):
+        from flink_tpu.operators.base import snapshot_scope
+
+        sink = KafkaExactlyOnceSink(broker.host, broker.port, "t",
+                                    sink_id="eos2")
+        sink.open(type("Ctx", (), {"subtask_index": 0})())
+        sink.write_batch(batch([7]))
+        with snapshot_scope(1):
+            snap = sink.snapshot_state()
+        del sink
+        for _ in range(2):                      # restore twice (retry)
+            r = KafkaExactlyOnceSink(broker.host, broker.port, "t",
+                                     sink_id="eos2")
+            r.open(type("Ctx", (), {"subtask_index": 0})())
+            r.restore_state(snap)
+            r.close()
+        assert [r["v"] for r in consume_all(broker)] == [7]
+
+    def test_notify_skips_later_checkpoints(self, broker):
+        from flink_tpu.operators.base import snapshot_scope
+
+        sink = KafkaExactlyOnceSink(broker.host, broker.port, "t",
+                                    sink_id="eos3")
+        sink.open(type("Ctx", (), {"subtask_index": 0})())
+        sink.write_batch(batch([1]))
+        with snapshot_scope(1):
+            sink.snapshot_state()
+        sink.write_batch(batch([2]))
+        with snapshot_scope(2):
+            sink.snapshot_state()
+        sink.notify_checkpoint_complete(1)
+        assert [r["v"] for r in consume_all(broker)] == [1]
+        sink.notify_checkpoint_complete(2)
+        assert sorted(r["v"] for r in consume_all(broker)) == [1, 2]
+        sink.close()

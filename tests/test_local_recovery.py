@@ -1,0 +1,51 @@
+"""Task-local state store (TaskLocalStateStoreImpl.java:54 analog):
+secondary worker-local snapshot copies; restore prefers local over the
+coordinator-shipped remote state.
+"""
+
+import numpy as np
+
+from flink_tpu.runtime.checkpoint.local import TaskLocalStateStore
+
+
+def test_store_load_roundtrip(tmp_path):
+    s = TaskLocalStateStore(str(tmp_path), worker_index=0)
+    snap = {"operator": {"total": 3.5}, "arr": np.arange(4)}
+    s.store(7, "v1", 0, snap)
+    got = s.load(7, "v1", 0)
+    assert got["operator"] == {"total": 3.5}
+    assert np.array_equal(got["arr"], np.arange(4))
+    assert s.load(7, "v1", 1) is None          # other subtask absent
+    assert s.load(8, "v1", 0) is None          # other checkpoint absent
+
+
+def test_confirm_prunes_older_checkpoints(tmp_path):
+    s = TaskLocalStateStore(str(tmp_path), worker_index=1)
+    for cid in (1, 2, 3):
+        s.store(cid, "v1", 0, {"cid": cid})
+    s.confirm(3)
+    assert s.checkpoint_ids() == [3]
+    assert s.load(3, "v1", 0) == {"cid": 3}
+    assert s.load(2, "v1", 0) is None
+
+
+def test_workers_are_isolated(tmp_path):
+    a = TaskLocalStateStore(str(tmp_path), worker_index=0)
+    b = TaskLocalStateStore(str(tmp_path), worker_index=1)
+    a.store(1, "v", 0, {"w": 0})
+    assert b.load(1, "v", 0) is None
+
+
+def test_corrupt_entry_falls_back_to_none(tmp_path):
+    s = TaskLocalStateStore(str(tmp_path), worker_index=0)
+    s.store(1, "v", 0, {"x": 1})
+    with open(s._path(1, "v", 0), "wb") as f:
+        f.write(b"not a pickle")
+    assert s.load(1, "v", 0) is None           # silent remote fallback
+
+
+def test_uid_quoting(tmp_path):
+    s = TaskLocalStateStore(str(tmp_path), worker_index=0)
+    uid = "map/with:odd chars?"
+    s.store(1, uid, 3, {"ok": True})
+    assert s.load(1, uid, 3) == {"ok": True}
