@@ -274,8 +274,12 @@ class KafkaWireBroker:
         self._txns: Dict[str, Dict[str, Any]] = {}
         self._next_pid = 1000
         #: committed transactional ids — EndTxn(commit) replays
-        #: idempotently (the 2PC sink's recover-and-commit path)
-        self._committed_tids: set = set()
+        #: idempotently (the 2PC sink's recover-and-commit path).  Ordered
+        #: dict as a bounded retention window (sinks mint one tid per
+        #: checkpoint epoch forever; replays only ever target RECENT
+        #: checkpoints, so old entries can age out)
+        self._committed_tids: Dict[str, None] = {}
+        self._committed_retention = 4096
         #: consumer groups under a dedicated lock: JoinGroup BLOCKS (the
         #: rebalance barrier) and must not hold the log lock while waiting
         self._groups: Dict[str, _Group] = {}
@@ -331,7 +335,7 @@ class KafkaWireBroker:
         tcf = os.path.join(self.directory, "_txn_commits.json")
         if os.path.exists(tcf):
             with open(tcf) as f:
-                self._committed_tids = set(json.load(f))
+                self._committed_tids = dict.fromkeys(json.load(f))
         self._load_txns()
 
     def _persist_txn_commits_locked(self) -> None:
@@ -343,7 +347,7 @@ class KafkaWireBroker:
         import json
         tmp = os.path.join(self.directory, "_txn_commits.json#tmp")
         with open(tmp, "w") as f:
-            json.dump(sorted(self._committed_tids), f)
+            json.dump(list(self._committed_tids), f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.directory, "_txn_commits.json"))
@@ -830,25 +834,47 @@ class KafkaWireBroker:
     def _persist_txn_locked(self, tid: str) -> None:
         """OPEN (pre-committed) transactions survive broker restarts: the
         2PC sink's crash window between pre-commit and commit must not
-        lose the staged records to a broker crash (the real broker gets
-        this from eager log appends + markers; the buffered design
-        persists the txn buffer instead).  Caller holds ``_lock``."""
+        lose the staged records to a broker crash.  The file is a pickle
+        STREAM — a small meta record followed by one appended segment per
+        transactional produce (O(n) total I/O; a full rewrite per produce
+        would be quadratic in epoch size).  This writes/truncates the META
+        record; ``_append_txn_segment_locked`` appends data.  Caller holds
+        ``_lock``."""
         if not self.directory:
             return
         import pickle
         txn = self._txns.get(tid)
         if txn is None:
             return
-        tmp = self._txn_path(tid) + "#tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump({"pid": txn["pid"], "epoch": txn["epoch"],
-                         "state": txn["state"],
-                         "staged": {f"{t}\0{p}": v
-                                    for (t, p), v in txn["staged"].items()}},
+        with open(self._txn_path(tid), "wb") as f:   # truncate: new epoch
+            pickle.dump({"meta": True, "pid": txn["pid"],
+                         "epoch": txn["epoch"], "state": txn["state"]},
                         f, protocol=pickle.HIGHEST_PROTOCOL)
+            # re-write any already-staged records (only non-empty right
+            # after a fencing reset, where staged was just cleared)
+            for (t, p), recs in txn["staged"].items():
+                if recs:
+                    pickle.dump((t, p, recs), f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, self._txn_path(tid))
+
+    def _append_txn_segment_locked(self, tid: str, topic: str, part: int,
+                                   recs: list) -> None:
+        """Append one produce's records to the txn file (durable staging
+        without rewriting the whole buffer).  Caller holds ``_lock``."""
+        if not self.directory or not recs:
+            return
+        import pickle
+        path = self._txn_path(tid)
+        if not os.path.exists(path):
+            self._persist_txn_locked(tid)
+            return               # meta write above already included recs
+        with open(path, "ab") as f:
+            pickle.dump((topic, part, recs), f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
 
     def _remove_txn_file_locked(self, tid: str) -> None:
         if not self.directory:
@@ -865,18 +891,23 @@ class KafkaWireBroker:
             if not (name.startswith("_txn-") and name.endswith(".pkl")):
                 continue
             tid = urllib.parse.unquote(name[len("_txn-"):-len(".pkl")])
+            staged: Dict[Any, list] = {}
+            meta = None
             try:
                 with open(os.path.join(self.directory, name), "rb") as f:
-                    rec = pickle.load(f)
-            except (OSError, pickle.PickleError, EOFError):
-                continue        # torn write: the txn aborts (never acked)
-            staged = {}
-            for key, v in rec["staged"].items():
-                t, _, p = key.rpartition("\0")
-                staged[(t, int(p))] = v
-            self._txns[tid] = {"pid": rec["pid"], "epoch": rec["epoch"],
-                               "state": rec["state"], "staged": staged}
-            self._next_pid = max(self._next_pid, rec["pid"] + 1)
+                    meta = pickle.load(f)
+                    while True:
+                        t, p, recs = pickle.load(f)
+                        staged.setdefault((t, int(p)), []).extend(recs)
+            except EOFError:
+                pass             # normal end of the segment stream
+            except (OSError, pickle.PickleError):
+                pass             # torn tail: keep the complete prefix
+            if not isinstance(meta, dict) or not meta.get("meta"):
+                continue         # unreadable meta: the txn aborts
+            self._txns[tid] = {"pid": meta["pid"], "epoch": meta["epoch"],
+                               "state": meta["state"], "staged": staged}
+            self._next_pid = max(self._next_pid, meta["pid"] + 1)
 
     def _init_producer_id(self, r: _Reader, w: _Writer) -> None:
         tid = r.string()
@@ -917,14 +948,21 @@ class KafkaWireBroker:
                                     r.array(lambda r: r.int32())))
         with self._lock:
             txn, err = self._txn_check_locked(tid, pid, epoch)
+            part_errs: Dict[Tuple[str, int], int] = {}
             if err == _ERR_NONE:
                 txn["state"] = "ongoing"
                 for t, ps in topics:
+                    parts = self._logs.get(t)
                     for p in ps:
-                        txn["staged"].setdefault((t, p), [])
+                        if parts is None or not 0 <= p < len(parts):
+                            part_errs[(t, p)] = _ERR_UNKNOWN_TOPIC
+                        else:
+                            txn["staged"].setdefault((t, p), [])
                 self._persist_txn_locked(tid)
         w.int32(0).array(topics, lambda w, t: w.string(t[0]).array(
-            t[1], lambda w, p: w.int32(p).int16(err)))
+            t[1], lambda w, p: w.int32(p).int16(
+                part_errs.get((t[0], p), err) if err == _ERR_NONE
+                else err)))
 
     def _end_txn(self, r: _Reader, w: _Writer) -> None:
         tid = r.string()
@@ -949,11 +987,16 @@ class KafkaWireBroker:
                 return
             if commit:
                 # ONE lock acquisition spans every partition append: the
-                # whole transaction becomes visible atomically
+                # whole transaction becomes visible atomically (partitions
+                # were validated at staging time; -1 here is impossible)
                 for (t, p), recs in sorted(txn["staged"].items()):
                     if recs:
-                        self._append_locked(t, p, recs)
-                self._committed_tids.add(tid)
+                        base = self._append_locked(t, p, recs)
+                        assert base >= 0, (t, p)
+                self._committed_tids[tid] = None
+                while len(self._committed_tids) > self._committed_retention:
+                    self._committed_tids.pop(
+                        next(iter(self._committed_tids)))
                 self._persist_txn_commits_locked()
             del self._txns[tid]
             self._remove_txn_file_locked(tid)
@@ -1031,10 +1074,20 @@ class KafkaWireBroker:
                         if err == _ERR_NONE and txn["state"] != "ongoing":
                             err = _ERR_INVALID_TXN_STATE
                         elif err == _ERR_NONE:
-                            txn["staged"].setdefault((topic, part),
-                                                     []).extend(
-                                (k, v, ts) for _o, ts, k, v, _h in recs)
-                            self._persist_txn_locked(tid)
+                            parts = self._logs.get(topic)
+                            if parts is None or not 0 <= part < len(parts):
+                                # validate at STAGING time: the commit
+                                # appends unconditionally, so an unknown
+                                # partition acked here would silently
+                                # vanish at EndTxn
+                                err = _ERR_UNKNOWN_TOPIC
+                            else:
+                                staged = [(k, v, ts)
+                                          for _o, ts, k, v, _h in recs]
+                                txn["staged"].setdefault(
+                                    (topic, part), []).extend(staged)
+                                self._append_txn_segment_locked(
+                                    tid, topic, part, staged)
                     per_part.append((part, err, -1))
                     continue
                 base = self._append(topic, part,
@@ -1462,11 +1515,10 @@ class KafkaExactlyOnceSink:
             return
         tid, pid, pepoch = self._begin_txn()
         if self.num_partitions == 1 or self.key_column is None:
+            # single partition, or keyless round-robin
             parts: Dict[int, List] = {}
             for i, kv in enumerate(self._buf):
-                parts.setdefault(
-                    0 if self.key_column is not None
-                    else i % self.num_partitions, []).append(kv)
+                parts.setdefault(i % self.num_partitions, []).append(kv)
         else:
             from flink_tpu.core.keygroups import hash_keys
             keys = np.asarray([k for k, _v in self._buf], object)

@@ -168,6 +168,16 @@ class AggregateFunction(RichFunction, abc.ABC):
                 and type(self).host_get_result
                 is not AggregateFunction.host_get_result)
 
+    def supports_retraction(self) -> bool:
+        """True when every ACC leaf combines by ADDITION (sum/count/avg):
+        the aggregate is invertible, so a fired window's contents can be
+        'purged' logically by subtracting a per-(key, window) value
+        baseline — the enabler for FIRE_AND_PURGE count triggers over
+        pane-shared (sliding) windows, where a physical purge would
+        corrupt overlapping neighbours."""
+        kinds = self.scatter_kind_leaves()
+        return kinds is not None and all(k == "add" for k in kinds)
+
     # -- introspection used by the state backend ----------------------------
     def scatter_kinds(self):
         """Optional fast-path declaration: a pytree matching ``identity()``'s

@@ -12,13 +12,16 @@ from flink_tpu.metrics.groups import (BUSY_TIME, CURRENT_WATERMARK,
                                       NUM_RECORDS_IN, NUM_RECORDS_OUT,
                                       MetricGroup, MetricRegistry,
                                       OperatorIOMetrics, task_metric_group)
-from flink_tpu.metrics.reporters import (LoggingReporter, MetricReporter,
-                                         PrometheusReporter)
+from flink_tpu.metrics.reporters import (GraphiteReporter,
+                                         InfluxDBReporter, LoggingReporter,
+                                         MetricReporter, PrometheusReporter,
+                                         StatsDReporter)
 
 __all__ = [
     "Counter", "Gauge", "SettableGauge", "Meter", "Histogram", "Metric",
     "MetricGroup", "MetricRegistry", "OperatorIOMetrics", "task_metric_group",
     "MetricReporter", "LoggingReporter", "PrometheusReporter",
+    "StatsDReporter", "GraphiteReporter", "InfluxDBReporter",
     "NUM_RECORDS_IN", "NUM_RECORDS_OUT", "NUM_LATE_RECORDS_DROPPED",
     "CURRENT_WATERMARK", "BUSY_TIME",
 ]

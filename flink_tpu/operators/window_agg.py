@@ -203,12 +203,16 @@ class WindowAggOperator(StreamOperator):
                        else EventTimeTrigger())
         if trigger.fires_on_count and not isinstance(assigner, GlobalWindows) \
                 and assigner.panes_per_window != 1 \
-                and trigger.purges_on_fire:
+                and trigger.purges_on_fire \
+                and not agg.supports_retraction():
             raise NotImplementedError(
                 "PURGING count triggers over MULTI-PANE (sliding) assigners "
-                "are not supported: overlapping windows share panes, so "
-                "purging one window's contents would corrupt its neighbours. "
-                "Plain CountTrigger (fire without purge) works.")
+                "need an INVERTIBLE aggregate (all-'add' ACC leaves: "
+                "sum/count/avg): overlapping windows share panes, so the "
+                "purge is logical — a per-(key, window) value baseline is "
+                "subtracted instead of clearing shared cells.  Min/max "
+                "cannot retract; use a plain CountTrigger (fire without "
+                "purge) for those.")
         self.trigger = trigger
         self.output_column = output_column
         self.emit_window_bounds = emit_window_bounds
@@ -336,6 +340,11 @@ class WindowAggOperator(StreamOperator):
         #: fired per key slot (the CountTrigger count register, which clears
         #: on FIRE — next fire needs n MORE elements)
         self._count_baselines: Dict[int, np.ndarray] = {}
+        #: FIRE_AND_PURGE over sliding windows: per-window VALUE baselines
+        #: (one np array per ACC leaf) — the fired-so-far accumulator that
+        #: gets subtracted from the live pane sum (logical purge; physical
+        #: purge would corrupt pane-sharing neighbours)
+        self._value_baselines: Dict[int, List[np.ndarray]] = {}
         #: host emit mirror: pane id -> bool[K] "this (key, pane) cell holds
         #: data".  The host computes every scatter id, so it KNOWS which keys
         #: a window will emit — fires upload the exact emit index and
@@ -428,6 +437,7 @@ class WindowAggOperator(StreamOperator):
         self._leaves = None
         self._counts = None
         self._count_baselines = {}
+        self._value_baselines = {}
         self._pending_fires = []
         self._mirror = {}
         self._vmirror = {}
@@ -828,6 +838,20 @@ class WindowAggOperator(StreamOperator):
     @partial(jax.jit, static_argnums=(0, 4))
     def _fire_step(self, leaves, counts, pane_slots, k_active: int):
         return self._fire_core(leaves, counts, pane_slots, k_active)
+
+    @partial(jax.jit, static_argnums=(0, 4))
+    def _fire_acc_step(self, leaves, counts, pane_slots, k_active: int):
+        """Like ``_fire_step`` but returns the combined ACCUMULATOR leaves
+        (pre-``get_result``): the purging-count-trigger path subtracts the
+        per-window value baseline from the acc before producing output."""
+        if k_active and k_active < counts.shape[0]:
+            leaves = tuple(jax.lax.slice_in_dim(l, 0, k_active, axis=0)
+                           for l in leaves)
+            counts = jax.lax.slice_in_dim(counts, 0, k_active, axis=0)
+        sel = tuple(jnp.take(l, pane_slots, axis=1) for l in leaves)
+        total = jnp.take(counts, pane_slots, axis=1).sum(axis=1)
+        combined = combine_along_axis(sel, self.agg.combine_leaves, axis=1)
+        return total > 0, combined
 
     def _k_active(self) -> int:
         """Static pow2 bound on live key rows (0 = use full capacity).
@@ -1267,11 +1291,13 @@ class WindowAggOperator(StreamOperator):
                 self._nm.drop_pane(ep)
         if self.pane_base > self.max_pane:
             self.max_pane = self.pane_base
-        if self._count_baselines:
+        if self._count_baselines or self._value_baselines:
             # drop count-trigger registers of windows fully behind retention
             lo_w = self.assigner.windows_of_pane(self.pane_base)[0]
             for w in [w for w in self._count_baselines if w < lo_w]:
                 del self._count_baselines[w]
+            for w in [w for w in self._value_baselines if w < lo_w]:
+                del self._value_baselines[w]
 
     # ------------------------------------------------------------------ fires
     def _fire_window(self, window_id: int) -> List[StreamElement]:
@@ -1378,10 +1404,17 @@ class WindowAggOperator(StreamOperator):
         (key, window) fires when the sum of the window's pane counts has
         grown by >= n since its last fire.  The per-window baseline is the
         CountTrigger count register (``ReducingState<Long>`` per (key,
-        window) namespace in the reference) — it clears on FIRE.  No purge:
-        overlapping windows share panes."""
+        window) namespace in the reference) — it clears on FIRE.
+
+        FIRE_AND_PURGE: overlapping windows share panes, so the purge is
+        LOGICAL — a per-(key, window) VALUE baseline of the fired
+        accumulator is kept, and emissions subtract it (invertible
+        aggregates only, enforced at construction).  The emitted rows are
+        exactly what the reference's per-namespace purged state would
+        produce, without touching the shared pane cells."""
         out: List[StreamElement] = []
         thr = self.trigger.count_threshold
+        purging = self.trigger.purges_on_fire
         ka = self._k_active() or self._K
         wins: set = set()
         for p in np.asarray(touched_panes).tolist():
@@ -1405,13 +1438,45 @@ class WindowAggOperator(StreamOperator):
                 base = grown
             over = (counts_w - base[:ka]) >= thr
             if over.any():
-                m, result = self._fire_step(self._leaves, self._counts,
-                                            slots, self._k_active())
-                mask = jnp.asarray(over) & m
-                out.extend(self._emit(mask, result,
-                                      self.assigner.window_bounds(w)))
+                if purging:
+                    out.extend(self._emit_purging_sliding(w, slots, ka,
+                                                          over))
+                else:
+                    m, result = self._fire_step(self._leaves, self._counts,
+                                                slots, self._k_active())
+                    mask = jnp.asarray(over) & m
+                    out.extend(self._emit(mask, result,
+                                          self.assigner.window_bounds(w)))
                 base[:ka] = np.where(over, counts_w, base[:ka])
             self._count_baselines[w] = base
+        return out
+
+    def _emit_purging_sliding(self, w: int, slots, ka: int,
+                              over: np.ndarray) -> List[StreamElement]:
+        """One FIRE_AND_PURGE emission for sliding window ``w``: download
+        the combined accumulator, subtract the value baseline (= contents
+        already fired-and-purged), emit, advance the baseline for fired
+        keys."""
+        _m, combined = self._fire_acc_step(self._leaves, self._counts,
+                                           slots, self._k_active())
+        comb_np = [np.asarray(l) for l in combined]
+        self.phase_bytes["d2h"] = self.phase_bytes.get("d2h", 0) + \
+            sum(l.nbytes for l in comb_np)
+        vb = self._value_baselines.get(w)
+        if vb is None or vb[0].shape[0] < ka:
+            grown = [np.zeros_like(c) for c in comb_np]
+            if vb is not None:
+                for g, o in zip(grown, vb):
+                    g[:o.shape[0]] = o
+            vb = grown
+        emit_leaves = tuple(c - b[:ka] for c, b in zip(comb_np, vb))
+        result = self.agg.get_result(self.spec.unflatten(emit_leaves))
+        out = self._emit(np.asarray(over),
+                         result, self.assigner.window_bounds(w))
+        for b, c in zip(vb, comb_np):
+            sel = over.reshape((-1,) + (1,) * (b.ndim - 1))
+            b[:ka] = np.where(sel, c, b[:ka])
+        self._value_baselines[w] = vb
         return out
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
@@ -1505,6 +1570,10 @@ class WindowAggOperator(StreamOperator):
                 arr[:min(len(b), n)] = np.asarray(b)[:n]
                 packed[w] = arr
             snap["count_baselines"] = packed
+        if self._value_baselines:
+            snap["value_baselines"] = {
+                w: [np.asarray(l).copy() for l in leaves]
+                for w, leaves in self._value_baselines.items()}
         return snap
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
@@ -1587,6 +1656,9 @@ class WindowAggOperator(StreamOperator):
         self._count_baselines = {w: np.asarray(b, np.int64).copy()
                                  for w, b in
                                  snap.get("count_baselines", {}).items()}
+        self._value_baselines = {w: [np.asarray(l).copy() for l in leaves]
+                                 for w, leaves in
+                                 snap.get("value_baselines", {}).items()}
 
 
 def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:

@@ -1,0 +1,106 @@
+"""Elasticsearch connector (ElasticsearchSink.java:63 analog): REST wire
+server + client + bulk-flushing sink."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.elasticsearch import (ElasticsearchClient,
+                                                ElasticsearchError,
+                                                ElasticsearchServer,
+                                                ElasticsearchSink)
+from flink_tpu.core.batch import RecordBatch
+
+
+@pytest.fixture
+def es():
+    srv = ElasticsearchServer()
+    yield srv
+    srv.close()
+
+
+def client(srv):
+    return ElasticsearchClient(srv.host, srv.port)
+
+
+class TestWire:
+    def test_index_and_get(self, es):
+        c = client(es)
+        c.create_index("people")
+        c.bulk([{"op": "index", "index": "people", "id": 1,
+                 "doc": {"name": "ada", "age": 36}}])
+        assert c.get("people", "1") == {"name": "ada", "age": 36}
+        assert c.get("people", "2") is None
+        assert c.count("people") == 1
+
+    def test_bulk_ndjson_over_raw_http(self, es):
+        """A FOREIGN http client speaking the documented _bulk NDJSON."""
+        body = (json.dumps({"index": {"_index": "t", "_id": "a"}}) + "\n"
+                + json.dumps({"x": 1}) + "\n"
+                + json.dumps({"delete": {"_index": "t", "_id": "a"}})
+                + "\n").encode()
+        req = urllib.request.Request(
+            f"http://{es.host}:{es.port}/_bulk", data=body, method="POST")
+        req.add_header("Content-Type", "application/x-ndjson")
+        res = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert [list(i)[0] for i in res["items"]] == ["index", "delete"]
+        assert client(es).count("t") == 0
+
+    def test_create_conflicts_and_update_merges(self, es):
+        c = client(es)
+        c.bulk([{"op": "create", "index": "i", "id": "x",
+                 "doc": {"a": 1}}])
+        with pytest.raises(ElasticsearchError, match="bulk failures"):
+            c.bulk([{"op": "create", "index": "i", "id": "x",
+                     "doc": {"a": 2}}])
+        c.bulk([{"op": "update", "index": "i", "id": "x",
+                 "doc": {"b": 2}}])
+        assert c.get("i", "x") == {"a": 1, "b": 2}
+
+    def test_search_term_and_match_all(self, es):
+        c = client(es)
+        c.bulk([{"op": "index", "index": "s", "id": i,
+                 "doc": {"grp": "a" if i % 2 == 0 else "b", "n": i}}
+                for i in range(6)])
+        assert len(c.search("s", size=100)) == 6
+        evens = c.search("s", {"term": {"grp": "a"}}, size=100)
+        assert sorted(d["n"] for d in evens) == [0, 2, 4]
+
+
+class TestSink:
+    def test_flush_on_checkpoint_at_least_once(self, es):
+        sink = ElasticsearchSink(es.host, es.port, "out", bulk_actions=100)
+        sink.open(None)
+        sink.write_batch(RecordBatch(
+            {"id": np.asarray([1, 2], np.int64),
+             "v": np.asarray([1.5, 2.5])}))
+        assert client(es).count("out") == 0    # still buffered
+        sink.snapshot_state()                  # checkpoint flushes
+        assert client(es).count("out") == 2
+
+    def test_deterministic_ids_make_replay_idempotent(self, es):
+        def run():
+            sink = ElasticsearchSink(es.host, es.port, "idem",
+                                     id_column="id")
+            sink.open(None)
+            sink.write_batch(RecordBatch(
+                {"id": np.asarray([1, 2, 3], np.int64),
+                 "v": np.asarray([10.0, 20.0, 30.0])}))
+            sink.end_input()
+            sink.close()
+        run()
+        run()                                  # replay after a crash
+        c = client(es)
+        assert c.count("idem") == 3            # no duplicates
+        assert c.get("idem", "2")["v"] == 20.0
+
+    def test_bulk_size_triggers_flush(self, es):
+        sink = ElasticsearchSink(es.host, es.port, "big", bulk_actions=8)
+        sink.open(None)
+        sink.write_batch(RecordBatch(
+            {"id": np.arange(20, dtype=np.int64)}))
+        assert client(es).count("big") >= 16   # two bulks auto-flushed
+        sink.end_input()
+        assert client(es).count("big") == 20

@@ -110,3 +110,76 @@ def test_executor_populates_io_metrics():
     # keyed-reduce vertex saw all 10 records in and emitted 10 running sums
     assert any(v == 10 for v in ins.values()), ins
     assert any(v == 10 for v in outs.values()), outs
+
+
+def test_statsd_line_protocol_and_udp_push():
+    import socket as _socket
+
+    from flink_tpu.metrics import StatsDReporter
+
+    reg = MetricRegistry()
+    g = task_metric_group(reg, "j", "t", 0)
+    g.counter("recs").inc(7)
+    g.gauge("wm", lambda: 12.5)
+    srv = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.settimeout(5)
+    rep = StatsDReporter("127.0.0.1", srv.getsockname()[1])
+    lines = rep.render(reg.all_metrics())
+    assert any(l.endswith(".recs.count:7|g") for l in lines), lines
+    assert any(l.endswith(".wm.value:12.5|g") for l in lines), lines
+    rep.report(reg.all_metrics())
+    got = {srv.recvfrom(4096)[0].decode() for _ in lines}
+    assert got == set(lines)
+    rep.close()
+    srv.close()
+
+
+def test_graphite_plaintext_over_tcp():
+    import socket as _socket
+    import threading as _threading
+
+    from flink_tpu.metrics import GraphiteReporter
+
+    reg = MetricRegistry()
+    g = task_metric_group(reg, "j", "t", 1)
+    g.counter("out").inc(3)
+    srv = _socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(8)
+    received = []
+
+    def accept():
+        try:
+            conn, _ = srv.accept()
+            conn.settimeout(5)
+            received.append(conn.recv(65536).decode())
+            conn.close()
+        except OSError:
+            pass
+
+    th = _threading.Thread(target=accept, daemon=True)
+    th.start()
+    rep = GraphiteReporter("127.0.0.1", srv.getsockname()[1])
+    lines = rep.render(reg.all_metrics(), now=1700000000)
+    assert any(".out.count 3 1700000000" in l for l in lines), lines
+    rep.report(reg.all_metrics())
+    th.join(5)
+    assert received and ".out.count 3 " in received[0]
+    rep.close()
+    srv.close()
+
+
+def test_influxdb_line_protocol():
+    from flink_tpu.metrics import InfluxDBReporter
+
+    reg = MetricRegistry()
+    g = task_metric_group(reg, "j", "my task", 0)
+    g.counter("recs").inc(5)
+    g.histogram("lat").update_all(np.array([1.0, 2.0, 3.0, 4.0]))
+    rep = InfluxDBReporter(tags={"host": "tm 1"})
+    lines = rep.render(reg.all_metrics(), now_ns=123)
+    # measurement escapes spaces; tags attach; fields group per metric
+    recs = [l for l in lines if ".recs," in l or ".recs " in l]
+    assert recs and "host=tm\\ 1" in recs[0] and "count=5i" in recs[0]
+    lat = [l for l in lines if ".lat" in l][0]
+    assert "p99=" in lat and "count=4i" in lat and lat.endswith(" 123")

@@ -457,19 +457,62 @@ def test_count_trigger_sliding_window_isolation():
         [(0, 11.0), (1000, 12.0)]
 
 
-def test_count_trigger_purging_sliding_rejected():
+def test_count_trigger_purging_sliding_non_invertible_rejected():
+    """Min/max cannot retract: FIRE_AND_PURGE over pane-shared windows
+    stays rejected for them (sum/count/avg work via value baselines)."""
     import jax.numpy as jnp
 
-    from flink_tpu.core.functions import SumAggregator
+    from flink_tpu.core.functions import MinAggregator
     from flink_tpu.operators.window_agg import WindowAggOperator
     from flink_tpu.windowing.assigners import SlidingEventTimeWindows
     from flink_tpu.windowing.triggers import CountTrigger
 
-    with pytest.raises(NotImplementedError, match="PURGING"):
+    with pytest.raises(NotImplementedError, match="INVERTIBLE"):
         WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
-                          SumAggregator(jnp.float32), key_column="k",
+                          MinAggregator(jnp.float32), key_column="k",
                           value_column="v",
                           trigger=CountTrigger.of(2, purge=True))
+
+
+def test_count_trigger_purging_sliding_value_baselines():
+    """FIRE_AND_PURGE over a SLIDING assigner (the r4 documented gap,
+    closed): each fired (key, window) logically purges — the next fire
+    emits ONLY contents accumulated since — while the shared pane cells
+    of overlapping neighbours stay intact."""
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+
+    def mk():
+        op = WindowAggOperator(SlidingEventTimeWindows.of(2000, 1000),
+                               SumAggregator(jnp.float32), key_column="k",
+                               value_column="v",
+                               trigger=CountTrigger.of(2, purge=True))
+        op.open(RuntimeContext())
+        return op
+
+    op = mk()
+    out = op.process_batch(RecordBatch(
+        {"k": np.array([7, 7]), "v": np.array([1., 2.])},
+        timestamps=np.array([1100, 1200])))
+    rows = [r for b in out for r in b.to_rows()]
+    assert sorted((r["window_start"], r["result"]) for r in rows) == \
+        [(0, 3.0), (1000, 3.0)]
+    snap = op.snapshot_state()            # baselines survive checkpoints
+    op2 = mk()
+    op2.restore_state(snap)
+    # two more elements in the same panes: the purged windows re-fire with
+    # ONLY the new contents (10+20), not the running total 33
+    out = op2.process_batch(RecordBatch(
+        {"k": np.array([7, 7]), "v": np.array([10., 20.])},
+        timestamps=np.array([1300, 1400])))
+    rows = [r for b in out for r in b.to_rows()]
+    assert sorted((r["window_start"], r["result"]) for r in rows) == \
+        [(0, 30.0), (1000, 30.0)]
 
 
 def test_count_trigger_nonpurging_tumbling_running_total():
