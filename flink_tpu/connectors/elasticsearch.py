@@ -137,6 +137,13 @@ class ElasticsearchServer:
                         self._reply(400, {"error": "malformed action line"})
                         return
                     op = next(iter(action))
+                    if op not in ("index", "create", "update", "delete"):
+                        # reject like real ES: an unknown op consuming the
+                        # wrong number of lines would desync the whole
+                        # action/source framing after it
+                        self._reply(400, {"error":
+                                          f"unknown bulk action {op!r}"})
+                        return
                     meta = action[op] or {}
                     index = meta.get("_index", default_index)
                     did = meta.get("_id") or uuid.uuid4().hex
@@ -241,6 +248,10 @@ class ElasticsearchClient:
         except urllib.error.HTTPError as e:
             raise ElasticsearchError(
                 f"{method} {path}: {e.code} {e.read()[:200]!r}") from e
+        except urllib.error.URLError as e:
+            # connection-level failure (refused / timeout / DNS): callers
+            # handle ElasticsearchError, never a raw URLError
+            raise ElasticsearchError(f"{method} {path}: {e.reason}") from e
 
     def create_index(self, index: str) -> None:
         self._call("PUT", f"/{index}")

@@ -846,18 +846,21 @@ class KafkaWireBroker:
         txn = self._txns.get(tid)
         if txn is None:
             return
-        with open(self._txn_path(tid), "wb") as f:   # truncate: new epoch
+        # atomic replace: a crash mid-rewrite must not destroy already
+        # fsynced (acked) staged records — the old file stays whole until
+        # the new one is durable
+        tmp = self._txn_path(tid) + "#tmp"
+        with open(tmp, "wb") as f:
             pickle.dump({"meta": True, "pid": txn["pid"],
                          "epoch": txn["epoch"], "state": txn["state"]},
                         f, protocol=pickle.HIGHEST_PROTOCOL)
-            # re-write any already-staged records (only non-empty right
-            # after a fencing reset, where staged was just cleared)
             for (t, p), recs in txn["staged"].items():
                 if recs:
                     pickle.dump((t, p, recs), f,
                                 protocol=pickle.HIGHEST_PROTOCOL)
             f.flush()
             os.fsync(f.fileno())
+        os.replace(tmp, self._txn_path(tid))
 
     def _append_txn_segment_locked(self, tid: str, topic: str, part: int,
                                    recs: list) -> None:

@@ -46,10 +46,15 @@ MAGIC = b"ORC"
 # orc_proto enums
 K_BOOLEAN, K_BYTE, K_SHORT, K_INT, K_LONG = 0, 1, 2, 3, 4
 K_FLOAT, K_DOUBLE, K_STRING, K_BINARY = 5, 6, 7, 8
+K_TIMESTAMP, K_DECIMAL = 9, 14
 K_STRUCT = 12
+#: timestamp values are seconds relative to the ORC epoch 2015-01-01
+#: 00:00:00 UTC, plus a scaled-nanosecond SECONDARY stream
+_ORC_EPOCH_S = 1_420_070_400
 COMP_NONE, COMP_ZLIB = 0, 1
 STREAM_PRESENT, STREAM_DATA, STREAM_LENGTH = 0, 1, 2
 STREAM_DICT_DATA = 3
+STREAM_SECONDARY = 5
 ENC_DIRECT, ENC_DICTIONARY, ENC_DIRECT_V2, ENC_DICTIONARY_V2 = 0, 1, 2, 3
 
 #: RLEv2 5-bit width codes -> bit widths (FixedBitSizes of the spec)
@@ -304,7 +309,11 @@ def _rle1_decode(data: bytes, n: int, signed: bool) -> np.ndarray:
         else:
             for _ in range(256 - ctrl):
                 x, pos = _read_uvarint(data, pos)
-                out[m] = _unzig(x) if signed else x
+                v = _unzig(x) if signed else x
+                if v >= 1 << 63:
+                    v -= 1 << 64    # 64-bit two's-complement wrap (the
+                    #                 signed nanos in the unsigned stream)
+                out[m] = v
                 m += 1
     return out
 
@@ -417,6 +426,8 @@ def _orc_kind(arr: np.ndarray) -> int:
     dt = arr.dtype
     if dt == np.bool_:
         return K_BOOLEAN
+    if np.issubdtype(dt, np.datetime64):
+        return K_TIMESTAMP
     if dt == np.int32:
         return K_INT
     if np.issubdtype(dt, np.integer):
@@ -425,7 +436,69 @@ def _orc_kind(arr: np.ndarray) -> int:
         return K_FLOAT
     if np.issubdtype(dt, np.floating):
         return K_DOUBLE
+    if dt == object and len(arr):
+        import decimal
+        head = next((v for v in arr.tolist() if v is not None), None)
+        if isinstance(head, decimal.Decimal):
+            return K_DECIMAL
     return K_STRING
+
+
+def _nanos_encode(nanos: np.ndarray) -> np.ndarray:
+    """ORC scaled nanoseconds: trailing decimal zeros strip off, their
+    count (minus one) rides the low 3 bits — 1000 serializes as
+    ``(1 << 3) | 2``.  Values may be NEGATIVE (pre-1970 sub-second
+    remainders under the truncate-toward-zero seconds convention): the
+    shifted mantissa keeps its sign and the low bits ride two's
+    complement, matching the C++ ORC writer (-0.5s → enc -33)."""
+    out = np.empty(len(nanos), np.int64)
+    for i, n in enumerate(nanos.tolist()):
+        a = -n if n < 0 else n
+        z = 0
+        if a:
+            while a % 10 == 0 and z < 8:
+                a //= 10
+                z += 1
+        if z >= 2:
+            m = -a if n < 0 else a
+            out[i] = (m << 3) | (z - 1)
+        else:
+            # 0 or 1 trailing zeros cannot be stripped (the 3-bit field
+            # encodes 2..8 removed zeros); store the raw value
+            out[i] = int(n) << 3
+    return out
+
+
+def _nanos_decode(enc: np.ndarray) -> np.ndarray:
+    zeros = enc & 7
+    vals = enc >> 3
+    scale = np.where(zeros > 0, 10 ** (zeros + 1), 1)
+    return vals * scale
+
+
+def _decimal_streams(arr: np.ndarray) -> List[Tuple[int, bytes]]:
+    """DECIMAL: unbounded zigzag-varint mantissas + a signed RLE scale
+    stream (per-value scales are legal; readers rescale to the declared
+    type scale)."""
+    data = bytearray()
+    scales = np.empty(len(arr), np.int64)
+    for i, v in enumerate(arr.tolist()):
+        t = v.as_tuple()
+        scale = max(-t.exponent, 0)
+        mantissa = int(v.scaleb(scale))
+        scales[i] = scale
+        # zigzag over arbitrary-precision ints: -1 flips all bits
+        n = ((mantissa << 1) ^ -1) if mantissa < 0 else mantissa << 1
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            if n:
+                data.append(b | 0x80)
+            else:
+                data.append(b)
+                break
+    return [(STREAM_DATA, bytes(data)),
+            (STREAM_SECONDARY, _rle1_encode(scales, signed=True))]
 
 
 def _column_streams(arr: np.ndarray, kind: int) -> List[Tuple[int, bytes]]:
@@ -440,6 +513,26 @@ def _column_streams(arr: np.ndarray, kind: int) -> List[Tuple[int, bytes]]:
                  np.asarray(arr, "<f4").tobytes())]
     if kind == K_DOUBLE:
         return [(STREAM_DATA, np.asarray(arr, "<f8").tobytes())]
+    if kind == K_TIMESTAMP:
+        ns = np.asarray(arr, "datetime64[ns]").astype(np.int64)
+        # seconds TRUNCATE toward zero and the nanos remainder carries the
+        # sign (the C++ ORC convention: floor-encoded pre-1970 fractional
+        # values read back one second early in foreign readers)
+        secs = ns // 1_000_000_000
+        rem = ns - secs * 1_000_000_000
+        adjust = (ns < 0) & (rem != 0)
+        secs = secs + adjust
+        nanos = rem - adjust * 1_000_000_000
+        enc = _nanos_encode(nanos)
+        # negative encodings ride the unsigned stream as 64-bit two's
+        # complement (what the C++ writer emits)
+        enc_u = [int(x) & 0xFFFFFFFFFFFFFFFF for x in enc.tolist()]
+        return [(STREAM_DATA,
+                 _rle1_encode(secs - _ORC_EPOCH_S, signed=True)),
+                (STREAM_SECONDARY,
+                 _rle1_encode(np.asarray(enc_u, object), signed=False))]
+    if kind == K_DECIMAL:
+        return _decimal_streams(arr)
     if kind == K_STRING:
         blobs = [("" if v is None else str(v)).encode() for v in
                  arr.tolist()]
@@ -529,7 +622,10 @@ def write_orc(batches: Iterable[RecordBatch], path: str,
             root.string(3, name)
         footer.msg(4, root)
         for kind in kinds:
-            footer.msg(4, _Msg().varint(1, kind))
+            tm = _Msg().varint(1, kind)
+            if kind == K_DECIMAL:
+                tm.varint(5, 38).varint(6, 18)   # precision/scale attrs
+            footer.msg(4, tm)
         footer.varint(6, total_rows)
         footer.varint(8, 0)                          # rowIndexStride: none
         fblob = _compress_stream(footer.encode(), comp)
@@ -625,6 +721,29 @@ def read_orc(path: str, batch_size: int = 0,
                 vals = np.frombuffer(data, "<f4", count=n_phys).copy()
             elif kind == K_DOUBLE:
                 vals = np.frombuffer(data, "<f8", count=n_phys).copy()
+            elif kind == K_TIMESTAMP:
+                secs = _int_decode(data, n_phys, True, enc)
+                nanos = _nanos_decode(_int_decode(
+                    stream(STREAM_SECONDARY), n_phys, False, enc))
+                ns = (secs + _ORC_EPOCH_S) * 1_000_000_000 + nanos
+                vals = ns.astype("datetime64[ns]")
+            elif kind == K_DECIMAL:
+                import decimal
+                scale_attr = _one(types[col], 6, 0)
+                scales = None
+                sec = stream(STREAM_SECONDARY)
+                if sec:
+                    scales = _int_decode(sec, n_phys, True, enc)
+                mants: List[int] = []
+                pos = 0
+                for _ in range(n_phys):
+                    u, pos = _read_uvarint(data, pos)
+                    mants.append(_unzig(u))
+                vals = np.asarray(
+                    [decimal.Decimal(m).scaleb(
+                        -int(scales[i] if scales is not None
+                             else scale_attr))
+                     for i, m in enumerate(mants)], object)
             elif kind in (K_STRING, K_BINARY):
                 is_dict = enc in (ENC_DICTIONARY, ENC_DICTIONARY_V2)
                 lens = _int_decode(

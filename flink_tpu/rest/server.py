@@ -65,17 +65,56 @@ def _percentiles(xs: List[float]) -> Dict[str, float]:
             "max": float(a.max()), "count": len(xs)}
 
 
+class MetricsHistory:
+    """Per-job ring of periodic metric samples — the
+    ``MetricStore``/``MetricFetcher`` analog behind the dashboard's
+    per-operator graphs.  Sampled by the REST server's background thread;
+    each sample is (wall ms, {vertex id: {records_in, records_out,
+    busy_ratio, backpressure_ratio}})."""
+
+    def __init__(self, capacity: int = 240):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._series: Dict[str, List[dict]] = {}
+
+    def sample(self, job_id: str, status: Dict[str, Any]) -> None:
+        import time as _time
+        entry = {"ts": int(_time.time() * 1000),
+                 "vertices": {v["id"]: {
+                     "records_in": v["records_in"],
+                     "records_out": v["records_out"],
+                     "busy_ratio": round(v.get("busy_ratio", 0.0), 4),
+                     "backpressure_ratio": round(
+                         v.get("backpressure_ratio", 0.0), 4)}
+                     for v in status.get("vertices", [])}}
+        with self._lock:
+            ring = self._series.setdefault(job_id, [])
+            ring.append(entry)
+            del ring[:-self.capacity]
+
+    def series(self, job_id: str) -> List[dict]:
+        with self._lock:
+            return list(self._series.get(job_id, []))
+
+
 class RestServer:
     def __init__(self, registry: JobRegistry, host: str = "127.0.0.1",
                  port: int = 0, ssl_context=None,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 sample_interval_s: float = 1.0):
         """``ssl_context``: server-side TLS (``security.ssl.rest.enabled``
         analog); ``auth_token``: require ``Authorization: Bearer <token>``
-        on every request."""
+        on every request.  A background thread samples every job's
+        per-vertex metrics into ``MetricsHistory`` each
+        ``sample_interval_s`` (the dashboard's graphs-over-time feed)."""
         self.registry = registry
         self._ssl = ssl_context
+        self.history = MetricsHistory()
+        self._sample_interval_s = sample_interval_s
+        self._stop_sampling = threading.Event()
         registry_ref = registry
         token_ref = auth_token
+        history_ref = self.history
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -165,6 +204,9 @@ class RestServer:
                                            for v in status["vertices"]),
                         "latency_ms": _percentiles(
                             cluster.sink_latencies_ms())})
+                if sub == "metrics/history":
+                    return self._send(
+                        {"series": history_ref.series(m.group(1))})
                 if sub == "exceptions":
                     return self._send({
                         "root_exception": status["failure"],
@@ -250,12 +292,34 @@ class RestServer:
         self.host, self.port = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="rest-server", daemon=True)
+        self._sampler = threading.Thread(target=self._sample_loop,
+                                         name="rest-metrics-sampler",
+                                         daemon=True)
+
+    def _sample_loop(self) -> None:
+        terminal_done: set = set()
+        while not self._stop_sampling.wait(self._sample_interval_s):
+            for jid, _name, cluster in self.registry.jobs():
+                if jid in terminal_done:
+                    continue       # frozen: keep the run's real history
+                try:
+                    status = cluster.job_status()
+                    self.history.sample(jid, status)
+                    if status.get("state") in ("FINISHED", "FAILED",
+                                               "CANCELED"):
+                        # one final sample then freeze — endless flatline
+                        # samples would evict the run's actual series
+                        terminal_done.add(jid)
+                except Exception:  # noqa: BLE001 — a finished/torn-down
+                    pass           # job must not kill the sampler
 
     def start(self) -> "RestServer":
         self._thread.start()
+        self._sampler.start()
         return self
 
     def stop(self) -> None:
+        self._stop_sampling.set()
         self._server.shutdown()
         self._server.server_close()
 
@@ -338,6 +402,7 @@ _DASHBOARD_HTML = """<!DOCTYPE html>
  <th>records in / out</th><th>watermark</th><th>time share</th></tr></thead>
  <tbody></tbody>
  </table>
+ <h2>Throughput (records/s per operator)</h2><div id="tput"></div>
  <h2>Job graph</h2><div id="dag" class="panelbox"></div>
  <h2>Subtask backpressure</h2><div id="bp"></div>
  <h2>Latency (source&rarr;sink)</h2><div class="tiles" id="lat"></div>
@@ -410,6 +475,7 @@ async function refresh(){
     .filter(k=>lat[k]!==undefined)
     .map(k=>tile(k,lat[k].toFixed(1)+' ms')).join('')||
     '<span style="color:var(--text-2);font-size:.85rem">no samples yet</span>';
+  renderTput(await J('/jobs/'+sel+'/metrics/history'));
   const ck=await J('/jobs/'+sel+'/checkpoints');
   document.getElementById('ckpts').textContent=
     ck.count?('completed: '+ck.count):'none yet';
@@ -432,6 +498,43 @@ async function refresh(){
   document.getElementById('exc').innerHTML=(ex.root_exception?
     ('<h2>Root exception</h2><div class="err">'+esc(ex.root_exception)+
      '</div>'):'')+exh;
+}
+function renderTput(h){
+  // per-vertex records/sec over time, derived from the sampled cumulative
+  // counters (MetricStore analog); one sparkline row per operator
+  const s=h.series||[];const el=document.getElementById('tput');
+  if(s.length<2){el.innerHTML=
+    '<span style="color:var(--text-2);font-size:.85rem">sampling…</span>';
+    return}
+  const ids=Object.keys(s[s.length-1].vertices);
+  const W=560,H=36;let out='';
+  for(const id of ids){
+    const rates=[];
+    for(let i=1;i<s.length;i++){
+      const a=s[i-1],b=s[i];
+      const va=a.vertices[id],vb=b.vertices[id];
+      if(!va||!vb)continue;
+      const dt=Math.max(1,(b.ts-a.ts))/1000;
+      rates.push(Math.max(0,(vb.records_in-va.records_in)/dt));
+    }
+    if(!rates.length)continue;
+    const mx=Math.max(1,...rates);
+    const pts=rates.map((r,i)=>
+      `${(i/(rates.length-1||1)*W).toFixed(1)},`+
+      `${(H-2-(H-6)*r/mx).toFixed(1)}`).join(' ');
+    const cur=rates[rates.length-1];
+    out+='<div class="bp-subtask"><span class="bp-label" title="'+esc(id)+
+      '">'+esc(id.length>14?id.slice(0,13)+'…':id)+'</span>'+
+      `<svg width="${W}" height="${H}" style="background:var(--panel);`+
+      `border-radius:6px"><polyline fill="none" stroke="var(--busy)" `+
+      `stroke-width="1.5" points="${pts}"/></svg>`+
+      '<span class="bp-pct">'+
+      (cur>=1e6?(cur/1e6).toFixed(2)+'M':cur>=1e3?(cur/1e3).toFixed(1)+'k':
+       cur.toFixed(0))+'/s · peak '+
+      (mx>=1e6?(mx/1e6).toFixed(2)+'M':mx>=1e3?(mx/1e3).toFixed(1)+'k':
+       mx.toFixed(0))+'/s</span></div>';
+  }
+  el.innerHTML=out||'<span style="color:var(--text-2)">no vertices</span>';
 }
 async function act(ev,id,verb){ev.stopPropagation();
   await fetch('/jobs/'+id+'/'+verb,{method:'POST'});refresh()}

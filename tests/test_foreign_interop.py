@@ -145,3 +145,61 @@ def test_pyarrow_orc_read_by_us(tmp_path):
     assert _concat(batches, "a").tolist() == list(range(n))
     names = [x for b in batches for x in np.asarray(b.column("s")).tolist()]
     assert names[8] == "y1"
+
+
+@pytest.mark.skipif(not HAVE_PYARROW, reason="pyarrow not installed")
+def test_orc_timestamp_and_decimal_cross_validation(tmp_path):
+    """ORC TIMESTAMP (2015-epoch seconds + scaled nanos) and DECIMAL
+    (unbounded zigzag mantissas + scale stream) interop with pyarrow in
+    both directions."""
+    import decimal
+
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    ts = np.asarray(["2024-01-15T12:30:45.123456789",
+                     "2015-01-01T00:00:00",
+                     "1969-12-31T23:59:59.5",
+                     "2030-06-01T08:00:00.5"], "datetime64[ns]")
+    dec = [decimal.Decimal("123.45"), decimal.Decimal("-0.001"),
+           decimal.Decimal("-7.25"),
+           decimal.Decimal("99999999999999999999.99")]
+
+    # ours -> pyarrow
+    ours = str(tmp_path / "ours.orc")
+    write_orc([RecordBatch({"t": ts, "d": np.asarray(dec, object)})], ours)
+    t = po.read_table(ours)
+    assert [x.isoformat() for x in t["t"].to_pylist()] == [
+        "2024-01-15T12:30:45.123456789",    # full nanosecond precision
+        "2015-01-01T00:00:00",
+        "1969-12-31T23:59:59.500000",       # pre-1970 fractional
+        "2030-06-01T08:00:00.500000"]
+    assert t["d"].to_pylist() == dec        # values equal (scale-normalized)
+
+    # pyarrow -> ours
+    theirs = str(tmp_path / "pa.orc")
+    po.write_table(pa.table({"t": ts, "d": dec}), theirs,
+                   compression="uncompressed")
+    (got,) = list(read_orc(theirs))
+    assert np.array_equal(np.asarray(got.column("t"), "datetime64[ns]"), ts)
+    assert list(got.column("d")) == dec
+
+
+def test_orc_timestamp_decimal_round_trip(tmp_path):
+    """No-pyarrow-needed round trip of the new ORC types, including
+    nanosecond precision and negative/large mantissas."""
+    import decimal
+
+    ts = np.asarray(["1999-12-31T23:59:59.999999999",
+                     "2015-01-01T00:00:00.000000001",
+                     "1969-12-31T23:59:59.5",      # pre-1970 fractional:
+                     "1969-06-01T00:00:00.25",     # trunc-toward-zero secs
+                     "2024-07-04T00:00:00"], "datetime64[ns]")
+    dec = [decimal.Decimal("0"), decimal.Decimal("-12345.678901"),
+           decimal.Decimal("7"), decimal.Decimal("-0.5"),
+           decimal.Decimal("1E+5")]
+    path = str(tmp_path / "t.orc")
+    write_orc([RecordBatch({"t": ts, "d": np.asarray(dec, object)})], path)
+    (got,) = list(read_orc(path))
+    assert np.array_equal(np.asarray(got.column("t"), "datetime64[ns]"), ts)
+    assert list(got.column("d")) == dec

@@ -272,3 +272,36 @@ def test_rest_checkpoint_stats_watermarks_and_exception_history(stack):
         th.join(timeout=120)
     ex = _get(f"{server.url}/jobs/{job_id}/exceptions")
     assert ex["root_exception"] is None and ex["history"] == []
+
+
+def test_metrics_history_sampled(tmp_path):
+    """The background sampler feeds /metrics/history with per-vertex
+    series over time — the MetricStore behind the dashboard's
+    per-operator throughput graphs."""
+    registry = JobRegistry()
+    server = RestServer(registry, sample_interval_s=0.05).start()
+    try:
+        job_id, mc, th = _run_job(registry, n=400_000,
+                                  name="history-job")
+        th.join(timeout=120)
+        time.sleep(0.3)                 # a few post-completion samples
+        h = _get(f"{server.url}/jobs/{job_id}/metrics/history")
+        series = h["series"]
+        assert len(series) >= 2
+        last = series[-1]
+        assert last["ts"] > 0
+        assert last["vertices"], last
+        v = next(iter(last["vertices"].values()))
+        assert {"records_in", "records_out", "busy_ratio",
+                "backpressure_ratio"} <= set(v)
+        # cumulative counters are monotone across samples
+        for vid in last["vertices"]:
+            vals = [s["vertices"][vid]["records_in"] for s in series
+                    if vid in s["vertices"]]
+            assert vals == sorted(vals)
+        # the dashboard page embeds the throughput panel
+        with urllib.request.urlopen(server.url, timeout=10) as r:
+            page = r.read().decode()
+        assert "metrics/history" in page and "renderTput" in page
+    finally:
+        server.stop()
