@@ -471,21 +471,16 @@ class RmqSink:
         self._cli()
 
     def write_batch(self, batch) -> None:
+        from flink_tpu.connectors.util import json_default
         c = self._cli()
         for r in batch.to_rows():
             c.publish(self.queue, json.dumps(
-                r, default=_json_default).encode())
+                r, default=json_default).encode())
 
     def close(self) -> None:
         if self._client is not None:
             self._client.close()
             self._client = None
-
-
-def _json_default(o):
-    if isinstance(o, np.generic):
-        return o.item()
-    raise TypeError(f"not JSON serializable: {type(o)}")
 
 
 class RmqSource:
@@ -546,17 +541,6 @@ class RmqSource:
         finally:
             c.close()
 
-    def _batch(self, rows, RecordBatch):
-        names: Dict[str, None] = {}
-        for r in rows:                   # union over ALL rows, not row 0
-            for k in r:
-                names.setdefault(k)
-        cols = {}
-        for k in names:
-            vals = [r.get(k) for r in rows]
-            arr = (np.asarray(vals, object) if any(v is None for v in vals)
-                   else np.asarray(vals))
-            cols[k] = arr
-        ts = (np.asarray(cols[self.timestamp_column], np.int64)
-              if self.timestamp_column else None)
-        return RecordBatch(cols, timestamps=ts)
+    def _batch(self, rows, _RecordBatch):
+        from flink_tpu.connectors.util import rows_to_batch
+        return rows_to_batch(rows, self.timestamp_column)

@@ -23,8 +23,12 @@ from flink_tpu.core.batch import RecordBatch
 
 
 def _coerce_columns(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
-    """Rows -> typed columns: try int64, then float64, else object.
-    Column set = union over all rows (sparse fields fill with None)."""
+    """Rows -> typed columns: all-int values -> int64, all-bool -> bool,
+    numeric-with-floats/None -> float64 (None becomes NaN), anything
+    mixed -> object.  Column set = union over all rows.  int64 is only
+    chosen when EVERY value is an integer — ``np.asarray([1.5], int64)``
+    silently truncates, so a try-int-first ladder would corrupt float
+    columns."""
     if not rows:
         return {}
     names: Dict[str, None] = {}
@@ -34,14 +38,20 @@ def _coerce_columns(rows: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
     cols: Dict[str, np.ndarray] = {}
     for name in names:
         vals = [r.get(name) for r in rows]
-        arr = None
-        for dtype in (np.int64, np.float64):
+        if all(isinstance(v, bool) for v in vals):
+            cols[name] = np.asarray(vals, bool)
+            continue
+        if all(isinstance(v, (int, np.integer))
+               and not isinstance(v, bool) for v in vals):
             try:
-                arr = np.asarray(vals, dtype)
-                break
-            except (ValueError, TypeError, OverflowError):
+                cols[name] = np.asarray(vals, np.int64)
                 continue
-        cols[name] = arr if arr is not None else np.asarray(vals, object)
+            except OverflowError:
+                pass                    # beyond int64: fall through
+        try:
+            cols[name] = np.asarray(vals, np.float64)
+        except (ValueError, TypeError, OverflowError):
+            cols[name] = np.asarray(vals, object)
     return cols
 
 

@@ -69,6 +69,10 @@ class QueryPlan:
     #: plan feeds a derived table
     rowtime: Optional[str] = None
     timestamps_assigned: bool = False
+    #: the result rows are a CHANGELOG (op column carries the change kind)
+    #: — set by TableEnvironment._plan from the planner's per-plan flag;
+    #: consumers must fold retractions, never sniff column names
+    changelog: bool = False
 
 
 def _transform(expr: Expr, fn: Callable[[Expr], Optional[Expr]]) -> Expr:

@@ -486,6 +486,7 @@ class TableEnvironment:
         finally:
             for t in self._catalog.values():
                 t._bound_env = None
+        plan.changelog = planner._changelog_join
         if return_planner:
             return env, plan, planner
         return env, plan
@@ -665,9 +666,14 @@ class GroupedTable:
             out_cols.append(out)
 
         env, plan = self.table._planned()
+        # a changelog input (CDC table, streaming-join view) must FOLD
+        # retractions, not sum raw rows; the plan carries the trait
+        # explicitly — a user column merely NAMED 'op' stays plain data
+        consume = plan.changelog
         out = Table._keyed_then(
             plan.stream, key, "sql-changelog-agg",
-            lambda: ChangelogGroupAggOperator(key, agg_columns))
+            lambda: ChangelogGroupAggOperator(
+                key, agg_columns, consume_retractions=consume))
         return TableResult(env, QP(out, out_cols))
 
 

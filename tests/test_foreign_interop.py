@@ -203,3 +203,18 @@ def test_orc_timestamp_decimal_round_trip(tmp_path):
     (got,) = list(read_orc(path))
     assert np.array_equal(np.asarray(got.column("t"), "datetime64[ns]"), ts)
     assert list(got.column("d")) == dec
+
+
+def test_jsonl_float_columns_not_truncated(tmp_path):
+    """Regression: the rows->columns coercion must never pick int64 for a
+    float column (np.asarray([1.5], int64) silently truncates)."""
+    from flink_tpu.formats import read_jsonl, write_jsonl
+
+    path = str(tmp_path / "f.jsonl")
+    with open(path, "w") as f:
+        f.write('{"a": 1.5, "b": 2, "c": true}\n')
+        f.write('{"a": 2.5, "b": 3, "c": false}\n')
+    (b,) = list(read_jsonl(path))
+    assert np.asarray(b.column("a")).tolist() == [1.5, 2.5]
+    assert np.asarray(b.column("b")).dtype == np.int64
+    assert np.asarray(b.column("c")).dtype == np.bool_
