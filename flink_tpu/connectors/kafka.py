@@ -1772,10 +1772,7 @@ class KafkaWireSink:
             self._client.close()
 
 
-def _json_default(o):
-    if isinstance(o, np.generic):
-        return o.item()
-    raise TypeError(type(o).__name__)
+from flink_tpu.connectors.util import json_default as _json_default  # noqa: E402 — shared encoder
 
 
 def _encode_batch_v2(base_offset, records):

@@ -11,8 +11,10 @@ with ``X-Amz-Target: Kinesis_20131202.<Action>`` and a JSON body,
 records base64-encoded, opaque shard iterators, ``TRIM_HORIZON`` /
 ``AT_SEQUENCE_NUMBER`` / ``AFTER_SEQUENCE_NUMBER`` / ``LATEST`` iterator
 types, and SigV4 request signing (``service="kinesis"``) reusing the S3
-module's signer — ``KinesisService`` verifies signatures when keys are
-configured.  Partition keys route to shards by hash (real Kinesis splits
+module's signer — ``KinesisService`` checks that the signed
+Authorization header carries the configured access-key ID (a presence
+check, NOT a full signature re-derivation; that lives in the S3
+server).  Partition keys route to shards by hash (real Kinesis splits
 the md5 hash-key RANGE across shards; same distribution, simpler
 bookkeeping).
 """

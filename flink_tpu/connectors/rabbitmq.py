@@ -27,8 +27,6 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-import numpy as np
-
 PROTOCOL_HEADER = b"AMQP\x00\x00\x09\x01"
 FRAME_METHOD, FRAME_HEADER, FRAME_BODY, FRAME_HEARTBEAT = 1, 2, 3, 8
 FRAME_END = 0xCE

@@ -9,7 +9,9 @@ import numpy as np
 
 
 def json_default(o):
-    """``json.dumps(default=...)`` hook for numpy scalars/arrays."""
+    """``json.dumps(default=...)`` hook for numpy scalars/arrays — the
+    ONE implementation (formats/__init__.py and the Kafka sinks alias
+    it)."""
     if isinstance(o, np.generic):
         return o.item()
     if isinstance(o, np.ndarray):

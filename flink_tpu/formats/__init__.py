@@ -132,11 +132,8 @@ def write_jsonl(batches, path: str) -> int:
 
 
 def _json_default(o):
-    if isinstance(o, np.generic):
-        return o.item()
-    if isinstance(o, np.ndarray):
-        return o.tolist()
-    raise TypeError(f"not JSON serializable: {type(o)}")
+    from flink_tpu.connectors.util import json_default
+    return json_default(o)
 
 
 def _batch_from_rows(rows: List[Dict[str, Any]],

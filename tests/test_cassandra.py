@@ -1,0 +1,135 @@
+"""Cassandra connector (CassandraSink analog): CQL binary protocol v4
+server + client + sink/source."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.cassandra import (CassandraError, CassandraSink,
+                                            CassandraSource, CqlClient,
+                                            CqlServer)
+from flink_tpu.core.batch import RecordBatch
+
+
+@pytest.fixture
+def srv():
+    s = CqlServer()
+    yield s
+    s.close()
+
+
+def connect(s):
+    return CqlClient(s.host, s.port)
+
+
+class TestWire:
+    def test_startup_create_insert_select(self, srv):
+        with connect(srv) as c:
+            c.execute("CREATE KEYSPACE ks")
+            c.execute("USE ks")
+            c.execute("CREATE TABLE t (id bigint PRIMARY KEY, "
+                      "name text, score double, ok boolean)")
+            c.execute("INSERT INTO t (id, name, score, ok) "
+                      "VALUES (1, 'ada', 9.5, true)")
+            c.execute("INSERT INTO t (id, name, score, ok) "
+                      "VALUES (2, 'bob', 7.25, false)")
+            cols, rows = c.execute("SELECT id, name, score, ok FROM t")
+            assert [n for n, _t in cols] == ["id", "name", "score", "ok"]
+            assert sorted(rows) == [[1, "ada", 9.5, True],
+                                    [2, "bob", 7.25, False]]
+
+    def test_upsert_by_primary_key(self, srv):
+        with connect(srv) as c:
+            c.execute("CREATE KEYSPACE ks")
+            c.execute("CREATE TABLE ks.u (id int PRIMARY KEY, v text)")
+            c.execute("INSERT INTO ks.u (id, v) VALUES (7, 'first')")
+            c.execute("INSERT INTO ks.u (id, v) VALUES (7, 'second')")
+            _, rows = c.execute("SELECT v FROM ks.u WHERE id = 7")
+            assert rows == [["second"]]       # Cassandra INSERT = upsert
+            _, rows = c.execute("SELECT id FROM ks.u")
+            assert len(rows) == 1             # no duplicate rows
+
+    def test_partial_insert_merges(self, srv):
+        with connect(srv) as c:
+            c.execute("CREATE KEYSPACE ks")
+            c.execute("CREATE TABLE ks.p (id int PRIMARY KEY, "
+                      "a text, b text)")
+            c.execute("INSERT INTO ks.p (id, a, b) VALUES (1, 'x', 'y')")
+            c.execute("INSERT INTO ks.p (id, b) VALUES (1, 'z')")
+            _, rows = c.execute("SELECT a, b FROM ks.p WHERE id = 1")
+            assert rows == [["x", "z"]]       # unset columns keep values
+
+    def test_errors_ride_error_frames(self, srv):
+        with connect(srv) as c:
+            with pytest.raises(CassandraError, match="no keyspace"):
+                c.execute("SELECT * FROM nope")
+            c.execute("CREATE KEYSPACE ks")
+            c.execute("USE ks")
+            with pytest.raises(CassandraError, match="does not exist"):
+                c.execute("SELECT * FROM nope")
+            # the connection SURVIVES errors (stream-level, not fatal)
+            c.execute("CREATE TABLE t (id int PRIMARY KEY, v text)")
+            c.execute("INSERT INTO t (id, v) VALUES (1, 'a')")
+            _, rows = c.execute("SELECT v FROM t")
+            assert rows == [["a"]]
+
+    def test_raw_frame_layout(self, srv):
+        """A foreign driver's first bytes: v4 STARTUP gets READY with the
+        response-direction bit set."""
+        import socket as _socket
+        from flink_tpu.connectors.cassandra import (OP_READY, OP_STARTUP,
+                                                    _frame, _string)
+        s = _socket.create_connection((srv.host, srv.port), timeout=5)
+        opts = struct.pack(">H", 1) + _string("CQL_VERSION") \
+            + _string("3.4.4")
+        s.sendall(_frame(0x04, 42, OP_STARTUP, opts))
+        hdr = s.recv(9)
+        version, _fl, stream, opcode, length = struct.unpack(">BBhBI", hdr)
+        assert version == 0x84                # response bit | v4
+        assert stream == 42 and opcode == OP_READY and length == 0
+        s.close()
+
+
+class TestConnector:
+    def test_sink_flush_on_checkpoint_and_idempotent_replay(self, srv):
+        with connect(srv) as c:
+            c.execute("CREATE KEYSPACE ks")
+            c.execute("CREATE TABLE ks.out (id bigint PRIMARY KEY, "
+                      "v double)")
+
+        def run():
+            sink = CassandraSink(srv.host, srv.port, "ks.out",
+                                 columns=["id", "v"])
+            sink.open(None)
+            sink.write_batch(RecordBatch(
+                {"id": np.asarray([1, 2, 3], np.int64),
+                 "v": np.asarray([1.5, 2.5, 3.5])}))
+            sink.snapshot_state()             # checkpoint flush
+            sink.close()
+
+        run()
+        run()                                 # replay: upserts, no dups
+        with connect(srv) as c:
+            _, rows = c.execute("SELECT id, v FROM ks.out")
+        assert sorted(rows) == [[1, 1.5], [2, 2.5], [3, 3.5]]
+
+    def test_source_in_pipeline(self, srv):
+        from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+        with connect(srv) as c:
+            c.execute("CREATE KEYSPACE ks")
+            c.execute("CREATE TABLE ks.n (id bigint PRIMARY KEY, "
+                      "k bigint, v double)")
+            for i, (k, v) in enumerate([(0, 1.0), (1, 2.0), (0, 3.0)]):
+                c.execute(f"INSERT INTO ks.n (id, k, v) "
+                          f"VALUES ({i}, {k}, {v})")
+        env = StreamExecutionEnvironment()
+        rows = (env.from_source(
+            CassandraSource(srv.host, srv.port, "ks.n"))
+            .key_by("k").sum("v", output_column="total")
+            .execute_and_collect())
+        finals = {}
+        for r in rows:
+            finals[r["k"]] = max(r["total"], finals.get(r["k"], 0.0))
+        assert finals == {0: 4.0, 1: 2.0}
