@@ -561,7 +561,8 @@ class _TxnState:
 
 
 class PostgresWireServer:
-    """In-process server speaking the v3 dialect (trust or md5 auth).
+    """In-process server speaking the v3 dialect (trust, md5, or
+    SCRAM-SHA-256 auth; simple AND extended query protocols).
 
     ``persist_dir`` makes prepared transactions and the committed-gid set
     durable (JSON files), so a 2PC sink's replayed ``COMMIT PREPARED``
@@ -569,7 +570,11 @@ class PostgresWireServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  users: Optional[Dict[str, str]] = None,
-                 persist_dir: Optional[str] = None):
+                 persist_dir: Optional[str] = None,
+                 auth: str = "md5"):
+        if auth not in ("md5", "scram-sha-256"):
+            raise ValueError(f"unsupported auth {auth!r}")
+        self.auth = auth
         self.users = users  # None = trust everyone
         self.tables: Dict[str, _Table] = {}
         self.prepared: Dict[str, list] = {}
@@ -726,55 +731,276 @@ class PostgresWireServer:
                 params[k.decode()] = v.decode()
         user = params.get("user", "")
         if self.users is not None:
-            salt = os.urandom(4)
-            sock.sendall(_msg(b"R", struct.pack(">i", 5) + salt))
-            t, body = read_message(sock)
-            if t != b"p":
-                sock.sendall(_error("expected password message", "28000"))
-                return
-            given = body.rstrip(b"\0").decode()
-            want = self.users.get(user)
-            if want is None or given != md5_password(user, want, salt):
-                sock.sendall(_error(
-                    f'password authentication failed for user "{user}"',
-                    "28P01"))
-                return
+            if self.auth == "scram-sha-256":
+                if not self._scram_handshake(sock, user):
+                    return
+            else:
+                salt = os.urandom(4)
+                sock.sendall(_msg(b"R", struct.pack(">i", 5) + salt))
+                t, body = read_message(sock)
+                if t != b"p":
+                    sock.sendall(_error("expected password message",
+                                        "28000"))
+                    return
+                given = body.rstrip(b"\0").decode()
+                want = self.users.get(user)
+                if want is None or given != md5_password(user, want, salt):
+                    sock.sendall(_error(
+                        f'password authentication failed for user '
+                        f'"{user}"', "28P01"))
+                    return
         sock.sendall(_msg(b"R", struct.pack(">i", 0)))          # AuthOk
+        self._post_auth(sock)
+        txn = _TxnState()
+        self._message_loop(sock, txn)
+
+    def _scram_handshake(self, sock, user: str) -> bool:
+        """SCRAM-SHA-256 (RFC 5802/7677, the PostgreSQL 10+ default):
+        AuthenticationSASL → SASLInitialResponse → SASLContinue →
+        client-final-with-proof → SASLFinal.  Mutual: the client proves
+        the password via ClientProof, the server proves it KNOWS the
+        password via ServerSignature.  Malformed client messages answer
+        with an ErrorResponse, never a dropped socket."""
+        try:
+            return self._scram_handshake_inner(sock, user)
+        except (KeyError, ValueError, IndexError, struct.error) as e:
+            try:
+                sock.sendall(_error(f"malformed SCRAM message: "
+                                    f"{e or type(e).__name__}", "28000"))
+            except OSError:
+                pass
+            return False
+
+    def _scram_handshake_inner(self, sock, user: str) -> bool:
+        import base64
+        import hashlib
+        import hmac as _hmac
+
+        sock.sendall(_msg(b"R", struct.pack(">i", 10)
+                          + _cstr("SCRAM-SHA-256") + b"\0"))
+        t, body = read_message(sock)
+        if t != b"p":
+            sock.sendall(_error("expected SASLInitialResponse", "28000"))
+            return False
+        nul = body.index(b"\0")
+        mech = body[:nul].decode()
+        (ln,) = struct.unpack_from(">i", body, nul + 1)
+        client_first = body[nul + 5:nul + 5 + ln].decode()
+        if mech != "SCRAM-SHA-256":
+            sock.sendall(_error(f"unsupported SASL mechanism {mech}",
+                                "28000"))
+            return False
+        # client-first: "n,,n=<user>,r=<cnonce>" (no channel binding)
+        bare = client_first.split(",", 2)[2]
+        cnonce = dict(p.split("=", 1) for p in bare.split(","))["r"]
+        want = self.users.get(user)
+        if want is None:
+            sock.sendall(_error(
+                f'password authentication failed for user "{user}"',
+                "28P01"))
+            return False
+        salt = os.urandom(16)
+        iters = 4096
+        snonce = cnonce + base64.b64encode(os.urandom(18)).decode()
+        server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
+                        f"i={iters}")
+        sock.sendall(_msg(b"R", struct.pack(">i", 11)
+                          + server_first.encode()))
+        t, body = read_message(sock)
+        if t != b"p":
+            sock.sendall(_error("expected SASLResponse", "28000"))
+            return False
+        client_final = body.decode()
+        cf = dict(p.split("=", 1) for p in client_final.split(","))
+        proof = base64.b64decode(cf["p"])
+        without_proof = client_final.rsplit(",p=", 1)[0]
+        if cf.get("r") != snonce:
+            sock.sendall(_error("SCRAM nonce mismatch", "28000"))
+            return False
+        salted = hashlib.pbkdf2_hmac("sha256", want.encode(), salt, iters)
+        client_key = _hmac.new(salted, b"Client Key",
+                               hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        auth_msg = f"{bare},{server_first},{without_proof}".encode()
+        signature = _hmac.new(stored_key, auth_msg,
+                              hashlib.sha256).digest()
+        recovered = bytes(a ^ b for a, b in zip(proof, signature))
+        if hashlib.sha256(recovered).digest() != stored_key:
+            sock.sendall(_error(
+                f'password authentication failed for user "{user}"',
+                "28P01"))
+            return False
+        server_key = _hmac.new(salted, b"Server Key",
+                               hashlib.sha256).digest()
+        server_sig = _hmac.new(server_key, auth_msg,
+                               hashlib.sha256).digest()
+        final = f"v={base64.b64encode(server_sig).decode()}"
+        sock.sendall(_msg(b"R", struct.pack(">i", 12) + final.encode()))
+        return True
+
+    def _post_auth(self, sock) -> None:
         for k, v in (("server_version", "14.0 (flink-tpu)"),
                      ("client_encoding", "UTF8")):
             sock.sendall(_msg(b"S", _cstr(k) + _cstr(v)))
         sock.sendall(_msg(b"K", struct.pack(">ii", os.getpid() & 0x7FFFFFFF,
                                             12345)))
         sock.sendall(_msg(b"Z", b"I"))
-        txn = _TxnState()
+
+    def _message_loop(self, sock, txn) -> None:
+        #: extended-protocol state (Parse/Bind/Describe/Execute/Sync —
+        #: the JDBC-driver flow): prepared statements by name and bound
+        #: portals (query + lazily cached result, so Describe's
+        #: RowDescription and Execute's DataRows come from ONE evaluation)
+        stmts: Dict[str, str] = {}
+        portals: Dict[str, dict] = {}
+        ext_out: List[bytes] = []
+        aborted = [False]
         while True:
             t, body = read_message(sock)
             if t == b"X":
                 return
-            if t != b"Q":
+            if t == b"Q":
+                # a simple Query amid an extended batch acts as an
+                # implicit Sync: buffered extended replies flush FIRST
+                # (response order must match request order) and the
+                # aborted state clears
+                if ext_out:
+                    sock.sendall(b"".join(ext_out))
+                    ext_out.clear()
+                aborted[0] = False
+                sql = body.rstrip(b"\0").decode()
+                out = []
+                try:
+                    for stmt in _split_statements(sql) or [""]:
+                        tag, fields, rows = self._sql.execute(stmt, txn)
+                        if tag == "EMPTY":
+                            out.append(_msg(b"I", b""))
+                            continue
+                        if fields is not None:
+                            out.append(_row_description(fields))
+                            for r in rows:
+                                out.append(_data_row(r))
+                        out.append(_msg(b"C", _cstr(tag)))
+                except (ValueError, TypeError, KeyError, IndexError) as e:
+                    # every statement failure must surface as an 'E'
+                    # message + ReadyForQuery, never kill the connection
+                    out.append(_error(str(e) or type(e).__name__))
+                    txn.reset()
+                out.append(_msg(b"Z", b"T" if txn.explicit else b"I"))
+                sock.sendall(b"".join(out))
+            elif t in (b"P", b"B", b"D", b"E", b"C", b"H", b"S"):
+                self._extended(t, body, txn, stmts, portals, ext_out,
+                               aborted, sock)
+            else:
                 sock.sendall(_error(f"unsupported message {t!r}", "08P01"))
                 sock.sendall(_msg(b"Z", b"I"))
-                continue
-            sql = body.rstrip(b"\0").decode()
-            out = []
-            try:
-                for stmt in _split_statements(sql) or [""]:
-                    tag, fields, rows = self._sql.execute(stmt, txn)
-                    if tag == "EMPTY":
-                        out.append(_msg(b"I", b""))
-                        continue
-                    if fields is not None:
-                        out.append(_row_description(fields))
-                        for r in rows:
-                            out.append(_data_row(r))
-                    out.append(_msg(b"C", _cstr(tag)))
-            except (ValueError, TypeError, KeyError, IndexError) as e:
-                # every statement failure must surface as an 'E' message +
-                # ReadyForQuery, never kill the connection mid-protocol
-                out.append(_error(str(e) or type(e).__name__))
-                txn.reset()
+
+    def _extended(self, t: bytes, body: bytes, txn, stmts, portals,
+                  out: List[bytes], aborted: List[bool], sock) -> None:
+        """One extended-protocol message.  Responses buffer until Sync or
+        Flush; an error puts the connection in the aborted state, where
+        everything but Sync is skipped (the reference's
+        skip-till-sync)."""
+        if t == b"S":                        # Sync: flush + ReadyForQuery
             out.append(_msg(b"Z", b"T" if txn.explicit else b"I"))
             sock.sendall(b"".join(out))
+            out.clear()
+            aborted[0] = False
+            return
+        if t == b"H":                        # Flush
+            sock.sendall(b"".join(out))
+            out.clear()
+            return
+        if aborted[0]:
+            return
+        try:
+            if t == b"P":                    # Parse
+                nul1 = body.index(b"\0")
+                name = body[:nul1].decode()
+                nul2 = body.index(b"\0", nul1 + 1)
+                stmts[name] = body[nul1 + 1:nul2].decode()
+                out.append(_msg(b"1", b""))
+            elif t == b"B":                  # Bind
+                pos = body.index(b"\0")
+                portal = body[:pos].decode()
+                pos += 1
+                end = body.index(b"\0", pos)
+                stmt_name = body[pos:end].decode()
+                pos = end + 1
+                (nfmt,) = struct.unpack_from(">h", body, pos)
+                pos += 2
+                fmts = struct.unpack_from(f">{nfmt}h", body, pos) \
+                    if nfmt else ()
+                pos += 2 * nfmt
+                if any(f == 1 for f in fmts):
+                    # binary-format parameters would be misread as UTF-8
+                    # text: reject explicitly rather than corrupt
+                    raise ValueError("binary-format parameters are not "
+                                     "supported (send text format)")
+                (nparams,) = struct.unpack_from(">h", body, pos)
+                pos += 2
+                params: List[Optional[str]] = []
+                for _ in range(nparams):
+                    (ln,) = struct.unpack_from(">i", body, pos)
+                    pos += 4
+                    if ln < 0:
+                        params.append(None)
+                    else:
+                        params.append(body[pos:pos + ln].decode())
+                        pos += ln
+                (nrfmt,) = struct.unpack_from(">h", body, pos)
+                rfmts = struct.unpack_from(f">{nrfmt}h", body, pos + 2) \
+                    if nrfmt else ()
+                if any(f == 1 for f in rfmts):
+                    raise ValueError("binary result format is not "
+                                     "supported (request text format)")
+                if stmt_name not in stmts:
+                    raise ValueError(f"unknown prepared statement "
+                                     f"{stmt_name!r}")
+                portals[portal] = {
+                    "query": _substitute_params(stmts[stmt_name], params)}
+                out.append(_msg(b"2", b""))
+            elif t == b"D":                  # Describe
+                kind, name = chr(body[0]), body[1:].rstrip(b"\0").decode()
+                if kind == "P":
+                    p = portals.get(name)
+                    if p is None:
+                        raise ValueError(f"unknown portal {name!r}")
+                    self._run_portal(p, txn)
+                    out.append(_row_description(p["fields"])
+                               if p["fields"] is not None
+                               else _msg(b"n", b""))
+                else:                        # statement: no param typing
+                    out.append(_msg(b"n", b""))
+            elif t == b"E":                  # Execute
+                name = body[:body.index(b"\0")].decode()
+                p = portals.get(name)
+                if p is None:
+                    raise ValueError(f"unknown portal {name!r}")
+                self._run_portal(p, txn)
+                if p["tag"] == "EMPTY":
+                    out.append(_msg(b"I", b""))
+                else:
+                    for r in (p["rows"] or []):
+                        out.append(_data_row(r))
+                    out.append(_msg(b"C", _cstr(p["tag"])))
+            elif t == b"C":                  # Close statement/portal
+                kind, name = chr(body[0]), body[1:].rstrip(b"\0").decode()
+                (stmts if kind == "S" else portals).pop(name, None)
+                out.append(_msg(b"3", b""))
+        except (ValueError, TypeError, KeyError, IndexError,
+                struct.error) as e:
+            out.append(_error(str(e) or type(e).__name__))
+            aborted[0] = True
+            txn.reset()
+
+    def _run_portal(self, p: dict, txn) -> None:
+        """Evaluate the portal's query ONCE; Describe and Execute share
+        the result (the reference derives Describe metadata without
+        executing; the mini engine evaluates eagerly instead)."""
+        if "tag" not in p:
+            tag, fields, rows = self._sql.execute(p["query"], txn)
+            p["tag"], p["fields"], p["rows"] = tag, fields, rows
 
     def close(self):
         self._tcp.shutdown()
@@ -804,6 +1030,7 @@ class PostgresWireClient:
                 + _cstr(user) + _cstr("database") + _cstr(database) + b"\0"
             self.sock.sendall(struct.pack(">i", len(payload) + 4) + payload)
             self.parameters: Dict[str, str] = {}
+            scram: Dict[str, Any] = {}
             while True:
                 t, body = read_message(self.sock)
                 if t == b"R":
@@ -813,6 +1040,10 @@ class PostgresWireClient:
                     if code == 5:
                         pw = md5_password(user, password, body[4:8])
                         self.sock.sendall(_msg(b"p", _cstr(pw)))
+                        continue
+                    if code in (10, 11, 12):
+                        self._scram_step(code, body[4:], user, password,
+                                         scram)
                         continue
                     raise PostgresError(
                         {"M": f"unsupported auth code {code}"})
@@ -831,6 +1062,58 @@ class PostgresWireClient:
             self.sock.close()
             raise
 
+    def _scram_step(self, code: int, payload: bytes, user: str,
+                    password: str, st: Dict[str, Any]) -> None:
+        """Client half of SCRAM-SHA-256 (RFC 5802): initial response,
+        proof computation, and SERVER-signature verification (mutual
+        auth — a server that doesn't know the password fails here)."""
+        import base64
+        import hashlib
+        import hmac as _hmac
+
+        if code == 10:                       # AuthenticationSASL
+            mechs = [m.decode() for m in payload.split(b"\0") if m]
+            if "SCRAM-SHA-256" not in mechs:
+                raise PostgresError({"M": f"no usable SASL mechanism "
+                                          f"in {mechs}"})
+            st["cnonce"] = base64.b64encode(os.urandom(18)).decode()
+            st["bare"] = f"n=,r={st['cnonce']}"
+            first = "n,," + st["bare"]
+            self.sock.sendall(_msg(
+                b"p", _cstr("SCRAM-SHA-256")
+                + struct.pack(">i", len(first)) + first.encode()))
+        elif code == 11:                     # SASLContinue (server-first)
+            server_first = payload.decode()
+            parts = dict(p.split("=", 1) for p in server_first.split(","))
+            nonce, salt = parts["r"], base64.b64decode(parts["s"])
+            iters = int(parts["i"])
+            if not nonce.startswith(st["cnonce"]):
+                raise PostgresError({"M": "SCRAM nonce mismatch"})
+            salted = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                         salt, iters)
+            client_key = _hmac.new(salted, b"Client Key",
+                                   hashlib.sha256).digest()
+            stored = hashlib.sha256(client_key).digest()
+            without_proof = f"c=biws,r={nonce}"
+            auth_msg = (f"{st['bare']},{server_first},"
+                        f"{without_proof}").encode()
+            sig = _hmac.new(stored, auth_msg, hashlib.sha256).digest()
+            proof = bytes(a ^ b for a, b in zip(client_key, sig))
+            server_key = _hmac.new(salted, b"Server Key",
+                                   hashlib.sha256).digest()
+            st["server_sig"] = _hmac.new(server_key, auth_msg,
+                                         hashlib.sha256).digest()
+            final = (f"{without_proof},"
+                     f"p={base64.b64encode(proof).decode()}")
+            self.sock.sendall(_msg(b"p", final.encode()))
+        else:                                # SASLFinal: verify the server
+            parts = dict(p.split("=", 1)
+                         for p in payload.decode().split(","))
+            got = base64.b64decode(parts.get("v", ""))
+            if got != st.get("server_sig"):
+                raise PostgresError({"M": "server signature verification "
+                                          "failed (not the real server?)"})
+
     @staticmethod
     def _error_fields(body: bytes) -> Dict[str, str]:
         out = {}
@@ -844,6 +1127,13 @@ class PostgresWireClient:
         """Simple-query cycle: returns (fields as (name, oid), text rows).
         Statements without a result set return ([], [])."""
         self.sock.sendall(_msg(b"Q", _cstr(sql)))
+        return self._read_until_ready()
+
+    def _read_until_ready(self) -> Tuple[List[Tuple[str, int]],
+                                         List[List[Optional[str]]]]:
+        """Drain responses to ReadyForQuery — shared by the simple AND
+        extended query cycles (extended-only messages like ParseComplete
+        fall through like CommandComplete does)."""
         fields: List[Tuple[str, int]] = []
         rows: List[List[Optional[str]]] = []
         err: Optional[Dict[str, str]] = None
@@ -881,11 +1171,12 @@ class PostgresWireClient:
                 if err is not None:
                     raise PostgresError(err)
                 return fields, rows
-            # 'C' CommandComplete / 'I' Empty / 'N' Notice: fall through
+            # 'C' CommandComplete / 'I' Empty / 'N' Notice / '1' Parse-
+            # Complete / '2' BindComplete / 'n' NoData / '3' Close-
+            # Complete: fall through
 
-    def query_columns(self, sql: str) -> Dict[str, np.ndarray]:
-        """Typed columns (numpy, dtype from the field OIDs)."""
-        fields, rows = self.query(sql)
+    @staticmethod
+    def _typed_columns(fields, rows) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {}
         for j, (name, oid) in enumerate(fields):
             dt = _OID_DTYPE.get(oid, np.dtype(object))
@@ -895,8 +1186,48 @@ class PostgresWireClient:
             out[name] = np.asarray(vals, dtype=dt)
         return out
 
+    def query_columns(self, sql: str) -> Dict[str, np.ndarray]:
+        """Typed columns (numpy, dtype from the field OIDs)."""
+        return self._typed_columns(*self.query(sql))
+
     def execute(self, sql: str) -> None:
         self.query(sql)
+
+    def execute_prepared(self, sql: str, params: Sequence[Any] = ()
+                         ) -> Tuple[List[Tuple[str, int]],
+                                    List[List[Optional[str]]]]:
+        """EXTENDED-protocol cycle (the JDBC PreparedStatement flow):
+        Parse → Bind (text-format ``$n`` parameters) → Describe(portal) →
+        Execute → Sync; returns (fields, text rows)."""
+        def enc(v: Any) -> Optional[bytes]:
+            if v is None:
+                return None
+            if isinstance(v, (bool, np.bool_)):
+                return b"true" if v else b"false"
+            return str(v).encode()
+
+        parse = _cstr("") + _cstr(sql) + struct.pack(">h", 0)
+        bind = bytearray(_cstr("") + _cstr("") + struct.pack(">h", 0))
+        bind += struct.pack(">h", len(params))
+        for v in params:
+            b = enc(v)
+            if b is None:
+                bind += struct.pack(">i", -1)
+            else:
+                bind += struct.pack(">i", len(b)) + b
+        bind += struct.pack(">h", 0)
+        frames = (_msg(b"P", parse) + _msg(b"B", bytes(bind))
+                  + _msg(b"D", b"P\0") + _msg(b"E", _cstr("")
+                                              + struct.pack(">i", 0))
+                  + _msg(b"S", b""))
+        self.sock.sendall(frames)
+        return self._read_until_ready()
+
+    def query_prepared(self, sql: str, params: Sequence[Any] = ()
+                       ) -> Dict[str, np.ndarray]:
+        """Typed columns via the extended protocol (``query_columns``'s
+        prepared-statement twin)."""
+        return self._typed_columns(*self.execute_prepared(sql, params))
 
     def close(self):
         try:
@@ -915,6 +1246,51 @@ class PostgresWireClient:
 # ---------------------------------------------------------------------------
 # connector seams
 # ---------------------------------------------------------------------------
+
+
+_NUM_INT = re.compile(r"[+-]?\d+$")
+_NUM_FLOAT = re.compile(r"[+-]?(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?$")
+
+
+def _substitute_params(query: str, params: List[Optional[str]]) -> str:
+    """Extended-protocol Bind: inline text-format parameters for ``$n``
+    placeholders OUTSIDE string literals (the mini engine evaluates SQL
+    text; a full server binds into a parse tree).  Values quote as typed
+    literals — strictly numeric text stays bare (``1_0``/``infinity``
+    spellings that Python's int()/float() accept do NOT count), anything
+    else single-quotes."""
+    def lit(v: Optional[str]) -> str:
+        if v is None:
+            return "NULL"
+        if _NUM_INT.fullmatch(v) or _NUM_FLOAT.fullmatch(v):
+            return v
+        if v.lower() in ("true", "false"):
+            return v
+        return "'" + v.replace("'", "''") + "'"
+
+    out: List[str] = []
+    i, n = 0, len(query)
+    in_str = False
+    while i < n:
+        ch = query[i]
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+            i += 1
+        elif ch == "$" and not in_str and i + 1 < n \
+                and query[i + 1].isdigit():
+            j = i + 1
+            while j < n and query[j].isdigit():
+                j += 1
+            idx = int(query[i + 1:j]) - 1
+            if not 0 <= idx < len(params):
+                raise ValueError(f"parameter ${query[i + 1:j]} not bound")
+            out.append(lit(params[idx]))
+            i = j
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
 
 
 def _sql_literal(v) -> str:

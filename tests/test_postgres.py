@@ -594,3 +594,136 @@ class TestSinkSeam:
         for w, n in zip(cols["w"].tolist(), cols["n"].tolist()):
             final[w] = n
         assert final == {"a": 3.0, "b": 2.0, "c": 1.0}
+
+
+class TestExtendedProtocol:
+    """Parse/Bind/Describe/Execute/Sync — the JDBC PreparedStatement
+    flow over the wire."""
+
+    def test_prepared_select_with_params(self, server):
+        with connect(server) as c:
+            c.execute("CREATE TABLE ep (id int8, name text, score float8)")
+            c.execute("INSERT INTO ep (id, name, score) VALUES "
+                      "(1, 'ada', 9.5), (2, 'bob', 7.0), (3, 'cat', 8.25)")
+            cols = c.query_prepared(
+                "SELECT name, score FROM ep WHERE id = $1", [2])
+            assert cols["name"].tolist() == ["bob"]
+            assert cols["score"].tolist() == [7.0]
+            # strings quote; embedded quotes escape
+            c.execute_prepared("INSERT INTO ep (id, name) VALUES ($1, $2)",
+                               [4, "o'hara"])
+            cols = c.query_prepared(
+                "SELECT name FROM ep WHERE id = $1", [4])
+            assert cols["name"].tolist() == ["o'hara"]
+
+    def test_prepared_null_and_bool(self, server):
+        with connect(server) as c:
+            c.execute("CREATE TABLE epn (id int8, ok bool, note text)")
+            c.execute_prepared(
+                "INSERT INTO epn (id, ok, note) VALUES ($1, $2, $3)",
+                [1, True, None])
+            cols = c.query_prepared("SELECT ok, note FROM epn")
+            assert cols["ok"].tolist() == [True]
+            assert cols["note"].tolist() == [None]
+
+    def test_error_aborts_until_sync_connection_survives(self, server):
+        with connect(server) as c:
+            with pytest.raises(PostgresError, match="does not exist"):
+                c.execute_prepared("SELECT x FROM missing_table")
+            # the connection recovered at Sync: next cycle works
+            c.execute("CREATE TABLE eps (id int8)")
+            c.execute_prepared("INSERT INTO eps (id) VALUES ($1)", [7])
+            assert c.query_prepared(
+                "SELECT id FROM eps")["id"].tolist() == [7]
+
+    def test_unbound_parameter_rejected(self, server):
+        with connect(server) as c:
+            c.execute("CREATE TABLE epu (id int8)")
+            with pytest.raises(PostgresError, match="not bound"):
+                c.execute_prepared("SELECT id FROM epu WHERE id = $2", [1])
+
+
+class TestScramAuth:
+    def test_scram_handshake_and_queries(self):
+        srv = PostgresWireServer(users={"alice": "s3cret"},
+                                 auth="scram-sha-256")
+        try:
+            c = PostgresWireClient(srv.host, srv.port, user="alice",
+                                   password="s3cret")
+            c.execute("CREATE TABLE s (x int4)")
+            c.execute("INSERT INTO s (x) VALUES (5)")
+            assert c.query_columns("SELECT x FROM s")["x"].tolist() == [5]
+            c.close()
+        finally:
+            srv.close()
+
+    def test_scram_wrong_password_rejected(self):
+        srv = PostgresWireServer(users={"alice": "s3cret"},
+                                 auth="scram-sha-256")
+        try:
+            with pytest.raises(PostgresError, match="authentication"):
+                PostgresWireClient(srv.host, srv.port, user="alice",
+                                   password="wrong")
+            with pytest.raises(PostgresError, match="authentication"):
+                PostgresWireClient(srv.host, srv.port, user="mallory",
+                                   password="s3cret")
+        finally:
+            srv.close()
+
+
+def test_params_inside_string_literals_untouched(server):
+    with connect(server) as c:
+        c.execute("CREATE TABLE lit (id int8, note text)")
+        # a '$1' INSIDE a string literal is data, not a placeholder
+        c.execute_prepared(
+            "INSERT INTO lit (id, note) VALUES ($1, 'worth $1')", [9])
+        cols = c.query_prepared("SELECT note FROM lit WHERE id = $1", [9])
+        assert cols["note"].tolist() == ["worth $1"]
+        # numeric-LOOKING text params stay strings ('1_0', 'infinity')
+        c.execute_prepared(
+            "INSERT INTO lit (id, note) VALUES ($1, $2)", [10, "1_0"])
+        c.execute_prepared(
+            "INSERT INTO lit (id, note) VALUES ($1, $2)",
+            [11, "infinity"])
+        cols = c.query_prepared(
+            "SELECT note FROM lit WHERE id >= $1 ORDER BY id", [10])
+        assert cols["note"].tolist() == ["1_0", "infinity"]
+
+
+def test_binary_format_rejected_not_misread(server):
+    import socket as _socket
+    from flink_tpu.connectors.postgres import _cstr, _msg
+    with connect(server) as c:
+        c.execute("CREATE TABLE bf (id int8)")
+        # hand-build a Bind with param format code 1 (binary)
+        parse = _cstr("") + _cstr("INSERT INTO bf (id) VALUES ($1)") \
+            + struct.pack(">h", 0)
+        bind = (_cstr("") + _cstr("") + struct.pack(">hh", 1, 1)
+                + struct.pack(">h", 1)
+                + struct.pack(">i", 8) + struct.pack(">q", 7)
+                + struct.pack(">h", 0))
+        c.sock.sendall(_msg(b"P", parse) + _msg(b"B", bind)
+                       + _msg(b"S", b""))
+        with pytest.raises(PostgresError, match="binary-format"):
+            c._read_until_ready()
+        # connection recovered at Sync
+        assert c.query_columns("SELECT COUNT(*) FROM bf")["count"][0] == 0
+
+
+def test_malformed_scram_gets_error_not_dropped_socket():
+    import socket as _socket
+    srv = PostgresWireServer(users={"a": "pw"}, auth="scram-sha-256")
+    try:
+        sock = _socket.create_connection((srv.host, srv.port), timeout=5)
+        payload = struct.pack(">i", PROTOCOL_V3) + b"user\0a\0\0"
+        sock.sendall(struct.pack(">i", len(payload) + 4) + payload)
+        t, body = read_message(sock)
+        assert t == b"R" and struct.unpack(">i", body[:4])[0] == 10
+        # garbage SASLInitialResponse (no NUL, no length)
+        bad = b"\xff\xfe"
+        sock.sendall(b"p" + struct.pack(">i", len(bad) + 4) + bad)
+        t, body = read_message(sock)
+        assert t == b"E"                    # ErrorResponse, not a RST
+        sock.close()
+    finally:
+        srv.close()
