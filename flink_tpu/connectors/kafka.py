@@ -252,12 +252,18 @@ class KafkaWireBroker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  directory: Optional[str] = None, node_id: int = 0,
-                 users: Optional[Dict[str, str]] = None):
-        #: SASL/PLAIN credentials (user -> password).  None = open broker;
-        #: set = every connection must complete SaslHandshake("PLAIN") +
-        #: SaslAuthenticate before any data/metadata API (unauthenticated
-        #: requests close the connection, as real brokers do)
+                 users: Optional[Dict[str, str]] = None,
+                 ssl_context=None):
+        #: SASL credentials (user -> password).  None = open broker;
+        #: set = every connection must complete SaslHandshake (PLAIN or
+        #: SCRAM-SHA-256) + SaslAuthenticate before any data/metadata API
+        #: (unauthenticated requests close the connection, as real
+        #: brokers do)
         self.users = users
+        #: a TLS LISTENER (the reference's ``security.protocol=SSL`` /
+        #: SASL_SSL): every accepted connection handshakes TLS before the
+        #: first Kafka frame; combine with ``users`` for SASL_SSL
+        self._ssl = ssl_context
         self.directory = directory
         self.node_id = node_id
         if directory:
@@ -410,8 +416,25 @@ class KafkaWireBroker:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
+            threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """Per-connection entry: the TLS handshake (when configured) runs
+        HERE, on the connection's own thread with a timeout — a silent
+        peer must never wedge the single accept loop."""
+        if self._ssl is not None:
+            try:
+                conn.settimeout(30)
+                conn = self._ssl.wrap_socket(conn, server_side=True)
+            except (OSError, ValueError):
+                # plaintext/bad-cert peers never reach the frame loop
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+        self._serve(conn)
 
     def _serve(self, conn: socket.socket) -> None:
         conn.settimeout(60)
@@ -484,21 +507,18 @@ class KafkaWireBroker:
                  (_API_LIST_TRANSACTIONS, 0, 0)],
                 lambda w, t: w.int16(t[0]).int16(t[1]).int16(t[2]))
         elif api_key == _API_SASL_HANDSHAKE:
-            mech = r.string() or ""
-            if mech.upper() != "PLAIN":
+            mech = (r.string() or "").upper()
+            if mech not in ("PLAIN", "SCRAM-SHA-256"):
                 w.int16(_ERR_UNSUPPORTED_SASL_MECHANISM)
             else:
-                state["mechanism"] = "PLAIN"
+                state["mechanism"] = mech
                 w.int16(_ERR_NONE)
-            w.array(["PLAIN"], lambda w, m: w.string(m))
+            w.array(["PLAIN", "SCRAM-SHA-256"], lambda w, m: w.string(m))
         elif api_key == _API_SASL_AUTHENTICATE:
-            # PLAIN token: [authzid] NUL user NUL password (RFC 4616)
             token = r.bytes_() or b""
-            if state["mechanism"] != "PLAIN":
-                w.int16(_ERR_ILLEGAL_SASL_STATE) \
-                    .string("SaslHandshake must precede authentication") \
-                    .bytes_(b"")
-            else:
+            mech = state.get("mechanism")
+            if mech == "PLAIN":
+                # PLAIN token: [authzid] NUL user NUL password (RFC 4616)
                 parts = token.split(b"\0")
                 user = parts[1].decode() if len(parts) == 3 else ""
                 pw = parts[2].decode() if len(parts) == 3 else ""
@@ -510,6 +530,12 @@ class KafkaWireBroker:
                     w.int16(_ERR_SASL_AUTHENTICATION_FAILED) \
                         .string(f"authentication failed for user "
                                 f"{user!r}").bytes_(b"")
+            elif mech == "SCRAM-SHA-256":
+                self._sasl_scram(state, token, w)
+            else:
+                w.int16(_ERR_ILLEGAL_SASL_STATE) \
+                    .string("SaslHandshake must precede authentication") \
+                    .bytes_(b"")
         elif api_key == _API_METADATA:
             self._metadata(r, w)
         elif api_key == _API_PRODUCE and api_version == 0:
@@ -749,6 +775,43 @@ class KafkaWireBroker:
         w.array(results, lambda w, t: w.string(t[0]).array(
             t[1], lambda w, p: w.int32(p[0]).int64(p[1]).string("")
             .int16(_ERR_NONE)))
+
+    def _sasl_scram(self, state: dict, token: bytes, w: _Writer) -> None:
+        """SCRAM-SHA-256 over SaslAuthenticate (two rounds: client-first →
+        server-first, client-final → server-final).  The RFC 5802 math is
+        the shared ``flink_tpu.security.scram`` implementation — same
+        code the Postgres handshake uses."""
+        from flink_tpu.security.scram import ScramServer
+
+        try:
+            text = token.decode()
+            srv = state.get("scram")
+            if srv is None:                   # round 1: client-first
+                srv = ScramServer()
+                user = ScramServer.username_of(text)
+                want = (self.users or {}).get(user)
+                if want is None:
+                    w.int16(_ERR_SASL_AUTHENTICATION_FAILED) \
+                        .string(f"authentication failed for user "
+                                f"{user!r}").bytes_(b"")
+                    return
+                state["scram"] = srv
+                first = srv.first_response(text, want)
+                w.int16(_ERR_NONE).string(None).bytes_(first.encode())
+                return
+            ok, final = srv.verify_final(text)  # round 2: client-final
+            state.pop("scram", None)
+            if ok:
+                state["authenticated"] = True
+                w.int16(_ERR_NONE).string(None).bytes_(final.encode())
+            else:
+                w.int16(_ERR_SASL_AUTHENTICATION_FAILED) \
+                    .string("SCRAM proof verification failed").bytes_(b"")
+        except (ValueError, KeyError, IndexError, UnicodeDecodeError) as e:
+            state.pop("scram", None)
+            w.int16(_ERR_SASL_AUTHENTICATION_FAILED) \
+                .string(f"malformed SCRAM message: "
+                        f"{e or type(e).__name__}").bytes_(b"")
 
     def _metadata(self, r: _Reader, w: _Writer) -> None:
         want = r.array(lambda r: r.string())
@@ -1182,14 +1245,23 @@ class KafkaWireClient:
 
     def __init__(self, host: str, port: int, client_id: str = "flink-tpu",
                  timeout_s: float = 30.0, username: Optional[str] = None,
-                 password: str = ""):
+                 password: str = "",
+                 sasl_mechanism: str = "PLAIN", ssl_context=None):
         self.host, self.port = host, port
         self.client_id = client_id
         self.timeout_s = timeout_s
-        #: SASL/PLAIN credentials; when set, every (re)connection runs
-        #: SaslHandshake + SaslAuthenticate before the first data API
+        #: SASL credentials; when set, every (re)connection runs
+        #: SaslHandshake + SaslAuthenticate before the first data API.
+        #: Mechanisms: PLAIN or SCRAM-SHA-256 (RFC 5802, mutual auth)
         self.username = username
         self.password = password
+        if sasl_mechanism.upper() not in ("PLAIN", "SCRAM-SHA-256"):
+            raise ValueError(f"unsupported SASL mechanism "
+                             f"{sasl_mechanism!r}")
+        self.sasl_mechanism = sasl_mechanism.upper()
+        #: TLS: wrap every (re)connection before the first frame
+        #: (``security.protocol=SSL``/SASL_SSL client side)
+        self.ssl_context = ssl_context
         self._sock: Optional[socket.socket] = None
         self._corr = 0
         self._lock = threading.Lock()
@@ -1219,31 +1291,47 @@ class KafkaWireClient:
         return r
 
     def _sasl_authenticate(self, s: socket.socket) -> None:
+        mech = self.sasl_mechanism
         r = self._raw_call(s, _API_SASL_HANDSHAKE, 1,
-                           _Writer().string("PLAIN").done())
+                           _Writer().string(mech).done())
         err = r.int16()
         if err != _ERR_NONE:
             raise KafkaError(f"SASL handshake rejected (error {err})")
-        token = b"\0" + self.username.encode() + b"\0" \
-            + self.password.encode()
-        r = self._raw_call(s, _API_SASL_AUTHENTICATE, 0,
-                           _Writer().bytes_(token).done())
-        err = r.int16()
-        msg = r.string()
-        if err != _ERR_NONE:
-            raise KafkaError(msg or f"SASL authentication failed "
-                                    f"(error {err})")
+
+        def auth_round(token: bytes) -> bytes:
+            rr = self._raw_call(s, _API_SASL_AUTHENTICATE, 0,
+                                _Writer().bytes_(token).done())
+            e = rr.int16()
+            msg = rr.string()
+            if e != _ERR_NONE:
+                raise KafkaError(msg or f"SASL authentication failed "
+                                        f"(error {e})")
+            return rr.bytes_() or b""
+
+        if mech == "PLAIN":
+            auth_round(b"\0" + self.username.encode() + b"\0"
+                       + self.password.encode())
+            return
+        # SCRAM-SHA-256: two token rounds + server-signature verification
+        from flink_tpu.security.scram import ScramClient
+        sc = ScramClient(self.username, self.password)
+        server_first = auth_round(sc.first().encode()).decode()
+        server_final = auth_round(sc.final(server_first).encode()).decode()
+        sc.verify(server_final)
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout_s)
-            if self.username is not None:
-                try:
+            try:
+                if self.ssl_context is not None:
+                    s = self.ssl_context.wrap_socket(
+                        s, server_hostname=self.host)
+                if self.username is not None:
                     self._sasl_authenticate(s)
-                except BaseException:
-                    s.close()
-                    raise
+            except BaseException:
+                s.close()
+                raise
             self._sock = s
         return self._sock
 

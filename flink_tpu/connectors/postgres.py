@@ -772,9 +772,7 @@ class PostgresWireServer:
             return False
 
     def _scram_handshake_inner(self, sock, user: str) -> bool:
-        import base64
-        import hashlib
-        import hmac as _hmac
+        from flink_tpu.security.scram import ScramServer
 
         sock.sendall(_msg(b"R", struct.pack(">i", 10)
                           + _cstr("SCRAM-SHA-256") + b"\0"))
@@ -790,51 +788,26 @@ class PostgresWireServer:
             sock.sendall(_error(f"unsupported SASL mechanism {mech}",
                                 "28000"))
             return False
-        # client-first: "n,,n=<user>,r=<cnonce>" (no channel binding)
-        bare = client_first.split(",", 2)[2]
-        cnonce = dict(p.split("=", 1) for p in bare.split(","))["r"]
-        want = self.users.get(user)
+        want = self.users.get(user)     # PG: the STARTUP user, not n=
         if want is None:
             sock.sendall(_error(
                 f'password authentication failed for user "{user}"',
                 "28P01"))
             return False
-        salt = os.urandom(16)
-        iters = 4096
-        snonce = cnonce + base64.b64encode(os.urandom(18)).decode()
-        server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
-                        f"i={iters}")
+        scram = ScramServer()           # shared RFC 5802 math (security/)
+        server_first = scram.first_response(client_first, want)
         sock.sendall(_msg(b"R", struct.pack(">i", 11)
                           + server_first.encode()))
         t, body = read_message(sock)
         if t != b"p":
             sock.sendall(_error("expected SASLResponse", "28000"))
             return False
-        client_final = body.decode()
-        cf = dict(p.split("=", 1) for p in client_final.split(","))
-        proof = base64.b64decode(cf["p"])
-        without_proof = client_final.rsplit(",p=", 1)[0]
-        if cf.get("r") != snonce:
-            sock.sendall(_error("SCRAM nonce mismatch", "28000"))
-            return False
-        salted = hashlib.pbkdf2_hmac("sha256", want.encode(), salt, iters)
-        client_key = _hmac.new(salted, b"Client Key",
-                               hashlib.sha256).digest()
-        stored_key = hashlib.sha256(client_key).digest()
-        auth_msg = f"{bare},{server_first},{without_proof}".encode()
-        signature = _hmac.new(stored_key, auth_msg,
-                              hashlib.sha256).digest()
-        recovered = bytes(a ^ b for a, b in zip(proof, signature))
-        if hashlib.sha256(recovered).digest() != stored_key:
+        ok, final = scram.verify_final(body.decode())
+        if not ok:
             sock.sendall(_error(
                 f'password authentication failed for user "{user}"',
                 "28P01"))
             return False
-        server_key = _hmac.new(salted, b"Server Key",
-                               hashlib.sha256).digest()
-        server_sig = _hmac.new(server_key, auth_msg,
-                               hashlib.sha256).digest()
-        final = f"v={base64.b64encode(server_sig).decode()}"
         sock.sendall(_msg(b"R", struct.pack(">i", 12) + final.encode()))
         return True
 
@@ -1064,55 +1037,35 @@ class PostgresWireClient:
 
     def _scram_step(self, code: int, payload: bytes, user: str,
                     password: str, st: Dict[str, Any]) -> None:
-        """Client half of SCRAM-SHA-256 (RFC 5802): initial response,
-        proof computation, and SERVER-signature verification (mutual
-        auth — a server that doesn't know the password fails here)."""
-        import base64
-        import hashlib
-        import hmac as _hmac
+        """Client half of SCRAM-SHA-256 over the PG SASL framing (auth
+        codes 10/11/12), delegating the RFC 5802 math to the shared
+        ``flink_tpu.security.scram`` implementation.  Mutual: the final
+        step verifies the SERVER's signature."""
+        from flink_tpu.security.scram import ScramClient
 
         if code == 10:                       # AuthenticationSASL
             mechs = [m.decode() for m in payload.split(b"\0") if m]
             if "SCRAM-SHA-256" not in mechs:
                 raise PostgresError({"M": f"no usable SASL mechanism "
                                           f"in {mechs}"})
-            st["cnonce"] = base64.b64encode(os.urandom(18)).decode()
-            st["bare"] = f"n=,r={st['cnonce']}"
-            first = "n,," + st["bare"]
+            # PG convention: the SCRAM username is empty (the startup
+            # packet already named the user)
+            st["scram"] = sc = ScramClient("", password)
+            first = sc.first()
             self.sock.sendall(_msg(
                 b"p", _cstr("SCRAM-SHA-256")
                 + struct.pack(">i", len(first)) + first.encode()))
         elif code == 11:                     # SASLContinue (server-first)
-            server_first = payload.decode()
-            parts = dict(p.split("=", 1) for p in server_first.split(","))
-            nonce, salt = parts["r"], base64.b64decode(parts["s"])
-            iters = int(parts["i"])
-            if not nonce.startswith(st["cnonce"]):
-                raise PostgresError({"M": "SCRAM nonce mismatch"})
-            salted = hashlib.pbkdf2_hmac("sha256", password.encode(),
-                                         salt, iters)
-            client_key = _hmac.new(salted, b"Client Key",
-                                   hashlib.sha256).digest()
-            stored = hashlib.sha256(client_key).digest()
-            without_proof = f"c=biws,r={nonce}"
-            auth_msg = (f"{st['bare']},{server_first},"
-                        f"{without_proof}").encode()
-            sig = _hmac.new(stored, auth_msg, hashlib.sha256).digest()
-            proof = bytes(a ^ b for a, b in zip(client_key, sig))
-            server_key = _hmac.new(salted, b"Server Key",
-                                   hashlib.sha256).digest()
-            st["server_sig"] = _hmac.new(server_key, auth_msg,
-                                         hashlib.sha256).digest()
-            final = (f"{without_proof},"
-                     f"p={base64.b64encode(proof).decode()}")
+            try:
+                final = st["scram"].final(payload.decode())
+            except ValueError as e:
+                raise PostgresError({"M": str(e)}) from e
             self.sock.sendall(_msg(b"p", final.encode()))
         else:                                # SASLFinal: verify the server
-            parts = dict(p.split("=", 1)
-                         for p in payload.decode().split(","))
-            got = base64.b64decode(parts.get("v", ""))
-            if got != st.get("server_sig"):
-                raise PostgresError({"M": "server signature verification "
-                                          "failed (not the real server?)"})
+            try:
+                st["scram"].verify(payload.decode())
+            except ValueError as e:
+                raise PostgresError({"M": str(e)}) from e
 
     @staticmethod
     def _error_fields(body: bytes) -> Dict[str, str]:

@@ -1,0 +1,121 @@
+"""SCRAM-SHA-256 (RFC 5802/7677) message-level state machines.
+
+One implementation of the salted-challenge math shared by every
+SCRAM-speaking protocol in the repo: the PostgreSQL wire handshake
+(SASL authentication codes 10/11/12) and the Kafka SASL/SCRAM mechanism
+(SaslAuthenticate token exchange).  Transport-agnostic: callers move the
+RFC's client-first / server-first / client-final / server-final strings
+over their own framing.
+
+Mutual authentication: the client proves the password via ClientProof
+(the server checks it against the STORED key without learning the
+password from the exchange), and the server proves it knows the password
+via ServerSignature, which the client verifies."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+from typing import Dict, Optional, Tuple
+
+
+def _h(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, hashlib.sha256).digest()
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _attrs(msg: str) -> Dict[str, str]:
+    return dict(p.split("=", 1) for p in msg.split(","))
+
+
+class ScramClient:
+    """Client half: ``first()`` → send; feed the server-first message to
+    ``final()`` → send; feed the server-final message to ``verify()``."""
+
+    def __init__(self, username: str, password: str):
+        self.username = username
+        self.password = password
+        self._cnonce = _b64(os.urandom(18))
+        self._bare = f"n={username},r={self._cnonce}"
+        self._server_sig: Optional[bytes] = None
+
+    def first(self) -> str:
+        return "n,," + self._bare
+
+    def final(self, server_first: str) -> str:
+        a = _attrs(server_first)
+        nonce, salt, iters = a["r"], base64.b64decode(a["s"]), int(a["i"])
+        if not nonce.startswith(self._cnonce):
+            raise ValueError("SCRAM nonce mismatch (not our challenge)")
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     salt, iters)
+        client_key = _hmac(salted, b"Client Key")
+        stored_key = _h(client_key)
+        without_proof = f"c=biws,r={nonce}"
+        auth_msg = f"{self._bare},{server_first},{without_proof}".encode()
+        proof = bytes(a ^ b for a, b in
+                      zip(client_key, _hmac(stored_key, auth_msg)))
+        self._server_sig = _hmac(_hmac(salted, b"Server Key"), auth_msg)
+        return f"{without_proof},p={_b64(proof)}"
+
+    def verify(self, server_final: str) -> None:
+        got = base64.b64decode(_attrs(server_final).get("v", ""))
+        if self._server_sig is None \
+                or not hmac.compare_digest(got, self._server_sig):
+            raise ValueError("SCRAM server signature verification failed "
+                             "(peer does not know the password)")
+
+
+class ScramServer:
+    """Server half: feed the client-first message + the user's password to
+    ``first_response()`` → send; feed the client-final message to
+    ``verify_final()`` → (ok, server-final to send)."""
+
+    def __init__(self, iterations: int = 4096):
+        self.iterations = iterations
+        self._server_first: Optional[str] = None
+        self._bare: Optional[str] = None
+        self._snonce: Optional[str] = None
+        self._salted: Optional[bytes] = None
+
+    @staticmethod
+    def username_of(client_first: str) -> str:
+        bare = client_first.split(",", 2)[2]
+        return _attrs(bare)["n"]
+
+    def first_response(self, client_first: str, password: str) -> str:
+        self._bare = client_first.split(",", 2)[2]
+        cnonce = _attrs(self._bare)["r"]
+        salt = os.urandom(16)
+        self._snonce = cnonce + _b64(os.urandom(18))
+        self._salted = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt, self.iterations)
+        self._server_first = (f"r={self._snonce},s={_b64(salt)},"
+                              f"i={self.iterations}")
+        return self._server_first
+
+    def verify_final(self, client_final: str) -> Tuple[bool, str]:
+        a = _attrs(client_final)
+        proof = base64.b64decode(a["p"])
+        without_proof = client_final.rsplit(",p=", 1)[0]
+        if a.get("r") != self._snonce:
+            return False, ""
+        client_key = _hmac(self._salted, b"Client Key")
+        stored_key = _h(client_key)
+        auth_msg = (f"{self._bare},{self._server_first},"
+                    f"{without_proof}").encode()
+        sig = _hmac(stored_key, auth_msg)
+        recovered = bytes(x ^ y for x, y in zip(proof, sig))
+        if not hmac.compare_digest(_h(recovered), stored_key):
+            return False, ""
+        server_sig = _hmac(_hmac(self._salted, b"Server Key"), auth_msg)
+        return True, f"v={_b64(server_sig)}"

@@ -275,7 +275,7 @@ def test_sasl_raw_frames():
         (size,) = struct.unpack(">i", _rx(s, 4))
         resp = _rx(s, size)
         corr, err, nmech = struct.unpack(">ihi", resp[:10])
-        assert (corr, err, nmech) == (7, 0, 1)
+        assert (corr, err, nmech) == (7, 0, 2)  # PLAIN + SCRAM-SHA-256
         mlen = struct.unpack(">h", resp[10:12])[0]
         assert resp[12:12 + mlen] == b"PLAIN"
         # SaslAuthenticate v0: api 36, bytes = \0 user \0 password
@@ -336,5 +336,67 @@ def test_sasl_with_v2_consumer_group():
         got, hw = fetch_v2(c, "t", 0, 0)
         assert hw == 2 and [r[3] for r in got] == [b"v1", b"v2"]
         c.close()
+    finally:
+        b.stop()
+
+
+def test_sasl_scram_sha256(tmp_path):
+    """SCRAM-SHA-256 over SaslAuthenticate: two token rounds, client
+    proof verified server-side, SERVER signature verified client-side
+    (mutual auth) — shared RFC 5802 math with the Postgres handshake."""
+    from flink_tpu.connectors.kafka import KafkaError
+
+    b = KafkaWireBroker(directory=str(tmp_path / "k"),
+                        users={"alice": "s3cret"}).start()
+    try:
+        b.create_topic("t", partitions=1)
+        c = KafkaWireClient(b.host, b.port, username="alice",
+                            password="s3cret",
+                            sasl_mechanism="SCRAM-SHA-256")
+        c.produce("t", 0, [(None, b"hello")])
+        msgs, hw = c.fetch("t", 0, 0)
+        assert hw == 1 and msgs[0][2] == b"hello"
+        c.close()
+        # wrong password fails the proof
+        with pytest.raises(KafkaError, match="SCRAM|authentication"):
+            KafkaWireClient(b.host, b.port, username="alice",
+                            password="wrong",
+                            sasl_mechanism="SCRAM-SHA-256").metadata()
+        # unknown user fails round 1
+        with pytest.raises(KafkaError, match="authentication"):
+            KafkaWireClient(b.host, b.port, username="mallory",
+                            password="s3cret",
+                            sasl_mechanism="SCRAM-SHA-256").metadata()
+    finally:
+        b.stop()
+
+
+def test_tls_listener_sasl_ssl(tmp_path):
+    """security.protocol=SASL_SSL analog: a TLS listener handshakes before
+    the first frame, then SCRAM authenticates inside the tunnel; a
+    PLAINTEXT client never reaches the frame loop."""
+    from flink_tpu.connectors.kafka import KafkaError
+    from flink_tpu.security import SecurityConfig, generate_self_signed
+
+    cert, key, ca = generate_self_signed(str(tmp_path / "pki"))
+    sec = SecurityConfig(internal_ssl=True, cert_path=cert, key_path=key,
+                         ca_path=ca)
+    b = KafkaWireBroker(directory=str(tmp_path / "k"),
+                        users={"alice": "pw"},
+                        ssl_context=sec.server_context(mutual=False)).start()
+    try:
+        b.create_topic("t", partitions=1)
+        c = KafkaWireClient(b.host, b.port, username="alice",
+                            password="pw",
+                            sasl_mechanism="SCRAM-SHA-256",
+                            ssl_context=sec.client_context(mutual=False))
+        c.produce("t", 0, [(None, b"over-tls")])
+        msgs, hw = c.fetch("t", 0, 0)
+        assert hw == 1 and msgs[0][2] == b"over-tls"
+        c.close()
+        # a plaintext client cannot speak to the TLS listener
+        plain = KafkaWireClient(b.host, b.port, timeout_s=3)
+        with pytest.raises((KafkaError, OSError, ValueError)):
+            plain.metadata()
     finally:
         b.stop()
