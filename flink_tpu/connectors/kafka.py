@@ -24,6 +24,7 @@ framework's source/sink seams.
 
 from __future__ import annotations
 
+import json as _json
 import os
 import socket
 import struct
@@ -1805,11 +1806,16 @@ class KafkaWireSink:
     clone_per_subtask = True
 
     def __init__(self, host: str, port: int, topic: str,
-                 key_column: Optional[str] = None, num_partitions: int = 1):
+                 key_column: Optional[str] = None, num_partitions: int = 1,
+                 value_encoder=None):
         self.host, self.port = host, port
         self.topic = topic
         self.key_column = key_column
         self.num_partitions = num_partitions
+        #: optional ``row dict -> bytes`` value encoder replacing the
+        #: default JSON — the SerializationSchema seam (e.g. the
+        #: Confluent Avro wire format, ``formats.registry``)
+        self.value_encoder = value_encoder
         self._client: Optional[KafkaWireClient] = None
         self._rr = 0
 
@@ -1821,25 +1827,26 @@ class KafkaWireSink:
     def open(self, ctx) -> None:
         self._cli()
 
-    def write_batch(self, batch) -> None:
-        import json
+    def _enc(self, row: dict) -> bytes:
+        if self.value_encoder is not None:
+            return self.value_encoder(row)
+        return _json.dumps(row, default=_json_default).encode()
 
+    def write_batch(self, batch) -> None:
         if not len(batch):
             return
         rows = batch.to_rows()
         if self.key_column is None:
             self._rr += 1
             part = self._rr % self.num_partitions
-            self._cli().produce(self.topic, part, [
-                (None, json.dumps(r, default=_json_default).encode())
-                for r in rows])
+            self._cli().produce(self.topic, part,
+                                [(None, self._enc(r)) for r in rows])
             return
         if self.num_partitions == 1:
             # single partition, but the KEY still matters downstream
             # (compaction, keyed re-ingest)
             self._cli().produce(self.topic, 0, [
-                (str(r[self.key_column]).encode(),
-                 json.dumps(r, default=_json_default).encode())
+                (str(r[self.key_column]).encode(), self._enc(r))
                 for r in rows])
             return
         from flink_tpu.core.keygroups import hash_keys
@@ -1848,8 +1855,7 @@ class KafkaWireSink:
         for p in np.unique(parts).tolist():
             sel = [r for r, m in zip(rows, parts == p) if m]
             self._cli().produce(self.topic, int(p), [
-                (str(r[self.key_column]).encode(),
-                 json.dumps(r, default=_json_default).encode())
+                (str(r[self.key_column]).encode(), self._enc(r))
                 for r in sel])
 
     def flush(self) -> None:

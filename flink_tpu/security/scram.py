@@ -37,6 +37,15 @@ def _attrs(msg: str) -> Dict[str, str]:
     return dict(p.split("=", 1) for p in msg.split(","))
 
 
+def _saslname_escape(name: str) -> str:
+    """RFC 5802 saslname: '=' and ',' must transit as =3D / =2C."""
+    return name.replace("=", "=3D").replace(",", "=2C")
+
+
+def _saslname_unescape(name: str) -> str:
+    return name.replace("=2C", ",").replace("=3D", "=")
+
+
 class ScramClient:
     """Client half: ``first()`` → send; feed the server-first message to
     ``final()`` → send; feed the server-final message to ``verify()``."""
@@ -45,7 +54,7 @@ class ScramClient:
         self.username = username
         self.password = password
         self._cnonce = _b64(os.urandom(18))
-        self._bare = f"n={username},r={self._cnonce}"
+        self._bare = f"n={_saslname_escape(username)},r={self._cnonce}"
         self._server_sig: Optional[bytes] = None
 
     def first(self) -> str:
@@ -90,7 +99,12 @@ class ScramServer:
     @staticmethod
     def username_of(client_first: str) -> str:
         bare = client_first.split(",", 2)[2]
-        return _attrs(bare)["n"]
+        # n=<saslname> ends at the ,r= attribute (escaped commas inside
+        # the name transit as =2C, so this split is unambiguous)
+        name = bare.split(",r=", 1)[0]
+        if not name.startswith("n="):
+            raise ValueError("malformed client-first message")
+        return _saslname_unescape(name[2:])
 
     def first_response(self, client_first: str, password: str) -> str:
         self._bare = client_first.split(",", 2)[2]
