@@ -49,7 +49,7 @@ class _PositionedFileReader:
 
 
 class FileSource(Source):
-    """Reads a file, directory, or glob in ``csv``/``jsonl``/``ftb`` format.
+    """Reads a file, directory, or glob in ``csv``/``jsonl``/``ftb``/``seq`` format.
     One split per file (``FileSourceSplit`` analog)."""
 
     def __init__(self, path: str, format: str = "csv",
@@ -82,7 +82,7 @@ class FileSource(Source):
     def _read_file(self, path: str, start_row: int) -> Iterator[StreamElement]:
         read = reader_for(self.format)
         kw = dict(self.format_kwargs)
-        if self.format in ("csv", "jsonl"):
+        if self.format in ("csv", "jsonl", "seq"):
             kw.setdefault("batch_size", self.batch_size)
             kw["timestamp_column"] = self.timestamp_column
             kw["skip_rows"] = start_row

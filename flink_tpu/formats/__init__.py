@@ -261,6 +261,16 @@ def _write_orc(batches, path: str, **kw) -> int:
     return write_orc(batches, path, **kw)
 
 
+def _read_seq(path: str, batch_size: int = 8192, **kw):
+    from flink_tpu.formats.sequencefile import read_sequencefile
+    return read_sequencefile(path, batch_size=batch_size, **kw)
+
+
+def _write_seq(batches, path: str, **kw) -> int:
+    from flink_tpu.formats.sequencefile import write_sequencefile
+    return write_sequencefile(batches, path, **kw)
+
+
 FORMATS = {
     "csv": (read_csv, write_csv),
     "jsonl": (read_jsonl, write_jsonl),
@@ -268,6 +278,7 @@ FORMATS = {
     "avro": (_read_avro, _write_avro),
     "parquet": (_read_parquet, _write_parquet),
     "orc": (_read_orc, _write_orc),
+    "seq": (_read_seq, _write_seq),
 }
 
 
