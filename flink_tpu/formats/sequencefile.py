@@ -149,13 +149,20 @@ def read_sequencefile(path: str, batch_size: int = 8192,
             (rec_len,) = struct.unpack(">i", lenb)
             if rec_len == -1:                  # sync marker
                 got = f.read(16)
+                if len(got) < 16:
+                    break                      # torn tail inside the sync
                 if got != sync:
                     raise ValueError("sync marker mismatch (corrupt file)")
                 continue
+            if rec_len < 0:
+                raise ValueError(f"corrupt record length {rec_len}")
             klenb = f.read(4)
             if len(klenb) < 4:
                 break                          # torn tail: keep the prefix
             (key_len,) = struct.unpack(">i", klenb)
+            if not 0 <= key_len <= rec_len:
+                raise ValueError(f"corrupt key length {key_len} "
+                                 f"(record {rec_len})")
             kv = f.read(rec_len)
             if len(kv) < rec_len:
                 break                          # torn tail record
@@ -172,8 +179,9 @@ def read_sequencefile(path: str, batch_size: int = 8192,
                     raise ValueError
                 if key:
                     # the record KEY is data too — a foreign file may keep
-                    # meaning only there; never silently drop it
-                    row.setdefault("key", key)
+                    # meaning only there; never silently drop it (when the
+                    # value already owns "key", park it next door)
+                    row["key" if "key" not in row else "_seq_key"] = key
             except ValueError:
                 row = {"key": key, "value": val}
             rows.append(row)
