@@ -190,6 +190,8 @@ _ERR_ILLEGAL_SASL_STATE = 34
 _ERR_SASL_AUTHENTICATION_FAILED = 58
 _ERR_INVALID_PRODUCER_EPOCH = 47
 _ERR_INVALID_TXN_STATE = 48
+_ERR_FETCH_SESSION_ID_NOT_FOUND = 70
+_ERR_INVALID_FETCH_SESSION_EPOCH = 71
 _ERR_UNKNOWN = -1
 
 _API_SASL_HANDSHAKE = 17
@@ -287,6 +289,14 @@ class KafkaWireBroker:
         #: checkpoints, so old entries can age out)
         self._committed_tids: Dict[str, None] = {}
         self._committed_retention = 4096
+        #: KIP-227 incremental fetch sessions: session id -> {"epoch",
+        #: "parts": {(topic, partition): fetch offset}}.  A FULL fetch
+        #: (epoch 0) establishes the session; incremental fetches send
+        #: only CHANGED partitions and the response carries only
+        #: partitions with news — the steady-state idle poll shrinks to a
+        #: near-empty request/response pair
+        self._fetch_sessions: Dict[int, dict] = {}
+        self._next_session = 1
         #: consumer groups under a dedicated lock: JoinGroup BLOCKS (the
         #: rebalance barrier) and must not hold the log lock while waiting
         self._groups: Dict[str, _Group] = {}
@@ -492,7 +502,7 @@ class KafkaWireBroker:
             return None  # real brokers drop unauthenticated connections
         if api_key == _API_VERSIONS:
             w.int16(_ERR_NONE).array(
-                [(_API_PRODUCE, 0, 3), (_API_FETCH, 0, 4),
+                [(_API_PRODUCE, 0, 3), (_API_FETCH, 0, 7),
                  (_API_LIST_OFFSETS, 0, 0), (_API_METADATA, 0, 0),
                  (_API_OFFSET_COMMIT, 2, 2), (_API_OFFSET_FETCH, 1, 1),
                  (_API_FIND_COORDINATOR, 0, 0), (_API_JOIN_GROUP, 0, 0),
@@ -547,6 +557,8 @@ class KafkaWireBroker:
             self._fetch(r, w)
         elif api_key == _API_FETCH and api_version == 4:
             self._fetch_v4(r, w)
+        elif api_key == _API_FETCH and api_version == 7:
+            self._fetch_v7(r, w)
         elif api_key == _API_LIST_OFFSETS and api_version == 0:
             self._list_offsets(r, w)
         elif api_key == _API_FIND_COORDINATOR:
@@ -1181,34 +1193,119 @@ class KafkaWireBroker:
                 part = r.int32()
                 offset = r.int64()
                 max_bytes = r.int32()
-                with self._lock:
-                    parts = self._logs.get(topic)
-                    if parts is None or not 0 <= part < len(parts):
-                        per_part.append((part, _ERR_UNKNOWN_TOPIC, -1, b""))
-                        continue
-                    log = parts[part]
-                    hw = len(log)
-                    if offset > hw or offset < 0:
-                        per_part.append((part, _ERR_OFFSET_OUT_OF_RANGE,
-                                         hw, b""))
-                        continue
-                    # one batch per fetch window, capped by max_bytes via a
-                    # record-count estimate then re-encoded exactly
-                    take = []
-                    size = 0
-                    for o, k, v, ts in log[offset:]:
-                        rec = (len(k or b"") + len(v or b"") + 32)
-                        if take and size + rec > max_bytes:
-                            break
-                        take.append((max(ts, 0), k, v, []))
-                        size += rec
-                    data = (_encode_batch_v2(offset, take) if take else b"")
-                per_part.append((part, _ERR_NONE, hw, data))
+                err, hw, data = self._read_partition_window(
+                    topic, part, offset, max_bytes)
+                per_part.append((part, err, hw, data))
             results.append((topic, per_part))
         w.int32(0)                              # throttle_time_ms
         w.array(results, lambda w, t: w.string(t[0]).array(
             t[1], lambda w, p: w.int32(p[0]).int16(p[1]).int64(p[2])
             .int64(p[2])                        # last_stable_offset = hw
+            .array([], lambda w, x: None)       # aborted transactions
+            .bytes_(p[3])))
+
+    def _read_partition_window(self, topic: str, part: int, offset: int,
+                               max_bytes: int):
+        """(error, high_watermark, record batch bytes) for one fetch
+        window — shared by the v4 and v7 fetch handlers.  Caller holds no
+        lock."""
+        with self._lock:
+            parts = self._logs.get(topic)
+            if parts is None or not 0 <= part < len(parts):
+                return _ERR_UNKNOWN_TOPIC, -1, b""
+            log = parts[part]
+            hw = len(log)
+            if offset > hw or offset < 0:
+                return _ERR_OFFSET_OUT_OF_RANGE, hw, b""
+            take = []
+            size = 0
+            for o, k, v, ts in log[offset:]:
+                rec = (len(k or b"") + len(v or b"") + 32)
+                if take and size + rec > max_bytes:
+                    break
+                take.append((max(ts, 0), k, v, []))
+                size += rec
+            data = (_encode_batch_v2(offset, take) if take else b"")
+        return _ERR_NONE, hw, data
+
+    def _fetch_v7(self, r: _Reader, w: _Writer) -> None:
+        """Fetch v7 with KIP-227 incremental fetch sessions."""
+        r.int32()                               # replica_id
+        r.int32()                               # max_wait
+        r.int32()                               # min_bytes
+        r.int32()                               # max_bytes (response-wide)
+        r.int8()                                # isolation_level
+        session_id = r.int32()
+        epoch = r.int32()
+        req_parts: List[Tuple[str, int, int, int]] = []
+        for _ in range(r.int32()):
+            topic = r.string()
+            for _ in range(r.int32()):
+                part = r.int32()
+                offset = r.int64()
+                r.int64()                       # log_start_offset
+                max_bytes = r.int32()
+                req_parts.append((topic, part, offset, max_bytes))
+        forgotten: List[Tuple[str, int]] = []
+        for _ in range(r.int32()):
+            topic = r.string()
+            for _ in range(r.int32()):
+                forgotten.append((topic, r.int32()))
+
+        def reply_error(code: int) -> None:
+            w.int32(0).int16(code).int32(session_id) \
+                .array([], lambda w, x: None)
+
+        with self._lock:
+            if epoch in (0, -1):
+                if epoch == -1:
+                    # KIP-227 session CLOSE: drop the named session and
+                    # serve this one request sessionless
+                    self._fetch_sessions.pop(session_id, None)
+                    session_id = 0
+                else:
+                    # FULL fetch establishes a new session (bounded
+                    # registry: oldest sessions age out, like
+                    # _committed_tids)
+                    session_id = self._next_session
+                    self._next_session += 1
+                    self._fetch_sessions[session_id] = {
+                        "epoch": 1,
+                        "parts": {(t, p): (o, mb)
+                                  for t, p, o, mb in req_parts}}
+                    while len(self._fetch_sessions) > 1024:
+                        self._fetch_sessions.pop(
+                            next(iter(self._fetch_sessions)))
+                sess_parts = {(t, p): (o, mb) for t, p, o, mb in req_parts}
+                full = True
+            else:
+                sess = self._fetch_sessions.get(session_id)
+                if sess is None:
+                    return reply_error(_ERR_FETCH_SESSION_ID_NOT_FOUND)
+                if epoch != sess["epoch"]:
+                    return reply_error(_ERR_INVALID_FETCH_SESSION_EPOCH)
+                sess["epoch"] += 1
+                for t, p in forgotten:
+                    sess["parts"].pop((t, p), None)
+                for t, p, o, mb in req_parts:   # adds AND offset updates
+                    sess["parts"][(t, p)] = (o, mb)
+                sess_parts = dict(sess["parts"])
+                full = False
+
+        by_topic: Dict[str, List[tuple]] = {}
+        for (topic, part), (offset, max_bytes) in sess_parts.items():
+            err, hw, data = self._read_partition_window(
+                topic, part, offset, max_bytes)
+            if not full and err == _ERR_NONE and not data:
+                continue    # incremental: only partitions with NEWS
+            by_topic.setdefault(topic, []).append((part, err, hw, data))
+        w.int32(0)                              # throttle_time_ms
+        w.int16(_ERR_NONE)
+        w.int32(session_id)
+        w.array(sorted(by_topic.items()), lambda w, t: w.string(t[0]).array(
+            t[1], lambda w, p: w.int32(p[0]).int16(p[1]).int64(p[2])
+            .int64(p[2])                        # last_stable_offset = hw
+            .int64(0)                           # log_start_offset
             .array([], lambda w, x: None)       # aborted transactions
             .bytes_(p[3])))
 

@@ -510,6 +510,107 @@ def fetch_v2(cli: KafkaWireClient, topic: str, partition: int, offset: int,
     raise ValueError("empty fetch response")
 
 
+class IncrementalFetcher:
+    """KIP-227 incremental fetch session (fetch v7): the FIRST poll is a
+    full fetch establishing a broker-side session; every later poll sends
+    only partitions whose fetch offset CHANGED since the last request,
+    and the broker answers with only partitions carrying news — the
+    steady-state idle poll is a near-empty exchange.
+
+    ``poll() -> {partition: [DecodedRecord, ...]}``; offsets advance
+    automatically as records are returned.  Per-partition errors do NOT
+    raise (the healthy partitions' records would be lost): the errored
+    partition lands in ``partition_errors``, leaves the local offset map,
+    and is forgotten from the broker session on the next request —
+    callers inspect ``partition_errors`` and re-add with a corrected
+    offset."""
+
+    def __init__(self, cli: KafkaWireClient, topic: str,
+                 partitions: List[int], start_offsets=None,
+                 max_bytes: int = 1 << 20):
+        self.cli = cli
+        self.topic = topic
+        self.max_bytes = max_bytes
+        self.offsets: Dict[int, int] = {
+            p: (start_offsets or {}).get(p, 0) for p in partitions}
+        self.session_id = 0
+        self.epoch = 0
+        self._sent: Dict[int, int] = {}       # offsets as of last request
+        self._forget: List[int] = []          # drop from the session
+        self.partition_errors: Dict[int, int] = {}
+
+    def _request(self, parts: List[int], forget: List[int]) -> '_Reader':
+        from flink_tpu.connectors.kafka import _API_FETCH
+        body = (_Writer().int32(-1).int32(100).int32(1)
+                .int32(self.max_bytes).int8(0)
+                .int32(self.session_id).int32(self.epoch)
+                .array([(self.topic,
+                         [(p, self.offsets[p]) for p in parts])],
+                       lambda w, t: w.string(t[0]).array(
+                           t[1], lambda w, pp: w.int32(pp[0])
+                           .int64(pp[1]).int64(0).int32(self.max_bytes)))
+                .array([(self.topic, list(forget))] if forget else [],
+                       lambda w, t: w.string(t[0]).array(
+                           t[1], lambda w, p: w.int32(p))))
+        return self.cli._call(_API_FETCH, 7, body.done())
+
+    def poll(self) -> Dict[int, List[DecodedRecord]]:
+        from flink_tpu.connectors.kafka import (
+            _ERR_FETCH_SESSION_ID_NOT_FOUND,
+            _ERR_INVALID_FETCH_SESSION_EPOCH)
+        self.partition_errors = {}
+        if self.epoch == 0:
+            parts = sorted(self.offsets)         # full fetch
+        else:
+            parts = sorted(p for p, o in self.offsets.items()
+                           if self._sent.get(p) != o)
+        forget, self._forget = self._forget, []
+        r = self._request(parts, forget)
+        r.int32()                                # throttle
+        err = r.int16()
+        sid = r.int32()
+        if err in (_ERR_FETCH_SESSION_ID_NOT_FOUND,
+                   _ERR_INVALID_FETCH_SESSION_EPOCH):
+            self.session_id, self.epoch = 0, 0   # re-establish full
+            self._sent = {}
+            return self.poll()
+        if err:
+            raise ValueError(f"fetch(v7) error {err}")
+        if self.epoch == 0 and sid:
+            self.session_id = sid
+        self.epoch += 1
+        for p in parts:
+            self._sent[p] = self.offsets[p]
+        out: Dict[int, List[DecodedRecord]] = {}
+        for _ in range(r.int32()):
+            r.string()
+            for _ in range(r.int32()):
+                part = r.int32()
+                perr = r.int16()
+                r.int64()                        # high watermark
+                r.int64()                        # last_stable_offset
+                r.int64()                        # log_start_offset
+                r.array(lambda r: (r.int64(), r.int64()))  # aborted
+                data = r.bytes_() or b""
+                if perr:
+                    # healthy partitions keep flowing; the bad one exits
+                    # the session until the caller re-adds it
+                    self.partition_errors[part] = perr
+                    self.offsets.pop(part, None)
+                    self._sent.pop(part, None)
+                    self._forget.append(part)
+                    continue
+                recs = decode_record_batches(data)
+                if recs:
+                    out[part] = recs
+                    self.offsets[part] = recs[-1][0] + 1
+        return out
+
+    def add_partition(self, partition: int, offset: int) -> None:
+        """(Re-)track a partition (e.g. after a partition_errors entry)."""
+        self.offsets[partition] = offset
+
+
 # ---------------------------------------------------------------------------
 # group source (committed-offset restart)
 # ---------------------------------------------------------------------------

@@ -61,7 +61,7 @@ def test_client_broker_roundtrip(broker):
     try:
         versions = dict((k, (lo, hi)) for k, lo, hi in c.api_versions())
         # v0 stays supported; v2-era ranges advertised since round 4
-        assert versions[0] == (0, 3) and versions[1] == (0, 4)
+        assert versions[0] == (0, 3) and versions[1] == (0, 7)
         assert versions[11] == (0, 0) and versions[14] == (0, 0)
         meta = c.metadata(["t"])
         assert meta["brokers"][0]["port"] == broker.port
@@ -398,5 +398,68 @@ def test_tls_listener_sasl_ssl(tmp_path):
         plain = KafkaWireClient(b.host, b.port, timeout_s=3)
         with pytest.raises((KafkaError, OSError, ValueError)):
             plain.metadata()
+    finally:
+        b.stop()
+
+
+def test_incremental_fetch_sessions_v7(tmp_path):
+    """KIP-227: a full fetch establishes a session; incremental polls
+    send only changed partitions and receive only partitions with news;
+    stale epochs re-establish."""
+    from flink_tpu.connectors.kafka_v2 import IncrementalFetcher, produce_v2
+
+    b = KafkaWireBroker(directory=str(tmp_path / "k")).start()
+    try:
+        b.create_topic("t", partitions=2)
+        c = KafkaWireClient(b.host, b.port)
+        produce_v2(c, "t", 0, [(0, None, b"a0", [])])
+        produce_v2(c, "t", 1, [(0, None, b"b0", [])])
+        f = IncrementalFetcher(c, "t", [0, 1])
+        got = f.poll()                          # full fetch
+        assert f.session_id > 0 and f.epoch == 1
+        assert {p: [r[3] for r in rs] for p, rs in got.items()} == \
+            {0: [b"a0"], 1: [b"b0"]}
+        # idle incremental poll: nothing changed, nothing returned
+        assert f.poll() == {}
+        assert f.epoch == 2
+        # news on ONE partition only
+        produce_v2(c, "t", 1, [(0, None, b"b1", [])])
+        got = f.poll()
+        assert list(got) == [1]
+        assert got[1][0][3] == b"b1"
+        assert f.offsets == {0: 1, 1: 2}
+        # a second fetcher killing the session state: simulate epoch skew
+        f.epoch = 99                            # stale epoch
+        produce_v2(c, "t", 0, [(0, None, b"a1", [])])
+        got = f.poll()                          # auto re-establishes
+        assert got[0][0][3] == b"a1"
+        c.close()
+    finally:
+        b.stop()
+
+
+def test_incremental_fetch_partition_error_isolated(tmp_path):
+    """A bad partition (out-of-range offset) must not lose the healthy
+    partitions' records: it lands in partition_errors, leaves the
+    session, and can be re-added."""
+    from flink_tpu.connectors.kafka_v2 import IncrementalFetcher, produce_v2
+
+    b = KafkaWireBroker(directory=str(tmp_path / "k")).start()
+    try:
+        b.create_topic("t", partitions=2)
+        c = KafkaWireClient(b.host, b.port)
+        produce_v2(c, "t", 0, [(0, None, b"ok", [])])
+        f = IncrementalFetcher(c, "t", [0, 1], start_offsets={1: 999})
+        got = f.poll()
+        assert got[0][0][3] == b"ok"            # healthy data delivered
+        assert 1 in f.partition_errors          # OFFSET_OUT_OF_RANGE
+        assert 1 not in f.offsets
+        assert f.poll() == {}                   # errored part forgotten
+        assert f.partition_errors == {}
+        f.add_partition(1, 0)                   # caller corrects offset
+        produce_v2(c, "t", 1, [(0, None, b"back", [])])
+        got = f.poll()
+        assert got[1][0][3] == b"back"
+        c.close()
     finally:
         b.stop()
