@@ -361,17 +361,14 @@ class Graph:
             cc = np.where(denom > 0, tri / np.maximum(denom, 1), 0.0)
         return cc
 
-    def bfs_levels(self, sources: "np.ndarray | int",
-                   max_supersteps: int = 0,
-                   directed: bool = False) -> np.ndarray:
-        """int32[n] hop distance from the nearest source (multi-source BFS);
-        unreachable = -1.  Default treats edges as undirected;
-        ``directed=True`` follows edge direction only (matching ``sssp``,
-        which always runs on the directed edges)."""
-        srcs = np.atleast_1d(np.asarray(sources, np.int64))
-        inf = np.iinfo(np.int32).max
-        init = np.full(self.n, inf, np.int32)
-        init[srcs] = 0
+    _BFS_INF = np.iinfo(np.int32).max
+
+    def _bfs_propagate(self, init: np.ndarray, directed: bool,
+                       max_supersteps: int, mesh=None) -> np.ndarray:
+        """Shared BFS superstep (min-combine hop propagation) over any
+        init shape — [n] for ``bfs_levels``, [n, n] for the simultaneous
+        all-pairs variant; -1 marks unreachable."""
+        inf = self._BFS_INF
 
         def msg(vals, _w):
             return jnp.where(vals < inf, vals + 1, inf)
@@ -382,8 +379,20 @@ class Graph:
         g = self if directed else self.undirected()
         out = g.scatter_gather(
             init, msg, "min", update, max_supersteps or self.n,
-            converged=lambda a, b: bool(jnp.array_equal(a, b)))
+            converged=lambda a, b: bool(jnp.array_equal(a, b)), mesh=mesh)
         return np.where(out >= inf, -1, out).astype(np.int32)
+
+    def bfs_levels(self, sources: "np.ndarray | int",
+                   max_supersteps: int = 0,
+                   directed: bool = False, mesh=None) -> np.ndarray:
+        """int32[n] hop distance from the nearest source (multi-source BFS);
+        unreachable = -1.  Default treats edges as undirected;
+        ``directed=True`` follows edge direction only (matching ``sssp``,
+        which always runs on the directed edges)."""
+        srcs = np.atleast_1d(np.asarray(sources, np.int64))
+        init = np.full(self.n, self._BFS_INF, np.int32)
+        init[srcs] = 0
+        return self._bfs_propagate(init, directed, max_supersteps, mesh)
 
     def label_propagation(self, initial_labels: np.ndarray,
                           num_iterations: int = 10) -> np.ndarray:
@@ -544,6 +553,70 @@ class Graph:
             "max_degree": int(deg.max()) if self.n else 0,
             "vertices_with_edges": int((deg > 0).sum()),
         }
+
+    def all_pairs_distances(self, directed: bool = False,
+                            max_supersteps: int = 0,
+                            mesh=None) -> np.ndarray:
+        """int32[n, n] hop distances (``d[i, j]`` = hops from i to j,
+        -1 = unreachable) — ALL sources propagate simultaneously as one
+        [n, n] vertex-value matrix through the same scatter-gather
+        superstep (one segment-min per step instead of n BFS runs; the
+        TPU-native cut for the all-pairs family).  n² memory: sized for
+        the analysis-scale graphs the eccentricity/closeness family
+        targets."""
+        init = np.full((self.n, self.n), self._BFS_INF, np.int32)
+        np.fill_diagonal(init, 0)
+        out = self._bfs_propagate(init, directed, max_supersteps, mesh)
+        # out[i, j] = distance from column-source j; expose row-source
+        # orientation d[i, j] = i -> j
+        return out.T.copy()
+
+    def eccentricity(self, mesh=None,
+                     distances: Optional[np.ndarray] = None) -> np.ndarray:
+        """int32[n] eccentricity: each vertex's maximum hop distance to
+        any REACHABLE vertex over the undirected graph (isolated
+        vertices: 0) — the ``Eccentricity`` library analog.  Pass a
+        precomputed ``all_pairs_distances()`` matrix to share one BFS
+        across the eccentricity/closeness/diameter family."""
+        d = (distances if distances is not None
+             else self.all_pairs_distances(mesh=mesh))
+        masked = np.where(d >= 0, d, 0)
+        return masked.max(axis=1).astype(np.int32)
+
+    def closeness_centrality(self, mesh=None,
+                             distances: Optional[np.ndarray] = None
+                             ) -> np.ndarray:
+        """float32[n] closeness with the Wasserman–Faust component
+        correction: ``((r-1)/(n-1)) * ((r-1)/sum_d)`` where r = reachable
+        vertices (incl. self) — comparable across disconnected
+        components; isolated vertices score 0."""
+        d = (distances if distances is not None
+             else self.all_pairs_distances(mesh=mesh))
+        reach = (d >= 0).sum(axis=1)                  # includes self (d=0)
+        dist_sum = np.where(d > 0, d, 0).sum(axis=1)
+        r1 = (reach - 1).astype(np.float64)
+        denom = np.maximum(dist_sum, 1)
+        frac = np.where(dist_sum > 0, r1 / denom, 0.0)
+        scale = r1 / max(self.n - 1, 1)
+        return (scale * frac).astype(np.float32)
+
+    def diameter_radius(self, mesh=None,
+                        distances: Optional[np.ndarray] = None) -> dict:
+        """Graph diameter/radius over the undirected graph's non-isolated
+        vertices.  Self-loops do not make a vertex non-isolated (they
+        contribute no path to anywhere else, like the triangle/k-core
+        paths that drop them)."""
+        ecc = self.eccentricity(mesh=mesh, distances=distances)
+        src_np = np.asarray(self.src)
+        dst_np = np.asarray(self.dst)
+        real = src_np != dst_np                  # ignore self-loops
+        deg = np.zeros(self.n, np.int64)
+        np.add.at(deg, src_np[real], 1)
+        np.add.at(deg, dst_np[real], 1)
+        live = ecc[deg > 0]
+        if live.size == 0:
+            return {"diameter": 0, "radius": 0}
+        return {"diameter": int(live.max()), "radius": int(live.min())}
 
     def jaccard_similarity(self) -> np.ndarray:
         """Per-EDGE Jaccard index |N(u) ∩ N(v)| / |N(u) ∪ N(v)| over the

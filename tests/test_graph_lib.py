@@ -392,3 +392,62 @@ def test_empty_projection_has_typed_weights():
     p = g.bipartite_projection(left_size=2)
     assert p.num_edges == 0
     assert p.weights is not None and np.asarray(p.weights).shape == (0,)
+
+
+def test_all_pairs_distances_and_eccentricity():
+    """Path 0-1-2-3 plus isolated 4: the [n,n] simultaneous-BFS matrix,
+    eccentricity, and diameter/radius match hand computation."""
+    g = Graph.from_edges([(0, 1), (1, 2), (2, 3)], num_vertices=5)
+    d = g.all_pairs_distances()
+    assert d[0].tolist() == [0, 1, 2, 3, -1]
+    assert d[3].tolist() == [3, 2, 1, 0, -1]
+    assert d[4].tolist() == [-1, -1, -1, -1, 0]
+    assert g.eccentricity().tolist() == [3, 2, 2, 3, 0]
+    assert g.diameter_radius() == {"diameter": 3, "radius": 2}
+    # directed orientation: row-source d[i, j] = i -> j
+    dd = g.all_pairs_distances(directed=True)
+    assert dd[0, 3] == 3 and dd[3, 0] == -1
+
+
+def test_closeness_centrality():
+    # star: the hub is closest to everything
+    g = Graph.from_edges([(0, 1), (0, 2), (0, 3), (0, 4)], num_vertices=5)
+    c = g.closeness_centrality()
+    assert c[0] == max(c)
+    assert np.allclose(c[1:], c[1])          # leaves tie
+    # hub closeness = (n-1)/sum(d) = 4/4 = 1.0 (full Wasserman-Faust
+    # scale since everything is reachable)
+    assert c[0] == pytest.approx(1.0)
+    # the component correction keeps disconnected graphs comparable
+    g2 = Graph.from_edges([(0, 1), (2, 3)], num_vertices=4)
+    c2 = g2.closeness_centrality()
+    assert np.allclose(c2, c2[0])            # symmetric pairs tie
+    assert 0 < c2[0] < 1.0                   # penalized vs a full graph
+
+
+def test_all_pairs_on_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:4])
+    if devs.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = Mesh(devs, ("d",))
+    g = Graph.from_edges([(0, 1), (1, 2), (2, 3), (3, 4), (4, 5)],
+                         num_vertices=6)
+    d = g.all_pairs_distances(mesh=mesh)
+    assert d[0].tolist() == [0, 1, 2, 3, 4, 5]
+    assert g.eccentricity(mesh=mesh).tolist() == [5, 4, 3, 3, 4, 5]
+
+
+def test_diameter_ignores_self_loops_and_shares_distances():
+    g = Graph.from_edges([(0, 1), (2, 2)], num_vertices=3)
+    # vertex 2 only has a self-loop: excluded from diameter/radius
+    assert g.diameter_radius() == {"diameter": 1, "radius": 1}
+    # one BFS shared across the family
+    g2 = Graph.from_edges([(0, 1), (1, 2)], num_vertices=3)
+    d = g2.all_pairs_distances()
+    assert g2.eccentricity(distances=d).tolist() == [2, 1, 2]
+    assert g2.diameter_radius(distances=d) == {"diameter": 2, "radius": 1}
+    c = g2.closeness_centrality(distances=d)
+    assert c[1] == max(c)
