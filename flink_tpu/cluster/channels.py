@@ -18,6 +18,7 @@ input) always go to every target channel, like the reference's
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -25,6 +26,7 @@ import numpy as np
 
 from flink_tpu.core import keygroups
 from flink_tpu.core.batch import RecordBatch, StreamElement
+from flink_tpu.testing import chaos
 
 
 class LocalChannel:
@@ -41,6 +43,19 @@ class LocalChannel:
         self._closed = False
 
     def put(self, el: StreamElement, timeout_s: Optional[float] = None) -> bool:
+        # fault point: a partitioned link stalls (bytes neither flow nor
+        # error — FreezableProxy semantics); fail/delay schedules raise/slow.
+        # Fired ONCE per put — while dropped, poll blocked() so the firing
+        # counter/history stay deterministic regardless of stall duration
+        if not chaos.fire("channel.send", channel=self.name):
+            deadline = (None if timeout_s is None
+                        else time.monotonic() + timeout_s)
+            while chaos.blocked("channel.send"):
+                if self._closed:
+                    return False
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.01)
         with self._not_full:
             while len(self._q) >= self.capacity and not self._closed:
                 if not self._not_full.wait(timeout=timeout_s):

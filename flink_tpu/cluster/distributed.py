@@ -304,6 +304,14 @@ class _WorkerRuntime:
         self._send(("ack", checkpoint_id, vertex_uid, subtask_index,
                     snapshot))
 
+    def decline_checkpoint(self, checkpoint_id: int, vertex_uid: str,
+                           subtask_index: int, error: str) -> None:
+        """A subtask's snapshot failed: ship the decline to the coordinator
+        (``declineCheckpoint`` RPC) so the pending checkpoint is aborted and
+        charged to the failure budget — the task itself keeps running."""
+        self._send(("decline", checkpoint_id, vertex_uid, subtask_index,
+                    error))
+
     # -- runtime split requests (FLIP-27 RequestSplitEvent over the
     # control plane; replies land on a per-reader queue) ------------------
     def _make_split_requester(self, uid: str, idx: int):
@@ -643,6 +651,7 @@ class _Pending:
         self.cid = cid
         self.expected = set(expected)
         self.acks: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self.started_at = time.monotonic()
         #: enumerator snapshots taken at trigger time (§3.4 coordinator
         #: snapshots precede task triggers)
         self.enumerators = enumerators
@@ -659,11 +668,32 @@ class ProcessCluster:
                  spawn: bool = True, bind_host: str = "127.0.0.1",
                  listen_port: int = 0, restart_attempts: int = 0,
                  restart_delay_ms: int = 500, worker_recovery: bool = True,
-                 local_recovery_dir: Optional[str] = None):
+                 local_recovery_dir: Optional[str] = None,
+                 tolerable_failed_checkpoints: int = 0,
+                 checkpoint_timeout_s: float = 60.0):
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureManager
+
         self.job = job
         self.n_workers = n_workers
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
+        #: CheckpointFailureManager policy: storage-failed and timed-out
+        #: checkpoints beyond this many CONSECUTIVE failures fail the
+        #: execution, which the restart loop recovers from the latest
+        #: completed checkpoint (-1 = unlimited tolerance)
+        self.failure_manager = CheckpointFailureManager(
+            tolerable_failed_checkpoints)
+        self.checkpoint_timeout_s = checkpoint_timeout_s
+        #: restart attempts performed by the current run() — exported with
+        #: the failure manager's counters on a job-scope metric group
+        self._restarts = 0
+        from flink_tpu.metrics.groups import (MetricRegistry,
+                                              job_checkpoint_metrics)
+        self.metrics_registry = MetricRegistry()
+        self.job_metric_group = job_checkpoint_metrics(
+            self.metrics_registry.job_manager_group(), self.failure_manager,
+            lambda: self._restarts)
         #: local recovery: workers keep secondary snapshot copies under
         #: this directory and restore from them on same-worker restarts
         #: (``state.backend.local-recovery`` analog); stats from workers
@@ -738,17 +768,28 @@ class ProcessCluster:
         result in memory/checkpoints by design."""
         original_restore = restore
         attempt = 0
+        self._restarts = 0
         while True:
+            self._restarts = attempt
             if attempt > 0:
                 self._reset_attempt()
+                self.failure_manager.on_job_restart()
                 # restore ONLY from a checkpoint THIS run completed — a
                 # reused checkpoint dir may hold higher-numbered checkpoints
                 # from a previous execution, and load_latest() would silently
                 # resume a different job's state
                 latest = None
                 if self.checkpoint_storage is not None and self._completed_ids:
-                    latest = self.checkpoint_storage.load(
-                        max(self._completed_ids))
+                    # a load failure (checkpoint.load fault, transient read
+                    # error, corruption) must stay INSIDE the restart
+                    # machinery: fall back to progressively older completed
+                    # checkpoints, then to the caller's restore/scratch
+                    for cid in sorted(self._completed_ids, reverse=True):
+                        try:
+                            latest = self.checkpoint_storage.load(cid)
+                            break
+                        except Exception:  # noqa: BLE001
+                            continue
                 # no checkpoint completed yet: fall back to the restore the
                 # CALLER supplied (a savepoint must not silently drop)
                 restore = latest or original_restore
@@ -926,7 +967,8 @@ class ProcessCluster:
                 rows.extend(self._rows[key])
             return {"state": state, "error": self._failed, "rows": rows,
                     "recoveries": recoveries,
-                    "completed_checkpoints": list(self._completed_ids)}
+                    "completed_checkpoints": list(self._completed_ids),
+                    "failed_checkpoints": self.failure_manager.num_failed()}
         finally:
             self._all_done.set()   # stop this attempt's checkpoint ticker
             srv.close()
@@ -1049,6 +1091,9 @@ class ProcessCluster:
             self._failed = None
             self._done_workers = set()
             self._all_done = threading.Event()
+            # failover: in-flight checkpoint attempts die with the old
+            # execution, so the continuous-failure window restarts too
+            self.failure_manager.on_job_restart()
         old_done.set()  # stop the previous checkpoint ticker
         # 4. redeploy from this run's latest completed checkpoint
         restore = self._latest_restore(original_restore)
@@ -1094,6 +1139,9 @@ class ProcessCluster:
                 self._rows.pop(key, None)
             self._pending = None            # in-flight checkpoint aborts
             self._failed = None
+            # region failover restarts the continuous-failure window, same
+            # as a full restart (MiniCluster does this per region restart)
+            self.failure_manager.on_job_restart()
             self._done_workers -= touched_workers
             self._all_done = threading.Event()
         old_done.set()  # stop the previous checkpoint ticker
@@ -1224,6 +1272,11 @@ class ProcessCluster:
                 _, uid, i, snap = msg
                 with self._lock:
                     self._finals[(uid, i)] = snap
+                    # a completion deferred on this final (state FINISHED
+                    # arrived first) proceeds now that the state is whole
+                    p = self._pending
+                    if p is not None and len(p.acks) >= len(p.expected):
+                        self._complete(p)
             elif kind == "ack":
                 _, cid, uid, i, snap = msg
                 with self._lock:
@@ -1232,6 +1285,19 @@ class ProcessCluster:
                         p.acks[(uid, i)] = snap
                         if len(p.acks) >= len(p.expected):
                             self._complete(p)
+            elif kind == "decline":
+                _, cid, uid, i, error = msg
+                from flink_tpu.runtime.checkpoint.failure import \
+                    CheckpointFailureReason
+                with self._lock:
+                    p = self._pending
+                    if p is not None and p.cid == cid:
+                        # abort the attempt, charge the tolerable budget;
+                        # the TASK stays up (decline != task failure)
+                        self._pending = None
+                        self._checkpoint_failure_locked(
+                            CheckpointFailureReason.DECLINED, cid,
+                            f"{uid}[{i}] declined: {error}")
             elif kind == "split_request":
                 _, uid, i = msg
                 split, done_flag = self._source_coordinator.request_split(
@@ -1254,7 +1320,21 @@ class ProcessCluster:
 
     # -- checkpointing -----------------------------------------------------
     def trigger_checkpoint(self, all_subtasks: set) -> Optional[int]:
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureReason
+
         with self._lock:
+            if self._pending is not None and (
+                    time.monotonic() - self._pending.started_at
+                    >= self.checkpoint_timeout_s):
+                # expired: abort + charge the budget (a dead worker's acks
+                # will never arrive; failure detection handles the worker)
+                expired = self._pending
+                self._pending = None
+                self._checkpoint_failure_locked(
+                    CheckpointFailureReason.TIMEOUT, expired.cid,
+                    f"{len(expired.acks)}/{len(expired.expected)} acks "
+                    f"after {self.checkpoint_timeout_s}s")
             if self._pending is not None or self._failed is not None \
                     or self._recovering:
                 return None
@@ -1275,6 +1355,17 @@ class ProcessCluster:
     def _complete(self, p: _Pending) -> None:
         """Assemble + store (caller holds the lock) — mirrors
         ``MiniCluster._complete_checkpoint`` incl. FLIP-147 finals."""
+        # a FINISHED subtask's state arrives as two messages (state, then
+        # final); completing between them would persist a HOLE for that
+        # subtask — and if its worker dies mid-send, the hole would be
+        # silently restored later, losing the subtask's entire output.
+        # Defer instead: the final's arrival re-runs completion; a lost
+        # final leaves the pending to the checkpoint timeout / recovery
+        # abort, and restore falls back to the previous intact checkpoint.
+        for key, st in self._states.items():
+            if st == "FINISHED" and key not in p.acks \
+                    and key not in self._finals:
+                return
         assembled: Dict[str, Any] = {"__job__": {
             "checkpoint_id": p.cid,
             "run_token": self.run_token,
@@ -1290,12 +1381,48 @@ class ProcessCluster:
                 entry = assembled.setdefault(
                     uid, {"subtasks": [None] * self._counts[uid]})
                 entry["subtasks"][i] = snap
-        if self.checkpoint_storage is not None:
-            self.checkpoint_storage.store(p.cid, assembled)
-        self._completed_ids.append(p.cid)
+        # claim completion BEFORE dropping the lock for storage I/O: late
+        # acks for this id are ignored and a new trigger may start
         self._pending = None
+        if self.checkpoint_storage is not None:
+            from flink_tpu.runtime.checkpoint.failure import \
+                CheckpointFailureReason
+            # the store (and any retry/backoff wrapper) must not stall the
+            # coordinator lock: worker events keep flowing while bytes land
+            self._lock.release()
+            try:
+                try:
+                    self.checkpoint_storage.store(p.cid, assembled)
+                except Exception as e:  # noqa: BLE001
+                    store_error = f"{type(e).__name__}: {e}"
+                else:
+                    store_error = None
+            finally:
+                self._lock.acquire()
+            if store_error is not None:
+                # abandoned checkpoint, job keeps running — until the
+                # tolerable budget is exhausted (then the restart loop
+                # recovers from the latest stored checkpoint)
+                self._checkpoint_failure_locked(
+                    CheckpointFailureReason.STORAGE, p.cid, store_error)
+                return
+        self.failure_manager.on_checkpoint_success(p.cid)
+        self._completed_ids.append(p.cid)
         for idx in self._conns:
             self._to_worker(idx, ("notify", p.cid))
+
+    def _checkpoint_failure_locked(self, reason: str, cid: int,
+                                   detail: str) -> None:
+        """Caller holds ``_lock``: charge one checkpoint failure; past the
+        tolerable budget the attempt FAILS (run() restores the next attempt
+        from the latest completed checkpoint)."""
+        if self.failure_manager.on_checkpoint_failure(reason, cid) \
+                and self._failed is None:
+            self._failed = (
+                f"tolerable failed checkpoints "
+                f"({self.failure_manager.tolerable}) exceeded — "
+                f"checkpoint {cid} {reason}: {detail}")
+            self._all_done.set()
 
     def _checkpoint_loop(self, all_subtasks: set, done: threading.Event) -> None:
         while not done.is_set():

@@ -13,6 +13,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from flink_tpu.testing import chaos
+
 
 class HeartbeatTarget:
     """What the monitor pings (``HeartbeatTarget`` analog): any callable that
@@ -56,6 +58,11 @@ class HeartbeatManager:
             self._monitors.pop(resource_id, None)
 
     def receive_heartbeat(self, resource_id: str) -> None:
+        # fault point: a partitioned target's heartbeats are dropped on the
+        # floor (the monitor never sees them -> timeout fires even though
+        # the target is alive — the classic one-way partition false suspect)
+        if not chaos.fire("heartbeat.deliver", target=resource_id):
+            return
         with self._lock:
             m = self._monitors.get(resource_id)
             if m is not None:

@@ -94,15 +94,26 @@ class JobResult:
 
 
 class MiniCluster(TaskListener):
+    #: synthetic "vertex" charged with checkpoint-policy failures: never in
+    #: any plan, so region lookup falls back to a FULL restart
+    _CHECKPOINT_COORDINATOR_UID = "__checkpoint_coordinator__"
+
     def __init__(self, checkpoint_storage=None, checkpoint_interval_ms: int = 0,
                  unaligned: bool = False, checkpoint_timeout_s: float = 60.0,
                  restart_attempts: int = 0, restart_delay_ms: int = 50,
                  channel_capacity: int = 32, restart_strategy=None,
-                 config=None):
+                 config=None, tolerable_failed_checkpoints: int = 0):
         from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
                                                 NoRestartStrategy)
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureManager
 
         self.config = config
+        #: execution.checkpointing.tolerable-failed-checkpoints analog:
+        #: declined/timed-out/storage-failed checkpoints beyond this many
+        #: CONSECUTIVE failures trigger job failover (-1 = unlimited)
+        self.failure_manager = CheckpointFailureManager(
+            tolerable_failed_checkpoints)
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.unaligned = unaligned
@@ -133,6 +144,18 @@ class MiniCluster(TaskListener):
         #: every task failure ever seen (JobExceptionsHandler's history,
         #: not just the current root cause); bounded
         self._exception_history: List[Dict[str, Any]] = []
+        #: restarts performed by the CURRENT/most recent execute() —
+        #: surfaced by job_status() next to the failed-checkpoint counters
+        self._restarts = 0
+        #: job-scope metric group: numberOfCompleted/FailedCheckpoints +
+        #: numRestarts (CheckpointStatsTracker analogs) on a jobmanager
+        #: root, so reporters attached to ``metrics_registry`` export them
+        from flink_tpu.metrics.groups import (MetricRegistry,
+                                              job_checkpoint_metrics)
+        self.metrics_registry = MetricRegistry()
+        self.job_metric_group = job_checkpoint_metrics(
+            self.metrics_registry.job_manager_group(), self.failure_manager,
+            lambda: self._restarts)
 
     # ------------------------------------------------------------ listener
     def _slot_memory(self):
@@ -165,8 +188,9 @@ class MiniCluster(TaskListener):
                 if p is not None and (vertex_uid, subtask_index) not in p.acks:
                     p.expected -= 1
                     if len(p.acks) >= p.expected:
+                        # claims self._pending; a NEW checkpoint may start
+                        # during its unlocked store, so don't clear after
                         self._complete_checkpoint(p)
-                        self._pending = None
 
     def acknowledge_checkpoint(self, checkpoint_id: int, vertex_uid: str,
                                subtask_index: int,
@@ -178,7 +202,40 @@ class MiniCluster(TaskListener):
             p.acks[(vertex_uid, subtask_index)] = snapshot
             if len(p.acks) >= p.expected:
                 self._complete_checkpoint(p)
-                self._pending = None
+
+    def decline_checkpoint(self, checkpoint_id: int, vertex_uid: str,
+                           subtask_index: int, error: str) -> None:
+        """A subtask could not snapshot: abort the pending checkpoint and
+        charge the failure budget (``receiveDeclineMessage`` analog)."""
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureReason
+
+        with self._lock:
+            p = self._pending
+            if p is None or p.checkpoint_id != checkpoint_id:
+                return                       # already aborted/completed
+            self._pending = None
+            self._record_checkpoint_failure(
+                CheckpointFailureReason.DECLINED, checkpoint_id,
+                f"{vertex_uid}[{subtask_index}] declined: {error}")
+
+    def _record_checkpoint_failure(self, reason: str, checkpoint_id: int,
+                                   detail: str) -> None:
+        """Caller holds ``_lock``.  Counts one in-flight checkpoint failure;
+        past the tolerable budget the JOB fails over (the execute loop's
+        restart strategy takes it from there, full-restart region)."""
+        exceeded = self.failure_manager.on_checkpoint_failure(
+            reason, checkpoint_id)
+        self._exception_history.append({
+            "timestamp_ms": int(time.time() * 1000),
+            "task": f"checkpoint-{checkpoint_id}",
+            "exception": f"checkpoint {reason}: {detail}"})
+        del self._exception_history[:-50]
+        if exceeded and self._failed is None:
+            self._failed = (
+                f"{self._CHECKPOINT_COORDINATOR_UID}[0]: tolerable failed "
+                f"checkpoints ({self.failure_manager.tolerable}) exceeded — "
+                f"checkpoint {checkpoint_id} {reason}: {detail}")
 
     def _complete_checkpoint(self, p: _PendingCheckpoint) -> None:
         assembled: Dict[str, Any] = {"__job__": {
@@ -202,8 +259,35 @@ class MiniCluster(TaskListener):
                         t.vertex_uid,
                         {"subtasks": [None] * self._subtask_counts[t.vertex_uid]})
                     entry["subtasks"][t.subtask_index] = final
+        # claim completion BEFORE dropping the lock for storage I/O: late
+        # acks/declines for this id are ignored and a new trigger may start
+        self._pending = None
         if self.checkpoint_storage is not None:
-            self.checkpoint_storage.store(p.checkpoint_id, assembled)
+            from flink_tpu.runtime.checkpoint.failure import \
+                CheckpointFailureReason
+            # the store (and any retry/backoff wrapper around it) must not
+            # stall the coordinator lock: acks, declines and triggers keep
+            # flowing while the bytes land
+            self._lock.release()
+            try:
+                try:
+                    self.checkpoint_storage.store(p.checkpoint_id, assembled)
+                except Exception as e:  # noqa: BLE001
+                    store_error = f"{type(e).__name__}: {e}"
+                else:
+                    store_error = None
+            finally:
+                self._lock.acquire()
+            if store_error is not None:
+                # a storage flake must not kill the ACKING TASK's thread
+                # (store runs on it): the checkpoint is abandoned, the
+                # failure budget charged, the job keeps running — or fails
+                # over once the budget is exhausted
+                self._record_checkpoint_failure(
+                    CheckpointFailureReason.STORAGE, p.checkpoint_id,
+                    store_error)
+                return
+        self.failure_manager.on_checkpoint_success(p.checkpoint_id)
         self._completed_ids.append(p.checkpoint_id)
         self._latest_snapshot = assembled
         self._checkpoint_stats.append({
@@ -377,12 +461,20 @@ class MiniCluster(TaskListener):
         ``CheckpointCoordinator.triggerCheckpoint:502``).  Returns
         ``(id, "ok")``, ``(None, "busy")`` while one is in flight, or
         ``(None, "declined")`` when checkpointing is no longer possible."""
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureReason
+
         with self._lock:
             if self._pending is not None:
                 if (time.monotonic() - self._pending.started_at
                         < self.checkpoint_timeout_s):
                     return None, "busy"   # previous still in flight
+                expired = self._pending
                 self._pending = None  # timed out: abort
+                self._record_checkpoint_failure(
+                    CheckpointFailureReason.TIMEOUT, expired.checkpoint_id,
+                    f"{len(expired.acks)}/{expired.expected} acks after "
+                    f"{self.checkpoint_timeout_s}s")
             if not self._tasks:
                 return None, "declined"   # nothing deployed yet
             # finished sources cannot inject barriers and finished tasks
@@ -414,6 +506,7 @@ class MiniCluster(TaskListener):
         self._plan = plan              # dashboard DAG view
         t0 = time.monotonic()
         restarts = 0
+        self._restarts = 0
         # restart budgets are per execution (per-ExecutionGraph in the
         # reference): a fresh strategy instance each run
         self._active_strategy = _copy.deepcopy(self.restart_strategy)
@@ -432,6 +525,10 @@ class MiniCluster(TaskListener):
                 self._active_strategy.notify_failure()
                 if self._active_strategy.can_restart():
                     restarts += 1
+                    self._restarts = restarts
+                    # in-flight checkpoint attempts die with the execution:
+                    # the continuous-failure window restarts too
+                    self.failure_manager.on_job_restart()
                     time.sleep(self._active_strategy.delay_ms() / 1000.0)
                     self._restart_failed_region(plan, failed_uid)
                     continue
@@ -504,9 +601,17 @@ class MiniCluster(TaskListener):
 
     def latest_restore(self) -> Optional[Dict[str, Any]]:
         """Most recent restorable snapshot: durable storage first, else the
-        in-memory copy of the last completed checkpoint."""
+        in-memory copy of the last completed checkpoint.  A storage read
+        failure (checkpoint.load fault, transient error) degrades to the
+        in-memory copy (or scratch) instead of escaping execute() — the
+        restart attempt must stay inside the restart machinery."""
         if self.checkpoint_storage is not None:
-            return self.checkpoint_storage.load_latest()
+            try:
+                loaded = self.checkpoint_storage.load_latest()
+            except Exception:  # noqa: BLE001
+                loaded = None
+            if loaded is not None:
+                return loaded
         return getattr(self, "_latest_snapshot", None)
 
     def cancel(self) -> None:
@@ -587,11 +692,21 @@ class MiniCluster(TaskListener):
             job_state = "RUNNING"
         else:
             job_state = "CREATED"
+        checkpoints = self.failure_manager.status()
+        # top-level "completed_checkpoints" is the LIST of ids; this is the
+        # lifetime count — name it distinctly so consumers can't mix them up
+        checkpoints["num_completed_checkpoints"] = self.failure_manager \
+            .num_completed()
         return {
             "state": job_state,
             "vertices": vertices,
             "completed_checkpoints": list(self._completed_ids),
             "checkpoint_stats": list(self._checkpoint_stats),
+            #: failed-checkpoint counters + tolerable budget (the
+            #: CheckpointFailureManager view) and restart count
+            "checkpoints": checkpoints,
+            "failed_checkpoints": self.failure_manager.num_failed(),
+            "restarts": self._restarts,
             "exception_history": list(self._exception_history),
             "failure": self._failed,
         }

@@ -22,6 +22,8 @@ import traceback
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
 
+from flink_tpu.testing import chaos
+
 
 class RpcTimeout(Exception):
     pass
@@ -112,6 +114,11 @@ class Gateway:
             raise AttributeError(item)
 
         def call(*args, **kwargs) -> Future:
+            # fault point: a dropped RPC never reaches the mailbox — the
+            # caller's future stays pending (timeout at await_future), the
+            # lost-message model; fail schedules raise synchronously
+            if not chaos.fire("rpc.call", endpoint=ep.name, method=item):
+                return Future()
             return ep.call_async(lambda: method(*args, **kwargs))
 
         return call

@@ -36,6 +36,7 @@ from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
 from flink_tpu.runtime.executor import WatermarkValve
+from flink_tpu.testing import chaos
 
 
 class TaskStates:
@@ -131,8 +132,12 @@ class SubtaskBase:
                 # data and end-of-input effects are already reflected in
                 # every downstream snapshot of the same checkpoint — only
                 # the channel-termination signal must be replayed, or
-                # downstream restored tasks would wait forever
+                # downstream restored tasks would wait forever.  The state
+                # must still be MATERIALIZED in the operator instance:
+                # terminal collection (chained collect sinks) reads rows
+                # from the live operator, not from the snapshot dict
                 self.final_snapshot = dict(self._restore)
+                self._open_and_restore()
                 self._transition(TaskStates.RUNNING)
                 self._emit([EndOfInput()])
                 self._transition(TaskStates.FINISHED)
@@ -275,6 +280,10 @@ class SourceSubtask(SubtaskBase):
                 break
             self._emitted += 1
             if isinstance(el, RecordBatch):
+                # fault point: crash-mid-stream in the source thread (the
+                # task FAILs; the restart strategy drives recovery)
+                chaos.fire("subtask.run", task=self.vertex_uid,
+                           subtask=self.subtask_index)
                 self.records_in += len(el)
                 self._batches_since_marker = getattr(
                     self, "_batches_since_marker", 0) + 1
@@ -303,14 +312,28 @@ class SourceSubtask(SubtaskBase):
             if cmd[0] == "checkpoint":
                 cid = cmd[1]
                 from flink_tpu.operators.base import snapshot_scope
-                # drain async emissions downstream BEFORE the barrier
-                prep = getattr(self.operator,
-                               "prepare_snapshot_pre_barrier", None)
-                if prep is not None:
-                    self._emit(prep())
-                with snapshot_scope(cid):
-                    snap = {"operator": self.operator.snapshot_state(),
-                            "source_offset": self._emitted}
+                try:
+                    chaos.fire("subtask.snapshot", task=self.vertex_uid,
+                               subtask=self.subtask_index, checkpoint=cid)
+                    # drain async emissions downstream BEFORE the barrier
+                    prep = getattr(self.operator,
+                                   "prepare_snapshot_pre_barrier", None)
+                    if prep is not None:
+                        self._emit(prep())
+                    with snapshot_scope(cid):
+                        snap = {"operator": self.operator.snapshot_state(),
+                                "source_offset": self._emitted}
+                except _Cancel:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    # snapshot failure DECLINES the checkpoint instead of
+                    # killing the task (CheckpointException -> decline);
+                    # the barrier still flows so downstream alignment ends
+                    self._emit([CheckpointBarrier(cid, timestamp=0)])
+                    self.listener.decline_checkpoint(
+                        cid, self.vertex_uid, self.subtask_index,
+                        f"{type(e).__name__}: {e}")
+                    continue
                 if self.split_requester is not None:
                     # dynamic mode: the in-flight split AND consumed splits
                     # are reader state (the enumerator's own snapshot can
@@ -428,14 +451,24 @@ class Subtask(SubtaskBase):
             if self.unaligned and first:
                 # barrier overtakes: snapshot NOW, forward NOW
                 from flink_tpu.operators.base import snapshot_scope
-                prep = getattr(self.operator,
-                               "prepare_snapshot_pre_barrier", None)
-                if prep is not None:
-                    self._emit(prep())
-                with snapshot_scope(el.checkpoint_id):
-                    self._pending_snapshot = {
-                        "operator": self.operator.snapshot_state(),
-                        "valve": self._valve.snapshot()}
+                try:
+                    chaos.fire("subtask.snapshot", task=self.vertex_uid,
+                               subtask=self.subtask_index,
+                               checkpoint=el.checkpoint_id)
+                    prep = getattr(self.operator,
+                                   "prepare_snapshot_pre_barrier", None)
+                    if prep is not None:
+                        self._emit(prep())
+                    with snapshot_scope(el.checkpoint_id):
+                        self._pending_snapshot = {
+                            "operator": self.operator.snapshot_state(),
+                            "valve": self._valve.snapshot()}
+                except _Cancel:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    # decline at alignment completion (barrier still flows)
+                    self._pending_snapshot = None
+                    self._snapshot_error = f"{type(e).__name__}: {e}"
                 self._emit([el])
             self._maybe_complete_alignment()
         elif isinstance(el, EndOfInput):
@@ -478,6 +511,9 @@ class Subtask(SubtaskBase):
                 self._emit(self.operator.process_tagged(el.batch))
         elif isinstance(el, RecordBatch):
             if len(el):
+                # fault point: crash mid-stream in a consuming subtask
+                chaos.fire("subtask.run", task=self.vertex_uid,
+                           subtask=self.subtask_index)
                 self._emit_status_change(self._valve.record_activity(i))
                 self.records_in += len(el)
                 t0 = time.monotonic_ns()
@@ -511,7 +547,17 @@ class Subtask(SubtaskBase):
             self._pending_barrier = None
 
     def _take_checkpoint(self, barrier: CheckpointBarrier) -> None:
-        if self.unaligned and self._pending_snapshot is not None:
+        cid = barrier.checkpoint_id
+        if self.unaligned:
+            if self._pending_snapshot is None:
+                # first-arrival snapshot failed: decline now that every
+                # channel delivered the barrier (the recorded channel
+                # state belongs to the aborted checkpoint — drop it)
+                self._channel_state = []
+                self.listener.decline_checkpoint(
+                    cid, self.vertex_uid, self.subtask_index,
+                    getattr(self, "_snapshot_error", "snapshot failed"))
+                return
             snap = self._pending_snapshot
             snap["channel_state"] = list(self._channel_state)
             self._pending_snapshot = None
@@ -519,16 +565,27 @@ class Subtask(SubtaskBase):
             # barrier was already forwarded at first arrival
         else:
             from flink_tpu.operators.base import snapshot_scope
-            prep = getattr(self.operator,
-                           "prepare_snapshot_pre_barrier", None)
-            if prep is not None:
-                self._emit(prep())
-            with snapshot_scope(barrier.checkpoint_id):
-                snap = {"operator": self.operator.snapshot_state(),
-                        "valve": self._valve.snapshot()}
+            try:
+                chaos.fire("subtask.snapshot", task=self.vertex_uid,
+                           subtask=self.subtask_index, checkpoint=cid)
+                prep = getattr(self.operator,
+                               "prepare_snapshot_pre_barrier", None)
+                if prep is not None:
+                    self._emit(prep())
+                with snapshot_scope(cid):
+                    snap = {"operator": self.operator.snapshot_state(),
+                            "valve": self._valve.snapshot()}
+            except _Cancel:
+                raise
+            except Exception as e:  # noqa: BLE001
+                self._emit([barrier])   # downstream alignment must end
+                self.listener.decline_checkpoint(
+                    cid, self.vertex_uid, self.subtask_index,
+                    f"{type(e).__name__}: {e}")
+                return
             self._emit([barrier])
         self.listener.acknowledge_checkpoint(
-            barrier.checkpoint_id, self.vertex_uid, self.subtask_index, snap)
+            cid, self.vertex_uid, self.subtask_index, snap)
 
     def _drain_commands(self) -> None:
         while True:
@@ -553,3 +610,9 @@ class TaskListener:
                                subtask_index: int,
                                snapshot: Dict[str, Any]) -> None:
         pass
+
+    def decline_checkpoint(self, checkpoint_id: int, vertex_uid: str,
+                           subtask_index: int, error: str) -> None:
+        """A task could not snapshot (``declineCheckpoint`` RPC analog):
+        the coordinator aborts the pending checkpoint and charges it to
+        the CheckpointFailureManager's tolerable budget."""

@@ -199,7 +199,15 @@ _API_SASL_AUTHENTICATE = 36
 
 
 class KafkaError(Exception):
-    """Broker-reported protocol error (auth failures, fatal responses)."""
+    """Broker-reported protocol error (auth failures, fatal responses).
+    ``code`` carries the wire error code when the raiser knows it, so
+    recovery paths can distinguish benign replies (e.g. an EndTxn commit
+    replay answered INVALID_TXN_STATE because the tid aged out of the
+    committed-tids retention) from real failures."""
+
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
 
 _API_PRODUCE, _API_FETCH, _API_LIST_OFFSETS = 0, 1, 2
 _API_METADATA, _API_VERSIONS = 3, 18
@@ -1285,6 +1293,12 @@ class KafkaWireBroker:
                 if epoch != sess["epoch"]:
                     return reply_error(_ERR_INVALID_FETCH_SESSION_EPOCH)
                 sess["epoch"] += 1
+                # LRU: re-insert on each successful incremental fetch so
+                # the bounded-registry eviction below removes the least
+                # recently USED session, not the oldest ESTABLISHED — an
+                # actively-polling consumer is never spuriously evicted
+                self._fetch_sessions[session_id] = \
+                    self._fetch_sessions.pop(session_id)
                 for t, p in forgotten:
                     sess["parts"].pop((t, p), None)
                 for t, p, o, mb in req_parts:   # adds AND offset updates
@@ -1568,7 +1582,7 @@ class KafkaWireClient:
         r.int32()                               # throttle
         err = r.int16()
         if err:
-            raise KafkaError(f"EndTxn error {err}")
+            raise KafkaError(f"EndTxn error {err}", code=err)
 
     def list_transactions(self) -> List[Tuple[str, int, int, str]]:
         """-> [(transactional_id, producer_id, epoch, state)] of every
@@ -1660,6 +1674,7 @@ class KafkaExactlyOnceSink:
         self.buffer_rows = buffer_rows
         self._client: Optional[KafkaWireClient] = None
         self._subtask_index = 0
+        self._parallelism = 1
         self._epoch = 0
         self._txn: Optional[Tuple[str, int, int]] = None  # (tid, pid, ep)
         self._staged: List[Tuple[str, int, int, Optional[int]]] = []
@@ -1672,6 +1687,7 @@ class KafkaExactlyOnceSink:
 
     def open(self, ctx) -> None:
         self._subtask_index = getattr(ctx, "subtask_index", 0)
+        self._parallelism = max(1, getattr(ctx, "parallelism", 1) or 1)
         self._cli()
 
     def _tid(self, epoch: int) -> str:
@@ -1757,12 +1773,38 @@ class KafkaExactlyOnceSink:
         c = self._cli()
         committed = set()
         for tid, pid, pepoch, _cid in snap.get("staged", []):
-            c.end_txn(tid, pid, pepoch, commit=True)   # idempotent replay
+            try:
+                c.end_txn(tid, pid, pepoch, commit=True)  # idempotent replay
+            except KafkaError as e:
+                if e.code != _ERR_INVALID_TXN_STATE:
+                    raise
+                # the tid aged out of the broker's committed-tids retention
+                # window: the commit already happened long ago — recovery
+                # proceeds idempotently instead of wedging
             committed.add(tid)
         self._staged = []
         mine = f"{self.sink_id}-s{self._subtask_index}-"
+        #: scale-down sweep (FlinkKafkaProducer's abort of removed
+        #: subtasks' transactions): subtask 0 also aborts dangling
+        #: pre-commits whose owner index no longer exists at the NEW
+        #: parallelism — otherwise their staged state leaks at the broker
+        #: forever (no surviving subtask would ever match their prefix).
+        #: CAVEAT: snapshots are index-restored, not union-redistributed —
+        #: a removed subtask's staged (pre-committed) txn from a COMPLETED
+        #: checkpoint has no surviving replayer, so the sweep aborts it;
+        #: scale down only after a final checkpoint's notify round, or
+        #: drain first (same operational rule as FlinkKafkaProducer before
+        #: union-state recovery existed)
+        sweep_all = f"{self.sink_id}-s"
         for tid, pid, pepoch, _state in c.list_transactions():
-            if not tid or not tid.startswith(mine) or tid in committed:
+            if not tid or tid in committed:
+                continue
+            abort = tid.startswith(mine)
+            if not abort and self._subtask_index == 0 \
+                    and tid.startswith(sweep_all):
+                idx_s = tid[len(sweep_all):].split("-", 1)[0]
+                abort = idx_s.isdigit() and int(idx_s) >= self._parallelism
+            if not abort:
                 continue
             try:
                 c.end_txn(tid, pid, pepoch, commit=False)

@@ -143,7 +143,9 @@ class StreamExecutionEnvironment:
                         restore: Optional[Dict[str, Any]] = None,
                         checkpoint_interval_ms: Optional[int] = None,
                         storage=None, unaligned: bool = False,
-                        restart_attempts: int = 0, timeout_s: float = 300.0):
+                        restart_attempts: int = 0, timeout_s: float = 300.0,
+                        tolerable_failed_checkpoints: int = 0,
+                        checkpoint_timeout_s: float = 60.0):
         """Run on the in-process MiniCluster with REAL parallelism (one
         thread per subtask, channels + partitioners between them) — the
         multi-node semantics path (``MiniCluster.java`` analog)."""
@@ -155,7 +157,9 @@ class StreamExecutionEnvironment:
             checkpoint_interval_ms=(
                 checkpoint_interval_ms if checkpoint_interval_ms is not None
                 else self.checkpoint_interval_ms),
-            unaligned=unaligned, restart_attempts=restart_attempts)
+            unaligned=unaligned, restart_attempts=restart_attempts,
+            tolerable_failed_checkpoints=tolerable_failed_checkpoints,
+            checkpoint_timeout_s=checkpoint_timeout_s)
         self._last_cluster = cluster
         return cluster.execute(plan, restore=restore, timeout_s=timeout_s)
 

@@ -27,6 +27,8 @@ BUSY_TIME = "busyTimeMsPerSecond"
 NUM_RESTARTS = "numRestarts"
 CHECKPOINT_DURATION = "lastCheckpointDuration"
 CHECKPOINT_SIZE = "lastCheckpointSize"
+NUM_COMPLETED_CHECKPOINTS = "numberOfCompletedCheckpoints"
+NUM_FAILED_CHECKPOINTS = "numberOfFailedCheckpoints"
 
 
 class MetricGroup:
@@ -176,3 +178,15 @@ class OperatorIOMetrics:
         self.records_out = group.counter(NUM_RECORDS_OUT)
         self.late_dropped = group.counter(NUM_LATE_RECORDS_DROPPED)
         self.watermark = group.gauge(CURRENT_WATERMARK)
+
+
+def job_checkpoint_metrics(group: MetricGroup, failure_manager,
+                           restarts_supplier: Callable[[], int]) -> MetricGroup:
+    """Register a CheckpointFailureManager's lifetime counters + the restart
+    count on a job-scope group (``CheckpointStatsTracker`` /
+    ``numRestarts`` analogs) so reporters export them; returns the group."""
+    group._register(NUM_COMPLETED_CHECKPOINTS,
+                    failure_manager.completed_counter)
+    group._register(NUM_FAILED_CHECKPOINTS, failure_manager.failed_counter)
+    group.gauge(NUM_RESTARTS, restarts_supplier)
+    return group

@@ -1,13 +1,23 @@
+from flink_tpu.runtime.checkpoint.failure import (
+    CheckpointFailureManager,
+    CheckpointFailureReason,
+)
 from flink_tpu.runtime.checkpoint.storage import (
+    CorruptCheckpointError,
     FileCheckpointStorage,
     InMemoryCheckpointStorage,
+    RetryingCheckpointStorage,
     read_savepoint,
     write_savepoint,
 )
 
 __all__ = [
+    "CheckpointFailureManager",
+    "CheckpointFailureReason",
+    "CorruptCheckpointError",
     "FileCheckpointStorage",
     "InMemoryCheckpointStorage",
+    "RetryingCheckpointStorage",
     "read_savepoint",
     "write_savepoint",
 ]

@@ -41,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from flink_tpu.runtime.checkpoint.storage import (FORMAT_VERSION, _to_numpy)
+from flink_tpu.testing import chaos
 
 
 class ObjectStoreServer:
@@ -333,6 +334,7 @@ class ObjectStoreCheckpointStorage:
         return f"{self.prefix}chk-{cid}/_metadata.json"
 
     def store(self, checkpoint_id: int, snapshot: Dict[str, Any]) -> None:
+        chaos.fire("checkpoint.store", checkpoint_id=checkpoint_id)
         uids = []
         for uid, op_snap in snapshot.items():
             fname = f"op-{len(uids)}.pkl"
@@ -363,6 +365,7 @@ class ObjectStoreCheckpointStorage:
         return sorted(out)
 
     def load(self, checkpoint_id: int) -> Dict[str, Any]:
+        chaos.fire("checkpoint.load", checkpoint_id=checkpoint_id)
         meta = json.loads(self.client.get(self._meta_key(checkpoint_id)))
         if meta["version"] > FORMAT_VERSION:
             raise ValueError(f"checkpoint format {meta['version']} too new")

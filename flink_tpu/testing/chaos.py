@@ -1,0 +1,376 @@
+"""Seeded, deterministic fault injection for the runtime's chaos tests.
+
+Analog of the reference's jepsen harness (``flink-jepsen/src/jepsen/flink/
+nemesis.clj``) folded into the library: the runtime exposes **named fault
+points** — ``checkpoint.store`` / ``checkpoint.load`` (storage layer),
+``channel.send`` (data plane), ``rpc.call`` (control plane),
+``heartbeat.deliver`` (liveness), ``subtask.run`` / ``subtask.snapshot``
+(task threads) — each a near-zero-cost :func:`fire` call that consults the
+installed :class:`FaultInjector`.  Tests attach *schedules*
+(fail-K-times-then-succeed, crash-once-at-N, delay-by-D,
+partition-until-healed, seeded probabilistic failure) to points and get a
+reproducible failure sequence: schedules keyed by per-point counters (and
+per-point RNGs derived from the injector seed) produce identical action
+histories on every run regardless of thread interleaving elsewhere.
+
+:class:`FreezableProxy` (promoted out of ``tests/test_nemesis.py``) is the
+TCP-level injector for real-socket paths — a one-link network partition
+where bytes neither flow nor error while both endpoints stay up.
+
+Usage::
+
+    inj = FaultInjector(seed=7)
+    inj.inject("checkpoint.store", FailTimes(2))
+    with installed(inj):
+        cluster.execute(plan)
+    assert inj.history("checkpoint.store")[:2] == ["fail", "fail"]
+
+This module imports only the standard library so every runtime layer can
+call :func:`fire` without import cycles or overhead when no injector is
+installed.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+__all__ = [
+    "InjectedFault", "FaultSchedule", "FailTimes", "CrashOnceAt", "DelayBy",
+    "ActionSequence", "Partition", "FailWithProbability", "FaultInjector",
+    "FreezableProxy", "install", "uninstall", "installed", "fire", "active",
+    "blocked",
+]
+
+#: actions a schedule may return for one firing
+OK = "ok"          # proceed normally
+FAIL = "fail"      # raise InjectedFault at the fault point
+DROP = "drop"      # suppress delivery (heartbeats) / stall the link (channels)
+Action = Union[str, Tuple[str, float]]   # ("delay", seconds) is the 4th kind
+
+
+class InjectedFault(RuntimeError):
+    """The error raised at a firing fault point (schedule said ``fail``)."""
+
+
+class FaultSchedule:
+    """Maps the 1-based firing count of a point to an action.
+
+    Subclasses implement :meth:`action`; they must be pure functions of
+    ``(n, rng)`` (plus their own construction parameters and explicit
+    state transitions like :meth:`Partition.heal`) so the same seed yields
+    the same failure sequence on every run."""
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        raise NotImplementedError
+
+    def dropping(self) -> bool:
+        """Is the schedule in a PERSISTENT drop state right now?  Polled by
+        stalled senders (via :func:`blocked`) without advancing the firing
+        counter.  Default False: a one-shot ``drop`` from a sequence is a
+        momentary loss, not a stall — only :class:`Partition` keeps a link
+        down until explicitly healed."""
+        return False
+
+
+class FailTimes(FaultSchedule):
+    """Fail the first ``k`` firings, then succeed forever — the transient
+    storage-flake model (retry/backoff must absorb exactly ``k`` errors)."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        return FAIL if n <= self.k else OK
+
+
+class CrashOnceAt(FaultSchedule):
+    """Fail exactly the ``n``-th firing (1-based), once — crash-at-
+    checkpoint-N / crash-mid-window."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        return FAIL if n == self.n else OK
+
+
+class DelayBy(FaultSchedule):
+    """Delay each firing by ``seconds`` (the first ``times`` firings when
+    given) — slow-disk / slow-network injection."""
+
+    def __init__(self, seconds: float, times: Optional[int] = None):
+        self.seconds = seconds
+        self.times = times
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        if self.times is not None and n > self.times:
+            return OK
+        return ("delay", self.seconds)
+
+
+class ActionSequence(FaultSchedule):
+    """Explicit per-firing script (``["ok", "fail", "fail"]``), then
+    ``then`` forever — arbitrary deterministic scenarios."""
+
+    def __init__(self, actions: Sequence[Action], then: Action = OK):
+        self.actions = list(actions)
+        self.then = then
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        return self.actions[n - 1] if n <= len(self.actions) else self.then
+
+
+class Partition(FaultSchedule):
+    """Suppress delivery until healed (``drop`` while active) — the
+    logical-link partition; :class:`FreezableProxy` is its TCP twin."""
+
+    def __init__(self, active: bool = True):
+        self._active = threading.Event()
+        if active:
+            self._active.set()
+
+    def partition(self) -> None:
+        self._active.set()
+
+    def heal(self) -> None:
+        self._active.clear()
+
+    @property
+    def healed(self) -> bool:
+        return not self._active.is_set()
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        return DROP if self._active.is_set() else OK
+
+    def dropping(self) -> bool:
+        return self._active.is_set()
+
+
+class FailWithProbability(FaultSchedule):
+    """Fail each firing with probability ``p`` — drawn from the point's own
+    seeded RNG, so the sequence is a pure function of (seed, point)."""
+
+    def __init__(self, p: float):
+        self.p = p
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        return FAIL if rng.random() < self.p else OK
+
+
+class FaultInjector:
+    """Registry of fault points -> schedules with a deterministic seed.
+
+    Each point gets its own firing counter, its own ``random.Random``
+    seeded from ``f"{seed}:{point}"``, and its own action history — two
+    runs with the same seed and schedules produce identical per-point
+    histories no matter how unrelated threads interleave."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._schedules: Dict[str, FaultSchedule] = {}
+        self._counts: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        self._history: Dict[str, List[Action]] = {}
+
+    def inject(self, point: str, schedule: FaultSchedule) -> FaultSchedule:
+        """Attach ``schedule`` to ``point`` (replacing any previous one);
+        returns the schedule for later control (e.g. ``Partition.heal``)."""
+        with self._lock:
+            self._schedules[point] = schedule
+            self._counts.setdefault(point, 0)
+            self._history.setdefault(point, [])
+        return schedule
+
+    def clear(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            if point is None:
+                self._schedules.clear()
+            else:
+                self._schedules.pop(point, None)
+
+    def fire(self, point: str, **ctx) -> bool:
+        """Consult the point's schedule: returns True to proceed, False to
+        suppress delivery (``drop``), sleeps on ``delay``, raises
+        :class:`InjectedFault` on ``fail``."""
+        with self._lock:
+            sched = self._schedules.get(point)
+            if sched is None:
+                return True
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            rng = self._rngs.get(point)
+            if rng is None:
+                rng = self._rngs[point] = random.Random(
+                    f"{self.seed}:{point}")
+            act = sched.action(n, rng)
+            self._history.setdefault(point, []).append(act)
+        if act == OK:
+            return True
+        if act == DROP:
+            return False
+        if isinstance(act, tuple) and act[0] == "delay":
+            time.sleep(act[1])
+            return True
+        raise InjectedFault(f"injected fault at {point} (firing {n}, "
+                            f"ctx={ctx or {}})")
+
+    def blocked(self, point: str) -> bool:
+        """Is the point's schedule in a persistent drop state?  The poll
+        primitive for partition-style stalls: a blocked sender re-checks
+        until :meth:`Partition.heal` without advancing the firing counter,
+        RNG or history — stall duration never corrupts determinism.  A
+        one-shot ``drop`` (e.g. from an :class:`ActionSequence`) reads as
+        not-blocked, so it delays a sender momentarily instead of hanging
+        it forever."""
+        with self._lock:
+            sched = self._schedules.get(point)
+        return sched is not None and sched.dropping()
+
+    def history(self, point: Optional[str] = None):
+        """Recorded action sequence of one point (or all points) — the
+        determinism contract: compare across runs with the same seed."""
+        with self._lock:
+            if point is not None:
+                return list(self._history.get(point, []))
+            return {p: list(h) for p, h in self._history.items()}
+
+    def fired(self, point: str) -> int:
+        with self._lock:
+            return self._counts.get(point, 0)
+
+
+# ---------------------------------------------------------------------------
+# global hook — the runtime's fault points call fire(); no injector = no-op
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _ACTIVE
+    _ACTIVE = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+@contextmanager
+def installed(injector: FaultInjector):
+    """``with chaos.installed(inj): ...`` — scoped installation; always
+    uninstalls, so one test's faults never leak into the next."""
+    install(injector)
+    try:
+        yield injector
+    finally:
+        uninstall()
+
+
+def fire(point: str, **ctx) -> bool:
+    """The runtime-side hook: near-zero cost when no injector is installed."""
+    inj = _ACTIVE
+    if inj is None:
+        return True
+    return inj.fire(point, **ctx)
+
+
+def blocked(point: str) -> bool:
+    """Poll a dropped point without re-firing it (counter/RNG/history stay
+    untouched): a stalled sender loops on this until the partition heals."""
+    inj = _ACTIVE
+    return inj is not None and inj.blocked(point)
+
+
+# ---------------------------------------------------------------------------
+# TCP-level injector (promoted from tests/test_nemesis.py)
+# ---------------------------------------------------------------------------
+
+class FreezableProxy:
+    """TCP proxy that can stop forwarding bytes (packets 'drop' while both
+    endpoints' sockets stay open) — a one-link network partition.
+
+    Interpose it on a component's path to a real-socket service (object
+    store, Kafka broker, worker control plane) and call :meth:`freeze` /
+    :meth:`heal`; iptables-free, in-process, deterministic."""
+
+    def __init__(self, target_host: str, target_port: int):
+        self.target = (target_host, target_port)
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._frozen = threading.Event()
+        self._stop = threading.Event()
+        self._threads = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def freeze(self) -> None:
+        self._frozen.set()
+
+    def heal(self) -> None:
+        self._frozen.clear()
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(self.target, timeout=5)
+            except OSError:
+                conn.close()
+                continue
+            for a, b in ((conn, up), (up, conn)):
+                t = threading.Thread(target=self._pump, args=(a, b),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        src.settimeout(0.2)
+        while not self._stop.is_set():
+            if self._frozen.is_set():
+                # partition: bytes neither flow nor error — both sides hang
+                time.sleep(0.05)
+                continue
+            try:
+                data = src.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass

@@ -1,0 +1,566 @@
+"""Chaos suite: the fault-injection subsystem end-to-end.
+
+Exercises ``flink_tpu.testing.chaos`` against the runtime's named fault
+points — ``checkpoint.store``/``checkpoint.load`` with the
+``RetryingCheckpointStorage`` + ``CheckpointFailureManager`` policy stack,
+``heartbeat.deliver`` partitions, ``rpc.call`` drops, ``channel.send``
+delays — plus the hardened ``FileCheckpointStorage`` commit protocol
+(torn/truncated/corrupt checkpoints skipped by ``load_latest``).
+
+Reference: ``flink-jepsen`` nemeses + ``CheckpointFailureManagerTest.java``
++ ``CheckpointCoordinatorFailureTest.java`` semantics.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.heartbeat import HeartbeatManager, HeartbeatTarget
+from flink_tpu.cluster.channels import LocalChannel
+from flink_tpu.cluster.rpc import Gateway, RpcEndpoint
+from flink_tpu.cluster.task import TaskStates
+from flink_tpu.core.batch import RecordBatch
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.runtime.checkpoint.failure import (CheckpointFailureManager,
+                                                  CheckpointFailureReason)
+from flink_tpu.runtime.checkpoint.storage import (CorruptCheckpointError,
+                                                  FileCheckpointStorage,
+                                                  InMemoryCheckpointStorage,
+                                                  RetryingCheckpointStorage)
+from flink_tpu.testing import chaos
+from flink_tpu.testing.chaos import (ActionSequence, CrashOnceAt, DelayBy,
+                                     FailTimes, FailWithProbability,
+                                     FaultInjector, InjectedFault, Partition)
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    """One test's faults must never leak into the next."""
+    yield
+    chaos.uninstall()
+
+
+def _expected_sums(keys, vals):
+    out = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        out[int(k)] = out.get(int(k), 0.0) + v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# schedules + injector determinism (fast tier)
+# ---------------------------------------------------------------------------
+
+def test_fire_is_noop_without_injector():
+    assert chaos.fire("checkpoint.store") is True
+    assert chaos.active() is None
+
+
+def test_fail_times_then_succeed():
+    inj = chaos.install(FaultInjector(seed=1))
+    inj.inject("p", FailTimes(2))
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            chaos.fire("p")
+    assert chaos.fire("p") is True
+    assert inj.history("p") == ["fail", "fail", "ok"]
+
+
+def test_crash_once_at_n():
+    inj = chaos.install(FaultInjector())
+    inj.inject("p", CrashOnceAt(3))
+    assert chaos.fire("p") and chaos.fire("p")
+    with pytest.raises(InjectedFault):
+        chaos.fire("p")
+    assert chaos.fire("p") is True
+    assert inj.history("p") == ["ok", "ok", "fail", "ok"]
+
+
+def test_action_sequence_script():
+    inj = chaos.install(FaultInjector())
+    inj.inject("p", ActionSequence(["ok", "fail"], then="ok"))
+    assert chaos.fire("p")
+    with pytest.raises(InjectedFault):
+        chaos.fire("p")
+    assert chaos.fire("p") and chaos.fire("p")
+
+
+def test_seeded_probability_reproducible():
+    """The determinism contract: same seed -> identical action history."""
+    def run(seed):
+        inj = FaultInjector(seed=seed)
+        inj.inject("p", FailWithProbability(0.4))
+        with chaos.installed(inj):
+            for _ in range(64):
+                try:
+                    chaos.fire("p")
+                except InjectedFault:
+                    pass
+        return inj.history("p")
+
+    h1, h2 = run(seed=42), run(seed=42)
+    assert h1 == h2
+    assert "fail" in h1 and "ok" in h1      # p=0.4 over 64 draws
+    assert run(seed=43) != h1               # a different seed diverges
+
+
+def test_per_point_counters_and_rngs_are_independent():
+    inj = chaos.install(FaultInjector(seed=7))
+    inj.inject("a", FailTimes(1))
+    inj.inject("b", FailTimes(1))
+    with pytest.raises(InjectedFault):
+        chaos.fire("a")
+    # point b has its own counter: its first firing still fails
+    with pytest.raises(InjectedFault):
+        chaos.fire("b")
+    assert inj.fired("a") == 1 and inj.fired("b") == 1
+
+
+def test_installed_context_manager_scopes_faults():
+    inj = FaultInjector()
+    inj.inject("p", FailTimes(100))
+    with chaos.installed(inj):
+        with pytest.raises(InjectedFault):
+            chaos.fire("p")
+    assert chaos.fire("p") is True          # uninstalled on exit
+
+
+# ---------------------------------------------------------------------------
+# CheckpointFailureManager policy (fast tier)
+# ---------------------------------------------------------------------------
+
+def test_failure_manager_tolerates_then_trips():
+    fm = CheckpointFailureManager(tolerable_failed_checkpoints=2)
+    assert fm.on_checkpoint_failure(CheckpointFailureReason.DECLINED, 1) is False
+    assert fm.on_checkpoint_failure(CheckpointFailureReason.TIMEOUT, 2) is False
+    assert fm.on_checkpoint_failure(CheckpointFailureReason.STORAGE, 3) is True
+    assert fm.num_failed() == 3
+    st = fm.status()
+    assert st["continuous_failed_checkpoints"] == 3
+    assert st["last_failure_reason"] == CheckpointFailureReason.STORAGE
+
+
+def test_failure_manager_success_resets_continuous_window():
+    fm = CheckpointFailureManager(tolerable_failed_checkpoints=1)
+    assert fm.on_checkpoint_failure(CheckpointFailureReason.DECLINED, 1) is False
+    fm.on_checkpoint_success(2)
+    # the window restarted: one more failure is tolerated again
+    assert fm.on_checkpoint_failure(CheckpointFailureReason.DECLINED, 3) is False
+    assert fm.on_checkpoint_failure(CheckpointFailureReason.DECLINED, 4) is True
+    assert fm.num_failed() == 3 and fm.num_completed() == 1
+
+
+def test_failure_manager_unlimited_never_trips():
+    fm = CheckpointFailureManager(CheckpointFailureManager.UNLIMITED)
+    for cid in range(50):
+        assert fm.on_checkpoint_failure(CheckpointFailureReason.STORAGE,
+                                        cid) is False
+
+
+def test_failure_manager_restart_resets_window():
+    fm = CheckpointFailureManager(tolerable_failed_checkpoints=1)
+    fm.on_checkpoint_failure(CheckpointFailureReason.STORAGE, 1)
+    fm.on_job_restart()
+    assert fm.continuous_failures == 0
+    assert fm.num_failed() == 1             # lifetime counter survives
+
+
+# ---------------------------------------------------------------------------
+# RetryingCheckpointStorage (fast tier)
+# ---------------------------------------------------------------------------
+
+def test_retrying_storage_absorbs_transient_flakes():
+    inj = chaos.install(FaultInjector())
+    inj.inject("checkpoint.store", FailTimes(2))
+    sleeps = []
+    st = RetryingCheckpointStorage(InMemoryCheckpointStorage(),
+                                   max_attempts=3, initial_backoff_ms=10,
+                                   sleep=sleeps.append)
+    st.store(1, {"op": {"total": 1.0}})     # 2 flakes absorbed by retries
+    assert st.retries == 2
+    assert sleeps == [0.01, 0.02]           # bounded exponential backoff
+    assert st.load_latest() == {"op": {"total": 1.0}}
+    assert inj.history("checkpoint.store") == ["fail", "fail", "ok"]
+
+
+def test_retrying_storage_backoff_is_capped():
+    inj = chaos.install(FaultInjector())
+    inj.inject("checkpoint.store", FailTimes(4))
+    sleeps = []
+    st = RetryingCheckpointStorage(InMemoryCheckpointStorage(),
+                                   max_attempts=5, initial_backoff_ms=100,
+                                   multiplier=10.0, max_backoff_ms=250,
+                                   sleep=sleeps.append)
+    st.store(1, {"op": {}})
+    assert sleeps == [0.1, 0.25, 0.25, 0.25]
+
+
+def test_retrying_storage_gives_up_past_max_attempts():
+    inj = chaos.install(FaultInjector())
+    inj.inject("checkpoint.store", FailTimes(10))
+    st = RetryingCheckpointStorage(InMemoryCheckpointStorage(),
+                                   max_attempts=3, sleep=lambda s: None)
+    with pytest.raises(InjectedFault):
+        st.store(1, {"op": {}})
+    assert inj.fired("checkpoint.store") == 3
+
+
+def test_retrying_storage_never_retries_corruption(tmp_path):
+    st = FileCheckpointStorage(str(tmp_path))
+    st.store(1, {"op": {"x": 1}})
+    meta = st.metadata(1)
+    path = os.path.join(str(tmp_path), "chk-1", meta["operators"][0]["file"])
+    with open(path, "r+b") as f:
+        f.truncate(4)                        # torn write
+    attempts = []
+    wrapped = RetryingCheckpointStorage(st, max_attempts=5,
+                                        sleep=attempts.append)
+    with pytest.raises(CorruptCheckpointError):
+        wrapped.load(1)
+    assert attempts == []                    # a bad checksum never heals
+
+
+# ---------------------------------------------------------------------------
+# hardened FileCheckpointStorage commit protocol (fast tier)
+# ---------------------------------------------------------------------------
+
+def _file_of(st, cid, idx=0):
+    return os.path.join(st.base_dir, f"chk-{cid}",
+                        st.metadata(cid)["operators"][idx]["file"])
+
+
+def test_torn_checkpoint_is_skipped_by_load_latest(tmp_path):
+    st = FileCheckpointStorage(str(tmp_path))
+    st.store(1, {"op": {"total": 1.0}})
+    st.store(2, {"op": {"total": 2.0}})
+    with open(_file_of(st, 2), "r+b") as f:
+        f.truncate(8)                        # torn write survives a rename
+    with pytest.raises(CorruptCheckpointError, match="torn write"):
+        st.load(2)
+    # latest INTACT checkpoint served — corrupt one silently skipped
+    assert st.load_latest() == {"op": {"total": 1.0}}
+
+
+def test_checksum_mismatch_detected(tmp_path):
+    st = FileCheckpointStorage(str(tmp_path))
+    st.store(1, {"op": {"total": 7.0}})
+    path = _file_of(st, 1)
+    data = bytearray(open(path, "rb").read())
+    data[-1] ^= 0xFF                         # same size, flipped bits
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        st.load(1)
+    assert st.load_latest() is None
+
+
+def test_unreadable_metadata_is_corrupt_not_fatal(tmp_path):
+    st = FileCheckpointStorage(str(tmp_path))
+    st.store(1, {"op": {"total": 1.0}})
+    st.store(2, {"op": {"total": 2.0}})
+    with open(os.path.join(str(tmp_path), "chk-2", "_metadata.json"),
+              "w") as f:
+        f.write("{ torn json")
+    assert st.load_latest() == {"op": {"total": 1.0}}
+
+
+def test_crash_mid_write_leaves_only_staging_dir(tmp_path):
+    inj = chaos.install(FaultInjector())
+    st = FileCheckpointStorage(str(tmp_path))
+    st.store(1, {"op": {"total": 1.0}})
+    # crash before the atomic publish: the fault point fires at store()
+    # entry of checkpoint 2, so nothing of chk-2 is ever visible
+    inj.inject("checkpoint.store", CrashOnceAt(1))
+    with pytest.raises(InjectedFault):
+        st.store(2, {"op": {"total": 2.0}})
+    chaos.uninstall()
+    assert st.checkpoint_ids() == [1]
+    assert st.load_latest() == {"op": {"total": 1.0}}
+    # a leftover chk-N.inprogress staging dir is ignored entirely
+    os.makedirs(os.path.join(str(tmp_path), "chk-3.inprogress"))
+    assert st.checkpoint_ids() == [1]
+
+
+# ---------------------------------------------------------------------------
+# control-plane fault points: heartbeat partition, rpc drop, channel delay
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_partition_false_suspects_then_heals():
+    inj = chaos.install(FaultInjector())
+    dead = []
+    hb = HeartbeatManager(interval_s=0.03, timeout_s=0.12,
+                          on_timeout=dead.append)
+    # the target is perfectly alive: it answers every request instantly
+    hb.monitor_target("tm-1", HeartbeatTarget(
+        lambda: hb.receive_heartbeat("tm-1")))
+    part = inj.inject("heartbeat.deliver", Partition())
+    hb.start()
+    try:
+        deadline = time.monotonic() + 3.0
+        while "tm-1" not in dead and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # its heartbeats were dropped on the floor -> falsely suspected
+        assert dead == ["tm-1"]
+        part.heal()
+        hb.monitor_target("tm-1", HeartbeatTarget(
+            lambda: hb.receive_heartbeat("tm-1")))
+        time.sleep(0.3)                      # several timeout periods
+        assert dead == ["tm-1"]              # healed link: no new suspicion
+    finally:
+        hb.stop()
+
+
+def test_rpc_drop_loses_message_fail_raises():
+    class Echo(RpcEndpoint):
+        def ping(self, x):
+            return x
+
+    ep = Echo("echo")
+    ep.start()
+    try:
+        gw = Gateway(ep)
+        inj = chaos.install(FaultInjector())
+        inj.inject("rpc.call", ActionSequence([chaos.DROP, chaos.OK]))
+        lost = gw.ping(1)                   # dropped: never reaches mailbox
+        assert gw.ping(2).result(timeout=5) == 2
+        assert not lost.done()              # the lost-message model
+        # the point's firing counter survives schedule replacement: the
+        # next (third) firing is the one to target
+        inj.inject("rpc.call", CrashOnceAt(3))
+        with pytest.raises(InjectedFault):
+            gw.ping(3)                      # fail schedules raise at call
+    finally:
+        ep.stop()
+
+
+def test_channel_delay_schedule_slows_put():
+    inj = chaos.install(FaultInjector())
+    inj.inject("channel.send", DelayBy(0.05, times=1))
+    ch = LocalChannel(capacity=4, name="c0")
+    t0 = time.monotonic()
+    ch.put(RecordBatch({"v": np.asarray([1.0])}))
+    assert time.monotonic() - t0 >= 0.05    # first put delayed
+    t1 = time.monotonic()
+    ch.put(RecordBatch({"v": np.asarray([2.0])}))
+    assert time.monotonic() - t1 < 0.05     # schedule exhausted
+
+
+def test_channel_partition_stalls_until_closed():
+    inj = chaos.install(FaultInjector())
+    part = inj.inject("channel.send", Partition())
+    ch = LocalChannel(capacity=4, name="c0")
+    import threading
+    done = []
+    th = threading.Thread(
+        target=lambda: done.append(
+            ch.put(RecordBatch({"v": np.asarray([1.0])}))))
+    th.start()
+    time.sleep(0.05)
+    assert not done                          # bytes neither flow nor error
+    part.heal()
+    th.join(timeout=5)
+    assert done == [True]                    # healed link delivers
+    # determinism contract: the stall fired the point exactly ONCE no
+    # matter how long the partition lasted (the stall loop polls
+    # blocked(), it does not re-fire)
+    assert inj.fired("channel.send") == 1
+    assert inj.history("channel.send") == [chaos.DROP]
+
+
+def test_channel_partition_honors_put_timeout():
+    inj = chaos.install(FaultInjector())
+    inj.inject("channel.send", Partition())
+    ch = LocalChannel(capacity=4, name="c0")
+    t0 = time.monotonic()
+    ok = ch.put(RecordBatch({"v": np.asarray([1.0])}), timeout_s=0.1)
+    assert ok is False                       # bounded put gave up
+    assert 0.1 <= time.monotonic() - t0 < 2.0
+
+
+def test_latest_restore_survives_load_failure():
+    """A checkpoint.load fault during restart-recovery degrades to
+    no-restore instead of escaping the restart machinery."""
+    from flink_tpu.cluster.minicluster import MiniCluster
+
+    storage = InMemoryCheckpointStorage()
+    storage.store(1, {"op": {"x": 1}})
+    cluster = MiniCluster(checkpoint_storage=storage)
+    inj = chaos.install(FaultInjector())
+    inj.inject("checkpoint.load", FailTimes(1))
+    assert cluster.latest_restore() is None   # swallowed, not raised
+    assert cluster.latest_restore() == {"op": {"x": 1}}  # flake passed
+
+
+def test_job_checkpoint_metrics_exported():
+    """The failure manager's counters are registered on the cluster's
+    job-scope metric group (reporters attached to the registry see them)."""
+    from flink_tpu.cluster.minicluster import MiniCluster
+    from flink_tpu.metrics.groups import (NUM_COMPLETED_CHECKPOINTS,
+                                          NUM_FAILED_CHECKPOINTS,
+                                          NUM_RESTARTS)
+
+    cluster = MiniCluster()
+    names = {k.split(".")[-1]
+             for k in cluster.metrics_registry.all_metrics()}
+    assert {NUM_COMPLETED_CHECKPOINTS, NUM_FAILED_CHECKPOINTS,
+            NUM_RESTARTS} <= names
+    cluster.failure_manager.on_checkpoint_failure(
+        CheckpointFailureReason.STORAGE, 1)
+    metrics = cluster.metrics_registry.all_metrics()
+    failed = next(m for k, m in metrics.items()
+                  if k.endswith(NUM_FAILED_CHECKPOINTS))
+    assert failed.get_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: MiniCluster under chaos (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_transient_storage_flakes_absorbed_no_restart():
+    """Storage fails twice, the retry wrapper absorbs both: the job
+    finishes with ZERO restarts and ZERO failed checkpoints."""
+    inj = FaultInjector(seed=11)
+    inj.inject("checkpoint.store", FailTimes(2))
+    storage = RetryingCheckpointStorage(InMemoryCheckpointStorage(retain=10),
+                                        max_attempts=3, sleep=lambda s: None)
+    n = 30_000
+    keys = np.arange(n) % 13
+    vals = np.ones(n)
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    sink = (env.from_collection(columns={"k": keys, "v": vals},
+                                batch_size=128)
+            .key_by("k").sum("v").collect())
+    with chaos.installed(inj):
+        res = env.execute_cluster(storage=storage, checkpoint_interval_ms=5,
+                                  tolerable_failed_checkpoints=0)
+    assert res.state == TaskStates.FINISHED
+    assert res.restarts == 0
+    assert storage.retries >= 2
+    cluster = env._last_cluster
+    assert cluster.failure_manager.num_failed() == 0
+    assert res.completed_checkpoints
+    assert inj.history("checkpoint.store")[:3] == ["fail", "fail", "ok"]
+    final = _expected_sums(keys, vals)
+    got = {int(r["k"]): r["v"] for r in sink.rows()}
+    assert got == final
+
+
+@pytest.mark.slow
+def test_persistent_storage_failure_fails_over_and_recovers():
+    """Storage failures past tolerable_failed_checkpoints fail the job
+    over; the restart strategy recovers it from the last good checkpoint
+    (or from scratch) and final sums stay exactly-once."""
+    inj = FaultInjector(seed=12)
+    inj.inject("checkpoint.store", FailTimes(3))
+    storage = InMemoryCheckpointStorage(retain=10)     # no retry wrapper
+    n = 30_000
+    keys = np.arange(n) % 13
+    vals = np.ones(n)
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    sink = (env.from_collection(columns={"k": keys, "v": vals},
+                                batch_size=128)
+            .key_by("k").sum("v").collect())
+    with chaos.installed(inj):
+        res = env.execute_cluster(storage=storage, checkpoint_interval_ms=5,
+                                  restart_attempts=8,
+                                  tolerable_failed_checkpoints=0)
+    assert res.state == TaskStates.FINISHED
+    assert res.restarts >= 1, "budget exhaustion did not fail the job over"
+    cluster = env._last_cluster
+    status = cluster.job_status()
+    assert status["checkpoints"]["failed_checkpoints"] >= 1
+    assert status["checkpoints"]["tolerable_failed_checkpoints"] == 0
+    assert status["restarts"] == res.restarts
+    got = {int(r["k"]): r["v"] for r in sink.rows()}
+    assert got == _expected_sums(keys, vals)
+
+
+def _run_acceptance_scenario(seed):
+    """Transient storage flakes + a subtask crash mid-window; returns
+    (result, window-sum total, status, fail positions per point)."""
+    inj = FaultInjector(seed=seed)
+    inj.inject("checkpoint.store", FailTimes(2))
+    inj.inject("subtask.run", CrashOnceAt(60))
+    storage = InMemoryCheckpointStorage(retain=10)
+    rng = np.random.default_rng(seed)
+    n = 40_000
+    keys = rng.integers(0, 21, n)
+    vals = np.ones(n, dtype=np.float64)
+    ts = np.sort(rng.integers(0, 4000, n))
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    sink = (env.from_collection(columns={"k": keys, "v": vals, "t": ts},
+                                batch_size=128)
+            .assign_timestamps_and_watermarks(0, timestamp_column="t")
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(1000))
+            .sum("v").collect())
+    with chaos.installed(inj):
+        res = env.execute_cluster(storage=storage, checkpoint_interval_ms=5,
+                                  restart_attempts=4,
+                                  tolerable_failed_checkpoints=10)
+    total = sum(r["v"] for r in sink.rows())
+    fails = {p: [i for i, a in enumerate(h) if a == "fail"]
+             for p, h in inj.history().items()}
+    return res, total, env._last_cluster.job_status(), fails, float(vals.sum())
+
+
+@pytest.mark.slow
+def test_acceptance_storage_flake_then_crash_midwindow_exactly_once():
+    """The ISSUE acceptance scenario: checkpoint storage fails
+    transiently, then a subtask crashes mid-window; automatic failover
+    still yields exactly-once window sums, job_status() reports the
+    failed-checkpoint and restart counts, and the fault schedules are
+    deterministic under a fixed seed."""
+    res, total, status, fails, expect = _run_acceptance_scenario(seed=99)
+    assert res.state == TaskStates.FINISHED
+    assert res.restarts >= 1, "the injected crash did not trigger failover"
+    assert abs(total - expect) < 0.05, "window sums not exactly-once"
+    assert status["checkpoints"]["failed_checkpoints"] >= 1
+    assert status["restarts"] >= 1
+    assert status["failed_checkpoints"] == \
+        status["checkpoints"]["failed_checkpoints"]
+
+    # determinism: a second run with the same seed produces the same
+    # failure positions at every fault point
+    res2, total2, _status2, fails2, _ = _run_acceptance_scenario(seed=99)
+    assert res2.state == TaskStates.FINISHED
+    assert abs(total2 - expect) < 0.05
+    assert fails["checkpoint.store"] == fails2["checkpoint.store"] == [0, 1]
+    assert fails["subtask.run"] == fails2["subtask.run"] == [59]
+
+
+@pytest.mark.slow
+def test_snapshot_failure_declines_checkpoint_not_task():
+    """A snapshot error at a subtask DECLINES the checkpoint (charged to
+    the failure budget) instead of killing the task: with enough
+    tolerance the job still finishes without any restart."""
+    inj = FaultInjector(seed=13)
+    inj.inject("subtask.snapshot", FailTimes(1))
+    storage = InMemoryCheckpointStorage(retain=10)
+    n = 30_000
+    keys = np.arange(n) % 13
+    vals = np.ones(n)
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    sink = (env.from_collection(columns={"k": keys, "v": vals},
+                                batch_size=128)
+            .key_by("k").sum("v").collect())
+    with chaos.installed(inj):
+        res = env.execute_cluster(storage=storage, checkpoint_interval_ms=5,
+                                  tolerable_failed_checkpoints=10)
+    assert res.state == TaskStates.FINISHED
+    assert res.restarts == 0
+    cluster = env._last_cluster
+    assert cluster.failure_manager.num_failed() >= 1
+    assert cluster.failure_manager.status()["last_failure_reason"] == \
+        CheckpointFailureReason.DECLINED
+    got = {int(r["k"]): r["v"] for r in sink.rows()}
+    assert got == _expected_sums(keys, vals)

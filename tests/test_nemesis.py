@@ -6,11 +6,10 @@ new leader.
 
 Reference: ``flink-jepsen/src/jepsen/flink/nemesis.clj`` (partition
 nemeses) + ``checker.clj`` (availability model).  iptables-free: the
-partition is a freezable TCP proxy interposed on the leader's path.
+partition is a ``FreezableProxy`` (now part of the chaos library,
+``flink_tpu.testing.chaos``) interposed on the leader's path.
 """
 
-import socket
-import threading
 import time
 
 import pytest
@@ -18,81 +17,7 @@ import pytest
 from flink_tpu.cluster.ha import LeaseLeaderElection
 from flink_tpu.runtime.checkpoint.objectstore import (ObjectStoreClient,
                                                       ObjectStoreServer)
-
-
-class FreezableProxy:
-    """TCP proxy that can stop forwarding bytes (packets 'drop' while both
-    endpoints' sockets stay open) — a one-link network partition."""
-
-    def __init__(self, target_host: str, target_port: int):
-        self.target = (target_host, target_port)
-        self._srv = socket.create_server(("127.0.0.1", 0))
-        self.port = self._srv.getsockname()[1]
-        self.url = f"http://127.0.0.1:{self.port}"
-        self._frozen = threading.Event()
-        self._stop = threading.Event()
-        self._threads = []
-        t = threading.Thread(target=self._accept_loop, daemon=True)
-        t.start()
-        self._threads.append(t)
-
-    def freeze(self) -> None:
-        self._frozen.set()
-
-    def heal(self) -> None:
-        self._frozen.clear()
-
-    def _accept_loop(self) -> None:
-        self._srv.settimeout(0.2)
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._srv.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            try:
-                up = socket.create_connection(self.target, timeout=5)
-            except OSError:
-                conn.close()
-                continue
-            for a, b in ((conn, up), (up, conn)):
-                t = threading.Thread(target=self._pump, args=(a, b),
-                                     daemon=True)
-                t.start()
-                self._threads.append(t)
-
-    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
-        src.settimeout(0.2)
-        while not self._stop.is_set():
-            if self._frozen.is_set():
-                # partition: bytes neither flow nor error — both sides hang
-                time.sleep(0.05)
-                continue
-            try:
-                data = src.recv(65536)
-            except socket.timeout:
-                continue
-            except OSError:
-                break
-            if not data:
-                break
-            try:
-                dst.sendall(data)
-            except OSError:
-                break
-        for s in (src, dst):
-            try:
-                s.close()
-            except OSError:
-                pass
-
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+from flink_tpu.testing.chaos import FreezableProxy
 
 
 @pytest.fixture
