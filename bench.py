@@ -106,22 +106,55 @@ def make_batches(n_records: int, n_keys: int, batch_size: int, window_ms: int,
 
 
 def _build_op(window_ms: int, emit_tier: str = "host",
-              device_sync: str = "auto"):
+              device_sync: str = "auto", paging_cap: int = 0):
     import jax.numpy as jnp
 
     from flink_tpu.core.functions import RuntimeContext, SumAggregator
     from flink_tpu.operators.window_agg import WindowAggOperator
     from flink_tpu.windowing.assigners import TumblingEventTimeWindows
 
+    paging = None
+    if paging_cap:
+        from flink_tpu.state.paging import PagingConfig
+        paging = PagingConfig(capacity=paging_cap)
+        emit_tier = "device"   # paging pins the device tier
     op = WindowAggOperator(
         TumblingEventTimeWindows.of(window_ms), SumAggregator(jnp.float32),
         key_column="k", value_column="v",
         initial_key_capacity=1 << 20,
         emit_tier=emit_tier,
         snapshot_source="mirror" if emit_tier == "host" else "device",
-        device_sync=device_sync if emit_tier == "host" else "scatter")
+        device_sync=device_sync if emit_tier == "host" else "scatter",
+        paging=paging)
     op.open(RuntimeContext())
     return op
+
+
+def run_paged(batches, window_ms: int, checkpoint_every: int, cap: int):
+    """One full paged pass (device tier, K_cap = ``cap``): the cold-key
+    paging subsystem's cost + occupancy on the headline workload.  Returns
+    (records/sec, paging stats, phase dict)."""
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    op = _build_op(window_ms, paging_cap=cap)
+    t0 = time.perf_counter()
+    n = 0
+    for i, (keys, vals, ts) in enumerate(batches):
+        out = op.process_batch(RecordBatch({"k": keys, "v": vals},
+                                           timestamps=ts))
+        out += op.process_watermark(Watermark(int(ts.max()) - 1))
+        n += len(keys)
+        if checkpoint_every and (i + 1) % checkpoint_every == 0:
+            op.prepare_snapshot_pre_barrier()
+            op.snapshot_state()
+    stats = dict(op.paging_stats())   # occupancy BEFORE end-of-input drains
+    tail = op.end_input()
+    if tail:
+        np.asarray(tail[-1].column("result"))
+    elapsed = time.perf_counter() - t0
+    stats["evictions"] = op.paging_stats()["evictions"]
+    stats["promotions"] = op.paging_stats()["promotions"]
+    return n / elapsed, stats, dict(op.phase_ns)
 
 
 def _fire_digests(elements):
@@ -871,6 +904,10 @@ def main():
     ap.add_argument("--check", action="store_true",
                     help="exit nonzero if the result violates "
                          "BENCH_BUDGET.json (regression gate)")
+    ap.add_argument("--paging-cap", type=int, default=0,
+                    help="also run one cold-key-paging pass (device tier, "
+                         "K_cap=N < key count) and report rps + "
+                         "resident/spilled occupancy in details.paging")
     ap.add_argument("--config", type=int, default=2, choices=[1, 2, 3, 4, 5],
                     help="BASELINE.md config: 1=WordCount, 2=1M-key "
                          "tumbling (headline, default), 3=sliding "
@@ -977,6 +1014,22 @@ def main():
             op.phase_bytes["h2d_refresh"] / 1e6, 2)
     if scatter_cmp is not None:
         detail["scatter_mode"] = scatter_cmp
+    if args.paging_cap:
+        # cold-key paging pass (state/paging.py): state larger than HBM on
+        # the same workload — occupancy proves the ring ran as a cache
+        p_rps, p_stats, p_phases = run_paged(
+            batches, args.window_ms, args.checkpoint_every, args.paging_cap)
+        detail["paging"] = {
+            "rps": round(p_rps, 1),
+            "resident_keys": p_stats["resident_keys"],
+            "spilled_keys": p_stats["spilled_keys"],
+            "evictions": p_stats["evictions"],
+            "promotions": p_stats["promotions"],
+            "capacity": p_stats["capacity"],
+            "spill_mem_mb": round(p_stats["spill_mem_bytes"] / 1e6, 2),
+            "spill_log_mb": round(p_stats["spill_log_bytes"] / 1e6, 2),
+            "paging_ms": round(p_phases.get("paging", 0) / 1e6, 1),
+        }
     result = {
         "metric": f"records/sec/chip (1M-key tumbling sum, {platform}, "
                   f"checkpointing every {args.checkpoint_every} batches)",

@@ -130,6 +130,34 @@ def build_plan(job: str):
     return obj  # already an ExecutionPlan
 
 
+def plan_structure_digest(plan) -> str:
+    """Stable fingerprint of a plan's deploy-relevant STRUCTURE: vertex
+    uids/names/parallelisms, subtask counts (source split counts included),
+    and edges with partitioning/key columns.
+
+    Job shipping rebuilds the plan in every process from the
+    ``module:function`` reference, which silently assumes the builder is
+    deterministic; a nondeterministic builder (unseeded shuffles, dict-order
+    uids, host-dependent split enumeration) makes workers deploy DIFFERENT
+    jobs and diverge without any error.  The coordinator ships this digest
+    with every deploy and workers verify their own rebuild against it —
+    mismatches fail fast at deploy instead of corrupting the run."""
+    import hashlib
+
+    counts, _splits = subtask_counts_of(plan)
+    parts = []
+    for v in plan.vertices:
+        parts.append(f"v:{v.uid}:{v.name}:{counts.get(v.uid)}:"
+                     f"{v.max_parallelism}:{int(bool(v.is_source))}")
+        for e in v.out_edges:
+            tgt = plan.by_id[e.target_id]
+            parts.append(f"e:{v.uid}->{tgt.uid}"
+                         f"#{getattr(e, 'input_index', 0)}:"
+                         f"{getattr(e, 'partitioning', None)}:"
+                         f"{getattr(e, 'key_column', None)}")
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()[:16]
+
+
 def subtask_counts_of(plan) -> Tuple[Dict[str, int], Dict[int, list]]:
     """Subtask count per vertex (sources: one per split, like the
     MiniCluster; runtime-enumerated sources: fixed reader count, splits
@@ -350,19 +378,32 @@ class _WorkerRuntime:
     # -- deploy ------------------------------------------------------------
     def deploy(self, addresses: Dict[int, Tuple[str, int]],
                restore: Optional[Dict[str, Any]],
-               only: Optional[set] = None) -> None:
+               only: Optional[set] = None,
+               expected_digest: Optional[str] = None) -> bool:
         """Build and start this worker's subtask slice.  ``only``: restrict
         to these (vertex_uid, subtask_index) — region-scoped recovery
         redeploys just the affected regions' tasks, leaving the rest
         running (``RestartPipelinedRegionFailoverStrategy``).  Regions are
         edge-closed, so every channel of an ``only`` task has both
-        endpoints inside ``only``."""
+        endpoints inside ``only``.
+
+        ``expected_digest``: the coordinator's plan-structure digest.  This
+        worker rebuilds the plan from the job reference and REFUSES to
+        deploy on mismatch (nondeterministic job builder) — failing fast
+        beats silently deploying a divergent job.  Returns False on the
+        refusal."""
         from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
         from flink_tpu.cluster.net import RemoteChannel
         from flink_tpu.cluster.task import SourceSubtask, Subtask
         from flink_tpu.core.functions import RuntimeContext
 
         plan = build_plan(self.job)
+        if expected_digest is not None:
+            local = plan_structure_digest(plan)
+            if local != expected_digest:
+                self._send(("plan_mismatch", self.index, local,
+                            expected_digest))
+                return False
         counts, splits_by_vertex = subtask_counts_of(plan)
         assign = assign_subtasks(plan, counts, self.n_workers)
         me = self.index
@@ -508,6 +549,7 @@ class _WorkerRuntime:
         if not self.tasks:
             self._done_sent = True
             self._send(("worker_done", self.index))
+        return True
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> int:
@@ -537,11 +579,13 @@ class _WorkerRuntime:
                 break
             kind = msg[0]
             if kind == "deploy":
-                self.deploy(msg[1], msg[2],
-                            only=set(msg[3]) if len(msg) > 3
-                            and msg[3] is not None else None)
-                if msg[2] and (self.recovery_local
-                               or self.recovery_remote):
+                ok = self.deploy(msg[1], msg[2],
+                                 only=set(msg[3]) if len(msg) > 3
+                                 and msg[3] is not None else None,
+                                 expected_digest=msg[4] if len(msg) > 4
+                                 else None)
+                if ok and msg[2] and (self.recovery_local
+                                      or self.recovery_remote):
                     self._send(("recovery_stats", self.index,
                                 self.recovery_local,
                                 self.recovery_remote))
@@ -830,6 +874,9 @@ class ProcessCluster:
                   restore: Optional[Dict[str, Any]],
                   attempt: int = 0) -> Dict[str, Any]:
         plan = build_plan(self.job)
+        # shipped with every deploy; workers verify their own rebuild
+        # against it (nondeterministic job builders fail fast)
+        self._plan_digest = plan_structure_digest(plan)
         self._counts, _ = subtask_counts_of(plan)
         all_subtasks = {(uid, i) for uid, n in self._counts.items()
                         for i in range(n)}
@@ -905,7 +952,8 @@ class ProcessCluster:
                 th.start()
                 threads.append(th)
             for idx in self._conns:
-                self._to_worker(idx, ("deploy", addresses, restore))
+                self._to_worker(idx, ("deploy", addresses, restore, None,
+                                      self._plan_digest))
             if self.checkpoint_interval_ms > 0:
                 # the ticker loops on ITS attempt's event (self._all_done
                 # is replaced between restart attempts/recoveries)
@@ -1100,7 +1148,8 @@ class ProcessCluster:
         self._setup_source_coordinator(plan, restore)
         self._recovering = False
         for idx in self._conns:
-            self._to_worker(idx, ("deploy", addresses, restore))
+            self._to_worker(idx, ("deploy", addresses, restore, None,
+                                  self._plan_digest))
 
     def _recover_regions(self, plan, procs, dead, affected: set, addresses,
                          srv, server_ctx, need_token: bool, cport: int,
@@ -1150,7 +1199,8 @@ class ProcessCluster:
         self._recovering = False
         only = sorted(affected)
         for idx in sorted(touched_workers):
-            self._to_worker(idx, ("deploy", addresses, restore, only))
+            self._to_worker(idx, ("deploy", addresses, restore, only,
+                                  self._plan_digest))
 
     def _register_workers(self, srv, server_ctx, need_token: bool,
                           addresses: Dict[int, Tuple[str, int]],
@@ -1265,6 +1315,16 @@ class ProcessCluster:
                         p.expected.discard((uid, i))
                         if len(p.acks) >= len(p.expected):
                             self._complete(p)
+            elif kind == "plan_mismatch":
+                _, widx, local, expected = msg
+                with self._lock:
+                    if self._failed is None:
+                        self._failed = (
+                            f"worker {widx} rebuilt a DIFFERENT plan "
+                            f"(structure digest {local} != coordinator's "
+                            f"{expected}): the job builder is "
+                            f"nondeterministic — deploy rejected")
+                        self._all_done.set()
             elif kind == "recovery_stats":
                 with self._lock:
                     self.recovery_stats.append((msg[1], msg[2], msg[3]))

@@ -450,6 +450,33 @@ class MiniCluster(TaskListener):
                     t.start(sub_snaps[i] if i < len(sub_snaps) else None)
                     self._tasks.append(t)
         self._source_tasks = source_tasks
+        # job-scope paging occupancy gauges (idempotent registration): only
+        # when a deployed operator actually pages device state
+        if any(self._iter_paged_operators()):
+            from flink_tpu.metrics.groups import paging_metrics
+            paging_metrics(self.job_metric_group, self.paging_totals)
+
+    def _iter_paged_operators(self):
+        for t in getattr(self, "_tasks", []):
+            op = t.operator
+            for member in getattr(op, "operators", [op]):
+                if getattr(member, "_pager", None) is not None:
+                    yield member
+
+    def paging_totals(self) -> Optional[Dict[str, int]]:
+        """Aggregated ``paging_stats()`` across every paged operator
+        (job_status()["paging"] + the job-scope ``paging.*`` gauges)."""
+        total: Optional[Dict[str, int]] = None
+        for member in self._iter_paged_operators():
+            st = member.paging_stats()
+            if not st:
+                continue
+            if total is None:
+                total = dict(st)
+            else:
+                for k, v in st.items():
+                    total[k] = total.get(k, 0) + v
+        return total
 
     # ------------------------------------------------------------ triggers
     def trigger_checkpoint(self) -> Optional[int]:
@@ -697,7 +724,9 @@ class MiniCluster(TaskListener):
         # lifetime count — name it distinctly so consumers can't mix them up
         checkpoints["num_completed_checkpoints"] = self.failure_manager \
             .num_completed()
+        paging = self.paging_totals()
         return {
+            **({"paging": paging} if paging is not None else {}),
             "state": job_state,
             "vertices": vertices,
             "completed_checkpoints": list(self._completed_ids),

@@ -743,11 +743,23 @@ class WindowedStream:
                   value_column: Optional[str] = None,
                   value_selector=None,
                   output_column: str = "result",
-                  name: str = "window-agg") -> DataStream:
+                  name: str = "window-agg",
+                  emit_tier: Optional[str] = None,
+                  paging=None) -> DataStream:
+        """``paging``: a :class:`flink_tpu.state.paging.PagingConfig` caps
+        the operator's resident key capacity — cold keys page out to the
+        spill tier (state larger than HBM).  ``emit_tier`` overrides the
+        operator's auto tier pick ("host"/"device")."""
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
         late_tag = getattr(self, "_late_tag", None)
         ev = getattr(self, "_evictor", None)
+        if (paging is not None or emit_tier is not None) and (
+                ev is not None or keyed.env.mesh is not None
+                or not hasattr(assigner, "pane_of")):
+            raise ValueError("paging/emit_tier apply to the (unsharded) "
+                             "pane-ring window operator — not evictors, "
+                             "session windows or mesh-sharded state")
         if ev is not None:
             # evictor + aggregate: the DEVICE fast lane for the common
             # cases (Count/Time evictors + built-in aggregates) — raw
@@ -832,7 +844,9 @@ class WindowedStream:
                     from flink_tpu.parallel.mesh_runtime import (
                         MeshWindowAggOperator)
                     return MeshWindowAggOperator(mesh=mesh, **kwargs)
-                return WindowAggOperator(**kwargs)
+                if emit_tier is not None:
+                    kwargs["emit_tier"] = emit_tier
+                return WindowAggOperator(paging=paging, **kwargs)
 
         t = keyed._then(name, factory)
         return DataStream(keyed.env, t)

@@ -2,8 +2,13 @@
 
 Analog of the reference's ``flink-formats/flink-parquet``
 (``ParquetColumnarRowInputFormat.java:1`` — vectorized columnar reads,
-``ParquetWriterFactory`` writes); this environment has no pyarrow, so the
-format is implemented from first principles the same way ``avro.py`` was:
+``ParquetWriterFactory`` writes).  The format is implemented from first
+principles the same way ``avro.py`` was — a dependency-free codec is the
+point, not a workaround: it keeps the wire format auditable and the runtime
+image minimal.  pyarrow, where present, serves only as the FOREIGN
+implementation in the interop tests (``tests/test_foreign_interop.py``
+round-trips live pyarrow <-> this module, plus checked-in pyarrow-written
+fixture bytes that validate reads even without it):
 
 - **File layout**: ``PAR1`` magic, row groups of column chunks (one data
   page each, optional dictionary page), then the thrift-compact-encoded
@@ -25,9 +30,9 @@ format is implemented from first principles the same way ``avro.py`` was:
   policy (no snappy in this image).
 
 ``read_parquet`` yields one RecordBatch per row group; ``write_parquet``
-drains batches into row groups.  Interop caveat (PARITY.md): validated
-against spec-derived golden bytes and round-trips, not against a foreign
-implementation — none exists in this image.
+drains batches into row groups.  Validated against spec-derived golden
+bytes, round-trips, AND foreign-interop fixtures (files written by the
+Apache Arrow C++ writers) — see ``tests/test_foreign_interop.py``.
 """
 
 from __future__ import annotations

@@ -29,6 +29,12 @@ CHECKPOINT_DURATION = "lastCheckpointDuration"
 CHECKPOINT_SIZE = "lastCheckpointSize"
 NUM_COMPLETED_CHECKPOINTS = "numberOfCompletedCheckpoints"
 NUM_FAILED_CHECKPOINTS = "numberOfFailedCheckpoints"
+# device-state paging occupancy (state/paging.py; RocksDB block-cache
+# hit/miss counter analogs for the HBM pane-ring cache)
+PAGING_RESIDENT_KEYS = "paging.resident_keys"
+PAGING_SPILLED_KEYS = "paging.spilled_keys"
+PAGING_EVICTIONS = "paging.evictions"
+PAGING_PROMOTIONS = "paging.promotions"
 
 
 class MetricGroup:
@@ -178,6 +184,24 @@ class OperatorIOMetrics:
         self.records_out = group.counter(NUM_RECORDS_OUT)
         self.late_dropped = group.counter(NUM_LATE_RECORDS_DROPPED)
         self.watermark = group.gauge(CURRENT_WATERMARK)
+
+
+def paging_metrics(group: MetricGroup,
+                   stats_supplier: Callable[[], Optional[Dict[str, int]]]
+                   ) -> MetricGroup:
+    """Register the device-paging occupancy gauges on a (job-scope) group:
+    ``paging.resident_keys`` / ``paging.spilled_keys`` / ``paging.evictions``
+    / ``paging.promotions``.  ``stats_supplier`` returns the aggregated
+    :meth:`WindowAggOperator.paging_stats` dict (or None/empty -> 0s)."""
+    def _read(key: str) -> Callable[[], int]:
+        return lambda: int((stats_supplier() or {}).get(key, 0))
+
+    for name, key in ((PAGING_RESIDENT_KEYS, "resident_keys"),
+                      (PAGING_SPILLED_KEYS, "spilled_keys"),
+                      (PAGING_EVICTIONS, "evictions"),
+                      (PAGING_PROMOTIONS, "promotions")):
+        group.gauge(name, _read(key))
+    return group
 
 
 def job_checkpoint_metrics(group: MetricGroup, failure_manager,

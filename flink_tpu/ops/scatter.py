@@ -121,6 +121,45 @@ def scatter_generic(state_leaves, slot_ids, lifted_leaves,
     )
 
 
+def gather_row_pane_columns(state_leaves, counts, rows, pane_slots):
+    """Page-out gather: the ``rows x pane_slots`` sub-grid of ``[K, P, ...]``
+    keyed state — ``(counts[V, m], leaves[V, m, *leaf])``.  Row/pane pads
+    may use any in-range id (callers slice the pads off host-side);
+    ``jnp.take`` clips out-of-range pads."""
+    sel_counts = jnp.take(jnp.take(counts, rows, axis=0), pane_slots, axis=1)
+    sel_leaves = tuple(
+        jnp.take(jnp.take(l, rows, axis=0), pane_slots, axis=1)
+        for l in state_leaves)
+    return sel_counts, sel_leaves
+
+
+def reset_rows(state_leaves, counts, rows, leaf_inits):
+    """Reset whole key rows (every pane slot) to the accumulator identity.
+    Row pads use id K (out of range, dropped)."""
+    new_leaves = tuple(
+        l.at[rows].set(
+            jnp.broadcast_to(jnp.asarray(init, l.dtype),
+                             (rows.shape[0],) + l.shape[1:]),
+            mode="drop")
+        for l, init in zip(state_leaves, leaf_inits))
+    return new_leaves, counts.at[rows].set(0, mode="drop")
+
+
+def set_row_pane_columns(state_leaves, counts, rows, pane_slots,
+                         leaf_cols, counts_cols, leaf_inits):
+    """Page-in: reset the target rows across the whole ring, then set their
+    ``pane_slots`` columns from the promoted cells (identity where nothing
+    was spilled).  Row pads = K, pane pads = P (both dropped)."""
+    new_leaves, new_counts = reset_rows(state_leaves, counts, rows,
+                                        leaf_inits)
+    new_leaves = tuple(
+        l.at[rows[:, None], pane_slots[None, :]].set(col, mode="drop")
+        for l, col in zip(new_leaves, leaf_cols))
+    new_counts = new_counts.at[rows[:, None], pane_slots[None, :]].set(
+        counts_cols, mode="drop")
+    return new_leaves, new_counts
+
+
 def combine_along_axis(leaves, combine_leaves: Callable, axis: int, keepdims: bool = False):
     """Tree-reduce leaves along ``axis`` with an arbitrary monoid — the fire-time
     pane combine (blockwise partials → window total, SURVEY §5.7). Log-depth."""

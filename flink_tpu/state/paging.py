@@ -1,0 +1,386 @@
+"""Cold-key paging: the HBM pane ring as a cache over an unbounded key space.
+
+The reference grows keyed state past memory by swapping the heap backend for
+RocksDB (``RocksDBAggregatingState.java:45``, SURVEY §7.3 "state larger than
+HBM"); the device pane ring of
+:class:`~flink_tpu.operators.window_agg.WindowAggOperator` gets the same
+capability from a **residency tier**: the ``[K_cap, P, *leaf]`` ring holds
+only the HOT keys, and cold keys' pane cells live serialized in the
+memory-budgeted native :class:`~flink_tpu.native.SpillStore` (which itself
+overflows to disk) — key cardinality is no longer capped by HBM.
+
+Split of labor:
+
+- :class:`DevicePager` (here) owns every HOST-side decision: the residency
+  map (global key id -> HBM row), victim selection (clock second-chance or
+  exact LRU), the per-pane spilled-key bitmaps, and the serialized
+  per-(key, pane) entries in a :class:`~flink_tpu.state.spill.PaneSpillStore`
+  (count + emit-mirror bit + leaf values in device dtypes — eviction and
+  promotion round-trip bit-exactly).
+- The operator owns every DEVICE dispatch: one batched gather for the
+  evicted rows' live-pane cells (page-out), one batched reset+set for the
+  promoted rows (page-in), and one combine+get_result over uploaded columns
+  when spilled keys participate in a window fire.  Paging cost per
+  micro-batch is a handful of gather/scatter dispatches, never per-key host
+  chatter.
+
+Invariant: every (key, pane) cell lives in EXACTLY one tier.  Promotion
+folds a key's spilled cells back into its fresh HBM row (and deletes the
+entries) before the batch's scatter touches the row, so a promoted key's
+accumulation history is identical to an always-resident key's — the basis of
+the fire-digest-equality acceptance tests.
+
+Spilled keys stay first-class: they participate in window fires (the
+operator uploads their columns and runs the same pane combine), in
+snapshots (``fill_snapshot`` merges them into the repo-standard dense keyed
+snapshot format, so ``redistribute.split_keyed_snapshot`` and rescale work
+unchanged), and in restore at any K_cap (``import_rows`` spills the
+overflow).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+#: flags bit: the (key, pane) cell was marked in the host emit mirror
+MIRROR_BIT = 1
+
+#: rows examined per clock-sweep chunk (vectorized second-chance scan)
+_CLOCK_CHUNK = 4096
+
+
+def identity_grid(spec, rows: int, cols: int) -> List[np.ndarray]:
+    """One ``[rows, cols, *leaf]`` array per ACC leaf, filled with the
+    accumulator identity in DEVICE dtypes — the shared cell-grid layout of
+    page-in columns, spilled fires and dense snapshots."""
+    out = []
+    for init, shape, dt in zip(spec.leaf_inits, spec.leaf_shapes,
+                               spec.leaf_dtypes):
+        arr = np.empty((rows, cols) + tuple(shape), dt)
+        arr[...] = np.asarray(init).astype(dt)
+        out.append(arr)
+    return out
+
+
+@dataclass
+class PagingConfig:
+    """Operator-facing paging knobs (``docs/operations.md`` "State larger
+    than HBM").
+
+    capacity:   resident key capacity K_cap (rounded up to a power of two
+                by the operator) — the HBM footprint stays ``K_cap * P``
+                cells regardless of key cardinality.
+    policy:     "clock" (second-chance ref bits, O(1) amortized) or "lru"
+                (exact least-recently-touched via access ticks).
+    directory:  spill directory for the native store's disk log (a fresh
+                temp dir when None).
+    mem_budget: resident-byte budget of the SpillStore before IT evicts
+                entries to its disk log.
+    """
+
+    capacity: int
+    policy: str = "clock"
+    directory: Optional[str] = None
+    mem_budget: int = 64 << 20
+
+
+class DevicePager:
+    """Host-side residency manager for one operator's pane ring."""
+
+    def __init__(self, config: PagingConfig, spec, capacity: int):
+        if config.policy not in ("clock", "lru"):
+            raise ValueError(f"paging policy must be clock|lru, "
+                             f"got {config.policy!r}")
+        if config.capacity <= 0:
+            raise ValueError("paging capacity must be positive")
+        from flink_tpu.state.spill import PaneSpillStore
+
+        self.config = config
+        self.spec = spec
+        self.K = int(capacity)
+        self.store = PaneSpillStore(config.directory, config.mem_budget,
+                                    spec.leaf_dtypes, spec.leaf_shapes)
+        #: lifetime counters (metrics: paging.evictions / paging.promotions)
+        self.evictions = 0
+        self.promotions = 0
+        self._reset_maps()
+
+    def _reset_maps(self) -> None:
+        #: global key id -> HBM row, -1 = not resident (grows with keys)
+        self.row_of = np.full(1024, -1, np.int32)
+        #: HBM row -> global key id, -1 = free
+        self.gid_of = np.full(self.K, -1, np.int64)
+        self._tick = np.zeros(self.K, np.int64)   # lru: last-touch stamp
+        self._ref = np.zeros(self.K, np.uint8)    # clock: second-chance bit
+        self._hand = 0
+        self._clock = 0
+        self._n_resident = 0
+        self._next_free = 0                       # fresh rows low-water mark
+        self._free: List[int] = []                # rows recycled by eviction
+        #: pane id -> bool[num_keys] "this key has a spilled cell here"
+        self.spilled: Dict[int, np.ndarray] = {}
+
+    def reset(self) -> None:
+        """Drop all residency + spilled state (operator ``reset_state``)."""
+        self.store.clear()
+        self._reset_maps()
+        self.evictions = 0
+        self.promotions = 0
+
+    def close(self) -> None:
+        self.store.close()
+
+    # -- residency map ------------------------------------------------------
+    def ensure_gids(self, n: int) -> None:
+        if n > self.row_of.size:
+            grown = np.full(max(n, self.row_of.size * 2), -1, np.int32)
+            grown[: self.row_of.size] = self.row_of
+            self.row_of = grown
+
+    def rows(self, gids: np.ndarray) -> np.ndarray:
+        return self.row_of[gids]
+
+    @property
+    def resident_keys(self) -> int:
+        return self._n_resident
+
+    @property
+    def row_high_water(self) -> int:
+        """Rows ever assigned (fresh low-water mark): bounds live rows."""
+        return self._next_free
+
+    def free_count(self) -> int:
+        return (self.K - self._next_free) + len(self._free)
+
+    def touch(self, rows: np.ndarray) -> None:
+        self._clock += 1
+        self._tick[rows] = self._clock
+        self._ref[rows] = 1
+
+    def resident_pairs(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows, gids) of every assigned row, ascending row order."""
+        rows = np.flatnonzero(self.gid_of >= 0)
+        return rows.astype(np.int32), self.gid_of[rows]
+
+    # -- victim selection ---------------------------------------------------
+    def pick_victims(self, n: int, protected_rows: np.ndarray) -> np.ndarray:
+        """``n`` cold resident rows to evict; never rows of keys in the
+        current batch (``protected_rows``) — their cells are about to be
+        scattered into."""
+        elig = self.gid_of >= 0
+        if protected_rows.size:
+            elig[protected_rows] = False
+        if int(np.count_nonzero(elig)) < n:
+            raise RuntimeError(
+                f"paging: batch working set exceeds capacity (need {n} "
+                f"victims, {int(np.count_nonzero(elig))} eligible of "
+                f"K_cap={self.K}) — shrink the batch or raise capacity")
+        if self.config.policy == "lru":
+            cand = np.flatnonzero(elig)
+            if n >= cand.size:
+                return cand.astype(np.int32)
+            pick = cand[np.argpartition(self._tick[cand], n - 1)[:n]]
+            return pick.astype(np.int32)
+        # clock: vectorized second-chance sweep.  Two full sweeps clear
+        # every ref bit, so the bound below always terminates with picks.
+        out = np.empty(n, np.int64)
+        filled = 0
+        chunks_per_sweep = (self.K + _CLOCK_CHUNK - 1) // _CLOCK_CHUNK
+        for _ in range(3 * chunks_per_sweep + 1):
+            idx = (self._hand + np.arange(min(_CLOCK_CHUNK, self.K))) % self.K
+            self._hand = int((self._hand + idx.size) % self.K)
+            cand = idx[elig[idx]]
+            if cand.size == 0:
+                continue
+            second = self._ref[cand] == 1
+            self._ref[cand[second]] = 0   # second chance spent
+            pick = cand[~second]
+            take = min(n - filled, pick.size)
+            out[filled: filled + take] = pick[:take]
+            elig[pick[:take]] = False
+            filled += take
+            if filled >= n:
+                break
+        if filled < n:          # pathological interleaving: force-complete
+            rest = np.flatnonzero(elig)[: n - filled]
+            out[filled: filled + rest.size] = rest
+            filled += rest.size
+        return out[:n].astype(np.int32)
+
+    # -- page-out / page-in -------------------------------------------------
+    def spill_rows(self, victim_rows: np.ndarray, panes: np.ndarray,
+                   counts: np.ndarray, leaves: List[np.ndarray],
+                   mirror_bits: np.ndarray) -> None:
+        """Serialize the victims' live-pane cells (downloaded by the
+        operator) into the store and free their rows.  ``counts`` is
+        ``[V, m]`` int, ``leaves`` one ``[V, m, *leaf]`` array per ACC leaf,
+        ``mirror_bits`` ``[V, m]`` bool."""
+        gids = self.gid_of[victim_rows]
+        pl = [int(p) for p in np.asarray(panes).tolist()]
+        for i, g in enumerate(gids.tolist()):
+            for j, p in enumerate(pl):
+                c = int(counts[i, j])
+                b = bool(mirror_bits[i, j])
+                if c or b:
+                    self.store.put(g, p, MIRROR_BIT if b else 0, c,
+                                   [l[i, j] for l in leaves])
+                    self._mark_spilled(p, g)
+        self.row_of[gids] = -1
+        self.gid_of[victim_rows] = -1
+        self._ref[victim_rows] = 0
+        self._free.extend(int(r) for r in victim_rows.tolist())
+        self._n_resident -= int(victim_rows.size)
+        self.evictions += int(victim_rows.size)
+
+    def assign_rows(self, gids: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Bind free rows to ``gids`` (promotion/new keys); returns
+        (rows int32, n_recycled) — recycled rows carry stale device cells
+        the operator must reset before use."""
+        need = int(gids.size)
+        rows = np.empty(need, np.int64)
+        fresh = min(need, self.K - self._next_free)
+        if fresh:
+            rows[:fresh] = np.arange(self._next_free, self._next_free + fresh)
+            self._next_free += fresh
+        recycled = need - fresh
+        for i in range(recycled):
+            rows[fresh + i] = self._free.pop()
+        self.row_of[gids] = rows
+        self.gid_of[rows] = gids
+        self._n_resident += need
+        self.touch(rows)
+        return rows.astype(np.int32), recycled
+
+    def load_entries(self, gids: np.ndarray, panes: np.ndarray,
+                     delete: bool):
+        """Dense ``[R, m]`` columns of the spilled cells of ``gids`` over
+        ``panes`` (identity where nothing is spilled): (counts int32,
+        leaves in device dtypes, mirror bits, found bool[R]).  With
+        ``delete`` the entries move OUT of the spill tier (promotion) and
+        the promotion counter advances."""
+        R, m = int(gids.size), int(np.asarray(panes).size)
+        counts = np.zeros((R, m), np.int32)
+        bits = np.zeros((R, m), bool)
+        leaves = identity_grid(self.spec, R, m)
+        found = np.zeros(R, bool)
+        gl = np.asarray(gids).tolist()
+        for j, p in enumerate(np.asarray(panes).tolist()):
+            mark = self.spilled.get(int(p))
+            if mark is None:
+                continue
+            for i, g in enumerate(gl):
+                if g >= mark.size or not mark[g]:
+                    continue
+                entry = self.store.get(g, int(p))
+                if entry is None:
+                    continue
+                flags, c, vals = entry
+                counts[i, j] = c
+                bits[i, j] = bool(flags & MIRROR_BIT) or c > 0
+                for k, v in enumerate(vals):
+                    leaves[k][i, j] = v
+                found[i] = True
+                if delete:
+                    self.store.delete(g, int(p))
+                    mark[g] = False
+        if delete:
+            self.promotions += int(found.sum())
+        return counts, leaves, bits, found
+
+    # -- spilled-key queries -------------------------------------------------
+    def any_spilled(self, gids: np.ndarray, panes: np.ndarray) -> bool:
+        """Cheap pre-check: does ANY of ``gids`` hold a spilled cell in any
+        of ``panes``?  Saves the dense load_entries grids on the dominant
+        all-new-keys batches while the key space is still growing."""
+        gids = np.asarray(gids)
+        for p in np.asarray(panes).tolist():
+            mark = self.spilled.get(int(p))
+            if mark is None:
+                continue
+            sub = gids[gids < mark.size]
+            if sub.size and mark[sub].any():
+                return True
+        return False
+
+    def _mark_spilled(self, pane: int, gid: int) -> None:
+        arr = self.spilled.get(pane)
+        if arr is None or arr.size <= gid:
+            grown = np.zeros(max(self.row_of.size, gid + 1), bool)
+            if arr is not None:
+                grown[: arr.size] = arr
+            arr = self.spilled[pane] = grown
+        arr[gid] = True
+
+    def spilled_gids(self, panes: np.ndarray) -> np.ndarray:
+        """Ascending global ids holding a spilled cell in any of ``panes``."""
+        acc: Optional[np.ndarray] = None
+        for p in np.asarray(panes).tolist():
+            mark = self.spilled.get(int(p))
+            if mark is None:
+                continue
+            if acc is None:
+                acc = mark.copy()
+            else:
+                if acc.size < mark.size:
+                    acc = np.pad(acc, (0, mark.size - acc.size))
+                acc[: mark.size] |= mark
+        if acc is None:
+            return np.empty(0, np.int64)
+        return np.flatnonzero(acc).astype(np.int64)
+
+    def drop_panes(self, panes) -> None:
+        """Pane expiry: delete every spilled cell of the expired panes."""
+        for p in panes:
+            mark = self.spilled.pop(int(p), None)
+            if mark is None:
+                continue
+            for g in np.flatnonzero(mark).tolist():
+                self.store.delete(g, int(p))
+
+    # -- snapshot / restore ---------------------------------------------------
+    def fill_snapshot(self, counts: np.ndarray, leaves: List[np.ndarray],
+                      panes: np.ndarray) -> None:
+        """Merge spilled cells into dense gid-indexed snapshot arrays
+        (``counts [n, m]``, one ``[n, m, *leaf]`` per leaf) — the
+        repo-standard keyed snapshot format, redistribute-compatible."""
+        for j, p in enumerate(np.asarray(panes).tolist()):
+            mark = self.spilled.get(int(p))
+            if mark is None:
+                continue
+            for g in np.flatnonzero(mark).tolist():
+                entry = self.store.get(g, int(p))
+                if entry is None:
+                    continue
+                _flags, c, vals = entry
+                counts[g, j] = c
+                for k, v in enumerate(vals):
+                    leaves[k][g, j] = v
+
+    def import_rows(self, gids: np.ndarray, panes: np.ndarray,
+                    counts: np.ndarray, leaves: List[np.ndarray]) -> None:
+        """Restore overflow: spill snapshot rows (gid-indexed dense arrays)
+        that do not fit the resident capacity."""
+        pl = [int(p) for p in np.asarray(panes).tolist()]
+        for g in np.asarray(gids).tolist():
+            for j, p in enumerate(pl):
+                c = int(counts[g, j])
+                if not c:
+                    continue
+                self.store.put(g, p, MIRROR_BIT, c,
+                               [l[g, j] for l in leaves])
+                self._mark_spilled(p, g)
+
+    # -- observability --------------------------------------------------------
+    def stats(self, num_keys: int) -> Dict[str, int]:
+        """Occupancy + lifetime counters (metrics: ``paging.*``)."""
+        return {
+            "resident_keys": int(self._n_resident),
+            "spilled_keys": int(max(0, num_keys - self._n_resident)),
+            "evictions": int(self.evictions),
+            "promotions": int(self.promotions),
+            "capacity": int(self.K),
+            "spill_mem_bytes": int(self.store.mem_used()),
+            "spill_log_bytes": int(self.store.log_bytes()),
+        }

@@ -258,6 +258,92 @@ class SpillReducingState(SpillAggregatingState, ReducingState):
 
 
 
+class PaneSpillStore:
+    """Serialized per-(key, pane) pane-ring cells over the native SpillStore.
+
+    The storage tier of the device-state paging subsystem
+    (:mod:`flink_tpu.state.paging`): each entry is one cold key's
+    accumulator cell for one pane, under the key ``struct('<qq', gid,
+    pane)``.  The value layout is fixed-size and pickle-free so eviction /
+    promotion round-trips are bit-exact and cheap::
+
+        u8  flags   (bit0 = emit-mirror bit)
+        i64 count   (element count of the cell)
+        raw leaf bytes, one fixed-size block per ACC leaf in DEVICE
+        dtype/shape (spec.leaf_dtypes / spec.leaf_shapes order)
+
+    Device dtypes (not the host mirror's widened dtypes) on purpose: the
+    paged tier must reproduce exactly what the HBM cell held, so a key that
+    pages out and back in continues its accumulation history bitwise."""
+
+    _HEADER = struct.Struct("<Bq")
+
+    def __init__(self, directory: Optional[str] = None,
+                 mem_budget: int = 64 << 20,
+                 leaf_dtypes=(), leaf_shapes=()):
+        self.directory = directory or tempfile.mkdtemp(
+            prefix="flink_tpu_pages_")
+        self.store = SpillStore(self.directory, mem_budget)
+        self._closed = False
+        self._dtypes = [np.dtype(d) for d in leaf_dtypes]
+        self._shapes = [tuple(s) for s in leaf_shapes]
+        self._counts_per_leaf = [int(np.prod(s)) if s else 1
+                                 for s in self._shapes]
+        self._sizes = [d.itemsize * c for d, c in
+                       zip(self._dtypes, self._counts_per_leaf)]
+
+    @staticmethod
+    def _key(gid: int, pane: int) -> bytes:
+        return struct.pack("<qq", gid, pane)
+
+    def put(self, gid: int, pane: int, flags: int, count: int,
+            leaf_values) -> None:
+        parts = [self._HEADER.pack(flags, count)]
+        for v, d, s in zip(leaf_values, self._dtypes, self._shapes):
+            parts.append(np.ascontiguousarray(np.asarray(v, d)
+                                              .reshape(s)).tobytes())
+        self.store.put(self._key(gid, pane), b"".join(parts))
+
+    def get(self, gid: int, pane: int):
+        """(flags, count, [leaf arrays]) or None."""
+        raw = self.store.get(self._key(gid, pane))
+        if raw is None:
+            return None
+        flags, count = self._HEADER.unpack_from(raw)
+        off = self._HEADER.size
+        vals = []
+        for d, s, c, sz in zip(self._dtypes, self._shapes,
+                               self._counts_per_leaf, self._sizes):
+            a = np.frombuffer(raw, d, count=c, offset=off)
+            vals.append(a.reshape(s) if s else a[0])
+            off += sz
+        return flags, count, vals
+
+    def delete(self, gid: int, pane: int) -> None:
+        self.store.delete(self._key(gid, pane))
+
+    def clear(self) -> None:
+        if self._closed:
+            return
+        for k in list(self.store.keys()):
+            self.store.delete(k)
+
+    def __len__(self) -> int:
+        return len(self.store)
+
+    def mem_used(self) -> int:
+        return 0 if self._closed else self.store.mem_used()
+
+    def log_bytes(self) -> int:
+        return 0 if self._closed else self.store.log_bytes()
+
+    def close(self) -> None:
+        # occupancy gauges may read stats after the operator closed: byte
+        # gauges report 0 rather than touching a closed native handle
+        self._closed = True
+        self.store.close()
+
+
 class SpillKeyedStateBackend:
     """Keyed state backend over the native spill store (RocksDB-tier analog).
 

@@ -41,9 +41,9 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = [
     "InjectedFault", "FaultSchedule", "FailTimes", "CrashOnceAt", "DelayBy",
-    "ActionSequence", "Partition", "FailWithProbability", "FaultInjector",
-    "FreezableProxy", "install", "uninstall", "installed", "fire", "active",
-    "blocked",
+    "SlowDisk", "ActionSequence", "Partition", "FailWithProbability",
+    "FaultInjector", "FreezableProxy", "install", "uninstall", "installed",
+    "fire", "active", "blocked",
 ]
 
 #: actions a schedule may return for one firing
@@ -111,6 +111,40 @@ class DelayBy(FaultSchedule):
         if self.times is not None and n > self.times:
             return OK
         return ("delay", self.seconds)
+
+
+class SlowDisk(FaultSchedule):
+    """Seeded, jittered write stalls — the degrading-disk model (writes
+    intermittently take ~seconds instead of ~ms, without erroring).
+
+    Unlike :class:`DelayBy`'s constant delay, each firing stalls with
+    probability ``p`` for a duration drawn uniformly from
+    ``[min_s, max_s]`` out of the point's own seeded RNG — a realistic
+    bursty-latency profile that is still a pure function of
+    ``(seed, point, firing count)``, so two runs with one seed stall at
+    identical firings for identical durations.  ``times`` bounds the flaky
+    period (the disk "recovers" afterwards)."""
+
+    def __init__(self, max_s: float, min_s: float = 0.0, p: float = 1.0,
+                 times: Optional[int] = None):
+        if max_s < min_s:
+            raise ValueError("SlowDisk: max_s must be >= min_s")
+        self.max_s = max_s
+        self.min_s = min_s
+        self.p = p
+        self.times = times
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        # ALWAYS draw both samples: the RNG stream must advance identically
+        # per firing regardless of which branch a firing takes, or later
+        # firings' actions would depend on earlier probabilities
+        gate = rng.random()
+        span = self.min_s + (self.max_s - self.min_s) * rng.random()
+        if self.times is not None and n > self.times:
+            return OK
+        if gate >= self.p:
+            return OK
+        return ("delay", span)
 
 
 class ActionSequence(FaultSchedule):

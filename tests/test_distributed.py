@@ -14,7 +14,8 @@ import numpy as np
 import pytest
 
 from flink_tpu.cluster.distributed import (ProcessCluster, assign_subtasks,
-                                           build_plan, subtask_counts_of)
+                                           build_plan, plan_structure_digest,
+                                           subtask_counts_of)
 from flink_tpu.runtime.checkpoint.storage import FileCheckpointStorage
 
 pytestmark = pytest.mark.slow
@@ -59,6 +60,71 @@ def test_assignment_is_deterministic_and_total(job_path):
     assert a1 == a2
     assert set(a1.values()) <= {0, 1, 2}
     assert len(a1) == sum(counts.values())
+
+
+def test_plan_structure_digest_stable_and_sensitive(job_path):
+    """The deploy-time digest is a pure function of plan STRUCTURE: two
+    rebuilds of a deterministic job agree; a structural change (different
+    record count -> different source split count) does not go unnoticed."""
+    path, job = job_path
+    d1 = plan_structure_digest(build_plan(job))
+    d2 = plan_structure_digest(build_plan(job))
+    assert d1 == d2
+
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    def mini_plan(parallelism):
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(parallelism)
+        keys = (np.arange(1000) % 7).astype(np.int64)
+        (env.from_collection(columns={"k": keys, "v": np.ones(1000)},
+                             batch_size=256)
+            .key_by("k").sum("v").collect())
+        return env.get_stream_graph("mini").to_plan()
+
+    assert plan_structure_digest(mini_plan(2)) == \
+        plan_structure_digest(mini_plan(2))
+    assert plan_structure_digest(mini_plan(2)) != \
+        plan_structure_digest(mini_plan(3))
+
+
+NONDET_JOB_MODULE = textwrap.dedent('''
+    """NONDETERMINISTIC job builder: the plan depends on the building
+    process (the bug class the deploy digest exists to catch)."""
+    import os
+    import numpy as np
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    def build():
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        keys = (np.arange(2000) % 7).astype(np.int64)
+        (env.from_collection(columns={"k": keys, "v": np.ones(2000)},
+                             batch_size=512)
+            .key_by("k").sum("v")
+            .map(lambda cols: cols, name=f"m-{os.getpid()}")
+            .collect())
+        return env.get_stream_graph("nondet-job")
+''')
+
+
+def test_nondeterministic_builder_rejected_at_deploy(tmp_path):
+    """A worker that rebuilds a DIFFERENT plan (per-process operator name
+    here) must be rejected at deploy — the job fails fast with a digest
+    mismatch instead of silently deploying divergent jobs."""
+    mod = tmp_path / "dist_job_nondet.py"
+    mod.write_text(NONDET_JOB_MODULE)
+    sys.path.insert(0, str(tmp_path))
+    try:
+        pc = ProcessCluster("dist_job_nondet:build", n_workers=2,
+                            extra_sys_path=(str(tmp_path),))
+        res = pc.run(timeout_s=120)
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("dist_job_nondet", None)
+    assert res["state"] == "FAILED"
+    assert "nondeterministic" in res["error"]
+    assert "digest" in res["error"]
 
 
 def test_two_process_job(job_path):

@@ -1,0 +1,447 @@
+"""Cold-key paging subsystem: state larger than HBM for the pane ring.
+
+The acceptance contract (ISSUE 2): with K_cap forced far below the key
+cardinality, a paged run is FIRE-DIGEST-IDENTICAL to a fully-resident run —
+spilled keys participate in fires, snapshots and restore (at a different
+K_cap, and across the paged/resident boundary in both directions), and the
+occupancy counters are live in operator stats / job-scope metrics.
+
+Tier-1 carries the 64k-cap / 256k-key variant; the 1M-key eviction stress
+is marked ``slow``.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import RuntimeContext, SumAggregator
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.state.paging import DevicePager, PagingConfig
+from flink_tpu.state.spill import PaneSpillStore
+from flink_tpu.windowing.assigners import (SlidingEventTimeWindows,
+                                           TumblingEventTimeWindows)
+
+
+def _digests(elements):
+    """Sorted (window_start, key, result) — order-independent, and exact
+    because the tests use integer-valued float32 (sums < 2**24)."""
+    out = []
+    for b in elements:
+        if hasattr(b, "columns") and "result" in b.columns:
+            out.extend(zip(np.asarray(b.column("window_start")).tolist(),
+                           np.asarray(b.column("k")).tolist(),
+                           np.asarray(b.column("result")).tolist()))
+    return sorted(out)
+
+
+def _mk_op(paging, window_ms=1000, assigner=None, capacity_hint=1 << 13,
+           **kw):
+    kw.setdefault("emit_tier", "device")
+    op = WindowAggOperator(
+        assigner or TumblingEventTimeWindows.of(window_ms),
+        SumAggregator(jnp.float32), key_column="k", value_column="v",
+        initial_key_capacity=capacity_hint, paging=paging, **kw)
+    op.open(RuntimeContext())
+    return op
+
+
+def _feed(op, keys, ts_value, out, batch=512):
+    for lo in range(0, keys.size, batch):
+        k = keys[lo: lo + batch]
+        v = (k % 17 + 1).astype(np.float32)
+        ts = np.full(k.size, ts_value, np.int64)
+        out += op.process_batch(RecordBatch({"k": k, "v": v},
+                                            timestamps=ts))
+
+
+def _run(paging, n_keys=4096, windows=2, reps=2, seed=7, batch=512):
+    op = _mk_op(paging)
+    rng = np.random.default_rng(seed)
+    out = []
+    for w in range(windows):
+        for _ in range(reps):
+            _feed(op, rng.permutation(n_keys).astype(np.int64),
+                  w * 1000 + 10, out, batch)
+        out += op.process_watermark(Watermark(w * 1000 + 999))
+    out += op.end_input()
+    return _digests(out), op
+
+
+# ---------------------------------------------------------------------------
+# PaneSpillStore codec
+# ---------------------------------------------------------------------------
+
+def test_pane_spill_store_roundtrip(tmp_path):
+    st = PaneSpillStore(str(tmp_path / "pages"), 1 << 20,
+                        leaf_dtypes=(np.float32, np.int64),
+                        leaf_shapes=((), (2,)))
+    st.put(7, -3, 1, 42, [np.float32(1.5), np.array([4, 5], np.int64)])
+    flags, count, vals = st.get(7, -3)
+    assert (flags, count) == (1, 42)
+    assert vals[0] == np.float32(1.5)
+    np.testing.assert_array_equal(vals[1], [4, 5])
+    assert st.get(7, -2) is None and st.get(8, -3) is None
+    assert len(st) == 1
+    st.delete(7, -3)
+    assert st.get(7, -3) is None and len(st) == 0
+    # bit-exactness: float32 payloads survive exactly (paging round trips
+    # must not perturb accumulation history)
+    v = np.float32(0.1) + np.float32(1e-7)
+    st.put(1, 0, 0, 1, [v, np.zeros(2, np.int64)])
+    assert st.get(1, 0)[2][0].tobytes() == v.tobytes()
+    st.close()
+
+
+def test_pane_spill_store_clear(tmp_path):
+    st = PaneSpillStore(str(tmp_path / "pages"), 1 << 20,
+                        leaf_dtypes=(np.float32,), leaf_shapes=((),))
+    for g in range(10):
+        st.put(g, 0, 1, 1, [np.float32(g)])
+    assert len(st) == 10
+    st.clear()
+    assert len(st) == 0
+    st.close()
+
+
+# ---------------------------------------------------------------------------
+# DevicePager unit behavior
+# ---------------------------------------------------------------------------
+
+def test_pager_lru_evicts_coldest(tmp_path):
+    spec = SumAggregator(jnp.float32).acc_spec()
+    pager = DevicePager(PagingConfig(4, policy="lru",
+                                     directory=str(tmp_path / "p")), spec, 4)
+    pager.ensure_gids(8)
+    rows, _ = pager.assign_rows(np.arange(4, dtype=np.int64))
+    pager.touch(rows[2:])                     # rows 0,1 stay coldest
+    victims = pager.pick_victims(2, np.empty(0, np.int64))
+    assert sorted(victims.tolist()) == [0, 1]
+
+
+def test_pager_clock_second_chance(tmp_path):
+    spec = SumAggregator(jnp.float32).acc_spec()
+    pager = DevicePager(PagingConfig(4, policy="clock",
+                                     directory=str(tmp_path / "p")), spec, 4)
+    pager.ensure_gids(8)
+    pager.assign_rows(np.arange(4, dtype=np.int64))   # all ref bits set
+    # first sweep clears every ref bit, second sweep yields victims —
+    # deterministic hand order
+    victims = pager.pick_victims(2, np.empty(0, np.int64))
+    assert victims.size == 2
+    assert set(victims.tolist()) <= {0, 1, 2, 3}
+
+
+def test_pager_protected_rows_never_evicted(tmp_path):
+    spec = SumAggregator(jnp.float32).acc_spec()
+    pager = DevicePager(PagingConfig(4, policy="lru",
+                                     directory=str(tmp_path / "p")), spec, 4)
+    pager.ensure_gids(8)
+    pager.assign_rows(np.arange(4, dtype=np.int64))
+    victims = pager.pick_victims(2, np.array([0, 1], np.int64))
+    assert set(victims.tolist()) == {2, 3}
+    with pytest.raises(RuntimeError):
+        pager.pick_victims(3, np.array([0, 1], np.int64))
+
+
+def test_paging_config_validation():
+    with pytest.raises(ValueError):
+        _mk_op(PagingConfig(16, policy="fifo"))     # unknown policy
+    with pytest.raises(ValueError):
+        _mk_op(PagingConfig(16), emit_tier="host")  # host tier unsupported
+    from flink_tpu.windowing.triggers import CountTrigger
+    with pytest.raises(ValueError):
+        _mk_op(PagingConfig(16), trigger=CountTrigger.of(3))
+
+
+# ---------------------------------------------------------------------------
+# fire-digest equality: paged == fully resident
+# ---------------------------------------------------------------------------
+
+def test_fire_digests_identical_under_paging_both_policies():
+    ref, _ = _run(None)
+    clock, op_c = _run(PagingConfig(1024, policy="clock"))
+    lru, op_l = _run(PagingConfig(1024, policy="lru"))
+    assert clock == ref and lru == ref
+    for op in (op_c, op_l):
+        st = op.paging_stats()
+        assert st["evictions"] > 0 and st["promotions"] > 0
+        assert st["resident_keys"] == 1024
+        assert st["resident_keys"] + st["spilled_keys"] == 4096
+
+
+def test_paging_sliding_windows_digest_identical():
+    """Sliding windows: spilled cells span multiple panes per window and
+    every pane feeds two windows — the pane combine must agree across
+    tiers."""
+    assigner = SlidingEventTimeWindows.of(2000, 1000)
+    def run(paging):
+        op = _mk_op(paging, assigner=assigner)
+        rng = np.random.default_rng(11)
+        out = []
+        for w in range(4):
+            _feed(op, rng.permutation(2048).astype(np.int64),
+                  w * 1000 + 10, out)
+            out += op.process_watermark(Watermark(w * 1000 + 999))
+        out += op.end_input()
+        return _digests(out)
+    assert run(PagingConfig(512)) == run(None)
+
+
+def test_paging_late_records_within_lateness_refire():
+    """A late record for a key whose pane cells are SPILLED folds in after
+    promotion and re-fires identically to the resident run."""
+    def run(paging):
+        op = _mk_op(paging, allowed_lateness_ms=1000)
+        out = []
+        keys = np.arange(1024, dtype=np.int64)
+        _feed(op, keys, 10, out)
+        out += op.process_watermark(Watermark(999))       # window 0 fires
+        _feed(op, np.arange(1024, 2048, dtype=np.int64), 1010, out)  # evicts
+        late = np.arange(0, 512, dtype=np.int64)          # late for window 0
+        # batch=128 (= K_cap/2): identical batch boundaries in both runs —
+        # each late batch refires window 0, so granularity must match
+        _feed(op, late, 20, out, batch=128)               # refires window 0
+        out += op.process_watermark(Watermark(1999))
+        out += op.end_input()
+        return _digests(out)
+    assert run(PagingConfig(256)) == run(None)
+
+
+def test_async_fire_eviction_between_fire_and_drain_keeps_attribution():
+    """async_fire + paging: a queued fire's HBM rows may be evicted and
+    REASSIGNED before the download drains — emissions must stay attributed
+    to the keys that fired (rows translate to global ids at fire time)."""
+    def run(async_fire):
+        op = _mk_op(PagingConfig(256), async_fire=async_fire)
+        out = []
+        _feed(op, np.arange(1024, dtype=np.int64), 10, out, batch=128)
+        out += op.process_watermark(Watermark(999))   # fire (queued if async)
+        # evict + reassign the fired rows before any drain completes
+        _feed(op, np.arange(1024, 2048, dtype=np.int64), 1010, out, batch=128)
+        out += op.process_watermark(Watermark(1999))
+        out += op.end_input()                          # force-drains
+        return _digests(out)
+    assert run(True) == run(False)
+
+
+def test_k_cap_one_extreme_still_correct():
+    """K_cap=1: every batch splits to single records and every access
+    evicts — degenerate but correct (and must not recurse forever)."""
+    def run(paging):
+        op = _mk_op(paging)
+        out = []
+        _feed(op, np.arange(16, dtype=np.int64), 10, out, batch=8)
+        out += op.end_input()
+        return _digests(out)
+    assert run(PagingConfig(1)) == run(None)
+
+
+def test_oversized_batch_splits_instead_of_overflowing():
+    """A single batch with more distinct keys than K_cap/2 splits
+    host-side and still produces the resident run's digests."""
+    def run(paging):
+        op = _mk_op(paging)
+        out = []
+        keys = np.arange(2048, dtype=np.int64)
+        _feed(op, keys, 10, out, batch=2048)   # one batch >> K_cap=256
+        out += op.end_input()
+        return _digests(out)
+    assert run(PagingConfig(256)) == run(None)
+
+
+# ---------------------------------------------------------------------------
+# snapshots: restore at a different K_cap, across tiers, and rescale
+# ---------------------------------------------------------------------------
+
+def _run_with_cut(p_before, p_after, n_keys=4096, cut_at=10, seed=3):
+    """Feed 2 windows x 2 passes; snapshot mid-window-0 at batch ``cut_at``
+    and continue in a fresh operator configured with ``p_after``."""
+    rng = np.random.default_rng(seed)
+    plan = []
+    for w in range(2):
+        for _ in range(2):
+            keys = rng.permutation(n_keys).astype(np.int64)
+            for lo in range(0, n_keys, 512):
+                plan.append((keys[lo: lo + 512], w))
+    op = _mk_op(p_before)
+    out = []
+    lastw = 0
+    for i, (k, w) in enumerate(plan):
+        if i == cut_at:
+            snap = op.snapshot_state()
+            op = _mk_op(p_after)
+            op.restore_state(snap)
+        if w != lastw:
+            out += op.process_watermark(Watermark(lastw * 1000 + 999))
+            lastw = w
+        v = (k % 17 + 1).astype(np.float32)
+        out += op.process_batch(RecordBatch(
+            {"k": k, "v": v}, timestamps=np.full(k.size, w * 1000 + 10,
+                                                 np.int64)))
+    out += op.process_watermark(Watermark(lastw * 1000 + 999))
+    out += op.end_input()
+    return _digests(out)
+
+
+def test_restore_at_smaller_and_larger_k_cap():
+    ref = _run_with_cut(None, None)
+    assert _run_with_cut(PagingConfig(1024), PagingConfig(256)) == ref
+    assert _run_with_cut(PagingConfig(256), PagingConfig(2048)) == ref
+
+
+def test_savepoint_compat_resident_to_paged_and_back():
+    """ISSUE satellite: a savepoint written by a fully-resident run
+    restores into a paging run with a smaller K_cap, and vice versa, with
+    identical fire digests."""
+    ref = _run_with_cut(None, None)
+    assert _run_with_cut(None, PagingConfig(512)) == ref
+    assert _run_with_cut(PagingConfig(512), None) == ref
+
+
+def test_paged_snapshot_rescales_through_redistribute():
+    """The paged snapshot is the repo-standard dense keyed format:
+    split_keyed_snapshot + merge round-trips it (rescale compatibility)."""
+    op = _mk_op(PagingConfig(256))
+    out = []
+    _feed(op, np.arange(2000, dtype=np.int64), 10, out)
+    snap = op.snapshot_state()
+    parts = WindowAggOperator.split_snapshot(snap, 128, 4)
+    assert len(parts) == 4
+    sizes = [len(p["key_index"]["reverse"]) for p in parts]
+    assert sum(sizes) == 2000 and all(s > 0 for s in sizes)
+    merged = WindowAggOperator.merge_snapshots(parts)
+    op2 = _mk_op(PagingConfig(512))
+    op2.restore_state(merged)
+    out2 = op2.process_watermark(Watermark(999)) + op2.end_input()
+    d = _digests(out2)
+    assert len(d) == 2000
+    assert d == _digests(op_reference_fire())
+
+
+def op_reference_fire():
+    op = _mk_op(None)
+    out = []
+    _feed(op, np.arange(2000, dtype=np.int64), 10, out)
+    out += op.process_watermark(Watermark(999))
+    out += op.end_input()
+    return out
+
+
+def test_snapshot_reports_paging_stats():
+    op = _mk_op(PagingConfig(256))
+    out = []
+    _feed(op, np.arange(1000, dtype=np.int64), 10, out)
+    snap = op.snapshot_state()
+    st = snap["paging_stats"]
+    assert st["resident_keys"] == 256 and st["spilled_keys"] == 744
+
+
+# ---------------------------------------------------------------------------
+# occupancy metrics: job scope + stats surface
+# ---------------------------------------------------------------------------
+
+def test_paging_metrics_register_on_job_scope():
+    from flink_tpu.metrics.groups import (MetricRegistry, PAGING_EVICTIONS,
+                                          PAGING_PROMOTIONS,
+                                          PAGING_RESIDENT_KEYS,
+                                          PAGING_SPILLED_KEYS,
+                                          paging_metrics)
+    op = _mk_op(PagingConfig(256))
+    out = []
+    _feed(op, np.arange(1000, dtype=np.int64), 10, out)
+    reg = MetricRegistry()
+    group = reg.job_manager_group()
+    paging_metrics(group, op.paging_stats)
+    metrics = {k.split(".", 1)[-1]: m for k, m in reg.all_metrics().items()}
+    assert metrics[PAGING_RESIDENT_KEYS].get_value() == 256
+    assert metrics[PAGING_SPILLED_KEYS].get_value() == 744
+    assert metrics[PAGING_EVICTIONS].get_value() > 0
+    assert metrics[PAGING_PROMOTIONS].get_value() >= 0
+
+
+def test_minicluster_job_status_aggregates_paging():
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    n = 6000
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 3000, n)
+    vals = np.ones(n, np.float32)
+    ts = np.sort(rng.integers(0, 2000, n))
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    sink = (env.from_collection(columns={"k": keys, "v": vals, "t": ts},
+                                batch_size=256)
+            .assign_timestamps_and_watermarks(0, timestamp_column="t")
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(1000))
+            .aggregate(SumAggregator(jnp.float32), value_column="v",
+                       emit_tier="device", paging=PagingConfig(512))
+            .collect())
+    env.execute_cluster()
+    cluster = env._last_cluster
+    status = cluster.job_status()
+    assert "paging" in status
+    assert status["paging"]["evictions"] > 0
+    assert status["paging"]["capacity"] == 512
+    names = set(cluster.metrics_registry.all_metrics())
+    assert any(k.endswith("paging.resident_keys") for k in names)
+    total = sum(r["result"] for r in sink.rows())
+    assert total == float(n)
+
+
+# ---------------------------------------------------------------------------
+# scale: the acceptance variant (tier-1) + the 1M stress (slow)
+# ---------------------------------------------------------------------------
+
+def _scale_run(paging, n_keys, extra_refeed=0, seed=13, batch=1 << 15):
+    op = _mk_op(paging, window_ms=1000,
+                capacity_hint=1 << 10 if paging else n_keys)
+    rng = np.random.default_rng(seed)
+    out = []
+    for w in range(2):
+        _feed(op, rng.permutation(n_keys).astype(np.int64),
+              w * 1000 + 10, out, batch)
+        if extra_refeed and w == 0:
+            # re-touch a spilled slice while its pane is live -> promotions
+            _feed(op, np.arange(extra_refeed, dtype=np.int64),
+                  w * 1000 + 10, out, batch)
+        out += op.process_watermark(Watermark(w * 1000 + 999))
+    out += op.end_input()
+    return _digests(out), op
+
+
+def test_acceptance_64k_cap_256k_keys_digest_identical():
+    """THE acceptance run: K_cap = 64k forced far below 256k live keys.
+    Every key fires in every window (spilled keys fold into fires), the
+    digests match the fully-resident run exactly, and the occupancy
+    counters prove the ring ran as a cache."""
+    n_keys = 256 * 1024
+    cap = 64 * 1024
+    ref, _ = _scale_run(None, n_keys, extra_refeed=cap)
+    paged, op = _scale_run(PagingConfig(cap), n_keys, extra_refeed=cap)
+    assert len(ref) == 2 * n_keys
+    assert paged == ref
+    st = op.paging_stats()
+    assert st["resident_keys"] == cap
+    assert st["spilled_keys"] == n_keys - cap
+    assert st["evictions"] >= n_keys - cap
+    assert st["promotions"] > 0
+
+
+@pytest.mark.slow
+def test_eviction_stress_1m_keys():
+    """1M keys through a 64k-row ring: the eviction path at scale.  The
+    digest check is against per-key expectations (a 1M-key resident
+    reference run would double the runtime for no extra coverage)."""
+    n_keys = 1 << 20
+    cap = 64 * 1024
+    d, op = _scale_run(PagingConfig(cap), n_keys, batch=1 << 15)
+    assert len(d) == 2 * n_keys
+    # every (window, key) present exactly once with the exact sum
+    expect = sorted((w * 1000, k, float(np.float32(k % 17 + 1)))
+                    for w in range(2) for k in range(n_keys))
+    assert d == expect
+    st = op.paging_stats()
+    assert st["resident_keys"] == cap
+    assert st["evictions"] >= n_keys - cap
