@@ -427,6 +427,14 @@ class Subtask(SubtaskBase):
                 progressed = True
                 self._handle(i, el)
             if not progressed:
+                # input momentarily empty: the driver decides this is a
+                # pipeline flush point — complete the operator's in-flight
+                # hot stages rather than letting results wait on the NEXT
+                # batch's arrival (no-op for non-pipelined operators;
+                # getattr: duck-typed test operators need not subclass)
+                flush = getattr(self.operator, "flush_pipeline", None)
+                if flush is not None:
+                    self._emit(flush())
                 # nothing readable: brief blocking poll on one open channel
                 t0 = time.monotonic_ns()
                 for i, ch in enumerate(self.inputs):

@@ -745,11 +745,17 @@ class WindowedStream:
                   output_column: str = "result",
                   name: str = "window-agg",
                   emit_tier: Optional[str] = None,
-                  paging=None) -> DataStream:
+                  paging=None,
+                  pipeline_depth: int = 0,
+                  native_shards: int = 0) -> DataStream:
         """``paging``: a :class:`flink_tpu.state.paging.PagingConfig` caps
         the operator's resident key capacity — cold keys page out to the
         spill tier (state larger than HBM).  ``emit_tier`` overrides the
-        operator's auto tier pick ("host"/"device")."""
+        operator's auto tier pick ("host"/"device").  ``pipeline_depth`` >
+        0 runs the operator's hot stage (probe/mirror + device dispatch)
+        as a bounded software pipeline overlapping the task driver;
+        ``native_shards`` partitions the native probe across cores (0 =
+        auto) — both bit-identical to the serial defaults."""
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
         late_tag = getattr(self, "_late_tag", None)
@@ -846,7 +852,10 @@ class WindowedStream:
                     return MeshWindowAggOperator(mesh=mesh, **kwargs)
                 if emit_tier is not None:
                     kwargs["emit_tier"] = emit_tier
-                return WindowAggOperator(paging=paging, **kwargs)
+                return WindowAggOperator(paging=paging,
+                                         pipeline_depth=pipeline_depth,
+                                         native_shards=native_shards,
+                                         **kwargs)
 
         t = keyed._then(name, factory)
         return DataStream(keyed.env, t)

@@ -40,7 +40,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     if not os.path.exists(so_path):
         os.makedirs(_BUILD_DIR, exist_ok=True)
         tmp = so_path + f".tmp.{os.getpid()}"
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
                "-fvisibility=hidden", "-o", tmp, _SRC]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -134,7 +134,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.wm_live_panes.restype = None
     lib.wm_live_panes.argtypes = [vp, vp]
     lib.wm_probe_update.restype = None
-    lib.wm_probe_update.argtypes = [vp, vp, vp, i64, vp, u8p, vp, i64, vp]
+    lib.wm_probe_update.argtypes = [vp, vp, vp, i64, vp, u8p, vp, i64, vp,
+                                    i64, i32, i32]
+    lib.fn_hw_threads.restype = i32
+    lib.fn_hw_threads.argtypes = []
     lib.wm_fire.restype = i64
     lib.wm_fire.argtypes = [vp, vp, i32, vp, vp, vp]
     lib.wm_export_pane.restype = i32

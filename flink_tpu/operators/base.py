@@ -91,6 +91,14 @@ class StreamOperator:
         """Bounded-input flush (``BoundedOneInput.endInput`` analog)."""
         return []
 
+    def flush_pipeline(self) -> List[StreamElement]:
+        """Pipeline barrier hook: operators that pipeline their hot path
+        (``WindowAggOperator`` with ``pipeline_depth > 0``) complete every
+        in-flight stage here.  Task drivers call it at idle points — input
+        momentarily empty, source exhausted — so pipelined work never waits
+        on the NEXT batch's arrival.  Default: no-op."""
+        return []
+
     # -- checkpointing -------------------------------------------------------
     def prepare_snapshot_pre_barrier(self) -> List[StreamElement]:
         """Called BEFORE the barrier is forwarded / the snapshot is taken:

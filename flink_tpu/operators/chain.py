@@ -72,6 +72,13 @@ class ChainedOperator(StreamOperator):
             out.extend(self._feed(i + 1, op.end_input()))
         return out
 
+    def flush_pipeline(self) -> List[StreamElement]:
+        """Driver idle hook: barrier every chained operator's pipeline."""
+        out: List[StreamElement] = []
+        for i, op in enumerate(self.operators):
+            out.extend(self._feed(i + 1, op.flush_pipeline()))
+        return out
+
     def on_latency_marker(self, marker):
         """Markers flow around user functions; a recording member (sink)
         consumes them, otherwise the marker continues downstream."""

@@ -124,6 +124,134 @@ def _handle_ready(sliced) -> bool:
 
 from flink_tpu.ops.shapes import next_pow2 as _next_pow2  # noqa: E402
 
+#: flat scatter id for padding rows: INT32_MAX is out of range for any
+#: [K_cap * P] state, so XLA's mode="drop" scatter discards it at EVERY
+#: capacity — unlike K*P, it stays a dropped id across mid-stage key growth
+_PAD_ID = np.int32(np.iinfo(np.int32).max)
+
+
+def _device_trace():
+    """``jax.profiler`` annotation around the jitted device step: nests the
+    dispatch under "window_agg.device_step" in profiler traces
+    (``bench.py --profile``); a cheap no-op when no trace is active."""
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation("window_agg.device_step")
+    except Exception:  # noqa: BLE001 — profiler unavailable: plain no-op
+        import contextlib
+        return contextlib.nullcontext()
+
+
+class _HotPipeline:
+    """Single background worker running hot-path stages IN ORDER.
+
+    The two-stage software pipeline of ``WindowAggOperator.process_batch``:
+    the fused host probe/mirror + device dispatch of batch N runs on this
+    worker while the main thread returns to the driver (source decode,
+    channel IO, the next batch's serial front) and while the device executes
+    batch N-1's async dispatch.  Exactly one worker — stages are strictly
+    sequential, so state mutation order (and thus every fire digest,
+    snapshot, and counter) is identical to the serial path; only the thread
+    that runs them changes.  ``depth`` bounds the QUEUE: ``submit`` blocks
+    once ``depth`` stages are queued, so at most ``depth + 1`` batches are
+    held (queued plus the one executing) — the memory/backpressure bound.
+
+    Errors: a stage exception parks the worker (later stages are skipped)
+    and re-raises at EVERY subsequent ``flush()``/``submit()`` — the error
+    is STICKY, never consumed: a metrics/REST poller flushing from a
+    foreign thread (``job_status()`` -> ``paging_stats()``) must not steal
+    the failure from the task thread, whose own next barrier still has to
+    fail the task.  Only ``close()`` clears it.
+    """
+
+    __slots__ = ("depth", "_q", "_err", "_t")
+
+    def __init__(self, depth: int = 1):
+        import queue
+        self.depth = max(1, int(depth))
+        self._q = queue.Queue(maxsize=self.depth)
+        self._err: Optional[BaseException] = None
+        self._t = None
+
+    def _loop(self):
+        while True:
+            fn = self._q.get()
+            try:
+                if fn is None:
+                    return
+                if self._err is None:
+                    fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised at flush
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def submit(self, fn) -> None:
+        if self._err is not None:
+            self.flush()
+        if self._t is None:
+            import threading
+            self._t = threading.Thread(target=self._loop, daemon=True,
+                                       name="winagg-pipeline")
+            self._t.start()
+        self._q.put(fn)  # blocks at depth: bounded pipeline
+
+    def pending(self) -> bool:
+        return self._q.unfinished_tasks > 0
+
+    def flush(self) -> None:
+        """Barrier: block until every submitted stage completed.  A parked
+        stage error re-raises here and STAYS parked (see class docstring)."""
+        if self._t is not None:
+            self._q.join()
+        if self._err is not None:
+            raise self._err
+
+    def close(self) -> None:
+        self._err = None
+        if self._t is not None:
+            self._q.put(None)
+            self._t.join(timeout=10)
+            self._t = None
+
+
+class _Staging:
+    """One reusable padded upload set: the int32 flat-id buffer plus one
+    pow2-padded buffer per value leaf.  ``token`` is the device array the
+    consuming dispatch produced — the set is free for reuse once that
+    execution finished (``is_ready``), which protects against backends that
+    zero-copy alias host numpy buffers into dispatched computations."""
+
+    __slots__ = ("flat", "bufs", "treedef", "token")
+
+    def __init__(self, Bp: int, leaves, treedef):
+        self.flat = np.empty(Bp, np.int32)
+        self.bufs = [np.empty((Bp,) + a.shape[1:], a.dtype) for a in leaves]
+        self.treedef = treedef
+        self.token = None
+
+    def ready(self) -> bool:
+        tok = self.token
+        if tok is None:
+            return True
+        try:
+            return bool(tok.is_ready())
+        except Exception:  # noqa: BLE001 — deleted (donated) or no API:
+            return False   # provably-finished unknown -> never reuse
+
+    def fill_values(self, leaves, B: int):
+        """Edge-pad the value leaves into the reused buffers (same values
+        as ``_pad_rows``); full-width leaves pass through uncopied."""
+        out = []
+        for buf, a in zip(self.bufs, leaves):
+            if a.shape[0] == buf.shape[0]:
+                out.append(a)  # already pow2: no copy (matches _pad_rows)
+                continue
+            buf[:B] = a
+            buf[B:] = a[-1]
+            out.append(buf)
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
 
 class _PhaseTimer:
     """Accumulates wall time into a dict entry (bench phase breakdown)."""
@@ -172,11 +300,37 @@ class WindowAggOperator(StreamOperator):
         native_emit: bool = True,
         device_sync: str = "auto",
         paging=None,
+        pipeline_depth: int = 0,
+        native_shards: int = 0,
     ):
         #: host tier: use the C++ WinMirror kernels (fused probe+mirror,
         #: compacting fire) when eligible; False pins the numpy mirror —
         #: used by equivalence tests, and the portable fallback either way
         self.native_emit = native_emit
+        #: two-stage software pipeline (0 = serial): the hot stage (fused
+        #: probe/mirror + paging + device dispatch) of batch N runs on a
+        #: background worker, overlapping the driver's serial front for
+        #: batch N+1 and the device's async compute of batch N-1.  Barriers
+        #: at every state READ — fires, snapshots, watermark advances that
+        #: pass a window end, expiry with lateness, verification — keep
+        #: fire digests, snapshots, and counters bit-identical to the
+        #: serial path; ``depth`` bounds queued stages (at most depth + 1
+        #: batches held, queued plus executing).  Count triggers read
+        #: device counts inside process_batch, so they pin serial.
+        if int(pipeline_depth) < 0:
+            raise ValueError("pipeline_depth must be >= 0")
+        self.pipeline_depth = int(pipeline_depth)
+        self._pipe: Optional[_HotPipeline] = None
+        #: native probe shard count (0 = auto: FLINK_TPU_NATIVE_SHARDS or
+        #: one per core up to 4).  >1 hash-partitions the fused C probe's
+        #: mirror fold across the native worker pool — disjoint slot
+        #: ownership, lock-free, bit-identical at any count.
+        self.native_shards = int(native_shards)
+        self._nm_shards = 1
+        #: reusable padded staging sets keyed by (Bp, value tree spec):
+        #: scatter-mode dispatch reuses the flat-id and padded value
+        #: buffers across batches instead of reallocating per batch
+        self._staging_pool: Dict[tuple, List[_Staging]] = {}
         self._nm = None          # NativeWindowMirror when active
         self._nm_tried = False
         #: sideOutputLateData: beyond-lateness records emit as TaggedBatch
@@ -296,19 +450,21 @@ class WindowAggOperator(StreamOperator):
         # ---- device sync cadence (host tier only): how the device replica
         # tracks the authoritative host mirror.  "scatter": every micro-batch
         # dispatches the jitted scatter-combine — the device is continuously
-        # current (right on direct PCIe/ICI links, where dispatch is ~free,
-        # and on the CPU backend, where there is no transport at all).
+        # current (right on direct PCIe/ICI links, where dispatch is ~free).
         # "deferred": per-record dispatch is skipped and the replica
         # refreshes from the mirror at sync points (``device_refresh``:
         # restore, verification, idle) — right on TAXED transports (tunnel/
         # proxy links) where executing a dispatched step costs the host tens
         # of CPU-ms per uploaded MB and that CPU is stolen from the native
         # hot path (utils/transport.py; the ingress twin of the emit-tier
-        # download finding).  "auto" self-calibrates: the first operator on
-        # an accelerator backend measures its own first few update steps and
-        # the verdict is shared process-wide; the CPU backend always
-        # scatters.  Outside the host tier (device fires, sharded/mesh
-        # state) the device IS the authority and always scatters.
+        # download finding), and on slow CPU hosts, where the XLA scatter's
+        # ~0.5µs/update replica maintenance dwarfs the native mirror fold.
+        # "auto" self-calibrates on EVERY backend: the first host-tier
+        # operator measures its own first few real update steps and the
+        # verdict is shared process-wide; sub-MB batches never sample and
+        # settle on scatter (deterministic for unit-sized traffic).
+        # Outside the host tier (device fires, sharded/mesh state) the
+        # device IS the authority and always scatters.
         if device_sync not in ("auto", "scatter", "deferred"):
             raise ValueError(f"device_sync must be auto|scatter|deferred, "
                              f"got {device_sync!r}")
@@ -470,6 +626,8 @@ class WindowAggOperator(StreamOperator):
         """Drop all keyed state/time progress but KEEP compiled steps (the
         jit caches key on this instance).  Used by benchmarks/tests to re-run
         a warm operator, and by restore paths before loading a snapshot."""
+        self.flush_pipeline()  # in-flight stages still write this state
+        self._staging_pool = {}
         self.key_index = None
         self._leaves = None
         self._counts = None
@@ -544,9 +702,46 @@ class WindowAggOperator(StreamOperator):
         if self._nm_tried or self.emit_tier != "host" or not self.native_emit:
             return
         self._nm_tried = True
-        from flink_tpu.state.native_mirror import NativeWindowMirror
+        from flink_tpu.state.native_mirror import (NativeWindowMirror,
+                                                   calibrated_shards)
         self._nm = NativeWindowMirror.try_create(
             self.key_index, self.spec, self.kinds, self._mirror_dtypes)
+        if self._nm is not None:
+            # 0 = auto: MEASURED once per process (steal-heavy vCPUs often
+            # lose with extra shards — calibrated_shards A/Bs it)
+            self._nm_shards = self.native_shards or calibrated_shards()
+
+    # ------------------------------------------------------------- pipeline
+    def _pipe_active(self) -> bool:
+        """Pipelining applies to the time-triggered hot path only: count
+        triggers read device counts inside ``process_batch`` itself, which
+        would force a barrier per batch (i.e. the serial path anyway)."""
+        return self.pipeline_depth > 0 and not self.trigger.fires_on_count
+
+    def _pipe_pending(self) -> bool:
+        return self._pipe is not None and self._pipe.pending()
+
+    def flush_pipeline(self) -> List[StreamElement]:
+        """Pipeline barrier: complete every in-flight hot stage.  Called
+        internally before any state read (fires, snapshots, verification)
+        and by task drivers at idle points so pipelined results never wait
+        on the NEXT batch's arrival.  Safe no-op when pipelining is off."""
+        if self._pipe is not None:
+            self._pipe.flush()
+        return []
+
+    def _staging_acquire(self, Bp: int, leaves, treedef) -> _Staging:
+        key = (Bp, treedef,
+               tuple((a.dtype.str, a.shape[1:]) for a in leaves))
+        pool = self._staging_pool.setdefault(key, [])
+        for st in pool:
+            if st.ready():
+                st.token = None
+                return st
+        st = _Staging(Bp, leaves, treedef)
+        if len(pool) < 4:  # bounded: beyond that, dispatch is the backlog
+            pool.append(st)
+        return st
 
     def _resolve_device_sync(self) -> str:
         """Resolved sync cadence for this batch: "scatter", "deferred", or
@@ -560,24 +755,26 @@ class WindowAggOperator(StreamOperator):
         elif self.device_sync == "deferred":
             self.device_sync_mode = "deferred"
         else:  # auto
-            if jax.default_backend() == "cpu":
-                # the "device" is this host: nothing to tax, and staying
-                # scatter keeps CPU-backend behavior deterministic
+            # EVERY backend calibrates, the CPU backend included: there the
+            # "transport" is the XLA dispatch compute itself — a CPU scatter
+            # costs ~0.5µs/update (measured; independent of state size), so
+            # on slow boxes the per-batch replica sync dwarfs the entire
+            # native mirror fold.  Small-batch workloads never produce a
+            # calibration sample (transport.MIN_SAMPLE_MB) and settle on
+            # scatter — deterministic for unit-test-sized traffic.
+            from flink_tpu.utils import transport
+            taxed = transport.dispatch_taxed()
+            if taxed is None:
+                if self._calib_batches < 8:
+                    self._calib_batches += 1
+                    return "calibrating"
+                # batches too small to ever yield a calibration sample
+                # (transport.MIN_SAMPLE_MB): stop probing — scatter,
+                # without the per-batch measurement block
                 self.device_sync_mode = "scatter"
             else:
-                from flink_tpu.utils import transport
-                taxed = transport.dispatch_taxed()
-                if taxed is None:
-                    if self._calib_batches < 8:
-                        self._calib_batches += 1
-                        return "calibrating"
-                    # batches too small to ever yield a calibration sample
-                    # (transport.MIN_SAMPLE_MB): stop probing — scatter,
-                    # without the per-batch measurement block
-                    self.device_sync_mode = "scatter"
-                else:
-                    self.device_sync_mode = ("deferred" if taxed
-                                             else "scatter")
+                self.device_sync_mode = ("deferred" if taxed
+                                         else "scatter")
         return self.device_sync_mode
 
     def _mirror_columns(self, panes, rows: int,
@@ -638,6 +835,7 @@ class WindowAggOperator(StreamOperator):
         slots without a live pane reset to identity, which also folds in
         any expirations skipped while deferred; uploaded bytes scale with
         live panes.  No-op when the replica is already current."""
+        self.flush_pipeline()
         if not self._device_stale:
             return
         self._device_stale = False
@@ -764,6 +962,7 @@ class WindowAggOperator(StreamOperator):
         compare: ring mapping, dtype casts, expiry folds) rather than
         continuous per-batch equality — which deferred mode by design does
         not maintain between sync points."""
+        self.flush_pipeline()
         if self.device_sync_mode == "deferred":
             self.device_refresh()
         if self.emit_tier != "host" or self._leaves is None \
@@ -860,7 +1059,10 @@ class WindowAggOperator(StreamOperator):
         ones = jnp.ones(flat_ids.shape, jnp.int32)  # device-side: keeps the
         # host→device upload to ids+values only (tunnel bandwidth-bound)
         new_counts = counts.reshape(K * P).at[flat_ids].add(ones, mode="drop").reshape(K, P)
-        return new_leaves, new_counts
+        # scalar completion token: ready exactly when THIS execution
+        # finished — the staging-reuse gate (new_counts itself is donated
+        # into the next step, so its own readiness is unobservable)
+        return new_leaves, new_counts, new_counts[0, 0]
 
     def _fire_core(self, leaves, counts, pane_slots, k_active: int):
         """Shared fire body: slice live rows, gather window panes, combine,
@@ -1107,113 +1309,21 @@ class WindowAggOperator(StreamOperator):
                 panes = panes[live]
 
         pmin, pmax = int(panes.min()), int(panes.max())
-        if self.pane_base is None:
-            self.pane_base = pmin
-            self.max_pane = pmax
-        else:
-            # grow BEFORE extending the live range: the remap copies the
-            # old [pane_base, max_pane], which is alias-free only in the
-            # old ring geometry.  The range extends DOWNWARD too — a
-            # parallel source racing ahead must not make earlier panes
-            # unstorable (only truly expired panes drop, above).
-            new_base = min(self.pane_base, pmin)
-            span = max(self.max_pane, pmax) - new_base + 1
-            if span > self._P:
-                self._ensure_alloc()
-                self._grow_panes(span)
-            self.pane_base = new_base
-            self.max_pane = max(self.max_pane, pmax)
-        span = self.max_pane - self.pane_base + 1
-        if span > self._P:
-            self._ensure_alloc()
-            self._grow_panes(span)
-
-        self._try_native_mirror()
-        sync = self._resolve_device_sync()
         values = self._select(cols)
-        flat_b = None
-        if self._nm is not None:
-            # fused C pass: key probe + mirror write-through + device scatter
-            # ids (the triples are computed once and consumed twice —
-            # VERDICT r3 next #1b).  Deferred sync needs no scatter ids.
-            with self._phase("probe_mirror"):
-                lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
-                    self.agg.host_lift(values))]
-                if sync == "deferred":
-                    slots = self._nm.probe_update(keys, panes, lifted)
-                else:
-                    flat_b = np.empty(len(batch), np.int32)
-                    slots = self._nm.probe_update(keys, panes, lifted,
-                                                  pane_mod=self._P,
-                                                  flat_out=flat_b)
-        else:
-            with self._phase("probe"):
-                slots = self.key_index.lookup_or_insert(keys)
-        if self._pager is None and self.key_index.num_keys > self._K:
-            self._ensure_alloc()
-            self._grow_keys(self.key_index.num_keys)
-
-        self._ensure_alloc()
-        if self._pager is not None:
-            # translate global key ids -> resident HBM rows, paging cold
-            # keys out / promoted keys in (batched device dispatches)
-            with self._phase("paging"):
-                slots = self._page_slots(slots)
-        if sync == "deferred":
-            # taxed transport: skip the per-batch dispatch; the mirror (the
-            # authoritative copy in this mode) absorbs the batch above and
-            # the device replica catches up at the next device_refresh()
-            self._device_stale = True
-        else:
-            # ---- pad to pow2 batch size (static shapes; pads dropped via
-            # slot id K*P)
+        if self._pipe_active():
+            # two-stage software pipeline: the hot stage (probe/mirror +
+            # paging + device dispatch) runs on the background worker while
+            # the main thread returns to the driver and the device executes
+            # earlier dispatches.  Every state READ barriers through
+            # flush_pipeline() (fires, snapshots, expiry, verification), so
+            # observable behaviour is bit-identical to the serial path.
+            if self._pipe is None:
+                self._pipe = _HotPipeline(self.pipeline_depth)
             B = len(batch)
-            Bp = _next_pow2(B, 64)
-            if flat_b is not None:
-                flat_p = np.full(Bp, self._K * self._P, np.int32)
-                flat_p[:B] = flat_b
-            else:
-                flat = slots.astype(np.int64) * self._P + (panes % self._P)
-                flat_p64 = np.full(Bp, self._K * self._P, np.int64)
-                flat_p64[:B] = flat
-                flat_p = flat_p64.astype(np.int32)
-            values_p = jax.tree_util.tree_map(
-                lambda a: _pad_rows(np.asarray(a), Bp), values)
-
-            # np (not device) ids: the jit converts at dispatch, and the mesh
-            # subclass re-routes them through the all_to_all exchange
-            # host-side
-            with self._phase("device_dispatch"):
-                self._leaves, self._counts = self._update_step(
-                    self._leaves, self._counts, flat_p, values_p)
-            mb = (flat_p.nbytes + sum(a.nbytes for a in
-                                      jax.tree_util.tree_leaves(values_p)))
-            self.phase_bytes["h2d"] = self.phase_bytes.get("h2d", 0) + mb
-            if sync == "calibrating":
-                # self-calibration: until-ready wall of this REAL step is
-                # the honest dispatch cost (compile/queue noise is filtered
-                # by transport.py taking the min across samples)
-                from flink_tpu.utils import transport
-                t0 = time.perf_counter()
-                jax.block_until_ready(self._counts)
-                transport.record_dispatch_cost(mb / 1e6,
-                                               time.perf_counter() - t0)
-
-        # host emit mirror: record which (key, pane) cells this batch filled
-        # (unsharded device tier; the host tier's value mirror carries exact
-        # counts, subsuming the boolean mirror; sharded fires read the
-        # device mask instead)
-        if self.emit_tier == "host":
-            if self._nm is None:  # native path already folded in probe_mirror
-                with self._phase("mirror"):
-                    self._vmirror_update(slots, panes, values)
-        elif self.sharding is None:
-            uniq_panes = np.unique(panes)
-            if uniq_panes.size == 1:
-                self._mirror_mark(int(uniq_panes[0]), slots)
-            else:
-                for p in uniq_panes.tolist():
-                    self._mirror_mark(int(p), slots[panes == p])
+            self._pipe.submit(lambda: self._hot_stage(keys, panes, values,
+                                                      B, pmin, pmax))
+        else:
+            self._hot_stage(keys, panes, values, len(batch), pmin, pmax)
 
         out: List[StreamElement] = list(pending)
         # ---- count-trigger (GlobalWindows / countWindow path)
@@ -1234,6 +1344,9 @@ class WindowAggOperator(StreamOperator):
                 # skips the np.unique below, ~ms per hot-path batch
                 and self.assigner.windows_of_pane(pmin)[0]
                 <= self.last_fired_window):
+            # re-fires read the mirror/device state: barrier first (rare —
+            # only batches touching already-fired windows land here)
+            self.flush_pipeline()
             touched = np.unique(panes)
             refire: List[int] = []
             for p in touched.tolist():
@@ -1251,9 +1364,187 @@ class WindowAggOperator(StreamOperator):
                 out.extend(self._fire_window(w))
         return out
 
+    def _hot_stage(self, keys: np.ndarray, panes: np.ndarray, values,
+                   B: int, pmin: int, pmax: int) -> None:
+        """The pipelined hot stage of one micro-batch: pane-ring
+        bookkeeping/growth, the fused probe/mirror pass, key growth,
+        paging, and the device dispatch.  Runs inline when pipelining is
+        off, on the ``_HotPipeline`` worker when on — the SAME code in the
+        SAME order either way, so fire digests, snapshots, and counters
+        cannot diverge between the two modes."""
+        if self.pane_base is None:
+            self.pane_base = pmin
+            self.max_pane = pmax
+        else:
+            # grow BEFORE extending the live range: the remap copies the
+            # old [pane_base, max_pane], which is alias-free only in the
+            # old ring geometry.  The range extends DOWNWARD too — a
+            # parallel source racing ahead must not make earlier panes
+            # unstorable (only truly expired panes drop in the gate).
+            new_base = min(self.pane_base, pmin)
+            span = max(self.max_pane, pmax) - new_base + 1
+            if span > self._P:
+                self._ensure_alloc()
+                self._grow_panes(span)
+            self.pane_base = new_base
+            self.max_pane = max(self.max_pane, pmax)
+        span = self.max_pane - self.pane_base + 1
+        if span > self._P:
+            self._ensure_alloc()
+            self._grow_panes(span)
+
+        self._try_native_mirror()
+        sync = self._resolve_device_sync()
+        staging = None
+        flat_ready = False
+        # flatten the value tree ONCE per batch: staging acquisition and
+        # the padded fill both consume (leaves, treedef)
+        val_leaves = None
+        val_treedef = None
+
+        def flat_values():
+            nonlocal val_leaves, val_treedef
+            if val_leaves is None:
+                val_leaves = [np.asarray(a) for a in
+                              jax.tree_util.tree_leaves(values)]
+                val_treedef = jax.tree_util.tree_structure(values)
+            return val_leaves, val_treedef
+        if self._nm is not None:
+            # fused C pass: key probe + mirror write-through + device scatter
+            # ids (the triples are computed once and consumed twice —
+            # VERDICT r3 next #1b), sharded across the native worker pool
+            # when native_shards > 1.  Deferred sync needs no scatter ids.
+            with self._phase("probe_mirror"):
+                lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+                    self.agg.host_lift(values))]
+                if sync == "deferred":
+                    slots = self._nm.probe_update(keys, panes, lifted,
+                                                  shards=self._nm_shards)
+                else:
+                    # the C pass writes flat ids + padding tail straight
+                    # into the reusable staging buffer — dispatch-ready
+                    lv, td = flat_values()
+                    staging = self._staging_acquire(_next_pow2(B, 64),
+                                                    lv, td)
+                    slots = self._nm.probe_update(
+                        keys, panes, lifted, pane_mod=self._P,
+                        flat_out=staging.flat, flat_fill=int(_PAD_ID),
+                        shards=self._nm_shards)
+                    flat_ready = True
+        else:
+            with self._phase("probe"):
+                slots = self.key_index.lookup_or_insert(keys)
+        if self._pager is None and self.key_index.num_keys > self._K:
+            self._ensure_alloc()
+            self._grow_keys(self.key_index.num_keys)
+
+        self._ensure_alloc()
+        if self._pager is not None:
+            # translate global key ids -> resident HBM rows, paging cold
+            # keys out / promoted keys in (batched device dispatches).
+            # Pipelined or not, the pager sees this batch's slots BEFORE
+            # any later batch can influence eviction decisions: stages are
+            # strictly ordered on the single pipeline worker.
+            with self._phase("paging"):
+                slots = self._page_slots(slots)
+        if sync == "deferred":
+            # taxed transport: skip the per-batch dispatch; the mirror (the
+            # authoritative copy in this mode) absorbs the batch above and
+            # the device replica catches up at the next device_refresh()
+            self._device_stale = True
+        else:
+            # ---- pad to pow2 batch size into REUSED staging buffers
+            # (static shapes; pads dropped via the out-of-range _PAD_ID)
+            lv, td = flat_values()
+            if staging is None:
+                staging = self._staging_acquire(_next_pow2(B, 64), lv, td)
+            flat_p = staging.flat
+            if not flat_ready:
+                flat_p[:B] = slots.astype(np.int64) * self._P \
+                    + (panes % self._P)
+                flat_p[B:] = _PAD_ID
+            values_p = staging.fill_values(lv, B)
+
+            # np (not device) ids: the jit converts at dispatch, and the mesh
+            # subclass re-routes them through the all_to_all exchange
+            # host-side
+            t_cal = time.perf_counter() if sync == "calibrating" else 0.0
+            with self._phase("device_dispatch"):
+                with _device_trace():
+                    res = self._update_step(self._leaves, self._counts,
+                                            flat_p, values_p)
+            if len(res) == 3:
+                # the staging set frees once this execution's token is ready
+                self._leaves, self._counts, staging.token = res
+            else:
+                # subclass override without a completion token (mesh): gate
+                # reuse on the counts array itself — donated next step, so
+                # ready() only passes when the execution provably finished
+                self._leaves, self._counts = res
+                staging.token = self._counts
+            mb = (flat_p.nbytes + sum(a.nbytes for a in
+                                      jax.tree_util.tree_leaves(values_p)))
+            self.phase_bytes["h2d"] = self.phase_bytes.get("h2d", 0) + mb
+            if sync == "calibrating":
+                # self-calibration: dispatch-call PLUS until-ready wall of
+                # this REAL step is the honest replica-sync cost — backends
+                # whose dispatch is synchronous (CPU) pay inside the call,
+                # async transports pay in the wait; measuring only the wait
+                # would read a synchronous backend as free.  Compile/queue
+                # noise is filtered by transport.py taking the min across
+                # samples.
+                from flink_tpu.utils import transport
+                jax.block_until_ready(self._counts)
+                transport.record_dispatch_cost(mb / 1e6,
+                                               time.perf_counter() - t_cal)
+
+        # host emit mirror: record which (key, pane) cells this batch filled
+        # (unsharded device tier; the host tier's value mirror carries exact
+        # counts, subsuming the boolean mirror; sharded fires read the
+        # device mask instead)
+        if self.emit_tier == "host":
+            if self._nm is None:  # native path already folded in probe_mirror
+                with self._phase("mirror"):
+                    self._vmirror_update(slots, panes, values)
+        elif self.sharding is None:
+            uniq_panes = np.unique(panes)
+            if uniq_panes.size == 1:
+                self._mirror_mark(int(uniq_panes[0]), slots)
+            else:
+                for p in uniq_panes.tolist():
+                    self._mirror_mark(int(p), slots[panes == p])
+
     # ------------------------------------------------------------------ time
+    def _fired_horizon(self, now: int) -> int:
+        """Largest window id whose maxTimestamp (= end-1) has been passed —
+        the EventTimeTrigger fire condition.  Pure assigner math (no state
+        reads), so the pipelined watermark fast-path may call it while hot
+        stages are still in flight."""
+        a = self.assigner
+        denom = a.pane_stride * a.pane_ms
+        w_max = (now + 1 - a._offset - a.panes_per_window * a.pane_ms) // denom
+        while a.window_bounds(w_max + 1).max_timestamp <= now:
+            w_max += 1
+        while a.window_bounds(w_max).max_timestamp > now:
+            w_max -= 1
+        return w_max
+
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
         self.watermark = max(self.watermark, watermark.timestamp)
+        if (self._pipe_pending() and not self.async_fire
+                and self.lateness == 0
+                and self.trigger.fires_on_time and self.assigner.is_event_time
+                and not isinstance(self.assigner, GlobalWindows)
+                and self.last_fired_window is not None
+                and self._fired_horizon(self.watermark)
+                <= self.last_fired_window):
+            # pipelined fast path: the watermark passed no new window end,
+            # and with lateness 0 pane expiry coincides with fires — so
+            # nothing fires, nothing expires, no state is read, and the
+            # in-flight hot stages STAY in flight.  This is where the
+            # pipeline's overlap comes from on per-batch-watermark drivers.
+            return []
+        self.flush_pipeline()
         if not (self.trigger.fires_on_time and self.assigner.is_event_time):
             # count triggers don't FIRE on time, but window state still
             # retires at window end + lateness (the reference registers
@@ -1281,6 +1572,7 @@ class WindowAggOperator(StreamOperator):
         windows emit nothing — matching the reference, where a trailing
         partial countWindow is dropped at end of input."""
         if isinstance(self.assigner, GlobalWindows):
+            self.flush_pipeline()
             pending = self.drain_pending_fires() if self.async_fire else []
             if self.trigger.fires_on_time:
                 return pending + self._fire_by_count(force=True)
@@ -1297,6 +1589,7 @@ class WindowAggOperator(StreamOperator):
         return int(time.time() * 1000)
 
     def _advance_time(self, now: int) -> List[StreamElement]:
+        self.flush_pipeline()  # fires/expiry below read state
         # async fires from earlier calls surface before any new ones
         _pending = self.drain_pending_fires() if self.async_fire else []
         if self._leaves is None or self.pane_base is None:
@@ -1307,12 +1600,7 @@ class WindowAggOperator(StreamOperator):
         out: List[StreamElement] = list(_pending)
         # largest w whose maxTimestamp (= end-1) has been passed — the fire
         # condition of EventTimeTrigger: watermark >= window.maxTimestamp
-        denom = a.pane_stride * a.pane_ms
-        w_max = (now + 1 - a._offset - a.panes_per_window * a.pane_ms) // denom
-        while a.window_bounds(w_max + 1).max_timestamp <= now:
-            w_max += 1
-        while a.window_bounds(w_max).max_timestamp > now:
-            w_max -= 1
+        w_max = self._fired_horizon(now)
         # bound firing to windows that can contain data ([pane_base, max_pane])
         lo_window = a.windows_of_pane(self.pane_base)[0]
         hi_window = a.windows_of_pane(self.max_pane)[1]
@@ -1752,15 +2040,27 @@ class WindowAggOperator(StreamOperator):
 
     def paging_stats(self) -> Optional[Dict[str, int]]:
         """Occupancy + eviction/promotion counters, or None when paging is
-        off (job-scope ``paging.*`` metrics and bench details read this)."""
+        off (job-scope ``paging.*`` metrics and bench details read this).
+
+        Monitoring-grade: deliberately NO pipeline barrier — metrics/REST
+        pollers call this from foreign threads and must neither block on
+        in-flight hot stages nor receive the task's parked stage error.
+        Under pipelining the counters may lag by the (bounded) in-flight
+        stages; every correctness path (fires, snapshots) barriers."""
         if self._pager is None:
             return None
         n = self.key_index.num_keys if self.key_index is not None else 0
         return self._pager.stats(n)
 
     def close(self) -> None:
-        if self._pager is not None:
-            self._pager.close()
+        try:
+            self.flush_pipeline()
+        finally:
+            if self._pipe is not None:
+                self._pipe.close()
+                self._pipe = None
+            if self._pager is not None:
+                self._pager.close()
 
     def _paged_snapshot_rows(self, n: int, panes: np.ndarray):
         """Dense gid-indexed snapshot arrays merging both tiers:
@@ -1814,11 +2114,13 @@ class WindowAggOperator(StreamOperator):
         Python runtime the same way
         (``AbstractPythonFunctionOperator.prepareSnapshotPreBarrier:173``).
         After this, ``snapshot_state`` is always legal, async_fire included."""
+        self.flush_pipeline()
         if self.async_fire:
             return self.drain_pending_fires(force=True)
         return []
 
     def snapshot_state(self) -> Dict[str, Any]:
+        self.flush_pipeline()  # the snapshot must contain in-flight stages
         if self._pending_fires:
             # the runtime must call prepare_snapshot_pre_barrier first (all
             # in-repo runtimes do); a snapshot with un-drained async fires
@@ -1891,6 +2193,7 @@ class WindowAggOperator(StreamOperator):
         return snap
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.flush_pipeline()
         self.pane_base = snap["pane_base"]
         self.max_pane = snap["max_pane"]
         self.last_fired_window = snap["last_fired_window"]

@@ -272,6 +272,24 @@ class LocalExecutor:
                     barrier: CheckpointBarrier) -> None:
         self._route(rv, [barrier])
 
+
+    @staticmethod
+    def _close_all(plan, running) -> None:
+        """Close every operator even when one close() raises (a pipelined
+        operator surfaces parked hot-stage errors at close): remaining
+        operators must still release their threads/spill files/native
+        handles.  The FIRST error wins and re-raises after the sweep."""
+        first: Optional[BaseException] = None
+        for v in plan.vertices:
+            try:
+                running[v.id].operator.close()
+            except BaseException as e:  # noqa: BLE001 — collected, re-raised
+                if first is None:
+                    first = e
+        if first is not None:
+            raise first
+
+
     # ---------------------------------------------------------------- run
     def execute(self, plan: ExecutionPlan,
                 restore: Optional[Dict[str, Any]] = None,
@@ -315,6 +333,12 @@ class LocalExecutor:
                 try:
                     el = next(it)
                 except StopIteration:
+                    # source exhausted: this vertex goes quiet until the
+                    # bounded-end cascade — flush pipelined operators now
+                    # so their in-flight hot stages don't idle undispatched
+                    flush = getattr(rv.operator, "flush_pipeline", None)
+                    if flush is not None:
+                        self._route(rv, flush())
                     continue
                 # a source vertex's chain may include chained operators:
                 # feed the element through its own operator first
@@ -342,8 +366,7 @@ class LocalExecutor:
         # order.  drain=False (stop-with-savepoint --no-drain analog) keeps
         # in-progress windows unfired so a restore continues them.
         if not drain:
-            for v in plan.vertices:
-                running[v.id].operator.close()
+            self._close_all(plan, running)
             return JobExecutionResult(plan.job_name,
                                       (time.monotonic() - t0) * 1000.0,
                                       self._records,
@@ -357,8 +380,7 @@ class LocalExecutor:
         for v in plan.vertices:
             rv = running[v.id]
             self._route(rv, rv.operator.end_input())
-        for v in plan.vertices:
-            running[v.id].operator.close()
+        self._close_all(plan, running)
         return JobExecutionResult(plan.job_name,
                                   (time.monotonic() - t0) * 1000.0,
                                   self._records,

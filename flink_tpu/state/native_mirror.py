@@ -29,6 +29,7 @@ native key index.  Anything else falls back to the numpy mirror in
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -37,6 +38,102 @@ import numpy as np
 _VDT = {np.dtype(np.float64): 0, np.dtype(np.float32): 1,
         np.dtype(np.int64): 2, np.dtype(np.int32): 3}
 _KINDS = {"add": 0, "min": 1, "max": 2}
+
+
+def auto_shards() -> int:
+    """Default shard count for the native probe: one shard per core up to
+    4 (the pass is memory-latency bound — beyond a few cores the misses in
+    flight saturate the memory controller, and oversubscribing steals CPU
+    from XLA's own thread pool).  ``FLINK_TPU_NATIVE_SHARDS`` overrides."""
+    env = os.environ.get("FLINK_TPU_NATIVE_SHARDS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    cores = 0
+    from flink_tpu.native import get_lib
+    lib = get_lib()
+    if lib is not None and hasattr(lib, "fn_hw_threads"):
+        cores = int(lib.fn_hw_threads())  # what the C worker pool sees
+    return max(1, min(4, cores or os.cpu_count() or 1))
+
+
+import threading
+
+_calibrated_shards: Optional[int] = None
+#: module-scope: lazily creating the lock would itself be a check-then-act
+#: race between the first two calibrating threads
+_calib_lock = threading.Lock()
+
+
+def calibrated_shards() -> int:
+    """MEASURED default shard count, cached process-wide: A/Bs the fused
+    probe serially vs at :func:`auto_shards` on a throwaway keydict+mirror
+    (~tens of ms, once per process) and returns the faster setting.  The
+    core count alone cannot be trusted — on shared or steal-heavy vCPUs a
+    single core's prefetch pipelining already saturates the memory
+    subsystem and extra shards lose — so this is the shard twin of the
+    device-sync transport calibration: measure, don't assume.  Explicit
+    ``FLINK_TPU_NATIVE_SHARDS`` (via auto_shards) short-circuits the
+    measurement."""
+    global _calibrated_shards
+    if _calibrated_shards is not None:
+        return _calibrated_shards
+    with _calib_lock:
+        if _calibrated_shards is not None:
+            return _calibrated_shards
+        auto = auto_shards()
+        if os.environ.get("FLINK_TPU_NATIVE_SHARDS"):
+            _calibrated_shards = auto  # explicit: trust the operator
+            return auto
+        from flink_tpu.native import get_lib
+        lib = get_lib()
+        if auto <= 1 or lib is None or not hasattr(lib, "wm_create"):
+            _calibrated_shards = 1
+            return 1
+        import time
+        n_keys = 1 << 15
+        B = 1 << 15  # >= the C pass's parallel threshold
+        rng = np.random.default_rng(17)
+        keys_all = np.ascontiguousarray(
+            rng.integers(0, n_keys, 3 * B).astype(np.int64))
+        vals_all = np.ascontiguousarray(
+            rng.random(3 * B).astype(np.float32))
+        timings = {}
+        for shards in (1, auto):
+            d = lib.keydict_create(2 * n_keys)
+            kind = (ctypes.c_uint8 * 1)(0)   # add
+            lt = (ctypes.c_uint8 * 1)(0)     # f64 storage
+            init = np.zeros(1, np.uint64)
+            h = lib.wm_create(d, 1, kind, lt,
+                              init.ctypes.data_as(ctypes.c_void_p))
+            vdt = (ctypes.c_uint8 * 1)(1)    # VF32 input
+            warm_k = np.arange(n_keys, dtype=np.int64)
+            warm_p = np.zeros(n_keys, np.int64)
+            warm_v = np.zeros(n_keys, np.float32)
+            warm_s = np.empty(n_keys, np.int32)
+            vptr = (ctypes.c_void_p * 1)(warm_v.ctypes.data)
+            lib.wm_probe_update(h, warm_k.ctypes.data, warm_p.ctypes.data,
+                                n_keys, vptr, vdt, warm_s.ctypes.data,
+                                0, 0, 0, 0, shards)
+            panes = np.zeros(B, np.int64)
+            slots = np.empty(B, np.int32)
+            best = float("inf")
+            for i in range(3):
+                k = np.ascontiguousarray(keys_all[i * B:(i + 1) * B])
+                v = np.ascontiguousarray(vals_all[i * B:(i + 1) * B])
+                vp = (ctypes.c_void_p * 1)(v.ctypes.data)
+                t0 = time.perf_counter()
+                lib.wm_probe_update(h, k.ctypes.data, panes.ctypes.data, B,
+                                    vp, vdt, slots.ctypes.data, 0, 0, 0, 0,
+                                    shards)
+                best = min(best, time.perf_counter() - t0)
+            lib.wm_destroy(h)
+            lib.keydict_destroy(d)
+            timings[shards] = best
+        _calibrated_shards = min(timings, key=timings.get)
+        return _calibrated_shards
 
 
 class NativeWindowMirror:
@@ -98,17 +195,25 @@ class NativeWindowMirror:
     # -- hot path ------------------------------------------------------------
     def probe_update(self, keys: np.ndarray, panes: np.ndarray,
                      lifted: List[np.ndarray], pane_mod: int = 0,
-                     flat_out: Optional[np.ndarray] = None) -> np.ndarray:
+                     flat_out: Optional[np.ndarray] = None,
+                     flat_fill: int = 0, shards: int = 1) -> np.ndarray:
         """Fused probe + mirror fold; returns int32 slot ids for the device
         scatter.  ``lifted`` is the agg's host_lift leaves, one [B] array per
-        ACC leaf.  When ``flat_out`` (int32[n], contiguous) is given, the C
+        ACC leaf.  When ``flat_out`` (int32[>=n], contiguous) is given, the C
         pass also writes the device scatter ids slot * pane_mod +
-        pane %% pane_mod into it — one pass instead of three numpy ops."""
+        pane %% pane_mod into it — one pass instead of three numpy ops —
+        and fills the padding tail flat_out[n:] with ``flat_fill`` (the
+        dropped-row id), so a pow2 staging buffer comes back dispatch-ready.
+        ``shards`` > 1 hash-partitions the fold across the native worker
+        pool (disjoint slot ownership, no locks) — results are bit-identical
+        to the serial pass at any shard count."""
         keys = np.ascontiguousarray(keys, np.int64)
         panes = np.ascontiguousarray(panes, np.int64)
         n = keys.size
         slots = np.empty(n, np.int32)
         if n == 0:
+            if flat_out is not None:
+                flat_out[:] = flat_fill
             return slots
         nl = len(self._mirror_dtypes)
         arrs = []
@@ -121,6 +226,7 @@ class NativeWindowMirror:
             vdt[j] = _VDT[a.dtype]
         vals = (ctypes.c_void_p * nl)(*[a.ctypes.data for a in arrs])
         flat_ptr = 0
+        flat_cap = 0
         if flat_out is not None:
             # hard checks (not asserts): a wrong buffer here is C-side
             # memory corruption, and pane_mod 0 is a divide-by-zero in C
@@ -130,9 +236,11 @@ class NativeWindowMirror:
                     "flat_out must be contiguous int32 with size >= n and "
                     "pane_mod > 0")
             flat_ptr = flat_out.ctypes.data
+            flat_cap = flat_out.size
         self._lib.wm_probe_update(
             self._h, keys.ctypes.data, panes.ctypes.data, n, vals, vdt,
-            slots.ctypes.data, pane_mod, flat_ptr)
+            slots.ctypes.data, pane_mod, flat_ptr, flat_cap,
+            int(flat_fill), max(1, int(shards)))
         return slots
 
     def fire(self, panes: np.ndarray

@@ -33,7 +33,10 @@ from typing import List, Optional, Tuple
 
 #: dispatch cost above this marks the link taxed.  Tunnel transports
 #: measure ~25-40 ms/MB; direct-attached accelerators < 1 ms/MB.  The CPU
-#: backend never consults this (no transport to dodge — auto picks scatter).
+#: backend calibrates too: there the "transport" is the XLA dispatch
+#: compute itself (a CPU scatter costs ~0.5µs/update regardless of state
+#: size), which on slow hosts measures far past this threshold — exactly
+#: the boxes where per-batch replica sync loses to the deferred refresh.
 DISPATCH_TAXED_ABOVE_MS_PER_MB = 6.0
 
 #: samples needed before a verdict; the MIN per-MB cost is used, so the

@@ -15,12 +15,15 @@
 // reference is intended or needed.
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -828,6 +831,90 @@ API void keydict_reverse(void* h, i64* out) {
 }
 
 // ---------------------------------------------------------------------------
+// ShardPool: a small persistent worker pool for the sharded probe/mirror
+// pass.  The hot path is memory-latency bound on one core (every random
+// probe is a cache+TLB miss); a second/third core doubles the number of
+// misses in flight, which is the only parallelism this workload has.  The
+// CALLING thread executes shard 0 inline, pool workers cover shards
+// 1..S-1, so a serial call (S=1) never touches the pool at all.  The pool
+// is process-wide and intentionally leaked (daemon-style threads park on
+// the condvar forever): joining at static destruction would deadlock
+// interpreters that unload the library mid-exit.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct ShardPool {
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  // serializes whole waves: the pool is process-wide, so two threads
+  // sharding concurrently (parallel subtasks in one MiniCluster process)
+  // must not clobber each other's job/active/pending — without this the
+  // second caller rebinds `job` while the first wave's workers still
+  // reference it (use-after-free of the wave lambda).  Concurrent callers
+  // degrade to serialized waves, which is also the honest schedule: they
+  // would be contending for the same cores anyway.
+  std::mutex run_mu;
+  std::condition_variable cv_work, cv_done;
+  std::function<void(int)> job;
+  u64 gen = 0;
+  int active = 0;   // shards in the current wave (including the caller)
+  int pending = 0;  // participating workers not yet finished
+
+  void loop(int tid) {
+    u64 seen = 0;
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      cv_work.wait(lk, [&] { return gen != seen; });
+      seen = gen;
+      if (tid < active) {
+        auto f = job;  // copy: `job` is rebound by the next wave
+        lk.unlock();
+        f(tid);
+        lk.lock();
+        if (--pending == 0) cv_done.notify_all();
+      }
+    }
+  }
+
+  // Run f(tid) for tid in [0, nshards); blocks until every shard returns.
+  void run(int nshards, const std::function<void(int)>& f) {
+    if (nshards <= 1) {
+      f(0);
+      return;
+    }
+    std::lock_guard<std::mutex> wave(run_mu);
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      while ((int)workers.size() < nshards - 1) {
+        int tid = (int)workers.size() + 1;  // caller is shard 0
+        workers.emplace_back([this, tid] { loop(tid); });
+      }
+      job = f;
+      active = nshards;
+      pending = nshards - 1;
+      gen++;
+      cv_work.notify_all();
+    }
+    f(0);
+    std::unique_lock<std::mutex> lk(mu);
+    cv_done.wait(lk, [&] { return pending == 0; });
+  }
+};
+
+ShardPool* shard_pool() {
+  static ShardPool* p = new ShardPool();  // leaked by design, see above
+  return p;
+}
+
+// below this the parallel path costs more than the misses it hides
+static const i64 WM_MIN_PARALLEL = 1 << 14;
+
+}  // namespace
+
+API i32 fn_hw_threads() { return (i32)std::thread::hardware_concurrency(); }
+
+// ---------------------------------------------------------------------------
 // WinMirror: write-through host value mirror of windowed ACC cells.
 //
 // The native fire/mirror/probe hot path of the window operator's HOST emit
@@ -930,19 +1017,46 @@ API void wm_live_panes(void* h, i64* out) {
   for (auto& kv : w->panes) out[i++] = kv.first;
 }
 
-// Fused probe + mirror write-through: one pass maps keys -> slots (shared
-// dict; new keys insert) and folds each record into its pane's row.  Pane
-// pointers are cached across the usual within-batch runs (timestamps arrive
-// roughly sorted), and both the hash probe and the mirror row are
-// software-prefetched — the loop keeps ~8-12 cache misses in flight, which
-// is the only parallelism a single core offers.
-// ``pane_mod``/``flat_out``: when flat_out is non-null, also emit the device
-// scatter ids flat = slot * pane_mod + pane %% pane_mod (int32) — the ids
-// the jitted update step consumes — saving three numpy passes per batch.
-API void wm_probe_update(void* h, const i64* keys, const i64* pane_ids, i64 n,
-                         const void* const* vals, const u8* vdt,
-                         i32* slots_out, i64 pane_mod, i32* flat_out) {
-  auto* w = (WinMirror*)h;
+namespace {
+
+// One record's fold into its mirror row (generic path, any leaf mix).
+static inline void wm_fold_one(WinMirror* w, u8* row, const void* const* vals,
+                               const u8* vdt, i64 k) {
+  (*(i64*)row)++;
+  for (int l = 0; l < w->nl; l++) {
+    u8* cell = row + 8 + 8 * l;
+    if (w->lt[l] == 0) {
+      double x;
+      switch (vdt[l]) {
+        case VF64: x = ((const double*)vals[l])[k]; break;
+        case VF32: x = (double)((const float*)vals[l])[k]; break;
+        case VI64: x = (double)((const i64*)vals[l])[k]; break;
+        default:   x = (double)((const i32*)vals[l])[k]; break;
+      }
+      double* c = (double*)cell;
+      if (w->kind[l] == 0) *c += x;
+      else if (w->kind[l] == 1) { if (x < *c) *c = x; }
+      else { if (x > *c) *c = x; }
+    } else {
+      i64 x;
+      switch (vdt[l]) {
+        case VF64: x = (i64)((const double*)vals[l])[k]; break;
+        case VF32: x = (i64)((const float*)vals[l])[k]; break;
+        case VI64: x = ((const i64*)vals[l])[k]; break;
+        default:   x = (i64)((const i32*)vals[l])[k]; break;
+      }
+      i64* c = (i64*)cell;
+      if (w->kind[l] == 0) *c += x;
+      else if (w->kind[l] == 1) { if (x < *c) *c = x; }
+      else { if (x > *c) *c = x; }
+    }
+  }
+}
+
+static void wm_probe_serial(WinMirror* w, const i64* keys,
+                            const i64* pane_ids, i64 n,
+                            const void* const* vals, const u8* vdt,
+                            i32* slots_out, i64 pane_mod, i32* flat_out) {
   KeyDict* d = w->dict;
   d->reserve(n);
   for (i64 i = 0; i < n; i++) {
@@ -985,39 +1099,138 @@ API void wm_probe_update(void* h, const i64* keys, const i64* pane_ids, i64 n,
     for (i64 k = i; k < j; k++) {
       if (k + PF < j)
         __builtin_prefetch(base + (i64)slots_out[k + PF] * stride, 1);
-      u8* row = base + (i64)slots_out[k] * stride;
-      (*(i64*)row)++;
-      for (int l = 0; l < w->nl; l++) {
-        u8* cell = row + 8 + 8 * l;
-        if (w->lt[l] == 0) {
-          double x;
-          switch (vdt[l]) {
-            case VF64: x = ((const double*)vals[l])[k]; break;
-            case VF32: x = (double)((const float*)vals[l])[k]; break;
-            case VI64: x = (double)((const i64*)vals[l])[k]; break;
-            default:   x = (double)((const i32*)vals[l])[k]; break;
-          }
-          double* c = (double*)cell;
-          if (w->kind[l] == 0) *c += x;
-          else if (w->kind[l] == 1) { if (x < *c) *c = x; }
-          else { if (x > *c) *c = x; }
-        } else {
-          i64 x;
-          switch (vdt[l]) {
-            case VF64: x = (i64)((const double*)vals[l])[k]; break;
-            case VF32: x = (i64)((const float*)vals[l])[k]; break;
-            case VI64: x = ((const i64*)vals[l])[k]; break;
-            default:   x = (i64)((const i32*)vals[l])[k]; break;
-          }
-          i64* c = (i64*)cell;
-          if (w->kind[l] == 0) *c += x;
-          else if (w->kind[l] == 1) { if (x < *c) *c = x; }
-          else { if (x > *c) *c = x; }
-        }
-      }
+      wm_fold_one(w, base + (i64)slots_out[k] * stride, vals, vdt, k);
     }
     i = j;
   }
+}
+
+// Sharded probe+fold: bitwise identical to the serial pass at ANY shard
+// count.  Phase 1 partitions the batch into contiguous record ranges and
+// runs READ-ONLY dict lookups in parallel (no inserts -> the table is
+// immutable during the scan).  Phase 2 inserts the misses serially in
+// batch order, so new keys get exactly the slot ids the serial pass would
+// assign.  Phase 3 folds in parallel with slot-ownership partitioning
+// (shard t owns slots with slot %% S == t): every mirror cell has exactly
+// ONE writer and sees its updates in batch order — no locks, no atomics,
+// and the result is bit-identical, not just equivalent.
+static void wm_probe_sharded(WinMirror* w, const i64* keys,
+                             const i64* pane_ids, i64 n,
+                             const void* const* vals, const u8* vdt,
+                             i32* slots_out, i64 pane_mod, i32* flat_out,
+                             i64 flat_cap, i32 flat_pad, int S) {
+  KeyDict* d = w->dict;
+  d->reserve(n);  // up front: phase 1 must not observe a rehash
+  ShardPool* pool = shard_pool();
+  std::vector<std::vector<i64>> misses((size_t)S);
+  pool->run(S, [&](int t) {
+    const i64 lo = n * t / S, hi = n * (t + 1) / S;
+    auto& miss = misses[(size_t)t];
+    for (i64 i = lo; i < hi; i++) {
+      if (i + KD_PF < hi) d->prefetch(keys[i + KD_PF]);
+      i32 s = d->find(keys[i]);
+      slots_out[i] = s;
+      if (s < 0) miss.push_back(i);
+    }
+  });
+  // serial insert in batch order (ranges are contiguous and ordered, so
+  // concatenating the per-shard miss lists IS the original record order);
+  // duplicate new keys resolve to their first occurrence's slot, exactly
+  // like the serial pass
+  for (int t = 0; t < S; t++)
+    for (i64 i : misses[(size_t)t])
+      slots_out[i] = d->find_or_insert(keys[i]);
+  const i64 need = d->n;
+  // pre-grow every pane this batch touches: the parallel fold must not
+  // mutate the pane map (iterating pane runs costs one sequential scan)
+  {
+    i64 i = 0;
+    while (i < n) {
+      const i64 p = pane_ids[i];
+      w->ensure_pane(p, need);
+      i64 j = i + 1;
+      while (j < n && pane_ids[j] == p) j++;
+      i = j;
+    }
+  }
+  const i64 stride = w->stride;
+  const i64 PF = 16;
+  pool->run(S, [&](int t) {
+    if (flat_out) {
+      // flat device-scatter ids partition by record range (no sharing)
+      const i64 lo = n * t / S, hi = n * (t + 1) / S;
+      for (i64 k = lo; k < hi; k++) {
+        const i64 p = pane_ids[k];
+        const i32 ps = (i32)(((p % pane_mod) + pane_mod) % pane_mod);
+        flat_out[k] = slots_out[k] * (i32)pane_mod + ps;
+      }
+      if (t == S - 1)
+        for (i64 k = n; k < flat_cap; k++) flat_out[k] = flat_pad;
+    }
+    const u32 uS = (u32)S, ut = (u32)t;
+    i64 i = 0;
+    while (i < n) {
+      const i64 p = pane_ids[i];
+      i64 j = i + 1;
+      while (j < n && pane_ids[j] == p) j++;
+      u8* base = w->panes.find(p)->second.rows.p;  // pre-grown above
+      if (w->nl == 1 && w->kind[0] == 0 && w->lt[0] == 0 && vdt[0] == VF32) {
+        const float* v = (const float*)vals[0];
+        for (i64 k = i; k < j; k++) {
+          const i32 s = slots_out[k];
+          if ((u32)s % uS != ut) continue;
+          const i64 kp = k + PF;
+          if (kp < j && (u32)slots_out[kp] % uS == ut)
+            __builtin_prefetch(base + (i64)slots_out[kp] * stride, 1);
+          u8* row = base + (i64)s * stride;
+          (*(i64*)row)++;
+          *(double*)(row + 8) += (double)v[k];
+        }
+      } else {
+        for (i64 k = i; k < j; k++) {
+          const i32 s = slots_out[k];
+          if ((u32)s % uS != ut) continue;
+          const i64 kp = k + PF;
+          if (kp < j && (u32)slots_out[kp] % uS == ut)
+            __builtin_prefetch(base + (i64)slots_out[kp] * stride, 1);
+          wm_fold_one(w, base + (i64)s * stride, vals, vdt, k);
+        }
+      }
+      i = j;
+    }
+  });
+}
+
+}  // namespace
+
+// Fused probe + mirror write-through: one pass maps keys -> slots (shared
+// dict; new keys insert) and folds each record into its pane's row.  Pane
+// pointers are cached across the usual within-batch runs (timestamps arrive
+// roughly sorted), and both the hash probe and the mirror row are
+// software-prefetched — the loop keeps ~8-12 cache misses in flight, which
+// is all the parallelism a single core offers; ``nshards`` > 1 multiplies
+// it across cores (see wm_probe_sharded — bit-identical at any count).
+// ``pane_mod``/``flat_out``: when flat_out is non-null, also emit the device
+// scatter ids flat = slot * pane_mod + pane %% pane_mod (int32) — the ids
+// the jitted update step consumes — saving three numpy passes per batch;
+// flat_out[n..flat_cap) is filled with ``flat_pad`` (the dropped-padding
+// id), so the caller's pow2-padded staging buffer is ready to dispatch.
+API void wm_probe_update(void* h, const i64* keys, const i64* pane_ids, i64 n,
+                         const void* const* vals, const u8* vdt,
+                         i32* slots_out, i64 pane_mod, i32* flat_out,
+                         i64 flat_cap, i32 flat_pad, i32 nshards) {
+  auto* w = (WinMirror*)h;
+  int S = nshards;
+  if (S > 16) S = 16;
+  if (S > 1 && n >= WM_MIN_PARALLEL) {
+    wm_probe_sharded(w, keys, pane_ids, n, vals, vdt, slots_out, pane_mod,
+                     flat_out, flat_cap, flat_pad, S);
+    return;
+  }
+  wm_probe_serial(w, keys, pane_ids, n, vals, vdt, slots_out, pane_mod,
+                  flat_out);
+  if (flat_out)
+    for (i64 k = n; k < flat_cap; k++) flat_out[k] = flat_pad;
 }
 
 // Window fire: combine the window's panes per slot, compact non-empty rows

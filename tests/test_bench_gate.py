@@ -18,9 +18,14 @@ sys.path.insert(0, REPO)
 from bench import check_budget  # noqa: E402
 
 
-def _result(rps=10e6, p99=10.0, phases=None):
-    return {"value": rps, "p99_fire_latency_ms": p99,
-            "details": {"phases_ms": phases or {"probe_mirror": 100.0}}}
+def _result(rps=10e6, p99=10.0, phases=None, vs_numpy=None, elapsed=None):
+    r = {"value": rps, "p99_fire_latency_ms": p99,
+         "details": {"phases_ms": phases or {"probe_mirror": 100.0}}}
+    if vs_numpy is not None:
+        r["vs_numpy_baseline"] = vs_numpy
+    if elapsed is not None:
+        r["details"]["elapsed_ms"] = elapsed
+    return r
 
 
 def _budget(**kw):
@@ -56,6 +61,30 @@ def test_check_budget_unknown_phase_ignored():
     assert check_budget(_result(), b) == []
 
 
+def test_check_budget_vs_numpy_floor():
+    """CPU-forced runs must not lose to flat single-core numpy (the
+    acceptance floor of the pipelined hot path)."""
+    b = _budget(min_vs_numpy=1.0)
+    assert check_budget(_result(vs_numpy=2.05), b) == []
+    viol = check_budget(_result(vs_numpy=0.6), b)
+    assert len(viol) == 1 and "vs_numpy" in viol[0]
+    # results without the field (configN runners) are not violations
+    assert check_budget(_result(), b) == []
+
+
+def test_check_budget_probe_mirror_frac():
+    b = _budget(max_probe_mirror_frac=0.85, max_phase_ms={})
+    ok = _result(phases={"probe_mirror": 700.0}, elapsed=1000.0)
+    assert check_budget(ok, b) == []
+    viol = check_budget(
+        _result(phases={"probe_mirror": 950.0}, elapsed=1000.0), b)
+    assert len(viol) == 1 and "probe_mirror" in viol[0]
+    # no elapsed / no probe_mirror phase (numpy fallback): not a violation
+    assert check_budget(_result(phases={"probe": 950.0},
+                                elapsed=1000.0), b) == []
+    assert check_budget(_result(phases={"probe_mirror": 950.0}), b) == []
+
+
 def test_budget_file_shape():
     with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
         budget = json.load(f)
@@ -64,6 +93,50 @@ def test_budget_file_shape():
         assert sec["min_rps"] > 0
         assert sec["max_p99_ms"] > 0
         assert "probe_mirror" in sec["max_phase_ms"]
+    # CPU-forced full runs carry the pipelined-hot-path acceptance keys
+    full_cpu = budget["full_cpu"]
+    assert full_cpu["min_vs_numpy"] >= 1.0
+    assert 0 < full_cpu["max_probe_mirror_frac"] <= 1.0
+    # the full_cpu floor must catch losing the deferred lane (~1.6M rec/s
+    # measured scatter fallback on the reference host)
+    assert full_cpu["min_rps"] > 2_000_000
+
+
+def _operator_phase_names():
+    """The operator's ``_phase("...")`` names, scraped from the source —
+    the profile artifact's key vocabulary."""
+    import re
+    src = os.path.join(REPO, "flink_tpu", "operators", "window_agg.py")
+    with open(src) as f:
+        names = set(re.findall(r"_phase\(\"([a-z_]+)\"\)", f.read()))
+    assert names, "no _phase(...) sites found in window_agg.py"
+    return names
+
+
+def test_profile_artifact_produced_and_keys_match(tmp_path):
+    """bench.py --profile writes the per-phase JSON artifact (VERDICT #10)
+    and its phase keys are exactly the operator's ``_phase`` names (plus
+    the bench-level snapshot_total rollup)."""
+    out = tmp_path / "profile.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--records", "16384", "--keys", "2048", "--batch-size", "4096",
+         "--profile", str(out)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    assert out.exists(), "--profile did not write the artifact"
+    with open(out) as f:
+        prof = json.load(f)
+    allowed = _operator_phase_names() | {"snapshot_total"}
+    for section in ("phase_ns", "phases_ms"):
+        keys = set(prof[section])
+        assert keys <= allowed, f"unknown phase keys: {keys - allowed}"
+        assert "probe_mirror" in keys or "probe" in keys
+    assert prof["phase_ns"].get("probe_mirror", 0) > 0 or \
+        prof["phase_ns"].get("probe", 0) > 0
+    assert prof["trace_annotation"] == "window_agg.device_step"
+    assert "phase_bytes" in prof and "elapsed_ms" in prof
 
 
 @pytest.mark.slow

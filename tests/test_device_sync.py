@@ -187,10 +187,18 @@ class TestDeferredSync:
 
 
 class TestAutoResolution:
-    def test_auto_on_cpu_backend_scatters(self):
+    def test_auto_on_cpu_backend_small_batches_settle_scatter(self):
+        """The CPU backend calibrates like any other (its XLA dispatch
+        compute IS the transport cost), but unit-sized batches never yield
+        a sample (transport.MIN_SAMPLE_MB) — auto must settle on scatter
+        after the bounded probe, keeping small-traffic CPU behavior
+        deterministic."""
         op = make_op("auto")
-        keys, vals, ts = batches_for(1, nbatches=1)[0]
-        op.process_batch(RecordBatch({"k": keys, "v": vals}, timestamps=ts))
+        for keys, vals, ts in batches_for(1, nbatches=10):
+            op.process_batch(RecordBatch({"k": keys, "v": vals},
+                                         timestamps=ts))
+            op.process_watermark(Watermark(int(ts.max()) - 1))
+        assert transport.dispatch_taxed() is None
         assert op.device_sync_mode == "scatter"
 
     def test_calibration_gives_up_to_scatter(self, monkeypatch):

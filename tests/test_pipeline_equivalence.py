@@ -1,0 +1,388 @@
+"""Pipelined hot-path equivalence (the ISSUE 3 tentpole contract).
+
+``WindowAggOperator`` with ``pipeline_depth > 0`` runs its hot stage (fused
+probe/mirror + paging + device dispatch) on a background worker, and
+``native_shards > 1`` hash-partitions the fused C probe across the native
+worker pool.  Both are pure scheduling changes: fire digests, snapshots,
+and counters must be BIT-identical to the serial single-shard path — at any
+depth, any shard count, on every tier (host mirror / device / deferred /
+paged), and under chaos.  These tests compare exact bytes, not tolerances.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import RuntimeContext, SumAggregator
+from flink_tpu.native import native_available
+from flink_tpu.operators.base import StreamOperator
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+def _mk_op(pipeline_depth=0, native_shards=1, native=True, paging=None,
+           emit_tier="host", device_sync="scatter", window_ms=100, **kw):
+    if paging is not None:
+        emit_tier = "device"
+    op = WindowAggOperator(
+        TumblingEventTimeWindows.of(window_ms), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", emit_tier=emit_tier,
+        snapshot_source="mirror" if emit_tier == "host" else "device",
+        device_sync=device_sync if emit_tier == "host" else "scatter",
+        native_emit=native, pipeline_depth=pipeline_depth,
+        native_shards=native_shards, paging=paging, **kw)
+    op.open(RuntimeContext())
+    return op
+
+
+def _digests(out):
+    """Exact per-fired-batch fingerprint: window, row count, and the raw
+    BYTES of the emitted key and result columns (order included)."""
+    return [(int(np.asarray(b.column("window_start"))[0]), len(b),
+             np.asarray(b.column("k")).tobytes(),
+             np.asarray(b.column("result")).tobytes())
+            for b in out if hasattr(b, "columns") and "result" in b.columns]
+
+
+def _counters(op):
+    return {
+        "late_dropped": op.late_dropped,
+        "num_keys": op.key_index.num_keys if op.key_index else 0,
+        "watermark": op.watermark,
+        "last_fired_window": op.last_fired_window,
+    }
+
+
+def _assert_snap_equal(a, b):
+    assert set(a) == set(b), set(a) ^ set(b)
+    for k in sorted(a):
+        va, vb = a[k], b[k]
+        if isinstance(va, np.ndarray):
+            assert np.array_equal(va, np.asarray(vb)), k
+        elif isinstance(va, (list, tuple)):
+            for x, y in zip(va, vb):
+                assert np.array_equal(np.asarray(x), np.asarray(y)), k
+        elif isinstance(va, dict):
+            continue  # key_index internals: covered by digest equality
+        else:
+            assert va == vb, k
+
+
+def _seeded_run(op, n_batches=12, nk=1500, b=4000, seed=11, snap_at=7,
+                late_every=4):
+    """Seeded feed with per-batch watermarks, a mid-run snapshot, and
+    periodic LATE records (exercising the refire flush path), ending with
+    end_input.  Returns (digests, mid-run snapshot, counters)."""
+    rng = np.random.default_rng(seed)
+    out, snap = [], None
+    for i in range(n_batches):
+        keys = rng.integers(0, nk, b).astype(np.int64)
+        vals = rng.random(b).astype(np.float32)
+        ts = i * 50 + np.sort(rng.integers(0, 50, b)).astype(np.int64)
+        if late_every and i % late_every == late_every - 1 and i > 0:
+            # a slice of records one window behind (late within lateness 0:
+            # dropped — or refired when still retained)
+            ts[: b // 8] = max(0, (i - 3) * 50)
+        out += op.process_batch(RecordBatch({"k": keys, "v": vals},
+                                            timestamps=ts))
+        out += op.process_watermark(Watermark(int(ts.max()) - 1))
+        if i == snap_at:
+            op.prepare_snapshot_pre_barrier()
+            snap = op.snapshot_state()
+    out += op.end_input()
+    counters = _counters(op)
+    op.close()
+    return _digests(out), snap, counters
+
+
+# ---------------------------------------------------------------------------
+# pipelining on vs off: bit-identical digests, snapshots, counters
+# ---------------------------------------------------------------------------
+
+def test_pipeline_on_off_bit_identical_host_tier():
+    ref = _seeded_run(_mk_op(pipeline_depth=0))
+    for depth in (1, 3):
+        got = _seeded_run(_mk_op(pipeline_depth=depth))
+        assert got[0] == ref[0], f"fire digests diverged at depth {depth}"
+        _assert_snap_equal(got[1], ref[1])
+        assert got[2] == ref[2]
+
+
+def test_pipeline_on_off_bit_identical_device_tier():
+    ref = _seeded_run(_mk_op(pipeline_depth=0, emit_tier="device"))
+    got = _seeded_run(_mk_op(pipeline_depth=1, emit_tier="device"))
+    assert got[0] == ref[0]
+    _assert_snap_equal(got[1], ref[1])
+    assert got[2] == ref[2]
+
+
+def test_pipeline_on_off_bit_identical_deferred_sync():
+    ref = _seeded_run(_mk_op(pipeline_depth=0, device_sync="deferred"))
+    got = _seeded_run(_mk_op(pipeline_depth=2, device_sync="deferred"))
+    assert got[0] == ref[0]
+    _assert_snap_equal(got[1], ref[1])
+    assert got[2] == ref[2]
+
+
+def test_pipeline_numpy_mirror_fallback_identical():
+    ref = _seeded_run(_mk_op(pipeline_depth=0, native=False))
+    got = _seeded_run(_mk_op(pipeline_depth=1, native=False))
+    assert got[0] == ref[0]
+    _assert_snap_equal(got[1], ref[1])
+    assert got[2] == ref[2]
+
+
+# ---------------------------------------------------------------------------
+# native probe sharding: bit-identical at any shard count
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not native_available(),
+                    reason="native library unavailable")
+def test_native_shards_bit_identical():
+    """Batches above the native parallel threshold (2^14), so the sharded
+    lookup/insert/fold phases actually run.  Slot assignment, mirror cell
+    contents, and fire compaction order must all match shard count 1."""
+    kw = dict(n_batches=6, nk=4096, b=1 << 15, late_every=0, snap_at=3)
+    ref = _seeded_run(_mk_op(pipeline_depth=0, native_shards=1), **kw)
+    for shards in (2, 3):
+        got = _seeded_run(_mk_op(pipeline_depth=0, native_shards=shards),
+                          **kw)
+        assert got[0] == ref[0], f"digests diverged at {shards} shards"
+        _assert_snap_equal(got[1], ref[1])
+        assert got[2] == ref[2]
+    # sharded AND pipelined together
+    both = _seeded_run(_mk_op(pipeline_depth=2, native_shards=3), **kw)
+    assert both[0] == ref[0]
+    _assert_snap_equal(both[1], ref[1])
+    assert both[2] == ref[2]
+
+
+@pytest.mark.skipif(not native_available(),
+                    reason="native library unavailable")
+def test_native_shards_new_key_insert_order():
+    """Duplicate NEW keys inside one sharded batch must get the slot ids
+    the serial pass would assign (first occurrence in batch order), even
+    when the occurrences land in different shard ranges."""
+    b = 1 << 15
+    keys = np.arange(b, dtype=np.int64) % 977          # heavy duplication
+    keys = np.concatenate([keys, keys[::-1]])          # cross-range dups
+    vals = np.arange(keys.size, dtype=np.float32)
+    ts = np.zeros(keys.size, np.int64)
+
+    def run(shards):
+        op = _mk_op(native_shards=shards)
+        out = op.process_batch(RecordBatch({"k": keys, "v": vals},
+                                           timestamps=ts))
+        out += op.process_watermark(Watermark(99))
+        d = _digests(out)
+        op.close()
+        return d
+
+    assert run(4) == run(1)
+
+
+@pytest.mark.skipif(not native_available(),
+                    reason="native library unavailable")
+def test_native_shards_concurrent_callers_safe():
+    """The shard pool is process-wide: two subtask threads sharding their
+    OWN mirrors at once must serialize waves, not clobber each other
+    (regression: the unserialized pool raced job/pending across callers —
+    use-after-free of the wave closure, observed as a segfault)."""
+    import threading
+
+    from flink_tpu.state.keyindex import make_key_index
+    from flink_tpu.state.native_mirror import NativeWindowMirror
+
+    agg = SumAggregator(jnp.float32)
+    results = [None] * 3
+
+    def worker(seed, i):
+        rng = np.random.default_rng(seed)
+        idx = make_key_index(np.int64(0), capacity_hint=1 << 15)
+        nm = NativeWindowMirror.try_create(
+            idx, agg.acc_spec(), agg.scatter_kind_leaves(), (np.float64,))
+        B = 1 << 15
+        total = 0.0
+        count = 0
+        for _ in range(8):
+            k = rng.integers(0, 1 << 15, B).astype(np.int64)
+            v = rng.random(B).astype(np.float32)
+            flat = np.empty(B, np.int32)
+            nm.probe_update(k, np.zeros(B, np.int64), [v], pane_mod=16,
+                            flat_out=flat, flat_fill=2 ** 31 - 1, shards=3)
+            total += float(v.astype(np.float64).sum())
+            count += B
+        _keys, counts, leaves = nm.fire(np.array([0]))
+        results[i] = (float(np.asarray(leaves[0]).sum()), total,
+                      int(np.asarray(counts).sum()), count)
+
+    threads = [threading.Thread(target=worker, args=(s, i))
+               for i, s in enumerate((1, 2, 3))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for got, want, cnt, n in results:
+        assert abs(got - want) < 1e-6 * max(want, 1.0)
+        assert cnt == n
+
+
+# ---------------------------------------------------------------------------
+# paging: 64k cap / 256k keys, pipelined vs serial
+# ---------------------------------------------------------------------------
+
+def _paged_run(pipeline_depth, n_keys=256 * 1024, cap=64 * 1024, seed=13):
+    from flink_tpu.state.paging import PagingConfig
+    op = _mk_op(pipeline_depth=pipeline_depth, paging=PagingConfig(cap),
+                window_ms=1000, initial_key_capacity=1 << 10)
+    rng = np.random.default_rng(seed)
+    out = []
+    for w in range(2):
+        keys = rng.permutation(n_keys).astype(np.int64)
+        for lo in range(0, n_keys, 1 << 15):
+            k = keys[lo: lo + (1 << 15)]
+            v = (k % 17 + 1).astype(np.float32)
+            out += op.process_batch(RecordBatch(
+                {"k": k, "v": v},
+                timestamps=np.full(k.size, w * 1000 + 10, np.int64)))
+        out += op.process_watermark(Watermark(w * 1000 + 999))
+    out += op.end_input()
+    snap = op.snapshot_state()
+    stats = op.paging_stats()
+    op.close()
+    return _digests(out), snap, stats
+
+
+def test_pipeline_with_paging_64k_cap_256k_keys():
+    """The tentpole acceptance at the paging scale: K_cap 64k under 256k
+    live keys, pipelined vs serial — identical fire digests (every spilled
+    key fires), identical snapshots, identical occupancy counters.  The
+    pager sees each batch's slots before any later batch can influence
+    eviction decisions (stages are strictly ordered on the worker)."""
+    ref_d, ref_s, ref_st = _paged_run(0)
+    got_d, got_s, got_st = _paged_run(2)
+    assert got_d == ref_d
+    _assert_snap_equal(got_s, ref_s)
+    assert got_st == ref_st
+    assert ref_st["spilled_keys"] == 256 * 1024 - 64 * 1024
+
+
+# ---------------------------------------------------------------------------
+# chaos: SlowDisk on checkpoint storage must not perturb pipelined results
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_pipeline_under_slowdisk_identical_results_and_job_status():
+    """Cluster-level equivalence under the SlowDisk nemesis: a windowed
+    job with pipelining on vs off, checkpointing against a stalling store,
+    must produce identical result rows AND identical job_status() record
+    counters (records_in/out per vertex) — the pipeline barriers at every
+    snapshot, so a stalled checkpoint can neither lose nor duplicate a
+    stage."""
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+    from flink_tpu.testing import chaos
+    from flink_tpu.testing.chaos import FaultInjector, SlowDisk
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows as T
+
+    rng = np.random.default_rng(29)
+    n = 40_000
+    keys = rng.integers(0, 101, n).astype(np.int64)
+    vals = rng.random(n)
+    ts = np.sort(rng.integers(0, 5000, n)).astype(np.int64)
+
+    def run(pipeline_depth):
+        inj = FaultInjector(seed=7)
+        inj.inject("checkpoint.store",
+                   SlowDisk(max_s=0.03, min_s=0.01, p=0.5, times=10))
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        sink = (env.from_collection(
+                    columns={"k": keys, "v": vals, "t": ts}, batch_size=2048)
+                .assign_timestamps_and_watermarks(0, timestamp_column="t")
+                .key_by("k")
+                .window(T.of(500))
+                .aggregate(SumAggregator(np.float64), value_column="v",
+                           pipeline_depth=pipeline_depth)
+                .collect())
+        with chaos.installed(inj):
+            res = env.execute_cluster(storage=InMemoryCheckpointStorage(),
+                                      checkpoint_interval_ms=5,
+                                      tolerable_failed_checkpoints=0)
+        rows = sorted(
+            (int(r["k"]), int(r["window_start"]), float(r["result"]))
+            for r in sink.rows())
+        status = env._last_cluster.job_status()
+        records = sorted(
+            (v["name"], sum(s["records_in"] for s in v["subtasks"]),
+             sum(s["records_out"] for s in v["subtasks"]))
+            for v in status["vertices"])
+        return rows, records, res.state
+
+    rows0, rec0, state0 = run(0)
+    rows1, rec1, state1 = run(1)
+    assert state0 == state1
+    assert rows1 == rows0
+    assert rec1 == rec0
+
+
+# ---------------------------------------------------------------------------
+# barrier/driver-hook semantics
+# ---------------------------------------------------------------------------
+
+def test_flush_pipeline_base_noop_and_idempotent():
+    assert StreamOperator().flush_pipeline() == []
+    op = _mk_op(pipeline_depth=1)
+    assert op.flush_pipeline() == []          # nothing in flight: no-op
+    keys = np.arange(256, dtype=np.int64)
+    op.process_batch(RecordBatch(
+        {"k": keys, "v": np.ones(256, np.float32)},
+        timestamps=np.zeros(256, np.int64)))
+    op.flush_pipeline()
+    op.flush_pipeline()                       # idempotent
+    assert op.key_index.num_keys == 256       # stage completed at barrier
+    op.close()
+
+
+def test_pipeline_stage_error_surfaces_at_barrier():
+    """A stage failure must re-raise at the next barrier, not vanish."""
+    op = _mk_op(pipeline_depth=1)
+
+    def boom(*a, **kw):
+        raise RuntimeError("stage exploded")
+
+    op._hot_stage = boom
+    keys = np.arange(64, dtype=np.int64)
+    op.process_batch(RecordBatch(
+        {"k": keys, "v": np.ones(64, np.float32)},
+        timestamps=np.zeros(64, np.int64)))
+    with pytest.raises(RuntimeError, match="stage exploded"):
+        op.flush_pipeline()
+    # STICKY: a foreign-thread flush (metrics poller via job_status ->
+    # paging_stats) must not consume the error — the task thread's own
+    # next barrier still has to fail the task
+    with pytest.raises(RuntimeError, match="stage exploded"):
+        op.flush_pipeline()
+    with pytest.raises(RuntimeError, match="stage exploded"):
+        op.close()  # teardown surfaces the failure once more, then clears
+    assert op.flush_pipeline() == []
+
+
+def test_watermark_fast_path_never_defers_due_fires():
+    """The pipelined watermark fast path may only skip the barrier when NO
+    window newly passed: a watermark that crosses a window end must fire
+    immediately, with the just-submitted stage's records included."""
+    op = _mk_op(pipeline_depth=3)
+    out = []
+    for w in range(4):
+        keys = np.arange(100, dtype=np.int64)
+        ts = np.full(100, w * 100 + 50, np.int64)
+        out += op.process_batch(RecordBatch(
+            {"k": keys, "v": np.ones(100, np.float32)}, timestamps=ts))
+        out += op.process_watermark(Watermark(w * 100 + 99))
+    fired = _digests(out)
+    assert len(fired) == 4
+    assert all(n == 100 for _w, n, _k, _r in fired)
+    op.close()
