@@ -33,10 +33,19 @@ class HeartbeatMonitor:
         self.last_heartbeat = now
 
 
+def _monotonic() -> float:
+    """Default liveness clock: the injectable seam (a chaos ``ClockSkew``
+    on ``clock.monotonic`` can falsely age heartbeats — the
+    local-clock-jump false suspect, distinct from the dropped-delivery
+    partition)."""
+    from flink_tpu.utils.clock import monotonic
+    return monotonic()
+
+
 class HeartbeatManager:
     def __init__(self, interval_s: float = 0.2, timeout_s: float = 1.0,
                  on_timeout: Optional[Callable[[str], None]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = _monotonic):
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.on_timeout = on_timeout
@@ -60,8 +69,13 @@ class HeartbeatManager:
     def receive_heartbeat(self, resource_id: str) -> None:
         # fault point: a partitioned target's heartbeats are dropped on the
         # floor (the monitor never sees them -> timeout fires even though
-        # the target is alive — the classic one-way partition false suspect)
-        if not chaos.fire("heartbeat.deliver", target=resource_id):
+        # the target is alive — the classic one-way partition false
+        # suspect).  direction="response" pairs with the request-side
+        # firing in _tick: a Partition(direction=...) drops exactly one
+        # of the two (the ASYMMETRIC partition); an undirected Partition
+        # drops both.
+        if not chaos.fire("heartbeat.deliver", target=resource_id,
+                          direction="response"):
             return
         with self._lock:
             m = self._monitors.get(resource_id)
@@ -84,6 +98,12 @@ class HeartbeatManager:
             if now - m.last_heartbeat > self.timeout_s:
                 dead.append(rid)
             else:
+                # fault point, request direction: the monitor's heartbeat
+                # REQUEST can be partitioned away independently of the
+                # target's response (direction="request")
+                if not chaos.fire("heartbeat.deliver", target=rid,
+                                  direction="request"):
+                    continue
                 try:
                     m.target.request_fn()
                 except Exception:  # target unreachable → let timeout fire

@@ -151,11 +151,16 @@ class MiniCluster(TaskListener):
         #: numRestarts (CheckpointStatsTracker analogs) on a jobmanager
         #: root, so reporters attached to ``metrics_registry`` export them
         from flink_tpu.metrics.groups import (MetricRegistry,
+                                              device_health_metrics,
                                               job_checkpoint_metrics)
         self.metrics_registry = MetricRegistry()
         self.job_metric_group = job_checkpoint_metrics(
             self.metrics_registry.job_manager_group(), self.failure_manager,
             lambda: self._restarts)
+        #: device-lane health gauges (runtime/device_health.py): the
+        #: process-wide monitor's state + this job's degraded operators
+        device_health_metrics(self.job_metric_group,
+                              self.device_health_status)
 
     # ------------------------------------------------------------ listener
     def _slot_memory(self):
@@ -463,6 +468,29 @@ class MiniCluster(TaskListener):
                 if getattr(member, "_pager", None) is not None:
                     yield member
 
+    def device_health_status(self) -> Dict[str, Any]:
+        """Process-wide device-lane health + this job's per-operator tier
+        counters (``job_status()["device_health"]`` and the
+        ``device_health.*`` gauges).  Monitoring-grade: reads no operator
+        state behind a barrier."""
+        from flink_tpu.runtime import device_health
+        status = device_health.status_snapshot()
+        degraded = migrations = repromotions = 0
+        for t in getattr(self, "_tasks", []):
+            op = t.operator
+            for member in getattr(op, "operators", [op]):
+                stats_fn = getattr(member, "device_health_stats", None)
+                if stats_fn is None:
+                    continue
+                st = stats_fn()
+                degraded += st.get("degraded", 0)
+                migrations += st.get("quarantine_migrations", 0)
+                repromotions += st.get("repromotions", 0)
+        status["degraded_operators"] = degraded
+        status["quarantine_migrations"] = migrations
+        status["repromotions"] = repromotions
+        return status
+
     def paging_totals(self) -> Optional[Dict[str, int]]:
         """Aggregated ``paging_stats()`` across every paged operator
         (job_status()["paging"] + the job-scope ``paging.*`` gauges)."""
@@ -727,6 +755,7 @@ class MiniCluster(TaskListener):
         paging = self.paging_totals()
         return {
             **({"paging": paging} if paging is not None else {}),
+            "device_health": self.device_health_status(),
             "state": job_state,
             "vertices": vertices,
             "completed_checkpoints": list(self._completed_ids),

@@ -175,6 +175,25 @@ class SubtaskBase:
     def _invoke(self) -> None:
         raise NotImplementedError
 
+    def _tick_processing_time(self) -> None:
+        """Periodic ProcessingTimeService tick on the task thread (the
+        reference's timer callbacks run on the mailbox): fires due
+        processing-time timers through the operator between elements.
+        Rate-limited on RAW monotonic time; the time handed to the
+        operator reads through the injectable clock seam and is clamped
+        MONOTONE here, so a chaos ``ClockSkew`` backward step can neither
+        rewind processing time nor re-fire timers."""
+        mono = time.monotonic()
+        if mono - getattr(self, "_last_tick_mono", 0.0) < 0.05:
+            return
+        self._last_tick_mono = mono
+        from flink_tpu.utils import clock
+        now = max(clock.now_ms(), getattr(self, "_proc_now_ms", 0))
+        self._proc_now_ms = now
+        out = self.operator.on_processing_time(now)
+        if out:
+            self._emit(out)
+
     def _final_snapshot(self) -> Dict[str, Any]:
         return {"operator": self.operator.snapshot_state(), "finished": True}
 
@@ -271,6 +290,7 @@ class SourceSubtask(SubtaskBase):
         while True:
             self._check_cancel()
             self._drain_commands()
+            self._tick_processing_time()
             if self._paused.is_set():
                 time.sleep(0.002)  # paused: commands/cancel only
                 continue
@@ -417,6 +437,7 @@ class Subtask(SubtaskBase):
         while not all(self._ended):
             self._check_cancel()
             self._drain_commands()
+            self._tick_processing_time()
             progressed = False
             for i, ch in enumerate(self.inputs):
                 if self._ended[i] or i in self._blocked:

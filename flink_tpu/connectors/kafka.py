@@ -271,6 +271,20 @@ class KafkaWireBroker:
         #: (unauthenticated requests close the connection, as real
         #: brokers do)
         self.users = users
+        #: SCRAM credential hardening (ADVICE r5 #4): real brokers store a
+        #: STABLE salted credential per user, not the raw password — here
+        #: the stable per-user salt plus a (user, salt, iterations) ->
+        #: salted-password cache mean repeated handshakes (including
+        #: unauthenticated brute-force attempts) cost ONE 4096-iteration
+        #: PBKDF2 ever, not one per attempt.
+        self._scram_salts: Dict[str, bytes] = {}
+        self._scram_cache: Dict[Tuple[str, bytes, int], bytes] = {}
+        #: per-broker secret for DETERMINISTIC decoy salts: an unknown
+        #: user's handshake gets the same fake salt on every attempt (a
+        #: changing salt would itself leak nonexistence) and fails at the
+        #: client-final proof like any wrong password — no username
+        #: enumeration, and no PBKDF2 spent on nonexistent users.
+        self._scram_decoy_secret = os.urandom(16)
         #: a TLS LISTENER (the reference's ``security.protocol=SSL`` /
         #: SASL_SSL): every accepted connection handshakes TLS before the
         #: first Kafka frame; combine with ``users`` for SASL_SSL
@@ -280,6 +294,12 @@ class KafkaWireBroker:
         if directory:
             os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
+        # derive SCRAM credentials EAGERLY at construction: a lazy first
+        # derivation would make a real user's first handshake ~ms slower
+        # than a decoy's HMAC — a timing side channel that re-opens the
+        # username enumeration the decoy path closes
+        for _user in (users or {}):
+            self._scram_credentials(_user)
         #: topic -> partition -> list[(offset, key, value, timestamp_ms)]
         self._logs: Dict[str, List[List[Tuple[int, bytes, bytes, int]]]] = {}
         #: KIP-98 transactions: transactional_id -> {pid, epoch, state,
@@ -797,27 +817,55 @@ class KafkaWireBroker:
             t[1], lambda w, p: w.int32(p[0]).int64(p[1]).string("")
             .int16(_ERR_NONE)))
 
+    def _scram_credentials(self, user: str) -> Tuple[bytes, bytes]:
+        """(salt, salted_password) for a user — stable salt + cached
+        PBKDF2 for known users; a deterministic DECOY pair for unknown
+        users (HMAC of the per-broker secret — zero PBKDF2 cost, same
+        salt on every probe, proof can never verify)."""
+        import hashlib as _hl
+        import hmac as _hmac
+
+        want = (self.users or {}).get(user)
+        with self._lock:
+            if want is None:
+                salt = _hmac.new(self._scram_decoy_secret,
+                                 b"salt:" + user.encode(),
+                                 _hl.sha256).digest()[:16]
+                salted = _hmac.new(self._scram_decoy_secret, b"decoy-key",
+                                   _hl.sha256).digest()
+                return salt, salted
+            salt = self._scram_salts.get(user)
+            if salt is None:
+                salt = self._scram_salts[user] = os.urandom(16)
+            key = (user, salt, 4096)
+            salted = self._scram_cache.get(key)
+            if salted is None:
+                salted = self._scram_cache[key] = _hl.pbkdf2_hmac(
+                    "sha256", want.encode(), salt, 4096)
+            return salt, salted
+
     def _sasl_scram(self, state: dict, token: bytes, w: _Writer) -> None:
         """SCRAM-SHA-256 over SaslAuthenticate (two rounds: client-first →
         server-first, client-final → server-final).  The RFC 5802 math is
         the shared ``flink_tpu.security.scram`` implementation — same
-        code the Postgres handshake uses."""
+        code the Postgres handshake uses.
+
+        Unknown users are indistinguishable from wrong passwords: round 1
+        answers with a deterministic decoy salt and the exchange fails at
+        the round-2 proof — the pre-hardening behaviour (an immediate
+        "authentication failed for user X") let an attacker enumerate
+        valid usernames without knowing any password."""
         from flink_tpu.security.scram import ScramServer
 
         try:
             text = token.decode()
             srv = state.get("scram")
             if srv is None:                   # round 1: client-first
-                srv = ScramServer()
+                srv = ScramServer(iterations=4096)
                 user = ScramServer.username_of(text)
-                want = (self.users or {}).get(user)
-                if want is None:
-                    w.int16(_ERR_SASL_AUTHENTICATION_FAILED) \
-                        .string(f"authentication failed for user "
-                                f"{user!r}").bytes_(b"")
-                    return
+                salt, salted = self._scram_credentials(user)
                 state["scram"] = srv
-                first = srv.first_response(text, want)
+                first = srv.first_response(text, salt=salt, salted=salted)
                 w.int16(_ERR_NONE).string(None).bytes_(first.encode())
                 return
             ok, final = srv.verify_final(text)  # round 2: client-final

@@ -35,6 +35,16 @@ PAGING_RESIDENT_KEYS = "paging.resident_keys"
 PAGING_SPILLED_KEYS = "paging.spilled_keys"
 PAGING_EVICTIONS = "paging.evictions"
 PAGING_PROMOTIONS = "paging.promotions"
+# device-lane health (runtime/device_health.py): watchdog + quarantine/
+# heal cycle of the process's accelerator tier
+DEVICE_HEALTH_STATE = "device_health.state"          # 0 healthy, 1 quarantined
+DEVICE_HEALTH_QUARANTINES = "device_health.quarantines"
+DEVICE_HEALTH_HEALS = "device_health.heals"
+DEVICE_HEALTH_WATCHDOG_TIMEOUTS = "device_health.watchdog_timeouts"
+DEVICE_HEALTH_NEAR_MISSES = "device_health.near_misses"
+DEVICE_HEALTH_TRANSIENT_RETRIES = "device_health.transient_retries"
+DEVICE_HEALTH_OOM_PAGEOUTS = "device_health.oom_pageouts"
+DEVICE_HEALTH_DEGRADED_OPERATORS = "device_health.degraded_operators"
 
 
 class MetricGroup:
@@ -200,6 +210,32 @@ def paging_metrics(group: MetricGroup,
                       (PAGING_SPILLED_KEYS, "spilled_keys"),
                       (PAGING_EVICTIONS, "evictions"),
                       (PAGING_PROMOTIONS, "promotions")):
+        group.gauge(name, _read(key))
+    return group
+
+
+def device_health_metrics(group: MetricGroup,
+                          status_supplier: Callable[[], Dict[str, Any]]
+                          ) -> MetricGroup:
+    """Register the device-lane health gauges on a (job-scope) group:
+    state (0 healthy / 1 quarantined), quarantine + heal counters,
+    watchdog timeouts/near-misses, transient retries, OOM page-outs, and
+    the count of operators currently running degraded.  ``status_supplier``
+    returns ``job_status()["device_health"]``-shaped dicts."""
+    def _read(key: str, default: int = 0) -> Callable[[], int]:
+        return lambda: int((status_supplier() or {}).get(key, default))
+
+    group.gauge(DEVICE_HEALTH_STATE,
+                lambda: int((status_supplier() or {}).get("state")
+                            == "quarantined"))
+    for name, key in ((DEVICE_HEALTH_QUARANTINES, "quarantines"),
+                      (DEVICE_HEALTH_HEALS, "heals"),
+                      (DEVICE_HEALTH_WATCHDOG_TIMEOUTS, "watchdog_timeouts"),
+                      (DEVICE_HEALTH_NEAR_MISSES, "near_misses"),
+                      (DEVICE_HEALTH_TRANSIENT_RETRIES, "transient_retries"),
+                      (DEVICE_HEALTH_OOM_PAGEOUTS, "oom_pageouts"),
+                      (DEVICE_HEALTH_DEGRADED_OPERATORS,
+                       "degraded_operators")):
         group.gauge(name, _read(key))
     return group
 

@@ -219,10 +219,25 @@ class DeviceEvictingWindowOperator(StreamOperator):
         pp[:B] = panes - self._pane_epoch
         tp = np.zeros(Bp, np.int32)
         tp[:B] = ts - self._ts_epoch
-        self._vals, self._keys, self._panes, self._ts = self._append_step(
-            self._vals, self._keys, self._panes, self._ts,
-            jnp.asarray(vals), jnp.asarray(kp), jnp.asarray(pp),
-            jnp.asarray(tp), jnp.int32(self._count))
+        # guarded: the evicting lane's hot dispatch runs under the same
+        # device-health watchdog as the window hot path (a wedge here
+        # quarantines the tier and FAILS this operator — raw-element
+        # device buffers have no host twin tier to degrade onto, so the
+        # restart strategy recovers from the last checkpoint instead)
+        from flink_tpu.runtime import device_health
+        geom = (int(self._vals.shape[0]), Bp)
+        fresh_geom = geom != getattr(self, "_last_dispatch_geom", None)
+        self._last_dispatch_geom = geom
+        self._vals, self._keys, self._panes, self._ts = \
+            device_health.guarded_dispatch(
+                lambda: self._append_step(
+                    self._vals, self._keys, self._panes, self._ts,
+                    jnp.asarray(vals), jnp.asarray(kp), jnp.asarray(pp),
+                    jnp.asarray(tp), jnp.int32(self._count)),
+                mb=(vals.nbytes + kp.nbytes + pp.nbytes + tp.nbytes) / 1e6,
+                label=f"{getattr(self, 'name', 'evicting-window')}"
+                      ".append_step",
+                compile_grace=fresh_geom)
         self._count += Bp
         return []
 

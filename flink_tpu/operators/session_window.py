@@ -163,8 +163,8 @@ class SessionWindowOperator(StreamOperator):
         else:
             # processing time: stamp arrival time (the reference's
             # ProcessingTimeSessionWindows assigns currentProcessingTime)
-            import time as _t
-            now = int(_t.time() * 1000) if self._proc_time == LONG_MIN \
+            from flink_tpu.utils import clock
+            now = clock.now_ms() if self._proc_time == LONG_MIN \
                 else self._proc_time
             ts = np.full(len(batch), now, np.int64)
         if self.key_index is None:
@@ -328,10 +328,12 @@ class SessionWindowOperator(StreamOperator):
         return self._fire_due(self.watermark)
 
     def on_processing_time(self, timestamp_ms: int) -> List[StreamElement]:
-        self._proc_time = timestamp_ms
+        # monotone clamp: a backward-stepped clock (chaos ClockSkew) must
+        # neither rewind session gap progress nor close sessions early
+        self._proc_time = max(self._proc_time, timestamp_ms)
         if self.is_event_time:
             return []
-        return self._fire_due(timestamp_ms)
+        return self._fire_due(self._proc_time)
 
     def end_input(self) -> List[StreamElement]:
         if self.is_event_time:

@@ -63,6 +63,7 @@ from flink_tpu.core.functions import (SCATTER_UFUNCS, AggregateFunction,
                                       RuntimeContext)
 from flink_tpu.core import keygroups
 from flink_tpu.operators.base import StreamOperator
+from flink_tpu.runtime.device_health import DeviceQuarantinedError
 from flink_tpu.ops.scatter import (combine_along_axis,
                                    gather_row_pane_columns, reset_rows,
                                    scatter_fast, scatter_generic,
@@ -552,6 +553,25 @@ class WindowAggOperator(StreamOperator):
         self.watermark: int = LONG_MIN
         self.late_dropped: int = 0   # beyond-lateness drop counter (numRecordsDropped)
         self._proc_time: int = LONG_MIN
+        #: device-lane health (runtime/device_health.py): True while this
+        #: operator runs on the DEGRADED host/numpy tier after the process
+        #: -wide monitor quarantined the device.  Host-tier operators keep
+        #: folding into their (authoritative) mirror and just stop
+        #: dispatching (deferred-sync semantics); device-tier operators
+        #: materialize the pane ring into the host value mirror and serve
+        #: fires/snapshots from it until re-promotion at a checkpoint-
+        #: aligned safe point.
+        self._degraded = False
+        self._quarantine_migrations = 0
+        self._repromotions = 0
+        #: tier-transition fencing: every degrade/abandoned-promotion
+        #: bumps the epoch; a re-promotion attempt commits only if the
+        #: epoch it started under is still current (under _tier_lock), so
+        #: a watchdog-abandoned attempt that later limps to completion on
+        #: its sacrificed lane thread can never land stale state
+        self._tier_epoch = 0
+        import threading as _threading
+        self._tier_lock = _threading.Lock()
 
     #: snapshot entries row-indexed by key slot (rescale redistribution)
     ROW_FIELDS = ("leaves", "counts")
@@ -647,6 +667,10 @@ class WindowAggOperator(StreamOperator):
         self.phase_ns = {}
         self.phase_bytes = {}
         self._device_stale = False  # resolved sync mode survives the reset
+        self._degraded = False      # fresh state restores on the device
+        with self._tier_lock:
+            self._tier_epoch += 1   # fence any in-flight promotion
+        self._active_rows = None
         if self._pager is not None:
             self._pager.reset()
 
@@ -836,6 +860,8 @@ class WindowAggOperator(StreamOperator):
         any expirations skipped while deferred; uploaded bytes scale with
         live panes.  No-op when the replica is already current."""
         self.flush_pipeline()
+        if self._degraded:
+            return  # no refresh while quarantined; re-promotion rebuilds
         if not self._device_stale:
             return
         self._device_stale = False
@@ -863,14 +889,19 @@ class WindowAggOperator(StreamOperator):
             + sum(l.nbytes for l in leaf_cols))
 
     def _vmirror_pane(self, pane: int) -> list:
-        """[counts, *leaves] arrays for a pane, allocated/grown to >= _K."""
+        """[counts, *leaves] arrays for a pane, allocated/grown to >=
+        max(_K, live keys) — a DEGRADED paged operator holds every key in
+        the mirror, not just the K_cap-resident prefix."""
+        need = self._K
+        if self._degraded and self.key_index is not None:
+            need = max(need, _next_pow2(max(self.key_index.num_keys, 1)))
         entry = self._vmirror.get(pane)
-        if entry is None or entry[0].size < self._K:
-            fresh = [np.zeros(self._K, np.int64)]
+        if entry is None or entry[0].size < need:
+            fresh = [np.zeros(need, np.int64)]
             for init, shape, mdt in zip(self.spec.leaf_inits,
                                         self.spec.leaf_shapes,
                                         self._mirror_dtypes):
-                arr = np.empty((self._K,) + tuple(shape), mdt)
+                arr = np.empty((need,) + tuple(shape), mdt)
                 arr[...] = np.asarray(init).astype(mdt)
                 fresh.append(arr)
             if entry is not None:
@@ -963,6 +994,8 @@ class WindowAggOperator(StreamOperator):
         continuous per-batch equality — which deferred mode by design does
         not maintain between sync points."""
         self.flush_pipeline()
+        if self._degraded:
+            return True  # replica intentionally stale/absent in quarantine
         if self.device_sync_mode == "deferred":
             self.device_refresh()
         if self.emit_tier != "host" or self._leaves is None \
@@ -1384,17 +1417,38 @@ class WindowAggOperator(StreamOperator):
             new_base = min(self.pane_base, pmin)
             span = max(self.max_pane, pmax) - new_base + 1
             if span > self._P:
-                self._ensure_alloc()
-                self._grow_panes(span)
+                self._grow_panes_guarded(span)
             self.pane_base = new_base
             self.max_pane = max(self.max_pane, pmax)
         span = self.max_pane - self.pane_base + 1
         if span > self._P:
-            self._ensure_alloc()
-            self._grow_panes(span)
+            self._grow_panes_guarded(span)
+
+        if self._degraded and self.emit_tier != "host":
+            # quarantined device tier: the host value mirror is the
+            # authority — key probe + numpy fold only (no paging, no
+            # device dispatch); fires and snapshots read the mirror until
+            # re-promotion at a checkpoint-aligned safe point
+            with self._phase("probe"):
+                slots = self.key_index.lookup_or_insert(keys)
+            with self._phase("mirror"):
+                # grow EVERY live pane with the key count (the _grow_keys
+                # invariant): the per-touch growth below only covers this
+                # batch's panes, and an UNTOUCHED pane must still serve
+                # fires/snapshots/re-promotion at the new key count —
+                # mixed entry sizes would break the pane combine
+                for p in list(self._vmirror):
+                    self._vmirror_pane(p)
+                self._vmirror_update(slots, panes, values)
+            return
 
         self._try_native_mirror()
         sync = self._resolve_device_sync()
+        if self._degraded:
+            # quarantined HOST tier: the mirror is authoritative anyway —
+            # skip the replica dispatch (deferred-sync semantics) until
+            # re-promotion
+            sync = "deferred"
         staging = None
         flat_ready = False
         # flatten the value tree ONCE per batch: staging acquisition and
@@ -1439,6 +1493,8 @@ class WindowAggOperator(StreamOperator):
             self._grow_keys(self.key_index.num_keys)
 
         self._ensure_alloc()
+        gids = slots   # pre-paging GLOBAL ids: the quarantine-migration
+        #                fold must be gid-indexed, not HBM-row-indexed
         if self._pager is not None:
             # translate global key ids -> resident HBM rows, paging cold
             # keys out / promoted keys in (batched device dispatches).
@@ -1469,10 +1525,24 @@ class WindowAggOperator(StreamOperator):
             # subclass re-routes them through the all_to_all exchange
             # host-side
             t_cal = time.perf_counter() if sync == "calibrating" else 0.0
-            with self._phase("device_dispatch"):
-                with _device_trace():
-                    res = self._update_step(self._leaves, self._counts,
-                                            flat_p, values_p)
+            mb = (flat_p.nbytes + sum(a.nbytes for a in
+                                      jax.tree_util.tree_leaves(values_p)))
+            try:
+                with self._phase("device_dispatch"):
+                    with _device_trace():
+                        res = self._guarded_update(flat_p, values_p,
+                                                   mb / 1e6)
+            except DeviceQuarantinedError as err:
+                # the device tier wedged mid-batch: migrate to the host
+                # tier and fold THIS batch there — no record is dropped
+                self._enter_degraded(err)
+                with self._phase("mirror"):
+                    if self.emit_tier == "host":
+                        if self._nm is None:  # nm already folded in probe
+                            self._vmirror_update(slots, panes, values)
+                    else:
+                        self._vmirror_update(gids, panes, values)
+                return
             if len(res) == 3:
                 # the staging set frees once this execution's token is ready
                 self._leaves, self._counts, staging.token = res
@@ -1482,8 +1552,6 @@ class WindowAggOperator(StreamOperator):
                 # ready() only passes when the execution provably finished
                 self._leaves, self._counts = res
                 staging.token = self._counts
-            mb = (flat_p.nbytes + sum(a.nbytes for a in
-                                      jax.tree_util.tree_leaves(values_p)))
             self.phase_bytes["h2d"] = self.phase_bytes.get("h2d", 0) + mb
             if sync == "calibrating":
                 # self-calibration: dispatch-call PLUS until-ready wall of
@@ -1513,6 +1581,252 @@ class WindowAggOperator(StreamOperator):
             else:
                 for p in uniq_panes.tolist():
                     self._mirror_mark(int(p), slots[panes == p])
+
+    # ------------------------------------------- device-lane health (tiers)
+    def _grow_panes_guarded(self, span: int) -> None:
+        """Ring growth, degraded-aware: a quarantined DEVICE-tier operator
+        has no device ring (state lives in the host value mirror, keyed by
+        pane ID — no slot remap exists to run), so only ``_P`` advances;
+        re-promotion allocates at the final geometry."""
+        if self._degraded and self.emit_tier != "host":
+            while self._P < span:
+                self._P <<= 1
+            return
+        self._ensure_alloc()
+        self._grow_panes(span)
+
+    def _guarded_update(self, flat_p, values_p, mb: float):
+        """The jitted update dispatch under the device-health watchdog
+        (``runtime/device_health.py``): bounded deadline derived from the
+        measured dispatch cost, transient-error retry with backoff, OOM ->
+        forced page-out through the DevicePager, wedge -> process-wide
+        quarantine (the caller migrates tiers).  Retry assumes the failure
+        preceded buffer donation — true for the dispatch-level failures
+        the monitor models (the chaos point fires before the thunk; real
+        XLA dispatch rejections happen before execution consumes donated
+        buffers)."""
+        from flink_tpu.runtime import device_health
+        # geometry change => this dispatch RECOMPILES (the jit keys on
+        # K/P/batch shapes): grant the compile grace so state growth on a
+        # slow host never reads as a wedge under a tight deadline floor
+        leaves = jax.tree_util.tree_leaves(values_p)
+        geom = (self._K, self._P, int(flat_p.shape[0]),
+                tuple((a.dtype.str, a.shape[1:]) for a in leaves))
+        fresh_geom = geom != getattr(self, "_last_dispatch_geom", None)
+        self._last_dispatch_geom = geom
+        return device_health.guarded_dispatch(
+            lambda: self._update_step(self._leaves, self._counts, flat_p,
+                                      values_p),
+            mb=mb,
+            on_oom=(self._forced_page_out if self._pager is not None
+                    else None),
+            label=f"{self.name}.update_step",
+            compile_grace=fresh_geom)
+
+    def _enter_degraded(self, err: BaseException) -> None:
+        """Quarantine migration: leave the device tier MID-JOB.  Host-tier
+        operators just stop dispatching (their mirror is already the
+        authority); device-tier operators materialize the live pane ring
+        through the dense gid-indexed snapshot path into the host value
+        mirror (both pager tiers merged), then drop the device arrays.
+        Operators with no host twin tier (no numpy twins, sharded state,
+        count triggers) re-raise — the task fails and the normal restart
+        strategy recovers it from the last checkpoint instead."""
+        if (not self.agg.supports_host_emit() or self.sharding is not None
+                or self.trigger.fires_on_count
+                or isinstance(self.assigner, GlobalWindows)):
+            raise err
+        self._quarantine_migrations += 1
+        if self.emit_tier == "host":
+            self._degraded = True
+            self._device_stale = True
+            return
+        n = self.key_index.num_keys if self.key_index is not None else 0
+        if self._leaves is not None and self.pane_base is not None and n:
+            panes = self._live_panes()
+
+            def _salvage_gather():
+                if self._pager is not None:
+                    return self._paged_snapshot_rows(n, panes)
+                slots = jnp.asarray(panes % self._P, jnp.int32)
+                lv = [np.asarray(jnp.take(l, slots, axis=1))[:n]
+                      for l in self._leaves]
+                return np.asarray(jnp.take(self._counts, slots,
+                                           axis=1))[:n], lv
+
+            try:
+                # the salvage runs under its own bounded deadline on the
+                # monitor's lane: a REALLY wedged device hangs the read
+                # too, and the migration must never hang the task thread
+                from flink_tpu.runtime import device_health
+                mon = device_health.get_monitor(create=False)
+                if mon is not None:
+                    counts, leaves = mon.run_salvage(
+                        _salvage_gather, label=f"{self.name} migration")
+                else:
+                    counts, leaves = _salvage_gather()
+            except Exception as gather_err:  # noqa: BLE001
+                # a REAL watchdog timeout abandons the dispatch mid-flight
+                # with the state buffers already DONATED into it, or the
+                # wedged device cannot serve the download within the
+                # salvage deadline: the resident state is genuinely
+                # unrecoverable in-process — fail the task so the restart
+                # strategy recovers from the last checkpoint instead of
+                # silently losing panes (or hanging forever)
+                raise err from gather_err
+            self._degraded = True   # _vmirror_pane sizes past K_cap now
+            self._vmirror = {}
+            for j, p in enumerate(panes.tolist()):
+                if not counts[:, j].any():
+                    continue
+                entry = self._vmirror_pane(int(p))
+                entry[0][:n] = counts[:, j]
+                for k, src in enumerate(leaves):
+                    entry[k + 1][:n] = src[:, j].astype(
+                        self._mirror_dtypes[k])
+        self._degraded = True
+        self._drop_device_arrays()
+
+    def _drop_device_arrays(self) -> None:
+        """Tear down the device tier's in-process state (the mirror stays
+        authoritative).  Shared by the quarantine migration and the
+        false-heal rollback — one copy of the teardown set."""
+        with self._tier_lock:
+            self._tier_epoch += 1   # fence any in-flight promotion
+        self._leaves = None
+        self._counts = None
+        self._staging_pool = {}
+        self._mirror = {}
+        self._active_rows = None
+        if self._pager is not None:
+            self._pager.reset()
+
+    def _forced_page_out(self) -> None:
+        """Device-OOM pressure valve (monitor ``on_oom`` hook): spill the
+        cold half of the resident rows so the retried dispatch has HBM
+        headroom.  The current batch's rows stay protected — the in-flight
+        flat scatter ids already reference them."""
+        pager = self._pager
+        if pager is None or self.pane_base is None:
+            return
+        rows, _gids = pager.resident_pairs()
+        protected = getattr(self, "_active_rows", None)
+        if protected is None:
+            protected = np.empty(0, np.int64)
+        evictable = int(rows.size) - int(protected.size)
+        k = max(1, evictable // 2) if evictable > 0 else 0
+        if k <= 0:
+            return
+        live = self._live_panes()
+        victims = pager.pick_victims(k, protected)
+        if victims.size == 0:
+            return
+        counts, leaves = self._gather_rows(victims, live)
+        bits = self._mirror_bits_rows(victims, live)
+        pager.spill_rows(victims, live, counts, leaves, bits)
+        self._clear_mirror_rows(victims)
+
+    def _maybe_repromote(self) -> bool:
+        """Checkpoint-aligned safe point: if the process-wide monitor
+        healed the device tier, re-promote this operator's state and leave
+        degraded mode.  Returns True when a re-promotion happened."""
+        if not self._degraded:
+            return False
+        from flink_tpu.runtime import device_health
+        mon = device_health.get_monitor(create=False)
+        if mon is None or not mon.healthy:
+            return False
+        self.flush_pipeline()
+
+        def _promote():
+            if self.emit_tier == "host":
+                self._degraded = False   # device_refresh no-ops while degraded
+                try:
+                    self.device_refresh()  # stale replica: rebuild from mirror
+                except BaseException:
+                    self._degraded = True
+                    raise
+            else:
+                self._repromote_device()   # device uploads only, no commits
+
+        try:
+            # GUARDED (with compile grace — the restore-path kernels
+            # compile here): the healer probes in a throwaway subprocess,
+            # i.e. a fresh client, which can read healthy while THIS
+            # process's wedged grant still hangs every dispatch — a false
+            # heal must not hang the task thread mid-re-promotion
+            mon.run_guarded(_promote, label=f"{self.name} re-promotion",
+                            compile_grace=True)
+        except DeviceQuarantinedError:
+            # false heal: stay on the host tier (the mirror — dropped
+            # only after a COMMITTED promotion — is still the authority);
+            # the teardown bumps the tier epoch, fencing the abandoned
+            # attempt out of ever committing
+            self._degraded = True
+            self._device_stale = True
+            if self.emit_tier != "host":
+                self._drop_device_arrays()
+            else:
+                with self._tier_lock:
+                    self._tier_epoch += 1
+            return False
+        if self.emit_tier != "host":
+            # COMMIT on the TASK thread, after the guarded upload
+            # returned: an abandoned (hung) promotion attempt can never
+            # flip the tier or drop the mirror behind our back
+            self._degraded = False
+            self._vmirror = {}
+            self._device_stale = False
+        self._repromotions += 1
+        return True
+
+    def _repromote_device(self) -> None:
+        """Quarantine exit for the device tier, UPLOAD HALF: rebuild the
+        device pane ring (and pager residency) from the host value mirror
+        through the restore path.  Deliberately commits NO tier flags and
+        keeps ``_vmirror`` — the caller (``_maybe_repromote``) commits on
+        the task thread only after this guarded upload returned, and the
+        device-state writes are FENCED on the tier epoch captured at
+        entry: an abandoned attempt that later limps to completion finds
+        the epoch advanced (by the false-heal rollback or a re-degrade)
+        and aborts instead of landing stale state."""
+        n = self.key_index.num_keys if self.key_index is not None else 0
+        if n == 0 or self.pane_base is None:
+            return
+        with self._tier_lock:
+            epoch = self._tier_epoch
+        panes = self._live_panes()
+        counts, leaves = self._mirror_columns(panes.tolist(), n)
+        counts = np.asarray(counts)
+        if self._pager is not None:
+            with self._tier_lock:
+                if epoch != self._tier_epoch:
+                    raise DeviceQuarantinedError("re-promotion superseded")
+                self._paged_restore_rows(n, panes, counts, leaves)
+        else:
+            slots = jnp.asarray(panes % self._P, jnp.int32)
+            with self._tier_lock:
+                if epoch != self._tier_epoch:
+                    raise DeviceQuarantinedError("re-promotion superseded")
+                self._K = self._round_key_capacity(max(n, 1))
+                self._ensure_alloc()
+                self._leaves = tuple(
+                    l.at[:n, slots].set(jnp.asarray(s))
+                    for l, s in zip(self._leaves, leaves))
+                self._counts = self._counts.at[:n, slots].set(
+                    jnp.asarray(counts))
+                self._mirror = {}
+                for j, p in enumerate(panes.tolist()):
+                    nz = np.flatnonzero(counts[:, j] > 0)
+                    if nz.size:
+                        self._mirror_mark(int(p), nz)
+
+    def device_health_stats(self) -> Dict[str, int]:
+        """Per-operator tier-degradation counters (monitoring-grade, no
+        pipeline barrier — same contract as ``paging_stats``)."""
+        return {"degraded": int(self._degraded),
+                "quarantine_migrations": self._quarantine_migrations,
+                "repromotions": self._repromotions}
 
     # ------------------------------------------------------------------ time
     def _fired_horizon(self, now: int) -> int:
@@ -1584,15 +1898,16 @@ class WindowAggOperator(StreamOperator):
         return out
 
     def _now_ms(self) -> int:
-        import time
+        from flink_tpu.utils import clock
 
-        return int(time.time() * 1000)
+        return clock.now_ms()
 
     def _advance_time(self, now: int) -> List[StreamElement]:
         self.flush_pipeline()  # fires/expiry below read state
         # async fires from earlier calls surface before any new ones
         _pending = self.drain_pending_fires() if self.async_fire else []
-        if self._leaves is None or self.pane_base is None:
+        if self.pane_base is None or (self._leaves is None
+                                      and not self._degraded):
             return _pending
         a = self.assigner
         if isinstance(a, GlobalWindows):  # no time-bounded panes to fire
@@ -1630,10 +1945,12 @@ class WindowAggOperator(StreamOperator):
         if not expired:
             return
         self.pane_base = p
-        if self.device_sync_mode == "deferred":
-            # no in-line device writes while deferred: the next
-            # device_refresh rebuilds the whole ring (identity for slots
-            # without a live pane), which subsumes this clear
+        if self.device_sync_mode == "deferred" or self._degraded \
+                or self._leaves is None:
+            # no in-line device writes while deferred/degraded: the next
+            # device_refresh / re-promotion rebuilds the whole ring
+            # (identity for slots without a live pane), subsuming this
+            # clear
             self._device_stale = True
         else:
             slots = jnp.asarray(np.asarray(expired, np.int64) % self._P,
@@ -1645,7 +1962,7 @@ class WindowAggOperator(StreamOperator):
             self._vmirror.pop(ep, None)
             if self._nm is not None:
                 self._nm.drop_pane(ep)
-        if self._pager is not None:
+        if self._pager is not None and not self._degraded:
             self._pager.drop_panes(expired)
         if self.pane_base > self.max_pane:
             self.max_pane = self.pane_base
@@ -1659,6 +1976,19 @@ class WindowAggOperator(StreamOperator):
 
     # ------------------------------------------------------------------ fires
     def _fire_window(self, window_id: int) -> List[StreamElement]:
+        if self._degraded and self.emit_tier != "host":
+            # quarantined device tier: serve the fire from the host value
+            # mirror (zero device ops), the same pane combine the host
+            # emit tier runs
+            if self.pane_base is None:
+                return []
+            first, last = self.assigner.window_panes(window_id)
+            if last < self.pane_base or first > self.max_pane:
+                return []
+            panes = np.arange(max(first, self.pane_base),
+                              min(last, self.max_pane) + 1, dtype=np.int64)
+            with self._phase("fire"):
+                return self._fire_window_host(window_id, panes)
         if self._leaves is None:
             return []
         first, last = self.assigner.window_panes(window_id)
@@ -1903,7 +2233,11 @@ class WindowAggOperator(StreamOperator):
                 # reset them even when nothing was promoted from spill
                 self._reset_rows(rows_new)
         rows = pager.rows(gids)
-        pager.touch(pager.rows(uniq))
+        active = pager.rows(uniq)
+        pager.touch(active)
+        # rows referenced by the in-flight dispatch: protected from the
+        # OOM forced page-out (their flat scatter ids are already built)
+        self._active_rows = active
         return rows
 
     @partial(jax.jit, static_argnums=(0,))
@@ -2113,8 +2447,15 @@ class WindowAggOperator(StreamOperator):
         downstream BEFORE the barrier — the reference drains its external
         Python runtime the same way
         (``AbstractPythonFunctionOperator.prepareSnapshotPreBarrier:173``).
-        After this, ``snapshot_state`` is always legal, async_fire included."""
+        After this, ``snapshot_state`` is always legal, async_fire included.
+
+        Also the checkpoint-aligned SAFE POINT for device-lane healing:
+        a degraded operator whose monitor probed healthy re-promotes its
+        state to the device tier here, so the snapshot that follows is
+        already device-sourced and the tier switch is barrier-aligned —
+        a watermark or barrier can never observe half-migrated state."""
         self.flush_pipeline()
+        self._maybe_repromote()
         if self.async_fire:
             return self.drain_pending_fires(force=True)
         return []
@@ -2140,11 +2481,15 @@ class WindowAggOperator(StreamOperator):
         if self.key_index is not None:
             snap["key_index"] = self.key_index.snapshot()
             snap["key_index_kind"] = type(self.key_index).__name__
-        if self._leaves is not None and self.pane_base is not None:
+        if (self._leaves is not None or self._degraded) \
+                and self.pane_base is not None and self.key_index is not None:
             n = self.key_index.num_keys
             panes = np.arange(self.pane_base, self.max_pane + 1, dtype=np.int64)
             snap["panes"] = panes
-            if self.snapshot_source == "mirror":
+            if self.snapshot_source == "mirror" or self._degraded:
+                # degraded: the host value mirror IS the state — the dense
+                # gid-indexed format is identical, so a checkpoint taken
+                # DURING quarantine restores on either tier
                 # serialize the host mirror (continuously equal to device
                 # state, in higher precision) — zero device->host transfer;
                 # cast down to the device leaf dtypes so the snapshot format
@@ -2194,6 +2539,13 @@ class WindowAggOperator(StreamOperator):
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         self.flush_pipeline()
+        # restores land on the device tier; if the process-wide monitor is
+        # still quarantined, the first dispatch re-quarantines and the
+        # operator migrates again (the snapshot format is tier-agnostic)
+        self._degraded = False
+        with self._tier_lock:
+            self._tier_epoch += 1   # fence any in-flight promotion
+        self._active_rows = None
         self.pane_base = snap["pane_base"]
         self.max_pane = snap["max_pane"]
         self.last_fired_window = snap["last_fired_window"]

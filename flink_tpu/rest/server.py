@@ -252,6 +252,14 @@ class RestServer:
                     return self._send(
                         backpressure_html(status["vertices"]).encode(),
                         content_type="text/html")
+                if sub == "device_health":
+                    return self._send(status.get(
+                        "device_health", {"state": "healthy"}))
+                if sub == "device_health.html":
+                    from flink_tpu.rest.views import device_health_html
+                    return self._send(device_health_html(
+                        status.get("device_health", {})).encode(),
+                        content_type="text/html")
                 return self._send({"error": f"unknown path {sub}"}, 404)
 
             def do_POST(self):  # noqa: N802

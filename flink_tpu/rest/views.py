@@ -165,6 +165,36 @@ def checkpoints_html(history: List[Dict[str, Any]],
             '</tr></thead><tbody>' + "".join(rows) + "</tbody></table>")
 
 
+def device_health_html(status: Dict[str, Any]) -> str:
+    """Device-lane health panel (``job_status()["device_health"]``): tier
+    state badge + watchdog/quarantine/heal counters.  Server-rendered, DOM
+    -testable — same pattern as the checkpoint drill-down."""
+    state = str(status.get("state", "healthy"))
+    cls = "dh-healthy" if state == "healthy" else "dh-quarantined"
+    rows = []
+    for label, key in (("quarantines", "quarantines"),
+                       ("heals", "heals"),
+                       ("watchdog timeouts", "watchdog_timeouts"),
+                       ("watchdog near-misses", "near_misses"),
+                       ("transient retries", "transient_retries"),
+                       ("OOM page-outs", "oom_pageouts"),
+                       ("degraded operators", "degraded_operators"),
+                       ("tier migrations", "quarantine_migrations"),
+                       ("re-promotions", "repromotions")):
+        rows.append(f'<tr class="dh-row" data-metric="{_esc(key)}">'
+                    f'<td>{_esc(label)}</td>'
+                    f'<td>{_esc(status.get(key, 0))}</td></tr>')
+    failure = status.get("last_failure")
+    detail = (f'<div class="dh-failure">last failure: {_esc(failure)}</div>'
+              if failure else "")
+    return (f'<div class="dh-panel">'
+            f'<span class="dh-state {cls}" data-state="{_esc(state)}">'
+            f'device tier: {_esc(state)}</span>{detail}'
+            f'<table class="dh-table"><thead><tr><th>metric</th>'
+            f'<th>value</th></tr></thead><tbody>' + "".join(rows)
+            + "</tbody></table></div>")
+
+
 def backpressure_html(vertices: List[Dict[str, Any]]) -> str:
     """Per-SUBTASK busy/backpressure/idle bars (the reference's subtask
     backpressure tab), one row per subtask under its vertex."""

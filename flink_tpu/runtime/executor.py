@@ -399,8 +399,16 @@ class LocalExecutor:
     def _advance_processing_time(self, running: Dict[int, RunningVertex]) -> None:
         """Fire due processing-time timers on every vertex (the
         ``ProcessingTimeService`` tick; local mode polls wall clock between
-        source rounds — same granularity as the mailbox checking its mail)."""
-        now_ms = int(time.time() * 1000)
+        source rounds — same granularity as the mailbox checking its mail).
+
+        Reads through the injectable clock seam (``utils/clock.py``) and
+        clamps MONOTONE at this boundary: a backward-stepped wall clock
+        (chaos ``ClockSkew``, NTP) must never rewind processing time —
+        the reference's ``ProcessingTimeService`` is monotone by contract,
+        so timers can neither re-fire nor fire early on a step back."""
+        from flink_tpu.utils import clock
+        now_ms = max(clock.now_ms(), getattr(self, "_proc_time_ms", 0))
+        self._proc_time_ms = now_ms
         for rv in running.values():
             out = rv.operator.on_processing_time(now_ms)
             if out:

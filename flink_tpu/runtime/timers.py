@@ -115,6 +115,13 @@ class InternalTimerService:
         self.event_timers = _TimerTable()
         self.proc_timers = _TimerTable()
         self.current_watermark: int = LONG_MIN
+        #: high-water processing time: the service is MONOTONE even when
+        #: the driving clock is not (chaos ClockSkew / NTP step-back) —
+        #: ``ProcessingTimeService`` contract.  A backward step can
+        #: neither re-fire popped timers (they left the table) nor fire
+        #: pending ones early; a forward jump fires everything due at once
+        #: (no stuck timers).
+        self.current_processing_time: int = LONG_MIN
 
     # -- registration (batched) ---------------------------------------------
     def register_event_time(self, slots, timestamps, namespaces=None) -> None:
@@ -137,7 +144,9 @@ class InternalTimerService:
         return self.event_timers.pop_due(watermark)
 
     def advance_processing_time(self, now_ms: int):
-        return self.proc_timers.pop_due(now_ms)
+        self.current_processing_time = max(self.current_processing_time,
+                                           now_ms)
+        return self.proc_timers.pop_due(self.current_processing_time)
 
     def next_processing_time(self) -> Optional[int]:
         """Earliest pending processing-time timer (executor wakeup hint)."""
@@ -147,9 +156,11 @@ class InternalTimerService:
     def snapshot(self) -> Dict[str, Any]:
         return {"event": self.event_timers.snapshot(),
                 "proc": self.proc_timers.snapshot(),
-                "watermark": self.current_watermark}
+                "watermark": self.current_watermark,
+                "proc_time": self.current_processing_time}
 
     def restore(self, snap: Dict[str, Any]) -> None:
         self.event_timers.restore(snap["event"])
         self.proc_timers.restore(snap["proc"])
         self.current_watermark = int(snap.get("watermark", LONG_MIN))
+        self.current_processing_time = int(snap.get("proc_time", LONG_MIN))

@@ -106,13 +106,31 @@ class ScramServer:
             raise ValueError("malformed client-first message")
         return _saslname_unescape(name[2:])
 
-    def first_response(self, client_first: str, password: str) -> str:
+    def first_response(self, client_first: str,
+                       password: Optional[str] = None, *,
+                       salt: Optional[bytes] = None,
+                       salted: Optional[bytes] = None) -> str:
+        """Build the server-first message.  Either pass the ``password``
+        (the salted key is derived here — one PBKDF2 per handshake, fresh
+        random salt), or pass ``salt`` + ``salted`` directly: credential
+        stores keep a STABLE per-user salt and cache the salted password,
+        so repeated (including unauthenticated) handshakes stop costing a
+        fresh 4096-iteration PBKDF2 — and unknown-user handshakes can be
+        served with a deterministic decoy salt that never touches a real
+        credential (no username enumeration)."""
         self._bare = client_first.split(",", 2)[2]
         cnonce = _attrs(self._bare)["r"]
-        salt = os.urandom(16)
+        if salted is not None:
+            if salt is None:
+                raise ValueError("salted requires its salt")
+            self._salted = salted
+        else:
+            if password is None:
+                raise ValueError("need password or (salt, salted)")
+            salt = os.urandom(16) if salt is None else salt
+            self._salted = hashlib.pbkdf2_hmac(
+                "sha256", password.encode(), salt, self.iterations)
         self._snonce = cnonce + _b64(os.urandom(18))
-        self._salted = hashlib.pbkdf2_hmac(
-            "sha256", password.encode(), salt, self.iterations)
         self._server_first = (f"r={self._snonce},s={_b64(salt)},"
                               f"i={self.iterations}")
         return self._server_first

@@ -37,7 +37,10 @@ _ABSENT = -1
 
 
 def _now_ms() -> int:
-    return int(time.time() * 1000)
+    # TTL's default clock reads through the injectable clock seam, so a
+    # chaos ClockSkew schedule steers expiry deterministically
+    from flink_tpu.utils.clock import now_ms
+    return now_ms()
 
 
 def _segment_order_spans(slots: np.ndarray):

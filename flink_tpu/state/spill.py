@@ -38,7 +38,9 @@ _ABSENT = -1
 
 
 def _now_ms() -> int:
-    return int(time.time() * 1000)
+    # TTL default clock: the injectable seam (chaos ClockSkew-aware)
+    from flink_tpu.utils.clock import now_ms
+    return now_ms()
 
 
 class _SpillStateBase:

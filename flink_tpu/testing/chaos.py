@@ -42,15 +42,20 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 __all__ = [
     "InjectedFault", "FaultSchedule", "FailTimes", "CrashOnceAt", "DelayBy",
     "SlowDisk", "ActionSequence", "Partition", "FailWithProbability",
+    "WedgedDevice", "ClockSkew",
     "FaultInjector", "FreezableProxy", "install", "uninstall", "installed",
-    "fire", "active", "blocked",
+    "fire", "active", "blocked", "skew",
 ]
 
 #: actions a schedule may return for one firing
 OK = "ok"          # proceed normally
 FAIL = "fail"      # raise InjectedFault at the fault point
 DROP = "drop"      # suppress delivery (heartbeats) / stall the link (channels)
-Action = Union[str, Tuple[str, float]]   # ("delay", seconds) is the 4th kind
+HANG = "hang"      # block the firing thread until the schedule heals — the
+#                    wedged-accelerator model (device_health watchdog prey)
+# ("delay", seconds), ("fail", message) and ("skew", offset_ms) are the
+# parameterized kinds
+Action = Union[str, Tuple[str, float], Tuple[str, str]]
 
 
 class InjectedFault(RuntimeError):
@@ -72,20 +77,34 @@ class FaultSchedule:
         """Is the schedule in a PERSISTENT drop state right now?  Polled by
         stalled senders (via :func:`blocked`) without advancing the firing
         counter.  Default False: a one-shot ``drop`` from a sequence is a
-        momentary loss, not a stall — only :class:`Partition` keeps a link
-        down until explicitly healed."""
+        momentary loss, not a stall — only :class:`Partition` (and
+        :class:`WedgedDevice`) keeps a link down until explicitly healed."""
         return False
+
+    def matches(self, ctx: Dict) -> bool:
+        """Does this schedule apply to a firing with context ``ctx``?
+        Unmatched firings proceed normally WITHOUT advancing the counter,
+        RNG or history (so directional schedules stay deterministic
+        regardless of how much opposite-direction traffic flows).  Default:
+        applies to every firing."""
+        return True
 
 
 class FailTimes(FaultSchedule):
     """Fail the first ``k`` firings, then succeed forever — the transient
-    storage-flake model (retry/backoff must absorb exactly ``k`` errors)."""
+    storage-flake model (retry/backoff must absorb exactly ``k`` errors).
+    ``message`` customizes the raised error text, letting tests steer
+    error CLASSIFIERS (e.g. the device-health monitor reads
+    RESOURCE_EXHAUSTED as an OOM)."""
 
-    def __init__(self, k: int):
+    def __init__(self, k: int, message: Optional[str] = None):
         self.k = k
+        self.message = message
 
     def action(self, n: int, rng: random.Random) -> Action:
-        return FAIL if n <= self.k else OK
+        if n > self.k:
+            return OK
+        return FAIL if self.message is None else (FAIL, self.message)
 
 
 class CrashOnceAt(FaultSchedule):
@@ -161,12 +180,25 @@ class ActionSequence(FaultSchedule):
 
 class Partition(FaultSchedule):
     """Suppress delivery until healed (``drop`` while active) — the
-    logical-link partition; :class:`FreezableProxy` is its TCP twin."""
+    logical-link partition; :class:`FreezableProxy` is its TCP twin.
 
-    def __init__(self, active: bool = True):
+    ``direction`` makes the partition ASYMMETRIC: only firings whose
+    context carries a matching ``direction=...`` are dropped; everything
+    else (the opposite direction, or callers that pass no direction)
+    proceeds without even advancing the schedule's counter.  The classic
+    one-way-partition false suspect: A's messages to B blackhole while
+    B→A flows."""
+
+    def __init__(self, active: bool = True,
+                 direction: Optional[str] = None):
+        self.direction = direction
         self._active = threading.Event()
         if active:
             self._active.set()
+
+    def matches(self, ctx: Dict) -> bool:
+        return self.direction is None or ctx.get("direction") == \
+            self.direction
 
     def partition(self) -> None:
         self._active.set()
@@ -183,6 +215,75 @@ class Partition(FaultSchedule):
 
     def dropping(self) -> bool:
         return self._active.is_set()
+
+
+class WedgedDevice(FaultSchedule):
+    """Hang the firing thread from the ``at``-th firing until healed — the
+    wedged-accelerator model (VERDICT r5 weak #1: a SIGKILLed tunnel
+    client's device grant is never released; ``block_until_ready`` then
+    blocks forever in every process).  Deterministic: firing ``at`` (and
+    every later one while active) parks inside :meth:`FaultInjector.fire`
+    in a ``dropping()`` poll loop; :meth:`heal` releases it.  The
+    device-health watchdog is expected to abandon the hung dispatch from
+    outside long before then — the parked thread is the sacrifice."""
+
+    def __init__(self, at: int = 1):
+        self.at = at
+        self._active = threading.Event()
+        self._active.set()
+        self._reached = threading.Event()   # a firing actually wedged
+
+    def heal(self) -> None:
+        self._active.clear()
+
+    @property
+    def healed(self) -> bool:
+        return not self._active.is_set()
+
+    @property
+    def wedged_once(self) -> bool:
+        """Did any firing actually park?  (Test synchronization hook.)"""
+        return self._reached.is_set()
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        if self._active.is_set() and n >= self.at:
+            self._reached.set()
+            return HANG
+        return OK
+
+    def dropping(self) -> bool:
+        return self._active.is_set()
+
+
+class ClockSkew(FaultSchedule):
+    """Seeded clock skew applied per clock READING (``clock.wall`` /
+    ``clock.monotonic`` points, consumed via :func:`skew`): offset =
+    cumulative step ``jumps`` + linear ``drift_ms_per_read`` + seeded
+    jitter in ``[-jitter_ms, +jitter_ms]``.
+
+    ``jumps`` is a sequence of ``(reading_n, delta_ms)``: from the n-th
+    reading onward the clock is additionally offset by ``delta_ms``
+    (negative = backward step, positive = forward jump).  Pure function of
+    (seed, point, reading count) — two runs with one seed see identical
+    skewed clocks.  ``times`` bounds the skewed period (NTP "recovers"
+    afterwards)."""
+
+    def __init__(self, jumps: Sequence[Tuple[int, float]] = (),
+                 drift_ms_per_read: float = 0.0, jitter_ms: float = 0.0,
+                 times: Optional[int] = None):
+        self.jumps = list(jumps)
+        self.drift = float(drift_ms_per_read)
+        self.jitter = float(jitter_ms)
+        self.times = times
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        # ALWAYS draw: the RNG stream must advance identically per reading
+        # regardless of the recovered/skewed branch (SlowDisk invariant)
+        j = (2.0 * rng.random() - 1.0) * self.jitter
+        if self.times is not None and n > self.times:
+            return OK
+        off = sum(d for at, d in self.jumps if n >= at)
+        return ("skew", off + self.drift * n + j)
 
 
 class FailWithProbability(FaultSchedule):
@@ -228,14 +329,13 @@ class FaultInjector:
             else:
                 self._schedules.pop(point, None)
 
-    def fire(self, point: str, **ctx) -> bool:
-        """Consult the point's schedule: returns True to proceed, False to
-        suppress delivery (``drop``), sleeps on ``delay``, raises
-        :class:`InjectedFault` on ``fail``."""
+    def _consult(self, point: str, ctx) -> Tuple[Optional[FaultSchedule],
+                                                 Action, int]:
+        """One firing: match, count, draw the action, record history."""
         with self._lock:
             sched = self._schedules.get(point)
-            if sched is None:
-                return True
+            if sched is None or not sched.matches(ctx):
+                return None, OK, 0
             n = self._counts.get(point, 0) + 1
             self._counts[point] = n
             rng = self._rngs.get(point)
@@ -244,27 +344,53 @@ class FaultInjector:
                     f"{self.seed}:{point}")
             act = sched.action(n, rng)
             self._history.setdefault(point, []).append(act)
+        return sched, act, n
+
+    def fire(self, point: str, **ctx) -> bool:
+        """Consult the point's schedule: returns True to proceed, False to
+        suppress delivery (``drop``), sleeps on ``delay``, parks on
+        ``hang`` until the schedule heals, raises :class:`InjectedFault`
+        on ``fail``."""
+        sched, act, n = self._consult(point, ctx)
         if act == OK:
             return True
         if act == DROP:
             return False
+        if act == HANG:
+            # wedged: park until healed — the hang itself fired exactly
+            # once, so determinism survives any wedge duration
+            while sched.dropping():
+                time.sleep(0.005)
+            return True
         if isinstance(act, tuple) and act[0] == "delay":
             time.sleep(act[1])
             return True
+        if isinstance(act, tuple) and act[0] == FAIL:
+            raise InjectedFault(act[1])
         raise InjectedFault(f"injected fault at {point} (firing {n}, "
                             f"ctx={ctx or {}})")
 
-    def blocked(self, point: str) -> bool:
+    def skew(self, point: str, **ctx) -> float:
+        """Clock-reading twin of :meth:`fire`: returns the schedule's skew
+        offset in ms (``("skew", off)`` actions), 0.0 otherwise.  Each
+        reading advances the point's counter/RNG/history like a firing."""
+        _sched, act, _n = self._consult(point, ctx)
+        if isinstance(act, tuple) and act[0] == "skew":
+            return float(act[1])
+        return 0.0
+
+    def blocked(self, point: str, **ctx) -> bool:
         """Is the point's schedule in a persistent drop state?  The poll
         primitive for partition-style stalls: a blocked sender re-checks
         until :meth:`Partition.heal` without advancing the firing counter,
         RNG or history — stall duration never corrupts determinism.  A
         one-shot ``drop`` (e.g. from an :class:`ActionSequence`) reads as
         not-blocked, so it delays a sender momentarily instead of hanging
-        it forever."""
+        it forever.  Directional schedules only read blocked for matching
+        ``ctx`` (same contract as :meth:`fire`)."""
         with self._lock:
             sched = self._schedules.get(point)
-        return sched is not None and sched.dropping()
+        return sched is not None and sched.dropping() and sched.matches(ctx)
 
     def history(self, point: Optional[str] = None):
         """Recorded action sequence of one point (or all points) — the
@@ -277,6 +403,10 @@ class FaultInjector:
     def fired(self, point: str) -> int:
         with self._lock:
             return self._counts.get(point, 0)
+
+    def has_schedule(self, point: str) -> bool:
+        with self._lock:
+            return point in self._schedules
 
 
 # ---------------------------------------------------------------------------
@@ -320,11 +450,21 @@ def fire(point: str, **ctx) -> bool:
     return inj.fire(point, **ctx)
 
 
-def blocked(point: str) -> bool:
+def blocked(point: str, **ctx) -> bool:
     """Poll a dropped point without re-firing it (counter/RNG/history stay
     untouched): a stalled sender loops on this until the partition heals."""
     inj = _ACTIVE
-    return inj is not None and inj.blocked(point)
+    return inj is not None and inj.blocked(point, **ctx)
+
+
+def skew(point: str, **ctx) -> float:
+    """Clock-reading hook (``utils/clock.py``): current skew offset in ms
+    from an installed :class:`ClockSkew` schedule; 0.0 when no injector or
+    no schedule — near-zero cost on the unskewed path."""
+    inj = _ACTIVE
+    if inj is None:
+        return 0.0
+    return inj.skew(point, **ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -337,25 +477,42 @@ class FreezableProxy:
 
     Interpose it on a component's path to a real-socket service (object
     store, Kafka broker, worker control plane) and call :meth:`freeze` /
-    :meth:`heal`; iptables-free, in-process, deterministic."""
+    :meth:`heal`; iptables-free, in-process, deterministic.
+
+    :meth:`freeze` takes an optional ``direction`` for ASYMMETRIC
+    partitions: ``"a->b"`` blackholes only client→server bytes (requests
+    vanish, responses would flow), ``"b->a"`` only server→client
+    (requests arrive, responses vanish), ``"both"`` (default) the classic
+    full blackhole."""
+
+    DIRECTIONS = ("both", "a->b", "b->a")
 
     def __init__(self, target_host: str, target_port: int):
         self.target = (target_host, target_port)
         self._srv = socket.create_server(("127.0.0.1", 0))
         self.port = self._srv.getsockname()[1]
         self.url = f"http://127.0.0.1:{self.port}"
-        self._frozen = threading.Event()
+        self._frozen = {"a->b": threading.Event(),
+                        "b->a": threading.Event()}
         self._stop = threading.Event()
         self._threads = []
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
 
-    def freeze(self) -> None:
-        self._frozen.set()
+    def freeze(self, direction: str = "both") -> None:
+        if direction not in self.DIRECTIONS:
+            raise ValueError(f"direction must be one of {self.DIRECTIONS}")
+        for d, ev in self._frozen.items():
+            if direction in ("both", d):
+                ev.set()
 
-    def heal(self) -> None:
-        self._frozen.clear()
+    def heal(self, direction: str = "both") -> None:
+        if direction not in self.DIRECTIONS:
+            raise ValueError(f"direction must be one of {self.DIRECTIONS}")
+        for d, ev in self._frozen.items():
+            if direction in ("both", d):
+                ev.clear()
 
     def _accept_loop(self) -> None:
         self._srv.settimeout(0.2)
@@ -371,19 +528,17 @@ class FreezableProxy:
             except OSError:
                 conn.close()
                 continue
-            for a, b in ((conn, up), (up, conn)):
-                t = threading.Thread(target=self._pump, args=(a, b),
+            for a, b, d in ((conn, up, "a->b"), (up, conn, "b->a")):
+                t = threading.Thread(target=self._pump, args=(a, b, d),
                                      daemon=True)
                 t.start()
                 self._threads.append(t)
 
-    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              direction: str) -> None:
+        frozen = self._frozen[direction]
         src.settimeout(0.2)
         while not self._stop.is_set():
-            if self._frozen.is_set():
-                # partition: bytes neither flow nor error — both sides hang
-                time.sleep(0.05)
-                continue
             try:
                 data = src.recv(65536)
             except socket.timeout:
@@ -392,6 +547,14 @@ class FreezableProxy:
                 break
             if not data:
                 break
+            if frozen.is_set():
+                # blackhole: this direction's bytes are DROPPED on the
+                # floor (never queued — a heal must not deliver stale
+                # in-flight traffic the sender already gave up on); the
+                # sender neither errors nor progresses, exactly the
+                # packets-vanish partition, while the opposite pump may
+                # still be forwarding
+                continue
             try:
                 dst.sendall(data)
             except OSError:

@@ -1,0 +1,55 @@
+"""Injectable process clock — the single seam between the runtime and
+wall/monotonic time.
+
+Every time-dependent runtime component (processing-time ticks in the
+executors and task drivers, state TTL in ``state/heap.py`` and
+``state/spill.py``, session-gap closing, heartbeat liveness) reads time
+through this module instead of calling ``time.time()`` directly, for two
+reasons:
+
+1. **Chaos**: an installed :class:`~flink_tpu.testing.chaos.ClockSkew`
+   schedule (points ``clock.wall`` / ``clock.monotonic``) offsets every
+   reading deterministically — seeded backward steps, forward jumps and
+   drift, the NTP-misbehaviour nemesis.  Consumers must therefore never
+   assume two consecutive readings are ordered; components that need
+   monotone time clamp at their own boundary (the executors' processing
+   -time tick, ``InternalTimerService.advance_processing_time``).
+2. **Tests**: a :class:`Clock` instance is injectable wherever a component
+   takes a ``clock=`` parameter, without monkeypatching ``time``.
+
+The chaos hook costs one module attribute read + ``None`` check when no
+injector is installed (``chaos.skew``), so the hot paths can afford it.
+"""
+
+from __future__ import annotations
+
+import time
+
+from flink_tpu.testing import chaos
+
+__all__ = ["Clock", "SYSTEM_CLOCK", "now_ms", "monotonic"]
+
+
+class Clock:
+    """Wall + monotonic clock pair, chaos-overridable per reading."""
+
+    def now_ms(self) -> int:
+        """Wall clock in epoch milliseconds (``clock.wall`` skew point)."""
+        return int(time.time() * 1000.0 + chaos.skew("clock.wall"))
+
+    def monotonic(self) -> float:
+        """Monotonic seconds (``clock.monotonic`` skew point, offset in
+        ms).  NOTE: under an active skew schedule this is no longer
+        monotone — that is the point of the nemesis."""
+        return time.monotonic() + chaos.skew("clock.monotonic") / 1000.0
+
+
+SYSTEM_CLOCK = Clock()
+
+
+def now_ms() -> int:
+    return SYSTEM_CLOCK.now_ms()
+
+
+def monotonic() -> float:
+    return SYSTEM_CLOCK.monotonic()

@@ -139,6 +139,26 @@ def test_profile_artifact_produced_and_keys_match(tmp_path):
     assert "phase_bytes" in prof and "elapsed_ms" in prof
 
 
+def test_inject_wedge_smoke_exercises_shared_recovery_path(tmp_path):
+    """bench.py --inject-wedge drives the runtime/bench SHARED recovery
+    path (device_health watchdog -> quarantine -> degrade -> heal ->
+    checkpoint-aligned re-promotion) end-to-end on CPU and exits 0 only
+    when the full cycle ran with digest-identical fires."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--inject-wedge"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"] and result["digest_match"]
+    assert result["snapshot_during_quarantine"]
+    hs = result["device_health"]
+    assert hs["quarantines"] == 1 and hs["heals"] == 1
+    assert hs["watchdog_timeouts"] == 1
+    assert hs["quarantine_migrations"] == 1 and hs["repromotions"] == 1
+    assert hs["state"] == "healthy" and hs["degraded"] == 0
+
+
 @pytest.mark.slow
 def test_smoke_bench_passes_gate():
     """The committed budget must hold on this host: run the real smoke

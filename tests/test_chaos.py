@@ -353,6 +353,39 @@ def test_heartbeat_partition_false_suspects_then_heals():
         hb.stop()
 
 
+def test_heartbeat_asymmetric_partition_drops_one_direction_only():
+    """Partition(direction="response"): the monitor's heartbeat REQUESTS
+    keep reaching the target (it demonstrably keeps answering) while the
+    answers vanish — the target is falsely suspected by a one-direction
+    link, the sharpest false-suspect shape.  The request direction keeps
+    firing (and counting) untouched."""
+    inj = chaos.install(FaultInjector())
+    dead = []
+    answered = []
+    hb = HeartbeatManager(interval_s=0.03, timeout_s=0.12,
+                          on_timeout=dead.append)
+
+    def _answer():
+        answered.append(1)
+        hb.receive_heartbeat("tm-1")
+
+    hb.monitor_target("tm-1", HeartbeatTarget(_answer))
+    inj.inject("heartbeat.deliver", Partition(direction="response"))
+    hb.start()
+    try:
+        deadline = time.monotonic() + 3.0
+        while "tm-1" not in dead and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert dead == ["tm-1"], "responses dropped -> false suspect"
+        assert len(answered) >= 2, \
+            "requests must have kept flowing (the partition is one-way)"
+        # deterministic history: only matching (response) firings counted
+        assert all(a == chaos.DROP for a in inj.history("heartbeat.deliver"))
+        assert inj.fired("heartbeat.deliver") == len(answered)
+    finally:
+        hb.stop()
+
+
 def test_rpc_drop_loses_message_fail_raises():
     class Echo(RpcEndpoint):
         def ping(self, x):

@@ -1,0 +1,182 @@
+"""Clock seam + ClockSkew nemesis (ISSUE-4 satellite, VERDICT next #8).
+
+The runtime reads time through ``flink_tpu/utils/clock.py``; a chaos
+``ClockSkew`` schedule offsets every reading deterministically (seeded
+backward steps, forward jumps, drift).  These tests assert the monotone
+boundaries hold: processing-time timers never fire early on a backward
+step and never stick on a forward jump; state TTL never expires early;
+session gaps never close early.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.testing import chaos
+from flink_tpu.testing.chaos import ClockSkew, FaultInjector
+from flink_tpu.utils import clock
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    chaos.uninstall()
+
+
+def test_clock_skew_is_seeded_and_deterministic():
+    def offsets(seed):
+        inj = FaultInjector(seed=seed)
+        inj.inject("clock.wall", ClockSkew(jumps=[(3, -5000), (6, 60000)],
+                                           drift_ms_per_read=1.5,
+                                           jitter_ms=10.0))
+        with chaos.installed(inj):
+            return [chaos.skew("clock.wall") for _ in range(10)]
+
+    o1, o2 = offsets(5), offsets(5)
+    assert o1 == o2, "same seed must reproduce the exact skew sequence"
+    assert offsets(6) != o1
+    # jumps apply from their reading on; drift accumulates; jitter bounded
+    assert o1[0] == pytest.approx(1.5, abs=10.0)
+    assert o1[3] == pytest.approx(-5000 + 1.5 * 4, abs=10.0)
+    assert o1[7] == pytest.approx(55000 + 1.5 * 8, abs=10.0)
+
+
+def test_clock_reads_through_skew():
+    import time as _time
+    inj = FaultInjector(seed=1)
+    inj.inject("clock.wall", ClockSkew(jumps=[(1, -600_000)]))
+    with chaos.installed(inj):
+        skewed = clock.now_ms()
+    real = int(_time.time() * 1000)
+    assert 500_000 < real - skewed < 700_000
+    # no injector: exact wall clock, zero offset
+    assert abs(clock.now_ms() - int(_time.time() * 1000)) < 5_000
+
+
+def test_timer_service_monotone_under_backward_steps():
+    """Processing-time timers: a backward-stepped clock neither re-fires
+    popped timers nor fires pending ones early; a forward jump fires
+    everything due at once (no stuck timers)."""
+    from flink_tpu.runtime.timers import InternalTimerService
+
+    svc = InternalTimerService()
+    svc.register_processing_time([1], [1000])
+    svc.register_processing_time([2], [5000])
+    s, _, _ = svc.advance_processing_time(500)
+    assert s.size == 0
+    s, _, _ = svc.advance_processing_time(2000)
+    assert s.tolist() == [1]
+    # backward step: service time stays at its high-water mark
+    s, _, _ = svc.advance_processing_time(100)
+    assert s.size == 0 and svc.current_processing_time == 2000
+    # a timer registered in the (stepped-back) past fires at the next
+    # advance, not early and not never
+    svc.register_processing_time([3], [1500])
+    s, _, _ = svc.advance_processing_time(300)   # still behind high-water
+    assert s.tolist() == [3]
+    # forward jump: everything due fires at once
+    s, _, _ = svc.advance_processing_time(1_000_000)
+    assert s.tolist() == [2]
+    # snapshot round-trips the monotone high-water mark
+    snap = svc.snapshot()
+    svc2 = InternalTimerService()
+    svc2.restore(snap)
+    assert svc2.current_processing_time == 1_000_000
+
+
+def test_executor_processing_tick_monotone_under_skew():
+    """The LocalExecutor's ProcessingTimeService tick clamps monotone at
+    the clock seam: operators observe non-decreasing processing time even
+    while ClockSkew steps the wall clock backward."""
+    from flink_tpu.runtime.executor import LocalExecutor
+
+    seen = []
+
+    class _Probe:
+        def on_processing_time(self, ts):
+            seen.append(ts)
+            return []
+
+    ex = LocalExecutor()
+    running = {0: type("RV", (), {"operator": _Probe()})()}
+    inj = FaultInjector(seed=2)
+    # every second reading steps 10 minutes back, then recovers
+    inj.inject("clock.wall", ClockSkew(jumps=[(2, -600_000), (3, 600_000),
+                                              (4, -600_000), (5, 600_000)]))
+    with chaos.installed(inj):
+        for _ in range(5):
+            ex._advance_processing_time(running)
+    assert seen == sorted(seen), f"processing time regressed: {seen}"
+
+
+def test_ttl_no_premature_expiry_on_backward_step():
+    """State TTL under ClockSkew: a backward step must not expire live
+    state (cutoff moves back too); a forward jump past the TTL does."""
+    from flink_tpu.state.api import StateTtlConfig
+    from flink_tpu.state.heap import HeapKeyedStateBackend
+
+    backend = HeapKeyedStateBackend()
+    st = backend.value_state("v", dtype=np.float64,
+                             ttl=StateTtlConfig(ttl_ms=60_000))
+    slots = backend.key_slots(np.asarray([7]))
+    st.put_rows(slots, [1.0])        # touch at real wall time (no skew)
+    inj = FaultInjector(seed=3)
+    # skewed readings 2..3: 10 min BACKWARD; reading 4+: net +10 min
+    inj.inject("clock.wall", ClockSkew(jumps=[(2, -600_000),
+                                              (4, 1_200_000)]))
+    with chaos.installed(inj):
+        _vals, alive = st.get_rows(slots)          # reading 1 (no skew)
+        assert alive[0]
+        _vals, alive = st.get_rows(slots)          # reading 2 (backward)
+        assert alive[0], "backward step expired live state"
+        _vals, alive = st.get_rows(slots)          # reading 3 (backward)
+        assert alive[0]
+        _vals, alive = st.get_rows(slots)          # reading 4: +10 min
+        assert not alive[0], "TTL past its horizon must expire"
+
+
+def test_session_gap_monotone_under_skew():
+    """Processing-time session windows: a backward step neither closes a
+    session early nor reopens gap progress; the session closes exactly
+    when (monotone) processing time passes last-activity + gap."""
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.session_window import SessionWindowOperator
+    from flink_tpu.windowing.assigners import ProcessingTimeSessionWindows
+    import jax.numpy as jnp
+
+    op = SessionWindowOperator(ProcessingTimeSessionWindows(gap_ms=100),
+                               SumAggregator(jnp.float64), key_column="k",
+                               value_column="v")
+    op.open(RuntimeContext())
+    assert op.on_processing_time(1000) == []
+    op.process_batch(RecordBatch({"k": np.asarray([1, 1]),
+                                  "v": np.asarray([2.0, 3.0])}))
+    # gap not yet passed
+    assert op.on_processing_time(1050) == []
+    # BACKWARD step: must not close the session, must not rewind progress
+    assert op.on_processing_time(200) == []
+    assert op._proc_time == 1050
+    # gap passes on monotone time: exactly one fire with the full sum
+    fired = op.on_processing_time(1200)
+    rows = [b for b in fired if hasattr(b, "columns")]
+    assert len(rows) == 1 and len(rows[0]) == 1
+    assert float(np.asarray(rows[0].column("result"))[0]) == 5.0
+    # no refire after another backward step + recovery
+    assert op.on_processing_time(100) == []
+    assert op.on_processing_time(1300) == []
+
+
+def test_heartbeat_clock_seam_injectable():
+    """HeartbeatManager's default clock reads the seam (a monotonic skew
+    can falsely age heartbeats — the local-clock-jump false suspect)."""
+    from flink_tpu.cluster.heartbeat import HeartbeatManager
+
+    hb = HeartbeatManager()
+    inj = FaultInjector(seed=4)
+    inj.inject("clock.monotonic", ClockSkew(jumps=[(1, 50_000)]))
+    import time as _time
+    with chaos.installed(inj):
+        skewed = hb._clock()
+    assert skewed - _time.monotonic() > 40.0

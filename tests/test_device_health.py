@@ -1,0 +1,587 @@
+"""Device-lane health: watchdog, classification, quarantine, degraded
+tier, background healing, checkpoint-aligned re-promotion.
+
+The ISSUE-4 tentpole suite: the accelerator is a failure domain — a
+wedged dispatch must be detected (sacrificial watcher, bounded deadline),
+the device tier quarantined, the operator degraded MID-JOB onto the
+host/numpy tier bit-exactly, and healed back at a checkpoint boundary.
+All on CPU, via the deterministic ``WedgedDevice`` chaos schedule hanging
+the ``device.dispatch`` fault point.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import RuntimeContext, SumAggregator
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.runtime import device_health as dh
+from flink_tpu.runtime.device_health import (DeviceHealthMonitor,
+                                             DeviceQuarantinedError,
+                                             WatchdogConfig, classify_failure)
+from flink_tpu.testing import chaos
+from flink_tpu.testing.chaos import FailTimes, FaultInjector, WedgedDevice
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+pytestmark = pytest.mark.chaos
+
+WINDOW_MS = 1000
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor_and_injector():
+    """Neither a quarantined monitor nor an injector may leak across
+    tests (the monitor is process-wide by design)."""
+    prev = dh.get_monitor(create=False)
+    yield
+    dh.set_monitor(prev if prev is not None and prev.healthy else None)
+    chaos.uninstall()
+
+
+def _fast_monitor(**kw):
+    # the first-dispatch grace stays generous by default: operator tests'
+    # first dispatch carries an XLA compile, which must not read as a
+    # wedge even under the test-sized deadline floor
+    cfg = WatchdogConfig(deadline_floor_s=kw.pop("deadline_floor_s", 0.25),
+                         first_dispatch_grace_s=kw.pop(
+                             "first_dispatch_grace_s", 30.0),
+                         backoff_initial_s=0.001, backoff_max_s=0.01,
+                         probe_backoff_initial_s=0.02,
+                         probe_backoff_max_s=0.1)
+    mon = DeviceHealthMonitor(cfg, **kw)
+    dh.set_monitor(mon)
+    return mon
+
+
+def _build_op(emit_tier="device", paging_cap=0, **kw):
+    paging = None
+    if paging_cap:
+        from flink_tpu.state.paging import PagingConfig
+        paging = PagingConfig(capacity=paging_cap)
+    op = WindowAggOperator(
+        TumblingEventTimeWindows.of(WINDOW_MS), SumAggregator(jnp.float32),
+        key_column="k", value_column="v", emit_tier=emit_tier,
+        paging=paging, **kw)
+    op.open(RuntimeContext())
+    return op
+
+
+def _batches(n=20, b=256, keys=37, seed=5):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        k = rng.integers(0, keys, b)
+        v = np.ones(b, np.float32)
+        ts = i * (WINDOW_MS // 2) + np.sort(
+            rng.integers(0, WINDOW_MS // 2, b)).astype(np.int64)
+        out.append((k, v, ts))
+    return out
+
+
+def _digests(elements):
+    """(rows, sum) per fired window — merged per window id, because a
+    paged fire legitimately emits resident and spilled keys as separate
+    batches of the same window."""
+    out = {}
+    for b in elements:
+        if hasattr(b, "columns") and "result" in b.columns:
+            w = int(np.asarray(b.column("window_start"))[0])
+            rows, total = out.get(w, (0, 0.0))
+            out[w] = (rows + len(b),
+                      total + float(np.asarray(b.column("result"),
+                                               np.float64).sum()))
+    return sorted((w, r, s) for w, (r, s) in out.items())
+
+
+# ---------------------------------------------------------------------------
+# monitor unit tests
+# ---------------------------------------------------------------------------
+
+def test_classifier_conservative():
+    assert classify_failure(RuntimeError(
+        "RESOURCE_EXHAUSTED: out of memory")) == dh.OOM
+    assert classify_failure(RuntimeError("UNAVAILABLE: socket closed")) \
+        == dh.TRANSIENT
+    assert classify_failure(RuntimeError("INTERNAL: stream terminated")) \
+        == dh.TRANSIENT
+    assert classify_failure(chaos.InjectedFault("boom")) == dh.TRANSIENT
+    # programming errors must surface unchanged, never retried — absl
+    # status codes match as UPPERCASE words, not prose substrings
+    assert classify_failure(TypeError("bad operand shape")) == dh.FATAL
+    assert classify_failure(ValueError("shapes (3,) and (4,)")) == dh.FATAL
+    assert classify_failure(ValueError("unknown key column x")) == dh.FATAL
+    assert classify_failure(ValueError("operation aborted by user")) \
+        == dh.FATAL
+    assert classify_failure(KeyError("internal_field")) == dh.FATAL
+
+
+def test_watchdog_fires_under_wedged_device():
+    """A dispatch hung by WedgedDevice misses its deadline: the lane is
+    sacrificed, the tier quarantined, the caller unblocked with
+    DeviceQuarantinedError — the task mailbox never hangs."""
+    mon = _fast_monitor(heal_async=False, first_dispatch_grace_s=0.25)
+    inj = FaultInjector(seed=1)
+    sched = inj.inject("device.dispatch", WedgedDevice(at=1))
+    ran = []
+    with chaos.installed(inj):
+        t0 = time.monotonic()
+        with pytest.raises(DeviceQuarantinedError):
+            mon.run_guarded(lambda: ran.append(1))
+        assert time.monotonic() - t0 < 5.0   # bounded, not forever
+    assert mon.quarantined
+    assert mon.counters["watchdog_timeouts"] == 1
+    assert mon.counters["quarantines"] == 1
+    # the abandoned lane must NOT run the thunk once the schedule heals
+    sched.heal()
+    time.sleep(0.1)
+    assert ran == []
+    # further dispatches refuse fast (no deadline wait) while quarantined
+    t0 = time.monotonic()
+    with pytest.raises(DeviceQuarantinedError):
+        mon.run_guarded(lambda: 1)
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_transient_retry_succeeds_without_quarantine():
+    mon = _fast_monitor(heal_async=False)
+    inj = FaultInjector(seed=2)
+    inj.inject("device.dispatch", FailTimes(2))
+    with chaos.installed(inj):
+        assert mon.run_guarded(lambda: "ok") == "ok"
+    assert mon.healthy
+    assert mon.counters["transient_retries"] == 2
+    assert mon.counters["quarantines"] == 0
+
+
+def test_exhausted_transient_retries_quarantine():
+    mon = _fast_monitor(heal_async=False)
+    inj = FaultInjector(seed=3)
+    inj.inject("device.dispatch", FailTimes(50))
+    with chaos.installed(inj):
+        with pytest.raises(DeviceQuarantinedError):
+            mon.run_guarded(lambda: "ok")
+    assert mon.quarantined
+
+
+def test_background_healer_heals_on_schedule_heal():
+    """The healer probes under backoff (chaos-aware probe: the wedge
+    schedule IS the device state) and flips the tier back to HEALTHY
+    exactly once after heal()."""
+    mon = _fast_monitor(heal_async=True, first_dispatch_grace_s=0.25)
+    inj = FaultInjector(seed=4)
+    sched = inj.inject("device.dispatch", WedgedDevice(at=1))
+    with chaos.installed(inj):
+        with pytest.raises(DeviceQuarantinedError):
+            mon.run_guarded(lambda: 1)
+        time.sleep(0.15)
+        assert mon.quarantined, "probe must fail while wedged"
+        sched.heal()
+        deadline = time.monotonic() + 5.0
+        while mon.quarantined and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert mon.healthy
+    assert mon.counters["heals"] == 1
+    assert mon.counters["quarantines"] == 1
+
+
+def test_deadline_scales_with_measured_dispatch_cost():
+    from flink_tpu.utils import transport
+    mon = DeviceHealthMonitor(WatchdogConfig(deadline_floor_s=1.0,
+                                             deadline_multiplier=10.0))
+    assert mon.deadline_s(100.0) == 1.0         # unmeasured: floor
+    saved = transport._samples, transport._verdict
+    try:
+        transport.reset()
+        for _ in range(3):
+            transport.record_dispatch_cost(1.0, 0.05)   # 50 ms/MB
+        # 100 MB * 50 ms/MB * 10x = 50 s > floor
+        assert mon.deadline_s(100.0) == pytest.approx(50.0)
+        assert mon.deadline_s(0.001) == 1.0     # tiny upload: floor rules
+    finally:
+        transport._samples, transport._verdict = saved
+
+
+# ---------------------------------------------------------------------------
+# operator-level: degradation, OOM page-out, quarantine->heal digests
+# ---------------------------------------------------------------------------
+
+def _run_operator(op, batches, wedge_at=None, heal_at=None, snap_at=None,
+                  repromote_at=None, seed=1):
+    """Drive an operator through batches + per-batch watermarks under an
+    optional WedgedDevice schedule; returns (digests, mid snapshot)."""
+    inj = FaultInjector(seed=seed)
+    sched = (inj.inject("device.dispatch", WedgedDevice(at=wedge_at))
+             if wedge_at else None)
+    out, snap = [], None
+    with chaos.installed(inj):
+        for i, (k, v, ts) in enumerate(batches):
+            out += op.process_batch(RecordBatch({"k": k, "v": v},
+                                                timestamps=ts))
+            out += op.process_watermark(Watermark(int(ts.max()) - 1))
+            if snap_at is not None and i == snap_at:
+                op.prepare_snapshot_pre_barrier()
+                snap = op.snapshot_state()
+            if heal_at is not None and i == heal_at:
+                sched.heal()
+                assert dh.get_monitor().probe_now()
+            if repromote_at is not None and i == repromote_at:
+                out += op.prepare_snapshot_pre_barrier()
+        out += op.end_input()
+    return _digests(out), snap
+
+
+def test_quarantine_heal_cycle_digest_identical_device_tier():
+    """The acceptance cycle at operator level: wedge mid-stream ->
+    degrade (no dropped records) -> checkpoint DURING quarantine ->
+    heal -> re-promote at the next safe point; digests equal an unfaulted
+    run, and the mid-quarantine checkpoint restores on BOTH tiers."""
+    batches = _batches()
+    _fast_monitor(heal_async=False)
+    clean, _ = _run_operator(_build_op(), batches)
+
+    mon = _fast_monitor(heal_async=False)
+    op = _build_op()
+    wedged, snap = _run_operator(op, batches, wedge_at=8, snap_at=10,
+                                 heal_at=11, repromote_at=14)
+    assert wedged == clean
+    assert snap is not None
+    st = op.device_health_stats()
+    assert st == {"degraded": 0, "quarantine_migrations": 1,
+                  "repromotions": 1}
+    assert mon.counters["quarantines"] == 1 and mon.counters["heals"] == 1
+
+    # suffix digests of the clean run, for the replay comparison
+    op_ref = _build_op()
+    ref_out = []
+    for i, (k, v, ts) in enumerate(batches):
+        els = op_ref.process_batch(RecordBatch({"k": k, "v": v},
+                                               timestamps=ts))
+        els += op_ref.process_watermark(Watermark(int(ts.max()) - 1))
+        if i > 10:
+            ref_out += els
+    ref_out += op_ref.end_input()
+    suffix = _digests(ref_out)
+
+    def replay(snapshot, monitor):
+        dh.set_monitor(monitor)
+        op2 = _build_op()
+        op2.restore_state(snapshot)
+        out = []
+        for i, (k, v, ts) in enumerate(batches):
+            if i <= 10:
+                continue
+            out += op2.process_batch(RecordBatch({"k": k, "v": v},
+                                                 timestamps=ts))
+            out += op2.process_watermark(Watermark(int(ts.max()) - 1))
+        out += op2.end_input()
+        return _digests(out), op2
+
+    # tier A: healthy device tier
+    healthy, op_a = replay(snap, _fast_monitor(heal_async=False))
+    assert not op_a._degraded
+    assert healthy == suffix
+    # tier B: monitor still quarantined -> the first dispatch migrates
+    # and the whole replay runs degraded, same digests
+    qmon = _fast_monitor(heal_async=False)
+    qmon.quarantine("test: still wedged")
+    degraded, op_b = replay(snap, qmon)
+    assert op_b._degraded
+    assert degraded == suffix
+
+
+def test_quarantine_heal_cycle_host_tier():
+    """Host emit tier: the mirror is already authoritative — degrading
+    just stops the replica dispatch; fires stay identical, and the
+    re-promotion refresh restores device/mirror equality."""
+    batches = _batches(seed=9)
+    _fast_monitor(heal_async=False)
+    clean, _ = _run_operator(_build_op(emit_tier="host"), batches)
+
+    mon = _fast_monitor(heal_async=False)
+    op = _build_op(emit_tier="host")
+    wedged, _ = _run_operator(op, batches, wedge_at=6, heal_at=10,
+                              repromote_at=12)
+    assert wedged == clean
+    assert op.device_health_stats()["repromotions"] == 1
+    assert mon.counters["quarantines"] == 1 and mon.counters["heals"] == 1
+    assert op.verify_mirror(), "re-promoted replica must equal the mirror"
+
+
+def test_oom_forces_pageout_and_digests_survive():
+    """A RESOURCE_EXHAUSTED dispatch triggers the DevicePager pressure
+    valve (forced page-out of cold rows), then the retry succeeds — no
+    quarantine, and fire digests equal an un-faulted paged run."""
+    def paged_batches():
+        out = []
+        for i in range(6):
+            # rotating key ranges: batch i touches keys [i*64, i*64+128)
+            k = (np.arange(256) % 128) + (i * 64)
+            v = np.ones(256, np.float32)
+            ts = i * (WINDOW_MS // 2) + np.sort(
+                np.arange(256) % (WINDOW_MS // 2)).astype(np.int64)
+            out.append((k, v, ts))
+        return out
+
+    _fast_monitor(heal_async=False)
+    clean, _ = _run_operator(_build_op(paging_cap=512), paged_batches())
+
+    mon = _fast_monitor(heal_async=False)
+    inj = FaultInjector(seed=7)
+    # OOM at the THIRD dispatch: by then resident rows beyond the current
+    # batch's (protected) working set exist, so the valve has victims
+    inj.inject("device.dispatch",
+               chaos.ActionSequence(
+                   ["ok", "ok",
+                    ("fail", "RESOURCE_EXHAUSTED: out of memory "
+                             "allocating 1.0G")]))
+    op = _build_op(paging_cap=512)
+    out = []
+    with chaos.installed(inj):
+        for k, v, ts in paged_batches():
+            out += op.process_batch(RecordBatch({"k": k, "v": v},
+                                                timestamps=ts))
+            out += op.process_watermark(Watermark(int(ts.max()) - 1))
+        out += op.end_input()
+    assert mon.counters["oom_pageouts"] == 1
+    assert mon.counters["quarantines"] == 0
+    assert op.paging_stats()["evictions"] > 0, "valve never paged out"
+    assert _digests(out) == clean
+
+
+def test_unsupported_tier_fails_task_instead_of_degrading():
+    """No host twin tier (count trigger): the wedge surfaces as an error
+    — the normal restart path owns recovery, not a silent wrong tier."""
+    from flink_tpu.windowing.assigners import GlobalWindows
+    from flink_tpu.windowing.triggers import CountTrigger
+    mon = _fast_monitor(heal_async=False, first_dispatch_grace_s=0.3)
+    op = WindowAggOperator(GlobalWindows(), SumAggregator(jnp.float32),
+                           key_column="k", value_column="v",
+                           trigger=CountTrigger.of(4))
+    op.open(RuntimeContext())
+    inj = FaultInjector(seed=8)
+    inj.inject("device.dispatch", WedgedDevice(at=1))
+    with chaos.installed(inj):
+        with pytest.raises(DeviceQuarantinedError):
+            op.process_batch(RecordBatch(
+                {"k": np.arange(8) % 3,
+                 "v": np.ones(8, np.float32)},
+                timestamps=np.arange(8, dtype=np.int64)))
+    assert mon.quarantined
+
+
+def test_degraded_key_growth_keeps_all_panes_consistent():
+    """Keys that first appear DURING quarantine, touching only some
+    panes: every live mirror pane must still serve fires, snapshots and
+    re-promotion at the new key count (the _grow_keys all-pane invariant
+    carried into degraded mode)."""
+    mon = _fast_monitor(heal_async=False)
+    op = _build_op(initial_key_capacity=16)
+    inj = FaultInjector(seed=12)
+    sched = inj.inject("device.dispatch", WedgedDevice(at=2))
+    out = []
+    with chaos.installed(inj):
+        # batch 1 (healthy): 16 keys into window 0's pane
+        k = np.arange(16)
+        ts = np.zeros(16, np.int64)
+        out += op.process_batch(RecordBatch(
+            {"k": k, "v": np.ones(16, np.float32)}, timestamps=ts))
+        # batch 2 wedges -> degrade (same window-0 pane)
+        out += op.process_batch(RecordBatch(
+            {"k": k, "v": np.ones(16, np.float32)}, timestamps=ts))
+        assert op._degraded
+        # batch 3 (degraded): 200 NEW keys touch ONLY window 1's pane —
+        # window 0's pane entry must still grow with the key count
+        k2 = np.arange(16, 216)
+        ts2 = np.full(200, 1500, np.int64)
+        out += op.process_batch(RecordBatch(
+            {"k": k2, "v": np.ones(200, np.float32)}, timestamps=ts2))
+        # fire both windows + snapshot DURING quarantine at the grown count
+        out += op.process_watermark(Watermark(2100))
+        op.prepare_snapshot_pre_barrier()
+        snap = op.snapshot_state()
+        assert snap["counts"].shape[0] == 216
+        # heal + re-promote at the grown key count
+        sched.heal()
+        assert mon.probe_now()
+        op.prepare_snapshot_pre_barrier()
+        assert not op._degraded
+        out += op.end_input()
+    d = dict((w, (r, s)) for w, r, s in _digests(out))
+    assert d[0] == (16, 32.0)       # both window-0 batches counted
+    assert d[1000] == (200, 200.0)  # degraded-only keys all fired
+
+
+def test_salvage_read_is_deadline_bounded():
+    """A device that cannot serve the migration's state download within
+    the salvage deadline must not hang the task thread: the salvage
+    raises and the caller falls back to checkpoint recovery."""
+    import threading as _th
+    mon = _fast_monitor(heal_async=False)
+    hang = _th.Event()
+    with pytest.raises(DeviceQuarantinedError, match="salvage"):
+        mon.run_salvage(hang.wait, deadline_s=0.2, label="migration")
+    hang.set()  # release the sacrificed lane thread
+
+
+def test_lane_threads_pruned_when_task_threads_die():
+    """Per-task-thread lanes are pruned once their owning thread exits —
+    no thread/memory leak across many short-lived jobs."""
+    import threading as _th
+    mon = _fast_monitor(heal_async=False)
+
+    def _dispatch():
+        mon.run_guarded(lambda: 1)
+
+    for _ in range(5):
+        t = _th.Thread(target=_dispatch)
+        t.start()
+        t.join()
+    mon.run_guarded(lambda: 1)   # lookup prunes the dead threads' lanes
+    assert len(mon._lanes) == 1
+
+
+# ---------------------------------------------------------------------------
+# surface area: job_status, metrics, REST panel
+# ---------------------------------------------------------------------------
+
+def test_job_status_reports_device_health_defaults():
+    from flink_tpu.cluster.minicluster import MiniCluster
+    dh.reset_monitor()
+    status = MiniCluster().job_status()["device_health"]
+    assert status["state"] == "healthy"
+    assert status["quarantines"] == 0 and status["heals"] == 0
+    assert status["degraded_operators"] == 0
+
+
+def test_device_health_metrics_registered():
+    from flink_tpu.cluster.minicluster import MiniCluster
+    from flink_tpu.metrics.groups import (DEVICE_HEALTH_HEALS,
+                                          DEVICE_HEALTH_QUARANTINES,
+                                          DEVICE_HEALTH_STATE)
+    cluster = MiniCluster()
+    names = set(cluster.metrics_registry.all_metrics())
+    for suffix in (DEVICE_HEALTH_STATE, DEVICE_HEALTH_QUARANTINES,
+                   DEVICE_HEALTH_HEALS):
+        assert any(k.endswith(suffix) for k in names), suffix
+    mon = _fast_monitor(heal_async=False)
+    mon.quarantine("test")
+    metrics = cluster.metrics_registry.all_metrics()
+    state = next(m for k, m in metrics.items()
+                 if k.endswith(DEVICE_HEALTH_STATE))
+    assert state.get_value() == 1
+
+
+def test_device_health_html_panel():
+    from flink_tpu.rest.views import device_health_html
+    frag = device_health_html({"state": "quarantined", "quarantines": 1,
+                               "heals": 0, "watchdog_timeouts": 1,
+                               "degraded_operators": 2,
+                               "last_failure": "update_step wedged"})
+    assert 'data-state="quarantined"' in frag
+    assert "dh-quarantined" in frag
+    assert 'data-metric="quarantines"' in frag
+    assert "update_step wedged" in frag
+    healthy = device_health_html({"state": "healthy"})
+    assert 'data-state="healthy"' in healthy and "dh-healthy" in healthy
+
+
+# ---------------------------------------------------------------------------
+# cluster acceptance: wedge mid-stream, degrade, heal at a checkpoint
+# ---------------------------------------------------------------------------
+
+def _run_cluster_job(inject: bool, seed=31):
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+
+    # a generous deadline floor: under cluster load a HEALTHY dispatch can
+    # take hundreds of ms on a shared vCPU — the watchdog must only catch
+    # the injected wedge (which hangs far past any real dispatch)
+    mon = _fast_monitor(heal_async=True, deadline_floor_s=2.0)
+    rng = np.random.default_rng(seed)
+    n = 30_000
+    keys = rng.integers(0, 23, n)
+    vals = np.ones(n, dtype=np.float64)
+    ts = np.sort(rng.integers(0, 4000, n))
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    sink = (env.from_collection(columns={"k": keys, "v": vals, "t": ts},
+                                batch_size=128)
+            .assign_timestamps_and_watermarks(0, timestamp_column="t")
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(1000))
+            .sum("v").collect())
+    inj = FaultInjector(seed=seed)
+    healer = None
+    if inject:
+        sched = inj.inject("device.dispatch", WedgedDevice(at=40))
+
+        def _heal_once_quarantined():
+            deadline = time.monotonic() + 60
+            while not mon.quarantined and time.monotonic() < deadline:
+                time.sleep(0.005)
+            time.sleep(0.1)      # degraded batches + a checkpoint pass
+            # pause the sources so the job cannot finish before the heal
+            # and the checkpoint-aligned re-promotion have happened (the
+            # paused sources keep serving checkpoint barriers)
+            cluster = env._last_cluster
+            for t in cluster._source_tasks:
+                t._paused.set()
+            try:
+                sched.heal()     # background healer probes it healthy
+                while mon.quarantined and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                while (cluster.device_health_status()["repromotions"] < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+            finally:
+                for t in cluster._source_tasks:
+                    t._paused.clear()
+
+        healer = threading.Thread(target=_heal_once_quarantined,
+                                  daemon=True)
+        healer.start()
+    with chaos.installed(inj):
+        res = env.execute_cluster(storage=InMemoryCheckpointStorage(
+            retain=10), checkpoint_interval_ms=20,
+            tolerable_failed_checkpoints=0)
+    if healer is not None:
+        healer.join(timeout=10)
+    status = env._last_cluster.job_status()
+    rows = sorted((int(r["k"]), int(r["window_start"]), float(r["v"]))
+                  for r in sink.rows())
+    return res, rows, status
+
+
+@pytest.mark.slow
+def test_acceptance_wedge_degrade_heal_cluster_exactly_once():
+    """ISSUE-4 acceptance: a windowed job wedges mid-stream, degrades to
+    the host tier without dropping records, heals back to the device tier
+    at a checkpoint boundary; fire digests + exactly-once counters equal
+    an unfaulted run; job_status() records exactly one quarantine and one
+    heal."""
+    from flink_tpu.cluster.task import TaskStates
+
+    res0, rows0, status0 = _run_cluster_job(inject=False)
+    assert res0.state == TaskStates.FINISHED
+    assert status0["device_health"]["quarantines"] == 0
+
+    res1, rows1, status1 = _run_cluster_job(inject=True)
+    assert res1.state == TaskStates.FINISHED
+    assert res1.restarts == 0, "degradation must not cost a restart"
+    assert rows1 == rows0, "fire digests diverged from the unfaulted run"
+    hs = status1["device_health"]
+    assert hs["quarantines"] == 1 and hs["heals"] == 1
+    assert hs["quarantine_migrations"] == 1
+    assert hs["repromotions"] == 1
+    assert hs["state"] == "healthy"
+    assert hs["degraded_operators"] == 0
+    assert status1["checkpoints"]["failed_checkpoints"] == \
+        status0["checkpoints"]["failed_checkpoints"] == 0
+    # records_in per vertex equal (no drops, no replays)
+    recs0 = {v["name"]: v["records_in"] for v in status0["vertices"]}
+    recs1 = {v["name"]: v["records_in"] for v in status1["vertices"]}
+    assert recs0 == recs1

@@ -362,13 +362,70 @@ def test_sasl_scram_sha256(tmp_path):
             KafkaWireClient(b.host, b.port, username="alice",
                             password="wrong",
                             sasl_mechanism="SCRAM-SHA-256").metadata()
-        # unknown user fails round 1
-        with pytest.raises(KafkaError, match="authentication"):
+        # unknown user: the handshake COMPLETES round 1 (decoy salt — no
+        # username enumeration) and fails at the round-2 proof with the
+        # same error a wrong password gets
+        with pytest.raises(KafkaError, match="SCRAM|authentication"):
             KafkaWireClient(b.host, b.port, username="mallory",
                             password="s3cret",
                             sasl_mechanism="SCRAM-SHA-256").metadata()
     finally:
         b.stop()
+
+
+def test_sasl_scram_no_username_enumeration_and_cached_pbkdf2(tmp_path):
+    """SCRAM hardening: (a) unknown users get a DETERMINISTIC decoy salt
+    (same server-first shape as a real user, stable across attempts, user
+    -dependent) and fail only at the proof; (b) the salted password is
+    cached per (user, salt, iterations), so repeated handshakes — the
+    unauthenticated brute-force shape — cost one 4096-iteration PBKDF2
+    total, not one per attempt."""
+    import base64
+
+    from flink_tpu.connectors.kafka import KafkaWireBroker
+    from flink_tpu.security import scram as scram_mod
+    from flink_tpu.security.scram import ScramClient, ScramServer
+
+    b = KafkaWireBroker(directory=str(tmp_path / "k"),
+                        users={"alice": "s3cret"})
+
+    def server_first(user):
+        c = ScramClient(user, "x")
+        srv = ScramServer(iterations=4096)
+        salt, salted = b._scram_credentials(user)
+        return srv.first_response(c.first(), salt=salt, salted=salted)
+
+    def salt_of(msg):
+        return base64.b64decode(dict(p.split("=", 1)
+                                     for p in msg.split(","))["s"])
+
+    # decoy salts: stable per unknown user, distinct across users, same
+    # message shape as a real user's
+    s1, s2 = salt_of(server_first("mallory")), salt_of(server_first("mallory"))
+    assert s1 == s2, "a changing salt would itself leak nonexistence"
+    assert salt_of(server_first("eve")) != s1
+    assert {a.split("=", 1)[0] for a in server_first("mallory").split(",")} \
+        == {a.split("=", 1)[0] for a in server_first("alice").split(",")}
+
+    # PBKDF2 cost: N handshakes for a known user derive the salted
+    # password ONCE (cached per (user, salt, iterations)); the decoy path
+    # derives it ZERO times — unauthenticated attempts are cheap
+    import hashlib as _hl
+    calls = []
+    real = _hl.pbkdf2_hmac
+    try:
+        _hl.pbkdf2_hmac = lambda *a, **kw: (calls.append(1),
+                                            real(*a, **kw))[1]
+        b._scram_cache.clear()
+        b._scram_salts.clear()
+        for _ in range(5):
+            b._scram_credentials("alice")    # one derivation, then cache
+        for _ in range(5):
+            b._scram_credentials("mallory")  # decoy: zero derivations
+    finally:
+        _hl.pbkdf2_hmac = real
+    assert len(calls) == 1
+    assert scram_mod is not None  # shared RFC 5802 math module in use
 
 
 def test_tls_listener_sasl_ssl(tmp_path):

@@ -1,0 +1,98 @@
+"""Pytest marker audit (ISSUE-4 CI satellite).
+
+Two invariants keep the two-tier test scheme honest:
+
+1. Every marker used anywhere under ``tests/`` is DECLARED in
+   ``pyproject.toml`` (or a pytest builtin) — an unknown marker silently
+   selects nothing, so a typo like ``choas`` would quietly drop a test
+   from every ``-m`` expression.
+2. The ``chaos`` suite stays visible to the tier-1 command
+   (``-m 'not slow'``): at least a meaningful share of chaos-marked
+   tests must NOT also be slow-marked, or fault-injection coverage
+   silently migrates out of the gate everyone runs.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+TESTS = Path(__file__).parent
+REPO = TESTS.parent
+
+#: pytest's own marks — always legal without declaration
+BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+                 "filterwarnings", "tryfirst", "trylast"}
+
+
+def _marker_entries():
+    """The declared marker lines from pyproject.toml (`name: description`
+    strings), parsed with tomllib when available (3.11+), regex on 3.10."""
+    text = (REPO / "pyproject.toml").read_text()
+    try:
+        import tomllib
+    except ImportError:          # py310: stdlib tomllib is 3.11+
+        block = re.search(r"markers\s*=\s*\[(.*?)\]", text, re.S).group(1)
+        return [a or b for a, b in
+                re.findall(r"\"([^\"]+)\"|'([^']+)'", block)]
+    return tomllib.loads(text)["tool"]["pytest"]["ini_options"]["markers"]
+
+
+def _declared_markers():
+    return {ln.split(":", 1)[0].strip() for ln in _marker_entries()}
+
+
+def _marks_used():
+    """marker name -> set of files using it, scraped from the suite."""
+    used = {}
+    for path in sorted(TESTS.glob("*.py")):
+        src = path.read_text()
+        for m in re.finditer(r"pytest\.mark\.([A-Za-z_][A-Za-z0-9_]*)", src):
+            used.setdefault(m.group(1), set()).add(path.name)
+    return used
+
+
+def test_every_used_marker_is_declared():
+    declared = _declared_markers()
+    unknown = {name: sorted(files)
+               for name, files in _marks_used().items()
+               if name not in declared and name not in BUILTIN_MARKS}
+    assert not unknown, (
+        f"markers used but not declared in pyproject.toml: {unknown} — "
+        f"declare them under [tool.pytest.ini_options].markers or fix the "
+        f"typo (an unknown marker silently drops tests from -m selections)")
+
+
+def test_chaos_suite_collects_under_tier1():
+    """Every chaos-suite FILE must contribute tests to the tier-1 run:
+    a file whose chaos tests are all slow-marked has silently left the
+    gate.  Verified by real collection, not regex: collect with the
+    tier-1 expression and require chaos tests from each chaos file."""
+    import subprocess
+
+    mark_re = re.compile(r"^pytestmark\s*=.*\bchaos\b|^@pytest\.mark\.chaos",
+                         re.M)
+    chaos_files = sorted(p.name for p in TESTS.glob("*.py")
+                         if mark_re.search(p.read_text()))
+    assert chaos_files, "no chaos-marked files found at all"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "chaos and not slow", "-p", "no:cacheprovider",
+         *[str(TESTS / f) for f in chaos_files]],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    collected = proc.stdout
+    for f in chaos_files:
+        assert f"{f}::" in collected, \
+            (f"{f} contributes no tests to the tier-1 chaos selection "
+             f"(-m 'chaos and not slow') — its whole chaos coverage is "
+             f"slow-gated")
+
+
+def test_marker_declarations_have_descriptions():
+    """Each declared marker carries a description (the `name: text` form)
+    so `pytest --markers` documents the tiers."""
+    entries = _marker_entries()
+    assert entries
+    for entry in entries:
+        assert ":" in entry and entry.split(":", 1)[1].strip(), \
+            f"marker {entry!r} lacks a description"

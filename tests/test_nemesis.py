@@ -10,8 +10,10 @@ partition is a ``FreezableProxy`` (now part of the chaos library,
 ``flink_tpu.testing.chaos``) interposed on the leader's path.
 """
 
+import threading
 import time
 
+import numpy as np
 import pytest
 
 from flink_tpu.cluster.ha import LeaseLeaderElection
@@ -93,6 +95,116 @@ def test_partition_nemesis_lease_expiry_fencing_and_recovery(store):
         a.stop(abdicate=False)
         b.stop()
         proxy.stop()
+
+
+def test_freezable_proxy_directional_freeze(store):
+    """FreezableProxy asymmetry: freezing a->b blackholes client requests
+    (the call stalls) while b->a stays open; healing that one direction
+    restores the link."""
+    import urllib.error
+
+    proxy = FreezableProxy(store.host, store.port)
+    try:
+        c = ObjectStoreClient(proxy.url, timeout_s=0.5)
+        c.put("k", b"v1")
+        assert c.get("k") == b"v1"
+        proxy.freeze("a->b")           # requests vanish; responses would flow
+        with pytest.raises((urllib.error.URLError, TimeoutError, OSError)):
+            c.put("k", b"v2")
+        # the value is untouched (the request never reached the store)
+        direct = ObjectStoreClient(store.url, timeout_s=5)
+        assert direct.get("k") == b"v1"
+        proxy.heal("a->b")
+        c.put("k", b"v3")
+        assert direct.get("k") == b"v3"
+        # the opposite direction alone: requests ARRIVE (the store mutates)
+        # but the response is lost — the classic did-my-write-land ambiguity
+        proxy.freeze("b->a")
+        with pytest.raises((urllib.error.URLError, TimeoutError, OSError)):
+            c.put("k", b"v4")
+        assert direct.get("k") == b"v4"
+        proxy.heal()
+    finally:
+        proxy.stop()
+
+
+def test_asymmetric_partition_liveness_and_exactly_once(store):
+    """ISSUE-4 satellite: an A→B-only partition between the worker side
+    (checkpoint writes) and the coordinator-side store.  While frozen,
+    every store RPC times out — the job must stay LIVE (stores run outside
+    the coordinator lock; failures only charge the budget) and finish
+    EXACTLY-ONCE; after the heal, checkpoints land again."""
+    from flink_tpu.cluster.task import TaskStates
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.runtime.checkpoint.objectstore import \
+        ObjectStoreCheckpointStorage
+
+    proxy = FreezableProxy(store.host, store.port)
+    storage = ObjectStoreCheckpointStorage(
+        proxy.url, prefix="jobs/asym/",
+        client=ObjectStoreClient(proxy.url, timeout_s=0.3))
+    n = 30_000
+    keys = np.arange(n) % 13
+    vals = np.ones(n)
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    sink = (env.from_collection(columns={"k": keys, "v": vals},
+                                batch_size=128)
+            .key_by("k").sum("v").collect())
+
+    # event-driven nemesis: freeze worker->store AFTER a checkpoint landed
+    # cleanly, hold the sources paused until a store visibly failed during
+    # the partition AND a post-heal checkpoint completed — deterministic
+    # regardless of compile/oS timing
+    cycle_done = threading.Event()
+
+    def _nemesis():
+        deadline = time.monotonic() + 60
+        while not hasattr(env, "_last_cluster") and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        cluster = env._last_cluster
+        while not cluster._completed_ids and time.monotonic() < deadline:
+            time.sleep(0.005)
+        for t in cluster._source_tasks:      # job must outlive the cycle
+            t._paused.set()
+        try:
+            proxy.freeze("a->b")             # requests vanish; B->A flows
+            while cluster.failure_manager.num_failed() < 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+            before = len(cluster._completed_ids)
+            proxy.heal("a->b")
+            while len(cluster._completed_ids) <= before and \
+                    time.monotonic() < deadline:
+                time.sleep(0.005)
+        finally:
+            for t in cluster._source_tasks:
+                t._paused.clear()
+        cycle_done.set()
+
+    t = threading.Thread(target=_nemesis, daemon=True)
+    t.start()
+    try:
+        res = env.execute_cluster(storage=storage, checkpoint_interval_ms=5,
+                                  tolerable_failed_checkpoints=-1)
+    finally:
+        t.join(timeout=70)
+    assert cycle_done.is_set()
+    assert res.state == TaskStates.FINISHED, \
+        "one-way partition cost the job its liveness"
+    assert res.restarts == 0
+    got = {int(r["k"]): r["v"] for r in sink.rows()}
+    expect = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        expect[int(k)] = expect.get(int(k), 0.0) + v
+    assert got == expect, "sums not exactly-once under the partition"
+    cluster = env._last_cluster
+    status = cluster.job_status()
+    # the partitioned window charged storage failures but never the job
+    assert status["checkpoints"]["failed_checkpoints"] >= 1
+    # after the heal at least one checkpoint landed durably
+    assert storage.load_latest() is not None or res.completed_checkpoints
 
 
 def test_fenced_put_without_any_grant_rejects_unknown_tokens(store):
