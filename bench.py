@@ -987,6 +987,83 @@ def run_wedge_smoke(window_ms: int = 1000) -> dict:
                                   "watchdog_timeouts")}, **stats}}
 
 
+def run_checkpoint_backpressure(interval_ms: int, budget_ms: float,
+                                min_completed: int = 1,
+                                n_records: int = 40_000) -> dict:
+    """``--checkpoint-interval``: checkpoint duration + persisted in-flight
+    bytes under INJECTED backpressure (ISSUE-5 CI satellite).  A seeded
+    ``SlowConsumer`` schedule stalls one source's channels into the keyed
+    window subtasks (bursty drain stalls — input queues deepen, barriers
+    crawl behind the backlog) while a ``SlowDisk`` schedule stalls the
+    checkpoint store; the job runs with aligned-with-timeout escalation,
+    so checkpoints must keep completing within ``budget_ms`` regardless —
+    the unaligned-checkpoint acceptance in bench form."""
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+    from flink_tpu.testing import chaos
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 101, n_records)
+    vals = np.ones(n_records, np.float64)
+    ts = np.sort(rng.integers(0, 4000, n_records))
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    sink = (env.from_collection(columns={"k": keys, "v": vals, "t": ts},
+                                batch_size=256)
+            .assign_timestamps_and_watermarks(0, timestamp_column="t")
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(1000))
+            .sum("v").collect())
+    inj = chaos.FaultInjector(seed=29)
+    inj.inject("channel.recv",
+               chaos.SlowConsumer(max_s=0.03, min_s=0.015, p=0.3, burst=30,
+                                  channel="[0]->"))
+    inj.inject("checkpoint.store",
+               chaos.SlowDisk(max_s=0.04, min_s=0.01, p=0.5, times=30))
+    storage = InMemoryCheckpointStorage(retain=5)
+    t0 = time.monotonic()
+    with chaos.installed(inj):
+        res = env.execute_cluster(
+            storage=storage, checkpoint_interval_ms=interval_ms,
+            checkpoint_timeout_s=max(2.0, budget_ms / 1000.0),
+            alignment_timeout_ms=100, tolerable_failed_checkpoints=-1,
+            timeout_s=300)
+    wall_ms = (time.monotonic() - t0) * 1000.0
+    status = env._last_cluster.job_status()
+    stats = status["checkpoint_stats"]
+    durations = [s["duration_ms"] for s in stats]
+    persisted = [s["persisted_inflight_bytes"] for s in stats]
+    completed = len(res.completed_checkpoints)
+    unaligned = sum(1 for s in stats if s["unaligned"])
+    rows = sum(float(r["v"]) for r in sink.rows())
+    exactly_once = abs(rows - float(vals.sum())) < 0.5
+    ok = (res.state == "FINISHED" and completed >= min_completed
+          and exactly_once and durations
+          and max(durations) <= budget_ms)
+    return {
+        "metric": "checkpoint duration under injected backpressure",
+        "ok": ok,
+        "state": res.state,
+        "exactly_once": exactly_once,
+        "completed_checkpoints": completed,
+        "unaligned_checkpoints": unaligned,
+        "failed_checkpoints": status["checkpoints"]["failed_checkpoints"],
+        "checkpoint_interval_ms": interval_ms,
+        "budget_ms": budget_ms,
+        "max_duration_ms": max(durations) if durations else None,
+        "mean_duration_ms": (round(sum(durations) / len(durations), 1)
+                             if durations else None),
+        "max_alignment_ms": max((s["alignment_ms"] for s in stats),
+                                default=0.0),
+        "persisted_inflight_bytes_total": int(sum(persisted)),
+        "persisted_inflight_bytes_max": int(max(persisted, default=0)),
+        "overtaken_bytes_total": int(sum(s["overtaken_bytes"]
+                                         for s in stats)),
+        "wall_ms": round(wall_ms, 1),
+    }
+
+
 def check_budget(result: dict, budget: dict) -> list:
     """Compare one bench result against a BENCH_BUDGET.json section; returns
     human-readable violations (empty = pass).  The in-repo regression gate
@@ -1064,6 +1141,15 @@ def main():
                     help="BASELINE.md config: 1=WordCount, 2=1M-key "
                          "tumbling (headline, default), 3=sliding "
                          "multi-field, 4=session+Zipf, 5=SQL TUMBLE/HOP")
+    ap.add_argument("--checkpoint-interval", type=int, metavar="MS",
+                    default=0,
+                    help="standalone checkpoint-under-backpressure run: "
+                         "trigger checkpoints every MS milliseconds on a "
+                         "MiniCluster window job while seeded SlowConsumer"
+                         "/SlowDisk chaos injects backpressure; reports "
+                         "checkpoint duration + persisted in-flight bytes "
+                         "and exits nonzero if a checkpoint misses the "
+                         "checkpoint_backpressure budget")
     ap.add_argument("--inject-wedge", action="store_true",
                     help="standalone recovery smoke: wedge the hot-path "
                          "dispatch with a deterministic chaos schedule and "
@@ -1078,6 +1164,24 @@ def main():
         # independent, and the headline flags stay untouched
         result = run_wedge_smoke()
         print(json.dumps(result))
+        sys.exit(0 if result["ok"] else 1)
+
+    if args.checkpoint_interval:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_BUDGET.json")
+        with open(path) as f:
+            budget = json.load(f).get("checkpoint_backpressure", {})
+        result = run_checkpoint_backpressure(
+            args.checkpoint_interval,
+            budget_ms=budget.get("max_duration_ms", 5000.0),
+            min_completed=budget.get("min_completed", 1))
+        print(json.dumps(result))
+        if not result["ok"]:
+            print(f"# BUDGET VIOLATION: checkpoint under backpressure — "
+                  f"max duration {result['max_duration_ms']} ms vs budget "
+                  f"{result['budget_ms']} ms, state {result['state']}, "
+                  f"{result['completed_checkpoints']} completed",
+                  file=sys.stderr)
         sys.exit(0 if result["ok"] else 1)
 
     if args.config != 2:

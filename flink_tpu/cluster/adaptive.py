@@ -54,7 +54,15 @@ def _split_member(member: Dict[str, Any], max_parallelism: int,
 def rescale_snapshot(snapshot: Dict[str, Any], plan: ExecutionPlan,
                      new_counts: Dict[str, int]) -> Dict[str, Any]:
     """A MiniCluster checkpoint taken at one parallelism -> restorable at
-    another (the StateAssignmentOperation analog)."""
+    another (the StateAssignmentOperation analog).
+
+    Refuses (loudly) snapshots carrying persisted in-flight channel state:
+    an UNALIGNED checkpoint's channel state is keyed by physical channel
+    index and cannot be redistributed — drain-then-rescale (rescale from
+    an aligned savepoint) is the supported procedure."""
+    from flink_tpu.state.redistribute import reject_channel_state
+
+    reject_channel_state(snapshot, "rescale")
     out: Dict[str, Any] = {}
     by_uid = {v.uid: v for v in plan.vertices}
     for uid, entry in snapshot.items():

@@ -25,13 +25,61 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from flink_tpu.core import keygroups
-from flink_tpu.core.batch import RecordBatch, StreamElement
+from flink_tpu.core.batch import (CheckpointBarrier, EndOfInput, RecordBatch,
+                                  StreamElement)
 from flink_tpu.testing import chaos
+
+
+def take_until_barrier_locked(q: deque, announced: deque,
+                              checkpoint_id: int):
+    """Shared barrier-extraction loop (the caller holds the queue's lock):
+    pop the elements queued IN FRONT of checkpoint ``checkpoint_id``'s
+    barrier; consume the barrier itself when present (returning the
+    ELEMENT — its ``is_savepoint`` flag matters) and keep the announced
+    deque in sync.  Stops at any barrier or EndOfInput, never extracting
+    past a channel-terminating event.  One implementation for BOTH channel
+    flavors (``LocalChannel`` and ``net._ReceiveQueue``) so the
+    stop/announce invariants cannot silently diverge."""
+    out = []
+    barrier = None
+    while q:
+        el = q[0]
+        if isinstance(el, CheckpointBarrier):
+            if el.checkpoint_id == checkpoint_id:
+                barrier = q.popleft()
+                if announced:
+                    announced.popleft()
+            break
+        if isinstance(el, EndOfInput):
+            break
+        out.append(q.popleft())
+    return out, barrier
+
+
+def element_bytes(el: StreamElement) -> int:
+    """Approximate wire size of one stream element (RecordBatch column
+    nbytes; control elements a small constant) — the unit the unaligned
+    checkpoint accounting (overtaken / persisted in-flight bytes) and the
+    backpressure gauges report in."""
+    if isinstance(el, RecordBatch):
+        total = 0
+        for name in el.columns:
+            col = el.column(name)
+            nbytes = getattr(col, "nbytes", None)
+            total += int(nbytes) if nbytes is not None else 8 * len(el)
+        return max(total, 16)
+    return 16
 
 
 class LocalChannel:
     """Bounded in-memory channel (one producer subtask → one consumer
-    subtask).  ``capacity`` plays the role of the channel's credit budget."""
+    subtask).  ``capacity`` plays the role of the channel's credit budget.
+
+    Observability: ``backpressured_ns`` accumulates the time producers
+    spend blocked in :meth:`put` waiting for credit (the reference's
+    per-channel ``backPressuredTimeMsPerSecond``), and :meth:`depth` /
+    :meth:`queued_bytes` read the current backlog — both monitoring-grade
+    (one lock acquisition, no barriers)."""
 
     def __init__(self, capacity: int = 32, name: str = ""):
         self.capacity = capacity
@@ -41,6 +89,13 @@ class LocalChannel:
         self._not_full = threading.Condition(self._lock)
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
+        #: producer time spent waiting for credit (backpressured)
+        self.backpressured_ns = 0
+        #: checkpoint ids of barriers currently QUEUED (oldest first) — the
+        #: priority-event announcement of the reference: the consumer's
+        #: barrier handler learns a barrier arrived without draining the
+        #: backlog in front of it
+        self._announced: deque = deque()
 
     def put(self, el: StreamElement, timeout_s: Optional[float] = None) -> bool:
         # fault point: a partitioned link stalls (bytes neither flow nor
@@ -57,12 +112,18 @@ class LocalChannel:
                     return False
                 time.sleep(0.01)
         with self._not_full:
-            while len(self._q) >= self.capacity and not self._closed:
-                if not self._not_full.wait(timeout=timeout_s):
-                    return False
+            if len(self._q) >= self.capacity and not self._closed:
+                t0 = time.monotonic_ns()
+                while len(self._q) >= self.capacity and not self._closed:
+                    if not self._not_full.wait(timeout=timeout_s):
+                        self.backpressured_ns += time.monotonic_ns() - t0
+                        return False
+                self.backpressured_ns += time.monotonic_ns() - t0
             if self._closed:
                 return False
             self._q.append(el)
+            if isinstance(el, CheckpointBarrier):
+                self._announced.append(el.checkpoint_id)
             self._not_empty.notify()
             return True
 
@@ -73,8 +134,47 @@ class LocalChannel:
             if not self._q:
                 return None
             el = self._q.popleft()
+            if isinstance(el, CheckpointBarrier) and self._announced:
+                self._announced.popleft()
             self._not_full.notify()
-            return el
+        # fault point: a SLOW CONSUMER drains this channel with bursty
+        # stalls (chaos.SlowConsumer).  Outside the lock — a stalled
+        # consumer must not also block the producer's put
+        chaos.fire("channel.recv", channel=self.name)
+        return el
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def queued_bytes(self) -> int:
+        with self._lock:
+            return sum(element_bytes(el) for el in self._q)
+
+    def announced_barrier(self) -> Optional[int]:
+        """Oldest checkpoint barrier currently queued (or None): the
+        consumer's barrier handler reads this to react to a barrier ON
+        ARRIVAL instead of after draining the backlog in front of it."""
+        with self._lock:
+            return self._announced[0] if self._announced else None
+
+    def take_until_barrier(self, checkpoint_id: int):
+        """Barrier overtake (unaligned checkpoints): atomically extract the
+        queued elements IN FRONT of checkpoint ``checkpoint_id``'s barrier
+        — the in-flight data the barrier jumps over.  Returns
+        ``(elements, barrier)`` where ``barrier`` is the consumed barrier
+        ELEMENT (its ``is_savepoint`` flag matters to the caller) or None
+        when it was not queued.  Extraction stops at any barrier or
+        EndOfInput; it never reaches past a channel-terminating event.
+        Bypasses :meth:`poll` (and its slow-consumer fault point) by
+        design: persisting in-flight data must not be throttled by the
+        very backpressure it escapes."""
+        with self._not_full:
+            out, barrier = take_until_barrier_locked(
+                self._q, self._announced, checkpoint_id)
+            if out or barrier is not None:
+                self._not_full.notify_all()
+        return out, barrier
 
     def close(self) -> None:
         """Unblock producers/consumers (used on cancel/teardown)."""

@@ -235,6 +235,10 @@ class _WorkerRuntime:
                  local_recovery_dir: Optional[str] = None):
         from flink_tpu.cluster.net import ChannelServer
 
+        #: checkpoint-policy options shipped with deploy (unaligned /
+        #: alignment-timeout escalation / alignment-queue cap)
+        self._ckpt_opts: Dict[str, Any] = {}
+
         #: local recovery (TaskLocalStateStoreImpl.java:54): secondary
         #: worker-local snapshot copies; restore prefers them over the
         #: coordinator-shipped (remote-storage) state
@@ -379,7 +383,8 @@ class _WorkerRuntime:
     def deploy(self, addresses: Dict[int, Tuple[str, int]],
                restore: Optional[Dict[str, Any]],
                only: Optional[set] = None,
-               expected_digest: Optional[str] = None) -> bool:
+               expected_digest: Optional[str] = None,
+               ckpt_opts: Optional[Dict[str, Any]] = None) -> bool:
         """Build and start this worker's subtask slice.  ``only``: restrict
         to these (vertex_uid, subtask_index) — region-scoped recovery
         redeploys just the affected regions' tasks, leaving the rest
@@ -404,6 +409,9 @@ class _WorkerRuntime:
                 self._send(("plan_mismatch", self.index, local,
                             expected_digest))
                 return False
+        if ckpt_opts is not None:
+            self._ckpt_opts = dict(ckpt_opts)
+        opts = self._ckpt_opts
         counts, splits_by_vertex = subtask_counts_of(plan)
         assign = assign_subtasks(plan, counts, self.n_workers)
         me = self.index
@@ -533,7 +541,12 @@ class _WorkerRuntime:
                     t = Subtask(v.uid, i, v.build_operator(),
                                 outputs[v.id][i], ctx, self,
                                 inputs[v.id][i],
-                                input_logical=input_logical[v.id][i])
+                                input_logical=input_logical[v.id][i],
+                                unaligned=opts.get("unaligned", False),
+                                alignment_timeout_ms=opts.get(
+                                    "alignment_timeout_ms"),
+                                alignment_queue_max=opts.get(
+                                    "alignment_queue_max", 8192))
                     to_start.append((t, pick_restore(v.uid, i, sub_snaps)))
         if only is None:
             self.tasks = [t for t, _ in to_start]
@@ -583,6 +596,8 @@ class _WorkerRuntime:
                                  only=set(msg[3]) if len(msg) > 3
                                  and msg[3] is not None else None,
                                  expected_digest=msg[4] if len(msg) > 4
+                                 else None,
+                                 ckpt_opts=msg[5] if len(msg) > 5
                                  else None)
                 if ok and msg[2] and (self.recovery_local
                                       or self.recovery_remote):
@@ -692,10 +707,14 @@ class _WorkerRuntime:
 
 class _Pending:
     def __init__(self, cid: int, expected: set, enumerators=None):
+        from flink_tpu.utils.clock import MonotoneElapsed
+
         self.cid = cid
         self.expected = set(expected)
         self.acks: Dict[Tuple[str, int], Dict[str, Any]] = {}
-        self.started_at = time.monotonic()
+        #: expiry through the injectable clock seam, clamped monotone —
+        #: a ClockSkew backward step never un-expires a checkpoint
+        self.timer = MonotoneElapsed()
         #: enumerator snapshots taken at trigger time (§3.4 coordinator
         #: snapshots precede task triggers)
         self.enumerators = enumerators
@@ -714,12 +733,23 @@ class ProcessCluster:
                  restart_delay_ms: int = 500, worker_recovery: bool = True,
                  local_recovery_dir: Optional[str] = None,
                  tolerable_failed_checkpoints: int = 0,
-                 checkpoint_timeout_s: float = 60.0):
+                 checkpoint_timeout_s: float = 60.0,
+                 unaligned: bool = False,
+                 alignment_timeout_ms: Optional[float] = None,
+                 alignment_queue_max: int = 8192):
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureManager
 
         self.job = job
         self.n_workers = n_workers
+        #: unaligned-checkpoint policy, shipped to every worker with the
+        #: deploy message (workers thread it into their Subtasks)
+        self.ckpt_opts = {"unaligned": unaligned,
+                          "alignment_timeout_ms": alignment_timeout_ms,
+                          "alignment_queue_max": alignment_queue_max}
+        #: per-checkpoint stats incl. alignment/overtaken/persisted
+        #: in-flight accounting aggregated from the subtasks' acks
+        self._checkpoint_stats: List[Dict[str, Any]] = []
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
         #: CheckpointFailureManager policy: storage-failed and timed-out
@@ -953,7 +983,7 @@ class ProcessCluster:
                 threads.append(th)
             for idx in self._conns:
                 self._to_worker(idx, ("deploy", addresses, restore, None,
-                                      self._plan_digest))
+                                      self._plan_digest, self.ckpt_opts))
             if self.checkpoint_interval_ms > 0:
                 # the ticker loops on ITS attempt's event (self._all_done
                 # is replaced between restart attempts/recoveries)
@@ -1016,7 +1046,8 @@ class ProcessCluster:
             return {"state": state, "error": self._failed, "rows": rows,
                     "recoveries": recoveries,
                     "completed_checkpoints": list(self._completed_ids),
-                    "failed_checkpoints": self.failure_manager.num_failed()}
+                    "failed_checkpoints": self.failure_manager.num_failed(),
+                    "checkpoint_stats": list(self._checkpoint_stats)}
         finally:
             self._all_done.set()   # stop this attempt's checkpoint ticker
             srv.close()
@@ -1149,7 +1180,7 @@ class ProcessCluster:
         self._recovering = False
         for idx in self._conns:
             self._to_worker(idx, ("deploy", addresses, restore, None,
-                                  self._plan_digest))
+                                  self._plan_digest, self.ckpt_opts))
 
     def _recover_regions(self, plan, procs, dead, affected: set, addresses,
                          srv, server_ctx, need_token: bool, cport: int,
@@ -1200,7 +1231,7 @@ class ProcessCluster:
         only = sorted(affected)
         for idx in sorted(touched_workers):
             self._to_worker(idx, ("deploy", addresses, restore, only,
-                                  self._plan_digest))
+                                  self._plan_digest, self.ckpt_opts))
 
     def _register_workers(self, srv, server_ctx, need_token: bool,
                           addresses: Dict[int, Tuple[str, int]],
@@ -1385,7 +1416,7 @@ class ProcessCluster:
 
         with self._lock:
             if self._pending is not None and (
-                    time.monotonic() - self._pending.started_at
+                    self._pending.timer.seconds()
                     >= self.checkpoint_timeout_s):
                 # expired: abort + charge the budget (a dead worker's acks
                 # will never arrive; failure detection handles the worker)
@@ -1468,6 +1499,14 @@ class ProcessCluster:
                 return
         self.failure_manager.on_checkpoint_success(p.cid)
         self._completed_ids.append(p.cid)
+        # aggregate the subtasks' channel-state (v1) alignment accounting
+        # (one shared reader of the schema: task.aggregate_channel_state)
+        from flink_tpu.cluster.task import aggregate_channel_state
+        self._checkpoint_stats.append({
+            "id": p.cid, "duration_ms": round(p.timer.ms(), 1),
+            "acked_subtasks": len(p.acks),
+            **aggregate_channel_state(p.acks.values())})
+        del self._checkpoint_stats[:-100]
         for idx in self._conns:
             self._to_worker(idx, ("notify", p.cid))
 

@@ -34,13 +34,16 @@ from flink_tpu.cluster.task import (SourceSubtask, Subtask, SubtaskBase,
                                     TaskListener, TaskStates)
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.graph.stream_graph import ExecutionPlan, PlanVertex
+from flink_tpu.utils import clock
 
 
 @dataclass
 class _PendingCheckpoint:
     checkpoint_id: int
     expected: int
-    started_at: float
+    #: monotone elapsed timer (injectable clock seam): expiry decisions
+    #: never regress under a chaos ClockSkew backward step
+    timer: "clock.MonotoneElapsed"
     acks: Dict[Tuple[str, int], Dict[str, Any]] = field(default_factory=dict)
     #: OperatorCoordinator snapshots taken at TRIGGER time (the reference
     #: snapshots SourceCoordinator state before triggering tasks, §3.4)
@@ -102,13 +105,36 @@ class MiniCluster(TaskListener):
                  unaligned: bool = False, checkpoint_timeout_s: float = 60.0,
                  restart_attempts: int = 0, restart_delay_ms: int = 50,
                  channel_capacity: int = 32, restart_strategy=None,
-                 config=None, tolerable_failed_checkpoints: int = 0):
+                 config=None, tolerable_failed_checkpoints: int = 0,
+                 alignment_timeout_ms: Optional[float] = None,
+                 alignment_queue_max: Optional[int] = None):
         from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
                                                 NoRestartStrategy)
+        from flink_tpu.config.options import CheckpointingOptions
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureManager
 
         self.config = config
+        # unaligned-checkpoint policy: explicit args win, then config keys,
+        # then the option defaults (aligned, 8192-element queue cap)
+        if config is not None:
+            if not unaligned:
+                unaligned = bool(config.get(CheckpointingOptions.UNALIGNED))
+            if alignment_timeout_ms is None:
+                alignment_timeout_ms = config.get(
+                    CheckpointingOptions.ALIGNMENT_TIMEOUT)
+        if alignment_queue_max is None:
+            alignment_queue_max = (
+                config.get(CheckpointingOptions.ALIGNMENT_QUEUE_MAX)
+                if config is not None
+                else CheckpointingOptions.ALIGNMENT_QUEUE_MAX.default)
+        self.alignment_timeout_ms = alignment_timeout_ms
+        self.alignment_queue_max = alignment_queue_max
+        #: last completed checkpoint's alignment accounting (job_status()
+        #: ["checkpoints"] + the lastCheckpoint* gauges)
+        self._last_alignment: Dict[str, Any] = {
+            "last_alignment_duration_ms": 0.0, "last_overtaken_bytes": 0,
+            "last_persisted_inflight_bytes": 0, "unaligned_checkpoints": 0}
         #: execution.checkpointing.tolerable-failed-checkpoints analog:
         #: declined/timed-out/storage-failed checkpoints beyond this many
         #: CONSECUTIVE failures trigger job failover (-1 = unlimited)
@@ -151,6 +177,8 @@ class MiniCluster(TaskListener):
         #: numRestarts (CheckpointStatsTracker analogs) on a jobmanager
         #: root, so reporters attached to ``metrics_registry`` export them
         from flink_tpu.metrics.groups import (MetricRegistry,
+                                              backpressure_metrics,
+                                              checkpoint_alignment_metrics,
                                               device_health_metrics,
                                               job_checkpoint_metrics)
         self.metrics_registry = MetricRegistry()
@@ -161,6 +189,10 @@ class MiniCluster(TaskListener):
         #: process-wide monitor's state + this job's degraded operators
         device_health_metrics(self.job_metric_group,
                               self.device_health_status)
+        #: channel backpressure + unaligned-checkpoint alignment gauges
+        backpressure_metrics(self.job_metric_group, self.backpressure_totals)
+        checkpoint_alignment_metrics(self.job_metric_group,
+                                     lambda: self._last_alignment)
 
     # ------------------------------------------------------------ listener
     def _slot_memory(self):
@@ -295,12 +327,25 @@ class MiniCluster(TaskListener):
         self.failure_manager.on_checkpoint_success(p.checkpoint_id)
         self._completed_ids.append(p.checkpoint_id)
         self._latest_snapshot = assembled
+        # aggregate the subtasks' channel-state (v1) alignment accounting
+        # (one shared reader of the schema: task.aggregate_channel_state)
+        from flink_tpu.cluster.task import aggregate_channel_state
+        agg = aggregate_channel_state(p.acks.values())
+        self._last_alignment = {
+            "last_alignment_duration_ms": agg["alignment_ms"],
+            "last_overtaken_bytes": agg["overtaken_bytes"],
+            "last_persisted_inflight_bytes":
+                agg["persisted_inflight_bytes"],
+            "unaligned_checkpoints":
+                self._last_alignment.get("unaligned_checkpoints", 0)
+                + int(agg["unaligned"])}
         self._checkpoint_stats.append({
             "id": p.checkpoint_id,
             "completed_at_ms": int(time.time() * 1000),
-            "duration_ms": round((time.monotonic() - p.started_at) * 1000, 1),
+            "duration_ms": round(p.timer.ms(), 1),
             "state_size_bytes": _state_size(assembled),
-            "acked_subtasks": len(p.acks)})
+            "acked_subtasks": len(p.acks),
+            **agg})
         del self._checkpoint_stats[:-100]           # bounded history
         for t in self._tasks:
             t.commands.put(("notify_complete", p.checkpoint_id))
@@ -451,7 +496,9 @@ class MiniCluster(TaskListener):
                     t = Subtask(uid, i, v.build_operator(), outputs[v.id][i],
                                 ctx, self, inputs[v.id][i],
                                 unaligned=self.unaligned,
-                                input_logical=input_logical[v.id][i])
+                                input_logical=input_logical[v.id][i],
+                                alignment_timeout_ms=self.alignment_timeout_ms,
+                                alignment_queue_max=self.alignment_queue_max)
                     t.start(sub_snaps[i] if i < len(sub_snaps) else None)
                     self._tasks.append(t)
         self._source_tasks = source_tasks
@@ -506,22 +553,48 @@ class MiniCluster(TaskListener):
                     total[k] = total.get(k, 0) + v
         return total
 
+    def backpressure_totals(self) -> Dict[str, Any]:
+        """Aggregated channel backpressure view (the ``backpressure.*``
+        gauges): total producer credit-wait time, deepest input queue, and
+        elements currently buffered by barrier alignment.  Monitoring-grade
+        — reads channel counters only, no operator state."""
+        total_ms = 0.0
+        max_depth = 0
+        queued = 0
+        for t in getattr(self, "_tasks", []):
+            chan_fn = getattr(t, "channel_stats", None)
+            if chan_fn is None:
+                continue
+            for c in chan_fn():
+                total_ms += c["backpressured_ms"]
+                max_depth = max(max_depth, c["depth"])
+            queued += t.alignment_queued
+        return {"total_backpressured_ms": round(total_ms, 3),
+                "max_queue_depth": max_depth,
+                "alignment_queued_elements": queued}
+
     # ------------------------------------------------------------ triggers
     def trigger_checkpoint(self) -> Optional[int]:
         cid, _reason = self._trigger_checkpoint()
         return cid
 
-    def _trigger_checkpoint(self) -> Tuple[Optional[int], str]:
+    def _trigger_checkpoint(self, savepoint: bool = False
+                            ) -> Tuple[Optional[int], str]:
         """Start one checkpoint: inject barriers at all sources (RPC analog,
         ``CheckpointCoordinator.triggerCheckpoint:502``).  Returns
         ``(id, "ok")``, ``(None, "busy")`` while one is in flight, or
-        ``(None, "declined")`` when checkpointing is no longer possible."""
+        ``(None, "declined")`` when checkpointing is no longer possible.
+        ``savepoint=True`` marks the barriers so subtasks keep the
+        snapshot ALIGNED even under escalation (rescalable by contract)."""
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureReason
 
         with self._lock:
             if self._pending is not None:
-                if (time.monotonic() - self._pending.started_at
+                # expiry reads the injectable clock seam through a MONOTONE
+                # elapsed tracker: a ClockSkew backward step can neither
+                # un-expire a checkpoint nor extend its deadline
+                if (self._pending.timer.seconds()
                         < self.checkpoint_timeout_s):
                     return None, "busy"   # previous still in flight
                 expired = self._pending
@@ -544,12 +617,12 @@ class MiniCluster(TaskListener):
             cid = self._next_checkpoint_id
             self._next_checkpoint_id += 1
             self._pending = _PendingCheckpoint(
-                cid, expected=expected, started_at=time.monotonic())
+                cid, expected=expected, timer=clock.MonotoneElapsed())
             coord = getattr(self, "_source_coordinator", None)
             if coord is not None and coord._enums:
                 self._pending.enumerators = coord.snapshot()
         for t in self._source_tasks:
-            t.commands.put(("checkpoint", cid))
+            t.commands.put(("checkpoint", cid, savepoint))
         return cid, "ok"
 
     # ------------------------------------------------------------ execute
@@ -566,7 +639,8 @@ class MiniCluster(TaskListener):
         # reference): a fresh strategy instance each run
         self._active_strategy = _copy.deepcopy(self.restart_strategy)
         self._deploy(plan, restore)
-        last_trigger = time.monotonic()
+        # trigger cadence through the clock seam, monotone under skew
+        trigger_timer = clock.MonotoneElapsed()
         while True:
             time.sleep(0.002)
             if time.monotonic() - t0 > timeout_s:
@@ -603,10 +677,9 @@ class MiniCluster(TaskListener):
                                  (time.monotonic() - t0) * 1000, restarts,
                                  self._completed_ids)
             if (self.checkpoint_interval_ms and
-                    (time.monotonic() - last_trigger) * 1000
-                    >= self.checkpoint_interval_ms):
+                    trigger_timer.ms() >= self.checkpoint_interval_ms):
                 if self.trigger_checkpoint() is not None:
-                    last_trigger = time.monotonic()
+                    trigger_timer = clock.MonotoneElapsed()
 
     def _restart_failed_region(self, plan: ExecutionPlan,
                                failed_uid: str) -> None:
@@ -715,12 +788,20 @@ class MiniCluster(TaskListener):
             subtasks = []
             for t in sorted(ts, key=lambda t: t.subtask_index):
                 b, i, bp = ratios(t)
-                subtasks.append({
+                entry = {
                     "index": t.subtask_index, "state": t.state,
                     "records_in": t.records_in,
                     "records_out": t.records_out,
                     "busy_ratio": b, "idle_ratio": i,
-                    "backpressure_ratio": bp})
+                    "backpressure_ratio": bp}
+                # channel-consuming subtasks: per-channel queue depth /
+                # backpressured time + the alignment-queue gauge
+                chan_fn = getattr(t, "channel_stats", None)
+                if chan_fn is not None:
+                    entry["channels"] = chan_fn()
+                    entry["alignment_queued"] = t.alignment_queued
+                    entry["alignment_queue_peak"] = t.alignment_queue_peak
+                subtasks.append(entry)
             vertices.append({
                 "id": uid,
                 "name": names.get(uid, str(uid)),
@@ -752,6 +833,9 @@ class MiniCluster(TaskListener):
         # lifetime count — name it distinctly so consumers can't mix them up
         checkpoints["num_completed_checkpoints"] = self.failure_manager \
             .num_completed()
+        # unaligned-checkpoint accounting of the LAST completed checkpoint
+        # (alignment critical path, overtaken + persisted in-flight bytes)
+        checkpoints.update(self._last_alignment)
         paging = self.paging_totals()
         return {
             **({"paging": paging} if paging is not None else {}),
@@ -780,11 +864,13 @@ class MiniCluster(TaskListener):
 
     def savepoint(self) -> Optional[int]:
         """User-triggered checkpoint (savepoint analog): returns its id once
-        completed, or None if it could not complete."""
+        completed, or None if it could not complete.  Savepoint barriers
+        never escalate to unaligned — the snapshot stays rescalable and
+        rewritable (the drain-then-rescale contract depends on this)."""
         cid = None
         deadline0 = time.monotonic() + self.checkpoint_timeout_s
         while cid is None and time.monotonic() < deadline0:
-            cid, reason = self._trigger_checkpoint()
+            cid, reason = self._trigger_checkpoint(savepoint=True)
             if cid is None:
                 if reason == "declined":
                     return None    # permanently impossible (sources done)

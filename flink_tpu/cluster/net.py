@@ -140,37 +140,86 @@ class _ReceiveQueue:
     """Server-side channel queue; polling returns credits to the sender
     (``RemoteInputChannel.notifyCreditAvailable`` direction)."""
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, name: str = ""):
         self.capacity = capacity
+        self.name = name
         self._q: deque = deque()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._conn: Optional[socket.socket] = None
         self._closed = False
+        #: remote channels measure producer credit-waits sender-side; the
+        #: consumer-side gauge stays 0 here (shape parity w/ LocalChannel)
+        self.backpressured_ns = 0
+        #: queued-barrier announcement (LocalChannel contract)
+        self._announced: deque = deque()
 
     def _attach(self, conn: socket.socket) -> None:
         with self._lock:
             self._conn = conn
 
     def _push(self, el: StreamElement) -> None:
+        from flink_tpu.core.batch import CheckpointBarrier
         with self._not_empty:
             self._q.append(el)
+            if isinstance(el, CheckpointBarrier):
+                self._announced.append(el.checkpoint_id)
             self._not_empty.notify()
 
+    def announced_barrier(self) -> Optional[int]:
+        with self._lock:
+            return self._announced[0] if self._announced else None
+
     def poll(self, timeout_s: float = 0.0) -> Optional[StreamElement]:
+        from flink_tpu.core.batch import CheckpointBarrier
         with self._not_empty:
             if not self._q and timeout_s > 0:
                 self._not_empty.wait(timeout=timeout_s)
             if not self._q:
                 return None
             el = self._q.popleft()
+            if isinstance(el, CheckpointBarrier) and self._announced:
+                self._announced.popleft()
             conn = self._conn
         if conn is not None:
             try:
                 _send_frame(conn, _CREDIT, struct.pack("<I", 1))
             except OSError:
                 pass
+        # slow-consumer drain stall (chaos.SlowConsumer) — after the credit
+        # returns so the stall models the CONSUMER, not the link
+        from flink_tpu.testing import chaos
+        chaos.fire("channel.recv", channel=self.name)
         return el
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def queued_bytes(self) -> int:
+        from flink_tpu.cluster.channels import element_bytes
+        with self._lock:
+            return sum(element_bytes(el) for el in self._q)
+
+    def take_until_barrier(self, checkpoint_id: int):
+        """Barrier overtake on a remote input channel: extract the queued
+        elements in front of checkpoint ``checkpoint_id``'s barrier (the
+        SHARED extraction loop of ``channels.take_until_barrier_locked`` —
+        returns the consumed barrier element or None).  Credits for every
+        consumed element (barrier included) still flow back to the
+        sender."""
+        from flink_tpu.cluster.channels import take_until_barrier_locked
+        with self._not_empty:
+            out, barrier = take_until_barrier_locked(
+                self._q, self._announced, checkpoint_id)
+            conn = self._conn
+        credits = len(out) + (1 if barrier is not None else 0)
+        if conn is not None and credits:
+            try:
+                _send_frame(conn, _CREDIT, struct.pack("<I", credits))
+            except OSError:
+                pass
+        return out, barrier
 
     def close(self) -> None:
         with self._not_empty:
@@ -213,7 +262,7 @@ class ChannelServer:
             q = self._queues.get(channel_id)
             if q is None:
                 q = self._queues[channel_id] = _ReceiveQueue(
-                    self.channel_capacity)
+                    self.channel_capacity, name=channel_id)
             return q
 
     def _accept_loop(self) -> None:

@@ -25,6 +25,7 @@ import queue
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -34,9 +35,22 @@ from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
                                   StreamElement, StreamStatus, TaggedBatch,
                                   Watermark)
 from flink_tpu.core.functions import RuntimeContext
-from flink_tpu.cluster.channels import LocalChannel, OutputDispatcher
+from flink_tpu.cluster.channels import (LocalChannel, OutputDispatcher,
+                                        element_bytes)
 from flink_tpu.runtime.executor import WatermarkValve
 from flink_tpu.testing import chaos
+from flink_tpu.utils.clock import MonotoneElapsed
+
+
+class AlignmentBufferOverflowError(RuntimeError):
+    """The blocked-channel alignment queue hit its configured cap
+    (``execution.checkpointing.alignment-queue-max-elements``) while
+    alignment-timeout escalation is DISABLED: the subtask cannot keep
+    buffering barrier-blocked data without growing memory without bound,
+    and it cannot escalate to an unaligned checkpoint either.  A loud,
+    classified failure beats silent unbounded growth; enable
+    ``alignment_timeout_ms`` (or raise the cap) to let the barrier
+    overtake instead."""
 
 
 class TaskStates:
@@ -331,6 +345,10 @@ class SourceSubtask(SubtaskBase):
                 return
             if cmd[0] == "checkpoint":
                 cid = cmd[1]
+                # savepoint barriers stay ALIGNED end-to-end (no barrier
+                # overtake, no channel state): the snapshot must remain
+                # rescalable/rewritable (drain-then-rescale contract)
+                sp = bool(cmd[2]) if len(cmd) > 2 else False
                 from flink_tpu.operators.base import snapshot_scope
                 try:
                     chaos.fire("subtask.snapshot", task=self.vertex_uid,
@@ -349,7 +367,8 @@ class SourceSubtask(SubtaskBase):
                     # snapshot failure DECLINES the checkpoint instead of
                     # killing the task (CheckpointException -> decline);
                     # the barrier still flows so downstream alignment ends
-                    self._emit([CheckpointBarrier(cid, timestamp=0)])
+                    self._emit([CheckpointBarrier(cid, timestamp=0,
+                                                  is_savepoint=sp)])
                     self.listener.decline_checkpoint(
                         cid, self.vertex_uid, self.subtask_index,
                         f"{type(e).__name__}: {e}")
@@ -361,7 +380,8 @@ class SourceSubtask(SubtaskBase):
                     snap["current_split"] = self._current_split
                     snap["finished_splits"] = list(self._finished_splits)
                     self._finished_in_ckpt[cid] = self._finished_total
-                barrier = CheckpointBarrier(cid, timestamp=0)
+                barrier = CheckpointBarrier(cid, timestamp=0,
+                                            is_savepoint=sp)
                 self._emit([barrier])
                 self.listener.acknowledge_checkpoint(
                     cid, self.vertex_uid, self.subtask_index, snap)
@@ -390,63 +410,144 @@ class SourceSubtask(SubtaskBase):
 
 
 class Subtask(SubtaskBase):
-    """Channel-consuming subtask with aligned OR unaligned barriers.
+    """Channel-consuming subtask with aligned, unaligned, or
+    aligned-with-timeout barrier handling.
 
-    Aligned (default): a channel that delivered barrier N stops being polled
-    until every channel delivered N; snapshot at full alignment
-    (``SingleCheckpointBarrierHandler`` semantics).
+    Aligned (default): a channel that delivered barrier N stops being
+    processed until every channel delivered N
+    (``SingleCheckpointBarrierHandler`` semantics) — its post-barrier
+    elements buffer in a bounded per-subtask alignment queue
+    (``alignment_queue_max`` elements; overflow raises the classified
+    :class:`AlignmentBufferOverflowError` when escalation is disabled).
 
-    Unaligned (``unaligned=True``): the barrier overtakes — on FIRST arrival
-    the operator snapshots and the barrier is forwarded immediately; elements
-    still arriving on not-yet-barriered channels keep being processed but are
-    ALSO recorded as **channel state** in the snapshot; the ack happens once
-    every channel delivered the barrier (``ChannelStateWriterImpl`` analog).
-    On restore the recorded elements are re-processed first.
+    Unaligned (``unaligned=True`` / ``alignment_timeout_ms=0``): the
+    barrier OVERTAKES — on first arrival the operator snapshots and the
+    barrier is forwarded immediately; the in-flight elements queued in (or
+    still arriving on) not-yet-barriered channels are recorded as
+    **channel state** in the snapshot while also being processed; the ack
+    happens once every channel delivered the barrier
+    (``ChannelStateWriterImpl`` analog).  On restore the recorded elements
+    are replayed into the operator BEFORE any new input.
+
+    Aligned-with-timeout (``alignment_timeout_ms > 0``, FLIP-76's
+    ``execution.checkpointing.alignment-timeout``): start aligned; once
+    alignment exceeds the timeout (measured through the injectable clock
+    seam, monotone under ClockSkew) the handler ESCALATES to the unaligned
+    path — checkpoint duration stops depending on backpressure.
     """
 
     def __init__(self, vertex_uid: str, subtask_index: int, operator,
                  outputs, ctx, listener,
                  input_channels: Sequence[LocalChannel],
                  unaligned: bool = False,
-                 input_logical: Optional[Sequence[int]] = None):
+                 input_logical: Optional[Sequence[int]] = None,
+                 alignment_timeout_ms: Optional[float] = None,
+                 alignment_queue_max: int = 8192):
         super().__init__(vertex_uid, subtask_index, operator, outputs, ctx,
                          listener)
         self.inputs = list(input_channels)
         self.unaligned = unaligned
+        #: None = stay aligned forever; 0 = overtake at first arrival
+        #: (pure unaligned); >0 = aligned-with-timeout escalation
+        self.alignment_timeout_ms = (
+            0.0 if unaligned and alignment_timeout_ms is None
+            else alignment_timeout_ms)
+        self.alignment_queue_max = max(1, int(alignment_queue_max))
         #: physical channel index -> logical input port (two-input operators)
         self.input_logical = (list(input_logical) if input_logical is not None
                               else [0] * len(self.inputs))
+        # ---- barrier-handler state (initialized here so job_status() can
+        # read the gauges before/while the task thread runs) ----
+        self._ended = [False] * len(self.inputs)
+        self._barriered: Dict[int, int] = {}   # channel idx -> barrier id
+        self._pending_barrier: Optional[CheckpointBarrier] = None
+        self._pending_snapshot: Optional[Dict[str, Any]] = None
+        self._snapshot_error: Optional[str] = None
+        self._overtaken = False                # barrier already overtook
+        self._channel_state: List[tuple] = []  # [(input_idx, element), ...]
+        self._cs_bytes = 0                     # persisted in-flight bytes
+        self._overtaken_bytes = 0
+        self._align_queue: List[deque] = [deque()
+                                          for _ in range(len(self.inputs))]
+        self._align_queued = 0                 # elements across channels
+        self._align_timer: Optional[MonotoneElapsed] = None
+        #: announcement timer: a barrier QUEUED behind a backlog starts the
+        #: clock before the consumer ever drains to it (Flink's priority
+        #: barrier announcement); inherited by the alignment timer
+        self._announce_timer: Optional[MonotoneElapsed] = None
+        self._force_escalate = False
+        #: highest barrier id this subtask ever started aligning on: a
+        #: LOWER-id barrier finally draining out of a backlog is STALE
+        #: (its checkpoint was superseded/expired) and must be dropped,
+        #: never allowed to abort a healthy newer alignment
+        self._max_barrier_cid = 0
+        #: queue-depth gauge peaks: lifetime (the job_status gauge) and
+        #: per-alignment (reset at each first barrier — what
+        #: last_checkpoint_stats reports, so one historical deep backlog
+        #: is never misattributed to later checkpoints)
+        self.alignment_queue_peak = 0
+        self._align_peak_ckpt = 0
+        self.last_checkpoint_stats: Dict[str, Any] = {}
+
+    # ------------------------------------------------------ observability
+    @property
+    def alignment_queued(self) -> int:
+        return self._align_queued
+
+    def channel_stats(self) -> List[Dict[str, Any]]:
+        """Per-input-channel backpressure view (monitoring-grade): queue
+        depth + bytes and the producer's accumulated credit-wait time."""
+        out = []
+        for i, ch in enumerate(self.inputs):
+            depth_fn = getattr(ch, "depth", None)
+            bytes_fn = getattr(ch, "queued_bytes", None)
+            out.append({
+                "name": getattr(ch, "name", f"in{i}"),
+                "depth": int(depth_fn() if depth_fn else len(ch)),
+                "queued_bytes": int(bytes_fn()) if bytes_fn else 0,
+                "backpressured_ms": round(
+                    getattr(ch, "backpressured_ns", 0) / 1e6, 3)})
+        return out
+
+    # ------------------------------------------------------------ driving
+    def _is_blocked(self, i: int) -> bool:
+        """Aligned-phase block: the channel delivered the pending barrier
+        and the barrier has not (yet) overtaken."""
+        return (self._pending_barrier is not None and not self._overtaken
+                and i in self._barriered)
 
     def _invoke(self) -> None:
         n = len(self.inputs)
         self._valve = WatermarkValve(n)
-        self._ended = [False] * n
-        self._blocked: Dict[int, int] = {}  # channel idx -> blocking barrier id
-        self._pending_barrier: Optional[CheckpointBarrier] = None
-        self._pending_snapshot: Optional[Dict[str, Any]] = None
-        self._channel_state: List[tuple] = []   # [(input_idx, element), ...]
         # restore the valve FIRST: channel-state replay may carry watermarks
         # (upstream will not resend them), which must advance past the
         # snapshot-time valve, not be clobbered by it
         restored_valve = (self._restore or {}).get("valve")
         if restored_valve is not None:
             self._valve.restore(restored_valve)
-        # unaligned restore: re-process recorded in-flight elements
-        for i, el in (self._restore or {}).get("channel_state", []):
+        # unaligned restore: replay persisted in-flight channel state into
+        # the operator BEFORE any new input (versioned v1 section; legacy
+        # bare lists still restore)
+        for i, el in self._restored_channel_state():
             self._handle_data(i, el)
         while not all(self._ended):
             self._check_cancel()
             self._drain_commands()
             self._tick_processing_time()
+            self._maybe_escalate()
+            self._check_announcements()
             progressed = False
             for i, ch in enumerate(self.inputs):
-                if self._ended[i] or i in self._blocked:
+                if self._ended[i]:
                     continue
                 el = ch.poll(timeout_s=0.0)
                 if el is None:
                     continue
                 progressed = True
-                self._handle(i, el)
+                if self._is_blocked(i):
+                    self._enqueue_aligned(i, el)
+                else:
+                    self._handle(i, el)
             if not progressed:
                 # input momentarily empty: the driver decides this is a
                 # pipeline flush point — complete the operator's in-flight
@@ -459,7 +560,7 @@ class Subtask(SubtaskBase):
                 # nothing readable: brief blocking poll on one open channel
                 t0 = time.monotonic_ns()
                 for i, ch in enumerate(self.inputs):
-                    if not self._ended[i] and i not in self._blocked:
+                    if not self._ended[i] and not self._is_blocked(i):
                         el = ch.poll(timeout_s=0.01)
                         if el is not None:
                             self.idle_ns += time.monotonic_ns() - t0
@@ -470,46 +571,333 @@ class Subtask(SubtaskBase):
         self._emit(self.operator.end_input())
         self._emit([EndOfInput()])
 
+    def _restored_channel_state(self) -> List[tuple]:
+        cs = (self._restore or {}).get("channel_state")
+        if not cs:
+            return []
+        if isinstance(cs, dict):
+            version = cs.get("version")
+            if version != 1:
+                raise ValueError(
+                    f"unknown channel-state snapshot version {version!r} "
+                    f"(this runtime reads v1) — the checkpoint was written "
+                    f"by an incompatible runtime")
+            return list(cs.get("elements", []))
+        return list(cs)   # legacy: bare [(i, el), ...] list
+
     def _handle(self, i: int, el: StreamElement) -> None:
         """Single dispatch point for every input element (the mailbox default
         action), including barrier bookkeeping."""
         if isinstance(el, CheckpointBarrier):
+            pending = self._pending_barrier
+            cid = el.checkpoint_id
+            if cid < self._max_barrier_cid:
+                # STALE: this barrier's checkpoint was already superseded
+                # (it expired while the barrier sat behind a backlog).
+                # Its alignment can never complete — every other channel
+                # consumed it long ago — so dropping it is the only move
+                # that does not abort a HEALTHY newer alignment and
+                # cascade spurious declines downstream
+                return
+            if pending is not None and cid > pending.checkpoint_id:
+                # the coordinator gave up on the pending checkpoint (it
+                # expired) and triggered a NEWER one: abandon the stale
+                # alignment — its recorded channel state belongs to the
+                # aborted checkpoint and must not leak into this one
+                self._abort_alignment(f"superseded by checkpoint {cid}")
             first = self._pending_barrier is None
-            self._blocked[i] = el.checkpoint_id
-            self._pending_barrier = el
-            if self.unaligned and first:
-                # barrier overtakes: snapshot NOW, forward NOW
-                from flink_tpu.operators.base import snapshot_scope
-                try:
-                    chaos.fire("subtask.snapshot", task=self.vertex_uid,
-                               subtask=self.subtask_index,
-                               checkpoint=el.checkpoint_id)
-                    prep = getattr(self.operator,
-                                   "prepare_snapshot_pre_barrier", None)
-                    if prep is not None:
-                        self._emit(prep())
-                    with snapshot_scope(el.checkpoint_id):
-                        self._pending_snapshot = {
-                            "operator": self.operator.snapshot_state(),
-                            "valve": self._valve.snapshot()}
-                except _Cancel:
-                    raise
-                except Exception as e:  # noqa: BLE001
-                    # decline at alignment completion (barrier still flows)
-                    self._pending_snapshot = None
-                    self._snapshot_error = f"{type(e).__name__}: {e}"
-                self._emit([el])
+            if first:
+                self._pending_barrier = el
+                self._max_barrier_cid = max(self._max_barrier_cid, cid)
+                self._overtaken = False
+                self._pending_snapshot = None
+                self._snapshot_error = None
+                self._channel_state = []
+                self._cs_bytes = 0
+                self._overtaken_bytes = 0
+                self._align_peak_ckpt = 0
+                # alignment timer through the injectable clock seam,
+                # clamped monotone (ClockSkew must not un-expire it);
+                # an announcement that preceded the barrier's arrival
+                # already started the clock — alignment time measures
+                # from the barrier ENTERING the input, not being drained
+                self._align_timer = (self._announce_timer
+                                     if self._announce_timer is not None
+                                     else MonotoneElapsed())
+                self._announce_timer = None
+            self._barriered[i] = cid
+            if first and not el.is_savepoint \
+                    and (self.alignment_timeout_ms == 0
+                         or self._force_escalate):
+                self._escalate()   # pure unaligned / announced overtake
             self._maybe_complete_alignment()
         elif isinstance(el, EndOfInput):
             self._ended[i] = True
             # a channel ending mid-alignment completes the barrier
             self._maybe_complete_alignment()
         else:
-            if self.unaligned and self._pending_barrier is not None:
-                # pre-barrier in-flight data on a not-yet-barriered channel:
-                # record into channel state AND process normally
+            if (self._pending_barrier is not None and self._overtaken
+                    and i not in self._barriered):
+                # pre-barrier in-flight data on a not-yet-barriered channel
+                # after the overtake: record into channel state AND process
                 self._channel_state.append((i, el))
+                self._cs_bytes += element_bytes(el)
             self._handle_data(i, el)
+
+    # ------------------------------------------------ alignment machinery
+    def _enqueue_aligned(self, i: int, el: StreamElement) -> None:
+        """Aligned phase: buffer a blocked channel's post-barrier element.
+        The queue is the bounded stand-in for the reference's
+        blocked-channel buffer accumulation; its cap either escalates to
+        unaligned or fails loudly — never unbounded growth."""
+        if self._align_queued >= self.alignment_queue_max:
+            barrier = self._pending_barrier
+            if barrier is not None and barrier.is_savepoint:
+                # a USER-TRIGGERED savepoint must not kill the job: abort
+                # just this savepoint (decline + release the buffered
+                # elements + forward its barrier) — savepoint() reports
+                # None and the job keeps running, memory stays bounded
+                self._abort_alignment(
+                    f"savepoint {barrier.checkpoint_id} alignment queue "
+                    f"overflow ({self._align_queued} elements, cap "
+                    f"{self.alignment_queue_max}): savepoints cannot "
+                    f"escalate to unaligned — retry once backpressure "
+                    f"clears, or raise "
+                    f"execution.checkpointing.alignment-queue-max-elements")
+                self._process_overtaken(i, el)
+                return
+            if self.alignment_timeout_ms is not None:
+                # cap pressure escalates like timeout expiry does (the
+                # size-based escalation of FLIP-182): the overtake drains
+                # the queues, then this element processes in FIFO order
+                self._escalate()
+                self._maybe_complete_alignment()
+                self._process_overtaken(i, el)
+                return
+            msg = (f"alignment queue overflow: {self._align_queued} "
+                   f"elements buffered from barrier-blocked channels "
+                   f"(cap {self.alignment_queue_max}) while aligning "
+                   f"checkpoint "
+                   f"{barrier.checkpoint_id if barrier else '?'} and "
+                   f"alignment-timeout escalation is disabled — enable "
+                   f"execution.checkpointing.alignment-timeout or raise "
+                   f"execution.checkpointing.alignment-queue-max-elements")
+            if barrier is not None:
+                self.listener.decline_checkpoint(
+                    barrier.checkpoint_id, self.vertex_uid,
+                    self.subtask_index, msg)
+            raise AlignmentBufferOverflowError(msg)
+        self._align_queue[i].append(el)
+        self._align_queued += 1
+        self.alignment_queue_peak = max(self.alignment_queue_peak,
+                                        self._align_queued)
+        self._align_peak_ckpt = max(self._align_peak_ckpt,
+                                    self._align_queued)
+
+    def _maybe_escalate(self) -> None:
+        """Aligned-with-timeout: escalate once the (monotone, skew-proof)
+        alignment timer passes the configured timeout.  SAVEPOINTS never
+        escalate: their whole point is a rescalable, rewritable snapshot,
+        and channel state is neither (drain-then-rescale contract)."""
+        if (self._pending_barrier is None or self._overtaken
+                or self._pending_barrier.is_savepoint
+                or self.alignment_timeout_ms is None
+                or self._align_timer is None):
+            return
+        if self._align_timer.ms() >= self.alignment_timeout_ms:
+            self._escalate()
+            self._maybe_complete_alignment()
+
+    def _check_announcements(self) -> None:
+        """React to barriers QUEUED behind backlogs (the priority-event
+        announcement): before any barrier was drained, an announcement
+        starts the alignment clock and — on expiry — the handler jumps the
+        queue to the barrier; after an overtake, announced pending-cid
+        barriers on laggard channels are extracted the moment they arrive
+        instead of waiting for the (backpressured) drain to reach them."""
+        if self.alignment_timeout_ms is None:
+            return
+        if self._pending_barrier is None:
+            ann = None
+            for i, ch in enumerate(self.inputs):
+                if self._ended[i]:
+                    continue
+                fn = getattr(ch, "announced_barrier", None)
+                cid = fn() if fn is not None else None
+                if cid is not None:
+                    ann = (i, cid)
+                    break
+            if ann is None:
+                self._announce_timer = None
+                return
+            i, cid = ann
+            take = getattr(self.inputs[i], "take_until_barrier", None)
+            if take is None:
+                return
+            if cid < self._max_barrier_cid:
+                # a STALE barrier buried in the backlog: extract it so it
+                # stops shadowing newer announcements; the elements in
+                # front of it are live data, the barrier itself is dropped
+                els, _bar = take(cid)
+                for el in els:
+                    self._process_overtaken(i, el)
+                return
+            if self._announce_timer is None:
+                self._announce_timer = MonotoneElapsed()
+            if self._announce_timer.ms() < self.alignment_timeout_ms:
+                return
+            # announced barrier still buried: extract it — the elements in
+            # front of it are PRE-barrier and PRE-snapshot, so they process
+            # normally (into the operator snapshot); then the barrier
+            # overtakes immediately (savepoint barriers instead START a
+            # normal ALIGNED alignment — savepoints never escalate)
+            els, bar = take(cid)
+            for el in els:
+                self._process_overtaken(i, el)
+            if bar is not None:
+                self._force_escalate = not bar.is_savepoint
+                try:
+                    self._handle(i, bar)
+                finally:
+                    self._force_escalate = False
+        elif self._overtaken:
+            cid = self._pending_barrier.checkpoint_id
+            for i, ch in enumerate(self.inputs):
+                if self._ended[i] or i in self._barriered:
+                    continue
+                fn = getattr(ch, "announced_barrier", None)
+                acid = fn() if fn is not None else None
+                take = getattr(ch, "take_until_barrier", None)
+                if acid is None or take is None:
+                    continue
+                if acid < cid:
+                    # stale barrier shadowing the pending one: its
+                    # in-front elements are still pre-PENDING-barrier
+                    # in-flight data — record them; drop the barrier
+                    els, _bar = take(acid)
+                else:
+                    if acid != cid:
+                        continue
+                    els, bar = take(cid)
+                    if bar is not None:
+                        self._barriered[i] = cid
+                replay = []
+                for el in els:
+                    b = element_bytes(el)
+                    self._cs_bytes += b
+                    self._overtaken_bytes += b
+                    self._channel_state.append((i, el))
+                    replay.append(el)
+                for el in replay:
+                    self._process_overtaken(i, el)
+            self._maybe_complete_alignment()
+
+    def _escalate(self) -> None:
+        """The barrier OVERTAKES: snapshot now, forward now, extract the
+        in-flight elements queued in front of not-yet-delivered barriers
+        into channel state, and unblock the aligned queues."""
+        barrier = self._pending_barrier
+        if barrier is None or self._overtaken:
+            return
+        cid = barrier.checkpoint_id
+        from flink_tpu.operators.base import snapshot_scope
+        try:
+            chaos.fire("subtask.snapshot", task=self.vertex_uid,
+                       subtask=self.subtask_index, checkpoint=cid)
+            prep = getattr(self.operator,
+                           "prepare_snapshot_pre_barrier", None)
+            if prep is not None:
+                self._emit(prep())
+            with snapshot_scope(cid):
+                self._pending_snapshot = {
+                    "operator": self.operator.snapshot_state(),
+                    "valve": self._valve.snapshot()}
+        except _Cancel:
+            raise
+        except Exception as e:  # noqa: BLE001
+            # decline at alignment completion (barrier still flows)
+            self._pending_snapshot = None
+            self._snapshot_error = f"{type(e).__name__}: {e}"
+        self._emit([barrier])
+        self._overtaken = True
+        replay: List[tuple] = []
+        overtaken = 0
+        # in-flight data the barrier jumps over: everything queued in
+        # front of the barrier on not-yet-barriered channels is CHANNEL
+        # STATE (persisted + processed); if the barrier itself is queued,
+        # the channel counts as delivered without waiting for the
+        # (backpressured) consumer to drain to it
+        for i, ch in enumerate(self.inputs):
+            if self._ended[i] or i in self._barriered:
+                continue
+            take = getattr(ch, "take_until_barrier", None)
+            if take is None:
+                continue
+            els, bar = take(cid)
+            for el in els:
+                b = element_bytes(el)
+                overtaken += b
+                self._cs_bytes += b
+                self._channel_state.append((i, el))
+                replay.append((i, el))
+            if bar is not None:
+                self._barriered[i] = cid
+        # unblock the aligned queues: their buffered elements are
+        # POST-barrier data on already-delivered channels — overtaken by
+        # the barrier, processed now, NOT part of the snapshot
+        for i, q in enumerate(self._align_queue):
+            while q:
+                el = q.popleft()
+                overtaken += element_bytes(el)
+                replay.append((i, el))
+        self._align_queued = 0
+        self._overtaken_bytes += overtaken
+        for i, el in replay:
+            self._process_overtaken(i, el)
+
+    def _process_overtaken(self, i: int, el: StreamElement) -> None:
+        """Process an element released by an overtake/abort drain.  Data
+        was already recorded into channel state where required, so it must
+        NOT go back through ``_handle``'s recording path; barriers and
+        end-of-input keep their full bookkeeping, and a NEW alignment
+        started mid-drain re-blocks its channels."""
+        if isinstance(el, (CheckpointBarrier, EndOfInput)):
+            self._handle(i, el)
+        elif self._is_blocked(i):
+            self._enqueue_aligned(i, el)
+        else:
+            self._handle_data(i, el)
+
+    def _abort_alignment(self, reason: str) -> None:
+        """A superseding barrier invalidated the pending checkpoint: drop
+        its recorded channel state, decline it (the coordinator already
+        expired it — late declines are ignored), release the buffered
+        elements, and make sure downstream alignment for it still ends."""
+        barrier = self._pending_barrier
+        if barrier is None:
+            return
+        cid = barrier.checkpoint_id
+        was_overtaken = self._overtaken
+        self._pending_barrier = None
+        self._pending_snapshot = None
+        self._snapshot_error = None
+        self._overtaken = False
+        self._channel_state = []
+        self._cs_bytes = 0
+        self._barriered.clear()
+        self._align_timer = None
+        queued: List[tuple] = []
+        for i, q in enumerate(self._align_queue):
+            while q:
+                queued.append((i, q.popleft()))
+        self._align_queued = 0
+        for i, el in queued:
+            self._process_overtaken(i, el)
+        if not was_overtaken:
+            # never forwarded: downstream alignment must still end
+            self._emit([barrier])
+        self.listener.decline_checkpoint(cid, self.vertex_uid,
+                                         self.subtask_index, reason)
 
     def _emit_status_change(self, st) -> None:
         if st is not None:
@@ -569,29 +957,66 @@ class Subtask(SubtaskBase):
     def _maybe_complete_alignment(self) -> None:
         if self._pending_barrier is None:
             return
-        if all(self._ended[j] or j in self._blocked
-               for j in range(len(self.inputs))):
-            self._take_checkpoint(self._pending_barrier)
-            self._blocked.clear()
-            self._pending_barrier = None
+        if not all(self._ended[j] or j in self._barriered
+                   for j in range(len(self.inputs))):
+            return
+        barrier = self._pending_barrier
+        self._take_checkpoint(barrier)
+        self._barriered.clear()
+        self._pending_barrier = None
+        self._align_timer = None
+        # aligned completion: the blocked channels' buffered post-barrier
+        # elements process now, BEFORE any new poll of those channels
+        # (overtaken completions drained them at escalation already)
+        queued: List[tuple] = []
+        for i, q in enumerate(self._align_queue):
+            while q:
+                queued.append((i, q.popleft()))
+        self._align_queued = 0
+        for i, el in queued:
+            self._process_overtaken(i, el)
+
+    def _record_checkpoint_stats(self, cid: int, align_ms: float,
+                                 unaligned: bool, persisted: int) -> None:
+        self.last_checkpoint_stats = {
+            "checkpoint_id": cid,
+            "alignment_ms": round(align_ms, 3),
+            "unaligned": unaligned,
+            "overtaken_bytes": self._overtaken_bytes,
+            "persisted_inflight_bytes": persisted,
+            "alignment_queue_peak": self._align_peak_ckpt}
 
     def _take_checkpoint(self, barrier: CheckpointBarrier) -> None:
         cid = barrier.checkpoint_id
-        if self.unaligned:
+        align_ms = self._align_timer.ms() if self._align_timer else 0.0
+        if self._overtaken:
             if self._pending_snapshot is None:
-                # first-arrival snapshot failed: decline now that every
+                # overtake-time snapshot failed: decline now that every
                 # channel delivered the barrier (the recorded channel
                 # state belongs to the aborted checkpoint — drop it)
                 self._channel_state = []
+                self._cs_bytes = 0
+                self._record_checkpoint_stats(cid, align_ms, True, 0)
                 self.listener.decline_checkpoint(
                     cid, self.vertex_uid, self.subtask_index,
-                    getattr(self, "_snapshot_error", "snapshot failed"))
+                    self._snapshot_error or "snapshot failed")
                 return
             snap = self._pending_snapshot
-            snap["channel_state"] = list(self._channel_state)
+            # versioned channel-state section: the persisted in-flight
+            # elements plus the overtake accounting (v1)
+            snap["channel_state"] = {
+                "version": 1,
+                "elements": list(self._channel_state),
+                "persisted_bytes": self._cs_bytes,
+                "overtaken_bytes": self._overtaken_bytes,
+                "alignment_ms": round(align_ms, 3),
+                "unaligned": True}
+            self._record_checkpoint_stats(cid, align_ms, True,
+                                          self._cs_bytes)
             self._pending_snapshot = None
             self._channel_state = []
-            # barrier was already forwarded at first arrival
+            self._cs_bytes = 0
+            # barrier was already forwarded at the overtake
         else:
             from flink_tpu.operators.base import snapshot_scope
             try:
@@ -608,10 +1033,16 @@ class Subtask(SubtaskBase):
                 raise
             except Exception as e:  # noqa: BLE001
                 self._emit([barrier])   # downstream alignment must end
+                self._record_checkpoint_stats(cid, align_ms, False, 0)
                 self.listener.decline_checkpoint(
                     cid, self.vertex_uid, self.subtask_index,
                     f"{type(e).__name__}: {e}")
                 return
+            snap["channel_state"] = {
+                "version": 1, "elements": [], "persisted_bytes": 0,
+                "overtaken_bytes": 0,
+                "alignment_ms": round(align_ms, 3), "unaligned": False}
+            self._record_checkpoint_stats(cid, align_ms, False, 0)
             self._emit([barrier])
         self.listener.acknowledge_checkpoint(
             cid, self.vertex_uid, self.subtask_index, snap)
@@ -626,6 +1057,28 @@ class Subtask(SubtaskBase):
                 self.operator.notify_checkpoint_complete(cmd[1])
             elif cmd[0] == "cancel":
                 raise _Cancel()
+
+
+def aggregate_channel_state(snapshots) -> Dict[str, Any]:
+    """Roll up the subtask acks' channel-state (v1) sections for one
+    completed checkpoint — shared by both coordinators so the schema has
+    exactly one reader: max alignment across subtasks (the checkpoint's
+    critical path), summed overtaken / persisted in-flight bytes, and
+    whether ANY subtask's barrier overtook."""
+    align_ms = 0.0
+    overtaken = persisted = 0
+    any_unaligned = False
+    for snap in snapshots:
+        cs = snap.get("channel_state") if isinstance(snap, dict) else None
+        if isinstance(cs, dict):
+            align_ms = max(align_ms, cs.get("alignment_ms", 0.0))
+            overtaken += cs.get("overtaken_bytes", 0)
+            persisted += cs.get("persisted_bytes", 0)
+            any_unaligned |= bool(cs.get("unaligned"))
+    return {"alignment_ms": round(align_ms, 3),
+            "overtaken_bytes": overtaken,
+            "persisted_inflight_bytes": persisted,
+            "unaligned": any_unaligned}
 
 
 class TaskListener:

@@ -59,6 +59,19 @@ class CheckpointingOptions:
         0, "Minimum pause between checkpoints in ms.")
     RETAINED = key("state.checkpoints.num-retained").int_type().default_value(
         1, "How many completed checkpoints to retain.")
+    UNALIGNED = key("execution.checkpointing.unaligned").bool_type().default_value(
+        False, "Unaligned checkpoints: the barrier overtakes in-flight "
+        "channel data, which is persisted as channel state — checkpoint "
+        "duration becomes independent of backpressure.")
+    ALIGNMENT_TIMEOUT = key("execution.checkpointing.alignment-timeout").duration_type().default_value(
+        None, "Aligned-checkpoint timeout in ms: a checkpoint starts "
+        "aligned and ESCALATES to unaligned once alignment exceeds this "
+        "(0 = unaligned from the first barrier; None/unset = stay aligned).")
+    ALIGNMENT_QUEUE_MAX = key("execution.checkpointing.alignment-queue-max-elements").int_type().default_value(
+        8192, "Cap on elements buffered per subtask from barrier-blocked "
+        "channels during alignment.  Hitting it escalates to unaligned "
+        "when an alignment timeout is configured, and raises a classified "
+        "AlignmentBufferOverflowError otherwise — bounded memory either way.")
 
 
 class DeviceOptions:

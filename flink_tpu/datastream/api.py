@@ -145,10 +145,18 @@ class StreamExecutionEnvironment:
                         storage=None, unaligned: bool = False,
                         restart_attempts: int = 0, timeout_s: float = 300.0,
                         tolerable_failed_checkpoints: int = 0,
-                        checkpoint_timeout_s: float = 60.0):
+                        checkpoint_timeout_s: float = 60.0,
+                        alignment_timeout_ms: Optional[float] = None,
+                        alignment_queue_max: Optional[int] = None,
+                        channel_capacity: int = 32):
         """Run on the in-process MiniCluster with REAL parallelism (one
         thread per subtask, channels + partitioners between them) — the
-        multi-node semantics path (``MiniCluster.java`` analog)."""
+        multi-node semantics path (``MiniCluster.java`` analog).
+
+        ``alignment_timeout_ms`` enables aligned-with-timeout unaligned
+        checkpoints (0 = unaligned from the first barrier, like
+        ``unaligned=True``); ``alignment_queue_max`` caps the per-subtask
+        blocked-channel alignment buffer."""
         from flink_tpu.cluster.minicluster import MiniCluster
 
         plan = self.get_stream_graph(job_name).to_plan()
@@ -159,7 +167,10 @@ class StreamExecutionEnvironment:
                 else self.checkpoint_interval_ms),
             unaligned=unaligned, restart_attempts=restart_attempts,
             tolerable_failed_checkpoints=tolerable_failed_checkpoints,
-            checkpoint_timeout_s=checkpoint_timeout_s)
+            checkpoint_timeout_s=checkpoint_timeout_s,
+            alignment_timeout_ms=alignment_timeout_ms,
+            alignment_queue_max=alignment_queue_max,
+            channel_capacity=channel_capacity, config=self.config)
         self._last_cluster = cluster
         return cluster.execute(plan, restore=restore, timeout_s=timeout_s)
 

@@ -45,6 +45,17 @@ DEVICE_HEALTH_NEAR_MISSES = "device_health.near_misses"
 DEVICE_HEALTH_TRANSIENT_RETRIES = "device_health.transient_retries"
 DEVICE_HEALTH_OOM_PAGEOUTS = "device_health.oom_pageouts"
 DEVICE_HEALTH_DEGRADED_OPERATORS = "device_health.degraded_operators"
+# checkpoint alignment / unaligned-checkpoint accounting (the reference's
+# checkpointAlignmentTime + lastCheckpointPersistedData analogs)
+CHECKPOINT_ALIGNMENT_TIME = "lastCheckpointAlignmentTime"
+CHECKPOINT_OVERTAKEN_BYTES = "lastCheckpointOvertakenBytes"
+CHECKPOINT_PERSISTED_INFLIGHT = "lastCheckpointPersistedInFlightBytes"
+NUM_UNALIGNED_CHECKPOINTS = "numberOfUnalignedCheckpoints"
+# channel backpressure (backPressuredTimeMsPerSecond family, folded to
+# job scope: totals + the deepest input queue + alignment buffering)
+BACKPRESSURED_TIME_MS = "backpressure.total_backpressured_ms"
+BACKPRESSURE_MAX_QUEUE_DEPTH = "backpressure.max_queue_depth"
+BACKPRESSURE_ALIGNMENT_QUEUED = "backpressure.alignment_queued_elements"
 
 
 class MetricGroup:
@@ -236,6 +247,44 @@ def device_health_metrics(group: MetricGroup,
                       (DEVICE_HEALTH_OOM_PAGEOUTS, "oom_pageouts"),
                       (DEVICE_HEALTH_DEGRADED_OPERATORS,
                        "degraded_operators")):
+        group.gauge(name, _read(key))
+    return group
+
+
+def backpressure_metrics(group: MetricGroup,
+                         totals_supplier: Callable[[], Dict[str, Any]]
+                         ) -> MetricGroup:
+    """Register the channel-backpressure gauges on a (job-scope) group:
+    total producer credit-wait ms, deepest input queue, and elements
+    buffered by barrier alignment.  ``totals_supplier`` returns
+    ``MiniCluster.backpressure_totals()``-shaped dicts."""
+    def _read(key: str) -> Callable[[], Any]:
+        return lambda: (totals_supplier() or {}).get(key, 0)
+
+    for name, key in ((BACKPRESSURED_TIME_MS, "total_backpressured_ms"),
+                      (BACKPRESSURE_MAX_QUEUE_DEPTH, "max_queue_depth"),
+                      (BACKPRESSURE_ALIGNMENT_QUEUED,
+                       "alignment_queued_elements")):
+        group.gauge(name, _read(key))
+    return group
+
+
+def checkpoint_alignment_metrics(group: MetricGroup,
+                                 stats_supplier: Callable[[], Dict[str, Any]]
+                                 ) -> MetricGroup:
+    """Register the unaligned-checkpoint accounting gauges on a (job-scope)
+    group: alignment duration, overtaken bytes and persisted in-flight
+    bytes of the last completed checkpoint, plus the lifetime count of
+    checkpoints that escalated to unaligned."""
+    def _read(key: str) -> Callable[[], Any]:
+        return lambda: (stats_supplier() or {}).get(key, 0)
+
+    for name, key in (
+            (CHECKPOINT_ALIGNMENT_TIME, "last_alignment_duration_ms"),
+            (CHECKPOINT_OVERTAKEN_BYTES, "last_overtaken_bytes"),
+            (CHECKPOINT_PERSISTED_INFLIGHT,
+             "last_persisted_inflight_bytes"),
+            (NUM_UNALIGNED_CHECKPOINTS, "unaligned_checkpoints")):
         group.gauge(name, _read(key))
     return group
 

@@ -190,12 +190,27 @@ class RestServer:
                         {"id": v["id"], "watermark": v.get("watermark")}
                         for v in status["vertices"]]})
                 if sub == "backpressure":
+                    ck = status.get("checkpoints", {})
                     return self._send({"vertices": [
                         {"id": v["id"],
                          "busy": round(v["busy_ratio"], 4),
                          "idle": round(v["idle_ratio"], 4),
-                         "backpressured": round(v["backpressure_ratio"], 4)}
-                        for v in status["vertices"]]})
+                         "backpressured": round(v["backpressure_ratio"], 4),
+                         # per-channel queue depth / backpressured time +
+                         # the alignment-queue gauge (unaligned ckpts)
+                         "subtasks": [
+                             {"index": s["index"],
+                              "channels": s.get("channels", []),
+                              "alignment_queued":
+                                  s.get("alignment_queued", 0)}
+                             for s in v.get("subtasks", [])]}
+                        for v in status["vertices"]],
+                        "checkpoints": {
+                            k: ck.get(k, 0) for k in (
+                                "last_alignment_duration_ms",
+                                "last_overtaken_bytes",
+                                "last_persisted_inflight_bytes",
+                                "unaligned_checkpoints")}})
                 if sub == "metrics":
                     return self._send({
                         "records_in": sum(v["records_in"]
@@ -250,7 +265,9 @@ class RestServer:
                 if sub == "backpressure.html":
                     from flink_tpu.rest.views import backpressure_html
                     return self._send(
-                        backpressure_html(status["vertices"]).encode(),
+                        backpressure_html(
+                            status["vertices"],
+                            status.get("checkpoints", {})).encode(),
                         content_type="text/html")
                 if sub == "device_health":
                     return self._send(status.get(

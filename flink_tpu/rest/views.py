@@ -11,7 +11,7 @@ browser needed)."""
 from __future__ import annotations
 
 import html
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 def _esc(s: Any) -> str:
@@ -195,10 +195,30 @@ def device_health_html(status: Dict[str, Any]) -> str:
             + "</tbody></table></div>")
 
 
-def backpressure_html(vertices: List[Dict[str, Any]]) -> str:
+def backpressure_html(vertices: List[Dict[str, Any]],
+                      checkpoints: Optional[Dict[str, Any]] = None) -> str:
     """Per-SUBTASK busy/backpressure/idle bars (the reference's subtask
-    backpressure tab), one row per subtask under its vertex."""
+    backpressure tab), one row per subtask under its vertex — plus, when
+    present, the per-channel queue-depth/backpressured-time table and the
+    checkpoint-alignment summary of the unaligned-checkpoint path (same
+    server-rendered, DOM-testable pattern as the device-health panel)."""
     out = ['<div class="bp-view">']
+    cp = checkpoints or {}
+    if cp:
+        out.append(
+            f'<div class="bp-alignment">'
+            f'<span class="bp-align-item" data-metric='
+            f'"last_alignment_duration_ms">alignment '
+            f'{_esc(cp.get("last_alignment_duration_ms", 0))} ms</span>'
+            f'<span class="bp-align-item" data-metric='
+            f'"last_overtaken_bytes">overtaken '
+            f'{_esc(cp.get("last_overtaken_bytes", 0))} B</span>'
+            f'<span class="bp-align-item" data-metric='
+            f'"last_persisted_inflight_bytes">persisted in-flight '
+            f'{_esc(cp.get("last_persisted_inflight_bytes", 0))} B</span>'
+            f'<span class="bp-align-item" data-metric='
+            f'"unaligned_checkpoints">unaligned checkpoints '
+            f'{_esc(cp.get("unaligned_checkpoints", 0))}</span></div>')
     for v in vertices:
         out.append(f'<div class="bp-vertex" data-vertex-id='
                    f'"{_esc(v["id"])}"><h3>{_esc(v.get("name", v["id"]))}'
@@ -220,7 +240,22 @@ def backpressure_html(vertices: List[Dict[str, Any]]) -> str:
                 f'<div class="bp-idle" style="width:{idle * 100:.1f}%">'
                 f"</div></div>"
                 f'<span class="bp-pct">busy {busy * 100:.0f}% · bp '
-                f'{bp * 100:.0f}% · idle {idle * 100:.0f}%</span></div>')
+                f'{bp * 100:.0f}% · idle {idle * 100:.0f}%</span>')
+            chans = s.get("channels") or []
+            if chans:
+                rows = "".join(
+                    f'<tr class="bp-chan" data-channel="{_esc(c["name"])}">'
+                    f'<td>{_esc(c["name"])}</td><td>{_esc(c["depth"])}</td>'
+                    f'<td>{_esc(c.get("queued_bytes", 0))}</td>'
+                    f'<td>{_esc(c.get("backpressured_ms", 0))}</td></tr>'
+                    for c in chans)
+                out.append(
+                    f'<table class="bp-chan-table" data-alignment-queued='
+                    f'"{_esc(s.get("alignment_queued", 0))}">'
+                    f'<thead><tr><th>channel</th><th>depth</th>'
+                    f'<th>queued bytes</th><th>backpressured (ms)</th>'
+                    f'</tr></thead><tbody>{rows}</tbody></table>')
+            out.append("</div>")
         out.append("</div>")
     out.append("</div>")
     return "".join(out)

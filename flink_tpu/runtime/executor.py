@@ -319,7 +319,11 @@ class LocalExecutor:
                 readers.append((rv, reader))
                 self._split_readers.append((rv.vertex.uid, split_id, reader))
 
-        last_checkpoint = time.monotonic()
+        # checkpoint cadence through the injectable clock seam, clamped
+        # monotone: a chaos ClockSkew backward step must not stall the
+        # periodic trigger (nor a forward jump double-fire after recovery)
+        from flink_tpu.utils.clock import MonotoneElapsed
+        ckpt_timer = MonotoneElapsed()
         ckpt_id = 0
         while readers and not self._cancelled:
             if self.max_records is not None and self._records >= self.max_records:
@@ -356,11 +360,10 @@ class LocalExecutor:
                 still.append((rv, it))
             readers = still
             if (self.checkpoint_interval_ms and self.checkpoint_storage and
-                    (time.monotonic() - last_checkpoint) * 1000
-                    >= self.checkpoint_interval_ms):
+                    ckpt_timer.ms() >= self.checkpoint_interval_ms):
                 ckpt_id += 1
                 self.trigger_checkpoint(ckpt_id)
-                last_checkpoint = time.monotonic()
+                ckpt_timer = MonotoneElapsed()
 
         # bounded end: MAX_WATERMARK from sources, then end_input in topo
         # order.  drain=False (stop-with-savepoint --no-drain analog) keeps

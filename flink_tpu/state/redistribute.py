@@ -19,6 +19,42 @@ from flink_tpu.core import keygroups
 from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex
 
 
+class ChannelStateRescaleError(RuntimeError):
+    """A snapshot carrying persisted in-flight CHANNEL STATE (an unaligned
+    checkpoint) was handed to the rescale path.  Channel state is keyed by
+    physical channel index, not key group — redistributing it across a
+    different parallelism would replay in-flight elements into the wrong
+    subtasks (duplicates and losses at once).  The supported procedure is
+    drain-then-rescale: take an ALIGNED savepoint (stop-with-savepoint, or
+    let one aligned periodic checkpoint complete) and rescale from that."""
+
+
+def reject_channel_state(snapshot, context: str) -> None:
+    """Fail LOUDLY if any subtask snapshot in a job checkpoint carries
+    non-empty unaligned channel state — rescaling must never silently drop
+    or misroute persisted in-flight elements.  ``snapshot`` is the
+    MiniCluster/ProcessCluster layout ``{uid: {"subtasks": [...]}}``."""
+    if not isinstance(snapshot, dict):
+        return
+    for uid, entry in snapshot.items():
+        if uid.startswith("__") or not isinstance(entry, dict):
+            continue
+        for idx, sub in enumerate(entry.get("subtasks", []) or []):
+            if not isinstance(sub, dict):
+                continue
+            cs = sub.get("channel_state")
+            elements = (cs.get("elements", []) if isinstance(cs, dict)
+                        else cs)
+            if elements:
+                raise ChannelStateRescaleError(
+                    f"{context}: subtask {uid}[{idx}] snapshot carries "
+                    f"{len(elements)} persisted in-flight channel-state "
+                    f"elements (unaligned checkpoint) — channel state "
+                    f"cannot be redistributed across parallelisms; "
+                    f"drain-then-rescale: rescale from an ALIGNED "
+                    f"savepoint instead")
+
+
 def _restore_index(snap: Dict[str, Any]):
     cls = (ObjectKeyIndex if snap.get("key_index_kind") == "ObjectKeyIndex"
            else KeyIndex)

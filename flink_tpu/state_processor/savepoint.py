@@ -248,6 +248,11 @@ class SavepointWriter:
         import copy as _copy
         self.snapshot[uid] = _copy.deepcopy(self.snapshot[uid])
         entry = self.snapshot[uid]
+        # an UNALIGNED checkpoint's persisted in-flight channel state
+        # cannot survive an offline rewrite (the merge collapses subtask
+        # snapshots) — fail loudly instead of silently dropping elements
+        from flink_tpu.state.redistribute import reject_channel_state
+        reject_channel_state({uid: entry}, "savepoint transform")
         op_snap = _merged_operator_snapshot(entry)
         inner = op_snap.get("operator", op_snap)
         member = _find_member(inner, "key_index", "keys")

@@ -41,8 +41,8 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = [
     "InjectedFault", "FaultSchedule", "FailTimes", "CrashOnceAt", "DelayBy",
-    "SlowDisk", "ActionSequence", "Partition", "FailWithProbability",
-    "WedgedDevice", "ClockSkew",
+    "SlowDisk", "SlowConsumer", "ActionSequence", "Partition",
+    "FailWithProbability", "WedgedDevice", "ClockSkew",
     "FaultInjector", "FreezableProxy", "install", "uninstall", "installed",
     "fire", "active", "blocked", "skew",
 ]
@@ -164,6 +164,60 @@ class SlowDisk(FaultSchedule):
         if gate >= self.p:
             return OK
         return ("delay", span)
+
+
+class SlowConsumer(FaultSchedule):
+    """Seeded, BURSTY per-channel drain stalls — the slow-consumer model
+    (a sink or operator that intermittently falls behind, so its input
+    queues deepen and barriers crawl behind the backlog).
+
+    Fired at the ``channel.recv`` point (one firing per element actually
+    dequeued): with probability ``p`` a firing STARTS a burst of ``burst``
+    consecutive stalled dequeues, each stalling for a duration drawn
+    uniformly from ``[min_s, max_s]`` out of the point's seeded RNG.
+    Bursts — not independent per-element stalls — are what make input
+    queues deepen faster than they drain, the condition unaligned
+    checkpoints exist for.  Still a pure function of (seed, point, firing
+    count): both RNG samples are drawn on EVERY firing (the SlowDisk
+    invariant), and the burst countdown advances only with the strictly
+    ordered firing counter.  ``times`` bounds the flaky period; ``channel``
+    (a substring of the channel name) scopes the schedule to matching
+    channels — unmatched firings advance nothing."""
+
+    def __init__(self, max_s: float, min_s: float = 0.0, p: float = 0.05,
+                 burst: int = 8, times: Optional[int] = None,
+                 channel: Optional[str] = None):
+        if max_s < min_s:
+            raise ValueError("SlowConsumer: max_s must be >= min_s")
+        if burst < 1:
+            raise ValueError("SlowConsumer: burst must be >= 1")
+        self.max_s = max_s
+        self.min_s = min_s
+        self.p = p
+        self.burst = burst
+        self.times = times
+        self.channel = channel
+        self._burst_left = 0
+
+    def matches(self, ctx: Dict) -> bool:
+        return self.channel is None or self.channel in str(
+            ctx.get("channel", ""))
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        # ALWAYS draw both samples (SlowDisk invariant): the RNG stream
+        # must advance identically per firing regardless of branch
+        gate = rng.random()
+        span = self.min_s + (self.max_s - self.min_s) * rng.random()
+        if self.times is not None and n > self.times:
+            self._burst_left = 0
+            return OK
+        if self._burst_left > 0:
+            self._burst_left -= 1
+            return ("delay", span)
+        if gate < self.p:
+            self._burst_left = self.burst - 1
+            return ("delay", span)
+        return OK
 
 
 class ActionSequence(FaultSchedule):

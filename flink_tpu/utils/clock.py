@@ -27,7 +27,8 @@ import time
 
 from flink_tpu.testing import chaos
 
-__all__ = ["Clock", "SYSTEM_CLOCK", "now_ms", "monotonic"]
+__all__ = ["Clock", "SYSTEM_CLOCK", "now_ms", "monotonic",
+           "MonotoneElapsed"]
 
 
 class Clock:
@@ -42,6 +43,32 @@ class Clock:
         ms).  NOTE: under an active skew schedule this is no longer
         monotone — that is the point of the nemesis."""
         return time.monotonic() + chaos.skew("clock.monotonic") / 1000.0
+
+
+class MonotoneElapsed:
+    """Elapsed-seconds tracker that stays MONOTONE under a skewed
+    monotonic clock (chaos ``ClockSkew`` on ``clock.monotonic``).
+
+    Checkpoint expiry and alignment timers measure *elapsed* time; under a
+    backward clock step a naive ``now - start`` shrinks, which would
+    un-expire an already-expired checkpoint (or push an alignment timeout
+    into the future forever while the nemesis oscillates).  Readings here
+    clamp at their own high-water mark, so expiry decisions never regress:
+    once a deadline is passed it stays passed, matching the reference's
+    monotone ``ProcessingTimeService`` contract for its checkpoint
+    timeouts."""
+
+    def __init__(self, clock: "Clock" = None):
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self._start = self._clock.monotonic()
+        self._hw = 0.0
+
+    def seconds(self) -> float:
+        self._hw = max(self._hw, self._clock.monotonic() - self._start)
+        return self._hw
+
+    def ms(self) -> float:
+        return self.seconds() * 1000.0
 
 
 SYSTEM_CLOCK = Clock()

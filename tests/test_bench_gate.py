@@ -93,6 +93,9 @@ def test_budget_file_shape():
         assert sec["min_rps"] > 0
         assert sec["max_p99_ms"] > 0
         assert "probe_mirror" in sec["max_phase_ms"]
+    # checkpoint-under-backpressure budget (bench.py --checkpoint-interval)
+    cb = budget["checkpoint_backpressure"]
+    assert cb["max_duration_ms"] > 0 and cb["min_completed"] >= 1
     # CPU-forced full runs carry the pipelined-hot-path acceptance keys
     full_cpu = budget["full_cpu"]
     assert full_cpu["min_vs_numpy"] >= 1.0
@@ -157,6 +160,30 @@ def test_inject_wedge_smoke_exercises_shared_recovery_path(tmp_path):
     assert hs["watchdog_timeouts"] == 1
     assert hs["quarantine_migrations"] == 1 and hs["repromotions"] == 1
     assert hs["state"] == "healthy" and hs["degraded"] == 0
+
+
+def test_checkpoint_interval_completes_within_budget_under_backpressure():
+    """bench.py --checkpoint-interval injects SlowConsumer + SlowDisk
+    backpressure and asserts checkpoints (aligned-with-timeout escalation
+    enabled) still complete within the checkpoint_backpressure budget,
+    reporting duration + persisted in-flight bytes — exits 0 only when a
+    checkpoint completed in budget with exactly-once sums."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--checkpoint-interval", "50"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"] and result["exactly_once"]
+    with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
+        budget = json.load(f)["checkpoint_backpressure"]
+    assert result["completed_checkpoints"] >= budget["min_completed"]
+    assert result["max_duration_ms"] <= budget["max_duration_ms"]
+    # backpressure was REAL (the chaos schedules actually persisted
+    # in-flight data) — otherwise the run proves nothing
+    assert result["unaligned_checkpoints"] >= 1
+    assert result["persisted_inflight_bytes_total"] > 0
 
 
 @pytest.mark.slow

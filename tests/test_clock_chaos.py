@@ -168,6 +168,147 @@ def test_session_gap_monotone_under_skew():
     assert op.on_processing_time(1300) == []
 
 
+def test_monotone_elapsed_never_regresses_under_skew():
+    """MonotoneElapsed (checkpoint expiry + alignment timers): a backward
+    monotonic step must not shrink an elapsed reading — once a deadline is
+    passed it stays passed; a forward jump advances it immediately."""
+    from flink_tpu.utils.clock import MonotoneElapsed
+
+    inj = FaultInjector(seed=7)
+    # reading 1 = construction (unskewed); 2: +30s; 3: -60s (net -30s);
+    # 4: +120s more (net +90s)
+    inj.inject("clock.monotonic", ClockSkew(jumps=[(2, 30_000),
+                                                   (3, -90_000),
+                                                   (4, 150_000)]))
+    with chaos.installed(inj):
+        t = MonotoneElapsed()
+        a = t.seconds()          # +30s skew
+        b = t.seconds()          # -30s skew: must NOT regress
+        c = t.seconds()          # +90s skew: advances
+    assert a >= 29.0
+    assert b >= a, f"elapsed regressed under backward skew: {a} -> {b}"
+    assert c >= 89.0
+
+
+def test_checkpoint_expiry_monotone_under_skew():
+    """The MiniCluster coordinator's checkpoint-timeout path reads the
+    clock seam: a ClockSkew forward jump past the timeout expires the
+    pending checkpoint (charged as 'expired'), raw wall time regardless."""
+    from flink_tpu.cluster.minicluster import MiniCluster, _PendingCheckpoint
+    from flink_tpu.utils.clock import MonotoneElapsed
+
+    cluster = MiniCluster(checkpoint_timeout_s=60.0,
+                          tolerable_failed_checkpoints=-1)
+
+    class _T:
+        vertex_uid, subtask_index = "v", 0
+        state = "RUNNING"
+
+    cluster._tasks = [_T()]
+    cluster._source_tasks = []
+    cluster._finished = set()
+    inj = FaultInjector(seed=8)
+    # reading 1 = the pending timer's construction; every later reading
+    # jumps 10 minutes forward — far past the 60s timeout
+    inj.inject("clock.monotonic", ClockSkew(jumps=[(2, 600_000)]))
+    with chaos.installed(inj):
+        cluster._pending = _PendingCheckpoint(1, expected=1,
+                                              timer=MonotoneElapsed())
+        cid, reason = cluster._trigger_checkpoint()
+    assert cid is not None and reason == "ok", \
+        "expired pending must be aborted and a new checkpoint started"
+    st = cluster.failure_manager.status()
+    assert st["last_failure_reason"] == "expired"
+    assert st["last_failure_checkpoint_id"] == 1
+
+
+def test_alignment_timer_reads_clock_seam():
+    """A Subtask's aligned-with-timeout escalation runs off the injectable
+    clock: a forward monotonic jump expires a 60s alignment timeout
+    immediately — the barrier overtakes without any wall-clock wait."""
+    import time as _time
+
+    from flink_tpu.cluster.channels import LocalChannel
+    from flink_tpu.cluster.task import Subtask, TaskListener
+    from flink_tpu.core.batch import CheckpointBarrier, EndOfInput, RecordBatch
+    from flink_tpu.core.functions import RuntimeContext
+
+    class _Op:
+        name = "op"
+        forwards_watermarks = True
+        is_stateless = False
+        is_two_input = False
+
+        def open(self, ctx):
+            self.total = 0.0
+
+        def process_batch(self, b):
+            self.total += float(np.asarray(b.column("v")).sum())
+            return []
+
+        def process_watermark(self, wm):
+            return []
+
+        def on_processing_time(self, ts):
+            return []
+
+        def end_input(self):
+            return []
+
+        def snapshot_state(self):
+            return {"total": self.total}
+
+        def restore_state(self, s):
+            self.total = s["total"]
+
+        def notify_checkpoint_complete(self, cid):
+            pass
+
+        def close(self):
+            pass
+
+    class _Rec(TaskListener):
+        def __init__(self):
+            self.acks = {}
+
+        def acknowledge_checkpoint(self, cid, uid, idx, snap):
+            self.acks[cid] = snap
+
+    class _Out:
+        channels = []
+
+        def emit(self, el):
+            pass
+
+    ch0, ch1 = LocalChannel(16, "c0"), LocalChannel(16, "c1")
+    rec = _Rec()
+    t = Subtask("v1", 0, _Op(), [_Out()], RuntimeContext(), rec,
+                [ch0, ch1], alignment_timeout_ms=60_000)
+    inj = FaultInjector(seed=9)
+    # every monotonic reading from the 3rd on jumps +10 minutes: the
+    # alignment timer (started on the barrier) expires at once
+    inj.inject("clock.monotonic", ClockSkew(jumps=[(3, 600_000)]))
+    with chaos.installed(inj):
+        t.start()
+        ch0.put(CheckpointBarrier(1, 0))
+        deadline = _time.monotonic() + 10
+        while 1 not in rec.acks and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        # the OTHER channel never delivered its barrier: an ack can only
+        # come from the escalated (overtaken) path completing after ch1's
+        # barrier — send it now that escalation must have fired
+        ch1.put(CheckpointBarrier(1, 0))
+        deadline = _time.monotonic() + 10
+        while 1 not in rec.acks and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        ch0.put(EndOfInput())
+        ch1.put(EndOfInput())
+        t.join()
+    assert 1 in rec.acks
+    assert rec.acks[1]["channel_state"]["unaligned"], \
+        "the skew-expired alignment timer did not escalate"
+
+
 def test_heartbeat_clock_seam_injectable():
     """HeartbeatManager's default clock reads the seam (a monotonic skew
     can falsely age heartbeats — the local-clock-jump false suspect)."""

@@ -509,3 +509,56 @@ def test_local_recovery_zero_remote_reads_on_same_worker_restart(tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
         sys.modules.pop("localrec_job_mod", None)
+
+
+def test_unaligned_checkpoints_thread_through_process_cluster(slow_job_path,
+                                                              tmp_path):
+    """ISSUE-5: the unaligned-checkpoint policy ships with the deploy
+    message (ckpt_opts); worker Subtasks overtake at the first barrier,
+    acks carry the versioned channel-state section, the coordinator
+    aggregates the alignment accounting, and a restore from an unaligned
+    checkpoint replays channel state with exactly-once totals."""
+    path, job = slow_job_path
+    store = FileCheckpointStorage(str(tmp_path / "ckpt"))
+    pc = ProcessCluster(job, n_workers=2, checkpoint_storage=store,
+                        checkpoint_interval_ms=100, extra_sys_path=(path,),
+                        alignment_timeout_ms=0)
+    assert pc.ckpt_opts["alignment_timeout_ms"] == 0
+    res = pc.run(timeout_s=300)
+    assert res["state"] == "FINISHED", res["error"]
+    assert res["completed_checkpoints"], "no checkpoints completed"
+    stats = res["checkpoint_stats"]
+    assert stats, "coordinator collected no per-checkpoint stats"
+    assert any(s["unaligned"] for s in stats), \
+        "no checkpoint recorded a barrier overtake"
+    for s in stats:
+        assert {"alignment_ms", "overtaken_bytes",
+                "persisted_inflight_bytes"} <= set(s)
+    totals = {}
+    for r in res["rows"]:
+        totals[r["k"]] = max(r["v"], totals.get(r["k"], 0.0))
+    n, k = 60_000, 13
+    expect = {i: float(len(range(i, n, k))) for i in range(k)}
+    assert totals == expect
+
+    cid, snap = _mid_run_checkpoint(store, n)
+    if snap is None:
+        pytest.skip("job finished before a mid-run checkpoint completed")
+    # worker acks persisted the VERSIONED channel-state section
+    sections = [sub["channel_state"]
+                for uid, entry in snap.items() if not uid.startswith("__")
+                for sub in entry.get("subtasks", [])
+                if isinstance(sub, dict)
+                and isinstance(sub.get("channel_state"), dict)]
+    assert sections and all(cs["version"] == 1 for cs in sections)
+    assert any(cs["unaligned"] for cs in sections)
+
+    # restore at a DIFFERENT worker count: channel state replays into the
+    # same subtasks (placement changes, parallelism does not)
+    pc2 = ProcessCluster(job, n_workers=3, extra_sys_path=(path,))
+    res2 = pc2.run(timeout_s=300, restore=snap)
+    assert res2["state"] == "FINISHED", res2["error"]
+    totals2 = {}
+    for r in res2["rows"]:
+        totals2[r["k"]] = max(r["v"], totals2.get(r["k"], 0.0))
+    assert totals2 == expect

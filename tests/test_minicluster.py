@@ -311,10 +311,14 @@ def test_unaligned_barrier_overtakes_and_records_channel_state():
     snap = rec.acks[1]
     # operator snapshot taken at FIRST barrier: only 1+2 counted
     assert snap["operator"]["total"] == 3.0
-    # the overtaken element is in channel state
+    # the overtaken element is in the VERSIONED channel-state section
     cs = snap["channel_state"]
-    assert len(cs) == 1 and cs[0][0] == 1
-    assert float(np.asarray(cs[0][1].column("v"))[0]) == 10.0
+    assert cs["version"] == 1 and cs["unaligned"]
+    els = cs["elements"]
+    assert len(els) == 1 and els[0][0] == 1
+    assert float(np.asarray(els[0][1].column("v"))[0]) == 10.0
+    assert cs["persisted_bytes"] > 0
+    assert cs["alignment_ms"] >= 0.0
     # barrier must have been forwarded BEFORE the in-flight data was processed
     seen = []
     while True:

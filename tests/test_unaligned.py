@@ -1,0 +1,771 @@
+"""Unaligned checkpoints (ISSUE-5): barrier overtake, aligned-with-timeout
+escalation, persisted in-flight channel state, recovery replay, bounded
+alignment queues, and the backpressure observability that rides along.
+
+Reference semantics: Carbone et al. "Lightweight Asynchronous Snapshots for
+Distributed Dataflows" + FLIP-76 unaligned checkpoints (barrier overtaking,
+``ChannelStateWriterImpl``) and FLIP-182 aligned-checkpoint timeout.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.channels import LocalChannel, element_bytes
+from flink_tpu.cluster.task import (AlignmentBufferOverflowError, Subtask,
+                                    TaskListener, TaskStates)
+from flink_tpu.core.batch import CheckpointBarrier, EndOfInput, RecordBatch
+from flink_tpu.core.functions import RuntimeContext
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+from flink_tpu.state.redistribute import (ChannelStateRescaleError,
+                                          reject_channel_state)
+from flink_tpu.testing import chaos
+from flink_tpu.testing.chaos import (CrashOnceAt, FaultInjector, SlowConsumer,
+                                     SlowDisk)
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    chaos.uninstall()
+
+
+def _batch(*vals):
+    return RecordBatch({"v": np.asarray(vals, np.float64)})
+
+
+class _SumOp:
+    """Minimal stateful operator: sums the v column, records batch order."""
+
+    name = "sum"
+    forwards_watermarks = True
+    is_stateless = False
+    is_two_input = False
+
+    def open(self, ctx):
+        self.total = 0.0
+        self.seen = []
+
+    def process_batch(self, batch):
+        vals = np.asarray(batch.column("v"))
+        self.total += float(vals.sum())
+        self.seen.extend(float(v) for v in vals)
+        return []
+
+    def process_watermark(self, wm):
+        return []
+
+    def on_processing_time(self, ts):
+        return []
+
+    def end_input(self):
+        return [RecordBatch({"total": np.asarray([self.total])})]
+
+    def snapshot_state(self):
+        return {"total": self.total}
+
+    def restore_state(self, snap):
+        self.total = snap["total"]
+
+    def notify_checkpoint_complete(self, cid):
+        pass
+
+    def close(self):
+        pass
+
+
+class _Recorder(TaskListener):
+    def __init__(self):
+        self.acks = {}
+        self.declines = []
+        self.states = []
+
+    def task_state_changed(self, uid, idx, state, error):
+        self.states.append((state, error))
+
+    def acknowledge_checkpoint(self, cid, uid, idx, snap):
+        self.acks[cid] = snap
+
+    def decline_checkpoint(self, cid, uid, idx, error):
+        self.declines.append((cid, error))
+
+
+class _Out:
+    def __init__(self):
+        self.elements = []
+        self.channels = []
+
+    def emit(self, el):
+        self.elements.append(el)
+
+
+# ---------------------------------------------------------------------------
+# SlowConsumer schedule (chaos satellite)
+# ---------------------------------------------------------------------------
+
+def test_slow_consumer_is_seeded_and_bursty():
+    """Same seed => identical action sequence; stalls come in bursts of
+    the configured length; the flaky period is bounded by times."""
+    def actions(seed):
+        inj = FaultInjector(seed=seed)
+        inj.inject("p", SlowConsumer(max_s=0.0, min_s=0.0, p=0.2, burst=4,
+                                     times=60))
+        with chaos.installed(inj):
+            for _ in range(80):
+                inj.fire("p")
+        return inj.history("p")
+
+    h1, h2 = actions(5), actions(5)
+    assert h1 == h2, "same seed must reproduce the exact stall sequence"
+    assert actions(6) != h1
+    stalls = [i for i, a in enumerate(h1) if isinstance(a, tuple)]
+    assert stalls, "schedule never stalled"
+    # every stall belongs to a run of at least min(burst, remaining) length
+    runs, cur = [], []
+    for i, a in enumerate(h1[:60]):
+        if isinstance(a, tuple):
+            cur.append(i)
+        elif cur:
+            runs.append(cur)
+            cur = []
+    if cur:
+        runs.append(cur)
+    assert all(len(r) >= 4 for r in runs[:-1] or runs), \
+        f"stalls not bursty: run lengths {[len(r) for r in runs]}"
+    # bounded flaky period: nothing stalls past times
+    assert all(a == "ok" for a in h1[64:])
+
+
+def test_slow_consumer_channel_filter_scopes_stalls():
+    """A channel-scoped schedule only advances on matching channels —
+    other channels neither stall nor consume the firing counter."""
+    inj = FaultInjector(seed=3)
+    inj.inject("channel.recv", SlowConsumer(max_s=0.0, p=1.0, burst=2,
+                                            channel="a->b"))
+    with chaos.installed(inj):
+        inj.fire("channel.recv", channel="x->y")
+        inj.fire("channel.recv", channel="x->y")
+        assert inj.fired("channel.recv") == 0
+        inj.fire("channel.recv", channel="a->b[0]")
+        assert inj.fired("channel.recv") == 1
+
+
+def test_slow_consumer_stalls_local_channel_poll():
+    inj = FaultInjector(seed=4)
+    inj.inject("channel.recv", SlowConsumer(max_s=0.06, min_s=0.04, p=1.0,
+                                            burst=1, times=1))
+    ch = LocalChannel(4, name="a->b")
+    ch.put(_batch(1.0))
+    ch.put(_batch(2.0))
+    with chaos.installed(inj):
+        t0 = time.monotonic()
+        assert ch.poll() is not None      # firing 1: stalled
+        stalled = time.monotonic() - t0
+        t0 = time.monotonic()
+        assert ch.poll() is not None      # past times: fast
+        fast = time.monotonic() - t0
+    assert stalled >= 0.03
+    assert fast < 0.03
+
+
+# ---------------------------------------------------------------------------
+# channel-level barrier overtake + backpressure accounting
+# ---------------------------------------------------------------------------
+
+def test_take_until_barrier_extracts_prebarrier_elements():
+    ch = LocalChannel(16, name="c")
+    a, b, c = _batch(1.0), _batch(2.0), _batch(3.0)
+    ch.put(a)
+    ch.put(b)
+    ch.put(CheckpointBarrier(5, 0))
+    ch.put(c)
+    els, bar = ch.take_until_barrier(5)
+    # the consumed BARRIER element comes back (its is_savepoint flag
+    # matters to the caller), not just a found-bool
+    assert bar is not None and bar.checkpoint_id == 5
+    assert els == [a, b]
+    assert ch.depth() == 1 and ch.poll() is c
+    assert ch.announced_barrier() is None
+
+
+def test_take_until_barrier_without_barrier_takes_all_queued():
+    ch = LocalChannel(16, name="c")
+    a = _batch(1.0)
+    ch.put(a)
+    ch.put(EndOfInput())
+    els, bar = ch.take_until_barrier(5)
+    assert bar is None and els == [a]
+    assert isinstance(ch.poll(), EndOfInput)   # never extracts past EOI
+
+
+def test_channel_backpressured_time_accumulates():
+    ch = LocalChannel(1, name="c")
+    assert ch.put(_batch(1.0))
+    assert not ch.put(_batch(2.0), timeout_s=0.05)   # full: blocks, times out
+    assert ch.backpressured_ns >= 40_000_000
+    assert ch.depth() == 1 and ch.queued_bytes() > 0
+
+
+# ---------------------------------------------------------------------------
+# subtask-level: aligned-with-timeout escalation
+# ---------------------------------------------------------------------------
+
+def test_alignment_timeout_escalates_and_persists_inflight():
+    """Aligned start; the timer (clock seam) expires; the barrier overtakes:
+    snapshot at escalation, blocked-queue elements process post-snapshot,
+    later pre-barrier data on the laggard channel lands in channel state."""
+    ch0, ch1 = LocalChannel(16, "c0"), LocalChannel(16, "c1")
+    out = _Out()
+    rec = _Recorder()
+    op = _SumOp()
+    t = Subtask("v1", 0, op, [out], RuntimeContext(), rec, [ch0, ch1],
+                alignment_timeout_ms=80)
+    t.start()
+    ch0.put(_batch(1.0))
+    ch1.put(_batch(2.0))
+    time.sleep(0.1)
+    ch0.put(CheckpointBarrier(1, 0))     # alignment starts, ch0 blocks
+    time.sleep(0.03)                     # < timeout: still aligned
+    ch0.put(_batch(3.0))                 # post-barrier: alignment queue
+    time.sleep(0.25)                     # timer expired -> escalated
+    ch1.put(_batch(10.0))                # pre-barrier in-flight on ch1
+    time.sleep(0.1)
+    ch1.put(CheckpointBarrier(1, 0))     # alignment completes -> ack
+    time.sleep(0.1)
+    ch0.put(EndOfInput())
+    ch1.put(EndOfInput())
+    t.join()
+    assert t.state == TaskStates.FINISHED
+
+    snap = rec.acks[1]
+    # snapshot at ESCALATION: 1+2 only — the queued post-barrier 3.0 and
+    # the in-flight 10.0 are post-snapshot effects
+    assert snap["operator"]["total"] == 3.0
+    cs = snap["channel_state"]
+    assert cs["version"] == 1 and cs["unaligned"]
+    els = cs["elements"]
+    assert [i for i, _ in els] == [1]
+    assert float(np.asarray(els[0][1].column("v"))[0]) == 10.0
+    assert cs["persisted_bytes"] > 0
+    assert cs["overtaken_bytes"] >= element_bytes(_batch(3.0))
+    assert cs["alignment_ms"] >= 50
+    # everything was still processed exactly once by the RUNNING job
+    assert op.total == 16.0
+    # the barrier reached downstream (forwarded at escalation, before the
+    # laggard channel delivered its own)
+    kinds = [type(e).__name__ for e in out.elements]
+    assert "CheckpointBarrier" in kinds
+    # subtask-side accounting surfaces the same numbers
+    st = t.last_checkpoint_stats
+    assert st["unaligned"] and st["persisted_inflight_bytes"] > 0
+    assert t.alignment_queue_peak >= 1
+
+
+def test_pure_unaligned_mode_still_overtakes_at_first_arrival():
+    """Back-compat: unaligned=True == alignment_timeout_ms=0 — snapshot
+    and forward at FIRST barrier arrival."""
+    ch0, ch1 = LocalChannel(16, "c0"), LocalChannel(16, "c1")
+    out = _Out()
+    rec = _Recorder()
+    t = Subtask("v1", 0, _SumOp(), [out], RuntimeContext(), rec, [ch0, ch1],
+                unaligned=True)
+    assert t.alignment_timeout_ms == 0
+    t.start()
+    ch0.put(_batch(1.0))
+    time.sleep(0.05)
+    ch0.put(CheckpointBarrier(1, 0))
+    time.sleep(0.05)
+    ch1.put(_batch(10.0))
+    time.sleep(0.05)
+    ch1.put(CheckpointBarrier(1, 0))
+    ch0.put(EndOfInput())
+    ch1.put(EndOfInput())
+    t.join()
+    snap = rec.acks[1]
+    assert snap["operator"]["total"] == 1.0
+    assert snap["channel_state"]["unaligned"]
+    assert len(snap["channel_state"]["elements"]) == 1
+
+
+def test_escalation_extracts_barrier_queued_behind_backlog():
+    """The laggard channel's barrier is already QUEUED behind a backlog the
+    consumer has not drained: the overtake extracts the backlog into
+    channel state and consumes the barrier without waiting — checkpoint
+    completion independent of the backpressure."""
+    ch0, ch1 = LocalChannel(16, "c0"), LocalChannel(16, "c1")
+    out = _Out()
+    rec = _Recorder()
+    op = _SumOp()
+    t = Subtask("v1", 0, op, [out], RuntimeContext(), rec, [ch0, ch1],
+                alignment_timeout_ms=60)
+    # pre-fill ch1 BEFORE starting: backlog + barrier already queued
+    for v in (5.0, 6.0, 7.0, 8.0):
+        ch1.put(_batch(v))
+    ch1.put(CheckpointBarrier(1, 0))
+    # stall ch1's drain so the subtask cannot reach the barrier by polling
+    inj = FaultInjector(seed=9)
+    inj.inject("channel.recv", SlowConsumer(max_s=0.3, min_s=0.2, p=1.0,
+                                            burst=1000, channel="c1"))
+    with chaos.installed(inj):
+        t.start()
+        ch0.put(_batch(1.0))
+        time.sleep(0.1)
+        ch0.put(CheckpointBarrier(1, 0))   # alignment starts
+        deadline = time.monotonic() + 5
+        while 1 not in rec.acks and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert 1 in rec.acks, "overtake did not complete the checkpoint"
+        ch0.put(EndOfInput())
+        ch1.put(EndOfInput())
+        t.join()
+    snap = rec.acks[1]
+    cs = snap["channel_state"]
+    assert cs["unaligned"]
+    vals = [float(np.asarray(el.column("v"))[0]) for _i, el in cs["elements"]]
+    # the consistent-cut invariant: every pre-barrier element is EITHER in
+    # the operator snapshot or persisted as channel state, exactly once
+    assert snap["operator"]["total"] + sum(vals) == 27.0
+    assert op.total == 27.0                 # still processed exactly once
+
+
+def test_savepoint_barrier_never_escalates():
+    """A savepoint must stay ALIGNED even with escalation configured —
+    its snapshot has to remain rescalable/rewritable, and channel state is
+    neither (the drain-then-rescale contract depends on this)."""
+    ch0, ch1 = LocalChannel(16, "c0"), LocalChannel(16, "c1")
+    rec = _Recorder()
+    op = _SumOp()
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec, [ch0, ch1],
+                alignment_timeout_ms=50)
+    t.start()
+    ch0.put(_batch(1.0))
+    time.sleep(0.05)
+    ch0.put(CheckpointBarrier(1, 0, is_savepoint=True))
+    time.sleep(0.3)                      # far past the 50ms timeout
+    ch1.put(_batch(2.0))                 # still pre-barrier on ch1
+    time.sleep(0.05)
+    ch1.put(CheckpointBarrier(1, 0, is_savepoint=True))
+    time.sleep(0.1)
+    ch0.put(EndOfInput())
+    ch1.put(EndOfInput())
+    t.join()
+    snap = rec.acks[1]
+    cs = snap["channel_state"]
+    assert not cs["unaligned"] and cs["elements"] == [], \
+        "a savepoint escalated to unaligned"
+    # aligned semantics: ch1's pre-barrier element is IN the snapshot
+    assert snap["operator"]["total"] == 3.0
+
+
+def test_stale_barrier_does_not_abort_newer_alignment():
+    """The review-found supersession bug: checkpoint 1 escalates but its
+    laggard channel is so backpressured that 1 expires and the coordinator
+    triggers 2; the fast channel delivers barrier 2 (genuine supersession
+    of 1), and THEN the laggard finally drains its buried barrier 1.  The
+    stale barrier must be DROPPED — treating any id mismatch as
+    supersession would abort the healthy alignment of 2 and cascade
+    spurious declines."""
+    ch0, ch1 = LocalChannel(16, "c0"), LocalChannel(16, "c1")
+    rec = _Recorder()
+    op = _SumOp()
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec, [ch0, ch1],
+                alignment_timeout_ms=100)
+    t.start()
+    ch0.put(CheckpointBarrier(1, 0))     # alignment on 1 starts
+    time.sleep(0.3)                      # timer expires -> 1 ESCALATES
+    ch0.put(CheckpointBarrier(2, 0))     # coordinator expired 1 -> 2:
+    time.sleep(0.1)                      # genuine supersession aborts 1
+    assert [cid for cid, _ in rec.declines] == [1]
+    ch1.put(_batch(5.0))                 # pre-barrier data for 2 on ch1
+    ch1.put(CheckpointBarrier(1, 0))     # STALE barrier finally drains
+    ch1.put(CheckpointBarrier(2, 0))     # the real one completes 2
+    deadline = time.monotonic() + 10
+    while 2 not in rec.acks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert 2 in rec.acks, "stale barrier killed the healthy alignment"
+    # exactly one decline ever (the genuine supersession of 1) — the
+    # stale barrier caused no second abort
+    assert [cid for cid, _ in rec.declines] == [1]
+    # consistent cut for 2: the 5.0 is either in the operator snapshot or
+    # persisted as channel state, exactly once
+    snap = rec.acks[2]
+    cs_sum = sum(float(np.asarray(el.column("v")).sum())
+                 for _i, el in snap["channel_state"]["elements"])
+    assert snap["operator"]["total"] + cs_sum == 5.0
+    ch0.put(EndOfInput())
+    ch1.put(EndOfInput())
+    t.join()
+    assert t.state == TaskStates.FINISHED
+    assert op.total == 5.0
+
+
+def test_cluster_savepoint_stays_aligned_under_escalation():
+    """MiniCluster.savepoint() marks its barriers: even a job running with
+    alignment_timeout_ms produces an ALIGNED savepoint (empty channel
+    state) that the rescale guard accepts."""
+    env, sink, _ = _window_job(n=4000, batch_size=64)
+    storage = InMemoryCheckpointStorage(retain=5)
+    import threading as _threading
+
+    from flink_tpu.cluster.minicluster import MiniCluster
+    plan = env.get_stream_graph("sp-job").to_plan()
+    cluster = MiniCluster(checkpoint_storage=storage,
+                          alignment_timeout_ms=0)   # pure unaligned mode
+    result = {}
+
+    def run():
+        result["res"] = cluster.execute(plan, timeout_s=120)
+
+    th = _threading.Thread(target=run)
+    th.start()
+    time.sleep(0.15)
+    sp = cluster.savepoint()
+    th.join(timeout=120)
+    if sp is None:
+        pytest.skip("job finished before the savepoint could complete")
+    snap = storage.load(sp)
+    for uid, entry in snap.items():
+        if uid.startswith("__"):
+            continue
+        for sub in entry.get("subtasks", []):
+            cs = (sub or {}).get("channel_state")
+            if isinstance(cs, dict):
+                assert not cs["unaligned"] and cs["elements"] == []
+    reject_channel_state(snap, "rescale")   # must not raise
+
+
+# ---------------------------------------------------------------------------
+# bounded alignment queues
+# ---------------------------------------------------------------------------
+
+def test_alignment_queue_overflow_raises_classified_error():
+    """Cap hit while escalation is DISABLED: loud classified failure, not
+    unbounded growth; the pending checkpoint is declined first."""
+    ch0, ch1 = LocalChannel(32, "c0"), LocalChannel(32, "c1")
+    rec = _Recorder()
+    t = Subtask("v1", 0, _SumOp(), [_Out()], RuntimeContext(), rec,
+                [ch0, ch1], alignment_queue_max=4)
+    assert t.alignment_timeout_ms is None   # aligned, no escalation
+    t.start()
+    ch0.put(CheckpointBarrier(1, 0))        # ch0 blocks
+    time.sleep(0.05)
+    for k in range(8):                      # flood the blocked channel
+        ch0.put(_batch(float(k)))
+    t.join(timeout_s=10)
+    assert t.state == TaskStates.FAILED
+    err = next(e for s, e in rec.states if s == TaskStates.FAILED)
+    assert "AlignmentBufferOverflowError" in err
+    assert "alignment queue overflow" in err
+    assert rec.declines and rec.declines[0][0] == 1
+
+
+def test_alignment_queue_overflow_escalates_when_enabled():
+    """Same flood with a (long) alignment timeout configured: cap pressure
+    escalates to unaligned instead of failing (FLIP-182 size trigger)."""
+    ch0, ch1 = LocalChannel(32, "c0"), LocalChannel(32, "c1")
+    rec = _Recorder()
+    op = _SumOp()
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec,
+                [ch0, ch1], alignment_timeout_ms=60_000,
+                alignment_queue_max=4)
+    t.start()
+    ch0.put(CheckpointBarrier(1, 0))
+    time.sleep(0.05)
+    for k in range(8):
+        ch0.put(_batch(1.0))
+    time.sleep(0.2)
+    ch1.put(CheckpointBarrier(1, 0))
+    time.sleep(0.1)
+    ch0.put(EndOfInput())
+    ch1.put(EndOfInput())
+    t.join()
+    assert t.state == TaskStates.FINISHED
+    assert 1 in rec.acks and rec.acks[1]["channel_state"]["unaligned"]
+    assert op.total == 8.0
+
+
+def test_savepoint_queue_overflow_declines_savepoint_not_task():
+    """A user-triggered savepoint hitting the alignment-queue cap must not
+    kill the job: only the savepoint is declined (savepoint() reports
+    None); the task keeps running and a later checkpoint still works."""
+    ch0, ch1 = LocalChannel(32, "c0"), LocalChannel(32, "c1")
+    rec = _Recorder()
+    op = _SumOp()
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec,
+                [ch0, ch1], alignment_timeout_ms=100,
+                alignment_queue_max=4)
+    t.start()
+    ch0.put(CheckpointBarrier(1, 0, is_savepoint=True))
+    time.sleep(0.05)
+    for k in range(8):                      # flood the blocked channel
+        ch0.put(_batch(1.0))
+    time.sleep(0.3)
+    assert t.state == TaskStates.RUNNING, \
+        "savepoint overflow must not fail the task"
+    assert rec.declines and rec.declines[0][0] == 1
+    assert "savepoint" in rec.declines[0][1]
+    # a later (regular) checkpoint completes normally
+    ch0.put(CheckpointBarrier(2, 0))
+    ch1.put(CheckpointBarrier(2, 0))
+    deadline = time.monotonic() + 5
+    while 2 not in rec.acks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert 2 in rec.acks
+    ch0.put(EndOfInput())
+    ch1.put(EndOfInput())
+    t.join()
+    assert t.state == TaskStates.FINISHED
+    assert op.total == 8.0                  # released queue fully processed
+
+
+# ---------------------------------------------------------------------------
+# recovery: channel state replays before new input
+# ---------------------------------------------------------------------------
+
+def test_restore_replays_channel_state_before_new_input():
+    ch = LocalChannel(16, "c0")
+    rec = _Recorder()
+    op = _SumOp()
+    restore = {"operator": {"total": 3.0},
+               "channel_state": {"version": 1,
+                                 "elements": [(0, _batch(10.0)),
+                                              (0, _batch(11.0))],
+                                 "persisted_bytes": 64,
+                                 "overtaken_bytes": 64,
+                                 "alignment_ms": 1.0, "unaligned": True},
+               "valve": [0]}
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec, [ch])
+    t.start(restore)
+    ch.put(_batch(4.0))
+    ch.put(EndOfInput())
+    t.join()
+    assert t.state == TaskStates.FINISHED
+    # replay ORDER: persisted in-flight elements strictly before new input
+    assert op.seen == [10.0, 11.0, 4.0]
+    assert op.total == 3.0 + 10.0 + 11.0 + 4.0
+
+
+def test_unknown_channel_state_version_fails_loudly():
+    ch = LocalChannel(16, "c0")
+    rec = _Recorder()
+    restore = {"operator": {"total": 0.0},
+               "channel_state": {"version": 99, "elements": []}}
+    t = Subtask("v1", 0, _SumOp(), [_Out()], RuntimeContext(), rec, [ch])
+    t.start(restore)
+    t.join(timeout_s=10)
+    assert t.state == TaskStates.FAILED
+    err = next(e for s, e in rec.states if s == TaskStates.FAILED)
+    assert "channel-state" in err and "99" in err
+
+
+# ---------------------------------------------------------------------------
+# rescale: drain-then-rescale fails loudly
+# ---------------------------------------------------------------------------
+
+def test_rescale_rejects_nonempty_channel_state():
+    snap = {"__job__": {"checkpoint_id": 7},
+            "win": {"subtasks": [
+                {"operator": {}, "channel_state": {
+                    "version": 1, "elements": [(0, _batch(1.0))],
+                    "persisted_bytes": 24, "overtaken_bytes": 24,
+                    "alignment_ms": 5.0, "unaligned": True}}]}}
+    with pytest.raises(ChannelStateRescaleError, match="drain-then-rescale"):
+        reject_channel_state(snap, "rescale")
+
+
+def test_rescale_accepts_aligned_checkpoints():
+    # aligned checkpoints carry the v1 section with EMPTY elements — and
+    # legacy snapshots carry none at all; both must pass
+    snap = {"win": {"subtasks": [
+        {"operator": {}, "channel_state": {
+            "version": 1, "elements": [], "persisted_bytes": 0,
+            "overtaken_bytes": 0, "alignment_ms": 0.2,
+            "unaligned": False}},
+        {"operator": {}}]}}
+    reject_channel_state(snap, "rescale")   # no raise
+
+
+# ---------------------------------------------------------------------------
+# observability: job_status / gauges / REST panel
+# ---------------------------------------------------------------------------
+
+def _window_job(env_parallelism=2, n=6000, batch_size=64):
+    rng = np.random.default_rng(17)
+    keys = rng.integers(0, 13, n)
+    vals = np.ones(n, np.float64)
+    ts = np.sort(rng.integers(0, 3000, n))
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(env_parallelism)
+    sink = (env.from_collection(columns={"k": keys, "v": vals, "t": ts},
+                                batch_size=batch_size)
+            .assign_timestamps_and_watermarks(0, timestamp_column="t")
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(1000))
+            .sum("v").collect())
+    return env, sink, float(vals.sum())
+
+
+def _fire_digest(sink):
+    return sorted(tuple(sorted((k, float(v)) for k, v in r.items()))
+                  for r in sink.rows())
+
+
+def test_job_status_and_rest_panel_surface_backpressure():
+    env, sink, _total = _window_job()
+    res = env.execute_cluster(storage=InMemoryCheckpointStorage(retain=5),
+                              checkpoint_interval_ms=10,
+                              alignment_timeout_ms=5000)
+    assert res.state == TaskStates.FINISHED
+    status = env._last_cluster.job_status()
+    ck = status["checkpoints"]
+    for key in ("last_alignment_duration_ms", "last_overtaken_bytes",
+                "last_persisted_inflight_bytes", "unaligned_checkpoints"):
+        assert key in ck
+    # per-checkpoint history carries the alignment accounting
+    assert res.completed_checkpoints
+    st = status["checkpoint_stats"][-1]
+    for key in ("alignment_ms", "overtaken_bytes",
+                "persisted_inflight_bytes", "unaligned"):
+        assert key in st
+    # channel-consuming subtasks expose per-channel gauges
+    win = next(v for v in status["vertices"]
+               if not v["name"].startswith("collection-source"))
+    s0 = win["subtasks"][0]
+    assert s0["channels"] and {"name", "depth", "queued_bytes",
+                               "backpressured_ms"} <= set(s0["channels"][0])
+    assert "alignment_queued" in s0
+    # job-scope gauges registered (backpressure.* + lastCheckpoint*)
+    names = {k.split(".", 1)[1] if k.startswith("jobmanager.") else k
+             for k in env._last_cluster.metrics_registry.all_metrics()}
+    assert {"backpressure.total_backpressured_ms",
+            "backpressure.max_queue_depth",
+            "backpressure.alignment_queued_elements",
+            "lastCheckpointAlignmentTime",
+            "lastCheckpointPersistedInFlightBytes"} <= names
+    # the server-rendered panel renders channel rows + alignment summary
+    from flink_tpu.rest.views import backpressure_html
+    html = backpressure_html(status["vertices"], ck)
+    assert "bp-chan-table" in html and "bp-align-item" in html
+    assert 'data-metric="last_persisted_inflight_bytes"' in html
+
+
+# ---------------------------------------------------------------------------
+# acceptance: exactly-once under backpressure, aligned vs unaligned
+# ---------------------------------------------------------------------------
+
+def _run_backpressured(unfaulted=False, alignment_timeout_ms=None,
+                       checkpoint_timeout_s=60.0, seed=23,
+                       crash_at=None, restart_attempts=0):
+    """One keyed windowed run; SlowConsumer stalls source-0's channels into
+    the window subtasks and SlowDisk stalls the checkpoint store (unless
+    unfaulted).  Returns (result, digest, status, cluster, storage).
+
+    Timing margins (CI-safe by construction, not by luck): the stalled
+    channel drains one element per ~30-60ms sweep and holds a full
+    32-element credit queue, so an ALIGNED barrier needs >=1.4s of drain
+    to be reached — while the unaligned path acks in ~0.3s (100ms
+    announcement timeout + a few sweeps + the source's barrier-emit lag).
+    A 0.8s checkpoint timeout therefore separates the two modes with >=2x
+    margin on both sides."""
+    env, sink, _ = _window_job(n=12_000, batch_size=64)
+    inj = FaultInjector(seed=seed)
+    if not unfaulted:
+        # bursty drain stalls on ONE source's output channels: its barrier
+        # crawls behind the backlog while the sibling's arrives promptly
+        inj.inject("channel.recv",
+                   SlowConsumer(max_s=0.06, min_s=0.03, p=0.3, burst=40,
+                                channel="timestamps[0]->"))
+        inj.inject("checkpoint.store",
+                   SlowDisk(max_s=0.05, min_s=0.01, p=0.5, times=20))
+    if crash_at is not None:
+        inj.inject("subtask.run", CrashOnceAt(crash_at))
+    storage = InMemoryCheckpointStorage(retain=10)
+    with chaos.installed(inj):
+        res = env.execute_cluster(
+            storage=storage, checkpoint_interval_ms=30,
+            checkpoint_timeout_s=checkpoint_timeout_s,
+            alignment_timeout_ms=alignment_timeout_ms,
+            restart_attempts=restart_attempts,
+            tolerable_failed_checkpoints=-1, timeout_s=180)
+    return res, _fire_digest(sink), env._last_cluster.job_status(), \
+        env._last_cluster, storage
+
+
+def test_acceptance_unaligned_completes_where_aligned_expires():
+    """The ISSUE acceptance scenario: under SlowConsumer + SlowDisk
+    backpressure an unaligned-enabled job completes checkpoints that a
+    fully-aligned control run (same short timeout) expires — with fire
+    digests and job_status counters identical to an unfaulted aligned
+    run."""
+    # 1. unfaulted aligned baseline
+    res_base, digest_base, status_base, _c, _s = _run_backpressured(
+        unfaulted=True)
+    assert res_base.state == TaskStates.FINISHED
+
+    # 2. aligned CONTROL under backpressure: alignment stalls behind the
+    # slow-drained backlog, the short timeout expires the checkpoint
+    res_ctl, digest_ctl, status_ctl, _c2, _s2 = _run_backpressured(
+        alignment_timeout_ms=None, checkpoint_timeout_s=0.8)
+    assert res_ctl.state == TaskStates.FINISHED
+    assert status_ctl["checkpoints"]["failed_checkpoints"] >= 1, \
+        "the aligned control never expired a checkpoint"
+    assert status_ctl["checkpoints"]["last_failure_reason"] == "expired"
+
+    # 3. unaligned run, same timeout: the barrier overtakes the backlog
+    res_un, digest_un, status_un, cluster, storage = _run_backpressured(
+        alignment_timeout_ms=100, checkpoint_timeout_s=0.8)
+    assert res_un.state == TaskStates.FINISHED
+    assert res_un.completed_checkpoints, \
+        "unaligned run completed no checkpoint under backpressure"
+    stats = status_un["checkpoint_stats"]
+    assert any(s["unaligned"] for s in stats), \
+        "no checkpoint actually escalated to unaligned"
+    assert status_un["checkpoints"]["unaligned_checkpoints"] >= 1
+
+    # exactly-once: fire digests identical across all three runs
+    assert digest_un == digest_base
+    assert digest_ctl == digest_base
+
+    # job_status counters identical to the unfaulted aligned run
+    def counters(status):
+        return {v["name"]: (v["records_in"], v["records_out"])
+                for v in status["vertices"]}
+
+    assert counters(status_un) == counters(status_base)
+
+
+def test_acceptance_recovery_from_unaligned_checkpoint_exactly_once():
+    """Crash mid-run while unaligned checkpoints (with persisted in-flight
+    channel state) are the restore source: recovery replays the channel
+    state before new input and the fire digests still match the unfaulted
+    aligned run."""
+    res_base, digest_base, _st, _c, _s = _run_backpressured(unfaulted=True)
+    assert res_base.state == TaskStates.FINISHED
+
+    res, digest, status, cluster, storage = _run_backpressured(
+        alignment_timeout_ms=100, checkpoint_timeout_s=0.8,
+        crash_at=60, restart_attempts=4)
+    assert res.state == TaskStates.FINISHED
+    assert res.restarts >= 1, "the injected crash did not trigger failover"
+    # at least one STORED checkpoint carried persisted in-flight elements
+    # (so recovery exercised the channel-state replay path)
+    persisted = 0
+    for cid in res.completed_checkpoints:
+        snap = storage.load(cid)
+        if snap is None:
+            continue
+        for uid, entry in snap.items():
+            if uid.startswith("__"):
+                continue
+            for sub in entry.get("subtasks", []):
+                cs = (sub or {}).get("channel_state")
+                if isinstance(cs, dict):
+                    persisted += len(cs.get("elements", []))
+    assert persisted > 0, \
+        "no completed checkpoint persisted in-flight channel state"
+    assert digest == digest_base, "recovery broke exactly-once fire digests"
