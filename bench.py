@@ -48,6 +48,29 @@ from flink_tpu.utils.platform import honor_jax_platforms  # noqa: E402
 honor_jax_platforms()
 
 
+def _early_mesh_device_flags() -> None:
+    """``--mesh-devices N`` on a CPU target needs
+    ``--xla_force_host_platform_device_count=N`` BEFORE the first backend
+    init (argparse runs after module import, so peek at argv here) — the
+    laptop/CI recipe for exercising real multi-device sharding without a
+    pod (docs/operations.md "Multi-chip execution")."""
+    argv = sys.argv
+    n = 0
+    for i, a in enumerate(argv):
+        if a == "--mesh-devices" and i + 1 < len(argv):
+            n = int(argv[i + 1])
+        elif a.startswith("--mesh-devices="):
+            n = int(a.split("=", 1)[1])
+    if n > 1 and os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}")
+
+
+_early_mesh_device_flags()
+
+
 def _guard_wedged_accelerator(probe_timeout_s: int = 180,
                               retry_backoff_s: float = 20.0) -> None:
     """The tunnel transport can wedge PERMANENTLY (a SIGKILLed client's
@@ -125,7 +148,8 @@ def make_batches(n_records: int, n_keys: int, batch_size: int, window_ms: int,
 
 def _build_op(window_ms: int, emit_tier: str = "host",
               device_sync: str = "auto", paging_cap: int = 0,
-              pipeline_depth: int = 1, native_shards: int = 0):
+              pipeline_depth: int = 1, native_shards: int = 0,
+              mesh_devices: int = 0, key_capacity: int = 1 << 20):
     import jax.numpy as jnp
 
     from flink_tpu.core.functions import RuntimeContext, SumAggregator
@@ -137,10 +161,9 @@ def _build_op(window_ms: int, emit_tier: str = "host",
         from flink_tpu.state.paging import PagingConfig
         paging = PagingConfig(capacity=paging_cap)
         emit_tier = "device"   # paging pins the device tier
-    op = WindowAggOperator(
-        TumblingEventTimeWindows.of(window_ms), SumAggregator(jnp.float32),
+    kw = dict(
         key_column="k", value_column="v",
-        initial_key_capacity=1 << 20,
+        initial_key_capacity=key_capacity,
         emit_tier=emit_tier,
         snapshot_source="mirror" if emit_tier == "host" else "device",
         device_sync=device_sync if emit_tier == "host" else "scatter",
@@ -150,6 +173,19 @@ def _build_op(window_ms: int, emit_tier: str = "host",
         # across cores (--native-shards; 0 = auto)
         pipeline_depth=pipeline_depth,
         native_shards=native_shards)
+    if mesh_devices > 1:
+        # the mesh-sharded hot path: ONE logical operator over the chip
+        # mesh (parallel/mesh_runtime) — state in key-group-range blocks,
+        # records routed by on-device all_to_all, probe sharded per device
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.mesh_runtime import MeshWindowAggOperator
+        op = MeshWindowAggOperator(
+            TumblingEventTimeWindows.of(window_ms),
+            SumAggregator(jnp.float32), mesh=make_mesh(mesh_devices), **kw)
+    else:
+        op = WindowAggOperator(
+            TumblingEventTimeWindows.of(window_ms),
+            SumAggregator(jnp.float32), **kw)
     op.open(RuntimeContext())
     return op
 
@@ -199,7 +235,8 @@ def _fire_digests(elements):
 def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
                    emit_tier: str = "host", device_sync: str = "auto",
                    timed_passes: int = 3, pipeline_depth: int = 1,
-                   native_shards: int = 0):
+                   native_shards: int = 0, mesh_devices: int = 0,
+                   key_capacity: int = 1 << 20):
     """Timed checkpointable run.  Returns (records/sec, windows fired,
     snapshots taken, phase dict, mid-run snapshot + its batch index +
     post-checkpoint digests for the replay check)."""
@@ -241,8 +278,10 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
         phases = dict(op.phase_ns)
         phases["snapshot_total"] = snap_ns
         phases["elapsed"] = int(elapsed * 1e9)
+        shard_ns = {k: [int(x) for x in v.tolist()]
+                    for k, v in op.phase_shard_ns.items()}
         return (n / elapsed, fired, snaps, mid, digests,
-                phases, dict(op.phase_bytes))
+                phases, dict(op.phase_bytes), shard_ns)
 
     # warmup: cover the full key-capacity ladder so the timed run never
     # compiles — one synthetic pass inserts every key, then real batches.
@@ -256,7 +295,8 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
              np.zeros(min(bsz, nk - lo), np.int64))
             for lo in range(0, nk, bsz)]
     op = _build_op(window_ms, emit_tier, device_sync,
-                   pipeline_depth=pipeline_depth, native_shards=native_shards)
+                   pipeline_depth=pipeline_depth, native_shards=native_shards,
+                   mesh_devices=mesh_devices, key_capacity=key_capacity)
     run(op, warm + batches[:2] + batches[-1:])
     # best of three timed passes: this host suffers EPISODIC multi-second
     # slowdowns (shared-core tunnel client; measured ±70% swings on
@@ -275,13 +315,14 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
             gc.enable()
         if best is None or res[0] > best[0]:
             best = res
-    rps, fired, snaps, mid, digests, phases, bytes_ = best
-    return rps, fired, snaps, mid, digests, phases, bytes_, op
+    rps, fired, snaps, mid, digests, phases, bytes_, shard_ns = best
+    return (rps, fired, snaps, mid, digests, phases, bytes_, shard_ns, op)
 
 
 def replay_check(batches, window_ms: int, mid, digests,
                  emit_tier: str = "host", device_sync: str = "auto",
-                 pipeline_depth: int = 1, native_shards: int = 0) -> bool:
+                 pipeline_depth: int = 1, native_shards: int = 0,
+                 mesh_devices: int = 0, key_capacity: int = 1 << 20) -> bool:
     """Exactly-once evidence: restore the mid-run snapshot into a FRESH
     operator, replay the remaining batches, and require the identical
     per-window fire digests."""
@@ -291,7 +332,8 @@ def replay_check(batches, window_ms: int, mid, digests,
 
     i, snap = mid
     op = _build_op(window_ms, emit_tier, device_sync,
-                   pipeline_depth=pipeline_depth, native_shards=native_shards)
+                   pipeline_depth=pipeline_depth, native_shards=native_shards,
+                   mesh_devices=mesh_devices, key_capacity=key_capacity)
     op.restore_state(snap)
     out = []
     for keys, vals, ts in batches[i + 1:]:
@@ -1064,6 +1106,117 @@ def run_checkpoint_backpressure(interval_ms: int, budget_ms: float,
     }
 
 
+def run_mesh_bench(args) -> dict:
+    """``--mesh-devices N``: the sharded hot path as ONE logical operator
+    over an N-device mesh (forced host devices on CPU — see
+    ``_early_mesh_device_flags``).  Reports records/sec/**pod** alongside
+    records/sec/chip, the per-shard probe_mirror breakdown (the wall
+    decomposed into N independent probes), and the restore+replay digest
+    check — the multi-chip twin of the headline run."""
+    import jax
+
+    D = args.mesh_devices
+    avail = len(jax.devices())
+    if avail < D:
+        return {"metric": "records/sec/pod (mesh sharded hot path)",
+                "ok": False,
+                "error": f"{D} mesh devices requested, {avail} visible "
+                         f"(CPU targets force host devices automatically; "
+                         f"was JAX initialized before the flag?)"}
+    n_records = args.records or (1 << 18 if args.smoke else 1 << 22)
+    n_keys = min(args.keys, n_records)
+    batches = make_batches(n_records, n_keys, args.batch_size,
+                           args.window_ms)
+    (rps, fired, snaps, mid, digests, phases, bytes_, shard_ns,
+     op) = run_tpu_native(
+        batches, args.window_ms, args.checkpoint_every,
+        emit_tier=args.emit_tier, device_sync=args.device_sync,
+        timed_passes=2 if args.smoke else 3,
+        pipeline_depth=args.pipeline_depth,
+        native_shards=args.native_shards, mesh_devices=D,
+        # size the ring to the workload so the key-group-range blocks are
+        # POPULATED on every device (capacity-sized blocks would park all
+        # live rows on shard 0 at small key counts)
+        key_capacity=n_keys)
+    replay_ok = replay_check(batches, args.window_ms, mid, digests,
+                             args.emit_tier, args.device_sync,
+                             pipeline_depth=args.pipeline_depth,
+                             native_shards=args.native_shards,
+                             mesh_devices=D, key_capacity=n_keys)
+    ns = phases.pop("elapsed", 1)
+    per_shard_ms = [round(v / 1e6, 1)
+                    for v in shard_ns.get("probe_mirror", [])]
+    detail = {
+        "mesh_devices": D,
+        "platform": jax.devices()[0].platform,
+        "phases_ms": {k: round(v / 1e6, 1)
+                      for k, v in sorted(phases.items())},
+        "probe_mirror_shard_ms": per_shard_ms,
+        "elapsed_ms": round(ns / 1e6, 1),
+        "h2d_mb": round(bytes_.get("h2d", 0) / 1e6, 2),
+        "windows_fired": fired,
+        "snapshots_in_timed_run": snaps,
+        "restore_replay_ok": replay_ok,
+        "emit_tier": args.emit_tier,
+        "device_sync": op.device_sync_mode,
+        # --mesh-devices 1 is the single-chip leg of the comparison: the
+        # plain operator has no shard layout, its "manifest" is one block
+        "shard_manifest": ([
+            {"shard": d, "rows": list(op.shard_layout().row_range(d))}
+            for d in range(D)] if hasattr(op, "shard_layout")
+            else [{"shard": 0, "rows": [0, op._K]}]),
+    }
+    return {
+        "metric": f"records/sec/pod (1M-key tumbling sum, "
+                  f"{detail['platform']} mesh x{D}, checkpointing every "
+                  f"{args.checkpoint_every} batches)",
+        "value": round(rps, 1),
+        "unit": "records/sec",
+        "records_per_sec_pod": round(rps, 1),
+        "records_per_sec_chip": round(rps / D, 1),
+        "ok": replay_ok,
+        "details": detail,
+    }
+
+
+def check_mesh_budget(result: dict, budget: dict) -> list:
+    """``--mesh-devices`` result vs the BENCH_BUDGET ``mesh_cpu`` section:
+    a pod-throughput floor, per-phase ceilings, and a per-shard probe
+    share ceiling — the probe_mirror wall must actually be DECOMPOSED
+    (one shard hogging the whole wall means the sharding is fictional)."""
+    viol = []
+    if "error" in result:
+        return [result["error"]]
+    floor = budget.get("min_rps_pod")
+    if floor is not None and result["records_per_sec_pod"] < floor:
+        viol.append(f"rec/s/pod {result['records_per_sec_pod']:.0f} < "
+                    f"floor {floor:.0f}")
+    phases = result["details"]["phases_ms"]
+    for name, cap in budget.get("max_phase_ms", {}).items():
+        got = phases.get(name)
+        if got is not None and got > cap:
+            viol.append(f"phase {name} {got}ms > budget {cap}ms")
+    share_cap = budget.get("max_shard_probe_share")
+    per_shard = result["details"].get("probe_mirror_shard_ms") or []
+    live = [v for v in per_shard if v > 0]
+    # exempt single-live-shard runs: EXACT zeros only come from the serial
+    # C pass (sub-threshold batches write shard_ns[0]=total, rest 0 by
+    # contract).  A genuinely parked fold cannot masquerade: in the
+    # sharded pass every shard scans all records (the ownership check is
+    # per-record), so even a shard owning zero slots reports nonzero ns
+    # and the share check sees it
+    if share_cap is not None and len(live) > 1:
+        share = max(live) / sum(live)
+        if share > share_cap:
+            viol.append(
+                f"probe shard share {share:.0%} > ceiling {share_cap:.0%} "
+                f"(per-shard ms {per_shard}: the probe_mirror wall is not "
+                f"decomposed)")
+    if not result.get("ok"):
+        viol.append("restore/replay check failed")
+    return viol
+
+
 def check_budget(result: dict, budget: dict) -> list:
     """Compare one bench result against a BENCH_BUDGET.json section; returns
     human-readable violations (empty = pass).  The in-repo regression gate
@@ -1133,6 +1286,17 @@ def main():
                          "to PATH as JSON; the device step is additionally "
                          "annotated for jax.profiler traces "
                          "('window_agg.device_step')")
+    ap.add_argument("--mesh-devices", type=int, default=0, metavar="N",
+                    help="run the SHARDED hot path as one logical window "
+                         "operator over an N-device mesh (state in "
+                         "key-group-range blocks, records routed by an "
+                         "on-device all_to_all, probe sharded per device) "
+                         "and report records/sec/pod + records/sec/chip + "
+                         "the per-shard probe breakdown.  On CPU targets "
+                         "the N host devices are forced automatically "
+                         "(--xla_force_host_platform_device_count); with "
+                         "--check the result gates against the "
+                         "BENCH_BUDGET.json mesh_cpu section")
     ap.add_argument("--paging-cap", type=int, default=0,
                     help="also run one cold-key-paging pass (device tier, "
                          "K_cap=N < key count) and report rps + "
@@ -1184,6 +1348,35 @@ def main():
                   file=sys.stderr)
         sys.exit(0 if result["ok"] else 1)
 
+    if args.mesh_devices:
+        result = run_mesh_bench(args)
+        print(json.dumps(result))
+        print(f"# details: {json.dumps(result.get('details', {}))}",
+              file=sys.stderr)
+        if args.check:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BUDGET.json")
+            with open(path) as f:
+                budgets = json.load(f)
+            import jax
+            tier = ("mesh_cpu" if jax.devices()[0].platform == "cpu"
+                    else "mesh")
+            budget = budgets.get(tier)
+            if budget is not None and args.smoke:
+                # smoke sizes are one batch of fixed costs: the structural
+                # checks (shard share, phases, replay) still gate, the
+                # full-run pod floor does not
+                budget = {k: v for k, v in budget.items()
+                          if k != "min_rps_pod"}
+            # no budget section for this backend: the correctness checks
+            # (restore/replay) still gate — a digest mismatch must never
+            # exit 0 just because no perf floor is configured
+            viol = check_mesh_budget(result, budget or {})
+            for v in viol:
+                print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
+            sys.exit(1 if viol else 0)
+        sys.exit(0 if result.get("ok") else 1)
+
     if args.config != 2:
         result = CONFIG_RUNNERS[args.config](args.smoke)
         print(json.dumps(result))
@@ -1209,7 +1402,7 @@ def main():
         # probe counterproductive (see _pick_native_shards)
         args.native_shards = _pick_native_shards()
 
-    (tpu_rps, tpu_fired, snaps, mid, digests, phases, bytes_,
+    (tpu_rps, tpu_fired, snaps, mid, digests, phases, bytes_, _shard_ns,
      op) = run_tpu_native(batches, args.window_ms, args.checkpoint_every,
                           args.emit_tier, args.device_sync,
                           pipeline_depth=args.pipeline_depth,
@@ -1243,7 +1436,7 @@ def main():
     # so the cost of per-batch device sync on this link is on the record
     scatter_cmp = None
     if op.device_sync_mode == "deferred" and not args.smoke:
-        s_rps, _f, _s, _m, _d, s_phases, s_bytes, _op2 = run_tpu_native(
+        s_rps, _f, _s, _m, _d, s_phases, s_bytes, _sn, _op2 = run_tpu_native(
             batches, args.window_ms, args.checkpoint_every,
             args.emit_tier, device_sync="scatter", timed_passes=1,
             pipeline_depth=args.pipeline_depth,

@@ -42,6 +42,23 @@ import numpy as np
 SCATTER_UFUNCS = {"add": np.add, "min": np.minimum, "max": np.maximum}
 
 
+def canonical_acc_dtype(dtype) -> jnp.dtype:
+    """The dtype the BACKEND will actually store for an accumulator leaf:
+    float64/int64 requests canonicalize to 32-bit when jax x64 is off.
+    Aggregator constructors resolve through this instead of carrying the
+    raw request, so ``identity()`` never asks ``jnp.zeros`` for a dtype the
+    backend truncates (the per-call float64 UserWarning that spammed every
+    MULTICHIP tail).  The numeric result is unchanged — the backend stored
+    32 bits either way; the host mirror keeps its own f64/i64 twins."""
+    return jnp.dtype(jax.dtypes.canonicalize_dtype(np.dtype(dtype)))
+
+
+def default_float_dtype() -> jnp.dtype:
+    """Widest float the backend supports (f64 under x64, else f32) — the
+    default for datastream ``.sum()``/``.min()``/``.max()`` aggregates."""
+    return canonical_acc_dtype(np.float64)
+
+
 class Function:
     """Marker base for all user functions (``Function.java``)."""
 
@@ -284,7 +301,7 @@ class SumAggregator(ReduceFunction):
     """``.sum()`` (SumAggregator.java analog): elementwise sum, identity 0."""
 
     def __init__(self, dtype=jnp.float32):
-        self._dtype = jnp.dtype(dtype)
+        self._dtype = canonical_acc_dtype(dtype)
 
     def identity(self):
         return jnp.zeros((), self._dtype)
@@ -298,7 +315,7 @@ class SumAggregator(ReduceFunction):
 
 class MinAggregator(ReduceFunction):
     def __init__(self, dtype=jnp.float32):
-        self._dtype = jnp.dtype(dtype)
+        self._dtype = canonical_acc_dtype(dtype)
 
     def identity(self):
         if jnp.issubdtype(self._dtype, jnp.integer):
@@ -314,7 +331,7 @@ class MinAggregator(ReduceFunction):
 
 class MaxAggregator(ReduceFunction):
     def __init__(self, dtype=jnp.float32):
-        self._dtype = jnp.dtype(dtype)
+        self._dtype = canonical_acc_dtype(dtype)
 
     def identity(self):
         if jnp.issubdtype(self._dtype, jnp.integer):
@@ -355,7 +372,7 @@ class AvgAggregator(AggregateFunction):
     reference javadoc example (AggregateFunction.java:60-100)."""
 
     def __init__(self, dtype=jnp.float32):
-        self._dtype = jnp.dtype(dtype)
+        self._dtype = canonical_acc_dtype(dtype)
 
     def identity(self):
         return {"sum": jnp.zeros((), self._dtype), "count": jnp.zeros((), jnp.int32)}

@@ -136,6 +136,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.wm_probe_update.restype = None
     lib.wm_probe_update.argtypes = [vp, vp, vp, i64, vp, u8p, vp, i64, vp,
                                     i64, i32, i32]
+    lib.wm_probe_update2.restype = None
+    lib.wm_probe_update2.argtypes = [vp, vp, vp, i64, vp, u8p, vp, i64, vp,
+                                     i64, i32, i32, i64, vp]
     lib.fn_hw_threads.restype = i32
     lib.fn_hw_threads.argtypes = []
     lib.wm_fire.restype = i64

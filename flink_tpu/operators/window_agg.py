@@ -278,6 +278,16 @@ class _PhaseTimer:
 class WindowAggOperator(StreamOperator):
     """Keyed window aggregation: ``key_by(key_col).window(assigner).aggregate(agg)``."""
 
+    #: sharded-state capability flags, overridden by the mesh subclass
+    #: (``parallel/mesh_runtime.MeshWindowAggOperator``): the base operator
+    #: treats ``sharding is not None`` as an opaque placement hint and
+    #: disables the host emit tier / paging / degraded-tier migration; the
+    #: mesh operator owns a key-group-range state LAYOUT (state/shard_layout)
+    #: and runs all three per-shard.
+    _SHARDED_HOST_TIER = False
+    _SHARDED_PAGING = False
+    _SHARDED_DEGRADE = False
+
     def __init__(
         self,
         assigner: WindowAssigner,
@@ -393,7 +403,7 @@ class WindowAggOperator(StreamOperator):
         self.paging = paging
         self._pager = None
         if paging is not None:
-            if sharding is not None:
+            if sharding is not None and not self._SHARDED_PAGING:
                 raise ValueError("paging requires unsharded state (shard "
                                  "first, page within each shard)")
             if isinstance(assigner, GlobalWindows) \
@@ -425,7 +435,7 @@ class WindowAggOperator(StreamOperator):
         #   an accelerator (on CPU there is no transfer cost to dodge).
         host_capable = (
             agg.supports_host_emit()
-            and sharding is None
+            and (sharding is None or self._SHARDED_HOST_TIER)
             and self.trigger.fires_on_time
             and not self.trigger.fires_on_count
             and not isinstance(assigner, GlobalWindows))
@@ -470,7 +480,8 @@ class WindowAggOperator(StreamOperator):
             raise ValueError(f"device_sync must be auto|scatter|deferred, "
                              f"got {device_sync!r}")
         if device_sync == "deferred":
-            if emit_tier != "host" or sharding is not None:
+            if emit_tier != "host" or (sharding is not None
+                                       and not self._SHARDED_HOST_TIER):
                 raise ValueError(
                     "device_sync='deferred' requires the unsharded host emit "
                     "tier (the host mirror must be the authoritative copy)")
@@ -501,16 +512,21 @@ class WindowAggOperator(StreamOperator):
         #: bytes
         self.phase_ns: Dict[str, int] = {}
         self.phase_bytes: Dict[str, int] = {}
+        #: per-shard phase accounting: phase name -> int64[n_shards] ns,
+        #: filled when the fused probe runs sharded with a timing buffer
+        #: (the mesh runtime's per-shard probe breakdown; empty otherwise)
+        self.phase_shard_ns: Dict[str, np.ndarray] = {}
 
         # ring geometry — P must exceed the live pane span (window length in
         # panes + out-of-orderness + lateness retention)
         self._P = _next_pow2(max(initial_panes, 2 * assigner.panes_per_window))
         if paging is not None:
             # paged: K_cap is the FIXED resident capacity — the ring never
-            # grows with key cardinality (that is the whole point)
+            # grows with key cardinality (that is the whole point).  The
+            # DevicePager itself is created below, AFTER the shard-count
+            # divisibility rounding: pager.K must equal the final ring
+            # capacity or row assignment and restore overflow
             self._K = _next_pow2(paging.capacity)
-            from flink_tpu.state.paging import DevicePager
-            self._pager = DevicePager(paging, self.spec, self._K)
         else:
             self._K = _next_pow2(initial_key_capacity)
 
@@ -527,6 +543,9 @@ class WindowAggOperator(StreamOperator):
             nsh = max(len(sharding.mesh.devices.reshape(-1))
                       if hasattr(sharding, "mesh") else 1, 1)
             self._K = self._K * nsh // math.gcd(self._K, nsh)
+        if paging is not None:
+            from flink_tpu.state.paging import DevicePager
+            self._pager = DevicePager(paging, self.spec, self._K)
         self.key_index: Optional[KeyIndex | ObjectKeyIndex] = None
         self._leaves = None          # tuple of [K, P, *leaf] device arrays
         self._counts = None          # int32 [K, P]
@@ -609,6 +628,8 @@ class WindowAggOperator(StreamOperator):
         """Rescale a snapshot across key-group ranges
         (``StateAssignmentOperation.reDistributeKeyedStates`` analog)."""
         from flink_tpu.state.redistribute import split_keyed_snapshot
+        from flink_tpu.state.shard_layout import densify_keyed_snapshot
+        snap = densify_keyed_snapshot(snap)  # mesh per-shard slice format
         snap, extra = WindowAggOperator._pack_baselines(snap)
         parts = split_keyed_snapshot(snap, WindowAggOperator.ROW_FIELDS + extra,
                                      max_parallelism, new_parallelism)
@@ -620,6 +641,8 @@ class WindowAggOperator(StreamOperator):
         parts must share pane progress — true for snapshots taken at one
         barrier, where every subtask saw the same watermark."""
         from flink_tpu.state.redistribute import merge_keyed_snapshots
+        from flink_tpu.state.shard_layout import densify_keyed_snapshot
+        snaps = [densify_keyed_snapshot(s) for s in snaps]
         live = [s for s in snaps if "panes" in s]
         for s in live[1:]:
             if not np.array_equal(s["panes"], live[0]["panes"]):
@@ -666,6 +689,7 @@ class WindowAggOperator(StreamOperator):
         self._proc_time = LONG_MIN
         self.phase_ns = {}
         self.phase_bytes = {}
+        self.phase_shard_ns = {}
         self._device_stale = False  # resolved sync mode survives the reset
         self._degraded = False      # fresh state restores on the device
         with self._tier_lock:
@@ -735,6 +759,26 @@ class WindowAggOperator(StreamOperator):
             # lose with extra shards — calibrated_shards A/Bs it)
             self._nm_shards = self.native_shards or calibrated_shards()
 
+    def _probe_shards(self):
+        """(shards, shard_div, shard_ns) for the fused native probe:
+        shard count, contiguous-range ownership divisor (0 = slot %% S
+        classes), and an optional int64 per-shard timing buffer.  The mesh
+        subclass aligns these with the device mesh (shard t owns the
+        key-group range whose state block lives on device t) and collects
+        the per-shard breakdown."""
+        return self._nm_shards, 0, None
+
+    def _record_shard_ns(self, phase: str, shard_ns) -> None:
+        if shard_ns is None:
+            return
+        acc = self.phase_shard_ns.get(phase)
+        if acc is None or acc.size < shard_ns.size:
+            grown = np.zeros(shard_ns.size, np.int64)
+            if acc is not None:
+                grown[:acc.size] = acc
+            acc = self.phase_shard_ns[phase] = grown
+        acc[:shard_ns.size] += shard_ns
+
     # ------------------------------------------------------------- pipeline
     def _pipe_active(self) -> bool:
         """Pipelining applies to the time-triggered hot path only: count
@@ -773,7 +817,8 @@ class WindowAggOperator(StreamOperator):
         if self.device_sync_mode is not None:
             return self.device_sync_mode
         if (self.device_sync == "scatter" or self.emit_tier != "host"
-                or self.sharding is not None
+                or (self.sharding is not None
+                    and not self._SHARDED_HOST_TIER)
                 or self.snapshot_source != "mirror"):
             self.device_sync_mode = "scatter"
         elif self.device_sync == "deferred":
@@ -850,6 +895,16 @@ class WindowAggOperator(StreamOperator):
             jnp.broadcast_to(jnp.asarray(init, l.dtype), l.shape)
             .at[:rows, slots].set(col, mode="drop")
             for l, init, col in zip(leaves, self.spec.leaf_inits, leaf_cols))
+        if self.sharding is not None:
+            # the refresh must hand back PRE-PARTITIONED state (out
+            # shardings == the update step's in shardings): without the
+            # constraint XLA commits the scatter of replicated host
+            # columns onto one device and the next dispatch pays a
+            # reshard (the compile-once smoke's failure mode)
+            new_counts = jax.lax.with_sharding_constraint(new_counts,
+                                                          self.sharding)
+            new_leaves = tuple(jax.lax.with_sharding_constraint(
+                l, self.sharding) for l in new_leaves)
         return new_leaves, new_counts
 
     def device_refresh(self) -> None:
@@ -1471,9 +1526,12 @@ class WindowAggOperator(StreamOperator):
             with self._phase("probe_mirror"):
                 lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
                     self.agg.host_lift(values))]
+                nshards, shard_div, shard_ns = self._probe_shards()
                 if sync == "deferred":
                     slots = self._nm.probe_update(keys, panes, lifted,
-                                                  shards=self._nm_shards)
+                                                  shards=nshards,
+                                                  shard_div=shard_div,
+                                                  shard_ns=shard_ns)
                 else:
                     # the C pass writes flat ids + padding tail straight
                     # into the reusable staging buffer — dispatch-ready
@@ -1483,8 +1541,10 @@ class WindowAggOperator(StreamOperator):
                     slots = self._nm.probe_update(
                         keys, panes, lifted, pane_mod=self._P,
                         flat_out=staging.flat, flat_fill=int(_PAD_ID),
-                        shards=self._nm_shards)
+                        shards=nshards, shard_div=shard_div,
+                        shard_ns=shard_ns)
                     flat_ready = True
+                self._record_shard_ns("probe_mirror", shard_ns)
         else:
             with self._phase("probe"):
                 slots = self.key_index.lookup_or_insert(keys)
@@ -1574,7 +1634,9 @@ class WindowAggOperator(StreamOperator):
             if self._nm is None:  # native path already folded in probe_mirror
                 with self._phase("mirror"):
                     self._vmirror_update(slots, panes, values)
-        elif self.sharding is None:
+        elif self.sharding is None or self._pager is not None:
+            # paged mesh state keeps the emit mirror too: the gather fire
+            # and spilled-key fire both index it (gid-invariant host state)
             uniq_panes = np.unique(panes)
             if uniq_panes.size == 1:
                 self._mirror_mark(int(uniq_panes[0]), slots)
@@ -1632,7 +1694,8 @@ class WindowAggOperator(StreamOperator):
         Operators with no host twin tier (no numpy twins, sharded state,
         count triggers) re-raise — the task fails and the normal restart
         strategy recovers it from the last checkpoint instead."""
-        if (not self.agg.supports_host_emit() or self.sharding is not None
+        if (not self.agg.supports_host_emit()
+                or (self.sharding is not None and not self._SHARDED_DEGRADE)
                 or self.trigger.fires_on_count
                 or isinstance(self.assigner, GlobalWindows)):
             raise err
@@ -1995,7 +2058,14 @@ class WindowAggOperator(StreamOperator):
         # skip windows entirely outside retained panes
         if last < self.pane_base or first > self.max_pane:
             return []
-        if self.sharding is None and self.key_index is not None:
+        # mirror-indexed fires serve unsharded state AND sharded state whose
+        # host-side mirrors are maintained (mesh host tier: the value mirror
+        # is gid-indexed and mesh-size independent; mesh paged state: the
+        # emit mirror + spill maps drive the gather/spilled fire)
+        mirror_fire = self.key_index is not None and (
+            self.sharding is None or self.emit_tier == "host"
+            or self._pager is not None)
+        if mirror_fire:
             # clip to retained panes: expired slots are identity on device,
             # and the mirror only tracks live panes anyway
             panes = np.arange(max(first, self.pane_base),
@@ -2539,6 +2609,12 @@ class WindowAggOperator(StreamOperator):
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         self.flush_pipeline()
+        # mesh snapshots arrive as per-shard slices with key-group-range
+        # manifests (state/shard_layout): merge to the dense gid-indexed
+        # layout first — restore at ANY mesh size (1 included) re-slices
+        # by the CURRENT operator's layout, not the writer's
+        from flink_tpu.state.shard_layout import densify_keyed_snapshot
+        snap = densify_keyed_snapshot(snap)
         # restores land on the device tier; if the process-wide monitor is
         # still quarantined, the first dispatch re-quarantines and the
         # operator migrates again (the snapshot format is tier-agnostic)

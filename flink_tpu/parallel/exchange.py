@@ -19,15 +19,56 @@ dropped by downstream scatters.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from flink_tpu.parallel.mesh import KG_AXIS
+from flink_tpu.parallel.mesh import KG_AXIS, shard_map_compat
+
+
+def bucket_plan(dest: jnp.ndarray, num_shards: int, cap: int):
+    """The shared bucketing plan of every keyed exchange: STABLE-sort local
+    rows by destination shard and compute each row's flat position in the
+    ``[num_shards, cap]`` send buckets.
+
+    Returns ``(order, flat, valid_src)``: ``order`` is the stable row
+    permutation, ``flat[i]`` the bucket cell of sorted row ``i`` (or the
+    ``num_shards * cap`` drop sentinel once a destination's bucket is
+    full), ``valid_src`` the per-sorted-row in-capacity mask.  Stability
+    matters for more than determinism: records of one key keep their batch
+    order through the exchange, which is what makes the sharded
+    scatter-combine BIT-identical to the single-chip fold (same per-cell
+    accumulation order) at any mesh size."""
+    B = dest.shape[0]
+    order = jnp.argsort(dest, stable=True)
+    sdest = dest[order]
+    # position of each row within its destination's bucket
+    idx_in_dest = jnp.arange(B) - jnp.searchsorted(sdest, sdest, side="left")
+    valid_src = idx_in_dest < cap
+    flat = jnp.where(valid_src, sdest * cap + idx_in_dest, num_shards * cap)
+    return order, flat, valid_src
+
+
+def bucket_rows(a: jnp.ndarray, order: jnp.ndarray, flat: jnp.ndarray,
+                num_shards: int, cap: int, fill) -> jnp.ndarray:
+    """Place one row array into its ``[num_shards, cap, ...]`` send buckets
+    under a :func:`bucket_plan`; unfilled cells carry ``fill`` (an id the
+    receiving scatter drops, or a neutral value)."""
+    buf = jnp.full((num_shards * cap,) + a.shape[1:], fill, a.dtype)
+    return buf.at[flat].set(a[order], mode="drop").reshape(
+        (num_shards, cap) + a.shape[1:])
+
+
+def all_to_all_rows(bucketed: jnp.ndarray) -> jnp.ndarray:
+    """The keyed exchange collective: rotate ``[D, cap, ...]`` send buckets
+    over the mesh axis so row ``d`` of the result is what device ``d`` sent
+    to THIS device — the record→owning-shard route on ICI, replacing the
+    host-channel key-shuffle hop (``NettyMessage.java`` analog).  Must run
+    inside ``shard_map`` over :data:`~flink_tpu.parallel.mesh.KG_AXIS`."""
+    return jax.lax.all_to_all(bucketed, KG_AXIS, split_axis=0,
+                              concat_axis=0, tiled=True)
 
 
 def _bucket_local(dest: jnp.ndarray, leaves: Tuple[jnp.ndarray, ...],
@@ -37,23 +78,13 @@ def _bucket_local(dest: jnp.ndarray, leaves: Tuple[jnp.ndarray, ...],
     Returns (bucketed_leaves, valid mask [num_shards, cap], overflow count).
     Rows beyond ``cap`` for a destination overflow (counted, not sent).
     """
-    B = dest.shape[0]
-    order = jnp.argsort(dest)
-    sdest = dest[order]
-    # position of each row within its destination's bucket
-    idx_in_dest = jnp.arange(B) - jnp.searchsorted(sdest, sdest, side="left")
-    valid_src = idx_in_dest < cap
-    flat = jnp.where(valid_src, sdest * cap + idx_in_dest, num_shards * cap)
-    out_leaves = []
-    for l in leaves:
-        sl = l[order]
-        buf = jnp.zeros((num_shards * cap,) + l.shape[1:], l.dtype)
-        buf = buf.at[flat].set(sl, mode="drop")
-        out_leaves.append(buf.reshape((num_shards, cap) + l.shape[1:]))
+    order, flat, valid_src = bucket_plan(dest, num_shards, cap)
+    out_leaves = tuple(bucket_rows(l, order, flat, num_shards, cap, 0)
+                       for l in leaves)
     vmask = jnp.zeros((num_shards * cap,), bool).at[flat].set(
         valid_src, mode="drop").reshape(num_shards, cap)
     overflow = jnp.sum(~valid_src)
-    return tuple(out_leaves), vmask, overflow
+    return out_leaves, vmask, overflow
 
 
 def make_all_to_all_exchange(mesh: Mesh, num_leaves: int, cap: int):
@@ -84,8 +115,7 @@ def make_all_to_all_exchange(mesh: Mesh, num_leaves: int, cap: int):
 
     in_specs = (P(KG_AXIS),) + (P(KG_AXIS),) * num_leaves
     out_specs = ((P(KG_AXIS),) * num_leaves, P(KG_AXIS), P(KG_AXIS))
-    fn = shard_map(_exchange, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_vma=False)
+    fn = shard_map_compat(_exchange, mesh, in_specs, out_specs)
     return jax.jit(fn)
 
 

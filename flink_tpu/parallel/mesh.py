@@ -23,6 +23,23 @@ from flink_tpu.core import keygroups
 KG_AXIS = "kg"
 
 
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions: newer jax spells the replication
+    check ``check_vma``, 0.4.x spells it ``check_rep`` (and hosts shard_map
+    under ``jax.experimental``).  One shim so every exchange/runtime call
+    site stays version-agnostic."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
 def make_mesh(n_devices: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over the key-group axis (data parallelism over keyed state)."""
@@ -57,3 +74,14 @@ class KeyGroupSharding:
 def state_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [K_total, ...] state: key-slot dim split over the mesh."""
     return NamedSharding(mesh, P(KG_AXIS))
+
+
+def layout_for(mesh: Mesh, K: int):
+    """The key-group-range state layout of a [K, ...] array over ``mesh``
+    (``state/shard_layout.ShardLayout``): device ``d`` owns the contiguous
+    slot block ``[d*K/D, (d+1)*K/D)`` — the rows ``state_sharding`` places
+    on it.  The single source of row-ownership truth shared by snapshots
+    (per-shard slices + manifests), the sharded probe (contiguous-range
+    shard ownership), and the record router (dest = slot // (K/D))."""
+    from flink_tpu.state.shard_layout import ShardLayout
+    return ShardLayout(int(mesh.devices.size), K)

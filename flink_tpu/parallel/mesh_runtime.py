@@ -38,7 +38,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.operators.session_window import SessionWindowOperator
@@ -56,10 +55,46 @@ def _quantize(n: int, floor: int = 16) -> int:
 
 
 class MeshWindowAggOperator(WindowAggOperator):
-    """``WindowAggOperator`` executing over a device mesh: state sharded by
-    key group, records re-keyed over ICI via ``all_to_all`` inside the
-    update step.  API-compatible with the single-chip operator — graph
-    translation swaps it in when the environment carries a mesh."""
+    """``WindowAggOperator`` executing as ONE logical SPMD operator over a
+    1-D key-group mesh: state sharded by key group, records re-keyed over
+    ICI via ``all_to_all`` inside the update step.  API-compatible with the
+    single-chip operator — graph translation swaps it in when the
+    environment carries a mesh.
+
+    Per-shard subsystems (ISSUE 6): the host emit tier, cold-key paging,
+    and the degraded-tier migration all run against the SAME key-group-
+    range layout the device state uses (``state/shard_layout.ShardLayout``):
+
+    - **host tier**: the fused native probe/mirror pass shards by
+      CONTIGUOUS slot range (``shard_div = K / D``), so probe shard ``t``
+      maintains exactly the mirror rows whose device block lives on mesh
+      device ``t`` — the probe_mirror wall becomes D independent, smaller
+      probes (per-shard wall times in ``phase_shard_ns``), and the staging
+      buffer each probe fills feeds the sharded scatter directly.
+    - **paging**: the (host-side) ``DevicePager`` runs unchanged over
+      global HBM rows; a record's destination shard is its resident row's
+      owning block, so page-in/page-out gathers and the spilled-key fire
+      are mesh-size independent (and digests stay bit-identical at any D).
+    - **degraded tier**: a process-wide device quarantine degrades the
+      WHOLE mesh — the live pane ring materializes shard-by-shard through
+      the dense snapshot path into the host value mirror, fires continue
+      bit-exactly from numpy, and re-promotion at the checkpoint-aligned
+      safe point rebuilds the sharded state.
+    - **snapshots** are per-shard slices with key-group-range manifests
+      (``state/shard_layout.split_to_shard_slices``); restore at any mesh
+      size (single-chip included) re-slices by the reader's layout.
+
+    Chained dispatches stay pre-partitioned end-to-end: state flows out of
+    the ``shard_map`` step with ``out_specs == in_specs`` (key-slot axis on
+    ``KG_AXIS``), batch rows are ``device_put`` pre-partitioned onto the
+    same axis, and nothing in between reshards — one XLA compile per
+    (mesh size, K_cap, batch geometry), asserted by the tier-1 smoke via
+    :meth:`mesh_step_cache_size`.
+    """
+
+    _SHARDED_HOST_TIER = True
+    _SHARDED_PAGING = True
+    _SHARDED_DEGRADE = True
 
     def __init__(self, *args, mesh: Optional[Mesh] = None,
                  n_devices: Optional[int] = None, **kwargs):
@@ -72,6 +107,55 @@ class MeshWindowAggOperator(WindowAggOperator):
         #: row sharding for the incoming batch (split over devices like a
         #: distributed source's partitions)
         self._row_sharding = NamedSharding(mesh, P(KG_AXIS))
+        #: per-shard probe timing buffer (phase_shard_ns feed)
+        self._shard_ns_buf = np.zeros(self.n_shards, np.int64)
+
+    # ---------------------------------------------------------------- layout
+    def shard_layout(self):
+        """The key-group-range state layout (shared by snapshots, the
+        sharded probe, and the record router)."""
+        from flink_tpu.parallel.mesh import layout_for
+        return layout_for(self.mesh, self._K)
+
+    def _probe_shards(self):
+        """Align the fused native probe with the mesh: by default one probe
+        shard per device, owning the contiguous slot range
+        [t*K/D, (t+1)*K/D) — the rows whose device state block lives on
+        mesh device t.  The ownership divisor derives from the ACTUAL probe
+        shard count (an explicit ``native_shards`` override, or the native
+        pool's 16-shard cap on very wide meshes), so the ranges stay
+        balanced when S != D; the last range is open-ended either way.
+        The timing buffer feeds the per-shard probe_mirror breakdown."""
+        S = min(self.native_shards or self.n_shards, 16)  # C pool cap
+        if self._shard_ns_buf.size < S:
+            self._shard_ns_buf = np.zeros(S, np.int64)
+        return S, -(-self._K // S), self._shard_ns_buf
+
+    def mesh_step_cache_size(self) -> int:
+        """Compiled-variant count of the sharded update step (the tier-1
+        recompile smoke: one batch geometry must compile exactly once —
+        an implicit reshard would mint a second cache entry)."""
+        fn = type(self)._mesh_update_step
+        try:
+            return int(fn._cache_size())
+        except Exception:  # noqa: BLE001 — jax without the cache probe
+            return -1
+
+    # ------------------------------------------------------------- snapshots
+    def snapshot_state(self):
+        """Per-shard slices with key-group-range manifests instead of one
+        dense array set (the dense layout is recovered by
+        ``densify_keyed_snapshot`` on restore/rescale, so every consumer of
+        the old format keeps working)."""
+        snap = super().snapshot_state()
+        # paged snapshots stay dense: their gid space exceeds K_cap and is
+        # residency-independent — row-block ownership does not decompose it
+        # (the spill store is the per-shard story there)
+        if "counts" in snap and self._pager is None:
+            from flink_tpu.state.shard_layout import split_to_shard_slices
+            mp = getattr(getattr(self, "ctx", None), "max_parallelism", 128)
+            snap = split_to_shard_slices(snap, self.shard_layout(), mp)
+        return snap
 
     # ------------------------------------------------------------- device op
     @partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
@@ -87,28 +171,23 @@ class MeshWindowAggOperator(WindowAggOperator):
         KD = K // D
 
         def step(leaves, counts, dest, slots, pane_slots, *values):
-            B = dest.shape[0]  # local rows on this device
-            # ---- bucket local rows by destination shard ([D, cap])
-            order = jnp.argsort(dest)
-            sdest = dest[order]
-            idx_in = jnp.arange(B) - jnp.searchsorted(sdest, sdest,
-                                                      side="left")
-            flat = jnp.where(idx_in < cap, sdest * cap + idx_in, D * cap)
-
-            def bucket(a, fill):
-                buf = jnp.full((D * cap,) + a.shape[1:], fill, a.dtype)
-                return buf.at[flat].set(a[order], mode="drop").reshape(
-                    (D, cap) + a.shape[1:])
-
+            from flink_tpu.parallel.exchange import (all_to_all_rows,
+                                                     bucket_plan,
+                                                     bucket_rows)
+            # ---- bucket local rows by destination shard ([D, cap]); the
+            # STABLE plan keeps each key's records in batch order through
+            # the exchange (bit-identical per-cell accumulation at any D)
+            order, flat, _valid = bucket_plan(dest, D, cap)
+            bucket = lambda a, fill: bucket_rows(a, order, flat, D,  # noqa: E731
+                                                 cap, fill)
             b_slots = bucket(slots, K)           # K = invalid sentinel
             b_panes = bucket(pane_slots, 0)
             b_vals = [bucket(v, 0) for v in values]
             # ---- the keyed exchange: one collective over ICI
-            a2a = partial(jax.lax.all_to_all, axis_name=KG_AXIS,
-                          split_axis=0, concat_axis=0, tiled=True)
-            rx_slots = a2a(b_slots).reshape(D * cap)
-            rx_panes = a2a(b_panes).reshape(D * cap)
-            rx_vals = tuple(a2a(v).reshape((D * cap,) + v.shape[2:])
+            rx_slots = all_to_all_rows(b_slots).reshape(D * cap)
+            rx_panes = all_to_all_rows(b_panes).reshape(D * cap)
+            rx_vals = tuple(all_to_all_rows(v).reshape((D * cap,)
+                                                       + v.shape[2:])
                             for v in b_vals)
             # ---- local scatter-combine (this device's key-slot block)
             lo = jax.lax.axis_index(KG_AXIS).astype(jnp.int32) * KD
@@ -138,9 +217,8 @@ class MeshWindowAggOperator(WindowAggOperator):
                     P(KG_AXIS), P(KG_AXIS), P(KG_AXIS)) \
             + (P(KG_AXIS),) * nv
         out_specs = ((state_spec,) * len(leaves), state_spec)
-        fn = shard_map(step, mesh=self.mesh,
-                       in_specs=in_specs, out_specs=out_specs,
-                       check_vma=False)
+        from flink_tpu.parallel.mesh import shard_map_compat
+        fn = shard_map_compat(step, self.mesh, in_specs, out_specs)
         return fn(leaves, counts, *batch)
 
     def _values_tree(self, flat_values):
@@ -171,10 +249,16 @@ class MeshWindowAggOperator(WindowAggOperator):
         dest = np.minimum(slots_p.astype(np.int64) // KD, D - 1).astype(
             np.int32)
         dest[B:] = np.arange(Bp - B) % D  # spread pad rows evenly
-        # host-known capacity: max rows any (src block, dest) pair sends
+        # host-known capacity: max rows any (src block, dest) pair sends.
+        # STICKY high-water (the credit-capacity-only-grows rule of
+        # ResizingExchange): batch-to-batch skew wobble must not recompile
+        # the step — steady state is exactly one compile per (mesh, K,
+        # batch geometry), which the tier-1 recompile smoke asserts
         src = np.repeat(np.arange(D), Bp // D)
         per_pair = np.bincount(src * D + dest, minlength=D * D)
         cap = _quantize(int(per_pair.max()))
+        cap = self._exchange_cap_hw = max(
+            getattr(self, "_exchange_cap_hw", 0), cap)
         vleaves, self._values_treedef = jax.tree_util.tree_flatten(values)
         vpad = [jax.device_put(pad(np.asarray(v), 0, np.asarray(v).dtype),
                                self._row_sharding) for v in vleaves]
@@ -202,9 +286,12 @@ class MeshWindowAggOperator(WindowAggOperator):
     def _round_key_capacity(self, needed: int) -> int:
         """Key capacity must stay divisible by the shard count (even state
         blocks per device): round the pow2 up to the next multiple of D
-        (lcm), which pow2 meshes hit for free."""
+        (lcm), which pow2 meshes hit for free.  Paged state never grows —
+        K_cap is the pinned resident capacity (overflow pages out)."""
         import math
 
+        if self._pager is not None:
+            return self._K
         newK = _next_pow2(max(needed, self.n_shards), self._K)
         return newK * self.n_shards // math.gcd(newK, self.n_shards)
 
@@ -255,23 +342,17 @@ class MeshSessionWindowOperator(SessionWindowOperator):
         D = self.n_shards
 
         def step(dest, sid, *values):
-            B = dest.shape[0]
-            order = jnp.argsort(dest)
-            sdest = dest[order]
-            idx_in = jnp.arange(B) - jnp.searchsorted(sdest, sdest,
-                                                      side="left")
-            flat = jnp.where(idx_in < cap, sdest * cap + idx_in, D * cap)
-
-            def bucket(a, fill):
-                buf = jnp.full((D * cap,) + a.shape[1:], fill, a.dtype)
-                return buf.at[flat].set(a[order], mode="drop").reshape(
-                    (D, cap) + a.shape[1:])
-
-            a2a = partial(jax.lax.all_to_all, axis_name=KG_AXIS,
-                          split_axis=0, concat_axis=0, tiled=True)
-            rx_sid = a2a(bucket(sid, cap_sess)).reshape(D * cap)
-            rx_vals = tuple(a2a(bucket(v, 0)).reshape((D * cap,) + v.shape[2:])
-                            for v in values)
+            from flink_tpu.parallel.exchange import (all_to_all_rows,
+                                                     bucket_plan,
+                                                     bucket_rows)
+            order, flat, _valid = bucket_plan(dest, D, cap)
+            bucket = lambda a, fill: bucket_rows(a, order, flat, D,  # noqa: E731
+                                                 cap, fill)
+            rx_sid = all_to_all_rows(bucket(sid, cap_sess)).reshape(D * cap)
+            rx_vals = tuple(
+                all_to_all_rows(bucket(v, 0)).reshape((D * cap,)
+                                                      + v.shape[2:])
+                for v in values)
             lifted = tuple(jax.tree_util.tree_leaves(
                 self.agg.lift(self._values_tree(rx_vals))))
             outs = []
@@ -291,8 +372,8 @@ class MeshSessionWindowOperator(SessionWindowOperator):
         nv = len(batch) - 2
         in_specs = (P(KG_AXIS), P(KG_AXIS)) + (P(KG_AXIS),) * nv
         out_specs = (P(KG_AXIS),) * self.spec.num_leaves
-        fn = shard_map(step, mesh=self.mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_vma=False)
+        from flink_tpu.parallel.mesh import shard_map_compat
+        fn = shard_map_compat(step, self.mesh, in_specs, out_specs)
         return fn(*batch)
 
     def _values_tree(self, flat_values):

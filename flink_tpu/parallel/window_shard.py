@@ -1,20 +1,28 @@
-"""Multi-chip windowed aggregation: placement-level sharding.
+"""Multi-chip windowed aggregation: the sharding-aware operator factory.
 
-The single-chip ``WindowAggOperator`` kernels (scatter-combine, pane fire,
-clear/purge) are placement-agnostic XLA programs.  Multi-chip execution is
-therefore pure *data placement*: state arrays ``[K, P, ...]`` get a
-``NamedSharding`` over the key-slot dimension (the key-group axis, SURVEY
-§2.7/§7.1) and XLA's SPMD partitioner splits every step:
+Until ISSUE 6 this module built a PLACEMENT-only sharded operator (state
+arrays carried a ``NamedSharding`` and XLA's SPMD partitioner split the
+kernels, but the probe/mirror host path, paging, snapshots, and the record
+route all stayed single-chip).  It now fronts the full mesh runtime
+(``parallel/mesh_runtime.MeshWindowAggOperator``): one logical SPMD window
+operator whose
 
-- scatter updates: indices replicated, each device applies the in-range rows
-  of the batch to its local state slice — no collectives in the hot loop;
-- fire/clear/purge: row-parallel over K, trivially partitioned;
-- results come back sharded; the host emit path reads them once per fire.
+- state layout is key-group-range blocks per device
+  (``state/shard_layout.ShardLayout``),
+- record→owning-shard route is an on-device ``all_to_all`` collective
+  (``parallel/exchange``), not a host-channel hop,
+- probe/mirror maintenance shards by the same contiguous slot ranges
+  (per-shard probes; ``phase_shard_ns`` breakdown),
+- snapshots are per-shard slices with key-group-range manifests,
+  rescalable across mesh sizes.
 
-This mirrors how the reference scales ``keyBy``: identical operator logic per
-subtask, state split by key-group range (``KeyGroupRangeAssignment.java``).
-Cross-host record routing (the Netty shuffle analog) is the separate
-``parallel/exchange.py`` all_to_all path.
+This mirrors how the reference scales ``keyBy``: identical operator logic
+per subtask, state split by key-group range
+(``KeyGroupRangeAssignment.java``), the Netty shuffle replaced by ICI.
+
+``placement_sharded_window_operator`` keeps the old placement-only
+construction for A/B comparisons (kernel-partitioning correctness without
+the mesh runtime).
 """
 
 from __future__ import annotations
@@ -30,7 +38,20 @@ from flink_tpu.parallel.mesh import make_mesh, state_sharding
 def sharded_window_operator(mesh: Optional[Mesh] = None, *,
                             n_devices: Optional[int] = None,
                             **kwargs) -> WindowAggOperator:
-    """A ``WindowAggOperator`` whose keyed state is sharded over ``mesh``."""
+    """A window operator whose keyed state, probe path, and record route
+    are sharded over ``mesh`` (the full mesh runtime)."""
+    from flink_tpu.parallel.mesh_runtime import MeshWindowAggOperator
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    return MeshWindowAggOperator(mesh=mesh, **kwargs)
+
+
+def placement_sharded_window_operator(mesh: Optional[Mesh] = None, *,
+                                      n_devices: Optional[int] = None,
+                                      **kwargs) -> WindowAggOperator:
+    """The pre-ISSUE-6 construction: single-chip operator logic with state
+    arrays placed under a ``NamedSharding`` (XLA splits the kernels; the
+    host paths stay unsharded).  Kept for A/B tests."""
     if mesh is None:
         mesh = make_mesh(n_devices)
     return WindowAggOperator(sharding=state_sharding(mesh), **kwargs)

@@ -196,7 +196,9 @@ class NativeWindowMirror:
     def probe_update(self, keys: np.ndarray, panes: np.ndarray,
                      lifted: List[np.ndarray], pane_mod: int = 0,
                      flat_out: Optional[np.ndarray] = None,
-                     flat_fill: int = 0, shards: int = 1) -> np.ndarray:
+                     flat_fill: int = 0, shards: int = 1,
+                     shard_div: int = 0,
+                     shard_ns: Optional[np.ndarray] = None) -> np.ndarray:
         """Fused probe + mirror fold; returns int32 slot ids for the device
         scatter.  ``lifted`` is the agg's host_lift leaves, one [B] array per
         ACC leaf.  When ``flat_out`` (int32[>=n], contiguous) is given, the C
@@ -204,9 +206,15 @@ class NativeWindowMirror:
         pane %% pane_mod into it — one pass instead of three numpy ops —
         and fills the padding tail flat_out[n:] with ``flat_fill`` (the
         dropped-row id), so a pow2 staging buffer comes back dispatch-ready.
-        ``shards`` > 1 hash-partitions the fold across the native worker
-        pool (disjoint slot ownership, no locks) — results are bit-identical
-        to the serial pass at any shard count."""
+        ``shards`` > 1 partitions the fold across the native worker pool
+        (disjoint slot ownership, no locks) — results are bit-identical to
+        the serial pass at any shard count.  Ownership defaults to
+        slot %% shards classes; ``shard_div`` > 0 switches to CONTIGUOUS
+        slot ranges [t*shard_div, (t+1)*shard_div) — the mesh runtime
+        passes K_cap / n_devices so probe shard t owns exactly the
+        key-group range whose device state block lives on mesh device t.
+        ``shard_ns`` (int64[>=shards], contiguous) receives each shard's
+        fold wall time in nanoseconds (the per-shard probe breakdown)."""
         keys = np.ascontiguousarray(keys, np.int64)
         panes = np.ascontiguousarray(panes, np.int64)
         n = keys.size
@@ -214,6 +222,8 @@ class NativeWindowMirror:
         if n == 0:
             if flat_out is not None:
                 flat_out[:] = flat_fill
+            if shard_ns is not None:
+                shard_ns[:] = 0
             return slots
         nl = len(self._mirror_dtypes)
         arrs = []
@@ -237,10 +247,19 @@ class NativeWindowMirror:
                     "pane_mod > 0")
             flat_ptr = flat_out.ctypes.data
             flat_cap = flat_out.size
-        self._lib.wm_probe_update(
+        ns_ptr = 0
+        if shard_ns is not None:
+            if (shard_ns.dtype != np.int64
+                    or not shard_ns.flags.c_contiguous
+                    or shard_ns.size < max(1, int(shards))):
+                raise ValueError("shard_ns must be contiguous int64 with "
+                                 "size >= shards")
+            shard_ns[:] = 0
+            ns_ptr = shard_ns.ctypes.data
+        self._lib.wm_probe_update2(
             self._h, keys.ctypes.data, panes.ctypes.data, n, vals, vdt,
             slots.ctypes.data, pane_mod, flat_ptr, flat_cap,
-            int(flat_fill), max(1, int(shards)))
+            int(flat_fill), max(1, int(shards)), int(shard_div), ns_ptr)
         return slots
 
     def fire(self, panes: np.ndarray

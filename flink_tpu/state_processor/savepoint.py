@@ -169,10 +169,14 @@ class SavepointReader:
 
         snap = self.raw(uid)
         root = snap.get("operator", snap)
-        op_snap = _find_member(root, "leaves")
+        op_snap = _find_member(root, "leaves", "shard_slices")
         if op_snap is None:
             raise ValueError(f"{uid}: not a window-aggregate snapshot "
                              f"(fields: {sorted(root)[:8]})")
+        # mesh snapshots carry per-shard slices with key-group manifests
+        # (state/shard_layout) instead of dense arrays: merge first
+        from flink_tpu.state.shard_layout import densify_keyed_snapshot
+        op_snap = densify_keyed_snapshot(op_snap)
         cls = (ObjectKeyIndex if op_snap.get("key_index_kind") == "ObjectKeyIndex"
                else KeyIndex)
         idx = cls.restore(op_snap["key_index"])

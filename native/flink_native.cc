@@ -15,6 +15,7 @@
 // reference is intended or needed.
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -1110,15 +1111,22 @@ static void wm_probe_serial(WinMirror* w, const i64* keys,
 // runs READ-ONLY dict lookups in parallel (no inserts -> the table is
 // immutable during the scan).  Phase 2 inserts the misses serially in
 // batch order, so new keys get exactly the slot ids the serial pass would
-// assign.  Phase 3 folds in parallel with slot-ownership partitioning
-// (shard t owns slots with slot %% S == t): every mirror cell has exactly
-// ONE writer and sees its updates in batch order — no locks, no atomics,
-// and the result is bit-identical, not just equivalent.
+// assign.  Phase 3 folds in parallel with slot-ownership partitioning:
+// by default shard t owns slots with slot %% S == t; with shard_div > 0
+// shard t instead owns the CONTIGUOUS slot range
+// [t * shard_div, (t+1) * shard_div) — the key-group-range ownership the
+// mesh runtime uses, so probe shard t maintains exactly the mirror rows
+// whose device state block lives on mesh device t.  Either way every
+// mirror cell has exactly ONE writer and sees its updates in batch order —
+// no locks, no atomics, and the result is bit-identical, not just
+// equivalent.  shard_ns (nullable, length >= S) receives each shard's
+// phase-3 fold wall time in nanoseconds (the per-shard probe breakdown).
 static void wm_probe_sharded(WinMirror* w, const i64* keys,
                              const i64* pane_ids, i64 n,
                              const void* const* vals, const u8* vdt,
                              i32* slots_out, i64 pane_mod, i32* flat_out,
-                             i64 flat_cap, i32 flat_pad, int S) {
+                             i64 flat_cap, i32 flat_pad, int S,
+                             i64 shard_div, i64* shard_ns) {
   KeyDict* d = w->dict;
   d->reserve(n);  // up front: phase 1 must not observe a rehash
   ShardPool* pool = shard_pool();
@@ -1156,6 +1164,7 @@ static void wm_probe_sharded(WinMirror* w, const i64* keys,
   const i64 stride = w->stride;
   const i64 PF = 16;
   pool->run(S, [&](int t) {
+    const auto t0 = std::chrono::steady_clock::now();
     if (flat_out) {
       // flat device-scatter ids partition by record range (no sharing)
       const i64 lo = n * t / S, hi = n * (t + 1) / S;
@@ -1168,6 +1177,16 @@ static void wm_probe_sharded(WinMirror* w, const i64* keys,
         for (i64 k = n; k < flat_cap; k++) flat_out[k] = flat_pad;
     }
     const u32 uS = (u32)S, ut = (u32)t;
+    const bool by_range = shard_div > 0;
+    const i64 own_lo = by_range ? (i64)t * shard_div : 0;
+    // the LAST range is open-ended: slots past shard_div * S (a caller
+    // whose capacity grew under it) must still have exactly one owner
+    const i64 own_hi = !by_range ? 0
+        : (t == S - 1 ? INT64_MAX : own_lo + shard_div);
+    // mine(s): does this shard own slot s?  Range ownership compares
+    // against [own_lo, own_hi); modulo ownership hashes slot classes.
+#define WM_MINE(s) (by_range ? ((i64)(s) >= own_lo && (i64)(s) < own_hi) \
+                             : ((u32)(s) % uS == ut))
     i64 i = 0;
     while (i < n) {
       const i64 p = pane_ids[i];
@@ -1178,9 +1197,9 @@ static void wm_probe_sharded(WinMirror* w, const i64* keys,
         const float* v = (const float*)vals[0];
         for (i64 k = i; k < j; k++) {
           const i32 s = slots_out[k];
-          if ((u32)s % uS != ut) continue;
+          if (!WM_MINE(s)) continue;
           const i64 kp = k + PF;
-          if (kp < j && (u32)slots_out[kp] % uS == ut)
+          if (kp < j && WM_MINE(slots_out[kp]))
             __builtin_prefetch(base + (i64)slots_out[kp] * stride, 1);
           u8* row = base + (i64)s * stride;
           (*(i64*)row)++;
@@ -1189,15 +1208,19 @@ static void wm_probe_sharded(WinMirror* w, const i64* keys,
       } else {
         for (i64 k = i; k < j; k++) {
           const i32 s = slots_out[k];
-          if ((u32)s % uS != ut) continue;
+          if (!WM_MINE(s)) continue;
           const i64 kp = k + PF;
-          if (kp < j && (u32)slots_out[kp] % uS == ut)
+          if (kp < j && WM_MINE(slots_out[kp]))
             __builtin_prefetch(base + (i64)slots_out[kp] * stride, 1);
           wm_fold_one(w, base + (i64)s * stride, vals, vdt, k);
         }
       }
       i = j;
     }
+#undef WM_MINE
+    if (shard_ns)
+      shard_ns[t] = (i64)std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - t0).count();
   });
 }
 
@@ -1215,22 +1238,51 @@ static void wm_probe_sharded(WinMirror* w, const i64* keys,
 // the jitted update step consumes — saving three numpy passes per batch;
 // flat_out[n..flat_cap) is filled with ``flat_pad`` (the dropped-padding
 // id), so the caller's pow2-padded staging buffer is ready to dispatch.
+API void wm_probe_update2(void* h, const i64* keys, const i64* pane_ids,
+                          i64 n, const void* const* vals, const u8* vdt,
+                          i32* slots_out, i64 pane_mod, i32* flat_out,
+                          i64 flat_cap, i32 flat_pad, i32 nshards,
+                          i64 shard_div, i64* shard_ns);
+
 API void wm_probe_update(void* h, const i64* keys, const i64* pane_ids, i64 n,
                          const void* const* vals, const u8* vdt,
                          i32* slots_out, i64 pane_mod, i32* flat_out,
                          i64 flat_cap, i32 flat_pad, i32 nshards) {
+  wm_probe_update2(h, keys, pane_ids, n, vals, vdt, slots_out, pane_mod,
+                   flat_out, flat_cap, flat_pad, nshards, 0, nullptr);
+}
+
+// Extended probe entry: ``shard_div`` > 0 switches shard ownership from
+// slot %% S classes to contiguous slot ranges [t*shard_div, (t+1)*shard_div)
+// — the mesh runtime passes K_cap / n_devices so probe shard t owns exactly
+// the key-group range whose device state block lives on mesh device t.
+// ``shard_ns`` (nullable, i64[nshards]) receives per-shard fold wall nanos
+// (serial pass: total in shard_ns[0]).
+API void wm_probe_update2(void* h, const i64* keys, const i64* pane_ids,
+                          i64 n, const void* const* vals, const u8* vdt,
+                          i32* slots_out, i64 pane_mod, i32* flat_out,
+                          i64 flat_cap, i32 flat_pad, i32 nshards,
+                          i64 shard_div, i64* shard_ns) {
   auto* w = (WinMirror*)h;
   int S = nshards;
   if (S > 16) S = 16;
+  // range ownership must cover every slot: with fewer ranges than shards
+  // the tail shards simply own nothing (their ranges sit past shard_div*S)
   if (S > 1 && n >= WM_MIN_PARALLEL) {
     wm_probe_sharded(w, keys, pane_ids, n, vals, vdt, slots_out, pane_mod,
-                     flat_out, flat_cap, flat_pad, S);
+                     flat_out, flat_cap, flat_pad, S, shard_div, shard_ns);
     return;
   }
+  const auto t0 = std::chrono::steady_clock::now();
   wm_probe_serial(w, keys, pane_ids, n, vals, vdt, slots_out, pane_mod,
                   flat_out);
   if (flat_out)
     for (i64 k = n; k < flat_cap; k++) flat_out[k] = flat_pad;
+  if (shard_ns && nshards >= 1) {
+    for (i32 t = 1; t < nshards && t < 16; t++) shard_ns[t] = 0;
+    shard_ns[0] = (i64)std::chrono::duration_cast<std::chrono::nanoseconds>(
+        std::chrono::steady_clock::now() - t0).count();
+  }
 }
 
 // Window fire: combine the window's panes per slot, compact non-empty rows

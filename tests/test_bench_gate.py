@@ -85,6 +85,77 @@ def test_check_budget_probe_mirror_frac():
     assert check_budget(_result(phases={"probe_mirror": 950.0}), b) == []
 
 
+def _mesh_result(rps_pod=4e6, per_shard=(150.0, 120.0), phases=None,
+                 ok=True):
+    return {"records_per_sec_pod": rps_pod, "ok": ok,
+            "details": {"phases_ms": phases or {"probe_mirror": 600.0},
+                        "probe_mirror_shard_ms": list(per_shard)}}
+
+
+def _mesh_budget(**kw):
+    b = {"min_rps_pod": 1.5e6, "max_shard_probe_share": 0.85,
+         "max_phase_ms": {"probe_mirror": 2000.0}}
+    b.update(kw)
+    return b
+
+
+def test_check_mesh_budget_pass():
+    from bench import check_mesh_budget
+    assert check_mesh_budget(_mesh_result(), _mesh_budget()) == []
+
+
+def test_check_mesh_budget_pod_floor():
+    from bench import check_mesh_budget
+    viol = check_mesh_budget(_mesh_result(rps_pod=1e5), _mesh_budget())
+    assert len(viol) == 1 and "rec/s/pod" in viol[0]
+
+
+def test_check_mesh_budget_shard_share_ceiling():
+    """A 'sharded' probe whose whole fold sits on one shard is fictional
+    sharding — the share ceiling catches it."""
+    from bench import check_mesh_budget
+    viol = check_mesh_budget(_mesh_result(per_shard=(600.0, 1.0)),
+                             _mesh_budget())
+    assert len(viol) == 1 and "not\ndecomposed".replace("\n", " ") \
+        in viol[0].replace("\n", " ")
+    # single-device / serial-probe runs (one live entry) are exempt
+    assert check_mesh_budget(_mesh_result(per_shard=(600.0,)),
+                             _mesh_budget()) == []
+    assert check_mesh_budget(_mesh_result(per_shard=(600.0, 0.0)),
+                             _mesh_budget()) == []
+
+
+def test_check_mesh_budget_replay_and_phase():
+    from bench import check_mesh_budget
+    viol = check_mesh_budget(_mesh_result(ok=False), _mesh_budget())
+    assert any("replay" in v for v in viol)
+    viol = check_mesh_budget(
+        _mesh_result(phases={"probe_mirror": 9000.0}), _mesh_budget())
+    assert any("probe_mirror" in v for v in viol)
+
+
+def test_mesh_bench_reports_pod_and_per_shard(tmp_path):
+    """bench.py --mesh-devices N end-to-end on the forced-host CPU mesh:
+    records/sec/pod + records/sec/chip reported, per-shard probe
+    breakdown present, restore+replay digests hold, and the committed
+    mesh_cpu gate passes at smoke size."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--mesh-devices", "2", "--records", "65536", "--keys", "16384",
+         "--batch-size", "16384", "--check"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"]
+    assert result["records_per_sec_pod"] > 0
+    assert result["records_per_sec_chip"] * 2 == pytest.approx(
+        result["records_per_sec_pod"], rel=1e-6)
+    d = result["details"]
+    assert d["mesh_devices"] == 2 and d["restore_replay_ok"]
+    assert [m["shard"] for m in d["shard_manifest"]] == [0, 1]
+
+
 def test_budget_file_shape():
     with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
         budget = json.load(f)
@@ -103,6 +174,11 @@ def test_budget_file_shape():
     # the full_cpu floor must catch losing the deferred lane (~1.6M rec/s
     # measured scatter fallback on the reference host)
     assert full_cpu["min_rps"] > 2_000_000
+    # the mesh gate (bench.py --mesh-devices --check on CPU)
+    mesh = budget["mesh_cpu"]
+    assert mesh["min_rps_pod"] > 0
+    assert 0 < mesh["max_shard_probe_share"] <= 1.0
+    assert "probe_mirror" in mesh["max_phase_ms"]
 
 
 def _operator_phase_names():

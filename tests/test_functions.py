@@ -104,3 +104,37 @@ def test_accumulators_merge_into_job_result():
      .key_by("k").process(P()).collect())
     res = env.execute()
     assert res.get_accumulator_result("rows-seen") == 100
+
+
+def test_float64_requests_canonicalize_without_warning():
+    """ISSUE-6 satellite: aggregators asked for float64 under an x64-off
+    backend must request the CANONICAL dtype (f32) instead of letting jax
+    truncate-and-warn on every identity() — the UserWarning that spammed
+    every MULTICHIP tail (functions.py:290)."""
+    import warnings
+
+    import jax
+
+    from flink_tpu.core.functions import (MaxAggregator, MinAggregator,
+                                          SumAggregator, default_float_dtype)
+
+    x64 = bool(jax.config.jax_enable_x64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        for agg in (SumAggregator(jnp.float64), MinAggregator(np.float64),
+                    MaxAggregator("float64"), AvgAggregator(jnp.float64)):
+            ident = agg.identity()
+            leaves = jax.tree_util.tree_leaves(ident)
+            want = jnp.float64 if x64 else jnp.float32
+            float_leaves = [l for l in leaves
+                            if jnp.issubdtype(l.dtype, jnp.floating)]
+            assert float_leaves
+            assert all(l.dtype == want for l in float_leaves)
+    # the datastream default rides the same rule
+    assert default_float_dtype() == (jnp.float64 if x64 else jnp.float32)
+
+
+def test_explicit_float32_request_unchanged():
+    from flink_tpu.core.functions import SumAggregator
+
+    assert SumAggregator(jnp.float32).identity().dtype == jnp.float32

@@ -88,6 +88,32 @@ def test_chaos_suite_collects_under_tier1():
              f"slow-gated")
 
 
+def test_mesh_suite_collects_under_tier1():
+    """The mesh-sharded hot path's suites (ISSUE-6) must contribute tests
+    to the tier-1 run under ``JAX_PLATFORMS=cpu``: the conftest forces an
+    8-device virtual CPU mesh, so multi-device sharding is exercised by
+    the gate everyone runs — a slow-mark or cpu-skip sweep that silently
+    drops them fails here.  Verified by real collection, not regex."""
+    import subprocess
+
+    mesh_files = ["test_mesh_invariance.py", "test_mesh_runtime.py",
+                  "test_parallel.py"]
+    for f in mesh_files:
+        assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider",
+         *[str(TESTS / f) for f in mesh_files]],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for f in mesh_files:
+        assert f"{f}::" in proc.stdout, \
+            (f"{f} contributes no tests to the tier-1 selection "
+             f"(-m 'not slow' under JAX_PLATFORMS=cpu) — mesh sharding "
+             f"coverage left the gate")
+
+
 def test_marker_declarations_have_descriptions():
     """Each declared marker carries a description (the `name: text` form)
     so `pytest --markers` documents the tiers."""
