@@ -149,7 +149,8 @@ def make_batches(n_records: int, n_keys: int, batch_size: int, window_ms: int,
 def _build_op(window_ms: int, emit_tier: str = "host",
               device_sync: str = "auto", paging_cap: int = 0,
               pipeline_depth: int = 1, native_shards: int = 0,
-              mesh_devices: int = 0, key_capacity: int = 1 << 20):
+              mesh_devices: int = 0, key_capacity: int = 1 << 20,
+              device_probe: str = "auto"):
     import jax.numpy as jnp
 
     from flink_tpu.core.functions import RuntimeContext, SumAggregator
@@ -170,9 +171,11 @@ def _build_op(window_ms: int, emit_tier: str = "host",
         paging=paging,
         # the bench IS the hot-path deployment: pipelined by default
         # (--pipeline-depth 0 A/Bs the serial path), native probe sharded
-        # across cores (--native-shards; 0 = auto)
+        # across cores (--native-shards; 0 = auto), device-resident key
+        # probe behind --device-probe (auto = measured A/B calibration)
         pipeline_depth=pipeline_depth,
-        native_shards=native_shards)
+        native_shards=native_shards,
+        device_probe=device_probe)
     if mesh_devices > 1:
         # the mesh-sharded hot path: ONE logical operator over the chip
         # mesh (parallel/mesh_runtime) — state in key-group-range blocks,
@@ -236,7 +239,7 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
                    emit_tier: str = "host", device_sync: str = "auto",
                    timed_passes: int = 3, pipeline_depth: int = 1,
                    native_shards: int = 0, mesh_devices: int = 0,
-                   key_capacity: int = 1 << 20):
+                   key_capacity: int = 1 << 20, device_probe: str = "auto"):
     """Timed checkpointable run.  Returns (records/sec, windows fired,
     snapshots taken, phase dict, mid-run snapshot + its batch index +
     post-checkpoint digests for the replay check)."""
@@ -296,7 +299,8 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
             for lo in range(0, nk, bsz)]
     op = _build_op(window_ms, emit_tier, device_sync,
                    pipeline_depth=pipeline_depth, native_shards=native_shards,
-                   mesh_devices=mesh_devices, key_capacity=key_capacity)
+                   mesh_devices=mesh_devices, key_capacity=key_capacity,
+                   device_probe=device_probe)
     run(op, warm + batches[:2] + batches[-1:])
     # best of three timed passes: this host suffers EPISODIC multi-second
     # slowdowns (shared-core tunnel client; measured ±70% swings on
@@ -322,7 +326,8 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
 def replay_check(batches, window_ms: int, mid, digests,
                  emit_tier: str = "host", device_sync: str = "auto",
                  pipeline_depth: int = 1, native_shards: int = 0,
-                 mesh_devices: int = 0, key_capacity: int = 1 << 20) -> bool:
+                 mesh_devices: int = 0, key_capacity: int = 1 << 20,
+                 device_probe: str = "auto") -> bool:
     """Exactly-once evidence: restore the mid-run snapshot into a FRESH
     operator, replay the remaining batches, and require the identical
     per-window fire digests."""
@@ -333,7 +338,8 @@ def replay_check(batches, window_ms: int, mid, digests,
     i, snap = mid
     op = _build_op(window_ms, emit_tier, device_sync,
                    pipeline_depth=pipeline_depth, native_shards=native_shards,
-                   mesh_devices=mesh_devices, key_capacity=key_capacity)
+                   mesh_devices=mesh_devices, key_capacity=key_capacity,
+                   device_probe=device_probe)
     op.restore_state(snap)
     out = []
     for keys, vals, ts in batches[i + 1:]:
@@ -356,7 +362,8 @@ def measure_fire_latency(batches, window_ms: int,
                          emit_tier: str = "host",
                          device_sync: str = "auto",
                          pipeline_depth: int = 1,
-                         native_shards: int = 0) -> dict:
+                         native_shards: int = 0,
+                         device_probe: str = "auto") -> dict:
     """Window-fire latency: watermark arrival -> fired rows materialized on
     the host.  >= ``min_samples`` samples (VERDICT r2 weak #2), capped at
     ``max_samples`` (each device-tier sample is a real synchronous
@@ -381,7 +388,8 @@ def measure_fire_latency(batches, window_ms: int,
         cycles = halved
     cycles = cycles[:max_samples]
     op = _build_op(window_ms, emit_tier, device_sync,
-                   pipeline_depth=pipeline_depth, native_shards=native_shards)
+                   pipeline_depth=pipeline_depth, native_shards=native_shards,
+                   device_probe=device_probe)
     # warm compiles/allocations outside the timed samples
     warm_keys = batches[0][0]
     for i in range(2):
@@ -1134,6 +1142,7 @@ def run_mesh_bench(args) -> dict:
         timed_passes=2 if args.smoke else 3,
         pipeline_depth=args.pipeline_depth,
         native_shards=args.native_shards, mesh_devices=D,
+        device_probe=args.device_probe,
         # size the ring to the workload so the key-group-range blocks are
         # POPULATED on every device (capacity-sized blocks would park all
         # live rows on shard 0 at small key counts)
@@ -1142,10 +1151,12 @@ def run_mesh_bench(args) -> dict:
                              args.emit_tier, args.device_sync,
                              pipeline_depth=args.pipeline_depth,
                              native_shards=args.native_shards,
-                             mesh_devices=D, key_capacity=n_keys)
+                             mesh_devices=D, key_capacity=n_keys,
+                             device_probe=args.device_probe)
     ns = phases.pop("elapsed", 1)
     per_shard_ms = [round(v / 1e6, 1)
                     for v in shard_ns.get("probe_mirror", [])]
+    dp = op.device_probe_stats()
     detail = {
         "mesh_devices": D,
         "platform": jax.devices()[0].platform,
@@ -1159,6 +1170,9 @@ def run_mesh_bench(args) -> dict:
         "restore_replay_ok": replay_ok,
         "emit_tier": args.emit_tier,
         "device_sync": op.device_sync_mode,
+        "device_probe": "on" if dp["enabled"] else "off",
+        "probe_hit_rate": (round(dp["probe_hit_rate"], 4)
+                           if dp["probe_hit_rate"] is not None else None),
         # --mesh-devices 1 is the single-chip leg of the comparison: the
         # plain operator has no shard layout, its "manifest" is one block
         "shard_manifest": ([
@@ -1249,6 +1263,12 @@ def check_budget(result: dict, budget: dict) -> list:
         if share > frac:
             viol.append(f"probe_mirror {pm}ms is {share:.0%} of elapsed "
                         f"{elapsed}ms > ceiling {frac:.0%}")
+    hr_floor = budget.get("min_probe_hit_rate")
+    hr = result["details"].get("probe_hit_rate")
+    if hr_floor is not None and result["details"].get("device_probe") == "on" \
+            and hr is not None and hr < hr_floor:
+        viol.append(f"probe_hit_rate {hr} < floor {hr_floor} (the device "
+                    f"probe is not absorbing the warm-key steady state)")
     return viol
 
 
@@ -1280,6 +1300,14 @@ def main():
     ap.add_argument("--native-shards", type=int, default=0,
                     help="native probe shard count (0 = auto: "
                          "FLINK_TPU_NATIVE_SHARDS or one per core up to 4)")
+    ap.add_argument("--device-probe", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="device-resident key probe (state/device_keyindex):"
+                         " resolve warm keys inside the jitted step so the "
+                         "host C fold touches only misses.  auto runs a "
+                         "measured A/B calibration (the probe usually loses "
+                         "on CPU-forced runs and wins on real "
+                         "accelerators); on/off force")
     ap.add_argument("--profile", metavar="PATH", default=None,
                     help="write the per-phase breakdown (phase_ns, "
                          "phase_bytes, phases_ms) of the winning timed pass "
@@ -1406,11 +1434,13 @@ def main():
      op) = run_tpu_native(batches, args.window_ms, args.checkpoint_every,
                           args.emit_tier, args.device_sync,
                           pipeline_depth=args.pipeline_depth,
-                          native_shards=args.native_shards)
+                          native_shards=args.native_shards,
+                          device_probe=args.device_probe)
     replay_ok = replay_check(batches, args.window_ms, mid, digests,
                              args.emit_tier, args.device_sync,
                              pipeline_depth=args.pipeline_depth,
-                             native_shards=args.native_shards)
+                             native_shards=args.native_shards,
+                             device_probe=args.device_probe)
     # device-vs-mirror consistency: a REAL device download of the live
     # panes, compared against the host mirror (post-timing).  Under
     # deferred sync this validates the refresh round trip (upload ->
@@ -1428,7 +1458,7 @@ def main():
         max_samples=256 if args.emit_tier == "host" else 16,
         emit_tier=args.emit_tier, device_sync=args.device_sync,
         pipeline_depth=args.pipeline_depth,
-        native_shards=args.native_shards)
+        native_shards=args.native_shards, device_probe=args.device_probe)
 
     # transparency: when the transport calibration sent the headline run
     # down the deferred path, ALSO measure the scatter path (the r1-r3
@@ -1482,6 +1512,14 @@ def main():
         "pipeline_depth": args.pipeline_depth,
         "native_shards": op._nm_shards,
     }
+    dp = op.device_probe_stats()
+    detail["device_probe"] = "on" if dp["enabled"] else "off"
+    if dp["enabled"]:
+        detail["probe_hit_rate"] = (round(dp["probe_hit_rate"], 4)
+                                    if dp["probe_hit_rate"] is not None
+                                    else None)
+        detail["miss_inserts"] = dp["miss_inserts"]
+        detail["delta_d2h_mb"] = round(dp["delta_d2h_bytes"] / 1e6, 2)
     from flink_tpu.utils import transport
     if transport.dispatch_ms_per_mb() is not None:
         detail["dispatch_ms_per_mb"] = round(transport.dispatch_ms_per_mb(), 2)
@@ -1547,9 +1585,13 @@ def main():
         tier = "smoke" if args.smoke else "full"
         # CPU runs (JAX_PLATFORMS=cpu smoke, or a tunnel-less host) gate
         # against their own LOW-water marks — the accelerator floors would
-        # always trip on a single CPU core
+        # always trip on a single CPU core; real-accelerator runs gate
+        # against the *_device sections (ROADMAP item 2: device rounds
+        # regress loudly, like CPU ones)
         if platform == "cpu" and f"{tier}_cpu" in budgets:
             tier = f"{tier}_cpu"
+        elif platform != "cpu" and f"{tier}_device" in budgets:
+            tier = f"{tier}_device"
         budget = budgets[tier]
         viol = check_budget(result, budget)
         for v in viol:

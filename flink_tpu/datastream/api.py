@@ -758,7 +758,8 @@ class WindowedStream:
                   emit_tier: Optional[str] = None,
                   paging=None,
                   pipeline_depth: int = 0,
-                  native_shards: int = 0) -> DataStream:
+                  native_shards: int = 0,
+                  device_probe: str = "auto") -> DataStream:
         """``paging``: a :class:`flink_tpu.state.paging.PagingConfig` caps
         the operator's resident key capacity — cold keys page out to the
         spill tier (state larger than HBM).  ``emit_tier`` overrides the
@@ -766,7 +767,12 @@ class WindowedStream:
         0 runs the operator's hot stage (probe/mirror + device dispatch)
         as a bounded software pipeline overlapping the task driver;
         ``native_shards`` partitions the native probe across cores (0 =
-        auto) — both bit-identical to the serial defaults."""
+        auto) — both bit-identical to the serial defaults.
+        ``device_probe`` gates the device-resident key probe
+        (``state/device_keyindex.py``: warm keys resolve inside the jitted
+        step, the host C fold touches only misses) — "auto" runs a
+        measured A/B calibration, "on"/"off" force; bit-identical fires
+        and snapshots either way."""
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
         late_tag = getattr(self, "_late_tag", None)
@@ -860,12 +866,15 @@ class WindowedStream:
                 if mesh is not None:
                     from flink_tpu.parallel.mesh_runtime import (
                         MeshWindowAggOperator)
-                    return MeshWindowAggOperator(mesh=mesh, **kwargs)
+                    return MeshWindowAggOperator(mesh=mesh,
+                                                 device_probe=device_probe,
+                                                 **kwargs)
                 if emit_tier is not None:
                     kwargs["emit_tier"] = emit_tier
                 return WindowAggOperator(paging=paging,
                                          pipeline_depth=pipeline_depth,
                                          native_shards=native_shards,
+                                         device_probe=device_probe,
                                          **kwargs)
 
         t = keyed._then(name, factory)

@@ -131,6 +131,15 @@ from flink_tpu.ops.shapes import next_pow2 as _next_pow2  # noqa: E402
 _PAD_ID = np.int32(np.iinfo(np.int32).max)
 
 
+def _x64():
+    """Scoped 64-bit trace context for the device-probe DELTA arrays: the
+    mirror's f64/i64 precision must ride the device, but the repo runs jax
+    in 32-bit mode — ``enable_x64`` widens dtypes for exactly the delta
+    steps (allocation, fold, pull, clear) and nothing else."""
+    from jax.experimental import enable_x64
+    return enable_x64()
+
+
 def _device_trace():
     """``jax.profiler`` annotation around the jitted device step: nests the
     dispatch under "window_agg.device_step" in profiler traces
@@ -313,6 +322,7 @@ class WindowAggOperator(StreamOperator):
         paging=None,
         pipeline_depth: int = 0,
         native_shards: int = 0,
+        device_probe: str = "auto",
     ):
         #: host tier: use the C++ WinMirror kernels (fused probe+mirror,
         #: compacting fire) when eligible; False pins the numpy mirror —
@@ -592,6 +602,26 @@ class WindowAggOperator(StreamOperator):
         import threading as _threading
         self._tier_lock = _threading.Lock()
 
+        # ---- device-resident key probe (state/device_keyindex.py): resolve
+        # warm keys ON the device, inside the already-dispatched XLA step —
+        # the host C pass then touches only misses.  Warm-row contributions
+        # accumulate in device-resident DELTA arrays (mirror precision:
+        # f64/i64) and the host value mirror catches up pane-granularly at
+        # fire/snapshot/verify time (wm_apply_delta + a bounded d2h pull of
+        # only the panes about to fire).  "auto" runs the measured A/B
+        # calibration (calibrated_device_probe); "on"/"off" force.
+        if device_probe not in ("auto", "on", "off"):
+            raise ValueError(f"device_probe must be auto|on|off, "
+                             f"got {device_probe!r}")
+        self.device_probe = device_probe
+        self._dki = None                      # DeviceKeyIndex when active
+        self._devprobe_resolved: Optional[bool] = None
+        self._delta_leaves = None             # mirror-dtype [K, P] arrays
+        self._delta_counts = None             # int32 [K, P]
+        self._delta_panes: set = set()        # panes with unsynced delta
+        self._dp_stats = {"probe_hits": 0, "probe_misses": 0,
+                          "miss_inserts": 0, "delta_syncs": 0}
+
     #: snapshot entries row-indexed by key slot (rescale redistribution)
     ROW_FIELDS = ("leaves", "counts")
 
@@ -695,6 +725,11 @@ class WindowAggOperator(StreamOperator):
         with self._tier_lock:
             self._tier_epoch += 1   # fence any in-flight promotion
         self._active_rows = None
+        self._dki = None            # device probe table died with key_index
+        self._drop_delta()
+        self._devprobe_resolved = None
+        self._dp_stats = {"probe_hits": 0, "probe_misses": 0,
+                          "miss_inserts": 0, "delta_syncs": 0}
         if self._pager is not None:
             self._pager.reset()
 
@@ -779,6 +814,437 @@ class WindowAggOperator(StreamOperator):
             acc = self.phase_shard_ns[phase] = grown
         acc[:shard_ns.size] += shard_ns
 
+    # ----------------------------------------------- device-resident probe
+    def _devprobe_table_sharding(self):
+        """Placement for the device probe table (None = default device);
+        the mesh subclass keeps it unsharded too (the probe runs as one
+        plain dispatch; only the fold rides the exchange)."""
+        return None
+
+    def _devprobe_eligible(self) -> bool:
+        """Static eligibility of the device-resident key probe: the host
+        emit tier (the probe_mirror wall lives there), int64 keys, scalar
+        add/min/max accumulator leaves (the delta fold + wm_apply_delta
+        contract), and no paging — the pager needs every record's global
+        id ON THE HOST to translate gid -> resident row per batch, so a
+        device-resolved slot would be pulled straight back; the probe is
+        not the wall there (there is no host mirror fold to fuse with)."""
+        return (self.device_probe != "off"
+                and self.emit_tier == "host"
+                and self._pager is None
+                and self.kinds is not None
+                and all(tuple(s) == () for s in self.spec.leaf_shapes)
+                and isinstance(self.key_index, KeyIndex)
+                and not self.trigger.fires_on_count)
+
+    def _devprobe_active(self, sync: str) -> bool:
+        """Per-batch gate: resolved once per key-index lifetime ("on"
+        forces, "auto" asks the measured A/B calibration), then cheap."""
+        if self._degraded or sync not in ("scatter", "deferred"):
+            return False
+        if self._devprobe_resolved is None:
+            if not self._devprobe_eligible():
+                self._devprobe_resolved = False
+            elif self.device_probe == "on":
+                self._devprobe_resolved = True
+            else:
+                from flink_tpu.state.device_keyindex import \
+                    calibrated_device_probe
+                self._devprobe_resolved = calibrated_device_probe()
+        return self._devprobe_resolved
+
+    def device_probe_stats(self) -> Dict[str, Any]:
+        """Device-probe counters (monitoring-grade, no pipeline barrier):
+        hits/misses resolve the warm-key story (steady state ~= 100% hit
+        rate ⇒ the host C fold touches only miss rows), ``miss_inserts``
+        counts table scatters, ``delta_d2h_bytes`` the pane-granular
+        mirror catch-up pulls."""
+        s = dict(self._dp_stats)
+        total = s["probe_hits"] + s["probe_misses"]
+        s["enabled"] = int(bool(self._devprobe_resolved))
+        s["probe_hit_rate"] = (s["probe_hits"] / total) if total else None
+        s["delta_d2h_bytes"] = int(self.phase_bytes.get("delta_d2h", 0))
+        return s
+
+    def _drop_delta(self) -> None:
+        self._delta_leaves = None
+        self._delta_counts = None
+        self._delta_panes = set()
+
+    def _ensure_delta(self) -> None:
+        """Allocate the device-resident DELTA ring [K, P] in the MIRROR
+        dtypes (f64/i64 — the higher-precision twins, so warm-row folds
+        carry exactly the precision the host mirror fold would have)."""
+        if self._delta_counts is not None \
+                and self._delta_counts.shape == (self._K, self._P):
+            return
+        with _x64():
+            leaves = []
+            for init, mdt in zip(self.spec.leaf_inits, self._mirror_dtypes):
+                iv = np.asarray(init).astype(mdt)
+                leaves.append(jnp.broadcast_to(
+                    jnp.asarray(iv), (self._K, self._P)).copy())
+            counts = jnp.zeros((self._K, self._P), jnp.int32)
+            if self.sharding is not None:
+                leaves = [jax.device_put(l, self.sharding) for l in leaves]
+                counts = jax.device_put(counts, self.sharding)
+        self._delta_leaves = tuple(leaves)
+        self._delta_counts = counts
+        self._delta_panes = set()
+
+    def _delta_fold(self, dleaves, dcounts, flat, lifted):
+        """Traced helper: scatter-combine one batch's (flat id, value)
+        pairs into the delta ring (scatter_fast casts the f32 lifted
+        leaves up to the delta's f64/i64 dtypes)."""
+        K, P = dcounts.shape
+        dflat = tuple(l.reshape(K * P) for l in dleaves)
+        new = scatter_fast(dflat, flat, lifted, self.kinds)
+        ndl = tuple(l.reshape(K, P) for l in new)
+        ndc = dcounts.reshape(K * P).at[flat].add(
+            jnp.ones(flat.shape, jnp.int32), mode="drop").reshape(K, P)
+        return ndl, ndc
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4, 5, 6))
+    def _probed_update_step(self, tab, b, leaves, counts, dleaves, dcounts,
+                            key_lo, key_hi, start, pane_slots, values):
+        """Scatter-sync micro-batch with the key probe INSIDE the jitted
+        step: probe the device table, fold warm (hit) rows into both the
+        device state (device precision) and the delta ring (mirror
+        precision), and return a compact miss list for the host.  Miss and
+        pad rows carry the dropped _PAD_ID.  The scalar miss count is the
+        host's only mandatory read-back."""
+        from flink_tpu.state.device_keyindex import probe_impl
+        _name, probe = probe_impl(int(tab[0].shape[0]))
+        slot = probe(*tab, key_lo, key_hi, start)
+        Bp = key_lo.shape[0]
+        valid = jnp.arange(Bp, dtype=jnp.int32) < b
+        hit = valid & (slot >= 0)
+        K, P = counts.shape
+        flat = jnp.where(hit, slot * P + pane_slots, _PAD_ID)
+        lifted = tuple(jax.tree_util.tree_leaves(self.agg.lift(values)))
+        flat_leaves = tuple(l.reshape((K * P,) + l.shape[2:])
+                            for l in leaves)
+        new_flat = scatter_fast(flat_leaves, flat, lifted, self.kinds)
+        new_leaves = tuple(l.reshape((K, P) + l.shape[1:]) for l in new_flat)
+        ones = jnp.ones(flat.shape, jnp.int32)
+        new_counts = counts.reshape(K * P).at[flat].add(
+            ones, mode="drop").reshape(K, P)
+        ndl, ndc = self._delta_fold(dleaves, dcounts, flat, lifted)
+        miss = valid & (slot < 0)
+        miss_idx = jnp.nonzero(miss, size=Bp,
+                               fill_value=Bp)[0].astype(jnp.int32)
+        miss_count = jnp.sum(miss, dtype=jnp.int32)
+        return new_leaves, new_counts, ndl, ndc, miss_idx, miss_count
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4))
+    def _probed_delta_step(self, tab, b, dleaves, dcounts,
+                           key_lo, key_hi, start, pane_slots, values):
+        """Deferred-sync twin of :meth:`_probed_update_step`: the mirror is
+        authoritative, so warm rows fold into the delta ring ONLY (the
+        device state replica catches up at device_refresh, as before)."""
+        from flink_tpu.state.device_keyindex import probe_impl
+        _name, probe = probe_impl(int(tab[0].shape[0]))
+        slot = probe(*tab, key_lo, key_hi, start)
+        Bp = key_lo.shape[0]
+        valid = jnp.arange(Bp, dtype=jnp.int32) < b
+        hit = valid & (slot >= 0)
+        P = dcounts.shape[1]
+        flat = jnp.where(hit, slot * P + pane_slots, _PAD_ID)
+        lifted = tuple(jax.tree_util.tree_leaves(self.agg.lift(values)))
+        ndl, ndc = self._delta_fold(dleaves, dcounts, flat, lifted)
+        miss = valid & (slot < 0)
+        miss_idx = jnp.nonzero(miss, size=Bp,
+                               fill_value=Bp)[0].astype(jnp.int32)
+        miss_count = jnp.sum(miss, dtype=jnp.int32)
+        return ndl, ndc, miss_idx, miss_count
+
+    @partial(jax.jit, static_argnums=(0, 3))
+    def _delta_pull_step(self, dleaves, dcounts, rows: int, pane_slots):
+        """Bounded d2h pull: the delta columns of the panes about to be
+        read (fire/snapshot/verify), first ``rows`` key rows only — the
+        download scales with live keys x syncing panes, never the ring."""
+        cnt = jnp.take(dcounts[:rows], pane_slots, axis=1,
+                       mode="fill", fill_value=0)
+        sel = tuple(jnp.take(l[:rows], pane_slots, axis=1,
+                             mode="fill", fill_value=0)
+                    for l in dleaves)
+        return cnt, sel
+
+    @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
+    def _delta_clear_step(self, dleaves, dcounts, pane_slots):
+        """Reset synced (or expired) delta columns back to identity."""
+        new_leaves = []
+        for l, init, mdt in zip(dleaves, self.spec.leaf_inits,
+                                self._mirror_dtypes):
+            iv = np.asarray(init).astype(mdt)
+            fill = jnp.broadcast_to(jnp.asarray(iv),
+                                    (l.shape[0], pane_slots.shape[0]))
+            new_leaves.append(l.at[:, pane_slots].set(fill, mode="drop"))
+        return tuple(new_leaves), dcounts.at[:, pane_slots].set(
+            0, mode="drop")
+
+    def _devprobe_sync_mirror(self, panes=None) -> None:
+        """Pane-granular mirror catch-up: pull the delta columns of
+        ``panes`` (None = every unsynced pane), fold them into the host
+        value mirror (``wm_apply_delta`` / numpy twin), and reset those
+        delta columns on device.  Identity delta rows fold as no-ops, so
+        no mask rides the transfer."""
+        if self._delta_counts is None or not self._delta_panes:
+            return
+        if panes is None:
+            sync = sorted(self._delta_panes)
+        else:
+            want = {int(p) for p in np.asarray(panes).reshape(-1).tolist()}
+            sync = sorted(self._delta_panes & want)
+        if not sync:
+            return
+        n = self.key_index.num_keys if self.key_index is not None else 0
+        if n == 0:
+            self._delta_panes.difference_update(sync)
+            return
+        with self._phase("delta_sync"):
+            rows = min(_next_pow2(max(n, 1), 1024), self._K)
+            m = len(sync)
+            mp = _next_pow2(m, 1)
+            slots_np = np.full(mp, self._P, np.int32)   # pads: dropped
+            slots_np[:m] = np.asarray(sync, np.int64) % self._P
+            with _x64():
+                slots_d = jnp.asarray(slots_np)
+                cnt, sel = self._delta_pull_step(
+                    self._delta_leaves, self._delta_counts, rows, slots_d)
+                cnt_np = np.asarray(cnt)
+                sel_np = [np.asarray(l) for l in sel]
+                self._delta_leaves, self._delta_counts = \
+                    self._delta_clear_step(self._delta_leaves,
+                                           self._delta_counts, slots_d)
+            self.phase_bytes["delta_d2h"] = \
+                self.phase_bytes.get("delta_d2h", 0) + cnt_np.nbytes + \
+                sum(l.nbytes for l in sel_np)
+            for j, p in enumerate(sync):
+                col_cnt = cnt_np[:n, j]
+                if not col_cnt.any():
+                    continue
+                if self._nm is not None:
+                    self._nm.apply_delta(int(p), col_cnt.astype(np.int64),
+                                         [l[:n, j] for l in sel_np])
+                else:
+                    entry = self._vmirror_pane(int(p))
+                    entry[0][:n] += col_cnt
+                    for k, kind in enumerate(self.kinds):
+                        ufunc = SCATTER_UFUNCS[kind]
+                        entry[k + 1][:n] = ufunc(
+                            entry[k + 1][:n],
+                            sel_np[k][:n, j].astype(self._mirror_dtypes[k],
+                                                    copy=False))
+            self._delta_panes.difference_update(sync)
+            self._dp_stats["delta_syncs"] += 1
+
+    def _hot_stage_devprobe(self, keys: np.ndarray, panes: np.ndarray,
+                            values, B: int, sync: str) -> None:
+        """Device-probe variant of the hot stage: one guarded dispatch
+        probes + folds the warm rows; the host pass then touches ONLY the
+        compact miss list (insert into the keydict, C-fold into the
+        mirror, one scatter to keep the device table current)."""
+        from flink_tpu.runtime import device_health
+        self._ensure_alloc()
+        self._ensure_delta()
+        if self._dki is None:
+            from flink_tpu.state.device_keyindex import DeviceKeyIndex
+            self._dki = DeviceKeyIndex(
+                initial_capacity=max(1 << 16, 2 * self._K),
+                sharding=self._devprobe_table_sharding())
+        self._dki.ensure_loaded(self.key_index)   # bulk/restore load
+        with self._phase("device_probe"):
+            key_lo, key_hi, start = self._dki.prepare_batch(keys)
+            Bp = _next_pow2(B, 64)
+
+            def pad32(a, fill=0):
+                out = np.full(Bp, fill, np.int32)
+                out[:B] = a
+                return out
+
+            klo_p, khi_p, st_p = pad32(key_lo), pad32(key_hi), pad32(start)
+            ps_p = pad32((panes % self._P).astype(np.int32))
+            vleaves = [np.asarray(a) for a in
+                       jax.tree_util.tree_leaves(values)]
+            treedef = jax.tree_util.tree_structure(values)
+            values_p = jax.tree_util.tree_unflatten(
+                treedef, [_pad_rows(a, Bp) for a in vleaves])
+            mb = (16 * Bp + sum(a.nbytes for a in vleaves)) / 1e6
+            tab = self._dki.table()
+            b_arr = np.int32(B)
+            geom = ("devprobe", self._dki.capacity, self._K, self._P, Bp,
+                    tuple((a.dtype.str, a.shape[1:]) for a in vleaves))
+            fresh_geom = geom != getattr(self, "_last_dispatch_geom", None)
+            self._last_dispatch_geom = geom
+
+            def thunk():
+                with _x64():
+                    if sync == "deferred":
+                        out = self._probed_delta_step(
+                            tab, b_arr, self._delta_leaves,
+                            self._delta_counts, klo_p, khi_p, st_p, ps_p,
+                            values_p)
+                    else:
+                        out = self._probed_update_step(
+                            tab, b_arr, self._leaves, self._counts,
+                            self._delta_leaves, self._delta_counts,
+                            klo_p, khi_p, st_p, ps_p, values_p)
+                # the scalar miss count is the dispatch's sync point: a
+                # wedged device must surface HERE, under the watchdog
+                return out, int(out[-1])
+
+            try:
+                res, mc = device_health.guarded_dispatch(
+                    thunk, mb=mb, on_oom=None,
+                    label=f"{self.name}.device_probe",
+                    compile_grace=fresh_geom)
+            except DeviceQuarantinedError as err:
+                self._devprobe_degrade(err, keys, panes, values)
+                return
+            if sync == "deferred":
+                (self._delta_leaves, self._delta_counts,
+                 miss_idx, _mcnt) = res
+                self._device_stale = True
+            else:
+                (self._leaves, self._counts, self._delta_leaves,
+                 self._delta_counts, miss_idx, _mcnt) = res
+                self.phase_bytes["h2d"] = \
+                    self.phase_bytes.get("h2d", 0) + mb
+            self._delta_panes.update(
+                int(p) for p in np.unique(panes).tolist())
+            self._dp_stats["probe_hits"] += B - mc
+            self._dp_stats["probe_misses"] += mc
+        if mc:
+            self._devprobe_handle_misses(keys, panes, values, miss_idx, mc,
+                                         sync)
+
+    def _devprobe_absorb_misses(self, mkeys, mpanes, mvalues) -> np.ndarray:
+        """Shared miss-list host pass (single-chip AND mesh): fused C
+        probe+mirror fold over the miss rows only (numpy twin when the
+        native mirror is off), key growth with a delta drain/rebuild, and
+        one scatter to bring the device table current.  Returns the miss
+        rows' slot ids."""
+        with self._phase("probe_mirror"):
+            if self._nm is not None:
+                lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+                    self.agg.host_lift(mvalues))]
+                nshards, shard_div, shard_ns = self._probe_shards()
+                mslots = self._nm.probe_update(mkeys, mpanes, lifted,
+                                               shards=nshards,
+                                               shard_div=shard_div,
+                                               shard_ns=shard_ns)
+                self._record_shard_ns("probe_mirror", shard_ns)
+            else:
+                mslots = self.key_index.lookup_or_insert(mkeys)
+        if self.key_index.num_keys > self._K:
+            # growth reallocates the delta ring: drain it into the mirror
+            # first so no warm contribution is lost, then rebuild at newK
+            self._devprobe_sync_mirror(None)
+            self._drop_delta()
+            self._grow_keys(self.key_index.num_keys)
+            self._ensure_delta()
+        if self._nm is None:
+            # numpy value mirror: fold AFTER growth (the pane entries must
+            # already be sized for the new key count)
+            with self._phase("mirror"):
+                self._vmirror_update(mslots, mpanes, mvalues)
+        self._dp_stats["miss_inserts"] += \
+            self._dki.ensure_loaded(self.key_index)
+        return mslots
+
+    def _devprobe_handle_misses(self, keys, panes, values, miss_idx,
+                                mc: int, sync: str) -> None:
+        """The host pass over the compact miss list, plus — under scatter
+        sync — the miss rows' device-state fold."""
+        mi = np.asarray(miss_idx)[:mc].astype(np.int64)
+        mkeys = np.ascontiguousarray(keys[mi])
+        mpanes = np.ascontiguousarray(panes[mi])
+        mvalues = jax.tree_util.tree_map(lambda a: np.asarray(a)[mi],
+                                         values)
+        mslots = self._devprobe_absorb_misses(mkeys, mpanes, mvalues)
+        if sync != "deferred":
+            # the device replica must see every record: fold the miss rows
+            # through the plain (guarded) update step — host-built flat
+            # ids, the same watchdog/OOM/quarantine path as every other
+            # hot-path dispatch
+            Bm = int(mi.size)
+            Bmp = _next_pow2(Bm, 64)
+            flat = np.full(Bmp, _PAD_ID, np.int32)
+            flat[:Bm] = (mslots.astype(np.int64) * self._P
+                         + (mpanes % self._P)).astype(np.int32)
+            vleaves = [np.asarray(a) for a in
+                       jax.tree_util.tree_leaves(mvalues)]
+            treedef = jax.tree_util.tree_structure(mvalues)
+            values_p = jax.tree_util.tree_unflatten(
+                treedef, [_pad_rows(a, Bmp) for a in vleaves])
+            mb = (flat.nbytes + sum(a.nbytes for a in vleaves)) / 1e6
+            try:
+                with self._phase("device_dispatch"):
+                    res = self._guarded_update(flat, values_p, mb)
+            except DeviceQuarantinedError as err:
+                # every record is already accounted for in mirror-land
+                # (warm rows in the delta, miss rows C-folded above):
+                # degrade without refolding anything
+                self._devprobe_degrade(err)
+                return
+            self._leaves, self._counts = res[0], res[1]
+
+    def _devprobe_degrade(self, err: BaseException, keys=None, panes=None,
+                          values=None) -> None:
+        """Quarantine mid-batch with the device probe active: salvage the
+        unsynced delta into the mirror (under the monitor's bounded
+        salvage deadline — a REALLY wedged device fails the pull and the
+        task restarts from the last checkpoint, whose snapshot always
+        drained the delta first), drop the probe state, degrade the tier,
+        and — when ``keys`` is given — fold those not-yet-accounted rows
+        through the host pass so no record is lost.  Call sites that fail
+        AFTER every record reached mirror-land (warm rows in the delta,
+        misses C-folded) pass no rows."""
+        from flink_tpu.runtime import device_health
+        try:
+            if self._delta_counts is not None and self._delta_panes:
+                mon = device_health.get_monitor(create=False)
+                if mon is not None:
+                    mon.run_salvage(
+                        lambda: self._devprobe_sync_mirror(None),
+                        label=f"{self.name} delta salvage")
+                else:
+                    self._devprobe_sync_mirror(None)
+        except Exception as serr:  # noqa: BLE001 — delta unrecoverable
+            raise err from serr
+        self._drop_delta()
+        self._dki = None
+        self._devprobe_resolved = None   # re-resolve after a heal
+        self._enter_degraded(err)        # host tier: flags only
+        if keys is None or len(keys) == 0:
+            return
+        with self._phase("probe_mirror"):
+            if self._nm is not None:
+                lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
+                    self.agg.host_lift(values))]
+                nshards, shard_div, shard_ns = self._probe_shards()
+                self._nm.probe_update(keys, panes, lifted, shards=nshards,
+                                      shard_div=shard_div,
+                                      shard_ns=shard_ns)
+            else:
+                slots = self.key_index.lookup_or_insert(keys)
+                self._vmirror_update(slots, panes, values)
+
+    def devprobe_step_cache_size(self) -> Dict[str, int]:
+        """Compiled-variant counts of the probed steps (the tier-1
+        sticky-capacity recompile smoke, like PR 6's exchange test):
+        steady state must be exactly one compile per (table capacity,
+        K_cap, batch geometry)."""
+        out = {}
+        for name in ("_probed_update_step", "_probed_delta_step"):
+            fn = getattr(type(self), name)
+            try:
+                out[name] = int(fn._cache_size())
+            except Exception:  # noqa: BLE001 — jax without the cache probe
+                out[name] = -1
+        return out
+
     # ------------------------------------------------------------- pipeline
     def _pipe_active(self) -> bool:
         """Pipelining applies to the time-triggered hot path only: count
@@ -854,6 +1320,9 @@ class WindowAggOperator(StreamOperator):
         identity).  The single source of the mirror export semantics —
         identity fill, int64->int32 counts, mirror->device dtype casts —
         shared by mirror-sourced snapshots and the deferred-sync refresh."""
+        # device-probe delta: every mirror READER lands here (snapshots,
+        # refresh, re-promotion) — drain ALL unsynced panes first
+        self._devprobe_sync_mirror(None)
         ncols = len(panes) if ncols is None else ncols
         counts = np.zeros((rows, ncols), np.int32)
         leaves = []
@@ -1004,7 +1473,10 @@ class WindowAggOperator(StreamOperator):
     def _fire_window_host(self, window_id: int,
                           panes: np.ndarray) -> List[StreamElement]:
         """Serve a window fire ENTIRELY from the host mirror: no device op,
-        no download — the emit path for egress-constrained links."""
+        no download — the emit path for egress-constrained links.  With
+        the device probe active the mirror first catches up on exactly the
+        panes about to fire (the bounded pane-granular delta pull)."""
+        self._devprobe_sync_mirror(panes)
         n = self.key_index.num_keys if self.key_index is not None else 0
         if n == 0:
             return []
@@ -1053,6 +1525,7 @@ class WindowAggOperator(StreamOperator):
             return True  # replica intentionally stale/absent in quarantine
         if self.device_sync_mode == "deferred":
             self.device_refresh()
+        self._devprobe_sync_mirror(None)   # mirror must be caught up
         if self.emit_tier != "host" or self._leaves is None \
                 or self.pane_base is None:
             return True
@@ -1504,6 +1977,10 @@ class WindowAggOperator(StreamOperator):
             # skip the replica dispatch (deferred-sync semantics) until
             # re-promotion
             sync = "deferred"
+        if self._devprobe_active(sync):
+            # device-resident key probe: warm keys resolve INSIDE the
+            # dispatched step, the host pass touches only misses
+            return self._hot_stage_devprobe(keys, panes, values, B, sync)
         staging = None
         flat_ready = False
         # flatten the value tree ONCE per batch: staging acquisition and
@@ -1654,6 +2131,11 @@ class WindowAggOperator(StreamOperator):
             while self._P < span:
                 self._P <<= 1
             return
+        if self._delta_counts is not None and span > self._P:
+            # the delta ring reallocates with P: drain it into the mirror
+            # first (no warm contribution may be lost), rebuild at new P
+            self._devprobe_sync_mirror(None)
+            self._drop_delta()
         self._ensure_alloc()
         self._grow_panes(span)
 
@@ -2025,6 +2507,22 @@ class WindowAggOperator(StreamOperator):
             self._vmirror.pop(ep, None)
             if self._nm is not None:
                 self._nm.drop_pane(ep)
+        if self._delta_counts is not None and not self._degraded:
+            # expired panes' unsynced delta is DISCARDED, exactly like the
+            # mirror pane it would have folded into (reset, or a later
+            # sync of the reused ring slot would resurrect dead data)
+            dead = [p for p in expired if p in self._delta_panes]
+            if dead:
+                m = len(dead)
+                mp2 = _next_pow2(m, 1)
+                slots_np = np.full(mp2, self._P, np.int32)
+                slots_np[:m] = np.asarray(dead, np.int64) % self._P
+                with _x64():
+                    self._delta_leaves, self._delta_counts = \
+                        self._delta_clear_step(self._delta_leaves,
+                                               self._delta_counts,
+                                               jnp.asarray(slots_np))
+                self._delta_panes.difference_update(dead)
         if self._pager is not None and not self._degraded:
             self._pager.drop_panes(expired)
         if self.pane_base > self.max_pane:
@@ -2630,6 +3128,9 @@ class WindowAggOperator(StreamOperator):
         self._P = snap["P"]
         self._nm = None          # rebinds to the restored key index below
         self._nm_tried = False
+        self._dki = None         # probe table rebuilds from the key index
+        self._drop_delta()
+        self._devprobe_resolved = None
         if "key_index" in snap:
             if snap["key_index_kind"] == "ObjectKeyIndex":
                 self.key_index = ObjectKeyIndex.restore(snap["key_index"])

@@ -41,7 +41,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.operators.session_window import SessionWindowOperator
-from flink_tpu.operators.window_agg import WindowAggOperator, _next_pow2
+from flink_tpu.operators.window_agg import (WindowAggOperator, _next_pow2,
+                                            _x64)
+from flink_tpu.runtime.device_health import DeviceQuarantinedError
 from flink_tpu.ops.scatter import scatter_fast, scatter_generic
 from flink_tpu.parallel.mesh import KG_AXIS, make_mesh, state_sharding
 
@@ -227,11 +229,186 @@ class MeshWindowAggOperator(WindowAggOperator):
         treedef = self._values_treedef
         return jax.tree_util.tree_unflatten(treedef, list(flat_values))
 
+    @partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+    def _mesh_delta_step(self, dleaves_counts, batch, cap: int):
+        """Device-probe DELTA fold over the mesh: the same bucket →
+        ``all_to_all`` → local scatter pipeline as ``_mesh_update_step``,
+        but into the sharded delta ring (mirror dtypes — warm-row
+        contributions carry the host mirror's f64/i64 precision and fold
+        into it later via ``wm_apply_delta``)."""
+        dleaves, dcounts = dleaves_counts
+        D = self.n_shards
+        K, Pn = dcounts.shape
+        KD = K // D
+
+        def step(dleaves, dcounts, dest, slots, pane_slots, *values):
+            from flink_tpu.parallel.exchange import (all_to_all_rows,
+                                                     bucket_plan,
+                                                     bucket_rows)
+            order, flat, _valid = bucket_plan(dest, D, cap)
+            bucket = lambda a, fill: bucket_rows(a, order, flat, D,  # noqa: E731
+                                                 cap, fill)
+            b_slots = bucket(slots, K)
+            b_panes = bucket(pane_slots, 0)
+            b_vals = [bucket(v, 0) for v in values]
+            rx_slots = all_to_all_rows(b_slots).reshape(D * cap)
+            rx_panes = all_to_all_rows(b_panes).reshape(D * cap)
+            rx_vals = tuple(all_to_all_rows(v).reshape((D * cap,)
+                                                       + v.shape[2:])
+                            for v in b_vals)
+            lo = jax.lax.axis_index(KG_AXIS).astype(jnp.int32) * KD
+            local = rx_slots - lo
+            ok = (rx_slots < K) & (local >= 0) & (local < KD)
+            lflat = jnp.where(ok, local * Pn + rx_panes, KD * Pn)
+            lifted = tuple(jax.tree_util.tree_leaves(
+                self.agg.lift(self._values_tree(rx_vals))))
+            dflat = tuple(l.reshape(KD * Pn) for l in dleaves)
+            new_flat = scatter_fast(dflat, lflat, lifted, self.kinds)
+            new_leaves = tuple(l.reshape(KD, Pn) for l in new_flat)
+            ones = jnp.where(ok, 1, 0).astype(jnp.int32)
+            new_counts = dcounts.reshape(KD * Pn).at[lflat].add(
+                ones, mode="drop").reshape(KD, Pn)
+            return new_leaves, new_counts
+
+        nv = len(batch) - 3
+        state_spec = P(KG_AXIS)
+        in_specs = ((state_spec,) * len(dleaves), state_spec,
+                    P(KG_AXIS), P(KG_AXIS), P(KG_AXIS)) \
+            + (P(KG_AXIS),) * nv
+        out_specs = ((state_spec,) * len(dleaves), state_spec)
+        from flink_tpu.parallel.mesh import shard_map_compat
+        fn = shard_map_compat(step, self.mesh, in_specs, out_specs)
+        return fn(dleaves, dcounts, *batch)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _mesh_probe_step(self, tab, b, key_lo, key_hi, start):
+        """The device-resident key probe as its own dispatch: the mesh
+        routing (bucket plan, sticky capacity) is host-computed from the
+        resolved slots, so the probe runs once up front and the slots ride
+        back with the scalar miss count."""
+        from flink_tpu.state.device_keyindex import probe_impl
+        _name, probe = probe_impl(int(tab[0].shape[0]))
+        slot = probe(*tab, key_lo, key_hi, start)
+        valid = jnp.arange(slot.shape[0], dtype=jnp.int32) < b
+        miss = valid & (slot < 0)
+        return slot, jnp.sum(miss, dtype=jnp.int32)
+
+    def _hot_stage_devprobe(self, keys: np.ndarray, panes: np.ndarray,
+                            values, B: int, sync: str) -> None:
+        """Mesh device-probe hot stage: probe on device, route the warm
+        rows' delta fold (and, under scatter sync, the full state fold)
+        through the all_to_all exchange; the host C pass touches only the
+        miss rows (sharded by the same contiguous slot ranges as ever)."""
+        from flink_tpu.runtime import device_health
+        self._ensure_alloc()
+        self._ensure_delta()
+        if self._dki is None:
+            from flink_tpu.state.device_keyindex import DeviceKeyIndex
+            self._dki = DeviceKeyIndex(
+                initial_capacity=max(1 << 16, 2 * self._K),
+                sharding=self._devprobe_table_sharding())
+        self._dki.ensure_loaded(self.key_index)
+        mi = np.empty(0, np.int64)
+        with self._phase("device_probe"):
+            key_lo, key_hi, start = self._dki.prepare_batch(keys)
+            Bp = _next_pow2(B, 64)
+
+            def pad32(a, fill=0):
+                out = np.full(Bp, fill, np.int32)
+                out[:B] = a
+                return out
+
+            klo_p, khi_p, st_p = pad32(key_lo), pad32(key_hi), pad32(start)
+            geom = ("mesh_devprobe", self._dki.capacity, Bp)
+            fresh_geom = geom != getattr(self, "_last_dispatch_geom", None)
+            self._last_dispatch_geom = geom
+
+            def thunk():
+                slot_d, miss_d = self._mesh_probe_step(
+                    self._dki.table(), np.int32(B), jnp.asarray(klo_p),
+                    jnp.asarray(khi_p), jnp.asarray(st_p))
+                return slot_d, int(miss_d)
+
+            try:
+                slot_d, mc = device_health.guarded_dispatch(
+                    thunk, mb=12 * Bp / 1e6, on_oom=None,
+                    label=f"{self.name}.device_probe",
+                    compile_grace=fresh_geom)
+            except DeviceQuarantinedError as err:
+                self._devprobe_degrade(err, keys, panes, values)
+                return
+            slots = np.array(np.asarray(slot_d)[:B], np.int32)
+            self._dp_stats["probe_hits"] += B - mc
+            self._dp_stats["probe_misses"] += mc
+        if mc:
+            mi = np.flatnonzero(slots < 0)
+            mkeys = np.ascontiguousarray(keys[mi])
+            mpanes = np.ascontiguousarray(panes[mi])
+            mvalues = jax.tree_util.tree_map(lambda a: np.asarray(a)[mi],
+                                             values)
+            slots[mi] = self._devprobe_absorb_misses(mkeys, mpanes, mvalues)
+        panes_mod = (panes % self._P).astype(np.int32)
+        hit_mask = np.ones(B, bool)
+        if mc:
+            hit_mask[mi] = False
+        mb = sum(np.asarray(a).nbytes for a in
+                 jax.tree_util.tree_leaves(values)) / 1e6
+        if hit_mask.any():
+            h_idx = np.flatnonzero(hit_mask)
+            h_vals = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[h_idx], values)
+            try:
+                with self._phase("device_probe"):
+                    device_health.guarded_dispatch(
+                        lambda: self._apply_delta_update(
+                            h_vals, int(h_idx.size), slots[h_idx],
+                            panes_mod[h_idx]),
+                        mb=mb, label=f"{self.name}.delta_fold")
+            except DeviceQuarantinedError as err:
+                # warm rows never reached the delta (the chaos/dispatch
+                # failure precedes execution): refold exactly those rows
+                # on the host; misses are already in the mirror
+                self._devprobe_degrade(
+                    err, np.ascontiguousarray(keys[h_idx]),
+                    np.ascontiguousarray(panes[h_idx]), h_vals)
+                return
+            self._delta_panes.update(
+                int(p) for p in np.unique(panes[h_idx]).tolist())
+        if sync == "deferred":
+            self._device_stale = True
+        else:
+            values_np = jax.tree_util.tree_map(np.asarray, values)
+            try:
+                with self._phase("device_dispatch"):
+                    device_health.guarded_dispatch(
+                        lambda: self._apply_update(values_np, B, slots,
+                                                   panes_mod),
+                        mb=mb, label=f"{self.name}.update_step")
+            except DeviceQuarantinedError as err:
+                # every record is in mirror-land already (delta + misses):
+                # degrade without refolding
+                self._devprobe_degrade(err)
+
+    def devprobe_step_cache_size(self):
+        """Mesh twin of the probed-step recompile smoke: the probe and
+        delta steps must each compile once per (table capacity / batch
+        geometry, exchange capacity)."""
+        out = super().devprobe_step_cache_size()
+        for name in ("_mesh_probe_step", "_mesh_delta_step"):
+            fn = getattr(type(self), name)
+            try:
+                out[name] = int(fn._cache_size())
+            except Exception:  # noqa: BLE001 — jax without the cache probe
+                out[name] = -1
+        return out
+
     # ------------------------------------------------------------- host side
-    def _apply_update(self, values, B: int,
-                      slots: np.ndarray, panes: np.ndarray) -> None:
-        """Mesh replacement for the single-chip ``_update_step`` dispatch:
-        the records ride the all_to_all data plane to their owning shard."""
+    def _route_batch(self, values, B: int, slots: np.ndarray,
+                     panes: np.ndarray):
+        """Shared exchange routing for the state and delta folds: pad rows
+        to the mesh, compute destination shards, pick the STICKY bucket
+        capacity, and device_put the row-split batch.  Returns
+        ``(batch, cap)`` for a ``_mesh_*_step`` dispatch."""
         D = self.n_shards
         K = self._K
         KD = K // D
@@ -245,7 +422,7 @@ class MeshWindowAggOperator(WindowAggOperator):
             return out
 
         slots_p = pad(slots.astype(np.int32), K, np.int32)
-        panes_p = pad((panes % self._P).astype(np.int32), 0, np.int32)
+        panes_p = pad(panes.astype(np.int32), 0, np.int32)
         dest = np.minimum(slots_p.astype(np.int64) // KD, D - 1).astype(
             np.int32)
         dest[B:] = np.arange(Bp - B) % D  # spread pad rows evenly
@@ -263,9 +440,25 @@ class MeshWindowAggOperator(WindowAggOperator):
         vpad = [jax.device_put(pad(np.asarray(v), 0, np.asarray(v).dtype),
                                self._row_sharding) for v in vleaves]
         put = lambda a: jax.device_put(a, self._row_sharding)  # noqa: E731
-        batch = (put(dest), put(slots_p), put(panes_p), *vpad)
+        return (put(dest), put(slots_p), put(panes_p), *vpad), cap
+
+    def _apply_update(self, values, B: int,
+                      slots: np.ndarray, panes: np.ndarray) -> None:
+        """Mesh replacement for the single-chip ``_update_step`` dispatch:
+        the records ride the all_to_all data plane to their owning shard.
+        ``panes`` are ring slots (already mod P)."""
+        batch, cap = self._route_batch(values, B, slots, panes)
         self._leaves, self._counts = self._mesh_update_step(
             (self._leaves, self._counts), batch, cap)
+
+    def _apply_delta_update(self, values, B: int, slots: np.ndarray,
+                            panes: np.ndarray) -> None:
+        """Device-probe warm rows: fold into the SHARDED delta ring via the
+        same exchange (mirror precision — x64-scoped trace)."""
+        batch, cap = self._route_batch(values, B, slots, panes)
+        with _x64():
+            self._delta_leaves, self._delta_counts = self._mesh_delta_step(
+                (self._delta_leaves, self._delta_counts), batch, cap)
 
     def _update_step(self, leaves, counts, flat_ids, values):  # type: ignore[override]
         """Intercept the base class's device dispatch (the rest of the host

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -59,12 +60,56 @@ def auto_shards() -> int:
     return max(1, min(4, cores or os.cpu_count() or 1))
 
 
-import threading
-
 _calibrated_shards: Optional[int] = None
 #: module-scope: lazily creating the lock would itself be a check-then-act
 #: race between the first two calibrating threads
 _calib_lock = threading.Lock()
+
+
+def measure_fused_probe(lib, shards: int, n_keys: int, B: int,
+                        keys_all: np.ndarray, vals_all: np.ndarray,
+                        rounds: int = 3) -> float:
+    """Best-of-``rounds`` wall seconds of the fused C probe+fold at
+    ``shards`` over a warm ``n_keys`` table — the shared measurement
+    harness of the native-shards A/B and the device-probe calibration
+    (state/device_keyindex).  ``keys_all``/``vals_all`` hold ``rounds``
+    consecutive batches of ``B``.  The throwaway keydict/mirror pair is
+    released via try/finally even on a mid-measurement failure."""
+    import time
+    d = lib.keydict_create(2 * n_keys)
+    h = None
+    try:
+        kind = (ctypes.c_uint8 * 1)(0)   # add
+        lt = (ctypes.c_uint8 * 1)(0)     # f64 storage
+        init = np.zeros(1, np.uint64)
+        h = lib.wm_create(d, 1, kind, lt,
+                          init.ctypes.data_as(ctypes.c_void_p))
+        vdt = (ctypes.c_uint8 * 1)(1)    # VF32 input
+        warm_k = np.arange(n_keys, dtype=np.int64)
+        warm_p = np.zeros(n_keys, np.int64)
+        warm_v = np.zeros(n_keys, np.float32)
+        warm_s = np.empty(n_keys, np.int32)
+        vptr = (ctypes.c_void_p * 1)(warm_v.ctypes.data)
+        lib.wm_probe_update(h, warm_k.ctypes.data, warm_p.ctypes.data,
+                            n_keys, vptr, vdt, warm_s.ctypes.data,
+                            0, 0, 0, 0, shards)
+        panes = np.zeros(B, np.int64)
+        slots = np.empty(B, np.int32)
+        best = float("inf")
+        for i in range(rounds):
+            k = np.ascontiguousarray(keys_all[i * B:(i + 1) * B])
+            v = np.ascontiguousarray(vals_all[i * B:(i + 1) * B])
+            vp = (ctypes.c_void_p * 1)(v.ctypes.data)
+            t0 = time.perf_counter()
+            lib.wm_probe_update(h, k.ctypes.data, panes.ctypes.data, B,
+                                vp, vdt, slots.ctypes.data, 0, 0, 0, 0,
+                                shards)
+            best = min(best, time.perf_counter() - t0)
+        return best
+    finally:
+        if h:
+            lib.wm_destroy(h)
+        lib.keydict_destroy(d)
 
 
 def calibrated_shards() -> int:
@@ -92,7 +137,6 @@ def calibrated_shards() -> int:
         if auto <= 1 or lib is None or not hasattr(lib, "wm_create"):
             _calibrated_shards = 1
             return 1
-        import time
         n_keys = 1 << 15
         B = 1 << 15  # >= the C pass's parallel threshold
         rng = np.random.default_rng(17)
@@ -100,38 +144,9 @@ def calibrated_shards() -> int:
             rng.integers(0, n_keys, 3 * B).astype(np.int64))
         vals_all = np.ascontiguousarray(
             rng.random(3 * B).astype(np.float32))
-        timings = {}
-        for shards in (1, auto):
-            d = lib.keydict_create(2 * n_keys)
-            kind = (ctypes.c_uint8 * 1)(0)   # add
-            lt = (ctypes.c_uint8 * 1)(0)     # f64 storage
-            init = np.zeros(1, np.uint64)
-            h = lib.wm_create(d, 1, kind, lt,
-                              init.ctypes.data_as(ctypes.c_void_p))
-            vdt = (ctypes.c_uint8 * 1)(1)    # VF32 input
-            warm_k = np.arange(n_keys, dtype=np.int64)
-            warm_p = np.zeros(n_keys, np.int64)
-            warm_v = np.zeros(n_keys, np.float32)
-            warm_s = np.empty(n_keys, np.int32)
-            vptr = (ctypes.c_void_p * 1)(warm_v.ctypes.data)
-            lib.wm_probe_update(h, warm_k.ctypes.data, warm_p.ctypes.data,
-                                n_keys, vptr, vdt, warm_s.ctypes.data,
-                                0, 0, 0, 0, shards)
-            panes = np.zeros(B, np.int64)
-            slots = np.empty(B, np.int32)
-            best = float("inf")
-            for i in range(3):
-                k = np.ascontiguousarray(keys_all[i * B:(i + 1) * B])
-                v = np.ascontiguousarray(vals_all[i * B:(i + 1) * B])
-                vp = (ctypes.c_void_p * 1)(v.ctypes.data)
-                t0 = time.perf_counter()
-                lib.wm_probe_update(h, k.ctypes.data, panes.ctypes.data, B,
-                                    vp, vdt, slots.ctypes.data, 0, 0, 0, 0,
-                                    shards)
-                best = min(best, time.perf_counter() - t0)
-            lib.wm_destroy(h)
-            lib.keydict_destroy(d)
-            timings[shards] = best
+        timings = {shards: measure_fused_probe(lib, shards, n_keys, B,
+                                               keys_all, vals_all)
+                   for shards in (1, auto)}
         _calibrated_shards = min(timings, key=timings.get)
         return _calibrated_shards
 
@@ -261,6 +276,26 @@ class NativeWindowMirror:
             slots.ctypes.data, pane_mod, flat_ptr, flat_cap,
             int(flat_fill), max(1, int(shards)), int(shard_div), ns_ptr)
         return slots
+
+    def apply_delta(self, pane: int, counts: np.ndarray,
+                    leaves: List[np.ndarray]) -> None:
+        """Fold a pane-granular DELTA (warm-key contributions accumulated on
+        the device by the device-resident key probe) into the mirror:
+        counts add, each leaf combines by its declared kind.  Delta rows are
+        identity-initialized, so untouched rows fold as no-ops."""
+        counts = np.ascontiguousarray(counts, np.int64)
+        nl = len(self._mirror_dtypes)
+        arrs = []
+        vdt = (ctypes.c_uint8 * nl)()
+        for j, l in enumerate(leaves):
+            a = np.ascontiguousarray(l)
+            if a.dtype not in _VDT:
+                a = a.astype(np.float64)
+            arrs.append(a)
+            vdt[j] = _VDT[a.dtype]
+        ptrs = (ctypes.c_void_p * nl)(*[a.ctypes.data for a in arrs])
+        self._lib.wm_apply_delta(self._h, int(pane), counts.size,
+                                 counts.ctypes.data, ptrs, vdt)
 
     def fire(self, panes: np.ndarray
              ) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray]]:

@@ -85,6 +85,30 @@ def test_check_budget_probe_mirror_frac():
     assert check_budget(_result(phases={"probe_mirror": 950.0}), b) == []
 
 
+def test_check_budget_probe_hit_rate_floor():
+    """*_device sections gate the device-resident key probe: a hit rate
+    under the floor (the table not absorbing the warm-key steady state)
+    is a violation — but ONLY when the probe resolved on (auto
+    calibration may legitimately pick it off)."""
+    b = _budget(min_probe_hit_rate=0.8)
+    ok = _result()
+    ok["details"]["device_probe"] = "on"
+    ok["details"]["probe_hit_rate"] = 0.97
+    assert check_budget(ok, b) == []
+    bad = _result()
+    bad["details"]["device_probe"] = "on"
+    bad["details"]["probe_hit_rate"] = 0.4
+    viol = check_budget(bad, b)
+    assert len(viol) == 1 and "probe_hit_rate" in viol[0]
+    # probe calibrated OFF: the floor must not fire
+    off = _result()
+    off["details"]["device_probe"] = "off"
+    off["details"]["probe_hit_rate"] = 0.0
+    assert check_budget(off, b) == []
+    # no probe fields at all (pre-probe result shapes): not a violation
+    assert check_budget(_result(), b) == []
+
+
 def _mesh_result(rps_pod=4e6, per_shard=(150.0, 120.0), phases=None,
                  ok=True):
     return {"records_per_sec_pod": rps_pod, "ok": ok,
@@ -179,6 +203,14 @@ def test_budget_file_shape():
     assert mesh["min_rps_pod"] > 0
     assert 0 < mesh["max_shard_probe_share"] <= 1.0
     assert "probe_mirror" in mesh["max_phase_ms"]
+    # real-accelerator runs gate against the *_device sections (ROADMAP
+    # item 2's second half: device rounds regress loudly, like CPU ones)
+    for tier in ("full_device", "smoke_device"):
+        sec = budget[tier]
+        assert sec["min_rps"] > 0 and sec["max_p99_ms"] > 0
+        assert 0 < sec["min_probe_hit_rate"] <= 1.0
+        assert "device_probe" in sec["max_phase_ms"]
+        assert "delta_sync" in sec["max_phase_ms"]
 
 
 def _operator_phase_names():

@@ -114,6 +114,25 @@ def test_mesh_suite_collects_under_tier1():
              f"coverage left the gate")
 
 
+def test_device_probe_suite_collects_under_tier1():
+    """The device-resident key probe suite (ISSUE-7) must contribute tests
+    to the tier-1 run under ``JAX_PLATFORMS=cpu`` — the pure-lax probe
+    fallback exists precisely so this coverage never leaves the gate."""
+    import subprocess
+
+    f = "test_device_keyindex.py"
+    assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", str(TESTS / f)],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"{f}::" in proc.stdout, \
+        (f"{f} contributes no tests to the tier-1 selection — the device "
+         f"probe's digest-equality coverage left the gate")
+
+
 def test_marker_declarations_have_descriptions():
     """Each declared marker carries a description (the `name: text` form)
     so `pytest --markers` documents the tiers."""
