@@ -1114,6 +1114,154 @@ def run_checkpoint_backpressure(interval_ms: int, budget_ms: float,
     }
 
 
+def _cep_pattern(window_ms: int):
+    """Fraud-detection shape (examples/fraud_detection.py as a PATTERN):
+    a small 'bait' transaction followed by a large 'strike' on the same
+    key within 4 windows."""
+    from flink_tpu.cep import Pattern
+
+    return (Pattern.begin("small")
+            .where(lambda c: np.asarray(c["v"]) < 30.0)
+            .followed_by("large")
+            .where(lambda c: np.asarray(c["v"]) > 570.0)
+            .within(4 * window_ms))
+
+
+def _cep_batches(n_records: int, n_keys: int, batch_size: int,
+                 window_ms: int, seed: int = 23):
+    rng = np.random.default_rng(seed)
+    batches = []
+    t = 0
+    for lo in range(0, n_records, batch_size):
+        b = min(batch_size, n_records - lo)
+        keys = rng.integers(0, n_keys, b).astype(np.int64)
+        vals = (rng.random(b) * 600.0).astype(np.float64)
+        ts = t + np.sort(rng.integers(0, window_ms, b)).astype(np.int64)
+        t += window_ms
+        batches.append((keys, vals, ts))
+    return batches
+
+
+def run_cep_bench(args) -> dict:
+    """``--cep``: the vectorized CEP engine (ISSUE-8 tentpole) on a
+    fraud-detection-style pattern over the 1M-key stream.  Reports
+    events/sec + matches/sec + the partial-match high-water mark for the
+    batched kernel, the interpreted NFA's rate on the same stream (time-
+    budgeted — it is the per-event Python loop being replaced), the
+    engine ``auto`` calibration picked on this backend, and a small-prefix
+    equivalence check (identical matches, identical order).  With
+    ``--check`` the result gates against BENCH_BUDGET.json ``cep_cpu``."""
+    from flink_tpu.cep import CepOperator
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    n_records = args.records or (1 << 17 if args.smoke else 1 << 22)
+    n_keys = min(args.keys, n_records)
+    window_ms = args.window_ms
+    batches = _cep_batches(n_records, n_keys, args.batch_size, window_ms)
+    pattern = _cep_pattern(window_ms)
+    select = (lambda m: {"k": m["small"][0]["k"],
+                         "amount": m["large"][0]["v"]})
+
+    def one_pass(mode, budget_s=None):
+        op = CepOperator(pattern, "k", select, vectorized=mode)
+        t0 = time.perf_counter()
+        n = matches = 0
+        for keys, vals, ts in batches:
+            out = op.process_batch(
+                RecordBatch({"k": keys, "v": vals}, timestamps=ts))
+            out += op.process_watermark(Watermark(int(ts.max()) - 1))
+            matches += sum(len(b) for b in out if hasattr(b, "columns"))
+            n += keys.size
+            if budget_s and time.perf_counter() - t0 > budget_s:
+                break
+        if not budget_s:
+            tail = op.end_input()
+            matches += sum(len(b) for b in tail if hasattr(b, "columns"))
+        elapsed = time.perf_counter() - t0
+        return n / elapsed, matches / elapsed, matches, op.cep_stats()
+
+    # small-prefix equivalence: both engines, identical matches in order
+    def mini_rows(mode):
+        op = CepOperator(pattern, "k", select, vectorized=mode)
+        rows = []
+        for keys, vals, ts in _cep_batches(1 << 14, 4096, 4096, window_ms):
+            out = op.process_batch(
+                RecordBatch({"k": keys, "v": vals}, timestamps=ts))
+            out += op.process_watermark(Watermark(int(ts.max()) - 1))
+            for b in out:
+                for i in range(len(b)):
+                    rows.append((int(np.asarray(b.column("k"))[i]),
+                                 float(np.asarray(b.column("amount"))[i]),
+                                 int(np.asarray(b.timestamps)[i])))
+        return rows
+
+    equivalence_ok = mini_rows("on") == mini_rows("off")
+
+    vec = _best_of(lambda: one_pass("on"), 2 if args.smoke else 3)
+    interp = one_pass("off", budget_s=5.0 if args.smoke else 30.0)
+    auto_op = CepOperator(pattern, "k", select, vectorized="auto")
+    k0, v0, t0 = batches[0]
+    auto_op.process_batch(RecordBatch({"k": k0[:1024], "v": v0[:1024]},
+                                      timestamps=t0[:1024]))
+    auto_engine = auto_op.cep_stats()["engine"]
+
+    eps, mps, matches, stats = vec
+    i_eps, i_mps, _im, _is = interp
+    detail = {
+        "events_per_sec": round(eps, 1),
+        "matches": matches,
+        "partials_high_water": stats["partials_high_water"],
+        "interpreted_events_per_sec": round(i_eps, 1),
+        "interpreted_matches_per_sec": round(i_mps, 1),
+        "speedup_vs_interpreted": round(mps / i_mps, 2) if i_mps else None,
+        "auto_engine": auto_engine,
+        "equivalence_ok": equivalence_ok,
+        "n_records": n_records,
+        "n_keys": n_keys,
+        "vectorized_drains": stats["vectorized_drains"],
+        "degraded": stats["degraded"],
+    }
+    return {
+        "metric": f"matches/sec (CEP fraud pattern, {n_keys} keys, "
+                  f"vectorized NFA kernel)",
+        "value": round(mps, 1),
+        "unit": "matches/sec",
+        "ok": equivalence_ok and stats["degraded"] == 0,
+        "details": detail,
+    }
+
+
+def check_cep_budget(result: dict, budget: dict, smoke: bool = False) -> list:
+    """``--cep`` result vs the BENCH_BUDGET ``cep_cpu`` section: a
+    matches/sec floor (full runs), a speedup-vs-interpreted floor (the
+    acceptance bar — the batched kernel must beat the per-event Python
+    loop; relaxed at smoke size where fixed costs dominate), and the
+    equivalence check (never exit 0 on divergent matches)."""
+    viol = []
+    d = result["details"]
+    if not d.get("equivalence_ok"):
+        viol.append("vectorized-vs-interpreted equivalence check failed")
+    floor = budget.get("min_matches_per_sec")
+    if floor is not None and not smoke and result["value"] < floor:
+        viol.append(f"matches/sec {result['value']:.0f} < floor {floor:.0f}")
+    sp = d.get("speedup_vs_interpreted")
+    sp_floor = budget.get("min_speedup_smoke" if smoke
+                          else "min_speedup_vs_interpreted")
+    if sp_floor is not None and sp is None:
+        # the interpreted leg produced no matches: the A/B measured
+        # nothing, which must not read as "bar met"
+        viol.append("speedup vs interpreted unmeasured (interpreted pass "
+                    "recorded zero matches) — the acceptance bar cannot "
+                    "be skipped")
+    elif sp is not None and sp_floor is not None and sp < sp_floor:
+        viol.append(f"speedup vs interpreted {sp} < floor {sp_floor} "
+                    f"(the batched kernel is not paying for itself)")
+    if d.get("auto_engine") not in ("vectorized", "interpreted"):
+        viol.append(f"auto calibration resolved no engine: "
+                    f"{d.get('auto_engine')!r}")
+    return viol
+
+
 def run_mesh_bench(args) -> dict:
     """``--mesh-devices N``: the sharded hot path as ONE logical operator
     over an N-device mesh (forced host devices on CPU — see
@@ -1325,6 +1473,14 @@ def main():
                          "(--xla_force_host_platform_device_count); with "
                          "--check the result gates against the "
                          "BENCH_BUDGET.json mesh_cpu section")
+    ap.add_argument("--cep", action="store_true",
+                    help="standalone CEP workload: fraud-detection-style "
+                         "pattern over the 1M-key stream through the "
+                         "vectorized NFA kernel (cep/vectorized.py), "
+                         "reporting matches/sec + partials high-water + "
+                         "the measured speedup over the interpreted NFA; "
+                         "with --check gates against the BENCH_BUDGET.json "
+                         "cep_cpu section")
     ap.add_argument("--paging-cap", type=int, default=0,
                     help="also run one cold-key-paging pass (device tier, "
                          "K_cap=N < key count) and report rps + "
@@ -1375,6 +1531,22 @@ def main():
                   f"{result['completed_checkpoints']} completed",
                   file=sys.stderr)
         sys.exit(0 if result["ok"] else 1)
+
+    if args.cep:
+        result = run_cep_bench(args)
+        print(json.dumps(result))
+        print(f"# details: {json.dumps(result.get('details', {}))}",
+              file=sys.stderr)
+        if args.check:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BUDGET.json")
+            with open(path) as f:
+                budget = json.load(f).get("cep_cpu", {})
+            viol = check_cep_budget(result, budget, smoke=args.smoke)
+            for v in viol:
+                print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
+            sys.exit(1 if viol else 0)
+        sys.exit(0 if result.get("ok") else 1)
 
     if args.mesh_devices:
         result = run_mesh_bench(args)

@@ -2,11 +2,16 @@
 
 Analog of ``flink-libraries/flink-cep``: a fluent ``Pattern`` API compiled
 to an NFA run over keyed streams, with vectorized condition evaluation per
-batch and host-side transitions (``CEP.java``, ``nfa/NFA.java:86``).
+batch and — for eligible patterns — batched array-kernel NFA transitions
+advancing every key's partial matches at once (``cep/vectorized.py``;
+``CEP.java``, ``nfa/NFA.java:86``).
 """
 
 from flink_tpu.cep.operator import CEP, CepOperator, NFA, PatternStream
 from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern, Stage
+from flink_tpu.cep.vectorized import (TransitionTable, classify_pattern,
+                                      compile_pattern)
 
 __all__ = ["AfterMatchSkipStrategy", "CEP", "CepOperator", "NFA", "Pattern",
-           "PatternStream", "Stage"]
+           "PatternStream", "Stage", "TransitionTable", "classify_pattern",
+           "compile_pattern"]

@@ -7,12 +7,20 @@ Analog of ``flink-libraries/flink-cep``'s ``CepOperator`` + ``nfa/NFA.java:86``
   stage's predicate runs ONCE per batch over the whole column set, producing
   a ``[B, num_stages]`` bool matrix — the per-event work the reference does
   in ``ConditionContext`` collapses into a handful of vector ops.
-- **Host NFA transitions** (the data-dependent half): per key, events are
-  buffered until the watermark passes them (the reference buffers in
-  ``elementQueueState`` and processes on watermark,
-  ``CepOperator.onEventTime``), then sorted by timestamp and fed through the
-  NFA with branching partial matches (take/proceed — the reference's
-  ``SharedBuffer`` version tree, here explicit partial-match branches).
+- **Batched NFA transitions** (the formerly data-dependent half): for
+  eligible patterns (``cep/vectorized.py`` classifier) the per-key partial
+  matches of ALL keys advance together as fixed-shape arrays — one batched
+  state-transition dispatch per event step per drain — bit-identical to the
+  interpreted matcher below.  Ineligible shapes (``followedByAny``,
+  ``greedy()``, drain-time/``PREV`` conditions) run the interpreted
+  per-key NFA: per key, events are buffered until the watermark passes
+  them (the reference buffers in ``elementQueueState`` and processes on
+  watermark, ``CepOperator.onEventTime``), then sorted by timestamp and
+  fed through the NFA with branching partial matches.
+
+Event rows are buffered **columnar** (``_RowStore``): ``process_batch``
+never materializes per-row dicts up front — rows materialize lazily, only
+for events referenced by live partials or completed matches at emit time.
 
 Supported semantics: strict (``next``) / relaxed (``followedBy``) /
 non-deterministic relaxed (``followedByAny``) contiguity, NOT-patterns
@@ -25,6 +33,7 @@ SKIP_PAST_LAST_EVENT after-match strategies (``NFA.java:86``,
 
 from __future__ import annotations
 
+import bisect
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -32,7 +41,7 @@ import numpy as np
 
 from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
                                   Watermark)
-from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern, Stage
+from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern
 from flink_tpu.operators.base import StreamOperator
 
 
@@ -67,8 +76,9 @@ class NFA:
         #: SKIP_PAST_LAST_EVENT barrier: events at/before this ts cannot
         #: extend or start matches
         self.skip_until_ts: int = LONG_MIN
-        #: event id -> row, for match assembly (``SharedBuffer`` analog);
-        #: pruned to events referenced by live partials after every drain
+        #: legacy event-id -> row map: rows now resolve through the
+        #: operator's columnar ``_RowStore``; stays (empty) for readers of
+        #: the old layout
         self._rows: Dict[int, dict] = {}
 
     def _expired(self, pm: _Partial, ts: int) -> bool:
@@ -238,11 +248,264 @@ class NFA:
         return out
 
 
+class _RowStore:
+    """Columnar event-row store (the lazy half of the ``SharedBuffer``
+    analog): ``process_batch`` registers each batch's column arrays once;
+    row dicts materialize on demand — only for events referenced by live
+    partials or completed matches at emit time.  ``prune`` drops whole
+    batches once no referenced event id falls in their range."""
+
+    def __init__(self):
+        #: parallel sorted lists: event-id base per batch + (n, columns)
+        self._bases: List[int] = []
+        self._batches: List[Tuple[int, Dict[str, np.ndarray]]] = []
+        #: restored-snapshot rows (already materialized dicts)
+        self._extra: Dict[int, dict] = {}
+
+    def add_batch(self, cols: Dict[str, Any], base: int, n: int) -> None:
+        if n == 0:
+            return
+        self._bases.append(base)          # bases are monotone (event ids)
+        self._batches.append((n, {k: np.asarray(v)
+                                  for k, v in cols.items()}))
+
+    def put_row(self, eid: int, row: dict) -> None:
+        self._extra[eid] = row
+
+    def row(self, eid: int) -> dict:
+        r = self._extra.get(eid)
+        if r is not None:
+            return r
+        i = bisect.bisect_right(self._bases, eid) - 1
+        if i < 0:
+            raise KeyError(f"event {eid} not in row store")
+        base = self._bases[i]
+        n, arrs = self._batches[i]
+        if eid >= base + n:
+            raise KeyError(f"event {eid} not in row store")
+        j = eid - base
+
+        def cell(a):
+            x = a[j]
+            return x.item() if isinstance(x, np.generic) else x
+
+        return {k: cell(a) for k, a in arrs.items()}
+
+    def prune(self, referenced) -> None:
+        """Drop batches with no referenced event and stale restored rows.
+        ``referenced``: iterable/array of still-live event ids."""
+        ref = np.unique(np.asarray(list(referenced)
+                                   if not isinstance(referenced, np.ndarray)
+                                   else referenced, np.int64))
+        keep_b, keep_bt = [], []
+        for base, (n, arrs) in zip(self._bases, self._batches):
+            lo = np.searchsorted(ref, base)
+            if lo < ref.size and ref[lo] < base + n:
+                keep_b.append(base)
+                keep_bt.append((n, arrs))
+        self._bases, self._batches = keep_b, keep_bt
+        if self._extra:
+            refset = set(ref.tolist())
+            self._extra = {e: r for e, r in self._extra.items()
+                           if e in refset}
+
+    def stats(self) -> Dict[str, int]:
+        return {"batches": len(self._batches),
+                "restored_rows": len(self._extra)}
+
+
+class _VecState:
+    """Array-resident NFA state for ALL keys (the vectorized engine's
+    half of ``CepOperator``): ``[K, M]`` planes of (stage, count,
+    first_ts, event-ring length, rolling event hash), a ``[K, M, E]``
+    bounded event-pointer ring, per-key live count + skip barrier, and the
+    key <-> slot mapping.  M/E are sticky pow2 high-waters."""
+
+    def __init__(self, tab, kernel: str, m_cap: int = 4, e_cap: int = 4):
+        self.tab = tab
+        self.kernel = kernel
+        self.m_cap = m_cap
+        self.e_cap = e_cap
+        self.index = None                  # key index, built on first batch
+        self.n_slots = 0
+        k0 = 0
+        self.st = np.zeros((k0, m_cap), np.int32)
+        self.cnt = np.zeros((k0, m_cap), np.int32)
+        self.fst = np.full((k0, m_cap), LONG_MIN, np.int64)
+        self.eln = np.zeros((k0, m_cap), np.int32)
+        self.ev = np.zeros((k0, m_cap, e_cap), np.int64)
+        self.evh = np.zeros((k0, m_cap), np.int32)
+        self.nlv = np.zeros(k0, np.int32)
+        self.skip = np.full(k0, LONG_MIN, np.int64)
+        #: slots in first-DRAIN order (the interpreted ``_nfas`` creation
+        #: order — final negation harvests emit in this order)
+        self.drained_order: List[int] = []
+        self.drained = np.zeros(k0, bool)
+        self.rank = np.full(k0, -1, np.int32)
+        #: pending (buffered, not-yet-drained) events as columnar pieces:
+        #: dicts of slot/ts/eid int64 + bits/ubits [n, S] bool
+        self.pending: List[Dict[str, np.ndarray]] = []
+
+    # -- key slots -----------------------------------------------------------
+    def map_keys(self, keys: np.ndarray) -> np.ndarray:
+        from flink_tpu.state.keyindex import make_key_index
+
+        if self.index is None:
+            self.index = make_key_index(keys[0])
+        slots = np.asarray(self.index.lookup_or_insert(keys), np.int64)
+        self.ensure_slots(int(self.index.num_keys))
+        return slots
+
+    def key_of(self, slot: int):
+        k = self.index.reverse_keys()[slot]
+        return k.item() if isinstance(k, np.generic) else k
+
+    def ensure_slots(self, n: int) -> None:
+        if n <= self.n_slots:
+            return
+        cap = max(64, self.st.shape[0])
+        while cap < n:
+            cap *= 2
+        if cap > self.st.shape[0]:
+            grow = cap - self.st.shape[0]
+
+            def w(a, fill, dtype):
+                return np.concatenate(
+                    [a, np.full((grow,) + a.shape[1:], fill, dtype)], axis=0)
+
+            self.st = w(self.st, 0, np.int32)
+            self.cnt = w(self.cnt, 0, np.int32)
+            self.fst = w(self.fst, LONG_MIN, np.int64)
+            self.eln = w(self.eln, 0, np.int32)
+            self.ev = w(self.ev, 0, np.int64)
+            self.evh = w(self.evh, 0, np.int32)
+            self.nlv = np.concatenate(
+                [self.nlv, np.zeros(grow, np.int32)])
+            self.skip = np.concatenate(
+                [self.skip, np.full(grow, LONG_MIN, np.int64)])
+            self.drained = np.concatenate(
+                [self.drained, np.zeros(grow, bool)])
+            self.rank = np.concatenate(
+                [self.rank, np.full(grow, -1, np.int32)])
+        # fresh slots carry one pristine start partial
+        self.nlv[self.n_slots:n] = 1
+        self.n_slots = n
+
+    def grow_caps(self, m_cap: int, e_cap: int) -> None:
+        if m_cap > self.m_cap:
+            pad = m_cap - self.m_cap
+            K = self.st.shape[0]
+
+            def w(a, fill):
+                return np.concatenate(
+                    [a, np.full((K, pad) + a.shape[2:], fill, a.dtype)],
+                    axis=1)
+
+            self.st, self.cnt = w(self.st, 0), w(self.cnt, 0)
+            self.fst = w(self.fst, LONG_MIN)
+            self.eln, self.evh = w(self.eln, 0), w(self.evh, 0)
+            self.ev = w(self.ev, 0)
+            self.m_cap = m_cap
+        if e_cap > self.e_cap:
+            K, M = self.ev.shape[:2]
+            wide = np.zeros((K, M, e_cap), np.int64)
+            wide[:, :, :self.e_cap] = self.ev
+            self.ev = wide
+            self.e_cap = e_cap
+
+    # -- drain helpers -------------------------------------------------------
+    def consolidate(self) -> Optional[Dict[str, np.ndarray]]:
+        if not self.pending:
+            return None
+        if len(self.pending) == 1:
+            out = self.pending[0]
+        else:
+            out = {k: np.concatenate([p[k] for p in self.pending])
+                   for k in self.pending[0]}
+        self.pending = []
+        return out
+
+    def gather(self, slots: np.ndarray, m_cap: int, e_cap: int):
+        """Copy the rows for ``slots`` into a compact block at the
+        requested caps (the transactional unit the kernel advances).
+        Narrower-than-storage caps are fine when the rows fit — callers
+        size them from the rows' own nlv/eln high-water, so only dead
+        (pristine) columns are dropped."""
+        kc = slots.size
+        wm = min(m_cap, self.st.shape[1])
+
+        def g2(a, fill, dtype):
+            out = np.full((kc, m_cap), fill, dtype)
+            out[:, :wm] = a[slots][:, :wm]
+            return out
+
+        we = min(e_cap, self.ev.shape[2])
+        ev = np.zeros((kc, m_cap, e_cap), np.int64)
+        ev[:, :wm, :we] = self.ev[slots][:, :wm, :we]
+        return (g2(self.st, 0, np.int32), g2(self.cnt, 0, np.int32),
+                g2(self.fst, LONG_MIN, np.int64), g2(self.eln, 0, np.int32),
+                ev, g2(self.evh, 0, np.int32),
+                self.nlv[slots].copy(), self.skip[slots].copy())
+
+    def adopt(self, chunks, m_cap: int, e_cap: int) -> None:
+        """Commit the advanced blocks (after the whole drain's compute
+        succeeded — a quarantined dispatch leaves the state untouched)."""
+        self.grow_caps(m_cap, e_cap)
+        for slots, block in chunks:
+            if (block[4].shape[1] < self.m_cap
+                    or block[4].shape[2] < self.e_cap):
+                block = _grow_block(block, self.m_cap, self.e_cap)
+            st, cnt, fst, eln, ev, evh, nlv, skip = block
+            self.st[slots] = st
+            self.cnt[slots] = cnt
+            self.fst[slots] = fst
+            self.eln[slots] = eln
+            self.ev[slots] = ev
+            self.evh[slots] = evh
+            self.nlv[slots] = nlv
+            self.skip[slots] = skip
+
+    def mark_drained(self, slots: np.ndarray) -> None:
+        newly = slots[~self.drained[slots]]
+        if newly.size:
+            self.drained[newly] = True
+            base = len(self.drained_order)
+            self.rank[newly] = base + np.arange(newly.size, dtype=np.int32)
+            self.drained_order.extend(int(s) for s in newly)
+
+    def referenced_event_ids(self) -> np.ndarray:
+        """Event ids referenced by any live partial (for row pruning)."""
+        from flink_tpu.cep.vectorized import _PACK_MASK
+
+        rows = np.flatnonzero((self.nlv > 0)
+                              & (self.eln.max(axis=1, initial=0) > 0))
+        if rows.size == 0:
+            return np.empty(0, np.int64)
+        ev = self.ev[rows]
+        eln = self.eln[rows]
+        mask = (np.arange(ev.shape[2])[None, None, :]
+                < eln[:, :, None])
+        return np.unique(ev[mask] & np.int64(_PACK_MASK))
+
+    def total_partials(self) -> int:
+        if self.n_slots == 0:
+            return 0
+        return int(self.nlv[:self.n_slots][
+            self.drained[:self.n_slots]].sum())
+
+
 class CepOperator(StreamOperator):
     """Keyed CEP: buffer events to watermark, run per-key NFAs, emit matches.
 
     ``select_fn(match: Dict[stage_name, List[row_dict]]) -> row_dict``
     (``PatternSelectFunction`` analog).
+
+    ``vectorized``: ``"auto"`` (default — eligible patterns use the batched
+    array kernel when the process-wide calibration says it wins on this
+    backend, like ``--device-probe``), ``"on"`` (force; raises on
+    ineligible patterns), ``"off"`` (interpreted NFA).  Both engines are
+    bit-identical on eligible patterns — same matches, same order, same
+    snapshots.
     """
 
     def __init__(self, pattern: Pattern, key_column: str,
@@ -250,7 +513,8 @@ class CepOperator(StreamOperator):
                  name: str = "cep",
                  defer_conditions: bool = False,
                  prev_columns: Optional[List[str]] = None,
-                 leftmost_order_column: Optional[str] = None):
+                 leftmost_order_column: Optional[str] = None,
+                 vectorized: str = "auto"):
         last = pattern.stages[-1]
         if last.negated and last.contiguity != "strict" \
                 and pattern.within_ms is None:
@@ -258,6 +522,9 @@ class CepOperator(StreamOperator):
             # without a within window (the match could never complete)
             raise ValueError("notFollowedBy cannot be the last pattern "
                              "stage without within()")
+        if vectorized not in ("auto", "on", "off"):
+            raise ValueError(f"vectorized must be auto|on|off, "
+                             f"got {vectorized!r}")
         self.pattern = pattern
         self.key_column = key_column
         self.select_fn = select_fn
@@ -275,40 +542,125 @@ class CepOperator(StreamOperator):
         #: row (``SqlMatchRecognize`` leftmost semantics); CEP emits all.
         #: Names the rowtime column used to order starts.
         self.leftmost_order_column = leftmost_order_column
+        self.vectorized = vectorized
         self._nfas: Dict[Any, NFA] = {}
-        #: per key: list of (ts, event_id, stage_bits, until_bits|None, row)
+        #: per key: list of (ts, event_id, stage_bits, until_bits|None) —
+        #: rows live in the columnar ``_RowStore``, not here
         self._buffers: Dict[Any, List] = {}
         #: per key: last drained row (PREV continuity across drains)
         self._last_row: Dict[Any, dict] = {}
         self._next_event_id = 0
         self.watermark = LONG_MIN
+        self._rowstore = _RowStore()
+        self._engine: Optional[str] = None
+        self._engine_reasons: List[str] = []
+        self._vec: Optional[_VecState] = None
+        self._stats = {"matches": 0, "partials_high_water": 0,
+                       "vectorized_drains": 0, "interpreted_drains": 0,
+                       "degraded": 0}
+        self._partials_total = 0          # interpreted engine's live count
+        if vectorized == "on":
+            ok, reasons = self._classify()
+            if not ok:
+                raise ValueError(
+                    "vectorized='on' but the pattern is not eligible for "
+                    "the batched kernel: " + "; ".join(reasons))
 
+    # -- engine resolution ---------------------------------------------------
+    def _classify(self) -> Tuple[bool, List[str]]:
+        from flink_tpu.cep.vectorized import classify_pattern
+
+        ok, reasons = classify_pattern(self.pattern)
+        if self.defer_conditions:
+            ok = False
+            reasons.append("drain-time (deferred/PREV) condition evaluation")
+        if self.leftmost_order_column is not None:
+            ok = False
+            reasons.append("leftmost-match pruning (MATCH_RECOGNIZE "
+                           "SKIP PAST LAST ROW)")
+        return ok, reasons
+
+    def _resolve_engine(self) -> None:
+        if self._engine is not None:
+            return
+        from flink_tpu.cep import vectorized as V
+
+        ok, reasons = self._classify()
+        if self.vectorized == "off":
+            self._engine = "interpreted"
+            self._engine_reasons = ["vectorized='off'"]
+        elif self.vectorized == "on":
+            if not ok:
+                raise ValueError("vectorized='on' but the pattern is not "
+                                 "eligible: " + "; ".join(reasons))
+            self._engine = "vectorized"
+        else:
+            if ok and V.calibrated_vectorized_cep():
+                self._engine = "vectorized"
+            else:
+                self._engine = "interpreted"
+                if ok:
+                    reasons = ["calibration picked the interpreted NFA on "
+                               "this backend"]
+                self._engine_reasons = reasons
+        if self._engine == "vectorized":
+            self._vec = _VecState(V.compile_pattern(self.pattern),
+                                  V.default_kernel())
+
+    def cep_stats(self) -> Dict[str, Any]:
+        """Monitoring-grade counters: engine, matches emitted, the
+        partial-match high-water mark, drain counts per engine, and
+        mid-job degradations (quarantine fallbacks).  Never blocks: an
+        auto-mode operator that has not processed a batch yet reports
+        ``engine="unresolved"`` instead of running the calibration A/B on
+        the stats path."""
+        out = dict(self._stats)
+        out["engine"] = self._engine or "unresolved"
+        out["fallback_reasons"] = list(self._engine_reasons)
+        out.update(self._rowstore.stats())
+        return out
+
+    # -- ingestion -----------------------------------------------------------
     def process_batch(self, batch: RecordBatch) -> List[StreamElement]:
         if len(batch) == 0:
             return []
+        self._resolve_engine()
         cols = batch.columns
         if self.defer_conditions:
             bits = ubits = None
         else:
-            # vectorized: all stage (and until) conditions over the batch
+            # vectorized: all stage (and until) conditions over the batch —
+            # these [B, S] planes are the kernel's condition inputs
             bits = np.stack([s.matches(cols) for s in self.pattern.stages],
                             axis=1)
             ubits = (np.stack([s.until_matches(cols)
                                for s in self.pattern.stages], axis=1)
                      if any(s.until is not None for s in self.pattern.stages)
                      else None)
-        keys = np.asarray(cols[self.key_column])
         ts = (np.asarray(batch.timestamps, np.int64)
               if batch.timestamps is not None
               else np.arange(len(batch), dtype=np.int64) + self._next_event_id)
-        rows = batch.to_rows()
-        for i in range(len(batch)):
-            k = keys[i].item() if isinstance(keys[i], np.generic) else keys[i]
-            eid = self._next_event_id
-            self._next_event_id += 1
-            self._buffers.setdefault(k, []).append(
-                (int(ts[i]), eid, None if bits is None else bits[i],
-                 None if ubits is None else ubits[i], rows[i]))
+        base = self._next_event_id
+        self._next_event_id += len(batch)
+        self._rowstore.add_batch(cols, base, len(batch))
+        keys = np.asarray(cols[self.key_column])
+        if self._engine == "vectorized":
+            slots = self._vec.map_keys(keys)
+            piece = {"slot": slots,
+                     "ts": ts.astype(np.int64),
+                     "eid": base + np.arange(len(batch), dtype=np.int64),
+                     "bits": bits,
+                     "ubits": (ubits if ubits is not None
+                               else np.zeros_like(bits))}
+            self._vec.pending.append(piece)
+        else:
+            for i in range(len(batch)):
+                k = (keys[i].item() if isinstance(keys[i], np.generic)
+                     else keys[i])
+                self._buffers.setdefault(k, []).append(
+                    (int(ts[i]), base + i,
+                     None if bits is None else bits[i],
+                     None if ubits is None else ubits[i]))
         if batch.timestamps is None:
             # processing-time style: no watermarks will come, match eagerly
             return self._drain(2 ** 62)
@@ -321,19 +673,57 @@ class CepOperator(StreamOperator):
     def end_input(self) -> List[StreamElement]:
         return self._drain(2 ** 62)
 
+    # -- shared emission helpers ---------------------------------------------
+    def _row(self, eid: int) -> dict:
+        return self._rowstore.row(eid)
+
+    def _emit_match(self, events, mts: int, out_rows, out_ts) -> None:
+        self._stats["matches"] += 1
+        named: Dict[str, List[dict]] = {}
+        for stage_i, ev_id in events:
+            named.setdefault(self.pattern.stages[stage_i].name,
+                             []).append(self._row(ev_id))
+        res = self.select_fn(named)
+        if res is not None:
+            out_rows.append(res)
+            out_ts.append(mts)
+
+    def _emit_batch(self, out_rows, out_ts) -> List[StreamElement]:
+        if not out_rows:
+            return []
+        cols = {c: np.asarray([r[c] for r in out_rows])
+                for c in out_rows[0]}
+        return [RecordBatch(cols, timestamps=np.asarray(out_ts, np.int64))]
+
+    def _prune_rows_interpreted(self) -> None:
+        referenced = {ev for nfa in self._nfas.values()
+                      for pm in nfa.partials for _s, ev in pm.events}
+        for buf in self._buffers.values():
+            referenced.update(e[1] for e in buf)
+        self._rowstore.prune(np.fromiter(referenced, np.int64,
+                                         count=len(referenced)))
+
+    # -- drain dispatch ------------------------------------------------------
     def _drain(self, up_to_ts: int) -> List[StreamElement]:
+        self._resolve_engine()
+        if self._engine == "vectorized":
+            from flink_tpu.runtime import device_health
+            mon = device_health.get_monitor(create=False)
+            if mon is not None and mon.quarantined:
+                self._degrade_to_interpreted("device quarantined")
+                return self._drain_interpreted(up_to_ts)
+            try:
+                return self._drain_vectorized(up_to_ts)
+            except device_health.DeviceQuarantinedError:
+                self._degrade_to_interpreted(
+                    "vectorized drain dispatch quarantined")
+                return self._drain_interpreted(up_to_ts)
+        return self._drain_interpreted(up_to_ts)
+
+    # -- interpreted drain ---------------------------------------------------
+    def _drain_interpreted(self, up_to_ts: int) -> List[StreamElement]:
         out_rows: List[dict] = []
         out_ts: List[int] = []
-
-        def emit(nfa, match, ts):
-            named: Dict[str, List[dict]] = {}
-            for stage_i, ev_id in match:
-                named.setdefault(self.pattern.stages[stage_i].name,
-                                 []).append(nfa._rows[ev_id])
-            res = self.select_fn(named)
-            if res is not None:
-                out_rows.append(res)
-                out_ts.append(ts)
 
         for k, buf in self._buffers.items():
             ready = [e for e in buf if e[0] <= up_to_ts]
@@ -346,47 +736,45 @@ class CepOperator(StreamOperator):
             nfa = self._nfas.get(k)
             if nfa is None:
                 nfa = self._nfas[k] = NFA(self.pattern)
-            for ts, eid, bits, ubits, row in ready:
-                nfa._rows[eid] = row
-            for ts, eid, bits, ubits, row in ready:
+                self._partials_total += len(nfa.partials)
+            for ts, eid, bits, ubits in ready:
                 # a trailing notFollowedBy completes by TIME, which may
                 # happen between events (the within window closing)
+                before = len(nfa.partials)
                 for match, cts in nfa.harvest_expired_negations(ts):
-                    emit(nfa, match, cts)
+                    self._emit_match(match, cts, out_rows, out_ts)
                 ms = nfa.advance(eid, ts, bits, ubits)
+                self._partials_total += len(nfa.partials) - before
                 if len(ms) > 1 and self.leftmost_order_column is not None \
                         and self.pattern.skip_strategy == \
                         AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT:
                     oc = self.leftmost_order_column
                     ms = [min(ms, key=lambda m: (
-                        nfa._rows[m[0][1]].get(oc), m[0][1]))]
+                        self._row(m[0][1]).get(oc), m[0][1]))]
                 for match in ms:
-                    emit(nfa, match, ts)
+                    self._emit_match(match, ts, out_rows, out_ts)
         # time-driven completions for EVERY key — including quiet ones whose
         # within window the watermark just closed
         for k, nfa in self._nfas.items():
+            before = len(nfa.partials)
             for match, cts in nfa.harvest_expired_negations(up_to_ts):
-                emit(nfa, match, cts)
-            # SharedBuffer-style pruning: rows only live as long as a partial
-            # match references them — otherwise host memory (and every
-            # checkpoint) grows with total events processed
-            referenced = {ev_id for pm in nfa.partials
-                          for _stage, ev_id in pm.events}
-            if len(nfa._rows) > len(referenced):
-                nfa._rows = {e: r for e, r in nfa._rows.items()
-                             if e in referenced}
-        if not out_rows:
-            return []
-        cols = {c: np.asarray([r[c] for r in out_rows])
-                for c in out_rows[0]}
-        return [RecordBatch(cols, timestamps=np.asarray(out_ts, np.int64))]
+                self._emit_match(match, cts, out_rows, out_ts)
+            self._partials_total += len(nfa.partials) - before
+        # SharedBuffer-style pruning: event rows only live as long as a
+        # partial match (or a buffered event) references them — otherwise
+        # host memory (and every checkpoint) grows with events processed
+        self._prune_rows_interpreted()
+        self._stats["interpreted_drains"] += 1
+        self._stats["partials_high_water"] = max(
+            self._stats["partials_high_water"], self._partials_total)
+        return self._emit_batch(out_rows, out_ts)
 
     def _evaluate_deferred(self, k, ready):
         """Drain-time condition evaluation over the key's event-time-sorted
         rows: inject ``__prev_<col>`` columns (the previous row's values in
         ROWTIME order, seeded from the last drained row of this key), then
         run every stage condition vectorized over the chunk."""
-        rows_ = [e[4] for e in ready]
+        rows_ = [self._row(e[1]) for e in ready]
         cols = {c: np.asarray([r.get(c) for r in rows_])
                 for c in rows_[0]}
         prev = self._last_row.get(k)
@@ -411,33 +799,473 @@ class CepOperator(StreamOperator):
                            for s in self.pattern.stages], axis=1)
                  if any(s.until is not None for s in self.pattern.stages)
                  else None)
-        return [(ts, eid, bits[i], None if ubits is None else ubits[i], row)
-                for i, (ts, eid, _b, _u, row) in enumerate(ready)]
+        return [(ts, eid, bits[i], None if ubits is None else ubits[i])
+                for i, (ts, eid, _b, _u) in enumerate(ready)]
+
+    # -- vectorized drain ----------------------------------------------------
+    def _drain_vectorized(self, up_to_ts: int) -> List[StreamElement]:
+        from flink_tpu.runtime import device_health
+
+        vec = self._vec
+        pend = vec.consolidate()
+        sect0: List[Tuple[tuple, tuple, int]] = []
+        if pend is not None:
+            ready_m = pend["ts"] <= up_to_ts
+            if not ready_m.all():
+                keep = ~ready_m
+                vec.pending = [{k: v[keep] for k, v in pend.items()}]
+            if ready_m.any():
+                r = {k: v[ready_m] for k, v in pend.items()}
+                order = np.lexsort((r["eid"], r["ts"], r["slot"]))
+                r = {k: v[order] for k, v in r.items()}
+                uniq, offsets, counts = np.unique(
+                    r["slot"], return_index=True, return_counts=True)
+                pos = (np.arange(r["ts"].size)
+                       - np.repeat(offsets, counts))
+                krow = np.repeat(np.arange(uniq.size), counts)
+                # regroup keys by (partial-width bucket, ASCENDING event
+                # count): chunks never span width buckets, so the kernel
+                # runs each chunk at the narrow width ITS rows need (one
+                # hot key with many partials must not widen everyone), and
+                # within a bucket it steps only the suffix of keys still
+                # holding an event at step t — total work tracks
+                # sum(events), not keys x T_max.  Match ORDER is
+                # unaffected: every match carries its original
+                # (buffer-order, step) sort key.
+                nl = np.maximum(vec.nlv[uniq], 1)
+                wb = np.int64(1) << (
+                    np.ceil(np.log2(np.maximum(nl, 4))).astype(np.int64))
+                ksort = np.lexsort((counts, wb))
+                inv = np.empty_like(ksort)
+                inv[ksort] = np.arange(ksort.size)
+                sc = counts[ksort]
+                new_off = np.zeros(ksort.size, np.int64)
+                np.cumsum(sc[:-1], out=new_off[1:])
+                dest = new_off[inv[krow]] + pos
+                r2 = {}
+                for k, v in r.items():
+                    out = np.empty_like(v)
+                    out[dest] = v
+                    r2[k] = out
+                krow2 = np.empty(krow.size, np.int64)
+                krow2[dest] = inv[krow]
+                pos2 = np.empty_like(pos)
+                pos2[dest] = pos
+                wbs = wb[ksort]
+                bounds = np.flatnonzero(
+                    np.concatenate([[True], wbs[1:] != wbs[:-1]]))
+                bounds = np.append(bounds, wbs.size)
+                # ONE guarded dispatch per drain: the whole step loop is a
+                # pure function of gathered copies — a watchdog-abandoned
+                # (wedged) dispatch commits nothing; the ready events go
+                # back to pending so the degrade path re-drains the
+                # identical stream interpreted
+                try:
+                    chunks, sect0, m_cap, e_cap = \
+                        device_health.guarded_dispatch(
+                            lambda: self._vec_compute(
+                                r2, uniq[ksort], sc, pos2, krow2, ksort,
+                                bounds),
+                            label="cep.vectorized_drain")
+                except BaseException:
+                    vec.pending.append(r)
+                    raise
+                vec.adopt(chunks, m_cap, e_cap)
+                vec.mark_drained(uniq)
+        out_rows: List[dict] = []
+        out_ts: List[int] = []
+        sect0.sort(key=lambda m: m[0])
+        for _o, events, mts in sect0:
+            self._emit_match(events, mts, out_rows, out_ts)
+        for events, mts in self._vec_harvest_all(up_to_ts):
+            self._emit_match(events, mts, out_rows, out_ts)
+        self._stats["vectorized_drains"] += 1
+        self._stats["partials_high_water"] = max(
+            self._stats["partials_high_water"], vec.total_partials())
+        self._prune_rows_vectorized()
+        return self._emit_batch(out_rows, out_ts)
+
+    def _vec_compute(self, r, uniq, counts, pos, krow, korder, bounds):
+        """The drain's pure compute: advance every ready key's partials
+        through its event steps, chunked over keys.  Keys arrive sorted by
+        (partial-width bucket, ascending event count); ``korder[p]`` = the
+        key's original buffer-order rank, the match sort key.  Chunks stay
+        inside one width bucket (``bounds``) so each runs at the narrow
+        partial capacity its own rows need, and the numpy kernel steps only
+        the suffix of keys that still hold an event at step t.  Returns the
+        advanced blocks + matches + grown caps; commits NOTHING
+        (transactional — see the guarded dispatch above)."""
+        from flink_tpu.cep import vectorized as V
+
+        vec = self._vec
+        tab = vec.tab
+        S = tab.n_stages
+        m_cap, e_cap = vec.m_cap, vec.e_cap
+        chunk = 65536
+        step = V.step_jit if vec.kernel == "jit" else V.step_numpy
+        suffix = vec.kernel != "jit"      # jit needs shape-stable steps
+        sect0: List[Tuple[tuple, tuple, int]] = []
+        chunks = []
+        spans = [(int(lo2), min(int(lo2) + chunk, int(bhi)))
+                 for blo, bhi in zip(bounds[:-1], bounds[1:])
+                 for lo2 in range(int(blo), int(bhi), chunk)]
+        for lo, hi in spans:
+            kc = hi - lo
+            sel = (krow >= lo) & (krow < hi)
+            ek = (krow[sel] - lo).astype(np.int64)
+            ep = pos[sel].astype(np.int64)
+            cchunk = counts[lo:hi]
+            Tc = int(cchunk.max())
+            ets = np.zeros((kc, Tc), np.int64)
+            eid = np.zeros((kc, Tc), np.int64)
+            val = np.zeros((kc, Tc), bool)
+            bit = np.zeros((kc, Tc, S), bool)
+            ubi = np.zeros((kc, Tc, S), bool)
+            ets[ek, ep] = r["ts"][sel]
+            eid[ek, ep] = r["eid"][sel]
+            val[ek, ep] = True
+            bit[ek, ep] = r["bits"][sel]
+            ubi[ek, ep] = r["ubits"][sel]
+            # chunk-local widths: exactly what THIS bucket's rows need
+            slots = uniq[lo:hi]
+            m_loc = _pow2_at_least(int(vec.nlv[slots].max(initial=1)), 4)
+            e_loc = _pow2_at_least(
+                int(vec.eln[slots].max(initial=0)) + 1, 4)
+            block = vec.gather(slots, m_loc, e_loc)
+            for t in range(Tc):
+                # counts ascend within the chunk: keys with an event at
+                # step t are exactly the suffix [s0:]
+                s0 = int(np.searchsorted(cchunk, t, side="right")) \
+                    if suffix else 0
+                part = tuple(a[s0:] for a in block)
+                if tab.trailing_negation:
+                    part, harvested = _harvest_block(
+                        tab, part, val[s0:, t], ets[s0:, t])
+                    for i, (k, m, events, cts) in enumerate(harvested):
+                        sect0.append(
+                            ((korder[lo + s0 + k], t, 0, i), events, cts))
+                inputs = (val[s0:, t], ets[s0:, t], eid[s0:, t],
+                          bit[s0:, t, :], ubi[s0:, t, :])
+                res, m_new = step(tab, m_loc, part, inputs)
+                part = res.block
+                m_grew = max(m_new, part[0].shape[1])
+                e_grew = part[4].shape[2]
+                if m_grew > m_loc or e_grew > e_loc:
+                    m_loc = max(m_loc, m_grew)
+                    e_loc = max(e_loc, e_grew)
+                    block = _grow_block(block, m_loc, e_loc)
+                    part = _grow_block(part, m_loc, e_loc)
+                if s0:
+                    block = tuple(np.concatenate([full[:s0], new])
+                                  for full, new in zip(block, part))
+                else:
+                    block = part
+                for i in range(res.match_kc.shape[0]):
+                    k, _c = res.match_kc[i]
+                    sect0.append(
+                        ((korder[lo + s0 + int(k)], t, 1, i),
+                         V.unpack_events(res.match_ev[i]),
+                         int(ets[s0 + int(k), t])))
+            chunks.append((slots, block))
+            m_cap = max(m_cap, m_loc)
+            e_cap = max(e_cap, e_loc)
+        return chunks, sect0, m_cap, e_cap
+
+    def _vec_harvest_all(self, now: int):
+        """Drain-end trailing-negation harvest over every drained key, in
+        first-drain order (the interpreted engine's second ``_nfas``
+        loop)."""
+        from flink_tpu.cep.vectorized import unpack_events
+
+        vec = self._vec
+        tab = vec.tab
+        if not tab.trailing_negation or not vec.drained_order:
+            return []
+        n = vec.n_slots
+        live = (np.arange(vec.m_cap)[None, :] < vec.nlv[:n, None])
+        fst = vec.fst[:n]
+        safe = np.where(fst == LONG_MIN, now, fst)
+        mask = (live & vec.drained[:n, None]
+                & (vec.st[:n] == tab.n_stages - 1)
+                & (fst != LONG_MIN) & (now - safe > tab.within))
+        if not mask.any():
+            return []
+        hits = np.argwhere(mask)
+        hits = hits[np.lexsort((hits[:, 1], vec.rank[hits[:, 0]]))]
+        out = []
+        for k, m in hits:
+            eln = int(vec.eln[k, m])
+            out.append((unpack_events(vec.ev[k, m, :eln]),
+                        int(vec.fst[k, m] + tab.within)))
+        # remove the harvested partials (stable compaction of the rest)
+        rows = np.unique(hits[:, 0])
+        keep = live[rows] & ~mask[rows]
+        order = np.argsort(~keep, axis=1, kind="stable")
+        for name in ("st", "cnt", "fst", "eln", "evh"):
+            a = getattr(vec, name)
+            a[rows] = np.take_along_axis(a[rows], order, axis=1)
+        vec.ev[rows] = np.take_along_axis(vec.ev[rows],
+                                          order[:, :, None], axis=1)
+        vec.nlv[rows] = keep.sum(axis=1).astype(np.int32)
+        dead = (np.arange(vec.m_cap)[None, :] >= vec.nlv[rows, None])
+        vec.st[rows] = np.where(dead, 0, vec.st[rows])
+        vec.cnt[rows] = np.where(dead, 0, vec.cnt[rows])
+        vec.fst[rows] = np.where(dead, LONG_MIN, vec.fst[rows])
+        vec.eln[rows] = np.where(dead, 0, vec.eln[rows])
+        vec.evh[rows] = np.where(dead, 0, vec.evh[rows])
+        vec.ev[rows] = np.where(dead[:, :, None], 0, vec.ev[rows])
+        return out
+
+    def _prune_rows_vectorized(self) -> None:
+        vec = self._vec
+        parts = [vec.referenced_event_ids()]
+        for p in vec.pending:
+            parts.append(np.asarray(p["eid"], np.int64))
+        self._rowstore.prune(np.concatenate(parts)
+                             if parts else np.empty(0, np.int64))
+
+    # -- degrade to the interpreted engine (quarantine fallback) -------------
+    def _degrade_to_interpreted(self, reason: str) -> None:
+        """Mid-job fallback: decode the array state into per-key NFAs and
+        per-key buffers, then continue interpreted — digest-identical (the
+        two engines share one logical state)."""
+        from flink_tpu.cep.vectorized import decode_partials
+
+        vec = self._vec
+        self._buffers = {}
+        self._nfas = {}
+        self._partials_total = 0
+        if vec is not None and vec.index is not None:
+            # buffer dict insertion order = first-arrival order = slot id
+            for slot in range(vec.n_slots):
+                self._buffers[vec.key_of(slot)] = []
+            pend = vec.consolidate()
+            if pend is not None:
+                order = np.lexsort((pend["eid"], pend["slot"]))
+                for i in order:
+                    slot = int(pend["slot"][i])
+                    self._buffers[vec.key_of(slot)].append(
+                        (int(pend["ts"][i]), int(pend["eid"][i]),
+                         pend["bits"][i],
+                         pend["ubits"][i] if vec.tab.has_until else None))
+            for slot in vec.drained_order:
+                nfa = NFA(self.pattern)
+                nfa.partials = decode_partials(
+                    (vec.st[slot], vec.cnt[slot], vec.fst[slot],
+                     vec.eln[slot], vec.ev[slot]), int(vec.nlv[slot]))
+                nfa.skip_until_ts = int(vec.skip[slot])
+                self._nfas[vec.key_of(slot)] = nfa
+                self._partials_total += len(nfa.partials)
+        self._vec = None
+        self._engine = "interpreted"
+        self._engine_reasons = [f"degraded mid-job: {reason}"]
+        self._stats["degraded"] += 1
 
     # -- checkpointing -------------------------------------------------------
     def snapshot_state(self) -> Dict[str, Any]:
+        """One snapshot format for BOTH engines (the interpreted layout —
+        buffers carry materialized rows, NFAs carry partial lists), so
+        checkpoints restore across engine choices and mid-job degradations
+        never strand a savepoint."""
+        self._resolve_engine()
+        if self._engine == "vectorized":
+            buffers, nfas = self._vec_snapshot_views()
+        else:
+            buffers = {k: [(ts, eid, bits, ubits, self._row(eid))
+                           for ts, eid, bits, ubits in v]
+                       for k, v in self._buffers.items()}
+            nfas = {}
+            for k, n in self._nfas.items():
+                referenced = {ev for pm in n.partials
+                              for _s, ev in pm.events}
+                nfas[k] = (n.partials, n.skip_until_ts,
+                           {e: self._row(e) for e in sorted(referenced)})
         return {
-            "buffers": {k: list(v) for k, v in self._buffers.items()},
-            "nfas": {k: (n.partials, n.skip_until_ts,
-                         getattr(n, "_rows", {}))
-                     for k, n in self._nfas.items()},
+            "buffers": buffers,
+            "nfas": nfas,
             "last_rows": dict(self._last_row),
             "next_event_id": self._next_event_id,
             "watermark": self.watermark,
         }
 
+    def _vec_snapshot_views(self):
+        from flink_tpu.cep.vectorized import decode_partials
+
+        vec = self._vec
+        buffers: Dict[Any, list] = {}
+        if vec.index is not None:
+            for slot in range(vec.n_slots):
+                buffers[vec.key_of(slot)] = []
+            pend = vec.consolidate()
+            if pend is not None:
+                vec.pending = [pend]         # snapshot must not consume
+                order = np.lexsort((pend["eid"], pend["slot"]))
+                for i in order:
+                    slot = int(pend["slot"][i])
+                    eid = int(pend["eid"][i])
+                    buffers[vec.key_of(slot)].append(
+                        (int(pend["ts"][i]), eid, pend["bits"][i],
+                         pend["ubits"][i] if vec.tab.has_until else None,
+                         self._row(eid)))
+        nfas: Dict[Any, tuple] = {}
+        for slot in vec.drained_order:
+            partials = decode_partials(
+                (vec.st[slot], vec.cnt[slot], vec.fst[slot],
+                 vec.eln[slot], vec.ev[slot]), int(vec.nlv[slot]))
+            referenced = sorted({ev for pm in partials
+                                 for _s, ev in pm.events})
+            nfas[vec.key_of(slot)] = (
+                partials, int(vec.skip[slot]),
+                {e: self._row(e) for e in referenced})
+        return buffers, nfas
+
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        self._buffers = {k: list(v) for k, v in snap["buffers"].items()}
+        self._engine = None
+        self._resolve_engine()
+        self._rowstore = _RowStore()
+        self._buffers = {}
         self._nfas = {}
+        self._partials_total = 0
         for k, (partials, skip_ts, rows) in snap["nfas"].items():
-            nfa = NFA(self.pattern)
-            nfa.partials = list(partials)
-            nfa.skip_until_ts = skip_ts
-            nfa._rows = dict(rows)
-            self._nfas[k] = nfa
+            for e, row in rows.items():
+                self._rowstore.put_row(e, row)
+        if self._engine == "vectorized":
+            self._vec_restore(snap)
+        else:
+            for k, v in snap["buffers"].items():
+                entries = []
+                for e in v:
+                    # 5-tuple (with row) is the on-disk format; rows go to
+                    # the columnar store, buffers stay slim
+                    ts, eid, bits, ubits = e[0], e[1], e[2], e[3]
+                    if len(e) > 4:
+                        self._rowstore.put_row(eid, e[4])
+                    entries.append((ts, eid, bits, ubits))
+                self._buffers[k] = entries
+            for k, (partials, skip_ts, _rows) in snap["nfas"].items():
+                # the snapshot's rows already went into the row store's
+                # restored-row map above — duplicating them on the NFA
+                # would hold every row dict twice for the operator's life
+                nfa = NFA(self.pattern)
+                nfa.partials = list(partials)
+                nfa.skip_until_ts = skip_ts
+                self._nfas[k] = nfa
+                self._partials_total += len(nfa.partials)
         self._last_row = dict(snap.get("last_rows", {}))
         self._next_event_id = snap["next_event_id"]
         self.watermark = snap["watermark"]
+
+    def _vec_restore(self, snap: Dict[str, Any]) -> None:
+        from flink_tpu.cep import vectorized as V
+
+        self._vec = _VecState(V.compile_pattern(self.pattern),
+                              V.default_kernel())
+        vec = self._vec
+        # slot order: buffers dict order IS the original first-arrival
+        # order; any nfa-only keys (none in practice) follow
+        keys = list(snap["buffers"].keys())
+        known = set(keys)
+        keys += [k for k in snap["nfas"] if k not in known]
+        if not keys:
+            return
+        karr = np.asarray(keys)
+        if karr.dtype.kind not in "iu":
+            karr = np.asarray(keys, object)
+        vec.map_keys(karr)
+        slot_of = {k: i for i, k in enumerate(keys)}
+        pieces = {"slot": [], "ts": [], "eid": [], "bits": [], "ubits": []}
+        S = vec.tab.n_stages
+        for k, v in snap["buffers"].items():
+            for e in v:
+                ts, eid, bits, ubits = e[0], e[1], e[2], e[3]
+                if len(e) > 4:
+                    self._rowstore.put_row(eid, e[4])
+                pieces["slot"].append(slot_of[k])
+                pieces["ts"].append(ts)
+                pieces["eid"].append(eid)
+                pieces["bits"].append(np.asarray(bits, bool))
+                pieces["ubits"].append(np.zeros(S, bool) if ubits is None
+                                       else np.asarray(ubits, bool))
+        if pieces["slot"]:
+            vec.pending = [{
+                "slot": np.asarray(pieces["slot"], np.int64),
+                "ts": np.asarray(pieces["ts"], np.int64),
+                "eid": np.asarray(pieces["eid"], np.int64),
+                "bits": np.stack(pieces["bits"]),
+                "ubits": np.stack(pieces["ubits"]),
+            }]
+        for k, (partials, skip_ts, _rows) in snap["nfas"].items():
+            slot = slot_of[k]
+            row, m_cap, e_cap = V.encode_partials(
+                list(partials), vec.m_cap, vec.e_cap)
+            vec.grow_caps(m_cap, e_cap)
+            st, cnt, fst, eln, ev, evh, n = row
+            vec.st[slot, :st.size] = st
+            vec.cnt[slot, :cnt.size] = cnt
+            vec.fst[slot, :fst.size] = fst
+            vec.eln[slot, :eln.size] = eln
+            vec.ev[slot, :ev.shape[0], :ev.shape[1]] = ev
+            vec.evh[slot, :evh.size] = evh
+            vec.nlv[slot] = n
+            vec.skip[slot] = skip_ts
+            vec.mark_drained(np.asarray([slot]))
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+def _grow_block(block, m_cap: int, e_cap: int):
+    """Widen a gathered block to the given sticky caps (both axes)."""
+    from flink_tpu.cep.vectorized import grow_partials
+
+    block = grow_partials(block, m_cap)
+    st, cnt, fst, eln, ev, evh, nlv, skip = block
+    if ev.shape[2] < e_cap:
+        wide = np.zeros(ev.shape[:2] + (e_cap,), np.int64)
+        wide[:, :, :ev.shape[2]] = ev
+        ev = wide
+    return (st, cnt, fst, eln, ev, evh, nlv, skip)
+
+
+def _harvest_block(tab, block, keymask, now):
+    """Trailing-negation harvest for a gathered block, BEFORE the event
+    advances (the interpreted drain calls ``harvest_expired_negations(ts)``
+    per event): emits expired window-close completions in partial-list
+    order and compacts them out.  Pure — returns the new block."""
+    from flink_tpu.cep.vectorized import unpack_events
+
+    st, cnt, fst, eln, ev, evh, nlv, skip = block
+    M = st.shape[1]
+    live = np.arange(M)[None, :] < nlv[:, None]
+    safe = np.where(fst == LONG_MIN, now[:, None], fst)
+    mask = (live & keymask[:, None] & (st == tab.n_stages - 1)
+            & (fst != LONG_MIN) & (now[:, None] - safe > tab.within))
+    if not mask.any():
+        return block, []
+    out = []
+    for k, m in np.argwhere(mask):
+        out.append((int(k), int(m),
+                    unpack_events(ev[k, m, :int(eln[k, m])]),
+                    int(fst[k, m] + tab.within)))
+    keep = live & ~mask
+    order = np.argsort(~keep, axis=1, kind="stable")
+    t2 = lambda a: np.take_along_axis(a, order, axis=1)  # noqa: E731
+    n_nlv = keep.sum(axis=1).astype(np.int32)
+    n_st, n_cnt, n_fst = t2(st), t2(cnt), t2(fst)
+    n_eln, n_evh = t2(eln), t2(evh)
+    n_ev = np.take_along_axis(ev, order[:, :, None], axis=1)
+    dead = np.arange(M)[None, :] >= n_nlv[:, None]
+    n_st = np.where(dead, 0, n_st)
+    n_cnt = np.where(dead, 0, n_cnt)
+    n_fst = np.where(dead, LONG_MIN, n_fst)
+    n_eln = np.where(dead, 0, n_eln)
+    n_evh = np.where(dead, 0, n_evh)
+    n_ev = np.where(dead[:, :, None], 0, n_ev)
+    return (n_st, n_cnt, n_fst, n_eln, n_ev, n_evh, n_nlv, skip), out
 
 
 class CEP:
@@ -454,10 +1282,11 @@ class PatternStream:
         self.pattern = pattern
 
     def select(self, fn: Callable[[Dict[str, List[dict]]], dict],
-               name: str = "cep-select"):
+               name: str = "cep-select", vectorized: str = "auto"):
         from flink_tpu.datastream.api import DataStream
         key_col = self.keyed.key_column
         pat = self.pattern
         t = self.keyed._then(
-            name, lambda: CepOperator(pat, key_col, fn, name))
+            name, lambda _v=vectorized: CepOperator(pat, key_col, fn, name,
+                                                    vectorized=_v))
         return DataStream(self.keyed.env, t)

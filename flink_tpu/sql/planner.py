@@ -250,6 +250,181 @@ def _propagated_rowtime(table, items: List[SelectItem],
     return None
 
 
+class KeyHashCollisionError(RuntimeError):
+    """Two distinct composite keys hashed to the same int64 — the
+    hash-combine fast path cannot represent this stream; re-run with
+    ``hash_composite_keys=False`` (the object-tuple path)."""
+
+
+class _CompositeKeyHasher:
+    """int64 hash-combine fast path for composite keys, shared by the
+    GROUP BY pre-projection (``__key``) and the branch-merge key
+    (``__merge``).
+
+    The legacy path builds a Python tuple per ROW
+    (``np.fromiter((tuple(row) ...), object)``) — per-record host work on
+    the aggregate ingest path.  Here each numeric component column is
+    mixed through splitmix64 (``state/keyindex._mix64``, the same family
+    the key index probes with) with a per-position salt and folded into
+    one int64 — a handful of vectorized passes per batch.
+
+    Collisions are CHECKED, not assumed away: a host side table keeps one
+    bit-signature (and, when ``keep_components`` is set, the component
+    values) per distinct hash; every batch verifies its rows against the
+    table (vectorized searchsorted + lane compare) and raises
+    :class:`KeyHashCollisionError` on a genuine 64-bit collision.  The
+    component columns double as the split-back table for
+    ``sql-key-split`` — the post-aggregate map recovers ``__k<i>``
+    columns from fired hashes with one sorted-array gather.
+
+    Non-numeric components (strings, objects) are not eligible —
+    ``combine`` returns ``None`` and the caller falls back to the tuple
+    path."""
+
+    def __init__(self, keep_components: bool = False):
+        self.keep_components = keep_components
+        self._known = np.empty(0, np.int64)       # sorted distinct hashes
+        self._sigs: List[np.ndarray] = []         # per part: uint64 lanes
+        self._vals: List[np.ndarray] = []         # per part: orig values
+        #: LOCKED-IN representation: the first batch decides hash-vs-tuple
+        #: and every later batch must agree — a key column whose dtype
+        #: drifts mid-stream (a None turning int64 into object) must not
+        #: silently split one logical key into two representations
+        self._mode: Optional[str] = None          # "hash" | "tuple"
+        import threading
+        self._lock = threading.Lock()
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["_lock"] = None
+        return d
+
+    def __setstate__(self, d):
+        import threading
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _lane(part, n) -> Optional[np.ndarray]:
+        """One component column -> uint64 bit lane; None = ineligible."""
+        a = np.asarray(part)
+        if a.shape != (n,):
+            return None
+        if a.dtype.kind == "b":
+            a = a.astype(np.int64)
+        if a.dtype.kind in "iu":
+            return np.ascontiguousarray(a.astype(np.int64)).view(np.uint64)
+        if a.dtype.kind == "f":
+            f = np.ascontiguousarray(a.astype(np.float64))
+            f = f + 0.0             # canonicalize -0.0 (== +0.0 in SQL)
+            u = f.view(np.uint64)
+            # one NaN group regardless of payload bits
+            return np.where(np.isnan(f),
+                            np.uint64(0x7FF8000000000000), u)
+        return None
+
+    def combine(self, parts: Sequence, n: int) -> Optional[np.ndarray]:
+        """Hash ``parts`` (component columns) into int64[n]; registers new
+        hashes in the side table and collision-checks the batch.  Returns
+        ``None`` when any component is non-numeric (caller falls back)."""
+        from flink_tpu.state.keyindex import _mix64
+
+        if self._mode == "tuple":
+            return None
+        lanes = []
+        for i, p in enumerate(parts):
+            u = self._lane(p, n)
+            if u is None:
+                with self._lock:
+                    if self._mode == "hash":
+                        raise KeyHashCollisionError(
+                            f"composite key component {i} became "
+                            f"non-numeric mid-stream after earlier batches "
+                            f"were hashed — one representation per query; "
+                            f"re-run with hash_composite_keys=False")
+                    self._mode = "tuple"
+                return None
+            lanes.append(u)
+        h = np.zeros(n, np.uint64)
+        for i, u in enumerate(lanes):
+            salt = np.uint64((0x9E3779B97F4A7C15 * (i + 1)) & (2**64 - 1))
+            with np.errstate(over="ignore"):
+                h = _mix64(h ^ _mix64(u ^ salt))
+        out = h.view(np.int64).copy()
+        self._check_and_register(out, lanes, parts)
+        return out
+
+    def _check_and_register(self, h: np.ndarray, lanes, parts) -> None:
+        with self._lock:
+            if self._mode == "tuple":
+                raise KeyHashCollisionError(
+                    "composite key components became numeric after earlier "
+                    "batches fell back to tuples — one representation per "
+                    "query; re-run with hash_composite_keys=False")
+            self._mode = "hash"
+        if h.size == 0:
+            return
+        # within-batch: rows sharing a hash must share every component lane
+        # (unstable sort is fine — any occurrence's components serve as the
+        # registered signature once this adjacency check passes)
+        order = np.argsort(h)
+        ho = h[order]
+        adj = ho[1:] == ho[:-1]
+        if adj.any():
+            ai, bi = order[:-1][adj], order[1:][adj]
+            for u in lanes:
+                if (u[ai] != u[bi]).any():
+                    raise KeyHashCollisionError(
+                        "composite-key int64 hash collision inside a batch")
+        # cross-batch: first occurrence per distinct hash vs the side table
+        uniq_pos = np.concatenate([[0], np.flatnonzero(~adj) + 1])
+        u_h = ho[uniq_pos]
+        u_i = order[uniq_pos]
+        with self._lock:
+            if self._known.size:
+                pos = np.searchsorted(self._known, u_h)
+                safe = np.minimum(pos, self._known.size - 1)
+                found = (pos < self._known.size) & (self._known[safe] == u_h)
+            else:
+                pos = np.zeros(u_h.size, np.int64)
+                found = np.zeros(u_h.size, bool)
+            for lane_idx, u in enumerate(lanes):
+                if found.any() and (self._sigs[lane_idx][pos[found]]
+                                    != u[u_i[found]]).any():
+                    raise KeyHashCollisionError(
+                        "composite-key int64 hash collision across batches")
+            new = ~found
+            if new.any():
+                ins = pos[new]
+                if not self._sigs:
+                    self._sigs = [np.empty(0, np.uint64) for _ in lanes]
+                    if self.keep_components:
+                        self._vals = [np.empty(0, np.asarray(p).dtype)
+                                      for p in parts]
+                self._known = np.insert(self._known, ins, u_h[new])
+                self._sigs = [np.insert(s, ins, u[u_i[new]])
+                              for s, u in zip(self._sigs, lanes)]
+                if self.keep_components:
+                    self._vals = [np.insert(v, ins,
+                                            np.asarray(p)[u_i[new]])
+                                  for v, p in zip(self._vals, parts)]
+
+    def components(self, hashes: np.ndarray) -> List[np.ndarray]:
+        """Split-back: component columns for fired-row hashes (original
+        dtypes, one sorted-array gather per component)."""
+        h = np.asarray(hashes, np.int64)
+        with self._lock:
+            known, vals = self._known, list(self._vals)
+        pos = np.searchsorted(known, h)
+        safe = np.minimum(pos, max(known.size - 1, 0))
+        if known.size == 0 or not bool((known[safe] == h).all()):
+            raise KeyError(
+                "composite-key hash not in this process's side table — a "
+                "multi-process deployment split the pre-project and "
+                "key-split maps; re-run with hash_composite_keys=False")
+        return [v[safe] for v in vals]
+
+
 def _dedup_by_tuple_key(stream, key_parts_fn, name: str):
     """Shared distinct lowering: add a TUPLE ``__dedup`` column (unambiguous,
     hashable for both the dedup dict and key-group routing), hash-route by it
@@ -348,10 +523,18 @@ class Planner:
     """Translates a parsed SELECT over one registered table to a DataStream."""
 
     def __init__(self, env, catalog: Mapping[str, "CatalogTable"],
-                 mini_batch_rows: int = 0):
+                 mini_batch_rows: int = 0,
+                 hash_composite_keys: bool = True,
+                 cep_vectorized: str = "auto"):
         self.env = env
         self.catalog = catalog
         self.mini_batch_rows = mini_batch_rows
+        #: int64 hash-combine fast path for composite GROUP BY / merge keys
+        #: (collision-checked; _CompositeKeyHasher) — off = object tuples
+        self.hash_composite_keys = hash_composite_keys
+        #: threaded into the MATCH_RECOGNIZE CepOperator (auto|on|off);
+        #: the operator's plan-time classifier decides the engine
+        self.cep_vectorized = cep_vectorized
         #: rewrite-rule applications (rules.py), surfaced by EXPLAIN
         self.applied_rules: List[str] = []
         #: set when a join planned as an UNBOUNDED streaming join: the query
@@ -945,10 +1128,10 @@ class Planner:
         t = keyed._then(
             "sql-match-recognize",
             lambda _p=pattern, _k=key_col, _s=select_fn, _pc=list(prev_cols),
-            _oc=mr.order_by:
+            _oc=mr.order_by, _v=self.cep_vectorized:
             CepOperator(_p, _k, _s, name="sql-match-recognize",
                         defer_conditions=True, prev_columns=_pc,
-                        leftmost_order_column=_oc),
+                        leftmost_order_column=_oc, vectorized=_v),
             chainable=False)
         out_stream = DataStream(keyed.env, t)
         alias = mr.alias or stmt.table_alias or stmt.table
@@ -1312,6 +1495,13 @@ class Planner:
         single_col_key = (len(key_exprs) == 1 and isinstance(key_exprs[0], Column))
         key_col = key_exprs[0].name if single_col_key else "__key"
         emit_bounds = window is not None
+        # ONE hasher per aggregate plan, shared by every branch's
+        # pre-projection AND the post-aggregate key split — both branches
+        # register into the same side table, so split_key can never consult
+        # a table the other branch filled
+        self._key_hasher = (_CompositeKeyHasher(keep_components=True)
+                            if self.hash_composite_keys and not single_col_key
+                            and len(key_exprs) > 1 else None)
 
         if distinct_specs and window is not None and window.kind == "SESSION":
             # merging windows have no stable identity a row-level dedup key
@@ -1430,8 +1620,11 @@ class Planner:
                    for s in agg_specs if s.arg is not None]
         need_ones = any(s.arg is None for s in agg_specs)
 
+        hasher = getattr(self, "_key_hasher", None)
+
         def pre_project(cols, _kf=key_fns, _af=arg_fns,
-                        _composite=not single_col_key, _ones=need_ones):
+                        _composite=not single_col_key, _ones=need_ones,
+                        _h=hasher):
             n = _n(cols)
             out = dict(cols)
             for nm, f in _af:
@@ -1445,9 +1638,15 @@ class Planner:
                     out["__key"] = to_column(_kf[0](cols), n)
                 else:
                     parts = [to_column(f(cols), n) for f in _kf]
-                    out["__key"] = np.fromiter(
-                        (tuple(row) for row in zip(*(p.tolist() for p in parts))),
-                        object, count=n)
+                    # int64 hash-combine fast path (collision-checked) —
+                    # numeric keys skip the per-row Python tuple build
+                    key = _h.combine(parts, n) if _h is not None else None
+                    if key is None:
+                        key = np.fromiter(
+                            (tuple(row)
+                             for row in zip(*(p.tolist() for p in parts))),
+                            object, count=n)
+                    out["__key"] = key
             return out
 
         stream = stream.map(pre_project, name="sql-pre-project")
@@ -1540,16 +1739,26 @@ class Planner:
                                                      Transformation)
         from flink_tpu.operators.sql_ops import BranchMergeOperator
 
-        def add_merge_key(cols, _kc=key_col, _b=emit_bounds):
+        merge_hasher = (_CompositeKeyHasher()
+                        if self.hash_composite_keys else None)
+
+        def add_merge_key(cols, _kc=key_col, _b=emit_bounds,
+                          _h=merge_hasher):
             n = _n(cols)
             out = dict(cols)
             parts = [np.asarray(cols[_kc])]
             if _b:
                 parts += [np.asarray(cols["window_start"]),
                           np.asarray(cols["window_end"])]
-            out["__merge"] = np.fromiter(
-                (tuple(row) for row in zip(*(p.tolist() for p in parts))),
-                object, count=n)
+            # same int64 hash-combine fast path as pre_project's __key —
+            # BOTH branches share one hasher, so the collision check spans
+            # the join (equal hashes with unequal components cannot merge)
+            merge = _h.combine(parts, n) if _h is not None else None
+            if merge is None:
+                merge = np.fromiter(
+                    (tuple(row) for row in zip(*(p.tolist() for p in parts))),
+                    object, count=n)
+            out["__merge"] = merge
             return out
 
         a = a.map(add_merge_key, name="sql-merge-key")
@@ -1573,10 +1782,17 @@ class Planner:
         # ---- split composite key back into its columns
         if not single_col_key and len(key_exprs) > 1:
             key_out_names = [f"__k{i}" for i in range(len(key_exprs))]
+            hasher = getattr(self, "_key_hasher", None)
 
-            def split_key(cols, _names=key_out_names):
+            def split_key(cols, _names=key_out_names, _h=hasher):
                 out = dict(cols)
-                tuples = cols["__key"]
+                tuples = np.asarray(cols["__key"])
+                if _h is not None and tuples.dtype.kind in "iu":
+                    # hashed fast path: recover the component columns from
+                    # the shared side table (one sorted gather per part)
+                    for nm, arr in zip(_names, _h.components(tuples)):
+                        out[nm] = arr
+                    return out
                 for i, nm in enumerate(_names):
                     out[nm] = np.asarray([t[i] for t in tuples])
                 return out

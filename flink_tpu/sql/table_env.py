@@ -64,12 +64,21 @@ class TableEnvironment:
 
     def __init__(self, parallelism: int = 1, max_parallelism: int = 128,
                  mini_batch_rows: int = 0,
-                 catalog_dir: Optional[str] = None):
+                 catalog_dir: Optional[str] = None,
+                 hash_composite_keys: bool = True,
+                 cep_vectorized: str = "auto"):
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
         #: >0 enables mini-batch bundling before group aggregates
         #: (``table.exec.mini-batch`` analog)
         self.mini_batch_rows = mini_batch_rows
+        #: composite GROUP BY / merge keys ride the int64 hash-combine
+        #: fast path (collision-checked side table) instead of per-row
+        #: Python tuples; disable for multi-process SQL deployments where
+        #: the pre-project and key-split maps land in different workers
+        self.hash_composite_keys = hash_composite_keys
+        #: MATCH_RECOGNIZE CepOperator engine mode (auto|on|off)
+        self.cep_vectorized = cep_vectorized
         self._catalog: Dict[str, CatalogTable] = {}
         #: sink tables for INSERT INTO: name -> _SinkSpec
         self._sinks: Dict[str, "_SinkSpec"] = {}
@@ -192,7 +201,9 @@ class TableEnvironment:
         stmt = table._stmt
 
         def factory(env, _stmt=stmt):
-            plan = Planner(env, self._catalog).plan(_stmt)
+            plan = Planner(env, self._catalog,
+                           hash_composite_keys=self.hash_composite_keys,
+                           cep_vectorized=self.cep_vectorized).plan(_stmt)
             return plan.stream
 
         cols, changelog, unbounded = self._view_traits(stmt)
@@ -214,7 +225,9 @@ class TableEnvironment:
                                          max_parallelism=self.max_parallelism)
         for t in self._catalog.values():
             t._bound_env = env
-        planner = Planner(env, self._catalog)
+        planner = Planner(env, self._catalog,
+                          hash_composite_keys=self.hash_composite_keys,
+                          cep_vectorized=self.cep_vectorized)
         try:
             cols = planner.plan(stmt).output_columns
             return cols, planner._changelog_join, planner._unbounded_plan
@@ -480,7 +493,9 @@ class TableEnvironment:
         for t in self._catalog.values():
             t._bound_env = env
         planner = Planner(env, self._catalog,
-                          mini_batch_rows=self.mini_batch_rows)
+                          mini_batch_rows=self.mini_batch_rows,
+                          hash_composite_keys=self.hash_composite_keys,
+                          cep_vectorized=self.cep_vectorized)
         try:
             plan = planner.plan(stmt)
         finally:

@@ -302,6 +302,8 @@ def make_key_index(sample_key,
     2x (the load-factor bound) so a hinted run pays zero rehash-growths
     (the reference pre-sizes keyed state by maxParallelism the same way)."""
     arr = np.asarray(sample_key)
-    if arr.dtype.kind in "iu":
+    # a composite sample (tuple of numerics) parses as an int ARRAY — it
+    # must route to the object index, not the scalar int64 table
+    if arr.ndim == 0 and arr.dtype.kind in "iu":
         return KeyIndex(initial_capacity=max(1 << 16, 2 * capacity_hint))
     return ObjectKeyIndex()

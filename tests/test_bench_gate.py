@@ -180,6 +180,89 @@ def test_mesh_bench_reports_pod_and_per_shard(tmp_path):
     assert [m["shard"] for m in d["shard_manifest"]] == [0, 1]
 
 
+def _cep_result(mps=500.0, speedup=10.0, eq=True, auto="vectorized"):
+    return {"value": mps, "ok": eq,
+            "details": {"speedup_vs_interpreted": speedup,
+                        "equivalence_ok": eq, "auto_engine": auto}}
+
+
+def _cep_budget(**kw):
+    b = {"min_matches_per_sec": 150.0, "min_speedup_vs_interpreted": 3.0,
+         "min_speedup_smoke": 1.5}
+    b.update(kw)
+    return b
+
+
+def test_check_cep_budget_pass():
+    from bench import check_cep_budget
+    assert check_cep_budget(_cep_result(), _cep_budget()) == []
+
+
+def test_check_cep_budget_matches_floor_full_only():
+    """The matches/sec floor gates FULL runs; smoke is one batch of fixed
+    costs and only the relaxed speedup floor applies there."""
+    from bench import check_cep_budget
+    viol = check_cep_budget(_cep_result(mps=10.0), _cep_budget())
+    assert len(viol) == 1 and "matches/sec" in viol[0]
+    assert check_cep_budget(_cep_result(mps=10.0), _cep_budget(),
+                            smoke=True) == []
+
+
+def test_check_cep_budget_speedup_floor():
+    """The acceptance bar: the batched kernel must beat the interpreted
+    NFA by the budgeted factor (3x full, relaxed at smoke)."""
+    from bench import check_cep_budget
+    viol = check_cep_budget(_cep_result(speedup=2.0), _cep_budget())
+    assert len(viol) == 1 and "speedup" in viol[0]
+    # the same 2.0x PASSES the relaxed smoke floor...
+    assert check_cep_budget(_cep_result(speedup=2.0), _cep_budget(),
+                            smoke=True) == []
+    # ...but a kernel losing outright fails even at smoke
+    viol = check_cep_budget(_cep_result(speedup=0.9), _cep_budget(),
+                            smoke=True)
+    assert len(viol) == 1 and "speedup" in viol[0]
+
+
+def test_check_cep_budget_unmeasured_speedup_is_a_violation():
+    """An interpreted leg that recorded zero matches leaves the speedup
+    None — the acceptance bar must not silently pass as unmeasured."""
+    from bench import check_cep_budget
+    viol = check_cep_budget(_cep_result(speedup=None), _cep_budget())
+    assert any("unmeasured" in v for v in viol)
+    viol = check_cep_budget(_cep_result(speedup=None), _cep_budget(),
+                            smoke=True)
+    assert any("unmeasured" in v for v in viol)
+
+
+def test_check_cep_budget_equivalence_always_gates():
+    """Divergent vectorized-vs-interpreted matches must never exit 0 —
+    even at smoke size, even with every perf floor met."""
+    from bench import check_cep_budget
+    viol = check_cep_budget(_cep_result(eq=False), _cep_budget(),
+                            smoke=True)
+    assert any("equivalence" in v for v in viol)
+
+
+def test_cep_bench_smoke_passes_gate():
+    """bench.py --cep --smoke --check end-to-end on CPU: the vectorized
+    kernel beats the interpreted NFA, auto calibration resolves, matches
+    are equivalence-checked, and the committed cep_cpu gate passes."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--cep",
+         "--smoke", "--records", "65536", "--keys", "65536",
+         "--batch-size", "16384", "--check"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    d = result["details"]
+    assert result["ok"] and d["equivalence_ok"]
+    assert d["auto_engine"] in ("vectorized", "interpreted")
+    assert d["partials_high_water"] > 0
+    assert d["speedup_vs_interpreted"] is not None
+    assert d["degraded"] == 0
+
+
 def test_budget_file_shape():
     with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
         budget = json.load(f)
@@ -203,6 +286,11 @@ def test_budget_file_shape():
     assert mesh["min_rps_pod"] > 0
     assert 0 < mesh["max_shard_probe_share"] <= 1.0
     assert "probe_mirror" in mesh["max_phase_ms"]
+    # the vectorized-CEP gate (bench.py --cep --check)
+    cep = budget["cep_cpu"]
+    assert cep["min_matches_per_sec"] > 0
+    assert cep["min_speedup_vs_interpreted"] >= 3.0
+    assert 0 < cep["min_speedup_smoke"] <= cep["min_speedup_vs_interpreted"]
     # real-accelerator runs gate against the *_device sections (ROADMAP
     # item 2's second half: device rounds regress loudly, like CPU ones)
     for tier in ("full_device", "smoke_device"):

@@ -1,0 +1,471 @@
+"""Vectorized CEP engine (ISSUE-8 tentpole): the batched NFA
+state-transition kernel must be BIT-identical to the interpreted matcher —
+same matches, same order, same counters, same snapshots — on every
+eligible pattern, fall back (plan-time and mid-job) everywhere else, and
+keep event rows columnar until a match actually references them."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.cep import (AfterMatchSkipStrategy, CepOperator, Pattern,
+                           classify_pattern)
+from flink_tpu.cep.vectorized import _reset_calibration
+from flink_tpu.core.batch import RecordBatch, Watermark
+
+
+def _is(kind):
+    return lambda cols: np.asarray(cols["kind"]) == kind
+
+
+def _sel(m):
+    return {"sig": "|".join(f"{n}:{','.join(r['kind'] for r in rs)}"
+                            for n, rs in sorted(m.items())),
+            "k": next(iter(m.values()))[0]["k"]}
+
+
+def _stream(seed, n=90, n_keys=6):
+    """Seeded event stream staged into uneven batches with jittery
+    watermarks (some events held across drains)."""
+    rng = np.random.default_rng(seed)
+    kinds = ["a", "b", "c", "m", "s", "e", "x"]
+    evs = [(int(rng.integers(0, n_keys)), kinds[rng.integers(0, len(kinds))],
+            t) for t in range(n)]
+    chunks, wms = [], []
+    t = 0
+    while t < n:
+        sz = int(rng.integers(0, 7))
+        chunks.append(evs[t:t + sz])
+        t += sz
+        wms.append(int(rng.integers(max(0, t - 8), t + 3)))
+    return chunks, wms
+
+
+def _run(mode, pattern, chunks, wms, snap_at=(), select=_sel):
+    op = CepOperator(pattern, "k", select, vectorized=mode)
+    out, snaps = [], []
+    for j, (chunk, wm) in enumerate(zip(chunks, wms)):
+        if chunk:
+            ks = np.asarray([e[0] for e in chunk], np.int64)
+            kk = np.asarray([e[1] for e in chunk], object)
+            ts = np.asarray([e[2] for e in chunk], np.int64)
+            out += op.process_batch(RecordBatch({"k": ks, "kind": kk},
+                                                timestamps=ts))
+        out += op.process_watermark(Watermark(wm))
+        if j in snap_at:
+            snaps.append(op.snapshot_state())
+    out += op.end_input()
+    rows = [tuple(sorted((c, str(b.columns[c][i])) for c in b.columns))
+            + (int(np.asarray(b.timestamps)[i]),)
+            for b in out for i in range(len(b))]
+    return rows, op, snaps
+
+
+def _snap_eq(a, b):
+    if isinstance(a, dict) and isinstance(b, dict):
+        return (list(a.keys()) == list(b.keys())
+                and all(_snap_eq(a[k], b[k]) for k in a))
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_snap_eq(x, y)
+                                        for x, y in zip(a, b))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return np.array_equal(np.asarray(a), np.asarray(b))
+    return a == b
+
+
+def _corpus(skip):
+    return {
+        "followed_by": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).followed_by("b").where(_is("b")),
+        "next_strict": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).next("b").where(_is("b")),
+        "times_1_3": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).times(1, 3).followed_by("b").where(_is("b")),
+        "times_2_strict": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).times(2).next("b").where(_is("b")),
+        "one_or_more_within": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).one_or_more().followed_by("b").where(_is("b"))
+        .within(7),
+        "optional_chain": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).followed_by("m").where(_is("m")).optional()
+        .followed_by("m2").where(_is("s")).optional()
+        .followed_by("b").where(_is("b")),
+        "not_next": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).not_next("nb").where(_is("b"))
+        .next("c").where(_is("c")),
+        "not_next_end": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).not_next("nb").where(_is("b")),
+        "not_followed_by": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).not_followed_by("nb").where(_is("b"))
+        .followed_by("c").where(_is("c")).within(15),
+        "trailing_negation": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).times(1, 2).not_followed_by("nb").where(_is("b"))
+        .within(6),
+        "until_loop": Pattern.begin("a", skip_strategy=skip)
+        .where(_is("a")).one_or_more().until(_is("s"))
+        .followed_by("e").where(_is("e")).within(20),
+    }
+
+
+@pytest.mark.parametrize("skip", [AfterMatchSkipStrategy.NO_SKIP,
+                                  AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT])
+@pytest.mark.parametrize("name", sorted(_corpus(
+    AfterMatchSkipStrategy.NO_SKIP)))
+def test_equivalence_corpus(skip, name):
+    """The corpus acceptance: quantifiers, strict/relaxed contiguity,
+    not-patterns (incl. trailing under within), until, optional, both
+    skip strategies — matches, order, counters, AND mid-stream snapshots
+    bit-identical vectorized vs interpreted across 3 seeds."""
+    pattern = _corpus(skip)[name]
+    for seed in (0, 7, 11):
+        chunks, wms = _stream(seed)
+        snap_at = {len(chunks) // 2}
+        r_on, op_on, sn_on = _run("on", pattern, chunks, wms, snap_at)
+        r_off, op_off, sn_off = _run("off", pattern, chunks, wms, snap_at)
+        assert r_on == r_off, f"seed {seed}: match rows diverge"
+        s1, s2 = op_on.cep_stats(), op_off.cep_stats()
+        assert s1["matches"] == s2["matches"]
+        assert s1["partials_high_water"] == s2["partials_high_water"]
+        assert all(_snap_eq(a, b) for a, b in zip(sn_on, sn_off)), \
+            f"seed {seed}: snapshots diverge"
+
+
+def test_jit_kernel_matches_numpy_kernel():
+    """The jax.jit kernel leg produces the numpy kernel's exact results
+    (its dup/overflow flags replay on the numpy path, so bit-identity
+    never rests on a hash)."""
+    pattern = _corpus(AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)[
+        "times_1_3"]
+    chunks, wms = _stream(3, n=60)
+
+    def run_kernel(kernel):
+        op = CepOperator(pattern, "k", _sel, vectorized="on")
+        op._resolve_engine()
+        op._vec.kernel = kernel
+        out = []
+        for chunk, wm in zip(chunks, wms):
+            if chunk:
+                ks = np.asarray([e[0] for e in chunk], np.int64)
+                kk = np.asarray([e[1] for e in chunk], object)
+                ts = np.asarray([e[2] for e in chunk], np.int64)
+                out += op.process_batch(
+                    RecordBatch({"k": ks, "kind": kk}, timestamps=ts))
+            out += op.process_watermark(Watermark(wm))
+        out += op.end_input()
+        return [tuple(sorted((c, str(b.columns[c][i]))
+                             for c in b.columns))
+                for b in out for i in range(len(b))]
+
+    assert run_kernel("jit") == run_kernel("numpy")
+
+
+# ---------------------------------------------------------------------------
+# plan-time classifier
+# ---------------------------------------------------------------------------
+
+def test_classifier_rejects_followed_by_any():
+    p = (Pattern.begin("a").where(_is("a"))
+         .followed_by_any("b").where(_is("b")))
+    ok, reasons = classify_pattern(p)
+    assert not ok and any("relaxed_any" in r for r in reasons)
+
+
+def test_classifier_rejects_greedy():
+    p = (Pattern.begin("a").where(_is("a")).one_or_more().greedy()
+         .followed_by("b").where(_is("b")))
+    ok, reasons = classify_pattern(p)
+    assert not ok and any("greedy" in r for r in reasons)
+
+
+def test_classifier_accepts_full_eligible_surface():
+    p = (Pattern.begin("a").where(_is("a")).times(1, 3)
+         .not_followed_by("nb").where(_is("b"))
+         .followed_by("c").where(_is("c")).optional()
+         .followed_by("d").where(_is("e")).within(100))
+    ok, reasons = classify_pattern(p)
+    assert ok and reasons == []
+
+
+def test_vectorized_on_raises_for_ineligible_pattern():
+    p = (Pattern.begin("a").where(_is("a"))
+         .followed_by_any("b").where(_is("b")))
+    with pytest.raises(ValueError, match="not eligible"):
+        CepOperator(p, "k", _sel, vectorized="on")
+
+
+def test_deferred_conditions_fall_back_interpreted():
+    """MATCH_RECOGNIZE-style drain-time/PREV conditions are ineligible at
+    first cut: the operator resolves to the interpreted engine and says
+    why."""
+    p = Pattern.begin("a").where(_is("a")).followed_by("b").where(_is("b"))
+    op = CepOperator(p, "k", _sel, defer_conditions=True, vectorized="auto")
+    op._resolve_engine()
+    st = op.cep_stats()
+    assert st["engine"] == "interpreted"
+    assert any("deferred" in r or "PREV" in r
+               for r in st["fallback_reasons"])
+
+
+def test_ineligible_pattern_auto_falls_back():
+    p = (Pattern.begin("a").where(_is("a")).one_or_more().greedy()
+         .followed_by("b").where(_is("b")))
+    chunks, wms = _stream(2, n=40)
+    rows, op, _ = _run("auto", p, chunks, wms)
+    assert op.cep_stats()["engine"] == "interpreted"
+    r_off, _op2, _ = _run("off", p, chunks, wms)
+    assert rows == r_off
+
+
+def test_env_override_forces_engine(monkeypatch):
+    monkeypatch.setenv("FLINK_TPU_CEP_VECTORIZED", "off")
+    _reset_calibration()
+    try:
+        p = (Pattern.begin("a").where(_is("a"))
+             .followed_by("b").where(_is("b")))
+        op = CepOperator(p, "k", _sel, vectorized="auto")
+        op._resolve_engine()
+        assert op.cep_stats()["engine"] == "interpreted"
+    finally:
+        _reset_calibration()
+
+
+# ---------------------------------------------------------------------------
+# snapshots across engines + sticky growth + lazy rows
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("first,second", [("on", "off"), ("off", "on")])
+def test_cross_engine_restore(first, second):
+    """A mid-stream snapshot from either engine restores into the OTHER
+    and continues with identical matches — one logical state, two
+    executions."""
+    pattern = _corpus(AfterMatchSkipStrategy.NO_SKIP)["one_or_more_within"]
+    chunks, wms = _stream(5, n=80)
+    half = len(chunks) // 2
+    ref_rows, _op, _ = _run("off", pattern, chunks, wms)
+
+    op1 = CepOperator(pattern, "k", _sel, vectorized=first)
+    out = []
+    for chunk, wm in zip(chunks[:half], wms[:half]):
+        if chunk:
+            ks = np.asarray([e[0] for e in chunk], np.int64)
+            kk = np.asarray([e[1] for e in chunk], object)
+            ts = np.asarray([e[2] for e in chunk], np.int64)
+            out += op1.process_batch(RecordBatch({"k": ks, "kind": kk},
+                                                 timestamps=ts))
+        out += op1.process_watermark(Watermark(wm))
+    snap = op1.snapshot_state()
+
+    op2 = CepOperator(pattern, "k", _sel, vectorized=second)
+    op2.restore_state(snap)
+    for chunk, wm in zip(chunks[half:], wms[half:]):
+        if chunk:
+            ks = np.asarray([e[0] for e in chunk], np.int64)
+            kk = np.asarray([e[1] for e in chunk], object)
+            ts = np.asarray([e[2] for e in chunk], np.int64)
+            out += op2.process_batch(RecordBatch({"k": ks, "kind": kk},
+                                                 timestamps=ts))
+        out += op2.process_watermark(Watermark(wm))
+    out += op2.end_input()
+    rows = [tuple(sorted((c, str(b.columns[c][i])) for c in b.columns))
+            + (int(np.asarray(b.timestamps)[i]),)
+            for b in out for i in range(len(b))]
+    assert rows == ref_rows
+
+
+def test_sticky_growth_from_tiny_caps():
+    """Long oneOrMore runs overflow the initial partial/event-ring caps;
+    the sticky pow2 growth must preserve bit-identity."""
+    p = (Pattern.begin("a").where(_is("a")).one_or_more()
+         .followed_by("b").where(_is("b")))
+    evs = [(1, "a", t) for t in range(9)] + [(1, "b", 9)]
+    chunks, wms = [evs], [100]
+    r_on, op_on, _ = _run("on", p, chunks, wms)
+    r_off, _op, _ = _run("off", p, chunks, wms)
+    # oneOrMore branches on every sub-run ending at the 'b'
+    assert r_on == r_off and len(r_on) == 45
+    # growth actually happened (caps start at 4/4)
+    assert op_on._vec.m_cap > 4 and op_on._vec.e_cap > 4
+
+
+def test_process_batch_never_materializes_rows_upfront():
+    """ISSUE-8 satellite: ``batch.to_rows()`` must not run at ingest —
+    rows materialize lazily from the columnar store at emit time."""
+    p = Pattern.begin("a").where(_is("a")).followed_by("b").where(_is("b"))
+    for mode in ("on", "off"):
+        op = CepOperator(p, "k", _sel, vectorized=mode)
+        class NoRows(RecordBatch):
+            def to_rows(self):
+                raise AssertionError("to_rows called on the ingest path")
+
+        b = NoRows(
+            {"k": np.zeros(4, np.int64),
+             "kind": np.asarray(["a", "x", "b", "x"], object)},
+            timestamps=np.arange(4, dtype=np.int64))
+        op.process_batch(b)
+        out = op.process_watermark(Watermark(100))
+        assert sum(len(x) for x in out) == 1
+
+
+def test_row_store_prunes_unreferenced_batches():
+    """The columnar row store drops whole batches once nothing references
+    them — host memory must not grow with total events processed."""
+    p = Pattern.begin("a").where(_is("a")).next("b").where(_is("b"))
+    for mode in ("on", "off"):
+        op = CepOperator(p, "k", _sel, vectorized=mode)
+        for lo in range(0, 500, 50):
+            kk = np.asarray(["x"] * 50, object)   # never matches a stage
+            b = RecordBatch({"k": np.zeros(50, np.int64), "kind": kk},
+                            timestamps=np.arange(lo, lo + 50,
+                                                 dtype=np.int64))
+            op.process_batch(b)
+            op.process_watermark(Watermark(lo + 49))
+        assert op.cep_stats()["batches"] == 0, mode
+        snap = op.snapshot_state()
+        assert sum(len(r) for _p, _s, r in snap["nfas"].values()) == 0
+
+
+def test_pattern_stream_threads_vectorized():
+    """``.pattern(...).select(vectorized=...)`` reaches the operator."""
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.cep import CEP
+
+    env = StreamExecutionEnvironment()
+    rows = [{"k": 1, "kind": "a", "ts": 1}, {"k": 1, "kind": "b", "ts": 2}]
+    p = Pattern.begin("a").where(_is("a")).followed_by("b").where(_is("b"))
+    stream = (env.from_collection(rows, timestamp_column="ts")
+              .assign_timestamps_and_watermarks(0, timestamp_column="ts")
+              .key_by("k"))
+    sink = CEP.pattern(stream, p).select(
+        lambda m: {"n": len(m)}, vectorized="on").collect()
+    env.execute("cep-vec")
+    assert len(sink.rows()) == 1
+
+
+def test_match_recognize_threads_vectorized_mode():
+    """The SQL MATCH_RECOGNIZE lowering threads the planner's
+    ``cep_vectorized`` mode into the CepOperator; deferred (PREV-capable)
+    conditions keep it on the interpreted engine at first cut."""
+    from flink_tpu.sql.table_env import TableEnvironment
+
+    cols = {"k": np.asarray([1, 1, 1], np.int64),
+            "v": np.asarray([1.0, 9.0, 2.0]),
+            "ts": np.asarray([1, 2, 3], np.int64)}
+    tenv = TableEnvironment(cep_vectorized="auto")
+    tenv.register_collection("t", columns=cols, rowtime="ts")
+    rows = tenv.execute_sql(
+        "SELECT k, n FROM t MATCH_RECOGNIZE (PARTITION BY k ORDER BY ts "
+        "MEASURES COUNT(*) AS n AFTER MATCH SKIP PAST LAST ROW "
+        "PATTERN (A B) DEFINE A AS v < 5, B AS v > 5)").collect()
+    assert len(rows) == 1 and int(rows[0]["n"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# chaos: mid-job quarantine degrades to the interpreted path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_wedged_kernel_degrades_digest_identical():
+    """A WedgedDevice schedule hangs the vectorized drain dispatch; the
+    watchdog quarantines, the operator decodes its array state into
+    per-key NFAs MID-JOB and re-drains the identical pending events
+    interpreted — matches digest-identical to an unfaulted pass."""
+    from flink_tpu.runtime import device_health as dh
+    from flink_tpu.testing import chaos
+
+    pattern = (Pattern.begin("a").where(_is("a"))
+               .followed_by("b").where(_is("b")).within(30))
+    rng = np.random.default_rng(9)
+    kinds = ["a", "b", "x"]
+    evs = [(int(rng.integers(0, 8)), kinds[rng.integers(0, 3)], t)
+           for t in range(80)]
+
+    def one_pass(inject):
+        prev = dh.get_monitor(create=False)
+        dh.set_monitor(dh.DeviceHealthMonitor(
+            dh.WatchdogConfig(deadline_floor_s=0.5), heal_async=False))
+        inj = chaos.FaultInjector(seed=3)
+        if inject:
+            inj.inject("device.dispatch", chaos.WedgedDevice(at=4))
+        op = CepOperator(pattern, "k", _sel, vectorized="on")
+        out = []
+        try:
+            with chaos.installed(inj):
+                for lo in range(0, len(evs), 8):
+                    ch = evs[lo:lo + 8]
+                    ks = np.asarray([e[0] for e in ch], np.int64)
+                    kk = np.asarray([e[1] for e in ch], object)
+                    ts = np.asarray([e[2] for e in ch], np.int64)
+                    out += op.process_batch(
+                        RecordBatch({"k": ks, "kind": kk}, timestamps=ts))
+                    out += op.process_watermark(Watermark(int(ts.max())))
+                out += op.end_input()
+            stats = op.cep_stats()
+        finally:
+            dh.set_monitor(prev)
+        rows = [tuple(sorted((c, str(b.columns[c][i]))
+                             for c in b.columns))
+                + (int(np.asarray(b.timestamps)[i]),)
+                for b in out for i in range(len(b))]
+        return rows, stats
+
+    clean, s_clean = one_pass(False)
+    wedged, s_wedged = one_pass(True)
+    assert clean == wedged, "degraded pass diverged from unfaulted pass"
+    assert s_clean["engine"] == "vectorized" and s_clean["degraded"] == 0
+    assert s_wedged["engine"] == "interpreted"
+    assert s_wedged["degraded"] == 1
+    assert s_wedged["matches"] == s_clean["matches"]
+
+
+def test_quarantined_monitor_degrades_before_dispatch():
+    """An already-quarantined process-wide monitor sends the next drain
+    straight to the interpreted engine (no dispatch attempt)."""
+    from flink_tpu.runtime import device_health as dh
+
+    prev = dh.get_monitor(create=False)
+    mon = dh.DeviceHealthMonitor(dh.WatchdogConfig(deadline_floor_s=0.5),
+                                 heal_async=False)
+    dh.set_monitor(mon)
+    try:
+        mon.quarantine("test")
+        p = (Pattern.begin("a").where(_is("a"))
+             .followed_by("b").where(_is("b")))
+        op = CepOperator(p, "k", _sel, vectorized="on")
+        b = RecordBatch(
+            {"k": np.zeros(2, np.int64),
+             "kind": np.asarray(["a", "b"], object)},
+            timestamps=np.arange(2, dtype=np.int64))
+        op.process_batch(b)
+        out = op.process_watermark(Watermark(10))
+        assert sum(len(x) for x in out) == 1
+        assert op.cep_stats()["engine"] == "interpreted"
+        assert op.cep_stats()["degraded"] == 1
+    finally:
+        dh.set_monitor(prev)
+
+
+def test_partial_set_tripling_in_one_step():
+    """Regression: a step that nearly triples one hot key's partial set
+    forces the compaction width past the candidate count (M_out > 3M+1
+    after pow2 growth) — the kernel must pad, not crash, and must stay
+    bit-identical (found by review fuzz: 4 hot keys, until-loop)."""
+    skip = AfterMatchSkipStrategy.NO_SKIP
+    for name in ("until_loop", "one_or_more_within", "times_1_3"):
+        pattern = _corpus(skip)[name]
+        for seed in (37, 41):
+            chunks, wms = _stream(seed, n=120, n_keys=4)
+            r_on, op_on, _ = _run("on", pattern, chunks, wms)
+            r_off, op_off, _ = _run("off", pattern, chunks, wms)
+            assert r_on == r_off, (name, seed)
+            assert (op_on.cep_stats()["matches"]
+                    == op_off.cep_stats()["matches"])
+
+
+def test_cep_stats_never_runs_calibration():
+    """Regression: a monitoring read on a fresh auto-mode operator must
+    not block on the engine calibration A/B."""
+    p = Pattern.begin("a").where(_is("a")).followed_by("b").where(_is("b"))
+    op = CepOperator(p, "k", _sel, vectorized="auto")
+    st = op.cep_stats()               # no batch processed yet
+    assert st["engine"] == "unresolved"

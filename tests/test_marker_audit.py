@@ -133,6 +133,26 @@ def test_device_probe_suite_collects_under_tier1():
          f"probe's digest-equality coverage left the gate")
 
 
+def test_cep_vectorized_suite_collects_under_tier1():
+    """The vectorized CEP suite (ISSUE-8) must contribute tests to the
+    tier-1 run under ``JAX_PLATFORMS=cpu`` — the numpy kernel is the
+    bit-identical portable path, so the equivalence corpus never leaves
+    the gate."""
+    import subprocess
+
+    f = "test_cep_vectorized.py"
+    assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", str(TESTS / f)],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"{f}::" in proc.stdout, \
+        (f"{f} contributes no tests to the tier-1 selection — the "
+         f"vectorized CEP equivalence corpus left the gate")
+
+
 def test_marker_declarations_have_descriptions():
     """Each declared marker carries a description (the `name: text` form)
     so `pytest --markers` documents the tiers."""

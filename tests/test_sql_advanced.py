@@ -754,3 +754,48 @@ def test_filter_pushdown_outer_join_semantics(tenv):
         "SELECT o.oid, c.name FROM orders o LEFT JOIN customers c "
         "ON o.cust = c.cust WHERE c.name = 'alice'").collect()
     assert sorted(int(r["oid"]) for r in rows) == [0, 2]
+
+
+def test_composite_key_hasher_locks_representation():
+    """The int64 hash fast path decides hash-vs-tuple ONCE per query: a
+    key column whose dtype drifts mid-stream (a None turning int64 into
+    object) must raise, never silently split one logical key into two
+    __key representations."""
+    import numpy as np
+    import pytest
+    from flink_tpu.sql.planner import (KeyHashCollisionError,
+                                       _CompositeKeyHasher)
+
+    h = _CompositeKeyHasher(keep_components=True)
+    a = np.arange(4, dtype=np.int64)
+    b = np.ones(4, np.float64)
+    assert h.combine([a, b], 4) is not None          # locks in "hash"
+    drift = np.asarray([1, None, 3, 4], object)      # nullable batch
+    with pytest.raises(KeyHashCollisionError, match="non-numeric"):
+        h.combine([a, drift], 4)
+    # first-batch-ineligible locks in "tuple" and STAYS tuple even when a
+    # later batch would be hashable (consistent representation, no error)
+    h2 = _CompositeKeyHasher()
+    assert h2.combine([np.asarray(["x", "y"], object)], 2) is None
+    assert h2.combine([np.arange(2, dtype=np.int64)], 2) is None
+
+
+def test_composite_key_hash_negative_zero_groups_with_zero():
+    """Regression: 0.0 and -0.0 are one SQL group — the hash fast path
+    must canonicalize the float bit pattern, matching the tuple path."""
+    import numpy as np
+    from flink_tpu.sql.table_env import TableEnvironment
+
+    cols = {"a": np.ones(4, np.int64),
+            "b": np.asarray([0.0, -0.0, 0.0, -0.0]),
+            "v": np.asarray([1.0, 2.0, 3.0, 4.0])}
+    rows_by_flag = {}
+    for flag in (True, False):
+        tenv = TableEnvironment(hash_composite_keys=flag)
+        tenv.register_collection("t", columns=cols)
+        out = tenv.execute_sql(
+            "SELECT a, b, SUM(v) AS s FROM t GROUP BY a, b").collect()
+        out = out.rows() if hasattr(out, "rows") else out
+        rows_by_flag[flag] = sorted(
+            (int(r["a"]), float(r["s"])) for r in out)
+    assert rows_by_flag[True] == rows_by_flag[False] == [(1, 10.0)]
