@@ -150,7 +150,7 @@ def _build_op(window_ms: int, emit_tier: str = "host",
               device_sync: str = "auto", paging_cap: int = 0,
               pipeline_depth: int = 1, native_shards: int = 0,
               mesh_devices: int = 0, key_capacity: int = 1 << 20,
-              device_probe: str = "auto"):
+              device_probe: str = "auto", queryable=None):
     import jax.numpy as jnp
 
     from flink_tpu.core.functions import RuntimeContext, SumAggregator
@@ -175,7 +175,8 @@ def _build_op(window_ms: int, emit_tier: str = "host",
         # probe behind --device-probe (auto = measured A/B calibration)
         pipeline_depth=pipeline_depth,
         native_shards=native_shards,
-        device_probe=device_probe)
+        device_probe=device_probe,
+        queryable=queryable)
     if mesh_devices > 1:
         # the mesh-sharded hot path: ONE logical operator over the chip
         # mesh (parallel/mesh_runtime) — state in key-group-range blocks,
@@ -1262,6 +1263,209 @@ def check_cep_budget(result: dict, budget: dict, smoke: bool = False) -> list:
     return viol
 
 
+def run_queryable_bench(args) -> dict:
+    """``--queryable``: the serving tier (ISSUE-9) against a RUNNING 1M-key
+    window job.  One pass drains the stream with no read load (baseline
+    records/sec), a second pass drains the SAME stream while N pooled
+    clients hammer the TCP server with batched lookups — alternating
+    ``live`` and ``checkpoint`` consistency — through the real wire
+    protocol.  Reports lookups/sec + client-side p50/p99, the replicas'
+    worst observed lag, the job's records/sec under load (the
+    hot-path-non-interference acceptance: checkpoint reads serve from
+    frozen replica arrays, live reads from published fire segments —
+    neither blocks nor mutates the hot path), and a live-equality check
+    (values served over the wire == the view's fire-time values).  With
+    ``--check`` gates against BENCH_BUDGET.json ``queryable_cpu``."""
+    import threading
+
+    from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.queryable import (QueryableStateClientPool,
+                                     QueryableStateService,
+                                     QueryableStateSpec)
+
+    n_records = args.records or (1 << 17 if args.smoke else 1 << 22)
+    n_keys = min(args.keys, n_records)
+    window_ms = args.window_ms
+    # smoke shrinks the batch size too: the checkpoint feed must run at
+    # least a few times per pass or the replica/staleness leg measures
+    # nothing
+    batch_size = min(args.batch_size, 1 << 14) if args.smoke \
+        else args.batch_size
+    batches = make_batches(n_records, n_keys, batch_size, window_ms)
+    ckpt_every = max(1, min(args.checkpoint_every, len(batches) // 4))
+    n_clients = 2 if args.smoke else 4
+    batch_keys = 64
+
+    def drain(op, svc=None):
+        """The job under test: the standard drain loop, snapshotting every
+        --checkpoint-every batches into the serving tier's checkpoint feed
+        (the MiniCluster _complete_checkpoint path, inlined)."""
+        cid = 0
+        t0 = time.perf_counter()
+        for i, (k, v, ts) in enumerate(batches):
+            op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+            op.process_watermark(Watermark(int(ts.max()) - 1))
+            if svc is not None and (i + 1) % ckpt_every == 0:
+                cid += 1
+                op.prepare_snapshot_pre_barrier()
+                snap = op.snapshot_state()
+                svc.on_checkpoint_complete(
+                    cid, {"win": {"subtasks": [{"operator": snap}]}})
+                op.notify_checkpoint_complete(cid)
+        op.flush_pipeline()
+        elapsed = time.perf_counter() - t0
+        op.end_input()
+        return n_records / elapsed, cid
+
+    # pass 1: no read load — the interference baseline
+    op0 = _build_op(window_ms, "host", args.device_sync,
+                    pipeline_depth=args.pipeline_depth,
+                    native_shards=args.native_shards,
+                    device_probe=args.device_probe)
+    rps_no_load, _ = drain(op0)
+
+    # pass 2: same stream, N pooled clients of batched lookups
+    op = _build_op(window_ms, "host", args.device_sync,
+                   pipeline_depth=args.pipeline_depth,
+                   native_shards=args.native_shards,
+                   device_probe=args.device_probe, queryable="agg")
+    svc = QueryableStateService()
+    svc.register_views("agg", [op.queryable_view()], 1, 128)
+    svc.add_replica("agg", QueryableStateSpec("agg", "win", "k", op.agg))
+    server = svc.start_server()
+    stop = threading.Event()
+    lat_ms: list = []
+    counts = {"lookups": 0, "errors": 0, "max_lag": 0}
+    lock = threading.Lock()
+
+    def client_loop(seed):
+        rng = np.random.default_rng(seed)
+        pool = QueryableStateClientPool(server.host, server.port,
+                                        size=2, retries=1)
+        local_lat, local_n, local_err, local_lag = [], 0, 0, 0
+        i = 0
+        try:
+            while not stop.is_set():
+                keys = rng.integers(0, n_keys,
+                                    batch_keys).astype(int).tolist()
+                cons = "checkpoint" if i % 2 else "live"
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    got = pool.get_batch("agg", keys, consistency=cons)
+                except (RuntimeError, ConnectionError):
+                    local_err += 1
+                    continue
+                local_lat.append((time.perf_counter() - t0) * 1e3)
+                local_n += len(keys)
+                tags = got.get("tags", {})
+                local_lag = max(local_lag,
+                                tags.get("replica_lag_checkpoints") or 0)
+        finally:
+            pool.close()
+        with lock:
+            lat_ms.extend(local_lat)
+            counts["lookups"] += local_n
+            counts["errors"] += local_err
+            counts["max_lag"] = max(counts["max_lag"], local_lag)
+
+    threads = [threading.Thread(target=client_loop, args=(100 + c,),
+                                daemon=True) for c in range(n_clients)]
+    q_t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    rps_load, n_ckpts = drain(op, svc)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    q_elapsed = time.perf_counter() - q_t0
+
+    # live equality over the wire: served values must equal the view's
+    # fire-time values EXACTLY (the server adds serialization, not math)
+    view = op.queryable_view()
+    pool = QueryableStateClientPool(server.host, server.port)
+    rngq = np.random.default_rng(5)
+    sample = rngq.integers(0, n_keys, 256).astype(int).tolist()
+    wire = pool.get_batch("agg", sample, consistency="live")
+    vf, vv, _vt = view.lookup_batch(np.asarray(sample, np.int64))
+    live_equal = (wire["found"] == vf.tolist()
+                  and all((w is None and d is None) or w == d
+                          for w, d in zip(wire["values"], vv)))
+    pool.close()
+    svc.drain_feed()
+    final = svc.stats()
+    svc.close()
+
+    lat = np.asarray(lat_ms) if lat_ms else np.zeros(1)
+    qps = counts["lookups"] / max(q_elapsed, 1e-9)
+    detail = {
+        "n_records": n_records,
+        "n_keys": n_keys,
+        "clients": n_clients,
+        "keys_per_request": batch_keys,
+        "lookups": counts["lookups"],
+        "lookup_errors": counts["errors"],
+        "lookups_per_sec": round(qps, 1),
+        "lookup_p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "lookup_p99_ms": round(float(np.percentile(lat, 99)), 2),
+        "records_per_sec_no_load": round(rps_no_load, 1),
+        "records_per_sec_under_load": round(rps_load, 1),
+        "rps_under_load_frac": round(rps_load / max(rps_no_load, 1e-9), 3),
+        "checkpoints_fed": n_ckpts,
+        "max_replica_lag_checkpoints": max(
+            counts["max_lag"], final["replica_lag_checkpoints"]),
+        "live_equality_ok": live_equal,
+        "server_lookups_total": final["lookups_total"],
+    }
+    return {
+        "metric": f"batched lookups/sec ({n_clients} clients x "
+                  f"{batch_keys}-key requests against the running "
+                  f"{n_keys}-key window job, live+checkpoint)",
+        "value": round(qps, 1),
+        "unit": "lookups/sec",
+        "ok": live_equal and counts["errors"] == 0,
+        "details": detail,
+    }
+
+
+def check_queryable_budget(result: dict, budget: dict,
+                           smoke: bool = False) -> list:
+    """``--queryable`` vs BENCH_BUDGET ``queryable_cpu``: a lookups/sec
+    floor and a job-throughput-under-load floor (full runs — smoke sizes
+    are dominated by fixed costs), a client-side p99 ceiling, a replica
+    staleness ceiling, and the unconditional live-equality check (values
+    over the wire must be the fire-time values — never exit 0 on a
+    divergence)."""
+    viol = []
+    d = result["details"]
+    if not d.get("live_equality_ok"):
+        viol.append("live reads over the wire diverge from the view's "
+                    "fire-time values")
+    if d.get("lookup_errors"):
+        viol.append(f"{d['lookup_errors']} lookup requests failed after "
+                    f"pooled-client retries")
+    floor = budget.get("min_lookups_per_sec")
+    if floor is not None and not smoke and result["value"] < floor:
+        viol.append(f"lookups/sec {result['value']:.0f} < floor {floor:.0f}")
+    p99_cap = budget.get("max_p99_ms")
+    if p99_cap is not None and d["lookup_p99_ms"] > p99_cap:
+        viol.append(f"lookup p99 {d['lookup_p99_ms']}ms > ceiling "
+                    f"{p99_cap}ms")
+    lag_cap = budget.get("max_replica_lag_checkpoints")
+    if lag_cap is not None \
+            and d["max_replica_lag_checkpoints"] > lag_cap:
+        viol.append(f"replica lag {d['max_replica_lag_checkpoints']} "
+                    f"checkpoints > ceiling {lag_cap} (the replica feed "
+                    f"is not keeping up with the checkpoint stream)")
+    rps_floor = budget.get("min_rps_under_load")
+    if rps_floor is not None and not smoke \
+            and d["records_per_sec_under_load"] < rps_floor:
+        viol.append(f"records/sec under query load "
+                    f"{d['records_per_sec_under_load']:.0f} < floor "
+                    f"{rps_floor:.0f} (reads are stealing the hot path)")
+    return viol
+
+
 def run_mesh_bench(args) -> dict:
     """``--mesh-devices N``: the sharded hot path as ONE logical operator
     over an N-device mesh (forced host devices on CPU — see
@@ -1481,6 +1685,14 @@ def main():
                          "the measured speedup over the interpreted NFA; "
                          "with --check gates against the BENCH_BUDGET.json "
                          "cep_cpu section")
+    ap.add_argument("--queryable", action="store_true",
+                    help="standalone serving-tier workload (ISSUE-9): N "
+                         "pooled clients fire batched lookups (live + "
+                         "checkpoint consistency) over the TCP protocol "
+                         "against the running 1M-key window job; reports "
+                         "lookups/sec + p50/p99 + replica lag + the job's "
+                         "records/sec under query load; with --check "
+                         "gates against BENCH_BUDGET.json queryable_cpu")
     ap.add_argument("--paging-cap", type=int, default=0,
                     help="also run one cold-key-paging pass (device tier, "
                          "K_cap=N < key count) and report rps + "
@@ -1543,6 +1755,22 @@ def main():
             with open(path) as f:
                 budget = json.load(f).get("cep_cpu", {})
             viol = check_cep_budget(result, budget, smoke=args.smoke)
+            for v in viol:
+                print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
+            sys.exit(1 if viol else 0)
+        sys.exit(0 if result.get("ok") else 1)
+
+    if args.queryable:
+        result = run_queryable_bench(args)
+        print(json.dumps(result))
+        print(f"# details: {json.dumps(result.get('details', {}))}",
+              file=sys.stderr)
+        if args.check:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BUDGET.json")
+            with open(path) as f:
+                budget = json.load(f).get("queryable_cpu", {})
+            viol = check_queryable_budget(result, budget, smoke=args.smoke)
             for v in viol:
                 print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
             sys.exit(1 if viol else 0)

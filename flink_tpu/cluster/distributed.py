@@ -808,6 +808,11 @@ class ProcessCluster:
         self._next_cid = 1
         self._completed_ids: List[int] = []
         self._counts: Dict[str, int] = {}
+        #: queryable serving tier (ISSUE-9): checkpoint-consistency read
+        #: replicas fed by this coordinator's checkpoint stream (live views
+        #: live in the worker processes — the coordinator serves the
+        #: replica tier; see enable_queryable)
+        self.queryable = None
         self._reset_attempt()
 
     def _reset_attempt(self) -> None:
@@ -826,6 +831,39 @@ class ProcessCluster:
         self._all_done = threading.Event()
         self._conns: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
+
+    # -- queryable serving tier -------------------------------------------
+    def enable_queryable(self, name: str, uid: str, agg, key_column: str,
+                         output_column: str = "result",
+                         max_parallelism: int = 128):
+        """Serve ``uid``'s keyed window state at checkpoint consistency:
+        a :class:`~flink_tpu.queryable.replica.CheckpointReplica` fed by
+        this coordinator's checkpoint stream (and, when a checkpoint
+        storage is configured, able to tail it from any process).  Live
+        reads live inside the worker processes and are not proxied here —
+        the replica tier is exactly what a cross-process serving fleet
+        reads, so queries never touch a worker's hot path.  Returns the
+        service; call :meth:`queryable_stats` for the staleness view and
+        ``start_queryable_server`` for the TCP front end."""
+        from flink_tpu.queryable.replica import QueryableStateSpec
+        from flink_tpu.queryable.service import QueryableStateService
+        if self.queryable is None:
+            self.queryable = QueryableStateService()
+        self.queryable.add_replica(
+            name, QueryableStateSpec(name, uid, key_column, agg,
+                                     output_column=output_column),
+            storage=self.checkpoint_storage, max_parallelism=max_parallelism)
+        return self.queryable
+
+    def start_queryable_server(self, host: str = "127.0.0.1",
+                               port: int = 0):
+        if self.queryable is None:
+            from flink_tpu.queryable.service import QueryableStateService
+            self.queryable = QueryableStateService()
+        return self.queryable.start_server(host=host, port=port)
+
+    def queryable_stats(self):
+        return self.queryable.stats() if self.queryable is not None else None
 
     # -- lifecycle ---------------------------------------------------------
     def run(self, timeout_s: float = 180.0,
@@ -1499,6 +1537,10 @@ class ProcessCluster:
                 return
         self.failure_manager.on_checkpoint_success(p.cid)
         self._completed_ids.append(p.cid)
+        if self.queryable is not None:
+            # feed the read replicas off the checkpoint stream (enqueue
+            # only; the service's ingest thread parses the snapshot)
+            self.queryable.on_checkpoint_complete(p.cid, assembled)
         # aggregate the subtasks' channel-state (v1) alignment accounting
         # (one shared reader of the schema: task.aggregate_channel_state)
         from flink_tpu.cluster.task import aggregate_channel_state

@@ -193,6 +193,10 @@ class MiniCluster(TaskListener):
         backpressure_metrics(self.job_metric_group, self.backpressure_totals)
         checkpoint_alignment_metrics(self.job_metric_group,
                                      lambda: self._last_alignment)
+        #: queryable serving tier (ISSUE-9): auto-wired at deploy when any
+        #: operator was built with ``queryable=<name>`` — live views per
+        #: subtask + a checkpoint replica fed from _complete_checkpoint
+        self.queryable = None
 
     # ------------------------------------------------------------ listener
     def _slot_memory(self):
@@ -327,6 +331,11 @@ class MiniCluster(TaskListener):
         self.failure_manager.on_checkpoint_success(p.checkpoint_id)
         self._completed_ids.append(p.checkpoint_id)
         self._latest_snapshot = assembled
+        if self.queryable is not None:
+            # feed the read replicas off the checkpoint stream: enqueue
+            # only (the replica's own ingest thread parses the snapshot —
+            # the acking task thread never does serving-tier work)
+            self.queryable.on_checkpoint_complete(p.checkpoint_id, assembled)
         # aggregate the subtasks' channel-state (v1) alignment accounting
         # (one shared reader of the schema: task.aggregate_channel_state)
         from flink_tpu.cluster.task import aggregate_channel_state
@@ -507,6 +516,59 @@ class MiniCluster(TaskListener):
         if any(self._iter_paged_operators()):
             from flink_tpu.metrics.groups import paging_metrics
             paging_metrics(self.job_metric_group, self.paging_totals)
+        self._wire_queryable(plan)
+
+    def _wire_queryable(self, plan: ExecutionPlan) -> None:
+        """Register every ``queryable=<name>`` operator's live views with
+        the serving tier and stand up a checkpoint replica per state.
+        Re-deploys (restarts, region recovery) RE-register views — the
+        rebuilt operators publish fresh — while replicas persist (their
+        last ingested checkpoint keeps serving through the restart)."""
+        regs: Dict[str, Dict[str, Any]] = {}
+        for t in self._tasks:
+            op = t.operator
+            for member in getattr(op, "operators", [op]):
+                qname = getattr(member, "queryable", None)
+                view = getattr(member, "queryable_view", lambda: None)()
+                if qname is None or view is None:
+                    continue
+                entry = regs.setdefault(qname, {"uid": t.vertex_uid,
+                                                "views": {}, "op": member})
+                entry["views"][t.subtask_index] = view
+        if not regs:
+            return
+        if self.queryable is None:
+            from flink_tpu.metrics.groups import queryable_metrics
+            from flink_tpu.queryable.service import QueryableStateService
+            self.queryable = QueryableStateService()
+            queryable_metrics(self.job_metric_group,
+                              lambda: (self.queryable.stats()
+                                       if self.queryable else None))
+        max_par = {v.uid: v.max_parallelism
+                   for v in plan.vertices} if plan is not None else {}
+        for name, entry in regs.items():
+            p = self._subtask_counts.get(entry["uid"], len(entry["views"]))
+            views = [entry["views"].get(i) for i in range(p)]
+            from flink_tpu.queryable.view import WindowReadView
+            views = [v if v is not None else WindowReadView(
+                entry["op"].key_column) for v in views]
+            self.queryable.register_views(
+                name, views, parallelism=p,
+                max_parallelism=max_par.get(entry["uid"], 128))
+            if name not in self.queryable.registry.replicas():
+                from flink_tpu.queryable.replica import QueryableStateSpec
+                self.queryable.add_replica(
+                    name, QueryableStateSpec.from_operator(
+                        name, entry["uid"], entry["op"]),
+                    max_parallelism=max_par.get(entry["uid"], 128))
+
+    def start_queryable_server(self, host: str = "127.0.0.1", port: int = 0):
+        """Start (or return) the job's TCP queryable-state server
+        (``KvStateServerImpl`` analog) fronting the serving tier."""
+        if self.queryable is None:
+            from flink_tpu.queryable.service import QueryableStateService
+            self.queryable = QueryableStateService()
+        return self.queryable.start_server(host=host, port=port)
 
     def _iter_paged_operators(self):
         for t in getattr(self, "_tasks", []):
@@ -839,6 +901,8 @@ class MiniCluster(TaskListener):
         paging = self.paging_totals()
         return {
             **({"paging": paging} if paging is not None else {}),
+            **({"queryable": self.queryable.stats()}
+               if self.queryable is not None else {}),
             "device_health": self.device_health_status(),
             "state": job_state,
             "vertices": vertices,

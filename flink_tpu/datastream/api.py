@@ -759,7 +759,8 @@ class WindowedStream:
                   paging=None,
                   pipeline_depth: int = 0,
                   native_shards: int = 0,
-                  device_probe: str = "auto") -> DataStream:
+                  device_probe: str = "auto",
+                  queryable: Optional[str] = None) -> DataStream:
         """``paging``: a :class:`flink_tpu.state.paging.PagingConfig` caps
         the operator's resident key capacity — cold keys page out to the
         spill tier (state larger than HBM).  ``emit_tier`` overrides the
@@ -772,7 +773,11 @@ class WindowedStream:
         (``state/device_keyindex.py``: warm keys resolve inside the jitted
         step, the host C fold touches only misses) — "auto" runs a
         measured A/B calibration, "on"/"off" force; bit-identical fires
-        and snapshots either way."""
+        and snapshots either way.  ``queryable`` registers the operator's
+        state under that name with the queryable serving tier (ISSUE-9):
+        fired values become readable over the batched lookup protocol /
+        REST at ``live`` and (when checkpoints run) ``checkpoint``
+        consistency."""
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
         late_tag = getattr(self, "_late_tag", None)
@@ -783,6 +788,10 @@ class WindowedStream:
             raise ValueError("paging/emit_tier apply to the (unsharded) "
                              "pane-ring window operator — not evictors, "
                              "session windows or mesh-sharded state")
+        if queryable is not None and (ev is not None
+                                      or not hasattr(assigner, "pane_of")):
+            raise ValueError("queryable= is served by the pane-ring window "
+                             "operator — not evictors or session windows")
         if ev is not None:
             # evictor + aggregate: the DEVICE fast lane for the common
             # cases (Count/Time evictors + built-in aggregates) — raw
@@ -868,6 +877,7 @@ class WindowedStream:
                         MeshWindowAggOperator)
                     return MeshWindowAggOperator(mesh=mesh,
                                                  device_probe=device_probe,
+                                                 queryable=queryable,
                                                  **kwargs)
                 if emit_tier is not None:
                     kwargs["emit_tier"] = emit_tier
@@ -875,6 +885,7 @@ class WindowedStream:
                                          pipeline_depth=pipeline_depth,
                                          native_shards=native_shards,
                                          device_probe=device_probe,
+                                         queryable=queryable,
                                          **kwargs)
 
         t = keyed._then(name, factory)

@@ -56,6 +56,15 @@ NUM_UNALIGNED_CHECKPOINTS = "numberOfUnalignedCheckpoints"
 BACKPRESSURED_TIME_MS = "backpressure.total_backpressured_ms"
 BACKPRESSURE_MAX_QUEUE_DEPTH = "backpressure.max_queue_depth"
 BACKPRESSURE_ALIGNMENT_QUEUED = "backpressure.alignment_queued_elements"
+# queryable serving tier (queryable/service.py): lookup volume + latency
+# percentiles and the read replicas' staleness (checkpoints behind the
+# stream head, and for how long)
+QUERYABLE_LOOKUPS = "queryable.lookups_total"
+QUERYABLE_QPS = "queryable.lookups_per_sec"
+QUERYABLE_P50 = "queryable.lookup_p50_ms"
+QUERYABLE_P99 = "queryable.lookup_p99_ms"
+QUERYABLE_REPLICA_LAG_CHECKPOINTS = "queryable.replica_lag_checkpoints"
+QUERYABLE_REPLICA_LAG_MS = "queryable.replica_lag_ms"
 
 
 class MetricGroup:
@@ -265,6 +274,30 @@ def backpressure_metrics(group: MetricGroup,
                       (BACKPRESSURE_MAX_QUEUE_DEPTH, "max_queue_depth"),
                       (BACKPRESSURE_ALIGNMENT_QUEUED,
                        "alignment_queued_elements")):
+        group.gauge(name, _read(key))
+    return group
+
+
+def queryable_metrics(group: MetricGroup,
+                      stats_supplier: Callable[[], Optional[Dict[str, Any]]]
+                      ) -> MetricGroup:
+    """Register the queryable serving tier's gauges on a (job-scope)
+    group: lookup volume/qps, p50/p99 lookup latency, and replica
+    staleness.  ``stats_supplier`` returns
+    :meth:`QueryableStateService.stats` dicts (or None -> 0s)."""
+    def _read(key: str, default=0) -> Callable[[], Any]:
+        def read():
+            v = (stats_supplier() or {}).get(key)
+            return default if v is None else v
+        return read
+
+    for name, key in ((QUERYABLE_LOOKUPS, "lookups_total"),
+                      (QUERYABLE_QPS, "lookups_per_sec"),
+                      (QUERYABLE_P50, "lookup_p50_ms"),
+                      (QUERYABLE_P99, "lookup_p99_ms"),
+                      (QUERYABLE_REPLICA_LAG_CHECKPOINTS,
+                       "replica_lag_checkpoints"),
+                      (QUERYABLE_REPLICA_LAG_MS, "replica_lag_ms")):
         group.gauge(name, _read(key))
     return group
 

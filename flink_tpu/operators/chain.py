@@ -125,6 +125,15 @@ class ChainedOperator(StreamOperator):
             if f"op{i}" in snapshot:
                 op.restore_state(snapshot[f"op{i}"])
 
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        # the reference's OperatorChain.notifyCheckpointComplete notifies
+        # EVERY member: 2PC sinks commit, queryable views tag the
+        # consistency point — a chained member must not miss it
+        for op in self.operators:
+            hook = getattr(op, "notify_checkpoint_complete", None)
+            if hook is not None:
+                hook(checkpoint_id)
+
     def close(self) -> None:
         for op in self.operators:
             op.close()

@@ -323,6 +323,7 @@ class WindowAggOperator(StreamOperator):
         pipeline_depth: int = 0,
         native_shards: int = 0,
         device_probe: str = "auto",
+        queryable: Optional[str] = None,
     ):
         #: host tier: use the C++ WinMirror kernels (fused probe+mirror,
         #: compacting fire) when eligible; False pins the numpy mirror —
@@ -621,6 +622,21 @@ class WindowAggOperator(StreamOperator):
         self._delta_panes: set = set()        # panes with unsynced delta
         self._dp_stats = {"probe_hits": 0, "probe_misses": 0,
                           "miss_inserts": 0, "delta_syncs": 0}
+
+        # ---- queryable serving tier (ISSUE-9): when named, every fired
+        # window's emissions publish into a barrier-free live-read view
+        # (queryable/view.py) — the SAME (keys, values) arrays the fire
+        # emitted, off the delta-synced host mirror, so a live read is
+        # bit-equal to the operator's fire-time values on every tier and
+        # mesh size.  Tagged with the watermark + last-completed-checkpoint
+        # id they reflect.  None (the default) costs one attribute check
+        # per fire and nothing on the record hot path.
+        self.queryable = queryable
+        self._qview = None
+        self._last_completed_checkpoint: Optional[int] = None
+        if queryable is not None:
+            from flink_tpu.queryable.view import WindowReadView
+            self._qview = WindowReadView(key_column)
 
     #: snapshot entries row-indexed by key slot (rescale redistribution)
     ROW_FIELDS = ("leaves", "counts")
@@ -1762,6 +1778,15 @@ class WindowAggOperator(StreamOperator):
             cols.update(result)
         else:
             cols[self.output_column] = result
+        if self._qview is not None:
+            # queryable live view: retain this fire's (keys, values) arrays
+            # — every fire path (host mirror, device gather, spilled
+            # chunks, degraded tier, mesh) funnels through here, so live
+            # reads are bit-equal to fire-time values by construction
+            self._qview.publish(
+                keys, {c: v for c, v in cols.items()
+                       if c != self.key_column},
+                window, self.watermark, self._last_completed_checkpoint)
         if self.emit_window_bounds:
             # constant columns as 0-strided broadcast views: a 1M-row fire
             # would otherwise first-touch ~24MB of np.full pages per window
@@ -2953,6 +2978,20 @@ class WindowAggOperator(StreamOperator):
             return None
         n = self.key_index.num_keys if self.key_index is not None else 0
         return self._pager.stats(n)
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        """Track the last completed checkpoint so queryable live views tag
+        the consistency point they reflect (base hook is a no-op)."""
+        if self._last_completed_checkpoint is None \
+                or checkpoint_id > self._last_completed_checkpoint:
+            self._last_completed_checkpoint = checkpoint_id
+        super().notify_checkpoint_complete(checkpoint_id)
+
+    def queryable_view(self):
+        """The live-read view (``queryable/view.WindowReadView``) when this
+        operator was constructed with ``queryable=<name>``, else None.
+        Monitoring-grade: reading it takes no barrier."""
+        return self._qview
 
     def close(self) -> None:
         try:

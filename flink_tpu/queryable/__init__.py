@@ -1,4 +1,12 @@
+from flink_tpu.queryable.replica import (CheckpointReplica,
+                                         QueryableStateSpec)
 from flink_tpu.queryable.server import (KvStateRegistry, QueryableStateClient,
+                                        QueryableStateClientPool,
                                         QueryableStateServer)
+from flink_tpu.queryable.service import QueryableStateService
+from flink_tpu.queryable.view import WindowReadView
 
-__all__ = ["KvStateRegistry", "QueryableStateClient", "QueryableStateServer"]
+__all__ = ["KvStateRegistry", "QueryableStateClient",
+           "QueryableStateClientPool", "QueryableStateServer",
+           "QueryableStateService", "QueryableStateSpec",
+           "CheckpointReplica", "WindowReadView"]

@@ -1,63 +1,171 @@
-"""Queryable state: point lookups against live keyed state.
+"""Queryable state: the wire layer of the serving tier.
 
 Analog of ``flink-queryable-state`` (``KvStateServerImpl`` +
 ``KvStateServerHandler`` on each TM, ``KvStateRegistry`` in the runtime,
-client proxy with location lookup): states registered as queryable get point
-reads over a TCP server while the job runs.
+client proxy with location lookup), grown into the read path of ISSUE-9:
+the registry fronts three entry kinds —
 
-Protocol: length-prefixed JSON ``[state_name, key]`` request ->
-length-prefixed JSON ``[status, value]`` (``ok/missing/err``).  JSON, not
-pickle: requests arrive over the network from untrusted clients, and
-unpickling attacker bytes is remote code execution.  Keys are therefore
+- **live views** (``view.WindowReadView``): barrier-free fire-time
+  snapshots published by the operator, sharded per subtask and routed by
+  the record's own key-group assignment;
+- **checkpoint replicas** (``replica.CheckpointReplica``): lookups at the
+  last-completed-checkpoint consistency level, never touching the hot path;
+- **legacy backend states** (``register(name, backend, state)``): the
+  original dirty point-read against a keyed backend's non-inserting index
+  path, kept for compatibility.
+
+Protocol: length-prefixed JSON.  ``[state_name, key]`` (legacy point read)
+-> ``[status, value]``; ``{"state": s, "keys": [...], "consistency":
+"live"|"checkpoint"}`` (batched read) -> ``["ok", {"found": [...],
+"values": [...], "tags": {...}}]`` — one request, N keys, columnar answer.
+JSON, not pickle: requests arrive over the network from untrusted clients,
+and unpickling attacker bytes is remote code execution.  Keys are therefore
 limited to JSON scalars (str/int/float/bool).
-Reads are dirty by design — same consistency contract as the reference
-(queries see live, uncommitted state) — and read-only: lookups use the
-non-inserting key index path so the query thread never mutates the task
-thread's backend (single-writer preserved).
+
+Security: an unknown-state error reply names NOTHING — the registered
+state list is logged server-side only (the old reply echoed the full list
+to untrusted network clients).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import socketserver
 import struct
 import threading
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from flink_tpu.queryable.view import plain as _plain
+
 _LEN = struct.Struct("<I")
+_LOG = logging.getLogger("flink_tpu.queryable")
+
+#: batched requests are bounded: a hostile 100M-key request must not make
+#: the server materialize 100M answers
+MAX_BATCH_KEYS = 1 << 16
+
+
+class _LiveEntry:
+    """Per-subtask live views of ONE registered state + the routing
+    geometry (a query routes to the owning subtask exactly like a
+    record: murmur key group -> contiguous key-group range)."""
+
+    __slots__ = ("views", "parallelism", "max_parallelism")
+
+    def __init__(self, views: List, parallelism: int, max_parallelism: int):
+        self.views = list(views)
+        self.parallelism = int(parallelism)
+        self.max_parallelism = int(max_parallelism)
+
+    def lookup_batch(self, keys) -> Dict[str, Any]:
+        from flink_tpu.queryable.view import coerce_keys, route_keys
+        keys = coerce_keys(keys)
+        n = len(keys)
+        found = np.zeros(n, bool)
+        values: List[Optional[Dict[str, Any]]] = [None] * n
+        owner = route_keys(keys, self.parallelism, self.max_parallelism)
+        tags: List[Dict[str, Any]] = []
+        for sub in np.unique(owner).tolist():
+            if not (0 <= sub < len(self.views)):
+                continue
+            view = self.views[int(sub)]
+            sel = np.flatnonzero(owner == sub)
+            f, v, t = view.lookup_batch(np.asarray(keys)[sel])
+            tags.append(t)
+            for j, qi in enumerate(sel.tolist()):
+                if f[j]:
+                    found[qi] = True
+                    values[qi] = v[j]
+        wm = [t["watermark"] for t in tags if t.get("watermark") is not None]
+        ck = [t["checkpoint_id"] for t in tags
+              if t.get("checkpoint_id") is not None]
+        return {"found": found.tolist(), "values": values,
+                "tags": {"consistency": "live",
+                         "watermark": min(wm) if wm else None,
+                         "checkpoint_id": min(ck) if ck else None}}
 
 
 class KvStateRegistry:
-    """Registered queryable states (``KvStateRegistry.java`` analog).
-
-    ``register(name, backend, state)`` exposes a state instance; lookups
-    read through the backend's NON-mutating path.
-    """
+    """Registered queryable states (``KvStateRegistry.java`` analog),
+    extended with live views and checkpoint replicas."""
 
     def __init__(self):
         self._entries: Dict[str, Tuple[Any, Any]] = {}
+        self._live: Dict[str, _LiveEntry] = {}
+        self._replicas: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
+    # -- registration --------------------------------------------------------
     def register(self, state_name: str, backend, state) -> None:
         with self._lock:
             self._entries[state_name] = (backend, state)
 
+    def register_views(self, state_name: str, views: List,
+                       parallelism: int, max_parallelism: int) -> None:
+        """Expose per-subtask :class:`~flink_tpu.queryable.view.
+        WindowReadView` instances under one state name (re-registering
+        replaces — region restarts rebuild operators)."""
+        with self._lock:
+            self._live[state_name] = _LiveEntry(views, parallelism,
+                                                max_parallelism)
+
+    def register_replica(self, state_name: str, replica) -> None:
+        with self._lock:
+            self._replicas[state_name] = replica
+
     def unregister(self, state_name: str) -> None:
         with self._lock:
             self._entries.pop(state_name, None)
+            self._live.pop(state_name, None)
+            self._replicas.pop(state_name, None)
 
     def names(self):
         with self._lock:
-            return sorted(self._entries)
+            return sorted(set(self._entries) | set(self._live)
+                          | set(self._replicas))
 
+    def replicas(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._replicas)
+
+    def _unknown(self, state_name) -> Tuple[str, str]:
+        # the registered-state list is logged SERVER-side only: echoing it
+        # to an untrusted network client leaked the job's state topology
+        _LOG.warning("queryable lookup for unknown state %r "
+                     "(registered states: %s)", state_name, self.names())
+        return "err", "unknown state"
+
+    # -- point lookup (legacy protocol) --------------------------------------
     def lookup(self, state_name: str, key) -> Tuple[str, Any]:
+        from flink_tpu.queryable.view import is_scalar_key
+        if not is_scalar_key(key):
+            return "err", "key must be a JSON scalar (str/int/float/bool)"
         with self._lock:
             entry = self._entries.get(state_name)
-        if entry is None:
-            return "err", f"unknown state {state_name!r}; have {self.names()}"
+            live = self._live.get(state_name)
+            has_replica = state_name in self._replicas
+        if entry is not None:
+            return self._lookup_backend(entry, key)
+        if live is not None:
+            got = live.lookup_batch([key])
+            if got["found"][0]:
+                return "ok", got["values"][0]
+            return "missing", None
+        if has_replica:
+            # registered, but replica-only (e.g. a coordinator-side
+            # serving tier): say so instead of "unknown state"
+            return "err", "state served at checkpoint consistency only " \
+                          "— use the batched protocol with " \
+                          "consistency=checkpoint"
+        return self._unknown(state_name)
+
+    @staticmethod
+    def _lookup_backend(entry, key) -> Tuple[str, Any]:
         backend, state = entry
         idx = getattr(backend, "_index", None)
         if idx is None:
@@ -74,11 +182,45 @@ class KvStateRegistry:
             return "ok", _plain(np.asarray(vals)[0])
         return "ok", _plain(list(got)[0])
 
-
-def _plain(v):
-    if isinstance(v, np.generic):
-        return v.item()
-    return v
+    # -- batched lookup ------------------------------------------------------
+    def lookup_batch(self, state_name: str, keys,
+                     consistency: str = "live") -> Tuple[str, Any]:
+        from flink_tpu.queryable.view import is_scalar_key
+        if consistency not in ("live", "checkpoint"):
+            return "err", f"unknown consistency {consistency!r} " \
+                          f"(live|checkpoint)"
+        if len(keys) > MAX_BATCH_KEYS:
+            return "err", f"batch too large (max {MAX_BATCH_KEYS} keys)"
+        if not all(is_scalar_key(k) for k in keys):
+            # validate BEFORE hashing/routing: a list/dict/null key from
+            # an untrusted client must be a clean error, not a handler-
+            # thread exception that drops the connection mid-stream
+            return "err", "keys must be JSON scalars (str/int/float/bool)"
+        with self._lock:
+            live = self._live.get(state_name)
+            replica = self._replicas.get(state_name)
+            legacy = self._entries.get(state_name)
+        if live is None and replica is None and legacy is None:
+            return self._unknown(state_name)
+        if consistency == "checkpoint":
+            if replica is None:
+                return "err", "consistency 'checkpoint' not served for " \
+                              "this state (no replica registered)"
+            found, values, tags = replica.lookup_batch(keys)
+            return "ok", {"found": found.tolist(), "values": values,
+                          "tags": tags}
+        if live is not None:
+            return "ok", live.lookup_batch(keys)
+        if legacy is not None:
+            found, values = [], []
+            for k in keys:
+                status, v = self._lookup_backend(legacy, k)
+                found.append(status == "ok")
+                values.append(v if status == "ok" else None)
+            return "ok", {"found": found, "values": values,
+                          "tags": {"consistency": "live"}}
+        return "err", "state has no live read path (replica only — " \
+                      "query with consistency=checkpoint)"
 
 
 def _json_safe(v):
@@ -90,10 +232,13 @@ def _json_safe(v):
 
 
 class QueryableStateServer:
-    """TCP server answering point queries (``KvStateServerImpl`` analog)."""
+    """TCP server answering point + batched queries (``KvStateServerImpl``
+    analog).  ``registry`` may be a :class:`KvStateRegistry` or anything
+    exposing the same ``lookup``/``lookup_batch`` (the serving tier passes
+    its instrumented :class:`~flink_tpu.queryable.service.
+    QueryableStateService`)."""
 
-    def __init__(self, registry: KvStateRegistry, host: str = "127.0.0.1",
-                 port: int = 0):
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
         registry_ref = registry
 
@@ -108,16 +253,36 @@ class QueryableStateServer:
                         payload = _recv_exact(self.request, n)
                         if payload is None:
                             return
-                        try:
-                            state_name, key = json.loads(payload)
-                        except (ValueError, TypeError):
-                            resp = ("err", "malformed request")
-                        else:
-                            resp = registry_ref.lookup(state_name, key)
+                        resp = self._answer(payload)
                         data = json.dumps(resp, default=_json_safe).encode()
                         self.request.sendall(_LEN.pack(len(data)) + data)
                 except (ConnectionError, OSError):
                     return
+
+            @staticmethod
+            def _answer(payload: bytes):
+                try:
+                    req = json.loads(payload)
+                except (ValueError, TypeError):
+                    return ("err", "malformed request")
+                try:
+                    if isinstance(req, dict):
+                        state = req.get("state")
+                        keys = req.get("keys")
+                        if not isinstance(state, str) \
+                                or not isinstance(keys, list):
+                            return ("err", "malformed request")
+                        return registry_ref.lookup_batch(
+                            state, keys, req.get("consistency", "live"))
+                    state_name, key = req
+                    return registry_ref.lookup(state_name, key)
+                except (ValueError, TypeError):
+                    return ("err", "malformed request")
+                except Exception:  # noqa: BLE001 — an untrusted request
+                    # must never kill the connection without a reply (the
+                    # pooled client would burn retries on a poison pill)
+                    _LOG.exception("queryable lookup failed")
+                    return ("err", "internal error")
 
         self._server = socketserver.ThreadingTCPServer((host, port), Handler,
                                                        bind_and_activate=True)
@@ -136,7 +301,9 @@ class QueryableStateServer:
 
 
 class QueryableStateClient:
-    """``QueryableStateClient`` analog: connect + get."""
+    """``QueryableStateClient`` analog: connect + get.  Single socket, no
+    retry — the original client, kept working; use
+    :class:`QueryableStateClientPool` for pooling/retry/backoff."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 5.0):
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
@@ -161,6 +328,118 @@ class QueryableStateClient:
 
     def close(self) -> None:
         self._sock.close()
+
+
+class QueryableStateClientPool:
+    """Connection-pooled client with retry/timeout/backoff (the serving
+    tier's front-door client).
+
+    Lookups are idempotent reads, so a request that dies mid-stream
+    (server restart, partition reset, timeout) EVICTS the broken socket
+    from the pool and retries once on a fresh connection after a short
+    backoff — the failure mode the single-socket client surfaces as a bare
+    ``ConnectionError`` with an unusable socket left behind."""
+
+    def __init__(self, host: str, port: int, size: int = 4,
+                 timeout_s: float = 5.0, retries: int = 1,
+                 backoff_s: float = 0.05):
+        self.host = host
+        self.port = port
+        self.size = max(1, int(size))
+        self.timeout_s = timeout_s
+        self.retries = max(0, int(retries))
+        self.backoff_s = backoff_s
+        self._idle: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self.stats = {"requests": 0, "retries": 0, "evictions": 0}
+
+    # -- pool plumbing -------------------------------------------------------
+    def _checkout(self) -> socket.socket:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("client pool is closed")
+            if self._idle:
+                return self._idle.pop()
+        return socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout_s)
+
+    def _checkin(self, sock: socket.socket) -> None:
+        with self._lock:
+            if not self._closed and len(self._idle) < self.size:
+                self._idle.append(sock)
+                return
+        sock.close()
+
+    def _evict(self, sock: socket.socket) -> None:
+        self.stats["evictions"] += 1
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _request(self, obj) -> Any:
+        """One request/response round trip with eviction + bounded retry."""
+        payload = json.dumps(obj).encode()
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                self.stats["retries"] += 1
+                time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            sock = None
+            try:
+                sock = self._checkout()
+                sock.sendall(_LEN.pack(len(payload)) + payload)
+                hdr = _recv_exact(sock, _LEN.size)
+                if hdr is None:
+                    raise ConnectionError("server closed")
+                (n,) = _LEN.unpack(hdr)
+                data = _recv_exact(sock, n)
+                if data is None:
+                    raise ConnectionError("server closed mid-response")
+            except (ConnectionError, OSError) as e:
+                # broken mid-stream: the socket may hold half a response —
+                # NEVER back in the pool
+                if sock is not None:
+                    self._evict(sock)
+                last_err = e
+                continue
+            self._checkin(sock)
+            self.stats["requests"] += 1
+            return json.loads(data)
+        raise ConnectionError(
+            f"queryable lookup failed after {self.retries + 1} attempts: "
+            f"{last_err}") from last_err
+
+    # -- API -----------------------------------------------------------------
+    def get(self, state_name: str, key) -> Any:
+        status, value = self._request([state_name, key])
+        if status == "ok":
+            return value
+        if status == "missing":
+            raise KeyError(key)
+        raise RuntimeError(value)
+
+    def get_batch(self, state_name: str, keys,
+                  consistency: str = "live") -> Dict[str, Any]:
+        """One request, N keys: ``{"found": [...], "values": [...],
+        "tags": {...}}`` (columnar answer)."""
+        status, value = self._request({"state": state_name,
+                                       "keys": list(keys),
+                                       "consistency": consistency})
+        if status == "ok":
+            return value
+        raise RuntimeError(value)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for s in idle:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 def _recv_exact(sock, n: int) -> Optional[bytes]:

@@ -1,0 +1,215 @@
+"""The serving-tier facade: registry + replicas + metrics in one object.
+
+``QueryableStateService`` is what a cluster wires up per job: it owns the
+:class:`~flink_tpu.queryable.server.KvStateRegistry`, feeds registered
+:class:`~flink_tpu.queryable.replica.CheckpointReplica` instances from the
+cluster's checkpoint stream (on a dedicated ingest thread — the acking
+task thread only enqueues), instruments every lookup with per-state
+latency/qps accounting, and exposes ``stats()`` for
+``job_status()["queryable"]``, the ``queryable.*`` gauges, and the REST
+panel.  It answers the same ``lookup``/``lookup_batch`` interface as the
+registry, so the TCP server and REST handlers serve through it and every
+read is measured.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.queryable.replica import CheckpointReplica, QueryableStateSpec
+from flink_tpu.queryable.server import KvStateRegistry, QueryableStateServer
+
+
+class _LookupStats:
+    """Per-state latency ring + counters (monitoring-grade: a bounded
+    numpy ring, percentile math only when read)."""
+
+    __slots__ = ("lookups", "batches", "_lat", "_n", "_i", "_t0", "_lock")
+
+    RING = 4096
+
+    def __init__(self):
+        self.lookups = 0
+        self.batches = 0
+        self._lat = np.zeros(self.RING, np.float64)
+        self._n = 0
+        self._i = 0
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+
+    def record(self, n_keys: int, elapsed_ms: float) -> None:
+        with self._lock:
+            self.lookups += n_keys
+            self.batches += 1
+            self._lat[self._i] = elapsed_ms
+            self._i = (self._i + 1) % self.RING
+            self._n = min(self._n + 1, self.RING)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = self._lat[: self._n].copy()
+            lookups, batches = self.lookups, self.batches
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+        out = {"lookups": lookups, "batches": batches,
+               "lookups_per_sec": round(lookups / elapsed, 1)}
+        if lat.size:
+            out["lookup_p50_ms"] = round(float(np.percentile(lat, 50)), 3)
+            out["lookup_p99_ms"] = round(float(np.percentile(lat, 99)), 3)
+        else:
+            out["lookup_p50_ms"] = out["lookup_p99_ms"] = None
+        return out
+
+
+class QueryableStateService:
+    """One job's queryable serving tier."""
+
+    def __init__(self, registry: Optional[KvStateRegistry] = None):
+        self.registry = registry or KvStateRegistry()
+        self._stats: Dict[str, _LookupStats] = {}
+        self._stats_lock = threading.Lock()
+        #: checkpoint feed: the coordinator enqueues (cid, assembled) and
+        #: returns immediately; this thread runs the replica ingests so
+        #: snapshot parsing never runs on an acking task thread
+        self._feed: "queue.Queue[Optional[Tuple[int, Dict]]]" = queue.Queue()
+        self._feed_thread: Optional[threading.Thread] = None
+        self._server: Optional[QueryableStateServer] = None
+        self._closed = False
+
+    # -- registration --------------------------------------------------------
+    def register_views(self, name: str, views: List, parallelism: int,
+                       max_parallelism: int) -> None:
+        self.registry.register_views(name, views, parallelism,
+                                     max_parallelism)
+
+    def add_replica(self, name: str, spec: QueryableStateSpec,
+                    storage=None, **kw) -> CheckpointReplica:
+        """Create + register a checkpoint replica for ``name``.  With a
+        ``storage`` it can tail independently; without, it is fed by
+        :meth:`on_checkpoint_complete`."""
+        replica = CheckpointReplica(spec, storage=storage, **kw)
+        self.registry.register_replica(name, replica)
+        return replica
+
+    # -- checkpoint feed -----------------------------------------------------
+    def on_checkpoint_complete(self, checkpoint_id: int,
+                               assembled: Dict[str, Any]) -> None:
+        """Non-blocking: advertise to every replica (lag gauges move now)
+        and enqueue the payload for the ingest thread."""
+        for r in self.registry.replicas().values():
+            r.observe_completed(checkpoint_id)
+        if self._closed:
+            return
+        self._feed.put((checkpoint_id, assembled))
+        if self._feed_thread is None:
+            self._feed_thread = threading.Thread(
+                target=self._feed_loop, name="queryable-replica-feed",
+                daemon=True)
+            self._feed_thread.start()
+
+    def _feed_loop(self) -> None:
+        while True:
+            item = self._feed.get()
+            try:
+                if item is None:
+                    return
+                cid, assembled = item
+                for r in self.registry.replicas().values():
+                    try:
+                        r.ingest_assembled(cid, assembled)
+                    except Exception:  # noqa: BLE001 — a malformed state
+                        pass           # must not kill the feed for others
+            finally:
+                self._feed.task_done()
+
+    def drain_feed(self, timeout_s: float = 10.0) -> bool:
+        """Block until enqueued checkpoints are ingested (tests/bench)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._feed.unfinished_tasks == 0:
+                return True
+            time.sleep(0.005)
+        return False
+
+    # -- instrumented lookups -----------------------------------------------
+    def _stat(self, name: str) -> _LookupStats:
+        with self._stats_lock:
+            st = self._stats.get(name)
+            if st is None:
+                st = self._stats[name] = _LookupStats()
+            return st
+
+    def lookup(self, state_name: str, key) -> Tuple[str, Any]:
+        t0 = time.perf_counter()
+        out = self.registry.lookup(state_name, key)
+        self._stat(state_name).record(1, (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def lookup_batch(self, state_name: str, keys,
+                     consistency: str = "live") -> Tuple[str, Any]:
+        t0 = time.perf_counter()
+        out = self.registry.lookup_batch(state_name, keys, consistency)
+        self._stat(state_name).record(len(keys),
+                                      (time.perf_counter() - t0) * 1e3)
+        return out
+
+    # -- server lifecycle ----------------------------------------------------
+    def start_server(self, host: str = "127.0.0.1",
+                     port: int = 0) -> QueryableStateServer:
+        if self._server is None:
+            self._server = QueryableStateServer(self, host=host,
+                                                port=port).start()
+        return self._server
+
+    @property
+    def server(self) -> Optional[QueryableStateServer]:
+        return self._server
+
+    def close(self) -> None:
+        self._closed = True
+        if self._feed_thread is not None:
+            self._feed.put(None)
+            self._feed_thread.join(timeout=5)
+            self._feed_thread = None
+        for r in self.registry.replicas().values():
+            r.stop()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """``job_status()["queryable"]`` / gauge / REST-panel shape: the
+        per-state lookup accounting + every replica's staleness view, plus
+        job-level aggregates (max lag across replicas — the gauges)."""
+        with self._stats_lock:
+            per_state = {n: s.snapshot() for n, s in self._stats.items()}
+        replicas = {n: r.stats()
+                    for n, r in self.registry.replicas().items()}
+        for name, r in replicas.items():
+            per_state.setdefault(name, {})["replica"] = r
+        lookups = sum(s.get("lookups", 0) for s in per_state.values())
+        qps = sum(s.get("lookups_per_sec", 0) or 0
+                  for s in per_state.values())
+        p50 = [s["lookup_p50_ms"] for s in per_state.values()
+               if s.get("lookup_p50_ms") is not None]
+        p99 = [s["lookup_p99_ms"] for s in per_state.values()
+               if s.get("lookup_p99_ms") is not None]
+        return {
+            "states": sorted(self.registry.names()),
+            "per_state": per_state,
+            "lookups_total": lookups,
+            "lookups_per_sec": round(qps, 1),
+            "lookup_p50_ms": max(p50) if p50 else None,
+            "lookup_p99_ms": max(p99) if p99 else None,
+            "replica_lag_checkpoints": max(
+                (r["replica_lag_checkpoints"] for r in replicas.values()),
+                default=0),
+            "replica_lag_ms": max(
+                (r["replica_lag_ms"] for r in replicas.values()),
+                default=0.0),
+        }

@@ -269,6 +269,44 @@ class RestServer:
                             status["vertices"],
                             status.get("checkpoints", {})).encode(),
                         content_type="text/html")
+                if sub == "queryable":
+                    return self._send(status.get(
+                        "queryable", {"states": [], "lookups_total": 0}))
+                if sub == "queryable.html":
+                    from flink_tpu.rest.views import queryable_html
+                    return self._send(queryable_html(
+                        status.get("queryable", {})).encode(),
+                        content_type="text/html")
+                if sub.startswith("state/"):
+                    # GET /jobs/<id>/state/<name>/<key>?consistency=live
+                    qsvc = getattr(cluster, "queryable", None)
+                    if qsvc is None:
+                        return self._send(
+                            {"error": "queryable serving tier not enabled"},
+                            404)
+                    parts = sub.split("/", 2)
+                    if len(parts) != 3 or not parts[2]:
+                        return self._send({"error": "state/<name>/<key>"},
+                                          404)
+                    name, raw = parts[1], parts[2]
+                    from urllib.parse import parse_qs, unquote, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    cons = (q.get("consistency") or ["live"])[0]
+                    raw = unquote(raw)
+                    try:
+                        key: Any = int(raw)
+                    except ValueError:
+                        key = raw
+                    st, value = qsvc.lookup_batch(name, [key], cons)
+                    if st != "ok":
+                        return self._send({"error": value}, 400)
+                    if not value["found"][0]:
+                        return self._send({"error": f"no state for key "
+                                                    f"{key!r}",
+                                           "tags": value["tags"]}, 404)
+                    return self._send({"key": key,
+                                       "value": value["values"][0],
+                                       "tags": value["tags"]})
                 if sub == "device_health":
                     return self._send(status.get(
                         "device_health", {"state": "healthy"}))
@@ -280,7 +318,34 @@ class RestServer:
                 return self._send({"error": f"unknown path {sub}"}, 404)
 
             def do_POST(self):  # noqa: N802
-                path = self.path.rstrip("/")
+                path = self.path.split("?")[0].rstrip("/")
+                mb = re.match(r"^/jobs/([^/]+)/state/([^/:]+):batch$", path)
+                if mb:
+                    # POST /jobs/<id>/state/<name>:batch
+                    # body: {"keys": [...], "consistency": "live|checkpoint"}
+                    entry = self._job(mb.group(1))
+                    if entry is None:
+                        return
+                    qsvc = getattr(entry[1], "queryable", None)
+                    if qsvc is None:
+                        return self._send(
+                            {"error": "queryable serving tier not enabled"},
+                            404)
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        keys = body["keys"]
+                        assert isinstance(keys, list)
+                    except (ValueError, KeyError, AssertionError):
+                        return self._send(
+                            {"error": "body must be JSON with a 'keys' "
+                                      "list"}, 400)
+                    st, value = qsvc.lookup_batch(
+                        mb.group(2), keys,
+                        body.get("consistency", "live"))
+                    if st != "ok":
+                        return self._send({"error": value}, 400)
+                    return self._send(value)
                 m = re.match(r"^/jobs/([^/]+)/(savepoints|stop)$", path)
                 if not m:
                     return self._send({"error": "not found"}, 404)
@@ -430,6 +495,8 @@ _DASHBOARD_HTML = """<!DOCTYPE html>
  <h2>Throughput (records/s per operator)</h2><div id="tput"></div>
  <h2>Job graph</h2><div id="dag" class="panelbox"></div>
  <h2>Subtask backpressure</h2><div id="bp"></div>
+ <div id="qswrap" style="display:none"><h2>Queryable state</h2>
+ <div id="qs" class="panelbox"></div></div>
  <h2>Latency (source&rarr;sink)</h2><div class="tiles" id="lat"></div>
  <h2>Checkpoints</h2>
  <div id="ckview"></div>
@@ -510,6 +577,11 @@ async function refresh(){
     .then(t=>{document.getElementById('dag').innerHTML=t});
   fetch('/jobs/'+sel+'/backpressure.html').then(r=>r.text())
     .then(t=>{document.getElementById('bp').innerHTML=t});
+  const qsw=document.getElementById('qswrap');
+  if(cur.d.queryable){qsw.style.display='';
+    fetch('/jobs/'+sel+'/queryable.html').then(r=>r.text())
+      .then(t=>{document.getElementById('qs').innerHTML=t});
+  }else qsw.style.display='none';
   fetch('/jobs/'+sel+'/checkpoints.html').then(r=>r.text())
     .then(t=>{document.getElementById('ckview').innerHTML=t});
   const ex=await J('/jobs/'+sel+'/exceptions');

@@ -195,6 +195,42 @@ def device_health_html(status: Dict[str, Any]) -> str:
             + "</tbody></table></div>")
 
 
+def queryable_html(stats: Dict[str, Any]) -> str:
+    """Queryable serving tier panel (``job_status()["queryable"]``):
+    per-state lookup volume/latency + replica staleness and shard
+    manifests.  Server-rendered, DOM-testable — same pattern as the
+    device-health panel."""
+    per_state = stats.get("per_state", {})
+    lag = stats.get("replica_lag_checkpoints", 0)
+    head = (f'<div class="qs-summary" '
+            f'data-lookups="{_esc(stats.get("lookups_total", 0))}" '
+            f'data-replica-lag="{_esc(lag)}">'
+            f'lookups {_esc(stats.get("lookups_total", 0))} · '
+            f'{_esc(stats.get("lookups_per_sec", 0))}/s · '
+            f'p99 {_esc(stats.get("lookup_p99_ms"))} ms · '
+            f'replica lag {_esc(lag)} ckpts / '
+            f'{_esc(stats.get("replica_lag_ms", 0))} ms</div>')
+    rows = []
+    for name in sorted(per_state):
+        s = per_state[name]
+        rep = s.get("replica", {})
+        rows.append(
+            f'<tr class="qs-row" data-state="{_esc(name)}">'
+            f'<td>{_esc(name)}</td>'
+            f'<td>{_esc(s.get("lookups", 0))}</td>'
+            f'<td>{_esc(s.get("lookup_p50_ms"))}</td>'
+            f'<td>{_esc(s.get("lookup_p99_ms"))}</td>'
+            f'<td>{_esc(rep.get("serving_checkpoint_id"))}</td>'
+            f'<td>{_esc(rep.get("replica_lag_checkpoints", 0))}</td>'
+            f'<td>{_esc(len(rep.get("shards", [])))}</td></tr>')
+    return (f'<div class="qs-panel">{head}'
+            f'<table class="qs-table"><thead><tr><th>state</th>'
+            f'<th>lookups</th><th>p50 ms</th><th>p99 ms</th>'
+            f'<th>serving ckpt</th><th>lag</th><th>shards</th>'
+            f'</tr></thead><tbody>' + "".join(rows)
+            + "</tbody></table></div>")
+
+
 def backpressure_html(vertices: List[Dict[str, Any]],
                       checkpoints: Optional[Dict[str, Any]] = None) -> str:
     """Per-SUBTASK busy/backpressure/idle bars (the reference's subtask

@@ -3,9 +3,13 @@
 Analog of the reference's jepsen harness (``flink-jepsen/src/jepsen/flink/
 nemesis.clj``) folded into the library: the runtime exposes **named fault
 points** — ``checkpoint.store`` / ``checkpoint.load`` (storage layer),
-``channel.send`` (data plane), ``rpc.call`` (control plane),
-``heartbeat.deliver`` (liveness), ``subtask.run`` / ``subtask.snapshot``
-(task threads) — each a near-zero-cost :func:`fire` call that consults the
+``channel.send`` / ``channel.recv`` (data plane), ``rpc.call`` (control
+plane), ``heartbeat.deliver`` (liveness), ``subtask.run`` /
+``subtask.snapshot`` (task threads), ``device.dispatch`` (accelerator
+lane), ``queryable.replica_fetch`` (the serving tier's bulk checkpoint
+fetch; fired with ``direction="storage->replica"`` so
+``Partition(direction=)`` cuts exactly the replica's data plane) — each
+a near-zero-cost :func:`fire` call that consults the
 installed :class:`FaultInjector`.  Tests attach *schedules*
 (fail-K-times-then-succeed, crash-once-at-N, delay-by-D,
 partition-until-healed, seeded probabilistic failure) to points and get a

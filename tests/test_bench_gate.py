@@ -263,6 +263,87 @@ def test_cep_bench_smoke_passes_gate():
     assert d["degraded"] == 0
 
 
+def _queryable_result(qps=8000.0, p99=400.0, lag=1, rps_load=1_900_000.0,
+                      live_eq=True, errors=0):
+    return {"value": qps,
+            "details": {"lookups_per_sec": qps, "lookup_p50_ms": 4.5,
+                        "lookup_p99_ms": p99,
+                        "max_replica_lag_checkpoints": lag,
+                        "records_per_sec_under_load": rps_load,
+                        "live_equality_ok": live_eq,
+                        "lookup_errors": errors}}
+
+
+def _queryable_budget():
+    return {"min_lookups_per_sec": 2000, "max_p99_ms": 2500,
+            "max_replica_lag_checkpoints": 3,
+            "min_rps_under_load": 500_000}
+
+
+def test_check_queryable_budget_pass():
+    from bench import check_queryable_budget
+    assert check_queryable_budget(_queryable_result(),
+                                  _queryable_budget()) == []
+
+
+def test_check_queryable_budget_floors_full_only():
+    """qps + under-load-rps floors gate FULL runs (smoke is fixed-cost
+    dominated); p99/lag ceilings and the equality check gate both."""
+    from bench import check_queryable_budget
+    viol = check_queryable_budget(_queryable_result(qps=100.0),
+                                  _queryable_budget())
+    assert len(viol) == 1 and "lookups/sec" in viol[0]
+    assert check_queryable_budget(_queryable_result(qps=100.0),
+                                  _queryable_budget(), smoke=True) == []
+    viol = check_queryable_budget(_queryable_result(rps_load=100_000.0),
+                                  _queryable_budget())
+    assert len(viol) == 1 and "stealing the hot path" in viol[0]
+    assert check_queryable_budget(_queryable_result(rps_load=100_000.0),
+                                  _queryable_budget(), smoke=True) == []
+
+
+def test_check_queryable_budget_p99_and_lag_ceilings():
+    from bench import check_queryable_budget
+    viol = check_queryable_budget(_queryable_result(p99=9000.0),
+                                  _queryable_budget(), smoke=True)
+    assert len(viol) == 1 and "p99" in viol[0]
+    viol = check_queryable_budget(_queryable_result(lag=7),
+                                  _queryable_budget(), smoke=True)
+    assert len(viol) == 1 and "replica lag" in viol[0]
+
+
+def test_check_queryable_budget_equality_and_errors_always_gate():
+    """Wire values diverging from fire-time values, or lookups failing
+    after pooled-client retries, must never exit 0 — even at smoke."""
+    from bench import check_queryable_budget
+    viol = check_queryable_budget(_queryable_result(live_eq=False),
+                                  _queryable_budget(), smoke=True)
+    assert any("diverge" in v for v in viol)
+    viol = check_queryable_budget(_queryable_result(errors=3),
+                                  _queryable_budget(), smoke=True)
+    assert any("failed" in v for v in viol)
+
+
+def test_queryable_bench_smoke_passes_gate():
+    """bench.py --queryable --smoke --check end-to-end on CPU: batched
+    lookups over the real TCP protocol against the running window job,
+    live values equal fire-time values, replica fed from the checkpoint
+    stream, committed queryable_cpu gate passes."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--queryable",
+         "--smoke", "--records", "65536", "--keys", "65536", "--check"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    d = result["details"]
+    assert result["ok"] and d["live_equality_ok"]
+    assert d["lookup_errors"] == 0
+    assert d["lookups"] > 0
+    assert d["checkpoints_fed"] >= 1
+    assert d["records_per_sec_under_load"] > 0
+
+
 def test_budget_file_shape():
     with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
         budget = json.load(f)
@@ -286,6 +367,12 @@ def test_budget_file_shape():
     assert mesh["min_rps_pod"] > 0
     assert 0 < mesh["max_shard_probe_share"] <= 1.0
     assert "probe_mirror" in mesh["max_phase_ms"]
+    # the serving-tier gate (bench.py --queryable --check)
+    qs = budget["queryable_cpu"]
+    assert qs["min_lookups_per_sec"] > 0
+    assert qs["max_p99_ms"] > 0
+    assert qs["max_replica_lag_checkpoints"] >= 1
+    assert qs["min_rps_under_load"] > 0
     # the vectorized-CEP gate (bench.py --cep --check)
     cep = budget["cep_cpu"]
     assert cep["min_matches_per_sec"] > 0

@@ -153,6 +153,27 @@ def test_cep_vectorized_suite_collects_under_tier1():
          f"vectorized CEP equivalence corpus left the gate")
 
 
+def test_queryable_suite_collects_under_tier1():
+    """The queryable serving tier's suite (ISSUE-9) must contribute tests
+    to the tier-1 run under ``JAX_PLATFORMS=cpu`` — live-read bit-equality
+    (mesh 1v2 included), replica staleness/chaos, and the wire protocol
+    all run on the CPU backend, so a slow-mark sweep that silently drops
+    them fails here."""
+    import subprocess
+
+    f = "test_queryable_serving.py"
+    assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", str(TESTS / f)],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"{f}::" in proc.stdout, \
+        (f"{f} contributes no tests to the tier-1 selection — the serving "
+         f"tier's read-path coverage left the gate")
+
+
 def test_marker_declarations_have_descriptions():
     """Each declared marker carries a description (the `name: text` form)
     so `pytest --markers` documents the tiers."""

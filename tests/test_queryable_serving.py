@@ -1,0 +1,810 @@
+"""Queryable state serving tier (ISSUE-9): snapshot-consistent sharded
+reads off the checkpoint stream.
+
+Three layers under test:
+
+1. **Live reads** — fire-time published views (``queryable/view.py``):
+   barrier-free, bit-equal to the operator's own fire-time values for
+   already-fired panes, on the host/device tiers, at mesh 1 and 2, and
+   through a quarantine degrade.
+2. **Checkpoint replicas** (``queryable/replica.py``): lookups at the
+   last-completed-checkpoint consistency level, sharded by the writer's
+   own key-group layout (subtask ranges / mesh slice manifests), with
+   staleness gauges and manifest-driven catch-up across rescales; chaos:
+   a partitioned replica keeps serving at its advertised staleness and
+   re-converges after heal (``Partition(direction=)``), a slow-disk
+   storage only delays it (``SlowDisk``).
+3. **Serving front end** (``queryable/server.py`` + REST): batched lookup
+   protocol (one request, N keys, columnar answer), pooled client with
+   eviction + retry/backoff, the unknown-state reply that no longer leaks
+   the registered-state list, and the REST state endpoints + panel.
+"""
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import RuntimeContext, SumAggregator
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.queryable import (CheckpointReplica, KvStateRegistry,
+                                 QueryableStateClient,
+                                 QueryableStateClientPool,
+                                 QueryableStateServer, QueryableStateService,
+                                 QueryableStateSpec)
+from flink_tpu.queryable.replica import REPLICA_FETCH_POINT
+from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+from flink_tpu.testing import chaos
+from flink_tpu.testing.chaos import (FaultInjector, Partition, SlowDisk,
+                                     WedgedDevice)
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+WINDOW_MS = 1000
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _build_op(emit_tier="host", queryable="agg", mesh_devices=0, **kw):
+    kwargs = dict(key_column="k", value_column="v", emit_tier=emit_tier,
+                  queryable=queryable, **kw)
+    if emit_tier == "host":
+        kwargs.setdefault("snapshot_source", "mirror")
+    if mesh_devices:
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.mesh_runtime import MeshWindowAggOperator
+        kwargs.pop("emit_tier")
+        kwargs.pop("snapshot_source", None)
+        op = MeshWindowAggOperator(
+            TumblingEventTimeWindows.of(WINDOW_MS),
+            SumAggregator(jnp.float32), mesh=make_mesh(mesh_devices),
+            **kwargs)
+    else:
+        op = WindowAggOperator(TumblingEventTimeWindows.of(WINDOW_MS),
+                               SumAggregator(jnp.float32), **kwargs)
+    op.open(RuntimeContext())
+    return op
+
+
+def _batches(n=8, b=512, keys=61, seed=9, integer_values=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        k = rng.integers(0, keys, b)
+        if integer_values:
+            # integer-valued floats: exact in f32 AND f64, so device-tier
+            # and degraded (f64 mirror) runs are bit-comparable — the
+            # PR-4 digest convention
+            v = rng.integers(1, 8, b).astype(np.float32)
+        else:
+            v = (rng.random(b) * 10).astype(np.float32)
+        ts = i * (WINDOW_MS // 2) + np.sort(
+            rng.integers(0, WINDOW_MS // 2, b)).astype(np.int64)
+        out.append((k, v, ts))
+    return out
+
+
+def _drain(op, batches):
+    out = []
+    for k, v, ts in batches:
+        out += op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        out += op.process_watermark(Watermark(int(ts.max()) - 1))
+    out += op.end_input()
+    return out
+
+
+def _fire_values(elements, value_col="result"):
+    """key -> (value, window_start) of the NEWEST fired window containing
+    the key — what a live read must return, bit-equal."""
+    expect = {}
+    for el in elements:
+        if not hasattr(el, "columns") or value_col not in el.columns:
+            continue
+        ks = np.asarray(el.column("k"))
+        vs = np.asarray(el.column(value_col))
+        ws = np.asarray(el.column("window_start"))
+        for k, v, w in zip(ks.tolist(), vs.tolist(), ws.tolist()):
+            if k not in expect or w >= expect[k][1]:
+                expect[k] = (v, w)
+    return expect
+
+
+def _assert_view_bit_equal(view, expect, retained_windows=4):
+    starts = sorted({w for _v, w in expect.values()}, reverse=True)
+    served = set(starts[:retained_windows])
+    keys = [k for k, (_v, w) in expect.items() if w in served]
+    assert keys
+    found, values, tags = view.lookup_batch(np.asarray(keys, np.int64))
+    assert found.all()
+    for i, k in enumerate(keys):
+        v, w = expect[k]
+        assert values[i]["result"] == v, (k, values[i], v)   # bit-equal
+        assert values[i]["window_start"] == w
+    return tags
+
+
+# ---------------------------------------------------------------------------
+# layer 1: live reads
+# ---------------------------------------------------------------------------
+
+def test_live_view_bit_equal_host_tier():
+    op = _build_op(emit_tier="host")
+    expect = _fire_values(_drain(op, _batches()))
+    tags = _assert_view_bit_equal(op.queryable_view(), expect)
+    assert tags["watermark"] is not None
+    # checkpoint tag reflects notifications
+    op.notify_checkpoint_complete(7)
+    op2 = _build_op(emit_tier="host")
+    op2.notify_checkpoint_complete(7)
+    _drain(op2, _batches())
+    assert op2.queryable_view().tags()["checkpoint_id"] == 7
+
+
+def test_live_view_bit_equal_device_tier():
+    op = _build_op(emit_tier="device")
+    expect = _fire_values(_drain(op, _batches()))
+    _assert_view_bit_equal(op.queryable_view(), expect)
+
+
+def test_live_view_bit_equal_mesh_1_and_2():
+    """Acceptance: live reads bit-equal to fire-time values at mesh 1 AND
+    mesh 2 (and the two meshes agree with each other bit-for-bit)."""
+    batches = _batches(seed=17)
+    expects = []
+    for d in (1, 2):
+        op = _build_op(mesh_devices=d)
+        expect = _fire_values(_drain(op, batches))
+        _assert_view_bit_equal(op.queryable_view(), expect)
+        expects.append(expect)
+    assert expects[0] == expects[1]
+
+
+def test_live_view_missing_key_and_retention():
+    op = _build_op()
+    _drain(op, _batches())
+    view = op.queryable_view()
+    found, values, _ = view.lookup_batch(np.asarray([10 ** 12], np.int64))
+    assert not found[0] and values[0] is None
+    # the ring retains the newest few windows only
+    assert len(view._segments) <= view.retain_windows * 2
+    assert view.published_windows >= 4
+
+
+def test_live_view_never_blocks_on_pipelined_operator():
+    """The monitoring contract: a lookup takes no pipeline barrier — it
+    must answer while a hot stage is mid-flight (no flush)."""
+    op = _build_op(pipeline_depth=1)
+    batches = _batches()
+    for k, v, ts in batches[:-1]:
+        op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        op.process_watermark(Watermark(int(ts.max()) - 1))
+    t0 = time.perf_counter()
+    op.queryable_view().lookup_batch(np.asarray([1, 2, 3], np.int64))
+    assert time.perf_counter() - t0 < 1.0
+    op.end_input()
+    op.close()
+
+
+@pytest.mark.chaos
+def test_live_read_during_quarantine_degrade_digest_consistent():
+    """PR-4 acceptance extended to reads: wedge the device mid-job, let
+    the operator degrade to the host tier — live reads must stay
+    bit-equal to the (digest-identical) fire-time values."""
+    from flink_tpu.runtime import device_health as dh
+    from flink_tpu.runtime.device_health import (DeviceHealthMonitor,
+                                                 WatchdogConfig)
+    prev = dh.get_monitor(create=False)
+    try:
+        cfg = WatchdogConfig(deadline_floor_s=0.25,
+                             first_dispatch_grace_s=30.0,
+                             backoff_initial_s=0.001, backoff_max_s=0.01,
+                             probe_backoff_initial_s=0.02,
+                             probe_backoff_max_s=0.1)
+        dh.set_monitor(DeviceHealthMonitor(cfg, heal_async=False))
+        batches = _batches(n=10, seed=3, integer_values=True)
+        clean_op = _build_op(emit_tier="device", queryable=None)
+        clean = _fire_values(_drain(clean_op, batches))
+
+        dh.set_monitor(DeviceHealthMonitor(cfg, heal_async=False))
+        op = _build_op(emit_tier="device")
+        inj = FaultInjector(seed=1)
+        sched = inj.inject("device.dispatch", WedgedDevice(at=6))
+        out = []
+        with chaos.installed(inj):
+            for i, (k, v, ts) in enumerate(batches):
+                out += op.process_batch(
+                    RecordBatch({"k": k, "v": v}, timestamps=ts))
+                out += op.process_watermark(Watermark(int(ts.max()) - 1))
+                if i == 7:
+                    sched.heal()
+            out += op.end_input()
+        assert op.device_health_stats()["quarantine_migrations"] == 1
+        expect = _fire_values(out)
+        assert expect == clean          # digest-consistent with host tier
+        _assert_view_bit_equal(op.queryable_view(), expect)
+    finally:
+        chaos.uninstall()
+        dh.set_monitor(prev if prev is not None and prev.healthy else None)
+
+
+# ---------------------------------------------------------------------------
+# layer 2: checkpoint replicas
+# ---------------------------------------------------------------------------
+
+def _assembled_from(op, cid, uid="win"):
+    op.prepare_snapshot_pre_barrier()
+    return {uid: {"subtasks": [{"operator": {"op0": op.snapshot_state()}}]},
+            "__job__": {"checkpoint_id": cid}}
+
+
+def _expected_sums(batches):
+    exp = {}
+    for k, v, _ts in batches:
+        for kk, vv in zip(k.tolist(), v.tolist()):
+            exp[kk] = exp.get(kk, 0.0) + vv
+    return exp
+
+
+def test_replica_serves_last_completed_checkpoint():
+    batches = _batches(n=4, seed=21)
+    op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+    for k, v, ts in batches:
+        op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        op.process_watermark(Watermark(int(ts.max()) - 1))
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k", op.agg))
+    assert rep.ingest_assembled(1, _assembled_from(op, 1))
+    exp = _expected_sums(batches)
+    q = np.asarray(sorted(exp), np.int64)
+    found, values, tags = rep.lookup_batch(q)
+    assert found.all()
+    assert tags["checkpoint_id"] == 1
+    for i, k in enumerate(q.tolist()):
+        assert abs(values[i]["result"] - exp[k]) <= 2e-2 + 1e-4 * abs(exp[k])
+    # unknown key: found=False, no insert anywhere
+    f2, v2, _ = rep.lookup_batch([987654321])
+    assert not f2[0] and v2[0] is None
+
+
+def test_replica_subtask_sharding_routes_like_a_record():
+    """Two hash-partitioned subtask snapshots: the replica routes each
+    query to the shard whose key-group range owns the key — a key placed
+    (wrongly) in the OTHER shard must not be served from there."""
+    from flink_tpu.queryable.view import route_keys
+    keys = np.arange(40, dtype=np.int64)
+    owner = route_keys(keys, 2, 128)
+    ops = []
+    for sub in (0, 1):
+        op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+        mine = keys[owner == sub]
+        vals = (mine * 10 + sub).astype(np.float32)
+        ts = np.full(mine.size, 10, np.int64)
+        op.process_batch(RecordBatch({"k": mine, "v": vals}, timestamps=ts))
+        op.process_watermark(Watermark(50))
+        ops.append(op)
+    assembled = {"win": {"subtasks": [
+        {"operator": {"op0": ops[0].snapshot_state()}},
+        {"operator": {"op0": ops[1].snapshot_state()}}]}}
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k",
+                                               ops[0].agg))
+    assert rep.ingest_assembled(1, assembled)
+    st = rep.stats()
+    assert len(st["shards"]) == 2
+    # manifest = the job's own key-group ranges
+    assert st["shards"][0]["key_groups"] == [0, 63]
+    assert st["shards"][1]["key_groups"] == [64, 127]
+    found, values, _ = rep.lookup_batch(keys)
+    assert found.all()
+    for i, k in enumerate(keys.tolist()):
+        assert values[i]["result"] == float(k * 10 + owner[i])
+
+
+def test_replica_routes_with_full_parallelism_when_a_subtask_is_empty():
+    """A subtask that saw no records has no keyed snapshot, but it still
+    OWNS its key-group range: routing must use the FULL subtask count, or
+    present keys resolve as not-found."""
+    from flink_tpu.queryable.view import route_keys
+    keys = np.arange(60, dtype=np.int64)
+    owner = route_keys(keys, 3, 128)
+    ops = {}
+    for sub in (0, 2):                   # subtask 1 stays empty
+        op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+        mine = keys[owner == sub]
+        op.process_batch(RecordBatch(
+            {"k": mine, "v": (mine * 2).astype(np.float32)},
+            timestamps=np.full(mine.size, 10, np.int64)))
+        op.process_watermark(Watermark(50))
+        ops[sub] = op
+    assembled = {"win": {"subtasks": [
+        {"operator": {"op0": ops[0].snapshot_state()}},
+        {"operator": {}},                # no keyed state yet
+        {"operator": {"op0": ops[2].snapshot_state()}}]}}
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k",
+                                               ops[0].agg))
+    assert rep.ingest_assembled(1, assembled)
+    served = keys[(owner == 0) | (owner == 2)]
+    found, values, _ = rep.lookup_batch(served)
+    assert found.all()
+    for i, k in enumerate(served.tolist()):
+        assert values[i]["result"] == float(k * 2)
+    # subtask 1's keys are genuinely absent, not misrouted
+    f_empty, _v, _t = rep.lookup_batch(keys[owner == 1])
+    assert not f_empty.any()
+
+
+def test_non_scalar_keys_rejected_cleanly():
+    """List/dict/null keys from an untrusted client must come back as an
+    'err' reply — never an unreplied dropped connection."""
+    op = _build_op()
+    _drain(op, _batches(n=2, seed=55))
+    registry = KvStateRegistry()
+    registry.register_views("agg", [op.queryable_view()], 1, 128)
+    status, msg = registry.lookup_batch("agg", [[1, 2], 3])
+    assert status == "err" and "JSON scalars" in msg
+    status, msg = registry.lookup("agg", {"k": 1})
+    assert status == "err" and "scalar" in msg
+    server = QueryableStateServer(registry).start()
+    pool = QueryableStateClientPool(server.host, server.port, retries=0)
+    try:
+        with pytest.raises(RuntimeError, match="JSON scalars"):
+            pool.get_batch("agg", [[1, 2]])
+        # the connection survived the poison request
+        got = pool.get_batch("agg", [1, 2])
+        assert len(got["found"]) == 2
+    finally:
+        pool.close()
+        server.stop()
+
+
+def test_legacy_lookup_on_replica_only_state_names_the_consistency():
+    op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+    registry = KvStateRegistry()
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k", op.agg))
+    registry.register_replica("agg", rep)
+    status, msg = registry.lookup("agg", 1)
+    assert status == "err" and "checkpoint" in msg
+    assert "unknown" not in msg
+
+
+def test_replica_mesh_slices_and_rescale_catch_up():
+    """Mesh-2 slices ingest with their manifests; a later checkpoint from
+    a DIFFERENT layout (mesh 1) re-shards the replica wholesale —
+    manifest-driven catch-up, counted."""
+    batches = _batches(n=3, seed=33)
+    exp = _expected_sums(batches)
+    q = np.asarray(sorted(exp), np.int64)
+
+    def run_mesh(d):
+        op = _build_op(queryable=None, mesh_devices=d,
+                       allowed_lateness_ms=60_000)
+        for k, v, ts in batches:
+            op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+            op.process_watermark(Watermark(int(ts.max()) - 1))
+        return op
+
+    op2 = run_mesh(2)
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k", op2.agg))
+    assert rep.ingest_assembled(1, _assembled_from(op2, 1))
+    st = rep.stats()
+    assert len(st["shards"]) == 2
+    assert all(s["row_range"] is not None for s in st["shards"])
+    found, values, _ = rep.lookup_batch(q)
+    assert found.all()
+    for i, k in enumerate(q.tolist()):
+        assert abs(values[i]["result"] - exp[k]) <= 2e-2 + 1e-4 * abs(exp[k])
+
+    op1 = run_mesh(1)
+    assert rep.ingest_assembled(2, _assembled_from(op1, 2))
+    st2 = rep.stats()
+    assert st2["catch_ups"] == 1 and st2["serving_checkpoint_id"] == 2
+    found2, values2, tags2 = rep.lookup_batch(q)
+    assert found2.all() and tags2["checkpoint_id"] == 2
+
+
+@pytest.mark.chaos
+def test_partitioned_replica_serves_stale_and_reconverges():
+    """Nemesis acceptance: ``Partition(direction="storage->replica")``
+    blackholes the replica's bulk fetch.  It must KEEP SERVING its last
+    ingested checkpoint at the advertised staleness (lag gauges move),
+    and re-converge after heal."""
+    storage = InMemoryCheckpointStorage(retain=5)
+    op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+    b1 = _batches(n=2, seed=40)
+    for k, v, ts in b1:
+        op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        op.process_watermark(Watermark(int(ts.max()) - 1))
+    storage.store(1, _assembled_from(op, 1))
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k", op.agg),
+                            storage=storage)
+    assert rep.poll_once()
+    exp1 = _expected_sums(b1)
+    q = np.asarray(sorted(exp1), np.int64)
+    found, values1, tags = rep.lookup_batch(q)
+    assert found.all() and tags["replica_lag_checkpoints"] == 0
+
+    inj = FaultInjector(seed=2)
+    part = inj.inject(REPLICA_FETCH_POINT,
+                      Partition(direction="storage->replica"))
+    b2 = _batches(n=2, seed=41)
+    for k, v, ts in b2:
+        op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        op.process_watermark(Watermark(int(ts.max()) - 1))
+    storage.store(2, _assembled_from(op, 2))
+    storage.store(3, _assembled_from(op, 3))
+    with chaos.installed(inj):
+        assert not rep.poll_once()      # fetch dropped: stays stale
+        f2, values_stale, tags2 = rep.lookup_batch(q)
+        # advertised staleness: still serving checkpoint 1, 2 behind
+        assert tags2["checkpoint_id"] == 1
+        assert tags2["replica_lag_checkpoints"] == 2
+        assert tags2["replica_lag_ms"] >= 0.0
+        assert f2.all()
+        assert [v["result"] for v in values_stale] == \
+            [v["result"] for v in values1]
+        part.heal()
+        assert rep.poll_once()          # re-converges
+    tags3 = rep.tags()
+    assert tags3["checkpoint_id"] == 3
+    assert tags3["replica_lag_checkpoints"] == 0
+    exp_all = _expected_sums(b1 + b2)
+    f3, v3, _ = rep.lookup_batch(q)
+    assert f3.all()
+    for i, k in enumerate(q.tolist()):
+        assert abs(v3[i]["result"] - exp_all[k]) \
+            <= 2e-2 + 1e-4 * abs(exp_all[k])
+
+
+@pytest.mark.chaos
+def test_slow_disk_replica_keeps_serving():
+    """``SlowDisk`` on the storage load path only DELAYS catch-up; every
+    query in between is answered from the frozen arrays (no blocking)."""
+    storage = InMemoryCheckpointStorage(retain=5)
+    op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+    b1 = _batches(n=2, seed=44)
+    for k, v, ts in b1:
+        op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        op.process_watermark(Watermark(int(ts.max()) - 1))
+    storage.store(1, _assembled_from(op, 1))
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k", op.agg),
+                            storage=storage)
+    inj = FaultInjector(seed=3)
+    inj.inject("checkpoint.load", SlowDisk(max_s=0.15, min_s=0.05, p=1.0))
+    with chaos.installed(inj):
+        t0 = time.perf_counter()
+        assert rep.poll_once()          # slow, but lands
+        assert time.perf_counter() - t0 >= 0.05
+        q = np.asarray(sorted(_expected_sums(b1)), np.int64)
+        t1 = time.perf_counter()
+        found, _v, _t = rep.lookup_batch(q)
+        assert found.all()
+        assert time.perf_counter() - t1 < 0.05   # lookups never touch disk
+    assert inj.fired("checkpoint.load") >= 1
+
+
+# ---------------------------------------------------------------------------
+# layer 3: serving front end
+# ---------------------------------------------------------------------------
+
+def test_unknown_state_reply_does_not_leak_registry():
+    registry = KvStateRegistry()
+    op = _build_op()
+    registry.register_views("secret-state-name", [op.queryable_view()], 1,
+                            128)
+    status, msg = registry.lookup("nope", 1)
+    assert status == "err"
+    assert "secret-state-name" not in str(msg)
+    status2, msg2 = registry.lookup_batch("nope", [1, 2])
+    assert status2 == "err" and "secret-state-name" not in str(msg2)
+
+
+def test_batched_tcp_protocol_live_and_checkpoint():
+    op = _build_op(allowed_lateness_ms=60_000)
+    batches = _batches(n=4, seed=50)
+    out = []
+    for k, v, ts in batches:
+        out += op.process_batch(RecordBatch({"k": k, "v": v},
+                                            timestamps=ts))
+        out += op.process_watermark(Watermark(int(ts.max()) - 1))
+    svc = QueryableStateService()
+    svc.register_views("agg", [op.queryable_view()], 1, 128)
+    rep = svc.add_replica("agg", QueryableStateSpec("agg", "win", "k",
+                                                    op.agg))
+    # snapshot the live panes BEFORE end-of-input expires them: the
+    # replica serves the last completed checkpoint's cut
+    svc.on_checkpoint_complete(5, _assembled_from(op, 5))
+    assert svc.drain_feed()
+    out += op.end_input()
+    expect = _fire_values(out)
+    server = svc.start_server()
+    pool = QueryableStateClientPool(server.host, server.port, size=2)
+    try:
+        some = sorted(expect)[:16]
+        got = pool.get_batch("agg", some, consistency="live")
+        assert got["found"] == [True] * len(some)
+        for i, k in enumerate(some):
+            assert got["values"][i]["result"] == expect[k][0]
+        assert got["tags"]["consistency"] == "live"
+
+        exp_sums = _expected_sums(batches)
+        gc = pool.get_batch("agg", some, consistency="checkpoint")
+        assert gc["found"] == [True] * len(some)
+        assert gc["tags"]["checkpoint_id"] == 5
+        for i, k in enumerate(some):
+            assert abs(gc["values"][i]["result"] - exp_sums[k]) \
+                <= 2e-2 + 1e-4 * abs(exp_sums[k])
+
+        # consistency errors + single-get compatibility
+        with pytest.raises(RuntimeError):
+            pool.get_batch("agg", [1], consistency="bogus")
+        assert pool.get("agg", some[0])["result"] == expect[some[0]][0]
+        with pytest.raises(KeyError):
+            pool.get("agg", 987654321)
+        # service measured the traffic
+        st = svc.stats()
+        assert st["lookups_total"] >= len(some) * 2
+        assert st["lookup_p99_ms"] is not None
+        assert st["per_state"]["agg"]["replica"]["serving_checkpoint_id"] \
+            == 5
+        assert rep.stats()["ingests"] == 1
+    finally:
+        pool.close()
+        svc.close()
+
+
+def test_legacy_single_socket_client_still_works():
+    op = _build_op()
+    expect = _fire_values(_drain(op, _batches(n=3, seed=51)))
+    registry = KvStateRegistry()
+    registry.register_views("agg", [op.queryable_view()], 1, 128)
+    server = QueryableStateServer(registry).start()
+    try:
+        client = QueryableStateClient(server.host, server.port)
+        k = sorted(expect)[0]
+        assert client.get("agg", k)["result"] == expect[k][0]
+        with pytest.raises(KeyError):
+            client.get("agg", 10 ** 12)
+        client.close()
+    finally:
+        server.stop()
+
+
+class _FlakyOneShotServer:
+    """Answers exactly one request per connection, then slams the socket —
+    the mid-stream failure mode the pooled client must absorb."""
+
+    def __init__(self):
+        registry = KvStateRegistry()
+        op = _build_op()
+        _drain(op, _batches(n=2, seed=52))
+        self._registry = registry
+        registry.register_views("agg", [op.queryable_view()], 1, 128)
+        reg = registry
+        _len = struct.Struct("<I")
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                from flink_tpu.queryable.server import _recv_exact
+                hdr = _recv_exact(self.request, _len.size)
+                if hdr is None:
+                    return
+                (n,) = _len.unpack(hdr)
+                payload = _recv_exact(self.request, n)
+                req = json.loads(payload)
+                resp = reg.lookup_batch(req["state"], req["keys"],
+                                        req.get("consistency", "live"))
+                data = json.dumps(resp).encode()
+                self.request.sendall(_len.pack(len(data)) + data)
+                # one answer per connection: next request on this socket
+                # dies mid-stream
+                self.request.close()
+
+        self._srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                                    Handler)
+        self._srv.daemon_threads = True
+        self.host, self.port = self._srv.server_address
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_pooled_client_evicts_broken_connections_and_retries():
+    srv = _FlakyOneShotServer()
+    pool = QueryableStateClientPool(srv.host, srv.port, size=2, retries=1,
+                                    backoff_s=0.01)
+    try:
+        # every request after the first rides a pooled-but-dead socket:
+        # the pool must evict it and retry on a fresh connection
+        for _ in range(5):
+            got = pool.get_batch("agg", [1, 2, 3])
+            assert len(got["found"]) == 3
+        assert pool.stats["evictions"] >= 1
+        assert pool.stats["retries"] >= 1
+    finally:
+        pool.close()
+        srv.stop()
+    # the old single-socket client on the same server: second get raises
+    # and the socket stays broken (the documented legacy behavior)
+    srv2 = _FlakyOneShotServer()
+    try:
+        c = QueryableStateClient(srv2.host, srv2.port)
+        with pytest.raises((RuntimeError, KeyError, ConnectionError)):
+            c.get("agg", 1)
+            c.get("agg", 2)
+            c.get("agg", 3)
+        c.close()
+    finally:
+        srv2.stop()
+
+
+def test_batch_size_bound():
+    registry = KvStateRegistry()
+    op = _build_op()
+    registry.register_views("agg", [op.queryable_view()], 1, 128)
+    status, msg = registry.lookup_batch("agg", list(range(1 << 16 | 1)))
+    assert status == "err" and "batch too large" in msg
+
+
+# ---------------------------------------------------------------------------
+# cluster wiring: MiniCluster auto-registration + checkpoint feed + REST
+# ---------------------------------------------------------------------------
+
+def _run_cluster_job(n=20_000, checkpoint_interval_ms=30):
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 41, n)
+    vals = np.ones(n, np.float64)
+    ts = np.sort(rng.integers(0, 4000, n))
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    (env.from_collection(columns={"k": keys, "v": vals, "t": ts},
+                         batch_size=128)
+        .assign_timestamps_and_watermarks(0, timestamp_column="t")
+        .key_by("k")
+        .window(TumblingEventTimeWindows.of(1000))
+        .aggregate(SumAggregator(jnp.float64), value_column="v",
+                   queryable="totals")
+        .collect())
+    inj = chaos.FaultInjector(seed=6)
+    inj.inject("channel.recv",
+               chaos.SlowConsumer(max_s=0.02, min_s=0.01, p=0.2, burst=20,
+                                  channel="[0]->"))
+    storage = InMemoryCheckpointStorage(retain=5)
+    with chaos.installed(inj):
+        res = env.execute_cluster(storage=storage,
+                                  checkpoint_interval_ms=
+                                  checkpoint_interval_ms,
+                                  timeout_s=240)
+    return env._last_cluster, res, keys, vals
+
+
+@pytest.mark.chaos
+def test_minicluster_serving_tier_end_to_end():
+    cluster, res, keys, vals = _run_cluster_job()
+    try:
+        assert res.state == "FINISHED"
+        assert len(res.completed_checkpoints) >= 1
+        svc = cluster.queryable
+        assert svc is not None
+        assert svc.drain_feed()
+
+        status = cluster.job_status()["queryable"]
+        assert "totals" in status["states"]
+        rep_stats = status["per_state"]["totals"]["replica"]
+        assert rep_stats["serving_checkpoint_id"] == \
+            max(res.completed_checkpoints)
+        assert rep_stats["replica_lag_checkpoints"] == 0
+        assert len(rep_stats["shards"]) == 2   # parallelism-2 key groups
+
+        # gauges registered on the job metric group
+        all_metrics = cluster.metrics_registry.all_metrics()
+        assert any(n.endswith("queryable.replica_lag_checkpoints")
+                   for n in all_metrics)
+
+        # live + checkpoint reads over TCP with subtask routing
+        server = cluster.start_queryable_server()
+        pool = QueryableStateClientPool(server.host, server.port)
+        exp = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            exp[k] = exp.get(k, 0.0) + v
+        q = sorted(exp)
+        live = pool.get_batch("totals", q, consistency="live")
+        assert all(live["found"])
+        ck = pool.get_batch("totals", q, consistency="checkpoint")
+        assert ck["tags"]["checkpoint_id"] == max(res.completed_checkpoints)
+        assert any(ck["found"])        # the last ckpt precedes end-of-input
+        pool.close()
+    finally:
+        if cluster.queryable is not None:
+            cluster.queryable.close()
+
+
+def test_rest_state_endpoints_and_panel():
+    from flink_tpu.rest.server import JobRegistry, RestServer
+    cluster, res, keys, vals = _run_cluster_job(n=6000,
+                                                checkpoint_interval_ms=0)
+    registry = JobRegistry()
+    jid = registry.register("qjob", cluster)
+    rest = RestServer(registry).start()
+    try:
+        assert cluster.queryable is not None
+        base = f"{rest.url}/jobs/{jid}"
+        k = int(keys[0])
+        got = json.load(urllib.request.urlopen(
+            f"{base}/state/totals/{k}?consistency=live"))
+        assert got["key"] == k and "result" in got["value"]
+        assert got["tags"]["consistency"] == "live"
+        # missing key -> 404 with tags
+        try:
+            urllib.request.urlopen(f"{base}/state/totals/999999999")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # batch endpoint
+        req = urllib.request.Request(
+            f"{base}/state/totals:batch",
+            data=json.dumps({"keys": [k, 999999999],
+                             "consistency": "live"}).encode(),
+            headers={"Content-Type": "application/json"})
+        got2 = json.load(urllib.request.urlopen(req))
+        assert got2["found"] == [True, False]
+        # stats + panel
+        st = json.load(urllib.request.urlopen(f"{base}/queryable"))
+        assert "totals" in st["states"]
+        html = urllib.request.urlopen(
+            f"{base}/queryable.html").read().decode()
+        assert 'class="qs-panel"' in html and 'data-state="totals"' in html
+    finally:
+        rest.stop()
+        if cluster.queryable is not None:
+            cluster.queryable.close()
+
+
+# ---------------------------------------------------------------------------
+# ProcessCluster wiring: coordinator-side replica off the checkpoint stream
+# ---------------------------------------------------------------------------
+
+def test_process_cluster_replica_wiring(tmp_path):
+    """The coordinator's serving tier is replica-only (live views live in
+    the worker processes): enable_queryable + the checkpoint-stream feed,
+    exercised against the storage a coordinator writes — without
+    spawning workers (tier-1 friendly)."""
+    from flink_tpu.cluster.distributed import ProcessCluster
+    from flink_tpu.runtime.checkpoint.storage import FileCheckpointStorage
+
+    storage = FileCheckpointStorage(str(tmp_path / "ckpts"))
+    op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+    batches = _batches(n=3, seed=60)
+    for k, v, ts in batches:
+        op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        op.process_watermark(Watermark(int(ts.max()) - 1))
+    pc = ProcessCluster("qjob", n_workers=1, checkpoint_storage=storage,
+                        spawn=False)
+    svc = pc.enable_queryable("totals", "win", op.agg, "k")
+    assert pc.queryable is svc
+
+    # the coordinator's _complete feed path
+    pc.queryable.on_checkpoint_complete(1, _assembled_from(op, 1))
+    assert svc.drain_feed()
+    assert pc.queryable_stats()["per_state"]["totals"]["replica"][
+        "serving_checkpoint_id"] == 1
+
+    # and the storage-tailing path an external serving process would use
+    storage.store(2, _assembled_from(op, 2))
+    rep = svc.registry.replicas()["totals"]
+    assert rep.poll_once()
+    exp = _expected_sums(batches)
+    q = np.asarray(sorted(exp), np.int64)
+    found, values, tags = rep.lookup_batch(q)
+    assert found.all() and tags["checkpoint_id"] == 2
+    svc.close()
