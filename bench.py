@@ -245,6 +245,7 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
     snapshots taken, phase dict, mid-run snapshot + its batch index +
     post-checkpoint digests for the replay check)."""
     from flink_tpu.core.batch import RecordBatch, Watermark
+    from flink_tpu.observability import tracing
 
     def run(op, subset, checkpoint_every=0):
         t0 = time.perf_counter()
@@ -263,10 +264,21 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
                 digests.extend(_fire_digests(out))
             n += len(keys)
             if checkpoint_every and (i + 1) % checkpoint_every == 0:
+                # checkpoint lifecycle spans (no-ops unless a span journal
+                # is installed — the --trace leg): trigger → snapshot →
+                # complete on the same timeline as the hot-stage phases
+                cid = snaps + 1
+                tracing.instant("checkpoint.trigger", cat="checkpoint",
+                                checkpoint=cid)
                 s0 = time.perf_counter_ns()
-                op.prepare_snapshot_pre_barrier()
-                snap = op.snapshot_state()
-                snap_ns += time.perf_counter_ns() - s0
+                with tracing.span("checkpoint.snapshot", cat="checkpoint",
+                                  checkpoint=cid):
+                    op.prepare_snapshot_pre_barrier()
+                    snap = op.snapshot_state()
+                s1 = time.perf_counter_ns()
+                tracing.complete("checkpoint", s0, s1, cat="checkpoint",
+                                 checkpoint=cid)
+                snap_ns += s1 - s0
                 snaps += 1
                 if mid is None:          # keep the FIRST mid-run snapshot
                     mid = (i, snap)
@@ -1624,6 +1636,94 @@ def check_budget(result: dict, budget: dict) -> list:
     return viol
 
 
+def run_trace_bench(args, batches) -> dict:
+    """The --trace legs: a tracing-OFF and a tracing-ON run of the SAME
+    headline workload (same warmup/checkpoint cadence, best-of-2 each,
+    back-to-back so host drift mostly cancels), plus the Chrome
+    trace-event artifact from the ON leg's span journal.  Returns the
+    ``details["trace"]`` dict; the artifact itself is written to
+    ``args.trace``."""
+    from flink_tpu.observability import tracing
+
+    kw = dict(emit_tier=args.emit_tier, device_sync=args.device_sync,
+              timed_passes=2, pipeline_depth=args.pipeline_depth,
+              native_shards=args.native_shards,
+              device_probe=args.device_probe)
+    off_rps = run_tpu_native(batches, args.window_ms,
+                             args.checkpoint_every, **kw)[0]
+    journal = tracing.install(tracing.SpanJournal(capacity=1 << 17))
+    try:
+        on_rps = run_tpu_native(batches, args.window_ms,
+                                args.checkpoint_every, **kw)[0]
+    finally:
+        tracing.uninstall()
+    snap = journal.snapshot()
+    spans = snap["spans"]
+    hot = sum(1 for s in spans if s[4] == "hot_stage")
+    ckpt = sum(1 for s in spans if s[4] == "checkpoint")
+    ratio = on_rps / off_rps if off_rps else 0.0
+    return {"journal_snapshot": snap,
+            "tracing_off_rps": round(off_rps, 1),
+            "tracing_on_rps": round(on_rps, 1),
+            "throughput_ratio": round(ratio, 4),
+            "spans": len(spans), "dropped_spans": snap["dropped"],
+            "hot_stage_spans": hot, "checkpoint_spans": ckpt}
+
+
+def write_trace_artifact(path: str, trace: dict, latency_ms: dict) -> dict:
+    """Write the Perfetto-loadable trace-event JSON: the ON leg's spans
+    plus the fire-latency histogram summary (the ``window_fire_ms``
+    percentiles) embedded both as an instant event and in ``otherData``.
+    Returns the summary that lands in the bench result details."""
+    from flink_tpu.observability import tracing
+
+    snap = trace.pop("journal_snapshot")
+    events = tracing.to_chrome(snap, pid=0, process_name="bench")
+    lat_summary = {k: v for k, v in latency_ms.items()}
+    events.append({"name": "latency.window_fire", "cat": "latency",
+                   "ph": "i", "s": "g", "pid": 0, "tid": 0,
+                   "ts": snap["anchor_wall_us"], "args": lat_summary})
+    artifact = {
+        "traceEvents": events, "displayTimeUnit": "ms",
+        "otherData": {
+            "latency_histograms": {"window_fire_ms": lat_summary},
+            "tracing_off_rps": trace["tracing_off_rps"],
+            "tracing_on_rps": trace["tracing_on_rps"],
+            "throughput_ratio": trace["throughput_ratio"],
+            "dropped_spans": trace["dropped_spans"]}}
+    with open(path, "w") as f:
+        json.dump(artifact, f)
+    # count only a summary that carries actual samples — a zero-sample
+    # dict would let the --check structural gate pass on a vacuous
+    # artifact (no windows fired in the timed run)
+    n_summaries = 1 if lat_summary.get("samples") else 0
+    return {**trace, "latency_summaries": n_summaries, "path": path}
+
+
+def check_trace_budget(trace: dict, budget: dict,
+                       smoke: bool = False) -> list:
+    """trace_cpu gate: tracing must stay within the budgeted throughput
+    cost (<5% by default), and the artifact must be STRUCTURALLY useful —
+    hot-stage phase spans, checkpoint lifecycle spans and at least one
+    latency histogram summary, none of it silently truncated away.
+    The throughput ratio only gates FULL-size runs: at smoke size the
+    fixed per-pass costs (compile, first-fire) dominate and the on/off
+    ratio is noise; the structural checks gate unconditionally."""
+    viol = []
+    floor = budget.get("min_throughput_ratio", 0.95)
+    if not smoke and trace["throughput_ratio"] < floor:
+        viol.append(f"tracing-on throughput is "
+                    f"{trace['throughput_ratio']:.3f}x tracing-off "
+                    f"< floor {floor} (tracing must stay ~free)")
+    if trace.get("hot_stage_spans", 0) <= 0:
+        viol.append("trace contains no hot-stage phase spans")
+    if trace.get("checkpoint_spans", 0) <= 0:
+        viol.append("trace contains no checkpoint lifecycle spans")
+    if trace.get("latency_summaries", 0) < 1:
+        viol.append("trace contains no latency histogram summary")
+    return viol
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small fast run")
@@ -1666,6 +1766,15 @@ def main():
                          "to PATH as JSON; the device step is additionally "
                          "annotated for jax.profiler traces "
                          "('window_agg.device_step')")
+    ap.add_argument("--trace", metavar="PATH", default=None,
+                    help="end-to-end tracing artifact (ISSUE-10): run a "
+                         "tracing-off and a tracing-on leg of the headline "
+                         "workload and write the ON leg's span journal as "
+                         "Chrome trace-event JSON (Perfetto-loadable: "
+                         "hot-stage phase spans, checkpoint lifecycle "
+                         "spans, latency histogram summary) to PATH; with "
+                         "--check the tracing-on/off throughput ratio "
+                         "gates against BENCH_BUDGET.json trace_cpu")
     ap.add_argument("--mesh-devices", type=int, default=0, metavar="N",
                     help="run the SHARDED hot path as one logical window "
                          "operator over an N-device mesh (state in "
@@ -1717,6 +1826,17 @@ def main():
                          "heal/re-promote path end-to-end; exits nonzero "
                          "if the cycle or digest equality fails")
     args = ap.parse_args()
+
+    if args.trace and (args.cep or args.queryable or args.mesh_devices
+                       or args.config != 2 or args.inject_wedge
+                       or args.checkpoint_interval):
+        # --trace measures the HEADLINE single-chip workload's on/off legs;
+        # the dedicated-mode branches below exit before the trace block, so
+        # refuse loudly instead of silently writing no artifact
+        print("# ERROR: --trace applies to the headline bench only; drop "
+              "--cep/--queryable/--mesh-devices/--config to produce the "
+              "trace artifact", file=sys.stderr)
+        sys.exit(2)
 
     if args.inject_wedge:
         # standalone smoke with its own fixed 1s window: the cycle under
@@ -1947,6 +2067,12 @@ def main():
             "spill_log_mb": round(p_stats["spill_log_bytes"] / 1e6, 2),
             "paging_ms": round(p_phases.get("paging", 0) / 1e6, 1),
         }
+    trace_detail = None
+    if args.trace:
+        trace = run_trace_bench(args, batches)
+        trace_detail = write_trace_artifact(args.trace, trace,
+                                            detail["latency_ms"])
+        detail["trace"] = trace_detail
     result = {
         "metric": f"records/sec/chip (1M-key tumbling sum, {platform}, "
                   f"checkpointing every {args.checkpoint_every} batches)",
@@ -1977,6 +2103,10 @@ def main():
         with open(args.profile, "w") as f:
             json.dump(artifact, f, indent=1, sort_keys=True)
         print(f"# profile written: {args.profile}", file=sys.stderr)
+    if trace_detail is not None:
+        print(f"# trace written: {args.trace} "
+              f"({trace_detail['spans']} spans, "
+              f"ratio {trace_detail['throughput_ratio']})", file=sys.stderr)
     if args.check:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_BUDGET.json")
@@ -1994,6 +2124,12 @@ def main():
             tier = f"{tier}_device"
         budget = budgets[tier]
         viol = check_budget(result, budget)
+        if trace_detail is not None:
+            # tracing-on must cost <5% throughput (trace_cpu section) and
+            # the artifact must carry the spans the round needs
+            viol += check_trace_budget(trace_detail,
+                                       budgets.get("trace_cpu", {}),
+                                       smoke=args.smoke)
         for v in viol:
             print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
         if not (replay_ok and mirror_ok):

@@ -42,6 +42,7 @@ import numpy as np
 from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
                                   Watermark)
 from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern
+from flink_tpu.observability import tracing
 from flink_tpu.operators.base import StreamOperator
 
 
@@ -713,7 +714,9 @@ class CepOperator(StreamOperator):
                 self._degrade_to_interpreted("device quarantined")
                 return self._drain_interpreted(up_to_ts)
             try:
-                return self._drain_vectorized(up_to_ts)
+                with tracing.span("cep.vectorized_drain", cat="cep",
+                                  up_to_ts=int(up_to_ts)):
+                    return self._drain_vectorized(up_to_ts)
             except device_health.DeviceQuarantinedError:
                 self._degrade_to_interpreted(
                     "vectorized drain dispatch quarantined")

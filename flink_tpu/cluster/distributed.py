@@ -236,8 +236,12 @@ class _WorkerRuntime:
         from flink_tpu.cluster.net import ChannelServer
 
         #: checkpoint-policy options shipped with deploy (unaligned /
-        #: alignment-timeout escalation / alignment-queue cap)
+        #: alignment-timeout escalation / alignment-queue cap) plus the
+        #: observability opts (tracing / latency-marker cadence)
         self._ckpt_opts: Dict[str, Any] = {}
+        #: per-(source, hop) latency histograms for THIS worker's hops;
+        #: shipped to the coordinator with the trace dump
+        self.latency_tracker = None
 
         #: local recovery (TaskLocalStateStoreImpl.java:54): secondary
         #: worker-local snapshot copies; restore prefers them over the
@@ -412,6 +416,18 @@ class _WorkerRuntime:
         if ckpt_opts is not None:
             self._ckpt_opts = dict(ckpt_opts)
         opts = self._ckpt_opts
+        # observability: install the span journal when the coordinator
+        # asked for tracing, and stand up the per-worker latency tracker
+        # (markers record at every local hop; the panel ships with the
+        # trace dump for cross-process assembly)
+        if opts.get("tracing"):
+            from flink_tpu.observability import tracing as tracing_mod
+            if not tracing_mod.enabled():
+                tracing_mod.install(
+                    capacity=int(opts.get("trace_capacity", 65536)))
+        if self.latency_tracker is None:
+            from flink_tpu.observability import LatencyTracker
+            self.latency_tracker = LatencyTracker()
         counts, splits_by_vertex = subtask_counts_of(plan)
         assign = assign_subtasks(plan, counts, self.n_workers)
         me = self.index
@@ -557,7 +573,11 @@ class _WorkerRuntime:
                 # the just-started tasks guarantee a future terminal
                 # transition that runs the done check
                 self._done_sent = False
+        lat_ms = int(opts.get("latency_interval_ms") or 0)
         for t, snap in to_start:
+            t.latency_tracker = self.latency_tracker
+            if lat_ms and isinstance(t, SourceSubtask):
+                t.latency_marker_interval_ms = lat_ms
             t.start(snap)
         if not self.tasks:
             self._done_sent = True
@@ -687,6 +707,17 @@ class _WorkerRuntime:
                                        for t in mine}
                     # _done_sent stays True: deploy(only=...) re-arms it
                 self._send(("reset_done", self.index))
+            elif kind == "trace_request":
+                # ship this process's span ring + latency panel + our wall
+                # reading (the coordinator's clock-offset estimation input)
+                from flink_tpu.observability import tracing as tracing_mod
+                from flink_tpu.utils import clock as _clock
+                j = tracing_mod.active()
+                self._send(("trace_dump", self.index, {
+                    "journal": j.snapshot() if j is not None else None,
+                    "latency": (self.latency_tracker.panel()
+                                if self.latency_tracker is not None else []),
+                    "wall_now_ms": _clock.now_ms()}))
             elif kind == "cancel":
                 for t in self.tasks:
                     t.cancel()
@@ -715,6 +746,8 @@ class _Pending:
         #: expiry through the injectable clock seam, clamped monotone —
         #: a ClockSkew backward step never un-expires a checkpoint
         self.timer = MonotoneElapsed()
+        #: trigger-time perf reading — the trigger→complete trace span
+        self.t0_ns = time.perf_counter_ns()
         #: enumerator snapshots taken at trigger time (§3.4 coordinator
         #: snapshots precede task triggers)
         self.enumerators = enumerators
@@ -736,17 +769,43 @@ class ProcessCluster:
                  checkpoint_timeout_s: float = 60.0,
                  unaligned: bool = False,
                  alignment_timeout_ms: Optional[float] = None,
-                 alignment_queue_max: int = 8192):
+                 alignment_queue_max: int = 8192,
+                 tracing: bool = False,
+                 latency_interval_ms: Optional[int] = None,
+                 trace_capacity: int = 65536):
+        from flink_tpu.observability import tracing as tracing_mod
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureManager
 
         self.job = job
         self.n_workers = n_workers
-        #: unaligned-checkpoint policy, shipped to every worker with the
-        #: deploy message (workers thread it into their Subtasks)
+        #: unaligned-checkpoint + observability policy, shipped to every
+        #: worker with the deploy message (workers thread it into their
+        #: Subtasks / install their span journals)
         self.ckpt_opts = {"unaligned": unaligned,
                           "alignment_timeout_ms": alignment_timeout_ms,
-                          "alignment_queue_max": alignment_queue_max}
+                          "alignment_queue_max": alignment_queue_max,
+                          "tracing": tracing,
+                          "latency_interval_ms": latency_interval_ms,
+                          "trace_capacity": trace_capacity}
+        #: end-to-end tracing: workers record spans locally; at job end
+        #: the coordinator pulls every ring and assembles ONE merged
+        #: timeline (result["trace"], also kept as self.last_trace)
+        self.tracing = tracing
+        #: THIS cluster's coordinator-side journal handle (None when
+        #: tracing is off): run() resets it per execution so job B never
+        #: inherits job A's spans or its consumed ring capacity.  An
+        #: adopted pre-existing journal belongs to whoever installed it —
+        #: we record into it but never reset() it, and its owner's
+        #: capacity choice wins over ``trace_capacity``
+        self._trace_journal = None
+        self._owns_trace_journal = False
+        if tracing:
+            self._trace_journal, self._owns_trace_journal = \
+                tracing_mod.adopt_or_install(trace_capacity)
+        self.last_trace: Optional[Dict[str, Any]] = None
+        self._trace_cv = threading.Condition()
+        self._trace_dumps: List[Tuple[int, Dict[str, Any], float]] = []
         #: per-checkpoint stats incl. alignment/overtaken/persisted
         #: in-flight accounting aggregated from the subtasks' acks
         self._checkpoint_stats: List[Dict[str, Any]] = []
@@ -828,6 +887,10 @@ class ProcessCluster:
         self._pending: Optional[_Pending] = None
         self._failed: Optional[str] = None
         self._done_workers: set = set()
+        #: control connections that hit EOF this attempt: collect_trace
+        #: must not wait its full timeout on a worker that can never
+        #: answer (a SIGKILLed worker's socket EOFs long before reaping)
+        self._dead_conn_idx: set = set()
         self._all_done = threading.Event()
         self._conns: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
@@ -865,6 +928,42 @@ class ProcessCluster:
     def queryable_stats(self):
         return self.queryable.stats() if self.queryable is not None else None
 
+    # -- cross-process trace assembly --------------------------------------
+    def collect_trace(self, timeout_s: float = 15.0) -> Dict[str, Any]:
+        """Pull every live worker's span ring over the control plane and
+        merge them — with per-worker clock-offset estimation — into ONE
+        Chrome trace-event timeline (Perfetto-loadable).  Workers that
+        died or time out are simply absent from the merge."""
+        from flink_tpu.observability.assembly import merge_timelines
+        from flink_tpu.utils import clock as _clock
+
+        with self._trace_cv:
+            self._trace_dumps = []
+        t0_ms = float(_clock.now_ms())
+        conns = [i for i in self._conns if i not in self._dead_conn_idx]
+        for idx in conns:
+            self._to_worker(idx, ("trace_request",))
+        deadline = time.monotonic() + timeout_s
+        with self._trace_cv:
+            while time.monotonic() < deadline:
+                # recompute the live set every pass: a worker dying
+                # MID-collect must shrink what we wait for, not stall
+                # the merge until the full timeout.  Match by INDEX, not
+                # count — a worker that answers and THEN dies would
+                # otherwise satisfy another live worker's quota
+                answered = {d[0] for d in self._trace_dumps}
+                if all(i in answered or i in self._dead_conn_idx
+                       for i in conns):
+                    break
+                self._trace_cv.wait(timeout=0.2)
+            dumps = list(self._trace_dumps)
+        j = self._trace_journal
+        merged = merge_timelines(j.snapshot() if j is not None else None,
+                                 dumps, t0_ms=t0_ms)
+        merged["otherData"]["requested_workers"] = len(conns)
+        self.last_trace = merged
+        return merged
+
     # -- lifecycle ---------------------------------------------------------
     def run(self, timeout_s: float = 180.0,
             restore: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
@@ -878,7 +977,29 @@ class ProcessCluster:
         transactional sinks (``connectors/sinks.py``,
         ``connectors/log_service.py``) — the collect path keeps its whole
         result in memory/checkpoints by design."""
+        from flink_tpu.observability import tracing as tracing_mod
+
         original_restore = restore
+        if self.tracing:
+            # shared ownership state machine with MiniCluster.execute —
+            # per-execution reset of an owned coordinator ring, fresh ring
+            # when an adopted one's owner released, re-adoption otherwise
+            self._trace_journal, self._owns_trace_journal = \
+                tracing_mod.acquire_for_execution(
+                    self._trace_journal, self._owns_trace_journal,
+                    capacity=int(self.ckpt_opts.get("trace_capacity")
+                                 or 65536))
+        j, owned = self._trace_journal, self._owns_trace_journal
+        try:
+            return self._run_attempts(timeout_s, restore, original_restore)
+        finally:
+            # self._trace_journal/last_trace keep serving afterwards
+            tracing_mod.release_after_execution(j, owned)
+
+    def _run_attempts(self, timeout_s: float,
+                      restore: Optional[Dict[str, Any]],
+                      original_restore: Optional[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
         attempt = 0
         self._restarts = 0
         while True:
@@ -1070,6 +1191,24 @@ class ProcessCluster:
                         target=self._checkpoint_loop,
                         args=(all_subtasks, self._all_done),
                         daemon=True).start()
+            # assemble the merged cross-worker timeline BEFORE stopping
+            # the workers (their control loops must still answer).  The
+            # latency panel rides the same collection, and a latency
+            # interval WITHOUT tracing still deserves its histograms —
+            # the workers answer trace_request with journal=None then.
+            trace = None
+            latency_rows = None
+            if self.tracing or self.ckpt_opts.get("latency_interval_ms"):
+                merged = self.collect_trace()
+                rows = merged["otherData"].get("latency") or []
+                # the documented contract: latency_interval_ms alone
+                # always yields result["latency"] — an empty panel (no
+                # marker observed before the job finished) is an empty
+                # list, not a missing key
+                if rows or self.ckpt_opts.get("latency_interval_ms"):
+                    latency_rows = rows
+                if self.tracing:
+                    trace = merged
             for idx in self._conns:
                 self._to_worker(idx, ("stop",))
             for p in procs:
@@ -1085,7 +1224,10 @@ class ProcessCluster:
                     "recoveries": recoveries,
                     "completed_checkpoints": list(self._completed_ids),
                     "failed_checkpoints": self.failure_manager.num_failed(),
-                    "checkpoint_stats": list(self._checkpoint_stats)}
+                    "checkpoint_stats": list(self._checkpoint_stats),
+                    **({"trace": trace} if trace is not None else {}),
+                    **({"latency": latency_rows}
+                       if latency_rows is not None else {})}
         finally:
             self._all_done.set()   # stop this attempt's checkpoint ticker
             srv.close()
@@ -1135,6 +1277,12 @@ class ProcessCluster:
         for idx, conn in new_conns:
             self._conns[idx] = conn
             self._send_locks[idx] = threading.Lock()
+            with self._lock:
+                # the respawned worker's NEW control conn can answer
+                # trace_requests again — leaving it in the dead set would
+                # silently drop its ring from the merged timeline on
+                # exactly the recovered-worker runs the trace explains
+                self._dead_conn_idx.discard(idx)
             threading.Thread(target=self._serve_worker, args=(idx, conn),
                              daemon=True).start()
         return True
@@ -1362,10 +1510,16 @@ class ProcessCluster:
                 return  # a restart superseded this attempt: stale thread
             if msg is None:
                 with self._lock:
+                    if gen == self._gen:
+                        # done or not, this conn can never answer a
+                        # trace_request again — unblock any collector
+                        self._dead_conn_idx.add(idx)
                     if gen == self._gen and idx not in self._done_workers \
                             and self._failed is None:
                         self._failed = f"worker {idx} died"
                         self._all_done.set()
+                with self._trace_cv:
+                    self._trace_cv.notify_all()
                 return
             kind = msg[0]
             if kind == "state":
@@ -1437,6 +1591,12 @@ class ProcessCluster:
                 _, uid, i, rows = msg
                 with self._lock:
                     self._rows[(uid, i)] = rows
+            elif kind == "trace_dump":
+                from flink_tpu.utils import clock as _clock
+                with self._trace_cv:
+                    self._trace_dumps.append((msg[1], msg[2],
+                                              float(_clock.now_ms())))
+                    self._trace_cv.notify_all()
             elif kind == "reset_done":
                 with self._reset_cv:
                     self._reset_acks.add(msg[1])
@@ -1476,6 +1636,9 @@ class ProcessCluster:
             coord = getattr(self, "_source_coordinator", None)
             enums = (coord.snapshot() if coord is not None and coord._enums
                      else None)
+            from flink_tpu.observability import tracing as tracing_mod
+            tracing_mod.instant("checkpoint.trigger", cat="checkpoint",
+                                checkpoint=cid)
             self._pending = _Pending(cid, live, enumerators=enums)
         for idx in self._conns:
             self._to_worker(idx, ("checkpoint", cid))
@@ -1544,10 +1707,15 @@ class ProcessCluster:
         # aggregate the subtasks' channel-state (v1) alignment accounting
         # (one shared reader of the schema: task.aggregate_channel_state)
         from flink_tpu.cluster.task import aggregate_channel_state
+        from flink_tpu.observability import tracing as tracing_mod
+        agg = aggregate_channel_state(p.acks.values())
+        tracing_mod.complete("checkpoint", p.t0_ns, time.perf_counter_ns(),
+                             cat="checkpoint", checkpoint=p.cid,
+                             acked=len(p.acks),
+                             unaligned=bool(agg["unaligned"]))
         self._checkpoint_stats.append({
             "id": p.cid, "duration_ms": round(p.timer.ms(), 1),
-            "acked_subtasks": len(p.acks),
-            **aggregate_channel_state(p.acks.values())})
+            "acked_subtasks": len(p.acks), **agg})
         del self._checkpoint_stats[:-100]
         for idx in self._conns:
             self._to_worker(idx, ("notify", p.cid))

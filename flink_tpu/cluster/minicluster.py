@@ -34,6 +34,7 @@ from flink_tpu.cluster.task import (SourceSubtask, Subtask, SubtaskBase,
                                     TaskListener, TaskStates)
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.graph.stream_graph import ExecutionPlan, PlanVertex
+from flink_tpu.observability import tracing
 from flink_tpu.utils import clock
 
 
@@ -44,6 +45,8 @@ class _PendingCheckpoint:
     #: monotone elapsed timer (injectable clock seam): expiry decisions
     #: never regress under a chaos ClockSkew backward step
     timer: "clock.MonotoneElapsed"
+    #: trigger-time perf reading — the trigger→complete span endpoints
+    t0_ns: int = 0
     acks: Dict[Tuple[str, int], Dict[str, Any]] = field(default_factory=dict)
     #: OperatorCoordinator snapshots taken at TRIGGER time (the reference
     #: snapshots SourceCoordinator state before triggering tasks, §3.4)
@@ -107,10 +110,15 @@ class MiniCluster(TaskListener):
                  channel_capacity: int = 32, restart_strategy=None,
                  config=None, tolerable_failed_checkpoints: int = 0,
                  alignment_timeout_ms: Optional[float] = None,
-                 alignment_queue_max: Optional[int] = None):
+                 alignment_queue_max: Optional[int] = None,
+                 latency_interval_ms: Optional[int] = None,
+                 tracing_enabled: Optional[bool] = None):
         from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
                                                 NoRestartStrategy)
-        from flink_tpu.config.options import CheckpointingOptions
+        from flink_tpu.config.options import (CheckpointingOptions,
+                                              MetricOptions)
+        from flink_tpu.observability import LatencyTracker
+        from flink_tpu.observability import tracing as tracing_mod
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureManager
 
@@ -123,6 +131,33 @@ class MiniCluster(TaskListener):
             if alignment_timeout_ms is None:
                 alignment_timeout_ms = config.get(
                     CheckpointingOptions.ALIGNMENT_TIMEOUT)
+        # latency tracking + tracing: explicit args win, then the
+        # metrics.latency.interval / metrics.tracing.* config keys
+        if latency_interval_ms is None and config is not None:
+            latency_interval_ms = config.get(MetricOptions.LATENCY_INTERVAL)
+        self.latency_interval_ms = int(latency_interval_ms or 0)
+        if tracing_enabled is None and config is not None:
+            tracing_enabled = bool(config.get(MetricOptions.TRACING_ENABLED))
+        self.tracing_enabled = bool(tracing_enabled)
+        #: THIS cluster's journal handle: job_status()/trace_events() read
+        #: it instead of the process singleton, so a tracing-off job in
+        #: the same process never reports another job's spans as its own
+        self._trace_journal = None
+        #: True only when THIS cluster installed the journal: an adopted
+        #: pre-existing journal belongs to whoever installed it (a bench
+        #: harness, an outer job) — we record into it but never reset()
+        #: it, and its owner's capacity choice wins over config
+        self._owns_trace_journal = False
+        if self.tracing_enabled:
+            cap = (config.get(MetricOptions.TRACING_BUFFER)
+                   if config is not None
+                   else MetricOptions.TRACING_BUFFER.default)
+            self._trace_journal, self._owns_trace_journal = \
+                tracing_mod.adopt_or_install(cap)
+        #: per-(source, operator-hop) latency histograms fed by the
+        #: LatencyMarker flow; bound to the job metric group below so
+        #: every reporter (Prometheus summaries included) exports them
+        self.latency_tracker = LatencyTracker()
         if alignment_queue_max is None:
             alignment_queue_max = (
                 config.get(CheckpointingOptions.ALIGNMENT_QUEUE_MAX)
@@ -193,6 +228,8 @@ class MiniCluster(TaskListener):
         backpressure_metrics(self.job_metric_group, self.backpressure_totals)
         checkpoint_alignment_metrics(self.job_metric_group,
                                      lambda: self._last_alignment)
+        #: latency.* histogram + p50/p99 gauge export rides the same group
+        self.latency_tracker.bind_group(self.job_metric_group)
         #: queryable serving tier (ISSUE-9): auto-wired at deploy when any
         #: operator was built with ``queryable=<name>`` — live views per
         #: subtask + a checkpoint replica fed from _complete_checkpoint
@@ -240,6 +277,13 @@ class MiniCluster(TaskListener):
             p = self._pending
             if p is None or p.checkpoint_id != checkpoint_id:
                 return  # late ack for an aborted checkpoint: decline
+            # instant AFTER the validity check: a declined late ack must
+            # not show up on the timeline as a real lifecycle event (the
+            # trigger→complete span's acked count and the ack instants
+            # would disagree)
+            tracing.instant("checkpoint.ack", cat="checkpoint",
+                            checkpoint=checkpoint_id, task=vertex_uid,
+                            subtask=subtask_index)
             p.acks[(vertex_uid, subtask_index)] = snapshot
             if len(p.acks) >= p.expected:
                 self._complete_checkpoint(p)
@@ -348,11 +392,18 @@ class MiniCluster(TaskListener):
             "unaligned_checkpoints":
                 self._last_alignment.get("unaligned_checkpoints", 0)
                 + int(agg["unaligned"])}
+        size = _state_size(assembled)
+        # trigger→complete span: the whole lifecycle on one timeline row
+        if p.t0_ns:
+            tracing.complete("checkpoint", p.t0_ns, time.perf_counter_ns(),
+                             cat="checkpoint", checkpoint=p.checkpoint_id,
+                             state_size_bytes=size, acked=len(p.acks),
+                             unaligned=bool(agg["unaligned"]))
         self._checkpoint_stats.append({
             "id": p.checkpoint_id,
             "completed_at_ms": int(time.time() * 1000),
             "duration_ms": round(p.timer.ms(), 1),
-            "state_size_bytes": _state_size(assembled),
+            "state_size_bytes": size,
             "acked_subtasks": len(p.acks),
             **agg})
         del self._checkpoint_stats[:-100]           # bounded history
@@ -481,6 +532,7 @@ class MiniCluster(TaskListener):
                         t = SourceSubtask(uid, i, v.build_operator(),
                                           outputs[v.id][i], ctx, self, None,
                                           split_requester=requester)
+                        self._attach_observability(t)
                         t.start(sub_snaps[i] if i < len(sub_snaps) else None)
                         self._tasks.append(t)
                         source_tasks.append(t)
@@ -493,6 +545,7 @@ class MiniCluster(TaskListener):
                                          memory_manager=self._slot_memory())
                     t = SourceSubtask(uid, i, v.build_operator(),
                                       outputs[v.id][i], ctx, self, split)
+                    self._attach_observability(t)
                     t.start(sub_snaps[i] if i < len(sub_snaps) else None)
                     self._tasks.append(t)
                     source_tasks.append(t)
@@ -508,6 +561,7 @@ class MiniCluster(TaskListener):
                                 input_logical=input_logical[v.id][i],
                                 alignment_timeout_ms=self.alignment_timeout_ms,
                                 alignment_queue_max=self.alignment_queue_max)
+                    self._attach_observability(t)
                     t.start(sub_snaps[i] if i < len(sub_snaps) else None)
                     self._tasks.append(t)
         self._source_tasks = source_tasks
@@ -517,6 +571,14 @@ class MiniCluster(TaskListener):
             from flink_tpu.metrics.groups import paging_metrics
             paging_metrics(self.job_metric_group, self.paging_totals)
         self._wire_queryable(plan)
+
+    def _attach_observability(self, t: SubtaskBase) -> None:
+        """Wire latency tracking into a subtask BEFORE it starts: every
+        hop records markers into the shared tracker, and sources get the
+        ``metrics.latency.interval`` emission cadence."""
+        t.latency_tracker = self.latency_tracker
+        if isinstance(t, SourceSubtask) and self.latency_interval_ms:
+            t.latency_marker_interval_ms = self.latency_interval_ms
 
     def _wire_queryable(self, plan: ExecutionPlan) -> None:
         """Register every ``queryable=<name>`` operator's live views with
@@ -678,8 +740,11 @@ class MiniCluster(TaskListener):
                 return None, "declined"
             cid = self._next_checkpoint_id
             self._next_checkpoint_id += 1
+            tracing.instant("checkpoint.trigger", cat="checkpoint",
+                            checkpoint=cid, savepoint=savepoint)
             self._pending = _PendingCheckpoint(
-                cid, expected=expected, timer=clock.MonotoneElapsed())
+                cid, expected=expected, timer=clock.MonotoneElapsed(),
+                t0_ns=time.perf_counter_ns())
             coord = getattr(self, "_source_coordinator", None)
             if coord is not None and coord._enums:
                 self._pending.enumerators = coord.snapshot()
@@ -691,6 +756,28 @@ class MiniCluster(TaskListener):
     def execute(self, plan: ExecutionPlan,
                 restore: Optional[Dict[str, Any]] = None,
                 timeout_s: float = 300.0) -> JobResult:
+        from flink_tpu.observability import tracing as tracing_mod
+
+        if self.tracing_enabled:
+            # one shared ownership state machine (see
+            # tracing.acquire_for_execution): per-execution reset of an
+            # owned ring, fresh owned ring when an adopted one's owner
+            # released, (re-)adoption of whichever ring is actually live
+            self._trace_journal, self._owns_trace_journal = \
+                tracing_mod.acquire_for_execution(self._trace_journal,
+                                                  self._owns_trace_journal)
+        # the latency view is per execution too: job B's panel and
+        # latency.* series must not mix in job A's hop rows/samples
+        self.latency_tracker.reset()
+        j, owned = self._trace_journal, self._owns_trace_journal
+        try:
+            return self._execute(plan, restore, timeout_s)
+        finally:
+            tracing_mod.release_after_execution(j, owned)
+
+    def _execute(self, plan: ExecutionPlan,
+                 restore: Optional[Dict[str, Any]],
+                 timeout_s: float) -> JobResult:
         import copy as _copy
 
         self._plan = plan              # dashboard DAG view
@@ -890,6 +977,7 @@ class MiniCluster(TaskListener):
             job_state = "RUNNING"
         else:
             job_state = "CREATED"
+        journal = self._trace_journal
         checkpoints = self.failure_manager.status()
         # top-level "completed_checkpoints" is the LIST of ids; this is the
         # lifetime count — name it distinctly so consumers can't mix them up
@@ -904,6 +992,12 @@ class MiniCluster(TaskListener):
             **({"queryable": self.queryable.stats()}
                if self.queryable is not None else {}),
             "device_health": self.device_health_status(),
+            #: per-(source, hop) latency percentiles (LatencyMarker flow)
+            "latency": self.latency_tracker.panel(),
+            #: span-journal rollup (full export: trace_events() / REST
+            #: GET /jobs/<id>/trace)
+            "trace": (journal.summary() if journal is not None
+                      else {"enabled": False, "spans": 0, "dropped": 0}),
             "state": job_state,
             "vertices": vertices,
             "completed_checkpoints": list(self._completed_ids),
@@ -916,6 +1010,21 @@ class MiniCluster(TaskListener):
             "exception_history": list(self._exception_history),
             "failure": self._failed,
         }
+
+    def trace_events(self) -> Dict[str, Any]:
+        """Chrome trace-event export of the process span journal
+        (Perfetto-loadable; REST ``GET /jobs/<id>/trace`` backing)."""
+        journal = self._trace_journal
+        if journal is None:
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "otherData": {"enabled": False}}
+        snap = journal.snapshot()
+        return {"traceEvents": tracing.to_chrome(snap, pid=0,
+                                                 process_name="minicluster"),
+                "displayTimeUnit": "ms",
+                "otherData": {"enabled": True,
+                              "dropped_spans": snap["dropped"],
+                              "latency": self.latency_tracker.panel()}}
 
     def sink_latencies_ms(self) -> List[float]:
         out: List[float] = []

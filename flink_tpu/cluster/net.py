@@ -89,7 +89,7 @@ def _encode_control(el: StreamElement) -> bytes:
         d = {"t": "status", "idle": el.idle}
     elif isinstance(el, LatencyMarker):
         d = {"t": "latency", "mt": el.marked_time, "src": el.source_id,
-             "sub": el.subtask_index}
+             "sub": el.subtask_index, "name": el.source}
     else:
         raise TypeError(f"not wire-encodable: {type(el).__name__}")
     return json.dumps(d).encode()
@@ -107,7 +107,7 @@ def _decode_control(payload: bytes) -> StreamElement:
     if t == "status":
         return StreamStatus(d["idle"])
     if t == "latency":
-        return LatencyMarker(d["mt"], d["src"], d["sub"])
+        return LatencyMarker(d["mt"], d["src"], d["sub"], d.get("name", ""))
     raise ValueError(f"unknown control frame {t!r}")
 
 

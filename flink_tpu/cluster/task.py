@@ -37,8 +37,10 @@ from flink_tpu.core.batch import (LONG_MIN, MAX_WATERMARK, CheckpointBarrier,
 from flink_tpu.core.functions import RuntimeContext
 from flink_tpu.cluster.channels import (LocalChannel, OutputDispatcher,
                                         element_bytes)
+from flink_tpu.observability import tracing
 from flink_tpu.runtime.executor import WatermarkValve
 from flink_tpu.testing import chaos
+from flink_tpu.utils import clock
 from flink_tpu.utils.clock import MonotoneElapsed
 
 
@@ -87,6 +89,10 @@ class SubtaskBase:
         self.backpressure_ns = 0
         self.records_in = 0
         self.records_out = 0
+        #: per-(source, hop) latency recorder (observability/latency.py):
+        #: attached by the deploying cluster; every LatencyMarker this
+        #: subtask sees records marked_time→now at THIS hop
+        self.latency_tracker = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, restore: Optional[Dict[str, Any]] = None) -> None:
@@ -258,6 +264,13 @@ class SourceSubtask(SubtaskBase):
         #: emit a LatencyMarker every N batches (0 = off); the markers ride
         #: the dataflow around user functions (``LatencyMarker.java:32``)
         self.latency_marker_interval = 0
+        #: TIME-based emission cadence in ms (0 = off) — what the
+        #: ``metrics.latency.interval`` config key wires to; read through
+        #: the injectable clock seam so ClockSkew chaos covers latency
+        #: tracking like it covers timers.  Batch-based interval wins when
+        #: both are set (back-compat with the raw attribute).
+        self.latency_marker_interval_ms = 0
+        self._last_marker_wall_ms: Optional[int] = None
 
     def _invoke(self) -> None:
         if self.split_requester is None:
@@ -321,11 +334,13 @@ class SourceSubtask(SubtaskBase):
                 self.records_in += len(el)
                 self._batches_since_marker = getattr(
                     self, "_batches_since_marker", 0) + 1
-                if self.latency_marker_interval and \
-                        self._batches_since_marker >= self.latency_marker_interval:
+                if self._marker_due():
                     self._batches_since_marker = 0
-                    self._emit([LatencyMarker(time.time(),
-                                              subtask_index=self.subtask_index)])
+                    # marked_time through the clock seam (not time.time()):
+                    # the ClockSkew nemesis must cover latency tracking
+                    self._emit([LatencyMarker(clock.now_ms_f() / 1000.0,
+                                              subtask_index=self.subtask_index,
+                                              source=self.vertex_uid)])
                 t0 = time.monotonic_ns()
                 out = self.operator.process_batch(el)
                 self.busy_ns += time.monotonic_ns() - t0
@@ -336,6 +351,21 @@ class SourceSubtask(SubtaskBase):
                     self._emit([el])
             else:
                 self._emit([el])
+
+    def _marker_due(self) -> bool:
+        """Latency-marker cadence: batch-count interval when configured,
+        else the wall-clock interval of ``metrics.latency.interval``."""
+        if self.latency_marker_interval:
+            return (self._batches_since_marker
+                    >= self.latency_marker_interval)
+        if self.latency_marker_interval_ms:
+            now = clock.now_ms()
+            last = self._last_marker_wall_ms
+            if last is None or now - last >= self.latency_marker_interval_ms \
+                    or now < last:          # skew step backward: re-arm
+                self._last_marker_wall_ms = now
+                return True
+        return False
 
     def _drain_commands(self) -> None:
         while True:
@@ -358,7 +388,11 @@ class SourceSubtask(SubtaskBase):
                                    "prepare_snapshot_pre_barrier", None)
                     if prep is not None:
                         self._emit(prep())
-                    with snapshot_scope(cid):
+                    with tracing.span("checkpoint.snapshot",
+                                      cat="checkpoint", checkpoint=cid,
+                                      task=self.vertex_uid,
+                                      subtask=self.subtask_index), \
+                            snapshot_scope(cid):
                         snap = {"operator": self.operator.snapshot_state(),
                                 "source_offset": self._emitted}
                 except _Cancel:
@@ -607,6 +641,9 @@ class Subtask(SubtaskBase):
                 self._abort_alignment(f"superseded by checkpoint {cid}")
             first = self._pending_barrier is None
             if first:
+                tracing.instant("checkpoint.barrier", cat="checkpoint",
+                                checkpoint=cid, task=self.vertex_uid,
+                                subtask=self.subtask_index)
                 self._pending_barrier = el
                 self._max_barrier_cid = max(self._max_barrier_cid, cid)
                 self._overtaken = False
@@ -808,7 +845,10 @@ class Subtask(SubtaskBase):
                            "prepare_snapshot_pre_barrier", None)
             if prep is not None:
                 self._emit(prep())
-            with snapshot_scope(cid):
+            with tracing.span("checkpoint.snapshot", cat="checkpoint",
+                              checkpoint=cid, task=self.vertex_uid,
+                              subtask=self.subtask_index, overtake=True), \
+                    snapshot_scope(cid):
                 self._pending_snapshot = {
                     "operator": self.operator.snapshot_state(),
                     "valve": self._valve.snapshot()}
@@ -944,6 +984,11 @@ class Subtask(SubtaskBase):
         elif isinstance(el, LatencyMarker):
             # LatencyMarker flows around user functions; sinks record it.
             # The hook may return elements to keep forwarding (chains).
+            if self.latency_tracker is not None:
+                # record marked_time→now at THIS hop: the sink hop's
+                # histogram is the end-to-end latency, intermediate hops
+                # decompose it per operator
+                self.latency_tracker.record(el, self.vertex_uid)
             hook = getattr(self.operator, "on_latency_marker", None)
             if hook is not None:
                 out = hook(el)
@@ -978,6 +1023,11 @@ class Subtask(SubtaskBase):
 
     def _record_checkpoint_stats(self, cid: int, align_ms: float,
                                  unaligned: bool, persisted: int) -> None:
+        tracing.instant("checkpoint.alignment", cat="checkpoint",
+                        checkpoint=cid, task=self.vertex_uid,
+                        subtask=self.subtask_index,
+                        alignment_ms=round(align_ms, 3),
+                        unaligned=unaligned)
         self.last_checkpoint_stats = {
             "checkpoint_id": cid,
             "alignment_ms": round(align_ms, 3),
@@ -1026,7 +1076,10 @@ class Subtask(SubtaskBase):
                                "prepare_snapshot_pre_barrier", None)
                 if prep is not None:
                     self._emit(prep())
-                with snapshot_scope(cid):
+                with tracing.span("checkpoint.snapshot", cat="checkpoint",
+                                  checkpoint=cid, task=self.vertex_uid,
+                                  subtask=self.subtask_index), \
+                        snapshot_scope(cid):
                     snap = {"operator": self.operator.snapshot_state(),
                             "valve": self._valve.snapshot()}
             except _Cancel:

@@ -42,9 +42,9 @@ def render_markdown() -> str:
         lines.append("|---|---|---|---|")
         for _attr, opt in opts:
             desc = (opt.description or "").replace("|", "\\|")
+            typ = getattr(opt.type, "__name__", opt.type)
             lines.append(f"| `{opt.key}` | `{opt.default!r}` | "
-                         f"{getattr(opt.type, "__name__", opt.type)}"
-                         f" | {desc} |")
+                         f"{typ} | {desc} |")
         lines.append("")
     return "\n".join(lines)
 

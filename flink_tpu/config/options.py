@@ -149,7 +149,19 @@ class MetricOptions:
     REPORTERS = key("metrics.reporters").list_type().default_value(
         [], "Active metric reporter names.")
     LATENCY_INTERVAL = key("metrics.latency.interval").duration_type().default_value(
-        0, "Latency-marker emission interval in ms (0 = disabled).")
+        0, "Latency-marker emission interval in ms (0 = disabled): sources "
+        "emit LatencyMarker probes on this cadence (through the injectable "
+        "clock seam); every operator hop records them into per-(source, "
+        "hop) latency histograms exported by the reporters and the REST "
+        "latency panel.")
+    TRACING_ENABLED = key("metrics.tracing.enabled").bool_type().default_value(
+        False, "Install the per-process span journal at deploy: hot-stage "
+        "phases, checkpoint lifecycle, device-health/paging/exchange/CEP "
+        "events record structured spans, exported as Chrome trace-event "
+        "JSON (REST /jobs/<id>/trace, Perfetto-viewable).")
+    TRACING_BUFFER = key("metrics.tracing.buffer-size").int_type().default_value(
+        65536, "Span-journal ring capacity; once full new spans are "
+        "dropped and counted (bounded memory, loud truncation).")
     SCOPE_DELIMITER = key("metrics.scope.delimiter").string_type().default_value(".")
 
 

@@ -53,11 +53,15 @@ class StreamStatus(StreamElement):
 @dataclass(frozen=True)
 class LatencyMarker(StreamElement):
     """Latency-tracking probe (``LatencyMarker.java:32``): flows through
-    operators without entering user functions; sinks record marked_time→now."""
+    operators without entering user functions; every hop records
+    marked_time→now (``observability/latency.py``), sinks included.
+    ``source`` names the emitting vertex so per-(source, hop) histograms
+    attribute samples without an id registry."""
 
     marked_time: float
     source_id: int = 0
     subtask_index: int = 0
+    source: str = ""
 
 
 @dataclass(frozen=True)

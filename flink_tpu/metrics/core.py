@@ -10,6 +10,7 @@ latencies at once, so ``update_all`` is the hot path, not ``update``).
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
@@ -62,7 +63,9 @@ class Meter(Metric):
         self._clock = clock
         self._window_s = window_s
         self._count = 0
-        self._marks: list = []  # (t, cumulative count) checkpoints
+        # (t, cumulative count) checkpoints; deque so the window trim is
+        # O(1) per event (list.pop(0) was O(n) on hot meters)
+        self._marks: deque = deque()
 
     def mark_event(self, n: int = 1) -> None:
         self._count += n
@@ -70,7 +73,7 @@ class Meter(Metric):
         self._marks.append((now, self._count))
         cutoff = now - self._window_s
         while len(self._marks) > 2 and self._marks[0][0] < cutoff:
-            self._marks.pop(0)
+            self._marks.popleft()
 
     def get_count(self) -> int:
         return self._count
@@ -90,11 +93,13 @@ class Histogram(Metric):
         self._buf = np.zeros(size, np.float64)
         self._n = 0          # total updates ever
         self._pos = 0
+        self._sum = 0.0      # lifetime sum (Prometheus summary `_sum`)
 
     def update(self, value: float) -> None:
         self._buf[self._pos] = value
         self._pos = (self._pos + 1) % self._buf.size
         self._n += 1
+        self._sum += value
 
     def update_all(self, values: np.ndarray) -> None:
         """Bulk insert (the batched-runtime hot path)."""
@@ -112,9 +117,24 @@ class Histogram(Metric):
                 self._buf[: end - self._buf.size] = values[k:]
             self._pos = end % self._buf.size
         self._n += values.size
+        self._sum += float(values.sum())
+
+    def clear(self) -> None:
+        """Back to empty (count, sum, reservoir).  Per-execution latency
+        views reuse their already-registered Histogram objects across
+        resets — reporters see a counter reset, not a new series."""
+        self._buf[:] = 0.0
+        self._n = 0
+        self._pos = 0
+        self._sum = 0.0
 
     def get_count(self) -> int:
         return self._n
+
+    def get_sum(self) -> float:
+        """Lifetime sum of every recorded value (not just the reservoir) —
+        the Prometheus summary ``_sum`` series."""
+        return self._sum
 
     def _values(self) -> np.ndarray:
         return self._buf[: min(self._n, self._buf.size)]

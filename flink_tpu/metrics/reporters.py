@@ -82,9 +82,13 @@ class PrometheusReporter(MetricReporter):
     def bind(self, registry) -> None:
         self._registry = registry
 
-    def scrape(self) -> str:
-        metrics = self._registry.all_metrics() if self._registry else {}
-        lines = []
+    def render(self, metrics: Dict[str, Metric]) -> List[str]:
+        """Exposition-format lines for ``metrics`` — the same wire-level
+        seam the push reporters expose, so tests can assert exact
+        protocol bytes without an HTTP server.  Histograms ship as proper
+        Prometheus SUMMARY families: ``{quantile="0.5|0.95|0.99"}``
+        series plus the ``_sum`` / ``_count`` conventions."""
+        lines: List[str] = []
         for ident, m in sorted(metrics.items()):
             name = _prom_name(ident)
             if isinstance(m, Counter):
@@ -94,14 +98,20 @@ class PrometheusReporter(MetricReporter):
             elif isinstance(m, Histogram):
                 s = m.get_statistics()
                 lines.append(f"# TYPE {name} summary")
-                for q, k in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                for q, k in (("0.5", "p50"), ("0.95", "p95"),
+                             ("0.99", "p99")):
                     lines.append(f'{name}{{quantile="{q}"}} {s[k]}')
+                lines.append(f"{name}_sum {m.get_sum()}")
                 lines.append(f"{name}_count {s['count']}")
             elif isinstance(m, Gauge):
                 v = m.get_value()
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     lines += [f"# TYPE {name} gauge", f"{name} {v}"]
-        return "\n".join(lines) + "\n"
+        return lines
+
+    def scrape(self) -> str:
+        metrics = self._registry.all_metrics() if self._registry else {}
+        return "\n".join(self.render(metrics)) + "\n"
 
     # -- HTTP ---------------------------------------------------------------
     def start_server(self, port: int) -> int:

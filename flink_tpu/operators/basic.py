@@ -319,11 +319,14 @@ class SinkOperator(StreamOperator):
         return []
 
     def on_latency_marker(self, marker) -> None:
-        """Source→sink latency sample (``LatencyStats`` at the sink)."""
-        import time as _time
+        """Source→sink latency sample (``LatencyStats`` at the sink).
+        Reads through the clock seam so ClockSkew chaos covers latency
+        tracking; skew-negative samples clamp to 0."""
+        from flink_tpu.utils import clock
 
         self.latencies_ms = getattr(self, "latencies_ms", [])
-        self.latencies_ms.append((_time.time() - marker.marked_time) * 1000.0)
+        self.latencies_ms.append(max(
+            0.0, (clock.now_ms_f() / 1000.0 - marker.marked_time) * 1000.0))
         if len(self.latencies_ms) > 1024:
             del self.latencies_ms[:512]
 

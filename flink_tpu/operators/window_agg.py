@@ -62,6 +62,7 @@ from flink_tpu.core.batch import (LONG_MIN, RecordBatch, StreamElement,
 from flink_tpu.core.functions import (SCATTER_UFUNCS, AggregateFunction,
                                       RuntimeContext)
 from flink_tpu.core import keygroups
+from flink_tpu.observability import tracing
 from flink_tpu.operators.base import StreamOperator
 from flink_tpu.runtime.device_health import DeviceQuarantinedError
 from flink_tpu.ops.scatter import (combine_along_axis,
@@ -264,7 +265,10 @@ class _Staging:
 
 
 class _PhaseTimer:
-    """Accumulates wall time into a dict entry (bench phase breakdown)."""
+    """Accumulates wall time into a dict entry (bench phase breakdown).
+    When the span journal is installed, each timed region ALSO records a
+    "hot_stage" span under the SAME phase name — ``--profile`` and traces
+    agree on the vocabulary (tests/test_bench_gate scrapes it)."""
 
     __slots__ = ("_d", "_k", "_t0")
 
@@ -279,8 +283,11 @@ class _PhaseTimer:
 
     def __exit__(self, *exc):
         import time
-        self._d[self._k] = self._d.get(self._k, 0) + \
-            time.perf_counter_ns() - self._t0
+        t1 = time.perf_counter_ns()
+        self._d[self._k] = self._d.get(self._k, 0) + t1 - self._t0
+        j = tracing._JOURNAL       # one attr read + None check when off
+        if j is not None:
+            j.record("X", self._t0, t1 - self._t0, self._k, "hot_stage")
         return False
 
 

@@ -219,6 +219,27 @@ class RestServer:
                                            for v in status["vertices"]),
                         "latency_ms": _percentiles(
                             cluster.sink_latencies_ms())})
+                if sub == "latency":
+                    # per-(source, operator-hop) percentiles from the
+                    # LatencyMarker flow + the legacy sink rollup
+                    return self._send({
+                        "hops": status.get("latency", []),
+                        "sink_latency_ms": _percentiles(
+                            cluster.sink_latencies_ms())})
+                if sub == "latency.html":
+                    from flink_tpu.rest.views import latency_html
+                    return self._send(
+                        latency_html(status.get("latency", [])).encode(),
+                        content_type="text/html")
+                if sub == "trace":
+                    # Chrome trace-event JSON of the span journal
+                    # (Perfetto-viewable; trace summary in job_status)
+                    fn = getattr(cluster, "trace_events", None)
+                    if fn is None:
+                        return self._send(
+                            {"traceEvents": [], "displayTimeUnit": "ms",
+                             "otherData": {"enabled": False}})
+                    return self._send(fn())
                 if sub == "metrics/history":
                     return self._send(
                         {"series": history_ref.series(m.group(1))})
@@ -498,6 +519,7 @@ _DASHBOARD_HTML = """<!DOCTYPE html>
  <div id="qswrap" style="display:none"><h2>Queryable state</h2>
  <div id="qs" class="panelbox"></div></div>
  <h2>Latency (source&rarr;sink)</h2><div class="tiles" id="lat"></div>
+ <div id="lathops"></div>
  <h2>Checkpoints</h2>
  <div id="ckview"></div>
  <div id="ckpts" style="font-size:.88rem;color:var(--text-2)"></div>
@@ -567,6 +589,8 @@ async function refresh(){
     .filter(k=>lat[k]!==undefined)
     .map(k=>tile(k,lat[k].toFixed(1)+' ms')).join('')||
     '<span style="color:var(--text-2);font-size:.85rem">no samples yet</span>';
+  fetch('/jobs/'+sel+'/latency.html').then(r=>r.text())
+    .then(t=>{document.getElementById('lathops').innerHTML=t});
   renderTput(await J('/jobs/'+sel+'/metrics/history'));
   const ck=await J('/jobs/'+sel+'/checkpoints');
   document.getElementById('ckpts').textContent=

@@ -231,6 +231,33 @@ def queryable_html(stats: Dict[str, Any]) -> str:
             + "</tbody></table></div>")
 
 
+def latency_html(hops: List[Dict[str, Any]]) -> str:
+    """Per-(source, operator-hop) latency panel
+    (``job_status()["latency"]`` rows from the LatencyMarker flow):
+    p50/p95/p99/max per hop.  Server-rendered, DOM-testable — same
+    pattern as the device-health panel."""
+    if not hops:
+        return ('<div class="lat-panel" data-hops="0">no latency markers '
+                'recorded — set metrics.latency.interval to enable</div>')
+    rows = []
+    for h in hops:
+        rows.append(
+            f'<tr class="lat-row" data-source="{_esc(h["source"])}" '
+            f'data-hop="{_esc(h["hop"])}">'
+            f'<td>{_esc(h["source"])}[{_esc(h["source_subtask"])}]</td>'
+            f'<td>{_esc(h["hop"])}</td>'
+            f'<td>{_esc(h["count"])}</td>'
+            f'<td>{_esc(h["p50_ms"])}</td>'
+            f'<td>{_esc(h["p95_ms"])}</td>'
+            f'<td>{_esc(h["p99_ms"])}</td>'
+            f'<td>{_esc(h["max_ms"])}</td></tr>')
+    return (f'<div class="lat-panel" data-hops="{len(hops)}">'
+            f'<table class="lat-table"><thead><tr><th>source</th>'
+            f'<th>hop</th><th>samples</th><th>p50 ms</th><th>p95 ms</th>'
+            f'<th>p99 ms</th><th>max ms</th></tr></thead><tbody>'
+            + "".join(rows) + "</tbody></table></div>")
+
+
 def backpressure_html(vertices: List[Dict[str, Any]],
                       checkpoints: Optional[Dict[str, Any]] = None) -> str:
     """Per-SUBTASK busy/backpressure/idle bars (the reference's subtask

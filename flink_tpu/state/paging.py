@@ -44,6 +44,8 @@ import numpy as np
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from flink_tpu.observability import tracing
+
 #: flags bit: the (key, pane) cell was marked in the host emit mirror
 MIRROR_BIT = 1
 
@@ -217,22 +219,25 @@ class DevicePager:
         operator) into the store and free their rows.  ``counts`` is
         ``[V, m]`` int, ``leaves`` one ``[V, m, *leaf]`` array per ACC leaf,
         ``mirror_bits`` ``[V, m]`` bool."""
-        gids = self.gid_of[victim_rows]
-        pl = [int(p) for p in np.asarray(panes).tolist()]
-        for i, g in enumerate(gids.tolist()):
-            for j, p in enumerate(pl):
-                c = int(counts[i, j])
-                b = bool(mirror_bits[i, j])
-                if c or b:
-                    self.store.put(g, p, MIRROR_BIT if b else 0, c,
-                                   [l[i, j] for l in leaves])
-                    self._mark_spilled(p, g)
-        self.row_of[gids] = -1
-        self.gid_of[victim_rows] = -1
-        self._ref[victim_rows] = 0
-        self._free.extend(int(r) for r in victim_rows.tolist())
-        self._n_resident -= int(victim_rows.size)
-        self.evictions += int(victim_rows.size)
+        with tracing.span("paging.page_out", cat="paging",
+                          keys=int(victim_rows.size),
+                          panes=int(np.asarray(panes).size)):
+            gids = self.gid_of[victim_rows]
+            pl = [int(p) for p in np.asarray(panes).tolist()]
+            for i, g in enumerate(gids.tolist()):
+                for j, p in enumerate(pl):
+                    c = int(counts[i, j])
+                    b = bool(mirror_bits[i, j])
+                    if c or b:
+                        self.store.put(g, p, MIRROR_BIT if b else 0, c,
+                                       [l[i, j] for l in leaves])
+                        self._mark_spilled(p, g)
+            self.row_of[gids] = -1
+            self.gid_of[victim_rows] = -1
+            self._ref[victim_rows] = 0
+            self._free.extend(int(r) for r in victim_rows.tolist())
+            self._n_resident -= int(victim_rows.size)
+            self.evictions += int(victim_rows.size)
 
     def assign_rows(self, gids: np.ndarray) -> Tuple[np.ndarray, int]:
         """Bind free rows to ``gids`` (promotion/new keys); returns
@@ -261,6 +266,8 @@ class DevicePager:
         ``delete`` the entries move OUT of the spill tier (promotion) and
         the promotion counter advances."""
         R, m = int(gids.size), int(np.asarray(panes).size)
+        tracing.instant("paging.page_in", cat="paging", keys=R, panes=m,
+                        promote=bool(delete))
         counts = np.zeros((R, m), np.int32)
         bits = np.zeros((R, m), bool)
         leaves = identity_grid(self.spec, R, m)

@@ -27,7 +27,7 @@ import time
 
 from flink_tpu.testing import chaos
 
-__all__ = ["Clock", "SYSTEM_CLOCK", "now_ms", "monotonic",
+__all__ = ["Clock", "SYSTEM_CLOCK", "now_ms", "now_ms_f", "monotonic",
            "MonotoneElapsed"]
 
 
@@ -37,6 +37,14 @@ class Clock:
     def now_ms(self) -> int:
         """Wall clock in epoch milliseconds (``clock.wall`` skew point)."""
         return int(time.time() * 1000.0 + chaos.skew("clock.wall"))
+
+    def now_ms_f(self) -> float:
+        """Wall clock in epoch milliseconds WITHOUT the int truncation,
+        same ``clock.wall`` skew point.  Latency tracking needs sub-ms
+        resolution (hops routinely complete in <1 ms — quantized
+        endpoints would record every such sample as 0), but must still
+        sit behind the chaos seam like every other wall reading."""
+        return time.time() * 1000.0 + chaos.skew("clock.wall")
 
     def monotonic(self) -> float:
         """Monotonic seconds (``clock.monotonic`` skew point, offset in
@@ -76,6 +84,10 @@ SYSTEM_CLOCK = Clock()
 
 def now_ms() -> int:
     return SYSTEM_CLOCK.now_ms()
+
+
+def now_ms_f() -> float:
+    return SYSTEM_CLOCK.now_ms_f()
 
 
 def monotonic() -> float:

@@ -344,6 +344,83 @@ def test_queryable_bench_smoke_passes_gate():
     assert d["records_per_sec_under_load"] > 0
 
 
+def _trace_detail(ratio=0.99, hot=20, ckpt=4, lat=1):
+    return {"throughput_ratio": ratio, "hot_stage_spans": hot,
+            "checkpoint_spans": ckpt, "latency_summaries": lat,
+            "spans": hot + ckpt, "dropped_spans": 0}
+
+
+def test_check_trace_budget_pass():
+    from bench import check_trace_budget
+    assert check_trace_budget(_trace_detail(),
+                              {"min_throughput_ratio": 0.95}) == []
+
+
+def test_check_trace_budget_throughput_floor():
+    """Tracing-on must keep >= the budgeted fraction of tracing-off
+    throughput (the <5% overhead acceptance).  Smoke-size runs skip the
+    ratio floor only — fixed per-pass costs (compile, first fire)
+    dominate a smoke pass and the on/off ratio is pure noise there."""
+    from bench import check_trace_budget
+    viol = check_trace_budget(_trace_detail(ratio=0.80),
+                              {"min_throughput_ratio": 0.95})
+    assert len(viol) == 1 and "tracing-on" in viol[0]
+    assert check_trace_budget(_trace_detail(ratio=0.80),
+                              {"min_throughput_ratio": 0.95},
+                              smoke=True) == []
+    # structural gates stay on at smoke size
+    assert any("hot-stage" in v
+               for v in check_trace_budget(_trace_detail(ratio=0.80, hot=0),
+                                           {}, smoke=True))
+
+
+def test_check_trace_budget_structural_checks_always_gate():
+    """An artifact without hot-stage spans, checkpoint lifecycle spans or
+    a latency summary is not a usable trace — never exit 0 on one."""
+    from bench import check_trace_budget
+    b = {"min_throughput_ratio": 0.95}
+    assert any("hot-stage" in v
+               for v in check_trace_budget(_trace_detail(hot=0), b))
+    assert any("checkpoint" in v
+               for v in check_trace_budget(_trace_detail(ckpt=0), b))
+    assert any("latency" in v
+               for v in check_trace_budget(_trace_detail(lat=0), b))
+
+
+def test_trace_artifact_smoke(tmp_path):
+    """bench.py --trace end-to-end at smoke size: the artifact is
+    Perfetto-shaped trace-event JSON with hot-stage phase spans (the
+    operator's own ``_phase`` vocabulary), checkpoint lifecycle spans and
+    a latency histogram summary, and the tracing-on/off ratio is
+    reported.  (The trace_cpu ratio gate itself runs with --check on the
+    full bench — one smoke batch is fixed-cost noise.)"""
+    out = tmp_path / "trace.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--records", "16384", "--keys", "2048", "--batch-size", "4096",
+         "--checkpoint-every", "2", "--trace", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    t = result["details"]["trace"]
+    assert t["hot_stage_spans"] > 0 and t["checkpoint_spans"] > 0
+    assert t["latency_summaries"] == 1 and t["throughput_ratio"] > 0
+    with open(out) as f:
+        artifact = json.load(f)
+    assert artifact["displayTimeUnit"] == "ms"
+    evs = artifact["traceEvents"]
+    hot = {e["name"] for e in evs if e.get("cat") == "hot_stage"}
+    assert hot and hot <= _operator_phase_names()
+    ckpt_names = {e["name"] for e in evs if e.get("cat") == "checkpoint"}
+    assert {"checkpoint.trigger", "checkpoint.snapshot",
+            "checkpoint"} <= ckpt_names
+    assert artifact["otherData"]["latency_histograms"]["window_fire_ms"][
+        "samples"] > 0
+    # spans are the X/i/M trace-event dialect with µs timestamps
+    assert all(e["ph"] in ("X", "i", "M") for e in evs)
+
+
 def test_budget_file_shape():
     with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
         budget = json.load(f)
@@ -355,6 +432,10 @@ def test_budget_file_shape():
     # checkpoint-under-backpressure budget (bench.py --checkpoint-interval)
     cb = budget["checkpoint_backpressure"]
     assert cb["max_duration_ms"] > 0 and cb["min_completed"] >= 1
+    # the tracing-overhead gate (bench.py --trace --check): tracing-on
+    # must keep >= 95% of tracing-off throughput
+    tr = budget["trace_cpu"]
+    assert 0.95 <= tr["min_throughput_ratio"] <= 1.0
     # CPU-forced full runs carry the pipelined-hot-path acceptance keys
     full_cpu = budget["full_cpu"]
     assert full_cpu["min_vs_numpy"] >= 1.0

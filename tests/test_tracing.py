@@ -1,0 +1,563 @@
+"""End-to-end tracing + latency tracking (ISSUE-10).
+
+Tier-1 coverage of the observability subsystem: the span journal's
+ordering/overflow semantics, Chrome trace-event export, the
+``metrics.latency.interval`` marker→histogram plumbing (job_status,
+Prometheus exposition and the REST latency panel in the SAME run), and
+the ProcessCluster cross-worker merged timeline.
+"""
+
+import json
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.config.config_option import Configuration
+from flink_tpu.config.options import MetricOptions
+from flink_tpu.core.batch import LatencyMarker
+from flink_tpu.metrics.core import Histogram, Meter
+from flink_tpu.metrics.groups import MetricRegistry
+from flink_tpu.metrics.reporters import PrometheusReporter
+from flink_tpu.observability import LatencyTracker, SpanJournal, tracing
+from flink_tpu.observability.assembly import (estimate_offset_ms,
+                                              merge_timelines)
+
+
+@pytest.fixture(autouse=True)
+def _clean_journal():
+    """Tracing is a process-global singleton: every test starts and ends
+    without one installed, no matter what it does in between."""
+    tracing.uninstall()
+    yield
+    tracing.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# span journal
+# ---------------------------------------------------------------------------
+
+def test_span_ordering_and_kinds():
+    j = tracing.install(SpanJournal(64))
+    with tracing.span("outer", cat="test", k=1):
+        tracing.instant("mark", cat="test")
+        with tracing.span("inner", cat="test"):
+            pass
+    spans = j.spans()
+    names = [s[3] for s in spans]
+    # completion order: instants record immediately, spans on exit
+    assert names == ["mark", "inner", "outer"]
+    by_name = {s[3]: s for s in spans}
+    assert by_name["mark"][0] == "i" and by_name["outer"][0] == "X"
+    # the outer span STARTED before the instant and lasted past inner
+    assert by_name["outer"][1] <= by_name["mark"][1]
+    assert by_name["outer"][2] >= by_name["inner"][2]
+    assert by_name["outer"][6] == {"k": 1}
+
+
+def test_ring_overflow_drop_counter():
+    j = tracing.install(SpanJournal(4))
+    for i in range(10):
+        tracing.instant(f"e{i}", cat="test")
+    assert j.recorded == 4
+    assert j.dropped == 6
+    # the ring keeps the EARLIEST spans (drop-newest): trace start intact
+    assert [s[3] for s in j.spans()] == ["e0", "e1", "e2", "e3"]
+    assert j.summary()["categories"] == {"test": 4}
+
+
+def test_ring_concurrent_reservation_exact():
+    """The lock-free reservation (one atomic ``itertools.count`` next())
+    stays exact under concurrent recorders: recorded + dropped equals the
+    total emitted, the ring fills completely, and every reserved slot got
+    its writer's span."""
+    j = tracing.install(SpanJournal(10_000))
+    n_threads, per = 8, 5_000
+
+    def work():
+        for _ in range(per):
+            tracing.instant("e", cat="test")
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert j.recorded + j.dropped == n_threads * per
+    assert j.recorded == 10_000 and j.dropped == 30_000
+    assert all(s is not None for s in j._buf)
+
+
+def test_adopted_journal_survives_cluster_runs():
+    """A journal installed by an outer harness (bench --trace, a user's
+    big ring) is ADOPTED by a tracing-enabled cluster, not owned: the
+    cluster records into it but must never reset() it — the owner's
+    accumulated spans and capacity choice survive the job."""
+    from flink_tpu.cluster.minicluster import MiniCluster
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    j = tracing.install(SpanJournal(8192))
+    tracing.instant("harness-span", cat="test")
+    env = StreamExecutionEnvironment()
+    n = 30_000
+    (env.from_collection(columns={"k": np.arange(n) % 3,
+                                  "v": np.ones(n)}, batch_size=128)
+        .key_by("k").sum("v").collect())
+    plan = env.get_stream_graph("adopt-job").to_plan()
+    mc = MiniCluster(checkpoint_interval_ms=10, tracing_enabled=True)
+    assert mc._trace_journal is j and not mc._owns_trace_journal
+    res = mc.execute(plan, timeout_s=60)
+    assert res.state == "FINISHED"
+    assert "harness-span" in {s[3] for s in j.spans()}, \
+        "cluster reset an adopted journal"
+    # with no journal pre-installed the cluster installs its OWN ring
+    # (config capacity applies) and THAT one is reset per execution
+    tracing.uninstall()
+    mc2 = MiniCluster(checkpoint_interval_ms=10, tracing_enabled=True)
+    assert mc2._owns_trace_journal and tracing.active() is mc2._trace_journal
+    res2 = mc2.execute(plan, timeout_s=60)
+    assert res2.state == "FINISHED"
+    # an OWNED ring is released at execution end: the singleton is free,
+    # the handle still serves job_status()/trace_events(), and the next
+    # tracing-enabled cluster installs fresh instead of adopting (and
+    # reporting) job B's spans as its own
+    assert tracing.active() is None
+    assert mc2._trace_journal.recorded > 0
+    assert mc2.job_status()["trace"]["spans"] > 0
+    mc3 = MiniCluster(tracing_enabled=True)
+    assert mc3._owns_trace_journal
+    assert mc3._trace_journal is not mc2._trace_journal
+
+
+def test_adopting_cluster_recovers_after_owner_release():
+    """Two tracing-enabled clusters constructed back to back: B adopts
+    A's ring.  After A's execute releases the singleton, B must stand up
+    its OWN fresh ring at execute time — never run trace-dead while
+    reporting A's stale spans as its own."""
+    from flink_tpu.cluster.minicluster import MiniCluster
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    def make_plan(name):
+        env = StreamExecutionEnvironment()
+        (env.from_collection(columns={"k": np.arange(30_000) % 3,
+                                      "v": np.ones(30_000)},
+                             batch_size=128)
+            .key_by("k").sum("v").collect())
+        return env.get_stream_graph(name).to_plan()
+
+    a = MiniCluster(checkpoint_interval_ms=10, tracing_enabled=True)
+    b = MiniCluster(checkpoint_interval_ms=10, tracing_enabled=True)
+    assert a._owns_trace_journal and not b._owns_trace_journal
+    assert b._trace_journal is a._trace_journal
+    assert a.execute(make_plan("job-a"), timeout_s=60).state == "FINISHED"
+    assert tracing.active() is None          # A released its ring
+    assert b.execute(make_plan("job-b"), timeout_s=60).state == "FINISHED"
+    assert b._owns_trace_journal
+    assert b._trace_journal is not a._trace_journal
+    assert b.job_status()["trace"]["spans"] > 0
+    assert tracing.active() is None          # B released its ring too
+
+
+def test_owner_readopts_foreign_ring_at_execute():
+    """An OWNING cluster whose singleton was taken over by a DIFFERENT
+    owner between executions re-adopts the live ring at execute() — its
+    own ring is no longer where instrumentation records, so reporting
+    from it would serve the previous execution's spans as the new job's."""
+    from flink_tpu.cluster.minicluster import MiniCluster
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    def make_plan(name):
+        env = StreamExecutionEnvironment()
+        (env.from_collection(columns={"k": np.arange(30_000) % 3,
+                                      "v": np.ones(30_000)},
+                             batch_size=128)
+            .key_by("k").sum("v").collect())
+        return env.get_stream_graph(name).to_plan()
+
+    mc = MiniCluster(checkpoint_interval_ms=10, tracing_enabled=True)
+    assert mc._owns_trace_journal
+    own = mc._trace_journal
+    assert mc.execute(make_plan("job-a"), timeout_s=60).state == "FINISHED"
+    assert tracing.active() is None and own.recorded > 0
+    # an outer harness installs ITS journal between the two executions
+    harness = tracing.install(SpanJournal(1 << 15))
+    assert mc.execute(make_plan("job-b"), timeout_s=60).state == "FINISHED"
+    # job B's spans landed in the harness ring and the cluster reports it
+    assert mc._trace_journal is harness and not mc._owns_trace_journal
+    assert harness.recorded > 0
+    assert mc.job_status()["trace"]["spans"] == harness.recorded
+    # adopted, so NOT released: the harness keeps the singleton
+    assert tracing.active() is harness
+
+
+def test_disabled_tracing_is_a_noop():
+    assert not tracing.enabled()
+    with tracing.span("nope", cat="test"):
+        tracing.instant("nor-this")
+    tracing.complete("neither", 0, 10)
+    assert tracing.active() is None
+
+
+def test_chrome_export_schema():
+    j = tracing.install(SpanJournal(64))
+    with tracing.span("work", cat="hot_stage", batch=3):
+        pass
+    tracing.instant("tick", cat="checkpoint")
+    events = tracing.to_chrome(j.snapshot(), pid=7, process_name="p7")
+    json.dumps(events)                       # wire-serializable
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+    x = next(e for e in events if e["ph"] == "X")
+    assert x["name"] == "work" and x["cat"] == "hot_stage"
+    assert x["pid"] == 7 and "dur" in x and x["ts"] > 0
+    i = next(e for e in events if e["ph"] == "i")
+    assert i["name"] == "tick" and i["s"] == "t"
+    # wall anchoring: ts is microseconds since the epoch, roughly now
+    assert abs(x["ts"] / 1e6 - time.time()) < 3600
+
+
+def test_clock_offset_estimation_and_merge():
+    # worker clock 250ms ahead; symmetric RTT -> exact recovery
+    assert estimate_offset_ms(1000.0, 1010.0, 1255.0) == 250.0
+    j = tracing.install(SpanJournal(16))
+    tracing.instant("local", cat="test")
+    local = j.snapshot()
+    worker_j = SpanJournal(16)
+    worker_j.record("i", worker_j.anchor_perf_ns, 0, "remote", "test", None)
+    dump = {"journal": worker_j.snapshot(),
+            "wall_now_ms": worker_j.anchor_wall_us / 1000.0 + 250.0,
+            "latency": [{"source": "s", "hop": "h", "count": 1}]}
+    t0 = worker_j.anchor_wall_us / 1000.0
+    merged = merge_timelines(local, [(0, dump, t0)], t0_ms=t0)
+    assert merged["displayTimeUnit"] == "ms"
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+    assert merged["otherData"]["workers"] == 1
+    assert merged["otherData"]["clock_offsets_ms"][0] != 0.0
+    assert merged["otherData"]["latency"][0]["worker"] == 0
+    ts = [e["ts"] for e in merged["traceEvents"] if "ts" in e and e["ts"]]
+    assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# marker → histogram plumbing
+# ---------------------------------------------------------------------------
+
+def test_latency_tracker_records_per_source_hop():
+    class FakeClock:
+        now = 1_000_000
+
+        def now_ms(self):
+            return self.now
+
+        def now_ms_f(self):
+            return float(self.now)
+
+    c = FakeClock()
+    lt = LatencyTracker(clock_=c)
+    marked = (c.now_ms() - 40) / 1000.0          # marked 40ms ago
+    m = LatencyMarker(marked, subtask_index=1, source="src")
+    lat = lt.record(m, "sink")
+    assert lat == pytest.approx(40.0)
+    # a skew-negative reading clamps to zero, never a negative sample
+    future = LatencyMarker((c.now_ms() + 5000) / 1000.0, source="src")
+    assert lt.record(future, "sink") == 0.0
+    panel = lt.panel()
+    assert len(panel) == 2                       # (src,1,sink) + (src,0,sink)
+    row = next(r for r in panel if r["source_subtask"] == 1)
+    assert row["source"] == "src" and row["hop"] == "sink"
+    assert row["count"] == 1 and row["p99_ms"] == pytest.approx(40.0)
+    assert lt.summary() == {"hops": 2, "samples": 2}
+
+
+def test_latency_tracker_metrics_exported_via_prometheus():
+    reg = MetricRegistry()
+    group = reg.job_manager_group()
+    lt = LatencyTracker().bind_group(group)
+    m = LatencyMarker(time.time() - 0.05, source="src")
+    for _ in range(4):
+        lt.record(m, "agg")
+    reporter = PrometheusReporter(registry=reg)
+    text = reporter.scrape()
+    # summary family with proper quantile labels + _sum/_count and gauges
+    assert 'flink_tpu_jobmanager_latency_source_src_0_op_agg' in text
+    assert 'quantile="0.99"' in text and 'quantile="0.5"' in text
+    assert "_sum " in text and "_count 4" in text
+    assert "p99_ms" in text and "p50_ms" in text
+
+
+def test_latency_tracker_reset_per_execution():
+    """reset() drops every hop row (job B must not report job A's hops
+    or samples) while a reappearing hop reuses its already-registered
+    Histogram, so the panel and the Prometheus exposition keep reading
+    ONE reservoir."""
+    reg = MetricRegistry()
+    lt = LatencyTracker().bind_group(reg.job_manager_group())
+    lt.record(LatencyMarker(time.time() - 0.05, source="src"), "agg")
+    lt.record(LatencyMarker(time.time() - 0.05, source="src"), "only-a")
+    assert {r["hop"] for r in lt.panel()} == {"agg", "only-a"}
+    lt.reset()
+    assert lt.panel() == []
+    assert lt.summary() == {"hops": 0, "samples": 0}
+    lt.record(LatencyMarker(time.time() - 0.02, source="src"), "agg")
+    panel = lt.panel()
+    assert [r["hop"] for r in panel] == ["agg"]
+    assert panel[0]["count"] == 1
+    # the registered series IS the live reservoir: count restarted at 1,
+    # and the job-A-only hop's registered series was cleared, not frozen
+    text = PrometheusReporter(registry=reg).scrape()
+    assert "latency_source_src_0_op_agg_count 1" in text
+    assert "latency_source_src_0_op_only_a_count 0" in text
+
+
+def test_prometheus_histogram_summary_wire_format():
+    """render()-style wire assertion (like the push reporters): a
+    Histogram ships as a Prometheus SUMMARY — quantile series, _sum,
+    _count — under the sanitized metric name."""
+    reg = MetricRegistry()
+    h = reg.job_manager_group().histogram("latency.e2e_ms")
+    h.update_all(np.arange(1, 101, dtype=np.float64))
+    lines = PrometheusReporter(registry=reg).render(reg.all_metrics())
+    name = "flink_tpu_jobmanager_latency_e2e_ms"
+    assert f"# TYPE {name} summary" in lines
+    assert f'{name}{{quantile="0.5"}} 50.5' in lines
+    assert f'{name}{{quantile="0.99"}} 99.01' in lines
+    assert f"{name}_sum 5050.0" in lines
+    assert f"{name}_count 100" in lines
+
+
+def test_meter_deque_rate_semantics():
+    """The O(1)-trim deque keeps get_rate() bit-identical: rate is
+    (last - first) count over the retained window."""
+    now = [0.0]
+    m = Meter(window_s=10.0, clock=lambda: now[0])
+    for i in range(5):
+        now[0] = float(i)
+        m.mark_event(2)
+    assert m.get_count() == 10
+    assert m.get_rate() == pytest.approx((10 - 2) / 4.0)
+    # events beyond the window trim from the LEFT in O(1)
+    now[0] = 100.0
+    m.mark_event()
+    assert m.get_rate() == pytest.approx((11 - 10) / (100.0 - 4.0))
+
+
+# ---------------------------------------------------------------------------
+# MiniCluster end-to-end: config key → markers → histograms → REST
+# ---------------------------------------------------------------------------
+
+def test_latency_interval_config_key_wired():
+    from flink_tpu.cluster.minicluster import MiniCluster
+
+    config = Configuration().set(MetricOptions.LATENCY_INTERVAL, "5 ms")
+    mc = MiniCluster(config=config)
+    assert mc.latency_interval_ms == 5
+    # explicit arg wins over config
+    mc2 = MiniCluster(config=config, latency_interval_ms=11)
+    assert mc2.latency_interval_ms == 11
+    # tracing config key installs the journal
+    config2 = Configuration().set(MetricOptions.TRACING_ENABLED, True) \
+        .set(MetricOptions.TRACING_BUFFER, 128)
+    mc3 = MiniCluster(config=config2)
+    assert mc3.tracing_enabled and tracing.active().capacity == 128
+
+
+def test_minicluster_latency_and_trace_end_to_end():
+    """ONE run: p99 per (source, sink-hop) visible in job_status(), the
+    Prometheus exposition, and the REST panel; the span journal holds
+    checkpoint lifecycle spans exported as Chrome trace JSON."""
+    from flink_tpu.cluster.minicluster import MiniCluster
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.rest.server import JobRegistry, RestServer
+    from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    n = 120_000
+    (env.from_collection(columns={"k": np.arange(n) % 13,
+                                  "v": np.ones(n)}, batch_size=128)
+        .key_by("k").sum("v").collect())
+    plan = env.get_stream_graph("lat-job").to_plan()
+    mc = MiniCluster(checkpoint_storage=InMemoryCheckpointStorage(retain=3),
+                     checkpoint_interval_ms=20,
+                     latency_interval_ms=2, tracing_enabled=True)
+    registry = JobRegistry()
+    job_id = registry.register("lat-job", mc)
+    server = RestServer(registry).start()
+    try:
+        res = mc.execute(plan, timeout_s=120)
+        assert res.state == "FINISHED"
+        assert res.completed_checkpoints, "no checkpoint completed"
+
+        # 1. job_status(): per-(source, hop) latency incl. the sink hop
+        status = mc.job_status()
+        hops = status["latency"]
+        assert hops, "no latency hops recorded"
+        sink_uids = [v["id"] for v in status["vertices"]
+                     if "sink" in v["name"] or "collect" in v["name"]]
+        hop_ids = {h["hop"] for h in hops}
+        assert any(u in hop_ids for u in sink_uids) or len(hop_ids) >= 2
+        assert all(h["p99_ms"] >= 0 and h["count"] > 0 for h in hops)
+        # trace summary rides job_status too
+        assert status["trace"]["enabled"]
+        assert status["trace"]["spans"] > 0
+        assert status["trace"]["categories"].get("checkpoint", 0) > 0
+
+        # 2. Prometheus exposition, same run
+        text = PrometheusReporter(registry=mc.metrics_registry).scrape()
+        assert "latency_source_" in text and 'quantile="0.99"' in text
+
+        # 3. REST: latency JSON + panel + Chrome trace, same run
+        with urllib.request.urlopen(
+                f"{server.url}/jobs/{job_id}/latency", timeout=10) as r:
+            lat = json.loads(r.read())
+        assert lat["hops"] and lat["hops"][0]["count"] > 0
+        with urllib.request.urlopen(
+                f"{server.url}/jobs/{job_id}/latency.html", timeout=10) as r:
+            html = r.read().decode()
+        assert 'class="lat-row"' in html and "p99 ms" in html
+        with urllib.request.urlopen(
+                f"{server.url}/jobs/{job_id}/trace", timeout=10) as r:
+            trace = json.loads(r.read())
+        evs = trace["traceEvents"]
+        assert evs and trace["displayTimeUnit"] == "ms"
+        cats = {e.get("cat") for e in evs}
+        assert "checkpoint" in cats
+        names = {e["name"] for e in evs}
+        # full lifecycle: trigger → barrier/snapshot → ack → complete
+        assert {"checkpoint.trigger", "checkpoint.snapshot",
+                "checkpoint.ack", "checkpoint"} <= names
+        assert trace["otherData"]["latency"]
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# ProcessCluster: ONE merged timeline across workers
+# ---------------------------------------------------------------------------
+
+TRACE_JOB = textwrap.dedent('''
+    """Deterministic keyed-sum job, sized so checkpoints land mid-run."""
+    import numpy as np
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+
+    N = 60_000
+    K = 13
+
+    def build():
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        keys = (np.arange(N) % K).astype(np.int64)
+        (env.from_collection(columns={"k": keys, "v": np.ones(N)},
+                             batch_size=64)
+            .key_by("k").sum("v").collect())
+        return env.get_stream_graph("trace-job")
+''')
+
+
+def test_process_cluster_latency_without_tracing(tmp_path):
+    """``metrics.latency.interval`` alone (no tracing) must still surface
+    the per-hop histograms: the workers answer trace_request with an
+    empty journal but a full latency panel, and run()'s result carries
+    ``latency`` without a ``trace``."""
+    from flink_tpu.cluster.distributed import ProcessCluster
+
+    mod = tmp_path / "latonly_job_mod.py"
+    mod.write_text(TRACE_JOB)
+    sys.path.insert(0, str(tmp_path))
+    try:
+        pc = ProcessCluster("latonly_job_mod:build", n_workers=1,
+                            extra_sys_path=(str(tmp_path),),
+                            tracing=False, latency_interval_ms=5)
+        res = pc.run(timeout_s=300)
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("latonly_job_mod", None)
+    assert res["state"] == "FINISHED", res["error"]
+    assert "trace" not in res
+    assert res.get("latency"), "latency panel lost without tracing"
+    row = res["latency"][0]
+    assert {"hop", "p99_ms", "worker"} <= set(row)
+
+
+def test_collect_trace_does_not_stall_on_dead_workers():
+    """A worker whose control connection EOF'd (SIGKILL, crash) can never
+    answer a trace_request — collect_trace must exclude already-dead
+    conns up front and shrink its wait when one dies MID-collect, instead
+    of sitting out the full timeout."""
+    from flink_tpu.cluster.distributed import ProcessCluster
+
+    pc = ProcessCluster("fake_mod:build", n_workers=2)
+    sent = []
+    pc._to_worker = lambda idx, msg: sent.append(idx)
+
+    # both conns already dead: returns immediately, requests nothing
+    pc._conns = {0: object(), 1: object()}
+    pc._dead_conn_idx = {0, 1}
+    t0 = time.monotonic()
+    merged = pc.collect_trace(timeout_s=10.0)
+    assert time.monotonic() - t0 < 2.0
+    assert sent == [] and merged["otherData"]["requested_workers"] == 0
+
+    # one live conn dying mid-collect unblocks the wait early
+    pc._dead_conn_idx = {1}
+
+    def _die_later():
+        time.sleep(0.3)
+        pc._dead_conn_idx.add(0)
+        with pc._trace_cv:
+            pc._trace_cv.notify_all()
+
+    threading.Thread(target=_die_later, daemon=True).start()
+    t0 = time.monotonic()
+    merged = pc.collect_trace(timeout_s=10.0)
+    assert time.monotonic() - t0 < 5.0, "stalled on a dead worker"
+    assert sent == [0] and merged["otherData"]["workers"] == 0
+
+
+def test_process_cluster_merged_timeline(tmp_path):
+    """A ProcessCluster job with tracing on yields ONE merged Chrome
+    timeline: coordinator checkpoint spans (pid 0) + both workers' task
+    spans, clock-offset aligned, plus the workers' latency panels."""
+    from flink_tpu.cluster.distributed import ProcessCluster
+
+    mod = tmp_path / "trace_job_mod.py"
+    mod.write_text(TRACE_JOB)
+    sys.path.insert(0, str(tmp_path))
+    try:
+        pc = ProcessCluster("trace_job_mod:build", n_workers=2,
+                            checkpoint_interval_ms=50,
+                            extra_sys_path=(str(tmp_path),),
+                            tracing=True, latency_interval_ms=5)
+        res = pc.run(timeout_s=300)
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("trace_job_mod", None)
+    assert res["state"] == "FINISHED", res["error"]
+    trace = res["trace"]
+    assert trace is pc.last_trace
+    other = trace["otherData"]
+    assert other["requested_workers"] == 2
+    assert other["workers"] == 2, "a worker's ring never arrived"
+    assert set(other["clock_offsets_ms"]) == {0, 1}
+    evs = trace["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert {0, 1, 2} <= pids, f"merged timeline missing processes: {pids}"
+    # coordinator lifecycle + worker snapshot spans on the SAME timeline
+    names_by_pid = {}
+    for e in evs:
+        names_by_pid.setdefault(e["pid"], set()).add(e["name"])
+    assert "checkpoint.trigger" in names_by_pid[0]
+    worker_names = names_by_pid.get(1, set()) | names_by_pid.get(2, set())
+    assert "checkpoint.snapshot" in worker_names
+    # workers recorded marker latency at their hops
+    assert other["latency"], "no worker latency panels in the merge"
+    assert {"worker", "hop", "p99_ms"} <= set(other["latency"][0])
+    # one ordered timeline (metadata events carry no ts)
+    ts = [e["ts"] for e in evs if "ts" in e]
+    assert ts == sorted(ts)
+    json.dumps(trace)                    # Perfetto-loadable = valid JSON
