@@ -150,7 +150,8 @@ def _build_op(window_ms: int, emit_tier: str = "host",
               device_sync: str = "auto", paging_cap: int = 0,
               pipeline_depth: int = 1, native_shards: int = 0,
               mesh_devices: int = 0, key_capacity: int = 1 << 20,
-              device_probe: str = "auto", queryable=None):
+              device_probe: str = "auto", queryable=None,
+              superbatch: int = 0):
     import jax.numpy as jnp
 
     from flink_tpu.core.functions import RuntimeContext, SumAggregator
@@ -176,7 +177,10 @@ def _build_op(window_ms: int, emit_tier: str = "host",
         pipeline_depth=pipeline_depth,
         native_shards=native_shards,
         device_probe=device_probe,
-        queryable=queryable)
+        queryable=queryable,
+        # one-dispatch fused megastep (ISSUE-11): stage N micro-batches
+        # and advance them in one pass (0 = measured auto-calibration)
+        superbatch=superbatch)
     if mesh_devices > 1:
         # the mesh-sharded hot path: ONE logical operator over the chip
         # mesh (parallel/mesh_runtime) — state in key-group-range blocks,
@@ -240,7 +244,8 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
                    emit_tier: str = "host", device_sync: str = "auto",
                    timed_passes: int = 3, pipeline_depth: int = 1,
                    native_shards: int = 0, mesh_devices: int = 0,
-                   key_capacity: int = 1 << 20, device_probe: str = "auto"):
+                   key_capacity: int = 1 << 20, device_probe: str = "auto",
+                   superbatch: int = 0):
     """Timed checkpointable run.  Returns (records/sec, windows fired,
     snapshots taken, phase dict, mid-run snapshot + its batch index +
     post-checkpoint digests for the replay check)."""
@@ -313,7 +318,7 @@ def run_tpu_native(batches, window_ms: int, checkpoint_every: int,
     op = _build_op(window_ms, emit_tier, device_sync,
                    pipeline_depth=pipeline_depth, native_shards=native_shards,
                    mesh_devices=mesh_devices, key_capacity=key_capacity,
-                   device_probe=device_probe)
+                   device_probe=device_probe, superbatch=superbatch)
     run(op, warm + batches[:2] + batches[-1:])
     # best of three timed passes: this host suffers EPISODIC multi-second
     # slowdowns (shared-core tunnel client; measured ±70% swings on
@@ -340,7 +345,7 @@ def replay_check(batches, window_ms: int, mid, digests,
                  emit_tier: str = "host", device_sync: str = "auto",
                  pipeline_depth: int = 1, native_shards: int = 0,
                  mesh_devices: int = 0, key_capacity: int = 1 << 20,
-                 device_probe: str = "auto") -> bool:
+                 device_probe: str = "auto", superbatch: int = 0) -> bool:
     """Exactly-once evidence: restore the mid-run snapshot into a FRESH
     operator, replay the remaining batches, and require the identical
     per-window fire digests."""
@@ -352,7 +357,7 @@ def replay_check(batches, window_ms: int, mid, digests,
     op = _build_op(window_ms, emit_tier, device_sync,
                    pipeline_depth=pipeline_depth, native_shards=native_shards,
                    mesh_devices=mesh_devices, key_capacity=key_capacity,
-                   device_probe=device_probe)
+                   device_probe=device_probe, superbatch=superbatch)
     op.restore_state(snap)
     out = []
     for keys, vals, ts in batches[i + 1:]:
@@ -1506,7 +1511,7 @@ def run_mesh_bench(args) -> dict:
         timed_passes=2 if args.smoke else 3,
         pipeline_depth=args.pipeline_depth,
         native_shards=args.native_shards, mesh_devices=D,
-        device_probe=args.device_probe,
+        device_probe=args.device_probe, superbatch=args.superbatch,
         # size the ring to the workload so the key-group-range blocks are
         # POPULATED on every device (capacity-sized blocks would park all
         # live rows on shard 0 at small key counts)
@@ -1516,7 +1521,8 @@ def run_mesh_bench(args) -> dict:
                              pipeline_depth=args.pipeline_depth,
                              native_shards=args.native_shards,
                              mesh_devices=D, key_capacity=n_keys,
-                             device_probe=args.device_probe)
+                             device_probe=args.device_probe,
+                             superbatch=args.superbatch)
     ns = phases.pop("elapsed", 1)
     per_shard_ms = [round(v / 1e6, 1)
                     for v in shard_ns.get("probe_mirror", [])]
@@ -1537,6 +1543,12 @@ def run_mesh_bench(args) -> dict:
         "device_probe": "on" if dp["enabled"] else "off",
         "probe_hit_rate": (round(dp["probe_hit_rate"], 4)
                            if dp["probe_hit_rate"] is not None else None),
+        # fused staging on the mesh: the host super-pass + one exchange
+        # dispatch per super-batch (the scan lane is structurally off)
+        "fused": {k: (bool(v) if k == "enabled" else v)
+                  for k, v in op.fused_stats().items()
+                  if k in ("enabled", "depth", "flushes",
+                           "host_super_passes", "hot_dispatches")},
         # --mesh-devices 1 is the single-chip leg of the comparison: the
         # plain operator has no shard layout, its "manifest" is one block
         "shard_manifest": ([
@@ -1592,6 +1604,74 @@ def check_mesh_budget(result: dict, budget: dict) -> list:
                 f"decomposed)")
     if not result.get("ok"):
         viol.append("restore/replay check failed")
+    return viol
+
+
+def fused_equivalence_check(window_ms: int) -> bool:
+    """Fused on/off digest equality, asserted IN the run (ISSUE-11): a
+    small prefix of the headline stream drains through (a) the unfused
+    path, (b) the fused host super-pass, and (c) the forced scan lane
+    (device probe on + superbatch), and all three must produce identical
+    fire digests AND identical mid-run snapshot bytes.  The mirror tier's
+    f64/i64 accumulation is exact for f32 inputs, so this is equality,
+    not tolerance."""
+    from flink_tpu.core.batch import RecordBatch, Watermark
+
+    eq_batches = make_batches(1 << 16, 1 << 13, 1 << 13, window_ms,
+                              seed=41)
+
+    def drain(superbatch, device_probe):
+        op = _build_op(window_ms, "host", "deferred",
+                       pipeline_depth=0, native_shards=1,
+                       key_capacity=1 << 13, device_probe=device_probe,
+                       superbatch=superbatch)
+        out = []
+        sbytes = None
+        for i, (k, v, ts) in enumerate(eq_batches):
+            out += op.process_batch(RecordBatch({"k": k, "v": v},
+                                                timestamps=ts))
+            out += op.process_watermark(Watermark(int(ts.max()) - 1))
+            if i == len(eq_batches) // 2:
+                op.prepare_snapshot_pre_barrier()
+                snap = op.snapshot_state()
+                sbytes = (snap["counts"].tobytes(),
+                          tuple(np.asarray(l).tobytes()
+                                for l in snap["leaves"]))
+        out += op.end_input()
+        return _fire_digests(out), sbytes
+
+    base = drain(1, "off")
+    return drain(8, "off") == base and drain(4, "on") == base
+
+
+def check_fused_budget(result: dict, budget: dict,
+                       smoke: bool = False) -> list:
+    """Fused-lane gate (BENCH_BUDGET ``fused_cpu``/``fused_device``): the
+    in-run fused on/off digest equivalence is unconditional (divergent
+    digests never exit 0), ``max_dispatches_per_batch`` pins the
+    one-dispatch claim (steady-state warm-key super-batches must not leak
+    per-stage dispatches back in), and ``min_vs_numpy`` floors the CPU
+    fallback tier's ratio on full runs (smoke is one batch of fixed
+    costs)."""
+    viol = []
+    d = result["details"].get("fused") or {}
+    if not d.get("equivalence_ok"):
+        viol.append("fused on/off digest equivalence failed (fire digests "
+                    "or snapshot bytes diverge between the staged and "
+                    "per-batch paths)")
+    # the one-dispatch ceiling gates the FUSED lane's claim only: a run
+    # whose lane resolved (or was forced) off never promised amortized
+    # dispatch — e.g. per-batch probe+miss-update is structurally 2/batch
+    cap = budget.get("max_dispatches_per_batch")
+    dpb = d.get("dispatches_per_batch")
+    if (cap is not None and dpb is not None and d.get("enabled")
+            and dpb > cap):
+        viol.append(f"hot-path dispatches/batch {dpb} > ceiling {cap} "
+                    f"(the megastep is not amortizing dispatch)")
+    floor = budget.get("min_vs_numpy")
+    vs = result.get("vs_numpy_baseline")
+    if floor is not None and not smoke and vs is not None and vs < floor:
+        viol.append(f"vs_numpy_baseline {vs} < fused floor {floor}")
     return viol
 
 
@@ -1752,6 +1832,16 @@ def main():
     ap.add_argument("--native-shards", type=int, default=0,
                     help="native probe shard count (0 = auto: "
                          "FLINK_TPU_NATIVE_SHARDS or one per core up to 4)")
+    ap.add_argument("--superbatch", type=int, default=0, metavar="N",
+                    help="one-dispatch fused megastep (ISSUE-11): stage N "
+                         "micro-batches and advance them in ONE pass — a "
+                         "device-side lax.scan over donated buffers when "
+                         "the device probe is active, one concatenated "
+                         "fused C probe+fold on the host tier.  0 = auto "
+                         "(measured process-wide A/B, like "
+                         "--pipeline-depth/--device-probe), 1 = off; "
+                         "details land in details.fused and with --check "
+                         "gate against BENCH_BUDGET.json fused_cpu")
     ap.add_argument("--device-probe", default="auto",
                     choices=["auto", "on", "off"],
                     help="device-resident key probe (state/device_keyindex):"
@@ -1955,12 +2045,18 @@ def main():
                           args.emit_tier, args.device_sync,
                           pipeline_depth=args.pipeline_depth,
                           native_shards=args.native_shards,
-                          device_probe=args.device_probe)
+                          device_probe=args.device_probe,
+                          superbatch=args.superbatch)
     replay_ok = replay_check(batches, args.window_ms, mid, digests,
                              args.emit_tier, args.device_sync,
                              pipeline_depth=args.pipeline_depth,
                              native_shards=args.native_shards,
-                             device_probe=args.device_probe)
+                             device_probe=args.device_probe,
+                             superbatch=args.superbatch)
+    # fused on/off digest equality, asserted in THIS run (ISSUE-11): the
+    # staged super-pass and the forced scan lane must match the per-batch
+    # path exactly at small scale before the headline number counts
+    fused_eq_ok = fused_equivalence_check(args.window_ms)
     # device-vs-mirror consistency: a REAL device download of the live
     # panes, compared against the host mirror (post-timing).  Under
     # deferred sync this validates the refresh round trip (upload ->
@@ -2040,6 +2136,25 @@ def main():
                                     else None)
         detail["miss_inserts"] = dp["miss_inserts"]
         detail["delta_d2h_mb"] = round(dp["delta_d2h_bytes"] / 1e6, 2)
+    # ---- fused megastep accounting (ISSUE-11): the winning pass's staged
+    # depth, scan dispatches, hot-path dispatches/batch (the one-dispatch
+    # claim, gated by fused_cpu.max_dispatches_per_batch), compile counts
+    # of the scan megasteps (sticky geometry ⇒ O(log) per run), and the
+    # in-run fused on/off equivalence verdict
+    fu = op.fused_stats()
+    detail["fused"] = {
+        "enabled": bool(fu["enabled"]),
+        "superbatch": fu["depth"],
+        "staged_batches": fu["staged_batches"],
+        "flushes": fu["flushes"],
+        "scan_dispatches": fu["scan_dispatches"],
+        "scan_steps": fu["scan_steps"],
+        "host_super_passes": fu["host_super_passes"],
+        "dispatches_per_batch": round(
+            fu["hot_dispatches"] / max(1, len(batches)), 3),
+        "scan_compiles": op.fused_step_cache_size(),
+        "equivalence_ok": fused_eq_ok,
+    }
     from flink_tpu.utils import transport
     if transport.dispatch_ms_per_mb() is not None:
         detail["dispatch_ms_per_mb"] = round(transport.dispatch_ms_per_mb(), 2)
@@ -2124,6 +2239,14 @@ def main():
             tier = f"{tier}_device"
         budget = budgets[tier]
         viol = check_budget(result, budget)
+        fused_tier = ("fused_cpu" if platform == "cpu" else "fused_device")
+        if fused_tier in budgets:
+            viol += check_fused_budget(result, budgets[fused_tier],
+                                       smoke=args.smoke)
+        elif not fused_eq_ok:
+            # no fused budget configured for this backend: the digest
+            # equivalence still gates — divergence must never exit 0
+            viol.append("fused on/off digest equivalence failed")
         if trace_detail is not None:
             # tracing-on must cost <5% throughput (trace_cpu section) and
             # the artifact must carry the spans the round needs

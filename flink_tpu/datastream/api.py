@@ -760,7 +760,8 @@ class WindowedStream:
                   pipeline_depth: int = 0,
                   native_shards: int = 0,
                   device_probe: str = "auto",
-                  queryable: Optional[str] = None) -> DataStream:
+                  queryable: Optional[str] = None,
+                  superbatch: int = 1) -> DataStream:
         """``paging``: a :class:`flink_tpu.state.paging.PagingConfig` caps
         the operator's resident key capacity — cold keys page out to the
         spill tier (state larger than HBM).  ``emit_tier`` overrides the
@@ -777,7 +778,10 @@ class WindowedStream:
         state under that name with the queryable serving tier (ISSUE-9):
         fired values become readable over the batched lookup protocol /
         REST at ``live`` and (when checkpoints run) ``checkpoint``
-        consistency."""
+        consistency.  ``superbatch`` stages N micro-batches into one
+        fused megastep pass (ISSUE-11: one scan dispatch / one fused C
+        super-pass per N batches; 0 = measured auto-calibration, 1 = off)
+        — bit-identical fires, snapshots, and counters either way."""
         keyed, assigner = self.keyed, self.assigner
         trigger, lateness = self._trigger, self._allowed_lateness
         late_tag = getattr(self, "_late_tag", None)
@@ -878,6 +882,7 @@ class WindowedStream:
                     return MeshWindowAggOperator(mesh=mesh,
                                                  device_probe=device_probe,
                                                  queryable=queryable,
+                                                 superbatch=superbatch,
                                                  **kwargs)
                 if emit_tier is not None:
                     kwargs["emit_tier"] = emit_tier
@@ -886,6 +891,7 @@ class WindowedStream:
                                          native_shards=native_shards,
                                          device_probe=device_probe,
                                          queryable=queryable,
+                                         superbatch=superbatch,
                                          **kwargs)
 
         t = keyed._then(name, factory)

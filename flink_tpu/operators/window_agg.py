@@ -67,8 +67,8 @@ from flink_tpu.operators.base import StreamOperator
 from flink_tpu.runtime.device_health import DeviceQuarantinedError
 from flink_tpu.ops.scatter import (combine_along_axis,
                                    gather_row_pane_columns, reset_rows,
-                                   scatter_fast, scatter_generic,
-                                   set_row_pane_columns)
+                                   scatter_fast, scatter_fold_counts,
+                                   scatter_generic, set_row_pane_columns)
 from flink_tpu.state.keyindex import KeyIndex, ObjectKeyIndex, make_key_index
 from flink_tpu.state.paging import identity_grid
 from flink_tpu.windowing.assigners import GlobalWindows, WindowAssigner
@@ -303,6 +303,13 @@ class WindowAggOperator(StreamOperator):
     _SHARDED_HOST_TIER = False
     _SHARDED_PAGING = False
     _SHARDED_DEGRADE = False
+    #: fused scan-lane capability (operators/fused_step.py): the single-
+    #: dispatch ``lax.scan`` megastep over a staged [N, B] super-batch.
+    #: The mesh subclass turns it off — its exchange routing (bucket plan,
+    #: sticky capacity) is host-computed per batch — and stages through the
+    #: fused HOST pass instead (one concatenated C probe+fold + one
+    #: exchange dispatch per super-batch).
+    _FUSED_SCAN = True
 
     def __init__(
         self,
@@ -331,6 +338,7 @@ class WindowAggOperator(StreamOperator):
         native_shards: int = 0,
         device_probe: str = "auto",
         queryable: Optional[str] = None,
+        superbatch: int = 1,
     ):
         #: host tier: use the C++ WinMirror kernels (fused probe+mirror,
         #: compacting fire) when eligible; False pins the numpy mirror —
@@ -630,6 +638,34 @@ class WindowAggOperator(StreamOperator):
         self._dp_stats = {"probe_hits": 0, "probe_misses": 0,
                           "miss_inserts": 0, "delta_syncs": 0}
 
+        # ---- one-dispatch fused megastep (operators/fused_step.py,
+        # ROADMAP item 6): stage up to ``superbatch`` micro-batches and
+        # advance them in ONE pass — a device-side lax.scan over donated
+        # state buffers when the device-resident probe is active, or one
+        # concatenated fused C probe+fold (+ one replica dispatch under
+        # scatter sync) on the host tier.  1 = off (the default — the
+        # serial-equivalent baseline, like pipeline_depth=0); 0 = auto
+        # (measured process-wide A/B, calibrated_superbatch); N > 1
+        # forces depth N.
+        # Watermarks that pass no window end leave the stage untouched
+        # (fire-boundary math decides the scan boundary); every state read
+        # flushes through flush_pipeline, so observable behaviour is
+        # bit-identical to the unfused path.
+        if int(superbatch) < 0:
+            raise ValueError("superbatch must be >= 0 (0 = auto)")
+        self.superbatch = int(superbatch)
+        from flink_tpu.operators.fused_step import SuperBatchStage
+        self._fused_resolved: Optional[int] = None   # depth; 1 = off
+        self._fused_stage = SuperBatchStage()
+        self._fused_counters = {"flushes": 0, "staged_batches": 0,
+                                "scan_dispatches": 0, "scan_steps": 0,
+                                "host_super_passes": 0}
+        self._fused_bp_hw = 0    # sticky pow2 high-water: scan step width
+        self._fused_n_hw = 0     # sticky pow2 high-water: scan depth
+        self._fused_shards = 0   # super-pass C shard count (0 = unresolved)
+        #: guarded hot-path dispatch count (bench: dispatches/batch)
+        self._hot_dispatches = 0
+
         # ---- queryable serving tier (ISSUE-9): when named, every fired
         # window's emissions publish into a barrier-free live-read view
         # (queryable/view.py) — the SAME (keys, values) arrays the fire
@@ -722,7 +758,13 @@ class WindowAggOperator(StreamOperator):
         """Drop all keyed state/time progress but KEEP compiled steps (the
         jit caches key on this instance).  Used by benchmarks/tests to re-run
         a warm operator, and by restore paths before loading a snapshot."""
-        self.flush_pipeline()  # in-flight stages still write this state
+        if self._pipe is not None:
+            self._pipe.flush()   # in-flight stages still write this state
+        # staged micro-batches die with the state they were bound for (a
+        # fold into state we are about to drop would be wasted work); the
+        # sticky scan geometry and the resolved depth survive, like the
+        # resolved sync mode — compile-once across warm re-runs
+        self._fused_stage.take()
         self._staging_pool = {}
         self.key_index = None
         self._leaves = None
@@ -743,6 +785,10 @@ class WindowAggOperator(StreamOperator):
         self.phase_ns = {}
         self.phase_bytes = {}
         self.phase_shard_ns = {}
+        self._hot_dispatches = 0
+        self._fused_counters = {"flushes": 0, "staged_batches": 0,
+                                "scan_dispatches": 0, "scan_steps": 0,
+                                "host_super_passes": 0}
         self._device_stale = False  # resolved sync mode survives the reset
         self._degraded = False      # fresh state restores on the device
         with self._tier_lock:
@@ -921,11 +967,9 @@ class WindowAggOperator(StreamOperator):
         leaves up to the delta's f64/i64 dtypes)."""
         K, P = dcounts.shape
         dflat = tuple(l.reshape(K * P) for l in dleaves)
-        new = scatter_fast(dflat, flat, lifted, self.kinds)
-        ndl = tuple(l.reshape(K, P) for l in new)
-        ndc = dcounts.reshape(K * P).at[flat].add(
-            jnp.ones(flat.shape, jnp.int32), mode="drop").reshape(K, P)
-        return ndl, ndc
+        new, ndc = scatter_fold_counts(dflat, dcounts.reshape(K * P),
+                                       flat, lifted, self.kinds)
+        return tuple(l.reshape(K, P) for l in new), ndc.reshape(K, P)
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(3, 4, 5, 6))
     def _probed_update_step(self, tab, b, leaves, counts, dleaves, dcounts,
@@ -980,6 +1024,102 @@ class WindowAggOperator(StreamOperator):
                                fill_value=Bp)[0].astype(jnp.int32)
         miss_count = jnp.sum(miss, dtype=jnp.int32)
         return ndl, ndc, miss_idx, miss_count
+
+    def _fused_scan_body(self, tab, Pn, pad_id, treedef, carry_is_state,
+                         flat_state: int = 0):
+        """One scan step of the fused megastep: probe the device table,
+        fold warm rows, emit the compact miss list.  Shared by the scatter
+        and deferred scan steps; ``carry_is_state`` distinguishes the
+        (state, delta) carry from the delta-only carry.  The probe — and,
+        when capable, the fused Pallas probe+FOLD kernel (the Pallas path
+        extended beyond the probe: one kernel resolves slots and scatters
+        the delta without a round trip through HBM) — is chosen at trace
+        time like every probed step."""
+        from flink_tpu.state.device_keyindex import (
+            pallas_probe_fold, pallas_probe_fold_available, probe_impl)
+        _name, probe = probe_impl(int(tab[0].shape[0]))
+        fused_pallas = (not carry_is_state and flat_state > 0
+                        and pallas_probe_fold_available(
+                            int(tab[0].shape[0]), flat_state, self.kinds))
+
+        def fold(flat, lifted, flat_leaves, flat_counts):
+            return scatter_fold_counts(flat_leaves, flat_counts, flat,
+                                       lifted, self.kinds)
+
+        def body(carry, xs):
+            b, klo, khi, stt, ps = xs[:5]
+            vals = xs[5:]
+            Bp = klo.shape[0]
+            valid = jnp.arange(Bp, dtype=jnp.int32) < b
+            values = jax.tree_util.tree_unflatten(treedef, list(vals))
+            lifted = tuple(jax.tree_util.tree_leaves(self.agg.lift(values)))
+            if fused_pallas:
+                dl, dc = carry
+                slot, nds, ndc = pallas_probe_fold(
+                    *tab, klo, khi, stt, ps, jnp.reshape(b, (1,)),
+                    lifted[0], dl[0], dc, Pn)
+                out = ((nds,), ndc)
+            else:
+                slot = probe(*tab, klo, khi, stt)
+                hit = valid & (slot >= 0)
+                flat = jnp.where(hit, slot * Pn + ps, pad_id)
+                if carry_is_state:
+                    fl, fc, dl, dc = carry
+                    fl, fc = fold(flat, lifted, fl, fc)
+                    dl, dc = fold(flat, lifted, dl, dc)
+                    out = (fl, fc, dl, dc)
+                else:
+                    dl, dc = carry
+                    dl, dc = fold(flat, lifted, dl, dc)
+                    out = (dl, dc)
+            miss = valid & (slot < 0)
+            mi = jnp.nonzero(miss, size=Bp,
+                             fill_value=Bp)[0].astype(jnp.int32)
+            return out, (mi, jnp.sum(miss, dtype=jnp.int32))
+
+        return body
+
+    @partial(jax.jit, static_argnums=(0, 12), donate_argnums=(2, 3, 4, 5))
+    def _fused_scan_update_step(self, tab, leaves, counts, dleaves, dcounts,
+                                bs, key_lo, key_hi, start, pane_slots,
+                                vplanes, treedef):
+        """Scatter-sync scan megastep: ONE dispatch advances every staged
+        micro-batch — per step, probe + device-state fold (device
+        precision) + delta fold (mirror precision) — over donated state
+        buffers, so steady-state warm-key super-batches cost exactly one
+        dispatch.  Returns the per-step compact miss lists; the scalar
+        miss total is the host's only mandatory read-back."""
+        K, Pn = counts.shape
+        fl = tuple(l.reshape((K * Pn,) + l.shape[2:]) for l in leaves)
+        fc = counts.reshape(K * Pn)
+        dl = tuple(l.reshape(K * Pn) for l in dleaves)
+        dc = dcounts.reshape(K * Pn)
+        body = self._fused_scan_body(tab, Pn, _PAD_ID, treedef, True)
+        (fl, fc, dl, dc), (miss_idx, miss_counts) = jax.lax.scan(
+            body, (fl, fc, dl, dc),
+            (bs, key_lo, key_hi, start, pane_slots) + tuple(vplanes))
+        new_leaves = tuple(l.reshape((K, Pn) + l.shape[1:]) for l in fl)
+        new_dl = tuple(l.reshape(K, Pn) for l in dl)
+        return (new_leaves, fc.reshape(K, Pn), new_dl, dc.reshape(K, Pn),
+                miss_idx, miss_counts)
+
+    @partial(jax.jit, static_argnums=(0, 10), donate_argnums=(2, 3))
+    def _fused_scan_delta_step(self, tab, dleaves, dcounts, bs, key_lo,
+                               key_hi, start, pane_slots, vplanes, treedef):
+        """Deferred-sync scan megastep: the mirror is authoritative, so
+        warm rows fold into the delta ring ONLY (the device replica
+        catches up at device_refresh) — still one dispatch per
+        super-batch."""
+        K, Pn = dcounts.shape
+        dl = tuple(l.reshape(K * Pn) for l in dleaves)
+        dc = dcounts.reshape(K * Pn)
+        body = self._fused_scan_body(tab, Pn, _PAD_ID, treedef, False,
+                                     flat_state=K * Pn)
+        (dl, dc), (miss_idx, miss_counts) = jax.lax.scan(
+            body, (dl, dc),
+            (bs, key_lo, key_hi, start, pane_slots) + tuple(vplanes))
+        return (tuple(l.reshape(K, Pn) for l in dl), dc.reshape(K, Pn),
+                miss_idx, miss_counts)
 
     @partial(jax.jit, static_argnums=(0, 3))
     def _delta_pull_step(self, dleaves, dcounts, rows: int, pane_slots):
@@ -1118,6 +1258,7 @@ class WindowAggOperator(StreamOperator):
                 return out, int(out[-1])
 
             try:
+                self._hot_dispatches += 1
                 res, mc = device_health.guarded_dispatch(
                     thunk, mb=mb, on_oom=None,
                     label=f"{self.name}.device_probe",
@@ -1187,31 +1328,36 @@ class WindowAggOperator(StreamOperator):
                                          values)
         mslots = self._devprobe_absorb_misses(mkeys, mpanes, mvalues)
         if sync != "deferred":
-            # the device replica must see every record: fold the miss rows
-            # through the plain (guarded) update step — host-built flat
-            # ids, the same watchdog/OOM/quarantine path as every other
-            # hot-path dispatch
-            Bm = int(mi.size)
-            Bmp = _next_pow2(Bm, 64)
-            flat = np.full(Bmp, _PAD_ID, np.int32)
-            flat[:Bm] = (mslots.astype(np.int64) * self._P
-                         + (mpanes % self._P)).astype(np.int32)
-            vleaves = [np.asarray(a) for a in
-                       jax.tree_util.tree_leaves(mvalues)]
-            treedef = jax.tree_util.tree_structure(mvalues)
-            values_p = jax.tree_util.tree_unflatten(
-                treedef, [_pad_rows(a, Bmp) for a in vleaves])
-            mb = (flat.nbytes + sum(a.nbytes for a in vleaves)) / 1e6
-            try:
-                with self._phase("device_dispatch"):
-                    res = self._guarded_update(flat, values_p, mb)
-            except DeviceQuarantinedError as err:
-                # every record is already accounted for in mirror-land
-                # (warm rows in the delta, miss rows C-folded above):
-                # degrade without refolding anything
-                self._devprobe_degrade(err)
-                return
-            self._leaves, self._counts = res[0], res[1]
+            self._miss_replica_update(
+                mslots, mpanes, jax.tree_util.tree_structure(mvalues),
+                [np.asarray(a)
+                 for a in jax.tree_util.tree_leaves(mvalues)])
+
+    def _miss_replica_update(self, mslots, mpanes, treedef,
+                             vleaves) -> None:
+        """Scatter-sync replica catch-up for probe-miss rows (the shared
+        tail of the per-batch and fused miss paths): the device replica
+        must see every record, so fold the miss rows through the plain
+        (guarded) update step — host-built flat ids, the same
+        watchdog/OOM/quarantine path as every other hot-path dispatch.
+        Callers reach here only after every record is accounted for in
+        mirror-land (warm rows in the delta, miss rows C-folded), so a
+        quarantine degrades without refolding anything."""
+        Bm = int(mslots.size)
+        Bmp = _next_pow2(Bm, 64)
+        flat = np.full(Bmp, _PAD_ID, np.int32)
+        flat[:Bm] = (mslots.astype(np.int64) * self._P
+                     + (mpanes % self._P)).astype(np.int32)
+        values_p = jax.tree_util.tree_unflatten(
+            treedef, [_pad_rows(a, Bmp) for a in vleaves])
+        mb = (flat.nbytes + sum(a.nbytes for a in vleaves)) / 1e6
+        try:
+            with self._phase("device_dispatch"):
+                res = self._guarded_update(flat, values_p, mb)
+        except DeviceQuarantinedError as err:
+            self._devprobe_degrade(err)
+            return
+        self._leaves, self._counts = res[0], res[1]
 
     def _devprobe_degrade(self, err: BaseException, keys=None, panes=None,
                           values=None) -> None:
@@ -1227,6 +1373,20 @@ class WindowAggOperator(StreamOperator):
         from flink_tpu.runtime import device_health
         try:
             if self._delta_counts is not None and self._delta_panes:
+                # donated-buffer safety (PR-4's _enter_degraded guard,
+                # extended to the probe/scan lanes' delta planes): a
+                # genuinely timed-out dispatch may already have CONSUMED
+                # the donated delta arrays — salvaging a deleted buffer is
+                # a use-after-free, so fail the salvage up front and take
+                # the restart path (the last checkpoint always drained the
+                # delta first)
+                if any(getattr(a, "is_deleted", lambda: False)()
+                       for a in (self._delta_counts,
+                                 *(self._delta_leaves or ()))):
+                    raise RuntimeError(
+                        "delta planes were donated into the abandoned "
+                        "dispatch (consumed); in-process salvage is "
+                        "impossible")
                 mon = device_health.get_monitor(create=False)
                 if mon is not None:
                     mon.run_salvage(
@@ -1268,6 +1428,257 @@ class WindowAggOperator(StreamOperator):
                 out[name] = -1
         return out
 
+    # ------------------------------------------------- fused megastep lane
+    def _fused_depth(self, sync: str) -> int:
+        """Resolved super-batch staging depth for this batch (1 = off).
+        Resolution happens once per operator (like the sync cadence and the
+        device-probe verdict): forced by ``superbatch > 1``, measured by
+        ``calibrated_superbatch`` on auto.  Only the host emit tier stages —
+        its f64/i64 mirror makes regrouped accumulation bit-exact, and its
+        fires/snapshots already funnel through the flush barrier.  While
+        the sync cadence is still calibrating, batches run unfused (the
+        calibration measures per-batch dispatch cost)."""
+        if sync not in ("scatter", "deferred"):
+            return 1
+        if self._fused_resolved is None:
+            if (self.emit_tier != "host" or self._pager is not None
+                    or self.trigger.fires_on_count
+                    or self.superbatch == 1):
+                self._fused_resolved = 1
+            elif self.superbatch > 1:
+                self._fused_resolved = self.superbatch
+            else:
+                from flink_tpu.operators.fused_step import \
+                    calibrated_superbatch
+                self._fused_resolved = calibrated_superbatch()
+        return self._fused_resolved
+
+    def _fused_pending(self) -> bool:
+        return bool(self._fused_stage)
+
+    def fused_stats(self) -> Dict[str, Any]:
+        """Fused-lane counters (monitoring-grade, no pipeline barrier —
+        the ``paging_stats`` contract): staging depth, flush/dispatch
+        counts, and the guarded hot-path dispatch total the bench divides
+        into dispatches/batch."""
+        s = dict(self._fused_counters)
+        depth = self._fused_resolved or (self.superbatch
+                                         if self.superbatch > 1 else 0)
+        s["enabled"] = int((self._fused_resolved or 1) > 1)
+        s["depth"] = depth
+        s["staged_pending"] = len(self._fused_stage)
+        s["hot_dispatches"] = self._hot_dispatches
+        return s
+
+    def fused_step_cache_size(self) -> Dict[str, int]:
+        """Compiled-variant counts of the scan megasteps (the tier-1
+        sticky-geometry recompile smoke, the ``_cache_size`` pattern of
+        PR 6/7): steady state must be exactly one compile per (table
+        capacity, K_cap, P, scan depth, step width, value spec)."""
+        out = {}
+        for name in ("_fused_scan_update_step", "_fused_scan_delta_step"):
+            fn = getattr(type(self), name)
+            try:
+                out[name] = int(fn._cache_size())
+            except Exception:  # noqa: BLE001 — jax without the cache probe
+                out[name] = -1
+        return out
+
+    def _fused_flush(self) -> None:
+        """Advance every staged micro-batch in ONE pass.  Scan-capable
+        operators with the device probe active take the single-dispatch
+        ``lax.scan`` lane; everything else concatenates and runs the fused
+        host pass once (still one replica dispatch per super-batch under
+        scatter sync).  Runs wherever the stage filled (pipeline worker or
+        task thread) — never concurrently, see ``SuperBatchStage``."""
+        if not self._fused_stage:
+            return
+        st = self._fused_stage.take()
+        self._fused_counters["flushes"] += 1
+        sync = self.device_sync_mode or "deferred"
+        if self._degraded:
+            sync = "deferred"
+        if (self._FUSED_SCAN and len(st) > 1
+                and self._devprobe_active(sync)):
+            self._fused_flush_scan(st, sync)
+            return
+        from flink_tpu.operators.fused_step import concat_staged
+        if len(st) == 1:
+            # a fire boundary (or state read) drained a single staged
+            # batch: that is the plain per-batch path, not a super pass
+            keys, panes, values, B = st[0]
+        else:
+            self._fused_counters["host_super_passes"] += 1
+            with self._phase("fused_scan"):
+                keys, panes, values, B = concat_staged(st)
+        if self._devprobe_active(sync):
+            # scan-incapable subclass (mesh): one per-super-batch probe
+            # pass — the probe, exchange, and miss fold each amortize
+            # across the staged batches
+            self._hot_stage_devprobe(keys, panes, values, B, sync)
+            return
+        self._hot_stage_fold(keys, panes, values, B, sync,
+                             super_pass=len(st) > 1)
+
+    def _fused_super_shards(self):
+        """(shards, shard_div, shard_ns) for the fused host SUPER pass:
+        the per-batch calibration measured thread-pool wake against one
+        micro-batch — re-measure at super-batch size (fused_step.
+        calibrated_super_shards) and take whichever is larger.  Mesh
+        subclasses keep their device-aligned contiguous ranges."""
+        nshards, shard_div, shard_ns = self._probe_shards()
+        if shard_div == 0 and self.native_shards == 0:
+            if not self._fused_shards:
+                from flink_tpu.operators.fused_step import \
+                    calibrated_super_shards
+                self._fused_shards = calibrated_super_shards()
+            nshards = max(nshards, self._fused_shards)
+        return nshards, shard_div, shard_ns
+
+    def _fused_flush_scan(self, st, sync: str) -> None:
+        """The scan lane: stage the super-batch as padded [N, B] planes
+        (sticky pow2 high-water on both axes) and advance all N steps in
+        ONE jitted dispatch over donated state buffers.  Only the per-step
+        compact miss lists and the scalar miss total (the sync point) come
+        back; the host pass then touches misses only, in step order — the
+        same slot-assignment order as the per-batch path."""
+        from flink_tpu.runtime import device_health
+        self._ensure_alloc()
+        self._ensure_delta()
+        if self._dki is None:
+            from flink_tpu.state.device_keyindex import DeviceKeyIndex
+            self._dki = DeviceKeyIndex(
+                initial_capacity=max(1 << 16, 2 * self._K),
+                sharding=self._devprobe_table_sharding())
+        self._dki.ensure_loaded(self.key_index)
+        with self._phase("fused_scan"):
+            N = len(st)
+            bp = max(_next_pow2(int(s[3]), 64) for s in st)
+            self._fused_bp_hw = bp = max(self._fused_bp_hw, bp)
+            self._fused_n_hw = nhw = max(self._fused_n_hw,
+                                         _next_pow2(N, 1))
+            klo = np.zeros((nhw, bp), np.int32)
+            khi = np.zeros((nhw, bp), np.int32)
+            stt = np.zeros((nhw, bp), np.int32)
+            ps = np.zeros((nhw, bp), np.int32)
+            bs = np.zeros(nhw, np.int32)   # pad steps: b=0, all rows dropped
+            treedef = jax.tree_util.tree_structure(st[0][2])
+            leaves0 = [np.asarray(a)
+                       for a in jax.tree_util.tree_leaves(st[0][2])]
+            vplanes = [np.zeros((nhw, bp) + a.shape[1:], a.dtype)
+                       for a in leaves0]
+            for i, (keys, panes, values, B) in enumerate(st):
+                lo, hi, start = self._dki.prepare_batch(keys)
+                klo[i, :B] = lo
+                khi[i, :B] = hi
+                stt[i, :B] = start
+                ps[i, :B] = (panes % self._P).astype(np.int32)
+                bs[i] = B
+                for j, a in enumerate(jax.tree_util.tree_leaves(values)):
+                    vplanes[j][i, :B] = np.asarray(a)
+            mb = (16 * nhw * bp + sum(v.nbytes for v in vplanes)) / 1e6
+            tab = self._dki.table()
+            geom = ("fused_scan", sync, self._dki.capacity, self._K,
+                    self._P, nhw, bp,
+                    tuple((v.dtype.str, v.shape[2:]) for v in vplanes))
+            fresh_geom = geom != getattr(self, "_last_dispatch_geom", None)
+            self._last_dispatch_geom = geom
+
+            def thunk():
+                with _x64():
+                    if sync == "deferred":
+                        out = self._fused_scan_delta_step(
+                            tab, self._delta_leaves, self._delta_counts,
+                            bs, klo, khi, stt, ps, tuple(vplanes), treedef)
+                    else:
+                        out = self._fused_scan_update_step(
+                            tab, self._leaves, self._counts,
+                            self._delta_leaves, self._delta_counts,
+                            bs, klo, khi, stt, ps, tuple(vplanes), treedef)
+                # the scalar miss total is the dispatch's sync point: a
+                # wedged device must surface HERE, under the watchdog
+                return out, int(np.asarray(out[-1]).sum())
+
+            try:
+                self._hot_dispatches += 1
+                res, total_miss = device_health.guarded_dispatch(
+                    thunk, mb=mb, on_oom=None,
+                    label=f"{self.name}.fused_scan",
+                    compile_grace=fresh_geom)
+            except DeviceQuarantinedError as err:
+                self._fused_scan_degrade(err, st)
+                return
+            self._fused_counters["scan_dispatches"] += 1
+            self._fused_counters["scan_steps"] += N
+            if sync == "deferred":
+                (self._delta_leaves, self._delta_counts,
+                 miss_idx, miss_counts) = res
+                self._device_stale = True
+            else:
+                (self._leaves, self._counts, self._delta_leaves,
+                 self._delta_counts, miss_idx, miss_counts) = res
+                self.phase_bytes["h2d"] = \
+                    self.phase_bytes.get("h2d", 0) + mb
+            for _keys, panes, _values, _B in st:
+                self._delta_panes.update(
+                    int(p) for p in np.unique(panes).tolist())
+            total_rows = int(sum(s[3] for s in st))
+            self._dp_stats["probe_hits"] += total_rows - total_miss
+            self._dp_stats["probe_misses"] += total_miss
+        if total_miss:
+            self._fused_handle_misses(st, np.asarray(miss_idx),
+                                      np.asarray(miss_counts), sync)
+
+    def _fused_handle_misses(self, st, miss_idx, miss_counts,
+                             sync: str) -> None:
+        """Post-scan host pass over the compact per-step miss lists, in
+        step (= batch) order, so new keys get exactly the slot ids the
+        per-batch path would assign.  A key first seen mid-super-batch
+        misses on every later step too (the device table is immutable
+        during the scan); its rows all land here, folding into the SAME
+        mirror cells the warm path would have used — bit-identical under
+        the mirror's exact accumulation."""
+        parts = []
+        for i, (keys, panes, values, _B) in enumerate(st):
+            mc = int(miss_counts[i])
+            if not mc:
+                continue
+            mi = miss_idx[i, :mc].astype(np.int64)
+            mkeys = np.ascontiguousarray(keys[mi])
+            mpanes = np.ascontiguousarray(panes[mi])
+            mvalues = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[mi], values)
+            mslots = self._devprobe_absorb_misses(mkeys, mpanes, mvalues)
+            if sync != "deferred":
+                parts.append((mslots, mpanes, mvalues))
+        if sync == "deferred" or not parts:
+            return
+        # ONE guarded update folds every step's miss rows (the mirror-
+        # precision story already landed above, so concatenation order
+        # here only moves replica low bits — verify_mirror tolerance
+        # territory)
+        self._miss_replica_update(
+            np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts]),
+            jax.tree_util.tree_structure(parts[0][2]),
+            [np.concatenate([np.asarray(l) for l in ls])
+             for ls in zip(*[jax.tree_util.tree_leaves(p[2])
+                             for p in parts])])
+
+    def _fused_scan_degrade(self, err: BaseException, st) -> None:
+        """A quarantined scan dispatch.  The scan is transactional — one
+        ``guarded_dispatch``, like PR-8's ``cep.vectorized_drain``: the
+        watchdog's failure modes precede execution (the chaos point fires
+        before the thunk; an abandoned lane skips it), so NO staged row
+        reached any state plane.  Salvage the PRIOR delta into the mirror
+        (with the donated-buffer guard — planes a genuinely timed-out
+        dispatch already consumed fail the salvage and take the restart
+        path), degrade the tier, and refold EVERY staged batch through the
+        host pass so no record is lost."""
+        from flink_tpu.operators.fused_step import concat_staged
+        keys, panes, values, _B = concat_staged(st)
+        self._devprobe_degrade(err, keys, panes, values)
+
     # ------------------------------------------------------------- pipeline
     def _pipe_active(self) -> bool:
         """Pipelining applies to the time-triggered hot path only: count
@@ -1279,12 +1690,15 @@ class WindowAggOperator(StreamOperator):
         return self._pipe is not None and self._pipe.pending()
 
     def flush_pipeline(self) -> List[StreamElement]:
-        """Pipeline barrier: complete every in-flight hot stage.  Called
+        """Pipeline barrier: complete every in-flight hot stage AND fold
+        any staged super-batch (the fused lane's flush boundary).  Called
         internally before any state read (fires, snapshots, verification)
-        and by task drivers at idle points so pipelined results never wait
-        on the NEXT batch's arrival.  Safe no-op when pipelining is off."""
+        and by task drivers at idle points so pipelined/staged results
+        never wait on the NEXT batch's arrival.  Safe no-op when both
+        lanes are off."""
         if self._pipe is not None:
             self._pipe.flush()
+        self._fused_flush()
         return []
 
     def _staging_acquire(self, Bp: int, leaves, treedef) -> _Staging:
@@ -2009,10 +2423,32 @@ class WindowAggOperator(StreamOperator):
             # skip the replica dispatch (deferred-sync semantics) until
             # re-promotion
             sync = "deferred"
+        if self._fused_depth(sync) > 1:
+            # one-dispatch fused megastep: park the batch; the whole
+            # super-batch advances in ONE pass at the flush boundary
+            # (depth/row bound here, fire boundary or any state read via
+            # flush_pipeline)
+            from flink_tpu.operators.fused_step import MAX_STAGED_ROWS
+            self._fused_stage.push(keys, panes, values, B)
+            self._fused_counters["staged_batches"] += 1
+            if (len(self._fused_stage) >= self._fused_resolved
+                    or self._fused_stage.rows >= MAX_STAGED_ROWS):
+                self._fused_flush()
+            return
         if self._devprobe_active(sync):
             # device-resident key probe: warm keys resolve INSIDE the
             # dispatched step, the host pass touches only misses
             return self._hot_stage_devprobe(keys, panes, values, B, sync)
+        self._hot_stage_fold(keys, panes, values, B, sync)
+
+    def _hot_stage_fold(self, keys: np.ndarray, panes: np.ndarray, values,
+                        B: int, sync: str, super_pass: bool = False) -> None:
+        """The fold half of the hot stage (probe/mirror pass, paging,
+        device dispatch) for one batch — a micro-batch on the unfused
+        path, a whole concatenated super-batch from ``_fused_flush``: the
+        SAME code folding the SAME records in the SAME order either way,
+        so fire digests, snapshots, and counters cannot diverge between
+        the fused and unfused lanes."""
         staging = None
         flat_ready = False
         # flatten the value tree ONCE per batch: staging acquisition and
@@ -2032,10 +2468,16 @@ class WindowAggOperator(StreamOperator):
             # ids (the triples are computed once and consumed twice —
             # VERDICT r3 next #1b), sharded across the native worker pool
             # when native_shards > 1.  Deferred sync needs no scatter ids.
+            # Super-batches re-measure the shard verdict at their own size
+            # (thread-pool wake amortizes over N× the rows).
             with self._phase("probe_mirror"):
                 lifted = [np.asarray(l) for l in jax.tree_util.tree_leaves(
                     self.agg.host_lift(values))]
-                nshards, shard_div, shard_ns = self._probe_shards()
+                if super_pass:
+                    nshards, shard_div, shard_ns = \
+                        self._fused_super_shards()
+                else:
+                    nshards, shard_div, shard_ns = self._probe_shards()
                 if sync == "deferred":
                     slots = self._nm.probe_update(keys, panes, lifted,
                                                   shards=nshards,
@@ -2190,6 +2632,7 @@ class WindowAggOperator(StreamOperator):
                 tuple((a.dtype.str, a.shape[1:]) for a in leaves))
         fresh_geom = geom != getattr(self, "_last_dispatch_geom", None)
         self._last_dispatch_geom = geom
+        self._hot_dispatches += 1
         return device_health.guarded_dispatch(
             lambda: self._update_step(self._leaves, self._counts, flat_p,
                                       values_p),
@@ -2422,7 +2865,8 @@ class WindowAggOperator(StreamOperator):
 
     def process_watermark(self, watermark: Watermark) -> List[StreamElement]:
         self.watermark = max(self.watermark, watermark.timestamp)
-        if (self._pipe_pending() and not self.async_fire
+        if ((self._pipe_pending() or self._fused_pending())
+                and not self.async_fire
                 and self.lateness == 0
                 and self.trigger.fires_on_time and self.assigner.is_event_time
                 and not isinstance(self.assigner, GlobalWindows)

@@ -58,6 +58,19 @@ def scatter_fast(state_leaves, slot_ids, lifted_leaves, kinds: Sequence[str]):
     return tuple(out)
 
 
+def scatter_fold_counts(flat_leaves, flat_counts, slot_ids, lifted_leaves,
+                        kinds: Sequence[str]):
+    """One batch's fold into FLAT ``[K*P]`` keyed state: the value leaves
+    scatter-combine by kind and the element counts scatter-add ones — the
+    shared body of the per-batch update step, the device-probe delta fold,
+    and the fused scan megastep's per-step fold (window_agg), so the three
+    lanes cannot drift arithmetically.  Out-of-range ids (padding, probe
+    misses) drop."""
+    new_leaves = scatter_fast(flat_leaves, slot_ids, lifted_leaves, kinds)
+    ones = jnp.ones(slot_ids.shape, jnp.int32)
+    return new_leaves, flat_counts.at[slot_ids].add(ones, mode="drop")
+
+
 def segment_fold(slot_ids, lifted_leaves, combine_leaves: Callable,
                  num_slots: int = 0):
     """Generic per-batch segment reduction: returns (unique_slot_ids[B],

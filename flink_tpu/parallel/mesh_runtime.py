@@ -97,6 +97,13 @@ class MeshWindowAggOperator(WindowAggOperator):
     _SHARDED_HOST_TIER = True
     _SHARDED_PAGING = True
     _SHARDED_DEGRADE = True
+    #: the single-dispatch ``lax.scan`` lane stays off on the mesh: the
+    #: exchange routing (bucket plan, sticky capacity) is host-computed
+    #: per batch.  Super-batch STAGING still applies — the fused host pass
+    #: concatenates the staged batches, so the C probe, the all_to_all
+    #: exchange, and (with the probe on) the device probe dispatch each
+    #: run once per super-batch instead of once per micro-batch.
+    _FUSED_SCAN = False
 
     def __init__(self, *args, mesh: Optional[Mesh] = None,
                  n_devices: Optional[int] = None, **kwargs):
@@ -330,6 +337,7 @@ class MeshWindowAggOperator(WindowAggOperator):
                 return slot_d, int(miss_d)
 
             try:
+                self._hot_dispatches += 1
                 slot_d, mc = device_health.guarded_dispatch(
                     thunk, mb=12 * Bp / 1e6, on_oom=None,
                     label=f"{self.name}.device_probe",
@@ -359,6 +367,7 @@ class MeshWindowAggOperator(WindowAggOperator):
                 lambda a: np.asarray(a)[h_idx], values)
             try:
                 with self._phase("device_probe"):
+                    self._hot_dispatches += 1
                     device_health.guarded_dispatch(
                         lambda: self._apply_delta_update(
                             h_vals, int(h_idx.size), slots[h_idx],
@@ -380,6 +389,7 @@ class MeshWindowAggOperator(WindowAggOperator):
             values_np = jax.tree_util.tree_map(np.asarray, values)
             try:
                 with self._phase("device_dispatch"):
+                    self._hot_dispatches += 1
                     device_health.guarded_dispatch(
                         lambda: self._apply_update(values_np, B, slots,
                                                    panes_mod),

@@ -316,6 +316,11 @@ class DeviceHealthMonitor:
             "watchdog_timeouts": 0, "transient_retries": 0,
             "oom_pageouts": 0, "near_misses": 0, "probe_attempts": 0,
         }
+        #: guarded dispatches per label (e.g. "win.fused_scan") — the
+        #: fused-megastep era's dispatch accounting: dispatches/batch is
+        #: the metric the one-dispatch scan lane exists to shrink, and the
+        #: per-site breakdown shows WHICH dispatch a regression added
+        self.label_counts: Dict[str, int] = {}
 
     # -- state ---------------------------------------------------------------
     @property
@@ -337,6 +342,7 @@ class DeviceHealthMonitor:
             return {"state": self._state,
                     "last_failure": self.last_failure,
                     "deadline_floor_s": self.config.deadline_floor_s,
+                    "dispatch_labels": dict(self.label_counts),
                     **dict(self.counters)}
 
     # -- watchdog ------------------------------------------------------------
@@ -394,6 +400,8 @@ class DeviceHealthMonitor:
         while True:
             with self._lock:
                 self.counters["dispatches"] += 1
+                self.label_counts[label] = \
+                    self.label_counts.get(label, 0) + 1
                 if compile_grace or self.counters["dispatches"] == 1:
                     deadline = max(deadline,
                                    self.config.first_dispatch_grace_s)
@@ -617,5 +625,5 @@ def status_snapshot() -> Dict[str, Any]:
         return {"state": HEALTHY, "last_failure": None, "quarantines": 0,
                 "heals": 0, "watchdog_timeouts": 0, "transient_retries": 0,
                 "oom_pageouts": 0, "near_misses": 0, "dispatches": 0,
-                "probe_attempts": 0}
+                "probe_attempts": 0, "dispatch_labels": {}}
     return mon.status()

@@ -195,6 +195,28 @@ def test_tracing_suite_collects_under_tier1():
          f"observability suite left the gate")
 
 
+def test_fused_step_suite_collects_under_tier1():
+    """The one-dispatch fused megastep suite (ISSUE-11) must contribute
+    tests to the tier-1 run under ``JAX_PLATFORMS=cpu`` — the fused
+    on/off digest+snapshot+counter equality, the compile-once smoke, and
+    the mid-scan quarantine salvage all run on the CPU backend (the lax
+    scan lane needs no TPU), so a slow-mark sweep that silently drops
+    them fails here."""
+    import subprocess
+
+    f = "test_fused_step.py"
+    assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", str(TESTS / f)],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"{f}::" in proc.stdout, \
+        (f"{f} contributes no tests to the tier-1 selection — the fused "
+         f"megastep's bit-identity coverage left the gate")
+
+
 def test_marker_declarations_have_descriptions():
     """Each declared marker carries a description (the `name: text` form)
     so `pytest --markers` documents the tiers."""
