@@ -1281,24 +1281,26 @@ def check_cep_budget(result: dict, budget: dict, smoke: bool = False) -> list:
 
 
 def run_queryable_bench(args) -> dict:
-    """``--queryable``: the serving tier (ISSUE-9) against a RUNNING 1M-key
-    window job.  One pass drains the stream with no read load (baseline
-    records/sec), a second pass drains the SAME stream while N pooled
-    clients hammer the TCP server with batched lookups — alternating
-    ``live`` and ``checkpoint`` consistency — through the real wire
-    protocol.  Reports lookups/sec + client-side p50/p99, the replicas'
-    worst observed lag, the job's records/sec under load (the
-    hot-path-non-interference acceptance: checkpoint reads serve from
-    frozen replica arrays, live reads from published fire segments —
-    neither blocks nor mutates the hot path), and a live-equality check
-    (values served over the wire == the view's fire-time values).  With
-    ``--check`` gates against BENCH_BUDGET.json ``queryable_cpu``."""
-    import threading
-
+    """``--queryable``: the serving tier at production QPS (ISSUE-13)
+    against a RUNNING 1M-key window job.  One pass drains the stream with
+    no read load (baseline records/sec), a second pass drains the SAME
+    stream while ``--qps-clients`` pooled clients sustain
+    ``--qps-target`` aggregate lookups/sec through the BINARY COLUMNAR
+    wire protocol with client-side key-group routing — alternating
+    ``live`` and ``checkpoint`` consistency.  Reports lookups/sec,
+    client-side p50/p99 AND the server-side service-time percentiles
+    (lookup + serialization measured in the handler — the honest number
+    on a GIL-loaded box), protocol + routing mode, cache hit rate, the
+    replicas' worst observed lag, the job's throughput under load as a
+    FRACTION of unloaded (the <10% tax acceptance), a live-equality
+    check (wire values == the view's fire-time values) and a
+    binary==JSON answer-equality check.  With ``--check`` gates against
+    BENCH_BUDGET.json ``queryable_cpu``."""
     from flink_tpu.core.batch import RecordBatch, Watermark
     from flink_tpu.queryable import (QueryableStateClientPool,
                                      QueryableStateService,
                                      QueryableStateSpec)
+    from flink_tpu.queryable import wire as qwire
 
     n_records = args.records or (1 << 17 if args.smoke else 1 << 22)
     n_keys = min(args.keys, n_records)
@@ -1310,108 +1312,233 @@ def run_queryable_bench(args) -> dict:
         else args.batch_size
     batches = make_batches(n_records, n_keys, batch_size, window_ms)
     ckpt_every = max(1, min(args.checkpoint_every, len(batches) // 4))
-    n_clients = 2 if args.smoke else 4
-    batch_keys = 64
+    # client count trades per-request RTT for in-flight concurrency: the
+    # drain's jitted megastep holds the GIL in multi-ms stretches, so a
+    # single request's round trip can span several dispatch windows —
+    # sustained qps = in-flight / RTT, and the fleet is paced to the same
+    # aggregate target regardless of its size
+    n_clients = args.qps_clients or (2 if args.smoke else 16)
+    batch_keys = args.qps_batch_keys
+    qps_target = args.qps_target
+    # sustained-rate pacing: each client fires every `interval` seconds so
+    # the fleet lands on the aggregate target — the acceptance is "the
+    # target RATE sustained with <10% hot-path tax", not "max rate at any
+    # tax" (an unthrottled fleet measures GIL contention, not serving)
+    interval = (n_clients * batch_keys / qps_target) if qps_target else 0.0
 
-    def drain(op, svc=None):
-        """The job under test: the standard drain loop, snapshotting every
-        --checkpoint-every batches into the serving tier's checkpoint feed
-        (the MiniCluster _complete_checkpoint path, inlined)."""
+    # the serving window must be long enough to SUSTAIN the target rate
+    # (the one-dispatch job drains 4M records in well under a second):
+    # repeat the stream with advancing timestamps — same keys (warm steady
+    # state), fresh windows every repeat, live fires throughout
+    repeats = 1 if args.smoke else 8
+    max_ts = max(int(ts.max()) for _k, _v, ts in batches)
+    ts_span = ((max_ts // window_ms) + 2) * window_ms
+    # checkpoint cadence spans the WHOLE run (~4 checkpoints however many
+    # repeats): each 1M-key ingest is real background work on the feed
+    # thread, and production checkpoints are time-based, not
+    # per-2M-records
+    ckpt_every = max(ckpt_every, (len(batches) * repeats) // 4 or 1)
+
+    def drain(op, svc=None, n_repeats=1):
+        """The job under test: the standard drain loop over ``n_repeats``
+        timestamp-shifted passes of the stream (same keys — warm steady
+        state; fresh windows every repeat), snapshotting into the serving
+        tier's checkpoint feed (the MiniCluster _complete_checkpoint
+        path, inlined)."""
         cid = 0
+        step = 0
         t0 = time.perf_counter()
-        for i, (k, v, ts) in enumerate(batches):
-            op.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
-            op.process_watermark(Watermark(int(ts.max()) - 1))
-            if svc is not None and (i + 1) % ckpt_every == 0:
-                cid += 1
-                op.prepare_snapshot_pre_barrier()
-                snap = op.snapshot_state()
-                svc.on_checkpoint_complete(
-                    cid, {"win": {"subtasks": [{"operator": snap}]}})
-                op.notify_checkpoint_complete(cid)
+        for r in range(n_repeats):
+            off = r * ts_span
+            for k, v, ts in batches:
+                tso = ts + off if off else ts
+                op.process_batch(RecordBatch({"k": k, "v": v},
+                                             timestamps=tso))
+                op.process_watermark(Watermark(int(tso.max()) - 1))
+                step += 1
+                if svc is not None and step % ckpt_every == 0:
+                    cid += 1
+                    op.prepare_snapshot_pre_barrier()
+                    snap = op.snapshot_state()
+                    svc.on_checkpoint_complete(
+                        cid, {"win": {"subtasks": [{"operator": snap}]}})
+                    op.notify_checkpoint_complete(cid)
         op.flush_pipeline()
         elapsed = time.perf_counter() - t0
         op.end_input()
-        return n_records / elapsed, cid
+        return n_records * n_repeats / elapsed, cid
 
-    # pass 1: no read load — the interference baseline
-    op0 = _build_op(window_ms, "host", args.device_sync,
-                    pipeline_depth=args.pipeline_depth,
-                    native_shards=args.native_shards,
-                    device_probe=args.device_probe)
-    rps_no_load, _ = drain(op0)
+    # warm-up: one throwaway prefix drain + snapshot so pass 1 measures
+    # the job, not XLA compiles / process-wide sync+superbatch
+    # calibration / allocator warm-up (pass ordering must not bias the
+    # under-load-vs-unloaded fraction)
+    warm = _build_op(window_ms, "host", args.device_sync,
+                     pipeline_depth=args.pipeline_depth,
+                     native_shards=args.native_shards,
+                     device_probe=args.device_probe)
+    for k, v, ts in batches[: max(1, len(batches) // 8)]:
+        warm.process_batch(RecordBatch({"k": k, "v": v}, timestamps=ts))
+        warm.process_watermark(Watermark(int(ts.max()) - 1))
+    warm.flush_pipeline()
+    warm.prepare_snapshot_pre_barrier()
+    warm.snapshot_state()
+    warm.end_input()
+    del warm
 
-    # pass 2: same stream, N pooled clients of batched lookups
-    op = _build_op(window_ms, "host", args.device_sync,
-                   pipeline_depth=args.pipeline_depth,
-                   native_shards=args.native_shards,
-                   device_probe=args.device_probe, queryable="agg")
+    # a serving process trades a sliver of drain throughput for request
+    # latency: the default 5ms GIL switch interval parks a handler thread
+    # for milliseconds per slice behind the drain loop.  Applied to BOTH
+    # passes so the fraction stays apples-to-apples.
+    switch0 = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+
+    # interleaved rounds of (unloaded leg, loaded leg), best of each —
+    # symmetric, so this class of vCPU host's 10%+ run-to-run steal noise
+    # hits both sides of the under-load fraction equally.  EVERY leg runs
+    # the IDENTICAL job — queryable views published, checkpoints
+    # snapshotted and replica-ingested — so the fraction isolates the
+    # READ load, not the checkpoint stream the job runs either way.
+    rounds = 1 if args.smoke else 2
+
+    def _leg_op():
+        return _build_op(window_ms, "host", args.device_sync,
+                         pipeline_depth=args.pipeline_depth,
+                         native_shards=args.native_shards,
+                         device_probe=args.device_probe, queryable="agg")
+
+    # ONE serving tier + server for the whole bench: loaded legs
+    # re-register their op's live view (register_views replaces), the
+    # replica keeps ingesting whichever loaded leg is running
+    import jax.numpy as jnp
+
+    from flink_tpu.core.functions import SumAggregator
     svc = QueryableStateService()
-    svc.register_views("agg", [op.queryable_view()], 1, 128)
-    svc.add_replica("agg", QueryableStateSpec("agg", "win", "k", op.agg))
+    svc.add_replica("agg", QueryableStateSpec("agg", "win", "k",
+                                              SumAggregator(jnp.float32)))
     server = svc.start_server()
-    stop = threading.Event()
+
+    # the client fleet runs OUT-OF-PROCESS, like production readers: a
+    # client thread inside the job process measures GIL scheduling, not
+    # serving.  Only the server (its handler threads) shares the job's
+    # process — that contention IS the hot-path tax under test.  Clients
+    # pause between loaded legs (stdio go/pause protocol).
+    import subprocess as _sp
+    bench_path = os.path.abspath(__file__)
+    cprocs = []
+    for c in range(n_clients):
+        cenv = dict(os.environ)
+        # pin CPU in the client processes: they never run jax work, but
+        # bench.py's import-time wedged-accelerator guard probes the
+        # tunnel UNLESS JAX_PLATFORMS=cpu — 16 clients each paying a
+        # (possibly minutes-long) probe would dwarf the bench
+        cenv["JAX_PLATFORMS"] = "cpu"
+        cprocs.append(_sp.Popen(
+            [sys.executable, bench_path, "--_qps-client",
+             "--_qps-host", str(server.host),
+             "--_qps-port", str(server.port),
+             "--_qps-seed", str(100 + c),
+             "--_qps-interval-us", str(interval * 1e6),
+             "--qps-batch-keys", str(batch_keys),
+             "--keys", str(n_keys)],
+            stdin=_sp.PIPE, stdout=_sp.PIPE, text=True, env=cenv))
+    counts = {"lookups": 0, "errors": 0, "max_lag": 0, "routed_batches": 0}
     lat_ms: list = []
-    counts = {"lookups": 0, "errors": 0, "max_lag": 0}
-    lock = threading.Lock()
+    ready = 0
+    for p in cprocs:
+        line = p.stdout.readline()
+        if line.strip() == "READY":
+            ready += 1
+    if ready < n_clients:
+        counts["errors"] += n_clients - ready
 
-    def client_loop(seed):
-        rng = np.random.default_rng(seed)
-        pool = QueryableStateClientPool(server.host, server.port,
-                                        size=2, retries=1)
-        local_lat, local_n, local_err, local_lag = [], 0, 0, 0
-        i = 0
+    def _fleet(cmd: str) -> None:
+        for p in cprocs:
+            try:
+                p.stdin.write(cmd + "\n")
+                p.stdin.flush()
+            except OSError:
+                pass
+
+    rps_no_load = 0.0
+    rps_load = 0.0
+    q_elapsed = 0.0
+    n_ckpts = 0
+    op = None
+    for _round in range(rounds):
+        # unloaded leg
+        op0 = _leg_op()
+        svc0 = QueryableStateService()
+        svc0.register_views("agg", [op0.queryable_view()], 1, 128)
+        svc0.add_replica("agg", QueryableStateSpec("agg", "win", "k",
+                                                   op0.agg))
+        rps, _ = drain(op0, svc0, n_repeats=repeats)
+        svc0.drain_feed()
+        svc0.close()
+        rps_no_load = max(rps_no_load, rps)
+        del op0
+        # loaded leg: same job + the paced client fleet
+        op = _leg_op()
+        svc.register_views("agg", [op.queryable_view()], 1, 128)
+        q_t0 = time.perf_counter()
+        _fleet("go")
+        rps, cids = drain(op, svc, n_repeats=repeats)
+        _fleet("pause")
+        q_elapsed += time.perf_counter() - q_t0
+        rps_load = max(rps_load, rps)
+        n_ckpts += cids
+        if _round < rounds - 1:
+            op.end_input()
+    _fleet("stop")
+    for p in cprocs:
         try:
-            while not stop.is_set():
-                keys = rng.integers(0, n_keys,
-                                    batch_keys).astype(int).tolist()
-                cons = "checkpoint" if i % 2 else "live"
-                i += 1
-                t0 = time.perf_counter()
-                try:
-                    got = pool.get_batch("agg", keys, consistency=cons)
-                except (RuntimeError, ConnectionError):
-                    local_err += 1
-                    continue
-                local_lat.append((time.perf_counter() - t0) * 1e3)
-                local_n += len(keys)
-                tags = got.get("tags", {})
-                local_lag = max(local_lag,
-                                tags.get("replica_lag_checkpoints") or 0)
-        finally:
-            pool.close()
-        with lock:
-            lat_ms.extend(local_lat)
-            counts["lookups"] += local_n
-            counts["errors"] += local_err
-            counts["max_lag"] = max(counts["max_lag"], local_lag)
-
-    threads = [threading.Thread(target=client_loop, args=(100 + c,),
-                                daemon=True) for c in range(n_clients)]
-    q_t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    rps_load, n_ckpts = drain(op, svc)
-    stop.set()
-    for t in threads:
-        t.join(timeout=30)
-    q_elapsed = time.perf_counter() - q_t0
+            out, _ = p.communicate(timeout=60)
+        except _sp.TimeoutExpired:
+            p.kill()
+            counts["errors"] += 1
+            continue
+        stats_line = next((ln for ln in out.splitlines()
+                           if ln.startswith("STATS ")), None)
+        if stats_line is None:
+            counts["errors"] += 1
+            continue
+        st = json.loads(stats_line[len("STATS "):])
+        lat_ms.extend(st["lat_ms"])
+        counts["lookups"] += st["lookups"]
+        counts["errors"] += st["errors"]
+        counts["max_lag"] = max(counts["max_lag"], st["max_lag"])
+        counts["routed_batches"] += st["routed_batches"]
 
     # live equality over the wire: served values must equal the view's
-    # fire-time values EXACTLY (the server adds serialization, not math)
+    # fire-time values EXACTLY (the server adds serialization, not math);
+    # and the binary answer must be bit-identical to the JSON answer for
+    # the same keys (two encodings, one contract)
     view = op.queryable_view()
-    pool = QueryableStateClientPool(server.host, server.port)
+    jpool = QueryableStateClientPool(server.host, server.port)  # pure JSON
+    bpool = QueryableStateClientPool(server.host, server.port,
+                                     protocol="binary", routing=True)
     rngq = np.random.default_rng(5)
     sample = rngq.integers(0, n_keys, 256).astype(int).tolist()
-    wire = pool.get_batch("agg", sample, consistency="live")
+    json_ans = jpool.get_batch("agg", sample, consistency="live")
     vf, vv, _vt = view.lookup_batch(np.asarray(sample, np.int64))
-    live_equal = (wire["found"] == vf.tolist()
+    live_equal = (json_ans["found"] == vf.tolist()
                   and all((w is None and d is None) or w == d
-                          for w, d in zip(wire["values"], vv)))
-    pool.close()
+                          for w, d in zip(json_ans["values"], vv)))
+    bin_json_equal = True
+    for cons in ("live", "checkpoint"):
+        j = jpool.get_batch("agg", sample, consistency=cons)
+        bf, bc, _bt = bpool.get_batch_columnar(
+            "agg", np.asarray(sample, np.int64), consistency=cons)
+        bvals = qwire.values_from_columnar(bf, bc)
+        if j["found"] != bf.tolist() or any(
+                not ((w is None and d is None) or w == d)
+                for w, d in zip(j["values"], bvals)):
+            bin_json_equal = False
+    jpool.close()
+    bpool.close()
     svc.drain_feed()
     final = svc.stats()
     svc.close()
+    sys.setswitchinterval(switch0)
 
     lat = np.asarray(lat_ms) if lat_ms else np.zeros(1)
     qps = counts["lookups"] / max(q_elapsed, 1e-9)
@@ -1420,11 +1547,20 @@ def run_queryable_bench(args) -> dict:
         "n_keys": n_keys,
         "clients": n_clients,
         "keys_per_request": batch_keys,
+        "protocol": "binary",
+        "routing": "client" if counts["routed_batches"] else "server",
+        "qps_target": qps_target,
         "lookups": counts["lookups"],
         "lookup_errors": counts["errors"],
         "lookups_per_sec": round(qps, 1),
         "lookup_p50_ms": round(float(np.percentile(lat, 50)), 2),
         "lookup_p99_ms": round(float(np.percentile(lat, 99)), 2),
+        # server-side service time (lookup + serialization in the
+        # handler): the client-side p99 above also measures GIL stalls of
+        # this 2-vCPU box; this one measures the server
+        "serve_p50_ms": final.get("serve_p50_ms"),
+        "serve_p99_ms": final.get("serve_p99_ms"),
+        "cache_hit_rate": final.get("cache_hit_rate", 0.0),
         "records_per_sec_no_load": round(rps_no_load, 1),
         "records_per_sec_under_load": round(rps_load, 1),
         "rps_under_load_frac": round(rps_load / max(rps_no_load, 1e-9), 3),
@@ -1432,32 +1568,119 @@ def run_queryable_bench(args) -> dict:
         "max_replica_lag_checkpoints": max(
             counts["max_lag"], final["replica_lag_checkpoints"]),
         "live_equality_ok": live_equal,
+        "binary_json_equal_ok": bin_json_equal,
         "server_lookups_total": final["lookups_total"],
     }
     return {
         "metric": f"batched lookups/sec ({n_clients} clients x "
-                  f"{batch_keys}-key requests against the running "
+                  f"{batch_keys}-key binary columnar requests, "
+                  f"client-routed, against the running "
                   f"{n_keys}-key window job, live+checkpoint)",
         "value": round(qps, 1),
         "unit": "lookups/sec",
-        "ok": live_equal and counts["errors"] == 0,
+        "ok": live_equal and bin_json_equal and counts["errors"] == 0,
         "details": detail,
     }
+
+
+def _qps_client_main(args) -> int:
+    """Hidden ``--_qps-client`` worker: ONE out-of-process queryable
+    client of the ``--queryable`` bench.  Binary columnar protocol,
+    client-side key-group routing, constant-arrival-rate pacing (the wrk2
+    model: requests are DUE on a fixed schedule; after a stall the client
+    catches up to a bounded backlog so the offered rate stays the
+    target).  Parent protocol over stdio: prints ``READY``, then cycles
+    on ``go``/``pause`` lines (the bench interleaves loaded and unloaded
+    legs), stops on ``stop``/EOF and prints ``STATS <json>``."""
+    import threading as _th
+
+    from flink_tpu.queryable import QueryableStateClientPool
+
+    state = {"cmd": "wait"}
+
+    def _stdin_watch():
+        for line in sys.stdin:
+            cmd = line.strip()
+            if cmd in ("go", "pause", "stop"):
+                state["cmd"] = cmd
+                if cmd == "stop":
+                    return
+        state["cmd"] = "stop"
+
+    _th.Thread(target=_stdin_watch, daemon=True).start()
+    pool = QueryableStateClientPool(args._qps_host, args._qps_port,
+                                    size=2, retries=1,
+                                    protocol="binary", routing=True)
+    rng = np.random.default_rng(args._qps_seed)
+    interval = args._qps_interval_us / 1e6
+    batch_keys = args.qps_batch_keys
+    n_keys = args.keys
+    backlog_cap = max(4, int(1.0 / interval)) if interval else 0
+    print("READY", flush=True)
+    lat, lookups, errors, max_lag = [], 0, 0, 0
+    i = 0
+    while state["cmd"] != "stop":
+        if state["cmd"] != "go":
+            time.sleep(0.005)
+            continue
+        # entering a loaded leg: fresh schedule (pause time is not debt)
+        t_start = time.perf_counter() + (rng.uniform(0, interval)
+                                         if interval else 0.0)
+        fired = 0
+        while state["cmd"] == "go":
+            if interval:
+                due = (time.perf_counter() - t_start) / interval
+                if fired >= due:
+                    time.sleep(min((fired - due + 1) * interval, 0.02))
+                    continue
+                # bounded catch-up: after a stall (a 1M-key snapshot
+                # stretch holds the server's GIL for ~300ms) the client
+                # replays up to ONE SECOND of missed schedule, so the
+                # offered rate averages the target instead of
+                # target x uptime — any older backlog is dropped rather
+                # than burst at the window's end
+                fired = max(fired + 1, int(due) - backlog_cap)
+            keys = rng.integers(0, n_keys, batch_keys)    # stays int64
+            cons = "checkpoint" if i % 2 else "live"
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                _f, _c, tags = pool.get_batch_columnar("agg", keys,
+                                                       consistency=cons)
+            except (RuntimeError, ConnectionError):
+                errors += 1
+                continue
+            if len(lat) < 20000:
+                lat.append(round((time.perf_counter() - t0) * 1e3, 4))
+            lookups += batch_keys
+            max_lag = max(max_lag,
+                          tags.get("replica_lag_checkpoints") or 0)
+    routed = pool.stats["routed_batches"]
+    pool.close()
+    print("STATS " + json.dumps(
+        {"lookups": lookups, "errors": errors, "max_lag": max_lag,
+         "routed_batches": routed, "lat_ms": lat}), flush=True)
+    return 0
 
 
 def check_queryable_budget(result: dict, budget: dict,
                            smoke: bool = False) -> list:
     """``--queryable`` vs BENCH_BUDGET ``queryable_cpu``: a lookups/sec
-    floor and a job-throughput-under-load floor (full runs — smoke sizes
-    are dominated by fixed costs), a client-side p99 ceiling, a replica
-    staleness ceiling, and the unconditional live-equality check (values
-    over the wire must be the fire-time values — never exit 0 on a
-    divergence)."""
+    floor and a hot-path throughput-tax floor as a FRACTION of unloaded
+    (full runs — smoke sizes are dominated by fixed costs), a client-side
+    p99 ceiling, a replica staleness ceiling, and the unconditional
+    equality checks — live wire values == the view's fire-time values,
+    and binary answers == JSON answers — which never exit 0 on a
+    divergence, smoke included."""
     viol = []
     d = result["details"]
     if not d.get("live_equality_ok"):
         viol.append("live reads over the wire diverge from the view's "
                     "fire-time values")
+    if "binary_json_equal_ok" in d and not d["binary_json_equal_ok"]:
+        viol.append("binary columnar answers diverge from JSON answers "
+                    "for the same keys (two encodings must share one "
+                    "contract)")
     if d.get("lookup_errors"):
         viol.append(f"{d['lookup_errors']} lookup requests failed after "
                     f"pooled-client retries")
@@ -1468,12 +1691,26 @@ def check_queryable_budget(result: dict, budget: dict,
     if p99_cap is not None and d["lookup_p99_ms"] > p99_cap:
         viol.append(f"lookup p99 {d['lookup_p99_ms']}ms > ceiling "
                     f"{p99_cap}ms")
+    serve_cap = budget.get("max_serve_p99_ms")
+    if serve_cap is not None and d.get("serve_p99_ms") is not None \
+            and d["serve_p99_ms"] > serve_cap:
+        viol.append(f"server-side serve p99 {d['serve_p99_ms']}ms > "
+                    f"ceiling {serve_cap}ms")
     lag_cap = budget.get("max_replica_lag_checkpoints")
     if lag_cap is not None \
             and d["max_replica_lag_checkpoints"] > lag_cap:
         viol.append(f"replica lag {d['max_replica_lag_checkpoints']} "
                     f"checkpoints > ceiling {lag_cap} (the replica feed "
                     f"is not keeping up with the checkpoint stream)")
+    # hot-path non-interference, as a fraction of the unloaded run (the
+    # ISSUE-13 acceptance: under-load throughput >= 0.90 of unloaded)
+    frac_floor = budget.get("min_rps_under_load_frac")
+    if frac_floor is not None and not smoke \
+            and d["rps_under_load_frac"] < frac_floor:
+        viol.append(f"records/sec under query load is "
+                    f"{d['rps_under_load_frac']:.3f} of unloaded < floor "
+                    f"{frac_floor} (reads are taxing the hot path)")
+    # legacy absolute floor, honored when a budget still carries it
     rps_floor = budget.get("min_rps_under_load")
     if rps_floor is not None and not smoke \
             and d["records_per_sec_under_load"] < rps_floor:
@@ -1885,13 +2122,37 @@ def main():
                          "with --check gates against the BENCH_BUDGET.json "
                          "cep_cpu section")
     ap.add_argument("--queryable", action="store_true",
-                    help="standalone serving-tier workload (ISSUE-9): N "
-                         "pooled clients fire batched lookups (live + "
-                         "checkpoint consistency) over the TCP protocol "
-                         "against the running 1M-key window job; reports "
-                         "lookups/sec + p50/p99 + replica lag + the job's "
-                         "records/sec under query load; with --check "
+                    help="standalone serving-tier workload (ISSUE-13): "
+                         "--qps-clients pooled clients sustain "
+                         "--qps-target batched lookups/sec (live + "
+                         "checkpoint consistency) over the binary "
+                         "columnar wire with client-side key-group "
+                         "routing against the running 1M-key window job; "
+                         "reports lookups/sec + client p50/p99 + "
+                         "server-side serve p50/p99 + replica lag + the "
+                         "job's throughput under load; with --check "
                          "gates against BENCH_BUDGET.json queryable_cpu")
+    ap.add_argument("--qps-clients", type=int, default=0,
+                    help="--queryable client PROCESS count (0 = auto: 4 "
+                         "full, 2 smoke) — clients run out-of-process "
+                         "like production readers; only the server "
+                         "shares the job's process")
+    ap.add_argument("--qps-target", type=int, default=150_000,
+                    help="--queryable aggregate sustained lookups/sec "
+                         "target the client fleet paces itself to (0 = "
+                         "unthrottled max-rate mode)")
+    ap.add_argument("--qps-batch-keys", type=int, default=1024,
+                    help="--queryable keys per batched request")
+    ap.add_argument("--_qps-client", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--_qps-host", default="127.0.0.1",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--_qps-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--_qps-seed", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--_qps-interval-us", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--paging-cap", type=int, default=0,
                     help="also run one cold-key-paging pass (device tier, "
                          "K_cap=N < key count) and report rps + "
@@ -1916,6 +2177,11 @@ def main():
                          "heal/re-promote path end-to-end; exits nonzero "
                          "if the cycle or digest equality fails")
     args = ap.parse_args()
+
+    if getattr(args, "_qps_client"):
+        # hidden worker mode: one out-of-process queryable client of the
+        # --queryable bench (never imports jax — stays off the job's GIL)
+        sys.exit(_qps_client_main(args))
 
     if args.trace and (args.cep or args.queryable or args.mesh_devices
                        or args.config != 2 or args.inject_wedge

@@ -292,6 +292,14 @@ class _WorkerRuntime:
         self.sock.settimeout(None)
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
+        #: per-worker queryable serving (ISSUE-13): THIS worker's live
+        #: views + its own subtasks' replica shards behind a local
+        #: QueryableStateServer; the coordinator aggregates every
+        #: worker's (state -> subtasks -> endpoint) registration into the
+        #: routing table clients fan out on
+        self.qservice = None
+        self._q_states: Dict[str, Dict[str, Any]] = {}
+        self._q_acks: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self.tasks: List[Any] = []
         self._terminal = set()
         self._done_sent = False
@@ -337,6 +345,12 @@ class _WorkerRuntime:
             # restart restores from here without touching remote storage
             self.local_store.store(checkpoint_id, vertex_uid,
                                    subtask_index, snapshot)
+        if self.qservice is not None and any(
+                info["uid"] == vertex_uid for info in self._q_states.values()):
+            # stash for the worker-local replica tier: on notify-complete
+            # the stashed snapshots feed THIS worker's replica shards (the
+            # worker never sees the coordinator-assembled checkpoint)
+            self._q_acks[(vertex_uid, subtask_index)] = snapshot
         self._send(("ack", checkpoint_id, vertex_uid, subtask_index,
                     snapshot))
 
@@ -579,10 +593,82 @@ class _WorkerRuntime:
             if lat_ms and isinstance(t, SourceSubtask):
                 t.latency_marker_interval_ms = lat_ms
             t.start(snap)
+        if opts.get("queryable_serving", True):
+            self._wire_worker_queryable(plan, counts)
         if not self.tasks:
             self._done_sent = True
             self._send(("worker_done", self.index))
         return True
+
+    def _wire_worker_queryable(self, plan, counts: Dict[str, int]) -> None:
+        """Per-worker serving tier (ISSUE-13): front THIS worker's live
+        views and its own subtasks' checkpoint-replica shards behind a
+        local :class:`QueryableStateServer`, and register the (state ->
+        local subtasks -> endpoint) mapping with the coordinator — the
+        routing table clients use to skip the coordinator entirely.
+
+        Views register with the job's FULL parallelism (foreign subtasks
+        are None entries): routing geometry is global, ownership is
+        local.  Redeploys re-register wholesale; the server (and its
+        port) survives in-place recoveries, so only a worker PROCESS
+        restart moves an endpoint — the stale-map case the client's
+        evict-then-refresh retry handles."""
+        regs: Dict[str, Dict[str, Any]] = {}
+        max_par = {v.uid: v.max_parallelism for v in plan.vertices}
+        for t in self.tasks:
+            op = getattr(t, "operator", None)
+            for member in getattr(op, "operators", [op]):
+                qname = getattr(member, "queryable", None)
+                view = getattr(member, "queryable_view", lambda: None)()
+                if qname is None or view is None:
+                    continue
+                entry = regs.setdefault(qname, {
+                    "uid": t.vertex_uid, "op": member, "views": {}})
+                entry["views"][t.subtask_index] = view
+        if not regs:
+            return
+        from flink_tpu.queryable.replica import QueryableStateSpec
+        from flink_tpu.queryable.service import QueryableStateService
+        if self.qservice is None:
+            self.qservice = QueryableStateService()
+        advertise: Dict[str, Dict[str, Any]] = {}
+        for name, entry in regs.items():
+            uid = entry["uid"]
+            p = counts.get(uid, len(entry["views"]))
+            mp = max_par.get(uid, 128)
+            views = [entry["views"].get(i) for i in range(p)]
+            self.qservice.register_views(name, views, parallelism=p,
+                                         max_parallelism=mp)
+            if name not in self.qservice.registry.replicas():
+                self.qservice.add_replica(
+                    name, QueryableStateSpec.from_operator(
+                        name, uid, entry["op"]), max_parallelism=mp)
+            self._q_states[name] = {
+                "uid": uid, "parallelism": p, "max_parallelism": mp,
+                "subtasks": sorted(entry["views"])}
+            advertise[name] = dict(self._q_states[name])
+        server = self.qservice.start_server(host=self.server.host)
+        self._send(("qserve", self.index, advertise,
+                    self.advertise_host, server.port))
+
+    def _feed_worker_replicas(self, checkpoint_id: int) -> None:
+        """notify-complete -> feed this worker's replica shards from the
+        stashed ack snapshots: every queryable uid's assembled entry
+        carries the GLOBAL subtask list with only the local ones filled,
+        so the replica's routing parallelism matches the job while its
+        shards cover exactly this worker's key-group ranges."""
+        if self.qservice is None or not self._q_states:
+            return
+        assembled: Dict[str, Any] = {}
+        for info in self._q_states.values():
+            uid, p = info["uid"], info["parallelism"]
+            if uid in assembled:
+                continue
+            subs = [self._q_acks.get((uid, i)) for i in range(p)]
+            if any(s is not None for s in subs):
+                assembled[uid] = {"subtasks": subs}
+        if assembled:
+            self.qservice.on_checkpoint_complete(checkpoint_id, assembled)
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> int:
@@ -634,6 +720,7 @@ class _WorkerRuntime:
                     self.local_store.confirm(msg[1])
                 for t in self.tasks:
                     t.commands.put(("notify_complete", msg[1]))
+                self._feed_worker_replicas(msg[1])
             elif kind == "split_assign":
                 _, uid, idx, split, done = msg
                 q = self._split_queues.get((uid, idx))
@@ -727,6 +814,8 @@ class _WorkerRuntime:
             t.join(timeout_s=10)
         for w in self._remote_writers:
             w.close()
+        if self.qservice is not None:
+            self.qservice.close()
         self.server.stop()
         return 0
 
@@ -772,7 +861,8 @@ class ProcessCluster:
                  alignment_queue_max: int = 8192,
                  tracing: bool = False,
                  latency_interval_ms: Optional[int] = None,
-                 trace_capacity: int = 65536):
+                 trace_capacity: int = 65536,
+                 queryable_serving: bool = True):
         from flink_tpu.observability import tracing as tracing_mod
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureManager
@@ -787,7 +877,11 @@ class ProcessCluster:
                           "alignment_queue_max": alignment_queue_max,
                           "tracing": tracing,
                           "latency_interval_ms": latency_interval_ms,
-                          "trace_capacity": trace_capacity}
+                          "trace_capacity": trace_capacity,
+                          # per-worker serving (ISSUE-13): workers with
+                          # queryable operators stand up local servers and
+                          # register their endpoints here at deploy
+                          "queryable_serving": queryable_serving}
         #: end-to-end tracing: workers record spans locally; at job end
         #: the coordinator pulls every ring and assembles ONE merged
         #: timeline (result["trace"], also kept as self.last_trace)
@@ -894,6 +988,10 @@ class ProcessCluster:
         self._all_done = threading.Event()
         self._conns: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
+        #: per-worker serving registrations: state -> {uid, parallelism,
+        #: max_parallelism, endpoints: {subtask: (host, port)}} — the
+        #: routing table the coordinator's server advertises to clients
+        self._qserve_states: Dict[str, Dict[str, Any]] = {}
 
     # -- queryable serving tier -------------------------------------------
     def enable_queryable(self, name: str, uid: str, agg, key_column: str,
@@ -923,10 +1021,33 @@ class ProcessCluster:
         if self.queryable is None:
             from flink_tpu.queryable.service import QueryableStateService
             self.queryable = QueryableStateService()
-        return self.queryable.start_server(host=host, port=port)
+        server = self.queryable.start_server(host=host, port=port)
+        # replay the worker endpoint map collected so far: a client's
+        # {"routing": true} against this server routes live reads straight
+        # to the owning workers (the coordinator serves only the replica
+        # tier and the map itself)
+        with self._lock:
+            # copy the INNER endpoints dict too: the qserve handler keeps
+            # mutating the live one under this lock while the registry
+            # iterates the replayed copy under its own
+            snapshot = {name: {**info, "endpoints": dict(info["endpoints"])}
+                        for name, info in self._qserve_states.items()}
+        for name, info in snapshot.items():
+            self.queryable.set_state_endpoints(
+                name, info["endpoints"], parallelism=info["parallelism"],
+                max_parallelism=info["max_parallelism"])
+        return server
 
     def queryable_stats(self):
         return self.queryable.stats() if self.queryable is not None else None
+
+    def queryable_endpoints(self) -> Dict[str, Dict[int, Tuple[str, int]]]:
+        """state -> {subtask: (host, port)} as registered by the workers'
+        per-worker serving tiers (empty until a deploy with queryable
+        operators completes)."""
+        with self._lock:
+            return {name: dict(info["endpoints"])
+                    for name, info in self._qserve_states.items()}
 
     # -- cross-process trace assembly --------------------------------------
     def collect_trace(self, timeout_s: float = 15.0) -> Dict[str, Any]:
@@ -1551,6 +1672,33 @@ class ProcessCluster:
             elif kind == "recovery_stats":
                 with self._lock:
                     self.recovery_stats.append((msg[1], msg[2], msg[3]))
+            elif kind == "qserve":
+                # per-worker serving registration: merge this worker's
+                # (state -> local subtasks) at its advertised endpoint
+                # into the routing map (a respawned worker re-registers
+                # with its NEW port — stale client maps self-heal on
+                # their next refresh)
+                _, widx, advertise, host, port = msg
+                with self._lock:
+                    for name, info in advertise.items():
+                        entry = self._qserve_states.setdefault(
+                            name, {"uid": info["uid"],
+                                   "parallelism": info["parallelism"],
+                                   "max_parallelism":
+                                       info["max_parallelism"],
+                                   "endpoints": {}})
+                        entry["parallelism"] = info["parallelism"]
+                        entry["max_parallelism"] = info["max_parallelism"]
+                        entry["endpoints"].update(
+                            {int(i): (host, int(port))
+                             for i in info["subtasks"]})
+                if self.queryable is not None:
+                    for name, info in advertise.items():
+                        self.queryable.set_state_endpoints(
+                            name, {int(i): (host, int(port))
+                                   for i in info["subtasks"]},
+                            parallelism=info["parallelism"],
+                            max_parallelism=info["max_parallelism"])
             elif kind == "final":
                 _, uid, i, snap = msg
                 with self._lock:

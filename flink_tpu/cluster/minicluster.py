@@ -112,7 +112,8 @@ class MiniCluster(TaskListener):
                  alignment_timeout_ms: Optional[float] = None,
                  alignment_queue_max: Optional[int] = None,
                  latency_interval_ms: Optional[int] = None,
-                 tracing_enabled: Optional[bool] = None):
+                 tracing_enabled: Optional[bool] = None,
+                 queryable_replicas: int = 1):
         from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
                                                 NoRestartStrategy)
         from flink_tpu.config.options import (CheckpointingOptions,
@@ -165,6 +166,10 @@ class MiniCluster(TaskListener):
                 else CheckpointingOptions.ALIGNMENT_QUEUE_MAX.default)
         self.alignment_timeout_ms = alignment_timeout_ms
         self.alignment_queue_max = alignment_queue_max
+        #: queryable serving tier: N-replica read fan-out per state
+        #: (reads load-balance across the freshest members; a partitioned
+        #: member's traffic fails over to a sibling)
+        self.queryable_replicas = max(1, int(queryable_replicas))
         #: last completed checkpoint's alignment accounting (job_status()
         #: ["checkpoints"] + the lastCheckpoint* gauges)
         self._last_alignment: Dict[str, Any] = {
@@ -622,7 +627,8 @@ class MiniCluster(TaskListener):
                 self.queryable.add_replica(
                     name, QueryableStateSpec.from_operator(
                         name, entry["uid"], entry["op"]),
-                    max_parallelism=max_par.get(entry["uid"], 128))
+                    max_parallelism=max_par.get(entry["uid"], 128),
+                    replicas=self.queryable_replicas)
 
     def start_queryable_server(self, host: str = "127.0.0.1", port: int = 0):
         """Start (or return) the job's TCP queryable-state server

@@ -115,7 +115,10 @@ def _send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
     sock.sendall(_HDR.pack(ftype, len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes or return None on EOF — the shared socket
+    primitive of every framed protocol in the repo (data plane here, the
+    queryable serving tier's wire layer, the control planes)."""
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
@@ -123,6 +126,9 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
             return None
         buf += chunk
     return buf
+
+
+_recv_exact = recv_exact
 
 
 def _recv_frame(sock: socket.socket):

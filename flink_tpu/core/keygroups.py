@@ -159,3 +159,17 @@ def assign_key_to_parallel_operator(key_hashes: np.ndarray, max_parallelism: int
 
 def key_group_ranges(max_parallelism: int, parallelism: int) -> List[KeyGroupRange]:
     return [compute_key_group_range(max_parallelism, parallelism, i) for i in range(parallelism)]
+
+
+def route_raw_keys(keys: np.ndarray, parallelism: int,
+                   max_parallelism: int = 128) -> np.ndarray:
+    """RAW key column -> owning parallel-operator/shard index per key
+    (key hash -> murmur key group -> contiguous range): THE single
+    routing assignment shared by the record router, the queryable tier's
+    client-side routing (``queryable/view.route_keys``) and
+    ``ShardLayout.route_keys`` — one implementation so client routing can
+    never desynchronize from state ownership."""
+    if parallelism <= 1:
+        return np.zeros(len(keys), np.int32)
+    return assign_key_to_parallel_operator(hash_keys(np.asarray(keys)),
+                                           max_parallelism, parallelism)

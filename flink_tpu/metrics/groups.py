@@ -65,6 +65,12 @@ QUERYABLE_P50 = "queryable.lookup_p50_ms"
 QUERYABLE_P99 = "queryable.lookup_p99_ms"
 QUERYABLE_REPLICA_LAG_CHECKPOINTS = "queryable.replica_lag_checkpoints"
 QUERYABLE_REPLICA_LAG_MS = "queryable.replica_lag_ms"
+# server-side SERVICE time (lookup + serialization, measured in the TCP
+# handler) — the honest serve latency next to the client-side ring, whose
+# p99 on a GIL-loaded box measures the box, not the server
+QUERYABLE_SERVE_P50 = "queryable.serve_p50_ms"
+QUERYABLE_SERVE_P99 = "queryable.serve_p99_ms"
+QUERYABLE_CACHE_HIT_RATE = "queryable.cache_hit_rate"
 
 
 class MetricGroup:
@@ -295,6 +301,9 @@ def queryable_metrics(group: MetricGroup,
                       (QUERYABLE_QPS, "lookups_per_sec"),
                       (QUERYABLE_P50, "lookup_p50_ms"),
                       (QUERYABLE_P99, "lookup_p99_ms"),
+                      (QUERYABLE_SERVE_P50, "serve_p50_ms"),
+                      (QUERYABLE_SERVE_P99, "serve_p99_ms"),
+                      (QUERYABLE_CACHE_HIT_RATE, "cache_hit_rate"),
                       (QUERYABLE_REPLICA_LAG_CHECKPOINTS,
                        "replica_lag_checkpoints"),
                       (QUERYABLE_REPLICA_LAG_MS, "replica_lag_ms")):

@@ -1,5 +1,6 @@
+from flink_tpu.queryable.cache import HotKeyCache
 from flink_tpu.queryable.replica import (CheckpointReplica,
-                                         QueryableStateSpec)
+                                         QueryableStateSpec, ReplicaGroup)
 from flink_tpu.queryable.server import (KvStateRegistry, QueryableStateClient,
                                         QueryableStateClientPool,
                                         QueryableStateServer)
@@ -9,4 +10,5 @@ from flink_tpu.queryable.view import WindowReadView
 __all__ = ["KvStateRegistry", "QueryableStateClient",
            "QueryableStateClientPool", "QueryableStateServer",
            "QueryableStateService", "QueryableStateSpec",
-           "CheckpointReplica", "WindowReadView"]
+           "CheckpointReplica", "ReplicaGroup", "HotKeyCache",
+           "WindowReadView"]

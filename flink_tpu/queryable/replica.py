@@ -157,8 +157,12 @@ class CheckpointReplica:
     storage, the cross-process deployment)."""
 
     def __init__(self, spec: QueryableStateSpec, storage=None,
-                 poll_interval_s: float = 0.25, max_parallelism: int = 128):
+                 poll_interval_s: float = 0.25, max_parallelism: int = 128,
+                 name: Optional[str] = None):
         self.spec = spec
+        #: replica identity — distinguishes fan-out siblings in chaos
+        #: scoping (``Partition(replica=...)``) and in the staleness stats
+        self.name = name or spec.name
         self.storage = storage
         self.poll_interval_s = poll_interval_s
         self.max_parallelism = max_parallelism
@@ -188,6 +192,19 @@ class CheckpointReplica:
         (``{uid: {"subtasks": [...]}}``).  Returns False when the
         checkpoint carries no keyed state for the registered uid (e.g. a
         checkpoint taken before the operator saw data)."""
+        import time as _time
+
+        from flink_tpu.observability import tracing
+        t0 = _time.perf_counter_ns()
+        ok = self._ingest_assembled(checkpoint_id, assembled)
+        tracing.complete("queryable.replica_ingest", t0,
+                         _time.perf_counter_ns(), cat="queryable",
+                         replica=self.name, checkpoint=int(checkpoint_id),
+                         ingested=bool(ok))
+        return ok
+
+    def _ingest_assembled(self, checkpoint_id: int,
+                          assembled: Dict[str, Any]) -> bool:
         self.observe_completed(checkpoint_id)
         entry = assembled.get(self.spec.uid)
         if entry is None:
@@ -317,7 +334,8 @@ class CheckpointReplica:
             if self._serving_cid is not None and head <= self._serving_cid:
                 return False
         if not chaos.fire(REPLICA_FETCH_POINT, checkpoint_id=head,
-                          direction="storage->replica"):
+                          direction="storage->replica",
+                          replica=self.name):
             return False                 # partitioned: keep serving stale
         try:
             snap = self.storage.load(head)
@@ -367,6 +385,66 @@ class CheckpointReplica:
                     self._serve(shard, keys, pending, found, values)
         return found, values, self.tags()
 
+    @property
+    def epoch(self) -> Optional[int]:
+        """Content version for the hot-key response cache: the serving
+        checkpoint id (cache entries die the moment a newer checkpoint is
+        ingested — the invalidation contract)."""
+        return self._serving_cid
+
+    def lookup_batch_columnar(self, keys) -> Tuple[np.ndarray,
+                                                   Dict[str, np.ndarray],
+                                                   Dict[str, Any]]:
+        """Binary-wire twin of :meth:`lookup_batch`: dense result columns
+        gathered per shard with zero per-key Python objects."""
+        keys = coerce_keys(keys)
+        with self._lock:
+            shards = self._shards
+            parallelism = self._parallelism
+        n = len(keys)
+        found = np.zeros(n, bool)
+        cols: Dict[str, np.ndarray] = {}
+        if shards:
+            sliced = any(s.row_range is not None for s in shards)
+            if not sliced and parallelism > 1:
+                owner = route_keys(keys, parallelism, self.max_parallelism)
+                by_subtask = {s.index: s for s in shards}
+                for sub in np.unique(owner).tolist():
+                    shard = by_subtask.get(int(sub))
+                    if shard is None:
+                        continue
+                    sel = np.flatnonzero(owner == sub)
+                    self._serve_columnar(shard, keys, sel, found, cols)
+            else:
+                for shard in shards:
+                    pending = np.flatnonzero(~found)
+                    if pending.size == 0:
+                        break
+                    self._serve_columnar(shard, keys, pending, found, cols)
+        return found, cols, self.tags()
+
+    @staticmethod
+    def _serve_columnar(shard: ReplicaShard, keys: np.ndarray,
+                        sel: np.ndarray, found: np.ndarray,
+                        cols: Dict[str, np.ndarray]) -> None:
+        idx = shard.rows.locate(np.asarray(keys)[sel])
+        hit = idx >= 0
+        if not hit.any():
+            return
+        qsel = sel[hit]
+        rows = idx[hit]
+        n = len(keys)
+        for c, a in shard.rows.cols.items():
+            out = cols.get(c)
+            if out is None:
+                out = cols[c] = (np.empty(n, object)
+                                 if a.dtype.kind == "O"
+                                 else np.zeros(n, a.dtype))
+            got = a[rows]
+            out[qsel] = got if out.dtype == a.dtype \
+                else got.astype(out.dtype)
+        found[qsel] = True
+
     @staticmethod
     def _serve(shard: ReplicaShard, keys: np.ndarray, sel: np.ndarray,
                found: np.ndarray, values: List) -> None:
@@ -410,3 +488,113 @@ class CheckpointReplica:
                 "keys": sum(s.n_keys for s in self._shards),
                 "shards": [s.manifest() for s in self._shards],
             }
+
+
+class ReplicaGroup:
+    """N-replica read fan-out for ONE state (ISSUE-13): reads load-balance
+    across member :class:`CheckpointReplica` instances and always prefer
+    the FRESHEST members — a member partitioned from the checkpoint stream
+    (or simply behind) sees its traffic fail over to a sibling without a
+    single read error, and the staleness stats NAME the laggards so the
+    lag gauge points at the dead replica, not at an average.
+
+    The group answers the exact replica interface the registry, the feed
+    thread, and the wire layer already speak (``observe_completed`` /
+    ``ingest_assembled`` / ``lookup_batch[{_columnar}]`` / ``tags`` /
+    ``stats`` / ``start_tailing`` / ``stop``), so one registered entry is
+    transparently one replica or N."""
+
+    def __init__(self, members: List[CheckpointReplica]):
+        if not members:
+            raise ValueError("ReplicaGroup needs at least one member")
+        self.members = list(members)
+        # member names must be unique: the stats/laggards surface is
+        # name-keyed, and chaos scoping (Partition(replica=...)) targets
+        # by name — suffix duplicates (the CheckpointReplica default name
+        # is the state name for every member)
+        seen: Dict[str, int] = {}
+        for m in self.members:
+            n = seen.get(m.name, 0)
+            seen[m.name] = n + 1
+            if n:
+                m.name = f"{m.name}#r{n}"
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    @property
+    def spec(self):
+        return self.members[0].spec
+
+    # ---------------------------------------------------------------- feed
+    def observe_completed(self, checkpoint_id: int) -> None:
+        for m in self.members:
+            m.observe_completed(checkpoint_id)
+
+    def ingest_assembled(self, checkpoint_id: int,
+                         assembled: Dict[str, Any]) -> bool:
+        ok = False
+        for m in self.members:
+            ok = m.ingest_assembled(checkpoint_id, assembled) or ok
+        return ok
+
+    def start_tailing(self) -> "ReplicaGroup":
+        for m in self.members:
+            m.start_tailing()
+        return self
+
+    def stop(self) -> None:
+        for m in self.members:
+            m.stop()
+
+    # -------------------------------------------------------------- queries
+    def _pick(self) -> CheckpointReplica:
+        """Freshest-first load balancing: candidates are the members
+        serving the newest checkpoint id (None = never ingested sorts
+        last); ties rotate round-robin so read load spreads evenly across
+        the healthy siblings."""
+        best: List[CheckpointReplica] = []
+        best_cid = None
+        for m in self.members:
+            cid = m.epoch
+            rank = -1 if cid is None else int(cid)
+            if best_cid is None or rank > best_cid:
+                best_cid, best = rank, [m]
+            elif rank == best_cid:
+                best.append(m)
+        with self._lock:
+            self._rr += 1
+            return best[self._rr % len(best)]
+
+    @property
+    def epoch(self) -> Optional[int]:
+        return self._pick_epoch()
+
+    def _pick_epoch(self) -> Optional[int]:
+        cids = [m.epoch for m in self.members if m.epoch is not None]
+        return max(cids) if cids else None
+
+    def lookup_batch(self, keys):
+        return self._pick().lookup_batch(keys)
+
+    def lookup_batch_columnar(self, keys):
+        return self._pick().lookup_batch_columnar(keys)
+
+    def tags(self) -> Dict[str, Any]:
+        return self._pick().tags()
+
+    # -------------------------------------------------------------- surface
+    def stats(self) -> Dict[str, Any]:
+        """The freshest member's serving view (what reads actually see),
+        plus per-member staleness and the NAMES of the members lagging
+        behind it — the failover observability contract."""
+        per = {m.name: m.stats() for m in self.members}
+        head = self._pick_epoch()
+        laggards = sorted(
+            m.name for m in self.members
+            if head is not None and (m.epoch is None or m.epoch < head))
+        serving = self._pick().stats()
+        out = dict(serving)
+        out["replicas"] = len(self.members)
+        out["members"] = per
+        out["laggards"] = laggards
+        return out

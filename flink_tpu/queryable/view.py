@@ -89,6 +89,13 @@ class WindowReadView:
         self._segments: Tuple[_Segment, ...] = ()
         self.published_windows = 0
 
+    @property
+    def epoch(self) -> int:
+        """Monotone content version: bumps on every publish.  The hot-key
+        response cache keys its live entries on this (checkpoint-replica
+        entries key on the serving checkpoint id)."""
+        return self.published_windows
+
     # ----------------------------------------------------------- task thread
     def publish(self, keys: np.ndarray, cols: Dict[str, Any], window,
                 watermark: int, checkpoint_id: Optional[int]) -> None:
@@ -108,8 +115,12 @@ class WindowReadView:
             if len(starts) > self.retain_windows:
                 break
             keep.append(s)
-        self.published_windows += 1
         self._segments = tuple(keep)   # atomic swap
+        # epoch bumps AFTER the swap: a cached lookup racing publish may
+        # memoize the old segments under the old epoch (correct — the
+        # next epoch read invalidates it), never old data under the new
+        # epoch (which nothing would ever invalidate)
+        self.published_windows += 1
 
     # ---------------------------------------------------------- query threads
     def tags(self) -> Dict[str, Any]:
@@ -151,6 +162,49 @@ class WindowReadView:
             remaining = remaining[~hit]
         return found, values, self.tags()
 
+    def lookup_batch_columnar(self, keys: np.ndarray
+                              ) -> Tuple[np.ndarray, Dict[str, np.ndarray],
+                                         Dict[str, Any]]:
+        """The binary-wire fast path: (found mask, dense result columns,
+        tags) with ZERO per-key Python objects — each segment's hits are
+        gathered with one fancy-index per column.  Unfound rows are
+        zero filler (the wire ships the found plane alongside).  Window
+        bounds ride as two extra int64 columns so the answer carries the
+        same information as the dict path's per-key values."""
+        segs = self._segments
+        keys = np.asarray(keys)
+        n = len(keys)
+        found = np.zeros(n, bool)
+        cols: Dict[str, np.ndarray] = {}
+        remaining = np.arange(n)
+        for seg in segs:
+            if remaining.size == 0:
+                break
+            idx = seg.locate(keys[remaining])
+            hit = idx >= 0
+            if not hit.any():
+                continue
+            qsel = remaining[hit]
+            rows = idx[hit]
+            if not cols:
+                for c, a in seg.cols.items():
+                    cols[c] = (np.empty(n, object) if a.dtype.kind == "O"
+                               else np.zeros(n, a.dtype))
+                cols["window_start"] = np.zeros(n, np.int64)
+                cols["window_end"] = np.zeros(n, np.int64)
+            for c, a in seg.cols.items():
+                out = cols.get(c)
+                if out is None:
+                    continue
+                got = a[rows]
+                out[qsel] = got if out.dtype == a.dtype \
+                    else got.astype(out.dtype)
+            cols["window_start"][qsel] = seg.window_start
+            cols["window_end"][qsel] = seg.window_end
+            found[qsel] = True
+            remaining = remaining[~hit]
+        return found, cols, self.tags()
+
 
 def plain(v):
     """numpy scalar/array -> JSON-serializable python value (the one
@@ -184,13 +238,9 @@ def coerce_keys(keys) -> np.ndarray:
 
 def route_keys(keys: np.ndarray, parallelism: int,
                max_parallelism: int) -> np.ndarray:
-    """Owning subtask per key — EXACTLY the record route: key hash ->
-    murmur key group -> contiguous key-group range (``core/keygroups``).
-    A query for key k lands on the operator instance whose state holds k
-    because both sides run the same assignment."""
-    from flink_tpu.core import keygroups
-    if parallelism <= 1:
-        return np.zeros(len(keys), np.int32)
-    hashes = keygroups.hash_keys(np.asarray(keys))
-    return keygroups.assign_key_to_parallel_operator(
-        hashes, max_parallelism, parallelism)
+    """Owning subtask per key — EXACTLY the record route (one shared
+    implementation: ``core/keygroups.route_raw_keys``).  A query for key
+    k lands on the operator instance whose state holds k because both
+    sides run the same assignment."""
+    from flink_tpu.core.keygroups import route_raw_keys
+    return route_raw_keys(keys, parallelism, max_parallelism)

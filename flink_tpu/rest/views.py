@@ -202,31 +202,48 @@ def queryable_html(stats: Dict[str, Any]) -> str:
     device-health panel."""
     per_state = stats.get("per_state", {})
     lag = stats.get("replica_lag_checkpoints", 0)
+    protocols = stats.get("protocols") or {}
     head = (f'<div class="qs-summary" '
             f'data-lookups="{_esc(stats.get("lookups_total", 0))}" '
+            f'data-serve-p99="{_esc(stats.get("serve_p99_ms"))}" '
+            f'data-cache-hit-rate='
+            f'"{_esc(stats.get("cache_hit_rate", 0))}" '
             f'data-replica-lag="{_esc(lag)}">'
             f'lookups {_esc(stats.get("lookups_total", 0))} · '
             f'{_esc(stats.get("lookups_per_sec", 0))}/s · '
-            f'p99 {_esc(stats.get("lookup_p99_ms"))} ms · '
+            # both latency readings, labelled: the SERVER-side service
+            # time (lookup + serialization in the handler) is the honest
+            # serve cost; the lookup p99 excludes serialization
+            f'serve p99 {_esc(stats.get("serve_p99_ms"))} ms '
+            f'(server-side) · '
+            f'lookup p99 {_esc(stats.get("lookup_p99_ms"))} ms · '
+            f'binary {_esc(protocols.get("binary", 0))} / '
+            f'json {_esc(protocols.get("json", 0))} · '
+            f'cache hit {_esc(stats.get("cache_hit_rate", 0))} · '
             f'replica lag {_esc(lag)} ckpts / '
             f'{_esc(stats.get("replica_lag_ms", 0))} ms</div>')
     rows = []
     for name in sorted(per_state):
         s = per_state[name]
         rep = s.get("replica", {})
+        laggards = ",".join(rep.get("laggards", [])) or "-"
         rows.append(
-            f'<tr class="qs-row" data-state="{_esc(name)}">'
+            f'<tr class="qs-row" data-state="{_esc(name)}" '
+            f'data-laggards="{_esc(laggards)}">'
             f'<td>{_esc(name)}</td>'
             f'<td>{_esc(s.get("lookups", 0))}</td>'
             f'<td>{_esc(s.get("lookup_p50_ms"))}</td>'
             f'<td>{_esc(s.get("lookup_p99_ms"))}</td>'
             f'<td>{_esc(rep.get("serving_checkpoint_id"))}</td>'
             f'<td>{_esc(rep.get("replica_lag_checkpoints", 0))}</td>'
+            f'<td>{_esc(rep.get("replicas", 1))}</td>'
+            f'<td>{_esc(laggards)}</td>'
             f'<td>{_esc(len(rep.get("shards", [])))}</td></tr>')
     return (f'<div class="qs-panel">{head}'
             f'<table class="qs-table"><thead><tr><th>state</th>'
             f'<th>lookups</th><th>p50 ms</th><th>p99 ms</th>'
-            f'<th>serving ckpt</th><th>lag</th><th>shards</th>'
+            f'<th>serving ckpt</th><th>lag</th><th>replicas</th>'
+            f'<th>laggards</th><th>shards</th>'
             f'</tr></thead><tbody>' + "".join(rows)
             + "</tbody></table></div>")
 

@@ -79,6 +79,17 @@ class ShardLayout:
         r = keygroups.key_group_ranges(max_parallelism, self.n_shards)[shard]
         return int(r.start), int(r.end)
 
+    def route_keys(self, keys: np.ndarray,
+                   max_parallelism: int = 128) -> np.ndarray:
+        """Owning shard per RAW key — the record route (key hash -> murmur
+        key group -> contiguous range), the SAME implementation the
+        queryable tier's client-side routing uses
+        (``core/keygroups.route_raw_keys``): a client that partitions a
+        lookup batch with this function lands every key on the server
+        that owns its state."""
+        from flink_tpu.core.keygroups import route_raw_keys
+        return route_raw_keys(keys, self.n_shards, max_parallelism)
+
 
 def split_to_shard_slices(snap: Dict[str, Any], layout: ShardLayout,
                           max_parallelism: int = 128) -> Dict[str, Any]:

@@ -245,18 +245,27 @@ class Partition(FaultSchedule):
     else (the opposite direction, or callers that pass no direction)
     proceeds without even advancing the schedule's counter.  The classic
     one-way-partition false suspect: A's messages to B blackhole while
-    B→A flows."""
+    B→A flows.
+
+    ``replica`` scopes the partition to ONE queryable read replica (the
+    fan-out siblings fire the same point with ``replica=<name>`` context):
+    only the named replica's fetches blackhole — the failover nemesis that
+    proves reads continue via the siblings."""
 
     def __init__(self, active: bool = True,
-                 direction: Optional[str] = None):
+                 direction: Optional[str] = None,
+                 replica: Optional[str] = None):
         self.direction = direction
+        self.replica = replica
         self._active = threading.Event()
         if active:
             self._active.set()
 
     def matches(self, ctx: Dict) -> bool:
-        return self.direction is None or ctx.get("direction") == \
-            self.direction
+        return (self.direction is None
+                or ctx.get("direction") == self.direction) \
+            and (self.replica is None
+                 or ctx.get("replica") == self.replica)
 
     def partition(self) -> None:
         self._active.set()

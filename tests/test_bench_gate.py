@@ -263,21 +263,26 @@ def test_cep_bench_smoke_passes_gate():
     assert d["degraded"] == 0
 
 
-def _queryable_result(qps=8000.0, p99=400.0, lag=1, rps_load=1_900_000.0,
-                      live_eq=True, errors=0):
+def _queryable_result(qps=148_000.0, p99=400.0, lag=1,
+                      load_frac=0.94, live_eq=True, bin_eq=True,
+                      errors=0, serve_p99=50.0):
     return {"value": qps,
             "details": {"lookups_per_sec": qps, "lookup_p50_ms": 4.5,
                         "lookup_p99_ms": p99,
+                        "serve_p50_ms": 2.0, "serve_p99_ms": serve_p99,
+                        "protocol": "binary", "routing": "client",
                         "max_replica_lag_checkpoints": lag,
-                        "records_per_sec_under_load": rps_load,
+                        "records_per_sec_under_load": 14_000_000.0,
+                        "rps_under_load_frac": load_frac,
                         "live_equality_ok": live_eq,
+                        "binary_json_equal_ok": bin_eq,
                         "lookup_errors": errors}}
 
 
 def _queryable_budget():
-    return {"min_lookups_per_sec": 2000, "max_p99_ms": 2500,
+    return {"min_lookups_per_sec": 100_000, "max_p99_ms": 2500,
             "max_replica_lag_checkpoints": 3,
-            "min_rps_under_load": 500_000}
+            "min_rps_under_load_frac": 0.90}
 
 
 def test_check_queryable_budget_pass():
@@ -295,10 +300,10 @@ def test_check_queryable_budget_floors_full_only():
     assert len(viol) == 1 and "lookups/sec" in viol[0]
     assert check_queryable_budget(_queryable_result(qps=100.0),
                                   _queryable_budget(), smoke=True) == []
-    viol = check_queryable_budget(_queryable_result(rps_load=100_000.0),
+    viol = check_queryable_budget(_queryable_result(load_frac=0.7),
                                   _queryable_budget())
-    assert len(viol) == 1 and "stealing the hot path" in viol[0]
-    assert check_queryable_budget(_queryable_result(rps_load=100_000.0),
+    assert len(viol) == 1 and "taxing the hot path" in viol[0]
+    assert check_queryable_budget(_queryable_result(load_frac=0.7),
                                   _queryable_budget(), smoke=True) == []
 
 
@@ -322,6 +327,15 @@ def test_check_queryable_budget_equality_and_errors_always_gate():
     viol = check_queryable_budget(_queryable_result(errors=3),
                                   _queryable_budget(), smoke=True)
     assert any("failed" in v for v in viol)
+    # binary==JSON answer equality gates unconditionally too (ISSUE-13)
+    viol = check_queryable_budget(_queryable_result(bin_eq=False),
+                                  _queryable_budget(), smoke=True)
+    assert any("binary" in v for v in viol)
+    # an optional server-side serve-p99 ceiling is honored when present
+    viol = check_queryable_budget(
+        _queryable_result(serve_p99=9_000.0),
+        {**_queryable_budget(), "max_serve_p99_ms": 1000}, smoke=True)
+    assert any("serve p99" in v for v in viol)
 
 
 def test_queryable_bench_smoke_passes_gate():
@@ -338,8 +352,11 @@ def test_queryable_bench_smoke_passes_gate():
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     d = result["details"]
     assert result["ok"] and d["live_equality_ok"]
+    assert d["binary_json_equal_ok"]
     assert d["lookup_errors"] == 0
     assert d["lookups"] > 0
+    assert d["protocol"] == "binary" and d["routing"] == "client"
+    assert d["serve_p99_ms"] is not None
     assert d["checkpoints_fed"] >= 1
     assert d["records_per_sec_under_load"] > 0
 
@@ -533,10 +550,10 @@ def test_budget_file_shape():
     assert "probe_mirror" in mesh["max_phase_ms"]
     # the serving-tier gate (bench.py --queryable --check)
     qs = budget["queryable_cpu"]
-    assert qs["min_lookups_per_sec"] > 0
+    assert qs["min_lookups_per_sec"] >= 100_000    # the ISSUE-13 floor
     assert qs["max_p99_ms"] > 0
     assert qs["max_replica_lag_checkpoints"] >= 1
-    assert qs["min_rps_under_load"] > 0
+    assert 0.90 <= qs["min_rps_under_load_frac"] < 1.0
     # the vectorized-CEP gate (bench.py --cep --check)
     cep = budget["cep_cpu"]
     assert cep["min_matches_per_sec"] > 0

@@ -174,6 +174,27 @@ def test_queryable_suite_collects_under_tier1():
          f"tier's read-path coverage left the gate")
 
 
+def test_queryable_scale_suite_collects_under_tier1():
+    """The production-QPS serving suite (ISSUE-13) must contribute tests
+    to the tier-1 run under ``JAX_PLATFORMS=cpu`` — binary codec
+    round-trips, routing-table correctness, cache invalidation,
+    per-worker serving e2e and protocol negotiation all run on the CPU
+    backend, so a slow-mark sweep that silently drops them fails here."""
+    import subprocess
+
+    f = "test_queryable_scale.py"
+    assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", str(TESTS / f)],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"{f}::" in proc.stdout, \
+        (f"{f} contributes no tests to the tier-1 selection — the "
+         f"production-QPS read-path coverage left the gate")
+
+
 def test_tracing_suite_collects_under_tier1():
     """The end-to-end tracing suite (ISSUE-10) must contribute tests to
     the tier-1 run under ``JAX_PLATFORMS=cpu`` — span-journal semantics,

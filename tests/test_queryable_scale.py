@@ -1,0 +1,656 @@
+"""Production-QPS serving tier (ISSUE-13): binary columnar wire protocol,
+client-side key-group routing, hot-key response caching, N-replica
+fan-out, and per-worker serving in ProcessCluster.
+
+The PR-9 suite (test_queryable_serving.py) covers the read tiers'
+semantics; THIS suite covers the throughput rebuild on top of them —
+codec round trips at the dtype edges, routing-table agreement with the
+operators' own key-group assignment, cache invalidation on checkpoint
+complete, protocol negotiation between old and new peers, replica
+failover under a scoped partition, and the stale-endpoint-map retry the
+routed client self-heals with.
+"""
+
+import json
+import socket
+import socketserver
+import struct
+import sys
+import textwrap
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.batch import RecordBatch, Watermark
+from flink_tpu.core.functions import RuntimeContext, SumAggregator
+from flink_tpu.operators.window_agg import WindowAggOperator
+from flink_tpu.queryable import (QueryableStateClientPool,
+                                 QueryableStateService, QueryableStateSpec,
+                                 WindowReadView, wire)
+from flink_tpu.queryable.replica import (REPLICA_FETCH_POINT,
+                                         CheckpointReplica, ReplicaGroup)
+from flink_tpu.queryable.view import route_keys
+from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+from flink_tpu.state.shard_layout import ShardLayout
+from flink_tpu.testing import chaos
+from flink_tpu.testing.chaos import FaultInjector, Partition
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+WINDOW_MS = 1000
+
+_LEN = struct.Struct("<I")
+
+
+# ---------------------------------------------------------------------------
+# helpers (the PR-9 suite's drain/expect idiom)
+# ---------------------------------------------------------------------------
+
+def _build_op(queryable="agg", **kw):
+    kw.setdefault("snapshot_source", "mirror")
+    op = WindowAggOperator(TumblingEventTimeWindows.of(WINDOW_MS),
+                           SumAggregator(jnp.float32), key_column="k",
+                           value_column="v", emit_tier="host",
+                           queryable=queryable, **kw)
+    op.open(RuntimeContext())
+    return op
+
+
+def _batches(n=6, b=512, keys=61, seed=9, t0=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        k = rng.integers(0, keys, b)
+        v = rng.integers(1, 8, b).astype(np.float32)
+        ts = t0 + i * (WINDOW_MS // 2) + np.sort(
+            rng.integers(0, WINDOW_MS // 2, b)).astype(np.int64)
+        out.append((k, v, ts))
+    return out
+
+
+def _drain(op, batches):
+    out = []
+    for k, v, ts in batches:
+        out += op.process_batch(RecordBatch({"k": k, "v": v},
+                                            timestamps=ts))
+        out += op.process_watermark(Watermark(int(ts.max()) - 1))
+    return out
+
+
+def _assembled_from(op, cid, uid="win"):
+    op.prepare_snapshot_pre_barrier()
+    return {uid: {"subtasks": [{"operator": {"op0": op.snapshot_state()}}]},
+            "__job__": {"checkpoint_id": cid}}
+
+
+def _expected_sums(batches):
+    exp = {}
+    for k, v, _ts in batches:
+        for kk, vv in zip(k.tolist(), v.tolist()):
+            exp[kk] = exp.get(kk, 0.0) + vv
+    return exp
+
+
+# ---------------------------------------------------------------------------
+# binary columnar codec
+# ---------------------------------------------------------------------------
+
+def test_wire_codec_round_trip_edge_values():
+    """NaN/±inf float payloads and int64 extremes must survive the wire
+    bit-exactly — raw column bytes, not a decimal text path."""
+    found = np.array([1, 0, 1, 1, 1], bool)
+    i64 = np.array([np.iinfo(np.int64).min, 0, -1,
+                    np.iinfo(np.int64).max, 7], np.int64)
+    f64 = np.array([float("nan"), 0.0, float("inf"),
+                    float("-inf"), -0.0], np.float64)
+    f32 = np.array([1.5, 2.5, 3.5, 4.5, 5.5], np.float32)
+    obj = np.array(["a", None, "c", "", "e"], object)
+    tags = {"consistency": "checkpoint", "checkpoint_id": 12,
+            "replica_lag_checkpoints": 0}
+    payload = wire.encode_response(
+        found, {"cnt": i64, "val": f64, "f": f32, "tag": obj}, tags)
+    assert wire.is_binary(payload)
+    f2, cols, t2 = wire.decode_response(payload)
+    assert f2.tolist() == found.tolist()
+    assert t2 == tags
+    assert cols["cnt"].dtype == np.int64
+    assert cols["cnt"].tolist() == i64.tolist()
+    # bit-exact floats: compare raw bytes (NaN != NaN)
+    assert cols["val"].tobytes() == f64.tobytes()
+    assert np.signbit(cols["val"][4])          # -0.0 preserved
+    assert cols["f"].dtype == np.float32
+    assert cols["f"].tolist() == f32.tolist()
+    assert cols["tag"].tolist() == obj.tolist()
+
+
+def test_wire_request_round_trip_and_negotiation():
+    req = wire.encode_request("agg", np.arange(9, dtype=np.int64),
+                              "checkpoint")
+    assert wire.is_binary(req)
+    state, keys, cons = wire.decode_request(req)
+    assert state == "agg" and cons == "checkpoint"
+    assert isinstance(keys, np.ndarray) and keys.dtype == np.int64
+    # python int lists take the raw-int64 fast path too
+    _s, k2, _c = wire.decode_request(
+        wire.encode_request("agg", [5, 6, 7], "live"))
+    assert isinstance(k2, np.ndarray) and k2.tolist() == [5, 6, 7]
+    # object keys ride as JSON
+    _s, k3, _c = wire.decode_request(
+        wire.encode_request("agg", ["x", 3, True], "live"))
+    assert k3 == ["x", 3, True]
+    # a JSON request can never read as binary (0xFB is not valid JSON)
+    assert not wire.is_binary(json.dumps({"state": "agg"}).encode())
+    # unknown versions fail loudly, never silently misparse
+    bad = bytearray(req)
+    bad[1] = 99
+    with pytest.raises(wire.WireError):
+        wire.decode_request(bytes(bad))
+    with pytest.raises(RuntimeError, match="boom"):
+        wire.decode_response(wire.encode_error("boom"))
+
+
+def test_columnar_lookup_equals_dict_lookup():
+    """The two encodings of one contract: the columnar path's answers,
+    converted back to per-key dicts, must equal the dict path's."""
+    op = _build_op()
+    batches = _batches()
+    _drain(op, batches)
+    view = op.queryable_view()
+    rng = np.random.default_rng(3)
+    q = rng.integers(0, 80, 64).astype(np.int64)     # some keys missing
+    f_d, v_d, t_d = view.lookup_batch(q)
+    f_c, cols, t_c = view.lookup_batch_columnar(q)
+    assert f_c.tolist() == f_d.tolist()
+    assert t_c == t_d
+    assert wire.values_from_columnar(f_c, cols) == v_d
+    # replica twin
+    rep = CheckpointReplica(QueryableStateSpec("agg", "win", "k", op.agg))
+    assert rep.ingest_assembled(1, _assembled_from(op, 1))
+    f_d, v_d, _ = rep.lookup_batch(q)
+    f_c, cols, _ = rep.lookup_batch_columnar(q)
+    assert f_c.tolist() == f_d.tolist()
+    assert wire.values_from_columnar(f_c, cols) == v_d
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+def test_routing_table_matches_shard_layout_route_keys():
+    """One assignment, three call sites: the client's batch partitioning,
+    the view's per-subtask routing, and ``ShardLayout.route_keys`` must
+    agree key for key — otherwise a routed lookup lands on a server that
+    does not own the key's state."""
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 1 << 30, 4096).astype(np.int64)
+    for p in (1, 2, 3, 4, 7):
+        layout = ShardLayout(n_shards=p, K=p * 8)
+        a = layout.route_keys(keys, max_parallelism=128)
+        b = route_keys(keys, p, 128)
+        assert (a == b).all(), f"parallelism {p}"
+
+
+def test_client_fanout_covers_every_key_exactly_once():
+    svc = QueryableStateService()
+    views = [WindowReadView("k") for _ in range(3)]
+    svc.register_views("agg", views, 3, 128)
+    server = svc.start_server()
+    try:
+        pool = QueryableStateClientPool(server.host, server.port,
+                                        protocol="binary", routing=True)
+        keys = np.arange(333, dtype=np.int64)
+        groups = pool._split_by_endpoint("agg", keys)
+        assert groups is not None
+        seen = np.concatenate(list(groups.values()))
+        assert sorted(seen.tolist()) == list(range(333))
+        owner = route_keys(keys, 3, 128)
+        for _ep, sel in groups.items():
+            subs = set(owner[sel].tolist())
+            # every endpoint group is a union of whole subtasks
+            for s in subs:
+                assert set(np.flatnonzero(owner == s).tolist()) \
+                    <= set(sel.tolist())
+        pool.close()
+    finally:
+        svc.close()
+
+
+def test_per_subtask_registry_skips_foreign_views():
+    """A per-worker registry holds only its own subtasks' views (None
+    elsewhere): lookups answer local keys and leave foreign keys
+    not-found instead of crashing."""
+    op = _build_op()
+    _drain(op, _batches())
+    view = op.queryable_view()
+    svc = QueryableStateService()
+    svc.register_views("agg", [view, None], 2, 128)
+    keys = np.arange(61, dtype=np.int64)
+    owner = route_keys(keys, 2, 128)
+    status, got = svc.lookup_batch("agg", keys.tolist())
+    assert status == "ok"
+    for i, sub in enumerate(owner.tolist()):
+        if sub == 1:
+            assert not got["found"][i]       # foreign subtask: not here
+
+
+# ---------------------------------------------------------------------------
+# hot-key response cache
+# ---------------------------------------------------------------------------
+
+def test_cache_invalidation_on_checkpoint_complete():
+    """A cached answer row dies the moment a newer checkpoint is
+    ingested: the second read of a hot key after an ingest must return
+    the NEW value, and the cache must count the invalidation."""
+    op = _build_op(allowed_lateness_ms=60_000)
+    b1 = _batches(n=3, seed=20)
+    _drain(op, b1)
+    svc = QueryableStateService()
+    svc.add_replica("agg", QueryableStateSpec("agg", "win", "k", op.agg))
+    svc.on_checkpoint_complete(1, _assembled_from(op, 1))
+    assert svc.drain_feed()
+    exp1 = _expected_sums(b1)
+    key = sorted(exp1)[0]
+    _status, got1 = svc.lookup_batch("agg", [key], "checkpoint")
+    assert got1["found"][0]
+    v1 = got1["values"][0]["result"]
+    _status, got1b = svc.lookup_batch("agg", [key], "checkpoint")
+    assert got1b["values"][0]["result"] == v1
+    assert svc.cache.hits >= 1                 # second read was cached
+    # new data (later windows) + new checkpoint -> the cached row
+    # must NOT survive
+    b2 = _batches(n=3, seed=21, t0=10_000)
+    _drain(op, b2)
+    svc.on_checkpoint_complete(2, _assembled_from(op, 2))
+    assert svc.drain_feed()
+    _status, got2 = svc.lookup_batch("agg", [key], "checkpoint")
+    exp_all = _expected_sums(b1 + b2)
+    assert abs(got2["values"][0]["result"] - exp_all[key]) \
+        <= 2e-2 + 1e-4 * abs(exp_all[key])
+    assert got2["values"][0]["result"] != v1 or exp_all[key] == exp1[key]
+    assert svc.cache.invalidations >= 1
+    assert svc.stats()["cache"]["entries"] >= 1
+
+
+def test_cache_invalidation_on_live_publish():
+    op = _build_op()
+    b1 = _batches(n=2, seed=30)
+    _drain(op, b1)
+    svc = QueryableStateService()
+    svc.register_views("agg", [op.queryable_view()], 1, 128)
+    key = int(b1[0][0][0])
+    _s, got1 = svc.lookup_batch("agg", [key], "live")
+    _s, got1b = svc.lookup_batch("agg", [key], "live")
+    assert got1b["values"] == got1["values"]
+    hits_before = svc.cache.hits
+    assert hits_before >= 1
+    # another fired window bumps the view epoch: cache re-misses
+    _drain(op, _batches(n=2, seed=31, t0=10_000))
+    _s, got2 = svc.lookup_batch("agg", [key], "live")
+    assert svc.cache.invalidations >= 1
+    assert got2["found"][0]
+
+
+# ---------------------------------------------------------------------------
+# protocol negotiation (mixed old/new peers)
+# ---------------------------------------------------------------------------
+
+class _Pr9JsonOnlyServer:
+    """A PR-9-era server: length-prefixed JSON only — a binary frame
+    reads as malformed.  The negotiation target for new clients."""
+
+    def __init__(self, registry):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        hdr = self._recv(_LEN.size)
+                        if hdr is None:
+                            return
+                        (n,) = _LEN.unpack(hdr)
+                        payload = self._recv(n)
+                        if payload is None:
+                            return
+                        try:
+                            req = json.loads(payload)
+                            resp = registry.lookup_batch(
+                                req["state"], req["keys"],
+                                req.get("consistency", "live"))
+                        except (ValueError, TypeError, KeyError,
+                                UnicodeDecodeError):
+                            resp = ("err", "malformed request")
+                        data = json.dumps(
+                            resp, default=outer._safe).encode()
+                        self.request.sendall(_LEN.pack(len(data)) + data)
+                except (ConnectionError, OSError):
+                    return
+
+            def _recv(self, n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = self.request.recv(n - len(buf))
+                    if not chunk:
+                        return None
+                    buf += chunk
+                return buf
+
+        self._srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                                    Handler)
+        self._srv.daemon_threads = True
+        self.host, self.port = self._srv.server_address
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    @staticmethod
+    def _safe(v):
+        return v.item() if isinstance(v, np.generic) else (
+            v.tolist() if isinstance(v, np.ndarray) else str(v))
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_protocol_negotiation_mixed_old_new():
+    """Old JSON client against the new server AND new auto client against
+    an old JSON-only server: both keep working, and both return the same
+    answers the binary path returns."""
+    op = _build_op()
+    _drain(op, _batches())
+    svc = QueryableStateService()
+    svc.register_views("agg", [op.queryable_view()], 1, 128)
+    new_server = svc.start_server()
+    old_server = _Pr9JsonOnlyServer(svc.registry)
+    keys = np.arange(40, dtype=np.int64)
+    try:
+        # new client, binary, new server: the reference answer
+        bpool = QueryableStateClientPool(new_server.host, new_server.port,
+                                         protocol="binary")
+        bf, bc, _bt = bpool.get_batch_columnar("agg", keys)
+        ref = {"found": bf.tolist(),
+               "values": wire.values_from_columnar(bf, bc)}
+        # old client (pure JSON), new server
+        jpool = QueryableStateClientPool(new_server.host, new_server.port)
+        jgot = jpool.get_batch("agg", keys.tolist())
+        assert jgot["found"] == ref["found"]
+        assert jgot["values"] == ref["values"]
+        # new auto client, OLD server: negotiates down to JSON
+        apool = QueryableStateClientPool(old_server.host, old_server.port,
+                                         protocol="auto")
+        af, ac, _at = apool.get_batch_columnar("agg", keys)
+        assert apool.stats["json_fallbacks"] >= 1
+        assert af.tolist() == ref["found"]
+        assert wire.values_from_columnar(af, ac) == ref["values"]
+        # forced-binary client against the old server fails LOUDLY
+        fpool = QueryableStateClientPool(old_server.host, old_server.port,
+                                         protocol="binary")
+        with pytest.raises(RuntimeError, match="binary"):
+            fpool.get_batch_columnar("agg", keys)
+        for p in (bpool, jpool, apool, fpool):
+            p.close()
+    finally:
+        old_server.stop()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# replica fan-out + failover
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_replica_fanout_failover_partitioned_member():
+    """Partition ONE member of a 2-replica group from the checkpoint
+    stream mid-read-storm: every read keeps answering (via the fresh
+    sibling) with zero errors, and the staleness stats NAME the dead
+    member.  Heal -> the member re-converges and leaves the laggard
+    list."""
+    storage = InMemoryCheckpointStorage(retain=5)
+    op = _build_op(queryable=None, allowed_lateness_ms=60_000)
+    b1 = _batches(n=2, seed=40)
+    _drain(op, b1)
+    storage.store(1, _assembled_from(op, 1))
+    svc = QueryableStateService()
+    group = svc.add_replica("agg",
+                            QueryableStateSpec("agg", "win", "k", op.agg),
+                            storage=storage, replicas=2)
+    assert isinstance(group, ReplicaGroup)
+    assert [m.name for m in group.members] == ["agg#r0", "agg#r1"]
+    for m in group.members:
+        assert m.poll_once()
+    exp1 = _expected_sums(b1)
+    q = np.asarray(sorted(exp1), np.int64)
+
+    inj = FaultInjector(seed=3)
+    part = inj.inject(REPLICA_FETCH_POINT, Partition(replica="agg#r1"))
+    b2 = _batches(n=2, seed=41)
+    _drain(op, b2)
+    storage.store(2, _assembled_from(op, 2))
+    storage.store(3, _assembled_from(op, 3))
+    exp_all = _expected_sums(b1 + b2)
+    with chaos.installed(inj):
+        assert group.members[0].poll_once()      # healthy sibling advances
+        assert not group.members[1].poll_once()  # partitioned: stays at 1
+        # read storm THROUGH the group: every answer fresh, zero errors
+        for _ in range(32):
+            found, values, tags = group.lookup_batch(q)
+            assert found.all()
+            assert tags["checkpoint_id"] == 3
+            for i, k in enumerate(q.tolist()):
+                assert abs(values[i]["result"] - exp_all[k]) \
+                    <= 2e-2 + 1e-4 * abs(exp_all[k])
+        st = group.stats()
+        assert st["laggards"] == ["agg#r1"]       # the gauge NAMES it
+        assert st["members"]["agg#r1"]["serving_checkpoint_id"] == 1
+        assert st["serving_checkpoint_id"] == 3   # reads see the head
+        # the service-level lag stats ride the group's serving view
+        assert svc.stats()["per_state"]["agg"]["replica"][
+            "laggards"] == ["agg#r1"]
+        part.heal()
+        assert group.members[1].poll_once()       # re-converges
+    st2 = group.stats()
+    assert st2["laggards"] == []
+
+
+def test_replica_group_load_balances_across_fresh_members():
+    op = _build_op(queryable=None)
+    _drain(op, _batches(n=2, seed=50))
+    spec = QueryableStateSpec("agg", "win", "k", op.agg)
+    group = ReplicaGroup([CheckpointReplica(spec, name=f"agg#r{i}")
+                          for i in range(2)])
+    assembled = _assembled_from(op, 1)
+    group.ingest_assembled(1, assembled)
+    picks = {id(group._pick()) for _ in range(8)}
+    assert len(picks) == 2                       # both members take reads
+
+
+# ---------------------------------------------------------------------------
+# stale endpoint map: evict -> refresh -> retry
+# ---------------------------------------------------------------------------
+
+def test_stale_endpoint_map_refreshes_and_succeeds():
+    """A worker restarted on a NEW port: the routed client's first send
+    hits the dead endpoint, evicts the socket, refreshes the map from the
+    bootstrap server, and the retry lands on the new endpoint — no caller
+    -visible error."""
+    op = _build_op()
+    _drain(op, _batches())
+    view = op.queryable_view()
+    # "worker" server 1
+    w1 = QueryableStateService()
+    w1.register_views("agg", [view], 1, 128)
+    s1 = w1.start_server()
+    # bootstrap: advertises the worker endpoint, serves no views itself
+    boot = QueryableStateService()
+    boot.set_state_endpoints("agg", {0: (s1.host, s1.port)},
+                             parallelism=1, max_parallelism=128)
+    bs = boot.start_server()
+    pool = QueryableStateClientPool(bs.host, bs.port, protocol="binary",
+                                    routing=True, backoff_s=0.01)
+    keys = np.arange(16, dtype=np.int64)
+    f, _c, _t = pool.get_batch_columnar("agg", keys)
+    assert f.any()
+    refreshes_before = pool.stats["routing_refreshes"]
+    # the worker dies and comes back on a NEW port
+    w1.close()
+    w2 = QueryableStateService()
+    w2.register_views("agg", [view], 1, 128)
+    s2 = w2.start_server()
+    assert (s2.host, s2.port) != (s1.host, s1.port)
+    boot.set_state_endpoints("agg", {0: (s2.host, s2.port)},
+                             parallelism=1, max_parallelism=128)
+    # stale map in hand: the lookup must still succeed via evict ->
+    # refresh -> retry (never reusing the dead pooled socket)
+    f2, c2, _t2 = pool.get_batch_columnar("agg", keys)
+    assert f2.tolist() == f.tolist()
+    assert pool.stats["routing_refreshes"] > refreshes_before
+    assert pool.stats["retries"] >= 1
+    pool.close()
+    w2.close()
+    boot.close()
+
+
+# ---------------------------------------------------------------------------
+# serve-path observability
+# ---------------------------------------------------------------------------
+
+def test_serve_spans_and_server_side_histogram():
+    from flink_tpu.observability import tracing
+    op = _build_op()
+    _drain(op, _batches())
+    svc = QueryableStateService()
+    svc.register_views("agg", [op.queryable_view()], 1, 128)
+    svc.add_replica("agg", QueryableStateSpec("agg", "win", "k", op.agg))
+    journal = tracing.install(capacity=4096)
+    try:
+        svc.on_checkpoint_complete(1, _assembled_from(op, 1))
+        assert svc.drain_feed()
+        server = svc.start_server()
+        pool = QueryableStateClientPool(server.host, server.port,
+                                        protocol="binary")
+        jpool = QueryableStateClientPool(server.host, server.port)
+        keys = np.arange(8, dtype=np.int64)
+        pool.get_batch_columnar("agg", keys, "live")
+        jpool.get_batch("agg", keys.tolist(), "checkpoint")
+        pool.close()
+        jpool.close()
+        names = [s[3] for s in journal.spans()]
+        assert "queryable.serve" in names
+        assert "queryable.replica_ingest" in names
+        serve = next(s for s in journal.spans()
+                     if s[3] == "queryable.serve")
+        assert serve[6]["protocol"] in ("binary", "json")
+        st = svc.stats()
+        # the server-side service-time ring (lookup + serialization,
+        # recorded by the TCP handler) sits NEXT TO the lookup numbers
+        assert st["served_requests"] >= 2
+        assert st["serve_p99_ms"] is not None
+        assert st["protocols"]["binary"] >= 1
+        assert st["protocols"]["json"] >= 1
+    finally:
+        tracing.uninstall()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# per-worker serving e2e in ProcessCluster
+# ---------------------------------------------------------------------------
+
+QSERVE_JOB = textwrap.dedent('''
+    """Deterministic queryable window job: keyed sum, parallelism 2."""
+    import numpy as np
+    from flink_tpu.core.functions import SumAggregator
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    N = 60_000
+    K = 64
+
+    def build():
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        keys = (np.arange(N) % K).astype(np.int64)
+        vals = np.ones(N)
+        ts = (np.arange(N) * 2).astype(np.int64)
+        (env.from_collection(columns={"k": keys, "v": vals, "t": ts},
+                             timestamp_column="t", batch_size=512)
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(5_000))
+            .aggregate(SumAggregator(), value_column="v",
+                       queryable="agg")
+            .collect())
+        return env.get_stream_graph("qserve-job")
+''')
+
+
+def test_per_worker_serving_e2e_process_cluster(tmp_path):
+    """Each worker stands up its own QueryableStateServer fronting its
+    local live views + replica shards; the coordinator aggregates the
+    endpoint map; a routed client fans live AND checkpoint reads straight
+    to the owning workers."""
+    from flink_tpu.cluster.distributed import ProcessCluster
+
+    mod = tmp_path / "qserve_job_mod.py"
+    mod.write_text(QSERVE_JOB)
+    sys.path.insert(0, str(tmp_path))
+    pc = None
+    pool = None
+    try:
+        pc = ProcessCluster("qserve_job_mod:build", n_workers=2,
+                            checkpoint_storage=InMemoryCheckpointStorage(),
+                            checkpoint_interval_ms=300,
+                            extra_sys_path=(str(tmp_path),))
+        res = {}
+        th = threading.Thread(
+            target=lambda: res.update(pc.run(timeout_s=120)))
+        th.start()
+        deadline = time.monotonic() + 90
+        eps = {}
+        while time.monotonic() < deadline:
+            eps = pc.queryable_endpoints()
+            if len(set((eps.get("agg") or {}).values())) >= 2:
+                break
+            time.sleep(0.1)
+        assert len(set(eps["agg"].values())) >= 2, \
+            f"per-worker endpoints not registered: {eps}"
+        srv = pc.start_queryable_server()
+        pool = QueryableStateClientPool(srv.host, srv.port,
+                                        protocol="binary", routing=True)
+        keys = np.arange(64, dtype=np.int64)
+        live = ckpt = None
+        while time.monotonic() < deadline and (live is None
+                                               or ckpt is None):
+            try:
+                f, c, _t = pool.get_batch_columnar("agg", keys, "live")
+                if f.any() and live is None:
+                    live = (f, c)
+                f, c, t = pool.get_batch_columnar("agg", keys,
+                                                  "checkpoint")
+                if f.any() and ckpt is None:
+                    ckpt = (f, c, t)
+            except (RuntimeError, ConnectionError):
+                pass
+            time.sleep(0.1)
+        assert live is not None, "no live values served by the workers"
+        assert ckpt is not None, "no checkpoint values served"
+        # live reads were FANNED OUT to per-worker endpoints: more than
+        # one distinct server answered
+        assert pool.stats["routed_batches"] >= 1
+        assert pool.stats["fanout_requests"] > \
+            pool.stats["routed_batches"], \
+            "reads never fanned out past one endpoint"
+        f, c = live
+        # tumbling 5s windows over 2-ms-spaced records: each fired
+        # window holds 2500 records spread over 64 keys
+        vals = c["result"][f]
+        assert ((vals >= 30) & (vals <= 50)).all(), vals
+        th.join(timeout=120)
+        assert res.get("state") == "FINISHED", res
+        assert res.get("completed_checkpoints")
+    finally:
+        if pool is not None:
+            pool.close()
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("qserve_job_mod", None)
