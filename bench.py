@@ -1132,6 +1132,238 @@ def run_checkpoint_backpressure(interval_ms: int, budget_ms: float,
     }
 
 
+class _DiurnalSource:
+    """Diurnal load-curve generator (ISSUE-14): a stable-split bounded
+    source whose per-batch emission pace follows a day curve — slow at the
+    edges (overnight trough), fastest in the middle (the traffic peak) —
+    so arrival rate crosses the (injected, per-dequeue) consumer capacity
+    mid-stream and recrosses it on the way down.  Splits are fixed (2 by
+    default) regardless of job parallelism: the autoscaler's stable-split
+    rescale contract."""
+
+    def __new__(cls, n_records: int, n_keys: int, batch_size: int,
+                span_ms: int, peak_s: float, trough_s: float,
+                n_splits: int = 2, seed: int = 31):
+        import math
+
+        from flink_tpu.connectors.sources import Source, SourceSplit
+        from flink_tpu.core.batch import RecordBatch
+
+        class Diurnal(Source):
+            bounded = True
+
+            def __init__(self):
+                rng = np.random.default_rng(seed)
+                per = n_records // n_splits
+                self._data = []
+                for i in range(n_splits):
+                    ks = rng.integers(0, n_keys, per).astype(np.int64)
+                    ts = np.sort(rng.integers(0, span_ms, per)).astype(
+                        np.int64)
+                    self._data.append((ks, ts))
+                nb = max(1, per // batch_size)
+                #: pace per batch index: trough at the edges, peak (the
+                #: smallest sleep = highest arrival rate) in the middle
+                self.paces = [
+                    trough_s - (trough_s - peak_s)
+                    * math.sin(math.pi * i / max(1, nb - 1))
+                    for i in range(nb + 2)]
+                #: per-split high-water batch index EVER emitted: the
+                #: deterministic replay after a rescale re-reads from
+                #: batch 0 and must fast-forward — re-sleeping the whole
+                #:  pre-cut day curve would add seconds of dead time per
+                #: restore and shift the remaining curve
+                self._progress = [0] * n_splits
+
+            def create_splits(self, parallelism):
+                return [SourceSplit(self, i, n_splits)
+                        for i in range(n_splits)]
+
+            def read_split(self, index, of):
+                ks, ts = self._data[index]
+                ones = np.ones(batch_size, np.float64)
+                for bi, lo in enumerate(range(0, len(ks), batch_size)):
+                    hi = min(lo + batch_size, len(ks))
+                    if bi >= self._progress[index]:
+                        time.sleep(self.paces[min(bi, len(self.paces) - 1)])
+                        self._progress[index] = bi + 1
+                    yield RecordBatch({"k": ks[lo:hi],
+                                       "v": ones[:hi - lo],
+                                       "t": ts[lo:hi]})
+
+        return Diurnal()
+
+
+def run_autoscale_bench(args) -> dict:
+    """``--autoscale``: the reactive autoscaler (ISSUE-14) under a diurnal
+    load curve.  A stable-split :class:`_DiurnalSource` paces arrivals
+    through a day curve while a seeded ``DelayBy`` on ``channel.recv``
+    models a fixed per-dequeue consumer cost (so drain capacity scales
+    with parallelism — the reason scale-out helps); the
+    ``ReactiveAutoscaler`` watches the job's own backpressure gauges and
+    rescales 2→4 at the peak and back down after it, each rescale an
+    unaligned checkpoint with channel-state redistribution — no drain.
+    Reports rescale count/latency, throughput recovery time after the
+    scale-out, and records lost/duplicated (both MUST be 0), gated by
+    BENCH_BUDGET.json ``rescale_cpu``."""
+    import threading
+
+    from flink_tpu.cluster.adaptive import (AutoscalerPolicy,
+                                            ReactiveAutoscaler)
+    from flink_tpu.datastream.api import StreamExecutionEnvironment
+    from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+    from flink_tpu.testing import chaos
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    smoke = args.smoke
+    n_records = args.records or (150_000 if smoke else 600_000)
+    n_keys = min(args.keys, 1009 if smoke else 100_003)
+    batch_size = 128
+    span_ms = 20_000
+    from flink_tpu.connectors.sinks import CollectSink
+    sink = CollectSink()
+    source = _DiurnalSource(n_records, n_keys, batch_size, span_ms,
+                            peak_s=0.006, trough_s=0.025)
+
+    def plan_factory(parallelism):
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(parallelism)
+        (env.from_source(source)
+         .assign_timestamps_and_watermarks(0, timestamp_column="t")
+         .key_by("k")
+         .window(TumblingEventTimeWindows.of(1000))
+         .sum("v").add_sink(sink))
+        return env.get_stream_graph("autoscale-bench").to_plan()
+
+    scale_out_depth, scale_in_depth = 12, 2
+    policy = AutoscalerPolicy(min_parallelism=2, max_parallelism=4,
+                              scale_out_queue_depth=scale_out_depth,
+                              scale_in_queue_depth=scale_in_depth,
+                              sustain_polls=3, cooldown_ms=1500.0)
+    storage = InMemoryCheckpointStorage(retain=10)
+    scaler = ReactiveAutoscaler(
+        plan_factory, checkpoint_storage=storage, policy=policy,
+        initial_parallelism=2, poll_interval_ms=25.0,
+        checkpoint_interval_ms=50, alignment_timeout_ms=100.0,
+        restart_attempts=4, job_timeout_s=600.0)
+    inj = chaos.FaultInjector(seed=37)
+    # the consumer-cost model: every dequeue pays a fixed cost, so drain
+    # capacity is proportional to the number of consuming subtasks
+    inj.inject("channel.recv", chaos.DelayBy(0.010))
+    timeline = []
+    stop = threading.Event()
+
+    def watch():
+        t_w0 = time.monotonic()
+        while not stop.is_set():
+            st = scaler.status()
+            timeline.append((time.monotonic() - t_w0,
+                             st["signals"].get("max_queue_depth", 0),
+                             len(st["parallelism_path"]),
+                             st["last_rescale_duration_ms"]))
+            time.sleep(0.05)
+
+    t0 = time.monotonic()
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    with chaos.installed(inj):
+        scaler.start()
+        scaler.join(timeout_s=600)
+    stop.set()
+    wall_ms = (time.monotonic() - t0) * 1000.0
+    st = scaler.status()
+
+    # exactly-once accounting: per-key window sums vs the generated data
+    expected: dict = {}
+    for i in range(2):
+        ks, _ts = source._data[i]
+        for k in ks.tolist():
+            expected[k] = expected.get(k, 0.0) + 1.0
+    got: dict = {}
+    for r in sink.rows():
+        got[int(r["k"])] = got.get(int(r["k"]), 0.0) + float(r["v"])
+    lost = dup = 0.0
+    for k in set(expected) | set(got):
+        d = expected.get(k, 0.0) - got.get(k, 0.0)
+        if d > 0:
+            lost += d
+        else:
+            dup -= d
+
+    # throughput recovery: time from the first rescale COMPLETING (first
+    # output of the new deployment — last_rescale_duration_ms appears)
+    # until queue depth is back under the scale-in threshold: the new
+    # parallelism has drained the peak's backlog
+    recovery_ms = None
+    t_out = None
+    for t, depth, path_len, dur in timeline:
+        if t_out is None:
+            if path_len >= 2 and dur is not None:
+                t_out = t
+            continue
+        if depth <= scale_in_depth:
+            recovery_ms = round((t - t_out) * 1000.0, 1)
+            break
+    if t_out is not None and recovery_ms is None:
+        recovery_ms = round((timeline[-1][0] - t_out) * 1000.0, 1)
+
+    finished = scaler.state == "Finished"
+    ok = (finished and lost == 0 and dup == 0 and st["rescales"] >= 1)
+    return {
+        "metric": "reactive autoscaler under a diurnal load curve",
+        "ok": bool(ok),
+        "state": scaler.state,
+        "error": scaler.error,
+        "records": n_records,
+        "keys": n_keys,
+        "rescales": st["rescales"],
+        "rollbacks": st["rollbacks"],
+        "retriggers": st["retriggers"],
+        "parallelism_path": st["parallelism_path"],
+        "rescale_latency_ms": st["last_rescale_duration_ms"],
+        "recovery_ms": recovery_ms,
+        "records_lost": int(lost),
+        "records_duplicated": int(dup),
+        "records_per_sec": round(n_records / max(wall_ms / 1000.0, 1e-9)),
+        "wall_ms": round(wall_ms, 1),
+    }
+
+
+def check_rescale_budget(result: dict, budget: dict,
+                         smoke: bool = False) -> list:
+    """BENCH_BUDGET.json ``rescale_cpu`` gate for ``--autoscale``.
+    Exactly-once (zero lost, zero duplicated records) and job completion
+    gate UNCONDITIONALLY — a rescale that loses records must never exit 0
+    because no perf ceiling was configured."""
+    viol = []
+    if result.get("state") != "Finished":
+        viol.append(f"autoscaled job did not finish: "
+                    f"{result.get('state')} ({result.get('error')})")
+    lost = result.get("records_lost")
+    if lost != 0:
+        viol.append(f"records_lost {lost} != 0 — rescale dropped records")
+    dup = result.get("records_duplicated")
+    if dup != 0:
+        viol.append(f"records_duplicated {dup} != 0 — rescale replayed "
+                    f"records twice")
+    floor = budget.get("min_rescales", 1)
+    if result.get("rescales", 0) < floor:
+        viol.append(f"rescales {result.get('rescales')} < floor {floor} — "
+                    f"the autoscaler never reacted to the load curve")
+    cap = budget.get("max_rollbacks")
+    if cap is not None and result.get("rollbacks", 0) > cap:
+        viol.append(f"rollbacks {result.get('rollbacks')} > ceiling {cap}")
+    cap = budget.get("max_rescale_latency_ms")
+    lat = result.get("rescale_latency_ms")
+    if cap is not None and lat is not None and lat > cap:
+        viol.append(f"rescale latency {lat}ms > ceiling {cap}ms")
+    cap = budget.get("max_recovery_ms")
+    rec = result.get("recovery_ms")
+    if not smoke and cap is not None and rec is not None and rec > cap:
+        viol.append(f"throughput recovery {rec}ms > ceiling {cap}ms")
+    return viol
+
+
 def _cep_pattern(window_ms: int):
     """Fraud-detection shape (examples/fraud_detection.py as a PATTERN):
     a small 'bait' transaction followed by a large 'strike' on the same
@@ -2170,6 +2402,17 @@ def main():
                          "checkpoint duration + persisted in-flight bytes "
                          "and exits nonzero if a checkpoint misses the "
                          "checkpoint_backpressure budget")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="standalone reactive-autoscaler run (ISSUE-14): a "
+                         "diurnal load-curve source over a keyed window "
+                         "job with a fixed per-dequeue consumer cost; the "
+                         "ReactiveAutoscaler rescales 2->4 at the peak "
+                         "and back after it via unaligned checkpoints "
+                         "with channel-state redistribution (no drain); "
+                         "reports rescale latency, throughput recovery "
+                         "time and records lost/duplicated (must be 0); "
+                         "with --check gates against BENCH_BUDGET.json "
+                         "rescale_cpu")
     ap.add_argument("--inject-wedge", action="store_true",
                     help="standalone recovery smoke: wedge the hot-path "
                          "dispatch with a deterministic chaos schedule and "
@@ -2185,7 +2428,7 @@ def main():
 
     if args.trace and (args.cep or args.queryable or args.mesh_devices
                        or args.config != 2 or args.inject_wedge
-                       or args.checkpoint_interval):
+                       or args.checkpoint_interval or args.autoscale):
         # --trace measures the HEADLINE single-chip workload's on/off legs;
         # the dedicated-mode branches below exit before the trace block, so
         # refuse loudly instead of silently writing no artifact
@@ -2219,6 +2462,20 @@ def main():
                   f"{result['completed_checkpoints']} completed",
                   file=sys.stderr)
         sys.exit(0 if result["ok"] else 1)
+
+    if args.autoscale:
+        result = run_autoscale_bench(args)
+        print(json.dumps(result))
+        if args.check:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BUDGET.json")
+            with open(path) as f:
+                budget = json.load(f).get("rescale_cpu", {})
+            viol = check_rescale_budget(result, budget, smoke=args.smoke)
+            for v in viol:
+                print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
+            sys.exit(1 if viol else 0)
+        sys.exit(0 if result.get("ok") else 1)
 
     if args.cep:
         result = run_cep_bench(args)

@@ -456,6 +456,11 @@ class _WorkerRuntime:
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
         input_logical: Dict[int, List[List[int]]] = {
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+        # per-input-channel routing metadata written into the v2
+        # channel-state section (rescale restores re-route persisted
+        # in-flight elements by record key — state/redistribute)
+        input_routing: Dict[int, List[List[Dict[str, Any]]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
         outputs: Dict[int, List[List[OutputDispatcher]]] = {
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
 
@@ -464,6 +469,10 @@ class _WorkerRuntime:
                 tgt = plan.by_id[e.target_id]
                 np_, nc = n_subs(v), n_subs(tgt)
                 pairs, eff = _edge_pairs(e.partitioning, np_, nc)
+                routing = {"partitioning": e.partitioning,
+                           "key_column": e.key_column,
+                           "max_parallelism": v.max_parallelism,
+                           "logical": e.input_index}
                 # group channels per producer (dispatcher wants ci order)
                 per_producer: Dict[int, List[Any]] = {}
                 for pi, ci in pairs:
@@ -477,6 +486,7 @@ class _WorkerRuntime:
                         ch = LocalChannel(name=chan_id)
                         inputs[tgt.id][ci].append(ch)
                         input_logical[tgt.id][ci].append(e.input_index)
+                        input_routing[tgt.id][ci].append(dict(routing))
                     elif p_local:
                         host, port = addresses[assign[(tgt.uid, ci)]]
                         ch = RemoteChannel(host, port, chan_id,
@@ -489,6 +499,7 @@ class _WorkerRuntime:
                         q = self.server.channel(chan_id)
                         inputs[tgt.id][ci].append(q)
                         input_logical[tgt.id][ci].append(e.input_index)
+                        input_routing[tgt.id][ci].append(dict(routing))
                         self._inchans_by_task.setdefault(
                             (tgt.uid, ci), []).append(chan_id)
                     if p_local:
@@ -576,7 +587,8 @@ class _WorkerRuntime:
                                 alignment_timeout_ms=opts.get(
                                     "alignment_timeout_ms"),
                                 alignment_queue_max=opts.get(
-                                    "alignment_queue_max", 8192))
+                                    "alignment_queue_max", 8192),
+                                input_routing=input_routing[v.id][i])
                     to_start.append((t, pick_restore(v.uid, i, sub_snaps)))
         if only is None:
             self.tasks = [t for t, _ in to_start]
@@ -588,8 +600,14 @@ class _WorkerRuntime:
                 # transition that runs the done check
                 self._done_sent = False
         lat_ms = int(opts.get("latency_interval_ms") or 0)
+        # worker-local deploy barrier (the MiniCluster one, scoped to this
+        # process's slice): shared-instance sinks restore by replacement,
+        # so no local subtask may process input before the slice restored
+        gate = (threading.Barrier(len(to_start)) if len(to_start) > 1
+                else None)
         for t, snap in to_start:
             t.latency_tracker = self.latency_tracker
+            t._deploy_gate = gate
             if lat_ms and isinstance(t, SourceSubtask):
                 t.latency_marker_interval_ms = lat_ms
             t.start(snap)
@@ -1188,6 +1206,13 @@ class ProcessCluster:
         # against it (nondeterministic job builders fail fast)
         self._plan_digest = plan_structure_digest(plan)
         self._counts, _ = subtask_counts_of(plan)
+        if restore:
+            # a restore taken at a DIFFERENT parallelism (an autoscaler
+            # cut, a resized redeploy) redistributes through the key-group
+            # path — persisted in-flight channel state included — before
+            # it ships to the workers; matching snapshots pass untouched
+            from flink_tpu.cluster.adaptive import maybe_rescale_restore
+            restore = maybe_rescale_restore(restore, plan)
         all_subtasks = {(uid, i) for uid, n in self._counts.items()
                         for i in range(n)}
         self._setup_source_coordinator(plan, restore)

@@ -239,6 +239,10 @@ class MiniCluster(TaskListener):
         #: operator was built with ``queryable=<name>`` — live views per
         #: subtask + a checkpoint replica fed from _complete_checkpoint
         self.queryable = None
+        #: reactive-autoscaler status supplier (cluster/adaptive.py
+        #: ReactiveAutoscaler attaches it to each cluster it deploys):
+        #: surfaces as ``job_status()["autoscaler"]`` + autoscaler.* gauges
+        self.autoscaler_status_supplier = None
 
     # ------------------------------------------------------------ listener
     def _slot_memory(self):
@@ -469,6 +473,19 @@ class MiniCluster(TaskListener):
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
         input_logical: Dict[int, List[List[int]]] = {
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+        #: per-input-channel routing metadata (key column / partitioning /
+        #: producer max-parallelism / logical port): Subtasks write it
+        #: into the v2 channel-state section so persisted in-flight
+        #: elements can be re-routed BY KEY on a rescale restore
+        input_routing: Dict[int, List[List[Dict[str, Any]]]] = {
+            v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
+
+        def edge_routing(e, v) -> Dict[str, Any]:
+            return {"partitioning": e.partitioning,
+                    "key_column": e.key_column,
+                    "max_parallelism": v.max_parallelism,
+                    "logical": e.input_index}
+
         outputs: Dict[int, List[List[OutputDispatcher]]] = {
             v.id: [[] for _ in range(n_subs(v))] for v in plan.vertices}
         for v in plan.vertices:
@@ -487,6 +504,7 @@ class MiniCluster(TaskListener):
                             name=f"{v.name}[{pi}]->{tgt.name}[{pi}]")
                         inputs[tgt.id][pi].append(ch)
                         input_logical[tgt.id][pi].append(e.input_index)
+                        input_routing[tgt.id][pi].append(edge_routing(e, v))
                         outputs[v.id][pi].append(OutputDispatcher(
                             part, [ch], max_parallelism=v.max_parallelism,
                             subtask_index=pi, key_column=e.key_column))
@@ -497,6 +515,7 @@ class MiniCluster(TaskListener):
                     for ci, ch in enumerate(chans):
                         inputs[tgt.id][ci].append(ch)
                         input_logical[tgt.id][ci].append(e.input_index)
+                        input_routing[tgt.id][ci].append(edge_routing(e, v))
                     # forward edges with MISMATCHED parallelism degrade to
                     # round-robin (the reference inserts rescale here)
                     if part == "forward" and nc > 1:
@@ -504,6 +523,17 @@ class MiniCluster(TaskListener):
                     outputs[v.id][pi].append(OutputDispatcher(
                         part, chans, max_parallelism=v.max_parallelism,
                         subtask_index=pi, key_column=e.key_column))
+
+        # deploy barrier: no subtask of THIS deployment processes input
+        # before every subtask finished open+restore (shared-instance sink
+        # restores REPLACE rows — a sibling's pre-restore fire would be
+        # wiped; rescale redeploys hit exactly that race).  Sized to the
+        # tasks actually started below; kept-task region restarts gate
+        # only the restarted region's tasks.
+        n_new = sum(len(splits_by_vertex[v.id])
+                    if v.is_source and v.id in splits_by_vertex
+                    else subtask_counts[v.uid] for v in plan.vertices)
+        self._deploy_gate = threading.Barrier(n_new) if n_new > 1 else None
 
         restore = restore or {}
         for v in plan.vertices:
@@ -565,7 +595,8 @@ class MiniCluster(TaskListener):
                                 unaligned=self.unaligned,
                                 input_logical=input_logical[v.id][i],
                                 alignment_timeout_ms=self.alignment_timeout_ms,
-                                alignment_queue_max=self.alignment_queue_max)
+                                alignment_queue_max=self.alignment_queue_max,
+                                input_routing=input_routing[v.id][i])
                     self._attach_observability(t)
                     t.start(sub_snaps[i] if i < len(sub_snaps) else None)
                     self._tasks.append(t)
@@ -578,10 +609,13 @@ class MiniCluster(TaskListener):
         self._wire_queryable(plan)
 
     def _attach_observability(self, t: SubtaskBase) -> None:
-        """Wire latency tracking into a subtask BEFORE it starts: every
-        hop records markers into the shared tracker, and sources get the
-        ``metrics.latency.interval`` emission cadence."""
+        """Wire latency tracking + the deploy barrier into a subtask
+        BEFORE it starts: every hop records markers into the shared
+        tracker, sources get the ``metrics.latency.interval`` emission
+        cadence, and no subtask processes input until the whole
+        deployment finished restoring."""
         t.latency_tracker = self.latency_tracker
+        t._deploy_gate = getattr(self, "_deploy_gate", None)
         if isinstance(t, SourceSubtask) and self.latency_interval_ms:
             t.latency_marker_interval_ms = self.latency_interval_ms
 
@@ -786,6 +820,13 @@ class MiniCluster(TaskListener):
                  timeout_s: float) -> JobResult:
         import copy as _copy
 
+        if restore is not None:
+            # a snapshot taken at a DIFFERENT parallelism (the autoscaler's
+            # pre-rescale cut, an operator-resized redeploy) redistributes
+            # through the key-group path — persisted in-flight channel
+            # state included — instead of silently restoring positionally
+            from flink_tpu.cluster.adaptive import maybe_rescale_restore
+            restore = maybe_rescale_restore(restore, plan)
         self._plan = plan              # dashboard DAG view
         t0 = time.monotonic()
         restarts = 0
@@ -848,6 +889,14 @@ class MiniCluster(TaskListener):
         except KeyError:
             region = {v.uid for v in plan.vertices}
         latest = self.latest_restore()
+        if latest is not None:
+            # a worker dying MID-RESCALE restarts against a checkpoint the
+            # previous parallelism wrote (storage outlives the redeploy):
+            # redistribute it — keyed state AND persisted in-flight
+            # channel state — instead of restoring positionally into the
+            # wrong subtask count (the idempotent-re-trigger contract)
+            from flink_tpu.cluster.adaptive import maybe_rescale_restore
+            latest = maybe_rescale_restore(latest, plan)
         all_uids = {v.uid for v in plan.vertices}
         if region == all_uids:
             self.cancel()
@@ -993,10 +1042,17 @@ class MiniCluster(TaskListener):
         # (alignment critical path, overtaken + persisted in-flight bytes)
         checkpoints.update(self._last_alignment)
         paging = self.paging_totals()
+        autoscaler = None
+        if self.autoscaler_status_supplier is not None:
+            try:
+                autoscaler = self.autoscaler_status_supplier()
+            except Exception:  # noqa: BLE001 — monitoring must not fail status
+                autoscaler = None
         return {
             **({"paging": paging} if paging is not None else {}),
             **({"queryable": self.queryable.stats()}
                if self.queryable is not None else {}),
+            **({"autoscaler": autoscaler} if autoscaler is not None else {}),
             "device_health": self.device_health_status(),
             #: per-(source, hop) latency percentiles (LatencyMarker flow)
             "latency": self.latency_tracker.panel(),
@@ -1045,11 +1101,51 @@ class MiniCluster(TaskListener):
         """User-triggered checkpoint (savepoint analog): returns its id once
         completed, or None if it could not complete.  Savepoint barriers
         never escalate to unaligned — the snapshot stays rescalable and
-        rewritable (the drain-then-rescale contract depends on this)."""
+        rewritable even without channel-state redistribution."""
+        return self._triggered_checkpoint(savepoint=True)
+
+    def checkpoint(self, timeout_s: Optional[float] = None) -> Optional[int]:
+        """A fresh consistent cut of the RUNNING job — the rescale-under-
+        fire primitive: returns the id of the next checkpoint to COMPLETE
+        after this call (triggering one itself whenever no periodic
+        attempt holds the slot).  Unlike :meth:`savepoint` the cut's
+        barriers MAY escalate to unaligned under backpressure, so it
+        completes in bounded time exactly when the job is drowning, and
+        its persisted in-flight channel state redistributes by key on
+        restore at a different parallelism
+        (``state/redistribute.redistribute_channel_state``).  Adopting
+        the next completed id (rather than insisting on its own trigger)
+        matters on jobs with a short checkpoint interval: every completed
+        checkpoint is an equally valid cut, and racing the periodic
+        trigger loop for the pending slot could starve past any budget.
+        Returns None when no cut is possible (sources finished)."""
+        budget = (timeout_s if timeout_s is not None
+                  else self.checkpoint_timeout_s)
+        deadline = time.monotonic() + budget
+        with self._lock:
+            baseline = max(self._completed_ids, default=0)
+        while time.monotonic() < deadline:
+            with self._lock:
+                newer = [c for c in self._completed_ids if c > baseline]
+                if newer:
+                    return max(newer)
+                if self._failed is not None:
+                    return None
+            _cid, reason = self._trigger_checkpoint()
+            if reason == "declined":
+                return None    # permanently impossible (sources done)
+            time.sleep(0.005)
+        return None
+
+    def _triggered_checkpoint(self, savepoint: bool,
+                              timeout_s: Optional[float] = None
+                              ) -> Optional[int]:
+        budget = (timeout_s if timeout_s is not None
+                  else self.checkpoint_timeout_s)
         cid = None
-        deadline0 = time.monotonic() + self.checkpoint_timeout_s
+        deadline0 = time.monotonic() + budget
         while cid is None and time.monotonic() < deadline0:
-            cid, reason = self._trigger_checkpoint(savepoint=True)
+            cid, reason = self._trigger_checkpoint(savepoint=savepoint)
             if cid is None:
                 if reason == "declined":
                     return None    # permanently impossible (sources done)
@@ -1057,7 +1153,7 @@ class MiniCluster(TaskListener):
                 time.sleep(0.005)
         if cid is None:
             return None
-        deadline = time.monotonic() + self.checkpoint_timeout_s
+        deadline = time.monotonic() + budget
         while time.monotonic() < deadline:
             with self._lock:
                 if cid in self._completed_ids:

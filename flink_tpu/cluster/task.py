@@ -93,6 +93,13 @@ class SubtaskBase:
         #: attached by the deploying cluster; every LatencyMarker this
         #: subtask sees records marked_time→now at THIS hop
         self.latency_tracker = None
+        #: deploy barrier (threading.Barrier, set by the cluster before
+        #: start()): no subtask of one deployment processes input until
+        #: EVERY subtask finished open+restore.  Shared-instance sinks
+        #: (the collect path) restore by REPLACING their rows; a sibling
+        #: appending a fire before the owner subtask's restore ran would
+        #: be silently wiped — rescale redeploys hit exactly that race
+        self._deploy_gate = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, restore: Optional[Dict[str, Any]] = None) -> None:
@@ -104,6 +111,7 @@ class SubtaskBase:
 
     def cancel(self) -> None:
         self._cancelled.set()
+        self._abort_deploy_gate()   # a task parked at the barrier must wake
         self.commands.put(("cancel",))
         # Unblock a task thread stuck in a full output channel (backpressure
         # from a dead downstream) or an empty input poll: closed channels
@@ -145,25 +153,55 @@ class SubtaskBase:
         if self._cancelled.is_set():
             raise _Cancel()
 
+    def _wait_deploy_gate(self) -> None:
+        """Hold at the deploy barrier until every sibling subtask finished
+        open+restore.  Broken/timed-out barriers (a sibling failed during
+        restore, cancel during deploy) degrade to the old
+        start-immediately behavior — liveness first."""
+        gate = self._deploy_gate
+        if gate is None:
+            return
+        try:
+            gate.wait(timeout=30.0)
+        except threading.BrokenBarrierError:
+            pass
+
+    def _abort_deploy_gate(self) -> None:
+        gate = self._deploy_gate
+        if gate is not None:
+            try:
+                gate.abort()
+            except Exception:  # noqa: BLE001 — best-effort wakeup
+                pass
+
     def _run(self) -> None:
         try:
             if self._restore is not None and self._restore.get("finished"):
                 # restored from a FINAL snapshot (FLIP-147): this task's
-                # data and end-of-input effects are already reflected in
-                # every downstream snapshot of the same checkpoint — only
-                # the channel-termination signal must be replayed, or
-                # downstream restored tasks would wait forever.  The state
-                # must still be MATERIALIZED in the operator instance:
-                # terminal collection (chained collect sinks) reads rows
-                # from the live operator, not from the snapshot dict
+                # data is already reflected in every downstream snapshot of
+                # the same checkpoint — only the channel-TERMINATION
+                # signals must be replayed, or downstream restored tasks
+                # would wait forever.  That is BOTH signals the original
+                # emitted: the final MAX watermark and EndOfInput.  A
+                # downstream subtask restored with a fresh valve (a
+                # rescale redeploy) still holds not-yet-fired event-time
+                # state; without the watermark those windows would never
+                # fire — records silently lost at end of stream.  The
+                # watermark is monotone, so downstreams whose valve
+                # already saw MAX absorb the duplicate as a no-op.  The
+                # state must still be MATERIALIZED in the operator
+                # instance: terminal collection (chained collect sinks)
+                # reads rows from the live operator, not the snapshot dict
                 self.final_snapshot = dict(self._restore)
                 self._open_and_restore()
                 self._transition(TaskStates.RUNNING)
-                self._emit([EndOfInput()])
+                self._wait_deploy_gate()
+                self._emit([Watermark(MAX_WATERMARK), EndOfInput()])
                 self._transition(TaskStates.FINISHED)
                 return
             self._open_and_restore()
             self._transition(TaskStates.RUNNING)
+            self._wait_deploy_gate()
             self._invoke()
             # FLIP-147 (checkpoints after tasks finish): capture the FINAL
             # state so checkpoints completing after this task ends still
@@ -175,8 +213,10 @@ class SubtaskBase:
             self.operator.close()
             self._transition(TaskStates.FINISHED)
         except _Cancel:
+            self._abort_deploy_gate()   # siblings must not wait on us
             self._transition(TaskStates.CANCELED)
         except Exception as e:  # noqa: BLE001
+            self._abort_deploy_gate()   # a failed restore unblocks siblings
             traceback.print_exc()
             self._transition(TaskStates.FAILED, f"{type(e).__name__}: {e}")
         finally:
@@ -476,11 +516,21 @@ class Subtask(SubtaskBase):
                  unaligned: bool = False,
                  input_logical: Optional[Sequence[int]] = None,
                  alignment_timeout_ms: Optional[float] = None,
-                 alignment_queue_max: int = 8192):
+                 alignment_queue_max: int = 8192,
+                 input_routing: Optional[Sequence[Dict[str, Any]]] = None):
         super().__init__(vertex_uid, subtask_index, operator, outputs, ctx,
                          listener)
         self.inputs = list(input_channels)
         self.unaligned = unaligned
+        #: per-input-channel routing metadata the deploying cluster
+        #: captured from the edge ({"partitioning", "key_column",
+        #: "max_parallelism", "logical"}): written into the v2
+        #: channel-state section so a RESCALE restore can re-route each
+        #: persisted in-flight element by the record's own key
+        #: (state/redistribute.redistribute_channel_state)
+        self.input_routing = ([dict(r) for r in input_routing]
+                              if input_routing is not None
+                              else [{} for _ in self.inputs])
         #: None = stay aligned forever; 0 = overtake at first arrival
         #: (pure unaligned); >0 = aligned-with-timeout escalation
         self.alignment_timeout_ms = (
@@ -610,13 +660,30 @@ class Subtask(SubtaskBase):
         if not cs:
             return []
         if isinstance(cs, dict):
+            from flink_tpu.state.redistribute import CHANNEL_STATE_VERSIONS
             version = cs.get("version")
-            if version != 1:
+            if version not in CHANNEL_STATE_VERSIONS:
                 raise ValueError(
                     f"unknown channel-state snapshot version {version!r} "
-                    f"(this runtime reads v1) — the checkpoint was written "
-                    f"by an incompatible runtime")
-            return list(cs.get("elements", []))
+                    f"(this runtime reads "
+                    f"{'/'.join(f'v{v}' for v in CHANNEL_STATE_VERSIONS)})"
+                    f" — the checkpoint was written by an incompatible "
+                    f"runtime")
+            elements = list(cs.get("elements", []))
+            if cs.get("by_logical_port"):
+                # rescale-redistributed section: elements are keyed by
+                # LOGICAL input port (the old physical channel indices
+                # died with the old topology) — replay each on the first
+                # input channel of its port
+                mapped = []
+                for port, el in elements:
+                    try:
+                        i = self.input_logical.index(port)
+                    except ValueError:
+                        i = 0
+                    mapped.append((i, el))
+                return mapped
+            return elements
         return list(cs)   # legacy: bare [(i, el), ...] list
 
     def _handle(self, i: int, el: StreamElement) -> None:
@@ -1053,10 +1120,14 @@ class Subtask(SubtaskBase):
                 return
             snap = self._pending_snapshot
             # versioned channel-state section: the persisted in-flight
-            # elements plus the overtake accounting (v1)
+            # elements plus the overtake accounting.  v2 adds the
+            # per-input routing metadata (key column / partitioning /
+            # producer max-parallelism / logical port) that rescale-time
+            # redistribution routes persisted elements by
             snap["channel_state"] = {
-                "version": 1,
+                "version": 2,
                 "elements": list(self._channel_state),
+                "inputs": [dict(r) for r in self.input_routing],
                 "persisted_bytes": self._cs_bytes,
                 "overtaken_bytes": self._overtaken_bytes,
                 "alignment_ms": round(align_ms, 3),
@@ -1092,8 +1163,9 @@ class Subtask(SubtaskBase):
                     f"{type(e).__name__}: {e}")
                 return
             snap["channel_state"] = {
-                "version": 1, "elements": [], "persisted_bytes": 0,
-                "overtaken_bytes": 0,
+                "version": 2, "elements": [],
+                "inputs": [dict(r) for r in self.input_routing],
+                "persisted_bytes": 0, "overtaken_bytes": 0,
                 "alignment_ms": round(align_ms, 3), "unaligned": False}
             self._record_checkpoint_stats(cid, align_ms, False, 0)
             self._emit([barrier])

@@ -71,6 +71,17 @@ QUERYABLE_REPLICA_LAG_MS = "queryable.replica_lag_ms"
 QUERYABLE_SERVE_P50 = "queryable.serve_p50_ms"
 QUERYABLE_SERVE_P99 = "queryable.serve_p99_ms"
 QUERYABLE_CACHE_HIT_RATE = "queryable.cache_hit_rate"
+# reactive autoscaler (cluster/adaptive.ReactiveAutoscaler): the rescale
+# lifecycle's health — current vs target parallelism, how often the job
+# rescaled, how long the last rescale window was, and how many rescales
+# rolled back / re-triggered inside the window
+AUTOSCALER_CURRENT_PARALLELISM = "autoscaler.current_parallelism"
+AUTOSCALER_TARGET_PARALLELISM = "autoscaler.target_parallelism"
+AUTOSCALER_RESCALES = "autoscaler.rescales_total"
+AUTOSCALER_ROLLBACKS = "autoscaler.rollbacks_total"
+AUTOSCALER_RETRIGGERS = "autoscaler.retriggers_total"
+AUTOSCALER_LAST_RESCALE_MS = "autoscaler.last_rescale_duration_ms"
+AUTOSCALER_COOLDOWN_REMAINING_MS = "autoscaler.cooldown_remaining_ms"
 
 
 class MetricGroup:
@@ -307,6 +318,34 @@ def queryable_metrics(group: MetricGroup,
                       (QUERYABLE_REPLICA_LAG_CHECKPOINTS,
                        "replica_lag_checkpoints"),
                       (QUERYABLE_REPLICA_LAG_MS, "replica_lag_ms")):
+        group.gauge(name, _read(key))
+    return group
+
+
+def autoscaler_metrics(group: MetricGroup,
+                       status_supplier: Callable[[], Optional[Dict[str, Any]]]
+                       ) -> MetricGroup:
+    """Register the reactive autoscaler's gauges on a (job-scope) group:
+    current/target parallelism, rescale/rollback/re-trigger counters, the
+    last rescale window's duration, and the cooldown remaining.
+    ``status_supplier`` returns :meth:`ReactiveAutoscaler.status` dicts
+    (or None -> 0s)."""
+    def _read(key: str, default=0) -> Callable[[], Any]:
+        def read():
+            v = (status_supplier() or {}).get(key)
+            return default if v is None else v
+        return read
+
+    for name, key in ((AUTOSCALER_CURRENT_PARALLELISM,
+                       "current_parallelism"),
+                      (AUTOSCALER_TARGET_PARALLELISM, "target_parallelism"),
+                      (AUTOSCALER_RESCALES, "rescales"),
+                      (AUTOSCALER_ROLLBACKS, "rollbacks"),
+                      (AUTOSCALER_RETRIGGERS, "retriggers"),
+                      (AUTOSCALER_LAST_RESCALE_MS,
+                       "last_rescale_duration_ms"),
+                      (AUTOSCALER_COOLDOWN_REMAINING_MS,
+                       "cooldown_remaining_ms")):
         group.gauge(name, _read(key))
     return group
 

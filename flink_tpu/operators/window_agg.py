@@ -726,17 +726,28 @@ class WindowAggOperator(StreamOperator):
 
     @staticmethod
     def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
-        """Merge coordinated same-checkpoint snapshots (scale-down).  All
-        parts must share pane progress — true for snapshots taken at one
-        barrier, where every subtask saw the same watermark."""
+        """Merge same-checkpoint snapshots (scale-down).
+
+        Subtasks of one coordinated ALIGNED checkpoint share pane
+        progress (every subtask saw the same watermark at the barrier);
+        an UNALIGNED checkpoint's subtasks snapshot at different
+        watermarks (the barrier overtakes each at its own moment), so
+        their pane rings cover different-but-overlapping ranges.  The
+        keys are disjoint (key-group partitioned), so heterogeneous
+        progress merges safely by EXPANDING every part onto the union
+        pane range (zero panes a part never reached / already expired)
+        and taking the MINIMUM watermark / last-fired-window: windows a
+        faster subtask already fired have their state evicted there (no
+        double fire), while a slower subtask's unfired windows stay live
+        and fire when the restored job's watermark passes them again."""
         from flink_tpu.state.redistribute import merge_keyed_snapshots
         from flink_tpu.state.shard_layout import densify_keyed_snapshot
         snaps = [densify_keyed_snapshot(s) for s in snaps]
         live = [s for s in snaps if "panes" in s]
-        for s in live[1:]:
-            if not np.array_equal(s["panes"], live[0]["panes"]):
-                raise ValueError("cannot merge snapshots with different pane "
-                                 "progress (not from one coordinated checkpoint)")
+        if live and any(not np.array_equal(s["panes"], live[0]["panes"])
+                        for s in live[1:]):
+            snaps = WindowAggOperator._align_pane_progress(snaps)
+            live = [s for s in snaps if "panes" in s]
         all_windows = sorted({w for s in snaps
                               for w in (s.get("count_baselines") or {})})
         extra = ()
@@ -751,8 +762,59 @@ class WindowAggOperator(StreamOperator):
                                        WindowAggOperator.ROW_FIELDS + extra)
         merged = WindowAggOperator._unpack_baselines(merged)
         if live:
-            merged["watermark"] = max(s["watermark"] for s in live)
+            # MIN is correct for both cases: aligned parts all agree (min
+            # == max), unaligned parts must resume from the slowest
+            # subtask's progress or its not-yet-fired windows never fire
+            merged["watermark"] = min(s["watermark"] for s in live)
+            lf = [s.get("last_fired_window") for s in live]
+            merged["last_fired_window"] = (None if any(w is None for w in lf)
+                                           else min(lf))
         return merged
+
+    @staticmethod
+    def _align_pane_progress(snaps: List[Dict[str, Any]]
+                             ) -> List[Dict[str, Any]]:
+        """Expand each part's pane-indexed row fields onto the UNION pane
+        range (contiguous ``arange(min pane_base, max max_pane + 1)``):
+        panes a part already expired or never reached hold zero counts,
+        which is exactly their state there.  Keys stay disjoint across
+        parts, so the subsequent keyed merge concatenates rows without
+        ever adding two parts' values for one (key, pane)."""
+        live = [s for s in snaps if "panes" in s]
+        base = min(int(s["pane_base"]) for s in live)
+        top = max(int(s["max_pane"]) for s in live)
+        union = np.arange(base, top + 1, dtype=np.int64)
+        # the restored ring maps slot = pane % P: P must cover the union
+        # span or distinct panes would collide in one slot
+        ring = max(int(s.get("P", 2)) for s in live)
+        while ring < len(union):
+            ring <<= 1
+        out = []
+        for s in snaps:
+            if "panes" not in s:
+                out.append(s)
+                continue
+            s2 = dict(s)
+            off = int(s["pane_base"]) - base
+            counts = np.asarray(s["counts"])
+            n_p = counts.shape[1]
+            wide = np.zeros((counts.shape[0], len(union)), counts.dtype)
+            wide[:, off:off + n_p] = counts
+            s2["counts"] = wide
+            leaves = []
+            for leaf in s["leaves"]:
+                leaf = np.asarray(leaf)
+                w = np.zeros((leaf.shape[0], len(union)) + leaf.shape[2:],
+                             leaf.dtype)
+                w[:, off:off + n_p] = leaf
+                leaves.append(w)
+            s2["leaves"] = leaves
+            s2["panes"] = union
+            s2["pane_base"] = base
+            s2["max_pane"] = top
+            s2["P"] = ring
+            out.append(s2)
+        return out
 
     def reset_state(self) -> None:
         """Drop all keyed state/time progress but KEEP compiled steps (the

@@ -13,6 +13,7 @@ Endpoints:
   GET  /jobs/<id>/checkpoints         completed checkpoint stats
   GET  /jobs/<id>/backpressure        busy/idle/backpressured per vertex
   GET  /jobs/<id>/metrics             numeric metrics incl. latency pcts
+  GET  /jobs/<id>/autoscaler(.html)   reactive-autoscaler rescale status
   GET  /jobs/<id>/exceptions          root failure cause
   GET  /jobs/<id>/flamegraph          sampled task-thread flame graph
   POST /jobs/<id>/savepoints          trigger a savepoint
@@ -335,6 +336,14 @@ class RestServer:
                     from flink_tpu.rest.views import device_health_html
                     return self._send(device_health_html(
                         status.get("device_health", {})).encode(),
+                        content_type="text/html")
+                if sub == "autoscaler":
+                    return self._send(status.get(
+                        "autoscaler", {"state": "off"}))
+                if sub == "autoscaler.html":
+                    from flink_tpu.rest.views import autoscaler_html
+                    return self._send(autoscaler_html(
+                        status.get("autoscaler", {})).encode(),
                         content_type="text/html")
                 return self._send({"error": f"unknown path {sub}"}, 404)
 

@@ -195,6 +195,49 @@ def device_health_html(status: Dict[str, Any]) -> str:
             + "</tbody></table></div>")
 
 
+def autoscaler_html(status: Dict[str, Any]) -> str:
+    """Reactive-autoscaler panel (``job_status()["autoscaler"]``): the
+    rescale lifecycle's state badge, current→target parallelism, the
+    rescale/rollback/re-trigger counters, cooldown, the parallelism path
+    the job has walked, and the last observed signals.  Server-rendered,
+    DOM-testable — same pattern as the device-health panel."""
+    if not status:
+        return ('<div class="as-panel"><span class="as-state as-off" '
+                'data-state="off">autoscaler: off</span></div>')
+    state = str(status.get("state", "?"))
+    cur = status.get("current_parallelism", "?")
+    tgt = status.get("target_parallelism", "?")
+    cls = ("as-rescaling" if state == "Restarting" else "as-running")
+    rows = []
+    for label, key in (("rescales", "rescales"),
+                       ("rollbacks", "rollbacks"),
+                       ("re-triggers", "retriggers"),
+                       ("rescales skipped", "rescales_skipped"),
+                       ("last rescale duration (ms)",
+                        "last_rescale_duration_ms"),
+                       ("cooldown remaining (ms)", "cooldown_remaining_ms"),
+                       ("min parallelism", "min_parallelism"),
+                       ("max parallelism", "max_parallelism")):
+        rows.append(f'<tr class="as-row" data-metric="{_esc(key)}">'
+                    f'<td>{_esc(label)}</td>'
+                    f'<td>{_esc(status.get(key, 0))}</td></tr>')
+    path = " → ".join(str(p) for p in status.get("parallelism_path", []))
+    sig = status.get("signals") or {}
+    sig_items = "".join(
+        f'<span class="as-signal" data-signal="{_esc(k)}">'
+        f'{_esc(k)}={_esc(v)}</span> ' for k, v in sorted(sig.items()))
+    return (f'<div class="as-panel">'
+            f'<span class="as-state {cls}" data-state="{_esc(state)}">'
+            f'autoscaler: {_esc(state)} · parallelism {_esc(cur)} → '
+            f'{_esc(tgt)}</span>'
+            f'<div class="as-path" data-path="{_esc(path)}">path: '
+            f'{_esc(path)}</div>'
+            f'<div class="as-signals">{sig_items}</div>'
+            f'<table class="as-table"><thead><tr><th>metric</th>'
+            f'<th>value</th></tr></thead><tbody>' + "".join(rows)
+            + "</tbody></table></div>")
+
+
 def queryable_html(stats: Dict[str, Any]) -> str:
     """Queryable serving tier panel (``job_status()["queryable"]``):
     per-state lookup volume/latency + replica staleness and shard

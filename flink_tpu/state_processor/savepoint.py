@@ -41,7 +41,16 @@ def _merge_keyed_group(ops: List[Dict[str, Any]]) -> Dict[str, Any]:
     return merge_keyed_snapshots(ops, fields)
 
 
-def _merged_operator_snapshot(entry: Any) -> Dict[str, Any]:
+def _merged_operator_snapshot(entry: Any, strict: bool = False
+                              ) -> Dict[str, Any]:
+    """Merge one vertex's subtask snapshots into a single-operator view.
+
+    ``strict=True`` (the RESCALE path) propagates keyed-member merge
+    failures: silently keeping subtask 0's copy there would drop every
+    other subtask's state from the redeployed job — a quiet
+    exactly-once violation.  The default stays best-effort for offline
+    savepoint READS, where a heterogeneous member is merely unreadable,
+    not redeployed."""
     if not _is_subtask_layout(entry):
         return entry
     subs = [s for s in entry["subtasks"] if s is not None]
@@ -63,6 +72,8 @@ def _merged_operator_snapshot(entry: Any) -> Dict[str, Any]:
                 try:
                     out[mk] = _merge_keyed_group(members)
                 except (ValueError, KeyError, IndexError):
+                    if strict:
+                        raise
                     pass  # heterogeneous member layout: keep subtask 0
         return out
     return ops[0]
@@ -252,12 +263,27 @@ class SavepointWriter:
         import copy as _copy
         self.snapshot[uid] = _copy.deepcopy(self.snapshot[uid])
         entry = self.snapshot[uid]
-        # an UNALIGNED checkpoint's persisted in-flight channel state
-        # cannot survive an offline rewrite (the merge collapses subtask
-        # snapshots) — fail loudly instead of silently dropping elements
-        from flink_tpu.state.redistribute import reject_channel_state
-        reject_channel_state({uid: entry}, "savepoint transform")
-        op_snap = _merged_operator_snapshot(entry)
+        # an UNALIGNED checkpoint's persisted in-flight channel state must
+        # survive the offline rewrite even though the merge collapses the
+        # subtask snapshots: redistribute it to a SINGLE logical subtask
+        # (the merged layout's parallelism) — restoring the rewritten
+        # savepoint re-splits it by key through the rescale path.  Legacy
+        # v1 sections with elements still fail loudly (no routing
+        # metadata), never silently drop.
+        carried_cs = None
+        if _is_subtask_layout(entry):
+            from flink_tpu.state.redistribute import (
+                redistribute_channel_state)
+            sections = [(s or {}).get("channel_state")
+                        for s in entry["subtasks"]]
+            if any((cs.get("elements") if isinstance(cs, dict) else cs)
+                   for cs in sections):
+                carried_cs = redistribute_channel_state(
+                    sections, 1, context="savepoint transform")[0]
+        # strict: the rewritten savepoint REDEPLOYS — a keyed member that
+        # cannot merge must fail the rewrite, not silently keep only
+        # subtask 0's key-group ranges
+        op_snap = _merged_operator_snapshot(entry, strict=True)
         inner = op_snap.get("operator", op_snap)
         member = _find_member(inner, "key_index", "keys")
         if member is None:
@@ -301,6 +327,16 @@ class SavepointWriter:
             member.clear()
             member.update(new_snap)
             self.snapshot[uid] = op_snap
+        if carried_cs is not None:
+            # merged-to-parallelism-1 subtask layout: the rewritten state
+            # plus the redistributed in-flight elements; restore at any
+            # parallelism goes through maybe_rescale_restore/rescale_snapshot
+            rewritten = self.snapshot[uid]
+            sub = (rewritten if isinstance(rewritten, dict)
+                   and "operator" in rewritten
+                   else {"operator": rewritten, "valve": None})
+            sub["channel_state"] = carried_cs
+            self.snapshot[uid] = {"subtasks": [sub]}
         return self
 
     def write(self, storage, checkpoint_id: int = 1) -> Dict[str, Any]:

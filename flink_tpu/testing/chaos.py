@@ -8,7 +8,10 @@ plane), ``heartbeat.deliver`` (liveness), ``subtask.run`` /
 ``subtask.snapshot`` (task threads), ``device.dispatch`` (accelerator
 lane), ``queryable.replica_fetch`` (the serving tier's bulk checkpoint
 fetch; fired with ``direction="storage->replica"`` so
-``Partition(direction=)`` cuts exactly the replica's data plane) — each
+``Partition(direction=)`` cuts exactly the replica's data plane),
+``rescale.redistribute`` / ``rescale.redeploy`` (the rescale lifecycle's
+channel-state redistribution and redeploy steps — the
+:class:`KillDuringRescale` prey) — each
 a near-zero-cost :func:`fire` call that consults the
 installed :class:`FaultInjector`.  Tests attach *schedules*
 (fail-K-times-then-succeed, crash-once-at-N, delay-by-D,
@@ -46,7 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 __all__ = [
     "InjectedFault", "FaultSchedule", "FailTimes", "CrashOnceAt", "DelayBy",
     "SlowDisk", "SlowConsumer", "ActionSequence", "Partition",
-    "FailWithProbability", "WedgedDevice", "ClockSkew",
+    "FailWithProbability", "WedgedDevice", "ClockSkew", "KillDuringRescale",
     "FaultInjector", "FreezableProxy", "install", "uninstall", "installed",
     "fire", "active", "blocked", "skew",
 ]
@@ -351,6 +354,37 @@ class ClockSkew(FaultSchedule):
             return OK
         off = sum(d for at, d in self.jumps if n >= at)
         return ("skew", off + self.drift * n + j)
+
+
+class KillDuringRescale(FaultSchedule):
+    """Kill (or stall, then kill) INSIDE the rescale window — fired at the
+    ``rescale.redistribute`` point, which the rescale lifecycle hits after
+    the pre-rescale cut is taken and before the job redeploys at the new
+    parallelism.  Deterministic: the ``at``-th rescale through the point
+    dies (``times`` consecutive rescales when given), everything else
+    proceeds.  ``stall_s`` sleeps before the kill so partition/stall
+    composites can hold the window open.  The rescale lifecycle is
+    expected to absorb the kill: re-trigger the redistribution from the
+    same pre-rescale checkpoint (idempotent — the cut is immutable), or
+    roll back to the old parallelism past its retry budget; either way
+    zero records may be lost or duplicated."""
+
+    def __init__(self, at: int = 1, times: int = 1, stall_s: float = 0.0):
+        if times < 1:
+            raise ValueError("KillDuringRescale: times must be >= 1")
+        self.at = at
+        self.times = times
+        self.stall_s = stall_s
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        if self.at <= n < self.at + self.times:
+            if self.stall_s > 0:
+                # one composite firing: stall first (holds the rescale
+                # window open), then die — FaultInjector sleeps on the
+                # delay branch, so model it as a slow kill message
+                time.sleep(self.stall_s)
+            return (FAIL, f"killed during rescale (firing {n})")
+        return OK
 
 
 class FailWithProbability(FaultSchedule):

@@ -28,7 +28,7 @@ import time
 from flink_tpu.testing import chaos
 
 __all__ = ["Clock", "SYSTEM_CLOCK", "now_ms", "now_ms_f", "monotonic",
-           "MonotoneElapsed"]
+           "MonotoneElapsed", "sleep"]
 
 
 class Clock:
@@ -92,3 +92,13 @@ def now_ms_f() -> float:
 
 def monotonic() -> float:
     return SYSTEM_CLOCK.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """Pacing sleep — a raw ``time.sleep`` passthrough for poll-loop
+    cadence.  Deliberately NOT skewed: chaos targets time *decisions*
+    (deadlines, cooldowns, expiry — all of which must read
+    :class:`MonotoneElapsed` / the skewed readings above), not the OS
+    scheduler.  Living here keeps seam consumers off ``import time``
+    entirely, so a stray ``time.time()`` decision can't sneak back in."""
+    time.sleep(seconds)

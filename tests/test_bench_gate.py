@@ -664,3 +664,78 @@ def test_smoke_bench_passes_gate():
          "--check"],
         capture_output=True, text=True, timeout=900, cwd=REPO)
     assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+
+
+# ---------------------------------------------------------------------------
+# --autoscale (ISSUE-14): reactive autoscaler under a diurnal load curve
+# ---------------------------------------------------------------------------
+
+def _rescale_result(state="Finished", lost=0, dup=0, rescales=2,
+                    rollbacks=0, latency=1500.0, recovery=8000.0):
+    return {"state": state, "records_lost": lost,
+            "records_duplicated": dup, "rescales": rescales,
+            "rollbacks": rollbacks, "rescale_latency_ms": latency,
+            "recovery_ms": recovery}
+
+
+def _rescale_budget(**kw):
+    b = {"min_rescales": 1, "max_rollbacks": 0,
+         "max_rescale_latency_ms": 20000, "max_recovery_ms": 60000}
+    b.update(kw)
+    return b
+
+
+def test_check_rescale_budget_pass():
+    from bench import check_rescale_budget
+    assert check_rescale_budget(_rescale_result(), _rescale_budget()) == []
+
+
+def test_check_rescale_budget_exactly_once_always_gates():
+    """Lost/duplicated records and a non-finished job violate even with an
+    EMPTY budget section — a lossy rescale must never exit 0 because no
+    perf ceiling was configured."""
+    from bench import check_rescale_budget
+    assert any("records_lost" in v
+               for v in check_rescale_budget(_rescale_result(lost=3), {}))
+    assert any("records_duplicated" in v
+               for v in check_rescale_budget(_rescale_result(dup=1), {}))
+    assert any("did not finish" in v
+               for v in check_rescale_budget(
+                   _rescale_result(state="Failed"), {}))
+
+
+def test_check_rescale_budget_floors_and_ceilings():
+    from bench import check_rescale_budget
+    b = _rescale_budget()
+    assert any("rescales" in v for v in check_rescale_budget(
+        _rescale_result(rescales=0), b))
+    assert any("rollbacks" in v for v in check_rescale_budget(
+        _rescale_result(rollbacks=1), b))
+    assert any("rescale latency" in v for v in check_rescale_budget(
+        _rescale_result(latency=30000.0), b))
+    assert any("recovery" in v for v in check_rescale_budget(
+        _rescale_result(recovery=90000.0), b))
+    # recovery ceiling is full-run only (smoke streams are too short for
+    # a meaningful drain measurement)
+    assert check_rescale_budget(_rescale_result(recovery=90000.0), b,
+                                smoke=True) == []
+
+
+def test_autoscale_bench_smoke_passes_gate():
+    """bench.py --autoscale --smoke --check end-to-end on CPU: the
+    autoscaler reacts to the diurnal curve (>= 1 rescale via an unaligned
+    cut + channel-state redistribution) with ZERO records lost or
+    duplicated, and the committed rescale_cpu gate passes."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--autoscale",
+         "--smoke", "--records", "80000", "--check"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"] and result["state"] == "Finished"
+    assert result["records_lost"] == 0
+    assert result["records_duplicated"] == 0
+    assert result["rescales"] >= 1
+    assert max(result["parallelism_path"]) >= 4
+    assert result["rescale_latency_ms"] is not None

@@ -1,0 +1,817 @@
+"""Rescale under fire (ISSUE-14): channel-state redistribution on rescale
+restores of unaligned checkpoints, the reactive autoscaler, and the
+chaos-proof rescale lifecycle (deadline, rollback, idempotent re-trigger).
+
+Reference semantics: the FLIP-76 follow-on (channel-state redistribution
+on restore at a new parallelism — ``StateAssignmentOperation.
+reDistributeKeyedStates`` for in-flight data) + FLIP-160's reactive
+scheduler, closed over the job's own backpressure gauges.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import formats
+from flink_tpu.cluster.adaptive import (AutoscalerPolicy, ReactiveAutoscaler,
+                                        SchedulerStates, counts_for_plan,
+                                        maybe_rescale_restore,
+                                        rescale_snapshot)
+from flink_tpu.cluster.channels import LocalChannel
+from flink_tpu.cluster.minicluster import MiniCluster
+from flink_tpu.cluster.task import Subtask, TaskStates
+from flink_tpu.core.batch import (CheckpointBarrier, EndOfInput, RecordBatch,
+                                  Watermark)
+from flink_tpu.core.functions import RuntimeContext
+from flink_tpu.core.keygroups import route_raw_keys
+from flink_tpu.datastream.api import StreamExecutionEnvironment
+from flink_tpu.runtime.checkpoint.storage import InMemoryCheckpointStorage
+from flink_tpu.state.redistribute import (ChannelStateRescaleError,
+                                          redistribute_channel_state)
+from flink_tpu.testing import chaos
+from flink_tpu.testing.chaos import (ClockSkew, FailTimes, FaultInjector,
+                                     KillDuringRescale, SlowConsumer)
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+pytestmark = pytest.mark.chaos
+
+MAXP = 128
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    chaos.uninstall()
+
+
+def _batch(keys, vals=None):
+    keys = np.asarray(keys, np.int64)
+    vals = (np.ones(len(keys), np.float64) if vals is None
+            else np.asarray(vals, np.float64))
+    return RecordBatch({"k": keys, "v": vals})
+
+
+def _hash_input(logical=0, key_column="k", maxp=MAXP):
+    return {"partitioning": "hash", "key_column": key_column,
+            "max_parallelism": maxp, "logical": logical}
+
+
+def _v2_section(elements, inputs):
+    return {"version": 2, "elements": elements, "inputs": inputs,
+            "persisted_bytes": 1, "overtaken_bytes": 1,
+            "alignment_ms": 2.0, "unaligned": True}
+
+
+# ---------------------------------------------------------------------------
+# redistribute_channel_state: route-by-key correctness
+# ---------------------------------------------------------------------------
+
+def test_route_by_key_correctness_p1_to_7():
+    """Every persisted keyed row lands on exactly the subtask
+    ``route_raw_keys`` assigns its key to, at every parallelism 1..7,
+    with per-subtask relative order preserved."""
+    rng = np.random.default_rng(7)
+    all_keys = [rng.integers(0, 1000, 37), rng.integers(0, 1000, 11),
+                rng.integers(0, 1000, 23)]
+    sections = [
+        _v2_section([(0, _batch(all_keys[0])), (0, _batch(all_keys[1]))],
+                    [_hash_input()]),
+        _v2_section([(0, _batch(all_keys[2]))], [_hash_input()]),
+    ]
+    flat_keys = np.concatenate(all_keys)
+    for p in range(1, 8):
+        secs = redistribute_channel_state(sections, p)
+        assert len(secs) == p
+        seen = []
+        for t, sec in enumerate(secs):
+            assert sec["version"] == 2 and sec["by_logical_port"]
+            expect_order = [k for k in flat_keys
+                            if route_raw_keys(np.asarray([k]), p, MAXP)[0]
+                            == t]
+            got = [int(k) for _port, el in sec["elements"]
+                   for k in np.asarray(el.column("k"))]
+            for k in got:
+                assert route_raw_keys(np.asarray([k]), p, MAXP)[0] == t, \
+                    f"key {k} misrouted to subtask {t} at P={p}"
+            assert got == expect_order, \
+                f"P={p} subtask {t}: relative order not preserved"
+            seen.extend(got)
+        assert sorted(seen) == sorted(int(k) for k in flat_keys), \
+            f"P={p}: rows lost or duplicated by redistribution"
+
+
+def test_route_prefers_batch_key_groups_over_key_column():
+    """A batch already carrying key_groups (keyed upstream) routes by
+    them — the exact groups the live dispatcher would use."""
+    from flink_tpu.core import keygroups
+    keys = np.arange(50, dtype=np.int64)
+    kg = keygroups.assign_to_key_group(keygroups.hash_keys(keys), MAXP)
+    b = RecordBatch({"k": keys, "v": np.ones(50)}, key_groups=kg)
+    secs = redistribute_channel_state(
+        [_v2_section([(0, b)], [{"partitioning": "forward",
+                                 "max_parallelism": MAXP, "logical": 0,
+                                 "key_column": None}])], 4)
+    total = 0
+    for t, sec in enumerate(secs):
+        for _p, el in sec["elements"]:
+            total += len(el)
+            tgt = (np.asarray(el.key_groups, np.int64) * 4) // MAXP
+            assert (tgt == t).all()
+    assert total == 50
+
+
+def test_non_keyed_and_control_elements_replay_on_subtask_zero():
+    rebalance_in = {"partitioning": "rebalance", "key_column": None,
+                    "max_parallelism": MAXP, "logical": 0}
+    sec = _v2_section([(0, _batch([1, 2, 3])), (0, Watermark(77))],
+                      [rebalance_in])
+    secs = redistribute_channel_state([sec], 3)
+    assert [len(s["elements"]) for s in secs] == [2, 0, 0]
+    kinds = [type(el).__name__ for _p, el in secs[0]["elements"]]
+    assert kinds == ["RecordBatch", "Watermark"]
+
+
+def test_redistributed_sections_are_re_redistributable():
+    """A redistributed section carries port-indexed routing metadata, so
+    a SECOND pass (e.g. restoring a rewritten savepoint at yet another
+    parallelism) routes by the same key/max-parallelism as the first —
+    never the defaults."""
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 500, 64)
+    maxp = 64   # NON-default: a second pass falling back to 128 would
+    #             route differently and the coverage check would fail
+    sec = _v2_section([(0, _batch(keys))],
+                      [_hash_input(maxp=maxp, logical=1)])
+    first = redistribute_channel_state([sec], 1)   # collapse to one
+    assert first[0]["inputs"][1]["max_parallelism"] == maxp
+    second = redistribute_channel_state(first, 5)
+    seen = []
+    for t, s in enumerate(second):
+        for port, el in s["elements"]:
+            assert port == 1, "logical port lost across passes"
+            for k in np.asarray(el.column("k")):
+                assert route_raw_keys(np.asarray([k]), 5, maxp)[0] == t, \
+                    f"second pass misrouted key {k} (wrong max_parallelism)"
+                seen.append(int(k))
+    assert sorted(seen) == sorted(int(k) for k in keys)
+
+
+def test_v1_section_with_elements_fails_loudly():
+    v1 = {"version": 1, "elements": [(0, _batch([1]))],
+          "persisted_bytes": 1, "overtaken_bytes": 1,
+          "alignment_ms": 1.0, "unaligned": True}
+    with pytest.raises(ChannelStateRescaleError, match="v1"):
+        redistribute_channel_state([v1], 2)
+    # empty v1 sections pass (aligned checkpoints written by old runtimes)
+    empty = dict(v1, elements=[])
+    out = redistribute_channel_state([empty], 2)
+    assert all(not s["elements"] for s in out)
+
+
+def test_unknown_version_fails_loudly():
+    with pytest.raises(ValueError, match="99"):
+        redistribute_channel_state(
+            [{"version": 99, "elements": [(0, _batch([1]))]}], 2)
+
+
+# ---------------------------------------------------------------------------
+# v2 write format + replay-before-input ordering
+# ---------------------------------------------------------------------------
+
+class _SeenOp:
+    """Stateful test operator recording per-row arrival order."""
+
+    name = "seen"
+    forwards_watermarks = True
+    is_stateless = False
+    is_two_input = False
+
+    def open(self, ctx):
+        self.seen = []
+        self.total = 0.0
+
+    def process_batch(self, batch):
+        vals = np.asarray(batch.column("v"))
+        self.total += float(vals.sum())
+        self.seen.extend(int(k) for k in np.asarray(batch.column("k")))
+        return []
+
+    def process_watermark(self, wm):
+        return []
+
+    def on_processing_time(self, ts):
+        return []
+
+    def end_input(self):
+        return []
+
+    def snapshot_state(self):
+        return {"total": self.total}
+
+    def restore_state(self, snap):
+        self.total = snap["total"]
+
+    def notify_checkpoint_complete(self, cid):
+        pass
+
+    def close(self):
+        pass
+
+
+class _Recorder:
+    def __init__(self):
+        self.acks = {}
+        self.declines = []
+        self.states = []
+
+    def task_state_changed(self, uid, idx, state, error):
+        self.states.append((state, error))
+
+    def acknowledge_checkpoint(self, cid, uid, idx, snap):
+        self.acks[cid] = snap
+
+    def decline_checkpoint(self, cid, uid, idx, error):
+        self.declines.append((cid, error))
+
+
+class _Out:
+    def __init__(self):
+        self.elements = []
+        self.channels = []
+
+    def emit(self, el):
+        self.elements.append(el)
+
+
+def test_subtask_writes_v2_section_with_input_routing():
+    """The unaligned snapshot carries the v2 section: elements plus the
+    per-input routing metadata the deploying cluster captured."""
+    ch0, ch1 = LocalChannel(16, "c0"), LocalChannel(16, "c1")
+    rec = _Recorder()
+    t = Subtask("v1", 0, _SeenOp(), [_Out()], RuntimeContext(), rec,
+                [ch0, ch1], unaligned=True,
+                input_routing=[_hash_input(), _hash_input(logical=1)])
+    t.start()
+    ch0.put(_batch([1]))
+    time.sleep(0.05)
+    ch0.put(CheckpointBarrier(1, 0))
+    time.sleep(0.05)
+    ch1.put(_batch([5]))
+    time.sleep(0.05)
+    ch1.put(CheckpointBarrier(1, 0))
+    ch0.put(EndOfInput())
+    ch1.put(EndOfInput())
+    t.join()
+    cs = rec.acks[1]["channel_state"]
+    assert cs["version"] == 2 and cs["unaligned"]
+    assert len(cs["elements"]) == 1
+    assert cs["inputs"][0]["key_column"] == "k"
+    assert cs["inputs"][0]["max_parallelism"] == MAXP
+    assert cs["inputs"][1]["logical"] == 1
+    # the recorded section round-trips through redistribution
+    secs = redistribute_channel_state([cs], 3)
+    routed = sum(len(el) for s in secs for _p, el in s["elements"])
+    assert routed == 1
+
+
+def test_redistributed_section_replays_before_new_input():
+    """A by-logical-port (rescale-redistributed) section replays its
+    elements into the operator strictly BEFORE any new channel input —
+    the PR-5 ordering contract, preserved across the parallelism change."""
+    ch = LocalChannel(16, "c0")
+    rec = _Recorder()
+    op = _SeenOp()
+    section = {"version": 2, "by_logical_port": True,
+               "elements": [(0, _batch([101])), (0, _batch([102]))],
+               "inputs": [], "persisted_bytes": 8, "overtaken_bytes": 8,
+               "alignment_ms": 1.0, "unaligned": True}
+    t = Subtask("v1", 0, op, [_Out()], RuntimeContext(), rec, [ch],
+                input_routing=[_hash_input()])
+    t.start({"operator": {"total": 0.0}, "channel_state": section})
+    ch.put(_batch([7]))
+    ch.put(EndOfInput())
+    t.join()
+    assert t.state == TaskStates.FINISHED
+    assert op.seen == [101, 102, 7]
+
+
+# ---------------------------------------------------------------------------
+# rescale_snapshot / maybe_rescale_restore plumbing
+# ---------------------------------------------------------------------------
+
+class _PacedFileSource:
+    """Load-curve source: a FileSource whose reader paces batch emission
+    (the millions-of-users arrival-rate model — without pacing an
+    in-process source always saturates the pipeline and queue depth stops
+    meaning 'overloaded').  Built lazily to dodge import-order issues."""
+
+    def __new__(cls, path, pace_s: float, **kw):
+        from flink_tpu.connectors.file_source import FileSource
+
+        class Paced(FileSource):
+            def _read_file(self, p, start_row):
+                for el in super()._read_file(p, start_row):
+                    if isinstance(el, RecordBatch):
+                        time.sleep(pace_s)
+                    yield el
+
+        return Paced(path, **kw)
+
+
+def _window_plan_factory(tmp_path, n=24_000, n_files=2, keys_mod=31,
+                         batch_size=128, sink=None, pace_s=0.0):
+    """Stable-split (file) keyed window job: parallelism-independent
+    source splits, key_by -> tumbling window sum -> shared collect sink.
+    ``pace_s`` > 0 paces each split's batch emission (load-curve mode)."""
+    from flink_tpu.connectors.sinks import CollectSink
+
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    written = tmp_path / "_written"
+    if not written.exists():
+        per = n // n_files
+        for i in range(n_files):
+            lo = i * per
+            ks = (np.arange(lo, lo + per) % keys_mod).astype(np.int64)
+            ts = np.sort(np.arange(per) * (4000 // per)).astype(np.int64)
+            formats.write_csv(
+                [RecordBatch({"k": ks, "v": np.ones(per), "t": ts})],
+                str(tmp_path / f"in{i}.csv"))
+        written.mkdir()
+    sink = sink if sink is not None else CollectSink()
+
+    def plan_factory(parallelism):
+        from flink_tpu.connectors.file_source import FileSource
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(parallelism)
+        src = (_PacedFileSource(str(tmp_path), pace_s, format="csv",
+                                batch_size=batch_size) if pace_s > 0
+               else FileSource(str(tmp_path), format="csv",
+                               batch_size=batch_size))
+        (env.from_source(src)
+         .assign_timestamps_and_watermarks(0, timestamp_column="t")
+         .key_by("k")
+         .window(TumblingEventTimeWindows.of(1000))
+         .sum("v").add_sink(sink))
+        return env.get_stream_graph("rescale-job").to_plan()
+
+    return plan_factory, sink
+
+
+def _digest(sink):
+    return sorted(tuple(sorted((k, float(v)) for k, v in r.items()
+                               if k != "__ts__"))
+                  for r in sink.rows())
+
+
+def _expected_per_key(n, keys_mod):
+    expect = {}
+    for k in (np.arange(n) % keys_mod).tolist():
+        expect[k] = expect.get(k, 0) + 1.0
+    return expect
+
+
+def _per_key_counters(sink):
+    final = {}
+    for r in sink.rows():
+        final[int(r["k"])] = final.get(int(r["k"]), 0) + float(r["v"])
+    return final
+
+
+def test_shared_sink_merge_is_owner_filtered_union():
+    """Shared collect-sink members merge by per-key OWNER filtering: each
+    subtask's copy of the shared row list contributes exactly the rows of
+    keys it owns, so a fire present only in its owner's (later) copy is
+    kept, and rows present in every copy appear exactly once."""
+    from flink_tpu.cluster.adaptive import _union_shared_sink_members
+
+    P, maxp = 2, MAXP
+    keys = np.arange(40, dtype=np.int64)
+    owner = route_raw_keys(keys, P, maxp)
+    k0 = keys[owner == 0]
+    k1 = keys[owner == 1]
+
+    def copy_of(ks):
+        return {"batches": [({"k": np.asarray(ks, np.int64),
+                              "v": np.ones(len(ks))}, None)]}
+
+    # subtask 0 snapshotted EARLY: it has its own fires but is missing
+    # subtask 1's last fire (k1[-1]); subtask 1's later copy has all
+    ops = [{"op0": {}, "op2": copy_of(np.concatenate([k0, k1[:-1]]))},
+           {"op0": {}, "op2": copy_of(np.concatenate([k0, k1]))}]
+    _union_shared_sink_members(ops, "k", maxp)
+    merged = np.sort(np.concatenate(
+        [np.asarray(c["k"]) for c, _t in ops[0]["op2"]["batches"]]))
+    assert merged.tolist() == sorted(keys.tolist()), \
+        "owner union lost or duplicated fire rows"
+    assert ops[1]["op2"] == {}
+
+
+def test_maybe_rescale_restore_identity_and_mismatch(tmp_path):
+    plan_factory, _sink = _window_plan_factory(tmp_path, n=2000)
+    plan2 = plan_factory(2)
+    counts2 = counts_for_plan(plan2)
+    win_uid = next(v.uid for v in plan2.vertices if not v.is_source)
+    snap = {"__job__": {"parallelism": dict(counts2)},
+            win_uid: {"subtasks": [{"operator": {}}, {"operator": {}}]}}
+    assert maybe_rescale_restore(snap, plan2) is snap   # counts match
+    plan4 = plan_factory(4)
+    out = maybe_rescale_restore(snap, plan4)
+    assert out is not snap
+    assert len(out[win_uid]["subtasks"]) == 4
+
+
+def test_rescale_snapshot_fires_redistribute_chaos_point(tmp_path):
+    plan_factory, _sink = _window_plan_factory(tmp_path, n=2000)
+    plan2, plan4 = plan_factory(2), plan_factory(4)
+    win_uid = next(v.uid for v in plan2.vertices if not v.is_source)
+    snap = {win_uid: {"subtasks": [{"operator": {}}, {"operator": {}}]}}
+    inj = FaultInjector(seed=3)
+    inj.inject("rescale.redistribute", KillDuringRescale(at=1))
+    with chaos.installed(inj):
+        with pytest.raises(chaos.InjectedFault, match="rescale"):
+            rescale_snapshot(snap, plan4, counts_for_plan(plan4))
+        # second attempt (re-trigger) proceeds — the kill fires once
+        out = rescale_snapshot(snap, plan4, counts_for_plan(plan4))
+        assert len(out[win_uid]["subtasks"]) == 4
+        # same-parallelism calls (rollback shape) never fire the point
+        rescale_snapshot(snap, plan2, counts_for_plan(plan2))
+    assert inj.fired("rescale.redistribute") == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: rescale a BACKPRESSURED job from an unaligned checkpoint
+# ---------------------------------------------------------------------------
+
+def _run_to_cut(plan_factory, storage, seed=23, stall_times=3000):
+    """Run the job at parallelism 2 under SlowConsumer backpressure, take
+    a mid-stream unaligned cut, cancel.  Returns (cut_id, raw_snapshot)."""
+    inj = FaultInjector(seed=seed)
+    inj.inject("channel.recv",
+               SlowConsumer(max_s=0.05, min_s=0.02, p=0.5, burst=60,
+                            times=stall_times, channel="[0]->"))
+    plan = plan_factory(2)
+    cluster = MiniCluster(checkpoint_storage=storage,
+                          checkpoint_interval_ms=30,
+                          alignment_timeout_ms=100,
+                          tolerable_failed_checkpoints=-1)
+    done = {}
+
+    def run():
+        done["res"] = cluster.execute(plan, timeout_s=300)
+
+    th = threading.Thread(target=run, daemon=True)
+    with chaos.installed(inj):
+        th.start()
+        # wait for the stream to be genuinely mid-flight
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            tasks = getattr(cluster, "_tasks", [])
+            if sum(t.records_in for t in tasks
+                   if not hasattr(t, "split")) > 2000:
+                break
+            time.sleep(0.02)
+        cut = None
+        for _attempt in range(12):
+            cid = cluster.checkpoint(timeout_s=30)
+            if cid is None:
+                break
+            raw = storage.load(cid)
+            persisted = sum(
+                len((sub or {}).get("channel_state", {}).get("elements", []))
+                for uid, entry in raw.items() if not uid.startswith("__")
+                for sub in entry.get("subtasks", []))
+            if persisted > 0:
+                cut = (cid, raw)
+                break
+        cluster.cancel()
+        th.join(timeout=60)
+    assert cut is not None, \
+        "no unaligned cut with persisted in-flight elements could be taken"
+    return cut
+
+
+def test_rescale_backpressured_job_from_unaligned_checkpoint(tmp_path):
+    """The tentpole mechanism end-to-end, deterministically staged: a
+    SlowConsumer-backpressured job's UNALIGNED checkpoint (persisted
+    in-flight elements present) restores at parallelism 4 through
+    channel-state redistribution, and the continued job's fire digests +
+    per-key counters equal the unfaulted fixed-parallelism control —
+    ``reject_channel_state`` never fires on this path."""
+    n, keys_mod = 24_000, 31
+    # control: unfaulted, fixed parallelism 2
+    ctl_factory, ctl_sink = _window_plan_factory(tmp_path / "ctl", n=n,
+                                                 keys_mod=keys_mod)
+    ctl = MiniCluster()
+    res = ctl.execute(ctl_factory(2), timeout_s=300)
+    assert res.state == TaskStates.FINISHED
+    control_digest = _digest(ctl_sink)
+    assert _per_key_counters(ctl_sink) == _expected_per_key(n, keys_mod)
+
+    # faulted run: cut mid-stream under backpressure, rescale 2 -> 4
+    plan_factory, sink = _window_plan_factory(tmp_path / "run", n=n,
+                                              keys_mod=keys_mod)
+    storage = InMemoryCheckpointStorage(retain=10)
+    _cid, raw = _run_to_cut(plan_factory, storage)
+    plan4 = plan_factory(4)
+    restore = rescale_snapshot(raw, plan4, counts_for_plan(plan4))
+    # the redistributed restore carries the in-flight elements
+    carried = sum(
+        len((sub or {}).get("channel_state", {}).get("elements", []))
+        for uid, entry in restore.items() if not uid.startswith("__")
+        for sub in entry.get("subtasks", []))
+    assert carried > 0
+    cont = MiniCluster()
+    res2 = cont.execute(plan4, restore=restore, timeout_s=300)
+    assert res2.state == TaskStates.FINISHED
+    assert _digest(sink) == control_digest
+    assert _per_key_counters(sink) == _expected_per_key(n, keys_mod)
+
+
+# ---------------------------------------------------------------------------
+# reactive autoscaler: hysteresis / cooldown units
+# ---------------------------------------------------------------------------
+
+def _signals(depth=0, align=0, bp=0.0, p99=None):
+    return {"max_queue_depth": depth, "alignment_queued_elements": align,
+            "backpressured_ms_delta": bp, "latency_p99_ms": p99}
+
+
+def test_policy_scale_out_needs_sustained_overload():
+    p = AutoscalerPolicy(min_parallelism=2, max_parallelism=8,
+                         sustain_polls=3, cooldown_ms=0.0,
+                         scale_out_queue_depth=16)
+    assert p.observe(_signals(depth=20), 2) is None
+    assert p.observe(_signals(depth=20), 2) is None
+    assert p.observe(_signals(depth=20), 2) == 4
+    # one calm poll resets the streak
+    assert p.observe(_signals(depth=20), 2) is None
+    assert p.observe(_signals(depth=5), 2) is None    # dead band resets
+    assert p.observe(_signals(depth=20), 2) is None
+    assert p.observe(_signals(depth=20), 2) is None
+    assert p.observe(_signals(depth=20), 2) == 4
+
+
+def test_policy_scale_in_and_bounds():
+    p = AutoscalerPolicy(min_parallelism=2, max_parallelism=4,
+                         sustain_polls=2, cooldown_ms=0.0,
+                         scale_in_queue_depth=2)
+    assert p.observe(_signals(depth=0), 4) is None
+    assert p.observe(_signals(depth=0), 4) == 2
+    # at min parallelism: never below
+    assert p.observe(_signals(depth=0), 2) is None
+    assert p.observe(_signals(depth=0), 2) is None
+    # at max parallelism: never above
+    assert p.observe(_signals(depth=99), 4) is None
+    assert p.observe(_signals(depth=99), 4) is None
+    assert p.observe(_signals(depth=99), 4) is None
+
+
+def test_policy_alignment_queue_and_p99_trigger_scale_out():
+    p = AutoscalerPolicy(sustain_polls=1, cooldown_ms=0.0,
+                         scale_out_alignment_queued=100,
+                         scale_out_p99_ms=500.0, max_parallelism=8)
+    assert p.observe(_signals(align=200), 2) == 4
+    p2 = AutoscalerPolicy(sustain_polls=1, cooldown_ms=0.0,
+                          scale_out_p99_ms=500.0, max_parallelism=8)
+    assert p2.observe(_signals(p99=900.0), 2) == 4
+
+
+def test_policy_cooldown_blocks_consecutive_decisions():
+    p = AutoscalerPolicy(sustain_polls=1, cooldown_ms=60_000.0,
+                         max_parallelism=16)
+    assert p.observe(_signals(depth=99), 2) == 4
+    for _ in range(20):
+        assert p.observe(_signals(depth=99), 4) is None
+    assert p.in_cooldown() and p.cooldown_remaining_ms() > 0
+
+
+def test_policy_cooldown_is_skew_proof():
+    """Satellite: ClockSkew on the monotonic seam (backward steps +
+    jitter + forward jumps) must not turn the cooldown into a rescale
+    storm — MonotoneElapsed clamps at its high-water, so the one allowed
+    decision happens and the cooldown then HOLDS."""
+    inj = FaultInjector(seed=11)
+    inj.inject("clock.monotonic",
+               ClockSkew(jumps=[(3, -5000.0), (8, 4000.0), (15, -4000.0)],
+                         jitter_ms=200.0))
+    decisions = 0
+    with chaos.installed(inj):
+        p = AutoscalerPolicy(sustain_polls=1, cooldown_ms=60_000.0,
+                             max_parallelism=64)
+        cur = 2
+        for _ in range(60):
+            t = p.observe(_signals(depth=99), cur)
+            if t is not None:
+                decisions += 1
+                cur = t
+    assert decisions == 1, \
+        f"clock skew produced a rescale storm ({decisions} decisions)"
+
+
+# ---------------------------------------------------------------------------
+# reactive autoscaler: end-to-end acceptance (2 -> 4 -> 2 under fire)
+# ---------------------------------------------------------------------------
+
+N_ACC = 60_000
+ACC_PACE_S = 0.012
+ACC_BATCH = 100
+KEYS_MOD = 31
+
+
+@pytest.fixture(scope="module")
+def control_digest(tmp_path_factory):
+    """Unfaulted fixed-parallelism control for the acceptance runs."""
+    tmp = tmp_path_factory.mktemp("control")
+    factory, sink = _window_plan_factory(tmp, n=N_ACC, keys_mod=KEYS_MOD,
+                                         batch_size=ACC_BATCH,
+                                         pace_s=ACC_PACE_S)
+    res = MiniCluster().execute(factory(2), timeout_s=300)
+    assert res.state == TaskStates.FINISHED
+    return _digest(sink)
+
+
+def _acceptance_policy():
+    return AutoscalerPolicy(min_parallelism=2, max_parallelism=4,
+                            scale_out_queue_depth=12,
+                            scale_in_queue_depth=2,
+                            sustain_polls=2, cooldown_ms=300.0)
+
+
+def _run_autoscaled(tmp_path, extra_faults=None, seed=23,
+                    stall_times=80):
+    factory, sink = _window_plan_factory(tmp_path, n=N_ACC,
+                                         keys_mod=KEYS_MOD,
+                                         batch_size=ACC_BATCH,
+                                         pace_s=ACC_PACE_S)
+    inj = FaultInjector(seed=seed)
+    inj.inject("channel.recv",
+               SlowConsumer(max_s=0.04, min_s=0.015, p=0.4, burst=50,
+                            times=stall_times, channel="[0]->"))
+    for point, schedule in (extra_faults or {}).items():
+        inj.inject(point, schedule)
+    storage = InMemoryCheckpointStorage(retain=10)
+    scaler = ReactiveAutoscaler(
+        factory, checkpoint_storage=storage,
+        policy=_acceptance_policy(), initial_parallelism=2,
+        poll_interval_ms=15.0, checkpoint_interval_ms=30,
+        alignment_timeout_ms=100.0, restart_attempts=4,
+        job_timeout_s=300.0)
+    with chaos.installed(inj):
+        scaler.start()
+        scaler.join(timeout_s=300)
+    return scaler, sink, storage, inj
+
+
+def test_acceptance_autoscaled_2_4_2_exactly_once(tmp_path,
+                                                  control_digest):
+    """THE acceptance: a SlowConsumer-backpressured job autoscales out at
+    the (injected) peak and back in after it, through unaligned cuts with
+    redistributed channel state, and the fire digests + per-key counters
+    are bit-identical to the unfaulted fixed-parallelism control."""
+    scaler, sink, storage, _inj = _run_autoscaled(tmp_path)
+    assert scaler.state == SchedulerStates.FINISHED, \
+        (scaler.state, scaler.error)
+    st = scaler.status()
+    assert st["rescales"] >= 1, f"autoscaler never rescaled: {st}"
+    assert max(st["parallelism_path"]) >= 4, st["parallelism_path"]
+    # scale-in after the stall period ended (the diurnal trough)
+    assert st["parallelism_path"][-1] < max(st["parallelism_path"]), \
+        f"never scaled back in: {st['parallelism_path']}"
+    assert st["rollbacks"] == 0
+    assert _per_key_counters(sink) == _expected_per_key(N_ACC, KEYS_MOD), \
+        "exactly-once across autoscale violated"
+    assert _digest(sink) == control_digest
+
+
+def test_acceptance_kill_during_rescale_is_idempotent(tmp_path,
+                                                      control_digest):
+    """A kill INSIDE the rescale window (chaos at rescale.redistribute):
+    the lifecycle re-triggers from the same immutable cut and the run
+    stays exactly-once — digests equal the unfaulted control."""
+    scaler, sink, _storage, inj = _run_autoscaled(
+        tmp_path, extra_faults={
+            "rescale.redistribute": KillDuringRescale(at=1)})
+    assert scaler.state == SchedulerStates.FINISHED, \
+        (scaler.state, scaler.error)
+    st = scaler.status()
+    assert st["rescales"] >= 1
+    assert st["retriggers"] >= 1, \
+        "the injected kill never exercised the re-trigger path"
+    assert inj.fired("rescale.redistribute") >= 2
+    assert _per_key_counters(sink) == _expected_per_key(N_ACC, KEYS_MOD)
+    assert _digest(sink) == control_digest
+
+
+def test_acceptance_rollback_on_redeploy_failure(tmp_path,
+                                                 control_digest):
+    """Redeploy failing past the retry budget ROLLS BACK to the old
+    parallelism from the pre-rescale checkpoint — the job completes
+    exactly-once at the old parallelism."""
+    scaler, sink, _storage, _inj = _run_autoscaled(
+        tmp_path, extra_faults={"rescale.redeploy": FailTimes(2)})
+    assert scaler.state == SchedulerStates.FINISHED, \
+        (scaler.state, scaler.error)
+    st = scaler.status()
+    assert st["rollbacks"] >= 1, f"no rollback recorded: {st}"
+    assert st["retriggers"] >= 1
+    assert _per_key_counters(sink) == _expected_per_key(N_ACC, KEYS_MOD)
+    assert _digest(sink) == control_digest
+
+
+def test_acceptance_worker_killed_mid_redeploy(tmp_path, control_digest):
+    """A subtask crashing right after the rescale redeploy: the cluster's
+    own restart strategy restores — through maybe_rescale_restore — from
+    the pre-rescale (old parallelism) checkpoint, idempotently.  Still
+    exactly-once."""
+    # the crash fires on the ~40th batch processed AFTER the redeploy's
+    # fresh injector counters — i.e., inside the post-rescale window
+    from flink_tpu.testing.chaos import CrashOnceAt
+    scaler, sink, _storage, inj = _run_autoscaled(
+        tmp_path, extra_faults={"subtask.run": CrashOnceAt(260)})
+    assert scaler.state == SchedulerStates.FINISHED, \
+        (scaler.state, scaler.error)
+    assert inj.fired("subtask.run") >= 260
+    assert _per_key_counters(sink) == _expected_per_key(N_ACC, KEYS_MOD)
+    assert _digest(sink) == control_digest
+
+
+# ---------------------------------------------------------------------------
+# savepoints: still aligned, still rescalable the old way
+# ---------------------------------------------------------------------------
+
+def test_savepoints_stay_aligned_and_split_without_channel_state(tmp_path):
+    """Savepoints never escalate (PR-5 contract, unchanged): their v2
+    sections have empty elements, and rescale_snapshot splits them
+    without attaching channel state to the new subtasks."""
+    factory, _sink = _window_plan_factory(tmp_path, n=8000)
+    storage = InMemoryCheckpointStorage(retain=5)
+    cluster = MiniCluster(checkpoint_storage=storage,
+                          alignment_timeout_ms=0)   # pure unaligned mode
+    done = {}
+
+    def run():
+        done["res"] = cluster.execute(factory(2), timeout_s=120)
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    time.sleep(0.15)
+    sp = cluster.savepoint()
+    th.join(timeout=120)
+    if sp is None:
+        pytest.skip("job finished before the savepoint could complete")
+    raw = storage.load(sp)
+    for uid, entry in raw.items():
+        if uid.startswith("__"):
+            continue
+        for sub in entry.get("subtasks", []):
+            cs = (sub or {}).get("channel_state")
+            if isinstance(cs, dict):
+                assert not cs["unaligned"] and cs["elements"] == []
+    plan4 = factory(4)
+    out = rescale_snapshot(raw, plan4, counts_for_plan(plan4))
+    for uid, entry in out.items():
+        if uid.startswith("__"):
+            continue
+        for sub in entry.get("subtasks", []):
+            cs = (sub or {}).get("channel_state")
+            assert cs is None or not cs.get("elements")
+
+
+# ---------------------------------------------------------------------------
+# observability: status / gauges / REST panel
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_status_gauges_and_panel(tmp_path):
+    from flink_tpu.metrics.groups import (MetricRegistry, autoscaler_metrics)
+    from flink_tpu.rest.views import autoscaler_html
+
+    factory, _sink = _window_plan_factory(tmp_path, n=2000)
+    scaler = ReactiveAutoscaler(factory, policy=_acceptance_policy(),
+                                initial_parallelism=2)
+    st = scaler.status()
+    for key in ("state", "current_parallelism", "target_parallelism",
+                "rescales", "rollbacks", "retriggers",
+                "last_rescale_duration_ms", "cooldown_remaining_ms",
+                "parallelism_path", "signals"):
+        assert key in st
+    reg = MetricRegistry()
+    g = autoscaler_metrics(reg.job_manager_group(), scaler.status)
+    names = set(reg.all_metrics())
+    assert {"jobmanager.autoscaler.current_parallelism",
+            "jobmanager.autoscaler.target_parallelism",
+            "jobmanager.autoscaler.rescales_total",
+            "jobmanager.autoscaler.rollbacks_total",
+            "jobmanager.autoscaler.last_rescale_duration_ms"} <= names
+    assert g is not None
+    html = autoscaler_html(st)
+    assert 'data-metric="rescales"' in html
+    assert 'data-metric="rollbacks"' in html
+    assert "as-panel" in html and "as-path" in html
+    assert autoscaler_html({}).count("off") >= 1
+
+    # the cluster an autoscaler deploys surfaces the status in job_status
+    cluster = scaler._make_cluster()
+    status = cluster.job_status()
+    assert status["autoscaler"]["current_parallelism"] == 2

@@ -247,7 +247,8 @@ def test_alignment_timeout_escalates_and_persists_inflight():
     # the in-flight 10.0 are post-snapshot effects
     assert snap["operator"]["total"] == 3.0
     cs = snap["channel_state"]
-    assert cs["version"] == 1 and cs["unaligned"]
+    # v2 write format (ISSUE-14): elements + per-input routing metadata
+    assert cs["version"] == 2 and cs["unaligned"]
     els = cs["elements"]
     assert [i for i, _ in els] == [1]
     assert float(np.asarray(els[0][1].column("v"))[0]) == 10.0
@@ -527,7 +528,7 @@ def test_savepoint_queue_overflow_declines_savepoint_not_task():
 # recovery: channel state replays before new input
 # ---------------------------------------------------------------------------
 
-def test_restore_replays_channel_state_before_new_input():
+def test_restore_replays_v1_channel_state_before_new_input():
     ch = LocalChannel(16, "c0")
     rec = _Recorder()
     op = _SumOp()
@@ -564,10 +565,12 @@ def test_unknown_channel_state_version_fails_loudly():
 
 
 # ---------------------------------------------------------------------------
-# rescale: drain-then-rescale fails loudly
+# rescale: the keyed rescale path now REDISTRIBUTES v2 channel state
+# (tests/test_rescale_under_fire.py); only redistribution-incapable paths
+# (and legacy v1 sections with elements) still fail loudly
 # ---------------------------------------------------------------------------
 
-def test_rescale_rejects_nonempty_channel_state():
+def test_reject_helper_rejects_nonempty_channel_state():
     snap = {"__job__": {"checkpoint_id": 7},
             "win": {"subtasks": [
                 {"operator": {}, "channel_state": {
@@ -575,7 +578,7 @@ def test_rescale_rejects_nonempty_channel_state():
                     "persisted_bytes": 24, "overtaken_bytes": 24,
                     "alignment_ms": 5.0, "unaligned": True}}]}}
     with pytest.raises(ChannelStateRescaleError, match="drain-then-rescale"):
-        reject_channel_state(snap, "rescale")
+        reject_channel_state(snap, "offline merge")
 
 
 def test_rescale_accepts_aligned_checkpoints():
