@@ -1132,66 +1132,9 @@ def run_checkpoint_backpressure(interval_ms: int, budget_ms: float,
     }
 
 
-class _DiurnalSource:
-    """Diurnal load-curve generator (ISSUE-14): a stable-split bounded
-    source whose per-batch emission pace follows a day curve — slow at the
-    edges (overnight trough), fastest in the middle (the traffic peak) —
-    so arrival rate crosses the (injected, per-dequeue) consumer capacity
-    mid-stream and recrosses it on the way down.  Splits are fixed (2 by
-    default) regardless of job parallelism: the autoscaler's stable-split
-    rescale contract."""
-
-    def __new__(cls, n_records: int, n_keys: int, batch_size: int,
-                span_ms: int, peak_s: float, trough_s: float,
-                n_splits: int = 2, seed: int = 31):
-        import math
-
-        from flink_tpu.connectors.sources import Source, SourceSplit
-        from flink_tpu.core.batch import RecordBatch
-
-        class Diurnal(Source):
-            bounded = True
-
-            def __init__(self):
-                rng = np.random.default_rng(seed)
-                per = n_records // n_splits
-                self._data = []
-                for i in range(n_splits):
-                    ks = rng.integers(0, n_keys, per).astype(np.int64)
-                    ts = np.sort(rng.integers(0, span_ms, per)).astype(
-                        np.int64)
-                    self._data.append((ks, ts))
-                nb = max(1, per // batch_size)
-                #: pace per batch index: trough at the edges, peak (the
-                #: smallest sleep = highest arrival rate) in the middle
-                self.paces = [
-                    trough_s - (trough_s - peak_s)
-                    * math.sin(math.pi * i / max(1, nb - 1))
-                    for i in range(nb + 2)]
-                #: per-split high-water batch index EVER emitted: the
-                #: deterministic replay after a rescale re-reads from
-                #: batch 0 and must fast-forward — re-sleeping the whole
-                #:  pre-cut day curve would add seconds of dead time per
-                #: restore and shift the remaining curve
-                self._progress = [0] * n_splits
-
-            def create_splits(self, parallelism):
-                return [SourceSplit(self, i, n_splits)
-                        for i in range(n_splits)]
-
-            def read_split(self, index, of):
-                ks, ts = self._data[index]
-                ones = np.ones(batch_size, np.float64)
-                for bi, lo in enumerate(range(0, len(ks), batch_size)):
-                    hi = min(lo + batch_size, len(ks))
-                    if bi >= self._progress[index]:
-                        time.sleep(self.paces[min(bi, len(self.paces) - 1)])
-                        self._progress[index] = bi + 1
-                    yield RecordBatch({"k": ks[lo:hi],
-                                       "v": ones[:hi - lo],
-                                       "t": ts[lo:hi]})
-
-        return Diurnal()
+# ONE diurnal implementation for --autoscale AND the scenario suite
+# (ISSUE-15: twin generators drift) — promoted to testing/workload.py
+from flink_tpu.testing.workload import DiurnalSource as _DiurnalSource  # noqa: E402
 
 
 def run_autoscale_bench(args) -> dict:
@@ -1274,11 +1217,7 @@ def run_autoscale_bench(args) -> dict:
     st = scaler.status()
 
     # exactly-once accounting: per-key window sums vs the generated data
-    expected: dict = {}
-    for i in range(2):
-        ks, _ts = source._data[i]
-        for k in ks.tolist():
-            expected[k] = expected.get(k, 0.0) + 1.0
+    expected = {k: s for k, (_c, s) in source.expected_per_key().items()}
     got: dict = {}
     for r in sink.rows():
         got[int(r["k"])] = got.get(int(r["k"]), 0.0) + float(r["v"])
@@ -1361,6 +1300,100 @@ def check_rescale_budget(result: dict, budget: dict,
     rec = result.get("recovery_ms")
     if not smoke and cap is not None and rec is not None and rec > cap:
         viol.append(f"throughput recovery {rec}ms > ceiling {cap}ms")
+    return viol
+
+
+def run_scenario_bench(args) -> dict:
+    """``--scenario <name>|all``: the scenario suite (ISSUE-15) — named
+    end-to-end exactly-once applications under the shared diurnal load
+    curve.  Each scenario runs its FAULTED leg (reactive autoscaler,
+    consumer-cost backpressure, nemeses armed at the peak: worker kill,
+    SlowConsumer, KillDuringRescale, and — full runs — WedgedDevice;
+    routed binary queryable readers at a paced QPS) plus an unfaulted
+    CONTROL leg over a bit-identical stream, then verifies the committed
+    transactional output is exactly-once: zero lost, zero duplicated,
+    digest-identical to the control, scenario cross-checks clean.  With
+    ``--check`` each scenario gates against its own BENCH_BUDGET.json
+    section (``scenario_fraud_cpu`` / ``scenario_session_cpu`` /
+    ``scenario_feature_cpu``)."""
+    from flink_tpu.scenarios import SCENARIOS, ScenarioHarness, get_scenario
+
+    names = (list(SCENARIOS) if args.scenario == "all"
+             else [args.scenario])
+    results = []
+    for name in names:
+        harness = ScenarioHarness(
+            get_scenario(name), smoke=args.smoke,
+            records=args.records or None,
+            full_nemeses=not args.smoke)
+        results.append(harness.run())
+    return {
+        "metric": "scenario suite: exactly-once applications under a "
+                  "diurnal load curve",
+        "ok": all(r["ok"] for r in results),
+        "scenarios": results,
+    }
+
+
+def check_scenario_budget(result: dict, budget: dict,
+                          smoke: bool = False) -> list:
+    """BENCH_BUDGET.json gate for ONE scenario result.  Exactly-once
+    gates UNCONDITIONALLY (even smoke, even with an empty budget
+    section): records lost or duplicated, a committed digest differing
+    from the unfaulted control, a failed cross-check, or an empty
+    committed output must never exit 0 because no perf floor was
+    configured."""
+    name = result.get("scenario", "?")
+    viol = []
+    if result.get("state") != "Finished":
+        viol.append(f"{name}: faulted job did not finish: "
+                    f"{result.get('state')} ({result.get('error')})")
+    if result.get("control_state") != "Finished":
+        viol.append(f"{name}: control job did not finish: "
+                    f"{result.get('control_state')} "
+                    f"({result.get('control_error')})")
+    lost = result.get("records_lost")
+    if lost != 0:
+        viol.append(f"{name}: records_lost {lost} != 0 — committed output "
+                    f"dropped rows under chaos")
+    dup = result.get("records_duplicated")
+    if dup != 0:
+        viol.append(f"{name}: records_duplicated {dup} != 0 — committed "
+                    f"output replayed rows twice")
+    if not result.get("digest_match"):
+        viol.append(f"{name}: committed-sink digest differs from the "
+                    f"unfaulted control")
+    for v in result.get("cross_check_violations", []):
+        viol.append(f"{name}: {v}")
+    if sum(result.get("committed_rows", {}).values()) <= 0:
+        viol.append(f"{name}: no committed output rows")
+    floor = budget.get("min_rescales", 1)
+    if result.get("rescales", 0) < floor:
+        viol.append(f"{name}: rescales {result.get('rescales')} < floor "
+                    f"{floor} — the autoscaler never reacted to the "
+                    f"diurnal curve")
+    cap = budget.get("max_rollbacks")
+    if cap is not None and result.get("rollbacks", 0) > cap:
+        viol.append(f"{name}: rollbacks {result.get('rollbacks')} > "
+                    f"ceiling {cap}")
+    if not smoke:
+        floor = budget.get("min_peak_rps")
+        peak = result.get("peak_records_per_sec")
+        if floor is not None and (peak or 0.0) < floor:
+            viol.append(f"{name}: sustained peak {peak} rec/s < floor "
+                        f"{floor}")
+        cap = budget.get("max_p99_ms")
+        p99 = result.get("latency_p99_ms")
+        if cap is not None and p99 is not None and p99 > cap:
+            viol.append(f"{name}: end-to-end p99 {p99}ms > ceiling "
+                        f"{cap}ms")
+        floor = budget.get("min_lookups_per_sec")
+        q = result.get("queryable") or {}
+        if floor is not None and q:
+            lps = q.get("lookups_per_sec", 0.0)
+            if lps < floor:
+                viol.append(f"{name}: queryable reads {lps}/s < floor "
+                            f"{floor}/s")
     return viol
 
 
@@ -2413,6 +2446,18 @@ def main():
                          "time and records lost/duplicated (must be 0); "
                          "with --check gates against BENCH_BUDGET.json "
                          "rescale_cpu")
+    ap.add_argument("--scenario", default="",
+                    help="scenario suite (ISSUE-15): run one named "
+                         "end-to-end exactly-once application "
+                         "(fraud_detection, sessionized_analytics, "
+                         "feature_store) or 'all' — the diurnal load "
+                         "curve drives the job under the reactive "
+                         "autoscaler with nemeses injected at the peak "
+                         "and routed queryable readers; the committed "
+                         "transactional output must be exactly-once and "
+                         "digest-identical to an unfaulted control; with "
+                         "--check gates each scenario against its "
+                         "BENCH_BUDGET.json scenario_*_cpu section")
     ap.add_argument("--inject-wedge", action="store_true",
                     help="standalone recovery smoke: wedge the hot-path "
                          "dispatch with a deterministic chaos schedule and "
@@ -2428,7 +2473,8 @@ def main():
 
     if args.trace and (args.cep or args.queryable or args.mesh_devices
                        or args.config != 2 or args.inject_wedge
-                       or args.checkpoint_interval or args.autoscale):
+                       or args.checkpoint_interval or args.autoscale
+                       or args.scenario):
         # --trace measures the HEADLINE single-chip workload's on/off legs;
         # the dedicated-mode branches below exit before the trace block, so
         # refuse loudly instead of silently writing no artifact
@@ -2461,6 +2507,28 @@ def main():
                   f"{result['budget_ms']} ms, state {result['state']}, "
                   f"{result['completed_checkpoints']} completed",
                   file=sys.stderr)
+        sys.exit(0 if result["ok"] else 1)
+
+    if args.scenario:
+        result = run_scenario_bench(args)
+        print(json.dumps(result))
+        for s in result["scenarios"]:
+            print(f"# scenario {s['scenario']}: {json.dumps(s)}",
+                  file=sys.stderr)
+        if args.check:
+            from flink_tpu.scenarios import get_scenario
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BUDGET.json")
+            with open(path) as f:
+                budgets = json.load(f)
+            viol = []
+            for s in result["scenarios"]:
+                section = get_scenario(s["scenario"]).budget_section
+                viol += check_scenario_budget(s, budgets.get(section, {}),
+                                              smoke=args.smoke)
+            for v in viol:
+                print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
+            sys.exit(1 if viol else 0)
         sys.exit(0 if result["ok"] else 1)
 
     if args.autoscale:

@@ -1,58 +1,41 @@
-"""Fraud detection walkthrough analog (flink-walkthroughs): a keyed process
-function with state flags accounts whose SMALL transaction is immediately
-followed by a LARGE one, emitting alerts to a side output.
+"""Fraud detection walkthrough (flink-walkthroughs analog), rebased onto
+the GATED scenario definition (ISSUE-15): the pattern and the CEP
+topology are imported from ``flink_tpu.scenarios.fraud_detection`` —
+the same bait/strike detection that ``bench.py --scenario
+fraud_detection`` runs under the diurnal load curve with chaos at the
+peak — so the shipped example and the gated workload cannot diverge.
 
     python -m flink_tpu run examples/fraud_detection.py
+
+A SMALL "bait" transaction immediately followed by a LARGE "strike" on
+the same account raises an alert; alerts print and collect.
 """
 
 import numpy as np
 
 
 def main(env):
-    from flink_tpu.core.batch import OutputTag
-    from flink_tpu.operators.process import KeyedProcessFunction
-    from flink_tpu.state.api import ValueStateDescriptor
-
-    alerts = OutputTag("alerts")
-    SMALL, LARGE = 1.0, 500.0
-
-    class Detector(KeyedProcessFunction):
-        def process_batch(self, ctx, batch):
-            flagged = ctx.state(ValueStateDescriptor("small_seen", default=0))
-            accounts = np.asarray(batch.column("account"))
-            amounts = np.asarray(batch.column("amount"))
-            carried, _ = flagged.get_rows(batch.key_ids)
-            carried = np.asarray(carried).astype(bool)
-            # sequential per-account scan WITHIN the batch (the per-record
-            # order matters for this pattern), seeded by the carried state
-            last_small = {}
-            fraud = np.zeros(len(batch), bool)
-            for i, (acct, amt) in enumerate(zip(accounts.tolist(),
-                                                amounts.tolist())):
-                prev = last_small.get(acct, carried[i])
-                fraud[i] = prev and amt > LARGE
-                last_small[acct] = amt < SMALL
-            if fraud.any():
-                ctx.side_output(alerts, {"account": accounts[fraud],
-                                         "amount": amounts[fraud]})
-            # persist each account's LAST small-flag for the next batch
-            final = np.asarray([last_small[a] for a in accounts.tolist()],
-                               np.int64)
-            flagged.put_rows(batch.key_ids, final)
-            return [batch]
+    from flink_tpu.scenarios.fraud_detection import (LARGE_MIN, SMALL_MAX,
+                                                     detect_frauds)
 
     rng = np.random.default_rng(7)
     n = 10_000
-    accounts = rng.integers(0, 50, n)
-    amounts = rng.random(n) * 100
+    accounts = rng.integers(0, 50, n).astype(np.int64)
+    # legitimate traffic sits strictly between the thresholds
+    amounts = SMALL_MAX + rng.random(n) * (LARGE_MIN - SMALL_MAX)
+    ts = np.arange(n, dtype=np.int64)
     # plant bait -> strike sequences for three accounts
     for acct, pos in ((7, 100), (21, 2000), (33, 7777)):
         accounts[pos] = accounts[pos + 1] = acct
         amounts[pos] = 0.5          # bait
         amounts[pos + 1] = 900.0    # strike
 
-    tx = env.from_collection(columns={"account": accounts,
-                                      "amount": amounts}, batch_size=1024)
-    scored = tx.key_by("account").process(Detector())
-    scored.get_side_output(alerts).print(prefix="ALERT")
-    scored.collect()
+    tx = (env.from_collection(columns={"account": accounts,
+                                       "amount": amounts,
+                                       "t": ts}, batch_size=1024)
+          .assign_timestamps_and_watermarks(0, timestamp_column="t")
+          .key_by("account"))
+    # the scenario's CEP stage: Pattern(small -> large within 4 windows)
+    alerts = detect_frauds(tx, window_ms=1000, amount_column="amount")
+    alerts.print(prefix="ALERT")
+    return alerts.collect()

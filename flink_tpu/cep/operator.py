@@ -1158,6 +1158,106 @@ class CepOperator(StreamOperator):
         self._next_event_id = snap["next_event_id"]
         self.watermark = snap["watermark"]
 
+    # -- rescale -------------------------------------------------------------
+    @staticmethod
+    def split_snapshot(snap: Dict[str, Any], max_parallelism: int,
+                       new_parallelism: int) -> List[Dict[str, Any]]:
+        """One CEP snapshot -> ``new_parallelism`` snapshots, per-key
+        entries (event buffers, NFA partials, PREV rows) routed by the
+        key's key group — the same assignment the record router uses, so
+        a key's partial matches land exactly where its future events will
+        (ISSUE-15: scenarios rescale CEP jobs mid-stream).  Event ids stay
+        as-is: each part keeps a disjoint key subset and every key's
+        events came from this one operator, so ids stay unique per part;
+        ``next_event_id``/``watermark`` ride to every part."""
+        from flink_tpu.core import keygroups
+
+        keys: List[Any] = list(snap.get("buffers", {}))
+        known = set(keys)
+        for src in (snap.get("nfas", {}), snap.get("last_rows", {})):
+            for k in src:
+                if k not in known:
+                    known.add(k)
+                    keys.append(k)
+        if keys:
+            karr = np.asarray(keys)
+            if karr.dtype.kind not in "iu":
+                karr = np.asarray(keys, object)
+            owner = keygroups.route_raw_keys(karr, new_parallelism,
+                                             max_parallelism)
+        else:
+            owner = np.zeros(0, np.int32)
+        own_of = {k: int(owner[i]) for i, k in enumerate(keys)}
+        out = []
+        for p in range(new_parallelism):
+            out.append({
+                # preserve dict order: buffer order IS the vectorized
+                # engine's slot (first-arrival) order
+                "buffers": {k: v for k, v in snap.get("buffers", {}).items()
+                            if own_of[k] == p},
+                "nfas": {k: v for k, v in snap.get("nfas", {}).items()
+                         if own_of[k] == p},
+                "last_rows": {k: v
+                              for k, v in snap.get("last_rows", {}).items()
+                              if own_of[k] == p},
+                "next_event_id": snap.get("next_event_id", 0),
+                "watermark": snap.get("watermark", LONG_MIN),
+            })
+        return out
+
+    @staticmethod
+    def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Scale-down merge.  Keys are disjoint across parts (keyed
+        state), but event ids are NOT — each part numbered its events
+        independently, and the restore funnels every part's rows into ONE
+        columnar row store keyed by event id, where a collision would
+        silently alias two different events' rows.  Remap every event id
+        to ``eid * n_parts + part_index`` (disjoint ranges; within-part
+        order preserved, and all of one key's events come from one part,
+        so per-key event order is untouched).  The merged watermark takes
+        MIN — under an unaligned cut the parts sit at different
+        watermarks, and the behind part's in-flight elements replay with
+        their own watermark progression (the PR-5 ordering contract), so
+        the lower bound is the safe restart point (the ahead part's
+        already-drained keys hold post-drain state: nothing re-emits)."""
+        import dataclasses
+
+        live = [s for s in snaps if isinstance(s, dict) and s]
+        if not live:
+            return dict(snaps[0]) if snaps else {}
+        P = max(1, len(snaps))
+
+        def remap(eid: int, part: int) -> int:
+            return int(eid) * P + part
+
+        buffers: Dict[Any, list] = {}
+        nfas: Dict[Any, tuple] = {}
+        last_rows: Dict[Any, dict] = {}
+        next_eid = 0
+        wms = []
+        for part, s in enumerate(snaps):
+            if not isinstance(s, dict) or not s:
+                continue
+            for k, entries in s.get("buffers", {}).items():
+                buffers[k] = [
+                    (e[0], remap(e[1], part)) + tuple(e[2:])
+                    for e in entries]
+            for k, (partials, skip_ts, rows) in s.get("nfas", {}).items():
+                nfas[k] = (
+                    [dataclasses.replace(
+                        pm, events=tuple((st, remap(e, part))
+                                         for st, e in pm.events))
+                     for pm in partials],
+                    skip_ts,
+                    {remap(e, part): r for e, r in rows.items()})
+            last_rows.update(s.get("last_rows", {}))
+            next_eid = max(next_eid,
+                           remap(int(s.get("next_event_id", 0)), part) + 1)
+            wms.append(int(s.get("watermark", LONG_MIN)))
+        return {"buffers": buffers, "nfas": nfas, "last_rows": last_rows,
+                "next_event_id": next_eid,
+                "watermark": min(wms) if wms else LONG_MIN}
+
     def _vec_restore(self, snap: Dict[str, Any]) -> None:
         from flink_tpu.cep import vectorized as V
 

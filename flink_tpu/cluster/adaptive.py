@@ -48,6 +48,7 @@ from flink_tpu.cluster.minicluster import JobResult, MiniCluster
 from flink_tpu.graph.stream_graph import ExecutionPlan
 from flink_tpu.observability import tracing
 from flink_tpu.state.redistribute import (redistribute_channel_state,
+                                          snapshot_operator_class,
                                           split_keyed_snapshot)
 from flink_tpu.state_processor.savepoint import (_is_keyed,
                                                  _merged_operator_snapshot)
@@ -68,9 +69,12 @@ class SchedulerStates:
 
 def _split_member(member: Dict[str, Any], max_parallelism: int,
                   n: int) -> List[Dict[str, Any]]:
-    if "pane_base" in member:
-        from flink_tpu.operators.window_agg import WindowAggOperator
-        return WindowAggOperator.split_snapshot(member, max_parallelism, n)
+    # operators with their own rescale split/merge pair (window aggregate,
+    # session windows, CEP per-key state, two-phase-commit sinks) dispatch
+    # through the ONE kind table the savepoint merge also uses
+    cls = snapshot_operator_class(member)
+    if cls is not None:
+        return cls.split_snapshot(member, max_parallelism, n)
     if _is_keyed(member):
         fields = sorted({k for k in member
                          if k.startswith("state.") or k == "leaves"})

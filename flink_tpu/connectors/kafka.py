@@ -35,6 +35,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from flink_tpu.connectors.sinks import TwoPhaseCommitSink
+
 # ---------------------------------------------------------------------------
 # wire primitives
 # ---------------------------------------------------------------------------
@@ -1693,40 +1695,32 @@ class KafkaWireClient:
 # source/sink seams
 # ---------------------------------------------------------------------------
 
-class KafkaExactlyOnceSink:
+class KafkaExactlyOnceSink(TwoPhaseCommitSink):
     """Exactly-once Kafka sink: transactional produce bound to checkpoints
     — the ``FlinkKafkaProducer.java:100`` two-phase commit.
 
-    One transactional id PER EPOCH (``{sink_id}-s{subtask}-{epoch}``, the
-    same gid scheme as the Postgres 2PC sink): rows buffer locally and
-    flush into the epoch's broker transaction; ``snapshot_state``
-    PRE-COMMITS (flushes; the txn stays open at the broker, recorded with
-    its checkpoint id); ``notify_checkpoint_complete(N)`` commits exactly
-    the epochs staged for checkpoints <= N; ``restore_state`` commits the
-    snapshot's staged epochs (idempotent broker-side replay via the
-    committed-tid set) and aborts every OTHER dangling transaction of this
-    sink enumerated via ListTransactions — a crash between pre-commit and
-    commit neither loses (restore commits) nor duplicates (replayed
-    commits are idempotent; post-checkpoint epochs abort)."""
+    The checkpoint-bound lifecycle (one transactional id PER EPOCH,
+    ``{sink_id}-s{subtask}-{epoch}``; ``snapshot_state`` pre-commits,
+    ``notify_checkpoint_complete(N)`` commits the epochs staged for
+    checkpoints <= N, ``restore_state`` replays staged commits
+    idempotently and sweeps dangling transactions) lives in the reusable
+    :class:`~flink_tpu.connectors.sinks.TwoPhaseCommitSink` base; this
+    adapter binds it to the broker's KIP-98 machinery: InitProducerId /
+    AddPartitionsToTxn / transactional produce / EndTxn, with the
+    committed-tid set making commit replay idempotent and
+    ListTransactions driving the dangling sweep.
 
-    clone_per_subtask = True
+    A transaction handle is ``(tid, pid, producer_epoch)``."""
 
     def __init__(self, host: str, port: int, topic: str,
                  key_column: Optional[str] = None, num_partitions: int = 1,
                  sink_id: str = "kafka-eos", buffer_rows: int = 4096):
+        super().__init__(sink_id=sink_id, buffer_rows=buffer_rows)
         self.host, self.port = host, port
         self.topic = topic
         self.key_column = key_column
         self.num_partitions = num_partitions
-        self.sink_id = sink_id
-        self.buffer_rows = buffer_rows
         self._client: Optional[KafkaWireClient] = None
-        self._subtask_index = 0
-        self._parallelism = 1
-        self._epoch = 0
-        self._txn: Optional[Tuple[str, int, int]] = None  # (tid, pid, ep)
-        self._staged: List[Tuple[str, int, int, Optional[int]]] = []
-        self._buf: List[Tuple[Optional[bytes], bytes]] = []
 
     def _cli(self) -> KafkaWireClient:
         if self._client is None:
@@ -1734,118 +1728,85 @@ class KafkaExactlyOnceSink:
         return self._client
 
     def open(self, ctx) -> None:
-        self._subtask_index = getattr(ctx, "subtask_index", 0)
-        self._parallelism = max(1, getattr(ctx, "parallelism", 1) or 1)
+        super().open(ctx)
         self._cli()
 
-    def _tid(self, epoch: int) -> str:
-        return f"{self.sink_id}-s{self._subtask_index}-{epoch}"
+    # -- TwoPhaseCommitSink contract ----------------------------------------
+    def begin_transaction(self, txn_name: str) -> Tuple[str, int, int]:
+        pid, pepoch = self._cli().init_producer_id(txn_name)
+        self._cli().add_partitions_to_txn(
+            txn_name, pid, pepoch,
+            {self.topic: list(range(self.num_partitions))})
+        return (txn_name, pid, pepoch)
 
-    def _begin_txn(self) -> Tuple[str, int, int]:
-        if self._txn is None:
-            tid = self._tid(self._epoch)
-            pid, pepoch = self._cli().init_producer_id(tid)
-            self._cli().add_partitions_to_txn(
-                tid, pid, pepoch,
-                {self.topic: list(range(self.num_partitions))})
-            self._txn = (tid, pid, pepoch)
-        return self._txn
-
-    def write_batch(self, batch) -> None:
+    def write_rows(self, handle, rows) -> None:
         import json
-        if not len(batch):
-            return
-        for r in batch.to_rows():
+        tid, pid, pepoch = handle
+        buf = []
+        for r in rows:
             key = (None if self.key_column is None
                    else str(r[self.key_column]).encode())
-            self._buf.append(
-                (key, json.dumps(r, default=_json_default).encode()))
-        if len(self._buf) >= self.buffer_rows:
-            self._flush()
-
-    def _flush(self) -> None:
-        if not self._buf:
-            return
-        tid, pid, pepoch = self._begin_txn()
+            buf.append((key, json.dumps(r, default=_json_default).encode()))
         if self.num_partitions == 1 or self.key_column is None:
             # single partition, or keyless round-robin
             parts: Dict[int, List] = {}
-            for i, kv in enumerate(self._buf):
+            for i, kv in enumerate(buf):
                 parts.setdefault(i % self.num_partitions, []).append(kv)
         else:
             from flink_tpu.core.keygroups import hash_keys
-            keys = np.asarray([k for k, _v in self._buf], object)
+            keys = np.asarray([k for k, _v in buf], object)
             pn = np.abs(hash_keys(keys).astype(np.int64)) \
                 % self.num_partitions
             parts = {}
-            for i, kv in enumerate(self._buf):
+            for i, kv in enumerate(buf):
                 parts.setdefault(int(pn[i]), []).append(kv)
         for p, entries in sorted(parts.items()):
             self._cli().produce_txn(tid, pid, pepoch, self.topic, p,
                                     entries)
-        self._buf = []
 
-    def snapshot_state(self) -> Dict[str, Any]:
-        from flink_tpu.operators.base import current_checkpoint_id
-        self._flush()
-        if self._txn is not None:
-            tid, pid, pepoch = self._txn
-            # pre-commit: the txn stays OPEN at the broker; only the
-            # matching checkpoint's completion may commit it
-            self._staged.append((tid, pid, pepoch, current_checkpoint_id()))
-            self._txn = None
-            self._epoch += 1
-        return {"epoch": self._epoch, "staged": list(self._staged)}
+    def commit_transaction(self, handle) -> None:
+        # strict: a first-time commit (notify / end_input) answered with
+        # INVALID_TXN_STATE means the staged records are GONE (aborted
+        # from under us / lost open txn) — that must raise, not read as
+        # committed
+        tid, pid, pepoch = handle
+        self._cli().end_txn(tid, pid, pepoch, commit=True)
 
-    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
-        keep = []
-        for tid, pid, pepoch, staged_for in self._staged:
-            if staged_for is not None and staged_for > checkpoint_id:
-                keep.append((tid, pid, pepoch, staged_for))
-                continue
+    def replay_commit(self, handle) -> None:
+        tid, pid, pepoch = handle
+        try:
             self._cli().end_txn(tid, pid, pepoch, commit=True)
-        self._staged = keep
+        except KafkaError as e:
+            if e.code != _ERR_INVALID_TXN_STATE:
+                raise
+            # the tid aged out of the broker's committed-tids retention
+            # window: the commit already happened long ago — recovery
+            # proceeds idempotently instead of wedging
 
-    def end_input(self) -> None:
-        self._flush()
-        if self._txn is not None:
-            tid, pid, pepoch = self._txn
-            self._cli().end_txn(tid, pid, pepoch, commit=True)
-            self._txn = None
-            self._epoch += 1
+    def abort_transaction(self, handle) -> None:
+        tid, pid, pepoch = handle
+        try:
+            self._cli().end_txn(tid, pid, pepoch, commit=False)
+        except (KafkaError, OSError):
+            pass
 
-    def restore_state(self, snap: Dict[str, Any]) -> None:
-        self._epoch = int(snap.get("epoch", 0))
-        self._buf = []
-        self._txn = None
+    def sweep_dangling(self, committed) -> None:
         c = self._cli()
-        committed = set()
-        for tid, pid, pepoch, _cid in snap.get("staged", []):
-            try:
-                c.end_txn(tid, pid, pepoch, commit=True)  # idempotent replay
-            except KafkaError as e:
-                if e.code != _ERR_INVALID_TXN_STATE:
-                    raise
-                # the tid aged out of the broker's committed-tids retention
-                # window: the commit already happened long ago — recovery
-                # proceeds idempotently instead of wedging
-            committed.add(tid)
-        self._staged = []
+        committed_tids = {h[0] for h in committed}
         mine = f"{self.sink_id}-s{self._subtask_index}-"
         #: scale-down sweep (FlinkKafkaProducer's abort of removed
         #: subtasks' transactions): subtask 0 also aborts dangling
         #: pre-commits whose owner index no longer exists at the NEW
         #: parallelism — otherwise their staged state leaks at the broker
         #: forever (no surviving subtask would ever match their prefix).
-        #: CAVEAT: snapshots are index-restored, not union-redistributed —
-        #: a removed subtask's staged (pre-committed) txn from a COMPLETED
-        #: checkpoint has no surviving replayer, so the sweep aborts it;
-        #: scale down only after a final checkpoint's notify round, or
-        #: drain first (same operational rule as FlinkKafkaProducer before
-        #: union-state recovery existed)
+        #: A rescale restore is covered separately: the rescale machinery
+        #: UNIONS staged transactions onto subtask 0's member
+        #: (TwoPhaseCommitSink.merge_snapshots), whose commit replay runs
+        #: BEFORE this sweep — so the sweep only ever aborts genuinely
+        #: post-checkpoint transactions.
         sweep_all = f"{self.sink_id}-s"
         for tid, pid, pepoch, _state in c.list_transactions():
-            if not tid or tid in committed:
+            if not tid or tid in committed_tids:
                 continue
             abort = tid.startswith(mine)
             if not abort and self._subtask_index == 0 \
@@ -1860,13 +1821,7 @@ class KafkaExactlyOnceSink:
                 pass  # raced with another recovering instance
 
     def close(self) -> None:
-        if self._txn is not None and self._client is not None:
-            tid, pid, pepoch = self._txn
-            try:
-                self._client.end_txn(tid, pid, pepoch, commit=False)
-            except (KafkaError, OSError):
-                pass
-            self._txn = None
+        super().close()
         if self._client is not None:
             self._client.close()
             self._client = None

@@ -331,7 +331,14 @@ class SinkOperator(StreamOperator):
             del self.latencies_ms[:512]
 
     def end_input(self) -> List[StreamElement]:
-        if hasattr(self.sink, "flush"):
+        # transactional sinks finalize on end-of-stream (commit the last
+        # epoch's transaction — TwoPhaseCommitSink.end_input); without
+        # this the tail between the final barrier and end-of-input stays
+        # staged forever and close() ABORTS it: committed-output loss on
+        # every bounded job (found gating the scenario suite, ISSUE-15)
+        if hasattr(self.sink, "end_input"):
+            self.sink.end_input()
+        elif hasattr(self.sink, "flush"):
             self.sink.flush()
         return []
 

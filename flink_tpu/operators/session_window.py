@@ -459,7 +459,8 @@ class SessionWindowOperator(StreamOperator):
     @staticmethod
     def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
         """Scale-down: sessions are plain per-row records — concatenate."""
-        live = [s for s in snaps if len(np.asarray(s["session_keys"]))]
+        live = [s for s in snaps if "session_keys" in s
+                and len(np.asarray(s["session_keys"]))]
         if not live:
             return dict(snaps[0]) if snaps else {}
         merged = dict(live[0])
@@ -475,7 +476,14 @@ class SessionWindowOperator(StreamOperator):
                               for x in s.get(
                                   "sets",
                                   [[]] * len(np.asarray(s["session_keys"])))]
-        merged["watermark"] = max(int(s.get("watermark", LONG_MIN))
+        # MIN, not max: under an unaligned rescale cut the parts sit at
+        # different watermarks, and the behind part's persisted in-flight
+        # elements replay with their own watermark progression (PR-5
+        # ordering) — a max here would mark them late on arrival, records
+        # an unfaulted run accepts.  The ahead part's already-fired
+        # sessions keep their fired flags, so the lower restart point
+        # cannot double-fire them.
+        merged["watermark"] = min(int(s.get("watermark", LONG_MIN))
                                   for s in live)
         merged["late_dropped"] = sum(int(s.get("late_dropped", 0))
                                      for s in live)

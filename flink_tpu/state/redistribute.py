@@ -204,6 +204,36 @@ def redistribute_channel_state(sections, new_parallelism: int,
     return out
 
 
+#: snapshot-kind dispatch shared by the rescale split
+#: (``cluster/adaptive._split_member``) and the savepoint merge
+#: (``state_processor/savepoint._merge_keyed_group``): ONE ordered
+#: marker-key -> operator-class table, so a member's split and merge can
+#: never dispatch to different operators (the kinds used to live as
+#: parallel if-chains in three files).  First matching marker wins.
+_SNAPSHOT_KINDS: Tuple[Tuple[str, str, str], ...] = (
+    ("pane_base", "flink_tpu.operators.window_agg", "WindowAggOperator"),
+    ("session_keys", "flink_tpu.operators.session_window",
+     "SessionWindowOperator"),
+    ("nfas", "flink_tpu.cep.operator", "CepOperator"),
+    ("two_phase", "flink_tpu.connectors.sinks", "TwoPhaseCommitSink"),
+)
+
+
+def snapshot_operator_class(member: Any):
+    """The operator class owning this member snapshot's rescale
+    ``split_snapshot``/``merge_snapshots`` pair, or None for generic
+    keyed / opaque members.  Imports lazily (operators must stay
+    importable without this module's callers)."""
+    import importlib
+
+    if not isinstance(member, dict):
+        return None
+    for key, mod, cls in _SNAPSHOT_KINDS:
+        if key in member:
+            return getattr(importlib.import_module(mod), cls)
+    return None
+
+
 def _restore_index(snap: Dict[str, Any]):
     cls = (ObjectKeyIndex if snap.get("key_index_kind") == "ObjectKeyIndex"
            else KeyIndex)

@@ -18,7 +18,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from flink_tpu.state.heap import HeapKeyedStateBackend
-from flink_tpu.state.redistribute import merge_keyed_snapshots
+from flink_tpu.state.redistribute import (merge_keyed_snapshots,
+                                          snapshot_operator_class)
 
 
 def _is_subtask_layout(entry: Any) -> bool:
@@ -29,13 +30,24 @@ def _is_keyed(o: Any) -> bool:
     return isinstance(o, dict) and ("key_index" in o or "keys" in o)
 
 
+def _is_mergeable(o: Any) -> bool:
+    """Does this member snapshot have a rescale-aware merge?  Beyond the
+    generic keyed layout, every kind in the shared dispatch table
+    (window aggregate, session windows, CEP per-key state,
+    two-phase-commit sinks) merges consistently across subtasks."""
+    return _is_keyed(o) or snapshot_operator_class(o) is not None
+
+
 def _merge_keyed_group(ops: List[Dict[str, Any]]) -> Dict[str, Any]:
-    if any("pane_base" in o for o in ops):
-        # window-aggregate snapshots have their own slot-aligned row fields
-        # (leaves AND counts) + pane-progress invariants: use the operator's
-        # merge, not the generic keyed merge
-        from flink_tpu.operators.window_agg import WindowAggOperator
-        return WindowAggOperator.merge_snapshots(ops)
+    # empty members (a fresh subtask with no state yet) contribute
+    # nothing; dispatch through the SAME kind table the rescale split
+    # uses (state/redistribute.snapshot_operator_class), so a member's
+    # split and merge can never land on different operators
+    ops = [o for o in ops if isinstance(o, dict) and o] or list(ops)
+    for o in ops:
+        cls = snapshot_operator_class(o)
+        if cls is not None:
+            return cls.merge_snapshots(ops)
     fields = sorted({f for o in ops for f in o
                      if f.startswith("state.") or f == "leaves"})
     return merge_keyed_snapshots(ops, fields)
@@ -57,10 +69,12 @@ def _merged_operator_snapshot(entry: Any, strict: bool = False
     ops = [s.get("operator", s) for s in subs]
     if not ops:
         return {}
-    if all(_is_keyed(o) for o in ops):
+    if all(_is_mergeable(o) for o in ops):
         return _merge_keyed_group(ops)
-    # chained vertex: merge the keyed chain members across subtasks,
-    # best-effort (non-keyed members keep subtask 0's copy)
+    # chained vertex: merge the mergeable chain members across subtasks,
+    # best-effort (other non-keyed members keep subtask 0's copy); empty
+    # members (a subtask that held no state for this member yet) are
+    # compatible with any mergeable sibling
     member_keys = [k for k in ops[0]
                    if k.startswith("op") and k[2:].isdigit()]
     if member_keys and all(set(member_keys) <= set(o) for o in ops
@@ -68,7 +82,9 @@ def _merged_operator_snapshot(entry: Any, strict: bool = False
         out = dict(ops[0])
         for mk in member_keys:
             members = [o[mk] for o in ops]
-            if all(_is_keyed(m) for m in members):
+            live = [m for m in members
+                    if isinstance(m, dict) and m]
+            if live and all(_is_mergeable(m) for m in live):
                 try:
                     out[mk] = _merge_keyed_group(members)
                 except (ValueError, KeyError, IndexError):

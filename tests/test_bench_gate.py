@@ -564,6 +564,16 @@ def test_budget_file_shape():
     fused = budget["fused_cpu"]
     assert fused["max_dispatches_per_batch"] >= 1.0
     assert fused["min_vs_numpy"] >= budget["full_cpu"]["min_vs_numpy"]
+    # the scenario-suite gates (bench.py --scenario --check, ISSUE-15):
+    # every scenario must demand >= 1 autoscaler reaction; perf floors
+    # exist for the full tier (exactly-once gates unconditionally in code)
+    for sec in ("scenario_fraud_cpu", "scenario_session_cpu",
+                "scenario_feature_cpu"):
+        sc = budget[sec]
+        assert sc["min_rescales"] >= 1
+        assert sc["min_peak_rps"] > 0
+        assert sc["max_p99_ms"] > 0
+        assert sc["min_lookups_per_sec"] > 0
     # real-accelerator runs gate against the *_device sections (ROADMAP
     # item 2's second half: device rounds regress loudly, like CPU ones)
     for tier in ("full_device", "smoke_device"):
@@ -719,6 +729,98 @@ def test_check_rescale_budget_floors_and_ceilings():
     # a meaningful drain measurement)
     assert check_rescale_budget(_rescale_result(recovery=90000.0), b,
                                 smoke=True) == []
+
+
+def _scenario_result(state="Finished", control="Finished", lost=0, dup=0,
+                     digest=True, rescales=2, rollbacks=0, cross=(),
+                     committed=None, peak=2500.0, p99=5000.0, lps=400.0):
+    return {"scenario": "fraud_detection", "state": state,
+            "control_state": control, "records_lost": lost,
+            "records_duplicated": dup, "digest_match": digest,
+            "rescales": rescales, "rollbacks": rollbacks,
+            "cross_check_violations": list(cross),
+            "committed_rows": committed if committed is not None
+            else {"alerts": 575},
+            "peak_records_per_sec": peak, "latency_p99_ms": p99,
+            "queryable": {"lookups_per_sec": lps}}
+
+
+def _scenario_budget(**kw):
+    b = {"min_rescales": 1, "min_peak_rps": 1000, "max_p99_ms": 30000,
+         "min_lookups_per_sec": 60}
+    b.update(kw)
+    return b
+
+
+def test_check_scenario_budget_pass():
+    from bench import check_scenario_budget
+    assert check_scenario_budget(_scenario_result(),
+                                 _scenario_budget()) == []
+
+
+def test_check_scenario_budget_exactly_once_always_gates():
+    """Lost/duplicated/digest-mismatch/cross-check/no-output violate even
+    with an EMPTY budget section and in smoke — a lossy scenario must
+    never exit 0 because no perf floor was configured."""
+    from bench import check_scenario_budget
+    assert any("records_lost" in v for v in check_scenario_budget(
+        _scenario_result(lost=3), {}, smoke=True))
+    assert any("records_duplicated" in v for v in check_scenario_budget(
+        _scenario_result(dup=1), {}, smoke=True))
+    assert any("digest" in v for v in check_scenario_budget(
+        _scenario_result(digest=False), {}, smoke=True))
+    assert any("did not finish" in v for v in check_scenario_budget(
+        _scenario_result(state="Failed"), {}, smoke=True))
+    assert any("control" in v for v in check_scenario_budget(
+        _scenario_result(control="Canceled"), {}, smoke=True))
+    assert any("TUMBLE" in v for v in check_scenario_budget(
+        _scenario_result(cross=["SQL TUMBLE cross-check: diverged"]), {},
+        smoke=True))
+    assert any("no committed output" in v for v in check_scenario_budget(
+        _scenario_result(committed={"alerts": 0}), {}, smoke=True))
+
+
+def test_check_scenario_budget_floors_and_ceilings():
+    from bench import check_scenario_budget
+    b = _scenario_budget()
+    assert any("rescales" in v for v in check_scenario_budget(
+        _scenario_result(rescales=0), b))
+    assert any("peak" in v for v in check_scenario_budget(
+        _scenario_result(peak=100.0), b))
+    assert any("p99" in v for v in check_scenario_budget(
+        _scenario_result(p99=60000.0), b))
+    assert any("queryable" in v for v in check_scenario_budget(
+        _scenario_result(lps=1.0), b))
+    assert any("rollbacks" in v for v in check_scenario_budget(
+        _scenario_result(rollbacks=2), _scenario_budget(max_rollbacks=0)))
+    # perf floors are full-run only; exactly-once still gates in smoke
+    assert check_scenario_budget(
+        _scenario_result(peak=100.0, p99=60000.0, lps=1.0), b,
+        smoke=True) == []
+
+
+@pytest.mark.slow
+def test_scenario_bench_smoke_passes_gate(tmp_path):
+    """bench.py --scenario fraud_detection --smoke --check end-to-end on
+    CPU: the fraud scenario survives its peak nemeses exactly-once
+    (digest == unfaulted control), the autoscaler reacts on the curve,
+    and the committed scenario_fraud_cpu gate passes."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--scenario", "fraud_detection", "--smoke", "--records", "30000",
+         "--check"],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-3000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"]
+    (s,) = result["scenarios"]
+    assert s["scenario"] == "fraud_detection"
+    assert s["state"] == "Finished" and s["control_state"] == "Finished"
+    assert s["records_lost"] == 0 and s["records_duplicated"] == 0
+    assert s["digest_match"] and s["rescales"] >= 1
+    assert s["committed_rows"]["alerts"] > 0
+    assert s["queryable"]["lookups"] > 0
 
 
 def test_autoscale_bench_smoke_passes_gate():

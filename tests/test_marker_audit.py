@@ -260,6 +260,28 @@ def test_rescale_under_fire_suite_collects_under_tier1():
          f"lifecycle's exactly-once coverage left the gate")
 
 
+def test_scenarios_suite_collects_under_tier1():
+    """The scenario suite (ISSUE-15) must contribute tests to the tier-1
+    run under ``JAX_PLATFORMS=cpu`` — the per-scenario exactly-once-
+    under-kill acceptances vs the unfaulted control, the CEP/session
+    rescale split/merge units, the two-phase-commit sink lifecycle and
+    the SQL-vs-datastream cross-check all run on the CPU backend, so a
+    slow-mark sweep that silently drops them fails here."""
+    import subprocess
+
+    f = "test_scenarios.py"
+    assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", str(TESTS / f)],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"{f}::" in proc.stdout, \
+        (f"{f} contributes no tests to the tier-1 selection — the "
+         f"scenario suite's exactly-once coverage left the gate")
+
+
 def test_marker_declarations_have_descriptions():
     """Each declared marker carries a description (the `name: text` form)
     so `pytest --markers` documents the tiers."""
