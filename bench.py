@@ -1132,6 +1132,148 @@ def run_checkpoint_backpressure(interval_ms: int, budget_ms: float,
     }
 
 
+def _tree_eq(a, b) -> bool:
+    """Bit-exact structural equality of two snapshot trees (bool form of
+    the test suite's assertion helper — the bench must report, not raise)."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        a, b = np.asarray(a), np.asarray(b)
+        return (a.dtype == b.dtype and a.shape == b.shape
+                and bool(np.array_equal(a, b)))
+    if isinstance(a, dict):
+        return (isinstance(b, dict) and a.keys() == b.keys()
+                and all(_tree_eq(a[k], b[k]) for k in a))
+    if isinstance(a, (list, tuple)):
+        return (isinstance(b, (list, tuple)) and len(a) == len(b)
+                and all(_tree_eq(x, y) for x, y in zip(a, b)))
+    return bool(a == b)
+
+
+def run_incremental_checkpoint_bench(smoke: bool = False,
+                                     churn_frac: float = 0.10,
+                                     rounds: int = 5) -> dict:
+    """``--checkpoint-interval`` incremental leg (ISSUE-16): at a steady
+    state where ``churn_frac`` of the keys change per interval, measure
+    bytes/checkpoint for delta cuts vs the full dense snapshot, the
+    increments-per-base chain depth in ``IncrementalCheckpointStorage``,
+    and the measured recovery time (chain resolve + operator restore).
+    The chain-restored state must be digest-identical to the full
+    snapshot — reported as ``digest_match`` and gated unconditionally by
+    ``check_incremental_budget``."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from flink_tpu.core.batch import RecordBatch
+    from flink_tpu.core.functions import RuntimeContext, SumAggregator
+    from flink_tpu.operators.base import snapshot_scope
+    from flink_tpu.operators.window_agg import WindowAggOperator
+    from flink_tpu.runtime.checkpoint import delta
+    from flink_tpu.runtime.checkpoint.incremental import \
+        IncrementalCheckpointStorage
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    n_keys = 50_000 if smoke else 1_000_000
+    churn = max(1, int(n_keys * churn_frac))
+    rng = np.random.default_rng(17)
+    op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                           SumAggregator(jnp.float32),
+                           key_column="k", value_column="v")
+    op.open(RuntimeContext())
+    op.incremental_state = True
+
+    def feed(keys):
+        op.process_batch(RecordBatch(
+            {"k": keys, "v": np.ones(keys.size, np.float32)},
+            timestamps=np.full(keys.size, 100, np.int64)))
+
+    tmp = tempfile.mkdtemp(prefix="bench-incr-")
+    try:
+        storage = IncrementalCheckpointStorage(
+            tmp, retain=rounds + 2, max_increments_per_base=rounds + 2,
+            compact_in_background=False)
+        for part in np.array_split(np.arange(n_keys), 8):
+            feed(part)
+        with snapshot_scope(1, incremental=True):
+            storage.store(1, {"w": op.snapshot_state()})
+        op.notify_checkpoint_complete(1)
+
+        inc_bytes, cut_ms = [], []
+        for cid in range(2, 2 + rounds):
+            feed(rng.choice(n_keys, churn, replace=False).astype(np.int64))
+            t0 = time.perf_counter()
+            with snapshot_scope(cid, incremental=True):
+                snap = op.snapshot_state()
+            cut_ms.append((time.perf_counter() - t0) * 1000.0)
+            if delta.tree_has_increment({"w": snap}):
+                inc_bytes.append(delta.state_size(snap))
+            storage.store(cid, {"w": snap})
+            op.notify_checkpoint_complete(cid)
+
+        full = op.snapshot_state()
+        full_bytes = delta.state_size(full)
+        last = storage.checkpoint_ids()[-1]
+        t0 = time.perf_counter()
+        restored = storage.load_latest()          # base + ordered replay
+        op_r = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                                 SumAggregator(jnp.float32),
+                                 key_column="k", value_column="v")
+        op_r.open(RuntimeContext())
+        op_r.restore_state(restored["w"])
+        recovery_ms = (time.perf_counter() - t0) * 1000.0
+        digest_match = _tree_eq(restored["w"], full) and _tree_eq(
+            op_r.snapshot_state(), full)
+        ratio = (max(inc_bytes) / full_bytes) if inc_bytes else None
+        return {
+            "metric": "incremental checkpoint bytes + recovery at "
+                      f"{churn_frac:.0%} churn",
+            "ok": bool(digest_match and inc_bytes),
+            "n_keys": n_keys,
+            "churn_keys": churn,
+            "incremental_checkpoints": len(inc_bytes),
+            "full_snapshot_bytes": int(full_bytes),
+            "increment_bytes_max": int(max(inc_bytes)) if inc_bytes else None,
+            "increment_bytes_mean": (round(sum(inc_bytes) / len(inc_bytes))
+                                     if inc_bytes else None),
+            "bytes_ratio": round(ratio, 4) if ratio is not None else None,
+            "increments_per_base": storage.chain_length(last) - 1,
+            "compactions": storage.compactions,
+            "cut_ms_max": round(max(cut_ms), 2) if cut_ms else None,
+            "recovery_ms": round(recovery_ms, 1),
+            "digest_match": digest_match,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def check_incremental_budget(result: dict, budget: dict,
+                             smoke: bool = False) -> list:
+    """BENCH_BUDGET.json ``checkpoint_incremental`` gate.  Digest equality
+    (chain restore == full snapshot) and the existence of incremental cuts
+    gate UNCONDITIONALLY — a delta format that silently re-bases every cut
+    or resolves to different state must never exit 0 because no byte
+    ceiling was configured."""
+    viol = []
+    if not result.get("digest_match"):
+        viol.append("incremental: chain-restored state is not "
+                    "digest-identical to the full snapshot")
+    floor = budget.get("min_incremental_checkpoints", 1)
+    if result.get("incremental_checkpoints", 0) < floor:
+        viol.append(f"incremental: {result.get('incremental_checkpoints')} "
+                    f"delta cuts < floor {floor} — every cut re-based")
+    cap = budget.get("max_bytes_ratio")
+    ratio = result.get("bytes_ratio")
+    if cap is not None and ratio is not None and ratio > cap:
+        viol.append(f"incremental: delta bytes {ratio:.1%} of full "
+                    f"snapshot > ceiling {cap:.0%} at "
+                    f"{result.get('churn_keys')} churned keys")
+    cap = budget.get("max_recovery_ms")
+    rec = result.get("recovery_ms")
+    if not smoke and cap is not None and rec is not None and rec > cap:
+        viol.append(f"incremental: recovery {rec}ms > ceiling {cap}ms")
+    return viol
+
+
 # ONE diurnal implementation for --autoscale AND the scenario suite
 # (ISSUE-15: twin generators drift) — promoted to testing/workload.py
 from flink_tpu.testing.workload import DiurnalSource as _DiurnalSource  # noqa: E402
@@ -2434,7 +2576,13 @@ def main():
                          "/SlowDisk chaos injects backpressure; reports "
                          "checkpoint duration + persisted in-flight bytes "
                          "and exits nonzero if a checkpoint misses the "
-                         "checkpoint_backpressure budget")
+                         "checkpoint_backpressure budget; also runs the "
+                         "incremental-checkpoint leg (ISSUE-16): delta "
+                         "bytes vs a full snapshot at 10%% key churn, "
+                         "increments-per-base and chain-resolve recovery "
+                         "time, gated by checkpoint_incremental (the "
+                         "chain-restore digest-equality check is "
+                         "unconditional)")
     ap.add_argument("--autoscale", action="store_true",
                     help="standalone reactive-autoscaler run (ISSUE-14): a "
                          "diurnal load-curve source over a keyed window "
@@ -2495,11 +2643,20 @@ def main():
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_BUDGET.json")
         with open(path) as f:
-            budget = json.load(f).get("checkpoint_backpressure", {})
+            budgets = json.load(f)
+        budget = budgets.get("checkpoint_backpressure", {})
         result = run_checkpoint_backpressure(
             args.checkpoint_interval,
             budget_ms=budget.get("max_duration_ms", 5000.0),
             min_completed=budget.get("min_completed", 1))
+        # incremental leg (ISSUE-16): delta bytes vs full at 10% churn,
+        # increments-per-base, chain-resolve recovery time, digest gate
+        inc = run_incremental_checkpoint_bench(smoke=args.smoke)
+        inc_viol = check_incremental_budget(
+            inc, budgets.get("checkpoint_incremental", {}),
+            smoke=args.smoke)
+        result["incremental"] = inc
+        result["ok"] = bool(result["ok"] and inc["ok"] and not inc_viol)
         print(json.dumps(result))
         if not result["ok"]:
             print(f"# BUDGET VIOLATION: checkpoint under backpressure — "
@@ -2507,6 +2664,8 @@ def main():
                   f"{result['budget_ms']} ms, state {result['state']}, "
                   f"{result['completed_checkpoints']} completed",
                   file=sys.stderr)
+        for v in inc_viol:
+            print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
         sys.exit(0 if result["ok"] else 1)
 
     if args.scenario:

@@ -638,9 +638,11 @@ class ReactiveAutoscaler:
                  restart_attempts: int = 2,
                  channel_capacity: int = 32,
                  job_timeout_s: float = 600.0,
-                 latency_interval_ms: Optional[int] = None):
+                 latency_interval_ms: Optional[int] = None,
+                 incremental: bool = False):
         self.plan_factory = plan_factory
         self.checkpoint_storage = checkpoint_storage
+        self.incremental = bool(incremental)
         self.policy = policy or AutoscalerPolicy()
         self.poll_interval_ms = float(poll_interval_ms)
         self.rescale_deadline_ms = float(rescale_deadline_ms)
@@ -742,7 +744,8 @@ class ReactiveAutoscaler:
             restart_attempts=self.restart_attempts,
             channel_capacity=self.channel_capacity,
             tolerable_failed_checkpoints=-1,
-            latency_interval_ms=self.latency_interval_ms)
+            latency_interval_ms=self.latency_interval_ms,
+            incremental=self.incremental)
         cluster.autoscaler_status_supplier = self.status
         autoscaler_metrics(cluster.job_metric_group, self.status)
         # incarnation fencing: the new deployment's checkpoint ids start

@@ -349,8 +349,21 @@ class _WorkerRuntime:
                 info["uid"] == vertex_uid for info in self._q_states.values()):
             # stash for the worker-local replica tier: on notify-complete
             # the stashed snapshots feed THIS worker's replica shards (the
-            # worker never sees the coordinator-assembled checkpoint)
-            self._q_acks[(vertex_uid, subtask_index)] = snapshot
+            # worker never sees the coordinator-assembled checkpoint).
+            # An incremental ack resolves against the previous stash so
+            # the replica tier always ingests dense state; an unresolvable
+            # chain just skips the stash (the replica stays one cut stale)
+            from flink_tpu.runtime.checkpoint import delta
+            stash = snapshot
+            if delta.tree_has_increment(stash):
+                try:
+                    stash = delta.apply_increments(
+                        self._q_acks.get((vertex_uid, subtask_index)),
+                        stash)
+                except delta.IncrementChainError:
+                    stash = None
+            if stash is not None:
+                self._q_acks[(vertex_uid, subtask_index)] = stash
         self._send(("ack", checkpoint_id, vertex_uid, subtask_index,
                     snapshot))
 
@@ -527,6 +540,7 @@ class _WorkerRuntime:
             """Local-recovery preference: this worker's own local copy of
             (checkpoint, uid, subtask) wins over the coordinator-shipped
             remote state; the shipped copy is the fallback."""
+            from flink_tpu.testing import chaos
             shipped = sub_snaps[i] if i < len(sub_snaps) else None
             if self.local_store is not None and restore_cid is not None \
                     and same_run:
@@ -536,6 +550,15 @@ class _WorkerRuntime:
                     return local
                 if shipped is not None:
                     self.recovery_remote += 1
+            if shipped is not None and not chaos.fire(
+                    "restore.fetch", direction="storage->worker",
+                    worker=self.index, uid=uid, subtask=i):
+                # Partition(direction="storage->worker"): the remote
+                # (primary-storage) copy is unreachable — fail the deploy
+                # loudly rather than silently restoring empty state
+                raise RuntimeError(
+                    f"restore fetch partitioned (storage->worker) for "
+                    f"{uid}[{i}] and no local copy available")
             return shipped
 
         to_start: List[Tuple[Any, Optional[Dict[str, Any]]]] = []
@@ -599,6 +622,23 @@ class _WorkerRuntime:
                 # the just-started tasks guarantee a future terminal
                 # transition that runs the done check
                 self._done_sent = False
+        # incremental checkpoints (ISSUE-16): flip delta-tracking on in
+        # every capable operator/backend of this worker's slice (mirror of
+        # MiniCluster._attach_observability's incremental wiring)
+        if opts.get("incremental"):
+            for t, _snap in to_start:
+                t.incremental_checkpoints = True
+                for member in getattr(t.operator, "operators", [t.operator]):
+                    if hasattr(member, "incremental_state"):
+                        member.incremental_state = True
+                        if hasattr(member, "incr_rebase_ratio"):
+                            member.incr_rebase_ratio = float(
+                                opts.get("incr_rebase_ratio", 0.5))
+                        be = getattr(member, "backend", None)
+                        if be is not None \
+                                and hasattr(be, "snapshot_increment"):
+                            be.materialize_threshold = int(
+                                opts.get("materialization_threshold", 256))
         lat_ms = int(opts.get("latency_interval_ms") or 0)
         # worker-local deploy barrier (the MiniCluster one, scoped to this
         # process's slice): shared-instance sinks restore by replacement,
@@ -880,7 +920,10 @@ class ProcessCluster:
                  tracing: bool = False,
                  latency_interval_ms: Optional[int] = None,
                  trace_capacity: int = 65536,
-                 queryable_serving: bool = True):
+                 queryable_serving: bool = True,
+                 incremental: bool = False,
+                 incremental_rebase_ratio: float = 0.5,
+                 changelog_materialization_threshold: int = 256):
         from flink_tpu.observability import tracing as tracing_mod
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureManager
@@ -899,7 +942,16 @@ class ProcessCluster:
                           # per-worker serving (ISSUE-13): workers with
                           # queryable operators stand up local servers and
                           # register their endpoints here at deploy
-                          "queryable_serving": queryable_serving}
+                          "queryable_serving": queryable_serving,
+                          # incremental checkpoints (ISSUE-16): workers flip
+                          # delta-tracking on in their operators/backends;
+                          # the coordinator resolves increment acks against
+                          # the previous completed cut before anything
+                          # downstream consumes them
+                          "incremental": incremental,
+                          "incr_rebase_ratio": incremental_rebase_ratio,
+                          "materialization_threshold":
+                              changelog_materialization_threshold}
         #: end-to-end tracing: workers record spans locally; at job end
         #: the coordinator pulls every ring and assembles ONE merged
         #: timeline (result["trace"], also kept as self.last_trace)
@@ -998,6 +1050,10 @@ class ProcessCluster:
         self._rows: Dict[Tuple[str, int], List[Dict[str, Any]]] = {}
         self._pending: Optional[_Pending] = None
         self._failed: Optional[str] = None
+        #: previous completed checkpoint as a RESOLVED (increment-free)
+        #: tree — the base increment acks of the next cut resolve against;
+        #: reset per attempt (a restored execution's first cut is full)
+        self._latest_resolved: Optional[Dict[str, Any]] = None
         self._done_workers: set = set()
         #: control connections that hit EOF this attempt: collect_trace
         #: must not wait its full timeout on a worker that can never
@@ -1435,9 +1491,15 @@ class ProcessCluster:
 
     def _latest_restore(self, original_restore):
         """This run's newest completed checkpoint, else the original
-        restore the run started from."""
+        restore the run started from.  A load failure (corrupt increment
+        chain, transient read error) falls back to progressively older
+        completed checkpoints — recovery must not die on one bad file."""
         if self.checkpoint_storage is not None and self._completed_ids:
-            return self.checkpoint_storage.load(max(self._completed_ids))
+            for cid in sorted(self._completed_ids, reverse=True):
+                try:
+                    return self.checkpoint_storage.load(cid)
+                except Exception:  # noqa: BLE001
+                    continue
         return original_restore
 
     def _affected_region_subtasks(self, plan, dead) -> Optional[set]:
@@ -1500,6 +1562,9 @@ class ProcessCluster:
             self._rows = {}
             self._pending = None
             self._failed = None
+            # the redeploy restores operators, so their first cut is a
+            # full base — the old resolution base is no longer the parent
+            self._latest_resolved = None
             self._done_workers = set()
             self._all_done = threading.Event()
             # failover: in-flight checkpoint attempts die with the old
@@ -1551,6 +1616,11 @@ class ProcessCluster:
                 self._rows.pop(key, None)
             self._pending = None            # in-flight checkpoint aborts
             self._failed = None
+            # _latest_resolved survives region recovery ON PURPOSE: the
+            # unaffected regions' operators keep their increment chains
+            # (anchored at the last completed cut == _latest_resolved),
+            # while the affected regions restore and ack full cuts that
+            # replace their subtrees wholesale during resolution
             # region failover restarts the continuous-failure window, same
             # as a full restart (MiniCluster does this per region restart)
             self.failure_manager.on_job_restart()
@@ -1849,15 +1919,36 @@ class ProcessCluster:
         # claim completion BEFORE dropping the lock for storage I/O: late
         # acks for this id are ignored and a new trigger may start
         self._pending = None
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureReason
+        # incremental checkpoints (ISSUE-16): delta-tracking operators
+        # acked increment nodes — resolve them against the previous
+        # completed cut so restore/queryable/rescale keep consuming the
+        # dense interchange format; increment-capable storage persists the
+        # RAW tree (bytes ∝ change rate), everything else the resolved cut
+        from flink_tpu.runtime.checkpoint import delta
+        has_delta = delta.tree_has_increment(assembled)
+        if has_delta:
+            try:
+                resolved = delta.apply_increments(self._latest_resolved,
+                                                  assembled)
+            except delta.IncrementChainError as e:
+                self._checkpoint_failure_locked(
+                    CheckpointFailureReason.STORAGE, p.cid,
+                    f"IncrementChainError: {e}")
+                return
+        else:
+            resolved = assembled
         if self.checkpoint_storage is not None:
-            from flink_tpu.runtime.checkpoint.failure import \
-                CheckpointFailureReason
+            store_tree = assembled if (has_delta and getattr(
+                self.checkpoint_storage, "supports_increments", False)) \
+                else resolved
             # the store (and any retry/backoff wrapper) must not stall the
             # coordinator lock: worker events keep flowing while bytes land
             self._lock.release()
             try:
                 try:
-                    self.checkpoint_storage.store(p.cid, assembled)
+                    self.checkpoint_storage.store(p.cid, store_tree)
                 except Exception as e:  # noqa: BLE001
                     store_error = f"{type(e).__name__}: {e}"
                 else:
@@ -1873,10 +1964,11 @@ class ProcessCluster:
                 return
         self.failure_manager.on_checkpoint_success(p.cid)
         self._completed_ids.append(p.cid)
+        self._latest_resolved = resolved
         if self.queryable is not None:
             # feed the read replicas off the checkpoint stream (enqueue
             # only; the service's ingest thread parses the snapshot)
-            self.queryable.on_checkpoint_complete(p.cid, assembled)
+            self.queryable.on_checkpoint_complete(p.cid, resolved)
         # aggregate the subtasks' channel-state (v1) alignment accounting
         # (one shared reader of the schema: task.aggregate_channel_state)
         from flink_tpu.cluster.task import aggregate_channel_state
@@ -1886,9 +1978,16 @@ class ProcessCluster:
                              cat="checkpoint", checkpoint=p.cid,
                              acked=len(p.acks),
                              unaligned=bool(agg["unaligned"]))
+        from flink_tpu.cluster.minicluster import _state_size
+        size = _state_size(resolved)
         self._checkpoint_stats.append({
             "id": p.cid, "duration_ms": round(p.timer.ms(), 1),
-            "acked_subtasks": len(p.acks), **agg})
+            "acked_subtasks": len(p.acks),
+            "state_size_bytes": size,
+            # full-vs-delta accounting (== state_size_bytes on a full cut)
+            "incremental": has_delta,
+            "delta_bytes": _state_size(assembled) if has_delta else size,
+            **agg})
         del self._checkpoint_stats[:-100]
         for idx in self._conns:
             self._to_worker(idx, ("notify", p.cid))

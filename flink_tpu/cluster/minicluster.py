@@ -113,11 +113,12 @@ class MiniCluster(TaskListener):
                  alignment_queue_max: Optional[int] = None,
                  latency_interval_ms: Optional[int] = None,
                  tracing_enabled: Optional[bool] = None,
-                 queryable_replicas: int = 1):
+                 queryable_replicas: int = 1,
+                 incremental: bool = False):
         from flink_tpu.cluster.failover import (FixedDelayRestartStrategy,
                                                 NoRestartStrategy)
         from flink_tpu.config.options import (CheckpointingOptions,
-                                              MetricOptions)
+                                              MetricOptions, StateOptions)
         from flink_tpu.observability import LatencyTracker
         from flink_tpu.observability import tracing as tracing_mod
         from flink_tpu.runtime.checkpoint.failure import \
@@ -183,6 +184,19 @@ class MiniCluster(TaskListener):
         self.checkpoint_storage = checkpoint_storage
         self.checkpoint_interval_ms = checkpoint_interval_ms
         self.unaligned = unaligned
+        # incremental (delta) checkpoints: explicit arg wins, then the
+        # state.backend.incremental config key
+        if not incremental and config is not None:
+            incremental = bool(config.get(StateOptions.INCREMENTAL))
+        self.incremental = bool(incremental)
+        self.incremental_rebase_ratio = float(
+            config.get(CheckpointingOptions.INCREMENTAL_REBASE_RATIO)
+            if config is not None
+            else CheckpointingOptions.INCREMENTAL_REBASE_RATIO.default)
+        self.changelog_materialization_threshold = int(
+            config.get(StateOptions.CHANGELOG_MATERIALIZATION_THRESHOLD)
+            if config is not None
+            else StateOptions.CHANGELOG_MATERIALIZATION_THRESHOLD.default)
         self.checkpoint_timeout_s = checkpoint_timeout_s
         self.restart_attempts = restart_attempts
         self.restart_delay_ms = restart_delay_ms
@@ -356,16 +370,39 @@ class MiniCluster(TaskListener):
         # claim completion BEFORE dropping the lock for storage I/O: late
         # acks/declines for this id are ignored and a new trigger may start
         self._pending = None
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureReason
+        # incremental checkpoints: delta-tracking operators acked increment
+        # nodes — resolve them against the previous completed checkpoint's
+        # RESOLVED tree so everything downstream (queryable replicas,
+        # rescale, in-memory restore) keeps consuming the dense interchange
+        # format.  Increment-capable storage persists the RAW tree (bytes
+        # scale with the change rate); every other storage gets the
+        # self-contained resolved cut.
+        from flink_tpu.runtime.checkpoint import delta
+        has_delta = delta.tree_has_increment(assembled)
+        if has_delta:
+            try:
+                resolved = delta.apply_increments(
+                    getattr(self, "_latest_snapshot", None), assembled)
+            except delta.IncrementChainError as e:
+                self._record_checkpoint_failure(
+                    CheckpointFailureReason.STORAGE, p.checkpoint_id,
+                    f"IncrementChainError: {e}")
+                return
+        else:
+            resolved = assembled
         if self.checkpoint_storage is not None:
-            from flink_tpu.runtime.checkpoint.failure import \
-                CheckpointFailureReason
+            store_tree = assembled if (has_delta and getattr(
+                self.checkpoint_storage, "supports_increments", False)) \
+                else resolved
             # the store (and any retry/backoff wrapper around it) must not
             # stall the coordinator lock: acks, declines and triggers keep
             # flowing while the bytes land
             self._lock.release()
             try:
                 try:
-                    self.checkpoint_storage.store(p.checkpoint_id, assembled)
+                    self.checkpoint_storage.store(p.checkpoint_id, store_tree)
                 except Exception as e:  # noqa: BLE001
                     store_error = f"{type(e).__name__}: {e}"
                 else:
@@ -383,12 +420,12 @@ class MiniCluster(TaskListener):
                 return
         self.failure_manager.on_checkpoint_success(p.checkpoint_id)
         self._completed_ids.append(p.checkpoint_id)
-        self._latest_snapshot = assembled
+        self._latest_snapshot = resolved
         if self.queryable is not None:
             # feed the read replicas off the checkpoint stream: enqueue
             # only (the replica's own ingest thread parses the snapshot —
             # the acking task thread never does serving-tier work)
-            self.queryable.on_checkpoint_complete(p.checkpoint_id, assembled)
+            self.queryable.on_checkpoint_complete(p.checkpoint_id, resolved)
         # aggregate the subtasks' channel-state (v1) alignment accounting
         # (one shared reader of the schema: task.aggregate_channel_state)
         from flink_tpu.cluster.task import aggregate_channel_state
@@ -401,7 +438,7 @@ class MiniCluster(TaskListener):
             "unaligned_checkpoints":
                 self._last_alignment.get("unaligned_checkpoints", 0)
                 + int(agg["unaligned"])}
-        size = _state_size(assembled)
+        size = _state_size(resolved)
         # trigger→complete span: the whole lifecycle on one timeline row
         if p.t0_ns:
             tracing.complete("checkpoint", p.t0_ns, time.perf_counter_ns(),
@@ -413,6 +450,10 @@ class MiniCluster(TaskListener):
             "completed_at_ms": int(time.time() * 1000),
             "duration_ms": round(p.timer.ms(), 1),
             "state_size_bytes": size,
+            # full-vs-delta accounting: what was acked/persisted this cut
+            # (== state_size_bytes for a full cut)
+            "incremental": has_delta,
+            "delta_bytes": _state_size(assembled) if has_delta else size,
             "acked_subtasks": len(p.acks),
             **agg})
         del self._checkpoint_stats[:-100]           # bounded history
@@ -618,6 +659,21 @@ class MiniCluster(TaskListener):
         t._deploy_gate = getattr(self, "_deploy_gate", None)
         if isinstance(t, SourceSubtask) and self.latency_interval_ms:
             t.latency_marker_interval_ms = self.latency_interval_ms
+        if self.incremental:
+            # delta checkpoints: the subtask opens the snapshot scope with
+            # incremental=True (savepoints/finals excepted) and every
+            # delta-capable operator in the chain starts dirty tracking
+            t.incremental_checkpoints = True
+            for member in getattr(t.operator, "operators", [t.operator]):
+                if hasattr(member, "incremental_state"):
+                    member.incremental_state = True
+                    if hasattr(member, "incr_rebase_ratio"):
+                        member.incr_rebase_ratio = \
+                            self.incremental_rebase_ratio
+                be = getattr(member, "backend", None)
+                if be is not None and hasattr(be, "snapshot_increment"):
+                    be.materialize_threshold = \
+                        self.changelog_materialization_threshold
 
     def _wire_queryable(self, plan: ExecutionPlan) -> None:
         """Register every ``queryable=<name>`` operator's live views with

@@ -68,6 +68,13 @@ class _Cancel(Exception):
 
 
 class SubtaskBase:
+    #: set by the deploying cluster when incremental checkpointing is on:
+    #: periodic checkpoint cuts run inside snapshot_scope(incremental=True)
+    #: so delta-tracking operators may ship increments.  Savepoints and
+    #: final (FLIP-147) snapshots stay full regardless — they are the
+    #: rescale/interchange format
+    incremental_checkpoints = False
+
     def __init__(self, vertex_uid: str, subtask_index: int, operator,
                  outputs: Sequence[OutputDispatcher],
                  ctx: RuntimeContext,
@@ -432,7 +439,9 @@ class SourceSubtask(SubtaskBase):
                                       cat="checkpoint", checkpoint=cid,
                                       task=self.vertex_uid,
                                       subtask=self.subtask_index), \
-                            snapshot_scope(cid):
+                            snapshot_scope(
+                                cid, self.incremental_checkpoints
+                                and not sp):
                         snap = {"operator": self.operator.snapshot_state(),
                                 "source_offset": self._emitted}
                 except _Cancel:
@@ -915,7 +924,8 @@ class Subtask(SubtaskBase):
             with tracing.span("checkpoint.snapshot", cat="checkpoint",
                               checkpoint=cid, task=self.vertex_uid,
                               subtask=self.subtask_index, overtake=True), \
-                    snapshot_scope(cid):
+                    snapshot_scope(cid, self.incremental_checkpoints
+                                   and not barrier.is_savepoint):
                 self._pending_snapshot = {
                     "operator": self.operator.snapshot_state(),
                     "valve": self._valve.snapshot()}
@@ -1150,7 +1160,8 @@ class Subtask(SubtaskBase):
                 with tracing.span("checkpoint.snapshot", cat="checkpoint",
                                   checkpoint=cid, task=self.vertex_uid,
                                   subtask=self.subtask_index), \
-                        snapshot_scope(cid):
+                        snapshot_scope(cid, self.incremental_checkpoints
+                                       and not barrier.is_savepoint):
                     snap = {"operator": self.operator.snapshot_state(),
                             "valve": self._valve.snapshot()}
             except _Cancel:

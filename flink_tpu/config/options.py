@@ -43,7 +43,16 @@ class StateOptions:
     SAVEPOINT_DIR = key("state.savepoints.dir").string_type().default_value(
         None, "Directory for user-triggered savepoints.")
     INCREMENTAL = key("state.backend.incremental").bool_type().default_value(
-        False, "Incremental checkpoints (chunk diffing against previous snapshot).")
+        False, "Incremental checkpoints: delta-tracking operators ship "
+        "pane-granular / changelog-suffix increments against the last "
+        "confirmed base instead of full snapshots — checkpoint bytes scale "
+        "with the change rate.  Savepoints and final (drain) snapshots "
+        "stay full/self-contained.")
+    CHANGELOG_MATERIALIZATION_THRESHOLD = key(
+        "state.changelog.materialization-threshold").int_type().default_value(
+        256, "Changelog backend: auto-materialize (full inner snapshot + "
+        "log truncation) once the mutation log reaches this many entries; "
+        "0 keeps materialization manual.")
 
 
 class CheckpointingOptions:
@@ -72,6 +81,16 @@ class CheckpointingOptions:
         "channels during alignment.  Hitting it escalates to unaligned "
         "when an alignment timeout is configured, and raises a classified "
         "AlignmentBufferOverflowError otherwise — bounded memory either way.")
+    INCREMENTAL_MAX_INCREMENTS = key(
+        "execution.checkpointing.incremental.max-increments-per-base").int_type().default_value(
+        8, "Incremental storage: background-compact a checkpoint into a "
+        "self-contained base once its increment chain exceeds this many "
+        "links (bounds restore replay depth and retention pinning).")
+    INCREMENTAL_REBASE_RATIO = key(
+        "execution.checkpointing.incremental.rebase-ratio").float_type().default_value(
+        0.5, "Delta-tracking operators take a full re-base cut when dirty "
+        "cells exceed this fraction of the dense state grid (an increment "
+        "bigger than that stops paying for itself).")
 
 
 class DeviceOptions:

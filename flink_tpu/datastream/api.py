@@ -148,7 +148,8 @@ class StreamExecutionEnvironment:
                         checkpoint_timeout_s: float = 60.0,
                         alignment_timeout_ms: Optional[float] = None,
                         alignment_queue_max: Optional[int] = None,
-                        channel_capacity: int = 32):
+                        channel_capacity: int = 32,
+                        incremental: bool = False):
         """Run on the in-process MiniCluster with REAL parallelism (one
         thread per subtask, channels + partitioners between them) — the
         multi-node semantics path (``MiniCluster.java`` analog).
@@ -170,7 +171,8 @@ class StreamExecutionEnvironment:
             checkpoint_timeout_s=checkpoint_timeout_s,
             alignment_timeout_ms=alignment_timeout_ms,
             alignment_queue_max=alignment_queue_max,
-            channel_capacity=channel_capacity, config=self.config)
+            channel_capacity=channel_capacity, config=self.config,
+            incremental=incremental)
         self._last_cluster = cluster
         return cluster.execute(plan, restore=restore, timeout_s=timeout_s)
 

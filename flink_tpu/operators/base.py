@@ -34,14 +34,33 @@ def current_checkpoint_id() -> Optional[int]:
     return _CURRENT_CHECKPOINT_ID.get()
 
 
+#: True while the snapshot being taken may ship delta INCREMENTS instead of
+#: full state (incremental checkpointing enabled AND this cut is neither a
+#: savepoint nor a final FLIP-147 snapshot).  Operators with delta tracking
+#: (WindowAggOperator, changelog-backed KeyedProcessOperator) read it inside
+#: snapshot_state(); everyone else ignores it.  ContextVar like the id:
+#: concurrent subtask threads stay isolated, chained operators inherit it.
+_SNAPSHOT_INCREMENTAL: contextvars.ContextVar[bool] = \
+    contextvars.ContextVar("flink_tpu_snapshot_incremental", default=False)
+
+
+def snapshot_is_incremental() -> bool:
+    """May the in-progress snapshot ship a delta increment?  False outside
+    a snapshot, for savepoints and for final (FLIP-147) snapshots."""
+    return _SNAPSHOT_INCREMENTAL.get()
+
+
 @contextlib.contextmanager
-def snapshot_scope(checkpoint_id: Optional[int]):
+def snapshot_scope(checkpoint_id: Optional[int], incremental: bool = False):
     """Runtimes wrap operator ``snapshot_state()`` calls in this scope so
-    sinks can associate staged 2PC transactions with the checkpoint id."""
+    sinks can associate staged 2PC transactions with the checkpoint id and
+    delta-tracking operators know whether increments are allowed."""
     tok = _CURRENT_CHECKPOINT_ID.set(checkpoint_id)
+    tok2 = _SNAPSHOT_INCREMENTAL.set(incremental)
     try:
         yield
     finally:
+        _SNAPSHOT_INCREMENTAL.reset(tok2)
         _CURRENT_CHECKPOINT_ID.reset(tok)
 
 

@@ -20,7 +20,8 @@ import numpy as np
 
 from flink_tpu.core.batch import LONG_MIN, RecordBatch, StreamElement, Watermark
 from flink_tpu.core.functions import RichFunction, RuntimeContext
-from flink_tpu.operators.base import StreamOperator
+from flink_tpu.operators.base import (StreamOperator, current_checkpoint_id,
+                                      snapshot_is_incremental)
 from flink_tpu.runtime.timers import InternalTimerService
 from flink_tpu.state.heap import HeapKeyedStateBackend
 
@@ -107,6 +108,9 @@ class KeyedProcessOperator(StreamOperator):
         self.backend = backend if backend is not None \
             else HeapKeyedStateBackend()
         self.timers = InternalTimerService()
+        #: incremental checkpoints: ship changelog-suffix increments when
+        #: the backend supports them (runtime enables this per job)
+        self.incremental_state = False
 
     def open(self, ctx: RuntimeContext) -> None:
         super().open(ctx)
@@ -142,8 +146,7 @@ class KeyedProcessOperator(StreamOperator):
         return _normalize(out) + ctx._side
 
     # -- checkpointing -------------------------------------------------------
-    def snapshot_state(self) -> Dict[str, Any]:
-        snap = self.backend.snapshot()
+    def _timer_snapshot(self) -> Dict[str, Any]:
         tsnap = self.timers.snapshot()
         # slot ids -> raw keys for rescale-safety
         for part in ("event", "proc"):
@@ -152,8 +155,29 @@ class KeyedProcessOperator(StreamOperator):
             tsnap[part]["keys"] = (self.backend.slot_keys(slots)
                                    if slots.size else np.zeros(0, np.int64))
             del tsnap[part]["slots"]
-        snap["timers"] = tsnap
+        return tsnap
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        cid = current_checkpoint_id()
+        if self.incremental_state and cid is not None \
+                and snapshot_is_incremental() \
+                and hasattr(self.backend, "snapshot_increment"):
+            inc = self.backend.snapshot_increment(cid)
+            if inc is not None:
+                # timers ride in extras (small, shipped whole every cut:
+                # the applier overwrites them onto the resolved base)
+                inc["extras"] = {"timers": self._timer_snapshot()}
+                return inc
+            # fall through: full cut (the backend froze the position, so
+            # confirmation still advances the suffix base to this cut)
+        snap = self.backend.snapshot()
+        snap["timers"] = self._timer_snapshot()
         return snap
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        if hasattr(self.backend, "notify_checkpoint_complete"):
+            self.backend.notify_checkpoint_complete(checkpoint_id)
+        super().notify_checkpoint_complete(checkpoint_id)
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         tsnap = snap.get("timers")

@@ -63,7 +63,8 @@ from flink_tpu.core.functions import (SCATTER_UFUNCS, AggregateFunction,
                                       RuntimeContext)
 from flink_tpu.core import keygroups
 from flink_tpu.observability import tracing
-from flink_tpu.operators.base import StreamOperator
+from flink_tpu.operators.base import (StreamOperator, current_checkpoint_id,
+                                      snapshot_is_incremental)
 from flink_tpu.runtime.device_health import DeviceQuarantinedError
 from flink_tpu.ops.scatter import (combine_along_axis,
                                    gather_row_pane_columns, reset_rows,
@@ -681,6 +682,18 @@ class WindowAggOperator(StreamOperator):
             from flink_tpu.queryable.view import WindowReadView
             self._qview = WindowReadView(key_column)
 
+        # ---- incremental (delta) checkpoints (ISSUE-16): when the runtime
+        # enables it, every state mutation marks its (key, pane) cells /
+        # baseline windows dirty, and a non-savepoint snapshot ships only
+        # the dirt accumulated since the last CONFIRMED checkpoint as a
+        # ``window_delta`` increment (runtime/checkpoint/delta.py) instead
+        # of the full dense grid.  Off (the default) costs one attribute
+        # check per batch.
+        self.incremental_state = False
+        #: full re-base when dirty cells exceed this fraction of the grid
+        self.incr_rebase_ratio = 0.5
+        self._incr_clear()
+
     #: snapshot entries row-indexed by key slot (rescale redistribution)
     ROW_FIELDS = ("leaves", "counts")
 
@@ -863,6 +876,7 @@ class WindowAggOperator(StreamOperator):
                           "miss_inserts": 0, "delta_syncs": 0}
         if self._pager is not None:
             self._pager.reset()
+        self._incr_clear()      # a fresh state has no confirmed delta base
 
     # ------------------------------------------------------------------ state
     def _alloc(self, K: int, P: int):
@@ -2379,6 +2393,11 @@ class WindowAggOperator(StreamOperator):
 
         pmin, pmax = int(panes.min()), int(panes.max())
         values = self._select(cols)
+        if self.incremental_state:
+            # delta checkpoints: every (key, pane) this batch touches stays
+            # dirty until a checkpoint containing it is CONFIRMED; raw keys
+            # resolve to gids lazily at cut time (the index is append-only)
+            self._incr_mark_batch(keys, panes)
         if self._pipe_active():
             # two-stage software pipeline: the hot stage (probe/mirror +
             # paging + device dispatch) runs on the background worker while
@@ -3070,8 +3089,12 @@ class WindowAggOperator(StreamOperator):
             lo_w = self.assigner.windows_of_pane(self.pane_base)[0]
             for w in [w for w in self._count_baselines if w < lo_w]:
                 del self._count_baselines[w]
+                if self.incremental_state:
+                    self._incr_cb_drops.add(w)
             for w in [w for w in self._value_baselines if w < lo_w]:
                 del self._value_baselines[w]
+                if self.incremental_state:
+                    self._incr_vb_drops.add(w)
 
     # ------------------------------------------------------------------ fires
     def _fire_window(self, window_id: int) -> List[StreamElement]:
@@ -3140,6 +3163,10 @@ class WindowAggOperator(StreamOperator):
                     grown[:len(base)] = base
                 base = grown
                 self._count_baselines[0] = base
+                if self.incremental_state:
+                    # creation counts: a full snapshot packs the register
+                    # even before its first fire
+                    self._incr_cb_dirty.add(0)
             mask = jnp.asarray((counts_np - base[:ka]) >= thr)
         else:
             mask = counts0 >= thr
@@ -3154,6 +3181,8 @@ class WindowAggOperator(StreamOperator):
             fired = np.asarray(mask)
             base[:ka] = np.where(fired, np.asarray(counts0, np.int64),
                                  base[:ka])
+            if self.incremental_state:
+                self._incr_cb_dirty.add(0)
         if self.trigger.purges_on_fire and out:
             full_mask = jnp.zeros((self._K,), bool).at[:ka].set(mask)
             self._leaves, self._counts = self._purge_keys_step(
@@ -3161,6 +3190,10 @@ class WindowAggOperator(StreamOperator):
             fired_np = np.asarray(mask)
             for arr in self._mirror.values():  # whole key rows were purged
                 arr[: fired_np.size][fired_np] = False
+            if self.incremental_state:
+                # purged rows are identity in EVERY retained pane now
+                self._incr_mark_gids(np.flatnonzero(fired_np),
+                                     self._live_panes())
         return out
 
     def _fire_count_in_panes(self, touched_panes) -> List[StreamElement]:
@@ -3192,10 +3225,12 @@ class WindowAggOperator(StreamOperator):
                 full = jnp.zeros((self._K,), bool).at[:ka].set(mask)
                 self._leaves, self._counts = self._purge_cells_step(
                     self._leaves, self._counts, full, pane_slots)
+                fired_np = np.asarray(mask)
                 marr = self._mirror.get(int(p))
                 if marr is not None:
-                    fired_np = np.asarray(mask)
                     marr[: fired_np.size][fired_np] = False
+                if self.incremental_state:
+                    self._incr_mark_gids(np.flatnonzero(fired_np), [int(p)])
         return out
 
     def _fire_count_sliding(self, touched_panes) -> List[StreamElement]:
@@ -3248,6 +3283,10 @@ class WindowAggOperator(StreamOperator):
                                           self.assigner.window_bounds(w)))
                 base[:ka] = np.where(over, counts_w, base[:ka])
             self._count_baselines[w] = base
+            if self.incremental_state:
+                # the register exists (zero-grown included) — a full
+                # snapshot would pack it, so the delta must ship it too
+                self._incr_cb_dirty.add(w)
         return out
 
     def _emit_purging_sliding(self, w: int, slots, ka: int,
@@ -3276,6 +3315,8 @@ class WindowAggOperator(StreamOperator):
             sel = over.reshape((-1,) + (1,) * (b.ndim - 1))
             b[:ka] = np.where(sel, c, b[:ka])
         self._value_baselines[w] = vb
+        if self.incremental_state:
+            self._incr_vb_dirty.add(w)
         return out
 
     @partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2))
@@ -3492,12 +3533,209 @@ class WindowAggOperator(StreamOperator):
         n = self.key_index.num_keys if self.key_index is not None else 0
         return self._pager.stats(n)
 
+    # ------------------------------------ incremental (delta) checkpoints
+    def _incr_clear(self) -> None:
+        """Reset ALL delta tracking: the next cut must be a full re-base
+        (restore, reset — any point where the confirmed-base linkage to
+        the storage-side increment chain is severed)."""
+        self._incr_keychunks: List = []       # live (raw keys, panes) pairs
+        self._incr_gid_cells: Dict[int, List[np.ndarray]] = {}  # pane->gids
+        self._incr_cb_dirty: set = set()
+        self._incr_vb_dirty: set = set()
+        self._incr_cb_drops: set = set()
+        self._incr_vb_drops: set = set()
+        #: cuts taken but not yet confirmed: [(cid, cells, cbd, vbd,
+        #: cb_drops, vb_drops, num_keys_at_cut)] — every later cut ships
+        #: the UNION of these with the live dirt, so a crash between cut
+        #: and confirmation can never lose a mutation
+        self._incr_unconfirmed: List = []
+        self._incr_last_confirmed: Optional[int] = None
+        self._incr_confirmed_n = 0
+
+    def _incr_mark_batch(self, keys: np.ndarray, panes: np.ndarray) -> None:
+        self._incr_keychunks.append((np.array(keys, copy=True),
+                                     np.array(panes, copy=True)))
+        if len(self._incr_keychunks) > 512:
+            # bound live memory between cuts: coalesce into the gid map
+            self._incr_coalesce_live()
+
+    def _incr_mark_gids(self, gids: np.ndarray, panes) -> None:
+        """Product mark: every (gid, pane) cell in gids x panes is dirty."""
+        if not self.incremental_state or len(gids) == 0:
+            return
+        g = np.asarray(gids, np.int64).copy()
+        for p in np.asarray(panes).tolist():
+            self._incr_gid_cells.setdefault(int(p), []).append(g)
+
+    def _incr_coalesce_live(self) -> None:
+        """Resolve live raw-key chunks to gids and fold into the cell map."""
+        chunks, self._incr_keychunks = self._incr_keychunks, []
+        if self.key_index is None:
+            return
+        for keys, panes in chunks:
+            gids = np.asarray(self.key_index.lookup(keys), np.int64)
+            ok = gids >= 0
+            if not ok.all():
+                gids, panes = gids[ok], panes[ok]
+            for p in np.unique(panes).tolist():
+                self._incr_gid_cells.setdefault(int(p), []).append(
+                    gids[panes == p])
+
+    def _incr_freeze(self, cid: int) -> None:
+        """Move the live dirt into the unconfirmed ledger under ``cid``."""
+        self._incr_coalesce_live()
+        cells = {p: np.unique(lst[0] if len(lst) == 1
+                              else np.concatenate(lst))
+                 for p, lst in self._incr_gid_cells.items()}
+        n = self.key_index.num_keys if self.key_index is not None else 0
+        self._incr_unconfirmed.append(
+            (cid, cells, self._incr_cb_dirty, self._incr_vb_dirty,
+             self._incr_cb_drops, self._incr_vb_drops, n))
+        self._incr_gid_cells = {}
+        self._incr_cb_dirty, self._incr_vb_dirty = set(), set()
+        self._incr_cb_drops, self._incr_vb_drops = set(), set()
+
+    def _incremental_snapshot(self, cid: int):
+        """A ``window_delta`` increment covering every mutation since the
+        last CONFIRMED checkpoint, or None when this cut must be a full
+        re-base (no confirmed base yet, or the grid is too dirty for a
+        delta to pay off).  Either way the live dirt is frozen under
+        ``cid`` so the NEXT cut keeps covering it until confirmation."""
+        self._incr_freeze(cid)
+        base_n = self._incr_confirmed_n
+        if self._incr_last_confirmed is None or self.key_index is None:
+            return None
+        n = self.key_index.num_keys
+        # union of all unconfirmed dirt (absolute values: last-writer-wins
+        # replay makes shipping a superset harmless)
+        union: Dict[int, List[np.ndarray]] = {}
+        cbd: set = set()
+        vbd: set = set()
+        cb_drops: set = set()
+        vb_drops: set = set()
+        for (_c, ecells, ecbd, evbd, ecbdrop, evbdrop, _n) \
+                in self._incr_unconfirmed:
+            for p, g in ecells.items():
+                union.setdefault(int(p), []).append(g)
+            cbd |= ecbd
+            vbd |= evbd
+            cb_drops |= ecbdrop
+            vb_drops |= evbdrop
+        cells_map: Dict[int, np.ndarray] = {}
+        for p, lst in union.items():
+            if self.pane_base is not None and \
+                    not (self.pane_base <= p <= self.max_pane):
+                continue            # pane expired since it was marked
+            g = lst[0] if len(lst) == 1 else np.unique(np.concatenate(lst))
+            g = np.asarray(g, np.int64)
+            g = g[g < n]
+            if g.size:
+                cells_map[int(p)] = g
+        has_grid = (self._leaves is not None or self._degraded) \
+            and self.pane_base is not None
+        if has_grid:
+            m = int(self.max_pane - self.pane_base + 1)
+            dirty_cells = sum(int(g.size) for g in cells_map.values())
+            if n and m and dirty_cells > self.incr_rebase_ratio * n * m:
+                return None          # too dirty: re-base with a full cut
+        inc: Dict[str, Any] = {
+            "__increment__": 1, "kind": "window_delta",
+            "checkpoint_id": cid, "n": n, "base_n": base_n,
+            "has_grid": has_grid,
+            "meta": {"pane_base": self.pane_base, "max_pane": self.max_pane,
+                     "last_fired_window": self.last_fired_window,
+                     "watermark": self.watermark,
+                     "late_dropped": self.late_dropped, "P": self._P},
+            "key_index_kind": type(self.key_index).__name__,
+            "key_tail": np.asarray(
+                self.key_index.reverse_keys()[base_n:n]).copy(),
+        }
+        cell_list: List[Dict[str, Any]] = []
+        if has_grid and cells_map:
+            dirty_panes = sorted(cells_map)
+            panes_arr = np.asarray(dirty_panes, np.int64)
+            if self.snapshot_source == "mirror" or self._degraded:
+                with self._phase("snapshot"):
+                    counts, leaves = self._mirror_columns(dirty_panes, n)
+                for j, p in enumerate(dirty_panes):
+                    g = cells_map[p]
+                    cell_list.append(
+                        {"pane": p, "gids": g,
+                         "counts": counts[g, j].copy(),
+                         "leaves": [l[g, j].copy() for l in leaves]})
+            elif self._pager is not None:
+                with self._phase("snapshot"):
+                    counts, leaves = self._paged_snapshot_rows(n, panes_arr)
+                for j, p in enumerate(dirty_panes):
+                    g = cells_map[p]
+                    cell_list.append(
+                        {"pane": p, "gids": g,
+                         "counts": counts[g, j].copy(),
+                         "leaves": [l[g, j].copy() for l in leaves]})
+            else:
+                # device tier: ONE gather of the dirty-rows x dirty-panes
+                # grid — d2h bytes scale with the dirt, not the state
+                rows = np.unique(np.concatenate(
+                    [cells_map[p] for p in dirty_panes]))
+                with self._phase("snapshot"):
+                    counts, leaves = self._gather_rows(rows, panes_arr)
+                for j, p in enumerate(dirty_panes):
+                    g = cells_map[p]
+                    idx = np.searchsorted(rows, g)
+                    cell_list.append(
+                        {"pane": p, "gids": g,
+                         "counts": counts[idx, j].copy(),
+                         "leaves": [l[idx, j].copy() for l in leaves]})
+        inc["cells"] = cell_list
+        if has_grid:
+            from flink_tpu.state.evolution import acc_leaf_schema
+            inc["leaf_meta"] = [
+                (np.asarray(init, np.dtype(d)), str(np.dtype(d)),
+                 tuple(shape))
+                for init, shape, d in zip(self.spec.leaf_inits,
+                                          self.spec.leaf_shapes,
+                                          self.spec.leaf_dtypes)]
+            inc["leaf_schema"] = acc_leaf_schema(self.spec)
+        else:
+            inc["leaf_meta"] = []
+        if self._pager is not None:
+            inc["paging_stats"] = self._pager.stats(n)
+        cb_vals: Dict[int, np.ndarray] = {}
+        for w in cbd:
+            b = self._count_baselines.get(w)
+            if b is None:
+                cb_drops.add(w)
+            else:
+                cb_vals[w] = np.asarray(b, np.int64).copy()
+        vb_vals: Dict[int, List[np.ndarray]] = {}
+        for w in vbd:
+            ls = self._value_baselines.get(w)
+            if ls is None:
+                vb_drops.add(w)
+            else:
+                vb_vals[w] = [np.asarray(l).copy() for l in ls]
+        inc["count_baselines"] = cb_vals
+        inc["value_baselines"] = vb_vals
+        inc["cb_drops"] = sorted(cb_drops)
+        inc["vb_drops"] = sorted(vb_drops)
+        return inc
+
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
         """Track the last completed checkpoint so queryable live views tag
         the consistency point they reflect (base hook is a no-op)."""
         if self._last_completed_checkpoint is None \
                 or checkpoint_id > self._last_completed_checkpoint:
             self._last_completed_checkpoint = checkpoint_id
+        # delta tracking: dirt up to a CONFIRMED cut may be forgotten —
+        # only for cuts we actually froze (savepoints/finals are not part
+        # of the storage-side increment chain and must not advance it)
+        match = next((e for e in self._incr_unconfirmed
+                      if e[0] == checkpoint_id), None)
+        if match is not None:
+            self._incr_unconfirmed = [e for e in self._incr_unconfirmed
+                                      if e[0] > checkpoint_id]
+            self._incr_last_confirmed = checkpoint_id
+            self._incr_confirmed_n = match[6]
         super().notify_checkpoint_complete(checkpoint_id)
 
     def queryable_view(self):
@@ -3590,6 +3828,14 @@ class WindowAggOperator(StreamOperator):
                 "snapshot with in-flight async fires: the runtime must call "
                 "prepare_snapshot_pre_barrier() (and forward its elements) "
                 "before snapshot_state()")
+        cid = current_checkpoint_id()
+        if self.incremental_state and cid is not None \
+                and snapshot_is_incremental():
+            inc = self._incremental_snapshot(cid)
+            if inc is not None:
+                return inc
+            # fall through: full re-base cut (the dirt was still frozen
+            # under cid, so confirmation advances the delta base to it)
         snap: Dict[str, Any] = {
             "pane_base": self.pane_base,
             "max_pane": self.max_pane,
@@ -3682,6 +3928,7 @@ class WindowAggOperator(StreamOperator):
         self._nm_tried = False
         self._dki = None         # probe table rebuilds from the key index
         self._drop_delta()
+        self._incr_clear()       # restored state: first cut is a full base
         self._devprobe_resolved = None
         if "key_index" in snap:
             if snap["key_index_kind"] == "ObjectKeyIndex":

@@ -302,7 +302,11 @@ class ScenarioHarness:
                 poll_interval_ms=25.0, checkpoint_interval_ms=50,
                 alignment_timeout_ms=100.0, restart_attempts=4,
                 job_timeout_s=self.job_timeout_s,
-                latency_interval_ms=50)
+                latency_interval_ms=50,
+                # ISSUE-16: sub-second cuts stay affordable because delta
+                # tracking ships increment bytes ∝ change rate — the 2PC
+                # commit cadence stops being bounded by full-state bytes
+                incremental=True)
             inj = chaos.FaultInjector(seed=spec.seed)
             cost = _ConsumerCost(
                 self.consumer_cost_s,
@@ -399,7 +403,7 @@ class ScenarioHarness:
             cluster = MiniCluster(
                 checkpoint_storage=InMemoryCheckpointStorage(retain=5),
                 checkpoint_interval_ms=50, alignment_timeout_ms=100.0,
-                restart_attempts=2)
+                restart_attempts=2, incremental=True)
             t0 = time.monotonic()
             try:
                 out = cluster.execute(plan, timeout_s=self.job_timeout_s)

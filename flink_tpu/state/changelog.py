@@ -61,6 +61,15 @@ class ChangelogKeyedStateBackend:
         self._materialized: Optional[Dict[str, Any]] = None
         self._states: Dict[str, _ChangelogStateProxy] = {}
         self._descs: Dict[str, StateDescriptor] = {}
+        # ---- incremental checkpointing (ISSUE-16): a cut may ship only the
+        # log SUFFIX beyond the last CONFIRMED checkpoint's log position,
+        # valid only within one materialization epoch (materialize() re-bases
+        # the log, so positions across epochs are incomparable)
+        self._epoch = 0
+        #: auto-materialize when the log outgrows this (0 = manual only)
+        self.materialize_threshold = 0
+        self._unconfirmed: List[Tuple[int, int, int]] = []  # (cid,epoch,len)
+        self._confirmed: Optional[Tuple[int, int]] = None   # (epoch, len)
 
     def reserve_managed(self, manager, owner: str) -> None:
         """Forward the managed-memory claim to the wrapped backend (the
@@ -137,8 +146,11 @@ class ChangelogKeyedStateBackend:
         """Full inner snapshot; truncate the log (periodic materialization).
         The truncated log is re-seeded with register entries so later
         mutations of already-known states stay replayable."""
+        from flink_tpu.testing import chaos
+        chaos.fire("checkpoint.materialize", log_size=len(self._log))
         self._materialized = self.inner.snapshot()
         self._log = [("register", d) for d in self._descs.values()]
+        self._epoch += 1    # log positions of older epochs are now invalid
 
     def changelog_size(self) -> int:
         return len(self._log)
@@ -152,7 +164,45 @@ class ChangelogKeyedStateBackend:
             "changelog": list(self._log),
         }
 
+    def snapshot_increment(self, checkpoint_id: int):
+        """A ``changelog`` increment node (runtime/checkpoint/delta.py) with
+        the log suffix beyond the last CONFIRMED cut, or None when this cut
+        must ship the full snapshot (no confirmed base, or a materialization
+        re-based the log since).  Freezes the cut position either way, so
+        later cuts keep covering it until ``notify_checkpoint_complete``."""
+        if self.materialize_threshold \
+                and len(self._log) >= self.materialize_threshold:
+            self.materialize()   # background re-base: this cut goes full
+        self._unconfirmed.append((checkpoint_id, self._epoch,
+                                  len(self._log)))
+        if self._confirmed is None or self._confirmed[0] != self._epoch:
+            return None
+        log_base = self._confirmed[1]
+        return {
+            "__increment__": 1, "kind": "changelog",
+            "checkpoint_id": checkpoint_id,
+            "log_base": log_base,
+            "log_suffix": list(self._log[log_base:]),
+            "extras": {},
+        }
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        """Advance the confirmed log position to a cut this backend froze
+        (savepoints/finals never call ``snapshot_increment`` and therefore
+        never advance the increment chain)."""
+        match = next((e for e in self._unconfirmed
+                      if e[0] == checkpoint_id), None)
+        if match is not None:
+            self._unconfirmed = [e for e in self._unconfirmed
+                                 if e[0] > checkpoint_id]
+            self._confirmed = (match[1], match[2])
+
     def restore(self, snap: Dict[str, Any]) -> None:
+        # restored state severs the linkage to any storage-side increment
+        # chain: the first cut after restore is a full base
+        self._unconfirmed = []
+        self._confirmed = None
+        self._epoch += 1
         if not snap.get("changelog_backend"):
             # plain inner snapshot (e.g. pre-changelog checkpoint)
             self.inner.restore(snap)

@@ -50,8 +50,9 @@ __all__ = [
     "InjectedFault", "FaultSchedule", "FailTimes", "CrashOnceAt", "DelayBy",
     "SlowDisk", "SlowConsumer", "ActionSequence", "Partition",
     "FailWithProbability", "WedgedDevice", "ClockSkew", "KillDuringRescale",
+    "TruncatedWrite",
     "FaultInjector", "FreezableProxy", "install", "uninstall", "installed",
-    "fire", "active", "blocked", "skew",
+    "fire", "active", "blocked", "skew", "truncated",
 ]
 
 #: actions a schedule may return for one firing
@@ -224,6 +225,28 @@ class SlowConsumer(FaultSchedule):
         if gate < self.p:
             self._burst_left = self.burst - 1
             return ("delay", span)
+        return OK
+
+
+class TruncatedWrite(FaultSchedule):
+    """Tear durable writes short: firings ``at .. at+times-1`` return a
+    ``("truncate", frac)`` action — the fault point (storage consults it
+    via :meth:`FaultInjector.truncated`) persists only the first
+    ``frac`` of the payload's bytes, models a crash/power-cut after the
+    file was published (torn page past the rename).  The CRC/size gate on
+    load is expected to classify the survivor as corrupt and fall back to
+    an older base."""
+
+    def __init__(self, at: int = 1, frac: float = 0.5, times: int = 1):
+        if not 0.0 <= frac < 1.0:
+            raise ValueError("TruncatedWrite: frac must be in [0, 1)")
+        self.at = at
+        self.frac = frac
+        self.times = times
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        if self.at <= n < self.at + self.times:
+            return ("truncate", self.frac)
         return OK
 
 
@@ -480,6 +503,33 @@ class FaultInjector:
             return float(act[1])
         return 0.0
 
+    def truncated(self, point: str, nbytes: int, **ctx) -> int:
+        """Durable-write twin of :meth:`fire`: returns how many of the
+        payload's ``nbytes`` actually persist.  One consult per call (the
+        counter/RNG/history advance exactly once — never combine with a
+        separate ``fire`` on the same point): ``("truncate", frac)``
+        actions keep the first ``int(nbytes * frac)`` bytes, ``drop``
+        persists nothing, ``delay``/``hang``/``fail`` behave exactly like
+        :meth:`fire`, ``ok`` persists everything."""
+        sched, act, n = self._consult(point, ctx)
+        if act == OK:
+            return nbytes
+        if isinstance(act, tuple) and act[0] == "truncate":
+            return int(nbytes * float(act[1]))
+        if act == DROP:
+            return 0
+        if act == HANG:
+            while sched.dropping():
+                time.sleep(0.005)
+            return nbytes
+        if isinstance(act, tuple) and act[0] == "delay":
+            time.sleep(act[1])
+            return nbytes
+        if isinstance(act, tuple) and act[0] == FAIL:
+            raise InjectedFault(act[1])
+        raise InjectedFault(f"injected fault at {point} (firing {n}, "
+                            f"ctx={ctx or {}})")
+
     def blocked(self, point: str, **ctx) -> bool:
         """Is the point's schedule in a persistent drop state?  The poll
         primitive for partition-style stalls: a blocked sender re-checks
@@ -566,6 +616,15 @@ def skew(point: str, **ctx) -> float:
     if inj is None:
         return 0.0
     return inj.skew(point, **ctx)
+
+
+def truncated(point: str, nbytes: int, **ctx) -> int:
+    """Durable-write hook (checkpoint storage): how many of ``nbytes``
+    persist at this fault point — ``nbytes`` when no injector/schedule."""
+    inj = _ACTIVE
+    if inj is None:
+        return nbytes
+    return inj.truncated(point, nbytes, **ctx)
 
 
 # ---------------------------------------------------------------------------

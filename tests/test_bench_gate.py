@@ -641,6 +641,81 @@ def test_inject_wedge_smoke_exercises_shared_recovery_path(tmp_path):
     assert hs["state"] == "healthy" and hs["degraded"] == 0
 
 
+def _incr_result(**kw):
+    r = {"ok": True, "n_keys": 1_000_000, "churn_keys": 100_000,
+         "incremental_checkpoints": 5, "full_snapshot_bytes": 16_000_000,
+         "increment_bytes_max": 1_600_000, "bytes_ratio": 0.10,
+         "increments_per_base": 5, "compactions": 0,
+         "recovery_ms": 900.0, "digest_match": True}
+    r.update(kw)
+    return r
+
+
+def _incr_budget(**kw):
+    b = {"max_bytes_ratio": 0.25, "max_recovery_ms": 30000,
+         "min_incremental_checkpoints": 1}
+    b.update(kw)
+    return b
+
+
+def test_check_incremental_budget_pass():
+    from bench import check_incremental_budget
+    assert check_incremental_budget(_incr_result(), _incr_budget()) == []
+
+
+def test_check_incremental_budget_bytes_ratio_ceiling():
+    from bench import check_incremental_budget
+    viol = check_incremental_budget(_incr_result(bytes_ratio=0.40),
+                                    _incr_budget())
+    assert len(viol) == 1 and "25%" in viol[0]
+
+
+def test_check_incremental_budget_digest_always_gates():
+    """Digest inequality and zero delta cuts violate even in smoke and
+    even with an EMPTY budget section — a delta format that resolves to
+    different state or silently re-bases every cut never exits 0."""
+    from bench import check_incremental_budget
+    viol = check_incremental_budget(_incr_result(digest_match=False), {},
+                                    smoke=True)
+    assert any("digest" in v for v in viol)
+    viol = check_incremental_budget(_incr_result(incremental_checkpoints=0),
+                                    {}, smoke=True)
+    assert any("re-based" in v for v in viol)
+
+
+def test_check_incremental_budget_recovery_ceiling_full_only():
+    from bench import check_incremental_budget
+    res = _incr_result(recovery_ms=90_000.0)
+    assert check_incremental_budget(res, _incr_budget(), smoke=True) == []
+    viol = check_incremental_budget(res, _incr_budget(), smoke=False)
+    assert len(viol) == 1 and "recovery" in viol[0]
+
+
+def test_checkpoint_incremental_budget_section_present():
+    """BENCH_BUDGET.json carries the ISSUE-16 gate with the acceptance
+    ceiling: delta bytes <= 25% of full at <=10% churn."""
+    with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
+        sec = json.load(f)["checkpoint_incremental"]
+    assert 0 < sec["max_bytes_ratio"] <= 0.25
+    assert sec["max_recovery_ms"] > 0
+    assert sec["min_incremental_checkpoints"] >= 1
+
+
+def test_incremental_bench_smoke_passes_gate():
+    """The real incremental leg (smoke size) must hold its own budget:
+    delta cuts happen, bytes ratio inside the ceiling, chain restore
+    digest-identical."""
+    from bench import check_incremental_budget, \
+        run_incremental_checkpoint_bench
+    result = run_incremental_checkpoint_bench(smoke=True)
+    with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
+        budget = json.load(f)["checkpoint_incremental"]
+    assert result["ok"], result
+    assert check_incremental_budget(result, budget, smoke=True) == []
+    assert result["increments_per_base"] >= 1
+    assert result["bytes_ratio"] <= budget["max_bytes_ratio"]
+
+
 def test_checkpoint_interval_completes_within_budget_under_backpressure():
     """bench.py --checkpoint-interval injects SlowConsumer + SlowDisk
     backpressure and asserts checkpoints (aligned-with-timeout escalation
@@ -656,13 +731,20 @@ def test_checkpoint_interval_completes_within_budget_under_backpressure():
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["ok"] and result["exactly_once"]
     with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
-        budget = json.load(f)["checkpoint_backpressure"]
+        budget_all = json.load(f)
+    budget = budget_all["checkpoint_backpressure"]
     assert result["completed_checkpoints"] >= budget["min_completed"]
     assert result["max_duration_ms"] <= budget["max_duration_ms"]
     # backpressure was REAL (the chaos schedules actually persisted
     # in-flight data) — otherwise the run proves nothing
     assert result["unaligned_checkpoints"] >= 1
     assert result["persisted_inflight_bytes_total"] > 0
+    # the ISSUE-16 incremental leg rides the same flag: delta cuts land,
+    # chain restore is digest-identical, bytes ratio inside the ceiling
+    inc = result["incremental"]
+    assert inc["digest_match"] and inc["incremental_checkpoints"] >= 1
+    assert inc["bytes_ratio"] <= budget_all["checkpoint_incremental"][
+        "max_bytes_ratio"]
 
 
 @pytest.mark.slow
