@@ -1539,6 +1539,80 @@ def check_scenario_budget(result: dict, budget: dict,
     return viol
 
 
+def run_ha_kill_bench(args) -> dict:
+    """``--ha-kill``: coordinator high availability under fire (ISSUE-20).
+    Leader A runs a scenario under a FileHaStore lease; a
+    ``KillCoordinator`` nemesis fails A's lease renewal at the diurnal
+    peak (loud demotion — A keeps executing as a ZOMBIE); standby B
+    acquires the lease at epoch + 1, proves the zombie's stale-epoch
+    checkpoint completions are fenced by the HA store, recovers the job
+    from the completed-checkpoint pointer (increment chains included) and
+    finishes it.  Committed output must be exactly-once and
+    digest-identical to an unfaulted control; with ``--check`` gates
+    against BENCH_BUDGET.json ``ha_cpu``."""
+    from flink_tpu.scenarios import ScenarioHarness, get_scenario
+
+    name = args.scenario or "fraud_detection"
+    harness = ScenarioHarness(get_scenario(name), smoke=args.smoke,
+                              records=args.records or None)
+    result = harness.run_ha_kill()
+    return {
+        "metric": "coordinator HA: leader kill at the peak, epoch-fenced "
+                  "takeover from the HA store",
+        "ok": bool(result.get("ok")),
+        "ha_kill": result,
+    }
+
+
+def check_ha_budget(result: dict, budget: dict, smoke: bool = False) -> list:
+    """BENCH_BUDGET.json ``ha_cpu`` gate for one ``--ha-kill`` result.
+    Exactly-once and the fencing probes gate UNCONDITIONALLY (even smoke,
+    even with an empty budget section): a zombie ex-leader completing a
+    checkpoint or committing a 2PC transaction, lost/duplicated rows, or
+    a digest mismatch must never exit 0 because no ceiling was
+    configured.  The recovery-time ceiling is full-run only (smoke hosts
+    jitter too much for a wall-clock gate)."""
+    name = result.get("scenario", "?")
+    viol = []
+    if result.get("state") != "FINISHED":
+        viol.append(f"{name}: recovered job did not finish: "
+                    f"{result.get('state')}")
+    if result.get("control_state") != "Finished":
+        viol.append(f"{name}: control job did not finish: "
+                    f"{result.get('control_state')} "
+                    f"({result.get('control_error')})")
+    epochs = result.get("leader_epochs") or []
+    if len(epochs) != 2 or epochs[1] <= epochs[0]:
+        viol.append(f"{name}: takeover did not advance the leader epoch "
+                    f"({epochs})")
+    if not result.get("stale_pointer_rejected"):
+        viol.append(f"{name}: zombie ex-leader's checkpoint completion "
+                    f"was NOT fenced by the HA store")
+    if not result.get("stale_commit_fenced"):
+        viol.append(f"{name}: a 2PC commit under the stale epoch was NOT "
+                    f"fenced")
+    lost = result.get("records_lost")
+    if lost != 0:
+        viol.append(f"{name}: records_lost {lost} != 0 across the "
+                    f"coordinator kill")
+    dup = result.get("records_duplicated")
+    if dup != 0:
+        viol.append(f"{name}: records_duplicated {dup} != 0 across the "
+                    f"coordinator kill")
+    if not result.get("digest_match"):
+        viol.append(f"{name}: committed-sink digest differs from the "
+                    f"unfaulted control")
+    if sum(result.get("committed_rows", {}).values()) <= 0:
+        viol.append(f"{name}: no committed output rows")
+    if not smoke:
+        cap = budget.get("max_recovery_ms")
+        rec = result.get("recovery_ms")
+        if cap is not None and rec is not None and rec > cap:
+            viol.append(f"{name}: recovery {rec}ms > ceiling {cap}ms "
+                        f"(demotion -> new-epoch checkpoint completed)")
+    return viol
+
+
 def _cep_pattern(window_ms: int):
     """Fraud-detection shape (examples/fraud_detection.py as a PATTERN):
     a small 'bait' transaction followed by a large 'strike' on the same
@@ -2606,6 +2680,21 @@ def main():
                          "digest-identical to an unfaulted control; with "
                          "--check gates each scenario against its "
                          "BENCH_BUDGET.json scenario_*_cpu section")
+    ap.add_argument("--ha-kill", action="store_true",
+                    help="coordinator high availability under fire "
+                         "(ISSUE-20): run one scenario (default "
+                         "fraud_detection; pick with --scenario) under a "
+                         "FileHaStore leader lease, kill the leader's "
+                         "lease renewal at the diurnal peak while it "
+                         "keeps executing as a zombie, and have a "
+                         "standby take over at epoch+1, fence the "
+                         "zombie's checkpoint completions and 2PC "
+                         "commits, and recover the job from the "
+                         "HA-store pointer (increment chains included); "
+                         "committed output must be exactly-once and "
+                         "digest-identical to an unfaulted control; "
+                         "with --check gates against BENCH_BUDGET.json "
+                         "ha_cpu")
     ap.add_argument("--inject-wedge", action="store_true",
                     help="standalone recovery smoke: wedge the hot-path "
                          "dispatch with a deterministic chaos schedule and "
@@ -2622,7 +2711,7 @@ def main():
     if args.trace and (args.cep or args.queryable or args.mesh_devices
                        or args.config != 2 or args.inject_wedge
                        or args.checkpoint_interval or args.autoscale
-                       or args.scenario):
+                       or args.scenario or args.ha_kill):
         # --trace measures the HEADLINE single-chip workload's on/off legs;
         # the dedicated-mode branches below exit before the trace block, so
         # refuse loudly instead of silently writing no artifact
@@ -2667,6 +2756,23 @@ def main():
         for v in inc_viol:
             print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
         sys.exit(0 if result["ok"] else 1)
+
+    if args.ha_kill:
+        result = run_ha_kill_bench(args)
+        print(json.dumps(result))
+        print(f"# ha-kill: {json.dumps(result.get('ha_kill', {}))}",
+              file=sys.stderr)
+        if args.check:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BUDGET.json")
+            with open(path) as f:
+                budget = json.load(f).get("ha_cpu", {})
+            viol = check_ha_budget(result.get("ha_kill", {}), budget,
+                                   smoke=args.smoke)
+            for v in viol:
+                print(f"# BUDGET VIOLATION: {v}", file=sys.stderr)
+            sys.exit(1 if viol else 0)
+        sys.exit(0 if result.get("ok") else 1)
 
     if args.scenario:
         result = run_scenario_bench(args)

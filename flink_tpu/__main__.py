@@ -41,11 +41,16 @@ def _cmd_run(args) -> int:
 
         storage = (FileCheckpointStorage(args.checkpoint_dir)
                    if args.checkpoint_dir else None)
+        ha_store = None
+        if getattr(args, "ha_dir", None):
+            from flink_tpu.runtime.ha import FileHaStore
+            ha_store = FileHaStore(args.ha_dir)
         pc = ProcessCluster(
             args.script, n_workers=args.workers,
             checkpoint_storage=storage,
             checkpoint_interval_ms=args.checkpoint_interval,
             restart_attempts=args.restart_attempts,
+            ha_store=ha_store,
             extra_sys_path=(_os.getcwd(),))
         res = pc.run(timeout_s=86400.0, restore=_load_restore(args))
         print(f"job finished: {res['state']} (attempts={res['attempts']}, "
@@ -376,6 +381,10 @@ def _cmd_coordinate(args) -> int:
 
     storage = (FileCheckpointStorage(args.checkpoint_dir)
                if args.checkpoint_dir else None)
+    ha_store = None
+    if getattr(args, "ha_dir", None):
+        from flink_tpu.runtime.ha import FileHaStore
+        ha_store = FileHaStore(args.ha_dir)
     host, port = args.listen.rsplit(":", 1)
     # same FLINK_TPU_SSL_*/FLINK_TPU_AUTH_TOKEN env contract as workers —
     # on k8s both containers receive the secrets the same way
@@ -385,6 +394,7 @@ def _cmd_coordinate(args) -> int:
                             checkpoint_interval_ms=args.checkpoint_interval,
                             spawn=False, bind_host=host,
                             listen_port=int(port),
+                            ha_store=ha_store,
                             security=_security_from_env())
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -416,6 +426,10 @@ def build_parser() -> "argparse.ArgumentParser":
     pr.add_argument("--restore", "-s", default=None,
                     help="savepoint/checkpoint path to restore from "
                          "(a fresh run never resumes implicitly)")
+    pr.add_argument("--ha-dir", default=None,
+                    help="FileHaStore directory enabling coordinator HA: "
+                         "leader lease + epoch fencing + job recovery "
+                         "(high-availability.storageDir)")
     pr.set_defaults(fn=_cmd_run)
     ps = sub.add_parser("sql", help="run a SQL query")
     ps.add_argument("query")
@@ -452,6 +466,10 @@ def build_parser() -> "argparse.ArgumentParser":
     pco.add_argument("--checkpoint-interval", type=int, default=0)
     pco.add_argument("--restore", "-s", default=None,
                     help="savepoint/checkpoint path to restore from")
+    pco.add_argument("--ha-dir", default=None,
+                     help="FileHaStore directory enabling coordinator HA "
+                          "(a standby coordinator pointed at the same dir "
+                          "takes over at epoch + 1)")
     pco.add_argument("--timeout", type=float, default=86400.0)
     pco.set_defaults(fn=_cmd_coordinate)
     pls = sub.add_parser("logservice", help="standalone durable log broker "

@@ -56,6 +56,22 @@ _LEN = struct.Struct("<I")
 #: the coordinator buffer arbitrary amounts
 _MAX_HANDSHAKE = 4096
 
+#: coordinator HA (ISSUE-20): every coordinator→worker control message
+#: carries the leader epoch as its LAST element; this table maps each
+#: message kind to its base arity so workers can pop the epoch off
+#: regardless of the kind's own optional fields.  Epoch 0 = HA off.
+_MSG_ARITY = {"deploy": 6, "checkpoint": 2, "notify": 2, "split_assign": 5,
+              "reset": 1, "reset_tasks": 2, "trace_request": 1,
+              "cancel": 1, "stop": 1, "ping": 1}
+
+#: leader epochs partition the checkpoint-id space: epoch e's coordinator
+#: numbers its checkpoints from ``(e-1) * stride + 1``, so a zombie
+#: ex-leader racing the new leader into a SHARED checkpoint directory can
+#: never collide with (or overwrite) the new incarnation's cuts — the
+#: cross-incarnation id fencing PR-14's autoscaler introduced, scaled to
+#: leader changes
+_CID_EPOCH_STRIDE = 1_000_000
+
 
 def _recv_raw(sock: socket.socket, limit: Optional[int] = None
               ) -> Optional[bytes]:
@@ -309,6 +325,66 @@ class _WorkerRuntime:
         #: producer owns, and which server channel ids feed a local consumer
         self._writers_by_task: Dict[Tuple[str, int], List[Any]] = {}
         self._inchans_by_task: Dict[Tuple[str, int], List[str]] = {}
+        #: coordinator HA (ISSUE-20): highest leader epoch observed on the
+        #: control plane — messages carrying a LOWER (non-zero) epoch are a
+        #: zombie ex-leader's and are rejected, never acted on
+        self._leader_epoch = 0
+        self._fenced_msgs = 0
+        #: orphan-worker reaper: tracks coordinator liveness through the
+        #: shared heartbeat seam; armed at deploy when the coordinator
+        #: ships an ``orphan_timeout_s`` (None until then)
+        self._hb = None
+        self.orphaned = False
+
+    # -- coordinator HA -----------------------------------------------------
+    def _admit_epoch(self, epoch: int, kind: str) -> bool:
+        """Leader-epoch fence: adopt a HIGHER epoch (a new leader took
+        over), reject a LOWER one (a zombie ex-leader still sending).
+        Epoch 0 means HA is off and everything is admitted."""
+        if epoch > self._leader_epoch:
+            self._leader_epoch = epoch
+            server = getattr(self, "server", None)
+            if server is not None and hasattr(server, "min_epoch"):
+                # fence the data plane too: a stale incarnation's remote
+                # writers fail the channel HELLO against this worker
+                server.min_epoch = epoch
+            return True
+        if epoch and epoch < self._leader_epoch:
+            self._fenced_msgs += 1
+            self._send(("fenced", self.index, kind, epoch))
+            return False
+        return True
+
+    def _arm_orphan_reaper(self, timeout_s: float) -> None:
+        """Satellite 1: self-terminate (committing nothing) when the lease
+        holder goes silent past ``timeout_s`` — a dead-but-unreaped
+        coordinator must not leak worker processes holding sockets and
+        device state forever.  Every control message (pings included)
+        counts as a heartbeat."""
+        if self._hb is not None:
+            return
+        from flink_tpu.cluster.heartbeat import (HeartbeatManager,
+                                                 HeartbeatTarget)
+        self._hb = HeartbeatManager(
+            interval_s=max(0.2, float(timeout_s) / 4.0),
+            timeout_s=float(timeout_s),
+            on_timeout=self._coordinator_silent)
+        # the coordinator PUSHES pings; the request side is a no-op
+        self._hb.monitor_target("coordinator",
+                                HeartbeatTarget(lambda: None))
+        self._hb.receive_heartbeat("coordinator")
+        self._hb.start()
+
+    def _coordinator_silent(self, resource_id: str) -> None:
+        self.orphaned = True
+        for t in self.tasks:
+            t.cancel()
+        try:
+            # unblocks the control loop's recv -> clean exit path; nothing
+            # is committed (commits only ever happen on notify-complete)
+            self.sock.close()
+        except OSError:
+            pass
 
     def _send(self, obj: Any) -> None:
         try:
@@ -365,7 +441,7 @@ class _WorkerRuntime:
             if stash is not None:
                 self._q_acks[(vertex_uid, subtask_index)] = stash
         self._send(("ack", checkpoint_id, vertex_uid, subtask_index,
-                    snapshot))
+                    snapshot, self._leader_epoch))
 
     def decline_checkpoint(self, checkpoint_id: int, vertex_uid: str,
                            subtask_index: int, error: str) -> None:
@@ -443,6 +519,8 @@ class _WorkerRuntime:
         if ckpt_opts is not None:
             self._ckpt_opts = dict(ckpt_opts)
         opts = self._ckpt_opts
+        if opts.get("orphan_timeout_s"):
+            self._arm_orphan_reaper(opts["orphan_timeout_s"])
         # observability: install the span journal when the coordinator
         # asked for tracing, and stand up the per-worker latency tracker
         # (markers record at every local hop; the panel ships with the
@@ -504,7 +582,8 @@ class _WorkerRuntime:
                         host, port = addresses[assign[(tgt.uid, ci)]]
                         ch = RemoteChannel(host, port, chan_id,
                                            ssl_context=self._client_ssl,
-                                           auth_token=self._data_token)
+                                           auth_token=self._data_token,
+                                           epoch=self._leader_epoch)
                         self._remote_writers.append(ch)
                         self._writers_by_task.setdefault(
                             (v.uid, pi), []).append(ch)
@@ -707,7 +786,7 @@ class _WorkerRuntime:
             advertise[name] = dict(self._q_states[name])
         server = self.qservice.start_server(host=self.server.host)
         self._send(("qserve", self.index, advertise,
-                    self.advertise_host, server.port))
+                    self.advertise_host, server.port, self._leader_epoch))
 
     def _feed_worker_replicas(self, checkpoint_id: int) -> None:
         """notify-complete -> feed this worker's replica shards from the
@@ -755,6 +834,19 @@ class _WorkerRuntime:
             if msg is None:
                 break
             kind = msg[0]
+            # any control traffic proves the coordinator alive — heartbeat
+            # BEFORE the epoch fence (a fenced zombie is still a liveness
+            # signal only for ITS OWN workers, which share its socket)
+            if self._hb is not None:
+                self._hb.receive_heartbeat("coordinator")
+            base = _MSG_ARITY.get(kind)
+            epoch = 0
+            if base is not None and len(msg) > base:
+                epoch = msg[base] or 0
+            if not self._admit_epoch(epoch, kind):
+                continue
+            if kind == "ping":
+                continue
             if kind == "deploy":
                 ok = self.deploy(msg[1], msg[2],
                                  only=set(msg[3]) if len(msg) > 3
@@ -780,7 +872,7 @@ class _WorkerRuntime:
                     t.commands.put(("notify_complete", msg[1]))
                 self._feed_worker_replicas(msg[1])
             elif kind == "split_assign":
-                _, uid, idx, split, done = msg
+                uid, idx, split, done = msg[1:5]
                 q = self._split_queues.get((uid, idx))
                 if q is not None:
                     q.put((split, done))
@@ -868,6 +960,8 @@ class _WorkerRuntime:
                     t.cancel()
             elif kind == "stop":
                 break
+        if self._hb is not None:
+            self._hb.stop()
         for t in self.tasks:
             t.join(timeout_s=10)
         for w in self._remote_writers:
@@ -923,13 +1017,46 @@ class ProcessCluster:
                  queryable_serving: bool = True,
                  incremental: bool = False,
                  incremental_rebase_ratio: float = 0.5,
-                 changelog_materialization_threshold: int = 256):
+                 changelog_materialization_threshold: int = 256,
+                 ha_store=None,
+                 ha_lease_ttl_s: float = 2.0,
+                 ha_job_id: Optional[str] = None,
+                 worker_orphan_timeout_s: Optional[float] = 45.0,
+                 ping_interval_s: float = 5.0):
         from flink_tpu.observability import tracing as tracing_mod
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureManager
 
         self.job = job
         self.n_workers = n_workers
+        #: coordinator HA (ISSUE-20): a FileHaStore holding the leader
+        #: lease (monotone epoch), the registered job, and the
+        #: completed-checkpoint pointer.  None = HA off (epoch stays 0 and
+        #: the fences are no-ops).
+        self.ha_store = ha_store
+        self.ha_lease_ttl_s = float(ha_lease_ttl_s)
+        if ha_job_id is None and ha_store is not None:
+            from flink_tpu.runtime.ha import job_id_for
+            ha_job_id = job_id_for(job)
+        self.ha_job_id = ha_job_id
+        self._epoch = 0
+        self._lease = None
+        self._renewer = None
+        #: completions this (zombie) coordinator lost to the epoch fence —
+        #: each one also charges the checkpoint failure budget, so a fenced
+        #: ex-leader fails LOUDLY instead of running forever
+        self.ha_fenced_completions = 0
+        #: stale-epoch worker messages observed (`("fenced", ...)` reports
+        #: plus acks/qserve rejected coordinator-side)
+        self.fenced_worker_msgs = 0
+        #: how the last HA restore was resolved ("ha-pointer" /
+        #: "scan-fallback" / "none"), for the REST panel and tests
+        self.ha_restore_source: Optional[str] = None
+        #: orphan-worker reaper deadline shipped to workers via ckpt_opts;
+        #: the coordinator broadcasts pings every ping_interval_s so a
+        #: quiet-but-alive leader keeps its workers
+        self.worker_orphan_timeout_s = worker_orphan_timeout_s
+        self.ping_interval_s = float(ping_interval_s)
         #: unaligned-checkpoint + observability policy, shipped to every
         #: worker with the deploy message (workers thread it into their
         #: Subtasks / install their span journals)
@@ -951,7 +1078,11 @@ class ProcessCluster:
                           "incremental": incremental,
                           "incr_rebase_ratio": incremental_rebase_ratio,
                           "materialization_threshold":
-                              changelog_materialization_threshold}
+                              changelog_materialization_threshold,
+                          # orphan-worker reaper (ISSUE-20 satellite):
+                          # workers self-terminate when the coordinator is
+                          # silent past this deadline (None disables)
+                          "orphan_timeout_s": worker_orphan_timeout_s}
         #: end-to-end tracing: workers record spans locally; at job end
         #: the coordinator pulls every ring and assembles ONE merged
         #: timeline (result["trace"], also kept as self.last_trace)
@@ -1174,6 +1305,7 @@ class ProcessCluster:
         result in memory/checkpoints by design."""
         from flink_tpu.observability import tracing as tracing_mod
 
+        restore = self._ha_takeover(restore)
         original_restore = restore
         if self.tracing:
             # shared ownership state machine with MiniCluster.execute —
@@ -1188,8 +1320,115 @@ class ProcessCluster:
         try:
             return self._run_attempts(timeout_s, restore, original_restore)
         finally:
+            self._ha_shutdown()
             # self._trace_journal/last_trace keep serving afterwards
             tracing_mod.release_after_execution(j, owned)
+
+    # -- coordinator HA -----------------------------------------------------
+    @classmethod
+    def from_ha(cls, ha_store, job_id: str, checkpoint_storage=None,
+                **overrides) -> "ProcessCluster":
+        """Standby takeover: rebuild a coordinator for a job REGISTERED in
+        the HA store (``register_job`` persisted the reference + settings
+        under the registering leader's epoch).  ``run()`` then acquires
+        the lease at epoch+1 and restores from the completed-checkpoint
+        pointer."""
+        payload = ha_store.load_job(job_id)
+        kw = dict(payload.get("settings") or {})
+        kw.update(overrides)
+        kw.setdefault("n_workers", payload.get("n_workers", 2))
+        return cls(payload["job"], checkpoint_storage=checkpoint_storage,
+                   ha_store=ha_store, ha_job_id=job_id, **kw)
+
+    def _ha_takeover(self, restore):
+        """Acquire the leader lease (epoch+1 over any predecessor),
+        register the job, resolve the restore from the HA
+        completed-checkpoint pointer, and start renewing.  Returns the
+        (possibly pointer-resolved) restore."""
+        if self.ha_store is None:
+            return restore
+        from flink_tpu.runtime import ha as ha_mod
+
+        holder = f"coordinator-{os.getpid()}-{self.run_token}"
+        self._lease = self.ha_store.acquire(
+            holder, self.ha_lease_ttl_s,
+            timeout_s=max(30.0, 10 * self.ha_lease_ttl_s))
+        self._epoch = self._lease.epoch
+        # epoch-partitioned checkpoint ids: this incarnation can never
+        # collide with a zombie predecessor writing the same directory
+        self._next_cid = max(self._next_cid,
+                             (self._epoch - 1) * _CID_EPOCH_STRIDE + 1)
+        self.ha_store.register_job(
+            self.ha_job_id,
+            {"job": self.job, "n_workers": self.n_workers,
+             "settings": {
+                 "checkpoint_interval_ms": self.checkpoint_interval_ms,
+                 "checkpoint_timeout_s": self.checkpoint_timeout_s,
+                 "incremental": bool(self.ckpt_opts.get("incremental"))}},
+            self._epoch)
+        if restore is None:
+            restore, src = ha_mod.resolve_restore(
+                self.ha_store, self.ha_job_id, self.checkpoint_storage)
+            self.ha_restore_source = src
+        if self.checkpoint_storage is not None \
+                and hasattr(self.checkpoint_storage, "pin_provider"):
+            # retention pinning (satellite 2): the storage re-reads the
+            # HA pointer FRESH at every eviction pass, so even a stale
+            # leader's concurrent retention never evicts the pointed-at
+            # cut (or its increment chain)
+            store, job_id = self.ha_store, self.ha_job_id
+
+            def _ha_pin() -> Optional[int]:
+                ptr = store.completed_checkpoint(job_id)
+                return ptr["checkpoint_id"] if ptr else None
+
+            self.checkpoint_storage.pin_provider = _ha_pin
+        self._renewer = ha_mod.LeaseRenewer(
+            self.ha_store, self._lease, self.ha_lease_ttl_s,
+            on_lost=self._ha_demoted)
+        self._renewer.start()
+        return restore
+
+    def _ha_shutdown(self) -> None:
+        if self._renewer is not None:
+            self._renewer.stop()
+            self._renewer.join()
+            # release only a lease we still hold and cleanly finished
+            # with, so a successor skips the TTL wait; a LOST lease (or
+            # an injected renewal fault) belongs to whoever took it
+            if self._renewer.lost is None and self._lease is not None:
+                try:
+                    self.ha_store.release(self._renewer.lease)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._renewer = None
+
+    def _ha_demoted(self, exc: Exception) -> None:
+        """Lease renewal failed (TTL expired under us, a new leader took
+        over, or an injected ``ha.lease`` truncation): demote LOUDLY —
+        fail the run so nothing further completes under the stale epoch."""
+        with self._lock:
+            if self._failed is None:
+                self._failed = (f"leader lease lost (epoch {self._epoch}): "
+                                f"{exc}")
+            self._all_done.set()
+
+    def ha_status(self) -> Dict[str, Any]:
+        """HA panel: leader epoch, lease, fence counters, restore source —
+        what the REST ``/jobs/<id>/ha`` endpoint serves."""
+        lease = self._renewer.lease if self._renewer is not None \
+            else self._lease
+        lost = self._renewer.lost if self._renewer is not None else None
+        return {"enabled": self.ha_store is not None,
+                "leader_epoch": self._epoch,
+                "job_id": self.ha_job_id,
+                "holder": lease.holder if lease is not None else None,
+                "lease_deadline": lease.deadline if lease is not None
+                else None,
+                "demoted": lost is not None,
+                "restore_source": self.ha_restore_source,
+                "fenced_completions": self.ha_fenced_completions,
+                "fenced_worker_msgs": self.fenced_worker_msgs}
 
     def _run_attempts(self, timeout_s: float,
                       restore: Optional[Dict[str, Any]],
@@ -1202,25 +1441,12 @@ class ProcessCluster:
             if attempt > 0:
                 self._reset_attempt()
                 self.failure_manager.on_job_restart()
-                # restore ONLY from a checkpoint THIS run completed — a
-                # reused checkpoint dir may hold higher-numbered checkpoints
-                # from a previous execution, and load_latest() would silently
-                # resume a different job's state
-                latest = None
-                if self.checkpoint_storage is not None and self._completed_ids:
-                    # a load failure (checkpoint.load fault, transient read
-                    # error, corruption) must stay INSIDE the restart
-                    # machinery: fall back to progressively older completed
-                    # checkpoints, then to the caller's restore/scratch
-                    for cid in sorted(self._completed_ids, reverse=True):
-                        try:
-                            latest = self.checkpoint_storage.load(cid)
-                            break
-                        except Exception:  # noqa: BLE001
-                            continue
-                # no checkpoint completed yet: fall back to the restore the
-                # CALLER supplied (a savepoint must not silently drop)
-                restore = latest or original_restore
+                # restore from this run's newest completed checkpoint
+                # (under HA, the store's completed-checkpoint POINTER is
+                # consulted first — the same truth a standby leader uses),
+                # else the restore the CALLER supplied (a savepoint must
+                # not silently drop)
+                restore = self._latest_restore(original_restore)
             res = self._run_once(timeout_s, restore, attempt)
             res["attempts"] = attempt + 1
             if res["state"] == "FINISHED" or attempt >= self.restart_attempts \
@@ -1345,6 +1571,11 @@ class ProcessCluster:
             for idx in self._conns:
                 self._to_worker(idx, ("deploy", addresses, restore, None,
                                       self._plan_digest, self.ckpt_opts))
+            if self.ckpt_opts.get("orphan_timeout_s"):
+                self._ping_stop = threading.Event()
+                threading.Thread(target=self._ping_loop,
+                                 args=(self._ping_stop,),
+                                 daemon=True).start()
             if self.checkpoint_interval_ms > 0:
                 # the ticker loops on ITS attempt's event (self._all_done
                 # is replaced between restart attempts/recoveries)
@@ -1432,6 +1663,8 @@ class ProcessCluster:
                        if latency_rows is not None else {})}
         finally:
             self._all_done.set()   # stop this attempt's checkpoint ticker
+            if getattr(self, "_ping_stop", None) is not None:
+                self._ping_stop.set()
             srv.close()
             # close control connections so stale _serve_worker threads
             # unblock, and reap every child before a potential retry
@@ -1493,7 +1726,20 @@ class ProcessCluster:
         """This run's newest completed checkpoint, else the original
         restore the run started from.  A load failure (corrupt increment
         chain, transient read error) falls back to progressively older
-        completed checkpoints — recovery must not die on one bad file."""
+        completed checkpoints — recovery must not die on one bad file.
+
+        Under HA the store's completed-checkpoint pointer is truth
+        (satellite 2): it survives coordinator death, so a restarted or
+        standby leader restores exactly the cut the last leader durably
+        completed; the directory scan stays as a logged fallback inside
+        :func:`flink_tpu.runtime.ha.resolve_restore`."""
+        if self.ha_store is not None:
+            from flink_tpu.runtime import ha as ha_mod
+            snap, src = ha_mod.resolve_restore(
+                self.ha_store, self.ha_job_id, self.checkpoint_storage)
+            if snap is not None:
+                self.ha_restore_source = src
+                return snap
         if self.checkpoint_storage is not None and self._completed_ids:
             for cid in sorted(self._completed_ids, reverse=True):
                 try:
@@ -1709,10 +1955,22 @@ class ProcessCluster:
             hello_conns.append((idx, conn))
 
     def _to_worker(self, idx: int, msg) -> None:
+        # every control message carries the leader epoch as its last
+        # element (ISSUE-20); epoch 0 = HA off, workers admit everything
+        msg = tuple(msg) + (self._epoch,)
         try:
             _send_msg(self._conns[idx], msg, self._send_locks[idx])
         except OSError:
             pass
+
+    def _ping_loop(self, stop: threading.Event) -> None:
+        """Leader liveness pings: workers reset their orphan-reaper
+        deadline on every control message, so a quiet-but-alive leader
+        (long checkpoint interval, idle job) keeps its workers."""
+        while not stop.wait(self.ping_interval_s):
+            for idx in list(self._conns):
+                if idx not in self._dead_conn_idx:
+                    self._to_worker(idx, ("ping",))
 
     # -- per-worker event loop --------------------------------------------
     def _serve_worker(self, idx: int, conn: socket.socket) -> None:
@@ -1773,7 +2031,12 @@ class ProcessCluster:
                 # into the routing map (a respawned worker re-registers
                 # with its NEW port — stale client maps self-heal on
                 # their next refresh)
-                _, widx, advertise, host, port = msg
+                widx, advertise, host, port = msg[1:5]
+                q_epoch = msg[5] if len(msg) > 5 else 0
+                if q_epoch and self._epoch and q_epoch < self._epoch:
+                    with self._lock:
+                        self.fenced_worker_msgs += 1
+                    continue
                 with self._lock:
                     for name, info in advertise.items():
                         entry = self._qserve_states.setdefault(
@@ -1804,8 +2067,15 @@ class ProcessCluster:
                     if p is not None and len(p.acks) >= len(p.expected):
                         self._complete(p)
             elif kind == "ack":
-                _, cid, uid, i, snap = msg
+                cid, uid, i, snap = msg[1:5]
+                ack_epoch = msg[5] if len(msg) > 5 else 0
                 with self._lock:
+                    if ack_epoch and self._epoch \
+                            and ack_epoch < self._epoch:
+                        # a stale incarnation's worker acking into the new
+                        # leader: its snapshot belongs to a fenced epoch
+                        self.fenced_worker_msgs += 1
+                        continue
                     p = self._pending
                     if p is not None and p.cid == cid:
                         p.acks[(uid, i)] = snap
@@ -1840,6 +2110,12 @@ class ProcessCluster:
                     self._trace_dumps.append((msg[1], msg[2],
                                               float(_clock.now_ms())))
                     self._trace_cv.notify_all()
+            elif kind == "fenced":
+                # a worker rejected one of our messages as stale-epoch:
+                # we are a zombie ex-leader — count it (the decisive
+                # demotion comes from the HA-store fence / lease loss)
+                with self._lock:
+                    self.fenced_worker_msgs += 1
             elif kind == "reset_done":
                 with self._reset_cv:
                     self._reset_acks.add(msg[1])
@@ -1921,6 +2197,12 @@ class ProcessCluster:
         self._pending = None
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureReason
+        # coordinator HA (ISSUE-20): verify leadership BEFORE any bytes
+        # land — a zombie ex-leader must not even write into the shared
+        # checkpoint directory.  (The decisive fence is the pointer write
+        # below; this pre-check just narrows the window.)
+        if self.ha_store is not None and not self._ha_fence_locked(p.cid):
+            return
         # incremental checkpoints (ISSUE-16): delta-tracking operators
         # acked increment nodes — resolve them against the previous
         # completed cut so restore/queryable/rescale keep consuming the
@@ -1962,6 +2244,13 @@ class ProcessCluster:
                 self._checkpoint_failure_locked(
                     CheckpointFailureReason.STORAGE, p.cid, store_error)
                 return
+        # THE zombie fence: advancing the HA completed-checkpoint pointer
+        # re-verifies the store epoch atomically — a checkpoint only
+        # COMPLETES (and workers only get notify, so 2PC only commits) if
+        # this coordinator still holds the current epoch
+        if self.ha_store is not None and not self._ha_fence_locked(
+                p.cid, advance=True):
+            return
         self.failure_manager.on_checkpoint_success(p.cid)
         self._completed_ids.append(p.cid)
         self._latest_resolved = resolved
@@ -1991,6 +2280,38 @@ class ProcessCluster:
         del self._checkpoint_stats[:-100]
         for idx in self._conns:
             self._to_worker(idx, ("notify", p.cid))
+
+    def _ha_fence_locked(self, cid: int, advance: bool = False) -> bool:
+        """Caller holds ``_lock``: verify this coordinator still owns the
+        current leader epoch — with ``advance=True`` by durably moving the
+        completed-checkpoint pointer, otherwise by a read-only epoch
+        check.  A stale epoch charges the failure budget AND demotes the
+        run (the zombie fails loudly, never completing the checkpoint);
+        a pointer-write I/O error is charged as a storage failure."""
+        from flink_tpu.runtime.checkpoint.failure import \
+            CheckpointFailureReason
+        from flink_tpu.runtime.ha import StaleEpochError
+        try:
+            if advance:
+                self.ha_store.set_completed_checkpoint(
+                    self.ha_job_id, cid, self._epoch)
+            else:
+                self.ha_store.check_epoch(self._epoch)
+        except StaleEpochError as e:
+            self.ha_fenced_completions += 1
+            self.failure_manager.on_checkpoint_failure(
+                CheckpointFailureReason.STORAGE, cid)
+            if self._failed is None:
+                self._failed = (f"checkpoint {cid} fenced: stale leader "
+                                f"epoch {self._epoch}: {e}")
+            self._all_done.set()
+            return False
+        except Exception as e:  # noqa: BLE001 — HA store I/O error
+            self._checkpoint_failure_locked(
+                CheckpointFailureReason.STORAGE, cid,
+                f"HA pointer write failed: {type(e).__name__}: {e}")
+            return False
+        return True
 
     def _checkpoint_failure_locked(self, reason: str, cid: int,
                                    detail: str) -> None:

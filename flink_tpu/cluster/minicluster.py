@@ -257,6 +257,28 @@ class MiniCluster(TaskListener):
         #: ReactiveAutoscaler attaches it to each cluster it deploys):
         #: surfaces as ``job_status()["autoscaler"]`` + autoscaler.* gauges
         self.autoscaler_status_supplier = None
+        #: coordinator HA (ISSUE-20): optional callable(checkpoint_id) ->
+        #: bool consulted BEFORE a completed checkpoint is stored/notified
+        #: — the leader-epoch fence (e.g. FileHaStore pointer advance).
+        #: False/raise = this coordinator is a zombie ex-leader: the
+        #: completion aborts (no store, no notify, so 2PC never commits)
+        #: and the failure budget is charged
+        self.ha_commit_gate = None
+        #: completions this cluster lost to the HA fence
+        self.ha_fenced_completions = 0
+        #: HA panel supplier: surfaces as ``job_status()["ha"]`` + the
+        #: ``/jobs/<id>/ha`` REST endpoint
+        self.ha_status_supplier = None
+        from flink_tpu.metrics.groups import ha_metrics
+
+        def _ha_status():
+            if self.ha_status_supplier is None:
+                return None
+            try:
+                return self.ha_status_supplier()
+            except Exception:  # noqa: BLE001 — gauges never raise
+                return None
+        ha_metrics(self.job_metric_group, _ha_status)
 
     # ------------------------------------------------------------ listener
     def _slot_memory(self):
@@ -372,6 +394,23 @@ class MiniCluster(TaskListener):
         self._pending = None
         from flink_tpu.runtime.checkpoint.failure import \
             CheckpointFailureReason
+        # coordinator HA (ISSUE-20): the leader-epoch fence — a zombie
+        # ex-leader's completion must abort here, BEFORE bytes land and
+        # before any notify fans out (so its 2PC epochs never commit)
+        if self.ha_commit_gate is not None:
+            try:
+                admitted = bool(self.ha_commit_gate(p.checkpoint_id))
+            except Exception as e:  # noqa: BLE001 — fence errors = fenced
+                admitted = False
+                fence_detail = f"{type(e).__name__}: {e}"
+            else:
+                fence_detail = "stale leader epoch"
+            if not admitted:
+                self.ha_fenced_completions += 1
+                self._record_checkpoint_failure(
+                    CheckpointFailureReason.STORAGE, p.checkpoint_id,
+                    f"fenced by HA commit gate: {fence_detail}")
+                return
         # incremental checkpoints: delta-tracking operators acked increment
         # nodes — resolve them against the previous completed checkpoint's
         # RESOLVED tree so everything downstream (queryable replicas,
@@ -1104,11 +1143,18 @@ class MiniCluster(TaskListener):
                 autoscaler = self.autoscaler_status_supplier()
             except Exception:  # noqa: BLE001 — monitoring must not fail status
                 autoscaler = None
+        ha = None
+        if self.ha_status_supplier is not None:
+            try:
+                ha = self.ha_status_supplier()
+            except Exception:  # noqa: BLE001 — monitoring must not fail status
+                ha = None
         return {
             **({"paging": paging} if paging is not None else {}),
             **({"queryable": self.queryable.stats()}
                if self.queryable is not None else {}),
             **({"autoscaler": autoscaler} if autoscaler is not None else {}),
+            **({"ha": ha} if ha is not None else {}),
             "device_health": self.device_health_status(),
             #: per-(source, hop) latency percentiles (LatencyMarker flow)
             "latency": self.latency_tracker.panel(),

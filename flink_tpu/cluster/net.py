@@ -250,6 +250,11 @@ class ChannelServer:
                  auth_token: Optional[str] = None):
         require_secure_bind(host, ssl_context is not None, "ChannelServer",
                             detail=" (batches carry pickled columns)")
+        #: coordinator HA (ISSUE-20): data-plane epoch fence — a channel
+        #: HELLO carrying a LOWER (non-zero) leader epoch is a stale
+        #: incarnation's writer and is rejected before any decode.  Workers
+        #: raise this as they adopt higher epochs; 0 admits everything.
+        self.min_epoch = 0
         self.channel_capacity = channel_capacity
         self._ssl = ssl_context
         self._auth_token = auth_token
@@ -300,9 +305,19 @@ class ChannelServer:
                 conn.close()
                 return
             mac_len = payload[0]
-            mac, chan = payload[1:1 + mac_len], payload[1 + mac_len:]
+            mac, rest = payload[1:1 + mac_len], payload[1 + mac_len:]
+            if len(rest) < 8:
+                conn.close()
+                return
+            (epoch,) = struct.unpack("<Q", rest[:8])
+            chan = rest[8:]
             if self._auth_token is not None and not hmac_mod.compare_digest(
-                    _mac(self._auth_token, nonce, chan), mac):
+                    _mac(self._auth_token, nonce, rest), mac):
+                conn.close()
+                return
+            if epoch and epoch < self.min_epoch:
+                # stale-incarnation writer (zombie ex-leader's deploy):
+                # reject before attaching — its batches never decode
                 conn.close()
                 return
             conn.settimeout(None)
@@ -365,8 +380,11 @@ class RemoteChannel:
 
     def __init__(self, host: str, port: int, channel_id: str,
                  connect_timeout_s: float = 10.0, ssl_context=None,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None, epoch: int = 0):
         self.channel_id = channel_id
+        #: leader epoch this writer was deployed under (ISSUE-20); the
+        #: HELLO carries it and servers reject stale incarnations
+        self.epoch = int(epoch)
         self._sock = socket.create_connection((host, port),
                                               timeout=connect_timeout_s)
         if ssl_context is not None:
@@ -396,10 +414,13 @@ class RemoteChannel:
             ftype, nonce = _recv_frame(self._sock)
             if ftype != _CHALLENGE:
                 raise OSError("bad data-plane challenge")
-            cid = self.channel_id.encode()
-            mac = (_mac(self._auth_token, nonce, cid)
+            # HELLO = mac_len | mac | epoch u64 | channel id; the MAC
+            # covers epoch + channel id, so a stale epoch cannot be
+            # stripped or rewritten by an on-path peer
+            rest = struct.pack("<Q", self.epoch) + self.channel_id.encode()
+            mac = (_mac(self._auth_token, nonce, rest)
                    if self._auth_token else b"")
-            _send_frame(self._sock, _HELLO, bytes([len(mac)]) + mac + cid)
+            _send_frame(self._sock, _HELLO, bytes([len(mac)]) + mac + rest)
         except OSError as e:
             with self._have_credit:
                 self._closed = True

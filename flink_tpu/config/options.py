@@ -164,6 +164,35 @@ class RestartOptions:
     EXP_MULTIPLIER = key("restart-strategy.exponential-delay.backoff-multiplier").float_type().default_value(2.0)
 
 
+class HighAvailabilityOptions:
+    """Analog of ``HighAvailabilityOptions.java``: coordinator leader
+    lease + epoch fencing + job recovery from the HA store
+    (``runtime/ha.py``)."""
+
+    MODE = key("high-availability.type").string_type().default_value(
+        "none", "'none' (single coordinator) | 'filesystem' (FileHaStore: "
+        "leader lease with a monotone fencing epoch, registered job "
+        "plans, and the completed-checkpoint pointer recovery consults "
+        "before any directory scan).")
+    STORAGE_DIR = key("high-availability.storageDir").string_type().default_value(
+        None, "Directory backing the FileHaStore (lease, epoch counter, "
+        "job registry, checkpoint pointers).  Required when the type is "
+        "'filesystem'.")
+    LEASE_TTL = key("high-availability.lease.ttl").duration_type().default_value(
+        2000, "Leader lease time-to-live in ms.  The holder renews every "
+        "ttl/3; a standby acquires the lease (at epoch + 1) once the "
+        "deadline passes un-renewed.")
+    ORPHAN_TIMEOUT = key("high-availability.worker.orphan-timeout").duration_type().default_value(
+        45_000, "Workers self-terminate (committing nothing) when the "
+        "coordinator has been silent this long — no control traffic, no "
+        "pings — so an orphaned worker pool cannot outlive its leader. "
+        "0 disables the reaper.")
+    PING_INTERVAL = key("high-availability.coordinator.ping-interval").duration_type().default_value(
+        5000, "Coordinator ping cadence in ms: keeps quiet-but-alive "
+        "leaders' workers from self-terminating (must be well under the "
+        "orphan timeout).")
+
+
 class MetricOptions:
     REPORTERS = key("metrics.reporters").list_type().default_value(
         [], "Active metric reporter names.")

@@ -144,6 +144,16 @@ class TwoPhaseCommitSink(Sink):
         #: completion: [(handle, checkpoint_id)]
         self._staged: List[tuple] = []
         self._rows: List[Dict[str, Any]] = []
+        #: coordinator-HA fence (ISSUE-20): once a new leader restores this
+        #: sink it raises ``fence_epoch`` to its leader epoch, after which a
+        #: completion notification stamped with an OLDER epoch (a zombie
+        #: ex-leader racing its last notify round) is rejected instead of
+        #: committed — the staged transaction stays for the rightful
+        #: leader's replay.  None (the default) disables the fence;
+        #: un-stamped notifications (epoch=None) are always accepted for
+        #: single-coordinator back-compat.
+        self.fence_epoch: Optional[int] = None
+        self.fenced_commits = 0
 
     # -- subclass contract ---------------------------------------------------
     def begin_transaction(self, txn_name: str) -> tuple:
@@ -218,7 +228,14 @@ class TwoPhaseCommitSink(Sink):
                 "two_phase": self.sink_id,
                 "staged": [tuple(h) + (cid,) for h, cid in self._staged]}
 
-    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+    def notify_checkpoint_complete(self, checkpoint_id: int,
+                                   epoch: Optional[int] = None) -> None:
+        if (self.fence_epoch is not None and epoch is not None
+                and epoch < self.fence_epoch):
+            # zombie leader's notify: commit NOTHING — the transactions it
+            # wants committed belong to the new leader's restore replay
+            self.fenced_commits += 1
+            return
         keep = []
         for h, staged_for in self._staged:
             if staged_for is not None and staged_for > checkpoint_id:

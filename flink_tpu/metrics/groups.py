@@ -82,6 +82,12 @@ AUTOSCALER_ROLLBACKS = "autoscaler.rollbacks_total"
 AUTOSCALER_RETRIGGERS = "autoscaler.retriggers_total"
 AUTOSCALER_LAST_RESCALE_MS = "autoscaler.last_rescale_duration_ms"
 AUTOSCALER_COOLDOWN_REMAINING_MS = "autoscaler.cooldown_remaining_ms"
+# coordinator high availability (runtime/ha.py): the leader's fencing
+# epoch, demotion state, and how much stale-epoch traffic was rejected
+HA_LEADER_EPOCH = "ha.leader_epoch"
+HA_DEMOTED = "ha.demoted"                           # 0 leading, 1 demoted
+HA_FENCED_COMPLETIONS = "ha.fenced_completions"
+HA_FENCED_WORKER_MSGS = "ha.fenced_worker_msgs"
 
 
 class MetricGroup:
@@ -346,6 +352,30 @@ def autoscaler_metrics(group: MetricGroup,
                        "last_rescale_duration_ms"),
                       (AUTOSCALER_COOLDOWN_REMAINING_MS,
                        "cooldown_remaining_ms")):
+        group.gauge(name, _read(key))
+    return group
+
+
+def ha_metrics(group: MetricGroup,
+               status_supplier: Callable[[], Optional[Dict[str, Any]]]
+               ) -> MetricGroup:
+    """Register the coordinator-HA gauges on a (job-scope) group: the
+    leader epoch every control message is fenced by, whether this
+    coordinator has been demoted (lease lost), and the counts of
+    stale-epoch completions / worker messages it rejected.
+    ``status_supplier`` returns ``ha_status()``-shaped dicts (or None ->
+    0s, e.g. HA disabled)."""
+    def _read(key: str, default=0) -> Callable[[], Any]:
+        def read():
+            v = (status_supplier() or {}).get(key)
+            return default if v is None else v
+        return read
+
+    group.gauge(HA_DEMOTED,
+                lambda: int(bool((status_supplier() or {}).get("demoted"))))
+    for name, key in ((HA_LEADER_EPOCH, "leader_epoch"),
+                      (HA_FENCED_COMPLETIONS, "fenced_completions"),
+                      (HA_FENCED_WORKER_MSGS, "fenced_worker_msgs")):
         group.gauge(name, _read(key))
     return group
 

@@ -14,6 +14,7 @@ Endpoints:
   GET  /jobs/<id>/backpressure        busy/idle/backpressured per vertex
   GET  /jobs/<id>/metrics             numeric metrics incl. latency pcts
   GET  /jobs/<id>/autoscaler(.html)   reactive-autoscaler rescale status
+  GET  /jobs/<id>/ha(.html)           coordinator HA: leader epoch + fences
   GET  /jobs/<id>/exceptions          root failure cause
   GET  /jobs/<id>/flamegraph          sampled task-thread flame graph
   POST /jobs/<id>/savepoints          trigger a savepoint
@@ -344,6 +345,13 @@ class RestServer:
                     from flink_tpu.rest.views import autoscaler_html
                     return self._send(autoscaler_html(
                         status.get("autoscaler", {})).encode(),
+                        content_type="text/html")
+                if sub == "ha":
+                    return self._send(status.get("ha", {"enabled": False}))
+                if sub == "ha.html":
+                    from flink_tpu.rest.views import ha_html
+                    return self._send(ha_html(
+                        status.get("ha", {})).encode(),
                         content_type="text/html")
                 return self._send({"error": f"unknown path {sub}"}, 404)
 

@@ -238,6 +238,38 @@ def autoscaler_html(status: Dict[str, Any]) -> str:
             + "</tbody></table></div>")
 
 
+def ha_html(status: Dict[str, Any]) -> str:
+    """Coordinator-HA panel (``job_status()["ha"]``): leader/demoted
+    badge, the fencing epoch every control message carries, the lease
+    holder + deadline, which source recovery restored from, and the
+    stale-epoch rejection counters.  Server-rendered, DOM-testable —
+    same pattern as the autoscaler panel."""
+    if not status or not status.get("enabled"):
+        return ('<div class="ha-panel"><span class="ha-state ha-off" '
+                'data-state="off">ha: off</span></div>')
+    demoted = bool(status.get("demoted"))
+    state = "demoted" if demoted else "leading"
+    cls = "ha-demoted" if demoted else "ha-leading"
+    epoch = status.get("leader_epoch", 0)
+    rows = []
+    for label, key in (("job id", "job_id"),
+                       ("lease holder", "holder"),
+                       ("lease deadline (unix s)", "lease_deadline"),
+                       ("restore source", "restore_source"),
+                       ("fenced completions", "fenced_completions"),
+                       ("fenced worker msgs", "fenced_worker_msgs")):
+        rows.append(f'<tr class="ha-row" data-metric="{_esc(key)}">'
+                    f'<td>{_esc(label)}</td>'
+                    f'<td>{_esc(status.get(key, ""))}</td></tr>')
+    return (f'<div class="ha-panel">'
+            f'<span class="ha-state {cls}" data-state="{_esc(state)}" '
+            f'data-epoch="{_esc(epoch)}">'
+            f'ha: {_esc(state)} · epoch {_esc(epoch)}</span>'
+            f'<table class="ha-table"><thead><tr><th>field</th>'
+            f'<th>value</th></tr></thead><tbody>' + "".join(rows)
+            + "</tbody></table></div>")
+
+
 def queryable_html(stats: Dict[str, Any]) -> str:
     """Queryable serving tier panel (``job_status()["queryable"]``):
     per-state lookup volume/latency + replica staleness and shard

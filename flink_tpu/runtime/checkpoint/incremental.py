@@ -90,6 +90,12 @@ class IncrementalCheckpointStorage:
         self._compact_thread: Optional[threading.Thread] = None
         #: compactions performed (observability + tests)
         self.compactions = 0
+        #: coordinator HA (ISSUE-20): optional zero-arg callable returning
+        #: a checkpoint id retention must never evict (or None).  Re-read
+        #: FRESH per eviction pass, and the pinned cut's WHOLE increment
+        #: chain is kept — the HA completed-checkpoint pointer stays
+        #: restorable even under a stale leader's concurrent retention.
+        self.pin_provider = None
         if os.path.exists(self._registry_path):
             with open(self._registry_path) as f:
                 self._registry = {k: list(v) for k, v in json.load(f).items()}
@@ -373,8 +379,16 @@ class IncrementalCheckpointStorage:
     # -- retention / registry ------------------------------------------------
     def _needed_ids(self, ids: List[int]) -> set:
         """Checkpoints retention must keep: the newest ``retain`` heads
-        plus every chain member a retained head still resolves through."""
-        heads = ids[-self.retain:] if self.retain else []
+        plus every chain member a retained head still resolves through —
+        and the HA-pinned cut's whole chain, when a pin provider is set."""
+        heads = list(ids[-self.retain:]) if self.retain else []
+        if self.pin_provider is not None:
+            try:
+                pinned = self.pin_provider()
+            except Exception:  # noqa: BLE001 — pin source unreadable
+                pinned = None
+            if pinned is not None and pinned in ids and pinned not in heads:
+                heads.append(pinned)
         needed = set()
         for head in heads:
             try:

@@ -78,6 +78,12 @@ class FileCheckpointStorage:
         self.base_dir = base_dir
         self.retain = retain
         self.fsync = fsync
+        #: coordinator HA (ISSUE-20): optional zero-arg callable returning
+        #: a checkpoint id retention must NEVER evict (or None) — re-read
+        #: FRESH at every cleanup pass, so the HA completed-checkpoint
+        #: pointer stays restorable even when a stale leader's concurrent
+        #: retention runs against the same directory
+        self.pin_provider: Optional[Callable[[], Optional[int]]] = None
         os.makedirs(base_dir, exist_ok=True)
 
     def _dir(self, checkpoint_id: int) -> str:
@@ -130,7 +136,15 @@ class FileCheckpointStorage:
 
     def _cleanup(self):
         ids = self.checkpoint_ids()
+        pinned = None
+        if self.pin_provider is not None:
+            try:
+                pinned = self.pin_provider()
+            except Exception:  # noqa: BLE001 — pin source unreadable:
+                pinned = None  # fall back to plain retention
         for cid in ids[: max(0, len(ids) - self.retain)]:
+            if pinned is not None and cid == pinned:
+                continue
             shutil.rmtree(self._dir(cid), ignore_errors=True)
 
     def checkpoint_ids(self) -> List[int]:

@@ -421,6 +421,251 @@ class ScenarioHarness:
             broker.stop()
         return res
 
+    # -- coordinator HA: kill the leader at the peak (ISSUE-20) ------------
+    def run_ha_kill(self) -> Dict[str, Any]:
+        """Coordinator-kill leg: leader A (epoch e) runs the scenario under
+        a :class:`FileHaStore` lease; at the diurnal peak a
+        ``KillCoordinator`` nemesis fails A's next lease renewal (loud
+        demotion — A becomes a ZOMBIE that keeps executing), standby B
+        acquires the lease at e+1, proves A's stale-epoch checkpoint
+        completions are fenced by the HA store, recovers the job from the
+        completed-checkpoint pointer (increment chains included) and runs
+        it to completion.  Verified exactly like :meth:`run`: committed
+        rows vs an unfaulted control leg — zero lost, zero duplicated,
+        digest-identical — plus two unconditional fencing probes
+        (``stale_pointer_rejected``, ``stale_commit_fenced``)."""
+        from flink_tpu.cluster.minicluster import MiniCluster
+        from flink_tpu.connectors.kafka import (KafkaExactlyOnceSink,
+                                                KafkaWireBroker)
+        from flink_tpu.runtime import ha as ha_mod
+        from flink_tpu.runtime.checkpoint.incremental import \
+            IncrementalCheckpointStorage
+
+        spec = self.spec
+        t_total = time.monotonic()
+        result: Dict[str, Any] = {
+            "scenario": self.scenario.name, "mode": "ha-kill",
+            "smoke": spec.smoke, "records": spec.records, "keys": spec.keys,
+        }
+        try:
+            broker = KafkaWireBroker(
+                directory=os.path.join(self.base_dir, "ha-kafka")).start()
+            try:
+                for t in spec.topics:
+                    broker.create_topic(t, partitions=1)
+                store = ha_mod.FileHaStore(
+                    os.path.join(self.base_dir, "ha-store"))
+                storage = IncrementalCheckpointStorage(
+                    os.path.join(self.base_dir, "ha-ckpts"), retain=6,
+                    max_increments_per_base=4, compact_in_background=False)
+                job_id = ha_mod.job_id_for(f"scenario-{self.scenario.name}")
+                # satellite 2: retention (A's AND B's — shared storage)
+                # never evicts the pointed-at cut, whole chain included
+                storage.pin_provider = lambda: (
+                    (store.completed_checkpoint(job_id) or {})
+                    .get("checkpoint_id"))
+                source = self.scenario.make_source(spec, paced=True)
+                ttl = 0.75
+
+                def make_cluster(epoch: int) -> MiniCluster:
+                    c = MiniCluster(
+                        checkpoint_storage=storage,
+                        checkpoint_interval_ms=50,
+                        alignment_timeout_ms=100.0,
+                        restart_attempts=2, incremental=True)
+                    # epoch-partitioned checkpoint ids: the zombie and the
+                    # new leader share one directory without colliding
+                    c._next_checkpoint_id = (epoch - 1) * 1_000_000 + 1
+
+                    def gate(cid: int, _e: int = epoch) -> bool:
+                        # the decisive fence: advancing the HA pointer
+                        # re-verifies the store's leader epoch — a zombie
+                        # fails HERE, before any notify fans out
+                        try:
+                            store.set_completed_checkpoint(job_id, cid, _e)
+                            return True
+                        except ha_mod.StaleEpochError:
+                            return False
+                    c.ha_commit_gate = gate
+                    return c
+
+                inj = chaos.FaultInjector(seed=spec.seed)
+                with chaos.installed(inj):
+                    # -- leader A (epoch e) ---------------------------------
+                    lease_a = store.acquire(f"leader-A-{os.getpid()}", ttl)
+                    store.register_job(
+                        job_id, {"scenario": self.scenario.name,
+                                 "parallelism": 2}, lease_a.epoch)
+                    demoted = threading.Event()
+                    t_demote = [0.0]
+
+                    def on_lost(exc: Exception) -> None:
+                        t_demote[0] = time.monotonic()
+                        demoted.set()
+
+                    renewer_a = ha_mod.LeaseRenewer(
+                        store, lease_a, ttl, on_lost=on_lost).start()
+                    cluster_a = make_cluster(lease_a.epoch)
+                    plan_a = self.scenario.plan(
+                        2, source, self._make_sinks(broker), spec)
+                    a_out: Dict[str, Any] = {}
+
+                    def run_a() -> None:
+                        try:
+                            r = cluster_a.execute(
+                                plan_a, timeout_s=self.job_timeout_s)
+                            a_out["state"] = str(r.state)
+                        except Exception as e:  # noqa: BLE001 — zombie dies
+                            a_out["error"] = f"{type(e).__name__}: {e}"
+
+                    thread_a = threading.Thread(target=run_a, daemon=True,
+                                                name="ha-leader-A")
+                    thread_a.start()
+                    # arm the kill at the peak, once at least one cut has
+                    # published a pointer (something to recover FROM)
+                    deadline = time.monotonic() + self.job_timeout_s
+                    while time.monotonic() < deadline and (
+                            source.progress_frac() < PEAK_ARM_FRAC
+                            or store.completed_checkpoint(job_id) is None):
+                        time.sleep(0.02)
+                    inj.inject("ha.lease", chaos.KillCoordinator(at=1))
+                    demoted.wait(timeout=30)
+                    renewer_a.stop()
+                    renewer_a.join()
+                    if not t_demote[0]:
+                        t_demote[0] = time.monotonic()
+
+                    # -- standby B takes over at epoch e+1 ------------------
+                    lease_b = store.acquire(f"leader-B-{os.getpid()}", ttl,
+                                            timeout_s=60.0)
+                    renewer_b = ha_mod.LeaseRenewer(store, lease_b,
+                                                    ttl).start()
+                    # zombie probe: A is STILL RUNNING — its next
+                    # completion must bounce off the store's epoch fence
+                    probe_deadline = time.monotonic() + 10.0
+                    while (cluster_a.ha_fenced_completions == 0
+                           and thread_a.is_alive()
+                           and time.monotonic() < probe_deadline):
+                        time.sleep(0.02)
+                    stale_pointer_rejected = \
+                        cluster_a.ha_fenced_completions > 0
+                    pointer = store.completed_checkpoint(job_id)
+                    # stand the zombie down before the new incarnation
+                    # deploys (its open transactions get swept by B's
+                    # restore anyway)
+                    cluster_a.cancel()
+                    thread_a.join(timeout=60)
+
+                    snap, restore_source = ha_mod.resolve_restore(
+                        store, job_id, storage)
+                    registered = store.load_job(job_id)
+                    cluster_b = make_cluster(lease_b.epoch)
+                    plan_b = self.scenario.plan(
+                        int(registered.get("parallelism", 2)), source,
+                        self._make_sinks(broker), spec)
+                    b_out: Dict[str, Any] = {}
+
+                    def run_b() -> None:
+                        try:
+                            r = cluster_b.execute(
+                                plan_b, restore=snap,
+                                timeout_s=self.job_timeout_s)
+                            b_out["state"] = str(r.state)
+                        except Exception as e:  # noqa: BLE001
+                            b_out["error"] = f"{type(e).__name__}: {e}"
+
+                    thread_b = threading.Thread(target=run_b, daemon=True,
+                                                name="ha-leader-B")
+                    thread_b.start()
+                    # recovered = the NEW epoch completes a cut of its own
+                    recover_deadline = time.monotonic() + self.job_timeout_s
+                    while time.monotonic() < recover_deadline:
+                        ptr = store.completed_checkpoint(job_id)
+                        if ptr is not None and ptr["epoch"] >= lease_b.epoch:
+                            break
+                        if not thread_b.is_alive():
+                            break
+                        time.sleep(0.02)
+                    recovery_ms = round(
+                        (time.monotonic() - t_demote[0]) * 1000.0, 1)
+                    thread_b.join(timeout=self.job_timeout_s + 60)
+                    renewer_b.stop()
+                    renewer_b.join()
+
+                    # unconditional 2PC fence probe on a side topic (never
+                    # part of the digest): a staged transaction notified
+                    # under the OLD epoch must not commit
+                    broker.create_topic("ha-probe", partitions=1)
+                    psink = KafkaExactlyOnceSink(
+                        broker.host, broker.port, "ha-probe",
+                        sink_id="ha-probe", buffer_rows=4)
+                    try:
+                        h = tuple(psink.begin_transaction(psink.txn_name(0)))
+                        psink.write_rows(h, [{"probe": 1}])
+                        psink.pre_commit(h)
+                        psink._staged.append((h, 1))
+                        psink.fence_epoch = lease_b.epoch
+                        psink.notify_checkpoint_complete(
+                            1, epoch=lease_a.epoch)
+                        fenced_nothing = (
+                            psink.fenced_commits == 1
+                            and not consume_topic(broker, "ha-probe"))
+                        psink.notify_checkpoint_complete(
+                            1, epoch=lease_b.epoch)
+                        stale_commit_fenced = (
+                            fenced_nothing
+                            and len(consume_topic(broker, "ha-probe")) == 1)
+                    finally:
+                        psink.close()
+
+                faulted_committed = {t: consume_topic(broker, t)
+                                     for t in spec.topics}
+                result.update({
+                    "state": b_out.get("state", b_out.get("error",
+                                                          "Unknown")),
+                    "zombie_state": a_out.get("state",
+                                              a_out.get("error", "Unknown")),
+                    "leader_epochs": [lease_a.epoch, lease_b.epoch],
+                    "recovery_ms": recovery_ms,
+                    "restore_source": restore_source,
+                    "fenced_completions": cluster_a.ha_fenced_completions,
+                    "stale_pointer_rejected": bool(stale_pointer_rejected),
+                    "stale_commit_fenced": bool(stale_commit_fenced),
+                    "pointer": pointer,
+                })
+            finally:
+                broker.stop()
+
+            control = self._run_control()
+            lost, dup = diff_committed(faulted_committed, control.committed)
+            f_digest = committed_digest(faulted_committed)
+            c_digest = committed_digest(control.committed)
+            committed_total = sum(len(r) for r in faulted_committed.values())
+            result.update({
+                "control_state": control.state,
+                "control_error": control.error,
+                "records_lost": int(lost),
+                "records_duplicated": int(dup),
+                "digest_match": f_digest == c_digest,
+                "committed_rows": {t: len(r)
+                                   for t, r in faulted_committed.items()},
+                "control_rows": {t: len(r)
+                                 for t, r in control.committed.items()},
+                "ok": bool(result.get("state") == "FINISHED"
+                           and control.state == "Finished"
+                           and lost == 0 and dup == 0
+                           and f_digest == c_digest and committed_total > 0
+                           and result["stale_pointer_rejected"]
+                           and result["stale_commit_fenced"]
+                           and result["leader_epochs"][1]
+                           > result["leader_epochs"][0]),
+            })
+        finally:
+            if self._own_dir:
+                shutil.rmtree(self.base_dir, ignore_errors=True)
+        result["wall_ms"] = round((time.monotonic() - t_total) * 1000.0, 1)
+        return result
+
     # -- the whole scenario ------------------------------------------------
     def run(self) -> Dict[str, Any]:
         spec = self.spec

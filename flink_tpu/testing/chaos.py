@@ -11,7 +11,11 @@ fetch; fired with ``direction="storage->replica"`` so
 ``Partition(direction=)`` cuts exactly the replica's data plane),
 ``rescale.redistribute`` / ``rescale.redeploy`` (the rescale lifecycle's
 channel-state redistribution and redeploy steps — the
-:class:`KillDuringRescale` prey) — each
+:class:`KillDuringRescale` prey), ``ha.lease`` (the HA store's lease
+renewal write: :class:`TruncatedWrite` tears the renewal so the
+verify-back demotes the holder loudly; :class:`KillCoordinator` fails
+the n-th renewal outright — the leader "dies" and a standby takes over
+at epoch + 1) — each
 a near-zero-cost :func:`fire` call that consults the
 installed :class:`FaultInjector`.  Tests attach *schedules*
 (fail-K-times-then-succeed, crash-once-at-N, delay-by-D,
@@ -50,7 +54,7 @@ __all__ = [
     "InjectedFault", "FaultSchedule", "FailTimes", "CrashOnceAt", "DelayBy",
     "SlowDisk", "SlowConsumer", "ActionSequence", "Partition",
     "FailWithProbability", "WedgedDevice", "ClockSkew", "KillDuringRescale",
-    "TruncatedWrite",
+    "KillCoordinator", "TruncatedWrite",
     "FaultInjector", "FreezableProxy", "install", "uninstall", "installed",
     "fire", "active", "blocked", "skew", "truncated",
 ]
@@ -407,6 +411,38 @@ class KillDuringRescale(FaultSchedule):
                 # delay branch, so model it as a slow kill message
                 time.sleep(self.stall_s)
             return (FAIL, f"killed during rescale (firing {n})")
+        return OK
+
+
+class KillCoordinator(FaultSchedule):
+    """Kill the LEADER coordinator — fired at the ``ha.lease`` point,
+    which the HA store hits on every lease renewal write.  Deterministic:
+    the ``at``-th renewal (``times`` consecutive renewals when given)
+    fails outright, so the :class:`~flink_tpu.runtime.ha.LeaseRenewer`
+    invokes its ``on_lost`` demotion and the leader stands down exactly
+    as if the process died mid-flight: the lease ages out, a standby
+    acquires it at epoch + 1, recovers the job from the HA store's
+    completed-checkpoint pointer and resumes triggering.  ``stall_s``
+    sleeps before the kill (a wedged-then-dead leader whose lease file
+    goes stale while it still holds sockets open).  The cluster is
+    expected to absorb the kill with zero lost and zero duplicated
+    records: every stale-epoch completion, deploy and 2PC commit the
+    zombie attempts afterwards is fenced."""
+
+    def __init__(self, at: int = 1, times: int = 1, stall_s: float = 0.0):
+        if times < 1:
+            raise ValueError("KillCoordinator: times must be >= 1")
+        self.at = at
+        self.times = times
+        self.stall_s = stall_s
+
+    def action(self, n: int, rng: random.Random) -> Action:
+        if self.at <= n < self.at + self.times:
+            if self.stall_s > 0:
+                # composite firing: hold the lease stale first, then die —
+                # same slow-kill modeling as KillDuringRescale
+                time.sleep(self.stall_s)
+            return (FAIL, f"coordinator killed at lease renewal {n}")
         return OK
 
 

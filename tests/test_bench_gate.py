@@ -923,3 +923,98 @@ def test_autoscale_bench_smoke_passes_gate():
     assert result["rescales"] >= 1
     assert max(result["parallelism_path"]) >= 4
     assert result["rescale_latency_ms"] is not None
+
+
+def _ha_result(state="FINISHED", control="Finished", epochs=(1, 2),
+               pointer_fenced=True, commit_fenced=True, lost=0, dup=0,
+               digest=True, committed=None, recovery=4000.0):
+    return {"scenario": "fraud_detection", "state": state,
+            "control_state": control, "leader_epochs": list(epochs),
+            "stale_pointer_rejected": pointer_fenced,
+            "stale_commit_fenced": commit_fenced,
+            "records_lost": lost, "records_duplicated": dup,
+            "digest_match": digest,
+            "committed_rows": committed if committed is not None
+            else {"alerts": 575},
+            "recovery_ms": recovery}
+
+
+def _ha_budget(**kw):
+    b = {"max_recovery_ms": 30000}
+    b.update(kw)
+    return b
+
+
+def test_check_ha_budget_pass():
+    from bench import check_ha_budget
+    assert check_ha_budget(_ha_result(), _ha_budget()) == []
+
+
+def test_check_ha_budget_fencing_and_exactly_once_always_gate():
+    """A zombie completing a checkpoint or committing a 2PC transaction,
+    a non-advancing epoch, lost/duplicated rows, a digest mismatch or no
+    output violate even with an EMPTY budget section and in smoke — a
+    split-brain run must never exit 0 because no ceiling was
+    configured."""
+    from bench import check_ha_budget
+    assert any("NOT fenced by the HA store" in v for v in check_ha_budget(
+        _ha_result(pointer_fenced=False), {}, smoke=True))
+    assert any("2PC" in v for v in check_ha_budget(
+        _ha_result(commit_fenced=False), {}, smoke=True))
+    assert any("leader epoch" in v for v in check_ha_budget(
+        _ha_result(epochs=(1, 1)), {}, smoke=True))
+    assert any("leader epoch" in v for v in check_ha_budget(
+        _ha_result(epochs=(2,)), {}, smoke=True))
+    assert any("records_lost" in v for v in check_ha_budget(
+        _ha_result(lost=3), {}, smoke=True))
+    assert any("records_duplicated" in v for v in check_ha_budget(
+        _ha_result(dup=1), {}, smoke=True))
+    assert any("digest" in v for v in check_ha_budget(
+        _ha_result(digest=False), {}, smoke=True))
+    assert any("did not finish" in v for v in check_ha_budget(
+        _ha_result(state="FAILED"), {}, smoke=True))
+    assert any("control" in v for v in check_ha_budget(
+        _ha_result(control="Canceled"), {}, smoke=True))
+    assert any("no committed output" in v for v in check_ha_budget(
+        _ha_result(committed={"alerts": 0}), {}, smoke=True))
+
+
+def test_check_ha_budget_recovery_ceiling_full_only():
+    from bench import check_ha_budget
+    b = _ha_budget(max_recovery_ms=1000)
+    assert any("recovery" in v for v in check_ha_budget(
+        _ha_result(recovery=5000.0), b))
+    # smoke hosts jitter too much for a wall-clock gate
+    assert check_ha_budget(_ha_result(recovery=5000.0), b,
+                           smoke=True) == []
+
+
+def test_ha_budget_section_present():
+    with open(os.path.join(REPO, "BENCH_BUDGET.json")) as f:
+        budget = json.load(f)
+    ha = budget["ha_cpu"]
+    assert ha["max_recovery_ms"] > 0
+
+
+@pytest.mark.slow
+def test_ha_kill_bench_smoke_passes_gate():
+    """bench.py --ha-kill --smoke --check end-to-end on CPU: the leader
+    is killed at the peak and runs on as a zombie, the standby takes
+    over at epoch+1, both stale-epoch fences hold, and the committed
+    ha_cpu gate passes with a digest identical to the control."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ha-kill",
+         "--smoke", "--check"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-3000:])
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"]
+    res = result["ha_kill"]
+    assert res["state"] == "FINISHED"
+    assert res["control_state"] == "Finished"
+    assert res["leader_epochs"][1] == res["leader_epochs"][0] + 1
+    assert res["stale_pointer_rejected"] and res["stale_commit_fenced"]
+    assert res["records_lost"] == 0 and res["records_duplicated"] == 0
+    assert res["digest_match"]
+    assert res["restore_source"] == "ha-pointer"

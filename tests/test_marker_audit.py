@@ -304,6 +304,28 @@ def test_incremental_checkpoint_suite_collects_under_tier1():
          f"incremental-checkpoint restore coverage left the gate")
 
 
+def test_ha_suite_collects_under_tier1():
+    """The coordinator-HA suite (ISSUE-20) must contribute tests to the
+    tier-1 run under ``JAX_PLATFORMS=cpu`` — the lease/epoch units, the
+    store/worker/data-plane/2PC stale-epoch fences, the pinned-retention
+    and resolve_restore recovery semantics and the kill-the-leader
+    scenario acceptance all run on the CPU backend, so a slow-mark sweep
+    that silently drops them fails here."""
+    import subprocess
+
+    f = "test_ha.py"
+    assert (TESTS / f).exists(), f
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", str(TESTS / f)],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"{f}::" in proc.stdout, \
+        (f"{f} contributes no tests to the tier-1 selection — the "
+         f"coordinator-HA fencing coverage left the gate")
+
+
 def test_marker_declarations_have_descriptions():
     """Each declared marker carries a description (the `name: text` form)
     so `pytest --markers` documents the tiers."""
